@@ -1,0 +1,25 @@
+"""mxnet_tpu: a TPU-native deep-learning framework with MXNet's capabilities.
+
+Import as ``import mxnet_tpu as mx`` — the public surface mirrors the
+reference (`python/mxnet/__init__.py`): mx.nd, mx.autograd, mx.gluon,
+mx.optimizer, mx.kvstore, mx.io, mx.metric, mx.context/device helpers,
+mx.random, mx.profiler, mx.init — rebuilt on JAX/XLA/PJRT (see SURVEY.md).
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .base import MXNetError, get_env, set_env, environment
+from .device import (Context, Device, cpu, gpu, tpu, cpu_pinned, num_gpus,
+                     num_tpus, current_context, current_device)
+from . import engine
+from . import ops
+from . import ndarray
+from . import ndarray as nd
+from . import autograd
+from . import random
+from .ndarray import NDArray, waitall
+
+# Subsystems land milestone-by-milestone (SURVEY.md §7.1); this list grows
+# until it covers the reference's full `python/mxnet/__init__.py` surface.
+from . import test_utils

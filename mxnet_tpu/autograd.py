@@ -1,0 +1,377 @@
+"""Imperative autograd: tape recording + reverse-mode backward.
+
+Reference: python/mxnet/autograd.py (record, pause, backward, grad, Function),
+src/imperative/imperative.cc (Imperative::RecordOp, Imperative::Backward),
+src/nnvm/gradient.cc (Gradient pass).
+
+TPU-native design (SURVEY.md §3.3 TPU mapping): each eagerly-invoked op is
+recorded as a tape node carrying the backward closure obtained from
+``jax.vjp`` over the op's pure JAX implementation — jax.vjp plays the role of
+the per-op FGradient attribute and runs the forward exactly once.
+``backward()`` walks the tape in reverse topological order accumulating
+cotangents and writes leaf gradients into the arrays attached by
+``attach_grad`` honoring grad_req ('write' | 'add' | 'null').  A hybridized
+block records ONE node whose vjp is the jit-compiled backward of the whole
+cached graph, so the training hot path is two XLA executables, not a Python
+loop (SURVEY.md §7.2 item 1).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "set_recording", "set_training", "backward", "grad",
+           "mark_variables", "Function", "VariableNode"]
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "recording"):
+        _state.recording = False
+        _state.training = False
+    return _state
+
+
+def is_recording() -> bool:
+    return _st().recording
+
+
+def is_training() -> bool:
+    return _st().training
+
+
+def set_recording(flag: bool) -> bool:
+    st = _st()
+    old = st.recording
+    st.recording = bool(flag)
+    return old
+
+
+def set_training(flag: bool) -> bool:
+    st = _st()
+    old = st.training
+    st.training = bool(flag)
+    return old
+
+
+class _Scope:
+    def __init__(self, recording: Optional[bool], training: Optional[bool]):
+        self._rec = recording
+        self._train = training
+
+    def __enter__(self):
+        st = _st()
+        self._old = (st.recording, st.training)
+        if self._rec is not None:
+            st.recording = self._rec
+        if self._train is not None:
+            st.training = self._train
+        return self
+
+    def __exit__(self, *exc):
+        st = _st()
+        st.recording, st.training = self._old
+        return False
+
+
+def record(train_mode: bool = True) -> _Scope:
+    return _Scope(True, train_mode)
+
+
+def pause(train_mode: bool = False) -> _Scope:
+    return _Scope(False, train_mode)
+
+
+def train_mode() -> _Scope:
+    return _Scope(None, True)
+
+
+def predict_mode() -> _Scope:
+    return _Scope(None, False)
+
+
+# ---------------------------------------------------------------------------
+# tape
+# ---------------------------------------------------------------------------
+
+
+class VariableNode:
+    """Leaf marker created by NDArray.attach_grad / mark_variables."""
+    __slots__ = ("array",)
+
+    def __init__(self, array):
+        self.array = array
+
+
+class OpNode:
+    """One recorded op: vjp closure + parent links (≈ nnvm::Node + AGInfo)."""
+    __slots__ = ("name", "vjp_fn", "parents", "n_outputs", "rng_offset",
+                 "out_structure", "out_avals")
+
+    def __init__(self, name, vjp_fn, parents, n_outputs, rng_offset,
+                 out_structure, out_avals):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.parents = parents      # per-jax-input: VariableNode|OpNode|None
+        self.n_outputs = n_outputs
+        self.rng_offset = rng_offset
+        self.out_structure = out_structure  # 'one' | 'tuple'
+        self.out_avals = out_avals  # [(shape, dtype)] for zero-cotangent fill
+
+
+def record_op(op, params: Dict[str, Any], nd_inputs, jax_in, ctx):
+    """Called by ndarray.invoke while recording.  Runs forward via jax.vjp and
+    wraps outputs with tape pointers."""
+    from .ndarray.ndarray import NDArray
+
+    def pure(*xs):
+        return op.fn(*xs, **params)
+
+    outs, vjp_fn = jax.vjp(pure, *jax_in)
+    structure = "tuple" if isinstance(outs, tuple) else "one"
+    outs_t = outs if structure == "tuple" else (outs,)
+    rng_offset = 1 if op.needs_rng else 0
+
+    parents: List[Any] = [None] * rng_offset
+    for x in nd_inputs:
+        if isinstance(x, NDArray):
+            parents.append(x._ag_node)
+        else:
+            parents.append(None)
+    avals = [(o.shape, o.dtype) for o in outs_t]
+    node = OpNode(op.name, vjp_fn, parents, len(outs_t), rng_offset, structure,
+                  avals)
+    wrapped = []
+    for i, o in enumerate(outs_t):
+        nd = NDArray(o, ctx=ctx)
+        nd._ag_node = (node, i)
+        wrapped.append(nd)
+    if structure == "one":
+        return wrapped[0]
+    return wrapped
+
+
+def record_custom(vjp_fn, nd_inputs, outs, ctx, name="custom"):
+    """Record a single node with a user/jit-supplied vjp (the CachedOp path)."""
+    from .ndarray.ndarray import NDArray
+    structure = "tuple" if isinstance(outs, tuple) else "one"
+    outs_t = outs if structure == "tuple" else (outs,)
+    parents = []
+    for x in nd_inputs:
+        parents.append(x._ag_node if isinstance(x, NDArray) else None)
+    avals = [(o.shape, o.dtype) for o in outs_t]
+    node = OpNode(name, vjp_fn, parents, len(outs_t), 0, structure, avals)
+    wrapped = []
+    for i, o in enumerate(outs_t):
+        nd = NDArray(o, ctx=ctx)
+        nd._ag_node = (node, i)
+        wrapped.append(nd)
+    return wrapped[0] if structure == "one" else wrapped
+
+
+def mark_variables(variables, gradients, grad_reqs="write") -> None:
+    """Reference: autograd.mark_variables."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._grad = g
+        v._grad_req = req
+        v._ag_node = VariableNode(v)
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _topo_from(heads: Sequence[Tuple[OpNode, int]]) -> List[OpNode]:
+    """Iterative post-order DFS from the head nodes (no recursion limit on
+    deep tapes).  Post-order emits a node after all its producers, so the
+    caller iterates ``reversed(order)`` to run heads-first backward."""
+    seen = set()
+    order: List[OpNode] = []
+    for head, _ in heads:
+        if not isinstance(head, OpNode) or id(head) in seen:
+            continue
+        seen.add(id(head))
+        stack = [(head, iter(head.parents))]
+        while stack:
+            n, it = stack[-1]
+            advanced = False
+            for p in it:
+                pn = p[0] if isinstance(p, tuple) else p
+                if isinstance(pn, OpNode) and id(pn) not in seen:
+                    seen.add(id(pn))
+                    stack.append((pn, iter(pn.parents)))
+                    advanced = True
+                    break
+            if not advanced:
+                order.append(n)
+                stack.pop()
+    return order
+
+
+def backward(heads, head_grads=None, retain_graph: bool = False,
+             train_mode: bool = True) -> None:
+    """Compute gradients of heads w.r.t. attached variables (writes .grad)."""
+    _run_backward(heads, head_grads, retain_graph, write_leaves=True)
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph: bool = False, train_mode: bool = True):
+    """Reference: autograd.grad — returns grads instead of writing .grad."""
+    if create_graph:
+        raise NotImplementedError("higher-order autograd: not yet supported")
+    from .ndarray.ndarray import NDArray
+    variables = list(variables)
+    got = _run_backward(heads, head_grads, retain_graph or False,
+                        write_leaves=False, wanted=variables)
+    out = []
+    for v in variables:
+        g = got.get(id(v))
+        if g is None:
+            raise MXNetError("one of the variables does not require gradient "
+                             "or is unreachable from heads")
+        out.append(NDArray(g, ctx=v.context))
+    return out
+
+
+def _run_backward(heads, head_grads, retain_graph, write_leaves=True,
+                  wanted=None):
+    from .ndarray.ndarray import NDArray
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is not None and isinstance(head_grads, NDArray):
+        head_grads = [head_grads]
+
+    # cotangent store: id(OpNode) -> list per output slot
+    cts: Dict[int, List[Optional[jax.Array]]] = {}
+    leaf_vals: Dict[int, jax.Array] = {}
+    leaf_refs: Dict[int, Any] = {}
+    head_nodes: List[Tuple[OpNode, int]] = []
+
+    def add_ct(target, value):
+        if target is None:
+            return
+        if isinstance(target, VariableNode):
+            arr = target.array
+            prev = leaf_vals.get(id(arr))
+            leaf_vals[id(arr)] = value if prev is None else prev + value
+            leaf_refs[id(arr)] = arr
+            return
+        node, idx = target
+        slot = cts.setdefault(id(node), [None] * node.n_outputs)
+        slot[idx] = value if slot[idx] is None else slot[idx] + value
+
+    for i, h in enumerate(heads):
+        if h._ag_node is None:
+            raise MXNetError("cannot differentiate a head that was not "
+                             "computed while autograd was recording")
+        hg = None
+        if head_grads is not None and head_grads[i] is not None:
+            hg = head_grads[i]._jax if isinstance(head_grads[i], NDArray) \
+                else jnp.asarray(head_grads[i])
+        else:
+            hg = jnp.ones(h.shape, h.dtype)
+        add_ct(h._ag_node, hg)
+        if isinstance(h._ag_node, tuple):
+            head_nodes.append(h._ag_node)
+
+    order = _topo_from(head_nodes)
+    # order: producers-before-consumers removed by reversal → walk heads first
+    for node in reversed(order):
+        slot = cts.get(id(node))
+        if slot is None:
+            continue
+        cotangents = [
+            c if c is not None else jnp.zeros(node.out_avals[i][0],
+                                              node.out_avals[i][1])
+            for i, c in enumerate(slot)]
+        ct_in = tuple(cotangents) if node.out_structure == "tuple" else cotangents[0]
+        if node.vjp_fn is None:
+            raise MXNetError(
+                "backward through op %r a second time, but the graph was "
+                "freed; pass retain_graph=True to the first backward"
+                % node.name)
+        grads = node.vjp_fn(ct_in)
+        if not retain_graph:
+            node.vjp_fn = None
+        for parent, g in zip(node.parents, grads):
+            if parent is not None and g is not None:
+                add_ct(parent, g)
+
+    if write_leaves:
+        for key, val in leaf_vals.items():
+            arr = leaf_refs[key]
+            req = arr._grad_req
+            if req == "null" or arr._grad is None:
+                continue
+            if req == "add":
+                arr._grad._set_jax(arr._grad._jax + val.astype(arr._grad.dtype))
+            else:
+                arr._grad._set_jax(val.astype(arr._grad.dtype))
+        return None
+    return dict(leaf_vals)
+
+
+# ---------------------------------------------------------------------------
+# custom differentiable Function (reference: autograd.Function)
+# ---------------------------------------------------------------------------
+
+
+class Function:
+    """User-defined differentiable function.
+
+    Subclass and implement forward(self, *inputs) and backward(self,
+    *output_grads), both over NDArrays.  Mirrors python/mxnet/autograd.py
+    (class Function).
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *arrays):
+        self._saved = arrays
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray
+        with pause():
+            outs = self.forward(*inputs)
+        single = not isinstance(outs, (list, tuple))
+        outs_t = (outs,) if single else tuple(outs)
+        if not is_recording():
+            return outs
+        func = self
+
+        def vjp_fn(cotangents):
+            cts = (cotangents,) if single else cotangents
+            with pause():
+                gr = func.backward(*[NDArray(c) for c in cts])
+            if not isinstance(gr, (list, tuple)):
+                gr = (gr,)
+            return tuple(g._jax if isinstance(g, NDArray) else g for g in gr)
+
+        ctx = inputs[0].context if inputs and isinstance(inputs[0], NDArray) \
+            else None
+        jax_outs = tuple(o._jax for o in outs_t)
+        res = record_custom(vjp_fn, list(inputs),
+                            jax_outs if not single else jax_outs[0],
+                            ctx, name=type(self).__name__)
+        return res

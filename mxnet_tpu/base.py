@@ -1,0 +1,111 @@
+"""Foundation utilities: env-flag system, registry helpers, error types.
+
+TPU-native rebuild of the roles played by the reference's dmlc-core
+(`dmlc/parameter.h` DMLC_DECLARE_PARAMETER reflection, `dmlc::GetEnv` flag
+reads, `dmlc/logging.h` CHECK macros) and `python/mxnet/base.py` (ctypes
+plumbing).  There is no C ABI here: the framework is Python-first over
+jax/jaxlib, so "handle plumbing" reduces to ordinary Python objects.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, Optional
+
+__all__ = [
+    "MXNetError",
+    "get_env",
+    "set_env",
+    "environment",
+    "string_types",
+    "numeric_types",
+    "integer_types",
+]
+
+
+class MXNetError(RuntimeError):
+    """Default error type raised by the framework (reference: MXGetLastError)."""
+
+
+string_types = (str,)
+numeric_types = (float, int)
+integer_types = (int,)
+
+# ---------------------------------------------------------------------------
+# Env-flag system (reference: dmlc::GetEnv + env_var.md catalog).
+# Flags are read lazily at first use, like the reference, but we also keep a
+# process-local override dict so `mx.util.set_env` / the `environment()` test
+# context-manager work without mutating os.environ for spawned workers.
+# ---------------------------------------------------------------------------
+
+_env_overrides: Dict[str, Optional[str]] = {}
+_env_lock = threading.Lock()
+
+# Canonical flag catalog: name -> (default, docstring). Kept for doc-gen and
+# `mx.runtime` feature reporting; unknown MXNET_* flags still read through.
+ENV_CATALOG: Dict[str, Any] = {
+    "MXNET_ENGINE_TYPE": ("ThreadedEnginePerDevice", "Execution mode: 'NaiveEngine' forces synchronous per-op execution (block_until_ready after every op) for debugging; any other value keeps XLA async dispatch."),
+    "MXNET_EXEC_BULK_EXEC_INFERENCE": ("1", "No-op on TPU (XLA fuses); accepted for compat."),
+    "MXNET_EXEC_BULK_EXEC_TRAIN": ("1", "No-op on TPU (XLA fuses); accepted for compat."),
+    "MXNET_GPU_MEM_POOL_TYPE": ("Round", "No-op: PJRT owns HBM pooling."),
+    "MXNET_KVSTORE_BIGARRAY_BOUND": ("1000000", "Gradient bucket size threshold for kvstore collectives."),
+    "MXNET_ENFORCE_DETERMINISM": ("0", "Force deterministic kernels."),
+    "MXNET_SAFE_ACCUMULATION": ("1", "Accumulate reductions in fp32 even for fp16/bf16 inputs."),
+    "MXNET_DEFAULT_DTYPE": ("float32", "Default dtype for array creation."),
+}
+
+
+def get_env(name: str, default: Any = None, dtype: Callable = str) -> Any:
+    """Read an env flag with overrides (reference: dmlc::GetEnv)."""
+    with _env_lock:
+        if name in _env_overrides:
+            val = _env_overrides[name]
+        else:
+            val = os.environ.get(name)
+    if val is None:
+        if default is None and name in ENV_CATALOG:
+            default = ENV_CATALOG[name][0]
+        if default is None:
+            return None
+        val = default
+    try:
+        if dtype is bool:
+            return str(val).lower() in ("1", "true", "yes", "on")
+        return dtype(val)
+    except (TypeError, ValueError):
+        return default
+
+
+def set_env(name: str, value: Optional[str]) -> None:
+    """Set (or with None, unset) a process-local env override."""
+    with _env_lock:
+        _env_overrides[name] = None if value is None else str(value)
+        if value is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = str(value)
+
+
+class environment:
+    """Context manager scoping env-var changes (reference:
+    python/mxnet/test_utils.py (environment))."""
+
+    def __init__(self, *args):
+        if len(args) == 1 and isinstance(args[0], dict):
+            self._kwargs = dict(args[0])
+        elif len(args) == 2:
+            self._kwargs = {args[0]: args[1]}
+        else:
+            raise ValueError("environment() takes (name, value) or a dict")
+        self._saved: Dict[str, Optional[str]] = {}
+
+    def __enter__(self):
+        for k, v in self._kwargs.items():
+            self._saved[k] = os.environ.get(k)
+            set_env(k, v)
+        return self
+
+    def __exit__(self, *exc):
+        for k, v in self._saved.items():
+            set_env(k, v)
+        return False

@@ -1,0 +1,176 @@
+"""Device contexts (reference: python/mxnet/context.py `class Context`,
+include/mxnet/base.h `Context::GPU/CPU`).
+
+TPU-native mapping: a Context names a jax.Device. `mx.tpu(i)` is the
+first-class accelerator context (the reference's `mx.gpu(i)` role); `mx.gpu(i)`
+is kept as a compatibility alias for the accelerator so reference scripts run
+unmodified. `mx.cpu()` maps to the host XLA:CPU backend. When no TPU backend
+is present (pure-CPU test environments with a forced 8-device host platform),
+`tpu(i)` resolves to the i-th CPU device so the full test suite exercises
+multi-device logic on a fake mesh (SURVEY.md §4.5).
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import jax
+
+__all__ = ["Context", "cpu", "gpu", "tpu", "cpu_pinned", "num_gpus", "num_tpus",
+           "current_context", "current_device", "Device"]
+
+_ACCEL_PLATFORMS = ("tpu", "axon")  # axon = tunneled TPU platform name
+
+
+def _accel_devices() -> List[jax.Device]:
+    import os
+    if os.environ.get("MX_FORCE_CPU"):
+        # test harness: pretend no accelerator so tpu(i) maps onto the fake
+        # 8-device host mesh (SURVEY.md §4.5)
+        return []
+    for plat in _ACCEL_PLATFORMS:
+        try:
+            devs = jax.devices(plat)
+            if devs:
+                return devs
+        except RuntimeError:
+            continue
+    return []
+
+
+def _cpu_devices() -> List[jax.Device]:
+    try:
+        return jax.devices("cpu")
+    except RuntimeError:
+        # No cpu backend registered (rare); fall back to default platform.
+        return jax.devices()
+
+
+class Context:
+    """A device context. devtype in {'cpu', 'tpu', 'gpu', 'cpu_pinned'}.
+
+    'gpu' is an alias for the accelerator (tpu); 'cpu_pinned' aliases cpu
+    (PJRT manages pinned staging buffers itself).
+    """
+
+    devtype2str = {1: "cpu", 2: "gpu", 3: "cpu_pinned", 5: "tpu"}
+    devstr2type = {"cpu": 1, "gpu": 2, "cpu_pinned": 3, "tpu": 5}
+    _default_ctx = threading.local()
+
+    __slots__ = ("device_typeid", "device_id", "_old_ctx")
+
+    def __init__(self, device_type, device_id: int = 0):
+        if isinstance(device_type, Context):
+            self.device_typeid = device_type.device_typeid
+            self.device_id = device_type.device_id
+        elif isinstance(device_type, str):
+            self.device_typeid = Context.devstr2type[device_type]
+            self.device_id = device_id
+        else:
+            self.device_typeid = int(device_type)
+            self.device_id = device_id
+        self._old_ctx: Optional[Context] = None
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def device_type(self) -> str:
+        return Context.devtype2str[self.device_typeid]
+
+    def __hash__(self):
+        return hash((self.canonical_type, self.device_id))
+
+    @property
+    def canonical_type(self) -> str:
+        """'gpu' and 'tpu' are the same physical accelerator here."""
+        t = self.device_type
+        if t == "gpu":
+            return "tpu"
+        if t == "cpu_pinned":
+            return "cpu"
+        return t
+
+    def __eq__(self, other):
+        return (isinstance(other, Context)
+                and self.canonical_type == other.canonical_type
+                and self.device_id == other.device_id)
+
+    def __repr__(self):
+        return "%s(%d)" % (self.device_type, self.device_id)
+
+    def __str__(self):
+        return self.__repr__()
+
+    # -- jax mapping -------------------------------------------------------
+    @property
+    def jax_device(self) -> jax.Device:
+        if self.canonical_type == "tpu":
+            devs = _accel_devices()
+            if not devs:  # fake-mesh fallback: tpu(i) -> i-th host device
+                devs = _cpu_devices()
+        else:
+            devs = _cpu_devices()
+        if self.device_id >= len(devs):
+            raise ValueError(
+                "%s: device_id %d out of range (%d %s device(s) visible)"
+                % (self, self.device_id, len(devs), self.canonical_type))
+        return devs[self.device_id]
+
+    # -- default-context stack (reference: with mx.Context(...)) -----------
+    def __enter__(self):
+        if not hasattr(Context._default_ctx, "value"):
+            Context._default_ctx.value = Context("cpu", 0)
+        self._old_ctx = Context._default_ctx.value
+        Context._default_ctx.value = self
+        return self
+
+    def __exit__(self, *exc):
+        Context._default_ctx.value = self._old_ctx
+        return False
+
+    def empty_cache(self):
+        """Reference: Context.empty_cache. PJRT owns pooling; best-effort."""
+        # jax has no public per-device cache drop; live buffers stay valid.
+        return None
+
+
+# Device is the 2.x-era name for Context.
+Device = Context
+
+
+def cpu(device_id: int = 0) -> Context:
+    return Context("cpu", device_id)
+
+
+def cpu_pinned(device_id: int = 0) -> Context:
+    return Context("cpu_pinned", device_id)
+
+
+def tpu(device_id: int = 0) -> Context:
+    return Context("tpu", device_id)
+
+
+def gpu(device_id: int = 0) -> Context:
+    """Compatibility alias: accelerator context (maps to the TPU chip)."""
+    return Context("gpu", device_id)
+
+
+def num_tpus() -> int:
+    devs = _accel_devices()
+    if devs:
+        return len(devs)
+    # fake-mesh fallback mirrors tpu()'s resolution
+    return len(_cpu_devices())
+
+
+def num_gpus() -> int:
+    """Reference: mx.context.num_gpus — here the accelerator count."""
+    return len(_accel_devices())
+
+
+def current_context() -> Context:
+    if not hasattr(Context._default_ctx, "value"):
+        Context._default_ctx.value = Context("cpu", 0)
+    return Context._default_ctx.value
+
+
+current_device = current_context

@@ -1,0 +1,97 @@
+"""Execution-engine semantics over XLA async dispatch.
+
+Reference: src/engine/threaded_engine.cc (ThreadedEngine), naive_engine.cc
+(NaiveEngine), include/mxnet/engine.h.
+
+The reference schedules every op as a DAG node over read/write variable
+dependencies and runs them on per-device worker threads.  On TPU, PJRT already
+gives us exactly those semantics: op dispatch is async, data dependencies are
+tracked by buffer definition events, and results only block at explicit sync
+points.  What remains of the "engine" is therefore:
+
+  * the sync surface — `wait_to_read` (= block_until_ready), `WaitForAll`;
+  * a strict-sync debug mode (`MXNET_ENGINE_TYPE=NaiveEngine`) that blocks
+    after every op, used to bisect async-scheduling bugs (SURVEY.md §5.2);
+  * deferred-exception propagation: XLA raises device errors at sync points,
+    matching the reference's capture-on-worker / rethrow-at-sync contract;
+  * bulking (`Engine::StartBulk`): subsumed by XLA fusion — kept as no-ops.
+"""
+from __future__ import annotations
+
+import jax
+
+from .base import get_env
+
+__all__ = ["Engine", "engine", "is_naive", "wait_all", "set_bulk_size"]
+
+
+class Engine:
+    """Process-wide engine facade (singleton, like Engine::Get())."""
+
+    def __init__(self):
+        import weakref
+        # live NDArray chunks, registered at creation/write; WaitForAll
+        # blocks on each — the reference's "wait for all vars" semantics
+        self._live = weakref.WeakSet()
+
+    def track(self, chunk) -> None:
+        self._live.add(chunk)
+
+    # -- engine type -------------------------------------------------------
+    @property
+    def kind(self) -> str:
+        return get_env("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
+
+    def is_naive(self) -> bool:
+        # read each call: tests toggle via environment(); cost is a dict get
+        return self.kind in ("NaiveEngine", "naive")
+
+    # -- sync points -------------------------------------------------------
+    def wait_for_var(self, value) -> None:
+        """Block until `value` (a jax.Array) is computed (≈ WaitForVar)."""
+        if value is not None and hasattr(value, "block_until_ready"):
+            value.block_until_ready()
+
+    def wait_for_all(self) -> None:
+        """Reference: MXNDArrayWaitAll — block until every live array's
+        pending computation (and any effects) completed; surfaces deferred
+        device errors here, matching the reference's sync-point contract."""
+        try:
+            jax.effects_barrier()
+        except Exception:
+            pass
+        for chunk in list(self._live):
+            data = getattr(chunk, "data", None)
+            if data is not None and hasattr(data, "block_until_ready"):
+                data.block_until_ready()
+
+    def maybe_sync(self, value):
+        """Called by the dispatch layer after every eager op."""
+        if self.is_naive():
+            self.wait_for_var(value)
+        return value
+
+    # -- bulking (no-op on TPU; XLA fuses) ---------------------------------
+    def set_bulk_size(self, size: int) -> int:
+        return 0
+
+    def start_bulk(self):
+        return None
+
+    def stop_bulk(self):
+        return None
+
+
+engine = Engine()
+
+
+def is_naive() -> bool:
+    return engine.is_naive()
+
+
+def wait_all() -> None:
+    engine.wait_for_all()
+
+
+def set_bulk_size(size: int) -> int:
+    return engine.set_bulk_size(size)

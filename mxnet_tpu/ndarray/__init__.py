@@ -1,0 +1,67 @@
+"""`mx.nd` namespace: NDArray + one generated function per registered op.
+
+Reference: python/mxnet/ndarray/register.py (_make_ndarray_function) builds
+these wrappers at import from the C registry; we do the same from the Python
+registry (SURVEY.md §3.1).
+"""
+from __future__ import annotations
+
+import sys
+from types import ModuleType
+
+from ..ops import registry as _registry
+from .ndarray import (NDArray, invoke, array, zeros, ones, full, empty, arange,
+                      zeros_like, ones_like, concatenate, save, load,
+                      save_bytes, load_bytes, waitall, from_jax)
+from .ndarray import stack_arrays as _stack_arrays
+
+__all__ = ["NDArray", "invoke", "array", "zeros", "ones", "full", "empty",
+           "arange", "zeros_like", "ones_like", "concatenate", "save", "load",
+           "waitall"]
+
+
+def _make_op_func(opname: str):
+    op = _registry.get_op(opname)
+
+    def fn(*args, out=None, **kwargs):
+        return invoke(opname, *args, out=out, **kwargs)
+
+    fn.__name__ = opname
+    fn.__doc__ = op.doc
+    return fn
+
+
+_this = sys.modules[__name__]
+for _name in _registry.list_ops():
+    if not hasattr(_this, _name) and _name.isidentifier():
+        setattr(_this, _name, _make_op_func(_name))
+
+def stack(*data, axis=0, **kw):
+    """MXNet varargs form: nd.stack(a, b, axis=0); also accepts a list."""
+    if len(data) == 1 and isinstance(data[0], (list, tuple)):
+        data = tuple(data[0])
+    return _stack_arrays(data, axis=axis)
+
+
+def concat(*data, dim=1, axis=None, **kw):
+    if len(data) == 1 and isinstance(data[0], (list, tuple)):
+        data = tuple(data[0])
+    return invoke("concat", *data, dim=dim if axis is None else axis)
+
+
+Concat = concat
+
+
+# `mx.nd.random` submodule (reference: python/mxnet/ndarray/random.py)
+random = ModuleType(__name__ + ".random")
+random.uniform = _make_op_func("_random_uniform")
+random.normal = _make_op_func("_random_normal")
+random.randn = lambda *shape, **kw: random.normal(shape=shape, **kw)
+random.gamma = _make_op_func("_random_gamma")
+random.exponential = _make_op_func("_random_exponential")
+random.poisson = _make_op_func("_random_poisson")
+random.randint = _make_op_func("_random_randint")
+random.bernoulli = _make_op_func("_random_bernoulli")
+random.multinomial = _make_op_func("_sample_multinomial")
+random.shuffle = _make_op_func("shuffle")
+sys.modules[random.__name__] = random

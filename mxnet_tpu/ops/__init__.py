@@ -1,0 +1,13 @@
+"""Operator registry + implementations.
+
+Importing this package registers all ops (the role of C++ static-init
+registration at dlopen in the reference — SURVEY.md §3.1).
+"""
+from . import registry
+from .registry import register, get_op, list_ops, cached_jit, OpDef
+
+from . import elemwise    # noqa: F401
+from . import reduce      # noqa: F401
+from . import matrix      # noqa: F401
+from . import nn          # noqa: F401
+from . import random      # noqa: F401

@@ -1,0 +1,168 @@
+"""Elementwise + broadcast binary/unary ops.
+
+Reference: src/operator/tensor/elemwise_binary_broadcast_op_basic.cc
+(broadcast_add ...), elemwise_unary_op_basic.cc, src/operator/mxnet_op.h.
+On TPU these all lower to single fused XLA HLO ops — no kernels to write;
+the op registry entry IS the implementation (SURVEY.md §2.1 "Dense op
+kernels" row).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+# ---------------------------------------------------------------------------
+# broadcast binary — MXNet exposes elemwise_* (same-shape) and broadcast_*
+# (numpy broadcasting); XLA doesn't care, so both alias one impl.
+# ---------------------------------------------------------------------------
+
+_BINARY = {
+    "broadcast_add": jnp.add,
+    "broadcast_sub": jnp.subtract,
+    "broadcast_mul": jnp.multiply,
+    "broadcast_div": jnp.divide,
+    "broadcast_mod": jnp.mod,
+    "broadcast_power": jnp.power,
+    "broadcast_maximum": jnp.maximum,
+    "broadcast_minimum": jnp.minimum,
+    "broadcast_hypot": jnp.hypot,
+    "arctan2": jnp.arctan2,
+}
+
+_BINARY_ALIASES = {
+    "broadcast_add": ["elemwise_add", "_plus", "_add"],
+    "broadcast_sub": ["elemwise_sub", "_minus", "_sub"],
+    "broadcast_mul": ["elemwise_mul", "_mul"],
+    "broadcast_div": ["elemwise_div", "_div"],
+    "broadcast_mod": ["_mod"],
+    "broadcast_power": ["_power", "pow"],
+    "broadcast_maximum": ["_maximum", "maximum"],
+    "broadcast_minimum": ["_minimum", "minimum"],
+}
+
+for _name, _fn in _BINARY.items():
+    register(_name, _fn, aliases=_BINARY_ALIASES.get(_name, ()))
+
+_COMPARE = {
+    "broadcast_equal": jnp.equal,
+    "broadcast_not_equal": jnp.not_equal,
+    "broadcast_greater": jnp.greater,
+    "broadcast_greater_equal": jnp.greater_equal,
+    "broadcast_lesser": jnp.less,
+    "broadcast_lesser_equal": jnp.less_equal,
+    "broadcast_logical_and": jnp.logical_and,
+    "broadcast_logical_or": jnp.logical_or,
+    "broadcast_logical_xor": jnp.logical_xor,
+}
+
+for _name, _fn in _COMPARE.items():
+    # MXNet comparison ops return float (1.0/0.0), not bool
+    def _mk(f):
+        def cmp(a, b):
+            res = f(a, b)
+            want = a.dtype if jnp.issubdtype(a.dtype, jnp.floating) else jnp.float32
+            return res.astype(want)
+        return cmp
+    register(_name, _mk(_fn), differentiable=False,
+             aliases=[_name.replace("broadcast_", "")] if _name.startswith("broadcast_") else ())
+
+# ---------------------------------------------------------------------------
+# unary
+# ---------------------------------------------------------------------------
+
+_UNARY = {
+    "negative": jnp.negative,
+    "abs": jnp.abs,
+    "sign": jnp.sign,
+    "floor": jnp.floor,
+    "ceil": jnp.ceil,
+    "round": jnp.round,
+    "rint": jnp.rint,
+    "trunc": jnp.trunc,
+    "fix": jnp.trunc,
+    "exp": jnp.exp,
+    "expm1": jnp.expm1,
+    "log": jnp.log,
+    "log10": jnp.log10,
+    "log2": jnp.log2,
+    "log1p": jnp.log1p,
+    "sqrt": jnp.sqrt,
+    "cbrt": jnp.cbrt,
+    "square": jnp.square,
+    "reciprocal": jnp.reciprocal,
+    "rsqrt": lax.rsqrt,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "arcsin": jnp.arcsin,
+    "arccos": jnp.arccos,
+    "arctan": jnp.arctan,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh,
+    "arccosh": jnp.arccosh,
+    "arctanh": jnp.arctanh,
+    "erf": lax.erf,
+    "erfinv": lax.erf_inv,
+    "gamma": lambda x: jnp.exp(lax.lgamma(x)),
+    "gammaln": lax.lgamma,
+    "digamma": lax.digamma,
+    "sigmoid": jax.nn.sigmoid,
+    "softsign": lambda x: x / (1.0 + jnp.abs(x)),
+    "relu": jax.nn.relu,   # custom grad: 0 at x==0, matching the reference
+    "logical_not": lambda x: jnp.logical_not(x.astype(bool)).astype(x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.float32),
+    "identity": lambda x: x,
+}
+
+_UNARY_NONDIFF = {"sign", "floor", "ceil", "round", "rint", "trunc", "fix",
+                  "logical_not"}
+
+for _name, _fn in _UNARY.items():
+    register(_name, _fn, differentiable=_name not in _UNARY_NONDIFF,
+             aliases=["_copy"] if _name == "identity" else ())
+
+
+@register("clip")
+def _clip(x, a_min=None, a_max=None):
+    return jnp.clip(x, a_min, a_max)
+
+
+@register("isnan", differentiable=False)
+def _isnan(x):
+    return jnp.isnan(x).astype(jnp.float32)
+
+
+@register("isinf", differentiable=False)
+def _isinf(x):
+    return jnp.isinf(x).astype(jnp.float32)
+
+
+@register("isfinite", differentiable=False)
+def _isfinite(x):
+    return jnp.isfinite(x).astype(jnp.float32)
+
+
+@register("cast")
+def _cast(x, dtype="float32"):
+    d = jnp.bfloat16 if dtype == "bfloat16" else dtype
+    return x.astype(d)
+
+
+register("Cast", _cast)
+register("amp_cast", _cast)
+
+
+@register("where")
+def _where(cond, a, b):
+    return jnp.where(cond.astype(bool), a, b)
+
+
+@register("smooth_l1")
+def _smooth_l1(x, scalar=1.0):
+    s2 = scalar * scalar
+    absx = jnp.abs(x)
+    return jnp.where(absx < 1.0 / s2, 0.5 * s2 * x * x, absx - 0.5 / s2)

@@ -1,0 +1,364 @@
+"""Shape manipulation, linear algebra, indexing ops.
+
+Reference: src/operator/tensor/matrix_op.cc (transpose/reshape/slice/concat/
+stack/tile/repeat/clip/dot/batch_dot), indexing_op.cc (take/gather_nd/
+scatter_nd/one_hot/Embedding), diag_op.cc, la_op.cc (linalg_*).
+
+dot/batch_dot lower to `lax.dot_general` — the MXU path.  All shape ops are
+free at XLA level (layout changes fused away).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+# ---------------------------------------------------------------------------
+# matmul family (MXU)
+# ---------------------------------------------------------------------------
+
+
+@register("dot")
+def _dot(a, b, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    # MXNet dot: contract last axis of a with first axis of b
+    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+
+
+@register("batch_dot")
+def _batch_dot(a, b, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+@register("linalg_gemm2")
+def _linalg_gemm2(a, b, transpose_a=False, transpose_b=False, alpha=1.0):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return alpha * jnp.matmul(a, b)
+
+
+@register("linalg_syrk")
+def _linalg_syrk(a, transpose=False, alpha=1.0):
+    at = jnp.swapaxes(a, -1, -2)
+    return alpha * (jnp.matmul(at, a) if transpose else jnp.matmul(a, at))
+
+
+@register("linalg_potrf")
+def _linalg_potrf(a):
+    return jnp.linalg.cholesky(a)
+
+
+@register("linalg_trsm")
+def _linalg_trsm(a, b, transpose=False, rightside=False, lower=True, alpha=1.0):
+    if transpose:
+        a = jnp.swapaxes(a, -1, -2)
+        lower = not lower
+    sol = jax.scipy.linalg.solve_triangular(
+        a, alpha * b if not rightside else jnp.swapaxes(alpha * b, -1, -2),
+        lower=lower)
+    return sol if not rightside else jnp.swapaxes(sol, -1, -2)
+
+
+# ---------------------------------------------------------------------------
+# shape ops
+# ---------------------------------------------------------------------------
+
+
+@register("transpose")
+def _transpose(x, axes=None):
+    return jnp.transpose(x, axes=axes)
+
+
+@register("swapaxes", aliases=["SwapAxis"])
+def _swapaxes(x, dim1=0, dim2=0):
+    return jnp.swapaxes(x, dim1, dim2)
+
+
+@register("reshape", aliases=["Reshape"])
+def _reshape(x, shape=None, reverse=False):
+    # op-form reshape (copy semantics under trace); view reshape is the
+    # NDArray method.  Supports MXNet's 0 (=keep) / -1 (=infer) codes.
+    out = []
+    for i, d in enumerate(shape):
+        out.append(x.shape[i] if d == 0 else int(d))
+    return jnp.reshape(x, tuple(out))
+
+
+@register("flatten", aliases=["Flatten"])
+def _flatten(x):
+    return jnp.reshape(x, (x.shape[0], -1)) if x.ndim > 1 else x
+
+
+@register("expand_dims")
+def _expand_dims(x, axis=0):
+    return jnp.expand_dims(x, axis)
+
+
+@register("squeeze")
+def _squeeze(x, axis=None):
+    return jnp.squeeze(x, axis=axis)
+
+
+@register("broadcast_to")
+def _broadcast_to(x, shape=None):
+    tgt = tuple(x.shape[i] if s == 0 else s for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, tgt)
+
+
+@register("broadcast_axis", aliases=["broadcast_axes"])
+def _broadcast_axis(x, axis=None, size=None):
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    sizes = (size,) if isinstance(size, int) else tuple(size)
+    tgt = list(x.shape)
+    for a, s in zip(axes, sizes):
+        tgt[a] = s
+    return jnp.broadcast_to(x, tuple(tgt))
+
+
+@register("concat", aliases=["Concat"])
+def _concat(*xs, dim=1, num_args=None):
+    return jnp.concatenate(xs, axis=dim)
+
+
+@register("stack")
+def _stack(*xs, axis=0, num_args=None):
+    return jnp.stack(xs, axis=axis)
+
+
+@register("split", aliases=["SliceChannel"], num_outputs=0)
+def _split(x, num_outputs=2, axis=1, squeeze_axis=False):
+    parts = jnp.split(x, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+@register("split_v2", num_outputs=0)
+def _split_v2(x, indices=(), axis=0, squeeze_axis=False, sections=0):
+    if sections:
+        parts = jnp.split(x, sections, axis=axis)
+    else:
+        parts = jnp.split(x, list(indices), axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+@register("slice", aliases=["crop"])
+def _slice(x, begin=(), end=(), step=()):
+    idx = []
+    step = step or (None,) * len(begin)
+    for b, e, s in zip(begin, end, step):
+        idx.append(slice(b, e, s))
+    return x[tuple(idx)]
+
+
+@register("slice_axis")
+def _slice_axis(x, axis=0, begin=0, end=None):
+    idx = [slice(None)] * x.ndim
+    idx[axis] = slice(begin, end)
+    return x[tuple(idx)]
+
+
+@register("slice_like")
+def _slice_like(x, like, axes=()):
+    axes = axes or tuple(range(min(x.ndim, like.ndim)))
+    idx = [slice(None)] * x.ndim
+    for a in axes:
+        idx[a] = slice(0, like.shape[a])
+    return x[tuple(idx)]
+
+
+@register("tile")
+def _tile(x, reps=()):
+    return jnp.tile(x, reps)
+
+
+@register("repeat")
+def _repeat(x, repeats=1, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@register("pad", aliases=["Pad"])
+def _pad(x, mode="constant", pad_width=(), constant_value=0.0):
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(len(pad_width) // 2)]
+    if mode == "constant":
+        return jnp.pad(x, pw, mode="constant", constant_values=constant_value)
+    if mode == "edge":
+        return jnp.pad(x, pw, mode="edge")
+    if mode == "reflect":
+        return jnp.pad(x, pw, mode="reflect")
+    raise ValueError("bad pad mode %r" % mode)
+
+
+@register("flip", aliases=["reverse"])
+def _flip(x, axis=0):
+    return jnp.flip(x, axis=axis)
+
+
+@register("diag")
+def _diag(x, k=0):
+    if x.ndim == 1:
+        return jnp.diag(x, k=k)
+    return jnp.diagonal(x, offset=k, axis1=-2, axis2=-1)
+
+
+@register("zeros_like_op", aliases=["zeros_like"])
+def _zeros_like(x):
+    return jnp.zeros_like(x)
+
+
+@register("ones_like_op", aliases=["ones_like"])
+def _ones_like(x):
+    return jnp.ones_like(x)
+
+
+@register("space_to_depth")
+def _space_to_depth(x, block_size=2):
+    n, c, h, w = x.shape
+    bs = block_size
+    y = x.reshape(n, c, h // bs, bs, w // bs, bs)
+    y = y.transpose(0, 3, 5, 1, 2, 4)
+    return y.reshape(n, c * bs * bs, h // bs, w // bs)
+
+
+@register("depth_to_space")
+def _depth_to_space(x, block_size=2):
+    n, c, h, w = x.shape
+    bs = block_size
+    y = x.reshape(n, bs, bs, c // (bs * bs), h, w)
+    y = y.transpose(0, 3, 4, 1, 5, 2)
+    return y.reshape(n, c // (bs * bs), h * bs, w * bs)
+
+
+# ---------------------------------------------------------------------------
+# indexing / gather / scatter
+# ---------------------------------------------------------------------------
+
+
+@register("take")
+def _take(x, indices, axis=0, mode="clip"):
+    idx = indices.astype(jnp.int32)
+    if mode == "wrap":
+        idx = jnp.mod(idx, x.shape[axis])
+    else:
+        idx = jnp.clip(idx, 0, x.shape[axis] - 1)
+    return jnp.take(x, idx, axis=axis)
+
+
+@register("pick")
+def _pick(x, index, axis=-1, keepdims=False, mode="clip"):
+    idx = jnp.clip(index.astype(jnp.int32), 0, x.shape[axis] - 1)
+    out = jnp.take_along_axis(x, jnp.expand_dims(idx, axis % x.ndim), axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis)
+    return out
+
+
+@register("gather_nd")
+def _gather_nd(x, indices):
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    return x[tuple(idx[i] for i in range(m))]
+
+
+@register("scatter_nd")
+def _scatter_nd(data, indices, shape=None):
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    out = jnp.zeros(shape, data.dtype)
+    return out.at[tuple(idx[i] for i in range(m))].set(data)
+
+
+@register("Embedding", aliases=["embedding"])
+def _embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
+               sparse_grad=False):
+    # grads flow to `weight` as scatter-add via the gather VJP — the TPU
+    # realization of the rowsparse-gradient path (SURVEY.md "Sparse kernels")
+    idx = data.astype(jnp.int32)
+    return jnp.take(weight, idx, axis=0)
+
+
+@register("one_hot", differentiable=False)
+def _one_hot(indices, depth=None, on_value=1.0, off_value=0.0, dtype="float32"):
+    d = jnp.bfloat16 if dtype == "bfloat16" else dtype
+    hot = jax.nn.one_hot(indices.astype(jnp.int32), depth)
+    return (hot * (on_value - off_value) + off_value).astype(d)
+
+
+@register("where_op")
+def _where_op(cond, a, b):
+    return jnp.where(cond.astype(bool), a, b)
+
+
+@register("boolean_mask", differentiable=False)
+def _boolean_mask(data, index, axis=0):
+    # dynamic output shape: materialize via host round-trip is illegal under
+    # jit; MXNet semantics preserved eagerly only.
+    return jnp.compress(index.astype(bool), data, axis=axis)
+
+
+@register("sequence_mask", aliases=["SequenceMask"])
+def _sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                   value=0.0, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return data
+    seq_axis = axis
+    steps = jnp.arange(data.shape[seq_axis])
+    bshape = [1] * data.ndim
+    bshape[seq_axis] = data.shape[seq_axis]
+    steps = steps.reshape(bshape)
+    batch_axis = 1 - seq_axis if data.ndim > 1 else 0
+    lshape = [1] * data.ndim
+    lshape[batch_axis] = data.shape[batch_axis]
+    lens = sequence_length.reshape(lshape)
+    return jnp.where(steps < lens, data, jnp.asarray(value, data.dtype))
+
+
+@register("SequenceLast")
+def _sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        idx = [slice(None)] * data.ndim
+        idx[axis] = -1
+        return data[tuple(idx)]
+    last = (sequence_length.astype(jnp.int32) - 1)
+    moved = jnp.moveaxis(data, axis, 0)
+    return moved[last, jnp.arange(moved.shape[1])]
+
+
+@register("SequenceReverse")
+def _sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=axis)
+    moved = jnp.moveaxis(data, axis, 0)  # (T, B, ...)
+    T = moved.shape[0]
+    steps = jnp.arange(T)[:, None]
+    lens = sequence_length.astype(jnp.int32)[None, :]
+    src = jnp.where(steps < lens, lens - 1 - steps, steps)
+    out = jnp.take_along_axis(
+        moved, src.reshape(src.shape + (1,) * (moved.ndim - 2)).astype(jnp.int32),
+        axis=0)
+    return jnp.moveaxis(out, 0, axis)
+
+
+@register("shape_array", differentiable=False)
+def _shape_array(x):
+    return jnp.asarray(x.shape, dtype=jnp.int64)
+
+
+@register("size_array", differentiable=False)
+def _size_array(x):
+    return jnp.asarray([x.size], dtype=jnp.int64)

@@ -1,0 +1,400 @@
+"""Neural-network ops.
+
+Reference: src/operator/nn/ — convolution.cc (ConvolutionParam),
+fully_connected.cc, pooling.cc, batch_norm.cc, layer_norm.cc, dropout-inl.h,
+softmax.cc, activation.cc, leaky_relu.cc; cuDNN paths in
+src/operator/nn/cudnn/.
+
+TPU-native: conv → `lax.conv_general_dilated` (MXU-tiled by XLA, replacing
+cuDNN algo selection); pooling → `lax.reduce_window`; norms/softmax →
+jnp compositions that XLA fuses into the surrounding matmuls.  MXNet layout
+convention (NCHW / NCW / NCDHW) is preserved at the API; XLA relayouts
+internally for the MXU so no NHWC surface change is needed.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _tup(v, n):
+    if v is None:
+        return (0,) * n
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(v)
+    return v if len(v) == n else v + v[-1:] * (n - len(v))
+
+
+# ---------------------------------------------------------------------------
+# FullyConnected
+# ---------------------------------------------------------------------------
+
+
+@register("FullyConnected", aliases=["fully_connected"])
+def _fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False,
+                     flatten=True):
+    x = data.reshape(data.shape[0], -1) if flatten and data.ndim > 2 else data
+    # weight layout: (num_hidden, in_units) — reference keeps cuBLAS row-major
+    out = jnp.matmul(x, weight.T)
+    if bias is not None and not no_bias:
+        out = out + bias
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Convolution — MXNet NCHW layout; kernel layout OIHW
+# ---------------------------------------------------------------------------
+
+_CONV_DIMS = {1: ("NCH", "OIH", "NCH"), 2: ("NCHW", "OIHW", "NCHW"),
+              3: ("NCDHW", "OIDHW", "NCDHW")}
+
+
+@register("Convolution", aliases=["convolution"])
+def _convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
+                 pad=None, num_filter=None, num_group=1, no_bias=False,
+                 cudnn_tune=None, cudnn_off=False, workspace=1024, layout=None):
+    n = len(kernel)
+    stride = _tup(stride or 1, n)
+    dilate = _tup(dilate or 1, n)
+    pad = _tup(pad, n)
+    spatial = "DHW"[-n:] if n != 2 else "HW"
+    lhs_spec = "NC" + spatial
+    rhs_spec = "OI" + spatial
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                    (lhs_spec, rhs_spec, lhs_spec))
+    out = lax.conv_general_dilated(
+        data, weight, window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None)
+    out = out.astype(data.dtype)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape((1, -1) + (1,) * n)
+    return out
+
+
+@register("Deconvolution", aliases=["deconvolution"])
+def _deconvolution(data, weight, bias=None, kernel=None, stride=None,
+                   dilate=None, pad=None, adj=None, num_filter=None,
+                   num_group=1, no_bias=True, target_shape=None,
+                   cudnn_tune=None, cudnn_off=False, workspace=1024, layout=None):
+    n = len(kernel)
+    stride = _tup(stride or 1, n)
+    dilate = _tup(dilate or 1, n)
+    pad = _tup(pad, n)
+    adj = _tup(adj or 0, n)
+    spatial = "DHW"[-n:] if n != 2 else "HW"
+    lhs_spec = "NC" + spatial
+    # weight layout for Deconvolution is (in, out/g, *kernel) = IOHW
+    rhs_spec = "IO" + spatial
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                    (lhs_spec, rhs_spec, lhs_spec))
+    # transposed conv: pad by effective-kernel-1 minus user pad
+    eff = [(k - 1) * d + 1 for k, d in zip(kernel, dilate)]
+    pads = [(e - 1 - p, e - 1 - p + a) for e, p, a in zip(eff, pad, adj)]
+    out = lax.conv_general_dilated(
+        data, weight, window_strides=(1,) * n, padding=pads,
+        lhs_dilation=stride, rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=num_group)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape((1, -1) + (1,) * n)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pooling
+# ---------------------------------------------------------------------------
+
+
+@register("Pooling", aliases=["pooling"])
+def _pooling(data, kernel=None, pool_type="max", global_pool=False,
+             stride=None, pad=None, pooling_convention="valid",
+             count_include_pad=True, cudnn_off=False, layout=None, p_value=2):
+    n = data.ndim - 2
+    if global_pool:
+        axes = tuple(range(2, data.ndim))
+        if pool_type == "max":
+            return jnp.max(data, axis=axes, keepdims=True)
+        return jnp.mean(data, axis=axes, keepdims=True)
+    kernel = _tup(kernel, n)
+    stride = _tup(stride or kernel, n)
+    pad = _tup(pad, n)
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    if pooling_convention == "full":
+        # ceil-mode: pad right enough that ceil division is covered
+        pads = [(0, 0), (0, 0)]
+        for i in range(n):
+            in_sz = data.shape[2 + i]
+            out_sz = -(-(in_sz + 2 * pad[i] - kernel[i]) // stride[i]) + 1
+            needed = (out_sz - 1) * stride[i] + kernel[i] - in_sz
+            pads.append((pad[i], max(needed - pad[i], pad[i])))
+    else:
+        pads = [(0, 0), (0, 0)] + [(p, p) for p in pad]
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, init, lax.max, window, strides, pads)
+    if pool_type in ("avg", "sum"):
+        summed = lax.reduce_window(data, 0.0, lax.add, window, strides, pads)
+        if pool_type == "sum":
+            return summed
+        if count_include_pad:
+            denom = 1
+            for k in kernel:
+                denom *= k
+            return summed / denom
+        ones = jnp.ones_like(data)
+        counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
+        return summed / counts
+    if pool_type == "lp":
+        powed = lax.reduce_window(jnp.abs(data) ** p_value, 0.0, lax.add,
+                                  window, strides, pads)
+        return powed ** (1.0 / p_value)
+    raise ValueError("bad pool_type %r" % pool_type)
+
+
+@register("AdaptiveAvgPooling2D", aliases=["_contrib_AdaptiveAvgPooling2D"])
+def _adaptive_avg_pool(data, output_size=1):
+    os = _tup(output_size, 2)
+    n, c, h, w = data.shape
+    # reduce via mean over equal bins (exact when divisible; BASELINE nets are)
+    x = data.reshape(n, c, os[0], h // os[0], os[1], w // os[1])
+    return jnp.mean(x, axis=(3, 5))
+
+
+@register("BilinearResize2D", aliases=["_contrib_BilinearResize2D"])
+def _bilinear_resize(data, height=None, width=None, scale_height=None,
+                     scale_width=None, mode="size"):
+    n, c, h, w = data.shape
+    th = height or int(h * scale_height)
+    tw = width or int(w * scale_width)
+    return jax.image.resize(data, (n, c, th, tw), method="linear")
+
+
+@register("UpSampling")
+def _upsampling(data, scale=2, sample_type="nearest", num_args=1):
+    n, c, h, w = data.shape
+    if sample_type == "nearest":
+        return jnp.repeat(jnp.repeat(data, scale, axis=2), scale, axis=3)
+    return jax.image.resize(data, (n, c, h * scale, w * scale), method="linear")
+
+
+# ---------------------------------------------------------------------------
+# Activations
+# ---------------------------------------------------------------------------
+
+
+@register("Activation", aliases=["activation"])
+def _activation(data, act_type="relu"):
+    if act_type == "relu":
+        return jax.nn.relu(data)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(data)
+    if act_type == "tanh":
+        return jnp.tanh(data)
+    if act_type == "softrelu":
+        return jax.nn.softplus(data)
+    if act_type == "softsign":
+        return jax.nn.soft_sign(data)
+    if act_type == "log_sigmoid":
+        return jax.nn.log_sigmoid(data)
+    if act_type == "mish":
+        return data * jnp.tanh(jax.nn.softplus(data))
+    raise ValueError("bad act_type %r" % act_type)
+
+
+@register("LeakyReLU")
+def _leaky_relu(data, gamma=None, act_type="leaky", slope=0.25,
+                lower_bound=0.125, upper_bound=0.334):
+    if act_type == "leaky":
+        return jnp.where(data >= 0, data, slope * data)
+    if act_type == "prelu":
+        g = gamma.reshape((1, -1) + (1,) * (data.ndim - 2)) if data.ndim > 2 else gamma
+        return jnp.where(data >= 0, data, g * data)
+    if act_type == "elu":
+        return jnp.where(data >= 0, data, slope * (jnp.exp(data) - 1.0))
+    if act_type == "selu":
+        alpha, lam = 1.6732632423543772, 1.0507009873554805
+        return lam * jnp.where(data >= 0, data, alpha * (jnp.exp(data) - 1.0))
+    if act_type == "gelu":
+        return jax.nn.gelu(data, approximate=False)
+    if act_type == "gelu_tanh":
+        return jax.nn.gelu(data, approximate=True)
+    if act_type == "rrelu":  # eval-mode deterministic
+        return jnp.where(data >= 0, data, data * (lower_bound + upper_bound) / 2)
+    raise ValueError("bad act_type %r" % act_type)
+
+
+@register("softmax", aliases=["Softmax"])
+def _softmax(data, axis=-1, temperature=None, length=None, use_length=False,
+             dtype=None):
+    x = data if temperature in (None, 1.0) else data / temperature
+    if use_length and length is not None:
+        steps = jnp.arange(x.shape[axis])
+        bshape = [1] * x.ndim
+        bshape[axis] = x.shape[axis]
+        mask = steps.reshape(bshape) < length.reshape(
+            [x.shape[0]] + [1] * (x.ndim - 1))
+        x = jnp.where(mask, x, -jnp.inf)
+    out = jax.nn.softmax(x, axis=axis)
+    if use_length and length is not None:
+        out = jnp.where(jnp.isnan(out), 0.0, out)
+    return out.astype(dtype or data.dtype)
+
+
+@register("log_softmax")
+def _log_softmax(data, axis=-1, temperature=None, dtype=None):
+    x = data if temperature in (None, 1.0) else data / temperature
+    return jax.nn.log_softmax(x, axis=axis).astype(dtype or data.dtype)
+
+
+@register("softmin")
+def _softmin(data, axis=-1, temperature=None, dtype=None):
+    return _softmax(-data, axis=axis, temperature=temperature, dtype=dtype)
+
+
+@register("softmax_cross_entropy")
+def _softmax_cross_entropy(data, label):
+    logp = jax.nn.log_softmax(data, axis=-1)
+    onehot = jax.nn.one_hot(label.astype(jnp.int32), data.shape[-1],
+                            dtype=logp.dtype)
+    return -jnp.sum(logp * onehot)
+
+
+@register("SoftmaxOutput", aliases=["softmax_output"])
+def _softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
+                    multi_output=False, use_ignore=False, preserve_shape=False,
+                    normalization="null", out_grad=False, smooth_alpha=0.0):
+    # forward is plain softmax; the custom grad (p - onehot) comes out of the
+    # VJP of cross-entropy at the Gluon/Module loss level.
+    return jax.nn.softmax(data, axis=1 if multi_output else -1)
+
+
+# ---------------------------------------------------------------------------
+# Normalization
+# ---------------------------------------------------------------------------
+
+
+@register("BatchNorm", aliases=["batch_norm"], num_outputs=3,
+          aux_writeback={1: 3, 2: 4})
+def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
+                momentum=0.9, fix_gamma=True, use_global_stats=False,
+                output_mean_var=False, axis=1, cudnn_off=False,
+                min_calib_range=None, max_calib_range=None, training=True):
+    if output_mean_var:
+        raise NotImplementedError("BatchNorm(output_mean_var=True)")
+    ax = axis % data.ndim
+    red = tuple(i for i in range(data.ndim) if i != ax)
+    bshape = [1] * data.ndim
+    bshape[ax] = data.shape[ax]
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    if training and not use_global_stats:
+        x32 = data.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=red)
+        var = jnp.var(x32, axis=red)
+        new_mean = momentum * moving_mean + (1.0 - momentum) * mean
+        new_var = momentum * moving_var + (1.0 - momentum) * var
+    else:
+        mean, var = moving_mean, moving_var
+        new_mean, new_var = moving_mean, moving_var
+    inv = lax.rsqrt(var + eps).astype(data.dtype)
+    out = (data - mean.reshape(bshape).astype(data.dtype)) * \
+        (inv * g.astype(data.dtype)).reshape(bshape) + \
+        beta.astype(data.dtype).reshape(bshape)
+    return out, new_mean, new_var
+
+
+@register("LayerNorm", aliases=["layer_norm"])
+def _layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
+    x32 = data.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=axis, keepdims=True)
+    var = jnp.var(x32, axis=axis, keepdims=True)
+    inv = lax.rsqrt(var + eps)
+    norm = ((x32 - mean) * inv).astype(data.dtype)
+    ax = axis % data.ndim
+    bshape = [1] * data.ndim
+    bshape[ax] = data.shape[ax]
+    return norm * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("GroupNorm")
+def _group_norm(data, gamma, beta, num_groups=1, eps=1e-5):
+    n, c = data.shape[:2]
+    rest = data.shape[2:]
+    x = data.reshape(n, num_groups, c // num_groups, *rest).astype(jnp.float32)
+    red = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=red, keepdims=True)
+    var = jnp.var(x, axis=red, keepdims=True)
+    norm = ((x - mean) * lax.rsqrt(var + eps)).reshape(data.shape).astype(data.dtype)
+    bshape = (1, c) + (1,) * len(rest)
+    return norm * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("InstanceNorm")
+def _instance_norm(data, gamma, beta, eps=1e-3):
+    red = tuple(range(2, data.ndim))
+    x32 = data.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=red, keepdims=True)
+    var = jnp.var(x32, axis=red, keepdims=True)
+    norm = ((x32 - mean) * lax.rsqrt(var + eps)).astype(data.dtype)
+    bshape = (1, data.shape[1]) + (1,) * (data.ndim - 2)
+    return norm * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("RMSNorm")
+def _rms_norm(data, gamma, axis=-1, eps=1e-6):
+    x32 = data.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(x32), axis=axis, keepdims=True)
+    return (x32 * lax.rsqrt(ms + eps)).astype(data.dtype) * gamma
+
+
+# ---------------------------------------------------------------------------
+# Dropout — takes an RNG key array as first input (plumbed by nd wrapper)
+# ---------------------------------------------------------------------------
+
+
+@register("Dropout", aliases=["dropout"], needs_rng=True)
+def _dropout(key, data, p=0.5, mode="training", axes=(), cudnn_off=False,
+             training=True):
+    if not training or p <= 0.0:
+        return data
+    shape = list(data.shape)
+    for a in axes:
+        shape[a] = 1
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, tuple(shape)).astype(data.dtype)
+    return data * mask / keep
+
+
+# ---------------------------------------------------------------------------
+# Attention (reference: src/operator/contrib/transformer.cc interleaved
+# self-attention ops).  Composition form; the Pallas flash path plugs in at
+# mxnet_tpu/parallel/attention.py for long sequences.
+# ---------------------------------------------------------------------------
+
+
+@register("multi_head_attention")
+def _mha(q, k, v, num_heads=1, scaled=True, mask=None, causal=False):
+    # q,k,v: (B, T, H*D)
+    B, Tq, HD = q.shape
+    D = HD // num_heads
+    qh = q.reshape(B, Tq, num_heads, D).transpose(0, 2, 1, 3)
+    kh = k.reshape(B, -1, num_heads, D).transpose(0, 2, 1, 3)
+    vh = v.reshape(B, -1, num_heads, D).transpose(0, 2, 1, 3)
+    scale = (1.0 / jnp.sqrt(D)) if scaled else 1.0
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        Tk = kh.shape[2]
+        cm = jnp.tril(jnp.ones((Tq, Tk), bool))
+        logits = jnp.where(cm, logits, -jnp.inf)
+    if mask is not None:
+        logits = jnp.where(mask.astype(bool), logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+    return out.transpose(0, 2, 1, 3).reshape(B, Tq, HD)

@@ -1,0 +1,120 @@
+"""Random sampling ops.
+
+Reference: src/operator/random/sample_op.cc (_random_uniform, _random_normal,
+...), src/resource.cc (per-device cuRAND states seeded by mx.random.seed).
+
+TPU-native: counter-based stateless RNG.  A process-global root key (set by
+``mx.random.seed``) is folded with a monotonically increasing counter for
+every sample op; the key is passed to the op as an ordinary array input so
+the op stays pure/traceable.  This replaces the reference's per-device
+ResourceManager kRandom states while keeping `mx.random.seed` determinism.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+_state = threading.local()
+_DEFAULT_SEED = 0
+
+
+def _root():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.PRNGKey(_DEFAULT_SEED)
+        _state.counter = 0
+    return _state
+
+
+def seed(seed_val: int) -> None:
+    st = _root()
+    st.key = jax.random.PRNGKey(int(seed_val))
+    st.counter = 0
+
+
+def next_key() -> jax.Array:
+    st = _root()
+    st.counter += 1
+    return jax.random.fold_in(st.key, st.counter)
+
+
+def _dt(dtype):
+    if dtype in (None, "None"):
+        return jnp.float32
+    return jnp.bfloat16 if dtype == "bfloat16" else dtype
+
+
+@register("_random_uniform", aliases=["random_uniform", "uniform"],
+          differentiable=False, needs_rng=True)
+def _uniform(key, low=0.0, high=1.0, shape=(), dtype=None):
+    return jax.random.uniform(key, shape, _dt(dtype), minval=low, maxval=high)
+
+
+@register("_random_normal", aliases=["random_normal", "normal"],
+          differentiable=False, needs_rng=True)
+def _normal(key, loc=0.0, scale=1.0, shape=(), dtype=None):
+    return jax.random.normal(key, shape, _dt(dtype)) * scale + loc
+
+
+@register("_random_gamma", aliases=["random_gamma"], differentiable=False, needs_rng=True)
+def _gamma(key, alpha=1.0, beta=1.0, shape=(), dtype=None):
+    return jax.random.gamma(key, alpha, shape, _dt(dtype)) * beta
+
+
+@register("_random_exponential", aliases=["random_exponential"],
+          differentiable=False, needs_rng=True)
+def _exponential(key, lam=1.0, shape=(), dtype=None):
+    return jax.random.exponential(key, shape, _dt(dtype)) / lam
+
+
+@register("_random_poisson", aliases=["random_poisson"], differentiable=False, needs_rng=True)
+def _poisson(key, lam=1.0, shape=(), dtype=None):
+    return jax.random.poisson(key, lam, shape).astype(_dt(dtype))
+
+
+@register("_random_randint", aliases=["random_randint"], differentiable=False, needs_rng=True)
+def _randint(key, low=0, high=2, shape=(), dtype="int32"):
+    return jax.random.randint(key, shape, low, high, dtype or jnp.int32)
+
+
+@register("_random_bernoulli", aliases=["bernoulli"], differentiable=False, needs_rng=True)
+def _bernoulli(key, prob=0.5, shape=(), dtype=None):
+    return jax.random.bernoulli(key, prob, shape).astype(_dt(dtype))
+
+
+@register("_sample_multinomial", aliases=["sample_multinomial", "multinomial"],
+          differentiable=False, needs_rng=True)
+def _multinomial(key, data, shape=(), get_prob=False, dtype="int32"):
+    # data: (..., k) probabilities
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    n = 1
+    for s in (shape if isinstance(shape, tuple) else (shape,)):
+        n *= int(s) if s else 1
+    out_shape = data.shape[:-1] + ((shape if isinstance(shape, tuple) else (shape,)) if shape else ())
+    samp = jax.random.categorical(key, logits, axis=-1,
+                                  shape=(n,) + data.shape[:-1])
+    if data.ndim == 1:
+        samp = samp.reshape(out_shape if shape else ())
+    else:
+        samp = jnp.moveaxis(samp, 0, -1).reshape(out_shape)
+    samp = samp.astype(dtype or jnp.int32)
+    if get_prob:
+        # REINFORCE path: also return log-prob of each drawn sample
+        logp = jnp.take_along_axis(
+            jnp.broadcast_to(logits, samp.shape + (logits.shape[-1],)),
+            samp[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        return samp, logp
+    return samp
+
+
+@register("shuffle", aliases=["_shuffle"], differentiable=False, needs_rng=True)
+def _shuffle(key, data):
+    return jax.random.permutation(key, data, axis=0)
+
+
+@register("sample_normal_like", differentiable=False, needs_rng=True)
+def _normal_like(key, data, loc=0.0, scale=1.0):
+    return jax.random.normal(key, data.shape, data.dtype) * scale + loc

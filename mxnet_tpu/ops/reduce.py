@@ -1,0 +1,159 @@
+"""Reductions, argmin/max, sort/topk, norms.
+
+Reference: src/operator/tensor/broadcast_reduce_op.h (ReduceAxesCompute),
+src/operator/tensor/ordering_op.cc (topk/sort/argsort).
+
+MXNET_SAFE_ACCUMULATION: the reference accumulates fp16 reductions in fp32
+when set; XLA does the same for bf16 when we pass an explicit accumulator
+dtype — handled by promoting below.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+_ACC = {jnp.bfloat16: jnp.float32, jnp.float16: jnp.float32}
+
+
+def _acc_reduce(fn, x, axis, keepdims, exclude=False):
+    if exclude and axis is not None:
+        ax = (axis,) if isinstance(axis, int) else tuple(axis)
+        axis = tuple(i for i in range(x.ndim) if i not in ax)
+    out = fn(x, axis=axis, keepdims=keepdims,
+             dtype=_ACC.get(x.dtype.type)) if fn in (jnp.sum, jnp.prod, jnp.mean) \
+        else fn(x, axis=axis, keepdims=keepdims)
+    return out.astype(x.dtype)
+
+
+@register("sum", aliases=["sum_axis"])
+def _sum(x, axis=None, keepdims=False, exclude=False):
+    return _acc_reduce(jnp.sum, x, axis, keepdims, exclude)
+
+
+@register("mean")
+def _mean(x, axis=None, keepdims=False, exclude=False):
+    return _acc_reduce(jnp.mean, x, axis, keepdims, exclude)
+
+
+@register("prod")
+def _prod(x, axis=None, keepdims=False, exclude=False):
+    return _acc_reduce(jnp.prod, x, axis, keepdims, exclude)
+
+
+@register("nansum")
+def _nansum(x, axis=None, keepdims=False, exclude=False):
+    return _acc_reduce(jnp.nansum, x, axis, keepdims, exclude)
+
+
+@register("nanprod")
+def _nanprod(x, axis=None, keepdims=False, exclude=False):
+    return _acc_reduce(jnp.nanprod, x, axis, keepdims, exclude)
+
+
+@register("max", aliases=["max_axis"])
+def _max(x, axis=None, keepdims=False, exclude=False):
+    return _acc_reduce(jnp.max, x, axis, keepdims, exclude)
+
+
+@register("min", aliases=["min_axis"])
+def _min(x, axis=None, keepdims=False, exclude=False):
+    return _acc_reduce(jnp.min, x, axis, keepdims, exclude)
+
+
+@register("norm")
+def _norm(x, ord=2, axis=None, keepdims=False):
+    xf = x.astype(_ACC.get(x.dtype.type, x.dtype))
+    if ord == 1:
+        out = jnp.sum(jnp.abs(xf), axis=axis, keepdims=keepdims)
+    else:
+        out = jnp.sqrt(jnp.sum(jnp.square(xf), axis=axis, keepdims=keepdims))
+    return out.astype(x.dtype)
+
+
+@register("L2Normalization")
+def _l2norm(x, eps=1e-10, mode="instance"):
+    if mode == "instance":
+        axes = tuple(range(1, x.ndim))
+    elif mode == "channel":
+        axes = (1,)
+    else:  # spatial
+        axes = tuple(range(2, x.ndim))
+    denom = jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=True) + eps)
+    return x / denom
+
+
+@register("argmax", differentiable=False)
+def _argmax(x, axis=None, keepdims=False):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdims)
+    return out.astype(jnp.float32)   # MXNet argmax returns float
+
+
+@register("argmin", differentiable=False)
+def _argmin(x, axis=None, keepdims=False):
+    return jnp.argmin(x, axis=axis, keepdims=keepdims).astype(jnp.float32)
+
+
+@register("argmax_channel", differentiable=False)
+def _argmax_channel(x):
+    return jnp.argmax(x, axis=1).astype(jnp.float32)
+
+
+@register("sort", differentiable=False)
+def _sort(x, axis=-1, is_ascend=True):
+    out = jnp.sort(x, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+@register("argsort", differentiable=False)
+def _argsort(x, axis=-1, is_ascend=True, dtype="float32"):
+    out = jnp.argsort(x, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    d = jnp.bfloat16 if dtype == "bfloat16" else dtype
+    return out.astype(d)
+
+
+@register("topk", differentiable=False)
+def _topk(x, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    # XLA top_k works on the last axis; move axis there.
+    if axis is None:
+        x = x.reshape(-1)
+        axis = -1
+    ax = axis % x.ndim
+    xm = jnp.moveaxis(x, ax, -1)
+    src = -xm if is_ascend else xm
+    vals, idx = jax_topk(src, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, ax)
+    idx = jnp.moveaxis(idx, -1, ax)
+    d = jnp.bfloat16 if dtype == "bfloat16" else dtype
+    if ret_typ == "indices":
+        return idx.astype(d)
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return (vals, idx.astype(d))
+    if ret_typ == "mask":
+        onehot = jnp.sum(jnp.eye(xm.shape[-1], dtype=x.dtype)[idx], axis=-2)
+        return jnp.moveaxis(onehot, -1, ax)
+    raise ValueError("unknown ret_typ %r" % ret_typ)
+
+
+def jax_topk(x, k):
+    from jax import lax
+    return lax.top_k(x, k)
+
+
+@register("cumsum")
+def _cumsum(x, axis=None, dtype=None):
+    d = jnp.bfloat16 if dtype == "bfloat16" else dtype
+    return jnp.cumsum(x, axis=axis, dtype=d)
+
+
+@register("cumprod")
+def _cumprod(x, axis=None, dtype=None):
+    return jnp.cumprod(x, axis=axis, dtype=dtype)

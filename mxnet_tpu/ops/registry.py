@@ -1,0 +1,124 @@
+"""Operator registry.
+
+Reference: the nnvm op registry — include/mxnet/op_attr_types.h (FCompute,
+FGradient, FInferShape), NNVM_REGISTER_OP in src/operator/**.
+
+The rebuild's registry is a Python-side dict keyed by op name.  Each entry
+carries:
+  * ``fn`` — the op's implementation as a *pure, traceable JAX function*
+    ``fn(*arrays, **params) -> array | tuple`` where ``params`` are static
+    (hashable) keyword attributes.  This single function plays the role of
+    FCompute<cpu>, FCompute<gpu> and the cuDNN/oneDNN paths at once: XLA
+    lowers it per backend, and the MXU/fusion decisions belong to the
+    compiler (SURVEY.md §7.0).
+  * differentiability — gradients come from ``jax.vjp`` over ``fn`` (the role
+    of FGradient); ops that are semantically non-differentiable are marked so
+    the tape can skip/zero them.
+  * aliases — MXNet exposes many ops under several names (`elemwise_add`,
+    `broadcast_add`, `_plus`, ...).
+
+Shape/dtype inference (FInferShape/FInferType) falls out of ``jax.eval_shape``
+over ``fn`` and needs no per-op rule.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+
+__all__ = ["OpDef", "register", "get_op", "list_ops", "alias", "cached_jit"]
+
+_REGISTRY: Dict[str, "OpDef"] = {}
+
+
+class OpDef:
+    __slots__ = ("name", "fn", "differentiable", "num_outputs", "doc",
+                 "mutates_input", "needs_rng", "aux_writeback")
+
+    def __init__(self, name: str, fn: Callable, differentiable: bool = True,
+                 num_outputs: int = 1, doc: Optional[str] = None,
+                 mutates_input: Optional[int] = None, needs_rng: bool = False,
+                 aux_writeback: Optional[Dict[int, int]] = None):
+        self.name = name
+        self.fn = fn
+        self.differentiable = differentiable
+        self.num_outputs = num_outputs
+        self.doc = doc or (fn.__doc__ or "")
+        # index of the input the op writes in place (e.g. fused optimizer
+        # updates mutate the weight); dispatch writes back through the chunk.
+        self.mutates_input = mutates_input
+        # op's first positional arg is a PRNG key injected by the dispatcher
+        self.needs_rng = needs_rng
+        # {output_idx: input_idx}: outputs written in place into the given
+        # inputs (BatchNorm moving stats = the reference's aux states) and
+        # stripped from the visible return
+        self.aux_writeback = aux_writeback
+
+    def __repr__(self):
+        return "OpDef(%s)" % self.name
+
+
+def register(name: str, fn: Optional[Callable] = None, *, differentiable: bool = True,
+             num_outputs: int = 1, aliases: Sequence[str] = (),
+             mutates_input: Optional[int] = None, needs_rng: bool = False,
+             aux_writeback: Optional[Dict[int, int]] = None):
+    """Register an op. Usable as decorator or direct call."""
+
+    def _do(f: Callable) -> Callable:
+        op = OpDef(name, f, differentiable=differentiable,
+                   num_outputs=num_outputs, mutates_input=mutates_input,
+                   needs_rng=needs_rng, aux_writeback=aux_writeback)
+        _REGISTRY[name] = op
+        for a in aliases:
+            _REGISTRY[a] = op
+        return f
+
+    if fn is None:
+        return _do
+    return _do(fn)
+
+
+def alias(name: str, *names: str) -> None:
+    op = _REGISTRY[name]
+    for n in names:
+        _REGISTRY[n] = op
+
+
+def get_op(name: str) -> OpDef:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError("Operator %r is not registered (have %d ops)"
+                       % (name, len(set(_REGISTRY.values())))) from None
+
+
+def list_ops():
+    """All registered op names (reference: MXListAllOpNames)."""
+    return sorted(_REGISTRY.keys())
+
+
+# ---------------------------------------------------------------------------
+# Eager per-op jit cache — the rebuild's HOT LOOP 1 (SURVEY.md §3.2): an eager
+# `mx.nd.dot` must hit a dict lookup, not a retrace.  jax.jit already caches
+# compiled executables keyed on input avals; we additionally cache the jitted
+# callable per (op, static-params) so eager dispatch does zero re-wrapping.
+# ---------------------------------------------------------------------------
+
+def _freeze(v: Any):
+    if isinstance(v, (list, tuple)):
+        return tuple(_freeze(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    return v
+
+
+@functools.lru_cache(maxsize=8192)
+def _jitted(name: str, frozen_params) -> Callable:
+    op = _REGISTRY[name]
+    params = dict(frozen_params)
+    return jax.jit(functools.partial(op.fn, **params))
+
+
+def cached_jit(name: str, params: Dict[str, Any]) -> Callable:
+    return _jitted(name, tuple(sorted((k, _freeze(v)) for k, v in params.items())))

@@ -1,0 +1,16 @@
+"""Global RNG seeding (reference: python/mxnet/random.py `def seed`,
+src/resource.cc per-device SeedRandom).
+
+On TPU seeding replaces the process-global root PRNG key; per-ctx seeds
+(`mx.random.seed(s, ctx=...)`) collapse to the same key because the stateless
+counter-based design already gives device-independent streams.
+"""
+from __future__ import annotations
+
+from .ops import random as _impl
+
+__all__ = ["seed"]
+
+
+def seed(seed_state: int, ctx=None) -> None:
+    _impl.seed(seed_state)

@@ -1,0 +1,162 @@
+"""Test infrastructure (ported first, per SURVEY.md §7.1 M1: it IS the test
+strategy).
+
+Reference: python/mxnet/test_utils.py — check_numeric_gradient,
+assert_almost_equal, check_consistency, same, rand_ndarray, default_context,
+environment().
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .base import environment  # re-export (reference keeps it here)
+from .device import Context, cpu, current_context
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import autograd
+
+__all__ = ["default_context", "set_default_context", "assert_almost_equal",
+           "same", "almost_equal", "rand_ndarray", "rand_shape_nd",
+           "check_numeric_gradient", "check_consistency", "environment",
+           "default_rtol_atol"]
+
+_default_ctx: List[Context] = []
+
+
+def default_context() -> Context:
+    return _default_ctx[-1] if _default_ctx else current_context()
+
+
+def set_default_context(ctx: Context) -> None:
+    _default_ctx.clear()
+    _default_ctx.append(ctx)
+
+
+_DTYPE_TOL = {
+    np.dtype(np.float64): (1e-5, 1e-7),
+    np.dtype(np.float32): (1e-4, 1e-5),
+    np.dtype(np.float16): (1e-2, 1e-3),
+}
+
+
+def default_rtol_atol(dtype) -> tuple:
+    return _DTYPE_TOL.get(np.dtype(dtype) if dtype != "bfloat16" else None,
+                          (1e-2, 1e-2))
+
+
+def _to_np(a):
+    if isinstance(a, NDArray):
+        return a.asnumpy()
+    return np.asarray(a)
+
+
+def same(a, b) -> bool:
+    return np.array_equal(_to_np(a), _to_np(b))
+
+
+def almost_equal(a, b, rtol=None, atol=None) -> bool:
+    a, b = _to_np(a), _to_np(b)
+    rtol = rtol if rtol is not None else 1e-5
+    atol = atol if atol is not None else 1e-20
+    return np.allclose(a, b, rtol=rtol, atol=atol, equal_nan=True)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b")) -> None:
+    an, bn = _to_np(a), _to_np(b)
+    if an.dtype == object or bn.dtype == object:
+        raise AssertionError("non-numeric comparison")
+    dt = an.dtype if an.dtype.kind == "f" else np.dtype(np.float32)
+    drtol, datol = _DTYPE_TOL.get(dt, (1e-4, 1e-5))
+    rtol = rtol if rtol is not None else drtol
+    atol = atol if atol is not None else datol
+    if not np.allclose(an.astype(np.float64), bn.astype(np.float64),
+                       rtol=rtol, atol=atol, equal_nan=True):
+        err = np.abs(an.astype(np.float64) - bn.astype(np.float64))
+        rel = err / (np.abs(bn.astype(np.float64)) + atol)
+        raise AssertionError(
+            "%s and %s differ: max abs err %g, max rel err %g (rtol=%g atol=%g)"
+            % (names[0], names[1], err.max() if err.size else 0,
+               rel.max() if rel.size else 0, rtol, atol))
+
+
+def rand_shape_nd(ndim: int, dim: int = 10) -> tuple:
+    return tuple(np.random.randint(1, dim + 1, size=ndim).tolist())
+
+
+def rand_ndarray(shape, stype: str = "default", density=None, dtype=None,
+                 ctx: Optional[Context] = None) -> NDArray:
+    if stype != "default":
+        raise NotImplementedError("sparse rand_ndarray comes with sparse.py")
+    arr = np.random.uniform(-1.0, 1.0, size=shape)
+    return nd.array(arr, ctx=ctx or default_context(),
+                    dtype=dtype or "float32")
+
+
+# ---------------------------------------------------------------------------
+# numeric gradient checking (reference: check_numeric_gradient) — central
+# finite differences on the host against autograd's gradients.
+# ---------------------------------------------------------------------------
+
+
+def check_numeric_gradient(fn: Callable, inputs: Sequence[NDArray],
+                           eps: float = 1e-3, rtol: float = 1e-2,
+                           atol: float = 1e-3,
+                           grad_nodes: Optional[Sequence[int]] = None) -> None:
+    """fn: callable over NDArrays returning a single NDArray (any shape).
+    Compares autograd grads of sum(fn(*inputs)) with central differences.
+    Inputs should be float64-friendly magnitudes."""
+    inputs = [x if isinstance(x, NDArray) else nd.array(x) for x in inputs]
+    which = list(grad_nodes) if grad_nodes is not None else list(range(len(inputs)))
+    for x in inputs:
+        x.attach_grad()
+    with autograd.record():
+        y = fn(*inputs)
+        out = y.sum() if y.size > 1 else y
+    out.backward()
+    analytic = [inputs[i].grad.asnumpy().astype(np.float64) for i in which]
+
+    host = [x.asnumpy().astype(np.float64) for x in inputs]
+
+    def f_host(args):
+        ndargs = [nd.array(a, dtype="float32") for a in args]
+        r = fn(*ndargs)
+        return float(r.sum().asscalar() if r.size > 1 else r.asscalar())
+
+    for k, i in enumerate(which):
+        numeric = np.zeros_like(host[i])
+        flat = host[i].reshape(-1)
+        num_flat = numeric.reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + eps
+            fp = f_host(host)
+            flat[j] = orig - eps
+            fm = f_host(host)
+            flat[j] = orig
+            num_flat[j] = (fp - fm) / (2 * eps)
+        assert_almost_equal(analytic[k], numeric, rtol=rtol, atol=atol,
+                            names=("autograd_grad[%d]" % i, "numeric_grad[%d]" % i))
+
+
+def check_consistency(fn: Callable, inputs_np: Sequence[np.ndarray],
+                      ctx_list: Sequence[Context], dtypes=("float32",),
+                      rtol=None, atol=None) -> None:
+    """Run fn on the same inputs across contexts/dtypes; assert agreement.
+
+    Reference: check_consistency builds one symbol across [cpu, gpu]; here
+    cross-ctx = cpu vs tpu (SURVEY.md §4.2 — the rebuild's most important
+    test pattern)."""
+    for dtype in dtypes:
+        results = []
+        for ctx in ctx_list:
+            args = [nd.array(a, ctx=ctx, dtype=dtype) for a in inputs_np]
+            out = fn(*args)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            results.append([o.asnumpy() for o in outs])
+        base = results[0]
+        for other, ctx in zip(results[1:], ctx_list[1:]):
+            for a, b in zip(base, other):
+                assert_almost_equal(a, b, rtol=rtol, atol=atol,
+                                    names=("ctx[%s]" % ctx_list[0], "ctx[%s]" % ctx))

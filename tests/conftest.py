@@ -1,0 +1,30 @@
+"""Test harness config.
+
+Per SURVEY.md §4.5: unit tests run on a *fake 8-device CPU mesh*
+(xla_force_host_platform_device_count) so multi-device/kvstore/shard_map
+logic is exercised without TPU hardware; `mx.tpu(i)` resolves to the i-th
+host device.  Must run before jax is imported anywhere.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["MX_FORCE_CPU"] = "1"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seeded():
+    """Reference: @with_seed() — fixed seeds, logged for reproducibility."""
+    np.random.seed(1234)
+    import mxnet_tpu as mx
+    mx.random.seed(1234)
+    yield

@@ -1,0 +1,182 @@
+"""Autograd tests (reference pattern: tests/python/unittest/test_autograd.py:
+record/pause scopes, backward, grad_req modes, autograd.grad, Function)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def test_simple_backward():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0, 4.0, 6.0])
+
+
+def test_chain_rule_through_ops():
+    x = nd.array([[0.5, -1.0], [2.0, 0.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.relu(x)
+        z = (y * 3.0).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [[3.0, 0.0], [3.0, 0.0]])
+
+
+def test_backward_nonscalar_default_head_grad():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2.0
+    y.backward()  # implicit ones head grad
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0, 2.0])
+
+
+def test_explicit_head_grad():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward(nd.array([10.0, 100.0]))
+    np.testing.assert_allclose(x.grad.asnumpy(), [20.0, 400.0])
+
+
+def test_grad_req_add_and_null():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(2):
+        with autograd.record():
+            y = (x * x).sum()
+        y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.0, 8.0])
+
+    z = nd.array([1.0])
+    z.attach_grad(grad_req="null")
+    with autograd.record():
+        w = z * 2
+    w.backward()
+    np.testing.assert_allclose(z.grad.asnumpy(), [0.0])
+
+
+def test_pause_scope():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        with autograd.pause():
+            c = x * 10.0   # not recorded
+        z = y + c.detach()
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.0])
+
+
+def test_training_flags():
+    assert not autograd.is_training()
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training()
+
+
+def test_autograd_grad_api():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+    (g,) = autograd.grad(y, [x])
+    np.testing.assert_allclose(g.asnumpy(), [27.0])
+    # .grad untouched by grad()
+    np.testing.assert_allclose(x.grad.asnumpy(), [0.0])
+
+
+def test_shared_subexpression():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x          # y used twice
+        z = y + y
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [8.0])
+
+
+def test_multi_input_op():
+    a = nd.array([1.0, 2.0])
+    b = nd.array([3.0, 4.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = (a * b).sum()
+    c.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), [3.0, 4.0])
+    np.testing.assert_allclose(b.grad.asnumpy(), [1.0, 2.0])
+
+
+def test_matmul_grads():
+    a = nd.array(np.random.rand(3, 4).astype(np.float32))
+    w = nd.array(np.random.rand(4, 2).astype(np.float32))
+    w.attach_grad()
+    with autograd.record():
+        out = nd.dot(a, w).sum()
+    out.backward()
+    expected = a.asnumpy().T @ np.ones((3, 2), np.float32)
+    np.testing.assert_allclose(w.grad.asnumpy(), expected, rtol=1e-5)
+
+
+def test_dropout_under_record():
+    x = nd.ones((100, 100))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Dropout(x, p=0.5, training=True)
+        s = y.sum()
+    s.backward()
+    g = x.grad.asnumpy()
+    # grads are 0 or 2 (1/keep_prob)
+    vals = np.unique(g)
+    assert set(np.round(vals, 3)).issubset({0.0, 2.0})
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = nd.sigmoid(x)
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    f = Sigmoid()
+    x = nd.array([0.0, 1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(x.grad.asnumpy(), s * (1 - s), rtol=1e-5)
+
+
+def test_deep_chain_no_recursion_error():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x
+        for _ in range(300):
+            y = y + 0.01
+        z = y * 1.0
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [1.0])
+
+
+def test_numeric_gradient_checker():
+    from mxnet_tpu.test_utils import check_numeric_gradient
+    check_numeric_gradient(lambda x: nd.tanh(x), [nd.array([0.1, -0.3, 0.7])])
+    check_numeric_gradient(lambda a, b: a * b + nd.exp(a),
+                           [nd.array([0.5, 1.0]), nd.array([2.0, -1.0])])
