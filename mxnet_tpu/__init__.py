@@ -20,6 +20,14 @@ from . import autograd
 from . import random
 from .ndarray import NDArray, waitall
 
+from . import initializer
+from . import initializer as init
+from . import metric
+from . import lr_scheduler
+from . import optimizer
+from . import kvstore
+from . import gluon
+
 # Subsystems land milestone-by-milestone (SURVEY.md §7.1); this list grows
 # until it covers the reference's full `python/mxnet/__init__.py` surface.
 from . import test_utils

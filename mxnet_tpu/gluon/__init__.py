@@ -1,0 +1,19 @@
+"""Gluon: the imperative/hybrid high-level API.
+
+Reference: python/mxnet/gluon/__init__.py — Block/HybridBlock/SymbolBlock,
+Parameter/Constant/ParameterDict, Trainer, nn, rnn, loss, data, model_zoo,
+utils, contrib; gluon.metric re-exports mx.metric (2.x move).
+"""
+from .parameter import Parameter, Constant, ParameterDict, \
+    DeferredInitializationError
+from .block import Block, HybridBlock, SymbolBlock
+from .trainer import Trainer
+from . import nn
+from . import loss
+from . import utils
+from . import data
+from .. import metric
+
+__all__ = ["Parameter", "Constant", "ParameterDict",
+           "DeferredInitializationError", "Block", "HybridBlock",
+           "SymbolBlock", "Trainer", "nn", "loss", "utils", "data", "metric"]

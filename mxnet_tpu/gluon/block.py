@@ -1,0 +1,569 @@
+"""Gluon Block / HybridBlock / CachedOp.
+
+Reference: python/mxnet/gluon/block.py (class Block — child/param
+registration via __setattr__, collect_params, save_parameters /
+load_parameters with structural names; class HybridBlock — hybridize,
+_build_cache, _call_cached_op) and src/imperative/cached_op.cc
+(CachedOp::Forward, OptimizeGraph, static_alloc).
+
+TPU-native design (SURVEY.md §3.4 TPU mapping): ``hybridize()`` IS
+``jax.jit``.  On call, the block's Python ``forward`` is traced once per
+(input avals, param avals, mode) into a pure function of
+(trainable-params, frozen-params, rng, inputs); jax.jit caches the compiled
+XLA executable — the reference's CachedOp graph-optimization + static memory
+planning are XLA's problem now.  Training uses the split-executable pattern:
+one jitted forward that *returns its vjp* (a jax.tree_util.Partial whose
+residuals stay in HBM) + one jitted backward applying it, so the steady-state
+train step is exactly two XLA dispatches and the autograd tape records a
+single fused node (SURVEY.md §7.2 item 1).
+"""
+from __future__ import annotations
+
+import functools
+import re
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..device import Context, current_context, cpu
+from ..ndarray import ndarray as _nd_mod
+from ..ndarray.ndarray import NDArray
+from .. import autograd
+from .. import initializer as init_mod
+from ..ops import random as _ops_random
+from .parameter import (Parameter, Constant, ParameterDict,
+                        DeferredInitializationError, _ParamOverrideScope)
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+
+class Block:
+    """Base building block (reference: gluon.Block).
+
+    Children and parameters are registered automatically on attribute
+    assignment.  ``collect_params`` walks the tree producing structural
+    names ("encoder.0.weight"), the 2.x naming scheme used by
+    save_parameters/load_parameters.
+    """
+
+    def __init__(self, prefix: Optional[str] = None, params=None):
+        # Use object.__setattr__: these must exist before __setattr__ logic.
+        object.__setattr__(self, "_children", OrderedDict())
+        object.__setattr__(self, "_reg_params", OrderedDict())
+        object.__setattr__(self, "_forward_hooks", OrderedDict())
+        object.__setattr__(self, "_forward_pre_hooks", OrderedDict())
+        self._prefix = prefix or ""
+        # v1.x compat: self.params.get('weight', shape=...) creates params
+        self._params = ParameterDict(self._prefix, shared=params)
+        self._scope_counter = 0
+
+    # -- registration ------------------------------------------------------
+    def __setattr__(self, name: str, value: Any) -> None:
+        if isinstance(value, Block):
+            existing = self.__dict__.get("_children")
+            if existing is not None:
+                existing[name] = value
+        elif isinstance(value, Parameter):
+            reg = self.__dict__.get("_reg_params")
+            if reg is not None:
+                reg[name] = value
+        object.__setattr__(self, name, value)
+
+    def __delattr__(self, name: str) -> None:
+        self._children.pop(name, None)
+        self._reg_params.pop(name, None)
+        object.__delattr__(self, name)
+
+    def register_child(self, block: "Block", name: Optional[str] = None) -> None:
+        if name is None:
+            name = str(len(self._children))
+        self._children[name] = block
+        object.__setattr__(self, "_child_" + name, block)
+
+    def register_forward_hook(self, hook: Callable) -> "_HookHandle":
+        return _HookHandle(self._forward_hooks, hook)
+
+    def register_forward_pre_hook(self, hook: Callable) -> "_HookHandle":
+        return _HookHandle(self._forward_pre_hooks, hook)
+
+    # -- params ------------------------------------------------------------
+    @property
+    def params(self) -> ParameterDict:
+        """Own (directly registered) parameters (v1.x surface)."""
+        for n, p in self._reg_params.items():
+            key = self._params.prefix + n
+            if key not in self._params:
+                self._params[key] = p
+        return self._params
+
+    def collect_params(self, select: Optional[str] = None) -> ParameterDict:
+        """All parameters in this tree, keyed by structural name."""
+        out = ParameterDict(self._prefix)
+        pattern = re.compile(select) if select else None
+        for name, param in self._iter_params():
+            if pattern and not pattern.search(name):
+                continue
+            param._structural_name = name
+            out[name] = param
+        return out
+
+    def _iter_params(self, prefix: str = ""):
+        for name, param in self._reg_params.items():
+            yield (prefix + name if not prefix else prefix + name), param
+        for cname, child in self._children.items():
+            yield from child._iter_params(prefix + cname + ".")
+
+    def initialize(self, init=None, ctx=None, verbose: bool = False,
+                   force_reinit: bool = False) -> None:
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def zero_grad(self):
+        self.collect_params().zero_grad()
+
+    def reset_ctx(self, ctx):
+        self.collect_params().reset_ctx(ctx)
+
+    def cast(self, dtype) -> None:
+        for child in self._children.values():
+            child.cast(dtype)
+        for param in self._reg_params.values():
+            param.cast(dtype)
+
+    def apply(self, fn: Callable) -> "Block":
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def setattr(self, name, value):
+        for _, param in self._iter_params():
+            setattr(param, name, value)
+
+    def share_parameters(self, shared: Dict[str, Parameter]) -> "Block":
+        """2.x API: graft `shared` params into matching structural slots."""
+        if isinstance(shared, ParameterDict):
+            shared = dict(shared.items())
+        structural = {name: (holder, attr)
+                      for name, holder, attr in self._iter_param_slots()}
+        for name, param in shared.items():
+            if name in structural:
+                holder, attr = structural[name]
+                holder._reg_params[attr] = param
+                object.__setattr__(holder, attr, param)
+        return self
+
+    def _iter_param_slots(self, prefix: str = ""):
+        for attr in list(self._reg_params):
+            yield prefix + attr, self, attr
+        for cname, child in self._children.items():
+            yield from child._iter_param_slots(prefix + cname + ".")
+
+    # -- save / load -------------------------------------------------------
+    def save_parameters(self, filename: str, deduplicate: bool = False) -> None:
+        """Reference: Block.save_parameters — structural names, NDArray
+        dict file format (readable by mx.nd.load)."""
+        params = self.collect_params()
+        arg_dict = {}
+        seen = {}
+        for name, param in params.items():
+            if deduplicate and id(param) in seen:
+                continue
+            seen[id(param)] = name
+            arg_dict[name] = param._reduce()
+        _nd_mod.save(filename, arg_dict)
+
+    def load_parameters(self, filename: str, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False,
+                        dtype_source="current") -> None:
+        loaded = _nd_mod.load(filename)
+        params = self.collect_params()
+        loaded = {k[4:] if k.startswith(("arg:", "aux:")) else k: v
+                  for k, v in loaded.items()}
+        if not allow_missing:
+            for name in params.keys():
+                if name not in loaded:
+                    raise AssertionError(
+                        "Parameter %s is missing in %s. Set allow_missing=True "
+                        "to ignore missing parameters" % (name, filename))
+        for name, value in loaded.items():
+            if name not in params:
+                if not ignore_extra:
+                    raise AssertionError(
+                        "Parameter %s loaded from %s is not present in the "
+                        "Block. Set ignore_extra=True to ignore" % (name, filename))
+                continue
+            param = params[name]
+            if cast_dtype:
+                if dtype_source == "saved":
+                    param.cast(value.dtype)
+                else:
+                    value = value.astype(param.dtype)
+            if param._data is None and param._deferred_init is None:
+                param.initialize(ctx=ctx or cpu())
+            param.set_data(value)
+
+    save_params = save_parameters     # deprecated v1.x aliases
+    load_params = load_parameters
+
+    # -- call / forward ----------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            hook(self, args)
+        out = self._call_impl(*args, **kwargs)
+        for hook in self._forward_hooks.values():
+            hook(self, args, out)
+        return out
+
+    def _call_impl(self, *args, **kwargs):
+        try:
+            return self._forward_maybe_v1(*args, **kwargs)
+        except DeferredInitializationError:
+            self._deferred_init_from(args)
+            return self._forward_maybe_v1(*args, **kwargs)
+
+    def _deferred_init_from(self, args) -> None:
+        """Finish deferred param init using input shapes (reference:
+        HybridBlock._deferred_infer_shape → Parameter._finish_deferred_init)."""
+        self.infer_shape(*args)
+        for param in self._reg_params.values():
+            if param._deferred_init is not None:
+                param._finish_deferred_init()
+
+    def infer_shape(self, *args) -> None:
+        """Leaf layers with deferred-shape params override this."""
+        raise DeferredInitializationError(
+            "%s has parameters with unknown shape and does not implement "
+            "infer_shape" % type(self).__name__)
+
+    def _forward_maybe_v1(self, *args, **kwargs):
+        """Dispatch to forward(); v1.x-era subclasses may define
+        hybrid_forward(F, x, **params) instead — inject F=nd + own params."""
+        if type(self).forward not in Block._FORWARD_PLACEHOLDERS:
+            return self.forward(*args, **kwargs)
+        if hasattr(self, "hybrid_forward"):
+            ctx = _first_ctx(args) or current_context()
+            pkw = {n: p.data(ctx) for n, p in self._reg_params.items()}
+            return self.hybrid_forward(_nd_mod, *args, **pkw, **kwargs)
+        raise NotImplementedError(
+            "%s must implement forward (or hybrid_forward)" % type(self).__name__)
+
+    # set after HybridBlock is defined: {Block.forward, HybridBlock.forward}
+    _FORWARD_PLACEHOLDERS: tuple = ()
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def hybridize(self, active: bool = True, **kwargs) -> None:
+        """Recursively hybridize children (no-op on plain Blocks)."""
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def summary(self, *inputs):
+        """Print a per-block summary table (reference: Block.summary)."""
+        rows = []
+
+        def walk(block, name, depth):
+            n_params = sum(int(_np.prod(p.shape)) if p.shape else 0
+                           for p in block._reg_params.values())
+            rows.append(("  " * depth + (name or type(block).__name__),
+                         type(block).__name__, n_params))
+            for cname, child in block._children.items():
+                walk(child, cname, depth + 1)
+
+        walk(self, type(self).__name__, 0)
+        total = sum(r[2] for r in rows)
+        lines = ["%-40s %-20s %12s" % ("Layer", "Type", "Params"),
+                 "-" * 74]
+        lines += ["%-40s %-20s %12d" % r for r in rows]
+        lines += ["-" * 74, "Total params: %d" % total]
+        print("\n".join(lines))
+
+    def __repr__(self):
+        body = "\n".join("  (%s): %s" % (k, repr(v).replace("\n", "\n  "))
+                         for k, v in self._children.items())
+        return "%s(\n%s\n)" % (type(self).__name__, body) if body else \
+            "%s()" % type(self).__name__
+
+
+class _HookHandle:
+    _next_id = [0]
+
+    def __init__(self, hooks: OrderedDict, hook: Callable):
+        self._hooks = hooks
+        self._id = _HookHandle._next_id[0]
+        _HookHandle._next_id[0] += 1
+        hooks[self._id] = hook
+
+    def detach(self):
+        self._hooks.pop(self._id, None)
+
+
+def _first_ctx(args) -> Optional[Context]:
+    for a in args:
+        if isinstance(a, NDArray):
+            return a.context
+        if isinstance(a, (list, tuple)):
+            c = _first_ctx(a)
+            if c is not None:
+                return c
+    return None
+
+
+def _flatten_nds(obj, out: List[NDArray]):
+    """Collect NDArray leaves; return a template for rebuilding."""
+    if isinstance(obj, NDArray):
+        out.append(obj)
+        return _LEAF
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_flatten_nds(x, out) for x in obj)
+    return obj
+
+
+_LEAF = object()
+
+
+def _rebuild(template, leaves: List[Any], pos: List[int]):
+    if template is _LEAF:
+        v = leaves[pos[0]]
+        pos[0] += 1
+        return v
+    if isinstance(template, (list, tuple)):
+        return type(template)(_rebuild(t, leaves, pos) for t in template)
+    return template
+
+
+# Single process-wide backward executor: applies a vjp Partial to cotangents.
+# The Partial's static structure is fixed per forward-trace, so this jit hits
+# its cache every step (one XLA executable per cached graph).
+@jax.jit
+def _apply_vjp(vjp_fn, cotangents):
+    return vjp_fn(cotangents)
+
+
+class _CacheEntry:
+    """One compiled graph: key = (input avals, param avals, mode)."""
+    __slots__ = ("fwd_infer", "fwd_train", "mutated_ids", "out_template",
+                 "n_outs")
+
+    def __init__(self):
+        self.fwd_infer = None
+        self.fwd_train = None
+        self.mutated_ids: List[int] = []
+        self.out_template = None
+        self.n_outs = 0
+
+
+class HybridBlock(Block):
+    """Block that can be compiled into a cached XLA graph.
+
+    Reference: gluon.HybridBlock (hybridize/_build_cache/_call_cached_op,
+    export, optimize_for).  Steady state after hybridize():
+      inference — one jitted executable;
+      training  — fwd executable returning (outs, aux, vjp-Partial) + one
+                  shared backward executable; the tape records one node.
+    """
+
+    def __init__(self, prefix: Optional[str] = None, params=None):
+        super().__init__(prefix, params)
+        object.__setattr__(self, "_active", False)
+        object.__setattr__(self, "_cache", {})
+        object.__setattr__(self, "_flags", {})
+        object.__setattr__(self, "_monitor_all", False)
+
+    def hybridize(self, active: bool = True, static_alloc: bool = False,
+                  static_shape: bool = False, inline_limit: int = 2,
+                  forward_bulk_size: Optional[int] = None,
+                  backward_bulk_size: Optional[int] = None) -> None:
+        self._active = active
+        self._flags = {"static_alloc": static_alloc,
+                       "static_shape": static_shape}
+        self._cache = {}
+        super().hybridize(active,
+                          static_alloc=static_alloc, static_shape=static_shape)
+
+    def _clear_cached_op(self):
+        self._cache = {}
+
+    # -- the cached-op path -------------------------------------------------
+    def _call_impl(self, *args, **kwargs):
+        from .parameter import _overrides
+        # inside an enclosing trace, compose into it imperatively rather
+        # than nesting a second jit (reference: CachedOp inlining)
+        if not self._active or _overrides() is not None:
+            return super()._call_impl(*args, **kwargs)
+        params = list(self.collect_params().items())
+        # deferred params: first call runs imperatively (finishes deferred
+        # init with real shapes — the reference's _build_cache infer pass)
+        if any(p._data is None for _, p in params):
+            return super()._call_impl(*args, **kwargs)
+        return self._call_cached(params, args, kwargs)
+
+    def _call_cached(self, params, args, kwargs):
+        in_leaves: List[NDArray] = []
+        template = _flatten_nds(args, in_leaves)
+        in_vals = [x._jax for x in in_leaves]
+        ctx = _first_ctx(args) or current_context()
+
+        trainable, frozen = [], []
+        for _, p in params:
+            (trainable if p.grad_req != "null" else frozen).append(p)
+        recording = autograd.is_recording()
+        training = autograd.is_training()
+        key = (tuple((v.shape, str(v.dtype)) for v in in_vals),
+               tuple((p.shape, str(p.dtype)) for _, p in params),
+               tuple(sorted(kwargs.items())) if kwargs else (),
+               recording, training)
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._build_cache(params, trainable, frozen, template,
+                                      len(in_vals), kwargs, recording, training)
+            self._cache[key] = entry
+
+        t_vals = tuple(p.data(ctx)._jax for p in trainable)
+        f_vals = tuple(p.data(ctx)._jax for p in frozen)
+        rng = _ops_random.next_key()
+
+        if recording:
+            outs, vjp_fn, mutated = entry.fwd_train(t_vals, f_vals, rng,
+                                                    tuple(in_vals))
+        else:
+            outs, mutated = entry.fwd_infer(t_vals, f_vals, rng, tuple(in_vals))
+            vjp_fn = None
+
+        # write mutated aux state (BatchNorm running stats) back into params
+        by_id = {id(p): p for _, p in params}
+        for pid, new_val in zip(entry.mutated_ids, mutated):
+            p = by_id[pid]
+            arr = p.data(ctx)
+            arr._set_jax(new_val.astype(arr.dtype))
+
+        if vjp_fn is not None:
+            def tape_vjp(cotangents):
+                g_train, g_ins = _apply_vjp(vjp_fn, cotangents)
+                return tuple(g_train) + tuple(g_ins)
+
+            nd_inputs = [p.data(ctx) for p in trainable] + in_leaves
+            wrapped = autograd.record_custom(
+                tape_vjp, nd_inputs, tuple(outs), ctx,
+                name=type(self).__name__)
+        else:
+            wrapped = [NDArray(o, ctx=ctx) for o in outs]
+        return _rebuild(entry.out_template, wrapped, [0])
+
+    def _build_cache(self, params, trainable, frozen, template, n_in,
+                     kwargs, recording, training) -> _CacheEntry:
+        """Trace forward into a pure jax function and jit it (reference:
+        CachedOp::CachedOp + OptimizeGraph — here XLA does the optimizing)."""
+        entry = _CacheEntry()
+        block = self
+
+        def run(t_vals, f_vals, rng, in_vals):
+            # fresh tracer-backed NDArray per param; layers read them through
+            # Parameter.data() via the override scope
+            entry.mutated_ids = []
+            overrides: Dict[int, NDArray] = {}
+            tr_nds, fr_nds = [], []
+            for p, v in zip(trainable, t_vals):
+                nd = NDArray(v, ctx=cpu())
+                overrides[id(p)] = nd
+                tr_nds.append((p, nd))
+            for p, v in zip(frozen, f_vals):
+                nd = NDArray(v, ctx=cpu())
+                overrides[id(p)] = nd
+                fr_nds.append((p, nd))
+            in_nds = [NDArray(v, ctx=cpu()) for v in in_vals]
+            rebuilt = _rebuild(template, in_nds, [0])
+            with _ParamOverrideScope(overrides), \
+                    _ops_random.trace_key_scope(rng), \
+                    autograd._Scope(False, training):
+                out = Block._call_impl(block, *rebuilt, **kwargs)
+            out_leaves: List[NDArray] = []
+            entry.out_template = _flatten_nds(out, out_leaves)
+            entry.n_outs = len(out_leaves)
+            # detect aux-state mutation (chunk version bumped during trace)
+            mutated_vals = []
+            for p, nd in tr_nds + fr_nds:
+                if nd._chunk.version > 0:
+                    entry.mutated_ids.append(id(p))
+                    mutated_vals.append(nd._jax)
+            return tuple(o._jax for o in out_leaves), tuple(mutated_vals)
+
+        if recording:
+            @jax.jit
+            def fwd_train(t_vals, f_vals, rng, in_vals):
+                def f(tv, iv):
+                    return run(tv, f_vals, rng, iv)
+                outs, vjp_fn, mutated = jax.vjp(f, t_vals, in_vals,
+                                                has_aux=True)
+                return outs, vjp_fn, mutated
+
+            entry.fwd_train = fwd_train
+        else:
+            entry.fwd_infer = jax.jit(run)
+        return entry
+
+    # -- export (symbol.json + params artifact) -----------------------------
+    def export(self, path: str, epoch: int = 0, remove_amp_cast: bool = True):
+        """Serialize to `path-symbol.json` + `path-%04d.params` (reference:
+        HybridBlock.export).  The JSON carries the block config; parameters
+        use the MXNet binary dict format."""
+        from ..symbol import symbol_json_from_block
+        sym_file = "%s-symbol.json" % path
+        with open(sym_file, "w") as f:
+            f.write(symbol_json_from_block(self))
+        params_file = "%s-%04d.params" % (path, epoch)
+        arg_dict = {}
+        for name, p in self.collect_params().items():
+            arg_dict["arg:" + name] = p._reduce()
+        _nd_mod.save(params_file, arg_dict)
+        return sym_file, params_file
+
+    def optimize_for(self, x, backend=None, clear=True, **kwargs):
+        """Reference: HybridBlock.optimize_for(backend).  Backends map to
+        alternate lowering configs; the default XLA path ignores the hint."""
+        self.hybridize(True, **{k: v for k, v in kwargs.items()
+                                if k in ("static_alloc", "static_shape")})
+        return self(x)
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+
+Block._FORWARD_PLACEHOLDERS = (Block.forward, HybridBlock.forward)
+
+
+class SymbolBlock(HybridBlock):
+    """Runs a network from exported symbol.json + params (reference:
+    gluon.SymbolBlock.imports).  Full graph-json execution lands with the
+    symbol subsystem; constructing from a live Symbol works now."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__()
+        self._outputs = outputs
+        self._inputs = inputs
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        from ..symbol import load as sym_load
+        sym = sym_load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        block = SymbolBlock(sym, input_names)
+        if param_file:
+            block._sym_params = _nd_mod.load(param_file)
+        else:
+            block._sym_params = {}
+        block._input_names = input_names
+        return block
+
+    def forward(self, *args):
+        from ..symbol import evaluate as sym_eval
+        feeds = dict(zip(self._input_names, args))
+        params = {k[4:] if k.startswith(("arg:", "aux:")) else k: v
+                  for k, v in self._sym_params.items()}
+        return sym_eval(self._outputs, feeds, params)

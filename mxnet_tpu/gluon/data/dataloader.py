@@ -1,0 +1,103 @@
+"""DataLoader.
+
+Reference: python/mxnet/gluon/data/dataloader.py (class DataLoader,
+_MultiWorkerIter, default_batchify_fn, default_mp_batchify_fn).
+
+TPU-native: worker parallelism uses a thread pool rather than the
+reference's multiprocessing workers — the heavy lifting (decode/augment) is
+NumPy/PIL releasing the GIL, and forked processes do not mix with a live
+PJRT client.  Batches are assembled host-side as one contiguous NumPy array
+and make a single host→HBM transfer per batch (pin_memory's role — PJRT owns
+the staging buffers).
+"""
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Optional
+
+import numpy as _np
+
+from ... import ndarray as nd
+from ...ndarray.ndarray import NDArray
+from .dataset import Dataset
+from .sampler import BatchSampler, RandomSampler, SequentialSampler, Sampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference: default_batchify_fn)."""
+    if isinstance(data[0], NDArray):
+        return nd.stack(list(data))
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(list(i)) for i in data]
+    out = _np.asarray(data)
+    return nd.array(out)
+
+
+class DataLoader:
+    """Iterate a Dataset in mini-batches (reference: gluon.data.DataLoader)."""
+
+    def __init__(self, dataset: Dataset, batch_size: Optional[int] = None,
+                 shuffle: bool = False, sampler: Optional[Sampler] = None,
+                 last_batch: Optional[str] = None,
+                 batch_sampler: Optional[BatchSampler] = None,
+                 batchify_fn: Optional[Callable] = None,
+                 num_workers: int = 0, pin_memory: bool = False,
+                 pin_device_id: int = 0, prefetch: Optional[int] = None,
+                 thread_pool: bool = False, timeout: int = 120):
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+        self._timeout = timeout
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size must be specified unless "
+                                 "batch_sampler is specified")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle else \
+                    SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must not be specified if sampler "
+                                 "is specified")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError("batch_size, shuffle, sampler and last_batch "
+                             "must not be specified if batch_sampler is "
+                             "specified.")
+        self._batch_sampler = batch_sampler
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * self._num_workers)
+        self._batchify_fn = batchify_fn or default_batchify_fn
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def _load_batch(self, indices):
+        samples = [self._dataset[i] for i in indices]
+        return self._batchify_fn(samples)
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for indices in self._batch_sampler:
+                yield self._load_batch(indices)
+            return
+        # threaded prefetch pipeline (reference: _MultiWorkerIter)
+        with ThreadPoolExecutor(max_workers=self._num_workers) as pool:
+            futures = []
+            it = iter(self._batch_sampler)
+            try:
+                for _ in range(self._prefetch or self._num_workers):
+                    futures.append(pool.submit(self._load_batch, next(it)))
+            except StopIteration:
+                pass
+            while futures:
+                fut = futures.pop(0)
+                try:
+                    futures.append(pool.submit(self._load_batch, next(it)))
+                except StopIteration:
+                    pass
+                yield fut.result(timeout=self._timeout)

@@ -1,0 +1,8 @@
+"""Gluon nn layers (reference: python/mxnet/gluon/nn/)."""
+from ..block import Block, HybridBlock, SymbolBlock
+from .basic_layers import *     # noqa: F401,F403
+from .conv_layers import *      # noqa: F401,F403
+from . import basic_layers, conv_layers
+
+__all__ = (["Block", "HybridBlock", "SymbolBlock"] +
+           basic_layers.__all__ + conv_layers.__all__)

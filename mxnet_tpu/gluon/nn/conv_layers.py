@@ -1,0 +1,297 @@
+"""Convolution and pooling layers.
+
+Reference: python/mxnet/gluon/nn/conv_layers.py (_Conv, Conv1D-3D,
+Conv1D-3DTranspose, _Pooling, MaxPool/AvgPool/GlobalMaxPool/GlobalAvgPool
+1D-3D, ReflectionPad2D).
+
+TPU-native: all convs lower to one `lax.conv_general_dilated` (MXU path);
+pooling to `lax.reduce_window` (see ops/nn.py).  MXNet's NCHW/OIHW layouts
+are kept at the API; XLA picks internal layouts for the MXU.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as _np
+
+from ...ndarray.ndarray import NDArray, invoke
+from ... import initializer as init
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
+           "Conv3DTranspose", "MaxPool1D", "MaxPool2D", "MaxPool3D",
+           "AvgPool1D", "AvgPool2D", "AvgPool3D", "GlobalMaxPool1D",
+           "GlobalMaxPool2D", "GlobalMaxPool3D", "GlobalAvgPool1D",
+           "GlobalAvgPool2D", "GlobalAvgPool3D", "ReflectionPad2D"]
+
+
+def _tup(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+class _Conv(HybridBlock):
+    """Shared conv implementation (reference: gluon nn _Conv)."""
+
+    _op = "Convolution"
+
+    def __init__(self, channels, kernel_size, strides, padding, dilation,
+                 groups, layout, in_channels=0, activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 dtype="float32", **kwargs):
+        super().__init__(**kwargs)
+        ndim = len(kernel_size) if not isinstance(kernel_size, int) else 1
+        self._channels = channels
+        self._in_channels = in_channels
+        self._kernel = _tup(kernel_size, ndim)
+        ndim = len(self._kernel)
+        self._stride = _tup(strides, ndim)
+        self._pad = _tup(padding, ndim)
+        self._dilate = _tup(dilation, ndim)
+        self._groups = groups
+        self._layout = layout
+        self._act = activation
+        wshape = self._weight_shape(in_channels)
+        self.weight = Parameter("weight", shape=wshape, dtype=dtype,
+                                init=weight_initializer,
+                                allow_deferred_init=True)
+        if use_bias:
+            self.bias = Parameter("bias", shape=(channels,), dtype=dtype,
+                                  init=init.create(bias_initializer)
+                                  if isinstance(bias_initializer, str)
+                                  else bias_initializer,
+                                  allow_deferred_init=True)
+        else:
+            self.bias = None
+
+    def _weight_shape(self, in_channels):
+        # OIHW: (num_filter, in_channels/groups, *kernel)
+        return (self._channels, in_channels // self._groups if in_channels
+                else 0) + self._kernel
+
+    def infer_shape(self, x):
+        in_channels = x.shape[1]
+        self._in_channels = in_channels
+        self.weight.shape = self._weight_shape(in_channels)
+        if self.bias is not None:
+            self.bias.shape = (self._channels,)
+
+    def forward(self, x):
+        ctx = x.context
+        out = invoke(self._op, x, self.weight.data(ctx),
+                     None if self.bias is None else self.bias.data(ctx),
+                     kernel=self._kernel, stride=self._stride,
+                     dilate=self._dilate, pad=self._pad,
+                     num_filter=self._channels, num_group=self._groups,
+                     no_bias=self.bias is None)
+        if self._act:
+            out = invoke("Activation", out, act_type=self._act)
+        return out
+
+    def __repr__(self):
+        return "%s(%s -> %s, kernel_size=%s, stride=%s, padding=%s)" % (
+            type(self).__name__, self._in_channels or None, self._channels,
+            self._kernel, self._stride, self._pad)
+
+
+class Conv1D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0, dilation=1,
+                 groups=1, layout="NCW", **kwargs):
+        super().__init__(channels, _tup(kernel_size, 1), strides, padding,
+                         dilation, groups, layout, **kwargs)
+
+
+class Conv2D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 dilation=(1, 1), groups=1, layout="NCHW", **kwargs):
+        super().__init__(channels, _tup(kernel_size, 2), strides, padding,
+                         dilation, groups, layout, **kwargs)
+
+
+class Conv3D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
+                 layout="NCDHW", **kwargs):
+        super().__init__(channels, _tup(kernel_size, 3), strides, padding,
+                         dilation, groups, layout, **kwargs)
+
+
+class _ConvTranspose(_Conv):
+    _op = "Deconvolution"
+
+    def __init__(self, channels, kernel_size, strides, padding, output_padding,
+                 dilation, groups, layout, **kwargs):
+        self._out_pad = None  # set after ndim known
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, **kwargs)
+        self._out_pad = _tup(output_padding, len(self._kernel))
+
+    def _weight_shape(self, in_channels):
+        # Deconvolution weight layout: (in_channels, channels/groups, *kernel)
+        return (in_channels if in_channels else 0,
+                self._channels // self._groups) + self._kernel
+
+    def forward(self, x):
+        ctx = x.context
+        out = invoke("Deconvolution", x, self.weight.data(ctx),
+                     None if self.bias is None else self.bias.data(ctx),
+                     kernel=self._kernel, stride=self._stride,
+                     dilate=self._dilate, pad=self._pad,
+                     adj=self._out_pad or (0,) * len(self._kernel),
+                     num_filter=self._channels, num_group=self._groups,
+                     no_bias=self.bias is None)
+        if self._act:
+            out = invoke("Activation", out, act_type=self._act)
+        return out
+
+
+class Conv1DTranspose(_ConvTranspose):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, layout="NCW",
+                 **kwargs):
+        super().__init__(channels, _tup(kernel_size, 1), strides, padding,
+                         output_padding, dilation, groups, layout, **kwargs)
+
+
+class Conv2DTranspose(_ConvTranspose):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 output_padding=(0, 0), dilation=(1, 1), groups=1,
+                 layout="NCHW", **kwargs):
+        super().__init__(channels, _tup(kernel_size, 2), strides, padding,
+                         output_padding, dilation, groups, layout, **kwargs)
+
+
+class Conv3DTranspose(_ConvTranspose):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), output_padding=(0, 0, 0),
+                 dilation=(1, 1, 1), groups=1, layout="NCDHW", **kwargs):
+        super().__init__(channels, _tup(kernel_size, 3), strides, padding,
+                         output_padding, dilation, groups, layout, **kwargs)
+
+
+class _Pooling(HybridBlock):
+    def __init__(self, pool_size, strides, padding, ceil_mode=False,
+                 global_pool=False, pool_type="max", layout=None,
+                 count_include_pad=True, **kwargs):
+        super().__init__(**kwargs)
+        self._kernel = pool_size
+        self._stride = strides if strides is not None else pool_size
+        self._pad = padding
+        self._ceil = ceil_mode
+        self._global = global_pool
+        self._type = pool_type
+        self._count_include_pad = count_include_pad
+
+    def forward(self, x):
+        return invoke("Pooling", x, kernel=self._kernel,
+                      pool_type=self._type, global_pool=self._global,
+                      stride=self._stride, pad=self._pad,
+                      pooling_convention="full" if self._ceil else "valid",
+                      count_include_pad=self._count_include_pad)
+
+    def __repr__(self):
+        return "%s(size=%s, stride=%s, padding=%s, ceil_mode=%s)" % (
+            type(self).__name__, self._kernel, self._stride, self._pad,
+            self._ceil)
+
+
+class MaxPool1D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, **kwargs):
+        super().__init__(_tup(pool_size, 1), None if strides is None else
+                         _tup(strides, 1), _tup(padding, 1), ceil_mode,
+                         **kwargs)
+
+
+class MaxPool2D(_Pooling):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False, **kwargs):
+        super().__init__(_tup(pool_size, 2), None if strides is None else
+                         _tup(strides, 2), _tup(padding, 2), ceil_mode,
+                         **kwargs)
+
+
+class MaxPool3D(_Pooling):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, **kwargs):
+        super().__init__(_tup(pool_size, 3), None if strides is None else
+                         _tup(strides, 3), _tup(padding, 3), ceil_mode,
+                         **kwargs)
+
+
+class AvgPool1D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, count_include_pad=True, **kwargs):
+        super().__init__(_tup(pool_size, 1), None if strides is None else
+                         _tup(strides, 1), _tup(padding, 1), ceil_mode,
+                         pool_type="avg", count_include_pad=count_include_pad,
+                         **kwargs)
+
+
+class AvgPool2D(_Pooling):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False, count_include_pad=True,
+                 **kwargs):
+        super().__init__(_tup(pool_size, 2), None if strides is None else
+                         _tup(strides, 2), _tup(padding, 2), ceil_mode,
+                         pool_type="avg", count_include_pad=count_include_pad,
+                         **kwargs)
+
+
+class AvgPool3D(_Pooling):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, count_include_pad=True,
+                 **kwargs):
+        super().__init__(_tup(pool_size, 3), None if strides is None else
+                         _tup(strides, 3), _tup(padding, 3), ceil_mode,
+                         pool_type="avg", count_include_pad=count_include_pad,
+                         **kwargs)
+
+
+class GlobalMaxPool1D(_Pooling):
+    def __init__(self, layout="NCW", **kwargs):
+        super().__init__((1,), None, (0,), global_pool=True, **kwargs)
+
+
+class GlobalMaxPool2D(_Pooling):
+    def __init__(self, layout="NCHW", **kwargs):
+        super().__init__((1, 1), None, (0, 0), global_pool=True, **kwargs)
+
+
+class GlobalMaxPool3D(_Pooling):
+    def __init__(self, layout="NCDHW", **kwargs):
+        super().__init__((1, 1, 1), None, (0, 0, 0), global_pool=True,
+                         **kwargs)
+
+
+class GlobalAvgPool1D(_Pooling):
+    def __init__(self, layout="NCW", **kwargs):
+        super().__init__((1,), None, (0,), global_pool=True, pool_type="avg",
+                         **kwargs)
+
+
+class GlobalAvgPool2D(_Pooling):
+    def __init__(self, layout="NCHW", **kwargs):
+        super().__init__((1, 1), None, (0, 0), global_pool=True,
+                         pool_type="avg", **kwargs)
+
+
+class GlobalAvgPool3D(_Pooling):
+    def __init__(self, layout="NCDHW", **kwargs):
+        super().__init__((1, 1, 1), None, (0, 0, 0), global_pool=True,
+                         pool_type="avg", **kwargs)
+
+
+class ReflectionPad2D(HybridBlock):
+    """Reference: nn.ReflectionPad2D (pad op with mode='reflect')."""
+
+    def __init__(self, padding=0, **kwargs):
+        super().__init__(**kwargs)
+        if isinstance(padding, int):
+            padding = (0, 0, 0, 0, padding, padding, padding, padding)
+        self._padding = tuple(padding)
+
+    def forward(self, x):
+        return invoke("pad", x, mode="reflect", pad_width=self._padding)

@@ -1,0 +1,248 @@
+"""Gluon Trainer.
+
+Reference: python/mxnet/gluon/trainer.py (class Trainer — _init_kvstore
+decision, step = allreduce_grads + update, grad-clipping split, save/load
+optimizer states).
+
+TPU-native: with one device the step is pure fused-op updates; with several
+contexts the gradient allreduce goes through kvstore ('device' default,
+'ici' when accelerator contexts are present — the reference picks 'device'
+vs 'nccl' the same way).  Pod-scale sharded training instead jits the whole
+step over a Mesh (mxnet_tpu.parallel.TrainStep) but keeps this class's API.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..base import MXNetError
+from .. import optimizer as opt
+from ..kvstore import create as kv_create
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device", compression_params=None,
+                 update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            param_list = [params[key] for key in sorted(list(params.keys()))]
+        elif isinstance(params, (list, tuple)):
+            param_list = list(params)
+        else:
+            raise ValueError(
+                "First argument must be a list or dict of Parameters, got %s"
+                % type(params))
+        self._params: List[Parameter] = []
+        self._param2idx: Dict[str, int] = {}
+        for i, param in enumerate(param_list):
+            if not isinstance(param, Parameter):
+                raise ValueError("First argument must contain Parameters, "
+                                 "got %s" % type(param))
+            self._param2idx[param.name] = i
+            self._params.append(param)
+            param._set_trainer = self
+        self._compression_params = compression_params
+        self._contexts = self._check_contexts()
+        optimizer_params = optimizer_params or {}
+        self._init_optimizer(optimizer, optimizer_params)
+        self._scale = self._optimizer.rescale_grad
+        self._kvstore_params = {"kvstore": kvstore,
+                                "update_on_kvstore": update_on_kvstore}
+        self._kv_initialized = False
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._params_to_init: List[Parameter] = []
+        self._reset_kvstore()
+
+    # -- setup -------------------------------------------------------------
+    def _check_contexts(self):
+        contexts = None
+        for param in self._params:
+            ctx = param.list_ctx()
+            assert contexts is None or contexts == ctx, \
+                "All Parameters must be initialized on the same set of " \
+                "contexts, but Parameter %s is initialized on %s while " \
+                "previous Parameters are initialized on %s" % (
+                    param.name, str(ctx), str(contexts))
+            contexts = ctx
+        return contexts
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            assert not optimizer_params, \
+                "optimizer_params must be None if optimizer is an Optimizer " \
+                "instance"
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer, param_dict=param_dict,
+                                         **optimizer_params)
+        self._updaters = [opt.get_updater(self._optimizer)
+                          for _ in self._contexts]
+
+    def _reset_kvstore(self):
+        self._kv_initialized = False
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._params_to_init = [p for p in self._params]
+
+    def _init_kvstore(self):
+        config = self._kvstore_params
+        kvstore = config["kvstore"]
+        update_on_kvstore = config["update_on_kvstore"]
+        if kvstore and len(self._contexts) > 1:
+            # pick 'ici' for accelerator contexts like the reference picks
+            # nccl/device for GPUs
+            if isinstance(kvstore, str):
+                if kvstore == "device" and \
+                        any(c.canonical_type == "tpu" for c in self._contexts):
+                    kvstore = "ici"
+                kv = kv_create(kvstore)
+            else:
+                kv = kvstore
+            self._kvstore = kv
+            if update_on_kvstore is None:
+                update_on_kvstore = False
+            if update_on_kvstore:
+                kv.set_optimizer(self._optimizer)
+            self._update_on_kvstore = update_on_kvstore
+        else:
+            self._kvstore = None
+            self._update_on_kvstore = False
+        self._kv_initialized = True
+
+    def _init_params(self):
+        assert self._kv_initialized
+        if self._kvstore is None:
+            self._params_to_init = []
+            return
+        for i, param in enumerate(self._params):
+            if param._deferred_init is not None:
+                continue
+            self._kvstore.init(i, param.data(self._contexts[0]))
+        self._params_to_init = [p for p in self._params_to_init
+                                if p._deferred_init is not None]
+
+    # -- properties --------------------------------------------------------
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    # -- the step ----------------------------------------------------------
+    def step(self, batch_size, ignore_stale_grad=False):
+        """allreduce + update (reference: Trainer.step)."""
+        rescale_grad = self._scale / batch_size
+        self._check_and_rescale_grad(rescale_grad)
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        """Separate allreduce for gradient manipulation between reduce and
+        update (reference: Trainer.allreduce_grads)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        assert not (self._kvstore and self._update_on_kvstore), \
+            "allreduce_grads() when parameters are updated on kvstore " \
+            "is not supported. Try setting `update_on_kvstore` to False."
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            grads = param.list_grad()
+            if len(grads) <= 1 and not self._update_on_kvstore:
+                continue
+            self._kvstore.push(i, grads)
+            if self._update_on_kvstore:
+                # server-side optimizer ran on push: fetch updated weights
+                self._kvstore.pull(i, param.list_data())
+            else:
+                self._kvstore.pull(i, grads)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        """Separate update step (reference: Trainer.update)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        assert not (self._kvstore and self._update_on_kvstore), \
+            "update() when parameters are updated on kvstore " \
+            "is not supported. Try setting `update_on_kvstore` to False."
+        self._check_and_rescale_grad(self._scale / batch_size)
+        self._update(ignore_stale_grad)
+
+    def _check_and_rescale_grad(self, scale):
+        if self._update_on_kvstore and self._kv_initialized and \
+                self._optimizer.rescale_grad != scale:
+            raise UserWarning(
+                "Possible change in the `batch_size` from previous `step` "
+                "detected. Optimizer gradient normalizing factor will not "
+                "change w.r.t new batch_size when update_on_kvstore=True")
+        self._optimizer.rescale_grad = scale
+        for upd in self._updaters:
+            upd.optimizer.rescale_grad = scale
+
+    def _update(self, ignore_stale_grad=False):
+        if self._update_on_kvstore:
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            for upd, arr, grad in zip(self._updaters, param.list_data(),
+                                      param.list_grad()):
+                upd(i, grad, arr)
+
+    # -- states ------------------------------------------------------------
+    def save_states(self, fname):
+        """Pickled updater states incl. momentum buffers (reference:
+        Trainer.save_states)."""
+        assert self._optimizer is not None
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        if self._update_on_kvstore:
+            assert not self._params_to_init, \
+                "Cannot save trainer states when some parameters are not " \
+                "yet initialized in kvstore."
+            self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
+        else:
+            with open(fname, "wb") as fout:
+                fout.write(self._updaters[0].get_states(dump_optimizer=True))
+
+    def load_states(self, fname):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+            self._optimizer = self._kvstore._updater.optimizer
+        else:
+            with open(fname, "rb") as f:
+                states = f.read()
+            for updater in self._updaters:
+                updater.set_states(states)
+                updater.optimizer = self._updaters[0].optimizer
+            self._optimizer = self._updaters[0].optimizer
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        self._optimizer.param_dict = param_dict

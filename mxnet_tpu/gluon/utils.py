@@ -1,0 +1,111 @@
+"""Gluon utilities.
+
+Reference: python/mxnet/gluon/utils.py (split_data, split_and_load,
+clip_global_norm, check_sha1, download).
+"""
+from __future__ import annotations
+
+import math
+import os
+from typing import List, Optional
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..device import Context
+from ..ndarray.ndarray import NDArray
+from .. import ndarray as nd
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download"]
+
+
+def split_data(data: NDArray, num_slice: int, batch_axis: int = 0,
+               even_split: bool = True):
+    """Split along batch_axis into num_slice chunks (reference:
+    gluon.utils.split_data)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            "data with shape %s cannot be evenly split into %d slices along "
+            "axis %d. Use a batch size that's a multiple of %d or set "
+            "even_split=False to allow uneven partitioning of data." % (
+                str(data.shape), num_slice, batch_axis, num_slice))
+    if num_slice == 1:
+        return [data]
+    if not even_split and size < num_slice:
+        # fewer samples than slices: return `size` single-sample slices
+        # (reference behavior — callers get fewer slices, never empty ones)
+        num_slice = size
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(data.slice_axis(batch_axis, begin, end))
+    return slices
+
+
+def split_and_load(data, ctx_list: List[Context], batch_axis: int = 0,
+                   even_split: bool = True):
+    """Split and move each slice to its context (reference:
+    gluon.utils.split_and_load) — one host→HBM transfer per chip."""
+    if not isinstance(data, NDArray):
+        data = nd.array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays: List[NDArray], max_norm: float,
+                     check_isfinite: bool = True):
+    """Rescale so the concatenated grad's L2 norm ≤ max_norm (reference:
+    gluon.utils.clip_global_norm)."""
+    if not arrays:
+        raise ValueError("arrays must not be empty")
+
+    def _norm(array):
+        x = array.reshape(-1)
+        return (x * x).sum()
+
+    total = _norm(arrays[0])
+    for arr in arrays[1:]:
+        total = total + _norm(arr)
+    total_norm = float(total.sqrt().asscalar())
+    if check_isfinite and not math.isfinite(total_norm):
+        import warnings
+        warnings.warn(UserWarning("nan or inf is detected. Clipping results "
+                                  "will be undefined."), stacklevel=2)
+    scale = max_norm / (total_norm + 1e-8)
+    if scale < 1.0:
+        for arr in arrays:
+            arr *= scale
+    return total_norm
+
+
+def check_sha1(filename: str, sha1_hash: str) -> bool:
+    import hashlib
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url: str, path: Optional[str] = None, overwrite: bool = False,
+             sha1_hash: Optional[str] = None, retries: int = 5,
+             verify_ssl: bool = True) -> str:
+    """Reference: gluon.utils.download.  This environment has no network
+    egress; only already-downloaded files resolve."""
+    fname = path if path and not os.path.isdir(path) else os.path.join(
+        path or ".", url.split("/")[-1])
+    if os.path.exists(fname) and not overwrite and (
+            sha1_hash is None or check_sha1(fname, sha1_hash)):
+        return fname
+    raise MXNetError(
+        "download(%s): no network egress in this environment and file %s "
+        "is not present locally" % (url, fname))

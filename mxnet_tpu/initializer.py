@@ -1,0 +1,332 @@
+"""Weight initializers.
+
+Reference: python/mxnet/initializer.py (class Initializer, class Xavier,
+class MSRAPrelu, class Orthogonal, class Mixed, InitDesc attr-driven
+dispatch, the string/alias registry used by ``init="xavier"``).
+
+TPU-native: initializers produce values with ``jax.random`` under the global
+seed plumbing (mx.random.seed) and are materialized straight into HBM via the
+NDArray constructor — no host round trip for large params.
+"""
+from __future__ import annotations
+
+import json
+import math
+import re
+from typing import Optional
+
+import numpy as _np
+import jax.numpy as jnp
+
+from .base import MXNetError
+
+__all__ = ["InitDesc", "Initializer", "register", "create", "Zero", "One",
+           "Constant", "Uniform", "Normal", "Orthogonal", "Xavier",
+           "MSRAPrelu", "Bilinear", "LSTMBias", "Mixed", "Load"]
+
+_INIT_REGISTRY = {}
+
+
+def register(klass):
+    """Register an initializer under its lowercased class name
+    (reference: mx.init registry via ``Initializer.register``)."""
+    _INIT_REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+class InitDesc(str):
+    """Name + attrs descriptor passed to the initializer (reference:
+    python/mxnet/initializer.py InitDesc)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+class Initializer:
+    """Base initializer; call with (name, arr) — dispatches on name suffix
+    like the reference (`_init_weight`, `_init_bias`, ...)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+        self._verbose = False
+        self._print_func = None
+
+    def set_verbosity(self, verbose=False, print_func=None):
+        self._verbose = verbose
+        self._print_func = print_func or (lambda x: None)
+        return self
+
+    def dumps(self):
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, desc, arr):
+        if not isinstance(desc, str):
+            raise TypeError("first argument must be a name string/InitDesc")
+        if isinstance(desc, InitDesc) and desc.attrs.get("__init__"):
+            create(desc.attrs["__init__"])._init_weight(desc, arr)
+            return
+        name = desc.lower()
+        if name.endswith("weight"):
+            self._init_weight(desc, arr)
+        elif name.endswith("bias"):
+            self._init_bias(desc, arr)
+        elif name.endswith("gamma"):
+            self._init_gamma(desc, arr)
+        elif name.endswith("beta"):
+            self._init_beta(desc, arr)
+        elif name.endswith("running_mean") or name.endswith("moving_mean"):
+            self._init_zero(desc, arr)
+        elif name.endswith("running_var") or name.endswith("moving_var"):
+            self._init_one(desc, arr)
+        elif name.endswith("moving_inv_var"):
+            self._init_zero(desc, arr)
+        elif name.endswith("moving_avg"):
+            self._init_zero(desc, arr)
+        else:
+            self._init_default(desc, arr)
+
+    # -- leaf initializers -------------------------------------------------
+    def _init_zero(self, name, arr):
+        arr[:] = 0.0
+
+    def _init_one(self, name, arr):
+        arr[:] = 1.0
+
+    def _init_bias(self, name, arr):
+        arr[:] = 0.0
+
+    def _init_gamma(self, name, arr):
+        arr[:] = 1.0
+
+    def _init_beta(self, name, arr):
+        arr[:] = 0.0
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError("%s does not define _init_weight"
+                                  % type(self).__name__)
+
+    def _init_default(self, name, arr):
+        self._init_weight(name, arr)
+
+    def __repr__(self):
+        return "%s(%s)" % (self.__class__.__name__, self._kwargs)
+
+
+def create(init, **kwargs):
+    """Resolve an initializer from an instance, a name string, or a JSON
+    dump (reference: initializer registry + Initializer.dumps round trip)."""
+    if isinstance(init, Initializer):
+        return init
+    if callable(init) and not isinstance(init, str):
+        return init
+    if isinstance(init, str):
+        if init.startswith("["):  # JSON [name, kwargs]
+            name, kw = json.loads(init)
+            return _INIT_REGISTRY[name.lower()](**kw)
+        key = init.lower()
+        # MXNet registry names: 'zeros'/'ones' map to Zero/One
+        key = {"zeros": "zero", "ones": "one", "msra": "msraprelu",
+               "gaussian": "normal"}.get(key, key)
+        if key not in _INIT_REGISTRY:
+            raise MXNetError("unknown initializer %r (have: %s)"
+                             % (init, sorted(_INIT_REGISTRY)))
+        return _INIT_REGISTRY[key](**kwargs)
+    raise TypeError("cannot create initializer from %r" % (init,))
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, name, arr):
+        arr[:] = 0.0
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, name, arr):
+        arr[:] = 1.0
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, arr):
+        arr[:] = _np.asarray(self.value)
+
+
+@register
+class Uniform(Initializer):
+    """U(-scale, scale) (reference default scale 0.07)."""
+
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        arr[:] = _np.random.uniform(-self.scale, self.scale, arr.shape)
+
+
+@register
+class Normal(Initializer):
+    """N(0, sigma) (reference default sigma 0.01)."""
+
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, arr):
+        arr[:] = _np.random.normal(0.0, self.sigma, arr.shape)
+
+
+@register
+class Orthogonal(Initializer):
+    """Orthogonal matrix init (reference: Orthogonal, Saxe et al.)."""
+
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, name, arr):
+        nout = arr.shape[0]
+        nin = int(_np.prod(arr.shape[1:])) if len(arr.shape) > 1 else 1
+        if self.rand_type == "uniform":
+            tmp = _np.random.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = _np.random.normal(0.0, 1.0, (nout, nin))
+        u, _, v = _np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else v
+        arr[:] = (self.scale * q).reshape(arr.shape)
+
+
+@register
+class Xavier(Initializer):
+    """Xavier/Glorot (reference: class Xavier; magnitude default 3)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise ValueError("Xavier requires at least 2D weight, got %s for %s"
+                             % (shape, name))
+        if len(shape) > 2:
+            hw_scale = _np.prod(shape[2:])
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        factor = 1.0
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise ValueError("Incorrect factor type")
+        scale = math.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            arr[:] = _np.random.uniform(-scale, scale, shape)
+        elif self.rnd_type == "gaussian":
+            arr[:] = _np.random.normal(0, scale, shape)
+        else:
+            raise ValueError("Unknown random type")
+
+
+@register
+class MSRAPrelu(Xavier):
+    """Kaiming/He init (reference: class MSRAPrelu)."""
+
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel (reference: class Bilinear, used by
+    Deconvolution-based UpSampling)."""
+
+    def _init_weight(self, name, arr):
+        weight = _np.zeros(arr.shape, dtype=_np.float32).reshape(-1)
+        shape = arr.shape
+        f = _np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(_np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr[:] = weight.reshape(shape)
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias init (reference: class LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        v = _np.zeros(arr.shape)
+        num_hidden = arr.shape[0] // 4
+        v[num_hidden:2 * num_hidden] = self.forget_bias  # i, f, c, o gate order
+        arr[:] = v
+
+
+@register
+class Mixed(Initializer):
+    """Pattern→initializer dispatch (reference: class Mixed)."""
+
+    def __init__(self, patterns=None, initializers=None):
+        super().__init__()
+        patterns = patterns or []
+        initializers = initializers or []
+        if len(patterns) != len(initializers):
+            raise ValueError("patterns and initializers must pair up")
+        self.map = [(re.compile(p), create(i)) for p, i in
+                    zip(patterns, initializers)]
+
+    def __call__(self, name, arr):
+        for pat, init in self.map:
+            if pat.match(str(name)):
+                init(name, arr)
+                return
+        raise ValueError("Parameter %s did not match any pattern; add '.*' "
+                         "as a catch-all" % name)
+
+
+@register
+class Load(Initializer):
+    """Init from a dict of arrays, falling back to default_init
+    (reference: class Load used by model loading paths)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        super().__init__()
+        self.param = {k[4:] if k.startswith("arg:") or k.startswith("aux:")
+                      else k: v for k, v in param.items()}
+        self.default_init = default_init
+
+    def __call__(self, name, arr):
+        if name in self.param:
+            src = self.param[name]
+            src_np = src.asnumpy() if hasattr(src, "asnumpy") else _np.asarray(src)
+            if tuple(src_np.shape) != tuple(arr.shape):
+                raise ValueError("Parameter %s shape mismatch: %s vs %s"
+                                 % (name, src_np.shape, arr.shape))
+            arr[:] = src_np
+        else:
+            if self.default_init is None:
+                raise ValueError("Cannot init %s: not found and no default"
+                                 % name)
+            self.default_init(name, arr)

@@ -1,0 +1,5 @@
+"""KVStore package (reference: python/mxnet/kvstore/)."""
+from .kvstore import KVStore, create
+from .kvstore import KVStoreLocal, KVStoreDevice, KVStoreICI
+
+__all__ = ["KVStore", "create", "KVStoreLocal", "KVStoreDevice", "KVStoreICI"]

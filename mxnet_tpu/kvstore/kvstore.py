@@ -1,0 +1,235 @@
+"""KVStore: data-parallel gradient aggregation.
+
+Reference: include/mxnet/kvstore.h (KVStore::Create), src/kvstore/
+kvstore_local.h (KVStoreLocal — CPU reduce), comm.h (CommDevice — on-device
+tree reduce), kvstore_nccl.h (KVStoreNCCL), kvstore_dist.h (parameter
+server), python/mxnet/kvstore/kvstore.py.
+
+TPU-native (SURVEY.md §5.8): the NCCL/ps-lite transports are replaced by
+XLA collectives.
+  * ``local`` / ``device`` — single-process multi-device reduce+broadcast
+    (the reference's CommCPU/CommDevice); here one jitted sum over the
+    device copies, placed back per device.
+  * ``ici``   — the north-star store: allreduce = `psum` over a
+    `jax.sharding.Mesh` data-parallel axis; rides ICI within a slice and
+    DCN across slices (XLA inserts the hierarchy).  Multi-host ranks come
+    from `jax.distributed` (mxnet_tpu.parallel.init_process_group).
+  * ``dist_sync``/``dist_async``/``nccl`` — accepted as aliases that map
+    onto the collective path (the PS apparatus is deliberately not ported;
+    SURVEY.md §2.1 KVStore: dist row).
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Callable, Dict, List, Optional
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..device import Context, cpu
+from ..ndarray.ndarray import NDArray
+from .. import ndarray as nd
+
+__all__ = ["KVStore", "create", "KVStoreLocal", "KVStoreDevice", "KVStoreICI"]
+
+
+def _key(k):
+    # int keys stay ints: the Trainer numbers params 0..n and the Updater's
+    # optimizer looks them up in int-keyed param_dict/lr_mult tables
+    return k if isinstance(k, int) else str(k)
+
+
+@jax.jit
+def _sum_arrays(arrays):
+    out = arrays[0]
+    for a in arrays[1:]:
+        out = out + a
+    return out
+
+
+class KVStore:
+    """Base interface (reference: python/mxnet/kvstore/kvstore.py)."""
+
+    def __init__(self):
+        self._store: Dict[str, NDArray] = {}
+        self._updater = None
+        self._optimizer = None
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def type(self) -> str:
+        raise NotImplementedError
+
+    @property
+    def rank(self) -> int:
+        return 0
+
+    @property
+    def num_workers(self) -> int:
+        return 1
+
+    # -- data path ---------------------------------------------------------
+    def init(self, key, value):
+        """Register initial value(s) (reference: KVStore.init)."""
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            if k in self._store:
+                continue
+            vv = v[0] if isinstance(v, (list, tuple)) else v
+            self._store[k] = vv.copy()
+
+    def push(self, key, value, priority=0):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            merged = self._reduce(v if isinstance(v, (list, tuple)) else [v])
+            stored = self._store.get(k)
+            if stored is None:
+                raise MXNetError("key %s has not been initialized" % k)
+            if self._updater is not None:
+                self._updater(k, merged, stored)
+            else:
+                stored._set_jax(merged.as_in_context(stored.context)._jax)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = self._normalize(key, out)
+        for k, o in zip(keys, outs):
+            stored = self._store[k]
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            for t in targets:
+                stored.copyto(t)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """Fused push+pull — THE data-parallel allreduce (reference:
+        MXKVStorePushPullEx; SURVEY.md §3.5)."""
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out, priority)
+
+    def broadcast(self, key, value, out=None, priority=0):
+        self.init(key, value)
+        if out is not None:
+            self.pull(key, out, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Dense fallback: TPU keeps RowSparse semantics via gather
+        (SURVEY.md sparse row); full rows pulled here."""
+        self.pull(key, out, priority)
+
+    # -- optimizer ---------------------------------------------------------
+    def set_optimizer(self, optimizer):
+        from ..optimizer import get_updater
+        self._optimizer = optimizer
+        self._updater = get_updater(optimizer)
+
+    def set_gradient_compression(self, compression_params):
+        # 2-bit PS compression is N/A on the collective path; bf16 grad
+        # compression arrives with parallel/ (gradient buckets).
+        pass
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        assert self._updater is not None, "Cannot save states for fused optimizer"
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        assert self._updater is not None, "Cannot load states for fused optimizer"
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    def _barrier(self):
+        pass
+
+    # -- helpers -----------------------------------------------------------
+    @staticmethod
+    def _normalize(key, value):
+        if isinstance(key, (list, tuple)):
+            return [_key(k) for k in key], list(value)
+        return [_key(key)], [value]
+
+    def _reduce(self, values: List[NDArray]) -> NDArray:
+        if len(values) == 1:
+            return values[0]
+        target = values[0].context
+        vals = [v._jax if v.context == target else
+                jax.device_put(v._jax, target.jax_device) for v in values]
+        return NDArray(_sum_arrays(vals), ctx=target)
+
+
+class KVStoreLocal(KVStore):
+    """Single-process store, reduce on first device (reference:
+    KVStoreLocal + CommCPU)."""
+
+    @property
+    def type(self):
+        return "local"
+
+
+class KVStoreDevice(KVStoreLocal):
+    """Reduce on device (reference: CommDevice tree reduce; tree/ring
+    topology choice belongs to XLA now)."""
+
+    @property
+    def type(self):
+        return "device"
+
+
+class KVStoreICI(KVStoreLocal):
+    """Collective store over the TPU mesh (reference role: KVStoreNCCL;
+    SURVEY.md §5.8 `kvstore='ici'`).
+
+    Single-host: device-copies are reduced with one jitted sum (XLA emits
+    ICI transfers).  Multi-host: rank/num_workers come from jax.distributed
+    and the reduce runs as a psum inside the sharded train step
+    (mxnet_tpu.parallel); this object keeps the KVStore API so Trainer code
+    is unchanged.
+    """
+
+    def __init__(self):
+        super().__init__()
+        self._rank = 0
+        self._size = 1
+        try:
+            import jax.distributed  # noqa: F401
+            self._rank = jax.process_index()
+            self._size = jax.process_count()
+        except Exception:
+            pass
+
+    @property
+    def type(self):
+        return "ici"
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._size
+
+
+_STORES = {
+    "local": KVStoreLocal,
+    "device": KVStoreDevice,
+    "ici": KVStoreICI,
+    # collective path covers these transports on TPU:
+    "nccl": KVStoreICI,
+    "dist": KVStoreICI,
+    "dist_sync": KVStoreICI,
+    "dist_device_sync": KVStoreICI,
+    "dist_async": KVStoreICI,
+    "horovod": KVStoreICI,
+}
+
+
+def create(name: str = "local") -> KVStore:
+    """Reference: kvstore.create / KVStore::Create."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    key = name.lower()
+    if key not in _STORES:
+        raise MXNetError("unknown KVStore type %r (have %s)"
+                         % (name, sorted(_STORES)))
+    return _STORES[key]()

@@ -1,0 +1,481 @@
+"""Evaluation metrics.
+
+Reference: python/mxnet/metric.py (class EvalMetric, Accuracy, TopKAccuracy,
+F1, MCC, Perplexity, MAE, MSE, RMSE, CrossEntropy, NegativeLogLikelihood,
+PearsonCorrelation, Loss, CompositeEvalMetric, CustomMetric, np(), create()).
+Gluon 2.x re-exports this surface as gluon.metric.
+
+Accumulation happens on host in NumPy (metrics are tiny); predictions are
+fetched with asnumpy() — an explicit sync point, same as the reference.
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence, Union
+
+import numpy as _np
+
+__all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
+           "F1", "MCC", "Perplexity", "MAE", "MSE", "RMSE", "CrossEntropy",
+           "NegativeLogLikelihood", "PearsonCorrelation", "Loss",
+           "CustomMetric", "np", "create", "check_label_shapes"]
+
+_METRIC_REGISTRY = {}
+
+
+def register(klass):
+    _METRIC_REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def _as_numpy(x):
+    if hasattr(x, "asnumpy"):
+        return x.asnumpy()
+    return _np.asarray(x)
+
+
+def check_label_shapes(labels, preds, shape: bool = False):
+    """Reference: metric.check_label_shapes."""
+    if not shape:
+        n_label, n_pred = len(labels), len(preds)
+    else:
+        n_label = labels.shape[0]
+        n_pred = preds.shape[0]
+    if n_label != n_pred:
+        raise ValueError("Shape of labels %d does not match shape of "
+                         "predictions %d" % (n_label, n_pred))
+
+
+class EvalMetric:
+    """Base accumulator (reference: class EvalMetric)."""
+
+    def __init__(self, name, output_names=None, label_names=None, **kwargs):
+        self.name = str(name)
+        self.output_names = output_names
+        self.label_names = label_names
+        self._kwargs = kwargs
+        self.reset()
+
+    def __str__(self):
+        return "EvalMetric: {}".format(dict(self.get_name_value()))
+
+    def get_config(self):
+        config = dict(self._kwargs)
+        config.update({"metric": self.__class__.__name__, "name": self.name,
+                       "output_names": self.output_names,
+                       "label_names": self.label_names})
+        return config
+
+    def update_dict(self, label, pred):
+        if self.output_names is not None:
+            pred = [pred[name] for name in self.output_names]
+        else:
+            pred = list(pred.values())
+        if self.label_names is not None:
+            label = [label[name] for name in self.label_names]
+        else:
+            label = list(label.values())
+        self.update(label, pred)
+
+    def update(self, labels, preds):
+        raise NotImplementedError()
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, self.sum_metric / self.num_inst)
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name = [name]
+        if not isinstance(value, list):
+            value = [value]
+        return list(zip(name, value))
+
+
+@register
+class CompositeEvalMetric(EvalMetric):
+    """Manage multiple metrics as one (reference: CompositeEvalMetric)."""
+
+    def __init__(self, metrics=None, name="composite", output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names, label_names)
+        self.metrics = [create(m) for m in (metrics or [])]
+
+    def add(self, metric):
+        self.metrics.append(create(metric))
+
+    def get_metric(self, index):
+        return self.metrics[index]
+
+    def update(self, labels, preds):
+        for metric in self.metrics:
+            metric.update(labels, preds)
+
+    def reset(self):
+        for metric in getattr(self, "metrics", []):
+            metric.reset()
+
+    def get(self):
+        names, values = [], []
+        for metric in self.metrics:
+            name, value = metric.get()
+            names.append(name) if isinstance(name, str) else names.extend(name)
+            values.append(value) if not isinstance(value, list) \
+                else values.extend(value)
+        return (names, values)
+
+
+@register
+class Accuracy(EvalMetric):
+    def __init__(self, axis=1, name="accuracy", output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names, label_names, axis=axis)
+        self.axis = axis
+
+    def update(self, labels, preds):
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            pred = _as_numpy(pred)
+            label = _as_numpy(label)
+            if pred.ndim > label.ndim:
+                pred = _np.argmax(pred, axis=self.axis)
+            pred = pred.astype(_np.int64).ravel()
+            label = label.astype(_np.int64).ravel()
+            check_label_shapes(label, pred, shape=True)
+            self.sum_metric += float((pred == label).sum())
+            self.num_inst += len(label)
+
+
+@register
+class TopKAccuracy(EvalMetric):
+    def __init__(self, top_k=1, name="top_k_accuracy", output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names, label_names, top_k=top_k)
+        self.top_k = top_k
+        assert top_k > 1, "use Accuracy for top_k=1"
+        self.name += "_%d" % top_k
+
+    def update(self, labels, preds):
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            pred = _as_numpy(pred)
+            label = _as_numpy(label).astype(_np.int64)
+            assert pred.ndim <= 2, "Predictions should be no more than 2 dims"
+            topk = _np.argsort(pred.astype(_np.float64), axis=-1)
+            num_classes = pred.shape[-1]
+            depth = min(self.top_k, num_classes)
+            if pred.ndim == 1:
+                self.sum_metric += float(
+                    (topk[-depth:] == label).any())
+                self.num_inst += 1
+            else:
+                for k in range(1, depth + 1):
+                    self.sum_metric += float(
+                        (topk[:, -k] == label.ravel()).sum())
+                self.num_inst += label.shape[0]
+
+
+@register
+class F1(EvalMetric):
+    """Binary F1 (reference: metric.F1, average='macro'|'micro')."""
+
+    def __init__(self, name="f1", output_names=None, label_names=None,
+                 average="macro"):
+        self.average = average
+        self._tp = self._fp = self._fn = 0.0
+        self._scores: List[float] = []
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        for label, pred in zip(labels, preds):
+            pred = _as_numpy(pred)
+            label = _as_numpy(label).astype(_np.int64).ravel()
+            if pred.ndim > 1 and pred.shape[-1] > 1:
+                pred = _np.argmax(pred, axis=-1).ravel()
+            else:
+                pred = (pred.ravel() > 0.5).astype(_np.int64)
+            tp = float(((pred == 1) & (label == 1)).sum())
+            fp = float(((pred == 1) & (label == 0)).sum())
+            fn = float(((pred == 0) & (label == 1)).sum())
+            if self.average == "micro":
+                self._tp += tp
+                self._fp += fp
+                self._fn += fn
+            else:
+                prec = tp / (tp + fp) if tp + fp else 0.0
+                rec = tp / (tp + fn) if tp + fn else 0.0
+                f1 = 2 * prec * rec / (prec + rec) if prec + rec else 0.0
+                self._scores.append(f1)
+            self.num_inst += 1
+
+    def reset(self):
+        self._tp = self._fp = self._fn = 0.0
+        self._scores = []
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        if self.average == "micro":
+            prec = self._tp / (self._tp + self._fp) if self._tp + self._fp else 0
+            rec = self._tp / (self._tp + self._fn) if self._tp + self._fn else 0
+            f1 = 2 * prec * rec / (prec + rec) if prec + rec else 0.0
+            return (self.name, f1)
+        return (self.name, sum(self._scores) / len(self._scores))
+
+
+@register
+class MCC(EvalMetric):
+    """Matthews correlation coefficient (reference: metric.MCC)."""
+
+    def __init__(self, name="mcc", output_names=None, label_names=None):
+        self._tp = self._fp = self._tn = self._fn = 0.0
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        for label, pred in zip(labels, preds):
+            pred = _as_numpy(pred)
+            label = _as_numpy(label).astype(_np.int64).ravel()
+            if pred.ndim > 1 and pred.shape[-1] > 1:
+                pred = _np.argmax(pred, axis=-1).ravel()
+            else:
+                pred = (pred.ravel() > 0.5).astype(_np.int64)
+            self._tp += float(((pred == 1) & (label == 1)).sum())
+            self._fp += float(((pred == 1) & (label == 0)).sum())
+            self._tn += float(((pred == 0) & (label == 0)).sum())
+            self._fn += float(((pred == 0) & (label == 1)).sum())
+            self.num_inst += len(label)
+
+    def reset(self):
+        self._tp = self._fp = self._tn = self._fn = 0.0
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        tp, fp, tn, fn = self._tp, self._fp, self._tn, self._fn
+        denom = math.sqrt((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
+        return (self.name, ((tp * tn) - (fp * fn)) / denom if denom else 0.0)
+
+
+@register
+class Perplexity(EvalMetric):
+    """exp(mean NLL) (reference: metric.Perplexity; ignore_label skips
+    padding tokens — the PTB LM eval path)."""
+
+    def __init__(self, ignore_label=None, axis=-1, name="perplexity",
+                 output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names,
+                         ignore_label=ignore_label, axis=axis)
+        self.ignore_label = ignore_label
+        self.axis = axis
+
+    def update(self, labels, preds):
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        loss = 0.0
+        num = 0
+        for label, pred in zip(labels, preds):
+            pred = _as_numpy(pred).astype(_np.float64)
+            label = _as_numpy(label).astype(_np.int64).reshape(-1)
+            pred = pred.reshape(-1, pred.shape[-1])
+            probs = pred[_np.arange(label.shape[0]), label]
+            if self.ignore_label is not None:
+                ignore = (label == self.ignore_label)
+                probs = _np.where(ignore, 1.0, probs)
+                num -= int(ignore.sum())
+            loss -= _np.sum(_np.log(_np.maximum(1e-10, probs)))
+            num += label.shape[0]
+        self.sum_metric += loss
+        self.num_inst += num
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, math.exp(self.sum_metric / self.num_inst))
+
+
+@register
+class MAE(EvalMetric):
+    def __init__(self, name="mae", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        for label, pred in zip(labels, preds):
+            label = _as_numpy(label)
+            pred = _as_numpy(pred)
+            if len(label.shape) == 1:
+                label = label.reshape(label.shape[0], 1)
+            if len(pred.shape) == 1:
+                pred = pred.reshape(pred.shape[0], 1)
+            self.sum_metric += float(_np.abs(label - pred).mean())
+            self.num_inst += 1
+
+
+@register
+class MSE(EvalMetric):
+    def __init__(self, name="mse", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        for label, pred in zip(labels, preds):
+            label = _as_numpy(label)
+            pred = _as_numpy(pred)
+            if len(label.shape) == 1:
+                label = label.reshape(label.shape[0], 1)
+            if len(pred.shape) == 1:
+                pred = pred.reshape(pred.shape[0], 1)
+            self.sum_metric += float(((label - pred) ** 2).mean())
+            self.num_inst += 1
+
+
+@register
+class RMSE(MSE):
+    def __init__(self, name="rmse", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, math.sqrt(self.sum_metric / self.num_inst))
+
+
+@register
+class CrossEntropy(EvalMetric):
+    def __init__(self, eps=1e-12, name="cross-entropy", output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names, label_names, eps=eps)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        for label, pred in zip(labels, preds):
+            label = _as_numpy(label).ravel()
+            pred = _as_numpy(pred)
+            assert label.shape[0] == pred.shape[0]
+            prob = pred[_np.arange(label.shape[0]), label.astype(_np.int64)]
+            self.sum_metric += float((-_np.log(prob + self.eps)).sum())
+            self.num_inst += label.shape[0]
+
+
+@register
+class NegativeLogLikelihood(CrossEntropy):
+    def __init__(self, eps=1e-12, name="nll-loss", output_names=None,
+                 label_names=None):
+        super().__init__(eps, name, output_names, label_names)
+
+
+@register
+class PearsonCorrelation(EvalMetric):
+    def __init__(self, name="pearsonr", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        for label, pred in zip(labels, preds):
+            label = _as_numpy(label).ravel()
+            pred = _as_numpy(pred).ravel()
+            self.sum_metric += float(_np.corrcoef(pred, label)[0, 1])
+            self.num_inst += 1
+
+
+@register
+class Loss(EvalMetric):
+    """Mean of a loss output stream (reference: metric.Loss)."""
+
+    def __init__(self, name="loss", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, _, preds):
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        for pred in preds:
+            loss = float(_as_numpy(pred).sum())
+            self.sum_metric += loss
+            self.num_inst += int(_np.prod(_as_numpy(pred).shape))
+
+
+@register
+class CustomMetric(EvalMetric):
+    def __init__(self, feval, name=None, allow_extra_outputs=False,
+                 output_names=None, label_names=None):
+        name = name or getattr(feval, "__name__", "custom")
+        if name.startswith("<"):
+            name = "custom(%s)" % name
+        super().__init__(name, output_names, label_names)
+        self._feval = feval
+        self._allow_extra_outputs = allow_extra_outputs
+
+    def update(self, labels, preds):
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        if not self._allow_extra_outputs:
+            check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            reval = self._feval(_as_numpy(label), _as_numpy(pred))
+            if isinstance(reval, tuple):
+                num_inst, sum_metric = reval
+                self.sum_metric += sum_metric
+                self.num_inst += num_inst
+            else:
+                self.sum_metric += reval
+                self.num_inst += 1
+
+
+def np(numpy_feval, name=None, allow_extra_outputs=False):
+    """Wrap a numpy feval into a metric (reference: metric.np)."""
+
+    def feval(label, pred):
+        return numpy_feval(label, pred)
+
+    feval.__name__ = numpy_feval.__name__
+    return CustomMetric(feval, name, allow_extra_outputs)
+
+
+def create(metric, *args, **kwargs):
+    """Reference: metric.create — by name, callable, list, or instance."""
+    if callable(metric) and not isinstance(metric, type):
+        return CustomMetric(metric, *args, **kwargs)
+    if isinstance(metric, CompositeEvalMetric):
+        return metric
+    if isinstance(metric, EvalMetric):
+        return metric
+    if isinstance(metric, (list, tuple)):
+        composite = CompositeEvalMetric()
+        for child in metric:
+            composite.add(create(child, *args, **kwargs))
+        return composite
+    if isinstance(metric, str):
+        key = metric.lower()
+        aliases = {"acc": "accuracy", "ce": "crossentropy",
+                   "nll_loss": "negativeloglikelihood",
+                   "top_k_accuracy": "topkaccuracy", "top_k_acc": "topkaccuracy",
+                   "pearson_correlation": "pearsoncorrelation"}
+        key = aliases.get(key, key)
+        if key in _METRIC_REGISTRY:
+            return _METRIC_REGISTRY[key](*args, **kwargs)
+    if isinstance(metric, type) and issubclass(metric, EvalMetric):
+        return metric(*args, **kwargs)
+    raise ValueError("Metric must be a callable, name, or EvalMetric; got %r"
+                     % (metric,))

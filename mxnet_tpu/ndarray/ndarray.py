@@ -219,6 +219,13 @@ class NDArray:
         a = self.asnumpy()
         return a.astype(dtype) if dtype is not None else a
 
+    # pickling (reference: NDArray is picklable via its binary serialization;
+    # used by Trainer.save_states / kvstore set_optimizer)
+    def __reduce__(self):
+        return (_unpickle_ndarray, (self.asnumpy(), str(self.dtype)
+                                    if self.dtype != jnp.bfloat16 else
+                                    "bfloat16"))
+
     # dlpack bridge (reference: NDArray::ToDLPack / FromDLPack)
     def __dlpack__(self, stream=None):
         return self._jax.__dlpack__()
@@ -719,6 +726,11 @@ def _wrap_outputs(op, outs, ctx):
     o = NDArray(outs, ctx=ctx)
     engine.maybe_sync(o._jax)
     return o
+
+
+def _unpickle_ndarray(value: _np.ndarray, dtype: str) -> NDArray:
+    dt = jnp.bfloat16 if dtype == "bfloat16" else _np.dtype(dtype)
+    return NDArray(jnp.asarray(value, dtype=dt))
 
 
 def from_jax(value, ctx: Optional[Context] = None) -> NDArray:

@@ -10,4 +10,5 @@ from . import elemwise    # noqa: F401
 from . import reduce      # noqa: F401
 from . import matrix      # noqa: F401
 from . import nn          # noqa: F401
-from . import random      # noqa: F401
+from . import random     # noqa: F401
+from . import optimizer  # noqa: F401

@@ -398,3 +398,80 @@ def _mha(q, k, v, num_heads=1, scaled=True, mask=None, causal=False):
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
     return out.transpose(0, 2, 1, 3).reshape(B, Tq, HD)
+
+
+# ---------------------------------------------------------------------------
+# CTC loss (reference: src/operator/nn/ctc_loss.cc — warp-ctc/cuDNN CTC).
+# TPU-native: the alpha (forward-variable) recursion is a lax.scan over time
+# with log-sum-exp accumulation — static shapes, differentiable by autodiff,
+# no cuDNN dependency.  Blank label index 0 (MXNet blank_label='first').
+# ---------------------------------------------------------------------------
+
+
+@register("CTCLoss", aliases=["ctc_loss", "_contrib_CTCLoss", "_contrib_ctc_loss"])
+def _ctc_loss(pred, label, data_lengths=None, label_lengths=None,
+              blank_label="first"):
+    """pred: (T, N, C) activations (softmax applied internally, like the
+    reference).  blank_label='first': blank = class 0, labels 1..C-1,
+    0-padded.  blank_label='last': blank = class C-1, labels 0..C-2,
+    padded with -1 — remapped onto the 'first' layout by rolling the class
+    axis so one recursion serves both."""
+    T, N, C = pred.shape
+    L = label.shape[1]
+    S = 2 * L + 1
+    logp = jax.nn.log_softmax(pred.astype(jnp.float32), axis=-1)
+    label = label.astype(jnp.int32)
+    if blank_label == "last":
+        # move blank channel C-1 to the front and shift labels up by one
+        logp = jnp.concatenate([logp[..., -1:], logp[..., :-1]], axis=-1)
+        if label_lengths is None:
+            lab_len = jnp.sum((label >= 0).astype(jnp.int32), axis=1)
+        else:
+            lab_len = label_lengths.astype(jnp.int32)
+        label = jnp.where(label >= 0, label + 1, 0)
+    elif label_lengths is None:
+        # infer: count of non-zero entries (0 is blank ⇒ cannot be a label)
+        lab_len = jnp.sum((label != 0).astype(jnp.int32), axis=1)
+    else:
+        lab_len = label_lengths.astype(jnp.int32)
+    if data_lengths is None:
+        seq_len = jnp.full((N,), T, jnp.int32)
+    else:
+        seq_len = data_lengths.astype(jnp.int32)
+
+    # extended sequence: blank, l1, blank, l2, ..., blank  → shape (N, S)
+    ext = jnp.zeros((N, S), jnp.int32)
+    ext = ext.at[:, 1::2].set(label)
+    # transition-2 allowed where ext[s] != blank and ext[s] != ext[s-2]
+    ext_shift2 = jnp.pad(ext[:, :-2], ((0, 0), (2, 0)), constant_values=-1)
+    allow2 = (ext != 0) & (ext != ext_shift2)          # (N, S)
+
+    neg_inf = jnp.float32(-1e30)
+    pos = jnp.arange(S)
+    alpha0 = jnp.where(pos[None, :] < 2,
+                       jnp.take_along_axis(logp[0], ext, axis=-1), neg_inf)
+    alpha0 = jnp.where((pos[None, :] == 1) & (lab_len[:, None] == 0),
+                       neg_inf, alpha0)
+
+    def step(alpha, t):
+        a1 = jnp.pad(alpha[:, :-1], ((0, 0), (1, 0)), constant_values=neg_inf)
+        a2 = jnp.pad(alpha[:, :-2], ((0, 0), (2, 0)), constant_values=neg_inf)
+        a2 = jnp.where(allow2, a2, neg_inf)
+        m = jnp.maximum(jnp.maximum(alpha, a1), a2)
+        new = m + jnp.log(jnp.exp(alpha - m) + jnp.exp(a1 - m) +
+                          jnp.exp(a2 - m))
+        new = new + jnp.take_along_axis(logp[t], ext, axis=-1)
+        # past each sequence's length the alphas freeze
+        new = jnp.where((t < seq_len)[:, None], new, alpha)
+        return new, None
+
+    alpha, _ = lax.scan(step, alpha0, jnp.arange(1, T))
+    # final: logsumexp of positions 2*lab_len and 2*lab_len - 1
+    last = 2 * lab_len
+    a_last = jnp.take_along_axis(alpha, last[:, None], axis=1)[:, 0]
+    a_prev = jnp.take_along_axis(alpha, jnp.maximum(last - 1, 0)[:, None],
+                                 axis=1)[:, 0]
+    a_prev = jnp.where(lab_len > 0, a_prev, neg_inf)
+    m = jnp.maximum(a_last, a_prev)
+    ll = m + jnp.log(jnp.exp(a_last - m) + jnp.exp(a_prev - m))
+    return -ll

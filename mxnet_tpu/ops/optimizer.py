@@ -1,0 +1,204 @@
+"""Fused optimizer-update ops.
+
+Reference: src/operator/optimizer_op.cc (NNVM_REGISTER_OP(sgd_update),
+sgd_mom_update, mp_sgd_update, adam_update, nag_mom_update, rmsprop_update,
+rmspropalex_update, ftrl_update, signsgd_update, signum_update,
+lamb_update_phase1/lamb_update_phase2) and src/operator/contrib/adamw.cc.
+
+TPU-native: each update is one jitted XLA program that fuses the whole
+elementwise chain (the reference needed hand-fused CUDA kernels for this;
+XLA does it from the jnp composition).  In-place semantics use the registry's
+mutates_input (weight) + aux_writeback (state buffers) so Python-level
+NDArray handles update like the reference's mutable inputs.
+
+All ops clip gradients first when clip_gradient > 0 and apply
+rescale_grad — matching dmlc-param defaults.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _prep(grad, rescale_grad, clip_gradient, wd=0.0, weight=None):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    if wd and weight is not None:
+        g = g + wd * weight
+    return g
+
+
+@register("sgd_update", differentiable=False, mutates_input=0)
+def _sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                clip_gradient=-1.0, lazy_update=True):
+    g = _prep(grad, rescale_grad, clip_gradient, wd, weight)
+    return weight - lr * g.astype(weight.dtype)
+
+
+@register("sgd_mom_update", differentiable=False, num_outputs=2,
+          mutates_input=0, aux_writeback={1: 2})
+def _sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = _prep(grad, rescale_grad, clip_gradient, wd, weight)
+    new_mom = momentum * mom - lr * g.astype(mom.dtype)
+    return weight + new_mom.astype(weight.dtype), new_mom
+
+
+@register("mp_sgd_update", differentiable=False, num_outputs=2,
+          mutates_input=0, aux_writeback={1: 2})
+def _mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, lazy_update=True):
+    g = _prep(grad.astype(jnp.float32), rescale_grad, clip_gradient, wd,
+              weight32)
+    new_w32 = weight32 - lr * g
+    return new_w32.astype(weight.dtype), new_w32
+
+
+@register("mp_sgd_mom_update", differentiable=False, num_outputs=3,
+          mutates_input=0, aux_writeback={1: 2, 2: 3})
+def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                       wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                       lazy_update=True):
+    g = _prep(grad.astype(jnp.float32), rescale_grad, clip_gradient, wd,
+              weight32)
+    new_mom = momentum * mom - lr * g
+    new_w32 = weight32 + new_mom
+    return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+@register("nag_mom_update", differentiable=False, num_outputs=2,
+          mutates_input=0, aux_writeback={1: 2})
+def _nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient, wd, weight)
+    new_mom = momentum * mom + g.astype(mom.dtype)
+    update = momentum * new_mom + g.astype(mom.dtype)
+    return weight - lr * update.astype(weight.dtype), new_mom
+
+
+@register("adam_update", differentiable=False, num_outputs=3,
+          mutates_input=0, aux_writeback={1: 2, 2: 3})
+def _adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                 lazy_update=True):
+    g = _prep(grad, rescale_grad, clip_gradient, wd, weight).astype(mean.dtype)
+    new_mean = beta1 * mean + (1.0 - beta1) * g
+    new_var = beta2 * var + (1.0 - beta2) * g * g
+    update = lr * new_mean / (jnp.sqrt(new_var) + epsilon)
+    return weight - update.astype(weight.dtype), new_mean, new_var
+
+
+@register("adamw_update", aliases=["_adamw_update", "_contrib_adamw_update"],
+          differentiable=False, num_outputs=3, mutates_input=0,
+          aux_writeback={1: 2, 2: 3})
+def _adamw_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                  epsilon=1e-8, wd=0.0, eta=1.0, rescale_grad=1.0,
+                  clip_gradient=-1.0):
+    """Decoupled weight decay (reference: src/operator/contrib/adamw.cc)."""
+    g = _prep(grad, rescale_grad, clip_gradient).astype(mean.dtype)
+    new_mean = beta1 * mean + (1.0 - beta1) * g
+    new_var = beta2 * var + (1.0 - beta2) * g * g
+    update = eta * (lr * new_mean / (jnp.sqrt(new_var) + epsilon) +
+                    wd * weight.astype(mean.dtype))
+    return weight - update.astype(weight.dtype), new_mean, new_var
+
+
+@register("rmsprop_update", differentiable=False, num_outputs=2,
+          mutates_input=0, aux_writeback={1: 2})
+def _rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.9, epsilon=1e-8,
+                    wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                    clip_weights=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient, wd, weight).astype(n.dtype)
+    new_n = (1.0 - gamma1) * g * g + gamma1 * n
+    new_w = weight - (lr * g / jnp.sqrt(new_n + epsilon)).astype(weight.dtype)
+    if clip_weights is not None and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return new_w, new_n
+
+
+@register("rmspropalex_update", differentiable=False, num_outputs=4,
+          mutates_input=0, aux_writeback={1: 2, 2: 3, 3: 4})
+def _rmspropalex_update(weight, grad, n, g_buf, delta, lr=0.001, gamma1=0.95,
+                        gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                        clip_gradient=-1.0, clip_weights=-1.0):
+    """Centered RMSProp with momentum (Graves 2013; reference:
+    rmspropalex_update)."""
+    g = _prep(grad, rescale_grad, clip_gradient, wd, weight).astype(n.dtype)
+    new_n = (1.0 - gamma1) * g * g + gamma1 * n
+    new_g = (1.0 - gamma1) * g + gamma1 * g_buf
+    new_delta = gamma2 * delta - lr * g / jnp.sqrt(new_n - new_g * new_g +
+                                                   epsilon)
+    new_w = weight + new_delta.astype(weight.dtype)
+    if clip_weights is not None and clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return new_w, new_n, new_g, new_delta
+
+
+@register("ftrl_update", differentiable=False, num_outputs=3,
+          mutates_input=0, aux_writeback={1: 2, 2: 3})
+def _ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
+                 rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient).astype(z.dtype)
+    new_n = n + g * g
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * weight.astype(z.dtype)
+    new_w = jnp.where(
+        jnp.abs(new_z) <= lamda1, jnp.zeros_like(new_z),
+        (jnp.sign(new_z) * lamda1 - new_z) /
+        ((beta + jnp.sqrt(new_n)) / lr + wd))
+    return new_w.astype(weight.dtype), new_z, new_n
+
+
+@register("signsgd_update", differentiable=False, mutates_input=0)
+def _signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                    clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    return weight - lr * (jnp.sign(g) + wd * weight).astype(weight.dtype)
+
+
+@register("signum_update", differentiable=False, num_outputs=2,
+          mutates_input=0, aux_writeback={1: 2})
+def _signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    g = _prep(grad, rescale_grad, clip_gradient, wd, weight)
+    new_mom = momentum * mom - (1.0 - momentum) * g.astype(mom.dtype)
+    new_w = (1.0 - lr * wd_lh) * weight + lr * jnp.sign(new_mom).astype(weight.dtype)
+    return new_w, new_mom
+
+
+@register("lamb_update_phase1", differentiable=False, num_outputs=3,
+          mutates_input=None, aux_writeback={1: 2, 2: 3})
+def _lamb_phase1(grad, weight, mean, var, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, t=1, bias_correction=True, wd=0.0,
+                 rescale_grad=1.0, clip_gradient=-1.0):
+    """Phase 1 emits the raw update direction; phase 2 applies the trust
+    ratio (reference: src/operator/optimizer_op.cc lamb_update_phase1)."""
+    g = _prep(grad, rescale_grad, clip_gradient).astype(mean.dtype)
+    new_mean = beta1 * mean + (1.0 - beta1) * g
+    new_var = beta2 * var + (1.0 - beta2) * g * g
+    if bias_correction:
+        mean_hat = new_mean / (1.0 - beta1 ** t)
+        var_hat = new_var / (1.0 - beta2 ** t)
+    else:
+        mean_hat, var_hat = new_mean, new_var
+    update = mean_hat / (jnp.sqrt(var_hat) + epsilon) + \
+        wd * weight.astype(mean.dtype)
+    return update, new_mean, new_var
+
+
+@register("lamb_update_phase2", differentiable=False, mutates_input=0)
+def _lamb_phase2(weight, g_update, r1=None, r2=None, lr=0.01,
+                 lower_bound=-1.0, upper_bound=-1.0):
+    if r1 is None:
+        r1 = jnp.sqrt(jnp.sum(jnp.square(weight.astype(jnp.float32))))
+    if r2 is None:
+        r2 = jnp.sqrt(jnp.sum(jnp.square(g_update.astype(jnp.float32))))
+    if lower_bound is not None and lower_bound > 0:
+        r1 = jnp.maximum(r1, lower_bound)
+    if upper_bound is not None and upper_bound > 0:
+        r1 = jnp.minimum(r1, upper_bound)
+    ratio = jnp.where((r1 > 0) & (r2 > 0), r1 / r2, 1.0)
+    return weight - (lr * ratio * g_update).astype(weight.dtype)

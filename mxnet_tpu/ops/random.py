@@ -37,8 +37,34 @@ def seed(seed_val: int) -> None:
 
 def next_key() -> jax.Array:
     st = _root()
+    # Inside a hybridize/jit trace the key must be a *traced input*, not a
+    # baked-in constant (else every cached-graph call would replay the same
+    # dropout mask).  trace_key_scope installs a holder whose key is a tracer;
+    # we split it so successive ops in one trace draw distinct streams.
+    holder = getattr(st, "trace_holder", None)
+    if holder is not None:
+        holder[0], sub = jax.random.split(holder[0])
+        return sub
     st.counter += 1
     return jax.random.fold_in(st.key, st.counter)
+
+
+class trace_key_scope:
+    """Route next_key() through a traced base key for the duration of a
+    hybridized-graph trace (see gluon/block.py CachedOp)."""
+
+    def __init__(self, key: jax.Array):
+        self._holder = [key]
+
+    def __enter__(self):
+        st = _root()
+        self._old = getattr(st, "trace_holder", None)
+        st.trace_holder = self._holder
+        return self
+
+    def __exit__(self, *exc):
+        _root().trace_holder = self._old
+        return False
 
 
 def _dt(dtype):

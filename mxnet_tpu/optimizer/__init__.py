@@ -1,0 +1,8 @@
+"""Optimizer package (reference: python/mxnet/optimizer/)."""
+from .optimizer import (Optimizer, Updater, get_updater, register, create,
+                        SGD, NAG, Adam, AdamW, RMSProp, AdaGrad, AdaDelta,
+                        Ftrl, LAMB, LARS, Signum, SignSGD, DCASGD, Test)
+
+__all__ = ["Optimizer", "Updater", "get_updater", "register", "create",
+           "SGD", "NAG", "Adam", "AdamW", "RMSProp", "AdaGrad", "AdaDelta",
+           "Ftrl", "LAMB", "LARS", "Signum", "SignSGD", "DCASGD", "Test"]

@@ -1,0 +1,643 @@
+"""Optimizers.
+
+Reference: python/mxnet/optimizer/optimizer.py [v1.x] / per-file [2.x]
+(class Optimizer — registry, lr/wd mults, num_update bookkeeping,
+create_state, update_multi_precision; SGD, NAG, Adam, RMSProp, AdaGrad,
+AdaDelta, Ftrl, LAMB, LARS, Signum, DCASGD, Test; get_updater for the
+kvstore server path).
+
+TPU-native: every update dispatches one fused jitted op from
+ops/optimizer.py (the reference's hand-written CUDA kernels in
+src/operator/optimizer_op.cc become XLA-fused elementwise chains).
+Multi-precision keeps an fp32 master copy when the weight is bf16/fp16
+(reference: MP_SGD kernels; SURVEY.md AMP row).
+"""
+from __future__ import annotations
+
+import math
+import pickle
+import warnings
+from typing import Any, Dict, Optional
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, invoke
+from .. import ndarray as nd
+from ..lr_scheduler import LRScheduler
+
+__all__ = ["Optimizer", "Updater", "get_updater", "register", "create"]
+
+
+class Optimizer:
+    """Base optimizer (reference: class Optimizer)."""
+
+    opt_registry: Dict[str, type] = {}
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=None, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None, aggregate_num=0, use_fused_step=True):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate if learning_rate is not None else 0.01
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None and learning_rate is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._all_index_update_counts = {0: {}}
+        self._index_update_count = self._all_index_update_counts[0]
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.aggregate_num = aggregate_num
+        if param_idx2name is None:
+            param_idx2name = {}
+        assert isinstance(param_idx2name, dict), \
+            "param_idx2name should be a dict of param indexes to names."
+        self.idx2name = param_idx2name.copy()
+        self.sym_info = ()
+        self.param_dict = param_dict if param_dict else {}
+
+    # -- registry ----------------------------------------------------------
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        if name.lower() in Optimizer.opt_registry:
+            return Optimizer.opt_registry[name.lower()](**kwargs)
+        raise ValueError("Cannot find optimizer %s" % name)
+
+    # -- state -------------------------------------------------------------
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype in (_np.float16,
+                                                     _np.dtype("bfloat16")):
+            weight_master_copy = weight.astype(_np.float32)
+            return (self.create_state(index, weight_master_copy),
+                    weight_master_copy)
+        return self.create_state(index, weight)
+
+    # -- update ------------------------------------------------------------
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError()
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and isinstance(state, tuple) and \
+                len(state) == 2 and isinstance(state[1], NDArray) and \
+                state[1].dtype == _np.float32 and weight.dtype != _np.float32:
+            inner_state, weight32 = state
+            grad32 = grad.astype(_np.float32)
+            self.update(index, weight32, grad32, inner_state)
+            weight._set_jax(weight32._jax.astype(weight.dtype))
+        else:
+            self.update(index, weight, grad, state)
+
+    # list-form dispatch (2.x update signature takes lists)
+    def _normalize(self, indices, weights, grads, states):
+        if isinstance(weights, NDArray):
+            return [indices], [weights], [grads], [states]
+        return indices, weights, grads, states
+
+    # -- lr / wd plumbing --------------------------------------------------
+    @property
+    def learning_rate(self):
+        """Current base lr — scheduler value without per-param multipliers
+        (reference: Optimizer.learning_rate property)."""
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise UserWarning("LRScheduler of the optimizer has already been "
+                              "defined. Note that set_learning_rate can mutate "
+                              "the value of the learning rate of the optimizer "
+                              "only when the LRScheduler of the optimizer is "
+                              "undefined.")
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = args_lr_mult.copy()
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            is_weight = n.endswith("_weight") or n.endswith(".weight")
+            if not is_weight:
+                self.wd_mult[n] = 0.0
+        self.wd_mult.update(args_wd_mult)
+
+    def _set_current_context(self, device_id):
+        if device_id not in self._all_index_update_counts:
+            self._all_index_update_counts[device_id] = {}
+        self._index_update_count = self._all_index_update_counts[device_id]
+
+    def _update_count(self, index):
+        if not isinstance(index, (list, tuple)):
+            index = [index]
+        for idx in index:
+            if idx not in self._index_update_count:
+                self._index_update_count[idx] = self.begin_num_update
+            self._index_update_count[idx] += 1
+            self.num_update = max(self._index_update_count[idx],
+                                  self.num_update)
+
+    def _get_lrs(self, indices):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        lrs = [lr for _ in indices]
+        for i, index in enumerate(indices):
+            if index in self.param_dict:
+                lrs[i] *= self.param_dict[index].lr_mult
+            elif index in self.lr_mult:
+                lrs[i] *= self.lr_mult[index]
+            elif index in self.idx2name:
+                lrs[i] *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lrs
+
+    def _get_lr(self, index):
+        return self._get_lrs([index])[0]
+
+    def _get_wds(self, indices):
+        wds = [self.wd for _ in indices]
+        for i, index in enumerate(indices):
+            if index in self.param_dict:
+                wds[i] *= self.param_dict[index].wd_mult
+            elif index in self.wd_mult:
+                wds[i] *= self.wd_mult[index]
+            elif index in self.idx2name:
+                wds[i] *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wds
+
+    def _get_wd(self, index):
+        return self._get_wds([index])[0]
+
+    def __getstate__(self):
+        ret = self.__dict__.copy()
+        return ret
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+
+
+register = Optimizer.register
+
+
+def create(name, **kwargs):
+    """Reference: mx.optimizer.create."""
+    if isinstance(name, Optimizer):
+        return name
+    return Optimizer.create_optimizer(name, **kwargs)
+
+
+def _clip(value):
+    return -1.0 if value is None else value
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum (reference: optimizer.SGD → sgd_update /
+    sgd_mom_update / mp_* fused kernels)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=True,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kw = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                  clip_gradient=_clip(self.clip_gradient))
+        if state is not None:
+            invoke("sgd_mom_update", weight, grad, state,
+                   momentum=self.momentum, **kw)
+        else:
+            invoke("sgd_update", weight, grad, **kw)
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated SGD (reference: optimizer.NAG)."""
+
+    def __init__(self, learning_rate=0.1, momentum=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kw = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                  clip_gradient=_clip(self.clip_gradient))
+        if state is not None:
+            invoke("nag_mom_update", weight, grad, state,
+                   momentum=self.momentum, **kw)
+        else:
+            invoke("sgd_update", weight, grad, **kw)
+
+
+@register
+class Adam(Optimizer):
+    """Reference: optimizer.Adam → adam_update fused kernel, with the
+    bias-correction folded into lr like the reference."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr *= math.sqrt(coef2) / coef1
+        mean, var = state
+        invoke("adam_update", weight, grad, mean, var, lr=lr, wd=wd,
+               beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,
+               rescale_grad=self.rescale_grad,
+               clip_gradient=_clip(self.clip_gradient))
+
+
+@register
+class AdamW(Optimizer):
+    """Decoupled weight decay Adam (reference: contrib adamw_update;
+    2.x optimizer.AdamW)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, correct_bias=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.correct_bias = correct_bias
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        if self.correct_bias:
+            coef1 = 1.0 - self.beta1 ** t
+            coef2 = 1.0 - self.beta2 ** t
+            lr *= math.sqrt(coef2) / coef1
+        mean, var = state
+        invoke("adamw_update", weight, grad, mean, var, lr=lr, wd=wd, eta=1.0,
+               beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,
+               rescale_grad=self.rescale_grad,
+               clip_gradient=_clip(self.clip_gradient))
+
+
+@register
+class RMSProp(Optimizer):
+    """Reference: optimizer.RMSProp (centered=True → rmspropalex_update)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                    nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                    nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+        return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kw = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                  gamma1=self.gamma1, epsilon=self.epsilon,
+                  clip_gradient=_clip(self.clip_gradient),
+                  clip_weights=_clip(self.clip_weights))
+        if self.centered:
+            n, g, delta = state
+            invoke("rmspropalex_update", weight, grad, n, g, delta,
+                   gamma2=self.gamma2, **kw)
+        else:
+            invoke("rmsprop_update", weight, grad, state, **kw)
+
+
+@register
+class AdaGrad(Optimizer):
+    """Reference: optimizer.AdaGrad (history += g^2; w -= lr*g/sqrt(h+eps))."""
+
+    def __init__(self, learning_rate=0.01, eps=1e-7, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
+        # reference formula: history accumulates raw g^2; wd applied outside
+        # the adaptive denominator (optimizer.AdaGrad)
+        state += grad * grad
+        div = grad / (state + self.float_stable_eps).sqrt()
+        weight -= lr * (div + wd * weight)
+
+
+@register
+class AdaDelta(Optimizer):
+    """Reference: optimizer.AdaDelta."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context),
+                nd.zeros(weight.shape, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
+        grad = grad + wd * weight
+        acc_g, acc_delta = state
+        acc_g[:] = self.rho * acc_g + (1.0 - self.rho) * grad * grad
+        current_delta = ((acc_delta + self.epsilon).sqrt() /
+                         (acc_g + self.epsilon).sqrt()) * grad
+        acc_delta[:] = self.rho * acc_delta + \
+            (1.0 - self.rho) * current_delta * current_delta
+        weight -= current_delta
+
+
+@register
+class Ftrl(Optimizer):
+    """Reference: optimizer.Ftrl → ftrl_update."""
+
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context),   # z
+                nd.zeros(weight.shape, ctx=weight.context))   # n
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        z, n = state
+        invoke("ftrl_update", weight, grad, z, n, lr=lr, lamda1=self.lamda1,
+               beta=self.beta, wd=wd, rescale_grad=self.rescale_grad,
+               clip_gradient=_clip(self.clip_gradient))
+
+
+@register
+class LAMB(Optimizer):
+    """Layer-wise adaptive moments for large-batch BERT (reference:
+    optimizer.LAMB → lamb_update_phase1/2; SURVEY.md M6)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lower_bound = lower_bound
+        self.upper_bound = upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        mean, var = state
+        g_update = invoke("lamb_update_phase1", grad, weight, mean, var,
+                          beta1=self.beta1, beta2=self.beta2,
+                          epsilon=self.epsilon, t=t,
+                          bias_correction=self.bias_correction, wd=wd,
+                          rescale_grad=self.rescale_grad,
+                          clip_gradient=_clip(self.clip_gradient))
+        invoke("lamb_update_phase2", weight, g_update, lr=lr,
+               lower_bound=_clip(self.lower_bound),
+               upper_bound=_clip(self.upper_bound))
+
+
+@register
+class LARS(Optimizer):
+    """Layer-wise adaptive rate scaling (reference: optimizer.LARS)."""
+
+    def __init__(self, learning_rate=0.1, momentum=0.0, eta=0.001,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.eta = eta
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        w_norm = float(weight.norm().asscalar())
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        g_norm = float(g.norm().asscalar())
+        if w_norm > 0 and g_norm > 0:
+            lars_ratio = self.eta * w_norm / \
+                (g_norm + wd * w_norm + self.epsilon)
+            lr = lr * lars_ratio
+        kw = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                  clip_gradient=_clip(self.clip_gradient))
+        if state is not None:
+            invoke("sgd_mom_update", weight, grad, state,
+                   momentum=self.momentum, **kw)
+        else:
+            invoke("sgd_update", weight, grad, **kw)
+
+
+@register
+class SignSGD(Optimizer):
+    def __init__(self, learning_rate=0.01, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        invoke("signsgd_update", weight, grad, lr=self._get_lr(index),
+               wd=self._get_wd(index), rescale_grad=self.rescale_grad,
+               clip_gradient=_clip(self.clip_gradient))
+
+
+@register
+class Signum(Optimizer):
+    """Reference: optimizer.Signum → signum_update."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        if state is not None:
+            invoke("signum_update", weight, grad, state, lr=lr, wd=wd,
+                   momentum=self.momentum, wd_lh=self.wd_lh,
+                   rescale_grad=self.rescale_grad,
+                   clip_gradient=_clip(self.clip_gradient))
+        else:
+            invoke("signsgd_update", weight, grad, lr=lr, wd=wd,
+                   rescale_grad=self.rescale_grad,
+                   clip_gradient=_clip(self.clip_gradient))
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference: optimizer.DCASGD)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = grad.clip(-self.clip_gradient, self.clip_gradient)
+        mom, previous_weight = state
+        delta = -lr * (grad + wd * weight + self.lamda *
+                       grad * grad * (weight - previous_weight))
+        if mom is not None:
+            mom[:] = self.momentum * mom + delta
+            delta = mom
+        previous_weight[:] = weight
+        weight += delta
+
+
+@register
+class Test(Optimizer):
+    """Reference: optimizer.Test — used by test_optimizer comparisons."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        weight += grad * self.rescale_grad
+        state[:] = weight
+
+
+class Updater:
+    """Apply an optimizer to (index, grad, weight) triples — the kvstore
+    server-side hook (reference: get_updater / class Updater)."""
+
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states: Dict[Any, Any] = {}
+        self.states_synced: Dict[Any, bool] = {}
+        self.aggregate_updates = optimizer.aggregate_num > 0
+
+    def __call__(self, index, grad, weight):
+        if not isinstance(index, (list, tuple)):
+            index = [index]
+            grad = [grad]
+            weight = [weight]
+        for i, g, w in zip(index, grad, weight):
+            if i not in self.states:
+                self.states[i] = \
+                    self.optimizer.create_state_multi_precision(i, w)
+                self.states_synced[i] = True
+            self.optimizer.update_multi_precision(i, w, g, self.states[i])
+
+    def get_states(self, dump_optimizer=False):
+        if dump_optimizer:
+            return pickle.dumps((self.states, self.optimizer))
+        return pickle.dumps(self.states)
+
+    def set_states(self, states):
+        loaded = pickle.loads(states)
+        if isinstance(loaded, tuple) and len(loaded) == 2 and \
+                isinstance(loaded[1], Optimizer):
+            self.states, self.optimizer = loaded
+        else:
+            self.states = loaded
+        self.states_synced = dict.fromkeys(self.states.keys(), False)
+
+
+def get_updater(optimizer: Optimizer) -> Updater:
+    return Updater(optimizer)

@@ -1,0 +1,313 @@
+"""Gluon Block/Parameter/hybridize tests.
+
+Modeled on the reference's tests/python/unittest/test_gluon.py patterns:
+run imperative, hybridize, run again, assert identical outputs; parameter
+shape/save/load semantics; trainer updates.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def test_parameter_basic():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    p.initialize(init="xavier", ctx=mx.cpu())
+    assert p.name == "weight"
+    assert p.data().shape == (10, 10)
+    assert p.grad().shape == (10, 10)
+    assert len(p.list_data()) == 1
+    p.zero_grad()
+    assert np.allclose(p.grad().asnumpy(), 0)
+
+
+def test_parameter_invalid_access():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    with pytest.raises(RuntimeError):
+        p.data()
+
+
+def test_constant():
+    value = np.random.rand(4, 5)
+    c = gluon.Constant(value, name="const")
+    c.initialize()
+    assert c.grad_req == "null"
+    assert np.allclose(c.data().asnumpy(), value.astype(np.float32), atol=1e-6)
+
+
+def test_collect_params_structural_names():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(2))
+    params = net.collect_params()
+    names = set(params.keys())
+    assert "0.weight" in names and "1.bias" in names
+
+
+def test_collect_params_select():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(2))
+    only_w = net.collect_params(".*weight")
+    assert all(k.endswith("weight") for k in only_w.keys())
+    assert len(list(only_w.keys())) == 2
+
+
+def test_deferred_init_and_infer_shape():
+    d = nn.Dense(16)
+    d.initialize()
+    x = mx.nd.ones((2, 7))
+    y = d(x)
+    assert y.shape == (2, 16)
+    assert d.weight.shape == (16, 7)
+
+
+def test_uninitialized_raises():
+    d = nn.Dense(16)
+    x = mx.nd.ones((2, 7))
+    with pytest.raises(RuntimeError):
+        d(x)
+
+
+def test_hybridize_consistency():
+    """The canonical pattern: imperative output == hybridized output."""
+    np.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(16),
+            nn.LayerNorm(), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.randn(5, 12).astype(np.float32))
+    imp = net(x).asnumpy()
+    net.hybridize()
+    hyb1 = net(x).asnumpy()   # cache-building call
+    hyb2 = net(x).asnumpy()   # cached call
+    assert np.allclose(imp, hyb1, atol=1e-5)
+    assert np.allclose(imp, hyb2, atol=1e-5)
+
+
+def test_hybridize_backward_matches_imperative():
+    np.random.seed(0)
+
+    def build():
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, activation="tanh"), nn.Dense(3))
+        return net
+
+    x = mx.nd.array(np.random.randn(4, 6).astype(np.float32))
+    net1 = build()
+    net1.initialize(mx.init.Xavier())
+    with autograd.record():
+        loss1 = (net1(x) ** 2).sum()
+    loss1.backward()
+    g1 = net1[0].weight.grad().asnumpy()
+
+    net2 = build()
+    net2.load_dict = None
+    # copy params
+    net2.initialize(mx.init.Xavier())
+    for (_, a), (_, b) in zip(net2.collect_params().items(),
+                              net1.collect_params().items()):
+        a.set_data(b.data())
+    net2.hybridize()
+    with autograd.record():
+        loss2 = (net2(x) ** 2).sum()
+    loss2.backward()
+    g2 = net2[0].weight.grad().asnumpy()
+    assert np.allclose(float(loss1.asscalar()), float(loss2.asscalar()),
+                       rtol=1e-5)
+    assert np.allclose(g1, g2, atol=1e-5)
+
+
+def test_hybridize_retrace_on_new_shape():
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    net.hybridize()
+    y1 = net(mx.nd.ones((2, 3)))
+    y2 = net(mx.nd.ones((5, 3)))
+    assert y1.shape == (2, 4) and y2.shape == (5, 4)
+    assert len(net._cache) == 2  # one executable per input shape
+
+
+def test_batchnorm_running_stats_update():
+    bn = nn.BatchNorm(in_channels=3)
+    bn.initialize()
+    x = mx.nd.array(np.random.randn(4, 3, 5, 5).astype(np.float32) * 2 + 1)
+    before = bn.running_mean.data().asnumpy().copy()
+    with autograd.record():
+        bn(x)
+    after = bn.running_mean.data().asnumpy()
+    assert not np.allclose(before, after)
+    # eval mode: no update
+    before = after.copy()
+    bn(x)
+    assert np.allclose(before, bn.running_mean.data().asnumpy())
+
+
+def test_dropout_modes():
+    do = nn.Dropout(0.5)
+    x = mx.nd.ones((100, 100))
+    # eval: identity
+    assert np.allclose(do(x).asnumpy(), 1.0)
+    with autograd.record():
+        y = do(x).asnumpy()
+    assert (y == 0).any() and not np.allclose(y, 1.0)
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(3))
+    net.initialize(mx.init.Normal(0.1))
+    x = mx.nd.ones((2, 5))
+    ref = net(x).asnumpy()
+    fname = str(tmp_path / "net.params")
+    net.save_parameters(fname)
+
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(8, activation="relu"), nn.Dense(3))
+    net2.load_parameters(fname)
+    assert np.allclose(net2(x).asnumpy(), ref, atol=1e-6)
+
+
+def test_load_parameters_errors(tmp_path):
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    fname = str(tmp_path / "d.params")
+    net.save_parameters(fname)
+    other = nn.HybridSequential()
+    other.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    other.initialize()
+    with pytest.raises(AssertionError):
+        other.load_parameters(fname)
+    # bare-Dense names ("weight") are both missing-from and extra-to the
+    # Sequential's structural names ("0.weight") — need both flags
+    other.load_parameters(fname, allow_missing=True, ignore_extra=True)
+
+
+def test_trainer_sgd_matches_manual():
+    np.random.seed(0)
+    net = nn.Dense(1, in_units=4, use_bias=False)
+    net.initialize(mx.init.Normal(1.0))
+    w0 = net.weight.data().asnumpy().copy()
+    x = mx.nd.array(np.random.randn(8, 4).astype(np.float32))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    g = net.weight.grad().asnumpy().copy()
+    trainer.step(batch_size=8)
+    expect = w0 - 0.5 * (g / 8)
+    assert np.allclose(net.weight.data().asnumpy(), expect, atol=1e-6)
+
+
+def test_trainer_save_load_states(tmp_path):
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    x = mx.nd.ones((4, 3))
+    with autograd.record():
+        net(x).sum().backward()
+    trainer.step(4)
+    fname = str(tmp_path / "trainer.states")
+    trainer.save_states(fname)
+    trainer2 = gluon.Trainer(net.collect_params(), "sgd",
+                             {"learning_rate": 0.1, "momentum": 0.9})
+    trainer2.load_states(fname)
+    s1 = trainer._updaters[0].states
+    s2 = trainer2._updaters[0].states
+    assert set(s1.keys()) == set(s2.keys())
+
+
+def test_sequential_getitem_len():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(3), nn.Dense(2))
+    assert len(net) == 3
+    assert isinstance(net[1], nn.Dense)
+    assert len(net[1:]) == 2
+
+
+def test_grad_req_null_not_updated():
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    net.weight.grad_req = "null"
+    with autograd.record():
+        loss = net(mx.nd.ones((2, 3))).sum()
+    loss.backward()
+    assert net.bias.grad() is not None
+    with pytest.raises(RuntimeError):
+        net.weight.grad()
+
+
+def test_block_apply_and_cast():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net.initialize()
+    net.cast("float16")
+    assert net[0].weight.dtype == np.float16
+    out = net(mx.nd.ones((1, 3)).astype("float16"))
+    assert out.dtype == np.float16
+
+
+def test_v1_style_hybrid_forward():
+    """v1.x era: hybrid_forward(F, x, weight) with injected params."""
+
+    class Scale(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.scale = gluon.Parameter("scale", shape=(1,))
+
+        def hybrid_forward(self, F, x, scale):
+            return x * scale
+
+    blk = Scale()
+    blk.initialize(mx.init.Constant(3.0))
+    y = blk(mx.nd.ones((2, 2)))
+    assert np.allclose(y.asnumpy(), 3.0)
+    blk.hybridize()
+    y2 = blk(mx.nd.ones((2, 2)))
+    assert np.allclose(y2.asnumpy(), 3.0)
+
+
+def test_share_parameters():
+    a = nn.Dense(4, in_units=3)
+    a.initialize()
+    b = nn.Dense(4, in_units=3)
+    b.share_parameters(a.collect_params())
+    b.initialize()
+    assert a.weight is b.weight
+    x = mx.nd.ones((2, 3))
+    assert np.allclose(a(x).asnumpy(), b(x).asnumpy())
+
+
+def test_trainer_update_on_kvstore():
+    """update_on_kvstore=True: server-side optimizer updates weights and
+    they are pulled back into the parameters."""
+    np.random.seed(0)
+    ctxs = [mx.tpu(0), mx.tpu(1)]
+    net = nn.Dense(1, in_units=4, use_bias=False)
+    net.initialize(mx.init.Normal(1.0), ctx=ctxs)
+    w0 = net.weight.data(ctxs[0]).asnumpy().copy()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5}, kvstore="ici",
+                            update_on_kvstore=True)
+    x = mx.nd.array(np.random.randn(8, 4).astype(np.float32))
+    from mxnet_tpu.gluon.utils import split_and_load
+    parts = split_and_load(x, ctxs)
+    with autograd.record():
+        losses = [net(p).sum() for p in parts]
+    autograd.backward(losses)
+    grads = [net.weight.grad(c).asnumpy() for c in ctxs]
+    trainer.step(batch_size=8)
+    total_g = sum(grads)
+    expect = w0 - 0.5 * (total_g / 8)
+    for c in ctxs:
+        assert np.allclose(net.weight.data(c).asnumpy(), expect, atol=1e-5)
+
+
+def test_split_data_uneven_small():
+    from mxnet_tpu.gluon.utils import split_data
+    x = mx.nd.ones((2, 3))
+    parts = split_data(x, 4, even_split=False)
+    assert len(parts) == 2
+    assert all(p.shape[0] == 1 for p in parts)

@@ -1,0 +1,181 @@
+"""Loss tests vs numpy references (reference: tests/python/unittest/
+test_loss.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import loss as gloss
+
+
+def test_l2_loss():
+    pred = mx.nd.array(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    label = mx.nd.array(np.array([[1.5, 1.5], [3.0, 5.0]], np.float32))
+    l = gloss.L2Loss()(pred, label).asnumpy()
+    expect = 0.5 * ((np.array([[1, 2], [3, 4.]]) -
+                     np.array([[1.5, 1.5], [3, 5.]])) ** 2).mean(axis=1)
+    assert np.allclose(l, expect, atol=1e-6)
+
+
+def test_l1_loss():
+    pred = mx.nd.array([[1.0, -2.0]])
+    label = mx.nd.array([[0.0, 0.0]])
+    l = gloss.L1Loss()(pred, label).asnumpy()
+    assert np.allclose(l, [1.5])
+
+
+def test_softmax_ce_sparse_vs_dense():
+    np.random.seed(0)
+    logits = np.random.randn(6, 4).astype(np.float32)
+    labels = np.random.randint(0, 4, 6)
+    onehot = np.eye(4, dtype=np.float32)[labels]
+    l_sparse = gloss.SoftmaxCrossEntropyLoss()(
+        mx.nd.array(logits), mx.nd.array(labels)).asnumpy()
+    l_dense = gloss.SoftmaxCrossEntropyLoss(sparse_label=False)(
+        mx.nd.array(logits), mx.nd.array(onehot)).asnumpy()
+    logp = logits - logits.max(-1, keepdims=True)
+    logp = logp - np.log(np.exp(logp).sum(-1, keepdims=True))
+    expect = -logp[np.arange(6), labels]
+    assert np.allclose(l_sparse, expect, atol=1e-5)
+    assert np.allclose(l_dense, expect, atol=1e-5)
+
+
+def test_sigmoid_bce():
+    np.random.seed(0)
+    pred = np.random.randn(4, 3).astype(np.float32)
+    label = (np.random.rand(4, 3) > 0.5).astype(np.float32)
+    l = gloss.SigmoidBCELoss()(mx.nd.array(pred),
+                               mx.nd.array(label)).asnumpy()
+    p = 1 / (1 + np.exp(-pred))
+    expect = -(label * np.log(p) + (1 - label) * np.log(1 - p)).mean(axis=1)
+    assert np.allclose(l, expect, atol=1e-5)
+
+
+def test_kl_div():
+    np.random.seed(0)
+    logits = np.random.randn(3, 5).astype(np.float32)
+    target = np.random.rand(3, 5).astype(np.float32)
+    target /= target.sum(-1, keepdims=True)
+    logp = logits - logits.max(-1, keepdims=True)
+    logp = (logp - np.log(np.exp(logp).sum(-1, keepdims=True)))
+    l = gloss.KLDivLoss(from_logits=False)(
+        mx.nd.array(logits), mx.nd.array(target)).asnumpy()
+    expect = (target * (np.log(target + 1e-12) - logp)).mean(axis=-1)
+    assert np.allclose(l, expect, atol=1e-5)
+
+
+def test_huber_loss():
+    pred = mx.nd.array([0.0, 2.0])
+    label = mx.nd.array([0.5, 0.0])
+    l = gloss.HuberLoss(rho=1.0)(pred, label).asnumpy()
+    # |err|=0.5 -> 0.5*0.25 ; |err|=2 -> 2-0.5
+    assert np.allclose(l, [0.125, 1.5], atol=1e-6)
+
+
+def test_hinge_loss():
+    pred = mx.nd.array([[0.3], [-2.0]])
+    label = mx.nd.array([[1], [-1]])
+    l = gloss.HingeLoss()(pred, label).asnumpy()
+    assert np.allclose(l, [0.7, 0.0], atol=1e-6)
+
+
+def test_loss_backward_flows():
+    net_pred = mx.nd.array(np.random.randn(4, 3).astype(np.float32))
+    net_pred.attach_grad()
+    label = mx.nd.array([0, 1, 2, 0])
+    with autograd.record():
+        l = gloss.SoftmaxCrossEntropyLoss()(net_pred, label).sum()
+    l.backward()
+    g = net_pred.grad.asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+    # softmax CE grad = p - onehot
+    p = np.exp(net_pred.asnumpy())
+    p /= p.sum(-1, keepdims=True)
+    onehot = np.eye(3, dtype=np.float32)[[0, 1, 2, 0]]
+    assert np.allclose(g, (p - onehot), atol=1e-5)
+
+
+def test_ctc_loss_simple():
+    """CTC over a trivial 1-label problem matches hand computation."""
+    T, N, C = 3, 1, 3
+    # logits heavily favor label 1 at every step
+    logits = np.full((T, N, C), -5.0, np.float32)
+    logits[:, 0, 1] = 5.0
+    label = np.array([[1]], np.int32)
+    l = gloss.CTCLoss(layout="TNC")(mx.nd.array(logits),
+                                    mx.nd.array(label)).asnumpy()
+    assert l.shape == (1,)
+    assert np.isfinite(l).all()
+    # near-perfect prediction → small loss
+    assert l[0] < 1.0
+
+
+def test_ctc_loss_grad():
+    np.random.seed(0)
+    logits = mx.nd.array(np.random.randn(5, 2, 4).astype(np.float32))
+    logits.attach_grad()
+    label = mx.nd.array(np.array([[1, 2], [3, 0]], np.int32))
+    with autograd.record():
+        l = gloss.CTCLoss(layout="TNC")(logits, label).sum()
+    l.backward()
+    assert np.isfinite(logits.grad.asnumpy()).all()
+
+
+def test_triplet_loss():
+    a = mx.nd.array(np.zeros((2, 3), np.float32))
+    p = mx.nd.array(np.zeros((2, 3), np.float32))
+    n = mx.nd.array(np.ones((2, 3), np.float32))
+    l = gloss.TripletLoss(margin=1.0)(a, p, n).asnumpy()
+    # d(a,p)=0, d(a,n)=3 -> max(0, 0-3+1)=0
+    assert np.allclose(l, 0.0)
+    l2 = gloss.TripletLoss(margin=5.0)(a, p, n).asnumpy()
+    assert np.allclose(l2, 2.0)
+
+
+def test_metrics_accuracy():
+    from mxnet_tpu import metric
+    acc = metric.Accuracy()
+    pred = mx.nd.array([[0.9, 0.1], [0.2, 0.8], [0.7, 0.3]])
+    label = mx.nd.array([0, 1, 1])
+    acc.update([label], [pred])
+    name, value = acc.get()
+    assert name == "accuracy"
+    assert abs(value - 2.0 / 3) < 1e-6
+
+
+def test_metrics_composite_and_create():
+    from mxnet_tpu import metric
+    comp = metric.create(["accuracy", "mse"])
+    assert isinstance(comp, metric.CompositeEvalMetric)
+    topk = metric.create("top_k_accuracy", top_k=3)
+    assert isinstance(topk, metric.TopKAccuracy)
+
+
+def test_metric_perplexity():
+    from mxnet_tpu import metric
+    ppl = metric.Perplexity(ignore_label=None)
+    pred = mx.nd.array([[0.5, 0.5], [0.9, 0.1]])
+    label = mx.nd.array([0, 0])
+    ppl.update([label], [pred])
+    _, value = ppl.get()
+    expect = np.exp(-(np.log(0.5) + np.log(0.9)) / 2)
+    assert abs(value - expect) < 1e-5
+
+
+def test_ctc_blank_last_matches_first():
+    """blank_label='last' must equal 'first' under the channel remap."""
+    np.random.seed(1)
+    T, N, C = 6, 2, 5
+    logits_first = np.random.randn(T, N, C).astype(np.float32)
+    labels_first = np.array([[1, 2, 0], [3, 1, 4]], np.int32)  # 0-padded
+    l_first = mx.nd.ctc_loss(mx.nd.array(logits_first),
+                             mx.nd.array(labels_first)).asnumpy()
+    # same problem expressed in 'last' layout: blank channel moved to end,
+    # labels shifted down by 1, padding -1
+    logits_last = np.concatenate([logits_first[..., 1:],
+                                  logits_first[..., :1]], axis=-1)
+    labels_last = np.where(labels_first > 0, labels_first - 1, -1)
+    l_last = mx.nd.ctc_loss(mx.nd.array(logits_last),
+                            mx.nd.array(labels_last),
+                            blank_label="last").asnumpy()
+    assert np.allclose(l_first, l_last, atol=1e-4)

@@ -1,0 +1,201 @@
+"""Optimizer tests: each optimizer against a slow NumPy reference updater
+(the reference's tests/python/unittest/test_optimizer.py pattern)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt
+
+
+def _run(opt_instance, steps=3, shape=(5, 3), seed=0):
+    rng = np.random.RandomState(seed)
+    w0 = rng.randn(*shape).astype(np.float32)
+    grads = [rng.randn(*shape).astype(np.float32) for _ in range(steps)]
+    weight = mx.nd.array(w0.copy())
+    state = opt_instance.create_state(0, weight)
+    for g in grads:
+        opt_instance.update(0, weight, mx.nd.array(g), state)
+    return w0, grads, weight.asnumpy()
+
+
+def test_sgd_no_momentum():
+    o = opt.SGD(learning_rate=0.1, wd=0.0)
+    w0, grads, w = _run(o)
+    expect = w0.copy()
+    for g in grads:
+        expect -= 0.1 * g
+    assert np.allclose(w, expect, atol=1e-6)
+
+
+def test_sgd_momentum_wd():
+    lr, mom, wd = 0.1, 0.9, 0.01
+    o = opt.SGD(learning_rate=lr, momentum=mom, wd=wd)
+    w0, grads, w = _run(o)
+    expect = w0.copy()
+    m = np.zeros_like(expect)
+    for g in grads:
+        g = g + wd * expect
+        m = mom * m - lr * g
+        expect = expect + m
+    assert np.allclose(w, expect, atol=1e-5)
+
+
+def test_sgd_clip_gradient():
+    o = opt.SGD(learning_rate=1.0, clip_gradient=0.1)
+    w0, grads, w = _run(o)
+    expect = w0.copy()
+    for g in grads:
+        expect -= np.clip(g, -0.1, 0.1)
+    assert np.allclose(w, expect, atol=1e-6)
+
+
+def test_adam():
+    lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+    o = opt.Adam(learning_rate=lr, beta1=b1, beta2=b2, epsilon=eps)
+    w0, grads, w = _run(o)
+    expect = w0.copy()
+    m = np.zeros_like(expect)
+    v = np.zeros_like(expect)
+    for t, g in enumerate(grads, 1):
+        lr_t = lr * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        expect -= lr_t * m / (np.sqrt(v) + eps)
+    assert np.allclose(w, expect, atol=1e-5)
+
+
+def test_nag():
+    lr, mom = 0.1, 0.9
+    o = opt.NAG(learning_rate=lr, momentum=mom)
+    w0, grads, w = _run(o)
+    expect = w0.copy()
+    m = np.zeros_like(expect)
+    for g in grads:
+        m = mom * m + g
+        expect -= lr * (mom * m + g)
+    assert np.allclose(w, expect, atol=1e-5)
+
+
+def test_rmsprop():
+    lr, gamma1, eps = 0.01, 0.9, 1e-8
+    o = opt.RMSProp(learning_rate=lr, gamma1=gamma1, epsilon=eps)
+    w0, grads, w = _run(o)
+    expect = w0.copy()
+    n = np.zeros_like(expect)
+    for g in grads:
+        n = (1 - gamma1) * g * g + gamma1 * n
+        expect -= lr * g / np.sqrt(n + eps)
+    assert np.allclose(w, expect, atol=1e-5)
+
+
+def test_adagrad():
+    lr, eps, wd = 0.1, 1e-7, 0.01
+    o = opt.AdaGrad(learning_rate=lr, eps=eps, wd=wd)
+    w0, grads, w = _run(o)
+    expect = w0.copy()
+    h = np.zeros_like(expect)
+    for g in grads:
+        h += g * g
+        expect -= lr * (g / np.sqrt(h + eps) + wd * expect)
+    assert np.allclose(w, expect, atol=1e-5)
+
+
+def test_adamw_decoupled_wd():
+    lr, b1, b2, eps, wd = 0.01, 0.9, 0.999, 1e-8, 0.1
+    o = opt.AdamW(learning_rate=lr, beta1=b1, beta2=b2, epsilon=eps, wd=wd)
+    w0, grads, w = _run(o)
+    expect = w0.copy()
+    m = np.zeros_like(expect)
+    v = np.zeros_like(expect)
+    for t, g in enumerate(grads, 1):
+        lr_t = lr * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        expect -= lr_t * m / (np.sqrt(v) + eps) + wd * expect
+    assert np.allclose(w, expect, atol=1e-4)
+
+
+def test_ftrl():
+    o = opt.Ftrl(learning_rate=0.1, lamda1=0.01, beta=1.0)
+    w0, grads, w = _run(o)
+    lr, l1, beta = 0.1, 0.01, 1.0
+    expect = w0.copy()
+    z = np.zeros_like(expect)
+    n = np.zeros_like(expect)
+    for g in grads:
+        n_new = n + g * g
+        sigma = (np.sqrt(n_new) - np.sqrt(n)) / lr
+        z = z + g - sigma * expect
+        n = n_new
+        expect = np.where(np.abs(z) <= l1, 0.0,
+                          (np.sign(z) * l1 - z) / ((beta + np.sqrt(n)) / lr))
+    assert np.allclose(w, expect, atol=1e-5)
+
+
+def test_signum():
+    lr, mom = 0.01, 0.9
+    o = opt.Signum(learning_rate=lr, momentum=mom)
+    w0, grads, w = _run(o)
+    expect = w0.copy()
+    m = np.zeros_like(expect)
+    for g in grads:
+        m = mom * m - (1 - mom) * g
+        expect = expect + lr * np.sign(m)
+    assert np.allclose(w, expect, atol=1e-5)
+
+
+def test_lamb_runs_and_descends():
+    o = opt.LAMB(learning_rate=0.01)
+    w0, grads, w = _run(o, steps=5)
+    assert w.shape == w0.shape
+    assert not np.allclose(w, w0)
+    assert np.isfinite(w).all()
+
+
+def test_multi_precision_sgd():
+    o = opt.SGD(learning_rate=0.1, momentum=0.9, multi_precision=True)
+    weight = mx.nd.array(np.ones((4, 4), np.float16))
+    state = o.create_state_multi_precision(0, weight)
+    grad = mx.nd.array(np.full((4, 4), 0.5, np.float16))
+    o.update_multi_precision(0, weight, grad, state)
+    assert weight.dtype == np.float16
+    # master copy is fp32
+    assert state[1].dtype == np.float32
+    assert np.allclose(weight.asnumpy(), 1.0 - 0.05, atol=1e-3)
+
+
+def test_lr_scheduler_factor():
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+    sched = FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    o = opt.SGD(learning_rate=1.0, lr_scheduler=sched)
+    assert abs(sched(5) - 1.0) < 1e-9
+    assert abs(sched(11) - 0.5) < 1e-9
+
+
+def test_lr_scheduler_warmup():
+    from mxnet_tpu.lr_scheduler import CosineScheduler
+    sched = CosineScheduler(max_update=100, base_lr=1.0, warmup_steps=10)
+    assert sched(0) == 0.0
+    assert sched(5) == pytest.approx(0.5)
+    assert sched(10) == pytest.approx(1.0)
+    assert sched(100) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_optimizer_registry_create():
+    o = opt.create("adam", learning_rate=0.1)
+    assert isinstance(o, opt.Adam)
+    assert o.lr == 0.1
+    with pytest.raises(ValueError):
+        opt.create("nonexistent_optimizer")
+
+
+def test_updater_pickle_states():
+    o = opt.SGD(learning_rate=0.1, momentum=0.9)
+    upd = opt.get_updater(o)
+    w = mx.nd.ones((3, 3))
+    g = mx.nd.ones((3, 3))
+    upd(0, g, w)
+    blob = upd.get_states()
+    upd2 = opt.get_updater(opt.SGD(learning_rate=0.1, momentum=0.9))
+    upd2.set_states(blob)
+    assert 0 in upd2.states
