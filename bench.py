@@ -1,0 +1,80 @@
+"""Headline benchmark: ResNet-50 training throughput, images/sec/chip.
+
+BASELINE.json primary metric: "ResNet-50 ImageNet images/sec/chip".
+The driver runs this on the real chip each round (BENCH_r{N}.json).
+
+One full training step (fwd + loss + bwd + SGD-momentum update) compiled
+into a single XLA program via parallel.TrainStep on a 1-device mesh —
+the steady-state Gluon hybridize+Trainer path collapsed to its compute.
+bf16 compute (MXU-native) with fp32 master math in BN, synthetic data
+(the reference's benchmark_score.py / train_imagenet.py --benchmark 1
+pattern: measure compute throughput, not input pipeline).
+
+vs_baseline: MXNet-CUDA's classic published ResNet-50 fp16 throughput on
+one V100 (~1,41?0 img/s era-dependent; we use 1000 img/s as the nominal
+single-accelerator reference from the MXNet model-zoo era benchmarks,
+BASELINE.json `published` being empty).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_IMG_PER_SEC = 1000.0  # nominal MXNet-CUDA 1-GPU reference
+BATCH = 128
+WARMUP = 3
+ITERS = 10
+
+
+def main():
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.parallel import make_mesh, TrainStep
+
+    mx.random.seed(0)
+    np.random.seed(0)
+
+    with mx.Context("cpu"):
+        net = vision.resnet50_v1(classes=1000)
+        net.initialize(mx.init.Xavier())
+        net.cast("bfloat16")
+        net(mx.nd.zeros((1, 3, 224, 224), dtype="bfloat16"))  # deferred init
+
+    def loss_fn(logits, labels):
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        onehot = jax.nn.one_hot(labels, 1000, dtype=logp.dtype)
+        return -jnp.mean(jnp.sum(logp * onehot, axis=-1))
+
+    mesh = make_mesh(axes=("dp",), devices=jax.devices()[:1])
+    step = TrainStep(net, loss_fn, mesh, learning_rate=0.1, momentum=0.9)
+
+    x = jnp.asarray(np.random.randn(BATCH, 3, 224, 224), jnp.bfloat16)
+    y = jnp.asarray(np.random.randint(0, 1000, BATCH), jnp.int32)
+    xs, ys = step.shard_batch(x, y)
+
+    for _ in range(WARMUP):
+        loss = step(xs, ys)
+    jax.block_until_ready(loss._jax if hasattr(loss, "_jax") else loss)
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        loss = step(xs, ys)
+    jax.block_until_ready(loss._jax if hasattr(loss, "_jax") else loss)
+    dt = time.perf_counter() - t0
+
+    img_per_sec = BATCH * ITERS / dt
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(img_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

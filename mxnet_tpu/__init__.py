@@ -27,6 +27,7 @@ from . import lr_scheduler
 from . import optimizer
 from . import kvstore
 from . import gluon
+from . import parallel
 
 # Subsystems land milestone-by-milestone (SURVEY.md §7.1); this list grows
 # until it covers the reference's full `python/mxnet/__init__.py` surface.

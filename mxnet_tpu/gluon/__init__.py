@@ -12,8 +12,10 @@ from . import nn
 from . import loss
 from . import utils
 from . import data
+from . import rnn
+from . import model_zoo
 from .. import metric
 
 __all__ = ["Parameter", "Constant", "ParameterDict",
            "DeferredInitializationError", "Block", "HybridBlock",
-           "SymbolBlock", "Trainer", "nn", "loss", "utils", "data", "metric"]
+           "SymbolBlock", "Trainer", "nn", "loss", "utils", "data", "rnn", "model_zoo", "metric"]

@@ -537,6 +537,33 @@ class HybridBlock(Block):
 Block._FORWARD_PLACEHOLDERS = (Block.forward, HybridBlock.forward)
 
 
+def functionalize(block: Block):
+    """Lift a Block into (pure_fn, params) for direct jax use.
+
+    ``pure_fn(param_values, *inputs)`` runs the block's forward with the
+    given parameter arrays substituted (the CachedOp trace mechanism made
+    public) — the bridge the parallel/ package uses to pjit whole training
+    steps over a Mesh, and what __graft_entry__ exposes to the driver.
+    Parameters must be initialized; keys are structural names.
+    """
+    params = list(block.collect_params().items())
+
+    def pure_fn(param_values, *inputs, training=False):
+        overrides: Dict[int, NDArray] = {}
+        for name, p in params:
+            overrides[id(p)] = NDArray(param_values[name], ctx=cpu())
+        in_nds = [x if isinstance(x, NDArray) else NDArray(x, ctx=cpu())
+                  for x in inputs]
+        with _ParamOverrideScope(overrides), autograd._Scope(False, training):
+            out = block(*in_nds)
+        return jax.tree_util.tree_map(
+            lambda o: o._jax if isinstance(o, NDArray) else o, out,
+            is_leaf=lambda o: isinstance(o, NDArray))
+
+    param_values = {name: p.data()._jax for name, p in params}
+    return pure_fn, param_values
+
+
 class SymbolBlock(HybridBlock):
     """Runs a network from exported symbol.json + params (reference:
     gluon.SymbolBlock.imports).  Full graph-json execution lands with the

@@ -1,0 +1,384 @@
+"""Recurrent cells (explicit unrolled variants).
+
+Reference: python/mxnet/gluon/rnn/rnn_cell.py (RecurrentCell, RNNCell,
+LSTMCell, GRUCell, SequentialRNNCell, DropoutCell, BidirectionalCell,
+ResidualCell, ZoneoutCell; rnn_cell unroll semantics).
+
+Gate order matches the fused op (ops/rnn.py): LSTM i,f,g,o; GRU r,z,n —
+a cell unroll and the fused `RNN` op produce identical numbers, the
+reference's test_gluon_rnn consistency contract.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ... import ndarray as nd
+from ...ndarray.ndarray import NDArray, invoke
+from ... import initializer as init_mod
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell",
+           "GRUCell", "SequentialRNNCell", "DropoutCell", "BidirectionalCell",
+           "ResidualCell", "ZoneoutCell"]
+
+
+class RecurrentCell(HybridBlock):
+    """Base cell (reference: rnn.RecurrentCell)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+        for cell in self._children.values():
+            if isinstance(cell, RecurrentCell):
+                cell.reset()
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError()
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        assert not self._modified, \
+            "After applying modifier cells the base cell cannot be called " \
+            "directly. Call the modifier cell instead."
+        states = []
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            states.append(nd.zeros(**info, **kwargs) if func is None
+                          else func(**info, **kwargs))
+        return states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Unroll for `length` steps (reference: RecurrentCell.unroll)."""
+        self.reset()
+        axis = layout.find("T")
+        batch_axis = layout.find("N")
+        if isinstance(inputs, NDArray):
+            batch_size = inputs.shape[batch_axis]
+            seq = [inputs.slice_axis(axis, i, i + 1).squeeze(axis)
+                   for i in range(length)]
+        else:
+            seq = list(inputs)
+            batch_size = seq[0].shape[0]
+        if begin_state is None:
+            begin_state = self.begin_state(batch_size, ctx=seq[0].context)
+        states = begin_state
+        outputs = []
+        for i in range(length):
+            output, states = self(seq[i], states)
+            outputs.append(output)
+        if valid_length is not None:
+            masked = []
+            for i, out in enumerate(outputs):
+                mask = (valid_length > i).astype(out.dtype)
+                masked.append(out * mask.reshape((-1,) + (1,) * (out.ndim - 1)))
+            outputs = masked
+        if merge_outputs or merge_outputs is None and isinstance(inputs, NDArray):
+            outputs = nd.stack(outputs, axis=axis)
+        return outputs, states
+
+
+HybridRecurrentCell = RecurrentCell
+
+
+class RNNCell(RecurrentCell):
+    """Elman cell (reference: rnn.RNNCell)."""
+
+    def __init__(self, hidden_size, activation="tanh", input_size=0, **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._activation = activation
+        self._input_size = input_size
+        self.i2h_weight = Parameter("i2h_weight",
+                                    shape=(hidden_size, input_size),
+                                    allow_deferred_init=True)
+        self.h2h_weight = Parameter("h2h_weight",
+                                    shape=(hidden_size, hidden_size))
+        self.i2h_bias = Parameter("i2h_bias", shape=(hidden_size,),
+                                  init=init_mod.Zero())
+        self.h2h_bias = Parameter("h2h_bias", shape=(hidden_size,),
+                                  init=init_mod.Zero())
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (self._hidden_size, x.shape[-1])
+
+    def forward(self, inputs, states):
+        ctx = inputs.context
+        i2h = invoke("FullyConnected", inputs, self.i2h_weight.data(ctx),
+                     self.i2h_bias.data(ctx), num_hidden=self._hidden_size)
+        h2h = invoke("FullyConnected", states[0], self.h2h_weight.data(ctx),
+                     self.h2h_bias.data(ctx), num_hidden=self._hidden_size)
+        output = invoke("Activation", i2h + h2h, act_type=self._activation)
+        return output, [output]
+
+
+class LSTMCell(RecurrentCell):
+    """Reference: rnn.LSTMCell — gates i,f,g,o."""
+
+    def __init__(self, hidden_size, input_size=0,
+                 activation="tanh", recurrent_activation="sigmoid", **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        nh = hidden_size
+        self.i2h_weight = Parameter("i2h_weight", shape=(4 * nh, input_size),
+                                    allow_deferred_init=True)
+        self.h2h_weight = Parameter("h2h_weight", shape=(4 * nh, nh))
+        self.i2h_bias = Parameter("i2h_bias", shape=(4 * nh,),
+                                  init=init_mod.Zero())
+        self.h2h_bias = Parameter("h2h_bias", shape=(4 * nh,),
+                                  init=init_mod.Zero())
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)},
+                {"shape": (batch_size, self._hidden_size)}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (4 * self._hidden_size, x.shape[-1])
+
+    def forward(self, inputs, states):
+        ctx = inputs.context
+        nh = self._hidden_size
+        i2h = invoke("FullyConnected", inputs, self.i2h_weight.data(ctx),
+                     self.i2h_bias.data(ctx), num_hidden=4 * nh)
+        h2h = invoke("FullyConnected", states[0], self.h2h_weight.data(ctx),
+                     self.h2h_bias.data(ctx), num_hidden=4 * nh)
+        gates = i2h + h2h
+        slices = gates.split(num_outputs=4, axis=1)
+        in_gate = slices[0].sigmoid()
+        forget_gate = slices[1].sigmoid()
+        in_transform = slices[2].tanh()
+        out_gate = slices[3].sigmoid()
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * next_c.tanh()
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(RecurrentCell):
+    """Reference: rnn.GRUCell — gates r,z,n."""
+
+    def __init__(self, hidden_size, input_size=0, **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        nh = hidden_size
+        self.i2h_weight = Parameter("i2h_weight", shape=(3 * nh, input_size),
+                                    allow_deferred_init=True)
+        self.h2h_weight = Parameter("h2h_weight", shape=(3 * nh, nh))
+        self.i2h_bias = Parameter("i2h_bias", shape=(3 * nh,),
+                                  init=init_mod.Zero())
+        self.h2h_bias = Parameter("h2h_bias", shape=(3 * nh,),
+                                  init=init_mod.Zero())
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (3 * self._hidden_size, x.shape[-1])
+
+    def forward(self, inputs, states):
+        ctx = inputs.context
+        nh = self._hidden_size
+        prev_h = states[0]
+        i2h = invoke("FullyConnected", inputs, self.i2h_weight.data(ctx),
+                     self.i2h_bias.data(ctx), num_hidden=3 * nh)
+        h2h = invoke("FullyConnected", prev_h, self.h2h_weight.data(ctx),
+                     self.h2h_bias.data(ctx), num_hidden=3 * nh)
+        i2h_r, i2h_z, i2h_n = i2h.split(num_outputs=3, axis=1)
+        h2h_r, h2h_z, h2h_n = h2h.split(num_outputs=3, axis=1)
+        reset = (i2h_r + h2h_r).sigmoid()
+        update = (i2h_z + h2h_z).sigmoid()
+        next_h_tmp = (i2h_n + reset * h2h_n).tanh()
+        next_h = (1.0 - update) * next_h_tmp + update * prev_h
+        return next_h, [next_h]
+
+
+class SequentialRNNCell(RecurrentCell):
+    """Stack cells (reference: rnn.SequentialRNNCell)."""
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        infos = []
+        for cell in self._children.values():
+            infos.extend(cell.state_info(batch_size))
+        return infos
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+    def forward(self, inputs, states):
+        next_states = []
+        pos = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            state = states[pos:pos + n]
+            pos += n
+            inputs, state = cell(inputs, state)
+            next_states.extend(state)
+        return inputs, next_states
+
+
+class DropoutCell(RecurrentCell):
+    """Reference: rnn.DropoutCell."""
+
+    def __init__(self, rate, axes=(), **kwargs):
+        super().__init__(**kwargs)
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def forward(self, inputs, states):
+        from ... import autograd
+        if self._rate > 0 and autograd.is_training():
+            inputs = invoke("Dropout", inputs, p=self._rate,
+                            axes=tuple(self._axes), mode="training")
+        return inputs, states
+
+
+class _ModifierCell(RecurrentCell):
+    def __init__(self, base_cell):
+        super().__init__()
+        base_cell._modified = True
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        assert not self._modified
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(batch_size, func=func, **kwargs)
+        self.base_cell._modified = True
+        return begin
+
+
+class ResidualCell(_ModifierCell):
+    """Reference: rnn.ResidualCell — output += input."""
+
+    def forward(self, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        return output + inputs, states
+
+
+class ZoneoutCell(_ModifierCell):
+    """Reference: rnn.ZoneoutCell — stochastically preserve prev states."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        assert not isinstance(base_cell, BidirectionalCell), \
+            "BidirectionalCell doesn't support zoneout"
+        super().__init__(base_cell)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self._prev_output = None
+
+    def reset(self):
+        super().reset()
+        self._prev_output = None
+
+    def forward(self, inputs, states):
+        from ... import autograd
+        next_output, next_states = self.base_cell(inputs, states)
+        if not autograd.is_training():
+            return next_output, next_states
+
+        def mask(p, like):
+            return invoke("_random_bernoulli", prob=1 - p, shape=like.shape,
+                          dtype=str(like.dtype))
+
+        prev_output = self._prev_output
+        if prev_output is None:
+            prev_output = nd.zeros(next_output.shape,
+                                   ctx=next_output.context)
+        if self.zoneout_outputs > 0:
+            m = mask(self.zoneout_outputs, next_output)
+            output = m * next_output + (1 - m) * prev_output
+        else:
+            output = next_output
+        if self.zoneout_states > 0:
+            new_states = []
+            for new_s, old_s in zip(next_states, states):
+                m = mask(self.zoneout_states, new_s)
+                new_states.append(m * new_s + (1 - m) * old_s)
+        else:
+            new_states = next_states
+        self._prev_output = output
+        return output, new_states
+
+
+class BidirectionalCell(RecurrentCell):
+    """Reference: rnn.BidirectionalCell — unroll-only."""
+
+    def __init__(self, l_cell, r_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.register_child(l_cell, "l_cell")
+        self.register_child(r_cell, "r_cell")
+
+    def state_info(self, batch_size=0):
+        infos = []
+        for cell in self._children.values():
+            infos.extend(cell.state_info(batch_size))
+        return infos
+
+    def forward(self, inputs, states):
+        raise NotImplementedError(
+            "Bidirectional cannot be stepped. Please use unroll")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        self.reset()
+        axis = layout.find("T")
+        batch_axis = layout.find("N")
+        if isinstance(inputs, NDArray):
+            batch_size = inputs.shape[batch_axis]
+            seq = [inputs.slice_axis(axis, i, i + 1).squeeze(axis)
+                   for i in range(length)]
+        else:
+            seq = list(inputs)
+            batch_size = seq[0].shape[0]
+        l_cell, r_cell = self._children.values()
+        if begin_state is None:
+            begin_state = self.begin_state(batch_size, ctx=seq[0].context)
+        n_l = len(l_cell.state_info())
+        l_outputs, l_states = l_cell.unroll(
+            length, seq, begin_state[:n_l], layout, merge_outputs=False,
+            valid_length=valid_length)
+        if valid_length is None:
+            rev_seq = list(reversed(seq))
+        else:
+            # per-sample reverse of the valid region (reference:
+            # SequenceReverse(use_sequence_length=True)) so the reverse
+            # cell starts from each sequence's last valid step
+            stacked = nd.stack(seq, axis=0)  # (T, N, C)
+            rev = invoke("SequenceReverse", stacked, valid_length,
+                         use_sequence_length=True)
+            rev_seq = [rev[t] for t in range(length)]
+        r_outputs, r_states = r_cell.unroll(
+            length, rev_seq, begin_state[n_l:], layout,
+            merge_outputs=False, valid_length=valid_length)
+        if valid_length is None:
+            r_outputs = list(reversed(r_outputs))
+        else:
+            r_stacked = nd.stack(r_outputs, axis=0)
+            r_rev = invoke("SequenceReverse", r_stacked, valid_length,
+                           use_sequence_length=True)
+            r_outputs = [r_rev[t] for t in range(length)]
+        outputs = [nd.concat(lo, ro, dim=1) for lo, ro in
+                   zip(l_outputs, r_outputs)]
+        if merge_outputs or merge_outputs is None:
+            outputs = nd.stack(outputs, axis=axis)
+        return outputs, l_states + r_states

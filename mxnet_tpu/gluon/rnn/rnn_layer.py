@@ -1,0 +1,167 @@
+"""Gluon recurrent layers: RNN / LSTM / GRU over the fused RNN op.
+
+Reference: python/mxnet/gluon/rnn/rnn_layer.py (_RNNLayer, class RNN,
+class LSTM, class GRU) — parameter naming l{i}_i2h_weight / r{i}_i2h_weight
+(reverse direction) kept for checkpoint parity with the reference's
+cuDNN-packed layout (ops/rnn.py docstring).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ... import ndarray as nd
+from ...ndarray.ndarray import NDArray, invoke
+from ... import initializer as init_mod
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, mode,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(**kwargs)
+        assert layout in ("TNC", "NTC"), \
+            "Invalid layout %s; must be one of ['TNC' or 'NTC']" % layout
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._mode = mode
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._gates = _GATES[mode]
+        ng, ni, nh = self._gates, input_size, hidden_size
+        for i in range(num_layers):
+            for j in (["l", "r"] if bidirectional else ["l"]):
+                self._register_param("%s%d_i2h_weight" % (j, i),
+                                     (ng * nh, ni), i2h_weight_initializer)
+                self._register_param("%s%d_h2h_weight" % (j, i),
+                                     (ng * nh, nh), h2h_weight_initializer)
+                self._register_param("%s%d_i2h_bias" % (j, i),
+                                     (ng * nh,), i2h_bias_initializer)
+                self._register_param("%s%d_h2h_bias" % (j, i),
+                                     (ng * nh,), h2h_bias_initializer)
+            ni = nh * self._dir
+
+    def _register_param(self, name, shape, init):
+        if isinstance(init, str):
+            init = init_mod.create(init)
+        p = Parameter(name, shape=shape, init=init, allow_deferred_init=True)
+        setattr(self, name, p)
+
+    def infer_shape(self, inputs, *args):
+        isz = inputs.shape[2] if self._layout == "TNC" else inputs.shape[-1]
+        ng, nh = self._gates, self._hidden_size
+        ni = isz
+        for i in range(self._num_layers):
+            for j in (["l", "r"] if self._dir == 2 else ["l"]):
+                getattr(self, "%s%d_i2h_weight" % (j, i)).shape = (ng * nh, ni)
+            ni = nh * self._dir
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        """Initial states (reference: _RNNLayer.begin_state)."""
+        states = []
+        for info in self.state_info(batch_size):
+            states.append(nd.zeros(**info, **kwargs) if func is None
+                          else func(**info, **kwargs))
+        return states
+
+    def _pack_params(self, ctx):
+        """Concatenate per-layer params into the cuDNN-layout flat vector
+        (ops/rnn.py) — weights for all layers/directions, then biases."""
+        flat = []
+        dirs = ["l", "r"] if self._dir == 2 else ["l"]
+        for i in range(self._num_layers):
+            for j in dirs:
+                flat.append(getattr(self, "%s%d_i2h_weight" % (j, i))
+                            .data(ctx).reshape(-1))
+                flat.append(getattr(self, "%s%d_h2h_weight" % (j, i))
+                            .data(ctx).reshape(-1))
+        for i in range(self._num_layers):
+            for j in dirs:
+                flat.append(getattr(self, "%s%d_i2h_bias" % (j, i)).data(ctx))
+                flat.append(getattr(self, "%s%d_h2h_bias" % (j, i)).data(ctx))
+        return nd.concat(*flat, dim=0)
+
+    def forward(self, inputs, states=None, sequence_length=None):
+        from ... import autograd
+        ctx = inputs.context
+        batch_size = inputs.shape[0 if self._layout == "NTC" else 1]
+        skip_states = states is None
+        if skip_states:
+            states = self.begin_state(batch_size, ctx=ctx)
+        if isinstance(states, NDArray):
+            states = [states]
+        if self._layout == "NTC":
+            inputs = inputs.transpose((1, 0, 2))
+        params = self._pack_params(ctx)
+        h0 = states[0]
+        c0 = states[1] if len(states) > 1 else None
+        out, h_out, c_out = invoke(
+            "RNN", inputs, params, h0, c0, sequence_length,
+            state_size=self._hidden_size, num_layers=self._num_layers,
+            mode=self._mode, bidirectional=self._dir == 2, p=self._dropout,
+            use_sequence_length=sequence_length is not None,
+            training=autograd.is_training())
+        if self._layout == "NTC":
+            out = out.transpose((1, 0, 2))
+        new_states = [h_out] if self._mode != "lstm" else [h_out, c_out]
+        if skip_states:
+            return out
+        return out, new_states
+
+    def __repr__(self):
+        return "%s(%s, %s, layers=%s%s)" % (
+            type(self).__name__, self._input_size or "?", self._hidden_size,
+            self._num_layers, ", bidirectional" if self._dir == 2 else "")
+
+
+class RNN(_RNNLayer):
+    """Elman RNN with tanh/relu (reference: gluon.rnn.RNN)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False, input_size=0,
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size,
+                         "rnn_" + activation, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size)}]
+
+
+class LSTM(_RNNLayer):
+    """Reference: gluon.rnn.LSTM (cuDNN-RNN parity; SURVEY.md M5)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, "lstm", **kwargs)
+
+    def state_info(self, batch_size=0):
+        shape = (self._num_layers * self._dir, batch_size, self._hidden_size)
+        return [{"shape": shape}, {"shape": shape}]
+
+
+class GRU(_RNNLayer):
+    """Reference: gluon.rnn.GRU."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, "gru", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size)}]

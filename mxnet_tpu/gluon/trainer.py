@@ -42,7 +42,7 @@ class Trainer:
                                  "got %s" % type(param))
             self._param2idx[param.name] = i
             self._params.append(param)
-            param._set_trainer = self
+            param._trainer = self
         self._compression_params = compression_params
         self._contexts = self._check_contexts()
         optimizer_params = optimizer_params or {}

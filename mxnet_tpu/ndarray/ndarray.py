@@ -337,6 +337,13 @@ class NDArray:
 
     def __getitem__(self, key) -> "NDArray":
         key = self._unwrap_key(key)
+        from .. import autograd
+        if autograd.is_recording() and self._ag_node is not None and \
+                self._is_basic_index(key):
+            # recorded copy: keeps the gradient chain (views carry no node)
+            self._check_bounds(key)
+            return invoke("_internal_getitem", self,
+                          key=key if isinstance(key, tuple) else (key,))
         if self._is_basic_index(key) and self._vshape is None:
             self._check_bounds(key)
             # view sharing the chunk: writes through (MXNet slice semantics)
@@ -393,6 +400,12 @@ class NDArray:
         if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
             shape = tuple(shape[0])
         shape = _infer_reshape(self.shape, shape)
+        from .. import autograd
+        if autograd.is_recording() and self._ag_node is not None:
+            # recorded op-form reshape: a view would drop the tape node and
+            # silently cut the gradient chain (rnn param packing relies on
+            # grads flowing through reshape)
+            return invoke("reshape", self, shape=shape)
         if self._index is None and self._vshape is None:
             # view of the root chunk: writes through (reference semantics)
             return NDArray(None, _chunk=self._chunk, _vshape=shape)

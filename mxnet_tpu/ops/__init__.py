@@ -12,3 +12,4 @@ from . import matrix      # noqa: F401
 from . import nn          # noqa: F401
 from . import random     # noqa: F401
 from . import optimizer  # noqa: F401
+from . import rnn       # noqa: F401

@@ -362,3 +362,10 @@ def _shape_array(x):
 @register("size_array", differentiable=False)
 def _size_array(x):
     return jnp.asarray([x.size], dtype=jnp.int64)
+
+
+@register("_internal_getitem")
+def _internal_getitem(x, key=None):
+    """Basic-index read as a recorded op — used by NDArray.__getitem__ under
+    autograd so the gradient chain survives (views carry no tape node)."""
+    return x[key]

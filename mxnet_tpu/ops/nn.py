@@ -66,12 +66,14 @@ def _convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
     rhs_spec = "OI" + spatial
     dn = lax.conv_dimension_numbers(data.shape, weight.shape,
                                     (lhs_spec, rhs_spec, lhs_spec))
+    # no preferred_element_type: its transpose rule rejects the mixed
+    # fp32-cotangent/bf16-operand combo under grad, and TPU bf16 convs
+    # already accumulate in fp32 on the MXU
     out = lax.conv_general_dilated(
         data, weight, window_strides=stride,
         padding=[(p, p) for p in pad],
         rhs_dilation=dilate, dimension_numbers=dn,
-        feature_group_count=num_group,
-        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None)
+        feature_group_count=num_group)
     out = out.astype(data.dtype)
     if bias is not None and not no_bias:
         out = out + bias.reshape((1, -1) + (1,) * n)

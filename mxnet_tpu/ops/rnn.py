@@ -1,0 +1,196 @@
+"""Fused RNN op (RNN/LSTM/GRU, multi-layer, bidirectional).
+
+Reference: src/operator/rnn.cc (RNNParam, NNVM_REGISTER_OP(RNN)) and the
+cuDNN path src/operator/cudnn_rnn-inl.h.  MXNet exposes ONE fused op taking
+the packed parameter vector in cuDNN layout; Gluon's rnn_layer packs its
+per-layer parameters into that vector.
+
+TPU-native (SURVEY.md §2.1 cuDNN row: "RNN → lax.scan cell loop"): each
+layer/direction is a `lax.scan` over time whose body is one fused
+matmul+gate-nonlinearity step; XLA pipelines the h2h matmul chain onto the
+MXU.  The packed layout is preserved bit-for-bit so reference checkpoints
+load (SURVEY.md §7.2 hard part 5):
+  for layer ∈ 0..L-1, direction ∈ (fwd[, bwd]):  W_i2h (G*H, I), W_h2h (G*H, H)
+  then same order again for biases:              b_i2h (G*H),   b_h2h (G*H)
+Gate order: LSTM i,f,g,o; GRU r,z,n (cuDNN order, matching MXNet).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def _cell_step(mode):
+    """Returns step(h_prev, c_prev, x_proj, w_hh, b_hh) -> (h, c)."""
+    if mode == "rnn_relu":
+        def step(h, c, xp, w_hh, b_hh):
+            return jax.nn.relu(xp + h @ w_hh.T + b_hh), c
+    elif mode == "rnn_tanh":
+        def step(h, c, xp, w_hh, b_hh):
+            return jnp.tanh(xp + h @ w_hh.T + b_hh), c
+    elif mode == "lstm":
+        def step(h, c, xp, w_hh, b_hh):
+            gates = xp + h @ w_hh.T + b_hh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = f * c + i * g
+            return o * jnp.tanh(c_new), c_new
+    elif mode == "gru":
+        def step(h, c, xp, w_hh, b_hh):
+            # cuDNN GRU: r,z,n with n = tanh(x_n + r * (h @ Whn + bhn))
+            hp = h @ w_hh.T + b_hh
+            x_r, x_z, x_n = jnp.split(xp, 3, axis=-1)
+            h_r, h_z, h_n = jnp.split(hp, 3, axis=-1)
+            r = jax.nn.sigmoid(x_r + h_r)
+            z = jax.nn.sigmoid(x_z + h_z)
+            n = jnp.tanh(x_n + r * h_n)
+            return (1 - z) * n + z * h, c
+    else:
+        raise ValueError("unknown RNN mode %r" % mode)
+    return step
+
+
+def _seq_reverse(x, lens):
+    """Reverse each sample's first `lens[n]` steps of (T, N, ...) in place."""
+    T = x.shape[0]
+    steps = jnp.arange(T)[:, None]
+    src = jnp.where(steps < lens[None, :], lens[None, :] - 1 - steps, steps)
+    return jnp.take_along_axis(
+        x, src.reshape(src.shape + (1,) * (x.ndim - 2)).astype(jnp.int32),
+        axis=0)
+
+
+def _run_layer(x, h0, c0, w_ih, w_hh, b_ih, b_hh, mode, reverse=False,
+               seq_len=None):
+    """x: (T, N, I) → (T, N, H); one direction of one layer.
+
+    With seq_len (N,): states freeze past each sample's length (so final
+    h/c are the last VALID step's), padded outputs are zeroed, and the
+    reverse direction runs over the per-sample-reversed valid region —
+    the reference RNN op's use_sequence_length semantics."""
+    step = _cell_step(mode)
+    if seq_len is not None and reverse:
+        x = _seq_reverse(x, seq_len)
+        reverse = False
+    # hoist the input projection out of the scan: one big (T*N, I)@(I, G*H)
+    # matmul the MXU tiles well, leaving only the h2h matmul sequential
+    xp = jnp.einsum("tni,gi->tng", x, w_ih) + b_ih
+
+    if seq_len is None:
+        def body(carry, xpt):
+            h, c = carry
+            h_new, c_new = step(h, c, xpt, w_hh, b_hh)
+            return (h_new, c_new), h_new
+
+        (h_T, c_T), ys = lax.scan(body, (h0, c0), xp, reverse=reverse)
+        return ys, h_T, c_T
+
+    T = x.shape[0]
+
+    def body(carry, inp):
+        h, c = carry
+        xpt, t = inp
+        h_new, c_new = step(h, c, xpt, w_hh, b_hh)
+        valid = (t < seq_len)[:, None]
+        h_keep = jnp.where(valid, h_new, h)
+        c_keep = jnp.where(valid, c_new, c)
+        return (h_keep, c_keep), jnp.where(valid, h_new, 0).astype(h_new.dtype)
+
+    (h_T, c_T), ys = lax.scan(body, (h0, c0), (xp, jnp.arange(T)))
+    return ys, h_T, c_T
+
+
+def _unpack_params(params, num_layers, bidirectional, input_size, state_size,
+                   gates):
+    """Static unpacking of the cuDNN-layout flat vector."""
+    dirs = 2 if bidirectional else 1
+    gh = gates * state_size
+    shapes_w = []
+    for layer in range(num_layers):
+        isz = input_size if layer == 0 else state_size * dirs
+        for _ in range(dirs):
+            shapes_w.append((gh, isz))
+            shapes_w.append((gh, state_size))
+    offset = 0
+    weights = []
+    for shp in shapes_w:
+        n = shp[0] * shp[1]
+        weights.append(params[offset:offset + n].reshape(shp))
+        offset += n
+    biases = []
+    for _ in range(num_layers * dirs * 2):
+        biases.append(params[offset:offset + gh])
+        offset += gh
+    return weights, biases
+
+
+def rnn_param_size(num_layers, input_size, state_size, mode,
+                   bidirectional=False):
+    """Total packed-parameter length (reference: RNNParam size calc)."""
+    gates = _GATES[mode]
+    dirs = 2 if bidirectional else 1
+    gh = gates * state_size
+    total = 0
+    for layer in range(num_layers):
+        isz = input_size if layer == 0 else state_size * dirs
+        total += dirs * gh * (isz + state_size)   # weights
+    total += num_layers * dirs * 2 * gh           # biases
+    return total
+
+
+@register("RNN", aliases=["rnn"], num_outputs=3, needs_rng=True)
+def _rnn(key, data, params, state, state_cell=None, sequence_length=None,
+         state_size=0, num_layers=1, mode="lstm", bidirectional=False, p=0.0,
+         state_outputs=True, lstm_state_clip_min=None,
+         lstm_state_clip_max=None, use_sequence_length=False,
+         projection_size=None, training=False):
+    """data: (T, N, I) [MXNet TNC]; state: (L*D, N, H); LSTM adds
+    state_cell; sequence_length (N,) activates variable-length handling
+    when use_sequence_length=True (reference RNN op [1.7+]).
+    Returns (output, state_h_out, state_cell_out)."""
+    T, N, input_size = data.shape
+    gates = _GATES[mode]
+    dirs = 2 if bidirectional else 1
+    weights, biases = _unpack_params(params, num_layers, bidirectional,
+                                     input_size, state_size, gates)
+    if state_cell is None:
+        state_cell = jnp.zeros_like(state)
+    seq_len = None
+    if use_sequence_length and sequence_length is not None:
+        seq_len = sequence_length.astype(jnp.int32)
+    x = data
+    h_outs, c_outs = [], []
+    for layer in range(num_layers):
+        ys = []
+        for d in range(dirs):
+            idx = layer * dirs + d
+            w_ih = weights[2 * idx]
+            w_hh = weights[2 * idx + 1]
+            b_ih = biases[2 * idx]
+            b_hh = biases[2 * idx + 1]
+            y, h_T, c_T = _run_layer(x, state[idx], state_cell[idx], w_ih,
+                                     w_hh, b_ih, b_hh, mode, reverse=(d == 1),
+                                     seq_len=seq_len)
+            if seq_len is not None and d == 1:
+                y = _seq_reverse(y, seq_len)
+            ys.append(y)
+            h_outs.append(h_T)
+            c_outs.append(c_T)
+        x = ys[0] if dirs == 1 else jnp.concatenate(ys, axis=-1)
+        if p > 0 and training and layer < num_layers - 1:
+            key, sub = jax.random.split(key)
+            keep = 1.0 - p
+            mask = jax.random.bernoulli(sub, keep, x.shape)
+            x = jnp.where(mask, x / keep, 0).astype(x.dtype)
+    if mode == "lstm" and lstm_state_clip_min is not None:
+        c_outs = [jnp.clip(c, lstm_state_clip_min, lstm_state_clip_max)
+                  for c in c_outs]
+    h_out = jnp.stack(h_outs)
+    c_out = jnp.stack(c_outs)
+    return x, h_out, c_out

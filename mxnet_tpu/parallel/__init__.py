@@ -1,0 +1,13 @@
+"""Parallelism over TPU meshes.
+
+Reference role: the kvstore/comm layer (src/kvstore/, SURVEY.md §2.3/§5.8)
+plus the parallelism strategies the reference lacks (TP/SP design slots).
+TPU-native: `jax.sharding.Mesh` + NamedSharding + jit — XLA inserts the
+collectives (psum/all-gather/reduce-scatter) and rides ICI within a slice,
+DCN across slices.
+"""
+from .mesh import (make_mesh, replicated, batch_sharded, shard_params_tp,
+                   TrainStep, init_process_group)
+
+__all__ = ["make_mesh", "replicated", "batch_sharded", "shard_params_tp",
+           "TrainStep", "init_process_group"]

@@ -1,0 +1,162 @@
+"""Mesh construction + sharded training steps.
+
+Reference: the data-parallel machinery of src/kvstore/ (CommDevice reduce,
+KVStoreNCCL allreduce, kvstore_dist PS) and gluon Trainer's step — here ONE
+jitted function over a `jax.sharding.Mesh`: the forward, loss, backward,
+gradient allreduce and optimizer update compile into a single XLA program
+whose collectives XLA schedules to overlap with the backward pass (the
+per-key engine-op overlap property of SURVEY.md §3.5, now in the compiler).
+
+Tensor parallelism (absent in the reference, SURVEY.md §2.3 design slot):
+Megatron-style column/row sharding of Dense weights via NamedSharding —
+XLA inserts the psum at the row-sharded matmul.
+
+Multi-host: `init_process_group` wraps jax.distributed.initialize (the
+`tools/launch.py` / DMLC_ROLE env role).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..gluon.block import functionalize
+
+__all__ = ["make_mesh", "replicated", "batch_sharded", "shard_params_tp",
+           "TrainStep", "init_process_group"]
+
+
+def init_process_group(coordinator_address: Optional[str] = None,
+                       num_processes: Optional[int] = None,
+                       process_id: Optional[int] = None):
+    """Multi-host process group over DCN (reference role: ps-lite
+    Postoffice::Start + DMLC_* env; here jax.distributed.initialize)."""
+    jax.distributed.initialize(coordinator_address, num_processes, process_id)
+
+
+def make_mesh(axes: Sequence[str] = ("dp",),
+              shape: Optional[Sequence[int]] = None,
+              devices=None) -> Mesh:
+    """Build a Mesh over the visible devices.
+
+    Default: all devices on one 'dp' axis.  shape=(dp, tp) splits them 2-D;
+    -1 infers one dimension.  On a real pod, jax's device order keeps ICI
+    neighbours adjacent, so the innermost axis gets the fastest links.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if shape is None:
+        shape = [n] + [1] * (len(axes) - 1)
+    shape = list(shape)
+    if -1 in shape:
+        known = 1
+        for s in shape:
+            if s != -1:
+                known *= s
+        shape[shape.index(-1)] = n // known
+    arr = _np.asarray(devices[:int(_np.prod(shape))]).reshape(shape)
+    return Mesh(arr, tuple(axes))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharded(mesh: Mesh, axis: str = "dp") -> NamedSharding:
+    """Shard axis 0 (batch) over the data-parallel mesh axis."""
+    return NamedSharding(mesh, P(axis))
+
+
+def shard_params_tp(param_values: Dict[str, jax.Array], mesh: Mesh,
+                    tp_axis: str = "tp",
+                    rules: Optional[Dict[str, Any]] = None):
+    """Megatron-style TP placement for Dense weights.
+
+    rules: {param-name-substring: PartitionSpec}.  Default: alternate
+    column-parallel ((tp, None) on the (out, in) weight) and row-parallel
+    ((None, tp)) for consecutive '.weight' 2-D params; biases and
+    everything else replicate.
+    """
+    tp = mesh.shape.get(tp_axis, 1)
+    out = {}
+    col = True
+    for name, v in param_values.items():
+        spec = P()
+        if rules:
+            for frag, s in rules.items():
+                if frag in name:
+                    spec = s
+                    break
+            else:
+                spec = None
+        if rules is None or spec is None:
+            if tp > 1 and name.endswith("weight") and v.ndim == 2:
+                spec = P(tp_axis, None) if col else P(None, tp_axis)
+                col = not col
+            else:
+                # biases and everything else replicate (always a valid
+                # placement; XLA re-shards at use sites as needed)
+                spec = P()
+        out[name] = jax.device_put(v, NamedSharding(mesh, spec))
+    return out
+
+
+class TrainStep:
+    """One jitted data-parallel (+optional TP) training step.
+
+    Built from a Gluon block via functionalize(); the returned callable has
+    signature step(params, opt_state, *batch) -> (params, opt_state, loss).
+    SGD+momentum by default (enough for the dry-run and the bench; the full
+    optimizer set runs through gluon.Trainer's eager path).
+    """
+
+    def __init__(self, block, loss_fn: Callable, mesh: Mesh,
+                 learning_rate: float = 0.01, momentum: float = 0.9,
+                 dp_axis: str = "dp", tp_axis: str = "tp",
+                 tp_rules: Optional[Dict[str, Any]] = None,
+                 donate: bool = True):
+        pure_fn, param_values = functionalize(block)
+        self.mesh = mesh
+        self.params = shard_params_tp(param_values, mesh, tp_axis, tp_rules)
+        self.opt_state = jax.tree_util.tree_map(jnp.zeros_like, self.params)
+        self._batch_sharding = batch_sharded(mesh, dp_axis)
+        lr, mom = learning_rate, momentum
+
+        def step(params, opt_state, *batch):
+            def loss_of(p):
+                out = pure_fn(p, *batch[:-1], training=True)
+                return loss_fn(out, batch[-1])
+
+            loss, grads = jax.value_and_grad(loss_of)(params)
+            # batch is dp-sharded: jax.grad's sum over examples makes XLA
+            # emit the gradient all-reduce (psum over 'dp') automatically,
+            # overlapped with backward by the latency-hiding scheduler
+            new_opt = jax.tree_util.tree_map(
+                lambda m, g: mom * m - lr * g, opt_state, grads)
+            new_params = jax.tree_util.tree_map(
+                lambda p, m: p + m, params, new_opt)
+            return new_params, new_opt, loss
+
+        self._step = jax.jit(
+            step, donate_argnums=(0, 1) if donate else ())
+
+    def shard_batch(self, *arrays):
+        return tuple(jax.device_put(a, self._batch_sharding) for a in arrays)
+
+    def __call__(self, *batch):
+        batch = self.shard_batch(*batch)
+        self.params, self.opt_state, loss = self._step(
+            self.params, self.opt_state, *batch)
+        return loss
+
+    def write_back(self, block):
+        """Copy trained params back into the Block's Parameters."""
+        params = block.collect_params()
+        for name, v in self.params.items():
+            arr = params[name].data()
+            arr._set_jax(jnp.asarray(v).astype(arr.dtype))
