@@ -1,0 +1,188 @@
+"""BERT model family (GluonNLP-compatible architecture).
+
+Reference: the reference repo pairs with GluonNLP's
+gluonnlp/model/bert.py (BERTModel, BERTEncoder, BERTLayerNorm,
+bert_12_768_12 / bert_24_1024_16) built on the fused transformer ops of
+src/operator/contrib/transformer.cc — BASELINE config 2 (BERT-base
+pretraining, data-parallel kvstore='ici').
+
+TPU-native: attention dispatches to the Pallas flash kernel via the
+multi_head_attention op (ops/attention.py); bf16 via net.cast; the whole
+encoder hybridizes into one XLA program.  The pod-scale DP/TP path jits
+the training step over a Mesh (parallel.TrainStep — attention TP shards
+heads, FFN shards the hidden dim).
+"""
+from __future__ import annotations
+
+import math
+
+from ...ndarray.ndarray import NDArray, invoke
+from ... import ndarray as nd
+from .. import nn
+from ..block import HybridBlock
+
+__all__ = ["BERTModel", "BERTEncoder", "BERTEncoderLayer", "MultiHeadAttention",
+           "PositionwiseFFN", "bert_12_768_12", "bert_24_1024_16", "get_bert"]
+
+
+class MultiHeadAttention(HybridBlock):
+    """Self/cross attention with fused QKV projection (reference: the
+    interleaved_matmul_selfatt ops; GluonNLP DotProductSelfAttentionCell)."""
+
+    def __init__(self, units, num_heads, dropout=0.0, use_bias=True,
+                 **kwargs):
+        super().__init__(**kwargs)
+        assert units % num_heads == 0
+        self._units = units
+        self._num_heads = num_heads
+        self.query_key_value = nn.Dense(3 * units, flatten=False,
+                                        use_bias=use_bias)
+        self.proj = nn.Dense(units, flatten=False, use_bias=use_bias)
+        self.dropout = nn.Dropout(dropout)
+
+    def forward(self, x, mask=None):
+        # x: (N, T, C)
+        qkv = self.query_key_value(x)
+        q, k, v = qkv.split(num_outputs=3, axis=-1)
+        out = invoke("multi_head_attention", q, k, v, mask,
+                     num_heads=self._num_heads, scaled=True)
+        return self.dropout(self.proj(out))
+
+
+class PositionwiseFFN(HybridBlock):
+    """Reference: GluonNLP PositionwiseFFN (gelu for BERT)."""
+
+    def __init__(self, units, hidden_size, dropout=0.0, activation="gelu",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.ffn_1 = nn.Dense(hidden_size, flatten=False)
+        self.activation = nn.GELU() if activation == "gelu" else \
+            nn.Activation(activation)
+        self.ffn_2 = nn.Dense(units, flatten=False)
+        self.dropout = nn.Dropout(dropout)
+
+    def forward(self, x):
+        return self.dropout(self.ffn_2(self.activation(self.ffn_1(x))))
+
+
+class BERTEncoderLayer(HybridBlock):
+    """Post-LN transformer layer (BERT convention)."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.attention = MultiHeadAttention(units, num_heads, dropout)
+        self.layer_norm_att = nn.LayerNorm(in_channels=units)
+        self.ffn = PositionwiseFFN(units, hidden_size, dropout)
+        self.layer_norm_ffn = nn.LayerNorm(in_channels=units)
+        self.dropout = nn.Dropout(dropout)
+
+    def forward(self, x, mask=None):
+        att = self.attention(x, mask)
+        x = self.layer_norm_att(x + att)
+        ffn = self.ffn(x)
+        return self.layer_norm_ffn(x + ffn)
+
+
+class BERTEncoder(HybridBlock):
+    """Stack of encoder layers (reference: GluonNLP BERTEncoder)."""
+
+    def __init__(self, num_layers, units, hidden_size, num_heads,
+                 max_length=512, dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self._max_length = max_length
+        self._units = units
+        self.position_weight = None  # owned by BERTModel embeddings
+        self.transformer_cells = nn.HybridSequential()
+        for _ in range(num_layers):
+            self.transformer_cells.add(
+                BERTEncoderLayer(units, hidden_size, num_heads, dropout))
+
+    def forward(self, x, mask=None):
+        for cell in self.transformer_cells:
+            x = cell(x, mask)
+        return x
+
+
+class BERTModel(HybridBlock):
+    """BERT with MLM + NSP heads (reference: GluonNLP BERTModel).
+
+    forward(inputs, token_types, valid_length=None) →
+      (sequence_output, pooled_output) — use_decoder adds mlm_logits,
+      use_classifier adds nsp_logits, matching GluonNLP's output tuple.
+    """
+
+    def __init__(self, num_layers=12, units=768, hidden_size=3072,
+                 num_heads=12, vocab_size=30522, token_type_vocab_size=2,
+                 max_length=512, dropout=0.1, use_pooler=True,
+                 use_decoder=True, use_classifier=True, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._max_length = max_length
+        self.word_embed = nn.Embedding(vocab_size, units)
+        self.token_type_embed = nn.Embedding(token_type_vocab_size, units)
+        self.position_embed = nn.Embedding(max_length, units)
+        self.embed_layer_norm = nn.LayerNorm(in_channels=units)
+        self.embed_dropout = nn.Dropout(dropout)
+        self.encoder = BERTEncoder(num_layers, units, hidden_size, num_heads,
+                                   max_length, dropout)
+        self.use_pooler = use_pooler
+        self.use_decoder = use_decoder
+        self.use_classifier = use_classifier
+        if use_pooler:
+            self.pooler = nn.Dense(units, activation="tanh", flatten=False)
+        if use_decoder:
+            # MLM head: transform + tied-weight output over vocab
+            self.decoder_transform = nn.Dense(units, flatten=False)
+            self.decoder_act = nn.GELU()
+            self.decoder_norm = nn.LayerNorm(in_channels=units)
+            self.decoder_out = nn.Dense(vocab_size, flatten=False)
+        if use_classifier:
+            self.classifier = nn.Dense(2, flatten=False)
+
+    def _attention_mask(self, valid_length, seq_len):
+        if valid_length is None:
+            return None
+        steps = nd.arange(seq_len, ctx=valid_length.context)
+        mask = invoke("broadcast_lesser",
+                      steps.reshape((1, 1, 1, seq_len)),
+                      valid_length.reshape((-1, 1, 1, 1)))
+        return mask
+
+    def forward(self, inputs, token_types=None, valid_length=None):
+        N, T = inputs.shape
+        ctx = inputs.context
+        positions = nd.arange(T, ctx=ctx)
+        emb = self.word_embed(inputs)
+        if token_types is not None:
+            emb = emb + self.token_type_embed(token_types)
+        emb = emb + self.position_embed(positions).reshape((1, T, self._units))
+        emb = self.embed_dropout(self.embed_layer_norm(emb))
+        mask = self._attention_mask(valid_length, T)
+        seq_out = self.encoder(emb, mask)
+        outputs = [seq_out]
+        if self.use_pooler:
+            pooled = self.pooler(seq_out[:, 0, :].reshape((N, self._units)))
+            outputs.append(pooled)
+            if self.use_classifier:
+                outputs.append(self.classifier(pooled))
+        if self.use_decoder:
+            h = self.decoder_norm(self.decoder_act(
+                self.decoder_transform(seq_out)))
+            outputs.append(self.decoder_out(h))
+        return outputs[0] if len(outputs) == 1 else tuple(outputs)
+
+
+# GluonNLP-style spec names: bert_{layers}_{units}_{heads}
+def get_bert(num_layers, units, num_heads, **kwargs):
+    return BERTModel(num_layers=num_layers, units=units,
+                     hidden_size=4 * units, num_heads=num_heads, **kwargs)
+
+
+def bert_12_768_12(**kwargs):
+    """BERT-base (reference: gluonnlp bert_12_768_12)."""
+    return get_bert(12, 768, 12, **kwargs)
+
+
+def bert_24_1024_16(**kwargs):
+    """BERT-large (reference: gluonnlp bert_24_1024_16)."""
+    return get_bert(24, 1024, 16, **kwargs)

@@ -13,3 +13,4 @@ from . import nn          # noqa: F401
 from . import random     # noqa: F401
 from . import optimizer  # noqa: F401
 from . import rnn       # noqa: F401
+from . import attention  # noqa: F401

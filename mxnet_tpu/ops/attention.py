@@ -1,0 +1,241 @@
+"""Attention ops: Pallas flash attention + MXNet transformer parity ops.
+
+Reference: src/operator/contrib/transformer.cc
+(_contrib_interleaved_matmul_selfatt_qk, _contrib_interleaved_matmul_
+selfatt_valatt, _contrib_interleaved_matmul_encdec_qk/valatt) — the fused
+attention matmuls GluonNLP's BERT uses.
+
+TPU-native: the hot path is a blockwise online-softmax (flash) attention
+kernel in Pallas (SURVEY.md §2.1 cuDNN row: "attention → Pallas flash
+attention").  Blocks stream K/V through VMEM with running (max, sum)
+accumulators so the T×T score matrix never materializes in HBM; the MXU
+does the two matmuls per block.  Backward recomputes attention from the
+saved inputs (rematerialization — trade FLOPs for HBM, SURVEY.md design
+notes).  Non-TPU backends and unaligned shapes fall back to the jnp
+composition, which XLA fuses well at moderate sequence length.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+__all__ = ["attention_core", "flash_attention"]
+
+_BLOCK_Q = 256
+_BLOCK_K = 256
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# jnp reference path (always-correct fallback; also the recompute backward)
+# ---------------------------------------------------------------------------
+
+
+def _attention_jnp(q, k, v, scale, causal):
+    """q,k,v: (B, H, T, D)."""
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        Tq, Tk = q.shape[2], k.shape[2]
+        mask = jnp.tril(jnp.ones((Tq, Tk), bool), Tk - Tq)
+        logits = jnp.where(mask, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash kernel (forward)
+# ---------------------------------------------------------------------------
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal,
+                      block_k, seq_k):
+    # refs: q (block_q, D), k/v (seq_k, D), o (block_q, D); grid=(BH, Tq/bq)
+    import jax.experimental.pallas as pl
+
+    block_q, d = q_ref.shape
+    q = q_ref[:].astype(jnp.float32) * scale
+    q_idx = pl.program_id(1)
+
+    m = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
+    l = jnp.zeros((block_q, 1), jnp.float32)
+    acc = jnp.zeros((block_q, d), jnp.float32)
+
+    num_kb = seq_k // block_k
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k_blk = k_ref[pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = q_idx * block_q + \
+                lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + \
+                lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        # guard fully-masked rows: exp(-inf - -inf) would be nan
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - m_safe)
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = alpha * acc + jnp.dot(p, v_blk,
+                                        preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    if causal:
+        # only key blocks at or before this query block contribute
+        num_kb_eff = (q_idx + 1) * block_q // block_k
+        num_kb_eff = jnp.minimum(num_kb_eff, num_kb)
+        m, l, acc = lax.fori_loop(0, num_kb_eff, body, (m, l, acc))
+    else:
+        m, l, acc = lax.fori_loop(0, num_kb, body, (m, l, acc))
+
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q=_BLOCK_Q, block_k=_BLOCK_K):
+    """q,k,v: (B, H, T, D) with T % block == 0."""
+    import jax.experimental.pallas as pl
+
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    qr = q.reshape(B * H, Tq, D)
+    kr = k.reshape(B * H, Tk, D)
+    vr = v.reshape(B * H, Tk, D)
+    kernel = functools.partial(_flash_fwd_kernel, scale=scale, causal=causal,
+                               block_k=block_k, seq_k=Tk)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, Tq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+    )(qr, kr, vr)
+    return out.reshape(B, H, Tq, D)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(q, k, v, scale, causal):
+    """Blockwise flash attention, (B, H, T, D) layout."""
+    return _flash_fwd(q, k, v, scale, causal)
+
+
+def _flash_vjp_fwd(q, k, v, scale, causal):
+    return _flash_fwd(q, k, v, scale, causal), (q, k, v)
+
+
+def _flash_vjp_bwd(scale, causal, res, g):
+    # rematerialized backward through the jnp composition (correct grads;
+    # the dedicated flash backward kernel is a later optimization)
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: _attention_jnp(q, k, v, scale, causal),
+                     q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def attention_core(q, k, v, scale=None, causal=False, mask=None):
+    """Dispatch: Pallas flash on TPU for aligned mask-free shapes, jnp
+    composition otherwise.  q,k,v: (B, H, T, D)."""
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    Tq, Tk, D = q.shape[2], k.shape[2], q.shape[3]
+    use_flash = (_on_tpu() and mask is None and
+                 Tq % _BLOCK_Q == 0 and Tk % _BLOCK_K == 0 and
+                 D % 128 == 0 and (not causal or Tq == Tk))
+    if use_flash:
+        return flash_attention(q, k, v, float(scale), bool(causal))
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        cm = jnp.tril(jnp.ones((Tq, Tk), bool), Tk - Tq)
+        logits = jnp.where(cm, logits, -jnp.inf)
+    if mask is not None:
+        logits = jnp.where(mask.astype(bool), logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+# ---------------------------------------------------------------------------
+# MXNet transformer parity ops (interleaved QKV layout, reference:
+# src/operator/contrib/transformer.cc).  Input: (T, N, H*3*D) where the
+# projection interleaves [q1..qD, k1..kD, v1..vD] per head.
+# ---------------------------------------------------------------------------
+
+
+def _split_interleaved_qkv(qkv, heads):
+    T, N, HC = qkv.shape
+    D = HC // (heads * 3)
+    x = qkv.reshape(T, N, heads, 3, D)
+    # -> (N, heads, T, D)
+    q = x[:, :, :, 0].transpose(1, 2, 0, 3)
+    k = x[:, :, :, 1].transpose(1, 2, 0, 3)
+    v = x[:, :, :, 2].transpose(1, 2, 0, 3)
+    return q, k, v
+
+
+@register("_contrib_interleaved_matmul_selfatt_qk")
+def _selfatt_qk(queries_keys_values, heads=1):
+    """scores = scaled q @ k^T → (N*heads, T, T)."""
+    q, k, _ = _split_interleaved_qkv(queries_keys_values, heads)
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    s = jnp.einsum("nhqd,nhkd->nhqk", q * scale, k,
+                   preferred_element_type=jnp.float32).astype(q.dtype)
+    N, H, T, _ = s.shape
+    return s.reshape(N * H, T, T)
+
+
+@register("_contrib_interleaved_matmul_selfatt_valatt")
+def _selfatt_valatt(queries_keys_values, attention, heads=1):
+    """out = att @ v → (T, N, H*D)."""
+    _, _, v = _split_interleaved_qkv(queries_keys_values, heads)
+    N, H, T, D = v.shape
+    att = attention.reshape(N, H, T, T)
+    out = jnp.einsum("nhqk,nhkd->nhqd", att, v)
+    return out.transpose(2, 0, 1, 3).reshape(T, N, H * D)
+
+
+@register("_contrib_interleaved_matmul_encdec_qk")
+def _encdec_qk(queries, keys_values, heads=1):
+    Tq, N, HC = queries.shape
+    D = HC // heads
+    q = queries.reshape(Tq, N, heads, D).transpose(1, 2, 0, 3)
+    Tk = keys_values.shape[0]
+    kv = keys_values.reshape(Tk, N, heads, 2, D)
+    k = kv[:, :, :, 0].transpose(1, 2, 0, 3)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, q.dtype))
+    s = jnp.einsum("nhqd,nhkd->nhqk", q * scale, k,
+                   preferred_element_type=jnp.float32).astype(q.dtype)
+    return s.reshape(N * heads, Tq, Tk)
+
+
+@register("_contrib_interleaved_matmul_encdec_valatt")
+def _encdec_valatt(keys_values, attention, heads=1):
+    Tk, N, HC = keys_values.shape
+    D = HC // (heads * 2)
+    kv = keys_values.reshape(Tk, N, heads, 2, D)
+    v = kv[:, :, :, 1].transpose(1, 2, 0, 3)
+    Tq = attention.shape[1]
+    att = attention.reshape(N, heads, Tq, Tk)
+    out = jnp.einsum("nhqk,nhkd->nhqd", att, v)
+    return out.transpose(2, 0, 1, 3).reshape(Tq, N, heads * D)

@@ -381,24 +381,17 @@ def _dropout(key, data, p=0.5, mode="training", axes=(), cudnn_off=False,
 
 
 @register("multi_head_attention")
-def _mha(q, k, v, num_heads=1, scaled=True, mask=None, causal=False):
-    # q,k,v: (B, T, H*D)
+def _mha(q, k, v, mask=None, num_heads=1, scaled=True, causal=False):
+    # q,k,v: (B, T, H*D), mask broadcastable to (B, H, Tq, Tk);
+    # hot path = Pallas flash attention on TPU
+    from .attention import attention_core
     B, Tq, HD = q.shape
     D = HD // num_heads
     qh = q.reshape(B, Tq, num_heads, D).transpose(0, 2, 1, 3)
     kh = k.reshape(B, -1, num_heads, D).transpose(0, 2, 1, 3)
     vh = v.reshape(B, -1, num_heads, D).transpose(0, 2, 1, 3)
-    scale = (1.0 / jnp.sqrt(D)) if scaled else 1.0
-    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
-                        preferred_element_type=jnp.float32) * scale
-    if causal:
-        Tk = kh.shape[2]
-        cm = jnp.tril(jnp.ones((Tq, Tk), bool))
-        logits = jnp.where(cm, logits, -jnp.inf)
-    if mask is not None:
-        logits = jnp.where(mask.astype(bool), logits, -jnp.inf)
-    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+    scale = (1.0 / D ** 0.5) if scaled else 1.0
+    out = attention_core(qh, kh, vh, scale=scale, causal=causal, mask=mask)
     return out.transpose(0, 2, 1, 3).reshape(B, Tq, HD)
 
 

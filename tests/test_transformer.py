@@ -1,0 +1,151 @@
+"""Attention + BERT tests (reference pattern: GluonNLP bert tests +
+src/operator/contrib/transformer.cc op tests in test_operator.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.model_zoo import bert as bert_mod
+
+
+def _np_attention(q, k, v, scale, causal=False, mask=None):
+    logits = np.einsum("bhqd,bhkd->bhqk", q, k).astype(np.float64) * scale
+    if causal:
+        Tq, Tk = q.shape[2], k.shape[2]
+        cm = np.tril(np.ones((Tq, Tk), bool), Tk - Tq)
+        logits = np.where(cm, logits, -np.inf)
+    if mask is not None:
+        logits = np.where(mask, logits, -1e30)
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def test_attention_core_matches_numpy():
+    from mxnet_tpu.ops.attention import attention_core
+    np.random.seed(0)
+    B, H, T, D = 2, 3, 8, 4
+    q = np.random.randn(B, H, T, D).astype(np.float32)
+    k = np.random.randn(B, H, T, D).astype(np.float32)
+    v = np.random.randn(B, H, T, D).astype(np.float32)
+    scale = 1.0 / np.sqrt(D)
+    out = np.asarray(attention_core(q, k, v, scale=scale))
+    ref = _np_attention(q, k, v, scale)
+    assert np.allclose(out, ref, atol=1e-5)
+    out_c = np.asarray(attention_core(q, k, v, scale=scale, causal=True))
+    ref_c = _np_attention(q, k, v, scale, causal=True)
+    assert np.allclose(out_c, ref_c, atol=1e-5)
+
+
+def test_flash_kernel_matches_reference_cpu_interpret():
+    """Run the Pallas kernel in interpreter mode on CPU against the jnp
+    path (the TPU run is covered by bench/verify)."""
+    import jax
+    import jax.experimental.pallas as pl
+    from mxnet_tpu.ops import attention as att
+    np.random.seed(0)
+    B, H, T, D = 1, 2, 512, 128
+    q = np.random.randn(B, H, T, D).astype(np.float32)
+    k = np.random.randn(B, H, T, D).astype(np.float32)
+    v = np.random.randn(B, H, T, D).astype(np.float32)
+    scale = 1.0 / np.sqrt(D)
+
+    orig = pl.pallas_call
+    try:
+        pl.pallas_call = lambda *a, **kw: orig(*a, interpret=True, **kw)
+        out = np.asarray(att._flash_fwd(q, k, v, scale, False))
+        out_causal = np.asarray(att._flash_fwd(q, k, v, scale, True))
+    finally:
+        pl.pallas_call = orig
+    ref = _np_attention(q, k, v, scale)
+    ref_causal = _np_attention(q, k, v, scale, causal=True)
+    assert np.allclose(out, ref, atol=2e-4), np.abs(out - ref).max()
+    assert np.allclose(out_causal, ref_causal, atol=2e-4)
+
+
+def test_interleaved_selfatt_ops():
+    """interleaved_matmul_selfatt_qk + valatt == plain attention."""
+    np.random.seed(0)
+    T, N, H, D = 6, 2, 2, 4
+    qkv = np.random.randn(T, N, H * 3 * D).astype(np.float32)
+    s = mx.nd.invoke("_contrib_interleaved_matmul_selfatt_qk",
+                     mx.nd.array(qkv), heads=H)
+    att = s.softmax(axis=-1)
+    out = mx.nd.invoke("_contrib_interleaved_matmul_selfatt_valatt",
+                       mx.nd.array(qkv), att, heads=H)
+    assert out.shape == (T, N, H * D)
+    # reference: deinterleave manually
+    x = qkv.reshape(T, N, H, 3, D)
+    q = x[:, :, :, 0].transpose(1, 2, 0, 3)
+    k = x[:, :, :, 1].transpose(1, 2, 0, 3)
+    v = x[:, :, :, 2].transpose(1, 2, 0, 3)
+    ref = _np_attention(q, k, v, 1.0 / np.sqrt(D))
+    ref = ref.transpose(2, 0, 1, 3).reshape(T, N, H * D)
+    assert np.allclose(out.asnumpy(), ref, atol=1e-4)
+
+
+def test_mha_block():
+    np.random.seed(0)
+    blk = bert_mod.MultiHeadAttention(units=16, num_heads=4)
+    blk.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.randn(2, 5, 16).astype(np.float32))
+    out = blk(x)
+    assert out.shape == (2, 5, 16)
+
+
+def test_bert_tiny_forward_and_heads():
+    net = bert_mod.get_bert(num_layers=2, units=32, num_heads=4,
+                            vocab_size=100, max_length=16, dropout=0.0)
+    net.initialize(mx.init.Normal(0.02))
+    tokens = mx.nd.array(np.random.randint(0, 100, (3, 10)).astype(np.float32))
+    segments = mx.nd.array(np.zeros((3, 10), np.float32))
+    seq, pooled, nsp, mlm = net(tokens, segments)
+    assert seq.shape == (3, 10, 32)
+    assert pooled.shape == (3, 32)
+    assert nsp.shape == (3, 2)
+    assert mlm.shape == (3, 10, 100)
+
+
+def test_bert_valid_length_masks_padding():
+    net = bert_mod.get_bert(num_layers=1, units=16, num_heads=2,
+                            vocab_size=50, max_length=8, dropout=0.0,
+                            use_decoder=False, use_classifier=False)
+    net.initialize(mx.init.Normal(0.02))
+    tok = np.random.randint(1, 50, (1, 6)).astype(np.float32)
+    vl = mx.nd.array([4.0])
+    seq1, _ = net(mx.nd.array(tok), None, vl)
+    # changing a padded token must not change valid positions' output
+    tok2 = tok.copy()
+    tok2[0, 5] = (tok2[0, 5] + 7) % 50
+    seq2, _ = net(mx.nd.array(tok2), None, vl)
+    assert np.allclose(seq1.asnumpy()[:, :4], seq2.asnumpy()[:, :4],
+                       atol=1e-5)
+
+
+def test_bert_mlm_training_descends():
+    np.random.seed(0)
+    mx.random.seed(0)
+    V = 30
+    net = bert_mod.get_bert(num_layers=1, units=16, num_heads=2,
+                            vocab_size=V, max_length=8, dropout=0.0,
+                            use_pooler=False, use_classifier=False)
+    net.initialize(mx.init.Normal(0.05))
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    tokens = np.random.randint(0, V, (8, 8)).astype(np.float32)
+    first = last = None
+    for _ in range(15):
+        x = mx.nd.array(tokens)
+        with autograd.record():
+            seq, mlm = net(x)
+            loss = loss_fn(mlm.reshape((-1, V)),
+                           mx.nd.array(tokens.reshape(-1))).mean()
+        loss.backward()
+        trainer.step(1)
+        v = float(loss.asscalar())
+        first = first if first is not None else v
+        last = v
+    assert last < first * 0.5, (first, last)
