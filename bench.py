@@ -10,31 +10,52 @@ bf16 compute (MXU-native) with fp32 master math in BN, synthetic data
 (the reference's benchmark_score.py / train_imagenet.py --benchmark 1
 pattern: measure compute throughput, not input pipeline).
 
-vs_baseline: MXNet-CUDA's classic published ResNet-50 fp16 throughput on
-one V100 (~1,41?0 img/s era-dependent; we use 1000 img/s as the nominal
-single-accelerator reference from the MXNet model-zoo era benchmarks,
-BASELINE.json `published` being empty).
+Resilience (round-1 lesson: the TPU tunnel can be wedged, and a bare
+`jax.devices()` probe then HANGS, costing the round its bench number):
+the parent process probes each backend in a SUBPROCESS with a hard
+timeout + retries, then execs the actual benchmark as a child pinned to
+the first healthy backend via JAX_PLATFORMS. If every accelerator probe
+fails, it falls back to a small CPU run so the driver still records a
+numeric value (with "device" marking the fallback), never a traceback.
+
+vs_baseline: MXNet-CUDA's classic published ResNet-50 throughput on one
+V100-era GPU; BASELINE.json `published` is empty so we use 1000 img/s as
+the nominal single-accelerator reference.
 """
 import json
 import os
+import subprocess
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 BASELINE_IMG_PER_SEC = 1000.0  # nominal MXNet-CUDA 1-GPU reference
-BATCH = 128
-WARMUP = 3
-ITERS = 10
+PROBE_TIMEOUT_S = 150          # first TPU compile can take ~20-40s; be generous
+CHILD_TIMEOUT_S = 1200
 
 
-def main():
-    import numpy as np
+def run_bench():
+    """The actual benchmark. Runs on jax's default backend (parent pins it)."""
     import jax
+    if os.environ.get("MX_BENCH_PLATFORM") == "cpu":
+        # The axon plugin force-sets jax_platforms="axon,cpu" (ignores the
+        # JAX_PLATFORMS env); override the config back or backend init hangs
+        # on a wedged tunnel.
+        from mxnet_tpu.base import pin_cpu
+        pin_cpu()
+    import numpy as np
     import jax.numpy as jnp
     import mxnet_tpu as mx
     from mxnet_tpu.gluon.model_zoo import vision
     from mxnet_tpu.parallel import make_mesh, TrainStep
+
+    on_cpu = jax.default_backend() == "cpu"
+    # CPU fallback exists only so a wedged tunnel still yields a number:
+    # keep it small enough to finish.
+    batch = 8 if on_cpu else 256
+    warmup = 1 if on_cpu else 5
+    iters = 2 if on_cpu else 20
 
     mx.random.seed(0)
     np.random.seed(0)
@@ -53,27 +74,77 @@ def main():
     mesh = make_mesh(axes=("dp",), devices=jax.devices()[:1])
     step = TrainStep(net, loss_fn, mesh, learning_rate=0.1, momentum=0.9)
 
-    x = jnp.asarray(np.random.randn(BATCH, 3, 224, 224), jnp.bfloat16)
-    y = jnp.asarray(np.random.randint(0, 1000, BATCH), jnp.int32)
+    x = jnp.asarray(np.random.randn(batch, 3, 224, 224), jnp.bfloat16)
+    y = jnp.asarray(np.random.randint(0, 1000, batch), jnp.int32)
     xs, ys = step.shard_batch(x, y)
 
-    for _ in range(WARMUP):
+    for _ in range(warmup):
         loss = step(xs, ys)
     jax.block_until_ready(loss._jax if hasattr(loss, "_jax") else loss)
 
     t0 = time.perf_counter()
-    for _ in range(ITERS):
+    for _ in range(iters):
         loss = step(xs, ys)
     jax.block_until_ready(loss._jax if hasattr(loss, "_jax") else loss)
     dt = time.perf_counter() - t0
 
-    img_per_sec = BATCH * ITERS / dt
+    img_per_sec = batch * iters / dt
+    # MFU diagnostic: ResNet-50 fwd+bwd ~= 3x 3.87 GFLOP/img at 224x224.
+    tflops = img_per_sec * 3 * 3.87e9 / 1e12
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(img_per_sec, 2),
         "unit": "images/sec",
         "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 4),
+        "device": jax.default_backend(),
+        "batch": batch,
+        "tflops": round(tflops, 2),
     }))
+
+
+def _run_child(platform):
+    """Run the benchmark pinned to `platform`; return (rc, stdout)."""
+    env = dict(os.environ, MX_BENCH_CHILD="1", MX_BENCH_PLATFORM=platform)
+    env.pop("MX_FORCE_CPU", None)
+    env.pop("JAX_PLATFORMS", None)
+    if platform == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"  # belt; run_bench's config.update is braces
+    try:
+        r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                           env=env, timeout=CHILD_TIMEOUT_S,
+                           stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    except subprocess.TimeoutExpired as e:
+        if e.stderr:  # the wedge's last words are the only diagnostics
+            sys.stderr.write(e.stderr.decode(errors="replace")[-4000:])
+        return 124, ""
+    sys.stderr.write(r.stderr.decode(errors="replace")[-4000:])
+    return r.returncode, r.stdout.decode(errors="replace")
+
+
+def main():
+    if os.environ.get("MX_BENCH_CHILD"):
+        run_bench()
+        return
+    from mxnet_tpu.base import cpu_pinned_by_user, probe_accelerator
+    if cpu_pinned_by_user():
+        candidates = ["cpu"]  # honor MX_FORCE_CPU=1 / JAX_PLATFORMS=cpu
+    else:
+        healthy = probe_accelerator(PROBE_TIMEOUT_S)
+        candidates = (["accelerator"] if healthy else []) + ["cpu"]
+    for platform in candidates:
+        rc, out = _run_child(platform)
+        lines = [l for l in out.splitlines() if l.startswith("{")]
+        if rc == 0 and lines:
+            print(lines[-1])
+            return
+        sys.stderr.write("bench child on %r failed rc=%s\n" % (platform, rc))
+    # Absolute last resort: a well-formed JSON error record, not a traceback.
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": 0.0, "unit": "images/sec", "vs_baseline": 0.0,
+        "error": "no backend could run the benchmark",
+    }))
+    sys.exit(0)
 
 
 if __name__ == "__main__":

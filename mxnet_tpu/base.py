@@ -109,3 +109,72 @@ class environment:
         for k, v in self._saved.items():
             set_env(k, v)
         return False
+
+
+# ---------------------------------------------------------------------------
+# Accelerator tunnel health (TPU-via-axon deployments).  A wedged tunnel makes
+# jax backend init HANG (not error) — and the axon plugin force-sets
+# jax.config jax_platforms="axon,cpu", overriding the JAX_PLATFORMS env var.
+# These helpers are the single implementation behind bench.py, the driver
+# entry points and any tool that must never hang on a dead tunnel.
+# ---------------------------------------------------------------------------
+
+def cpu_pinned_by_user() -> bool:
+    """True if the operator explicitly pinned CPU (MX_FORCE_CPU=1 or
+    JAX_PLATFORMS=cpu) — callers must honor it and skip accelerator probes."""
+    if os.environ.get("MX_FORCE_CPU") == "1":
+        return True
+    return os.environ.get("JAX_PLATFORMS", "").strip() == "cpu"
+
+
+_probe_result: Optional[bool] = None
+
+
+def probe_accelerator(timeout_s: float = 120.0) -> bool:
+    """True iff jax's default backend is a healthy accelerator.
+
+    Probed in a SUBPROCESS with a hard timeout: in-process backend init on a
+    wedged tunnel blocks forever with no way to recover.  A probe timeout is
+    treated as definitively wedged (hangs don't flake) — no retry.  The
+    result is memoized for the process lifetime (the probe costs a full jax
+    startup, and the wedged/healthy state doesn't change underneath one
+    process by the same hangs-don't-flake reasoning)."""
+    global _probe_result
+    if _probe_result is not None:
+        return _probe_result
+    import subprocess
+    import sys
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("MX_FORCE_CPU", None)
+    code = "import jax; d = jax.devices(); assert jax.default_backend() != 'cpu'"
+    _probe_result = False
+    try:
+        r = subprocess.run([sys.executable, "-c", code], env=env,
+                           timeout=timeout_s,
+                           stdout=subprocess.DEVNULL,
+                           stderr=subprocess.DEVNULL)
+        _probe_result = r.returncode == 0
+    except subprocess.TimeoutExpired:
+        pass  # wedged: hangs don't flake, and a quick rc!=0 (no plugin) is
+        #       deterministic — one attempt decides either way
+    return _probe_result
+
+
+def pin_cpu() -> None:
+    """Pin jax to the cpu backend via config (the env var alone is NOT
+    enough: the axon plugin overrides it with jax.config.update)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
+def ensure_live_backend(timeout_s: float = 120.0) -> str:
+    """Honor an explicit user CPU pin; otherwise probe the accelerator and
+    pin cpu if it is wedged.  Returns "cpu" or "accelerator"."""
+    if cpu_pinned_by_user():
+        pin_cpu()
+        return "cpu"
+    if probe_accelerator(timeout_s):
+        return "accelerator"
+    pin_cpu()
+    return "cpu"

@@ -17,6 +17,15 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# The axon TPU plugin's sitecustomize force-overrides the platform list with
+# jax.config.update("jax_platforms", "axon,cpu"), IGNORING the JAX_PLATFORMS
+# env var — and any jax.devices() call then hangs forever on a wedged TPU
+# tunnel. Re-override the config back to cpu-only before anything touches a
+# backend.
+from mxnet_tpu.base import pin_cpu
+
+pin_cpu()
+
 import numpy as np
 import pytest
 
