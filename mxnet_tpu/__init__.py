@@ -20,6 +20,8 @@ from . import autograd
 from . import random
 from .ndarray import NDArray, waitall
 
+from . import symbol
+from . import symbol as sym
 from . import initializer
 from . import initializer as init
 from . import metric

@@ -214,6 +214,12 @@ class Block:
     def __call__(self, *args, **kwargs):
         for hook in self._forward_pre_hooks.values():
             hook(self, args)
+        # remember input avals so export() can re-trace without a sample
+        # (reference: CachedOp keeps the traced graph; here the trace is
+        # reconstructed on demand from shapes)
+        if args and all(isinstance(a, NDArray) for a in args):
+            object.__setattr__(self, "_last_input_avals",
+                               [(a.shape, str(a.dtype)) for a in args])
         out = self._call_impl(*args, **kwargs)
         for hook in self._forward_hooks.values():
             hook(self, args, out)

@@ -661,7 +661,24 @@ def _norm_axis(axis):
 # eager dispatch (reference: MXImperativeInvokeEx -> Imperative::Invoke)
 # ---------------------------------------------------------------------------
 
+# Symbol-trace hook (set by mxnet_tpu.symbol.trace_block): when non-None,
+# every invoke() is also recorded as a graph node — the imperative run IS
+# the trace (reference: hybrid_forward Symbol-proxy tracing).
+_sym_tracer = None
+
+
 def invoke(op_name: str, *inputs, out=None, **params):
+    ret = _invoke_impl(op_name, *inputs, out=out, **params)
+    tracer = _sym_tracer
+    if tracer is not None:
+        tracer.record(op_name,
+                      {k: v for k, v in params.items()
+                       if k not in ("ctx", "name")},
+                      inputs, ret)
+    return ret
+
+
+def _invoke_impl(op_name: str, *inputs, out=None, **params):
     """Invoke a registered op on NDArrays (HOT LOOP 1, SURVEY.md §3.2).
 
     - unwraps inputs to jax.Arrays (committed to their context's device)

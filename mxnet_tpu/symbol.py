@@ -1,0 +1,627 @@
+"""Symbol API: light DAG + symbol.json serialization + executor surface.
+
+Reference: python/mxnet/symbol/symbol.py (class Symbol, Symbol.tojson,
+Symbol.load, Symbol.simple_bind), src/nnvm (nnvm::Symbol / nnvm::Graph
+serialization), python/mxnet/executor.py (class Executor).
+
+TPU-native design (SURVEY.md §7.0): the *compute* graph IR of the rebuild is
+the jaxpr — XLA owns optimization.  This module keeps only what SURVEY says
+must be kept: the **serialization format** (`symbol.json`), the composition
+API (`mx.sym.Variable` + op calls mirroring `mx.nd.*` through the same op
+registry), and the executor-shaped wrapper so `HybridBlock.export()` /
+`SymbolBlock.imports()` round-trip deploy artifacts.  Execution of a loaded
+symbol is a topo-order walk dispatching each node through the eager op
+registry (`ndarray.invoke`) — i.e. it rides the per-op jit cache, and a
+bound Executor's forward can additionally be wrapped in one `jax.jit`.
+
+JSON format notes: same container layout as MXNet (`nodes` with
+``[[id, out_idx, version]]`` inputs, ``arg_nodes``, ``node_row_ptr``,
+``heads``, stringified ``attrs``), with this rebuild's op names (the op
+registry is the authority, like nnvm's registry was).
+"""
+from __future__ import annotations
+
+import ast
+import json
+import numbers
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .device import Context, current_context, cpu
+from .ops.registry import get_op, list_ops, cached_jit
+from .ndarray import ndarray as _nd_mod
+from .ndarray.ndarray import NDArray
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "loads",
+           "evaluate", "symbol_json_from_block", "Executor"]
+
+_MXNET_VERSION = 20000  # era tag written into symbol.json attrs
+
+
+# ---------------------------------------------------------------------------
+# graph nodes
+# ---------------------------------------------------------------------------
+
+class _SymNode:
+    """One graph node. op == "null" → variable (argument); "_const" → an
+    inlined literal (attrs["value"])."""
+    __slots__ = ("op", "name", "attrs", "inputs")
+
+    def __init__(self, op: str, name: str, attrs: Dict[str, str],
+                 inputs: List[Tuple["_SymNode", int]]):
+        self.op = op
+        self.name = name
+        self.attrs = attrs
+        self.inputs = inputs
+
+
+def _attr_str(v: Any) -> str:
+    """Stringify an op param the MXNet way (attrs are str→str in json)."""
+    if isinstance(v, str):
+        return v
+    if isinstance(v, bool):
+        return "True" if v else "False"
+    if isinstance(v, (list, tuple)):
+        return "(" + ", ".join(_attr_str(x) for x in v) + \
+            (",)" if len(v) == 1 else ")")
+    return str(v)
+
+
+def _attr_parse(s: str) -> Any:
+    """Parse a stringified attr back to a python value (best effort)."""
+    if not isinstance(s, str):
+        return s
+    if s == "None":
+        return None
+    try:
+        return ast.literal_eval(s)
+    except (ValueError, SyntaxError):
+        return s
+
+
+def _topo(heads: Sequence[Tuple[_SymNode, int]]) -> List[_SymNode]:
+    order: List[_SymNode] = []
+    seen: Dict[int, bool] = {}
+    stack = [(n, False) for n, _ in reversed(heads)]
+    while stack:
+        node, expanded = stack.pop()
+        if expanded:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen[id(node)] = True
+        stack.append((node, True))
+        for inp, _ in reversed(node.inputs):
+            if id(inp) not in seen:
+                stack.append((inp, False))
+    return order
+
+
+# ---------------------------------------------------------------------------
+# Symbol
+# ---------------------------------------------------------------------------
+
+class Symbol:
+    """Immutable handle on one or more graph outputs (reference:
+    python/mxnet/symbol/symbol.py (class Symbol))."""
+
+    def __init__(self, heads: List[Tuple[_SymNode, int]]):
+        self._heads = list(heads)
+
+    # -- construction helpers ------------------------------------------------
+    @property
+    def name(self) -> str:
+        return self._heads[0][0].name
+
+    def __iter__(self):
+        return (Symbol([h]) for h in self._heads)
+
+    def __len__(self):
+        return len(self._heads)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, str):
+            for n, i in self._heads:
+                if n.name == idx:
+                    return Symbol([(n, i)])
+            raise ValueError("no output named %r" % idx)
+        return Symbol([self._heads[idx]] if isinstance(idx, int)
+                      else self._heads[idx])
+
+    def get_internals(self) -> "Symbol":
+        """All intermediate outputs (reference: Symbol.get_internals)."""
+        return Symbol([(n, 0) for n in _topo(self._heads)])
+
+    def list_outputs(self) -> List[str]:
+        return ["%s_output" % n.name if n.op != "null" else n.name
+                for n, _ in self._heads]
+
+    def list_arguments(self) -> List[str]:
+        return [n.name for n in _topo(self._heads)
+                if n.op == "null" and not _is_aux_name(n.name)]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return [n.name for n in _topo(self._heads)
+                if n.op == "null" and _is_aux_name(n.name)]
+
+    def list_inputs(self) -> List[str]:
+        return [n.name for n in _topo(self._heads) if n.op == "null"]
+
+    def attr(self, key: str) -> Optional[str]:
+        return self._heads[0][0].attrs.get(key)
+
+    def list_attr(self) -> Dict[str, str]:
+        return dict(self._heads[0][0].attrs)
+
+    def attr_dict(self) -> Dict[str, Dict[str, str]]:
+        return {n.name: dict(n.attrs) for n in _topo(self._heads) if n.attrs}
+
+    # -- arithmetic ----------------------------------------------------------
+    def _binop(self, op: str, other, reverse: bool = False) -> "Symbol":
+        if isinstance(other, numbers.Number):
+            other = _const(other)
+        elif not isinstance(other, Symbol):
+            raise TypeError("unsupported operand: %r" % (other,))
+        a, b = (other, self) if reverse else (self, other)
+        return _make_op_symbol(op, [a, b], {})
+
+    def __add__(self, o):  return self._binop("broadcast_add", o)
+    def __radd__(self, o): return self._binop("broadcast_add", o, True)
+    def __sub__(self, o):  return self._binop("broadcast_sub", o)
+    def __rsub__(self, o): return self._binop("broadcast_sub", o, True)
+    def __mul__(self, o):  return self._binop("broadcast_mul", o)
+    def __rmul__(self, o): return self._binop("broadcast_mul", o, True)
+    def __truediv__(self, o):  return self._binop("broadcast_div", o)
+    def __rtruediv__(self, o): return self._binop("broadcast_div", o, True)
+    def __pow__(self, o):  return self._binop("broadcast_power", o)
+    def __neg__(self):     return _make_op_symbol("negative", [self], {})
+
+    def __repr__(self):
+        return "<Symbol %s>" % " ".join(n.name for n, _ in self._heads)
+
+    # -- serialization -------------------------------------------------------
+    def tojson(self) -> str:
+        nodes = _topo(self._heads)
+        nid = {id(n): i for i, n in enumerate(nodes)}
+        out_nodes = []
+        for n in nodes:
+            out_nodes.append({
+                "op": n.op if n.op != "null" else "null",
+                "name": n.name,
+                **({"attrs": dict(n.attrs)} if n.attrs else {}),
+                "inputs": [[nid[id(i)], idx, 0] for i, idx in n.inputs],
+            })
+        return json.dumps({
+            "nodes": out_nodes,
+            "arg_nodes": [i for i, n in enumerate(nodes) if n.op == "null"],
+            "node_row_ptr": list(range(len(nodes) + 1)),
+            "heads": [[nid[id(n)], idx, 0] for n, idx in self._heads],
+            "attrs": {"mxnet_version": ["int", _MXNET_VERSION]},
+        }, indent=2)
+
+    def save(self, fname: str) -> None:
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    @staticmethod
+    def load(fname: str) -> "Symbol":
+        return load(fname)
+
+    @staticmethod
+    def load_json(js: str) -> "Symbol":
+        return loads(js)
+
+    # -- shape/type inference ------------------------------------------------
+    def infer_shape(self, **known):
+        """Returns (arg_shapes, out_shapes, aux_shapes) ordered like
+        list_arguments()/list_outputs()/list_auxiliary_states()."""
+        return self._infer(known, want="shape")
+
+    def infer_type(self, **known):
+        return self._infer(known, want="dtype")
+
+    def _infer(self, known, want: str):
+        nodes = _topo(self._heads)
+        avals: Dict[int, List[jax.ShapeDtypeStruct]] = {}
+        for n in nodes:
+            if n.op == "null":
+                if n.name in known:
+                    v = known[n.name]
+                    if want == "shape":
+                        aval = jax.ShapeDtypeStruct(tuple(v), jnp.float32)
+                    else:
+                        aval = jax.ShapeDtypeStruct((), _np.dtype(v))
+                    avals[id(n)] = [aval]
+                else:
+                    shp = _attr_parse(n.attrs.get("__shape__", "None"))
+                    dt = n.attrs.get("__dtype__", "float32")
+                    if shp is None and want == "shape":
+                        raise MXNetError(
+                            "infer_shape: missing shape for argument %r"
+                            % n.name)
+                    avals[id(n)] = [jax.ShapeDtypeStruct(
+                        tuple(shp or ()), _np.dtype(dt))]
+            elif n.op == "_const":
+                val = _np.asarray(_attr_parse(n.attrs["value"]), _np.float32)
+                avals[id(n)] = [jax.ShapeDtypeStruct(val.shape, val.dtype)]
+            else:
+                avals[id(n)] = _node_eval_shape(n, avals)
+        args = [avals[id(n)][0] for n in nodes
+                if n.op == "null" and not _is_aux_name(n.name)]
+        auxs = [avals[id(n)][0] for n in nodes
+                if n.op == "null" and _is_aux_name(n.name)]
+        outs = [avals[id(n)][i] for n, i in self._heads]
+        pick = (lambda a: tuple(a.shape)) if want == "shape" else \
+            (lambda a: _np.dtype(str(a.dtype)))
+        return ([pick(a) for a in args], [pick(a) for a in outs],
+                [pick(a) for a in auxs])
+
+    # -- execution -----------------------------------------------------------
+    def eval(self, ctx: Optional[Context] = None, **kwargs):
+        """Evaluate with NDArray feeds (reference: Symbol.eval)."""
+        out = evaluate(self, kwargs, {}, ctx=ctx)
+        return out if isinstance(out, list) else [out]
+
+    def bind(self, ctx, args, args_grad=None, grad_req="write",
+             aux_states=None) -> "Executor":
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states)
+
+    def simple_bind(self, ctx=None, grad_req="write", **shapes) -> "Executor":
+        """Allocate arguments from shapes and bind (reference:
+        Symbol.simple_bind → GraphExecutor::Init; here allocation is plain
+        NDArray zeros — XLA owns memory planning, SURVEY §2.1)."""
+        ctx = ctx or current_context()
+        arg_shapes, _, aux_shapes = self.infer_shape(**shapes)
+        args = {}
+        for name, shp in zip(self.list_arguments(), arg_shapes):
+            args[name] = _nd_mod.zeros(shp, ctx=ctx)
+        aux = {}
+        for name, shp in zip(self.list_auxiliary_states(), aux_shapes):
+            aux[name] = _nd_mod.zeros(shp, ctx=ctx)
+        grads = None
+        if grad_req != "null":
+            grads = {n: _nd_mod.zeros(s, ctx=ctx)
+                     for n, s in zip(self.list_arguments(), arg_shapes)}
+        return Executor(self, ctx, args, grads, grad_req, aux)
+
+
+def _is_aux_name(name: str) -> bool:
+    """Aux-state heuristic: BatchNorm-style running statistics (the rebuild
+    has no per-op aux declaration in the graph; naming is the convention)."""
+    return ("running_mean" in name or "running_var" in name
+            or "moving_mean" in name or "moving_var" in name)
+
+
+# ---------------------------------------------------------------------------
+# op-call composition (mx.sym.<op>(...) mirrors mx.nd.<op>(...))
+# ---------------------------------------------------------------------------
+
+_name_counter: Dict[str, int] = {}
+
+
+def _gen_name(op: str) -> str:
+    base = op.lower().lstrip("_")
+    n = _name_counter.get(base, 0)
+    _name_counter[base] = n + 1
+    return "%s%d" % (base, n)
+
+
+def _const(value) -> Symbol:
+    node = _SymNode("_const", _gen_name("const"),
+                    {"value": _attr_str(value)}, [])
+    return Symbol([(node, 0)])
+
+
+def _make_op_symbol(op_name: str, inputs: List[Symbol],
+                    params: Dict[str, Any], name: Optional[str] = None) -> Symbol:
+    op = get_op(op_name)   # raises if unknown
+    attrs = {k: _attr_str(v) for k, v in params.items() if v is not None}
+    in_heads: List[Tuple[_SymNode, int]] = []
+    for s in inputs:
+        if isinstance(s, numbers.Number):
+            s = _const(s)
+        if not isinstance(s, Symbol):
+            raise TypeError("%s: inputs must be Symbols, got %r"
+                            % (op_name, type(s)))
+        if len(s._heads) != 1:
+            raise MXNetError("%s: cannot use a multi-output symbol directly "
+                             "as input; index it first" % op_name)
+        in_heads.append(s._heads[0])
+    node = _SymNode(op.name, name or _gen_name(op_name), attrs, in_heads)
+    n_out = op.num_outputs
+    if op.aux_writeback:
+        n_out = n_out - len(op.aux_writeback)
+    if n_out == 1:
+        return Symbol([(node, 0)])
+    return Symbol([(node, i) for i in range(n_out)])
+
+
+def Variable(name: str, shape=None, dtype=None, **kwargs) -> Symbol:
+    attrs = {}
+    if shape is not None:
+        attrs["__shape__"] = _attr_str(tuple(shape))
+    if dtype is not None:
+        attrs["__dtype__"] = str(dtype)
+    attrs.update({k: _attr_str(v) for k, v in kwargs.items()})
+    return Symbol([(_SymNode("null", name, attrs, []), 0)])
+
+
+var = Variable
+
+
+def Group(symbols: Sequence[Symbol]) -> Symbol:
+    heads = []
+    for s in symbols:
+        heads.extend(s._heads)
+    return Symbol(heads)
+
+
+def __getattr__(name: str):
+    """mx.sym.<op> for every registered op (module __getattr__, PEP 562)."""
+    try:
+        get_op(name)
+    except KeyError:
+        raise AttributeError("module 'symbol' has no attribute %r" % name)
+    op_name = name
+
+    def op_call(*inputs, name=None, **params):
+        return _make_op_symbol(op_name, list(inputs), params, name=name)
+    op_call.__name__ = op_name
+    return op_call
+
+
+# ---------------------------------------------------------------------------
+# serialization: load / loads
+# ---------------------------------------------------------------------------
+
+def loads(js: str) -> Symbol:
+    g = json.loads(js)
+    raw_nodes = g["nodes"]
+    built: List[_SymNode] = []
+    for rn in raw_nodes:
+        attrs = dict(rn.get("attrs", rn.get("param", {})))
+        inputs = [(built[i], idx) for i, idx, *_ in rn.get("inputs", [])]
+        built.append(_SymNode(rn["op"], rn["name"], attrs, inputs))
+    heads = g.get("heads")
+    if not heads:
+        heads = [[len(built) - 1, 0, 0]]
+    return Symbol([(built[i], idx) for i, idx, *_ in heads])
+
+
+def load(fname: str) -> Symbol:
+    with open(fname) as f:
+        return loads(f.read())
+
+
+# ---------------------------------------------------------------------------
+# execution: evaluate / Executor
+# ---------------------------------------------------------------------------
+
+def evaluate(sym: Symbol, feeds: Dict[str, Any], params: Dict[str, Any],
+             ctx: Optional[Context] = None):
+    """Topo-order execution through the eager op registry (each node rides
+    the per-op jit cache; reference: GraphExecutor::RunOps role)."""
+    ctx = ctx or current_context()
+    values: Dict[int, List[NDArray]] = {}
+    nodes = _topo(sym._heads)
+    for n in nodes:
+        if n.op == "null":
+            v = feeds.get(n.name, params.get(n.name))
+            if v is None:
+                raise MXNetError("evaluate: missing value for argument %r"
+                                 % n.name)
+            if not isinstance(v, NDArray):
+                v = _nd_mod.array(v, ctx=ctx)
+            values[id(n)] = [v]
+        elif n.op == "_const":
+            values[id(n)] = [_nd_mod.array(
+                _attr_parse(n.attrs["value"]), ctx=ctx)]
+        else:
+            ins = [values[id(i)][idx] for i, idx in n.inputs]
+            kw = {k: _attr_parse(v) for k, v in n.attrs.items()
+                  if not k.startswith("__")}
+            out = _nd_mod.invoke(n.op, *ins, **kw)
+            values[id(n)] = out if isinstance(out, list) else [out]
+    outs = [values[id(n)][i] for n, i in sym._heads]
+    return outs if len(outs) != 1 else outs[0]
+
+
+def _node_eval_shape(n: _SymNode, avals) -> List[jax.ShapeDtypeStruct]:
+    op = get_op(n.op)
+    kw = {k: _attr_parse(v) for k, v in n.attrs.items()
+          if not k.startswith("__")}
+    ins = [avals[id(i)][idx] for i, idx in n.inputs]
+    fn = cached_jit(op.name, kw)
+    if op.needs_rng:
+        from .ops import random as _rnd
+        key = _rnd.next_key()
+        ins = [jax.ShapeDtypeStruct(key.shape, key.dtype)] + ins
+    out = jax.eval_shape(fn, *ins)
+    return list(out) if isinstance(out, (list, tuple)) else [out]
+
+
+class Executor:
+    """Bound computation (reference: python/mxnet/executor.py (Executor),
+    src/executor/graph_executor.cc — memory planning here is XLA's job)."""
+
+    def __init__(self, sym: Symbol, ctx, args, args_grad=None,
+                 grad_req="write", aux_states=None):
+        self._sym = sym
+        self._ctx = ctx or current_context()
+        if isinstance(args, (list, tuple)):
+            args = dict(zip(sym.list_arguments(), args))
+        self.arg_dict: Dict[str, NDArray] = dict(args)
+        if isinstance(args_grad, (list, tuple)):
+            args_grad = dict(zip(sym.list_arguments(), args_grad))
+        self.grad_dict: Dict[str, NDArray] = dict(args_grad or {})
+        self.aux_dict: Dict[str, NDArray] = dict(aux_states or {})
+        self._grad_req = grad_req
+        self.outputs: List[NDArray] = []
+
+    def forward(self, is_train: bool = False, **feeds):
+        from . import autograd
+        vals = dict(self.arg_dict)
+        vals.update(self.aux_dict)
+        for k, v in feeds.items():
+            if not isinstance(v, NDArray):
+                v = _nd_mod.array(v, ctx=self._ctx)
+            self.arg_dict[k] = v
+            vals[k] = v
+        if is_train and self._grad_req != "null":
+            for name, arr in self.arg_dict.items():
+                if name in self.grad_dict:
+                    arr.attach_grad(self._grad_req)
+            with autograd.record():
+                out = evaluate(self._sym, vals, {}, ctx=self._ctx)
+        else:
+            out = evaluate(self._sym, vals, {}, ctx=self._ctx)
+        self.outputs = out if isinstance(out, list) else [out]
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        from . import autograd
+        heads = self.outputs
+        if out_grads is None:
+            autograd.backward(heads)
+        else:
+            if not isinstance(out_grads, (list, tuple)):
+                out_grads = [out_grads]
+            autograd.backward(heads, list(out_grads))
+        for name, arr in self.arg_dict.items():
+            g = arr.grad
+            if g is not None and name in self.grad_dict:
+                self.grad_dict[name]._set_jax(g._jax)
+
+    @property
+    def grad_arrays(self) -> List[NDArray]:
+        return [self.grad_dict.get(n) for n in self._sym.list_arguments()]
+
+    @property
+    def arg_arrays(self) -> List[NDArray]:
+        return [self.arg_dict[n] for n in self._sym.list_arguments()]
+
+    def copy_params_from(self, arg_params, aux_params=None):
+        for k, v in arg_params.items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._set_jax(v._jax if isinstance(v, NDArray)
+                                          else jnp.asarray(v))
+        for k, v in (aux_params or {}).items():
+            if k in self.aux_dict:
+                self.aux_dict[k]._set_jax(v._jax if isinstance(v, NDArray)
+                                          else jnp.asarray(v))
+
+
+# ---------------------------------------------------------------------------
+# block → symbol tracing (HybridBlock.export backbone)
+# ---------------------------------------------------------------------------
+
+class _Tracer:
+    """Records every `ndarray.invoke` into graph nodes during a concrete
+    forward run (the reference traces hybrid_forward with Symbol proxies;
+    here the imperative run itself is the trace — same graphs, no proxy
+    type, because every NDArray op routes through one dispatcher)."""
+
+    def __init__(self):
+        self.node_of: Dict[int, Tuple[_SymNode, int]] = {}  # id(NDArray) →
+        self.names = {}
+
+    def add_variable(self, arr: NDArray, name: str) -> None:
+        node = _SymNode("null", name, {}, [])
+        self.node_of[id(arr)] = (node, 0)
+
+    def _lookup(self, x) -> Tuple[_SymNode, int]:
+        if isinstance(x, NDArray):
+            hit = self.node_of.get(id(x))
+            if hit is not None:
+                return hit
+            # NDArray produced outside the recorded region (e.g. a python
+            # view) — inline it as a constant
+            if x.size > 1 << 16:
+                raise MXNetError(
+                    "symbol trace: large untracked input (%s elements); "
+                    "ops feeding export()ed graphs must flow through the "
+                    "op registry" % x.size)
+            node = _SymNode("_const", _gen_name("const"),
+                            {"value": _attr_str(
+                                _np.asarray(x.asnumpy()).tolist())}, [])
+            self.node_of[id(x)] = (node, 0)
+            return (node, 0)
+        # python scalar / numpy value
+        node = _SymNode("_const", _gen_name("const"),
+                        {"value": _attr_str(
+                            _np.asarray(x).tolist() if not isinstance(
+                                x, numbers.Number) else x)}, [])
+        return (node, 0)
+
+    def record(self, op_name: str, params: Dict[str, Any], inputs, ret):
+        op = get_op(op_name)
+        attrs = {k: _attr_str(v) for k, v in params.items()
+                 if v is not None and k not in ("ctx", "name")}
+        in_heads = [self._lookup(x) for x in inputs if x is not None]
+        node = _SymNode(op.name, _gen_name(op_name), attrs, in_heads)
+        outs = ret if isinstance(ret, (list, tuple)) else [ret]
+        for i, o in enumerate(outs):
+            if isinstance(o, NDArray):
+                self.node_of[id(o)] = (node, i)
+
+
+def trace_block(block, *inputs, input_names: Optional[List[str]] = None
+                ) -> Symbol:
+    """Run `block` once on `inputs` recording the op graph; parameters
+    become named Variables (structural names), inputs become `data`
+    variables. Returns the output Symbol."""
+    from . import autograd
+    from .gluon.block import Block
+
+    tracer = _Tracer()
+    params = block.collect_params()
+    ctx = None
+    for x in inputs:
+        if isinstance(x, NDArray):
+            ctx = x.context
+            break
+    ctx = ctx or cpu()
+    for name, p in params.items():
+        if p._data is not None:
+            tracer.add_variable(p.data(ctx), name)
+    if input_names is None:
+        input_names = ["data"] if len(inputs) == 1 else \
+            ["data%d" % i for i in range(len(inputs))]
+    for x, nm in zip(inputs, input_names):
+        if isinstance(x, NDArray):
+            tracer.add_variable(x, nm)
+    prev = _nd_mod._sym_tracer
+    _nd_mod._sym_tracer = tracer
+    try:
+        with autograd.pause():
+            # run the un-hybridized path: the imperative ops ARE the trace
+            out = Block._call_impl(block, *inputs)
+    finally:
+        _nd_mod._sym_tracer = prev
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    heads = []
+    for o in outs:
+        hit = tracer.node_of.get(id(o))
+        if hit is None:
+            raise MXNetError("symbol trace: block output was not produced "
+                             "by registry ops; cannot export")
+        heads.append(hit)
+    return Symbol(heads)
+
+
+def symbol_json_from_block(block) -> str:
+    """Serialize a HybridBlock's traced graph (reference:
+    HybridBlock.export → Symbol.tojson). Requires the block to have been
+    run at least once (shapes known)."""
+    shapes = getattr(block, "_last_input_avals", None)
+    if shapes is None:
+        raise MXNetError(
+            "export: run the block on real inputs at least once before "
+            "export() (the reference requires hybridize()+forward too)")
+    inputs = [_nd_mod.zeros(s, dtype=d, ctx=cpu()) for s, d in shapes]
+    return trace_block(block, *inputs).tojson()

@@ -1,0 +1,170 @@
+"""Symbol API tests.
+
+Reference patterns: tests/python/unittest/test_symbol.py (compose, json
+roundtrip, infer_shape), test_gluon.py export/imports roundtrips, and the
+Executor surface of python/mxnet/executor.py.
+"""
+import json
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.block import SymbolBlock
+
+
+def test_compose_eval():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = 2.0 * a + b / 2.0
+    out = c.eval(a=nd.array([1.0, 2.0]), b=nd.array([10.0, 20.0]))
+    np.testing.assert_allclose(out[0].asnumpy(), [7.0, 14.0])
+
+
+def test_op_namespace_mirrors_nd():
+    x = sym.Variable("x")
+    y = sym.relu(sym.dot(x, x))
+    v = nd.array([[1.0, -2.0], [3.0, 4.0]])
+    out = y.eval(x=v)[0]
+    expect = np.maximum(v.asnumpy() @ v.asnumpy(), 0)
+    np.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-6)
+
+
+def test_json_roundtrip():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = sym.broadcast_mul(sym.broadcast_add(a, b), a, name="prod")
+    js = c.tojson()
+    g = json.loads(js)
+    assert {n["op"] for n in g["nodes"]} == {"null", "broadcast_add",
+                                            "broadcast_mul"}
+    assert g["heads"] and g["arg_nodes"] == [0, 1]
+    assert g["node_row_ptr"][-1] == len(g["nodes"])
+    c2 = sym.loads(js)
+    assert c2.list_arguments() == ["a", "b"]
+    va, vb = nd.array([2.0]), nd.array([3.0])
+    np.testing.assert_allclose(c2.eval(a=va, b=vb)[0].asnumpy(), [10.0])
+
+
+def test_save_load_file(tmp_path):
+    a = sym.Variable("a")
+    s = sym.exp(a)
+    f = str(tmp_path / "s.json")
+    s.save(f)
+    s2 = mx.symbol.load(f)
+    np.testing.assert_allclose(
+        s2.eval(a=nd.array([0.0, 1.0]))[0].asnumpy(),
+        np.exp([0.0, 1.0]), rtol=1e-6)
+
+
+def test_infer_shape_and_type():
+    d = sym.Variable("data")
+    w = sym.Variable("w")
+    o = sym.dot(d, w)
+    arg_shapes, out_shapes, aux_shapes = o.infer_shape(data=(4, 3), w=(3, 7))
+    assert arg_shapes == [(4, 3), (3, 7)]
+    assert out_shapes == [(4, 7)]
+    assert aux_shapes == []
+
+
+def test_group_and_internals():
+    a = sym.Variable("a")
+    b = sym.sigmoid(a)
+    c = sym.tanh(a)
+    g = sym.Group([b, c])
+    assert len(g) == 2
+    outs = g.eval(a=nd.array([0.0]))
+    assert len(outs) == 2
+    np.testing.assert_allclose(outs[0].asnumpy(), [0.5])
+    internals = b.get_internals()
+    assert "a" in internals.list_outputs()[0] or \
+        "a" in [s.name for s in internals]
+
+
+def test_scalar_const_nodes():
+    a = sym.Variable("a")
+    c = (a + 1.5) * 2.0
+    js = c.tojson()
+    assert "_const" in js
+    out = sym.loads(js).eval(a=nd.array([1.0]))[0]
+    np.testing.assert_allclose(out.asnumpy(), [5.0])
+
+
+def test_export_imports_dense(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    x = nd.random.normal(shape=(3, 8))
+    y0 = net(x)
+    prefix = str(tmp_path / "dense")
+    sf, pf = net.export(prefix)
+    sb = SymbolBlock.imports(sf, ["data"], pf)
+    y1 = sb(x)
+    np.testing.assert_allclose(y0.asnumpy(), y1.asnumpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_export_imports_conv_bn(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1), nn.BatchNorm(),
+            nn.Activation("relu"), nn.MaxPool2D(), nn.Flatten(),
+            nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    x = nd.random.normal(shape=(2, 3, 16, 16))
+    y0 = net(x)
+    prefix = str(tmp_path / "conv")
+    sf, pf = net.export(prefix)
+    loaded = mx.symbol.load(sf)
+    assert loaded.list_auxiliary_states() == ["1.running_mean",
+                                              "1.running_var"]
+    assert "data" in loaded.list_arguments()
+    sb = SymbolBlock.imports(sf, ["data"], pf)
+    y1 = sb(x)
+    np.testing.assert_allclose(y0.asnumpy(), y1.asnumpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_export_requires_forward(tmp_path):
+    net = nn.Dense(4)
+    net.initialize()
+    with pytest.raises(mx.MXNetError):
+        net.export(str(tmp_path / "nofwd"))
+
+
+def test_executor_forward_backward():
+    d = sym.Variable("data")
+    w = sym.Variable("w")
+    o = sym.sum(sym.dot(d, w))
+    exe = o.simple_bind(mx.cpu(), data=(4, 3), w=(3, 2))
+    dv = np.random.randn(4, 3).astype(np.float32)
+    wv = np.random.randn(3, 2).astype(np.float32)
+    exe.copy_params_from({"data": nd.array(dv), "w": nd.array(wv)})
+    outs = exe.forward(is_train=True)
+    np.testing.assert_allclose(outs[0].asnumpy(), (dv @ wv).sum(),
+                               rtol=1e-5)
+    exe.backward()
+    # d sum(d@w)/dw = d^T @ ones
+    np.testing.assert_allclose(exe.grad_dict["w"].asnumpy(),
+                               dv.T @ np.ones((4, 2), np.float32),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(exe.grad_dict["data"].asnumpy(),
+                               np.ones((4, 2), np.float32) @ wv.T,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_symbolblock_forward_is_hybridizable(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="tanh"), nn.Dense(2))
+    net.initialize()
+    x = nd.random.normal(shape=(2, 4))
+    y0 = net(x)
+    prefix = str(tmp_path / "hyb")
+    sf, pf = net.export(prefix)
+    sb = SymbolBlock.imports(sf, ["data"], pf)
+    y1 = sb(x)
+    y2 = sb(x)  # second call: cached path
+    np.testing.assert_allclose(y0.asnumpy(), y1.asnumpy(), rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(y1.asnumpy(), y2.asnumpy(), rtol=1e-6)
