@@ -22,6 +22,7 @@ from .ndarray import NDArray, waitall
 
 from . import symbol
 from . import symbol as sym
+from . import contrib
 from . import initializer
 from . import initializer as init
 from . import metric

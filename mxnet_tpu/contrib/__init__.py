@@ -1,0 +1,15 @@
+"""mx.contrib: control flow, detection ops, misc extensions.
+
+Reference: python/mxnet/contrib/__init__.py (ndarray/symbol contrib
+namespaces), python/mxnet/ndarray/contrib.py (foreach/while_loop/cond),
+src/operator/contrib/*.
+
+`mx.contrib.nd.<op>` mirrors the reference's contrib.ndarray namespace;
+the control-flow combinators live at both `mx.contrib.nd.foreach` and the
+2.x-style `mx.npx`-free top level here.
+"""
+from ..ops.control_flow import foreach, while_loop, cond
+from . import ndarray
+from . import ndarray as nd
+
+__all__ = ["foreach", "while_loop", "cond", "nd", "ndarray"]

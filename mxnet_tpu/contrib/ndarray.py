@@ -1,0 +1,38 @@
+"""mx.contrib.nd: contrib op namespace over NDArrays.
+
+Reference: python/mxnet/contrib/ndarray generated namespace — every
+`_contrib_*` registry op appears here without the prefix (MultiBoxPrior,
+box_nms, ROIAlign, interleaved attention ops, ...), plus the control-flow
+combinators.
+"""
+from __future__ import annotations
+
+import sys
+
+from ..ops import registry as _registry
+from ..ndarray.ndarray import invoke
+from ..ops.control_flow import foreach, while_loop, cond  # noqa: F401
+
+
+def _make(opname):
+    def fn(*args, out=None, **kwargs):
+        return invoke(opname, *args, out=out, **kwargs)
+    fn.__name__ = opname
+    fn.__doc__ = _registry.get_op(opname).doc
+    return fn
+
+
+_this = sys.modules[__name__]
+for _name in _registry.list_ops():
+    if _name.startswith("_contrib_"):
+        _short = _name[len("_contrib_"):]
+        if _short.isidentifier() and not hasattr(_this, _short):
+            setattr(_this, _short, _make(_name))
+# detection/spatial ops registered under bare names are contrib surface too
+for _name in ("MultiBoxPrior", "MultiBoxTarget", "MultiBoxDetection",
+              "ROIAlign", "box_iou", "box_nms"):
+    if not hasattr(_this, _name):
+        try:
+            setattr(_this, _name, _make(_name))
+        except KeyError:
+            pass

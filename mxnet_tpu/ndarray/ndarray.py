@@ -711,6 +711,10 @@ def _invoke_impl(op_name: str, *inputs, out=None, **params):
     from .. import autograd
     if autograd.is_recording() and op.differentiable:
         outs = autograd.record_op(op, params, inputs, jax_in, ctx)
+    elif op.no_jit:
+        # dynamic-output-shape op: eager only, outside the jit cache
+        outs = op.fn(*jax_in, **params)
+        outs = _wrap_outputs(op, outs, ctx)
     else:
         fn = cached_jit(op.name, params)
         outs = fn(*jax_in)

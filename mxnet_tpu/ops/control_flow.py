@@ -1,0 +1,241 @@
+"""Control-flow ops: foreach / while_loop / cond.
+
+Reference: src/operator/control_flow.cc (_foreach, _while_loop, _cond),
+python/mxnet/ndarray/contrib.py (foreach, while_loop, cond).
+
+TPU-native design: these lower DIRECTLY to `lax.scan` / `lax.while_loop` /
+`lax.cond` (SURVEY.md §2.1 control-flow row: "near-free").  The user body
+is a Python callable over NDArrays; inside the combinator the NDArrays wrap
+jax tracers (the same mechanism HybridBlock's CachedOp uses), so one XLA
+program is built for the whole loop — the reference needed subgraph ops +
+LoopState for this; XLA's native loop constructs replace all of it.
+
+Autograd: when the tape is recording, the whole combinator is recorded as
+ONE tape node whose VJP is `jax.vjp` over the scanned function —
+gradients flow through loops exactly as the reference's backward-through-
+subgraph did.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _flatten(obj, out: List[Any]):
+    from ..ndarray.ndarray import NDArray
+    if isinstance(obj, NDArray):
+        out.append(obj)
+        return None
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_flatten(x, out) for x in obj)
+    out.append(obj)
+    return None
+
+
+def _tree_to_jax(obj):
+    from ..ndarray.ndarray import NDArray
+    if isinstance(obj, NDArray):
+        return obj._jax
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_tree_to_jax(x) for x in obj)
+    return jnp.asarray(obj)
+
+
+def _tree_to_nd(obj, ctx):
+    from ..ndarray.ndarray import NDArray
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_tree_to_nd(x, ctx) for x in obj)
+    return NDArray(obj, ctx=ctx)
+
+
+def _first_ctx(*objs):
+    from ..ndarray.ndarray import NDArray
+    from ..device import current_context
+    for obj in objs:
+        leaves = jax.tree_util.tree_leaves(
+            obj, is_leaf=lambda x: isinstance(x, NDArray))
+        for leaf in leaves:
+            if isinstance(leaf, NDArray):
+                return leaf.context
+    return current_context()
+
+
+def _maybe_record(pure_fn, inputs_tree, out_tree_def):
+    """Run pure_fn over the jax leaves of inputs; if the tape is recording,
+    register one custom node with jax.vjp's cotangent closure."""
+    from .. import autograd
+    from ..ndarray.ndarray import NDArray
+    ctx = _first_ctx(inputs_tree)
+    jax_in = _tree_to_jax(inputs_tree)
+    if autograd.is_recording():
+        nd_leaves: List[NDArray] = []
+        _flatten(inputs_tree, nd_leaves)
+        nd_leaves = [x for x in nd_leaves if isinstance(x, NDArray)]
+
+        flat_in = [x._jax for x in nd_leaves]
+
+        def flat_fn(*leaves):
+            it = iter(leaves)
+
+            def rebuild(obj):
+                if isinstance(obj, NDArray):
+                    return next(it)
+                if isinstance(obj, (list, tuple)):
+                    return type(obj)(rebuild(x) for x in obj)
+                return obj
+            rebuilt = rebuild(inputs_tree)
+            outs = pure_fn(_tree_to_jax(rebuilt))
+            return tuple(jax.tree_util.tree_leaves(outs))
+
+        out_leaves, vjp_fn = jax.vjp(flat_fn, *flat_in)
+
+        def tape_vjp(cotangents):
+            return vjp_fn(tuple(cotangents))
+
+        wrapped = autograd.record_custom(tape_vjp, nd_leaves,
+                                         tuple(out_leaves), ctx,
+                                         name="control_flow")
+        return out_tree_def(list(wrapped), ctx)
+    outs = pure_fn(jax_in)
+    leaves = list(jax.tree_util.tree_leaves(outs))
+    return out_tree_def([NDArray(o, ctx=ctx) for o in leaves], ctx)
+
+
+def foreach(body: Callable, data, init_states):
+    """Scan `body(x_t, states) -> (out_t, new_states)` over axis 0 of
+    `data` (reference: contrib.foreach → _foreach op; here = lax.scan)."""
+    from ..ndarray.ndarray import NDArray
+    single_data = isinstance(data, NDArray)
+    single_state = isinstance(init_states, NDArray)
+    datas = [data] if single_data else list(data)
+    states0 = [init_states] if single_state else list(init_states)
+    ctx = _first_ctx(datas, states0)
+
+    out_struct = {}
+
+    def pure(tree):
+        d_vals, s_vals = tree
+
+        def step(carry, xs):
+            x_nds = [NDArray(x, ctx=ctx) for x in xs]
+            c_nds = [NDArray(c, ctx=ctx) for c in carry]
+            out, new_states = body(x_nds[0] if single_data else x_nds,
+                                   c_nds[0] if single_state else c_nds)
+            out_l: List[NDArray] = []
+            out_struct["tmpl"] = _flatten(out, out_l)
+            out_struct["n_out"] = len(out_l)
+            ns_l: List[NDArray] = []
+            out_struct["s_tmpl"] = _flatten(new_states, ns_l)
+            return (tuple(o._jax for o in ns_l),
+                    tuple(o._jax for o in out_l))
+
+        carry, ys = lax.scan(step, tuple(s_vals), tuple(d_vals))
+        return tuple(ys) + tuple(carry)
+
+    def rebuild(leaves: List[NDArray], ctx):
+        n = out_struct["n_out"]
+        outs, states = leaves[:n], leaves[n:]
+
+        def fill(tmpl, vals, pos):
+            if tmpl is None:
+                v = vals[pos[0]]
+                pos[0] += 1
+                return v
+            if isinstance(tmpl, (list, tuple)):
+                return type(tmpl)(fill(t, vals, pos) for t in tmpl)
+            return tmpl
+        out = fill(out_struct["tmpl"], outs, [0])
+        st = fill(out_struct["s_tmpl"], states, [0])
+        return out, st
+
+    return _maybe_record(pure, (datas, states0), rebuild)
+
+
+def while_loop(cond_fn: Callable, body: Callable, loop_vars,
+               max_iterations: int = None):
+    """Reference: contrib.while_loop.  TPU-native: bounded `lax.scan` with
+    an active-mask (XLA needs static trip count for differentiability; the
+    reference's _while_loop also required max_iterations).  Returns
+    (outputs=None, final_loop_vars) — per-step output stacking is only
+    supported through `foreach`."""
+    from ..ndarray.ndarray import NDArray
+    if max_iterations is None:
+        raise ValueError("while_loop requires max_iterations (static bound "
+                         "for XLA; the reference required it too)")
+    single = isinstance(loop_vars, NDArray)
+    lv = [loop_vars] if single else list(loop_vars)
+    ctx = _first_ctx(lv)
+
+    def pure(tree):
+        (vals,) = tree
+
+        def step(carry, _):
+            vals, active = carry
+            v_nds = [NDArray(v, ctx=ctx) for v in vals]
+            arg = v_nds[0] if single else v_nds
+            c = cond_fn(arg)
+            c_val = c._jax if isinstance(c, NDArray) else jnp.asarray(c)
+            active_now = jnp.logical_and(active, c_val.reshape(()))
+            out = body(arg)
+            out = [out] if single else list(out)
+            new_vals = tuple(
+                jnp.where(active_now, o._jax.astype(v.dtype), v)
+                for o, v in zip(out, vals))
+            return (new_vals, active_now), None
+
+        (final, _), _ = lax.scan(step, (tuple(vals), jnp.asarray(True)),
+                                 None, length=max_iterations)
+        return final
+
+    def rebuild(leaves, ctx):
+        return leaves[0] if single else list(leaves)
+
+    return None, _maybe_record(pure, ([v for v in lv],), rebuild)
+
+
+def cond(pred: Callable, then_func: Callable, else_func: Callable,
+         inputs):
+    """Reference: contrib.cond → lax.cond. `pred(inputs)` must return a
+    scalar; both branches must produce identically-shaped outputs."""
+    from ..ndarray.ndarray import NDArray
+    single = isinstance(inputs, NDArray)
+    ins = [inputs] if single else list(inputs)
+    ctx = _first_ctx(ins)
+    struct = {}
+
+    def pure(tree):
+        (vals,) = tree
+        v_nds = [NDArray(v, ctx=ctx) for v in vals]
+        arg = v_nds[0] if single else v_nds
+        p = pred(arg)
+        p_val = (p._jax if isinstance(p, NDArray) else jnp.asarray(p))
+
+        def run(branch):
+            def f(vals):
+                v_nds = [NDArray(v, ctx=ctx) for v in vals]
+                out = branch(v_nds[0] if single else v_nds)
+                out_l: List[NDArray] = []
+                struct["tmpl"] = _flatten(out, out_l)
+                return tuple(o._jax for o in out_l)
+            return f
+
+        return lax.cond(p_val.reshape(()).astype(bool),
+                        run(then_func), run(else_func), tuple(vals))
+
+    def rebuild(leaves, ctx):
+        def fill(tmpl, vals, pos):
+            if tmpl is None:
+                v = vals[pos[0]]
+                pos[0] += 1
+                return v
+            if isinstance(tmpl, (list, tuple)):
+                return type(tmpl)(fill(t, vals, pos) for t in tmpl)
+            return tmpl
+        return fill(struct["tmpl"], leaves, [0])
+
+    return _maybe_record(pure, (ins,), rebuild)

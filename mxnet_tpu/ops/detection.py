@@ -1,0 +1,281 @@
+"""Detection ops: box IoU/NMS + the SSD MultiBox family.
+
+Reference: src/operator/contrib/bounding_box.cc (box_nms, box_iou),
+src/operator/contrib/multibox_prior.cc (MultiBoxPriorParam),
+src/operator/contrib/multibox_target.cc (MultiBoxTargetParam),
+src/operator/contrib/multibox_detection.cc (MultiBoxDetectionParam).
+
+TPU-native design (SURVEY.md §7.2 hard part 3: dynamic shapes): every op
+here is STATIC-shape — suppression/invalidity is expressed by masking
+(score = -1 entries), never by compaction, so XLA compiles one executable
+per shape.  NMS is the O(N²) mask-matrix formulation: compute the full
+pairwise-IoU matrix once (an MXU-friendly batched computation), then a
+`lax.scan` over boxes in score order flips a keep-mask — no data-dependent
+control flow.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias
+
+# ---------------------------------------------------------------------------
+# IoU
+# ---------------------------------------------------------------------------
+
+
+def _pairwise_iou(a, b, fmt="corner"):
+    """IoU of (..., Na, 4) vs (..., Nb, 4) → (..., Na, Nb)."""
+    if fmt == "center":
+        def to_corner(x):
+            cx, cy, w, h = jnp.split(x, 4, axis=-1)
+            return jnp.concatenate(
+                [cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1)
+        a, b = to_corner(a), to_corner(b)
+    ax1, ay1, ax2, ay2 = [a[..., i] for i in range(4)]
+    bx1, by1, bx2, by2 = [b[..., i] for i in range(4)]
+    ix1 = jnp.maximum(ax1[..., :, None], bx1[..., None, :])
+    iy1 = jnp.maximum(ay1[..., :, None], by1[..., None, :])
+    ix2 = jnp.minimum(ax2[..., :, None], bx2[..., None, :])
+    iy2 = jnp.minimum(ay2[..., :, None], by2[..., None, :])
+    iw = jnp.maximum(ix2 - ix1, 0.0)
+    ih = jnp.maximum(iy2 - iy1, 0.0)
+    inter = iw * ih
+    area_a = jnp.maximum(ax2 - ax1, 0.0) * jnp.maximum(ay2 - ay1, 0.0)
+    area_b = jnp.maximum(bx2 - bx1, 0.0) * jnp.maximum(by2 - by1, 0.0)
+    union = area_a[..., :, None] + area_b[..., None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register("_contrib_box_iou", differentiable=False)
+def _box_iou(lhs, rhs, format="corner"):
+    return _pairwise_iou(lhs, rhs, fmt=format)
+
+
+alias("_contrib_box_iou", "box_iou")
+
+
+# ---------------------------------------------------------------------------
+# box_nms — static-shape masked suppression
+# ---------------------------------------------------------------------------
+
+@register("_contrib_box_nms", differentiable=False)
+def _box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+             coord_start=2, score_index=1, id_index=-1, background_id=-1,
+             force_suppress=False, in_format="corner", out_format="corner"):
+    """data: (..., N, K). Suppressed/invalid entries get all fields -1
+    (the reference's convention). Output shape == input shape."""
+    orig_shape = data.shape
+    flat = data.reshape((-1,) + orig_shape[-2:])
+    B, N, K = flat.shape
+    boxes = flat[..., coord_start:coord_start + 4]
+    scores = flat[..., score_index]
+    ids = flat[..., id_index] if id_index >= 0 else jnp.zeros_like(scores)
+
+    valid = scores > valid_thresh
+    if background_id >= 0 and id_index >= 0:
+        valid &= ids != background_id
+    # sort by score descending (invalid entries pushed last)
+    order = jnp.argsort(jnp.where(valid, -scores, jnp.inf), axis=-1)
+    boxes_s = jnp.take_along_axis(boxes, order[..., None], axis=1)
+    valid_s = jnp.take_along_axis(valid, order, axis=1)
+    ids_s = jnp.take_along_axis(ids, order, axis=1)
+    if topk > 0:
+        idx = jnp.arange(N)
+        valid_s &= idx[None, :] < topk
+
+    iou = _pairwise_iou(boxes_s, boxes_s, fmt=in_format)   # (B, N, N)
+    same_class = (ids_s[:, :, None] == ids_s[:, None, :]) \
+        if (id_index >= 0 and not force_suppress) else jnp.ones(
+            (B, N, N), bool)
+    suppress_pair = (iou > overlap_thresh) & same_class
+
+    def step(keep, i):
+        # box i (in score order) suppresses all later boxes overlapping it,
+        # but only if it itself is still kept
+        row = jnp.take(suppress_pair, i, axis=1) & (jnp.arange(N)[None, :] > i)
+        keep_i = jnp.take(keep, i, axis=1)[:, None]
+        keep = keep & ~(row & keep_i)
+        return keep, None
+
+    keep0 = valid_s
+    keep, _ = lax.scan(step, keep0, jnp.arange(N))
+    # scatter keep-mask back to original order
+    inv = jnp.argsort(order, axis=-1)
+    keep_orig = jnp.take_along_axis(keep, inv, axis=1)
+    out = jnp.where(keep_orig[..., None], flat, -jnp.ones_like(flat))
+    return out.reshape(orig_shape)
+
+
+alias("_contrib_box_nms", "box_nms")
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxPrior — anchor generation
+# ---------------------------------------------------------------------------
+
+@register("MultiBoxPrior", differentiable=False)
+def _multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                    steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """data: (B, C, H, W) feature map → (1, H*W*A, 4) corner-format anchors
+    where A = len(sizes) + len(ratios) - 1 (the reference's convention)."""
+    H, W = data.shape[-2], data.shape[-1]
+    sizes = tuple(sizes) if isinstance(sizes, (tuple, list)) else (sizes,)
+    ratios = tuple(ratios) if isinstance(ratios, (tuple, list)) else (ratios,)
+    step_y = steps[1] if steps[1] > 0 else 1.0 / H
+    step_x = steps[0] if steps[0] > 0 else 1.0 / W
+    cy = (jnp.arange(H, dtype=jnp.float32) + offsets[1]) * step_y
+    cx = (jnp.arange(W, dtype=jnp.float32) + offsets[0]) * step_x
+    cyx = jnp.stack(jnp.meshgrid(cy, cx, indexing="ij"), axis=-1)  # H,W,2
+
+    # anchor (w, h) list: all sizes at ratio[0], then size[0] at ratios[1:]
+    whs = [(s * jnp.sqrt(ratios[0]), s / jnp.sqrt(ratios[0])) for s in sizes]
+    whs += [(sizes[0] * jnp.sqrt(r), sizes[0] / jnp.sqrt(r))
+            for r in ratios[1:]]
+    w = jnp.asarray([x[0] for x in whs], jnp.float32)  # (A,)
+    h = jnp.asarray([x[1] for x in whs], jnp.float32)
+    A = w.shape[0]
+    ctr = jnp.broadcast_to(cyx[:, :, None, :], (H, W, A, 2))
+    x1 = ctr[..., 1] - w / 2
+    y1 = ctr[..., 0] - h / 2
+    x2 = ctr[..., 1] + w / 2
+    y2 = ctr[..., 0] + h / 2
+    anchors = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(1, H * W * A, 4)
+    if clip:
+        anchors = jnp.clip(anchors, 0.0, 1.0)
+    return anchors
+
+
+alias("MultiBoxPrior", "_contrib_MultiBoxPrior", "multibox_prior")
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxTarget — anchor ↔ ground-truth matching
+# ---------------------------------------------------------------------------
+
+@register("MultiBoxTarget", differentiable=False, num_outputs=3)
+def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                     ignore_label=-1.0, negative_mining_ratio=-1.0,
+                     negative_mining_thresh=0.5, minimum_negative_samples=0,
+                     variances=(0.1, 0.1, 0.2, 0.2)):
+    """anchor: (1, N, 4) corners; label: (B, M, 5) [cls, x1, y1, x2, y2]
+    padded with cls=-1; cls_pred: (B, num_cls+1, N).
+    Returns (loc_target (B, N*4), loc_mask (B, N*4), cls_target (B, N)).
+    cls_target: 0 = background, k+1 = object class k, -1 = ignored
+    (negative mining). Matching: best-anchor-per-gt forced + IoU threshold.
+    """
+    anchors = anchor.reshape(-1, 4)                      # (N, 4)
+    N = anchors.shape[0]
+    B, M = label.shape[0], label.shape[1]
+    gt_cls = label[..., 0]                               # (B, M)
+    gt_box = label[..., 1:5]                             # (B, M, 4)
+    gt_valid = gt_cls >= 0
+
+    iou = _pairwise_iou(jnp.broadcast_to(anchors, (B, N, 4)), gt_box)
+    iou = jnp.where(gt_valid[:, None, :], iou, -1.0)     # (B, N, M)
+
+    best_gt = jnp.argmax(iou, axis=-1)                   # (B, N)
+    best_iou = jnp.max(iou, axis=-1)
+    matched = best_iou >= overlap_threshold
+
+    # force-match: each valid gt claims its best anchor
+    best_anchor = jnp.argmax(iou, axis=1)                # (B, M)
+    forced = jnp.zeros((B, N), bool)
+    forced_gt = jnp.zeros((B, N), jnp.int32)
+    batch_idx = jnp.arange(B)[:, None]
+    forced = forced.at[batch_idx, best_anchor].set(gt_valid)
+    forced_gt = forced_gt.at[batch_idx, best_anchor].set(
+        jnp.where(gt_valid, jnp.arange(M)[None, :], 0))
+    use_forced = forced
+    match_gt = jnp.where(use_forced, forced_gt, best_gt)
+    is_pos = matched | use_forced
+
+    # loc targets: encode matched gt vs anchor with variances (center form)
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+    g = jnp.take_along_axis(gt_box, match_gt[..., None], axis=1)  # (B,N,4)
+    gw = g[..., 2] - g[..., 0]
+    gh = g[..., 3] - g[..., 1]
+    gcx = (g[..., 0] + g[..., 2]) / 2
+    gcy = (g[..., 1] + g[..., 3]) / 2
+    eps = 1e-8
+    tx = (gcx - acx) / jnp.maximum(aw, eps) / variances[0]
+    ty = (gcy - acy) / jnp.maximum(ah, eps) / variances[1]
+    tw = jnp.log(jnp.maximum(gw / jnp.maximum(aw, eps), eps)) / variances[2]
+    th = jnp.log(jnp.maximum(gh / jnp.maximum(ah, eps), eps)) / variances[3]
+    loc_t = jnp.stack([tx, ty, tw, th], axis=-1)          # (B, N, 4)
+    loc_target = jnp.where(is_pos[..., None], loc_t, 0.0).reshape(B, N * 4)
+    loc_mask = jnp.where(is_pos[..., None],
+                         jnp.ones_like(loc_t), 0.0).reshape(B, N * 4)
+
+    matched_cls = jnp.take_along_axis(gt_cls, match_gt, axis=1)   # (B, N)
+    cls_target = jnp.where(is_pos, matched_cls + 1.0, 0.0)
+
+    if negative_mining_ratio > 0:
+        # OHNM: keep the top (ratio × #pos) highest-background-loss
+        # negatives per sample; the rest get ignore_label
+        bg_prob = jax.nn.softmax(cls_pred, axis=1)[:, 0, :]       # (B, N)
+        neg_score = jnp.where(is_pos, jnp.inf, bg_prob)           # small=hard
+        rank = jnp.argsort(jnp.argsort(neg_score, axis=-1), axis=-1)
+        n_pos = jnp.sum(is_pos, axis=-1, keepdims=True)
+        n_neg = jnp.maximum(negative_mining_ratio * n_pos,
+                            minimum_negative_samples)
+        keep_neg = rank < n_neg
+        cls_target = jnp.where(is_pos | keep_neg, cls_target,
+                               ignore_label)
+    return loc_target, loc_mask, cls_target
+
+
+alias("MultiBoxTarget", "_contrib_MultiBoxTarget", "multibox_target")
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxDetection — decode + per-class NMS
+# ---------------------------------------------------------------------------
+
+@register("MultiBoxDetection", differentiable=False)
+def _multibox_detection(cls_prob, loc_pred, anchor, clip=True,
+                        threshold=0.01, background_id=0, nms_threshold=0.5,
+                        force_suppress=False,
+                        variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """cls_prob: (B, num_cls+1, N); loc_pred: (B, N*4); anchor: (1, N, 4).
+    → (B, N, 6) rows [class_id, score, x1, y1, x2, y2], suppressed = -1."""
+    B = cls_prob.shape[0]
+    N = anchor.shape[1]
+    anchors = anchor.reshape(N, 4)
+    loc = loc_pred.reshape(B, N, 4)
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+    cx = loc[..., 0] * variances[0] * aw + acx
+    cy = loc[..., 1] * variances[1] * ah + acy
+    w = jnp.exp(loc[..., 2] * variances[2]) * aw
+    h = jnp.exp(loc[..., 3] * variances[3]) * ah
+    x1, y1 = cx - w / 2, cy - h / 2
+    x2, y2 = cx + w / 2, cy + h / 2
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1)          # (B, N, 4)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+
+    # best non-background class per anchor (the reference's formulation)
+    prob = jnp.moveaxis(cls_prob, 1, 2)                   # (B, N, C+1)
+    fg = prob[..., 1:] if background_id == 0 else jnp.delete(
+        prob, background_id, axis=-1)
+    cls_id = jnp.argmax(fg, axis=-1).astype(boxes.dtype)  # (B, N)
+    score = jnp.max(fg, axis=-1)
+    keep = score > threshold
+    rows = jnp.concatenate(
+        [jnp.where(keep, cls_id, -1.0)[..., None],
+         jnp.where(keep, score, -1.0)[..., None],
+         jnp.where(keep[..., None], boxes, -1.0)], axis=-1)  # (B, N, 6)
+    return _box_nms(rows, overlap_thresh=nms_threshold, valid_thresh=0.0,
+                    topk=nms_topk, coord_start=2, score_index=1, id_index=0,
+                    force_suppress=force_suppress)
+
+
+alias("MultiBoxDetection", "_contrib_MultiBoxDetection", "multibox_detection")

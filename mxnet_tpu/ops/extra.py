@@ -1,0 +1,431 @@
+"""Long-tail ops: activations, bitwise, scalar-variant, sampling-free math.
+
+Reference anchors: src/operator/leaky_relu.cc (LeakyReLU modes incl.
+elu/selu/gelu via Activation), src/operator/tensor/elemwise_unary_op_basic.cc,
+elemwise_binary_scalar_op*.cc (the _plus_scalar family), np elemwise tail,
+src/operator/tensor/histogram.cc, src/operator/numpy/np_percentile_op.cc.
+
+Everything is a one-line jnp/lax lowering — the value of this file is API
+surface (MXNet name + signature + defaults), not kernels; XLA owns codegen.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias
+
+# ---------------------------------------------------------------------------
+# activations tail
+# ---------------------------------------------------------------------------
+
+
+@register("gelu")
+def _gelu(x, approximation="none"):
+    return jax.nn.gelu(x, approximate=(approximation == "tanh"))
+
+
+@register("selu")
+def _selu(x):
+    return jax.nn.selu(x)
+
+
+@register("elu")
+def _elu(x, alpha=1.0):
+    return jax.nn.elu(x, alpha=alpha)
+
+
+@register("softrelu", aliases=["softplus"])
+def _softrelu(x):
+    return jax.nn.softplus(x)
+
+
+@register("hard_sigmoid")
+def _hard_sigmoid(x, alpha=0.2, beta=0.5):
+    return jnp.clip(alpha * x + beta, 0.0, 1.0)
+
+
+@register("hard_swish")
+def _hard_swish(x):
+    return x * jnp.clip(x / 6.0 + 0.5, 0.0, 1.0)
+
+
+@register("silu", aliases=["swish"])
+def _silu(x):
+    return x * jax.nn.sigmoid(x)
+
+
+@register("mish")
+def _mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@register("prelu")
+def _prelu(x, gamma):
+    return jnp.where(x >= 0, x, gamma * x)
+
+
+@register("rrelu", needs_rng=True)
+def _rrelu(key, x, lower_bound=0.125, upper_bound=0.334, training=True):
+    if training:
+        slope = jax.random.uniform(key, x.shape, x.dtype,
+                                   lower_bound, upper_bound)
+    else:
+        slope = (lower_bound + upper_bound) / 2.0
+    return jnp.where(x >= 0, x, slope * x)
+
+
+@register("log_sigmoid")
+def _log_sigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+@register("masked_softmax")
+def _masked_softmax(data, mask=None, axis=-1, temperature=1.0,
+                    normalize=True):
+    x = data / temperature
+    if mask is not None:
+        x = jnp.where(mask.astype(bool), x, -jnp.inf)
+    out = jax.nn.softmax(x, axis=axis)
+    if mask is not None:
+        out = jnp.where(mask.astype(bool), out, 0.0)
+    return out
+
+
+@register("masked_log_softmax")
+def _masked_log_softmax(data, mask=None, axis=-1, temperature=1.0):
+    x = data / temperature
+    if mask is not None:
+        x = jnp.where(mask.astype(bool), x, -jnp.inf)
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+# ---------------------------------------------------------------------------
+# bitwise / integer
+# ---------------------------------------------------------------------------
+
+@register("bitwise_and")
+def _bitwise_and(a, b):
+    return jnp.bitwise_and(a, b)
+
+
+@register("bitwise_or")
+def _bitwise_or(a, b):
+    return jnp.bitwise_or(a, b)
+
+
+@register("bitwise_xor")
+def _bitwise_xor(a, b):
+    return jnp.bitwise_xor(a, b)
+
+
+@register("bitwise_not", aliases=["invert"])
+def _bitwise_not(a):
+    return jnp.bitwise_not(a)
+
+
+@register("bitwise_left_shift", aliases=["left_shift"])
+def _left_shift(a, b):
+    return jnp.left_shift(a, b)
+
+
+@register("bitwise_right_shift", aliases=["right_shift"])
+def _right_shift(a, b):
+    return jnp.right_shift(a, b)
+
+
+# ---------------------------------------------------------------------------
+# math tail
+# ---------------------------------------------------------------------------
+
+@register("radians")
+def _radians(x):
+    return jnp.radians(x)
+
+
+@register("degrees")
+def _degrees(x):
+    return jnp.degrees(x)
+
+
+@register("rcbrt")
+def _rcbrt(x):
+    return 1.0 / jnp.cbrt(x)
+
+
+@register("erfc")
+def _erfc(x):
+    return jax.scipy.special.erfc(x)
+
+
+@register("gammainc")
+def _gammainc(a, x):
+    return jax.scipy.special.gammainc(a, x)
+
+
+@register("gammaincc")
+def _gammaincc(a, x):
+    return jax.scipy.special.gammaincc(a, x)
+
+
+@register("polygamma")
+def _polygamma(x, n=0):
+    return jax.scipy.special.polygamma(n, x)
+
+
+@register("logaddexp")
+def _logaddexp(a, b):
+    return jnp.logaddexp(a, b)
+
+
+@register("logsumexp")
+def _logsumexp(data, axis=None, keepdims=False):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return jax.scipy.special.logsumexp(data, axis=ax, keepdims=keepdims)
+
+
+@register("ldexp")
+def _ldexp(a, b):
+    return a * jnp.exp2(b)
+
+
+@register("fmod")
+def _fmod(a, b):
+    return jnp.fmod(a, b)
+
+
+@register("heaviside")
+def _heaviside(a, b):
+    return jnp.heaviside(a, b)
+
+
+@register("copysign")
+def _copysign(a, b):
+    return jnp.copysign(a, b)
+
+
+@register("nextafter")
+def _nextafter(a, b):
+    return jnp.nextafter(a, b)
+
+
+@register("hypot")
+def _hypot(a, b):
+    return jnp.hypot(a, b)
+
+
+@register("sinc")
+def _sinc(x):
+    return jnp.sinc(x)
+
+
+@register("i0")
+def _i0(x):
+    return jax.scipy.special.i0(x)
+
+
+@register("trace_op", aliases=["trace"])
+def _trace(data, offset=0, axis1=0, axis2=1):
+    return jnp.trace(data, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@register("cross")
+def _cross(a, b, axisa=-1, axisb=-1, axisc=-1):
+    return jnp.cross(a, b, axisa=axisa, axisb=axisb, axisc=axisc)
+
+
+@register("kron")
+def _kron(a, b):
+    return jnp.kron(a, b)
+
+
+@register("interp")
+def _interp(x, xp, fp, left=None, right=None):
+    return jnp.interp(x, xp, fp, left=left, right=right)
+
+
+@register("digitize", differentiable=False)
+def _digitize(x, bins, right=False):
+    return jnp.digitize(x, bins, right=right)
+
+
+@register("lerp")
+def _lerp(start, end, weight):
+    return start + weight * (end - start)
+
+
+# ---------------------------------------------------------------------------
+# reductions / stats tail
+# ---------------------------------------------------------------------------
+
+@register("quantile")
+def _quantile(a, q, axis=None, keepdims=False, interpolation="linear"):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return jnp.quantile(a, q, axis=ax, keepdims=keepdims,
+                        method=interpolation)
+
+
+@register("percentile")
+def _percentile(a, q, axis=None, keepdims=False, interpolation="linear"):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return jnp.percentile(a, q, axis=ax, keepdims=keepdims,
+                          method=interpolation)
+
+
+@register("median")
+def _median(a, axis=None, keepdims=False):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return jnp.median(a, axis=ax, keepdims=keepdims)
+
+
+@register("std")
+def _std(a, axis=None, ddof=0, keepdims=False):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return jnp.std(a, axis=ax, ddof=ddof, keepdims=keepdims)
+
+
+@register("var")
+def _var(a, axis=None, ddof=0, keepdims=False):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return jnp.var(a, axis=ax, ddof=ddof, keepdims=keepdims)
+
+
+@register("ptp")
+def _ptp(a, axis=None, keepdims=False):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return jnp.ptp(a, axis=ax, keepdims=keepdims)
+
+
+@register("average")
+def _average(a, weights=None, axis=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return jnp.average(a, axis=ax, weights=weights)
+
+
+@register("histogram", differentiable=False, num_outputs=2)
+def _histogram(data, bin_cnt=10, range=None):
+    """Reference: src/operator/tensor/histogram.cc. Static-shape: fixed
+    bin_cnt; returns (counts, bin_edges)."""
+    lo, hi = range if range is not None else (None, None)
+    if lo is None:
+        raise ValueError("histogram on TPU requires an explicit range= "
+                         "(static shapes; the reference's auto-range needs "
+                         "a host sync)")
+    edges = jnp.linspace(lo, hi, bin_cnt + 1)
+    idx = jnp.clip(((data - lo) / (hi - lo) * bin_cnt).astype(jnp.int32),
+                   0, bin_cnt - 1)
+    in_range = (data >= lo) & (data <= hi)
+    counts = jnp.zeros((bin_cnt,), jnp.int32)
+    counts = counts.at[idx.reshape(-1)].add(
+        in_range.reshape(-1).astype(jnp.int32))
+    return counts, edges
+
+
+@register("nan_to_num")
+def _nan_to_num(data, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(data, nan=nan, posinf=posinf, neginf=neginf)
+
+
+@register("cummax", differentiable=False)
+def _cummax(a, axis=0):
+    return lax.associative_scan(jnp.maximum, a, axis=axis)
+
+
+@register("cummin", differentiable=False)
+def _cummin(a, axis=0):
+    return lax.associative_scan(jnp.minimum, a, axis=axis)
+
+
+# ---------------------------------------------------------------------------
+# indexing tail
+# ---------------------------------------------------------------------------
+
+@register("index_add")
+def _index_add(data, index, value):
+    return data.at[index.astype(jnp.int32)].add(value)
+
+
+@register("index_copy")
+def _index_copy(data, index, value):
+    return data.at[index.astype(jnp.int32)].set(value)
+
+
+@register("index_update")
+def _index_update(data, index, value):
+    return data.at[index.astype(jnp.int32)].set(value)
+
+
+@register("searchsorted", differentiable=False)
+def _searchsorted(a, v, side="left"):
+    return jnp.searchsorted(a, v, side=side)
+
+
+@register("bincount", differentiable=False)
+def _bincount(data, weights=None, minlength=0):
+    if minlength <= 0:
+        raise ValueError("bincount on TPU requires minlength= (static "
+                         "output shape)")
+    return jnp.bincount(data.astype(jnp.int32), weights=weights,
+                        length=minlength)
+
+
+@register("roll")
+def _roll(data, shift=0, axis=None):
+    sh = tuple(shift) if isinstance(shift, (list, tuple)) else shift
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return jnp.roll(data, sh, axis=ax)
+
+
+@register("rot90")
+def _rot90(data, k=1, axes=(0, 1)):
+    return jnp.rot90(data, k=k, axes=tuple(axes))
+
+
+@register("tril")
+def _tril(data, k=0):
+    return jnp.tril(data, k=k)
+
+
+@register("triu")
+def _triu(data, k=0):
+    return jnp.triu(data, k=k)
+
+
+@register("diagonal")
+def _diagonal(data, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(data, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@register("atleast_1d")
+def _atleast_1d(x):
+    return jnp.atleast_1d(x)
+
+
+@register("atleast_2d")
+def _atleast_2d(x):
+    return jnp.atleast_2d(x)
+
+
+@register("atleast_3d")
+def _atleast_3d(x):
+    return jnp.atleast_3d(x)
+
+
+# ---------------------------------------------------------------------------
+# windows / creation-style (static shape params)
+# ---------------------------------------------------------------------------
+
+@register("hanning", differentiable=False)
+def _hanning(M=0, dtype="float32"):
+    return jnp.hanning(M).astype(dtype)
+
+
+@register("hamming", differentiable=False)
+def _hamming(M=0, dtype="float32"):
+    return jnp.hamming(M).astype(dtype)
+
+
+@register("blackman", differentiable=False)
+def _blackman(M=0, dtype="float32"):
+    return jnp.blackman(M).astype(dtype)

@@ -1,0 +1,180 @@
+"""Linear-algebra op tail.
+
+Reference: src/operator/tensor/la_op.cc (linalg_gemm/trmm/potri/gelqf/
+syevd/makediag/extractdiag/maketrian/extracttrian/sumlogdiag/det/slogdet/
+inverse), src/operator/numpy/linalg/*, src/operator/contrib/krprod.cc
+(khatri_rao), np einsum.
+
+All lower to jax.numpy.linalg / lax.linalg — XLA's native decompositions
+(QR/Cholesky/eigh run on the MXU where block-factorizable).  gemm2/potrf/
+syrk/trsm live in matrix.py since round 1; this file adds the tail.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias
+
+
+@register("linalg_gemm")
+def _linalg_gemm(a, b, c, transpose_a=False, transpose_b=False, alpha=1.0,
+                 beta=1.0, axis=-2):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return alpha * jnp.matmul(a, b) + beta * c
+
+
+@register("linalg_trmm")
+def _linalg_trmm(a, b, transpose=False, rightside=False, lower=True,
+                 alpha=1.0):
+    tri = jnp.tril(a) if lower else jnp.triu(a)
+    if transpose:
+        tri = jnp.swapaxes(tri, -1, -2)
+    return alpha * (jnp.matmul(b, tri) if rightside else jnp.matmul(tri, b))
+
+
+@register("linalg_potri")
+def _linalg_potri(a):
+    """Inverse from a Cholesky factor: (L L^T)^-1 given L."""
+    eye = jnp.broadcast_to(jnp.eye(a.shape[-1], dtype=a.dtype), a.shape)
+    linv = lax.linalg.triangular_solve(a, eye, left_side=True, lower=True)
+    return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
+
+
+@register("linalg_gelqf", num_outputs=2)
+def _linalg_gelqf(a):
+    """LQ factorization (reference returns (L, Q) with A = L Q)."""
+    q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2), mode="reduced")
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+@register("linalg_syevd", num_outputs=2)
+def _linalg_syevd(a):
+    """Symmetric eigendecomposition: returns (U, lambda) with
+    A = U^T diag(lambda) U (the reference's row-eigenvector convention)."""
+    w, v = jnp.linalg.eigh(a)
+    return jnp.swapaxes(v, -1, -2), w
+
+
+@register("linalg_makediag")
+def _linalg_makediag(a, offset=0):
+    return jnp.vectorize(lambda v: jnp.diag(v, k=offset),
+                         signature="(n)->(m,m)")(a)
+
+
+@register("linalg_extractdiag")
+def _linalg_extractdiag(a, offset=0):
+    return jnp.vectorize(lambda m: jnp.diag(m, k=offset),
+                         signature="(m,m)->(n)")(a)
+
+
+@register("linalg_maketrian")
+def _linalg_maketrian(a, offset=0, lower=True):
+    """Pack a vector into a (lower/upper) triangular matrix."""
+    n_elem = a.shape[-1]
+    # n(n+1)/2 = n_elem → n
+    n = int((-1 + (1 + 8 * n_elem) ** 0.5) / 2)
+    idx = jnp.tril_indices(n) if lower else jnp.triu_indices(n)
+
+    def pack(v):
+        m = jnp.zeros((n, n), a.dtype)
+        return m.at[idx].set(v)
+    return jnp.vectorize(pack, signature="(k)->(m,m)")(a)
+
+
+@register("linalg_extracttrian")
+def _linalg_extracttrian(a, offset=0, lower=True):
+    n = a.shape[-1]
+    idx = jnp.tril_indices(n) if lower else jnp.triu_indices(n)
+
+    def unpack(m):
+        return m[idx]
+    return jnp.vectorize(unpack, signature="(m,m)->(k)")(a)
+
+
+@register("linalg_sumlogdiag")
+def _linalg_sumlogdiag(a):
+    d = jnp.diagonal(a, axis1=-2, axis2=-1)
+    return jnp.sum(jnp.log(d), axis=-1)
+
+
+@register("linalg_inverse", aliases=["inverse"])
+def _linalg_inverse(a):
+    return jnp.linalg.inv(a)
+
+
+@register("linalg_det", aliases=["det"])
+def _linalg_det(a):
+    return jnp.linalg.det(a)
+
+
+@register("linalg_slogdet", aliases=["slogdet"], num_outputs=2)
+def _linalg_slogdet(a):
+    sign, logabs = jnp.linalg.slogdet(a)
+    return sign, logabs
+
+
+@register("khatri_rao", differentiable=True)
+def _khatri_rao(*mats):
+    """Column-wise Kronecker product (reference: src/operator/contrib/
+    krprod.cc)."""
+    out = mats[0]
+    for m in mats[1:]:
+        out = (out[:, None, :] * m[None, :, :]).reshape(-1, out.shape[-1])
+    return out
+
+
+@register("einsum")
+def _einsum(*args, subscripts=""):
+    return jnp.einsum(subscripts, *args)
+
+
+alias("einsum", "_npi_einsum")
+
+
+@register("moments", num_outputs=2)
+def _moments(data, axes=None, keepdims=False):
+    """Reference: src/operator/nn/moments.cc — returns (mean, var)."""
+    ax = tuple(axes) if isinstance(axes, (list, tuple)) else axes
+    mean = jnp.mean(data, axis=ax, keepdims=keepdims)
+    var = jnp.var(data, axis=ax, keepdims=keepdims)
+    return mean, var
+
+
+@register("batch_take")
+def _batch_take(a, indices):
+    """Reference: src/operator/tensor/indexing_op.cc (batch_take):
+    out[i] = a[i, indices[i]]."""
+    return jnp.take_along_axis(
+        a, indices.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+
+
+@register("ravel_multi_index", differentiable=False)
+def _ravel_multi_index(data, shape=None):
+    """data: (ndim, N) indices → flat indices (reference:
+    src/operator/tensor/ravel.cc)."""
+    strides = []
+    s = 1
+    for d in reversed(shape):
+        strides.append(s)
+        s *= d
+    strides = jnp.asarray(list(reversed(strides)), data.dtype)
+    return jnp.sum(data * strides[:, None], axis=0)
+
+
+@register("unravel_index", differentiable=False)
+def _unravel_index(data, shape=None):
+    out = []
+    rem = data.astype(jnp.int64) if data.dtype != jnp.int32 else data
+    strides = []
+    s = 1
+    for d in reversed(shape):
+        strides.append(s)
+        s *= d
+    for st, d in zip(reversed(strides), shape):
+        out.append((rem // st) % d)
+    return jnp.stack(out, axis=0).astype(data.dtype)

@@ -304,7 +304,7 @@ def _where_op(cond, a, b):
     return jnp.where(cond.astype(bool), a, b)
 
 
-@register("boolean_mask", differentiable=False)
+@register("boolean_mask", differentiable=False, no_jit=True)
 def _boolean_mask(data, index, axis=0):
     # dynamic output shape: materialize via host round-trip is illegal under
     # jit; MXNet semantics preserved eagerly only.

@@ -34,12 +34,13 @@ _REGISTRY: Dict[str, "OpDef"] = {}
 
 class OpDef:
     __slots__ = ("name", "fn", "differentiable", "num_outputs", "doc",
-                 "mutates_input", "needs_rng", "aux_writeback")
+                 "mutates_input", "needs_rng", "aux_writeback", "no_jit")
 
     def __init__(self, name: str, fn: Callable, differentiable: bool = True,
                  num_outputs: int = 1, doc: Optional[str] = None,
                  mutates_input: Optional[int] = None, needs_rng: bool = False,
-                 aux_writeback: Optional[Dict[int, int]] = None):
+                 aux_writeback: Optional[Dict[int, int]] = None,
+                 no_jit: bool = False):
         self.name = name
         self.fn = fn
         self.differentiable = differentiable
@@ -54,6 +55,9 @@ class OpDef:
         # inputs (BatchNorm moving stats = the reference's aux states) and
         # stripped from the visible return
         self.aux_writeback = aux_writeback
+        # dynamic-output-shape ops (boolean_mask, np.unique-style) cannot be
+        # traced: dispatch eagerly, outside the per-op jit cache
+        self.no_jit = no_jit
 
     def __repr__(self):
         return "OpDef(%s)" % self.name
@@ -62,13 +66,15 @@ class OpDef:
 def register(name: str, fn: Optional[Callable] = None, *, differentiable: bool = True,
              num_outputs: int = 1, aliases: Sequence[str] = (),
              mutates_input: Optional[int] = None, needs_rng: bool = False,
-             aux_writeback: Optional[Dict[int, int]] = None):
+             aux_writeback: Optional[Dict[int, int]] = None,
+             no_jit: bool = False):
     """Register an op. Usable as decorator or direct call."""
 
     def _do(f: Callable) -> Callable:
         op = OpDef(name, f, differentiable=differentiable,
                    num_outputs=num_outputs, mutates_input=mutates_input,
-                   needs_rng=needs_rng, aux_writeback=aux_writeback)
+                   needs_rng=needs_rng, aux_writeback=aux_writeback,
+                   no_jit=no_jit)
         _REGISTRY[name] = op
         for a in aliases:
             _REGISTRY[a] = op

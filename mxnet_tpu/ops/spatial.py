@@ -1,0 +1,191 @@
+"""Spatial vision ops: GridGenerator/BilinearSampler/SpatialTransformer,
+ROIPooling/ROIAlign, Correlation, im2col/col2im.
+
+Reference: src/operator/spatial_transformer.cc (SpatialTransformerParam),
+src/operator/bilinear_sampler.cc, src/operator/grid_generator.cc,
+src/operator/roi_pooling.cc (ROIPoolingParam), src/operator/contrib/
+roi_align.cc (ROIAlignParam), src/operator/correlation.cc,
+src/operator/nn/im2col.h.
+
+TPU-native: gather-based formulations with static shapes.  Bilinear
+sampling = 4 gathers + lerp (vectorized over the batch with vmap);
+ROI ops vmap over rois.  No scatter in the forward paths, so VJPs are
+XLA-generated scatter-adds.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias
+
+
+def _bilinear_gather(img, x, y):
+    """img: (C, H, W); x, y: (...) pixel coords → (C, ...) samples; zero
+    padding outside."""
+    C, H, W = img.shape
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    dx = x - x0
+    dy = y - y0
+
+    def at(xi, yi):
+        inb = (xi >= 0) & (xi <= W - 1) & (yi >= 0) & (yi <= H - 1)
+        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        v = img[:, yc, xc]            # (C, ...)
+        return jnp.where(inb, v, 0.0)
+
+    w00 = (1 - dx) * (1 - dy)
+    w01 = dx * (1 - dy)
+    w10 = (1 - dx) * dy
+    w11 = dx * dy
+    return (at(x0, y0) * w00 + at(x0 + 1, y0) * w01 +
+            at(x0, y0 + 1) * w10 + at(x0 + 1, y0 + 1) * w11)
+
+
+@register("GridGenerator", differentiable=True)
+def _grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    """affine: data (B, 6) → grid (B, 2, H, W) of normalized [-1,1] coords;
+    warp: data (B, 2, H, W) flow added to the identity grid."""
+    H, W = target_shape
+    ys = jnp.linspace(-1.0, 1.0, H)
+    xs = jnp.linspace(-1.0, 1.0, W)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    if transform_type == "affine":
+        theta = data.reshape(-1, 2, 3)
+        base = jnp.stack([gx.ravel(), gy.ravel(),
+                          jnp.ones(H * W, data.dtype)], axis=0)  # (3, HW)
+        out = jnp.einsum("bij,jk->bik", theta, base)             # (B, 2, HW)
+        return out.reshape(-1, 2, H, W)
+    # warp: normalized flow displacement
+    B = data.shape[0]
+    Hd, Wd = data.shape[2], data.shape[3]
+    ys = jnp.linspace(-1.0, 1.0, Hd)
+    xs = jnp.linspace(-1.0, 1.0, Wd)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ident = jnp.stack([gx, gy], axis=0)[None]                    # (1,2,H,W)
+    flow = jnp.stack([data[:, 0] * 2.0 / jnp.maximum(Wd - 1, 1),
+                      data[:, 1] * 2.0 / jnp.maximum(Hd - 1, 1)], axis=1)
+    return ident + flow
+
+
+@register("BilinearSampler")
+def _bilinear_sampler(data, grid, cudnn_off=False):
+    """data: (B, C, H, W), grid: (B, 2, Ho, Wo) in [-1, 1] (x, y).
+    Reference: src/operator/bilinear_sampler.cc."""
+    H, W = data.shape[2], data.shape[3]
+    gx = (grid[:, 0] + 1.0) * (W - 1) / 2.0      # (B, Ho, Wo)
+    gy = (grid[:, 1] + 1.0) * (H - 1) / 2.0
+    return jax.vmap(_bilinear_gather)(data, gx, gy)
+
+
+@register("SpatialTransformer")
+def _spatial_transformer(data, loc, target_shape=(0, 0),
+                         transform_type="affine",
+                         sampler_type="bilinear", cudnn_off=False):
+    grid = _grid_generator(loc, transform_type="affine",
+                           target_shape=tuple(target_shape))
+    return _bilinear_sampler(data, grid)
+
+
+@register("ROIPooling")
+def _roi_pooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0):
+    """data: (B, C, H, W); rois: (R, 5) [batch_idx, x1, y1, x2, y2] in
+    image coords.  Max-pool each roi into pooled_size bins (reference:
+    src/operator/roi_pooling.cc). Gather-based: static bin sampling grid
+    (2x2 samples/bin, max-reduced) — XLA-friendly, no data-dependent
+    shapes."""
+    PH, PW = pooled_size
+    H, W = data.shape[2], data.shape[3]
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = roi[1] * spatial_scale, roi[2] * spatial_scale, \
+            roi[3] * spatial_scale, roi[4] * spatial_scale
+        rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+        bw, bh = rw / PW, rh / PH
+        # 2 samples per bin per axis, max-reduced ≈ exact max for small bins
+        sx = x1 + (jnp.arange(PW)[:, None] + jnp.asarray([0.25, 0.75])) * bw
+        sy = y1 + (jnp.arange(PH)[:, None] + jnp.asarray([0.25, 0.75])) * bh
+        xx = sx.reshape(-1)                       # (PW*2,)
+        yy = sy.reshape(-1)                       # (PH*2,)
+        gx, gy = jnp.meshgrid(xx, yy, indexing="xy")  # (PH*2, PW*2)
+        xi = jnp.clip(jnp.round(gx), 0, W - 1).astype(jnp.int32)
+        yi = jnp.clip(jnp.round(gy), 0, H - 1).astype(jnp.int32)
+        img = data[b]                             # (C, H, W)
+        vals = img[:, yi, xi]                     # (C, PH*2, PW*2)
+        vals = vals.reshape(img.shape[0], PH, 2, PW, 2)
+        return jnp.max(vals, axis=(2, 4))         # (C, PH, PW)
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register("_contrib_ROIAlign")
+def _roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+               sample_ratio=2, position_sensitive=False, aligned=False):
+    """Average-pooled bilinear sampling (reference: contrib/roi_align.cc)."""
+    PH, PW = pooled_size
+    S = max(int(sample_ratio), 1)
+    off = 0.5 if aligned else 0.0
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = roi[1] * spatial_scale - off
+        y1 = roi[2] * spatial_scale - off
+        x2 = roi[3] * spatial_scale - off
+        y2 = roi[4] * spatial_scale - off
+        rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+        rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+        bw, bh = rw / PW, rh / PH
+        ix = (jnp.arange(S) + 0.5) / S
+        sx = x1 + (jnp.arange(PW)[:, None] + ix) * bw   # (PW, S)
+        sy = y1 + (jnp.arange(PH)[:, None] + ix) * bh   # (PH, S)
+        gx = sx.reshape(-1)
+        gy = sy.reshape(-1)
+        mx_, my_ = jnp.meshgrid(gx, gy, indexing="xy")  # (PH*S, PW*S)
+        vals = _bilinear_gather(data[b], mx_, my_)      # (C, PH*S, PW*S)
+        vals = vals.reshape(vals.shape[0], PH, S, PW, S)
+        return jnp.mean(vals, axis=(2, 4))
+
+    return jax.vmap(one_roi)(rois)
+
+
+alias("_contrib_ROIAlign", "ROIAlign", "roi_align")
+
+
+@register("Correlation", num_outputs=1)
+def _correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                 stride2=1, pad_size=0, is_multiply=True):
+    """FlowNet correlation layer (reference: src/operator/correlation.cc).
+    Simplified: kernel_size=1 patch correlation over a (2d+1)² displacement
+    window, expressed as shifted elementwise products (XLA fuses the whole
+    window loop)."""
+    d = max_displacement
+    B, C, H, W = data1.shape
+    p1 = jnp.pad(data1, ((0, 0), (0, 0), (d, d), (d, d)))
+    p2 = jnp.pad(data2, ((0, 0), (0, 0), (d, d), (d, d)))
+    outs = []
+    for dy in range(-d, d + 1, stride2):
+        for dx in range(-d, d + 1, stride2):
+            a = p1[:, :, d:d + H, d:d + W]
+            b = p2[:, :, d + dy:d + dy + H, d + dx:d + dx + W]
+            prod = a * b if is_multiply else -jnp.abs(a - b)
+            outs.append(jnp.mean(prod, axis=1))
+    return jnp.stack(outs, axis=1)
+
+
+@register("im2col")
+def _im2col(data, kernel=(1, 1), stride=(1, 1), dilate=(1, 1), pad=(0, 0)):
+    """Reference: src/operator/nn/im2col.h. (B, C, H, W) →
+    (B, C*kh*kw, L) patches."""
+    kh, kw = kernel
+    patches = lax.conv_general_dilated_patches(
+        data, filter_shape=(kh, kw), window_strides=tuple(stride),
+        padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+        rhs_dilation=tuple(dilate),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    B, CKK, Ho, Wo = patches.shape
+    return patches.reshape(B, CKK, Ho * Wo)

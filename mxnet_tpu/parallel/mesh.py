@@ -77,31 +77,34 @@ def shard_params_tp(param_values: Dict[str, jax.Array], mesh: Mesh,
                     rules: Optional[Dict[str, Any]] = None):
     """Megatron-style TP placement for Dense weights.
 
-    rules: {param-name-substring: PartitionSpec}.  Default: alternate
-    column-parallel ((tp, None) on the (out, in) weight) and row-parallel
-    ((None, tp)) for consecutive '.weight' 2-D params; biases and
-    everything else replicate.
+    rules: {param-name-substring: PartitionSpec} — explicit layout control
+    (the Megatron-style annotation surface); any param not matching a rule
+    replicates.  Without rules: alternate column-parallel ((tp, None) on
+    the (out, in) weight) and row-parallel ((None, tp)) for consecutive
+    '.weight' 2-D params; biases and everything else replicate.
+
+    NOTE: sharding choices here NEVER change results — XLA inserts the
+    collectives that preserve the math; a suboptimal layout only costs
+    communication.  The default alternation is the right layout for MLP
+    stacks (one psum per Dense pair); for other architectures pass rules.
     """
     tp = mesh.shape.get(tp_axis, 1)
     out = {}
     col = True
     for name, v in param_values.items():
-        spec = P()
-        if rules:
+        if rules is not None:
+            spec = P()   # explicit mode: unmatched params replicate
             for frag, s in rules.items():
                 if frag in name:
                     spec = s
                     break
-            else:
-                spec = None
-        if rules is None or spec is None:
-            if tp > 1 and name.endswith("weight") and v.ndim == 2:
-                spec = P(tp_axis, None) if col else P(None, tp_axis)
-                col = not col
-            else:
-                # biases and everything else replicate (always a valid
-                # placement; XLA re-shards at use sites as needed)
-                spec = P()
+        elif tp > 1 and name.endswith("weight") and v.ndim == 2:
+            spec = P(tp_axis, None) if col else P(None, tp_axis)
+            col = not col
+        else:
+            # biases and everything else replicate (always a valid
+            # placement; XLA re-shards at use sites as needed)
+            spec = P()
         out[name] = jax.device_put(v, NamedSharding(mesh, spec))
     return out
 

@@ -1,0 +1,172 @@
+"""Contrib semantics: control flow (foreach/while_loop/cond) and the
+detection op family.
+
+Reference: tests/python/unittest/test_contrib_control_flow.py,
+tests/python/unittest/test_contrib_operator.py (box_nms/MultiBox tests).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def test_foreach_cumsum():
+    def body(x, state):
+        new = state + x
+        return new, new
+
+    data = nd.array(np.arange(6, dtype=np.float32).reshape(3, 2))
+    out, final = mx.contrib.foreach(body, data, nd.zeros((2,)))
+    np.testing.assert_allclose(out.asnumpy(),
+                               np.cumsum(data.asnumpy(), axis=0))
+    np.testing.assert_allclose(final.asnumpy(), [6.0, 9.0])
+
+
+def test_foreach_multiple_states():
+    def body(x, states):
+        s0, s1 = states
+        return x + s0, [s0 + x, s1 * 2]
+
+    data = nd.array(np.ones((4, 2), np.float32))
+    out, states = mx.contrib.foreach(body, data,
+                                     [nd.zeros((2,)), nd.ones((2,))])
+    assert out.shape == (4, 2)
+    np.testing.assert_allclose(states[0].asnumpy(), [4.0, 4.0])
+    np.testing.assert_allclose(states[1].asnumpy(), [16.0, 16.0])
+
+
+def test_foreach_grad():
+    def body(x, state):
+        new = state + x
+        return new, new
+
+    x = nd.array(np.ones((3, 2), np.float32))
+    x.attach_grad()
+    with autograd.record():
+        _, final = mx.contrib.foreach(body, x, nd.zeros((2,)))
+        loss = (final * final).sum()
+    loss.backward()
+    # final = sum_t x_t; d(final^2)/dx_t = 2*final = 6
+    np.testing.assert_allclose(x.grad.asnumpy(), 6 * np.ones((3, 2)))
+
+
+def test_while_loop():
+    _, fin = mx.contrib.while_loop(
+        lambda v: v[0] < 100, lambda v: [v[0] * 2],
+        [nd.array([3.0])], max_iterations=10)
+    np.testing.assert_allclose(fin[0].asnumpy(), [192.0])
+    # bound shorter than convergence: stops at max_iterations
+    _, fin = mx.contrib.while_loop(
+        lambda v: v[0] < 100, lambda v: [v[0] * 2],
+        [nd.array([3.0])], max_iterations=2)
+    np.testing.assert_allclose(fin[0].asnumpy(), [12.0])
+
+
+def test_while_loop_requires_bound():
+    with pytest.raises(ValueError):
+        mx.contrib.while_loop(lambda v: v[0] < 1, lambda v: [v[0]],
+                              [nd.array([0.0])])
+
+
+def test_cond():
+    r = mx.contrib.cond(lambda v: v[0].sum() > 0,
+                        lambda v: v[0] * 2, lambda v: v[0] - 1,
+                        [nd.array([1.0, 2.0])])
+    np.testing.assert_allclose(r.asnumpy(), [2.0, 4.0])
+    r = mx.contrib.cond(lambda v: v[0].sum() > 100,
+                        lambda v: v[0] * 2, lambda v: v[0] - 1,
+                        [nd.array([1.0, 2.0])])
+    np.testing.assert_allclose(r.asnumpy(), [0.0, 1.0])
+
+
+def test_box_iou():
+    a = nd.array([[0.0, 0.0, 2.0, 2.0]])
+    b = nd.array([[1.0, 1.0, 3.0, 3.0], [4.0, 4.0, 5.0, 5.0]])
+    iou = mx.contrib.nd.box_iou(a, b).asnumpy()
+    np.testing.assert_allclose(iou, [[1.0 / 7.0, 0.0]], rtol=1e-5)
+
+
+def test_box_nms_suppression():
+    boxes = nd.array([[[0, 0.9, 0.0, 0.0, 1.0, 1.0],
+                       [0, 0.8, 0.05, 0.05, 1.0, 1.0],
+                       [1, 0.7, 0.5, 0.5, 0.9, 0.9],
+                       [0, -1.0, 0.0, 0.0, 0.1, 0.1]]])
+    out = mx.contrib.nd.box_nms(boxes, overlap_thresh=0.5).asnumpy()
+    assert out[0, 0, 1] == pytest.approx(0.9)     # top box kept
+    assert (out[0, 1] == -1).all()                # same-class overlap gone
+    assert out[0, 2, 0] == 1                      # other class kept
+    assert (out[0, 3] == -1).all()                # invalid score stays out
+    # force_suppress ignores class ids
+    out2 = mx.contrib.nd.box_nms(boxes, overlap_thresh=0.1,
+                                 force_suppress=True).asnumpy()
+    assert (out2[0, 2] == -1).all()
+
+
+def test_box_nms_topk():
+    boxes = nd.array([[[0.9, 0.0, 0.0, 0.2, 0.2],
+                       [0.8, 0.4, 0.4, 0.6, 0.6],
+                       [0.7, 0.8, 0.8, 1.0, 1.0]]])
+    out = mx.contrib.nd.box_nms(boxes, overlap_thresh=0.5, topk=2,
+                                coord_start=1, score_index=0,
+                                id_index=-1).asnumpy()
+    kept = (out[0, :, 0] > 0).sum()
+    assert kept == 2
+
+
+def test_multibox_prior_values():
+    feat = nd.zeros((1, 4, 2, 2))
+    anchors = mx.contrib.nd.MultiBoxPrior(feat, sizes=(0.5,),
+                                          ratios=(1.0,)).asnumpy()
+    assert anchors.shape == (1, 4, 4)
+    # first anchor centered at (0.25, 0.25) with size 0.5
+    np.testing.assert_allclose(anchors[0, 0], [0.0, 0.0, 0.5, 0.5],
+                               atol=1e-6)
+
+
+def test_multibox_target_matching():
+    feat = nd.zeros((1, 4, 3, 3))
+    anchors = mx.contrib.nd.MultiBoxPrior(feat, sizes=(0.4,), ratios=(1.0,))
+    # one gt box near the center anchor; one padding row
+    label = nd.array([[[1, 0.3, 0.3, 0.7, 0.7], [-1, 0, 0, 0, 0]]])
+    cls_pred = nd.zeros((1, 3, 9))
+    loc_t, loc_m, cls_t = mx.contrib.nd.MultiBoxTarget(anchors, label,
+                                                       cls_pred)
+    ct = cls_t.asnumpy()[0]
+    assert (ct == 2).sum() >= 1          # class 1 → target 2 (bg=0)
+    assert (ct == 0).sum() > 0           # background anchors exist
+    lm = loc_m.asnumpy().reshape(9, 4)
+    assert (lm.sum(axis=1) > 0).sum() == (ct > 0).sum()
+
+
+def test_multibox_detection_decodes():
+    feat = nd.zeros((1, 4, 2, 2))
+    anchors = mx.contrib.nd.MultiBoxPrior(feat, sizes=(0.5,), ratios=(1.0,))
+    N = anchors.shape[1]
+    cls_prob = nd.array(np.tile([[0.1], [0.8], [0.1]], (1, 1, N)))
+    loc_pred = nd.zeros((1, N * 4))
+    det = mx.contrib.nd.MultiBoxDetection(cls_prob, loc_pred, anchors,
+                                          nms_threshold=0.9).asnumpy()
+    assert det.shape == (1, N, 6)
+    top = det[0, det[0, :, 1].argmax()]
+    assert top[0] == 0                  # class 0 (first fg class)
+    assert top[1] == pytest.approx(0.8, abs=1e-5)
+    # decoded box equals anchor when loc_pred == 0
+    np.testing.assert_allclose(top[2:], anchors.asnumpy()[0, 0], atol=1e-5)
+
+
+def test_foreach_matches_python_loop():
+    """Property check vs an imperative python loop (reference pattern)."""
+    W = nd.random.normal(shape=(4, 4))
+
+    def body(x, h):
+        new_h = nd.tanh(nd.dot(x, W) + h)
+        return new_h, new_h
+
+    data = nd.random.normal(shape=(5, 2, 4))
+    out, final = mx.contrib.foreach(body, data, nd.zeros((2, 4)))
+    h = nd.zeros((2, 4))
+    for t in range(5):
+        h = nd.tanh(nd.dot(data[t], W) + h)
+    np.testing.assert_allclose(final.asnumpy(), h.asnumpy(), rtol=1e-5,
+                               atol=1e-5)

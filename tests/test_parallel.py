@@ -1,0 +1,169 @@
+"""Parallel-layer tests on the fake 8-device CPU mesh.
+
+Reference patterns: tests/nightly/dist_sync_kvstore.py (exact-integer
+payload reduces), SURVEY.md §4.5 (xla_force_host_platform_device_count
+fake-mesh testing of kvstore='ici'/shard_map logic).
+
+Key invariant exercised throughout: sharding annotations NEVER change
+semantics — a dp- or dp×tp-sharded TrainStep must produce the same loss
+trajectory as the single-device step (XLA inserts collectives to preserve
+the math; placement only affects performance).
+"""
+import numpy as np
+import pytest
+
+import jax
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.parallel import (make_mesh, shard_params_tp, batch_sharded,
+                                TrainStep)
+from jax.sharding import PartitionSpec as P
+
+
+def _devices(n=8):
+    devs = jax.devices("cpu")
+    if len(devs) < n:
+        pytest.skip("needs %d fake devices" % n)
+    return devs[:n]
+
+
+def _make_net(seed=0, dense_sizes=(16, 10), conv=False):
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    net = nn.HybridSequential()
+    if conv:
+        net.add(nn.Conv2D(4, 3, padding=1, activation="relu"),
+                nn.MaxPool2D(), nn.Flatten())
+    for k in dense_sizes[:-1]:
+        net.add(nn.Dense(k, activation="relu"))
+    net.add(nn.Dense(dense_sizes[-1]))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def _loss_fn(logits, labels):
+    import jax.numpy as jnp
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    onehot = jax.nn.one_hot(labels, logits.shape[-1], dtype=logp.dtype)
+    return -jnp.mean(jnp.sum(logp * onehot, axis=-1))
+
+
+def _batch(seed=1, n=16, feat=(8,), classes=10):
+    rng = np.random.RandomState(seed)
+    import jax.numpy as jnp
+    x = jnp.asarray(rng.randn(n, *feat).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, classes, n).astype(np.int32))
+    return x, y
+
+
+def _run_steps(net_seed, mesh, steps=3, conv=False, tp_rules=None):
+    feat = (3, 8, 8) if conv else (8,)
+    net = _make_net(net_seed, conv=conv)
+    net(nd.zeros((1,) + feat))      # finalize deferred shapes
+    step = TrainStep(net, _loss_fn, mesh, learning_rate=0.1,
+                     momentum=0.9, tp_rules=tp_rules)
+    x, y = _batch(net_seed + 1, feat=feat)
+    return [float(step(x, y)) for _ in range(steps)]
+
+
+def test_make_mesh_shapes():
+    devs = _devices()
+    m = make_mesh(axes=("dp",), devices=devs)
+    assert dict(m.shape) == {"dp": 8}
+    m = make_mesh(axes=("dp", "tp"), shape=(-1, 2), devices=devs)
+    assert dict(m.shape) == {"dp": 4, "tp": 2}
+    m = make_mesh(axes=("dp", "tp"), shape=(2, 4), devices=devs)
+    assert dict(m.shape) == {"dp": 2, "tp": 4}
+
+
+def test_dp_matches_single_device():
+    devs = _devices()
+    losses_1 = _run_steps(0, make_mesh(axes=("dp",), devices=devs[:1]))
+    losses_8 = _run_steps(0, make_mesh(axes=("dp",), devices=devs))
+    np.testing.assert_allclose(losses_1, losses_8, rtol=2e-4)
+    assert losses_8[-1] < losses_8[0]    # and it actually descends
+
+
+def test_dp_tp_matches_dp_only():
+    devs = _devices()
+    losses_dp = _run_steps(0, make_mesh(axes=("dp",), devices=devs))
+    losses_tp = _run_steps(0, make_mesh(axes=("dp", "tp"), shape=(-1, 2),
+                                        devices=devs))
+    np.testing.assert_allclose(losses_dp, losses_tp, rtol=2e-4)
+
+
+def test_tp_non_alternating_architecture_correct():
+    """3 Dense + conv: col/row alternation is a placement heuristic only —
+    results must equal the single-device run regardless of layer layout."""
+    devs = _devices()
+    losses_1 = _run_steps(0, make_mesh(axes=("dp",), devices=devs[:1]),
+                          conv=True)
+    losses_tp = _run_steps(0, make_mesh(axes=("dp", "tp"), shape=(2, 4),
+                                        devices=devs), conv=True)
+    np.testing.assert_allclose(losses_1, losses_tp, rtol=2e-4)
+
+
+def test_shard_params_tp_explicit_rules():
+    devs = _devices()
+    mesh = make_mesh(axes=("dp", "tp"), shape=(4, 2), devices=devs)
+    import jax.numpy as jnp
+    params = {"a.weight": jnp.zeros((8, 4)), "a.bias": jnp.zeros((8,)),
+              "emb.weight": jnp.zeros((16, 8))}
+    out = shard_params_tp(params, mesh, rules={"a.weight": P("tp", None)})
+    spec_a = out["a.weight"].sharding.spec
+    assert tuple(spec_a) == ("tp", None)
+    # un-matched names replicate under explicit rules
+    assert tuple(out["emb.weight"].sharding.spec) in ((), (None, None))
+
+
+def test_shard_params_tp_default_alternation():
+    devs = _devices()
+    mesh = make_mesh(axes=("dp", "tp"), shape=(4, 2), devices=devs)
+    import jax.numpy as jnp
+    params = {"0.weight": jnp.zeros((8, 4)), "0.bias": jnp.zeros((8,)),
+              "1.weight": jnp.zeros((4, 8))}
+    out = shard_params_tp(params, mesh)
+    assert tuple(out["0.weight"].sharding.spec) == ("tp", None)   # column
+    assert tuple(out["1.weight"].sharding.spec) == (None, "tp")   # row
+    assert tuple(out["0.bias"].sharding.spec) in ((), (None,))    # replicated
+
+
+def test_batch_sharded_placement():
+    devs = _devices()
+    mesh = make_mesh(axes=("dp",), devices=devs)
+    import jax.numpy as jnp
+    x = jax.device_put(jnp.zeros((16, 4)), batch_sharded(mesh))
+    assert len(x.sharding.device_set) == 8
+    assert tuple(x.sharding.spec) == ("dp",)
+
+
+def test_kvstore_ici_exact_integer_reduce():
+    """dist_sync_kvstore pattern: push known integer payloads from every
+    'worker' (device), pull the exact sum."""
+    kv = mx.kvstore.create("ici")
+    shape = (4, 4)
+    kv.init("w", nd.zeros(shape))
+    n = kv.num_devices if hasattr(kv, "num_devices") else 8
+    vals = [nd.array(np.full(shape, i + 1, np.float32)) for i in range(4)]
+    kv.push("w", vals)
+    out = nd.zeros(shape)
+    kv.pull("w", out=out)
+    np.testing.assert_array_equal(out.asnumpy(),
+                                  np.full(shape, 1 + 2 + 3 + 4, np.float32))
+
+
+def test_trainstep_write_back():
+    devs = _devices()
+    mesh = make_mesh(axes=("dp",), devices=devs)
+    net = _make_net(3)
+    net(nd.zeros((1, 8)))
+    before = {k: p.data().asnumpy().copy()
+              for k, p in net.collect_params().items()}
+    step = TrainStep(net, _loss_fn, mesh, learning_rate=0.1)
+    x, y = _batch(4)
+    step(x, y)
+    step.write_back(net)
+    after = {k: p.data().asnumpy() for k, p in net.collect_params().items()}
+    changed = [k for k in before if not np.allclose(before[k], after[k])]
+    assert changed, "write_back did not update any parameter"
