@@ -34,6 +34,7 @@ from ..base import MXNetError, get_env
 from ..device import Context, current_context, cpu
 from ..engine import engine
 from ..ops.registry import get_op, cached_jit
+from .. import profiler as _profiler
 
 __all__ = ["NDArray", "invoke", "array", "zeros", "ones", "full", "empty",
            "arange", "zeros_like", "ones_like", "concatenate", "stack_arrays",
@@ -668,7 +669,15 @@ _sym_tracer = None
 
 
 def invoke(op_name: str, *inputs, out=None, **params):
-    ret = _invoke_impl(op_name, *inputs, out=out, **params)
+    if _profiler.IMPERATIVE:
+        with _profiler.op_span(op_name):
+            ret = _invoke_impl(op_name, *inputs, out=out, **params)
+            if _profiler.want_sync():
+                jax.tree_util.tree_map(
+                    lambda x: jax.block_until_ready(x._jax)
+                    if isinstance(x, NDArray) else x, ret)
+    else:
+        ret = _invoke_impl(op_name, *inputs, out=out, **params)
     tracer = _sym_tracer
     if tracer is not None:
         tracer.record(op_name,
