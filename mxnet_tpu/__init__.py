@@ -20,6 +20,7 @@ from . import autograd
 from . import random
 from .ndarray import NDArray, waitall
 
+from . import amp
 from . import profiler
 from . import symbol
 from . import symbol as sym

@@ -1,0 +1,272 @@
+"""mx.amp — automatic mixed precision.
+
+Reference: ``python/mxnet/contrib/amp/amp.py`` (init, init_trainer,
+scale_loss, unscale, convert_hybrid_block) and its op lists
+(``lists/symbol_fp16.py``).
+
+TPU-first design: the reference rewrites graphs by monkey-patching every op
+function and inserting ``amp_cast`` symbol nodes.  Here **all** op traffic —
+eager, autograd, and hybridize tracing — flows through one dispatcher
+(``ndarray.invoke``), so AMP is a single cast hook at that chokepoint:
+ops on the *target* list get narrow inputs, ops on the *fp32* list get wide
+inputs, *widest* ops get type-matched inputs, and XLA propagates dtypes
+through everything else (then fuses the casts into adjacent kernels, so the
+inserted converts are free in practice).
+
+The default target dtype is ``bfloat16``: MXU-native and fp32-exponent-range,
+so loss scaling is a no-op by default (``LossScaler(init_scale=1)``).
+``float16`` is supported with the reference's dynamic loss-scaling algorithm
+for parity.
+"""
+from __future__ import annotations
+
+import functools
+from contextlib import contextmanager
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from . import lists
+
+__all__ = ["init", "init_trainer", "scale_loss", "unscale", "LossScaler",
+           "convert_hybrid_block", "lists"]
+
+# Consulted by ndarray._invoke_impl on every dispatch; None = AMP off.
+STATE: Optional["_AmpState"] = None
+
+_NARROW = (jnp.bfloat16, jnp.float16)
+
+
+class _AmpState:
+    __slots__ = ("target_dtype", "target_ops", "fp32_ops", "widest_ops",
+                 "conditional_fp32")
+
+    def __init__(self, target_dtype, target_ops, fp32_ops, widest_ops,
+                 conditional_fp32):
+        self.target_dtype = target_dtype
+        self.target_ops = frozenset(target_ops)
+        self.fp32_ops = frozenset(fp32_ops)
+        self.widest_ops = frozenset(widest_ops)
+        # {op_name: (param_name, frozenset(values))}
+        self.conditional_fp32 = {name: (pname, frozenset(vals))
+                                 for name, pname, vals in conditional_fp32}
+
+    def cast_inputs(self, op_name: str, params: dict, jax_in: list) -> list:
+        """Apply the op's dtype policy to its unwrapped jax.Array inputs."""
+        if op_name in self.target_ops:
+            return [self._to(x, self.target_dtype) for x in jax_in]
+        if op_name in self.fp32_ops:
+            return [self._up(x) for x in jax_in]
+        cond = self.conditional_fp32.get(op_name)
+        if cond is not None and str(params.get(cond[0])) in cond[1]:
+            return [self._up(x) for x in jax_in]
+        if op_name in self.widest_ops:
+            floats = [x.dtype for x in jax_in
+                      if isinstance(x, jnp.ndarray) and
+                      jnp.issubdtype(x.dtype, jnp.floating)]
+            if len(set(floats)) > 1:
+                widest = functools.reduce(jnp.promote_types, floats)
+                return [self._to(x, widest) for x in jax_in]
+        return jax_in
+
+    @staticmethod
+    def _to(x, dtype):
+        if isinstance(x, jnp.ndarray) and \
+                jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != dtype:
+            return x.astype(dtype)
+        return x
+
+    @staticmethod
+    def _up(x):
+        if isinstance(x, jnp.ndarray) and x.dtype in _NARROW:
+            return x.astype(jnp.float32)
+        return x
+
+
+def init(target_dtype="bfloat16", target_dtype_ops=None, fp32_ops=None,
+         widest_dtype_ops=None, conditional_fp32_ops=None):
+    """Turn AMP on (reference: amp.init).
+
+    target_dtype: 'bfloat16' (TPU default) or 'float16'.
+    The *_ops arguments override the default lists in ``amp.lists``.
+    """
+    global STATE
+    dt = _np.dtype(jnp.bfloat16) if str(target_dtype) == "bfloat16" \
+        else _np.dtype(target_dtype)
+    if dt not in (_np.dtype(jnp.bfloat16), _np.dtype("float16")):
+        raise ValueError("AMP target_dtype must be bfloat16 or float16, "
+                         "got %s" % target_dtype)
+    STATE = _AmpState(
+        dt,
+        lists.TARGET_DTYPE_OPS if target_dtype_ops is None else target_dtype_ops,
+        lists.FP32_OPS if fp32_ops is None else fp32_ops,
+        lists.WIDEST_TYPE_CASTS if widest_dtype_ops is None else widest_dtype_ops,
+        lists.CONDITIONAL_FP32_OPS if conditional_fp32_ops is None
+        else conditional_fp32_ops,
+    )
+
+
+def turn_off():
+    """Disable AMP casting (no reference equivalent; useful in tests)."""
+    global STATE
+    STATE = None
+
+
+def active() -> bool:
+    return STATE is not None
+
+
+# -- dynamic loss scaling -----------------------------------------------------
+
+@functools.partial(jax.jit)
+def _all_finite(flat):
+    ok = jnp.bool_(True)
+    for g in flat:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(g)))
+    return ok
+
+
+class LossScaler:
+    """Dynamic loss scaling (reference: amp.loss_scaler.LossScaler).
+
+    Multiply the loss by ``loss_scale`` before backward; divide gradients
+    back during the update (via the trainer's rescale_grad); on any
+    non-finite gradient skip the update and halve the scale; after
+    ``scale_window`` clean steps double it.
+    """
+
+    def __init__(self, init_scale=2. ** 16, scale_factor=2.,
+                 scale_window=2000):
+        self.loss_scale = float(init_scale)
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._unskipped = 0
+        self._max_scale = 2. ** 24
+
+    def has_overflow(self, params) -> bool:
+        """Check grads of ``params`` for inf/nan (one fused jitted reduce)."""
+        grads = []
+        for p in params:
+            for g in p.list_grad():
+                grads.append(g._jax)
+        if not grads:
+            return False
+        return not bool(_all_finite(grads))
+
+    def update_scale(self, overflow: bool):
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self._scale_factor, 1.0)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._scale_window:
+                self.loss_scale = min(self.loss_scale * self._scale_factor,
+                                      self._max_scale)
+                self._unskipped = 0
+
+
+class _StaticScaler(LossScaler):
+    """bf16 needs no scaling: scale pinned at 1, overflow check skipped
+    (bf16 has fp32's exponent range — overflow means the model diverged,
+    and hiding that behind skipped steps would be a disservice)."""
+
+    def __init__(self):
+        super().__init__(init_scale=1.0)
+
+    def has_overflow(self, params) -> bool:
+        return False
+
+    def update_scale(self, overflow: bool):
+        pass
+
+
+def init_trainer(trainer):
+    """Attach a loss scaler to a Gluon Trainer (reference: amp.init_trainer).
+
+    Wraps the trainer's update so a step with non-finite gradients is
+    skipped and the scale backed off — the reference does the same via its
+    patched optimizer.
+    """
+    if STATE is None:
+        raise RuntimeError("amp.init() must be called before init_trainer()")
+    if getattr(trainer, "_amp_loss_scaler", None) is not None:
+        return
+    scaler = _StaticScaler() if STATE.target_dtype == _np.dtype(jnp.bfloat16) \
+        else LossScaler()
+    trainer._amp_loss_scaler = scaler
+    trainer._amp_original_scale = trainer._scale
+    orig_update = trainer._update
+
+    def _amp_update(ignore_stale_grad=False):
+        live = [p for p in trainer._params if p.grad_req != "null"]
+        overflow = scaler.has_overflow(live)
+        if not overflow:
+            orig_update(ignore_stale_grad)
+        scaler.update_scale(overflow)
+
+    trainer._update = _amp_update
+
+
+@contextmanager
+def scale_loss(loss, trainer):
+    """Scale the loss up before ``backward()`` (reference: amp.scale_loss).
+
+    Usage::
+
+        with amp.scale_loss(loss, trainer) as scaled:
+            scaled.backward()
+        trainer.step(batch_size)
+    """
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        yield loss
+        return
+    trainer._scale = trainer._amp_original_scale / scaler.loss_scale
+    if isinstance(loss, (list, tuple)):
+        yield type(loss)(l * scaler.loss_scale for l in loss)
+    else:
+        yield loss * scaler.loss_scale
+
+
+def unscale(trainer):
+    """Divide current gradients by the loss scale in place (reference:
+    amp.unscale) — for gradient manipulation between backward and step."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None or scaler.loss_scale == 1.0:
+        return
+    inv = 1.0 / scaler.loss_scale
+    for p in trainer._params:
+        if p.grad_req == "null":
+            continue
+        for g in p.list_grad():
+            g *= inv
+    # grads are now unscaled; stop the trainer from dividing again
+    trainer._scale = trainer._amp_original_scale
+
+
+def convert_hybrid_block(block, target_dtype="bfloat16",
+                         cast_optional_params=False):
+    """Cast a HybridBlock for narrow-dtype inference (reference:
+    amp.convert_hybrid_block).
+
+    Casts every parameter to ``target_dtype`` except normalization-layer
+    parameters (gamma/beta/moving stats stay fp32 — the FP32_OPS policy
+    promotes their inputs at dispatch when AMP is active, and XLA fuses the
+    converts).
+    """
+    from ..gluon import nn as _nn
+    norm_types = (_nn.BatchNorm, _nn.LayerNorm, _nn.GroupNorm,
+                  _nn.InstanceNorm)
+
+    def _walk(b):
+        yield b
+        for c in b._children.values():
+            yield from _walk(c)
+
+    block.cast(target_dtype)
+    for child in _walk(block):
+        if isinstance(child, norm_types):
+            child.cast("float32")
+    return block

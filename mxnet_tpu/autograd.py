@@ -290,9 +290,15 @@ def _run_backward(heads, head_grads, retain_graph, write_leaves=True,
         slot = cts.get(id(node))
         if slot is None:
             continue
+        # Cotangents must match each output's dtype; a consumer may have
+        # promoted (e.g. the AMP fp32-list casts a bf16 activation up before
+        # a loss op), in which case its cotangent arrives wide — cast it
+        # back, which is precisely the vjp of the implicit promote.
         cotangents = [
-            c if c is not None else jnp.zeros(node.out_avals[i][0],
-                                              node.out_avals[i][1])
+            jnp.zeros(node.out_avals[i][0], node.out_avals[i][1])
+            if c is None
+            else (c.astype(node.out_avals[i][1])
+                  if c.dtype != node.out_avals[i][1] else c)
             for i, c in enumerate(slot)]
         ct_in = tuple(cotangents) if node.out_structure == "tuple" else cotangents[0]
         if node.vjp_fn is None:

@@ -9,7 +9,8 @@ the control-flow combinators live at both `mx.contrib.nd.foreach` and the
 2.x-style `mx.npx`-free top level here.
 """
 from ..ops.control_flow import foreach, while_loop, cond
+from .. import amp  # 1.x location: mx.contrib.amp (2.x: mx.amp)
 from . import ndarray
 from . import ndarray as nd
 
-__all__ = ["foreach", "while_loop", "cond", "nd", "ndarray"]
+__all__ = ["foreach", "while_loop", "cond", "nd", "ndarray", "amp"]

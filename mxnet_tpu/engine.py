@@ -63,6 +63,10 @@ class Engine:
         for chunk in list(self._live):
             data = getattr(chunk, "data", None)
             if data is not None and hasattr(data, "block_until_ready"):
+                # a buffer donated into a jit (e.g. parallel.TrainStep) is
+                # deleted on the device; there is nothing left to wait on
+                if getattr(data, "is_deleted", lambda: False)():
+                    continue
                 data.block_until_ready()
 
     def maybe_sync(self, value):

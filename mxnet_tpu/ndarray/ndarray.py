@@ -35,6 +35,7 @@ from ..device import Context, current_context, cpu
 from ..engine import engine
 from ..ops.registry import get_op, cached_jit
 from .. import profiler as _profiler
+from .. import amp as _amp
 
 __all__ = ["NDArray", "invoke", "array", "zeros", "ones", "full", "empty",
            "arange", "zeros_like", "ones_like", "concatenate", "stack_arrays",
@@ -713,6 +714,9 @@ def _invoke_impl(op_name: str, *inputs, out=None, **params):
         else:
             raise TypeError("invoke(%s): bad input type %s" % (op_name, type(x)))
     ctx = ctx or current_context()
+    amp_state = _amp.STATE
+    if amp_state is not None:
+        jax_in = amp_state.cast_inputs(op.name, params, jax_in)
     if op.needs_rng:
         from ..ops import random as _rnd
         jax_in.insert(0, _rnd.next_key())

@@ -1,0 +1,169 @@
+"""mx.amp tests.
+
+Reference pattern: tests/python/unittest/test_amp.py / test_contrib_amp.py —
+list-driven casting, loss scaling semantics, converted-model dtype checks.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import amp, autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+@pytest.fixture(autouse=True)
+def _amp_off_after():
+    yield
+    amp.turn_off()
+
+
+def test_target_op_casts_down():
+    amp.init()
+    a = mx.nd.ones((4, 8))            # fp32
+    b = mx.nd.ones((8, 2))
+    out = mx.nd.dot(a, b)
+    assert out.dtype == np.dtype("bfloat16").newbyteorder("=") or \
+        str(out.dtype) == "bfloat16"
+    np.testing.assert_allclose(out.asnumpy().astype(np.float32), 8.0)
+
+
+def test_fp32_op_casts_up():
+    amp.init()
+    x = mx.nd.ones((2, 3), dtype="bfloat16")
+    out = mx.nd.softmax(x)
+    assert str(out.dtype) == "float32"
+
+
+def test_widest_cast():
+    amp.init()
+    a = mx.nd.ones((4,), dtype="bfloat16")
+    b = mx.nd.ones((4,), dtype="float32")
+    out = a + b
+    assert str(out.dtype) == "float32"
+
+
+def test_conditional_fp32():
+    amp.init()
+    x = mx.nd.ones((4,), dtype="bfloat16")
+    soft = mx.nd.Activation(x, act_type="softrelu")
+    assert str(soft.dtype) == "float32"
+    rel = mx.nd.Activation(x, act_type="relu")
+    assert str(rel.dtype) == "bfloat16"
+
+
+def test_off_by_default_and_turn_off():
+    a = mx.nd.ones((2, 2))
+    assert str(mx.nd.dot(a, a).dtype) == "float32"
+    amp.init()
+    assert str(mx.nd.dot(a, a).dtype) == "bfloat16"
+    amp.turn_off()
+    assert str(mx.nd.dot(a, a).dtype) == "float32"
+
+
+def test_grads_flow_through_amp_casts():
+    amp.init()
+    w = mx.nd.array(np.random.randn(8, 2).astype(np.float32))
+    w.attach_grad()
+    x = mx.nd.array(np.random.randn(4, 8).astype(np.float32))
+    with autograd.record():
+        y = mx.nd.dot(x, w)
+        loss = (y * y).mean()
+    loss.backward()
+    g = w.grad.asnumpy()
+    assert g.dtype == np.float32          # master grad stays wide
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def _toy_trainer(dtype="float16"):
+    net = nn.Dense(1, in_units=4)
+    net.initialize()
+    if dtype:
+        net.cast(dtype)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1,
+                             "multi_precision": dtype == "float16"})
+    return net, trainer
+
+
+def test_scale_loss_and_dynamic_scaler():
+    amp.init(target_dtype="float16")
+    net, trainer = _toy_trainer("float16")
+    amp.init_trainer(trainer)
+    scaler = trainer._amp_loss_scaler
+    assert scaler.loss_scale > 1.0
+    scaler.loss_scale = 1024.0  # keep loss*scale inside fp16 range
+    s0 = scaler.loss_scale
+    x = mx.nd.ones((2, 4), dtype="float16")
+    y = mx.nd.ones((2, 1), dtype="float16")
+    with autograd.record():
+        out = net(x)
+        loss = ((out - y) ** 2).mean()
+        with amp.scale_loss(loss, trainer) as scaled:
+            pass
+    scaled.backward()
+    # backward saw the scaled loss; trainer divides by the scale on update
+    assert trainer._scale == pytest.approx(1.0 / s0)
+    w_before = net.weight.data().asnumpy().copy()
+    trainer.step(2)
+    assert not np.allclose(net.weight.data().asnumpy(), w_before)
+
+
+def test_overflow_skips_update_and_backs_off():
+    amp.init(target_dtype="float16")
+    net, trainer = _toy_trainer("float16")
+    amp.init_trainer(trainer)
+    scaler = trainer._amp_loss_scaler
+    x = mx.nd.ones((2, 4), dtype="float16")
+    with autograd.record():
+        loss = net(x).mean()
+    loss.backward()
+    # poison the gradient
+    net.weight.grad()[:] = mx.nd.full(net.weight.grad().shape, np.inf,
+                                      dtype="float16")
+    w_before = net.weight.data().asnumpy().copy()
+    s0 = scaler.loss_scale
+    trainer.step(2)
+    np.testing.assert_array_equal(net.weight.data().asnumpy(), w_before)
+    assert scaler.loss_scale == s0 / 2
+
+
+def test_bf16_amp_training_converges():
+    amp.init()  # bfloat16
+    np.random.seed(0)
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+    amp.init_trainer(trainer)
+    sce = gluon.loss.SoftmaxCrossEntropyLoss()
+    X = np.random.randn(128, 8).astype(np.float32)
+    Y = (X[:, 0] > 0).astype(np.int32)
+    losses = []
+    for _ in range(30):
+        x, y = mx.nd.array(X), mx.nd.array(Y)
+        with autograd.record():
+            loss = sce(net(x), y)
+            with amp.scale_loss(loss, trainer) as scaled:
+                pass
+        scaled.backward()
+        trainer.step(128)
+        losses.append(float(loss.mean().asnumpy()))
+    assert losses[-1] < 0.3 < losses[0]
+
+
+def test_convert_hybrid_block_keeps_norms_fp32():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8), nn.BatchNorm(), nn.Dense(2))
+    net.initialize()
+    net(mx.nd.ones((2, 4)))
+    amp.convert_hybrid_block(net, "bfloat16")
+    assert str(net[0].weight.dtype) == "bfloat16"
+    assert str(net[1].gamma.dtype) == "float32"
+    assert str(net[2].weight.dtype) == "bfloat16"
+    # runs end to end with AMP handling the dtype boundaries
+    amp.init()
+    out = net(mx.nd.ones((2, 4), dtype="bfloat16"))
+    assert np.isfinite(out.asnumpy().astype(np.float32)).all()
