@@ -317,8 +317,13 @@ class Embedding(HybridBlock):
         super().__init__(**kwargs)
         self._input_dim = input_dim
         self._output_dim = output_dim
+        # sparse_grad: the trainer converts the (dense, mostly-zero-row)
+        # tape gradient to row_sparse so the optimizer's lazy path touches
+        # only rows the batch used (reference: Embedding sparse_grad)
         self.weight = Parameter("weight", shape=(input_dim, output_dim),
-                                dtype=dtype, init=weight_initializer)
+                                dtype=dtype, init=weight_initializer,
+                                grad_stype="row_sparse" if sparse_grad
+                                else "default")
 
     def forward(self, x):
         return invoke("Embedding", x, self.weight.data(x.context),

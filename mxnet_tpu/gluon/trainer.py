@@ -207,8 +207,13 @@ class Trainer:
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
                 continue
+            sparse = param._grad_stype == "row_sparse"
             for upd, arr, grad in zip(self._updaters, param.list_data(),
                                       param.list_grad()):
+                if sparse:
+                    # nnz discovery is a host sync (reference cast_storage);
+                    # the update itself is a jitted gather/scatter
+                    grad = grad.tostype("row_sparse")
                 upd(i, grad, arr)
 
     # -- states ------------------------------------------------------------

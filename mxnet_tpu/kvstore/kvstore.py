@@ -113,9 +113,34 @@ class KVStore:
             self.pull(key, out, priority)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        """Dense fallback: TPU keeps RowSparse semantics via gather
-        (SURVEY.md sparse row); full rows pulled here."""
-        self.pull(key, out, priority)
+        """Pull only `row_ids` rows of the stored value (reference:
+        KVStore.row_sparse_pull).  One jitted gather per target; the result
+        lands in `out` (RowSparseNDArray: contents swapped in; dense
+        NDArray: full pull fallback) or is returned."""
+        if row_ids is None:
+            return self.pull(key, out, priority)
+        from ..ndarray.sparse import RowSparseNDArray
+        keys, outs = self._normalize(key, out)
+        ids_per_key = row_ids if isinstance(row_ids, (list, tuple)) else \
+            [row_ids] * len(keys)
+        results = []
+        for k, o, ids in zip(keys, outs, ids_per_key):
+            stored = self._store[k]
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            per_target = ids if isinstance(ids, (list, tuple)) else \
+                [ids] * len(targets)
+            for t, tid in zip(targets, per_target):
+                if tid.dtype not in (_np.int32, _np.int64):
+                    tid = tid.astype(_np.int32)
+                rows = nd.invoke("take", stored, tid, axis=0)
+                if isinstance(t, RowSparseNDArray):
+                    t._data = rows
+                    t._indices = tid
+                elif isinstance(t, NDArray):
+                    stored.copyto(t)  # dense target: full pull
+                else:
+                    results.append(RowSparseNDArray(rows, tid, stored.shape))
+        return results or None
 
     # -- optimizer ---------------------------------------------------------
     def set_optimizer(self, optimizer):

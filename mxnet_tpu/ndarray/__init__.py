@@ -36,6 +36,9 @@ for _name in _registry.list_ops():
     if not hasattr(_this, _name) and _name.isidentifier():
         setattr(_this, _name, _make_op_func(_name))
 
+from . import sparse
+from .sparse import cast_storage, RowSparseNDArray, CSRNDArray
+
 def stack(*data, axis=0, **kw):
     """MXNet varargs form: nd.stack(a, b, axis=0); also accepts a list."""
     if len(data) == 1 and isinstance(data[0], (list, tuple)):

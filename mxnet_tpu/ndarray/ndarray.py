@@ -557,7 +557,8 @@ class NDArray:
 
     def tostype(self, stype):
         if stype != "default":
-            raise NotImplementedError("sparse storage conversion: see sparse.py")
+            from .sparse import cast_storage
+            return cast_storage(self, stype)
         return self
 
     def slice_axis(self, axis, begin, end):

@@ -1,0 +1,411 @@
+"""Sparse NDArrays: RowSparse and CSR.
+
+Reference: ``python/mxnet/ndarray/sparse.py`` (RowSparseNDArray, CSRNDArray,
+row_sparse_array, csr_matrix, cast_storage, retain, sparse.dot),
+``src/operator/tensor/cast_storage-inl.h`` (CastStorage),
+``src/operator/tensor/dot-inl.h`` (DotCsrDnsDnsImpl),
+``src/operator/optimizer_op.cc`` (rowsparse SGD/Adam — the lazy updates live
+in ``ops/optimizer.py`` here).
+
+TPU-first design (SURVEY.md sparse row): XLA has no sparse storage — the MXU
+wants dense tiles — so sparse here is *semantics*, not a kernel library:
+
+* a RowSparseNDArray is (indices, data-rows); converting to dense is one
+  ``scatter``; every fixed-nnz computation (dot, retain, lazy optimizer
+  update) is a jitted gather/scatter/segment_sum, which XLA lowers well.
+* discovering nnz (dense → sparse) is *dynamic-shaped* and therefore a
+  host-side eager step — exactly the reference's CastStorage sync point.
+* the payoff is the same as the reference's: embedding-sized workloads touch
+  only the rows a batch used (optimizer updates, kvstore row_sparse_pull),
+  instead of materializing full-vocabulary gradients.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple, Union
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from ..device import Context, current_context
+from ..base import MXNetError
+from .ndarray import NDArray, array as _dense_array, from_jax
+
+__all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
+           "row_sparse_array", "csr_matrix", "cast_storage", "retain",
+           "dot", "zeros", "empty", "array"]
+
+
+# -- jitted fixed-nnz kernels -------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("shape",))
+def _rsp_to_dense(data, indices, shape):
+    return jnp.zeros(shape, data.dtype).at[indices].set(data)
+
+
+@functools.partial(jax.jit, static_argnames=("shape",))
+def _csr_to_dense(data, indices, indptr, shape):
+    nnz = data.shape[0]
+    row_ids = jnp.searchsorted(indptr, jnp.arange(nnz), side="right") - 1
+    return jnp.zeros(shape, data.dtype).at[row_ids, indices].add(data)
+
+
+@functools.partial(jax.jit, static_argnames=("n_rows",))
+def _csr_dot_dns(data, indices, indptr, rhs, n_rows):
+    """out[i, :] = sum_{nz in row i} data[nz] * rhs[col[nz], :]."""
+    nnz = data.shape[0]
+    row_ids = jnp.searchsorted(indptr, jnp.arange(nnz), side="right") - 1
+    contrib = data[:, None] * rhs[indices]
+    return jax.ops.segment_sum(contrib, row_ids, num_segments=n_rows)
+
+
+@functools.partial(jax.jit, static_argnames=("n_cols",))
+def _csr_t_dot_dns(data, indices, indptr, rhs, n_cols):
+    """out[j, :] = sum_{nz with col j} data[nz] * rhs[row[nz], :]."""
+    nnz = data.shape[0]
+    row_ids = jnp.searchsorted(indptr, jnp.arange(nnz), side="right") - 1
+    contrib = data[:, None] * rhs[row_ids]
+    return jax.ops.segment_sum(contrib, indices, num_segments=n_cols)
+
+
+@jax.jit
+def _retain_rows(data, indices, keep_ids):
+    """Gather the kept subset: rows of `indices` present in `keep_ids`
+    survive; absent keep_ids yield zero rows (reference retain semantics:
+    the result's indices are exactly `keep_ids` ∩ stored, but with fixed
+    shapes we return one row per keep_id, zeros where missing)."""
+    pos = jnp.searchsorted(indices, keep_ids)
+    pos = jnp.clip(pos, 0, indices.shape[0] - 1)
+    hit = indices[pos] == keep_ids
+    rows = data[pos]
+    return jnp.where(hit[(...,) + (None,) * (data.ndim - 1)], rows,
+                     jnp.zeros_like(rows)), hit
+
+
+# -- classes ------------------------------------------------------------------
+
+class BaseSparseNDArray:
+    """Common surface shared by RowSparseNDArray / CSRNDArray."""
+
+    stype: str = "undefined"
+
+    def __init__(self, shape: Tuple[int, ...], dtype, ctx: Context):
+        self._shape = tuple(int(s) for s in shape)
+        self._dtype = _np.dtype(dtype)
+        self._ctx = ctx
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def context(self):
+        return self._ctx
+
+    ctx = context
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def size(self):
+        out = 1
+        for s in self._shape:
+            out *= s
+        return out
+
+    def asnumpy(self):
+        return self.tostype("default").asnumpy()
+
+    def astype(self, dtype):
+        raise NotImplementedError
+
+    def tostype(self, stype):
+        raise NotImplementedError
+
+    def wait_to_read(self):
+        pass
+
+    def __len__(self):
+        return self._shape[0]
+
+    def __repr__(self):
+        return "\n<%s %s @%s>" % (type(self).__name__,
+                                  "x".join(str(s) for s in self._shape),
+                                  self._ctx)
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """A majority-zero-rows array: (indices, data) (reference:
+    RowSparseNDArray — indices are the ids of non-zero rows, sorted
+    ascending and unique; data stacks those rows)."""
+
+    stype = "row_sparse"
+
+    def __init__(self, data: NDArray, indices: NDArray,
+                 shape: Tuple[int, ...]):
+        if data.shape[0] != indices.shape[0]:
+            raise ValueError("data rows (%d) != indices (%d)"
+                             % (data.shape[0], indices.shape[0]))
+        super().__init__(shape, data.dtype, data.context)
+        self._data = data
+        self._indices = indices
+
+    @property
+    def data(self) -> NDArray:
+        return self._data
+
+    @property
+    def indices(self) -> NDArray:
+        return self._indices
+
+    def tostype(self, stype: str):
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            if self._data.shape[0] == 0:
+                return _dense_array(_np.zeros(self._shape, self._dtype),
+                                    ctx=self._ctx)
+            return from_jax(_rsp_to_dense(self._data._jax,
+                                          self._indices._jax, self._shape),
+                            ctx=self._ctx)
+        raise ValueError("cannot cast row_sparse to %r" % stype)
+
+    def astype(self, dtype):
+        return RowSparseNDArray(self._data.astype(dtype), self._indices,
+                                self._shape)
+
+    def retain(self, row_ids):
+        return retain(self, row_ids)
+
+    def copyto(self, other):
+        if isinstance(other, RowSparseNDArray):
+            raise MXNetError("copyto(row_sparse): destination must be "
+                             "rebuilt, arrays are (indices,data) pairs")
+        return self.tostype("default").copyto(other)
+
+    def __neg__(self):
+        return RowSparseNDArray(-self._data, self._indices, self._shape)
+
+    def __mul__(self, other):
+        if isinstance(other, (int, float)):
+            return RowSparseNDArray(self._data * other, self._indices,
+                                    self._shape)
+        return NotImplemented
+
+    __rmul__ = __mul__
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row 2-D array (reference: CSRNDArray)."""
+
+    stype = "csr"
+
+    def __init__(self, data: NDArray, indices: NDArray, indptr: NDArray,
+                 shape: Tuple[int, int]):
+        if len(shape) != 2:
+            raise ValueError("CSR must be 2-D, got %s" % (shape,))
+        super().__init__(shape, data.dtype, data.context)
+        self._data = data
+        self._indices = indices
+        self._indptr = indptr
+
+    @property
+    def data(self) -> NDArray:
+        return self._data
+
+    @property
+    def indices(self) -> NDArray:
+        return self._indices
+
+    @property
+    def indptr(self) -> NDArray:
+        return self._indptr
+
+    def tostype(self, stype: str):
+        if stype == "csr":
+            return self
+        if stype == "default":
+            if self._data.shape[0] == 0:
+                return _dense_array(_np.zeros(self._shape, self._dtype),
+                                    ctx=self._ctx)
+            return from_jax(_csr_to_dense(self._data._jax,
+                                          self._indices._jax,
+                                          self._indptr._jax, self._shape),
+                            ctx=self._ctx)
+        raise ValueError("cannot cast csr to %r" % stype)
+
+    def astype(self, dtype):
+        return CSRNDArray(self._data.astype(dtype), self._indices,
+                          self._indptr, self._shape)
+
+    def copyto(self, other):
+        return self.tostype("default").copyto(other)
+
+    def __getitem__(self, i):
+        # row slice returns a dense row (parity convenience, eager)
+        return self.tostype("default")[i]
+
+
+# -- constructors -------------------------------------------------------------
+
+def _as_idx(x, ctx):
+    if isinstance(x, NDArray):
+        return x.astype(_np.int32) if x.dtype != _np.int32 else x
+    return _dense_array(_np.asarray(x, _np.int32), ctx=ctx)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """Create a RowSparseNDArray (reference: sparse.row_sparse_array).
+
+    ``arg1`` is either a (data, indices) pair or a dense array-like (in
+    which case zero rows are stripped — a host-side nnz discovery).
+    """
+    ctx = ctx or current_context()
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        if not isinstance(data, NDArray):
+            data = _dense_array(_np.asarray(data, dtype), ctx=ctx)
+        elif dtype is not None:
+            data = data.astype(dtype)
+        indices = _as_idx(indices, ctx)
+        if shape is None:
+            raise ValueError("shape is required with (data, indices)")
+        return RowSparseNDArray(data, indices, tuple(shape))
+    # dense input
+    dense = arg1.asnumpy() if isinstance(arg1, NDArray) else \
+        _np.asarray(arg1, dtype)
+    nz = _np.flatnonzero(dense.reshape(dense.shape[0], -1).any(axis=1))
+    return RowSparseNDArray(
+        _dense_array(dense[nz], ctx=ctx),
+        _dense_array(nz.astype(_np.int32), ctx=ctx),
+        tuple(shape or dense.shape))
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """Create a CSRNDArray from (data, indices, indptr) or dense
+    (reference: sparse.csr_matrix)."""
+    ctx = ctx or current_context()
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        if not isinstance(data, NDArray):
+            data = _dense_array(_np.asarray(data, dtype), ctx=ctx)
+        return CSRNDArray(data, _as_idx(indices, ctx), _as_idx(indptr, ctx),
+                          tuple(shape))
+    dense = arg1.asnumpy() if isinstance(arg1, NDArray) else \
+        _np.asarray(arg1, dtype)
+    if dense.ndim != 2:
+        raise ValueError("csr_matrix needs 2-D input")
+    rows, cols = _np.nonzero(dense)
+    data = dense[rows, cols]
+    indptr = _np.zeros(dense.shape[0] + 1, _np.int32)
+    _np.add.at(indptr, rows + 1, 1)
+    indptr = _np.cumsum(indptr).astype(_np.int32)
+    return CSRNDArray(_dense_array(data, ctx=ctx),
+                      _dense_array(cols.astype(_np.int32), ctx=ctx),
+                      _dense_array(indptr, ctx=ctx),
+                      tuple(shape or dense.shape))
+
+
+def zeros(stype: str, shape, ctx=None, dtype="float32"):
+    """All-zero sparse array (reference: sparse.zeros)."""
+    ctx = ctx or current_context()
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    dt = _np.dtype(dtype)
+    if stype == "row_sparse":
+        return RowSparseNDArray(
+            _dense_array(_np.zeros((0,) + shape[1:], dt), ctx=ctx),
+            _dense_array(_np.zeros((0,), _np.int32), ctx=ctx), shape)
+    if stype == "csr":
+        return CSRNDArray(
+            _dense_array(_np.zeros((0,), dt), ctx=ctx),
+            _dense_array(_np.zeros((0,), _np.int32), ctx=ctx),
+            _dense_array(_np.zeros(shape[0] + 1, _np.int32), ctx=ctx), shape)
+    if stype == "default":
+        from . import ndarray as _nd
+        return _nd.zeros(shape, ctx=ctx, dtype=dtype)
+    raise ValueError("unknown stype %r" % stype)
+
+
+empty = zeros
+
+
+def array(source, ctx=None, dtype=None):
+    """Sparse-aware array(): passes sparse inputs through, converts
+    scipy.sparse csr if available (reference: sparse.array)."""
+    if isinstance(source, BaseSparseNDArray):
+        return source
+    if hasattr(source, "tocsr"):  # scipy.sparse matrix without importing scipy
+        csr = source.tocsr()
+        return csr_matrix((csr.data, csr.indices, csr.indptr),
+                          shape=csr.shape, ctx=ctx, dtype=dtype)
+    return csr_matrix(source, ctx=ctx, dtype=dtype)
+
+
+# -- functional surface -------------------------------------------------------
+
+def cast_storage(arr, stype: str):
+    """Convert between storage types (reference: cast_storage op).
+
+    dense→sparse discovers nnz on the host (a sync point, as in the
+    reference); sparse→dense is a jitted scatter.
+    """
+    if isinstance(arr, BaseSparseNDArray):
+        if stype == "default":
+            return arr.tostype("default")
+        if stype == arr.stype:
+            return arr
+        return cast_storage(arr.tostype("default"), stype)
+    if stype == "default":
+        return arr
+    if stype == "row_sparse":
+        return row_sparse_array(arr, shape=arr.shape, ctx=arr.context)
+    if stype == "csr":
+        return csr_matrix(arr, shape=arr.shape, ctx=arr.context)
+    raise ValueError("unknown stype %r" % stype)
+
+
+def retain(rsp: RowSparseNDArray, row_ids) -> RowSparseNDArray:
+    """Keep only `row_ids` rows (reference: sparse.retain — the kvstore
+    row_sparse_pull building block)."""
+    if not isinstance(rsp, RowSparseNDArray):
+        raise TypeError("retain expects a RowSparseNDArray")
+    ids = _as_idx(row_ids, rsp.context)
+    if rsp._data.shape[0] == 0:
+        data = _dense_array(
+            _np.zeros((ids.shape[0],) + rsp.shape[1:], rsp.dtype),
+            ctx=rsp.context)
+        return RowSparseNDArray(data, ids, rsp.shape)
+    rows, _hit = _retain_rows(rsp._data._jax, rsp._indices._jax, ids._jax)
+    return RowSparseNDArray(from_jax(rows, ctx=rsp.context), ids, rsp.shape)
+
+
+def dot(lhs, rhs, transpose_a: bool = False, transpose_b: bool = False):
+    """Sparse dot (reference: src/operator/tensor/dot-inl.h).
+
+    Supported: dot(csr, dense), dot(csr.T, dense) — the fwd/bwd pair of
+    sparse-input linear layers.  Dense×dense falls through to nd.dot.
+    """
+    if transpose_b:
+        raise NotImplementedError("sparse dot with transpose_b")
+    if isinstance(lhs, CSRNDArray):
+        rhs_jax = rhs._jax if isinstance(rhs, NDArray) else jnp.asarray(rhs)
+        if transpose_a:
+            out = _csr_t_dot_dns(lhs._data._jax, lhs._indices._jax,
+                                 lhs._indptr._jax, rhs_jax, lhs.shape[1])
+        else:
+            out = _csr_dot_dns(lhs._data._jax, lhs._indices._jax,
+                               lhs._indptr._jax, rhs_jax, lhs.shape[0])
+        return from_jax(out, ctx=lhs.context)
+    if isinstance(lhs, RowSparseNDArray) or isinstance(rhs, BaseSparseNDArray):
+        raise NotImplementedError(
+            "sparse dot supports csr×dense; densify with tostype('default')")
+    from .ndarray import invoke
+    return invoke("dot", lhs, rhs, transpose_a=transpose_a,
+                  transpose_b=transpose_b)

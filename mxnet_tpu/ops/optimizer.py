@@ -202,3 +202,50 @@ def _lamb_phase2(weight, g_update, r1=None, r2=None, lr=0.01,
         r1 = jnp.minimum(r1, upper_bound)
     ratio = jnp.where((r1 > 0) & (r2 > 0), r1 / r2, 1.0)
     return weight - (lr * ratio * g_update).astype(weight.dtype)
+
+
+# -- rowsparse lazy updates ---------------------------------------------------
+# Reference: src/operator/optimizer_op.cc (SGDUpdateRspImpl, SGDMomUpdateRspImpl,
+# AdamUpdateRspImpl — "lazy update": only rows present in the gradient touch
+# weight/state; absent rows skip wd decay and momentum/moment decay too).
+# TPU-native: one jitted gather → elementwise chain → scatter; XLA fuses it.
+
+@register("_sparse_sgd_update", differentiable=False, mutates_input=0)
+def _sparse_sgd_update(weight, grad_data, grad_idx, lr=0.01, wd=0.0,
+                       rescale_grad=1.0, clip_gradient=-1.0):
+    rows = weight[grad_idx]
+    g = _prep(grad_data.astype(rows.dtype), rescale_grad, clip_gradient, wd,
+              rows)
+    return weight.at[grad_idx].set(rows - lr * g)
+
+
+@register("_sparse_sgd_mom_update", differentiable=False, num_outputs=2,
+          mutates_input=0, aux_writeback={1: 3})
+def _sparse_sgd_mom_update(weight, grad_data, grad_idx, mom, lr=0.01,
+                           momentum=0.0, wd=0.0, rescale_grad=1.0,
+                           clip_gradient=-1.0):
+    rows = weight[grad_idx]
+    mrows = mom[grad_idx]
+    g = _prep(grad_data.astype(mrows.dtype), rescale_grad, clip_gradient, wd,
+              rows)
+    new_m = momentum * mrows - lr * g
+    return (weight.at[grad_idx].set(rows + new_m.astype(weight.dtype)),
+            mom.at[grad_idx].set(new_m))
+
+
+@register("_sparse_adam_update", differentiable=False, num_outputs=3,
+          mutates_input=0, aux_writeback={1: 3, 2: 4})
+def _sparse_adam_update(weight, grad_data, grad_idx, mean, var, lr=0.001,
+                        beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
+                        rescale_grad=1.0, clip_gradient=-1.0):
+    rows = weight[grad_idx]
+    m = mean[grad_idx]
+    v = var[grad_idx]
+    g = _prep(grad_data.astype(rows.dtype), rescale_grad, clip_gradient, wd,
+              rows)
+    new_m = beta1 * m + (1.0 - beta1) * g
+    new_v = beta2 * v + (1.0 - beta2) * g * g
+    new_w = rows - lr * new_m / (jnp.sqrt(new_v) + epsilon)
+    return (weight.at[grad_idx].set(new_w),
+            mean.at[grad_idx].set(new_m),
+            var.at[grad_idx].set(new_v))

@@ -227,6 +227,17 @@ class SGD(Optimizer):
         wd = self._get_wd(index)
         kw = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
                   clip_gradient=_clip(self.clip_gradient))
+        if getattr(grad, "stype", "default") == "row_sparse":
+            if self.lazy_update:
+                # reference SGDUpdateRspImpl: only gradient rows are touched
+                if state is not None:
+                    invoke("_sparse_sgd_mom_update", weight, grad.data,
+                           grad.indices, state, momentum=self.momentum, **kw)
+                else:
+                    invoke("_sparse_sgd_update", weight, grad.data,
+                           grad.indices, **kw)
+                return
+            grad = grad.tostype("default")
         if state is not None:
             invoke("sgd_mom_update", weight, grad, state,
                    momentum=self.momentum, **kw)
@@ -286,6 +297,17 @@ class Adam(Optimizer):
         coef2 = 1.0 - self.beta2 ** t
         lr *= math.sqrt(coef2) / coef1
         mean, var = state
+        if getattr(grad, "stype", "default") == "row_sparse":
+            if self.lazy_update:
+                # reference AdamUpdateRspImpl: moments decay only on rows
+                # the batch touched
+                invoke("_sparse_adam_update", weight, grad.data, grad.indices,
+                       mean, var, lr=lr, wd=wd, beta1=self.beta1,
+                       beta2=self.beta2, epsilon=self.epsilon,
+                       rescale_grad=self.rescale_grad,
+                       clip_gradient=_clip(self.clip_gradient))
+                return
+            grad = grad.tostype("default")
         invoke("adam_update", weight, grad, mean, var, lr=lr, wd=wd,
                beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,
                rescale_grad=self.rescale_grad,

@@ -483,6 +483,9 @@ def _anchors():
 
 # Ops exercised by dedicated suites rather than the battery:
 TESTED_ELSEWHERE = {
+    "_sparse_sgd_update": "tests/test_sparse.py",
+    "_sparse_sgd_mom_update": "tests/test_sparse.py",
+    "_sparse_adam_update": "tests/test_sparse.py",
     "RNN": "tests/test_rnn.py",
     "CTCLoss": "tests/test_loss.py",
     "multi_head_attention": "tests/test_transformer.py",

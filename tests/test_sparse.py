@@ -1,0 +1,168 @@
+"""Sparse NDArray tests.
+
+Reference pattern: tests/python/unittest/test_sparse_ndarray.py /
+test_sparse_operator.py — creation/roundtrip, cast_storage both ways,
+retain, csr dot vs numpy, rowsparse lazy optimizer semantics (only touched
+rows move), Embedding sparse_grad end to end, kvstore row_sparse_pull.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, kvstore
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.ndarray import sparse
+
+
+def _rand_rsp(shape=(10, 4), nnz_rows=(1, 4, 7), dtype=np.float32):
+    dense = np.zeros(shape, dtype)
+    for r in nnz_rows:
+        dense[r] = np.random.randn(*shape[1:]).astype(dtype)
+    return dense
+
+
+def test_row_sparse_roundtrip():
+    dense = _rand_rsp()
+    rsp = sparse.row_sparse_array(dense, shape=dense.shape)
+    assert rsp.stype == "row_sparse"
+    assert list(rsp.indices.asnumpy()) == [1, 4, 7]
+    np.testing.assert_array_equal(rsp.tostype("default").asnumpy(), dense)
+    np.testing.assert_array_equal(rsp.asnumpy(), dense)
+
+
+def test_row_sparse_from_pair():
+    data = np.random.randn(2, 3).astype(np.float32)
+    rsp = sparse.row_sparse_array((data, [0, 5]), shape=(8, 3))
+    out = rsp.asnumpy()
+    np.testing.assert_array_equal(out[0], data[0])
+    np.testing.assert_array_equal(out[5], data[1])
+    assert np.abs(out[[1, 2, 3, 4, 6, 7]]).sum() == 0
+
+
+def test_csr_roundtrip_and_dot():
+    np.random.seed(0)
+    dense = np.random.randn(6, 5).astype(np.float32)
+    dense[np.random.rand(6, 5) > 0.4] = 0
+    csr = sparse.csr_matrix(dense)
+    np.testing.assert_allclose(csr.asnumpy(), dense, rtol=1e-6)
+    rhs = np.random.randn(5, 3).astype(np.float32)
+    out = sparse.dot(csr, mx.nd.array(rhs))
+    np.testing.assert_allclose(out.asnumpy(), dense @ rhs, rtol=1e-5)
+    outT = sparse.dot(csr, mx.nd.array(np.random.randn(6, 2).astype(np.float32)
+                                       ), transpose_a=True)
+    assert outT.shape == (5, 2)
+
+
+def test_csr_T_dot_matches_numpy():
+    np.random.seed(1)
+    dense = np.random.randn(4, 7).astype(np.float32)
+    dense[np.random.rand(4, 7) > 0.5] = 0
+    rhs = np.random.randn(4, 3).astype(np.float32)
+    csr = sparse.csr_matrix(dense)
+    out = sparse.dot(csr, mx.nd.array(rhs), transpose_a=True)
+    np.testing.assert_allclose(out.asnumpy(), dense.T @ rhs, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_cast_storage_both_ways():
+    dense = _rand_rsp()
+    nd_dense = mx.nd.array(dense)
+    rsp = nd_dense.tostype("row_sparse")
+    assert rsp.stype == "row_sparse"
+    back = rsp.tostype("default")
+    np.testing.assert_array_equal(back.asnumpy(), dense)
+    csr = mx.nd.array(dense).tostype("csr")
+    assert csr.stype == "csr"
+    np.testing.assert_array_equal(csr.asnumpy(), dense)
+
+
+def test_retain():
+    dense = _rand_rsp(nnz_rows=(1, 4, 7))
+    rsp = sparse.row_sparse_array(dense, shape=dense.shape)
+    kept = sparse.retain(rsp, [1, 3, 7])
+    out = kept.asnumpy()
+    np.testing.assert_array_equal(out[1], dense[1])
+    np.testing.assert_array_equal(out[7], dense[7])
+    assert np.abs(out[3]).sum() == 0  # requested but absent -> zero
+    assert np.abs(out[4]).sum() == 0  # present but not requested -> dropped
+
+
+def test_sparse_zeros():
+    z = sparse.zeros("row_sparse", (5, 3))
+    assert z.asnumpy().sum() == 0
+    zc = sparse.zeros("csr", (4, 4))
+    assert zc.asnumpy().sum() == 0
+
+
+def test_lazy_sgd_only_touches_grad_rows():
+    np.random.seed(2)
+    w0 = np.random.randn(10, 4).astype(np.float32)
+    weight = mx.nd.array(w0)
+    gdense = _rand_rsp(nnz_rows=(2, 5))
+    grad = sparse.row_sparse_array(gdense, shape=gdense.shape)
+    opt = mx.optimizer.SGD(learning_rate=0.5, momentum=0.9, wd=0.1)
+    state = opt.create_state(0, weight)
+    opt.update(0, weight, grad, state)
+    w1 = weight.asnumpy()
+    untouched = [r for r in range(10) if r not in (2, 5)]
+    # untouched rows identical — wd did NOT decay them (lazy semantics)
+    np.testing.assert_array_equal(w1[untouched], w0[untouched])
+    for r in (2, 5):
+        expect = w0[r] - 0.5 * (gdense[r] + 0.1 * w0[r])
+        np.testing.assert_allclose(w1[r], expect, rtol=1e-5)
+    # momentum state only populated on touched rows
+    mom = state.asnumpy()
+    assert np.abs(mom[untouched]).sum() == 0
+    assert np.abs(mom[[2, 5]]).sum() > 0
+
+
+def test_lazy_adam_only_touches_grad_rows():
+    np.random.seed(3)
+    w0 = np.random.randn(8, 3).astype(np.float32)
+    weight = mx.nd.array(w0)
+    gdense = _rand_rsp(shape=(8, 3), nnz_rows=(0, 6))
+    grad = sparse.row_sparse_array(gdense, shape=gdense.shape)
+    opt = mx.optimizer.Adam(learning_rate=0.1)
+    state = opt.create_state(0, weight)
+    opt.update(0, weight, grad, state)
+    w1 = weight.asnumpy()
+    untouched = [r for r in range(8) if r not in (0, 6)]
+    np.testing.assert_array_equal(w1[untouched], w0[untouched])
+    assert not np.allclose(w1[[0, 6]], w0[[0, 6]])
+
+
+def test_embedding_sparse_grad_training():
+    np.random.seed(4)
+    mx.random.seed(4)
+    emb = nn.Embedding(20, 6, sparse_grad=True)
+    emb.initialize()
+    trainer = gluon.Trainer(emb.collect_params(), "sgd",
+                            {"learning_rate": 1.0, "wd": 0.01})
+    w0 = emb.weight.data().asnumpy().copy()
+    ids = mx.nd.array(np.array([3, 7, 7, 11]), dtype="int32")
+    with autograd.record():
+        out = emb(ids)
+        loss = (out * out).mean()
+    loss.backward()
+    trainer.step(4)
+    w1 = emb.weight.data().asnumpy()
+    touched = [3, 7, 11]
+    untouched = [r for r in range(20) if r not in touched]
+    np.testing.assert_array_equal(w1[untouched], w0[untouched])
+    assert not np.allclose(w1[touched], w0[touched])
+
+
+def test_kvstore_row_sparse_pull():
+    kv = kvstore.create("local")
+    val = mx.nd.array(np.arange(20, dtype=np.float32).reshape(5, 4))
+    kv.init("emb", val)
+    ids = mx.nd.array(np.array([0, 3]), dtype="int32")
+    out = sparse.zeros("row_sparse", (5, 4))
+    kv.row_sparse_pull("emb", out=out, row_ids=ids)
+    np.testing.assert_array_equal(out.indices.asnumpy(), [0, 3])
+    np.testing.assert_array_equal(out.data.asnumpy(), val.asnumpy()[[0, 3]])
+    dense = out.asnumpy()
+    assert np.abs(dense[[1, 2, 4]]).sum() == 0
+    # return form (no out)
+    res = kv.row_sparse_pull("emb", row_ids=ids)
+    np.testing.assert_array_equal(res[0].data.asnumpy(), val.asnumpy()[[0, 3]])
