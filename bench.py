@@ -102,6 +102,49 @@ def run_bench():
     }))
 
 
+def run_real_data_bench():
+    """--real-data: prove the input pipeline (.rec → JPEG decode → augment →
+    NCHW batch) sustains the compute rate (SURVEY hard part 7: ~3k img/s
+    decode behind a saturated MXU).  Builds a synthetic ImageNet-shaped
+    .rec pack, then measures ImageRecordIter throughput standalone."""
+    import tempfile
+    import numpy as np
+    from mxnet_tpu import recordio
+    from mxnet_tpu.io import ImageRecordIter
+
+    n_img, edge, batch = 512, 256, 64
+    d = tempfile.mkdtemp(prefix="mxbench_rec_")
+    prefix = os.path.join(d, "synth")
+    rng = np.random.RandomState(0)
+    w = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    # JPEG-realistic content: smooth low-freq fields, not raw noise
+    base = rng.rand(8, edge, edge, 3)
+    for i in range(n_img):
+        img = (base[i % 8] * (120 + (i % 100)) % 255).astype(np.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 1000), i, 0), img, quality=90))
+    w.close()
+
+    it = ImageRecordIter(path_imgrec=prefix + ".rec",
+                         data_shape=(3, 224, 224), batch_size=batch,
+                         shuffle=True, rand_crop=True, rand_mirror=True,
+                         preprocess_threads=os.cpu_count() or 8)
+    for _ in range(2):  # warm the pool
+        next(it)
+    it.reset()
+    t0 = time.perf_counter()
+    n = 0
+    for b in it:
+        n += b.data[0].shape[0]
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "image_record_iter_images_per_sec",
+        "value": round(n / dt, 2), "unit": "images/sec",
+        "vs_baseline": round(n / dt / 3000.0, 4),  # ref decode target
+        "threads": os.cpu_count() or 8, "batch": batch,
+    }))
+
+
 def _run_child(platform):
     """Run the benchmark pinned to `platform`; return (rc, stdout)."""
     env = dict(os.environ, MX_BENCH_CHILD="1", MX_BENCH_PLATFORM=platform)
@@ -122,6 +165,9 @@ def _run_child(platform):
 
 
 def main():
+    if "--real-data" in sys.argv:
+        run_real_data_bench()
+        return
     if os.environ.get("MX_BENCH_CHILD"):
         run_bench()
         return

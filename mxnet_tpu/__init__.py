@@ -22,6 +22,9 @@ from .ndarray import NDArray, waitall
 
 from . import amp
 from . import profiler
+from . import recordio
+from . import io
+from . import image
 from . import symbol
 from . import symbol as sym
 from . import contrib

@@ -1,0 +1,42 @@
+"""Native (C++) runtime components, built on demand.
+
+The reference ships its runtime core (recordio, iterators, allocator) as
+C++ in libmxnet.so; here the native pieces live in ``src/*.cc`` at the repo
+root and are compiled lazily into this package directory with the system
+toolchain (g++ — no pybind11 in this image, so the ABI is plain ``extern
+"C"`` consumed via ctypes).
+
+``load(name)`` returns the ctypes CDLL for ``src/<name>.cc``, compiling it
+if the cached .so is missing or older than the source.  Raises OSError if
+no compiler is available — callers fall back to their pure-Python path.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(os.path.dirname(os.path.dirname(_DIR)), "src")
+_lock = threading.Lock()
+_cache = {}
+
+
+def _build(name: str) -> str:
+    src = os.path.join(_SRC, name + ".cc")
+    out = os.path.join(_DIR, "lib%s.so" % name)
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", src, "-o", out]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise OSError("native build failed for %s:\n%s" % (name, proc.stderr))
+    return out
+
+
+def load(name: str) -> ctypes.CDLL:
+    with _lock:
+        if name not in _cache:
+            _cache[name] = ctypes.CDLL(_build(name))
+        return _cache[name]

@@ -119,23 +119,25 @@ class KVStore:
         NDArray: full pull fallback) or is returned."""
         if row_ids is None:
             return self.pull(key, out, priority)
-        from ..ndarray.sparse import RowSparseNDArray
+        from ..ndarray.sparse import RowSparseNDArray, _as_idx
         keys, outs = self._normalize(key, out)
-        ids_per_key = row_ids if isinstance(row_ids, (list, tuple)) else \
-            [row_ids] * len(keys)
+        # row_ids forms: one ids array (NDArray/numpy/list of ints) shared by
+        # every key, or a list of such matching the key list
+        is_per_key = isinstance(row_ids, (list, tuple)) and len(row_ids) and \
+            not isinstance(row_ids[0], (int, _np.integer))
+        ids_per_key = list(row_ids) if is_per_key else [row_ids] * len(keys)
         results = []
         for k, o, ids in zip(keys, outs, ids_per_key):
             stored = self._store[k]
             targets = o if isinstance(o, (list, tuple)) else [o]
-            per_target = ids if isinstance(ids, (list, tuple)) else \
+            per_target = ids if isinstance(ids, (list, tuple)) and len(ids) \
+                and not isinstance(ids[0], (int, _np.integer)) else \
                 [ids] * len(targets)
             for t, tid in zip(targets, per_target):
-                if tid.dtype not in (_np.int32, _np.int64):
-                    tid = tid.astype(_np.int32)
+                tid = _as_idx(tid, stored.context)
                 rows = nd.invoke("take", stored, tid, axis=0)
                 if isinstance(t, RowSparseNDArray):
-                    t._data = rows
-                    t._indices = tid
+                    t._assign(rows, tid)
                 elif isinstance(t, NDArray):
                     stored.copyto(t)  # dense target: full pull
                 else:

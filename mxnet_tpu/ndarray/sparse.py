@@ -70,6 +70,11 @@ def _csr_t_dot_dns(data, indices, indptr, rhs, n_cols):
 
 
 @jax.jit
+def _row_mask(x):
+    return jnp.any(x.reshape(x.shape[0], -1) != 0, axis=1)
+
+
+@jax.jit
 def _retain_rows(data, indices, keep_ids):
     """Gather the kept subset: rows of `indices` present in `keep_ids`
     survive; absent keep_ids yield zero rows (reference retain semantics:
@@ -164,6 +169,18 @@ class RowSparseNDArray(BaseSparseNDArray):
     @property
     def indices(self) -> NDArray:
         return self._indices
+
+    def _assign(self, data: NDArray, indices: NDArray):
+        """Replace contents in place, keeping class invariants (length
+        match, declared dtype) — the mutation point kvstore uses."""
+        if data.shape[0] != indices.shape[0]:
+            raise ValueError("data rows (%d) != indices (%d)"
+                             % (data.shape[0], indices.shape[0]))
+        if data.dtype != self._dtype:
+            data = data.astype(self._dtype)
+        self._data = data
+        self._indices = indices
+        self._ctx = data.context
 
     def tostype(self, stype: str):
         if stype == "row_sparse":
@@ -277,10 +294,25 @@ def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
         indices = _as_idx(indices, ctx)
         if shape is None:
             raise ValueError("shape is required with (data, indices)")
+        # class invariant: indices sorted ascending (retain/kvstore
+        # searchsorted relies on it) — sort on device if needed
+        idx_np = indices.asnumpy()
+        if idx_np.size and _np.any(idx_np[1:] < idx_np[:-1]):
+            order = jnp.argsort(indices._jax)
+            indices = from_jax(indices._jax[order], ctx=ctx)
+            data = from_jax(data._jax[order], ctx=ctx)
         return RowSparseNDArray(data, indices, tuple(shape))
-    # dense input
-    dense = arg1.asnumpy() if isinstance(arg1, NDArray) else \
-        _np.asarray(arg1, dtype)
+    # dense input — nnz discovery syncs only a (rows,) bool mask to host;
+    # the row gather stays on device (review finding: a full asnumpy() of
+    # an embedding-sized gradient would negate the lazy-update payoff)
+    if isinstance(arg1, NDArray):
+        mask = _np.asarray(_row_mask(arg1._jax))
+        nz = _np.flatnonzero(mask).astype(_np.int32)
+        rows = arg1._jax[jnp.asarray(nz)]
+        return RowSparseNDArray(from_jax(rows, ctx=arg1.context),
+                                _dense_array(nz, ctx=arg1.context),
+                                tuple(shape or arg1.shape))
+    dense = _np.asarray(arg1, dtype)
     nz = _np.flatnonzero(dense.reshape(dense.shape[0], -1).any(axis=1))
     return RowSparseNDArray(
         _dense_array(dense[nz], ctx=ctx),
@@ -296,8 +328,12 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
         data, indices, indptr = arg1
         if not isinstance(data, NDArray):
             data = _dense_array(_np.asarray(data, dtype), ctx=ctx)
-        return CSRNDArray(data, _as_idx(indices, ctx), _as_idx(indptr, ctx),
-                          tuple(shape))
+        indices = _as_idx(indices, ctx)
+        indptr = _as_idx(indptr, ctx)
+        if shape is None:  # infer like the reference: rows from indptr,
+            cols = int(indices.asnumpy().max()) + 1 if len(indices) else 0
+            shape = (len(indptr) - 1, cols)
+        return CSRNDArray(data, indices, indptr, tuple(shape))
     dense = arg1.asnumpy() if isinstance(arg1, NDArray) else \
         _np.asarray(arg1, dtype)
     if dense.ndim != 2:

@@ -1,0 +1,206 @@
+// Native RecordIO core.
+//
+// Reference behavior: 3rdparty/dmlc-core/include/dmlc/recordio.h
+// (RecordIOWriter/RecordIOReader) and src/recordio.cc — MXNet's on-disk
+// .rec container: every record is
+//
+//   uint32 kMagic = 0xced7230a
+//   uint32 lrec   = (cflag << 29) | length          (cflag: 0=whole,
+//                   1=first chunk, 2=middle, 3=last — multi-chunk records
+//                   appear when payloads embed the magic)
+//   byte   data[length], zero-padded to a 4-byte boundary
+//
+// This implementation is byte-compatible with files produced by the
+// reference's im2rec (same magic, same lrec encoding, same padding) and is
+// exposed to Python through a minimal C ABI (ctypes — no pybind11 in this
+// image).  The reader hands out a pointer into an internally managed
+// buffer, valid until the next call on the same handle; the writer returns
+// the byte offset of each record so the .idx sidecar can be built the way
+// MXIndexedRecordIO expects.
+//
+// TPU relevance: file parsing is pure host-side runtime — the one place
+// where native code pays off is keeping the input pipeline off the Python
+// interpreter's critical path while the chip is busy (SURVEY.md hard part:
+// sustaining the JPEG/decode rate behind a saturated MXU).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+
+inline uint32_t EncodeLRec(uint32_t cflag, uint32_t length) {
+  return (cflag << 29u) | (length & ((1u << 29u) - 1u));
+}
+inline uint32_t DecodeFlag(uint32_t rec) { return (rec >> 29u) & 7u; }
+inline uint32_t DecodeLength(uint32_t rec) { return rec & ((1u << 29u) - 1u); }
+
+struct Writer {
+  FILE* fp = nullptr;
+};
+
+struct Reader {
+  FILE* fp = nullptr;
+  std::vector<char> buf;    // assembled record payload
+  std::vector<char> chunk;  // scratch for one chunk
+};
+
+// Find the next occurrence of the magic pattern in [begin, end).
+const char* FindMagic(const char* begin, const char* end) {
+  uint32_t magic = kMagic;
+  const char* pat = reinterpret_cast<const char*>(&magic);
+  if (end - begin < 4) return nullptr;
+  for (const char* p = begin; p + 4 <= end; ++p) {
+    if (memcmp(p, pat, 4) == 0) return p;
+  }
+  return nullptr;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---- writer ---------------------------------------------------------------
+
+void* MXRecordIOWriterCreate(const char* path) {
+  FILE* fp = fopen(path, "wb");
+  if (!fp) return nullptr;
+  Writer* w = new Writer();
+  w->fp = fp;
+  return w;
+}
+
+// Append one record; returns the byte offset of its header (for .idx),
+// or -1 on error.  Splits the payload on embedded magic patterns into
+// chunks exactly like dmlc::RecordIOWriter::WriteRecord, so readers that
+// resynchronize on magic can recover.
+int64_t MXRecordIOWriterWrite(void* handle, const char* data, uint64_t len) {
+  Writer* w = static_cast<Writer*>(handle);
+  if (!w || !w->fp) return -1;
+  int64_t pos = static_cast<int64_t>(ftell(w->fp));
+
+  // collect chunk boundaries at embedded magics
+  std::vector<std::pair<const char*, uint64_t>> chunks;
+  const char* p = data;
+  const char* end = data + len;
+  while (true) {
+    const char* hit = len ? FindMagic(p, end) : nullptr;
+    if (hit == nullptr) {
+      chunks.emplace_back(p, static_cast<uint64_t>(end - p));
+      break;
+    }
+    chunks.emplace_back(p, static_cast<uint64_t>(hit - p));
+    p = hit + 4;  // the magic bytes themselves are elided; flag says "join"
+  }
+
+  uint32_t magic = kMagic;
+  for (size_t i = 0; i < chunks.size(); ++i) {
+    uint32_t cflag;
+    if (chunks.size() == 1) {
+      cflag = 0;
+    } else if (i == 0) {
+      cflag = 1;
+    } else if (i + 1 == chunks.size()) {
+      cflag = 3;
+    } else {
+      cflag = 2;
+    }
+    uint32_t clen = static_cast<uint32_t>(chunks[i].second);
+    uint32_t lrec = EncodeLRec(cflag, clen);
+    if (fwrite(&magic, 4, 1, w->fp) != 1) return -1;
+    if (fwrite(&lrec, 4, 1, w->fp) != 1) return -1;
+    if (clen && fwrite(chunks[i].first, 1, clen, w->fp) != clen) return -1;
+    uint32_t pad = (4 - (clen & 3u)) & 3u;
+    uint32_t zero = 0;
+    if (pad && fwrite(&zero, 1, pad, w->fp) != pad) return -1;
+  }
+  return pos;
+}
+
+int64_t MXRecordIOWriterTell(void* handle) {
+  Writer* w = static_cast<Writer*>(handle);
+  return w && w->fp ? static_cast<int64_t>(ftell(w->fp)) : -1;
+}
+
+void MXRecordIOWriterClose(void* handle) {
+  Writer* w = static_cast<Writer*>(handle);
+  if (w) {
+    if (w->fp) fclose(w->fp);
+    delete w;
+  }
+}
+
+// ---- reader ---------------------------------------------------------------
+
+void* MXRecordIOReaderCreate(const char* path) {
+  FILE* fp = fopen(path, "rb");
+  if (!fp) return nullptr;
+  Reader* r = new Reader();
+  r->fp = fp;
+  return r;
+}
+
+// Read the next logical record (reassembling multi-chunk ones).
+// Returns 0 on success (out_data/out_len set, pointer valid until the next
+// call), 1 on clean EOF, -1 on corruption/IO error.
+int MXRecordIOReaderNext(void* handle, const char** out_data,
+                         uint64_t* out_len) {
+  Reader* r = static_cast<Reader*>(handle);
+  if (!r || !r->fp) return -1;
+  r->buf.clear();
+  bool in_multi = false;
+  while (true) {
+    uint32_t magic = 0, lrec = 0;
+    size_t got = fread(&magic, 1, 4, r->fp);
+    if (got == 0 && !in_multi) return 1;  // clean EOF
+    if (got != 4 || magic != kMagic) return -1;
+    if (fread(&lrec, 4, 1, r->fp) != 1) return -1;
+    uint32_t cflag = DecodeFlag(lrec);
+    uint32_t clen = DecodeLength(lrec);
+    size_t base = r->buf.size();
+    if (in_multi) {
+      // chunks were split at an elided magic: restore it
+      uint32_t m = kMagic;
+      r->buf.insert(r->buf.end(), reinterpret_cast<char*>(&m),
+                    reinterpret_cast<char*>(&m) + 4);
+      base = r->buf.size();
+    }
+    r->buf.resize(base + clen);
+    if (clen && fread(r->buf.data() + base, 1, clen, r->fp) != clen)
+      return -1;
+    uint32_t pad = (4 - (clen & 3u)) & 3u;
+    if (pad) {
+      char dump[4];
+      if (fread(dump, 1, pad, r->fp) != pad) return -1;
+    }
+    if (cflag == 0 || cflag == 3) break;  // whole record or last chunk
+    in_multi = true;
+  }
+  *out_data = r->buf.data();
+  *out_len = r->buf.size();
+  return 0;
+}
+
+void MXRecordIOReaderSeek(void* handle, int64_t pos) {
+  Reader* r = static_cast<Reader*>(handle);
+  if (r && r->fp) fseek(r->fp, static_cast<long>(pos), SEEK_SET);
+}
+
+int64_t MXRecordIOReaderTell(void* handle) {
+  Reader* r = static_cast<Reader*>(handle);
+  return r && r->fp ? static_cast<int64_t>(ftell(r->fp)) : -1;
+}
+
+void MXRecordIOReaderClose(void* handle) {
+  Reader* r = static_cast<Reader*>(handle);
+  if (r) {
+    if (r->fp) fclose(r->fp);
+    delete r;
+  }
+}
+
+}  // extern "C"

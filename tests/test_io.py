@@ -1,0 +1,274 @@
+"""Data-IO tests: recordio container, mx.io iterators, mx.image, im2rec.
+
+Reference pattern: tests/python/unittest/test_recordio.py, test_io.py,
+test_image.py — format roundtrips, iterator epoch semantics (shuffle/pad/
+discard), ImageRecordIter over an im2rec-built pack.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio, image, io as mio
+from mxnet_tpu.gluon.data import RecordFileDataset
+from mxnet_tpu.gluon.data.vision import ImageRecordDataset
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- recordio -----------------------------------------------------------------
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "a.rec")
+    w = recordio.MXRecordIO(path, "w")
+    payloads = [b"x", b"hello world", b"", b"z" * 4097]
+    for p in payloads:
+        w.write(p)
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    got = []
+    while True:
+        x = r.read()
+        if x is None:
+            break
+        got.append(x)
+    assert got == payloads
+    r.reset()
+    assert r.read() == payloads[0]
+    r.close()
+
+
+def test_recordio_embedded_magic(tmp_path):
+    """Payloads containing the magic pattern must roundtrip (multi-chunk)."""
+    path = str(tmp_path / "m.rec")
+    magic = (0xced7230a).to_bytes(4, "little")
+    payloads = [magic, b"ab" + magic + b"cd", magic + magic, b"tail" + magic]
+    w = recordio.MXRecordIO(path, "w")
+    for p in payloads:
+        w.write(p)
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    for p in payloads:
+        assert r.read() == p
+    assert r.read() is None
+
+
+def test_indexed_recordio(tmp_path):
+    path = str(tmp_path / "b.rec")
+    idxp = str(tmp_path / "b.idx")
+    w = recordio.MXIndexedRecordIO(idxp, path, "w")
+    for i in range(10):
+        w.write_idx(i, b"rec%03d" % i)
+    w.close()
+    assert os.path.isfile(idxp)
+    r = recordio.MXIndexedRecordIO(idxp, path, "r")
+    assert r.keys == list(range(10))
+    for i in (7, 0, 9, 3):
+        assert r.read_idx(i) == b"rec%03d" % i
+
+
+def test_native_and_python_writers_interop(tmp_path):
+    """The ctypes-C++ and pure-Python paths produce identical bytes."""
+    if recordio._get_lib() is None:
+        pytest.skip("native lib unavailable")
+    pn = str(tmp_path / "n.rec")
+    pp = str(tmp_path / "p.rec")
+    payloads = [b"abc", b"x" * 33, (0xced7230a).to_bytes(4, "little") * 2]
+    w = recordio.MXRecordIO(pn, "w")
+    for x in payloads:
+        w.write(x)
+    w.close()
+    wp = recordio.MXRecordIO(pp, "w")
+    wp._handle = None  # force python fallback path
+    wp._pyfile = open(pp, "wb")
+    for x in payloads:
+        wp.write(x)
+    wp._pyfile.close()
+    wp.is_open = False
+    with open(pn, "rb") as f1, open(pp, "rb") as f2:
+        assert f1.read() == f2.read()
+
+
+def test_pack_unpack_img():
+    img = (np.random.rand(24, 16, 3) * 255).astype(np.uint8)
+    s = recordio.pack_img(recordio.IRHeader(0, 2.0, 5, 0), img,
+                          img_fmt=".png")
+    header, out = recordio.unpack_img(s)
+    assert header.label == 2.0 and header.id == 5
+    np.testing.assert_array_equal(out, img)
+    # jpeg is lossy but close on smooth content
+    grad = np.tile(np.arange(16, dtype=np.uint8)[None, :, None] * 8,
+                   (24, 1, 3))
+    s = recordio.pack_img(recordio.IRHeader(0, 1.0, 0, 0), grad, quality=95)
+    _h, outj = recordio.unpack_img(s)
+    assert outj.shape == grad.shape
+    assert np.abs(outj.astype(int) - grad.astype(int)).mean() < 4
+
+
+# -- mx.io --------------------------------------------------------------------
+
+def test_ndarray_iter_basic():
+    X = np.arange(40, dtype=np.float32).reshape(10, 4)
+    Y = np.arange(10, dtype=np.float32)
+    it = mio.NDArrayIter(X, Y, batch_size=3, last_batch_handle="pad")
+    descs = it.provide_data
+    assert descs[0].name == "data" and descs[0].shape == (3, 4)
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[-1].pad == 2
+    # pad wraps to head samples
+    np.testing.assert_array_equal(batches[-1].data[0].asnumpy()[1:],
+                                  X[[0, 1]])
+    it.reset()
+    assert len(list(it)) == 4
+
+
+def test_ndarray_iter_discard_and_shuffle():
+    X = np.arange(10, dtype=np.float32).reshape(10, 1)
+    it = mio.NDArrayIter(X, batch_size=4, shuffle=True,
+                         last_batch_handle="discard")
+    seen = np.concatenate([b.data[0].asnumpy().ravel() for b in it])
+    assert len(seen) == 8 and len(np.unique(seen)) == 8
+    it.reset()
+    seen2 = np.concatenate([b.data[0].asnumpy().ravel() for b in it])
+    assert len(seen2) == 8
+
+
+def test_ndarray_iter_dict_inputs():
+    it = mio.NDArrayIter({"a": np.zeros((4, 2)), "b": np.ones((4, 3))},
+                         batch_size=2)
+    names = [d.name for d in it.provide_data]
+    assert names == ["a", "b"]
+    b = next(it)
+    assert b.data[0].shape == (2, 2) and b.data[1].shape == (2, 3)
+
+
+def test_resize_and_prefetch_iter():
+    X = np.arange(12, dtype=np.float32).reshape(6, 2)
+    base = mio.NDArrayIter(X, batch_size=2)
+    rs = mio.ResizeIter(base, size=5)  # longer than one epoch: rewinds
+    assert len(list(rs)) == 5
+    base.reset()
+    pf = mio.PrefetchingIter(mio.NDArrayIter(X, batch_size=2))
+    batches = list(pf)
+    assert len(batches) == 3
+    np.testing.assert_array_equal(batches[0].data[0].asnumpy(), X[:2])
+
+
+def test_csv_iter(tmp_path):
+    data = np.random.rand(7, 3).astype(np.float32)
+    labels = np.arange(7, dtype=np.float32)
+    dcsv = str(tmp_path / "d.csv")
+    lcsv = str(tmp_path / "l.csv")
+    np.savetxt(dcsv, data, delimiter=",")
+    np.savetxt(lcsv, labels, delimiter=",")
+    it = mio.CSVIter(data_csv=dcsv, data_shape=(3,), label_csv=lcsv,
+                     batch_size=2)
+    b = next(it)
+    np.testing.assert_allclose(b.data[0].asnumpy(), data[:2], rtol=1e-6)
+
+
+# -- mx.image -----------------------------------------------------------------
+
+def test_image_decode_resize_crop():
+    img = (np.random.rand(40, 30, 3) * 255).astype(np.uint8)
+    s = recordio.pack_img(recordio.IRHeader(0, 0.0, 0, 0), img,
+                          img_fmt=".png")
+    _h, payload = recordio.unpack(s)
+    dec = image.imdecode(payload)
+    assert dec.shape == (40, 30, 3)
+    np.testing.assert_array_equal(dec.asnumpy(), img)
+    r = image.imresize(dec, 15, 20)
+    assert r.shape == (20, 15, 3)
+    rs = image.resize_short(dec, 16)
+    assert min(rs.shape[:2]) == 16
+    c, rect = image.center_crop(dec, (8, 8))
+    assert c.shape == (8, 8, 3) and rect[2:] == (8, 8)
+    rc, _ = image.random_crop(dec, (8, 8))
+    assert rc.shape == (8, 8, 3)
+    n = image.color_normalize(dec, mean=np.array([1.0, 2.0, 3.0]),
+                              std=np.array([2.0, 2.0, 2.0]))
+    assert str(n.dtype) == "float32"
+
+
+def test_augmenter_chain():
+    augs = image.CreateAugmenter(data_shape=(3, 12, 12), resize=16,
+                                 rand_crop=True, rand_mirror=True,
+                                 mean=True, std=True)
+    img = mx.nd.array((np.random.rand(40, 30, 3) * 255).astype(np.uint8))
+    out = img
+    for a in augs:
+        out = a(out)
+    assert out.shape == (12, 12, 3)
+    assert str(out.dtype) == "float32"
+
+
+def _build_pack(tmp_path, n=12, classes=3):
+    """im2rec over a generated image folder, via the CLI."""
+    from PIL import Image
+    root = tmp_path / "imgs"
+    for c in range(classes):
+        d = root / ("class%d" % c)
+        d.mkdir(parents=True)
+        for i in range(n // classes):
+            arr = np.full((32, 32, 3), 40 * c + i, np.uint8)
+            Image.fromarray(arr).save(d / ("img%d.jpg" % i))
+    prefix = str(tmp_path / "pack")
+    subprocess.run([sys.executable,
+                    os.path.join(REPO, "tools", "im2rec.py"),
+                    prefix, str(root)], check=True, capture_output=True)
+    return prefix
+
+
+def test_im2rec_and_image_record_iter(tmp_path):
+    prefix = _build_pack(tmp_path)
+    assert os.path.isfile(prefix + ".rec") and os.path.isfile(prefix + ".idx")
+    it = mio.ImageRecordIter(path_imgrec=prefix + ".rec",
+                             data_shape=(3, 28, 28), batch_size=4,
+                             shuffle=True, preprocess_threads=2)
+    labels = []
+    nb = 0
+    for batch in it:
+        assert batch.data[0].shape == (4, 3, 28, 28)
+        labels.extend(batch.label[0].asnumpy().tolist())
+        nb += 1
+    assert nb == 3
+    assert set(labels) == {0.0, 1.0, 2.0}
+    it.reset()
+    assert sum(1 for _ in it) == 3
+
+
+def test_record_file_and_image_record_dataset(tmp_path):
+    prefix = _build_pack(tmp_path)
+    ds = RecordFileDataset(prefix + ".rec")
+    assert len(ds) == 12
+    header, img = recordio.unpack_img(ds[0])
+    assert img.shape == (32, 32, 3)
+    ids = ImageRecordDataset(prefix + ".rec")
+    img, label = ids[5]
+    assert img.shape == (32, 32, 3)
+    assert isinstance(label, float)
+    # DataLoader over the dataset matches direct reads
+    from mxnet_tpu.gluon.data import DataLoader
+    loader = DataLoader(ids.transform_first(
+        lambda im: im.astype(np.float32).transpose(2, 0, 1)),
+        batch_size=6)
+    batch, lab = next(iter(loader))
+    assert batch.shape == (6, 3, 32, 32)
+
+
+def test_image_record_iter_sharding(tmp_path):
+    prefix = _build_pack(tmp_path)
+    parts = []
+    for pi in range(2):
+        it = mio.ImageRecordIter(path_imgrec=prefix + ".rec",
+                                 data_shape=(3, 32, 32), batch_size=2,
+                                 num_parts=2, part_index=pi)
+        ids = []
+        for b in it:
+            ids.extend(b.label[0].asnumpy().tolist())
+        parts.append(len(ids))
+    assert sum(parts) == 12  # disjoint shards cover the set

@@ -166,3 +166,49 @@ def test_kvstore_row_sparse_pull():
     # return form (no out)
     res = kv.row_sparse_pull("emb", row_ids=ids)
     np.testing.assert_array_equal(res[0].data.asnumpy(), val.asnumpy()[[0, 3]])
+
+
+# -- review-finding regressions ----------------------------------------------
+
+def test_unsorted_pair_construction_sorts():
+    data = np.array([[5., 5.], [1., 1.]], np.float32)
+    rsp = sparse.row_sparse_array((data, [5, 0]), shape=(8, 2))
+    np.testing.assert_array_equal(rsp.indices.asnumpy(), [0, 5])
+    kept = sparse.retain(rsp, [0, 5])
+    np.testing.assert_array_equal(kept.asnumpy()[0], [1., 1.])
+    np.testing.assert_array_equal(kept.asnumpy()[5], [5., 5.])
+
+
+def test_csr_shape_inference():
+    csr = sparse.csr_matrix((np.ones(2, np.float32), [0, 1], [0, 1, 2]))
+    assert csr.shape == (2, 2)
+    np.testing.assert_array_equal(csr.asnumpy(), np.eye(2, dtype=np.float32))
+
+
+def test_row_sparse_pull_numpy_and_list_ids():
+    kv = kvstore.create("local")
+    val = mx.nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    kv.init("w", val)
+    out = sparse.zeros("row_sparse", (4, 3))
+    kv.row_sparse_pull("w", out=out, row_ids=np.array([0, 2]))
+    np.testing.assert_array_equal(out.data.asnumpy(), val.asnumpy()[[0, 2]])
+    out.asnumpy()  # must not crash: indices are real NDArrays
+    kv.row_sparse_pull("w", out=out, row_ids=[1, 3])
+    np.testing.assert_array_equal(out.indices.asnumpy(), [1, 3])
+
+
+def test_row_sparse_pull_keeps_declared_dtype():
+    kv = kvstore.create("local")
+    kv.init("w", mx.nd.array(np.arange(8, dtype=np.float32).reshape(4, 2)))
+    out = sparse.zeros("row_sparse", (4, 2), dtype="float16")
+    kv.row_sparse_pull("w", out=out, row_ids=np.array([1]))
+    assert str(out.dtype) == "float16"
+    assert str(out.data.dtype) == "float16"
+
+
+def test_dense_to_rsp_stays_on_device():
+    # fast path: dense NDArray -> row_sparse without full host copy
+    g = mx.nd.array(_rand_rsp(shape=(64, 8), nnz_rows=(3, 9)))
+    rsp = g.tostype("row_sparse")
+    np.testing.assert_array_equal(rsp.indices.asnumpy(), [3, 9])
+    np.testing.assert_array_equal(rsp.asnumpy(), g.asnumpy())

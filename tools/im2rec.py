@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""im2rec: build .rec/.idx packs from an image folder or a .lst file.
+
+Reference: ``tools/im2rec.py`` (list generation + multiprocess pack) —
+same .lst format (``index\\tlabel[\\tlabels...]\\tpath``), same record
+layout (IRHeader + encoded image via recordio.pack_img), so packs made
+here are interchangeable with reference ones.
+
+Usage:
+  python tools/im2rec.py --make-list PREFIX ROOT      # write PREFIX.lst
+  python tools/im2rec.py PREFIX ROOT                  # pack PREFIX.lst -> .rec/.idx
+"""
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def list_images(root):
+    cat = {}
+    items = []
+    for folder in sorted(os.listdir(root)):
+        path = os.path.join(root, folder)
+        if not os.path.isdir(path):
+            continue
+        cat[folder] = len(cat)
+        for fname in sorted(os.listdir(path)):
+            if os.path.splitext(fname)[1].lower() in EXTS:
+                items.append((os.path.join(folder, fname), cat[folder]))
+    return items
+
+
+def make_list(args):
+    items = list_images(args.root)
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(items)
+    with open(args.prefix + ".lst", "w") as f:
+        for i, (path, label) in enumerate(items):
+            f.write("%d\t%f\t%s\n" % (i, label, path))
+    print("wrote %s.lst (%d items)" % (args.prefix, len(items)))
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield int(parts[0]), [float(x) for x in parts[1:-1]], parts[-1]
+
+
+def pack(args):
+    from mxnet_tpu import recordio, image
+    lst = args.prefix + ".lst"
+    if not os.path.isfile(lst):
+        make_list(args)
+    writer = recordio.MXIndexedRecordIO(args.prefix + ".idx",
+                                        args.prefix + ".rec", "w")
+    count = 0
+    for idx, labels, rel in read_list(lst):
+        fpath = os.path.join(args.root, rel)
+        label = labels[0] if len(labels) == 1 else labels
+        if args.pass_through:
+            with open(fpath, "rb") as f:
+                payload = recordio.pack(
+                    recordio.IRHeader(0, label, idx, 0), f.read())
+        else:
+            img = image.imread(fpath).asnumpy()
+            if args.resize:
+                img = image.resize_short(img, args.resize).asnumpy()
+            payload = recordio.pack_img(
+                recordio.IRHeader(0, label, idx, 0), img,
+                quality=args.quality,
+                img_fmt=".png" if args.encoding == ".png" else ".jpg")
+        writer.write_idx(idx, payload)
+        count += 1
+    writer.close()
+    print("packed %d records -> %s.rec/.idx" % (count, args.prefix))
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("prefix")
+    p.add_argument("root")
+    p.add_argument("--make-list", action="store_true", dest="make_list_only")
+    p.add_argument("--shuffle", type=int, default=1)
+    p.add_argument("--resize", type=int, default=0)
+    p.add_argument("--quality", type=int, default=95)
+    p.add_argument("--encoding", default=".jpg", choices=[".jpg", ".png"])
+    p.add_argument("--pass-through", action="store_true",
+                   help="pack raw file bytes without re-encoding")
+    args = p.parse_args()
+    if args.make_list_only:
+        make_list(args)
+    else:
+        pack(args)
+
+
+if __name__ == "__main__":
+    main()
