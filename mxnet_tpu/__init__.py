@@ -10,6 +10,15 @@ from __future__ import annotations
 __version__ = "0.1.0"
 
 from .base import MXNetError, get_env, set_env, environment
+
+# Honor an explicit CPU pin (MX_FORCE_CPU=1 / JAX_PLATFORMS=cpu) at import:
+# PJRT plugins can force-override the platform list via jax.config.update,
+# ignoring the env var, and a backend probe on a wedged accelerator tunnel
+# blocks forever.  Doing this here covers subprocesses (im2rec, bench
+# children, launchers) that inherit only the environment.
+from .base import cpu_pinned_by_user as _cpu_pinned, pin_cpu as _pin_cpu
+if _cpu_pinned():
+    _pin_cpu()
 from .device import (Context, Device, cpu, gpu, tpu, cpu_pinned, num_gpus,
                      num_tpus, current_context, current_device)
 from . import engine
@@ -36,6 +45,11 @@ from . import optimizer
 from . import kvstore
 from . import gluon
 from . import parallel
+from . import callback
+from . import model
+from . import monitor
+from . import module
+from . import module as mod
 
 # Subsystems land milestone-by-milestone (SURVEY.md §7.1); this list grows
 # until it covers the reference's full `python/mxnet/__init__.py` surface.

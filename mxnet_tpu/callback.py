@@ -1,0 +1,106 @@
+"""mx.callback — training-loop callbacks.
+
+Reference: ``python/mxnet/callback.py`` (Speedometer, do_checkpoint,
+log_train_metric, ProgressBar) — the furniture every reference training
+script wires into ``Module.fit``/``batch_end_callback``.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import time
+
+__all__ = ["Speedometer", "ProgressBar", "do_checkpoint",
+           "log_train_metric", "LogValidationMetricsCallback"]
+
+
+class Speedometer:
+    """Log samples/sec (and metrics) every `frequent` batches (reference:
+    callback.Speedometer)."""
+
+    def __init__(self, batch_size, frequent=50, auto_reset=True):
+        self.batch_size = batch_size
+        self.frequent = frequent
+        self.auto_reset = auto_reset
+        self.init = False
+        self.tic = 0
+        self.last_count = 0
+
+    def __call__(self, param):
+        count = param.nbatch
+        if self.last_count > count:
+            self.init = False
+        self.last_count = count
+        if self.init:
+            if count % self.frequent == 0:
+                speed = self.frequent * self.batch_size / \
+                    (time.time() - self.tic)
+                if param.eval_metric is not None:
+                    name_value = param.eval_metric.get_name_value()
+                    if self.auto_reset:
+                        param.eval_metric.reset()
+                    msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec\t%s"
+                    logging.info(msg, param.epoch, count, speed,
+                                 "\t".join("%s=%f" % kv for kv in name_value))
+                else:
+                    logging.info(
+                        "Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
+                        param.epoch, count, speed)
+                self.tic = time.time()
+        else:
+            self.init = True
+            self.tic = time.time()
+
+
+class ProgressBar:
+    """Text progress bar per batch (reference: callback.ProgressBar)."""
+
+    def __init__(self, total, length=80):
+        self.bar_len = length
+        self.total = total
+
+    def __call__(self, param):
+        count = param.nbatch
+        filled_len = int(round(self.bar_len * count / float(self.total)))
+        percents = math.ceil(100.0 * count / float(self.total))
+        prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
+        logging.info("[%s] %s%s\r", prog_bar, percents, "%")
+
+
+def do_checkpoint(prefix, period=1):
+    """Epoch-end callback saving `prefix-symbol.json` +
+    `prefix-%04d.params` (reference: callback.do_checkpoint)."""
+    from .model import save_checkpoint
+    period = int(max(1, period))
+
+    def _callback(iter_no, sym, arg, aux):
+        if (iter_no + 1) % period == 0:
+            save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
+    return _callback
+
+
+def log_train_metric(period, auto_reset=False):
+    """Batch-end callback logging the running metric (reference:
+    callback.log_train_metric)."""
+
+    def _callback(param):
+        if param.nbatch % period == 0 and param.eval_metric is not None:
+            name_value = param.eval_metric.get_name_value()
+            logging.info("Iter[%d] Batch[%d] Train-%s", param.epoch,
+                         param.nbatch,
+                         "\t".join("%s=%f" % kv for kv in name_value))
+            if auto_reset:
+                param.eval_metric.reset()
+    return _callback
+
+
+class LogValidationMetricsCallback:
+    """Eval-end callback (reference: callback.LogValidationMetricsCallback).
+    """
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            logging.info("Epoch[%d] Validation-%s=%f", param.epoch, name,
+                         value)

@@ -107,14 +107,14 @@ class DataIter:
         return self._next_batch.pad
 
 
-def _as_arrays(data, allow_dict=True):
+def _as_arrays(data, default_name="data"):
     """Normalize data= argument to [(name, numpy)] (reference: _init_data)."""
     if data is None:
         return []
     if isinstance(data, (NDArray, _np.ndarray)):
-        data = [("data", data)]
+        data = [(default_name, data)]
     elif isinstance(data, (list, tuple)):
-        data = [("data" if i == 0 else "data%d" % i, d)
+        data = [(default_name if i == 0 else "%s%d" % (default_name, i), d)
                 for i, d in enumerate(data)]
     elif isinstance(data, dict):
         data = sorted(data.items())
@@ -135,13 +135,8 @@ class NDArrayIter(DataIter):
                  last_batch_handle="pad", data_name="data",
                  label_name="softmax_label"):
         super().__init__(batch_size)
-        self.data = _as_arrays(data)
-        self.label = _as_arrays(label)
-        if self.data and data_name != "data" and len(self.data) == 1:
-            self.data = [(data_name, self.data[0][1])]
-        if self.label and label_name != "softmax_label" and \
-                len(self.label) == 1:
-            self.label = [(label_name, self.label[0][1])]
+        self.data = _as_arrays(data, data_name)
+        self.label = _as_arrays(label, label_name)
         self.num_data = self.data[0][1].shape[0] if self.data else 0
         if last_batch_handle not in ("pad", "discard", "roll_over"):
             raise ValueError("bad last_batch_handle %r" % last_batch_handle)

@@ -1,0 +1,57 @@
+"""mx.model — checkpoint helpers + BatchEndParam.
+
+Reference: ``python/mxnet/model.py`` (save_checkpoint, load_checkpoint,
+BatchEndParam; the FeedForward class itself is superseded by Module and
+not rebuilt — SURVEY §1 L12).
+
+Artifact layout matches the reference exactly:
+  ``prefix-symbol.json``   — Symbol.tojson()
+  ``prefix-%04d.params``   — nd.save dict with ``arg:``/``aux:`` prefixes
+so checkpoints interchange with reference tooling.
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+from typing import Dict, Tuple
+
+from . import ndarray as nd
+from .ndarray.ndarray import NDArray
+
+__all__ = ["BatchEndParam", "save_checkpoint", "load_checkpoint", "load_params"]
+
+BatchEndParam = namedtuple("BatchEndParams",
+                           ["epoch", "nbatch", "eval_metric", "locals"])
+
+
+def save_checkpoint(prefix: str, epoch: int, symbol, arg_params: Dict,
+                    aux_params: Dict, remove_amp_cast: bool = True) -> None:
+    """Reference: model.save_checkpoint."""
+    if symbol is not None:
+        symbol.save("%s-symbol.json" % prefix)
+    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
+    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    nd.save(param_name, save_dict)
+
+
+def load_params(prefix: str, epoch: int) -> Tuple[Dict, Dict]:
+    """Reference: model.load_params — just the two param dicts."""
+    save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
+    arg_params: Dict[str, NDArray] = {}
+    aux_params: Dict[str, NDArray] = {}
+    for k, v in save_dict.items():
+        tp, _, name = k.partition(":")
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+    return arg_params, aux_params
+
+
+def load_checkpoint(prefix: str, epoch: int):
+    """Reference: model.load_checkpoint → (symbol, arg_params, aux_params).
+    """
+    from . import symbol as sym
+    symbol = sym.load("%s-symbol.json" % prefix)
+    arg_params, aux_params = load_params(prefix, epoch)
+    return symbol, arg_params, aux_params

@@ -1,0 +1,498 @@
+"""mx.mod — the v1.x Module API over the symbol executor.
+
+Reference: ``python/mxnet/module/module.py`` (class Module — bind,
+init_params, init_optimizer, forward/backward/update, fit/score/predict,
+save_checkpoint/Module.load) and ``base_module.py`` (the fit loop).
+
+TPU-first notes: the reference Module owns executor groups over GPU lists
+and a kvstore; here the bound Executor evaluates the symbol DAG through
+the per-op jit cache on the chosen context, and the *output-layer loss
+gradients* (SoftmaxOutput & friends compute their loss gradient in-op in
+the reference: ``src/operator/softmax_output.cc``) are injected as head
+cotangents so the tape reproduces exactly ``(p - onehot)``-style grads.
+Multi-device data parallelism belongs to ``parallel.TrainStep``/Gluon
+Trainer in this rebuild; Module executes on its first context and is the
+compatibility surface for v1.x-era scripts (checkpoints interchange via
+mx.model).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..device import Context, cpu, current_context
+from .. import initializer as init_mod
+from .. import metric as metric_mod
+from .. import ndarray as nd
+from ..ndarray.ndarray import NDArray
+from .. import optimizer as opt_mod
+from ..io import DataDesc, DataBatch
+from ..model import BatchEndParam, save_checkpoint, load_checkpoint
+
+__all__ = ["BaseModule", "Module"]
+
+
+def _as_descs(shapes) -> List[DataDesc]:
+    out = []
+    for s in shapes or []:
+        if isinstance(s, DataDesc):
+            out.append(s)
+        else:
+            name, shape = s[0], s[1]
+            out.append(DataDesc(name, shape, *s[2:]))
+    return out
+
+
+# Loss-output heads (reference: src/operator/softmax_output.cc etc. compute
+# their loss gradient in-op).  Module binds the executor over the *backbone*
+# — the loss op's input z becomes the head — applies the output transform
+# itself, and injects the exact reference gradient w.r.t. z as the backward
+# cotangent.  This sidesteps inverting the op's vjp, which zeroes out at
+# saturation (sigmoid(z)→1 makes the p(1-p) factor exactly 0 in fp32).
+
+def _softmax_rule(z, y, attrs):
+    scale = float(attrs.get("grad_scale", 1.0))
+    p = jax.nn.softmax(z._jax, axis=-1)
+    yi = y._jax.astype(jnp.int32)
+    onehot = jnp.zeros_like(p).at[jnp.arange(yi.shape[0]), yi].set(1.0)
+    if attrs.get("normalization", "null") == "batch":
+        scale = scale / yi.shape[0]
+    return nd.from_jax(p, ctx=z.context), \
+        nd.from_jax((p - onehot) * scale, ctx=z.context)
+
+
+def _linreg_rule(z, y, attrs):
+    scale = float(attrs.get("grad_scale", 1.0))
+    return z, nd.from_jax((z._jax - y._jax.reshape(z.shape)) * scale,
+                          ctx=z.context)
+
+
+def _maereg_rule(z, y, attrs):
+    scale = float(attrs.get("grad_scale", 1.0))
+    return z, nd.from_jax(
+        jnp.sign(z._jax - y._jax.reshape(z.shape)) * scale, ctx=z.context)
+
+
+def _logreg_rule(z, y, attrs):
+    scale = float(attrs.get("grad_scale", 1.0))
+    p = jax.nn.sigmoid(z._jax)
+    return nd.from_jax(p, ctx=z.context), \
+        nd.from_jax((p - y._jax.reshape(z.shape)) * scale, ctx=z.context)
+
+
+_HEAD_RULES = {
+    "SoftmaxOutput": _softmax_rule,
+    "LinearRegressionOutput": _linreg_rule,
+    "MAERegressionOutput": _maereg_rule,
+    "LogisticRegressionOutput": _logreg_rule,
+}
+
+
+class BaseModule:
+    """Reference: module/base_module.py — shared fit/score/predict loops."""
+
+    def __init__(self, logger=logging):
+        self.logger = logger
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+        self.for_training = False
+
+    # subclass surface ------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        raise NotImplementedError
+
+    def backward(self, out_grads=None):
+        raise NotImplementedError
+
+    def update(self):
+        raise NotImplementedError
+
+    def get_outputs(self):
+        raise NotImplementedError
+
+    def update_metric(self, eval_metric, labels):
+        raise NotImplementedError
+
+    # shared loops ----------------------------------------------------------
+    def forward_backward(self, data_batch):
+        self.forward(data_batch, is_train=True)
+        self.backward()
+
+    def score(self, eval_data, eval_metric, num_batch=None, reset=True,
+              epoch=0, batch_end_callback=None):
+        """Reference: BaseModule.score."""
+        assert self.binded and self.params_initialized
+        if reset:
+            eval_data.reset()
+        if not isinstance(eval_metric, metric_mod.EvalMetric):
+            eval_metric = metric_mod.create(eval_metric)
+        eval_metric.reset()
+        for nbatch, batch in enumerate(eval_data):
+            if num_batch is not None and nbatch == num_batch:
+                break
+            self.forward(batch, is_train=False)
+            self.update_metric(eval_metric, batch.label)
+            if batch_end_callback is not None:
+                for cb in _as_list(batch_end_callback):
+                    cb(BatchEndParam(epoch, nbatch, eval_metric, locals()))
+        return eval_metric.get_name_value()
+
+    def predict(self, eval_data, num_batch=None, reset=True):
+        """Reference: BaseModule.predict — concatenated outputs."""
+        assert self.binded and self.params_initialized
+        if reset:
+            eval_data.reset()
+        outputs = []
+        for nbatch, batch in enumerate(eval_data):
+            if num_batch is not None and nbatch == num_batch:
+                break
+            self.forward(batch, is_train=False)
+            outs = self.get_outputs()
+            if batch.pad:
+                outs = [o[:o.shape[0] - batch.pad] for o in outs]
+            outputs.append([o.copy() for o in outs])
+        if not outputs:
+            return []
+        merged = [nd.concatenate([b[i] for b in outputs], axis=0)
+                  for i in range(len(outputs[0]))]
+        return merged[0] if len(merged) == 1 else merged
+
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.01),),
+            eval_end_callback=None, initializer=None,
+            arg_params=None, aux_params=None, allow_missing=False,
+            force_init=False, begin_epoch=0, num_epoch=None,
+            validation_metric=None, monitor=None):
+        """The reference training loop (reference: BaseModule.fit)."""
+        assert num_epoch is not None, "please specify number of epochs"
+        initializer = initializer or init_mod.Uniform(0.01)
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label, for_training=True)
+        if monitor is not None:
+            self.install_monitor(monitor)
+        self.init_params(initializer=initializer, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=allow_missing,
+                         force_init=force_init)
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=dict(optimizer_params))
+        if validation_metric is None:
+            validation_metric = eval_metric
+        if not isinstance(eval_metric, metric_mod.EvalMetric):
+            eval_metric = metric_mod.create(eval_metric)
+
+        for epoch in range(begin_epoch, num_epoch):
+            eval_metric.reset()
+            train_data.reset()
+            for nbatch, data_batch in enumerate(train_data):
+                if monitor is not None:
+                    monitor.tic()
+                self.forward_backward(data_batch)
+                self.update()
+                self.update_metric(eval_metric, data_batch.label)
+                if monitor is not None:
+                    monitor.toc_print()
+                if batch_end_callback is not None:
+                    for cb in _as_list(batch_end_callback):
+                        cb(BatchEndParam(epoch, nbatch, eval_metric,
+                                         locals()))
+            for name, val in eval_metric.get_name_value():
+                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+            if epoch_end_callback is not None:
+                arg, aux = self.get_params()
+                for cb in _as_list(epoch_end_callback):
+                    cb(epoch, self.symbol, arg, aux)
+            if eval_data is not None:
+                res = self.score(eval_data, validation_metric, epoch=epoch,
+                                 batch_end_callback=eval_end_callback)
+                for name, val in res:
+                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
+                                     name, val)
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+class Module(BaseModule):
+    """Reference: module/module.py (class Module)."""
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None):
+        super().__init__(logger)
+        if isinstance(context, (list, tuple)):
+            if len(context) > 1:
+                logger.warning(
+                    "Module executes on %s; multi-device data parallelism "
+                    "is parallel.TrainStep's job in this rebuild", context[0])
+            context = context[0] if context else None
+        self._context = context or current_context()
+        self._symbol = symbol
+        self._data_names = list(data_names or [])
+        self._label_names = list(label_names or [])
+        self._fixed_param_names = list(fixed_param_names or [])
+        arg_names = symbol.list_arguments()
+        self._param_names = [n for n in arg_names
+                             if n not in self._data_names
+                             and n not in self._label_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        # split heads into (backbone head, loss rule): the executor runs the
+        # backbone; loss-output forward transforms + exact grads are ours
+        from ..symbol import Symbol as _Sym
+        self._head_rules = []
+        exec_heads = []
+        for node, idx in symbol._heads:
+            rule = _HEAD_RULES.get(node.op)
+            if rule is not None:
+                exec_heads.append(node.inputs[0])
+                self._head_rules.append((rule, node.attrs))
+            else:
+                exec_heads.append((node, idx))
+                self._head_rules.append(None)
+        self._exec_symbol = _Sym(exec_heads)
+        self._exec = None
+        self._optimizer = None
+        self._updater = None
+        self._data_shapes = None
+        self._label_shapes = None
+
+    # -- properties ---------------------------------------------------------
+    @property
+    def symbol(self):
+        return self._symbol
+
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    @property
+    def data_shapes(self):
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return [(n, o.shape) for n, o in
+                zip(self.output_names, self._exec.outputs)] \
+            if self._exec.outputs else None
+
+    # -- bind ---------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, grad_req="write"):
+        """Allocate the executor (reference: Module.bind)."""
+        if self.binded and not force_rebind:
+            return
+        self.for_training = for_training
+        self._data_shapes = _as_descs(data_shapes)
+        self._label_shapes = _as_descs(label_shapes)
+        feed = {d.name: d.shape for d in self._data_shapes +
+                self._label_shapes}
+        arg_shapes, _, aux_shapes = self._exec_symbol.infer_shape(
+            **{k: v for k, v in feed.items()
+               if k in self._exec_symbol.list_arguments()})
+        arg_names = self._exec_symbol.list_arguments()
+        args: Dict[str, NDArray] = {}
+        grads: Dict[str, NDArray] = {}
+        for name, shape in zip(arg_names, arg_shapes):
+            args[name] = nd.zeros(shape, ctx=self._context)
+            wants_grad = (name in self._param_names and
+                          name not in self._fixed_param_names) or \
+                (inputs_need_grad and name in self._data_names)
+            if for_training and wants_grad:
+                grads[name] = nd.zeros(shape, ctx=self._context)
+        self.inputs_need_grad = inputs_need_grad
+        aux = {name: nd.zeros(shape, ctx=self._context)
+               for name, shape in zip(self._aux_names, aux_shapes)}
+        self._exec = self._exec_symbol.bind(
+            self._context, args, grads,
+            grad_req if for_training else "null", aux)
+        self.binded = True
+
+    # -- params -------------------------------------------------------------
+    def init_params(self, initializer=init_mod.Uniform(0.01),
+                    arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False,
+                    allow_extra=False):
+        """Reference: Module.init_params (initializer=None leaves
+        unmatched params untouched, as set_params needs)."""
+        assert self.binded, "call bind before init_params"
+        if self.params_initialized and not force_init:
+            return
+        for name in self._param_names:
+            arr = self._exec.arg_dict[name]
+            if arg_params is not None and name in arg_params:
+                arr._set_jax(arg_params[name]._jax if isinstance(
+                    arg_params[name], NDArray)
+                    else jnp.asarray(arg_params[name]))
+            elif arg_params is not None and not allow_missing:
+                raise MXNetError(
+                    "missing parameter %r (pass allow_missing=True to "
+                    "initialize absent params)" % name)
+            elif initializer is not None:
+                initializer(init_mod.InitDesc(name), arr)
+        for name in self._aux_names:
+            arr = self._exec.aux_dict[name]
+            if aux_params is not None and name in aux_params:
+                arr._set_jax(aux_params[name]._jax if isinstance(
+                    aux_params[name], NDArray)
+                    else jnp.asarray(aux_params[name]))
+            elif initializer is not None:
+                initializer(init_mod.InitDesc(name), arr)
+        self.params_initialized = True
+
+    def get_params(self):
+        """Reference: Module.get_params → (arg_params, aux_params)."""
+        assert self.binded and self.params_initialized
+        arg = {n: self._exec.arg_dict[n].copy() for n in self._param_names}
+        aux = {n: self._exec.aux_dict[n].copy() for n in self._aux_names}
+        return arg, aux
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        self.init_params(initializer=None, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=allow_missing,
+                         force_init=force_init)
+
+    # -- optimizer ----------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        """Reference: Module.init_optimizer (kvstore collapses to the local
+        updater — one device owns the weights here)."""
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            return
+        if isinstance(optimizer, str):
+            idx2name = dict(enumerate(self._param_names))
+            optimizer = opt_mod.create(
+                optimizer, param_idx2name=idx2name,
+                **dict(optimizer_params or {}))
+        self._optimizer = optimizer
+        self._updater = opt_mod.get_updater(optimizer)
+        self.optimizer_initialized = True
+
+    # -- compute ------------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        """Reference: Module.forward."""
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        feeds = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            feeds[name] = arr.as_in_context(self._context)
+        self._labels = []
+        if data_batch.label:
+            for name, arr in zip(self._label_names, data_batch.label):
+                arr = arr.as_in_context(self._context)
+                if name in self._exec.arg_dict:  # labels a non-loss head uses
+                    feeds[name] = arr
+                self._labels.append(arr)
+        raw = self._exec.forward(is_train=is_train, **feeds)
+        # apply loss-output forward transforms; cache exact head grads
+        self._outputs = []
+        self._head_grads = []
+        labels = list(self._labels)
+        for z, rule in zip(raw, self._head_rules):
+            if rule is None:
+                self._outputs.append(z)
+                self._head_grads.append(None)
+                continue
+            fn, attrs = rule
+            label = labels.pop(0) if labels else None
+            if label is None:
+                self._outputs.append(z)   # inference: no label, no grad
+                self._head_grads.append(None)
+                continue
+            out, grad = fn(z, label, attrs)
+            self._outputs.append(out)
+            self._head_grads.append(grad)
+
+    def backward(self, out_grads=None):
+        """Reference: Module.backward — loss-output heads use the exact
+        in-op gradient cached at forward; other heads need out_grads."""
+        assert self.binded and self.params_initialized
+        if out_grads is None:
+            out_grads = []
+            for (node, _), g in zip(self._symbol._heads, self._head_grads):
+                if g is None:
+                    raise MXNetError(
+                        "Module.backward: head %r is not a loss output with "
+                        "a label feed; pass out_grads explicitly (reference "
+                        "requires the same)" % node.name)
+                out_grads.append(g)
+        self._exec.backward(out_grads)
+
+    def update(self):
+        """Reference: Module.update — updater over (grad, weight) pairs."""
+        assert self.binded and self.params_initialized and \
+            self.optimizer_initialized
+        for i, name in enumerate(self._param_names):
+            grad = self._exec.grad_dict.get(name)
+            if grad is None:
+                continue
+            self._updater(i, grad, self._exec.arg_dict[name])
+
+    def get_outputs(self):
+        assert self.binded
+        return getattr(self, "_outputs", None) or self._exec.outputs
+
+    def get_input_grads(self):
+        assert self.binded
+        return [self._exec.grad_dict.get(n) for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(labels, self.get_outputs())
+
+    def install_monitor(self, monitor):
+        monitor.install(self._exec)
+
+    # -- checkpoints ---------------------------------------------------------
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        """Reference: Module.save_checkpoint."""
+        arg, aux = self.get_params()
+        save_checkpoint(prefix, epoch, self._symbol, arg, aux)
+        if save_optimizer_states:
+            with open("%s-%04d.states" % (prefix, epoch), "wb") as f:
+                f.write(self._updater.get_states(dump_optimizer=True))
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        """Reference: Module.load."""
+        sym, arg, aux = load_checkpoint(prefix, epoch)
+        mod = Module(sym, **kwargs)
+
+        orig_bind = mod.bind
+
+        def bind_and_load(*a, **kw):
+            orig_bind(*a, **kw)
+            mod.init_params(arg_params=arg, aux_params=aux)
+        mod.bind = bind_and_load
+
+        if load_optimizer_states:
+            states_file = "%s-%04d.states" % (prefix, epoch)
+            orig_init_opt = mod.init_optimizer
+
+            def init_opt_and_load(*a, **kw):
+                orig_init_opt(*a, **kw)
+                with open(states_file, "rb") as f:
+                    mod._updater.set_states(f.read())
+                mod._optimizer = mod._updater.optimizer
+            mod.init_optimizer = init_opt_and_load
+        return mod

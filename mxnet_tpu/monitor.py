@@ -1,0 +1,82 @@
+"""mx.monitor — executor introspection during training.
+
+Reference: ``python/mxnet/monitor.py`` (class Monitor — installs output
+hooks on executors, stat_func over arrays every `interval` batches).
+
+The reference intercepts every op's outputs via MXExecutorSetMonitorCallback;
+this rebuild's executor evaluates whole jitted programs, so the observable
+surface is the bound arrays: arguments, gradients, aux states, and outputs
+— which is what Monitor consumers (debugging exploding grads, dead units)
+actually read.  ``monitor_all`` is accepted for parity and widens nothing
+further.
+"""
+from __future__ import annotations
+
+import logging
+import re
+from typing import Callable, List, Optional, Tuple
+
+from . import ndarray as nd
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    def __init__(self, interval: int, stat_func: Optional[Callable] = None,
+                 pattern: str = ".*", sort: bool = False,
+                 monitor_all: bool = False):
+        if stat_func is None:
+            def stat_func(x):
+                return nd.invoke("norm", x) / (x.size ** 0.5)
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue: List[Tuple[int, str, object]] = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+
+    def install(self, exe) -> None:
+        """Attach to an executor (reference: Monitor.install_to_executor)."""
+        self.exes.append(exe)
+
+    def tic(self):
+        """Start collecting for this batch if due (reference: Monitor.tic)."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self) -> List[Tuple[int, str, str]]:
+        """Collect stats from installed executors (reference: Monitor.toc)."""
+        if not self.activated:
+            return []
+        self.activated = False
+        res = []
+        for exe in self.exes:
+            groups = [("%s" % n, a) for n, a in exe.arg_dict.items()]
+            groups += [("%s_grad" % n, a) for n, a in exe.grad_dict.items()
+                       if a is not None]
+            groups += [("%s" % n, a) for n, a in exe.aux_dict.items()]
+            groups += [("output%d" % i, o)
+                       for i, o in enumerate(exe.outputs)]
+            for name, arr in groups:
+                if arr is None or not self.re_prog.match(name):
+                    continue
+                self.queue.append((self.step, name, self.stat_func(arr)))
+        for n, k, v_list in self.queue:
+            if not isinstance(v_list, (list, tuple)):
+                v_list = [v_list]
+            s = ",".join("%f" % float(v.asnumpy().reshape(-1)[0])
+                         for v in v_list)
+            res.append((n, k, s))
+        if self.sort:
+            res = sorted(res, key=lambda x: x[1])
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        """Collect and log (reference: Monitor.toc_print)."""
+        for n, k, v in self.toc():
+            logging.info("Batch: %7d %30s %s", n, k, v)

@@ -470,3 +470,21 @@ def _ctc_loss(pred, label, data_lengths=None, label_lengths=None,
     m = jnp.maximum(a_last, a_prev)
     ll = m + jnp.log(jnp.exp(a_last - m) + jnp.exp(a_prev - m))
     return -ll
+
+
+@register("LinearRegressionOutput", aliases=["linear_regression_output"])
+def _linear_regression_output(data, label, grad_scale=1.0):
+    # forward is identity; Module.backward injects the implicit l2 loss
+    # gradient (pred - label) the reference computes in-op
+    # (src/operator/regression_output.cc)
+    return data
+
+
+@register("MAERegressionOutput", aliases=["mae_regression_output"])
+def _mae_regression_output(data, label, grad_scale=1.0):
+    return data
+
+
+@register("LogisticRegressionOutput", aliases=["logistic_regression_output"])
+def _logistic_regression_output(data, label, grad_scale=1.0):
+    return jax.nn.sigmoid(data)

@@ -241,16 +241,24 @@ class Symbol:
                     shp = _attr_parse(n.attrs.get("__shape__", "None"))
                     dt = n.attrs.get("__dtype__", "float32")
                     if shp is None and want == "shape":
-                        raise MXNetError(
-                            "infer_shape: missing shape for argument %r"
-                            % n.name)
+                        # defer: a consuming op may determine it (the
+                        # reference's backward shape inference — FC/conv
+                        # weights from data shape + attrs)
+                        avals[id(n)] = None
+                        continue
                     avals[id(n)] = [jax.ShapeDtypeStruct(
                         tuple(shp or ()), _np.dtype(dt))]
             elif n.op == "_const":
                 val = _np.asarray(_attr_parse(n.attrs["value"]), _np.float32)
                 avals[id(n)] = [jax.ShapeDtypeStruct(val.shape, val.dtype)]
             else:
+                _infer_param_inputs(n, avals)
                 avals[id(n)] = _node_eval_shape(n, avals)
+        for n in nodes:
+            if avals.get(id(n)) is None:
+                raise MXNetError(
+                    "infer_shape: missing shape for argument %r (no "
+                    "backward-inference rule reached it)" % n.name)
         args = [avals[id(n)][0] for n in nodes
                 if n.op == "null" and not _is_aux_name(n.name)]
         auxs = [avals[id(n)][0] for n in nodes
@@ -431,10 +439,78 @@ def evaluate(sym: Symbol, feeds: Dict[str, Any], params: Dict[str, Any],
     return outs if len(outs) != 1 else outs[0]
 
 
+def _infer_param_inputs(n: _SymNode, avals) -> None:
+    """Backward shape inference for parameter inputs (reference: each op's
+    FInferShape fills unknown arg shapes; here a rule table covers the
+    param-bearing ops so Module/simple_bind work from data shapes alone)."""
+    unresolved = [pos for pos, (i, _idx) in enumerate(n.inputs)
+                  if avals.get(id(i)) is None]
+    if not unresolved:
+        return
+    kw = {k: _attr_parse(v) for k, v in n.attrs.items()
+          if not k.startswith("__")}
+
+    def dshape(pos=0):
+        i, idx = n.inputs[pos]
+        a = avals.get(id(i))
+        if a is None:
+            raise MXNetError("infer_shape: input %d of %r unknown"
+                             % (pos, n.name))
+        return a[idx].shape
+
+    shapes: Dict[int, tuple] = {}
+    op = n.op
+    if op == "FullyConnected":
+        nh = int(kw["num_hidden"])
+        d = dshape()
+        in_units = int(_np.prod(d[1:])) if kw.get("flatten", True) else d[-1]
+        shapes = {1: (nh, in_units), 2: (nh,)}
+    elif op in ("Convolution", "Deconvolution"):
+        kern = tuple(kw["kernel"]) if not isinstance(kw["kernel"], int) \
+            else (kw["kernel"],)
+        nf = int(kw["num_filter"])
+        ng = int(kw.get("num_group", 1))
+        cin = dshape()[1]
+        if op == "Convolution":
+            shapes = {1: (nf, cin // ng) + kern, 2: (nf,)}
+        else:
+            shapes = {1: (cin, nf // ng) + kern, 2: (nf,)}
+    elif op in ("BatchNorm", "InstanceNorm"):
+        c = dshape()[int(kw.get("axis", 1))]
+        shapes = {1: (c,), 2: (c,), 3: (c,), 4: (c,)}
+    elif op == "GroupNorm":
+        c = dshape()[1]
+        shapes = {1: (c,), 2: (c,)}
+    elif op == "LayerNorm":
+        c = dshape()[int(kw.get("axis", -1))]
+        shapes = {1: (c,), 2: (c,)}
+    elif op == "RMSNorm":
+        c = dshape()[-1]
+        shapes = {1: (c,)}
+    elif op == "Embedding":
+        shapes = {1: (int(kw["input_dim"]), int(kw["output_dim"]))}
+    elif op == "SoftmaxOutput":
+        shapes = {1: dshape()[:-1]}           # label: data minus class axis
+    elif op in ("LinearRegressionOutput", "MAERegressionOutput",
+                "LogisticRegressionOutput"):
+        shapes = {1: dshape()}                # label: same as data
+    for pos in unresolved:
+        if pos not in shapes:
+            continue
+        node, _ = n.inputs[pos]
+        avals[id(node)] = [jax.ShapeDtypeStruct(shapes[pos], jnp.float32)]
+
+
 def _node_eval_shape(n: _SymNode, avals) -> List[jax.ShapeDtypeStruct]:
     op = get_op(n.op)
     kw = {k: _attr_parse(v) for k, v in n.attrs.items()
           if not k.startswith("__")}
+    for pos, (i, _idx) in enumerate(n.inputs):
+        if avals.get(id(i)) is None:
+            raise MXNetError(
+                "infer_shape: missing shape for argument %r (input %d of "
+                "%r; no backward-inference rule covers it)"
+                % (i.name, pos, n.name))
     ins = [avals[id(i)][idx] for i, idx in n.inputs]
     fn = cached_jit(op.name, kw)
     if op.needs_rng:

@@ -1,0 +1,229 @@
+"""Module API tests.
+
+Reference pattern: tests/python/unittest/test_module.py — bind/init/fit on
+a small symbolic MLP, head-gradient correctness for the loss-output ops,
+score/predict, checkpoint roundtrip through mx.model artifacts, Speedometer
+and Monitor smoke.
+"""
+import logging
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io as mio, callback, monitor
+from mxnet_tpu.module import Module
+
+sym = mx.sym
+
+
+def _mlp_softmax():
+    data = sym.Variable("data")
+    h = sym.FullyConnected(data, sym.Variable("fc1_weight"),
+                           sym.Variable("fc1_bias"), num_hidden=32)
+    h = sym.Activation(h, act_type="relu")
+    out = sym.FullyConnected(h, sym.Variable("fc2_weight"),
+                             sym.Variable("fc2_bias"), num_hidden=3)
+    return sym.SoftmaxOutput(out, sym.Variable("softmax_label"),
+                             normalization="batch", name="softmax")
+
+
+def _toy_classification(n=240, dim=8, classes=3, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, dim).astype(np.float32)
+    Y = (X[:, :classes].argmax(axis=1)).astype(np.float32)
+    return X, Y
+
+
+def test_bind_shapes_and_params():
+    mod = Module(_mlp_softmax(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 8))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(mx.init.Xavier())
+    assert sorted(mod._param_names) == ["fc1_bias", "fc1_weight",
+                                        "fc2_bias", "fc2_weight"]
+    arg, aux = mod.get_params()
+    assert arg["fc1_weight"].shape == (32, 8)
+    assert aux == {}
+
+
+def test_softmax_head_gradient_matches_formula():
+    """backward through SoftmaxOutput must produce exactly (p - onehot)/N
+    w.r.t. the logits, like src/operator/softmax_output.cc."""
+    data = sym.Variable("data")
+    out = sym.SoftmaxOutput(data, sym.Variable("softmax_label"),
+                            normalization="null")
+    mod = Module(out, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 5))],
+             label_shapes=[("softmax_label", (4,))], inputs_need_grad=True)
+    mod.init_params()
+    logits = np.random.RandomState(1).randn(4, 5).astype(np.float32)
+    labels = np.array([0, 2, 4, 1], np.float32)
+    batch = mio.DataBatch(data=[mx.nd.array(logits)],
+                          label=[mx.nd.array(labels)])
+    mod.forward(batch, is_train=True)
+    p = mod.get_outputs()[0].asnumpy()
+    np.testing.assert_allclose(
+        p, np.exp(logits) / np.exp(logits).sum(1, keepdims=True), rtol=1e-5)
+    mod.backward()
+    g = mod.get_input_grads()[0]
+    onehot = np.eye(5, dtype=np.float32)[labels.astype(int)]
+    np.testing.assert_allclose(g.asnumpy(), p - onehot, rtol=1e-4, atol=1e-5)
+
+
+def test_linear_regression_head_gradient():
+    data = sym.Variable("data")
+    out = sym.LinearRegressionOutput(data, sym.Variable("softmax_label"))
+    mod = Module(out, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (6, 1))],
+             label_shapes=[("softmax_label", (6, 1))], inputs_need_grad=True)
+    mod.init_params()
+    x = np.random.randn(6, 1).astype(np.float32)
+    y = np.random.randn(6, 1).astype(np.float32)
+    batch = mio.DataBatch(data=[mx.nd.array(x)], label=[mx.nd.array(y)])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    np.testing.assert_allclose(mod.get_input_grads()[0].asnumpy(), x - y,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_module_fit_converges_and_scores():
+    X, Y = _toy_classification()
+    train = mio.NDArrayIter(X, Y, batch_size=24, shuffle=True)
+    val = mio.NDArrayIter(X, Y, batch_size=24)
+    mod = Module(_mlp_softmax(), context=mx.cpu())
+    mod.fit(train, eval_data=val, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            initializer=mx.init.Xavier(), num_epoch=12,
+            batch_end_callback=callback.Speedometer(24, frequent=5))
+    acc = mod.score(val, "acc")
+    assert acc[0][1] > 0.9, acc
+    preds = mod.predict(val)
+    assert preds.shape == (240, 3)
+    np.testing.assert_allclose(preds.asnumpy().sum(axis=1), 1.0, rtol=1e-4)
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    X, Y = _toy_classification(n=48)
+    train = mio.NDArrayIter(X, Y, batch_size=16)
+    mod = Module(_mlp_softmax(), context=mx.cpu())
+    mod.fit(train, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1}, num_epoch=2,
+            epoch_end_callback=callback.do_checkpoint(
+                str(tmp_path / "mlp")))
+    assert os.path.isfile(str(tmp_path / "mlp-symbol.json"))
+    assert os.path.isfile(str(tmp_path / "mlp-0002.params"))
+
+    mod2 = Module.load(str(tmp_path / "mlp"), 2, context=mx.cpu())
+    mod2.bind(data_shapes=[("data", (16, 8))],
+              label_shapes=[("softmax_label", (16,))], for_training=False)
+    train.reset()
+    batch = next(train)
+    mod.forward(batch, is_train=False)
+    mod2.forward(batch, is_train=False)
+    np.testing.assert_allclose(mod.get_outputs()[0].asnumpy(),
+                               mod2.get_outputs()[0].asnumpy(), rtol=1e-5)
+
+
+def test_set_get_params_and_save_checkpoint(tmp_path):
+    mod = Module(_mlp_softmax(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 8))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer()
+    arg, aux = mod.get_params()
+    arg2 = {k: v * 0 for k, v in arg.items()}
+    mod.set_params(arg2, aux)
+    assert float(mod.get_params()[0]["fc1_weight"].asnumpy().sum()) == 0.0
+    mod.save_checkpoint(str(tmp_path / "m"), 0)
+    assert os.path.isfile(str(tmp_path / "m-0000.params"))
+
+
+def test_monitor_smoke(caplog):
+    X, Y = _toy_classification(n=24)
+    train = mio.NDArrayIter(X, Y, batch_size=12)
+    mod = Module(_mlp_softmax(), context=mx.cpu())
+    mon = monitor.Monitor(interval=1, pattern=".*weight.*")
+    with caplog.at_level(logging.INFO):
+        mod.fit(train, optimizer="sgd", num_epoch=1, monitor=mon)
+    msgs = [r.message for r in caplog.records if "fc1_weight" in r.message]
+    assert msgs, "monitor produced no stats"
+
+
+# -- review-finding regressions ----------------------------------------------
+
+def test_init_params_allow_missing_semantics():
+    mod = Module(_mlp_softmax(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 8))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(mx.init.Xavier())
+    arg, aux = mod.get_params()
+    partial = {"fc1_weight": arg["fc1_weight"]}
+    with pytest.raises(mx.MXNetError):  # missing + allow_missing=False
+        mod.init_params(arg_params=partial, force_init=True)
+    # allow_missing=True initializes the absent ones (not left as-is)
+    mod.set_params({k: v * 0 for k, v in arg.items()}, aux)
+    mod.init_params(mx.init.One(), arg_params=partial,
+                    allow_missing=True, force_init=True)
+    assert float(mod.get_params()[0]["fc2_weight"].asnumpy().mean()) == 1.0
+
+
+def test_saturated_logistic_gradient_not_zero():
+    """Confidently-wrong saturated units must still get gradient (p - y)."""
+    data = sym.Variable("data")
+    out = sym.LogisticRegressionOutput(data, sym.Variable("softmax_label"))
+    mod = Module(out, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (2, 1))],
+             label_shapes=[("softmax_label", (2, 1))], inputs_need_grad=True)
+    mod.init_params()
+    z = np.array([[30.0], [-30.0]], np.float32)   # sigmoid == exactly 1 / 0
+    y = np.array([[0.0], [1.0]], np.float32)
+    batch = mio.DataBatch(data=[mx.nd.array(z)], label=[mx.nd.array(y)])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    g = mod.get_input_grads()[0].asnumpy()
+    np.testing.assert_allclose(g, [[1.0], [-1.0]], atol=1e-6)
+
+
+def test_module_load_restores_optimizer_states(tmp_path):
+    X, Y = _toy_classification(n=48)
+    train = mio.NDArrayIter(X, Y, batch_size=16)
+    mod = Module(_mlp_softmax(), context=mx.cpu())
+    mod.fit(train, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            num_epoch=2)
+    mod.save_checkpoint(str(tmp_path / "m"), 2, save_optimizer_states=True)
+    mod2 = Module.load(str(tmp_path / "m"), 2, load_optimizer_states=True)
+    mod2.bind(data_shapes=train.provide_data,
+              label_shapes=train.provide_label)
+    mod2.init_optimizer(optimizer="sgd",
+                        optimizer_params={"learning_rate": 0.1,
+                                          "momentum": 0.9})
+    s1 = mod._updater.states
+    s2 = mod2._updater.states
+    assert set(s1.keys()) == set(s2.keys()) and len(s1) > 0
+    for k in s1:
+        a, b = s1[k], s2[k]
+        if isinstance(a, tuple):
+            a, b = a[0], b[0]
+        if a is None:
+            assert b is None
+        else:
+            np.testing.assert_allclose(a.asnumpy(), b.asnumpy(), rtol=1e-6)
+
+
+def test_infer_shape_clean_error_for_unknown_var():
+    x = sym.Variable("x")
+    w = sym.Variable("mystery")
+    out = sym.broadcast_add(x, w)
+    with pytest.raises(mx.MXNetError, match="mystery"):
+        out.infer_shape(x=(2, 3))
+
+
+def test_infer_shape_loss_label_rule():
+    s = _mlp_softmax()
+    args, outs, _ = s.infer_shape(data=(10, 8))  # no label shape given
+    shapes = dict(zip(s.list_arguments(), args))
+    assert shapes["softmax_label"] == (10,)
+    assert outs == [(10, 3)]

@@ -483,6 +483,9 @@ def _anchors():
 
 # Ops exercised by dedicated suites rather than the battery:
 TESTED_ELSEWHERE = {
+    "LinearRegressionOutput": "tests/test_module.py",
+    "MAERegressionOutput": "tests/test_module.py",
+    "LogisticRegressionOutput": "tests/test_module.py",
     "_sparse_sgd_update": "tests/test_sparse.py",
     "_sparse_sgd_mom_update": "tests/test_sparse.py",
     "_sparse_adam_update": "tests/test_sparse.py",
