@@ -55,34 +55,70 @@ def _as_descs(shapes) -> List[DataDesc]:
 # cotangent.  This sidesteps inverting the op's vjp, which zeroes out at
 # saturation (sigmoid(z)→1 makes the p(1-p) factor exactly 0 in fp32).
 
+def _attr_f(attrs, key, default):
+    v = attrs.get(key, default)
+    return float(v) if not isinstance(v, bool) else v
+
+
+def _attr_b(attrs, key, default=False):
+    v = attrs.get(key, default)
+    if isinstance(v, str):
+        return v in ("1", "True", "true")
+    return bool(v)
+
+
 def _softmax_rule(z, y, attrs):
-    scale = float(attrs.get("grad_scale", 1.0))
-    p = jax.nn.softmax(z._jax, axis=-1)
-    yi = y._jax.astype(jnp.int32)
-    onehot = jnp.zeros_like(p).at[jnp.arange(yi.shape[0]), yi].set(1.0)
-    if attrs.get("normalization", "null") == "batch":
+    """ND softmax head: class axis 1 when multi_output (reference layout
+    (B, C, d1..)), else last; integer labels of any matching shape;
+    use_ignore/ignore_label mask + 'valid' normalization honored."""
+    scale = _attr_f(attrs, "grad_scale", 1.0)
+    axis = 1 if _attr_b(attrs, "multi_output") else -1
+    zj = jnp.moveaxis(z._jax, axis, -1)           # classes last
+    p = jax.nn.softmax(zj, axis=-1)
+    out = nd.from_jax(jnp.moveaxis(p, -1, axis), ctx=z.context)
+    if y is None:
+        return out, None
+    yi = y._jax.astype(jnp.int32).reshape(zj.shape[:-1])
+    onehot = jax.nn.one_hot(yi, zj.shape[-1], dtype=p.dtype)
+    g = p - onehot
+    norm = attrs.get("normalization", "null")
+    if _attr_b(attrs, "use_ignore"):
+        valid = (yi != int(_attr_f(attrs, "ignore_label", -1.0)))
+        g = g * valid[..., None]
+        if norm == "valid":
+            scale = scale / jnp.maximum(valid.sum(), 1)
+    elif norm == "valid":
+        scale = scale / yi.size
+    if norm == "batch":
         scale = scale / yi.shape[0]
-    return nd.from_jax(p, ctx=z.context), \
-        nd.from_jax((p - onehot) * scale, ctx=z.context)
+    return out, nd.from_jax(jnp.moveaxis(g * scale, -1, axis),
+                            ctx=z.context)
 
 
 def _linreg_rule(z, y, attrs):
-    scale = float(attrs.get("grad_scale", 1.0))
+    if y is None:
+        return z, None
+    scale = _attr_f(attrs, "grad_scale", 1.0)
     return z, nd.from_jax((z._jax - y._jax.reshape(z.shape)) * scale,
                           ctx=z.context)
 
 
 def _maereg_rule(z, y, attrs):
-    scale = float(attrs.get("grad_scale", 1.0))
+    if y is None:
+        return z, None
+    scale = _attr_f(attrs, "grad_scale", 1.0)
     return z, nd.from_jax(
         jnp.sign(z._jax - y._jax.reshape(z.shape)) * scale, ctx=z.context)
 
 
 def _logreg_rule(z, y, attrs):
-    scale = float(attrs.get("grad_scale", 1.0))
+    scale = _attr_f(attrs, "grad_scale", 1.0)
     p = jax.nn.sigmoid(z._jax)
-    return nd.from_jax(p, ctx=z.context), \
-        nd.from_jax((p - y._jax.reshape(z.shape)) * scale, ctx=z.context)
+    out = nd.from_jax(p, ctx=z.context)
+    if y is None:
+        return out, None
+    return out, nd.from_jax((p - y._jax.reshape(z.shape)) * scale,
+                            ctx=z.context)
 
 
 _HEAD_RULES = {
@@ -254,7 +290,11 @@ class Module(BaseModule):
             rule = _HEAD_RULES.get(node.op)
             if rule is not None:
                 exec_heads.append(node.inputs[0])
-                self._head_rules.append((rule, node.attrs))
+                # label bound by VARIABLE NAME (node.inputs[1]), not head
+                # position — multi-head models feed each head its own label
+                label_name = node.inputs[1][0].name \
+                    if len(node.inputs) > 1 else None
+                self._head_rules.append((rule, node.attrs, label_name))
             else:
                 exec_heads.append((node, idx))
                 self._head_rules.append(None)
@@ -335,6 +375,15 @@ class Module(BaseModule):
         assert self.binded, "call bind before init_params"
         if self.params_initialized and not force_init:
             return
+        if not allow_extra:
+            extra = [k for k in (arg_params or {}) if k not in
+                     self._param_names]
+            extra += [k for k in (aux_params or {}) if k not in
+                      self._aux_names]
+            if extra:
+                raise MXNetError(
+                    "init_params/set_params got params not in the symbol: "
+                    "%s (pass allow_extra=True to ignore)" % extra)
         for name in self._param_names:
             arr = self._exec.arg_dict[name]
             if arg_params is not None and name in arg_params:
@@ -368,7 +417,7 @@ class Module(BaseModule):
                    force_init=True, allow_extra=False):
         self.init_params(initializer=None, arg_params=arg_params,
                          aux_params=aux_params, allow_missing=allow_missing,
-                         force_init=force_init)
+                         force_init=force_init, allow_extra=allow_extra)
 
     # -- optimizer ----------------------------------------------------------
     def init_optimizer(self, kvstore="local", optimizer="sgd",
@@ -405,21 +454,24 @@ class Module(BaseModule):
                     feeds[name] = arr
                 self._labels.append(arr)
         raw = self._exec.forward(is_train=is_train, **feeds)
-        # apply loss-output forward transforms; cache exact head grads
+        # apply loss-output forward transforms (always — predict without
+        # labels must still see probabilities); cache exact head grads
+        # when this head's label was fed
+        label_map = dict(zip(self._label_names, self._labels))
+        positional = list(self._labels)
         self._outputs = []
         self._head_grads = []
-        labels = list(self._labels)
         for z, rule in zip(raw, self._head_rules):
             if rule is None:
                 self._outputs.append(z)
                 self._head_grads.append(None)
                 continue
-            fn, attrs = rule
-            label = labels.pop(0) if labels else None
-            if label is None:
-                self._outputs.append(z)   # inference: no label, no grad
-                self._head_grads.append(None)
-                continue
+            fn, attrs, label_name = rule
+            label = label_map.get(label_name)
+            if label is not None:
+                positional = [l for l in positional if l is not label]
+            elif positional:                     # unnamed fallback
+                label = positional.pop(0)
             out, grad = fn(z, label, attrs)
             self._outputs.append(out)
             self._head_grads.append(grad)

@@ -8,6 +8,9 @@ DCN across slices.
 """
 from .mesh import (make_mesh, replicated, batch_sharded, shard_params_tp,
                    TrainStep, init_process_group)
+from .ring import (ring_attention, ulysses_attention,
+                   context_parallel_attention)
 
 __all__ = ["make_mesh", "replicated", "batch_sharded", "shard_params_tp",
-           "TrainStep", "init_process_group"]
+           "TrainStep", "init_process_group", "ring_attention",
+           "ulysses_attention", "context_parallel_attention"]

@@ -1,0 +1,158 @@
+"""Sequence/context parallelism: ring attention + all-to-all (Ulysses).
+
+No reference counterpart (SURVEY.md §5.7: the reference caps at
+single-device attention) — this is the TPU-native long-context layer the
+rebuild adds as first-class: sequences sharded over an 'sp' mesh axis so
+context length scales with the number of chips.
+
+Two standard schemes, both over ``shard_map``:
+
+* **Ring attention** (`ring_attention`): K/V blocks rotate around the sp
+  ring via ``ppermute`` while each device's Q stays put; partial attention
+  accumulates with the online-softmax (flash) recurrence, so the full
+  L×L score matrix never materializes and each hop's compute overlaps the
+  next hop's ICI transfer (XLA's latency-hiding scheduler).  Memory per
+  chip: O(L/n · L/n) per block instead of O(L²).
+* **Ulysses / all-to-all** (`ulysses_attention`): ``all_to_all`` swaps the
+  sharded axis from sequence to heads, runs exact local attention on full
+  sequences for H/n heads, and swaps back.  Cheaper at moderate L (two
+  all-to-alls), requires heads % n == 0.
+
+Both are differentiable (shard_map + collectives have transfer rules), so
+they drop into training steps; numerical equality against single-device
+attention is pinned by tests on the 8-device CPU mesh.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:                                   # jax >= 0.7 canonical location
+    from jax import shard_map
+except ImportError:                    # older: experimental alias
+    from jax.experimental.shard_map import shard_map
+
+__all__ = ["ring_attention", "ulysses_attention",
+           "context_parallel_attention"]
+
+
+def _block_attn(q, k, v, q_off, k_off, causal, scale):
+    """One (q-block × kv-block) partial flash step.
+
+    Returns (o_partial, m_block, l_block): unnormalized output, row max,
+    row sum for the online-softmax merge.  Shapes: q (B, Lq, H, D),
+    k/v (B, Lk, H, D).
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        qpos = q_off + jnp.arange(q.shape[1])
+        kpos = k_off + jnp.arange(k.shape[1])
+        mask = qpos[:, None] >= kpos[None, :]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    m = jnp.max(s, axis=-1)                       # (B, H, Lq)
+    # all-masked rows: exp(-inf - -inf) = nan; pin m to 0 there
+    m = jnp.where(jnp.isneginf(m), 0.0, m)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)                       # (B, H, Lq)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v)       # unnormalized
+    return o, m, l
+
+
+def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = False,
+                   scale: Optional[float] = None):
+    """Ring attention over the ``axis_name`` collective axis.
+
+    Call INSIDE shard_map with q/k/v sequence-sharded on that axis:
+    q, k, v: (B, L_local, H, D).  Returns (B, L_local, H, D).
+    """
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    lq = q.shape[1]
+    lk = k.shape[1]
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    q_off = idx * lq
+
+    def body(t, carry):
+        o, m, l, kt, vt = carry
+        # block t originated on device (idx - t) mod n
+        src = (idx - t) % n
+        ob, mb, lb = _block_attn(q, kt, vt, q_off, src * lk, causal, scale)
+        # online-softmax merge of (o, m, l) with the new block
+        m_new = jnp.maximum(m, mb)
+        alpha = jnp.exp(m - m_new)                # rescale old accumulator
+        beta = jnp.exp(mb - m_new)
+        l_new = l * alpha + lb * beta
+        o_new = o * alpha.transpose(0, 2, 1)[..., None] + \
+            ob * beta.transpose(0, 2, 1)[..., None]
+        # rotate K/V around the ring for the next step
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        kt = lax.ppermute(kt, axis_name, perm)
+        vt = lax.ppermute(vt, axis_name, perm)
+        return o_new, m_new, l_new, kt, vt
+
+    o0 = jnp.zeros(q.shape, jnp.promote_types(q.dtype, jnp.float32))
+    m0 = jnp.full((q.shape[0], q.shape[2], lq), -jnp.inf)
+    l0 = jnp.zeros((q.shape[0], q.shape[2], lq))
+    # the loop body makes these device-varying over sp (they depend on
+    # axis_index); mark the initial carry to match (shard_map vma typing)
+    if hasattr(lax, "pcast"):
+        o0, m0, l0 = (lax.pcast(x, (axis_name,), to="varying")
+                      for x in (o0, m0, l0))
+    else:
+        o0, m0, l0 = (lax.pvary(x, (axis_name,)) for x in (o0, m0, l0))
+    o, m, l, _, _ = lax.fori_loop(0, n, body, (o0, m0, l0,
+                                               k.astype(o0.dtype),
+                                               v.astype(o0.dtype)))
+    l = jnp.maximum(l, 1e-38)                     # fully-masked rows
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, *, axis_name: str = "sp",
+                      causal: bool = False, scale: Optional[float] = None):
+    """DeepSpeed-Ulysses SP: all_to_all seq-shard → head-shard, exact local
+    attention over the FULL sequence on H/n heads, all_to_all back.
+
+    Call INSIDE shard_map; q/k/v (B, L_local, H, D) with H % n == 0.
+    """
+    n = lax.psum(1, axis_name)
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+
+    def seq_to_heads(x):
+        # (B, L/n, H, D) -> (B, L, H/n, D): gather seq, scatter heads
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    o, m, l = _block_attn(qh.astype(jnp.float32), kh.astype(jnp.float32),
+                          vh.astype(jnp.float32), 0, 0, causal, scale)
+    out = o / jnp.maximum(l, 1e-38).transpose(0, 2, 1)[..., None]
+    return heads_to_seq(out.astype(q.dtype))
+
+
+def context_parallel_attention(q, k, v, mesh: Mesh, *, sp_axis: str = "sp",
+                               causal: bool = False, method: str = "ring",
+                               scale: Optional[float] = None):
+    """User-facing wrapper: shard q/k/v (B, L, H, D) over ``sp_axis`` on
+    dim 1 and run the chosen SP attention.  Output sharding matches input.
+    """
+    fn = {"ring": ring_attention, "ulysses": ulysses_attention}[method]
+    spec = P(None, sp_axis, None, None)
+    inner = functools.partial(fn, axis_name=sp_axis, causal=causal,
+                              scale=scale)
+    mapped = shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec)
+    sharding = NamedSharding(mesh, spec)
+    q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
+    return mapped(q, k, v)

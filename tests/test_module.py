@@ -227,3 +227,73 @@ def test_infer_shape_loss_label_rule():
     shapes = dict(zip(s.list_arguments(), args))
     assert shapes["softmax_label"] == (10,)
     assert outs == [(10, 3)]
+
+
+def test_predict_without_labels_applies_transform():
+    """Inference with a label-free iterator must still return probabilities."""
+    mod = Module(_mlp_softmax(), context=mx.cpu())
+    X, Y = _toy_classification(n=32)
+    train = mio.NDArrayIter(X, Y, batch_size=8)
+    mod.fit(train, optimizer="sgd", num_epoch=1)
+    unlabeled = mio.NDArrayIter(X, batch_size=8)
+    preds = mod.predict(unlabeled)
+    np.testing.assert_allclose(preds.asnumpy().sum(axis=1), 1.0, rtol=1e-4)
+
+
+def test_multi_head_labels_matched_by_name():
+    """Each loss head must get ITS label, not the positional one."""
+    data = sym.Variable("data")
+    h1 = sym.LinearRegressionOutput(data, sym.Variable("lab_a"))
+    h2 = sym.LinearRegressionOutput(data * 2.0, sym.Variable("lab_b"))
+    group = sym.Group([h1, h2])
+    # label_names deliberately in the OPPOSITE order of the heads
+    mod = Module(group, label_names=("lab_b", "lab_a"), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (3, 2))],
+             label_shapes=[("lab_b", (3, 2)), ("lab_a", (3, 2))],
+             inputs_need_grad=True)
+    mod.init_params()
+    x = np.ones((3, 2), np.float32)
+    la = np.zeros((3, 2), np.float32)         # head1 target
+    lb = np.full((3, 2), 2.0, np.float32)     # head2 target (2x - 2 = 0)
+    batch = mio.DataBatch(data=[mx.nd.array(x)],
+                          label=[mx.nd.array(lb), mx.nd.array(la)])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    # dL/dx = (x - la) + 2*(2x - lb) = 1 + 2*0 = 1 everywhere
+    np.testing.assert_allclose(mod.get_input_grads()[0].asnumpy(), 1.0,
+                               atol=1e-6)
+
+
+def test_softmax_output_nd_with_ignore_label():
+    data = sym.Variable("data")
+    out = sym.SoftmaxOutput(data, sym.Variable("softmax_label"),
+                            multi_output=True, use_ignore=True,
+                            ignore_label=-1.0, normalization="valid")
+    mod = Module(out, context=mx.cpu())
+    B, C, T = 2, 4, 3
+    mod.bind(data_shapes=[("data", (B, C, T))],
+             label_shapes=[("softmax_label", (B, T))], inputs_need_grad=True)
+    mod.init_params()
+    z = np.random.RandomState(0).randn(B, C, T).astype(np.float32)
+    y = np.array([[0, -1, 2], [-1, 3, 1]], np.float32)
+    batch = mio.DataBatch(data=[mx.nd.array(z)], label=[mx.nd.array(y)])
+    mod.forward(batch, is_train=True)
+    p = mod.get_outputs()[0].asnumpy()
+    np.testing.assert_allclose(p.sum(axis=1), 1.0, rtol=1e-5)  # class axis 1
+    mod.backward()
+    g = mod.get_input_grads()[0].asnumpy()
+    assert np.abs(g[0, :, 1]).sum() == 0     # ignored positions: zero grad
+    assert np.abs(g[1, :, 0]).sum() == 0
+    assert np.abs(g[0, :, 0]).sum() > 0
+
+
+def test_set_params_rejects_extra():
+    mod = Module(_mlp_softmax(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 8))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(mx.init.Xavier())
+    arg, aux = mod.get_params()
+    arg["bogus_weight"] = arg["fc1_weight"]
+    with pytest.raises(mx.MXNetError, match="bogus_weight"):
+        mod.set_params(arg, aux)
+    mod.set_params(arg, aux, allow_extra=True)  # explicit opt-out works
