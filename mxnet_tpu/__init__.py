@@ -20,7 +20,9 @@ from .base import cpu_pinned_by_user as _cpu_pinned, pin_cpu as _pin_cpu
 if _cpu_pinned():
     _pin_cpu()
 from .device import (Context, Device, cpu, gpu, tpu, cpu_pinned, num_gpus,
-                     num_tpus, current_context, current_device)
+                     num_tpus, current_context, current_device,
+                     tpu_memory_info, gpu_memory_info)
+from . import runtime
 from . import engine
 from . import ops
 from . import ndarray

@@ -17,6 +17,7 @@ from typing import List, Optional
 import jax
 
 __all__ = ["Context", "cpu", "gpu", "tpu", "cpu_pinned", "num_gpus", "num_tpus",
+           "tpu_memory_info", "gpu_memory_info",
            "current_context", "current_device", "Device"]
 
 _ACCEL_PLATFORMS = ("tpu", "axon")  # axon = tunneled TPU platform name
@@ -174,3 +175,23 @@ def current_context() -> Context:
 
 
 current_device = current_context
+
+
+def tpu_memory_info(device_id: int = 0):
+    """(free, total) bytes on the accelerator (reference:
+    mx.context.gpu_memory_info → MXGetGPUMemoryInformation64).
+
+    Backed by the PJRT allocator's memory_stats; backends that expose no
+    stats (CPU) report (0, 0) — the reference raises there, but a soft
+    zero keeps monitoring loops portable across the fake-mesh tests.
+    """
+    ctx = Context("tpu", device_id)
+    stats = ctx.jax_device.memory_stats() or {}
+    total = stats.get("bytes_limit", 0)
+    used = stats.get("bytes_in_use", 0)
+    return (total - used, total)
+
+
+def gpu_memory_info(device_id: int = 0):
+    """Compatibility alias (reference name) for tpu_memory_info."""
+    return tpu_memory_info(device_id)

@@ -299,6 +299,12 @@ class Module(BaseModule):
                 exec_heads.append((node, idx))
                 self._head_rules.append(None)
         self._exec_symbol = _Sym(exec_heads)
+        # loss-head label variables are labels even when not declared in
+        # label_names (they're stripped with their head from the backbone)
+        head_labels = {r[2] for r in self._head_rules
+                       if r is not None and r[2] is not None}
+        self._param_names = [n for n in self._param_names
+                             if n not in head_labels]
         self._exec = None
         self._optimizer = None
         self._updater = None
@@ -470,7 +476,10 @@ class Module(BaseModule):
             label = label_map.get(label_name)
             if label is not None:
                 positional = [l for l in positional if l is not label]
-            elif positional:                     # unnamed fallback
+            elif label_name is None and positional:
+                # only an UNNAMED head may take a label positionally; a
+                # named head whose label wasn't fed runs in inference mode
+                # rather than silently training on another head's labels
                 label = positional.pop(0)
             out, grad = fn(z, label, attrs)
             self._outputs.append(out)

@@ -34,7 +34,19 @@ def init_process_group(coordinator_address: Optional[str] = None,
                        num_processes: Optional[int] = None,
                        process_id: Optional[int] = None):
     """Multi-host process group over DCN (reference role: ps-lite
-    Postoffice::Start + DMLC_* env; here jax.distributed.initialize)."""
+    Postoffice::Start + DMLC_* env; here jax.distributed.initialize).
+
+    Arguments default from the env contract tools/launch.py sets
+    (MX_COORDINATOR / MX_NUM_PROCESSES / MX_PROCESS_ID), the way the
+    reference workers read DMLC_PS_ROOT_URI & co from their tracker.
+    """
+    import os
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("MX_COORDINATOR")
+    if num_processes is None and os.environ.get("MX_NUM_PROCESSES"):
+        num_processes = int(os.environ["MX_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("MX_PROCESS_ID"):
+        process_id = int(os.environ["MX_PROCESS_ID"])
     jax.distributed.initialize(coordinator_address, num_processes, process_id)
 
 

@@ -122,6 +122,10 @@ def ulysses_attention(q, k, v, *, axis_name: str = "sp",
     Call INSIDE shard_map; q/k/v (B, L_local, H, D) with H % n == 0.
     """
     n = lax.psum(1, axis_name)
+    if q.shape[2] % n != 0:
+        raise ValueError(
+            "ulysses_attention: heads (%d) must divide by the %r axis size "
+            "(%d); use ring_attention otherwise" % (q.shape[2], axis_name, n))
     if scale is None:
         scale = q.shape[-1] ** -0.5
 
@@ -135,10 +139,13 @@ def ulysses_attention(q, k, v, *, axis_name: str = "sp",
                               tiled=True)
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    o, m, l = _block_attn(qh.astype(jnp.float32), kh.astype(jnp.float32),
-                          vh.astype(jnp.float32), 0, 0, causal, scale)
-    out = o / jnp.maximum(l, 1e-38).transpose(0, 2, 1)[..., None]
-    return heads_to_seq(out.astype(q.dtype))
+    # local exact attention through the shared dispatch: blockwise Pallas
+    # flash on TPU (no L×L materialization); jnp fallback elsewhere
+    from ..ops.attention import attention_core
+    out = attention_core(qh.transpose(0, 2, 1, 3), kh.transpose(0, 2, 1, 3),
+                         vh.transpose(0, 2, 1, 3), scale=scale,
+                         causal=causal)
+    return heads_to_seq(out.transpose(0, 2, 1, 3).astype(q.dtype))
 
 
 def context_parallel_attention(q, k, v, mesh: Mesh, *, sp_axis: str = "sp",

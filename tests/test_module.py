@@ -297,3 +297,22 @@ def test_set_params_rejects_extra():
     with pytest.raises(mx.MXNetError, match="bogus_weight"):
         mod.set_params(arg, aux)
     mod.set_params(arg, aux, allow_extra=True)  # explicit opt-out works
+
+
+def test_named_head_without_label_stays_inference():
+    """A named loss head whose label is not fed must NOT steal another
+    head's label positionally."""
+    data = sym.Variable("data")
+    h1 = sym.LinearRegressionOutput(data, sym.Variable("reg_label"))
+    h2 = sym.SoftmaxOutput(data * 1.0, sym.Variable("softmax_label"))
+    mod = Module(sym.Group([h1, h2]), label_names=("softmax_label",),
+                 context=mx.cpu())
+    mod.bind(data_shapes=[("data", (2, 3))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params()
+    batch = mio.DataBatch(data=[mx.nd.array(np.ones((2, 3), np.float32))],
+                          label=[mx.nd.array(np.array([0, 1], np.float32))])
+    mod.forward(batch, is_train=True)
+    # reg head got no label -> no cached grad; softmax head has one
+    assert mod._head_grads[0] is None
+    assert mod._head_grads[1] is not None

@@ -243,3 +243,11 @@ def test_ring_attention_long_sequence_sp2():
                                      jnp.asarray(q), mesh, causal=True)
     ref = _ref_attention(q, q, q, True)
     np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-5)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    from mxnet_tpu.parallel import make_mesh, context_parallel_attention
+    mesh = make_mesh(axes=("sp",))  # sp=8
+    q = jnp.zeros((1, 16, 6, 4), jnp.float32)  # 6 heads % 8 != 0
+    with pytest.raises(Exception, match="heads"):
+        context_parallel_attention(q, q, q, mesh, method="ulysses")

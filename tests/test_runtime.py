@@ -1,0 +1,121 @@
+"""Runtime features, memory info, launcher, multi-process init.
+
+Reference pattern: tests/python/unittest/test_runtime.py (feature_list/
+is_enabled) and the §4.5 trick of exercising distributed wiring with local
+processes (tests/nightly/dist_sync_kvstore.py's launcher pattern).
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import runtime
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_feature_list_and_is_enabled():
+    feats = runtime.feature_list()
+    names = {f.name for f in feats}
+    assert {"XLA", "BF16", "RECORDIO", "PROFILER", "DIST_KVSTORE"} <= names
+    fs = runtime.features()
+    assert fs.is_enabled("XLA") is True
+    assert fs.is_enabled("CUDA") is False          # TPU build
+    assert fs.is_enabled("xla") is True            # case-insensitive
+    with pytest.raises(RuntimeError):
+        fs.is_enabled("NO_SUCH_FEATURE")
+    assert "✔" in repr(fs["XLA"])
+
+
+def test_native_recordio_feature_reflects_build():
+    fs = runtime.features()
+    from mxnet_tpu import recordio
+    assert fs.is_enabled("NATIVE_RECORDIO") == \
+        (recordio._get_lib() is not None)
+
+
+def test_memory_info_soft_zero_on_cpu():
+    free, total = mx.tpu_memory_info(0)
+    assert free >= 0 and total >= 0      # CPU backend: no stats -> (0, 0)
+    assert mx.gpu_memory_info(0) == (free, total)
+
+
+def test_launch_local_sets_env_contract(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys
+        rank = os.environ["MX_PROCESS_ID"]
+        n = os.environ["MX_NUM_PROCESSES"]
+        coord = os.environ["MX_COORDINATOR"]
+        assert os.environ["DMLC_ROLE"] == "worker"
+        assert os.environ["DMLC_NUM_WORKER"] == n
+        print("rank %s/%s at %s" % (rank, n, coord), flush=True)
+    """))
+    r = subprocess.run([sys.executable,
+                        os.path.join(REPO, "tools", "launch.py"),
+                        "-n", "2", "--launcher", "local", "--",
+                        sys.executable, str(script)],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    out = r.stdout
+    assert "rank 0/2" in out and "rank 1/2" in out
+
+
+def test_launch_manual_prints_plan():
+    r = subprocess.run([sys.executable,
+                        os.path.join(REPO, "tools", "launch.py"),
+                        "-n", "3", "--launcher", "manual", "--",
+                        "python", "train.py"],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0
+    assert r.stdout.count("MX_PROCESS_ID") == 3
+
+
+def test_init_process_group_two_processes(tmp_path):
+    """SURVEY §4.5: real 2-process jax.distributed init on localhost —
+    the multi-host wiring the reference tests with local PS processes."""
+    script = tmp_path / "dist_worker.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["MX_FORCE_CPU"] = "1"
+        sys.path.insert(0, %r)
+        import mxnet_tpu as mx           # pins cpu before backend init
+        from mxnet_tpu.parallel import init_process_group
+        init_process_group()             # reads MX_* env from launch.py
+        import jax
+        assert jax.process_count() == 2, jax.process_count()
+        assert len(jax.devices()) == 2   # one cpu device per process
+        from jax.experimental import multihost_utils
+        import numpy as np
+        mine = np.array([float(jax.process_index())], np.float32)
+        every = multihost_utils.process_allgather(mine)
+        assert sorted(every.ravel().tolist()) == [0.0, 1.0], every
+        print("dist ok rank", jax.process_index(), flush=True)
+    """) % REPO)
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)           # forced 8-dev count breaks pairing
+    r = subprocess.run([sys.executable,
+                        os.path.join(REPO, "tools", "launch.py"),
+                        "-n", "2", "--launcher", "local", "--",
+                        sys.executable, str(script)],
+                       capture_output=True, text=True, timeout=300,
+                       env=env)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "dist ok rank 0" in r.stdout and "dist ok rank 1" in r.stdout
+
+
+def test_launch_preserves_inner_separator(tmp_path):
+    script = tmp_path / "echoargs.py"
+    script.write_text("import sys; print('ARGS:' + '|'.join(sys.argv[1:]))")
+    r = subprocess.run([sys.executable,
+                        os.path.join(REPO, "tools", "launch.py"),
+                        "-n", "1", "--launcher", "local", "--",
+                        sys.executable, str(script), "--", "--data", "x"],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0
+    assert "ARGS:--|--data|x" in r.stdout

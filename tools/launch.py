@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""launch.py — start a multi-process / multi-host training job.
+
+Reference: ``tools/launch.py`` + ``3rdparty/ps-lite/tracker``
+(dmlc_tracker.local/ssh — spawn workers+servers with DMLC_* envs).
+
+TPU-native contract: there are no parameter servers — every process is a
+jax.distributed worker; ``mxnet_tpu.parallel.init_process_group()``
+(called by the training script, or implicitly via MX_DIST_AUTO_INIT) reads
+the env this launcher sets:
+
+  MX_COORDINATOR    host:port of process 0
+  MX_NUM_PROCESSES  world size
+  MX_PROCESS_ID     this process's rank
+
+Modes:
+  -n N --launcher local  : N processes on this host (separate CPU backends;
+                           for pipeline/io testing — real multi-chip needs
+                           one process per host)
+  -n N --launcher ssh -H hostfile : one process per hostfile line via ssh
+  --launcher manual      : print the per-rank environment + command
+
+Example:
+  python tools/launch.py -n 2 --launcher local -- python train.py --kv dist
+"""
+import argparse
+import os
+import shlex
+import socket
+import subprocess
+import sys
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _env_for(rank: int, coordinator: str, n: int):
+    env = dict(os.environ)
+    env.update({
+        "MX_COORDINATOR": coordinator,
+        "MX_NUM_PROCESSES": str(n),
+        "MX_PROCESS_ID": str(rank),
+        # reference-era names, for scripts that read DMLC_*:
+        "DMLC_NUM_WORKER": str(n),
+        "DMLC_WORKER_ID": str(rank),
+        "DMLC_ROLE": "worker",
+    })
+    return env
+
+
+def launch_local(args, command):
+    coordinator = "127.0.0.1:%d" % _free_port()
+    procs = []
+    for rank in range(args.num_workers):
+        env = _env_for(rank, coordinator, args.num_workers)
+        procs.append(subprocess.Popen(command, env=env))
+    rc = 0
+    for p in procs:
+        rc = p.wait() or rc
+    return rc
+
+
+def launch_ssh(args, command):
+    hosts = []
+    with open(args.hostfile) as f:
+        for line in f:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                hosts.append(line.split()[0])
+    if len(hosts) < args.num_workers:
+        raise SystemExit("hostfile has %d hosts < -n %d"
+                         % (len(hosts), args.num_workers))
+    coordinator = "%s:%d" % (hosts[0], 43117)
+    procs = []
+    for rank in range(args.num_workers):
+        env = _env_for(rank, coordinator, args.num_workers)
+        exports = " ".join("%s=%s" % (k, shlex.quote(v))
+                           for k, v in env.items()
+                           if k.startswith(("MX_", "DMLC_", "JAX_")))
+        remote = "cd %s && env %s %s" % (
+            shlex.quote(os.getcwd()), exports,
+            " ".join(shlex.quote(c) for c in command))
+        procs.append(subprocess.Popen(["ssh", "-o",
+                                       "StrictHostKeyChecking=no",
+                                       hosts[rank], remote]))
+    rc = 0
+    for p in procs:
+        rc = p.wait() or rc
+    return rc
+
+
+def launch_manual(args, command):
+    coordinator = "<host0>:43117"
+    for rank in range(args.num_workers):
+        env = {"MX_COORDINATOR": coordinator,
+               "MX_NUM_PROCESSES": args.num_workers,
+               "MX_PROCESS_ID": rank}
+        exports = " ".join("%s=%s" % kv for kv in env.items())
+        print("rank %d:  env %s %s" % (rank, exports, " ".join(command)))
+    return 0
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("-n", "--num-workers", type=int, required=True)
+    p.add_argument("--launcher", default="local",
+                   choices=["local", "ssh", "manual"])
+    p.add_argument("-H", "--hostfile", default=None)
+    p.add_argument("command", nargs=argparse.REMAINDER)
+    args = p.parse_args()
+    command = args.command
+    if command and command[0] == "--":   # strip only the leading separator
+        command = command[1:]
+    if not command:
+        raise SystemExit("no command given")
+    if args.launcher == "local":
+        sys.exit(launch_local(args, command))
+    elif args.launcher == "ssh":
+        if not args.hostfile:
+            raise SystemExit("--launcher ssh needs -H hostfile")
+        sys.exit(launch_ssh(args, command))
+    else:
+        sys.exit(launch_manual(args, command))
+
+
+if __name__ == "__main__":
+    main()
