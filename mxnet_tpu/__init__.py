@@ -33,6 +33,8 @@ from .ndarray import NDArray, waitall
 
 from . import amp
 from . import profiler
+from . import numpy as np
+from . import npx
 from . import recordio
 from . import io
 from . import image

@@ -1,0 +1,111 @@
+"""mx.npx — numpy-mode operator extensions (2.x era).
+
+Reference: ``python/mxnet/ndarray/numpy_extension/_op.py`` + the
+``mxnet.npx`` namespace (set_np/reset_np, activation/layer ops, data ops).
+
+``set_np()`` in the reference flips global array-semantics switches; this
+rebuild has numpy semantics natively (one array type over jax), so the
+switches only record intent for code that asserts on them.
+"""
+from __future__ import annotations
+
+from .ndarray.ndarray import invoke, NDArray
+
+__all__ = ["set_np", "reset_np", "is_np_array", "is_np_shape",
+           "use_np", "use_np_array", "use_np_shape"]
+
+_np_array = False
+_np_shape = False
+
+
+def set_np(shape=True, array=True, dtype=None):
+    """Reference: npx.set_np — enable numpy semantics (native here)."""
+    global _np_array, _np_shape
+    _np_array = array
+    _np_shape = shape
+
+
+def reset_np():
+    set_np(shape=False, array=False)
+
+
+def is_np_array() -> bool:
+    return _np_array
+
+
+def is_np_shape() -> bool:
+    return _np_shape
+
+
+def use_np(func_or_cls):
+    """Decorator form (reference: npx.use_np) — a no-op marker here."""
+    return func_or_cls
+
+
+use_np_array = use_np
+use_np_shape = use_np
+
+
+def _op(op_name, pyname=None):
+    def f(*args, **kwargs):
+        return invoke(op_name, *args, **kwargs)
+    f.__name__ = pyname or op_name.lstrip("_").lower()
+    f.__doc__ = "npx.%s — registry op %r" % (f.__name__, op_name)
+    return f
+
+
+# activation / nn ops (reference: npx.activation, npx.softmax, ...)
+activation = _op("Activation", "activation")
+relu = _op("relu")
+sigmoid = _op("sigmoid")
+log_sigmoid = _op("log_sigmoid")
+softmax = _op("softmax")
+log_softmax = _op("log_softmax")
+masked_softmax = _op("masked_softmax")
+masked_log_softmax = _op("masked_log_softmax")
+leaky_relu = _op("LeakyReLU", "leaky_relu")
+gelu = _op("gelu")
+batch_norm = _op("BatchNorm", "batch_norm")
+layer_norm = _op("LayerNorm", "layer_norm")
+group_norm = _op("GroupNorm", "group_norm")
+instance_norm = _op("InstanceNorm", "instance_norm")
+l2_normalization = _op("L2Normalization", "l2_normalization")
+convolution = _op("Convolution", "convolution")
+deconvolution = _op("Deconvolution", "deconvolution")
+pooling = _op("Pooling", "pooling")
+fully_connected = _op("FullyConnected", "fully_connected")
+embedding = _op("Embedding", "embedding")
+dropout = _op("Dropout", "dropout")
+rnn = _op("RNN", "rnn")
+multi_head_attention = _op("multi_head_attention")
+ctc_loss = _op("CTCLoss", "ctc_loss")
+smooth_l1 = _op("smooth_l1")
+# data / indexing ops
+topk = _op("topk")
+pick = _op("pick")
+one_hot = _op("one_hot")
+gather_nd = _op("gather_nd")
+scatter_nd = _op("scatter_nd")
+batch_dot = _op("batch_dot")
+sequence_mask = _op("sequence_mask")
+shape_array = _op("shape_array")
+boolean_mask = _op("boolean_mask")
+# casting / misc
+cast = _op("Cast", "cast")
+amp_cast = _op("amp_cast")
+
+
+def load(fname):
+    """npx.load — dict of arrays (reference: npx.load)."""
+    from . import ndarray as nd
+    return nd.load(fname)
+
+
+def save(fname, data):
+    from . import ndarray as nd
+    return nd.save(fname, data)
+
+
+def waitall():
+    from .ndarray import waitall as _w
+    _w()

@@ -1,0 +1,466 @@
+"""mx.np — the NumPy-compatible array API (2.x era).
+
+Reference: ``python/mxnet/numpy/multiarray.py`` (mx.np.ndarray + the numpy
+function surface) and ``python/mxnet/numpy/linalg.py``/``random.py``.
+
+Design decision (TPU-first): the reference maintains TWO array types —
+legacy ``mx.nd.NDArray`` and ``mx.np.ndarray`` — because its C++ storage
+distinguishes legacy ops from numpy-semantics ops.  This rebuild has one
+substrate (jax.Array) whose semantics ARE numpy's, so ``mx.np`` exposes
+the numpy function surface over the SAME array type as ``mx.nd``
+(``mx.np.ndarray is mx.nd.NDArray``).  Code written against either API
+interoperates; ``npx.set_np()`` is a compatibility flag, not a mode
+switch.
+
+Functions whose MXNet op exists route through the op registry (per-op jit
+cache, autograd tape); the numpy-only tail wraps jnp directly — still
+traced/differentiated when recording, because recording happens at the
+``invoke`` layer for registry ops and these wrappers stay out of autograd
+(matching the reference, where mx.np creation/query ops are not
+differentiable either).
+"""
+from __future__ import annotations
+
+import sys
+from types import ModuleType
+from typing import Any
+
+import numpy as _onp
+import jax
+import jax.numpy as jnp
+
+from ..ndarray.ndarray import NDArray, invoke, from_jax, array as _nd_array
+from ..ndarray import ndarray as _nd
+from ..device import current_context
+
+ndarray = NDArray          # one array type (see module docstring)
+
+pi = _onp.pi
+e = _onp.e
+inf = _onp.inf
+nan = _onp.nan
+newaxis = None
+euler_gamma = _onp.euler_gamma
+
+float32 = _onp.float32
+float64 = _onp.float64
+float16 = _onp.float16
+int8 = _onp.int8
+int32 = _onp.int32
+int64 = _onp.int64
+uint8 = _onp.uint8
+bool_ = _onp.bool_
+
+
+# -- helpers ------------------------------------------------------------------
+
+def _unwrap(x):
+    if isinstance(x, NDArray):
+        return x._jax
+    if isinstance(x, (list, tuple)):
+        return type(x)(_unwrap(v) for v in x)
+    return x
+
+
+def _wrap(out, ctx=None):
+    if isinstance(out, (list, tuple)):
+        return type(out)(_wrap(o, ctx) for o in out)
+    if hasattr(out, "dtype") and hasattr(out, "shape"):
+        return from_jax(jnp.asarray(out), ctx=ctx or current_context())
+    return out
+
+
+def _jnp_fn(jfn):
+    def f(*args, **kwargs):
+        return _wrap(jfn(*[_unwrap(a) for a in args],
+                         **{k: _unwrap(v) for k, v in kwargs.items()}))
+    f.__name__ = jfn.__name__
+    f.__doc__ = "mx.np.%s — numpy-compatible wrapper over jnp.%s" % (
+        jfn.__name__, jfn.__name__)
+    return f
+
+
+def _op_fn(op_name, pyname=None):
+    def f(*args, **kwargs):
+        return invoke(op_name, *args, **kwargs)
+    f.__name__ = pyname or op_name
+    return f
+
+
+# -- creation -----------------------------------------------------------------
+
+def array(object, dtype=None, ctx=None, device=None):
+    return _nd_array(object, ctx=ctx or device, dtype=dtype)
+
+
+def zeros(shape, dtype=float32, ctx=None, device=None, order="C"):
+    return _nd.zeros(shape, ctx=ctx or device, dtype=dtype)
+
+
+def ones(shape, dtype=float32, ctx=None, device=None, order="C"):
+    return _nd.ones(shape, ctx=ctx or device, dtype=dtype)
+
+
+def full(shape, fill_value, dtype=None, ctx=None, device=None):
+    return _nd.full(shape, fill_value, ctx=ctx or device, dtype=dtype)
+
+
+def empty(shape, dtype=float32, ctx=None, device=None):
+    return _nd.empty(shape, ctx=ctx or device, dtype=dtype)
+
+
+def arange(start, stop=None, step=1, dtype=None, ctx=None, device=None):
+    return _nd.arange(start, stop, step, dtype=dtype, ctx=ctx or device)
+
+
+def linspace(start, stop, num=50, endpoint=True, retstep=False, dtype=None,
+             axis=0, ctx=None, device=None):
+    return _wrap(jnp.linspace(start, stop, num, endpoint=endpoint,
+                              retstep=retstep, dtype=dtype, axis=axis),
+                 ctx=ctx or device)
+
+
+def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None,
+             ctx=None, device=None):
+    return _wrap(jnp.logspace(start, stop, num, endpoint=endpoint,
+                              base=base, dtype=dtype), ctx=ctx or device)
+
+
+def eye(N, M=None, k=0, dtype=float32, ctx=None, device=None):
+    return _wrap(jnp.eye(N, M, k, dtype=dtype), ctx=ctx or device)
+
+
+identity = lambda n, dtype=float32, **kw: eye(n, dtype=dtype)
+identity.__name__ = "identity"
+
+
+def _src_ctx(a):
+    return a.context if isinstance(a, NDArray) else None
+
+
+def zeros_like(a, dtype=None):
+    return invoke("zeros_like_op", a) if dtype is None else \
+        _wrap(jnp.zeros_like(_unwrap(a), dtype=dtype), ctx=_src_ctx(a))
+
+
+def ones_like(a, dtype=None):
+    return invoke("ones_like_op", a) if dtype is None else \
+        _wrap(jnp.ones_like(_unwrap(a), dtype=dtype), ctx=_src_ctx(a))
+
+
+def full_like(a, fill_value, dtype=None):
+    return _wrap(jnp.full_like(_unwrap(a), fill_value, dtype=dtype),
+                 ctx=_src_ctx(a))
+
+
+def copy(a):
+    return a.copy()
+
+
+def ascontiguousarray(a, dtype=None):
+    return array(a, dtype=dtype)
+
+
+asarray = array
+
+
+# -- elementwise math: registry-backed (taped + jit-cached) --------------------
+
+_REGISTRY_FUNCS = {
+    # numpy name: op name
+    "add": "broadcast_add", "subtract": "broadcast_sub",
+    "multiply": "broadcast_mul", "divide": "broadcast_div",
+    "true_divide": "broadcast_div", "mod": "broadcast_mod",
+    "remainder": "broadcast_mod", "power": "broadcast_power",
+    "maximum": "broadcast_maximum", "minimum": "broadcast_minimum",
+    "hypot": "broadcast_hypot",
+    "equal": "broadcast_equal", "not_equal": "broadcast_not_equal",
+    "greater": "broadcast_greater", "less": "broadcast_lesser",
+    "greater_equal": "broadcast_greater_equal",
+    "less_equal": "broadcast_lesser_equal",
+    "logical_and": "broadcast_logical_and",
+    "logical_or": "broadcast_logical_or",
+    "logical_xor": "broadcast_logical_xor",
+    "logical_not": "logical_not",
+    "negative": "negative", "reciprocal": "reciprocal",
+    "exp": "exp", "expm1": "expm1", "log": "log", "log2": "log2",
+    "log10": "log10", "log1p": "log1p", "sqrt": "sqrt", "cbrt": "cbrt",
+    "square": "square", "abs": "abs", "absolute": "abs", "fabs": "abs",
+    "sign": "sign", "rint": "rint", "fix": "fix", "floor": "floor",
+    "ceil": "ceil", "trunc": "trunc", "round": "round",
+    "sin": "sin", "cos": "cos", "tan": "tan", "arcsin": "arcsin",
+    "arccos": "arccos", "arctan": "arctan", "arctan2": "arctan2",
+    "sinh": "sinh", "cosh": "cosh", "tanh": "tanh", "arcsinh": "arcsinh",
+    "arccosh": "arccosh", "arctanh": "arctanh",
+    "degrees": "degrees", "radians": "radians",
+    "copysign": "copysign", "ldexp": "ldexp", "logaddexp": "logaddexp",
+    "isnan": "isnan", "isinf": "isinf", "isfinite": "isfinite",
+    "sinc": "sinc", "i0": "i0", "nan_to_num": "nan_to_num",
+    "heaviside": "heaviside", "interp": "interp",
+    "bitwise_and": "bitwise_and", "bitwise_or": "bitwise_or",
+    "bitwise_xor": "bitwise_xor", "bitwise_not": "bitwise_not",
+    "invert": "bitwise_not",
+    "left_shift": "bitwise_left_shift", "right_shift": "bitwise_right_shift",
+    "lcm": None, "gcd": None,  # handled by jnp fallback below
+    # reductions / scans
+    "sum": "sum", "prod": "prod", "mean": "mean", "std": "std", "var": "var",
+    "min": "min", "max": "max", "argmin": "argmin", "argmax": "argmax",
+    "cumsum": "cumsum", "cumprod": "cumprod", "nansum": "nansum",
+    "nanprod": "nanprod", "ptp": "ptp", "median": "median",
+    "percentile": None, "quantile": None, "average": "average",
+    "all": None, "any": None,
+    # shape / indexing
+    "reshape": None, "transpose": None, "swapaxes": None,
+    "expand_dims": None, "squeeze": None,
+    "broadcast_to": None, "repeat": None, "tile": None,
+    "flip": None, "roll": None, "rot90": None, "split": None,
+    "take": "take", "where": "where", "clip": None, "pad": None,
+    "diag": None, "diagonal": "diagonal", "tril": None, "triu": None,
+    "sort": "sort", "argsort": "argsort", "searchsorted": "searchsorted",
+    "histogram": None, "bincount": None, "digitize": "digitize",
+    "unravel_index": "unravel_index", "ravel_multi_index": "ravel_multi_index",
+    "atleast_1d": "atleast_1d", "atleast_2d": "atleast_2d",
+    "atleast_3d": "atleast_3d",
+    # linear algebra
+    "dot": "dot", "einsum": None, "kron": "kron", "cross": "cross",
+    "trace": "trace_op", "outer": None, "inner": None, "matmul": None,
+    "tensordot": None, "vdot": None,
+}
+
+_this = sys.modules[__name__]
+for _pyname, _opname in _REGISTRY_FUNCS.items():
+    if _opname is not None:
+        setattr(_this, _pyname, _op_fn(_opname, _pyname))
+
+# jnp-backed tail (no registry op / different semantics)
+for _pyname in ["matmul", "tensordot", "inner", "outer", "vdot", "lcm",
+                "gcd", "all", "any", "meshgrid", "indices", "tril_indices",
+                "triu_indices", "unique", "ediff1d", "diff", "gradient",
+                "trapz", "nanmean", "nanstd", "nanvar", "nanmin", "nanmax",
+                "count_nonzero", "array_equal", "allclose", "isclose",
+                "float_power", "nextafter", "positive", "real", "imag",
+                "conj", "exp2", "signbit", "frexp", "deg2rad", "rad2deg",
+                "moveaxis", "ravel", "vstack", "hstack", "dstack",
+                "column_stack", "flipud", "fliplr", "append", "resize",
+                "insert", "delete", "polyval", "vander", "tri",
+                "fill_diagonal", "may_share_memory", "shares_memory"]:
+    if not hasattr(_this, _pyname) and hasattr(jnp, _pyname):
+        setattr(_this, _pyname, _jnp_fn(getattr(jnp, _pyname)))
+
+
+# numpy positional signatures that differ from the registry kwarg form
+def reshape(a, newshape, order="C"):
+    return invoke("reshape", a, shape=tuple(newshape) if
+                  not isinstance(newshape, int) else (newshape,))
+
+
+def transpose(a, axes=None):
+    return invoke("transpose", a, axes=tuple(axes) if axes is not None
+                  else None)
+
+
+def expand_dims(a, axis):
+    return invoke("expand_dims", a, axis=axis)
+
+
+def squeeze(a, axis=None):
+    return invoke("squeeze", a, axis=axis)
+
+
+def broadcast_to(a, shape):
+    return invoke("broadcast_to", a, shape=tuple(shape))
+
+
+def repeat(a, repeats, axis=None):
+    return invoke("repeat", a, repeats=repeats, axis=axis)
+
+
+def tile(a, reps):
+    return invoke("tile", a, reps=tuple(reps) if
+                  not isinstance(reps, int) else (reps,))
+
+
+def flip(a, axis=None):
+    if axis is None:
+        return _wrap(jnp.flip(_unwrap(a)))
+    return invoke("flip", a, axis=axis)
+
+
+def roll(a, shift, axis=None):
+    return invoke("roll", a, shift=shift, axis=axis)
+
+
+def rot90(a, k=1, axes=(0, 1)):
+    return invoke("rot90", a, k=k, axes=tuple(axes))
+
+
+def clip(a, a_min, a_max, out=None):
+    return invoke("clip", a, a_min=a_min, a_max=a_max)
+
+
+def pad(a, pad_width, mode="constant", constant_values=0.0, **kw):
+    # normalize numpy's forms — int, (b, a), ((b0,a0), (b1,a1), ...) — to
+    # the registry op's flat (b0, a0, b1, a1, ...) layout
+    nd_ = a.ndim
+    if isinstance(pad_width, int):
+        pairs = [(pad_width, pad_width)] * nd_
+    else:
+        pw = list(pad_width)
+        if pw and not isinstance(pw[0], (list, tuple)):
+            if len(pw) == 2:
+                pairs = [tuple(pw)] * nd_
+            else:
+                pairs = [(int(w), int(w)) for w in pw]
+        else:
+            pairs = [tuple(p) for p in pw]
+            if len(pairs) == 1:
+                pairs = pairs * nd_
+    flat = tuple(int(x) for p in pairs for x in p)
+    return invoke("pad", a, pad_width=flat, mode=mode,
+                  constant_value=constant_values)
+
+
+def diag(v, k=0):
+    return invoke("diag", v, k=k)
+
+
+def tril(m, k=0):
+    return invoke("tril", m, k=k)
+
+
+def triu(m, k=0):
+    return invoke("triu", m, k=k)
+
+
+def percentile(a, q, axis=None, keepdims=False, interpolation="linear"):
+    return invoke("percentile", a, q=q, axis=axis, keepdims=keepdims,
+                  interpolation=interpolation)
+
+
+def quantile(a, q, axis=None, keepdims=False, interpolation="linear"):
+    return invoke("quantile", a, q=q, axis=axis, keepdims=keepdims,
+                  interpolation=interpolation)
+
+
+def histogram(a, bins=10, range=None, weights=None, density=None):
+    if range is None:
+        a_np = a.asnumpy() if isinstance(a, NDArray) else _onp.asarray(a)
+        range = (float(a_np.min()), float(a_np.max()))
+    return invoke("histogram", a, bin_cnt=bins, range=tuple(range))
+
+
+def bincount(x, weights=None, minlength=0):
+    if minlength <= 0:
+        x_np = x.asnumpy() if isinstance(x, NDArray) else _onp.asarray(x)
+        minlength = int(x_np.max()) + 1 if x_np.size else 1
+    if weights is not None:
+        return invoke("bincount", x, weights, minlength=minlength)
+    return invoke("bincount", x, minlength=minlength)
+
+
+def einsum(subscripts, *operands, **kwargs):
+    return invoke("einsum", *operands, subscripts=subscripts)
+
+
+def split(ary, indices_or_sections, axis=0):
+    if isinstance(indices_or_sections, int):
+        return invoke("split_v2", ary, sections=indices_or_sections,
+                      axis=axis)
+    return invoke("split_v2", ary, indices=tuple(indices_or_sections),
+                  axis=axis)
+
+
+def concatenate(seq, axis=0, out=None):
+    if axis is None:   # numpy semantics: flatten everything first
+        seq = [invoke("flatten", s).reshape((-1,)) if isinstance(s, NDArray)
+               else _wrap(jnp.ravel(jnp.asarray(s))) for s in seq]
+        axis = 0
+    return invoke("concat", *seq, dim=axis)
+
+
+def stack(arrays, axis=0, out=None):
+    return _nd.stack_arrays(tuple(arrays), axis=axis)
+
+
+def shape(a):
+    return a.shape
+
+
+def ndim(a):
+    return a.ndim
+
+
+def size(a, axis=None):
+    return a.size if axis is None else a.shape[axis]
+
+
+def may_promote(*args):  # internal helper kept for API explorers
+    return _onp.result_type(*[getattr(a, "dtype", type(a)) for a in args])
+
+
+# -- submodules: np.linalg / np.random ----------------------------------------
+
+linalg = ModuleType(__name__ + ".linalg")
+linalg.norm = _op_fn("norm", "norm")
+linalg.inv = _op_fn("linalg_inverse", "inv")
+linalg.det = _op_fn("linalg_det", "det")
+linalg.slogdet = _op_fn("linalg_slogdet", "slogdet")
+linalg.cholesky = _op_fn("linalg_potrf", "cholesky")
+linalg.eigh = _op_fn("linalg_syevd", "eigh")
+linalg.svd = _jnp_fn(jnp.linalg.svd)
+linalg.qr = _jnp_fn(jnp.linalg.qr)
+linalg.solve = _jnp_fn(jnp.linalg.solve)
+linalg.lstsq = _jnp_fn(jnp.linalg.lstsq)
+linalg.matrix_rank = _jnp_fn(jnp.linalg.matrix_rank)
+linalg.pinv = _jnp_fn(jnp.linalg.pinv)
+linalg.eigvalsh = _jnp_fn(jnp.linalg.eigvalsh)
+sys.modules[linalg.__name__] = linalg
+
+random = ModuleType(__name__ + ".random")
+random.uniform = lambda low=0.0, high=1.0, size=None, dtype=None, ctx=None, \
+    device=None: invoke("_random_uniform", low=low, high=high,
+                        shape=size if size is not None else (),
+                        dtype=dtype or "float32")
+random.normal = lambda loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, \
+    device=None: invoke("_random_normal", loc=loc, scale=scale,
+                        shape=size if size is not None else (),
+                        dtype=dtype or "float32")
+random.randint = lambda low, high=None, size=None, dtype=None, ctx=None: \
+    invoke("_random_randint", low=low if high is not None else 0,
+           high=high if high is not None else low,
+           shape=size if size is not None else (),
+           dtype=dtype or "int32")
+random.rand = lambda *shape: random.uniform(size=shape or ())
+random.randn = lambda *shape: random.normal(size=shape or ())
+random.gamma = lambda shape_p=1.0, scale=1.0, size=None, **kw: \
+    invoke("_random_gamma", alpha=shape_p, beta=scale,
+           shape=size if size is not None else ())
+random.exponential = lambda scale=1.0, size=None, **kw: \
+    invoke("_random_exponential", lam=1.0 / scale,
+           shape=size if size is not None else ())
+def _shuffle_inplace(a):
+    a._set_jax(invoke("shuffle", a)._jax)
+
+
+random.shuffle = _shuffle_inplace
+random.choice = lambda a, size=None, replace=True, p=None, **kw: _wrap(
+    jax.random.choice(_np_random_key(), _unwrap(a) if
+                      isinstance(a, NDArray) else jnp.arange(a),
+                      shape=tuple(size) if isinstance(size, (list, tuple))
+                      else (() if size is None else (size,)),
+                      replace=replace, p=_unwrap(p) if p is not None else None))
+random.seed = None  # bound below to mx.random.seed
+sys.modules[random.__name__] = random
+
+
+def _np_random_key():
+    from ..ops import random as _rnd
+    return _rnd.next_key()
+
+
+def _bind_seed():
+    from .. import random as _mxrandom
+    random.seed = _mxrandom.seed
+
+
+_bind_seed()

@@ -1,0 +1,216 @@
+"""mx.np / mx.npx tests.
+
+Reference pattern: tests/python/unittest/test_numpy_op.py /
+test_numpy_ndarray.py — function-surface parity against real numpy,
+npx extensions, interop with autograd/gluon.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, npx, autograd
+from mxnet_tpu.gluon import nn
+
+R = onp.random.RandomState(42)
+
+
+def test_one_array_type():
+    assert np.ndarray is mx.nd.NDArray
+    a = np.array([[1.0, 2.0], [3.0, 4.0]])
+    assert isinstance(a, mx.nd.NDArray)
+
+
+def test_creation():
+    onp.testing.assert_array_equal(np.zeros((2, 3)).asnumpy(),
+                                   onp.zeros((2, 3), onp.float32))
+    onp.testing.assert_array_equal(np.ones(4).asnumpy(), onp.ones(4))
+    onp.testing.assert_array_equal(np.full((2,), 7.0).asnumpy(),
+                                   onp.full((2,), 7.0, onp.float32))
+    onp.testing.assert_array_equal(np.arange(5).asnumpy(), onp.arange(5))
+    onp.testing.assert_allclose(np.linspace(0, 1, 5).asnumpy(),
+                                onp.linspace(0, 1, 5), rtol=1e-6)
+    onp.testing.assert_array_equal(np.eye(3).asnumpy(), onp.eye(3))
+    a = np.array([1.0, 2.0])
+    onp.testing.assert_array_equal(np.zeros_like(a).asnumpy(), [0, 0])
+    onp.testing.assert_array_equal(np.ones_like(a).asnumpy(), [1, 1])
+
+
+UNARY = ["exp", "log1p", "sqrt", "square", "abs", "sign", "floor", "ceil",
+         "sin", "cos", "tanh", "arctan", "sinh", "log2", "expm1", "rint",
+         "isnan", "isfinite", "negative", "reciprocal", "cbrt", "radians"]
+
+
+@pytest.mark.parametrize("name", UNARY)
+def test_unary_matches_numpy(name):
+    x = R.uniform(0.2, 0.9, (3, 4)).astype(onp.float32)
+    got = getattr(np, name)(np.array(x)).asnumpy()
+    want = getattr(onp, name)(x)
+    onp.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)
+
+
+BINARY = ["add", "subtract", "multiply", "divide", "maximum", "minimum",
+          "power", "arctan2", "hypot", "logaddexp", "copysign",
+          "greater", "less_equal", "not_equal", "logical_and"]
+
+
+@pytest.mark.parametrize("name", BINARY)
+def test_binary_matches_numpy(name):
+    a = R.uniform(0.2, 0.9, (3, 4)).astype(onp.float32)
+    b = R.uniform(0.2, 0.9, (4,)).astype(onp.float32)   # broadcast
+    got = getattr(np, name)(np.array(a), np.array(b)).asnumpy()
+    want = getattr(onp, name)(a, b)
+    onp.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)
+
+
+REDUCE = ["sum", "mean", "std", "var", "min", "max", "prod"]
+
+
+@pytest.mark.parametrize("name", REDUCE)
+@pytest.mark.parametrize("axis", [None, 0, 1])
+def test_reductions(name, axis):
+    x = R.randn(4, 5).astype(onp.float32)
+    got = getattr(np, name)(np.array(x), axis=axis).asnumpy()
+    want = getattr(onp, name)(x, axis=axis)
+    onp.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_shape_ops():
+    x = R.randn(2, 3, 4).astype(onp.float32)
+    a = np.array(x)
+    onp.testing.assert_array_equal(np.reshape(a, (6, 4)).asnumpy(),
+                                   x.reshape(6, 4))
+    onp.testing.assert_array_equal(np.transpose(a, (2, 0, 1)).asnumpy(),
+                                   x.transpose(2, 0, 1))
+    onp.testing.assert_array_equal(np.expand_dims(a, 1).asnumpy(),
+                                   onp.expand_dims(x, 1))
+    onp.testing.assert_array_equal(np.concatenate([a, a], axis=2).asnumpy(),
+                                   onp.concatenate([x, x], axis=2))
+    onp.testing.assert_array_equal(np.stack([a, a], axis=0).asnumpy(),
+                                   onp.stack([x, x]))
+    onp.testing.assert_array_equal(np.flip(a, axis=1).asnumpy(),
+                                   onp.flip(x, 1))
+    onp.testing.assert_array_equal(np.moveaxis(a, 0, -1).asnumpy(),
+                                   onp.moveaxis(x, 0, -1))
+    onp.testing.assert_array_equal(np.ravel(a).asnumpy(), x.ravel())
+    onp.testing.assert_array_equal(
+        np.where(np.array(x > 0), a, -a).asnumpy(), onp.where(x > 0, x, -x))
+
+
+def test_linalg_and_matmul():
+    a = R.randn(3, 4).astype(onp.float32)
+    b = R.randn(4, 2).astype(onp.float32)
+    onp.testing.assert_allclose(np.dot(np.array(a), np.array(b)).asnumpy(),
+                                a @ b, rtol=1e-5)
+    onp.testing.assert_allclose(np.matmul(np.array(a), np.array(b)).asnumpy(),
+                                a @ b, rtol=1e-5)
+    onp.testing.assert_allclose(
+        np.einsum("ij,jk->ik", np.array(a), np.array(b)).asnumpy(),
+        a @ b, rtol=1e-5)
+    sq = a @ a.T + 3 * onp.eye(3, dtype=onp.float32)
+    onp.testing.assert_allclose(
+        np.linalg.inv(np.array(sq)).asnumpy(), onp.linalg.inv(sq),
+        rtol=1e-3, atol=1e-4)
+    onp.testing.assert_allclose(
+        np.linalg.norm(np.array(a)).asnumpy(), onp.linalg.norm(a),
+        rtol=1e-5)
+    onp.testing.assert_allclose(
+        np.linalg.cholesky(np.array(sq)).asnumpy(), onp.linalg.cholesky(sq),
+        rtol=1e-4, atol=1e-5)
+
+
+def test_random():
+    mx.random.seed(7)
+    u = np.random.uniform(0, 1, size=(100,))
+    assert u.shape == (100,)
+    assert 0 <= float(u.asnumpy().min()) and float(u.asnumpy().max()) <= 1
+    n = np.random.normal(0, 1, size=(500,))
+    assert abs(float(n.asnumpy().mean())) < 0.2
+    r = np.random.randint(0, 10, size=(50,))
+    assert set(r.asnumpy().tolist()) <= set(range(10))
+    assert np.random.randn(2, 3).shape == (2, 3)
+    mx.random.seed(7)
+    u2 = np.random.uniform(0, 1, size=(100,))
+    onp.testing.assert_array_equal(u.asnumpy(), u2.asnumpy())
+
+
+def test_np_arrays_flow_through_autograd_and_gluon():
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    x = np.random.normal(size=(2, 3))
+    with autograd.record():
+        y = net(x)
+        loss = np.sum(y * y)
+    loss.backward()
+    g = net.weight.grad()
+    assert g.shape == (4, 3)
+    assert float(np.abs(g).asnumpy().sum()) > 0
+
+
+def test_npx_set_np_and_ops():
+    npx.set_np()
+    assert npx.is_np_array() and npx.is_np_shape()
+    npx.reset_np()
+    assert not npx.is_np_array()
+    x = np.array(R.randn(2, 5).astype(onp.float32))
+    s = npx.softmax(x)
+    onp.testing.assert_allclose(s.asnumpy().sum(axis=1), 1.0, rtol=1e-5)
+    onp.testing.assert_allclose(npx.relu(x).asnumpy(),
+                                onp.maximum(x.asnumpy(), 0))
+    oh = npx.one_hot(np.array([0, 2]), depth=3)
+    onp.testing.assert_array_equal(oh.asnumpy(),
+                                   [[1, 0, 0], [0, 0, 1]])
+    k = npx.topk(x, k=2, axis=-1)
+    assert k.shape == (2, 2)
+
+
+def test_npx_save_load_roundtrip(tmp_path):
+    f = str(tmp_path / "arrs")
+    npx.save(f, {"a": np.ones((2, 2)), "b": np.arange(3)})
+    out = npx.load(f)
+    onp.testing.assert_array_equal(out["a"].asnumpy(), onp.ones((2, 2)))
+    onp.testing.assert_array_equal(out["b"].asnumpy(), onp.arange(3))
+
+
+# -- review-finding regressions ----------------------------------------------
+
+def test_pad_all_numpy_forms():
+    x = onp.arange(6, dtype=onp.float32).reshape(2, 3)
+    a = np.array(x)
+    for pw in [1, (1, 2), ((1, 1), (0, 2))]:
+        onp.testing.assert_array_equal(np.pad(a, pw).asnumpy(),
+                                       onp.pad(x, pw))
+    onp.testing.assert_array_equal(
+        np.pad(a, 1, constant_values=5.0).asnumpy(),
+        onp.pad(x, 1, constant_values=5.0))
+
+
+def test_histogram_and_bincount():
+    x = onp.array([0.1, 0.4, 0.4, 0.9], onp.float32)
+    counts, edges = np.histogram(np.array(x), bins=4, range=(0, 1))
+    c_ref, e_ref = onp.histogram(x, bins=4, range=(0, 1))
+    onp.testing.assert_array_equal(counts.asnumpy(), c_ref)
+    onp.testing.assert_allclose(edges.asnumpy(), e_ref, rtol=1e-6)
+    counts2, _ = np.histogram(np.array(x))  # range inferred from data
+    assert int(counts2.asnumpy().sum()) == 4
+    b = onp.array([0, 1, 1, 3], onp.int32)
+    onp.testing.assert_array_equal(np.bincount(np.array(b)).asnumpy(),
+                                   onp.bincount(b))
+
+
+def test_concatenate_axis_none_flattens():
+    a = np.ones((2, 2))
+    b = np.zeros((2, 2))
+    out = np.concatenate([a, b], axis=None)
+    assert out.shape == (8,)
+    onp.testing.assert_array_equal(out.asnumpy(),
+                                   onp.concatenate([onp.ones((2, 2)),
+                                                    onp.zeros((2, 2))],
+                                                   axis=None))
+
+
+def test_like_ctx_and_randint_dtype():
+    a = np.ones((2, 2), ctx=mx.cpu())
+    z = np.zeros_like(a, dtype=onp.int32)
+    assert z.context == a.context and str(z.dtype) == "int32"
+    r = np.random.randint(0, 5, size=(4,), dtype="int64")
+    assert str(r.dtype) in ("int64", "int32")  # int32 if x64 disabled
