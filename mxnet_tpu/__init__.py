@@ -1,9 +1,12 @@
 """mxnet_tpu: a TPU-native deep-learning framework with MXNet's capabilities.
 
 Import as ``import mxnet_tpu as mx`` — the public surface mirrors the
-reference (`python/mxnet/__init__.py`): mx.nd, mx.autograd, mx.gluon,
-mx.optimizer, mx.kvstore, mx.io, mx.metric, mx.context/device helpers,
-mx.random, mx.profiler, mx.init — rebuilt on JAX/XLA/PJRT (see SURVEY.md).
+reference (`python/mxnet/__init__.py`): mx.nd (+sparse), mx.np/mx.npx,
+mx.sym, mx.mod, mx.autograd, mx.gluon, mx.optimizer, mx.kvstore, mx.io,
+mx.image, mx.recordio, mx.metric, mx.amp, mx.profiler, mx.runtime,
+mx.callback, mx.monitor, mx.model, mx.init, mx.random, device helpers —
+rebuilt on JAX/XLA/PJRT (see SURVEY.md; README "Status" lists the scope
+cuts).
 """
 from __future__ import annotations
 
