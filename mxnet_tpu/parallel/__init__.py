@@ -10,7 +10,11 @@ from .mesh import (make_mesh, replicated, batch_sharded, shard_params_tp,
                    TrainStep, init_process_group)
 from .ring import (ring_attention, ulysses_attention,
                    context_parallel_attention)
+from .pipeline import pipeline_apply, pipeline_parallel
+from .moe import moe_apply, moe_parallel, top1_dispatch
 
 __all__ = ["make_mesh", "replicated", "batch_sharded", "shard_params_tp",
            "TrainStep", "init_process_group", "ring_attention",
-           "ulysses_attention", "context_parallel_attention"]
+           "ulysses_attention", "context_parallel_attention",
+           "pipeline_apply", "pipeline_parallel", "moe_apply",
+           "moe_parallel", "top1_dispatch"]
