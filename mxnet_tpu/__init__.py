@@ -53,6 +53,7 @@ from . import kvstore
 from . import gluon
 from . import parallel
 from . import callback
+from . import checkpoint
 from . import model
 from . import monitor
 from . import module

@@ -169,6 +169,22 @@ class TrainStep:
             self.params, self.opt_state, *batch)
         return loss
 
+    def save(self, path: str) -> None:
+        """Sharded checkpoint of params+opt_state (mxnet_tpu.checkpoint)."""
+        from ..checkpoint import save_sharded
+        save_sharded(path, {"params": self.params,
+                            "opt_state": self.opt_state})
+
+    def restore(self, path: str) -> None:
+        """Restore in place, re-laying-out onto THIS step's shardings
+        (elastic: the saving mesh may have differed)."""
+        from ..checkpoint import restore_sharded
+        state = restore_sharded(
+            path,
+            template={"params": self.params, "opt_state": self.opt_state})
+        self.params = state["params"]
+        self.opt_state = state["opt_state"]
+
     def write_back(self, block):
         """Copy trained params back into the Block's Parameters."""
         params = block.collect_params()

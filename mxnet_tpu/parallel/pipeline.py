@@ -109,8 +109,9 @@ def pipeline_parallel(stage_fn: Callable, mesh: Mesh, *,
                 "has %d devices (one stage per device)"
                 % (n_given, pp_axis, n_stages))
         batch = x.shape[0]
-        assert batch % n_micro == 0, \
-            "batch (%d) must divide into %d microbatches" % (batch, n_micro)
+        if batch % n_micro != 0:
+            raise ValueError("batch (%d) must divide into %d microbatches"
+                             % (batch, n_micro))
         xs = x.reshape((n_micro, batch // n_micro) + x.shape[1:])
         specs_in = (jax.tree_util.tree_map(lambda _: P(pp_axis),
                                            stacked_params),

@@ -1,0 +1,111 @@
+"""Sharded checkpoint/resume tests (SURVEY §5.4) on the fake 8-device mesh.
+
+Reference pattern: checkpoint-resume bitwise-continuation tests — save mid
+training, restore into a FRESH training step, and require the loss
+trajectory to continue identically; plus elastic restore onto a different
+mesh layout.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.checkpoint import (save_sharded, restore_sharded,
+                                  CheckpointManager)
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.parallel import make_mesh, TrainStep
+
+
+def _devices(n=8):
+    devs = jax.devices()
+    if len(devs) < n:
+        pytest.skip("needs %d devices" % n)
+    return devs[:n]
+
+
+def _net():
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.zeros((1, 8)))
+    return net
+
+
+def _loss_fn(logits, labels):
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    onehot = jax.nn.one_hot(labels, logits.shape[-1], dtype=logp.dtype)
+    return -jnp.mean(jnp.sum(logp * onehot, axis=-1))
+
+
+def _batch(seed=0, n=16):
+    rng = np.random.RandomState(seed)
+    return (jnp.asarray(rng.randn(n, 8), jnp.float32),
+            jnp.asarray(rng.randint(0, 4, n), jnp.int32))
+
+
+def test_sharded_save_restore_roundtrip(tmp_path):
+    mesh = make_mesh(axes=("dp", "tp"), shape=(4, 2), devices=_devices())
+    step = TrainStep(_net(), _loss_fn, mesh, learning_rate=0.1)
+    x, y = _batch()
+    for _ in range(3):
+        step(x, y)
+    step.save(str(tmp_path / "ck"))
+
+    step2 = TrainStep(_net(), _loss_fn, mesh, learning_rate=0.1)
+    tmpl_shardings = {n: v.sharding for n, v in step2.params.items()}
+    step2.restore(str(tmp_path / "ck"))
+    for name in step.params:
+        np.testing.assert_array_equal(np.asarray(step.params[name]),
+                                      np.asarray(step2.params[name]))
+        # restore lays out onto the TEMPLATE step's shardings (the new
+        # job's layout), not whatever the saving compiler chose
+        assert step2.params[name].sharding == tmpl_shardings[name]
+    # training CONTINUES identically (opt state restored too)
+    l1 = float(step(x, y))
+    l2 = float(step2(x, y))
+    assert l1 == pytest.approx(l2, rel=1e-6)
+
+
+def test_elastic_restore_onto_different_mesh(tmp_path):
+    mesh_a = make_mesh(axes=("dp", "tp"), shape=(4, 2), devices=_devices())
+    step_a = TrainStep(_net(), _loss_fn, mesh_a, learning_rate=0.1)
+    x, y = _batch(1)
+    step_a(x, y)
+    step_a.save(str(tmp_path / "ck"))
+
+    # new job, new topology: dp=2 x tp=4
+    mesh_b = make_mesh(axes=("dp", "tp"), shape=(2, 4), devices=_devices())
+    step_b = TrainStep(_net(), _loss_fn, mesh_b, learning_rate=0.1)
+    step_b.restore(str(tmp_path / "ck"))
+    for name in step_a.params:
+        np.testing.assert_array_equal(np.asarray(step_a.params[name]),
+                                      np.asarray(step_b.params[name]))
+    l = float(step_b(x, y))
+    assert np.isfinite(l)
+
+
+def test_checkpoint_manager_retention_and_resume(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "run"), max_to_keep=2)
+    state = {"w": jnp.arange(8, dtype=jnp.float32)}
+    for s in (1, 2, 3):
+        mgr.save(s, {"w": state["w"] * s})
+    assert mgr.latest_step() == 3
+    assert mgr.all_steps() == [2, 3]      # retention dropped step 1
+    out = mgr.restore(template=state)
+    np.testing.assert_array_equal(np.asarray(out["w"]),
+                                  np.arange(8, dtype=np.float32) * 3)
+    out2 = mgr.restore(step=2, template=state)
+    np.testing.assert_array_equal(np.asarray(out2["w"]),
+                                  np.arange(8, dtype=np.float32) * 2)
+    mgr.close()
+
+
+def test_restore_without_template(tmp_path):
+    save_sharded(str(tmp_path / "raw"), {"a": jnp.ones((3,)),
+                                         "b": {"c": jnp.zeros((2, 2))}})
+    out = restore_sharded(str(tmp_path / "raw"))
+    np.testing.assert_array_equal(np.asarray(out["a"]), np.ones(3))
+    assert out["b"]["c"].shape == (2, 2)
