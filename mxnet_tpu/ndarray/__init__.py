@@ -39,6 +39,12 @@ for _name in _registry.list_ops():
 from . import sparse
 from .sparse import cast_storage, RowSparseNDArray, CSRNDArray
 
+def Custom(*inputs, op_type=None, **kwargs):
+    """User-defined op (reference: nd.Custom over src/operator/custom)."""
+    from ..operator import Custom as _custom
+    return _custom(*inputs, op_type=op_type, **kwargs)
+
+
 def stack(*data, axis=0, **kw):
     """MXNet varargs form: nd.stack(a, b, axis=0); also accepts a list."""
     if len(data) == 1 and isinstance(data[0], (list, tuple)):

@@ -1,0 +1,128 @@
+"""mx.operator CustomOp bridge: numpy-callback ops with autograd, under
+eager and hybridized execution (reference: tests/python/unittest/
+test_operator.py test_custom_op)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+@mx.operator.register("sigmoid_custom")
+class SigmoidProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return SigmoidOp()
+
+
+class SigmoidOp(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0]
+        self.assign(out_data[0], req[0], 1.0 / (1.0 + np.exp(-x)))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0]
+        self.assign(in_grad[0], req[0], out_grad[0] * y * (1.0 - y))
+
+
+@mx.operator.register("scale2")
+class Scale2Prop(mx.operator.CustomOpProp):
+    """Two inputs, two outputs: (2a+b, a*b)."""
+    def list_arguments(self):
+        return ["a", "b"]
+
+    def list_outputs(self):
+        return ["s", "p"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0], in_shape[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return Scale2Op()
+
+
+class Scale2Op(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        a, b = in_data
+        self.assign(out_data[0], req[0], 2 * a + b)
+        self.assign(out_data[1], req[1], a * b)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        a, b = in_data
+        gs, gp = out_grad
+        self.assign(in_grad[0], req[0], 2 * gs + gp * b)
+        self.assign(in_grad[1], req[1], gs + gp * a)
+
+
+def test_custom_forward():
+    x = nd.array(np.array([-1.0, 0.0, 2.0], np.float32))
+    y = nd.Custom(x, op_type="sigmoid_custom")
+    np.testing.assert_allclose(y.asnumpy(),
+                               1 / (1 + np.exp(-x.asnumpy())), rtol=1e-6)
+
+
+def test_custom_backward():
+    xv = np.array([[-1.0, 0.5], [2.0, -3.0]], np.float32)
+    x = nd.array(xv)
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="sigmoid_custom")
+        loss = y.sum()
+    loss.backward()
+    s = 1 / (1 + np.exp(-xv))
+    np.testing.assert_allclose(x.grad.asnumpy(), s * (1 - s), rtol=1e-5)
+
+
+def test_custom_multi_io_backward():
+    av, bv = np.array([1.0, 2.0], np.float32), np.array([3.0, -1.0], np.float32)
+    a, b = nd.array(av), nd.array(bv)
+    a.attach_grad(); b.attach_grad()
+    with autograd.record():
+        s, p = nd.Custom(a, b, op_type="scale2")
+        loss = (s * s).sum() + p.sum()
+    loss.backward()
+    # d/da [(2a+b)^2 + a*b] = 4(2a+b) + b ; d/db = 2(2a+b) + a
+    np.testing.assert_allclose(a.grad.asnumpy(), 4 * (2 * av + bv) + bv, rtol=1e-5)
+    np.testing.assert_allclose(b.grad.asnumpy(), 2 * (2 * av + bv) + av, rtol=1e-5)
+
+
+def test_custom_inside_hybridize():
+    class Net(mx.gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.dense = mx.gluon.nn.Dense(4)
+
+        def forward(self, x):
+            return nd.Custom(self.dense(x), op_type="sigmoid_custom")
+
+    net = Net()
+    net.initialize()
+    x = nd.array(np.random.RandomState(0).randn(2, 3).astype(np.float32))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    np.testing.assert_allclose(eager, hybrid, rtol=1e-5, atol=1e-6)
+
+
+def test_custom_hybridize_grad():
+    class Net(mx.gluon.HybridBlock):
+        def forward(self, x):
+            return nd.Custom(x, op_type="sigmoid_custom")
+
+    net = Net()
+    net.hybridize()
+    xv = np.array([0.3, -0.7], np.float32)
+    x = nd.array(xv)
+    x.attach_grad()
+    with autograd.record():
+        y = net(x)
+    y.backward()
+    s = 1 / (1 + np.exp(-xv))
+    np.testing.assert_allclose(x.grad.asnumpy(), s * (1 - s), rtol=1e-5)
+
+
+def test_unregistered_raises():
+    with pytest.raises(mx.MXNetError):
+        nd.Custom(nd.zeros((2,)), op_type="nope_not_here")
