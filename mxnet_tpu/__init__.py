@@ -59,6 +59,7 @@ from . import monitor
 from . import module
 from . import module as mod
 from . import operator
+from . import tpu_kernel
 
 # Subsystems land milestone-by-milestone (SURVEY.md §7.1); this list grows
 # until it covers the reference's full `python/mxnet/__init__.py` surface.

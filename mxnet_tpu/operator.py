@@ -121,6 +121,16 @@ def Custom(*inputs, op_type: Optional[str] = None, **kwargs):
 
     if op_type is None:
         raise MXNetError("Custom requires op_type=")
+    from .ndarray import ndarray as _ndmod
+    if _ndmod._sym_tracer is not None:
+        raise MXNetError(
+            "Custom ops cannot be traced into symbol.json (the numpy "
+            "callback has no graph representation — the reference's "
+            "exported Custom nodes need the python process too); exclude "
+            "the Custom op from the exported subgraph")
+    # standard MXNet call kwargs are not prop parameters
+    kwargs.pop("name", None)
+    kwargs.pop("ctx", None)
     prop = get_registered_op(op_type)(**{k: str(v) for k, v in kwargs.items()})
 
     nd_in = [x if isinstance(x, NDArray) else NDArray(jnp.asarray(x))

@@ -75,6 +75,10 @@ def register(name: str, fn: Optional[Callable] = None, *, differentiable: bool =
                    num_outputs=num_outputs, mutates_input=mutates_input,
                    needs_rng=needs_rng, aux_writeback=aux_writeback,
                    no_jit=no_jit)
+        if name in _REGISTRY or any(a in _REGISTRY for a in aliases):
+            # re-registration (user kernel iteration): drop the per-op jit
+            # cache or dispatch keeps hitting the old fn via (name, params)
+            _jitted.cache_clear()
         _REGISTRY[name] = op
         for a in aliases:
             _REGISTRY[a] = op

@@ -1,0 +1,114 @@
+"""mx.tpu_kernel: user Pallas kernels — launch, decorator, op registration
+with autograd (reference: tests/python/gpu/test_rtc.py pattern, rebuilt for
+the Pallas RTC equivalent). Runs in interpret mode on the CPU test mesh."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def test_kernel_launch():
+    def axpy(a_ref, x_ref, y_ref, o_ref):
+        o_ref[...] = a_ref[...] * x_ref[...] + y_ref[...]
+
+    k = mx.tpu_kernel.Kernel(axpy)
+    a = nd.full((8, 128), 2.0)
+    x = nd.array(np.arange(8 * 128, dtype=np.float32).reshape(8, 128))
+    y = nd.ones((8, 128))
+    out = k.launch([a, x, y], out_shape=(8, 128))
+    np.testing.assert_allclose(out.asnumpy(),
+                               2.0 * x.asnumpy() + 1.0, rtol=1e-6)
+
+
+def test_kernel_decorator_and_call():
+    @mx.tpu_kernel.kernel()
+    def double(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2.0
+
+    x = nd.array(np.random.RandomState(0).randn(4, 128).astype(np.float32))
+    out = double(x, out_shape=(4, 128))
+    np.testing.assert_allclose(out.asnumpy(), 2 * x.asnumpy(), rtol=1e-6)
+
+
+def test_kernel_gridded():
+    import jax.experimental.pallas as pl
+
+    @mx.tpu_kernel.kernel(grid=(2,),
+                          in_specs=[pl.BlockSpec((4, 128), lambda i: (i, 0))],
+                          out_specs=pl.BlockSpec((4, 128), lambda i: (i, 0)))
+    def relu_blocked(x_ref, o_ref):
+        o_ref[...] = np.maximum(x_ref[...], 0.0) if isinstance(
+            x_ref[...], np.ndarray) else x_ref[...].clip(0.0)
+
+    x = nd.array(np.random.RandomState(1).randn(8, 128).astype(np.float32))
+    out = relu_blocked(x, out_shape=(8, 128))
+    np.testing.assert_allclose(out.asnumpy(), np.maximum(x.asnumpy(), 0),
+                               rtol=1e-6)
+
+
+def test_registered_op_with_grad():
+    @mx.tpu_kernel.register(
+        "pallas_square",
+        out_shape_fn=lambda x: x,
+        grad=lambda cts, x: (cts[0] * 2.0 * x,))
+    def square_kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * x_ref[...]
+
+    xv = np.array([1.0, -2.0, 3.0], np.float32)
+    x = nd.array(xv)
+    out = nd.pallas_square(x)
+    np.testing.assert_allclose(out.asnumpy(), xv * xv, rtol=1e-6)
+
+    x.attach_grad()
+    with autograd.record():
+        y = nd.pallas_square(x)
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * xv, rtol=1e-6)
+
+
+def test_registered_op_in_hybridize():
+    @mx.tpu_kernel.register(
+        "pallas_scale3", out_shape_fn=lambda x: x,
+        grad=lambda cts, x: (cts[0] * 3.0,))
+    def scale3(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 3.0
+
+    class Net(mx.gluon.HybridBlock):
+        def forward(self, x):
+            return nd.pallas_scale3(x)
+
+    net = Net()
+    net.hybridize()
+    xv = np.random.RandomState(2).randn(2, 5).astype(np.float32)
+    x = nd.array(xv)
+    x.attach_grad()
+    with autograd.record():
+        y = net(x)
+    y.backward()
+    np.testing.assert_allclose(y.asnumpy(), 3 * xv, rtol=1e-6)
+    np.testing.assert_allclose(x.grad.asnumpy(), np.full_like(xv, 3.0))
+
+
+def test_reregistration_evicts_jit_cache():
+    def make(mult):
+        @mx.tpu_kernel.register("pallas_mul_iter", out_shape_fn=lambda x: x)
+        def mul_kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...] * mult
+        return mul_kernel
+
+    x = nd.array(np.array([1.0, 2.0], np.float32))
+    make(2.0)
+    np.testing.assert_allclose(nd.pallas_mul_iter(x).asnumpy(), [2.0, 4.0])
+    make(5.0)  # notebook iteration: same name, new body
+    np.testing.assert_allclose(nd.pallas_mul_iter(x).asnumpy(), [5.0, 10.0])
+
+
+def test_nondiff_registered_op_refuses_grad():
+    @mx.tpu_kernel.register("pallas_sign_nd", out_shape_fn=lambda x: x)
+    def sign_kernel(x_ref, o_ref):
+        o_ref[...] = (x_ref[...] > 0).astype(x_ref[...].dtype)
+
+    x = nd.array(np.array([1.0, -1.0], np.float32))
+    out = nd.pallas_sign_nd(x)
+    np.testing.assert_allclose(out.asnumpy(), [1.0, 0.0])
