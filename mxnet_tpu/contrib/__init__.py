@@ -12,5 +12,7 @@ from ..ops.control_flow import foreach, while_loop, cond
 from .. import amp  # 1.x location: mx.contrib.amp (2.x: mx.amp)
 from . import ndarray
 from . import ndarray as nd
+from . import quantization
 
-__all__ = ["foreach", "while_loop", "cond", "nd", "ndarray", "amp"]
+__all__ = ["foreach", "while_loop", "cond", "nd", "ndarray", "amp",
+           "quantization"]

@@ -1,0 +1,254 @@
+"""INT8 quantization driver: calibrate a float network and rewrite its
+Dense/Conv2D layers onto the int8 MXU ops.
+
+Reference: python/mxnet/contrib/quantization.py (quantize_model,
+quantize_net, _LayerOutputCollector, _get_optimal_thresholds — the
+KL-divergence "entropy" calibration), src/operator/quantization/
+quantize_graph_pass.cc (the graph rewrite inserting quantize/dequantize
+pairs).
+
+TPU-native design: instead of an nnvm graph pass, quantization is a
+*block rewrite* — each Dense/Conv2D is wrapped so its forward runs
+quantize_v2(input) → int8 GEMM/conv (MXU int8×int8→int32) → dequantize.
+Weights are pre-quantized once at conversion time.  Calibration modes
+match the reference: 'naive' (observed min/max) and 'entropy'
+(KL-optimal thresholds over a 255-bin histogram).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["quantize_net", "CalibrationCollector",
+           "_get_optimal_threshold"]
+
+
+def _smooth(p: _np.ndarray, eps: float = 1e-4) -> _np.ndarray:
+    """Laplace-style smoothing the reference applies before KL."""
+    is_zero = p == 0
+    n_zero = int(is_zero.sum())
+    n_nonzero = p.size - n_zero
+    if n_nonzero == 0:
+        return p
+    take = eps * n_zero / n_nonzero
+    out = p.astype(_np.float64).copy()
+    out[is_zero] = eps
+    out[~is_zero] -= take
+    return out
+
+
+def _get_optimal_threshold(arr: _np.ndarray, num_bins: int = 8001,
+                           num_quantized_bins: int = 255) -> float:
+    """KL-divergence calibration (reference: quantization.py
+    _get_optimal_threshold): pick the |threshold| whose clipped+requantized
+    distribution diverges least from the original histogram."""
+    a = _np.abs(arr.ravel())
+    amax = float(a.max()) if a.size else 0.0
+    if amax == 0.0:
+        return 1e-30
+    hist, edges = _np.histogram(a, bins=num_bins, range=(0, amax))
+    zero_bin = 0  # histogram of |x|: everything is non-negative
+    best_kl, best_t = _np.inf, amax
+    # scan candidate thresholds from num_quantized_bins upward
+    for i in range(num_quantized_bins, num_bins + 1,
+                   max(1, (num_bins - num_quantized_bins) // 64)):
+        t = edges[i] if i < len(edges) else edges[-1]
+        p = hist[:i].astype(_np.float64).copy()
+        p[-1] += hist[i:].sum()  # clip outliers into the last bin
+        # quantize p down to num_quantized_bins then expand back
+        factor = i / num_quantized_bins
+        idx = (_np.arange(i) / factor).astype(_np.int64).clip(
+            0, num_quantized_bins - 1)
+        q_small = _np.zeros(num_quantized_bins)
+        _np.add.at(q_small, idx, p)
+        counts = _np.zeros(num_quantized_bins)
+        _np.add.at(counts, idx, (p > 0).astype(_np.float64))
+        q = _np.zeros(i)
+        nz = counts[idx] > 0
+        q[nz] = (q_small[idx] / counts[idx])[nz] * (p[nz] > 0)
+        ps, qs = _smooth(p / max(p.sum(), 1e-30)), _smooth(
+            q / max(q.sum(), 1e-30))
+        kl = float(_np.sum(ps * _np.log(_np.maximum(ps, 1e-30)
+                                        / _np.maximum(qs, 1e-30))))
+        if kl < best_kl:
+            best_kl, best_t = kl, float(t)
+    return max(best_t, 1e-30)
+
+
+class CalibrationCollector:
+    """Collects per-layer input statistics during calibration forward
+    passes (reference: _LayerOutputCollector)."""
+
+    def __init__(self, mode: str = "naive"):
+        if mode not in ("naive", "entropy"):
+            raise MXNetError("calib_mode must be 'naive' or 'entropy'")
+        self.mode = mode
+        self.min_max: Dict[str, Tuple[float, float]] = {}
+        self._samples: Dict[str, List[_np.ndarray]] = {}
+
+    def collect(self, name: str, x: _np.ndarray) -> None:
+        mn, mx = float(x.min()), float(x.max())
+        if name in self.min_max:
+            omn, omx = self.min_max[name]
+            self.min_max[name] = (min(mn, omn), max(mx, omx))
+        else:
+            self.min_max[name] = (mn, mx)
+        if self.mode == "entropy":
+            self._samples.setdefault(name, []).append(
+                _np.asarray(x, _np.float32).ravel())
+
+    def thresholds(self) -> Dict[str, Tuple[float, float]]:
+        if self.mode == "naive":
+            return dict(self.min_max)
+        out = {}
+        for name, chunks in self._samples.items():
+            t = _get_optimal_threshold(_np.concatenate(chunks))
+            out[name] = (-t, t)
+        return out
+
+
+class _QuantizedForward:
+    """Replacement forward for a calibrated Dense/Conv2D block."""
+
+    def __init__(self, block, kind: str, in_range: Tuple[float, float],
+                 quantized_dtype: str):
+        from .. import ndarray as nd
+        self.block = block
+        self.kind = kind
+        self.in_min, self.in_max = in_range
+        self.dtype = quantized_dtype
+        # pre-quantize weights once (symmetric int8)
+        w = block.weight.data()
+        wnp = w.asnumpy()
+        self.w_min = float(wnp.min())
+        self.w_max = float(wnp.max())
+        self.qweight, _, _ = nd.invoke("_contrib_quantize", w,
+                                       nd.array([self.w_min]),
+                                       nd.array([self.w_max]),
+                                       out_type="int8")
+        self.bias = block.bias.data() if getattr(block, "bias", None) \
+            is not None else None
+
+    def __call__(self, x):
+        from .. import ndarray as nd
+        qx, mn, mx_ = nd.invoke("_contrib_quantize_v2", x,
+                                out_type=self.dtype,
+                                min_calib_range=self.in_min,
+                                max_calib_range=self.in_max)
+        b = self.bias
+        if b is not None:
+            bnp = b.asnumpy()
+            bmin, bmax = float(bnp.min()), float(bnp.max())
+            qb, _, _ = nd.invoke("_contrib_quantize", b,
+                                 nd.array([bmin]), nd.array([bmax]),
+                                 out_type="int8")
+        else:
+            qb, bmin, bmax = None, 0.0, 0.0
+        if self.kind == "dense":
+            acc, omn, omx = nd.invoke(
+                "_contrib_quantized_fully_connected", qx, self.qweight, qb,
+                mn, mx_, nd.array([self.w_min]), nd.array([self.w_max]),
+                nd.array([bmin]), nd.array([bmax]),
+                num_hidden=self.block._units, no_bias=qb is None,
+                flatten=self.block._flatten)
+        else:
+            blk = self.block
+            acc, omn, omx = nd.invoke(
+                "_contrib_quantized_conv", qx, self.qweight, qb,
+                mn, mx_, nd.array([self.w_min]), nd.array([self.w_max]),
+                nd.array([bmin]), nd.array([bmax]),
+                kernel=blk._kernel, stride=blk._stride, dilate=blk._dilate,
+                pad=blk._pad, num_filter=blk._channels,
+                num_group=blk._groups, no_bias=qb is None)
+        out = nd.invoke("_contrib_dequantize", acc, omn, omx)
+        act = getattr(self.block, "_act", None)
+        if act:
+            out = nd.invoke("Activation", out, act_type=act)
+        return out
+
+
+def quantize_net(network, quantized_dtype: str = "int8",
+                 exclude_layers: Optional[Sequence[str]] = None,
+                 calib_data=None, calib_mode: str = "naive",
+                 num_calib_batches: Optional[int] = None,
+                 logger=None):
+    """Calibrate `network` on `calib_data` and return it with Dense/Conv2D
+    forwards rewritten onto int8 ops (reference: quantize_net).
+
+    `network` must be an initialized (shape-known) gluon net; `calib_data`
+    iterates over input batches (NDArray, or (data, label) tuples whose
+    first element is fed)."""
+    from ..gluon.nn import Dense
+    from ..gluon.nn.conv_layers import Conv2D
+    from ..ndarray import NDArray
+
+    if quantized_dtype not in ("int8", "uint8", "auto"):
+        raise MXNetError("quantized_dtype must be int8/uint8/auto")
+    if quantized_dtype == "auto":
+        quantized_dtype = "int8"
+    if calib_data is None:
+        raise MXNetError("TPU quantize_net requires calib_data (the "
+                         "reference's calib_mode='none' weight-only path "
+                         "is not supported)")
+    exclude = set(exclude_layers or ())
+
+    def walk(block, prefix=""):
+        for cname, child in block._children.items():
+            full = prefix + cname if not prefix else prefix + "." + cname
+            yield full, child
+            yield from walk(child, full)
+
+    targets: List[Tuple[str, object, str]] = []
+    for name, blk in walk(network):
+        if name in exclude:
+            continue
+        if isinstance(blk, Dense):
+            targets.append((name, blk, "dense"))
+        elif isinstance(blk, Conv2D) and blk._groups == 1:
+            targets.append((name, blk, "conv"))
+
+    # ---- calibration pass: hook each target's forward to observe inputs ----
+    collector = CalibrationCollector(calib_mode)
+    originals = {}
+
+    def make_hook(name, blk):
+        fwd = blk.forward
+
+        def hooked(x, *a, **k):
+            collector.collect(name, x.asnumpy())
+            return fwd(x, *a, **k)
+        return fwd, hooked
+
+    for name, blk, _ in targets:
+        originals[name], hooked = make_hook(name, blk)
+        blk.forward = hooked
+    try:
+        n = 0
+        for batch in calib_data:
+            x = batch[0] if isinstance(batch, (tuple, list)) else batch
+            network(x)
+            n += 1
+            if num_calib_batches is not None and n >= num_calib_batches:
+                break
+        if n == 0:
+            raise MXNetError("calib_data yielded no batches")
+    finally:
+        for name, blk, _ in targets:
+            blk.forward = originals[name]
+
+    ranges = collector.thresholds()
+
+    # ---- rewrite pass ----
+    for name, blk, kind in targets:
+        if name not in ranges:
+            continue  # block never ran during calibration
+        blk.forward = _QuantizedForward(blk, kind, ranges[name],
+                                        quantized_dtype)
+        blk._quantized = True
+    if logger:
+        logger.info("quantized %d layers (%s calibration over %d batches)",
+                    len(targets), calib_mode, n)
+    return network

@@ -19,3 +19,4 @@ from . import extra      # noqa: F401
 from . import detection  # noqa: F401
 from . import spatial    # noqa: F401
 from . import control_flow  # noqa: F401
+from . import quantization  # noqa: F401

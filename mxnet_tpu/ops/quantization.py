@@ -1,0 +1,218 @@
+"""INT8 quantization ops.
+
+Reference: src/operator/quantization/quantize.cc (_contrib_quantize),
+quantize_v2.cc, dequantize.cc, requantize.cc,
+quantized_fully_connected.cc, quantized_conv.cc, quantized_pooling.cc,
+quantized_flatten.cc, quantized_activation.cc.
+
+TPU-native design: int8 GEMM/conv run on the MXU via
+``lax.dot_general``/``conv_general_dilated`` with
+``preferred_element_type=int32`` — the role cuDNN/cuBLAS int8 paths (and
+oneDNN's s8s8s32) play in the reference.  Quantization follows MXNet's
+convention: int8 is SYMMETRIC (scale = 127 / max|range|, zero-point 0,
+which is what keeps int8×int8→int32 a plain matmul on the systolic
+array), uint8 is affine with zero-point 0 over [0, max].  Every
+quantized op carries (min, max) calibration scalars alongside the data
+tensor and returns its own output range, exactly like the reference's
+3-ary outputs.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+__all__ = []
+
+_INT8_MAX = 127.0
+_UINT8_MAX = 255.0
+
+
+def _range_scale(mn, mx, out_type="int8"):
+    """MXNet FloatToQuantized convention: symmetric for int8."""
+    mn = jnp.asarray(mn, jnp.float32).reshape(())
+    mx = jnp.asarray(mx, jnp.float32).reshape(())
+    if out_type == "uint8":
+        real_range = jnp.maximum(mx, 1e-30)
+        return _UINT8_MAX / real_range
+    real_range = jnp.maximum(jnp.maximum(jnp.abs(mn), jnp.abs(mx)), 1e-30)
+    return _INT8_MAX / real_range
+
+
+@register("_contrib_quantize", num_outputs=3, differentiable=False,
+          aliases=["quantize"])
+def _quantize(data, min_range, max_range, out_type="int8"):
+    """float → int8/uint8 with a provided calibration range (reference:
+    quantize.cc QuantizeCompute)."""
+    scale = _range_scale(min_range, max_range, out_type)
+    if out_type == "uint8":
+        q = jnp.clip(jnp.rint(data * scale), 0, _UINT8_MAX).astype(jnp.uint8)
+        return q, jnp.zeros((1,), jnp.float32), jnp.reshape(
+            jnp.asarray(max_range, jnp.float32), (1,))
+    q = jnp.clip(jnp.rint(data * scale), -_INT8_MAX, _INT8_MAX)
+    q = q.astype(jnp.int8)
+    amax = _INT8_MAX / scale
+    return (q, jnp.reshape(-amax, (1,)), jnp.reshape(amax, (1,)))
+
+
+@register("_contrib_quantize_v2", num_outputs=3, differentiable=False,
+          aliases=["quantize_v2"])
+def _quantize_v2(data, out_type="int8", min_calib_range=None,
+                 max_calib_range=None):
+    """Like quantize but computes the range from the data when no
+    calibrated range is given (reference: quantize_v2.cc)."""
+    if min_calib_range is None or max_calib_range is None:
+        mn = jnp.min(data).astype(jnp.float32)
+        mx = jnp.max(data).astype(jnp.float32)
+    else:
+        mn = jnp.asarray(min_calib_range, jnp.float32)
+        mx = jnp.asarray(max_calib_range, jnp.float32)
+    return _quantize(data, mn, mx, out_type=out_type)
+
+
+@register("_contrib_dequantize", differentiable=False,
+          aliases=["dequantize"])
+def _dequantize(data, min_range, max_range, out_type="float32"):
+    """int8/uint8/int32 → float (reference: dequantize.cc)."""
+    mn = jnp.asarray(min_range, jnp.float32).reshape(())
+    mx = jnp.asarray(max_range, jnp.float32).reshape(())
+    if data.dtype == jnp.uint8:
+        scale = jnp.maximum(mx, 1e-30) / _UINT8_MAX
+        return data.astype(jnp.float32) * scale
+    qmax = {jnp.int8.dtype: _INT8_MAX,
+            jnp.int32.dtype: 2147483647.0}.get(jnp.dtype(data.dtype),
+                                               _INT8_MAX)
+    real_range = jnp.maximum(jnp.maximum(jnp.abs(mn), jnp.abs(mx)), 1e-30)
+    return data.astype(jnp.float32) * (real_range / qmax)
+
+
+@register("_contrib_requantize", num_outputs=3, differentiable=False,
+          aliases=["requantize"])
+def _requantize(data, min_range, max_range, min_calib_range=None,
+                max_calib_range=None):
+    """int32 accumulator → int8 (reference: requantize.cc).  With no
+    calibrated range, uses the int32 tensor's actual range."""
+    f = _dequantize(data, min_range, max_range)
+    if min_calib_range is None or max_calib_range is None:
+        mn, mx = jnp.min(f), jnp.max(f)
+    else:
+        mn = jnp.asarray(min_calib_range, jnp.float32)
+        mx = jnp.asarray(max_calib_range, jnp.float32)
+    return _quantize(f, mn, mx, out_type="int8")
+
+
+def _deq_scale(mn, mx, dtype):
+    if dtype == jnp.uint8.dtype:
+        return jnp.maximum(jnp.asarray(mx, jnp.float32).reshape(()), 1e-30) \
+            / _UINT8_MAX
+    mn = jnp.asarray(mn, jnp.float32).reshape(())
+    mx = jnp.asarray(mx, jnp.float32).reshape(())
+    return jnp.maximum(jnp.maximum(jnp.abs(mn), jnp.abs(mx)), 1e-30) / _INT8_MAX
+
+
+@register("_contrib_quantized_fully_connected", num_outputs=3,
+          differentiable=False, aliases=["quantized_fully_connected"])
+def _quantized_fc(data, weight, bias, min_data, max_data, min_weight,
+                  max_weight, min_bias=None, max_bias=None, num_hidden=None,
+                  no_bias=False, flatten=True):
+    """int8 GEMM on the MXU: int8×int8→int32 dot, bias folded in at the
+    accumulator scale (reference: quantized_fully_connected.cc)."""
+    x = data.reshape(data.shape[0], -1) if flatten and data.ndim > 2 else data
+    acc = lax.dot_general(
+        x.astype(jnp.int8), weight.astype(jnp.int8),
+        (((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    sx = _deq_scale(min_data, max_data, x.dtype)
+    sw = _deq_scale(min_weight, max_weight, jnp.int8.dtype)
+    out_scale = sx * sw  # one int32 step == this many float units
+    if bias is not None and not no_bias:
+        sb = _deq_scale(min_bias, max_bias, jnp.int8.dtype)
+        b32 = jnp.rint(bias.astype(jnp.float32) * (sb / out_scale))
+        acc = acc + b32.astype(jnp.int32)
+    amax = 2147483647.0 * out_scale
+    return acc, jnp.reshape(-amax, (1,)), jnp.reshape(amax, (1,))
+
+
+@register("_contrib_quantized_conv", num_outputs=3, differentiable=False,
+          aliases=["quantized_conv"])
+def _quantized_conv(data, weight, bias, min_data, max_data, min_weight,
+                    max_weight, min_bias=None, max_bias=None, kernel=None,
+                    stride=None, dilate=None, pad=None, num_filter=None,
+                    num_group=1, no_bias=False, layout=None,
+                    cudnn_tune=None, cudnn_off=False, workspace=1024):
+    """int8 convolution accumulating in int32 on the MXU (reference:
+    quantized_conv.cc; NCHW/OIHW layouts like the float op)."""
+    n = len(kernel)
+    stride = tuple(stride) if stride else (1,) * n
+    dilate = tuple(dilate) if dilate else (1,) * n
+    pad = tuple(pad) if pad else (0,) * n
+    spatial = "DHW"[-n:] if n != 2 else "HW"
+    dn = lax.conv_dimension_numbers(
+        data.shape, weight.shape,
+        ("NC" + spatial, "OI" + spatial, "NC" + spatial))
+    acc = lax.conv_general_dilated(
+        data.astype(jnp.int8), weight.astype(jnp.int8),
+        window_strides=stride, padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    sx = _deq_scale(min_data, max_data, data.dtype)
+    sw = _deq_scale(min_weight, max_weight, jnp.int8.dtype)
+    out_scale = sx * sw
+    if bias is not None and not no_bias:
+        sb = _deq_scale(min_bias, max_bias, jnp.int8.dtype)
+        b32 = jnp.rint(bias.astype(jnp.float32) * (sb / out_scale))
+        acc = acc + b32.astype(jnp.int32).reshape((1, -1) + (1,) * n)
+    amax = 2147483647.0 * out_scale
+    return acc, jnp.reshape(-amax, (1,)), jnp.reshape(amax, (1,))
+
+
+@register("_contrib_quantized_pooling", num_outputs=3, differentiable=False,
+          aliases=["quantized_pooling"])
+def _quantized_pooling(data, min_data, max_data, kernel=None,
+                       pool_type="max", global_pool=False, stride=None,
+                       pad=None, pooling_convention="valid",
+                       count_include_pad=True, cudnn_off=False, layout=None,
+                       p_value=2):
+    """Pooling stays in the quantized domain — ranges pass through
+    (reference: quantized_pooling.cc)."""
+    from .nn import _pooling
+    if pool_type == "max":
+        out = _pooling(data.astype(jnp.int32), kernel=kernel,
+                       pool_type="max", global_pool=global_pool,
+                       stride=stride, pad=pad,
+                       pooling_convention=pooling_convention,
+                       count_include_pad=count_include_pad)
+        out = out.astype(data.dtype)
+    else:  # avg pooling must average in a wider type
+        out = _pooling(data.astype(jnp.float32), kernel=kernel,
+                       pool_type=pool_type, global_pool=global_pool,
+                       stride=stride, pad=pad,
+                       pooling_convention=pooling_convention,
+                       count_include_pad=count_include_pad)
+        out = jnp.rint(out).astype(data.dtype)
+    return (out, jnp.reshape(jnp.asarray(min_data, jnp.float32), (1,)),
+            jnp.reshape(jnp.asarray(max_data, jnp.float32), (1,)))
+
+
+@register("_contrib_quantized_flatten", num_outputs=3, differentiable=False,
+          aliases=["quantized_flatten"])
+def _quantized_flatten(data, min_data, max_data):
+    return (data.reshape(data.shape[0], -1),
+            jnp.reshape(jnp.asarray(min_data, jnp.float32), (1,)),
+            jnp.reshape(jnp.asarray(max_data, jnp.float32), (1,)))
+
+
+@register("_contrib_quantized_act", num_outputs=3, differentiable=False,
+          aliases=["quantized_act"])
+def _quantized_act(data, min_data, max_data, act_type="relu"):
+    """relu in the int domain: clamp at the zero point (reference:
+    quantized_activation.cc — only relu is supported there too)."""
+    if act_type != "relu":
+        raise ValueError("quantized activation supports only relu")
+    out = jnp.maximum(data, 0).astype(data.dtype)
+    mx_ = jnp.asarray(max_data, jnp.float32)
+    return (out, jnp.zeros((1,), jnp.float32),
+            jnp.reshape(jnp.maximum(mx_, 0.0), (1,)))

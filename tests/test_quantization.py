@@ -1,0 +1,150 @@
+"""INT8 quantization: op semantics + the quantize_net calibration/rewrite
+flow (reference: tests/python/quantization/test_quantization.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.contrib.quantization import (quantize_net,
+                                            _get_optimal_threshold)
+
+
+def test_quantize_dequantize_roundtrip():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 16).astype(np.float32) * 3
+    mn, mx_ = float(x.min()), float(x.max())
+    q, qmn, qmx = nd.invoke("_contrib_quantize", nd.array(x),
+                            nd.array([mn]), nd.array([mx_]), out_type="int8")
+    assert q.dtype == np.int8
+    back = nd.invoke("_contrib_dequantize", q, qmn, qmx)
+    amax = max(abs(mn), abs(mx_))
+    np.testing.assert_allclose(back.asnumpy(), x, atol=amax / 127 + 1e-6)
+
+
+def test_quantize_v2_auto_range():
+    x = np.array([[-1.0, 0.5, 2.0]], np.float32)
+    q, mn, mx_ = nd.invoke("_contrib_quantize_v2", nd.array(x),
+                           out_type="int8")
+    assert float(mx_.asnumpy()[0]) == pytest.approx(2.0, rel=1e-5)
+    assert q.asnumpy()[0, 2] == 127
+
+
+def test_quantize_uint8():
+    x = np.array([0.0, 1.0, 2.0], np.float32)
+    q, mn, mx_ = nd.invoke("_contrib_quantize", nd.array(x),
+                           nd.array([0.0]), nd.array([2.0]),
+                           out_type="uint8")
+    assert q.dtype == np.uint8
+    np.testing.assert_array_equal(q.asnumpy(), [0, 128, 255])
+
+
+def test_quantized_fully_connected_matches_float():
+    rng = np.random.RandomState(1)
+    x = rng.randn(8, 32).astype(np.float32)
+    w = rng.randn(16, 32).astype(np.float32)
+    b = rng.randn(16).astype(np.float32)
+
+    def qr(a):
+        return nd.array([float(a.min())]), nd.array([float(a.max())])
+
+    xmn, xmx = qr(x); wmn, wmx = qr(w); bmn, bmx = qr(b)
+    qx, qxmn, qxmx = nd.invoke("_contrib_quantize", nd.array(x), xmn, xmx,
+                               out_type="int8")
+    qw, _, _ = nd.invoke("_contrib_quantize", nd.array(w), wmn, wmx,
+                         out_type="int8")
+    qb, _, _ = nd.invoke("_contrib_quantize", nd.array(b), bmn, bmx,
+                         out_type="int8")
+    acc, omn, omx = nd.invoke("_contrib_quantized_fully_connected",
+                              qx, qw, qb, qxmn, qxmx, wmn, wmx, bmn, bmx,
+                              num_hidden=16)
+    assert acc.dtype == np.int32
+    out = nd.invoke("_contrib_dequantize", acc, omn, omx)
+    expect = x @ w.T + b
+    # int8 GEMM tolerance: ~1% of the output scale
+    err = np.abs(out.asnumpy() - expect).max()
+    assert err < 0.05 * np.abs(expect).max()
+
+
+def test_quantized_conv_matches_float():
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    w = rng.randn(4, 3, 3, 3).astype(np.float32)
+
+    def qz(a):
+        mn, mx_ = nd.array([float(a.min())]), nd.array([float(a.max())])
+        q, qmn, qmx = nd.invoke("_contrib_quantize", nd.array(a), mn, mx_,
+                                out_type="int8")
+        return q, mn, mx_, qmn, qmx
+
+    qx, xmn, xmx, qxmn, qxmx = qz(x)
+    qw, wmn, wmx, _, _ = qz(w)
+    acc, omn, omx = nd.invoke("_contrib_quantized_conv", qx, qw, None,
+                              qxmn, qxmx, wmn, wmx, kernel=(3, 3),
+                              pad=(1, 1), num_filter=4, no_bias=True)
+    out = nd.invoke("_contrib_dequantize", acc, omn, omx).asnumpy()
+    expect = nd.invoke("Convolution", nd.array(x), nd.array(w), None,
+                       kernel=(3, 3), pad=(1, 1), num_filter=4,
+                       no_bias=True).asnumpy()
+    assert np.abs(out - expect).max() < 0.05 * np.abs(expect).max()
+
+
+def test_quantized_pooling_and_flatten_pass_range():
+    x = (np.arange(16).reshape(1, 1, 4, 4) - 8).astype(np.int8)
+    out, mn, mx_ = nd.invoke("_contrib_quantized_pooling", nd.array(x),
+                             nd.array([-1.0]), nd.array([1.0]),
+                             kernel=(2, 2), stride=(2, 2), pool_type="max")
+    assert out.dtype == np.int8
+    assert float(mx_.asnumpy()[0]) == 1.0
+    f, _, _ = nd.invoke("_contrib_quantized_flatten", out,
+                        nd.array([-1.0]), nd.array([1.0]))
+    assert f.shape == (1, 4)
+
+
+def test_optimal_threshold_sane():
+    rng = np.random.RandomState(3)
+    x = rng.randn(20000).astype(np.float32)
+    x[0] = 40.0  # one huge outlier the KL calibration should clip away
+    t = _get_optimal_threshold(x)
+    assert 2.0 < t < 40.0
+
+
+def test_quantize_net_mlp():
+    rng = np.random.RandomState(4)
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Dense(32, activation="relu"),
+            mx.gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    x = nd.array(rng.randn(64, 20).astype(np.float32))
+    float_out = net(x).asnumpy()
+
+    quantize_net(net, calib_data=[x], calib_mode="naive")
+    q_out = net(x).asnumpy()
+    # int8 accuracy: close to float on a 2-layer MLP
+    scale = np.abs(float_out).max()
+    assert np.abs(q_out - float_out).max() < 0.1 * scale
+
+
+def test_quantize_net_conv_entropy():
+    rng = np.random.RandomState(5)
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Conv2D(8, 3, padding=1, activation="relu"),
+            mx.gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    batches = [nd.array(rng.randn(4, 3, 8, 8).astype(np.float32))
+               for _ in range(3)]
+    float_out = net(batches[0]).asnumpy()
+    quantize_net(net, calib_data=batches, calib_mode="entropy")
+    q_out = net(batches[0]).asnumpy()
+    scale = np.abs(float_out).max()
+    assert np.abs(q_out - float_out).max() < 0.15 * scale
+
+
+def test_quantize_net_excludes():
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Dense(8), mx.gluon.nn.Dense(4))
+    net.initialize()
+    x = nd.ones((2, 6))
+    quantize_net(net, calib_data=[x], exclude_layers=["0.0"])
+    kids = list(net._children.values())[0]._children
+    assert not getattr(list(kids.values())[0], "_quantized", False)
+    assert getattr(list(kids.values())[1], "_quantized", False)
