@@ -142,23 +142,30 @@ def probe_accelerator(timeout_s: float = 120.0) -> bool:
     global _probe_result
     if _probe_result is not None:
         return _probe_result
+    _probe_result = probe_accelerator_once(timeout_s)
+    return _probe_result
+
+
+def probe_accelerator_once(timeout_s: float = 120.0) -> bool:
+    """One un-memoized subprocess probe (see probe_accelerator).  Polling
+    loops (tools/tpu_capture.py) use this directly — a tunnel that heals
+    mid-round must be observable across repeated calls."""
     import subprocess
     import sys
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
     env.pop("MX_FORCE_CPU", None)
     code = "import jax; d = jax.devices(); assert jax.default_backend() != 'cpu'"
-    _probe_result = False
     try:
         r = subprocess.run([sys.executable, "-c", code], env=env,
                            timeout=timeout_s,
                            stdout=subprocess.DEVNULL,
                            stderr=subprocess.DEVNULL)
-        _probe_result = r.returncode == 0
+        return r.returncode == 0
     except subprocess.TimeoutExpired:
-        pass  # wedged: hangs don't flake, and a quick rc!=0 (no plugin) is
-        #       deterministic — one attempt decides either way
-    return _probe_result
+        # wedged: hangs don't flake, and a quick rc!=0 (no plugin) is
+        # deterministic — one attempt decides either way
+        return False
 
 
 def pin_cpu() -> None:

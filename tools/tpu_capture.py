@@ -1,0 +1,215 @@
+"""Standing TPU-bench capture loop.
+
+Role: the reference measures its headline numbers with always-available
+GPUs (`example/image-classification/benchmark_score.py`); here the one
+real TPU chip sits behind a tunnel that can be wedged for hours and heal
+mid-round.  A one-shot probe at bench time therefore misses healthy
+windows.  This loop runs in the background for the whole round:
+
+  1. re-probes the accelerator on a fixed cadence (subprocess + hard
+     timeout, same hangs-don't-flake machinery as base.probe_accelerator),
+     appending every attempt to TPU_CAPTURE.log;
+  2. on the first healthy window, runs the full capture suite —
+     ResNet-50 train bench (bench.py), a flash-attention fwd+bwd
+     microbench, and a real-Mosaic (interpret=False) Pallas kernel
+     smoke — and persists the JSON results to TPU_CAPTURE.json;
+  3. bench.py consults TPU_CAPTURE.json when its own live probe fails,
+     so the driver's end-of-round run reports the captured TPU number
+     instead of the CPU fallback.
+
+Run:  nohup python tools/tpu_capture.py > /dev/null 2>&1 &
+"""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Load base.py standalone (NOT via the mxnet_tpu package __init__, which
+# imports jax — the parent loop must stay jax-free or a wedged axon tunnel
+# can hang the loop itself).  base.py only imports os/threading/typing.
+_spec = importlib.util.spec_from_file_location(
+    "_mx_base_standalone", os.path.join(REPO, "mxnet_tpu", "base.py"))
+_mx_base = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_mx_base)
+LOG = os.path.join(REPO, "TPU_CAPTURE.log")
+OUT = os.path.join(REPO, "TPU_CAPTURE.json")
+PROBE_TIMEOUT_S = 120
+CHILD_TIMEOUT_S = 1800
+PROBE_INTERVAL_S = 300          # 5 min cadence: ~144 probes over a 12h round
+MAX_HOURS = 13
+
+
+def _ts():
+    return time.strftime("%Y-%m-%dT%H:%M:%S%z")
+
+
+def _log(msg):
+    line = "%s %s" % (_ts(), msg)
+    with open(LOG, "a") as f:
+        f.write(line + "\n")
+
+
+def _probe():
+    """One un-memoized subprocess probe (shared helper in base.py)."""
+    return _mx_base.probe_accelerator_once(PROBE_TIMEOUT_S)
+
+
+def _run_json_child(argv, tag):
+    """Run a child that prints one JSON line; return the parsed dict or None."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("MX_FORCE_CPU", None)
+    # The bench.py child must MEASURE, not replay a prior capture — otherwise
+    # a stale result could be re-stamped with a fresh captured_at forever.
+    env["MX_NO_CAPTURE_FALLBACK"] = "1"
+    try:
+        r = subprocess.run(argv, env=env, timeout=CHILD_TIMEOUT_S, cwd=REPO,
+                           stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    except subprocess.TimeoutExpired:
+        _log("%s: TIMEOUT after %ss" % (tag, CHILD_TIMEOUT_S))
+        return None
+    lines = [l for l in r.stdout.decode(errors="replace").splitlines()
+             if l.startswith("{")]
+    if r.returncode != 0 or not lines:
+        _log("%s: rc=%s no-json; stderr tail: %s"
+             % (tag, r.returncode, r.stderr.decode(errors="replace")[-1500:]))
+        return None
+    try:
+        return json.loads(lines[-1])
+    except ValueError:
+        _log("%s: unparseable json: %r" % (tag, lines[-1][:200]))
+        return None
+
+
+def flash_microbench():
+    """Child mode: flash-attention fwd+bwd throughput on the live backend."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    sys.path.insert(0, REPO)
+    from mxnet_tpu.ops.attention import flash_attention
+
+    B, H, L, D = 4, 12, 2048, 64
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, H, L, D), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(B, H, L, D), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(B, H, L, D), jnp.bfloat16)
+
+    def loss(q, k, v):
+        out = flash_attention(q, k, v, 1.0 / np.sqrt(D), False)
+        return jnp.sum(out.astype(jnp.float32))
+
+    step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    out = step(q, k, v)
+    jax.block_until_ready(out)
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = step(q, k, v)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    # fwd 2*2*B*H*L^2*D FLOPs (QK^T + PV), bwd ~2.5x fwd
+    flops = 3.5 * 2 * 2 * B * H * L * L * D
+    print(json.dumps({
+        "metric": "flash_attention_fwd_bwd_tflops",
+        "value": round(flops * iters / dt / 1e12, 2), "unit": "TFLOP/s",
+        "device": jax.default_backend(),
+        "shape": [B, H, L, D],
+        "ms_per_step": round(dt / iters * 1e3, 2),
+    }))
+
+
+def mosaic_smoke():
+    """Child mode: execute a Pallas kernel with interpret=False (real Mosaic
+    lowering) and check numerics vs jnp — proves block specs + VMEM budgets
+    on hardware, which interpret-mode tests cannot."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    sys.path.insert(0, REPO)
+    from mxnet_tpu.ops.attention import flash_attention
+
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(2, 4, 512, 64), jnp.float32)
+    k = jnp.asarray(rng.randn(2, 4, 512, 64), jnp.float32)
+    v = jnp.asarray(rng.randn(2, 4, 512, 64), jnp.float32)
+    out = jax.jit(lambda q, k, v: flash_attention(q, k, v, 1.0 / np.sqrt(64),
+                                                  False))(q, k, v)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(64)
+    ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), v)
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref)))
+    assert err < 2e-2, err
+    print(json.dumps({
+        "metric": "pallas_mosaic_flash_max_abs_err", "value": round(err, 6),
+        "unit": "abs", "device": jax.default_backend(), "ok": True,
+    }))
+
+
+def capture():
+    """Run the full capture suite; returns dict of tag -> result (or None)."""
+    results = {}
+    results["resnet50_bench"] = _run_json_child(
+        [sys.executable, os.path.join(REPO, "bench.py")], "resnet50_bench")
+    results["flash_microbench"] = _run_json_child(
+        [sys.executable, os.path.abspath(__file__), "--child-flash"],
+        "flash_microbench")
+    results["mosaic_smoke"] = _run_json_child(
+        [sys.executable, os.path.abspath(__file__), "--child-mosaic"],
+        "mosaic_smoke")
+    return results
+
+
+def main():
+    if "--child-flash" in sys.argv:
+        flash_microbench()
+        return
+    if "--child-mosaic" in sys.argv:
+        mosaic_smoke()
+        return
+    once = "--once" in sys.argv
+    deadline = time.time() + MAX_HOURS * 3600
+    n = 0
+    if os.path.exists(OUT):
+        # A capture file can only describe an EARLIER round's window; remove
+        # it so a stale number can never masquerade as this round's.
+        os.remove(OUT)
+        _log("removed stale TPU_CAPTURE.json from a previous round")
+    _log("capture loop started (interval=%ss)" % PROBE_INTERVAL_S)
+    while time.time() < deadline:
+        n += 1
+        healthy = _probe()
+        _log("probe %d: %s" % (n, "HEALTHY" if healthy else "wedged"))
+        if healthy:
+            _log("running capture suite")
+            results = capture()
+            bench = results.get("resnet50_bench") or {}
+            if bench.get("device") not in (None, "cpu"):
+                import glob
+                payload = {"captured_at": _ts(), "probes": n,
+                           # Round identity: the driver writes BENCH_r{N}.json
+                           # at each round's END, so any BENCH file that
+                           # appears after this capture marks it as stale.
+                           "bench_files_at_capture": sorted(
+                               os.path.basename(p) for p in
+                               glob.glob(os.path.join(REPO, "BENCH_r*.json"))),
+                           "results": results}
+                tmp = OUT + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(payload, f, indent=1)
+                os.replace(tmp, OUT)  # atomic: bench.py may read concurrently
+                _log("capture SUCCESS -> TPU_CAPTURE.json")
+                return
+            _log("capture ran but bench device was %r; continuing"
+                 % bench.get("device"))
+        if once:
+            return
+        time.sleep(PROBE_INTERVAL_S)
+    _log("capture loop ended without a healthy window (%d probes)" % n)
+
+
+if __name__ == "__main__":
+    main()
