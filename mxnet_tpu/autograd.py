@@ -26,16 +26,26 @@ import jax.numpy as jnp
 from .base import MXNetError
 
 __all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
-           "is_training", "set_recording", "set_training", "backward", "grad",
-           "mark_variables", "Function", "VariableNode"]
+           "is_training", "set_recording", "set_training", "ambient_is_train",
+           "backward", "grad", "mark_variables", "Function", "VariableNode"]
 
 _state = threading.local()
+
+# Cross-thread mirror of which threads are currently recording/training.
+# XLA host callbacks (jax.pure_callback — the Custom-op bridge) execute on
+# runtime threads that never entered an autograd scope; ambient_is_train()
+# lets them see "is any thread training right now" instead of a fresh
+# thread-local default of False.
+_ambient_lock = threading.Lock()
+_recording_threads: set = set()
+_training_threads: set = set()
 
 
 def _st():
     if not hasattr(_state, "recording"):
         _state.recording = False
         _state.training = False
+        _state.explicit = False  # this thread never entered a scope
     return _state
 
 
@@ -47,10 +57,18 @@ def is_training() -> bool:
     return _st().training
 
 
+def _mirror(which: set, flag: bool) -> None:
+    ident = threading.get_ident()
+    with _ambient_lock:
+        (which.add if flag else which.discard)(ident)
+
+
 def set_recording(flag: bool) -> bool:
     st = _st()
     old = st.recording
     st.recording = bool(flag)
+    st.explicit = True
+    _mirror(_recording_threads, st.recording)
     return old
 
 
@@ -58,7 +76,22 @@ def set_training(flag: bool) -> bool:
     st = _st()
     old = st.training
     st.training = bool(flag)
+    st.explicit = True
+    _mirror(_training_threads, st.training)
     return old
+
+
+def ambient_is_train() -> bool:
+    """Per-call train flag for code running on a thread that may not own the
+    autograd scope (XLA host-callback threads).  Falls back to "any thread is
+    currently recording/training" — correct for the single-trainer process;
+    a process training and predicting on two threads at once sees train=True
+    on both callback paths (documented edge)."""
+    st = _st()
+    if st.explicit:
+        return st.recording or st.training
+    with _ambient_lock:
+        return bool(_recording_threads or _training_threads)
 
 
 class _Scope:
@@ -70,14 +103,14 @@ class _Scope:
         st = _st()
         self._old = (st.recording, st.training)
         if self._rec is not None:
-            st.recording = self._rec
+            set_recording(self._rec)
         if self._train is not None:
-            st.training = self._train
+            set_training(self._train)
         return self
 
     def __exit__(self, *exc):
-        st = _st()
-        st.recording, st.training = self._old
+        set_recording(self._old[0])
+        set_training(self._old[1])
         return False
 
 

@@ -44,33 +44,47 @@ def _get_optimal_threshold(arr: _np.ndarray, num_bins: int = 8001,
                            num_quantized_bins: int = 255) -> float:
     """KL-divergence calibration (reference: quantization.py
     _get_optimal_threshold): pick the |threshold| whose clipped+requantized
-    distribution diverges least from the original histogram."""
+    distribution diverges least from the original histogram.
+
+    The decisive detail (matching the reference): p carries the clipped
+    outlier mass in its last bin, but q is built from the UNCLIPPED slice —
+    so aggressive clipping shows up as p-mass with no q-mass and is
+    penalized by the KL term.  Every candidate bin from num_quantized_bins
+    to num_bins is scanned (no subsampling); the inner merge uses
+    ``_np.bincount`` so the full scan stays fast."""
     a = _np.abs(arr.ravel())
     amax = float(a.max()) if a.size else 0.0
     if amax == 0.0:
         return 1e-30
     hist, edges = _np.histogram(a, bins=num_bins, range=(0, amax))
-    zero_bin = 0  # histogram of |x|: everything is non-negative
+    hist = hist.astype(_np.float64)
+    csum = _np.cumsum(hist)
+    total = csum[-1]
+    arange = _np.arange(num_bins)
     best_kl, best_t = _np.inf, amax
-    # scan candidate thresholds from num_quantized_bins upward
-    for i in range(num_quantized_bins, num_bins + 1,
-                   max(1, (num_bins - num_quantized_bins) // 64)):
-        t = edges[i] if i < len(edges) else edges[-1]
-        p = hist[:i].astype(_np.float64).copy()
-        p[-1] += hist[i:].sum()  # clip outliers into the last bin
-        # quantize p down to num_quantized_bins then expand back
-        factor = i / num_quantized_bins
-        idx = (_np.arange(i) / factor).astype(_np.int64).clip(
-            0, num_quantized_bins - 1)
-        q_small = _np.zeros(num_quantized_bins)
-        _np.add.at(q_small, idx, p)
-        counts = _np.zeros(num_quantized_bins)
-        _np.add.at(counts, idx, (p > 0).astype(_np.float64))
+    for i in range(num_quantized_bins, num_bins + 1):
+        t = edges[i]
+        sliced = hist[:i]
+        p = sliced.copy()
+        p[-1] += total - csum[i - 1]  # clip outliers into the last bin
+        nonzero = p != 0
+        # merge the unclipped slice into num_quantized_bins groups,
+        # then expand back, spreading each group over its nonzero bins
+        num_merged = i // num_quantized_bins
+        idx = _np.minimum(arange[:i] // num_merged, num_quantized_bins - 1)
+        q_small = _np.bincount(idx, weights=sliced,
+                               minlength=num_quantized_bins)
+        counts = _np.bincount(idx, weights=nonzero.astype(_np.float64),
+                              minlength=num_quantized_bins)
         q = _np.zeros(i)
-        nz = counts[idx] > 0
-        q[nz] = (q_small[idx] / counts[idx])[nz] * (p[nz] > 0)
-        ps, qs = _smooth(p / max(p.sum(), 1e-30)), _smooth(
-            q / max(q.sum(), 1e-30))
+        valid = counts[idx] > 0
+        q[valid] = (q_small[idx] / _np.maximum(counts[idx], 1.0))[valid]
+        q[~nonzero] = 0.0
+        qsum = q.sum()
+        if qsum <= 0:
+            continue
+        ps = _smooth(p / p.sum())
+        qs = _smooth(q / qsum)
         kl = float(_np.sum(ps * _np.log(_np.maximum(ps, 1e-30)
                                         / _np.maximum(qs, 1e-30))))
         if kl < best_kl:
@@ -129,8 +143,26 @@ class _QuantizedForward:
                                        nd.array([self.w_min]),
                                        nd.array([self.w_max]),
                                        out_type="int8")
-        self.bias = block.bias.data() if getattr(block, "bias", None) \
+        # bias is pre-quantized once here too (the reference quantizes bias
+        # at conversion time) — never in the inference hot path
+        bias = block.bias.data() if getattr(block, "bias", None) \
             is not None else None
+        if bias is not None:
+            bnp = bias.asnumpy()
+            self.b_min = float(bnp.min())
+            self.b_max = float(bnp.max())
+            self.qbias, _, _ = nd.invoke("_contrib_quantize", bias,
+                                         nd.array([self.b_min]),
+                                         nd.array([self.b_max]),
+                                         out_type="int8")
+        else:
+            self.qbias, self.b_min, self.b_max = None, 0.0, 0.0
+        # all range scalars are conversion-time constants: build the device
+        # arrays ONCE so the inference hot path does zero host->device work
+        self._wmn = nd.array([self.w_min])
+        self._wmx = nd.array([self.w_max])
+        self._bmn = nd.array([self.b_min])
+        self._bmx = nd.array([self.b_max])
 
     def __call__(self, x):
         from .. import ndarray as nd
@@ -138,28 +170,18 @@ class _QuantizedForward:
                                 out_type=self.dtype,
                                 min_calib_range=self.in_min,
                                 max_calib_range=self.in_max)
-        b = self.bias
-        if b is not None:
-            bnp = b.asnumpy()
-            bmin, bmax = float(bnp.min()), float(bnp.max())
-            qb, _, _ = nd.invoke("_contrib_quantize", b,
-                                 nd.array([bmin]), nd.array([bmax]),
-                                 out_type="int8")
-        else:
-            qb, bmin, bmax = None, 0.0, 0.0
+        qb = self.qbias
         if self.kind == "dense":
             acc, omn, omx = nd.invoke(
                 "_contrib_quantized_fully_connected", qx, self.qweight, qb,
-                mn, mx_, nd.array([self.w_min]), nd.array([self.w_max]),
-                nd.array([bmin]), nd.array([bmax]),
+                mn, mx_, self._wmn, self._wmx, self._bmn, self._bmx,
                 num_hidden=self.block._units, no_bias=qb is None,
                 flatten=self.block._flatten)
         else:
             blk = self.block
             acc, omn, omx = nd.invoke(
                 "_contrib_quantized_conv", qx, self.qweight, qb,
-                mn, mx_, nd.array([self.w_min]), nd.array([self.w_max]),
-                nd.array([bmin]), nd.array([bmax]),
+                mn, mx_, self._wmn, self._wmx, self._bmn, self._bmx,
                 kernel=blk._kernel, stride=blk._stride, dilate=blk._dilate,
                 pad=blk._pad, num_filter=blk._channels,
                 num_group=blk._groups, no_bias=qb is None)

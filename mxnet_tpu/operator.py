@@ -157,9 +157,14 @@ def Custom(*inputs, op_type: Optional[str] = None, **kwargs):
                       for s, t in zip(out_shapes, out_dtypes))
     in_avals = tuple(jax.ShapeDtypeStruct(s, t)
                      for s, t in zip(in_shapes, in_dtypes))
-    is_train = autograd.is_training() or autograd.is_recording()
-
     def _fwd_cb(*xs):
+        # is_train is re-derived at CALLBACK time, not closed over at trace
+        # time: under hybridize the first trace's value would otherwise be
+        # frozen into every later call (the reference passes per-call
+        # is_train to CustomOp.forward).  ambient_is_train() (not
+        # is_training()) because pure_callback may run on an XLA runtime
+        # thread whose thread-local autograd state was never set.
+        is_train = autograd.ambient_is_train()
         in_data = _writable(xs)
         out_data = [_np.zeros(s, t) for s, t in zip(out_shapes, out_dtypes)]
         op.forward(is_train, ["write"] * n_out, in_data, out_data, [])

@@ -120,8 +120,12 @@ def _quantized_fc(data, weight, bias, min_data, max_data, min_weight,
     """int8 GEMM on the MXU: int8×int8→int32 dot, bias folded in at the
     accumulator scale (reference: quantized_fully_connected.cc)."""
     x = data.reshape(data.shape[0], -1) if flatten and data.ndim > 2 else data
+    # uint8 activations must NOT be cast to int8 (values >127 would wrap
+    # modulo 256): widen both sides to int32 and accumulate in int32 —
+    # correct u8×s8 math at the cost of leaving the s8s8 MXU path.
+    lt = jnp.int32 if x.dtype == jnp.uint8.dtype else jnp.int8
     acc = lax.dot_general(
-        x.astype(jnp.int8), weight.astype(jnp.int8),
+        x.astype(lt), weight.astype(lt),
         (((x.ndim - 1,), (1,)), ((), ())),
         preferred_element_type=jnp.int32)
     sx = _deq_scale(min_data, max_data, x.dtype)
@@ -152,8 +156,10 @@ def _quantized_conv(data, weight, bias, min_data, max_data, min_weight,
     dn = lax.conv_dimension_numbers(
         data.shape, weight.shape,
         ("NC" + spatial, "OI" + spatial, "NC" + spatial))
+    # see _quantized_fc: uint8 data would wrap under an int8 cast
+    lt = jnp.int32 if data.dtype == jnp.uint8.dtype else jnp.int8
     acc = lax.conv_general_dilated(
-        data.astype(jnp.int8), weight.astype(jnp.int8),
+        data.astype(lt), weight.astype(lt),
         window_strides=stride, padding=[(p, p) for p in pad],
         rhs_dilation=dilate, dimension_numbers=dn,
         feature_group_count=num_group,

@@ -483,6 +483,15 @@ def _anchors():
 
 # Ops exercised by dedicated suites rather than the battery:
 TESTED_ELSEWHERE = {
+    "_contrib_quantize": "tests/test_quantization.py",
+    "_contrib_quantize_v2": "tests/test_quantization.py",
+    "_contrib_dequantize": "tests/test_quantization.py",
+    "_contrib_requantize": "tests/test_quantization.py",
+    "_contrib_quantized_fully_connected": "tests/test_quantization.py",
+    "_contrib_quantized_conv": "tests/test_quantization.py",
+    "_contrib_quantized_pooling": "tests/test_quantization.py",
+    "_contrib_quantized_flatten": "tests/test_quantization.py",
+    "_contrib_quantized_act": "tests/test_quantization.py",
     "LinearRegressionOutput": "tests/test_module.py",
     "MAERegressionOutput": "tests/test_module.py",
     "LogisticRegressionOutput": "tests/test_module.py",

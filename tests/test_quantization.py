@@ -100,6 +100,53 @@ def test_quantized_pooling_and_flatten_pass_range():
     assert f.shape == (1, 4)
 
 
+def test_requantize_int32_to_int8():
+    rng = np.random.RandomState(7)
+    x = rng.randn(4, 8).astype(np.float32)
+    mn, mx_ = float(x.min()), float(x.max())
+    q, qmn, qmx = nd.invoke("_contrib_quantize", nd.array(x),
+                            nd.array([mn]), nd.array([mx_]), out_type="int8")
+    # fake an int32 accumulator carrying the same values: acc = q * 2^16,
+    # so full-scale 2^31 corresponds to amax_range/127 * 2^15 in float
+    acc = q.asnumpy().astype(np.int32) * (1 << 16)
+    amax = max(abs(mn), abs(mx_)) * (2.0 ** 31) / (127.0 * (1 << 16))
+    r, rmn, rmx = nd.invoke("_contrib_requantize", nd.array(acc),
+                            nd.array([-amax]), nd.array([amax]))
+    assert r.dtype == np.int8
+    back = nd.invoke("_contrib_dequantize", r, rmn, rmx).asnumpy()
+    np.testing.assert_allclose(back, x, atol=2 * max(abs(mn), abs(mx_)) / 127)
+
+
+def test_quantized_act_relu():
+    x = np.array([-5, -1, 0, 3, 7], np.int8)
+    out, mn, mx_ = nd.invoke("_contrib_quantized_act", nd.array(x),
+                             nd.array([-1.0]), nd.array([2.0]),
+                             act_type="relu")
+    np.testing.assert_array_equal(out.asnumpy(), [0, 0, 0, 3, 7])
+    assert float(mn.asnumpy()[0]) == 0.0
+    assert float(mx_.asnumpy()[0]) == 2.0
+
+
+def test_quantized_fc_uint8_data():
+    # uint8 activations must not wrap modulo 256 in the GEMM
+    rng = np.random.RandomState(8)
+    x = rng.rand(4, 16).astype(np.float32) * 3  # non-negative -> uint8 range
+    w = rng.randn(6, 16).astype(np.float32)
+    qx, qxmn, qxmx = nd.invoke("_contrib_quantize", nd.array(x),
+                               nd.array([0.0]), nd.array([3.0]),
+                               out_type="uint8")
+    assert qx.asnumpy().max() > 127  # the wrap-prone regime
+    wmn, wmx = nd.array([float(w.min())]), nd.array([float(w.max())])
+    qw, _, _ = nd.invoke("_contrib_quantize", nd.array(w), wmn, wmx,
+                         out_type="int8")
+    acc, omn, omx = nd.invoke("_contrib_quantized_fully_connected",
+                              qx, qw, None, qxmn, qxmx, wmn, wmx,
+                              num_hidden=6, no_bias=True)
+    out = nd.invoke("_contrib_dequantize", acc, omn, omx).asnumpy()
+    expect = x @ w.T
+    assert np.abs(out - expect).max() < 0.05 * np.abs(expect).max()
+
+
 def test_optimal_threshold_sane():
     rng = np.random.RandomState(3)
     x = rng.randn(20000).astype(np.float32)
@@ -140,11 +187,13 @@ def test_quantize_net_conv_entropy():
 
 
 def test_quantize_net_excludes():
+    # exclude_layers names are structural child paths: HybridSequential's
+    # direct children are "0", "1", ... (nested blocks dot-join: "0.body.2")
     net = mx.gluon.nn.HybridSequential()
     net.add(mx.gluon.nn.Dense(8), mx.gluon.nn.Dense(4))
     net.initialize()
     x = nd.ones((2, 6))
-    quantize_net(net, calib_data=[x], exclude_layers=["0.0"])
-    kids = list(net._children.values())[0]._children
-    assert not getattr(list(kids.values())[0], "_quantized", False)
-    assert getattr(list(kids.values())[1], "_quantized", False)
+    quantize_net(net, calib_data=[x], exclude_layers=["0"])
+    kids = list(net._children.values())
+    assert not getattr(kids[0], "_quantized", False)
+    assert getattr(kids[1], "_quantized", False)
