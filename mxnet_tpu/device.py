@@ -24,6 +24,10 @@ _ACCEL_PLATFORMS = ("tpu", "axon")  # axon = tunneled TPU platform name
 
 
 def _accel_devices() -> List[jax.Device]:
+    """Device ids are PROCESS-LOCAL, like the reference's per-worker gpu(i):
+    under jax.distributed, rank r's cpu(0)/tpu(0) must resolve to one of
+    r's own (addressable) devices, never another process's — hence
+    jax.local_devices, not jax.devices."""
     import os
     if os.environ.get("MX_FORCE_CPU"):
         # test harness: pretend no accelerator so tpu(i) maps onto the fake
@@ -31,7 +35,7 @@ def _accel_devices() -> List[jax.Device]:
         return []
     for plat in _ACCEL_PLATFORMS:
         try:
-            devs = jax.devices(plat)
+            devs = jax.local_devices(backend=plat)
             if devs:
                 return devs
         except RuntimeError:
@@ -41,10 +45,10 @@ def _accel_devices() -> List[jax.Device]:
 
 def _cpu_devices() -> List[jax.Device]:
     try:
-        return jax.devices("cpu")
+        return jax.local_devices(backend="cpu")
     except RuntimeError:
         # No cpu backend registered (rare); fall back to default platform.
-        return jax.devices()
+        return jax.local_devices()
 
 
 class Context:

@@ -88,22 +88,33 @@ class Trainer:
         self._kvstore = None
         self._update_on_kvstore = None
         self._params_to_init = [p for p in self._params]
+        self._kv_broadcast_done: set = set()
 
     def _init_kvstore(self):
         config = self._kvstore_params
         kvstore = config["kvstore"]
         update_on_kvstore = config["update_on_kvstore"]
-        if kvstore and len(self._contexts) > 1:
+        try:
+            import jax
+            nproc = jax.process_count()
+        except Exception:
+            nproc = 1
+        # multi-process (jax.distributed) needs the kvstore even with ONE
+        # local context: the cross-process allreduce lives there
+        if kvstore and (len(self._contexts) > 1 or nproc > 1):
             # pick 'ici' for accelerator contexts like the reference picks
             # nccl/device for GPUs
             if isinstance(kvstore, str):
                 if kvstore == "device" and \
-                        any(c.canonical_type == "tpu" for c in self._contexts):
+                        (nproc > 1 or any(c.canonical_type == "tpu"
+                                          for c in self._contexts)):
                     kvstore = "ici"
                 kv = kv_create(kvstore)
             else:
                 kv = kvstore
             self._kvstore = kv
+            if self._compression_params:
+                kv.set_gradient_compression(self._compression_params)
             if update_on_kvstore is None:
                 update_on_kvstore = False
             if update_on_kvstore:
@@ -120,9 +131,18 @@ class Trainer:
             self._params_to_init = []
             return
         for i, param in enumerate(self._params):
-            if param._deferred_init is not None:
+            if param._deferred_init is not None \
+                    or i in self._kv_broadcast_done:
+                # already-broadcast params must NOT be re-pulled: after the
+                # first step the store slot holds the reduced GRADIENT
+                # (update_on_kvstore=False), not a weight
                 continue
-            self._kvstore.init(i, param.data(self._contexts[0]))
+            # broadcast, not bare init: every device copy (and on multi-
+            # process stores every WORKER) starts from the store's agreed
+            # value — the reference Trainer._init_params kvstore.broadcast
+            self._kvstore.broadcast(i, param.data(self._contexts[0]),
+                                    out=param.list_data())
+            self._kv_broadcast_done.add(i)
         self._params_to_init = [p for p in self._params_to_init
                                 if p._deferred_init is not None]
 
@@ -169,7 +189,10 @@ class Trainer:
             if param.grad_req == "null":
                 continue
             grads = param.list_grad()
-            if len(grads) <= 1 and not self._update_on_kvstore:
+            if len(grads) <= 1 and not self._update_on_kvstore \
+                    and self._kvstore.num_workers <= 1:
+                # single grad, single worker: nothing to reduce — but a
+                # multi-process store must still see the push (allreduce)
                 continue
             self._kvstore.push(i, grads)
             if self._update_on_kvstore:

@@ -159,6 +159,23 @@ class Constant(Initializer):
         arr[:] = _np.asarray(self.value)
 
 
+def _draw_uniform(low, high, shape):
+    """All initializer randomness rides the mx.random.seed stream (the
+    reference seeds initializers through MXNet's RNG, not numpy's): same
+    seed => same init on every process — the property multi-host DP relies
+    on before the first weight broadcast."""
+    import jax
+    from .ops.random import next_key
+    return jax.random.uniform(next_key(), tuple(shape), minval=low,
+                              maxval=high)
+
+
+def _draw_normal(mean, sigma, shape):
+    import jax
+    from .ops.random import next_key
+    return jax.random.normal(next_key(), tuple(shape)) * sigma + mean
+
+
 @register
 class Uniform(Initializer):
     """U(-scale, scale) (reference default scale 0.07)."""
@@ -168,7 +185,7 @@ class Uniform(Initializer):
         self.scale = scale
 
     def _init_weight(self, name, arr):
-        arr[:] = _np.random.uniform(-self.scale, self.scale, arr.shape)
+        arr[:] = _draw_uniform(-self.scale, self.scale, arr.shape)
 
 
 @register
@@ -180,7 +197,7 @@ class Normal(Initializer):
         self.sigma = sigma
 
     def _init_weight(self, name, arr):
-        arr[:] = _np.random.normal(0.0, self.sigma, arr.shape)
+        arr[:] = _draw_normal(0.0, self.sigma, arr.shape)
 
 
 @register
@@ -196,9 +213,9 @@ class Orthogonal(Initializer):
         nout = arr.shape[0]
         nin = int(_np.prod(arr.shape[1:])) if len(arr.shape) > 1 else 1
         if self.rand_type == "uniform":
-            tmp = _np.random.uniform(-1.0, 1.0, (nout, nin))
+            tmp = _np.asarray(_draw_uniform(-1.0, 1.0, (nout, nin)))
         else:
-            tmp = _np.random.normal(0.0, 1.0, (nout, nin))
+            tmp = _np.asarray(_draw_normal(0.0, 1.0, (nout, nin)))
         u, _, v = _np.linalg.svd(tmp, full_matrices=False)
         q = u if u.shape == tmp.shape else v
         arr[:] = (self.scale * q).reshape(arr.shape)
@@ -235,9 +252,9 @@ class Xavier(Initializer):
             raise ValueError("Incorrect factor type")
         scale = math.sqrt(self.magnitude / factor)
         if self.rnd_type == "uniform":
-            arr[:] = _np.random.uniform(-scale, scale, shape)
+            arr[:] = _draw_uniform(-scale, scale, shape)
         elif self.rnd_type == "gaussian":
-            arr[:] = _np.random.normal(0, scale, shape)
+            arr[:] = _draw_normal(0, scale, shape)
         else:
             raise ValueError("Unknown random type")
 

@@ -151,9 +151,35 @@ class KVStore:
         self._updater = get_updater(optimizer)
 
     def set_gradient_compression(self, compression_params):
-        # 2-bit PS compression is N/A on the collective path; bf16 grad
-        # compression arrives with parallel/ (gradient buckets).
-        pass
+        """Reference: kvstore.py set_gradient_compression →
+        src/kvstore/gradient_compression.cc (2-bit PS compression).
+
+        TPU collective path: ``{'type': 'bf16'}`` is the supported scheme —
+        gradients are cast to bfloat16 before the allreduce (half the
+        ICI/DCN bytes, the SURVEY-sanctioned equivalent of the reference's
+        2-bit PS compression).  Anything else warns loudly instead of
+        silently succeeding."""
+        import warnings
+        ctype = (compression_params or {}).get("type")
+        if ctype == "bf16":
+            self._compress_bf16 = True
+            return
+        self._compress_bf16 = False  # unsupported/None DISABLES compression
+        if ctype is not None:
+            warnings.warn(
+                "gradient compression %r is not supported on the TPU "
+                "collective path (no parameter server to dequantize); "
+                "gradients will NOT be compressed. Use {'type': 'bf16'} "
+                "for bfloat16 allreduce compression." % (ctype,),
+                stacklevel=2)
+
+    def _maybe_compress(self, x):
+        """bf16 cast applied to gradient payloads before the reduce."""
+        if getattr(self, "_compress_bf16", False) and \
+                jnp.issubdtype(x.dtype, jnp.floating) and \
+                x.dtype != jnp.bfloat16.dtype:
+            return x.astype(jnp.bfloat16), x.dtype
+        return x, None
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
         assert self._updater is not None, "Cannot save states for fused optimizer"
@@ -179,9 +205,15 @@ class KVStore:
         if len(values) == 1:
             return values[0]
         target = values[0].context
-        vals = [v._jax if v.context == target else
-                jax.device_put(v._jax, target.jax_device) for v in values]
-        return NDArray(_sum_arrays(vals), ctx=target)
+        comp = [self._maybe_compress(v._jax) for v in values]
+        orig_dtype = comp[0][1]
+        vals = [(x if v.context == target else
+                 jax.device_put(x, target.jax_device))
+                for (x, _), v in zip(comp, values)]
+        out = _sum_arrays(vals)
+        if orig_dtype is not None:
+            out = out.astype(orig_dtype)
+        return NDArray(out, ctx=target)
 
 
 class KVStoreLocal(KVStore):
@@ -203,14 +235,17 @@ class KVStoreDevice(KVStoreLocal):
 
 
 class KVStoreICI(KVStoreLocal):
-    """Collective store over the TPU mesh (reference role: KVStoreNCCL;
-    SURVEY.md §5.8 `kvstore='ici'`).
+    """Collective store over the TPU mesh (reference role: KVStoreNCCL +
+    KVStoreDist's dist_sync contract; SURVEY.md §5.8 `kvstore='ici'`).
 
     Single-host: device-copies are reduced with one jitted sum (XLA emits
-    ICI transfers).  Multi-host: rank/num_workers come from jax.distributed
-    and the reduce runs as a psum inside the sharded train step
-    (mxnet_tpu.parallel); this object keeps the KVStore API so Trainer code
-    is unchanged.
+    ICI transfers).  Multi-host (`jax.process_count() > 1` after
+    mxnet_tpu.parallel.init_process_group): every push additionally
+    allreduces across processes — a jitted sum over a global 1-axis mesh,
+    lowered by XLA to collectives over ICI within a slice and DCN across
+    slices.  The dist_sync contract matches the reference
+    (src/kvstore/kvstore_dist.h KVStoreDist::PushPullImpl): a pull after N
+    workers push returns the N-worker SUM.
     """
 
     def __init__(self):
@@ -223,6 +258,9 @@ class KVStoreICI(KVStoreLocal):
             self._size = jax.process_count()
         except Exception:
             pass
+        self._mesh = None
+        self._home_dev = None
+        self._xsum_cache: Dict = {}
 
     @property
     def type(self):
@@ -235,6 +273,78 @@ class KVStoreICI(KVStoreLocal):
     @property
     def num_workers(self):
         return self._size
+
+    def init(self, key, value):
+        """Multi-process init carries the reference's dist contract: the
+        stored value is RANK 0's (kvstore_dist.h: only one worker's init
+        reaches the server), so a subsequent broadcast/pull hands every
+        worker identical weights regardless of local RNG state."""
+        super().init(key, value)
+        if self._size > 1:
+            keys, _ = self._normalize(key, value)
+            for k in keys:
+                stored = self._store[k]
+                payload = stored._jax if self._rank == 0 else \
+                    jnp.zeros_like(stored._jax)
+                agreed = self._cross_process_sum(payload)
+                stored._set_jax(jax.device_put(agreed.addressable_data(0),
+                                               stored.context.jax_device))
+
+    # -- cross-process allreduce -------------------------------------------
+    def _ensure_mesh(self):
+        """1-axis mesh with ONE device per process: the locally merged
+        value is already a single array, so a per-process representative
+        device is all the collective needs (a Mesh may legally span a
+        subset of devices; every process contributes its device 0)."""
+        if self._mesh is None:
+            import numpy as np
+            from jax.sharding import Mesh
+            firsts = {}
+            for d in sorted(jax.devices(), key=lambda d: d.id):
+                firsts.setdefault(d.process_index, d)
+            devs = [firsts[p] for p in sorted(firsts)]
+            self._home_dev = firsts[self._rank]
+            self._mesh = Mesh(np.array(devs), ("dp",))
+        return self._mesh
+
+    def _cross_process_sum(self, x):
+        """Cross-process allreduce: stack each process's payload as one
+        shard of a (num_workers, ...) global array, jitted sum over the
+        mesh axis, result replicated — XLA lowers this to a collective
+        over ICI/DCN.  Exact for integer dtypes (no padding, no scaling)."""
+        mesh = self._ensure_mesh()
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        key = (x.shape, str(x.dtype))
+        fn = self._xsum_cache.get(key)
+        if fn is None:
+            fn = jax.jit(lambda y: jnp.sum(y, axis=0),
+                         in_shardings=NamedSharding(mesh, P("dp")),
+                         out_shardings=NamedSharding(mesh, P()))
+            self._xsum_cache[key] = fn
+        shard = jax.device_put(x[None], self._home_dev)
+        stacked = jax.make_array_from_single_device_arrays(
+            (self._size,) + tuple(x.shape),
+            NamedSharding(mesh, P("dp")), [shard])
+        return fn(stacked)
+
+    def _reduce(self, values: List[NDArray]) -> NDArray:
+        merged = super()._reduce(values)
+        if self._size > 1:
+            payload, orig_dtype = self._maybe_compress(merged._jax)
+            out = self._cross_process_sum(payload)
+            if orig_dtype is not None:
+                out = out.astype(orig_dtype)
+            # out is replicated over the global mesh; its local shard IS the
+            # full value — re-home it on the store's device
+            out = jax.device_put(out.addressable_data(0),
+                                 merged.context.jax_device)
+            merged = NDArray(out, ctx=merged.context)
+        return merged
+
+    def _barrier(self):
+        if self._size > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("mx_kvstore_barrier")
 
 
 _STORES = {

@@ -1,0 +1,184 @@
+"""dist_sync contract for kvstore='ici': cross-process allreduce.
+
+Reference pattern: tests/nightly/dist_sync_kvstore.py — N local worker
+processes push rank-distinguishable payloads and assert the pull equals the
+num_workers-sum (src/kvstore/kvstore_dist.h KVStoreDist::PushPullImpl
+semantics), plus a Trainer.step gradient-equality check across processes.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _launch(script_path, n=2, xla_flags=None):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # conftest's forced 8-dev count breaks pairing
+    if xla_flags:
+        env["XLA_FLAGS"] = xla_flags
+    r = subprocess.run([sys.executable,
+                        os.path.join(REPO, "tools", "launch.py"),
+                        "-n", str(n), "--launcher", "local", "--",
+                        sys.executable, str(script_path)],
+                       capture_output=True, text=True, timeout=300, env=env)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    return r.stdout
+
+
+_PRELUDE = """
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["MX_FORCE_CPU"] = "1"
+    sys.path.insert(0, %r)
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import init_process_group
+    init_process_group()
+    import jax
+    import numpy as np
+    from mxnet_tpu import nd, autograd
+""" % REPO
+
+
+def test_pushpull_is_num_workers_sum(tmp_path):
+    script = tmp_path / "w.py"
+    script.write_text(textwrap.dedent(_PRELUDE) + textwrap.dedent("""
+        from mxnet_tpu import kvstore
+        kv = kvstore.create("ici")
+        assert kv.num_workers == 2, kv.num_workers
+        rank = kv.rank
+
+        # float payload: worker r pushes (r+1)*base; pull must be 3*base
+        base = np.array([1., 2., 3., 4.], np.float32)
+        kv.init("f", nd.zeros((4,)))
+        kv.push("f", nd.array(base * (rank + 1)))
+        out = nd.zeros((4,))
+        kv.pull("f", out=out)
+        np.testing.assert_allclose(out.asnumpy(), base * 3, rtol=1e-6)
+
+        # integer payload must be exact (no averaging artifacts)
+        kv.init("i", nd.zeros((3,), dtype="int32"))
+        kv.push("i", nd.array(np.full(3, rank + 10, np.int32)))
+        oi = nd.zeros((3,), dtype="int32")
+        kv.pull("i", out=oi)
+        np.testing.assert_array_equal(oi.asnumpy(), np.full(3, 21, np.int32))
+
+        # fused pushpull
+        kv.init("g", nd.zeros((2,)))
+        o = nd.zeros((2,))
+        kv.pushpull("g", nd.array(np.full(2, rank + 1.0, np.float32)), out=o)
+        np.testing.assert_allclose(o.asnumpy(), [3., 3.])
+        print("PUSHPULL_OK rank", rank, flush=True)
+    """))
+    out = _launch(script)
+    assert out.count("PUSHPULL_OK") == 2
+
+
+def test_pushpull_multi_local_device(tmp_path):
+    """2 processes x 2 local devices: the payload rides local device 0,
+    zeros pad the rest — the sum must still be the num_workers-sum."""
+    script = tmp_path / "w.py"
+    script.write_text(textwrap.dedent(_PRELUDE) + textwrap.dedent("""
+        assert len(jax.local_devices()) == 2, jax.local_devices()
+        from mxnet_tpu import kvstore
+        kv = kvstore.create("ici")
+        rank = kv.rank
+        kv.init("k", nd.zeros((5,)))
+        kv.push("k", nd.array(np.arange(5, dtype=np.float32) + 10 * rank))
+        out = nd.zeros((5,))
+        kv.pull("k", out=out)
+        expect = 2 * np.arange(5, dtype=np.float32) + 10.0  # sum of ranks
+        np.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-6)
+        print("MULTIDEV_OK rank", rank, flush=True)
+    """))
+    out = _launch(script,
+                  xla_flags="--xla_force_host_platform_device_count=2")
+    assert out.count("MULTIDEV_OK") == 2
+
+
+def test_trainer_step_matches_serial_reference(tmp_path):
+    """Each worker trains on its own batch; after Trainer.step the weights
+    must (a) be identical across workers and (b) equal the serial update
+    computed from BOTH batches — the reference's dist-sync training
+    invariant."""
+    script = tmp_path / "w.py"
+    script.write_text(textwrap.dedent(_PRELUDE) + textwrap.dedent("""
+        from jax.experimental import multihost_utils
+        rank = jax.process_index()
+
+        def fresh_net(w=None):
+            mx.random.seed(7)           # identical init across RANKS (the
+            net = mx.gluon.nn.Dense(1, use_bias=False, in_units=3)
+            net.initialize(mx.init.Xavier())
+            if w is not None:           # draw order advances the stream, so
+                net.weight.set_data(nd.array(w))  # clones copy explicitly
+            return net
+
+        def batch(r):
+            rng = np.random.RandomState(100 + r)
+            x = rng.randn(4, 3).astype(np.float32)
+            y = rng.randn(4, 1).astype(np.float32)
+            return nd.array(x), nd.array(y)
+
+        def grad_of(net, x, y):
+            with autograd.record():
+                loss = ((net(x) - y) ** 2).mean()
+            loss.backward()
+            return net.weight.grad().asnumpy().copy()
+
+        # -- distributed: my batch only, Trainer with kvstore='ici' --------
+        net = fresh_net()
+        w0 = net.weight.data().asnumpy().copy()
+        trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                                   {"learning_rate": 0.5, "wd": 0.0},
+                                   kvstore="ici")
+        x, y = batch(rank)
+        with autograd.record():
+            loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        trainer.step(2)                  # global batch: 2 workers
+        w_dist = net.weight.data().asnumpy()
+
+        # -- serial reference: both batches, num_workers-sum of grads ------
+        ref = fresh_net(w0)
+        g0 = grad_of(ref, *batch(0))
+        g1 = grad_of(ref, *batch(1))
+        w_exp = w0 - 0.5 * (g0 + g1) / 2.0
+
+        np.testing.assert_allclose(w_dist, w_exp, rtol=1e-5, atol=1e-6)
+        # identical across workers
+        allw = multihost_utils.process_allgather(w_dist)
+        np.testing.assert_allclose(allw[0], allw[-1], rtol=0, atol=0)
+        print("TRAINER_OK rank", rank, flush=True)
+    """))
+    out = _launch(script)
+    assert out.count("TRAINER_OK") == 2
+
+
+def test_gradient_compression_bf16(tmp_path):
+    """set_gradient_compression({'type': 'bf16'}) casts the allreduce
+    payload to bfloat16; anything else warns (never a silent no-op)."""
+    script = tmp_path / "w.py"
+    script.write_text(textwrap.dedent(_PRELUDE) + textwrap.dedent("""
+        import warnings
+        from mxnet_tpu import kvstore
+        kv = kvstore.create("ici")
+        rank = kv.rank
+        kv.set_gradient_compression({"type": "bf16"})
+        kv.init("c", nd.zeros((4,)))
+        v = np.array([1.0, 2.0, 3.0, 4.5], np.float32)
+        kv.push("c", nd.array(v * (rank + 1)))
+        out = nd.zeros((4,))
+        kv.pull("c", out=out)
+        # bf16 has ~3 decimal digits: sum 3*v to bf16 precision
+        np.testing.assert_allclose(out.asnumpy(), 3 * v, rtol=2e-2)
+        assert out.dtype == np.float32          # decompressed on arrival
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+        assert any("not supported" in str(x.message) for x in w), w
+        print("COMPRESS_OK rank", rank, flush=True)
+    """))
+    out = _launch(script)
+    assert out.count("COMPRESS_OK") == 2

@@ -200,6 +200,58 @@ def test_trainer_sgd_matches_manual():
     assert np.allclose(net.weight.data().asnumpy(), expect, atol=1e-6)
 
 
+def test_trainer_deferred_param_does_not_clobber_weights():
+    """Re-entering _init_params while a deferred param is pending must not
+    re-broadcast already-trained params: their store slot holds the reduced
+    GRADIENT after a step (update_on_kvstore=False), not a weight."""
+    ctxs = [mx.cpu(0), mx.cpu(1)]
+    used = nn.Dense(2, in_units=3, use_bias=False)
+    unused = nn.Dense(2)                # frozen branch, never forwarded:
+    for p in unused.collect_params().values():
+        p.grad_req = "null"             # stays deferred across steps
+    used.initialize(mx.init.Normal(1.0), ctx=ctxs)
+    unused.initialize(mx.init.Normal(1.0), ctx=ctxs)
+    params = list(used.collect_params().values()) + \
+        list(unused.collect_params().values())
+    trainer = gluon.Trainer(params, "sgd", {"learning_rate": 0.5},
+                            kvstore="ici")
+
+    def step(i):
+        xs = [mx.nd.array(np.full((2, 3), i + 1 + j, np.float32), ctx=c)
+              for j, c in enumerate(ctxs)]
+        with autograd.record():
+            ls = [(used(x) ** 2).mean() for x in xs]
+        for l in ls:
+            l.backward()
+        w_before = used.weight.data(ctxs[0]).asnumpy().copy()
+        gsum = sum(used.weight.grad(c).asnumpy() for c in ctxs)
+        trainer.step(4)
+        return w_before - 0.5 * gsum / 4
+
+    step(0)
+    assert trainer._params_to_init          # unused is still deferred
+    expect2 = step(1)                       # re-enters _init_params
+    np.testing.assert_allclose(used.weight.data(ctxs[0]).asnumpy(),
+                               expect2, rtol=1e-5, atol=1e-7)
+
+
+def test_trainer_compression_params_reach_kvstore():
+    net = nn.Dense(2, in_units=3)
+    net.initialize(ctx=[mx.cpu(0), mx.cpu(1)])
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore="ici",
+                            compression_params={"type": "bf16"})
+    x = mx.nd.ones((4, 3))
+    with autograd.record():
+        ls = [net(x.as_in_context(c)).sum()
+              for c in (mx.cpu(0), mx.cpu(1))]
+    for l in ls:
+        l.backward()
+    trainer.step(8)
+    assert trainer._kvstore is not None
+    assert getattr(trainer._kvstore, "_compress_bf16", False) is True
+
+
 def test_trainer_save_load_states(tmp_path):
     net = nn.Dense(2, in_units=3)
     net.initialize()
