@@ -53,6 +53,10 @@ def _get_optimal_threshold(arr: _np.ndarray, num_bins: int = 8001,
     to num_bins is scanned (no subsampling); the inner merge uses
     ``_np.bincount`` so the full scan stays fast."""
     a = _np.abs(arr.ravel())
+    # exact zeros carry no quantization information (0 requantizes exactly
+    # at any threshold) and a post-relu zero spike would otherwise dominate
+    # the KL optimum; the reference strips them before the histogram
+    a = a[a != 0]
     amax = float(a.max()) if a.size else 0.0
     if amax == 0.0:
         return 1e-30

@@ -59,9 +59,10 @@ def _attention_jnp(q, k, v, scale, causal):
 # ---------------------------------------------------------------------------
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal,
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
                       block_k, seq_k):
-    # refs: q (block_q, D), k/v (seq_k, D), o (block_q, D); grid=(BH, Tq/bq)
+    # refs: q (block_q, D), k/v (seq_k, D), o (block_q, D), lse (block_q,);
+    # grid=(BH, Tq/bq)
     import jax.experimental.pallas as pl
 
     block_q, d = q_ref.shape
@@ -98,17 +99,26 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal,
 
     if causal:
         # only key blocks at or before this query block contribute
-        num_kb_eff = (q_idx + 1) * block_q // block_k
+        # (ceil-div: correct for any block_q/block_k ratio)
+        num_kb_eff = ((q_idx + 1) * block_q + block_k - 1) // block_k
         num_kb_eff = jnp.minimum(num_kb_eff, num_kb)
         m, l, acc = lax.fori_loop(0, num_kb_eff, body, (m, l, acc))
     else:
         m, l, acc = lax.fori_loop(0, num_kb, body, (m, l, acc))
 
     o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    # logsumexp residual for the flash backward: lse = m + log(l)
+    # (softmax prob recomputes as exp(s - lse)); -inf for fully-masked rows
+    lse = jnp.where(l > 0,
+                    jnp.where(jnp.isfinite(m), m, 0.0)
+                    + jnp.log(jnp.maximum(l, 1e-30)),
+                    -jnp.inf)
+    lse_ref[:] = lse[:, 0]
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q=_BLOCK_Q, block_k=_BLOCK_K):
-    """q,k,v: (B, H, T, D) with T % block == 0."""
+    """q,k,v: (B, H, T, D) with T % block == 0.  Returns (out, lse) with
+    lse (B, H, Tq) — the backward's recompute residual."""
     import jax.experimental.pallas as pl
 
     B, H, Tq, D = q.shape
@@ -118,7 +128,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q=_BLOCK_Q, block_k=_BLOCK_K):
     vr = v.reshape(B * H, Tk, D)
     kernel = functools.partial(_flash_fwd_kernel, scale=scale, causal=causal,
                                block_k=block_k, seq_k=Tk)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(B * H, Tq // block_q),
         in_specs=[
@@ -126,29 +136,189 @@ def _flash_fwd(q, k, v, scale, causal, block_q=_BLOCK_Q, block_k=_BLOCK_K):
             pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
         ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, Tq), jnp.float32),
+        ],
+    )(qr, kr, vr)
+    return out.reshape(B, H, Tq, D), lse.reshape(B, H, Tq)
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash backward (FlashAttention-2 recompute-from-LSE formulation):
+# O(L) memory — the T×T score matrix is never materialized.  Two kernels:
+# dq iterates q-blocks (streaming K/V), dk/dv iterates k-blocks (streaming
+# Q/dO).  delta = rowsum(dO * O) is the softmax-jacobian correction term.
+# ---------------------------------------------------------------------------
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, scale, causal, block_k, seq_k):
+    import jax.experimental.pallas as pl
+
+    block_q, d = q_ref.shape
+    q = q_ref[:].astype(jnp.float32)
+    do = do_ref[:].astype(jnp.float32)
+    lse = lse_ref[:].astype(jnp.float32)[:, None]
+    delta = delta_ref[:].astype(jnp.float32)[:, None]
+    q_idx = pl.program_id(1)
+    lse_safe = jnp.where(jnp.isfinite(lse), lse, 0.0)
+
+    num_kb = seq_k // block_k
+
+    def body(kb, dq):
+        k_blk = k_ref[pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = q_idx * block_q + \
+                lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + \
+                lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        p = jnp.where(jnp.isfinite(s) & jnp.isfinite(lse),
+                      jnp.exp(s - lse_safe), 0.0)
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+
+    dq = jnp.zeros((block_q, d), jnp.float32)
+    if causal:
+        num_kb_eff = jnp.minimum(
+            ((q_idx + 1) * block_q + block_k - 1) // block_k, num_kb)
+        dq = lax.fori_loop(0, num_kb_eff, body, dq)
+    else:
+        dq = lax.fori_loop(0, num_kb, body, dq)
+    dq_ref[:] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, scale, causal, block_q, seq_q):
+    import jax.experimental.pallas as pl
+
+    block_k, d = k_ref.shape
+    k = k_ref[:].astype(jnp.float32)
+    v = v_ref[:].astype(jnp.float32)
+    k_idx = pl.program_id(1)
+
+    num_qb = seq_q // block_q
+
+    def body(qb, carry):
+        dk, dv = carry
+        q_blk = q_ref[pl.dslice(qb * block_q, block_q), :].astype(jnp.float32)
+        do_blk = do_ref[pl.dslice(qb * block_q, block_q), :].astype(
+            jnp.float32)
+        lse = lse_ref[pl.dslice(qb * block_q, block_q)].astype(
+            jnp.float32)[:, None]
+        delta = delta_ref[pl.dslice(qb * block_q, block_q)].astype(
+            jnp.float32)[:, None]
+        lse_safe = jnp.where(jnp.isfinite(lse), lse, 0.0)
+        s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qb * block_q + \
+                lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = k_idx * block_k + \
+                lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        p = jnp.where(jnp.isfinite(s) & jnp.isfinite(lse),
+                      jnp.exp(s - lse_safe), 0.0)
+        dv_new = dv + jnp.dot(p.T, do_blk,
+                              preferred_element_type=jnp.float32)
+        dp = jnp.dot(do_blk, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk_new = dk + jnp.dot(ds.T, q_blk,
+                              preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    dk = jnp.zeros((block_k, d), jnp.float32)
+    dv = jnp.zeros((block_k, d), jnp.float32)
+    if causal:
+        # only query blocks at or after this key block contribute
+        qb_start = (k_idx * block_k) // block_q
+        dk, dv = lax.fori_loop(qb_start, num_qb, body, (dk, dv))
+    else:
+        dk, dv = lax.fori_loop(0, num_qb, body, (dk, dv))
+    dk_ref[:] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, g, scale, causal,
+               block_q=_BLOCK_Q, block_k=_BLOCK_K):
+    import jax.experimental.pallas as pl
+
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    qr = q.reshape(B * H, Tq, D)
+    kr = k.reshape(B * H, Tk, D)
+    vr = v.reshape(B * H, Tk, D)
+    gr = g.reshape(B * H, Tq, D)
+    lser = lse.reshape(B * H, Tq)
+    # softmax-jacobian row term; O(T*D) elementwise — fused by XLA
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1).reshape(B * H, Tq)
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_k=block_k, seq_k=Tk),
+        grid=(B * H, Tq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
+        ],
         out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
-    )(qr, kr, vr)
-    return out.reshape(B, H, Tq, D)
+    )(qr, kr, vr, gr, lser, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, seq_q=Tq),
+        grid=(B * H, Tk // block_k),
+        in_specs=[
+            pl.BlockSpec((None, Tq, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, Tq, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, Tq), lambda b, i: (b, 0)),
+            pl.BlockSpec((None, Tq), lambda b, i: (b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tk, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, Tk, D), v.dtype),
+        ],
+    )(qr, kr, vr, gr, lser, delta)
+
+    return (dq.reshape(B, H, Tq, D), dk.reshape(B, H, Tk, D),
+            dv.reshape(B, H, Tk, D))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def flash_attention(q, k, v, scale, causal):
     """Blockwise flash attention, (B, H, T, D) layout."""
-    return _flash_fwd(q, k, v, scale, causal)
+    return _flash_fwd(q, k, v, scale, causal)[0]
 
 
 def _flash_vjp_fwd(q, k, v, scale, causal):
-    return _flash_fwd(q, k, v, scale, causal), (q, k, v)
+    out, lse = _flash_fwd(q, k, v, scale, causal)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_vjp_bwd(scale, causal, res, g):
-    # rematerialized backward through the jnp composition (correct grads;
-    # the dedicated flash backward kernel is a later optimization)
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: _attention_jnp(q, k, v, scale, causal),
-                     q, k, v)
-    return vjp(g)
+    # blockwise Pallas backward: O(L) memory (recompute-from-LSE), never
+    # building the T×T score matrix the old jnp rematerialization needed
+    q, k, v, o, lse = res
+    return _flash_bwd(q, k, v, o, lse, g, scale, causal)
 
 
 flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)

@@ -156,7 +156,8 @@ def test_optimal_threshold_sane():
 
 
 def test_quantize_net_mlp():
-    rng = np.random.RandomState(4)
+    mx.random.seed(4)     # initializers draw from the mx stream: pin it so
+    rng = np.random.RandomState(4)  # accuracy tolerance is deterministic
     net = mx.gluon.nn.HybridSequential()
     net.add(mx.gluon.nn.Dense(32, activation="relu"),
             mx.gluon.nn.Dense(10))
@@ -172,6 +173,7 @@ def test_quantize_net_mlp():
 
 
 def test_quantize_net_conv_entropy():
+    mx.random.seed(5)
     rng = np.random.RandomState(5)
     net = mx.gluon.nn.HybridSequential()
     net.add(mx.gluon.nn.Conv2D(8, 3, padding=1, activation="relu"),

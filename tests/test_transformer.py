@@ -54,14 +54,90 @@ def test_flash_kernel_matches_reference_cpu_interpret():
     orig = pl.pallas_call
     try:
         pl.pallas_call = lambda *a, **kw: orig(*a, interpret=True, **kw)
-        out = np.asarray(att._flash_fwd(q, k, v, scale, False))
-        out_causal = np.asarray(att._flash_fwd(q, k, v, scale, True))
+        out, lse = att._flash_fwd(q, k, v, scale, False)
+        out, lse = np.asarray(out), np.asarray(lse)
+        out_causal = np.asarray(att._flash_fwd(q, k, v, scale, True)[0])
     finally:
         pl.pallas_call = orig
     ref = _np_attention(q, k, v, scale)
     ref_causal = _np_attention(q, k, v, scale, causal=True)
     assert np.allclose(out, ref, atol=2e-4), np.abs(out - ref).max()
     assert np.allclose(out_causal, ref_causal, atol=2e-4)
+    # lse residual: logsumexp of the scaled scores
+    s = np.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    lse_ref = np.log(np.exp(s - s.max(-1, keepdims=True)).sum(-1)) \
+        + s.max(-1)
+    assert np.allclose(lse, lse_ref, atol=1e-4), np.abs(lse - lse_ref).max()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_backward_matches_jnp_cpu_interpret(causal):
+    """The blockwise Pallas backward (recompute-from-LSE, O(L) memory) must
+    produce the same dq/dk/dv as differentiating the jnp composition."""
+    import jax
+    import jax.numpy as jnp
+    import jax.experimental.pallas as pl
+    from mxnet_tpu.ops import attention as att
+    np.random.seed(1)
+    B, H, T, D = 1, 2, 512, 128
+    q = np.random.randn(B, H, T, D).astype(np.float32)
+    k = np.random.randn(B, H, T, D).astype(np.float32)
+    v = np.random.randn(B, H, T, D).astype(np.float32)
+    g = np.random.randn(B, H, T, D).astype(np.float32)
+    scale = 1.0 / np.sqrt(D)
+
+    orig = pl.pallas_call
+    try:
+        pl.pallas_call = lambda *a, **kw: orig(*a, interpret=True, **kw)
+        _, vjp = jax.vjp(
+            lambda q, k, v: att.flash_attention(q, k, v, scale, causal),
+            q, k, v)
+        dq, dk, dv = vjp(jnp.asarray(g))
+    finally:
+        pl.pallas_call = orig
+
+    _, vjp_ref = jax.vjp(
+        lambda q, k, v: att._attention_jnp(q, k, v, scale, causal), q, k, v)
+    dq_r, dk_r, dv_r = vjp_ref(jnp.asarray(g))
+    for got, want, name in ((dq, dq_r, "dq"), (dk, dk_r, "dk"),
+                            (dv, dv_r, "dv")):
+        err = np.abs(np.asarray(got) - np.asarray(want)).max()
+        rel = err / max(np.abs(np.asarray(want)).max(), 1e-6)
+        assert rel < 2e-4, (name, err, rel)
+
+
+def test_flash_backward_bf16_cpu_interpret():
+    """bf16 inputs (the MXU-native training dtype) flow through the flash
+    backward; grads come back bf16 and near the fp32 reference."""
+    import jax
+    import jax.numpy as jnp
+    import jax.experimental.pallas as pl
+    from mxnet_tpu.ops import attention as att
+    np.random.seed(2)
+    B, H, T, D = 1, 1, 256, 128
+    q = jnp.asarray(np.random.randn(B, H, T, D), jnp.bfloat16)
+    k = jnp.asarray(np.random.randn(B, H, T, D), jnp.bfloat16)
+    v = jnp.asarray(np.random.randn(B, H, T, D), jnp.bfloat16)
+    scale = 1.0 / np.sqrt(D)
+
+    orig = pl.pallas_call
+    try:
+        pl.pallas_call = lambda *a, **kw: orig(*a, interpret=True, **kw)
+        def loss(q, k, v):
+            return jnp.sum(att.flash_attention(q, k, v, scale, False)
+                           .astype(jnp.float32))
+        dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    finally:
+        pl.pallas_call = orig
+    assert dq.dtype == jnp.bfloat16
+    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+    def loss_ref(q, k, v):
+        return jnp.sum(att._attention_jnp(q, k, v, scale, False))
+    rq, rk, rv = jax.grad(loss_ref, argnums=(0, 1, 2))(qf, kf, vf)
+    for got, want in ((dq, rq), (dk, rk), (dv, rv)):
+        rel = (np.abs(np.asarray(got, np.float32) - np.asarray(want)).max()
+               / max(np.abs(np.asarray(want)).max(), 1e-6))
+        assert rel < 0.05, rel
 
 
 def test_interleaved_selfatt_ops():
