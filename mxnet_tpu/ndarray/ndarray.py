@@ -738,10 +738,15 @@ def _invoke_impl(op_name: str, *inputs, out=None, **params):
     # aux-state write-back (BatchNorm moving stats ≈ reference aux arrays):
     # designated outputs are stored into their input NDArrays in place and
     # stripped from the visible return
-    if op.aux_writeback and isinstance(outs, (list, tuple)):
+    # aux_writeback may be a callable of the call params for ops with a
+    # variable arity (multi_sgd fleets: the output->input map depends on
+    # num_weights)
+    awb = op.aux_writeback(params) if callable(op.aux_writeback) \
+        else op.aux_writeback
+    if awb and isinstance(outs, (list, tuple)):
         visible = []
         for i, o in enumerate(outs):
-            tgt_idx = op.aux_writeback.get(i)
+            tgt_idx = awb.get(i)
             if tgt_idx is not None:
                 tgt = inputs[tgt_idx]
                 if isinstance(tgt, NDArray):

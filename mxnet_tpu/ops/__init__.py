@@ -20,3 +20,8 @@ from . import detection  # noqa: F401
 from . import spatial    # noqa: F401
 from . import control_flow  # noqa: F401
 from . import quantization  # noqa: F401
+from . import scalar     # noqa: F401
+from . import creation   # noqa: F401
+from . import misc       # noqa: F401
+from . import image      # noqa: F401
+from . import nn_extra   # noqa: F401

@@ -1,0 +1,56 @@
+"""Tensor-creation ops.
+
+Reference: src/operator/tensor/init_op.cc (_zeros, _ones, _full, _arange,
+_linspace, _eye, zeros_like/ones_like) — the no-input ops behind mx.nd.zeros
+etc.  All shapes/params are static, so each call is one cached XLA
+executable that materializes straight into device memory.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = []
+
+
+def _dt(dtype):
+    if dtype in (None, "None"):
+        return jnp.float32
+    return jnp.bfloat16 if dtype == "bfloat16" else dtype
+
+
+@register("_zeros", aliases=["zeros_op"], differentiable=False)
+def _zeros(shape=(), dtype=None):
+    return jnp.zeros(shape, _dt(dtype))
+
+
+@register("_ones", aliases=["ones_op"], differentiable=False)
+def _ones(shape=(), dtype=None):
+    return jnp.ones(shape, _dt(dtype))
+
+
+@register("_full", aliases=["full_op"], differentiable=False)
+def _full(shape=(), value=0.0, dtype=None):
+    return jnp.full(shape, value, _dt(dtype))
+
+
+@register("_arange", aliases=["arange_op"], differentiable=False)
+def _arange(start=0.0, stop=None, step=1.0, repeat=1, dtype=None):
+    if stop is None:
+        start, stop = 0.0, start
+    out = jnp.arange(start, stop, step, _dt(dtype))
+    if repeat != 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+@register("_linspace", aliases=["linspace_op"], differentiable=False)
+def _linspace(start=0.0, stop=1.0, num=50, endpoint=True, dtype=None):
+    return jnp.linspace(start, stop, int(num), endpoint=endpoint,
+                        dtype=_dt(dtype))
+
+
+@register("_eye", aliases=["eye_op"], differentiable=False)
+def _eye(N=1, M=0, k=0, dtype=None):
+    return jnp.eye(int(N), int(M) if M else None, int(k), dtype=_dt(dtype))

@@ -279,3 +279,242 @@ def _multibox_detection(cls_prob, loc_pred, anchor, clip=True,
 
 
 alias("MultiBoxDetection", "_contrib_MultiBoxDetection", "multibox_detection")
+
+
+@register("_contrib_box_encode", aliases=["box_encode"], num_outputs=2,
+          differentiable=False)
+def _box_encode(samples, matches, anchors, refs, means=(0., 0., 0., 0.),
+                stds=(0.1, 0.1, 0.2, 0.2)):
+    """Corner boxes → center-form regression targets vs matched refs
+    (reference: src/operator/contrib/bounding_box.cc BoxEncode)."""
+    m = jnp.take_along_axis(refs, matches.astype(jnp.int32)[..., None]
+                            .repeat(4, -1), axis=1)
+    aw = anchors[..., 2] - anchors[..., 0]
+    ah = anchors[..., 3] - anchors[..., 1]
+    ax = anchors[..., 0] + 0.5 * aw
+    ay = anchors[..., 1] + 0.5 * ah
+    gw = m[..., 2] - m[..., 0]
+    gh = m[..., 3] - m[..., 1]
+    gx = m[..., 0] + 0.5 * gw
+    gy = m[..., 1] + 0.5 * gh
+    means = jnp.asarray(means, jnp.float32)
+    stds = jnp.asarray(stds, jnp.float32)
+    t = jnp.stack([
+        ((gx - ax) / jnp.maximum(aw, 1e-12) - means[0]) / stds[0],
+        ((gy - ay) / jnp.maximum(ah, 1e-12) - means[1]) / stds[1],
+        (jnp.log(jnp.maximum(gw, 1e-12) / jnp.maximum(aw, 1e-12))
+         - means[2]) / stds[2],
+        (jnp.log(jnp.maximum(gh, 1e-12) / jnp.maximum(ah, 1e-12))
+         - means[3]) / stds[3]], axis=-1)
+    valid = (samples > 0.5)[..., None]
+    targets = jnp.where(valid, t, 0.0)
+    masks = jnp.where(valid, jnp.ones_like(t), jnp.zeros_like(t))
+    return targets, masks
+
+
+@register("_contrib_box_decode", aliases=["box_decode"],
+          differentiable=False)
+def _box_decode(data, anchors, std0=1.0, std1=1.0, std2=1.0, std3=1.0,
+                clip=-1.0, format="corner"):
+    """Regression deltas + anchors → corner boxes (reference: BoxDecode)."""
+    if format == "corner":
+        aw = anchors[..., 2] - anchors[..., 0]
+        ah = anchors[..., 3] - anchors[..., 1]
+        ax = anchors[..., 0] + 0.5 * aw
+        ay = anchors[..., 1] + 0.5 * ah
+    else:
+        ax, ay, aw, ah = (anchors[..., i] for i in range(4))
+    dx = data[..., 0] * std0
+    dy = data[..., 1] * std1
+    dw = data[..., 2] * std2
+    dh = data[..., 3] * std3
+    cx = dx * aw + ax
+    cy = dy * ah + ay
+    w = jnp.exp(dw) * aw
+    h = jnp.exp(dh) * ah
+    out = jnp.stack([cx - 0.5 * w, cy - 0.5 * h,
+                     cx + 0.5 * w, cy + 0.5 * h], axis=-1)
+    if clip > 0:
+        out = jnp.clip(out, 0.0, clip)
+    return out
+
+
+@register("_contrib_PSROIPooling", aliases=["PSROIPooling"],
+          differentiable=False)
+def _psroi_pooling(data, rois, spatial_scale=1.0, output_dim=1,
+                   pooled_size=1, group_size=0):
+    """Position-sensitive ROI pooling (reference:
+    src/operator/contrib/psroi_pooling.cc — R-FCN heads).
+    data (B, C, H, W) with C = output_dim*group²; rois (R, 5)."""
+    g = int(group_size) if group_size else int(pooled_size)
+    p = int(pooled_size)
+    B, C, H, W = data.shape
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = (roi[i] * spatial_scale for i in range(1, 5))
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bins = []
+        img = data[bidx]
+        for ph in range(p):
+            for pw in range(p):
+                gy = ph * g // p
+                gx = pw * g // p
+                ys = y1 + rh * ph / p
+                ye = y1 + rh * (ph + 1) / p
+                xs = x1 + rw * pw / p
+                xe = x1 + rw * (pw + 1) / p
+                yy = jnp.arange(H, dtype=jnp.float32)
+                xx = jnp.arange(W, dtype=jnp.float32)
+                my = ((yy + 1 > ys) & (yy < ye)).astype(jnp.float32)
+                mxm = ((xx + 1 > xs) & (xx < xe)).astype(jnp.float32)
+                mask = my[:, None] * mxm[None, :]
+                area = jnp.maximum(mask.sum(), 1.0)
+                chans = img.reshape(output_dim, g * g, H, W)[
+                    :, gy * g + gx]
+                bins.append((chans * mask).sum(axis=(-1, -2)) / area)
+        out = jnp.stack(bins, axis=-1)          # (output_dim, p*p)
+        return out.reshape(output_dim, p, p)
+    return jax.vmap(one_roi)(rois.astype(jnp.float32))
+
+
+@register("Proposal", aliases=["_contrib_Proposal", "proposal"],
+          differentiable=False)
+def _proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+              rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+              scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+              feature_stride=16, output_score=False, iou_loss=False):
+    """RPN proposal generation (reference: src/operator/contrib/
+    proposal.cc): anchors + deltas → clip → min-size filter → top-N by
+    score → NMS → top-post-N rois (B*(N,5) stacked)."""
+    B, A2, H, W = cls_prob.shape
+    A = A2 // 2
+    base = float(feature_stride)
+    # anchor set at (0,0): center-form
+    anchors = []
+    for r in ratios:
+        for s in scales:
+            size = base * base / float(r)
+            w = jnp.sqrt(size) * float(s)
+            h = w * float(r)
+            anchors.append([-(w - base) / 2, -(h - base) / 2,
+                            (w + base) / 2 - 1, (h + base) / 2 - 1])
+    anc = jnp.asarray(anchors, jnp.float32)            # (A, 4)
+    sx = jnp.arange(W, dtype=jnp.float32) * base
+    sy = jnp.arange(H, dtype=jnp.float32) * base
+    shift = jnp.stack(jnp.meshgrid(sx, sy, indexing="xy"), axis=-1)
+    shift = jnp.concatenate([shift, shift], axis=-1)   # (H, W, 4)
+    all_anchors = (anc[None, None] + shift[:, :, None]).reshape(-1, 4)
+
+    def one(scores, deltas, info):
+        s = scores[A:].transpose(1, 2, 0).reshape(-1)   # fg scores
+        d = deltas.reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        boxes = _box_decode(
+            d.reshape(1, -1, 4),
+            all_anchors.reshape(1, -1, 4), format="corner")[0]
+        boxes = jnp.clip(boxes,
+                         jnp.zeros((4,)),
+                         jnp.stack([info[1] - 1, info[0] - 1,
+                                    info[1] - 1, info[0] - 1]))
+        ws = boxes[:, 2] - boxes[:, 0] + 1
+        hs = boxes[:, 3] - boxes[:, 1] + 1
+        min_size = rpn_min_size * info[2]
+        keep = (ws >= min_size) & (hs >= min_size)
+        s = jnp.where(keep, s, -1.0)
+        k = min(rpn_pre_nms_top_n, s.shape[0])
+        top_s, top_i = lax.top_k(s, k)
+        top_boxes = boxes[top_i]
+        dets = jnp.concatenate([top_s[:, None], top_boxes], axis=1)
+        # NMS over ALL pre-nms candidates (topk here would invalidate boxes
+        # before suppression even ran), then COMPACT the survivors to the
+        # front — _box_nms leaves -1 rows in place — and truncate to post-N.
+        kept = _box_nms(dets[None], overlap_thresh=threshold,
+                        valid_thresh=0.0, topk=-1,
+                        coord_start=1, score_index=0, id_index=-1)[0]
+        alive = kept[:, 0] > -1
+        order = jnp.argsort(jnp.where(alive, 0, 1), stable=True)
+        return kept[order][:rpn_post_nms_top_n]
+
+    outs = jax.vmap(one)(cls_prob, bbox_pred,
+                         jnp.broadcast_to(im_info, (B, 3)))
+    scores = outs[..., 0:1]
+    boxes = outs[..., 1:5]
+    batch_idx = jnp.broadcast_to(
+        jnp.arange(B, dtype=jnp.float32)[:, None, None],
+        (B, boxes.shape[1], 1))
+    rois = jnp.concatenate([batch_idx, boxes], axis=-1).reshape(-1, 5)
+    if output_score:
+        return rois, scores.reshape(-1, 1)
+    return rois
+
+
+@register("MultiProposal", aliases=["_contrib_MultiProposal"],
+          differentiable=False)
+def _multi_proposal(cls_prob, bbox_pred, im_info, **kw):
+    """Batched Proposal (reference: multi_proposal.cc) — same math; the
+    batch loop is already vmapped in Proposal."""
+    return _proposal(cls_prob, bbox_pred, im_info, **kw)
+
+
+@register("_contrib_DeformableConvolution",
+          aliases=["DeformableConvolution"], differentiable=False)
+def _deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
+                            stride=(1, 1), dilate=(1, 1), pad=(1, 1),
+                            num_filter=1, num_group=1,
+                            num_deformable_group=1, no_bias=False,
+                            workspace=1024, layout=None):
+    """Deformable conv v1 (reference: src/operator/contrib/
+    deformable_convolution.cc): bilinear-sample the input at
+    offset-perturbed taps, then a dense 1x1-style contraction per tap."""
+    if num_group != 1:
+        raise ValueError("DeformableConvolution: num_group != 1 is not "
+                         "supported on the TPU backend yet")
+    kh, kw = kernel
+    B, C, H, W = data.shape
+    Ho = (H + 2 * pad[0] - dilate[0] * (kh - 1) - 1) // stride[0] + 1
+    Wo = (W + 2 * pad[1] - dilate[1] * (kw - 1) - 1) // stride[1] + 1
+    # offset: (B, 2*dg*kh*kw, Ho, Wo) — (dy, dx) per tap per group
+    off = offset.reshape(B, num_deformable_group, kh * kw, 2, Ho, Wo)
+    yy = jnp.arange(Ho, dtype=jnp.float32) * stride[0] - pad[0]
+    xx = jnp.arange(Wo, dtype=jnp.float32) * stride[1] - pad[1]
+    cg = C // num_deformable_group
+
+    def sample(img, y, x):
+        """img (C', H, W); y/x (...): bilinear with zero padding."""
+        y0 = jnp.floor(y)
+        x0 = jnp.floor(x)
+        wy = y - y0
+        wx = x - x0
+
+        def at(yi, xi):
+            inside = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+            yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+            xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+            v = img[:, yc, xc]
+            return jnp.where(inside, v, 0.0)
+        return ((1 - wy) * (1 - wx) * at(y0, x0) + (1 - wy) * wx * at(y0, x0 + 1)
+                + wy * (1 - wx) * at(y0 + 1, x0) + wy * wx * at(y0 + 1, x0 + 1))
+
+    def one(img, offs):
+        cols = []
+        for g in range(num_deformable_group):
+            part = img[g * cg:(g + 1) * cg].astype(jnp.float32)
+            for t in range(kh * kw):
+                i, j = t // kw, t % kw
+                ty = yy[:, None] + i * dilate[0] + offs[g, t, 0]
+                tx = xx[None, :] + j * dilate[1] + offs[g, t, 1]
+                cols.append(sample(part, ty, tx))   # (cg, Ho, Wo)
+        return jnp.concatenate(cols, axis=0)        # (C*kh*kw grouped)
+
+    cols = jax.vmap(one)(data.astype(jnp.float32), off.astype(jnp.float32))
+    # cols: (B, dg*cg*kh*kw, Ho, Wo) ordered [g][tap][c]; weight (O, C/ng, kh, kw)
+    cols = cols.reshape(B, num_deformable_group, kh * kw, cg, Ho, Wo)
+    cols = cols.transpose(0, 1, 3, 2, 4, 5).reshape(B, C * kh * kw, Ho, Wo)
+    wmat = weight.reshape(num_filter, -1)
+    out = jnp.einsum("of,bfhw->bohw",
+                     wmat.astype(jnp.float32),
+                     cols.reshape(B, C * kh * kw, Ho, Wo))
+    if bias is not None and not no_bias:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out.astype(data.dtype)

@@ -1,0 +1,203 @@
+"""mx.nd.image.* operators.
+
+Reference: src/operator/image/image_random.cc + image_resize.cc
+(_image_to_tensor, _image_normalize, _image_resize, _image_crop,
+_image_flip_*, _image_adjust_lighting, _image_random_*) — the op-level
+augmentation pipeline gluon.data.vision.transforms rides.
+
+Layout: HWC or NHWC uint8/float input, like the reference.  Deterministic
+ops are pure jnp; random_* draw through the registry's stateless rng
+plumbing (needs_rng) so they are traceable under hybridized transforms.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = []
+
+# ITU-R BT.601 luma weights (the reference's grayscale coefficients)
+_LUMA = (0.299, 0.587, 0.114)
+
+
+def _is_batch(x):
+    return x.ndim == 4
+
+
+@register("_image_to_tensor", aliases=["image_to_tensor"])
+def _to_tensor(data):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (reference: ToTensor)."""
+    x = data.astype(jnp.float32) / 255.0
+    if _is_batch(data):
+        return x.transpose(0, 3, 1, 2)
+    return x.transpose(2, 0, 1)
+
+
+@register("_image_normalize", aliases=["image_normalize"])
+def _normalize(data, mean=(0.0,), std=(1.0,)):
+    """CHW float -> (x - mean) / std per channel (reference: Normalize)."""
+    mean = jnp.asarray(mean, jnp.float32)
+    std = jnp.asarray(std, jnp.float32)
+    if _is_batch(data):
+        return (data - mean.reshape(1, -1, 1, 1)) / std.reshape(1, -1, 1, 1)
+    return (data - mean.reshape(-1, 1, 1)) / std.reshape(-1, 1, 1)
+
+
+@register("_image_resize", aliases=["image_resize"])
+def _resize(data, size=(0, 0), keep_ratio=False, interp=1):
+    """HWC resize; size (w, h) like the reference."""
+    if isinstance(size, int):
+        size = (size, size)
+    w, h = int(size[0]), int(size[1] if len(size) > 1 else size[0])
+    method = "nearest" if interp == 0 else "linear"
+    if _is_batch(data):
+        out_shape = (data.shape[0], h, w, data.shape[3])
+    else:
+        out_shape = (h, w, data.shape[2])
+    return jax.image.resize(data.astype(jnp.float32), out_shape,
+                            method=method).astype(data.dtype)
+
+
+@register("_image_crop", aliases=["image_crop"])
+def _crop(data, x=0, y=0, width=1, height=1):
+    if _is_batch(data):
+        return data[:, y:y + height, x:x + width, :]
+    return data[y:y + height, x:x + width, :]
+
+
+@register("_image_flip_left_right", aliases=["image_flip_left_right"])
+def _flip_lr(data):
+    return jnp.flip(data, axis=-2)
+
+
+@register("_image_flip_top_bottom", aliases=["image_flip_top_bottom"])
+def _flip_tb(data):
+    return jnp.flip(data, axis=-3)
+
+
+@register("_image_adjust_lighting", aliases=["image_adjust_lighting"])
+def _adjust_lighting(data, alpha=(0.0, 0.0, 0.0)):
+    """AlexNet-style PCA lighting shift (reference: AdjustLighting)."""
+    eigval = jnp.asarray([55.46, 4.794, 1.148], jnp.float32)
+    eigvec = jnp.asarray([[-0.5675, 0.7192, 0.4009],
+                          [-0.5808, -0.0045, -0.8140],
+                          [-0.5836, -0.6948, 0.4203]], jnp.float32)
+    alpha = jnp.asarray(alpha, jnp.float32)
+    shift = (eigvec * alpha * eigval).sum(axis=1)
+    return (data.astype(jnp.float32) + shift).astype(data.dtype)
+
+
+def _blend(a, b, w):
+    return (w * a.astype(jnp.float32)
+            + (1.0 - w) * b.astype(jnp.float32))
+
+
+def _grayscale(x):
+    wts = jnp.asarray(_LUMA, jnp.float32)
+    g = (x.astype(jnp.float32) * wts).sum(axis=-1, keepdims=True)
+    return jnp.broadcast_to(g, x.shape)
+
+
+def _brightness(x, w):
+    return _blend(x, jnp.zeros_like(x, jnp.float32), w)
+
+
+def _contrast(x, w):
+    mean = _grayscale(x).mean()
+    return _blend(x, jnp.full_like(x, mean, jnp.float32), w)
+
+
+def _saturation(x, w):
+    return _blend(x, _grayscale(x), w)
+
+
+def _hue(x, w):
+    """YIQ rotation (the reference's AdjustHue path)."""
+    t_yiq = jnp.asarray([[0.299, 0.587, 0.114],
+                         [0.596, -0.274, -0.321],
+                         [0.211, -0.523, 0.311]], jnp.float32)
+    t_rgb = jnp.asarray([[1.0, 0.956, 0.621],
+                         [1.0, -0.272, -0.647],
+                         [1.0, -1.107, 1.705]], jnp.float32)
+    h = w * jnp.pi
+    u, v = jnp.cos(h), jnp.sin(h)
+    rot = jnp.asarray([[1.0, 0.0, 0.0],
+                       [0.0, 0.0, 0.0],
+                       [0.0, 0.0, 0.0]], jnp.float32)
+    rot = rot.at[1, 1].set(u).at[1, 2].set(-v).at[2, 1].set(v).at[2, 2].set(u)
+    m = t_rgb @ rot @ t_yiq
+    return x.astype(jnp.float32) @ m.T
+
+
+def _rand_w(key, frac):
+    return jax.random.uniform(key, (), jnp.float32, 1.0 - frac, 1.0 + frac)
+
+
+@register("_image_random_brightness", aliases=["image_random_brightness"],
+          differentiable=False, needs_rng=True)
+def _random_brightness(key, data, min_factor=0.0, max_factor=0.0):
+    w = jax.random.uniform(key, (), jnp.float32, min_factor, max_factor)
+    return _brightness(data, w).astype(data.dtype)
+
+
+@register("_image_random_contrast", aliases=["image_random_contrast"],
+          differentiable=False, needs_rng=True)
+def _random_contrast(key, data, min_factor=0.0, max_factor=0.0):
+    w = jax.random.uniform(key, (), jnp.float32, min_factor, max_factor)
+    return _contrast(data, w).astype(data.dtype)
+
+
+@register("_image_random_saturation", aliases=["image_random_saturation"],
+          differentiable=False, needs_rng=True)
+def _random_saturation(key, data, min_factor=0.0, max_factor=0.0):
+    w = jax.random.uniform(key, (), jnp.float32, min_factor, max_factor)
+    return _saturation(data, w).astype(data.dtype)
+
+
+@register("_image_random_hue", aliases=["image_random_hue"],
+          differentiable=False, needs_rng=True)
+def _random_hue(key, data, min_factor=0.0, max_factor=0.0):
+    w = jax.random.uniform(key, (), jnp.float32, min_factor, max_factor)
+    return _hue(data, w).astype(data.dtype)
+
+
+@register("_image_random_color_jitter", aliases=["image_random_color_jitter"],
+          differentiable=False, needs_rng=True)
+def _random_color_jitter(key, data, brightness=0.0, contrast=0.0,
+                         saturation=0.0, hue=0.0):
+    kb, kc, ks, kh = jax.random.split(key, 4)
+    x = data.astype(jnp.float32)
+    if brightness > 0:
+        x = _brightness(x, _rand_w(kb, brightness))
+    if contrast > 0:
+        x = _contrast(x, _rand_w(kc, contrast))
+    if saturation > 0:
+        x = _saturation(x, _rand_w(ks, saturation))
+    if hue > 0:
+        x = _hue(x, jax.random.uniform(kh, (), jnp.float32, -hue, hue))
+    return x.astype(data.dtype)
+
+
+@register("_image_random_lighting", aliases=["image_random_lighting"],
+          differentiable=False, needs_rng=True)
+def _random_lighting(key, data, alpha_std=0.05):
+    alpha = jax.random.normal(key, (3,), jnp.float32) * alpha_std
+    return _adjust_lighting(data, alpha)
+
+
+@register("_image_random_flip_left_right",
+          aliases=["image_random_flip_left_right"],
+          differentiable=False, needs_rng=True)
+def _random_flip_lr(key, data, p=0.5):
+    return jnp.where(jax.random.bernoulli(key, p),
+                     jnp.flip(data, axis=-2), data)
+
+
+@register("_image_random_flip_top_bottom",
+          aliases=["image_random_flip_top_bottom"],
+          differentiable=False, needs_rng=True)
+def _random_flip_tb(key, data, p=0.5):
+    return jnp.where(jax.random.bernoulli(key, p),
+                     jnp.flip(data, axis=-3), data)

@@ -1,0 +1,389 @@
+"""Misc long-tail ops: ElementWiseSum, AMP helpers, shape-like ops, contrib
+odds and ends.
+
+Reference anchors: src/operator/tensor/elemwise_sum.cc (add_n),
+src/operator/contrib/all_finite.cc, src/operator/tensor/amp_cast.cc
+(amp_multicast), src/operator/tensor/matrix_op.cc (reshape_like,
+broadcast_like, reverse), src/operator/tensor/indexing_op.cc
+(choose_element_0index / fill_element_0index), src/operator/contrib/
+(arange_like, index_array, allclose, quadratic, fft/ifft,
+bipartite_matching, gradient multiplier), src/operator/numpy/np_diff_op.cc.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+__all__ = []
+
+
+@register("add_n", aliases=["ElementWiseSum", "element_wise_sum"])
+def _add_n(*args):
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+@register("all_finite", differentiable=False)
+def _all_finite(data, init_output=True):
+    return jnp.isfinite(data).all().reshape((1,)).astype(jnp.float32)
+
+
+@register("multi_all_finite", differentiable=False)
+def _multi_all_finite(*arrays, num_arrays=1, init_output=True):
+    ok = jnp.array(True)
+    for a in arrays:
+        ok = jnp.logical_and(ok, jnp.isfinite(a).all())
+    return ok.reshape((1,)).astype(jnp.float32)
+
+
+@register("amp_multicast", num_outputs=-1)  # variable: one per input
+def _amp_multicast(*args, num_outputs=1, cast_narrow=False):
+    """Cast all inputs to the widest (or narrowest) float type among them
+    (reference: amp_multicast in amp_cast.cc)."""
+    dts = [a.dtype for a in args]
+    order = [jnp.float16, jnp.bfloat16, jnp.float32, jnp.float64]
+
+    def rank(d):
+        for i, t in enumerate(order):
+            if d == t:
+                return i
+        return len(order)
+    target = (min if cast_narrow else max)(dts, key=rank)
+    return tuple(a.astype(target) for a in args)
+
+
+@register("cast_storage", differentiable=False)
+def _cast_storage(data, stype="default"):
+    """Dense backend: every stype materializes dense (the NDArray layer owns
+    real CSR/RowSparse conversion — ndarray/sparse.py tostype)."""
+    return data
+
+
+@register("choose_element_0index", aliases=["pick_0index"],
+          differentiable=False)
+def _choose_element_0index(lhs, rhs):
+    # pick lhs[i, rhs[i]] along the trailing axis (legacy pick)
+    idx = rhs.astype(jnp.int32)
+    return jnp.take_along_axis(lhs, idx[..., None], axis=-1)[..., 0]
+
+
+@register("fill_element_0index", differentiable=False)
+def _fill_element_0index(lhs, mhs, rhs):
+    # lhs[i, rhs[i]] = mhs[i] (functional: returns the filled copy)
+    idx = rhs.astype(jnp.int32)
+    src = jnp.expand_dims(mhs, -1)
+    return jnp.put_along_axis(lhs, idx[..., None], src, axis=-1,
+                              inplace=False)
+
+
+@register("reshape_like")
+def _reshape_like(lhs, rhs, lhs_begin=None, lhs_end=None, rhs_begin=None,
+                  rhs_end=None):
+    if lhs_begin is None and rhs_begin is None:
+        return lhs.reshape(rhs.shape)
+    lb = 0 if lhs_begin is None else int(lhs_begin)
+    le = lhs.ndim if lhs_end is None else int(lhs_end)
+    rb = 0 if rhs_begin is None else int(rhs_begin)
+    re_ = rhs.ndim if rhs_end is None else int(rhs_end)
+    new_shape = lhs.shape[:lb] + rhs.shape[rb:re_] + lhs.shape[le:]
+    return lhs.reshape(new_shape)
+
+
+@register("broadcast_like")
+def _broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None):
+    if lhs_axes is None and rhs_axes is None:
+        return jnp.broadcast_to(lhs, rhs.shape)
+    shape = list(lhs.shape)
+    for la, ra in zip(lhs_axes, rhs_axes):
+        shape[int(la)] = rhs.shape[int(ra)]
+    return jnp.broadcast_to(lhs, tuple(shape))
+
+
+@register("reverse", aliases=["_reverse"])
+def _reverse(data, axis=0):
+    axes = axis if isinstance(axis, (list, tuple)) else (axis,)
+    for ax in axes:
+        data = jnp.flip(data, int(ax))
+    return data
+
+
+@register("diff")
+def _diff(a, n=1, axis=-1):
+    return jnp.diff(a, n=int(n), axis=int(axis))
+
+
+@register("_contrib_arange_like", aliases=["arange_like"],
+          differentiable=False)
+def _arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    if axis is None:
+        n = 1
+        for s in data.shape:
+            n *= s
+        out = start + step * jnp.arange(n, dtype=jnp.float32)
+        return out.reshape(data.shape)
+    n = data.shape[int(axis)]
+    return start + step * jnp.arange(n, dtype=jnp.float32)
+
+
+@register("_contrib_div_sqrt_dim", aliases=["div_sqrt_dim"])
+def _div_sqrt_dim(data):
+    return data / jnp.sqrt(jnp.asarray(data.shape[-1], data.dtype))
+
+
+@register("_contrib_gradientmultiplier", aliases=["gradientmultiplier"])
+def _gradientmultiplier(data, scalar=1.0):
+    """Identity forward, grad scaled by `scalar` (gradient-reversal layers
+    use scalar=-1)."""
+    s = jnp.asarray(scalar, data.dtype)
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    def bwd(_, g):
+        return (g * s,)
+    f.defvjp(fwd, bwd)
+    return f(data)
+
+
+@register("_contrib_index_array", aliases=["index_array"],
+          differentiable=False)
+def _index_array(data, axes=None):
+    shape = data.shape
+    if axes is None:
+        axes = tuple(range(len(shape)))
+    else:
+        axes = tuple(int(a) for a in axes)
+    grids = [lax.broadcasted_iota(jnp.int64, shape, a) for a in axes]
+    return jnp.stack(grids, axis=-1)
+
+
+@register("_contrib_allclose", aliases=["allclose"], differentiable=False)
+def _allclose(a, b, rtol=1e-05, atol=1e-08, equal_nan=True):
+    return jnp.allclose(a, b, rtol=rtol, atol=atol,
+                        equal_nan=equal_nan).reshape((1,)).astype(jnp.float32)
+
+
+@register("_contrib_quadratic", aliases=["quadratic"])
+def _quadratic(data, a=0.0, b=0.0, c=0.0):
+    """The reference's tutorial op (src/operator/contrib/quadratic_op.cc)."""
+    return a * data * data + b * data + c
+
+
+@register("_contrib_fft", aliases=["fft"], differentiable=False)
+def _fft(data, compute_size=128):
+    """1-D FFT over the last axis; complex output packed [re, im] pairs on
+    the last axis like the reference cuFFT wrapper."""
+    z = jnp.fft.fft(data.astype(jnp.complex64), axis=-1)
+    out = jnp.stack([z.real, z.imag], axis=-1)
+    return out.reshape(data.shape[:-1] + (2 * data.shape[-1],))
+
+
+@register("_contrib_ifft", aliases=["ifft"], differentiable=False)
+def _ifft(data, compute_size=128):
+    n = data.shape[-1] // 2
+    pairs = data.reshape(data.shape[:-1] + (n, 2))
+    z = lax.complex(pairs[..., 0], pairs[..., 1])
+    return jnp.fft.ifft(z, axis=-1).real.astype(jnp.float32)
+
+
+@register("_contrib_bipartite_matching", aliases=["bipartite_matching"],
+          num_outputs=2, differentiable=False)
+def _bipartite_matching(data, is_ascend=False, threshold=0.0, topk=-1):
+    """Greedy bipartite matching over a (..., N, M) score matrix
+    (reference: src/operator/contrib/bounding_box.cc BipartiteMatching).
+    Returns (row->col match or -1, col->row match or -1)."""
+    x = data
+    lead = x.shape[:-2]
+    N, M = x.shape[-2], x.shape[-1]
+    xf = x.reshape((-1, N, M))
+    big = jnp.asarray(jnp.inf, x.dtype)
+    sign = 1.0 if is_ascend else -1.0
+    k = N if topk in (-1, None) else min(int(topk), N)
+
+    def one(mat):
+        def body(_, carry):
+            m, rowm, colm = carry
+            flat = jnp.argmin(sign * m)
+            i, j = flat // M, flat % M
+            val = m[i, j]
+            ok = (val > threshold) if not is_ascend else (val < big)
+            rowm = jnp.where(ok, rowm.at[i].set(j), rowm)
+            colm = jnp.where(ok, colm.at[j].set(i), colm)
+            m = jnp.where(ok, m.at[i, :].set(sign * big), m)
+            m = jnp.where(ok, m.at[:, j].set(sign * big), m)
+            return m, rowm, colm
+        rowm = jnp.full((N,), -1, jnp.float32)
+        colm = jnp.full((M,), -1, jnp.float32)
+        _, rowm, colm = lax.fori_loop(0, k, body, (mat, rowm, colm))
+        return rowm, colm
+    rows, cols = jax.vmap(one)(xf)
+    return rows.reshape(lead + (N,)), cols.reshape(lead + (M,))
+
+
+@register("_contrib_getnnz", aliases=["getnnz"], differentiable=False)
+def _getnnz(data, axis=None):
+    nz = (data != 0)
+    if axis is None:
+        return jnp.sum(nz).astype(jnp.int64).reshape(())
+    return jnp.sum(nz, axis=int(axis)).astype(jnp.int64)
+
+
+@register("_contrib_dynamic_reshape", aliases=["dynamic_reshape"],
+          no_jit=True, differentiable=False)
+def _dynamic_reshape(data, shape_like):
+    """Reshape with a runtime shape TENSOR (dynamic-shape: eager-only)."""
+    import numpy as np
+    tgt = tuple(int(s) for s in np.asarray(shape_like))
+    return jnp.reshape(data, tgt)
+
+
+@register("_scatter_set_nd", differentiable=False)
+def _scatter_set_nd(lhs, rhs, indices, shape=None):
+    """lhs[indices] = rhs (functional copy; reference: _scatter_set_nd in
+    indexing_op.cc — gather_nd's in-place writing dual)."""
+    idx = tuple(indices[i].astype(jnp.int32) for i in range(indices.shape[0]))
+    return lhs.at[idx].set(rhs)
+
+
+@register("_rnn_param_concat")
+def _rnn_param_concat(*args, dim=0, num_args=1):
+    """Concat RNN parameter blobs into the fused layout (reference:
+    src/operator/rnn.cc _rnn_param_concat)."""
+    flat = [a.reshape(-1) if a.ndim != 1 else a for a in args]
+    return jnp.concatenate(flat, axis=0)
+
+
+@register("_onehot_encode", differentiable=False)
+def _onehot_encode(indices, out_like):
+    """Legacy onehot_encode(indices, out) (reference:
+    src/operator/tensor/indexing_op.cc OneHotEncode)."""
+    return jax.nn.one_hot(indices.astype(jnp.int32), out_like.shape[-1],
+                          dtype=out_like.dtype)
+
+
+@register("_copyto", aliases=["copyto_op"])
+def _copyto(data):
+    return data + 0  # fresh buffer; device move handled by the call layer
+
+
+@register("_sparse_retain", aliases=["sparse_retain"], differentiable=False)
+def _sparse_retain_op(data, indices):
+    """Zero all rows except `indices` (dense view of the reference's
+    row_sparse retain, src/operator/tensor/sparse_retain.cc)."""
+    mask = jnp.zeros((data.shape[0],), bool).at[
+        indices.astype(jnp.int32)].set(True)
+    return jnp.where(mask.reshape((-1,) + (1,) * (data.ndim - 1)), data, 0)
+
+
+@register("softmax_with_length")
+def _softmax_with_length(data, length, axis=-1, temperature=1.0):
+    """Softmax over the first `length` positions per row (reference:
+    src/operator/nn/softmax.cc SoftmaxWithLength)."""
+    ax = axis % data.ndim
+    pos = jnp.arange(data.shape[ax])
+    shape = [1] * data.ndim
+    shape[ax] = -1
+    mask = pos.reshape(shape) < jnp.expand_dims(length, ax)
+    logits = jnp.where(mask, data / temperature, -jnp.inf)
+    out = jax.nn.softmax(logits, axis=ax)
+    return jnp.where(mask, out, 0.0)
+
+
+@register("IdentityAttachKLSparseReg",
+          aliases=["identity_attach_kl_sparse_reg"])
+def _identity_kl_sparse_reg(data, sparseness_target=0.1, penalty=0.001,
+                            momentum=0.9):
+    """Identity forward; backward adds the KL sparsity-penalty gradient on
+    the mean activation (reference: src/operator/regression_output...
+    identity_attach_KL_sparse_reg.cc)."""
+    rho = sparseness_target
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, jnp.clip(jnp.mean(x, axis=0), 1e-6, 1 - 1e-6)
+
+    def bwd(rho_hat, g):
+        reg = penalty * (-rho / rho_hat + (1 - rho) / (1 - rho_hat))
+        return (g + reg[None, :].astype(g.dtype),)
+    f.defvjp(fwd, bwd)
+    return f(data)
+
+
+@register("_contrib_count_sketch", aliases=["count_sketch"],
+          differentiable=False)
+def _count_sketch(data, h, s, out_dim=1, processing_batch_size=32):
+    """Count-sketch projection (reference: src/operator/contrib/
+    count_sketch.cc): out[:, h[j]] += s[j] * data[:, j]."""
+    idx = h.reshape(-1).astype(jnp.int32)
+    sign = s.reshape(-1).astype(data.dtype)
+    signed = data * sign[None, :]
+    out = jnp.zeros((data.shape[0], int(out_dim)), data.dtype)
+    return out.at[:, idx].add(signed)
+
+
+@register("_contrib_hawkesll", aliases=["hawkesll"], num_outputs=2,
+          differentiable=False)
+def _hawkesll(lda, alpha, beta, state, lags, marks, valid_length,
+              max_time):
+    """Hawkes-process log-likelihood over interarrival lags (reference:
+    src/operator/contrib/hawkes_ll.cc).  Returns (loglik, final state)."""
+    B, T = lags.shape
+
+    def one(lda_i, state_i, lags_i, marks_i, vl_i, tmax):
+        marks_i = marks_i.astype(jnp.int32)
+        times = jnp.cumsum(lags_i)
+        valid = jnp.arange(T) < vl_i
+
+        def step(carry, t):
+            ll, rem = carry
+            k = marks_i[t]
+            rem = rem * jnp.exp(-beta * lags_i[t])
+            lam = lda_i[k] + alpha[k] * beta[k] * rem[k]
+            v = valid[t]
+            ll = ll + jnp.where(v, jnp.log(jnp.maximum(lam, 1e-30)), 0.0)
+            rem = jnp.where(v, rem.at[k].add(1.0), rem)
+            return (ll, rem), None
+        (ll, rem), _ = lax.scan(step, (0.0, state_i), jnp.arange(T))
+        # compensator: ∫₀ᵀ λ(t)dt — background + decayed window-start state
+        # + each event's exponential-kernel mass inside the window
+        comp = jnp.sum(lda_i) * tmax
+        comp = comp + jnp.sum(alpha * state_i
+                              * (1.0 - jnp.exp(-beta * tmax)))
+        decay = 1.0 - jnp.exp(-beta[marks_i]
+                              * jnp.maximum(tmax - times, 0.0))
+        comp = comp + jnp.sum(jnp.where(valid, alpha[marks_i] * decay, 0.0))
+        # state handed to the next window: decayed to tmax
+        rem_out = rem * jnp.exp(-beta * jnp.maximum(tmax - times[-1], 0.0))
+        return ll - comp, rem_out
+    tmax = jnp.broadcast_to(jnp.asarray(max_time, jnp.float32), (B,))
+    ll, rem = jax.vmap(one)(lda, state, lags, marks, valid_length, tmax)
+    return ll, rem
+
+
+@register("_image_imdecode", aliases=["imdecode_op"], no_jit=True,
+          differentiable=False)
+def _imdecode(buf, flag=1, to_rgb=True):
+    """Host JPEG/PNG decode via PIL (reference: src/io/image_io.cc
+    Imdecode — OpenCV there)."""
+    import io as _io
+    import numpy as np
+    from PIL import Image
+    raw = np.asarray(buf, np.uint8).tobytes()
+    img = Image.open(_io.BytesIO(raw))
+    img = img.convert("RGB" if to_rgb else "L")
+    arr = np.asarray(img, np.uint8)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return jnp.asarray(arr)

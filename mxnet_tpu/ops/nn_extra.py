@@ -1,0 +1,189 @@
+"""NN long-tail layers.
+
+Reference: src/operator/nn/lrn.cc (LRN), src/operator/tensor/
+elemwise_unary_op_basic.cc (BlockGrad/stop_gradient), src/operator/
+make_loss.cc (MakeLoss), src/operator/svm_output.cc (SVMOutput),
+src/operator/softmax_activation.cc, src/operator/crop.cc (legacy Crop),
+src/operator/nn/im2col.h (col2im), src/operator/contrib/sync_batch_norm.cc,
+src/operator/contrib/batch_norm_relu.cc.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+__all__ = []
+
+
+@register("LRN", aliases=["lrn"])
+def _lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    """Local response normalization across channels, NCHW (reference:
+    lrn.cc LRNForward): x / (k + alpha/n * sum_window(x²))^beta."""
+    sq = jnp.square(data.astype(jnp.float32))
+    half = nsize // 2
+    # window-sum over C via padded cumulative trick (static nsize)
+    padded = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    acc = jnp.zeros_like(sq)
+    for i in range(nsize):
+        acc = acc + lax.dynamic_slice_in_dim(padded, i, sq.shape[1], axis=1)
+    norm = jnp.power(knorm + (alpha / nsize) * acc, beta)
+    return (data.astype(jnp.float32) / norm).astype(data.dtype)
+
+
+@register("BlockGrad", aliases=["stop_gradient", "block_grad"])
+def _block_grad(data):
+    return lax.stop_gradient(data)
+
+
+@register("MakeLoss", aliases=["make_loss"])
+def _make_loss(data, grad_scale=1.0, valid_thresh=0.0,
+               normalization="null"):
+    """Identity forward; backward feeds grad_scale (reference:
+    make_loss.cc).  Normalization 'batch'/'valid' divide like the
+    reference."""
+    scale = grad_scale
+    if normalization == "batch":
+        scale = grad_scale / data.shape[0]
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        if normalization == "valid":
+            nvalid = jnp.maximum(
+                jnp.sum((x > valid_thresh).astype(jnp.float32)), 1.0)
+            return x, nvalid
+        return x, None
+
+    def bwd(res, g):
+        s = scale if res is None else grad_scale / res
+        return (g * s,)
+    f.defvjp(fwd, bwd)
+    return f(data)
+
+
+@register("SVMOutput", aliases=["svm_output"])
+def _svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
+                use_linear=False):
+    """Forward is identity (scores); backward applies the hinge-loss
+    gradient (reference: svm_output.cc)."""
+    coef = regularization_coefficient
+
+    @jax.custom_vjp
+    def f(x, lab):
+        return x
+
+    def fwd(x, lab):
+        return x, (x, lab)
+
+    def bwd(res, g):
+        x, lab = res
+        onehot = jax.nn.one_hot(lab.astype(jnp.int32), x.shape[-1],
+                                dtype=x.dtype)
+        sign = 2.0 * onehot - 1.0            # +1 at label, -1 elsewhere
+        viol = (margin - sign * x) > 0
+        dx = jnp.where(viol, -sign, 0.0)
+        if not use_linear:                    # squared hinge
+            dx = dx * 2.0 * jnp.maximum(margin - sign * x, 0.0)
+        return (coef * dx.astype(x.dtype), jnp.zeros_like(lab))
+    f.defvjp(fwd, bwd)
+    return f(data, label)
+
+
+@register("SoftmaxActivation", aliases=["softmax_activation"])
+def _softmax_activation(data, mode="instance"):
+    if mode == "channel":
+        return jax.nn.softmax(data, axis=1)
+    flat = data.reshape(data.shape[0], -1)
+    return jax.nn.softmax(flat, axis=-1).reshape(data.shape)
+
+
+@register("Crop")  # NB lowercase "crop" stays an alias of slice (matrix.py),
+def _crop_legacy(data, *like, offset=(0, 0), h_w=(0, 0), num_args=1,
+                 center_crop=False):
+    """Legacy Crop (reference: crop.cc): crop NCHW `data` to `like`'s
+    spatial size (2-input form) or to h_w."""
+    if like:
+        th, tw = like[0].shape[2], like[0].shape[3]
+    else:
+        th, tw = h_w
+    H, W = data.shape[2], data.shape[3]
+    if center_crop:
+        y0, x0 = (H - th) // 2, (W - tw) // 2
+    else:
+        y0, x0 = offset
+    return data[:, :, y0:y0 + th, x0:x0 + tw]
+
+
+@register("col2im")
+def _col2im(data, output_size=(1, 1), kernel=(1, 1), stride=(1, 1),
+            dilate=(1, 1), pad=(0, 0)):
+    """Inverse of im2col: scatter-add (B, C*kh*kw, L) patches back to
+    (B, C, H, W) (reference: im2col.h col2im)."""
+    kh, kw = kernel
+    H, W = output_size
+    B, CKK, L = data.shape
+    C = CKK // (kh * kw)
+    Ho = (H + 2 * pad[0] - dilate[0] * (kh - 1) - 1) // stride[0] + 1
+    Wo = (W + 2 * pad[1] - dilate[1] * (kw - 1) - 1) // stride[1] + 1
+    x = data.reshape(B, C, kh, kw, Ho, Wo)
+    Hp, Wp = H + 2 * pad[0], W + 2 * pad[1]
+    out = jnp.zeros((B, C, Hp, Wp), data.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            yi = i * dilate[0]
+            xi = j * dilate[1]
+            ys = slice(yi, yi + Ho * stride[0], stride[0])
+            xs = slice(xi, xi + Wo * stride[1], stride[1])
+            out = out.at[:, :, ys, xs].add(x[:, :, i, j])
+    return out[:, :, pad[0]:Hp - pad[0], pad[1]:Wp - pad[1]] \
+        if pad[0] or pad[1] else out
+
+
+@register("_contrib_BatchNormWithReLU", aliases=["BatchNormWithReLU"],
+          num_outputs=3, aux_writeback={1: 3, 2: 4})
+def _batch_norm_with_relu(data, gamma, beta, moving_mean, moving_var,
+                          eps=1e-3, momentum=0.9, fix_gamma=True,
+                          use_global_stats=False, axis=1):
+    """Fused BatchNorm+ReLU (reference: batch_norm_relu.cc) — XLA fuses the
+    relu into the normalization epilogue."""
+    from .nn import _batch_norm
+    out, new_mean, new_var = _batch_norm(
+        data, gamma, beta, moving_mean, moving_var, eps=eps,
+        momentum=momentum, fix_gamma=fix_gamma,
+        use_global_stats=use_global_stats, axis=axis)
+    return jnp.maximum(out, 0), new_mean, new_var
+
+
+@register("_contrib_SyncBatchNorm", aliases=["SyncBatchNorm"],
+          num_outputs=3, aux_writeback={1: 3, 2: 4})
+def _sync_batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+                     momentum=0.9, fix_gamma=True, use_global_stats=False,
+                     ndev=1, key=None, axis_name=None):
+    """Cross-device BatchNorm (reference: sync_batch_norm.cc).  Inside
+    shard_map/pmap pass axis_name to psum the batch statistics over the
+    data-parallel axis; single-device it equals BatchNorm."""
+    red = tuple(i for i in range(data.ndim) if i != 1)
+    x = data.astype(jnp.float32)
+    if use_global_stats:
+        mean, var = moving_mean, moving_var
+        new_mean, new_var = moving_mean, moving_var
+    else:
+        mean = jnp.mean(x, axis=red)
+        sq = jnp.mean(x * x, axis=red)
+        if axis_name is not None:
+            mean = lax.pmean(mean, axis_name)
+            sq = lax.pmean(sq, axis_name)
+        var = sq - mean * mean
+        new_mean = momentum * moving_mean + (1.0 - momentum) * mean
+        new_var = momentum * moving_var + (1.0 - momentum) * var
+    shape = [1] * data.ndim
+    shape[1] = -1
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    out = (x - mean.reshape(shape)) / jnp.sqrt(var.reshape(shape) + eps)
+    out = out * g.reshape(shape) + beta.reshape(shape)
+    return out.astype(data.dtype), new_mean, new_var

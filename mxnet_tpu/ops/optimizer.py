@@ -249,3 +249,294 @@ def _sparse_adam_update(weight, grad_data, grad_idx, mean, var, lr=0.001,
     return (weight.at[grad_idx].set(new_w),
             mean.at[grad_idx].set(new_m),
             var.at[grad_idx].set(new_v))
+
+
+# ---------------------------------------------------------------------------
+# optimizer tail (reference: src/operator/optimizer_op.cc ftml/mp_* rows,
+# src/operator/contrib/optimizer_op.cc group_adagrad,
+# src/operator/contrib/multi_*.cc and preloaded_multi_*.cc fused fleets)
+# ---------------------------------------------------------------------------
+
+
+@register("ftml_update", differentiable=False, num_outputs=4,
+          mutates_input=0, aux_writeback={1: 2, 2: 3, 3: 4})
+def _ftml_update(weight, grad, d, v, z, lr=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, t=1, wd=0.0, rescale_grad=1.0,
+                 clip_grad=-1.0):
+    g = _prep(grad, rescale_grad, clip_grad, wd, weight)
+    v_new = beta2 * v + (1.0 - beta2) * g * g
+    bias2 = 1.0 - beta2 ** t
+    d_new = (1.0 - beta1 ** t) / lr * \
+        (jnp.sqrt(v_new / bias2) + epsilon)
+    sigma = d_new - beta1 * d
+    z_new = beta1 * z + (1.0 - beta1) * g - sigma * weight
+    w_new = -z_new / d_new
+    return w_new.astype(weight.dtype), d_new, v_new, z_new
+
+
+@register("mp_nag_mom_update", differentiable=False, num_outputs=3,
+          mutates_input=0, aux_writeback={1: 2, 2: 3})
+def _mp_nag_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                       wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep(grad.astype(jnp.float32), rescale_grad, clip_gradient, wd,
+              weight32)
+    new_mom = momentum * mom + g
+    new_w32 = weight32 - lr * (g + momentum * new_mom)
+    return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+@register("mp_lamb_update_phase1", differentiable=False, num_outputs=3,
+          aux_writeback={1: 2, 2: 3})
+def _mp_lamb_phase1(grad, weight32, mean, var, beta1=0.9, beta2=0.999,
+                    epsilon=1e-6, t=1, bias_correction=True, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep(grad.astype(jnp.float32), rescale_grad, clip_gradient)
+    new_mean = beta1 * mean + (1.0 - beta1) * g
+    new_var = beta2 * var + (1.0 - beta2) * g * g
+    m, v = new_mean, new_var
+    if bias_correction:
+        m = m / (1.0 - beta1 ** t)
+        v = v / (1.0 - beta2 ** t)
+    g_update = m / (jnp.sqrt(v) + epsilon) + wd * weight32
+    return g_update, new_mean, new_var
+
+
+@register("mp_lamb_update_phase2", differentiable=False, num_outputs=2,
+          mutates_input=0, aux_writeback={1: 4})
+def _mp_lamb_phase2(weight, g_update, r1, r2, weight32, lr=0.01,
+                    lower_bound=-1.0, upper_bound=-1.0):
+    r1 = jnp.where(lower_bound >= 0, jnp.maximum(r1, lower_bound), r1)
+    r1 = jnp.where(upper_bound >= 0, jnp.minimum(r1, upper_bound), r1)
+    ratio = jnp.where(jnp.logical_and(r1 > 0, r2 > 0), r1 / r2, 1.0)
+    new_w32 = weight32 - lr * ratio * g_update
+    return new_w32.astype(weight.dtype), new_w32
+
+
+@register("mp_adamw_update", aliases=["_mp_adamw_update"],
+          differentiable=False, num_outputs=4,
+          mutates_input=0, aux_writeback={1: 2, 2: 3, 3: 4})
+def _mp_adamw_update(weight, grad, mean, var, weight32, rescale_grad,
+                     lr=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                     wd=0.0, eta=1.0, clip_gradient=-1.0):
+    # rescale_grad arrives as a TENSOR (loss-scale) like the reference
+    g = grad.astype(jnp.float32) * rescale_grad.astype(jnp.float32)
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_mean = beta1 * mean + (1.0 - beta1) * g
+    new_var = beta2 * var + (1.0 - beta2) * g * g
+    # decoupled weight decay: wd OUTSIDE the lr factor (matches the fp32
+    # _adamw_update above and the reference's mp_adamw_update)
+    upd = lr * new_mean / (jnp.sqrt(new_var) + epsilon) + wd * weight32
+    new_w32 = weight32 - eta * upd
+    return new_w32.astype(weight.dtype), new_mean, new_var, new_w32
+
+
+@register("_contrib_group_adagrad_update",
+          aliases=["group_adagrad_update"], differentiable=False,
+          num_outputs=2, mutates_input=0, aux_writeback={1: 2})
+def _group_adagrad_update(weight, grad, history, lr=0.01, rescale_grad=1.0,
+                          clip_gradient=-1.0, epsilon=1e-5):
+    """Row-wise AdaGrad (reference: group_adagrad — Adagrad with one
+    accumulator per embedding row)."""
+    g = _prep(grad, rescale_grad, clip_gradient)
+    sq = jnp.mean(g * g, axis=tuple(range(1, g.ndim)), keepdims=True) \
+        if g.ndim > 1 else g * g
+    new_h = history + sq
+    return (weight - lr * g / (jnp.sqrt(new_h) + epsilon)).astype(
+        weight.dtype), new_h
+
+
+def _multi_pairs(arrays, stride):
+    n = len(arrays) // stride
+    return [tuple(arrays[i * stride + j] for j in range(stride))
+            for i in range(n)]
+
+
+def _scalar_list(v, n, default):
+    if v is None:
+        return (default,) * n
+    if isinstance(v, (int, float)):
+        return (float(v),) * n
+    return tuple(float(x) for x in v)
+
+
+@register("multi_sgd_update", differentiable=False, num_outputs=-1,
+          aux_writeback=lambda p: {i: 2 * i
+                                   for i in range(int(p.get("num_weights",
+                                                            1)))})
+def _multi_sgd_update(*arrays, lrs=None, wds=None, rescale_grad=1.0,
+                      clip_gradient=-1.0, num_weights=1):
+    """Fused SGD over many (weight, grad) pairs in ONE launch (reference:
+    multi_sgd_update — kernel-launch amortization; here one XLA program).
+    Outputs are written back in place via the registry's (callable)
+    aux_writeback map keyed on num_weights."""
+    lrs = _scalar_list(lrs, num_weights, 0.01)
+    wds = _scalar_list(wds, num_weights, 0.0)
+    outs = []
+    for i, (w, g) in enumerate(_multi_pairs(list(arrays), 2)):
+        gg = _prep(g, rescale_grad, clip_gradient, wds[i], w)
+        outs.append(w - lrs[i] * gg.astype(w.dtype))
+    return tuple(outs)
+
+
+@register("multi_sgd_mom_update", differentiable=False, num_outputs=-1,
+          aux_writeback=lambda p: {k: v for i in range(
+              int(p.get("num_weights", 1)))
+              for k, v in ((2 * i, 3 * i), (2 * i + 1, 3 * i + 2))})
+def _multi_sgd_mom_update(*arrays, lrs=None, wds=None, momentum=0.0,
+                          rescale_grad=1.0, clip_gradient=-1.0,
+                          num_weights=1):
+    lrs = _scalar_list(lrs, num_weights, 0.01)
+    wds = _scalar_list(wds, num_weights, 0.0)
+    outs = []
+    for i, (w, g, m) in enumerate(_multi_pairs(list(arrays), 3)):
+        gg = _prep(g, rescale_grad, clip_gradient, wds[i], w)
+        new_m = momentum * m - lrs[i] * gg.astype(m.dtype)
+        outs.append(w + new_m.astype(w.dtype))
+        outs.append(new_m)
+    return tuple(outs)
+
+
+@register("multi_mp_sgd_update", differentiable=False, num_outputs=-1,
+          aux_writeback=lambda p: {k: v for i in range(
+              int(p.get("num_weights", 1)))
+              for k, v in ((2 * i, 3 * i), (2 * i + 1, 3 * i + 2))})
+def _multi_mp_sgd_update(*arrays, lrs=None, wds=None, rescale_grad=1.0,
+                         clip_gradient=-1.0, num_weights=1):
+    lrs = _scalar_list(lrs, num_weights, 0.01)
+    wds = _scalar_list(wds, num_weights, 0.0)
+    outs = []
+    for i, (w, g, w32) in enumerate(_multi_pairs(list(arrays), 3)):
+        gg = _prep(g.astype(jnp.float32), rescale_grad, clip_gradient,
+                   wds[i], w32)
+        new_w32 = w32 - lrs[i] * gg
+        outs.append(new_w32.astype(w.dtype))
+        outs.append(new_w32)
+    return tuple(outs)
+
+
+@register("multi_mp_sgd_mom_update", differentiable=False,
+          num_outputs=-1,
+          aux_writeback=lambda p: {k: v for i in range(
+              int(p.get("num_weights", 1)))
+              for k, v in ((3 * i, 4 * i), (3 * i + 1, 4 * i + 2),
+                           (3 * i + 2, 4 * i + 3))})
+def _multi_mp_sgd_mom_update(*arrays, lrs=None, wds=None, momentum=0.0,
+                             rescale_grad=1.0, clip_gradient=-1.0,
+                             num_weights=1):
+    lrs = _scalar_list(lrs, num_weights, 0.01)
+    wds = _scalar_list(wds, num_weights, 0.0)
+    outs = []
+    for i, (w, g, m, w32) in enumerate(_multi_pairs(list(arrays), 4)):
+        gg = _prep(g.astype(jnp.float32), rescale_grad, clip_gradient,
+                   wds[i], w32)
+        new_m = momentum * m - lrs[i] * gg
+        new_w32 = w32 + new_m
+        outs.append(new_w32.astype(w.dtype))
+        outs.append(new_m)
+        outs.append(new_w32)
+    return tuple(outs)
+
+
+@register("multi_sum_sq", differentiable=False)
+def _multi_sum_sq(*arrays, num_arrays=1):
+    """Σx² per input array, stacked into one (N,) vector (reference:
+    multi_sum_sq — the LARS norm pass)."""
+    return jnp.stack([jnp.sum(a.astype(jnp.float32) * a) for a in arrays])
+
+
+@register("multi_lars", differentiable=False)
+def _multi_lars(lrs, weights_sum_sq, grads_sum_sq, wds, eta=0.001,
+                eps=1e-8, rescale_grad=1.0):
+    """LARS learning-rate adaptation over stacked per-layer norms
+    (reference: multi_lars)."""
+    w_norm = jnp.sqrt(weights_sum_sq)
+    g_norm = jnp.sqrt(grads_sum_sq) * rescale_grad
+    trust = jnp.where((w_norm > 0) & (g_norm > 0),
+                      eta * w_norm / (g_norm + wds * w_norm + eps), 1.0)
+    return lrs * trust
+
+
+@register("preloaded_multi_sgd_update", differentiable=False,
+          num_outputs=-1,
+          aux_writeback=lambda p: {i: 2 * i for i in range(
+              int(p.get("num_weights", 1)))})
+def _preloaded_multi_sgd_update(*arrays, rescale_grad=1.0,
+                                clip_gradient=-1.0, num_weights=1):
+    """multi_sgd with lrs/wds as the trailing TENSOR inputs (reference:
+    preloaded_multi_sgd_update — LARS feeds adapted lrs without a host
+    roundtrip)."""
+    lrs, wds = arrays[-2], arrays[-1]
+    outs = []
+    for i, (w, g) in enumerate(_multi_pairs(list(arrays[:-2]), 2)):
+        # lr/wd are TENSOR elements (traced): apply arithmetically
+        gg = _prep(g, rescale_grad, clip_gradient) + wds[i] * w
+        outs.append(w - lrs[i] * gg.astype(w.dtype))
+    return tuple(outs)
+
+
+@register("preloaded_multi_sgd_mom_update", differentiable=False,
+          num_outputs=-1,
+          aux_writeback=lambda p: {k: v for i in range(
+              int(p.get("num_weights", 1)))
+              for k, v in ((2 * i, 3 * i), (2 * i + 1, 3 * i + 2))})
+def _preloaded_multi_sgd_mom_update(*arrays, momentum=0.0, rescale_grad=1.0,
+                                    clip_gradient=-1.0, num_weights=1):
+    lrs, wds = arrays[-2], arrays[-1]
+    outs = []
+    for i, (w, g, m) in enumerate(_multi_pairs(list(arrays[:-2]), 3)):
+        gg = _prep(g, rescale_grad, clip_gradient) + wds[i] * w
+        new_m = momentum * m - lrs[i] * gg.astype(m.dtype)
+        outs.append(w + new_m.astype(w.dtype))
+        outs.append(new_m)
+    return tuple(outs)
+
+
+@register("preloaded_multi_mp_sgd_update", differentiable=False,
+          num_outputs=-1,
+          aux_writeback=lambda p: {k: v for i in range(
+              int(p.get("num_weights", 1)))
+              for k, v in ((2 * i, 3 * i), (2 * i + 1, 3 * i + 2))})
+def _preloaded_multi_mp_sgd_update(*arrays, rescale_grad=1.0,
+                                   clip_gradient=-1.0, num_weights=1):
+    lrs, wds = arrays[-2], arrays[-1]
+    outs = []
+    for i, (w, g, w32) in enumerate(_multi_pairs(list(arrays[:-2]), 3)):
+        gg = _prep(g.astype(jnp.float32), rescale_grad, clip_gradient) \
+            + wds[i] * w32
+        new_w32 = w32 - lrs[i] * gg
+        outs.append(new_w32.astype(w.dtype))
+        outs.append(new_w32)
+    return tuple(outs)
+
+
+@register("preloaded_multi_mp_sgd_mom_update", differentiable=False,
+          num_outputs=-1,
+          aux_writeback=lambda p: {k: v for i in range(
+              int(p.get("num_weights", 1)))
+              for k, v in ((3 * i, 4 * i), (3 * i + 1, 4 * i + 2),
+                           (3 * i + 2, 4 * i + 3))})
+def _preloaded_multi_mp_sgd_mom_update(*arrays, momentum=0.0,
+                                       rescale_grad=1.0, clip_gradient=-1.0,
+                                       num_weights=1):
+    lrs, wds = arrays[-2], arrays[-1]
+    outs = []
+    for i, (w, g, m, w32) in enumerate(_multi_pairs(list(arrays[:-2]), 4)):
+        gg = _prep(g.astype(jnp.float32), rescale_grad, clip_gradient) \
+            + wds[i] * w32
+        new_m = momentum * m - lrs[i] * gg
+        new_w32 = w32 + new_m
+        outs.append(new_w32.astype(w.dtype))
+        outs.append(new_m)
+        outs.append(new_w32)
+    return tuple(outs)
+
+
+@register("reset_arrays", differentiable=False, num_outputs=-1,
+          aux_writeback=lambda p: {i: i for i in range(
+              int(p.get("num_arrays", 1)))})
+def _reset_arrays(*arrays, num_arrays=1):
+    """Zero every input (reference: reset_arrays — gradient clearing in one
+    launch).  Functional: returns the zeroed copies; in-place semantics come
+    from the NDArray call layer."""
+    return tuple(jnp.zeros_like(a) for a in arrays)

@@ -222,3 +222,134 @@ def _quantized_act(data, min_data, max_data, act_type="relu"):
     mx_ = jnp.asarray(max_data, jnp.float32)
     return (out, jnp.zeros((1,), jnp.float32),
             jnp.reshape(jnp.maximum(mx_, 0.0), (1,)))
+
+
+# ---------------------------------------------------------------------------
+# quantized op tail (reference: src/operator/quantization/
+# quantized_batch_norm.cc, quantized_elemwise_add.cc,
+# quantized_elemwise_mul.cc, quantized_indexing_op.cc (embedding),
+# quantized_concat.cc, calibrate.cc) and the intgemm bridge
+# (src/operator/contrib/intgemm/*.cc — here the MXU plays VNNI's role).
+# ---------------------------------------------------------------------------
+
+
+@register("_contrib_quantized_batch_norm", num_outputs=3,
+          differentiable=False, aliases=["quantized_batch_norm"])
+def _quantized_batch_norm(data, gamma, beta, moving_mean, moving_var,
+                          min_data, max_data, eps=1e-3, momentum=0.9,
+                          fix_gamma=True, use_global_stats=True, axis=1):
+    """int8 BN folded to an affine per-channel op in the float domain, then
+    requantized (reference: quantized_batch_norm.cc inference-only path)."""
+    f = _dequantize(data, min_data, max_data)
+    shape = [1] * f.ndim
+    shape[axis] = -1
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    inv = g / jnp.sqrt(moving_var + eps)
+    out = (f - moving_mean.reshape(shape)) * inv.reshape(shape) \
+        + beta.reshape(shape)
+    omax = jnp.max(jnp.abs(out))
+    return _quantize(out, -omax, omax, out_type="int8")
+
+
+@register("_contrib_quantized_elemwise_add", num_outputs=3,
+          differentiable=False, aliases=["quantized_elemwise_add"])
+def _quantized_elemwise_add(lhs, rhs, lhs_min, lhs_max, rhs_min, rhs_max):
+    f = _dequantize(lhs, lhs_min, lhs_max) + _dequantize(rhs, rhs_min,
+                                                         rhs_max)
+    amax = jnp.max(jnp.abs(f))
+    return _quantize(f, -amax, amax, out_type="int8")
+
+
+@register("_contrib_quantized_elemwise_mul", num_outputs=3,
+          differentiable=False, aliases=["quantized_elemwise_mul"])
+def _quantized_elemwise_mul(lhs, rhs, lhs_min, lhs_max, rhs_min, rhs_max):
+    sl = _deq_scale(lhs_min, lhs_max, lhs.dtype)
+    sr = _deq_scale(rhs_min, rhs_max, rhs.dtype)
+    acc = lhs.astype(jnp.int32) * rhs.astype(jnp.int32)
+    out_scale = sl * sr
+    amax = 2147483647.0 * out_scale
+    return acc, jnp.reshape(-amax, (1,)), jnp.reshape(amax, (1,))
+
+
+@register("_contrib_quantized_embedding", num_outputs=3,
+          differentiable=False, aliases=["quantized_embedding"])
+def _quantized_embedding(data, weight, min_weight, max_weight,
+                         input_dim=None, output_dim=None, dtype="float32"):
+    rows = jnp.take(weight, data.astype(jnp.int32), axis=0)
+    return (rows, jnp.reshape(jnp.asarray(min_weight, jnp.float32), (1,)),
+            jnp.reshape(jnp.asarray(max_weight, jnp.float32), (1,)))
+
+
+@register("_contrib_quantized_concat", num_outputs=3,
+          differentiable=False, aliases=["quantized_concat"])
+def _quantized_concat(*args, num_args=1, dim=1):
+    """Concat in the quantized domain: inputs arrive interleaved
+    (d0..dn, min0, max0, ..minn, maxn); requantize to the widest range."""
+    n = num_args
+    datas, mins, maxs = args[:n], args[n::2][:n], args[n + 1::2][:n]
+    fs = [_dequantize(d, mn, mx) for d, mn, mx in zip(datas, mins, maxs)]
+    f = jnp.concatenate(fs, axis=dim)
+    amax = jnp.max(jnp.abs(f))
+    return _quantize(f, -amax, amax, out_type="int8")
+
+
+@register("_contrib_calibrate_entropy", num_outputs=2,
+          differentiable=False, aliases=["calibrate_entropy"], no_jit=True)
+def _calibrate_entropy(hist, hist_edges, num_quantized_bins=255):
+    """KL threshold from a collected histogram (reference: calibrate.cc).
+    Host-side: the scan is control-flow heavy and calibration is offline."""
+    import numpy as np
+    h = np.asarray(hist, np.float64)
+    edges = np.asarray(hist_edges, np.float64)
+    centers = np.abs((edges[:-1] + edges[1:]) / 2)
+    synth = np.repeat(centers, np.minimum(h.astype(np.int64), 1 << 16))
+    from ..contrib.quantization import _get_optimal_threshold
+    t = _get_optimal_threshold(synth, num_bins=min(len(h), 8001),
+                               num_quantized_bins=num_quantized_bins)
+    return (jnp.asarray([-t], jnp.float32), jnp.asarray([t], jnp.float32))
+
+
+@register("_contrib_intgemm_maxabsolute", aliases=["intgemm_maxabsolute"],
+          differentiable=False)
+def _intgemm_maxabsolute(data):
+    return jnp.max(jnp.abs(data)).reshape((1,))
+
+
+@register("_contrib_intgemm_prepare_data", aliases=["intgemm_prepare_data"],
+          differentiable=False)
+def _intgemm_prepare_data(data, maxabs):
+    scale = 127.0 / jnp.maximum(jnp.reshape(maxabs, ()), 1e-30)
+    return jnp.clip(jnp.rint(data * scale), -127, 127).astype(jnp.int8)
+
+
+@register("_contrib_intgemm_prepare_weight",
+          aliases=["intgemm_prepare_weight"], differentiable=False)
+def _intgemm_prepare_weight(weight, maxabs=None, already_quantized=False):
+    if already_quantized:
+        return weight.astype(jnp.int8)
+    scale = 127.0 / jnp.maximum(jnp.reshape(maxabs, ()), 1e-30)
+    return jnp.clip(jnp.rint(weight * scale), -127, 127).astype(jnp.int8)
+
+
+@register("_contrib_intgemm_take_weight", aliases=["intgemm_take_weight"],
+          differentiable=False)
+def _intgemm_take_weight(weight, indices):
+    return jnp.take(weight, indices.astype(jnp.int32), axis=0)
+
+
+@register("_contrib_intgemm_fully_connected",
+          aliases=["intgemm_fully_connected"], differentiable=False)
+def _intgemm_fully_connected(data, weight, scaling, bias=None,
+                             num_hidden=None, no_bias=False, flatten=True,
+                             out_type="float32"):
+    """int8×int8→int32 GEMM rescaled to float (reference: intgemm's
+    Multiply + UnquantizeAndWrite callback; MXU int8 path here)."""
+    x = data.reshape(data.shape[0], -1) if flatten and data.ndim > 2 else data
+    acc = lax.dot_general(
+        x.astype(jnp.int8), weight.astype(jnp.int8),
+        (((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * jnp.reshape(scaling, ())
+    if bias is not None and not no_bias:
+        out = out + bias.astype(jnp.float32)
+    return out if out_type == "float32" else acc

@@ -144,3 +144,235 @@ def _shuffle(key, data):
 @register("sample_normal_like", differentiable=False, needs_rng=True)
 def _normal_like(key, data, loc=0.0, scale=1.0):
     return jax.random.normal(key, data.shape, data.dtype) * scale + loc
+
+
+# ---------------------------------------------------------------------------
+# distribution tail (reference: src/operator/random/sample_op.cc) — inverse-
+# CDF transforms over uniform/gamma primitives; all counter-based stateless
+# ---------------------------------------------------------------------------
+
+
+def _u(key, shape, dtype):
+    # uniform in (0, 1): open at 0 so log() stays finite
+    return jax.random.uniform(key, shape, dtype, minval=jnp.finfo(dtype).tiny,
+                              maxval=1.0)
+
+
+@register("_random_negative_binomial",
+          aliases=["random_negative_binomial", "negative_binomial"],
+          differentiable=False, needs_rng=True)
+def _negative_binomial(key, k=1, p=1.0, shape=(), dtype=None):
+    """NB(k, p) == Poisson(Gamma(k, (1-p)/p)) (reference sampler)."""
+    dt = _dt(dtype)
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, float(k), shape, jnp.float32) * \
+        ((1.0 - p) / max(p, 1e-12))
+    return jax.random.poisson(k2, lam, shape).astype(dt)
+
+
+@register("_random_generalized_negative_binomial",
+          aliases=["random_generalized_negative_binomial",
+                   "generalized_negative_binomial"],
+          differentiable=False, needs_rng=True)
+def _gen_negative_binomial(key, mu=1.0, alpha=1.0, shape=(), dtype=None):
+    """GNB(mu, alpha): Poisson with Gamma(1/alpha, mu*alpha) rate."""
+    dt = _dt(dtype)
+    if alpha == 0.0:
+        return jax.random.poisson(key, mu, shape).astype(dt)
+    k1, k2 = jax.random.split(key)
+    r = 1.0 / alpha
+    lam = jax.random.gamma(k1, r, shape, jnp.float32) * (mu * alpha)
+    return jax.random.poisson(k2, lam, shape).astype(dt)
+
+
+@register("_random_pareto", aliases=["random_pareto", "pareto"],
+          differentiable=False, needs_rng=True)
+def _pareto(key, a=1.0, shape=(), dtype=None):
+    dt = _dt(dtype)
+    return jnp.expm1(-jnp.log(_u(key, shape, jnp.float32)) / a).astype(dt)
+
+
+@register("_random_rayleigh", aliases=["random_rayleigh", "rayleigh"],
+          differentiable=False, needs_rng=True)
+def _rayleigh(key, scale=1.0, shape=(), dtype=None):
+    dt = _dt(dtype)
+    u = _u(key, shape, jnp.float32)
+    return (scale * jnp.sqrt(-2.0 * jnp.log(u))).astype(dt)
+
+
+@register("_random_weibull", aliases=["random_weibull", "weibull"],
+          differentiable=False, needs_rng=True)
+def _weibull(key, a=1.0, shape=(), dtype=None):
+    dt = _dt(dtype)
+    u = _u(key, shape, jnp.float32)
+    return jnp.power(-jnp.log(u), 1.0 / a).astype(dt)
+
+
+@register("_random_logistic", aliases=["random_logistic", "logistic"],
+          differentiable=False, needs_rng=True)
+def _logistic(key, loc=0.0, scale=1.0, shape=(), dtype=None):
+    dt = _dt(dtype)
+    return (jax.random.logistic(key, shape, jnp.float32) * scale
+            + loc).astype(dt)
+
+
+@register("_random_gumbel", aliases=["random_gumbel", "gumbel"],
+          differentiable=False, needs_rng=True)
+def _gumbel(key, loc=0.0, scale=1.0, shape=(), dtype=None):
+    dt = _dt(dtype)
+    return (jax.random.gumbel(key, shape, jnp.float32) * scale
+            + loc).astype(dt)
+
+
+# ---------------------------------------------------------------------------
+# sample_* family: per-row distribution parameters as TENSOR inputs
+# (reference: src/operator/random/multisample_op.cc — each row of the
+# parameter tensors draws `shape` samples)
+# ---------------------------------------------------------------------------
+
+
+def _persample(key, params, shape, draw):
+    """params: tuple of same-shape tensors; returns shape params.shape+shape
+    with draw(key, *scalar_params, sample_shape)."""
+    ps = params[0].shape
+    extra = tuple(shape) if isinstance(shape, (tuple, list)) else \
+        ((int(shape),) if shape else ())
+    out_shape = ps + extra
+    return draw(key, params, out_shape, extra)
+
+
+@register("_sample_uniform", aliases=["sample_uniform"],
+          differentiable=False, needs_rng=True)
+def _sample_uniform(key, low, high, shape=(), dtype=None):
+    dt = _dt(dtype)
+
+    def draw(key, params, out_shape, extra):
+        low, high = params
+        u = jax.random.uniform(key, out_shape, jnp.float32)
+        lowb = low.reshape(low.shape + (1,) * len(extra))
+        highb = high.reshape(high.shape + (1,) * len(extra))
+        return (lowb + u * (highb - lowb)).astype(dt)
+    return _persample(key, (low, high), shape, draw)
+
+
+@register("_sample_normal", aliases=["sample_normal"],
+          differentiable=False, needs_rng=True)
+def _sample_normal(key, mu, sigma, shape=(), dtype=None):
+    dt = _dt(dtype)
+
+    def draw(key, params, out_shape, extra):
+        mu, sigma = params
+        z = jax.random.normal(key, out_shape, jnp.float32)
+        mub = mu.reshape(mu.shape + (1,) * len(extra))
+        sigb = sigma.reshape(sigma.shape + (1,) * len(extra))
+        return (mub + z * sigb).astype(dt)
+    return _persample(key, (mu, sigma), shape, draw)
+
+
+@register("_sample_gamma", aliases=["sample_gamma"],
+          differentiable=False, needs_rng=True)
+def _sample_gamma(key, alpha, beta, shape=(), dtype=None):
+    dt = _dt(dtype)
+
+    def draw(key, params, out_shape, extra):
+        alpha, beta = params
+        ab = alpha.reshape(alpha.shape + (1,) * len(extra))
+        bb = beta.reshape(beta.shape + (1,) * len(extra))
+        g = jax.random.gamma(key, jnp.broadcast_to(ab, out_shape), out_shape,
+                             jnp.float32)
+        return (g * bb).astype(dt)
+    return _persample(key, (alpha, beta), shape, draw)
+
+
+@register("_sample_exponential", aliases=["sample_exponential"],
+          differentiable=False, needs_rng=True)
+def _sample_exponential(key, lam, shape=(), dtype=None):
+    dt = _dt(dtype)
+
+    def draw(key, params, out_shape, extra):
+        (lam,) = params
+        lamb = lam.reshape(lam.shape + (1,) * len(extra))
+        e = jax.random.exponential(key, out_shape, jnp.float32)
+        return (e / lamb).astype(dt)
+    return _persample(key, (lam,), shape, draw)
+
+
+@register("_sample_poisson", aliases=["sample_poisson"],
+          differentiable=False, needs_rng=True)
+def _sample_poisson(key, lam, shape=(), dtype=None):
+    dt = _dt(dtype)
+
+    def draw(key, params, out_shape, extra):
+        (lam,) = params
+        lamb = jnp.broadcast_to(lam.reshape(lam.shape + (1,) * len(extra)),
+                                out_shape)
+        return jax.random.poisson(key, lamb).astype(dt)
+    return _persample(key, (lam,), shape, draw)
+
+
+@register("_sample_negative_binomial", aliases=["sample_negative_binomial"],
+          differentiable=False, needs_rng=True)
+def _sample_negative_binomial(key, k, p, shape=(), dtype=None):
+    dt = _dt(dtype)
+
+    def draw(key, params, out_shape, extra):
+        k_, p_ = params
+        kb = jnp.broadcast_to(k_.reshape(k_.shape + (1,) * len(extra))
+                              .astype(jnp.float32), out_shape)
+        pb = jnp.broadcast_to(p_.reshape(p_.shape + (1,) * len(extra))
+                              .astype(jnp.float32), out_shape)
+        k1, k2 = jax.random.split(key)
+        lam = jax.random.gamma(k1, kb, out_shape, jnp.float32) * \
+            ((1.0 - pb) / jnp.maximum(pb, 1e-12))
+        return jax.random.poisson(k2, lam).astype(dt)
+    return _persample(key, (k, p), shape, draw)
+
+
+@register("_sample_generalized_negative_binomial",
+          aliases=["sample_generalized_negative_binomial"],
+          differentiable=False, needs_rng=True)
+def _sample_gen_negative_binomial(key, mu, alpha, shape=(), dtype=None):
+    dt = _dt(dtype)
+
+    def draw(key, params, out_shape, extra):
+        mu_, al_ = params
+        mub = jnp.broadcast_to(mu_.reshape(mu_.shape + (1,) * len(extra))
+                               .astype(jnp.float32), out_shape)
+        alb = jnp.broadcast_to(al_.reshape(al_.shape + (1,) * len(extra))
+                               .astype(jnp.float32), out_shape)
+        k1, k2 = jax.random.split(key)
+        r = 1.0 / jnp.maximum(alb, 1e-12)
+        lam = jax.random.gamma(k1, r, out_shape, jnp.float32) * (mub * alb)
+        return jax.random.poisson(k2, lam).astype(dt)
+    return _persample(key, (mu, alpha), shape, draw)
+
+
+@register("_sample_unique_zipfian", aliases=["sample_unique_zipfian"],
+          differentiable=False, needs_rng=True, no_jit=True,
+          num_outputs=2)
+def _sample_unique_zipfian(key, range_max=1, shape=()):
+    """Unique zipfian draws for sampled softmax (reference:
+    src/operator/random/unique_sample_op.cc).  Dynamic-unique ⇒ eager-only;
+    returns (samples, expected-count-per-draw)."""
+    import numpy as np
+    n = 1
+    for s in (shape if isinstance(shape, (tuple, list)) else (shape,)):
+        n *= int(s) if s else 1
+    seed = int(jax.random.randint(key, (), 0, 2**31 - 1))
+    rng = np.random.RandomState(seed)
+    log_range = np.log(range_max + 1.0)
+    out, seen = [], set()
+    trials = 0
+    while len(out) < n:
+        u = rng.rand()
+        v = int(np.exp(u * log_range)) - 1
+        v = min(max(v, 0), range_max - 1)
+        trials += 1
+        if v not in seen:
+            seen.add(v)
+            out.append(v)
+    samples = np.asarray(out, np.int64)
+    prob = np.log((samples + 2.0) / (samples + 1.0)) / log_range
+    cnt = prob * trials
+    return (jnp.asarray(samples),
+            jnp.asarray(cnt.astype(np.float32)))

@@ -1,0 +1,139 @@
+"""Scalar-operand elemwise ops (the reference's _plus_scalar family).
+
+Reference: src/operator/tensor/elemwise_binary_scalar_op_basic.cc,
+elemwise_binary_scalar_op_extended.cc, elemwise_binary_scalar_op_logic.cc.
+MXNet routes NDArray-op-python-number arithmetic through these; the
+``scalar`` attribute is a static param, so under jit it folds into the
+compiled program (no host->device transfer per call).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = []
+
+
+@register("_plus_scalar", aliases=["plus_scalar"])
+def _plus_scalar(data, scalar=0.0):
+    return data + jnp.asarray(scalar, data.dtype)
+
+
+@register("_minus_scalar", aliases=["minus_scalar"])
+def _minus_scalar(data, scalar=0.0):
+    return data - jnp.asarray(scalar, data.dtype)
+
+
+@register("_rminus_scalar", aliases=["rminus_scalar"])
+def _rminus_scalar(data, scalar=0.0):
+    return jnp.asarray(scalar, data.dtype) - data
+
+
+@register("_mul_scalar", aliases=["mul_scalar"])
+def _mul_scalar(data, scalar=1.0):
+    return data * jnp.asarray(scalar, data.dtype)
+
+
+@register("_div_scalar", aliases=["div_scalar"])
+def _div_scalar(data, scalar=1.0):
+    return data / jnp.asarray(scalar, data.dtype)
+
+
+@register("_rdiv_scalar", aliases=["rdiv_scalar"])
+def _rdiv_scalar(data, scalar=1.0):
+    return jnp.asarray(scalar, data.dtype) / data
+
+
+@register("_mod_scalar", aliases=["mod_scalar"], differentiable=False)
+def _mod_scalar(data, scalar=1.0):
+    return jnp.mod(data, jnp.asarray(scalar, data.dtype))
+
+
+@register("_rmod_scalar", aliases=["rmod_scalar"], differentiable=False)
+def _rmod_scalar(data, scalar=1.0):
+    return jnp.mod(jnp.asarray(scalar, data.dtype), data)
+
+
+@register("_power_scalar", aliases=["power_scalar"])
+def _power_scalar(data, scalar=1.0):
+    return jnp.power(data, jnp.asarray(scalar, data.dtype))
+
+
+@register("_rpower_scalar", aliases=["rpower_scalar"])
+def _rpower_scalar(data, scalar=1.0):
+    return jnp.power(jnp.asarray(scalar, data.dtype), data)
+
+
+@register("_maximum_scalar", aliases=["maximum_scalar"])
+def _maximum_scalar(data, scalar=0.0):
+    return jnp.maximum(data, jnp.asarray(scalar, data.dtype))
+
+
+@register("_minimum_scalar", aliases=["minimum_scalar"])
+def _minimum_scalar(data, scalar=0.0):
+    return jnp.minimum(data, jnp.asarray(scalar, data.dtype))
+
+
+@register("_hypot_scalar", aliases=["hypot_scalar"])
+def _hypot_scalar(data, scalar=0.0):
+    return jnp.hypot(data, jnp.asarray(scalar, data.dtype))
+
+
+@register("_equal_scalar", aliases=["equal_scalar"], differentiable=False)
+def _equal_scalar(data, scalar=0.0):
+    return (data == jnp.asarray(scalar, data.dtype)).astype(data.dtype)
+
+
+@register("_not_equal_scalar", aliases=["not_equal_scalar"],
+          differentiable=False)
+def _not_equal_scalar(data, scalar=0.0):
+    return (data != jnp.asarray(scalar, data.dtype)).astype(data.dtype)
+
+
+@register("_greater_scalar", aliases=["greater_scalar"], differentiable=False)
+def _greater_scalar(data, scalar=0.0):
+    return (data > jnp.asarray(scalar, data.dtype)).astype(data.dtype)
+
+
+@register("_greater_equal_scalar", aliases=["greater_equal_scalar"],
+          differentiable=False)
+def _greater_equal_scalar(data, scalar=0.0):
+    return (data >= jnp.asarray(scalar, data.dtype)).astype(data.dtype)
+
+
+@register("_lesser_scalar", aliases=["lesser_scalar"], differentiable=False)
+def _lesser_scalar(data, scalar=0.0):
+    return (data < jnp.asarray(scalar, data.dtype)).astype(data.dtype)
+
+
+@register("_lesser_equal_scalar", aliases=["lesser_equal_scalar"],
+          differentiable=False)
+def _lesser_equal_scalar(data, scalar=0.0):
+    return (data <= jnp.asarray(scalar, data.dtype)).astype(data.dtype)
+
+
+@register("_logical_and_scalar", aliases=["logical_and_scalar"],
+          differentiable=False)
+def _logical_and_scalar(data, scalar=0.0):
+    return jnp.logical_and(data, scalar).astype(data.dtype)
+
+
+@register("_logical_or_scalar", aliases=["logical_or_scalar"],
+          differentiable=False)
+def _logical_or_scalar(data, scalar=0.0):
+    return jnp.logical_or(data, scalar).astype(data.dtype)
+
+
+@register("_logical_xor_scalar", aliases=["logical_xor_scalar"],
+          differentiable=False)
+def _logical_xor_scalar(data, scalar=0.0):
+    return jnp.logical_xor(data, scalar).astype(data.dtype)
+
+
+@register("smooth_l1_scalar", aliases=["_smooth_l1_scalar"])
+def _smooth_l1_scalar(data, scalar=1.0):
+    # reference smooth_l1 with sigma passed as the scalar operand
+    s2 = jnp.asarray(scalar, data.dtype) ** 2
+    a = jnp.abs(data)
+    return jnp.where(a < 1.0 / s2, 0.5 * s2 * data * data, a - 0.5 / s2)

@@ -342,8 +342,10 @@ def _make_op_symbol(op_name: str, inputs: List[Symbol],
         in_heads.append(s._heads[0])
     node = _SymNode(op.name, name or _gen_name(op_name), attrs, in_heads)
     n_out = op.num_outputs
-    if op.aux_writeback:
+    if op.aux_writeback and not callable(op.aux_writeback):
         n_out = n_out - len(op.aux_writeback)
+    elif callable(op.aux_writeback):
+        n_out = n_out - len(op.aux_writeback(attrs))
     if n_out == 1:
         return Symbol([(node, 0)])
     return Symbol([(node, i) for i in range(n_out)])

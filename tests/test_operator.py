@@ -146,7 +146,10 @@ SPECS.update({
               ref=lambda x: np.sqrt((x * x).sum())),
     "std": S(lambda: [f(3, 4)], {"axis": 1}, ref=lambda x: x.std(1)),
     "var": S(lambda: [f(3, 4)], {"axis": 1}, ref=lambda x: x.var(1)),
-    "ptp": S(lambda: [f(3, 4)], {"axis": 1}, ref=lambda x: np.ptp(x, 1)),
+    # well-separated values: numeric grad is undefined at tied extrema
+    "ptp": S(lambda: [np.argsort(R.rand(3, 4), 1).astype(np.float32)
+                      + f(3, 4) * 0.1],
+             {"axis": 1}, ref=lambda x: np.ptp(x, 1)),
     "median": S(lambda: [f(3, 5)], {"axis": 1},
                 ref=lambda x: np.median(x, 1), grad=False),
     "quantile": S(lambda: [f(3, 5)], {"q": 0.5, "axis": 1},
@@ -232,7 +235,8 @@ SPECS.update({
                         grad=False),
     "depth_to_space": S(lambda: [f(1, 4, 2, 2)], {"block_size": 2},
                         grad=False),
-    "reverse": S(lambda: [f(3, 4)], {"axis": 0}, ref=lambda x: x[::-1]),
+    "reverse": S(lambda: [f(3, 4)], {"axis": (0, 1)},
+                 ref=lambda x: x[::-1, ::-1]),
     "shape_array": S(lambda: [f(3, 4)],
                      ref=lambda x: np.array([3, 4], np.int64), grad=False),
     "size_array": S(lambda: [f(3, 4)],
@@ -468,6 +472,441 @@ SPECS.update({
                           np.float32)], grad=False),
     "_contrib_box_iou": S(lambda: [fpos(3, 4), fpos(2, 4)], grad=False),
 })
+
+
+# --- scalar-operand family (reference: elemwise_binary_scalar_op*) --------
+_SCALAR_REFS = {
+    "_plus_scalar": lambda x, s: x + s,
+    "_minus_scalar": lambda x, s: x - s,
+    "_rminus_scalar": lambda x, s: s - x,
+    "_mul_scalar": lambda x, s: x * s,
+    "_div_scalar": lambda x, s: x / s,
+    "_rdiv_scalar": lambda x, s: s / x,
+    "_mod_scalar": lambda x, s: np.mod(x, s),
+    "_power_scalar": lambda x, s: np.power(np.abs(x) + 0.5, s),
+    "_rpower_scalar": lambda x, s: np.power(s, x),
+    "_maximum_scalar": lambda x, s: np.maximum(x, s),
+    "_minimum_scalar": lambda x, s: np.minimum(x, s),
+    "_hypot_scalar": lambda x, s: np.hypot(x, s),
+    "_equal_scalar": lambda x, s: (x == s).astype(np.float32),
+    "_not_equal_scalar": lambda x, s: (x != s).astype(np.float32),
+    "_greater_scalar": lambda x, s: (x > s).astype(np.float32),
+    "_greater_equal_scalar": lambda x, s: (x >= s).astype(np.float32),
+    "_lesser_scalar": lambda x, s: (x < s).astype(np.float32),
+    "_lesser_equal_scalar": lambda x, s: (x <= s).astype(np.float32),
+    "_logical_and_scalar":
+        lambda x, s: np.logical_and(x, s).astype(np.float32),
+    "_logical_or_scalar":
+        lambda x, s: np.logical_or(x, s).astype(np.float32),
+    "_logical_xor_scalar":
+        lambda x, s: np.logical_xor(x, s).astype(np.float32),
+}
+for _name, _sref in _SCALAR_REFS.items():
+    SPECS[_name] = S(lambda: [f(3, 4)], {"scalar": 0.7},
+                     ref=(lambda r=_sref: lambda x: r(x, 0.7))())
+SPECS["_power_scalar"] = S(lambda: [fpos(3, 4)], {"scalar": 1.3},
+                           ref=lambda x: np.power(x, 1.3))
+SPECS["_rmod_scalar"] = S(lambda: [fpos(3, 4)], {"scalar": 0.7},
+                          ref=lambda x: np.mod(0.7, x))
+SPECS["smooth_l1_scalar"] = S(
+    lambda: [f(3, 4)], {"scalar": 1.0},
+    ref=lambda x: np.where(np.abs(x) < 1, 0.5 * x * x, np.abs(x) - 0.5))
+
+SPECS.update({
+    # creation (init_op.cc)
+    "_zeros": S(lambda: [], {"shape": (3, 4)},
+                ref=lambda: np.zeros((3, 4), np.float32)),
+    "_ones": S(lambda: [], {"shape": (3, 4)},
+               ref=lambda: np.ones((3, 4), np.float32)),
+    "_full": S(lambda: [], {"shape": (2, 3), "value": 2.5},
+               ref=lambda: np.full((2, 3), 2.5, np.float32)),
+    "_arange": S(lambda: [], {"start": 1.0, "stop": 7.0, "step": 2.0},
+                 ref=lambda: np.arange(1.0, 7.0, 2.0, np.float32)),
+    "_linspace": S(lambda: [], {"start": 0.0, "stop": 1.0, "num": 5},
+                   ref=lambda: np.linspace(0, 1, 5, dtype=np.float32)),
+    "_eye": S(lambda: [], {"N": 3, "M": 4, "k": 1},
+              ref=lambda: np.eye(3, 4, 1, dtype=np.float32)),
+    # misc tail
+    "add_n": S(lambda: [f(3, 4), f(3, 4), f(3, 4)],
+               ref=lambda a, b, c: a + b + c),
+    "all_finite": S(lambda: [f(3, 4)],
+                    ref=lambda x: np.float32([np.isfinite(x).all()])),
+    "multi_all_finite": S(lambda: [f(3), f(3)], {"num_arrays": 2},
+                          ref=lambda a, b: np.float32([1.0])),
+    "amp_multicast": S(lambda: [f(3, 4), f(3, 4)], {"num_outputs": 2},
+                       ref=lambda a, b: (a, b)),
+    "cast_storage": S(lambda: [f(3, 4)], {"stype": "default"},
+                      ref=lambda x: x),
+    "_copyto": S(lambda: [f(3, 4)], ref=lambda x: x),
+    "choose_element_0index": S(
+        lambda: [f(4, 5), ints(4, hi=5).astype(np.float32)], grad=False,
+        ref=lambda x, i: x[np.arange(4), i.astype(np.int64)]),
+    "fill_element_0index": S(
+        lambda: [f(4, 5), f(4), ints(4, hi=5).astype(np.float32)],
+        grad=False,
+        ref=lambda x, v, i: _fill_ref(x, v, i)),
+    "reshape_like": S(lambda: [f(2, 6), f(3, 4)], ref=lambda a, b: a.reshape(3, 4)),
+    "broadcast_like": S(lambda: [f(1, 4), f(3, 4)],
+                        ref=lambda a, b: np.broadcast_to(a, (3, 4))),
+    "diff": S(lambda: [f(3, 6)], {"n": 1, "axis": -1},
+              ref=lambda x: np.diff(x, axis=-1)),
+    "_onehot_encode": S(lambda: [ints(4, hi=5).astype(np.float32), f(4, 5)],
+                        grad=False,
+                        ref=lambda i, o: np.eye(5, dtype=np.float32)[
+                            i.astype(np.int64)]),
+    "_sparse_retain": S(
+        lambda: [f(5, 3), np.array([0, 2], np.int32)], grad=False,
+        ref=lambda x, i: np.where(
+            np.isin(np.arange(5), i)[:, None], x, 0).astype(np.float32)),
+    "softmax_with_length": S(
+        lambda: [f(2, 5), np.array([3, 5], np.int32)], grad=False,
+        ref=lambda x, ln: np.stack([
+            np.concatenate([
+                np.exp(x[b, :ln[b]]) / np.exp(x[b, :ln[b]]).sum(),
+                np.zeros(5 - ln[b], np.float32)])
+            for b in range(2)])),
+    "_scatter_set_nd": S(
+        lambda: [f(4, 5), f(2), np.array([[0, 2], [1, 3]], np.int32)],
+        grad=False,
+        ref=None),
+    "IdentityAttachKLSparseReg": S(lambda: [fpos(4, 3)], grad=False,
+                                   ref=lambda x: x),
+    "_contrib_arange_like": S(lambda: [f(2, 3)], {"axis": 1}, grad=False,
+                              ref=lambda x: np.arange(3, dtype=np.float32)),
+    "_contrib_div_sqrt_dim": S(lambda: [f(3, 4)],
+                               ref=lambda x: x / np.sqrt(4)),
+    "_contrib_gradientmultiplier": S(lambda: [f(3, 4)], {"scalar": 1.0},
+                                     ref=lambda x: x),
+    "_contrib_index_array": S(lambda: [f(2, 3)], grad=False, ref=None),
+    "_contrib_allclose": S(lambda: [f(3, 4), f(3, 4)], grad=False,
+                           ref=None),
+    "_contrib_quadratic": S(lambda: [f(3, 4)],
+                            {"a": 1.0, "b": 2.0, "c": 3.0},
+                            ref=lambda x: x * x + 2 * x + 3),
+    "_contrib_fft": S(
+        lambda: [f(2, 8)], grad=False,
+        ref=lambda x: np.stack([np.fft.fft(x, axis=-1).real,
+                                np.fft.fft(x, axis=-1).imag],
+                               axis=-1).reshape(2, 16).astype(np.float32)),
+    "_contrib_ifft": S(
+        lambda: [f(2, 16)], grad=False,
+        ref=lambda x: np.fft.ifft(
+            x.reshape(2, 8, 2)[..., 0] + 1j * x.reshape(2, 8, 2)[..., 1],
+            axis=-1).real.astype(np.float32)),
+    "_contrib_bipartite_matching": S(
+        lambda: [np.array([[0.9, 0.1], [0.8, 0.7]], np.float32)],
+        grad=False,
+        ref=lambda x: (np.array([0., 1.], np.float32),
+                       np.array([0., 1.], np.float32))),
+    "_contrib_getnnz": S(lambda: [f(3, 4)], grad=False, ref=None),
+    "_contrib_dynamic_reshape": S(
+        lambda: [f(2, 6), np.array([3, 4], np.int32)], grad=False,
+        ref=lambda x, s: x.reshape(3, 4)),
+    "_contrib_count_sketch": S(
+        lambda: [f(3, 6), ints(6, hi=4).astype(np.float32),
+                 R.choice([-1.0, 1.0], 6).astype(np.float32)],
+        {"out_dim": 4}, grad=False, ref=None),
+    "_contrib_hawkesll": S(
+        lambda: [fpos(2, 3), fpos(3), fpos(3), fpos(2, 3),
+                 fpos(2, 4), ints(2, 4, hi=3).astype(np.float32),
+                 np.array([4, 3], np.float32),
+                 np.array([10.0, 10.0], np.float32)],
+        grad=False, ref=None),
+    "_rnn_param_concat": S(lambda: [f(6), f(4)], {"dim": 0},
+                           ref=lambda a, b: np.concatenate([a, b])),
+    "col2im": S(
+        lambda: [_im2col_np(f(1, 2, 4, 4))],
+        {"output_size": (4, 4), "kernel": (2, 2), "stride": (2, 2)},
+        grad=False, ref=None),
+    # optimizer tail (update semantics pinned in test_optimizer for the
+    # single-weight rows; here forward sanity for the fused fleets)
+    "ftml_update": S(lambda: [f(4), f(4), fpos(4), fpos(4), f(4)],
+                     {"lr": 0.01, "t": 1}, grad=False, ref=None),
+    "mp_nag_mom_update": S(
+        lambda: [f(4), f(4), f(4), f(4)], {"lr": 0.01, "momentum": 0.9},
+        grad=False, ref=None),
+    "mp_lamb_update_phase1": S(
+        lambda: [f(4), f(4), f(4), fpos(4)], {"t": 1}, grad=False,
+        ref=None),
+    "mp_lamb_update_phase2": S(
+        lambda: [f(4), f(4), np.array(1.0, np.float32),
+                 np.array(1.0, np.float32), f(4)],
+        {"lr": 0.01}, grad=False, ref=None),
+    "mp_adamw_update": S(
+        lambda: [f(4), f(4), f(4), fpos(4), f(4),
+                 np.array(1.0, np.float32)],
+        {"lr": 0.01}, grad=False, ref=None),
+    "_contrib_group_adagrad_update": S(
+        lambda: [f(4, 3), f(4, 3), fpos(4, 1)], {"lr": 0.01}, grad=False,
+        ref=None),
+    "multi_sgd_update": S(
+        lambda: [f(4), f(4), f(3), f(3)],
+        {"lrs": (0.1, 0.1), "wds": (0.0, 0.0), "num_weights": 2},
+        grad=False, ref=lambda w0, g0, w1, g1: (w0 - 0.1 * g0,
+                                                w1 - 0.1 * g1)),
+    "multi_sgd_mom_update": S(
+        lambda: [f(4), f(4), np.zeros(4, np.float32),
+                 f(3), f(3), np.zeros(3, np.float32)],
+        {"lrs": (0.1, 0.1), "wds": (0.0, 0.0), "num_weights": 2},
+        grad=False, ref=None),
+    "multi_mp_sgd_update": S(
+        lambda: [f(4), f(4), f(4), f(3), f(3), f(3)],
+        {"lrs": (0.1, 0.1), "wds": (0.0, 0.0), "num_weights": 2},
+        grad=False, ref=None),
+    "multi_mp_sgd_mom_update": S(
+        lambda: [f(4), f(4), np.zeros(4, np.float32), f(4),
+                 f(3), f(3), np.zeros(3, np.float32), f(3)],
+        {"lrs": (0.1, 0.1), "wds": (0.0, 0.0), "num_weights": 2},
+        grad=False, ref=None),
+    "multi_sum_sq": S(lambda: [f(4), f(3)], {"num_arrays": 2}, grad=False,
+                      ref=lambda a, b: np.array([np.sum(a * a),
+                                                 np.sum(b * b)],
+                                                np.float32)),
+    "multi_lars": S(
+        lambda: [fpos(3), fpos(3), fpos(3), np.zeros(3, np.float32)],
+        {"eta": 0.001}, grad=False, ref=None),
+    "preloaded_multi_sgd_update": S(
+        lambda: [f(4), f(4), f(3), f(3),
+                 np.array([0.1, 0.1], np.float32),
+                 np.zeros(2, np.float32)],
+        {"num_weights": 2}, grad=False,
+        ref=lambda w0, g0, w1, g1, lrs, wds: (w0 - 0.1 * g0,
+                                              w1 - 0.1 * g1)),
+    "preloaded_multi_sgd_mom_update": S(
+        lambda: [f(4), f(4), np.zeros(4, np.float32),
+                 f(3), f(3), np.zeros(3, np.float32),
+                 np.array([0.1, 0.1], np.float32),
+                 np.zeros(2, np.float32)],
+        {"num_weights": 2}, grad=False, ref=None),
+    "preloaded_multi_mp_sgd_update": S(
+        lambda: [f(4), f(4), f(4), f(3), f(3), f(3),
+                 np.array([0.1, 0.1], np.float32),
+                 np.zeros(2, np.float32)],
+        {"num_weights": 2}, grad=False, ref=None),
+    "preloaded_multi_mp_sgd_mom_update": S(
+        lambda: [f(4), f(4), np.zeros(4, np.float32), f(4),
+                 f(3), f(3), np.zeros(3, np.float32), f(3),
+                 np.array([0.1, 0.1], np.float32),
+                 np.zeros(2, np.float32)],
+        {"num_weights": 2}, grad=False, ref=None),
+    "reset_arrays": S(lambda: [f(3), f(4)], {"num_arrays": 2}, grad=False,
+                      ref=lambda a, b: (np.zeros_like(a),
+                                        np.zeros_like(b))),
+    # nn tail
+    "LRN": S(lambda: [f(2, 6, 4, 4)], {"nsize": 3}, grad=False, ref=None),
+    "BlockGrad": S(lambda: [f(3, 4)], grad=False, ref=lambda x: x),
+    "MakeLoss": S(lambda: [fpos(3, 4)], grad=False, ref=lambda x: x),
+    "SVMOutput": S(lambda: [f(4, 5), ints(4, hi=5).astype(np.float32)],
+                   grad=False, ref=lambda x, y: x),
+    "SoftmaxActivation": S(
+        lambda: [f(3, 4)], grad=False,
+        ref=lambda x: np.exp(x - x.max(-1, keepdims=True))
+        / np.exp(x - x.max(-1, keepdims=True)).sum(-1, keepdims=True)),
+    "Crop": S(lambda: [f(1, 2, 6, 6)],
+              {"offset": (1, 1), "h_w": (4, 4), "num_args": 1},
+              grad=False, ref=lambda x: x[:, :, 1:5, 1:5]),
+    "_contrib_BatchNormWithReLU": S(
+        lambda: [f(2, 3, 4, 4), np.ones(3, np.float32),
+                 np.zeros(3, np.float32), np.zeros(3, np.float32),
+                 np.ones(3, np.float32)], grad=False, ref=None),
+    "_contrib_SyncBatchNorm": S(
+        lambda: [f(2, 3, 4, 4), np.ones(3, np.float32),
+                 np.zeros(3, np.float32), np.zeros(3, np.float32),
+                 np.ones(3, np.float32)], grad=False, ref=None),
+    # image ops
+    "_image_to_tensor": S(
+        lambda: [ints(4, 5, 3, hi=255).astype(np.uint8)], grad=False,
+        ref=lambda x: (x.astype(np.float32) / 255).transpose(2, 0, 1)),
+    "_image_normalize": S(
+        lambda: [fpos(3, 4, 5)],
+        {"mean": (0.5, 0.5, 0.5), "std": (0.2, 0.2, 0.2)}, grad=False,
+        ref=lambda x: (x - 0.5) / 0.2),
+    "_image_resize": S(lambda: [ints(6, 8, 3, hi=255).astype(np.uint8)],
+                       {"size": (4, 3)}, grad=False, ref=None),
+    "_image_crop": S(lambda: [ints(6, 8, 3, hi=255).astype(np.uint8)],
+                     {"x": 1, "y": 2, "width": 4, "height": 3}, grad=False,
+                     ref=lambda x: x[2:5, 1:5, :]),
+    "_image_flip_left_right": S(
+        lambda: [fpos(4, 5, 3)], grad=False, ref=lambda x: x[:, ::-1, :]),
+    "_image_flip_top_bottom": S(
+        lambda: [fpos(4, 5, 3)], grad=False, ref=lambda x: x[::-1, :, :]),
+    "_image_adjust_lighting": S(
+        lambda: [fpos(4, 5, 3)], {"alpha": (0.0, 0.0, 0.0)}, grad=False,
+        ref=lambda x: x),
+    "_image_random_brightness": S(
+        lambda: [fpos(4, 5, 3)], {"min_factor": 0.5, "max_factor": 1.5},
+        grad=False),
+    "_image_random_contrast": S(
+        lambda: [fpos(4, 5, 3)], {"min_factor": 0.5, "max_factor": 1.5},
+        grad=False),
+    "_image_random_saturation": S(
+        lambda: [fpos(4, 5, 3)], {"min_factor": 0.5, "max_factor": 1.5},
+        grad=False),
+    "_image_random_hue": S(
+        lambda: [fpos(4, 5, 3)], {"min_factor": -0.1, "max_factor": 0.1},
+        grad=False),
+    "_image_random_color_jitter": S(
+        lambda: [fpos(4, 5, 3)],
+        {"brightness": 0.2, "contrast": 0.2, "saturation": 0.2,
+         "hue": 0.05}, grad=False),
+    "_image_random_lighting": S(lambda: [fpos(4, 5, 3)],
+                                {"alpha_std": 0.05}, grad=False),
+    "_image_random_flip_left_right": S(lambda: [fpos(4, 5, 3)], grad=False),
+    "_image_random_flip_top_bottom": S(lambda: [fpos(4, 5, 3)], grad=False),
+    "_image_imdecode": S(lambda: [_jpeg_bytes()], grad=False, ref=None),
+    # random tail
+    "_random_negative_binomial": S(
+        lambda: [], {"k": 3, "p": 0.5, "shape": (64,)}, grad=False),
+    "_random_generalized_negative_binomial": S(
+        lambda: [], {"mu": 2.0, "alpha": 0.3, "shape": (64,)}, grad=False),
+    "_random_pareto": S(lambda: [], {"a": 2.0, "shape": (64,)}, grad=False),
+    "_random_rayleigh": S(lambda: [], {"scale": 1.5, "shape": (64,)},
+                          grad=False),
+    "_random_weibull": S(lambda: [], {"a": 1.5, "shape": (64,)}, grad=False),
+    "_random_logistic": S(lambda: [], {"loc": 0.0, "scale": 1.0,
+                                       "shape": (64,)}, grad=False),
+    "_random_gumbel": S(lambda: [], {"loc": 0.0, "scale": 1.0,
+                                     "shape": (64,)}, grad=False),
+    "_sample_uniform": S(lambda: [np.zeros(3, np.float32),
+                                  np.ones(3, np.float32)],
+                         {"shape": (5,)}, grad=False),
+    "_sample_normal": S(lambda: [f(3), fpos(3)], {"shape": (5,)},
+                        grad=False),
+    "_sample_gamma": S(lambda: [fpos(3) + 1, fpos(3)], {"shape": (5,)},
+                       grad=False),
+    "_sample_exponential": S(lambda: [fpos(3)], {"shape": (5,)},
+                             grad=False),
+    "_sample_poisson": S(lambda: [fpos(3) * 3], {"shape": (5,)},
+                         grad=False),
+    "_sample_negative_binomial": S(
+        lambda: [np.array([2., 3., 4.], np.float32), fpos(3)],
+        {"shape": (5,)}, grad=False),
+    "_sample_generalized_negative_binomial": S(
+        lambda: [fpos(3) * 2, fpos(3)], {"shape": (5,)}, grad=False),
+    "_sample_unique_zipfian": S(lambda: [], {"range_max": 100,
+                                             "shape": (8,)}, grad=False),
+    # detection tail
+    "_contrib_box_encode": S(
+        lambda: [np.ones((1, 2), np.float32),
+                 np.zeros((1, 2), np.float32),
+                 np.array([[[0., 0., 1., 1.], [1., 1., 2., 2.]]],
+                          np.float32),
+                 np.array([[[0., 0., 1., 1.]]], np.float32)],
+        grad=False, ref=None),
+    "_contrib_box_decode": S(
+        lambda: [np.zeros((1, 2, 4), np.float32),
+                 np.array([[[0., 0., 1., 1.], [1., 1., 2., 2.]]],
+                          np.float32)],
+        grad=False,
+        ref=lambda d, a: a),
+    "_contrib_PSROIPooling": S(
+        lambda: [fpos(1, 8, 6, 6),
+                 np.array([[0, 0, 0, 4, 4]], np.float32)],
+        {"spatial_scale": 1.0, "output_dim": 2, "pooled_size": 2},
+        grad=False, ref=None),
+    "Proposal": S(
+        lambda: [fpos(1, 6, 4, 4), f(1, 12, 4, 4) * 0.1,
+                 np.array([64., 64., 1.], np.float32)],
+        {"scales": (8,), "ratios": (0.5, 1, 2), "rpn_pre_nms_top_n": 12,
+         "rpn_post_nms_top_n": 4, "feature_stride": 16},
+        grad=False, ref=None),
+    "MultiProposal": S(
+        lambda: [fpos(2, 6, 4, 4), f(2, 12, 4, 4) * 0.1,
+                 np.array([64., 64., 1.], np.float32)],
+        {"scales": (8,), "ratios": (0.5, 1, 2), "rpn_pre_nms_top_n": 12,
+         "rpn_post_nms_top_n": 4, "feature_stride": 16},
+        grad=False, ref=None),
+    "_contrib_DeformableConvolution": S(
+        lambda: [fpos(1, 2, 5, 5), np.zeros((1, 18, 5, 5), np.float32),
+                 f(3, 2, 3, 3)],
+        {"kernel": (3, 3), "pad": (1, 1), "num_filter": 3, "no_bias": True},
+        grad=False, ref=None),
+    # quantized tail (numeric contracts pinned in test_quantization)
+    "_contrib_quantized_batch_norm": S(
+        lambda: [ints(2, 3, 4, 4, lo=-100, hi=100).astype(np.int8),
+                 np.ones(3, np.float32), np.zeros(3, np.float32),
+                 np.zeros(3, np.float32), np.ones(3, np.float32),
+                 np.array([-1.0], np.float32), np.array([1.0], np.float32)],
+        grad=False, ref=None),
+    "_contrib_quantized_elemwise_add": S(
+        lambda: [ints(3, 4, lo=-100, hi=100).astype(np.int8),
+                 ints(3, 4, lo=-100, hi=100).astype(np.int8),
+                 np.array([-1.], np.float32), np.array([1.], np.float32),
+                 np.array([-1.], np.float32), np.array([1.], np.float32)],
+        grad=False, ref=None),
+    "_contrib_quantized_elemwise_mul": S(
+        lambda: [ints(3, 4, lo=-100, hi=100).astype(np.int8),
+                 ints(3, 4, lo=-100, hi=100).astype(np.int8),
+                 np.array([-1.], np.float32), np.array([1.], np.float32),
+                 np.array([-1.], np.float32), np.array([1.], np.float32)],
+        grad=False, ref=None),
+    "_contrib_quantized_embedding": S(
+        lambda: [ints(5, hi=4).astype(np.float32),
+                 ints(4, 6, lo=-100, hi=100).astype(np.int8),
+                 np.array([-1.], np.float32), np.array([1.], np.float32)],
+        grad=False, ref=None),
+    "_contrib_quantized_concat": S(
+        lambda: [ints(2, 3, lo=-100, hi=100).astype(np.int8),
+                 ints(2, 3, lo=-100, hi=100).astype(np.int8),
+                 np.array([-1.], np.float32), np.array([1.], np.float32),
+                 np.array([-2.], np.float32), np.array([2.], np.float32)],
+        {"num_args": 2, "dim": 0}, grad=False, ref=None),
+    "_contrib_calibrate_entropy": S(
+        lambda: [np.histogram(np.abs(R.randn(5000)), bins=64,
+                              range=(0, 4))[0].astype(np.float32),
+                 np.histogram(np.abs(R.randn(5000)), bins=64,
+                              range=(0, 4))[1].astype(np.float32)],
+        {"num_quantized_bins": 15}, grad=False, ref=None),
+    "_contrib_intgemm_maxabsolute": S(
+        lambda: [f(3, 4)], grad=False,
+        ref=lambda x: np.array([np.abs(x).max()], np.float32)),
+    "_contrib_intgemm_prepare_data": S(
+        lambda: [f(3, 4), np.array([1.0], np.float32)], grad=False,
+        ref=None),
+    "_contrib_intgemm_prepare_weight": S(
+        lambda: [f(3, 4), np.array([1.0], np.float32)], grad=False,
+        ref=None),
+    "_contrib_intgemm_take_weight": S(
+        lambda: [ints(4, 6, lo=-100, hi=100).astype(np.int8),
+                 ints(2, hi=4).astype(np.float32)], grad=False, ref=None),
+    "_contrib_intgemm_fully_connected": S(
+        lambda: [ints(2, 8, lo=-30, hi=30).astype(np.int8),
+                 ints(4, 8, lo=-30, hi=30).astype(np.int8),
+                 np.array([0.01], np.float32)],
+        {"num_hidden": 4, "no_bias": True}, grad=False,
+        ref=lambda x, w, s: (x.astype(np.int32)
+                             @ w.astype(np.int32).T).astype(np.float32)
+        * 0.01),
+})
+
+
+def _fill_ref(x, v, i):
+    y = x.copy()
+    np.put_along_axis(y, i.astype(np.int64)[:, None], v[:, None], axis=-1)
+    return y
+
+
+def _im2col_np(x):
+    """2x2/stride-2 im2col in the (C, kh, kw)-flattened layout."""
+    B, C, H, W = x.shape
+    Ho, Wo = H // 2, W // 2
+    out = np.zeros((B, C * 4, Ho * Wo), np.float32)
+    for c in range(C):
+        for i in range(2):
+            for j in range(2):
+                for l in range(Ho * Wo):
+                    out[:, c * 4 + i * 2 + j, l] = \
+                        x[:, c, 2 * (l // Wo) + i, 2 * (l % Wo) + j]
+    return out
+
+
+def _jpeg_bytes():
+    import io as _io
+    from PIL import Image
+    img = Image.fromarray(ints(8, 8, 3, hi=255).astype(np.uint8))
+    buf = _io.BytesIO()
+    img.save(buf, format="JPEG")
+    return np.frombuffer(buf.getvalue(), np.uint8).copy()
 
 
 def _spd(n):
