@@ -1,0 +1,181 @@
+"""SSD: Single Shot MultiBox Detector (BASELINE config 4).
+
+Reference: example/ssd/symbol/symbol_builder.py (get_symbol_train — the
+VGG16-reduced SSD-300), python/mxnet/... MultiBox ops
+(src/operator/contrib/multibox_prior.cc / multibox_target.cc /
+multibox_detection.cc), GluonCV's model_zoo.ssd for the gluon-style
+composition.
+
+TPU-first notes: every head is a 3x3 conv (MXU-friendly); anchors are
+generated per feature map by the MultiBoxPrior op at trace time (static
+shapes ⇒ one XLA program); training targets come from the MultiBoxTarget
+op so the whole step stays jittable; inference decodes + NMS via
+MultiBoxDetection.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+from ... import ndarray as F
+from ...ndarray.ndarray import invoke
+from .. import nn
+from ..block import HybridBlock
+from ..loss import Loss
+
+__all__ = ["SSD", "SSDMultiBoxLoss", "ssd_300_vgg16_voc", "ssd_toy"]
+
+
+def _conv_block(channels, num_convs, pool=True):
+    blk = nn.HybridSequential()
+    for _ in range(num_convs):
+        blk.add(nn.Conv2D(channels, 3, padding=1, activation="relu"))
+    if pool:
+        blk.add(nn.MaxPool2D(2, strides=2))
+    return blk
+
+
+def _down_block(channels, strides=2, padding=1):
+    """1x1 bottleneck + 3x3 (the reference's extra layers; the last two
+    SSD-300 extras use stride 1, pad 0 to reach 3x3 and 1x1 maps)."""
+    blk = nn.HybridSequential()
+    blk.add(nn.Conv2D(channels // 2, 1, activation="relu"),
+            nn.Conv2D(channels, 3, strides=strides, padding=padding,
+                      activation="relu"))
+    return blk
+
+
+class SSD(HybridBlock):
+    """Multi-scale detector over a list of feature stages.
+
+    forward(x) -> (anchors (1, A, 4), cls_preds (B, A, num_classes+1),
+    box_preds (B, A*4)) — exactly the triple MultiBoxTarget/
+    MultiBoxDetection consume."""
+
+    def __init__(self, stages: Sequence[HybridBlock], num_classes: int,
+                 sizes: Sequence[Tuple[float, float]],
+                 ratios: Sequence[Sequence[float]], **kwargs):
+        super().__init__(**kwargs)
+        if not (len(stages) == len(sizes) == len(ratios)):
+            raise ValueError("stages/sizes/ratios must align per scale")
+        self.num_classes = num_classes
+        self._sizes = [tuple(s) for s in sizes]
+        self._ratios = [tuple(r) for r in ratios]
+        self.stages = nn.HybridSequential()
+        for s in stages:
+            self.stages.add(s)
+        self.class_predictors = nn.HybridSequential()
+        self.box_predictors = nn.HybridSequential()
+        for s, r in zip(self._sizes, self._ratios):
+            a = len(s) + len(r) - 1          # anchors per position
+            self.class_predictors.add(
+                nn.Conv2D(a * (num_classes + 1), 3, padding=1))
+            self.box_predictors.add(nn.Conv2D(a * 4, 3, padding=1))
+
+    def forward(self, x):
+        anchors, cls_preds, box_preds = [], [], []
+        feat = x
+        B = x.shape[0]
+        for stage, cls_p, box_p, s, r in zip(
+                self.stages, self.class_predictors, self.box_predictors,
+                self._sizes, self._ratios):
+            feat = stage(feat)
+            anchors.append(invoke("MultiBoxPrior", feat, sizes=s, ratios=r,
+                                  clip=False))
+            # (B, aC, H, W) -> (B, H*W*a, C): channel-last flatten
+            cp = cls_p(feat).transpose((0, 2, 3, 1)).reshape(
+                (B, -1, self.num_classes + 1))
+            bp = box_p(feat).transpose((0, 2, 3, 1)).reshape((B, -1))
+            cls_preds.append(cp)
+            box_preds.append(bp)
+        anchors = F.concat(*anchors, dim=1) if len(anchors) > 1 \
+            else anchors[0]
+        cls_preds = F.concat(*cls_preds, dim=1) if len(cls_preds) > 1 \
+            else cls_preds[0]
+        box_preds = F.concat(*box_preds, dim=1) if len(box_preds) > 1 \
+            else box_preds[0]
+        return anchors, cls_preds, box_preds
+
+    # -- training / inference glue -----------------------------------------
+    def targets(self, anchors, cls_preds, labels,
+                negative_mining_ratio=3.0):
+        """MultiBoxTarget over this net's outputs (reference:
+        training_targets in example/ssd)."""
+        cls_preds_t = cls_preds.transpose((0, 2, 1))   # (B, C+1, A)
+        return invoke("MultiBoxTarget", anchors, labels, cls_preds_t,
+                      negative_mining_ratio=negative_mining_ratio)
+
+    def detect(self, anchors, cls_preds, box_preds, nms_threshold=0.45,
+               threshold=0.01, nms_topk=400):
+        """Decode + NMS → (B, A, 6) [cls, score, x1, y1, x2, y2]."""
+        cls_prob = invoke("softmax", cls_preds, axis=-1).transpose((0, 2, 1))
+        return invoke("MultiBoxDetection", cls_prob, box_preds, anchors,
+                      nms_threshold=nms_threshold, threshold=threshold,
+                      nms_topk=nms_topk)
+
+
+class SSDMultiBoxLoss(Loss):
+    """Joint class + localization loss with hard-negative mining already
+    applied by MultiBoxTarget (cls_target == -1 rows are ignored), matching
+    the reference's MultiBoxLoss composition."""
+
+    def __init__(self, rho=1.0, lambd=1.0, **kwargs):
+        super().__init__(None, 0, **kwargs)
+        self._rho = rho
+        self._lambd = lambd
+
+    def forward(self, cls_preds, box_preds, cls_target, loc_target,
+                loc_mask):
+        # cls: softmax CE over (B, A, C+1), ignoring -1 targets
+        logp = invoke("log_softmax", cls_preds, axis=-1)
+        valid = (cls_target >= 0)
+        tgt = F.maximum(cls_target, F.zeros_like(cls_target))
+        picked = invoke("pick", logp, tgt, axis=-1)
+        n_valid = F.maximum(valid.astype("float32").sum(),
+                           F.ones((1,)))
+        cls_loss = -(picked * valid.astype("float32")).sum() / n_valid
+        # loc: smooth-L1 on masked offsets
+        diff = (box_preds - loc_target) * loc_mask
+        loc_loss = invoke("smooth_l1", diff, scalar=self._rho).sum() / n_valid
+        return cls_loss + self._lambd * loc_loss
+
+
+def ssd_300_vgg16_voc(classes: int = 20, **kwargs) -> SSD:
+    """SSD-300 with the VGG16(-style reduced) trunk (reference:
+    example/ssd vgg16_reduced — conv4_3 + conv7 + 4 extra scales; 300x300
+    input yields 38/19/10/5/3/1 feature maps)."""
+    trunk = nn.HybridSequential()           # -> conv4_3 at 38x38
+    trunk.add(_conv_block(64, 2), _conv_block(128, 2))
+    c3 = nn.HybridSequential()              # pool3 is CEIL-mode: 75 -> 38
+    for _ in range(3):
+        c3.add(nn.Conv2D(256, 3, padding=1, activation="relu"))
+    c3.add(nn.MaxPool2D(2, strides=2, ceil_mode=True))
+    trunk.add(c3)
+    trunk.add(*[nn.Conv2D(512, 3, padding=1, activation="relu")
+                for _ in range(3)])
+    s2 = nn.HybridSequential()              # conv5 + fc6/fc7-as-conv at 19x19
+    s2.add(nn.MaxPool2D(2, strides=2), _conv_block(512, 3, pool=False),
+           nn.MaxPool2D(3, strides=1, padding=1),  # SSD's stride-1 pool5
+           nn.Conv2D(1024, 3, padding=6, dilation=6, activation="relu"),
+           nn.Conv2D(1024, 1, activation="relu"))
+    stages: List[HybridBlock] = [
+        trunk, s2,
+        _down_block(512),                       # 19 -> 10
+        _down_block(256),                       # 10 -> 5
+        _down_block(256, strides=1, padding=0),  # 5 -> 3
+        _down_block(256, strides=1, padding=0),  # 3 -> 1
+    ]
+    sizes = [(0.1, 0.141), (0.2, 0.272), (0.37, 0.447), (0.54, 0.619),
+             (0.71, 0.79), (0.88, 0.961)]
+    ratios = [(1, 2, 0.5)] + [(1, 2, 0.5, 3, 1.0 / 3)] * 3 \
+        + [(1, 2, 0.5)] * 2
+    return SSD(stages, classes, sizes, ratios, **kwargs)
+
+
+def ssd_toy(classes: int = 2, **kwargs) -> SSD:
+    """Tiny SSD for tests: 2 scales over a small conv trunk."""
+    s1 = nn.HybridSequential()
+    s1.add(_conv_block(16, 1), _conv_block(32, 1))
+    s2 = _down_block(64)
+    return SSD([s1, s2], classes,
+               sizes=[(0.2, 0.3), (0.5, 0.6)],
+               ratios=[(1, 2, 0.5)] * 2, **kwargs)

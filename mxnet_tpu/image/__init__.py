@@ -462,4 +462,8 @@ def __getattr__(name):
     if name == "ImageIter":
         from ..io import ImageRecordIter
         return ImageRecordIter
+    if name in ("ImageDetIter", "CreateDetAugmenter", "DetAugmenter",
+                "DetHorizontalFlipAug", "DetRandomCropAug", "DetBorderAug"):
+        from . import detection
+        return getattr(detection, name)
     raise AttributeError(name)

@@ -18,7 +18,8 @@ import numpy as _np
 __all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
            "F1", "MCC", "Perplexity", "MAE", "MSE", "RMSE", "CrossEntropy",
            "NegativeLogLikelihood", "PearsonCorrelation", "Loss",
-           "CustomMetric", "np", "create", "check_label_shapes"]
+           "CustomMetric", "np", "create", "check_label_shapes",
+           "VOCMApMetric", "VOC07MApMetric"]
 
 _METRIC_REGISTRY = {}
 
@@ -441,6 +442,109 @@ class CustomMetric(EvalMetric):
             else:
                 self.sum_metric += reval
                 self.num_inst += 1
+
+
+@register
+class VOCMApMetric(EvalMetric):
+    """PASCAL-VOC mean average precision (reference: GluonCV
+    VOCMApMetric / example/ssd evaluate.py MApMetric).
+
+    update(labels, preds): labels (B, M, 5+) rows [cls, x1, y1, x2, y2]
+    padded with cls=-1; preds (B, N, 6) rows [cls, score, x1, y1, x2, y2]
+    with suppressed rows -1 (MultiBoxDetection's output)."""
+
+    def __init__(self, iou_thresh=0.5, class_names=None, use_07_metric=False,
+                 name="mAP"):
+        self.iou_thresh = iou_thresh
+        self.class_names = class_names
+        self.use_07_metric = use_07_metric
+        super().__init__(name)
+
+    def reset(self):
+        self._records = {}   # cls -> list[(score, is_tp)]
+        self._n_gt = {}      # cls -> count
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds):
+        for lab, pred in zip(labels, preds):
+            lab = lab.asnumpy() if hasattr(lab, "asnumpy") else _np.asarray(lab)
+            pred = pred.asnumpy() if hasattr(pred, "asnumpy") else \
+                _np.asarray(pred)
+            for b in range(lab.shape[0]):
+                self._update_one(lab[b], pred[b])
+
+    def _update_one(self, lab, pred):
+        gts = lab[lab[:, 0] >= 0]
+        for c in gts[:, 0].astype(int):
+            self._n_gt[c] = self._n_gt.get(c, 0) + 1
+        dets = pred[pred[:, 0] >= 0]
+        dets = dets[_np.argsort(-dets[:, 1])]
+        matched = _np.zeros(len(gts), bool)
+        for det in dets:
+            c = int(det[0])
+            # VOC protocol: pick the overall-best-IoU gt of this class; a
+            # second detection of an ALREADY-matched gt is a false positive
+            # (it must not fall through to a worse gt)
+            best_iou, best_j = 0.0, -1
+            for j, gt in enumerate(gts):
+                if int(gt[0]) != c:
+                    continue
+                iou = self._iou(det[2:6], gt[1:5])
+                if iou > best_iou:
+                    best_iou, best_j = iou, j
+            tp = best_iou >= self.iou_thresh and not matched[best_j]
+            if tp:
+                matched[best_j] = True
+            self._records.setdefault(c, []).append((float(det[1]), tp))
+
+    @staticmethod
+    def _iou(a, b):
+        ix = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+        iy = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+        inter = ix * iy
+        ua = ((a[2] - a[0]) * (a[3] - a[1])
+              + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / ua if ua > 0 else 0.0
+
+    def _average_precision(self, recs, n_gt):
+        if not recs or n_gt == 0:
+            return 0.0
+        recs = sorted(recs, key=lambda r: -r[0])
+        tps = _np.cumsum([r[1] for r in recs])
+        fps = _np.cumsum([not r[1] for r in recs])
+        rec = tps / n_gt
+        prec = tps / _np.maximum(tps + fps, 1e-12)
+        if self.use_07_metric:      # 11-point interpolation
+            ap = 0.0
+            for t in _np.arange(0.0, 1.1, 0.1):
+                p = prec[rec >= t].max() if (rec >= t).any() else 0.0
+                ap += p / 11.0
+            return float(ap)
+        # VOC10+/COCO-style: integrate the precision envelope
+        mrec = _np.concatenate([[0.0], rec, [1.0]])
+        mpre = _np.concatenate([[0.0], prec, [0.0]])
+        for i in range(len(mpre) - 2, -1, -1):
+            mpre[i] = max(mpre[i], mpre[i + 1])
+        idx = _np.where(mrec[1:] != mrec[:-1])[0]
+        return float(_np.sum((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1]))
+
+    def get(self):
+        classes = sorted(self._n_gt)
+        if not classes:
+            return self.name, float("nan")
+        aps = [self._average_precision(self._records.get(c, []),
+                                       self._n_gt[c]) for c in classes]
+        return self.name, float(_np.mean(aps))
+
+
+@register
+class VOC07MApMetric(VOCMApMetric):
+    """11-point interpolated VOC2007 mAP (reference: VOC07MApMetric)."""
+
+    def __init__(self, iou_thresh=0.5, class_names=None, name="mAP07"):
+        super().__init__(iou_thresh, class_names, use_07_metric=True,
+                         name=name)
 
 
 def np(numpy_feval, name=None, allow_extra_outputs=False):
