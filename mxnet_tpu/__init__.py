@@ -36,6 +36,8 @@ from .ndarray import NDArray, waitall
 
 from . import amp
 from . import profiler
+from . import visualization
+from . import visualization as viz
 from . import numpy as np
 from . import npx
 from . import recordio

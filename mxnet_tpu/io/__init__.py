@@ -46,13 +46,14 @@ class DataBatch:
     """One batch (reference: io.DataBatch)."""
 
     def __init__(self, data, label=None, pad=0, index=None,
-                 provide_data=None, provide_label=None):
+                 provide_data=None, provide_label=None, bucket_key=None):
         self.data = data
         self.label = label
         self.pad = pad
         self.index = index
         self.provide_data = provide_data
         self.provide_label = provide_label
+        self.bucket_key = bucket_key  # BucketingModule routing
 
     def __repr__(self):
         shapes = [getattr(d, "shape", None) for d in (self.data or [])]

@@ -34,7 +34,7 @@ from .. import optimizer as opt_mod
 from ..io import DataDesc, DataBatch
 from ..model import BatchEndParam, save_checkpoint, load_checkpoint
 
-__all__ = ["BaseModule", "Module"]
+__all__ = ["BaseModule", "Module", "BucketingModule"]
 
 
 def _as_descs(shapes) -> List[DataDesc]:
@@ -557,3 +557,6 @@ class Module(BaseModule):
                 mod._optimizer = mod._updater.optimizer
             mod.init_optimizer = init_opt_and_load
         return mod
+
+
+from .bucketing_module import BucketingModule  # noqa: E402

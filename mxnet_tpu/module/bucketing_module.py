@@ -1,0 +1,217 @@
+"""BucketingModule: variable-length-sequence execution over shared params.
+
+Reference: python/mxnet/module/bucketing_module.py (class BucketingModule)
+— the reference's answer to variable-length sequences (example/rnn rides
+it): one Module per bucket key, all binding the SAME parameter arrays, so
+any bucket's update advances the single shared model.
+
+TPU realization (SURVEY.md hard part 3): each bucket is a separate bound
+Module whose static shapes compile once into the per-op jit cache — the
+"bucketed jit caches" design: switching buckets switches executables, it
+never retraces an existing one.  Parameter sharing is by NDArray identity
+(same underlying device buffer), the rebuild's equivalent of the
+reference's shared_module memory sharing.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, Optional
+
+from ..base import MXNetError
+from .. import initializer as init_mod
+from . import BaseModule, Module
+
+__all__ = ["BucketingModule"]
+
+
+class BucketingModule(BaseModule):
+    """Reference: BucketingModule(sym_gen, default_bucket_key, ...).
+
+    ``sym_gen(bucket_key) -> (symbol, data_names, label_names)``."""
+
+    def __init__(self, sym_gen: Callable, default_bucket_key=None,
+                 logger=logging, context=None, fixed_param_names=None):
+        super().__init__(logger)
+        if default_bucket_key is None:
+            raise MXNetError("default_bucket_key is required")
+        self._sym_gen = sym_gen
+        self._default_bucket_key = default_bucket_key
+        self._context = context
+        self._fixed_param_names = fixed_param_names
+        self._buckets: Dict = {}
+        self._curr_module: Optional[Module] = None
+        self._curr_bucket_key = None
+        self._for_training = True
+        self._grad_req = "write"
+
+    # -- properties ---------------------------------------------------------
+    @property
+    def default_bucket_key(self):
+        return self._default_bucket_key
+
+    @property
+    def data_names(self):
+        return self._curr_module.data_names if self.binded else \
+            self._sym_gen(self._default_bucket_key)[1]
+
+    @property
+    def output_names(self):
+        return self._curr_module.output_names if self.binded else \
+            self._sym_gen(self._default_bucket_key)[0].list_outputs()
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._curr_module.data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._curr_module.label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return self._curr_module.output_shapes
+
+    @property
+    def symbol(self):
+        assert self.binded
+        return self._curr_module.symbol
+
+    @property
+    def buckets(self):
+        """bucket_key -> bound Module (one compiled executable set each)."""
+        return self._buckets
+
+    # -- bind / switch ------------------------------------------------------
+    def _make_module(self, bucket_key) -> Module:
+        symbol, data_names, label_names = self._sym_gen(bucket_key)
+        return Module(symbol, data_names=data_names,
+                      label_names=label_names, logger=self.logger,
+                      context=self._context,
+                      fixed_param_names=self._fixed_param_names)
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, grad_req="write"):
+        """Bind the DEFAULT bucket (reference: BucketingModule.bind)."""
+        if self.binded and not force_rebind:
+            return
+        if force_rebind:
+            self._buckets = {}
+        self._for_training = for_training
+        self._grad_req = grad_req
+        self._inputs_need_grad = inputs_need_grad
+        module = self._make_module(self._default_bucket_key)
+        module.bind(data_shapes, label_shapes, for_training,
+                    inputs_need_grad, grad_req=grad_req)
+        self._buckets[self._default_bucket_key] = module
+        self._curr_module = module
+        self._curr_bucket_key = self._default_bucket_key
+        self.binded = True
+
+    def _share_params(self, child: Module) -> None:
+        """Point the child's parameter (and grad) buffers at the master's —
+        NDArray identity is buffer identity, so one update serves all
+        buckets (the reference's shared_module)."""
+        master = self._buckets[self._default_bucket_key]
+        mexec, cexec = master._exec, child._exec
+        for name in child._param_names:
+            if name not in mexec.arg_dict:
+                raise MXNetError(
+                    "bucket introduces parameter %r absent from the default "
+                    "bucket — sym_gen must produce a shape-compatible "
+                    "parameter set (reference requirement)" % name)
+            if mexec.arg_dict[name].shape != cexec.arg_dict[name].shape:
+                raise MXNetError(
+                    "parameter %r changes shape across buckets: %s vs %s"
+                    % (name, mexec.arg_dict[name].shape,
+                       cexec.arg_dict[name].shape))
+            cexec.arg_dict[name] = mexec.arg_dict[name]
+            if name in mexec.grad_dict and name in cexec.grad_dict:
+                cexec.grad_dict[name] = mexec.grad_dict[name]
+        for name in child._aux_names:
+            if name in mexec.aux_dict:
+                cexec.aux_dict[name] = mexec.aux_dict[name]
+        # one optimizer/updater instance across buckets (shared state),
+        # applied over the MASTER's param order so state indices agree
+        child._param_names = list(master._param_names)
+        child._optimizer = master._optimizer
+        child._updater = master._updater
+        child.optimizer_initialized = master.optimizer_initialized
+        child.params_initialized = True
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        """Reference: BucketingModule.switch_bucket — bind-once per key,
+        then O(1) switches (each key keeps its own compiled executables)."""
+        assert self.binded, "call bind before switch_bucket"
+        if bucket_key not in self._buckets:
+            module = self._make_module(bucket_key)
+            module.bind(data_shapes, label_shapes, self._for_training,
+                        self._inputs_need_grad, grad_req=self._grad_req)
+            self._share_params(module)
+            self._buckets[bucket_key] = module
+        self._curr_module = self._buckets[bucket_key]
+        self._curr_bucket_key = bucket_key
+
+    # -- params / optimizer (delegate to the default bucket) ---------------
+    def init_params(self, initializer=init_mod.Uniform(0.01),
+                    arg_params=None, aux_params=None, allow_missing=False,
+                    force_init=False, allow_extra=False):
+        assert self.binded
+        self._buckets[self._default_bucket_key].init_params(
+            initializer, arg_params, aux_params, allow_missing, force_init,
+            allow_extra)
+        self.params_initialized = True
+
+    def get_params(self):
+        return self._buckets[self._default_bucket_key].get_params()
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        self._buckets[self._default_bucket_key].set_params(
+            arg_params, aux_params, allow_missing, force_init, allow_extra)
+        self.params_initialized = True
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        assert self.binded and self.params_initialized
+        master = self._buckets[self._default_bucket_key]
+        master.init_optimizer(kvstore, optimizer, optimizer_params,
+                              force_init)
+        for key, mod in self._buckets.items():
+            if key != self._default_bucket_key:
+                mod._optimizer = master._optimizer
+                mod._updater = master._updater
+                mod.optimizer_initialized = True
+        self.optimizer_initialized = True
+
+    # -- compute (delegate to the current bucket) ---------------------------
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        key = getattr(data_batch, "bucket_key", None)
+        if key is not None and key != self._curr_bucket_key:
+            data_shapes = getattr(data_batch, "provide_data", None)
+            label_shapes = getattr(data_batch, "provide_label", None)
+            self.switch_bucket(key, data_shapes, label_shapes)
+        self._curr_module.forward(data_batch, is_train)
+
+    def backward(self, out_grads=None):
+        self._curr_module.backward(out_grads)
+
+    def update(self):
+        self._curr_module.update()
+
+    def get_outputs(self):
+        return self._curr_module.get_outputs()
+
+    def get_input_grads(self):
+        return self._curr_module.get_input_grads()
+
+    def update_metric(self, eval_metric, labels):
+        self._curr_module.update_metric(eval_metric, labels)
+
+    def install_monitor(self, monitor):
+        for mod in self._buckets.values():
+            mod.install_monitor(monitor)

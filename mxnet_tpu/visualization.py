@@ -1,0 +1,188 @@
+"""Network visualization: print_summary + plot_network.
+
+Reference: python/mxnet/visualization.py (print_summary — the layer table
+with shapes and parameter counts; plot_network — the graphviz Digraph).
+
+plot_network emits DOT source directly (a tiny ``_Dot`` shim mirrors
+graphviz.Digraph's API surface we need) so the subsystem has zero
+dependencies; if the real ``graphviz`` package is importable the genuine
+Digraph object is returned instead, exactly like the reference.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, Optional
+
+from .base import MXNetError
+
+__all__ = ["print_summary", "plot_network"]
+
+
+class _Dot:
+    """Minimal graphviz.Digraph stand-in: collects nodes/edges, renders
+    DOT text via .source; .render writes the .dot file."""
+
+    def __init__(self, name="plot", **_kw):
+        self.name = name
+        self._lines = []
+
+    def node(self, name, label=None, **attrs):
+        a = dict(attrs)
+        if label is not None:
+            a["label"] = label
+        s = ", ".join('%s="%s"' % (k, v) for k, v in sorted(a.items()))
+        self._lines.append('  "%s" [%s];' % (name, s))
+
+    def edge(self, tail, head, label=None, **attrs):
+        a = dict(attrs)
+        if label:
+            a["label"] = label
+        s = ", ".join('%s="%s"' % (k, v) for k, v in sorted(a.items()))
+        self._lines.append('  "%s" -> "%s"%s;'
+                           % (tail, head, " [%s]" % s if s else ""))
+
+    @property
+    def source(self):
+        return "digraph %s {\n%s\n}\n" % (self.name, "\n".join(self._lines))
+
+    def render(self, filename=None, **_kw):
+        filename = filename or (self.name + ".dot")
+        if not filename.endswith(".dot"):
+            filename += ".dot"
+        with open(filename, "w") as f:
+            f.write(self.source)
+        return filename
+
+
+_FILLCOLORS = {
+    "FullyConnected": "#fb8072", "Convolution": "#fb8072",
+    "Deconvolution": "#fb8072", "Activation": "#ffffb3",
+    "LeakyReLU": "#ffffb3", "BatchNorm": "#bebada",
+    "LayerNorm": "#bebada", "Pooling": "#80b1d3", "concat": "#fdb462",
+    "softmax": "#fccde5", "SoftmaxOutput": "#fccde5",
+}
+
+
+def _node_label(node) -> str:
+    op = node.op
+    attrs = node.attrs or {}
+    if op == "FullyConnected":
+        return "FullyConnected\n%s" % attrs.get("num_hidden", "")
+    if op in ("Convolution", "Deconvolution"):
+        return "%s\n%sx%s/%s, %s" % (op, *_kern(attrs))
+    if op == "Activation" or op == "LeakyReLU":
+        return "%s\n%s" % (op, attrs.get("act_type", ""))
+    if op == "Pooling":
+        return "Pooling\n%s, %sx%s/%s" % ((attrs.get("pool_type", "max"),)
+                                          + _kern(attrs)[:3])
+    return op
+
+
+def _kern(attrs):
+    import ast
+
+    def twos(v, d="1"):
+        # literal_eval only: attrs may come from an UNTRUSTED symbol.json
+        try:
+            t = ast.literal_eval(str(v)) if v else (int(d), int(d))
+        except (ValueError, SyntaxError):
+            t = (d, d)
+        t = t if isinstance(t, tuple) else (t, t)
+        return t
+    k = twos(attrs.get("kernel"), "1")
+    s = twos(attrs.get("stride"), "1")
+    return (str(k[0]), str(k[1]), str(s[0]), attrs.get("num_filter", ""))
+
+
+def _walk(symbol):
+    """Topo-ordered unique nodes of a Symbol DAG."""
+    seen, order = set(), []
+
+    def visit(node):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for child, _ in node.inputs:
+            visit(child)
+        order.append(node)
+    for node, _ in symbol._heads:
+        visit(node)
+    return order
+
+
+def print_summary(symbol, shape: Optional[Dict] = None, line_length=120,
+                  positions=(0.44, 0.64, 0.74, 1.0)):
+    """Layer-table summary (reference: visualization.print_summary).
+    Returns the table string (and prints it)."""
+    shapes = {}
+    if shape is not None:
+        arg_shapes, out_shapes, _ = symbol.infer_shape(**shape)
+        shapes = dict(zip(symbol.list_arguments(), arg_shapes))
+    nodes = _walk(symbol)
+    pos = [int(line_length * p) for p in positions]
+    header = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+    lines = ["_" * line_length]
+    row = ""
+    for i, h in enumerate(header):
+        row += h + " " * max(1, pos[i] - len(row) - len(h))
+    lines += [row, "=" * line_length]
+    total_params = 0
+    for node in nodes:
+        if node.op == "null":
+            continue
+        params = 0
+        for child, _ in node.inputs:
+            if child.op == "null" and child.name in shapes:
+                n = 1
+                for d in shapes[child.name]:
+                    n *= d
+                if not child.name.endswith(("data", "label")):
+                    params += n
+        total_params += params
+        prevs = ",".join(c.name for c, _ in node.inputs if c.op != "null")
+        cells = ["%s (%s)" % (node.name, node.op), "", str(params), prevs]
+        row = ""
+        for i, c in enumerate(cells):
+            row += c + " " * max(1, pos[i] - len(row) - len(c))
+        lines.append(row)
+    lines += ["=" * line_length, "Total params: %d" % total_params,
+              "_" * line_length]
+    table = "\n".join(lines)
+    print(table)
+    return table
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """DOT graph of the symbol DAG (reference: plot_network).  Returns a
+    graphviz.Digraph when the package is available, else the built-in shim
+    (same .source / .render surface)."""
+    try:
+        from graphviz import Digraph  # optional, like the reference
+        dot = Digraph(name=title, format=save_format)
+    except ImportError:
+        dot = _Dot(name=title)
+    base_attrs = {"shape": "box", "fixedsize": "false", "style": "filled"}
+    base_attrs.update(node_attrs or {})
+    names = set()
+    for node in _walk(symbol):
+        if node.op == "null":
+            is_weight = node.name.endswith(("_weight", "_bias", "_gamma",
+                                            "_beta", "_moving_mean",
+                                            "_moving_var"))
+            if hide_weights and is_weight:
+                continue
+            dot.node(node.name, label=node.name, fillcolor="#8dd3c7",
+                     **base_attrs)
+        else:
+            dot.node(node.name, label=_node_label(node),
+                     fillcolor=_FILLCOLORS.get(node.op, "#b3de69"),
+                     **base_attrs)
+        names.add(node.name)
+    for node in _walk(symbol):
+        if node.op == "null":
+            continue
+        for child, _ in node.inputs:
+            if child.name in names:
+                dot.edge(child.name, node.name)
+    return dot

@@ -316,3 +316,95 @@ def test_named_head_without_label_stays_inference():
     # reg head got no label -> no cached grad; softmax head has one
     assert mod._head_grads[0] is None
     assert mod._head_grads[1] is not None
+
+
+# ---------------------------------------------------------------------------
+# BucketingModule (reference: python/mxnet/module/bucketing_module.py)
+# ---------------------------------------------------------------------------
+
+
+def _bucket_sym_gen(seq_len):
+    """Variable-length mean-pool classifier: data (B, seq_len, 4) -> dense.
+    Same parameter set for every bucket (the bucketing contract)."""
+    data = sym.Variable("data")
+    pooled = sym.mean(data, axis=1)            # (B, 4), length-independent
+    out = sym.FullyConnected(pooled, sym.Variable("fc_weight"),
+                             sym.Variable("fc_bias"), num_hidden=3)
+    out = sym.SoftmaxOutput(out, sym.Variable("softmax_label"),
+                            name="softmax")
+    return out, ("data",), ("softmax_label",)
+
+
+def _bucket_batch(seq_len, rng, bs=6):
+    x = rng.randn(bs, seq_len, 4).astype(np.float32)
+    y = rng.randint(0, 3, bs).astype(np.float32)
+    return mio.DataBatch(
+        data=[mx.nd.array(x)], label=[mx.nd.array(y)],
+        bucket_key=seq_len,
+        provide_data=[("data", (bs, seq_len, 4))],
+        provide_label=[("softmax_label", (bs,))])
+
+
+def test_bucketing_module_shares_weights_across_buckets():
+    from mxnet_tpu.module import BucketingModule
+    rng = np.random.RandomState(0)
+    bm = BucketingModule(_bucket_sym_gen, default_bucket_key=10,
+                         context=mx.cpu())
+    bm.bind(data_shapes=[("data", (6, 10, 4))],
+            label_shapes=[("softmax_label", (6,))])
+    bm.init_params(mx.init.Xavier())
+    bm.init_optimizer(optimizer="sgd",
+                      optimizer_params=(("learning_rate", 0.5),))
+
+    # drive three bucket lengths; every step must move the ONE shared weight
+    w_prev = bm.get_params()[0]["fc_weight"].asnumpy().copy()
+    for seq_len in (10, 5, 20, 5, 10):
+        batch = _bucket_batch(seq_len, rng)
+        bm.forward(batch, is_train=True)
+        bm.backward()
+        bm.update()
+        w_now = bm.get_params()[0]["fc_weight"].asnumpy()
+        assert not np.array_equal(w_now, w_prev), seq_len
+        w_prev = w_now.copy()
+    # one bound executor per DISTINCT bucket key, reused on revisits
+    assert sorted(bm.buckets) == [5, 10, 20]
+    # revisiting a bucket must NOT create a new module (the bucketed cache)
+    mod_5 = bm.buckets[5]
+    bm.forward(_bucket_batch(5, rng), is_train=True)
+    assert bm.buckets[5] is mod_5
+    # weight buffers are SHARED by identity, not copies
+    master = bm.buckets[10]
+    assert master._exec.arg_dict["fc_weight"] is \
+        bm.buckets[5]._exec.arg_dict["fc_weight"]
+
+
+def test_bucketing_module_trains_to_lower_loss():
+    from mxnet_tpu.module import BucketingModule
+    rng = np.random.RandomState(3)
+    bm = BucketingModule(_bucket_sym_gen, default_bucket_key=8,
+                         context=mx.cpu())
+    bm.bind(data_shapes=[("data", (6, 8, 4))],
+            label_shapes=[("softmax_label", (6,))])
+    bm.init_params(mx.init.Xavier())
+    bm.init_optimizer(optimizer="sgd",
+                      optimizer_params=(("learning_rate", 0.3),))
+    metric = mx.metric.create("acc")
+
+    # learnable rule: class = argmax of mean-pooled first 3 dims
+    def batch(seq_len):
+        x = rng.randn(6, seq_len, 4).astype(np.float32)
+        y = x.mean(axis=1)[:, :3].argmax(axis=1).astype(np.float32)
+        return mio.DataBatch(
+            data=[mx.nd.array(x)], label=[mx.nd.array(y)],
+            bucket_key=seq_len,
+            provide_data=[("data", (6, seq_len, 4))],
+            provide_label=[("softmax_label", (6,))])
+
+    for epoch in range(40):
+        b = batch([4, 8, 12][epoch % 3])
+        bm.forward(b, is_train=True)
+        bm.backward()
+        bm.update()
+        if epoch >= 30:
+            metric.update([b.label[0]], bm.get_outputs())
+    assert metric.get()[1] > 0.6, metric.get()
