@@ -146,10 +146,12 @@ class VariableNode:
 class OpNode:
     """One recorded op: vjp closure + parent links (≈ nnvm::Node + AGInfo)."""
     __slots__ = ("name", "vjp_fn", "parents", "n_outputs", "rng_offset",
+                 "primal_fn", "primal_vals", "primal_refs",
                  "out_structure", "out_avals")
 
     def __init__(self, name, vjp_fn, parents, n_outputs, rng_offset,
-                 out_structure, out_avals):
+                 out_structure, out_avals, primal_fn=None, primal_vals=None,
+                 primal_refs=None):
         self.name = name
         self.vjp_fn = vjp_fn
         self.parents = parents      # per-jax-input: VariableNode|OpNode|None
@@ -157,6 +159,12 @@ class OpNode:
         self.rng_offset = rng_offset
         self.out_structure = out_structure  # 'one' | 'tuple'
         self.out_avals = out_avals  # [(shape, dtype)] for zero-cotangent fill
+        # higher-order support: re-linearization needs the op's pure fn and
+        # its primal inputs (the stored vjp closure alone cannot yield
+        # d(grad)/d(primal))
+        self.primal_fn = primal_fn
+        self.primal_vals = primal_vals   # list[jax.Array]
+        self.primal_refs = primal_refs   # list[NDArray|None] (tape links)
 
 
 def record_op(op, params: Dict[str, Any], nd_inputs, jax_in, ctx):
@@ -179,8 +187,11 @@ def record_op(op, params: Dict[str, Any], nd_inputs, jax_in, ctx):
         else:
             parents.append(None)
     avals = [(o.shape, o.dtype) for o in outs_t]
+    refs = [None] * rng_offset + [x if isinstance(x, NDArray) else None
+                                  for x in nd_inputs]
     node = OpNode(op.name, vjp_fn, parents, len(outs_t), rng_offset, structure,
-                  avals)
+                  avals, primal_fn=pure, primal_vals=list(jax_in),
+                  primal_refs=refs)
     wrapped = []
     for i, o in enumerate(outs_t):
         nd = NDArray(o, ctx=ctx)
@@ -191,8 +202,11 @@ def record_op(op, params: Dict[str, Any], nd_inputs, jax_in, ctx):
     return wrapped
 
 
-def record_custom(vjp_fn, nd_inputs, outs, ctx, name="custom"):
-    """Record a single node with a user/jit-supplied vjp (the CachedOp path)."""
+def record_custom(vjp_fn, nd_inputs, outs, ctx, name="custom",
+                  primal_fn=None):
+    """Record a single node with a user/jit-supplied vjp (the CachedOp path).
+    Pass primal_fn (pure over the nd_inputs' jax values) to keep the node
+    differentiable under create_graph."""
     from .ndarray.ndarray import NDArray
     structure = "tuple" if isinstance(outs, tuple) else "one"
     outs_t = outs if structure == "tuple" else (outs,)
@@ -200,7 +214,13 @@ def record_custom(vjp_fn, nd_inputs, outs, ctx, name="custom"):
     for x in nd_inputs:
         parents.append(x._ag_node if isinstance(x, NDArray) else None)
     avals = [(o.shape, o.dtype) for o in outs_t]
-    node = OpNode(name, vjp_fn, parents, len(outs_t), 0, structure, avals)
+    pvals = prefs = None
+    if primal_fn is not None:
+        pvals = [x._jax if isinstance(x, NDArray) else jnp.asarray(x)
+                 for x in nd_inputs]
+        prefs = [x if isinstance(x, NDArray) else None for x in nd_inputs]
+    node = OpNode(name, vjp_fn, parents, len(outs_t), 0, structure, avals,
+                  primal_fn=primal_fn, primal_vals=pvals, primal_refs=prefs)
     wrapped = []
     for i, o in enumerate(outs_t):
         nd = NDArray(o, ctx=ctx)
@@ -259,34 +279,52 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
 
 def grad(heads, variables, head_grads=None, retain_graph=None,
          create_graph: bool = False, train_mode: bool = True):
-    """Reference: autograd.grad — returns grads instead of writing .grad."""
-    if create_graph:
-        raise NotImplementedError("higher-order autograd: not yet supported")
+    """Reference: autograd.grad — returns grads instead of writing .grad.
+
+    With ``create_graph=True`` the backward pass itself is RECORDED: every
+    tape node's vjp closure is a jax-transformable function, so its
+    application becomes a new tape node (via jax.vjp over the vjp),
+    making the returned gradients differentiable — grad-of-grad composes
+    to any order (reference: Imperative::Backward's create_graph)."""
     from .ndarray.ndarray import NDArray
     variables = list(variables)
-    got = _run_backward(heads, head_grads, retain_graph or False,
-                        write_leaves=False, wanted=variables)
+    if retain_graph is None:
+        retain_graph = create_graph
+    if create_graph:
+        # the backward computation itself must RECORD (cotangent fan-in
+        # accumulation and dtype promotes are ordinary NDArray ops) — force
+        # recording even when grad() is called outside a record() scope
+        with _Scope(True, train_mode):
+            got = _run_backward(heads, head_grads, retain_graph,
+                                write_leaves=False, wanted=variables,
+                                record_graph=True)
+    else:
+        got = _run_backward(heads, head_grads, retain_graph,
+                            write_leaves=False, wanted=variables)
     out = []
     for v in variables:
         g = got.get(id(v))
         if g is None:
             raise MXNetError("one of the variables does not require gradient "
                              "or is unreachable from heads")
-        out.append(NDArray(g, ctx=v.context))
+        out.append(g if isinstance(g, NDArray) else NDArray(g, ctx=v.context))
     return out
 
 
 def _run_backward(heads, head_grads, retain_graph, write_leaves=True,
-                  wanted=None):
+                  wanted=None, record_graph=False):
     from .ndarray.ndarray import NDArray
     if isinstance(heads, NDArray):
         heads = [heads]
     if head_grads is not None and isinstance(head_grads, NDArray):
         head_grads = [head_grads]
 
-    # cotangent store: id(OpNode) -> list per output slot
-    cts: Dict[int, List[Optional[jax.Array]]] = {}
-    leaf_vals: Dict[int, jax.Array] = {}
+    # cotangent store: id(OpNode) -> list per output slot.  Values are raw
+    # jax arrays normally; with record_graph they are NDArrays carrying
+    # tape pointers so the backward computation is itself differentiable
+    # (NDArray.__add__ in the accumulation below records too).
+    cts: Dict[int, List[Optional[Any]]] = {}
+    leaf_vals: Dict[int, Any] = {}
     leaf_refs: Dict[int, Any] = {}
     head_nodes: List[Tuple[OpNode, int]] = []
 
@@ -309,10 +347,14 @@ def _run_backward(heads, head_grads, retain_graph, write_leaves=True,
                              "computed while autograd was recording")
         hg = None
         if head_grads is not None and head_grads[i] is not None:
-            hg = head_grads[i]._jax if isinstance(head_grads[i], NDArray) \
-                else jnp.asarray(head_grads[i])
+            hg = head_grads[i] if record_graph and \
+                isinstance(head_grads[i], NDArray) else (
+                head_grads[i]._jax if isinstance(head_grads[i], NDArray)
+                else jnp.asarray(head_grads[i]))
         else:
             hg = jnp.ones(h.shape, h.dtype)
+            if record_graph:
+                hg = NDArray(hg)
         add_ct(h._ag_node, hg)
         if isinstance(h._ag_node, tuple):
             head_nodes.append(h._ag_node)
@@ -333,13 +375,57 @@ def _run_backward(heads, head_grads, retain_graph, write_leaves=True,
             else (c.astype(node.out_avals[i][1])
                   if c.dtype != node.out_avals[i][1] else c)
             for i, c in enumerate(slot)]
-        ct_in = tuple(cotangents) if node.out_structure == "tuple" else cotangents[0]
         if node.vjp_fn is None:
             raise MXNetError(
                 "backward through op %r a second time, but the graph was "
                 "freed; pass retain_graph=True to the first backward"
                 % node.name)
-        grads = node.vjp_fn(ct_in)
+        if record_graph:
+            # higher-order: re-linearize the op from its PURE fn + primals
+            # so the backward step is a fresh tape node differentiable in
+            # BOTH the incoming cotangent and the primal inputs (the
+            # stored vjp closure hides the primal dependency)
+            if node.primal_fn is None:
+                raise MXNetError(
+                    "create_graph through %r: this node (hybridized block /"
+                    " custom Function) does not retain its primal function;"
+                    " higher-order autograd needs eagerly-recorded ops"
+                    % node.name)
+            ct_nds = [c if isinstance(c, NDArray) else NDArray(c)
+                      for c in cotangents]
+            jax_cts = [c._jax for c in ct_nds]
+            n_ct = len(jax_cts)
+            is_tuple = node.out_structure == "tuple"
+            pure = node.primal_fn
+            # only expose grads whose parent exists: a dangling grad (e.g.
+            # x^y's dy = x^y·ln x at negative x) can be NaN, and even a
+            # zero cotangent would propagate 0*NaN through the next vjp
+            keep = [i for i, p in enumerate(node.parents) if p is not None]
+
+            def apply(*args, pure=pure, n_ct=n_ct, is_tuple=is_tuple,
+                      keep=tuple(keep)):
+                cs, prims = args[:n_ct], args[n_ct:]
+                _, vjp = jax.vjp(pure, *prims)
+                gr = vjp(tuple(cs) if is_tuple else cs[0])
+                return tuple(gr[i] for i in keep)
+
+            outs, vjp2 = jax.vjp(apply, *jax_cts, *node.primal_vals)
+            rec_inputs = list(ct_nds) + [
+                r if r is not None else v
+                for r, v in zip(node.primal_refs, node.primal_vals)]
+            # primal_fn threads through so grad-of-grad-of-grad composes
+            wrapped = record_custom(vjp2, rec_inputs, tuple(outs), None,
+                                    name=node.name + "_backward",
+                                    primal_fn=apply)
+            kept_nd = wrapped if isinstance(wrapped, (list, tuple)) \
+                else [wrapped]
+            grads = [None] * len(node.parents)
+            for i, g in zip(keep, kept_nd):
+                grads[i] = g
+        else:
+            ct_in = tuple(cotangents) if node.out_structure == "tuple" \
+                else cotangents[0]
+            grads = node.vjp_fn(ct_in)
         if not retain_graph:
             node.vjp_fn = None
         for parent, g in zip(node.parents, grads):

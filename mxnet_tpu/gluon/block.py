@@ -530,8 +530,32 @@ class HybridBlock(Block):
         return sym_file, params_file
 
     def optimize_for(self, x, backend=None, clear=True, **kwargs):
-        """Reference: HybridBlock.optimize_for(backend).  Backends map to
-        alternate lowering configs; the default XLA path ignores the hint."""
+        """Reference: HybridBlock.optimize_for(backend) — subgraph-backend
+        selection.  Real lowering configs on TPU:
+
+        * ``backend='pallas'`` forces the Pallas flash-attention kernel in
+          every attention_core dispatch where block alignment permits
+          (the reference's force-a-partitioned-subgraph role);
+        * ``backend='xla'`` forces the plain jnp/XLA composition;
+        * ``backend=None`` restores the heuristic.
+
+        The config is process-wide (like MXNET_SUBGRAPH_BACKEND), not
+        per-block; unknown backends warn loudly instead of silently
+        doing nothing."""
+        from ..ops import attention as _att
+        if backend in (None, "pallas", "xla"):
+            _att.set_attention_impl(backend)
+            self._backend = backend
+        else:
+            import warnings
+            warnings.warn(
+                "optimize_for backend %r is not a TPU lowering config "
+                "(supported: 'pallas', 'xla', None); running the default "
+                "XLA path" % (backend,), stacklevel=2)
+            _att.set_attention_impl(None)   # make the warning true
+            self._backend = None
+        if clear:
+            self._clear_cached_op()  # retrace under the new lowering config
         self.hybridize(True, **{k: v for k, v in kwargs.items()
                                 if k in ("static_alloc", "static_shape")})
         return self(x)

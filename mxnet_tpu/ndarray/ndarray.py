@@ -221,6 +221,57 @@ class NDArray:
         a = self.asnumpy()
         return a.astype(dtype) if dtype is not None else a
 
+    # numpy interop protocols (reference: mx.np.ndarray implements
+    # __array_ufunc__/__array_function__ so numpy-API code operates on
+    # MXNet arrays without a host copy): route numpy ufuncs/functions onto
+    # the jnp implementations, returning NDArray — device-resident.
+    def __array_ufunc__(self, ufunc, method, *inputs, **kwargs):
+        if method != "__call__" or kwargs:
+            # reductions / dtype= / where= / casting= have numpy semantics
+            # we don't replicate on device: run them on HOST numpy (the
+            # pre-protocol __array__ behavior; NotImplemented would raise)
+            vals = [x.asnumpy() if isinstance(x, NDArray) else x
+                    for x in inputs]
+            return getattr(ufunc, method)(*vals, **kwargs)
+        from .. import numpy as _mxnp
+        # prefer the mx.np implementation: registry-backed ops there go
+        # through invoke(), so the call RECORDS on the autograd tape
+        impl = getattr(_mxnp, ufunc.__name__, None)
+        if impl is not None and callable(impl):
+            try:
+                return impl(*inputs)
+            except (TypeError, MXNetError):
+                pass
+        jfn = getattr(jnp, ufunc.__name__, None)
+        if jfn is None:
+            return NotImplemented
+        vals = [x._jax if isinstance(x, NDArray) else x for x in inputs]
+        try:
+            out = jfn(*vals)
+        except TypeError:
+            return NotImplemented
+        if isinstance(out, tuple):
+            return tuple(NDArray(o, ctx=self.context) for o in out)
+        return NDArray(out, ctx=self.context)
+
+    def __array_function__(self, func, types, args, kwargs):
+        from .. import numpy as _mxnp
+        impl = getattr(_mxnp, func.__name__, None)
+        if impl is not None and callable(impl):
+            return impl(*args, **kwargs)
+
+        # no device implementation: preserve the pre-protocol behavior by
+        # coercing to host numpy (the __array__ fallback numpy used before
+        # __array_function__ existed on this type)
+        def coerce(x):
+            if isinstance(x, NDArray):
+                return x.asnumpy()
+            if isinstance(x, (list, tuple)):
+                return type(x)(coerce(v) for v in x)
+            return x
+        return func(*[coerce(a) for a in args],
+                    **{k: coerce(v) for k, v in kwargs.items()})
+
     # pickling (reference: NDArray is picklable via its binary serialization;
     # used by Trainer.save_states / kvstore set_optimizer)
     def __reduce__(self):

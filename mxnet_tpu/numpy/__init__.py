@@ -464,3 +464,120 @@ def _bind_seed():
 
 
 _bind_seed()
+
+
+# -- numpy-surface tail (auto-lifted jnp wrappers) ---------------------------
+# Each name not already defined above and present in jnp gets the standard
+# wrapper: unwrap NDArrays -> jnp -> wrap back.  This is how the reference
+# fills its `_npi` long tail with one C++ macro per op; here the substrate
+# already speaks numpy.
+
+_TAIL_NAMES = [
+    # nan-aware
+    "nanmean", "nanstd", "nanvar", "nanmax", "nanmin", "nanargmax",
+    "nanargmin", "nanmedian", "nanquantile", "nanpercentile", "nancumsum",
+    "nancumprod",
+    # set ops (dynamic output: eager jnp, fine off-trace)
+    "unique", "intersect1d", "union1d", "setdiff1d", "setxor1d", "isin",
+    "in1d",
+    # stacking / splitting
+    "vstack", "hstack", "dstack", "column_stack", "row_stack",
+    "array_split", "hsplit", "vsplit", "dsplit", "broadcast_arrays",
+    # construction
+    "meshgrid", "tri", "vander", "indices", "fromfunction",
+    # statistics / calculus
+    "cov", "corrcoef", "gradient", "ediff1d", "interp", "convolve",
+    "correlate", "histogram2d", "histogramdd",
+    # elementwise tail
+    "floor_divide", "true_divide", "remainder", "float_power", "signbit",
+    "exp2", "logaddexp2", "angle", "real", "imag", "conj", "conjugate",
+    "around", "fabs", "positive", "frexp", "modf",
+    # indexing / predicates
+    "argwhere", "flatnonzero", "nonzero", "count_nonzero", "compress",
+    "take_along_axis", "extract", "select", "piecewise",
+    "apply_along_axis", "apply_over_axes",
+    # shapes
+    "fliplr", "flipud", "resize", "trim_zeros",
+    # reductions / misc
+    "amax", "amin", "alltrue", "any", "all", "iscomplex", "isreal",
+    "isclose", "array_equal", "array_equiv", "allclose",
+    "packbits", "unpackbits", "tril_indices", "triu_indices",
+    "diag_indices", "tensordot", "inner", "outer", "vdot", "matmul",
+    "divmod", "copy", "result_type", "promote_types", "can_cast",
+]
+
+_g = globals()
+for _name in _TAIL_NAMES:
+    if _name in _g:
+        continue
+    _src = getattr(jnp, _name, None)
+    if _src is None:
+        continue
+    _g[_name] = _jnp_fn(_src) if callable(_src) else _src
+
+
+def trapz(y, x=None, dx=1.0, axis=-1):
+    fn = getattr(jnp, "trapezoid", None) or getattr(jnp, "trapz")
+    return _wrap(fn(_unwrap(y), _unwrap(x) if x is not None else None,
+                    dx=dx, axis=axis))
+
+
+# -- linalg tail --------------------------------------------------------------
+linalg.cond = _jnp_fn(jnp.linalg.cond)
+linalg.matrix_power = _jnp_fn(jnp.linalg.matrix_power)
+linalg.multi_dot = lambda arrays, **kw: _wrap(
+    jnp.linalg.multi_dot([_unwrap(a) for a in arrays], **kw))
+linalg.eigvals = _jnp_fn(jnp.linalg.eigvals)
+linalg.eig = _jnp_fn(jnp.linalg.eig)
+linalg.tensorsolve = _jnp_fn(jnp.linalg.tensorsolve)
+linalg.tensorinv = _jnp_fn(jnp.linalg.tensorinv)
+
+
+# -- random tail --------------------------------------------------------------
+
+def _rand_size(size):
+    if size is None:
+        return ()
+    return tuple(size) if isinstance(size, (list, tuple)) else (size,)
+
+
+def _rk():
+    return _np_random_key()
+
+
+random.beta = lambda a, b, size=None, **kw: _wrap(
+    jax.random.beta(_rk(), a, b, _rand_size(size)))
+random.laplace = lambda loc=0.0, scale=1.0, size=None, **kw: _wrap(
+    jax.random.laplace(_rk(), _rand_size(size)) * scale + loc)
+random.gumbel = lambda loc=0.0, scale=1.0, size=None, **kw: invoke(
+    "_random_gumbel", loc=loc, scale=scale, shape=_rand_size(size))
+random.logistic = lambda loc=0.0, scale=1.0, size=None, **kw: invoke(
+    "_random_logistic", loc=loc, scale=scale, shape=_rand_size(size))
+random.pareto = lambda a, size=None, **kw: invoke(
+    "_random_pareto", a=a, shape=_rand_size(size))
+random.rayleigh = lambda scale=1.0, size=None, **kw: invoke(
+    "_random_rayleigh", scale=scale, shape=_rand_size(size))
+random.weibull = lambda a, size=None, **kw: invoke(
+    "_random_weibull", a=a, shape=_rand_size(size))
+random.poisson = lambda lam=1.0, size=None, **kw: invoke(
+    "_random_poisson", lam=lam, shape=_rand_size(size))
+random.lognormal = lambda mean=0.0, sigma=1.0, size=None, **kw: _wrap(
+    jnp.exp(jax.random.normal(_rk(), _rand_size(size)) * sigma + mean))
+random.chisquare = lambda df, size=None, **kw: _wrap(
+    jax.random.gamma(_rk(), df / 2.0, _rand_size(size)) * 2.0)
+random.standard_normal = lambda size=None: random.normal(size=size)
+random.standard_exponential = lambda size=None: random.exponential(
+    size=size)
+random.multivariate_normal = lambda mean, cov, size=None, **kw: _wrap(
+    jax.random.multivariate_normal(_rk(), _unwrap(mean), _unwrap(cov),
+                                   _rand_size(size) or None))
+random.multinomial = lambda n=1, pvals=None, size=None, **kw: _wrap(
+    _onp.random.RandomState(
+        int(jax.random.randint(_rk(), (), 0, 2**31 - 1))
+    ).multinomial(n, _onp.asarray(_unwrap(pvals)), _rand_size(size) or None))
+random.permutation = lambda x, **kw: _wrap(
+    jax.random.permutation(_rk(), _unwrap(x) if isinstance(x, NDArray)
+                           else x))
+random.binomial = lambda n, p, size=None, **kw: _wrap(
+    jax.random.binomial(_rk(), n, _unwrap(p),
+                        shape=_rand_size(size) or None).astype("int32"))

@@ -37,6 +37,29 @@ def _on_tpu() -> bool:
         return False
 
 
+def _interpret() -> bool:
+    """Pallas kernels execute via Mosaic on TPU, interpret elsewhere —
+    one code path, testable on CPU, real lowering on hardware."""
+    return not _on_tpu()
+
+
+# Lowering config (reference role: optimize_for(backend) /
+# MXNET_SUBGRAPH_BACKEND): None = heuristic dispatch, "pallas" = force the
+# flash kernel wherever alignment permits (any backend; CPU interprets),
+# "xla" = force the jnp composition.  Process-wide, set through
+# HybridBlock.optimize_for or set_attention_impl.
+_FORCED_IMPL = None
+
+
+def set_attention_impl(impl):
+    global _FORCED_IMPL
+    if impl not in (None, "pallas", "xla"):
+        raise ValueError("attention impl must be None, 'pallas' or 'xla'")
+    prev = _FORCED_IMPL
+    _FORCED_IMPL = impl
+    return prev
+
+
 # ---------------------------------------------------------------------------
 # jnp reference path (always-correct fallback; also the recompute backward)
 # ---------------------------------------------------------------------------
@@ -130,6 +153,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q=_BLOCK_Q, block_k=_BLOCK_K):
                                block_k=block_k, seq_k=Tk)
     out, lse = pl.pallas_call(
         kernel,
+        interpret=_interpret(),
         grid=(B * H, Tq // block_q),
         in_specs=[
             pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
@@ -264,6 +288,7 @@ def _flash_bwd(q, k, v, o, lse, g, scale, causal,
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, scale=scale, causal=causal,
                           block_k=block_k, seq_k=Tk),
+        interpret=_interpret(),
         grid=(B * H, Tq // block_q),
         in_specs=[
             pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
@@ -280,6 +305,7 @@ def _flash_bwd(q, k, v, o, lse, g, scale, causal,
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q, seq_q=Tq),
+        interpret=_interpret(),
         grid=(B * H, Tk // block_k),
         in_specs=[
             pl.BlockSpec((None, Tq, D), lambda b, i: (b, 0, 0)),
@@ -330,9 +356,14 @@ def attention_core(q, k, v, scale=None, causal=False, mask=None):
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     Tq, Tk, D = q.shape[2], k.shape[2], q.shape[3]
-    use_flash = (_on_tpu() and mask is None and
-                 Tq % _BLOCK_Q == 0 and Tk % _BLOCK_K == 0 and
-                 D % 128 == 0 and (not causal or Tq == Tk))
+    aligned = (mask is None and Tq % _BLOCK_Q == 0 and Tk % _BLOCK_K == 0
+               and D % 128 == 0 and (not causal or Tq == Tk))
+    if _FORCED_IMPL == "xla":
+        use_flash = False
+    elif _FORCED_IMPL == "pallas":
+        use_flash = aligned          # CPU interprets; TPU lowers via Mosaic
+    else:
+        use_flash = _on_tpu() and aligned
     if use_flash:
         return flash_attention(q, k, v, float(scale), bool(causal))
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,

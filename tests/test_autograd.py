@@ -180,3 +180,76 @@ def test_numeric_gradient_checker():
     check_numeric_gradient(lambda x: nd.tanh(x), [nd.array([0.1, -0.3, 0.7])])
     check_numeric_gradient(lambda a, b: a * b + nd.exp(a),
                            [nd.array([0.5, 1.0]), nd.array([2.0, -1.0])])
+
+
+# ---------------------------------------------------------------------------
+# higher-order autograd (reference: Imperative::Backward create_graph)
+# ---------------------------------------------------------------------------
+
+
+def test_second_order_grad():
+    x = mx.nd.array(np.array([2.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 3).sum()
+        gx = autograd.grad(y, [x], create_graph=True)[0]   # 3x^2
+        assert abs(float(gx.asnumpy()[0]) - 12.0) < 1e-5
+        z = (gx ** 2).sum()                                # 9x^4
+    z.backward()
+    assert abs(float(x.grad.asnumpy()[0]) - 288.0) < 1e-3  # 36x^3
+
+
+def test_third_order_grad():
+    x = mx.nd.array(np.array([1.5], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        f = (x ** 4).sum()
+        g1 = autograd.grad(f, [x], create_graph=True)[0]
+        g2 = autograd.grad(g1.sum(), [x], create_graph=True)[0]
+        g3 = autograd.grad(g2.sum(), [x])[0]
+    assert abs(float(g3.asnumpy()[0]) - 36.0) < 1e-3       # 24x
+
+
+def test_gradient_norm_penalty():
+    """The WGAN-GP / sharpness-aware pattern: differentiate a gradient's
+    norm back to the weights."""
+    w = mx.nd.array(np.array([[0.5, -0.3]], np.float32))
+    w.attach_grad()
+    x = mx.nd.array(np.array([[1.0, 2.0]], np.float32))
+    with autograd.record():
+        out = (mx.nd.dot(w, x.T) ** 2).sum()
+        gw = autograd.grad(out, [w], create_graph=True)[0]
+        gnorm = (gw ** 2).sum()
+    gnorm.backward()
+    # out=(w.x)^2, gw=2(w.x)x, |gw|^2=4(w.x)^2|x|^2, d/dw=8(w.x)|x|^2 x
+    expect = 8 * (-0.1) * 5 * np.array([1.0, 2.0])
+    np.testing.assert_allclose(w.grad.asnumpy()[0], expect, rtol=1e-4)
+
+
+def test_second_order_mixed_ops():
+    """exp/sin chain: d2/dx2 exp(sin x) at x0 vs closed form."""
+    x0 = 0.7
+    x = mx.nd.array(np.array([x0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.exp(mx.nd.sin(x)).sum()
+        g1 = autograd.grad(y, [x], create_graph=True)[0]
+    g1.backward()
+    expect = np.exp(np.sin(x0)) * (np.cos(x0) ** 2 - np.sin(x0))
+    np.testing.assert_allclose(x.grad.asnumpy()[0], expect, rtol=1e-4)
+
+
+def test_create_graph_outside_record_scope():
+    """grad(create_graph=True) called after exiting record() must keep
+    fan-out cotangent accumulation differentiable (the backward forces its
+    own recording scope)."""
+    x = mx.nd.array(np.array([2.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x + x * x).sum()          # fan-out: x feeds two products
+    g1 = autograd.grad(y, [x], create_graph=True)[0]   # outside record
+    assert abs(float(g1.asnumpy()[0]) - 8.0) < 1e-5    # 4x
+    with autograd.record():
+        s = g1.sum()
+    gg = autograd.grad(s, [x])[0]
+    assert abs(float(gg.asnumpy()[0]) - 4.0) < 1e-5    # d/dx 4x

@@ -214,3 +214,112 @@ def test_like_ctx_and_randint_dtype():
     assert z.context == a.context and str(z.dtype) == "int32"
     r = np.random.randint(0, 5, size=(4,), dtype="int64")
     assert str(r.dtype) in ("int64", "int32")  # int32 if x64 disabled
+
+
+# ---------------------------------------------------------------------------
+# numpy-surface tail + array interop protocols
+# ---------------------------------------------------------------------------
+
+
+def test_np_nan_family():
+    x = np.array([[1.0, 2.0], [3.0, float("nan")]])
+    assert float(np.nanmean(x)) == pytest.approx(2.0)
+    assert float(np.nanmax(x)) == 3.0
+    assert float(np.nansum(x)) == 6.0
+    assert float(np.nanstd(x)) == pytest.approx(onp.nanstd(x.asnumpy()))
+
+
+def test_np_set_ops_and_stacking():
+    a = np.array([3, 1, 3, 2])
+    assert np.unique(a).asnumpy().tolist() == [1, 2, 3]
+    u = np.union1d(np.array([1, 2]), np.array([2, 3]))
+    assert u.asnumpy().tolist() == [1, 2, 3]
+    v = np.vstack([np.ones((1, 2)), np.zeros((1, 2))])
+    assert v.shape == (2, 2)
+    h = np.hstack([np.ones((2, 1)), np.zeros((2, 2))])
+    assert h.shape == (2, 3)
+    cs = np.column_stack([np.array([1.0, 2.0]), np.array([3.0, 4.0])])
+    assert cs.shape == (2, 2)
+
+
+def test_np_statistics_tail():
+    x = np.array([[1.0, 2.0, 3.0], [2.0, 4.0, 6.5]])
+    c = np.cov(x)
+    onp.testing.assert_allclose(c.asnumpy(), onp.cov(x.asnumpy()),
+                                rtol=1e-5)
+    cc = np.corrcoef(x)
+    onp.testing.assert_allclose(cc.asnumpy(), onp.corrcoef(x.asnumpy()),
+                                rtol=1e-5)
+    t = np.trapz(np.array([0.0, 1.0, 2.0]))
+    assert float(t) == pytest.approx(2.0)
+    g = np.gradient(np.array([0.0, 1.0, 4.0]))
+    onp.testing.assert_allclose(g.asnumpy(), [1.0, 2.0, 3.0])
+    yi = np.interp(np.array([0.5]), np.array([0.0, 1.0]),
+                   np.array([10.0, 20.0]))
+    assert float(yi.asnumpy()[0]) == pytest.approx(15.0)
+
+
+def test_np_random_tail_deterministic():
+    import mxnet_tpu as mx
+    draws = {}
+    for name, kwargs in [("beta", dict(a=2.0, b=3.0, size=(4,))),
+                         ("laplace", dict(size=(4,))),
+                         ("lognormal", dict(size=(4,))),
+                         ("chisquare", dict(df=3.0, size=(4,))),
+                         ("poisson", dict(lam=2.0, size=(4,)))]:
+        mx.random.seed(11)
+        a = getattr(np.random, name)(**kwargs).asnumpy()
+        mx.random.seed(11)
+        b = getattr(np.random, name)(**kwargs).asnumpy()
+        onp.testing.assert_array_equal(a, b)
+        draws[name] = a
+    assert all(onp.isfinite(v).all() for v in draws.values())
+
+
+def test_numpy_ufunc_protocol_returns_ndarray():
+    """np.sqrt(mx_array) must stay device-resident (reference:
+    mx.np.ndarray.__array_ufunc__)."""
+    from mxnet_tpu.ndarray.ndarray import NDArray
+    x = np.array([1.0, 4.0, 9.0])
+    r = onp.sqrt(x)
+    assert isinstance(r, NDArray)
+    onp.testing.assert_allclose(r.asnumpy(), [1.0, 2.0, 3.0])
+    r2 = onp.add(x, 1.0)
+    assert isinstance(r2, NDArray)
+
+
+def test_numpy_array_function_protocol():
+    from mxnet_tpu.ndarray.ndarray import NDArray
+    x = np.array([1.0, 2.0])
+    r = onp.concatenate([x, x])
+    assert isinstance(r, NDArray) and r.shape == (4,)
+    s = onp.stack([x, x])
+    assert isinstance(s, NDArray) and s.shape == (2, 2)
+
+
+def test_numpy_protocol_kwargs_and_fallback_run_on_host():
+    """ufunc kwargs (dtype=...) and numpy functions with no device impl
+    coerce to host numpy instead of raising."""
+    x = np.array([1.0, 4.0])
+    r = onp.sqrt(x, dtype=onp.float64)
+    assert isinstance(r, onp.ndarray) and r.dtype == onp.float64
+    fit = onp.polyfit(onp.arange(4.0),
+                      np.array(onp.arange(4.0, dtype=onp.float32)), 1)
+    assert isinstance(fit, onp.ndarray)
+
+
+def test_numpy_ufunc_records_on_tape():
+    x = np.array([4.0])
+    x.attach_grad()
+    with autograd.record():
+        y = onp.sqrt(x)
+        y.sum().backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [0.25])
+
+
+def test_np_random_binomial_array_p():
+    import mxnet_tpu as mx
+    mx.random.seed(0)
+    b = np.random.binomial(10, onp.array([0.0, 1.0], onp.float32),
+                           size=(2,))
+    assert b.asnumpy().tolist() == [0, 10]

