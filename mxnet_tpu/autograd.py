@@ -427,7 +427,13 @@ def _run_backward(heads, head_grads, retain_graph, write_leaves=True,
                 else cotangents[0]
             grads = node.vjp_fn(ct_in)
         if not retain_graph:
+            # free BOTH the vjp residuals and the higher-order primal refs
+            # — otherwise every op input's device buffer stays pinned via
+            # the tape after a plain backward
             node.vjp_fn = None
+            node.primal_fn = None
+            node.primal_vals = None
+            node.primal_refs = None
         for parent, g in zip(node.parents, grads):
             if parent is not None and g is not None:
                 add_ct(parent, g)

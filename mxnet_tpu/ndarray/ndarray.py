@@ -258,7 +258,10 @@ class NDArray:
         from .. import numpy as _mxnp
         impl = getattr(_mxnp, func.__name__, None)
         if impl is not None and callable(impl):
-            return impl(*args, **kwargs)
+            try:
+                return impl(*args, **kwargs)
+            except (TypeError, MXNetError):
+                pass  # numpy-only kwargs (where=, ...) -> host fallback
 
         # no device implementation: preserve the pre-protocol behavior by
         # coercing to host numpy (the __array__ fallback numpy used before

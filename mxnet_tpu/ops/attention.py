@@ -440,3 +440,76 @@ def _encdec_valatt(keys_values, attention, heads=1):
     att = attention.reshape(N, heads, Tq, Tk)
     out = jnp.einsum("nhqk,nhkd->nhqd", att, v)
     return out.transpose(2, 0, 1, 3).reshape(Tq, N, heads * D)
+
+
+# ---------------------------------------------------------------------------
+# sliding-window attention (reference: src/operator/contrib/
+# sldwin_atten-inl.h — GluonNLP's Longformer ops).  Banded layout:
+# score[b, i, h, j] pairs query i with key i + (j - w)*dilation, j in
+# [0, 2w] (symmetric) or [0, w] (causal-left only); out-of-range or
+# beyond-valid-length entries are masked.  O(L*w) memory, gather-based —
+# the band never materializes the full L×L matrix.
+# ---------------------------------------------------------------------------
+
+
+def _sldwin_offsets(w, symmetric):
+    lo = -w
+    hi = w if symmetric else 0
+    return jnp.arange(lo, hi + 1)
+
+
+def _sldwin_kidx(L, w, dilation, symmetric):
+    offs = _sldwin_offsets(w, symmetric) * dilation       # (J,)
+    idx = jnp.arange(L)[:, None] + offs[None, :]          # (L, J)
+    valid = (idx >= 0) & (idx < L)
+    return jnp.clip(idx, 0, L - 1), valid
+
+
+@register("_contrib_sldwin_atten_score", aliases=["sldwin_atten_score"],
+          no_jit=True)  # per-head dilation tensor must be concrete
+def _sldwin_atten_score(query, key, dilation, w=1, symmetric=True):
+    """query/key: (B, L, H, D); dilation: (H,) ints → (B, L, H, J)."""
+    B, L, H, D = query.shape
+    outs = []
+    dil = jnp.asarray(dilation).reshape(-1)
+    for h in range(H):
+        d = int(dil[h]) if dil.shape[0] > 1 else int(dil[0])
+        idx, valid = _sldwin_kidx(L, int(w), d, bool(symmetric))
+        kg = key[:, :, h, :][:, idx, :]                   # (B, L, J, D)
+        s = jnp.einsum("bld,bljd->blj", query[:, :, h, :], kg)
+        outs.append(jnp.where(valid[None], s, 0.0))
+    return jnp.stack(outs, axis=2)                        # (B, L, H, J)
+
+
+@register("_contrib_sldwin_atten_mask_like",
+          aliases=["sldwin_atten_mask_like"], differentiable=False,
+          no_jit=True)
+def _sldwin_atten_mask_like(score, dilation, valid_length, w=1,
+                            symmetric=True):
+    """1.0 where the band entry addresses a real, in-valid-length key."""
+    B, L, H, J = score.shape
+    dil = jnp.asarray(dilation).reshape(-1)
+    vl = jnp.asarray(valid_length).reshape(B, 1, 1)
+    masks = []
+    for h in range(H):
+        d = int(dil[h]) if dil.shape[0] > 1 else int(dil[0])
+        idx, valid = _sldwin_kidx(L, int(w), d, bool(symmetric))
+        in_len = (idx[None] < vl) & (jnp.arange(L)[None, :, None] < vl)
+        masks.append(valid[None] & in_len)
+    return jnp.stack(masks, axis=2).astype(score.dtype)
+
+
+@register("_contrib_sldwin_atten_context",
+          aliases=["sldwin_atten_context"], no_jit=True)
+def _sldwin_atten_context(score, value, dilation, w=1, symmetric=True):
+    """score: (B, L, H, J); value: (B, L, H, D) → (B, L, H, D)."""
+    B, L, H, J = score.shape
+    dil = jnp.asarray(dilation).reshape(-1)
+    outs = []
+    for h in range(H):
+        d = int(dil[h]) if dil.shape[0] > 1 else int(dil[0])
+        idx, valid = _sldwin_kidx(L, int(w), d, bool(symmetric))
+        vg = value[:, :, h, :][:, idx, :]                 # (B, L, J, D)
+        s = jnp.where(valid[None], score[:, :, h, :], 0.0)
+        outs.append(jnp.einsum("blj,bljd->bld", s, vg))
+    return jnp.stack(outs, axis=2)

@@ -518,3 +518,103 @@ def _deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
     if bias is not None and not no_bias:
         out = out + bias.reshape(1, -1, 1, 1)
     return out.astype(data.dtype)
+
+
+@register("_contrib_mrcnn_mask_target", aliases=["mrcnn_mask_target"],
+          num_outputs=2, differentiable=False)
+def _mrcnn_mask_target(rois, gt_masks, matches, cls_targets,
+                       num_rois=None, num_classes=1, mask_size=(14, 14)):
+    """Mask R-CNN training targets (reference: src/operator/contrib/
+    mrcnn_mask_target.cu): crop each roi's matched gt mask to mask_size
+    and scatter it into its class slot.  rois (B, R, 4) corner, gt_masks
+    (B, M, H, W), matches (B, R) gt index, cls_targets (B, R) class id."""
+    ms = tuple(mask_size) if isinstance(mask_size, (tuple, list)) \
+        else (int(mask_size), int(mask_size))
+    B, R = matches.shape[0], matches.shape[1]
+    H, W = gt_masks.shape[2], gt_masks.shape[3]
+
+    def one(rois_b, masks_b, match_b, cls_b):
+        def per_roi(roi, mi, ci):
+            m = masks_b[mi.astype(jnp.int32)]            # (H, W)
+            x1, y1, x2, y2 = roi[0], roi[1], roi[2], roi[3]
+            ys = y1 + (y2 - y1) * (jnp.arange(ms[0]) + 0.5) / ms[0]
+            xs = x1 + (x2 - x1) * (jnp.arange(ms[1]) + 0.5) / ms[1]
+            yi = jnp.clip(ys, 0, H - 1).astype(jnp.int32)
+            xi = jnp.clip(xs, 0, W - 1).astype(jnp.int32)
+            crop = m[yi][:, xi]                          # nearest sample
+            onehot = jax.nn.one_hot(ci.astype(jnp.int32), num_classes,
+                                    dtype=crop.dtype)
+            return onehot[:, None, None] * crop[None]
+        targets = jax.vmap(per_roi)(rois_b, match_b, cls_b)
+        weights = (cls_b > 0).astype(jnp.float32)
+        wmask = jnp.broadcast_to(
+            weights[:, None, None, None],
+            (R, num_classes) + ms)
+        return targets, wmask
+    t, w = jax.vmap(one)(rois.astype(jnp.float32), gt_masks, matches,
+                         cls_targets)
+    return t, w
+
+
+@register("_contrib_ModulatedDeformableConvolution",
+          aliases=["ModulatedDeformableConvolution"], differentiable=False)
+def _modulated_deformable_convolution(data, offset, mask, weight, bias=None,
+                                      kernel=(3, 3), stride=(1, 1),
+                                      dilate=(1, 1), pad=(1, 1),
+                                      num_filter=1, num_group=1,
+                                      num_deformable_group=1, no_bias=False,
+                                      workspace=1024, layout=None):
+    """Deformable conv v2 (reference: modulated_deformable_convolution.cc):
+    v1 sampling plus a learned per-tap modulation scalar in [0, 1] applied
+    to the sampled columns BEFORE the contraction (post-hoc output scaling
+    would not be equivalent)."""
+    kh, kw = kernel
+    if num_group != 1:
+        raise ValueError("ModulatedDeformableConvolution: num_group != 1 "
+                         "is not supported on the TPU backend yet")
+    B, C, H, W = data.shape
+    Ho = (H + 2 * pad[0] - dilate[0] * (kh - 1) - 1) // stride[0] + 1
+    Wo = (W + 2 * pad[1] - dilate[1] * (kw - 1) - 1) // stride[1] + 1
+    off = offset.reshape(B, num_deformable_group, kh * kw, 2, Ho, Wo)
+    mod = mask.reshape(B, num_deformable_group, kh * kw, Ho, Wo)
+    yy = jnp.arange(Ho, dtype=jnp.float32) * stride[0] - pad[0]
+    xx = jnp.arange(Wo, dtype=jnp.float32) * stride[1] - pad[1]
+    cg = C // num_deformable_group
+
+    def sample(img, y, x):
+        y0 = jnp.floor(y)
+        x0 = jnp.floor(x)
+        wy = y - y0
+        wx = x - x0
+
+        def at(yi, xi):
+            inside = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+            yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+            xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+            return jnp.where(inside, img[:, yc, xc], 0.0)
+        return ((1 - wy) * (1 - wx) * at(y0, x0)
+                + (1 - wy) * wx * at(y0, x0 + 1)
+                + wy * (1 - wx) * at(y0 + 1, x0)
+                + wy * wx * at(y0 + 1, x0 + 1))
+
+    def one(img, offs, mods):
+        cols = []
+        for g in range(num_deformable_group):
+            part = img[g * cg:(g + 1) * cg].astype(jnp.float32)
+            for t in range(kh * kw):
+                i, j = t // kw, t % kw
+                ty = yy[:, None] + i * dilate[0] + offs[g, t, 0]
+                tx = xx[None, :] + j * dilate[1] + offs[g, t, 1]
+                cols.append(sample(part, ty, tx) * mods[g, t][None])
+        return jnp.concatenate(cols, axis=0)
+
+    cols = jax.vmap(one)(data.astype(jnp.float32), off.astype(jnp.float32),
+                         mod.astype(jnp.float32))
+    cols = cols.reshape(B, num_deformable_group, kh * kw, cg, Ho, Wo)
+    cols = cols.transpose(0, 1, 3, 2, 4, 5).reshape(B, C * kh * kw, Ho, Wo)
+    out = jnp.einsum("of,bfhw->bohw",
+                     weight.reshape(num_filter, -1).astype(jnp.float32),
+                     cols)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out.astype(data.dtype)

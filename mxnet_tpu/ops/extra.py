@@ -429,3 +429,19 @@ def _hamming(M=0, dtype="float32"):
 @register("blackman", differentiable=False)
 def _blackman(M=0, dtype="float32"):
     return jnp.blackman(M).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# straight-through estimators (reference: src/operator/contrib/stes_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("_contrib_round_ste", aliases=["round_ste"])
+def _round_ste(data):
+    """round() forward, identity gradient (quantization-aware training)."""
+    return data + lax.stop_gradient(jnp.rint(data) - data)
+
+
+@register("_contrib_sign_ste", aliases=["sign_ste"])
+def _sign_ste(data):
+    return data + lax.stop_gradient(jnp.sign(data) - data)

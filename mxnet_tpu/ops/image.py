@@ -201,3 +201,58 @@ def _random_flip_lr(key, data, p=0.5):
 def _random_flip_tb(key, data, p=0.5):
     return jnp.where(jax.random.bernoulli(key, p),
                      jnp.flip(data, axis=-3), data)
+
+
+# ---------------------------------------------------------------------------
+# OpenCV-plugin parity ops (reference: plugin/opencv/cv_api.cc — _cvimread,
+# _cvimdecode, _cvimresize, _cvcopyMakeBorder).  PIL plays OpenCV's role.
+# ---------------------------------------------------------------------------
+
+
+@register("_cvimdecode", aliases=["cvimdecode"], no_jit=True,
+          differentiable=False)
+def _cvimdecode(buf, flag=1, to_rgb=True):
+    from .misc import _imdecode
+    return _imdecode(buf, flag=flag, to_rgb=to_rgb)
+
+
+@register("_cvimread", aliases=["cvimread"], no_jit=True,
+          differentiable=False)
+def _cvimread(filename="", flag=1, to_rgb=True):
+    import numpy as np
+    from PIL import Image
+    gray = (flag == 0)          # OpenCV IMREAD_GRAYSCALE
+    img = Image.open(filename).convert(
+        "L" if gray or not to_rgb else "RGB")
+    arr = np.asarray(img, np.uint8)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return jnp.asarray(arr)
+
+
+@register("_cvimresize", aliases=["cvimresize"], differentiable=False)
+def _cvimresize(data, w=1, h=1, interp=1):
+    method = "nearest" if interp == 0 else "linear"
+    out_shape = (int(h), int(w), data.shape[2])
+    return jax.image.resize(data.astype(jnp.float32), out_shape,
+                            method=method).astype(data.dtype)
+
+
+@register("_cvcopyMakeBorder", aliases=["copyMakeBorder_op"],
+          differentiable=False)
+def _cvcopy_make_border(data, top=0, bot=0, left=0, right=0, type=0,
+                        value=0.0, values=()):
+    """OpenCV border types: 0 constant, 1 replicate, 2 reflect,
+    3 wrap, 4 reflect_101."""
+    pads = ((top, bot), (left, right), (0, 0))
+    if type == 0:
+        if values:                 # per-channel constant fill
+            chans = [jnp.pad(data[..., c], pads[:2],
+                             constant_values=values[min(c, len(values) - 1)])
+                     for c in range(data.shape[-1])]
+            return jnp.stack(chans, axis=-1).astype(data.dtype)
+        return jnp.pad(data, pads, constant_values=value).astype(data.dtype)
+    mode = {1: "edge", 2: "symmetric", 3: "wrap", 4: "reflect"}.get(type)
+    if mode is None:
+        raise ValueError("unsupported border type %r" % (type,))
+    return jnp.pad(data, pads, mode=mode).astype(data.dtype)

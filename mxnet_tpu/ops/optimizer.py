@@ -540,3 +540,60 @@ def _reset_arrays(*arrays, num_arrays=1):
     launch).  Functional: returns the zeroed copies; in-place semantics come
     from the NDArray call layer."""
     return tuple(jnp.zeros_like(a) for a in arrays)
+
+
+@register("multi_adamw_update", aliases=["_multi_adamw_update"],
+          differentiable=False, num_outputs=-1,
+          aux_writeback=lambda p: {k: v for i in range(
+              int(p.get("num_weights", 1)))
+              for k, v in ((3 * i, 4 * i), (3 * i + 1, 4 * i + 2),
+                           (3 * i + 2, 4 * i + 3))})
+def _multi_adamw_update(*arrays, lrs=None, wds=None, etas=None, beta1=0.9,
+                        beta2=0.999, epsilon=1e-8, clip_gradient=-1.0,
+                        num_weights=1):
+    """Fused AdamW fleet (reference: src/operator/contrib/adamw.cc
+    multi_adamw_update).  Inputs (w, g, mean, var)*N + rescale_grad tensor
+    last."""
+    rescale = arrays[-1].astype(jnp.float32)
+    lrs = _scalar_list(lrs, num_weights, 0.001)
+    wds = _scalar_list(wds, num_weights, 0.0)
+    etas = _scalar_list(etas, num_weights, 1.0)
+    outs = []
+    for i, (w, g, m, v) in enumerate(_multi_pairs(list(arrays[:-1]), 4)):
+        gg = g.astype(jnp.float32) * rescale
+        if clip_gradient is not None and clip_gradient > 0:
+            gg = jnp.clip(gg, -clip_gradient, clip_gradient)
+        new_m = beta1 * m + (1.0 - beta1) * gg
+        new_v = beta2 * v + (1.0 - beta2) * gg * gg
+        upd = lrs[i] * new_m / (jnp.sqrt(new_v) + epsilon) + wds[i] * w
+        outs.extend([(w - etas[i] * upd).astype(w.dtype), new_m, new_v])
+    return tuple(outs)
+
+
+@register("multi_mp_adamw_update", aliases=["_multi_mp_adamw_update"],
+          differentiable=False, num_outputs=-1,
+          aux_writeback=lambda p: {k: v for i in range(
+              int(p.get("num_weights", 1)))
+              for k, v in ((4 * i, 5 * i), (4 * i + 1, 5 * i + 2),
+                           (4 * i + 2, 5 * i + 3), (4 * i + 3, 5 * i + 4))})
+def _multi_mp_adamw_update(*arrays, lrs=None, wds=None, etas=None,
+                           beta1=0.9, beta2=0.999, epsilon=1e-8,
+                           clip_gradient=-1.0, num_weights=1):
+    """Mixed-precision fused AdamW (inputs (w, g, mean, var, w32)*N +
+    rescale_grad last)."""
+    rescale = arrays[-1].astype(jnp.float32)
+    lrs = _scalar_list(lrs, num_weights, 0.001)
+    wds = _scalar_list(wds, num_weights, 0.0)
+    etas = _scalar_list(etas, num_weights, 1.0)
+    outs = []
+    for i, (w, g, m, v, w32) in enumerate(_multi_pairs(list(arrays[:-1]),
+                                                       5)):
+        gg = g.astype(jnp.float32) * rescale
+        if clip_gradient is not None and clip_gradient > 0:
+            gg = jnp.clip(gg, -clip_gradient, clip_gradient)
+        new_m = beta1 * m + (1.0 - beta1) * gg
+        new_v = beta2 * v + (1.0 - beta2) * gg * gg
+        upd = lrs[i] * new_m / (jnp.sqrt(new_v) + epsilon) + wds[i] * w32
+        new_w32 = w32 - etas[i] * upd
+        outs.extend([new_w32.astype(w.dtype), new_m, new_v, new_w32])
+    return tuple(outs)

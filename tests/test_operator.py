@@ -506,6 +506,12 @@ for _name, _sref in _SCALAR_REFS.items():
                      ref=(lambda r=_sref: lambda x: r(x, 0.7))())
 SPECS["_power_scalar"] = S(lambda: [fpos(3, 4)], {"scalar": 1.3},
                            ref=lambda x: np.power(x, 1.3))
+# numeric gradient is undefined at the min/max kink: keep the scalar
+# OUTSIDE the f() value range (±[0.3, 0.9])
+SPECS["_maximum_scalar"] = S(lambda: [f(3, 4)], {"scalar": 1.5},
+                             ref=lambda x: np.maximum(x, 1.5))
+SPECS["_minimum_scalar"] = S(lambda: [f(3, 4)], {"scalar": 1.5},
+                             ref=lambda x: np.minimum(x, 1.5))
 SPECS["_rmod_scalar"] = S(lambda: [fpos(3, 4)], {"scalar": 0.7},
                           ref=lambda x: np.mod(0.7, x))
 SPECS["smooth_l1_scalar"] = S(
@@ -880,6 +886,76 @@ SPECS.update({
 })
 
 
+
+def _jpeg_file():
+    import tempfile
+    from PIL import Image
+    fd, path = tempfile.mkstemp(suffix=".jpg")
+    import os as _os
+    _os.close(fd)
+    Image.fromarray(ints(8, 8, 3, hi=255).astype(np.uint8)).save(path)
+    return path
+
+
+SPECS.update({
+    # sliding-window attention (GluonNLP longformer ops)
+    "_contrib_sldwin_atten_score": S(
+        lambda: [f(1, 8, 2, 4), f(1, 8, 2, 4),
+                 np.ones(2, np.float32)], {"w": 2, "symmetric": True},
+        grad=False, ref=None),
+    "_contrib_sldwin_atten_mask_like": S(
+        lambda: [f(1, 8, 2, 5), np.ones(2, np.float32),
+                 np.array([8.0], np.float32)], {"w": 2, "symmetric": True},
+        grad=False, ref=None),
+    "_contrib_sldwin_atten_context": S(
+        lambda: [f(1, 8, 2, 5), f(1, 8, 2, 4),
+                 np.ones(2, np.float32)], {"w": 2, "symmetric": True},
+        grad=False, ref=None),
+    # straight-through estimators
+    # numeric-vs-autodiff comparison is wrong BY DESIGN for STEs (the
+    # straight-through gradient is identity while the true one is 0 a.e.)
+    # -> forward ref here, gradient pinned in test_ste_identity_gradient
+    "_contrib_round_ste": S(lambda: [f(3, 4)], ref=np.rint, grad=False),
+    "_contrib_sign_ste": S(lambda: [f(3, 4)], ref=np.sign, grad=False),
+    # opencv-plugin parity
+    "_cvimdecode": S(lambda: [_jpeg_bytes()], grad=False, ref=None),
+    "_cvimread": S(lambda: [], {"filename": _jpeg_file()}, grad=False,
+                   ref=None),
+    "_cvimresize": S(lambda: [ints(6, 8, 3, hi=255).astype(np.uint8)],
+                     {"w": 4, "h": 3}, grad=False, ref=None),
+    "_cvcopyMakeBorder": S(
+        lambda: [fpos(3, 4, 3)], {"top": 1, "bot": 1, "left": 2,
+                                  "right": 2},
+        grad=False,
+        ref=lambda x: np.pad(x, ((1, 1), (2, 2), (0, 0))).astype(
+            np.float32)),
+    # fused adamw fleets
+    "multi_adamw_update": S(
+        lambda: [f(4), f(4), f(4), fpos(4), f(3), f(3), f(3), fpos(3),
+                 np.array(1.0, np.float32)],
+        {"lrs": (0.01, 0.01), "wds": (0.0, 0.0), "num_weights": 2},
+        grad=False, ref=None),
+    "multi_mp_adamw_update": S(
+        lambda: [f(4), f(4), f(4), fpos(4), f(4),
+                 f(3), f(3), f(3), fpos(3), f(3),
+                 np.array(1.0, np.float32)],
+        {"lrs": (0.01, 0.01), "wds": (0.0, 0.0), "num_weights": 2},
+        grad=False, ref=None),
+    # detection tail 2
+    "_contrib_mrcnn_mask_target": S(
+        lambda: [np.array([[[1., 1., 5., 5.]]], np.float32),
+                 fpos(1, 2, 8, 8), np.zeros((1, 1), np.float32),
+                 np.ones((1, 1), np.float32)],
+        {"num_classes": 2, "mask_size": (4, 4)}, grad=False, ref=None),
+    "_contrib_ModulatedDeformableConvolution": S(
+        lambda: [fpos(1, 2, 5, 5), np.zeros((1, 18, 5, 5), np.float32),
+                 np.ones((1, 9, 5, 5), np.float32), f(3, 2, 3, 3)],
+        {"kernel": (3, 3), "pad": (1, 1), "num_filter": 3,
+         "no_bias": True}, grad=False, ref=None),
+})
+
+
+
 def _fill_ref(x, v, i):
     y = x.copy()
     np.put_along_axis(y, i.astype(np.int64)[:, None], v[:, None], axis=-1)
@@ -1029,3 +1105,17 @@ def test_grad(opname):
 
     check_numeric_gradient(fn, nd_inputs, rtol=spec.grad_rtol,
                            atol=spec.grad_atol)
+
+
+def test_ste_identity_gradient():
+    """round_ste/sign_ste must pass the incoming gradient straight through
+    (reference: stes_op.cc)."""
+    from mxnet_tpu import autograd
+    for op in ("_contrib_round_ste", "_contrib_sign_ste"):
+        x = nd.array(f(3, 4))
+        x.attach_grad()
+        with autograd.record():
+            y = invoke(op, x)
+        y.backward(nd.array(np.full((3, 4), 2.5, np.float32)))
+        np.testing.assert_allclose(x.grad.asnumpy(),
+                                   np.full((3, 4), 2.5), rtol=1e-6)

@@ -154,6 +154,9 @@ def capture():
     results = {}
     results["resnet50_bench"] = _run_json_child(
         [sys.executable, os.path.join(REPO, "bench.py")], "resnet50_bench")
+    results["bert_bench"] = _run_json_child(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--bert"],
+        "bert_bench")
     results["flash_microbench"] = _run_json_child(
         [sys.executable, os.path.abspath(__file__), "--child-flash"],
         "flash_microbench")
