@@ -38,6 +38,7 @@ from . import amp
 from . import profiler
 from . import visualization
 from . import visualization as viz
+from . import onnx
 from . import numpy as np
 from . import npx
 from . import recordio

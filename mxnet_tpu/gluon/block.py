@@ -398,9 +398,14 @@ class HybridBlock(Block):
     # -- the cached-op path -------------------------------------------------
     def _call_impl(self, *args, **kwargs):
         from .parameter import _overrides
+        from ..ndarray import ndarray as _ndmod
         # inside an enclosing trace, compose into it imperatively rather
-        # than nesting a second jit (reference: CachedOp inlining)
-        if not self._active or _overrides() is not None:
+        # than nesting a second jit (reference: CachedOp inlining); same
+        # during SYMBOL tracing (export after hybridize+forward): nested
+        # blocks must not run their jitted cache or tracers leak into the
+        # symbol recorder
+        if not self._active or _overrides() is not None \
+                or _ndmod._sym_tracer is not None:
             return super()._call_impl(*args, **kwargs)
         params = list(self.collect_params().items())
         # deferred params: first call runs imperatively (finishes deferred

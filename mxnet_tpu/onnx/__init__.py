@@ -1,0 +1,650 @@
+"""ONNX export/import (reference: python/mxnet/onnx/mx2onnx —
+export_model — and mx2onnx's onnx2mx import path).
+
+This environment has no ``onnx`` package, so the ModelProto is written
+and read DIRECTLY in protobuf wire format (varint + length-delimited
+fields; the field numbers below are onnx.proto's).  The subset covers
+the classic deploy graphs: Gemm/Conv/BatchNormalization/Pooling/
+activations/elementwise/Concat/Reshape/Transpose/Flatten/Dropout/
+Softmax — enough for the model-zoo CNN/MLP family.  Round-trip
+(export → import → identical outputs) is pinned by tests; conformance
+against onnxruntime needs a network-enabled environment.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["export_model", "import_model", "get_model_metadata"]
+
+
+# ---------------------------------------------------------------------------
+# protobuf wire-format primitives
+# ---------------------------------------------------------------------------
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    n &= (1 << 64) - 1
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _key(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _f_varint(field: int, value: int) -> bytes:
+    return _key(field, 0) + _varint(int(value))
+
+
+def _f_bytes(field: int, payload: bytes) -> bytes:
+    return _key(field, 2) + _varint(len(payload)) + payload
+
+
+def _f_str(field: int, s: str) -> bytes:
+    return _f_bytes(field, s.encode("utf-8"))
+
+
+def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    shift = result = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _scan(buf: bytes):
+    """Yield (field, wire, value, start, end) messages."""
+    pos = 0
+    while pos < len(buf):
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            val, pos = _read_varint(buf, pos)
+            yield field, wire, val
+        elif wire == 2:
+            ln, pos = _read_varint(buf, pos)
+            yield field, wire, buf[pos:pos + ln]
+            pos += ln
+        elif wire == 5:
+            yield field, wire, buf[pos:pos + 4]
+            pos += 4
+        elif wire == 1:
+            yield field, wire, buf[pos:pos + 8]
+            pos += 8
+        else:
+            raise MXNetError("onnx: unsupported wire type %d" % wire)
+
+
+# ---------------------------------------------------------------------------
+# onnx.proto field numbers (ModelProto and friends)
+# ---------------------------------------------------------------------------
+
+_DT = {"float32": 1, "uint8": 2, "int8": 3, "int32": 6, "int64": 7,
+       "bool": 9, "float16": 10, "float64": 11, "bfloat16": 16}
+_DT_INV = {v: k for k, v in _DT.items()}
+
+
+def _tensor(name: str, arr: _np.ndarray) -> bytes:
+    dt = _DT[str(arr.dtype)]
+    out = b"".join(_f_varint(1, d) for d in arr.shape)
+    out += _f_varint(2, dt)
+    out += _f_str(8, name)
+    out += _f_bytes(9, _np.ascontiguousarray(arr).tobytes())
+    return out
+
+
+def _parse_tensor(buf: bytes) -> Tuple[str, _np.ndarray]:
+    dims: List[int] = []
+    dtype = 1
+    name = ""
+    raw = b""
+    floats: List[float] = []
+    for field, wire, val in _scan(buf):
+        if field == 1 and wire == 0:
+            dims.append(val)
+        elif field == 2:
+            dtype = val
+        elif field == 8:
+            name = val.decode("utf-8")
+        elif field == 9:
+            raw = val
+        elif field == 4 and wire == 2:  # packed float_data
+            floats = list(struct.unpack("<%df" % (len(val) // 4), val))
+    np_dt = _np.dtype(_DT_INV.get(dtype, "float32"))
+    if raw:
+        arr = _np.frombuffer(raw, np_dt).reshape(dims).copy()
+    else:
+        arr = _np.asarray(floats, np_dt).reshape(dims)
+    return name, arr
+
+
+def _attr(name: str, value) -> bytes:
+    out = _f_str(1, name)
+    if isinstance(value, float):
+        out += _key(2, 5) + struct.pack("<f", value) + _f_varint(20, 1)
+    elif isinstance(value, bool) or isinstance(value, int):
+        out += _f_varint(3, int(value)) + _f_varint(20, 2)
+    elif isinstance(value, str):
+        out += _f_bytes(4, value.encode()) + _f_varint(20, 3)
+    elif isinstance(value, (list, tuple)):
+        if value and isinstance(value[0], float):
+            out += b"".join(_key(7, 5) + struct.pack("<f", v)
+                            for v in value)
+            out += _f_varint(20, 6)
+        else:
+            out += b"".join(_f_varint(8, int(v)) for v in value)
+            out += _f_varint(20, 7)
+    else:
+        raise MXNetError("onnx attr %r: unsupported %r" % (name, value))
+    return out
+
+
+def _signed64(v: int) -> int:
+    """Protobuf int64 is two's-complement in a 64-bit varint."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _parse_attr(buf: bytes):
+    name = ""
+    fval = None
+    ival = None
+    sval = None
+    floats: List[float] = []
+    ints: List[int] = []
+    atype = 0
+    for field, wire, val in _scan(buf):
+        if field == 1:
+            name = val.decode("utf-8")
+        elif field == 2:
+            fval = struct.unpack("<f", val)[0]
+        elif field == 3:
+            ival = _signed64(val)
+        elif field == 4:
+            sval = val.decode("utf-8")
+        elif field == 7:
+            floats.append(struct.unpack("<f", val)[0])
+        elif field == 8:
+            ints.append(_signed64(val))
+        elif field == 20:
+            atype = val
+    if atype == 1:
+        return name, fval
+    if atype == 2:
+        return name, ival
+    if atype == 3:
+        return name, sval
+    if atype == 6:
+        return name, floats
+    return name, ints
+
+
+def _node(op_type: str, inputs: List[str], outputs: List[str], name: str,
+          attrs: Dict[str, Any]) -> bytes:
+    out = b"".join(_f_str(1, i) for i in inputs)
+    out += b"".join(_f_str(2, o) for o in outputs)
+    out += _f_str(3, name) + _f_str(4, op_type)
+    out += b"".join(_f_bytes(5, _attr(k, v)) for k, v in attrs.items())
+    return out
+
+
+def _parse_node(buf: bytes):
+    inputs: List[str] = []
+    outputs: List[str] = []
+    name = op_type = ""
+    attrs: Dict[str, Any] = {}
+    for field, wire, val in _scan(buf):
+        if field == 1:
+            inputs.append(val.decode("utf-8"))
+        elif field == 2:
+            outputs.append(val.decode("utf-8"))
+        elif field == 3:
+            name = val.decode("utf-8")
+        elif field == 4:
+            op_type = val.decode("utf-8")
+        elif field == 5:
+            k, v = _parse_attr(val)
+            attrs[k] = v
+    return op_type, inputs, outputs, name, attrs
+
+
+def _value_info(name: str, shape: Tuple[int, ...], elem_type: int = 1) \
+        -> bytes:
+    shape_pb = b"".join(
+        _f_bytes(1, _f_varint(1, d)) for d in shape)        # Dimension
+    tensor_pb = _f_varint(1, elem_type) + _f_bytes(2, shape_pb)
+    type_pb = _f_bytes(1, tensor_pb)                        # tensor_type
+    return _f_str(1, name) + _f_bytes(2, type_pb)
+
+
+def _parse_value_info(buf: bytes):
+    name = ""
+    shape: List[int] = []
+    for field, wire, val in _scan(buf):
+        if field == 1:
+            name = val.decode("utf-8")
+        elif field == 2:
+            for f2, w2, v2 in _scan(val):
+                if f2 == 1:                                  # tensor_type
+                    for f3, w3, v3 in _scan(v2):
+                        if f3 == 2:                          # shape
+                            for f4, w4, v4 in _scan(v3):
+                                if f4 == 1:                  # dim
+                                    for f5, w5, v5 in _scan(v4):
+                                        if f5 == 1:
+                                            shape.append(v5)
+    return name, tuple(shape)
+
+
+# ---------------------------------------------------------------------------
+# mx symbol -> onnx graph
+# ---------------------------------------------------------------------------
+
+
+def _walk(symbol):
+    seen, order = set(), []
+
+    def visit(node):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for child, _ in node.inputs:
+            visit(child)
+        order.append(node)
+    for node, _ in symbol._heads:
+        visit(node)
+    return order
+
+
+def _a(attrs, key, default=None):
+    import ast
+    v = attrs.get(key, default)
+    if isinstance(v, str):
+        try:
+            return ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            return v
+    return v
+
+
+def _conv_attrs(attrs):
+    kernel = tuple(_a(attrs, "kernel"))
+    stride = tuple(_a(attrs, "stride", (1,) * len(kernel)) or
+                   (1,) * len(kernel))
+    pad = tuple(_a(attrs, "pad", (0,) * len(kernel)) or (0,) * len(kernel))
+    dilate = tuple(_a(attrs, "dilate", (1,) * len(kernel)) or
+                   (1,) * len(kernel))
+    return {"kernel_shape": list(kernel), "strides": list(stride),
+            "pads": list(pad) * 2, "dilations": list(dilate),
+            "group": int(_a(attrs, "num_group", 1) or 1)}
+
+
+def export_model(sym, params, input_shapes=None, input_types=_np.float32,
+                 onnx_file_path="model.onnx", opset_version=13,
+                 verbose=False, **kw):
+    """Reference: mx.onnx.export_model(sym, params, in_shapes, in_types,
+    onnx_file_path).  `sym` may be a Symbol or a symbol.json path; `params`
+    a dict (NDArray values) or a .params path."""
+    from .. import ndarray as nd
+    from ..symbol import Symbol, load as sym_load
+
+    if isinstance(sym, str):
+        sym = sym_load(sym)
+    if isinstance(params, str):
+        loaded = nd.load(params)
+        params = {k.split(":", 1)[-1]: v for k, v in loaded.items()}
+    params = {k: (v.asnumpy() if hasattr(v, "asnumpy") else _np.asarray(v))
+              for k, v in (params or {}).items()}
+
+    nodes_pb: List[bytes] = []
+    inits_pb: List[bytes] = []
+    inputs_pb: List[bytes] = []
+    outputs_pb: List[bytes] = []
+
+    arg_names = sym.list_arguments()
+    data_names = [n for n in arg_names if n not in params]
+    shapes = dict(zip(data_names, input_shapes or []))
+
+    def out_name(node, idx=0):
+        return node.name if idx == 0 else "%s_out%d" % (node.name, idx)
+
+    for node in _walk(sym):
+        op = node.op
+        attrs = node.attrs or {}
+        ins = [out_name(c, i) for c, i in node.inputs]
+        if op == "null":
+            if node.name in params:
+                inits_pb.append(_f_bytes(5, _tensor(node.name,
+                                                    params[node.name])))
+            else:
+                inputs_pb.append(_f_bytes(11, _value_info(
+                    node.name, shapes.get(node.name, ()))))
+            continue
+        name = node.name
+        outs = [out_name(node)]
+        if op == "FullyConnected":
+            no_bias = str(attrs.get("no_bias", "False")) in ("True", "1")
+            flatten = str(attrs.get("flatten", "True")) not in ("False", "0")
+            if flatten:
+                flat_in = ins[0] + "_flat"
+                nodes_pb.append(_f_bytes(1, _node(
+                    "Flatten", [ins[0]], [flat_in], name + "_flatten",
+                    {"axis": 1})))
+                gemm_in = [flat_in, ins[1]] + ([] if no_bias else [ins[2]])
+                nodes_pb.append(_f_bytes(1, _node(
+                    "Gemm", gemm_in, outs, name,
+                    {"alpha": 1.0, "beta": 1.0, "transA": 0, "transB": 1})))
+            else:
+                # per-position projection over N-D input: ONNX Gemm is 2-D
+                # only, so emit MatMul against a TRANSPOSED weight
+                # initializer (+ Add for bias)
+                wname = ins[1]
+                if wname not in params:
+                    raise MXNetError(
+                        "onnx export: FullyConnected(flatten=False) needs "
+                        "its weight as a parameter (got graph input %r)"
+                        % wname)
+                wt_name = wname + "_T"
+                if wt_name not in params:
+                    params[wt_name] = _np.ascontiguousarray(
+                        params[wname].T)
+                mm_out = outs[0] if no_bias else name + "_mm"
+                nodes_pb.append(_f_bytes(1, _node(
+                    "MatMul", [ins[0], wt_name], [mm_out],
+                    name + "_matmul", {})))
+                if not no_bias:
+                    nodes_pb.append(_f_bytes(1, _node(
+                        "Add", [mm_out, ins[2]], outs, name, {})))
+        elif op == "Convolution":
+            no_bias = str(attrs.get("no_bias", "False")) in ("True", "1")
+            conv_in = ins[:2] + ([] if no_bias else [ins[2]])
+            nodes_pb.append(_f_bytes(1, _node("Conv", conv_in, outs, name,
+                                              _conv_attrs(attrs))))
+        elif op == "Activation":
+            act = attrs.get("act_type", "relu")
+            onnx_op = {"relu": "Relu", "sigmoid": "Sigmoid",
+                       "tanh": "Tanh", "softrelu": "Softplus"}.get(act)
+            if onnx_op is None:
+                raise MXNetError("onnx export: Activation %r" % act)
+            nodes_pb.append(_f_bytes(1, _node(onnx_op, ins, outs, name, {})))
+        elif op == "BatchNorm":
+            nodes_pb.append(_f_bytes(1, _node(
+                "BatchNormalization",
+                [ins[0], ins[1], ins[2], ins[3], ins[4]], outs, name,
+                {"epsilon": float(_a(attrs, "eps", 1e-3) or 1e-3),
+                 "momentum": float(_a(attrs, "momentum", 0.9) or 0.9)})))
+        elif op == "Pooling":
+            ptype = attrs.get("pool_type", "max")
+            if str(attrs.get("global_pool", "False")) in ("True", "1"):
+                onnx_op = "GlobalMaxPool" if ptype == "max" else \
+                    "GlobalAveragePool"
+                nodes_pb.append(_f_bytes(1, _node(onnx_op, ins, outs,
+                                                  name, {})))
+            else:
+                kernel = tuple(_a(attrs, "kernel"))
+                stride = tuple(_a(attrs, "stride", kernel) or kernel)
+                pad = tuple(_a(attrs, "pad", (0,) * len(kernel)) or
+                            (0,) * len(kernel))
+                onnx_op = "MaxPool" if ptype == "max" else "AveragePool"
+                nodes_pb.append(_f_bytes(1, _node(
+                    onnx_op, ins, outs, name,
+                    {"kernel_shape": list(kernel),
+                     "strides": list(stride), "pads": list(pad) * 2})))
+        elif op in ("softmax", "SoftmaxOutput", "log_softmax"):
+            onnx_op = "LogSoftmax" if op == "log_softmax" else "Softmax"
+            nodes_pb.append(_f_bytes(1, _node(
+                onnx_op, ins[:1], outs, name,
+                {"axis": int(_a(attrs, "axis", -1) or -1)})))
+        elif op in ("Flatten", "flatten"):
+            nodes_pb.append(_f_bytes(1, _node("Flatten", ins, outs, name,
+                                              {"axis": 1})))
+        elif op == "Dropout":
+            nodes_pb.append(_f_bytes(1, _node("Dropout", ins, outs, name,
+                                              {})))
+        elif op in ("broadcast_add", "elemwise_add", "_plus"):
+            nodes_pb.append(_f_bytes(1, _node("Add", ins, outs, name, {})))
+        elif op in ("broadcast_sub", "elemwise_sub"):
+            nodes_pb.append(_f_bytes(1, _node("Sub", ins, outs, name, {})))
+        elif op in ("broadcast_mul", "elemwise_mul"):
+            nodes_pb.append(_f_bytes(1, _node("Mul", ins, outs, name, {})))
+        elif op in ("broadcast_div", "elemwise_div"):
+            nodes_pb.append(_f_bytes(1, _node("Div", ins, outs, name, {})))
+        elif op == "concat":
+            nodes_pb.append(_f_bytes(1, _node(
+                "Concat", ins, outs, name,
+                {"axis": int(_a(attrs, "dim", 1) or 1)})))
+        elif op in ("reshape", "Reshape"):
+            shape_name = name + "_shape"
+            shp = _np.asarray(_a(attrs, "shape"), _np.int64)
+            inits_pb.append(_f_bytes(5, _tensor(shape_name, shp)))
+            nodes_pb.append(_f_bytes(1, _node(
+                "Reshape", [ins[0], shape_name], outs, name, {})))
+        elif op in ("transpose",):
+            axes = _a(attrs, "axes")
+            nodes_pb.append(_f_bytes(1, _node(
+                "Transpose", ins, outs, name,
+                {"perm": list(axes)} if axes else {})))
+        elif op == "relu":
+            nodes_pb.append(_f_bytes(1, _node("Relu", ins, outs, name, {})))
+        elif op == "sigmoid":
+            nodes_pb.append(_f_bytes(1, _node("Sigmoid", ins, outs, name,
+                                              {})))
+        elif op == "tanh":
+            nodes_pb.append(_f_bytes(1, _node("Tanh", ins, outs, name, {})))
+        else:
+            raise MXNetError(
+                "onnx export: op %r has no ONNX mapping yet (supported: "
+                "FC/Conv/BN/Pool/activations/elemwise/concat/reshape/"
+                "transpose/softmax/dropout/flatten)" % op)
+
+    emitted = {n.name for n in _walk(sym) if n.op == "null"}
+    for pname, arr in params.items():
+        if pname.endswith("_T") and pname not in emitted:
+            inits_pb.append(_f_bytes(5, _tensor(pname, arr)))
+
+    for node, idx in sym._heads:
+        outputs_pb.append(_f_bytes(12, _value_info(out_name(node, idx), ())))
+
+    graph = b"".join(nodes_pb) + _f_str(2, "mxnet_tpu") + \
+        b"".join(inits_pb) + b"".join(inputs_pb) + b"".join(outputs_pb)
+    opset = _f_str(1, "") + _f_varint(2, opset_version)
+    model = _f_varint(1, 8)                      # ir_version 8
+    model += _f_str(2, "mxnet_tpu") + _f_str(3, "3.0")
+    model += _f_bytes(7, graph)
+    model += _f_bytes(8, opset)
+    with open(onnx_file_path, "wb") as f:
+        f.write(model)
+    return onnx_file_path
+
+
+# ---------------------------------------------------------------------------
+# onnx graph -> mx symbol
+# ---------------------------------------------------------------------------
+
+
+_IMPORT_SIMPLE = {"Relu": ("Activation", {"act_type": "relu"}),
+                  "Sigmoid": ("Activation", {"act_type": "sigmoid"}),
+                  "Tanh": ("Activation", {"act_type": "tanh"}),
+                  "Softplus": ("Activation", {"act_type": "softrelu"})}
+
+
+def import_model(onnx_file_path: str):
+    """Reference: onnx2mx import_model → (sym, arg_params, aux_params)."""
+    from .. import ndarray as nd
+    from .. import symbol as sym_mod
+
+    with open(onnx_file_path, "rb") as f:
+        buf = f.read()
+    graph = None
+    for field, wire, val in _scan(buf):
+        if field == 7:
+            graph = val
+    if graph is None:
+        raise MXNetError("onnx import: no graph in %r" % onnx_file_path)
+
+    nodes = []
+    inits: Dict[str, _np.ndarray] = {}
+    g_inputs: List[Tuple[str, Tuple[int, ...]]] = []
+    for field, wire, val in _scan(graph):
+        if field == 1:
+            nodes.append(_parse_node(val))
+        elif field == 5:
+            nm, arr = _parse_tensor(val)
+            inits[nm] = arr
+        elif field == 11:
+            g_inputs.append(_parse_value_info(val))
+
+    env: Dict[str, Any] = {}
+    for nm, shape in g_inputs:
+        env[nm] = sym_mod.Variable(nm)
+    arg_params: Dict[str, Any] = {}
+    aux_params: Dict[str, Any] = {}
+
+    def var_of(nm):
+        if nm not in env:
+            env[nm] = sym_mod.Variable(nm)
+            if nm in inits:
+                (aux_params if ("moving_" in nm or "running_" in nm)
+                 else arg_params)[nm] = nd.array(inits[nm])
+        return env[nm]
+
+    last = None
+    for op_type, ins, outs, name, attrs in nodes:
+        if op_type == "Flatten" and name.endswith("_flatten"):
+            env[outs[0]] = sym_mod.flatten(var_of(ins[0]))
+        elif op_type == "Gemm":
+            alpha = float(attrs.get("alpha", 1.0))
+            beta = float(attrs.get("beta", 1.0))
+            if int(attrs.get("transA", 0)) != 0 or alpha != 1.0 \
+                    or beta != 1.0:
+                raise MXNetError(
+                    "onnx import: Gemm with transA/alpha/beta != defaults "
+                    "is not supported (got transA=%s alpha=%s beta=%s)"
+                    % (attrs.get("transA", 0), alpha, beta))
+            if int(attrs.get("transB", 1)) == 0:
+                # weight stored (in, out): transpose into FC layout
+                inits[ins[1]] = _np.ascontiguousarray(inits[ins[1]].T)
+            w = inits[ins[1]]
+            out = sym_mod.FullyConnected(
+                var_of(ins[0]), var_of(ins[1]),
+                var_of(ins[2]) if len(ins) > 2 else None,
+                num_hidden=int(w.shape[0]), no_bias=len(ins) <= 2,
+                name=name)
+            env[outs[0]] = out
+        elif op_type == "Conv":
+            w = inits[ins[1]]
+            out = sym_mod.Convolution(
+                var_of(ins[0]), var_of(ins[1]),
+                var_of(ins[2]) if len(ins) > 2 else None,
+                kernel=tuple(attrs["kernel_shape"]),
+                stride=tuple(attrs.get("strides",
+                                       (1,) * len(attrs["kernel_shape"]))),
+                pad=tuple(attrs.get("pads",
+                                    [0] * 2 * len(attrs["kernel_shape"]))
+                          [:len(attrs["kernel_shape"])]),
+                dilate=tuple(attrs.get("dilations",
+                                       (1,) * len(attrs["kernel_shape"]))),
+                num_filter=int(w.shape[0]),
+                num_group=int(attrs.get("group", 1)),
+                no_bias=len(ins) <= 2, name=name)
+            env[outs[0]] = out
+        elif op_type in _IMPORT_SIMPLE:
+            mx_op, extra = _IMPORT_SIMPLE[op_type]
+            env[outs[0]] = getattr(sym_mod, mx_op)(var_of(ins[0]),
+                                                   name=name, **extra)
+        elif op_type == "BatchNormalization":
+            env[outs[0]] = sym_mod.BatchNorm(
+                *[var_of(i) for i in ins], name=name,
+                eps=float(attrs.get("epsilon", 1e-3)),
+                momentum=float(attrs.get("momentum", 0.9)),
+                fix_gamma=False)
+        elif op_type in ("MaxPool", "AveragePool", "GlobalMaxPool",
+                         "GlobalAveragePool"):
+            if op_type.startswith("Global"):
+                env[outs[0]] = sym_mod.Pooling(
+                    var_of(ins[0]), kernel=(1, 1), global_pool=True,
+                    pool_type="max" if "Max" in op_type else "avg",
+                    name=name)
+            else:
+                k = tuple(attrs["kernel_shape"])
+                env[outs[0]] = sym_mod.Pooling(
+                    var_of(ins[0]), kernel=k,
+                    stride=tuple(attrs.get("strides", k)),
+                    pad=tuple(attrs.get("pads", [0] * 2 * len(k))[:len(k)]),
+                    pool_type="max" if op_type == "MaxPool" else "avg",
+                    name=name)
+        elif op_type in ("Softmax", "LogSoftmax"):
+            fn = sym_mod.log_softmax if op_type == "LogSoftmax" else \
+                sym_mod.softmax
+            env[outs[0]] = fn(var_of(ins[0]),
+                              axis=int(attrs.get("axis", -1)), name=name)
+        elif op_type == "Flatten":
+            env[outs[0]] = sym_mod.flatten(var_of(ins[0]), name=name)
+        elif op_type == "Dropout":
+            env[outs[0]] = var_of(ins[0])      # inference: identity
+        elif op_type == "MatMul":
+            wt = inits.get(ins[1])
+            if wt is None:
+                raise MXNetError("onnx import: MatMul needs an initializer "
+                                 "weight")
+            # (in, out) layout from export's _T initializer -> FC layout
+            inits[ins[1]] = _np.ascontiguousarray(wt.T)
+            env[outs[0]] = sym_mod.FullyConnected(
+                var_of(ins[0]), var_of(ins[1]), None,
+                num_hidden=int(wt.shape[1]), no_bias=True, flatten=False,
+                name=name)
+        elif op_type in ("Add", "Sub", "Mul", "Div"):
+            fn = {"Add": sym_mod.broadcast_add,
+                  "Sub": sym_mod.broadcast_sub,
+                  "Mul": sym_mod.broadcast_mul,
+                  "Div": sym_mod.broadcast_div}[op_type]
+            env[outs[0]] = fn(var_of(ins[0]), var_of(ins[1]), name=name)
+        elif op_type == "Concat":
+            env[outs[0]] = sym_mod.concat(
+                *[var_of(i) for i in ins],
+                dim=int(attrs.get("axis", 1)), name=name)
+        elif op_type == "Reshape":
+            shp = tuple(int(x) for x in inits[ins[1]])
+            env[outs[0]] = sym_mod.reshape(var_of(ins[0]), shape=shp,
+                                           name=name)
+        elif op_type == "Transpose":
+            env[outs[0]] = sym_mod.transpose(
+                var_of(ins[0]), axes=tuple(attrs.get("perm", ())) or None,
+                name=name)
+        else:
+            raise MXNetError("onnx import: op %r unsupported" % op_type)
+        last = env[outs[0]]
+
+    # materialize any initializer referenced by the graph into params
+    for nm, arr in inits.items():
+        if nm in env and nm not in arg_params and nm not in aux_params:
+            (aux_params if ("moving_" in nm or "running_" in nm)
+             else arg_params)[nm] = nd.array(arr)
+    return last, arg_params, aux_params
+
+
+def get_model_metadata(onnx_file_path: str):
+    """Reference: onnx2mx.get_model_metadata — input/output descriptors."""
+    with open(onnx_file_path, "rb") as f:
+        buf = f.read()
+    meta = {"input_tensor_data": [], "output_tensor_data": []}
+    for field, wire, val in _scan(buf):
+        if field == 7:
+            for f2, w2, v2 in _scan(val):
+                if f2 == 11:
+                    meta["input_tensor_data"].append(_parse_value_info(v2))
+                elif f2 == 12:
+                    meta["output_tensor_data"].append(_parse_value_info(v2))
+    return meta

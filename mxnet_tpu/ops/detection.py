@@ -546,10 +546,14 @@ def _mrcnn_mask_target(rois, gt_masks, matches, cls_targets,
                                     dtype=crop.dtype)
             return onehot[:, None, None] * crop[None]
         targets = jax.vmap(per_roi)(rois_b, match_b, cls_b)
-        weights = (cls_b > 0).astype(jnp.float32)
-        wmask = jnp.broadcast_to(
-            weights[:, None, None, None],
-            (R, num_classes) + ms)
+        # only the MATCHED class channel is supervised (reference weights
+        # are one_hot(cls) — broadcasting over classes would train every
+        # other channel toward an all-zero mask)
+        onehot_w = jax.nn.one_hot(cls_b.astype(jnp.int32), num_classes,
+                                  dtype=jnp.float32)
+        weights = onehot_w * (cls_b > 0).astype(jnp.float32)[:, None]
+        wmask = jnp.broadcast_to(weights[:, :, None, None],
+                                 (R, num_classes) + ms)
         return targets, wmask
     t, w = jax.vmap(one)(rois.astype(jnp.float32), gt_masks, matches,
                          cls_targets)

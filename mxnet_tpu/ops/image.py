@@ -221,13 +221,13 @@ def _cvimdecode(buf, flag=1, to_rgb=True):
 def _cvimread(filename="", flag=1, to_rgb=True):
     import numpy as np
     from PIL import Image
-    gray = (flag == 0)          # OpenCV IMREAD_GRAYSCALE
-    img = Image.open(filename).convert(
-        "L" if gray or not to_rgb else "RGB")
-    arr = np.asarray(img, np.uint8)
-    if arr.ndim == 2:
-        arr = arr[:, :, None]
-    return jnp.asarray(arr)
+    if flag == 0:               # OpenCV IMREAD_GRAYSCALE
+        arr = np.asarray(Image.open(filename).convert("L"), np.uint8)
+        return jnp.asarray(arr[:, :, None])
+    arr = np.asarray(Image.open(filename).convert("RGB"), np.uint8)
+    if not to_rgb:              # OpenCV-native channel order is BGR
+        arr = arr[:, :, ::-1]
+    return jnp.asarray(arr.copy())
 
 
 @register("_cvimresize", aliases=["cvimresize"], differentiable=False)

@@ -18,11 +18,15 @@ def _launch(script_path, n=2, xla_flags=None):
     env.pop("XLA_FLAGS", None)  # conftest's forced 8-dev count breaks pairing
     if xla_flags:
         env["XLA_FLAGS"] = xla_flags
-    r = subprocess.run([sys.executable,
-                        os.path.join(REPO, "tools", "launch.py"),
-                        "-n", str(n), "--launcher", "local", "--",
-                        sys.executable, str(script_path)],
-                       capture_output=True, text=True, timeout=300, env=env)
+    for attempt in range(2):   # retry once: the free-port pick can race
+        r = subprocess.run([sys.executable,
+                            os.path.join(REPO, "tools", "launch.py"),
+                            "-n", str(n), "--launcher", "local", "--",
+                            sys.executable, str(script_path)],
+                           capture_output=True, text=True, timeout=300,
+                           env=env)
+        if r.returncode == 0:
+            return r.stdout
     assert r.returncode == 0, (r.stdout, r.stderr)
     return r.stdout
 

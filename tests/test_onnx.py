@@ -1,0 +1,124 @@
+"""ONNX export/import round-trip (reference: python/mxnet/onnx mx2onnx/
+onnx2mx).  No onnx package offline: the wire format is written/read
+directly; the round-trip (export -> import -> numerically identical
+forward) pins both directions against each other."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import symbol as sym
+
+
+def _forward(symbol, params, x):
+    args = {"data": nd.array(x)}
+    for k, v in params.items():
+        args[k] = v if isinstance(v, nd.NDArray) else nd.array(v)
+    exe = symbol.bind(mx.cpu(), args)
+    return exe.forward()[0].asnumpy()
+
+
+def _mlp():
+    data = sym.Variable("data")
+    h = sym.FullyConnected(data, sym.Variable("fc1_weight"),
+                           sym.Variable("fc1_bias"), num_hidden=8,
+                           name="fc1")
+    h = sym.Activation(h, act_type="relu", name="relu1")
+    out = sym.FullyConnected(h, sym.Variable("fc2_weight"),
+                             sym.Variable("fc2_bias"), num_hidden=3,
+                             name="fc2")
+    return sym.softmax(out, name="prob")
+
+
+def test_mlp_roundtrip(tmp_path):
+    rng = np.random.RandomState(0)
+    params = {
+        "fc1_weight": nd.array(rng.randn(8, 4).astype(np.float32)),
+        "fc1_bias": nd.array(rng.randn(8).astype(np.float32)),
+        "fc2_weight": nd.array(rng.randn(3, 8).astype(np.float32)),
+        "fc2_bias": nd.array(rng.randn(3).astype(np.float32)),
+    }
+    s = _mlp()
+    path = str(tmp_path / "mlp.onnx")
+    mx.onnx.export_model(s, params, input_shapes=[(2, 4)],
+                         onnx_file_path=path)
+    s2, arg2, aux2 = mx.onnx.import_model(path)
+    x = rng.randn(2, 4).astype(np.float32)
+    np.testing.assert_allclose(_forward(s2, arg2, x),
+                               _forward(s, params, x), rtol=1e-5, atol=1e-6)
+
+
+def test_conv_roundtrip(tmp_path):
+    rng = np.random.RandomState(1)
+    data = sym.Variable("data")
+    c = sym.Convolution(data, sym.Variable("conv_weight"),
+                        sym.Variable("conv_bias"), kernel=(3, 3),
+                        pad=(1, 1), num_filter=4, name="conv")
+    r = sym.Activation(c, act_type="relu", name="crelu")
+    p = sym.Pooling(r, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                    name="pool")
+    f = sym.flatten(p, name="flat")
+    out = sym.FullyConnected(f, sym.Variable("fc_weight"),
+                             sym.Variable("fc_bias"), num_hidden=2,
+                             name="fc")
+    params = {
+        "conv_weight": nd.array(rng.randn(4, 3, 3, 3).astype(np.float32)),
+        "conv_bias": nd.array(rng.randn(4).astype(np.float32)),
+        "fc_weight": nd.array(rng.randn(2, 64).astype(np.float32)),
+        "fc_bias": nd.array(rng.randn(2).astype(np.float32)),
+    }
+    path = str(tmp_path / "cnn.onnx")
+    mx.onnx.export_model(out, params, input_shapes=[(1, 3, 8, 8)],
+                         onnx_file_path=path)
+    s2, arg2, aux2 = mx.onnx.import_model(path)
+    x = rng.randn(1, 3, 8, 8).astype(np.float32)
+    np.testing.assert_allclose(_forward(s2, arg2, x),
+                               _forward(out, params, x),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_metadata(tmp_path):
+    params = {"fc1_weight": nd.array(np.zeros((8, 4), np.float32)),
+              "fc1_bias": nd.array(np.zeros(8, np.float32)),
+              "fc2_weight": nd.array(np.zeros((3, 8), np.float32)),
+              "fc2_bias": nd.array(np.zeros(3, np.float32))}
+    path = str(tmp_path / "m.onnx")
+    mx.onnx.export_model(_mlp(), params, input_shapes=[(2, 4)],
+                         onnx_file_path=path)
+    meta = mx.onnx.get_model_metadata(path)
+    assert meta["input_tensor_data"] == [("data", (2, 4))]
+    assert len(meta["output_tensor_data"]) == 1
+
+
+def test_export_unsupported_op_raises(tmp_path):
+    import pytest
+    data = sym.Variable("data")
+    weird = sym.GridGenerator(data, transform_type="affine",
+                              target_shape=(4, 4))
+    with pytest.raises(Exception, match="no ONNX mapping"):
+        mx.onnx.export_model(weird, {}, input_shapes=[(1, 6)],
+                             onnx_file_path=str(tmp_path / "x.onnx"))
+
+
+def test_export_after_hybridize_forward(tmp_path):
+    """The standard deploy flow: hybridize + forward (cache active) then
+    export -> onnx -> import must match the original outputs (regression:
+    nested cached blocks used to leak jit tracers into the symbol trace)."""
+    import os
+    rng = np.random.RandomState(3)
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Dense(16, activation="relu"),
+            mx.gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    x = nd.array(rng.randn(2, 8).astype(np.float32))
+    ref = net(x).asnumpy()
+    net.hybridize()
+    net(x)
+    sym_file, params_file = net.export(str(tmp_path / "mlp"), epoch=0)
+    onnx_path = mx.onnx.export_model(
+        sym_file, params_file, input_shapes=[(2, 8)],
+        onnx_file_path=str(tmp_path / "mlp.onnx"))
+    s2, arg2, aux2 = mx.onnx.import_model(onnx_path)
+    args = {"data": x}
+    args.update(arg2)
+    out = s2.bind(mx.cpu(), args).forward()[0].asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)

@@ -887,14 +887,24 @@ SPECS.update({
 
 
 
+_JPEG_FILE = None
+
+
 def _jpeg_file():
-    import tempfile
-    from PIL import Image
-    fd, path = tempfile.mkstemp(suffix=".jpg")
-    import os as _os
-    _os.close(fd)
-    Image.fromarray(ints(8, 8, 3, hi=255).astype(np.uint8)).save(path)
-    return path
+    """One temp jpeg per process, removed at exit (the spec table needs a
+    concrete path at build time)."""
+    global _JPEG_FILE
+    if _JPEG_FILE is None:
+        import atexit
+        import os as _os
+        import tempfile
+        from PIL import Image
+        fd, path = tempfile.mkstemp(suffix=".jpg")
+        _os.close(fd)
+        Image.fromarray(ints(8, 8, 3, hi=255).astype(np.uint8)).save(path)
+        atexit.register(lambda: _os.path.exists(path) and _os.unlink(path))
+        _JPEG_FILE = path
+    return _JPEG_FILE
 
 
 SPECS.update({

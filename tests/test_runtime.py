@@ -99,12 +99,15 @@ def test_init_process_group_two_processes(tmp_path):
     """) % REPO)
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)           # forced 8-dev count breaks pairing
-    r = subprocess.run([sys.executable,
-                        os.path.join(REPO, "tools", "launch.py"),
-                        "-n", "2", "--launcher", "local", "--",
-                        sys.executable, str(script)],
-                       capture_output=True, text=True, timeout=300,
-                       env=env)
+    for attempt in range(2):   # retry once: the free-port pick can race
+        r = subprocess.run([sys.executable,
+                            os.path.join(REPO, "tools", "launch.py"),
+                            "-n", "2", "--launcher", "local", "--",
+                            sys.executable, str(script)],
+                           capture_output=True, text=True, timeout=300,
+                           env=env)
+        if r.returncode == 0:
+            break
     assert r.returncode == 0, (r.stdout, r.stderr)
     assert "dist ok rank 0" in r.stdout and "dist ok rank 1" in r.stdout
 
