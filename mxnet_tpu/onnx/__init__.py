@@ -312,6 +312,8 @@ def export_model(sym, params, input_shapes=None, input_types=_np.float32,
     inits_pb: List[bytes] = []
     inputs_pb: List[bytes] = []
     outputs_pb: List[bytes] = []
+    consumed_only_transposed: set = set()
+    param_nodes: List[str] = []
 
     arg_names = sym.list_arguments()
     data_names = [n for n in arg_names if n not in params]
@@ -326,8 +328,7 @@ def export_model(sym, params, input_shapes=None, input_types=_np.float32,
         ins = [out_name(c, i) for c, i in node.inputs]
         if op == "null":
             if node.name in params:
-                inits_pb.append(_f_bytes(5, _tensor(node.name,
-                                                    params[node.name])))
+                param_nodes.append(node.name)
             else:
                 inputs_pb.append(_f_bytes(11, _value_info(
                     node.name, shapes.get(node.name, ()))))
@@ -360,6 +361,7 @@ def export_model(sym, params, input_shapes=None, input_types=_np.float32,
                 if wt_name not in params:
                     params[wt_name] = _np.ascontiguousarray(
                         params[wname].T)
+                consumed_only_transposed.add(wname)
                 mm_out = outs[0] if no_bias else name + "_mm"
                 nodes_pb.append(_f_bytes(1, _node(
                     "MatMul", [ins[0], wt_name], [mm_out],
@@ -380,6 +382,12 @@ def export_model(sym, params, input_shapes=None, input_types=_np.float32,
                 raise MXNetError("onnx export: Activation %r" % act)
             nodes_pb.append(_f_bytes(1, _node(onnx_op, ins, outs, name, {})))
         elif op == "BatchNorm":
+            fix_gamma = str(attrs.get("fix_gamma", "True")) not in \
+                ("False", "0")
+            if fix_gamma and ins[1] in params:
+                # mxnet treats gamma as all-ones under fix_gamma (the
+                # default); the exported graph must match that forward
+                params[ins[1]] = _np.ones_like(params[ins[1]])
             nodes_pb.append(_f_bytes(1, _node(
                 "BatchNormalization",
                 [ins[0], ins[1], ins[2], ins[3], ins[4]], outs, name,
@@ -449,9 +457,12 @@ def export_model(sym, params, input_shapes=None, input_types=_np.float32,
                 "FC/Conv/BN/Pool/activations/elemwise/concat/reshape/"
                 "transpose/softmax/dropout/flatten)" % op)
 
-    emitted = {n.name for n in _walk(sym) if n.op == "null"}
+    for pname in param_nodes:
+        if pname in consumed_only_transposed:
+            continue    # only its _T form is referenced; don't store twice
+        inits_pb.append(_f_bytes(5, _tensor(pname, params[pname])))
     for pname, arr in params.items():
-        if pname.endswith("_T") and pname not in emitted:
+        if pname.endswith("_T") and pname not in param_nodes:
             inits_pb.append(_f_bytes(5, _tensor(pname, arr)))
 
     for node, idx in sym._heads:
@@ -472,6 +483,19 @@ def export_model(sym, params, input_shapes=None, input_types=_np.float32,
 # ---------------------------------------------------------------------------
 # onnx graph -> mx symbol
 # ---------------------------------------------------------------------------
+
+
+def _sym_pads(attrs, what):
+    """mxnet pads are symmetric (begin == end); reject silently-lossy
+    asymmetric ONNX pads instead of truncating them."""
+    pads = attrs.get("pads")
+    if pads is not None:
+        half = len(pads) // 2
+        if list(pads[:half]) != list(pads[half:]):
+            raise MXNetError(
+                "onnx import: %s with asymmetric pads %s is not supported "
+                "(mxnet pads are begin==end)" % (what, pads))
+
 
 
 _IMPORT_SIMPLE = {"Relu": ("Activation", {"act_type": "relu"}),
@@ -497,6 +521,7 @@ def import_model(onnx_file_path: str):
     nodes = []
     inits: Dict[str, _np.ndarray] = {}
     g_inputs: List[Tuple[str, Tuple[int, ...]]] = []
+    g_outputs: List[str] = []
     for field, wire, val in _scan(graph):
         if field == 1:
             nodes.append(_parse_node(val))
@@ -505,6 +530,8 @@ def import_model(onnx_file_path: str):
             inits[nm] = arr
         elif field == 11:
             g_inputs.append(_parse_value_info(val))
+        elif field == 12:
+            g_outputs.append(_parse_value_info(val)[0])
 
     env: Dict[str, Any] = {}
     for nm, shape in g_inputs:
@@ -525,6 +552,10 @@ def import_model(onnx_file_path: str):
         if op_type == "Flatten" and name.endswith("_flatten"):
             env[outs[0]] = sym_mod.flatten(var_of(ins[0]))
         elif op_type == "Gemm":
+            if ins[1] not in inits:
+                raise MXNetError("onnx import: Gemm weight %r must be an "
+                                 "initializer (dynamic weights are not "
+                                 "supported)" % ins[1])
             alpha = float(attrs.get("alpha", 1.0))
             beta = float(attrs.get("beta", 1.0))
             if int(attrs.get("transA", 0)) != 0 or alpha != 1.0 \
@@ -533,7 +564,7 @@ def import_model(onnx_file_path: str):
                     "onnx import: Gemm with transA/alpha/beta != defaults "
                     "is not supported (got transA=%s alpha=%s beta=%s)"
                     % (attrs.get("transA", 0), alpha, beta))
-            if int(attrs.get("transB", 1)) == 0:
+            if int(attrs.get("transB", 0)) == 0:  # ONNX default is 0
                 # weight stored (in, out): transpose into FC layout
                 inits[ins[1]] = _np.ascontiguousarray(inits[ins[1]].T)
             w = inits[ins[1]]
@@ -545,6 +576,7 @@ def import_model(onnx_file_path: str):
             env[outs[0]] = out
         elif op_type == "Conv":
             w = inits[ins[1]]
+            _sym_pads(attrs, "Conv")
             out = sym_mod.Convolution(
                 var_of(ins[0]), var_of(ins[1]),
                 var_of(ins[2]) if len(ins) > 2 else None,
@@ -572,6 +604,8 @@ def import_model(onnx_file_path: str):
                 fix_gamma=False)
         elif op_type in ("MaxPool", "AveragePool", "GlobalMaxPool",
                          "GlobalAveragePool"):
+            if not op_type.startswith("Global"):
+                _sym_pads(attrs, op_type)
             if op_type.startswith("Global"):
                 env[outs[0]] = sym_mod.Pooling(
                     var_of(ins[0]), kernel=(1, 1), global_pool=True,
@@ -616,6 +650,10 @@ def import_model(onnx_file_path: str):
                 *[var_of(i) for i in ins],
                 dim=int(attrs.get("axis", 1)), name=name)
         elif op_type == "Reshape":
+            if ins[1] not in inits:
+                raise MXNetError("onnx import: Reshape shape %r must be an "
+                                 "initializer (dynamic shapes are not "
+                                 "supported)" % ins[1])
             shp = tuple(int(x) for x in inits[ins[1]])
             env[outs[0]] = sym_mod.reshape(var_of(ins[0]), shape=shp,
                                            name=name)
@@ -632,7 +670,15 @@ def import_model(onnx_file_path: str):
         if nm in env and nm not in arg_params and nm not in aux_params:
             (aux_params if ("moving_" in nm or "running_" in nm)
              else arg_params)[nm] = nd.array(arr)
-    return last, arg_params, aux_params
+    # return the graph's DECLARED outputs (field 12), not whichever node
+    # happened to come last in the topological order
+    declared = [env[o] for o in g_outputs if o in env]
+    if declared:
+        out_sym = declared[0] if len(declared) == 1 \
+            else sym_mod.Group(declared)
+    else:
+        out_sym = last
+    return out_sym, arg_params, aux_params
 
 
 def get_model_metadata(onnx_file_path: str):

@@ -382,8 +382,10 @@ def _imdecode(buf, flag=1, to_rgb=True):
     from PIL import Image
     raw = np.asarray(buf, np.uint8).tobytes()
     img = Image.open(_io.BytesIO(raw))
-    img = img.convert("RGB" if to_rgb else "L")
-    arr = np.asarray(img, np.uint8)
-    if arr.ndim == 2:
-        arr = arr[:, :, None]
+    if flag == 0:               # IMREAD_GRAYSCALE
+        arr = np.asarray(img.convert("L"), np.uint8)
+        return jnp.asarray(arr[:, :, None])
+    arr = np.asarray(img.convert("RGB"), np.uint8)
+    if not to_rgb:              # OpenCV-native BGR order
+        arr = arr[:, :, ::-1].copy()
     return jnp.asarray(arr)

@@ -117,7 +117,16 @@ def shard_params_tp(param_values: Dict[str, jax.Array], mesh: Mesh,
             # biases and everything else replicate (always a valid
             # placement; XLA re-shards at use sites as needed)
             spec = P()
-        out[name] = jax.device_put(v, NamedSharding(mesh, spec))
+        sharding = NamedSharding(mesh, spec)
+        if jax.process_count() > 1:
+            # multi-host: device_put would need a cross-host transfer; every
+            # process holds the SAME full value (same-seed init / broadcast),
+            # so assemble the global array from local slices
+            host_v = _np.asarray(v)
+            out[name] = jax.make_array_from_callback(
+                host_v.shape, sharding, lambda idx, hv=host_v: hv[idx])
+        else:
+            out[name] = jax.device_put(v, sharding)
     return out
 
 
@@ -161,6 +170,15 @@ class TrainStep:
             step, donate_argnums=(0, 1) if donate else ())
 
     def shard_batch(self, *arrays):
+        """Place host batches onto the dp-sharded layout.  Multi-host: each
+        process passes its LOCAL shard (the data-loader's part_index slice)
+        and the pieces assemble into one global array — the reference's
+        dist-training contract where every worker feeds its own partition."""
+        if jax.process_count() > 1:
+            return tuple(
+                jax.make_array_from_process_local_data(
+                    self._batch_sharding, _np.asarray(a))
+                for a in arrays)
         return tuple(jax.device_put(a, self._batch_sharding) for a in arrays)
 
     def __call__(self, *batch):
