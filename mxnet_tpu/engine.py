@@ -30,6 +30,8 @@ class Engine:
 
     def __init__(self):
         import weakref
+        self._kind_raw = object()   # sentinel: never equals a str
+        self._naive = False
         # live NDArray chunks, registered at creation/write; WaitForAll
         # blocks on each — the reference's "wait for all vars" semantics
         self._live = weakref.WeakSet()
@@ -43,8 +45,16 @@ class Engine:
         return get_env("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
 
     def is_naive(self) -> bool:
-        # read each call: tests toggle via environment(); cost is a dict get
-        return self.kind in ("NaiveEngine", "naive")
+        # HOT PATH (called after every eager op): one raw os.environ read,
+        # cached by VALUE — catches both set_env/environment() (which keep
+        # os.environ in sync) and direct monkeypatch.setenv writes, without
+        # get_env's lock + override-dict + dtype machinery per dispatch
+        import os
+        val = os.environ.get("MXNET_ENGINE_TYPE")
+        if val != self._kind_raw:
+            self._kind_raw = val
+            self._naive = val in ("NaiveEngine", "naive")
+        return self._naive
 
     # -- sync points -------------------------------------------------------
     def wait_for_var(self, value) -> None:

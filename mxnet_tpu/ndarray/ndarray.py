@@ -722,6 +722,7 @@ def _norm_axis(axis):
 # every invoke() is also recorded as a graph node — the imperative run IS
 # the trace (reference: hybrid_forward Symbol-proxy tracing).
 _sym_tracer = None
+_autograd = None
 
 
 def invoke(op_name: str, *inputs, out=None, **params):
@@ -776,7 +777,10 @@ def _invoke_impl(op_name: str, *inputs, out=None, **params):
         from ..ops import random as _rnd
         jax_in.insert(0, _rnd.next_key())
 
-    from .. import autograd
+    global _autograd
+    if _autograd is None:
+        from .. import autograd as _autograd  # lazy: breaks import cycle
+    autograd = _autograd
     if autograd.is_recording() and op.differentiable:
         outs = autograd.record_op(op, params, inputs, jax_in, ctx)
     elif op.no_jit:

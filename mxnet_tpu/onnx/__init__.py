@@ -457,8 +457,17 @@ def export_model(sym, params, input_shapes=None, input_types=_np.float32,
                 "FC/Conv/BN/Pool/activations/elemwise/concat/reshape/"
                 "transpose/softmax/dropout/flatten)" % op)
 
+    # a param may be skipped only if NO emitted node consumes it directly
+    # (a weight shared between a flatten=False MatMul and any direct use
+    # must still be stored)
+    direct_refs: set = set()
+    for nb in nodes_pb:
+        # nb is _f_bytes(1, node); strip the tag+length prefix to parse
+        body = next(v for f, w, v in _scan(nb) if f == 1)
+        _, n_ins, _, _, _ = _parse_node(body)
+        direct_refs.update(n_ins)
     for pname in param_nodes:
-        if pname in consumed_only_transposed:
+        if pname in consumed_only_transposed and pname not in direct_refs:
             continue    # only its _T form is referenced; don't store twice
         inits_pb.append(_f_bytes(5, _tensor(pname, params[pname])))
     for pname, arr in params.items():
@@ -534,6 +543,7 @@ def import_model(onnx_file_path: str):
             g_outputs.append(_parse_value_info(val)[0])
 
     env: Dict[str, Any] = {}
+    transposed_weights: set = set()
     for nm, shape in g_inputs:
         env[nm] = sym_mod.Variable(nm)
     arg_params: Dict[str, Any] = {}
@@ -564,9 +574,15 @@ def import_model(onnx_file_path: str):
                     "onnx import: Gemm with transA/alpha/beta != defaults "
                     "is not supported (got transA=%s alpha=%s beta=%s)"
                     % (attrs.get("transA", 0), alpha, beta))
-            if int(attrs.get("transB", 0)) == 0:  # ONNX default is 0
-                # weight stored (in, out): transpose into FC layout
+            want_t = int(attrs.get("transB", 0)) == 0  # ONNX default is 0
+            if want_t and ins[1] not in transposed_weights:
+                # weight stored (in, out): transpose into FC layout ONCE
                 inits[ins[1]] = _np.ascontiguousarray(inits[ins[1]].T)
+                transposed_weights.add(ins[1])
+            elif not want_t and ins[1] in transposed_weights:
+                raise MXNetError(
+                    "onnx import: weight %r is shared by Gemm nodes with "
+                    "conflicting transB values" % ins[1])
             w = inits[ins[1]]
             out = sym_mod.FullyConnected(
                 var_of(ins[0]), var_of(ins[1]),
@@ -634,7 +650,9 @@ def import_model(onnx_file_path: str):
                 raise MXNetError("onnx import: MatMul needs an initializer "
                                  "weight")
             # (in, out) layout from export's _T initializer -> FC layout
-            inits[ins[1]] = _np.ascontiguousarray(wt.T)
+            if ins[1] not in transposed_weights:
+                inits[ins[1]] = _np.ascontiguousarray(wt.T)
+                transposed_weights.add(ins[1])
             env[outs[0]] = sym_mod.FullyConnected(
                 var_of(ins[0]), var_of(ins[1]), None,
                 num_hidden=int(wt.shape[1]), no_bias=True, flatten=False,

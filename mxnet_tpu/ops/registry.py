@@ -131,4 +131,6 @@ def _jitted(name: str, frozen_params) -> Callable:
 
 
 def cached_jit(name: str, params: Dict[str, Any]) -> Callable:
+    if not params:          # hot path: most elementwise ops have no attrs
+        return _jitted(name, ())
     return _jitted(name, tuple(sorted((k, _freeze(v)) for k, v in params.items())))
