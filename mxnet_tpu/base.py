@@ -76,26 +76,16 @@ def get_env(name: str, default: Any = None, dtype: Callable = str) -> Any:
         return default
 
 
-_env_epoch = 0
-
-
-def env_epoch() -> int:
-    """Bumped on every set_env — lets hot paths cache env-derived flags
-    (engine kind) and revalidate with one integer compare instead of an
-    os.environ read per op dispatch."""
-    return _env_epoch
-
-
 def set_env(name: str, value: Optional[str]) -> None:
-    """Set (or with None, unset) a process-local env override."""
-    global _env_epoch
+    """Set (or with None, unset) a process-local env override.  NB this
+    keeps os.environ in sync, which hot-path caches (engine.is_naive's
+    value-compare) rely on."""
     with _env_lock:
         _env_overrides[name] = None if value is None else str(value)
         if value is None:
             os.environ.pop(name, None)
         else:
             os.environ[name] = str(value)
-        _env_epoch += 1
 
 
 class environment:

@@ -191,8 +191,13 @@ def _parse_attr(buf: bytes):
     return name, ints
 
 
+_ref_sink = None    # set by export_model: collects node input names
+
+
 def _node(op_type: str, inputs: List[str], outputs: List[str], name: str,
           attrs: Dict[str, Any]) -> bytes:
+    if _ref_sink is not None:
+        _ref_sink.update(inputs)
     out = b"".join(_f_str(1, i) for i in inputs)
     out += b"".join(_f_str(2, o) for o in outputs)
     out += _f_str(3, name) + _f_str(4, op_type)
@@ -314,6 +319,9 @@ def export_model(sym, params, input_shapes=None, input_types=_np.float32,
     outputs_pb: List[bytes] = []
     consumed_only_transposed: set = set()
     param_nodes: List[str] = []
+    direct_refs: set = set()    # filled by _node as nodes are emitted
+    global _ref_sink
+    _ref_sink = direct_refs
 
     arg_names = sym.list_arguments()
     data_names = [n for n in arg_names if n not in params]
@@ -457,15 +465,10 @@ def export_model(sym, params, input_shapes=None, input_types=_np.float32,
                 "FC/Conv/BN/Pool/activations/elemwise/concat/reshape/"
                 "transpose/softmax/dropout/flatten)" % op)
 
+    _ref_sink = None
     # a param may be skipped only if NO emitted node consumes it directly
     # (a weight shared between a flatten=False MatMul and any direct use
-    # must still be stored)
-    direct_refs: set = set()
-    for nb in nodes_pb:
-        # nb is _f_bytes(1, node); strip the tag+length prefix to parse
-        body = next(v for f, w, v in _scan(nb) if f == 1)
-        _, n_ins, _, _, _ = _parse_node(body)
-        direct_refs.update(n_ins)
+    # must still be stored); direct_refs was filled at _node-emission time
     for pname in param_nodes:
         if pname in consumed_only_transposed and pname not in direct_refs:
             continue    # only its _T form is referenced; don't store twice
@@ -513,6 +516,16 @@ _IMPORT_SIMPLE = {"Relu": ("Activation", {"act_type": "relu"}),
                   "Softplus": ("Activation", {"act_type": "softrelu"})}
 
 
+def _claim_layout(registry, name, want_t):
+    """Order-independent shared-weight layout check: every consumer of an
+    initializer must agree on whether it gets transposed."""
+    prev = registry.setdefault(name, want_t)
+    if prev != want_t:
+        raise MXNetError(
+            "onnx import: weight %r is shared by nodes with conflicting "
+            "layouts (transB / MatMul-transposed mix)" % name)
+
+
 def import_model(onnx_file_path: str):
     """Reference: onnx2mx import_model → (sym, arg_params, aux_params)."""
     from .. import ndarray as nd
@@ -544,6 +557,7 @@ def import_model(onnx_file_path: str):
 
     env: Dict[str, Any] = {}
     transposed_weights: set = set()
+    weight_layout: Dict[str, bool] = {}   # name -> wants transpose
     for nm, shape in g_inputs:
         env[nm] = sym_mod.Variable(nm)
     arg_params: Dict[str, Any] = {}
@@ -575,27 +589,26 @@ def import_model(onnx_file_path: str):
                     "is not supported (got transA=%s alpha=%s beta=%s)"
                     % (attrs.get("transA", 0), alpha, beta))
             want_t = int(attrs.get("transB", 0)) == 0  # ONNX default is 0
+            _claim_layout(weight_layout, ins[1], want_t)
             if want_t and ins[1] not in transposed_weights:
                 # weight stored (in, out): transpose into FC layout ONCE
                 inits[ins[1]] = _np.ascontiguousarray(inits[ins[1]].T)
                 transposed_weights.add(ins[1])
-            elif not want_t and ins[1] in transposed_weights:
-                raise MXNetError(
-                    "onnx import: weight %r is shared by Gemm nodes with "
-                    "conflicting transB values" % ins[1])
             w = inits[ins[1]]
-            out = sym_mod.FullyConnected(
-                var_of(ins[0]), var_of(ins[1]),
-                var_of(ins[2]) if len(ins) > 2 else None,
-                num_hidden=int(w.shape[0]), no_bias=len(ins) <= 2,
-                name=name)
-            env[outs[0]] = out
+            fc_in = [var_of(ins[0]), var_of(ins[1])]
+            if len(ins) > 2:
+                fc_in.append(var_of(ins[2]))
+            env[outs[0]] = sym_mod.FullyConnected(
+                *fc_in, num_hidden=int(w.shape[0]),
+                no_bias=len(ins) <= 2, name=name)
         elif op_type == "Conv":
             w = inits[ins[1]]
             _sym_pads(attrs, "Conv")
+            conv_in = [var_of(ins[0]), var_of(ins[1])]
+            if len(ins) > 2:
+                conv_in.append(var_of(ins[2]))
             out = sym_mod.Convolution(
-                var_of(ins[0]), var_of(ins[1]),
-                var_of(ins[2]) if len(ins) > 2 else None,
+                *conv_in,
                 kernel=tuple(attrs["kernel_shape"]),
                 stride=tuple(attrs.get("strides",
                                        (1,) * len(attrs["kernel_shape"]))),
@@ -645,17 +658,18 @@ def import_model(onnx_file_path: str):
         elif op_type == "Dropout":
             env[outs[0]] = var_of(ins[0])      # inference: identity
         elif op_type == "MatMul":
-            wt = inits.get(ins[1])
-            if wt is None:
+            if ins[1] not in inits:
                 raise MXNetError("onnx import: MatMul needs an initializer "
                                  "weight")
+            _claim_layout(weight_layout, ins[1], True)
             # (in, out) layout from export's _T initializer -> FC layout
             if ins[1] not in transposed_weights:
-                inits[ins[1]] = _np.ascontiguousarray(wt.T)
+                inits[ins[1]] = _np.ascontiguousarray(inits[ins[1]].T)
                 transposed_weights.add(ins[1])
+            w = inits[ins[1]]     # (out, in) AFTER the shared transpose
             env[outs[0]] = sym_mod.FullyConnected(
-                var_of(ins[0]), var_of(ins[1]), None,
-                num_hidden=int(wt.shape[1]), no_bias=True, flatten=False,
+                var_of(ins[0]), var_of(ins[1]),
+                num_hidden=int(w.shape[0]), no_bias=True, flatten=False,
                 name=name)
         elif op_type in ("Add", "Sub", "Mul", "Div"):
             fn = {"Add": sym_mod.broadcast_add,

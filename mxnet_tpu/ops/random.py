@@ -20,6 +20,7 @@ from .registry import register
 
 _state = threading.local()
 _DEFAULT_SEED = 0
+_fold_in_jit = jax.jit(jax.random.fold_in)
 
 
 def _root():
@@ -46,7 +47,11 @@ def next_key() -> jax.Array:
         holder[0], sub = jax.random.split(holder[0])
         return sub
     st.counter += 1
-    return jax.random.fold_in(st.key, st.counter)
+    # JITTED fold_in with the counter as a traced ARRAY operand: the eager
+    # threefry path runs dozens of un-fused scalar ops (~100ms+ per call on
+    # CPU), and a Python-int counter would bake into the trace and recompile
+    # per value.  One executable serves every counter.
+    return _fold_in_jit(st.key, jnp.uint32(st.counter))
 
 
 class trace_key_scope:

@@ -122,3 +122,42 @@ def test_export_after_hybridize_forward(tmp_path):
     args.update(arg2)
     out = s2.bind(mx.cpu(), args).forward()[0].asnumpy()
     np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_tied_weight_flatten_false_roundtrip(tmp_path):
+    """One weight shared by two flatten=False FC heads: exported ONCE in
+    transposed form, imported with a SINGLE transpose and the right
+    num_hidden (regressions: dropped initializer / double transpose /
+    stale num_hidden / None bias into symbol compose)."""
+    data = sym.Variable("data")
+    w = sym.Variable("w")
+    h1 = sym.FullyConnected(data, w, num_hidden=8, no_bias=True,
+                            flatten=False, name="fc1")
+    h2 = sym.FullyConnected(sym.Activation(data, act_type="relu"), w,
+                            num_hidden=8, no_bias=True, flatten=False,
+                            name="fc2")
+    out = h1 + h2
+    params = {"w": nd.array(
+        np.random.RandomState(0).randn(8, 4).astype(np.float32))}
+    path = str(tmp_path / "tied.onnx")
+    mx.onnx.export_model(out, params, input_shapes=[(2, 3, 4)],
+                         onnx_file_path=path)
+    s2, arg2, _ = mx.onnx.import_model(path)
+    x = np.random.RandomState(1).randn(2, 3, 4).astype(np.float32)
+    np.testing.assert_allclose(_forward(s2, arg2, x),
+                               _forward(out, params, x),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_no_bias_gemm_roundtrip(tmp_path):
+    g = sym.FullyConnected(sym.Variable("data"), sym.Variable("w2"),
+                           num_hidden=3, no_bias=True, name="g")
+    params = {"w2": nd.array(
+        np.random.RandomState(2).randn(3, 4).astype(np.float32))}
+    path = str(tmp_path / "nb.onnx")
+    mx.onnx.export_model(g, params, input_shapes=[(2, 4)],
+                         onnx_file_path=path)
+    s2, arg2, _ = mx.onnx.import_model(path)
+    x = np.random.RandomState(3).randn(2, 4).astype(np.float32)
+    np.testing.assert_allclose(_forward(s2, arg2, x),
+                               _forward(g, params, x), rtol=1e-5)
