@@ -330,142 +330,147 @@ def export_model(sym, params, input_shapes=None, input_types=_np.float32,
     def out_name(node, idx=0):
         return node.name if idx == 0 else "%s_out%d" % (node.name, idx)
 
-    for node in _walk(sym):
-        op = node.op
-        attrs = node.attrs or {}
-        ins = [out_name(c, i) for c, i in node.inputs]
-        if op == "null":
-            if node.name in params:
-                param_nodes.append(node.name)
-            else:
-                inputs_pb.append(_f_bytes(11, _value_info(
-                    node.name, shapes.get(node.name, ()))))
-            continue
-        name = node.name
-        outs = [out_name(node)]
-        if op == "FullyConnected":
-            no_bias = str(attrs.get("no_bias", "False")) in ("True", "1")
-            flatten = str(attrs.get("flatten", "True")) not in ("False", "0")
-            if flatten:
-                flat_in = ins[0] + "_flat"
-                nodes_pb.append(_f_bytes(1, _node(
-                    "Flatten", [ins[0]], [flat_in], name + "_flatten",
-                    {"axis": 1})))
-                gemm_in = [flat_in, ins[1]] + ([] if no_bias else [ins[2]])
-                nodes_pb.append(_f_bytes(1, _node(
-                    "Gemm", gemm_in, outs, name,
-                    {"alpha": 1.0, "beta": 1.0, "transA": 0, "transB": 1})))
-            else:
-                # per-position projection over N-D input: ONNX Gemm is 2-D
-                # only, so emit MatMul against a TRANSPOSED weight
-                # initializer (+ Add for bias)
-                wname = ins[1]
-                if wname not in params:
-                    raise MXNetError(
-                        "onnx export: FullyConnected(flatten=False) needs "
-                        "its weight as a parameter (got graph input %r)"
-                        % wname)
-                wt_name = wname + "_T"
-                if wt_name not in params:
-                    params[wt_name] = _np.ascontiguousarray(
-                        params[wname].T)
-                consumed_only_transposed.add(wname)
-                mm_out = outs[0] if no_bias else name + "_mm"
-                nodes_pb.append(_f_bytes(1, _node(
-                    "MatMul", [ins[0], wt_name], [mm_out],
-                    name + "_matmul", {})))
-                if not no_bias:
+    try:
+        for node in _walk(sym):
+            op = node.op
+            attrs = node.attrs or {}
+            ins = [out_name(c, i) for c, i in node.inputs]
+            if op == "null":
+                if node.name in params:
+                    param_nodes.append(node.name)
+                else:
+                    inputs_pb.append(_f_bytes(11, _value_info(
+                        node.name, shapes.get(node.name, ()))))
+                continue
+            name = node.name
+            outs = [out_name(node)]
+            if op == "FullyConnected":
+                no_bias = str(attrs.get("no_bias", "False")) in ("True", "1")
+                flatten = str(attrs.get("flatten", "True")) not in ("False", "0")
+                if flatten:
+                    flat_in = ins[0] + "_flat"
                     nodes_pb.append(_f_bytes(1, _node(
-                        "Add", [mm_out, ins[2]], outs, name, {})))
-        elif op == "Convolution":
-            no_bias = str(attrs.get("no_bias", "False")) in ("True", "1")
-            conv_in = ins[:2] + ([] if no_bias else [ins[2]])
-            nodes_pb.append(_f_bytes(1, _node("Conv", conv_in, outs, name,
-                                              _conv_attrs(attrs))))
-        elif op == "Activation":
-            act = attrs.get("act_type", "relu")
-            onnx_op = {"relu": "Relu", "sigmoid": "Sigmoid",
-                       "tanh": "Tanh", "softrelu": "Softplus"}.get(act)
-            if onnx_op is None:
-                raise MXNetError("onnx export: Activation %r" % act)
-            nodes_pb.append(_f_bytes(1, _node(onnx_op, ins, outs, name, {})))
-        elif op == "BatchNorm":
-            fix_gamma = str(attrs.get("fix_gamma", "True")) not in \
-                ("False", "0")
-            if fix_gamma and ins[1] in params:
-                # mxnet treats gamma as all-ones under fix_gamma (the
-                # default); the exported graph must match that forward
-                params[ins[1]] = _np.ones_like(params[ins[1]])
-            nodes_pb.append(_f_bytes(1, _node(
-                "BatchNormalization",
-                [ins[0], ins[1], ins[2], ins[3], ins[4]], outs, name,
-                {"epsilon": float(_a(attrs, "eps", 1e-3) or 1e-3),
-                 "momentum": float(_a(attrs, "momentum", 0.9) or 0.9)})))
-        elif op == "Pooling":
-            ptype = attrs.get("pool_type", "max")
-            if str(attrs.get("global_pool", "False")) in ("True", "1"):
-                onnx_op = "GlobalMaxPool" if ptype == "max" else \
-                    "GlobalAveragePool"
-                nodes_pb.append(_f_bytes(1, _node(onnx_op, ins, outs,
-                                                  name, {})))
-            else:
-                kernel = tuple(_a(attrs, "kernel"))
-                stride = tuple(_a(attrs, "stride", kernel) or kernel)
-                pad = tuple(_a(attrs, "pad", (0,) * len(kernel)) or
-                            (0,) * len(kernel))
-                onnx_op = "MaxPool" if ptype == "max" else "AveragePool"
+                        "Flatten", [ins[0]], [flat_in], name + "_flatten",
+                        {"axis": 1})))
+                    gemm_in = [flat_in, ins[1]] + ([] if no_bias else [ins[2]])
+                    nodes_pb.append(_f_bytes(1, _node(
+                        "Gemm", gemm_in, outs, name,
+                        {"alpha": 1.0, "beta": 1.0, "transA": 0, "transB": 1})))
+                else:
+                    # per-position projection over N-D input: ONNX Gemm is 2-D
+                    # only, so emit MatMul against a TRANSPOSED weight
+                    # initializer (+ Add for bias)
+                    wname = ins[1]
+                    if wname not in params:
+                        raise MXNetError(
+                            "onnx export: FullyConnected(flatten=False) needs "
+                            "its weight as a parameter (got graph input %r)"
+                            % wname)
+                    wt_name = wname + "_T"
+                    if wt_name not in params:
+                        params[wt_name] = _np.ascontiguousarray(
+                            params[wname].T)
+                    consumed_only_transposed.add(wname)
+                    mm_out = outs[0] if no_bias else name + "_mm"
+                    nodes_pb.append(_f_bytes(1, _node(
+                        "MatMul", [ins[0], wt_name], [mm_out],
+                        name + "_matmul", {})))
+                    if not no_bias:
+                        nodes_pb.append(_f_bytes(1, _node(
+                            "Add", [mm_out, ins[2]], outs, name, {})))
+            elif op == "Convolution":
+                no_bias = str(attrs.get("no_bias", "False")) in ("True", "1")
+                conv_in = ins[:2] + ([] if no_bias else [ins[2]])
+                nodes_pb.append(_f_bytes(1, _node("Conv", conv_in, outs, name,
+                                                  _conv_attrs(attrs))))
+            elif op == "Activation":
+                act = attrs.get("act_type", "relu")
+                onnx_op = {"relu": "Relu", "sigmoid": "Sigmoid",
+                           "tanh": "Tanh", "softrelu": "Softplus"}.get(act)
+                if onnx_op is None:
+                    raise MXNetError("onnx export: Activation %r" % act)
+                nodes_pb.append(_f_bytes(1, _node(onnx_op, ins, outs, name, {})))
+            elif op == "BatchNorm":
+                fix_gamma = str(attrs.get("fix_gamma", "True")) not in \
+                    ("False", "0")
+                if fix_gamma and ins[1] in params:
+                    # mxnet treats gamma as all-ones under fix_gamma (the
+                    # default); the exported graph must match that forward
+                    params[ins[1]] = _np.ones_like(params[ins[1]])
                 nodes_pb.append(_f_bytes(1, _node(
-                    onnx_op, ins, outs, name,
-                    {"kernel_shape": list(kernel),
-                     "strides": list(stride), "pads": list(pad) * 2})))
-        elif op in ("softmax", "SoftmaxOutput", "log_softmax"):
-            onnx_op = "LogSoftmax" if op == "log_softmax" else "Softmax"
-            nodes_pb.append(_f_bytes(1, _node(
-                onnx_op, ins[:1], outs, name,
-                {"axis": int(_a(attrs, "axis", -1) or -1)})))
-        elif op in ("Flatten", "flatten"):
-            nodes_pb.append(_f_bytes(1, _node("Flatten", ins, outs, name,
-                                              {"axis": 1})))
-        elif op == "Dropout":
-            nodes_pb.append(_f_bytes(1, _node("Dropout", ins, outs, name,
-                                              {})))
-        elif op in ("broadcast_add", "elemwise_add", "_plus"):
-            nodes_pb.append(_f_bytes(1, _node("Add", ins, outs, name, {})))
-        elif op in ("broadcast_sub", "elemwise_sub"):
-            nodes_pb.append(_f_bytes(1, _node("Sub", ins, outs, name, {})))
-        elif op in ("broadcast_mul", "elemwise_mul"):
-            nodes_pb.append(_f_bytes(1, _node("Mul", ins, outs, name, {})))
-        elif op in ("broadcast_div", "elemwise_div"):
-            nodes_pb.append(_f_bytes(1, _node("Div", ins, outs, name, {})))
-        elif op == "concat":
-            nodes_pb.append(_f_bytes(1, _node(
-                "Concat", ins, outs, name,
-                {"axis": int(_a(attrs, "dim", 1) or 1)})))
-        elif op in ("reshape", "Reshape"):
-            shape_name = name + "_shape"
-            shp = _np.asarray(_a(attrs, "shape"), _np.int64)
-            inits_pb.append(_f_bytes(5, _tensor(shape_name, shp)))
-            nodes_pb.append(_f_bytes(1, _node(
-                "Reshape", [ins[0], shape_name], outs, name, {})))
-        elif op in ("transpose",):
-            axes = _a(attrs, "axes")
-            nodes_pb.append(_f_bytes(1, _node(
-                "Transpose", ins, outs, name,
-                {"perm": list(axes)} if axes else {})))
-        elif op == "relu":
-            nodes_pb.append(_f_bytes(1, _node("Relu", ins, outs, name, {})))
-        elif op == "sigmoid":
-            nodes_pb.append(_f_bytes(1, _node("Sigmoid", ins, outs, name,
-                                              {})))
-        elif op == "tanh":
-            nodes_pb.append(_f_bytes(1, _node("Tanh", ins, outs, name, {})))
-        else:
-            raise MXNetError(
-                "onnx export: op %r has no ONNX mapping yet (supported: "
-                "FC/Conv/BN/Pool/activations/elemwise/concat/reshape/"
-                "transpose/softmax/dropout/flatten)" % op)
+                    "BatchNormalization",
+                    [ins[0], ins[1], ins[2], ins[3], ins[4]], outs, name,
+                    {"epsilon": float(_a(attrs, "eps", 1e-3) or 1e-3),
+                     "momentum": float(_a(attrs, "momentum", 0.9) or 0.9)})))
+            elif op == "Pooling":
+                ptype = attrs.get("pool_type", "max")
+                if str(attrs.get("global_pool", "False")) in ("True", "1"):
+                    onnx_op = "GlobalMaxPool" if ptype == "max" else \
+                        "GlobalAveragePool"
+                    nodes_pb.append(_f_bytes(1, _node(onnx_op, ins, outs,
+                                                      name, {})))
+                else:
+                    kernel = tuple(_a(attrs, "kernel"))
+                    stride = tuple(_a(attrs, "stride", kernel) or kernel)
+                    pad = tuple(_a(attrs, "pad", (0,) * len(kernel)) or
+                                (0,) * len(kernel))
+                    onnx_op = "MaxPool" if ptype == "max" else "AveragePool"
+                    nodes_pb.append(_f_bytes(1, _node(
+                        onnx_op, ins, outs, name,
+                        {"kernel_shape": list(kernel),
+                         "strides": list(stride), "pads": list(pad) * 2})))
+            elif op in ("softmax", "SoftmaxOutput", "log_softmax"):
+                onnx_op = "LogSoftmax" if op == "log_softmax" else "Softmax"
+                nodes_pb.append(_f_bytes(1, _node(
+                    onnx_op, ins[:1], outs, name,
+                    {"axis": int(_a(attrs, "axis", -1) or -1)})))
+            elif op in ("Flatten", "flatten"):
+                nodes_pb.append(_f_bytes(1, _node("Flatten", ins, outs, name,
+                                                  {"axis": 1})))
+            elif op == "Dropout":
+                nodes_pb.append(_f_bytes(1, _node("Dropout", ins, outs, name,
+                                                  {})))
+            elif op in ("broadcast_add", "elemwise_add", "_plus"):
+                nodes_pb.append(_f_bytes(1, _node("Add", ins, outs, name, {})))
+            elif op in ("broadcast_sub", "elemwise_sub"):
+                nodes_pb.append(_f_bytes(1, _node("Sub", ins, outs, name, {})))
+            elif op in ("broadcast_mul", "elemwise_mul"):
+                nodes_pb.append(_f_bytes(1, _node("Mul", ins, outs, name, {})))
+            elif op in ("broadcast_div", "elemwise_div"):
+                nodes_pb.append(_f_bytes(1, _node("Div", ins, outs, name, {})))
+            elif op == "concat":
+                nodes_pb.append(_f_bytes(1, _node(
+                    "Concat", ins, outs, name,
+                    {"axis": int(_a(attrs, "dim", 1) or 1)})))
+            elif op in ("reshape", "Reshape"):
+                shape_name = name + "_shape"
+                shp = _np.asarray(_a(attrs, "shape"), _np.int64)
+                inits_pb.append(_f_bytes(5, _tensor(shape_name, shp)))
+                nodes_pb.append(_f_bytes(1, _node(
+                    "Reshape", [ins[0], shape_name], outs, name, {})))
+            elif op in ("transpose",):
+                axes = _a(attrs, "axes")
+                nodes_pb.append(_f_bytes(1, _node(
+                    "Transpose", ins, outs, name,
+                    {"perm": list(axes)} if axes else {})))
+            elif op == "relu":
+                nodes_pb.append(_f_bytes(1, _node("Relu", ins, outs, name, {})))
+            elif op == "sigmoid":
+                nodes_pb.append(_f_bytes(1, _node("Sigmoid", ins, outs, name,
+                                                  {})))
+            elif op == "tanh":
+                nodes_pb.append(_f_bytes(1, _node("Tanh", ins, outs, name, {})))
+            else:
+                raise MXNetError(
+                    "onnx export: op %r has no ONNX mapping yet (supported: "
+                    "FC/Conv/BN/Pool/activations/elemwise/concat/reshape/"
+                    "transpose/softmax/dropout/flatten)" % op)
 
-    _ref_sink = None
+    finally:
+        # the sink is module-global: ALWAYS detach it, even when
+        # an unsupported op raises mid-walk (and never leave a
+        # stale set for a concurrent/next export to pollute)
+        _ref_sink = None
     # a param may be skipped only if NO emitted node consumes it directly
     # (a weight shared between a flatten=False MatMul and any direct use
     # must still be stored); direct_refs was filled at _node-emission time

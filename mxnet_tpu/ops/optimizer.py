@@ -597,3 +597,94 @@ def _multi_mp_adamw_update(*arrays, lrs=None, wds=None, etas=None,
         new_w32 = w32 - etas[i] * upd
         outs.extend([new_w32.astype(w.dtype), new_m, new_v, new_w32])
     return tuple(outs)
+
+
+@register("multi_lans_update", aliases=["_multi_lans_update"],
+          differentiable=False, num_outputs=-1,
+          aux_writeback=lambda p: {k: v for i in range(
+              int(p.get("num_weights", 1)))
+              for k, v in ((3 * i, 4 * i), (3 * i + 1, 4 * i + 2),
+                           (3 * i + 2, 4 * i + 3))})
+def _multi_lans_update(*arrays, learning_rates=None, wds=None, beta1=0.9,
+                       beta2=0.999, epsilon=1e-6, t=1, bias_correction=True,
+                       lower_bound=-1.0, upper_bound=-1.0,
+                       clip_gradient=-1.0, num_weights=1):
+    """Fused LANS fleet (reference: src/operator/contrib/multi_lans.cc /
+    the LANS paper): per-layer trust ratio applied SEPARATELY to the
+    momentum and gradient terms, each INCLUDING the weight-decay
+    contribution; gradients are norm-normalized first.  Inputs
+    (w, g, mean, var)*N; learning_rates/wds are float tuples."""
+    lrs = _scalar_list(learning_rates, num_weights, 0.001)
+    wds_l = _scalar_list(wds, num_weights, 0.0)
+    outs = []
+    for i, (w, g, m, v) in enumerate(_multi_pairs(list(arrays), 4)):
+        w32 = w.astype(jnp.float32)
+        g32 = g.astype(jnp.float32)
+        gnorm = jnp.sqrt(jnp.sum(g32 * g32))
+        g32 = g32 / jnp.maximum(gnorm, 1e-12)        # LANS grad normalize
+        if clip_gradient is not None and clip_gradient > 0:
+            g32 = jnp.clip(g32, -clip_gradient, clip_gradient)
+        new_m = beta1 * m + (1.0 - beta1) * g32
+        new_v = beta2 * v + (1.0 - beta2) * g32 * g32
+        mh, vh = new_m, new_v
+        if bias_correction:
+            mh = mh / (1.0 - beta1 ** t)
+            vh = vh / (1.0 - beta2 ** t)
+        wnorm = jnp.sqrt(jnp.sum(w32 * w32))
+
+        def trust(upd):
+            unorm = jnp.sqrt(jnp.sum(upd * upd))
+            ratio = jnp.where((wnorm > 0) & (unorm > 0), wnorm / unorm, 1.0)
+            if lower_bound > 0:
+                ratio = jnp.maximum(ratio, lower_bound)
+            if upper_bound > 0:
+                ratio = jnp.minimum(ratio, upper_bound)
+            return ratio * upd
+        denom = jnp.sqrt(vh) + epsilon
+        upd = beta1 * trust(mh / denom + wds_l[i] * w32) +             (1.0 - beta1) * trust(g32 / denom + wds_l[i] * w32)
+        outs.extend([(w32 - lrs[i] * upd).astype(w.dtype), new_m, new_v])
+    return tuple(outs)
+
+
+@register("multi_mp_lans_update", aliases=["_multi_mp_lans_update"],
+          differentiable=False, num_outputs=-1,
+          aux_writeback=lambda p: {k: v for i in range(
+              int(p.get("num_weights", 1)))
+              for k, v in ((4 * i, 5 * i), (4 * i + 1, 5 * i + 2),
+                           (4 * i + 2, 5 * i + 3), (4 * i + 3, 5 * i + 4))})
+def _multi_mp_lans_update(*arrays, learning_rates=None, wds=None, beta1=0.9,
+                          beta2=0.999, epsilon=1e-6, t=1,
+                          bias_correction=True, lower_bound=-1.0,
+                          upper_bound=-1.0, clip_gradient=-1.0,
+                          num_weights=1):
+    """Mixed-precision LANS fleet ((w, g, mean, var, w32)*N)."""
+    lrs = _scalar_list(learning_rates, num_weights, 0.001)
+    wds_l = _scalar_list(wds, num_weights, 0.0)
+    outs = []
+    for i, (w, g, m, v, w32) in enumerate(_multi_pairs(list(arrays), 5)):
+        g32 = g.astype(jnp.float32)
+        gnorm = jnp.sqrt(jnp.sum(g32 * g32))
+        g32 = g32 / jnp.maximum(gnorm, 1e-12)
+        if clip_gradient is not None and clip_gradient > 0:
+            g32 = jnp.clip(g32, -clip_gradient, clip_gradient)
+        new_m = beta1 * m + (1.0 - beta1) * g32
+        new_v = beta2 * v + (1.0 - beta2) * g32 * g32
+        mh, vh = new_m, new_v
+        if bias_correction:
+            mh = mh / (1.0 - beta1 ** t)
+            vh = vh / (1.0 - beta2 ** t)
+        wnorm = jnp.sqrt(jnp.sum(w32 * w32))
+
+        def trust(upd):
+            unorm = jnp.sqrt(jnp.sum(upd * upd))
+            ratio = jnp.where((wnorm > 0) & (unorm > 0), wnorm / unorm, 1.0)
+            if lower_bound > 0:
+                ratio = jnp.maximum(ratio, lower_bound)
+            if upper_bound > 0:
+                ratio = jnp.minimum(ratio, upper_bound)
+            return ratio * upd
+        denom = jnp.sqrt(vh) + epsilon
+        upd = beta1 * trust(mh / denom + wds_l[i] * w32) +             (1.0 - beta1) * trust(g32 / denom + wds_l[i] * w32)
+        new_w32 = w32 - lrs[i] * upd
+        outs.extend([new_w32.astype(w.dtype), new_m, new_v, new_w32])
+    return tuple(outs)

@@ -887,6 +887,29 @@ SPECS.update({
 
 
 
+
+_MPLANS_W = f(4)
+
+
+def _lans_ref(w, g, m, v, lr, wd, beta1=0.9, beta2=0.999, eps=1e-6, t=1):
+    """NumPy LANS single step (the paper's Algorithm: normalized grad,
+    trust ratio on momentum AND gradient terms, each incl. weight decay)."""
+    g = g / max(np.sqrt(np.sum(g * g)), 1e-12)
+    m1 = beta1 * m + (1 - beta1) * g
+    v1 = beta2 * v + (1 - beta2) * g * g
+    mh = m1 / (1 - beta1 ** t)
+    vh = v1 / (1 - beta2 ** t)
+    wn = np.sqrt(np.sum(w * w))
+
+    def trust(u):
+        un = np.sqrt(np.sum(u * u))
+        return (wn / un if wn > 0 and un > 0 else 1.0) * u
+    d = np.sqrt(vh) + eps
+    upd = beta1 * trust(mh / d + wd * w) + \
+        (1 - beta1) * trust(g / d + wd * w)
+    return (w - lr * upd, m1, v1)
+
+
 _JPEG_FILE = None
 
 
@@ -940,6 +963,18 @@ SPECS.update({
         ref=lambda x: np.pad(x, ((1, 1), (2, 2), (0, 0))).astype(
             np.float32)),
     # fused adamw fleets
+    "multi_lans_update": S(
+        lambda: [f(4), f(4), np.zeros(4, np.float32),
+                 np.zeros(4, np.float32)],
+        {"learning_rates": (0.1,), "wds": (0.01,), "t": 1,
+         "num_weights": 1}, grad=False,
+        ref=lambda w, g, m, v: _lans_ref(w, g, m, v, 0.1, 0.01)),
+    "multi_mp_lans_update": S(
+        lambda: [_MPLANS_W.copy(), f(4), np.zeros(4, np.float32),
+                 np.zeros(4, np.float32), _MPLANS_W.astype(np.float32)],
+        {"learning_rates": (0.1,), "wds": (0.01,), "t": 1,
+         "num_weights": 1}, grad=False,
+        ref=lambda w, g, m, v, w32: _lans_ref(w32, g, m, v, 0.1, 0.01)),
     "multi_adamw_update": S(
         lambda: [f(4), f(4), f(4), fpos(4), f(3), f(3), f(3), fpos(3),
                  np.array(1.0, np.float32)],
@@ -1129,3 +1164,35 @@ def test_ste_identity_gradient():
         y.backward(nd.array(np.full((3, 4), 2.5, np.float32)))
         np.testing.assert_allclose(x.grad.asnumpy(),
                                    np.full((3, 4), 2.5), rtol=1e-6)
+
+
+def test_multi_lans_matches_reference():
+    """Fleet outputs are written back in place (visible return is empty),
+    so the in-place results must be compared explicitly against the numpy
+    LANS step — including a NONZERO weight decay inside both trust terms."""
+    w_np, g_np = f(4), f(4)
+    w = nd.array(w_np)
+    g = nd.array(g_np)
+    m = nd.array(np.zeros(4, np.float32))
+    v = nd.array(np.zeros(4, np.float32))
+    invoke("multi_lans_update", w, g, m, v,
+           learning_rates=(0.1,), wds=(0.01,), t=1, num_weights=1)
+    w_ref, m_ref, v_ref = _lans_ref(w_np, g_np, np.zeros(4, np.float32),
+                                    np.zeros(4, np.float32), 0.1, 0.01)
+    np.testing.assert_allclose(w.asnumpy(), w_ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(m.asnumpy(), m_ref, rtol=1e-5)
+    np.testing.assert_allclose(v.asnumpy(), v_ref, rtol=1e-5, atol=1e-9)
+
+    # mixed-precision variant: master weights drive the math
+    w2_np = f(4)
+    w2 = nd.array(w2_np.astype(np.float32))
+    g2_np = f(4)
+    g2 = nd.array(g2_np)
+    m2 = nd.array(np.zeros(4, np.float32))
+    v2 = nd.array(np.zeros(4, np.float32))
+    w32 = nd.array(w2_np.astype(np.float32))
+    invoke("multi_mp_lans_update", w2, g2, m2, v2, w32,
+           learning_rates=(0.1,), wds=(0.01,), t=1, num_weights=1)
+    wr, mr, vr = _lans_ref(w2_np, g2_np, np.zeros(4, np.float32),
+                           np.zeros(4, np.float32), 0.1, 0.01)
+    np.testing.assert_allclose(w32.asnumpy(), wr, rtol=1e-5, atol=1e-6)
