@@ -46,6 +46,7 @@ from . import io
 from . import image
 from . import symbol
 from . import symbol as sym
+from .symbol import AttrScope
 from . import contrib
 from . import initializer
 from . import initializer as init

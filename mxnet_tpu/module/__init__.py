@@ -341,10 +341,15 @@ class Module(BaseModule):
 
     # -- bind ---------------------------------------------------------------
     def bind(self, data_shapes, label_shapes=None, for_training=True,
-             inputs_need_grad=False, force_rebind=False, grad_req="write"):
-        """Allocate the executor (reference: Module.bind)."""
+             inputs_need_grad=False, force_rebind=False, grad_req="write",
+             shared_module=None, group2ctx=None):
+        """Allocate the executor (reference: Module.bind; ``group2ctx``
+        maps AttrScope(ctx_group=...) names to devices — manual model
+        parallelism, reference GraphExecutor PlaceDevice)."""
         if self.binded and not force_rebind:
             return
+        self._group2ctx = dict(group2ctx or {})
+        self._shared_module = shared_module
         self.for_training = for_training
         self._data_shapes = _as_descs(data_shapes)
         self._label_shapes = _as_descs(label_shapes)
@@ -354,21 +359,55 @@ class Module(BaseModule):
             **{k: v for k, v in feed.items()
                if k in self._exec_symbol.list_arguments()})
         arg_names = self._exec_symbol.list_arguments()
+        # group2ctx: allocate each arg on ITS group's device (the reference
+        # GraphExecutor PlaceDevice) so only activations cross boundaries
+        # per step, never the weights
+        node_ctx: Dict[str, Context] = {}
+        if self._group2ctx:
+            from .. import symbol as _sym_mod
+            for n in _sym_mod._topo(self._exec_symbol._heads):
+                if n.op == "null":
+                    grp = n.attrs.get("__ctx_group__")
+                    if grp in self._group2ctx:
+                        node_ctx[n.name] = self._group2ctx[grp]
         args: Dict[str, NDArray] = {}
         grads: Dict[str, NDArray] = {}
         for name, shape in zip(arg_names, arg_shapes):
-            args[name] = nd.zeros(shape, ctx=self._context)
+            args[name] = nd.zeros(shape,
+                                  ctx=node_ctx.get(name, self._context))
             wants_grad = (name in self._param_names and
                           name not in self._fixed_param_names) or \
                 (inputs_need_grad and name in self._data_names)
             if for_training and wants_grad:
-                grads[name] = nd.zeros(shape, ctx=self._context)
+                grads[name] = nd.zeros(shape,
+                                       ctx=node_ctx.get(name, self._context))
         self.inputs_need_grad = inputs_need_grad
         aux = {name: nd.zeros(shape, ctx=self._context)
                for name, shape in zip(self._aux_names, aux_shapes)}
         self._exec = self._exec_symbol.bind(
             self._context, args, grads,
-            grad_req if for_training else "null", aux)
+            grad_req if for_training else "null", aux,
+            group2ctx=self._group2ctx)
+        if self._shared_module is not None:
+            # reference semantics: share parameter (and grad) BUFFERS with
+            # the given bound module — one update serves both (the
+            # BucketingModule mechanism, by NDArray identity)
+            src = self._shared_module._exec
+            for pname in self._param_names:
+                if pname in src.arg_dict:
+                    if src.arg_dict[pname].shape != \
+                            self._exec.arg_dict[pname].shape:
+                        raise MXNetError(
+                            "shared_module: parameter %r shape mismatch"
+                            % pname)
+                    self._exec.arg_dict[pname] = src.arg_dict[pname]
+                    if pname in src.grad_dict and \
+                            pname in self._exec.grad_dict:
+                        self._exec.grad_dict[pname] = src.grad_dict[pname]
+            for aname in self._aux_names:
+                if aname in src.aux_dict:
+                    self._exec.aux_dict[aname] = src.aux_dict[aname]
+            self.params_initialized = self._shared_module.params_initialized
         self.binded = True
 
     # -- params -------------------------------------------------------------
@@ -481,6 +520,11 @@ class Module(BaseModule):
                 # named head whose label wasn't fed runs in inference mode
                 # rather than silently training on another head's labels
                 label = positional.pop(0)
+            if label is not None and isinstance(z, NDArray) \
+                    and label.context != z.context:
+                # group2ctx: the head may live on another device than the
+                # label feed — align (the reference's cross-device copy)
+                label = label.as_in_context(z.context)
             out, grad = fn(z, label, attrs)
             self._outputs.append(out)
             self._head_grads.append(grad)

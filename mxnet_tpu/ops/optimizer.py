@@ -608,7 +608,7 @@ def _multi_mp_adamw_update(*arrays, lrs=None, wds=None, etas=None,
 def _multi_lans_update(*arrays, learning_rates=None, wds=None, beta1=0.9,
                        beta2=0.999, epsilon=1e-6, t=1, bias_correction=True,
                        lower_bound=-1.0, upper_bound=-1.0,
-                       clip_gradient=-1.0, num_weights=1):
+                       clip_gradient=-1.0, rescale_grad=1.0, num_weights=1):
     """Fused LANS fleet (reference: src/operator/contrib/multi_lans.cc /
     the LANS paper): per-layer trust ratio applied SEPARATELY to the
     momentum and gradient terms, each INCLUDING the weight-decay
@@ -619,7 +619,9 @@ def _multi_lans_update(*arrays, learning_rates=None, wds=None, beta1=0.9,
     outs = []
     for i, (w, g, m, v) in enumerate(_multi_pairs(list(arrays), 4)):
         w32 = w.astype(jnp.float32)
-        g32 = g.astype(jnp.float32)
+        # rescale accepted for reference-signature parity; it cancels under
+        # the LANS norm-normalization below
+        g32 = g.astype(jnp.float32) * rescale_grad
         gnorm = jnp.sqrt(jnp.sum(g32 * g32))
         g32 = g32 / jnp.maximum(gnorm, 1e-12)        # LANS grad normalize
         if clip_gradient is not None and clip_gradient > 0:
@@ -656,13 +658,13 @@ def _multi_mp_lans_update(*arrays, learning_rates=None, wds=None, beta1=0.9,
                           beta2=0.999, epsilon=1e-6, t=1,
                           bias_correction=True, lower_bound=-1.0,
                           upper_bound=-1.0, clip_gradient=-1.0,
-                          num_weights=1):
+                          rescale_grad=1.0, num_weights=1):
     """Mixed-precision LANS fleet ((w, g, mean, var, w32)*N)."""
     lrs = _scalar_list(learning_rates, num_weights, 0.001)
     wds_l = _scalar_list(wds, num_weights, 0.0)
     outs = []
     for i, (w, g, m, v, w32) in enumerate(_multi_pairs(list(arrays), 5)):
-        g32 = g.astype(jnp.float32)
+        g32 = g.astype(jnp.float32) * rescale_grad  # cancels post-normalize
         gnorm = jnp.sqrt(jnp.sum(g32 * g32))
         g32 = g32 / jnp.maximum(gnorm, 1e-12)
         if clip_gradient is not None and clip_gradient > 0:

@@ -24,6 +24,7 @@ from __future__ import annotations
 import ast
 import json
 import numbers
+import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as _np
@@ -36,7 +37,8 @@ from .ops.registry import get_op, list_ops, cached_jit
 from .ndarray import ndarray as _nd_mod
 from .ndarray.ndarray import NDArray
 
-__all__ = ["Symbol", "Variable", "var", "Group", "load", "loads",
+__all__ = ["Symbol", "Variable", "var", "Group", "AttrScope",
+           "load", "loads",
            "evaluate", "symbol_json_from_block", "Executor"]
 
 _MXNET_VERSION = 20000  # era tag written into symbol.json attrs
@@ -276,8 +278,9 @@ class Symbol:
         return out if isinstance(out, list) else [out]
 
     def bind(self, ctx, args, args_grad=None, grad_req="write",
-             aux_states=None) -> "Executor":
-        return Executor(self, ctx, args, args_grad, grad_req, aux_states)
+             aux_states=None, group2ctx=None) -> "Executor":
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states,
+                        group2ctx=group2ctx)
 
     def simple_bind(self, ctx=None, grad_req="write", **shapes) -> "Executor":
         """Allocate arguments from shapes and bind (reference:
@@ -328,7 +331,9 @@ def _const(value) -> Symbol:
 def _make_op_symbol(op_name: str, inputs: List[Symbol],
                     params: Dict[str, Any], name: Optional[str] = None) -> Symbol:
     op = get_op(op_name)   # raises if unknown
-    attrs = {k: _attr_str(v) for k, v in params.items() if v is not None}
+    attrs = dict(AttrScope.current_attrs())
+    attrs.update({k: _attr_str(v) for k, v in params.items()
+                  if v is not None})
     in_heads: List[Tuple[_SymNode, int]] = []
     for s in inputs:
         if isinstance(s, numbers.Number):
@@ -351,8 +356,43 @@ def _make_op_symbol(op_name: str, inputs: List[Symbol],
     return Symbol([(node, i) for i in range(n_out)])
 
 
+class AttrScope:
+    """Scoped symbol attributes (reference: python/mxnet/attribute.py
+    class AttrScope) — most importantly ``ctx_group`` for manual model
+    parallelism: ``with mx.AttrScope(ctx_group='dev1'):`` stamps
+    ``__ctx_group__`` onto every node created inside, and
+    ``Module.bind(group2ctx={'dev1': ctx})`` / ``Executor`` place those
+    nodes' compute on the mapped device."""
+
+    _current = threading.local()
+
+    def __init__(self, **kwargs):
+        self._attrs = {"__%s__" % k: _attr_str(v)
+                       for k, v in kwargs.items() if v is not None}
+
+    @classmethod
+    def current_attrs(cls) -> Dict[str, str]:
+        stack = getattr(cls._current, "stack", None)
+        if not stack:
+            return {}
+        merged: Dict[str, str] = {}
+        for scope in stack:
+            merged.update(scope._attrs)
+        return merged
+
+    def __enter__(self):
+        if not hasattr(AttrScope._current, "stack"):
+            AttrScope._current.stack = []
+        AttrScope._current.stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        AttrScope._current.stack.pop()
+        return False
+
+
 def Variable(name: str, shape=None, dtype=None, **kwargs) -> Symbol:
-    attrs = {}
+    attrs = dict(AttrScope.current_attrs())
     if shape is not None:
         attrs["__shape__"] = _attr_str(tuple(shape))
     if dtype is not None:
@@ -412,27 +452,65 @@ def load(fname: str) -> Symbol:
 # execution: evaluate / Executor
 # ---------------------------------------------------------------------------
 
+def _cross_device(x: NDArray, tgt: Context) -> NDArray:
+    """Differentiable device transfer (reference: the _CrossDeviceCopy op
+    GraphExecutor inserts for group2ctx edges).  Forward device_puts to the
+    target; the vjp moves the cotangent back to the source device so the
+    tape stays connected across groups."""
+    from . import autograd
+    moved = jax.device_put(x._jax, tgt.jax_device)
+    if autograd.is_recording():
+        src_dev = x.context.jax_device
+
+        def vjp(ct):
+            return (jax.device_put(ct, src_dev),)
+        return autograd.record_custom(vjp, [x], moved, tgt,
+                                      name="_cross_device_copy")
+    return NDArray(moved, ctx=tgt)
+
+
 def evaluate(sym: Symbol, feeds: Dict[str, Any], params: Dict[str, Any],
-             ctx: Optional[Context] = None):
+             ctx: Optional[Context] = None, group2ctx=None):
     """Topo-order execution through the eager op registry (each node rides
-    the per-op jit cache; reference: GraphExecutor::RunOps role)."""
+    the per-op jit cache; reference: GraphExecutor::RunOps role).
+
+    ``group2ctx`` (reference: GraphExecutor's PlaceDevice over
+    ``__ctx_group__``): nodes stamped by ``AttrScope(ctx_group=...)`` run
+    on the mapped device; inputs crossing a group boundary are moved —
+    manual model parallelism."""
     ctx = ctx or current_context()
     values: Dict[int, List[NDArray]] = {}
     nodes = _topo(sym._heads)
+
+    def node_ctx(n):
+        if group2ctx:
+            grp = n.attrs.get("__ctx_group__")
+            if grp is not None and grp in group2ctx:
+                return group2ctx[grp]
+        return ctx
+
     for n in nodes:
+        tgt = node_ctx(n)
         if n.op == "null":
             v = feeds.get(n.name, params.get(n.name))
             if v is None:
                 raise MXNetError("evaluate: missing value for argument %r"
                                  % n.name)
             if not isinstance(v, NDArray):
-                v = _nd_mod.array(v, ctx=ctx)
+                v = _nd_mod.array(v, ctx=tgt)
             values[id(n)] = [v]
         elif n.op == "_const":
             values[id(n)] = [_nd_mod.array(
-                _attr_parse(n.attrs["value"]), ctx=ctx)]
+                _attr_parse(n.attrs["value"]), ctx=tgt)]
         else:
             ins = [values[id(i)][idx] for i, idx in n.inputs]
+            if group2ctx:
+                # cross-group edges become device transfers (the
+                # reference inserts _CrossDeviceCopy nodes here); the
+                # transfer must be ON THE TAPE with a device-moving vjp or
+                # gradients die at every group boundary
+                ins = [_cross_device(x, tgt) if isinstance(x, NDArray)
+                       and x.context != tgt else x for x in ins]
             kw = {k: _attr_parse(v) for k, v in n.attrs.items()
                   if not k.startswith("__")}
             out = _nd_mod.invoke(n.op, *ins, **kw)
@@ -528,7 +606,7 @@ class Executor:
     src/executor/graph_executor.cc — memory planning here is XLA's job)."""
 
     def __init__(self, sym: Symbol, ctx, args, args_grad=None,
-                 grad_req="write", aux_states=None):
+                 grad_req="write", aux_states=None, group2ctx=None):
         self._sym = sym
         self._ctx = ctx or current_context()
         if isinstance(args, (list, tuple)):
@@ -539,6 +617,7 @@ class Executor:
         self.grad_dict: Dict[str, NDArray] = dict(args_grad or {})
         self.aux_dict: Dict[str, NDArray] = dict(aux_states or {})
         self._grad_req = grad_req
+        self._group2ctx = dict(group2ctx or {})
         self.outputs: List[NDArray] = []
 
     def forward(self, is_train: bool = False, **feeds):
@@ -555,9 +634,11 @@ class Executor:
                 if name in self.grad_dict:
                     arr.attach_grad(self._grad_req)
             with autograd.record():
-                out = evaluate(self._sym, vals, {}, ctx=self._ctx)
+                out = evaluate(self._sym, vals, {}, ctx=self._ctx,
+                               group2ctx=self._group2ctx)
         else:
-            out = evaluate(self._sym, vals, {}, ctx=self._ctx)
+            out = evaluate(self._sym, vals, {}, ctx=self._ctx,
+                           group2ctx=self._group2ctx)
         self.outputs = out if isinstance(out, list) else [out]
         return self.outputs
 

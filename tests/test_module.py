@@ -408,3 +408,72 @@ def test_bucketing_module_trains_to_lower_loss():
         if epoch >= 30:
             metric.update([b.label[0]], bm.get_outputs())
     assert metric.get()[1] > 0.6, metric.get()
+
+
+def test_module_group2ctx_trains_across_devices():
+    """Manual model parallelism through Module.bind(group2ctx=...): the two
+    layer groups execute on different fake-mesh devices and a training
+    loss with the SoftmaxOutput head still descends (the head rule aligns
+    the label onto the head's device)."""
+    with mx.AttrScope(ctx_group="a"):
+        data = sym.Variable("data")
+        h = sym.FullyConnected(data, sym.Variable("l1_weight"),
+                               sym.Variable("l1_bias"), num_hidden=16,
+                               name="l1")
+        h = sym.Activation(h, act_type="relu")
+    with mx.AttrScope(ctx_group="b"):
+        o = sym.FullyConnected(h, sym.Variable("l2_weight"),
+                               sym.Variable("l2_bias"), num_hidden=3,
+                               name="l2")
+        o = sym.SoftmaxOutput(o, sym.Variable("softmax_label"))
+    mod = Module(o, context=mx.cpu(0))
+    mod.bind(data_shapes=[("data", (8, 6))],
+             label_shapes=[("softmax_label", (8,))],
+             group2ctx={"a": mx.cpu(0), "b": mx.cpu(1)})
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.3),))
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 6).astype(np.float32)
+    y = x[:, :3].argmax(axis=1).astype(np.float32)  # learnable rule
+    metric = mx.metric.Accuracy()
+    for epoch in range(30):
+        batch = mio.DataBatch(data=[mx.nd.array(x)],
+                              label=[mx.nd.array(y)])
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    mod.forward(mio.DataBatch(data=[mx.nd.array(x)],
+                              label=[mx.nd.array(y)]), is_train=False)
+    metric.update([mx.nd.array(y)], mod.get_outputs())
+    assert metric.get()[1] > 0.8, metric.get()
+    # the head really lives on device 1
+    assert mod.get_outputs()[0].context == mx.cpu(1)
+
+
+def test_module_shared_module_shares_buffers():
+    """bind(shared_module=...) must share parameter buffers by identity
+    (reference Module semantics): an update through one module is visible
+    through the other."""
+    def make_sym():
+        d = sym.Variable("data")
+        return sym.LinearRegressionOutput(
+            sym.FullyConnected(d, sym.Variable("fc_weight"),
+                               sym.Variable("fc_bias"), num_hidden=2,
+                               name="fc"),
+            sym.Variable("softmax_label"))
+    master = Module(make_sym(), context=mx.cpu())
+    master.bind(data_shapes=[("data", (4, 3))],
+                label_shapes=[("softmax_label", (4, 2))])
+    master.init_params(mx.init.Normal(1.0))
+    child = Module(make_sym(), context=mx.cpu())
+    child.bind(data_shapes=[("data", (2, 3))],
+               label_shapes=[("softmax_label", (2, 2))],
+               shared_module=master)
+    assert child.params_initialized
+    assert child._exec.arg_dict["fc_weight"] is \
+        master._exec.arg_dict["fc_weight"]
+    # mutate through master; child sees it
+    master._exec.arg_dict["fc_weight"]._set_jax(
+        master._exec.arg_dict["fc_weight"]._jax * 0 + 5.0)
+    assert float(child._exec.arg_dict["fc_weight"].asnumpy()[0, 0]) == 5.0
