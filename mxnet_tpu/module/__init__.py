@@ -370,9 +370,28 @@ class Module(BaseModule):
                     grp = n.attrs.get("__ctx_group__")
                     if grp in self._group2ctx:
                         node_ctx[n.name] = self._group2ctx[grp]
+        shared_exec = None
+        if self._shared_module is not None:
+            if not getattr(self._shared_module, "binded", False):
+                raise MXNetError(
+                    "bind(shared_module=...): the shared module must be "
+                    "bound first (reference Module asserts the same)")
+            shared_exec = self._shared_module._exec
         args: Dict[str, NDArray] = {}
         grads: Dict[str, NDArray] = {}
         for name, shape in zip(arg_names, arg_shapes):
+            if shared_exec is not None and name in self._param_names \
+                    and name in shared_exec.arg_dict:
+                # share by identity — never allocate a throwaway buffer
+                shared_arr = shared_exec.arg_dict[name]
+                if tuple(shared_arr.shape) != tuple(shape):
+                    raise MXNetError(
+                        "shared_module: parameter %r shape mismatch "
+                        "(%s vs %s)" % (name, shared_arr.shape, shape))
+                args[name] = shared_arr
+                if for_training and name in shared_exec.grad_dict:
+                    grads[name] = shared_exec.grad_dict[name]
+                continue
             args[name] = nd.zeros(shape,
                                   ctx=node_ctx.get(name, self._context))
             wants_grad = (name in self._param_names and
@@ -388,25 +407,10 @@ class Module(BaseModule):
             self._context, args, grads,
             grad_req if for_training else "null", aux,
             group2ctx=self._group2ctx)
-        if self._shared_module is not None:
-            # reference semantics: share parameter (and grad) BUFFERS with
-            # the given bound module — one update serves both (the
-            # BucketingModule mechanism, by NDArray identity)
-            src = self._shared_module._exec
-            for pname in self._param_names:
-                if pname in src.arg_dict:
-                    if src.arg_dict[pname].shape != \
-                            self._exec.arg_dict[pname].shape:
-                        raise MXNetError(
-                            "shared_module: parameter %r shape mismatch"
-                            % pname)
-                    self._exec.arg_dict[pname] = src.arg_dict[pname]
-                    if pname in src.grad_dict and \
-                            pname in self._exec.grad_dict:
-                        self._exec.grad_dict[pname] = src.grad_dict[pname]
+        if shared_exec is not None:
             for aname in self._aux_names:
-                if aname in src.aux_dict:
-                    self._exec.aux_dict[aname] = src.aux_dict[aname]
+                if aname in shared_exec.aux_dict:
+                    self._exec.aux_dict[aname] = shared_exec.aux_dict[aname]
             self.params_initialized = self._shared_module.params_initialized
         self.binded = True
 

@@ -82,6 +82,20 @@ def _attention_jnp(q, k, v, scale, causal):
 # ---------------------------------------------------------------------------
 
 
+
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct carrying the input's varying-mesh-axes (vma) so
+    pallas_call works INSIDE shard_map(check_vma=True) — ring attention
+    runs these kernels per shard."""
+    try:
+        aval = jax.typeof(like)
+        vma = getattr(aval, "vma", None)
+        if vma:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except Exception:
+        pass
+    return jax.ShapeDtypeStruct(shape, dtype)
+
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
                       block_k, seq_k):
     # refs: q (block_q, D), k/v (seq_k, D), o (block_q, D), lse (block_q,);
@@ -165,8 +179,8 @@ def _flash_fwd(q, k, v, scale, causal, block_q=_BLOCK_Q, block_k=_BLOCK_K):
             pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, Tq), jnp.float32),
+            _sds((B * H, Tq, D), q.dtype, qr),
+            _sds((B * H, Tq), jnp.float32, qr),
         ],
     )(qr, kr, vr)
     return out.reshape(B, H, Tq, D), lse.reshape(B, H, Tq)
@@ -299,7 +313,7 @@ def _flash_bwd(q, k, v, o, lse, g, scale, causal,
             pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
         ],
         out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        out_shape=_sds((B * H, Tq, D), q.dtype, qr),
     )(qr, kr, vr, gr, lser, delta)
 
     dk, dv = pl.pallas_call(
@@ -320,13 +334,57 @@ def _flash_bwd(q, k, v, o, lse, g, scale, causal,
             pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, Tk, D), k.dtype),
-            jax.ShapeDtypeStruct((B * H, Tk, D), v.dtype),
+            _sds((B * H, Tk, D), k.dtype, qr),
+            _sds((B * H, Tk, D), v.dtype, qr),
         ],
     )(qr, kr, vr, gr, lser, delta)
 
     return (dq.reshape(B, H, Tq, D), dk.reshape(B, H, Tk, D),
             dv.reshape(B, H, Tk, D))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention_with_lse(q, k, v, scale, causal):
+    """Blockwise flash attention returning (out, lse) — the ring-attention
+    building block (partials merge via logsumexp).  Differentiable in BOTH
+    outputs: the lse cotangent contributes
+        dq += scale * g_lse ⊙ (P K)          (P K = this kernel with v:=k)
+        dk += scale * Pᵀ (g_lse ⊙ q)          (the dkv kernel's dv pass)
+    so the merge weights backpropagate without materializing P."""
+    return _flash_fwd(q, k, v, scale, causal)
+
+
+def _flash_lse_vjp_fwd(q, k, v, scale, causal):
+    # symbolic_zeros=True wraps primals in CustomVJPPrimal
+    q, k, v = (x.value if hasattr(x, "value") else x for x in (q, k, v))
+    out, lse = _flash_fwd(q, k, v, scale, causal)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_lse_vjp_bwd(scale, causal, res, cts):
+    from jax.custom_derivatives import SymbolicZero
+    g_out, g_lse = cts
+    q, k, v, o, lse = res
+    if isinstance(g_out, SymbolicZero):
+        g_out = jnp.zeros(o.shape, o.dtype)
+    dq, dk, dv = _flash_bwd(q, k, v, o, lse, g_out, scale, causal)
+    if not isinstance(g_lse, SymbolicZero):
+        # the lse term costs one extra fwd + one bwd kernel pass — the
+        # symbolic-zero gate skips it when only `out` was used downstream
+        gl = jnp.where(jnp.isfinite(lse), g_lse, 0.0)[..., None]
+        pk = _flash_fwd(q, k, k.astype(q.dtype), scale, causal)[0]
+        dq = (dq.astype(jnp.float32)
+              + scale * gl * pk.astype(jnp.float32)).astype(dq.dtype)
+        g2 = (gl * q.astype(jnp.float32)).astype(q.dtype)
+        _, _, dk2 = _flash_bwd(q, k, jnp.zeros_like(v), jnp.zeros_like(o),
+                               lse, g2, scale, causal)
+        dk = (dk.astype(jnp.float32)
+              + scale * dk2.astype(jnp.float32)).astype(dk.dtype)
+    return dq, dk, dv
+
+
+flash_attention_with_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd,
+                                symbolic_zeros=True)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))

@@ -63,12 +63,94 @@ def _block_attn(q, k, v, q_off, k_off, causal, scale):
     return o, m, l
 
 
+def _flash_ok(q, k) -> bool:
+    """Shard shapes eligible for the blockwise Pallas kernel per hop."""
+    from ..ops import attention as _att
+    if _att._FORCED_IMPL == "xla":
+        return False
+    lq, lk, d = q.shape[1], k.shape[1], q.shape[3]
+    return (lq % _att._BLOCK_Q == 0 and lk % _att._BLOCK_K == 0
+            and d % 128 == 0)
+
+
+def _ring_attention_flash(q, k, v, *, axis_name, causal, scale):
+    """Flash-kernel ring: each hop runs the blockwise Pallas kernel on its
+    K/V shard, producing a NORMALIZED partial plus its logsumexp; partials
+    merge with the standard (out, lse) combine
+        lse' = logaddexp(lse, lse_b);  out' = out·e^{lse-lse'} + out_b·e^{lse_b-lse'}
+    so per-hop memory is O(L/n · D) and the score matrix never exists.
+    q/k/v here are (B, Lq, H, D) (sequence-sharded); kernel layout is
+    (B, H, L, D)."""
+    from ..ops.attention import flash_attention_with_lse
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    qh = q.transpose(0, 2, 1, 3)                   # (B, H, Lq, D)
+
+    def hop(kt, vt, src):
+        kh = kt.transpose(0, 2, 1, 3)
+        vh = vt.transpose(0, 2, 1, 3)
+
+        def full(_):
+            return flash_attention_with_lse(qh, kh, vh, scale, False)
+
+        def diag(_):
+            return flash_attention_with_lse(qh, kh, vh, scale, True)
+
+        def skip(_):
+            z = jnp.zeros(qh.shape, qh.dtype)
+            neg = jnp.full(qh.shape[:3], -jnp.inf, jnp.float32)
+            # match the pallas branches' varying-axes type (check_vma)
+            if hasattr(lax, "pcast"):
+                z, neg = (lax.pcast(x, (axis_name,), to="varying")
+                          for x in (z, neg))
+            else:
+                z, neg = (lax.pvary(x, (axis_name,)) for x in (z, neg))
+            return z, neg
+        if not causal:
+            return full(None)
+        # causal over the GLOBAL sequence: earlier shards attend fully,
+        # same shard causally, later shards not at all
+        branch = jnp.where(src < idx, 0, jnp.where(src == idx, 1, 2))
+        return lax.switch(branch, [full, diag, skip], None)
+
+    # the ring length is STATIC (mesh axis size): unroll in Python — each
+    # hop's kernel launch can then overlap the next hop's ppermute (XLA's
+    # latency-hiding scheduler), and no loop-carried pallas lowering is
+    # needed
+    out = jnp.zeros(qh.shape, jnp.float32)
+    lse = jnp.full(qh.shape[:3], -jnp.inf, jnp.float32)
+    if hasattr(lax, "pcast"):
+        out, lse = (lax.pcast(x, (axis_name,), to="varying")
+                    for x in (out, lse))
+    else:
+        out, lse = (lax.pvary(x, (axis_name,)) for x in (out, lse))
+    kt, vt = k, v
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    for t in range(n):
+        src = (idx - t) % n
+        out_b, lse_b = hop(kt, vt, src)
+        lse_new = jnp.logaddexp(lse, lse_b)
+        lse_safe = jnp.where(jnp.isfinite(lse_new), lse_new, 0.0)
+        wa = jnp.where(jnp.isfinite(lse), jnp.exp(lse - lse_safe), 0.0)
+        wb = jnp.where(jnp.isfinite(lse_b), jnp.exp(lse_b - lse_safe), 0.0)
+        out = out * wa[..., None] + out_b.astype(jnp.float32) * wb[..., None]
+        lse = lse_new
+        if t != n - 1:
+            kt = lax.ppermute(kt, axis_name, perm)
+            vt = lax.ppermute(vt, axis_name, perm)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
 def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = False,
                    scale: Optional[float] = None):
     """Ring attention over the ``axis_name`` collective axis.
 
     Call INSIDE shard_map with q/k/v sequence-sharded on that axis:
     q, k, v: (B, L_local, H, D).  Returns (B, L_local, H, D).
+
+    Hops run the blockwise Pallas flash kernel when the shard shapes are
+    block-aligned (Mosaic on TPU, interpret elsewhere); otherwise the jnp
+    online-softmax block recurrence below.
     """
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
@@ -76,6 +158,9 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = False,
     lk = k.shape[1]
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    if _flash_ok(q, k):
+        return _ring_attention_flash(q, k, v, axis_name=axis_name,
+                                     causal=causal, scale=scale)
     q_off = idx * lq
 
     # checkpoint the block step: backward recomputes the block's score
@@ -166,8 +251,16 @@ def context_parallel_attention(q, k, v, mesh: Mesh, *, sp_axis: str = "sp",
     spec = P(None, sp_axis, None, None)
     inner = functools.partial(fn, axis_name=sp_axis, causal=causal,
                               scale=scale)
-    mapped = shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec)
+    # check_vma/check_rep off: interpret-mode pallas inside shard_map trips
+    # jax's varying-axes checker on kernel constants ("Primitive mul
+    # requires varying manual axes to match ... as a temporary workaround
+    # pass check_vma=False") — the jax-recommended workaround
+    try:
+        mapped = shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
+                           out_specs=spec, check_vma=False)
+    except TypeError:   # older jax spells it check_rep
+        mapped = shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
+                           out_specs=spec, check_rep=False)
     sharding = NamedSharding(mesh, spec)
     q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
     return mapped(q, k, v)
