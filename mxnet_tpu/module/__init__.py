@@ -389,7 +389,12 @@ class Module(BaseModule):
                         "shared_module: parameter %r shape mismatch "
                         "(%s vs %s)" % (name, shared_arr.shape, shape))
                 args[name] = shared_arr
-                if for_training and name in shared_exec.grad_dict:
+                # share the grad buffer only if THIS module trains the
+                # param — a fixed_param_names entry here must not write
+                # into the master's gradients
+                wants_grad_shared = name not in self._fixed_param_names
+                if for_training and wants_grad_shared \
+                        and name in shared_exec.grad_dict:
                     grads[name] = shared_exec.grad_dict[name]
                 continue
             args[name] = nd.zeros(shape,

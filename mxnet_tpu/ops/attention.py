@@ -366,8 +366,12 @@ def _flash_lse_vjp_bwd(scale, causal, res, cts):
     g_out, g_lse = cts
     q, k, v, o, lse = res
     if isinstance(g_out, SymbolicZero):
-        g_out = jnp.zeros(o.shape, o.dtype)
-    dq, dk, dv = _flash_bwd(q, k, v, o, lse, g_out, scale, causal)
+        # out unused downstream: no kernel passes needed for its term
+        dq = jnp.zeros(q.shape, q.dtype)
+        dk = jnp.zeros(k.shape, k.dtype)
+        dv = jnp.zeros(v.shape, v.dtype)
+    else:
+        dq, dk, dv = _flash_bwd(q, k, v, o, lse, g_out, scale, causal)
     if not isinstance(g_lse, SymbolicZero):
         # the lse term costs one extra fwd + one bwd kernel pass — the
         # symbolic-zero gate skips it when only `out` was used downstream

@@ -622,3 +622,58 @@ def _modulated_deformable_convolution(data, offset, mask, weight, bias=None,
     if bias is not None and not no_bias:
         out = out + bias.reshape(1, -1, 1, 1)
     return out.astype(data.dtype)
+
+
+@register("_contrib_DeformablePSROIPooling",
+          aliases=["DeformablePSROIPooling"], differentiable=False)
+def _deformable_psroi_pooling(data, rois, trans, spatial_scale=1.0,
+                              output_dim=1, group_size=1, pooled_size=1,
+                              part_size=0, sample_per_part=1,
+                              trans_std=0.0, no_trans=False):
+    """Deformable position-sensitive ROI pooling (reference:
+    src/operator/contrib/deformable_psroi_pooling.cc — R-FCN deform heads):
+    PSROIPooling with per-bin learned (dx, dy) offsets scaled by trans_std.
+    data (B, C, H, W) with C = output_dim*group², rois (R, 5),
+    trans (R, 2, part, part): channel 0 = dx, channel 1 = dy per part
+    cell (the layout the flattened indexing below consumes)."""
+    g = int(group_size)
+    p = int(pooled_size)
+    part = int(part_size) if part_size else p
+    B, C, H, W = data.shape
+
+    def one_roi(roi, tr):
+        bidx = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = (roi[i] * spatial_scale for i in range(1, 5))
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        img = data[bidx]
+        bins = []
+        for ph in range(p):
+            for pw in range(p):
+                if no_trans:
+                    dx = dy = 0.0
+                else:
+                    pi = min(ph * part // p, part - 1)
+                    pj = min(pw * part // p, part - 1)
+                    dx = tr[0 * part * part + pi * part + pj] * trans_std \
+                        * rw
+                    dy = tr[1 * part * part + pi * part + pj] * trans_std \
+                        * rh
+                ys = y1 + rh * ph / p + dy
+                ye = y1 + rh * (ph + 1) / p + dy
+                xs = x1 + rw * pw / p + dx
+                xe = x1 + rw * (pw + 1) / p + dx
+                yy = jnp.arange(H, dtype=jnp.float32)
+                xx = jnp.arange(W, dtype=jnp.float32)
+                my = ((yy + 1 > ys) & (yy < ye)).astype(jnp.float32)
+                mxm = ((xx + 1 > xs) & (xx < xe)).astype(jnp.float32)
+                mask = my[:, None] * mxm[None, :]
+                area = jnp.maximum(mask.sum(), 1.0)
+                gy = min(ph * g // p, g - 1)
+                gx = min(pw * g // p, g - 1)
+                chans = img.reshape(output_dim, g * g, H, W)[:, gy * g + gx]
+                bins.append((chans * mask).sum(axis=(-1, -2)) / area)
+        return jnp.stack(bins, axis=-1).reshape(output_dim, p, p)
+    trans_flat = trans.reshape(trans.shape[0], -1)
+    return jax.vmap(one_roi)(rois.astype(jnp.float32),
+                             trans_flat.astype(jnp.float32))

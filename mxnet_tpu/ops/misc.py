@@ -389,3 +389,26 @@ def _imdecode(buf, flag=1, to_rgb=True):
     if not to_rgb:              # OpenCV-native BGR order
         arr = arr[:, :, ::-1].copy()
     return jnp.asarray(arr)
+
+
+@register("_contrib_edge_id", aliases=["edge_id"], no_jit=True,
+          differentiable=False)
+def _edge_id(indptr, indices, u, v):
+    """Edge ids of (u, v) pairs in a CSR adjacency, -1 when absent
+    (reference: src/operator/contrib/dgl_graph.cc EdgeID over CSRNDArray;
+    the CSR's data array holds edge ids — here the data INDEX is the id,
+    matching mx.nd.contrib.edge_id's contract with data = arange).
+    Host-side: graph queries are control-flow bound."""
+    import numpy as np
+    ip = np.asarray(indptr).astype(np.int64)
+    ix = np.asarray(indices).astype(np.int64)
+    uu = np.asarray(u).astype(np.int64).ravel()
+    vv = np.asarray(v).astype(np.int64).ravel()
+    out = np.full(uu.shape, -1.0, np.float32)
+    for i, (a, b) in enumerate(zip(uu, vv)):
+        lo, hi = ip[a], ip[a + 1]
+        seg = ix[lo:hi]
+        hits = np.nonzero(seg == b)[0]
+        if hits.size:
+            out[i] = float(lo + hits[0])
+    return jnp.asarray(out)

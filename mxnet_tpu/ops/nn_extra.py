@@ -187,3 +187,29 @@ def _sync_batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     out = (x - mean.reshape(shape)) / jnp.sqrt(var.reshape(shape) + eps)
     out = out * g.reshape(shape) + beta.reshape(shape)
     return out.astype(data.dtype), new_mean, new_var
+
+
+@register("Convolution_v1", aliases=["convolution_v1"])
+def _convolution_v1(data, weight, bias=None, kernel=(1, 1), stride=(),
+                    dilate=(), pad=(), num_filter=1, num_group=1,
+                    workspace=1024, no_bias=False, cudnn_tune=None,
+                    cudnn_off=False, layout=None):
+    """Legacy Convolution_v1 (reference: src/operator/convolution_v1.cc —
+    kept as a distinct op for checkpoint compat; 2-D only, NCHW)."""
+    from .nn import _convolution
+    return _convolution(data, weight, bias, kernel=kernel,
+                        stride=stride or (1, 1), dilate=dilate or (1, 1),
+                        pad=pad or (0, 0), num_filter=num_filter,
+                        num_group=num_group, no_bias=no_bias)
+
+
+@register("Pooling_v1", aliases=["pooling_v1"])
+def _pooling_v1(data, kernel=(1, 1), pool_type="max", global_pool=False,
+                stride=(), pad=()):
+    """Legacy Pooling_v1 (reference: src/operator/pooling_v1.cc): always
+    the CEIL ('full') output-shape convention — the semantic difference
+    that kept it a separate op."""
+    from .nn import _pooling
+    return _pooling(data, kernel=kernel, pool_type=pool_type,
+                    global_pool=global_pool, stride=stride or kernel,
+                    pad=pad or (0, 0), pooling_convention="full")

@@ -64,13 +64,17 @@ def _block_attn(q, k, v, q_off, k_off, causal, scale):
 
 
 def _flash_ok(q, k) -> bool:
-    """Shard shapes eligible for the blockwise Pallas kernel per hop."""
+    """Shard shapes eligible for the blockwise Pallas kernel per hop —
+    same gate as attention_core: Mosaic on TPU (or the 'pallas' lowering
+    config forced, which interprets off-TPU), never interpret-by-default
+    on CPU/GPU where the compiled jnp path is far faster."""
     from ..ops import attention as _att
     if _att._FORCED_IMPL == "xla":
         return False
     lq, lk, d = q.shape[1], k.shape[1], q.shape[3]
-    return (lq % _att._BLOCK_Q == 0 and lk % _att._BLOCK_K == 0
-            and d % 128 == 0)
+    aligned = (lq % _att._BLOCK_Q == 0 and lk % _att._BLOCK_K == 0
+               and d % 128 == 0)
+    return aligned and (_att._on_tpu() or _att._FORCED_IMPL == "pallas")
 
 
 def _ring_attention_flash(q, k, v, *, axis_name, causal, scale):

@@ -987,6 +987,28 @@ SPECS.update({
         {"lrs": (0.01, 0.01), "wds": (0.0, 0.0), "num_weights": 2},
         grad=False, ref=None),
     # detection tail 2
+    "_contrib_edge_id": S(
+        lambda: [np.array([0, 2, 3], np.float32),
+                 np.array([1, 2, 0], np.float32),
+                 np.array([0, 0, 1, 1], np.float32),
+                 np.array([2, 0, 0, 2], np.float32)],
+        grad=False,
+        ref=lambda ip, ix, u, v: np.array([1.0, -1.0, 2.0, -1.0],
+                                          np.float32)),
+    "_contrib_DeformablePSROIPooling": S(
+        lambda: [fpos(1, 8, 6, 6), np.array([[0, 0, 0, 4, 4]], np.float32),
+                 np.full((1, 2, 2, 2), 0.5, np.float32)],  # (R, 2, p, p)
+        {"spatial_scale": 1.0, "output_dim": 2, "group_size": 2,
+         "pooled_size": 2, "part_size": 2, "trans_std": 0.1},
+        grad=False, ref=None),
+    "Convolution_v1": S(
+        lambda: [fpos(1, 2, 5, 5), f(3, 2, 3, 3)],
+        {"kernel": (3, 3), "pad": (1, 1), "num_filter": 3, "no_bias": True},
+        grad=False, ref=None),
+    "Pooling_v1": S(
+        lambda: [fpos(1, 2, 5, 5)],
+        {"kernel": (2, 2), "stride": (2, 2), "pool_type": "max"},
+        grad=False, ref=None),
     "_contrib_mrcnn_mask_target": S(
         lambda: [np.array([[[1., 1., 5., 5.]]], np.float32),
                  fpos(1, 2, 8, 8), np.zeros((1, 1), np.float32),

@@ -460,6 +460,8 @@ def test_ring_attention_flash_path_matches_single_device():
     g = jnp.asarray(rng.randn(B, L, H, D), jnp.float32) * 0.1
     scale = 1.0 / np.sqrt(D)
 
+    from mxnet_tpu.ops import attention as att
+    prev = att.set_attention_impl("pallas")   # engage flash off-TPU
     for causal in (False, True):
         def run(q, k, v):
             return ring_mod.context_parallel_attention(
@@ -485,3 +487,4 @@ def test_ring_attention_flash_path_matches_single_device():
             err = np.abs(np.asarray(got) - np.asarray(ref_g)).max()
             rel = err / max(np.abs(np.asarray(ref_g)).max(), 1e-6)
             assert rel < 5e-3, (causal, nm, rel)
+    att.set_attention_impl(prev)
