@@ -204,8 +204,29 @@ class TrainStep:
         self.opt_state = state["opt_state"]
 
     def write_back(self, block):
-        """Copy trained params back into the Block's Parameters."""
+        """Copy trained params back into the Block's Parameters.
+
+        Step params are GLOBAL (mesh-sharded/replicated) arrays; the
+        block's NDArrays are single-device — materialize the local copy
+        (replicated: shard 0 IS the value; sharded: gather first) and
+        re-home it, or later eager ops mix single- and multi-device
+        operands and fail."""
         params = block.collect_params()
         for name, v in self.params.items():
             arr = params[name].data()
-            arr._set_jax(jnp.asarray(v).astype(arr.dtype))
+            if hasattr(v, "sharding") and not isinstance(
+                    v.sharding, jax.sharding.SingleDeviceSharding):
+                if getattr(v.sharding, "is_fully_replicated", False):
+                    local = v.addressable_data(0)
+                elif jax.process_count() > 1:
+                    # spans non-addressable devices: multihost gather
+                    from jax.experimental import multihost_utils
+                    local = multihost_utils.process_allgather(
+                        v, tiled=True)
+                else:
+                    local = jax.device_get(v)      # gather sharded param
+            else:
+                local = v
+            arr._set_jax(jax.device_put(jnp.asarray(local),
+                                        arr.context.jax_device)
+                         .astype(arr.dtype))

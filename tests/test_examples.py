@@ -1,0 +1,46 @@
+"""The examples/ scripts must stay runnable offline (reference pattern:
+example/ scripts are smoke-run in CI)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, *args, timeout=600, cwd=None):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    # pin explicitly: in the MX_TEST_CTX=tpu lane the conftest does NOT
+    # set these, and an unpinned example subprocess would hang on a
+    # wedged tunnel until its timeout
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MX_FORCE_CPU"] = "1"
+    r = subprocess.run([sys.executable,
+                        os.path.join(REPO, "examples", script), *args],
+                       capture_output=True, text=True, timeout=timeout,
+                       env=env, cwd=cwd or REPO)
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    return r.stdout
+
+
+def test_mnist_example():
+    out = _run("train_mnist_gluon.py", "--epochs", "1", "--hybridize")
+    assert "final test accuracy" in out
+
+
+def test_resnet_dp_example(tmp_path):
+    out = _run("train_resnet_dp.py", "--steps", "2", "--batch-size", "8",
+               "--image-size", "32", "--model", "resnet18_v1",
+               cwd=str(tmp_path))
+    assert "step 1 loss" in out
+    for f in ("resnet_dp_trained-symbol.json",
+              "resnet_dp_trained-0000.params"):
+        assert os.path.exists(os.path.join(str(tmp_path), f))
+
+
+def test_ssd_example():
+    out = _run("train_ssd.py", "--epochs", "1")
+    assert "mAP07" in out
