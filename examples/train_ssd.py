@@ -87,8 +87,9 @@ def main():
     for batch in it:
         anchors, cls_preds, box_preds = net(
             batch.data[0].as_in_context(mx.tpu(0)) / 255.0)
-        metric.update([batch.label[0]],
-                      [net.detect(anchors, cls_preds, box_preds)])
+        dets = net.detect(anchors, cls_preds, box_preds)
+        n = batch.data[0].shape[0] - batch.pad   # drop wrap-around padding
+        metric.update([batch.label[0][:n]], [dets[:n]])
     print("train-set %s=%.4f" % metric.get())
 
 

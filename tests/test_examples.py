@@ -11,7 +11,6 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def _run(script, *args, timeout=600, cwd=None):
     env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
     # pin explicitly: in the MX_TEST_CTX=tpu lane the conftest does NOT
     # set these, and an unpinned example subprocess would hang on a

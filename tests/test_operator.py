@@ -1218,3 +1218,98 @@ def test_multi_lans_matches_reference():
     wr, mr, vr = _lans_ref(w2_np, g2_np, np.zeros(4, np.float32),
                            np.zeros(4, np.float32), 0.1, 0.01)
     np.testing.assert_allclose(w32.asnumpy(), wr, rtol=1e-5, atol=1e-6)
+
+
+def test_sldwin_attention_matches_banded_reference():
+    """Sliding-window attention ops vs a dense numpy banded reference
+    (score gather, mask, context contraction; symmetric and causal-left
+    windows, dilation > 1)."""
+    rng = np.random.RandomState(0)
+    B, L, H, D, w = 1, 10, 2, 4, 2
+    q = rng.randn(B, L, H, D).astype(np.float32)
+    k = rng.randn(B, L, H, D).astype(np.float32)
+    v = rng.randn(B, L, H, D).astype(np.float32)
+    for symmetric, dil in ((True, 1), (False, 1), (True, 2),
+                           (False, 2)):
+        dilation = np.full(H, dil, np.float32)
+        offs = list(range(-w, (w if symmetric else 0) + 1))
+        J = len(offs)
+        score = invoke("_contrib_sldwin_atten_score", nd.array(q),
+                       nd.array(k), nd.array(dilation), w=w,
+                       symmetric=symmetric).asnumpy()
+        assert score.shape == (B, L, H, J)
+        ref = np.zeros((B, L, H, J), np.float32)
+        for i in range(L):
+            for jj, o in enumerate(offs):
+                t = i + o * dil
+                if 0 <= t < L:
+                    for h in range(H):
+                        ref[0, i, h, jj] = q[0, i, h] @ k[0, t, h]
+        np.testing.assert_allclose(score, ref, rtol=1e-5, atol=1e-5)
+
+        mask = invoke("_contrib_sldwin_atten_mask_like", nd.array(score),
+                      nd.array(dilation), nd.array([float(L)]), w=w,
+                      symmetric=symmetric).asnumpy()
+        valid = np.zeros((B, L, H, J), np.float32)
+        for i in range(L):
+            for jj, o in enumerate(offs):
+                t = i + o * dil
+                valid[0, i, :, jj] = 1.0 if 0 <= t < L else 0.0
+        np.testing.assert_array_equal(mask, valid)
+
+        ctxo = invoke("_contrib_sldwin_atten_context", nd.array(score),
+                      nd.array(v), nd.array(dilation), w=w,
+                      symmetric=symmetric).asnumpy()
+        refc = np.zeros((B, L, H, D), np.float32)
+        for i in range(L):
+            for jj, o in enumerate(offs):
+                t = i + o * dil
+                if 0 <= t < L:
+                    for h in range(H):
+                        refc[0, i, h] += ref[0, i, h, jj] * v[0, t, h]
+        np.testing.assert_allclose(ctxo, refc, rtol=1e-4, atol=1e-4)
+
+
+def test_psroi_pooling_reference():
+    """PSROIPooling vs a direct numpy computation on a tiny grid."""
+    data = np.arange(1 * 4 * 4 * 4, dtype=np.float32).reshape(1, 4, 4, 4)
+    rois = np.array([[0, 0, 0, 3, 3]], np.float32)
+    out = invoke("_contrib_PSROIPooling", nd.array(data), nd.array(rois),
+                 spatial_scale=1.0, output_dim=1, pooled_size=2,
+                 group_size=2).asnumpy()
+    assert out.shape == (1, 1, 2, 2)
+    # bin (ph, pw) averages channel ph*2+pw over its spatial window
+    # roi [0,3]x[0,3] -> bins cover rows/cols [0,1.5) and [1.5,3)
+    def avg(c, ys, ye, xs, xe):
+        mask = np.zeros((4, 4), np.float32)
+        for yy in range(4):
+            for xx in range(4):
+                if yy + 1 > ys and yy < ye and xx + 1 > xs and xx < xe:
+                    mask[yy, xx] = 1
+        return (data[0, c] * mask).sum() / max(mask.sum(), 1)
+    expect = np.array([[avg(0, 0, 1.5, 0, 1.5), avg(1, 0, 1.5, 1.5, 3)],
+                       [avg(2, 1.5, 3, 0, 1.5), avg(3, 1.5, 3, 1.5, 3)]],
+                      np.float32)
+    np.testing.assert_allclose(out[0, 0], expect, rtol=1e-5)
+
+
+def test_box_encode_decode_roundtrip():
+    """box_encode targets decoded against the same anchors must recover
+    the matched ground-truth boxes (the SSD/R-CNN regression contract)."""
+    anchors = np.array([[[0.1, 0.1, 0.4, 0.5], [0.5, 0.4, 0.9, 0.8]]],
+                       np.float32)
+    refs = np.array([[[0.15, 0.12, 0.45, 0.55], [0.48, 0.42, 0.88, 0.82]]],
+                    np.float32)
+    samples = np.ones((1, 2), np.float32)
+    matches = np.array([[0, 1]], np.float32)
+    targets, masks = invoke("_contrib_box_encode", nd.array(samples),
+                            nd.array(matches), nd.array(anchors),
+                            nd.array(refs))
+    assert masks.asnumpy().min() == 1.0     # both rois positive
+    # decode with matching stds recovers the refs
+    decoded = invoke("_contrib_box_decode",
+                     targets * nd.array(np.array([0.1, 0.1, 0.2, 0.2],
+                                                 np.float32)),
+                     nd.array(anchors), std0=1.0, std1=1.0, std2=1.0,
+                     std3=1.0).asnumpy()
+    np.testing.assert_allclose(decoded, refs, rtol=1e-4, atol=1e-5)
