@@ -174,6 +174,55 @@ def run_bert_bench():
     }))
 
 
+def run_score_bench():
+    """--score: model-zoo INFERENCE throughput vs batch size (reference:
+    example/image-classification/benchmark_score.py).  Hybridized forward
+    (one executable per shape), bf16."""
+    import jax
+    if os.environ.get("MX_BENCH_PLATFORM") == "cpu":
+        from mxnet_tpu.base import pin_cpu
+        pin_cpu()
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    on_cpu = jax.default_backend() == "cpu"
+    # compute must actually LIVE on the accelerator: default ctx is cpu(0),
+    # which would silently benchmark XLA:CPU under a TPU label
+    ctx = mx.cpu(0) if on_cpu else mx.tpu(0)
+    models = ["resnet18_v1"] if on_cpu else \
+        ["resnet18_v1", "resnet50_v1", "mobilenet1_0"]
+    batches = [1, 8] if on_cpu else [1, 8, 32, 128]
+    size = 64 if on_cpu else 224
+    iters = 3 if on_cpu else 20
+    results = {}
+    mx.random.seed(0)
+    for name in models:
+        net = getattr(vision, name)(classes=1000)
+        net.initialize(mx.init.Xavier(), ctx=ctx)
+        net.cast("bfloat16")
+        net.hybridize(static_alloc=True)
+        per_batch = {}
+        for b in batches:
+            x = mx.nd.array(np.random.rand(b, 3, size, size),
+                            dtype="bfloat16", ctx=ctx)
+            net(x).wait_to_read()                  # compile
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = net(x)
+            out.wait_to_read()
+            per_batch[b] = round(b * iters /
+                                 (time.perf_counter() - t0), 2)
+        results[name] = per_batch
+    top = results[models[0]][batches[-1]]
+    print(json.dumps({
+        "metric": "model_zoo_inference_images_per_sec",
+        "value": top, "unit": "images/sec",
+        "vs_baseline": 0.0, "device": jax.default_backend(),
+        "per_model": results,
+    }))
+
+
 def run_real_data_bench():
     """--real-data: prove the input pipeline (.rec → JPEG decode → augment →
     NCHW batch) sustains the compute rate (SURVEY hard part 7: ~3k img/s
@@ -267,7 +316,10 @@ def _captured_tpu_result(mode="resnet"):
                      for p in glob.glob(os.path.join(here, "BENCH_r*.json"))}
         if now_files - set(payload["bench_files_at_capture"]):
             return None
-        key = "bert_bench" if mode == "bert" else "resnet50_bench"
+        key = {"bert": "bert_bench", "resnet": "resnet50_bench",
+               "score": "score_bench"}.get(mode)
+        if key is None:
+            return None
         bench = payload["results"][key]
         if isinstance(bench, dict) and bench.get("device") not in (None, "cpu"):
             bench["captured_at"] = payload.get("captured_at")
@@ -283,15 +335,19 @@ def main():
         run_real_data_bench()
         return
     if os.environ.get("MX_BENCH_CHILD"):
-        if os.environ.get("MX_BENCH_MODE") == "bert":
+        mode_env = os.environ.get("MX_BENCH_MODE")
+        if mode_env == "bert":
             run_bert_bench()
+        elif mode_env == "score":
+            run_score_bench()
         else:
             run_bench()
         return
-    mode = "bert" if "--bert" in sys.argv else "resnet"
-    if mode == "bert":
-        # same probe/fallback machinery, bert child
-        os.environ["MX_BENCH_MODE"] = "bert"
+    mode = "bert" if "--bert" in sys.argv else \
+        ("score" if "--score" in sys.argv else "resnet")
+    if mode != "resnet":
+        # same probe/fallback machinery, mode-specific child
+        os.environ["MX_BENCH_MODE"] = mode
     from mxnet_tpu.base import cpu_pinned_by_user, probe_accelerator
     if cpu_pinned_by_user():
         candidates = ["cpu"]  # honor MX_FORCE_CPU=1 / JAX_PLATFORMS=cpu
@@ -321,9 +377,9 @@ def main():
                 return
     # Absolute last resort: a well-formed JSON error record, not a traceback.
     print(json.dumps({
-        "metric": "bert_base_pretrain_tokens_per_sec_per_chip"
-                  if mode == "bert" else
-                  "resnet50_train_images_per_sec_per_chip",
+        "metric": {"bert": "bert_base_pretrain_tokens_per_sec_per_chip",
+                   "score": "model_zoo_inference_images_per_sec"}.get(
+                       mode, "resnet50_train_images_per_sec_per_chip"),
         "value": 0.0,
         "unit": "tokens/sec" if mode == "bert" else "images/sec",
         "vs_baseline": 0.0,

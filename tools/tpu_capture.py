@@ -157,6 +157,9 @@ def capture():
     results["bert_bench"] = _run_json_child(
         [sys.executable, os.path.join(REPO, "bench.py"), "--bert"],
         "bert_bench")
+    results["score_bench"] = _run_json_child(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--score"],
+        "score_bench")
     results["flash_microbench"] = _run_json_child(
         [sys.executable, os.path.abspath(__file__), "--child-flash"],
         "flash_microbench")
