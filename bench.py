@@ -197,6 +197,7 @@ def run_score_bench():
     iters = 3 if on_cpu else 20
     results = {}
     mx.random.seed(0)
+    np.random.seed(0)
     for name in models:
         net = getattr(vision, name)(classes=1000)
         net.initialize(mx.init.Xavier(), ctx=ctx)

@@ -1313,3 +1313,69 @@ def test_box_encode_decode_roundtrip():
                      nd.array(anchors), std0=1.0, std1=1.0, std2=1.0,
                      std3=1.0).asnumpy()
     np.testing.assert_allclose(decoded, refs, rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_conv_zero_offsets_equals_convolution():
+    """With all offsets zero (and all-ones modulation), deformable conv
+    must equal standard Convolution — the exactness anchor for the
+    bilinear-sampling path."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 2, 6, 6).astype(np.float32)
+    w = rng.randn(3, 2, 3, 3).astype(np.float32)
+    want = invoke("Convolution", nd.array(x), nd.array(w), None,
+                  kernel=(3, 3), pad=(1, 1), num_filter=3,
+                  no_bias=True).asnumpy()
+    got = invoke("_contrib_DeformableConvolution", nd.array(x),
+                 nd.array(np.zeros((1, 18, 6, 6), np.float32)),
+                 nd.array(w), kernel=(3, 3), pad=(1, 1), num_filter=3,
+                 no_bias=True).asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    got_v2 = invoke("_contrib_ModulatedDeformableConvolution", nd.array(x),
+                    nd.array(np.zeros((1, 18, 6, 6), np.float32)),
+                    nd.array(np.ones((1, 9, 6, 6), np.float32)),
+                    nd.array(w), kernel=(3, 3), pad=(1, 1), num_filter=3,
+                    no_bias=True).asnumpy()
+    np.testing.assert_allclose(got_v2, want, rtol=1e-4, atol=1e-5)
+    # half-modulation scales the output linearly
+    got_half = invoke("_contrib_ModulatedDeformableConvolution",
+                      nd.array(x),
+                      nd.array(np.zeros((1, 18, 6, 6), np.float32)),
+                      nd.array(np.full((1, 9, 6, 6), 0.5, np.float32)),
+                      nd.array(w), kernel=(3, 3), pad=(1, 1), num_filter=3,
+                      no_bias=True).asnumpy()
+    np.testing.assert_allclose(got_half, 0.5 * want, rtol=1e-4, atol=1e-5)
+
+
+def test_hawkesll_matches_slow_reference():
+    """Hawkes log-likelihood vs a direct O(T²)-style numpy evaluation of
+    intensity terms and the exponential-kernel compensator."""
+    rng = np.random.RandomState(0)
+    B, T, K = 1, 5, 2
+    lda = np.full((B, K), 0.5, np.float32)
+    alpha = np.array([0.2, 0.3], np.float32)
+    beta = np.array([1.0, 2.0], np.float32)
+    state = np.zeros((B, K), np.float32)
+    lags = rng.rand(B, T).astype(np.float32)
+    marks = rng.randint(0, K, (B, T)).astype(np.float32)
+    valid = np.array([T], np.float32)
+    tmax = np.array([float(lags.sum() + 1.0)], np.float32)
+    ll, _ = invoke("_contrib_hawkesll", nd.array(lda), nd.array(alpha),
+                   nd.array(beta), nd.array(state), nd.array(lags),
+                   nd.array(marks), nd.array(valid), nd.array(tmax))
+    # slow reference
+    times = np.cumsum(lags[0])
+    ll_ref = 0.0
+    for i in range(T):
+        k = int(marks[0, i])
+        exc = 0.0
+        for j in range(i):
+            if int(marks[0, j]) == k:
+                exc += np.exp(-beta[k] * (times[i] - times[j]))
+        lam = lda[0, k] + alpha[k] * beta[k] * exc
+        ll_ref += np.log(lam)
+    comp = lda[0].sum() * tmax[0]
+    for i in range(T):
+        k = int(marks[0, i])
+        comp += alpha[k] * (1 - np.exp(-beta[k] * (tmax[0] - times[i])))
+    ll_ref -= comp
+    np.testing.assert_allclose(float(ll.asnumpy()[0]), ll_ref, rtol=1e-4)
