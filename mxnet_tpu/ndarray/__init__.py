@@ -74,3 +74,14 @@ random.bernoulli = _make_op_func("_random_bernoulli")
 random.multinomial = _make_op_func("_sample_multinomial")
 random.shuffle = _make_op_func("shuffle")
 sys.modules[random.__name__] = random
+
+def __getattr__(name):
+    if name == "contrib":
+        # reference parity: mx.nd.contrib IS the contrib op namespace
+        # (same module as mx.contrib.nd); register it like .random above
+        # so `import mxnet_tpu.ndarray.contrib` also works
+        import sys
+        from ..contrib import ndarray as contrib
+        sys.modules[__name__ + ".contrib"] = contrib
+        return contrib
+    raise AttributeError(name)

@@ -170,3 +170,20 @@ def test_foreach_matches_python_loop():
         h = nd.tanh(nd.dot(data[t], W) + h)
     np.testing.assert_allclose(final.asnumpy(), h.asnumpy(), rtol=1e-5,
                                atol=1e-5)
+
+
+def test_nd_contrib_namespace_parity():
+    """mx.nd.contrib mirrors mx.contrib.nd (reference exposes both)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    a = mx.nd.contrib.arange_like(nd.zeros((2, 5)), axis=1)
+    assert a.asnumpy().tolist() == [0.0, 1.0, 2.0, 3.0, 4.0]
+    for name in ("box_nms", "box_iou", "quadratic", "edge_id",
+                 "sldwin_atten_score", "box_encode", "ROIAlign",
+                 "MultiBoxPrior"):
+        assert hasattr(mx.nd.contrib, name), name
+    from mxnet_tpu.contrib import ndarray as contrib_nd
+    assert mx.nd.contrib is contrib_nd
+    import importlib
+    mod = importlib.import_module("mxnet_tpu.ndarray.contrib")
+    assert mod is contrib_nd
