@@ -231,3 +231,41 @@ def test_trainstep_two_process_dp(tmp_path):
     """))
     out = _launch(script)
     assert out.count("TRAINSTEP_OK") == 2
+
+
+def test_trainer_update_on_kvstore_two_process(tmp_path):
+    """update_on_kvstore=True multi-process (the reference's server-side
+    optimizer): every worker's store applies the SAME summed gradient, so
+    weights stay identical and match the serial update."""
+    script = tmp_path / "w.py"
+    script.write_text(textwrap.dedent(_PRELUDE) + textwrap.dedent("""
+        from jax.experimental import multihost_utils
+        rank = jax.process_index()
+        mx.random.seed(3)
+        net = mx.gluon.nn.Dense(2, use_bias=False, in_units=3)
+        net.initialize(mx.init.Xavier())
+        w0 = net.weight.data().asnumpy().copy()
+        trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                                   {"learning_rate": 0.4, "wd": 0.0},
+                                   kvstore="ici", update_on_kvstore=True)
+        rng = np.random.RandomState(50 + rank)
+        x = nd.array(rng.randn(4, 3).astype(np.float32))
+        y = nd.array(rng.randn(4, 2).astype(np.float32))
+        with autograd.record():
+            loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        g_local = net.weight.grad().asnumpy().copy()
+        trainer.step(2)
+        w1 = net.weight.data().asnumpy()
+        # serial: sum of both workers' grads, applied once
+        allg = multihost_utils.process_allgather(g_local)
+        gsum = allg.reshape(2, *g_local.shape).sum(axis=0)
+        # update_on_kvstore: optimizer rescale_grad = 1/batch_size
+        w_exp = w0 - 0.4 * gsum / 2.0
+        np.testing.assert_allclose(w1, w_exp, rtol=1e-5, atol=1e-6)
+        allw = multihost_utils.process_allgather(w1)
+        np.testing.assert_allclose(allw[0], allw[-1], rtol=0, atol=0)
+        print("UOK_OK rank", rank, flush=True)
+    """))
+    out = _launch(script)
+    assert out.count("UOK_OK") == 2
