@@ -62,6 +62,7 @@ from . import model
 from . import monitor
 from . import module
 from . import module as mod
+from . import rnn
 from . import operator
 from . import tpu_kernel
 

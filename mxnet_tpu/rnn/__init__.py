@@ -1,0 +1,121 @@
+"""mx.rnn — the v1.x bucketed-sequence utilities.
+
+Reference: python/mxnet/rnn/io.py (class BucketSentenceIter) — the data
+side of BucketingModule: sentences are binned into fixed bucket lengths,
+padded within their bucket, and each batch carries its ``bucket_key`` so the
+module switches to that bucket's compiled executables.
+"""
+from __future__ import annotations
+
+import bisect
+import random
+from typing import List, Optional, Sequence
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..io import DataBatch, DataDesc, DataIter
+
+__all__ = ["BucketSentenceIter"]
+
+
+class BucketSentenceIter(DataIter):
+    """Reference: mx.rnn.BucketSentenceIter(sentences, batch_size,
+    buckets=..., invalid_label=-1, data_name='data',
+    label_name='softmax_label').
+
+    ``sentences``: list of int-id sequences.  Each is placed in the
+    smallest bucket that fits (longer-than-largest are dropped with a
+    warning, like the reference), padded with ``invalid_label``; labels
+    are the next-token shift.  Batches are drawn bucket-by-bucket and
+    carry ``bucket_key = bucket length``.
+    """
+
+    def __init__(self, sentences: Sequence[Sequence[int]], batch_size: int,
+                 buckets: Optional[List[int]] = None, invalid_label: int = -1,
+                 data_name: str = "data", label_name: str = "softmax_label",
+                 dtype: str = "float32", layout: str = "NT", shuffle=True,
+                 seed: int = 0):
+        super().__init__(batch_size)
+        if layout != "NT":
+            raise MXNetError("BucketSentenceIter: only layout='NT' "
+                             "(batch, time) is supported")
+        if buckets is None:
+            # reference default: one bucket per observed length with
+            # enough sentences to fill a batch
+            counts = {}
+            for s in sentences:
+                counts[len(s)] = counts.get(len(s), 0) + 1
+            buckets = sorted(L for L, c in counts.items()
+                             if c >= batch_size) or \
+                [max(len(s) for s in sentences)]
+        self.buckets = sorted(buckets)
+        self.data_name = data_name
+        self.label_name = label_name
+        self.invalid_label = invalid_label
+        self._dtype = _np.dtype(dtype)
+        self._shuffle = shuffle
+        self._rng = random.Random(seed)
+
+        self.data: List[List[_np.ndarray]] = [[] for _ in self.buckets]
+        n_dropped = 0
+        for s in sentences:
+            idx = bisect.bisect_left(self.buckets, len(s))
+            if idx == len(self.buckets):
+                n_dropped += 1
+                continue
+            row = _np.full(self.buckets[idx], invalid_label, self._dtype)
+            row[:len(s)] = _np.asarray(s, self._dtype)
+            self.data[idx].append(row)
+        if n_dropped:
+            import warnings
+            warnings.warn("BucketSentenceIter: dropped %d sentence(s) "
+                          "longer than the largest bucket (%d)"
+                          % (n_dropped, self.buckets[-1]))
+        self.default_bucket_key = self.buckets[-1]
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name,
+                         (self.batch_size, self.default_bucket_key),
+                         self._dtype)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self.label_name,
+                         (self.batch_size, self.default_bucket_key),
+                         self._dtype)]
+
+    def reset(self):
+        # plan (bucket_idx, start) batch slots; shuffle within buckets and
+        # across the plan (the reference shuffles both)
+        self._plan = []
+        for i, rows in enumerate(self.data):
+            if self._shuffle:
+                self._rng.shuffle(rows)
+            for start in range(0, len(rows) - self.batch_size + 1,
+                              self.batch_size):
+                self._plan.append((i, start))
+        if self._shuffle:
+            self._rng.shuffle(self._plan)
+        self._cursor = 0
+
+    def next(self) -> DataBatch:
+        from .. import ndarray as nd
+        if self._cursor >= len(self._plan):
+            raise StopIteration
+        bidx, start = self._plan[self._cursor]
+        self._cursor += 1
+        rows = self.data[bidx][start:start + self.batch_size]
+        L = self.buckets[bidx]
+        x = _np.stack(rows)
+        # next-token labels, padded with invalid_label at the end
+        y = _np.full_like(x, self.invalid_label)
+        y[:, :-1] = x[:, 1:]
+        return DataBatch(
+            data=[nd.array(x)], label=[nd.array(y)], bucket_key=L,
+            provide_data=[DataDesc(self.data_name,
+                                   (self.batch_size, L), self._dtype)],
+            provide_label=[DataDesc(self.label_name,
+                                    (self.batch_size, L), self._dtype)])
