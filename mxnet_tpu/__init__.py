@@ -63,6 +63,14 @@ from . import monitor
 from . import module
 from . import module as mod
 from . import rnn
+from . import util
+from . import device as context
+
+# compat: the reference's context.py is a REAL module — register the alias
+# so `import mxnet_tpu.context` / `from mxnet_tpu.context import Context`
+# work like they do upstream
+import sys as _sys
+_sys.modules[__name__ + ".context"] = context
 from . import operator
 from . import tpu_kernel
 

@@ -1,0 +1,107 @@
+"""mx.util (reference: python/mxnet/util.py): env helpers + numpy-mode
+decorators.
+
+One-array-type design note: mx.np.ndarray IS mx.nd.NDArray here, so the
+np-mode switches are compatibility recorders (npx.set_np flags), and the
+use_np* decorators are transparent wrappers — code written against the
+reference API runs unchanged.
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+from .base import get_env, set_env
+
+__all__ = ["getenv", "setenv", "makedirs", "is_np_array", "is_np_shape",
+           "np_array", "np_shape", "use_np", "use_np_array",
+           "use_np_shape", "get_gpu_count", "get_gpu_memory"]
+
+
+def getenv(name):
+    """Reference: mx.util.getenv."""
+    return get_env(name)
+
+
+def setenv(name, value):
+    """Reference: mx.util.setenv."""
+    set_env(name, value)
+
+
+def makedirs(d):
+    """Reference: mx.util.makedirs (exist_ok semantics)."""
+    os.makedirs(os.path.expanduser(d), exist_ok=True)
+
+
+def is_np_array() -> bool:
+    from . import npx
+    return npx.is_np_array()
+
+
+def is_np_shape() -> bool:
+    from . import npx
+    return npx.is_np_shape()
+
+
+class _NpScope:
+    """Context manager/decorator setting the npx numpy-mode flags; None
+    leaves a flag untouched, and __exit__ restores BOTH flags exactly
+    (compat: the flags gate nothing — one array type)."""
+
+    def __init__(self, array=None, shape=None):
+        self._array = array
+        self._shape = shape
+
+    def __enter__(self):
+        from . import npx
+        self._saved = (npx.is_np_array(), npx.is_np_shape())
+        npx.set_np(
+            array=self._saved[0] if self._array is None else self._array,
+            shape=self._saved[1] if self._shape is None else self._shape)
+        return self
+
+    def __exit__(self, *exc):
+        from . import npx
+        npx.set_np(array=self._saved[0], shape=self._saved[1])
+        return False
+
+    def __call__(self, fn_or_cls):
+        if isinstance(fn_or_cls, type):
+            return fn_or_cls          # classes pass through (compat)
+
+        @functools.wraps(fn_or_cls)
+        def wrapped(*a, **kw):
+            with _NpScope(self._array, self._shape):
+                return fn_or_cls(*a, **kw)
+        return wrapped
+
+
+def np_array(active=True):
+    return _NpScope(array=active)
+
+
+def np_shape(active=True):
+    return _NpScope(shape=active)
+
+
+def use_np_array(fn):
+    return _NpScope(array=True)(fn)
+
+
+def use_np_shape(fn):
+    return _NpScope(shape=True)(fn)
+
+
+def use_np(fn):
+    """Reference: @use_np — activate both numpy semantics."""
+    return _NpScope(array=True, shape=True)(fn)
+
+
+def get_gpu_count() -> int:
+    from .device import num_gpus
+    return num_gpus()
+
+
+def get_gpu_memory(dev_id: int = 0):
+    from .device import gpu_memory_info
+    return gpu_memory_info(dev_id)

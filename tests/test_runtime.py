@@ -122,3 +122,34 @@ def test_launch_preserves_inner_separator(tmp_path):
                        capture_output=True, text=True, timeout=60)
     assert r.returncode == 0
     assert "ARGS:--|--data|x" in r.stdout
+
+
+def test_util_and_context_modules():
+    """mx.util + mx.context compatibility surface (reference:
+    python/mxnet/util.py, python/mxnet/context.py)."""
+    import mxnet_tpu as mx
+    assert mx.context.Context is mx.Context
+    assert mx.context.cpu(0) == mx.cpu(0)
+    assert mx.util.getenv("MXNET_ENGINE_TYPE") is not None
+    mx.util.setenv("MX_UTIL_TEST", "1")
+    assert mx.util.getenv("MX_UTIL_TEST") == "1"
+    mx.util.setenv("MX_UTIL_TEST", None)
+
+    @mx.util.use_np
+    def f(x):
+        return mx.np.sqrt(x)
+    out = f(mx.np.array([9.0]))
+    assert out.asnumpy().tolist() == [3.0]
+    assert not mx.util.is_np_array()   # flag restored by the scope
+    with mx.util.np_shape():
+        assert mx.util.is_np_shape()
+    # deactivating scope + exact restore of both flags
+    from mxnet_tpu import npx
+    npx.set_np(shape=True, array=False)
+    with mx.util.np_array(False):
+        assert not mx.util.is_np_array()
+    assert mx.util.is_np_shape() and not mx.util.is_np_array()
+    npx.reset_np()
+    from mxnet_tpu.context import Context as CtxImport
+    assert CtxImport is mx.Context
+    assert mx.util.get_gpu_count() >= 0
