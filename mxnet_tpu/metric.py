@@ -493,7 +493,8 @@ class VOCMApMetric(EvalMetric):
                 iou = self._iou(det[2:6], gt[1:5])
                 if iou > best_iou:
                     best_iou, best_j = iou, j
-            tp = best_iou >= self.iou_thresh and not matched[best_j]
+            tp = (best_j >= 0 and best_iou >= self.iou_thresh
+                  and not matched[best_j])
             if tp:
                 matched[best_j] = True
             self._records.setdefault(c, []).append((float(det[1]), tp))

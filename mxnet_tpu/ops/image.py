@@ -132,7 +132,10 @@ def _hue(x, w):
 
 
 def _rand_w(key, frac):
-    return jax.random.uniform(key, (), jnp.float32, 1.0 - frac, 1.0 + frac)
+    # clamp at 0: frac > 1 must brighten/flatten, never invert (the
+    # reference samples jitter factors from [max(0, 1-frac), 1+frac])
+    return jax.random.uniform(key, (), jnp.float32,
+                              max(0.0, 1.0 - frac), 1.0 + frac)
 
 
 @register("_image_random_brightness", aliases=["image_random_brightness"],

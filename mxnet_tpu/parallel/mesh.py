@@ -32,13 +32,17 @@ __all__ = ["make_mesh", "replicated", "batch_sharded", "shard_params_tp",
 
 def init_process_group(coordinator_address: Optional[str] = None,
                        num_processes: Optional[int] = None,
-                       process_id: Optional[int] = None):
+                       process_id: Optional[int] = None,
+                       initialization_timeout: Optional[int] = None):
     """Multi-host process group over DCN (reference role: ps-lite
     Postoffice::Start + DMLC_* env; here jax.distributed.initialize).
 
     Arguments default from the env contract tools/launch.py sets
     (MX_COORDINATOR / MX_NUM_PROCESSES / MX_PROCESS_ID), the way the
     reference workers read DMLC_PS_ROOT_URI & co from their tracker.
+    ``initialization_timeout`` (seconds, also env MX_INIT_TIMEOUT) bounds
+    the coordinator handshake so a failed pairing surfaces as an error the
+    launcher can retry with a fresh port instead of a 5-minute hang.
     """
     import os
     if coordinator_address is None:
@@ -47,7 +51,21 @@ def init_process_group(coordinator_address: Optional[str] = None,
         num_processes = int(os.environ["MX_NUM_PROCESSES"])
     if process_id is None and os.environ.get("MX_PROCESS_ID"):
         process_id = int(os.environ["MX_PROCESS_ID"])
-    jax.distributed.initialize(coordinator_address, num_processes, process_id)
+    if initialization_timeout is None and os.environ.get("MX_INIT_TIMEOUT"):
+        initialization_timeout = int(os.environ["MX_INIT_TIMEOUT"])
+    kwargs = {}
+    if initialization_timeout is not None:
+        import inspect
+        import warnings
+        sig = inspect.signature(jax.distributed.initialize)
+        if "initialization_timeout" in sig.parameters:
+            kwargs["initialization_timeout"] = initialization_timeout
+        else:
+            warnings.warn("this jax has no initialization_timeout kwarg; "
+                          "the requested %ss handshake bound is ignored"
+                          % initialization_timeout)
+    jax.distributed.initialize(coordinator_address, num_processes,
+                               process_id, **kwargs)
 
 
 def make_mesh(axes: Sequence[str] = ("dp",),

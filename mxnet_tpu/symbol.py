@@ -346,6 +346,13 @@ def _make_op_symbol(op_name: str, inputs: List[Symbol],
                              "as input; index it first" % op_name)
         in_heads.append(s._heads[0])
     node = _SymNode(op.name, name or _gen_name(op_name), attrs, in_heads)
+    if op.num_outputs == -1:
+        # variadic fleet ops (multi_sgd_update & co): their output count
+        # depends on runtime input lists, which the symbol DAG cannot carry
+        raise MXNetError(
+            "%s has a variadic output count (num_outputs=-1) and is not "
+            "supported in symbol mode; call it imperatively via mx.nd"
+            % op_name)
     n_out = op.num_outputs
     if op.aux_writeback and not callable(op.aux_writeback):
         n_out = n_out - len(op.aux_writeback)

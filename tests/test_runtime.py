@@ -99,15 +99,24 @@ def test_init_process_group_two_processes(tmp_path):
     """) % REPO)
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)           # forced 8-dev count breaks pairing
-    for attempt in range(2):   # retry once: the free-port pick can race
-        r = subprocess.run([sys.executable,
-                            os.path.join(REPO, "tools", "launch.py"),
-                            "-n", "2", "--launcher", "local", "--",
-                            sys.executable, str(script)],
-                           capture_output=True, text=True, timeout=300,
-                           env=env)
-        if r.returncode == 0:
+    # Fail the handshake fast (60s) so a raced port retries with a fresh
+    # one instead of hanging out the whole test budget; 3 attempts.
+    env["MX_INIT_TIMEOUT"] = "60"
+    r = None
+    for attempt in range(3):   # retry: the free-port pick can race
+        try:
+            r = subprocess.run([sys.executable,
+                                os.path.join(REPO, "tools", "launch.py"),
+                                "-n", "2", "--launcher", "local", "--",
+                                sys.executable, str(script)],
+                               capture_output=True, text=True, timeout=240,
+                               env=env)
+        except subprocess.TimeoutExpired:
+            continue           # hung handshake: fresh port next attempt
+        if r.returncode == 0 and "dist ok rank 0" in r.stdout \
+                and "dist ok rank 1" in r.stdout:
             break
+    assert r is not None, "every attempt hung out its timeout"
     assert r.returncode == 0, (r.stdout, r.stderr)
     assert "dist ok rank 0" in r.stdout and "dist ok rank 1" in r.stdout
 

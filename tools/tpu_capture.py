@@ -126,7 +126,8 @@ def flash_microbench():
 def mosaic_smoke():
     """Child mode: execute a Pallas kernel with interpret=False (real Mosaic
     lowering) and check numerics vs jnp — proves block specs + VMEM budgets
-    on hardware, which interpret-mode tests cannot."""
+    on hardware, which interpret-mode tests cannot.  Covers forward AND the
+    custom-vjp backward (the bwd kernel has its own block specs)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -143,10 +144,48 @@ def mosaic_smoke():
     ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), v)
     err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref)))
     assert err < 2e-2, err
+
+    # Backward through the Pallas custom_vjp vs jnp autodiff of the ref.
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, 1.0 / np.sqrt(64), False)
+                       * jnp.cos(jnp.arange(64, dtype=jnp.float32)))
+
+    def loss_ref(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(64)
+        o = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), v)
+        return jnp.sum(o * jnp.cos(jnp.arange(64, dtype=jnp.float32)))
+
+    g_flash = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+    bwd_err = max(float(jnp.max(jnp.abs(a - b)))
+                  for a, b in zip(g_flash, g_ref))
+    assert bwd_err < 5e-2, bwd_err
     print(json.dumps({
         "metric": "pallas_mosaic_flash_max_abs_err", "value": round(err, 6),
         "unit": "abs", "device": jax.default_backend(), "ok": True,
+        "bwd_max_abs_err": round(bwd_err, 6),
     }))
+
+
+def _run_tpu_test_lane():
+    """Run the MX_TEST_CTX=tpu pytest lane (op battery + gluon) on the live
+    chip; returns a summary dict parsed from pytest's last line."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("MX_FORCE_CPU", None)
+    env["MX_TEST_CTX"] = "tpu"
+    argv = [sys.executable, "-m", "pytest", "-q", "--no-header", "-p",
+            "no:cacheprovider", "tests/test_operator.py", "tests/test_gluon.py"]
+    try:
+        r = subprocess.run(argv, env=env, timeout=CHILD_TIMEOUT_S, cwd=REPO,
+                           stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    except subprocess.TimeoutExpired:
+        _log("tpu_test_lane: TIMEOUT after %ss" % CHILD_TIMEOUT_S)
+        return None
+    tail = r.stdout.decode(errors="replace").strip().splitlines()
+    summary = tail[-1] if tail else ""
+    _log("tpu_test_lane: rc=%s %s" % (r.returncode, summary[:200]))
+    return {"rc": r.returncode, "summary": summary[:500]}
 
 
 def capture():
@@ -166,6 +205,11 @@ def capture():
     results["mosaic_smoke"] = _run_json_child(
         [sys.executable, os.path.abspath(__file__), "--child-mosaic"],
         "mosaic_smoke")
+    # bench.py --real-data synthesizes its own .rec pack — no data drop needed
+    results["real_data_bench"] = _run_json_child(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--real-data"],
+        "real_data_bench")
+    results["tpu_test_lane"] = _run_tpu_test_lane()
     return results
 
 
