@@ -767,6 +767,19 @@ def _invoke_impl(op_name: str, *inputs, out=None, **params):
             jax_in.append(jnp.asarray(x))
         elif x is None:
             jax_in.append(None)
+        elif hasattr(x, "stype") and hasattr(x, "tostype"):
+            # sparse input.  no_jit ops (graph/sampling ops) take the sparse
+            # object raw; everything else gets the reference's storage
+            # FALLBACK semantics — densify with a one-time warning
+            # (src/operator/elemwise_op_common.h dispatch-fallback +
+            # "storage fallback" LogStorageFallback).
+            if op.no_jit:
+                jax_in.append(x)
+            else:
+                _warn_storage_fallback(op_name, x.stype)
+                jax_in.append(x.tostype("default")._jax)
+            if ctx is None:
+                ctx = x.context
         else:
             raise TypeError("invoke(%s): bad input type %s" % (op_name, type(x)))
     ctx = ctx or current_context()
@@ -825,17 +838,40 @@ def _invoke_impl(op_name: str, *inputs, out=None, **params):
     return outs
 
 
+_STORAGE_FALLBACK_WARNED = set()
+
+
+def _warn_storage_fallback(op_name, stype):
+    if (op_name, stype) not in _STORAGE_FALLBACK_WARNED:
+        _STORAGE_FALLBACK_WARNED.add((op_name, stype))
+        import warnings
+        warnings.warn(
+            "op %s has no sparse implementation for stype=%r; converting "
+            "to dense (reference: MXNet storage-fallback warning)"
+            % (op_name, stype))
+
+
+def _wrap_one(o, ctx):
+    # ops may return already-wrapped NDArrays / sparse arrays (no_jit
+    # graph ops); pass them through instead of re-wrapping
+    if isinstance(o, NDArray) or hasattr(o, "stype"):
+        return o
+    return NDArray(o, ctx=ctx)
+
+
 def _wrap_outputs(op, outs, ctx):
     if isinstance(outs, tuple) and op.num_outputs != 1:
-        wrapped = [NDArray(o, ctx=ctx) for o in outs]
-        engine.maybe_sync(wrapped[0]._jax)
+        wrapped = [_wrap_one(o, ctx) for o in outs]
+        engine.maybe_sync(wrapped[0]._jax
+                          if isinstance(wrapped[0], NDArray) else None)
         return wrapped
     if isinstance(outs, (tuple, list)):
         outs = outs[0] if len(outs) == 1 and op.num_outputs == 1 else outs
     if isinstance(outs, (tuple, list)):
-        return [NDArray(o, ctx=ctx) for o in outs]
-    o = NDArray(outs, ctx=ctx)
-    engine.maybe_sync(o._jax)
+        return [_wrap_one(o, ctx) for o in outs]
+    o = _wrap_one(outs, ctx)
+    if isinstance(o, NDArray):
+        engine.maybe_sync(o._jax)
     return o
 
 

@@ -581,3 +581,475 @@ random.permutation = lambda x, **kw: _wrap(
 random.binomial = lambda n, p, size=None, **kw: _wrap(
     jax.random.binomial(_rk(), n, _unwrap(p),
                         shape=_rand_size(size) or None).astype("int32"))
+
+
+# -- route the surface through the registered _npi_* layer --------------------
+# (reference: python/mxnet/numpy/multiarray.py dispatching to _npi ops).
+# These overrides supersede the legacy-op routing and the raw-jnp tail above:
+# every call goes through `invoke` -> per-op jit cache + autograd tape, with
+# TRUE numpy semantics (bool comparisons, numpy promotion) from ops/numpy_ops.
+
+def _npi1(op, **fixed):
+    def fn(a, **kw):
+        kw.update(fixed)
+        return invoke(op, a, **kw)
+    fn.__name__ = op.replace("_npi_", "")
+    return fn
+
+
+def _npi2(op):
+    def fn(a, b, **kw):
+        return invoke(op, a, b, **kw)
+    fn.__name__ = op.replace("_npi_", "")
+    return fn
+
+
+for _py, _opn in [
+        ("add", "_npi_add"), ("subtract", "_npi_subtract"),
+        ("multiply", "_npi_multiply"), ("divide", "_npi_true_divide"),
+        ("true_divide", "_npi_true_divide"), ("power", "_npi_power"),
+        ("float_power", "_npi_float_power"),
+        ("floor_divide", "_npi_floor_divide"), ("mod", "_npi_remainder"),
+        ("remainder", "_npi_remainder"), ("fmod", "_npi_fmod"),
+        ("maximum", "_npi_maximum"), ("minimum", "_npi_minimum"),
+        ("fmax", "_npi_fmax"), ("fmin", "_npi_fmin"),
+        ("arctan2", "_npi_arctan2"), ("hypot", "_npi_hypot"),
+        ("logaddexp", "_npi_logaddexp"), ("logaddexp2", "_npi_logaddexp2"),
+        ("copysign", "_npi_copysign"), ("nextafter", "_npi_nextafter"),
+        ("ldexp", "_npi_ldexp"), ("heaviside", "_npi_heaviside"),
+        ("gcd", "_npi_gcd"), ("lcm", "_npi_lcm"),
+        ("bitwise_and", "_npi_bitwise_and"),
+        ("bitwise_or", "_npi_bitwise_or"),
+        ("bitwise_xor", "_npi_bitwise_xor"),
+        ("left_shift", "_npi_left_shift"),
+        ("right_shift", "_npi_right_shift"),
+        ("equal", "_npi_equal"), ("not_equal", "_npi_not_equal"),
+        ("less", "_npi_less"), ("less_equal", "_npi_less_equal"),
+        ("greater", "_npi_greater"),
+        ("greater_equal", "_npi_greater_equal"),
+        ("logical_and", "_npi_logical_and"),
+        ("logical_or", "_npi_logical_or"),
+        ("logical_xor", "_npi_logical_xor"),
+        ("isclose", "_npi_isclose"), ("array_equal", "_npi_array_equal"),
+        ("array_equiv", "_npi_array_equiv"), ("allclose", "_npi_allclose"),
+        ("matmul", "_npi_matmul"), ("dot", "_npi_dot"),
+        ("vdot", "_npi_vdot"), ("inner", "_npi_inner"),
+        ("outer", "_npi_outer"), ("digitize", "_npi_digitize"),
+        ("convolve", "_npi_convolve"), ("correlate", "_npi_correlate"),
+        ("polyval", "_npi_polyval"), ("searchsorted", "_npi_searchsorted"),
+        ("isin", "_npi_isin"), ("in1d", "_npi_in1d"),
+        ("intersect1d", "_npi_intersect1d"), ("union1d", "_npi_union1d"),
+        ("setdiff1d", "_npi_setdiff1d"), ("setxor1d", "_npi_setxor1d")]:
+    _g[_py] = _npi2(_opn)
+
+for _py, _opn in [
+        ("absolute", "_npi_absolute"), ("abs", "_npi_absolute"),
+        ("fabs", "_npi_fabs"), ("negative", "_npi_negative"),
+        ("positive", "_npi_positive"), ("exp", "_npi_exp"),
+        ("exp2", "_npi_exp2"), ("expm1", "_npi_expm1"), ("log", "_npi_log"),
+        ("log2", "_npi_log2"), ("log10", "_npi_log10"),
+        ("log1p", "_npi_log1p"), ("sqrt", "_npi_sqrt"),
+        ("cbrt", "_npi_cbrt"), ("square", "_npi_square"),
+        ("reciprocal", "_npi_reciprocal"), ("sin", "_npi_sin"),
+        ("cos", "_npi_cos"), ("tan", "_npi_tan"), ("arcsin", "_npi_arcsin"),
+        ("arccos", "_npi_arccos"), ("arctan", "_npi_arctan"),
+        ("sinh", "_npi_sinh"), ("cosh", "_npi_cosh"), ("tanh", "_npi_tanh"),
+        ("arcsinh", "_npi_arcsinh"), ("arccosh", "_npi_arccosh"),
+        ("arctanh", "_npi_arctanh"), ("degrees", "_npi_degrees"),
+        ("radians", "_npi_radians"), ("deg2rad", "_npi_deg2rad"),
+        ("rad2deg", "_npi_rad2deg"), ("sinc", "_npi_sinc"),
+        ("i0", "_npi_i0"), ("sign", "_npi_sign"),
+        ("signbit", "_npi_signbit"), ("floor", "_npi_floor"),
+        ("ceil", "_npi_ceil"), ("trunc", "_npi_trunc"),
+        ("rint", "_npi_rint"), ("fix", "_npi_fix"), ("isnan", "_npi_isnan"),
+        ("isinf", "_npi_isinf"), ("isfinite", "_npi_isfinite"),
+        ("isneginf", "_npi_isneginf"), ("isposinf", "_npi_isposinf"),
+        ("logical_not", "_npi_logical_not"),
+        ("bitwise_not", "_npi_bitwise_not"), ("invert", "_npi_invert"),
+        ("real", "_npi_real"), ("imag", "_npi_imag"),
+        ("conjugate", "_npi_conjugate"), ("conj", "_npi_conjugate"),
+        ("nan_to_num", "_npi_nan_to_num"), ("ravel", "_npi_ravel"),
+        ("fliplr", "_npi_fliplr"), ("flipud", "_npi_flipud"),
+        ("flatnonzero", "_npi_flatnonzero"), ("argwhere", "_npi_argwhere"),
+        ("ediff1d", "_npi_ediff1d"), ("corrcoef", "_npi_corrcoef"),
+        ("trim_zeros", "_npi_trim_zeros"), ("diagflat", "_npi_diagflat"),
+        ("msort", "_npi_msort")]:
+    _g[_py] = _npi1(_opn)
+
+
+def _red_sig(op, has_dtype=True, has_ddof=False):
+    if has_ddof:
+        def fn(a, axis=None, dtype=None, out=None, ddof=0, keepdims=False):
+            return invoke(op, a, out=out, axis=axis, dtype=dtype, ddof=ddof,
+                          keepdims=keepdims)
+    elif has_dtype:
+        def fn(a, axis=None, dtype=None, out=None, keepdims=False):
+            return invoke(op, a, out=out, axis=axis, dtype=dtype,
+                          keepdims=keepdims)
+    else:
+        def fn(a, axis=None, out=None, keepdims=False):
+            return invoke(op, a, out=out, axis=axis, keepdims=keepdims)
+    fn.__name__ = op.replace("_npi_", "")
+    return fn
+
+
+for _py, _opn, _kind in [
+        ("sum", "_npi_sum", "dtype"), ("prod", "_npi_prod", "dtype"),
+        ("mean", "_npi_mean", "dtype"), ("nansum", "_npi_nansum", "dtype"),
+        ("nanprod", "_npi_nanprod", "dtype"),
+        ("nanmean", "_npi_nanmean", "dtype"),
+        ("std", "_npi_std", "ddof"), ("var", "_npi_var", "ddof"),
+        ("nanstd", "_npi_nanstd", "ddof"), ("nanvar", "_npi_nanvar", "ddof"),
+        ("max", "_npi_amax", "plain"), ("amax", "_npi_amax", "plain"),
+        ("min", "_npi_amin", "plain"), ("amin", "_npi_amin", "plain"),
+        ("nanmax", "_npi_nanmax", "plain"),
+        ("nanmin", "_npi_nanmin", "plain"), ("ptp", "_npi_ptp", "plain"),
+        ("all", "_npi_all", "plain"), ("any", "_npi_any", "plain"),
+        ("median", "_npi_median", "plain"),
+        ("nanmedian", "_npi_nanmedian", "plain"),
+        ("count_nonzero", "_npi_count_nonzero", "plain")]:
+    _g[_py] = _red_sig(_opn, has_dtype=_kind == "dtype",
+                       has_ddof=_kind == "ddof")
+
+
+def _argred_sig(op):
+    def fn(a, axis=None, out=None, keepdims=False):
+        return invoke(op, a, out=out, axis=axis, keepdims=keepdims)
+    fn.__name__ = op.replace("_npi_", "")
+    return fn
+
+
+argmax = _argred_sig("_npi_argmax")
+argmin = _argred_sig("_npi_argmin")
+nanargmax = _argred_sig("_npi_nanargmax")
+nanargmin = _argred_sig("_npi_nanargmin")
+
+
+def _cum_sig(op):
+    def fn(a, axis=None, dtype=None, out=None):
+        return invoke(op, a, out=out, axis=axis, dtype=dtype)
+    fn.__name__ = op.replace("_npi_", "")
+    return fn
+
+
+cumsum = _cum_sig("_npi_cumsum")
+cumprod = _cum_sig("_npi_cumprod")
+nancumsum = _cum_sig("_npi_nancumsum")
+nancumprod = _cum_sig("_npi_nancumprod")
+
+
+def percentile(a, q, axis=None, out=None, method="linear", keepdims=False,
+               interpolation=None):
+    return invoke("_npi_percentile", a, out=out, q=float(q) if _onp.isscalar(q)
+                  else tuple(q), axis=axis,
+                  method=interpolation or method, keepdims=keepdims)
+
+
+def quantile(a, q, axis=None, out=None, method="linear", keepdims=False,
+             interpolation=None):
+    return invoke("_npi_quantile", a, out=out, q=float(q) if _onp.isscalar(q)
+                  else tuple(q), axis=axis,
+                  method=interpolation or method, keepdims=keepdims)
+
+
+def nanpercentile(a, q, axis=None, out=None, method="linear",
+                  keepdims=False):
+    return invoke("_npi_nanpercentile", a, out=out,
+                  q=float(q) if _onp.isscalar(q) else tuple(q), axis=axis,
+                  method=method, keepdims=keepdims)
+
+
+def nanquantile(a, q, axis=None, out=None, method="linear", keepdims=False):
+    return invoke("_npi_nanquantile", a, out=out,
+                  q=float(q) if _onp.isscalar(q) else tuple(q), axis=axis,
+                  method=method, keepdims=keepdims)
+
+
+def average(a, axis=None, weights=None, returned=False):
+    if weights is None:
+        out = invoke("_npi_average", a, axis=axis)
+    else:
+        out = invoke("_npi_average", a, weights, axis=axis)
+    if returned:
+        w = (full_like(a, 1.0) if weights is None else weights)
+        return out, sum(w, axis=axis) if axis is not None else sum(w)
+    return out
+
+
+def unique(ar, return_index=False, return_inverse=False,
+           return_counts=False, axis=None):
+    return invoke("_npi_unique", ar, return_index=return_index,
+                  return_inverse=return_inverse,
+                  return_counts=return_counts, axis=axis)
+
+
+def nonzero(a):
+    return tuple(invoke("_npi_nonzero", a))
+
+
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        return nonzero(condition)
+    return invoke("_npi_where", condition, x, y)
+
+
+def take_along_axis(arr, indices, axis):
+    return invoke("_npi_take_along_axis", arr, indices, axis=axis)
+
+
+def compress(condition, a, axis=None, out=None):
+    return invoke("_npi_compress", condition, a, out=out, axis=axis)
+
+
+def extract(condition, arr):
+    return invoke("_npi_extract", condition, arr)
+
+
+def select(condlist, choicelist, default=0):
+    return invoke("_npi_select", *(list(condlist) + list(choicelist)),
+                  default=default)
+
+
+def moveaxis(a, source, destination):
+    return invoke("_npi_moveaxis", a,
+                  source=tuple(source) if isinstance(source, (list, tuple))
+                  else source,
+                  destination=tuple(destination)
+                  if isinstance(destination, (list, tuple)) else destination)
+
+
+def rollaxis(a, axis, start=0):
+    return invoke("_npi_rollaxis", a, axis=axis, start=start)
+
+
+def append(arr, values, axis=None):
+    return invoke("_npi_append", arr, values, axis=axis)
+
+
+def delete(arr, obj, axis=None):
+    if isinstance(obj, NDArray):
+        obj = [int(v) for v in obj.asnumpy().ravel()]
+    elif isinstance(obj, _onp.ndarray):
+        obj = [int(v) for v in obj.ravel()]
+    return invoke("_npi_delete", arr, obj=obj, axis=axis)
+
+
+def insert(arr, obj, values, axis=None):
+    if isinstance(obj, NDArray):
+        obj = [int(v) for v in obj.asnumpy().ravel()]
+    elif isinstance(obj, _onp.ndarray):
+        obj = [int(v) for v in obj.ravel()]
+    return invoke("_npi_insert", arr, values, obj=obj, axis=axis)
+
+
+def interp(x, xp, fp, left=None, right=None):
+    return invoke("_npi_interp", x, xp, fp, left=left, right=right)
+
+
+def gradient(f, *varargs, axis=None):
+    return invoke("_npi_gradient", f, *varargs, axis=axis)
+
+
+def diff(a, n=1, axis=-1):
+    return invoke("_npi_diff", a, n=n, axis=axis)
+
+
+def cov(m, y=None, rowvar=True, bias=False, ddof=None):
+    if y is not None:
+        m = vstack((m, y))
+    return invoke("_npi_cov", m, rowvar=rowvar, bias=bias, ddof=ddof)
+
+
+def meshgrid(*xi, indexing="xy", sparse=False):
+    return tuple(invoke("_npi_meshgrid", *xi, indexing=indexing,
+                        sparse=sparse))
+
+
+def broadcast_arrays(*args):
+    return tuple(invoke("_npi_broadcast_arrays", *args))
+
+
+def vstack(tup, **kw):
+    return invoke("_npi_vstack", *tup)
+
+
+row_stack = vstack
+
+
+def hstack(tup, **kw):
+    return invoke("_npi_hstack", *tup)
+
+
+def dstack(tup, **kw):
+    return invoke("_npi_dstack", *tup)
+
+
+def column_stack(tup, **kw):
+    return invoke("_npi_column_stack", *tup)
+
+
+def array_split(ary, indices_or_sections, axis=0):
+    ios = indices_or_sections
+    return invoke("_npi_array_split", ary,
+                  indices_or_sections=ios if isinstance(ios, int)
+                  else tuple(ios), axis=axis)
+
+
+def hsplit(ary, indices_or_sections):
+    ios = indices_or_sections
+    return invoke("_npi_hsplit", ary,
+                  indices_or_sections=ios if isinstance(ios, int)
+                  else tuple(ios))
+
+
+def vsplit(ary, indices_or_sections):
+    ios = indices_or_sections
+    return invoke("_npi_vsplit", ary,
+                  indices_or_sections=ios if isinstance(ios, int)
+                  else tuple(ios))
+
+
+def dsplit(ary, indices_or_sections):
+    ios = indices_or_sections
+    return invoke("_npi_dsplit", ary,
+                  indices_or_sections=ios if isinstance(ios, int)
+                  else tuple(ios))
+
+
+def tensordot(a, b, axes=2):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(x) if isinstance(x, (list, tuple)) else int(x)
+                     for x in axes)
+    return invoke("_npi_tensordot", a, b, axes=axes)
+
+
+def lexsort(keys, axis=-1):
+    return invoke("_npi_lexsort", *keys, axis=axis)
+
+
+def partition(a, kth, axis=-1):
+    return invoke("_npi_partition", a, kth=kth, axis=axis)
+
+
+def argpartition(a, kth, axis=-1):
+    return invoke("_npi_argpartition", a, kth=kth, axis=axis)
+
+
+def tri(N, M=None, k=0, dtype=None):
+    return invoke("_npi_tri", N=N, M=M, k=k, dtype=_onp.dtype(dtype).name
+                  if dtype else None)
+
+
+def vander(x, N=None, increasing=False):
+    return invoke("_npi_vander", x, N=N, increasing=increasing)
+
+
+def tril_indices(n, k=0, m=None):
+    return tuple(invoke("_npi_tril_indices", n=n, k=k, m=m))
+
+
+def triu_indices(n, k=0, m=None):
+    return tuple(invoke("_npi_triu_indices", n=n, k=k, m=m))
+
+
+def diag_indices_from(arr):
+    return tuple(invoke("_npi_diag_indices_from", arr))
+
+
+def indices(dimensions, dtype=None):
+    return invoke("_npi_indices", dimensions=tuple(dimensions),
+                  dtype=_onp.dtype(dtype).name if dtype else None)
+
+
+def full_like(a, fill_value, dtype=None):
+    return invoke("_npi_full_like", a, fill_value=fill_value,
+                  dtype=_onp.dtype(dtype).name if dtype else None)
+
+
+def empty_like(prototype, dtype=None):
+    return invoke("_npi_empty_like", prototype,
+                  dtype=_onp.dtype(dtype).name if dtype else None)
+
+
+def identity(n, dtype=None):
+    return invoke("_npi_identity", n=n,
+                  dtype=_onp.dtype(dtype).name if dtype else None)
+
+
+def bartlett(M):
+    return invoke("_npi_bartlett", M=M)
+
+
+def kaiser(M, beta):
+    return invoke("_npi_kaiser", M=M, beta=beta)
+
+
+def trapz(y, x=None, dx=1.0, axis=-1):
+    if x is None:
+        return invoke("_npi_trapz", y, dx=dx, axis=axis)
+    return invoke("_npi_trapz", y, x, axis=axis)
+
+
+trapezoid = trapz
+
+
+def histogram(a, bins=10, range=None, weights=None, density=None):
+    if range is None:
+        a_np = a.asnumpy() if isinstance(a, NDArray) else _onp.asarray(a)
+        range = (float(a_np.min()), float(a_np.max()))
+    if weights is None:
+        out = invoke("_npi_histogram", a, bins=bins, range=tuple(range),
+                     density=bool(density))
+    else:
+        out = invoke("_npi_histogram", a, weights, bins=bins,
+                     range=tuple(range), density=bool(density))
+    return tuple(out)
+
+
+def bincount(x, weights=None, minlength=0):
+    if weights is None:
+        return invoke("_npi_bincount", x, minlength=minlength)
+    return invoke("_npi_bincount", x, weights, minlength=minlength)
+
+
+def divmod_(a, b):
+    return tuple(invoke("_npi_divmod", a, b))
+
+
+divmod = divmod_
+
+
+def modf(a):
+    return tuple(invoke("_npi_modf", a))
+
+
+def frexp(a):
+    return tuple(invoke("_npi_frexp", a))
+
+
+def around(a, decimals=0, out=None):
+    return invoke("_npi_around", a, out=out, decimals=decimals)
+
+
+round = around
+round_ = around
+
+
+def clip(a, a_min, a_max, out=None):
+    return invoke("_npi_clip", a, out=out, a_min=a_min, a_max=a_max)
+
+
+def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None,
+             axis=0, ctx=None, device=None):
+    return invoke("_npi_logspace", start=start, stop=stop, num=num,
+                  endpoint=endpoint, base=base,
+                  dtype=_onp.dtype(dtype).name if dtype else None,
+                  ctx=ctx or device)
+
+
+def geomspace(start, stop, num=50, endpoint=True, dtype=None, ctx=None,
+              device=None):
+    return invoke("_npi_geomspace", start=start, stop=stop, num=num,
+                  endpoint=endpoint,
+                  dtype=_onp.dtype(dtype).name if dtype else None,
+                  ctx=ctx or device)

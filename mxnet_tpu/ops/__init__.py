@@ -25,3 +25,5 @@ from . import creation   # noqa: F401
 from . import misc       # noqa: F401
 from . import image      # noqa: F401
 from . import nn_extra   # noqa: F401
+from . import numpy_ops  # noqa: F401
+from . import graph      # noqa: F401

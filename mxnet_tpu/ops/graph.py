@@ -1,0 +1,250 @@
+"""DGL graph-sampling contrib ops.
+
+Reference: ``src/operator/contrib/dgl_graph.cc`` (`_contrib_dgl_csr_neighbor_
+uniform_sample`, `_contrib_dgl_csr_neighbor_non_uniform_sample`,
+`_contrib_dgl_subgraph`, `_contrib_dgl_adjacency`,
+`_contrib_dgl_graph_compact`) — the graph-neural-network sampling kernels
+MXNet grew for DGL.  They are CPU ops with value-dependent output shapes in
+the reference too, so the TPU rebuild keeps them host-side (``no_jit``),
+numpy-computed over CSR storage; the padded fixed-size outputs (``max_num_
+vertices``) exist precisely so downstream compute CAN be jitted on static
+shapes.
+
+Contract notes (mount empty — see SURVEY.md caveat): output layouts follow
+the upstream operator docs: samplers return, per seed array,
+``(padded vertex ids with count in the last slot, sub-CSR over local ids,
+per-vertex layer/hop)``; ``dgl_subgraph`` returns induced sub-CSRs and,
+with ``return_mapping``, CSRs whose data are parent edge ids.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = []
+
+
+def _csr_parts(g):
+    """CSRNDArray | dense-like -> numpy (data, indices, indptr, shape)."""
+    if hasattr(g, "stype") and g.stype == "csr":
+        return (_np.asarray(g.data.asnumpy()),
+                _np.asarray(g.indices.asnumpy()).astype(_np.int64),
+                _np.asarray(g.indptr.asnumpy()).astype(_np.int64),
+                tuple(g.shape))
+    raise TypeError("dgl graph ops need a CSRNDArray adjacency, got %r"
+                    % type(g))
+
+
+def _make_csr(data, indices, indptr, shape):
+    from ..ndarray import sparse as _sp
+    from ..ndarray.ndarray import array as _arr
+    return _sp.CSRNDArray(
+        _arr(_np.asarray(data)),
+        _arr(_np.asarray(indices, _np.int64)),
+        _arr(_np.asarray(indptr, _np.int64)), tuple(shape))
+
+
+def _neigh(indices, indptr, v):
+    return indices[indptr[v]:indptr[v + 1]]
+
+
+@register("_contrib_dgl_adjacency", aliases=["dgl_adjacency"],
+          differentiable=False, no_jit=True)
+def _dgl_adjacency(g):
+    """Same sparsity structure, data replaced by 1.0 (edge indicator)."""
+    data, indices, indptr, shape = _csr_parts(g)
+    return _make_csr(_np.ones_like(data, _np.float32), indices, indptr,
+                     shape)
+
+
+@register("_contrib_dgl_subgraph", aliases=["dgl_subgraph"],
+          differentiable=False, no_jit=True, num_outputs=-1)
+def _dgl_subgraph(g, *vids, return_mapping=False):
+    """Induced subgraph(s) of `g` over each given vertex-id array.
+
+    Outputs: one sub-CSR per vid array (vertices remapped to local ids,
+    data = 1-based local edge ids); with return_mapping, additionally one
+    CSR per vid array whose data are the PARENT edge ids."""
+    _data, indices, indptr, shape = _csr_parts(g)
+    subs, maps = [], []
+    for v in vids:
+        v = _np.asarray(v.asnumpy() if hasattr(v, "asnumpy") else v,
+                        _np.int64).ravel()
+        n = v.shape[0]
+        local = {int(x): i for i, x in enumerate(v)}
+        s_indptr = _np.zeros(n + 1, _np.int64)
+        s_cols, s_orig = [], []
+        for i, x in enumerate(v):
+            row = _neigh(indices, indptr, int(x))
+            eids = _np.arange(indptr[int(x)], indptr[int(x) + 1])
+            for c, e in zip(row, eids):
+                j = local.get(int(c))
+                if j is not None:
+                    s_cols.append(j)
+                    s_orig.append(int(e))
+            s_indptr[i + 1] = len(s_cols)
+        nnz = len(s_cols)
+        subs.append(_make_csr(_np.arange(1, nnz + 1, dtype=_np.float32),
+                              _np.asarray(s_cols, _np.int64), s_indptr,
+                              (n, n)))
+        if return_mapping:
+            maps.append(_make_csr(_np.asarray(s_orig, _np.float32),
+                                  _np.asarray(s_cols, _np.int64), s_indptr,
+                                  (n, n)))
+    return tuple(subs + maps)
+
+
+def _neighbor_sample(rng, indices, indptr, seeds, num_hops, num_neighbor,
+                     max_num_vertices, prob=None):
+    """BFS expansion with per-vertex neighbor subsampling."""
+    seeds = _np.asarray(seeds, _np.int64).ravel()
+    seeds = seeds[seeds >= 0]
+    visited = {}
+    layer_of = {}
+    frontier = []
+    for s in seeds:
+        if len(visited) >= max_num_vertices:
+            break               # seed list larger than the vertex budget
+        if int(s) not in visited:
+            visited[int(s)] = len(visited)
+            layer_of[int(s)] = 0
+            frontier.append(int(s))
+    edges = []                      # (src_local, dst_parent) pairs
+    for hop in range(1, num_hops + 1):
+        nxt = []
+        for v in frontier:
+            nb = _neigh(indices, indptr, v)
+            if nb.shape[0] == 0:
+                continue
+            if nb.shape[0] > num_neighbor:
+                if prob is not None:
+                    p = prob[nb]
+                    psum = p.sum()
+                    if psum <= 0:
+                        continue
+                    chosen = rng.choice(nb, size=num_neighbor, replace=False,
+                                        p=p / psum)
+                else:
+                    chosen = rng.choice(nb, size=num_neighbor, replace=False)
+            else:
+                chosen = nb
+            for c in chosen:
+                c = int(c)
+                if len(visited) >= max_num_vertices and c not in visited:
+                    continue
+                if c not in visited:
+                    visited[c] = len(visited)
+                    layer_of[c] = hop
+                    nxt.append(c)
+                edges.append((visited[v], c))
+        frontier = nxt
+    n = len(visited)
+    verts = _np.full(max_num_vertices + 1, -1, _np.int64)
+    layer = _np.full(max_num_vertices + 1, -1, _np.int64)
+    order = sorted(visited, key=visited.get)
+    verts[:n] = order
+    verts[-1] = n                   # count in the final slot
+    for x in order:
+        layer[visited[x]] = layer_of[x]
+    # build sub-CSR over local ids, padded to max_num_vertices rows
+    rows = [[] for _ in range(max_num_vertices)]
+    for src_local, dst_parent in edges:
+        j = visited.get(dst_parent)
+        if j is not None:
+            rows[src_local].append(j)
+    s_indptr = _np.zeros(max_num_vertices + 1, _np.int64)
+    s_cols = []
+    for i, r in enumerate(rows):
+        s_cols.extend(sorted(set(r)))
+        s_indptr[i + 1] = len(s_cols)
+    nnz = len(s_cols)
+    sub = (_np.arange(1, nnz + 1, dtype=_np.float32),
+           _np.asarray(s_cols, _np.int64), s_indptr,
+           (max_num_vertices, max_num_vertices))
+    return verts, sub, layer
+
+
+@register("_contrib_dgl_csr_neighbor_uniform_sample",
+          aliases=["dgl_csr_neighbor_uniform_sample"],
+          differentiable=False, no_jit=True, needs_rng=True, num_outputs=-1)
+def _dgl_neighbor_uniform(key, g, *seeds, num_hops=1, num_neighbor=2,
+                          max_num_vertices=100):
+    _data, indices, indptr, _shape = _csr_parts(g)
+    rng = _np.random.RandomState(
+        int(_np.asarray(jnp.sum(key.astype(jnp.uint32))) % (2**31 - 1)))
+    vs, subs, layers = [], [], []
+    for s in seeds:
+        s = s.asnumpy() if hasattr(s, "asnumpy") else s
+        verts, sub, layer = _neighbor_sample(
+            rng, indices, indptr, s, int(num_hops), int(num_neighbor),
+            int(max_num_vertices))
+        vs.append(jnp.asarray(verts))
+        subs.append(_make_csr(*sub))
+        layers.append(jnp.asarray(layer))
+    return tuple(vs + subs + layers)
+
+
+@register("_contrib_dgl_csr_neighbor_non_uniform_sample",
+          aliases=["dgl_csr_neighbor_non_uniform_sample"],
+          differentiable=False, no_jit=True, needs_rng=True, num_outputs=-1)
+def _dgl_neighbor_non_uniform(key, g, probability, *seeds, num_hops=1,
+                              num_neighbor=2, max_num_vertices=100):
+    _data, indices, indptr, _shape = _csr_parts(g)
+    prob = _np.asarray(probability.asnumpy()
+                       if hasattr(probability, "asnumpy") else probability,
+                       _np.float64).ravel()
+    rng = _np.random.RandomState(
+        int(_np.asarray(jnp.sum(key.astype(jnp.uint32))) % (2**31 - 1)))
+    vs, subs, layers, probs = [], [], [], []
+    for s in seeds:
+        s = s.asnumpy() if hasattr(s, "asnumpy") else s
+        verts, sub, layer = _neighbor_sample(
+            rng, indices, indptr, s, int(num_hops), int(num_neighbor),
+            int(max_num_vertices), prob=prob)
+        n = int(verts[-1])
+        pv = _np.zeros(int(max_num_vertices) + 1, _np.float64)
+        pv[:n] = prob[verts[:n]] if prob.shape[0] > 0 else 0.0
+        vs.append(jnp.asarray(verts))
+        probs.append(jnp.asarray(pv.astype(_np.float32)))
+        subs.append(_make_csr(*sub))
+        layers.append(jnp.asarray(layer))
+    return tuple(vs + probs + subs + layers)
+
+
+def _compact_one(g, n):
+    data, indices, indptr, _shape = _csr_parts(g)
+    keep = indptr[n]
+    mask = indices[:keep] < n
+    new_cols, new_data = indices[:keep][mask], data[:keep][mask]
+    new_indptr = _np.zeros(n + 1, _np.int64)
+    for i in range(n):
+        seg = indices[indptr[i]:indptr[i + 1]]
+        new_indptr[i + 1] = new_indptr[i] + int((seg < n).sum())
+    return _make_csr(new_data, new_cols, new_indptr, (n, n))
+
+
+@register("_contrib_dgl_graph_compact", aliases=["dgl_graph_compact"],
+          differentiable=False, no_jit=True, num_outputs=-1)
+def _dgl_graph_compact(*graphs, graph_sizes=(), return_mapping=False):
+    """Strip the max_num_vertices padding from sampled subgraphs: each
+    input CSR is truncated to its true vertex count from graph_sizes.
+    With return_mapping, inputs are (g_1..g_k, map_1..map_k) and both
+    halves are compacted with the same sizes (reference arity)."""
+    sizes = [int(x) for x in (graph_sizes if isinstance(graph_sizes,
+                                                        (list, tuple))
+                              else [graph_sizes])]
+    k = len(sizes)
+    expected = 2 * k if return_mapping else k
+    if len(graphs) != expected:
+        raise ValueError(
+            "dgl_graph_compact: got %d graphs but graph_sizes has %d "
+            "entries%s" % (len(graphs), k,
+                           " (x2 for return_mapping)" if return_mapping
+                           else ""))
+    outs = [_compact_one(g, n) for g, n in zip(graphs[:k], sizes)]
+    if return_mapping:
+        outs += [_compact_one(g, n) for g, n in zip(graphs[k:], sizes)]
+    return tuple(outs)

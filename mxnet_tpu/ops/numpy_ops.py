@@ -1,0 +1,897 @@
+"""numpy-semantics internal ops — the ``_npi_*`` namespace.
+
+Reference: ``src/operator/numpy/`` (np_elemwise_broadcast_op.cc,
+np_broadcast_reduce_op_value.cc, np_matrix_op.cc, np_insert_op*.cc, ...)
+registering the ``_npi_*``/``_np_*`` internal ops that
+``python/mxnet/numpy/multiarray.py`` dispatches to.
+
+Semantics note (why these are DISTINCT ops, not aliases of the legacy
+``mx.nd`` surface): the legacy ops carry MXNet conventions — comparisons
+return float32, no int→float promotion, 1-d-minimum outputs — while the
+``_npi_`` layer implements *NumPy* conventions: bool outputs for
+comparisons/logic, NumPy dtype-promotion on mixed inputs, 0-d scalars.
+jax.numpy already implements the NumPy rules, so each op here is a thin
+pure function over jnp — XLA-traceable, jit-cached by the dispatcher,
+and differentiable through ``jax.vjp`` where the math is.
+
+Ops whose OUTPUT SHAPE depends on input *values* (unique, nonzero,
+set ops, ...) are registered ``no_jit`` and computed eagerly — same
+posture as the reference, which runs these on CPU with dynamic outputs.
+
+Routing: ``mxnet_tpu/numpy/__init__.py`` dispatches its function surface
+through these registered names via ``invoke`` so numpy calls hit the
+per-op jit cache and the autograd tape like every other op.
+"""
+from __future__ import annotations
+
+import numpy as _onp
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = []  # everything is reached through the registry
+
+
+def _reg(name, fn, differentiable=True, aliases=(), num_outputs=1,
+         no_jit=False):
+    fn.__name__ = name
+    if not fn.__doc__:
+        fn.__doc__ = ("numpy-semantics %s (reference: src/operator/numpy/ "
+                      "%s registration)" % (name.replace("_npi_", ""), name))
+    register(name, fn, differentiable=differentiable, aliases=aliases,
+             num_outputs=num_outputs, no_jit=no_jit)
+
+
+# ---------------------------------------------------------------------------
+# unary elementwise
+# ---------------------------------------------------------------------------
+
+def _unary(jfn):
+    def fn(a):
+        return jfn(a)
+    return fn
+
+
+_UNARY_DIFF = {
+    "absolute": jnp.absolute, "fabs": jnp.fabs, "negative": jnp.negative,
+    "positive": jnp.positive, "conjugate": jnp.conjugate,
+    "exp": jnp.exp, "exp2": jnp.exp2, "expm1": jnp.expm1,
+    "log": jnp.log, "log2": jnp.log2, "log10": jnp.log10, "log1p": jnp.log1p,
+    "sqrt": jnp.sqrt, "cbrt": jnp.cbrt, "square": jnp.square,
+    "reciprocal": jnp.reciprocal,
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "arcsin": jnp.arcsin, "arccos": jnp.arccos, "arctan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh, "arccosh": jnp.arccosh, "arctanh": jnp.arctanh,
+    "degrees": jnp.degrees, "radians": jnp.radians,
+    "deg2rad": jnp.deg2rad, "rad2deg": jnp.rad2deg,
+    "sinc": jnp.sinc, "i0": jnp.i0,
+}
+
+_UNARY_NONDIFF = {
+    "sign": jnp.sign, "signbit": jnp.signbit,
+    "floor": jnp.floor, "ceil": jnp.ceil, "trunc": jnp.trunc,
+    "rint": jnp.rint, "fix": jnp.trunc,  # np.fix == truncate toward zero
+    "isnan": jnp.isnan, "isinf": jnp.isinf, "isfinite": jnp.isfinite,
+    "isneginf": jnp.isneginf, "isposinf": jnp.isposinf,
+    "logical_not": jnp.logical_not, "bitwise_not": jnp.bitwise_not,
+    "invert": jnp.invert,
+}
+
+for _n, _f in _UNARY_DIFF.items():
+    _reg("_npi_" + _n, _unary(_f))
+for _n, _f in _UNARY_NONDIFF.items():
+    _reg("_npi_" + _n, _unary(_f), differentiable=False)
+
+
+def _npi_around(a, decimals=0):
+    return jnp.round(a, decimals)
+
+
+_reg("_npi_around", _npi_around, differentiable=False,
+     aliases=["_npi_round", "_npi_round_"])
+
+
+def _npi_nan_to_num(a, copy=True, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf)
+
+
+_reg("_npi_nan_to_num", _npi_nan_to_num, differentiable=False)
+
+
+def _npi_real(a):
+    return jnp.real(a)
+
+
+def _npi_imag(a):
+    return jnp.imag(a)
+
+
+_reg("_npi_real", _npi_real)
+_reg("_npi_imag", _npi_imag)
+
+
+# ---------------------------------------------------------------------------
+# binary elementwise (numpy promotion; scalars arrive as arrays or params)
+# ---------------------------------------------------------------------------
+
+def _binary(jfn):
+    def fn(a, b):
+        return jfn(a, b)
+    return fn
+
+
+_BINARY_DIFF = {
+    "add": jnp.add, "subtract": jnp.subtract, "multiply": jnp.multiply,
+    "true_divide": jnp.true_divide, "power": jnp.power,
+    "float_power": jnp.float_power,
+    "arctan2": jnp.arctan2, "hypot": jnp.hypot,
+    "logaddexp": jnp.logaddexp, "logaddexp2": jnp.logaddexp2,
+    "maximum": jnp.maximum, "minimum": jnp.minimum,
+    "fmax": jnp.fmax, "fmin": jnp.fmin,
+    "copysign": jnp.copysign,
+}
+
+_BINARY_NONDIFF = {
+    "floor_divide": jnp.floor_divide, "remainder": jnp.remainder,
+    "fmod": jnp.fmod, "nextafter": jnp.nextafter, "ldexp": jnp.ldexp,
+    "heaviside": jnp.heaviside,
+    "gcd": jnp.gcd, "lcm": jnp.lcm,
+    "bitwise_and": jnp.bitwise_and, "bitwise_or": jnp.bitwise_or,
+    "bitwise_xor": jnp.bitwise_xor,
+    "left_shift": jnp.left_shift, "right_shift": jnp.right_shift,
+    "equal": jnp.equal, "not_equal": jnp.not_equal,
+    "less": jnp.less, "less_equal": jnp.less_equal,
+    "greater": jnp.greater, "greater_equal": jnp.greater_equal,
+    "logical_and": jnp.logical_and, "logical_or": jnp.logical_or,
+    "logical_xor": jnp.logical_xor,
+}
+
+for _n, _f in _BINARY_DIFF.items():
+    _reg("_npi_" + _n, _binary(_f))
+for _n, _f in _BINARY_NONDIFF.items():
+    _reg("_npi_" + _n, _binary(_f), differentiable=False)
+
+
+def _npi_divmod(a, b):
+    return jnp.divmod(a, b)
+
+
+_reg("_npi_divmod", _npi_divmod, differentiable=False, num_outputs=2)
+
+
+def _npi_modf(a):
+    return jnp.modf(a)
+
+
+_reg("_npi_modf", _npi_modf, differentiable=False, num_outputs=2)
+
+
+def _npi_frexp(a):
+    return jnp.frexp(a)
+
+
+_reg("_npi_frexp", _npi_frexp, differentiable=False, num_outputs=2)
+
+
+def _npi_isclose(a, b, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def _npi_allclose(a, b, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def _npi_array_equal(a, b):
+    return jnp.array_equal(a, b)
+
+
+def _npi_array_equiv(a, b):
+    return jnp.array_equiv(a, b)
+
+
+_reg("_npi_isclose", _npi_isclose, differentiable=False)
+_reg("_npi_allclose", _npi_allclose, differentiable=False)
+_reg("_npi_array_equal", _npi_array_equal, differentiable=False)
+_reg("_npi_array_equiv", _npi_array_equiv, differentiable=False)
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+
+def _red(jfn):
+    def fn(a, axis=None, keepdims=False):
+        return jfn(a, axis=axis, keepdims=keepdims)
+    return fn
+
+
+def _red_dtype(jfn):
+    def fn(a, axis=None, dtype=None, keepdims=False):
+        return jfn(a, axis=axis, dtype=dtype, keepdims=keepdims)
+    return fn
+
+
+def _red_ddof(jfn):
+    def fn(a, axis=None, dtype=None, ddof=0, keepdims=False):
+        return jfn(a, axis=axis, dtype=dtype, ddof=ddof, keepdims=keepdims)
+    return fn
+
+
+_reg("_npi_sum", _red_dtype(jnp.sum))
+_reg("_npi_prod", _red_dtype(jnp.prod))
+_reg("_npi_mean", _red_dtype(jnp.mean))
+_reg("_npi_nansum", _red_dtype(jnp.nansum))
+_reg("_npi_nanprod", _red_dtype(jnp.nanprod))
+_reg("_npi_nanmean", _red_dtype(jnp.nanmean))
+_reg("_npi_std", _red_ddof(jnp.std))
+_reg("_npi_var", _red_ddof(jnp.var))
+_reg("_npi_nanstd", _red_ddof(jnp.nanstd))
+_reg("_npi_nanvar", _red_ddof(jnp.nanvar))
+_reg("_npi_amax", _red(jnp.max), aliases=["_npi_max"])
+_reg("_npi_amin", _red(jnp.min), aliases=["_npi_min"])
+_reg("_npi_nanmax", _red(jnp.nanmax))
+_reg("_npi_nanmin", _red(jnp.nanmin))
+_reg("_npi_ptp", _red(jnp.ptp), differentiable=False)
+_reg("_npi_all", _red(jnp.all), differentiable=False)
+_reg("_npi_any", _red(jnp.any), differentiable=False)
+
+
+def _npi_count_nonzero(a, axis=None, keepdims=False):
+    return jnp.count_nonzero(a, axis=axis, keepdims=keepdims)
+
+
+_reg("_npi_count_nonzero", _npi_count_nonzero, differentiable=False)
+
+
+def _arg_red(jfn):
+    def fn(a, axis=None, keepdims=False):
+        out = jfn(a, axis=axis)
+        if keepdims:
+            out = jnp.expand_dims(
+                out, tuple(range(a.ndim)) if axis is None else axis)
+        return out
+    return fn
+
+
+_reg("_npi_argmax", _arg_red(jnp.argmax), differentiable=False)
+_reg("_npi_argmin", _arg_red(jnp.argmin), differentiable=False)
+_reg("_npi_nanargmax", _arg_red(jnp.nanargmax), differentiable=False)
+_reg("_npi_nanargmin", _arg_red(jnp.nanargmin), differentiable=False)
+
+
+def _cum(jfn):
+    def fn(a, axis=None, dtype=None):
+        return jfn(a, axis=axis, dtype=dtype)
+    return fn
+
+
+_reg("_npi_cumsum", _cum(jnp.cumsum))
+_reg("_npi_cumprod", _cum(jnp.cumprod))
+_reg("_npi_nancumsum", _cum(jnp.nancumsum))
+_reg("_npi_nancumprod", _cum(jnp.nancumprod))
+
+
+def _npi_median(a, axis=None, keepdims=False):
+    return jnp.median(a, axis=axis, keepdims=keepdims)
+
+
+def _npi_nanmedian(a, axis=None, keepdims=False):
+    return jnp.nanmedian(a, axis=axis, keepdims=keepdims)
+
+
+def _npi_percentile(a, q, axis=None, method="linear", keepdims=False):
+    return jnp.percentile(a, jnp.asarray(q), axis=axis, method=method,
+                          keepdims=keepdims)
+
+
+def _npi_nanpercentile(a, q, axis=None, method="linear", keepdims=False):
+    return jnp.nanpercentile(a, jnp.asarray(q), axis=axis, method=method,
+                             keepdims=keepdims)
+
+
+def _npi_quantile(a, q, axis=None, method="linear", keepdims=False):
+    return jnp.quantile(a, jnp.asarray(q), axis=axis, method=method,
+                        keepdims=keepdims)
+
+
+def _npi_nanquantile(a, q, axis=None, method="linear", keepdims=False):
+    return jnp.nanquantile(a, jnp.asarray(q), axis=axis, method=method,
+                           keepdims=keepdims)
+
+
+_reg("_npi_median", _npi_median)
+_reg("_npi_nanmedian", _npi_nanmedian)
+_reg("_npi_percentile", _npi_percentile)
+_reg("_npi_nanpercentile", _npi_nanpercentile)
+_reg("_npi_quantile", _npi_quantile)
+_reg("_npi_nanquantile", _npi_nanquantile)
+
+
+def _npi_average(a, weights=None, axis=None):
+    if weights is None:
+        return jnp.mean(a, axis=axis)
+    return jnp.average(a, axis=axis, weights=weights)
+
+
+_reg("_npi_average", _npi_average)
+
+
+def _npi_trapz(y, x=None, dx=1.0, axis=-1):
+    f = getattr(jnp, "trapezoid", None) or jnp.trapz
+    if x is None:
+        return f(y, dx=dx, axis=axis)
+    return f(y, x, axis=axis)
+
+
+_reg("_npi_trapz", _npi_trapz, aliases=["_npi_trapezoid"])
+
+
+# ---------------------------------------------------------------------------
+# shape manipulation
+# ---------------------------------------------------------------------------
+
+def _npi_reshape(a, newshape, order="C"):
+    return jnp.reshape(a, newshape, order=order)
+
+
+def _npi_ravel(a, order="C"):
+    return jnp.ravel(a, order=order)
+
+
+def _npi_transpose(a, axes=None):
+    return jnp.transpose(a, axes)
+
+
+def _npi_swapaxes(a, axis1, axis2):
+    return jnp.swapaxes(a, axis1, axis2)
+
+
+def _npi_moveaxis(a, source, destination):
+    return jnp.moveaxis(a, source, destination)
+
+
+def _npi_rollaxis(a, axis, start=0):
+    return jnp.rollaxis(a, axis, start)
+
+
+def _npi_expand_dims(a, axis):
+    return jnp.expand_dims(a, axis)
+
+
+def _npi_squeeze(a, axis=None):
+    return jnp.squeeze(a, axis)
+
+
+def _npi_broadcast_to(a, shape):
+    return jnp.broadcast_to(a, shape)
+
+
+def _npi_flip(a, axis=None):
+    return jnp.flip(a, axis)
+
+
+def _npi_fliplr(a):
+    return jnp.fliplr(a)
+
+
+def _npi_flipud(a):
+    return jnp.flipud(a)
+
+
+def _npi_roll(a, shift, axis=None):
+    return jnp.roll(a, shift, axis)
+
+
+def _npi_rot90(a, k=1, axes=(0, 1)):
+    return jnp.rot90(a, k, axes)
+
+
+for _n in ("reshape", "ravel", "transpose", "swapaxes", "moveaxis",
+           "rollaxis", "expand_dims", "squeeze", "broadcast_to", "flip",
+           "fliplr", "flipud", "roll", "rot90"):
+    _reg("_npi_" + _n, globals()["_npi_" + _n])
+
+
+def _npi_concatenate(*arrays, axis=0):
+    return jnp.concatenate(arrays, axis=axis)
+
+
+def _npi_stack(*arrays, axis=0):
+    return jnp.stack(arrays, axis=axis)
+
+
+def _npi_column_stack(*arrays):
+    return jnp.column_stack(arrays)
+
+
+def _npi_hstack(*arrays):
+    return jnp.hstack(arrays)
+
+
+def _npi_vstack(*arrays):
+    return jnp.vstack(arrays)
+
+
+def _npi_dstack(*arrays):
+    return jnp.dstack(arrays)
+
+
+_reg("_npi_concatenate", _npi_concatenate, aliases=["_npi_concat"])
+_reg("_npi_stack", _npi_stack)
+_reg("_npi_column_stack", _npi_column_stack)
+_reg("_npi_hstack", _npi_hstack)
+_reg("_npi_vstack", _npi_vstack)
+_reg("_npi_dstack", _npi_dstack)
+
+
+def _split_like(jfn):
+    def fn(a, indices_or_sections, axis=0):
+        return tuple(jfn(a, indices_or_sections, axis=axis))
+    return fn
+
+
+_reg("_npi_split", _split_like(jnp.split), num_outputs=-1)
+_reg("_npi_array_split", _split_like(jnp.array_split), num_outputs=-1)
+
+
+def _npi_hsplit(a, indices_or_sections):
+    return tuple(jnp.hsplit(a, indices_or_sections))
+
+
+def _npi_vsplit(a, indices_or_sections):
+    return tuple(jnp.vsplit(a, indices_or_sections))
+
+
+def _npi_dsplit(a, indices_or_sections):
+    return tuple(jnp.dsplit(a, indices_or_sections))
+
+
+_reg("_npi_hsplit", _npi_hsplit, num_outputs=-1)
+_reg("_npi_vsplit", _npi_vsplit, num_outputs=-1)
+_reg("_npi_dsplit", _npi_dsplit, num_outputs=-1)
+
+
+def _npi_repeat(a, repeats, axis=None):
+    return jnp.repeat(a, repeats, axis=axis)
+
+
+def _npi_tile(a, reps):
+    return jnp.tile(a, reps)
+
+
+def _npi_append(arr, values, axis=None):
+    return jnp.append(arr, values, axis=axis)
+
+
+_reg("_npi_repeat", _npi_repeat)
+_reg("_npi_tile", _npi_tile)
+_reg("_npi_append", _npi_append)
+
+
+def _npi_pad(a, pad_width, mode="constant", constant_values=0):
+    if mode == "constant":
+        return jnp.pad(a, pad_width, mode, constant_values=constant_values)
+    return jnp.pad(a, pad_width, mode)
+
+
+_reg("_npi_pad", _npi_pad)
+
+
+def _npi_delete(arr, obj, axis=None):
+    # static obj (int/slice/index list passed as attr) -> static out shape
+    return jnp.delete(arr, obj if not isinstance(obj, list) else
+                      jnp.asarray(obj), axis=axis)
+
+
+def _npi_insert(arr, values, obj, axis=None):
+    return jnp.insert(arr, obj if not isinstance(obj, list) else
+                      jnp.asarray(obj), values, axis=axis)
+
+
+_reg("_npi_delete", _npi_delete, no_jit=True, differentiable=False)
+_reg("_npi_insert", _npi_insert, no_jit=True, differentiable=False)
+
+
+def _npi_trim_zeros(filt, trim="fb"):
+    return jnp.asarray(_onp.trim_zeros(_onp.asarray(filt), trim))
+
+
+_reg("_npi_trim_zeros", _npi_trim_zeros, no_jit=True, differentiable=False)
+
+
+# ---------------------------------------------------------------------------
+# indexing / selection
+# ---------------------------------------------------------------------------
+
+def _npi_take(a, indices, axis=None, mode="clip"):
+    return jnp.take(a, indices, axis=axis, mode=mode)
+
+
+def _npi_take_along_axis(a, indices, axis):
+    return jnp.take_along_axis(a, indices, axis=axis)
+
+
+def _npi_compress(condition, a, axis=None):
+    return jnp.asarray(_onp.compress(_onp.asarray(condition),
+                                     _onp.asarray(a), axis=axis))
+
+
+def _npi_extract(condition, arr):
+    return jnp.asarray(_onp.extract(_onp.asarray(condition),
+                                    _onp.asarray(arr)))
+
+
+def _npi_choose(a, *choices, mode="clip"):
+    return jnp.choose(a, list(choices), mode=mode)
+
+
+def _npi_select(*args, default=0):
+    n = len(args) // 2
+    return jnp.select(list(args[:n]), list(args[n:]), default=default)
+
+
+def _npi_where(condition, x, y):
+    return jnp.where(condition, x, y)
+
+
+_reg("_npi_take", _npi_take)
+_reg("_npi_take_along_axis", _npi_take_along_axis)
+_reg("_npi_compress", _npi_compress, no_jit=True, differentiable=False)
+_reg("_npi_extract", _npi_extract, no_jit=True, differentiable=False)
+_reg("_npi_choose", _npi_choose, differentiable=False)
+_reg("_npi_select", _npi_select)
+_reg("_npi_where", _npi_where)
+
+
+def _npi_nonzero(a):
+    return tuple(jnp.asarray(i) for i in _onp.nonzero(_onp.asarray(a)))
+
+
+def _npi_flatnonzero(a):
+    return jnp.asarray(_onp.flatnonzero(_onp.asarray(a)))
+
+
+def _npi_argwhere(a):
+    return jnp.asarray(_onp.argwhere(_onp.asarray(a)))
+
+
+_reg("_npi_nonzero", _npi_nonzero, no_jit=True, differentiable=False,
+     num_outputs=-1)
+_reg("_npi_flatnonzero", _npi_flatnonzero, no_jit=True, differentiable=False)
+_reg("_npi_argwhere", _npi_argwhere, no_jit=True, differentiable=False)
+
+
+def _npi_searchsorted(a, v, side="left"):
+    return jnp.searchsorted(a, v, side=side)
+
+
+_reg("_npi_searchsorted", _npi_searchsorted, differentiable=False)
+
+
+def _npi_unravel_index(indices, shape):
+    return tuple(jnp.unravel_index(indices, shape))
+
+
+def _npi_ravel_multi_index(*multi_index, dims, mode="clip"):
+    return jnp.ravel_multi_index(multi_index, dims, mode=mode)
+
+
+_reg("_npi_unravel_index", _npi_unravel_index, differentiable=False,
+     num_outputs=-1)
+_reg("_npi_ravel_multi_index", _npi_ravel_multi_index, differentiable=False)
+
+
+def _npi_diag_indices_from(a):
+    return tuple(jnp.diag_indices_from(a))
+
+
+def _npi_tril_indices(n, k=0, m=None):
+    return tuple(jnp.tril_indices(n, k, m))
+
+
+def _npi_triu_indices(n, k=0, m=None):
+    return tuple(jnp.triu_indices(n, k, m))
+
+
+def _npi_indices(dimensions, dtype=None):
+    return jnp.indices(tuple(dimensions),
+                       dtype=dtype or jnp.int32)
+
+
+_reg("_npi_diag_indices_from", _npi_diag_indices_from, differentiable=False,
+     num_outputs=-1)
+_reg("_npi_tril_indices", _npi_tril_indices, differentiable=False,
+     num_outputs=2)
+_reg("_npi_triu_indices", _npi_triu_indices, differentiable=False,
+     num_outputs=2)
+_reg("_npi_indices", _npi_indices, differentiable=False)
+
+
+# ---------------------------------------------------------------------------
+# linear algebra (numpy calling conventions; dense MXU work)
+# ---------------------------------------------------------------------------
+
+def _npi_dot(a, b):
+    return jnp.dot(a, b)
+
+
+def _npi_vdot(a, b):
+    return jnp.vdot(a, b)
+
+
+def _npi_inner(a, b):
+    return jnp.inner(a, b)
+
+
+def _npi_outer(a, b):
+    return jnp.outer(a, b)
+
+
+def _npi_matmul(a, b):
+    return jnp.matmul(a, b)
+
+
+def _npi_tensordot(a, b, axes=2):
+    if isinstance(axes, list):
+        axes = tuple(tuple(x) if isinstance(x, list) else x for x in axes)
+    return jnp.tensordot(a, b, axes=axes)
+
+
+def _npi_trace_np(a, offset=0, axis1=0, axis2=1):
+    return jnp.trace(a, offset, axis1, axis2)
+
+
+_reg("_npi_dot", _npi_dot)
+_reg("_npi_vdot", _npi_vdot)
+_reg("_npi_inner", _npi_inner)
+_reg("_npi_outer", _npi_outer)
+_reg("_npi_matmul", _npi_matmul)
+_reg("_npi_tensordot", _npi_tensordot)
+_reg("_npi_trace", _npi_trace_np)
+
+
+# ---------------------------------------------------------------------------
+# set operations (value-dependent shapes: eager numpy, reference posture)
+# ---------------------------------------------------------------------------
+
+def _npi_unique(a, return_index=False, return_inverse=False,
+                return_counts=False, axis=None):
+    out = _onp.unique(_onp.asarray(a), return_index=return_index,
+                      return_inverse=return_inverse,
+                      return_counts=return_counts, axis=axis)
+    if isinstance(out, tuple):
+        return tuple(jnp.asarray(o) for o in out)
+    return jnp.asarray(out)
+
+
+def _npi_isin(element, test_elements, invert=False):
+    return jnp.isin(element, test_elements, invert=invert)
+
+
+def _npi_in1d(ar1, ar2, invert=False):
+    return jnp.isin(jnp.ravel(ar1), ar2, invert=invert)
+
+
+def _npi_intersect1d(ar1, ar2):
+    return jnp.asarray(_onp.intersect1d(_onp.asarray(ar1),
+                                        _onp.asarray(ar2)))
+
+
+def _npi_union1d(ar1, ar2):
+    return jnp.asarray(_onp.union1d(_onp.asarray(ar1), _onp.asarray(ar2)))
+
+
+def _npi_setdiff1d(ar1, ar2):
+    return jnp.asarray(_onp.setdiff1d(_onp.asarray(ar1), _onp.asarray(ar2)))
+
+
+def _npi_setxor1d(ar1, ar2):
+    return jnp.asarray(_onp.setxor1d(_onp.asarray(ar1), _onp.asarray(ar2)))
+
+
+_reg("_npi_unique", _npi_unique, no_jit=True, differentiable=False,
+     num_outputs=-1)
+_reg("_npi_isin", _npi_isin, differentiable=False)
+_reg("_npi_in1d", _npi_in1d, differentiable=False)
+for _n in ("intersect1d", "union1d", "setdiff1d", "setxor1d"):
+    _reg("_npi_" + _n, globals()["_npi_" + _n], no_jit=True,
+         differentiable=False)
+
+
+# ---------------------------------------------------------------------------
+# sorting
+# ---------------------------------------------------------------------------
+
+def _npi_sort(a, axis=-1, kind=None):
+    return jnp.sort(a, axis=axis)
+
+
+def _npi_argsort_np(a, axis=-1, kind=None):
+    return jnp.argsort(a, axis=axis)
+
+
+def _npi_lexsort(*keys, axis=-1):
+    return jnp.lexsort(keys, axis=axis)
+
+
+def _npi_partition(a, kth, axis=-1):
+    return jnp.partition(a, kth, axis=axis)
+
+
+def _npi_argpartition(a, kth, axis=-1):
+    return jnp.argpartition(a, kth, axis=axis)
+
+
+def _npi_msort(a):
+    return jnp.sort(a, axis=0)
+
+
+_reg("_npi_sort", _npi_sort)
+_reg("_npi_argsort", _npi_argsort_np, differentiable=False)
+_reg("_npi_lexsort", _npi_lexsort, differentiable=False)
+_reg("_npi_partition", _npi_partition, differentiable=False)
+_reg("_npi_argpartition", _npi_argpartition, differentiable=False)
+_reg("_npi_msort", _npi_msort)
+
+
+# ---------------------------------------------------------------------------
+# math misc
+# ---------------------------------------------------------------------------
+
+def _npi_clip(a, a_min=None, a_max=None):
+    return jnp.clip(a, a_min, a_max)
+
+
+def _npi_interp_np(x, xp, fp, left=None, right=None):
+    return jnp.interp(x, xp, fp, left=left, right=right)
+
+
+def _npi_ediff1d(ary, to_end=None, to_begin=None):
+    return jnp.ediff1d(ary, to_end=to_end, to_begin=to_begin)
+
+
+def _npi_diff(a, n=1, axis=-1):
+    return jnp.diff(a, n=n, axis=axis)
+
+
+def _npi_gradient(f, *varargs, axis=None):
+    out = jnp.gradient(f, *varargs, axis=axis)
+    if isinstance(out, list):
+        return tuple(out)
+    return out
+
+
+def _npi_convolve(a, v, mode="full"):
+    return jnp.convolve(a, v, mode=mode)
+
+
+def _npi_correlate(a, v, mode="valid"):
+    return jnp.correlate(a, v, mode=mode)
+
+
+def _npi_polyval(p, x):
+    return jnp.polyval(p, x)
+
+
+def _npi_corrcoef(x):
+    return jnp.corrcoef(x)
+
+
+def _npi_cov(m, rowvar=True, bias=False, ddof=None):
+    return jnp.cov(m, rowvar=rowvar, bias=bias, ddof=ddof)
+
+
+def _npi_histogram(a, weights=None, bins=10, range=None, density=False):
+    h, e = jnp.histogram(a, bins=bins, range=range, weights=weights,
+                         density=density)
+    return h, e
+
+
+def _npi_bincount(x, weights=None, minlength=0):
+    # numpy semantics: out length = max(x)+1 (value-dependent) -> eager
+    return jnp.asarray(_onp.bincount(_onp.asarray(x),
+                                     None if weights is None
+                                     else _onp.asarray(weights),
+                                     minlength))
+
+
+def _npi_digitize(x, bins, right=False):
+    return jnp.digitize(x, bins, right=right)
+
+
+_reg("_npi_clip", _npi_clip)
+_reg("_npi_interp", _npi_interp_np)
+_reg("_npi_ediff1d", _npi_ediff1d)
+_reg("_npi_diff", _npi_diff)
+_reg("_npi_gradient", _npi_gradient, num_outputs=-1)
+_reg("_npi_convolve", _npi_convolve)
+_reg("_npi_correlate", _npi_correlate)
+_reg("_npi_polyval", _npi_polyval)
+_reg("_npi_corrcoef", _npi_corrcoef)
+_reg("_npi_cov", _npi_cov)
+_reg("_npi_histogram", _npi_histogram, differentiable=False, num_outputs=2)
+_reg("_npi_bincount", _npi_bincount, no_jit=True, differentiable=False)
+_reg("_npi_digitize", _npi_digitize, differentiable=False)
+
+
+# ---------------------------------------------------------------------------
+# windows + creation-like
+# ---------------------------------------------------------------------------
+
+def _win(jfn):
+    def fn(M, dtype=None):
+        out = jfn(int(M))
+        return out.astype(dtype) if dtype else out
+    return fn
+
+
+_reg("_npi_bartlett", _win(jnp.bartlett), differentiable=False)
+_reg("_npi_kaiser",
+     (lambda M, beta=0.0, dtype=None:
+      jnp.kaiser(int(M), beta).astype(dtype)
+      if dtype else jnp.kaiser(int(M), beta)),
+     differentiable=False)
+_reg("_npi_blackman_np", _win(jnp.blackman), differentiable=False,
+     aliases=["_npi_blackman"])
+_reg("_npi_hamming_np", _win(jnp.hamming), differentiable=False,
+     aliases=["_npi_hamming"])
+_reg("_npi_hanning_np", _win(jnp.hanning), differentiable=False,
+     aliases=["_npi_hanning"])
+
+
+def _npi_full_like(a, fill_value, dtype=None):
+    return jnp.full_like(a, fill_value, dtype=dtype)
+
+
+def _npi_empty_like(a, dtype=None):
+    return jnp.empty_like(a, dtype=dtype)
+
+
+def _npi_identity(n, dtype=None):
+    return jnp.identity(int(n), dtype=dtype)
+
+
+def _npi_tri(N, M=None, k=0, dtype=None):
+    return jnp.tri(int(N), M if M is None else int(M), k,
+                   dtype=dtype or jnp.float32)
+
+
+def _npi_diagflat(v, k=0):
+    return jnp.diagflat(v, k)
+
+
+def _npi_vander(x, N=None, increasing=False):
+    return jnp.vander(x, N, increasing=increasing)
+
+
+def _npi_meshgrid(*xi, indexing="xy", sparse=False):
+    return tuple(jnp.meshgrid(*xi, indexing=indexing, sparse=sparse))
+
+
+def _npi_broadcast_arrays(*args):
+    return tuple(jnp.broadcast_arrays(*args))
+
+
+def _npi_logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None):
+    return jnp.logspace(start, stop, int(num), endpoint=endpoint, base=base,
+                        dtype=dtype)
+
+
+def _npi_geomspace(start, stop, num=50, endpoint=True, dtype=None):
+    return jnp.geomspace(start, stop, int(num), endpoint=endpoint,
+                         dtype=dtype)
+
+
+_reg("_npi_full_like", _npi_full_like, differentiable=False)
+_reg("_npi_empty_like", _npi_empty_like, differentiable=False)
+_reg("_npi_identity", _npi_identity, differentiable=False)
+_reg("_npi_tri", _npi_tri, differentiable=False)
+_reg("_npi_diagflat", _npi_diagflat)
+_reg("_npi_vander", _npi_vander, differentiable=False)
+_reg("_npi_meshgrid", _npi_meshgrid, differentiable=False, num_outputs=-1)
+_reg("_npi_broadcast_arrays", _npi_broadcast_arrays, num_outputs=-1)
+_reg("_npi_logspace", _npi_logspace, differentiable=False)
+_reg("_npi_geomspace", _npi_geomspace, differentiable=False)

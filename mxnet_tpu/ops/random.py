@@ -381,3 +381,133 @@ def _sample_unique_zipfian(key, range_max=1, shape=()):
     cnt = prob * trials
     return (jnp.asarray(samples),
             jnp.asarray(cnt.astype(np.float32)))
+
+
+# ---------------------------------------------------------------------------
+# *_like variants (reference: sample_op.cc _random_uniform_like & co) —
+# draw with the template array's shape and dtype
+# ---------------------------------------------------------------------------
+
+
+@register("_random_uniform_like", aliases=["random_uniform_like"],
+          differentiable=False, needs_rng=True)
+def _uniform_like(key, data, low=0.0, high=1.0):
+    return jax.random.uniform(key, data.shape, data.dtype,
+                              minval=low, maxval=high)
+
+
+@register("_random_normal_like", aliases=["random_normal_like"],
+          differentiable=False, needs_rng=True)
+def _random_normal_like(key, data, loc=0.0, scale=1.0):
+    return jax.random.normal(key, data.shape, data.dtype) * scale + loc
+
+
+@register("_random_exponential_like", aliases=["random_exponential_like"],
+          differentiable=False, needs_rng=True)
+def _exponential_like(key, data, lam=1.0):
+    return jax.random.exponential(key, data.shape, data.dtype) / lam
+
+
+@register("_random_gamma_like", aliases=["random_gamma_like"],
+          differentiable=False, needs_rng=True)
+def _gamma_like(key, data, alpha=1.0, beta=1.0):
+    return jax.random.gamma(key, alpha, data.shape, data.dtype) * beta
+
+
+@register("_random_poisson_like", aliases=["random_poisson_like"],
+          differentiable=False, needs_rng=True)
+def _poisson_like(key, data, lam=1.0):
+    return jax.random.poisson(key, lam, data.shape).astype(data.dtype)
+
+
+@register("_random_negative_binomial_like",
+          aliases=["random_negative_binomial_like"],
+          differentiable=False, needs_rng=True)
+def _negative_binomial_like(key, data, k=1, p=1.0):
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, float(k), data.shape, jnp.float32) * \
+        ((1.0 - p) / max(p, 1e-12))
+    return jax.random.poisson(k2, lam, data.shape).astype(data.dtype)
+
+
+@register("_random_generalized_negative_binomial_like",
+          aliases=["random_generalized_negative_binomial_like"],
+          differentiable=False, needs_rng=True)
+def _gnb_like(key, data, mu=1.0, alpha=1.0):
+    a = 1.0 / max(alpha, 1e-12)
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, a, data.shape, jnp.float32) * (mu * alpha)
+    return jax.random.poisson(k2, lam, data.shape).astype(data.dtype)
+
+
+# numpy-era samplers (reference: src/operator/numpy/random/*.cc)
+
+
+@register("_npi_uniform", differentiable=False, needs_rng=True)
+def _npi_uniform(key, low=0.0, high=1.0, size=(), dtype=None):
+    return jax.random.uniform(key, size or (), _dt(dtype),
+                              minval=low, maxval=high)
+
+
+@register("_npi_normal", differentiable=False, needs_rng=True)
+def _npi_normal(key, loc=0.0, scale=1.0, size=(), dtype=None):
+    return jax.random.normal(key, size or (), _dt(dtype)) * scale + loc
+
+
+@register("_npi_choice", differentiable=False, needs_rng=True, no_jit=True)
+def _npi_choice(key, a, size=(), replace=True, p=None):
+    return jax.random.choice(key, a, size or (), replace=replace, p=p)
+
+
+@register("_npi_laplace", aliases=["random_laplace", "laplace"],
+          differentiable=False, needs_rng=True)
+def _npi_laplace(key, loc=0.0, scale=1.0, size=(), dtype=None):
+    return jax.random.laplace(key, size or (), _dt(dtype)) * scale + loc
+
+
+@register("_npi_beta", aliases=["random_beta", "beta"],
+          differentiable=False, needs_rng=True)
+def _npi_beta(key, a=1.0, b=1.0, size=(), dtype=None):
+    return jax.random.beta(key, a, b, size or (), _dt(dtype))
+
+
+@register("_npi_chisquare", aliases=["random_chisquare", "chisquare"],
+          differentiable=False, needs_rng=True)
+def _npi_chisquare(key, df=1.0, size=(), dtype=None):
+    return jax.random.chisquare(key, df, shape=size or (), dtype=_dt(dtype))
+
+
+@register("_npi_f", aliases=["random_f"],
+          differentiable=False, needs_rng=True)
+def _npi_f(key, dfnum=1.0, dfden=1.0, size=(), dtype=None):
+    return jax.random.f(key, dfnum, dfden, shape=size or (),
+                        dtype=_dt(dtype))
+
+
+@register("_npi_standard_t", aliases=["random_standard_t", "standard_t"],
+          differentiable=False, needs_rng=True)
+def _npi_standard_t(key, df=1.0, size=(), dtype=None):
+    return jax.random.t(key, df, shape=size or (), dtype=_dt(dtype))
+
+
+@register("_npi_lognormal", aliases=["random_lognormal", "lognormal"],
+          differentiable=False, needs_rng=True)
+def _npi_lognormal(key, mean=0.0, sigma=1.0, size=(), dtype=None):
+    return jnp.exp(jax.random.normal(key, size or (), _dt(dtype))
+                   * sigma + mean)
+
+
+@register("_npi_triangular", aliases=["random_triangular", "triangular"],
+          differentiable=False, needs_rng=True)
+def _npi_triangular(key, left=0.0, mode=0.5, right=1.0, size=(), dtype=None):
+    dt = _dt(dtype)
+    u = jax.random.uniform(key, size or (), dt)
+    c = (mode - left) / (right - left)
+    lo = left + jnp.sqrt(u * (right - left) * (mode - left))
+    hi = right - jnp.sqrt((1 - u) * (right - left) * (right - mode))
+    return jnp.where(u < c, lo, hi)
+
+
+@register("_npi_permutation", differentiable=False, needs_rng=True)
+def _npi_permutation(key, x):
+    return jax.random.permutation(key, x, axis=0)

@@ -1,0 +1,119 @@
+"""DGL graph-sampling contrib ops (reference:
+src/operator/contrib/dgl_graph.cc; tests/python/unittest/test_dgl_graph.py
+pattern — structural invariants over small CSR graphs)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def ring(n=6):
+    """Directed ring + chord graph as CSR."""
+    indptr = np.arange(0, 2 * n + 1, 2, dtype=np.int64)
+    indices = np.empty(2 * n, np.int64)
+    for v in range(n):
+        indices[2 * v] = (v + 1) % n
+        indices[2 * v + 1] = (v + 2) % n
+    data = np.arange(1, 2 * n + 1, dtype=np.float32)
+    return nd.sparse.csr_matrix((data, indices, indptr), shape=(n, n))
+
+
+def to_dense(csr):
+    return csr.tostype("default").asnumpy()
+
+
+def test_dgl_adjacency():
+    g = ring()
+    adj = mx.nd.contrib.dgl_adjacency(g)
+    assert adj.stype == "csr"
+    d = to_dense(adj)
+    assert set(np.unique(d)) <= {0.0, 1.0}
+    assert (d != 0).sum() == 12          # same structure as parent
+    assert ((to_dense(g) != 0) == (d != 0)).all()
+
+
+def test_dgl_subgraph_induced():
+    g = ring()
+    vids = nd.array(np.array([0, 1, 2], np.int64))
+    sub, mapping = mx.nd.contrib.dgl_subgraph(g, vids, return_mapping=True)
+    assert sub.shape == (3, 3) and mapping.shape == (3, 3)
+    parent = to_dense(g)
+    subd = to_dense(sub)
+    md = to_dense(mapping)
+    v = [0, 1, 2]
+    for i in range(3):
+        for j in range(3):
+            # edge present in subgraph iff present between parent vertices
+            assert (subd[i, j] != 0) == (parent[v[i], v[j]] != 0)
+            if md[i, j] != 0:
+                # mapping data = parent edge id = index into g.data
+                eid = int(md[i, j])
+                lo, hi = int(g.indptr.asnumpy()[v[i]]), \
+                    int(g.indptr.asnumpy()[v[i] + 1])
+                assert lo <= eid < hi
+                assert int(g.indices.asnumpy()[eid]) == v[j]
+
+
+def test_dgl_uniform_sample_invariants():
+    g = ring(8)
+    mx.random.seed(3)
+    out = mx.nd.contrib.dgl_csr_neighbor_uniform_sample(
+        g, nd.array(np.array([0], np.int64)),
+        num_hops=2, num_neighbor=2, max_num_vertices=8)
+    verts, sub, layer = out
+    v = verts.asnumpy()
+    n = int(v[-1])
+    assert 1 <= n <= 8
+    assert v[0] == 0                       # seed first
+    lay = layer.asnumpy()
+    assert lay[0] == 0
+    assert (lay[:n] >= 0).all() and (lay[:n] <= 2).all()
+    # every sampled edge exists in the parent graph
+    parent = to_dense(g)
+    subd = to_dense(sub)
+    for i in range(n):
+        for j in range(n):
+            if subd[i, j] != 0:
+                assert parent[int(v[i]), int(v[j])] != 0
+
+
+def test_dgl_non_uniform_sample_respects_zero_prob():
+    g = ring(6)
+    # forbid vertex 1 entirely: its sampling probability is 0
+    prob = np.ones(6, np.float32)
+    prob[1] = 0.0
+    mx.random.seed(0)
+    out = mx.nd.contrib.dgl_csr_neighbor_non_uniform_sample(
+        g, nd.array(prob), nd.array(np.array([0], np.int64)),
+        num_hops=3, num_neighbor=1, max_num_vertices=6)
+    verts, pv, sub, layer = out
+    v = verts.asnumpy()
+    n = int(v[-1])
+    assert 1 not in v[:n].tolist()
+    # returned probabilities align with the sampled vertices
+    assert np.allclose(pv.asnumpy()[:n], prob[v[:n]])
+
+
+def test_dgl_graph_compact():
+    g = ring(8)
+    mx.random.seed(1)
+    verts, sub, _layer = mx.nd.contrib.dgl_csr_neighbor_uniform_sample(
+        g, nd.array(np.array([2], np.int64)),
+        num_hops=1, num_neighbor=2, max_num_vertices=8)
+    n = int(verts.asnumpy()[-1])
+    compact = mx.nd.contrib.dgl_graph_compact(sub, graph_sizes=(n,))
+    c = compact[0] if isinstance(compact, (list, tuple)) else compact
+    assert c.shape == (n, n)
+    # compaction preserves the live block
+    assert (to_dense(sub)[:n, :n] != 0).sum() == (to_dense(c) != 0).sum()
+
+
+def test_sparse_storage_fallback_warns():
+    """Dense-only ops densify sparse inputs with a one-time warning
+    (reference storage-fallback semantics)."""
+    g = ring(4)
+    with pytest.warns(UserWarning, match="storage-fallback|no sparse"):
+        out = nd.sum(g)
+    got = float(out.asnumpy()) if hasattr(out, "asnumpy") else float(out)
+    assert np.isclose(got, g.data.asnumpy().sum())

@@ -323,3 +323,45 @@ def test_np_random_binomial_array_p():
     b = np.random.binomial(10, onp.array([0.0, 1.0], onp.float32),
                            size=(2,))
     assert b.asnumpy().tolist() == [0, 10]
+
+
+def test_npi_routing_numpy_semantics():
+    """mx.np dispatches through the registered _npi_* layer: comparisons
+    give bool, mixed dtypes promote numpy-style, results are tape-aware."""
+    a = np.array([1.0, 2.0, 3.0])
+    b = np.array([2.0, 2.0, 2.0])
+    eq = np.equal(a, b)
+    assert eq.dtype == onp.bool_, eq.dtype
+    assert np.less(a, b).asnumpy().tolist() == [True, False, False]
+    # int + float promotes (legacy mx.nd ops would not)
+    i = np.array(onp.array([1, 2, 3], onp.int32))
+    s = np.add(i, np.array([0.5, 0.5, 0.5]))
+    assert "float" in str(s.dtype)
+    # divmod / modf multi-output
+    q, r = np.divmod(a, b)
+    assert q.asnumpy().tolist() == [0.0, 1.0, 1.0]
+    assert r.asnumpy().tolist() == [1.0, 0.0, 1.0]
+
+
+def test_npi_routing_autograd():
+    """_npi ops record on the tape like every registry op."""
+    import mxnet_tpu as mx
+    x = np.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with mx.autograd.record():
+        y = np.sum(np.multiply(x, x))
+    y.backward()
+    assert onp.allclose(x.grad.asnumpy(), [2.0, 4.0, 6.0])
+
+
+def test_npi_unique_and_sets():
+    a = np.array(onp.array([3, 1, 2, 3, 1], onp.int32))
+    u = np.unique(a)
+    assert u.asnumpy().tolist() == [1, 2, 3]
+    u, idx, inv, cnt = np.unique(a, return_index=True, return_inverse=True,
+                                  return_counts=True)
+    assert cnt.asnumpy().tolist() == [2, 1, 2]
+    assert np.setdiff1d(a, np.array(onp.array([1], onp.int32))
+                         ).asnumpy().tolist() == [2, 3]
+    got = np.isin(a, np.array(onp.array([1, 2], onp.int32)))
+    assert got.asnumpy().tolist() == [False, True, True, False, True]

@@ -1063,6 +1063,355 @@ def _anchors():
                 sizes=(0.5,), ratios=(1.0,)).asnumpy()
 
 
+# --- _npi_* numpy-semantics layer (ops/numpy_ops.py) -----------------------
+# Each op mirrors one numpy function, so the reference IS that function.
+
+def sep(*shape):
+    """Well-separated values: numeric grad safe at order statistics."""
+    flat = np.argsort(R.rand(int(np.prod(shape))))
+    return (flat.reshape(shape).astype(np.float32)
+            + R.uniform(0.1, 0.3, shape).astype(np.float32))
+
+
+_NPI_UNARY_GEN = {
+    "log": fpos, "log2": fpos, "log10": fpos, "log1p": fpos, "sqrt": fpos,
+    "cbrt": fpos, "arccosh": lambda *s: 1.0 + fpos(*s), "arcsin": funit,
+    "arccos": funit, "arctanh": funit, "i0": fpos,
+}
+_NPI_UNARY = [
+    "absolute", "fabs", "negative", "positive", "conjugate", "exp", "exp2",
+    "expm1", "log", "log2", "log10", "log1p", "sqrt", "cbrt", "square",
+    "reciprocal", "sin", "cos", "tan", "arcsin", "arccos", "arctan", "sinh",
+    "cosh", "tanh", "arcsinh", "arccosh", "arctanh", "degrees", "radians",
+    "deg2rad", "rad2deg", "sinc", "i0", "sign", "signbit", "floor", "ceil",
+    "trunc", "rint", "fix", "isnan", "isinf", "isfinite", "isneginf",
+    "isposinf", "logical_not", "real", "imag",
+]
+for _n in _NPI_UNARY:
+    _gen = _NPI_UNARY_GEN.get(_n, f)
+    SPECS["_npi_" + _n] = S(lambda g=_gen: [g(3, 4)], ref=getattr(np, _n))
+SPECS["_npi_bitwise_not"] = S(lambda: [ints(3, 4)], ref=np.bitwise_not)
+SPECS["_npi_invert"] = S(lambda: [ints(3, 4)], ref=np.invert)
+SPECS["_npi_around"] = S(lambda: [f(3, 4)], {"decimals": 1},
+                         ref=lambda x: np.around(x, 1))
+SPECS["_npi_nan_to_num"] = S(lambda: [f(3, 4)], ref=np.nan_to_num)
+
+_NPI_BINARY = [
+    "add", "subtract", "multiply", "true_divide", "power", "float_power",
+    "arctan2", "hypot", "logaddexp", "logaddexp2", "maximum", "minimum",
+    "fmax", "fmin", "copysign", "floor_divide", "remainder", "fmod",
+    "nextafter", "ldexp", "heaviside", "equal", "not_equal", "less",
+    "less_equal", "greater", "greater_equal", "logical_and", "logical_or",
+    "logical_xor",
+]
+for _n in _NPI_BINARY:
+    _r = getattr(np, _n)
+    if _n in ("power", "float_power"):
+        SPECS["_npi_" + _n] = S(lambda: [fpos(3, 4), f(3, 4)], ref=_r)
+    else:
+        SPECS["_npi_" + _n] = S(lambda: [f(3, 4), fpos(3, 4)], ref=_r,
+                                rtol=1e-4, atol=1e-4)
+for _n in ("gcd", "lcm", "bitwise_and", "bitwise_or", "bitwise_xor"):
+    SPECS["_npi_" + _n] = S(lambda: [ints(2, 5, lo=1), ints(2, 5, lo=1)],
+                            ref=getattr(np, _n))
+SPECS["_npi_ldexp"] = S(lambda: [f(3, 4), ints(3, 4, hi=4)], ref=np.ldexp)
+SPECS["_npi_left_shift"] = S(lambda: [ints(3, 4), ints(3, 4, hi=4)],
+                             ref=np.left_shift)
+SPECS["_npi_right_shift"] = S(lambda: [ints(3, 4, lo=8, hi=64),
+                                       ints(3, 4, hi=3)], ref=np.right_shift)
+SPECS["_npi_divmod"] = S(lambda: [f(3, 4), fpos(3, 4)],
+                         ref=lambda a, b: np.divmod(a, b))
+SPECS["_npi_modf"] = S(lambda: [f(3, 4)], ref=lambda a: np.modf(a))
+SPECS["_npi_frexp"] = S(lambda: [fpos(3, 4)], ref=lambda a: np.frexp(a))
+SPECS["_npi_isclose"] = S(lambda: [f(3, 4), f(3, 4)], ref=np.isclose)
+SPECS["_npi_allclose"] = S(lambda: [f(3, 4), f(3, 4)],
+                           ref=lambda a, b: np.asarray(np.allclose(a, b)))
+SPECS["_npi_array_equal"] = S(
+    lambda: [f(3, 4), f(3, 4)],
+    ref=lambda a, b: np.asarray(np.array_equal(a, b)))
+SPECS["_npi_array_equiv"] = S(
+    lambda: [f(3, 4), f(3, 4)],
+    ref=lambda a, b: np.asarray(np.array_equiv(a, b)))
+
+# reductions
+for _n in ("sum", "prod", "mean", "nansum", "nanprod", "nanmean", "std",
+           "var", "nanstd", "nanvar"):
+    SPECS["_npi_" + _n] = S(lambda: [fpos(2, 3, 4)], {"axis": 1},
+                            ref=(lambda r: lambda x: r(x, axis=1))(
+                                getattr(np, _n)))
+for _n, _r in (("amax", np.max), ("amin", np.min), ("nanmax", np.nanmax),
+               ("nanmin", np.nanmin), ("ptp", np.ptp)):
+    SPECS["_npi_" + _n] = S(lambda: [sep(3, 4)], {"axis": 1},
+                            ref=(lambda r: lambda x: r(x, axis=1))(_r))
+for _n in ("all", "any"):
+    SPECS["_npi_" + _n] = S(lambda: [ints(3, 4, hi=2)], {"axis": 1},
+                            ref=(lambda r: lambda x: r(x, axis=1))(
+                                getattr(np, _n)))
+SPECS["_npi_count_nonzero"] = S(lambda: [ints(3, 4, hi=2)], {"axis": 1},
+                                ref=lambda x: np.count_nonzero(x, axis=1))
+for _n in ("argmax", "argmin", "nanargmax", "nanargmin"):
+    SPECS["_npi_" + _n] = S(lambda: [sep(3, 4)], {"axis": 1},
+                            ref=(lambda r: lambda x: r(x, axis=1))(
+                                getattr(np, _n)))
+for _n in ("cumsum", "cumprod", "nancumsum", "nancumprod"):
+    SPECS["_npi_" + _n] = S(lambda: [fpos(3, 4)], {"axis": 1},
+                            ref=(lambda r: lambda x: r(x, axis=1))(
+                                getattr(np, _n)))
+SPECS["_npi_median"] = S(lambda: [sep(3, 5)], {"axis": 1},
+                         ref=lambda x: np.median(x, axis=1))
+SPECS["_npi_nanmedian"] = S(lambda: [sep(3, 5)], {"axis": 1},
+                            ref=lambda x: np.nanmedian(x, axis=1))
+SPECS["_npi_percentile"] = S(lambda: [sep(20)], {"q": 30.0},
+                             ref=lambda x: np.percentile(x, 30.0),
+                             grad=False)
+SPECS["_npi_nanpercentile"] = S(lambda: [sep(20)], {"q": 30.0},
+                                ref=lambda x: np.nanpercentile(x, 30.0),
+                                grad=False)
+SPECS["_npi_quantile"] = S(lambda: [sep(20)], {"q": 0.3},
+                           ref=lambda x: np.quantile(x, 0.3), grad=False)
+SPECS["_npi_nanquantile"] = S(lambda: [sep(20)], {"q": 0.3},
+                              ref=lambda x: np.nanquantile(x, 0.3),
+                              grad=False)
+SPECS["_npi_average"] = S(lambda: [f(3, 4), fpos(3, 4)],
+                          ref=lambda a, w: np.average(a, weights=w))
+SPECS["_npi_trapz"] = S(lambda: [f(8)],
+                        ref=lambda y: np.trapezoid(y)
+                        if hasattr(np, "trapezoid") else np.trapz(y))
+
+# shape manipulation
+SPECS["_npi_reshape"] = S(lambda: [f(3, 4)], {"newshape": (4, 3)},
+                          ref=lambda x: x.reshape(4, 3))
+SPECS["_npi_ravel"] = S(lambda: [f(3, 4)], ref=np.ravel)
+SPECS["_npi_transpose"] = S(lambda: [f(3, 4, 2)], {"axes": (2, 0, 1)},
+                            ref=lambda x: x.transpose(2, 0, 1))
+SPECS["_npi_swapaxes"] = S(lambda: [f(3, 4, 2)], {"axis1": 0, "axis2": 2},
+                           ref=lambda x: np.swapaxes(x, 0, 2))
+SPECS["_npi_moveaxis"] = S(lambda: [f(3, 4, 2)],
+                           {"source": 0, "destination": 2},
+                           ref=lambda x: np.moveaxis(x, 0, 2))
+SPECS["_npi_rollaxis"] = S(lambda: [f(3, 4, 2)], {"axis": 2},
+                           ref=lambda x: np.rollaxis(x, 2))
+SPECS["_npi_expand_dims"] = S(lambda: [f(3, 4)], {"axis": 1},
+                              ref=lambda x: np.expand_dims(x, 1))
+SPECS["_npi_squeeze"] = S(lambda: [f(3, 1, 4)], {"axis": 1},
+                          ref=lambda x: np.squeeze(x, 1))
+SPECS["_npi_broadcast_to"] = S(lambda: [f(1, 4)], {"shape": (3, 4)},
+                               ref=lambda x: np.broadcast_to(x, (3, 4)))
+SPECS["_npi_flip"] = S(lambda: [f(3, 4)], {"axis": 1},
+                       ref=lambda x: np.flip(x, 1))
+SPECS["_npi_fliplr"] = S(lambda: [f(3, 4)], ref=np.fliplr)
+SPECS["_npi_flipud"] = S(lambda: [f(3, 4)], ref=np.flipud)
+SPECS["_npi_roll"] = S(lambda: [f(3, 4)], {"shift": 2, "axis": 1},
+                       ref=lambda x: np.roll(x, 2, 1))
+SPECS["_npi_rot90"] = S(lambda: [f(3, 4)], {"k": 1},
+                        ref=lambda x: np.rot90(x, 1))
+SPECS["_npi_concatenate"] = S(lambda: [f(3, 4), f(2, 4)], {"axis": 0},
+                              ref=lambda a, b: np.concatenate([a, b], 0))
+SPECS["_npi_stack"] = S(lambda: [f(3, 4), f(3, 4)], {"axis": 1},
+                        ref=lambda a, b: np.stack([a, b], 1))
+SPECS["_npi_column_stack"] = S(lambda: [f(4), f(4)],
+                               ref=lambda a, b: np.column_stack([a, b]))
+SPECS["_npi_hstack"] = S(lambda: [f(3, 4), f(3, 2)],
+                         ref=lambda a, b: np.hstack([a, b]))
+SPECS["_npi_vstack"] = S(lambda: [f(3, 4), f(2, 4)],
+                         ref=lambda a, b: np.vstack([a, b]))
+SPECS["_npi_dstack"] = S(lambda: [f(3, 4), f(3, 4)],
+                         ref=lambda a, b: np.dstack([a, b]))
+SPECS["_npi_split"] = S(lambda: [f(4, 6)],
+                        {"indices_or_sections": 2, "axis": 1},
+                        ref=lambda x: tuple(np.split(x, 2, 1)))
+SPECS["_npi_array_split"] = S(lambda: [f(5, 4)],
+                              {"indices_or_sections": 2, "axis": 0},
+                              ref=lambda x: tuple(np.array_split(x, 2, 0)))
+SPECS["_npi_hsplit"] = S(lambda: [f(4, 6)], {"indices_or_sections": 3},
+                         ref=lambda x: tuple(np.hsplit(x, 3)))
+SPECS["_npi_vsplit"] = S(lambda: [f(4, 6)], {"indices_or_sections": 2},
+                         ref=lambda x: tuple(np.vsplit(x, 2)))
+SPECS["_npi_dsplit"] = S(lambda: [f(2, 3, 4)], {"indices_or_sections": 2},
+                         ref=lambda x: tuple(np.dsplit(x, 2)))
+SPECS["_npi_repeat"] = S(lambda: [f(3, 4)], {"repeats": 2, "axis": 1},
+                         ref=lambda x: np.repeat(x, 2, 1))
+SPECS["_npi_tile"] = S(lambda: [f(3, 4)], {"reps": (2, 1)},
+                       ref=lambda x: np.tile(x, (2, 1)))
+SPECS["_npi_append"] = S(lambda: [f(3, 4), f(2, 4)], {"axis": 0},
+                         ref=lambda a, b: np.append(a, b, 0))
+SPECS["_npi_pad"] = S(lambda: [f(3, 4)], {"pad_width": ((1, 1), (2, 0))},
+                      ref=lambda x: np.pad(x, ((1, 1), (2, 0))))
+SPECS["_npi_delete"] = S(lambda: [f(5, 4)], {"obj": 2, "axis": 0},
+                         ref=lambda x: np.delete(x, 2, 0))
+SPECS["_npi_insert"] = S(lambda: [f(5, 4), f(1, 4)], {"obj": 2, "axis": 0},
+                         ref=lambda x, v: np.insert(x, 2, v, 0))
+SPECS["_npi_trim_zeros"] = S(
+    lambda: [np.concatenate([[0.0, 0.0], fpos(4), [0.0]]).astype(np.float32)],
+    ref=np.trim_zeros)
+
+# indexing / selection
+SPECS["_npi_take"] = S(lambda: [f(5, 4), ints(3, hi=5)], {"axis": 0},
+                       ref=lambda x, i: np.take(x, i, 0))
+SPECS["_npi_take_along_axis"] = S(
+    lambda: [f(3, 4), np.argsort(R.rand(3, 4), 1).astype(np.int64)],
+    {"axis": 1}, ref=lambda x, i: np.take_along_axis(x, i, 1))
+SPECS["_npi_compress"] = S(lambda: [ints(4, hi=2), f(4, 3)], {"axis": 0},
+                           ref=lambda c, x: np.compress(c.astype(bool), x, 0),
+                           grad=False)
+SPECS["_npi_extract"] = S(lambda: [ints(3, 4, hi=2), f(3, 4)],
+                          ref=lambda c, x: np.extract(c, x), grad=False)
+SPECS["_npi_choose"] = S(lambda: [ints(4, hi=3), f(4), f(4), f(4)],
+                         ref=lambda i, a, b, c: np.choose(i, [a, b, c]))
+SPECS["_npi_select"] = S(
+    lambda: [ints(3, 4, hi=2), ints(3, 4, hi=2), f(3, 4), f(3, 4)],
+    ref=lambda c1, c2, x1, x2: np.select([c1.astype(bool), c2.astype(bool)],
+                                         [x1, x2]))
+SPECS["_npi_where"] = S(lambda: [ints(3, 4, hi=2), f(3, 4), f(3, 4)],
+                        ref=lambda c, x, y: np.where(c.astype(bool), x, y))
+SPECS["_npi_nonzero"] = S(lambda: [ints(3, 4, hi=2)],
+                          ref=lambda x: tuple(np.nonzero(x)), grad=False)
+SPECS["_npi_flatnonzero"] = S(lambda: [ints(3, 4, hi=2)],
+                              ref=np.flatnonzero, grad=False)
+SPECS["_npi_argwhere"] = S(lambda: [ints(3, 4, hi=2)], ref=np.argwhere,
+                           grad=False)
+SPECS["_npi_searchsorted"] = S(lambda: [np.sort(f(8)), f(5)],
+                               ref=np.searchsorted)
+SPECS["_npi_unravel_index"] = S(lambda: [ints(5, hi=12)], {"shape": (3, 4)},
+                                ref=lambda i: np.unravel_index(i, (3, 4)))
+SPECS["_npi_ravel_multi_index"] = S(
+    lambda: [ints(5, hi=3), ints(5, hi=4)], {"dims": (3, 4)},
+    ref=lambda a, b: np.ravel_multi_index((a, b), (3, 4)))
+SPECS["_npi_diag_indices_from"] = S(
+    lambda: [f(4, 4)], ref=lambda x: tuple(np.diag_indices_from(x)),
+    grad=False)
+SPECS["_npi_tril_indices"] = S(lambda: [], {"n": 4, "k": 0},
+                               ref=lambda: tuple(np.tril_indices(4)))
+SPECS["_npi_triu_indices"] = S(lambda: [], {"n": 4, "k": 0},
+                               ref=lambda: tuple(np.triu_indices(4)))
+SPECS["_npi_indices"] = S(lambda: [], {"dimensions": (2, 3)},
+                          ref=lambda: np.indices((2, 3)).astype(np.int32))
+
+# linalg
+SPECS["_npi_dot"] = S(lambda: [f(3, 4), f(4, 2)], ref=np.dot)
+SPECS["_npi_vdot"] = S(lambda: [f(8), f(8)], ref=np.vdot)
+SPECS["_npi_inner"] = S(lambda: [f(3, 4), f(2, 4)], ref=np.inner)
+SPECS["_npi_outer"] = S(lambda: [f(3), f(4)], ref=np.outer)
+SPECS["_npi_matmul"] = S(lambda: [f(2, 3, 4), f(2, 4, 5)], ref=np.matmul)
+SPECS["_npi_tensordot"] = S(lambda: [f(3, 4, 5), f(4, 5, 2)],
+                            {"axes": 2}, ref=lambda a, b: np.tensordot(a, b))
+SPECS["_npi_trace"] = S(lambda: [f(4, 4)], ref=np.trace)
+
+# set ops
+SPECS["_npi_unique"] = S(lambda: [ints(12, hi=5)], ref=np.unique, grad=False)
+SPECS["_npi_isin"] = S(lambda: [ints(3, 4), ints(5)], ref=np.isin)
+SPECS["_npi_in1d"] = S(lambda: [ints(8), ints(5)],
+                       ref=lambda a, b: np.isin(a.ravel(), b))
+SPECS["_npi_intersect1d"] = S(lambda: [ints(8), ints(8)], ref=np.intersect1d,
+                              grad=False)
+SPECS["_npi_union1d"] = S(lambda: [ints(8), ints(8)], ref=np.union1d,
+                          grad=False)
+SPECS["_npi_setdiff1d"] = S(lambda: [ints(8), ints(8)], ref=np.setdiff1d,
+                            grad=False)
+SPECS["_npi_setxor1d"] = S(lambda: [ints(8), ints(8)], ref=np.setxor1d,
+                           grad=False)
+
+# sorting
+SPECS["_npi_sort"] = S(lambda: [sep(3, 4)], {"axis": 1},
+                       ref=lambda x: np.sort(x, 1))
+SPECS["_npi_argsort"] = S(lambda: [sep(3, 4)], {"axis": 1},
+                          ref=lambda x: np.argsort(x, 1))
+SPECS["_npi_lexsort"] = S(lambda: [sep(6), sep(6)],
+                          ref=lambda a, b: np.lexsort((a, b)))
+# partition order within segments is UNSPECIFIED -> semantic test below,
+# not an elementwise ref
+SPECS["_npi_partition"] = S(lambda: [sep(8)], {"kth": 3}, grad=False)
+SPECS["_npi_argpartition"] = S(lambda: [sep(8)], {"kth": 3}, grad=False)
+
+
+def test_npi_partition_semantics():
+    x = sep(9)
+    part = invoke("_npi_partition", nd.array(x), kth=4).asnumpy()
+    api = invoke("_npi_argpartition", nd.array(x), kth=4).asnumpy()
+    for out in (part, x[api]):
+        assert out[4] == np.sort(x)[4]
+        assert (out[:4] <= out[4]).all() and (out[5:] >= out[4]).all()
+        assert sorted(out.tolist()) == sorted(x.tolist())
+SPECS["_npi_msort"] = S(lambda: [sep(5, 3)], ref=lambda x: np.sort(x, 0))
+
+# math misc
+SPECS["_npi_clip"] = S(lambda: [f(3, 4)], {"a_min": -0.5, "a_max": 0.5},
+                       ref=lambda x: np.clip(x, -0.5, 0.5))
+SPECS["_npi_interp"] = S(lambda: [f(5), np.sort(f(8)), f(8)],
+                         ref=np.interp, grad=False)
+SPECS["_npi_ediff1d"] = S(lambda: [f(8)], ref=np.ediff1d)
+SPECS["_npi_diff"] = S(lambda: [f(3, 6)], {"n": 1, "axis": 1},
+                       ref=lambda x: np.diff(x, 1, 1))
+SPECS["_npi_gradient"] = S(lambda: [f(4, 5)],
+                           ref=lambda x: tuple(np.gradient(x)))
+SPECS["_npi_convolve"] = S(lambda: [f(6), f(3)], {"mode": "full"},
+                           ref=lambda a, v: np.convolve(a, v, "full"))
+SPECS["_npi_correlate"] = S(lambda: [f(6), f(3)], {"mode": "valid"},
+                            ref=lambda a, v: np.correlate(a, v, "valid"))
+SPECS["_npi_polyval"] = S(lambda: [f(4), f(5)], ref=np.polyval)
+SPECS["_npi_corrcoef"] = S(lambda: [f(3, 8)], ref=np.corrcoef, grad=False)
+SPECS["_npi_cov"] = S(lambda: [f(3, 8)], ref=lambda m: np.cov(m),
+                      grad=False)
+SPECS["_npi_histogram"] = S(lambda: [f(20)], {"bins": 5, "range": (-1., 1.)},
+                            ref=lambda x: np.histogram(x, 5, (-1., 1.)),
+                            grad=False)
+SPECS["_npi_bincount"] = S(lambda: [ints(12, hi=5)], ref=np.bincount,
+                           grad=False)
+SPECS["_npi_digitize"] = S(lambda: [f(8), np.sort(f(4))], ref=np.digitize)
+
+# windows + creation
+SPECS["_npi_bartlett"] = S(lambda: [], {"M": 8},
+                           ref=lambda: np.bartlett(8), grad=False)
+SPECS["_npi_kaiser"] = S(lambda: [], {"M": 8, "beta": 2.0},
+                         ref=lambda: np.kaiser(8, 2.0), grad=False)
+SPECS["_npi_blackman_np"] = S(lambda: [], {"M": 8},
+                              ref=lambda: np.blackman(8), grad=False)
+SPECS["_npi_hamming_np"] = S(lambda: [], {"M": 8},
+                             ref=lambda: np.hamming(8), grad=False)
+SPECS["_npi_hanning_np"] = S(lambda: [], {"M": 8},
+                             ref=lambda: np.hanning(8), grad=False)
+SPECS["_npi_full_like"] = S(lambda: [f(3, 4)], {"fill_value": 2.5},
+                            ref=lambda x: np.full_like(x, 2.5))
+SPECS["_npi_empty_like"] = S(lambda: [f(3, 4)], grad=False)  # values undef
+SPECS["_npi_identity"] = S(lambda: [], {"n": 4},
+                           ref=lambda: np.identity(4, np.float32))
+SPECS["_npi_tri"] = S(lambda: [], {"N": 4, "k": 0},
+                      ref=lambda: np.tri(4, dtype=np.float32))
+SPECS["_npi_diagflat"] = S(lambda: [f(4)], {"k": 1},
+                           ref=lambda x: np.diagflat(x, 1))
+SPECS["_npi_vander"] = S(lambda: [f(4)], {"N": 3},
+                         ref=lambda x: np.vander(x, 3))
+SPECS["_npi_meshgrid"] = S(lambda: [f(3), f(4)],
+                           ref=lambda a, b: tuple(np.meshgrid(a, b)))
+SPECS["_npi_broadcast_arrays"] = S(
+    lambda: [f(1, 4), f(3, 1)],
+    ref=lambda a, b: tuple(np.broadcast_arrays(a, b)))
+SPECS["_npi_logspace"] = S(lambda: [], {"start": 0.0, "stop": 2.0, "num": 5},
+                           ref=lambda: np.logspace(0.0, 2.0, 5), grad=False)
+SPECS["_npi_geomspace"] = S(lambda: [], {"start": 1.0, "stop": 16.0,
+                                         "num": 5},
+                            ref=lambda: np.geomspace(1.0, 16.0, 5),
+                            grad=False)
+
+# numpy-era + *_like samplers: stochastic -> shape/finiteness + moments
+for _n, _p in [
+        ("_random_uniform_like", {}), ("_random_normal_like", {}),
+        ("_random_exponential_like", {}), ("_random_gamma_like", {}),
+        ("_random_poisson_like", {}), ("_random_negative_binomial_like", {}),
+        ("_random_generalized_negative_binomial_like", {})]:
+    SPECS[_n] = S(lambda: [fpos(64)], _p, grad=False)
+for _n, _p in [
+        ("_npi_uniform", {"size": (64,)}), ("_npi_normal", {"size": (64,)}),
+        ("_npi_laplace", {"size": (64,)}), ("_npi_beta", {"size": (64,)}),
+        ("_npi_chisquare", {"size": (64,)}), ("_npi_f", {"size": (64,)}),
+        ("_npi_standard_t", {"df": 4.0, "size": (64,)}),
+        ("_npi_lognormal", {"size": (64,)}),
+        ("_npi_triangular", {"size": (64,)})]:
+    SPECS[_n] = S(lambda: [], _p, grad=False)
+SPECS["_npi_choice"] = S(lambda: [fpos(16)], {"size": (8,)}, grad=False)
+SPECS["_npi_permutation"] = S(lambda: [f(8)], grad=False)
+
+
 # Ops exercised by dedicated suites rather than the battery:
 TESTED_ELSEWHERE = {
     "_contrib_quantize": "tests/test_quantization.py",
@@ -1103,6 +1452,11 @@ TESTED_ELSEWHERE = {
     "lamb_update_phase2": "tests/test_optimizer.py",
     "rrelu": "stochastic activation (forward sanity only via LeakyReLU)",
     "_internal_getitem": "tests/test_ndarray.py (indexing suite)",
+    "_contrib_dgl_adjacency": "tests/test_graph.py",
+    "_contrib_dgl_subgraph": "tests/test_graph.py",
+    "_contrib_dgl_csr_neighbor_uniform_sample": "tests/test_graph.py",
+    "_contrib_dgl_csr_neighbor_non_uniform_sample": "tests/test_graph.py",
+    "_contrib_dgl_graph_compact": "tests/test_graph.py",
 }
 
 
