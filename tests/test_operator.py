@@ -12,9 +12,37 @@ numpy-reference + check_numeric_gradient tests) — rebuilt as a spec table
   test_coverage  — every unique registry op must appear in SPECS or in
                    TESTED_ELSEWHERE (pointing at the suite that covers it);
                    adding an op without a test fails CI.
+
+Reference coverage: ~85% of SPECS carry a `ref=` numpy re-implementation.
+The ~99 specs WITHOUT refs are exactly these classes, exempt by nature:
+  * stochastic samplers (_random_* / _sample_* / _npi_<dist> / shuffle /
+    *_like / _image_random_*) — no deterministic reference exists;
+    shape+finiteness here, moment checks in their dedicated tests;
+  * _npi_partition/_npi_argpartition — within-segment order is
+    UNSPECIFIED; pinned by test_npi_partition_semantics instead;
+  * _npi_empty_like — values are undefined by contract;
+  * decode/IO ops (_cvimread/_cvimdecode/_image_imdecode) and resamplers
+    (_cvimresize/_image_resize/BilinearResize2D/BilinearSampler/
+    GridGenerator/SpatialTransformer/Correlation/Deconvolution/ROIPooling
+    /PSROIPooling family) — pinned by exactness-anchor tests further down
+    this file (test_deformable_matches_convolution, PSROI/box anchors) and
+    tests/test_ssd.py end-to-end parity rather than elementwise refs;
+  * detection pipeline ops (MultiBox*/Proposal*/box_nms/box_encode/
+    mrcnn_mask_target) — protocol-level checks live in test_ssd.py and the
+    box-anchor tests here;
+  * quantized/intgemm kernels — numeric contracts pinned in
+    tests/test_quantization.py;
+  * linalg factorizations (linalg_syevd/gelqf/maketrian) — eigenvector/
+    factor sign+order ambiguity; validated by reconstruction identities in
+    their grad specs and tests/test_ndarray.py linalg checks;
+  * im2col/col2im, count_sketch, hawkesll, calibrate_entropy,
+    sldwin_atten_* — pinned by dedicated reference tests in this file
+    (sliding-window attention vs dense mask, hawkesll vs slow loop,
+    KL-calibration behaviour) rather than one-liner refs.
 """
 import numpy as np
 import pytest
+import scipy.special as _sp
 
 import mxnet_tpu as mx
 from mxnet_tpu import nd
@@ -43,6 +71,13 @@ def ints(*shape, lo=0, hi=8):
     return R.randint(lo, hi, shape).astype(np.int32)
 
 
+def sep(*shape):
+    """Well-separated values: numeric grad safe at order statistics."""
+    flat = np.argsort(R.rand(int(np.prod(shape))))
+    return (flat.reshape(shape).astype(np.float32)
+            + R.uniform(0.1, 0.3, shape).astype(np.float32))
+
+
 class Spec:
     def __init__(self, inputs, params=None, ref=None, grad=None, rtol=1e-4,
                  atol=1e-4, grad_rtol=1e-2, grad_atol=1e-2):
@@ -56,6 +91,109 @@ class Spec:
 
 def S(inputs, params=None, ref=None, **kw):
     return Spec(inputs, params, ref, **kw)
+
+
+def _masked_softmax_ref(x, m):
+    b = m.astype(bool)
+    xm = np.where(b, x, -1e30)
+    e = np.exp(xm - xm.max(-1, keepdims=True))
+    out = e / e.sum(-1, keepdims=True)
+    return np.where(b, out, 0.0).astype(np.float32)
+
+
+def _masked_log_softmax_ref(x, m):
+    b = m.astype(bool)
+    xm = np.where(b, x, -1e30)
+    out = xm - xm.max(-1, keepdims=True) - np.log(
+        np.exp(xm - xm.max(-1, keepdims=True)).sum(-1, keepdims=True))
+    return np.where(b, out, -np.inf).astype(np.float32)
+
+
+def _scatter_nd_ref(data, idx, shape):
+    out = np.zeros(shape, data.dtype)
+    out[tuple(idx[i] for i in range(idx.shape[0]))] = data
+    return out
+
+
+def _index_add_ref(data, index, value):
+    out = data.copy()
+    np.add.at(out, index, value)
+    return out
+
+
+def _index_set_ref(data, index, value):
+    out = data.copy()
+    out[index] = value
+    return out
+
+
+def _seq_mask_ref(x, lens, value=0.0):
+    out = x.copy()
+    for b, L in enumerate(lens.astype(int)):
+        out[L:, b] = value
+    return out
+
+
+def _pool_max_ref(x, k, s, ceil=False):
+    N, C, H, W = x.shape
+    if ceil:
+        Ho = -((H - k) // -s) + 1
+        Wo = -((W - k) // -s) + 1
+    else:
+        Ho, Wo = (H - k) // s + 1, (W - k) // s + 1
+    out = np.zeros((N, C, Ho, Wo), x.dtype)
+    for i in range(Ho):
+        for j in range(Wo):
+            out[:, :, i, j] = x[:, :, i * s:min(i * s + k, H),
+                                j * s:min(j * s + k, W)].max((2, 3))
+    return out
+
+
+def _lrn_ref(x, nsize=3, alpha=1e-4, beta=0.75, k=2.0):
+    sq = np.square(x)
+    half = nsize // 2
+    acc = np.zeros_like(sq)
+    C = x.shape[1]
+    for c in range(C):
+        lo, hi = max(0, c - half), min(C, c + half + 1)
+        acc[:, c] = sq[:, lo:hi].sum(1)
+    return x / np.power(k + (alpha / nsize) * acc, beta)
+
+
+def _boxes(n):
+    """(n, 4) corner boxes with x1<x2, y1<y2."""
+    lo = R.uniform(0.0, 0.5, (n, 2)).astype(np.float32)
+    hi = lo + R.uniform(0.1, 0.5, (n, 2)).astype(np.float32)
+    return np.concatenate([lo, hi], 1)
+
+
+def _iou_ref(a, b):
+    out = np.zeros((a.shape[0], b.shape[0]), np.float32)
+    for i in range(a.shape[0]):
+        for j in range(b.shape[0]):
+            ix = max(0.0, min(a[i, 2], b[j, 2]) - max(a[i, 0], b[j, 0]))
+            iy = max(0.0, min(a[i, 3], b[j, 3]) - max(a[i, 1], b[j, 1]))
+            inter = ix * iy
+            ua = ((a[i, 2] - a[i, 0]) * (a[i, 3] - a[i, 1])
+                  + (b[j, 2] - b[j, 0]) * (b[j, 3] - b[j, 1]) - inter)
+            out[i, j] = inter / ua if ua > 0 else 0.0
+    return out
+
+
+def _conv2d_ref(x, w, b, stride=1, pad=0):
+    N, C, H, W = x.shape
+    O, _C, kh, kw = w.shape
+    if pad:
+        x = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+        H, W = H + 2 * pad, W + 2 * pad
+    Ho, Wo = (H - kh) // stride + 1, (W - kw) // stride + 1
+    out = np.zeros((N, O, Ho, Wo), np.float32)
+    for i in range(Ho):
+        for j in range(Wo):
+            patch = x[:, :, i * stride:i * stride + kh,
+                      j * stride:j * stride + kw]          # N,C,kh,kw
+            out[:, :, i, j] = np.einsum("nchw,ochw->no", patch, w)
+    return out + b.reshape(1, -1, 1, 1)
 
 
 # --- unary elementwise with direct numpy refs ------------------------------
@@ -80,14 +218,23 @@ _UNARY = {
     "relu": (lambda x: np.maximum(x, 0), f),
     "softsign": (lambda x: x / (1 + np.abs(x)), f),
     "identity": (lambda x: x, f),
-    "erf": (None, f), "erfc": (None, f), "erfinv": (None, funit),
-    "gamma": (None, fpos), "gammaln": (None, fpos), "digamma": (None, fpos),
+    "erf": (lambda x: _sp.erf(x), f), "erfc": (lambda x: _sp.erfc(x), f),
+    "erfinv": (lambda x: _sp.erfinv(x), funit),
+    "gamma": (lambda x: _sp.gamma(x), fpos),
+    "gammaln": (lambda x: _sp.gammaln(x), fpos),
+    "digamma": (lambda x: _sp.digamma(x), fpos),
     "radians": (np.radians, f), "degrees": (np.degrees, f),
-    "sinc": (np.sinc, f), "i0": (None, fpos),
-    "selu": (None, f), "gelu": (None, f), "silu": (None, f),
-    "mish": (None, f), "elu": (None, f), "softrelu": (None, f),
-    "log_sigmoid": (None, f),
-    "hard_sigmoid": (None, f), "hard_swish": (None, f),
+    "sinc": (np.sinc, f), "i0": (lambda x: _sp.i0(x), fpos),
+    "selu": (lambda x: 1.0507009873554805 * np.where(
+        x > 0, x, 1.6732632423543772 * (np.exp(x) - 1)), f),
+    "gelu": (lambda x: 0.5 * x * (1 + _sp.erf(x / np.sqrt(2.0))), f),
+    "silu": (lambda x: x / (1 + np.exp(-x)), f),
+    "mish": (lambda x: x * np.tanh(np.log1p(np.exp(x))), f),
+    "elu": (lambda x: np.where(x > 0, x, np.exp(x) - 1), f),
+    "softrelu": (lambda x: np.log1p(np.exp(x)), f),
+    "log_sigmoid": (lambda x: -np.log1p(np.exp(-x)), f),
+    "hard_sigmoid": (lambda x: np.clip(0.2 * x + 0.5, 0, 1), f),
+    "hard_swish": (lambda x: x * np.clip(x + 3, 0, 6) / 6.0, f),
     "isnan": (np.isnan, f), "isinf": (np.isinf, f),
     "isfinite": (np.isfinite, f),
     "logical_not": (lambda x: np.logical_not(x).astype(np.float32), f),
@@ -115,8 +262,8 @@ _BINARY = {
     "broadcast_logical_or": lambda a, b: np.logical_or(a, b).astype(np.float32),
     "broadcast_logical_xor": lambda a, b: np.logical_xor(a, b).astype(np.float32),
     "arctan2": np.arctan2, "copysign": np.copysign,
-    "logaddexp": np.logaddexp, "fmod": None, "nextafter": np.nextafter,
-    "heaviside": np.heaviside, "ldexp": None,
+    "logaddexp": np.logaddexp, "fmod": np.fmod, "nextafter": np.nextafter,
+    "heaviside": np.heaviside, "ldexp": lambda a, b: a * np.exp2(b),
 }
 
 SPECS = {}
@@ -127,7 +274,8 @@ for _name, _ref in _BINARY.items():
 
 SPECS.update({
     "arccosh": S(lambda: [1.0 + fpos(3, 4)], ref=np.arccosh),
-    "broadcast_mod": S(lambda: [f(3, 4), fpos(3, 4)], grad=False),
+    "broadcast_mod": S(lambda: [f(3, 4), fpos(3, 4)], ref=np.mod,
+                       grad=False),
     "broadcast_power": S(lambda: [fpos(3, 4), f(3, 4)], ref=np.power),
     "nextafter": S(lambda: [f(3, 4), fpos(3, 4)], ref=np.nextafter,
                    grad=False),
@@ -176,12 +324,27 @@ SPECS.update({
                      ref=lambda x: x - x.max(-1, keepdims=True) - np.log(
                          np.exp(x - x.max(-1, keepdims=True)).sum(
                              -1, keepdims=True))),
-    "masked_softmax": S(lambda: [f(3, 4), ints(3, 4, lo=0, hi=2)],
-                        {"axis": -1}, grad=False),
+    "masked_softmax": S(
+        # mask keeps column 0 live so no row is fully masked
+        lambda: [f(3, 4),
+                 np.concatenate([np.ones((3, 1), np.int32),
+                                 ints(3, 3, lo=0, hi=2)], 1)],
+        {"axis": -1}, grad=False, ref=_masked_softmax_ref),
+    # all-ones mask here (battery finiteness gate rejects the -inf the op
+    # yields at masked slots); partial-mask path pinned by
+    # test_masked_log_softmax_partial
     "masked_log_softmax": S(lambda: [f(3, 4), np.ones((3, 4), np.int32)],
-                            {"axis": -1}, grad=False),
+                            {"axis": -1}, grad=False,
+                            ref=lambda x, m: x - x.max(-1, keepdims=True)
+                            - np.log(np.exp(x - x.max(-1, keepdims=True))
+                                     .sum(-1, keepdims=True))),
     "softmax_cross_entropy": S(
-        lambda: [f(3, 4), ints(3, lo=0, hi=4)], grad=False),
+        lambda: [f(3, 4), ints(3, lo=0, hi=4)], grad=False,
+        ref=lambda x, y: np.asarray(-(
+            (x - x.max(-1, keepdims=True)
+             - np.log(np.exp(x - x.max(-1, keepdims=True)).sum(
+                 -1, keepdims=True)))[np.arange(3), y]).sum(),
+            np.float32)),
     "smooth_l1": S(lambda: [f(3, 4)], {"scalar": 1.0},
                    ref=lambda x: np.where(np.abs(x) < 1, 0.5 * x * x,
                                           np.abs(x) - 0.5)),
@@ -232,9 +395,13 @@ SPECS.update({
     "triu": S(lambda: [f(4, 4)], ref=np.triu),
     "trace_op": S(lambda: [f(4, 4)], ref=np.trace),
     "space_to_depth": S(lambda: [f(1, 1, 4, 4)], {"block_size": 2},
-                        grad=False),
+                        grad=False,
+                        ref=lambda x: x.reshape(1, 1, 2, 2, 2, 2)
+                        .transpose(0, 3, 5, 1, 2, 4).reshape(1, 4, 2, 2)),
     "depth_to_space": S(lambda: [f(1, 4, 2, 2)], {"block_size": 2},
-                        grad=False),
+                        grad=False,
+                        ref=lambda x: x.reshape(1, 2, 2, 1, 2, 2)
+                        .transpose(0, 3, 4, 1, 5, 2).reshape(1, 1, 4, 4)),
     "reverse": S(lambda: [f(3, 4)], {"axis": (0, 1)},
                  ref=lambda x: x[::-1, ::-1]),
     "shape_array": S(lambda: [f(3, 4)],
@@ -297,17 +464,21 @@ SPECS.update({
     "gather_nd": S(lambda: [f(4, 5), np.array([[0, 1], [2, 3]], np.int32)],
                    ref=lambda a, i: a[i[0], i[1]], grad=False),
     "scatter_nd": S(lambda: [f(2), np.array([[0, 1], [2, 3]], np.int32)],
-                    {"shape": (4, 5)}, grad=False),
+                    {"shape": (4, 5)}, grad=False,
+                    ref=lambda d, i: _scatter_nd_ref(d, i, (4, 5))),
     "where_op": S(lambda: [ints(3, 4, lo=0, hi=2), f(3, 4), f(3, 4)],
                   ref=lambda c, a, b: np.where(c, a, b), grad=False),
     "where": S(lambda: [ints(3, 4, lo=0, hi=2), f(3, 4), f(3, 4)],
                ref=lambda c, a, b: np.where(c, a, b), grad=False),
     "boolean_mask": S(lambda: [f(4, 3), np.array([1, 0, 1, 1], np.int32)],
-                      grad=False),
-    "index_add": S(lambda: [f(5, 3), ints(2, hi=5), f(2, 3)], grad=False),
-    "index_copy": S(lambda: [f(5, 3), ints(2, hi=5), f(2, 3)], grad=False),
-    "index_update": S(lambda: [f(5, 3), ints(2, hi=5), f(2, 3)],
-                      grad=False),
+                      grad=False,
+                      ref=lambda d, m: d[m.astype(bool)]),
+    "index_add": S(lambda: [f(5, 3), np.array([1, 3], np.int32), f(2, 3)],
+                   grad=False, ref=_index_add_ref),
+    "index_copy": S(lambda: [f(5, 3), np.array([1, 3], np.int32), f(2, 3)],
+                    grad=False, ref=_index_set_ref),
+    "index_update": S(lambda: [f(5, 3), np.array([1, 3], np.int32),
+                               f(2, 3)], grad=False, ref=_index_set_ref),
     "ravel_multi_index": S(
         lambda: [np.array([[1, 2], [0, 3]], np.int64)], {"shape": (3, 4)},
         ref=lambda d: np.ravel_multi_index((d[0], d[1]), (3, 4)),
@@ -315,20 +486,25 @@ SPECS.update({
     "unravel_index": S(
         lambda: [np.array([5, 11], np.int64)], {"shape": (3, 4)},
         ref=lambda d: np.stack(np.unravel_index(d, (3, 4))), grad=False),
-    "searchsorted": S(lambda: [np.sort(f(8)), f(3)], grad=False),
+    "searchsorted": S(lambda: [np.sort(f(8)), f(3)], grad=False,
+                      ref=np.searchsorted),
     "bincount": S(lambda: [ints(10, hi=5)], {"minlength": 5},
                   ref=lambda d: np.bincount(d, minlength=5), grad=False),
-    "digitize": S(lambda: [f(5), np.sort(f(4))], grad=False),
+    "digitize": S(lambda: [f(5), np.sort(f(4))], grad=False,
+                  ref=np.digitize),
     "histogram": S(lambda: [fpos(20)], {"bin_cnt": 5, "range": (0.0, 1.0)},
-                   grad=False),
-    "interp": S(lambda: [f(4), np.sort(fpos(5)), fpos(5)], grad=False),
+                   grad=False,
+                   ref=lambda x: np.histogram(x, 5, (0.0, 1.0))),
+    "interp": S(lambda: [f(4), np.sort(fpos(5)), fpos(5)], grad=False,
+                ref=np.interp),
     # sorting
     "sort": S(lambda: [f(3, 6)], {"axis": -1}, ref=lambda x: np.sort(x, -1),
               grad=False),
     "argsort": S(lambda: [f(3, 6)], {"axis": -1},
                  ref=lambda x: np.argsort(x, -1).astype(np.float32),
                  grad=False),
-    "topk": S(lambda: [f(3, 6)], {"k": 2, "ret_typ": "value"}, grad=False),
+    "topk": S(lambda: [sep(3, 6)], {"k": 2, "ret_typ": "value"}, grad=False,
+              ref=lambda x: np.sort(x, -1)[:, :-3:-1]),
     "cumsum": S(lambda: [f(3, 4)], {"axis": 1},
                 ref=lambda x: np.cumsum(x, 1)),
     "cumprod": S(lambda: [fpos(3, 4)], {"axis": 1},
@@ -353,9 +529,12 @@ SPECS.update({
     # special binary
     "prelu": S(lambda: [f(3, 4), fpos(1)],
                ref=lambda x, g: np.where(x >= 0, x, g * x)),
-    "polygamma": S(lambda: [fpos(3)], {"n": 1}, grad=False),
-    "gammainc": S(lambda: [fpos(3), fpos(3)], grad=False),
-    "gammaincc": S(lambda: [fpos(3), fpos(3)], grad=False),
+    "polygamma": S(lambda: [fpos(3)], {"n": 1}, grad=False,
+                   ref=lambda x: _sp.polygamma(1, x).astype(np.float32)),
+    "gammainc": S(lambda: [fpos(3), fpos(3)], grad=False,
+                  ref=lambda a, x: _sp.gammainc(a, x)),
+    "gammaincc": S(lambda: [fpos(3), fpos(3)], grad=False,
+                   ref=lambda a, x: _sp.gammaincc(a, x)),
     # windows / creation
     "hanning": S(lambda: [], {"M": 8}, ref=lambda: np.hanning(8),
                  grad=False, rtol=1e-5, atol=1e-6),
@@ -366,24 +545,31 @@ SPECS.update({
     # sequence ops
     "sequence_mask": S(
         lambda: [f(4, 2, 3), np.array([2, 4], np.int32)],
-        {"use_sequence_length": True}, grad=False),
+        {"use_sequence_length": True}, grad=False,
+        ref=lambda x, lens: _seq_mask_ref(x, lens)),
     "SequenceLast": S(
         lambda: [f(4, 2, 3), np.array([2, 4], np.int32)],
-        {"use_sequence_length": True}, grad=False),
+        {"use_sequence_length": True}, grad=False,
+        ref=lambda x, lens: x[lens.astype(int) - 1,
+                              np.arange(x.shape[1])]),
     "SequenceReverse": S(
         lambda: [f(4, 2, 3), np.array([2, 4], np.int32)],
-        {"use_sequence_length": True}, grad=False),
+        {"use_sequence_length": True}, grad=False,
+        ref=lambda x, lens: np.stack(
+            [np.concatenate([x[:L, b][::-1], x[L:, b]])
+             for b, L in enumerate(lens.astype(int))], 1)),
     # NN layers (layer semantics tested in test_gluon; battery = sanity+grad)
     "FullyConnected": S(lambda: [f(3, 4), f(5, 4), f(5)],
                         {"num_hidden": 5},
                         ref=lambda x, w, b: x @ w.T + b),
     "Convolution": S(lambda: [f(1, 2, 5, 5), f(3, 2, 3, 3), f(3)],
-                     {"kernel": (3, 3), "num_filter": 3}, grad=False),
+                     {"kernel": (3, 3), "num_filter": 3}, grad=False,
+                     ref=lambda x, w, b: _conv2d_ref(x, w, b)),
     "Deconvolution": S(lambda: [f(1, 2, 4, 4), f(2, 3, 3, 3), f(3)],
                        {"kernel": (3, 3), "num_filter": 3}, grad=False),
     "Pooling": S(lambda: [f(1, 2, 4, 4)],
                  {"kernel": (2, 2), "pool_type": "max", "stride": (2, 2)},
-                 grad=False),
+                 grad=False, ref=lambda x: _pool_max_ref(x, 2, 2)),
     "Activation": S(lambda: [f(3, 4)], {"act_type": "relu"},
                     ref=lambda x: np.maximum(x, 0)),
     "LeakyReLU": S(lambda: [f(3, 4)], {"act_type": "leaky", "slope": 0.1},
@@ -391,15 +577,33 @@ SPECS.update({
     "BatchNorm": S(lambda: [f(2, 3, 4, 4), np.ones(3, np.float32),
                             np.zeros(3, np.float32),
                             np.zeros(3, np.float32),
-                            np.ones(3, np.float32)], grad=False),
+                            np.ones(3, np.float32)], grad=False,
+                   ref=lambda x, g, b, mm, mv:
+                   (x - x.mean((0, 2, 3), keepdims=True))
+                   / np.sqrt(x.var((0, 2, 3), keepdims=True) + 1e-5)),
     "LayerNorm": S(lambda: [f(3, 4), np.ones(4, np.float32),
-                            np.zeros(4, np.float32)], grad=False),
+                            np.zeros(4, np.float32)], grad=False,
+                   rtol=1e-3, atol=1e-3,
+                   ref=lambda x, g, b: (x - x.mean(-1, keepdims=True))
+                   / np.sqrt(x.var(-1, keepdims=True) + 1e-5)),
     "GroupNorm": S(lambda: [f(2, 4, 3), np.ones(4, np.float32),
                             np.zeros(4, np.float32)], {"num_groups": 2},
-                   grad=False),
+                   grad=False, rtol=1e-3, atol=1e-3,
+                   ref=lambda x, g, b:
+                   ((x.reshape(2, 2, 2, 3)
+                     - x.reshape(2, 2, 2, 3).mean((2, 3), keepdims=True))
+                    / np.sqrt(x.reshape(2, 2, 2, 3).var((2, 3),
+                                                        keepdims=True)
+                              + 1e-5)).reshape(2, 4, 3)),
     "InstanceNorm": S(lambda: [f(2, 3, 4), np.ones(3, np.float32),
-                               np.zeros(3, np.float32)], grad=False),
-    "RMSNorm": S(lambda: [f(3, 4), np.ones(4, np.float32)], grad=False),
+                               np.zeros(3, np.float32)], grad=False,
+                      rtol=1e-3, atol=1e-3,
+                      ref=lambda x, g, b: (x - x.mean(-1, keepdims=True))
+                      / np.sqrt(x.var(-1, keepdims=True) + 1e-3)),
+    "RMSNorm": S(lambda: [f(3, 4), np.ones(4, np.float32)], grad=False,
+                 rtol=1e-3, atol=1e-3,
+                 ref=lambda x, g: x / np.sqrt(
+                     (x * x).mean(-1, keepdims=True) + 1e-6)),
     "L2Normalization": S(lambda: [f(3, 4)],
                          ref=lambda x: x / np.sqrt(
                              (x * x).sum(1, keepdims=True) + 1e-10)),
@@ -408,11 +612,17 @@ SPECS.update({
                    ref=lambda i, w: w[i], grad=False),
     "Dropout": S(lambda: [f(3, 4)], {"p": 0.0}, ref=lambda x: x,
                  grad=False),
-    "SoftmaxOutput": S(lambda: [f(3, 4), ints(3, hi=4)], grad=False),
+    "SoftmaxOutput": S(lambda: [f(3, 4), ints(3, hi=4)], grad=False,
+                       ref=lambda x, y: np.exp(x - x.max(-1, keepdims=True))
+                       / np.exp(x - x.max(-1, keepdims=True)).sum(
+                           -1, keepdims=True)),
     "UpSampling": S(lambda: [f(1, 2, 3, 3)],
-                    {"scale": 2, "sample_type": "nearest"}, grad=False),
+                    {"scale": 2, "sample_type": "nearest"}, grad=False,
+                    ref=lambda x: x.repeat(2, 2).repeat(2, 3)),
     "AdaptiveAvgPooling2D": S(lambda: [f(1, 2, 4, 4)],
-                              {"output_size": (2, 2)}, grad=False),
+                              {"output_size": (2, 2)}, grad=False,
+                              ref=lambda x: x.reshape(1, 2, 2, 2, 2, 2)
+                              .mean((3, 5))),
     "BilinearResize2D": S(lambda: [f(1, 2, 4, 4)],
                           {"height": 8, "width": 8}, grad=False),
     "Cast": S(lambda: [f(3, 4)], {"dtype": "float32"}, ref=lambda x: x),
@@ -470,7 +680,8 @@ SPECS.update({
     "_contrib_box_nms": S(
         lambda: [np.array([[[0, .9, 0, 0, 1, 1], [0, .8, 0, 0, 1, 1]]],
                           np.float32)], grad=False),
-    "_contrib_box_iou": S(lambda: [fpos(3, 4), fpos(2, 4)], grad=False),
+    "_contrib_box_iou": S(lambda: [_boxes(3), _boxes(2)], grad=False,
+                          ref=lambda a, b: _iou_ref(a, b)),
 })
 
 
@@ -574,7 +785,7 @@ SPECS.update({
     "_scatter_set_nd": S(
         lambda: [f(4, 5), f(2), np.array([[0, 2], [1, 3]], np.int32)],
         grad=False,
-        ref=None),
+        ref=lambda l, r, i: _index_set_ref(l, (i[0], i[1]), r)),
     "IdentityAttachKLSparseReg": S(lambda: [fpos(4, 3)], grad=False,
                                    ref=lambda x: x),
     "_contrib_arange_like": S(lambda: [f(2, 3)], {"axis": 1}, grad=False,
@@ -583,9 +794,12 @@ SPECS.update({
                                ref=lambda x: x / np.sqrt(4)),
     "_contrib_gradientmultiplier": S(lambda: [f(3, 4)], {"scalar": 1.0},
                                      ref=lambda x: x),
-    "_contrib_index_array": S(lambda: [f(2, 3)], grad=False, ref=None),
+    "_contrib_index_array": S(lambda: [f(2, 3)], grad=False,
+                              ref=lambda x: np.stack(
+                                  np.indices(x.shape), -1)),
     "_contrib_allclose": S(lambda: [f(3, 4), f(3, 4)], grad=False,
-                           ref=None),
+                           ref=lambda a, b: np.asarray(
+                               np.allclose(a, b), np.float32)),
     "_contrib_quadratic": S(lambda: [f(3, 4)],
                             {"a": 1.0, "b": 2.0, "c": 3.0},
                             ref=lambda x: x * x + 2 * x + 3),
@@ -604,7 +818,9 @@ SPECS.update({
         grad=False,
         ref=lambda x: (np.array([0., 1.], np.float32),
                        np.array([0., 1.], np.float32))),
-    "_contrib_getnnz": S(lambda: [f(3, 4)], grad=False, ref=None),
+    "_contrib_getnnz": S(lambda: [f(3, 4)], grad=False,
+                         ref=lambda x: np.asarray(
+                             (x != 0).sum(), np.int64)),
     "_contrib_dynamic_reshape": S(
         lambda: [f(2, 6), np.array([3, 4], np.int32)], grad=False,
         ref=lambda x, s: x.reshape(3, 4)),
@@ -626,25 +842,42 @@ SPECS.update({
         grad=False, ref=None),
     # optimizer tail (update semantics pinned in test_optimizer for the
     # single-weight rows; here forward sanity for the fused fleets)
+    # update-rule refs re-derived from the published formulas (FTML paper,
+    # NAG, LAMB paper, decoupled AdamW) — independent of the op impls
     "ftml_update": S(lambda: [f(4), f(4), fpos(4), fpos(4), f(4)],
-                     {"lr": 0.01, "t": 1}, grad=False, ref=None),
+                     {"lr": 0.01, "t": 1}, grad=False,
+                     ref=lambda w, g, d, v, z, b1=0.6, b2=0.999, e=1e-8:
+                     -(b1 * z + (1 - b1) * g
+                       - ((1 - b1) / 0.01 * (np.sqrt(
+                           (b2 * v + (1 - b2) * g * g) / (1 - b2)) + e)
+                          - b1 * d) * w)
+                     / ((1 - b1) / 0.01 * (np.sqrt(
+                         (b2 * v + (1 - b2) * g * g) / (1 - b2)) + e))),
     "mp_nag_mom_update": S(
         lambda: [f(4), f(4), f(4), f(4)], {"lr": 0.01, "momentum": 0.9},
-        grad=False, ref=None),
+        grad=False,
+        ref=lambda w, g, m, w32: w32 - 0.01 * (g + 0.9 * (0.9 * m + g))),
     "mp_lamb_update_phase1": S(
         lambda: [f(4), f(4), f(4), fpos(4)], {"t": 1}, grad=False,
-        ref=None),
+        ref=lambda g, w32, m, v, b1=0.9, b2=0.999, e=1e-6:
+        ((b1 * m + (1 - b1) * g) / (1 - b1))
+        / (np.sqrt((b2 * v + (1 - b2) * g * g) / (1 - b2)) + e)),
     "mp_lamb_update_phase2": S(
         lambda: [f(4), f(4), np.array(1.0, np.float32),
                  np.array(1.0, np.float32), f(4)],
-        {"lr": 0.01}, grad=False, ref=None),
+        {"lr": 0.01}, grad=False,
+        ref=lambda w, gu, r1, r2, w32: w32 - 0.01 * (r1 / r2) * gu),
     "mp_adamw_update": S(
         lambda: [f(4), f(4), f(4), fpos(4), f(4),
                  np.array(1.0, np.float32)],
-        {"lr": 0.01}, grad=False, ref=None),
+        {"lr": 0.01}, grad=False,
+        ref=lambda w, g, m, v, w32, rs, b1=0.9, b2=0.999, e=1e-8:
+        w32 - 0.01 * (b1 * m + (1 - b1) * g)
+        / (np.sqrt(b2 * v + (1 - b2) * g * g) + e)),
     "_contrib_group_adagrad_update": S(
         lambda: [f(4, 3), f(4, 3), fpos(4, 1)], {"lr": 0.01}, grad=False,
-        ref=None),
+        ref=lambda w, g, h: w - 0.01 * g / (np.sqrt(
+            h + (g * g).mean(1, keepdims=True)) + 1e-5)),
     "multi_sgd_update": S(
         lambda: [f(4), f(4), f(3), f(3)],
         {"lrs": (0.1, 0.1), "wds": (0.0, 0.0), "num_weights": 2},
@@ -654,23 +887,29 @@ SPECS.update({
         lambda: [f(4), f(4), np.zeros(4, np.float32),
                  f(3), f(3), np.zeros(3, np.float32)],
         {"lrs": (0.1, 0.1), "wds": (0.0, 0.0), "num_weights": 2},
-        grad=False, ref=None),
+        # all outputs are written back in place -> invisible to
+        # test_forward; pinned by test_fleet_update_writeback
+        grad=False),
     "multi_mp_sgd_update": S(
         lambda: [f(4), f(4), f(4), f(3), f(3), f(3)],
         {"lrs": (0.1, 0.1), "wds": (0.0, 0.0), "num_weights": 2},
-        grad=False, ref=None),
+        grad=False),
     "multi_mp_sgd_mom_update": S(
         lambda: [f(4), f(4), np.zeros(4, np.float32), f(4),
                  f(3), f(3), np.zeros(3, np.float32), f(3)],
         {"lrs": (0.1, 0.1), "wds": (0.0, 0.0), "num_weights": 2},
-        grad=False, ref=None),
+        grad=False),
     "multi_sum_sq": S(lambda: [f(4), f(3)], {"num_arrays": 2}, grad=False,
                       ref=lambda a, b: np.array([np.sum(a * a),
                                                  np.sum(b * b)],
                                                 np.float32)),
     "multi_lars": S(
         lambda: [fpos(3), fpos(3), fpos(3), np.zeros(3, np.float32)],
-        {"eta": 0.001}, grad=False, ref=None),
+        {"eta": 0.001}, grad=False,
+        ref=lambda lrs, wsq, gsq, wds: lrs * np.where(
+            (np.sqrt(wsq) > 0) & (np.sqrt(gsq) > 0),
+            0.001 * np.sqrt(wsq) / (np.sqrt(gsq) + wds * np.sqrt(wsq)
+                                    + 1e-8), 1.0)),
     "preloaded_multi_sgd_update": S(
         lambda: [f(4), f(4), f(3), f(3),
                  np.array([0.1, 0.1], np.float32),
@@ -683,23 +922,24 @@ SPECS.update({
                  f(3), f(3), np.zeros(3, np.float32),
                  np.array([0.1, 0.1], np.float32),
                  np.zeros(2, np.float32)],
-        {"num_weights": 2}, grad=False, ref=None),
+        {"num_weights": 2}, grad=False),
     "preloaded_multi_mp_sgd_update": S(
         lambda: [f(4), f(4), f(4), f(3), f(3), f(3),
                  np.array([0.1, 0.1], np.float32),
                  np.zeros(2, np.float32)],
-        {"num_weights": 2}, grad=False, ref=None),
+        {"num_weights": 2}, grad=False),
     "preloaded_multi_mp_sgd_mom_update": S(
         lambda: [f(4), f(4), np.zeros(4, np.float32), f(4),
                  f(3), f(3), np.zeros(3, np.float32), f(3),
                  np.array([0.1, 0.1], np.float32),
                  np.zeros(2, np.float32)],
-        {"num_weights": 2}, grad=False, ref=None),
+        {"num_weights": 2}, grad=False),
     "reset_arrays": S(lambda: [f(3), f(4)], {"num_arrays": 2}, grad=False,
                       ref=lambda a, b: (np.zeros_like(a),
                                         np.zeros_like(b))),
     # nn tail
-    "LRN": S(lambda: [f(2, 6, 4, 4)], {"nsize": 3}, grad=False, ref=None),
+    "LRN": S(lambda: [f(2, 6, 4, 4)], {"nsize": 3}, grad=False,
+             ref=_lrn_ref),
     "BlockGrad": S(lambda: [f(3, 4)], grad=False, ref=lambda x: x),
     "MakeLoss": S(lambda: [fpos(3, 4)], grad=False, ref=lambda x: x),
     "SVMOutput": S(lambda: [f(4, 5), ints(4, hi=5).astype(np.float32)],
@@ -826,7 +1066,10 @@ SPECS.update({
         lambda: [fpos(1, 2, 5, 5), np.zeros((1, 18, 5, 5), np.float32),
                  f(3, 2, 3, 3)],
         {"kernel": (3, 3), "pad": (1, 1), "num_filter": 3, "no_bias": True},
-        grad=False, ref=None),
+        grad=False,
+        # zero offsets make deformable conv == plain convolution
+        ref=lambda x, off, w: _conv2d_ref(x, w, np.zeros(3, np.float32),
+                                          pad=1)),
     # quantized tail (numeric contracts pinned in test_quantization)
     "_contrib_quantized_batch_norm": S(
         lambda: [ints(2, 3, 4, 4, lo=-100, hi=100).astype(np.int8),
@@ -979,13 +1222,13 @@ SPECS.update({
         lambda: [f(4), f(4), f(4), fpos(4), f(3), f(3), f(3), fpos(3),
                  np.array(1.0, np.float32)],
         {"lrs": (0.01, 0.01), "wds": (0.0, 0.0), "num_weights": 2},
-        grad=False, ref=None),
+        grad=False),
     "multi_mp_adamw_update": S(
         lambda: [f(4), f(4), f(4), fpos(4), f(4),
                  f(3), f(3), f(3), fpos(3), f(3),
                  np.array(1.0, np.float32)],
         {"lrs": (0.01, 0.01), "wds": (0.0, 0.0), "num_weights": 2},
-        grad=False, ref=None),
+        grad=False),
     # detection tail 2
     "_contrib_edge_id": S(
         lambda: [np.array([0, 2, 3], np.float32),
@@ -1004,11 +1247,15 @@ SPECS.update({
     "Convolution_v1": S(
         lambda: [fpos(1, 2, 5, 5), f(3, 2, 3, 3)],
         {"kernel": (3, 3), "pad": (1, 1), "num_filter": 3, "no_bias": True},
-        grad=False, ref=None),
+        grad=False,
+        ref=lambda x, w: _conv2d_ref(x, w, np.zeros(3, np.float32),
+                                     pad=1)),
     "Pooling_v1": S(
         lambda: [fpos(1, 2, 5, 5)],
         {"kernel": (2, 2), "stride": (2, 2), "pool_type": "max"},
-        grad=False, ref=None),
+        # v1 pooling uses the CEIL output convention (windows clipped at
+        # the edge) — that is the v1/v2 behavioural difference
+        grad=False, ref=lambda x: _pool_max_ref(x, 2, 2, ceil=True)),
     "_contrib_mrcnn_mask_target": S(
         lambda: [np.array([[[1., 1., 5., 5.]]], np.float32),
                  fpos(1, 2, 8, 8), np.zeros((1, 1), np.float32),
@@ -1065,13 +1312,6 @@ def _anchors():
 
 # --- _npi_* numpy-semantics layer (ops/numpy_ops.py) -----------------------
 # Each op mirrors one numpy function, so the reference IS that function.
-
-def sep(*shape):
-    """Well-separated values: numeric grad safe at order statistics."""
-    flat = np.argsort(R.rand(int(np.prod(shape))))
-    return (flat.reshape(shape).astype(np.float32)
-            + R.uniform(0.1, 0.3, shape).astype(np.float32))
-
 
 _NPI_UNARY_GEN = {
     "log": fpos, "log2": fpos, "log10": fpos, "log1p": fpos, "sqrt": fpos,
@@ -1322,6 +1562,84 @@ SPECS["_npi_lexsort"] = S(lambda: [sep(6), sep(6)],
 # not an elementwise ref
 SPECS["_npi_partition"] = S(lambda: [sep(8)], {"kth": 3}, grad=False)
 SPECS["_npi_argpartition"] = S(lambda: [sep(8)], {"kth": 3}, grad=False)
+
+
+def test_masked_log_softmax_partial():
+    """Masked slots must be -inf and kept slots must renormalize over the
+    kept set only (the battery spec uses an all-ones mask because its
+    finiteness gate rejects -inf)."""
+    x = f(3, 4)
+    m = np.concatenate([np.ones((3, 1), np.int32),
+                        ints(3, 3, lo=0, hi=2)], 1)
+    got = invoke("masked_log_softmax", nd.array(x), nd.array(m),
+                 axis=-1).asnumpy()
+    want = _masked_log_softmax_ref(x, m)
+    b = m.astype(bool)
+    assert np.isneginf(got[~b]).all()
+    assert_almost_equal(got[b], want[b], rtol=1e-4, atol=1e-4,
+                        names=("masked_log_softmax", "ref"))
+
+
+def test_fleet_update_writeback():
+    """multi_* / preloaded_multi_* optimizer fleets write every output back
+    in place (aux_writeback covers them all), so test_forward sees an empty
+    visible return and compares nothing.  Pin the written-back weights
+    against the update formulas here."""
+    def arrs(*xs):
+        return [nd.array(x) for x in xs]
+
+    w1, g1, w2, g2 = f(4), f(4), f(3), f(3)
+    ws = arrs(w1, w2)
+    invoke("multi_sgd_update", ws[0], nd.array(g1), ws[1], nd.array(g2),
+           lrs=(0.1, 0.2), wds=(0.0, 0.0), num_weights=2)
+    assert_almost_equal(ws[0].asnumpy(), w1 - 0.1 * g1, 1e-5, 1e-5,
+                        names=("multi_sgd w1", "ref"))
+    assert_almost_equal(ws[1].asnumpy(), w2 - 0.2 * g2, 1e-5, 1e-5,
+                        names=("multi_sgd w2", "ref"))
+
+    # momentum variant, one step from zero state == plain sgd step
+    ws = arrs(w1, w2)
+    moms = arrs(np.zeros(4, np.float32), np.zeros(3, np.float32))
+    invoke("multi_sgd_mom_update", ws[0], nd.array(g1), moms[0],
+           ws[1], nd.array(g2), moms[1],
+           lrs=(0.1, 0.1), wds=(0.0, 0.0), momentum=0.9, num_weights=2)
+    assert_almost_equal(ws[0].asnumpy(), w1 - 0.1 * g1, 1e-5, 1e-5,
+                        names=("multi_sgd_mom w1", "ref"))
+
+    # mp variant: fp32 master weights drive the update
+    ws = arrs(w1, w2)
+    w32s = arrs(w1.copy(), w2.copy())
+    invoke("multi_mp_sgd_update", ws[0], nd.array(g1), w32s[0],
+           ws[1], nd.array(g2), w32s[1],
+           lrs=(0.1, 0.1), wds=(0.0, 0.0), num_weights=2)
+    assert_almost_equal(ws[0].asnumpy(), w1 - 0.1 * g1, 1e-5, 1e-5,
+                        names=("multi_mp_sgd w1", "ref"))
+    assert_almost_equal(w32s[1].asnumpy(), w2 - 0.1 * g2, 1e-5, 1e-5,
+                        names=("multi_mp_sgd w32", "ref"))
+
+    # preloaded variant: lrs/wds arrive as tensors
+    ws = arrs(w1, w2)
+    invoke("preloaded_multi_sgd_update", ws[0], nd.array(g1),
+           ws[1], nd.array(g2),
+           nd.array(np.array([0.1, 0.3], np.float32)),
+           nd.array(np.zeros(2, np.float32)), num_weights=2)
+    assert_almost_equal(ws[1].asnumpy(), w2 - 0.3 * g2, 1e-5, 1e-5,
+                        names=("preloaded_multi_sgd w2", "ref"))
+
+    # adamw fleet: one step from zero states vs the decoupled-AdamW formula
+    m1, v1 = np.zeros(4, np.float32), np.zeros(4, np.float32)
+    m2, v2 = np.zeros(3, np.float32), np.zeros(3, np.float32)
+    ws = arrs(w1, w2)
+    ms, vs = arrs(m1, m2), arrs(v1, v2)
+    invoke("multi_adamw_update", ws[0], nd.array(g1), ms[0], vs[0],
+           ws[1], nd.array(g2), ms[1], vs[1],
+           nd.array(np.array(1.0, np.float32)),
+           lrs=(0.01, 0.01), wds=(0.0, 0.0), num_weights=2)
+    b1, b2, e = 0.9, 0.999, 1e-8
+    nm, nv = (1 - b1) * g1, (1 - b2) * g1 * g1
+    assert_almost_equal(ws[0].asnumpy(),
+                        w1 - 0.01 * nm / (np.sqrt(nv) + e), 1e-5, 1e-5,
+                        names=("multi_adamw w1", "ref"))
 
 
 def test_npi_partition_semantics():
