@@ -2,10 +2,11 @@
 from .dataset import Dataset, SimpleDataset, ArrayDataset, RecordFileDataset
 from .sampler import (Sampler, SequentialSampler, RandomSampler, BatchSampler,
                       FilterSampler, IntervalSampler)
-from .dataloader import DataLoader, default_batchify_fn
+from .dataloader import (DataLoader, default_batchify_fn,
+                         default_mp_batchify_fn)
 from . import vision
 
 __all__ = ["Dataset", "SimpleDataset", "ArrayDataset", "RecordFileDataset",
            "Sampler", "SequentialSampler", "RandomSampler", "BatchSampler",
            "FilterSampler", "IntervalSampler", "DataLoader",
-           "default_batchify_fn", "vision"]
+           "default_batchify_fn", "default_mp_batchify_fn", "vision"]

@@ -19,6 +19,7 @@ import io as _io
 import numbers
 import os
 import struct
+import threading
 from collections import namedtuple
 
 import numpy as _np
@@ -80,6 +81,7 @@ class MXRecordIO:
         self._handle = None
         self._lib = None      # pinned per instance so close() survives
         self._pyfile = None   # python fallback
+        self._read_lock = threading.Lock()
         self.open()
 
     # -- lifecycle ----------------------------------------------------------
@@ -124,7 +126,24 @@ class MXRecordIO:
             pass  # interpreter shutdown: module globals may be gone
 
     def __getstate__(self):
-        raise RuntimeError("MXRecordIO is not picklable; reopen per process")
+        """Readers are picklable for multiprocess DataLoader workers —
+        the handle is dropped and each process reopens on unpickle
+        (reference: recordio reopening across _MultiWorkerIter forks).
+        Writers hold buffered state and must not cross processes."""
+        if self.flag != "r":
+            raise RuntimeError("MXRecordIO writers are not picklable")
+        state = self.__dict__.copy()
+        state["_handle"] = None
+        state["_lib"] = None
+        state["_pyfile"] = None
+        state["is_open"] = False
+        state.pop("_read_lock", None)     # locks do not pickle
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._read_lock = threading.Lock()
+        self.open()
 
     # -- IO ------------------------------------------------------------------
     def write(self, buf: bytes) -> None:
@@ -253,8 +272,12 @@ class MXIndexedRecordIO(MXRecordIO):
             self._pyfile.seek(pos)
 
     def read_idx(self, idx):
-        self.seek(idx)
-        return self.read()
+        # seek+read must be atomic: DataLoader's thread_pool path (and any
+        # user threads) share one reader, and an interleaved seek silently
+        # returns the WRONG record
+        with self._read_lock:
+            self.seek(idx)
+            return self.read()
 
     def write_idx(self, idx, buf: bytes):
         assert self.flag == "w"
