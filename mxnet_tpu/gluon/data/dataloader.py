@@ -64,6 +64,8 @@ def default_mp_batchify_fn(data):
 def _to_numpy_tree(batch):
     if isinstance(batch, NDArray):
         return batch.asnumpy()
+    if isinstance(batch, tuple) and hasattr(batch, "_fields"):  # namedtuple
+        return type(batch)(*(_to_numpy_tree(b) for b in batch))
     if isinstance(batch, (list, tuple)):
         return type(batch)(_to_numpy_tree(b) for b in batch)
     return batch
@@ -72,6 +74,8 @@ def _to_numpy_tree(batch):
 def _to_nd_tree(batch):
     if isinstance(batch, _np.ndarray):
         return nd.array(batch)
+    if isinstance(batch, tuple) and hasattr(batch, "_fields"):
+        return type(batch)(*(_to_nd_tree(b) for b in batch))
     if isinstance(batch, (list, tuple)):
         return [_to_nd_tree(b) for b in batch]
     return batch
@@ -82,14 +86,21 @@ _worker_dataset = None
 _worker_batchify = None
 
 
+_worker_init_error = None
+
+
 def _worker_initializer(dataset_bytes, batchify_bytes):
     """Runs once in each spawned worker: pin the CPU backend, THEN
     unpickle the dataset/batchify.  The payloads travel as raw pickle
     bytes so no user object is unpickled before the pin — a pool-respawned
     replacement worker (after an OOM-kill) must also never initialize the
-    TPU backend, and it spawns with whatever env the parent has then."""
+    TPU backend, and it spawns with whatever env the parent has then.
+
+    An unpickle failure must NOT raise here: a raising initializer makes
+    multiprocessing respawn dying workers forever and the user only ever
+    sees a timeout.  Record the error; _worker_fn reports it per task."""
     import pickle
-    global _worker_dataset, _worker_batchify
+    global _worker_dataset, _worker_batchify, _worker_init_error
     os.environ["MX_FORCE_CPU"] = "1"
     os.environ["JAX_PLATFORMS"] = "cpu"
     try:
@@ -97,11 +108,20 @@ def _worker_initializer(dataset_bytes, batchify_bytes):
         pin_cpu()
     except Exception:
         pass
-    _worker_dataset = pickle.loads(dataset_bytes)
-    _worker_batchify = pickle.loads(batchify_bytes)
+    try:
+        _worker_dataset = pickle.loads(dataset_bytes)
+        _worker_batchify = pickle.loads(batchify_bytes)
+    except Exception as e:  # e.g. dataset class only importable in parent
+        _worker_init_error = "%s: %s" % (type(e).__name__, e)
 
 
 def _worker_fn(indices):
+    if _worker_init_error is not None:
+        raise RuntimeError(
+            "DataLoader worker could not reconstruct the dataset in the "
+            "spawned process (%s). The dataset/batchify must be importable "
+            "from the worker — move classes out of __main__, or use "
+            "thread_pool=True." % _worker_init_error)
     samples = [_worker_dataset[i] for i in indices]
     return _to_numpy_tree(_worker_batchify(samples))
 
@@ -183,6 +203,11 @@ class DataLoader:
         samples = [self._dataset[i] for i in indices]
         return (self._batchify_fn or default_batchify_fn)(samples)
 
+    def _depth(self):
+        """In-flight batches: explicit prefetch honored (min 1 — the
+        push-one-pop-one floor), default 2x workers."""
+        return max(1, self._prefetch)
+
     def _iter_threads(self):
         """Thread-pool path (thread_pool=True): decode in threads, PIL's C
         codecs release the GIL."""
@@ -190,7 +215,7 @@ class DataLoader:
             futures = []
             it = iter(self._batch_sampler)
             try:
-                for _ in range(self._prefetch or self._num_workers):
+                for _ in range(self._depth()):
                     futures.append(pool.submit(self._load_batch, next(it)))
             except StopIteration:
                 pass
@@ -209,7 +234,7 @@ class DataLoader:
         pending = []
         it = iter(self._batch_sampler)
         try:
-            for _ in range(self._prefetch or self._num_workers):
+            for _ in range(self._depth()):
                 pending.append(pool.apply_async(_worker_fn,
                                                 (list(next(it)),)))
         except StopIteration:

@@ -83,7 +83,8 @@ class KVStore:
     def push(self, key, value, priority=0):
         keys, values = self._normalize(key, value)
         for k, v in zip(keys, values):
-            merged = self._reduce(v if isinstance(v, (list, tuple)) else [v])
+            merged = self._reduce(v if isinstance(v, (list, tuple)) else [v],
+                                  key=k)
             stored = self._store.get(k)
             if stored is None:
                 raise MXNetError("key %s has not been initialized" % k)
@@ -152,25 +153,32 @@ class KVStore:
 
     def set_gradient_compression(self, compression_params):
         """Reference: kvstore.py set_gradient_compression →
-        src/kvstore/gradient_compression.cc (2-bit PS compression).
+        src/kvstore/gradient_compression.cc.
 
-        TPU collective path: ``{'type': 'bf16'}`` is the supported scheme —
-        gradients are cast to bfloat16 before the allreduce (half the
-        ICI/DCN bytes, the SURVEY-sanctioned equivalent of the reference's
-        2-bit PS compression).  Anything else warns loudly instead of
-        silently succeeding."""
+        ``{'type': '2bit', 'threshold': t}`` — the reference's exact
+        scheme: per-key residual error feedback, each pushed gradient
+        quantized to {-t, 0, +t} per worker before the reduce
+        (gradient_compression.py).  ``{'type': 'bf16'}`` — TPU-extra:
+        cast payloads to bfloat16 before the allreduce (half the ICI/DCN
+        bytes).  Anything else warns loudly instead of silently
+        succeeding."""
         import warnings
-        ctype = (compression_params or {}).get("type")
+        params = dict(compression_params or {})
+        ctype = params.get("type")
+        self._gc = None
+        self._compress_bf16 = False
+        if ctype == "2bit":
+            from .gradient_compression import GradientCompression
+            self._gc = GradientCompression(
+                threshold=float(params.get("threshold", 0.5)))
+            return
         if ctype == "bf16":
             self._compress_bf16 = True
             return
-        self._compress_bf16 = False  # unsupported/None DISABLES compression
         if ctype is not None:
             warnings.warn(
-                "gradient compression %r is not supported on the TPU "
-                "collective path (no parameter server to dequantize); "
-                "gradients will NOT be compressed. Use {'type': 'bf16'} "
-                "for bfloat16 allreduce compression." % (ctype,),
+                "gradient compression %r is not supported (use '2bit' or "
+                "'bf16'); gradients will NOT be compressed." % (ctype,),
                 stacklevel=2)
 
     def _maybe_compress(self, x):
@@ -201,7 +209,19 @@ class KVStore:
             return [_key(k) for k in key], list(value)
         return [_key(key)], [value]
 
-    def _reduce(self, values: List[NDArray]) -> NDArray:
+    def _reduce(self, values: List[NDArray], key=None) -> NDArray:
+        merged = self._reduce_local(values)
+        # 2-bit error-feedback quantization of the per-process merged
+        # gradient (reference: worker quantizes AFTER its local multi-GPU
+        # reduce, before the wire — kvstore_dist.h PushImpl)
+        gc = getattr(self, "_gc", None)
+        if gc is not None and key is not None and \
+                jnp.issubdtype(merged._jax.dtype, jnp.floating):
+            merged = NDArray(gc.quantize(key, merged._jax),
+                             ctx=merged.context)
+        return merged
+
+    def _reduce_local(self, values: List[NDArray]) -> NDArray:
         if len(values) == 1:
             return values[0]
         target = values[0].context
@@ -327,8 +347,8 @@ class KVStoreICI(KVStoreLocal):
             NamedSharding(mesh, P("dp")), [shard])
         return fn(stacked)
 
-    def _reduce(self, values: List[NDArray]) -> NDArray:
-        merged = super()._reduce(values)
+    def _reduce(self, values: List[NDArray], key=None) -> NDArray:
+        merged = super()._reduce(values, key=key)
         if self._size > 1:
             payload, orig_dtype = self._maybe_compress(merged._jax)
             out = self._cross_process_sum(payload)

@@ -178,9 +178,14 @@ def test_gradient_compression_bf16(tmp_path):
         # bf16 has ~3 decimal digits: sum 3*v to bf16 precision
         np.testing.assert_allclose(out.asnumpy(), 3 * v, rtol=2e-2)
         assert out.dtype == np.float32          # decompressed on arrival
+        # '2bit' is now a real scheme (no warning); junk still warns
         with warnings.catch_warnings(record=True) as w:
             warnings.simplefilter("always")
             kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+        assert not w, [str(x.message) for x in w]
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            kv.set_gradient_compression({"type": "1bit"})
         assert any("not supported" in str(x.message) for x in w), w
         print("COMPRESS_OK rank", rank, flush=True)
     """))
@@ -269,3 +274,46 @@ def test_trainer_update_on_kvstore_two_process(tmp_path):
     """))
     out = _launch(script)
     assert out.count("UOK_OK") == 2
+
+
+def test_2bit_compression_two_process_sum_with_residual(tmp_path):
+    """VERDICT r3 #5: dist contract of {'type': '2bit'} — each worker
+    quantizes its pushed grad to {-t, 0, +t} with per-key error feedback;
+    the pull is the num_workers-sum of the quantized levels, and over many
+    pushes the accumulated sum tracks the true sum within num_workers *
+    threshold per element (residual never exceeds the threshold band)."""
+    import textwrap as tw
+    script = tmp_path / "w.py"
+    script.write_text(tw.dedent(_PRELUDE) + tw.dedent("""
+        from mxnet_tpu import kvstore
+        kv = kvstore.create("ici")
+        rank = kv.rank
+        t = 0.5
+        kv.set_gradient_compression({"type": "2bit", "threshold": t})
+
+        # per-step |g| must stay under the threshold: 2-bit can emit at
+        # most one +-t level per step (the reference has the same tracking
+        # condition)
+        g = np.array([0.1, -0.2, 0.15, 0.05], np.float32) * (rank + 1)
+        kv.init("w", nd.zeros((4,)))
+        total = np.zeros(4, np.float32)
+        for step in range(8):
+            kv.push("w", nd.array(g))
+            out = nd.zeros((4,))
+            kv.pull("w", out=out)
+            got = out.asnumpy()
+            # every pulled element is a sum of 2 workers' levels from
+            # {-t, 0, +t}
+            lv = np.array([-2*t, -t, 0.0, t, 2*t], np.float32)
+            assert all(np.isclose(lv, v).any() for v in got), got
+            total += got
+        # error feedback: per worker the emitted sum differs from the true
+        # sum by the final residual, |residual| < t + |g|_max
+        base = g / (rank + 1)
+        true = 8 * 3 * base                      # g_0 + g_1 = 3 * base
+        bound = 2 * (t + np.abs(g).max())
+        assert np.all(np.abs(total - true) <= bound), (total, true)
+        print("COMPRESS2BIT_OK rank", rank, flush=True)
+    """))
+    out = _launch(script)
+    assert out.count("COMPRESS2BIT_OK") == 2
