@@ -48,6 +48,7 @@ from . import symbol
 from . import symbol as sym
 from .symbol import AttrScope
 from . import contrib
+from . import subgraph
 from . import initializer
 from . import initializer as init
 from . import metric

@@ -36,6 +36,40 @@ __all__ = ["init", "init_trainer", "scale_loss", "unscale", "LossScaler",
 # Consulted by ndarray._invoke_impl on every dispatch; None = AMP off.
 STATE: Optional["_AmpState"] = None
 
+# Thread-local override stack: per-block subgraph properties (amp_bf16 /
+# amp_float16) scope a policy to ONE block's trace without touching the
+# process-wide STATE other threads read concurrently.
+import threading as _threading  # noqa: E402
+
+_TLS = _threading.local()
+
+
+def current_state() -> Optional["_AmpState"]:
+    """The effective AMP policy for this thread: innermost scoped override
+    first, else the process-wide STATE."""
+    stack = getattr(_TLS, "stack", None)
+    if stack:
+        return stack[-1]
+    return STATE
+
+
+class state_scope:
+    """Push a scoped policy (or None to disable AMP inside the scope)."""
+
+    def __init__(self, state: Optional["_AmpState"]):
+        self._state = state
+
+    def __enter__(self):
+        if not hasattr(_TLS, "stack"):
+            _TLS.stack = []
+        _TLS.stack.append(self._state)
+        return self
+
+    def __exit__(self, *exc):
+        _TLS.stack.pop()
+        return False
+
+
 _NARROW = (jnp.bfloat16, jnp.float16)
 
 
@@ -85,6 +119,25 @@ class _AmpState:
         return x
 
 
+def make_state(target_dtype="bfloat16", target_dtype_ops=None, fp32_ops=None,
+               widest_dtype_ops=None, conditional_fp32_ops=None) -> "_AmpState":
+    """Build a policy state without installing it (used by amp.init and by
+    the per-block subgraph properties)."""
+    dt = _np.dtype(jnp.bfloat16) if str(target_dtype) == "bfloat16" \
+        else _np.dtype(target_dtype)
+    if dt not in (_np.dtype(jnp.bfloat16), _np.dtype("float16")):
+        raise ValueError("AMP target_dtype must be bfloat16 or float16, "
+                         "got %s" % target_dtype)
+    return _AmpState(
+        dt,
+        lists.TARGET_DTYPE_OPS if target_dtype_ops is None else target_dtype_ops,
+        lists.FP32_OPS if fp32_ops is None else fp32_ops,
+        lists.WIDEST_TYPE_CASTS if widest_dtype_ops is None else widest_dtype_ops,
+        lists.CONDITIONAL_FP32_OPS if conditional_fp32_ops is None
+        else conditional_fp32_ops,
+    )
+
+
 def init(target_dtype="bfloat16", target_dtype_ops=None, fp32_ops=None,
          widest_dtype_ops=None, conditional_fp32_ops=None):
     """Turn AMP on (reference: amp.init).
@@ -93,19 +146,8 @@ def init(target_dtype="bfloat16", target_dtype_ops=None, fp32_ops=None,
     The *_ops arguments override the default lists in ``amp.lists``.
     """
     global STATE
-    dt = _np.dtype(jnp.bfloat16) if str(target_dtype) == "bfloat16" \
-        else _np.dtype(target_dtype)
-    if dt not in (_np.dtype(jnp.bfloat16), _np.dtype("float16")):
-        raise ValueError("AMP target_dtype must be bfloat16 or float16, "
-                         "got %s" % target_dtype)
-    STATE = _AmpState(
-        dt,
-        lists.TARGET_DTYPE_OPS if target_dtype_ops is None else target_dtype_ops,
-        lists.FP32_OPS if fp32_ops is None else fp32_ops,
-        lists.WIDEST_TYPE_CASTS if widest_dtype_ops is None else widest_dtype_ops,
-        lists.CONDITIONAL_FP32_OPS if conditional_fp32_ops is None
-        else conditional_fp32_ops,
-    )
+    STATE = make_state(target_dtype, target_dtype_ops, fp32_ops,
+                       widest_dtype_ops, conditional_fp32_ops)
 
 
 def turn_off():

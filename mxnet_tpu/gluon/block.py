@@ -425,10 +425,19 @@ class HybridBlock(Block):
             (trainable if p.grad_req != "null" else frozen).append(p)
         recording = autograd.is_recording()
         training = autograd.is_training()
+        backend = getattr(self, "_backend", None)
+        from ..subgraph import get_backend as _get_backend
+        from ..ops import attention as _att
         key = (tuple((v.shape, str(v.dtype)) for v in in_vals),
                tuple((p.shape, str(p.dtype)) for _, p in params),
                tuple(sorted(kwargs.items())) if kwargs else (),
-               recording, training)
+               recording, training,
+               # lowering identity: the property's cache token AND the
+               # process-wide attention default the scoped impl falls back
+               # to — changing either must retrace, never reuse a stale
+               # executable
+               _get_backend(backend).cache_token() if backend else None,
+               _att._FORCED_IMPL)
         entry = self._cache.get(key)
         if entry is None:
             entry = self._build_cache(params, trainable, frozen, template,
@@ -439,12 +448,17 @@ class HybridBlock(Block):
         f_vals = tuple(p.data(ctx)._jax for p in frozen)
         rng = _ops_random.next_key()
 
-        if recording:
-            outs, vjp_fn, mutated = entry.fwd_train(t_vals, f_vals, rng,
-                                                    tuple(in_vals))
-        else:
-            outs, mutated = entry.fwd_infer(t_vals, f_vals, rng, tuple(in_vals))
-            vjp_fn = None
+        # per-block lowering overrides active for trace AND execution:
+        # jax.jit traces lazily on the first call of the jitted fn, so the
+        # property scope must wrap the call, not just entry construction
+        with self._backend_scope():
+            if recording:
+                outs, vjp_fn, mutated = entry.fwd_train(t_vals, f_vals, rng,
+                                                        tuple(in_vals))
+            else:
+                outs, mutated = entry.fwd_infer(t_vals, f_vals, rng,
+                                                tuple(in_vals))
+                vjp_fn = None
 
         # write mutated aux state (BatchNorm running stats) back into params
         by_id = {id(p): p for _, p in params}
@@ -536,34 +550,44 @@ class HybridBlock(Block):
 
     def optimize_for(self, x, backend=None, clear=True, **kwargs):
         """Reference: HybridBlock.optimize_for(backend) — subgraph-backend
-        selection.  Real lowering configs on TPU:
+        selection via the backend-property registry (mxnet_tpu.subgraph;
+        reference subgraph_property.h SubgraphPropertyRegistry).
 
-        * ``backend='pallas'`` forces the Pallas flash-attention kernel in
-          every attention_core dispatch where block alignment permits
-          (the reference's force-a-partitioned-subgraph role);
-        * ``backend='xla'`` forces the plain jnp/XLA composition;
-        * ``backend=None`` restores the heuristic.
-
-        The config is process-wide (like MXNET_SUBGRAPH_BACKEND), not
-        per-block; unknown backends warn loudly instead of silently
-        doing nothing."""
-        from ..ops import attention as _att
-        if backend in (None, "pallas", "xla"):
-            _att.set_attention_impl(backend)
-            self._backend = backend
-        else:
-            import warnings
-            warnings.warn(
-                "optimize_for backend %r is not a TPU lowering config "
-                "(supported: 'pallas', 'xla', None); running the default "
-                "XLA path" % (backend,), stacklevel=2)
-            _att.set_attention_impl(None)   # make the warning true
+        The named property's lowering overrides apply to THIS block only
+        (per-block semantics like the reference, not process-wide): its
+        scope is entered around every trace/execution of this block's
+        cached op, and the cached-op key carries the backend name.
+        Built-ins: ``'pallas'`` (force the Pallas flash-attention kernel
+        where alignment permits), ``'xla'`` (plain jnp composition),
+        ``'amp_bf16'`` / ``'amp_float16'`` (AMP policy lists scoped to the
+        block).  ``None`` restores default lowering.  Unknown backends
+        warn loudly instead of silently doing nothing."""
+        from ..subgraph import get_backend
+        if backend is None:
             self._backend = None
+        else:
+            try:
+                get_backend(backend)
+                self._backend = backend
+            except KeyError as e:
+                import warnings
+                warnings.warn(
+                    "optimize_for: %s; running the default XLA path" % e,
+                    stacklevel=2)
+                self._backend = None
         if clear:
             self._clear_cached_op()  # retrace under the new lowering config
         self.hybridize(True, **{k: v for k, v in kwargs.items()
                                 if k in ("static_alloc", "static_shape")})
         return self(x)
+
+    def _backend_scope(self):
+        import contextlib
+        backend = getattr(self, "_backend", None)
+        if backend is None:
+            return contextlib.nullcontext()
+        from ..subgraph import get_backend
+        return get_backend(backend).scope()
 
     def forward(self, *args, **kwargs):
         raise NotImplementedError

@@ -783,7 +783,7 @@ def _invoke_impl(op_name: str, *inputs, out=None, **params):
         else:
             raise TypeError("invoke(%s): bad input type %s" % (op_name, type(x)))
     ctx = ctx or current_context()
-    amp_state = _amp.STATE
+    amp_state = _amp.current_state()
     if amp_state is not None:
         jax_in = amp_state.cast_inputs(op.name, params, jax_in)
     if op.needs_rng:

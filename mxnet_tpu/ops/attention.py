@@ -17,6 +17,7 @@ composition, which XLA fuses well at moderate sequence length.
 from __future__ import annotations
 
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -46,9 +47,14 @@ def _interpret() -> bool:
 # Lowering config (reference role: optimize_for(backend) /
 # MXNET_SUBGRAPH_BACKEND): None = heuristic dispatch, "pallas" = force the
 # flash kernel wherever alignment permits (any backend; CPU interprets),
-# "xla" = force the jnp composition.  Process-wide, set through
-# HybridBlock.optimize_for or set_attention_impl.
+# "xla" = force the jnp composition.  Two levels:
+#   * process-wide default via set_attention_impl (MXNET_SUBGRAPH_BACKEND
+#     role);
+#   * a thread-local SCOPE (attention_impl_scope) that the subgraph
+#     backend-property registry pushes around one block's trace, so
+#     per-block optimize_for never leaks into other blocks.
 _FORCED_IMPL = None
+_IMPL_TLS = threading.local()
 
 
 def set_attention_impl(impl):
@@ -58,6 +64,33 @@ def set_attention_impl(impl):
     prev = _FORCED_IMPL
     _FORCED_IMPL = impl
     return prev
+
+
+def current_attention_impl():
+    stack = getattr(_IMPL_TLS, "stack", None)
+    if stack:
+        return stack[-1]
+    return _FORCED_IMPL
+
+
+class attention_impl_scope:
+    """Scoped override: the innermost scope wins over the global."""
+
+    def __init__(self, impl):
+        if impl not in (None, "pallas", "xla"):
+            raise ValueError("attention impl must be None, 'pallas' or "
+                             "'xla'")
+        self._impl = impl
+
+    def __enter__(self):
+        if not hasattr(_IMPL_TLS, "stack"):
+            _IMPL_TLS.stack = []
+        _IMPL_TLS.stack.append(self._impl)
+        return self
+
+    def __exit__(self, *exc):
+        _IMPL_TLS.stack.pop()
+        return False
 
 
 # ---------------------------------------------------------------------------
@@ -420,9 +453,10 @@ def attention_core(q, k, v, scale=None, causal=False, mask=None):
     Tq, Tk, D = q.shape[2], k.shape[2], q.shape[3]
     aligned = (mask is None and Tq % _BLOCK_Q == 0 and Tk % _BLOCK_K == 0
                and D % 128 == 0 and (not causal or Tq == Tk))
-    if _FORCED_IMPL == "xla":
+    impl = current_attention_impl()
+    if impl == "xla":
         use_flash = False
-    elif _FORCED_IMPL == "pallas":
+    elif impl == "pallas":
         use_flash = aligned          # CPU interprets; TPU lowers via Mosaic
     else:
         use_flash = _on_tpu() and aligned

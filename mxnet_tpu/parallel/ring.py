@@ -69,12 +69,13 @@ def _flash_ok(q, k) -> bool:
     config forced, which interprets off-TPU), never interpret-by-default
     on CPU/GPU where the compiled jnp path is far faster."""
     from ..ops import attention as _att
-    if _att._FORCED_IMPL == "xla":
+    impl = _att.current_attention_impl()   # per-block scope wins over global
+    if impl == "xla":
         return False
     lq, lk, d = q.shape[1], k.shape[1], q.shape[3]
     aligned = (lq % _att._BLOCK_Q == 0 and lk % _att._BLOCK_K == 0
                and d % 128 == 0)
-    return aligned and (_att._on_tpu() or _att._FORCED_IMPL == "pallas")
+    return aligned and (_att._on_tpu() or impl == "pallas")
 
 
 def _ring_attention_flash(q, k, v, *, axis_name, causal, scale):

@@ -1,0 +1,128 @@
+"""Subgraph backend-property registry — named lowering configs.
+
+Reference: ``src/operator/subgraph/subgraph_property.h`` (SubgraphProperty,
+SubgraphPropertyRegistry, MXNET_SUBGRAPH_BACKEND) and
+``build_subgraph.cc`` — the mechanism behind ``HybridBlock.optimize_for
+(backend)``: a registry of named backend properties, each of which selects
+and rewrites parts of the graph for its target.
+
+TPU-native realization: XLA already does the partition/fuse work, so a
+property here is a *scoped bundle of lowering overrides* applied around
+one block's trace — which kernel an op lowers to (Pallas flash vs XLA
+composition for attention), what dtype policy applies (AMP bf16 lists),
+etc.  Properties are PER BLOCK: ``net.optimize_for(x, backend='pallas')``
+stamps the property on that block, the cached-op plumbing enters the
+property's scope for that block's traces/executions only, and the cache
+key carries the backend name so different lowerings never share an
+executable.  The reference's process-wide ``MXNET_SUBGRAPH_BACKEND``
+escape hatch maps to the process-wide defaults (e.g.
+``ops.attention.set_attention_impl``).
+
+Adding a backend::
+
+    @register_backend("my_lowering")
+    class MyProperty(SubgraphProperty):
+        def scope(self):
+            return some_context_manager()
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict
+
+__all__ = ["SubgraphProperty", "register_backend", "get_backend",
+           "list_backends"]
+
+_REGISTRY: Dict[str, "SubgraphProperty"] = {}
+
+
+class SubgraphProperty:
+    """A named lowering config (reference: class SubgraphProperty).
+
+    Subclasses override :meth:`scope` to return a context manager that
+    installs this property's overrides for the duration of one block
+    trace/execution."""
+
+    name: str = ""
+
+    def scope(self):
+        return contextlib.nullcontext()
+
+    def cache_token(self):
+        """Hashable identity mixed into the block's cached-op key — two
+        properties whose lowering differs must not share executables."""
+        return self.name
+
+
+def register_backend(name: str):
+    """Decorator: register a SubgraphProperty class or instance under
+    `name` (reference: MXNET_REGISTER_SUBGRAPH_PROPERTY)."""
+
+    def _do(obj):
+        prop = obj() if isinstance(obj, type) else obj
+        prop.name = name
+        _REGISTRY[name] = prop
+        return obj
+
+    return _do
+
+
+def get_backend(name: str) -> SubgraphProperty:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            "unknown subgraph backend %r (registered: %s)"
+            % (name, ", ".join(sorted(_REGISTRY)) or "<none>")) from None
+
+
+def list_backends():
+    return sorted(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# built-in properties
+# ---------------------------------------------------------------------------
+
+
+@register_backend("pallas")
+class _PallasAttention(SubgraphProperty):
+    """Force the Pallas flash-attention kernel wherever block alignment
+    permits (the reference's force-a-partitioned-subgraph role)."""
+
+    def scope(self):
+        from .ops.attention import attention_impl_scope
+        return attention_impl_scope("pallas")
+
+
+@register_backend("xla")
+class _XlaAttention(SubgraphProperty):
+    """Force the plain jnp/XLA attention composition."""
+
+    def scope(self):
+        from .ops.attention import attention_impl_scope
+        return attention_impl_scope("xla")
+
+
+@register_backend("amp_bf16")
+class _AmpBf16(SubgraphProperty):
+    """Apply the AMP bfloat16 policy lists (amp/lists.py) to every op
+    dispatched inside this block — per-block mixed precision without the
+    process-wide amp.init()."""
+
+    def scope(self):
+        return _amp_scope("bfloat16")
+
+
+@register_backend("amp_float16")
+class _AmpFp16(SubgraphProperty):
+    def scope(self):
+        return _amp_scope("float16")
+
+
+def _amp_scope(dtype):
+    # thread-local override: the REQUESTED policy always applies inside the
+    # block (even when a different process-wide amp.init is active), and
+    # concurrent threads never observe it
+    from . import amp as _amp
+    return _amp.state_scope(_amp.make_state(target_dtype=dtype))

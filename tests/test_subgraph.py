@@ -1,0 +1,110 @@
+"""Subgraph backend-property registry (reference:
+src/operator/subgraph/subgraph_property.h SubgraphPropertyRegistry,
+HybridBlock.optimize_for; tests/python/unittest/test_subgraph.py pattern).
+
+Key invariant: properties are PER BLOCK — two blocks with different
+backends coexist without clobbering each other or the process default.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.subgraph import (SubgraphProperty, register_backend,
+                                get_backend, list_backends)
+
+
+def test_registry_has_builtin_properties():
+    names = list_backends()
+    for expected in ("pallas", "xla", "amp_bf16", "amp_float16"):
+        assert expected in names, names
+    assert get_backend("pallas").cache_token() == "pallas"
+    with pytest.raises(KeyError, match="unknown subgraph backend"):
+        get_backend("tensorrt")
+
+
+def test_register_custom_property_and_scope_runs():
+    seen = []
+
+    @register_backend("_test_prop")
+    class _P(SubgraphProperty):
+        def scope(self):
+            import contextlib
+
+            @contextlib.contextmanager
+            def cm():
+                seen.append("enter")
+                yield
+                seen.append("exit")
+            return cm()
+
+    net = nn.Dense(3, in_units=4)
+    net.initialize()
+    x = nd.ones((2, 4))
+    net.optimize_for(x, backend="_test_prop")
+    assert net._backend == "_test_prop"
+    net(x).wait_to_read()
+    assert seen and seen.count("enter") == seen.count("exit")
+
+
+def test_per_block_attention_isolation():
+    """Block A forced 'pallas', block B forced 'xla', plain calls default:
+    the scoped impl must be visible only inside each block's execution."""
+    from mxnet_tpu.ops import attention as att
+
+    class AttnBlock(nn.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.seen = []
+
+        def hybrid_forward(self, F, x):
+            self.seen.append(att.current_attention_impl())
+            return x * 2
+
+    a, b = AttnBlock(), AttnBlock()
+    for blk in (a, b):
+        blk.initialize()
+    x = nd.ones((2, 4))
+    a.optimize_for(x, backend="pallas")
+    b.optimize_for(x, backend="xla")
+    assert att.current_attention_impl() is None   # nothing leaked
+    a(x).wait_to_read()
+    b(x).wait_to_read()
+    assert "pallas" in a.seen and "xla" not in a.seen
+    assert "xla" in b.seen and "pallas" not in b.seen
+    assert att.current_attention_impl() is None
+
+
+def test_backend_cache_key_separation():
+    """Same block re-targeted: executables must not be shared across
+    lowering configs (the backend is part of the cached-op key)."""
+    net = nn.Dense(3, in_units=4)
+    net.initialize()
+    x = nd.ones((2, 4))
+    net.optimize_for(x, backend="pallas")
+    net(x).wait_to_read()
+    keys_pallas = set(net._cache)
+    net.optimize_for(x, backend="xla", clear=False)
+    net(x).wait_to_read()
+    assert set(net._cache) != keys_pallas        # new entries, old intact
+    # key layout: (..., property_token, global_attention_default)
+    assert all(k[-2] in ("pallas", "xla") for k in net._cache)
+    assert all(k[-1] is None for k in net._cache)
+
+
+def test_amp_bf16_property_casts_inside_block_only():
+    import mxnet_tpu.amp as amp
+
+    net = nn.Dense(8, in_units=8)
+    net.initialize()
+    x = nd.ones((2, 8))
+    out_plain = net(x)
+    assert str(out_plain.dtype) == "float32"
+    net.optimize_for(x, backend="amp_bf16")
+    out_amp = net(x)
+    assert amp.STATE is None                      # scope did not leak
+    assert "bfloat16" in str(out_amp.dtype)
+    # numerics stay close at bf16 precision
+    np.testing.assert_allclose(out_amp.asnumpy().astype(np.float32),
+                               out_plain.asnumpy(), rtol=2e-2, atol=2e-2)

@@ -245,14 +245,16 @@ def test_optimize_for_selects_attention_lowering():
     out_x = np.asarray(att.attention_core(q, k, v))
     assert np.allclose(out_p, out_x, atol=2e-4)
 
-    # the Block surface routes through the same switch; unknown backends warn
+    # the Block surface stamps a PER-BLOCK property (never the global);
+    # unknown backends warn
     net = nn.Dense(4, in_units=8)
     net.initialize()
     x = mx.nd.ones((2, 8))
     net.optimize_for(x, backend="pallas")
-    assert att._FORCED_IMPL == "pallas"
+    assert att._FORCED_IMPL is None          # global untouched
+    assert net._backend == "pallas"
     with warnings.catch_warnings(record=True) as w:
         warnings.simplefilter("always")
         net.optimize_for(x, backend="tensorrt")
-    assert any("lowering config" in str(x.message) for x in w)
-    att.set_attention_impl(None)
+    assert any("unknown subgraph backend" in str(x.message) for x in w)
+    assert net._backend is None
