@@ -27,7 +27,8 @@ from ..ndarray.ndarray import NDArray
 from ..device import cpu
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "CSVIter", "ImageRecordIter"]
+           "PrefetchingIter", "CSVIter", "ImageRecordIter", "LibSVMIter",
+           "MNISTIter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
@@ -310,6 +311,169 @@ class CSVIter(DataIter):
             data, label, batch_size,
             last_batch_handle="pad" if round_batch else "discard",
             label_name="label")
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+
+class LibSVMIter(DataIter):
+    """Sparse batches from LibSVM text files (reference:
+    src/io/iter_libsvm.cc LibSVMIterParam/LibSVMIter via io.LibSVMIter).
+
+    Each line is ``label idx:val idx:val ...``; batches come out as
+    CSRNDArray of shape (batch_size, num_features) — the sparse-iterator
+    integration path (feeds rowsparse/CSR pipelines).  ``label_libsvm``
+    optionally reads labels (possibly multi-output, also sparse text)
+    from a second file, like the reference.  Loads eagerly (host RAM);
+    the reference streams, same documented trade as CSVIter."""
+
+    def __init__(self, data_libsvm, data_shape, batch_size=1,
+                 label_libsvm=None, label_shape=(1,), round_batch=True,
+                 **_kw):
+        super().__init__(batch_size)
+        n_feat = int(data_shape[0] if isinstance(data_shape, (tuple, list))
+                     else data_shape)
+        labels, rows = self._parse(data_libsvm)
+        if label_libsvm is not None:
+            n_lab = int(label_shape[0] if isinstance(label_shape,
+                                                     (tuple, list))
+                        else label_shape)
+            lab_rows = self._parse(label_libsvm)[1]
+            labels = _np.zeros((len(lab_rows), n_lab), _np.float32)
+            for i, row in enumerate(lab_rows):
+                for j, v in row:
+                    if j < n_lab:
+                        labels[i, j] = v
+        else:
+            labels = _np.asarray(labels, _np.float32).reshape(-1, 1)
+        if len(rows) != len(labels):
+            raise ValueError("libsvm data has %d rows but labels have %d"
+                             % (len(rows), len(labels)))
+        self._rows = rows
+        self._labels = labels
+        self._n_feat = n_feat
+        self._round_batch = round_batch
+        self._cursor = 0
+
+    @staticmethod
+    def _parse(path):
+        labels, rows = [], []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                parts = line.split()
+                head = parts[0]
+                if ":" in head:          # label-less line (label file use)
+                    labels.append(0.0)
+                    ents = parts
+                else:
+                    labels.append(float(head))
+                    ents = parts[1:]
+                row = []
+                for ent in ents:
+                    idx, val = ent.split(":")
+                    row.append((int(idx), float(val)))
+                rows.append(row)
+        return labels, rows
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size, self._n_feat),
+                         _np.float32)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label",
+                         (self.batch_size,) + tuple(self._labels.shape[1:]),
+                         _np.float32)]
+
+    def reset(self):
+        self._cursor = 0
+
+    def next(self) -> DataBatch:
+        from ..ndarray.sparse import csr_matrix
+        n = len(self._rows)
+        if self._cursor >= n:
+            raise StopIteration
+        end = self._cursor + self.batch_size
+        idxs = list(range(self._cursor, min(end, n)))
+        pad = 0
+        if end > n:
+            if not self._round_batch:
+                raise StopIteration
+            pad = end - n
+            idxs += list(range(pad))     # wrap like the reference round_batch
+        self._cursor = end
+        data_vals, data_cols, indptr = [], [], [0]
+        for i in idxs:
+            for j, v in sorted(self._rows[i]):
+                data_cols.append(j)
+                data_vals.append(v)
+            indptr.append(len(data_cols))
+        csr = csr_matrix((_np.asarray(data_vals, _np.float32),
+                          _np.asarray(data_cols, _np.int64),
+                          _np.asarray(indptr, _np.int64)),
+                         shape=(len(idxs), self._n_feat))
+        label = nd.array(self._labels[idxs])
+        return DataBatch([csr], [label], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+
+def _read_idx_ubyte(path):
+    """Parse the MNIST IDX format (magic 0x801/0x803)."""
+    import gzip
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        raw = f.read()
+    magic = int.from_bytes(raw[:4], "big")
+    ndim = magic & 0xFF
+    dims = [int.from_bytes(raw[4 + 4 * i:8 + 4 * i], "big")
+            for i in range(ndim)]
+    data = _np.frombuffer(raw, _np.uint8, offset=4 + 4 * ndim)
+    return data.reshape(dims)
+
+
+class MNISTIter(DataIter):
+    """Batches over the classic MNIST idx-ubyte pair (reference:
+    src/io/iter_mnist.cc MNISTIter — the v1.x `mx.io.MNISTIter` surface).
+
+    ``flat=True`` yields (batch, 784) float rows scaled to [0,1);
+    ``flat=False`` yields (batch, 1, 28, 28).  ``part_index``/``num_parts``
+    shard for distributed training like the reference."""
+
+    def __init__(self, image, label, batch_size=128, shuffle=False,
+                 flat=False, seed=0, silent=True, num_parts=1, part_index=0,
+                 **_kw):
+        super().__init__(batch_size)
+        images = _read_idx_ubyte(image).astype(_np.float32) / 255.0
+        labels = _read_idx_ubyte(label).astype(_np.float32)
+        if images.ndim != 3 or labels.ndim != 1 or                 images.shape[0] != labels.shape[0]:
+            raise ValueError("not an MNIST idx pair: %r %r"
+                             % (images.shape, labels.shape))
+        images = images[part_index::num_parts]
+        labels = labels[part_index::num_parts]
+        self._flat = flat
+        data = images.reshape(len(images), -1) if flat else             images[:, None, :, :]
+        self._inner = NDArrayIter(
+            data, labels, batch_size, shuffle=shuffle,
+            last_batch_handle="pad", label_name="softmax_label")
+        if not silent:
+            print("MNISTIter: loaded %d images %s" % (len(images),
+                                                      data.shape[1:]))
 
     @property
     def provide_data(self):

@@ -333,3 +333,121 @@ def test_dataloader_unpicklable_dataset_raises_helpfully():
     dl2 = DataLoader(ds, batch_size=4, num_workers=2, thread_pool=True)
     out = list(dl2)
     np.testing.assert_allclose(out[0].asnumpy(), [0.0, 2.0, 4.0, 6.0])
+
+
+# -- LibSVMIter (reference: src/io/iter_libsvm.cc; test_io.py pattern) ------
+
+def test_libsvm_iter_csr_batches(tmp_path):
+    path = str(tmp_path / "data.libsvm")
+    with open(path, "w") as f:
+        f.write("1 0:1.5 3:2.0\n")
+        f.write("0 1:3.5\n")
+        f.write("2 0:0.5 2:1.0 4:4.0\n")
+        f.write("1 4:2.5\n")
+    it = mio.LibSVMIter(data_libsvm=path, data_shape=(5,), batch_size=2)
+    batches = list(it)
+    assert len(batches) == 2
+    b0 = batches[0]
+    assert b0.data[0].stype == "csr"
+    dense = b0.data[0].tostype("default").asnumpy()
+    np.testing.assert_allclose(dense, [[1.5, 0, 0, 2.0, 0],
+                                       [0, 3.5, 0, 0, 0]])
+    np.testing.assert_allclose(b0.label[0].asnumpy().ravel(), [1.0, 0.0])
+    b1 = batches[1]
+    dense1 = b1.data[0].tostype("default").asnumpy()
+    np.testing.assert_allclose(dense1, [[0.5, 0, 1.0, 0, 4.0],
+                                        [0, 0, 0, 0, 2.5]])
+    # reset re-iterates identically
+    it.reset()
+    again = next(it).data[0].tostype("default").asnumpy()
+    np.testing.assert_allclose(again, dense)
+
+
+def test_libsvm_iter_round_batch_pad(tmp_path):
+    path = str(tmp_path / "d.libsvm")
+    with open(path, "w") as f:
+        for i in range(3):
+            f.write("%d 0:%d\n" % (i, i + 1))
+    it = mio.LibSVMIter(data_libsvm=path, data_shape=(2,), batch_size=2)
+    b0, b1 = list(it)
+    assert b0.pad == 0 and b1.pad == 1          # wrapped one sample
+    np.testing.assert_allclose(
+        b1.data[0].tostype("default").asnumpy(), [[3, 0], [1, 0]])
+
+
+def test_libsvm_iter_separate_label_file(tmp_path):
+    dpath, lpath = str(tmp_path / "d.libsvm"), str(tmp_path / "l.libsvm")
+    with open(dpath, "w") as f:
+        f.write("0 0:1.0\n0 1:2.0\n")
+    with open(lpath, "w") as f:
+        f.write("0:0.5 1:0.7\n")
+        f.write("1:0.9\n")
+    it = mio.LibSVMIter(data_libsvm=dpath, data_shape=(2,), batch_size=2,
+                        label_libsvm=lpath, label_shape=(2,))
+    b = next(it)
+    np.testing.assert_allclose(b.label[0].asnumpy(), [[0.5, 0.7],
+                                                      [0.0, 0.9]])
+
+
+def test_libsvm_feeds_sparse_dot():
+    """The CSR batch plugs straight into sparse compute (dot(csr, dense))."""
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "x.libsvm")
+        with open(path, "w") as f:
+            f.write("1 0:2.0 2:1.0\n0 1:1.0\n")
+        it = mio.LibSVMIter(data_libsvm=path, data_shape=(3,), batch_size=2)
+        csr = next(it).data[0]
+        w = mx.nd.array(np.arange(6, dtype=np.float32).reshape(3, 2))
+        out = mx.nd.sparse.dot(csr, w)
+        np.testing.assert_allclose(out.asnumpy(),
+                                   csr.tostype("default").asnumpy()
+                                   @ w.asnumpy())
+
+
+# -- MNISTIter (reference: src/io/iter_mnist.cc) ----------------------------
+
+def _write_idx(tmp_path, images, labels):
+    img_path = str(tmp_path / "imgs-idx3-ubyte")
+    lab_path = str(tmp_path / "labs-idx1-ubyte")
+    n, h, w = images.shape
+    with open(img_path, "wb") as f:
+        f.write((0x803).to_bytes(4, "big"))
+        for dim in (n, h, w):
+            f.write(dim.to_bytes(4, "big"))
+        f.write(images.astype(np.uint8).tobytes())
+    with open(lab_path, "wb") as f:
+        f.write((0x801).to_bytes(4, "big"))
+        f.write(n.to_bytes(4, "big"))
+        f.write(labels.astype(np.uint8).tobytes())
+    return img_path, lab_path
+
+
+def test_mnist_iter_shapes_and_values(tmp_path):
+    rng = np.random.RandomState(0)
+    images = rng.randint(0, 256, (10, 28, 28)).astype(np.uint8)
+    labels = (np.arange(10) % 10).astype(np.uint8)
+    img_path, lab_path = _write_idx(tmp_path, images, labels)
+
+    it = mio.MNISTIter(image=img_path, label=lab_path, batch_size=4,
+                       flat=False)
+    b = next(it)
+    assert b.data[0].shape == (4, 1, 28, 28)
+    np.testing.assert_allclose(b.data[0].asnumpy()[0, 0],
+                               images[0] / 255.0, atol=1e-6)
+    np.testing.assert_allclose(b.label[0].asnumpy(), labels[:4])
+
+    flat = mio.MNISTIter(image=img_path, label=lab_path, batch_size=5,
+                         flat=True)
+    fb = next(flat)
+    assert fb.data[0].shape == (5, 784)
+
+
+def test_mnist_iter_sharding(tmp_path):
+    images = np.zeros((8, 28, 28), np.uint8)
+    labels = np.arange(8).astype(np.uint8)
+    img_path, lab_path = _write_idx(tmp_path, images, labels)
+    part = mio.MNISTIter(image=img_path, label=lab_path, batch_size=4,
+                         num_parts=2, part_index=1)
+    b = next(part)
+    np.testing.assert_allclose(b.label[0].asnumpy(), [1, 3, 5, 7])
