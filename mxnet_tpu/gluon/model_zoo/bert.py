@@ -45,7 +45,8 @@ class MultiHeadAttention(HybridBlock):
         qkv = self.query_key_value(x)
         q, k, v = qkv.split(num_outputs=3, axis=-1)
         out = invoke("multi_head_attention", q, k, v, mask,
-                     num_heads=self._num_heads, scaled=True)
+                     num_heads=self._num_heads, scaled=True,
+                     units=self._units)
         return self.dropout(self.proj(out))
 
 

@@ -381,7 +381,8 @@ def _dropout(key, data, p=0.5, mode="training", axes=(), cudnn_off=False,
 
 
 @register("multi_head_attention")
-def _mha(q, k, v, mask=None, num_heads=1, scaled=True, causal=False):
+def _mha(q, k, v, mask=None, num_heads=1, scaled=True, causal=False,
+         units=None):  # units: carried for ONNX export (scale = sqrt(units/heads))
     # q,k,v: (B, T, H*D), mask broadcastable to (B, H, Tq, Tk);
     # hot path = Pallas flash attention on TPU
     from .attention import attention_core

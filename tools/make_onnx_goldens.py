@@ -1,0 +1,27 @@
+"""Regenerate the golden ONNX wire-format fixtures (tests/fixtures/).
+
+The byte-exact fixtures pin the exporter's output format offline —
+conformance testing without onnxruntime (see
+tests/test_onnx.py::test_golden_fixture_bytes).  Run after INTENTIONAL
+exporter changes and commit the updated .onnx files."""
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("MX_FORCE_CPU", "1")
+
+
+def main():
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    import test_onnx
+    out_dir = os.path.join(REPO, "tests", "fixtures")
+    os.makedirs(out_dir, exist_ok=True)
+    test_onnx._golden_lstm(os.path.join(out_dir, "golden_lstm.onnx"))
+    test_onnx._golden_encoder(os.path.join(out_dir, "golden_encoder.onnx"))
+    print("wrote", sorted(os.listdir(out_dir)))
+
+
+if __name__ == "__main__":
+    main()
