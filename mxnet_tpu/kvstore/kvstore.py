@@ -14,9 +14,14 @@ XLA collectives.
     `jax.sharding.Mesh` data-parallel axis; rides ICI within a slice and
     DCN across slices (XLA inserts the hierarchy).  Multi-host ranks come
     from `jax.distributed` (mxnet_tpu.parallel.init_process_group).
-  * ``dist_sync``/``dist_async``/``nccl`` — accepted as aliases that map
-    onto the collective path (the PS apparatus is deliberately not ported;
-    SURVEY.md §2.1 KVStore: dist row).
+  * ``dist_sync``/``nccl``/``horovod`` — aliases onto the collective
+    path (sync DP on dedicated TPU pods is strictly better via
+    collectives; SURVEY.md §2.1 KVStore: dist row).
+  * ``dist_async`` — the one PS capability with NO collective
+    equivalent: a real parameter server (kvstore/server.py over TCP)
+    applies every worker's push immediately, no barriers — reference
+    kvstore_dist_server.h DataHandleEx async semantics.  Launch with
+    ``tools/launch.py -n W -s 1``.
 """
 from __future__ import annotations
 
@@ -32,7 +37,8 @@ from ..device import Context, cpu
 from ..ndarray.ndarray import NDArray
 from .. import ndarray as nd
 
-__all__ = ["KVStore", "create", "KVStoreLocal", "KVStoreDevice", "KVStoreICI"]
+__all__ = ["KVStore", "create", "KVStoreLocal", "KVStoreDevice",
+           "KVStoreICI", "KVStoreDistAsync"]
 
 
 def _key(k):
@@ -367,6 +373,132 @@ class KVStoreICI(KVStoreLocal):
             multihost_utils.sync_global_devices("mx_kvstore_barrier")
 
 
+def _ps_addr():
+    """Parameter-server address from the launcher env, or None."""
+    import os
+    addr = os.environ.get("MX_PS_ROOT") or \
+        os.environ.get("DMLC_PS_ROOT_URI")
+    if not addr:
+        return None
+    if ":" not in addr:
+        addr = "%s:%s" % (addr, os.environ.get("DMLC_PS_ROOT_PORT", "9600"))
+    return addr
+
+
+class KVStoreDistAsync(KVStore):
+    """Async parameter-server store (reference: KVStoreDist with
+    dist_async — src/kvstore/kvstore_dist_server.h DataHandleEx async
+    path): each worker's push is applied by the server THE MOMENT it
+    arrives (server-side optimizer), pulls return whatever is current,
+    and workers never wait for each other.  Server address from
+    MX_PS_ROOT (set by tools/launch.py -s 1)."""
+
+    def __init__(self):
+        super().__init__()
+        import os
+        from . import server as _srv
+        self._srv_mod = _srv
+        addr = _ps_addr()
+        if not addr:
+            raise MXNetError(
+                "kvstore 'dist_async' needs a parameter server: launch "
+                "with tools/launch.py -n <workers> -s 1 (MX_PS_ROOT unset)")
+        host, port = addr.rsplit(":", 1)
+        import socket
+        import time as _time
+        deadline = _time.time() + 60
+        while True:     # the launcher starts the server concurrently:
+            try:        # retry until it binds (ps-lite scheduler role)
+                self._sock = socket.create_connection((host, int(port)),
+                                                      timeout=120)
+                break
+            except (ConnectionRefusedError, OSError):
+                if _time.time() > deadline:
+                    raise
+                _time.sleep(0.2)
+        self._lock = __import__("threading").Lock()
+        self._rank = int(os.environ.get("MX_PROCESS_ID",
+                                        os.environ.get("DMLC_WORKER_ID", 0)))
+        self._size = int(os.environ.get("MX_NUM_PROCESSES",
+                                        os.environ.get("DMLC_NUM_WORKER",
+                                                       1)))
+
+    @property
+    def type(self):
+        return "dist_async"
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._size
+
+    def _rpc(self, *msg):
+        import socket as _socket
+        with self._lock:
+            if self._sock is None:
+                raise MXNetError("dist_async connection is closed (a prior "
+                                 "RPC timed out; the stream cannot resync)")
+            try:
+                self._srv_mod.send_msg(self._sock, msg)
+                ok, payload = self._srv_mod.recv_msg(self._sock)
+            except (_socket.timeout, TimeoutError):
+                # a late reply would desync every later request/response
+                # pair: poison the connection instead of misreading it
+                self._sock.close()
+                self._sock = None
+                raise MXNetError("dist_async server did not answer %r "
+                                 "within the socket timeout" % (msg[0],))
+        if not ok:
+            raise MXNetError("dist_async server: %s" % payload)
+        return payload
+
+    def init(self, key, value):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            vv = v[0] if isinstance(v, (list, tuple)) else v
+            self._rpc("INIT", k, vv.asnumpy())
+            self._store[k] = vv.copy()       # local mirror for shape/dtype
+
+    def push(self, key, value, priority=0):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            merged = self._reduce(v if isinstance(v, (list, tuple)) else [v],
+                                  key=k)
+            self._rpc("PUSH", k, merged.asnumpy())
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = self._normalize(key, out)
+        for k, o in zip(keys, outs):
+            arr = self._rpc("PULL", k)
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            for t in targets:
+                t._set_jax(nd.array(arr).astype(t.dtype)._jax)
+
+    def set_optimizer(self, optimizer):
+        """Ship the optimizer to the server (reference: the pickled
+        set_optimizer controller message).  The server keeps the FIRST
+        installation (state preservation); the trailing barrier guarantees
+        no worker pushes before the optimizer is installed."""
+        self._rpc("SET_OPT", pickle.dumps(optimizer))
+        self._optimizer = optimizer
+        # updates happen server-side: no local updater
+        self._updater = None
+        if self._size > 1:
+            self._barrier()
+
+    def _barrier(self):
+        self._rpc("BARRIER", None)
+
+    def stop_server(self):
+        try:
+            self._rpc("STOP", None)
+        except MXNetError:
+            pass
+
+
 _STORES = {
     "local": KVStoreLocal,
     "device": KVStoreDevice,
@@ -376,16 +508,26 @@ _STORES = {
     "dist": KVStoreICI,
     "dist_sync": KVStoreICI,
     "dist_device_sync": KVStoreICI,
-    "dist_async": KVStoreICI,
+    "dist_async": KVStoreDistAsync,
     "horovod": KVStoreICI,
 }
 
 
 def create(name: str = "local") -> KVStore:
     """Reference: kvstore.create / KVStore::Create."""
+    import os
     if not isinstance(name, str):
         raise TypeError("name must be a string")
     key = name.lower()
+    if key == "dist_async" and _ps_addr() is None:
+        # no PS in the deployment: degrade to the sync collective store
+        # with a loud note, like the reference refuses to start without
+        # a tracker (here multi-process jobs still work, just synchronously)
+        import warnings
+        warnings.warn("kvstore 'dist_async' requested without a parameter "
+                      "server (launch with tools/launch.py -s 1); using "
+                      "the synchronous collective store instead")
+        return KVStoreICI()
     if key not in _STORES:
         raise MXNetError("unknown KVStore type %r (have %s)"
                          % (name, sorted(_STORES)))

@@ -1,0 +1,198 @@
+"""dist_async parameter server.
+
+Reference: ``src/kvstore/kvstore_dist_server.h`` (`KVStoreDistServer`,
+`DataHandleEx` **async** path — the server applies each worker's push the
+moment it arrives, no per-key barrier) and
+``python/mxnet/kvstore/kvstore_server.py`` (the python run loop a
+DMLC_ROLE=server process enters).
+
+The reference transports over ps-lite/ZeroMQ; this rebuild's sync path
+rightly replaced PS with collectives (`kvstore='ici'`), but the ASYNC
+semantics — stale-tolerant updates, workers progressing independently —
+have no collective equivalent, so the PS role comes back for exactly this
+store.  Transport is a length-prefixed pickle protocol over TCP (stdlib
+socketserver; the ZMQ dependency is an implementation detail of the
+reference, not part of its contract).
+
+Wire protocol: request = (cmd, key, payload...); response = (ok, payload).
+Commands: INIT (store if absent), PUSH (updater(key, grad, store) when an
+optimizer is installed, else accumulate-sum), PULL, SET_OPT (pickled
+optimizer, the reference's set_optimizer controller message), BARRIER
+(explicit only — pushes NEVER barrier), STOP.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from typing import Dict
+
+import numpy as _np
+
+__all__ = ["KVStoreServer", "serve_forever", "send_msg", "recv_msg"]
+
+
+def send_msg(sock: socket.socket, obj) -> None:
+    payload = pickle.dumps(obj, protocol=4)
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def recv_msg(sock: socket.socket):
+    head = b""
+    while len(head) < 8:
+        chunk = sock.recv(8 - len(head))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        head += chunk
+    (n,) = struct.unpack("<Q", head)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("peer closed mid-message")
+        buf += chunk
+    return pickle.loads(bytes(buf))
+
+
+class KVStoreServer:
+    """The server-side store + optimizer (reference: KVStoreDistServer)."""
+
+    def __init__(self, num_workers: int = 1):
+        self._store: Dict = {}
+        self._locks: Dict = {}
+        self._global_lock = threading.Lock()
+        self._updater = None
+        self._num_workers = num_workers
+        self._barrier_count = 0
+        self._barrier_gen = 0
+        self._barrier_cv = threading.Condition()
+
+    def _lock_of(self, key):
+        with self._global_lock:
+            return self._locks.setdefault(key, threading.Lock())
+
+    # -- command handlers ---------------------------------------------------
+    def handle(self, msg):
+        cmd = msg[0]
+        if cmd == "INIT":
+            _, key, value = msg
+            with self._lock_of(key):
+                if key not in self._store:
+                    self._store[key] = _np.array(value, copy=True)
+            return True, None
+        if cmd == "PUSH":
+            _, key, grad = msg
+            with self._lock_of(key):
+                stored = self._store.get(key)
+                if stored is None:
+                    return False, "key %r not initialized" % (key,)
+                if self._updater is not None:
+                    # async contract: apply THIS worker's gradient now
+                    self._updater(key, grad, stored)
+                else:
+                    # no optimizer: the server is an ACCUMULATOR — pull
+                    # returns init + sum of every push (the dist num_
+                    # workers-sum contract); differs from local stores,
+                    # where push replaces (documented divergence)
+                    stored += grad
+            return True, None
+        if cmd == "PULL":
+            _, key = msg
+            with self._lock_of(key):
+                stored = self._store.get(key)
+                if stored is None:
+                    return False, "key %r not initialized" % (key,)
+                return True, _np.array(stored, copy=True)
+        if cmd == "SET_OPT":
+            _, blob = msg
+            if self._updater is not None:
+                # every worker ships the optimizer (startup skew): keep the
+                # FIRST installation so accumulated momentum/Adam state is
+                # never wiped mid-training (reference gates the controller
+                # message on rank 0 for the same reason)
+                return True, "already installed"
+            from ..optimizer import get_updater
+            optimizer = pickle.loads(blob)
+            self._updater = _NumpyUpdater(get_updater(optimizer))
+            return True, None
+        if cmd == "BARRIER":
+            # generation barrier (explicit _barrier() calls only; PUSH
+            # never blocks — that's the async contract)
+            with self._barrier_cv:
+                gen = self._barrier_gen
+                self._barrier_count += 1
+                if self._barrier_count == self._num_workers:
+                    self._barrier_count = 0
+                    self._barrier_gen += 1
+                    self._barrier_cv.notify_all()
+                else:
+                    ok = self._barrier_cv.wait_for(
+                        lambda: self._barrier_gen > gen, timeout=120)
+                    if not ok:
+                        self._barrier_count = max(0,
+                                                  self._barrier_count - 1)
+                        return False, ("barrier timed out waiting for %d "
+                                       "workers" % self._num_workers)
+            return True, None
+        if cmd == "STOP":
+            return True, "stopping"
+        return False, "unknown command %r" % (cmd,)
+
+
+class _NumpyUpdater:
+    """Bridge the mx Updater (NDArray in/out) to the numpy server store —
+    the server process stays off any accelerator."""
+
+    def __init__(self, updater):
+        self._updater = updater
+
+    def __call__(self, key, grad_np, stored_np):
+        from ..ndarray.ndarray import array as _arr
+        g = _arr(_np.asarray(grad_np))
+        w = _arr(stored_np)
+        self._updater(key, g, w)
+        stored_np[...] = w.asnumpy()
+
+
+def serve_forever(port=None, num_workers=None, ready_file=None):
+    """Run the server loop (reference: KVStoreServer.run; entered by
+    DMLC_ROLE=server processes under tools/launch.py)."""
+    port = int(port if port is not None else
+               os.environ.get("MX_PS_PORT", 9600))
+    num_workers = int(num_workers if num_workers is not None else
+                      os.environ.get("DMLC_NUM_WORKER", 1))
+    server_state = KVStoreServer(num_workers)
+    stop_event = threading.Event()
+
+    class Handler(socketserver.BaseRequestHandler):
+        def handle(self):
+            while True:
+                try:
+                    msg = recv_msg(self.request)
+                except (ConnectionError, OSError):
+                    return
+                ok, payload = server_state.handle(msg)
+                send_msg(self.request, (ok, payload))
+                if msg[0] == "STOP":
+                    stop_event.set()
+                    return
+
+    class Server(socketserver.ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+    with Server(("0.0.0.0", port), Handler) as srv:
+        if ready_file:
+            with open(ready_file, "w") as f:
+                f.write("%d" % srv.server_address[1])
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        stop_event.wait()
+        srv.shutdown()
+
+
+if __name__ == "__main__":
+    serve_forever()

@@ -317,3 +317,62 @@ def test_2bit_compression_two_process_sum_with_residual(tmp_path):
     """))
     out = _launch(script)
     assert out.count("COMPRESS2BIT_OK") == 2
+
+
+def test_dist_async_parameter_server(tmp_path):
+    """dist_async contract (reference: kvstore_dist_server.h DataHandleEx
+    async path + tests/nightly/dist_async_kvstore.py): a real PS process
+    applies each worker's push IMMEDIATELY (server-side optimizer), pulls
+    return current state, and a worker progresses without the other."""
+    import textwrap as tw
+    script = tmp_path / "w.py"
+    script.write_text(tw.dedent(_PRELUDE) + tw.dedent("""
+        from mxnet_tpu import kvstore, optimizer
+        kv = kvstore.create("dist_async")
+        assert kv.type == "dist_async"
+        rank = kv.rank
+        assert kv.num_workers == 2
+
+        kv.init("w", nd.ones((4,)))
+        kv.set_optimizer(optimizer.SGD(learning_rate=0.5))
+
+        # ASYNC: this worker pushes and pulls alone — no barrier, the
+        # other worker's participation is not required for progress
+        g = np.full(4, 1.0, np.float32)
+        kv.push("w", nd.array(g))
+        out = nd.zeros((4,))
+        kv.pull("w", out=out)
+        v = out.asnumpy()
+        # server applied AT LEAST this worker's update; each update is
+        # -0.5*g, so value is 1 - 0.5*k for k pushes seen so far
+        k = round(float((1.0 - v[0]) / 0.5))
+        assert k >= 1 and np.allclose(v, 1.0 - 0.5 * k), v
+
+        # after both workers barrier, exactly 2 pushes are in
+        kv._barrier()
+        kv.pull("w", out=out)
+        np.testing.assert_allclose(out.asnumpy(), 1.0 - 0.5 * 2)
+        print("DIST_ASYNC_OK rank", rank, flush=True)
+    """))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([sys.executable,
+                        os.path.join(REPO, "tools", "launch.py"),
+                        "-n", "2", "-s", "1", "--launcher", "local", "--",
+                        sys.executable, str(script)],
+                       capture_output=True, text=True, timeout=300,
+                       env=env)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert r.stdout.count("DIST_ASYNC_OK") == 2
+
+
+def test_dist_async_without_server_degrades_loudly(tmp_path):
+    import warnings
+    from mxnet_tpu import kvstore
+    for var in ("MX_PS_ROOT", "DMLC_PS_ROOT_URI"):
+        os.environ.pop(var, None)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        kv = kvstore.create("dist_async")
+    assert any("parameter server" in str(x.message) for x in w)
+    assert kv.type == "ici"

@@ -55,17 +55,56 @@ def _env_for(rank: int, coordinator: str, n: int):
 
 def launch_local(args, command):
     coordinator = "127.0.0.1:%d" % _free_port()
+    server_procs = []
+    ps_root = None
+    if getattr(args, "num_servers", 0) > 1:
+        print("launch.py: only ONE parameter server is implemented; "
+              "-s %d capped to 1 (keys are not sharded across servers)"
+              % args.num_servers, file=sys.stderr)
+        args.num_servers = 1
+    if getattr(args, "num_servers", 0) > 0:
+        # dist_async parameter server(s) (reference: tracker starting
+        # DMLC_ROLE=server processes); one port per server, workers get
+        # MX_PS_ROOT pointing at server 0
+        ps_port = _free_port()
+        ps_root = "127.0.0.1:%d" % ps_port
+        for s in range(args.num_servers):
+            env = dict(os.environ)
+            repo = os.path.dirname(os.path.dirname(os.path.abspath(
+                __file__)))
+            env.update({"DMLC_ROLE": "server",
+                        "DMLC_NUM_WORKER": str(args.num_workers),
+                        "MX_PS_PORT": str(ps_port if s == 0
+                                          else _free_port()),
+                        "MX_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
+                        "PYTHONPATH": repo + os.pathsep +
+                        env.get("PYTHONPATH", "")})
+            server_procs.append(subprocess.Popen(
+                [sys.executable, "-m", "mxnet_tpu.kvstore.server"],
+                env=env))
     procs = []
     for rank in range(args.num_workers):
         env = _env_for(rank, coordinator, args.num_workers)
+        if ps_root:
+            env["MX_PS_ROOT"] = ps_root
+            env["DMLC_PS_ROOT_URI"] = ps_root.split(":")[0]
+            env["DMLC_PS_ROOT_PORT"] = ps_root.split(":")[1]
         procs.append(subprocess.Popen(command, env=env))
     rc = 0
     for p in procs:
         rc = p.wait() or rc
+    for p in server_procs:       # workers done: stop the PS
+        p.terminate()
+        p.wait()
     return rc
 
 
 def launch_ssh(args, command):
+    if getattr(args, "num_servers", 0) > 0:
+        raise SystemExit(
+            "launch.py: -s/--num-servers is only implemented for the "
+            "local launcher; start `python -m mxnet_tpu.kvstore.server` "
+            "on a host manually and export MX_PS_ROOT=host:port")
     hosts = []
     with open(args.hostfile) as f:
         for line in f:
@@ -108,6 +147,7 @@ def launch_manual(args, command):
 def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("-n", "--num-workers", type=int, required=True)
+    p.add_argument("-s", "--num-servers", type=int, default=0)
     p.add_argument("--launcher", default="local",
                    choices=["local", "ssh", "manual"])
     p.add_argument("-H", "--hostfile", default=None)
