@@ -499,18 +499,34 @@ class NDArray:
     def __neg__(self): return invoke("negative", self)
     def __abs__(self): return invoke("abs", self)
 
+    # comparisons: legacy mx.nd returns float32 0/1; under npx.set_np()
+    # they switch to the _npi numpy-semantics ops (bool outputs, so
+    # x[x > 0] boolean masking works) — the reference's set_np contract
+    @staticmethod
+    def _cmp_op(legacy, npi):
+        from .. import npx as _npx
+        return npi if _npx.is_np_array() else legacy
+
     def __eq__(self, o):
         if o is None:
             return False
-        return self._binop("broadcast_equal", o)
+        return self._binop(self._cmp_op("broadcast_equal", "_npi_equal"), o)
     def __ne__(self, o):
         if o is None:
             return True
-        return self._binop("broadcast_not_equal", o)
-    def __gt__(self, o): return self._binop("broadcast_greater", o)
-    def __ge__(self, o): return self._binop("broadcast_greater_equal", o)
-    def __lt__(self, o): return self._binop("broadcast_lesser", o)
-    def __le__(self, o): return self._binop("broadcast_lesser_equal", o)
+        return self._binop(self._cmp_op("broadcast_not_equal",
+                                        "_npi_not_equal"), o)
+    def __gt__(self, o):
+        return self._binop(self._cmp_op("broadcast_greater",
+                                        "_npi_greater"), o)
+    def __ge__(self, o):
+        return self._binop(self._cmp_op("broadcast_greater_equal",
+                                        "_npi_greater_equal"), o)
+    def __lt__(self, o):
+        return self._binop(self._cmp_op("broadcast_lesser", "_npi_less"), o)
+    def __le__(self, o):
+        return self._binop(self._cmp_op("broadcast_lesser_equal",
+                                        "_npi_less_equal"), o)
 
     def __hash__(self):
         return id(self)
