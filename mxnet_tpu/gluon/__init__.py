@@ -11,6 +11,7 @@ from .trainer import Trainer
 from . import nn
 from . import loss
 from . import utils
+from . import contrib
 from . import data
 from . import rnn
 from . import model_zoo

@@ -1,0 +1,7 @@
+"""gluon.contrib — experimental blocks (reference:
+python/mxnet/gluon/contrib/: nn/basic_layers.py, rnn/conv_rnn_cell.py,
+rnn/rnn_cell.py)."""
+from . import nn      # noqa: F401
+from . import rnn     # noqa: F401
+
+__all__ = ["nn", "rnn"]

@@ -1,0 +1,101 @@
+"""gluon.contrib.nn (reference: python/mxnet/gluon/contrib/nn/
+basic_layers.py — Concurrent, HybridConcurrent, Identity,
+SparseEmbedding, SyncBatchNorm, PixelShuffle1D/2D/3D)."""
+from __future__ import annotations
+
+from ...ndarray.ndarray import invoke
+from .. import nn as _nn
+from ..block import Block, HybridBlock
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "SyncBatchNorm", "PixelShuffle1D", "PixelShuffle2D",
+           "PixelShuffle3D"]
+
+
+class Concurrent(_nn.Concatenate):
+    """Run children on the SAME input, concat outputs (reference:
+    contrib.nn.Concurrent — renamed nn.Concatenate in 2.x; same block)."""
+
+    def __init__(self, axis=-1, **kwargs):
+        super().__init__(axis=axis, **kwargs)
+        self.axis = axis
+
+
+class HybridConcurrent(_nn.HybridConcatenate):
+    """Hybridizable Concurrent (2.x: nn.HybridConcatenate)."""
+
+    def __init__(self, axis=-1, **kwargs):
+        super().__init__(axis=axis, **kwargs)
+        self.axis = axis
+
+
+Identity = _nn.Identity
+
+
+class SparseEmbedding(Block):
+    """Embedding with row-sparse gradients (reference:
+    contrib.nn.SparseEmbedding; here sparse_grad=True Embedding — the
+    rowsparse path is the op's gather VJP)."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32", **kwargs):
+        super().__init__(**kwargs)
+        self._kwargs = {"input_dim": input_dim, "output_dim": output_dim,
+                        "sparse_grad": True}
+        from ..parameter import Parameter
+        self.weight = Parameter("weight", shape=(input_dim, output_dim),
+                                dtype=dtype, stype="row_sparse",
+                                grad_stype="row_sparse")
+
+    def forward(self, x):
+        return invoke("Embedding", x, self.weight.data(x.context),
+                      **self._kwargs)
+
+
+# contrib and nn share ONE SyncBatchNorm (2.x moved it to nn); the v1.x
+# contrib signature (num_devices) is the nn one
+SyncBatchNorm = _nn.SyncBatchNorm
+
+
+def _pixel_shuffle(ndim):
+    class _PixelShuffle(HybridBlock):
+        def __init__(self, factor, **kwargs):
+            super().__init__(**kwargs)
+            self._factor = (factor,) * ndim if isinstance(factor, int) \
+                else tuple(factor)
+
+        def hybrid_forward(self, F, x):
+            import jax.numpy as jnp
+            from ...ndarray.ndarray import NDArray, from_jax
+            f = self._factor
+            a = x._jax if isinstance(x, NDArray) else jnp.asarray(x)
+            N, C = a.shape[0], a.shape[1]
+            spatial = a.shape[2:]
+            import numpy as _onp
+            newC = C // int(_onp.prod(f))
+            # (N, C', f1..fn, d1..dn) -> interleave f_i after d_i
+            a = a.reshape((N, newC) + tuple(f) + tuple(spatial))
+            perm = [0, 1]
+            for i in range(ndim):
+                perm += [2 + ndim + i, 2 + i]
+            a = a.transpose(perm)
+            out_sp = tuple(d * fi for d, fi in zip(spatial, f))
+            return from_jax(a.reshape((N, newC) + out_sp), ctx=x.context
+                            if isinstance(x, NDArray) else None)
+
+        def __repr__(self):
+            return "%s(factor=%s)" % (type(self).__name__, (self._factor,))
+    return _PixelShuffle
+
+
+PixelShuffle1D = _pixel_shuffle(1)
+PixelShuffle1D.__name__ = "PixelShuffle1D"
+PixelShuffle1D.__doc__ = """Upsample 1-D by channel-to-width shuffle
+(reference: contrib.nn.PixelShuffle1D)."""
+PixelShuffle2D = _pixel_shuffle(2)
+PixelShuffle2D.__name__ = "PixelShuffle2D"
+PixelShuffle2D.__doc__ = """Sub-pixel convolution upsampling: (N, C*f1*f2,
+H, W) -> (N, C, H*f1, W*f2) (reference: contrib.nn.PixelShuffle2D)."""
+PixelShuffle3D = _pixel_shuffle(3)
+PixelShuffle3D.__name__ = "PixelShuffle3D"
+PixelShuffle3D.__doc__ = """3-D sub-pixel shuffle (reference:
+contrib.nn.PixelShuffle3D)."""

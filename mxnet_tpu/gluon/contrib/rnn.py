@@ -1,0 +1,189 @@
+"""gluon.contrib.rnn (reference: python/mxnet/gluon/contrib/rnn/
+conv_rnn_cell.py — Conv1D/2D/3DLSTMCell family — and rnn_cell.py —
+VariationalDropoutCell, LSTMPCell)."""
+from __future__ import annotations
+
+from ... import initializer as init_mod
+from ...ndarray.ndarray import invoke
+from ..parameter import Parameter
+from ..rnn.rnn_cell import RecurrentCell
+
+__all__ = ["Conv1DLSTMCell", "Conv2DLSTMCell", "Conv3DLSTMCell",
+           "VariationalDropoutCell", "LSTMPCell"]
+
+
+class _ConvLSTMCell(RecurrentCell):
+    """ConvLSTM (Shi et al. 2015): the LSTM matmuls become convolutions,
+    states carry spatial maps (reference: contrib.rnn._ConvRNNCell/
+    _ConvLSTMCell).  input: (N, C, *spatial); hidden: (N, H, *spatial)."""
+
+    _ndim = 2
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel=3,
+                 h2h_kernel=3, activation="tanh", **kwargs):
+        super().__init__(**kwargs)
+        nd_ = self._ndim
+        self._input_shape = tuple(input_shape)   # (C, *spatial)
+        self._hc = hidden_channels
+        k = (i2h_kernel,) * nd_ if isinstance(i2h_kernel, int) \
+            else tuple(i2h_kernel)
+        hk = (h2h_kernel,) * nd_ if isinstance(h2h_kernel, int) \
+            else tuple(h2h_kernel)
+        # pad is derived as k//2 for BOTH convs, so both kernels must be
+        # odd or the i2h/h2h spatial dims diverge
+        assert all(x % 2 == 1 for x in hk), \
+            "h2h_kernel must be odd to conserve spatial dims"
+        assert all(x % 2 == 1 for x in k), \
+            "i2h_kernel must be odd to conserve spatial dims"
+        self._i2h_kernel, self._h2h_kernel = k, hk
+        self._i2h_pad = tuple(x // 2 for x in k)
+        self._h2h_pad = tuple(x // 2 for x in hk)
+        self._activation = activation
+        C = self._input_shape[0]
+        self.i2h_weight = Parameter("i2h_weight",
+                                    shape=(4 * hidden_channels, C) + k)
+        self.h2h_weight = Parameter(
+            "h2h_weight", shape=(4 * hidden_channels, hidden_channels) + hk)
+        self.i2h_bias = Parameter("i2h_bias", shape=(4 * hidden_channels,),
+                                  init=init_mod.Zero())
+        self.h2h_bias = Parameter("h2h_bias", shape=(4 * hidden_channels,),
+                                  init=init_mod.Zero())
+
+    def state_info(self, batch_size=0):
+        spatial = self._input_shape[1:]
+        shape = (batch_size, self._hc) + spatial
+        return [{"shape": shape}, {"shape": shape}]
+
+    def forward(self, inputs, states):
+        ctx = inputs.context
+        i2h = invoke("Convolution", inputs, self.i2h_weight.data(ctx),
+                     self.i2h_bias.data(ctx), kernel=self._i2h_kernel,
+                     pad=self._i2h_pad, num_filter=4 * self._hc)
+        h2h = invoke("Convolution", states[0], self.h2h_weight.data(ctx),
+                     self.h2h_bias.data(ctx), kernel=self._h2h_kernel,
+                     pad=self._h2h_pad, num_filter=4 * self._hc)
+        gates = i2h + h2h
+        sl = gates.split(num_outputs=4, axis=1)
+        i = sl[0].sigmoid()
+        f = sl[1].sigmoid()
+        g = invoke("Activation", sl[2], act_type=self._activation)
+        o = sl[3].sigmoid()
+        next_c = f * states[1] + i * g
+        next_h = o * invoke("Activation", next_c,
+                            act_type=self._activation)
+        return next_h, [next_h, next_c]
+
+
+class Conv1DLSTMCell(_ConvLSTMCell):
+    _ndim = 1
+
+
+class Conv2DLSTMCell(_ConvLSTMCell):
+    _ndim = 2
+
+
+class Conv3DLSTMCell(_ConvLSTMCell):
+    _ndim = 3
+
+
+class VariationalDropoutCell(RecurrentCell):
+    """Variational (same-mask-every-step) dropout around a base cell
+    (reference: contrib.rnn.VariationalDropoutCell; Gal & Ghahramani).
+    Masks are drawn ONCE per unroll (reset clears them)."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+        self._di, self._ds, self._do = drop_inputs, drop_states, drop_outputs
+        self._mask_i = self._mask_s = self._mask_o = None
+        base_cell._modified = True
+
+    def reset(self):
+        super().reset()
+        self._mask_i = self._mask_s = self._mask_o = None
+        base = getattr(self, "base_cell", None)   # called from __init__ too
+        if base is not None:
+            base._modified = False
+            base.reset()
+            base._modified = True
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        self.base_cell._modified = False
+        out = self.base_cell.begin_state(batch_size, func, **kwargs)
+        self.base_cell._modified = True
+        return out
+
+    def _mask(self, cached, like, p):
+        from ... import autograd
+        if p == 0.0 or not autograd.is_training():
+            return None
+        if cached is None or cached.shape != like.shape:
+            keep = invoke("_random_bernoulli", prob=1.0 - p,
+                          shape=like.shape, dtype=str(like.dtype))
+            cached = keep / (1.0 - p)
+        return cached
+
+    def forward(self, inputs, states):
+        self._mask_i = self._mask(self._mask_i, inputs, self._di)
+        if self._mask_i is not None:
+            inputs = inputs * self._mask_i
+        if self._ds:
+            self._mask_s = self._mask(self._mask_s, states[0], self._ds)
+            if self._mask_s is not None:
+                states = [states[0] * self._mask_s] + list(states[1:])
+        self.base_cell._modified = False
+        out, next_states = self.base_cell(inputs, states)
+        self.base_cell._modified = True
+        self._mask_o = self._mask(self._mask_o, out, self._do)
+        if self._mask_o is not None:
+            out = out * self._mask_o
+        return out, next_states
+
+
+class LSTMPCell(RecurrentCell):
+    """LSTM with a hidden-state projection (reference: contrib.rnn.
+    LSTMPCell; Sak et al. 2014 — h = W_r · o⊙tanh(c), shrinking the
+    recurrent width)."""
+
+    def __init__(self, hidden_size, projection_size, input_size=0, **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        nh, npj = hidden_size, projection_size
+        self.i2h_weight = Parameter("i2h_weight", shape=(4 * nh, input_size),
+                                    allow_deferred_init=True)
+        self.h2h_weight = Parameter("h2h_weight", shape=(4 * nh, npj))
+        self.h2r_weight = Parameter("h2r_weight", shape=(npj, nh))
+        self.i2h_bias = Parameter("i2h_bias", shape=(4 * nh,),
+                                  init=init_mod.Zero())
+        self.h2h_bias = Parameter("h2h_bias", shape=(4 * nh,),
+                                  init=init_mod.Zero())
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._projection_size)},
+                {"shape": (batch_size, self._hidden_size)}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (4 * self._hidden_size, x.shape[-1])
+
+    def forward(self, inputs, states):
+        ctx = inputs.context
+        nh = self._hidden_size
+        i2h = invoke("FullyConnected", inputs, self.i2h_weight.data(ctx),
+                     self.i2h_bias.data(ctx), num_hidden=4 * nh)
+        h2h = invoke("FullyConnected", states[0], self.h2h_weight.data(ctx),
+                     self.h2h_bias.data(ctx), num_hidden=4 * nh)
+        gates = i2h + h2h
+        sl = gates.split(num_outputs=4, axis=1)
+        i, f = sl[0].sigmoid(), sl[1].sigmoid()
+        g, o = sl[2].tanh(), sl[3].sigmoid()
+        next_c = f * states[1] + i * g
+        hidden = o * next_c.tanh()
+        next_r = invoke("FullyConnected", hidden,
+                        self.h2r_weight.data(ctx), None, no_bias=True,
+                        num_hidden=self._projection_size)
+        return next_r, [next_r, next_c]

@@ -1,0 +1,123 @@
+"""gluon.contrib blocks (reference: python/mxnet/gluon/contrib/ —
+tests/python/unittest/test_gluon_contrib.py pattern)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.contrib import nn as cnn
+from mxnet_tpu.gluon.contrib import rnn as crnn
+
+
+def test_concurrent_and_identity():
+    assert cnn.Identity is nn.Identity             # aliased, not duplicated
+    net = cnn.HybridConcurrent(axis=1)
+    net.add(nn.Dense(3), cnn.Identity(), nn.Dense(2))
+    net.initialize()
+    x = nd.array(np.random.RandomState(0).randn(4, 5).astype("float32"))
+    out = net(x)
+    assert out.shape == (4, 3 + 5 + 2)
+    # identity branch passes x through untouched
+    np.testing.assert_allclose(out.asnumpy()[:, 3:8], x.asnumpy(),
+                               rtol=1e-6)
+
+
+def test_pixelshuffle2d_matches_numpy():
+    ps = cnn.PixelShuffle2D(2)
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 8, 3, 4).astype(np.float32)
+    out = ps(nd.array(x)).asnumpy()
+    assert out.shape == (1, 2, 6, 8)
+    # numpy reference: torch.pixel_shuffle layout
+    want = x.reshape(1, 2, 2, 2, 3, 4).transpose(0, 1, 4, 2, 5, 3) \
+        .reshape(1, 2, 6, 8)
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+
+
+def test_pixelshuffle1d_and_3d_shapes():
+    x1 = nd.array(np.arange(12, dtype=np.float32).reshape(1, 6, 2))
+    assert cnn.PixelShuffle1D(3)(x1).shape == (1, 2, 6)
+    x3 = nd.array(np.zeros((1, 8, 2, 2, 2), np.float32))
+    assert cnn.PixelShuffle3D(2)(x3).shape == (1, 1, 4, 4, 4)
+
+
+def test_conv2d_lstm_cell_unroll():
+    cell = crnn.Conv2DLSTMCell(input_shape=(3, 8, 8), hidden_channels=4,
+                               i2h_kernel=3, h2h_kernel=3)
+    cell.initialize(mx.init.Xavier())
+    rng = np.random.RandomState(2)
+    seq = nd.array(rng.randn(2, 5, 3, 8, 8).astype(np.float32))  # NTC...
+    outs, states = cell.unroll(5, seq, layout="NTC")
+    assert outs.shape == (2, 5, 4, 8, 8)
+    assert states[0].shape == (2, 4, 8, 8)
+    assert states[1].shape == (2, 4, 8, 8)
+    assert np.isfinite(outs.asnumpy()).all()
+    # gradient flows end to end
+    cell.reset()
+    with autograd.record():
+        o, _ = cell.unroll(5, seq, layout="NTC")
+        loss = (o * o).mean()
+    loss.backward()
+    g = cell.i2h_weight.grad()
+    assert np.abs(g.asnumpy()).sum() > 0
+
+
+def test_variational_dropout_same_mask_every_step():
+    base = mx.gluon.rnn.LSTMCell(6, input_size=4)
+    cell = crnn.VariationalDropoutCell(base, drop_inputs=0.5)
+    cell.initialize()
+    rng = np.random.RandomState(3)
+    seq = nd.array(rng.randn(2, 7, 4).astype(np.float32))
+    mx.random.seed(0)
+    with autograd.record(train_mode=True):
+        cell.unroll(7, seq, layout="NTC")
+        m_first = cell._mask_i.asnumpy()
+    # the mask is drawn once and reused across all 7 steps
+    assert set(np.round(np.unique(m_first), 4)) <= {0.0, 2.0}
+    # inference: no dropout
+    cell.reset()
+    outs, _ = cell.unroll(7, seq, layout="NTC")
+    assert cell._mask_i is None
+
+
+def test_lstmp_cell_projection_shapes():
+    cell = crnn.LSTMPCell(hidden_size=8, projection_size=3, input_size=5)
+    cell.initialize(mx.init.Xavier())
+    x = nd.array(np.random.RandomState(4).randn(2, 5).astype("float32"))
+    states = cell.begin_state(2)
+    assert states[0].shape == (2, 3) and states[1].shape == (2, 8)
+    out, next_states = cell(x, states)
+    assert out.shape == (2, 3)                  # projected
+    assert next_states[1].shape == (2, 8)       # cell state full width
+    seq = nd.array(np.random.RandomState(5).randn(2, 4, 5).astype("float32"))
+    cell.reset()
+    outs, _ = cell.unroll(4, seq, layout="NTC")
+    assert outs.shape == (2, 4, 3)
+
+
+def test_sparse_embedding_forward_grad():
+    emb = cnn.SparseEmbedding(10, 4)
+    emb.initialize(mx.init.Normal(0.1))
+    idx = nd.array(np.array([1, 3, 1], np.float32))
+    with autograd.record():
+        out = emb(idx)
+        loss = (out * out).sum()
+    loss.backward()
+    assert out.shape == (3, 4)
+    g = emb.weight.grad()
+    gn = g.asnumpy() if hasattr(g, "asnumpy") else np.asarray(g)
+    if gn.ndim == 2 and gn.shape == (10, 4):
+        touched = np.abs(gn).sum(1) > 0
+        assert touched[1] and touched[3] and not touched[0]
+
+
+def test_sync_batch_norm_api():
+    assert cnn.SyncBatchNorm is nn.SyncBatchNorm   # one class, 2.x move
+    bn = cnn.SyncBatchNorm(in_channels=4, num_devices=8)
+    bn.initialize()
+    x = nd.array(np.random.RandomState(6).randn(2, 4, 3, 3)
+                 .astype("float32"))
+    with autograd.record(train_mode=True):
+        out = bn(x)
+    assert out.shape == x.shape
