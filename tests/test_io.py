@@ -451,3 +451,41 @@ def test_mnist_iter_sharding(tmp_path):
                          num_parts=2, part_index=1)
     b = next(part)
     np.testing.assert_allclose(b.label[0].asnumpy(), [1, 3, 5, 7])
+
+
+def test_parse_log_tool():
+    """tools/parse_log.py scrapes Speedometer/fit logs (reference
+    tools/parse_log.py role)."""
+    import tempfile
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import parse_log
+    lines = [
+        "INFO:root:Epoch[0] Batch [100]\tSpeed: 1000.0 samples/sec\t"
+        "accuracy=0.61",
+        "INFO:root:Epoch[0] Batch [200]\tSpeed: 1200.0 samples/sec\t"
+        "accuracy=0.64",
+        "INFO:root:Epoch[0] Time cost=10.5",
+        "INFO:root:Epoch[0] Validation-accuracy=0.70",
+        "INFO:root:Epoch[1] Batch [100]\tSpeed: 1500.0 samples/sec\t"
+        "accuracy=0.72",
+    ]
+    out = parse_log.parse(lines)
+    assert out[0]["val-accuracy"] == 0.70
+    assert out[0]["time"] == 10.5
+    assert out[0]["speeds"] == [1000.0, 1200.0]
+    assert out[1]["train-accuracy"] == 0.72
+
+
+def test_bandwidth_tool_runs():
+    """tools/bandwidth.py (reference tools/bandwidth/measure.py role)
+    reports a JSON bandwidth line for the local store."""
+    import json
+    import subprocess
+    r = subprocess.run([sys.executable,
+                        os.path.join(REPO, "tools", "bandwidth.py"),
+                        "--cpu", "--mb", "2", "--iters", "3"],
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-500:]
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "kvstore_pushpull_bandwidth_gb_per_sec"
+    assert rec["value"] > 0

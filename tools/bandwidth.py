@@ -1,0 +1,66 @@
+"""kvstore push/pull bandwidth harness.
+
+Reference: ``tools/bandwidth/measure.py`` — times repeated
+``push``+``pull`` of large arrays through a kvstore and reports GB/s per
+store type.  Here the interesting axes are the collective stores (one
+jitted reduce; ICI on real hardware, host RAM on the fake mesh) and the
+dist_async TCP parameter server.
+
+Run:  python tools/bandwidth.py [--store local|device|ici] [--mb 64]
+      [--iters 10] [--compress 2bit|bf16]
+(dist_async needs `tools/launch.py -n W -s 1 -- python tools/bandwidth.py
+ --store dist_async`.)
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--store", default="local")
+    p.add_argument("--mb", type=float, default=64.0)
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--compress", default=None)
+    p.add_argument("--cpu", action="store_true",
+                   help="pin the CPU backend (no TPU probe)")
+    args = p.parse_args()
+    if args.cpu:
+        os.environ.setdefault("MX_FORCE_CPU", "1")
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, kvstore
+
+    kv = kvstore.create(args.store)
+    if args.compress:
+        kv.set_gradient_compression({"type": args.compress,
+                                     "threshold": 0.5})
+    n = int(args.mb * (1 << 20) / 4)
+    payload = nd.array(np.random.RandomState(0).rand(n).astype(np.float32))
+    out = nd.zeros((n,))
+    kv.init("x", nd.zeros((n,)))
+    kv.pushpull("x", payload, out=out)          # warm (compile/connect)
+    out.wait_to_read()
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        kv.pushpull("x", payload, out=out)
+    out.wait_to_read()
+    dt = time.perf_counter() - t0
+    moved = 2 * args.mb * args.iters / 1024.0    # push + pull, GiB
+    print(json.dumps({
+        "metric": "kvstore_pushpull_bandwidth_gb_per_sec",
+        "value": round(moved / dt, 3), "unit": "GiB/s",
+        "store": kv.type, "mb_per_tensor": args.mb, "iters": args.iters,
+        "compression": args.compress,
+        "num_workers": kv.num_workers,
+    }))
+
+
+if __name__ == "__main__":
+    main()

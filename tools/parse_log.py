@@ -1,0 +1,88 @@
+"""Training-log parser: epoch/accuracy/speed table from fit-style logs.
+
+Reference: ``tools/parse_log.py`` — scrapes `Epoch[N] ... accuracy=X` and
+`Speed: Y samples/sec` lines (the Speedometer/fit logging format this
+rebuild's mx.callback.Speedometer and Module.fit emit) into a summary
+table/CSV.
+
+Run:  python tools/parse_log.py train.log [--format csv|table]
+"""
+import argparse
+import re
+import sys
+from collections import defaultdict
+
+_EPOCH = re.compile(r"Epoch\[(\d+)\]")
+_METRIC = re.compile(r"(\w[\w-]*)=([0-9.eE+-]+)")
+_SPEED = re.compile(r"Speed[:=]\s*([0-9.]+)\s*samples/sec")
+_TIME = re.compile(r"Time cost[:=]\s*([0-9.]+)")
+
+
+def parse(lines):
+    epochs = defaultdict(dict)
+    for line in lines:
+        m = _EPOCH.search(line)
+        if not m:
+            continue
+        e = int(m.group(1))
+        rec = epochs[e]
+        sp = _SPEED.search(line)
+        if sp:
+            rec.setdefault("speeds", []).append(float(sp.group(1)))
+        tc = _TIME.search(line)
+        if tc:
+            rec["time"] = float(tc.group(1))
+        is_val = "Validation" in line
+        for name, val in _METRIC.findall(line):
+            if name in ("Speed", "Time", "cost"):
+                continue
+            # fit logs write Train-accuracy=/Validation-accuracy=;
+            # Speedometer batch lines write bare accuracy=
+            if name.startswith("Validation-"):
+                key = "val-" + name[len("Validation-"):]
+            elif name.startswith("Train-"):
+                key = "train-" + name[len("Train-"):]
+            else:
+                key = ("val-" if is_val else "train-") + name
+            try:
+                rec[key] = float(val)
+            except ValueError:
+                pass
+    return dict(epochs)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("logfile")
+    p.add_argument("--format", default="table", choices=["table", "csv"])
+    args = p.parse_args()
+    with open(args.logfile) as f:
+        epochs = parse(f)
+    if not epochs:
+        print("no Epoch[N] lines found", file=sys.stderr)
+        return 1
+    cols = sorted({k for rec in epochs.values() for k in rec
+                   if k != "speeds"})
+    header = ["epoch"] + cols + ["avg-speed"]
+    rows = []
+    for e in sorted(epochs):
+        rec = epochs[e]
+        speeds = rec.get("speeds", [])
+        avg = sum(speeds) / len(speeds) if speeds else ""
+        rows.append([e] + [rec.get(c, "") for c in cols] +
+                    [round(avg, 2) if avg else ""])
+    if args.format == "csv":
+        print(",".join(str(h) for h in header))
+        for r in rows:
+            print(",".join(str(x) for x in r))
+    else:
+        widths = [max(len(str(h)), *(len(str(r[i])) for r in rows))
+                  for i, h in enumerate(header)]
+        print("  ".join(str(h).ljust(w) for h, w in zip(header, widths)))
+        for r in rows:
+            print("  ".join(str(x).ljust(w) for x, w in zip(r, widths)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
