@@ -1,0 +1,124 @@
+"""Random-op distribution battery (reference:
+tests/python/unittest/test_random.py — per-distribution moment checks,
+chi-square uniformity, seed determinism).
+
+The op battery exempts samplers from numpy refs (stochastic); this file
+is their correctness gate: with N=40k draws the sample mean/var must land
+within ~5 sigma of the closed-form moments, uniform draws must pass a
+chi-square bucket test, and mx.random.seed must reproduce streams.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray.ndarray import invoke
+
+N = 40_000
+
+
+def draws(op, **params):
+    mx.random.seed(7)
+    if "size" in params or "shape" in params:
+        out = invoke(op, **params)
+    else:
+        out = invoke(op, shape=(N,), **params)
+    return out.asnumpy().astype(np.float64)
+
+
+# (op, params, mean, var) — closed-form moments
+MOMENTS = [
+    ("_random_uniform", {"low": -1.0, "high": 3.0}, 1.0, 16.0 / 12.0),
+    ("_random_normal", {"loc": 2.0, "scale": 3.0}, 2.0, 9.0),
+    ("_random_gamma", {"alpha": 4.0, "beta": 0.5}, 2.0, 1.0),
+    ("_random_exponential", {"lam": 2.0}, 0.5, 0.25),
+    ("_random_poisson", {"lam": 6.0}, 6.0, 6.0),
+    ("_random_negative_binomial", {"k": 5, "p": 0.5}, 5.0, 10.0),
+    ("_random_generalized_negative_binomial", {"mu": 4.0, "alpha": 0.25},
+     4.0, 4.0 + 0.25 * 16.0),
+    ("_random_logistic", {"loc": 1.0, "scale": 0.5},
+     1.0, (np.pi ** 2) * 0.25 / 3.0),
+    ("_random_gumbel", {"loc": 0.0, "scale": 1.0},
+     np.euler_gamma, np.pi ** 2 / 6.0),
+    ("_random_rayleigh", {"scale": 2.0},
+     2.0 * np.sqrt(np.pi / 2.0), (4.0 - np.pi) / 2.0 * 4.0),
+    ("_random_weibull", {"a": 1.0}, 1.0, 1.0),   # == Exp(1)
+    ("_random_pareto", {"a": 5.0}, 0.25, 5.0 / 48.0),  # numpy-style Lomax
+    ("_npi_laplace", {"loc": -1.0, "scale": 0.5, "size": (N,)},
+     -1.0, 2.0 * 0.25),
+    ("_npi_beta", {"a": 2.0, "b": 6.0, "size": (N,)},
+     0.25, 2.0 * 6.0 / (64.0 * 9.0)),
+    ("_npi_chisquare", {"df": 5.0, "size": (N,)}, 5.0, 10.0),
+    ("_npi_standard_t", {"df": 10.0, "size": (N,)}, 0.0, 10.0 / 8.0),
+    ("_npi_lognormal", {"mean": 0.0, "sigma": 0.5, "size": (N,)},
+     np.exp(0.125), (np.exp(0.25) - 1) * np.exp(0.25)),
+    ("_npi_triangular", {"left": 0.0, "mode": 1.0, "right": 2.0,
+                         "size": (N,)}, 1.0, 4.0 / 24.0 - 0.0),
+]
+
+
+@pytest.mark.parametrize("op,params,mean,var",
+                         MOMENTS, ids=[m[0] for m in MOMENTS])
+def test_distribution_moments(op, params, mean, var):
+    x = draws(op, **params)
+    assert np.isfinite(x).all()
+    # standard error bounds: 5-sigma on the mean, generous on the var
+    se_mean = np.sqrt(var / N)
+    assert abs(x.mean() - mean) < 5 * se_mean + 1e-3, \
+        (op, x.mean(), mean)
+    assert abs(x.var() - var) < 0.15 * var + 5e-3, (op, x.var(), var)
+
+
+def test_uniform_chi_square():
+    """Bucketed chi-square against Uniform(0,1) (reference test_random
+    chi-square helper): 20 buckets, dof=19, crit(0.999) ≈ 43.8."""
+    x = draws("_random_uniform", low=0.0, high=1.0)
+    counts, _ = np.histogram(x, bins=20, range=(0.0, 1.0))
+    expect = N / 20.0
+    chi2 = float(((counts - expect) ** 2 / expect).sum())
+    assert chi2 < 43.8, chi2
+
+
+def test_randint_bounds_and_coverage():
+    x = draws("_random_randint", low=3, high=11)
+    assert x.min() >= 3 and x.max() <= 10
+    assert set(np.unique(x).astype(int)) == set(range(3, 11))
+
+
+def test_bernoulli_rate():
+    x = draws("_random_bernoulli", prob=0.3)
+    assert set(np.unique(x)) <= {0.0, 1.0}
+    assert abs(x.mean() - 0.3) < 5 * np.sqrt(0.21 / N)
+
+
+def test_seed_determinism_and_divergence():
+    mx.random.seed(42)
+    a = invoke("_random_normal", shape=(64,)).asnumpy()
+    mx.random.seed(42)
+    b = invoke("_random_normal", shape=(64,)).asnumpy()
+    np.testing.assert_array_equal(a, b)
+    c = invoke("_random_normal", shape=(64,)).asnumpy()  # stream advanced
+    assert not np.array_equal(a, c)
+    mx.random.seed(43)
+    d = invoke("_random_normal", shape=(64,)).asnumpy()
+    assert not np.array_equal(a, d)
+
+
+def test_sample_ops_parameter_broadcast():
+    """_sample_* draw per-row with row-specific parameters (reference
+    sample_op row semantics)."""
+    mx.random.seed(0)
+    mu = nd.array(np.array([0.0, 100.0], np.float32))
+    sd = nd.array(np.array([1.0, 1.0], np.float32))
+    out = invoke("_sample_normal", mu, sd, shape=(4000,)).asnumpy()
+    assert out.shape == (2, 4000)
+    assert abs(out[0].mean() - 0.0) < 0.2
+    assert abs(out[1].mean() - 100.0) < 0.2
+
+
+def test_shuffle_is_permutation():
+    mx.random.seed(1)
+    x = nd.array(np.arange(512, dtype=np.float32))
+    y = invoke("shuffle", x).asnumpy()
+    assert sorted(y.tolist()) == list(range(512))
+    assert not np.array_equal(y, np.arange(512))
