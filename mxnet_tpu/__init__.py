@@ -45,6 +45,7 @@ from . import recordio
 from . import io
 from . import image
 from . import symbol
+from . import name
 from . import symbol as sym
 from .symbol import AttrScope
 from . import contrib

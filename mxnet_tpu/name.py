@@ -1,0 +1,58 @@
+"""mx.name — NameManager / Prefix (reference: python/mxnet/name.py).
+
+The v1.x auto-naming stack: symbols created without an explicit name ask
+the CURRENT NameManager; ``with mx.name.Prefix('stage1_'):`` prepends a
+prefix to every auto-generated name inside the scope (how the reference
+model zoo keeps per-stage parameter names unique)."""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+__all__ = ["NameManager", "Prefix", "current"]
+
+
+class NameManager:
+    """Scoped auto-namer (reference: name.NameManager)."""
+
+    _current = threading.local()
+
+    def __init__(self):
+        self._counter: Dict[str, int] = {}
+        self._old_manager: Optional["NameManager"] = None
+
+    def get(self, name: Optional[str], hint: str) -> str:
+        """Return `name` or generate `hint%d` (reference: NameManager.get)."""
+        if name is not None:
+            return name
+        n = self._counter.get(hint, 0)
+        self._counter[hint] = n + 1
+        return "%s%d" % (hint, n)
+
+    def __enter__(self):
+        self._old_manager = current()
+        NameManager._current.value = self
+        return self
+
+    def __exit__(self, *exc):
+        NameManager._current.value = self._old_manager
+        return False
+
+
+class Prefix(NameManager):
+    """Prefix every auto name (reference: name.Prefix)."""
+
+    def __init__(self, prefix: str):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        return self._prefix + super().get(name, hint)
+
+
+def current() -> NameManager:
+    mgr = getattr(NameManager._current, "value", None)
+    if mgr is None:
+        mgr = NameManager()
+        NameManager._current.value = mgr
+    return mgr

@@ -312,14 +312,12 @@ def _is_aux_name(name: str) -> bool:
 # op-call composition (mx.sym.<op>(...) mirrors mx.nd.<op>(...))
 # ---------------------------------------------------------------------------
 
-_name_counter: Dict[str, int] = {}
-
-
 def _gen_name(op: str) -> str:
+    # auto names route through mx.name's scoped NameManager (reference:
+    # name.NameManager — Prefix scopes prepend to every generated name)
+    from .name import current as _current_namer
     base = op.lower().lstrip("_")
-    n = _name_counter.get(base, 0)
-    _name_counter[base] = n + 1
-    return "%s%d" % (base, n)
+    return _current_namer().get(None, base)
 
 
 def _const(value) -> Symbol:

@@ -204,3 +204,24 @@ def test_attr_scope_group2ctx_model_parallel():
     node_attrs = reloaded.attr_dict()
     assert node_attrs["fc1"]["__ctx_group__"] == "dev1"
     assert node_attrs["fc2"]["__ctx_group__"] == "dev2"
+
+
+def test_name_manager_and_prefix():
+    """mx.name.NameManager / Prefix scope auto-generated symbol names
+    (reference: python/mxnet/name.py; test_symbol name-scoping pattern)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym
+
+    with mx.name.NameManager():              # fresh counter scope
+        a = sym.relu(sym.Variable("x"))
+        assert a.name == "relu0"
+        b = sym.relu(sym.Variable("y"))
+        assert b.name == "relu1"
+        with mx.name.Prefix("stage1_"):
+            c = sym.relu(sym.Variable("z"))
+            assert c.name.startswith("stage1_relu")
+        d = sym.relu(sym.Variable("w"))      # prefix scope popped
+        assert d.name == "relu2"
+    # explicit names pass through untouched
+    e = sym.relu(sym.Variable("x"), name="myrelu")
+    assert e.name == "myrelu"
