@@ -23,12 +23,20 @@ _lock = threading.Lock()
 _cache = {}
 
 
+# per-component link flags (the reference links OpenCV etc. into
+# libmxnet.so; here each native piece declares its own system libs)
+_LINK_FLAGS = {
+    "imdecode": ["-ljpeg"],
+}
+
+
 def _build(name: str) -> str:
     src = os.path.join(_SRC, name + ".cc")
     out = os.path.join(_DIR, "lib%s.so" % name)
     if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
         return out
     cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", src, "-o", out]
+    cmd += _LINK_FLAGS.get(name, [])
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
         raise OSError("native build failed for %s:\n%s" % (name, proc.stderr))

@@ -17,6 +17,7 @@ ImageRecordIter.
 from __future__ import annotations
 
 import io as _io
+import threading as _threading
 import logging
 import os
 import random as _pyrandom
@@ -55,10 +56,80 @@ def _to_np(img) -> _np.ndarray:
 
 # -- codecs -------------------------------------------------------------------
 
+_NATIVE_JPEG = None
+_NATIVE_JPEG_TRIED = False
+
+
+_NATIVE_JPEG_LOCK = _threading.Lock()
+
+
+def _native_jpeg():
+    """ctypes handle on the native libjpeg decoder (src/imdecode.cc) —
+    the reference's C++ decode path; None when the toolchain/libjpeg is
+    unavailable (PIL fallback).  First call builds under a lock so a
+    thread pool's concurrent first batch WAITS for the native path
+    instead of silently decoding via PIL."""
+    global _NATIVE_JPEG, _NATIVE_JPEG_TRIED
+    if _NATIVE_JPEG_TRIED:
+        return _NATIVE_JPEG
+    with _NATIVE_JPEG_LOCK:
+        if _NATIVE_JPEG_TRIED:
+            return _NATIVE_JPEG
+        try:
+            import ctypes
+            from .. import _native
+            lib = _native.load("imdecode")
+            lib.MXImdecode.restype = ctypes.c_int
+            lib.MXImdecode.argtypes = [
+                ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int,
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte)),
+                ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_int)]
+            lib.MXImdecodeFree.argtypes = [
+                ctypes.POINTER(ctypes.c_ubyte)]
+            _NATIVE_JPEG = lib
+        except OSError:
+            _NATIVE_JPEG = None
+        _NATIVE_JPEG_TRIED = True
+    return _NATIVE_JPEG
+
+
+def _imdecode_native(buf: bytes, flag: int):
+    lib = _native_jpeg()
+    if lib is None:
+        return None
+    import ctypes
+    out = ctypes.POINTER(ctypes.c_ubyte)()
+    h = ctypes.c_int()
+    w = ctypes.c_int()
+    c = ctypes.c_int()
+    rc = lib.MXImdecode(buf, len(buf), 1 if flag == 0 else 3,
+                        ctypes.byref(out), ctypes.byref(h), ctypes.byref(w),
+                        ctypes.byref(c))
+    if rc != 0:
+        return None          # not a JPEG / corrupt: PIL path decides
+    try:
+        n = h.value * w.value * c.value
+        arr = _np.ctypeslib.as_array(out, shape=(n,)).reshape(
+            h.value, w.value, c.value).copy()
+    finally:
+        lib.MXImdecodeFree(out)
+    return arr
+
+
 def imdecode(buf: bytes, to_rgb: int = 1, flag: int = 1) -> NDArray:
     """Decode JPEG/PNG bytes → HWC uint8 NDArray (reference: mx.image.imdecode
     → cv::imdecode).  ``flag=0`` decodes grayscale (H, W, 1); to_rgb keeps
-    RGB channel order (the reference's default converts BGR→RGB)."""
+    RGB channel order (the reference's default converts BGR→RGB).
+
+    JPEG rides the native GIL-free decoder (src/imdecode.cc, the
+    reference's C++ parser role); PNG/other formats and build-less
+    environments fall back to PIL."""
+    arr = _imdecode_native(bytes(buf), flag)
+    if arr is not None:
+        if flag != 0 and not to_rgb:
+            arr = arr[:, :, ::-1]
+        return _to_nd(arr)
     Image = _pil()
     pil = Image.open(_io.BytesIO(buf))
     if flag == 0:

@@ -1,0 +1,99 @@
+// Native JPEG decoder — the hot half of the reference's C++ image
+// pipeline (src/io/iter_image_recordio_2.cc ImageRecordIOParser2 +
+// image_aug_default.cc decode via cv::imdecode).
+//
+// The GIL-free decode is what lets host CPUs keep a TPU fed: python
+// callers (mx.image.imdecode, io.ImageRecordIter workers) drop into this
+// via ctypes, so N decode threads scale on N cores instead of fighting
+// over the interpreter.  Plain libjpeg (present in the image); extern "C"
+// ABI consumed by ctypes — no pybind11 in this environment.
+#include <csetjmp>
+#include <cstdint>
+#include <cstdio>   // jpeglib.h needs FILE declared first
+#include <cstdlib>
+#include <cstring>
+
+#include <jpeglib.h>
+
+namespace {
+
+struct ErrorMgr {
+  jpeg_error_mgr pub;
+  jmp_buf jump;
+};
+
+void error_exit(j_common_ptr cinfo) {
+  // libjpeg's default handler calls exit(); longjmp back out instead
+  ErrorMgr* mgr = reinterpret_cast<ErrorMgr*>(cinfo->err);
+  longjmp(mgr->jump, 1);
+}
+
+void silent_output(j_common_ptr) {
+  // corrupt inputs are a return code, not stderr noise
+}
+
+}  // namespace
+
+extern "C" {
+
+// Decode JPEG bytes.  channels_want: 0 = keep source, 1 = grayscale,
+// 3 = RGB.  On success returns 0 and *out (malloc'd HWC uint8, caller
+// frees with MXImdecodeFree) + dims.  Non-JPEG or corrupt data: -1.
+int MXImdecode(const unsigned char* data, uint64_t len, int channels_want,
+               unsigned char** out, int* height, int* width,
+               int* channels) {
+  if (len < 2 || data[0] != 0xFF || data[1] != 0xD8) {
+    return -1;  // not a JPEG (PNG etc. stay on the python/PIL path)
+  }
+  jpeg_decompress_struct cinfo;
+  ErrorMgr err;
+  cinfo.err = jpeg_std_error(&err.pub);
+  err.pub.error_exit = error_exit;
+  err.pub.output_message = silent_output;
+  // volatile: modified between setjmp and longjmp — without it the
+  // recovery free() may see an indeterminate register value (C99 7.13.2.1)
+  unsigned char* volatile buf = nullptr;
+  if (setjmp(err.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    std::free(buf);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<unsigned char*>(data),
+               static_cast<unsigned long>(len));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  if (channels_want == 1) {
+    cinfo.out_color_space = JCS_GRAYSCALE;
+  } else if (channels_want == 3) {
+    cinfo.out_color_space = JCS_RGB;
+  }
+  jpeg_start_decompress(&cinfo);
+  const int h = static_cast<int>(cinfo.output_height);
+  const int w = static_cast<int>(cinfo.output_width);
+  const int c = static_cast<int>(cinfo.output_components);
+  const size_t stride = static_cast<size_t>(w) * c;
+  buf = static_cast<unsigned char*>(std::malloc(stride * h));
+  if (buf == nullptr) {
+    jpeg_abort_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  while (cinfo.output_scanline < cinfo.output_height) {
+    unsigned char* row = buf + stride * cinfo.output_scanline;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  *out = buf;
+  *height = h;
+  *width = w;
+  *channels = c;
+  return 0;
+}
+
+void MXImdecodeFree(unsigned char* buf) { std::free(buf); }
+
+}  // extern "C"

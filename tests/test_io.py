@@ -489,3 +489,36 @@ def test_bandwidth_tool_runs():
     rec = json.loads(r.stdout.strip().splitlines()[-1])
     assert rec["metric"] == "kvstore_pushpull_bandwidth_gb_per_sec"
     assert rec["value"] > 0
+
+
+def test_native_jpeg_decoder_matches_pil():
+    """src/imdecode.cc (reference ImageRecordIOParser2 decode role):
+    bit-exact with PIL on the same libjpeg, clean fallback on corrupt
+    bytes and non-JPEG formats."""
+    import io as _io
+    from PIL import Image as PILImage
+    from mxnet_tpu import image as mimg
+
+    if mimg._native_jpeg() is None:
+        pytest.skip("no native toolchain")
+    rng = np.random.RandomState(0)
+    raw = rng.randint(0, 255, (32, 48, 3)).astype(np.uint8)
+    buf = _io.BytesIO()
+    PILImage.fromarray(raw).save(buf, format="JPEG", quality=92)
+    jpeg = buf.getvalue()
+    nat = mimg._imdecode_native(jpeg, 1)
+    assert nat is not None
+    pil = np.asarray(PILImage.open(_io.BytesIO(jpeg)).convert("RGB"))
+    np.testing.assert_array_equal(nat, pil)       # same libjpeg: bit-exact
+    # grayscale request
+    g = mimg._imdecode_native(jpeg, 0)
+    assert g.shape[2] in (1, 3)
+    # corrupt JPEG -> None (PIL path decides), never a crash
+    assert mimg._imdecode_native(b"\xff\xd8not-a-real-jpeg" * 3, 1) is None
+    # PNG is not claimed by the native path
+    buf2 = _io.BytesIO()
+    PILImage.fromarray(raw).save(buf2, format="PNG")
+    assert mimg._imdecode_native(buf2.getvalue(), 1) is None
+    # the public imdecode composes both paths
+    np.testing.assert_array_equal(mimg.imdecode(jpeg).asnumpy(), pil)
+    assert mimg.imdecode(buf2.getvalue()).shape == (32, 48, 3)
