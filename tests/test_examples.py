@@ -43,3 +43,13 @@ def test_resnet_dp_example(tmp_path):
 def test_ssd_example():
     out = _run("train_ssd.py", "--epochs", "1")
     assert "mAP07" in out
+
+
+def test_word_lm_example():
+    """BASELINE config 3 example surface (reference example/rnn/word_lm):
+    LSTM LM with truncated BPTT, perplexity + wps logging."""
+    out = _run("word_lm.py", "--epochs", "1", "--max-batches", "8",
+               "--batch-size", "8", "--bptt", "16", "--hidden", "32",
+               "--embed", "16", "--vocab", "200")
+    assert "Train-perplexity=" in out
+    assert "final train perplexity" in out
