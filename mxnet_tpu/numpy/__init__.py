@@ -407,13 +407,15 @@ linalg.det = _op_fn("linalg_det", "det")
 linalg.slogdet = _op_fn("linalg_slogdet", "slogdet")
 linalg.cholesky = _op_fn("linalg_potrf", "cholesky")
 linalg.eigh = _op_fn("linalg_syevd", "eigh")
-linalg.svd = _jnp_fn(jnp.linalg.svd)
-linalg.qr = _jnp_fn(jnp.linalg.qr)
-linalg.solve = _jnp_fn(jnp.linalg.solve)
-linalg.lstsq = _jnp_fn(jnp.linalg.lstsq)
-linalg.matrix_rank = _jnp_fn(jnp.linalg.matrix_rank)
-linalg.pinv = _jnp_fn(jnp.linalg.pinv)
-linalg.eigvalsh = _jnp_fn(jnp.linalg.eigvalsh)
+linalg.svd = lambda a, full_matrices=False: tuple(
+    invoke("_npi_svd", a, full_matrices=full_matrices))
+linalg.qr = lambda a: tuple(invoke("_npi_qr", a))
+linalg.solve = _op_fn("_npi_solve", "solve")
+linalg.lstsq = lambda a, b, rcond=None: tuple(
+    invoke("_npi_lstsq", a, b, rcond=rcond))
+linalg.matrix_rank = _op_fn("_npi_matrix_rank", "matrix_rank")
+linalg.pinv = _op_fn("_npi_pinv", "pinv")
+linalg.eigvalsh = _op_fn("_npi_eigvalsh", "eigvalsh")
 sys.modules[linalg.__name__] = linalg
 
 random = ModuleType(__name__ + ".random")
@@ -523,14 +525,14 @@ def trapz(y, x=None, dx=1.0, axis=-1):
 
 
 # -- linalg tail --------------------------------------------------------------
-linalg.cond = _jnp_fn(jnp.linalg.cond)
-linalg.matrix_power = _jnp_fn(jnp.linalg.matrix_power)
-linalg.multi_dot = lambda arrays, **kw: _wrap(
-    jnp.linalg.multi_dot([_unwrap(a) for a in arrays], **kw))
-linalg.eigvals = _jnp_fn(jnp.linalg.eigvals)
-linalg.eig = _jnp_fn(jnp.linalg.eig)
-linalg.tensorsolve = _jnp_fn(jnp.linalg.tensorsolve)
-linalg.tensorinv = _jnp_fn(jnp.linalg.tensorinv)
+linalg.cond = _op_fn("_npi_cond", "cond")
+linalg.matrix_power = _op_fn("_npi_matrix_power", "matrix_power")
+linalg.multi_dot = lambda arrays, **kw: invoke("_npi_multi_dot", *arrays)
+linalg.eigvals = _jnp_fn(jnp.linalg.eigvals)   # complex out: jnp path
+linalg.eig = _jnp_fn(jnp.linalg.eig)           # complex out: jnp path
+linalg.tensorsolve = lambda a, b, axes=None: invoke(
+    "_npi_tensorsolve", a, b, axes=tuple(axes) if axes else None)
+linalg.tensorinv = lambda a, ind=2: invoke("_npi_tensorinv", a, ind=ind)
 
 
 # -- random tail --------------------------------------------------------------

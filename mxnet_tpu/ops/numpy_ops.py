@@ -895,3 +895,86 @@ _reg("_npi_meshgrid", _npi_meshgrid, differentiable=False, num_outputs=-1)
 _reg("_npi_broadcast_arrays", _npi_broadcast_arrays, num_outputs=-1)
 _reg("_npi_logspace", _npi_logspace, differentiable=False)
 _reg("_npi_geomspace", _npi_geomspace, differentiable=False)
+
+
+# ---------------------------------------------------------------------------
+# numpy linalg (reference: src/operator/numpy/linalg/np_*.cc — _npi_svd,
+# _npi_qr, _npi_solve, _npi_pinv, _npi_cholesky, _npi_eigvalsh, ...)
+# ---------------------------------------------------------------------------
+
+
+def _npi_svd(a, full_matrices=False):
+    u, s, vh = jnp.linalg.svd(a, full_matrices=full_matrices)
+    return u, s, vh
+
+
+def _npi_qr(a):
+    q, r = jnp.linalg.qr(a)
+    return q, r
+
+
+def _npi_solve(a, b):
+    return jnp.linalg.solve(a, b)
+
+
+def _npi_lstsq(a, b, rcond=None):
+    x, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+    return x, res, rank, sv
+
+
+def _npi_pinv(a, rcond=1e-15):
+    return jnp.linalg.pinv(a, rcond)
+
+
+def _npi_cholesky(a, lower=True):
+    out = jnp.linalg.cholesky(a)
+    return out if lower else jnp.swapaxes(out, -1, -2)
+
+
+def _npi_eigvalsh(a, UPLO="L"):
+    return jnp.linalg.eigvalsh(a, UPLO=UPLO)
+
+
+def _npi_eigh(a, UPLO="L"):
+    w, v = jnp.linalg.eigh(a, UPLO=UPLO)
+    return w, v
+
+
+def _npi_matrix_rank(M, tol=None, hermitian=False):
+    return jnp.linalg.matrix_rank(M, tol=tol)
+
+
+def _npi_matrix_power(a, n):
+    return jnp.linalg.matrix_power(a, int(n))
+
+
+def _npi_multi_dot(*arrays):
+    return jnp.linalg.multi_dot(list(arrays))
+
+
+def _npi_tensorsolve(a, b, axes=None):
+    return jnp.linalg.tensorsolve(a, b, axes=axes)
+
+
+def _npi_tensorinv(a, ind=2):
+    return jnp.linalg.tensorinv(a, ind=ind)
+
+
+def _npi_cond(x, p=None):
+    return jnp.linalg.cond(x, p=p)
+
+
+_reg("_npi_svd", _npi_svd, num_outputs=3)
+_reg("_npi_qr", _npi_qr, num_outputs=2)
+_reg("_npi_solve", _npi_solve)
+_reg("_npi_lstsq", _npi_lstsq, num_outputs=4, differentiable=False)
+_reg("_npi_pinv", _npi_pinv)
+_reg("_npi_cholesky", _npi_cholesky)
+_reg("_npi_eigvalsh", _npi_eigvalsh)
+_reg("_npi_eigh", _npi_eigh, num_outputs=2)
+_reg("_npi_matrix_rank", _npi_matrix_rank, differentiable=False)
+_reg("_npi_matrix_power", _npi_matrix_power)
+_reg("_npi_multi_dot", _npi_multi_dot)
+_reg("_npi_tensorsolve", _npi_tensorsolve)
+_reg("_npi_tensorinv", _npi_tensorinv)
+_reg("_npi_cond", _npi_cond, differentiable=False)

@@ -1711,6 +1711,60 @@ SPECS["_npi_geomspace"] = S(lambda: [], {"start": 1.0, "stop": 16.0,
                             ref=lambda: np.geomspace(1.0, 16.0, 5),
                             grad=False)
 
+# numpy linalg (_npi_*): deterministic factorizations get direct refs;
+# sign/order-ambiguous ones (svd/qr/eigh/lstsq) are pinned by the
+# reconstruction-identity test below
+SPECS["_npi_solve"] = S(lambda: [_spd(4), f(4, 2)],
+                        ref=np.linalg.solve, rtol=1e-3, atol=1e-3)
+SPECS["_npi_pinv"] = S(lambda: [f(4, 3)], ref=np.linalg.pinv,
+                       rtol=1e-3, atol=1e-3)
+SPECS["_npi_cholesky"] = S(lambda: [_spd(4)], ref=np.linalg.cholesky,
+                           rtol=1e-3, atol=1e-3)
+SPECS["_npi_eigvalsh"] = S(lambda: [_spd(4)], ref=np.linalg.eigvalsh,
+                           rtol=1e-3, atol=1e-3)
+SPECS["_npi_matrix_rank"] = S(lambda: [_spd(4)], grad=False,
+                              ref=lambda a: np.asarray(
+                                  np.linalg.matrix_rank(a)))
+SPECS["_npi_matrix_power"] = S(lambda: [_spd(3)], {"n": 3},
+                               ref=lambda a: np.linalg.matrix_power(a, 3),
+                               rtol=1e-3, atol=1e-3)
+SPECS["_npi_multi_dot"] = S(lambda: [f(3, 4), f(4, 5), f(5, 2)],
+                            ref=lambda *ms: np.linalg.multi_dot(ms))
+SPECS["_npi_tensorsolve"] = S(
+    lambda: [_spd(4).reshape(2, 2, 2, 2), f(2, 2)],
+    ref=np.linalg.tensorsolve, rtol=1e-3, atol=1e-3, grad=False)
+SPECS["_npi_tensorinv"] = S(lambda: [_spd(4).reshape(2, 2, 2, 2)],
+                            ref=np.linalg.tensorinv,
+                            rtol=1e-3, atol=1e-3, grad=False)
+SPECS["_npi_cond"] = S(lambda: [_spd(4)], grad=False,
+                       ref=lambda a: np.asarray(np.linalg.cond(a),
+                                                np.float32),
+                       rtol=1e-3, atol=1e-3)
+SPECS["_npi_svd"] = S(lambda: [f(4, 3)], grad=False)     # sign-ambiguous
+SPECS["_npi_qr"] = S(lambda: [f(4, 3)], grad=False)      # sign-ambiguous
+SPECS["_npi_eigh"] = S(lambda: [_spd(4)], grad=False)    # sign-ambiguous
+SPECS["_npi_lstsq"] = S(lambda: [f(5, 3), f(5, 2)], grad=False)
+
+
+def test_npi_linalg_reconstruction_identities():
+    """svd/qr/eigh/lstsq are unique only up to signs/order: pin them by
+    the identities they must satisfy instead of elementwise refs."""
+    a = f(5, 3)
+    u, s, vh = (x.asnumpy() for x in invoke("_npi_svd", nd.array(a)))
+    np.testing.assert_allclose((u * s) @ vh, a, rtol=1e-4, atol=1e-4)
+    q, r = (x.asnumpy() for x in invoke("_npi_qr", nd.array(a)))
+    np.testing.assert_allclose(q @ r, a, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(q.T @ q, np.eye(3), rtol=1e-4, atol=1e-4)
+    spd = _spd(4)
+    w, v = (x.asnumpy() for x in invoke("_npi_eigh", nd.array(spd)))
+    np.testing.assert_allclose(v @ np.diag(w) @ v.T, spd,
+                               rtol=1e-3, atol=1e-3)
+    A, b = f(6, 3), f(6, 2)
+    x = invoke("_npi_lstsq", nd.array(A), nd.array(b))[0].asnumpy()
+    want = np.linalg.lstsq(A, b, rcond=None)[0]
+    np.testing.assert_allclose(x, want, rtol=1e-3, atol=1e-3)
+
+
 # numpy-era + *_like samplers: stochastic -> shape/finiteness + moments
 for _n, _p in [
         ("_random_uniform_like", {}), ("_random_normal_like", {}),
