@@ -13,6 +13,7 @@ from .. import amp  # 1.x location: mx.contrib.amp (2.x: mx.amp)
 from . import ndarray
 from . import ndarray as nd
 from . import quantization
+from . import summary
 
 __all__ = ["foreach", "while_loop", "cond", "nd", "ndarray", "amp",
            "quantization"]

@@ -1,0 +1,269 @@
+"""TensorBoard event-file writer (reference role: the external ``mxboard``
+package — SURVEY §5.5 "optional TensorBoard scalar writer since the
+profiler already emits TB traces").
+
+No tensorboard package offline, so the wire format is written directly
+(the ONNX module's approach): TFRecord framing (u64 length + masked
+crc32c + payload) around Event protos (field numbers from
+tensorboard/compat/proto/event.proto).  Scalars, text, and histograms;
+readable by a stock TensorBoard pointed at the logdir.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import time
+from typing import Optional
+
+import numpy as _np
+
+__all__ = ["SummaryWriter"]
+
+
+# -- crc32c (Castagnoli), required by TFRecord framing ----------------------
+
+_CRC_TABLE = []
+
+
+def _crc_table():
+    if not _CRC_TABLE:
+        poly = 0x82F63B78
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ poly if c & 1 else c >> 1
+            _CRC_TABLE.append(c)
+    return _CRC_TABLE
+
+
+def _crc32c(data: bytes) -> int:
+    table = _crc_table()
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return ((((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF)
+
+
+# -- protobuf primitives (shared shape with onnx/__init__.py) ---------------
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    n &= (1 << 64) - 1
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _key(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _f_varint(field: int, value: int) -> bytes:
+    return _key(field, 0) + _varint(int(value))
+
+
+def _f_bytes(field: int, payload: bytes) -> bytes:
+    return _key(field, 2) + _varint(len(payload)) + payload
+
+
+def _f_double(field: int, value: float) -> bytes:
+    return _key(field, 1) + struct.pack("<d", value)
+
+
+def _f_float(field: int, value: float) -> bytes:
+    return _key(field, 5) + struct.pack("<f", value)
+
+
+def _summary_value(tag: str, simple_value: Optional[float] = None,
+                   histo: Optional[bytes] = None,
+                   text: Optional[str] = None) -> bytes:
+    # Summary.Value: tag=1, simple_value=2, histo=5, tensor=8
+    out = _f_bytes(1, tag.encode())
+    if simple_value is not None:
+        out += _f_float(2, float(simple_value))
+    if histo is not None:
+        out += _f_bytes(5, histo)
+    if text is not None:
+        payload = text.encode()
+        # TensorProto: dtype=1 (field 1, DT_STRING=7), string_val=8
+        tensor = _f_varint(1, 7) + _f_bytes(8, payload)
+        out += _f_bytes(8, tensor)
+        # metadata plugin_name="text" (SummaryMetadata field 9:
+        # plugin_data{plugin_name=1})
+        out += _f_bytes(9, _f_bytes(1, _f_bytes(1, b"text")))
+    return out
+
+
+def _histogram_proto(values: _np.ndarray, bins: int = 30) -> bytes:
+    v = _np.asarray(values, _np.float64).ravel()
+    counts, edges = _np.histogram(v, bins=bins)
+    out = _f_double(1, float(v.min()))
+    out += _f_double(2, float(v.max()))
+    out += _f_double(3, float(v.size))
+    out += _f_double(4, float(v.sum()))
+    out += _f_double(5, float((v * v).sum()))
+    # bucket_limit=6 (packed double), bucket=7 (packed double)
+    limits = b"".join(struct.pack("<d", e) for e in edges[1:])
+    buckets = b"".join(struct.pack("<d", float(c)) for c in counts)
+    out += _f_bytes(6, limits)
+    out += _f_bytes(7, buckets)
+    return out
+
+
+class SummaryWriter:
+    """Append-only TB event file (reference role: mxboard.SummaryWriter).
+
+    >>> sw = SummaryWriter('./logs')
+    >>> sw.add_scalar('loss', 0.5, step)
+    >>> sw.add_histogram('weights', nd_array, step)
+    >>> sw.close()
+    """
+
+    def __init__(self, logdir: str, filename_suffix: str = ""):
+        os.makedirs(logdir, exist_ok=True)
+        fname = "events.out.tfevents.%010d.%s%s" % (
+            int(time.time()), os.uname().nodename, filename_suffix)
+        self._path = os.path.join(logdir, fname)
+        self._f = open(self._path, "wb")
+        # file header event: wall_time + file_version
+        self._write_event(_f_double(1, time.time()) +
+                          _f_bytes(3, b"brain.Event:2"))
+
+    def _write_event(self, event_pb: bytes) -> None:
+        length = struct.pack("<Q", len(event_pb))
+        self._f.write(length)
+        self._f.write(struct.pack("<I", _masked_crc(length)))
+        self._f.write(event_pb)
+        self._f.write(struct.pack("<I", _masked_crc(event_pb)))
+        self._f.flush()
+
+    def _event(self, summary: bytes, step: int) -> bytes:
+        return (_f_double(1, time.time()) + _f_varint(2, step) +
+                _f_bytes(5, summary))
+
+    def add_scalar(self, tag: str, value, global_step: int = 0) -> None:
+        if hasattr(value, "asnumpy"):
+            value = float(value.asnumpy())
+        self._write_event(self._event(
+            _f_bytes(1, _summary_value(tag, simple_value=float(value))),
+            global_step))
+
+    def add_histogram(self, tag: str, values, global_step: int = 0,
+                      bins: int = 30) -> None:
+        if hasattr(values, "asnumpy"):
+            values = values.asnumpy()
+        self._write_event(self._event(
+            _f_bytes(1, _summary_value(
+                tag, histo=_histogram_proto(values, bins))), global_step))
+
+    def add_text(self, tag: str, text: str, global_step: int = 0) -> None:
+        self._write_event(self._event(
+            _f_bytes(1, _summary_value(tag, text=text)), global_step))
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+# -- reader (round-trip testing without tensorboard) ------------------------
+
+def _read_varint(buf, pos):
+    shift = result = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def read_events(path):
+    """Parse an event file back into [(step, tag, value-or-kind)] —
+    the round-trip gate (stock TB is the real consumer)."""
+    out = []
+    with open(path, "rb") as f:
+        data = f.read()
+    pos = 0
+    while pos < len(data):
+        (length,) = struct.unpack_from("<Q", data, pos)
+        (lcrc,) = struct.unpack_from("<I", data, pos + 8)
+        if lcrc != _masked_crc(data[pos:pos + 8]):
+            raise ValueError("corrupt length crc at %d" % pos)
+        event = data[pos + 12:pos + 12 + length]
+        (dcrc,) = struct.unpack_from("<I", data, pos + 12 + length)
+        if dcrc != _masked_crc(event):
+            raise ValueError("corrupt data crc at %d" % pos)
+        pos += 12 + length + 4
+        # walk the Event proto
+        epos, step, summary = 0, 0, None
+        while epos < len(event):
+            key, epos = _read_varint(event, epos)
+            field, wire = key >> 3, key & 7
+            if wire == 0:
+                val, epos = _read_varint(event, epos)
+                if field == 2:
+                    step = val
+            elif wire == 1:
+                epos += 8
+            elif wire == 5:
+                epos += 4
+            elif wire == 2:
+                ln, epos = _read_varint(event, epos)
+                if field == 5:
+                    summary = event[epos:epos + ln]
+                epos += ln
+        if summary is None:
+            continue
+        spos = 0
+        while spos < len(summary):
+            key, spos = _read_varint(summary, spos)
+            field, wire = key >> 3, key & 7
+            if wire != 2:
+                raise ValueError("unexpected summary wire %d" % wire)
+            ln, spos = _read_varint(summary, spos)
+            value = summary[spos:spos + ln]
+            spos += ln
+            vpos, tag, payload = 0, "", None
+            while vpos < len(value):
+                k2, vpos = _read_varint(value, vpos)
+                f2, w2 = k2 >> 3, k2 & 7
+                if w2 == 2:
+                    ln2, vpos = _read_varint(value, vpos)
+                    body = value[vpos:vpos + ln2]
+                    vpos += ln2
+                    if f2 == 1:
+                        tag = body.decode()
+                    elif f2 == 5:
+                        payload = ("histo", body)
+                    elif f2 == 8:
+                        payload = ("text", body)
+                elif w2 == 5:
+                    (sv,) = struct.unpack_from("<f", value, vpos)
+                    vpos += 4
+                    if f2 == 2:
+                        payload = ("scalar", sv)
+                elif w2 == 1:
+                    vpos += 8
+                else:
+                    _, vpos = _read_varint(value, vpos)
+            out.append((step, tag, payload))
+    return out
