@@ -27,8 +27,14 @@ from .registry import register
 
 __all__ = ["attention_core", "flash_attention"]
 
-_BLOCK_Q = 256
-_BLOCK_K = 256
+# kernel block sizes: 256x256 keeps the fp32 accumulators + two operand
+# tiles comfortably inside v5e VMEM; overridable via env so a healthy
+# TPU window can sweep candidates without code edits
+# (tools/tpu_capture.py --child-flash honors these)
+import os as _os
+
+_BLOCK_Q = int(_os.environ.get("MX_FLASH_BLOCK_Q", 256))
+_BLOCK_K = int(_os.environ.get("MX_FLASH_BLOCK_K", 256))
 
 
 def _on_tpu() -> bool:

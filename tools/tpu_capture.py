@@ -85,6 +85,35 @@ def _run_json_child(argv, tag):
         return None
 
 
+def flash_block_sweep():
+    """Child mode: sweep MX_FLASH_BLOCK_Q/K candidates on the live chip and
+    report TFLOP/s per config — the block-size tuning that interpret-mode
+    CPU runs cannot do (VMEM limits/Mosaic tiling only exist on hardware).
+    Each config runs in a SUBPROCESS because the env is read at import."""
+    import subprocess
+    results = {}
+    for bq, bk in ((128, 128), (128, 256), (256, 256), (256, 512),
+                   (512, 512)):
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env.pop("MX_FORCE_CPU", None)
+        env["MX_FLASH_BLOCK_Q"] = str(bq)
+        env["MX_FLASH_BLOCK_K"] = str(bk)
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--child-flash"],
+                env=env, timeout=600, cwd=REPO,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+            lines = [l for l in r.stdout.decode(errors="replace")
+                     .splitlines() if l.startswith("{")]
+            results["%dx%d" % (bq, bk)] = json.loads(lines[-1]) if lines                 else {"rc": r.returncode,
+                      "err": r.stderr.decode(errors="replace")[-400:]}
+        except subprocess.TimeoutExpired:
+            results["%dx%d" % (bq, bk)] = {"err": "timeout"}
+    print(json.dumps({"metric": "flash_block_sweep", "configs": results,
+                      "value": 0.0, "unit": "sweep"}))
+
+
 def flash_microbench():
     """Child mode: flash-attention fwd+bwd throughput on the live backend."""
     import jax
@@ -205,6 +234,9 @@ def capture():
     results["mosaic_smoke"] = _run_json_child(
         [sys.executable, os.path.abspath(__file__), "--child-mosaic"],
         "mosaic_smoke")
+    results["flash_block_sweep"] = _run_json_child(
+        [sys.executable, os.path.abspath(__file__), "--child-sweep"],
+        "flash_block_sweep")
     # bench.py --real-data synthesizes its own .rec pack — no data drop needed
     results["real_data_bench"] = _run_json_child(
         [sys.executable, os.path.join(REPO, "bench.py"), "--real-data"],
@@ -216,6 +248,9 @@ def capture():
 def main():
     if "--child-flash" in sys.argv:
         flash_microbench()
+        return
+    if "--child-sweep" in sys.argv:
+        flash_block_sweep()
         return
     if "--child-mosaic" in sys.argv:
         mosaic_smoke()
