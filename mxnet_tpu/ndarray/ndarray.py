@@ -1002,10 +1002,7 @@ _NDARRAY_V2_MAGIC = 0xF993FAC9
 _NDARRAY_V3_MAGIC = 0xF993FACA
 
 
-def _save_one(buf: bytearray, arr: NDArray) -> None:
-    a = arr.asnumpy()
-    buf += struct.pack("<I", _NDARRAY_V2_MAGIC)
-    buf += struct.pack("<i", 0)                       # kDefaultStorage
+def _write_dense_payload(buf: bytearray, a: _np.ndarray) -> None:
     buf += struct.pack("<I", a.ndim)
     for d in a.shape:
         buf += struct.pack("<I", d)
@@ -1020,6 +1017,39 @@ def _save_one(buf: bytearray, arr: NDArray) -> None:
         buf += a16.tobytes()
     else:
         buf += _np.ascontiguousarray(a).tobytes()
+
+
+def _save_one(buf: bytearray, arr) -> None:
+    # sparse stypes round-trip (reference NDArray::Save handles
+    # kRowSparseStorage=1 / kCSRStorage=2 with their aux arrays; byte
+    # layout here: stype, logical shape, n_aux, aux payloads..., data —
+    # self-consistent, unverifiable against reference bytes offline)
+    stype = getattr(arr, "stype", "default")
+    if stype == "row_sparse":
+        buf += struct.pack("<I", _NDARRAY_V2_MAGIC)
+        buf += struct.pack("<i", 1)
+        buf += struct.pack("<I", len(arr.shape))
+        for d in arr.shape:
+            buf += struct.pack("<I", d)
+        buf += struct.pack("<I", 1)                   # n aux
+        _write_dense_payload(buf, arr.indices.asnumpy().astype(_np.int64))
+        _write_dense_payload(buf, arr.data.asnumpy())
+        return
+    if stype == "csr":
+        buf += struct.pack("<I", _NDARRAY_V2_MAGIC)
+        buf += struct.pack("<i", 2)
+        buf += struct.pack("<I", len(arr.shape))
+        for d in arr.shape:
+            buf += struct.pack("<I", d)
+        buf += struct.pack("<I", 2)                   # n aux
+        _write_dense_payload(buf, arr.indptr.asnumpy().astype(_np.int64))
+        _write_dense_payload(buf, arr.indices.asnumpy().astype(_np.int64))
+        _write_dense_payload(buf, arr.data.asnumpy())
+        return
+    a = arr.asnumpy()
+    buf += struct.pack("<I", _NDARRAY_V2_MAGIC)
+    buf += struct.pack("<i", 0)                       # kDefaultStorage
+    _write_dense_payload(buf, a)
 
 
 class _Reader:
@@ -1038,20 +1068,10 @@ class _Reader:
         return b
 
 
-def _load_one(r: _Reader) -> NDArray:
-    magic = r.take("I")
-    if magic == _NDARRAY_V1_MAGIC:
-        ndim = r.take("I")
-        shape = tuple(int(r.take("I")) for _ in range(ndim))
-    elif magic in (_NDARRAY_V2_MAGIC, _NDARRAY_V3_MAGIC):
-        stype = r.take("i")
-        if stype != 0:
-            raise MXNetError("sparse ndarray load not supported yet (stype=%d)" % stype)
-        ndim = r.take("I")
-        shape = tuple(int(r.take("I")) for _ in range(ndim))
-    else:
-        raise MXNetError("invalid NDArray magic 0x%x" % magic)
-    devtype, devid = r.take("ii")
+def _read_dense_payload(r: "_Reader"):
+    ndim = r.take("I")
+    shape = tuple(int(r.take("I")) for _ in range(ndim))
+    r.take("ii")                                      # saved ctx
     flag = r.take("i")
     dtype = FLAG_TO_DTYPE[flag]
     count = 1
@@ -1060,10 +1080,49 @@ def _load_one(r: _Reader) -> NDArray:
     if flag == 12:
         raw = r.raw(count * 2)
         a = _np.frombuffer(raw, dtype=_np.uint16).reshape(shape)
-        val = jnp.asarray(a).view(jnp.bfloat16)
-        return NDArray(val, ctx=current_context())
-    a = _np.frombuffer(r.raw(count * dtype.itemsize), dtype=dtype).reshape(shape)
-    return array(a, dtype=a.dtype)
+        return jnp.asarray(a).view(jnp.bfloat16), True
+    a = _np.frombuffer(r.raw(count * dtype.itemsize),
+                       dtype=dtype).reshape(shape)
+    return a, False
+
+
+def _load_one(r: _Reader):
+    magic = r.take("I")
+    stype = 0
+    if magic == _NDARRAY_V1_MAGIC:
+        ndim = r.take("I")
+        shape = tuple(int(r.take("I")) for _ in range(ndim))
+        r.take("ii")
+        flag = r.take("i")
+        dtype = FLAG_TO_DTYPE[flag]
+        count = 1
+        for d in shape:
+            count *= d
+        a = _np.frombuffer(r.raw(count * dtype.itemsize),
+                           dtype=dtype).reshape(shape)
+        return array(a, dtype=a.dtype)
+    if magic not in (_NDARRAY_V2_MAGIC, _NDARRAY_V3_MAGIC):
+        raise MXNetError("invalid NDArray magic 0x%x" % magic)
+    stype = r.take("i")
+    if stype == 0:
+        val, is_bf16 = _read_dense_payload(r)
+        if is_bf16:
+            return NDArray(val, ctx=current_context())
+        return array(val, dtype=val.dtype)
+    # sparse: logical shape, n_aux, aux payloads..., data
+    from . import sparse as _sp
+    ndim = r.take("I")
+    shape = tuple(int(r.take("I")) for _ in range(ndim))
+    n_aux = r.take("I")
+    aux = [_read_dense_payload(r)[0] for _ in range(n_aux)]
+    data, _ = _read_dense_payload(r)
+    if stype == 1:                                    # row_sparse
+        return _sp.RowSparseNDArray(array(data),
+                                    array(_np.asarray(aux[0])), shape)
+    if stype == 2:                                    # csr
+        return _sp.CSRNDArray(array(data), array(_np.asarray(aux[1])),
+                              array(_np.asarray(aux[0])), shape)
+    raise MXNetError("unknown storage type %d in file" % stype)
 
 
 def save_bytes(data) -> bytes:

@@ -212,3 +212,28 @@ def test_dense_to_rsp_stays_on_device():
     rsp = g.tostype("row_sparse")
     np.testing.assert_array_equal(rsp.indices.asnumpy(), [3, 9])
     np.testing.assert_array_equal(rsp.asnumpy(), g.asnumpy())
+
+
+def test_sparse_save_load_roundtrip(tmp_path):
+    """nd.save/load preserve storage types (reference: NDArray::Save
+    writes kRowSparseStorage/kCSRStorage with their aux arrays — the old
+    behavior silently densified)."""
+    path = str(tmp_path / "sp.params")
+    csr = nd.sparse.csr_matrix((np.array([1.5, 2.5], np.float32),
+                                np.array([0, 2]), np.array([0, 1, 2])),
+                               shape=(2, 3))
+    rsp = nd.sparse.row_sparse_array((np.full((2, 3), 5.0, np.float32),
+                                      np.array([1, 4])), shape=(6, 3))
+    dense = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    nd.save(path, {"csr": csr, "rsp": rsp, "d": dense})
+    loaded = nd.load(path)
+    assert loaded["csr"].stype == "csr"
+    assert loaded["rsp"].stype == "row_sparse"
+    assert getattr(loaded["d"], "stype", "default") == "default"
+    np.testing.assert_allclose(loaded["csr"].tostype("default").asnumpy(),
+                               csr.tostype("default").asnumpy())
+    np.testing.assert_allclose(loaded["rsp"].tostype("default").asnumpy(),
+                               rsp.tostype("default").asnumpy())
+    np.testing.assert_array_equal(loaded["csr"].indptr.asnumpy(),
+                                  [0, 1, 2])
+    np.testing.assert_array_equal(loaded["rsp"].indices.asnumpy(), [1, 4])
