@@ -218,6 +218,7 @@ def test_sparse_save_load_roundtrip(tmp_path):
     """nd.save/load preserve storage types (reference: NDArray::Save
     writes kRowSparseStorage/kCSRStorage with their aux arrays — the old
     behavior silently densified)."""
+    from mxnet_tpu import nd
     path = str(tmp_path / "sp.params")
     csr = nd.sparse.csr_matrix((np.array([1.5, 2.5], np.float32),
                                 np.array([0, 2]), np.array([0, 1, 2])),
