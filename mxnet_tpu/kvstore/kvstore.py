@@ -477,6 +477,17 @@ class KVStoreDistAsync(KVStore):
             for t in targets:
                 t._set_jax(nd.array(arr).astype(t.dtype)._jax)
 
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull CURRENT server rows (the base implementation reads the
+        local init-time mirror, which a server-side optimizer has long
+        moved past)."""
+        keys, outs = self._normalize(key, out)
+        for k in keys:
+            arr = self._rpc("PULL", k)
+            self._store[k] = nd.array(arr)     # refresh mirror, then gather
+        return super().row_sparse_pull(key, out=out, priority=priority,
+                                       row_ids=row_ids)
+
     def set_optimizer(self, optimizer):
         """Ship the optimizer to the server (reference: the pickled
         set_optimizer controller message).  The server keeps the FIRST

@@ -352,6 +352,18 @@ def test_dist_async_parameter_server(tmp_path):
         kv._barrier()
         kv.pull("w", out=out)
         np.testing.assert_allclose(out.asnumpy(), 1.0 - 0.5 * 2)
+
+        # row_sparse_pull must see CURRENT server state, not the
+        # init-time mirror
+        from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+        kv.init("emb", nd.ones((6, 2)))
+        kv.push("emb", nd.array(np.ones((6, 2), np.float32)))
+        kv._barrier()
+        tgt = nd.sparse.row_sparse_array(
+            (np.zeros((2, 2), np.float32), np.array([1, 4])), shape=(6, 2))
+        kv.row_sparse_pull("emb", out=tgt, row_ids=nd.array([1, 4]))
+        got = tgt.data.asnumpy()
+        assert not np.allclose(got, 1.0), got   # moved off the init value
         print("DIST_ASYNC_OK rank", rank, flush=True)
     """))
     env = dict(os.environ)
