@@ -38,6 +38,6 @@ class AlexNet(HybridBlock):
 def alexnet(pretrained=False, ctx=None, root=None, **kwargs):
     net = AlexNet(**kwargs)
     if pretrained:
-        raise ValueError("pretrained weights require local files; call "
-                         "net.load_parameters(path) instead (no egress)")
+        from ..model_store import load_pretrained
+        load_pretrained(net, "alexnet", root, ctx)
     return net

@@ -82,7 +82,8 @@ densenet_spec = {121: (64, 32, [6, 12, 24, 16]),
 
 def _get(num_layers, pretrained, **kwargs):
     if pretrained:
-        raise ValueError("pretrained weights require local files")
+        from ..model_store import load_pretrained
+        load_pretrained(net, "densenet%d" % num_layers, root, ctx)
     init_f, growth, config = densenet_spec[num_layers]
     return DenseNet(init_f, growth, config, **kwargs)
 

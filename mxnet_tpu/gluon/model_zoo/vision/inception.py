@@ -122,5 +122,7 @@ class Inception3(HybridBlock):
 
 def inception_v3(pretrained=False, **kwargs):
     if pretrained:
-        raise ValueError("pretrained weights require local files")
+        from ..model_store import load_pretrained
+        load_pretrained(net, "inceptionv3", kwargs.get("root"),
+                        kwargs.get("ctx"))
     return Inception3(**kwargs)

