@@ -53,3 +53,23 @@ def test_word_lm_example():
                "--embed", "16", "--vocab", "200")
     assert "Train-perplexity=" in out
     assert "final train perplexity" in out
+
+
+def test_dist_async_example():
+    """PS workflow example: 1 server + 2 workers converge async."""
+    import subprocess
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([sys.executable,
+                        os.path.join(REPO, "tools", "launch.py"),
+                        "-n", "2", "-s", "1", "--launcher", "local", "--",
+                        sys.executable,
+                        os.path.join(REPO, "examples",
+                                     "train_dist_async.py"),
+                        "--steps", "25"],
+                       capture_output=True, text=True, timeout=420, env=env)
+    assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-1500:])
+    finals = [float(l.split("loss")[1].split("(")[0])
+              for l in r.stdout.splitlines() if "FINAL" in l]
+    assert len(finals) == 2
+    assert all(v < 1.0 for v in finals), finals
