@@ -21,7 +21,8 @@ XLA collectives.
     equivalent: a real parameter server (kvstore/server.py over TCP)
     applies every worker's push immediately, no barriers — reference
     kvstore_dist_server.h DataHandleEx async semantics.  Launch with
-    ``tools/launch.py -n W -s 1``.
+    ``tools/launch.py -n W -s S`` — keys hash-shard across the S
+    servers (MX_PS_ROOTS).
 """
 from __future__ import annotations
 
@@ -385,37 +386,53 @@ def _ps_addr():
     return addr
 
 
+def _ps_addrs():
+    """ALL server addresses (MX_PS_ROOTS, comma-separated) — keys shard
+    across them by hash (reference: kvstore_dist.h key->server
+    assignment + MXNET_KVSTORE_BIGARRAY_BOUND sharding role)."""
+    import os
+    roots = os.environ.get("MX_PS_ROOTS")
+    if roots:
+        return [a.strip() for a in roots.split(",") if a.strip()]
+    one = _ps_addr()
+    return [one] if one else []
+
+
 class KVStoreDistAsync(KVStore):
     """Async parameter-server store (reference: KVStoreDist with
     dist_async — src/kvstore/kvstore_dist_server.h DataHandleEx async
     path): each worker's push is applied by the server THE MOMENT it
     arrives (server-side optimizer), pulls return whatever is current,
-    and workers never wait for each other.  Server address from
-    MX_PS_ROOT (set by tools/launch.py -s 1)."""
+    and workers never wait for each other.  Server addresses from
+    MX_PS_ROOTS (tools/launch.py -s N; keys hash-shard across servers)
+    or MX_PS_ROOT (single server)."""
 
     def __init__(self):
         super().__init__()
         import os
         from . import server as _srv
         self._srv_mod = _srv
-        addr = _ps_addr()
-        if not addr:
+        addrs = _ps_addrs()
+        if not addrs:
             raise MXNetError(
                 "kvstore 'dist_async' needs a parameter server: launch "
-                "with tools/launch.py -n <workers> -s 1 (MX_PS_ROOT unset)")
-        host, port = addr.rsplit(":", 1)
+                "with tools/launch.py -n <workers> -s <servers> "
+                "(MX_PS_ROOTS/MX_PS_ROOT unset)")
         import socket
         import time as _time
-        deadline = _time.time() + 60
-        while True:     # the launcher starts the server concurrently:
-            try:        # retry until it binds (ps-lite scheduler role)
-                self._sock = socket.create_connection((host, int(port)),
-                                                      timeout=120)
-                break
-            except (ConnectionRefusedError, OSError):
-                if _time.time() > deadline:
-                    raise
-                _time.sleep(0.2)
+        self._socks = []
+        for addr in addrs:
+            host, port = addr.rsplit(":", 1)
+            deadline = _time.time() + 60
+            while True:  # the launcher starts servers concurrently:
+                try:     # retry until each binds (ps-lite scheduler role)
+                    self._socks.append(socket.create_connection(
+                        (host, int(port)), timeout=120))
+                    break
+                except (ConnectionRefusedError, OSError):
+                    if _time.time() > deadline:
+                        raise
+                    _time.sleep(0.2)
         self._lock = __import__("threading").Lock()
         self._rank = int(os.environ.get("MX_PROCESS_ID",
                                         os.environ.get("DMLC_WORKER_ID", 0)))
@@ -435,25 +452,51 @@ class KVStoreDistAsync(KVStore):
     def num_workers(self):
         return self._size
 
-    def _rpc(self, *msg):
+    def _server_of(self, key) -> int:
+        """key -> server index (stable hash; reference key->server
+        assignment)."""
+        import zlib
+        return zlib.crc32(str(key).encode()) % len(self._socks)
+
+    def _rpc_on(self, idx, *msg):
         import socket as _socket
         with self._lock:
-            if self._sock is None:
-                raise MXNetError("dist_async connection is closed (a prior "
-                                 "RPC timed out; the stream cannot resync)")
+            sock = self._socks[idx]
+            if sock is None:
+                raise MXNetError("dist_async connection %d is closed (a "
+                                 "prior RPC timed out; the stream cannot "
+                                 "resync)" % idx)
             try:
-                self._srv_mod.send_msg(self._sock, msg)
-                ok, payload = self._srv_mod.recv_msg(self._sock)
+                self._srv_mod.send_msg(sock, msg)
+                ok, payload = self._srv_mod.recv_msg(sock)
             except (_socket.timeout, TimeoutError):
                 # a late reply would desync every later request/response
                 # pair: poison the connection instead of misreading it
-                self._sock.close()
-                self._sock = None
-                raise MXNetError("dist_async server did not answer %r "
-                                 "within the socket timeout" % (msg[0],))
+                sock.close()
+                self._socks[idx] = None
+                raise MXNetError("dist_async server %d did not answer %r "
+                                 "within the socket timeout"
+                                 % (idx, msg[0]))
         if not ok:
             raise MXNetError("dist_async server: %s" % payload)
         return payload
+
+    def _rpc(self, *msg):
+        """Route by key for data commands; controller commands go wider
+        (SET_OPT to every server, BARRIER to server 0)."""
+        cmd = msg[0]
+        if cmd in ("INIT", "PUSH", "PULL"):
+            return self._rpc_on(self._server_of(msg[1]), *msg)
+        if cmd in ("SET_OPT", "STOP"):
+            # controller fan-out: every server installs the optimizer /
+            # shuts down (a STOP reaching only server 0 would leak the
+            # rest as live processes on manual multi-host deployments)
+            out = None
+            for i in range(len(self._socks)):
+                if self._socks[i] is not None:
+                    out = self._rpc_on(i, *msg)
+            return out
+        return self._rpc_on(0, *msg)        # BARRIER
 
     def init(self, key, value):
         keys, values = self._normalize(key, value)
@@ -536,8 +579,8 @@ def create(name: str = "local") -> KVStore:
         # a tracker (here multi-process jobs still work, just synchronously)
         import warnings
         warnings.warn("kvstore 'dist_async' requested without a parameter "
-                      "server (launch with tools/launch.py -s 1); using "
-                      "the synchronous collective store instead")
+                      "server (launch with tools/launch.py -s <servers>); "
+                      "using the synchronous collective store instead")
         return KVStoreICI()
     if key not in _STORES:
         raise MXNetError("unknown KVStore type %r (have %s)"

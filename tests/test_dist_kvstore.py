@@ -388,3 +388,44 @@ def test_dist_async_without_server_degrades_loudly(tmp_path):
         kv = kvstore.create("dist_async")
     assert any("parameter server" in str(x.message) for x in w)
     assert kv.type == "ici"
+
+
+def test_dist_async_two_servers_key_sharding(tmp_path):
+    """-s 2: keys hash-shard across two PS processes; every key's
+    init/push/pull routes to the same server and values stay correct."""
+    import textwrap as tw
+    script = tmp_path / "w.py"
+    script.write_text(tw.dedent(_PRELUDE) + tw.dedent("""
+        from mxnet_tpu import kvstore, optimizer
+        kv = kvstore.create("dist_async")
+        assert len(kv._socks) == 2, len(kv._socks)
+        rank = kv.rank
+        kv.set_optimizer(optimizer.SGD(learning_rate=0.5))
+        # enough keys to land on both servers
+        keys = list(range(8))
+        servers = {k: kv._server_of(k) for k in keys}
+        assert set(servers.values()) == {0, 1}, servers
+        for k in keys:
+            kv.init(k, nd.ones((3,)) * (k + 1))
+        kv._barrier()
+        for k in keys:
+            kv.push(k, nd.array(np.full(3, 2.0, np.float32)))
+        kv._barrier()
+        for k in keys:
+            out = nd.zeros((3,))
+            kv.pull(k, out=out)
+            # init (k+1) minus 0.5*2.0 per push, 2 workers
+            np.testing.assert_allclose(out.asnumpy(), (k + 1) - 2.0,
+                                       rtol=1e-6)
+        print("SHARDED_PS_OK rank", rank, flush=True)
+    """))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([sys.executable,
+                        os.path.join(REPO, "tools", "launch.py"),
+                        "-n", "2", "-s", "2", "--launcher", "local", "--",
+                        sys.executable, str(script)],
+                       capture_output=True, text=True, timeout=300,
+                       env=env)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert r.stdout.count("SHARDED_PS_OK") == 2

@@ -56,26 +56,19 @@ def _env_for(rank: int, coordinator: str, n: int):
 def launch_local(args, command):
     coordinator = "127.0.0.1:%d" % _free_port()
     server_procs = []
-    ps_root = None
-    if getattr(args, "num_servers", 0) > 1:
-        print("launch.py: only ONE parameter server is implemented; "
-              "-s %d capped to 1 (keys are not sharded across servers)"
-              % args.num_servers, file=sys.stderr)
-        args.num_servers = 1
+    ps_roots = []
     if getattr(args, "num_servers", 0) > 0:
         # dist_async parameter server(s) (reference: tracker starting
-        # DMLC_ROLE=server processes); one port per server, workers get
-        # MX_PS_ROOT pointing at server 0
-        ps_port = _free_port()
-        ps_root = "127.0.0.1:%d" % ps_port
+        # DMLC_ROLE=server processes); with -s N keys shard across the N
+        # servers by hash (kvstore_dist.h key->server assignment role)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         for s in range(args.num_servers):
+            port = _free_port()
+            ps_roots.append("127.0.0.1:%d" % port)
             env = dict(os.environ)
-            repo = os.path.dirname(os.path.dirname(os.path.abspath(
-                __file__)))
             env.update({"DMLC_ROLE": "server",
                         "DMLC_NUM_WORKER": str(args.num_workers),
-                        "MX_PS_PORT": str(ps_port if s == 0
-                                          else _free_port()),
+                        "MX_PS_PORT": str(port),
                         "MX_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
                         "PYTHONPATH": repo + os.pathsep +
                         env.get("PYTHONPATH", "")})
@@ -85,10 +78,12 @@ def launch_local(args, command):
     procs = []
     for rank in range(args.num_workers):
         env = _env_for(rank, coordinator, args.num_workers)
-        if ps_root:
-            env["MX_PS_ROOT"] = ps_root
-            env["DMLC_PS_ROOT_URI"] = ps_root.split(":")[0]
-            env["DMLC_PS_ROOT_PORT"] = ps_root.split(":")[1]
+        if ps_roots:
+            env["MX_PS_ROOT"] = ps_roots[0]
+            env["MX_PS_ROOTS"] = ",".join(ps_roots)
+            env["DMLC_PS_ROOT_URI"] = ps_roots[0].split(":")[0]
+            env["DMLC_PS_ROOT_PORT"] = ps_roots[0].split(":")[1]
+            env["DMLC_NUM_SERVER"] = str(len(ps_roots))
         procs.append(subprocess.Popen(command, env=env))
     rc = 0
     for p in procs:
