@@ -52,6 +52,23 @@ ENV_CATALOG: Dict[str, Any] = {
     "MXNET_ENFORCE_DETERMINISM": ("0", "Force deterministic kernels."),
     "MXNET_SAFE_ACCUMULATION": ("1", "Accumulate reductions in fp32 even for fp16/bf16 inputs."),
     "MXNET_DEFAULT_DTYPE": ("float32", "Default dtype for array creation."),
+    # rebuild-specific flags (SURVEY §5.6: env vars are the de-facto flag
+    # system; this catalog is the canonical doc source — docs/ENV_VARS.md
+    # is generated from it by tools/gen_env_docs.py)
+    "MX_FORCE_CPU": ("0", "Pin the CPU backend: mx.tpu(i) resolves to host devices and nothing touches the accelerator tunnel (tests, data workers)."),
+    "MX_TEST_CTX": ("", "'tpu' switches the pytest lane to the real chip as default context (conftest probes the tunnel first)."),
+    "MX_DATA_DIR": ("", "Root of real-dataset drops (mnist/, ptb/): arms tests/test_real_data.py and the examples' real-data paths."),
+    "MX_PRETRAINED_DIR": ("~/.mxnet/models", "Local weight store scanned by model_zoo get_model(..., pretrained=True)."),
+    "MX_COORDINATOR": ("", "host:port of process 0 for jax.distributed (set by tools/launch.py)."),
+    "MX_NUM_PROCESSES": ("", "Process-group size for jax.distributed (launcher-set)."),
+    "MX_PROCESS_ID": ("", "This process's rank (launcher-set)."),
+    "MX_INIT_TIMEOUT": ("", "Seconds to bound the jax.distributed coordinator handshake (fail-fast + retry instead of hanging)."),
+    "MX_PS_ROOT": ("", "dist_async parameter-server address host:port (single server)."),
+    "MX_PS_ROOTS": ("", "Comma-separated PS addresses; keys hash-shard across them (launch.py -s N)."),
+    "MX_PS_PORT": ("9600", "Port a kvstore server process binds (DMLC_ROLE=server)."),
+    "MX_FLASH_BLOCK_Q": ("256", "Pallas flash-attention query-block rows (VMEM tiling knob; sweepable on hardware)."),
+    "MX_FLASH_BLOCK_K": ("256", "Pallas flash-attention key-block rows."),
+    "MX_NO_CAPTURE_FALLBACK": ("0", "bench.py: never replay a TPU capture (the capture loop's own children set this)."),
 }
 
 

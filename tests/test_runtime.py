@@ -177,3 +177,12 @@ def test_standing_tools_exit_clean():
         [sys.executable, os.path.join(REPO, "tools", "op_inventory.py")],
         capture_output=True, text=True, timeout=300).stdout)
     assert rec["ours"]["unique_impls"] >= 700
+
+
+def test_env_docs_in_sync():
+    """docs/ENV_VARS.md is generated from ENV_CATALOG; adding a flag
+    without regenerating (tools/gen_env_docs.py) fails here."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import gen_env_docs
+    with open(os.path.join(REPO, "docs", "ENV_VARS.md")) as f:
+        assert f.read() == gen_env_docs.render()
