@@ -978,3 +978,65 @@ _reg("_npi_multi_dot", _npi_multi_dot)
 _reg("_npi_tensorsolve", _npi_tensorsolve)
 _reg("_npi_tensorinv", _npi_tensorinv)
 _reg("_npi_cond", _npi_cond, differentiable=False)
+
+
+# ---------------------------------------------------------------------------
+# 2.x symbol.json name parity: graphs serialized by the numpy-era reference
+# carry _npi_* node op names for ops whose semantics our existing kernels
+# already implement — pure ALIASES (no new impls), so loaded symbols
+# resolve (symbol.py looks nodes up by registry name).
+# ---------------------------------------------------------------------------
+
+from .registry import alias as _alias
+
+for _existing, _npi_names in [
+        ("diag", ["_npi_diag"]),
+        ("tril", ["_npi_tril"]),
+        ("triu", ["_npi_triu"]),
+        ("_eye", ["_npi_eye"]),
+        ("_arange", ["_npi_arange"]),
+        ("_zeros", ["_npi_zeros"]),
+        ("_ones", ["_npi_ones"]),
+        ("_full", ["_npi_full"]),
+        ("_linspace", ["_npi_linspace"]),
+        ("zeros_like_op", ["_npi_zeros_like"]),
+        ("ones_like_op", ["_npi_ones_like"]),
+        ("kron", ["_npi_kron"]),
+        ("cross", ["_npi_cross"]),
+        ("diagonal", ["_npi_diagonal"]),
+        ("one_hot", ["_npi_one_hot"]),
+        ("boolean_mask", ["_npi_boolean_mask"]),
+        ("atleast_1d", ["_npi_atleast_1d"]),
+        ("atleast_2d", ["_npi_atleast_2d"]),
+        ("atleast_3d", ["_npi_atleast_3d"]),
+        ("logsumexp", ["_npi_logsumexp"]),
+        ("histogram", ["_npx_histogram"]),
+        ("topk", ["_npx_topk"]),
+        ("pick", ["_npx_pick"]),
+        ("gather_nd", ["_npi_gather_nd", "_npx_gather_nd"]),
+        ("scatter_nd", ["_npi_scatter_nd"]),
+        ("sequence_mask", ["_npx_sequence_mask"]),
+        ("shape_array", ["_npx_shape_array"]),
+        ("Activation", ["_npx_activation"]),
+        ("BatchNorm", ["_npx_batch_norm"]),
+        ("Convolution", ["_npx_convolution"]),
+        ("Deconvolution", ["_npx_deconvolution"]),
+        ("Pooling", ["_npx_pooling"]),
+        ("FullyConnected", ["_npx_fully_connected"]),
+        ("Embedding", ["_npx_embedding"]),
+        ("Dropout", ["_npx_dropout"]),
+        ("LayerNorm", ["_npx_layer_norm"]),
+        ("GroupNorm", ["_npx_group_norm"]),
+        ("softmax", ["_npx_softmax"]),
+        ("log_softmax", ["_npx_log_softmax"]),
+        ("masked_softmax", ["_npx_masked_softmax"]),
+        ("relu", ["_npx_relu"]),
+        ("sigmoid", ["_npx_sigmoid"]),
+        ("RNN", ["_npx_rnn"]),
+        ("reshape", ["_npx_reshape"]),
+        ("arange_like", ["_npi_arange_like"]),
+        ("broadcast_like", ["_npi_broadcast_like"])]:
+    try:
+        _alias(_existing, *_npi_names)
+    except KeyError:
+        pass   # alias table is best-effort across op-set evolution
