@@ -2105,3 +2105,33 @@ def test_hawkesll_matches_slow_reference():
         comp += alpha[k] * (1 - np.exp(-beta[k] * (tmax[0] - times[i])))
     ll_ref -= comp
     np.testing.assert_allclose(float(ll.asnumpy()[0]), ll_ref, rtol=1e-4)
+
+
+def test_npi_symbol_json_name_parity():
+    """A 2.x-era symbol.json whose nodes use _npi_/_npx_ op names loads
+    and executes through the registry aliases (numpy-era graph compat)."""
+    import json as _json
+    sym_json = _json.dumps({
+        "nodes": [
+            {"op": "null", "name": "data", "inputs": []},
+            {"op": "null", "name": "w", "inputs": []},
+            {"op": "_npx_fully_connected", "name": "fc",
+             "attrs": {"num_hidden": "3", "no_bias": "True"},
+             "inputs": [[0, 0, 0], [1, 0, 0]]},
+            {"op": "_npx_relu", "name": "act", "inputs": [[2, 0, 0]]},
+            {"op": "_npi_add", "name": "out",
+             "inputs": [[3, 0, 0], [3, 0, 0]]},
+        ],
+        "arg_nodes": [0, 1],
+        "node_row_ptr": [0, 1, 2, 3, 4, 5],
+        "heads": [[4, 0, 0]],
+        "attrs": {"mxnet_version": ["int", 20000]},
+    })
+    import mxnet_tpu as mx
+    s = mx.sym.loads(sym_json)
+    x = f(2, 4)
+    w = f(3, 4)
+    exe = s.bind(mx.cpu(), {"data": nd.array(x), "w": nd.array(w)})
+    got = exe.forward()[0].asnumpy()
+    want = 2 * np.maximum(x @ w.T, 0)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
