@@ -36,6 +36,14 @@ import os as _os
 _BLOCK_Q = int(_os.environ.get("MX_FLASH_BLOCK_Q", 256))
 _BLOCK_K = int(_os.environ.get("MX_FLASH_BLOCK_K", 256))
 
+# Mosaic requires the last two dims of every block to be (8k, 128k) or
+# equal to the full array dims — a rank-2 (BH, T) residual with a
+# squeezed-BH block violates that.  The LSE therefore rides with a small
+# trailing lane dim (all lanes duplicate the value); 8 = one sublane's
+# width, and 8 == the full array dim satisfies the lowering rule while
+# costing 8x (not 128x) the compact residual's HBM.
+_LSE_LANES = 8
+
 
 def _on_tpu() -> bool:
     try:
@@ -137,8 +145,8 @@ def _sds(shape, dtype, like):
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
                       block_k, seq_k):
-    # refs: q (block_q, D), k/v (seq_k, D), o (block_q, D), lse (block_q,);
-    # grid=(BH, Tq/bq)
+    # refs: q (block_q, D), k/v (seq_k, D), o (block_q, D),
+    # lse (block_q, _LSE_LANES) — lanes duplicate the value; grid=(BH, Tq/bq)
     import jax.experimental.pallas as pl
 
     block_q, d = q_ref.shape
@@ -189,12 +197,14 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
                     jnp.where(jnp.isfinite(m), m, 0.0)
                     + jnp.log(jnp.maximum(l, 1e-30)),
                     -jnp.inf)
-    lse_ref[:] = lse[:, 0]
+    lse_ref[:] = jnp.broadcast_to(lse, (block_q, _LSE_LANES))
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q=_BLOCK_Q, block_k=_BLOCK_K):
-    """q,k,v: (B, H, T, D) with T % block == 0.  Returns (out, lse) with
-    lse (B, H, Tq) — the backward's recompute residual."""
+def _flash_fwd_res(q, k, v, scale, causal, block_q=_BLOCK_Q,
+                   block_k=_BLOCK_K):
+    """q,k,v: (B, H, T, D) with T % block == 0.  Returns (out, lse_lanes)
+    with lse_lanes (B*H, Tq, _LSE_LANES) fp32 — the laned residual the
+    backward kernels consume directly (no rebroadcast on the bwd path)."""
     import jax.experimental.pallas as pl
 
     B, H, Tq, D = q.shape
@@ -204,7 +214,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q=_BLOCK_Q, block_k=_BLOCK_K):
     vr = v.reshape(B * H, Tk, D)
     kernel = functools.partial(_flash_fwd_kernel, scale=scale, causal=causal,
                                block_k=block_k, seq_k=Tk)
-    out, lse = pl.pallas_call(
+    out, lse_lanes = pl.pallas_call(
         kernel,
         interpret=_interpret(),
         grid=(B * H, Tq // block_q),
@@ -215,14 +225,26 @@ def _flash_fwd(q, k, v, scale, causal, block_q=_BLOCK_Q, block_k=_BLOCK_K):
         ],
         out_specs=[
             pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((None, block_q, _LSE_LANES), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             _sds((B * H, Tq, D), q.dtype, qr),
-            _sds((B * H, Tq), jnp.float32, qr),
+            _sds((B * H, Tq, _LSE_LANES), jnp.float32, qr),
         ],
     )(qr, kr, vr)
-    return out.reshape(B, H, Tq, D), lse.reshape(B, H, Tq)
+    return out.reshape(B, H, Tq, D), lse_lanes
+
+
+def _lse_from_lanes(lse_lanes, B, H, Tq):
+    """(B*H, Tq, _LSE_LANES) laned residual -> public (B, H, Tq)."""
+    return lse_lanes[:, :, 0].reshape(B, H, Tq)
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q=_BLOCK_Q, block_k=_BLOCK_K):
+    """Public-shape wrapper: returns (out, lse) with lse (B, H, Tq)."""
+    B, H, Tq, _ = q.shape
+    out, lse_lanes = _flash_fwd_res(q, k, v, scale, causal, block_q, block_k)
+    return out, _lse_from_lanes(lse_lanes, B, H, Tq)
 
 
 # ---------------------------------------------------------------------------
@@ -233,15 +255,19 @@ def _flash_fwd(q, k, v, scale, causal, block_q=_BLOCK_Q, block_k=_BLOCK_K):
 # ---------------------------------------------------------------------------
 
 
-def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
                          dq_ref, *, scale, causal, block_k, seq_k):
     import jax.experimental.pallas as pl
 
     block_q, d = q_ref.shape
     q = q_ref[:].astype(jnp.float32)
     do = do_ref[:].astype(jnp.float32)
-    lse = lse_ref[:].astype(jnp.float32)[:, None]
-    delta = delta_ref[:].astype(jnp.float32)[:, None]
+    # lanes all duplicate the value; a lane-reduce recovers (block_q, 1)
+    lse = jnp.max(lse_ref[:], axis=-1, keepdims=True)
+    # softmax-jacobian row term, computed in-kernel (saves a (BH, T)
+    # residual array + its laned rebroadcast)
+    delta = jnp.sum(do * o_ref[:].astype(jnp.float32), axis=-1,
+                    keepdims=True)
     q_idx = pl.program_id(1)
     lse_safe = jnp.where(jnp.isfinite(lse), lse, 0.0)
 
@@ -273,7 +299,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dq_ref[:] = (dq * scale).astype(dq_ref.dtype)
 
 
-def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
                           dk_ref, dv_ref, *, scale, causal, block_q, seq_q):
     import jax.experimental.pallas as pl
 
@@ -289,10 +315,11 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         q_blk = q_ref[pl.dslice(qb * block_q, block_q), :].astype(jnp.float32)
         do_blk = do_ref[pl.dslice(qb * block_q, block_q), :].astype(
             jnp.float32)
-        lse = lse_ref[pl.dslice(qb * block_q, block_q)].astype(
-            jnp.float32)[:, None]
-        delta = delta_ref[pl.dslice(qb * block_q, block_q)].astype(
-            jnp.float32)[:, None]
+        lse = jnp.max(lse_ref[pl.dslice(qb * block_q, block_q), :],
+                      axis=-1, keepdims=True)
+        delta = jnp.sum(
+            do_blk * o_ref[pl.dslice(qb * block_q, block_q), :].astype(
+                jnp.float32), axis=-1, keepdims=True)
         lse_safe = jnp.where(jnp.isfinite(lse), lse, 0.0)
         s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
@@ -323,8 +350,10 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[:] = dv.astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, o, lse, g, scale, causal,
+def _flash_bwd(q, k, v, o, lse_lanes, g, scale, causal,
                block_q=_BLOCK_Q, block_k=_BLOCK_K):
+    """lse_lanes: (B*H, Tq, _LSE_LANES) fp32 as produced by
+    _flash_fwd_res; delta is recomputed in-kernel from o/do blocks."""
     import jax.experimental.pallas as pl
 
     B, H, Tq, D = q.shape
@@ -332,11 +361,8 @@ def _flash_bwd(q, k, v, o, lse, g, scale, causal,
     qr = q.reshape(B * H, Tq, D)
     kr = k.reshape(B * H, Tk, D)
     vr = v.reshape(B * H, Tk, D)
+    outr = o.reshape(B * H, Tq, D)
     gr = g.reshape(B * H, Tq, D)
-    lser = lse.reshape(B * H, Tq)
-    # softmax-jacobian row term; O(T*D) elementwise — fused by XLA
-    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1).reshape(B * H, Tq)
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, scale=scale, causal=causal,
@@ -348,12 +374,12 @@ def _flash_bwd(q, k, v, o, lse, g, scale, causal,
             pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
-            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, _LSE_LANES), lambda b, i: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
         out_shape=_sds((B * H, Tq, D), q.dtype, qr),
-    )(qr, kr, vr, gr, lser, delta)
+    )(qr, kr, vr, outr, gr, lse_lanes)
 
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, scale=scale, causal=causal,
@@ -365,8 +391,8 @@ def _flash_bwd(q, k, v, o, lse, g, scale, causal,
             pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((None, Tq, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, Tq), lambda b, i: (b, 0)),
-            pl.BlockSpec((None, Tq), lambda b, i: (b, 0)),
+            pl.BlockSpec((None, Tq, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, Tq, _LSE_LANES), lambda b, i: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0)),
@@ -376,7 +402,7 @@ def _flash_bwd(q, k, v, o, lse, g, scale, causal,
             _sds((B * H, Tk, D), k.dtype, qr),
             _sds((B * H, Tk, D), v.dtype, qr),
         ],
-    )(qr, kr, vr, gr, lser, delta)
+    )(qr, kr, vr, outr, gr, lse_lanes)
 
     return (dq.reshape(B, H, Tq, D), dk.reshape(B, H, Tk, D),
             dv.reshape(B, H, Tk, D))
@@ -396,31 +422,36 @@ def flash_attention_with_lse(q, k, v, scale, causal):
 def _flash_lse_vjp_fwd(q, k, v, scale, causal):
     # symbolic_zeros=True wraps primals in CustomVJPPrimal
     q, k, v = (x.value if hasattr(x, "value") else x for x in (q, k, v))
-    out, lse = _flash_fwd(q, k, v, scale, causal)
-    return (out, lse), (q, k, v, out, lse)
+    B, H, Tq, _ = q.shape
+    out, lse_lanes = _flash_fwd_res(q, k, v, scale, causal)
+    return (out, _lse_from_lanes(lse_lanes, B, H, Tq)), (q, k, v, out,
+                                                         lse_lanes)
 
 
 def _flash_lse_vjp_bwd(scale, causal, res, cts):
     from jax.custom_derivatives import SymbolicZero
     g_out, g_lse = cts
-    q, k, v, o, lse = res
+    q, k, v, o, lse_lanes = res
+    B, H, Tq, _ = q.shape
     if isinstance(g_out, SymbolicZero):
         # out unused downstream: no kernel passes needed for its term
         dq = jnp.zeros(q.shape, q.dtype)
         dk = jnp.zeros(k.shape, k.dtype)
         dv = jnp.zeros(v.shape, v.dtype)
     else:
-        dq, dk, dv = _flash_bwd(q, k, v, o, lse, g_out, scale, causal)
+        dq, dk, dv = _flash_bwd(q, k, v, o, lse_lanes, g_out, scale,
+                                causal)
     if not isinstance(g_lse, SymbolicZero):
         # the lse term costs one extra fwd + one bwd kernel pass — the
         # symbolic-zero gate skips it when only `out` was used downstream
+        lse = _lse_from_lanes(lse_lanes, B, H, Tq)
         gl = jnp.where(jnp.isfinite(lse), g_lse, 0.0)[..., None]
         pk = _flash_fwd(q, k, k.astype(q.dtype), scale, causal)[0]
         dq = (dq.astype(jnp.float32)
               + scale * gl * pk.astype(jnp.float32)).astype(dq.dtype)
         g2 = (gl * q.astype(jnp.float32)).astype(q.dtype)
         _, _, dk2 = _flash_bwd(q, k, jnp.zeros_like(v), jnp.zeros_like(o),
-                               lse, g2, scale, causal)
+                               lse_lanes, g2, scale, causal)
         dk = (dk.astype(jnp.float32)
               + scale * dk2.astype(jnp.float32)).astype(dk.dtype)
     return dq, dk, dv
@@ -437,15 +468,15 @@ def flash_attention(q, k, v, scale, causal):
 
 
 def _flash_vjp_fwd(q, k, v, scale, causal):
-    out, lse = _flash_fwd(q, k, v, scale, causal)
-    return out, (q, k, v, out, lse)
+    out, lse_lanes = _flash_fwd_res(q, k, v, scale, causal)
+    return out, (q, k, v, out, lse_lanes)
 
 
 def _flash_vjp_bwd(scale, causal, res, g):
     # blockwise Pallas backward: O(L) memory (recompute-from-LSE), never
     # building the T×T score matrix the old jnp rematerialization needed
-    q, k, v, o, lse = res
-    return _flash_bwd(q, k, v, o, lse, g, scale, causal)
+    q, k, v, o, lse_lanes = res
+    return _flash_bwd(q, k, v, o, lse_lanes, g, scale, causal)
 
 
 flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
