@@ -303,7 +303,8 @@ def _average(a, weights=None, axis=None):
     return jnp.average(a, axis=ax, weights=weights)
 
 
-@register("histogram", differentiable=False, num_outputs=2)
+@register("histogram", aliases=["_histogram"],
+          differentiable=False, num_outputs=2)
 def _histogram(data, bin_cnt=10, range=None):
     """Reference: src/operator/tensor/histogram.cc. Static-shape: fixed
     bin_cnt; returns (counts, bin_edges)."""

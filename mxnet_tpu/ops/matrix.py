@@ -146,7 +146,7 @@ def _split(x, num_outputs=2, axis=1, squeeze_axis=False):
     return tuple(parts)
 
 
-@register("split_v2", num_outputs=0)
+@register("split_v2", aliases=["_split_v2"], num_outputs=0)
 def _split_v2(x, indices=(), axis=0, squeeze_axis=False, sections=0):
     if sections:
         parts = jnp.split(x, sections, axis=axis)
@@ -157,13 +157,14 @@ def _split_v2(x, indices=(), axis=0, squeeze_axis=False, sections=0):
     return tuple(parts)
 
 
+def _idx_slices(begin, end, step):
+    step = step or (None,) * len(begin)
+    return [slice(b, e, s) for b, e, s in zip(begin, end, step)]
+
+
 @register("slice", aliases=["crop"])
 def _slice(x, begin=(), end=(), step=()):
-    idx = []
-    step = step or (None,) * len(begin)
-    for b, e, s in zip(begin, end, step):
-        idx.append(slice(b, e, s))
-    return x[tuple(idx)]
+    return x[tuple(_idx_slices(begin, end, step))]
 
 
 @register("slice_axis")
@@ -369,3 +370,24 @@ def _internal_getitem(x, key=None):
     """Basic-index read as a recorded op — used by NDArray.__getitem__ under
     autograd so the gradient chain survives (views carry no tape node)."""
     return x[key]
+
+def _assign_slices(x, begin, end, step):
+    idx = _idx_slices(begin, end, step)
+    idx.extend([slice(None)] * (x.ndim - len(idx)))
+    return tuple(idx)
+
+
+@register("_slice_assign", aliases=["_crop_assign"])
+def _slice_assign(lhs, rhs, begin=(), end=(), step=()):
+    """lhs with lhs[begin:end:step] = rhs (reference:
+    src/operator/tensor/matrix_op.cc _slice_assign — the recorded form of
+    sliced writes).  begin/end/step are static attrs, so this stays
+    jittable; differentiable in both operands (scatter vjp)."""
+    idx = _assign_slices(lhs, begin, end, step)
+    return lhs.at[idx].set(rhs.astype(lhs.dtype))
+
+
+@register("_slice_assign_scalar", aliases=["_crop_assign_scalar"])
+def _slice_assign_scalar(data, scalar=0.0, begin=(), end=(), step=()):
+    idx = _assign_slices(data, begin, end, step)
+    return data.at[idx].set(jnp.asarray(scalar, data.dtype))

@@ -210,7 +210,7 @@ def _activation(data, act_type="relu"):
     raise ValueError("bad act_type %r" % act_type)
 
 
-@register("LeakyReLU")
+@register("LeakyReLU", aliases=["leaky_relu", "_npx_leaky_relu"])
 def _leaky_relu(data, gamma=None, act_type="leaky", slope=0.25,
                 lower_bound=0.125, upper_bound=0.334):
     if act_type == "leaky":

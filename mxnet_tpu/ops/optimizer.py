@@ -690,3 +690,91 @@ def _multi_mp_lans_update(*arrays, learning_rates=None, wds=None, beta1=0.9,
         new_w32 = w32 - lrs[i] * upd
         outs.extend([new_w32.astype(w.dtype), new_m, new_v, new_w32])
     return tuple(outs)
+
+@register("adagrad_update", aliases=["_sparse_adagrad_update"],
+          differentiable=False, num_outputs=2, mutates_input=0,
+          aux_writeback={1: 2})
+def _adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-7, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0):
+    """AdaGrad (reference: src/operator/optimizer_op.cc adagrad_update;
+    the _sparse_adagrad_update alias covers the rowsparse entry point —
+    rowsparse laziness happens at the NDArray layer here, the math is
+    identical)."""
+    g = _prep(grad, rescale_grad, clip_gradient)
+    new_h = history + g * g
+    upd = g / jnp.sqrt(new_h + epsilon) + wd * weight
+    return (weight - lr * upd).astype(weight.dtype), new_h
+
+
+def _lamb_fleet_body(w, g, m, v, w32, lr, wd, beta1, beta2, epsilon, t,
+                     bias_correction, lower_bound, upper_bound,
+                     clip_gradient, rescale_grad):
+    """One LAMB fleet member (reference: src/operator/contrib/multi_lamb.cc):
+    adam moments, then ONE per-layer trust ratio on the whole update
+    (contrast LANS, which applies separate ratios to the momentum and
+    gradient terms)."""
+    g32 = g.astype(jnp.float32) * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g32 = jnp.clip(g32, -clip_gradient, clip_gradient)
+    new_m = beta1 * m + (1.0 - beta1) * g32
+    new_v = beta2 * v + (1.0 - beta2) * g32 * g32
+    mh, vh = new_m, new_v
+    if bias_correction:
+        mh = mh / (1.0 - beta1 ** t)
+        vh = vh / (1.0 - beta2 ** t)
+    upd = mh / (jnp.sqrt(vh) + epsilon) + wd * w32
+    wnorm = jnp.sqrt(jnp.sum(w32 * w32))
+    if lower_bound > 0:
+        wnorm = jnp.maximum(wnorm, lower_bound)
+    if upper_bound > 0:
+        wnorm = jnp.minimum(wnorm, upper_bound)
+    unorm = jnp.sqrt(jnp.sum(upd * upd))
+    ratio = jnp.where((wnorm > 0) & (unorm > 0), wnorm / unorm, 1.0)
+    return w32 - lr * ratio * upd, new_m, new_v
+
+
+@register("multi_lamb_update", aliases=["_contrib_multi_lamb_update"],
+          differentiable=False, num_outputs=-1,
+          aux_writeback=lambda p: {k: v for i in range(
+              int(p.get("num_weights", 1)))
+              for k, v in ((3 * i, 4 * i), (3 * i + 1, 4 * i + 2),
+                           (3 * i + 2, 4 * i + 3))})
+def _multi_lamb_update(*arrays, learning_rates=None, wds=None, beta1=0.9,
+                       beta2=0.999, epsilon=1e-6, t=1, bias_correction=True,
+                       lower_bound=-1.0, upper_bound=-1.0,
+                       clip_gradient=-1.0, rescale_grad=1.0, num_weights=1):
+    """Fused multi-tensor LAMB ((w, g, mean, var)*N)."""
+    lrs = _scalar_list(learning_rates, num_weights, 0.001)
+    wds_l = _scalar_list(wds, num_weights, 0.0)
+    outs = []
+    for i, (w, g, m, v) in enumerate(_multi_pairs(list(arrays), 4)):
+        new_w32, new_m, new_v = _lamb_fleet_body(
+            w, g, m, v, w.astype(jnp.float32), lrs[i], wds_l[i], beta1,
+            beta2, epsilon, t, bias_correction, lower_bound, upper_bound,
+            clip_gradient, rescale_grad)
+        outs.extend([new_w32.astype(w.dtype), new_m, new_v])
+    return tuple(outs)
+
+
+@register("multi_mp_lamb_update", aliases=["_contrib_multi_mp_lamb_update"],
+          differentiable=False, num_outputs=-1,
+          aux_writeback=lambda p: {k: v for i in range(
+              int(p.get("num_weights", 1)))
+              for k, v in ((4 * i, 5 * i), (4 * i + 1, 5 * i + 2),
+                           (4 * i + 2, 5 * i + 3), (4 * i + 3, 5 * i + 4))})
+def _multi_mp_lamb_update(*arrays, learning_rates=None, wds=None, beta1=0.9,
+                          beta2=0.999, epsilon=1e-6, t=1,
+                          bias_correction=True, lower_bound=-1.0,
+                          upper_bound=-1.0, clip_gradient=-1.0,
+                          rescale_grad=1.0, num_weights=1):
+    """Mixed-precision fused LAMB ((w, g, mean, var, w32)*N)."""
+    lrs = _scalar_list(learning_rates, num_weights, 0.001)
+    wds_l = _scalar_list(wds, num_weights, 0.0)
+    outs = []
+    for i, (w, g, m, v, w32) in enumerate(_multi_pairs(list(arrays), 5)):
+        new_w32, new_m, new_v = _lamb_fleet_body(
+            w, g, m, v, w32, lrs[i], wds_l[i], beta1, beta2, epsilon, t,
+            bias_correction, lower_bound, upper_bound, clip_gradient,
+            rescale_grad)
+        outs.extend([new_w32.astype(w.dtype), new_m, new_v, new_w32])
+    return tuple(outs)

@@ -189,3 +189,33 @@ def _im2col(data, kernel=(1, 1), stride=(1, 1), dilate=(1, 1), pad=(0, 0)):
         dimension_numbers=("NCHW", "OIHW", "NCHW"))
     B, CKK, Ho, Wo = patches.shape
     return patches.reshape(B, CKK, Ho * Wo)
+
+@register("_contrib_RROIAlign", aliases=["RROIAlign"])
+def _rroi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+                sampling_ratio=2):
+    """Rotated ROI align (reference: src/operator/contrib/rroi_align.cc,
+    RRPN-style rois).  rois: (N, 6) = [batch, cx, cy, w, h, angle_deg];
+    bins sample a rotated grid around (cx, cy), bilinear, mean-reduced."""
+    PH, PW = pooled_size
+    S = max(int(sampling_ratio), 1)
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        cx = roi[1] * spatial_scale
+        cy = roi[2] * spatial_scale
+        rw = jnp.maximum(roi[3] * spatial_scale, 1.0)
+        rh = jnp.maximum(roi[4] * spatial_scale, 1.0)
+        theta = roi[5] * jnp.pi / 180.0
+        ix = (jnp.arange(S) + 0.5) / S
+        # bin-local sample coords, centered on the box
+        lx = ((jnp.arange(PW)[:, None] + ix) / PW - 0.5).reshape(-1) * rw
+        ly = ((jnp.arange(PH)[:, None] + ix) / PH - 0.5).reshape(-1) * rh
+        gx, gy = jnp.meshgrid(lx, ly, indexing="xy")    # (PH*S, PW*S)
+        c, s = jnp.cos(theta), jnp.sin(theta)
+        sx = cx + gx * c - gy * s
+        sy = cy + gx * s + gy * c
+        vals = _bilinear_gather(data[b], sx, sy)        # (C, PH*S, PW*S)
+        vals = vals.reshape(vals.shape[0], PH, S, PW, S)
+        return jnp.mean(vals, axis=(2, 4))
+
+    return jax.vmap(one_roi)(rois)

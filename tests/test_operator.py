@@ -1785,6 +1785,107 @@ SPECS["_npi_permutation"] = S(lambda: [f(8)], grad=False)
 
 
 # Ops exercised by dedicated suites rather than the battery:
+def _lamb_ref(w, g, m, v, lr, wd, beta1=0.9, beta2=0.999, eps=1e-6, t=1):
+    """NumPy LAMB single step: adam moments, one trust ratio on the whole
+    update (incl. weight decay)."""
+    m1 = beta1 * m + (1 - beta1) * g
+    v1 = beta2 * v + (1 - beta2) * g * g
+    mh = m1 / (1 - beta1 ** t)
+    vh = v1 / (1 - beta2 ** t)
+    upd = mh / (np.sqrt(vh) + eps) + wd * w
+    wn = np.sqrt(np.sum(w * w))
+    un = np.sqrt(np.sum(upd * upd))
+    ratio = wn / un if wn > 0 and un > 0 else 1.0
+    return (w - lr * ratio * upd, m1, v1)
+
+
+def _rroi_ref(data, rois, PH=2, PW=2, S=2):
+    """NumPy rotated-roi-align (angle=0 case exercises the full bilinear
+    sampling path)."""
+    N = rois.shape[0]
+    C = data.shape[1]
+    out = np.zeros((N, C, PH, PW), np.float32)
+    H, W = data.shape[2], data.shape[3]
+    for n in range(N):
+        b, cx, cy, rw, rh, ang = rois[n]
+        rw, rh = max(rw, 1.0), max(rh, 1.0)
+        th = ang * np.pi / 180.0
+        ix = (np.arange(S) + 0.5) / S
+        lx = (((np.arange(PW)[:, None] + ix) / PW) - 0.5).reshape(-1) * rw
+        ly = (((np.arange(PH)[:, None] + ix) / PH) - 0.5).reshape(-1) * rh
+        gx, gy = np.meshgrid(lx, ly, indexing="xy")
+        sx = cx + gx * np.cos(th) - gy * np.sin(th)
+        sy = cy + gx * np.sin(th) + gy * np.cos(th)
+        x0 = np.clip(np.floor(sx).astype(int), 0, W - 1)
+        y0 = np.clip(np.floor(sy).astype(int), 0, H - 1)
+        x1 = np.clip(x0 + 1, 0, W - 1)
+        y1 = np.clip(y0 + 1, 0, H - 1)
+        fx = np.clip(sx, 0, W - 1) - x0
+        fy = np.clip(sy, 0, H - 1) - y0
+        img = data[int(b)]
+        vals = (img[:, y0, x0] * (1 - fx) * (1 - fy)
+                + img[:, y0, x1] * fx * (1 - fy)
+                + img[:, y1, x0] * (1 - fx) * fy
+                + img[:, y1, x1] * fx * fy)
+        out[n] = vals.reshape(C, PH, S, PW, S).mean(axis=(2, 4))
+    return out
+
+
+def _slice_assign_ref(lhs, rhs, begin, end):
+    out = lhs.copy()
+    out[tuple(slice(b, e) for b, e in zip(begin, end))] = rhs
+    return out
+
+
+def _index_copy_ref(old, idx, new):
+    out = old.copy()
+    out[idx.astype(int)] = new
+    return out
+
+
+SPECS.update({
+    "adagrad_update": S(
+        lambda: [f(4), f(4), fpos(4)], {"lr": 0.01, "wd": 0.01},
+        grad=False,
+        ref=lambda w, g, h: w - 0.01 * (
+            g / np.sqrt(h + g * g + 1e-7) + 0.01 * w)),
+    "multi_lamb_update": S(
+        lambda: [f(4), f(4), np.zeros(4, np.float32),
+                 np.zeros(4, np.float32)],
+        {"learning_rates": (0.1,), "wds": (0.01,), "t": 1,
+         "num_weights": 1}, grad=False,
+        ref=lambda w, g, m, v: _lamb_ref(w, g, m, v, 0.1, 0.01)),
+    "multi_mp_lamb_update": S(
+        lambda: [_MPLANS_W.copy(), f(4), np.zeros(4, np.float32),
+                 np.zeros(4, np.float32), _MPLANS_W.astype(np.float32)],
+        {"learning_rates": (0.1,), "wds": (0.01,), "t": 1,
+         "num_weights": 1}, grad=False,
+        ref=lambda w, g, m, v, w32: _lamb_ref(w32, g, m, v, 0.1, 0.01)),
+    "_contrib_boolean_mask": S(
+        lambda: [f(4, 3), np.array([1, 0, 1, 1], np.float32)], {},
+        grad=False,
+        ref=lambda d, i: d[i != 0]),
+    "_contrib_index_copy": S(
+        lambda: [f(5, 3), np.array([0, 2], np.int32), f(2, 3)], {},
+        ref=lambda o, i, n: _index_copy_ref(o, i, n)),
+    "_identity_with_attr_like_rhs": S(
+        lambda: [f(3, 4), f(3, 4)], {}, ref=lambda a, b: a),
+    "_slice_assign": S(
+        lambda: [f(4, 5), f(2, 4)], {"begin": (1, 0), "end": (3, 4)},
+        ref=lambda l, r: _slice_assign_ref(l, r, (1, 0), (3, 4))),
+    "_slice_assign_scalar": S(
+        lambda: [f(4, 5)], {"scalar": 2.5, "begin": (1, 0), "end": (3, 4)},
+        ref=lambda l: _slice_assign_ref(
+            l, np.float32(2.5), (1, 0), (3, 4))),
+    "_contrib_RROIAlign": S(
+        lambda: [f(1, 2, 8, 8),
+                 np.array([[0, 4.0, 4.0, 4.0, 4.0, 30.0]], np.float32)],
+        {"pooled_size": (2, 2), "spatial_scale": 1.0, "sampling_ratio": 2},
+        grad=False,
+        ref=lambda d, r: _rroi_ref(d, r)),
+})
+
+
 TESTED_ELSEWHERE = {
     "_contrib_quantize": "tests/test_quantization.py",
     "_contrib_quantize_v2": "tests/test_quantization.py",
@@ -1944,6 +2045,37 @@ def test_multi_lans_matches_reference():
     wr, mr, vr = _lans_ref(w2_np, g2_np, np.zeros(4, np.float32),
                            np.zeros(4, np.float32), 0.1, 0.01)
     np.testing.assert_allclose(w32.asnumpy(), wr, rtol=1e-5, atol=1e-6)
+
+
+def test_multi_lamb_matches_reference():
+    """LAMB fleet outputs are in-place (visible return empty) — compare the
+    written-back arrays against the numpy LAMB step, nonzero weight decay.
+    (The SPECS refs for these two ops never execute for the same reason;
+    this test is the real comparison.)"""
+    w_np, g_np = f(4), f(4)
+    w, g = nd.array(w_np), nd.array(g_np)
+    m = nd.array(np.zeros(4, np.float32))
+    v = nd.array(np.zeros(4, np.float32))
+    invoke("multi_lamb_update", w, g, m, v,
+           learning_rates=(0.1,), wds=(0.01,), t=1, num_weights=1)
+    w_ref, m_ref, v_ref = _lamb_ref(w_np, g_np, np.zeros(4, np.float32),
+                                    np.zeros(4, np.float32), 0.1, 0.01)
+    np.testing.assert_allclose(w.asnumpy(), w_ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(m.asnumpy(), m_ref, rtol=1e-5)
+    np.testing.assert_allclose(v.asnumpy(), v_ref, rtol=1e-5, atol=1e-9)
+
+    w2_np, g2_np = f(4), f(4)
+    w2 = nd.array(w2_np)
+    g2 = nd.array(g2_np)
+    m2 = nd.array(np.zeros(4, np.float32))
+    v2 = nd.array(np.zeros(4, np.float32))
+    w32 = nd.array(w2_np.astype(np.float32))
+    invoke("multi_mp_lamb_update", w2, g2, m2, v2, w32,
+           learning_rates=(0.1,), wds=(0.01,), t=1, num_weights=1)
+    wr, mr, vr = _lamb_ref(w2_np, g2_np, np.zeros(4, np.float32),
+                           np.zeros(4, np.float32), 0.1, 0.01)
+    np.testing.assert_allclose(w32.asnumpy(), wr, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(m2.asnumpy(), mr, rtol=1e-5)
 
 
 def test_sldwin_attention_matches_banded_reference():
