@@ -64,23 +64,23 @@ def _pixel_shuffle(ndim):
                 else tuple(factor)
 
         def hybrid_forward(self, F, x):
-            import jax.numpy as jnp
-            from ...ndarray.ndarray import NDArray, from_jax
+            # registry-routed reshape/transpose/reshape (the reference's
+            # own decomposition) so the op sequence records on the
+            # autograd tape AND serializes through the symbol tracer
             f = self._factor
-            a = x._jax if isinstance(x, NDArray) else jnp.asarray(x)
-            N, C = a.shape[0], a.shape[1]
-            spatial = a.shape[2:]
-            import numpy as _onp
-            newC = C // int(_onp.prod(f))
-            # (N, C', f1..fn, d1..dn) -> interleave f_i after d_i
-            a = a.reshape((N, newC) + tuple(f) + tuple(spatial))
+            N, C = x.shape[0], x.shape[1]
+            spatial = tuple(x.shape[2:])
+            n_f = 1
+            for fi in f:
+                n_f *= int(fi)
+            newC = C // n_f
+            x = x.reshape((N, newC) + tuple(f) + spatial)
             perm = [0, 1]
             for i in range(ndim):
                 perm += [2 + ndim + i, 2 + i]
-            a = a.transpose(perm)
+            x = x.transpose(tuple(perm))
             out_sp = tuple(d * fi for d, fi in zip(spatial, f))
-            return from_jax(a.reshape((N, newC) + out_sp), ctx=x.context
-                            if isinstance(x, NDArray) else None)
+            return x.reshape((N, newC) + out_sp)
 
         def __repr__(self):
             return "%s(factor=%s)" % (type(self).__name__, (self._factor,))

@@ -1055,3 +1055,38 @@ def geomspace(start, stop, num=50, endpoint=True, dtype=None, ctx=None,
                   endpoint=endpoint,
                   dtype=_onp.dtype(dtype).name if dtype else None,
                   ctx=ctx or device)
+
+
+# ---------------------------------------------------------------------------
+# NumPy-2.0 / array-API aliases (numpy renamed these in 2.0; exposing both
+# spellings keeps mx.np usable as a drop-in with new-style user code)
+# ---------------------------------------------------------------------------
+acos = arccos
+acosh = arccosh
+asin = arcsin
+asinh = arcsinh
+atan = arctan
+atan2 = arctan2
+atanh = arctanh
+concat = concatenate
+permute_dims = transpose
+pow = power
+bitwise_invert = invert
+bitwise_left_shift = left_shift
+bitwise_right_shift = right_shift
+
+
+def broadcast_shapes(*shapes):
+    return _onp.broadcast_shapes(*shapes)
+
+
+def finfo(dtype):
+    return _onp.finfo(_onp.dtype(getattr(dtype, "dtype", dtype)))
+
+
+def iinfo(dtype):
+    return _onp.iinfo(_onp.dtype(getattr(dtype, "dtype", dtype)))
+
+
+def astype(x, dtype, copy=True):
+    return x.astype(dtype, copy=copy)

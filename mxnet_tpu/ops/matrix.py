@@ -305,11 +305,14 @@ def _where_op(cond, a, b):
     return jnp.where(cond.astype(bool), a, b)
 
 
-@register("boolean_mask", differentiable=False, no_jit=True)
+@register("boolean_mask", aliases=["_contrib_boolean_mask"],
+          differentiable=False, no_jit=True)
 def _boolean_mask(data, index, axis=0):
-    # dynamic output shape: materialize via host round-trip is illegal under
-    # jit; MXNet semantics preserved eagerly only.
-    return jnp.compress(index.astype(bool), data, axis=axis)
+    """Keep slices along `axis` whose index entry is non-zero (reference:
+    src/operator/contrib/boolean_mask.cc).  Dynamic output shape, so
+    no_jit and eager-only; the reference's backward is a sanctioned cut
+    (use `take` with precomputed indices to train through a mask)."""
+    return jnp.compress(index.astype(bool), data, axis=int(axis))
 
 
 @register("sequence_mask", aliases=["SequenceMask"])

@@ -413,22 +413,6 @@ def _edge_id(indptr, indices, u, v):
             out[i] = float(lo + hits[0])
     return jnp.asarray(out)
 
-@register("_contrib_boolean_mask", aliases=["boolean_mask"], no_jit=True,
-          differentiable=False)
-def _boolean_mask(data, index, axis=0):
-    """Keep the slices along `axis` whose index entry is non-zero
-    (reference: src/operator/contrib/boolean_mask.cc).  The output shape
-    depends on the data — inherently dynamic, so the op is no_jit AND
-    non-differentiable here: the index concretization (host nonzero)
-    cannot run under a jax trace, so the reference's backward is a
-    sanctioned cut (use `take` with precomputed indices to train through
-    a mask)."""
-    import numpy as _np
-    ax = int(axis)
-    keep = _np.nonzero(_np.asarray(index).reshape(-1) != 0)[0]
-    return jnp.take(data, jnp.asarray(keep, jnp.int32), axis=ax)
-
-
 @register("_contrib_index_copy", aliases=["index_copy"])
 def _index_copy(old_tensor, index_vector, new_tensor):
     """Copy rows of new_tensor into old_tensor at index_vector

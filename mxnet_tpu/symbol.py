@@ -787,5 +787,12 @@ def symbol_json_from_block(block) -> str:
         raise MXNetError(
             "export: run the block on real inputs at least once before "
             "export() (the reference requires hybridize()+forward too)")
-    inputs = [_nd_mod.zeros(s, dtype=d, ctx=cpu()) for s, d in shapes]
+    # trace on whatever device the parameters live on — a TPU-resident net
+    # must export without a copy to host
+    ctx = cpu()
+    for p in block.collect_params().values():
+        if p._data is not None:
+            ctx = p.list_ctx()[0]
+            break
+    inputs = [_nd_mod.zeros(s, dtype=d, ctx=ctx) for s, d in shapes]
     return trace_block(block, *inputs).tojson()

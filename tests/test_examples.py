@@ -73,3 +73,20 @@ def test_dist_async_example():
               for l in r.stdout.splitlines() if "FINAL" in l]
     assert len(finals) == 2
     assert all(v < 1.0 for v in finals), finals
+
+
+def test_dcgan_example():
+    """Adversarial two-Trainer loop (reference example/gluon/dcgan)."""
+    out = _run("dcgan.py", "--epochs", "1", "--batch-size", "16",
+               "--max-batches", "2")
+    assert "lossD" in out and "lossG" in out
+
+
+def test_super_resolution_example(tmp_path):
+    """ESPCN + PixelShuffle + the canonical ONNX-export path
+    (reference example/gluon/super_resolution)."""
+    onnx_path = os.path.join(str(tmp_path), "sr.onnx")
+    out = _run("super_resolution.py", "--epochs", "1", "--max-batches",
+               "2", "--export", onnx_path, cwd=str(tmp_path))
+    assert "psnr" in out
+    assert os.path.exists(onnx_path) and os.path.getsize(onnx_path) > 1000

@@ -47,6 +47,17 @@ def _ts():
     return time.strftime("%Y-%m-%dT%H:%M:%S%z")
 
 
+def _current_round():
+    """Round number from the driver's PROGRESS.jsonl (last line), or None
+    when unavailable — the primary same-round identity for captures."""
+    try:
+        with open(os.path.join(REPO, "PROGRESS.jsonl")) as f:
+            lines = [l for l in f.read().splitlines() if l.strip()]
+        return json.loads(lines[-1]).get("round")
+    except Exception:
+        return None
+
+
 def _log(msg):
     line = "%s %s" % (_ts(), msg)
     with open(LOG, "a") as f:
@@ -302,7 +313,11 @@ def main():
             prior = {}
         now_bench = sorted(os.path.basename(p) for p in
                            glob.glob(os.path.join(REPO, "BENCH_r*.json")))
-        if now_bench and prior.get("bench_files_at_capture") == now_bench:
+        rnd = _current_round()
+        same_round = (prior.get("round") == rnd if rnd is not None
+                      and prior.get("round") is not None
+                      else prior.get("bench_files_at_capture") == now_bench)
+        if same_round:
             results = prior.get("results") or {}
             _log("seeding from same-round TPU_CAPTURE.json (%d children ok)"
                  % sum(_ok(v) for v in results.values()))
@@ -327,15 +342,18 @@ def main():
             before_ok = sum(_ok(results.get(t)) for t in TAG_NAMES)
             results = capture(results, attempts)
             n_ok = sum(_ok(results.get(t)) for t in TAG_NAMES)
-            bench = results.get("resnet50_bench") or {}
-            if _ok(bench) and n_ok > before_ok:
-                # write ONLY when something new was measured — captured_at
-                # must never be re-stamped onto unchanged results
+            if n_ok > before_ok:
+                # write on ANY improvement (a failed resnet bench must not
+                # discard other captured children), and ONLY on
+                # improvement — captured_at is never re-stamped onto
+                # unchanged results
                 import glob
                 payload = {"captured_at": _ts(), "probes": n,
-                           # Round identity: the driver writes BENCH_r{N}.json
-                           # at each round's END, so any BENCH file that
-                           # appears after this capture marks it as stale.
+                           "round": _current_round(),
+                           # secondary round identity: the driver writes
+                           # BENCH_r{N}.json at each round's END, so any
+                           # BENCH file appearing after this capture marks
+                           # it stale when PROGRESS.jsonl is unavailable
                            "bench_files_at_capture": sorted(
                                os.path.basename(p) for p in
                                glob.glob(os.path.join(REPO, "BENCH_r*.json"))),
@@ -346,9 +364,8 @@ def main():
                 os.replace(tmp, OUT)  # atomic: bench.py may read concurrently
                 _log("capture -> TPU_CAPTURE.json (%d/%d children ok)"
                      % (n_ok, len(TAG_NAMES)))
-            elif not _ok(bench):
-                _log("capture ran but bench device was %r; continuing"
-                     % bench.get("device"))
+            else:
+                _log("no new children captured this window")
             if all(_ok(results.get(t)) for t in TAG_NAMES):
                 _log("capture COMPLETE — all children captured")
                 return
