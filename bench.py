@@ -62,6 +62,34 @@ def _device_peak_tflops():
     return 197.0
 
 
+def _timed_steps(step, scan, warmup, iters, dev_batch, host_batch):
+    """Measure `iters` steps; per-step dispatch loop by default, ONE
+    k-step jit (TrainStep.run_steps) with --scan.  In scan mode the first
+    timed call absorbs the k-step compile and is discarded (no separate
+    warmup executable); returns (loss, dt)."""
+    import time as _t
+    import jax
+
+    def _sync(x):
+        jax.block_until_ready(x._jax if hasattr(x, "_jax") else x)
+
+    if scan:
+        loss = step.run_steps(iters, *host_batch)   # compile + warm
+        _sync(loss)
+        t0 = _t.perf_counter()
+        loss = step.run_steps(iters, *host_batch)
+        _sync(loss)
+        return loss, _t.perf_counter() - t0
+    for _ in range(warmup):
+        loss = step(*dev_batch)
+    _sync(loss)
+    t0 = _t.perf_counter()
+    for _ in range(iters):
+        loss = step(*dev_batch)
+    _sync(loss)
+    return loss, _t.perf_counter() - t0
+
+
 def run_bench():
     """The actual benchmark. Runs on jax's default backend (parent pins it)."""
     import jax
@@ -105,21 +133,15 @@ def run_bench():
     y = jnp.asarray(np.random.randint(0, 1000, batch), jnp.int32)
     xs, ys = step.shard_batch(x, y)
 
-    for _ in range(warmup):
-        loss = step(xs, ys)
-    jax.block_until_ready(loss._jax if hasattr(loss, "_jax") else loss)
-
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        loss = step(xs, ys)
-    jax.block_until_ready(loss._jax if hasattr(loss, "_jax") else loss)
-    dt = time.perf_counter() - t0
+    scan = os.environ.get("MX_BENCH_SCAN") == "1"
+    loss, dt = _timed_steps(step, scan, warmup, iters, (xs, ys), (x, y))
 
     img_per_sec = batch * iters / dt
     # MFU diagnostic: ResNet-50 fwd+bwd ~= 3x 3.87 GFLOP/img at 224x224.
     tflops = img_per_sec * 3 * 3.87e9 / 1e12
     print(json.dumps({
-        "metric": "resnet50_train_images_per_sec_per_chip",
+        "metric": "resnet50_train_images_per_sec_per_chip"
+                  + ("_scan" if scan else ""),
         "value": round(img_per_sec, 2),
         "unit": "images/sec",
         "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 4),
@@ -180,14 +202,10 @@ def run_bert_bench():
     lab = jnp.asarray(np.random.randint(0, vocab, (batch, seq)), jnp.int32)
     tok, seg, lab = step.shard_batch(tok, seg, lab)
 
-    for _ in range(warmup):
-        loss = step(tok, seg, lab)
-    jax.block_until_ready(loss._jax if hasattr(loss, "_jax") else loss)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        loss = step(tok, seg, lab)
-    jax.block_until_ready(loss._jax if hasattr(loss, "_jax") else loss)
-    dt = time.perf_counter() - t0
+    scan = os.environ.get("MX_BENCH_SCAN") == "1"
+    host = tuple(np.asarray(jax.device_get(a)) for a in (tok, seg, lab))
+    loss, dt = _timed_steps(step, scan, warmup, iters,
+                            (tok, seg, lab), host)
 
     tokens_per_sec = batch * seq * iters / dt
     # MEASURED param count (not the 110M folklore number): sum over the
@@ -201,7 +219,8 @@ def run_bert_bench():
     tflops = tokens_per_sec * flops_per_token / 1e12
     mfu = tflops / _device_peak_tflops() if not on_cpu else 0.0
     print(json.dumps({
-        "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
+        "metric": "bert_base_pretrain_tokens_per_sec_per_chip"
+                  + ("_scan" if scan else ""),
         "value": round(tokens_per_sec, 1), "unit": "tokens/sec",
         "vs_baseline": round(mfu / 0.5, 4),   # 1.0 == the 50% MFU target
         "device": jax.default_backend(), "batch": batch, "seq": seq,
@@ -429,6 +448,11 @@ def main():
         return
     mode = "bert" if "--bert" in sys.argv else \
         ("score" if "--score" in sys.argv else "resnet")
+    if "--scan" in sys.argv:
+        # diagnostic: run the measured iterations inside ONE jit (lax scan
+        # over the step) — the delta vs the default per-step dispatch loop
+        # is the per-step host/tunnel overhead
+        os.environ["MX_BENCH_SCAN"] = "1"
     if mode != "resnet":
         # same probe/fallback machinery, mode-specific child
         os.environ["MX_BENCH_MODE"] = mode

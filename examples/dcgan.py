@@ -132,6 +132,9 @@ def main():
                 dsum += float(errD.mean().asnumpy())
                 gsum += float(errG.mean().asnumpy())
                 seen += bs
+            if n_b == 0:
+                raise SystemExit("no batches: --batch-size exceeds the "
+                                 "dataset size")
             print("epoch %d: lossD %.4f lossG %.4f (%.1f img/s)"
                   % (epoch, dsum / n_b, gsum / n_b,
                      seen / (time.time() - t0)))

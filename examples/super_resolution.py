@@ -39,8 +39,8 @@ def build_net(upscale):
 
 
 def get_data(batch_size, upscale, n=256, hr=48):
-    """(LR, HR) luminance patch pairs; synthetic offline, image folder
-    under MX_DATA_DIR/images when armed."""
+    """(LR, HR) luminance patch pairs (synthetic; LR = mean-pooled HR,
+    the standard degradation model)."""
     lr = hr // upscale
     rng = np.random.RandomState(0)
     base = rng.uniform(0, 1, (n, 1, hr, hr)).astype(np.float32)
@@ -91,6 +91,9 @@ def main():
                 trainer.step(lo.shape[0])
                 lsum += float(loss.mean().asnumpy())
                 seen += lo.shape[0]
+            if n_b == 0:
+                raise SystemExit("no batches: --batch-size exceeds the "
+                                 "dataset size")
             mse = lsum / n_b * 2.0                # L2Loss halves
             print("epoch %d: mse %.5f psnr %.2f dB (%.1f patch/s)"
                   % (epoch, mse, 10 * np.log10(1.0 / max(mse, 1e-9)),

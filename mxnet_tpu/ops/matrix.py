@@ -312,7 +312,8 @@ def _boolean_mask(data, index, axis=0):
     src/operator/contrib/boolean_mask.cc).  Dynamic output shape, so
     no_jit and eager-only; the reference's backward is a sanctioned cut
     (use `take` with precomputed indices to train through a mask)."""
-    return jnp.compress(index.astype(bool), data, axis=int(axis))
+    return jnp.compress(index.reshape(-1).astype(bool), data,
+                        axis=int(axis))
 
 
 @register("sequence_mask", aliases=["SequenceMask"])

@@ -22,6 +22,7 @@ from typing import Any, Callable, Dict, Optional, Sequence
 import numpy as _np
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..gluon.block import functionalize
@@ -184,8 +185,11 @@ class TrainStep:
                 lambda p, m: p + m, params, new_opt)
             return new_params, new_opt, loss
 
+        self._step_fn = step
+        self._donate = donate
         self._step = jax.jit(
             step, donate_argnums=(0, 1) if donate else ())
+        self._multi = {}
 
     def shard_batch(self, *arrays):
         """Place host batches onto the dp-sharded layout.  Multi-host: each
@@ -198,6 +202,31 @@ class TrainStep:
                     self._batch_sharding, _np.asarray(a))
                 for a in arrays)
         return tuple(jax.device_put(a, self._batch_sharding) for a in arrays)
+
+    def run_steps(self, k: int, *batch):
+        """Run k steps under ONE jit dispatch (lax.fori_loop over the step
+        body, same batch each iteration).  Perf diagnostic: comparing
+        k-step against k x one-step isolates per-step dispatch/transfer
+        overhead (tunnel RPC, host work) from device compute — the
+        reference's benchmark_score.py plays the same trick with its
+        wait_to_read-once loop."""
+        batch = self.shard_batch(*batch)
+        if k not in self._multi:
+            step_fn = self._step_fn
+
+            def multi(params, opt_state, *b):
+                def body(_, carry):
+                    p, o, _loss = carry
+                    p, o, loss = step_fn(p, o, *b)
+                    return p, o, loss.astype(jnp.float32)
+                return lax.fori_loop(
+                    0, k, body,
+                    (params, opt_state, jnp.zeros((), jnp.float32)))
+            self._multi[k] = jax.jit(
+                multi, donate_argnums=(0, 1) if self._donate else ())
+        self.params, self.opt_state, loss = self._multi[k](
+            self.params, self.opt_state, *batch)
+        return loss
 
     def __call__(self, *batch):
         batch = self.shard_batch(*batch)
