@@ -29,7 +29,7 @@ import numpy as _onp
 import jax
 import jax.numpy as jnp
 
-from .registry import register
+from .registry import alias, register
 
 __all__ = []  # everything is reached through the registry
 
@@ -153,6 +153,56 @@ for _n, _f in _BINARY_DIFF.items():
     _reg("_npi_" + _n, _binary(_f))
 for _n, _f in _BINARY_NONDIFF.items():
     _reg("_npi_" + _n, _binary(_f), differentiable=False)
+
+
+# scalar variants: how 2.x graphs encode `a + 2` / `2 / a` (reference:
+# np_elemwise_broadcast_op.cc _npi_*_scalar / _npi_r*_scalar).  The
+# scalar stays a PYTHON number so jax's weak typing reproduces numpy's
+# array-scalar promotion; is_int preserves integer semantics.
+def _scalar_variant(jfn, reflected):
+    def fn(data, scalar=0.0, is_int=False):
+        s = int(scalar) if bool(is_int) and float(scalar).is_integer() \
+            else float(scalar)
+        return jfn(s, data) if reflected else jfn(data, s)
+    return fn
+
+
+_NONCOMMUTATIVE = ("subtract", "true_divide", "power", "mod",
+                   "floor_divide", "arctan2", "copysign", "ldexp",
+                   "nextafter")
+
+def _rldexp(data, scalar=0.0, is_int=False):
+    # reference semantics: scalar * 2**data, defined for FLOAT exponents
+    # too (jnp.ldexp rejects non-integer exponent dtypes)
+    del is_int
+    return float(scalar) * jnp.exp2(data)
+
+
+for _n, _f in list(_BINARY_DIFF.items()) + list(_BINARY_NONDIFF.items()):
+    _d = _n in _BINARY_DIFF
+    _mx = "mod" if _n == "remainder" else _n
+    _reg("_npi_%s_scalar" % _mx, _scalar_variant(_f, False),
+         differentiable=_d,
+         aliases=(("_npi_%s_scalar" % _n,) if _mx != _n else ()))
+    if _mx in _NONCOMMUTATIVE and _mx != "ldexp":
+        _reg("_npi_r%s_scalar" % _mx, _scalar_variant(_f, True),
+             differentiable=_d)
+
+_reg("_npi_rldexp_scalar", _rldexp)
+alias("_npi_remainder", "_npi_mod")
+_reg("_npi_rarctan2", _binary(lambda a, b: jnp.arctan2(b, a)))
+_reg("_npi_rcopysign", _binary(lambda a, b: jnp.copysign(b, a)))
+_reg("_npi_rldexp", lambda a, b: b * jnp.exp2(a))
+
+
+def _npi_spacing(a):
+    # SIGNED distance to the next representable value away from zero
+    # (np.spacing(-1.0) == -eps)
+    away = jnp.where(a >= 0, jnp.inf, -jnp.inf).astype(a.dtype)
+    return jnp.nextafter(a, away) - a
+
+
+_reg("_npi_spacing", _npi_spacing, differentiable=False)
 
 
 def _npi_divmod(a, b):
@@ -987,7 +1037,6 @@ _reg("_npi_cond", _npi_cond, differentiable=False)
 # resolve (symbol.py looks nodes up by registry name).
 # ---------------------------------------------------------------------------
 
-from .registry import alias as _alias
 
 for _existing, _npi_names in [
         ("diag", ["_npi_diag"]),
@@ -1037,6 +1086,6 @@ for _existing, _npi_names in [
         ("arange_like", ["_npi_arange_like"]),
         ("broadcast_like", ["_npi_broadcast_like"])]:
     try:
-        _alias(_existing, *_npi_names)
+        alias(_existing, *_npi_names)
     except KeyError:
         pass   # alias table is best-effort across op-set evolution

@@ -1785,6 +1785,122 @@ SPECS["_npi_permutation"] = S(lambda: [f(8)], grad=False)
 
 
 # Ops exercised by dedicated suites rather than the battery:
+# _npi scalar-variant family (generated, mirroring the kernel table):
+# every entry carries an independent numpy ref.  Int-domain ops use int
+# inputs + is_int=True.
+def _ints34():
+    return ints(3, 4, hi=7) + 1
+
+
+_SCALAR_FAM = {
+    # name: (inputs, scalar, np forward, int_domain)
+    "add": (lambda: [f(3, 4)], 1.7, np.add, False),
+    "subtract": (lambda: [f(3, 4)], 1.7, np.subtract, False),
+    "multiply": (lambda: [f(3, 4)], 1.7, np.multiply, False),
+    "true_divide": (lambda: [f(3, 4)], 1.7, np.true_divide, False),
+    "power": (lambda: [fpos(3, 4)], 1.3, np.power, False),
+    "float_power": (lambda: [fpos(3, 4)], 1.3, np.float_power, False),
+    "arctan2": (lambda: [f(3, 4)], 0.7, np.arctan2, False),
+    "hypot": (lambda: [f(3, 4)], 0.7, np.hypot, False),
+    "logaddexp": (lambda: [f(3, 4)], 0.7, np.logaddexp, False),
+    "logaddexp2": (lambda: [f(3, 4)], 0.7, np.logaddexp2, False),
+    "maximum": (lambda: [f(3, 4)], 0.3, np.maximum, False),
+    "minimum": (lambda: [f(3, 4)], 0.3, np.minimum, False),
+    "fmax": (lambda: [f(3, 4)], 0.3, np.fmax, False),
+    "fmin": (lambda: [f(3, 4)], 0.3, np.fmin, False),
+    "copysign": (lambda: [f(3, 4)], -1.0, np.copysign, False),
+    "floor_divide": (lambda: [fpos(3, 4)], 0.7, np.floor_divide, False),
+    "mod": (lambda: [fpos(3, 4)], 0.7, np.mod, False),
+    "fmod": (lambda: [fpos(3, 4)], 0.7, np.fmod, False),
+    "nextafter": (lambda: [f(3, 4)], 1.0, np.nextafter, False),
+    "ldexp": (lambda: [f(3, 4)], 2.0,
+              lambda x, s: np.ldexp(x, int(s)), True),
+    "heaviside": (lambda: [f(3, 4)], 0.5, np.heaviside, False),
+    "gcd": (lambda: [_ints34()], 6.0,
+            lambda x, s: np.gcd(x, int(s)), True),
+    "lcm": (lambda: [_ints34()], 6.0,
+            lambda x, s: np.lcm(x, int(s)), True),
+    "bitwise_and": (lambda: [_ints34()], 6.0,
+                    lambda x, s: np.bitwise_and(x, int(s)), True),
+    "bitwise_or": (lambda: [_ints34()], 6.0,
+                   lambda x, s: np.bitwise_or(x, int(s)), True),
+    "bitwise_xor": (lambda: [_ints34()], 6.0,
+                    lambda x, s: np.bitwise_xor(x, int(s)), True),
+    "left_shift": (lambda: [_ints34()], 2.0,
+                   lambda x, s: np.left_shift(x, int(s)), True),
+    "right_shift": (lambda: [_ints34()], 1.0,
+                    lambda x, s: np.right_shift(x, int(s)), True),
+    "equal": (lambda: [ints(3, 4, hi=3).astype(np.float32)], 1.0,
+              np.equal, False),
+    "not_equal": (lambda: [ints(3, 4, hi=3).astype(np.float32)], 1.0,
+                  np.not_equal, False),
+    "less": (lambda: [f(3, 4)], 0.0, np.less, False),
+    "less_equal": (lambda: [f(3, 4)], 0.0, np.less_equal, False),
+    "greater": (lambda: [f(3, 4)], 0.0, np.greater, False),
+    "greater_equal": (lambda: [f(3, 4)], 0.0, np.greater_equal, False),
+    "logical_and": (lambda: [ints(3, 4, hi=2).astype(np.float32)], 1.0,
+                    np.logical_and, False),
+    "logical_or": (lambda: [ints(3, 4, hi=2).astype(np.float32)], 0.0,
+                   np.logical_or, False),
+    "logical_xor": (lambda: [ints(3, 4, hi=2).astype(np.float32)], 1.0,
+                    np.logical_xor, False),
+}
+
+_R_SCALAR = ("subtract", "true_divide", "power", "mod", "floor_divide",
+             "arctan2", "copysign", "ldexp")
+
+
+def _mk_scalar_spec(np_fn, scalar, refl, int_dom):
+    if refl:
+        ref = lambda x: np.asarray(np_fn(  # noqa: E731
+            (int(scalar) if int_dom else scalar), x))
+    else:
+        ref = lambda x: np.asarray(np_fn(  # noqa: E731
+            x, (int(scalar) if int_dom else scalar)))
+    return ref
+
+
+# the differentiable subset gets the numeric-gradient battery too
+# (random float inputs stay clear of the max/min/copysign kinks)
+_SCALAR_DIFF = {"add", "subtract", "multiply", "true_divide", "power",
+                "float_power", "arctan2", "hypot", "logaddexp",
+                "logaddexp2", "maximum", "minimum", "fmax", "fmin",
+                "copysign"}
+
+for _n, (_inp, _s, _np_fn, _intd) in _SCALAR_FAM.items():
+    _params = {"scalar": _s}
+    if _intd:
+        _params["is_int"] = True
+    _g = _n in _SCALAR_DIFF
+    SPECS["_npi_%s_scalar" % _n] = S(
+        _inp, dict(_params), grad=_g,
+        ref=_mk_scalar_spec(_np_fn, _s, False, _intd))
+    if _n in _R_SCALAR and _n != "ldexp":
+        SPECS["_npi_r%s_scalar" % _n] = S(
+            _inp, dict(_params), grad=_g,
+            ref=_mk_scalar_spec(_np_fn, _s, True, _intd))
+
+# reflected ldexp: scalar * 2**data, float exponents allowed
+SPECS["_npi_rldexp_scalar"] = S(
+    lambda: [f(3, 4)], {"scalar": 2.0},
+    ref=lambda x: np.asarray(2.0 * np.exp2(x)))
+SPECS["_npi_rnextafter_scalar"] = S(
+    lambda: [f(3, 4)], {"scalar": 1.0}, grad=False,
+    ref=lambda x: np.nextafter(np.float32(1.0), x))
+
+SPECS.update({
+    "_npi_mod": S(lambda: [fpos(3, 4), fpos(3, 4) + 0.5], grad=False,
+                  ref=np.mod),
+    "_npi_rarctan2": S(lambda: [f(3, 4), f(3, 4)],
+                       ref=lambda a, b: np.arctan2(b, a)),
+    "_npi_rcopysign": S(lambda: [f(3, 4), f(3, 4)],
+                        ref=lambda a, b: np.copysign(b, a)),
+    "_npi_rldexp": S(lambda: [f(3, 4), f(3, 4)],
+                     ref=lambda a, b: np.asarray(b * np.exp2(a))),
+    "_npi_spacing": S(lambda: [f(3, 4)], grad=False, ref=np.spacing),
+})
+
+
 def _lamb_ref(w, g, m, v, lr, wd, beta1=0.9, beta2=0.999, eps=1e-6, t=1):
     """NumPy LAMB single step: adam moments, one trust ratio on the whole
     update (incl. weight decay)."""
