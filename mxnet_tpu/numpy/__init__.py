@@ -600,7 +600,41 @@ def _npi1(op, **fixed):
 
 
 def _npi2(op):
+    """Binary dispatch with the reference's array-scalar split: a python
+    number on either side routes to the _npi_*_scalar / _npi_r*_scalar
+    kernel (no scalar->array materialization; graphs record the same
+    node the reference writes), arrays to the tensor-tensor kernel."""
+    stem = op.replace("_npi_", "")
+    stem = "mod" if stem == "remainder" else stem
+    s_name = "_npi_%s_scalar" % stem
+    r_name = "_npi_r%s_scalar" % stem
+    _commutes = stem in ("add", "multiply", "maximum", "minimum", "fmax",
+                         "fmin", "hypot", "logaddexp", "logaddexp2")
+    _have = []                           # memoized (s_ok, r_ok)
+
+    def _num(x):
+        """Python number usable as a float attr without precision loss
+        (large ints stay on the exact tensor path)."""
+        if isinstance(x, (bool, _onp.bool_)) or \
+                not isinstance(x, (int, float)):
+            return False
+        return not isinstance(x, int) or abs(x) <= 2 ** 53
+
     def fn(a, b, **kw):
+        if not _have:
+            from ..ops.registry import _REGISTRY as _ops
+            _have.append((s_name in _ops, r_name in _ops))
+        s_ok, r_ok = _have[0]
+        if s_ok and _num(b) and not isinstance(a, (int, float)):
+            return invoke(s_name, a, scalar=float(b),
+                          is_int=isinstance(b, int), **kw)
+        if _num(a) and not isinstance(b, (int, float)):
+            if r_ok:
+                return invoke(r_name, b, scalar=float(a),
+                              is_int=isinstance(a, int), **kw)
+            if s_ok and _commutes:
+                return invoke(s_name, b, scalar=float(a),
+                              is_int=isinstance(a, int), **kw)
         return invoke(op, a, b, **kw)
     fn.__name__ = op.replace("_npi_", "")
     return fn

@@ -181,14 +181,19 @@ def _rldexp(data, scalar=0.0, is_int=False):
 for _n, _f in list(_BINARY_DIFF.items()) + list(_BINARY_NONDIFF.items()):
     _d = _n in _BINARY_DIFF
     _mx = "mod" if _n == "remainder" else _n
+    # no_jit: the scalar is a static attr — a per-op jit would compile
+    # one executable PER SCALAR VALUE (cache blowup for decaying-lr-style
+    # loops); the plain jnp call is one dispatch anyway, and under an
+    # outer jit/hybridize trace the kernel inlines with the scalar baked
+    # in, exactly like the reference graph attr
     _reg("_npi_%s_scalar" % _mx, _scalar_variant(_f, False),
-         differentiable=_d,
+         differentiable=_d, no_jit=True,
          aliases=(("_npi_%s_scalar" % _n,) if _mx != _n else ()))
     if _mx in _NONCOMMUTATIVE and _mx != "ldexp":
         _reg("_npi_r%s_scalar" % _mx, _scalar_variant(_f, True),
-             differentiable=_d)
+             differentiable=_d, no_jit=True)
 
-_reg("_npi_rldexp_scalar", _rldexp)
+_reg("_npi_rldexp_scalar", _rldexp, no_jit=True)
 alias("_npi_remainder", "_npi_mod")
 _reg("_npi_rarctan2", _binary(lambda a, b: jnp.arctan2(b, a)))
 _reg("_npi_rcopysign", _binary(lambda a, b: jnp.copysign(b, a)))
