@@ -122,8 +122,10 @@ _UNARY_NONDIFF = {"sign", "floor", "ceil", "round", "rint", "trunc", "fix",
                   "logical_not"}
 
 for _name, _fn in _UNARY.items():
+    _al = {"identity": ["_copy", "_np_copy"],
+           "gamma": ["_npx_gamma"]}.get(_name, [])
     register(_name, _fn, differentiable=_name not in _UNARY_NONDIFF,
-             aliases=["_copy"] if _name == "identity" else ())
+             aliases=_al)
 
 
 @register("clip")

@@ -32,7 +32,7 @@ def _dot(a, b, transpose_a=False, transpose_b=False):
     return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
 
 
-@register("batch_dot")
+@register("batch_dot", aliases=["_npx_batch_dot"])
 def _batch_dot(a, b, transpose_a=False, transpose_b=False):
     if transpose_a:
         a = jnp.swapaxes(a, -1, -2)

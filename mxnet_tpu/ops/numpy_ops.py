@@ -1094,3 +1094,40 @@ for _existing, _npi_names in [
         alias(_existing, *_npi_names)
     except KeyError:
         pass   # alias table is best-effort across op-set evolution
+
+# remaining 2.x internal spellings (early `_np_*` era + `_npx_*`
+# extended names) onto the existing kernels — graph-loading parity only.
+# Same best-effort guard as the table above (one mechanism, one place to
+# extend).  NOT aliased: _npx_cond is the control-flow cond
+# (control_flow.cc), unrelated to _npi_cond (linalg condition number) —
+# better an unregistered-op error than a silently wrong dispatch.
+for _existing, _names in [
+        ("_npi_sort", ["_npx_sort"]),
+        ("_npi_argsort", ["_npx_argsort"]),
+        ("_npi_one_hot", ["_npx_one_hot"]),
+        ("_npi_full_like", ["_np_full_like"]),
+        ("_npi_zeros_like", ["_np_zeros_like"]),
+        ("_npi_ones_like", ["_np_ones_like"]),
+        ("_npi_transpose", ["_np_transpose"]),
+        ("_npi_dot", ["_np_dot"]),
+        ("_npi_sum", ["_np_sum"]),
+        ("_npi_prod", ["_np_prod"]),
+        ("_npi_reshape", ["_np_reshape"])]:
+    try:
+        alias(_existing, *_names)
+    except KeyError:
+        pass   # best-effort across op-set evolution
+
+
+def _npx_nonzero(a):
+    # 2.x npx.nonzero convention: ONE (N, ndim) int64 index tensor
+    # (contrast _npi_nonzero, which returns ndim separate (N,) arrays)
+    import numpy as _hostnp
+    idx = _hostnp.nonzero(_hostnp.asarray(a))
+    # int64 unless x64 is off (jax truncates with a warning otherwise)
+    _i64 = jnp.int64 if jax.config.read("jax_enable_x64") else jnp.int32
+    return jnp.asarray(_hostnp.stack(idx, axis=-1), _i64) \
+        if idx else jnp.zeros((0, max(a.ndim, 1)), _i64)
+
+
+_reg("_npx_nonzero", _npx_nonzero, no_jit=True, differentiable=False)

@@ -1898,6 +1898,9 @@ SPECS.update({
     "_npi_rldexp": S(lambda: [f(3, 4), f(3, 4)],
                      ref=lambda a, b: np.asarray(b * np.exp2(a))),
     "_npi_spacing": S(lambda: [f(3, 4)], grad=False, ref=np.spacing),
+    "_npx_nonzero": S(lambda: [ints(3, 4, hi=2).astype(np.float32)],
+                      grad=False,
+                      ref=lambda x: np.stack(np.nonzero(x), axis=-1)),
 })
 
 
