@@ -342,7 +342,7 @@ def _cummin(a, axis=0):
 # indexing tail
 # ---------------------------------------------------------------------------
 
-@register("index_add", aliases=["_npx_index_add"])
+@register("index_add")
 def _index_add(data, index, value):
     return data.at[index.astype(jnp.int32)].add(value)
 
@@ -352,7 +352,7 @@ def _index_copy(data, index, value):
     return data.at[index.astype(jnp.int32)].set(value)
 
 
-@register("index_update", aliases=["_npx_index_update"])
+@register("index_update")
 def _index_update(data, index, value):
     return data.at[index.astype(jnp.int32)].set(value)
 

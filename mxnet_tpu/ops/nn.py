@@ -96,7 +96,19 @@ def _deconvolution(data, weight, bias=None, kernel=None, stride=None,
     rhs_spec = "IO" + spatial
     dn = lax.conv_dimension_numbers(data.shape, weight.shape,
                                     (lhs_spec, rhs_spec, lhs_spec))
-    # transposed conv: pad by effective-kernel-1 minus user pad
+    # transposed conv: pad by effective-kernel-1 minus user pad, and run
+    # the SPATIALLY FLIPPED kernel — Deconvolution is the transpose of
+    # correlation, which this dilated-conv emulation only reproduces with
+    # the flip (caught by the torch-oracle parity lane)
+    weight = jnp.flip(weight, axis=tuple(range(2, 2 + n)))
+    if num_group > 1:
+        # (in, out/g, *k) -> (in/g, out, *k): lax feature_group_count
+        # wants per-group input channels and GROUP-MAJOR output channels
+        cin, og = weight.shape[0], weight.shape[1]
+        w = weight.reshape((num_group, cin // num_group, og)
+                           + tuple(kernel))
+        weight = jnp.moveaxis(w, 0, 1).reshape(
+            (cin // num_group, og * num_group) + tuple(kernel))
     eff = [(k - 1) * d + 1 for k, d in zip(kernel, dilate)]
     pads = [(e - 1 - p, e - 1 - p + a) for e, p, a in zip(eff, pad, adj)]
     out = lax.conv_general_dilated(

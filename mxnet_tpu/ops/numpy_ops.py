@@ -1121,13 +1121,16 @@ for _existing, _names in [
 
 def _npx_nonzero(a):
     # 2.x npx.nonzero convention: ONE (N, ndim) int64 index tensor
-    # (contrast _npi_nonzero, which returns ndim separate (N,) arrays)
-    import numpy as _hostnp
-    idx = _hostnp.nonzero(_hostnp.asarray(a))
-    # int64 unless x64 is off (jax truncates with a warning otherwise)
+    # (contrast _npi_nonzero, which returns ndim separate (N,) arrays).
+    # np.argwhere IS this layout — reuse the argwhere kernel's host
+    # round-trip; int64 unless x64 is off (jax truncates otherwise).
     _i64 = jnp.int64 if jax.config.read("jax_enable_x64") else jnp.int32
-    return jnp.asarray(_hostnp.stack(idx, axis=-1), _i64) \
-        if idx else jnp.zeros((0, max(a.ndim, 1)), _i64)
+    return _REG_LOOKUP("_npi_argwhere")(a).astype(_i64)
+
+
+def _REG_LOOKUP(name):
+    from .registry import _REGISTRY
+    return _REGISTRY[name].fn
 
 
 _reg("_npx_nonzero", _npx_nonzero, no_jit=True, differentiable=False)

@@ -1,0 +1,266 @@
+"""Independent-oracle parity: heavyweight kernels vs torch (CPU).
+
+The operator battery checks ops against hand-written numpy references;
+torch is a fully independent implementation of the same math (reference
+pattern: tests/python/unittest/test_operator.py uses scipy/your-own-loop
+oracles for conv/rnn).  Forward AND backward are compared — both
+frameworks get the same cotangent.
+"""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import mxnet_tpu as mx               # noqa: E402
+from mxnet_tpu import autograd, gluon, nd  # noqa: E402
+from mxnet_tpu.ndarray import invoke  # noqa: E402
+
+RTOL, ATOL = 1e-4, 1e-4
+
+
+def _t(x, grad=False):
+    t = torch.tensor(x)
+    if grad:
+        t.requires_grad_(True)
+    return t
+
+
+def _close(ours, theirs, rtol=RTOL, atol=ATOL, what=""):
+    a = ours.asnumpy() if hasattr(ours, "asnumpy") else np.asarray(ours)
+    b = theirs.detach().numpy() if hasattr(theirs, "detach") \
+        else np.asarray(theirs)
+    np.testing.assert_allclose(a, b, rtol=rtol, atol=atol, err_msg=what)
+
+
+@pytest.mark.parametrize("cin,cout,k,s,p,d,g", [
+    (3, 8, 3, 1, 1, 1, 1),
+    (4, 8, 3, 2, 1, 2, 1),
+    (4, 4, 3, 1, 0, 1, 2),
+    (2, 6, 5, 2, 2, 1, 2),
+])
+def test_convolution_vs_torch(cin, cout, k, s, p, d, g):
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, cin, 12, 12).astype(np.float32)
+    w = rng.randn(cout, cin // g, k, k).astype(np.float32)
+    b = rng.randn(cout).astype(np.float32)
+
+    tx, tw, tb = _t(x, True), _t(w, True), _t(b, True)
+    to = torch.nn.functional.conv2d(tx, tw, tb, stride=s, padding=p,
+                                    dilation=d, groups=g)
+    go = rng.randn(*to.shape).astype(np.float32)
+    to.backward(_t(go))
+
+    xx, ww, bb = nd.array(x), nd.array(w), nd.array(b)
+    for v in (xx, ww, bb):
+        v.attach_grad()
+    with autograd.record():
+        o = invoke("Convolution", xx, ww, bb, kernel=(k, k),
+                   num_filter=cout, stride=(s, s), pad=(p, p),
+                   dilate=(d, d), num_group=g)
+    o.backward(nd.array(go))
+
+    _close(o, to, what="conv fwd")
+    _close(xx.grad, tx.grad, what="conv dx")
+    _close(ww.grad, tw.grad, what="conv dw")
+    _close(bb.grad, tb.grad, what="conv db")
+
+
+@pytest.mark.parametrize("cin,cout,k,s,p,adj,g", [
+    (4, 6, 3, 2, 1, 1, 1),
+    (4, 4, 4, 2, 1, 0, 2),
+    (3, 5, 3, 1, 0, 0, 1),
+])
+def test_deconvolution_vs_torch(cin, cout, k, s, p, adj, g):
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, cin, 7, 7).astype(np.float32)
+    w = rng.randn(cin, cout // g, k, k).astype(np.float32)
+
+    tx, tw = _t(x, True), _t(w, True)
+    to = torch.nn.functional.conv_transpose2d(
+        tx, tw, stride=s, padding=p, output_padding=adj, groups=g)
+    go = rng.randn(*to.shape).astype(np.float32)
+    to.backward(_t(go))
+
+    xx, ww = nd.array(x), nd.array(w)
+    xx.attach_grad()
+    ww.attach_grad()
+    with autograd.record():
+        o = invoke("Deconvolution", xx, ww, None, kernel=(k, k),
+                   num_filter=cout, stride=(s, s), pad=(p, p),
+                   adj=(adj, adj), num_group=g, no_bias=True)
+    o.backward(nd.array(go))
+
+    _close(o, to, what="deconv fwd")
+    _close(xx.grad, tx.grad, what="deconv dx")
+    _close(ww.grad, tw.grad, what="deconv dw")
+
+
+def test_pooling_vs_torch():
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 3, 10, 10).astype(np.float32)
+    # max pool with stride+pad
+    tx = _t(x, True)
+    to = torch.nn.functional.max_pool2d(tx, 3, stride=2, padding=1)
+    go = rng.randn(*to.shape).astype(np.float32)
+    to.backward(_t(go))
+    xx = nd.array(x)
+    xx.attach_grad()
+    with autograd.record():
+        o = invoke("Pooling", xx, kernel=(3, 3), pool_type="max",
+                   stride=(2, 2), pad=(1, 1))
+    o.backward(nd.array(go))
+    _close(o, to, what="maxpool fwd")
+    _close(xx.grad, tx.grad, what="maxpool dx")
+
+    # avg pool, no padding (sidesteps count_include_pad conventions)
+    to2 = torch.nn.functional.avg_pool2d(torch.tensor(x), 2, stride=2)
+    o2 = invoke("Pooling", nd.array(x), kernel=(2, 2), pool_type="avg",
+                stride=(2, 2))
+    _close(o2, to2, what="avgpool fwd")
+
+
+def test_batchnorm_train_vs_torch():
+    rng = np.random.RandomState(3)
+    C = 5
+    x = rng.randn(4, C, 6, 6).astype(np.float32)
+    gamma = rng.rand(C).astype(np.float32) + 0.5
+    beta = rng.randn(C).astype(np.float32)
+    rm = rng.randn(C).astype(np.float32)
+    rv = rng.rand(C).astype(np.float32) + 0.5
+    mom = 0.9   # MXNet: moving = mom*moving + (1-mom)*batch
+
+    trm, trv = _t(rm.copy()), _t(rv.copy())
+    tx = _t(x, True)
+    tg, tb = _t(gamma, True), _t(beta, True)
+    to = torch.nn.functional.batch_norm(
+        tx, trm, trv, tg, tb, training=True, momentum=1.0 - mom, eps=1e-5)
+    go = rng.randn(*to.shape).astype(np.float32)
+    to.backward(_t(go))
+
+    xx = nd.array(x)
+    gg, bb = nd.array(gamma), nd.array(beta)
+    mmean, mvar = nd.array(rm.copy()), nd.array(rv.copy())
+    xx.attach_grad()
+    gg.attach_grad()
+    bb.attach_grad()
+    with autograd.record():
+        o = invoke("BatchNorm", xx, gg, bb, mmean, mvar, eps=1e-5,
+                   momentum=mom, fix_gamma=False, training=True)
+    o.backward(nd.array(go))
+
+    _close(o, to, what="bn fwd")
+    _close(xx.grad, tx.grad, rtol=1e-3, atol=1e-4, what="bn dx")
+    _close(gg.grad, tg.grad, rtol=1e-3, atol=1e-4, what="bn dgamma")
+    _close(bb.grad, tb.grad, what="bn dbeta")
+    # running-stat update (torch uses unbiased var for the running stat;
+    # MXNet uses biased — rescale before comparing)
+    n = x.size // C
+    _close(mmean, trm, what="bn running mean")
+    rv_ours = mvar.asnumpy()
+    rv_theirs = trv.numpy()
+    batch_biased = x.transpose(1, 0, 2, 3).reshape(C, -1).var(axis=1)
+    expect_ours = mom * rv + (1 - mom) * batch_biased
+    np.testing.assert_allclose(rv_ours, expect_ours, rtol=1e-4,
+                               err_msg="bn running var (mxnet semantics)")
+    expect_theirs = mom * rv + (1 - mom) * batch_biased * n / (n - 1)
+    np.testing.assert_allclose(rv_theirs, expect_theirs, rtol=1e-4,
+                               err_msg="torch unbiased-var sanity")
+
+
+def test_layernorm_vs_torch():
+    rng = np.random.RandomState(4)
+    x = rng.randn(3, 7, 16).astype(np.float32)
+    gamma = rng.rand(16).astype(np.float32) + 0.5
+    beta = rng.randn(16).astype(np.float32)
+    tx, tg, tb = _t(x, True), _t(gamma, True), _t(beta, True)
+    to = torch.nn.functional.layer_norm(tx, (16,), tg, tb, eps=1e-5)
+    go = rng.randn(*to.shape).astype(np.float32)
+    to.backward(_t(go))
+
+    xx, gg, bb = nd.array(x), nd.array(gamma), nd.array(beta)
+    for v in (xx, gg, bb):
+        v.attach_grad()
+    with autograd.record():
+        o = invoke("LayerNorm", xx, gg, bb, axis=-1, eps=1e-5)
+    o.backward(nd.array(go))
+    _close(o, to, what="ln fwd")
+    _close(xx.grad, tx.grad, rtol=1e-3, atol=1e-4, what="ln dx")
+    _close(gg.grad, tg.grad, rtol=1e-3, atol=1e-4, what="ln dgamma")
+    _close(bb.grad, tb.grad, what="ln dbeta")
+
+
+def _copy_rnn_params(gluon_net, torch_net, num_layers, bidirectional):
+    """gluon l{k}_/r{k}_ params <- torch weight_*_l{k}[_reverse] (same
+    (G*H, in) layouts and gate orders for LSTM i,f,g,o / GRU r,z,n)."""
+    params = gluon_net.collect_params()
+    for layer in range(num_layers):
+        for direction, prefix in ((0, "l"), (1, "r")):
+            if direction == 1 and not bidirectional:
+                continue
+            sfx = "_reverse" if direction else ""
+            pairs = [
+                ("%s%d_i2h_weight" % (prefix, layer),
+                 "weight_ih_l%d%s" % (layer, sfx)),
+                ("%s%d_h2h_weight" % (prefix, layer),
+                 "weight_hh_l%d%s" % (layer, sfx)),
+                ("%s%d_i2h_bias" % (prefix, layer),
+                 "bias_ih_l%d%s" % (layer, sfx)),
+                ("%s%d_h2h_bias" % (prefix, layer),
+                 "bias_hh_l%d%s" % (layer, sfx)),
+            ]
+            for gname, tname in pairs:
+                t = getattr(torch_net, tname).detach().numpy()
+                params[gname].set_data(nd.array(t))
+
+
+@pytest.mark.parametrize("mode,bidirectional,layers", [
+    ("lstm", False, 1), ("lstm", True, 2), ("gru", False, 2),
+    ("gru", True, 1),
+])
+def test_rnn_vs_torch(mode, bidirectional, layers):
+    T, N, I, H = 7, 3, 5, 6
+    rng = np.random.RandomState(5)
+    x = rng.randn(T, N, I).astype(np.float32)
+
+    tnet = (torch.nn.LSTM if mode == "lstm" else torch.nn.GRU)(
+        I, H, num_layers=layers, bidirectional=bidirectional)
+    gnet = (gluon.rnn.LSTM if mode == "lstm" else gluon.rnn.GRU)(
+        H, num_layers=layers, bidirectional=bidirectional)
+    gnet.initialize()
+    gnet(nd.zeros((T, N, I)))     # shape inference
+    _copy_rnn_params(gnet, tnet, layers, bidirectional)
+
+    tx = _t(x, True)
+    to, _ = tnet(tx)
+    go = rng.randn(*to.shape).astype(np.float32)
+    to.backward(_t(go))
+
+    xx = nd.array(x)
+    xx.attach_grad()
+    with autograd.record():
+        o = gnet(xx)
+    o.backward(nd.array(go))
+
+    _close(o, to, rtol=1e-3, atol=1e-4, what="rnn fwd")
+    _close(xx.grad, tx.grad, rtol=1e-3, atol=1e-4, what="rnn dx")
+
+
+def test_embedding_grad_vs_torch():
+    rng = np.random.RandomState(6)
+    V, D = 11, 4
+    w = rng.randn(V, D).astype(np.float32)
+    idx = rng.randint(0, V, (3, 5)).astype(np.int32)
+
+    tw = _t(w, True)
+    to = torch.nn.functional.embedding(torch.tensor(idx).long(), tw)
+    go = rng.randn(*to.shape).astype(np.float32)
+    to.backward(_t(go))
+
+    ww = nd.array(w)
+    ww.attach_grad()
+    with autograd.record():
+        o = invoke("Embedding", nd.array(idx), ww, input_dim=V,
+                   output_dim=D)
+    o.backward(nd.array(go))
+    _close(o, to, what="embedding fwd")
+    _close(ww.grad, tw.grad, what="embedding dweight")
