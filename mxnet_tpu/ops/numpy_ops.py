@@ -1122,15 +1122,13 @@ for _existing, _names in [
 def _npx_nonzero(a):
     # 2.x npx.nonzero convention: ONE (N, ndim) int64 index tensor
     # (contrast _npi_nonzero, which returns ndim separate (N,) arrays).
-    # np.argwhere IS this layout — reuse the argwhere kernel's host
-    # round-trip; int64 unless x64 is off (jax truncates otherwise).
+    # np.argwhere IS this layout — call the argwhere kernel directly;
+    # 0-d inputs keep one index column (the reference treats a scalar as
+    # shape-(1,)); int64 unless x64 is off (jax truncates otherwise).
     _i64 = jnp.int64 if jax.config.read("jax_enable_x64") else jnp.int32
-    return _REG_LOOKUP("_npi_argwhere")(a).astype(_i64)
-
-
-def _REG_LOOKUP(name):
-    from .registry import _REGISTRY
-    return _REGISTRY[name].fn
+    if a.ndim == 0:
+        a = a.reshape(1)
+    return _npi_argwhere(a).astype(_i64)
 
 
 _reg("_npx_nonzero", _npx_nonzero, no_jit=True, differentiable=False)

@@ -264,3 +264,175 @@ def test_embedding_grad_vs_torch():
     o.backward(nd.array(go))
     _close(o, to, what="embedding fwd")
     _close(ww.grad, tw.grad, what="embedding dweight")
+
+
+def test_ctc_loss_vs_torch():
+    rng = np.random.RandomState(7)
+    T, N, C, L = 12, 3, 6, 4
+    pred = rng.randn(T, N, C).astype(np.float32)
+    # labels in 1..C-1 (blank=0), variable lengths, 0-padded
+    lab_lens = np.array([4, 2, 3], np.int32)
+    label = np.zeros((N, L), np.int32)
+    for i, ln in enumerate(lab_lens):
+        label[i, :ln] = rng.randint(1, C, ln)
+    in_lens = np.array([12, 10, 11], np.int32)
+
+    tp = _t(pred, True)
+    tlogp = torch.nn.functional.log_softmax(tp, dim=-1)
+    targets = torch.tensor(
+        np.concatenate([label[i, :lab_lens[i]] for i in range(N)]).astype(
+            np.int64))
+    tloss = torch.nn.functional.ctc_loss(
+        tlogp, targets, torch.tensor(in_lens.astype(np.int64)),
+        torch.tensor(lab_lens.astype(np.int64)), blank=0,
+        reduction="none", zero_infinity=False)
+    tloss.sum().backward()
+
+    xx = nd.array(pred)
+    xx.attach_grad()
+    with autograd.record():
+        o = invoke("CTCLoss", xx, nd.array(label),
+                   nd.array(in_lens), nd.array(lab_lens))
+    o.backward(nd.array(np.ones(N, np.float32)))
+    _close(o, tloss, rtol=1e-3, atol=1e-4, what="ctc loss")
+    _close(xx.grad, tp.grad, rtol=1e-3, atol=1e-4, what="ctc dpred")
+
+
+def test_softmax_axis_vs_torch():
+    rng = np.random.RandomState(8)
+    x = rng.randn(2, 5, 3, 4).astype(np.float32)
+    for ax in (1, -1):
+        tx = _t(x, True)
+        to = torch.nn.functional.softmax(tx, dim=ax)
+        go = rng.randn(*to.shape).astype(np.float32)
+        to.backward(_t(go))
+        xx = nd.array(x)
+        xx.attach_grad()
+        with autograd.record():
+            o = invoke("softmax", xx, axis=ax)
+        o.backward(nd.array(go))
+        _close(o, to, what="softmax fwd ax=%d" % ax)
+        _close(xx.grad, tx.grad, rtol=1e-3, atol=1e-5,
+               what="softmax dx ax=%d" % ax)
+
+
+def test_group_instance_norm_vs_torch():
+    rng = np.random.RandomState(9)
+    x = rng.randn(2, 6, 5, 5).astype(np.float32)
+    g = rng.rand(6).astype(np.float32) + 0.5
+    b = rng.randn(6).astype(np.float32)
+
+    to = torch.nn.functional.group_norm(
+        torch.tensor(x), 3, torch.tensor(g), torch.tensor(b), eps=1e-5)
+    o = invoke("GroupNorm", nd.array(x), nd.array(g), nd.array(b),
+               num_groups=3, eps=1e-5)
+    _close(o, to, what="groupnorm fwd")
+
+    to2 = torch.nn.functional.instance_norm(
+        torch.tensor(x), weight=torch.tensor(g), bias=torch.tensor(b),
+        eps=1e-3)
+    o2 = invoke("InstanceNorm", nd.array(x), nd.array(g), nd.array(b),
+                eps=1e-3)
+    _close(o2, to2, rtol=1e-3, atol=1e-5, what="instancenorm fwd")
+
+
+@pytest.mark.parametrize("act", ["relu", "tanh"])
+def test_vanilla_rnn_vs_torch(act):
+    T, N, I, H = 6, 2, 4, 5
+    rng = np.random.RandomState(10)
+    x = rng.randn(T, N, I).astype(np.float32)
+    tnet = torch.nn.RNN(I, H, nonlinearity=act)
+    gnet = gluon.rnn.RNN(H, activation=act)
+    gnet.initialize()
+    gnet(nd.zeros((T, N, I)))
+    _copy_rnn_params(gnet, tnet, 1, False)
+    to, _ = tnet(_t(x))
+    o = gnet(nd.array(x))
+    _close(o, to, rtol=1e-4, atol=1e-5, what="vanilla rnn fwd")
+
+
+def test_attention_vs_torch_sdpa():
+    from mxnet_tpu.ops.attention import attention_core, attention_impl_scope
+    import jax
+    rng = np.random.RandomState(11)
+    B, H, S, D = 2, 4, 256, 128     # aligned so pallas path engages
+    q = rng.randn(B, H, S, D).astype(np.float32)
+    k = rng.randn(B, H, S, D).astype(np.float32)
+    v = rng.randn(B, H, S, D).astype(np.float32)
+    for causal in (False, True):
+        tq, tk, tv = _t(q, True), _t(k, True), _t(v, True)
+        to = torch.nn.functional.scaled_dot_product_attention(
+            tq, tk, tv, is_causal=causal)
+        go = rng.randn(*to.shape).astype(np.float32)
+        to.backward(_t(go))
+        for impl in ("pallas", "xla"):
+            with attention_impl_scope(impl):
+                o, vjp = jax.vjp(
+                    lambda q_, k_, v_: attention_core(q_, k_, v_,
+                                                      causal=causal),
+                    q, k, v)
+                dq, dk, dv = vjp(go)
+            _close(o, to, rtol=2e-3, atol=2e-3,
+                   what="sdpa fwd %s causal=%s" % (impl, causal))
+            for ours, theirs, nm in ((dq, tq.grad, "dq"),
+                                     (dk, tk.grad, "dk"),
+                                     (dv, tv.grad, "dv")):
+                _close(ours, theirs, rtol=2e-3, atol=2e-3,
+                       what="sdpa %s %s causal=%s" % (nm, impl, causal))
+
+
+def test_bilinear_sampler_vs_torch_grid_sample():
+    rng = np.random.RandomState(12)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    # strictly in-range grid: convention parity on the interpolation math
+    grid = (rng.rand(2, 2, 5, 5).astype(np.float32) * 1.8 - 0.9)
+    tg = torch.tensor(np.moveaxis(grid, 1, -1))     # (N, Ho, Wo, 2)
+    to = torch.nn.functional.grid_sample(
+        torch.tensor(x), tg, mode="bilinear", align_corners=True)
+    o = invoke("BilinearSampler", nd.array(x), nd.array(grid))
+    _close(o, to, rtol=1e-4, atol=1e-5, what="bilinear sampler")
+
+
+def test_trainer_sgd_adam_vs_torch_optim():
+    """3 full steps of Dense + Trainer vs torch Linear + optim — wires
+    gluon Trainer, optimizer update ops, and autograd into one oracle."""
+    rng = np.random.RandomState(13)
+    w0 = rng.randn(3, 5).astype(np.float32)
+    b0 = rng.randn(3).astype(np.float32)
+    xs = rng.randn(4, 5).astype(np.float32)
+    ys = rng.randn(4, 3).astype(np.float32)
+
+    for opt_name, opt_kw, topt_cls, topt_kw in [
+        ("sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 0.01},
+         torch.optim.SGD, {"lr": 0.1, "momentum": 0.9,
+                           "weight_decay": 0.01}),
+        ("adam", {"learning_rate": 0.05},
+         torch.optim.Adam, {"lr": 0.05}),
+    ]:
+        net = gluon.nn.Dense(3, in_units=5)
+        net.initialize()
+        net.weight.set_data(nd.array(w0))
+        net.bias.set_data(nd.array(b0))
+        trainer = gluon.Trainer(net.collect_params(), opt_name, opt_kw)
+
+        tnet = torch.nn.Linear(5, 3)
+        with torch.no_grad():
+            tnet.weight.copy_(torch.tensor(w0))
+            tnet.bias.copy_(torch.tensor(b0))
+        topt = topt_cls(tnet.parameters(), **topt_kw)
+
+        for _ in range(3):
+            with autograd.record():
+                loss = ((net(nd.array(xs)) - nd.array(ys)) ** 2).mean()
+            loss.backward()
+            trainer.step(1, ignore_stale_grad=True)
+
+            topt.zero_grad()
+            tl = ((tnet(torch.tensor(xs)) - torch.tensor(ys)) ** 2).mean()
+            tl.backward()
+            topt.step()
+
+        _close(net.weight.data(), tnet.weight, rtol=1e-4, atol=1e-5,
+               what="%s weight after 3 steps" % opt_name)
+        _close(net.bias.data(), tnet.bias, rtol=1e-4, atol=1e-5,
+               what="%s bias after 3 steps" % opt_name)
