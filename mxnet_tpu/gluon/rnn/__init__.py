@@ -1,9 +1,11 @@
 """Gluon recurrent API (reference: python/mxnet/gluon/rnn/)."""
 from .rnn_layer import RNN, LSTM, GRU
 from .rnn_cell import (RecurrentCell, HybridRecurrentCell, RNNCell, LSTMCell,
-                       GRUCell, SequentialRNNCell, DropoutCell,
-                       BidirectionalCell, ResidualCell, ZoneoutCell)
+                       GRUCell, SequentialRNNCell, HybridSequentialRNNCell,
+                       DropoutCell, ModifierCell, BidirectionalCell,
+                       ResidualCell, ZoneoutCell)
 
 __all__ = ["RNN", "LSTM", "GRU", "RecurrentCell", "HybridRecurrentCell",
            "RNNCell", "LSTMCell", "GRUCell", "SequentialRNNCell",
-           "DropoutCell", "BidirectionalCell", "ResidualCell", "ZoneoutCell"]
+           "HybridSequentialRNNCell", "DropoutCell", "ModifierCell",
+           "BidirectionalCell", "ResidualCell", "ZoneoutCell"]

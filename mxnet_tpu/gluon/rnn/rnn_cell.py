@@ -20,7 +20,7 @@ from ..parameter import Parameter
 
 __all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell",
            "GRUCell", "SequentialRNNCell", "DropoutCell", "BidirectionalCell",
-           "ResidualCell", "ZoneoutCell"]
+           "ResidualCell", "ZoneoutCell", "ModifierCell", "HybridSequentialRNNCell"]
 
 
 class RecurrentCell(HybridBlock):
@@ -250,7 +250,7 @@ class DropoutCell(RecurrentCell):
         return inputs, states
 
 
-class _ModifierCell(RecurrentCell):
+class ModifierCell(RecurrentCell):
     def __init__(self, base_cell):
         super().__init__()
         base_cell._modified = True
@@ -267,7 +267,7 @@ class _ModifierCell(RecurrentCell):
         return begin
 
 
-class ResidualCell(_ModifierCell):
+class ResidualCell(ModifierCell):
     """Reference: rnn.ResidualCell — output += input."""
 
     def forward(self, inputs, states):
@@ -275,7 +275,7 @@ class ResidualCell(_ModifierCell):
         return output + inputs, states
 
 
-class ZoneoutCell(_ModifierCell):
+class ZoneoutCell(ModifierCell):
     """Reference: rnn.ZoneoutCell — stochastically preserve prev states."""
 
     def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
@@ -318,6 +318,13 @@ class ZoneoutCell(_ModifierCell):
             new_states = next_states
         self._prev_output = output
         return output, new_states
+
+
+class HybridSequentialRNNCell(SequentialRNNCell):
+    """Hybrid-capable sequential stack (reference: rnn/rnn_cell.py
+    HybridSequentialRNNCell).  Cells here are HybridBlocks already, so
+    the stacking semantics are SequentialRNNCell's; the distinct class
+    keeps reference API parity (isinstance checks, repr)."""
 
 
 class BidirectionalCell(RecurrentCell):

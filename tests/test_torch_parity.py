@@ -392,6 +392,16 @@ def test_bilinear_sampler_vs_torch_grid_sample():
     o = invoke("BilinearSampler", nd.array(x), nd.array(grid))
     _close(o, to, rtol=1e-4, atol=1e-5, what="bilinear sampler")
 
+    # out-of-range grid: zero padding outside the image (reference
+    # bilinear_sampler.cc semantics)
+    grid2 = (rng.rand(2, 2, 5, 5).astype(np.float32) * 3.0 - 1.5)
+    tg2 = torch.tensor(np.moveaxis(grid2, 1, -1))
+    to2 = torch.nn.functional.grid_sample(
+        torch.tensor(x), tg2, mode="bilinear", padding_mode="zeros",
+        align_corners=True)
+    o2 = invoke("BilinearSampler", nd.array(x), nd.array(grid2))
+    _close(o2, to2, rtol=1e-4, atol=1e-5, what="bilinear sampler OOB")
+
 
 def test_trainer_sgd_adam_vs_torch_optim():
     """3 full steps of Dense + Trainer vs torch Linear + optim — wires
@@ -436,3 +446,38 @@ def test_trainer_sgd_adam_vs_torch_optim():
                what="%s weight after 3 steps" % opt_name)
         _close(net.bias.data(), tnet.bias, rtol=1e-4, atol=1e-5,
                what="%s bias after 3 steps" % opt_name)
+
+
+def test_pooling_conventions_vs_torch():
+    """MXNet pooling_convention='full' == torch ceil_mode=True;
+    count_include_pad both ways on padded avg pool."""
+    rng = np.random.RandomState(14)
+    # 10x10: (10-3) % 2 != 0, so ceil gives 5 outputs vs floor's 4 —
+    # the 'full' padding path actually engages
+    x = rng.randn(2, 3, 10, 10).astype(np.float32)
+
+    to = torch.nn.functional.max_pool2d(torch.tensor(x), 3, stride=2,
+                                        ceil_mode=True)
+    o = invoke("Pooling", nd.array(x), kernel=(3, 3), pool_type="max",
+               stride=(2, 2), pooling_convention="full")
+    _close(o, to, what="maxpool full/ceil")
+
+    for cip in (True, False):
+        to2 = torch.nn.functional.avg_pool2d(
+            torch.tensor(x), 3, stride=2, padding=1,
+            count_include_pad=cip)
+        o2 = invoke("Pooling", nd.array(x), kernel=(3, 3),
+                    pool_type="avg", stride=(2, 2), pad=(1, 1),
+                    count_include_pad=cip)
+        _close(o2, to2, what="avgpool count_include_pad=%s" % cip)
+
+
+def test_lrn_vs_torch():
+    rng = np.random.RandomState(15)
+    x = rng.randn(2, 8, 6, 6).astype(np.float32)
+    alpha, beta, k, n = 1e-3, 0.75, 2.0, 5
+    to = torch.nn.functional.local_response_norm(
+        torch.tensor(x), n, alpha=alpha, beta=beta, k=k)
+    o = invoke("LRN", nd.array(x), alpha=alpha, beta=beta, knorm=k,
+               nsize=n)
+    _close(o, to, rtol=1e-4, atol=1e-5, what="lrn fwd")
