@@ -302,6 +302,69 @@ class LSTMBias(Initializer):
 
 
 @register
+class FusedRNN(Initializer):
+    """Initialize a fused-RNN packed parameter blob (reference: class
+    FusedRNN): the flat cuDNN-layout vector is split into per-layer/
+    direction i2h/h2h weight matrices and biases (the layout
+    ops/rnn.py._unpack_params reads), each initialized with `init`, with
+    the LSTM forget-gate bias set to forget_bias."""
+
+    def __init__(self, init, num_hidden, num_layers, mode,
+                 bidirectional=False, forget_bias=1.0):
+        if init is not None and not isinstance(init, Initializer):
+            init = create(init)
+        if init is not None and not isinstance(init, Initializer):
+            raise TypeError("FusedRNN needs an Initializer (or its name); "
+                            "got %r" % (type(init).__name__,))
+        super().__init__(init=init.dumps() if init else None,
+                         num_hidden=num_hidden, num_layers=num_layers,
+                         mode=mode, bidirectional=bidirectional,
+                         forget_bias=forget_bias)
+        self._init = init
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        from .ops.rnn import _GATES, rnn_param_size
+        gates = _GATES[self._mode]
+        H = self._num_hidden
+        dirs = 2 if self._bidirectional else 1
+        gh = gates * H
+        total = arr.shape[0]
+        # infer input_size from the blob length (reference does the same
+        # via the RNN op's shape inference)
+        #   total = dirs*gh*(I + H) + (L-1)*dirs*gh*(H*dirs + H) + L*dirs*2*gh
+        rest = total - self._num_layers * dirs * 2 * gh \
+            - (self._num_layers - 1) * dirs * gh * (H * dirs + H)
+        input_size = rest // (dirs * gh) - H
+        assert rnn_param_size(self._num_layers, input_size, H, self._mode,
+                              self._bidirectional) == total, \
+            "FusedRNN: blob length does not match the declared geometry"
+        out = _np.zeros(total, dtype=_np.float64)
+        offset = 0
+        for layer in range(self._num_layers):
+            isz = input_size if layer == 0 else H * dirs
+            for _ in range(dirs):
+                for cols in (isz, H):
+                    w = _np.zeros((gh, cols))
+                    if self._init is not None:
+                        self._init("%s_weight" % desc, w)
+                    out[offset:offset + w.size] = w.reshape(-1)
+                    offset += w.size
+        for _ in range(self._num_layers * dirs * 2):
+            b = _np.zeros(gh)
+            if self._mode == "lstm":
+                # gate order i, f, g, o (ops/rnn.py _cell_step)
+                b[H:2 * H] = self._forget_bias / 2.0
+            out[offset:offset + gh] = b
+            offset += gh
+        arr[:] = out.reshape(arr.shape)
+
+
+@register
 class Mixed(Initializer):
     """Pattern→initializer dispatch (reference: class Mixed)."""
 

@@ -19,7 +19,7 @@ __all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
            "F1", "MCC", "Perplexity", "MAE", "MSE", "RMSE", "CrossEntropy",
            "NegativeLogLikelihood", "PearsonCorrelation", "Loss",
            "CustomMetric", "np", "create", "check_label_shapes",
-           "VOCMApMetric", "VOC07MApMetric"]
+           "VOCMApMetric", "VOC07MApMetric", "Torch", "Caffe"]
 
 _METRIC_REGISTRY = {}
 
@@ -415,6 +415,26 @@ class Loss(EvalMetric):
             loss = float(_as_numpy(pred).sum())
             self.sum_metric += loss
             self.num_inst += int(_np.prod(_as_numpy(pred).shape))
+
+
+@register
+class Torch(Loss):
+    """Deprecated alias metric for torch criterion outputs (reference:
+    metric.Torch — Loss under another name)."""
+
+    def __init__(self, name="torch", output_names=None, label_names=None):
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names)
+
+
+@register
+class Caffe(Loss):
+    """Deprecated alias metric for caffe criterion outputs (reference:
+    metric.Caffe — Loss under another name)."""
+
+    def __init__(self, name="caffe", output_names=None, label_names=None):
+        super().__init__(name, output_names=output_names,
+                         label_names=label_names)
 
 
 @register
