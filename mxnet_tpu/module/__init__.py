@@ -438,6 +438,7 @@ class Module(BaseModule):
                 raise MXNetError(
                     "init_params/set_params got params not in the symbol: "
                     "%s (pass allow_extra=True to ignore)" % extra)
+        attr_map = self._symbol.attr_dict()
         for name in self._param_names:
             arr = self._exec.arg_dict[name]
             if arg_params is not None and name in arg_params:
@@ -449,7 +450,8 @@ class Module(BaseModule):
                     "missing parameter %r (pass allow_missing=True to "
                     "initialize absent params)" % name)
             elif initializer is not None:
-                initializer(init_mod.InitDesc(name), arr)
+                initializer(init_mod.InitDesc(
+                    name, attrs=attr_map.get(name)), arr)
         for name in self._aux_names:
             arr = self._exec.aux_dict[name]
             if aux_params is not None and name in aux_params:
@@ -457,7 +459,8 @@ class Module(BaseModule):
                     aux_params[name], NDArray)
                     else jnp.asarray(aux_params[name]))
             elif initializer is not None:
-                initializer(init_mod.InitDesc(name), arr)
+                initializer(init_mod.InitDesc(
+                    name, attrs=attr_map.get(name)), arr)
         self.params_initialized = True
 
     def get_params(self):

@@ -16,7 +16,14 @@ import numpy as _np
 from ..base import MXNetError
 from ..io import DataBatch, DataDesc, DataIter
 
-__all__ = ["BucketSentenceIter"]
+from .rnn_cell import (RNNParams, BaseRNNCell, RNNCell, LSTMCell, GRUCell,
+                       FusedRNNCell, SequentialRNNCell, BidirectionalCell,
+                       DropoutCell, ZoneoutCell, ResidualCell, ModifierCell)
+
+__all__ = ["BucketSentenceIter", "RNNParams", "BaseRNNCell", "RNNCell",
+           "LSTMCell", "GRUCell", "FusedRNNCell", "SequentialRNNCell",
+           "BidirectionalCell", "DropoutCell", "ZoneoutCell",
+           "ResidualCell", "ModifierCell"]
 
 
 class BucketSentenceIter(DataIter):

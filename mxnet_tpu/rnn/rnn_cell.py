@@ -1,0 +1,591 @@
+"""mx.rnn symbolic cell API (reference: python/mxnet/rnn/rnn_cell.py).
+
+The v1.x pre-Gluon recurrent API: cells compose SYMBOLS (weight
+variables are auto-shared via RNNParams), `unroll` builds the
+time-unrolled graph that BucketingModule compiles per bucket, and
+FusedRNNCell wraps the fused RNN op (cuDNN role → ops/rnn.py lax.scan).
+
+Deviation (documented): `begin_state()` needs `batch_size` when called
+standalone — the reference's shape-0 placeholder trick rides nnvm's
+partial shape inference, which the jax.eval_shape-based inference here
+does not model.  `unroll(begin_state=None)` needs no batch size: the
+initial state is composed from the input symbol itself.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..base import MXNetError
+from .. import symbol as sym
+
+__all__ = ["RNNParams", "BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
+           "FusedRNNCell", "SequentialRNNCell", "BidirectionalCell",
+           "DropoutCell", "ZoneoutCell", "ResidualCell"]
+
+
+class RNNParams:
+    """Container sharing weight Symbols between steps (reference:
+    rnn_cell.py class RNNParams)."""
+
+    def __init__(self, prefix: str = ""):
+        self._prefix = prefix
+        self._params = {}
+
+    def get(self, name: str, **kwargs) -> "sym.Symbol":
+        name = self._prefix + name
+        if name not in self._params:
+            self._params[name] = sym.Variable(name, **kwargs)
+        return self._params[name]
+
+
+class BaseRNNCell:
+    """Abstract cell (reference: class BaseRNNCell)."""
+
+    def __init__(self, prefix: str = "", params: Optional[RNNParams] = None):
+        if params is None:
+            params = RNNParams(prefix)
+            self._own_params = True
+        else:
+            self._own_params = False
+        self._prefix = prefix
+        self._params = params
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+
+    @property
+    def params(self) -> RNNParams:
+        self._own_params = False
+        return self._params
+
+    @property
+    def state_info(self):
+        raise NotImplementedError
+
+    @property
+    def _gate_names(self):
+        return ()
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError
+
+    def begin_state(self, func=None, batch_size: int = 0, **kwargs):
+        """Initial-state symbols.  With batch_size > 0 these are concrete
+        zeros; without it, unroll() composes the zeros from the inputs."""
+        assert not self._modified, \
+            "After applying modifier cells the base cell cannot be called"
+        states = []
+        for info in self.state_info:
+            self._init_counter += 1
+            if batch_size <= 0:
+                raise MXNetError(
+                    "begin_state: pass batch_size=N (the reference's "
+                    "shape-0 placeholder needs nnvm partial inference; "
+                    "unroll(begin_state=None) avoids the need entirely)")
+            shape = (batch_size,) + tuple(info["shape"][1:])
+            if func is None:
+                states.append(sym._zeros(
+                    shape=shape,
+                    name="%sbegin_state_%d" % (self._prefix,
+                                               self._init_counter)))
+            else:
+                states.append(func(
+                    name="%sbegin_state_%d" % (self._prefix,
+                                               self._init_counter),
+                    shape=shape, **kwargs))
+        return states
+
+    def _zeros_from(self, x_step, n_units, name):
+        """(N, n_units) zeros composed from an input symbol (batch size
+        stays symbolic — no placeholder shapes needed)."""
+        col = sym.slice_axis(x_step, axis=-1, begin=0, end=1)
+        z = sym._zeros(shape=(1, n_units), name=name + "_zconst")
+        return sym.broadcast_add(sym._mul_scalar(col, scalar=0.0), z)
+
+    def _default_states(self, x_step):
+        states = []
+        for i, info in enumerate(self.state_info):
+            states.append(self._zeros_from(
+                x_step, info["shape"][-1],
+                "%sbegin_state_%d" % (self._prefix, i)))
+        return states
+
+    def unpack_weights(self, args):
+        """Fused blob → per-gate matrices; base cells store unfused
+        already (reference contract: dict passthrough)."""
+        return dict(args)
+
+    def pack_weights(self, args):
+        return dict(args)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        inputs, _ = _normalize_sequence(length, inputs, layout, False)
+        if begin_state is None:
+            begin_state = self._default_states(inputs[0])
+        states = begin_state
+        outputs = []
+        for i in range(length):
+            output, states = self(inputs[i], states)
+            outputs.append(output)
+        outputs, _ = _normalize_sequence(length, outputs, layout,
+                                         merge_outputs)
+        return outputs, states
+
+
+def _normalize_sequence(length, inputs, layout, merge, in_layout=None):
+    """(reference: rnn_cell.py _normalize_sequence) list ⇄ merged tensor."""
+    axis = layout.find("T")
+    if isinstance(inputs, sym.Symbol):
+        if merge is False:
+            sliced = sym.split(inputs, num_outputs=length, axis=axis,
+                               squeeze_axis=True)
+            inputs = list(sliced) if length > 1 else [sliced]
+    else:
+        inputs = list(inputs)
+        if merge is True:
+            inputs = [sym.expand_dims(x, axis=axis) for x in inputs]
+            inputs = sym.concat(*inputs, dim=axis)
+    return inputs, axis
+
+
+class RNNCell(BaseRNNCell):
+    """Vanilla RNN cell (reference: class RNNCell)."""
+
+    def __init__(self, num_hidden, activation="tanh", prefix="rnn_",
+                 params=None):
+        super().__init__(prefix, params)
+        self._num_hidden = num_hidden
+        self._activation = activation
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("",)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h = sym.FullyConnected(data=inputs, weight=self._iW,
+                                 bias=self._iB,
+                                 num_hidden=self._num_hidden,
+                                 name="%si2h" % name)
+        h2h = sym.FullyConnected(data=states[0], weight=self._hW,
+                                 bias=self._hB,
+                                 num_hidden=self._num_hidden,
+                                 name="%sh2h" % name)
+        output = sym.Activation(i2h + h2h, act_type=self._activation,
+                                name="%sout" % name)
+        return output, [output]
+
+
+class LSTMCell(BaseRNNCell):
+    """LSTM cell, gate order i f g o like the fused op (reference:
+    class LSTMCell; gate order matches ops/rnn.py _cell_step)."""
+
+    def __init__(self, num_hidden, prefix="lstm_", params=None,
+                 forget_bias=1.0):
+        super().__init__(prefix, params)
+        self._num_hidden = num_hidden
+        self._iW = self.params.get("i2h_weight")
+        self._hW = self.params.get("h2h_weight")
+        from ..initializer import LSTMBias
+        self._iB = self.params.get(
+            "i2h_bias", init=LSTMBias(forget_bias=forget_bias))
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"},
+                {"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("_i", "_f", "_c", "_o")
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h = sym.FullyConnected(data=inputs, weight=self._iW,
+                                 bias=self._iB,
+                                 num_hidden=self._num_hidden * 4,
+                                 name="%si2h" % name)
+        h2h = sym.FullyConnected(data=states[0], weight=self._hW,
+                                 bias=self._hB,
+                                 num_hidden=self._num_hidden * 4,
+                                 name="%sh2h" % name)
+        gates = i2h + h2h
+        slices = list(sym.SliceChannel(gates, num_outputs=4, axis=1,
+                                       name="%sslice" % name))
+        in_gate = sym.Activation(slices[0], act_type="sigmoid")
+        forget_gate = sym.Activation(slices[1], act_type="sigmoid")
+        in_transform = sym.Activation(slices[2], act_type="tanh")
+        out_gate = sym.Activation(slices[3], act_type="sigmoid")
+        next_c = sym.broadcast_add(
+            sym.broadcast_mul(forget_gate, states[1]),
+            sym.broadcast_mul(in_gate, in_transform))
+        next_h = sym.broadcast_mul(
+            out_gate, sym.Activation(next_c, act_type="tanh"))
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(BaseRNNCell):
+    """GRU cell, gate order r z n (reference: class GRUCell; cuDNN
+    formulation matching ops/rnn.py)."""
+
+    def __init__(self, num_hidden, prefix="gru_", params=None):
+        super().__init__(prefix, params)
+        self._num_hidden = num_hidden
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("_r", "_z", "_o")
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        prev_h = states[0]
+        i2h = sym.FullyConnected(data=inputs, weight=self._iW,
+                                 bias=self._iB,
+                                 num_hidden=self._num_hidden * 3,
+                                 name="%si2h" % name)
+        h2h = sym.FullyConnected(data=prev_h, weight=self._hW,
+                                 bias=self._hB,
+                                 num_hidden=self._num_hidden * 3,
+                                 name="%sh2h" % name)
+        i2h_r, i2h_z, i2h_n = list(sym.SliceChannel(
+            i2h, num_outputs=3, axis=1, name="%si2h_slice" % name))
+        h2h_r, h2h_z, h2h_n = list(sym.SliceChannel(
+            h2h, num_outputs=3, axis=1, name="%sh2h_slice" % name))
+        reset = sym.Activation(i2h_r + h2h_r, act_type="sigmoid")
+        update = sym.Activation(i2h_z + h2h_z, act_type="sigmoid")
+        next_h_tmp = sym.Activation(
+            i2h_n + sym.broadcast_mul(reset, h2h_n), act_type="tanh")
+        ones = sym._rminus_scalar(update, scalar=1.0)
+        next_h = sym.broadcast_add(
+            sym.broadcast_mul(ones, next_h_tmp),
+            sym.broadcast_mul(update, prev_h))
+        return next_h, [next_h]
+
+
+class FusedRNNCell(BaseRNNCell):
+    """Fused multi-layer cell over the RNN op (reference: class
+    FusedRNNCell — the cuDNN path; here ops/rnn.py's lax.scan kernel)."""
+
+    def __init__(self, num_hidden, num_layers=1, mode="lstm",
+                 bidirectional=False, dropout=0.0, get_next_state=False,
+                 forget_bias=1.0, prefix=None, params=None):
+        if prefix is None:
+            prefix = "%s_" % mode
+        super().__init__(prefix, params)
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._dropout = dropout
+        self._get_next_state = get_next_state
+        # forget_bias applies when the blob is initialized with
+        # mx.init.FusedRNN (the reference contract); a default here would
+        # shadow the user's global initializer
+        self._forget_bias = forget_bias
+        self._param = self.params.get("parameters")
+
+    @property
+    def state_info(self):
+        dirs = 2 if self._bidirectional else 1
+        info = [{"shape": (self._num_layers * dirs, 0, self._num_hidden),
+                 "__layout__": "LNC"}]
+        if self._mode == "lstm":
+            info.append(dict(info[0]))
+        return info
+
+    @property
+    def _gate_names(self):
+        return {"rnn_relu": ("",), "rnn_tanh": ("",),
+                "lstm": ("_i", "_f", "_c", "_o"),
+                "gru": ("_r", "_z", "_o")}[self._mode]
+
+    def _state_like(self, x_tnc, name):
+        """(L*dirs, N, H) zeros composed from (T, N, C) inputs."""
+        dirs = 2 if self._bidirectional else 1
+        step = sym.slice_axis(x_tnc, axis=0, begin=0, end=1)     # (1,N,C)
+        col = sym.slice_axis(step, axis=-1, begin=0, end=1)      # (1,N,1)
+        z = sym._zeros(shape=(self._num_layers * dirs, 1,
+                             self._num_hidden), name=name + "_zconst")
+        return sym.broadcast_add(sym._mul_scalar(col, scalar=0.0), z)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        # fused op wants (T, N, C)
+        if isinstance(inputs, sym.Symbol):
+            x = inputs if layout == "TNC" else \
+                sym.swapaxes(inputs, dim1=0, dim2=1)
+        else:
+            xs = [sym.expand_dims(i, axis=0) for i in inputs]
+            x = sym.concat(*xs, dim=0)
+        if begin_state is None:
+            states = [self._state_like(x, "%sbegin_state_%d"
+                                       % (self._prefix, i))
+                      for i in range(len(self.state_info))]
+        else:
+            states = list(begin_state)
+        kwargs = {}
+        if self._mode == "lstm":
+            kwargs["state_cell"] = states[1]
+        rnn = sym.RNN(data=x, parameters=self._param, state=states[0],
+                      state_size=self._num_hidden,
+                      num_layers=self._num_layers, mode=self._mode,
+                      bidirectional=self._bidirectional, p=self._dropout,
+                      state_outputs=self._get_next_state,
+                      name="%srnn" % self._prefix, **kwargs)
+        heads = list(rnn)
+        outputs = heads[0]
+        if layout == "NTC":
+            outputs = sym.swapaxes(outputs, dim1=0, dim2=1)
+        if merge_outputs is False:
+            sliced = sym.split(outputs, num_outputs=length,
+                               axis=layout.find("T"), squeeze_axis=True)
+            outputs = list(sliced) if length > 1 else [sliced]
+        if self._get_next_state:
+            next_states = heads[1:3] if self._mode == "lstm" \
+                else heads[1:2]
+        else:
+            next_states = []
+        return outputs, next_states
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError(
+            "FusedRNNCell cannot be stepped; use unroll, or unfuse()")
+
+    def unfuse(self) -> "SequentialRNNCell":
+        """Stacked unfused cells matching this cell's geometry (weights
+        are NOT shared — reference unfuse() + unpack_weights covers
+        conversion; here conversion goes through the .params blob)."""
+        stack = SequentialRNNCell()
+        get_cell = {
+            "rnn_relu": lambda p: RNNCell(self._num_hidden,
+                                          activation="relu", prefix=p),
+            "rnn_tanh": lambda p: RNNCell(self._num_hidden,
+                                          activation="tanh", prefix=p),
+            "lstm": lambda p: LSTMCell(self._num_hidden, prefix=p),
+            "gru": lambda p: GRUCell(self._num_hidden, prefix=p),
+        }[self._mode]
+        for i in range(self._num_layers):
+            if self._bidirectional:
+                stack.add(BidirectionalCell(
+                    get_cell("%sl%d_" % (self._prefix, i)),
+                    get_cell("%sr%d_" % (self._prefix, i))))
+            else:
+                stack.add(get_cell("%sl%d_" % (self._prefix, i)))
+        return stack
+
+
+class SequentialRNNCell(BaseRNNCell):
+    """Stacked cells (reference: class SequentialRNNCell)."""
+
+    def __init__(self, params=None):
+        super().__init__(prefix="", params=params)
+        self._cells = []
+
+    def add(self, cell):
+        self._cells.append(cell)
+
+    @property
+    def state_info(self):
+        return sum((c.state_info for c in self._cells), [])
+
+    def begin_state(self, **kwargs):
+        return sum((c.begin_state(**kwargs) for c in self._cells), [])
+
+    def _default_states(self, x_step):
+        return sum((c._default_states(x_step) for c in self._cells), [])
+
+    def __call__(self, inputs, states):
+        next_states = []
+        p = 0
+        for cell in self._cells:
+            n = len(cell.state_info)
+            inputs, st = cell(inputs, states[p:p + n])
+            next_states.extend(st)
+            p += n
+        return inputs, next_states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        """Layer-major: each cell unrolls the WHOLE sequence (reference
+        SequentialRNNCell.unroll) — required for Bidirectional children,
+        and it keeps per-layer graphs compact."""
+        self.reset()
+        inputs, _ = _normalize_sequence(length, inputs, layout, None)
+        num_cells = len(self._cells)
+        p = 0
+        next_states = []
+        for i, cell in enumerate(self._cells):
+            n = len(cell.state_info)
+            states = None if begin_state is None \
+                else begin_state[p:p + n]
+            p += n
+            inputs, st = cell.unroll(
+                length, inputs=inputs, begin_state=states, layout=layout,
+                merge_outputs=None if i < num_cells - 1 else merge_outputs)
+            next_states.extend(st)
+        return inputs, next_states
+
+
+class BidirectionalCell(BaseRNNCell):
+    """Forward + backward over the sequence (reference: class
+    BidirectionalCell); only unroll is defined."""
+
+    def __init__(self, l_cell, r_cell, params=None, output_prefix="bi_"):
+        super().__init__("", params)
+        self._l = l_cell
+        self._r = r_cell
+        self._output_prefix = output_prefix
+
+    @property
+    def state_info(self):
+        return self._l.state_info + self._r.state_info
+
+    def _default_states(self, x_step):
+        return (self._l._default_states(x_step)
+                + self._r._default_states(x_step))
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError(
+            "BidirectionalCell cannot be stepped; use unroll")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        inputs, axis = _normalize_sequence(length, inputs, layout, False)
+        if begin_state is None:
+            begin_state = self._default_states(inputs[0])
+        nl = len(self._l.state_info)
+        l_out, l_states = self._l.unroll(
+            length, inputs, begin_state[:nl], layout=layout,
+            merge_outputs=False)
+        r_out, r_states = self._r.unroll(
+            length, list(reversed(inputs)), begin_state[nl:],
+            layout=layout, merge_outputs=False)
+        outs = []
+        for i in range(length):
+            outs.append(sym.concat(l_out[i], r_out[length - 1 - i],
+                                   dim=1,
+                                   name="%st%d" % (self._output_prefix,
+                                                   i)))
+        outs, _ = _normalize_sequence(length, outs, layout, merge_outputs)
+        return outs, l_states + r_states
+
+
+class DropoutCell(BaseRNNCell):
+    """Dropout on outputs between stacked cells (reference: class
+    DropoutCell)."""
+
+    def __init__(self, dropout, prefix="dropout_", params=None):
+        super().__init__(prefix, params)
+        self._dropout = dropout
+
+    @property
+    def state_info(self):
+        return []
+
+    def _default_states(self, x_step):
+        return []
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        if self._dropout > 0:
+            inputs = sym.Dropout(data=inputs, p=self._dropout,
+                                 name="%st%d" % (self._prefix,
+                                                 self._counter))
+        return inputs, states
+
+
+class ModifierCell(BaseRNNCell):
+    """Base of cells that wrap another cell (reference: class
+    ModifierCell)."""
+
+    def __init__(self, base_cell):
+        super().__init__()
+        base_cell._modified = True
+        self.base_cell = base_cell
+
+    @property
+    def params(self):
+        self._own_params = False
+        return self.base_cell.params
+
+    @property
+    def state_info(self):
+        return self.base_cell.state_info
+
+    def _default_states(self, x_step):
+        return self.base_cell._default_states(x_step)
+
+    def begin_state(self, **kwargs):
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(**kwargs)
+        self.base_cell._modified = True
+        return begin
+
+
+class ZoneoutCell(ModifierCell):
+    """Zoneout on states (reference: class ZoneoutCell; the stochastic
+    path rides the Dropout op's mask)."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        super().__init__(base_cell)
+        self._zo = zoneout_outputs
+        self._zs = zoneout_states
+        self._prev = None
+
+    def reset(self):
+        super().reset()
+        self._prev = None
+
+    def __call__(self, inputs, states):
+        out, next_states = self.base_cell(inputs, states)
+
+        def mix(p, new, old):
+            if p <= 0 or old is None:
+                return new
+            mask = sym.Dropout(data=sym._mul_scalar(new, scalar=0.0) + 1.0,
+                               p=p)
+            keep = sym.broadcast_mul(mask, new - old)
+            return old + keep
+        next_states = [mix(self._zs, n, o)
+                       for n, o in zip(next_states, states)]
+        out = mix(self._zo, out, self._prev)
+        self._prev = out
+        return out, next_states
+
+
+class ResidualCell(ModifierCell):
+    """Output = base(x) + x (reference: class ResidualCell)."""
+
+    def __call__(self, inputs, states):
+        out, next_states = self.base_cell(inputs, states)
+        return sym.broadcast_add(out, inputs), next_states
+
+
+__all__.append("ModifierCell")

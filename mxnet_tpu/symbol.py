@@ -326,6 +326,21 @@ def _const(value) -> Symbol:
     return Symbol([(node, 0)])
 
 
+# symbol-mode output counts for attr-determined (num_outputs=0) ops
+def _split_nout(a):
+    if "num_outputs" not in a:
+        raise MXNetError("split/SliceChannel needs num_outputs in symbol "
+                         "mode (the output count shapes the graph)")
+    return int(a["num_outputs"])
+
+
+_ATTR_NOUT = {
+    "split": _split_nout,
+    "split_v2": lambda a: int(a["sections"]) if int(a.get("sections", 0))
+    else len(tuple(a.get("indices", ()) or ())) + 1,
+}
+
+
 def _make_op_symbol(op_name: str, inputs: List[Symbol],
                     params: Dict[str, Any], name: Optional[str] = None) -> Symbol:
     op = get_op(op_name)   # raises if unknown
@@ -351,6 +366,17 @@ def _make_op_symbol(op_name: str, inputs: List[Symbol],
             "%s has a variadic output count (num_outputs=-1) and is not "
             "supported in symbol mode; call it imperatively via mx.nd"
             % op_name)
+    if op.num_outputs == 0:
+        # attr-determined output count (split family)
+        derive = _ATTR_NOUT.get(op.name)
+        if derive is None:
+            raise MXNetError(
+                "%s: output count depends on attrs and no symbol-mode "
+                "rule derives it" % op_name)
+        n_out = derive({k: _attr_parse(v) for k, v in attrs.items()})
+        if n_out == 1:
+            return Symbol([(node, 0)])
+        return Symbol([(node, i) for i in range(n_out)])
     n_out = op.num_outputs
     if op.aux_writeback and not callable(op.aux_writeback):
         n_out = n_out - len(op.aux_writeback)
@@ -396,12 +422,17 @@ class AttrScope:
         return False
 
 
-def Variable(name: str, shape=None, dtype=None, **kwargs) -> Symbol:
+def Variable(name: str, shape=None, dtype=None, init=None,
+             **kwargs) -> Symbol:
     attrs = dict(AttrScope.current_attrs())
     if shape is not None:
         attrs["__shape__"] = _attr_str(tuple(shape))
     if dtype is not None:
         attrs["__dtype__"] = str(dtype)
+    if init is not None:
+        # serialized like the reference (attrs['__init__'] = init.dumps())
+        # so Module.init_params can re-create it via initializer.create
+        attrs["__init__"] = init if isinstance(init, str) else init.dumps()
     attrs.update({k: _attr_str(v) for k, v in kwargs.items()})
     return Symbol([(_SymNode("null", name, attrs, []), 0)])
 
@@ -416,6 +447,113 @@ def Group(symbols: Sequence[Symbol]) -> Symbol:
     return Symbol(heads)
 
 
+# Declared tensor inputs of the classic layer ops (reference: each op's
+# ListArguments).  Enables the two v1.x symbolic-API conventions the
+# positional form alone cannot express: inputs passed by KEYWORD
+# (sym.FullyConnected(data=net, ...)) and AUTO-CREATED parameter
+# variables named {node}_{input} for slots the caller omits
+# (sym.Convolution(data=x, num_filter=32, kernel=(3,3), name='conv1')
+# materializes conv1_weight/conv1_bias; backward shape inference in
+# _infer_param_inputs sizes them).  The second element gates creation:
+# True = always; a callable decides from the op attrs.
+_always = lambda attrs: True                                  # noqa: E731
+_unless_no_bias = lambda attrs: not _attr_parse(               # noqa: E731
+    str(attrs.get("no_bias", "False")))
+_never = lambda attrs: False                                  # noqa: E731
+_BN_INPUTS = (("data", _always), ("gamma", _always), ("beta", _always),
+              ("moving_mean", _always), ("moving_var", _always))
+_INPUT_DECLS = {
+    "FullyConnected": (("data", _always), ("weight", _always),
+                       ("bias", _unless_no_bias)),
+    "Convolution": (("data", _always), ("weight", _always),
+                    ("bias", _unless_no_bias)),
+    "Deconvolution": (("data", _always), ("weight", _always),
+                      ("bias", _unless_no_bias)),
+    "BatchNorm": _BN_INPUTS,
+    "BatchNormWithReLU": _BN_INPUTS,
+    "Embedding": (("data", _always), ("weight", _always)),
+    "LayerNorm": (("data", _always), ("gamma", _always),
+                  ("beta", _always)),
+    "GroupNorm": (("data", _always), ("gamma", _always),
+                  ("beta", _always)),
+    "InstanceNorm": (("data", _always), ("gamma", _always),
+                     ("beta", _always)),
+    "RMSNorm": (("data", _always), ("gamma", _always)),
+    "LeakyReLU": (("data", _always),
+                  ("gamma", lambda attrs: str(
+                      attrs.get("act_type", "leaky")) == "prelu")),
+    "Activation": (("data", _always),),
+    "Pooling": (("data", _always),),
+    "Dropout": (("data", _always),),
+    "LRN": (("data", _always),),
+    "softmax": (("data", _always),),
+    "log_softmax": (("data", _always),),
+    "SoftmaxActivation": (("data", _always),),
+    "SoftmaxOutput": (("data", _always), ("label", _always)),
+    "LinearRegressionOutput": (("data", _always), ("label", _always)),
+    "MAERegressionOutput": (("data", _always), ("label", _always)),
+    "LogisticRegressionOutput": (("data", _always), ("label", _always)),
+    "SVMOutput": (("data", _always), ("label", _always)),
+    "MakeLoss": (("data", _always),),
+    "RNN": (("data", _always), ("parameters", _always),
+            ("state", _always),
+            ("state_cell", lambda attrs: str(
+                attrs.get("mode", "lstm")) == "lstm"),
+            ("sequence_length", _never)),
+}
+
+
+def _fn_input_names(op):
+    """Positional parameter names of the kernel fn (minus the injected rng
+    key) — the keyword→slot map for ops without a declared input table."""
+    import inspect
+    names = [p.name for p in inspect.signature(op.fn).parameters.values()
+             if p.kind in (inspect.Parameter.POSITIONAL_ONLY,
+                           inspect.Parameter.POSITIONAL_OR_KEYWORD)]
+    if op.needs_rng and names and names[0] == "key":
+        names = names[1:]
+    return names
+
+
+def _assemble_inputs(op, op_name, node_name, inputs, sym_kwargs, params):
+    decl = _INPUT_DECLS.get(op.name)
+    if decl is not None:
+        names = [d[0] for d in decl]
+    else:
+        names = _fn_input_names(op)
+    slots = [None] * len(names)
+    for k, v in sym_kwargs.items():
+        if k not in names:
+            raise MXNetError(
+                "%s: unknown tensor input %r (declared inputs: %s)"
+                % (op_name, k, names))
+        slots[names.index(k)] = v
+    # positional inputs are LEADING (reference convention): positional i
+    # binds slot i, and colliding with a keyword is an error, not a
+    # silent shift into the next free slot
+    for i, v in enumerate(inputs):
+        if i >= len(slots):
+            raise MXNetError("%s: too many inputs (%d given, %d declared)"
+                             % (op_name, len(inputs), len(slots)))
+        if slots[i] is not None:
+            raise MXNetError(
+                "%s: input %r passed both positionally and as a keyword"
+                % (op_name, names[i]))
+        slots[i] = v
+    if decl is not None:
+        for i, (nm, want) in enumerate(decl):
+            if slots[i] is None and want(params):
+                slots[i] = Variable("%s_%s" % (node_name, nm))
+    while slots and slots[-1] is None:
+        slots.pop()
+    for i, v in enumerate(slots):
+        if v is None:
+            raise MXNetError(
+                "%s: missing tensor input %r (pass it positionally or as "
+                "a keyword)" % (op_name, names[i]))
+    return slots
+
+
 def __getattr__(name: str):
     """mx.sym.<op> for every registered op (module __getattr__, PEP 562)."""
     try:
@@ -425,6 +563,15 @@ def __getattr__(name: str):
     op_name = name
 
     def op_call(*inputs, name=None, **params):
+        op = get_op(op_name)
+        sym_kwargs = {k: params.pop(k) for k in list(params)
+                      if isinstance(params[k], Symbol)}
+        if sym_kwargs or (op.name in _INPUT_DECLS
+                          and len(inputs) < len(_INPUT_DECLS[op.name])):
+            node_name = name or _gen_name(op_name)
+            merged = _assemble_inputs(op, op_name, node_name, list(inputs),
+                                      sym_kwargs, params)
+            return _make_op_symbol(op_name, merged, params, name=node_name)
         return _make_op_symbol(op_name, list(inputs), params, name=name)
     op_call.__name__ = op_name
     return op_call
@@ -574,6 +721,17 @@ def _infer_param_inputs(n: _SymNode, avals) -> None:
         shapes = {1: (c,)}
     elif op == "Embedding":
         shapes = {1: (int(kw["input_dim"]), int(kw["output_dim"]))}
+    elif op == "RNN":
+        from .ops.rnn import rnn_param_size
+        T_, N_, I_ = dshape()
+        H_ = int(kw["state_size"])
+        L_ = int(kw.get("num_layers", 1))
+        bi_ = bool(kw.get("bidirectional", False))
+        dirs = 2 if bi_ else 1
+        blob = rnn_param_size(L_, I_, H_, str(kw.get("mode", "lstm")),
+                              bi_)
+        shapes = {1: (blob,), 2: (L_ * dirs, N_, H_),
+                  3: (L_ * dirs, N_, H_)}
     elif op == "SoftmaxOutput":
         shapes = {1: dshape()[:-1]}           # label: data minus class axis
     elif op in ("LinearRegressionOutput", "MAERegressionOutput",

@@ -1,0 +1,131 @@
+"""mx.rnn symbolic cell API (reference pattern:
+tests/python/unittest/test_rnn.py — build cells, unroll, infer shape,
+bind, and compare fused vs unfused numerics)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _embed(V=20, E=8):
+    data = mx.sym.Variable("data")
+    return mx.sym.Embedding(data=data, input_dim=V, output_dim=E,
+                            name="embed")
+
+
+def test_lstm_cell_unroll_shapes():
+    T, N, H = 5, 4, 6
+    cell = mx.rnn.LSTMCell(H, prefix="lstm_")
+    outputs, states = cell.unroll(T, inputs=_embed(), merge_outputs=True)
+    exe = outputs.simple_bind(mx.cpu(), data=(N, T))
+    assert sorted(a for a in outputs.list_arguments() if "lstm" in a) == [
+        "lstm_h2h_bias", "lstm_h2h_weight", "lstm_i2h_bias",
+        "lstm_i2h_weight"]
+    exe.arg_dict["data"][:] = nd.array(
+        np.random.RandomState(0).randint(0, 20, (N, T)))
+    out = exe.forward()
+    assert out[0].shape == (N, T, H)
+    assert len(states) == 2
+
+
+def test_gru_residual_stack_and_zoneout():
+    T, N, H, E = 4, 3, 8, 8
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.GRUCell(H, prefix="g0_"))
+    stack.add(mx.rnn.ResidualCell(mx.rnn.GRUCell(H, prefix="g1_")))
+    stack.add(mx.rnn.DropoutCell(0.0))
+    outputs, _ = stack.unroll(T, inputs=_embed(E=E), merge_outputs=True)
+    exe = outputs.simple_bind(mx.cpu(), data=(N, T))
+    exe.arg_dict["data"][:] = nd.array(
+        np.random.RandomState(1).randint(0, 20, (N, T)))
+    assert exe.forward()[0].shape == (N, T, H)
+
+
+def test_cell_params_shared_across_steps():
+    """Unrolling must reuse ONE weight set (RNNParams sharing)."""
+    cell = mx.rnn.RNNCell(5, prefix="r_")
+    outputs, _ = cell.unroll(6, inputs=_embed(), merge_outputs=True)
+    args = [a for a in outputs.list_arguments() if a.startswith("r_")]
+    assert sorted(args) == ["r_h2h_bias", "r_h2h_weight", "r_i2h_bias",
+                            "r_i2h_weight"]
+
+
+def test_fused_cell_matches_gluon_numerics():
+    """FusedRNNCell (symbol) and gluon.rnn.LSTM (imperative) share the
+    ops/rnn.py kernel — same blob in, same numbers out."""
+    T, N, I, H = 5, 3, 4, 6
+    rng = np.random.RandomState(2)
+    x = rng.randn(T, N, I).astype(np.float32)
+
+    fused = mx.rnn.FusedRNNCell(H, num_layers=1, mode="lstm",
+                                prefix="fl_")
+    data = mx.sym.Variable("data")
+    out, _ = fused.unroll(T, inputs=data, layout="TNC")
+    exe = out.simple_bind(mx.cpu(), data=(T, N, I))
+    from mxnet_tpu.ops.rnn import rnn_param_size
+    n = rnn_param_size(1, I, H, "lstm")
+    blob = rng.randn(n).astype(np.float32) * 0.1
+    exe.arg_dict["data"][:] = nd.array(x)
+    exe.arg_dict["fl_parameters"][:] = nd.array(blob)
+    sym_out = exe.forward()[0].asnumpy()
+
+    gnet = mx.gluon.rnn.LSTM(H, num_layers=1)
+    gnet.initialize()
+    gnet(nd.zeros((T, N, I)))
+    # gluon packs per-layer params into the same blob layout
+    params = gnet.collect_params()
+    gh = 4 * H
+    ofs = 0
+    for pname, cols in (("l0_i2h_weight", I), ("l0_h2h_weight", H)):
+        size = gh * cols
+        params[pname].set_data(nd.array(
+            blob[ofs:ofs + size].reshape(gh, cols)))
+        ofs += size
+    for pname in ("l0_i2h_bias", "l0_h2h_bias"):
+        params[pname].set_data(nd.array(blob[ofs:ofs + gh]))
+        ofs += gh
+    glu_out = gnet(nd.array(x)).asnumpy()
+    np.testing.assert_allclose(sym_out, glu_out, rtol=1e-5, atol=1e-6)
+
+
+def test_bidirectional_cell():
+    T, N, H = 4, 2, 5
+    bi = mx.rnn.BidirectionalCell(mx.rnn.LSTMCell(H, prefix="fl_"),
+                                  mx.rnn.LSTMCell(H, prefix="bl_"))
+    out, states = bi.unroll(T, inputs=_embed(), merge_outputs=True)
+    exe = out.simple_bind(mx.cpu(), data=(N, T))
+    exe.arg_dict["data"][:] = nd.array(
+        np.random.RandomState(3).randint(0, 20, (N, T)))
+    assert exe.forward()[0].shape == (N, T, 2 * H)
+    assert len(states) == 4
+
+
+def test_unfuse_geometry():
+    fused = mx.rnn.FusedRNNCell(6, num_layers=2, mode="gru",
+                                bidirectional=True, prefix="fg_")
+    stack = fused.unfuse()
+    out, _ = stack.unroll(3, inputs=_embed(), merge_outputs=True)
+    exe = out.simple_bind(mx.cpu(), data=(2, 3))
+    exe.arg_dict["data"][:] = nd.array(
+        np.random.RandomState(4).randint(0, 20, (2, 3)))
+    assert exe.forward()[0].shape == (2, 3, 12)
+
+
+def test_classic_symbol_autovars():
+    """Keyword inputs + auto-created parameter variables (the v1.x
+    composition convention this round enables)."""
+    data = mx.sym.Variable("data")
+    c = mx.sym.Convolution(data=data, num_filter=4, kernel=(3, 3),
+                           pad=(1, 1), name="c1")
+    b = mx.sym.BatchNorm(data=c, name="bn1")
+    f = mx.sym.FullyConnected(data=mx.sym.Flatten(b), num_hidden=3,
+                              name="fc1")
+    assert "c1_weight" in f.list_arguments()
+    assert "bn1_gamma" in f.list_arguments()
+    assert "bn1_moving_mean" in f.list_auxiliary_states()
+    exe = f.simple_bind(mx.cpu(), data=(2, 3, 6, 6))
+    assert exe.forward(is_train=True)[0].shape == (2, 3)
+    # no_bias suppresses the bias variable
+    g = mx.sym.FullyConnected(data=data, num_hidden=3, no_bias=True,
+                              name="nb")
+    assert "nb_bias" not in g.list_arguments()
