@@ -121,6 +121,24 @@ def _logreg_rule(z, y, attrs):
                             ctx=z.context)
 
 
+# shape-only ops a label may pass through between its variable and the
+# loss head (replayed on the fed array): reshape/flatten family
+_LABEL_SHAPE_OPS = {"reshape", "Reshape", "_npi_reshape", "_np_reshape",
+                    "Flatten", "flatten", "expand_dims", "squeeze"}
+
+
+def _trace_label_var(node):
+    chain = []
+    while node.op in _LABEL_SHAPE_OPS and len(node.inputs) == 1:
+        chain.append((node.op, dict(node.attrs)))
+        node = node.inputs[0][0]
+    if node.op == "null":
+        return node.name, tuple(reversed(chain))
+    # untraceable label subgraph: keep the op-node name so the positional
+    # fallback stays DISABLED and a missing feed errors loudly
+    return node.name, ()
+
+
 _HEAD_RULES = {
     "SoftmaxOutput": _softmax_rule,
     "LinearRegressionOutput": _linreg_rule,
@@ -291,10 +309,17 @@ class Module(BaseModule):
             if rule is not None:
                 exec_heads.append(node.inputs[0])
                 # label bound by VARIABLE NAME (node.inputs[1]), not head
-                # position — multi-head models feed each head its own label
-                label_name = node.inputs[1][0].name \
-                    if len(node.inputs) > 1 else None
-                self._head_rules.append((rule, node.attrs, label_name))
+                # position — multi-head models feed each head its own
+                # label.  A chain of shape-only ops between the variable
+                # and the head (the classic Reshape(label, (-1,)) in
+                # bucketing LMs) is traced through and replayed on the
+                # fed array at forward time.
+                label_name, label_chain = (None, ())
+                if len(node.inputs) > 1:
+                    label_name, label_chain = _trace_label_var(
+                        node.inputs[1][0])
+                self._head_rules.append((rule, node.attrs, label_name,
+                                         label_chain))
             else:
                 exec_heads.append((node, idx))
                 self._head_rules.append(None)
@@ -523,8 +548,16 @@ class Module(BaseModule):
                 self._outputs.append(z)
                 self._head_grads.append(None)
                 continue
-            fn, attrs, label_name = rule
+            fn, attrs, label_name, label_chain = rule
             label = label_map.get(label_name)
+            if label is not None and label_chain:
+                from ..ndarray.ndarray import invoke as _invoke
+                from ..symbol import _attr_parse as _ap
+                for op_n, op_attrs in label_chain:
+                    label = _invoke(op_n, label,
+                                    **{k: _ap(v)
+                                       for k, v in op_attrs.items()
+                                       if not k.startswith("__")})
             if label is not None:
                 positional = [l for l in positional if l is not label]
             elif label_name is None and positional:

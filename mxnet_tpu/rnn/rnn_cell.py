@@ -20,7 +20,7 @@ from .. import symbol as sym
 
 __all__ = ["RNNParams", "BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
            "FusedRNNCell", "SequentialRNNCell", "BidirectionalCell",
-           "DropoutCell", "ZoneoutCell", "ResidualCell"]
+           "DropoutCell", "ModifierCell", "ZoneoutCell", "ResidualCell"]
 
 
 class RNNParams:
@@ -569,8 +569,12 @@ class ZoneoutCell(ModifierCell):
         def mix(p, new, old):
             if p <= 0 or old is None:
                 return new
-            mask = sym.Dropout(data=sym._mul_scalar(new, scalar=0.0) + 1.0,
-                               p=p)
+            # Dropout(ones) is 0 or 1/(1-p): rescale to an exact {0,1}
+            # mask so kept units get NEW (not the reference-diverging
+            # 2*new-old extrapolation)
+            mask = sym._mul_scalar(
+                sym.Dropout(data=sym._mul_scalar(new, scalar=0.0) + 1.0,
+                            p=p), scalar=1.0 - p)
             keep = sym.broadcast_mul(mask, new - old)
             return old + keep
         next_states = [mix(self._zs, n, o)
@@ -587,5 +591,3 @@ class ResidualCell(ModifierCell):
         out, next_states = self.base_cell(inputs, states)
         return sym.broadcast_add(out, inputs), next_states
 
-
-__all__.append("ModifierCell")

@@ -524,6 +524,10 @@ def _assemble_inputs(op, op_name, node_name, inputs, sym_kwargs, params):
     slots = [None] * len(names)
     for k, v in sym_kwargs.items():
         if k not in names:
+            if k == "data" and names:
+                # the classic API's universal first-input keyword
+                slots[0] = v
+                continue
             raise MXNetError(
                 "%s: unknown tensor input %r (declared inputs: %s)"
                 % (op_name, k, names))

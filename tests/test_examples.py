@@ -90,3 +90,15 @@ def test_super_resolution_example(tmp_path):
                "2", "--export", onnx_path, cwd=str(tmp_path))
     assert "psnr" in out
     assert os.path.exists(onnx_path) and os.path.getsize(onnx_path) > 1000
+
+
+def test_lstm_bucketing_example():
+    """Classic pre-Gluon stack: BucketSentenceIter + symbolic rnn cells +
+    BucketingModule.fit (reference example/rnn/bucketing)."""
+    out = _run("lstm_bucketing.py", "--num-epochs", "2", "--vocab", "80",
+               "--num-hidden", "24", "--num-embed", "12",
+               "--buckets", "10", "20", "30", "40", timeout=900)
+    # epoch logs ride stderr (logging); stdout carries the final score
+    assert "final train perplexity" in out
+    final = float(out.strip().splitlines()[-1].split(":")[1])
+    assert final < 500, final
