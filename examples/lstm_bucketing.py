@@ -62,7 +62,7 @@ def main():
     ap.add_argument("--num-embed", type=int, default=32)
     ap.add_argument("--num-layers", type=int, default=2)
     ap.add_argument("--vocab", type=int, default=200)
-    ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--lr", type=float, default=0.3)
     ap.add_argument("--buckets", type=int, nargs="+",
                     default=[10, 20, 30, 40])
     args = ap.parse_args()

@@ -512,9 +512,24 @@ class Module(BaseModule):
             return
         if isinstance(optimizer, str):
             idx2name = dict(enumerate(self._param_names))
+            params = dict(optimizer_params or {})
+            if "rescale_grad" not in params:
+                # reference Module.init_optimizer: loss heads emit
+                # PER-EXAMPLE gradients (SoftmaxOutput normalization
+                # 'null'), so the optimizer divides by the batch size —
+                # read off the DataDesc's batch axis (layout-aware)
+                batch = 1
+                if self._data_shapes:
+                    desc = self._data_shapes[0]
+                    axis = 0
+                    layout = getattr(desc, "layout", None)
+                    if layout:
+                        from ..io import DataDesc as _DD
+                        axis = max(_DD.get_batch_axis(layout), 0)
+                    batch = desc[1][axis]
+                params["rescale_grad"] = 1.0 / max(batch, 1)
             optimizer = opt_mod.create(
-                optimizer, param_idx2name=idx2name,
-                **dict(optimizer_params or {}))
+                optimizer, param_idx2name=idx2name, **params)
         self._optimizer = optimizer
         self._updater = opt_mod.get_updater(optimizer)
         self.optimizer_initialized = True

@@ -98,7 +98,26 @@ def test_lstm_bucketing_example():
     out = _run("lstm_bucketing.py", "--num-epochs", "2", "--vocab", "80",
                "--num-hidden", "24", "--num-embed", "12",
                "--buckets", "10", "20", "30", "40", timeout=900)
-    # epoch logs ride stderr (logging); stdout carries the final score
+    # epoch logs ride stderr (logging); stdout carries the final score.
+    # Untrained-random scores ~110 on this config (uniform = vocab 80):
+    # the bound must separate learning from a stall
     assert "final train perplexity" in out
     final = float(out.strip().splitlines()[-1].split(":")[1])
-    assert final < 500, final
+    assert final < 95, final
+
+
+def test_symbolic_mnist_example():
+    """Classic Module.fit workflow with auto-created symbol params
+    (reference example/image-classification/train_mnist.py)."""
+    out = _run("train_mnist_symbolic.py", "--num-epochs", "3",
+               timeout=900)
+    acc = float(out.strip().splitlines()[-1].split(":")[1])
+    assert acc > 0.9, acc
+
+
+def test_symbolic_lenet_example():
+    """The conv branch: symbolic Convolution/Pooling auto-params."""
+    out = _run("train_mnist_symbolic.py", "--network", "lenet",
+               "--num-epochs", "1", timeout=900)
+    acc = float(out.strip().splitlines()[-1].split(":")[1])
+    assert acc > 0.9, acc

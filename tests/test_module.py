@@ -93,8 +93,10 @@ def test_module_fit_converges_and_scores():
     train = mio.NDArrayIter(X, Y, batch_size=24, shuffle=True)
     val = mio.NDArrayIter(X, Y, batch_size=24)
     mod = Module(_mlp_softmax(), context=mx.cpu())
+    # lr sized for the reference gradient contract (per-example sums x
+    # auto rescale_grad=1/batch in init_optimizer = mean gradients)
     mod.fit(train, eval_data=val, optimizer="sgd",
-            optimizer_params={"learning_rate": 0.5},
+            optimizer_params={"learning_rate": 2.0},
             initializer=mx.init.Xavier(), num_epoch=12,
             batch_end_callback=callback.Speedometer(24, frequent=5))
     acc = mod.score(val, "acc")
