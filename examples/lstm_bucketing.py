@@ -62,7 +62,7 @@ def main():
     ap.add_argument("--num-embed", type=int, default=32)
     ap.add_argument("--num-layers", type=int, default=2)
     ap.add_argument("--vocab", type=int, default=200)
-    ap.add_argument("--lr", type=float, default=0.3)
+    ap.add_argument("--lr", type=float, default=0.5)
     ap.add_argument("--buckets", type=int, nargs="+",
                     default=[10, 20, 30, 40])
     args = ap.parse_args()
@@ -98,7 +98,7 @@ def main():
         eval_metric=mx.metric.Perplexity(ignore_label=0),
         optimizer="sgd",
         optimizer_params={"learning_rate": args.lr, "momentum": 0.0,
-                          "wd": 1e-5, "clip_gradient": 5.0},
+                          "wd": 1e-5, "clip_gradient": 0.25},
         initializer=mx.init.Xavier(factor_type="in", magnitude=2.34),
         num_epoch=args.num_epochs,
         batch_end_callback=mx.callback.Speedometer(
