@@ -18,6 +18,7 @@ mx.model).
 from __future__ import annotations
 
 import logging
+import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as _np
@@ -67,19 +68,23 @@ def _attr_b(attrs, key, default=False):
     return bool(v)
 
 
-def _softmax_rule(z, y, attrs):
+# Each head rule has a PURE jnp core (usable inside the whole-graph jit)
+# and an NDArray wrapper for the eager executor path.
+
+
+def _softmax_core(zj, yj, attrs):
     """ND softmax head: class axis 1 when multi_output (reference layout
     (B, C, d1..)), else last; integer labels of any matching shape;
     use_ignore/ignore_label mask + 'valid' normalization honored."""
     scale = _attr_f(attrs, "grad_scale", 1.0)
     axis = 1 if _attr_b(attrs, "multi_output") else -1
-    zj = jnp.moveaxis(z._jax, axis, -1)           # classes last
-    p = jax.nn.softmax(zj, axis=-1)
-    out = nd.from_jax(jnp.moveaxis(p, -1, axis), ctx=z.context)
-    if y is None:
+    zm = jnp.moveaxis(zj, axis, -1)               # classes last
+    p = jax.nn.softmax(zm, axis=-1)
+    out = jnp.moveaxis(p, -1, axis)
+    if yj is None:
         return out, None
-    yi = y._jax.astype(jnp.int32).reshape(zj.shape[:-1])
-    onehot = jax.nn.one_hot(yi, zj.shape[-1], dtype=p.dtype)
+    yi = yj.astype(jnp.int32).reshape(zm.shape[:-1])
+    onehot = jax.nn.one_hot(yi, zm.shape[-1], dtype=p.dtype)
     g = p - onehot
     norm = attrs.get("normalization", "null")
     if _attr_b(attrs, "use_ignore"):
@@ -91,34 +96,51 @@ def _softmax_rule(z, y, attrs):
         scale = scale / yi.size
     if norm == "batch":
         scale = scale / yi.shape[0]
-    return out, nd.from_jax(jnp.moveaxis(g * scale, -1, axis),
-                            ctx=z.context)
+    return out, jnp.moveaxis(g * scale, -1, axis)
 
 
-def _linreg_rule(z, y, attrs):
-    if y is None:
-        return z, None
+def _linreg_core(zj, yj, attrs):
+    if yj is None:
+        return zj, None
     scale = _attr_f(attrs, "grad_scale", 1.0)
-    return z, nd.from_jax((z._jax - y._jax.reshape(z.shape)) * scale,
-                          ctx=z.context)
+    return zj, (zj - yj.reshape(zj.shape)) * scale
 
 
-def _maereg_rule(z, y, attrs):
-    if y is None:
-        return z, None
+def _maereg_core(zj, yj, attrs):
+    if yj is None:
+        return zj, None
     scale = _attr_f(attrs, "grad_scale", 1.0)
-    return z, nd.from_jax(
-        jnp.sign(z._jax - y._jax.reshape(z.shape)) * scale, ctx=z.context)
+    return zj, jnp.sign(zj - yj.reshape(zj.shape)) * scale
 
 
-def _logreg_rule(z, y, attrs):
+def _logreg_core(zj, yj, attrs):
     scale = _attr_f(attrs, "grad_scale", 1.0)
-    p = jax.nn.sigmoid(z._jax)
-    out = nd.from_jax(p, ctx=z.context)
-    if y is None:
-        return out, None
-    return out, nd.from_jax((p - y._jax.reshape(z.shape)) * scale,
-                            ctx=z.context)
+    p = jax.nn.sigmoid(zj)
+    if yj is None:
+        return p, None
+    return p, (p - yj.reshape(zj.shape)) * scale
+
+
+def _wrap_rule(core):
+    def rule(z, y, attrs):
+        out, g = core(z._jax, None if y is None else y._jax, attrs)
+        out_nd = nd.from_jax(out, ctx=z.context)
+        return out_nd, (None if g is None
+                        else nd.from_jax(g, ctx=z.context))
+    return rule
+
+
+_softmax_rule = _wrap_rule(_softmax_core)
+_linreg_rule = _wrap_rule(_linreg_core)
+_maereg_rule = _wrap_rule(_maereg_core)
+_logreg_rule = _wrap_rule(_logreg_core)
+
+_RULE_CORES = {
+    "SoftmaxOutput": _softmax_core,
+    "LinearRegressionOutput": _linreg_core,
+    "MAERegressionOutput": _maereg_core,
+    "LogisticRegressionOutput": _logreg_core,
+}
 
 
 # shape-only ops a label may pass through between its variable and the
@@ -335,6 +357,11 @@ class Module(BaseModule):
         self._updater = None
         self._data_shapes = None
         self._label_shapes = None
+        # whole-graph jit fast path (reference role: GraphExecutor
+        # compiles the graph once; None = untried, False = not jittable)
+        self._jit_step = {}
+        self._fast_grads = None
+        self._jit_ok = None
 
     # -- properties ---------------------------------------------------------
     @property
@@ -433,6 +460,7 @@ class Module(BaseModule):
         self.inputs_need_grad = inputs_need_grad
         aux = {name: nd.zeros(shape, ctx=self._context)
                for name, shape in zip(self._aux_names, aux_shapes)}
+        self._grad_req = grad_req if for_training else "null"
         self._exec = self._exec_symbol.bind(
             self._context, args, grads,
             grad_req if for_training else "null", aux,
@@ -535,6 +563,138 @@ class Module(BaseModule):
         self.optimizer_initialized = True
 
     # -- compute ------------------------------------------------------------
+    def _resolve_head_labels(self):
+        """Per-head label NDArray (or None), applying the traced
+        shape-only chains and the positional fallback — shared by the
+        eager and the whole-graph-jit paths."""
+        label_map = dict(zip(self._label_names, self._labels))
+        positional = list(self._labels)
+        resolved = []
+        for rule in self._head_rules:
+            if rule is None:
+                resolved.append(None)
+                continue
+            _fn, _attrs, label_name, label_chain = rule
+            label = label_map.get(label_name)
+            if label is not None and label_chain:
+                from ..ndarray.ndarray import invoke as _invoke
+                from ..symbol import _attr_parse as _ap
+                for op_n, op_attrs in label_chain:
+                    label = _invoke(op_n, label,
+                                    **{k: _ap(v)
+                                       for k, v in op_attrs.items()
+                                       if not k.startswith("__")})
+            if label is not None:
+                positional = [l for l in positional if l is not label]
+            elif label_name is None and positional:
+                label = positional.pop(0)
+            resolved.append(label)
+        return resolved
+
+    def _try_fast_forward(self, feeds, is_train):
+        """One-executable forward (+backward when training): the whole
+        graph, the loss-head transforms, their exact gradients and the
+        vjp run as a single jitted function (reference: GraphExecutor
+        compiles the graph; per-node dispatch is the fallback)."""
+        from .. import amp as _amp_mod
+        if self._jit_ok is False or self._exec._group2ctx \
+                or _amp_mod.current_state() is not None \
+                or os.environ.get("MX_MODULE_JIT", "1") == "0":
+            # per-op AMP casting and device groups live in the eager
+            # dispatcher — those configurations keep the per-node path
+            return None
+        head_nodes = [n for n, _ in self._symbol._heads]
+        labels = self._resolve_head_labels()
+        if is_train:
+            # the fused backward needs every head to be a loss head with
+            # a label; anything else falls back to the eager tape
+            if any(r is None or l is None
+                   for r, l in zip(self._head_rules, labels)):
+                return None
+        key = bool(is_train)
+        step = self._jit_step.get(key)
+        if step is None:
+            from ..symbol import build_pure_fn, NotJittableGraph
+            try:
+                pure = build_pure_fn(self._exec_symbol, is_train=is_train)
+            except NotJittableGraph:
+                self._jit_ok = False
+                return None
+            cores = []
+            for node, rule in zip(head_nodes, self._head_rules):
+                if rule is None:
+                    cores.append((None, None))
+                else:
+                    attrs = {k: v for k, v in rule[1].items()}
+                    cores.append((_RULE_CORES[node.op], attrs))
+
+            if is_train:
+                def step(diff_vals, other_vals, label_vals, rng):
+                    def f(dv):
+                        heads, aux_new = pure({**dv, **other_vals}, rng)
+                        return tuple(heads), aux_new
+                    heads, vjp_fn, aux_new = jax.vjp(f, diff_vals,
+                                                     has_aux=True)
+                    outs, cots = [], []
+                    for z, (core, attrs), lab in zip(heads, cores,
+                                                     label_vals):
+                        out, g = core(z, lab, attrs)
+                        outs.append(out)
+                        cots.append(g)
+                    (d_diff,) = vjp_fn(tuple(cots))
+                    return tuple(outs), d_diff, aux_new
+            else:
+                def step(all_vals, label_vals, rng):
+                    heads, _aux = pure(all_vals, rng)
+                    outs = []
+                    for z, (core, attrs), lab in zip(heads, cores,
+                                                     label_vals):
+                        if core is None:
+                            outs.append(z)
+                        else:
+                            outs.append(core(z, lab, attrs)[0])
+                    return tuple(outs)
+            step = jax.jit(step)
+            self._jit_step[key] = step
+            self._jit_ok = True
+
+        from ..ops.random import next_key
+        rng = next_key()
+        label_vals = [None if l is None else l._jax for l in labels]
+        if is_train:
+            diff = {}
+            other = {}
+            for name, arr in self._exec.arg_dict.items():
+                v = feeds[name]._jax if name in feeds else arr._jax
+                if name in self._exec.grad_dict:
+                    diff[name] = v
+                else:
+                    other[name] = v
+            for name, arr in self._exec.aux_dict.items():
+                other[name] = arr._jax
+            outs, d_diff, aux_new = step(diff, other, label_vals, rng)
+            self._fast_grads = d_diff
+            for name, val in aux_new.items():
+                tgt = self._exec.aux_dict.get(name)
+                if tgt is not None:
+                    tgt._set_jax(val.astype(tgt.dtype))
+        else:
+            vals = {}
+            for name, arr in self._exec.arg_dict.items():
+                vals[name] = feeds[name]._jax if name in feeds \
+                    else arr._jax
+            for name, arr in self._exec.aux_dict.items():
+                vals[name] = arr._jax
+            outs = step(vals, label_vals, rng)
+            self._fast_grads = None
+        ctx = self._context
+        self._outputs = [nd.from_jax(o, ctx=ctx) for o in outs]
+        self._head_grads = [None] * len(outs)
+        # keep the executor's feed cache coherent for get_input_grads etc.
+        for name, arr in feeds.items():
+            self._exec.arg_dict[name] = arr
+        return True
+
     def forward(self, data_batch, is_train=None):
         """Reference: Module.forward."""
         assert self.binded and self.params_initialized
@@ -550,36 +710,22 @@ class Module(BaseModule):
                 if name in self._exec.arg_dict:  # labels a non-loss head uses
                     feeds[name] = arr
                 self._labels.append(arr)
+        if self._try_fast_forward(feeds, is_train):
+            return
+        self._fast_grads = None
         raw = self._exec.forward(is_train=is_train, **feeds)
         # apply loss-output forward transforms (always — predict without
         # labels must still see probabilities); cache exact head grads
         # when this head's label was fed
-        label_map = dict(zip(self._label_names, self._labels))
-        positional = list(self._labels)
+        labels = self._resolve_head_labels()
         self._outputs = []
         self._head_grads = []
-        for z, rule in zip(raw, self._head_rules):
+        for z, rule, label in zip(raw, self._head_rules, labels):
             if rule is None:
                 self._outputs.append(z)
                 self._head_grads.append(None)
                 continue
-            fn, attrs, label_name, label_chain = rule
-            label = label_map.get(label_name)
-            if label is not None and label_chain:
-                from ..ndarray.ndarray import invoke as _invoke
-                from ..symbol import _attr_parse as _ap
-                for op_n, op_attrs in label_chain:
-                    label = _invoke(op_n, label,
-                                    **{k: _ap(v)
-                                       for k, v in op_attrs.items()
-                                       if not k.startswith("__")})
-            if label is not None:
-                positional = [l for l in positional if l is not label]
-            elif label_name is None and positional:
-                # only an UNNAMED head may take a label positionally; a
-                # named head whose label wasn't fed runs in inference mode
-                # rather than silently training on another head's labels
-                label = positional.pop(0)
+            fn, attrs, _label_name, _chain = rule
             if label is not None and isinstance(z, NDArray) \
                     and label.context != z.context:
                 # group2ctx: the head may live on another device than the
@@ -593,6 +739,23 @@ class Module(BaseModule):
         """Reference: Module.backward — loss-output heads use the exact
         in-op gradient cached at forward; other heads need out_grads."""
         assert self.binded and self.params_initialized
+        if self._fast_grads is not None and out_grads is not None:
+            raise MXNetError(
+                "Module.backward(out_grads=...) needs the per-op eager "
+                "path, but this forward ran the whole-graph jit; set "
+                "MX_MODULE_JIT=0 (or install a monitor) to disable it")
+        if self._fast_grads is not None and out_grads is None:
+            # the fused jit step already produced every argument gradient
+            for name, g in self._fast_grads.items():
+                tgt = self._exec.grad_dict.get(name)
+                if tgt is None:
+                    continue
+                if self._grad_req == "add":
+                    tgt._set_jax(tgt._jax + g.astype(tgt.dtype))
+                else:
+                    tgt._set_jax(g.astype(tgt.dtype))
+            self._fast_grads = None
+            return
         if out_grads is None:
             out_grads = []
             for (node, _), g in zip(self._symbol._heads, self._head_grads):
@@ -626,6 +789,9 @@ class Module(BaseModule):
         eval_metric.update(labels, self.get_outputs())
 
     def install_monitor(self, monitor):
+        # the monitor taps per-node intermediates, which the whole-graph
+        # jit never materializes — monitored modules run the eager path
+        self._jit_ok = False
         monitor.install(self._exec)
 
     # -- checkpoints ---------------------------------------------------------

@@ -625,8 +625,96 @@ def _cross_device(x: NDArray, tgt: Context) -> NDArray:
     return NDArray(moved, ctx=tgt)
 
 
+class NotJittableGraph(Exception):
+    """Raised when a symbol graph cannot become one pure jax function
+    (dynamic-shape/no_jit ops, in-place optimizer ops, device groups)."""
+
+
+def build_pure_fn(sym: Symbol, is_train: bool = False):
+    """One PURE jax function for the whole graph (reference role:
+    GraphExecutor compiles the graph once; here the whole-graph jaxpr is
+    handed to XLA as a single executable instead of per-node dispatch).
+
+    Returns fn(values: dict name → jax.Array, key) →
+    (head_arrays: list, aux_updates: dict name → jax.Array).
+    aux_updates carries aux-writeback results (BatchNorm moving stats)
+    keyed by the source VARIABLE name; the caller owns applying them.
+    """
+    nodes = _topo(sym._heads)
+    plan = []
+    for n in nodes:
+        if n.op in ("null", "_const"):
+            plan.append((n, None, None))
+            continue
+        op = get_op(n.op)
+        if op.no_jit or op.mutates_input is not None:
+            raise NotJittableGraph("%s (%s)" % (n.name, n.op))
+        kw = {k: _attr_parse(v) for k, v in n.attrs.items()
+              if not k.startswith("__")}
+        if "training" not in kw and _accepts_training(op):
+            kw["training"] = bool(is_train)
+        plan.append((n, op, kw))
+    if any(n.attrs.get("__ctx_group__") for n, _, _ in plan):
+        raise NotJittableGraph("ctx_group placement")
+
+    def fn(values, key):
+        vals: Dict[int, list] = {}
+        aux_updates: Dict[str, Any] = {}
+        for idx, (n, op, kw) in enumerate(plan):
+            if n.op == "null":
+                vals[id(n)] = [values[n.name]]
+                continue
+            if n.op == "_const":
+                vals[id(n)] = [jnp.asarray(_attr_parse(n.attrs["value"]),
+                                           jnp.float32)]
+                continue
+            ins = [vals[id(i)][j] for i, j in n.inputs]
+            if op.needs_rng:
+                out = op.fn(jax.random.fold_in(key, idx), *ins, **kw)
+            else:
+                out = op.fn(*ins, **kw)
+            outs = list(out) if isinstance(out, (list, tuple)) else [out]
+            if not op.differentiable:
+                # the eager tape records only differentiable ops —
+                # gradients STOP here (reference FGradient-absent ops)
+                outs = [jax.lax.stop_gradient(o) for o in outs]
+            awb = op.aux_writeback(kw) if callable(op.aux_writeback) \
+                else op.aux_writeback
+            if awb:
+                visible = []
+                for oi, o in enumerate(outs):
+                    tgt = awb.get(oi)
+                    if tgt is None:
+                        visible.append(o)
+                        continue
+                    src_node = n.inputs[tgt][0]
+                    if src_node.op == "null":
+                        aux_updates[src_node.name] = o
+                outs = visible
+            vals[id(n)] = outs
+        heads = [vals[id(n)][i] for n, i in sym._heads]
+        return heads, aux_updates
+    return fn
+
+
+_ACCEPTS_TRAINING: Dict[str, bool] = {}
+
+
+def _accepts_training(op) -> bool:
+    hit = _ACCEPTS_TRAINING.get(op.name)
+    if hit is None:
+        import inspect
+        try:
+            hit = "training" in inspect.signature(op.fn).parameters
+        except (TypeError, ValueError):
+            hit = False
+        _ACCEPTS_TRAINING[op.name] = hit
+    return hit
+
+
 def evaluate(sym: Symbol, feeds: Dict[str, Any], params: Dict[str, Any],
-             ctx: Optional[Context] = None, group2ctx=None):
+             ctx: Optional[Context] = None, group2ctx=None,
+             is_train: bool = False):
     """Topo-order execution through the eager op registry (each node rides
     the per-op jit cache; reference: GraphExecutor::RunOps role).
 
@@ -669,6 +757,11 @@ def evaluate(sym: Symbol, feeds: Dict[str, Any], params: Dict[str, Any],
                        and x.context != tgt else x for x in ins]
             kw = {k: _attr_parse(v) for k, v in n.attrs.items()
                   if not k.startswith("__")}
+            # mode flag (BatchNorm batch-vs-moving stats, Dropout on/off):
+            # graph attrs don't carry it — the executor's is_train does
+            # (reference: GraphExecutor forward(is_train))
+            if "training" not in kw and _accepts_training(get_op(n.op)):
+                kw["training"] = bool(is_train)
             out = _nd_mod.invoke(n.op, *ins, **kw)
             values[id(n)] = out if isinstance(out, list) else [out]
     outs = [values[id(n)][i] for n, i in sym._heads]
@@ -802,10 +895,11 @@ class Executor:
                     arr.attach_grad(self._grad_req)
             with autograd.record():
                 out = evaluate(self._sym, vals, {}, ctx=self._ctx,
-                               group2ctx=self._group2ctx)
+                               group2ctx=self._group2ctx, is_train=True)
         else:
             out = evaluate(self._sym, vals, {}, ctx=self._ctx,
-                           group2ctx=self._group2ctx)
+                           group2ctx=self._group2ctx,
+                           is_train=bool(is_train))
         self.outputs = out if isinstance(out, list) else [out]
         return self.outputs
 

@@ -537,3 +537,73 @@ def test_bucket_sentence_iter_with_bucketing_module():
         correct += int((out[mask] == y[mask]).sum())
         total += int(mask.sum())
     assert correct / total > 0.9, (correct, total)
+
+
+def test_fast_path_matches_eager():
+    """The whole-graph-jit step and the eager per-op tape must produce
+    IDENTICAL parameters after several train steps (same init, same
+    data) — the fast path is an execution strategy, not a semantics
+    change."""
+    import os as _os
+    X, Y = _toy_classification()
+    results = {}
+    for mode in ("1", "0"):
+        _os.environ["MX_MODULE_JIT"] = mode
+        try:
+            mx.random.seed(7)
+            train = mio.NDArrayIter(X, Y, batch_size=24)
+            mod = Module(_mlp_softmax(), context=mx.cpu())
+            mod.bind(data_shapes=train.provide_data,
+                     label_shapes=train.provide_label)
+            mod.init_params(mx.init.Xavier(rnd_type="uniform",
+                                           factor_type="avg", magnitude=2))
+            mod.init_optimizer(optimizer="sgd",
+                               optimizer_params={"learning_rate": 0.5,
+                                                 "momentum": 0.9})
+            for _ in range(2):
+                train.reset()
+                for batch in train:
+                    mod.forward(batch, is_train=True)
+                    mod.backward()
+                    mod.update()
+            results[mode] = {k: v.asnumpy()
+                             for k, v in mod.get_params()[0].items()}
+        finally:
+            _os.environ.pop("MX_MODULE_JIT", None)
+    for k in results["1"]:
+        np.testing.assert_allclose(results["1"][k], results["0"][k],
+                                   rtol=1e-4, atol=1e-5, err_msg=k)
+
+
+def test_fast_path_batchnorm_aux_and_eval():
+    """BatchNorm under the fused step: train updates moving stats, eval
+    uses them (and leaves them alone), matching the eager path."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 3, 6, 6).astype(np.float32)
+    Y = rng.randint(0, 2, 64)
+    d = mx.sym.Variable("data")
+    c = mx.sym.Convolution(data=d, num_filter=4, kernel=(3, 3),
+                           name="c1")
+    b = mx.sym.BatchNorm(data=c, name="bn1")
+    f = mx.sym.FullyConnected(data=mx.sym.Flatten(b), num_hidden=2,
+                              name="fc")
+    net = mx.sym.SoftmaxOutput(data=f, name="softmax")
+    train = mio.NDArrayIter(X, Y, batch_size=16)
+    mod = Module(net, context=mx.cpu())
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    mm0 = mod._exec.aux_dict["bn1_moving_mean"].asnumpy().copy()
+    for batch in train:
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    mm1 = mod._exec.aux_dict["bn1_moving_mean"].asnumpy().copy()
+    assert not np.allclose(mm0, mm1), "train must update moving stats"
+    train.reset()
+    for batch in train:
+        mod.forward(batch, is_train=False)
+    mm2 = mod._exec.aux_dict["bn1_moving_mean"].asnumpy()
+    np.testing.assert_allclose(mm1, mm2, err_msg="eval must not touch")
