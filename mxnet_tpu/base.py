@@ -55,6 +55,7 @@ ENV_CATALOG: Dict[str, Any] = {
     # rebuild-specific flags (SURVEY §5.6: env vars are the de-facto flag
     # system; this catalog is the canonical doc source — docs/ENV_VARS.md
     # is generated from it by tools/gen_env_docs.py)
+    "MX_MODULE_JIT": ("1", "0 disables the whole-graph-jit fast paths (Module fused train step AND Executor inference) - debugging escape hatch back to per-op dispatch."),
     "MX_FORCE_CPU": ("0", "Pin the CPU backend: mx.tpu(i) resolves to host devices and nothing touches the accelerator tunnel (tests, data workers)."),
     "MX_TEST_CTX": ("", "'tpu' switches the pytest lane to the real chip as default context (conftest probes the tunnel first)."),
     "MX_DATA_DIR": ("", "Root of real-dataset drops (mnist/, ptb/): arms tests/test_real_data.py and the examples' real-data paths."),

@@ -596,10 +596,9 @@ class Module(BaseModule):
         graph, the loss-head transforms, their exact gradients and the
         vjp run as a single jitted function (reference: GraphExecutor
         compiles the graph; per-node dispatch is the fallback)."""
-        from .. import amp as _amp_mod
+        from ..symbol import whole_graph_jit_enabled
         if self._jit_ok is False or self._exec._group2ctx \
-                or _amp_mod.current_state() is not None \
-                or os.environ.get("MX_MODULE_JIT", "1") == "0":
+                or not whole_graph_jit_enabled():
             # per-op AMP casting and device groups live in the eager
             # dispatcher — those configurations keep the per-node path
             return None
@@ -658,8 +657,11 @@ class Module(BaseModule):
             self._jit_step[key] = step
             self._jit_ok = True
 
-        from ..ops.random import next_key
-        rng = next_key()
+        if self._exec._rng_needed():
+            from ..ops.random import next_key
+            rng = next_key()
+        else:
+            rng = jax.random.PRNGKey(0)
         label_vals = [None if l is None else l._jax for l in labels]
         if is_train:
             diff = {}
@@ -791,7 +793,9 @@ class Module(BaseModule):
     def install_monitor(self, monitor):
         # the monitor taps per-node intermediates, which the whole-graph
         # jit never materializes — monitored modules run the eager path
+        # at BOTH layers (the executor has its own inference fast path)
         self._jit_ok = False
+        self._exec._pure_ok = False
         monitor.install(self._exec)
 
     # -- checkpoints ---------------------------------------------------------

@@ -38,6 +38,9 @@ class Monitor:
         self.sort = sort
 
     def install(self, exe) -> None:
+        # per-node taps need per-node execution — disable the executor's
+        # whole-graph-jit inference fast path
+        exe._pure_ok = False
         """Attach to an executor (reference: Monitor.install_to_executor)."""
         self.exes.append(exe)
 

@@ -625,6 +625,18 @@ def _cross_device(x: NDArray, tgt: Context) -> NDArray:
     return NDArray(moved, ctx=tgt)
 
 
+def whole_graph_jit_enabled() -> bool:
+    """One guard for every whole-graph-jit fast path (Module's fused
+    train step AND bare Executor inference): MX_MODULE_JIT=0 disables
+    both, and active AMP keeps the per-op dispatcher (its cast policy
+    lives there)."""
+    import os as _os
+    if _os.environ.get("MX_MODULE_JIT", "1") == "0":
+        return False
+    from . import amp as _amp_mod
+    return _amp_mod.current_state() is None
+
+
 class NotJittableGraph(Exception):
     """Raised when a symbol graph cannot become one pure jax function
     (dynamic-shape/no_jit ops, in-place optimizer ops, device groups)."""
@@ -694,6 +706,8 @@ def build_pure_fn(sym: Symbol, is_train: bool = False):
             vals[id(n)] = outs
         heads = [vals[id(n)][i] for n, i in sym._heads]
         return heads, aux_updates
+    fn.needs_rng = any(op is not None and op.needs_rng
+                       for _, op, _ in plan)
     return fn
 
 
@@ -879,6 +893,8 @@ class Executor:
         self._grad_req = grad_req
         self._group2ctx = dict(group2ctx or {})
         self.outputs: List[NDArray] = []
+        self._pure_ok = None      # None=untried, False=not jittable
+        self._pure_jit = None
 
     def forward(self, is_train: bool = False, **feeds):
         from . import autograd
@@ -889,6 +905,13 @@ class Executor:
                 v = _nd_mod.array(v, ctx=self._ctx)
             self.arg_dict[k] = v
             vals[k] = v
+        if not is_train and not self._group2ctx and self._pure_ok is not False:
+            # inference rides ONE compiled executable when the graph
+            # allows it (same strategy as Module's fused train step)
+            out = self._fast_infer(vals)
+            if out is not None:
+                self.outputs = out
+                return self.outputs
         if is_train and self._grad_req != "null":
             for name, arr in self.arg_dict.items():
                 if name in self.grad_dict:
@@ -902,6 +925,42 @@ class Executor:
                            is_train=bool(is_train))
         self.outputs = out if isinstance(out, list) else [out]
         return self.outputs
+
+    def _fast_infer(self, vals):
+        if not whole_graph_jit_enabled():
+            return None
+        if self._pure_jit is None:
+            try:
+                pure = build_pure_fn(self._sym, is_train=False)
+            except NotJittableGraph:
+                self._pure_ok = False
+                return None
+
+            def run(values, key):
+                heads, _aux = pure(values, key)
+                return tuple(heads)
+            self._pure_jit = jax.jit(run)
+        jvals = {}
+        for k, v in vals.items():
+            jvals[k] = v._jax if isinstance(v, NDArray) else jnp.asarray(v)
+        if self._rng_needed():
+            from .ops.random import next_key
+            key = next_key()
+        else:
+            key = jax.random.PRNGKey(0)
+        outs = self._pure_jit(jvals, key)
+        return [_nd_mod.NDArray(o, ctx=self._ctx) for o in outs]
+
+    # rng: draw from the global stream ONLY when the graph has random
+    # ops — a deterministic graph must not advance the seed state the
+    # eager path leaves untouched
+    def _rng_needed(self) -> bool:
+        if getattr(self, "_rng_flag", None) is None:
+            from .ops.registry import get_op as _gop
+            self._rng_flag = any(
+                n.op not in ("null", "_const") and _gop(n.op).needs_rng
+                for n in _topo(self._sym._heads))
+        return self._rng_flag
 
     def backward(self, out_grads=None):
         from . import autograd
