@@ -56,6 +56,7 @@ from . import metric
 from . import lr_scheduler
 from . import optimizer
 from . import kvstore
+from . import kvstore as kv
 from . import gluon
 from . import parallel
 from . import callback
@@ -74,6 +75,8 @@ from . import device as context
 import sys as _sys
 _sys.modules[__name__ + ".context"] = context
 from . import operator
+from . import attribute
+from . import npx as numpy_extension    # 2.x alias: mx.numpy_extension IS npx
 from . import tpu_kernel
 
 # Subsystems land milestone-by-milestone (SURVEY.md §7.1); this list grows

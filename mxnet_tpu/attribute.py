@@ -1,0 +1,5 @@
+"""mx.attribute (reference: python/mxnet/attribute.py) — AttrScope's
+canonical home; the implementation lives with the symbol DAG."""
+from .symbol import AttrScope
+
+__all__ = ["AttrScope"]
