@@ -77,6 +77,7 @@ _sys.modules[__name__ + ".context"] = context
 from . import operator
 from . import attribute
 from . import npx as numpy_extension    # 2.x alias: mx.numpy_extension IS npx
+_sys.modules[__name__ + ".numpy_extension"] = numpy_extension
 from . import tpu_kernel
 
 # Subsystems land milestone-by-milestone (SURVEY.md §7.1); this list grows

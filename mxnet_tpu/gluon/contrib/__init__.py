@@ -1,7 +1,8 @@
 """gluon.contrib — experimental blocks (reference:
 python/mxnet/gluon/contrib/: nn/basic_layers.py, rnn/conv_rnn_cell.py,
-rnn/rnn_cell.py)."""
-from . import nn      # noqa: F401
-from . import rnn     # noqa: F401
+rnn/rnn_cell.py, estimator/)."""
+from . import nn         # noqa: F401
+from . import rnn        # noqa: F401
+from . import estimator  # noqa: F401
 
-__all__ = ["nn", "rnn"]
+__all__ = ["nn", "rnn", "estimator"]

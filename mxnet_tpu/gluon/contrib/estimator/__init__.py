@@ -1,0 +1,17 @@
+"""Gluon Estimator (reference: python/mxnet/gluon/contrib/estimator/).
+
+The high-level fit loop over a Gluon net: metrics, validation, and an
+event-handler pipeline (train/epoch/batch begin+end hooks) with the
+stock handlers (logging, checkpointing, early stopping, validation).
+"""
+from .estimator import Estimator
+from .event_handler import (TrainBegin, TrainEnd, EpochBegin, EpochEnd,
+                            BatchBegin, BatchEnd, StoppingHandler,
+                            MetricHandler, ValidationHandler,
+                            LoggingHandler, CheckpointHandler,
+                            EarlyStoppingHandler)
+
+__all__ = ["Estimator", "TrainBegin", "TrainEnd", "EpochBegin",
+           "EpochEnd", "BatchBegin", "BatchEnd", "StoppingHandler",
+           "MetricHandler", "ValidationHandler", "LoggingHandler",
+           "CheckpointHandler", "EarlyStoppingHandler"]

@@ -1,0 +1,150 @@
+"""Gluon Estimator fit loop (reference:
+python/mxnet/gluon/contrib/estimator/estimator.py (class Estimator))."""
+from __future__ import annotations
+
+import copy
+
+from .... import autograd, metric as metric_mod
+from ....base import MXNetError
+from ....device import current_context
+from ... import Trainer
+from ... import loss as gloss
+from .event_handler import (TrainBegin, TrainEnd, EpochBegin, EpochEnd,
+                            BatchBegin, BatchEnd, StoppingHandler,
+                            MetricHandler, ValidationHandler,
+                            LoggingHandler)
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Estimator:
+    """High-level fit/evaluate over a Gluon net (reference: Estimator).
+
+    estimator = Estimator(net, loss=SoftmaxCrossEntropyLoss(),
+                          train_metrics=mx.metric.Accuracy(),
+                          trainer=Trainer(...))
+    estimator.fit(train_data, val_data, epochs=3)
+    """
+
+    def __init__(self, net, loss, train_metrics=None, val_metrics=None,
+                 trainer=None, context=None):
+        self.net = net
+        if not isinstance(loss, gloss.Loss):
+            raise MXNetError("loss must be a gluon Loss; got %r"
+                             % (type(loss).__name__,))
+        self.loss = loss
+        self.train_metrics = _as_list(train_metrics)
+        if not self.train_metrics:
+            self.train_metrics = [metric_mod.Accuracy()]
+        self.train_metrics.append(metric_mod.Loss("train loss"))
+        # val metrics mirror the train ones — deepcopy keeps constructor
+        # config (TopKAccuracy(top_k=...), Accuracy(axis=...))
+        self.val_metrics = _as_list(val_metrics)
+        if not self.val_metrics:
+            self.val_metrics = []
+            for m in self.train_metrics:
+                if isinstance(m, metric_mod.Loss):
+                    self.val_metrics.append(
+                        metric_mod.Loss("validation loss"))
+                else:
+                    vm = copy.deepcopy(m)
+                    vm.reset()
+                    self.val_metrics.append(vm)
+        self.context = context or current_context()
+        self.trainer = trainer or Trainer(
+            net.collect_params(), "adam", {"learning_rate": 1e-3})
+        self.stop_training = False
+
+    # -- evaluation ---------------------------------------------------------
+    def evaluate_batch(self, batch, metrics):
+        data, label = (b.as_in_context(self.context) for b in batch[:2])
+        pred = self.net(data)
+        loss = self.loss(pred, label)
+        for m in metrics:
+            if isinstance(m, metric_mod.Loss):
+                m.update(0, loss)
+            else:
+                m.update(label, pred)
+
+    def evaluate(self, val_data, batch_axis=0):
+        for m in self.val_metrics:
+            m.reset()
+        for batch in val_data:
+            batch = batch if isinstance(batch, (list, tuple)) \
+                else (batch.data[0], batch.label[0])
+            self.evaluate_batch(batch, self.val_metrics)
+        return self.val_metrics
+
+    # -- training -----------------------------------------------------------
+    def fit_batch(self, batch, batch_axis=0):
+        data, label = (b.as_in_context(self.context) for b in batch[:2])
+        with autograd.record():
+            pred = self.net(data)
+            loss = self.loss(pred, label)
+        loss.backward()
+        return data, label, pred, loss
+
+    def fit(self, train_data, val_data=None, epochs=None,
+            event_handlers=None, batches=None, batch_axis=0):
+        if epochs is None and batches is None:
+            epochs = 1
+        handlers = self._prepare_handlers(val_data, epochs, batches,
+                                          _as_list(event_handlers))
+        # validation runs FIRST at each boundary so user handlers
+        # monitoring a val metric read THIS epoch's value (reference
+        # sorts handlers the same way)
+        def _ordered(cls):
+            hs = [h for h in handlers if isinstance(h, cls)]
+            return ([h for h in hs if isinstance(h, ValidationHandler)]
+                    + [h for h in hs
+                       if not isinstance(h, ValidationHandler)])
+        tb, te = _ordered(TrainBegin), _ordered(TrainEnd)
+        eb, ee = _ordered(EpochBegin), _ordered(EpochEnd)
+        bb, be = _ordered(BatchBegin), _ordered(BatchEnd)
+
+        self.stop_training = False
+        for h in tb:
+            h.train_begin(self)
+        while not self.stop_training:
+            for h in eb:
+                h.epoch_begin(self)
+            if hasattr(train_data, "reset"):
+                train_data.reset()
+            for batch in train_data:
+                batch = batch if isinstance(batch, (list, tuple)) \
+                    else (batch.data[0], batch.label[0])
+                for h in bb:
+                    h.batch_begin(self, batch=batch)
+                data, label, pred, loss = self.fit_batch(batch,
+                                                         batch_axis)
+                self.trainer.step(data.shape[batch_axis])
+                for h in be:
+                    if h.batch_end(self, batch=batch, pred=pred,
+                                   label=label, loss=loss):
+                        self.stop_training = True
+                if self.stop_training:
+                    break
+            for h in ee:
+                if h.epoch_end(self):
+                    self.stop_training = True
+        for h in te:
+            h.train_end(self)
+
+    def _prepare_handlers(self, val_data, epochs, batches, handlers):
+        # defaults mirror the reference: stopping + metric + validation +
+        # logging unless the user supplied their own of that kind
+        if not any(isinstance(h, StoppingHandler) for h in handlers):
+            handlers.append(StoppingHandler(max_epoch=epochs,
+                                            max_batch=batches))
+        if not any(isinstance(h, MetricHandler) for h in handlers):
+            handlers.append(MetricHandler(self.train_metrics))
+        if val_data is not None and \
+                not any(isinstance(h, ValidationHandler) for h in handlers):
+            handlers.append(ValidationHandler(val_data, self.evaluate))
+        if not any(isinstance(h, LoggingHandler) for h in handlers):
+            handlers.append(LoggingHandler(metrics=self.train_metrics))
+        return handlers

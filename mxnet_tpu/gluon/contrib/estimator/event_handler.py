@@ -1,0 +1,252 @@
+"""Estimator event handlers (reference:
+python/mxnet/gluon/contrib/estimator/event_handler.py)."""
+from __future__ import annotations
+
+import logging
+import os
+
+from .... import metric as metric_mod
+from ....base import MXNetError
+
+
+def _single_metric_value(monitor, what):
+    name, value = monitor.get()
+    if isinstance(value, (list, tuple)):
+        raise MXNetError(
+            "%s needs a SINGLE metric to monitor; got a composite "
+            "(%r) - pass one of its children" % (what, name))
+    return name, value
+
+
+class TrainBegin:
+    def train_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class TrainEnd:
+    def train_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochBegin:
+    def epoch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochEnd:
+    def epoch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchBegin:
+    def batch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchEnd:
+    def batch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Stop on max_epoch/max_batch (reference: StoppingHandler)."""
+
+    def __init__(self, max_epoch=None, max_batch=None):
+        self.max_epoch = max_epoch
+        self.max_batch = max_batch
+        self.current_batch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.max_batch and self.current_batch >= self.max_batch:
+            self.stop_training = True
+        return self.stop_training
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.max_epoch and self.current_epoch >= self.max_epoch:
+            self.stop_training = True
+        return self.stop_training
+
+
+class MetricHandler(EpochBegin, BatchEnd):
+    """Reset train metrics per epoch, update per batch (reference:
+    MetricHandler)."""
+
+    def __init__(self, metrics):
+        self.metrics = metrics
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        for m in self.metrics:
+            m.reset()
+
+    def batch_end(self, estimator, *args, **kwargs):
+        pred = kwargs.get("pred")
+        label = kwargs.get("label")
+        loss = kwargs.get("loss")
+        for m in self.metrics:
+            if isinstance(m, metric_mod.Loss):
+                m.update(0, loss)
+            else:
+                m.update(label, pred)
+
+
+class ValidationHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Run validation on an interval (reference: ValidationHandler)."""
+
+    def __init__(self, val_data, eval_fn, epoch_period=1,
+                 batch_period=None):
+        self.val_data = val_data
+        self.eval_fn = eval_fn
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period and \
+                self.current_batch % self.batch_period == 0:
+            self.eval_fn(val_data=self.val_data)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period and \
+                self.current_epoch % self.epoch_period == 0:
+            self.eval_fn(val_data=self.val_data)
+
+
+class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd,
+                     BatchBegin, BatchEnd):
+    """Train progress logging (reference: LoggingHandler)."""
+
+    def __init__(self, log_interval="epoch", metrics=None):
+        self.log_interval = log_interval
+        self.metrics = metrics or []
+        self.batch_index = 0
+        self.current_epoch = 0
+        self.logger = logging.getLogger("mxnet_tpu.estimator")
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.logger.info("Training begin")
+
+    def train_end(self, estimator, *args, **kwargs):
+        self.logger.info("Training end")
+
+    def _fmt(self):
+        return ", ".join("%s: %.4f" % m.get() for m in self.metrics)
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.batch_index += 1
+        if isinstance(self.log_interval, int) and \
+                self.batch_index % self.log_interval == 0:
+            self.logger.info("[Epoch %d][Batch %d] %s",
+                             self.current_epoch, self.batch_index,
+                             self._fmt())
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        self.batch_index = 0
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.logger.info("[Epoch %d] %s", self.current_epoch, self._fmt())
+        self.current_epoch += 1
+
+
+class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Save parameters (+trainer state) per epoch; optionally only on
+    monitored-metric improvement (reference: CheckpointHandler)."""
+
+    def __init__(self, model_dir, model_prefix="model", monitor=None,
+                 mode="auto", save_best=False, epoch_period=1):
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        self.monitor = monitor
+        self.save_best = save_best
+        self.epoch_period = epoch_period
+        self.current_epoch = 0
+        if monitor is not None:
+            name = _single_metric_value(monitor, "CheckpointHandler")[0]
+        else:
+            name = ""
+        if mode == "auto":
+            mode = "max" if "acc" in name or "f1" in name else "min"
+        self.mode = mode
+        self.best = float("-inf") if mode == "max" else float("inf")
+
+    def train_begin(self, estimator, *args, **kwargs):
+        os.makedirs(self.model_dir, exist_ok=True)
+
+    def _improved(self, value):
+        return value > self.best if self.mode == "max" \
+            else value < self.best
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.current_epoch % self.epoch_period:
+            return
+        prefix = os.path.join(self.model_dir, self.model_prefix)
+        estimator.net.save_parameters(
+            "%s-epoch%d.params" % (prefix, self.current_epoch))
+        if estimator.trainer is not None:
+            estimator.trainer.save_states(
+                "%s-epoch%d.states" % (prefix, self.current_epoch))
+        if self.save_best and self.monitor is not None:
+            value = _single_metric_value(self.monitor,
+                                         "CheckpointHandler")[1]
+            if self._improved(value):
+                self.best = value
+                estimator.net.save_parameters(
+                    "%s-best.params" % prefix)
+
+
+class EarlyStoppingHandler(TrainBegin, EpochEnd):
+    """Stop when the monitored metric stops improving (reference:
+    EarlyStoppingHandler)."""
+
+    def __init__(self, monitor, min_delta=0.0, patience=0, mode="auto"):
+        self.monitor = monitor
+        self.min_delta = min_delta
+        self.patience = patience
+        name = _single_metric_value(monitor, "EarlyStoppingHandler")[0]
+        if mode == "auto":
+            mode = "max" if "acc" in name or "f1" in name else "min"
+        self.mode = mode
+        self.best = float("-inf") if mode == "max" else float("inf")
+        self.wait = 0
+        self.stop_training = False
+        self.stopped_epoch = 0
+        self.current_epoch = 0
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.wait = 0
+        self.stop_training = False
+
+    def _improved(self, value):
+        if self.mode == "max":
+            return value > self.best + self.min_delta
+        return value < self.best - self.min_delta
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        value = _single_metric_value(self.monitor,
+                                     "EarlyStoppingHandler")[1]
+        if self._improved(value):
+            self.best = value
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait > self.patience:
+                self.stop_training = True
+                self.stopped_epoch = self.current_epoch
+        self.current_epoch += 1
+        return self.stop_training

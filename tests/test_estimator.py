@@ -1,0 +1,99 @@
+"""gluon.contrib.estimator (reference:
+tests/python/unittest/test_gluon_estimator.py /
+test_gluon_event_handler.py patterns)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.gluon.contrib.estimator import (
+    Estimator, BatchEnd, CheckpointHandler, EarlyStoppingHandler,
+    LoggingHandler, StoppingHandler)
+
+
+def _data(n=192, d=8, k=3, batch=32, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    W = rng.randn(d, k).astype(np.float32)
+    Y = (X @ W).argmax(1).astype(np.float32)
+    ds = gluon.data.ArrayDataset(nd.array(X), nd.array(Y))
+    return gluon.data.DataLoader(ds, batch_size=batch, shuffle=True), \
+        gluon.data.DataLoader(ds, batch_size=batch)
+
+
+def _est(lr=0.05):
+    net = gluon.nn.Dense(3)
+    net.initialize(mx.init.Xavier())
+    return Estimator(net, loss=gluon.loss.SoftmaxCrossEntropyLoss(),
+                     train_metrics=mx.metric.Accuracy(),
+                     trainer=gluon.Trainer(net.collect_params(), "adam",
+                                           {"learning_rate": lr}))
+
+
+def test_fit_converges_and_validates():
+    train, val = _data()
+    est = _est()
+    est.fit(train, val_data=val, epochs=5)
+    assert est.train_metrics[0].get()[1] > 0.85
+    vals = dict(m.get() for m in est.evaluate(val))
+    assert vals["accuracy"] > 0.85
+
+
+def test_stop_on_batches():
+    train, _ = _data()
+    est = _est()
+    seen = []
+
+    class Counter(BatchEnd):
+        def batch_end(self, estimator, *a, **kw):
+            seen.append(1)
+
+    est.fit(train, batches=4, event_handlers=[Counter()])
+    assert len(seen) == 4
+
+
+def test_checkpoint_handler(tmp_path):
+    train, _ = _data()
+    est = _est()
+    est.fit(train, epochs=2, event_handlers=[
+        CheckpointHandler(str(tmp_path), monitor=est.train_metrics[0],
+                          save_best=True)])
+    names = sorted(os.listdir(str(tmp_path)))
+    assert "model-best.params" in names
+    assert "model-epoch2.params" in names
+    # best weights load back into a fresh net
+    net2 = gluon.nn.Dense(3)
+    net2.load_parameters(str(tmp_path / "model-best.params"))
+
+
+def test_early_stopping():
+    train, _ = _data()
+    est = _est(lr=0.0)      # frozen learning -> metric never improves
+    stopper = EarlyStoppingHandler(est.train_metrics[0], patience=1)
+    est.fit(train, epochs=50, event_handlers=[stopper])
+    assert stopper.stop_training
+    assert stopper.current_epoch < 10
+
+
+def test_default_handlers_dedupe():
+    train, _ = _data()
+    est = _est()
+    handlers = est._prepare_handlers(None, 2, None,
+                                     [StoppingHandler(max_epoch=2),
+                                      LoggingHandler()])
+    assert sum(isinstance(h, StoppingHandler) for h in handlers) == 1
+    assert sum(isinstance(h, LoggingHandler) for h in handlers) == 1
+
+
+def test_val_metric_monitors_read_current_epoch():
+    """Validation runs before user epoch-end handlers, so a handler
+    monitoring a val metric sees THIS epoch's value (not nan/stale)."""
+    train, val = _data()
+    est = _est()
+    stopper = EarlyStoppingHandler(est.val_metrics[0], patience=3,
+                                   mode="max")
+    est.fit(train, val_data=val, epochs=4, event_handlers=[stopper])
+    # the monitor must have seen real values (best updated from -inf)
+    assert stopper.best > 0.0, stopper.best

@@ -481,3 +481,25 @@ def test_lrn_vs_torch():
     o = invoke("LRN", nd.array(x), alpha=alpha, beta=beta, knorm=k,
                nsize=n)
     _close(o, to, rtol=1e-4, atol=1e-5, what="lrn fwd")
+
+
+def test_spatial_transformer_vs_torch():
+    """GridGenerator(affine) + BilinearSampler == affine_grid +
+    grid_sample(align_corners=True) (reference: spatial_transformer.cc)."""
+    rng = np.random.RandomState(16)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    # mild affine transforms around identity
+    theta = (np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32), (2, 1))
+             + rng.uniform(-0.2, 0.2, (2, 6)).astype(np.float32))
+
+    tg = torch.nn.functional.affine_grid(
+        torch.tensor(theta.reshape(2, 2, 3)), (2, 3, 6, 6),
+        align_corners=True)
+    to = torch.nn.functional.grid_sample(
+        torch.tensor(x), tg, mode="bilinear", padding_mode="zeros",
+        align_corners=True)
+
+    o = invoke("SpatialTransformer", nd.array(x), nd.array(theta),
+               target_shape=(6, 6), transform_type="affine",
+               sampler_type="bilinear")
+    _close(o, to, rtol=1e-4, atol=1e-5, what="spatial transformer")
