@@ -435,8 +435,14 @@ def _run_backward(heads, head_grads, retain_graph, write_leaves=True,
             node.primal_vals = None
             node.primal_refs = None
         for parent, g in zip(node.parents, grads):
-            if parent is not None and g is not None:
-                add_ct(parent, g)
+            if parent is None or g is None:
+                continue
+            gj = g._jax if hasattr(g, "_jax") else g
+            if getattr(gj, "dtype", None) == jax.dtypes.float0:
+                # jax.vjp's "no gradient" marker for integer inputs
+                # (index operands of gather/clip/mod): nothing flows
+                continue
+            add_ct(parent, g)
 
     if write_leaves:
         for key, val in leaf_vals.items():

@@ -1124,3 +1124,94 @@ def iinfo(dtype):
 
 def astype(x, dtype, copy=True):
     return x.astype(dtype, copy=copy)
+
+
+def block(arrays):
+    """Assemble nested lists of arrays (np.block subset: nested lists,
+    no bare-scalar mixing)."""
+    ctx = None
+
+    def find_ctx(a):
+        nonlocal ctx
+        if isinstance(a, list):
+            for x in a:
+                find_ctx(x)
+        elif ctx is None:
+            ctx = _src_ctx(a)
+    find_ctx(arrays)
+    return _wrap(jnp.block(_unwrap(arrays)), ctx=ctx)
+
+
+def choose(a, choices, out=None, mode="raise"):
+    """numpy.choose over the registry ops (stack + take_along_axis), so
+    float choices stay on the autograd tape; XLA cannot raise on
+    out-of-range, so mode='raise' checks eagerly when possible and
+    otherwise clips."""
+    idx = asarray(a).astype("int32")
+    n = len(choices)
+    if mode == "wrap":
+        idx = mod(idx, n)
+    elif mode == "clip":
+        idx = clip(idx, 0, n - 1)
+    else:
+        try:
+            inp = _onp.asarray(_unwrap(asarray(a)))
+            if inp.size and (inp.min() < 0 or inp.max() >= n):
+                raise ValueError(
+                    "choose: index out of range for %d choices" % n)
+        except TypeError:
+            pass   # traced index: fall through to clipped gather
+        idx = clip(idx, 0, n - 1)
+    from ..ndarray.ndarray import NDArray as _ND
+    ch = stack([c if isinstance(c, _ND) else asarray(c)
+                for c in choices])       # asarray would DETACH taped arrays
+    return take_along_axis(ch, expand_dims(idx, 0), 0)[0]
+
+
+def put_along_axis(arr, indices, values, axis):
+    """Out-of-place variant (functional substrate): returns the updated
+    array AND writes through when `arr` is an NDArray."""
+    a = _unwrap(arr)
+    res = jnp.put_along_axis(a, _unwrap(indices),
+                             _unwrap(values).astype(a.dtype),
+                             axis, inplace=False)
+    if hasattr(arr, "_set_jax"):
+        arr._set_jax(res)
+        return arr
+    return _wrap(res, ctx=_src_ctx(arr))
+
+
+def _check_2d(arr, what):
+    if len(arr.shape) != 2:
+        raise ValueError("%s: input array must be 2-d" % what)
+
+
+def tril_indices_from(arr, k=0):
+    _check_2d(arr, "tril_indices_from")
+    return tril_indices(arr.shape[0], k=k, m=arr.shape[1])
+
+
+def triu_indices_from(arr, k=0):
+    _check_2d(arr, "triu_indices_from")
+    return triu_indices(arr.shape[0], k=k, m=arr.shape[1])
+
+
+def ix_(*args):
+    conv = []
+    for a in args:
+        ja = _unwrap(asarray(a))
+        if ja.dtype == jnp.bool_:
+            ja = jnp.nonzero(ja)[0]       # numpy: masks become indices
+        conv.append(ja)
+    outs = jnp.ix_(*conv)
+    ctx = _src_ctx(args[0]) if args else None
+    return tuple(_wrap(o, ctx=ctx) for o in outs)
+
+
+def mask_indices(n, mask_func, k=0):
+    """numpy semantics: apply mask_func to an (n, n) ones matrix and
+    return the nonzero indices — works with any triu/tril-like callable
+    (ours or numpy's)."""
+    m = ones((n, n))
+    a = mask_func(m, k)
+    return nonzero(a)

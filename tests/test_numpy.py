@@ -414,3 +414,30 @@ def test_np_function_promotion_rules():
     b = np.greater(i, 1)
     assert b.dtype == onp.bool_
     assert str(np.sum(b).dtype).startswith("int")     # bool sums to int
+
+
+def test_np_block_choose_putalong_ix():
+    """The np.block/choose/put_along_axis/ix_/tril_indices_from family
+    (2.x mx.np breadth) against numpy."""
+    a = np.array([[1.0, 2], [3, 4]])
+    assert np.block([[a, a], [a, a]]).shape == (4, 4)
+    onp.testing.assert_allclose(
+        np.block([a, a]).asnumpy(), onp.block([a.asnumpy(), a.asnumpy()]))
+    c = np.choose(np.array([0, 1], dtype="int32"),
+                  [np.array([1.0, 2]), np.array([10.0, 20])])
+    onp.testing.assert_allclose(c.asnumpy(), [1, 20])
+    arr = np.zeros((2, 3))
+    np.put_along_axis(arr, np.array([[0], [2]], dtype="int32"),
+                      np.array([[5.0], [7.0]]), axis=1)
+    onp.testing.assert_allclose(arr.asnumpy(), [[5, 0, 0], [0, 0, 7]])
+    r, c2 = np.tril_indices_from(a)
+    er, ec = onp.tril_indices_from(a.asnumpy())
+    assert onp.array_equal(r.asnumpy(), er)
+    assert onp.array_equal(c2.asnumpy(), ec)
+    ix = np.ix_(np.array([0, 1], dtype="int32"),
+                np.array([1], dtype="int32"))
+    assert ix[0].shape == (2, 1) and ix[1].shape == (1, 1)
+    r3, c3 = np.mask_indices(3, np.triu, 1)
+    er3, ec3 = onp.mask_indices(3, onp.triu, 1)
+    assert onp.array_equal(r3.asnumpy(), er3)
+    assert onp.array_equal(c3.asnumpy(), ec3)
