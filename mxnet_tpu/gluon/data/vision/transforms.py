@@ -24,7 +24,7 @@ from ...nn import Sequential
 __all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
            "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
            "RandomBrightness", "RandomContrast", "RandomSaturation",
-           "RandomLighting", "RandomColorJitter", "CropResize"]
+           "RandomLighting", "RandomColorJitter", "CropResize", "RandomHue", "RandomGray", "Rotate", "RandomRotation"]
 
 
 def _to_np(x) -> _np.ndarray:
@@ -279,3 +279,95 @@ class RandomColorJitter(Block):
         for t in ts:
             x = t(x)
         return x
+
+
+class RandomHue(Block):
+    """Random hue jitter (reference: transforms.RandomHue over the
+    _image_random_hue kernel)."""
+
+    def __init__(self, hue):
+        super().__init__()
+        self._h = hue
+
+    def forward(self, x):
+        from ....ndarray.ndarray import invoke
+        return invoke("_image_random_hue", nd.array(_to_np(x)),
+                      min_factor=-self._h, max_factor=self._h)
+
+
+class RandomGray(Block):
+    """With probability p, collapse to ITU-R BT.601 luma replicated over
+    channels (reference: transforms.RandomGray)."""
+
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        if _pyrandom.random() >= self._p:
+            return x if isinstance(x, nd.NDArray) else nd.array(_to_np(x))
+        a = _to_np(x).astype(_np.float32)
+        luma = (0.299 * a[..., 0] + 0.587 * a[..., 1]
+                + 0.114 * a[..., 2])
+        return nd.array(_np.stack([luma] * a.shape[-1], axis=-1))
+
+
+class Rotate(Block):
+    """Rotate by a FIXED angle (degrees, counter-clockwise), bilinear
+    with zero padding (reference: transforms.Rotate)."""
+
+    def __init__(self, rotation_degrees, zoom_in=False, zoom_out=False):
+        super().__init__()
+        if zoom_in or zoom_out:
+            raise NotImplementedError(
+                "Rotate: zoom_in/zoom_out not implemented")
+        self._deg = rotation_degrees
+
+    def forward(self, x):
+        return _rotate_hwc(x, self._deg)
+
+
+class RandomRotation(Block):
+    """Rotate by a uniform random angle from [lo, hi] degrees
+    (reference: transforms.RandomRotation)."""
+
+    def __init__(self, angle_limits, zoom_in=False, zoom_out=False,
+                 rotate_with_proba=1.0):
+        super().__init__()
+        if zoom_in or zoom_out:
+            raise NotImplementedError(
+                "RandomRotation: zoom_in/zoom_out not implemented")
+        self._lim = angle_limits
+        self._p = rotate_with_proba
+
+    def forward(self, x):
+        if _pyrandom.random() >= self._p:
+            return x if isinstance(x, nd.NDArray) else nd.array(_to_np(x))
+        deg = _pyrandom.uniform(*self._lim)
+        return _rotate_hwc(x, deg)
+
+
+def _rotate_hwc(x, deg):
+    """HWC rotate about the center via the BilinearSampler kernel (the
+    affine grid is the rotation matrix)."""
+    import math
+    from ....ndarray.ndarray import invoke
+    a = _to_np(x).astype(_np.float32)
+    chw = _np.moveaxis(a, -1, 0)[None]                # (1, C, H, W)
+    # grid maps output→input, and the image y-axis points down: the
+    # CCW array-coords rotation needs the NEGATED angle here (pinned
+    # against np.rot90 in tests).  Normalized grid units differ per axis
+    # for H != W — the sin terms carry the aspect ratio so the rotation
+    # stays RIGID in pixel space.
+    th = -math.radians(deg)
+    H, W = a.shape[0], a.shape[1]
+    sx = max(W - 1, 1) / 2.0
+    sy = max(H - 1, 1) / 2.0
+    theta = _np.array([[math.cos(th), math.sin(th) * sy / sx, 0.0,
+                        -math.sin(th) * sx / sy, math.cos(th), 0.0]],
+                      _np.float32)
+    grid = invoke("GridGenerator", nd.array(theta),
+                  transform_type="affine",
+                  target_shape=(a.shape[0], a.shape[1]))
+    out = invoke("BilinearSampler", nd.array(chw), grid)
+    return nd.array(_np.moveaxis(out.asnumpy()[0], 0, -1))

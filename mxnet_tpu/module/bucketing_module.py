@@ -41,6 +41,7 @@ class BucketingModule(BaseModule):
         self._buckets: Dict = {}
         self._curr_module: Optional[Module] = None
         self._curr_bucket_key = None
+        self._monitor = None
         self._for_training = True
         self._grad_req = "write"
 
@@ -150,6 +151,10 @@ class BucketingModule(BaseModule):
             module.bind(data_shapes, label_shapes, self._for_training,
                         self._inputs_need_grad, grad_req=self._grad_req)
             self._share_params(module)
+            if self._monitor is not None:
+                # late-created buckets get the monitor too (reference
+                # re-installs in switch_bucket)
+                module.install_monitor(self._monitor)
             self._buckets[bucket_key] = module
         self._curr_module = self._buckets[bucket_key]
         self._curr_bucket_key = bucket_key
@@ -213,5 +218,6 @@ class BucketingModule(BaseModule):
         self._curr_module.update_metric(eval_metric, labels)
 
     def install_monitor(self, monitor):
+        self._monitor = monitor
         for mod in self._buckets.values():
             mod.install_monitor(monitor)

@@ -1163,8 +1163,13 @@ def choose(a, choices, out=None, mode="raise"):
             pass   # traced index: fall through to clipped gather
         idx = clip(idx, 0, n - 1)
     from ..ndarray.ndarray import NDArray as _ND
-    ch = stack([c if isinstance(c, _ND) else asarray(c)
-                for c in choices])       # asarray would DETACH taped arrays
+    chs = [c if isinstance(c, _ND) else asarray(c) for c in choices]
+    # numpy semantics: index and choices broadcast together
+    common = _onp.broadcast_shapes(tuple(idx.shape),
+                                   *[tuple(c.shape) for c in chs])
+    idx = broadcast_to(idx, common)
+    ch = stack([c if tuple(c.shape) == common else broadcast_to(c, common)
+                for c in chs])    # broadcast_to is a registry op: taped
     return take_along_axis(ch, expand_dims(idx, 0), 0)[0]
 
 
@@ -1173,7 +1178,7 @@ def put_along_axis(arr, indices, values, axis):
     array AND writes through when `arr` is an NDArray."""
     a = _unwrap(arr)
     res = jnp.put_along_axis(a, _unwrap(indices),
-                             _unwrap(values).astype(a.dtype),
+                             jnp.asarray(_unwrap(values)).astype(a.dtype),
                              axis, inplace=False)
     if hasattr(arr, "_set_jax"):
         arr._set_jax(res)

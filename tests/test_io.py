@@ -522,3 +522,25 @@ def test_native_jpeg_decoder_matches_pil():
     # the public imdecode composes both paths
     np.testing.assert_array_equal(mimg.imdecode(jpeg).asnumpy(), pil)
     assert mimg.imdecode(buf2.getvalue()).shape == (32, 48, 3)
+
+
+def test_vision_transforms_hue_gray_rotate():
+    """RandomHue/RandomGray/Rotate/RandomRotation (reference:
+    gluon/data/vision/transforms.py) — Rotate pinned against np.rot90."""
+    import numpy as onp
+    from mxnet_tpu.gluon.data.vision import transforms as T
+    img = mx.nd.array(onp.random.RandomState(0).rand(8, 8, 3)
+                      .astype(onp.float32))
+    r = T.Rotate(90)(img).asnumpy()
+    onp.testing.assert_allclose(
+        r, onp.rot90(img.asnumpy(), 1, axes=(0, 1)), atol=1e-5)
+    g = T.RandomGray(1.0)(img).asnumpy()
+    onp.testing.assert_allclose(g[..., 0], g[..., 2])
+    h = T.RandomHue(0.3)(img)
+    assert h.shape == img.shape
+    rr = T.RandomRotation((-45, 45))(img)
+    assert rr.shape == img.shape
+    # p=0 variants are identity
+    onp.testing.assert_allclose(
+        T.RandomRotation((-45, 45), rotate_with_proba=0.0)(img).asnumpy(),
+        img.asnumpy())
