@@ -219,6 +219,18 @@ def quantize_net(network, quantized_dtype: str = "int8",
         raise MXNetError("TPU quantize_net requires calib_data (the "
                          "reference's calib_mode='none' weight-only path "
                          "is not supported)")
+    # A hybridized net would run its CACHED fp32 executable, bypassing
+    # both the calibration hooks and the rewritten int8 forwards — the
+    # quantized net is python-dispatched (each int8 op rides the per-op
+    # jit cache instead).  De-hybridize the whole tree up front.
+    def _dehybridize(block):
+        if hasattr(block, "_cache"):
+            block._cache = {}
+        if hasattr(block, "_active"):
+            block._active = False
+        for child in getattr(block, "_children", {}).values():
+            _dehybridize(child)
+    _dehybridize(network)
     exclude = set(exclude_layers or ())
 
     def walk(block, prefix=""):

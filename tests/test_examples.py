@@ -121,3 +121,17 @@ def test_symbolic_lenet_example():
                "--num-epochs", "1", timeout=900)
     acc = float(out.strip().splitlines()[-1].split(":")[1])
     assert acc > 0.9, acc
+
+
+def test_quantize_model_example():
+    """Post-training INT8 flow: train fp32 -> calibrate -> compare
+    (reference example/quantization).  The quantized-layer count proves
+    the rewrite actually engaged (a hybridize-cache bypass once made
+    this comparison fp32-vs-fp32)."""
+    out = _run("quantize_model.py", "--epochs", "2", timeout=900)
+    lines = out.strip().splitlines()
+    n_q = int([l for l in lines if l.startswith("quantized layers")][0]
+              .split(":")[1])
+    assert n_q == 4, out
+    drop = float(lines[-1].split(":")[1])
+    assert abs(drop) < 0.1, out
