@@ -84,8 +84,7 @@ def latency(net, data, iters=3):
 
 def _quantized_layers(block):
     for child in block._children.values():
-        if type(getattr(child, "forward", None)).__name__ \
-                == "_QuantizedForward":
+        if getattr(child, "_quantized", False):
             yield child
         yield from _quantized_layers(child)
 
@@ -118,9 +117,8 @@ def main():
         fp32_acc = accuracy(net, val)
         fp32_ips = latency(net, val)
 
-        calib = [x for i, (x, _) in enumerate(train)
-                 if i < args.calib_batches]
-        qnet = quantize_net(net, calib_data=calib,
+        qnet = quantize_net(net, calib_data=train,
+                            num_calib_batches=args.calib_batches,
                             calib_mode=args.calib_mode)
         n_q = sum(1 for _ in _quantized_layers(qnet))
         print("quantized layers: %d" % n_q)

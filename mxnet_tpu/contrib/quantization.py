@@ -222,15 +222,8 @@ def quantize_net(network, quantized_dtype: str = "int8",
     # A hybridized net would run its CACHED fp32 executable, bypassing
     # both the calibration hooks and the rewritten int8 forwards — the
     # quantized net is python-dispatched (each int8 op rides the per-op
-    # jit cache instead).  De-hybridize the whole tree up front.
-    def _dehybridize(block):
-        if hasattr(block, "_cache"):
-            block._cache = {}
-        if hasattr(block, "_active"):
-            block._active = False
-        for child in getattr(block, "_children", {}).values():
-            _dehybridize(child)
-    _dehybridize(network)
+    # jit cache instead).
+    network.hybridize(active=False)
     exclude = set(exclude_layers or ())
 
     def walk(block, prefix=""):
@@ -280,13 +273,15 @@ def quantize_net(network, quantized_dtype: str = "int8",
     ranges = collector.thresholds()
 
     # ---- rewrite pass ----
+    n_rewritten = 0
     for name, blk, kind in targets:
         if name not in ranges:
             continue  # block never ran during calibration
         blk.forward = _QuantizedForward(blk, kind, ranges[name],
                                         quantized_dtype)
         blk._quantized = True
+        n_rewritten += 1
     if logger:
         logger.info("quantized %d layers (%s calibration over %d batches)",
-                    len(targets), calib_mode, n)
+                    n_rewritten, calib_mode, n)
     return network

@@ -225,3 +225,38 @@ def test_name_manager_and_prefix():
     # explicit names pass through untouched
     e = sym.relu(sym.Variable("x"), name="myrelu")
     assert e.name == "myrelu"
+
+
+def test_symbolblock_imports_classic_autovar_net():
+    """A classic symbol built with keyword inputs + auto-created params
+    round-trips through symbol.json into gluon.SymbolBlock and matches
+    the executor numerics."""
+    import os
+    import tempfile
+
+    import numpy as onp
+
+    from mxnet_tpu import gluon
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data=data, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(data=net, act_type="relu")
+    net = mx.sym.FullyConnected(data=net, num_hidden=3, name="fc2")
+    with tempfile.TemporaryDirectory() as d:
+        sym_path = os.path.join(d, "net-symbol.json")
+        with open(sym_path, "w") as f:
+            f.write(net.tojson())
+        exe = net.simple_bind(mx.cpu(), data=(2, 5))
+        for k in exe.arg_dict:
+            if k != "data":
+                exe.arg_dict[k][:] = nd.random.normal(
+                    shape=exe.arg_dict[k].shape)
+        params_path = os.path.join(d, "net-0000.params")
+        nd.save(params_path, {"arg:%s" % k: v
+                              for k, v in exe.arg_dict.items()
+                              if k != "data"})
+        sb = gluon.SymbolBlock.imports(sym_path, ["data"], params_path)
+        x = nd.random.normal(shape=(2, 5))
+        onp.testing.assert_allclose(sb(x).asnumpy(),
+                                    exe.forward(data=x)[0].asnumpy(),
+                                    atol=1e-5)
