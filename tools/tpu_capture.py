@@ -215,7 +215,9 @@ def _run_tpu_test_lane():
     env.pop("MX_FORCE_CPU", None)
     env["MX_TEST_CTX"] = "tpu"
     argv = [sys.executable, "-m", "pytest", "-q", "--no-header", "-p",
-            "no:cacheprovider", "tests/test_operator.py", "tests/test_gluon.py"]
+            "no:cacheprovider", "tests/test_operator.py",
+            "tests/test_gluon.py", "tests/test_transformer.py",
+            "tests/test_torch_parity.py"]
     try:
         r = subprocess.run(argv, env=env, timeout=CHILD_TIMEOUT_S, cwd=REPO,
                            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
