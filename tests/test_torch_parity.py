@@ -503,3 +503,96 @@ def test_spatial_transformer_vs_torch():
                target_shape=(6, 6), transform_type="affine",
                sampler_type="bilinear")
     _close(o, to, rtol=1e-4, atol=1e-5, what="spatial transformer")
+
+
+def test_conv1d_conv3d_vs_torch():
+    """The 1-D and 3-D Convolution layouts (NCW/NCDHW) — only the 2-D
+    path gets regular exercise elsewhere."""
+    rng = np.random.RandomState(17)
+    # 1-D
+    x1 = rng.randn(2, 3, 12).astype(np.float32)
+    w1 = rng.randn(5, 3, 3).astype(np.float32)
+    tx, tw = _t(x1, True), _t(w1, True)
+    to = torch.nn.functional.conv1d(tx, tw, stride=2, padding=1)
+    go = rng.randn(*to.shape).astype(np.float32)
+    to.backward(_t(go))
+    xx, ww = nd.array(x1), nd.array(w1)
+    xx.attach_grad()
+    ww.attach_grad()
+    with autograd.record():
+        o = invoke("Convolution", xx, ww, None, kernel=(3,),
+                   num_filter=5, stride=(2,), pad=(1,), no_bias=True)
+    o.backward(nd.array(go))
+    _close(o, to, what="conv1d fwd")
+    _close(xx.grad, tx.grad, what="conv1d dx")
+    _close(ww.grad, tw.grad, what="conv1d dw")
+
+    # 3-D
+    x3 = rng.randn(1, 2, 6, 6, 6).astype(np.float32)
+    w3 = rng.randn(4, 2, 3, 3, 3).astype(np.float32)
+    tx3, tw3 = _t(x3, True), _t(w3, True)
+    to3 = torch.nn.functional.conv3d(tx3, tw3, stride=1, padding=1)
+    go3 = rng.randn(*to3.shape).astype(np.float32)
+    to3.backward(_t(go3))
+    xx3, ww3 = nd.array(x3), nd.array(w3)
+    xx3.attach_grad()
+    ww3.attach_grad()
+    with autograd.record():
+        o3 = invoke("Convolution", xx3, ww3, None, kernel=(3, 3, 3),
+                    num_filter=4, stride=(1, 1, 1), pad=(1, 1, 1),
+                    no_bias=True)
+    o3.backward(nd.array(go3))
+    _close(o3, to3, rtol=2e-4, atol=2e-4, what="conv3d fwd")
+    _close(xx3.grad, tx3.grad, rtol=2e-4, atol=2e-4, what="conv3d dx")
+    _close(ww3.grad, tw3.grad, rtol=2e-4, atol=2e-4, what="conv3d dw")
+
+
+def test_pool1d_pool3d_vs_torch():
+    rng = np.random.RandomState(18)
+    x1 = rng.randn(2, 3, 11).astype(np.float32)
+    tx = _t(x1, True)
+    to = torch.nn.functional.max_pool1d(tx, 3, stride=2)
+    go = rng.randn(*to.shape).astype(np.float32)
+    to.backward(_t(go))
+    xx = nd.array(x1)
+    xx.attach_grad()
+    with autograd.record():
+        o = invoke("Pooling", xx, kernel=(3,), pool_type="max",
+                   stride=(2,))
+    o.backward(nd.array(go))
+    _close(o, to, what="maxpool1d fwd")
+    _close(xx.grad, tx.grad, what="maxpool1d dx")
+
+    x3 = rng.randn(1, 2, 6, 6, 6).astype(np.float32)
+    tx3 = _t(x3, True)
+    to3 = torch.nn.functional.avg_pool3d(tx3, 2, stride=2)
+    go3 = rng.randn(*to3.shape).astype(np.float32)
+    to3.backward(_t(go3))
+    xx3 = nd.array(x3)
+    xx3.attach_grad()
+    with autograd.record():
+        o3 = invoke("Pooling", xx3, kernel=(2, 2, 2),
+                    pool_type="avg", stride=(2, 2, 2))
+    o3.backward(nd.array(go3))
+    _close(o3, to3, what="avgpool3d fwd")
+    _close(xx3.grad, tx3.grad, what="avgpool3d dx")
+
+
+def test_deconv1d_vs_torch():
+    rng = np.random.RandomState(19)
+    x = rng.randn(2, 4, 9).astype(np.float32)
+    w = rng.randn(4, 6, 3).astype(np.float32)
+    tx, tw = _t(x, True), _t(w, True)
+    to = torch.nn.functional.conv_transpose1d(tx, tw, stride=2, padding=1)
+    go = rng.randn(*to.shape).astype(np.float32)
+    to.backward(_t(go))
+    xx, ww = nd.array(x), nd.array(w)
+    xx.attach_grad()
+    ww.attach_grad()
+    with autograd.record():
+        o = invoke("Deconvolution", xx, ww, None, kernel=(3,),
+                   num_filter=6, stride=(2,), pad=(1,), no_bias=True)
+    o.backward(nd.array(go))
+    _close(o, to, what="deconv1d fwd")
+    _close(xx.grad, tx.grad, what="deconv1d dx")
+    _close(ww.grad, tw.grad, what="deconv1d dw")
