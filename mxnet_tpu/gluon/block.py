@@ -641,7 +641,15 @@ class SymbolBlock(HybridBlock):
             input_names = [input_names]
         block = SymbolBlock(sym, input_names)
         if param_file:
-            block._sym_params = _nd_mod.load(param_file)
+            loaded = _nd_mod.load(param_file)
+            if isinstance(loaded, list):
+                if loaded:
+                    raise MXNetError(
+                        "SymbolBlock.imports: %r holds a name-less array "
+                        "LIST; parameters need the dict form (arg:/aux: "
+                        "keys)" % param_file)
+                loaded = {}      # empty save is format-ambiguous
+            block._sym_params = loaded
         else:
             block._sym_params = {}
         block._input_names = input_names

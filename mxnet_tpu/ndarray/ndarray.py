@@ -742,6 +742,9 @@ _autograd = None
 
 
 def invoke(op_name: str, *inputs, out=None, **params):
+    # positional-attr extraction happens HERE, before dispatch AND before
+    # the symbol tracer records — both must see the canonical call
+    inputs = get_op(op_name).split_pos_attrs(inputs, params, NDArray)
     if _profiler.IMPERATIVE:
         with _profiler.op_span(op_name):
             ret = _invoke_impl(op_name, *inputs, out=out, **params)

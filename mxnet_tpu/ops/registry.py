@@ -34,7 +34,8 @@ _REGISTRY: Dict[str, "OpDef"] = {}
 
 class OpDef:
     __slots__ = ("name", "fn", "differentiable", "num_outputs", "doc",
-                 "mutates_input", "needs_rng", "aux_writeback", "no_jit")
+                 "mutates_input", "needs_rng", "aux_writeback", "no_jit",
+                 "_pos_params")
 
     def __init__(self, name: str, fn: Callable, differentiable: bool = True,
                  num_outputs: int = 1, doc: Optional[str] = None,
@@ -58,6 +59,57 @@ class OpDef:
         # dynamic-output-shape ops (boolean_mask, np.unique-style) cannot be
         # traced: dispatch eagerly, outside the per-op jit cache
         self.no_jit = no_jit
+        self._pos_params = None
+
+    def pos_params(self):
+        """[(name, has_default)] for the kernel's positional parameters
+        (minus the injected rng key; stops at *args).  Drives the
+        classic-API convention: a positional NON-tensor value whose slot
+        HAS a default is an attr (nd.expand_dims(x, 0), nd.one_hot(i, 5),
+        nd.reshape(x, (2, 3))); a slot without a default is a tensor
+        operand (broadcast_add(x, 2.0) stays an array)."""
+        if self._pos_params is None:
+            import inspect
+            info = []
+            try:
+                for p in inspect.signature(self.fn).parameters.values():
+                    if p.kind not in (p.POSITIONAL_ONLY,
+                                      p.POSITIONAL_OR_KEYWORD):
+                        break
+                    info.append((p.name, p.default is not p.empty))
+            except (TypeError, ValueError):
+                pass
+            if self.needs_rng and info and info[0][0] == "key":
+                info = info[1:]
+            self._pos_params = tuple(info)
+        return self._pos_params
+
+    def split_pos_attrs(self, inputs, params, tensor_cls):
+        """Classic-API positional attrs (one shared implementation for
+        the nd and sym dispatchers): a plain value (number/tuple/list/
+        str) in a slot whose kernel parameter HAS a default moves into
+        `params` (mutated in place); defaultless slots keep plain
+        numbers as tensor operands.  Raises on a positional/keyword
+        duplicate.  Returns the remaining tensor inputs."""
+        import numbers as _numbers
+        if not any(isinstance(x, (_numbers.Number, tuple, list, str))
+                   and not isinstance(x, tensor_cls) for x in inputs):
+            return inputs
+        info = self.pos_params()
+        kept = []
+        for i, x in enumerate(inputs):
+            if isinstance(x, (_numbers.Number, tuple, list, str)) \
+                    and not isinstance(x, tensor_cls) \
+                    and i < len(info) and info[i][1]:
+                name = info[i][0]
+                if name in params:
+                    raise TypeError(
+                        "%s: got multiple values for %r (positional and "
+                        "keyword)" % (self.name, name))
+                params[name] = x
+            else:
+                kept.append(x)
+        return tuple(kept)
 
     def __repr__(self):
         return "OpDef(%s)" % self.name

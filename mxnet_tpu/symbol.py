@@ -347,6 +347,15 @@ def _make_op_symbol(op_name: str, inputs: List[Symbol],
     attrs = dict(AttrScope.current_attrs())
     attrs.update({k: _attr_str(v) for k, v in params.items()
                   if v is not None})
+    # classic-API positional attrs, same convention as nd dispatch
+    # (shared helper; defaultless slots keep numbers as _const operands
+    # for the s + 2-style arithmetic helpers)
+    pos_attrs: Dict[str, Any] = {}
+    inputs = list(op.split_pos_attrs(tuple(inputs), pos_attrs, Symbol))
+    for k, v in pos_attrs.items():
+        if k in attrs:
+            raise TypeError("%s: got multiple values for %r" % (op_name, k))
+        attrs[k] = _attr_str(v)
     in_heads: List[Tuple[_SymNode, int]] = []
     for s in inputs:
         if isinstance(s, numbers.Number):

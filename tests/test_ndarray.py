@@ -204,3 +204,27 @@ def test_dlpack_interop():
     a = nd.array([1.0, 2.0])
     j = jnp.asarray(np.from_dlpack(a))
     np.testing.assert_array_equal(np.asarray(j), [1, 2])
+
+
+def test_positional_attr_convention():
+    """Classic-API positional attrs: a plain value in a defaulted kernel
+    slot is an attr (nd.expand_dims(x, 0), nd.one_hot(i, depth),
+    nd.reshape(x, shape)); defaultless slots keep scalars as array
+    operands (broadcast_add(x, 1.5))."""
+    x = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    assert nd.reshape(x, (3, 2)).shape == (3, 2)
+    assert nd.tile(x, (2, 1)).shape == (4, 3)
+    assert nd.repeat(x, 2).shape == (12,)
+    assert nd.expand_dims(x, 0).shape == (1, 2, 3)
+    assert nd.one_hot(nd.array(np.array([0, 2], np.float32)), 3) \
+        .shape == (2, 3)
+    np.testing.assert_allclose(nd.flip(x, 1).asnumpy()[0], [2, 1, 0])
+    from mxnet_tpu.ndarray.ndarray import invoke
+    np.testing.assert_allclose(
+        invoke("broadcast_add", x, 1.5).asnumpy()[0], [1.5, 2.5, 3.5])
+    # symbol side follows the same convention
+    s = mx.sym.Variable("x")
+    e = mx.sym.reshape(mx.sym.expand_dims(s, 0), (3, 2))
+    exe = e.simple_bind(mx.cpu(), x=(2, 3))
+    exe.arg_dict["x"][:] = x
+    assert exe.forward()[0].shape == (3, 2)
