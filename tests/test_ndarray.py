@@ -228,3 +228,32 @@ def test_positional_attr_convention():
     exe = e.simple_bind(mx.cpu(), x=(2, 3))
     exe.arg_dict["x"][:] = x
     assert exe.forward()[0].shape == (3, 2)
+
+
+def test_classic_idiom_battery():
+    """The positional idioms every v1.x codebase uses, in one net."""
+    x = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    assert nd.transpose(x, (1, 0)).shape == (3, 2)
+    assert nd.swapaxes(x, 0, 1).shape == (3, 2)
+    assert (nd.clip(x, 1, 4).asnumpy() <= 4).all()
+    assert len(nd.split(x, 3)) == 3
+    assert nd.concat(x, x, dim=0).shape == (4, 3)
+    assert nd.dot(x, x, True).shape == (3, 3)
+    assert nd.sum(x, 1).shape == (2,)
+    assert nd.argmax(x, 1).shape == (2,)
+    assert nd.slice_axis(x, 1, 0, 2).shape == (2, 2)
+    assert nd.squeeze(nd.expand_dims(x, 0), 0).shape == (2, 3)
+    assert nd.stack(x, x, axis=0).shape == (2, 2, 3)
+    assert nd.broadcast_axis(nd.expand_dims(x, 0), 0, 4).shape \
+        == (4, 2, 3)
+    assert nd.cast(x, "int32").dtype == np.int32
+    np.testing.assert_allclose(
+        nd.one_hot(nd.array(np.array([0, 2], np.float32)), 3,
+                   on_value=5, off_value=-1).asnumpy()[0], [5, -1, -1])
+    np.testing.assert_allclose(
+        nd.SequenceMask(nd.ones((3, 2)),
+                        nd.array(np.array([1, 2], np.float32)), True,
+                        value=-9).asnumpy()[:, 0], [1, -9, -9])
+    for rt, want in (("indices", (2, 2)), ("value", (2, 2)),
+                     ("mask", (2, 3))):
+        assert tuple(nd.topk(x, k=2, ret_typ=rt).shape) == want
