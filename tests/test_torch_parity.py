@@ -322,11 +322,20 @@ def test_group_instance_norm_vs_torch():
     g = rng.rand(6).astype(np.float32) + 0.5
     b = rng.randn(6).astype(np.float32)
 
-    to = torch.nn.functional.group_norm(
-        torch.tensor(x), 3, torch.tensor(g), torch.tensor(b), eps=1e-5)
-    o = invoke("GroupNorm", nd.array(x), nd.array(g), nd.array(b),
-               num_groups=3, eps=1e-5)
+    tx, tg, tb = _t(x, True), _t(g, True), _t(b, True)
+    to = torch.nn.functional.group_norm(tx, 3, tg, tb, eps=1e-5)
+    go = rng.randn(*to.shape).astype(np.float32)
+    to.backward(_t(go))
+    xx, gg, bb = nd.array(x), nd.array(g), nd.array(b)
+    for v in (xx, gg, bb):
+        v.attach_grad()
+    with autograd.record():
+        o = invoke("GroupNorm", xx, gg, bb, num_groups=3, eps=1e-5)
+    o.backward(nd.array(go))
     _close(o, to, what="groupnorm fwd")
+    _close(xx.grad, tx.grad, rtol=1e-3, atol=1e-4, what="groupnorm dx")
+    _close(gg.grad, tg.grad, rtol=1e-3, atol=1e-4, what="gn dgamma")
+    _close(bb.grad, tb.grad, what="gn dbeta")
 
     to2 = torch.nn.functional.instance_norm(
         torch.tensor(x), weight=torch.tensor(g), bias=torch.tensor(b),
