@@ -605,3 +605,28 @@ def test_deconv1d_vs_torch():
     _close(o, to, what="deconv1d fwd")
     _close(xx.grad, tx.grad, what="deconv1d dx")
     _close(ww.grad, tw.grad, what="deconv1d dw")
+
+
+def test_softmax_temperature_and_bn_global_stats():
+    rng = np.random.RandomState(20)
+    x = rng.randn(3, 7).astype(np.float32)
+    T = 2.5
+    to = torch.nn.functional.softmax(torch.tensor(x) / T, dim=-1)
+    o = invoke("softmax", nd.array(x), axis=-1, temperature=T)
+    _close(o, to, what="softmax temperature")
+
+    # use_global_stats=True in TRAINING still normalizes by the moving
+    # stats (the reference's frozen-BN fine-tuning mode)
+    C = 4
+    xb = rng.randn(2, C, 5, 5).astype(np.float32)
+    g = rng.rand(C).astype(np.float32) + 0.5
+    b = rng.randn(C).astype(np.float32)
+    rm = rng.randn(C).astype(np.float32)
+    rv = rng.rand(C).astype(np.float32) + 0.5
+    to2 = torch.nn.functional.batch_norm(
+        torch.tensor(xb), torch.tensor(rm), torch.tensor(rv),
+        torch.tensor(g), torch.tensor(b), training=False, eps=1e-5)
+    o2 = invoke("BatchNorm", nd.array(xb), nd.array(g), nd.array(b),
+                nd.array(rm.copy()), nd.array(rv.copy()), eps=1e-5,
+                fix_gamma=False, use_global_stats=True, training=True)
+    _close(o2, to2, rtol=1e-4, atol=1e-5, what="bn use_global_stats")
