@@ -607,3 +607,68 @@ def test_fast_path_batchnorm_aux_and_eval():
         mod.forward(batch, is_train=False)
     mm2 = mod._exec.aux_dict["bn1_moving_mean"].asnumpy()
     np.testing.assert_allclose(mm1, mm2, err_msg="eval must not touch")
+
+
+def test_symbolic_resnet_trains_through_fused_step():
+    """Deep classic symbol (conv/BN/residual adds, 46 args + 26 aux
+    states, all auto-created) converges through the whole-graph-jitted
+    Module step — the fused path's BN writebacks and residual graph
+    structure at realistic depth."""
+
+    def unit(data, nf, stride, dim_match, name):
+        bn1 = mx.sym.BatchNorm(data=data, fix_gamma=False,
+                               name=name + "_bn1")
+        act1 = mx.sym.Activation(data=bn1, act_type="relu")
+        conv1 = mx.sym.Convolution(data=act1, num_filter=nf,
+                                   kernel=(3, 3), stride=stride,
+                                   pad=(1, 1), no_bias=True,
+                                   name=name + "_conv1")
+        bn2 = mx.sym.BatchNorm(data=conv1, fix_gamma=False,
+                               name=name + "_bn2")
+        act2 = mx.sym.Activation(data=bn2, act_type="relu")
+        conv2 = mx.sym.Convolution(data=act2, num_filter=nf,
+                                   kernel=(3, 3), pad=(1, 1),
+                                   no_bias=True, name=name + "_conv2")
+        short = data if dim_match else mx.sym.Convolution(
+            data=act1, num_filter=nf, kernel=(1, 1), stride=stride,
+            no_bias=True, name=name + "_sc")
+        return conv2 + short
+
+    data = mx.sym.Variable("data")
+    body = mx.sym.Convolution(data=data, num_filter=8, kernel=(3, 3),
+                              pad=(1, 1), no_bias=True, name="conv0")
+    for i, (nf, s) in enumerate([(8, (1, 1)), (16, (2, 2))]):
+        body = unit(body, nf, s, False, "s%d_u1" % i)
+        body = unit(body, nf, (1, 1), True, "s%d_u2" % i)
+    bn = mx.sym.BatchNorm(data=body, fix_gamma=False, name="bn_final")
+    act = mx.sym.Activation(data=bn, act_type="relu")
+    pool = mx.sym.Pooling(data=act, global_pool=True, pool_type="avg",
+                          kernel=(1, 1))
+    net = mx.sym.SoftmaxOutput(
+        data=mx.sym.FullyConnected(data=mx.sym.Flatten(pool),
+                                   num_hidden=5, name="fc"),
+        name="softmax")
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(48, 3, 12, 12).astype(np.float32)
+    Y = rng.randint(0, 5, 48).astype(np.float32)
+    it = mio.NDArrayIter(X, Y, batch_size=16, shuffle=True)
+    mod = Module(net, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5,
+                                         "momentum": 0.9})
+    metric = mx.metric.CrossEntropy()
+    losses = []
+    for _ in range(5):
+        it.reset()
+        metric.reset()
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            mod.update_metric(metric, batch.label)
+            mod.backward()
+            mod.update()
+        losses.append(metric.get()[1])
+    assert mod._jit_ok is True, "fused path must engage"
+    assert losses[-1] < losses[0] * 0.8, losses
