@@ -164,6 +164,13 @@ class BucketingModule(BaseModule):
                     arg_params=None, aux_params=None, allow_missing=False,
                     force_init=False, allow_extra=False):
         assert self.binded
+        if arg_params is None and aux_params is None and not force_init \
+                and getattr(self, "_preloaded_params", None):
+            # one-shot install of checkpoint params from load();
+            # force_init or explicit params always win, and the preload
+            # is consumed so later re-inits behave normally
+            arg_params, aux_params = self._preloaded_params
+            self._preloaded_params = None
         self._buckets[self._default_bucket_key].init_params(
             initializer, arg_params, aux_params, allow_missing, force_init,
             allow_extra)
@@ -221,3 +228,30 @@ class BucketingModule(BaseModule):
         self._monitor = monitor
         for mod in self._buckets.values():
             mod.install_monitor(monitor)
+
+    # -- checkpoints --------------------------------------------------------
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        """Reference: BucketingModule.save_checkpoint — the DEFAULT
+        bucket's symbol + the shared params (all buckets alias them)."""
+        assert self.binded and self.params_initialized
+        self._buckets[self._default_bucket_key].save_checkpoint(
+            prefix, epoch, save_optimizer_states)
+
+    @staticmethod
+    def load(prefix, epoch, sym_gen, default_bucket_key,
+             logger=logging, context=None, fixed_param_names=None,
+             load_optimizer_states=False):
+        """Reference: BucketingModule.load — rebuild from sym_gen and a
+        Module-format checkpoint; params install at bind+init time."""
+        if load_optimizer_states:
+            raise MXNetError(
+                "BucketingModule.load(load_optimizer_states=True) is not "
+                "supported: restore trainer state via "
+                "init_optimizer + updater.set_states after binding")
+        from ..model import load_checkpoint
+        _sym, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        bm = BucketingModule(sym_gen, default_bucket_key, logger=logger,
+                             context=context,
+                             fixed_param_names=fixed_param_names)
+        bm._preloaded_params = (arg_params, aux_params)
+        return bm

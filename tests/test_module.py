@@ -672,3 +672,30 @@ def test_symbolic_resnet_trains_through_fused_step():
         losses.append(metric.get()[1])
     assert mod._jit_ok is True, "fused path must engage"
     assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_bucketing_module_checkpoint_roundtrip(tmp_path):
+    """Reference: BucketingModule.save_checkpoint/load — default-bucket
+    symbol + shared params round-trip."""
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        fc = mx.sym.FullyConnected(data=mx.sym.Flatten(data),
+                                   num_hidden=4, name="fc")
+        return (mx.sym.SoftmaxOutput(data=fc, name="softmax"),
+                ("data",), ("softmax_label",))
+
+    from mxnet_tpu.module import BucketingModule as BM
+    bm = BM(sym_gen, default_bucket_key=6, context=mx.cpu())
+    bm.bind(data_shapes=[("data", (4, 6))],
+            label_shapes=[("softmax_label", (4,))])
+    bm.init_params(mx.init.Xavier())
+    prefix = str(tmp_path / "bm")
+    bm.save_checkpoint(prefix, 3)
+    bm2 = BM.load(prefix, 3, sym_gen, default_bucket_key=6,
+                  context=mx.cpu())
+    bm2.bind(data_shapes=[("data", (4, 6))],
+             label_shapes=[("softmax_label", (4,))])
+    bm2.init_params()
+    np.testing.assert_allclose(
+        bm.get_params()[0]["fc_weight"].asnumpy(),
+        bm2.get_params()[0]["fc_weight"].asnumpy())
