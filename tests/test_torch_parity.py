@@ -630,3 +630,49 @@ def test_softmax_temperature_and_bn_global_stats():
                 nd.array(rm.copy()), nd.array(rv.copy()), eps=1e-5,
                 fix_gamma=False, use_global_stats=True, training=True)
     _close(o2, to2, rtol=1e-4, atol=1e-5, what="bn use_global_stats")
+
+
+def test_gluon_losses_vs_torch():
+    """Gluon loss blocks vs torch.nn.functional equivalents (mean over
+    the batch axis matches gluon's per-sample means)."""
+    from mxnet_tpu import gluon
+    rng = np.random.RandomState(21)
+    p = rng.randn(4, 5).astype(np.float32)
+    t = rng.randn(4, 5).astype(np.float32)
+
+    l1 = gluon.loss.L1Loss()(nd.array(p), nd.array(t)).asnumpy()
+    tl1 = torch.nn.functional.l1_loss(torch.tensor(p), torch.tensor(t),
+                                      reduction="none").mean(1).numpy()
+    np.testing.assert_allclose(l1, tl1, rtol=1e-5)
+
+    l2 = gluon.loss.L2Loss()(nd.array(p), nd.array(t)).asnumpy()
+    tl2 = torch.nn.functional.mse_loss(torch.tensor(p), torch.tensor(t),
+                                       reduction="none").mean(1).numpy()
+    np.testing.assert_allclose(l2, tl2 / 2.0, rtol=1e-5)  # gluon halves
+
+    lab = rng.randint(0, 5, 4)
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()(
+        nd.array(p), nd.array(lab.astype(np.float32))).asnumpy()
+    tce = torch.nn.functional.cross_entropy(
+        torch.tensor(p), torch.tensor(lab).long(),
+        reduction="none").numpy()
+    np.testing.assert_allclose(ce, tce, rtol=1e-5)
+
+    logits = rng.randn(4, 5).astype(np.float32)
+    bin_t = (rng.rand(4, 5) > 0.5).astype(np.float32)
+    bce = gluon.loss.SigmoidBinaryCrossEntropyLoss()(
+        nd.array(logits), nd.array(bin_t)).asnumpy()
+    tbce = torch.nn.functional.binary_cross_entropy_with_logits(
+        torch.tensor(logits), torch.tensor(bin_t),
+        reduction="none").mean(1).numpy()
+    np.testing.assert_allclose(bce, tbce, rtol=1e-4)
+
+    # Huber: gluon HuberLoss(rho) == torch huber_loss(delta=rho)/rho?
+    # MXNet: 0.5*err^2/rho for |err|<=rho else |err|-0.5*rho; torch
+    # huber: 0.5*err^2 for |err|<=d else d*(|err|-0.5*d) — gluon = torch/d
+    rho = 1.3
+    h = gluon.loss.HuberLoss(rho=rho)(nd.array(p), nd.array(t)).asnumpy()
+    th = torch.nn.functional.huber_loss(
+        torch.tensor(p), torch.tensor(t), delta=rho,
+        reduction="none").mean(1).numpy()
+    np.testing.assert_allclose(h, th / rho, rtol=1e-5)
