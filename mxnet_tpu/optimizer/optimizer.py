@@ -336,15 +336,22 @@ class AdamW(Optimizer):
         lr = self._get_lr(index)
         wd = self._get_wd(index)
         t = self._index_update_count[index]
+        step_lr = lr
         if self.correct_bias:
             coef1 = 1.0 - self.beta1 ** t
             coef2 = 1.0 - self.beta2 ** t
-            lr *= math.sqrt(coef2) / coef1
+            step_lr = lr * math.sqrt(coef2) / coef1
         mean, var = state
-        invoke("adamw_update", weight, grad, mean, var, lr=lr, wd=wd, eta=1.0,
-               beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,
-               rescale_grad=self.rescale_grad,
+        # DECOUPLED decay at the RAW lr (the reference class follows the
+        # huggingface formulation: only the adam step carries the
+        # bias-correction factor; coupling wd with it shrinks the decay
+        # ~3x at t=1)
+        invoke("adamw_update", weight, grad, mean, var, lr=step_lr,
+               wd=0.0, eta=1.0, beta1=self.beta1, beta2=self.beta2,
+               epsilon=self.epsilon, rescale_grad=self.rescale_grad,
                clip_gradient=_clip(self.clip_gradient))
+        if wd:
+            weight -= lr * wd * weight
 
 
 @register

@@ -111,7 +111,10 @@ def test_adamw_decoupled_wd():
         lr_t = lr * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
         m = b1 * m + (1 - b1) * g
         v = b2 * v + (1 - b2) * g * g
-        expect -= lr_t * m / (np.sqrt(v) + eps) + wd * expect
+        # decoupled decay at the RAW lr (huggingface/2.x AdamW; pinned
+        # against torch.optim.AdamW in the torch-oracle lane)
+        expect -= lr_t * m / (np.sqrt(v) + eps)
+        expect -= lr * wd * expect
     assert np.allclose(w, expect, atol=1e-4)
 
 
