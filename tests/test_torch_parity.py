@@ -429,6 +429,9 @@ def test_trainer_sgd_adam_vs_torch_optim():
          torch.optim.Adam, {"lr": 0.05}),
         ("adamw", {"learning_rate": 0.05, "wd": 0.02},
          torch.optim.AdamW, {"lr": 0.05, "weight_decay": 0.02}),
+        ("nag", {"learning_rate": 0.1, "momentum": 0.9},
+         torch.optim.SGD, {"lr": 0.1, "momentum": 0.9,
+                           "nesterov": True}),
     ]:
         net = gluon.nn.Dense(3, in_units=5)
         net.initialize()
