@@ -561,6 +561,14 @@ random.rayleigh = lambda scale=1.0, size=None, **kw: invoke(
     "_random_rayleigh", scale=scale, shape=_rand_size(size))
 random.weibull = lambda a, size=None, **kw: invoke(
     "_random_weibull", a=a, shape=_rand_size(size))
+random.f = lambda dfnum, dfden, size=None, **kw: invoke(
+    "_random_f", dfnum=dfnum, dfden=dfden, shape=_rand_size(size))
+random.geometric = lambda p, size=None, **kw: invoke(
+    "_random_geometric", p=p, shape=_rand_size(size))
+random.power = lambda a, size=None, **kw: invoke(
+    "_random_power", a=a, shape=_rand_size(size))
+random.negative_binomial = lambda n, p, size=None, **kw: invoke(
+    "_random_negative_binomial", k=n, p=p, shape=_rand_size(size))
 random.poisson = lambda lam=1.0, size=None, **kw: invoke(
     "_random_poisson", lam=lam, shape=_rand_size(size))
 random.lognormal = lambda mean=0.0, sigma=1.0, size=None, **kw: _wrap(

@@ -511,3 +511,34 @@ def _npi_triangular(key, left=0.0, mode=0.5, right=1.0, size=(), dtype=None):
 @register("_npi_permutation", differentiable=False, needs_rng=True)
 def _npi_permutation(key, x):
     return jax.random.permutation(key, x, axis=0)
+
+
+@register("_random_f", aliases=["random_f"], differentiable=False,
+          needs_rng=True)
+def _f_dist(key, dfnum=1.0, dfden=1.0, shape=(), dtype=None):
+    """F(d1, d2) = (X1/d1)/(X2/d2) for chi-square X1, X2 (reference:
+    np.random.f)."""
+    dt = _dt(dtype)
+    k1, k2 = jax.random.split(key)
+    x1 = 2.0 * jax.random.gamma(k1, dfnum / 2.0, shape, jnp.float32)
+    x2 = 2.0 * jax.random.gamma(k2, dfden / 2.0, shape, jnp.float32)
+    return ((x1 / dfnum) / (x2 / dfden)).astype(dt)
+
+
+@register("_random_geometric", aliases=["random_geometric"],
+          differentiable=False, needs_rng=True)
+def _geometric(key, p=0.5, shape=(), dtype=None):
+    """Trials to first success, support {1, 2, ...} (np.random.geometric
+    convention): ceil(log(U)/log(1-p))."""
+    dt = _dt(dtype)
+    u = _u(key, shape, jnp.float32)
+    return jnp.ceil(jnp.log(u) / jnp.log1p(-p)).astype(dt)
+
+
+@register("_random_power", aliases=["random_power"],
+          differentiable=False, needs_rng=True)
+def _power_dist(key, a=1.0, shape=(), dtype=None):
+    """Power distribution on [0, 1]: U^(1/a) (np.random.power)."""
+    dt = _dt(dtype)
+    u = _u(key, shape, jnp.float32)
+    return jnp.power(u, 1.0 / a).astype(dt)

@@ -657,6 +657,12 @@ SPECS.update({
                                     "shape": (64,)}, grad=False),
     "_random_exponential": S(lambda: [], {"lam": 1.0, "shape": (64,)},
                              grad=False),
+    "_random_f": S(lambda: [], {"dfnum": 5.0, "dfden": 8.0,
+                                "shape": (64,)}, grad=False),
+    "_random_geometric": S(lambda: [], {"p": 0.4, "shape": (64,)},
+                           grad=False),
+    "_random_power": S(lambda: [], {"a": 2.0, "shape": (64,)},
+                       grad=False),
     "_random_poisson": S(lambda: [], {"lam": 2.0, "shape": (64,)},
                          grad=False),
     "_random_randint": S(lambda: [], {"low": 0, "high": 5, "shape": (64,)},

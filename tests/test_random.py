@@ -122,3 +122,17 @@ def test_shuffle_is_permutation():
     y = invoke("shuffle", x).asnumpy()
     assert sorted(y.tolist()) == list(range(512))
     assert not np.array_equal(y, np.arange(512))
+
+
+def test_f_geometric_power_moments():
+    """np.random.f / geometric / power / negative_binomial moment gates
+    (reference: np_random tests' moment-check pattern)."""
+    mx.random.seed(0)
+    f = mx.np.random.f(5.0, 8.0, 40000).asnumpy()
+    assert abs(f.mean() - 8 / 6) < 0.05
+    g = mx.np.random.geometric(0.3, 40000).asnumpy()
+    assert abs(g.mean() - 1 / 0.3) < 0.1 and g.min() >= 1
+    p = mx.np.random.power(3.0, 40000).asnumpy()
+    assert abs(p.mean() - 0.75) < 0.01 and p.max() <= 1.0
+    nb = mx.np.random.negative_binomial(4, 0.4, 40000).asnumpy()
+    assert abs(nb.mean() - 6.0) < 0.15
