@@ -699,3 +699,28 @@ def test_bucketing_module_checkpoint_roundtrip(tmp_path):
     np.testing.assert_allclose(
         bm.get_params()[0]["fc_weight"].asnumpy(),
         bm2.get_params()[0]["fc_weight"].asnumpy())
+
+
+def test_fused_path_grad_req_add():
+    """grad_req='add' accumulates across backward calls on the fused
+    whole-graph path, like the eager executor."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(
+        data=mx.sym.FullyConnected(data=data, num_hidden=3, name="fc"),
+        name="softmax")
+    X = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+    Y = np.random.RandomState(1).randint(0, 3, 8).astype(np.float32)
+    it = mio.NDArrayIter(X, Y, batch_size=8)
+    mod = Module(net, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data,
+             label_shapes=it.provide_label, grad_req="add")
+    mod.init_params(mx.init.Xavier())
+    batch = next(iter(it))
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    g1 = mod._exec.grad_dict["fc_weight"].asnumpy().copy()
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    np.testing.assert_allclose(mod._exec.grad_dict["fc_weight"].asnumpy(),
+                               2 * g1, rtol=1e-5)
+    assert mod._jit_ok is True
