@@ -38,7 +38,7 @@ __all__ = ["imdecode", "imread", "imresize", "imrotate", "resize_short",
            "RandomSizedCropAug", "CenterCropAug", "BrightnessJitterAug",
            "ContrastJitterAug", "SaturationJitterAug", "HueJitterAug",
            "ColorJitterAug", "LightingAug", "ColorNormalizeAug",
-           "RandomGrayAug", "CreateAugmenter", "ImageIter"]
+           "RandomGrayAug", "CreateAugmenter", "ImageIter", "scale_down"]
 
 
 def _pil():
@@ -526,6 +526,19 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     if mean is not None and std is not None:
         auglist.append(ColorNormalizeAug(mean, std))
     return auglist
+
+
+def scale_down(src_size, size):
+    """Scale `size` (w, h) down proportionally to fit within `src_size`
+    (h, w) (reference: image.scale_down — crop sizes must not exceed the
+    source image)."""
+    w, h = size
+    sh, sw = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
 
 
 # ImageIter lives with the other iterators; re-exported here for parity
