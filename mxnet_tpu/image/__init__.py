@@ -530,10 +530,10 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
 
 def scale_down(src_size, size):
     """Scale `size` (w, h) down proportionally to fit within `src_size`
-    (h, w) (reference: image.scale_down — crop sizes must not exceed the
-    source image)."""
+    (w, h) (reference: image.scale_down — crop sizes must not exceed the
+    source image; scale_down((640,480),(720,120)) == (640,106))."""
     w, h = size
-    sh, sw = src_size
+    sw, sh = src_size
     if sh < h:
         w, h = float(w * sh) / h, sh
     if sw < w:

@@ -544,3 +544,10 @@ def test_vision_transforms_hue_gray_rotate():
     onp.testing.assert_allclose(
         T.RandomRotation((-45, 45), rotate_with_proba=0.0)(img).asnumpy(),
         img.asnumpy())
+
+
+def test_image_scale_down():
+    """Reference docstring examples (src_size and size both (w, h))."""
+    assert mx.image.scale_down((640, 480), (720, 120)) == (640, 106)
+    assert mx.image.scale_down((360, 1000), (480, 500)) == (360, 375)
+    assert mx.image.scale_down((100, 100), (50, 50)) == (50, 50)
