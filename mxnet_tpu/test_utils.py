@@ -160,3 +160,15 @@ def check_consistency(fn: Callable, inputs_np: Sequence[np.ndarray],
             for a, b in zip(base, other):
                 assert_almost_equal(a, b, rtol=rtol, atol=atol,
                                     names=("ctx[%s]" % ctx_list[0], "ctx[%s]" % ctx))
+
+def rand_shape_2d(dim0=10, dim1=10):
+    """Random 2-D shape up to the given bounds (reference:
+    test_utils.rand_shape_2d)."""
+    return (_np.random.randint(1, dim0 + 1),
+            _np.random.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (_np.random.randint(1, dim0 + 1),
+            _np.random.randint(1, dim1 + 1),
+            _np.random.randint(1, dim2 + 1))
