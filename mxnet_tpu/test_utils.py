@@ -164,11 +164,11 @@ def check_consistency(fn: Callable, inputs_np: Sequence[np.ndarray],
 def rand_shape_2d(dim0=10, dim1=10):
     """Random 2-D shape up to the given bounds (reference:
     test_utils.rand_shape_2d)."""
-    return (_np.random.randint(1, dim0 + 1),
-            _np.random.randint(1, dim1 + 1))
+    return (np.random.randint(1, dim0 + 1),
+            np.random.randint(1, dim1 + 1))
 
 
 def rand_shape_3d(dim0=10, dim1=10, dim2=10):
-    return (_np.random.randint(1, dim0 + 1),
-            _np.random.randint(1, dim1 + 1),
-            _np.random.randint(1, dim2 + 1))
+    return (np.random.randint(1, dim0 + 1),
+            np.random.randint(1, dim1 + 1),
+            np.random.randint(1, dim2 + 1))
