@@ -12,8 +12,12 @@ from ..ops.control_flow import foreach, while_loop, cond
 from .. import amp  # 1.x location: mx.contrib.amp (2.x: mx.amp)
 from . import ndarray
 from . import ndarray as nd
+from . import symbol
+from . import symbol as sym
 from . import quantization
 from . import summary
+from . import summary as tensorboard   # the mxboard-role module
+from .. import onnx                    # 1.x location: mx.contrib.onnx
 
 __all__ = ["foreach", "while_loop", "cond", "nd", "ndarray", "amp",
            "quantization"]

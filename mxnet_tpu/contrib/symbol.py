@@ -1,0 +1,15 @@
+"""mx.contrib.symbol — contrib ops through the Symbol API (reference:
+python/mxnet/contrib/symbol.py; the op set is the registry's _contrib_
+family, composed symbolically)."""
+from ..symbol import __getattr__ as _sym_getattr
+
+
+def __getattr__(name):
+    # resolve contrib names against the symbol op namespace, accepting
+    # both spellings (box_nms and _contrib_box_nms)
+    for cand in (name, "_contrib_" + name):
+        try:
+            return _sym_getattr(cand)
+        except AttributeError:
+            continue
+    raise AttributeError("contrib.symbol has no op %r" % name)
