@@ -105,3 +105,13 @@ def get_gpu_count() -> int:
 def get_gpu_memory(dev_id: int = 0):
     from .device import gpu_memory_info
     return gpu_memory_info(dev_id)
+
+def set_np(shape=True, array=True, dtype=False):
+    """Reference: util.set_np — npx.set_np's canonical home."""
+    from . import npx
+    return npx.set_np(shape=shape, array=array, dtype=dtype)
+
+
+def reset_np():
+    from . import npx
+    return npx.reset_np()
