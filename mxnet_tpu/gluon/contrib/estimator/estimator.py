@@ -73,6 +73,11 @@ class Estimator:
     def evaluate(self, val_data, batch_axis=0):
         for m in self.val_metrics:
             m.reset()
+        # DataIter.__iter__ returns self without rewinding: reset here or
+        # the per-epoch ValidationHandler iterates an exhausted iterator
+        # from epoch 2 on and validation metrics silently freeze
+        if hasattr(val_data, "reset"):
+            val_data.reset()
         for batch in val_data:
             batch = batch if isinstance(batch, (list, tuple)) \
                 else (batch.data[0], batch.label[0])

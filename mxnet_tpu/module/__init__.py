@@ -576,6 +576,12 @@ class Module(BaseModule):
                 continue
             _fn, _attrs, label_name, label_chain = rule
             label = label_map.get(label_name)
+            if label is not None:
+                # drop the ORIGINAL fed object from the positional pool
+                # before any shape-chain replay rebinds `label` to a new
+                # NDArray — otherwise a later unnamed head could pop the
+                # consumed label positionally and train on the wrong one
+                positional = [l for l in positional if l is not label]
             if label is not None and label_chain:
                 from ..ndarray.ndarray import invoke as _invoke
                 from ..symbol import _attr_parse as _ap
@@ -584,9 +590,7 @@ class Module(BaseModule):
                                     **{k: _ap(v)
                                        for k, v in op_attrs.items()
                                        if not k.startswith("__")})
-            if label is not None:
-                positional = [l for l in positional if l is not label]
-            elif label_name is None and positional:
+            if label is None and label_name is None and positional:
                 label = positional.pop(0)
             resolved.append(label)
         return resolved
@@ -702,12 +706,32 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized
         if is_train is None:
             is_train = self.for_training
+        def in_batch_order(arrays, descs, wanted):
+            """Reference DataParallelExecutorGroup matches batch arrays to
+            module slots by NAME (DataDesc), not position — NDArrayIter
+            sorts dict-fed names, so positional zip would swap slots."""
+            names = []
+            for d in descs or []:
+                names.append(d[0] if isinstance(d, (tuple, list))
+                             else getattr(d, "name", d))
+            if len(names) == len(arrays):
+                by_name = dict(zip(names, arrays))
+                if all(n in by_name for n in wanted):
+                    # superset is fine: extra batch slots are ignored
+                    return [(n, by_name[n]) for n in wanted]
+            return list(zip(wanted, arrays))
+
         feeds = {}
-        for name, arr in zip(self._data_names, data_batch.data):
+        for name, arr in in_batch_order(
+                data_batch.data, getattr(data_batch, "provide_data", None),
+                self._data_names):
             feeds[name] = arr.as_in_context(self._context)
         self._labels = []
         if data_batch.label:
-            for name, arr in zip(self._label_names, data_batch.label):
+            for name, arr in in_batch_order(
+                    data_batch.label,
+                    getattr(data_batch, "provide_label", None),
+                    self._label_names):
                 arr = arr.as_in_context(self._context)
                 if name in self._exec.arg_dict:  # labels a non-loss head uses
                     feeds[name] = arr
@@ -788,6 +812,12 @@ class Module(BaseModule):
         return [self._exec.grad_dict.get(n) for n in self._data_names]
 
     def update_metric(self, eval_metric, labels):
+        # forward() has already name-matched the batch labels into module
+        # slot order; the raw data_batch.label list may be sorted
+        # differently (NDArrayIter sorts dict-fed names)
+        if getattr(self, "_labels", None) and len(self._labels) == \
+                len(labels):
+            labels = self._labels
         eval_metric.update(labels, self.get_outputs())
 
     def install_monitor(self, monitor):

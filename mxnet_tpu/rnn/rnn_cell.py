@@ -579,7 +579,12 @@ class ZoneoutCell(ModifierCell):
             return old + keep
         next_states = [mix(self._zs, n, o)
                        for n, o in zip(next_states, states)]
-        out = mix(self._zo, out, self._prev)
+        # first timestep: the reference zones the output against a zeros
+        # prev_output (mask * new), not an unmasked pass-through
+        prev = self._prev
+        if prev is None and self._zo > 0:
+            prev = sym._mul_scalar(out, scalar=0.0)
+        out = mix(self._zo, out, prev)
         self._prev = out
         return out, next_states
 

@@ -87,6 +87,21 @@ def test_default_handlers_dedupe():
     assert sum(isinstance(h, LoggingHandler) for h in handlers) == 1
 
 
+def test_evaluate_resets_dataiter_val_data():
+    """A DataIter-style val_data (iter() returns self, no rewind) must be
+    reset by evaluate(), or epoch-2+ validation sees zero batches and the
+    metrics silently freeze."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(96, 8).astype(np.float32)
+    Y = rng.randint(0, 3, 96).astype(np.float32)
+    it = mx.io.NDArrayIter(X, Y, batch_size=32)
+    est = _est()
+    first = dict(m.get() for m in est.evaluate(it))
+    second = dict(m.get() for m in est.evaluate(it))
+    assert not np.isnan(second["accuracy"])
+    assert second["accuracy"] == first["accuracy"]
+
+
 def test_val_metric_monitors_read_current_epoch():
     """Validation runs before user epoch-end handlers, so a handler
     monitoring a val metric sees THIS epoch's value (not nan/stale)."""

@@ -724,3 +724,34 @@ def test_fused_path_grad_req_add():
     np.testing.assert_allclose(mod._exec.grad_dict["fc_weight"].asnumpy(),
                                2 * g1, rtol=1e-5)
     assert mod._jit_ok is True
+
+
+def test_multi_head_label_name_matching():
+    """NDArrayIter sorts dict-fed label names; Module must match batch
+    labels to its label_names by NAME (reference DataParallelExecutorGroup
+    semantics), or a two-head fit silently trains each head on the other
+    head's label and never converges."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(192, 8).astype(np.float32)
+    W = rng.randn(8, 3).astype(np.float32)
+    Y = (X @ W).argmax(1).astype(np.float32)
+    Yr = X @ rng.randn(8, 1).astype(np.float32)
+    d = mx.sym.Variable("data")
+    h1 = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(d, num_hidden=3, name="fc"), name="softmax")
+    h2 = mx.sym.LinearRegressionOutput(
+        mx.sym.FullyConnected(d, num_hidden=1, name="fc2"), name="lro")
+    # module order (softmax_label, lro_label) != iterator's sorted order
+    mod = Module(mx.sym.Group([h1, h2]), data_names=("data",),
+                 label_names=("softmax_label", "lro_label"),
+                 context=mx.cpu())
+    it = mio.NDArrayIter({"data": X},
+                         {"softmax_label": Y, "lro_label": Yr},
+                         batch_size=32)
+    assert [d_.name for d_ in it.provide_label][0] == "lro_label"
+    mod.fit(it, num_epoch=10, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.3}, eval_metric="loss")
+    it.reset()
+    preds = mod.predict(it)
+    acc = float((preds[0].asnumpy().argmax(1) == Y).mean())
+    assert acc > 0.85, acc

@@ -41,6 +41,25 @@ def test_gru_residual_stack_and_zoneout():
     assert exe.forward()[0].shape == (N, T, H)
 
 
+def test_zoneout_first_step_zones_against_zeros():
+    """Reference ZoneoutCell zones the FIRST output against a zeros
+    prev_output (mask*new), so with high zoneout some units of step-1
+    output are exactly zero — not an unmasked pass-through."""
+    N, H, E = 4, 16, 8
+    cell = mx.rnn.ZoneoutCell(mx.rnn.GRUCell(H, prefix="g_"),
+                              zoneout_outputs=0.5)
+    outputs, _ = cell.unroll(1, inputs=_embed(E=E), merge_outputs=True)
+    exe = outputs.simple_bind(mx.cpu(), data=(N, 1))
+    rs = np.random.RandomState(3)
+    exe.arg_dict["data"][:] = nd.array(rs.randint(0, 20, (N, 1)))
+    for name, arr in exe.arg_dict.items():
+        if name != "data":
+            arr[:] = nd.array(rs.randn(*arr.shape) * 0.5)
+    out = exe.forward(is_train=True)[0].asnumpy()
+    n_zero = int((out == 0.0).sum())
+    assert 0 < n_zero < out.size, n_zero
+
+
 def test_cell_params_shared_across_steps():
     """Unrolling must reuse ONE weight set (RNNParams sharing)."""
     cell = mx.rnn.RNNCell(5, prefix="r_")

@@ -38,7 +38,15 @@ _spec.loader.exec_module(_mx_base)
 LOG = os.path.join(REPO, "TPU_CAPTURE.log")
 OUT = os.path.join(REPO, "TPU_CAPTURE.json")
 PROBE_TIMEOUT_S = 120
-CHILD_TIMEOUT_S = 1800
+# Round-4 post-mortem: a single healthy window was burned by 1800s child
+# timeouts on a tunnel that wedged mid-suite.  Children now get a 300s
+# budget (the pytest lane and the block sweep are the only exceptions,
+# and both run LAST), and the tunnel is re-probed before EVERY child so a
+# mid-suite wedge aborts the pass instead of serially timing out.
+CHILD_TIMEOUT_S = 300
+SWEEP_TIMEOUT_S = 1500          # 5 x (60s probe + 180s config) + startup
+REAL_DATA_TIMEOUT_S = 1200      # synthesizes a .rec pack then trains
+PYTEST_TIMEOUT_S = 1800         # the longest child; always ordered last
 PROBE_INTERVAL_S = 300          # 5 min cadence: ~144 probes over a 12h round
 MAX_HOURS = 13
 
@@ -69,8 +77,9 @@ def _probe():
     return _mx_base.probe_accelerator_once(PROBE_TIMEOUT_S)
 
 
-def _run_json_child(argv, tag):
+def _run_json_child(argv, tag, timeout=None):
     """Run a child that prints one JSON line; return the parsed dict or None."""
+    timeout = timeout or CHILD_TIMEOUT_S
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
     env.pop("MX_FORCE_CPU", None)
@@ -78,10 +87,10 @@ def _run_json_child(argv, tag):
     # a stale result could be re-stamped with a fresh captured_at forever.
     env["MX_NO_CAPTURE_FALLBACK"] = "1"
     try:
-        r = subprocess.run(argv, env=env, timeout=CHILD_TIMEOUT_S, cwd=REPO,
+        r = subprocess.run(argv, env=env, timeout=timeout, cwd=REPO,
                            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
     except subprocess.TimeoutExpired:
-        _log("%s: TIMEOUT after %ss" % (tag, CHILD_TIMEOUT_S))
+        _log("%s: TIMEOUT after %ss" % (tag, timeout))
         return None
     lines = [l for l in r.stdout.decode(errors="replace").splitlines()
              if l.startswith("{")]
@@ -105,6 +114,11 @@ def flash_block_sweep():
     results = {}
     for bq, bk in ((128, 128), (128, 256), (256, 256), (256, 512),
                    (512, 512)):
+        # the tunnel can wedge mid-sweep: re-probe before each config so a
+        # dead backend costs one 60s probe, not 5 serial config timeouts
+        if not _mx_base.probe_accelerator_once(60):
+            results["%dx%d" % (bq, bk)] = {"err": "tunnel wedged, skipped"}
+            break  # dead backend: stop probing, report what we have
         env = dict(os.environ)
         env.pop("JAX_PLATFORMS", None)
         env.pop("MX_FORCE_CPU", None)
@@ -113,7 +127,7 @@ def flash_block_sweep():
         try:
             r = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "--child-flash"],
-                env=env, timeout=600, cwd=REPO,
+                env=env, timeout=180, cwd=REPO,
                 stdout=subprocess.PIPE, stderr=subprocess.PIPE)
             lines = [l for l in r.stdout.decode(errors="replace")
                      .splitlines() if l.startswith("{")]
@@ -219,10 +233,10 @@ def _run_tpu_test_lane():
             "tests/test_gluon.py", "tests/test_transformer.py",
             "tests/test_torch_parity.py"]
     try:
-        r = subprocess.run(argv, env=env, timeout=CHILD_TIMEOUT_S, cwd=REPO,
+        r = subprocess.run(argv, env=env, timeout=PYTEST_TIMEOUT_S, cwd=REPO,
                            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
     except subprocess.TimeoutExpired:
-        _log("tpu_test_lane: TIMEOUT after %ss" % CHILD_TIMEOUT_S)
+        _log("tpu_test_lane: TIMEOUT after %ss" % PYTEST_TIMEOUT_S)
         return None
     tail = r.stdout.decode(errors="replace").strip().splitlines()
     # pytest's "N passed in Xs" line may be followed by TPU-runtime
@@ -239,21 +253,38 @@ def _run_tpu_test_lane():
     return {"rc": r.returncode, "summary": summary[:500]}
 
 
-# The capture suite: tag -> child argv (None = the pytest lane, which has
-# its own runner).  bench.py --real-data synthesizes its own .rec pack, so
-# no data drop is needed.  ONE table drives capture(), the missing-list,
-# the ok-counter, and the completion check.
+# The capture suite: tag -> (child argv, timeout).  None argv = the pytest
+# lane, which has its own runner.  bench.py --real-data synthesizes its own
+# .rec pack, so no data drop is needed.  ONE table drives capture(), the
+# missing-list, the ok-counter, and the completion check.
+#
+# ORDER = information-per-second, highest first (round-4 lesson: the one
+# healthy window died before the highest-value child even started):
+#   1. mosaic_smoke      — "does the Pallas flash kernel lower through
+#                          Mosaic at all?"  The single most valuable bit;
+#                          nothing else answers it.  ~2 compiles, <300s.
+#   2. flash_microbench  — kernel TFLOP/s, the headline Pallas number.
+#   3. resnet50_bench    — the BASELINE headline img/s.
+#   4. bert_bench / score_bench — the other BASELINE configs.
+#   5. flash_block_sweep — tuning, only meaningful after 1-2 land.
+#   6. real_data_bench / tpu_test_lane — breadth; the only long children.
 TAGS = (
-    ("resnet50_bench", [os.path.join(REPO, "bench.py")]),
-    ("bert_bench", [os.path.join(REPO, "bench.py"), "--bert"]),
-    ("score_bench", [os.path.join(REPO, "bench.py"), "--score"]),
-    ("flash_microbench", [os.path.abspath(__file__), "--child-flash"]),
-    ("mosaic_smoke", [os.path.abspath(__file__), "--child-mosaic"]),
-    ("flash_block_sweep", [os.path.abspath(__file__), "--child-sweep"]),
-    ("real_data_bench", [os.path.join(REPO, "bench.py"), "--real-data"]),
-    ("tpu_test_lane", None),
+    ("mosaic_smoke", [os.path.abspath(__file__), "--child-mosaic"],
+     CHILD_TIMEOUT_S),
+    ("flash_microbench", [os.path.abspath(__file__), "--child-flash"],
+     CHILD_TIMEOUT_S),
+    ("resnet50_bench", [os.path.join(REPO, "bench.py")], CHILD_TIMEOUT_S),
+    ("bert_bench", [os.path.join(REPO, "bench.py"), "--bert"],
+     CHILD_TIMEOUT_S),
+    ("score_bench", [os.path.join(REPO, "bench.py"), "--score"],
+     CHILD_TIMEOUT_S),
+    ("flash_block_sweep", [os.path.abspath(__file__), "--child-sweep"],
+     SWEEP_TIMEOUT_S),
+    ("real_data_bench", [os.path.join(REPO, "bench.py"), "--real-data"],
+     REAL_DATA_TIMEOUT_S),
+    ("tpu_test_lane", None, PYTEST_TIMEOUT_S),
 )
-TAG_NAMES = tuple(t for t, _ in TAGS)
+TAG_NAMES = tuple(t[0] for t in TAGS)
 MAX_ATTEMPTS = 3   # a deterministically-failing child must not hog the
                    # chip all round: give up after this many tries
 
@@ -280,23 +311,58 @@ def _ok(res):
     return dev is not None and dev != "cpu"
 
 
-def capture(prev=None, attempts=None):
+def _persist(results, probes):
+    """Write TPU_CAPTURE.json atomically.  Called the moment any child
+    lands (round-4 lesson: a wedge later in the pass must never cost
+    artifacts already captured)."""
+    import glob
+    payload = {"captured_at": _ts(), "probes": probes,
+               "round": _current_round(),
+               # secondary round identity: the driver writes BENCH_r{N}.json
+               # at each round's END, so any BENCH file appearing after this
+               # capture marks it stale when PROGRESS.jsonl is unavailable
+               "bench_files_at_capture": sorted(
+                   os.path.basename(p) for p in
+                   glob.glob(os.path.join(REPO, "BENCH_r*.json"))),
+               "results": results}
+    tmp = OUT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1)
+    os.replace(tmp, OUT)  # atomic: bench.py may read concurrently
+
+
+def capture(prev=None, attempts=None, probes=0, already_probed=False):
     """Run the capture suite; with `prev`, only re-run children whose
     earlier attempt failed (tunnel wedged mid-suite) and merge.
     `attempts` (tag -> count) is updated in place; tags over MAX_ATTEMPTS
-    are skipped for good."""
+    are skipped for good.
+
+    The tunnel is RE-PROBED before every child: a mid-suite wedge aborts
+    the pass immediately (cost: one 120s probe) instead of letting each
+    remaining child burn its timeout on a dead backend.  Every captured
+    child is persisted the moment it lands.  `already_probed` skips the
+    probe for the FIRST child only (the caller just saw a healthy probe)."""
     results = dict(prev or {})
     attempts = attempts if attempts is not None else {}
-    for tag, argv in TAGS:
+    for tag, argv, timeout in TAGS:
         if _ok(results.get(tag)):
             continue
         if attempts.get(tag, 0) >= MAX_ATTEMPTS:
             continue
+        if already_probed:
+            already_probed = False
+        elif not _probe():
+            _log("capture pass ABORTED before %s: tunnel wedged" % tag)
+            return results
         attempts[tag] = attempts.get(tag, 0) + 1
         if argv is None:
             results[tag] = _run_tpu_test_lane()
         else:
-            results[tag] = _run_json_child([sys.executable] + argv, tag)
+            results[tag] = _run_json_child([sys.executable] + argv, tag,
+                                           timeout)
+        if _ok(results[tag]):
+            _persist(results, probes)
+            _log("captured %s -> TPU_CAPTURE.json" % tag)
     return results
 
 
@@ -354,30 +420,13 @@ def main():
                 return
             _log("running capture suite (missing: %s)" % ",".join(todo))
             before_ok = sum(_ok(results.get(t)) for t in TAG_NAMES)
-            results = capture(results, attempts)
+            # capture() persists each child as it lands and aborts the pass
+            # if a pre-child re-probe finds the tunnel wedged
+            results = capture(results, attempts, n, already_probed=True)
             n_ok = sum(_ok(results.get(t)) for t in TAG_NAMES)
             if n_ok > before_ok:
-                # write on ANY improvement (a failed resnet bench must not
-                # discard other captured children), and ONLY on
-                # improvement — captured_at is never re-stamped onto
-                # unchanged results
-                import glob
-                payload = {"captured_at": _ts(), "probes": n,
-                           "round": _current_round(),
-                           # secondary round identity: the driver writes
-                           # BENCH_r{N}.json at each round's END, so any
-                           # BENCH file appearing after this capture marks
-                           # it stale when PROGRESS.jsonl is unavailable
-                           "bench_files_at_capture": sorted(
-                               os.path.basename(p) for p in
-                               glob.glob(os.path.join(REPO, "BENCH_r*.json"))),
-                           "results": results}
-                tmp = OUT + ".tmp"
-                with open(tmp, "w") as f:
-                    json.dump(payload, f, indent=1)
-                os.replace(tmp, OUT)  # atomic: bench.py may read concurrently
-                _log("capture -> TPU_CAPTURE.json (%d/%d children ok)"
-                     % (n_ok, len(TAG_NAMES)))
+                _log("window yielded %d new children (%d/%d total ok)"
+                     % (n_ok - before_ok, n_ok, len(TAG_NAMES)))
             else:
                 _log("no new children captured this window")
             if all(_ok(results.get(t)) for t in TAG_NAMES):
