@@ -460,7 +460,11 @@ def main():
     if cpu_pinned_by_user():
         candidates = ["cpu"]  # honor MX_FORCE_CPU=1 / JAX_PLATFORMS=cpu
     else:
-        healthy = probe_accelerator(PROBE_TIMEOUT_S)
+        # MX_ASSUME_LIVE=1: the caller (tools/tpu_capture.py) probed the
+        # tunnel immediately before spawning us — don't burn up to 150s of
+        # the child budget re-proving it
+        healthy = os.environ.get("MX_ASSUME_LIVE") == "1" \
+            or probe_accelerator(PROBE_TIMEOUT_S)
         if not healthy:
             captured = _captured_tpu_result(mode)
             if captured is not None:
