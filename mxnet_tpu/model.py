@@ -1,8 +1,11 @@
-"""mx.model — checkpoint helpers + BatchEndParam.
+"""mx.model — checkpoint helpers, BatchEndParam and the deprecated
+``FeedForward`` class.
 
 Reference: ``python/mxnet/model.py`` (save_checkpoint, load_checkpoint,
-BatchEndParam; the FeedForward class itself is superseded by Module and
-not rebuilt — SURVEY §1 L12).
+BatchEndParam, class FeedForward).  FeedForward here is the same thin
+deprecated veneer the reference ships: a Module wrapped in the v1.x
+numpy-in/numpy-out convenience API, kept so classic scripts run
+unmodified.
 
 Artifact layout matches the reference exactly:
   ``prefix-symbol.json``   — Symbol.tojson()
@@ -11,13 +14,18 @@ so checkpoints interchange with reference tooling.
 """
 from __future__ import annotations
 
+import warnings
 from collections import namedtuple
 from typing import Dict, Tuple
 
+import numpy as _np
+
+from . import initializer as init_mod
 from . import ndarray as nd
 from .ndarray.ndarray import NDArray
 
-__all__ = ["BatchEndParam", "save_checkpoint", "load_checkpoint", "load_params"]
+__all__ = ["BatchEndParam", "save_checkpoint", "load_checkpoint",
+           "load_params", "FeedForward"]
 
 BatchEndParam = namedtuple("BatchEndParams",
                            ["epoch", "nbatch", "eval_metric", "locals"])
@@ -55,3 +63,213 @@ def load_checkpoint(prefix: str, epoch: int):
     symbol = sym.load("%s-symbol.json" % prefix)
     arg_params, aux_params = load_params(prefix, epoch)
     return symbol, arg_params, aux_params
+
+
+class FeedForward:
+    """Deprecated v1.x estimator (reference: ``python/mxnet/model.py``
+    class FeedForward).  A thin veneer over :class:`mxnet_tpu.module.Module`
+    accepting numpy arrays / NDArrays / DataIters, kept for script
+    compatibility; new code should use Module or Gluon."""
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=init_mod.Uniform(0.01),
+                 numpy_batch_size=128, arg_params=None, aux_params=None,
+                 allow_extra_params=False, begin_epoch=0, **kwargs):
+        warnings.warn(
+            "\033[91mmxnet_tpu.model.FeedForward has been deprecated. "
+            "Please use mxnet_tpu.mod.Module instead.\033[0m",
+            DeprecationWarning, stacklevel=2)
+        from .device import cpu as _cpu
+        self.symbol = symbol
+        if ctx is None:
+            ctx = [_cpu()]
+        elif not isinstance(ctx, (list, tuple)):
+            ctx = [ctx]
+        self.ctx = ctx
+        self.num_epoch = num_epoch
+        self.epoch_size = epoch_size
+        self.optimizer = optimizer
+        self.initializer = initializer
+        self.numpy_batch_size = numpy_batch_size
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.allow_extra_params = allow_extra_params
+        self.begin_epoch = begin_epoch
+        # reference: leftover kwargs are optimizer hyper-parameters
+        self.kwargs = dict(kwargs)
+        self._module = None
+
+    # -- data plumbing (reference: model._init_data) ------------------------
+    def _label_names(self):
+        return [a for a in self.symbol.list_arguments()
+                if a.endswith("_label")] or ["softmax_label"]
+
+    def _as_iter(self, X, y=None, is_train=False):
+        from .io import DataIter, NDArrayIter
+        if isinstance(X, DataIter):
+            return X
+        if isinstance(X, NDArray):
+            X = X.asnumpy()
+        if y is not None and isinstance(y, NDArray):
+            y = y.asnumpy()
+        X = _np.asarray(X)
+        if y is not None:
+            y = _np.asarray(y)
+        batch = min(self.numpy_batch_size, X.shape[0])
+        label_name = self._label_names()[0]
+        return NDArrayIter(X, y, batch_size=batch, shuffle=is_train,
+                           label_name=label_name)
+
+    def _create_module(self, it, for_training, logger=None):
+        import logging as _logging
+        from .module import Module
+        label_names = tuple(self._label_names()) \
+            if it.provide_label else ()
+        data_names = tuple(d[0] if isinstance(d, (tuple, list)) else d.name
+                           for d in it.provide_data)
+        # the full ctx list goes through so Module can emit its
+        # multi-device guidance (parallel.TrainStep) instead of a
+        # silent device drop
+        mod = Module(self.symbol, data_names=data_names,
+                     label_names=label_names,
+                     context=self.ctx if len(self.ctx) > 1 else self.ctx[0],
+                     logger=logger or _logging)
+        mod.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label if label_names else None,
+                 for_training=for_training)
+        mod.init_params(initializer=self.initializer,
+                        arg_params=self.arg_params,
+                        aux_params=self.aux_params,
+                        allow_missing=self.arg_params is not None,
+                        allow_extra=self.allow_extra_params)
+        return mod
+
+    # -- training -----------------------------------------------------------
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", logger=None, work_load_list=None,
+            monitor=None, eval_end_callback=None,
+            eval_batch_end_callback=None):
+        """Reference: FeedForward.fit — train on X/y (arrays or DataIter)."""
+        data = self._as_iter(X, y, is_train=True)
+        if self.epoch_size is not None:
+            # reference: epoch_size bounds batches/epoch (the epoch
+            # boundary for unbounded/streaming iterators)
+            from .io import ResizeIter
+            data = ResizeIter(data, self.epoch_size)
+        if eval_data is not None and not hasattr(eval_data, "provide_data"):
+            # (X, y) tuple form
+            eval_data = self._as_iter(eval_data[0], eval_data[1])
+        # _create_module binds AND initializes (initializer/arg_params/
+        # allow_extra handled there) — Module.fit's own bind/init_params
+        # early-return on the already-prepared module, so the init args
+        # are deliberately not re-passed
+        self._module = self._create_module(data, for_training=True,
+                                           logger=logger)
+        opt_params = dict(self.kwargs)
+        self._module.fit(
+            data, eval_data=eval_data, eval_metric=eval_metric,
+            epoch_end_callback=epoch_end_callback,
+            batch_end_callback=batch_end_callback, kvstore=kvstore,
+            optimizer=self.optimizer, optimizer_params=opt_params,
+            eval_end_callback=eval_end_callback,
+            eval_batch_end_callback=eval_batch_end_callback,
+            begin_epoch=self.begin_epoch, num_epoch=self.num_epoch,
+            monitor=monitor)
+        self.arg_params, self.aux_params = self._module.get_params()
+        return self
+
+    # -- inference ----------------------------------------------------------
+    def _inference_module(self, it):
+        if self._module is None:
+            assert self.arg_params is not None, \
+                "model has not been trained or loaded"
+            self._module = self._create_module(it, for_training=False)
+        return self._module
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        """Reference: FeedForward.predict — numpy out (list when the net
+        has multiple outputs); with return_data, also (data, label)."""
+        it = self._as_iter(X)
+        mod = self._inference_module(it)
+        if not return_data:
+            # the batch loop / pad trimming / concatenation live in ONE
+            # place: BaseModule.predict
+            preds = mod.predict(it, num_batch=num_batch, reset=reset)
+            if isinstance(preds, list):
+                return [p.asnumpy() for p in preds]
+            return preds.asnumpy()
+        if reset:
+            it.reset()
+        outs, datas, labels = None, [], []
+        for i, batch in enumerate(it):
+            if num_batch is not None and i >= num_batch:
+                break
+            mod.forward(batch, is_train=False)
+            pad = getattr(batch, "pad", 0) or 0
+            keep = batch.data[0].shape[0] - pad
+            got = [o.asnumpy()[:keep] for o in mod.get_outputs()]
+            if outs is None:
+                outs = [[] for _ in got]
+            for acc, o in zip(outs, got):
+                acc.append(o)
+            datas.append(batch.data[0].asnumpy()[:keep])
+            labels.append(batch.label[0].asnumpy()[:keep]
+                          if batch.label else None)
+        preds = [_np.concatenate(o, axis=0) for o in (outs or [])]
+        result = preds[0] if len(preds) == 1 else preds
+        data_np = _np.concatenate(datas, axis=0)
+        label_np = (None if not labels or labels[0] is None
+                    else _np.concatenate(labels, axis=0))
+        return result, data_np, label_np
+
+    def score(self, X, y=None, eval_metric="acc", num_batch=None,
+              batch_end_callback=None, reset=True):
+        """Reference: FeedForward.score → the metric's scalar value.
+        Accepts a label-carrying DataIter, or numpy/NDArray X with y."""
+        from . import metric as metric_mod
+        it = self._as_iter(X, y)
+        assert it.provide_label, \
+            "score needs labels: pass y, or a DataIter that provides them"
+        mod = self._inference_module(it)
+        if not isinstance(eval_metric, metric_mod.EvalMetric):
+            eval_metric = metric_mod.create(eval_metric)
+        res = mod.score(it, eval_metric, num_batch=num_batch, reset=reset,
+                        batch_end_callback=batch_end_callback)
+        return dict(res)[eval_metric.name] if res else float("nan")
+
+    # -- persistence (reference artifact layout) ----------------------------
+    def save(self, prefix, epoch=None, remove_amp_cast=True):
+        epoch = self.num_epoch if epoch is None else epoch
+        assert epoch is not None, "epoch unknown: pass save(prefix, epoch)"
+        save_checkpoint(prefix, epoch, self.symbol,
+                        self.arg_params or {}, self.aux_params or {},
+                        remove_amp_cast=remove_amp_cast)
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        """Reference: FeedForward.load — rebuild from a checkpoint."""
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch,
+                           **kwargs)
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None,
+               epoch_size=None, optimizer="sgd",
+               initializer=init_mod.Uniform(0.01), eval_data=None,
+               eval_metric="acc", epoch_end_callback=None,
+               batch_end_callback=None, kvstore="local", logger=None,
+               work_load_list=None, eval_end_callback=None,
+               eval_batch_end_callback=None, **kwargs):
+        """Reference: FeedForward.create — construct + fit in one call."""
+        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch,
+                            epoch_size=epoch_size, optimizer=optimizer,
+                            initializer=initializer, **kwargs)
+        model.fit(X, y, eval_data=eval_data, eval_metric=eval_metric,
+                  epoch_end_callback=epoch_end_callback,
+                  batch_end_callback=batch_end_callback, kvstore=kvstore,
+                  logger=logger, work_load_list=work_load_list,
+                  eval_end_callback=eval_end_callback,
+                  eval_batch_end_callback=eval_batch_end_callback)
+        return model

@@ -243,7 +243,8 @@ class BaseModule:
             epoch_end_callback=None, batch_end_callback=None,
             kvstore="local", optimizer="sgd",
             optimizer_params=(("learning_rate", 0.01),),
-            eval_end_callback=None, initializer=None,
+            eval_end_callback=None, eval_batch_end_callback=None,
+            initializer=None,
             arg_params=None, aux_params=None, allow_missing=False,
             force_init=False, begin_epoch=0, num_epoch=None,
             validation_metric=None, monitor=None):
@@ -263,6 +264,8 @@ class BaseModule:
             validation_metric = eval_metric
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
+        if not isinstance(validation_metric, metric_mod.EvalMetric):
+            validation_metric = metric_mod.create(validation_metric)
 
         for epoch in range(begin_epoch, num_epoch):
             eval_metric.reset()
@@ -286,8 +289,14 @@ class BaseModule:
                 for cb in _as_list(epoch_end_callback):
                     cb(epoch, self.symbol, arg, aux)
             if eval_data is not None:
+                # reference contract: eval_BATCH_end fires per eval batch,
+                # eval_end fires ONCE per evaluation with final metrics
                 res = self.score(eval_data, validation_metric, epoch=epoch,
-                                 batch_end_callback=eval_end_callback)
+                                 batch_end_callback=eval_batch_end_callback)
+                if eval_end_callback is not None:
+                    for cb in _as_list(eval_end_callback):
+                        cb(BatchEndParam(epoch, 0, validation_metric,
+                                         locals()))
                 for name, val in res:
                     self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
                                      name, val)

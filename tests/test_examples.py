@@ -135,3 +135,9 @@ def test_quantize_model_example():
     assert n_q == 4, out
     drop = float(lines[-1].split(":")[1])
     assert abs(drop) < 0.1, out
+
+
+def test_feedforward_mnist_example():
+    out = _run("train_mnist_feedforward.py", "--epochs", "4")
+    assert "final test accuracy" in out
+    assert "checkpoint roundtrip OK" in out

@@ -1,0 +1,106 @@
+"""mx.model.FeedForward — the deprecated v1.x estimator veneer
+(reference: python/mxnet/model.py class FeedForward; test pattern:
+tests/python/unittest/test_model* and the classic MNIST mlp script)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data=data, num_hidden=32, name="fc1")
+    h = mx.sym.Activation(data=h, act_type="relu", name="relu1")
+    h = mx.sym.FullyConnected(data=h, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(data=h, name="softmax")
+
+
+def _toy(n=256, d=16, k=4, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    W = rng.randn(d, k).astype(np.float32)
+    Y = (X @ W).argmax(1).astype(np.float32)
+    return X, Y
+
+
+def test_feedforward_classic_script_runs_unmodified():
+    """The exact v1.x idiom: construct with optimizer kwargs, fit on numpy
+    arrays, predict returns numpy, score returns a scalar."""
+    X, Y = _toy()
+    with pytest.warns(DeprecationWarning):
+        model = mx.model.FeedForward(
+            symbol=_mlp(), num_epoch=8, learning_rate=0.2, momentum=0.9,
+            numpy_batch_size=64)
+    model.fit(X=X, y=Y)
+    preds = model.predict(X)
+    assert isinstance(preds, np.ndarray) and preds.shape == (256, 4)
+    # both classic idioms: score(X, y) on arrays and score(val_iter)
+    acc = model.score(X, Y, eval_metric="acc")
+    assert acc > 0.9, acc
+    val = mx.io.NDArrayIter(X, Y, batch_size=64,
+                            label_name="softmax_label")
+    assert abs(model.score(val) - acc) < 1e-6
+    assert float((preds.argmax(1) == Y).mean()) > 0.9
+
+
+def test_feedforward_eval_data_and_dataiter_input():
+    X, Y = _toy()
+    it = mx.io.NDArrayIter(X, Y, batch_size=64, shuffle=True,
+                           label_name="softmax_label")
+    val = mx.io.NDArrayIter(X, Y, batch_size=64,
+                            label_name="softmax_label")
+    model = mx.model.FeedForward(symbol=_mlp(), num_epoch=10,
+                                 learning_rate=0.2, momentum=0.9)
+    model.fit(X=it, eval_data=val, eval_metric="acc")
+    assert model.score(val) > 0.85
+
+
+def test_feedforward_save_load_roundtrip(tmp_path):
+    X, Y = _toy()
+    model = mx.model.FeedForward(symbol=_mlp(), num_epoch=5,
+                                 learning_rate=0.2)
+    model.fit(X=X, y=Y)
+    prefix = str(tmp_path / "ff")
+    model.save(prefix)                      # -> ff-symbol.json, ff-0005.params
+    loaded = mx.model.FeedForward.load(prefix, 5)
+    np.testing.assert_allclose(loaded.predict(X), model.predict(X),
+                               rtol=1e-5, atol=1e-6)
+    # and the artifact interchanges with the Module checkpoint reader
+    sym2, args2, aux2 = mx.model.load_checkpoint(prefix, 5)
+    assert "fc1_weight" in args2
+
+
+def test_feedforward_create_and_predict_with_return_data():
+    X, Y = _toy()
+    model = mx.model.FeedForward.create(
+        symbol=_mlp(), X=X, y=Y, num_epoch=5, learning_rate=0.2)
+    preds, data_np, label_np = model.predict(X, return_data=True)
+    assert data_np.shape == X.shape
+    assert preds.shape[0] == X.shape[0]
+
+
+def test_feedforward_predict_before_fit_requires_params():
+    model = mx.model.FeedForward(symbol=_mlp())
+    with pytest.raises(AssertionError):
+        model.predict(np.zeros((4, 16), np.float32))
+
+
+def test_feedforward_epoch_size_and_eval_callbacks():
+    """epoch_size bounds batches/epoch (streaming-iter contract) and
+    eval_end fires ONCE per evaluation while eval_batch_end fires per
+    eval batch (reference BaseModule.fit contract)."""
+    X, Y = _toy(n=256)
+    seen_batches, eval_ends, eval_batches = [], [], []
+    model = mx.model.FeedForward(symbol=_mlp(), num_epoch=3,
+                                 learning_rate=0.1, epoch_size=2,
+                                 numpy_batch_size=32)
+    model.fit(
+        X=X, y=Y, eval_data=(X[:64], Y[:64]),
+        batch_end_callback=lambda p: seen_batches.append(p.nbatch),
+        eval_end_callback=lambda p: eval_ends.append(p.epoch),
+        eval_batch_end_callback=lambda p: eval_batches.append(p.nbatch))
+    # 3 epochs x epoch_size=2 batches
+    assert len(seen_batches) == 6, seen_batches
+    assert eval_ends == [0, 1, 2], eval_ends
+    # eval set: 64 rows / 32 batch = 2 eval batches per epoch
+    assert len(eval_batches) == 6, eval_batches
