@@ -16,6 +16,7 @@ from . import symbol
 from . import symbol as sym
 from . import quantization
 from . import summary
+from . import text
 from . import summary as tensorboard   # the mxboard-role module
 from .. import onnx                    # 1.x location: mx.contrib.onnx
 

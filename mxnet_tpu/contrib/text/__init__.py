@@ -1,0 +1,10 @@
+"""mx.contrib.text — vocabularies and pretrained token embeddings
+(reference: python/mxnet/contrib/text/__init__.py)."""
+from . import utils
+from . import vocab
+from . import embedding
+from .vocab import Vocabulary
+from .embedding import (TokenEmbedding, GloVe, FastText, CustomEmbedding,
+                        CompositeEmbedding)
+
+__all__ = ["utils", "vocab", "embedding", "Vocabulary"]

@@ -1,0 +1,347 @@
+"""Pretrained token embeddings (reference:
+python/mxnet/contrib/text/embedding.py — GloVe/FastText/CustomEmbedding,
+registry, CompositeEmbedding).
+
+Offline posture: this environment has no network, so the reference's
+download path is replaced by a local `embedding_root` drop directory —
+``<embedding_root>/<embedding_name>/<pretrained_file_name>``.  Drop the
+(publicly distributed) GloVe/FastText text files there and the loaders
+activate without code changes; absent files raise a clear error instead
+of attempting a download.  File FORMATS are parsed exactly as the
+reference does (whitespace-delimited text; FastText .vec's first line is
+a "count dim" header and is skipped).
+"""
+import io
+import logging
+import os
+
+from ... import ndarray as nd
+from .vocab import Vocabulary
+
+__all__ = ["register", "create", "get_pretrained_file_names",
+           "TokenEmbedding", "GloVe", "FastText", "CustomEmbedding",
+           "CompositeEmbedding"]
+
+_REGISTRY = {}
+
+
+def register(embedding_cls):
+    """Reference: embedding.register — decorator adding a TokenEmbedding
+    subclass to the create()/get_pretrained_file_names() registry."""
+    name = embedding_cls.__name__.lower()
+    _REGISTRY[name] = embedding_cls
+    return embedding_cls
+
+
+def create(embedding_name, **kwargs):
+    """Reference: embedding.create('glove', pretrained_file_name=...)."""
+    name = embedding_name.lower()
+    if name not in _REGISTRY:
+        raise KeyError(
+            "Cannot find `embedding_name` %r. Valid: %s"
+            % (embedding_name, ", ".join(sorted(_REGISTRY))))
+    return _REGISTRY[name](**kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Reference: embedding.get_pretrained_file_names — the catalog of
+    publicly distributed files per registered embedding (or a dict of
+    all of them)."""
+    if embedding_name is not None:
+        name = embedding_name.lower()
+        if name not in _REGISTRY:
+            raise KeyError(
+                "Cannot find `embedding_name` %r. Valid: %s"
+                % (embedding_name, ", ".join(sorted(_REGISTRY))))
+        return list(_REGISTRY[name].pretrained_file_names)
+    return {n: list(c.pretrained_file_names)
+            for n, c in _REGISTRY.items()}
+
+
+class TokenEmbedding(Vocabulary):
+    """Reference: embedding._TokenEmbedding — a Vocabulary whose indices
+    additionally map to embedding vectors (`idx_to_vec`)."""
+
+    pretrained_file_names = ()
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._vec_len = 0
+        self._idx_to_vec = None
+
+    # -- offline file resolution -------------------------------------------
+    @classmethod
+    def _default_root(cls):
+        return os.path.join(os.path.expanduser("~"), ".mxnet",
+                            "embeddings")
+
+    @classmethod
+    def _resolve_pretrained_path(cls, embedding_root, pretrained_file_name):
+        cls._check_pretrained_file_names(pretrained_file_name)
+        path = os.path.join(os.path.expanduser(embedding_root),
+                            cls.__name__.lower(), pretrained_file_name)
+        if not os.path.isfile(path):
+            raise OSError(
+                "%s not found. This environment is offline: download %r "
+                "elsewhere and drop it at exactly this path to activate "
+                "the loader." % (path, pretrained_file_name))
+        return path
+
+    @classmethod
+    def _check_pretrained_file_names(cls, pretrained_file_name):
+        if pretrained_file_name not in cls.pretrained_file_names:
+            raise KeyError(
+                "Cannot find pretrained file %r for %s. Valid: %s"
+                % (pretrained_file_name, cls.__name__,
+                   ", ".join(cls.pretrained_file_names)))
+
+    # -- loading ------------------------------------------------------------
+    def _load_embedding(self, pretrained_file_path, elem_delim,
+                        init_unknown_vec, encoding="utf8"):
+        """Parse a whitespace-delimited embedding text file exactly as the
+        reference does: tolerate a FastText header line, warn-and-skip
+        malformed lines, first occurrence of a token wins."""
+        pretrained_file_path = os.path.expanduser(pretrained_file_path)
+        if not os.path.isfile(pretrained_file_path):
+            raise OSError("`pretrained_file_path` %r must be a valid path "
+                          "to the pre-trained token embedding file."
+                          % pretrained_file_path)
+        all_elems = []
+        tokens = set()
+        loaded_unknown_vec = None
+        with io.open(pretrained_file_path, "r", encoding=encoding) as f:
+            for line_num, line in enumerate(f):
+                elems = line.rstrip().split(elem_delim)
+                assert len(elems) > 1, \
+                    "line %d in %r: unexpected data format" \
+                    % (line_num, pretrained_file_path)
+                token, elems = elems[0], elems[1:]
+                if token == self.unknown_token \
+                        and loaded_unknown_vec is None:
+                    loaded_unknown_vec = [float(i) for i in elems]
+                elif token in tokens:
+                    logging.warning(
+                        "line %d in %r: duplicate token %r, skipped",
+                        line_num, pretrained_file_path, token)
+                elif len(elems) == 1 and line_num == 0:
+                    # FastText .vec "count dim" header
+                    logging.info("skipped header line of %r",
+                                 pretrained_file_path)
+                else:
+                    try:
+                        vec = [float(i) for i in elems]
+                    except ValueError:
+                        logging.warning(
+                            "line %d in %r: unparsable vector for %r, "
+                            "skipped", line_num, pretrained_file_path,
+                            token)
+                        continue
+                    if self._vec_len and len(vec) != self._vec_len:
+                        logging.warning(
+                            "line %d in %r: dim %d != %d, skipped",
+                            line_num, pretrained_file_path, len(vec),
+                            self._vec_len)
+                        continue
+                    if not self._vec_len:
+                        self._vec_len = len(vec)
+                    self._idx_to_token.append(token)
+                    self._token_to_idx[token] = \
+                        len(self._idx_to_token) - 1
+                    tokens.add(token)
+                    all_elems.extend(vec)
+        import numpy as _np
+        mat = _np.zeros((len(self), self._vec_len), dtype="float32")
+        if all_elems:
+            mat[len(self) - len(tokens):] = _np.asarray(
+                all_elems, dtype="float32").reshape(len(tokens),
+                                                    self._vec_len)
+        self._idx_to_vec = nd.array(mat)
+        if loaded_unknown_vec is None:
+            self._idx_to_vec[0] = init_unknown_vec(shape=self._vec_len)
+        else:
+            self._idx_to_vec[0] = nd.array(loaded_unknown_vec)
+
+    def _index_tokens_from_vocabulary(self, vocabulary):
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._unknown_token = vocabulary.unknown_token
+        self._reserved_tokens = vocabulary.reserved_tokens
+
+    def _set_idx_to_vec_by_embeddings(self, token_embeddings, vocab_len,
+                                      vocab_idx_to_token):
+        """Reference: compose idx_to_vec for an explicit vocabulary from
+        one or more already-loaded embeddings (concatenated)."""
+        new_vec_len = sum(e.vec_len for e in token_embeddings)
+        import numpy as _np
+        mat = _np.zeros((vocab_len, new_vec_len), dtype="float32")
+        col = 0
+        for e in token_embeddings:
+            col_end = col + e.vec_len
+            mat[:, col:col_end] = e.get_vecs_by_tokens(
+                vocab_idx_to_token).asnumpy()
+            col = col_end
+        self._vec_len = new_vec_len
+        self._idx_to_vec = nd.array(mat)
+
+    # -- queries ------------------------------------------------------------
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        """Token(s) → vector(s); unknown tokens get idx 0's vector.  With
+        lower_case_backup, miss falls back to the lower-cased token."""
+        to_reduce = False
+        if not isinstance(tokens, list):
+            tokens = [tokens]
+            to_reduce = True
+        if not lower_case_backup:
+            indices = [self.token_to_idx.get(t, 0) for t in tokens]
+        else:
+            indices = [self.token_to_idx.get(
+                t, self.token_to_idx.get(t.lower(), 0)) for t in tokens]
+        import numpy as _np
+        vecs = self._idx_to_vec.asnumpy()[_np.asarray(indices)]
+        out = nd.array(vecs)
+        return out[0] if to_reduce else out
+
+    def update_token_vectors(self, tokens, new_vectors):
+        """Overwrite vectors of existing tokens (reference semantics:
+        unknown tokens are an error)."""
+        assert self._idx_to_vec is not None, \
+            "The property `idx_to_vec` has not been properly set."
+        if not isinstance(tokens, list) or len(tokens) == 1:
+            assert isinstance(new_vectors, nd.NDArray) and \
+                len(new_vectors.shape) in (1, 2), \
+                "`new_vectors` must be a 1-D or 2-D NDArray if `tokens` " \
+                "is a single token."
+            if not isinstance(tokens, list):
+                tokens = [tokens]
+            if len(new_vectors.shape) == 1:
+                new_vectors = new_vectors.expand_dims(0)
+        else:
+            assert isinstance(new_vectors, nd.NDArray) and \
+                len(new_vectors.shape) == 2, \
+                "`new_vectors` must be a 2-D NDArray if `tokens` is a " \
+                "list of multiple strings."
+        assert new_vectors.shape == (len(tokens), self.vec_len), \
+            "The length of `new_vectors` must be equal to the number of " \
+            "`tokens` and the width of `new_vectors` must be equal to " \
+            "the dimension of embeddings"
+        indices = []
+        for token in tokens:
+            if token in self.token_to_idx:
+                indices.append(self.token_to_idx[token])
+            else:
+                raise ValueError(
+                    "Token %r is unknown. To update the embedding vector "
+                    "for an unknown token, please specify it explicitly "
+                    "as the `unknown_token` %r in `tokens`."
+                    % (token, self.unknown_token))
+        vecs = self._idx_to_vec.asnumpy().copy()
+        vecs[indices] = new_vectors.asnumpy()
+        self._idx_to_vec = nd.array(vecs)
+
+    # keep the reference's underscore alias working
+    @staticmethod
+    def _get_pretrained_file_names(embedding_name=None):
+        return get_pretrained_file_names(embedding_name)
+
+
+@register
+class GloVe(TokenEmbedding):
+    """Reference: embedding.GloVe — Common Crawl / Wikipedia GloVe text
+    files (`glove.<corpus>.<dim>d.txt`)."""
+
+    pretrained_file_names = (
+        "glove.42B.300d.txt", "glove.6B.50d.txt", "glove.6B.100d.txt",
+        "glove.6B.200d.txt", "glove.6B.300d.txt", "glove.840B.300d.txt",
+        "glove.twitter.27B.25d.txt", "glove.twitter.27B.50d.txt",
+        "glove.twitter.27B.100d.txt", "glove.twitter.27B.200d.txt")
+
+    def __init__(self, pretrained_file_name="glove.840B.300d.txt",
+                 embedding_root=None, init_unknown_vec=nd.zeros,
+                 vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        root = embedding_root or self._default_root()
+        path = self._resolve_pretrained_path(root, pretrained_file_name)
+        if vocabulary is not None:
+            self._index_tokens_from_vocabulary(vocabulary)
+            whole = type(self).__new__(type(self))
+            TokenEmbedding.__init__(whole)
+            whole._load_embedding(path, " ", init_unknown_vec)
+            self._set_idx_to_vec_by_embeddings(
+                [whole], len(self), self.idx_to_token)
+        else:
+            self._load_embedding(path, " ", init_unknown_vec)
+
+
+@register
+class FastText(TokenEmbedding):
+    """Reference: embedding.FastText — `wiki.<lang>.vec` files (first
+    line is a "count dim" header)."""
+
+    pretrained_file_names = (
+        "wiki.en.vec", "wiki.simple.vec", "wiki.zh.vec", "wiki.de.vec",
+        "wiki.fr.vec", "wiki.es.vec", "wiki.ja.vec", "wiki.ru.vec",
+        "crawl-300d-2M.vec")
+
+    def __init__(self, pretrained_file_name="wiki.simple.vec",
+                 embedding_root=None, init_unknown_vec=nd.zeros,
+                 vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        root = embedding_root or self._default_root()
+        path = self._resolve_pretrained_path(root, pretrained_file_name)
+        if vocabulary is not None:
+            self._index_tokens_from_vocabulary(vocabulary)
+            whole = type(self).__new__(type(self))
+            TokenEmbedding.__init__(whole)
+            whole._load_embedding(path, " ", init_unknown_vec)
+            self._set_idx_to_vec_by_embeddings(
+                [whole], len(self), self.idx_to_token)
+        else:
+            self._load_embedding(path, " ", init_unknown_vec)
+
+
+@register
+class CustomEmbedding(TokenEmbedding):
+    """Reference: embedding.CustomEmbedding — user-supplied embedding
+    file: ``token<elem_delim>v1<elem_delim>v2...`` per line."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ",
+                 encoding="utf8", init_unknown_vec=nd.zeros,
+                 vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        if vocabulary is not None:
+            self._index_tokens_from_vocabulary(vocabulary)
+            whole = TokenEmbedding()
+            whole._load_embedding(pretrained_file_path, elem_delim,
+                                  init_unknown_vec, encoding)
+            self._set_idx_to_vec_by_embeddings(
+                [whole], len(self), self.idx_to_token)
+        else:
+            self._load_embedding(pretrained_file_path, elem_delim,
+                                 init_unknown_vec, encoding)
+
+
+class CompositeEmbedding(TokenEmbedding):
+    """Reference: embedding.CompositeEmbedding — index a vocabulary with
+    the CONCATENATION of multiple token embeddings' vectors."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        if not isinstance(token_embeddings, list):
+            token_embeddings = [token_embeddings]
+        for e in token_embeddings:
+            assert isinstance(e, TokenEmbedding), \
+                "The parameter `token_embeddings` must be an instance or " \
+                "a list of instances of `TokenEmbedding`"
+        self._vocab = vocabulary
+        self._index_tokens_from_vocabulary(vocabulary)
+        self._vec_len = 0
+        self._idx_to_vec = None
+        self._set_idx_to_vec_by_embeddings(
+            token_embeddings, len(self), self.idx_to_token)

@@ -1,0 +1,21 @@
+"""Text token-counting utilities (reference:
+python/mxnet/contrib/text/utils.py)."""
+import re
+from collections import Counter
+
+__all__ = ["count_tokens_from_str"]
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """Reference: utils.count_tokens_from_str — split `source_str` on the
+    token/sequence delimiters and tally tokens into a Counter (optionally
+    updating an existing one in place)."""
+    source_str = filter(None,
+                        re.split(token_delim + "|" + seq_delim, source_str))
+    if to_lower:
+        source_str = [t.lower() for t in source_str]
+    if counter_to_update is None:
+        return Counter(source_str)
+    counter_to_update.update(source_str)
+    return counter_to_update

@@ -1,0 +1,98 @@
+"""Text vocabulary (reference: python/mxnet/contrib/text/vocab.py).
+
+Indexing convention matches the reference exactly: index 0 is the
+unknown token, reserved tokens follow, then corpus tokens sorted by
+descending frequency (ties broken alphabetically for determinism).
+"""
+from collections import Counter
+
+__all__ = ["Vocabulary"]
+
+
+class Vocabulary:
+    """Reference: vocab.Vocabulary — token/index mappings built from a
+    ``collections.Counter``."""
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        assert min_freq > 0, "`min_freq` must be set to a positive value"
+        if reserved_tokens is not None:
+            reserved_set = set(reserved_tokens)
+            assert unknown_token not in reserved_set, \
+                "`reserved_tokens` must not contain the `unknown_token`"
+            assert len(reserved_set) == len(reserved_tokens), \
+                "`reserved_tokens` must not contain duplicates"
+        self._unknown_token = unknown_token
+        self._reserved_tokens = (list(reserved_tokens)
+                                 if reserved_tokens is not None else None)
+        self._index_unknown_and_reserved_tokens()
+        if counter is not None:
+            self._index_counter_keys(counter, most_freq_count, min_freq)
+
+    def _index_unknown_and_reserved_tokens(self):
+        self._idx_to_token = [self._unknown_token]
+        if self._reserved_tokens is not None:
+            self._idx_to_token.extend(self._reserved_tokens)
+        self._token_to_idx = {t: i for i, t in
+                              enumerate(self._idx_to_token)}
+
+    def _index_counter_keys(self, counter, most_freq_count, min_freq):
+        assert isinstance(counter, Counter), \
+            "`counter` must be an instance of collections.Counter"
+        unknown_and_reserved = set(self._idx_to_token)
+        # descending frequency, alphabetical within a frequency class
+        token_freqs = sorted(counter.items(), key=lambda x: x[0])
+        token_freqs.sort(key=lambda x: x[1], reverse=True)
+        token_cap = len(unknown_and_reserved) + (
+            len(counter) if most_freq_count is None else most_freq_count)
+        for token, freq in token_freqs:
+            if freq < min_freq or len(self._idx_to_token) == token_cap:
+                break
+            if token not in unknown_and_reserved:
+                self._idx_to_token.append(token)
+                self._token_to_idx[token] = len(self._idx_to_token) - 1
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """Token (or list of tokens) → index (or list of indices);
+        unknown tokens map to index 0 (reference semantics)."""
+        to_reduce = False
+        if not isinstance(tokens, list):
+            tokens = [tokens]
+            to_reduce = True
+        indices = [self._token_to_idx.get(t, 0) for t in tokens]
+        return indices[0] if to_reduce else indices
+
+    def to_tokens(self, indices):
+        """Index (or list of indices) → token (or list of tokens)."""
+        to_reduce = False
+        if not isinstance(indices, list):
+            indices = [indices]
+            to_reduce = True
+        max_idx = len(self._idx_to_token) - 1
+        tokens = []
+        for idx in indices:
+            if not isinstance(idx, int) or idx > max_idx:
+                raise ValueError(
+                    "Token index %s in the provided `indices` is invalid."
+                    % idx)
+            tokens.append(self._idx_to_token[idx])
+        return tokens[0] if to_reduce else tokens

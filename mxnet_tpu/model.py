@@ -117,8 +117,12 @@ class FeedForward:
             y = _np.asarray(y)
         batch = min(self.numpy_batch_size, X.shape[0])
         label_name = self._label_names()[0]
+        # reference _init_data trains with roll_over (padded head samples
+        # must not get a second gradient/metric contribution per epoch)
         return NDArrayIter(X, y, batch_size=batch, shuffle=is_train,
-                           label_name=label_name)
+                           label_name=label_name,
+                           last_batch_handle="roll_over" if is_train
+                           else "pad")
 
     def _create_module(self, it, for_training, logger=None):
         import logging as _logging
@@ -234,9 +238,11 @@ class FeedForward:
         mod = self._inference_module(it)
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
-        res = mod.score(it, eval_metric, num_batch=num_batch, reset=reset,
-                        batch_end_callback=batch_end_callback)
-        return dict(res)[eval_metric.name] if res else float("nan")
+        mod.score(it, eval_metric, num_batch=num_batch, reset=reset,
+                  batch_end_callback=batch_end_callback)
+        # reference returns eval_metric.get()[1]: a scalar for a simple
+        # metric, the list of values for a composite
+        return eval_metric.get()[1]
 
     # -- persistence (reference artifact layout) ----------------------------
     def save(self, prefix, epoch=None, remove_amp_cast=True):

@@ -1,0 +1,211 @@
+"""mx.contrib.text (reference pattern:
+tests/python/unittest/test_contrib_text.py — counters, vocabulary
+indexing invariants, embedding loading from token files, composite
+embeddings, registry/catalog)."""
+import os
+from collections import Counter
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.contrib import text
+
+
+def _counter():
+    return text.utils.count_tokens_from_str(
+        "life is great ! \n life is good . \n")
+
+
+def test_count_tokens_from_str():
+    c = _counter()
+    assert c == Counter({"life": 2, "is": 2, "great": 1, "!": 1,
+                         "good": 1, ".": 1})
+    c2 = text.utils.count_tokens_from_str(
+        "Life is GREAT\nlife is good", to_lower=True)
+    assert c2["life"] == 2 and c2["great"] == 1
+    # in-place update of an existing counter
+    base = Counter({"life": 10})
+    out = text.utils.count_tokens_from_str("life is",
+                                           counter_to_update=base)
+    assert out is base and base["life"] == 11 and base["is"] == 1
+
+
+def test_vocabulary_indexing_invariants():
+    v = text.vocab.Vocabulary(_counter(), most_freq_count=None,
+                              min_freq=1, unknown_token="<unk>",
+                              reserved_tokens=["<pad>"])
+    # index 0 unk, then reserved, then by descending freq (alpha ties)
+    assert v.idx_to_token[0] == "<unk>"
+    assert v.idx_to_token[1] == "<pad>"
+    assert set(v.idx_to_token[2:4]) == {"is", "life"}
+    assert len(v) == 8
+    assert v.to_indices("unseen-token") == 0
+    assert v.to_indices(["life", "unseen"]) == [v.token_to_idx["life"], 0]
+    assert v.to_tokens(0) == "<unk>"
+    assert v.to_tokens([0, 1]) == ["<unk>", "<pad>"]
+    with pytest.raises(ValueError):
+        v.to_tokens(len(v))
+
+
+def test_vocabulary_most_freq_and_min_freq():
+    v = text.vocab.Vocabulary(_counter(), most_freq_count=2, min_freq=1)
+    assert len(v) == 3            # unk + 2 most frequent
+    v2 = text.vocab.Vocabulary(_counter(), min_freq=2)
+    assert set(v2.idx_to_token[1:]) == {"life", "is"}
+    with pytest.raises(AssertionError):
+        text.vocab.Vocabulary(_counter(), min_freq=0)
+    with pytest.raises(AssertionError):
+        text.vocab.Vocabulary(_counter(), reserved_tokens=["<unk>"])
+
+
+def _write_custom(path, lines):
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return str(path)
+
+
+def test_custom_embedding_loads_and_queries(tmp_path):
+    p = _write_custom(tmp_path / "emb.txt", [
+        "a 0.1 0.2 0.3",
+        "b 1.0 2.0 3.0",
+        "c -1.0 -2.0 -3.0",
+    ])
+    e = text.embedding.CustomEmbedding(p)
+    assert e.vec_len == 3
+    assert len(e) == 4            # unk + 3 tokens
+    np.testing.assert_allclose(
+        e.get_vecs_by_tokens("b").asnumpy(), [1.0, 2.0, 3.0], rtol=1e-6)
+    # unknown -> init_unknown_vec (zeros by default)
+    np.testing.assert_allclose(
+        e.get_vecs_by_tokens("zzz").asnumpy(), [0, 0, 0], atol=0)
+    two = e.get_vecs_by_tokens(["a", "c"]).asnumpy()
+    np.testing.assert_allclose(two[1], [-1, -2, -3], rtol=1e-6)
+    # lower_case_backup
+    np.testing.assert_allclose(
+        e.get_vecs_by_tokens("B", lower_case_backup=True).asnumpy(),
+        [1.0, 2.0, 3.0], rtol=1e-6)
+
+
+def test_custom_embedding_malformed_lines_and_unk_row(tmp_path):
+    p = _write_custom(tmp_path / "emb.txt", [
+        "a 0.1 0.2",
+        "broken 0.1 xyz",          # unparsable -> warn + skip
+        "dup 1.0 1.0",
+        "dup 9.9 9.9",             # duplicate -> first wins
+        "<unk> 7.0 8.0",           # explicit unknown vector row
+        "short 0.5",               # dim mismatch -> skip
+    ])
+    e = text.embedding.CustomEmbedding(p)
+    assert "broken" not in e.token_to_idx
+    assert "short" not in e.token_to_idx
+    np.testing.assert_allclose(
+        e.get_vecs_by_tokens("dup").asnumpy(), [1.0, 1.0], rtol=1e-6)
+    np.testing.assert_allclose(
+        e.get_vecs_by_tokens("never-seen").asnumpy(), [7.0, 8.0],
+        rtol=1e-6)
+
+
+def test_custom_embedding_with_vocabulary(tmp_path):
+    p = _write_custom(tmp_path / "emb.txt", [
+        "life 1 1", "is 2 2", "great 3 3"])
+    v = text.vocab.Vocabulary(_counter(), most_freq_count=3)
+    e = text.embedding.CustomEmbedding(p, vocabulary=v)
+    # vocabulary drives the index space, embedding supplies vectors
+    assert len(e) == len(v)
+    assert e.idx_to_token == v.idx_to_token
+    np.testing.assert_allclose(
+        e.get_vecs_by_tokens("life").asnumpy(), [1, 1], rtol=1e-6)
+    # vocab token absent from the embedding file -> unk vector (zeros)
+    missing = [t for t in v.idx_to_token[1:]
+               if t not in ("life", "is", "great")]
+    if missing:
+        np.testing.assert_allclose(
+            e.get_vecs_by_tokens(missing[0]).asnumpy(), [0, 0], atol=0)
+
+
+def test_update_token_vectors(tmp_path):
+    p = _write_custom(tmp_path / "emb.txt", ["a 1 1", "b 2 2"])
+    e = text.embedding.CustomEmbedding(p)
+    e.update_token_vectors("a", nd.array([9.0, 9.0]))
+    np.testing.assert_allclose(
+        e.get_vecs_by_tokens("a").asnumpy(), [9, 9], rtol=1e-6)
+    e.update_token_vectors(["a", "b"], nd.array([[1., 2.], [3., 4.]]))
+    np.testing.assert_allclose(e.idx_to_vec.asnumpy()[1:],
+                               [[1, 2], [3, 4]], rtol=1e-6)
+    with pytest.raises(ValueError):
+        e.update_token_vectors("nope", nd.array([0.0, 0.0]))
+
+
+def test_composite_embedding_concatenates(tmp_path):
+    p1 = _write_custom(tmp_path / "e1.txt", ["x 1 2", "y 3 4"])
+    p2 = _write_custom(tmp_path / "e2.txt", ["x 5 7", "z 6 8"])
+    e1 = text.embedding.CustomEmbedding(p1)
+    e2 = text.embedding.CustomEmbedding(p2)
+    v = text.vocab.Vocabulary(Counter({"x": 2, "y": 1, "z": 1}))
+    ce = text.embedding.CompositeEmbedding(v, [e1, e2])
+    assert ce.vec_len == 4
+    np.testing.assert_allclose(
+        ce.get_vecs_by_tokens("x").asnumpy(), [1, 2, 5, 7], rtol=1e-6)
+    np.testing.assert_allclose(
+        ce.get_vecs_by_tokens("y").asnumpy(), [3, 4, 0, 0], rtol=1e-6)
+    np.testing.assert_allclose(
+        ce.get_vecs_by_tokens("z").asnumpy(), [0, 0, 6, 8], rtol=1e-6)
+
+
+def test_glove_fasttext_local_root_and_catalog(tmp_path):
+    # catalog / registry surface
+    names = text.embedding.get_pretrained_file_names()
+    assert "glove" in names and "fasttext" in names
+    assert "glove.6B.50d.txt" in \
+        text.embedding.get_pretrained_file_names("glove")
+    with pytest.raises(KeyError):
+        text.embedding.get_pretrained_file_names("nope")
+    with pytest.raises(KeyError):
+        text.embedding.create("nope")
+
+    # GloVe from a local drop directory (offline activation path)
+    root = tmp_path / "embeddings"
+    os.makedirs(root / "glove")
+    _write_custom(root / "glove" / "glove.6B.50d.txt",
+                  ["hello " + " ".join(["0.5"] * 50),
+                   "world " + " ".join(["0.25"] * 50)])
+    g = text.embedding.create("glove",
+                              pretrained_file_name="glove.6B.50d.txt",
+                              embedding_root=str(root))
+    assert g.vec_len == 50
+    np.testing.assert_allclose(
+        g.get_vecs_by_tokens("hello").asnumpy()[:2], [0.5, 0.5])
+
+    # FastText .vec header line is skipped
+    os.makedirs(root / "fasttext")
+    _write_custom(root / "fasttext" / "wiki.simple.vec",
+                  ["2 3", "alpha 1 2 3", "beta 4 5 6"])
+    ft = text.embedding.create("fasttext",
+                               pretrained_file_name="wiki.simple.vec",
+                               embedding_root=str(root))
+    assert ft.vec_len == 3 and "alpha" in ft.token_to_idx
+    # missing file -> clear offline error, not a download attempt
+    with pytest.raises(OSError, match="offline"):
+        text.embedding.GloVe(pretrained_file_name="glove.6B.100d.txt",
+                             embedding_root=str(root))
+    # unknown catalog name -> KeyError
+    with pytest.raises(KeyError):
+        text.embedding.GloVe(pretrained_file_name="not-a-file.txt",
+                             embedding_root=str(root))
+
+
+def test_embedding_feeds_gluon_embedding_layer(tmp_path):
+    """The reference workflow: load vectors, set them into a
+    gluon.nn.Embedding weight, look tokens up through the layer."""
+    p = _write_custom(tmp_path / "emb.txt",
+                      ["cat 1 0", "dog 0 1", "fish 1 1"])
+    v = text.vocab.Vocabulary(Counter({"cat": 3, "dog": 2, "fish": 1}))
+    e = text.embedding.CustomEmbedding(p, vocabulary=v)
+    layer = mx.gluon.nn.Embedding(len(e), e.vec_len)
+    layer.initialize()
+    layer.weight.set_data(e.idx_to_vec)
+    idx = nd.array(e.to_indices(["dog", "cat"]))
+    out = layer(idx).asnumpy()
+    np.testing.assert_allclose(out, [[0, 1], [1, 0]], rtol=1e-6)
