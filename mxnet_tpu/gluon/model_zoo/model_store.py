@@ -4,10 +4,15 @@ model_store.py — get_model_file with a download cache).
 No network egress in this environment, so the store is purely local: a
 weight drop at ``$MX_PRETRAINED_DIR`` (or ``~/.mxnet/models``, the
 reference's cache root) activates ``get_model(name, pretrained=True)``
-without code changes.  Accepted layouts per model name:
+without code changes.  Accepted layouts per model name, in priority
+order:
 
+    <root>/<name>-<sha1[:8]>.params   (the reference's cache naming —
+                                       the 8-hex short hash MUST match
+                                       the file content's sha1 prefix,
+                                       the reference's check_sha1 gate)
     <root>/<name>.params
-    <root>/<name>-0000.params      (reference checkpoint naming)
+    <root>/<name>-0000.params         (reference checkpoint naming)
 
 Absent weights raise the same clear error everywhere, pointing at the
 drop location — the API stays wired so data arrival is a no-op change
@@ -15,9 +20,14 @@ drop location — the API stays wired so data arrival is a no-op change
 """
 from __future__ import annotations
 
+import glob as _glob
+import hashlib
 import os
+import re
 
-__all__ = ["get_model_file", "load_pretrained"]
+__all__ = ["get_model_file", "load_pretrained", "purge"]
+
+_SHA1_NAME = re.compile(r"-([0-9a-f]{8})\.params$")
 
 
 def _root(root=None):
@@ -27,17 +37,48 @@ def _root(root=None):
 
 def get_model_file(name: str, root=None) -> str:
     """Path of `name`'s local weight file (reference: get_model_file —
-    minus the download; raises with the expected drop location)."""
+    minus the download; raises with the expected drop location).
+    Reference-style sha1-named cache files are integrity-checked: the
+    short hash embedded in the file name must be a prefix of the file
+    content's sha1 (reference: gluon.utils.check_sha1)."""
     base = _root(root)
+    corrupted = []
+    for cand in sorted(_glob.glob(
+            os.path.join(base, name + "-????????.params"))):
+        m = _SHA1_NAME.search(cand)
+        if not m:
+            continue  # e.g. <name>-0000.params: checkpoint naming, below
+        sha1 = hashlib.sha1()
+        with open(cand, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                sha1.update(chunk)
+        if sha1.hexdigest().startswith(m.group(1)):
+            return cand
+        corrupted.append(cand)
     for cand in (os.path.join(base, name + ".params"),
                  os.path.join(base, name + "-0000.params")):
         if os.path.exists(cand):
             return cand
+    if corrupted:
+        # only fatal when no valid fallback exists: a stale corrupted
+        # cache file must not shadow a good flat-named drop
+        raise OSError(
+            "pretrained weight file(s) %s failed the sha1 short-hash "
+            "check embedded in their names — the drop is corrupted or "
+            "misnamed; re-drop or rename without the 8-hex suffix"
+            % corrupted)
     raise FileNotFoundError(
         "pretrained weights for %r not found; this environment has no "
-        "network egress — drop %s.params into %s (or set "
-        "MX_PRETRAINED_DIR) to activate pretrained=True"
-        % (name, name, base))
+        "network egress — drop %s.params (or the reference cache file "
+        "%s-<sha1[:8]>.params) into %s (or set MX_PRETRAINED_DIR) to "
+        "activate pretrained=True" % (name, name, name, base))
+
+
+def purge(root=None):
+    """Reference: model_store.purge — clear the local weight cache."""
+    base = _root(root)
+    for f in _glob.glob(os.path.join(base, "*.params")):
+        os.remove(f)
 
 
 def load_pretrained(net, name: str, root=None, ctx=None):

@@ -1,0 +1,101 @@
+"""model_zoo.model_store — pretrained-weight local store (reference:
+python/mxnet/gluon/model_zoo/model_store.py get_model_file/purge and the
+sha1-named cache layout + gluon.utils.check_sha1 gate)."""
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon.model_zoo import model_store, vision
+
+
+def _sha1(path):
+    h = hashlib.sha1()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _drop_weights(net, name, root, sha1_named=True):
+    os.makedirs(root, exist_ok=True)
+    tmp = os.path.join(root, name + ".params.tmp")
+    net.save_parameters(tmp)
+    if sha1_named:
+        final = os.path.join(root,
+                             "%s-%s.params" % (name, _sha1(tmp)[:8]))
+    else:
+        final = os.path.join(root, name + ".params")
+    os.replace(tmp, final)
+    return final
+
+
+def test_get_model_pretrained_from_sha1_drop(tmp_path):
+    """The VERDICT acceptance flow: drop reference-cache-named weights,
+    get_model(name, pretrained=True, root=...) loads and predicts."""
+    mx.random.seed(0)
+    ref = vision.resnet50_v1(classes=10)
+    ref.initialize(mx.init.Xavier())
+    x = nd.random.normal(shape=(1, 3, 32, 32))
+    want = ref(x)  # also finalizes deferred shapes so save has all params
+    _drop_weights(ref, "resnet50_v1", str(tmp_path))
+
+    net = vision.get_model("resnet50_v1", classes=10, pretrained=True,
+                           root=str(tmp_path))
+    got = net(x)
+    np.testing.assert_allclose(got.asnumpy(), want.asnumpy(), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_get_model_file_sha1_check_rejects_corruption(tmp_path):
+    net = vision.get_model("mobilenet0.25", classes=4)
+    net.initialize()
+    net(nd.zeros((1, 3, 32, 32)))
+    path = _drop_weights(net, "mobilenet0.25", str(tmp_path))
+    # flip a byte -> content sha1 no longer matches the name's short hash
+    with open(path, "r+b") as f:
+        f.seek(100)
+        b = f.read(1)
+        f.seek(100)
+        f.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(OSError, match="sha1"):
+        model_store.get_model_file("mobilenet0.25", root=str(tmp_path))
+
+
+def test_get_model_file_flat_and_checkpoint_names(tmp_path):
+    net = vision.get_model("squeezenet1.0", classes=4)
+    net.initialize()
+    net(nd.zeros((1, 3, 64, 64)))
+    _drop_weights(net, "squeezenet1.0", str(tmp_path), sha1_named=False)
+    p = model_store.get_model_file("squeezenet1.0", root=str(tmp_path))
+    assert p.endswith("squeezenet1.0.params")
+    # missing -> actionable offline error naming the drop location
+    with pytest.raises(FileNotFoundError, match="MX_PRETRAINED_DIR"):
+        model_store.get_model_file("alexnet", root=str(tmp_path))
+
+
+def test_purge_clears_cache(tmp_path):
+    net = vision.get_model("mobilenet0.25", classes=4)
+    net.initialize()
+    net(nd.zeros((1, 3, 32, 32)))
+    _drop_weights(net, "mobilenet0.25", str(tmp_path))
+    model_store.purge(str(tmp_path))
+    with pytest.raises(FileNotFoundError):
+        model_store.get_model_file("mobilenet0.25", root=str(tmp_path))
+
+
+def test_corrupted_sha1_file_does_not_shadow_valid_flat_drop(tmp_path):
+    net = vision.get_model("mobilenet0.25", classes=4)
+    net.initialize()
+    net(nd.zeros((1, 3, 32, 32)))
+    bad = _drop_weights(net, "mobilenet0.25", str(tmp_path))
+    with open(bad, "r+b") as f:
+        f.seek(50)
+        f.write(b"\xff")
+    good = _drop_weights(net, "mobilenet0.25", str(tmp_path),
+                         sha1_named=False)
+    assert model_store.get_model_file("mobilenet0.25",
+                                      root=str(tmp_path)) == good
