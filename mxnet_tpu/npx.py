@@ -109,3 +109,60 @@ def save(fname, data):
 def waitall():
     from .ndarray import waitall as _w
     _w()
+
+
+# -- npx.special: XLA-lowered special functions (beyond-reference TPU
+# primitives; jax.scipy.special via the registry so they ride the per-op
+# jit cache and autograd tape) -------------------------------------------
+import sys as _sys
+from types import ModuleType as _ModuleType
+
+special = _ModuleType(__name__ + ".special")
+for _sname in ("betainc", "zeta", "ndtr", "ndtri", "log_ndtr", "logit",
+               "expit", "xlogy", "xlog1py", "entr", "rel_entr", "kl_div",
+               "i0e", "i1", "i1e",
+               # second batch: registered defensively per jax build —
+               # only expose what the registry actually has
+               "betaln", "expi", "expn", "exp1", "factorial",
+               "gammasgn", "hyp1f1", "poch", "spence"):
+    from .ops.registry import _REGISTRY as _regtab
+    if "_npx_" + _sname not in _regtab:
+        continue
+    def _mk_special(_opn="_npx_" + _sname):
+        def f(*args):
+            return invoke(_opn, *args)
+        return f
+    setattr(special, _sname, _mk_special())
+    getattr(special, _sname).__name__ = _sname
+if "_npx_multigammaln" in _regtab:
+    def _multigammaln(a, d):
+        return invoke("_npx_multigammaln", a, d=int(d))
+    _multigammaln.__name__ = "multigammaln"
+    special.multigammaln = _multigammaln
+if "_npx_bernoulli" in _regtab:
+    def _bernoulli(n):
+        return invoke("_npx_bernoulli", n=int(n))
+    _bernoulli.__name__ = "bernoulli"
+    special.bernoulli = _bernoulli
+_sys.modules[special.__name__] = special
+
+
+# -- npx.stats: distribution densities over the registry ------------------
+stats = _ModuleType(__name__ + ".stats")
+for _dist, _fns in (("norm", ("pdf", "logpdf", "cdf", "logcdf")),
+                    ("expon", ("logpdf",)), ("gamma", ("logpdf",)),
+                    ("beta", ("logpdf",)), ("t", ("logpdf",)),
+                    ("cauchy", ("logpdf",)), ("laplace", ("logpdf",)),
+                    ("uniform", ("logpdf",)),
+                    ("poisson", ("pmf", "logpmf")),
+                    ("bernoulli", ("logpmf",))):
+    _dm = _ModuleType(stats.__name__ + "." + _dist)
+    for _f in _fns:
+        def _mk_stat(_opn="_npx_stats_%s_%s" % (_dist, _f)):
+            def g(*args):
+                return invoke(_opn, *args)
+            return g
+        setattr(_dm, _f, _mk_stat())
+    setattr(stats, _dist, _dm)
+    _sys.modules[_dm.__name__] = _dm
+_sys.modules[stats.__name__] = stats

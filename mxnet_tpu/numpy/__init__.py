@@ -418,6 +418,35 @@ linalg.pinv = _op_fn("_npi_pinv", "pinv")
 linalg.eigvalsh = _op_fn("_npi_eigvalsh", "eigvalsh")
 sys.modules[linalg.__name__] = linalg
 
+# -- np.fft: the full NumPy fft surface over XLA's FFT HLO --------------------
+fft = ModuleType(__name__ + ".fft")
+for _f1 in ("fft", "ifft", "rfft", "irfft", "hfft", "ihfft"):
+    def _mk1(_opn="_npi_" + _f1):
+        def f(a, n=None, axis=-1, norm=None):
+            return invoke(_opn, a, n=n, axis=axis, norm=norm)
+        return f
+    setattr(fft, _f1, _mk1())
+    getattr(fft, _f1).__name__ = _f1
+for _fn_ in ("fft2", "ifft2", "rfft2", "irfft2", "fftn", "ifftn",
+             "rfftn", "irfftn"):
+    def _mkn(_opn="_npi_" + _fn_):
+        def f(a, s=None, axes=None, norm=None):
+            return invoke(_opn, a, s=tuple(s) if s is not None else None,
+                          axes=tuple(axes) if axes is not None else None,
+                          norm=norm)
+        return f
+    setattr(fft, _fn_, _mkn())
+    getattr(fft, _fn_).__name__ = _fn_
+fft.fftfreq = lambda n, d=1.0: invoke("_npi_fftfreq", n=n, d=d)
+fft.rfftfreq = lambda n, d=1.0: invoke("_npi_rfftfreq", n=n, d=d)
+fft.fftshift = lambda x, axes=None: invoke(
+    "_npi_fftshift", x, axes=tuple(axes) if isinstance(axes, (list, tuple))
+    else axes)
+fft.ifftshift = lambda x, axes=None: invoke(
+    "_npi_ifftshift", x, axes=tuple(axes)
+    if isinstance(axes, (list, tuple)) else axes)
+sys.modules[fft.__name__] = fft
+
 random = ModuleType(__name__ + ".random")
 random.uniform = lambda low=0.0, high=1.0, size=None, dtype=None, ctx=None, \
     device=None: invoke("_random_uniform", low=low, high=high,
@@ -578,6 +607,48 @@ random.chisquare = lambda df, size=None, **kw: _wrap(
 random.standard_normal = lambda size=None: random.normal(size=size)
 random.standard_exponential = lambda size=None: random.exponential(
     size=size)
+def _scalar_param(name, v):
+    """Distribution parameters ride the jit cache as STATIC attrs, so
+    they must be host scalars; numpy's array-parameter broadcasting is
+    not supported (matching the rest of this module) — turn the
+    deep unhashable-key crash into a clear error."""
+    if isinstance(v, NDArray) or isinstance(v, _onp.ndarray):
+        if getattr(v, "size", 2) == 1:
+            return float(v.asnumpy() if isinstance(v, NDArray) else v)
+        raise TypeError(
+            "np.random: array-valued parameter %r is not supported "
+            "(pass a scalar; broadcasting over parameter arrays is a "
+            "documented divergence)" % name)
+    return float(v)
+
+
+random.standard_t = lambda df, size=None, **kw: invoke(
+    "_npi_standard_t", df=_scalar_param("df", df), size=_rand_size(size))
+random.standard_cauchy = lambda size=None, **kw: invoke(
+    "_npi_standard_cauchy", size=_rand_size(size))
+random.standard_gamma = lambda shape, size=None, **kw: invoke(
+    "_npi_standard_gamma", shape_param=_scalar_param("shape", shape),
+    size=_rand_size(size))
+random.triangular = lambda left, mode, right, size=None, **kw: invoke(
+    "_npi_triangular", left=_scalar_param("left", left),
+    mode=_scalar_param("mode", mode),
+    right=_scalar_param("right", right), size=_rand_size(size))
+random.dirichlet = lambda alpha, size=None, **kw: invoke(
+    "_npi_dirichlet", alpha=tuple(float(a) for a in alpha),
+    size=_rand_size(size))
+random.noncentral_chisquare = lambda df, nonc, size=None, **kw: invoke(
+    "_npi_noncentral_chisquare", df=_scalar_param("df", df),
+    nonc=_scalar_param("nonc", nonc), size=_rand_size(size))
+random.wald = lambda mean, scale, size=None, **kw: invoke(
+    "_npi_wald", mean=_scalar_param("mean", mean),
+    scale=_scalar_param("scale", scale), size=_rand_size(size))
+random.logseries = lambda p, size=None, **kw: invoke(
+    "_npi_logseries", p=_scalar_param("p", p), size=_rand_size(size))
+random.vonmises = lambda mu, kappa, size=None, **kw: invoke(
+    "_npi_vonmises", mu=_scalar_param("mu", mu),
+    kappa=_scalar_param("kappa", kappa), size=_rand_size(size))
+random.zipf = lambda a, size=None, **kw: invoke(
+    "_npi_zipf", a=_scalar_param("a", a), size=_rand_size(size))
 random.multivariate_normal = lambda mean, cov, size=None, **kw: _wrap(
     jax.random.multivariate_normal(_rk(), _unwrap(mean), _unwrap(cov),
                                    _rand_size(size) or None))
@@ -1022,6 +1093,170 @@ def identity(n, dtype=None):
 
 def bartlett(M):
     return invoke("_npi_bartlett", M=M)
+
+
+def blackman(M):
+    return invoke("_npi_blackman_np", M=M)
+
+
+def hamming(M):
+    return invoke("_npi_hamming_np", M=M)
+
+
+def hanning(M):
+    return invoke("_npi_hanning_np", M=M)
+
+
+def unwrap(p, discont=None, axis=-1, period=6.283185307179586):
+    return invoke("_npi_unwrap", p, discont=discont, axis=axis,
+                  period=period)
+
+
+def spacing(x):
+    return invoke("_npi_spacing", x)
+
+
+def polyadd(a1, a2):
+    return invoke("_npi_polyadd", a1, a2)
+
+
+def polysub(a1, a2):
+    return invoke("_npi_polysub", a1, a2)
+
+
+def polymul(a1, a2):
+    return invoke("_npi_polymul", a1, a2)
+
+
+def polydiv(u, v):
+    return tuple(invoke("_npi_polydiv", u, v))
+
+
+def polyder(p, m=1):
+    return invoke("_npi_polyder", p, m=m)
+
+
+def polyint(p, m=1):
+    return invoke("_npi_polyint", p, m=m)
+
+
+def polyfit(x, y, deg):
+    return invoke("_npi_polyfit", x, y, deg=deg)
+
+
+def roots(p):
+    return invoke("_npi_roots", p)
+
+
+def poly(seq_of_zeros):
+    return invoke("_npi_poly", seq_of_zeros)
+
+
+def histogram_bin_edges(a, bins=10, range=None):
+    return invoke("_npi_histogram_bin_edges", a, bins=bins,
+                  range=tuple(range) if range is not None else None)
+
+
+def real_if_close(a, tol=100.0):
+    return invoke("_npi_real_if_close", a, tol=tol)
+
+
+def matrix_transpose(x):
+    return invoke("_npi_matrix_transpose", x)
+
+
+def iscomplexobj(x):
+    return _onp.issubdtype(_onp.dtype(getattr(x, "dtype", type(x))),
+                           _onp.complexfloating)
+
+
+def isrealobj(x):
+    return not iscomplexobj(x)
+
+
+def shares_memory(a, b, max_work=None):
+    """Chunk identity is the only aliasing this NDArray model has: views
+    share their root chunk; separate arrays never share."""
+    ca = getattr(a, "_chunk", None)
+    cb = getattr(b, "_chunk", None)
+    return ca is not None and ca is cb
+
+
+may_share_memory = shares_memory
+
+
+def einsum_path(*operands, optimize="greedy"):
+    ops = [o.asnumpy() if isinstance(o, NDArray) else o for o in operands]
+    return _onp.einsum_path(*ops, optimize=optimize)
+
+
+def common_type(*arrays):
+    return _onp.common_type(*[_onp.empty(0, dtype=a.dtype)
+                              for a in arrays])
+
+
+def min_scalar_type(a):
+    return _onp.min_scalar_type(a.asnumpy() if isinstance(a, NDArray)
+                                else a)
+
+
+def place(arr, mask, vals):
+    """numpy.place: in-place write of `vals` (cycled over the running
+    True count) at mask positions."""
+    out = invoke("_npi_place_impl", arr, mask,
+                 vals if isinstance(vals, NDArray) else array(vals))
+    arr._set_jax(out._jax)
+
+
+def putmask(a, mask, values):
+    """numpy.putmask: in-place write, values cycled by flat position."""
+    out = invoke("_npi_putmask_impl", a, mask,
+                 values if isinstance(values, NDArray) else array(values))
+    a._set_jax(out._jax)
+
+
+def copyto(dst, src, where=True):
+    """numpy.copyto onto an NDArray destination."""
+    src = src if isinstance(src, NDArray) else array(src)
+    if where is True:
+        out = broadcast_to(src, dst.shape).astype(dst.dtype)
+    else:
+        w = where if isinstance(where, NDArray) else array(where)
+        # numpy.copyto preserves the destination dtype even when the
+        # where-select promotes
+        out = invoke("_npi_where", w, src, dst).astype(dst.dtype)
+    dst._set_jax(out._jax if isinstance(out, NDArray) else out)
+
+
+def fromiter(iterable, dtype, count=-1):
+    return array(_onp.fromiter(iterable, dtype=dtype, count=count))
+
+
+def frombuffer(buffer, dtype=float, count=-1, offset=0):
+    return array(_onp.frombuffer(buffer, dtype=dtype, count=count,
+                                 offset=offset))
+
+
+def fromstring(string, dtype=float, count=-1, sep=""):
+    return array(_onp.fromstring(string, dtype=dtype, count=count,
+                                 sep=sep))
+
+
+class _IndexGrid:
+    """np.mgrid/ogrid index tricks (dense/open) over NDArray outputs."""
+
+    def __init__(self, sparse):
+        self._sparse = sparse
+
+    def __getitem__(self, key):
+        out = (_onp.ogrid if self._sparse else _onp.mgrid)[key]
+        if isinstance(out, _onp.ndarray):
+            return array(out)
+        return [array(o) for o in out]
+
+
+mgrid = _IndexGrid(sparse=False)
+ogrid = _IndexGrid(sparse=True)
 
 
 def kaiser(M, beta):

@@ -347,11 +347,6 @@ def _index_add(data, index, value):
     return data.at[index.astype(jnp.int32)].add(value)
 
 
-@register("index_copy")
-def _index_copy(data, index, value):
-    return data.at[index.astype(jnp.int32)].set(value)
-
-
 @register("index_update")
 def _index_update(data, index, value):
     return data.at[index.astype(jnp.int32)].set(value)

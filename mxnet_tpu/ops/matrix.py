@@ -205,8 +205,9 @@ def _pad(x, mode="constant", pad_width=(), constant_value=0.0):
     raise ValueError("bad pad mode %r" % mode)
 
 
-@register("flip", aliases=["reverse"])
+@register("flip")
 def _flip(x, axis=0):
+    # "reverse" (multi-axis v1.x semantics) is owned by ops/misc.py
     return jnp.flip(x, axis=axis)
 
 

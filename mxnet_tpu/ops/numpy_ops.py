@@ -1132,3 +1132,289 @@ def _npx_nonzero(a):
 
 
 _reg("_npx_nonzero", _npx_nonzero, no_jit=True, differentiable=False)
+
+
+# ---------------------------------------------------------------------------
+# numpy fft (reference: the mx.np surface tracks NumPy's np.fft module;
+# on TPU these lower to XLA's FFT HLO, which runs on-device)
+# ---------------------------------------------------------------------------
+
+def _fftify(jfn, name, differentiable=True):
+    def fn(a, n=None, axis=-1, norm=None):
+        return jfn(a, n=n, axis=axis, norm=norm)
+    fn.__name__ = name
+    _reg(name, fn, differentiable=differentiable)
+
+
+def _fftify_nd(jfn, name):
+    def fn(a, s=None, axes=None, norm=None):
+        if jfn in (jnp.fft.fft2, jnp.fft.ifft2, jnp.fft.rfft2,
+                   jnp.fft.irfft2):
+            return jfn(a, s=s, axes=axes if axes is not None else (-2, -1),
+                       norm=norm)
+        return jfn(a, s=s, axes=axes, norm=norm)
+    fn.__name__ = name
+    _reg(name, fn)
+
+
+_fftify(jnp.fft.fft, "_npi_fft")
+_fftify(jnp.fft.ifft, "_npi_ifft")
+_fftify(jnp.fft.rfft, "_npi_rfft")
+_fftify(jnp.fft.irfft, "_npi_irfft")
+_fftify(jnp.fft.hfft, "_npi_hfft")
+_fftify(jnp.fft.ihfft, "_npi_ihfft")
+_fftify_nd(jnp.fft.fft2, "_npi_fft2")
+_fftify_nd(jnp.fft.ifft2, "_npi_ifft2")
+_fftify_nd(jnp.fft.rfft2, "_npi_rfft2")
+_fftify_nd(jnp.fft.irfft2, "_npi_irfft2")
+_fftify_nd(jnp.fft.fftn, "_npi_fftn")
+_fftify_nd(jnp.fft.ifftn, "_npi_ifftn")
+_fftify_nd(jnp.fft.rfftn, "_npi_rfftn")
+_fftify_nd(jnp.fft.irfftn, "_npi_irfftn")
+
+
+def _npi_fftfreq(n, d=1.0):
+    return jnp.fft.fftfreq(int(n), d=d)
+
+
+def _npi_rfftfreq(n, d=1.0):
+    return jnp.fft.rfftfreq(int(n), d=d)
+
+
+def _npi_fftshift(a, axes=None):
+    return jnp.fft.fftshift(a, axes=axes)
+
+
+def _npi_ifftshift(a, axes=None):
+    return jnp.fft.ifftshift(a, axes=axes)
+
+
+_reg("_npi_fftfreq", _npi_fftfreq, differentiable=False)
+_reg("_npi_rfftfreq", _npi_rfftfreq, differentiable=False)
+_reg("_npi_fftshift", _npi_fftshift)
+_reg("_npi_ifftshift", _npi_ifftshift)
+
+
+# ---------------------------------------------------------------------------
+# numpy polynomial family (np.polyadd/... surface; polyval/vander above)
+# ---------------------------------------------------------------------------
+
+def _npi_polyadd(a1, a2):
+    return jnp.polyadd(a1, a2)
+
+
+def _npi_polysub(a1, a2):
+    return jnp.polysub(a1, a2)
+
+
+def _npi_polymul(a1, a2):
+    return jnp.polymul(a1, a2)
+
+
+def _npi_polydiv(u, v):
+    q, r = jnp.polydiv(u, v)
+    return q, r
+
+
+def _npi_polyder(p, m=1):
+    for _ in range(int(m)):
+        p = jnp.polyder(p)
+    return p
+
+
+def _npi_polyint(p, m=1):
+    for _ in range(int(m)):
+        p = jnp.polyint(p)
+    return p
+
+
+def _npi_polyfit(x, y, deg):
+    return jnp.polyfit(x, y, int(deg))
+
+
+def _npi_roots(p):
+    # strip_zeros=False keeps the output shape static (len(p)-1) so the
+    # kernel stays jittable; numpy strips leading zeros instead
+    return jnp.roots(p, strip_zeros=False)
+
+
+def _npi_poly(seq):
+    return jnp.poly(seq)
+
+
+_reg("_npi_polyadd", _npi_polyadd)
+_reg("_npi_polysub", _npi_polysub)
+_reg("_npi_polymul", _npi_polymul)
+_reg("_npi_polydiv", _npi_polydiv, num_outputs=2)
+_reg("_npi_polyder", _npi_polyder)
+_reg("_npi_polyint", _npi_polyint)
+_reg("_npi_polyfit", _npi_polyfit)
+_reg("_npi_roots", _npi_roots, differentiable=False)
+_reg("_npi_poly", _npi_poly, differentiable=False)
+
+
+# ---------------------------------------------------------------------------
+# remaining numpy surface: unwrap (kaiser/spacing kernels already exist
+# above — only their np-level bindings were missing)
+# ---------------------------------------------------------------------------
+
+def _npi_unwrap(p, discont=None, axis=-1, period=6.283185307179586):
+    return jnp.unwrap(p, discont=discont, axis=axis, period=period)
+
+
+_reg("_npi_unwrap", _npi_unwrap)
+
+
+# ---------------------------------------------------------------------------
+# special functions (beyond the reference: jax.scipy.special lowered to
+# XLA — useful loss/statistics primitives with exact gradients on TPU)
+# ---------------------------------------------------------------------------
+
+def _specials():
+    from jax.scipy import special as jsp
+    table = {
+        "_npx_betainc": (jsp.betainc, True),
+        "_npx_zeta": (jsp.zeta, True),
+        "_npx_ndtr": (jsp.ndtr, True),
+        "_npx_ndtri": (jsp.ndtri, True),
+        "_npx_log_ndtr": (jsp.log_ndtr, True),
+        "_npx_logit": (jsp.logit, True),
+        "_npx_expit": (jsp.expit, True),
+        "_npx_xlogy": (jsp.xlogy, True),
+        "_npx_xlog1py": (jsp.xlog1py, True),
+        "_npx_entr": (jsp.entr, True),
+        "_npx_rel_entr": (jsp.rel_entr, True),
+        "_npx_kl_div": (jsp.kl_div, True),
+        "_npx_i0e": (jsp.i0e, True),
+        "_npx_i1": (jsp.i1, True),
+        "_npx_i1e": (jsp.i1e, True),
+    }
+    for name, (jfn, diff) in table.items():
+        def make(jfn=jfn):
+            def fn(*args):
+                return jfn(*args)
+            return fn
+        f = make()
+        f.__doc__ = ("jax.scipy.special.%s lowered to XLA (beyond-"
+                     "reference TPU primitive)" % jfn.__name__)
+        _reg(name, f, differentiable=diff)
+
+
+_specials()
+
+
+def _more_specials():
+    """Second special-function batch: registered defensively (only what
+    this jax build provides) so the surface tracks jax.scipy.special."""
+    from jax.scipy import special as jsp
+    def _multigammaln(a, d=1):
+        # d is the integration-space dimension: a static attr, not an
+        # operand (jax requires it concrete)
+        return jsp.multigammaln(a, int(d))
+    if hasattr(jsp, "multigammaln"):
+        _reg("_npx_multigammaln", _multigammaln)
+
+    def _bernoulli(n=1):
+        # jsp.bernoulli builds the first n+1 Bernoulli numbers with a
+        # concrete-n Python loop: n is a static attr, not an operand
+        return jsp.bernoulli(int(n))
+    if hasattr(jsp, "bernoulli"):
+        _reg("_npx_bernoulli", _bernoulli, differentiable=False)
+    for name in ("betaln", "expi", "expn", "exp1",
+                 "factorial", "gammasgn", "hyp1f1",
+                 "poch", "spence"):
+        jfn = getattr(jsp, name, None)
+        if jfn is None:
+            continue
+        def make(jfn=jfn):
+            def fn(*args):
+                return jfn(*args)
+            return fn
+        f = make()
+        f.__doc__ = ("jax.scipy.special.%s lowered to XLA (beyond-"
+                     "reference TPU primitive)" % name)
+        _reg("_npx_" + name, f)
+
+
+_more_specials()
+
+
+def _npi_histogram_bin_edges(a, bins=10, range=None):
+    return jnp.histogram_bin_edges(a, bins=bins, range=range)
+
+
+def _npi_real_if_close(a, tol=100.0):
+    # numpy semantics: drop an imaginary part that is numerically zero.
+    # The complex->real decision is value-dependent -> eager (no_jit).
+    a = jnp.asarray(a)
+    if not jnp.issubdtype(a.dtype, jnp.complexfloating):
+        return a
+    import numpy as _np2
+    eps = _np2.finfo(a.dtype).eps
+    if bool(jnp.all(jnp.abs(a.imag) < tol * eps)):
+        return a.real
+    return a
+
+
+def _npi_matrix_transpose(a):
+    return jnp.swapaxes(a, -2, -1)
+
+
+def _npi_place_impl(a, mask, vals):
+    # numpy.place: first N True positions take vals cyclically.  The
+    # cyclic index depends on the mask's running count — computable with
+    # static shapes via cumsum, so it stays jittable.
+    vals = jnp.atleast_1d(vals).ravel()   # scalars/multi-d per numpy
+    idx = (jnp.cumsum(mask.ravel().astype(jnp.int32)) - 1) % vals.size
+    flat = jnp.where(mask.ravel(), vals[idx], a.ravel())
+    return flat.reshape(a.shape)
+
+
+def _npi_putmask_impl(a, mask, vals):
+    # numpy.putmask: vals broadcast cyclically by POSITION (not by the
+    # running mask count, unlike place)
+    vals = jnp.atleast_1d(vals).ravel()
+    idx = jnp.arange(a.size) % vals.size
+    flat = jnp.where(mask.ravel(), vals[idx], a.ravel())
+    return flat.reshape(a.shape)
+
+
+_reg("_npi_histogram_bin_edges", _npi_histogram_bin_edges,
+     differentiable=False)
+_reg("_npi_real_if_close", _npi_real_if_close, no_jit=True,
+     differentiable=False)
+_reg("_npi_matrix_transpose", _npi_matrix_transpose)
+_reg("_npi_place_impl", _npi_place_impl)
+_reg("_npi_putmask_impl", _npi_putmask_impl)
+
+
+def _stats():
+    """jax.scipy.stats log-densities as registry kernels (npx.stats.*):
+    exact-gradient loss/likelihood primitives lowered to XLA."""
+    from jax.scipy import stats as jst
+    table = [
+        ("norm_pdf", jst.norm.pdf), ("norm_logpdf", jst.norm.logpdf),
+        ("norm_cdf", jst.norm.cdf), ("norm_logcdf", jst.norm.logcdf),
+        ("expon_logpdf", jst.expon.logpdf),
+        ("gamma_logpdf", jst.gamma.logpdf),
+        ("beta_logpdf", jst.beta.logpdf),
+        ("t_logpdf", jst.t.logpdf),
+        ("cauchy_logpdf", jst.cauchy.logpdf),
+        ("laplace_logpdf", jst.laplace.logpdf),
+        ("uniform_logpdf", jst.uniform.logpdf),
+        ("poisson_pmf", jst.poisson.pmf),
+        ("poisson_logpmf", jst.poisson.logpmf),
+        ("bernoulli_logpmf", jst.bernoulli.logpmf),
+    ]
+    for name, jfn in table:
+        def make(jfn=jfn):
+            def fn(*args):
+                return jfn(*args)
+            return fn
+        f = make()
+        f.__doc__ = ("jax.scipy.stats %s lowered to XLA (beyond-reference "
+                     "TPU primitive)" % name)
+        _reg("_npx_stats_" + name, f)
+
+
+_stats()

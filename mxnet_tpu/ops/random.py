@@ -477,9 +477,10 @@ def _npi_chisquare(key, df=1.0, size=(), dtype=None):
     return jax.random.chisquare(key, df, shape=size or (), dtype=_dt(dtype))
 
 
-@register("_npi_f", aliases=["random_f"],
-          differentiable=False, needs_rng=True)
+@register("_npi_f", differentiable=False, needs_rng=True)
 def _npi_f(key, dfnum=1.0, dfden=1.0, size=(), dtype=None):
+    # NOTE: the user-visible legacy alias "random_f" belongs to the
+    # legacy-convention _random_f kernel below (shape= kwarg), not here
     return jax.random.f(key, dfnum, dfden, shape=size or (),
                         dtype=_dt(dtype))
 
@@ -542,3 +543,144 @@ def _power_dist(key, a=1.0, shape=(), dtype=None):
     dt = _dt(dtype)
     u = _u(key, shape, jnp.float32)
     return jnp.power(u, 1.0 / a).astype(dt)
+
+
+@register("_npi_dirichlet", aliases=["random_dirichlet", "dirichlet"],
+          differentiable=False, needs_rng=True)
+def _npi_dirichlet(key, alpha=(1.0,), size=(), dtype=None):
+    """np.random.dirichlet: normalized Gamma(alpha_i) draws."""
+    dt = _dt(dtype)
+    alpha = jnp.asarray(alpha, dt)
+    return jax.random.dirichlet(key, alpha, shape=size or (), dtype=dt)
+
+
+@register("_npi_standard_cauchy",
+          aliases=["random_standard_cauchy", "standard_cauchy"],
+          differentiable=False, needs_rng=True)
+def _npi_standard_cauchy(key, size=(), dtype=None):
+    return jax.random.cauchy(key, size or (), _dt(dtype))
+
+
+@register("_npi_standard_gamma",
+          aliases=["random_standard_gamma", "standard_gamma"],
+          differentiable=False, needs_rng=True)
+def _npi_standard_gamma(key, shape_param=1.0, size=(), dtype=None):
+    return jax.random.gamma(key, shape_param, size or (), _dt(dtype))
+
+
+@register("_npi_noncentral_chisquare",
+          aliases=["random_noncentral_chisquare", "noncentral_chisquare"],
+          differentiable=False, needs_rng=True)
+def _npi_noncentral_chisquare(key, df=1.0, nonc=0.0, size=(), dtype=None):
+    """Poisson-mixture construction: chi2(df + 2*K), K ~ Poisson(nonc/2)
+    (the standard exact sampler; np.random.noncentral_chisquare)."""
+    dt = _dt(dtype)
+    k_key, c_key = jax.random.split(key)
+    k = jax.random.poisson(k_key, nonc / 2.0, shape=size or ())
+    return jax.random.chisquare(
+        c_key, df + 2.0 * k.astype(jnp.float32), shape=size or (),
+        dtype=dt)
+
+
+@register("_npi_wald", aliases=["random_wald", "wald"],
+          differentiable=False, needs_rng=True)
+def _npi_wald(key, mean=1.0, scale=1.0, size=(), dtype=None):
+    """Inverse Gaussian via the Michael-Schucany-Haas transform
+    (np.random.wald)."""
+    dt = _dt(dtype)
+    n_key, u_key = jax.random.split(key)
+    shape = size or ()
+    v = jax.random.normal(n_key, shape, jnp.float32) ** 2
+    x = (mean + (mean ** 2) * v / (2.0 * scale)
+         - (mean / (2.0 * scale))
+         * jnp.sqrt(4.0 * mean * scale * v + (mean * v) ** 2))
+    u = jax.random.uniform(u_key, shape, jnp.float32)
+    return jnp.where(u <= mean / (mean + x), x,
+                     (mean ** 2) / x).astype(dt)
+
+
+@register("_npi_logseries", aliases=["random_logseries", "logseries"],
+          differentiable=False, needs_rng=True)
+def _npi_logseries(key, p=0.5, size=(), dtype=None):
+    """Kemp's exact two-uniform sampler for the log-series distribution
+    (np.random.logseries): x = floor(1 + ln(v)/ln(1 - (1-p)^u))."""
+    dt = dtype or "int32"
+    shape = size or ()
+    ku, kv = jax.random.split(key)
+    u = jax.random.uniform(ku, shape, jnp.float32, 1e-7, 1.0)
+    v = jax.random.uniform(kv, shape, jnp.float32, 1e-7, 1.0)
+    q = 1.0 - jnp.power(1.0 - p, u)
+    x = jnp.floor(1.0 + jnp.log(v) / jnp.log(q))
+    return jnp.maximum(x, 1.0).astype(dt)
+
+
+@register("_npi_vonmises", aliases=["random_vonmises", "vonmises"],
+          differentiable=False, needs_rng=True)
+def _npi_vonmises(key, mu=0.0, kappa=1.0, size=(), dtype=None):
+    """Best-Fisher (1979) rejection sampler, vectorized with a fixed
+    64-round accept mask (acceptance rate ~65%+ per round, so the
+    probability of an unfilled lane after 64 rounds is < 1e-29)."""
+    dt = _dt(dtype)
+    shape = size or ()
+    if kappa < 1e-6:
+        # numpy semantics: kappa=0 is the uniform circular distribution
+        # (the Best-Fisher rho would be 0/0)
+        u = jax.random.uniform(key, shape, jnp.float32, 0.0, 1.0)
+        theta = 2.0 * jnp.pi * u - jnp.pi
+        return (jnp.mod(theta + mu + jnp.pi, 2.0 * jnp.pi)
+                - jnp.pi).astype(dt)
+    r = 1.0 + jnp.sqrt(1.0 + 4.0 * kappa ** 2)
+    rho = (r - jnp.sqrt(2.0 * r)) / (2.0 * kappa)
+    s = (1.0 + rho ** 2) / (2.0 * rho)
+
+    def body(carry, k):
+        out, done = carry
+        k1, k2, k3 = jax.random.split(k, 3)
+        u1 = jax.random.uniform(k1, shape, jnp.float32, 1e-7, 1.0)
+        u2 = jax.random.uniform(k2, shape, jnp.float32, 1e-7, 1.0)
+        u3 = jax.random.uniform(k3, shape, jnp.float32, 1e-7, 1.0)
+        z = jnp.cos(jnp.pi * u1)
+        f = (1.0 + s * z) / (s + z)
+        c = kappa * (s - f)
+        accept = (c * (2.0 - c) - u2 > 0) | (jnp.log(c / u2) + 1.0 - c >= 0)
+        theta = jnp.sign(u3 - 0.5) * jnp.arccos(jnp.clip(f, -1.0, 1.0))
+        out = jnp.where(done, out, jnp.where(accept, theta, out))
+        done = done | accept
+        return (out, done), None
+
+    keys = jax.random.split(key, 64)
+    init = (jnp.zeros(shape, jnp.float32), jnp.zeros(shape, bool))
+    (theta, _done), _ = jax.lax.scan(body, init, keys)
+    return (jnp.mod(theta + mu + jnp.pi, 2.0 * jnp.pi) - jnp.pi).astype(dt)
+
+
+@register("_npi_zipf", aliases=["random_zipf", "zipf"],
+          differentiable=False, needs_rng=True)
+def _npi_zipf(key, a=2.0, size=(), dtype=None):
+    """Devroye's rejection-inversion sampler for the Zipf distribution,
+    vectorized with a fixed 64-round accept mask (acceptance rate is
+    >= 1/2 for a > 1, so 64 rounds leave < 1e-19 unfilled)."""
+    if not a > 1.0:
+        raise ValueError("zipf: a must be > 1 (got %r)" % (a,))
+    dt = dtype or "int32"
+    shape = size or ()
+    am1 = a - 1.0
+    b = jnp.power(2.0, am1)
+
+    def body(carry, k):
+        out, done = carry
+        k1, k2 = jax.random.split(k)
+        u = jax.random.uniform(k1, shape, jnp.float32, 1e-7, 1.0)
+        v = jax.random.uniform(k2, shape, jnp.float32)
+        x = jnp.floor(jnp.power(u, -1.0 / am1))
+        t = jnp.power(1.0 + 1.0 / x, am1)
+        accept = (v * x * (t - 1.0) / (b - 1.0) <= t / b) & \
+            (x >= 1.0) & jnp.isfinite(x)
+        out = jnp.where(done, out, jnp.where(accept, x, out))
+        done = done | accept
+        return (out, done), None
+
+    keys = jax.random.split(key, 64)
+    init = (jnp.ones(shape, jnp.float32), jnp.zeros(shape, bool))
+    (x, _done), _ = jax.lax.scan(body, init, keys)
+    return x.astype(dt)

@@ -119,8 +119,13 @@ def register(name: str, fn: Optional[Callable] = None, *, differentiable: bool =
              num_outputs: int = 1, aliases: Sequence[str] = (),
              mutates_input: Optional[int] = None, needs_rng: bool = False,
              aux_writeback: Optional[Dict[int, int]] = None,
-             no_jit: bool = False):
-    """Register an op. Usable as decorator or direct call."""
+             no_jit: bool = False, replace: bool = False):
+    """Register an op. Usable as decorator or direct call.
+
+    ``replace=True`` is for deliberate re-registration (user kernel
+    iteration via tpu_kernel.register); the built-in op modules must not
+    overwrite each other silently — that has already masked a kernel
+    regression once, so a same-module duplicate raises."""
 
     def _do(f: Callable) -> Callable:
         op = OpDef(name, f, differentiable=differentiable,
@@ -128,6 +133,13 @@ def register(name: str, fn: Optional[Callable] = None, *, differentiable: bool =
                    needs_rng=needs_rng, aux_writeback=aux_writeback,
                    no_jit=no_jit)
         if name in _REGISTRY or any(a in _REGISTRY for a in aliases):
+            if not replace:
+                dup = name if name in _REGISTRY else \
+                    next(a for a in aliases if a in _REGISTRY)
+                raise ValueError(
+                    "op %r is already registered (to %r); pass "
+                    "replace=True only for deliberate user-kernel "
+                    "re-registration" % (dup, _REGISTRY[dup].fn))
             # re-registration (user kernel iteration): drop the per-op jit
             # cache or dispatch keeps hitting the old fn via (name, params)
             _jitted.cache_clear()

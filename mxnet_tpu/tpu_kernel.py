@@ -196,7 +196,7 @@ def register(name: str, *, out_shape_fn: Callable,
         fn.__name__ = name
         fn.__doc__ = body.__doc__ or ("user tpu_kernel %s" % name)
         _registry.register(name, fn, differentiable=grad is not None,
-                           aliases=aliases)
+                           aliases=aliases, replace=True)
         # surface on the live mx.nd namespace like generated op wrappers
         import sys
         ndmod = sys.modules.get("mxnet_tpu.ndarray")

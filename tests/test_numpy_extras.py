@@ -1,0 +1,344 @@
+"""Round-5 numpy-surface additions: np.fft, polynomial family, windows,
+new random distributions, npx.special / npx.stats (scipy-oracle lanes).
+
+Reference: the mx.np surface tracks NumPy (python/mxnet/numpy/
+multiarray.py); np.fft/poly/emath-adjacent names follow installed-NumPy
+behavior.  npx.special / npx.stats are beyond-reference XLA primitives
+oracled against installed scipy.
+"""
+import numpy as onp
+import pytest
+import scipy.special as ss
+import scipy.stats as st
+
+import mxnet_tpu as mx
+from mxnet_tpu import npx
+
+np = mx.np
+
+
+def setup_module():
+    mx.random.seed(0)
+    onp.random.seed(0)
+
+
+# -- np.fft -----------------------------------------------------------------
+
+def test_fft_family_matches_numpy():
+    x = onp.random.RandomState(0).randn(16).astype("float32")
+    mxx = np.array(x)
+    onp.testing.assert_allclose(np.fft.fft(mxx).asnumpy(),
+                                onp.fft.fft(x), rtol=1e-4, atol=1e-4)
+    onp.testing.assert_allclose(np.fft.ifft(np.fft.fft(mxx)).asnumpy(),
+                                x, rtol=1e-4, atol=1e-5)
+    onp.testing.assert_allclose(np.fft.rfft(mxx).asnumpy(),
+                                onp.fft.rfft(x), rtol=1e-4, atol=1e-4)
+    onp.testing.assert_allclose(np.fft.irfft(np.fft.rfft(mxx)).asnumpy(),
+                                x, rtol=1e-4, atol=1e-5)
+    onp.testing.assert_allclose(np.fft.hfft(np.fft.ihfft(mxx)).asnumpy(),
+                                x, rtol=1e-3, atol=1e-4)
+
+
+def test_fft_nd_axes_and_shift():
+    x = onp.random.RandomState(1).randn(4, 8).astype("float32")
+    mxx = np.array(x)
+    onp.testing.assert_allclose(np.fft.fft2(mxx).asnumpy(),
+                                onp.fft.fft2(x), rtol=1e-4, atol=1e-4)
+    onp.testing.assert_allclose(
+        np.fft.fftn(mxx, axes=(0,)).asnumpy(),
+        onp.fft.fftn(x, axes=(0,)), rtol=1e-4, atol=1e-4)
+    onp.testing.assert_allclose(
+        np.fft.rfft2(mxx).asnumpy(), onp.fft.rfft2(x), rtol=1e-4,
+        atol=1e-4)
+    onp.testing.assert_allclose(
+        np.fft.fftshift(np.fft.fftfreq(8)).asnumpy(),
+        onp.fft.fftshift(onp.fft.fftfreq(8)), rtol=1e-6)
+    onp.testing.assert_allclose(
+        np.fft.ifftshift(np.fft.fftshift(mxx)).asnumpy(), x)
+    onp.testing.assert_allclose(np.fft.rfftfreq(9, d=0.5).asnumpy(),
+                                onp.fft.rfftfreq(9, d=0.5), rtol=1e-6)
+
+
+def test_fft_gradient_flows():
+    # gradient through irfft(rfft(x)) round-trip (real-valued chain)
+    from mxnet_tpu import autograd, nd
+    x2 = nd.random.normal(shape=(8,))
+    x2.attach_grad()
+    with autograd.record():
+        z = np.fft.irfft(np.fft.rfft(x2))
+        loss = (z * z).sum()
+    loss.backward()
+    onp.testing.assert_allclose(x2.grad.asnumpy(), 2 * x2.asnumpy(),
+                                rtol=1e-4, atol=1e-5)
+
+
+# -- polynomial family ------------------------------------------------------
+
+def test_polynomial_family_matches_numpy():
+    a = onp.array([1.0, -3.0, 2.0], "float32")
+    b = onp.array([1.0, 1.0], "float32")
+    onp.testing.assert_allclose(np.polyadd(np.array(a), np.array(b))
+                                .asnumpy(), onp.polyadd(a, b))
+    onp.testing.assert_allclose(np.polysub(np.array(a), np.array(b))
+                                .asnumpy(), onp.polysub(a, b))
+    onp.testing.assert_allclose(np.polymul(np.array(a), np.array(b))
+                                .asnumpy(), onp.polymul(a, b))
+    q, r = np.polydiv(np.array(a), np.array(b))
+    qn, rn = onp.polydiv(a, b)
+    onp.testing.assert_allclose(q.asnumpy(), qn, rtol=1e-5)
+    onp.testing.assert_allclose(np.polyder(np.array(a)).asnumpy(),
+                                onp.polyder(a))
+    onp.testing.assert_allclose(np.polyint(np.array(a)).asnumpy(),
+                                onp.polyint(a), rtol=1e-6)
+    onp.testing.assert_allclose(np.polyder(np.array(a), m=2).asnumpy(),
+                                onp.polyder(a, 2))
+
+
+def test_polyfit_and_roots():
+    xs = onp.linspace(0, 1, 20).astype("float32")
+    ys = 2 * xs ** 2 + 1
+    fit = np.polyfit(np.array(xs), np.array(ys), 2).asnumpy()
+    onp.testing.assert_allclose(fit, [2.0, 0.0, 1.0], atol=1e-3)
+    r = onp.sort(onp.real(np.roots(np.array([1.0, -3.0, 2.0])).asnumpy()))
+    onp.testing.assert_allclose(r, [1.0, 2.0], atol=1e-4)
+    # poly(roots) round-trips the monic coefficients
+    c = np.poly(np.array([1.0, 2.0])).asnumpy()
+    onp.testing.assert_allclose(onp.real(c), [1.0, -3.0, 2.0], atol=1e-5)
+
+
+# -- windows / misc ---------------------------------------------------------
+
+def test_windows_match_numpy():
+    for name in ("blackman", "hamming", "hanning", "bartlett"):
+        onp.testing.assert_allclose(getattr(np, name)(12).asnumpy(),
+                                    getattr(onp, name)(12), atol=1e-6)
+    onp.testing.assert_allclose(np.kaiser(12, 8.6).asnumpy(),
+                                onp.kaiser(12, 8.6), atol=1e-5)
+
+
+def test_unwrap_spacing_misc():
+    p = onp.array([0.0, 3.0, 6.0, 9.0], "float32")
+    onp.testing.assert_allclose(np.unwrap(np.array(p)).asnumpy(),
+                                onp.unwrap(p), rtol=1e-5)
+    assert np.spacing(np.array([1.0])).asnumpy()[0] == \
+        onp.spacing(onp.float32(1.0))
+    x = onp.arange(6.0, dtype="float32").reshape(2, 3)
+    assert np.matrix_transpose(np.array(x)).shape == (3, 2)
+    onp.testing.assert_allclose(
+        np.histogram_bin_edges(np.array([1.0, 2.0, 3.0]), bins=4)
+        .asnumpy(), onp.histogram_bin_edges(onp.array([1., 2., 3.]), 4))
+
+
+def test_place_putmask_copyto_mgrid():
+    arr = np.array([1.0, 2.0, 3.0, 4.0])
+    np.place(arr, np.array([True, False, True, True]),
+             np.array([9.0, 8.0]))
+    onp.testing.assert_allclose(arr.asnumpy(), [9, 2, 8, 9])
+    arr2 = np.array([1.0, 2.0, 3.0, 4.0])
+    np.putmask(arr2, np.array([True, False, True, True]),
+               np.array([9.0, 8.0]))
+    onp.testing.assert_allclose(arr2.asnumpy(), [9, 2, 9, 8])
+    # numpy oracles for the same semantics
+    n1 = onp.array([1.0, 2.0, 3.0, 4.0])
+    onp.place(n1, onp.array([True, False, True, True]),
+              onp.array([9.0, 8.0]))
+    onp.testing.assert_allclose(arr.asnumpy(), n1)
+    n2 = onp.array([1.0, 2.0, 3.0, 4.0])
+    onp.putmask(n2, onp.array([True, False, True, True]),
+                onp.array([9.0, 8.0]))
+    onp.testing.assert_allclose(arr2.asnumpy(), n2)
+    dst = np.zeros((3,))
+    np.copyto(dst, np.array([1.0, 2.0, 3.0]))
+    onp.testing.assert_allclose(dst.asnumpy(), [1, 2, 3])
+    g = np.mgrid[0:3, 0:2]
+    onp.testing.assert_allclose(g[0].asnumpy(), onp.mgrid[0:3, 0:2][0])
+    og = np.ogrid[0:3]
+    onp.testing.assert_allclose(og.asnumpy(), onp.ogrid[0:3])
+
+
+# -- new random distributions ----------------------------------------------
+
+def test_random_dirichlet_wald_noncentral():
+    d = np.random.dirichlet([1.0, 2.0, 3.0], size=(200,)).asnumpy()
+    onp.testing.assert_allclose(d.sum(1), onp.ones(200), rtol=1e-5)
+    onp.testing.assert_allclose(d.mean(0), [1 / 6, 2 / 6, 3 / 6],
+                                atol=0.05)
+    w = np.random.wald(3.0, 2.0, size=(40000,)).asnumpy()
+    assert abs(w.mean() - 3.0) < 0.15
+    assert (w > 0).all()
+    nc = np.random.noncentral_chisquare(3.0, 2.0, size=(40000,)).asnumpy()
+    assert abs(nc.mean() - 5.0) < 0.2          # mean = df + nonc
+
+
+def test_random_logseries_vonmises_zipf():
+    p = 0.5
+    ls = np.random.logseries(p, size=(50000,)).asnumpy()
+    want = -p / ((1 - p) * onp.log(1 - p))
+    assert abs(ls.mean() - want) < 0.03
+    assert ls.min() >= 1
+    vm = np.random.vonmises(0.5, 4.0, size=(50000,)).asnumpy()
+    assert (vm >= -onp.pi).all() and (vm <= onp.pi).all()
+    cm = onp.angle(onp.exp(1j * vm).mean())
+    assert abs(cm - 0.5) < 0.02
+    # concentration: circular variance matches scipy's vonmises
+    R = onp.abs(onp.exp(1j * vm).mean())
+    assert abs(R - (ss.i1(4.0) / ss.i0(4.0))) < 0.01
+    z = np.random.zipf(3.0, size=(50000,)).asnumpy()
+    assert z.min() >= 1
+    assert abs(z.mean() - ss.zeta(2.0) / ss.zeta(3.0)) < 0.05
+
+
+def test_random_standard_families():
+    sg = np.random.standard_gamma(2.0, size=(40000,)).asnumpy()
+    assert abs(sg.mean() - 2.0) < 0.1
+    sc = np.random.standard_cauchy(size=(1000,)).asnumpy()
+    assert onp.isfinite(sc).all()
+    t5 = np.random.standard_t(5.0, size=(40000,)).asnumpy()
+    assert abs(t5.std() - onp.sqrt(5.0 / 3.0)) < 0.05
+    tr = np.random.triangular(0.0, 0.5, 1.0, size=(40000,)).asnumpy()
+    assert abs(tr.mean() - 0.5) < 0.02
+
+
+def test_review_regressions():
+    """Round-5 review findings: signed spacing, scalar place/putmask,
+    copyto dtype preservation, vonmises kappa=0, zipf validation,
+    bernoulli static n."""
+    # spacing keeps numpy's SIGN convention (the round-5 duplicate
+    # registration that dropped it was removed)
+    assert np.spacing(np.array([-1.0])).asnumpy()[0] == \
+        onp.spacing(onp.float32(-1.0))
+    # scalar vals forms
+    a1 = np.array([1.0, 2.0, 3.0])
+    np.place(a1, np.array([True, False, True]), 5)
+    onp.testing.assert_allclose(a1.asnumpy(), [5, 2, 5])
+    a2 = np.array([1.0, 2.0, 3.0])
+    np.putmask(a2, np.array([False, True, True]), 7.0)
+    onp.testing.assert_allclose(a2.asnumpy(), [1, 7, 7])
+    # copyto preserves destination dtype through a where mask
+    dst = np.array([1, 2, 3], dtype="int32")
+    np.copyto(dst, np.array([9.9, 9.9, 9.9]),
+              where=np.array([True, False, True]))
+    assert str(dst.dtype) == "int32"
+    assert dst.asnumpy().tolist() == [9, 2, 9]
+    # kappa=0 vonmises is the uniform circular distribution
+    mx.random.seed(1)
+    vm0 = np.random.vonmises(0.0, 0.0, size=(20000,)).asnumpy()
+    assert onp.isfinite(vm0).all()
+    assert abs(onp.abs(onp.exp(1j * vm0).mean())) < 0.03
+    with pytest.raises(ValueError):
+        np.random.zipf(1.0, size=(4,))
+    with pytest.raises(TypeError):
+        np.random.standard_gamma(np.array([1.0, 2.0]), size=(4,))
+    # bernoulli numbers: B_0..B_3
+    bn = npx.special.bernoulli(3).asnumpy()
+    onp.testing.assert_allclose(bn, ss.bernoulli(3), rtol=1e-6)
+
+
+# -- npx.special / npx.stats (scipy oracle) ---------------------------------
+
+def test_npx_special_against_scipy():
+    x = onp.array([0.1, 0.5, 0.9], "float32")
+    a = onp.array([1.5, 2.0, 3.0], "float32")
+    b = onp.array([2.0, 1.0, 0.5], "float32")
+    cases = [
+        (npx.special.expit, ss.expit, (x,)),
+        (npx.special.logit, ss.logit, (x,)),
+        (npx.special.ndtr, ss.ndtr, (x,)),
+        (npx.special.ndtri, ss.ndtri, (x,)),
+        (npx.special.xlogy, ss.xlogy, (a, b)),
+        (npx.special.xlog1py, ss.xlog1py, (a, b)),
+        (npx.special.entr, ss.entr, (x,)),
+        (npx.special.rel_entr, ss.rel_entr, (a, b)),
+        (npx.special.kl_div, ss.kl_div, (a, b)),
+        (npx.special.i0e, ss.i0e, (a,)),
+        (npx.special.i1, ss.i1, (a,)),
+        (npx.special.i1e, ss.i1e, (a,)),
+        (npx.special.betainc, ss.betainc, (a, b, x)),
+        (npx.special.zeta, ss.zeta, (a, b)),
+    ]
+    for ours, ref, args in cases:
+        got = ours(*[np.array(v) for v in args]).asnumpy()
+        onp.testing.assert_allclose(got, ref(*args), rtol=2e-4,
+                                    atol=1e-5, err_msg=ref.__name__)
+
+
+def test_npx_special_second_batch_against_scipy():
+    """Defensively-registered batch: only assert the names this jax build
+    actually provides (absent ones are not registered either)."""
+    a = onp.array([1.5, 2.0, 3.0], "float32")
+    b = onp.array([2.0, 1.0, 0.5], "float32")
+    k = onp.array([1.0, 2.0, 3.0], "float32")
+    maybe = [
+        ("betaln", (a, b), ss.betaln),
+        ("factorial", (k,), lambda x: ss.factorial(x)),
+        ("gammasgn", (a,), ss.gammasgn),
+        ("poch", (a, b), ss.poch),
+        ("spence", (a,), ss.spence),
+        ("expi", (a,), ss.expi),
+        ("exp1", (a,), ss.exp1),
+        ("multigammaln", (a, 2), lambda x, d: ss.multigammaln(x, d)),
+        ("hyp1f1", (a, b, onp.float32(0.5)),
+         lambda x, y, z: ss.hyp1f1(x, y, z)),
+    ]
+    tested = 0
+    for name, args, ref in maybe:
+        ours = getattr(npx.special, name, None)
+        if ours is None:
+            continue
+        mx_args = [np.array(v) if isinstance(v, onp.ndarray) else v
+                   for v in args]
+        got = ours(*mx_args).asnumpy()
+        onp.testing.assert_allclose(got, ref(*args), rtol=2e-3,
+                                    atol=1e-5, err_msg=name)
+        tested += 1
+    assert tested >= 4, "suspiciously few second-batch specials: %d" % tested
+
+
+def test_npx_special_gradients():
+    from mxnet_tpu import autograd, nd
+    x = nd.array([0.3])
+    x.attach_grad()
+    with autograd.record():
+        y = npx.special.expit(x)
+    y.backward()
+    s = ss.expit(0.3)
+    onp.testing.assert_allclose(x.grad.asnumpy(), [s * (1 - s)], rtol=1e-5)
+
+
+def test_npx_stats_against_scipy():
+    x = onp.array([0.0, 1.0, -0.5], "float32")
+    onp.testing.assert_allclose(
+        npx.stats.norm.logpdf(np.array(x)).asnumpy(),
+        st.norm.logpdf(x), rtol=1e-5)
+    onp.testing.assert_allclose(
+        npx.stats.norm.cdf(np.array(x)).asnumpy(),
+        st.norm.cdf(x), rtol=1e-5)
+    onp.testing.assert_allclose(
+        npx.stats.gamma.logpdf(np.array([1.5]), np.array([2.0]))
+        .asnumpy(), st.gamma.logpdf(1.5, 2.0), rtol=1e-5)
+    onp.testing.assert_allclose(
+        npx.stats.poisson.logpmf(np.array([2.0]), np.array([3.0]))
+        .asnumpy(), st.poisson.logpmf(2, 3), rtol=1e-5)
+    onp.testing.assert_allclose(
+        npx.stats.t.logpdf(np.array([0.5]), np.array([5.0])).asnumpy(),
+        st.t.logpdf(0.5, 5.0), rtol=1e-5)
+
+
+# -- census artifact stays honest -------------------------------------------
+
+def test_op_census_zero_missing_and_850_kernels():
+    import subprocess
+    import sys as _sys
+    import os
+    r = subprocess.run([_sys.executable, "tools/op_census.py"],
+                       capture_output=True, text=True,
+                       cwd=os.path.dirname(os.path.dirname(
+                           os.path.abspath(__file__))))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "MISSING: none" in r.stdout
+    from mxnet_tpu.ops import registry as reg
+    uniq = set()
+    for spec in reg._REGISTRY.values():
+        fn = getattr(spec, "fn", None) or spec
+        uniq.add(id(fn))
+    assert len(uniq) >= 850, len(uniq)

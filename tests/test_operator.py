@@ -2012,6 +2012,36 @@ SPECS.update({
 
 
 TESTED_ELSEWHERE = {
+    # round-5 numpy-surface families: oracled in tests/test_numpy_extras.py
+    **{op: "tests/test_numpy_extras.py" for op in (
+        "_npi_fft", "_npi_ifft", "_npi_rfft", "_npi_irfft", "_npi_hfft",
+        "_npi_ihfft", "_npi_fft2", "_npi_ifft2", "_npi_rfft2",
+        "_npi_irfft2", "_npi_fftn", "_npi_ifftn", "_npi_rfftn",
+        "_npi_irfftn", "_npi_fftfreq", "_npi_rfftfreq", "_npi_fftshift",
+        "_npi_ifftshift",
+        "_npi_polyadd", "_npi_polysub", "_npi_polymul", "_npi_polydiv",
+        "_npi_polyder", "_npi_polyint", "_npi_polyfit", "_npi_roots",
+        "_npi_poly", "_npi_kaiser", "_npi_unwrap", "_npi_spacing",
+        "_npi_histogram_bin_edges", "_npi_real_if_close",
+        "_npi_matrix_transpose", "_npi_place_impl", "_npi_putmask_impl",
+        "_npi_dirichlet", "_npi_standard_cauchy", "_npi_standard_gamma",
+        "_npi_noncentral_chisquare", "_npi_wald", "_npi_logseries",
+        "_npi_vonmises", "_npi_zipf",
+        "_npx_betainc", "_npx_zeta", "_npx_ndtr", "_npx_ndtri",
+        "_npx_log_ndtr", "_npx_logit", "_npx_expit", "_npx_xlogy",
+        "_npx_xlog1py", "_npx_entr", "_npx_rel_entr", "_npx_kl_div",
+        "_npx_i0e", "_npx_i1", "_npx_i1e", "_npx_betaln",
+        "_npx_bernoulli", "_npx_expi", "_npx_expn", "_npx_exp1",
+        "_npx_factorial", "_npx_gammasgn", "_npx_hyp1f1",
+        "_npx_multigammaln", "_npx_poch", "_npx_spence",
+        "_npx_stats_norm_pdf", "_npx_stats_norm_logpdf",
+        "_npx_stats_norm_cdf", "_npx_stats_norm_logcdf",
+        "_npx_stats_expon_logpdf", "_npx_stats_gamma_logpdf",
+        "_npx_stats_beta_logpdf", "_npx_stats_t_logpdf",
+        "_npx_stats_cauchy_logpdf", "_npx_stats_laplace_logpdf",
+        "_npx_stats_uniform_logpdf", "_npx_stats_poisson_pmf",
+        "_npx_stats_poisson_logpmf", "_npx_stats_bernoulli_logpmf",
+    )},
     "_contrib_quantize": "tests/test_quantization.py",
     "_contrib_quantize_v2": "tests/test_quantization.py",
     "_contrib_dequantize": "tests/test_quantization.py",
