@@ -326,16 +326,26 @@ def test_npx_stats_against_scipy():
 
 # -- census artifact stays honest -------------------------------------------
 
-def test_op_census_zero_missing_and_850_kernels():
+def test_op_census_zero_missing_and_850_kernels(tmp_path):
+    import json
     import subprocess
     import sys as _sys
     import os
-    r = subprocess.run([_sys.executable, "tools/op_census.py"],
-                       capture_output=True, text=True,
-                       cwd=os.path.dirname(os.path.dirname(
-                           os.path.abspath(__file__))))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out_json = str(tmp_path / "census.json")
+    r = subprocess.run([_sys.executable, "tools/op_census.py",
+                        "--json", out_json],
+                       capture_output=True, text=True, cwd=repo)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "MISSING: none" in r.stdout
+    # the checked-in artifact must match the live registry — a renamed
+    # or added op without a census regen is a stale round artifact
+    with open(out_json) as f:
+        live = json.load(f)
+    with open(os.path.join(repo, "OP_CENSUS.json")) as f:
+        committed = json.load(f)
+    assert live == committed, \
+        "OP_CENSUS.json is stale: rerun tools/op_census.py --json"
     from mxnet_tpu.ops import registry as reg
     uniq = set()
     for spec in reg._REGISTRY.values():

@@ -1,0 +1,225 @@
+"""Import conformance against FOREIGN onnx bytes (VERDICT r4 #8).
+
+Every other ONNX test round-trips this repo's own writer, which cannot
+catch a shared misreading of onnx.proto.  The fixtures here are authored
+by an INDEPENDENT minimal protobuf encoder written directly from the
+onnx.proto3 message spec (field numbers/wire types transcribed below) —
+no code shared with mxnet_tpu.onnx — then imported and checked against
+pure-numpy math.  The first run writes the bytes under tests/fixtures/
+foreign_*.onnx; later runs verify the generator reproduces the
+checked-in bytes exactly (fixture drift = spec-reading change).
+"""
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+FIXDIR = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+# ---------------------------------------------------------------------------
+# Independent wire-format encoder (transcribed from onnx.proto3):
+#   ModelProto:  ir_version=1(varint)  opset_import=8(msg)  graph=7(msg)
+#   OperatorSetIdProto: domain=1(str) version=2(varint)
+#   GraphProto:  node=1  name=2  initializer=5  input=11  output=12
+#   NodeProto:   input=1  output=2  name=3  op_type=4  attribute=5
+#   AttributeProto: name=1 f=2 i=3 s=4 floats=7 ints=8 strings=9 type=20
+#   TensorProto: dims=1  data_type=2  float_data=4  name=8  raw_data=9
+#   ValueInfoProto: name=1  type=2{tensor_type=1{elem_type=1 shape=2}}
+#   TensorShapeProto.Dimension: dim_value=1  dim_param=2
+# ---------------------------------------------------------------------------
+
+def vint(n):
+    out = b""
+    while True:
+        b7 = n & 0x7F
+        n >>= 7
+        out += bytes([b7 | (0x80 if n else 0)])
+        if not n:
+            return out
+
+
+def tag(field, wire):
+    return vint((field << 3) | wire)
+
+
+def f_msg(field, payload):
+    return tag(field, 2) + vint(len(payload)) + payload
+
+
+def f_str(field, s):
+    return f_msg(field, s.encode())
+
+
+def f_int(field, v):
+    return tag(field, 0) + vint(v)
+
+
+def tensor(name, arr):
+    arr = np.asarray(arr, np.float32)
+    pb = b"".join(f_int(1, d) for d in arr.shape)
+    pb += f_int(2, 1)                               # FLOAT
+    pb += f_str(8, name)
+    pb += f_msg(9, arr.tobytes())                   # raw_data
+    return pb
+
+
+def attr_int(name, v):
+    return f_str(1, name) + f_int(3, v) + f_int(20, 2)
+
+
+def attr_float(name, v):
+    return f_str(1, name) + tag(2, 5) + struct.pack("<f", v) + f_int(20, 1)
+
+
+def attr_strs(name, vals):
+    return f_str(1, name) + b"".join(f_msg(9, v.encode()) for v in vals) \
+        + f_int(20, 8)
+
+
+def node(op, ins, outs, name, attrs=()):
+    pb = b"".join(f_str(1, i) for i in ins)
+    pb += b"".join(f_str(2, o) for o in outs)
+    pb += f_str(3, name) + f_str(4, op)
+    pb += b"".join(f_msg(5, a) for a in attrs)
+    return pb
+
+
+def vinfo(name, shape):
+    dims = b"".join(f_msg(1, f_int(1, d)) for d in shape)
+    ttype = f_int(1, 1) + f_msg(2, dims)
+    return f_str(1, name) + f_msg(2, f_msg(1, ttype))
+
+
+def model(graph_pb):
+    return (f_int(1, 8)                             # ir_version
+            + f_msg(8, f_str(1, "") + f_int(2, 13))  # opset 13
+            + f_msg(7, graph_pb))
+
+
+def write_or_verify(path, data):
+    """First run pins the fixture; later runs must reproduce it."""
+    if os.path.exists(path):
+        with open(path, "rb") as f:
+            assert f.read() == data, \
+                "foreign fixture generator drifted from %s" % path
+    else:
+        with open(path, "wb") as f:
+            f.write(data)
+
+
+# ---------------------------------------------------------------------------
+# fixture 1: Gemm + Relu                                                     |
+# ---------------------------------------------------------------------------
+
+def _gemm_relu_bytes(rng):
+    W = rng.randn(3, 4).astype(np.float32)          # Gemm transB=1
+    b = rng.randn(3).astype(np.float32)
+    g = b""
+    g += f_msg(1, node("Gemm", ["x", "W", "b"], ["h"], "gemm",
+                       [attr_float("alpha", 1.0), attr_float("beta", 1.0),
+                        attr_int("transA", 0), attr_int("transB", 1)]))
+    g += f_msg(1, node("Relu", ["h"], ["y"], "relu"))
+    g += f_str(2, "foreign_gemm")
+    g += f_msg(5, tensor("W", W)) + f_msg(5, tensor("b", b))
+    g += f_msg(11, vinfo("x", (2, 4)))
+    g += f_msg(12, vinfo("y", (2, 3)))
+    return model(g), W, b
+
+
+def test_foreign_gemm_relu_import():
+    rng = np.random.RandomState(11)
+    data, W, b = _gemm_relu_bytes(rng)
+    path = os.path.join(FIXDIR, "foreign_gemm.onnx")
+    write_or_verify(path, data)
+    s, arg, aux = mx.onnx.import_model(path)
+    x = rng.randn(2, 4).astype(np.float32)
+    args = {"x": nd.array(x)}
+    args.update({k: v for k, v in arg.items()})
+    out = s.bind(mx.cpu(), args).forward()[0].asnumpy()
+    np.testing.assert_allclose(out, np.maximum(x @ W.T + b, 0),
+                               rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# fixture 2: GRU (linear_before_reset=1), ONNX z,r,h gate order             |
+# ---------------------------------------------------------------------------
+
+def _gru_ref(x, h0, W, R, Wb, Rb, H):
+    """Pure-numpy ONNX GRU (forward, linear_before_reset=1)."""
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+    T, N, _ = x.shape
+    Wz, Wr, Wh = W[:H], W[H:2 * H], W[2 * H:]
+    Rz, Rr, Rh = R[:H], R[H:2 * H], R[2 * H:]
+    Wbz, Wbr, Wbh = Wb[:H], Wb[H:2 * H], Wb[2 * H:]
+    Rbz, Rbr, Rbh = Rb[:H], Rb[H:2 * H], Rb[2 * H:]
+    h = h0.copy()
+    ys = []
+    for t in range(T):
+        xt = x[t]
+        z = sig(xt @ Wz.T + h @ Rz.T + Wbz + Rbz)
+        r = sig(xt @ Wr.T + h @ Rr.T + Wbr + Rbr)
+        hh = np.tanh(xt @ Wh.T + r * (h @ Rh.T + Rbh) + Wbh)
+        h = (1 - z) * hh + z * h
+        ys.append(h.copy())
+    return np.stack(ys)[:, None]                    # (T, 1, N, H)
+
+
+def _gru_bytes(rng, T=4, N=2, I=3, H=5):
+    W = (rng.randn(3 * H, I) * 0.4).astype(np.float32)
+    R = (rng.randn(3 * H, H) * 0.4).astype(np.float32)
+    B = (rng.randn(6 * H) * 0.2).astype(np.float32)
+    g = b""
+    g += f_msg(1, node("GRU", ["x", "W", "R", "B"], ["y"], "gru",
+                       [attr_int("hidden_size", H),
+                        attr_int("linear_before_reset", 1)]))
+    g += f_str(2, "foreign_gru")
+    g += f_msg(5, tensor("W", W[None]))
+    g += f_msg(5, tensor("R", R[None]))
+    g += f_msg(5, tensor("B", B[None]))
+    g += f_msg(11, vinfo("x", (T, N, I)))
+    g += f_msg(12, vinfo("y", (T, 1, N, H)))
+    return model(g), W, R, B
+
+
+def test_foreign_gru_import():
+    rng = np.random.RandomState(7)
+    T, N, I, H = 4, 2, 3, 5
+    data, W, R, B = _gru_bytes(rng, T, N, I, H)
+    path = os.path.join(FIXDIR, "foreign_gru.onnx")
+    write_or_verify(path, data)
+    s, arg, aux = mx.onnx.import_model(path)
+    x = rng.randn(T, N, I).astype(np.float32)
+    args = {"x": nd.array(x)}
+    args.update(arg)
+    out = s.bind(mx.cpu(), args).forward()[0].asnumpy()
+    want = _gru_ref(x, np.zeros((N, H), np.float32), W, R,
+                    B[:3 * H], B[3 * H:], H)
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+def test_foreign_gru_lbr0_refused():
+    """linear_before_reset=0 math differs from our cuDNN-semantics
+    kernel: the importer must refuse, not silently mis-import."""
+    rng = np.random.RandomState(7)
+    H = 5
+    W = rng.randn(3 * H, 3).astype(np.float32)
+    R = rng.randn(3 * H, H).astype(np.float32)
+    g = b""
+    g += f_msg(1, node("GRU", ["x", "W", "R"], ["y"], "gru",
+                       [attr_int("hidden_size", H)]))
+    g += f_str(2, "gru_lbr0")
+    g += f_msg(5, tensor("W", W[None])) + f_msg(5, tensor("R", R[None]))
+    g += f_msg(11, vinfo("x", (2, 2, 3)))
+    g += f_msg(12, vinfo("y", (2, 1, 2, H)))
+    import tempfile
+    path = os.path.join(tempfile.mkdtemp(), "lbr0.onnx")
+    with open(path, "wb") as f:
+        f.write(model(g))
+    with pytest.raises(Exception, match="linear_before_reset"):
+        mx.onnx.import_model(path)
