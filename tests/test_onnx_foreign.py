@@ -223,3 +223,38 @@ def test_foreign_gru_lbr0_refused():
         f.write(model(g))
     with pytest.raises(Exception, match="linear_before_reset"):
         mx.onnx.import_model(path)
+
+
+def test_foreign_lstm_no_initial_states_binds_clean():
+    """Foreign LSTMs commonly omit initial_h/initial_c: the importer must
+    synthesize spec-mandated zeros for BOTH, value-blind (an inf in the
+    data must not poison the zero state), leaving no hidden free vars."""
+    rng = np.random.RandomState(3)
+    T, N, I, H = 3, 2, 4, 5
+    W = (rng.randn(4 * H, I) * 0.3).astype(np.float32)
+    R = (rng.randn(4 * H, H) * 0.3).astype(np.float32)
+    g = b""
+    g += f_msg(1, node("LSTM", ["x", "W", "R"], ["y"], "lstm",
+                       [attr_int("hidden_size", H)]))
+    g += f_str(2, "lstm_nostate")
+    g += f_msg(5, tensor("W", W[None])) + f_msg(5, tensor("R", R[None]))
+    g += f_msg(11, vinfo("x", (T, N, I)))
+    g += f_msg(12, vinfo("y", (T, 1, N, H)))
+    import tempfile
+    path = os.path.join(tempfile.mkdtemp(), "lstm_nostate.onnx")
+    with open(path, "wb") as f:
+        f.write(model(g))
+    s, arg, aux = mx.onnx.import_model(path)
+    x = rng.randn(T, N, I).astype(np.float32)
+    args = {"x": nd.array(x)}
+    args.update(arg)
+    out = s.bind(mx.cpu(), args).forward()[0].asnumpy()  # binds: no free vars
+    assert np.isfinite(out).all()
+    # value-blind zero states: an inf in timestep 0 must only affect the
+    # lanes the recurrence actually touches, not the h0/c0 synthesis
+    x_inf = x.copy()
+    x_inf[0, 0, 0] = np.inf
+    args2 = {"x": nd.array(x_inf)}
+    args2.update(arg)
+    out2 = s.bind(mx.cpu(), args2).forward()[0].asnumpy()
+    assert np.isfinite(out2[:, :, 1]).all()   # batch element 1 untouched

@@ -1,0 +1,149 @@
+"""The data-drop activation contract, proven end-to-end OFFLINE.
+
+tests/test_real_data.py's gates have never run because no drop exists.
+This meta-test synthesizes a learnable MNIST-shaped idx drop, a PTB-shaped
+corpus and a VOC2007-shaped detection set, lays them out with
+tools/prepare_data.py, and then RUNS the real-data gates against the
+result in a subprocess — so the entire activation path (layout
+validation -> gz idx readers -> corpus reader -> VOC XML parse ->
+det-rec pack -> gates) is exercised every round, and a real drop only
+changes the numbers, not the code path.
+"""
+import gzip
+import os
+import struct
+import subprocess
+import sys
+
+import numpy as np
+from PIL import Image
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write_idx_images(path, imgs):
+    n, h, w = imgs.shape
+    with gzip.open(path, "wb") as f:
+        f.write(struct.pack(">IIII", 0x803, n, h, w))
+        f.write(imgs.astype(np.uint8).tobytes())
+
+
+def _write_idx_labels(path, labels):
+    with gzip.open(path, "wb") as f:
+        f.write(struct.pack(">II", 0x801, len(labels)))
+        f.write(labels.astype(np.uint8).tobytes())
+
+
+def _make_mnist(dirpath, n_train=4096, n_test=512):
+    """Learnable MNIST stand-in: each digit a fixed 28x28 prototype plus
+    noise, so the config-0 accuracy gate can actually reach its bar."""
+    os.makedirs(dirpath, exist_ok=True)
+    protos = (np.random.RandomState(42).rand(10, 28, 28) * 255)
+
+    def make(n, seed):
+        rng = np.random.RandomState(seed)
+        y = rng.randint(0, 10, n)
+        x = protos[y] * 0.6 + rng.rand(n, 28, 28) * 255 * 0.4
+        return np.clip(x, 0, 255), y
+
+    xtr, ytr = make(n_train, 0)
+    xte, yte = make(n_test, 1)
+    _write_idx_images(os.path.join(dirpath,
+                                   "train-images-idx3-ubyte.gz"), xtr)
+    _write_idx_labels(os.path.join(dirpath,
+                                   "train-labels-idx1-ubyte.gz"), ytr)
+    _write_idx_images(os.path.join(dirpath,
+                                   "t10k-images-idx3-ubyte.gz"), xte)
+    _write_idx_labels(os.path.join(dirpath,
+                                   "t10k-labels-idx1-ubyte.gz"), yte)
+
+
+def _make_ptb(dirpath):
+    """Highly regular corpus: the perplexity gate's bar (<300) is easy
+    for structured text, which is the point — the gate must RUN."""
+    os.makedirs(dirpath, exist_ok=True)
+    rng = np.random.RandomState(0)
+    words = ["the", "cat", "dog", "sat", "ran", "on", "mat", "log",
+             "a", "and"]
+    def corpus(n):
+        toks = []
+        for _ in range(n):
+            s = rng.randint(0, len(words) - 1)
+            toks += [words[s], words[(s + 1) % len(words)],
+                     words[(s + 2) % len(words)]]
+        return " ".join(toks)
+    with open(os.path.join(dirpath, "ptb.train.txt"), "w") as f:
+        f.write(corpus(40000))
+    with open(os.path.join(dirpath, "ptb.valid.txt"), "w") as f:
+        f.write(corpus(2000))
+
+
+def _make_voc(dirpath, n=24, edge=200):
+    """VOC2007-shaped drop: JPEGs with one bright box each + matching
+    annotation XMLs and trainval split."""
+    ann = os.path.join(dirpath, "Annotations")
+    jpg = os.path.join(dirpath, "JPEGImages")
+    split = os.path.join(dirpath, "ImageSets", "Main")
+    for d in (ann, jpg, split):
+        os.makedirs(d, exist_ok=True)
+    rng = np.random.RandomState(3)
+    ids = []
+    for i in range(n):
+        img_id = "%06d" % i
+        ids.append(img_id)
+        img = np.full((edge, edge, 3), 40, np.uint8)
+        bw = rng.randint(edge // 4, edge // 2)
+        x0 = rng.randint(0, edge - bw)
+        y0 = rng.randint(0, edge - bw)
+        img[y0:y0 + bw, x0:x0 + bw] = 230
+        Image.fromarray(img).save(os.path.join(jpg, img_id + ".jpg"),
+                                  quality=90)
+        cls = ["cat", "dog"][i % 2]
+        xml = ("<annotation><size><width>%d</width><height>%d</height>"
+               "<depth>3</depth></size><object><name>%s</name><bndbox>"
+               "<xmin>%d</xmin><ymin>%d</ymin><xmax>%d</xmax>"
+               "<ymax>%d</ymax></bndbox></object></annotation>"
+               % (edge, edge, cls, x0 + 1, y0 + 1, x0 + bw, y0 + bw))
+        with open(os.path.join(ann, img_id + ".xml"), "w") as f:
+            f.write(xml)
+    with open(os.path.join(split, "trainval.txt"), "w") as f:
+        f.write("\n".join(ids) + "\n")
+    with open(os.path.join(split, "test.txt"), "w") as f:
+        f.write("\n".join(ids[: n // 4]) + "\n")
+
+
+def test_prepare_data_layout_and_gates_run(tmp_path):
+    # 1. scatter a synthetic "downloads" directory
+    src = tmp_path / "downloads"
+    _make_mnist(str(src / "somewhere" / "deep"))
+    _make_ptb(str(src / "simple-examples" / "data"))
+    _make_voc(str(src / "VOCdevkit" / "VOC2007"))
+
+    # 2. prepare_data converts it into the documented layout
+    target = tmp_path / "data"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "prepare_data.py"),
+         str(src), str(target)],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "mnist: OK" in r.stdout
+    assert "ptb: OK" in r.stdout
+    assert "voc: OK" in r.stdout
+
+    # 3. --check agrees
+    r2 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "prepare_data.py"),
+         "--check", str(target)], capture_output=True, text=True, cwd=REPO)
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+
+    # 4. the real-data gates RUN against the drop (no skips)
+    env = dict(os.environ, MX_DATA_DIR=str(target),
+               JAX_PLATFORMS="cpu", MX_FORCE_CPU="1")
+    r3 = subprocess.run(
+        [sys.executable, "-m", "pytest", "-q", "--no-header",
+         "-p", "no:cacheprovider", "tests/test_real_data.py"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=1500)
+    tail = r3.stdout.strip().splitlines()[-1] if r3.stdout.strip() else ""
+    assert r3.returncode == 0, r3.stdout[-3000:] + r3.stderr[-2000:]
+    assert "skipped" not in tail, tail
+    assert "3 passed" in tail, tail

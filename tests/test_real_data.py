@@ -6,9 +6,11 @@ and run the moment a data drop appears at ``MX_DATA_DIR``:
 
     MX_DATA_DIR=/data python -m pytest tests/test_real_data.py
 
-Expected layout:
+Expected layout (tools/prepare_data.py validates/creates it):
   $MX_DATA_DIR/mnist/train-images-idx3-ubyte(.gz) + the other 3 idx files
   $MX_DATA_DIR/ptb/ptb.train.txt + ptb.valid.txt
+  $MX_DATA_DIR/voc/VOC2007/{Annotations,JPEGImages,ImageSets/Main}
+      (config 4: the SSD data-path gate)
 """
 import os
 
@@ -103,3 +105,106 @@ def test_ptb_lstm_perplexity_descends():
         losses.append(float(loss.mean().asnumpy().item()))
     ppl = float(np.exp(np.mean(losses[-20:])))
     assert ppl < 300, ppl
+
+
+VOC_DIR = os.path.join(DATA_DIR or "", "voc", "VOC2007")
+
+
+def _voc_to_det_rec(tmp_path, n_images=48, edge=256):
+    """VOC2007 drop -> indexed det .rec in the reference --pack-label
+    format (class_id + normalized boxes), via the real annotation XMLs."""
+    import xml.etree.ElementTree as ET
+    from mxnet_tpu import recordio
+    from PIL import Image
+
+    classes = ["aeroplane", "bicycle", "bird", "boat", "bottle", "bus",
+               "car", "cat", "chair", "cow", "diningtable", "dog",
+               "horse", "motorbike", "person", "pottedplant", "sheep",
+               "sofa", "train", "tvmonitor"]
+    cls_of = {c: i for i, c in enumerate(classes)}
+    with open(os.path.join(VOC_DIR, "ImageSets", "Main",
+                           "trainval.txt")) as f:
+        ids = [l.strip().split()[0] for l in f if l.strip()][:n_images]
+    prefix = os.path.join(str(tmp_path), "voc_det")
+    w = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    kept = 0
+    for i, img_id in enumerate(ids):
+        xml = os.path.join(VOC_DIR, "Annotations", img_id + ".xml")
+        jpg = os.path.join(VOC_DIR, "JPEGImages", img_id + ".jpg")
+        if not (os.path.exists(xml) and os.path.exists(jpg)):
+            continue
+        root = ET.parse(xml).getroot()
+        size = root.find("size")
+        W = float(size.find("width").text)
+        H = float(size.find("height").text)
+        label = [2.0, 5.0]
+        for obj in root.iter("object"):
+            name = obj.find("name").text.strip().lower()
+            if name not in cls_of:
+                continue
+            bb = obj.find("bndbox")
+            label += [float(cls_of[name]),
+                      float(bb.find("xmin").text) / W,
+                      float(bb.find("ymin").text) / H,
+                      float(bb.find("xmax").text) / W,
+                      float(bb.find("ymax").text) / H]
+        if len(label) == 2:
+            continue
+        img = np.asarray(Image.open(jpg).convert("RGB").resize(
+            (edge, edge)), np.uint8)
+        w.write_idx(kept, recordio.pack_img(
+            recordio.IRHeader(0, label, kept, 0), img, quality=85))
+        kept += 1
+    w.close()
+    return prefix, kept, len(classes)
+
+
+def test_ssd_voc_pipeline_parity(tmp_path):
+    """BASELINE config 4 drop contract: real VOC2007 annotations/images
+    flow through pack_img -> ImageDetIter -> SSD targets -> loss descent
+    and the VOC07 mAP metric accepts the resulting detections.  (The
+    full-mAP parity number needs the full 16h train; this gate proves
+    the data path end-to-end on the real files.)"""
+    if not os.path.isdir(VOC_DIR):
+        pytest.skip("no voc/VOC2007 under MX_DATA_DIR "
+                    "(tools/prepare_data.py lays it out)")
+    from mxnet_tpu.gluon.model_zoo.ssd import SSDMultiBoxLoss, ssd_toy
+    from mxnet_tpu.image.detection import ImageDetIter
+    from mxnet_tpu.metric import VOC07MApMetric
+
+    edge = 128
+    prefix, kept, n_classes = _voc_to_det_rec(tmp_path, edge=edge)
+    assert kept >= 8, "VOC drop yielded too few readable images"
+    it = ImageDetIter(path_imgrec=prefix + ".rec", batch_size=8,
+                      data_shape=(3, edge, edge), shuffle=True,
+                      rand_mirror=True)
+    net = ssd_toy(classes=n_classes)
+    net.initialize(mx.init.Xavier())
+    loss_fn = SSDMultiBoxLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9})
+    losses = []
+    for epoch in range(4):
+        it.reset()
+        for batch in it:
+            x = batch.data[0] / 255.0
+            y = batch.label[0]
+            with autograd.record():
+                anchors, cls_preds, box_preds = net(x)
+                loc_t, loc_m, cls_t = net.targets(anchors, cls_preds, y)
+                loss = loss_fn(cls_preds, box_preds, cls_t, loc_t, loc_m)
+            loss.backward()
+            trainer.step(x.shape[0])
+            losses.append(float(loss.mean().asnumpy().item()))
+    assert losses[-1] < losses[0], losses[:3] + losses[-3:]
+
+    # detections run through the VOC07 metric: label (A, 5) [cls, box],
+    # pred (A, 6) [cls, score, box] per the metric's convention
+    m = VOC07MApMetric(iou_thresh=0.5)
+    it.reset()
+    batch = next(it)
+    anchors, cls_preds, box_preds = net(batch.data[0] / 255.0)
+    det = net.detect(anchors, cls_preds, box_preds)
+    m.update([batch.label[0]], [det])
+    name, value = m.get()
+    assert np.isfinite(value)
