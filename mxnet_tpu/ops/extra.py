@@ -197,7 +197,11 @@ def _fmod(a, b):
 
 @register("heaviside")
 def _heaviside(a, b):
-    return jnp.heaviside(a, b)
+    # numpy: heaviside(nan, h) is nan; jnp.heaviside returns h there
+    out = jnp.heaviside(a, b)
+    if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating):
+        out = jnp.where(jnp.isnan(a), jnp.nan, out)
+    return out
 
 
 @register("copysign")

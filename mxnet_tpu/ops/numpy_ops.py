@@ -54,9 +54,17 @@ def _unary(jfn):
     return fn
 
 
+def _np_conjugate(a):
+    # numpy promotes bool input to int8; jnp keeps bool
+    out = jnp.conjugate(a)
+    if out.dtype == jnp.bool_:
+        out = out.astype(jnp.int8)
+    return out
+
+
 _UNARY_DIFF = {
     "absolute": jnp.absolute, "fabs": jnp.fabs, "negative": jnp.negative,
-    "positive": jnp.positive, "conjugate": jnp.conjugate,
+    "positive": jnp.positive, "conjugate": _np_conjugate,
     "exp": jnp.exp, "exp2": jnp.exp2, "expm1": jnp.expm1,
     "log": jnp.log, "log2": jnp.log2, "log10": jnp.log10, "log1p": jnp.log1p,
     "sqrt": jnp.sqrt, "cbrt": jnp.cbrt, "square": jnp.square,
@@ -70,10 +78,23 @@ _UNARY_DIFF = {
     "sinc": jnp.sinc, "i0": jnp.i0,
 }
 
+def _as_float_round(jfn):
+    # numpy's round family PROMOTES integer/bool input to float output;
+    # jnp passes ints through unchanged
+    def fn(a):
+        if not jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating):
+            a = jnp.asarray(a).astype(jnp.float32)
+        return jfn(a)
+    return fn
+
+
 _UNARY_NONDIFF = {
     "sign": jnp.sign, "signbit": jnp.signbit,
-    "floor": jnp.floor, "ceil": jnp.ceil, "trunc": jnp.trunc,
-    "rint": jnp.rint, "fix": jnp.trunc,  # np.fix == truncate toward zero
+    "floor": _as_float_round(jnp.floor),
+    "ceil": _as_float_round(jnp.ceil),
+    "trunc": _as_float_round(jnp.trunc),
+    "rint": jnp.rint,
+    "fix": _as_float_round(jnp.trunc),  # np.fix == truncate toward zero
     "isnan": jnp.isnan, "isinf": jnp.isinf, "isfinite": jnp.isfinite,
     "isneginf": jnp.isneginf, "isposinf": jnp.isposinf,
     "logical_not": jnp.logical_not, "bitwise_not": jnp.bitwise_not,
@@ -134,10 +155,14 @@ _BINARY_DIFF = {
     "copysign": jnp.copysign,
 }
 
+# ONE nan-propagating heaviside serves both the legacy "heaviside" op
+# (ops/extra.py registration) and the _npi_ numpy layer
+from .extra import _heaviside as _np_heaviside  # noqa: E402
+
 _BINARY_NONDIFF = {
     "floor_divide": jnp.floor_divide, "remainder": jnp.remainder,
     "fmod": jnp.fmod, "nextafter": jnp.nextafter, "ldexp": jnp.ldexp,
-    "heaviside": jnp.heaviside,
+    "heaviside": _np_heaviside,
     "gcd": jnp.gcd, "lcm": jnp.lcm,
     "bitwise_and": jnp.bitwise_and, "bitwise_or": jnp.bitwise_or,
     "bitwise_xor": jnp.bitwise_xor,

@@ -112,12 +112,25 @@ def _make_voc(dirpath, n=24, edge=200):
         f.write("\n".join(ids[: n // 4]) + "\n")
 
 
+def _make_image_tree(dirpath, classes=3, per_class=4, edge=48):
+    """class-subdirectory image layout: the im2rec packing input."""
+    rng = np.random.RandomState(5)
+    for c in range(classes):
+        d = os.path.join(dirpath, "class%d" % c)
+        os.makedirs(d, exist_ok=True)
+        for i in range(per_class):
+            img = (rng.rand(edge, edge, 3) * 255).astype(np.uint8)
+            Image.fromarray(img).save(
+                os.path.join(d, "img%d.jpg" % i), quality=85)
+
+
 def test_prepare_data_layout_and_gates_run(tmp_path):
     # 1. scatter a synthetic "downloads" directory
     src = tmp_path / "downloads"
     _make_mnist(str(src / "somewhere" / "deep"))
     _make_ptb(str(src / "simple-examples" / "data"))
     _make_voc(str(src / "VOCdevkit" / "VOC2007"))
+    _make_image_tree(str(src / "raw_images"))
 
     # 2. prepare_data converts it into the documented layout
     target = tmp_path / "data"
@@ -129,6 +142,14 @@ def test_prepare_data_layout_and_gates_run(tmp_path):
     assert "mnist: OK" in r.stdout
     assert "ptb: OK" in r.stdout
     assert "voc: OK" in r.stdout
+    # the image tree was packed through im2rec into train.rec
+    assert "imagenet: train.rec present" in r.stdout, r.stdout
+    from mxnet_tpu.io import ImageRecordIter
+    it = ImageRecordIter(
+        path_imgrec=str(target / "imagenet" / "train.rec"),
+        data_shape=(3, 32, 32), batch_size=4)
+    b = next(it)
+    assert b.data[0].shape == (4, 3, 32, 32)
 
     # 3. --check agrees
     r2 = subprocess.run(

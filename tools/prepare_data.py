@@ -78,6 +78,7 @@ def check(target):
             err = _check_idx_magic(hit, 3 if "images" in name else 1)
             if err:
                 problems.append(err)
+                mnist_ok = False
     if mnist_ok:
         print("mnist: OK (config 0 accuracy gate will run)")
     ptb_ok = True
@@ -88,6 +89,7 @@ def check(target):
             ptb_ok = False
         elif os.path.getsize(p) < 1000:
             problems.append("ptb: %s is suspiciously small" % name)
+            ptb_ok = False
     if ptb_ok:
         print("ptb: OK (config 3 perplexity gate will run)")
     voc = os.path.join(target, "voc", "VOC2007")
@@ -162,7 +164,7 @@ def convert(source, target):
                 [sys.executable,
                  os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "im2rec.py"),
-                 prefix, cand, "--recursive", "--pack-label"],
+                 prefix, cand, "--quality", "90"],
                 check=True)
             break
 
