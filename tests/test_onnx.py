@@ -408,3 +408,35 @@ def test_dynamic_batch_axis_export(tmp_path):
         mx.onnx.export_model(_mlp(), params, input_shapes=[(2, 4)],
                              onnx_file_path=str(tmp_path / "dyn3.onnx"),
                              dynamic=True)
+
+
+def test_deconvolution_clip_pad_roundtrip(tmp_path):
+    """Deconvolution<->ConvTranspose (incl. adj/output_padding), clip and
+    Pad round-trip numerically."""
+    rng = np.random.RandomState(4)
+    data = sym.Variable("data")
+    h = sym.Deconvolution(data, sym.Variable("dc_weight"), kernel=(3, 3),
+                          stride=(2, 2), pad=(1, 1), adj=(1, 1),
+                          num_filter=5, no_bias=True, name="dc")
+    h = sym.clip(h, a_min=-0.4, a_max=0.6, name="cl")
+    out = sym.Pad(h, mode="constant", constant_value=0.25,
+                  pad_width=(0, 0, 0, 0, 1, 2, 1, 2), name="pd")
+    params = {"dc_weight": rng.randn(4, 5, 3, 3).astype(np.float32) * 0.3}
+    path = str(tmp_path / "dcp.onnx")
+    mx.onnx.export_model(out, params, input_shapes=[(2, 4, 7, 7)],
+                         onnx_file_path=path)
+    s2, arg2, aux2 = mx.onnx.import_model(path)
+    x = rng.randn(2, 4, 7, 7).astype(np.float32)
+    np.testing.assert_allclose(_forward(s2, arg2, x),
+                               _forward(out, params, x),
+                               rtol=1e-5, atol=1e-5)
+    # edge-mode Pad too (no constant_value input)
+    out2 = sym.Pad(sym.Variable("data"), mode="edge",
+                   pad_width=(0, 0, 0, 0, 2, 2, 2, 2), name="pe")
+    path2 = str(tmp_path / "pe.onnx")
+    mx.onnx.export_model(out2, {}, input_shapes=[(1, 2, 4, 4)],
+                         onnx_file_path=path2)
+    s3, arg3, _ = mx.onnx.import_model(path2)
+    x2 = rng.randn(1, 2, 4, 4).astype(np.float32)
+    np.testing.assert_allclose(_forward(s3, arg3, x2),
+                               _forward(out2, {}, x2), rtol=1e-6)
