@@ -458,6 +458,66 @@ class KVStoreDistAsync(KVStore):
         import zlib
         return zlib.crc32(str(key).encode()) % len(self._socks)
 
+    # -- big-array sharding (reference: MXNET_KVSTORE_BIGARRAY_BOUND in
+    # kvstore_dist.h — tensors over the bound split EVENLY across ALL
+    # servers instead of hashing whole to one) -----------------------------
+    @property
+    def _bigarray_bound(self):
+        import os
+        return int(os.environ.get("MXNET_KVSTORE_BIGARRAY_BOUND",
+                                  1_000_000))
+
+    def _shard_plan(self, size):
+        """[(server, start, stop)] flat slices, or None for whole-key
+        routing.  Deterministic in (size, n_servers, bound) so every
+        worker computes the same plan with no coordination."""
+        n_srv = len(self._socks)
+        if n_srv <= 1 or size < self._bigarray_bound:
+            return None
+        bounds = [size * i // n_srv for i in range(n_srv + 1)]
+        return [(i, bounds[i], bounds[i + 1]) for i in range(n_srv)
+                if bounds[i + 1] > bounds[i]]
+
+    @staticmethod
+    def _part_key(key, i):
+        return "%s::part%d" % (key, i)
+
+    def _send_np(self, cmd, k, arr_np):
+        """INIT/PUSH routing: whole key by hash, or sliced across all
+        servers when over the big-array bound."""
+        plan = self._shard_plan(arr_np.size)
+        if plan is None:
+            self._rpc(cmd, k, arr_np)
+            return
+        flat = arr_np.ravel()
+        for i, s, e in plan:
+            self._rpc_on(i, cmd, self._part_key(k, i), flat[s:e])
+
+    def _pull_np(self, k, shape, size):
+        import numpy as _onp
+        plan = self._shard_plan(size)
+        if plan is None:
+            return self._rpc("PULL", k)
+        # pipeline: issue every part request on its own socket FIRST,
+        # then collect replies — wall-clock ~max(parts), not sum(parts)
+        # (the concurrency is the point of big-array sharding)
+        with self._lock:
+            for i, _s, _e in plan:
+                if self._socks[i] is None:
+                    raise MXNetError("dist_async connection %d is closed"
+                                     % i)
+                self._srv_mod.send_msg(self._socks[i],
+                                       ("PULL", self._part_key(k, i)))
+            parts = []
+            for i, _s, _e in plan:
+                ok, payload = self._srv_mod.recv_msg(self._socks[i])
+                if not ok:
+                    raise MXNetError("dist_async server %d: %s"
+                                     % (i, payload))
+                parts.append(payload)
+        return _onp.concatenate(
+            [_onp.asarray(p).ravel() for p in parts]).reshape(shape)
+
     def _rpc_on(self, idx, *msg):
         import socket as _socket
         with self._lock:
@@ -502,7 +562,7 @@ class KVStoreDistAsync(KVStore):
         keys, values = self._normalize(key, value)
         for k, v in zip(keys, values):
             vv = v[0] if isinstance(v, (list, tuple)) else v
-            self._rpc("INIT", k, vv.asnumpy())
+            self._send_np("INIT", k, vv.asnumpy())
             self._store[k] = vv.copy()       # local mirror for shape/dtype
 
     def push(self, key, value, priority=0):
@@ -510,13 +570,14 @@ class KVStoreDistAsync(KVStore):
         for k, v in zip(keys, values):
             merged = self._reduce(v if isinstance(v, (list, tuple)) else [v],
                                   key=k)
-            self._rpc("PUSH", k, merged.asnumpy())
+            self._send_np("PUSH", k, merged.asnumpy())
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs = self._normalize(key, out)
         for k, o in zip(keys, outs):
-            arr = self._rpc("PULL", k)
             targets = o if isinstance(o, (list, tuple)) else [o]
+            arr = self._pull_np(k, targets[0].shape,
+                                int(targets[0].size))
             for t in targets:
                 t._set_jax(nd.array(arr).astype(t.dtype)._jax)
 
@@ -526,7 +587,13 @@ class KVStoreDistAsync(KVStore):
         moved past)."""
         keys, outs = self._normalize(key, out)
         for k in keys:
-            arr = self._rpc("PULL", k)
+            mirror = self._store.get(k)
+            if mirror is not None:           # init populated shape/dtype
+                arr = self._pull_np(k, mirror.shape, int(mirror.size))
+            else:
+                # key init'd by another worker only: whole-key pull (a
+                # big SHARDED key still needs a local init for its shape)
+                arr = self._rpc("PULL", k)
             self._store[k] = nd.array(arr)     # refresh mirror, then gather
         return super().row_sparse_pull(key, out=out, priority=priority,
                                        row_ids=row_ids)

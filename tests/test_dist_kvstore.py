@@ -429,3 +429,56 @@ def test_dist_async_two_servers_key_sharding(tmp_path):
                        env=env)
     assert r.returncode == 0, (r.stdout, r.stderr)
     assert r.stdout.count("SHARDED_PS_OK") == 2
+
+
+def test_dist_async_bigarray_shards_across_servers(tmp_path):
+    """MXNET_KVSTORE_BIGARRAY_BOUND (reference kvstore_dist.h): tensors
+    over the bound split EVENLY across ALL servers (flat slices) instead
+    of hashing whole to one; small tensors keep whole-key routing; the
+    server-side optimizer updates each slice correctly."""
+    import textwrap as tw
+    script = tmp_path / "w.py"
+    script.write_text(tw.dedent(_PRELUDE) + tw.dedent("""
+        from mxnet_tpu import kvstore, optimizer
+        kv = kvstore.create("dist_async")
+        assert len(kv._socks) == 2
+        assert kv._bigarray_bound == 10        # env reached the store
+        rank = kv.rank
+        kv.set_optimizer(optimizer.SGD(learning_rate=0.5))
+
+        big = np.arange(24, dtype=np.float32).reshape(4, 6)  # 24 >= 10
+        small = np.ones(3, np.float32)
+        kv.init("big", nd.array(big))
+        kv.init("small", nd.array(small))
+        kv._barrier()
+
+        # each server holds ONLY its slice: part keys answer directly
+        p0 = np.asarray(kv._rpc_on(0, "PULL", "big::part0")).ravel()
+        p1 = np.asarray(kv._rpc_on(1, "PULL", "big::part1")).ravel()
+        np.testing.assert_allclose(p0, np.arange(12, dtype=np.float32))
+        np.testing.assert_allclose(p1,
+                                   np.arange(12, 24, dtype=np.float32))
+
+        kv.push("big", nd.array(np.ones((4, 6), np.float32)))
+        kv.push("small", nd.array(np.ones(3, np.float32)))
+        kv._barrier()
+        out = nd.zeros((4, 6))
+        kv.pull("big", out=out)
+        # 2 workers pushed grad=1 each at lr 0.5 -> value - 1.0
+        np.testing.assert_allclose(out.asnumpy(), big - 1.0, rtol=1e-6)
+        outs = nd.zeros((3,))
+        kv.pull("small", out=outs)
+        np.testing.assert_allclose(outs.asnumpy(), small - 1.0, rtol=1e-6)
+        print("BIGARRAY_OK rank", rank, flush=True)
+    """))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["MXNET_KVSTORE_BIGARRAY_BOUND"] = "10"
+    r = subprocess.run([sys.executable,
+                        os.path.join(REPO, "tools", "launch.py"),
+                        "-n", "2", "-s", "2", "--launcher", "local", "--",
+                        sys.executable, str(script)],
+                       capture_output=True, text=True, timeout=300,
+                       env=env)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert r.stdout.count("BIGARRAY_OK") == 2
