@@ -139,6 +139,16 @@ class Symbol:
         """All intermediate outputs (reference: Symbol.get_internals)."""
         return Symbol([(n, 0) for n in _topo(self._heads)])
 
+    def get_children(self) -> Optional["Symbol"]:
+        """Direct input symbols of the head op (reference:
+        Symbol.get_children; None for leaf variables)."""
+        kids = []
+        for n, _i in self._heads:
+            kids.extend(n.inputs)
+        if not kids:
+            return None
+        return Symbol([(c, i) for c, i in kids])
+
     def list_outputs(self) -> List[str]:
         return ["%s_output" % n.name if n.op != "null" else n.name
                 for n, _ in self._heads]
@@ -224,10 +234,16 @@ class Symbol:
         list_arguments()/list_outputs()/list_auxiliary_states()."""
         return self._infer(known, want="shape")
 
+    def infer_shape_partial(self, **known):
+        """Reference: Symbol.infer_shape_partial — like infer_shape but
+        arguments/outputs the rules cannot reach come back as () instead
+        of raising (the classic pre-bind diagnostic)."""
+        return self._infer(known, want="shape", partial=True)
+
     def infer_type(self, **known):
         return self._infer(known, want="dtype")
 
-    def _infer(self, known, want: str):
+    def _infer(self, known, want: str, partial: bool = False):
         nodes = _topo(self._heads)
         avals: Dict[int, List[jax.ShapeDtypeStruct]] = {}
         for n in nodes:
@@ -254,8 +270,29 @@ class Symbol:
                 val = _np.asarray(_attr_parse(n.attrs["value"]), _np.float32)
                 avals[id(n)] = [jax.ShapeDtypeStruct(val.shape, val.dtype)]
             else:
+                if partial:
+                    # backward param rules derive weight shapes from the
+                    # FIRST (data) input: with it unknown — or any op
+                    # input unknown — this node's outputs stay unknown
+                    first_unknown = bool(n.inputs) and \
+                        avals.get(id(n.inputs[0][0])) is None
+                    op_unknown = any(avals.get(id(c)) is None
+                                     for c, _i in n.inputs
+                                     if c.op not in ("null",))
+                    if first_unknown or op_unknown:
+                        avals[id(n)] = None      # unknown propagates
+                        continue
                 _infer_param_inputs(n, avals)
+                if partial and any(avals.get(id(c)) is None
+                                   for c, _i in n.inputs):
+                    avals[id(n)] = None
+                    continue
                 avals[id(n)] = _node_eval_shape(n, avals)
+        if partial:
+            unknown = jax.ShapeDtypeStruct((), jnp.float32)
+            for n in nodes:
+                if avals.get(id(n)) is None:
+                    avals[id(n)] = [unknown]
         for n in nodes:
             if avals.get(id(n)) is None:
                 raise MXNetError(

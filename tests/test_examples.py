@@ -141,3 +141,11 @@ def test_feedforward_mnist_example():
     out = _run("train_mnist_feedforward.py", "--epochs", "4")
     assert "final test accuracy" in out
     assert "checkpoint roundtrip OK" in out
+
+
+def test_long_context_example():
+    out = _run("train_long_context.py", "--seq-len", "128", "--steps",
+               "30", "--batch", "2", "--d-model", "32", "--heads", "2",
+               "--layers", "1")
+    assert "final loss" in out
+    assert "sp=2" in out

@@ -260,3 +260,27 @@ def test_symbolblock_imports_classic_autovar_net():
         onp.testing.assert_allclose(sb(x).asnumpy(),
                                     exe.forward(data=x)[0].asnumpy(),
                                     atol=1e-5)
+
+
+def test_infer_shape_partial_and_get_children():
+    """Reference Symbol.infer_shape_partial: unreached args/outputs come
+    back as () instead of raising; get_children returns the head op's
+    direct inputs (None for leaves)."""
+    d = sym.Variable("data")
+    o = sym.Activation(sym.FullyConnected(d, num_hidden=3, name="pfc"),
+                       act_type="relu", name="pact")
+    args, outs, _ = o.infer_shape_partial()
+    assert args == [(), (), ()] and outs == [()]
+    args, outs, _ = o.infer_shape_partial(data=(2, 4))
+    assert args == [(2, 4), (3, 4), (3,)] and outs == [(2, 3)]
+    # full inference still raises on unknowns
+    import pytest
+    from mxnet_tpu.base import MXNetError
+    with pytest.raises(MXNetError):
+        o.infer_shape()
+    kids = o.get_children()
+    assert kids.list_outputs() == ["pfc_output"]
+    assert sym.Variable("x").get_children() is None
+    # grandparents: children of children reach the leaf variables
+    gk = kids.get_children()
+    assert set(gk.list_outputs()) == {"data", "pfc_weight", "pfc_bias"}
