@@ -512,3 +512,17 @@ class Function:
                             jax_outs if not single else jax_outs[0],
                             ctx, name=type(self).__name__)
         return res
+
+
+def get_symbol(x):
+    """Reference: autograd.get_symbol — retrieve the recorded compute
+    history of an NDArray as a Symbol.  This rebuild's tape records jax
+    vjp closures, not named graph nodes, so the imperative history is
+    not reconstructible as a Symbol; the supported route to a symbolic
+    graph is HybridBlock.hybridize()+export (or SymbolBlock), which
+    trace through the same kernels with full fidelity."""
+    raise MXNetError(
+        "autograd.get_symbol is not supported on the TPU rebuild: the "
+        "autograd tape holds jax vjp closures, not graph nodes.  Use "
+        "net.hybridize() + net.export(...) (or gluon.SymbolBlock) to "
+        "obtain the symbolic graph of a computation.")

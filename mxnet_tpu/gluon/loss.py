@@ -20,7 +20,7 @@ __all__ = ["Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss",
            "SigmoidBCELoss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss",
            "KLDivLoss", "CTCLoss", "HuberLoss", "HingeLoss",
            "SquaredHingeLoss", "LogisticLoss", "TripletLoss",
-           "PoissonNLLLoss", "CosineEmbeddingLoss"]
+           "PoissonNLLLoss", "CosineEmbeddingLoss", "SDMLLoss"]
 
 
 def _apply_weighting(loss, weight=None, sample_weight=None):
@@ -290,3 +290,31 @@ class CosineEmbeddingLoss(Loss):
         loss = nd.where(label == 1, 1.0 - cos, (cos - self._margin).relu())
         loss = _apply_weighting(loss, self._weight, sample_weight)
         return loss
+
+
+class SDMLLoss(Loss):
+    """Smoothed deep metric learning loss (reference: gluon.loss.SDMLLoss):
+    treats a (x1[i], x2[i]) batch as N retrieval problems — the pairwise
+    distance matrix is turned into a distribution with softmax(-d) and
+    pulled toward a label-smoothed identity via KL divergence."""
+
+    def __init__(self, smoothing_parameter=0.3, weight=1.0, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._smoothing = smoothing_parameter
+        self._kl = KLDivLoss(from_logits=True)
+
+    def forward(self, x1, x2):
+        from .. import nd as _nd
+        n = x1.shape[0]
+        # squared euclidean distances between every (x1[i], x2[j]) pair
+        x1sq = (x1 * x1).sum(axis=1).reshape((n, 1))
+        x2sq = (x2 * x2).sum(axis=1).reshape((1, n))
+        dist = x1sq + x2sq - 2.0 * _nd.dot(x1, x2.T)
+        log_prob = _nd.log_softmax(-dist, axis=1)
+        # label-smoothed identity target: diagonal keeps 1-s, the rest
+        # shares s/(N-1)
+        eye = _nd.eye(n)
+        labels = eye * (1.0 - self._smoothing) + \
+            (1.0 - eye) * (self._smoothing / max(n - 1, 1))
+        return self._kl(log_prob, labels)

@@ -10,14 +10,14 @@ import sys
 from types import ModuleType
 
 from ..ops import registry as _registry
-from .ndarray import (NDArray, invoke, array, zeros, ones, full, empty, arange,
+from .ndarray import (NDArray, invoke, array, zeros, ones, full, empty, arange, eye,
                       zeros_like, ones_like, concatenate, save, load,
                       save_bytes, load_bytes, waitall, from_jax)
 from .ndarray import stack_arrays as _stack_arrays
 
 __all__ = ["NDArray", "invoke", "array", "zeros", "ones", "full", "empty",
-           "arange", "zeros_like", "ones_like", "concatenate", "save", "load",
-           "waitall"]
+           "arange", "eye", "zeros_like", "ones_like", "concatenate",
+           "save", "load", "waitall"]
 
 
 def _make_op_func(opname: str):

@@ -964,6 +964,13 @@ def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None) -> NDArra
     return NDArray(_put(vals, ctx), ctx=ctx)
 
 
+def eye(N, M=0, k=0, ctx=None, dtype=None) -> NDArray:
+    """Reference: mx.nd.eye (M=0 means square)."""
+    ctx = ctx or current_context()
+    vals = jnp.eye(int(N), int(M) or None, int(k), _creation_dtype(dtype))
+    return NDArray(_put(vals, ctx), ctx=ctx)
+
+
 def zeros_like(a: NDArray, **kw) -> NDArray:
     return zeros(a.shape, ctx=a.context, dtype=a.dtype)
 

@@ -166,3 +166,51 @@ for _dist, _fns in (("norm", ("pdf", "logpdf", "cdf", "logcdf")),
     setattr(stats, _dist, _dm)
     _sys.modules[_dm.__name__] = _dm
 _sys.modules[stats.__name__] = stats
+
+
+# -- 2.x npx surface stragglers ------------------------------------------
+def gamma(x):
+    """npx.gamma — the Gamma function (reference npx surface)."""
+    return invoke("gamma", x)
+
+
+def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    return invoke("arange_like", data, start=start, step=step,
+                  repeat=repeat, axis=axis)
+
+
+def broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None):
+    return invoke("broadcast_like", lhs, rhs,
+                  lhs_axes=tuple(lhs_axes) if lhs_axes is not None
+                  else None,
+                  rhs_axes=tuple(rhs_axes) if rhs_axes is not None
+                  else None)
+
+
+def reshape_like(lhs, rhs):
+    return invoke("reshape_like", lhs, rhs)
+
+
+def cpu(device_id=0):
+    from .device import cpu as _cpu
+    return _cpu(device_id)
+
+
+def gpu(device_id=0):
+    from .device import gpu as _gpu
+    return _gpu(device_id)
+
+
+def tpu(device_id=0):
+    from .device import tpu as _tpu
+    return _tpu(device_id)
+
+
+def num_gpus():
+    from .device import num_gpus as _n
+    return _n()
+
+
+def current_device():
+    from .device import current_context as _cc
+    return _cc()

@@ -352,3 +352,22 @@ def test_op_census_zero_missing_and_850_kernels(tmp_path):
         fn = getattr(spec, "fn", None) or spec
         uniq.add(id(fn))
     assert len(uniq) >= 850, len(uniq)
+
+
+def test_npx_stragglers_and_autograd_get_symbol():
+    """2.x npx surface stragglers route through the registry; nd.eye
+    matches numpy; autograd.get_symbol refuses with guidance."""
+    x = mx.nd.array(onp.array([[1.0, 2.0], [3.0, 4.0]], "float32"))
+    onp.testing.assert_allclose(npx.gamma(x).asnumpy(),
+                                ss.gamma(x.asnumpy()), rtol=1e-5)
+    al = npx.arange_like(x)
+    assert al.size == 4
+    rl = npx.reshape_like(mx.nd.array(onp.arange(4.0)), x)
+    assert rl.shape == (2, 2)
+    onp.testing.assert_allclose(mx.nd.eye(3, k=1).asnumpy(),
+                                onp.eye(3, k=1))
+    assert npx.num_gpus() == 0
+    assert npx.cpu().device_type == "cpu"
+    assert npx.current_device() is not None
+    with pytest.raises(Exception, match="hybridize"):
+        mx.autograd.get_symbol(x)
