@@ -104,3 +104,15 @@ class LogValidationMetricsCallback:
         for name, value in param.eval_metric.get_name_value():
             logging.info("Epoch[%d] Validation-%s=%f", param.epoch, name,
                          value)
+
+
+def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
+    """Reference: callback.module_checkpoint — epoch-end callback that
+    checkpoints a Module (symbol + params, optionally optimizer
+    states)."""
+    period = int(max(1, period))
+
+    def _callback(iter_no, sym=None, arg=None, aux=None):
+        if (iter_no + 1) % period == 0:
+            mod.save_checkpoint(prefix, iter_no + 1, save_optimizer_states)
+    return _callback

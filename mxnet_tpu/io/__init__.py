@@ -23,11 +23,12 @@ from typing import List, Optional, Sequence
 import numpy as _np
 
 from .. import ndarray as nd
+from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 from ..device import cpu
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "CSVIter", "ImageRecordIter", "LibSVMIter",
+           "PrefetchingIter", "CSVIter", "ImageRecordIter", "ImageRecordUInt8Iter", "LibSVMIter",
            "MNISTIter"]
 
 
@@ -597,3 +598,23 @@ class ImageRecordIter(DataIter):
             label=[nd.array(labels, ctx=cpu())],
             pad=pad, provide_data=self.provide_data,
             provide_label=self.provide_label)
+
+
+class ImageRecordUInt8Iter(ImageRecordIter):
+    """Reference: io.ImageRecordUInt8Iter — ImageRecordIter that hands
+    out RAW uint8 pixels (no mean/std normalization), for pipelines that
+    normalize on-device (e.g. the INT8 quantized path)."""
+
+    def __init__(self, *args, **kwargs):
+        for banned in ("mean_r", "mean_g", "mean_b",
+                       "std_r", "std_g", "std_b"):
+            if kwargs.pop(banned, 0):
+                raise MXNetError(
+                    "ImageRecordUInt8Iter hands out raw uint8 pixels; "
+                    "%s is not applicable (normalize on-device)" % banned)
+        if str(kwargs.pop("dtype", "uint8")) != "uint8":
+            raise MXNetError(
+                "ImageRecordUInt8Iter is uint8 by definition; use "
+                "ImageRecordIter for other dtypes")
+        kwargs["dtype"] = "uint8"
+        super().__init__(*args, **kwargs)

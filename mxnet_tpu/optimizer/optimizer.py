@@ -670,3 +670,135 @@ class Updater:
 
 def get_updater(optimizer: Optimizer) -> Updater:
     return Updater(optimizer)
+
+
+@register
+class FTML(Optimizer):
+    """Follow the Moving Leader (reference: optimizer.FTML →
+    ftml_update fused kernel)."""
+
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        z = nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        return (nd.zeros_like(z), nd.zeros_like(z), z)   # d, v, z
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        d, v, z = state
+        invoke("ftml_update", weight, grad, d, v, z,
+               lr=self._get_lr(index), beta1=self.beta1, beta2=self.beta2,
+               epsilon=self.epsilon, t=t, wd=self._get_wd(index),
+               rescale_grad=self.rescale_grad,
+               clip_grad=_clip(self.clip_gradient))
+
+
+@register
+class Adamax(Optimizer):
+    """Infinity-norm Adam variant (reference: optimizer.Adamax — python
+    update over nd ops, no fused kernel in the reference either)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context,
+                         dtype=weight.dtype),
+                nd.zeros(weight.shape, ctx=weight.context,
+                         dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index) / (1.0 - self.beta1 ** t)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            grad = invoke("clip", grad, a_min=-self.clip_gradient,
+                          a_max=self.clip_gradient)
+        m, u = state
+        m[:] = self.beta1 * m + (1.0 - self.beta1) * grad
+        u[:] = invoke("maximum", self.beta2 * u, invoke("abs", grad))
+        weight[:] = weight - lr * m / (u + 1e-8)
+
+
+@register
+class Nadam(Optimizer):
+    """Nesterov Adam (reference: optimizer.Nadam — momentum-schedule
+    python update)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context,
+                         dtype=weight.dtype),
+                nd.zeros(weight.shape, ctx=weight.context,
+                         dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            grad = invoke("clip", grad, a_min=-self.clip_gradient,
+                          a_max=self.clip_gradient)
+        mu_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        mu_tp1 = self.beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1)
+                                                    * self.schedule_decay))
+        self.m_schedule = self.m_schedule * mu_t
+        m_schedule_next = self.m_schedule * mu_tp1
+        m, v = state
+        m[:] = self.beta1 * m + (1.0 - self.beta1) * grad
+        v[:] = self.beta2 * v + (1.0 - self.beta2) * grad * grad
+        g_prime = grad / (1.0 - self.m_schedule)
+        m_prime = m / (1.0 - m_schedule_next)
+        v_prime = v / (1.0 - self.beta2 ** t)
+        m_bar = (1.0 - mu_t) * g_prime + mu_tp1 * m_prime
+        weight[:] = weight - lr * m_bar / (invoke("sqrt", v_prime)
+                                           + self.epsilon)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics (reference: optimizer.SGLD
+    — posterior sampling: half-lr gradient step + sqrt(lr) gaussian
+    noise)."""
+
+    def __init__(self, learning_rate=0.01, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        # reference order: clip the RESCALED gradient, then add the full
+        # (unclipped) weight-decay force
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = invoke("clip", grad, a_min=-self.clip_gradient,
+                          a_max=self.clip_gradient)
+        grad = grad + wd * weight
+        noise = nd.random.normal(0.0, math.sqrt(lr), shape=weight.shape,
+                                 ctx=weight.context)
+        weight[:] = weight - lr / 2.0 * grad + noise

@@ -126,3 +126,42 @@ class BucketSentenceIter(DataIter):
                                    (self.batch_size, L), self._dtype)],
             provide_label=[DataDesc(self.label_name,
                                     (self.batch_size, L), self._dtype)])
+
+
+def save_rnn_checkpoint(cells, prefix, epoch, symbol, arg_params,
+                        aux_params):
+    """Reference: rnn.save_rnn_checkpoint — unpack every cell's fused
+    blobs before writing the standard checkpoint pair, so the artifact
+    holds per-gate matrices."""
+    from ..model import save_checkpoint
+    if not isinstance(cells, (list, tuple)):
+        cells = [cells]
+    for cell in cells:
+        arg_params = cell.unpack_weights(arg_params)
+    save_checkpoint(prefix, epoch, symbol, arg_params, aux_params)
+
+
+def load_rnn_checkpoint(cells, prefix, epoch):
+    """Reference: rnn.load_rnn_checkpoint — load the pair and re-pack
+    per-gate matrices into each cell's fused layout."""
+    from ..model import load_checkpoint
+    sym, arg, aux = load_checkpoint(prefix, epoch)
+    if not isinstance(cells, (list, tuple)):
+        cells = [cells]
+    for cell in cells:
+        arg = cell.pack_weights(arg)
+    return sym, arg, aux
+
+
+def do_rnn_checkpoint(cells, prefix, period=1):
+    """Reference: rnn.do_rnn_checkpoint — the epoch-end callback form."""
+    period = int(max(1, period))
+
+    def _callback(iter_no, sym, arg, aux):
+        if (iter_no + 1) % period == 0:
+            save_rnn_checkpoint(cells, prefix, iter_no + 1, sym, arg, aux)
+    return _callback
+
+
+__all__ += ["save_rnn_checkpoint", "load_rnn_checkpoint",
+            "do_rnn_checkpoint"]

@@ -333,6 +333,93 @@ class FusedRNNCell(BaseRNNCell):
                              self._num_hidden), name=name + "_zconst")
         return sym.broadcast_add(sym._mul_scalar(col, scalar=0.0), z)
 
+    # -- fused blob <-> per-gate matrices (reference: FusedRNNCell
+    # unpack_weights/pack_weights over the cuDNN parameter layout) ---------
+    def _blob_geometry(self, total):
+        """(G, H, dirs, input_size) from the flat blob length."""
+        G = len(self._gate_names)
+        H = self._num_hidden
+        dirs = 2 if self._bidirectional else 1
+        L = self._num_layers
+        bias_total = L * dirs * 2 * G * H
+        w_rest = sum(dirs * (G * H * (H * dirs) + G * H * H)
+                     for _ in range(L - 1))
+        w0_h2h = dirs * G * H * H
+        rem = total - bias_total - w_rest - w0_h2h
+        assert rem % (dirs * G * H) == 0, \
+            "fused blob length %d inconsistent with cell geometry" % total
+        return G, H, dirs, rem // (dirs * G * H)
+
+    def _param_names_ordered(self, G, dirs):
+        """(weight names, bias names) in the cuDNN layout order the flat
+        blob packs them (layer-major; i2h before h2h; gates split)."""
+        wnames, bnames = [], []
+        for layer in range(self._num_layers):
+            for d in range(dirs):
+                p = "%s%s%d_" % (self._prefix, "lr"[d], layer)
+                for kind in ("i2h", "h2h"):
+                    wnames.append([("%s%s%s_weight" % (p, kind, g))
+                                   for g in self._gate_names])
+        for layer in range(self._num_layers):
+            for d in range(dirs):
+                p = "%s%s%d_" % (self._prefix, "lr"[d], layer)
+                for kind in ("i2h", "h2h"):
+                    bnames.append([("%s%s%s_bias" % (p, kind, g))
+                                   for g in self._gate_names])
+        return wnames, bnames
+
+    def unpack_weights(self, args):
+        """Fused blob -> per-gate i2h/h2h matrices (reference naming:
+        ``{prefix}{l|r}{layer}_{i2h|h2h}{gate}_weight/bias``)."""
+        args = dict(args)
+        pname = self._prefix + "parameters"
+        if pname not in args:
+            return args
+        from .. import ndarray as nd
+        blob = args.pop(pname)
+        flat = blob.asnumpy().ravel()
+        G, H, dirs, I = self._blob_geometry(flat.size)
+        wnames, bnames = self._param_names_ordered(G, dirs)
+        ofs = 0
+        wi = 0
+        for layer in range(self._num_layers):
+            isz = I if layer == 0 else H * dirs
+            for _d in range(dirs):
+                for kind_sz in (isz, H):
+                    mat = flat[ofs:ofs + G * H * kind_sz].reshape(
+                        G * H, kind_sz)
+                    ofs += G * H * kind_sz
+                    for g, name in enumerate(wnames[wi]):
+                        args[name] = nd.array(mat[g * H:(g + 1) * H])
+                    wi += 1
+        for names in bnames:
+            vec = flat[ofs:ofs + G * H]
+            ofs += G * H
+            for g, name in enumerate(names):
+                args[name] = nd.array(vec[g * H:(g + 1) * H])
+        return args
+
+    def pack_weights(self, args):
+        """Per-gate matrices -> fused blob (inverse of unpack_weights)."""
+        args = dict(args)
+        G = len(self._gate_names)
+        dirs = 2 if self._bidirectional else 1
+        wnames, bnames = self._param_names_ordered(G, dirs)
+        if not all(n in args for group in wnames + bnames for n in group):
+            return args          # nothing (or only partial) to pack
+        import numpy as _np
+        from .. import ndarray as nd
+        parts = []
+        for group in wnames:
+            parts.append(_np.concatenate(
+                [args.pop(n).asnumpy() for n in group], axis=0).ravel())
+        for group in bnames:
+            parts.append(_np.concatenate(
+                [args.pop(n).asnumpy() for n in group], axis=0).ravel())
+        args[self._prefix + "parameters"] = nd.array(
+            _np.concatenate(parts))
+        return args
+
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None):
         self.reset()

@@ -551,3 +551,29 @@ def test_image_scale_down():
     assert mx.image.scale_down((640, 480), (720, 120)) == (640, 106)
     assert mx.image.scale_down((360, 1000), (480, 500)) == (360, 375)
     assert mx.image.scale_down((100, 100), (50, 50)) == (50, 50)
+
+
+def test_image_record_uint8_iter(tmp_path):
+    """io.ImageRecordUInt8Iter: raw uint8 batches, normalization args
+    refused (reference: the INT8 pipeline's input iterator)."""
+    from mxnet_tpu import recordio
+    from mxnet_tpu.io import ImageRecordUInt8Iter
+    from mxnet_tpu.base import MXNetError
+    rng = np.random.RandomState(0)
+    prefix = str(tmp_path / "u8")
+    w = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    for i in range(8):
+        img = (rng.rand(32, 32, 3) * 255).astype(np.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 3), i, 0), img, quality=95))
+    w.close()
+    it = ImageRecordUInt8Iter(path_imgrec=prefix + ".rec",
+                              data_shape=(3, 28, 28), batch_size=4)
+    b = next(it)
+    assert str(b.data[0].dtype) == "uint8"
+    assert b.data[0].shape == (4, 3, 28, 28)
+    assert int(b.data[0].asnumpy().max()) > 1    # raw pixels, not scaled
+    with pytest.raises(MXNetError):
+        ImageRecordUInt8Iter(path_imgrec=prefix + ".rec",
+                             data_shape=(3, 28, 28), batch_size=4,
+                             mean_r=123.0)

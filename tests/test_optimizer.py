@@ -4,6 +4,7 @@ import numpy as np
 import pytest
 
 import mxnet_tpu as mx
+from mxnet_tpu import nd
 from mxnet_tpu import optimizer as opt
 
 
@@ -202,3 +203,22 @@ def test_updater_pickle_states():
     upd2 = opt.get_updater(opt.SGD(learning_rate=0.1, momentum=0.9))
     upd2.set_states(blob)
     assert 0 in upd2.states
+
+
+def test_round5_optimizers_descend_and_create():
+    """FTML/Adamax/Nadam/SGLD: registry create() resolves them and each
+    descends on a quadratic (Adamax additionally trajectory-pinned vs
+    torch in test_torch_parity)."""
+    import numpy as onp
+    for name in ("ftml", "adamax", "nadam", "sgld"):
+        mx.random.seed(0)
+        o = opt.create(name, learning_rate=0.05 if name != "sgld"
+                       else 0.005)
+        w = nd.array(onp.array([3.0, -2.0], "float32"))
+        state = o.create_state(0, w)
+        first = float((w * w).sum().asnumpy().item())
+        for _ in range(120):
+            o.update(0, w, 2.0 * w, state)
+        last = float((w * w).sum().asnumpy().item())
+        assert last < first * (0.6 if name != "sgld" else 0.9), \
+            (name, first, last)

@@ -148,3 +148,76 @@ def test_classic_symbol_autovars():
     g = mx.sym.FullyConnected(data=data, num_hidden=3, no_bias=True,
                               name="nb")
     assert "nb_bias" not in g.list_arguments()
+
+
+def test_rnn_checkpoint_helpers(tmp_path):
+    """rnn.save_rnn_checkpoint/load_rnn_checkpoint round-trip through
+    cell pack/unpack; do_rnn_checkpoint is the callback form."""
+    T, N, H, E = 3, 2, 6, 8
+    cell = mx.rnn.LSTMCell(H, prefix="ck_")
+    outputs, _ = cell.unroll(T, inputs=_embed(E=E), merge_outputs=True)
+    exe = outputs.simple_bind(mx.cpu(), data=(N, T))
+    rs = np.random.RandomState(0)
+    args = {}
+    for name, arr in exe.arg_dict.items():
+        if name != "data":
+            arr[:] = nd.array(rs.randn(*arr.shape) * 0.1)
+            args[name] = arr.copy()
+    prefix = str(tmp_path / "rnn-ck")
+    mx.rnn.save_rnn_checkpoint(cell, prefix, 3, outputs, args, {})
+    sym2, arg2, aux2 = mx.rnn.load_rnn_checkpoint(cell, prefix, 3)
+    assert sorted(arg2) == sorted(args)
+    for k in args:
+        np.testing.assert_allclose(arg2[k].asnumpy(), args[k].asnumpy())
+    # callback form writes on the matching epoch
+    cb = mx.rnn.do_rnn_checkpoint(cell, str(tmp_path / "cb"), period=2)
+    cb(1, outputs, args, {})       # epoch index 1 -> (1+1)%2==0 -> saves
+    import os
+    assert os.path.exists(str(tmp_path / "cb-0002.params"))
+
+
+def test_module_checkpoint_callback(tmp_path):
+    mod_sym = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3,
+                              name="mfc"), name="softmax")
+    mod = mx.mod.Module(mod_sym, context=mx.cpu())
+    X = np.random.RandomState(0).randn(16, 4).astype(np.float32)
+    Y = np.random.RandomState(1).randint(0, 3, 16).astype(np.float32)
+    it = mx.io.NDArrayIter(X, Y, batch_size=8)
+    prefix = str(tmp_path / "mc")
+    mx.mod  # namespace sanity
+    mod.fit(it, num_epoch=2, optimizer="sgd",
+            epoch_end_callback=mx.callback.module_checkpoint(mod, prefix))
+    import os
+    assert os.path.exists(prefix + "-0002.params")
+    assert os.path.exists(prefix + "-symbol.json")
+
+
+def test_fused_cell_unpack_pack_roundtrip():
+    """FusedRNNCell.unpack_weights splits the cuDNN blob into per-gate
+    i2h/h2h matrices (so rnn checkpoints hold per-gate layouts) and
+    pack_weights inverts it exactly — including bidirectional stacks."""
+    from mxnet_tpu.ops.rnn import rnn_param_size
+    for mode, bidir, L in (("lstm", False, 1), ("gru", True, 2)):
+        cell = mx.rnn.FusedRNNCell(6, num_layers=L, mode=mode,
+                                   bidirectional=bidir, prefix="fz_")
+        I = 5
+        psize = rnn_param_size(L, I, 6, mode, bidirectional=bidir)
+        rs = np.random.RandomState(0)
+        blob = nd.array(rs.randn(psize).astype(np.float32))
+        args = {"fz_parameters": blob, "other": nd.array(np.ones(2))}
+        unpacked = cell.unpack_weights(args)
+        assert "fz_parameters" not in unpacked
+        assert "other" in unpacked
+        gates = {"lstm": 4, "gru": 3}[mode]
+        dirs = 2 if bidir else 1
+        # per (layer, dir): i2h+h2h weights and biases per gate
+        n_per_gate = L * dirs * 2 * 2
+        assert len(unpacked) - 1 == gates * n_per_gate, len(unpacked)
+        w00 = unpacked["fz_l0_i2h%s_weight"
+                       % ("_i" if mode == "lstm" else "_r")]
+        assert w00.shape == (6, I)
+        repacked = cell.pack_weights(unpacked)
+        np.testing.assert_allclose(repacked["fz_parameters"].asnumpy(),
+                                   blob.asnumpy(), rtol=1e-6)
+        assert "other" in repacked and len(repacked) == 2

@@ -432,6 +432,8 @@ def test_trainer_sgd_adam_vs_torch_optim():
         ("nag", {"learning_rate": 0.1, "momentum": 0.9},
          torch.optim.SGD, {"lr": 0.1, "momentum": 0.9,
                            "nesterov": True}),
+        ("adamax", {"learning_rate": 0.05},
+         torch.optim.Adamax, {"lr": 0.05}),
     ]:
         net = gluon.nn.Dense(3, in_units=5)
         net.initialize()
@@ -682,3 +684,33 @@ def test_gluon_losses_vs_torch():
         torch.tensor(p), torch.tensor(t), delta=rho,
         reduction="none").mean(1).numpy()
     np.testing.assert_allclose(h, th / rho, rtol=1e-5)
+
+
+def test_nadam_single_param_vs_torch():
+    """Nadam vs torch.optim.NAdam on ONE parameter: the reference keeps
+    m_schedule as an optimizer-global scalar advanced per update() call,
+    so multi-parameter trajectories deliberately follow the reference
+    (not torch); with a single parameter the two definitions coincide
+    and must match numerically."""
+    rng = np.random.RandomState(21)
+    w0 = rng.randn(3, 5).astype(np.float32)
+    xs = rng.randn(4, 5).astype(np.float32)
+    ys = rng.randn(4, 3).astype(np.float32)
+    net = gluon.nn.Dense(3, in_units=5, use_bias=False)
+    net.initialize()
+    net.weight.set_data(nd.array(w0))
+    trainer = gluon.Trainer(net.collect_params(), "nadam",
+                            {"learning_rate": 0.05})
+    tw = torch.nn.Parameter(torch.tensor(w0.copy()))
+    topt = torch.optim.NAdam([tw], lr=0.05)
+    for _ in range(4):
+        with autograd.record():
+            loss = ((net(nd.array(xs)) - nd.array(ys)) ** 2).mean()
+        loss.backward()
+        trainer.step(1, ignore_stale_grad=True)
+        topt.zero_grad()
+        tl = ((torch.tensor(xs) @ tw.T - torch.tensor(ys)) ** 2).mean()
+        tl.backward()
+        topt.step()
+    _close(net.weight.data(), tw, rtol=2e-4, atol=2e-5,
+           what="nadam weight after 4 steps")
