@@ -61,6 +61,7 @@ from . import gluon
 from . import parallel
 from . import callback
 from . import checkpoint
+from . import fault
 from . import model
 from . import monitor
 from . import module

@@ -67,6 +67,17 @@ ENV_CATALOG: Dict[str, Any] = {
     "MX_PS_ROOT": ("", "dist_async parameter-server address host:port (single server)."),
     "MX_PS_ROOTS": ("", "Comma-separated PS addresses; keys hash-shard across them (launch.py -s N)."),
     "MX_PS_PORT": ("9600", "Port a kvstore server process binds (DMLC_ROLE=server)."),
+    "MX_PS_SNAPSHOT": ("", "Path where a kvstore server persists its store (atomic pickle) after mutations and on STOP; a server restarted with the same path resumes with no data loss."),
+    "MX_PS_SNAPSHOT_EVERY": ("1", "Snapshot the server store every N mutating requests (1 = every PUSH/INIT; larger trades durability for throughput)."),
+    "MX_KVSTORE_RETRY_DEADLINE": ("60", "dist_async client: total seconds to keep retrying a failed RPC (reconnect + replay) before raising a terminal MXNetError."),
+    "MX_KVSTORE_RETRY_BASE": ("0.05", "dist_async client: first backoff delay in seconds; doubles per attempt."),
+    "MX_KVSTORE_RETRY_MAX": ("2.0", "dist_async client: backoff delay cap in seconds."),
+    "MX_KVSTORE_RETRY_JITTER": ("0.2", "dist_async client: uniform jitter fraction added to each backoff delay (decorrelates worker retry storms)."),
+    "MX_KVSTORE_RECV_TIMEOUT": ("", "Seconds a kvstore recv_msg may block mid-message before raising TimeoutError (empty = block forever; the dist_async client always bounds its RPCs with this, default 30 there)."),
+    "MX_KVSTORE_BARRIER_TIMEOUT": ("120", "Seconds a kvstore server BARRIER waits for stragglers before failing the barrier."),
+    "MX_KVSTORE_HEARTBEAT": ("5", "dist_async client: seconds between background PINGs to each server (0 disables); keeps a compute-bound worker from being evicted as stale."),
+    "MX_KVSTORE_STALE_TIMEOUT": ("30", "kvstore server: a worker silent this many seconds is evicted from barrier accounting so a wedged peer cannot hold BARRIER forever."),
+    "MX_FAULT_INJECT": ("", "Fault-injection spec 'site:action[:k=v,...];...' armed at import (tools/launch.py --fault); see mxnet_tpu/fault.py."),
     "MX_FLASH_BLOCK_Q": ("256", "Pallas flash-attention query-block rows (VMEM tiling knob; sweepable on hardware)."),
     "MX_FLASH_BLOCK_K": ("256", "Pallas flash-attention key-block rows."),
     "MX_NO_CAPTURE_FALLBACK": ("0", "bench.py: never replay a TPU capture (the capture loop's own children set this)."),

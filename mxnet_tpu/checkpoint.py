@@ -18,11 +18,17 @@ none either); checkpoint frequency bounds lost work.
 from __future__ import annotations
 
 import os
-from typing import Any, Optional
+import shutil
+from typing import Any, Callable, Optional, Tuple
 
 import jax
 
-__all__ = ["save_sharded", "restore_sharded", "CheckpointManager"]
+__all__ = ["save_sharded", "restore_sharded", "CheckpointManager",
+           "resume_or_init"]
+
+_TMP_MARK = ".saving-"      # in-progress save dir: <name>.saving-tmp
+                            # (deterministic — every host of a collective
+                            # save must hand orbax the SAME directory)
 
 
 def _checkpointer():
@@ -35,10 +41,73 @@ def save_sharded(path: str, state: Any, force: bool = True) -> None:
 
     Every process must call this with its view of the same global arrays;
     orbax writes one OCDBT store with each host's local shards.
+
+    Crash-safe by construction (§5.3 failure posture): the tree is
+    written to a sibling ``<name>.saving-tmp`` dir and renamed into
+    place, so a process killed mid-save never loses the last restorable
+    checkpoint — a kill during the write leaves ``path`` untouched, and
+    a kill inside the two-rename commit leaves the previous checkpoint
+    at ``<name>.replaced`` from which the next save/restore recovers
+    automatically.  The ``checkpoint.commit`` fault site sits between
+    write and rename for chaos tests to kill into.
     """
+    from . import fault as _fault
+    path = os.path.abspath(path)
+    parent, name = os.path.split(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    _recover_commit(path)
+    # force=False must fail BEFORE the (expensive, collective) write —
+    # and on every host, or the lead's late error would strand the rest
+    # in the commit barrier
+    if not force and os.path.exists(path):
+        raise FileExistsError("checkpoint %s exists (force=False)" % path)
+    # the temp name is DETERMINISTIC so a multi-host job's processes all
+    # hand orbax the same directory (the collective-save contract above);
+    # process 0 alone performs the filesystem commit, with barriers
+    # fencing the write and the rename
+    nprocs = jax.process_count()
+    is_lead = jax.process_index() == 0
+    tmp = os.path.join(parent, name + _TMP_MARK + "tmp")
+    old = os.path.join(parent, name + ".replaced")
+    if is_lead:
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        if os.path.exists(old):
+            shutil.rmtree(old)
+    _sync(nprocs, "mx_ckpt_pre_save")       # stale-tmp cleanup visible
     ckptr = _checkpointer()
-    ckptr.save(os.path.abspath(path), state, force=force)
+    ckptr.save(tmp, state, force=True)
     ckptr.wait_until_finished()
+    _sync(nprocs, "mx_ckpt_written")        # every host's shards are in
+    # a kill landing here leaves `path` untouched — exactly the contract
+    _fault.fire("checkpoint.commit")
+    if is_lead:
+        had_old = os.path.exists(path)
+        if had_old:
+            os.rename(path, old)
+        os.rename(tmp, path)                # path momentarily absent: a
+        if had_old:                         # kill here is healed by
+            shutil.rmtree(old, ignore_errors=True)   # _recover_commit
+    _sync(nprocs, "mx_ckpt_committed")      # rename visible everywhere
+
+
+def _recover_commit(path: str) -> None:
+    """Heal a crash inside save_sharded's two-rename commit window: if
+    ``path`` is missing but ``<name>.replaced`` (the displaced previous
+    checkpoint — known-complete) exists, put it back."""
+    old = path + ".replaced"
+    if not os.path.exists(path) and os.path.exists(old):
+        try:
+            os.rename(old, path)
+        except OSError:
+            pass            # a peer process won the recovery race
+
+
+def _sync(nprocs: int, tag: str) -> None:
+    if nprocs > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(tag)
 
 
 def restore_sharded(path: str, template: Optional[Any] = None,
@@ -52,6 +121,7 @@ def restore_sharded(path: str, template: Optional[Any] = None,
     """
     ckptr = _checkpointer()
     path = os.path.abspath(path)
+    _recover_commit(path)       # heal a crash mid-commit before reading
     if template is None:
         return ckptr.restore(path)
     return ckptr.restore(path, _restore_target(template, shardings))
@@ -108,3 +178,30 @@ class CheckpointManager:
 
     def close(self):
         self._mgr.close()
+
+
+def resume_or_init(directory: str, init_fn: Callable[[], Any], *,
+                   shardings: Optional[Any] = None,
+                   max_to_keep: int = 3,
+                   manager: Optional[CheckpointManager] = None,
+                   ) -> Tuple[Any, int, CheckpointManager]:
+    """The §5.3 recovery loop's entry point: restore the latest
+    checkpoint if one exists, else build fresh state.
+
+    ``init_fn`` constructs the fresh training state (a pytree of
+    jax.Arrays); it always runs — its result is either returned as-is
+    (cold start) or used as the restore template so arrays land with the
+    new job's shapes/dtypes (pass ``shardings`` to re-lay-out onto a new
+    mesh).  Returns ``(state, start_step, manager)`` where
+    ``start_step`` is 0 on a cold start and ``latest_step() + 1`` after
+    a resume — drivers loop ``for step in range(start_step, total)`` and
+    ``manager.save(step, state)`` periodically, and a crashed-and-
+    restarted job continues where the last save left off.
+    """
+    mgr = manager or CheckpointManager(directory, max_to_keep=max_to_keep)
+    state = init_fn()
+    step = mgr.latest_step()
+    if step is None:
+        return state, 0, mgr
+    restored = mgr.restore(step, template=state, shardings=shardings)
+    return restored, step + 1, mgr

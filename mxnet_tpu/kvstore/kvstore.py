@@ -405,23 +405,47 @@ class KVStoreDistAsync(KVStore):
     arrives (server-side optimizer), pulls return whatever is current,
     and workers never wait for each other.  Server addresses from
     MX_PS_ROOTS (tools/launch.py -s N; keys hash-shard across servers)
-    or MX_PS_ROOT (single server)."""
+    or MX_PS_ROOT (single server).
+
+    Fault tolerance (ps-lite resender role, rebuilt over
+    mxnet_tpu.fault): every RPC is SEQ-tagged and retried under a
+    :class:`~mxnet_tpu.fault.RetryPolicy` — a dropped connection or a
+    server restart triggers transparent reconnect and an idempotent
+    replay of the in-flight request (the server's replay cache
+    guarantees exactly-once application), with a loud terminal
+    MXNetError only after ``MX_KVSTORE_RETRY_DEADLINE`` seconds.  A
+    background heartbeat thread PINGs each server every
+    ``MX_KVSTORE_HEARTBEAT`` seconds on its own connections so a
+    compute-bound worker is never evicted as stale."""
 
     def __init__(self):
         super().__init__()
         import os
+        import threading
+        import uuid
         from . import server as _srv
+        from .. import fault as _fault
         self._srv_mod = _srv
+        self._fault = _fault
         addrs = _ps_addrs()
         if not addrs:
             raise MXNetError(
                 "kvstore 'dist_async' needs a parameter server: launch "
                 "with tools/launch.py -n <workers> -s <servers> "
                 "(MX_PS_ROOTS/MX_PS_ROOT unset)")
+        self._addrs = list(addrs)
+        self._rank = int(os.environ.get("MX_PROCESS_ID",
+                                        os.environ.get("DMLC_WORKER_ID", 0)))
+        self._size = int(os.environ.get("MX_NUM_PROCESSES",
+                                        os.environ.get("DMLC_NUM_WORKER",
+                                                       1)))
+        # liveness is per RANK server-side; the uuid distinguishes a
+        # restarted worker's replay cache from its predecessor's
+        self._client_id = "r%d:%s" % (self._rank, uuid.uuid4().hex[:12])
         import socket
         import time as _time
         self._socks = []
-        for addr in addrs:
+        for addr in self._addrs:
             host, port = addr.rsplit(":", 1)
             deadline = _time.time() + 60
             while True:  # the launcher starts servers concurrently:
@@ -433,12 +457,98 @@ class KVStoreDistAsync(KVStore):
                     if _time.time() > deadline:
                         raise
                     _time.sleep(0.2)
-        self._lock = __import__("threading").Lock()
-        self._rank = int(os.environ.get("MX_PROCESS_ID",
-                                        os.environ.get("DMLC_WORKER_ID", 0)))
-        self._size = int(os.environ.get("MX_NUM_PROCESSES",
-                                        os.environ.get("DMLC_NUM_WORKER",
-                                                       1)))
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._seq_lock = threading.Lock()
+        self._hb_stop = threading.Event()
+        self._hb_thread = None
+        self._start_heartbeat()
+
+    # -- resilience plumbing ------------------------------------------------
+    def _retry_policy(self):
+        from ..fault import RetryPolicy
+        return RetryPolicy.from_env()
+
+    def _next_seq(self):
+        with self._seq_lock:
+            self._seq += 1
+            return self._seq
+
+    def _recv_timeout(self, cmd="PULL"):
+        """Per-request reply deadline.  BARRIER legitimately blocks up to
+        the server's barrier timeout, so its reply window must exceed it
+        (a shorter window would replay the barrier and double-count this
+        worker)."""
+        from ..base import get_env
+        if cmd == "BARRIER":
+            t = get_env("MX_KVSTORE_BARRIER_TIMEOUT", 120.0, float)
+            return (t if t and t > 0 else 120.0) + 30.0
+        t = get_env("MX_KVSTORE_RECV_TIMEOUT", 0.0, float)
+        return t if t and t > 0 else 30.0
+
+    def _kill_sock(self, idx):
+        sock = self._socks[idx]
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        self._socks[idx] = None
+
+    def _ensure_sock(self, idx):
+        """Reconnect a dead connection (server restart recovery path)."""
+        import socket
+        sock = self._socks[idx]
+        if sock is not None:
+            return sock
+        host, port = self._addrs[idx].rsplit(":", 1)
+        sock = socket.create_connection((host, int(port)), timeout=5)
+        # the 5s bound is for CONNECT only — leave sends as generous as
+        # the original __init__ connections (a big sendall over a slow
+        # link must not be capped at the connect timeout)
+        sock.settimeout(120)
+        self._socks[idx] = sock
+        return sock
+
+    def _start_heartbeat(self):
+        import socket as _socket
+        import threading
+        from ..base import get_env
+        interval = get_env("MX_KVSTORE_HEARTBEAT", dtype=float)
+        if not interval or interval <= 0:
+            return
+
+        def run():
+            # dedicated connections: a heartbeat must not contend with a
+            # long-blocking data RPC (e.g. a worker waiting in BARRIER)
+            socks = [None] * len(self._addrs)
+            while not self._hb_stop.wait(interval):
+                for i, addr in enumerate(self._addrs):
+                    try:
+                        if socks[i] is None:
+                            host, port = addr.rsplit(":", 1)
+                            socks[i] = _socket.create_connection(
+                                (host, int(port)), timeout=2)
+                        self._srv_mod.send_msg(
+                            socks[i], ("PING", self._client_id))
+                        self._srv_mod.recv_msg(socks[i], timeout=2)
+                    except (ConnectionError, OSError, TimeoutError):
+                        if socks[i] is not None:
+                            try:
+                                socks[i].close()
+                            except OSError:
+                                pass
+                        socks[i] = None    # reconnect next beat
+            for s in socks:
+                if s is not None:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+
+        self._hb_thread = threading.Thread(target=run, daemon=True,
+                                           name="mx-kvstore-heartbeat")
+        self._hb_thread.start()
 
     @property
     def type(self):
@@ -500,46 +610,87 @@ class KVStoreDistAsync(KVStore):
             return self._rpc("PULL", k)
         # pipeline: issue every part request on its own socket FIRST,
         # then collect replies — wall-clock ~max(parts), not sum(parts)
-        # (the concurrency is the point of big-array sharding)
-        with self._lock:
-            for i, _s, _e in plan:
-                if self._socks[i] is None:
-                    raise MXNetError("dist_async connection %d is closed"
-                                     % i)
-                self._srv_mod.send_msg(self._socks[i],
-                                       ("PULL", self._part_key(k, i)))
-            parts = []
-            for i, _s, _e in plan:
-                ok, payload = self._srv_mod.recv_msg(self._socks[i])
-                if not ok:
-                    raise MXNetError("dist_async server %d: %s"
-                                     % (i, payload))
-                parts.append(payload)
-        return _onp.concatenate(
-            [_onp.asarray(p).ravel() for p in parts]).reshape(shape)
+        # (the concurrency is the point of big-array sharding).  PULL is
+        # idempotent, so a failed round simply re-issues every part with
+        # fresh seqs under the retry policy.
+        policy = self._retry_policy()
+        timeout = self._recv_timeout("PULL")
+        for _attempt in policy:
+            try:
+                with self._lock:
+                    for i, _s, _e in plan:
+                        sock = self._ensure_sock(i)
+                        self._fault.fire(
+                            "kvstore.send",
+                            on_close=lambda i=i: self._kill_sock(i))
+                        self._srv_mod.send_msg(
+                            sock, ("SEQ", self._client_id,
+                                   self._next_seq(),
+                                   ("PULL", self._part_key(k, i))))
+                    parts = []
+                    bad = None
+                    for i, _s, _e in plan:
+                        # drain EVERY pending reply even after a failure:
+                        # an unread response left buffered would be
+                        # misread as the next RPC's answer (desync)
+                        ok, payload = self._srv_mod.recv_msg(
+                            self._socks[i], timeout=timeout)
+                        if not ok and bad is None:
+                            bad = (i, payload)
+                        parts.append(payload)
+                    if bad is not None:
+                        raise MXNetError("dist_async server %d: %s" % bad)
+                return _onp.concatenate(
+                    [_onp.asarray(p).ravel() for p in parts]).reshape(shape)
+            except (ConnectionError, OSError, TimeoutError) as e:
+                for i, _s, _e in plan:
+                    self._kill_sock(i)
+                policy.note(e)
+        raise MXNetError(
+            "dist_async sharded pull of %r failed for %.3gs "
+            "(MX_KVSTORE_RETRY_DEADLINE); last error: %s"
+            % (k, policy.deadline, policy.last_error))
 
     def _rpc_on(self, idx, *msg):
-        import socket as _socket
-        with self._lock:
-            sock = self._socks[idx]
-            if sock is None:
-                raise MXNetError("dist_async connection %d is closed (a "
-                                 "prior RPC timed out; the stream cannot "
-                                 "resync)" % idx)
-            try:
-                self._srv_mod.send_msg(sock, msg)
-                ok, payload = self._srv_mod.recv_msg(sock)
-            except (_socket.timeout, TimeoutError):
-                # a late reply would desync every later request/response
-                # pair: poison the connection instead of misreading it
-                sock.close()
-                self._socks[idx] = None
-                raise MXNetError("dist_async server %d did not answer %r "
-                                 "within the socket timeout"
-                                 % (idx, msg[0]))
-        if not ok:
-            raise MXNetError("dist_async server: %s" % payload)
-        return payload
+        """One RPC with transparent recovery: on a dropped/ timed-out
+        connection, reconnect and REPLAY the same (client_id, seq)
+        envelope — the server's replay cache makes the retry idempotent
+        (a PUSH applied before the reply was lost is answered from cache,
+        never re-applied).  Gives up loudly after the retry deadline."""
+        seq = self._next_seq()
+        wrapped = ("SEQ", self._client_id, seq, msg)
+        timeout = self._recv_timeout(msg[0])
+        policy = self._retry_policy()
+        if msg[0] == "STOP":
+            # shutdown is best-effort: don't spend the full recovery
+            # deadline on a server that is already gone
+            policy.deadline = min(policy.deadline, 5.0)
+        for _attempt in policy:
+            with self._lock:
+                try:
+                    sock = self._ensure_sock(idx)
+                    self._fault.fire(
+                        "kvstore.send",
+                        on_close=lambda: self._kill_sock(idx))
+                    self._srv_mod.send_msg(sock, wrapped)
+                    self._fault.fire(
+                        "kvstore.recv",
+                        on_close=lambda: self._kill_sock(idx))
+                    ok, payload = self._srv_mod.recv_msg(sock,
+                                                         timeout=timeout)
+                except (ConnectionError, OSError, TimeoutError) as e:
+                    self._kill_sock(idx)
+                    policy.note(e)
+                    continue
+            if not ok:
+                raise MXNetError("dist_async server %d: %s"
+                                 % (idx, payload))
+            return payload
+        raise MXNetError(
+            "dist_async server %d (%s) unreachable: %r retried for %.3gs "
+            "(MX_KVSTORE_RETRY_DEADLINE exceeded); last error: %s"
+            % (idx, self._addrs[idx], msg[0], policy.deadline,
+               policy.last_error))
 
     def _rpc(self, *msg):
         """Route by key for data commands; controller commands go wider
@@ -553,8 +704,11 @@ class KVStoreDistAsync(KVStore):
             # rest as live processes on manual multi-host deployments)
             out = None
             for i in range(len(self._socks)):
-                if self._socks[i] is not None:
+                try:
                     out = self._rpc_on(i, *msg)
+                except MXNetError:
+                    if cmd != "STOP":   # STOP is best-effort per server
+                        raise
             return out
         return self._rpc_on(0, *msg)        # BARRIER
 
@@ -618,6 +772,17 @@ class KVStoreDistAsync(KVStore):
             self._rpc("STOP", None)
         except MXNetError:
             pass
+        self.close()
+
+    def close(self):
+        """Stop the heartbeat thread and drop every connection."""
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2)
+            self._hb_thread = None
+        with self._lock:
+            for i in range(len(self._socks)):
+                self._kill_sock(i)
 
 
 _STORES = {

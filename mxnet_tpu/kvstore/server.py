@@ -18,7 +18,28 @@ Wire protocol: request = (cmd, key, payload...); response = (ok, payload).
 Commands: INIT (store if absent), PUSH (updater(key, grad, store) when an
 optimizer is installed, else accumulate-sum), PULL, SET_OPT (pickled
 optimizer, the reference's set_optimizer controller message), BARRIER
-(explicit only — pushes NEVER barrier), STOP.
+(explicit only — pushes NEVER barrier), PING (heartbeat; refreshes the
+sender's liveness), STOP.
+
+Fault tolerance (the ps-lite resender/heartbeat role, rebuilt here):
+
+* Requests may arrive wrapped as ``("SEQ", client_id, seq, inner)`` — the
+  retrying client (kvstore.py) tags each RPC so a reconnect-and-replay
+  after a dropped reply is applied **exactly once**: the server caches
+  each client's last (seq, response) and answers a replayed seq from the
+  cache instead of re-executing it (double-applying a PUSH would corrupt
+  the optimizer trajectory).
+* Liveness: every SEQ/PING carries a client id whose rank prefix feeds a
+  last-seen table.  BARRIER releases when all *live* workers have
+  arrived — a worker silent for ``MX_KVSTORE_STALE_TIMEOUT`` seconds is
+  evicted from barrier accounting, so a wedged peer cannot hold the
+  barrier forever; the overall wait is bounded by
+  ``MX_KVSTORE_BARRIER_TIMEOUT``.
+* Durability: with ``MX_PS_SNAPSHOT=path`` the server persists its store
+  (+ installed optimizer and its slot states) to an atomically-replaced
+  pickle after mutations and on STOP, and reloads it at startup — a
+  server restarted on the same port resumes with no data loss, which is
+  what lets the client's transparent reconnect actually succeed.
 """
 from __future__ import annotations
 
@@ -28,7 +49,8 @@ import socket
 import socketserver
 import struct
 import threading
-from typing import Dict
+import time as _time
+from typing import Dict, Optional
 
 import numpy as _np
 
@@ -40,48 +62,281 @@ def send_msg(sock: socket.socket, obj) -> None:
     sock.sendall(struct.pack("<Q", len(payload)) + payload)
 
 
-def recv_msg(sock: socket.socket):
-    head = b""
-    while len(head) < 8:
-        chunk = sock.recv(8 - len(head))
-        if not chunk:
-            raise ConnectionError("peer closed")
-        head += chunk
-    (n,) = struct.unpack("<Q", head)
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(min(1 << 20, n - len(buf)))
-        if not chunk:
-            raise ConnectionError("peer closed mid-message")
-        buf += chunk
-    return pickle.loads(bytes(buf))
+def _env_timeout(name: str, default: str = "") -> Optional[float]:
+    """Positive float from the env, else the ENV_CATALOG default (the
+    single documented source of truth), else `default`; None = no bound."""
+    raw = os.environ.get(name)
+    if raw is None:
+        from ..base import ENV_CATALOG
+        raw = ENV_CATALOG.get(name, (default, ""))[0] or default
+    try:
+        val = float(raw)
+    except (TypeError, ValueError):
+        return None
+    return val if val > 0 else None
+
+
+def recv_msg(sock: socket.socket, timeout: Optional[float] = None,
+             idle_block: bool = False):
+    """Receive one length-prefixed message.
+
+    ``timeout`` bounds how long the peer may stall; None reads the
+    ``MX_KVSTORE_RECV_TIMEOUT`` env knob (empty/0 = block forever).
+    With ``idle_block=True`` the wait for the FIRST byte is unbounded
+    (a server handler idling between requests is healthy) but a peer
+    that stalls *mid-message* still trips TimeoutError instead of
+    hanging the thread forever.
+    """
+    if timeout is None:
+        timeout = _env_timeout("MX_KVSTORE_RECV_TIMEOUT")
+    saved = sock.gettimeout()
+    try:
+        sock.settimeout(None if idle_block else timeout)
+        head = b""
+        while len(head) < 8:
+            try:
+                chunk = sock.recv(8 - len(head))
+            except socket.timeout:
+                raise TimeoutError(
+                    "recv_msg: peer sent no %s within %.3gs"
+                    % ("data" if not head else "full header", timeout))
+            if not chunk:
+                raise ConnectionError("peer closed")
+            if not head:
+                # first byte landed: message started, bound the rest
+                sock.settimeout(timeout)
+            head += chunk
+        (n,) = struct.unpack("<Q", head)
+        buf = bytearray()
+        while len(buf) < n:
+            try:
+                chunk = sock.recv(min(1 << 20, n - len(buf)))
+            except socket.timeout:
+                raise TimeoutError(
+                    "recv_msg: peer stalled mid-message (%d/%d bytes) "
+                    "for %.3gs" % (len(buf), n, timeout))
+            if not chunk:
+                raise ConnectionError("peer closed mid-message")
+            buf += chunk
+        return pickle.loads(bytes(buf))
+    finally:
+        try:
+            sock.settimeout(saved)
+        except OSError:
+            pass
+
+
+def _rank_of(client_id) -> str:
+    """Liveness is tracked per RANK: a restarted worker (new uuid, same
+    rank) replaces its predecessor's entry instead of leaking a stale
+    ghost that would permanently shrink the barrier quorum."""
+    cid = str(client_id)
+    return cid.split(":", 1)[0]
 
 
 class KVStoreServer:
     """The server-side store + optimizer (reference: KVStoreDistServer)."""
 
-    def __init__(self, num_workers: int = 1):
+    def __init__(self, num_workers: int = 1,
+                 snapshot_path: Optional[str] = None,
+                 snapshot_every: Optional[int] = None):
         self._store: Dict = {}
         self._locks: Dict = {}
         self._global_lock = threading.Lock()
         self._updater = None
+        self._opt_blob = None
         self._num_workers = num_workers
         self._barrier_count = 0
         self._barrier_gen = 0
         self._barrier_cv = threading.Condition()
+        # liveness: rank -> last activity (monotonic seconds)
+        self._last_seen: Dict[str, float] = {}
+        # ranks parked inside the current barrier generation: alive by
+        # definition, excluded from stale eviction
+        self._barrier_waiting: Dict[str, int] = {}
+        # exactly-once replay cache: client_id -> [seq, done Event, resp]
+        # (mutating commands only — PULL/PING re-execute harmlessly, and
+        # skipping them keeps parameter-sized replies out of the cache)
+        self._replay: Dict[str, list] = {}
+        self._replay_lock = threading.Lock()
+        self._snapshot_path = snapshot_path if snapshot_path is not None \
+            else (os.environ.get("MX_PS_SNAPSHOT") or None)
+        try:
+            self._snapshot_every = int(
+                snapshot_every if snapshot_every is not None else
+                os.environ.get("MX_PS_SNAPSHOT_EVERY", "1") or 1)
+        except ValueError:
+            self._snapshot_every = 1
+        self._mutations = 0
+        self._mutation_lock = threading.Lock()
+        self._snapshot_lock = threading.Lock()
+        if self._snapshot_path and os.path.exists(self._snapshot_path):
+            self._load_snapshot()
 
     def _lock_of(self, key):
         with self._global_lock:
             return self._locks.setdefault(key, threading.Lock())
 
+    # -- liveness -----------------------------------------------------------
+    def touch(self, client_id) -> None:
+        if client_id is not None:
+            self._last_seen[_rank_of(client_id)] = _time.monotonic()
+
+    def _effective_workers(self) -> int:
+        """Barrier quorum = configured workers minus evicted-stale ranks.
+        Ranks never heard from are NOT stale (they may still be starting),
+        and ranks parked INSIDE the barrier are alive by definition — a
+        waiting worker's own silence (e.g. heartbeats disabled) must
+        never evict it out of the barrier it is holding."""
+        stale = _env_timeout("MX_KVSTORE_STALE_TIMEOUT")
+        if stale is None:
+            return self._num_workers
+        horizon = _time.monotonic() - stale
+        # list(): touch() inserts from other handler threads concurrently
+        evicted = sum(1 for r, t in list(self._last_seen.items())
+                      if t < horizon and r not in self._barrier_waiting)
+        return max(1, self._num_workers - evicted)
+
+    # -- durability ---------------------------------------------------------
+    def _load_snapshot(self) -> None:
+        with open(self._snapshot_path, "rb") as f:
+            blob = pickle.load(f)
+        self._store = {k: _np.array(v, copy=True)
+                       for k, v in blob["store"].items()}
+        if blob.get("opt_blob") is not None:
+            self._install_optimizer(blob["opt_blob"])
+            states = blob.get("opt_states")
+            if states is not None:
+                self._updater.inner.set_states(states)
+        # exactly-once across restarts: resurrect the replay cache so a
+        # PUSH that was applied+snapshotted right before the crash is
+        # answered from cache when the reconnecting client replays it
+        for cid, (seq, resp) in blob.get("replay", {}).items():
+            done = threading.Event()
+            done.set()
+            self._replay[cid] = [seq, done, resp]
+
+    def snapshot(self) -> None:
+        """Atomically persist store + optimizer (write sibling, rename).
+        Serialized under _snapshot_lock: concurrent handler threads must
+        not race on the temp file (the loser's os.replace would throw)."""
+        path = self._snapshot_path
+        if not path:
+            return
+        with self._snapshot_lock:
+            with self._global_lock:
+                locks = list(self._locks.values())
+            for lk in locks:       # quiesce in-flight per-key mutations
+                lk.acquire()
+            try:
+                with self._replay_lock:
+                    replay = {cid: (ent[0], ent[2])
+                              for cid, ent in self._replay.items()
+                              if ent[1].is_set()}
+                with self._global_lock:   # fence vs concurrent INIT insert
+                    items = list(self._store.items())
+                blob = {"store": {k: _np.array(v, copy=True)
+                                  for k, v in items},
+                        "opt_blob": self._opt_blob,
+                        "opt_states": (self._updater.inner.get_states(False)
+                                       if self._updater is not None
+                                       else None),
+                        "replay": replay}
+            finally:
+                for lk in locks:
+                    lk.release()
+            tmp = "%s.tmp.%d" % (path, os.getpid())
+            with open(tmp, "wb") as f:
+                pickle.dump(blob, f, protocol=4)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+
+    def _note_mutation(self) -> None:
+        if not self._snapshot_path:
+            return
+        with self._mutation_lock:   # lost increments would skip the
+            self._mutations += 1    # modulo boundary below
+            due = self._mutations % max(1, self._snapshot_every) == 0
+        if due:
+            self.snapshot()
+
+    def _install_optimizer(self, blob) -> None:
+        from ..optimizer import get_updater
+        optimizer = pickle.loads(blob)
+        self._updater = _NumpyUpdater(get_updater(optimizer))
+        self._opt_blob = blob
+
+    # -- exactly-once replay ------------------------------------------------
+    _MUTATING = ("INIT", "PUSH", "SET_OPT")
+
+    def handle_request(self, msg, client_id=None):
+        """Entry point for one wire request: unwraps SEQ envelopes and
+        answers replayed sequence numbers from the cache (idempotent
+        reconnect-replay), then dispatches to :meth:`handle`.
+
+        PULL/PING bypass the cache — re-executing them is harmless, and
+        skipping them keeps parameter-sized replies out of it.  The
+        snapshot for a mutating command fires AFTER its cache entry
+        resolves, so a persisted store state always travels with the
+        cache entry that marks its push as applied (a crash between the
+        two can therefore never lead to a double-apply on restart)."""
+        if isinstance(msg, tuple) and msg and msg[0] == "SEQ":
+            _, cid, seq, inner = msg
+            self.touch(cid)
+            cmd = inner[0] if inner else None
+            if cmd in ("PULL", "PING"):
+                return self.handle(inner, client_id=cid)
+            with self._replay_lock:
+                ent = self._replay.get(cid)
+                if ent is not None and seq == ent[0]:
+                    dup = ent
+                elif ent is not None and seq < ent[0]:
+                    return False, ("stale request seq %s (server already "
+                                   "at %s)" % (seq, ent[0]))
+                else:
+                    dup = None
+                    ent = [seq, threading.Event(), None]
+                    self._replay[cid] = ent
+            if dup is not None:
+                # the original execution may still be in flight on the
+                # dead connection's thread: wait for its result rather
+                # than re-executing (PUSH must apply exactly once)
+                timeout = (_env_timeout("MX_KVSTORE_BARRIER_TIMEOUT")
+                           or 120) + 30
+                if not dup[1].wait(timeout=timeout):
+                    return False, "replayed request %s still in flight" % seq
+                return dup[2]
+            try:
+                resp = self.handle(inner, client_id=cid)
+            except BaseException as e:
+                # the entry MUST resolve even on a handler fault — a
+                # forever-pending seq would starve every future replay of
+                # it (the client would burn its whole retry deadline)
+                ent[2] = (False, "server error handling %r: %s"
+                          % (inner[0], e))
+                ent[1].set()
+                raise
+            ent[2] = resp
+            ent[1].set()
+            if cmd in self._MUTATING:
+                self._note_mutation()
+            return resp
+        resp = self.handle(msg, client_id=client_id)
+        if msg and msg[0] in self._MUTATING:
+            self._note_mutation()
+        return resp
+
     # -- command handlers ---------------------------------------------------
-    def handle(self, msg):
+    def handle(self, msg, client_id=None):
         cmd = msg[0]
         if cmd == "INIT":
             _, key, value = msg
             with self._lock_of(key):
                 if key not in self._store:
-                    self._store[key] = _np.array(value, copy=True)
+                    arr = _np.array(value, copy=True)
+                    with self._global_lock:   # fence vs snapshot iteration
+                        self._store[key] = arr
             return True, None
         if cmd == "PUSH":
             _, key, grad = msg
@@ -114,32 +369,75 @@ class KVStoreServer:
                 # never wiped mid-training (reference gates the controller
                 # message on rank 0 for the same reason)
                 return True, "already installed"
-            from ..optimizer import get_updater
-            optimizer = pickle.loads(blob)
-            self._updater = _NumpyUpdater(get_updater(optimizer))
+            self._install_optimizer(blob)
             return True, None
+        if cmd == "PING":
+            # heartbeat: payload is the sender's client_id (also reached
+            # touch() via the envelope when SEQ-wrapped)
+            if len(msg) > 1:
+                self.touch(msg[1])
+            return True, "PONG"
         if cmd == "BARRIER":
-            # generation barrier (explicit _barrier() calls only; PUSH
-            # never blocks — that's the async contract)
-            with self._barrier_cv:
-                gen = self._barrier_gen
-                self._barrier_count += 1
-                if self._barrier_count == self._num_workers:
-                    self._barrier_count = 0
-                    self._barrier_gen += 1
-                    self._barrier_cv.notify_all()
-                else:
-                    ok = self._barrier_cv.wait_for(
-                        lambda: self._barrier_gen > gen, timeout=120)
-                    if not ok:
-                        self._barrier_count = max(0,
-                                                  self._barrier_count - 1)
-                        return False, ("barrier timed out waiting for %d "
-                                       "workers" % self._num_workers)
-            return True, None
+            return self._handle_barrier(client_id)
         if cmd == "STOP":
+            # serve_forever snapshots once after the drain (fresher and
+            # cheaper than snapshotting here too); standalone embedders
+            # of KVStoreServer call .snapshot() themselves at shutdown
             return True, "stopping"
         return False, "unknown command %r" % (cmd,)
+
+    def _handle_barrier(self, client_id=None):
+        """Generation barrier (explicit _barrier() calls only; PUSH never
+        blocks — that's the async contract).  Waits re-check the live-
+        worker quorum every poll tick so a stale worker's eviction
+        releases the survivors instead of stranding them.  The caller's
+        rank registers in _barrier_waiting while parked, which shields
+        it from its own stale eviction (it is alive, just waiting)."""
+        timeout = _env_timeout("MX_KVSTORE_BARRIER_TIMEOUT") or 120.0
+        stale = _env_timeout("MX_KVSTORE_STALE_TIMEOUT") or 30.0
+        poll = min(0.25, max(0.02, stale / 5.0))
+        rank = _rank_of(client_id) if client_id is not None else None
+        with self._barrier_cv:
+            gen = self._barrier_gen
+            self._barrier_count += 1
+            if rank is not None:
+                self._barrier_waiting[rank] = \
+                    self._barrier_waiting.get(rank, 0) + 1
+            try:
+                if self._try_release_barrier():
+                    return True, None
+                deadline = _time.monotonic() + timeout
+                while self._barrier_gen == gen:
+                    remaining = deadline - _time.monotonic()
+                    if remaining <= 0:
+                        self._barrier_count = max(0,
+                                                  self._barrier_count - 1)
+                        return False, ("barrier timed out after %.3gs "
+                                       "waiting for %d workers (%d arrived)"
+                                       % (timeout, self._num_workers,
+                                          self._barrier_count + 1))
+                    self._barrier_cv.wait(timeout=min(poll, remaining))
+                    if self._barrier_gen == gen:
+                        if self._try_release_barrier():
+                            break
+            finally:
+                if rank is not None:
+                    n = self._barrier_waiting.get(rank, 0) - 1
+                    if n <= 0:
+                        self._barrier_waiting.pop(rank, None)
+                    else:
+                        self._barrier_waiting[rank] = n
+                    self.touch(client_id)     # fresh on the way out
+        return True, None
+
+    def _try_release_barrier(self) -> bool:
+        """Caller holds _barrier_cv.  Release if every live worker is in."""
+        if self._barrier_count >= self._effective_workers():
+            self._barrier_count = 0
+            self._barrier_gen += 1
+            self._barrier_cv.notify_all()
+            return True
+        return False
 
 
 class _NumpyUpdater:
@@ -147,36 +445,72 @@ class _NumpyUpdater:
     the server process stays off any accelerator."""
 
     def __init__(self, updater):
-        self._updater = updater
+        self.inner = updater
 
     def __call__(self, key, grad_np, stored_np):
         from ..ndarray.ndarray import array as _arr
         g = _arr(_np.asarray(grad_np))
         w = _arr(stored_np)
-        self._updater(key, g, w)
+        self.inner(key, g, w)
         stored_np[...] = w.asnumpy()
 
 
-def serve_forever(port=None, num_workers=None, ready_file=None):
+def serve_forever(port=None, num_workers=None, ready_file=None,
+                  snapshot_path=None):
     """Run the server loop (reference: KVStoreServer.run; entered by
-    DMLC_ROLE=server processes under tools/launch.py)."""
+    DMLC_ROLE=server processes under tools/launch.py).
+
+    STOP drains gracefully: the listener closes, in-flight requests get
+    their replies, THEN the process exits — so a worker's final RPC never
+    races the shutdown.
+    """
+    from .. import fault as _fault
     port = int(port if port is not None else
                os.environ.get("MX_PS_PORT", 9600))
     num_workers = int(num_workers if num_workers is not None else
                       os.environ.get("DMLC_NUM_WORKER", 1))
-    server_state = KVStoreServer(num_workers)
+    server_state = KVStoreServer(num_workers, snapshot_path=snapshot_path)
     stop_event = threading.Event()
+    inflight_count = [0]
+    inflight_lock = threading.Lock()
+    conns = set()                           # live client sockets, severed
+    conns_lock = threading.Lock()           # after the STOP drain
 
     class Handler(socketserver.BaseRequestHandler):
         def handle(self):
+            with conns_lock:
+                conns.add(self.request)
+            try:
+                self._serve()
+            finally:
+                with conns_lock:
+                    conns.discard(self.request)
+
+        def _serve(self):
             while True:
                 try:
-                    msg = recv_msg(self.request)
+                    msg = recv_msg(self.request, idle_block=True)
+                except (ConnectionError, OSError, TimeoutError):
+                    return
+                with inflight_lock:
+                    inflight_count[0] += 1
+                try:
+                    _fault.fire("server.handle")
+                    ok, payload = server_state.handle_request(msg)
+                except SystemExit:          # injected crash: die mid-request
+                    os._exit(17)
+                except _fault.FaultError as e:
+                    ok, payload = False, str(e)
+                finally:
+                    with inflight_lock:
+                        inflight_count[0] -= 1
+                try:
+                    send_msg(self.request, (ok, payload))
                 except (ConnectionError, OSError):
                     return
-                ok, payload = server_state.handle(msg)
-                send_msg(self.request, (ok, payload))
-                if msg[0] == "STOP":
+                inner = msg[3] if isinstance(msg, tuple) and msg and \
+                    msg[0] == "SEQ" else msg
+                if inner and inner[0] == "STOP":
                     stop_event.set()
                     return
 
@@ -191,7 +525,28 @@ def serve_forever(port=None, num_workers=None, ready_file=None):
         t = threading.Thread(target=srv.serve_forever, daemon=True)
         t.start()
         stop_event.wait()
-        srv.shutdown()
+        srv.shutdown()                      # stop accepting
+        drain_deadline = _time.monotonic() + 5.0
+        while _time.monotonic() < drain_deadline:
+            with inflight_lock:
+                if inflight_count[0] == 0:
+                    break
+            _time.sleep(0.02)
+        server_state.snapshot()
+        # sever surviving client connections so peers observe the stop
+        # immediately (a subprocess server gets this for free at exit;
+        # an in-process one must do it explicitly)
+        with conns_lock:
+            leftover = list(conns)
+        for c in leftover:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
 
 
 if __name__ == "__main__":

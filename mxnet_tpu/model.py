@@ -153,8 +153,13 @@ class FeedForward:
             epoch_end_callback=None, batch_end_callback=None,
             kvstore="local", logger=None, work_load_list=None,
             monitor=None, eval_end_callback=None,
-            eval_batch_end_callback=None):
-        """Reference: FeedForward.fit — train on X/y (arrays or DataIter)."""
+            eval_batch_end_callback=None, checkpoint_dir=None,
+            checkpoint_period=1, auto_resume=True):
+        """Reference: FeedForward.fit — train on X/y (arrays or DataIter).
+
+        ``checkpoint_dir``/``checkpoint_period``/``auto_resume`` pass
+        through to :meth:`Module.fit`'s fault-tolerance hook: periodic
+        crash-safe checkpointing with restart-from-latest resume."""
         data = self._as_iter(X, y, is_train=True)
         if self.epoch_size is not None:
             # reference: epoch_size bounds batches/epoch (the epoch
@@ -179,7 +184,8 @@ class FeedForward:
             eval_end_callback=eval_end_callback,
             eval_batch_end_callback=eval_batch_end_callback,
             begin_epoch=self.begin_epoch, num_epoch=self.num_epoch,
-            monitor=monitor)
+            monitor=monitor, checkpoint_dir=checkpoint_dir,
+            checkpoint_period=checkpoint_period, auto_resume=auto_resume)
         self.arg_params, self.aux_params = self._module.get_params()
         return self
 

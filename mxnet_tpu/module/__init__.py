@@ -247,8 +247,18 @@ class BaseModule:
             initializer=None,
             arg_params=None, aux_params=None, allow_missing=False,
             force_init=False, begin_epoch=0, num_epoch=None,
-            validation_metric=None, monitor=None):
-        """The reference training loop (reference: BaseModule.fit)."""
+            validation_metric=None, monitor=None,
+            checkpoint_dir=None, checkpoint_period=1, auto_resume=True):
+        """The reference training loop (reference: BaseModule.fit).
+
+        Fault tolerance (§5.3 failure posture): pass ``checkpoint_dir``
+        to install periodic crash-safe checkpointing — every
+        ``checkpoint_period`` epochs the params land in a step-numbered
+        :class:`~mxnet_tpu.checkpoint.CheckpointManager` store, and with
+        ``auto_resume=True`` (default) a restarted job picks up from
+        ``latest_step() + 1`` instead of epoch 0, so a crash costs at
+        most ``checkpoint_period`` epochs of work.
+        """
         assert num_epoch is not None, "please specify number of epochs"
         initializer = initializer or init_mod.Uniform(0.01)
         self.bind(data_shapes=train_data.provide_data,
@@ -260,6 +270,32 @@ class BaseModule:
                          force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=dict(optimizer_params))
+        ckpt_mgr = None
+        if checkpoint_dir is not None:
+            from ..checkpoint import CheckpointManager
+            ckpt_mgr = CheckpointManager(checkpoint_dir)
+            if auto_resume:
+                latest = ckpt_mgr.latest_step()
+                if latest is not None:
+                    arg, aux = self.get_params()
+                    template = {"arg": {k: v._jax for k, v in arg.items()},
+                                "aux": {k: v._jax for k, v in aux.items()}}
+                    restored = ckpt_mgr.restore(latest, template=template)
+                    self.set_params(
+                        {k: NDArray(v) for k, v in restored["arg"].items()},
+                        {k: NDArray(v) for k, v in restored["aux"].items()},
+                        force_init=True)
+                    # optimizer slot state (momentum/Adam moments) rides
+                    # in a sidecar so the resumed trajectory matches an
+                    # uninterrupted run, not a cold optimizer restart
+                    states = _read_opt_states(checkpoint_dir, latest)
+                    if states is not None and \
+                            getattr(self, "_updater", None) is not None:
+                        self._updater.set_states(states)
+                    begin_epoch = max(begin_epoch, latest + 1)
+                    self.logger.info(
+                        "fit: auto-resumed from checkpoint epoch %d; "
+                        "starting at epoch %d", latest, begin_epoch)
         if validation_metric is None:
             validation_metric = eval_metric
         if not isinstance(eval_metric, metric_mod.EvalMetric):
@@ -284,6 +320,20 @@ class BaseModule:
                                          locals()))
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+            # chaos hook: tests kill the loop here to exercise resume
+            from .. import fault as _fault
+            _fault.fire("module.fit.epoch")
+            if ckpt_mgr is not None and (
+                    (epoch + 1) % max(1, checkpoint_period) == 0
+                    or epoch == num_epoch - 1):
+                arg, aux = self.get_params()
+                ckpt_mgr.save(epoch,
+                              {"arg": {k: v._jax for k, v in arg.items()},
+                               "aux": {k: v._jax for k, v in aux.items()}})
+                if getattr(self, "_updater", None) is not None:
+                    _write_opt_states(checkpoint_dir, epoch,
+                                      self._updater.get_states(False),
+                                      keep=ckpt_mgr.all_steps())
             if epoch_end_callback is not None:
                 arg, aux = self.get_params()
                 for cb in _as_list(epoch_end_callback):
@@ -304,6 +354,40 @@ class BaseModule:
 
 def _as_list(x):
     return x if isinstance(x, (list, tuple)) else [x]
+
+
+def _opt_states_path(directory, epoch):
+    return os.path.join(directory, "optstate-%d.bin" % epoch)
+
+
+def _write_opt_states(directory, epoch, blob, keep=()):
+    """Crash-safe optimizer-state sidecar next to the orbax step dirs
+    (write sibling + rename), pruned to the manager's retained steps."""
+    path = _opt_states_path(directory, epoch)
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, path)
+    retained = set(keep) | {epoch}
+    for entry in os.listdir(directory):
+        if entry.startswith("optstate-") and entry.endswith(".bin"):
+            try:
+                step = int(entry[len("optstate-"):-len(".bin")])
+            except ValueError:
+                continue
+            if step not in retained:
+                try:
+                    os.remove(os.path.join(directory, entry))
+                except OSError:
+                    pass
+
+
+def _read_opt_states(directory, epoch):
+    path = _opt_states_path(directory, epoch)
+    if not os.path.exists(path):
+        return None
+    with open(path, "rb") as f:
+        return f.read()
 
 
 class Module(BaseModule):

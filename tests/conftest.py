@@ -50,6 +50,19 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    # chaos lane: fault-injection tests (tests/test_fault.py).  They run
+    # inside tier-1's `not slow` selection — the FaultInjector's virtual
+    # clock keeps retry/backoff schedules sleep-free, so determinism
+    # comes from exact call ordinals, not wall-clock races.
+    config.addinivalue_line(
+        "markers",
+        "chaos: deterministic fault-injection tests (virtual delays, "
+        "no real sleeps; kept fast enough for tier-1)")
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 `not slow` selection")
+
+
 def pytest_collection_modifyitems(config, items):
     if TPU_LANE and not _tpu_reachable:
         skip = pytest.mark.skip(

@@ -1,0 +1,707 @@
+"""Chaos suite: the fault-tolerance ladder under deterministic injection.
+
+Every test is marked ``chaos`` and stays inside tier-1's `not slow`
+selection: retry/backoff schedules run under mxnet_tpu.fault's virtual
+clock wherever wall time doesn't matter, and the few tests that need
+real sockets use sub-second knobs (MX_KVSTORE_RETRY_BASE=0.05 etc.).
+
+Coverage, bottom-up:
+  * RetryPolicy schedule + deadline math (virtual time, zero real sleep)
+  * FaultInjector arming (ordinals, counts, env spec, virtual delay)
+  * recv_msg timeout semantics (stalled peer raises, idle is fine)
+  * server-side exactly-once replay cache (idempotent PUSH replay)
+  * barrier: MX_KVSTORE_BARRIER_TIMEOUT + stale-worker eviction
+  * dist_async end-to-end: worker survives a parameter-server restart
+    (snapshot durability + client reconnect-and-replay), injected
+    connection drops, and the loud terminal error past the deadline
+  * crash-safe save_sharded (kill between write and commit)
+  * resume_or_init / Module.fit auto-resume after an injected crash
+"""
+import os
+import pickle
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import fault
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.kvstore.server import (KVStoreServer, recv_msg, send_msg,
+                                      serve_forever)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fault.clear()
+    yield
+    fault.clear()
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+def test_retry_policy_backoff_schedule_virtual():
+    with fault.use_virtual_time() as clk:
+        p = fault.RetryPolicy(deadline=10.0, base=0.5, max_delay=4.0,
+                              jitter=0.0)
+        attempts = list(p)
+    # sleeps 0.5,1,2,4 = 7.5s; the next 4s delay would exceed deadline 10
+    assert attempts == [0, 1, 2, 3, 4]
+    assert clk.sleeps == [0.5, 1.0, 2.0, 4.0]
+
+
+def test_retry_policy_jitter_is_bounded_and_seeded():
+    import random
+    p = fault.RetryPolicy(deadline=1, base=1.0, max_delay=8.0, jitter=0.5,
+                          rng=random.Random(7))
+    q = fault.RetryPolicy(deadline=1, base=1.0, max_delay=8.0, jitter=0.5,
+                          rng=random.Random(7))
+    for k in range(4):
+        d_p, d_q = p.delay(k), q.delay(k)
+        assert d_p == d_q                      # deterministic under a seed
+        base = min(1.0 * 2 ** k, 8.0)
+        assert base <= d_p <= base * 1.5
+
+
+def test_retry_policy_reads_env(monkeypatch):
+    monkeypatch.setenv("MX_KVSTORE_RETRY_DEADLINE", "3.5")
+    monkeypatch.setenv("MX_KVSTORE_RETRY_BASE", "0.25")
+    monkeypatch.setenv("MX_KVSTORE_RETRY_MAX", "1.5")
+    monkeypatch.setenv("MX_KVSTORE_RETRY_JITTER", "0")
+    p = fault.RetryPolicy.from_env()
+    assert (p.deadline, p.base, p.max_delay, p.jitter) == (3.5, 0.25, 1.5, 0)
+
+
+# ---------------------------------------------------------------------------
+# FaultInjector
+# ---------------------------------------------------------------------------
+
+def test_inject_fires_on_exact_ordinals():
+    fault.inject("t.site", action="error", after=2, count=2)
+    fault.fire("t.site")                       # call 0: skipped
+    fault.fire("t.site")                       # call 1: skipped
+    with pytest.raises(fault.FaultError):
+        fault.fire("t.site")                   # call 2: fires
+    with pytest.raises(fault.FaultError):
+        fault.fire("t.site")                   # call 3: fires
+    fault.fire("t.site")                       # count exhausted
+    assert fault.site_calls("t.site") == 5
+
+
+def test_inject_close_runs_on_close_hook():
+    closed = []
+    fault.inject("t.close", action="close")
+    with pytest.raises(fault.FaultError) as ei:
+        fault.fire("t.close", on_close=lambda: closed.append(True))
+    assert closed == [True]
+    assert isinstance(ei.value, ConnectionError)   # transport-shaped
+
+
+def test_inject_delay_is_virtual():
+    fault.inject("t.delay", action="delay", delay=7.5)
+    with fault.use_virtual_time() as clk:
+        t0 = time.monotonic()
+        fault.fire("t.delay")
+        elapsed = time.monotonic() - t0
+    assert clk.now() == 7.5                    # virtual clock advanced
+    assert elapsed < 1.0                       # ...but no real sleep
+
+
+def test_disarm_and_clear():
+    rule = fault.inject("t.d", action="error", count=-1)
+    fault.disarm(rule)
+    fault.fire("t.d")                          # disarmed: no-op
+    fault.inject("t.d", action="error", count=-1)
+    fault.clear("t.d")
+    fault.fire("t.d")
+
+
+def test_arm_from_env_spec():
+    rules = fault.arm_from_env(
+        "a.site:error:after=1,count=3;b.site:delay:delay=0.5")
+    assert len(rules) == 2
+    assert (rules[0].site, rules[0].after, rules[0].count) == ("a.site", 1, 3)
+    assert (rules[1].action, rules[1].delay) == ("delay", 0.5)
+    with pytest.raises(ValueError):
+        fault.arm_from_env("missing-action")
+    with pytest.raises(ValueError):
+        fault.arm_from_env("a:error:bogus=1")
+
+
+def test_launch_py_forwards_fault_spec():
+    """tools/launch.py --fault arms MX_FAULT_INJECT in every worker."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "1", "--launcher", "local",
+         "--fault", "kvstore.send:close:after=3", "--",
+         sys.executable, "-c",
+         "import os; print('SPEC=' + os.environ['MX_FAULT_INJECT'])"],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "SPEC=kvstore.send:close:after=3" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# recv_msg timeout (satellite: a stalled peer must not hang the thread)
+# ---------------------------------------------------------------------------
+
+def test_recv_msg_times_out_on_silent_peer():
+    a, b = socket.socketpair()
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            recv_msg(a, timeout=0.15)
+        assert time.monotonic() - t0 < 2.0
+    finally:
+        a.close()
+        b.close()
+
+
+def test_recv_msg_times_out_mid_message():
+    a, b = socket.socketpair()
+    try:
+        # header promises 100 bytes; peer stalls after 10
+        b.sendall(struct.pack("<Q", 100) + b"x" * 10)
+        with pytest.raises(TimeoutError) as ei:
+            recv_msg(a, timeout=0.15)
+        assert "mid-message" in str(ei.value)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_recv_msg_idle_block_still_bounds_started_message():
+    """idle_block=True waits forever for a message to START, but once the
+    first byte lands the rest is bounded — the server-loop posture."""
+    a, b = socket.socketpair()
+    try:
+        b.sendall(b"\x01")                     # message started, then stall
+        with pytest.raises(TimeoutError):
+            recv_msg(a, timeout=0.15, idle_block=True)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_recv_msg_default_from_env(monkeypatch):
+    monkeypatch.setenv("MX_KVSTORE_RECV_TIMEOUT", "0.15")
+    a, b = socket.socketpair()
+    try:
+        with pytest.raises(TimeoutError):
+            recv_msg(a)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_recv_msg_roundtrip_unaffected():
+    a, b = socket.socketpair()
+    try:
+        send_msg(b, ("PING", "r0:x"))
+        assert recv_msg(a, timeout=1.0) == ("PING", "r0:x")
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# server: exactly-once replay, heartbeat liveness, barrier eviction
+# ---------------------------------------------------------------------------
+
+def test_server_replay_cache_applies_push_exactly_once():
+    srv = KVStoreServer(num_workers=1)
+    srv.handle_request(("SEQ", "r0:x", 1, ("INIT", "w", np.ones(3))))
+    ok, _ = srv.handle_request(("SEQ", "r0:x", 2, ("PUSH", "w", np.ones(3))))
+    assert ok
+    # reconnect-replay of the SAME seq: answered from cache, NOT re-applied
+    ok2, _ = srv.handle_request(("SEQ", "r0:x", 2, ("PUSH", "w",
+                                                    np.ones(3))))
+    assert ok2
+    ok3, val = srv.handle_request(("SEQ", "r0:x", 3, ("PULL", "w")))
+    assert ok3
+    np.testing.assert_allclose(val, 2.0)       # init 1 + exactly one push
+    # a MUTATING seq from the past is refused, never silently re-run
+    # (PULL/PING are idempotent and bypass the cache entirely)
+    ok4, msg4 = srv.handle_request(("SEQ", "r0:x", 1, ("PUSH", "w",
+                                                       np.ones(3))))
+    assert not ok4 and "stale" in str(msg4)
+    _, val2 = srv.handle_request(("SEQ", "r0:x", 4, ("PULL", "w")))
+    np.testing.assert_allclose(val2, 2.0)      # store untouched by stale
+
+
+def test_replay_cache_survives_snapshot_restart(tmp_path):
+    """Exactly-once across the restart itself: a PUSH applied and
+    snapshotted right before the crash is answered from the restored
+    cache when the reconnecting client replays it — never re-applied."""
+    snap = str(tmp_path / "s.pkl")
+    srv = KVStoreServer(num_workers=1, snapshot_path=snap)
+    srv.handle_request(("SEQ", "r0:x", 1, ("INIT", "w", np.ones(2))))
+    srv.handle_request(("SEQ", "r0:x", 2, ("PUSH", "w", np.ones(2))))
+    # crash after snapshot, before the reply reached the worker:
+    srv2 = KVStoreServer(num_workers=1, snapshot_path=snap)   # restart
+    ok, _ = srv2.handle_request(("SEQ", "r0:x", 2, ("PUSH", "w",
+                                                    np.ones(2))))
+    assert ok
+    _, val = srv2.handle_request(("SEQ", "r0:x", 3, ("PULL", "w")))
+    np.testing.assert_allclose(val, 2.0)       # once, not twice
+
+
+def test_replay_cache_resolves_even_when_handler_faults():
+    """A handler fault must still resolve the seq's cache entry with an
+    error — a forever-pending entry would make every replay wait out the
+    full window and starve the client's retry deadline."""
+    srv = KVStoreServer(num_workers=1)
+    with pytest.raises(Exception):
+        srv.handle_request(("SEQ", "r0:x", 5, ("PUSH",)))   # malformed
+    t0 = time.monotonic()
+    ok, payload = srv.handle_request(("SEQ", "r0:x", 5, ("PUSH",)))
+    assert time.monotonic() - t0 < 1.0       # instant, no in-flight wait
+    assert not ok and "server error" in str(payload)
+
+
+def test_concurrent_pushes_with_snapshot_do_not_race(tmp_path):
+    """Snapshot writes are serialized: concurrent handler threads all
+    snapshotting after their mutations must never collide on the temp
+    file (the loser's os.replace used to throw FileNotFoundError)."""
+    snap = str(tmp_path / "s.pkl")
+    srv = KVStoreServer(num_workers=8, snapshot_path=snap)
+    srv.handle_request(("SEQ", "r0:a", 1, ("INIT", "w", np.zeros(4))))
+    errs = []
+
+    def push(cid):
+        try:
+            ok, p = srv.handle_request(
+                ("SEQ", cid, 2, ("PUSH", "w", np.ones(4))))
+            assert ok, p
+        except Exception as e:               # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=push, args=("r%d:c" % i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    srv.snapshot()                           # settle the final state
+    srv2 = KVStoreServer(num_workers=8, snapshot_path=snap)
+    np.testing.assert_allclose(srv2._store["w"], 8.0)
+
+
+def test_server_ping_tracks_liveness():
+    srv = KVStoreServer(num_workers=2)
+    ok, payload = srv.handle(("PING", "r0:abc"))
+    assert ok and payload == "PONG"
+    assert "r0" in srv._last_seen
+
+
+def test_barrier_timeout_env(monkeypatch):
+    """Satellite: the hardcoded 120s barrier wait is now env-tunable."""
+    monkeypatch.setenv("MX_KVSTORE_BARRIER_TIMEOUT", "0.3")
+    monkeypatch.setenv("MX_KVSTORE_STALE_TIMEOUT", "30")
+    srv = KVStoreServer(num_workers=2)
+    t0 = time.monotonic()
+    ok, payload = srv.handle(("BARRIER", None))
+    elapsed = time.monotonic() - t0
+    assert not ok and "timed out" in str(payload)
+    assert 0.2 < elapsed < 3.0                 # honored 0.3, not 120
+
+
+def test_barrier_releases_when_stale_worker_evicted(monkeypatch):
+    """A wedged worker cannot hold BARRIER forever: once it goes silent
+    past MX_KVSTORE_STALE_TIMEOUT it leaves the quorum and the live
+    workers proceed."""
+    monkeypatch.setenv("MX_KVSTORE_STALE_TIMEOUT", "0.25")
+    monkeypatch.setenv("MX_KVSTORE_BARRIER_TIMEOUT", "20")
+    srv = KVStoreServer(num_workers=2)
+    srv.touch("r1:wedged")                     # seen once, then silent
+    time.sleep(0.35)                           # past the stale window
+    t0 = time.monotonic()
+    ok, _ = srv.handle_request(("SEQ", "r0:live", 1, ("BARRIER", None)))
+    assert ok
+    assert time.monotonic() - t0 < 5.0         # released, no 20s strand
+
+
+def test_barrier_waits_for_workers_never_seen(monkeypatch):
+    """Eviction only applies to workers that went silent AFTER being
+    seen — a worker still booting must be waited for."""
+    monkeypatch.setenv("MX_KVSTORE_STALE_TIMEOUT", "0.2")
+    monkeypatch.setenv("MX_KVSTORE_BARRIER_TIMEOUT", "0.4")
+    srv = KVStoreServer(num_workers=2)         # worker 1 never connects
+    ok, payload = srv.handle(("BARRIER", None))
+    assert not ok and "timed out" in str(payload)
+
+
+# ---------------------------------------------------------------------------
+# dist_async end-to-end: server restart survival
+# ---------------------------------------------------------------------------
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _start_server(port, snapshot=None, num_workers=1):
+    t = threading.Thread(
+        target=serve_forever,
+        kwargs=dict(port=port, num_workers=num_workers,
+                    snapshot_path=snapshot),
+        daemon=True)
+    t.start()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port), timeout=0.2).close()
+            return t
+        except OSError:
+            time.sleep(0.05)
+    raise RuntimeError("server did not come up on %d" % port)
+
+
+def _stop_server(port, thread):
+    raw = socket.create_connection(("127.0.0.1", port), timeout=5)
+    send_msg(raw, ("STOP", None))
+    assert recv_msg(raw, timeout=5)[0]
+    raw.close()
+    thread.join(timeout=10)
+    assert not thread.is_alive()
+
+
+@pytest.fixture
+def _fast_retries(monkeypatch):
+    monkeypatch.setenv("MX_KVSTORE_RETRY_DEADLINE", "20")
+    monkeypatch.setenv("MX_KVSTORE_RETRY_BASE", "0.05")
+    monkeypatch.setenv("MX_KVSTORE_RETRY_MAX", "0.25")
+    monkeypatch.setenv("MX_KVSTORE_HEARTBEAT", "0")   # no bg threads here
+    monkeypatch.delenv("MX_PS_ROOTS", raising=False)
+
+
+def _make_client(monkeypatch, port):
+    from mxnet_tpu.kvstore.kvstore import KVStoreDistAsync
+    monkeypatch.setenv("MX_PS_ROOT", "127.0.0.1:%d" % port)
+    return KVStoreDistAsync()
+
+
+def test_worker_survives_server_restart(_fast_retries, monkeypatch,
+                                        tmp_path):
+    """THE acceptance case: push, kill the PS mid-session, restart it on
+    the same port (snapshot-backed), and the client's next pull succeeds
+    within the retry deadline — no data loss, optimizer state intact."""
+    from mxnet_tpu import optimizer
+    port = _free_port()
+    snap = str(tmp_path / "ps.pkl")
+    t = _start_server(port, snapshot=snap)
+    kv = _make_client(monkeypatch, port)
+    try:
+        kv.init("w", mx.nd.ones((4,)))
+        kv.set_optimizer(optimizer.SGD(learning_rate=0.5))
+        kv.push("w", mx.nd.ones((4,)))
+        out = mx.nd.zeros((4,))
+        kv.pull("w", out=out)
+        np.testing.assert_allclose(out.asnumpy(), 0.5)
+
+        _stop_server(port, t)                  # ...the server dies...
+
+        # restart with a delay, while the client is already retrying
+        def restart():
+            time.sleep(0.4)
+            _start_server(port, snapshot=snap)
+        restarter = threading.Thread(target=restart, daemon=True)
+        restarter.start()
+        out2 = mx.nd.zeros((4,))
+        t0 = time.monotonic()
+        kv.pull("w", out=out2)                 # rides through the outage
+        assert time.monotonic() - t0 < 20      # inside the retry deadline
+        np.testing.assert_allclose(out2.asnumpy(), 0.5)   # no data loss
+
+        # the restored server still applies the optimizer (snapshot
+        # carried the SET_OPT blob + slot states, not just weights)
+        kv.push("w", mx.nd.ones((4,)))
+        kv.pull("w", out=out2)
+        np.testing.assert_allclose(out2.asnumpy(), 0.0)
+        restarter.join()
+    finally:
+        kv.stop_server()
+
+
+def test_client_rides_through_injected_connection_drops(
+        _fast_retries, monkeypatch):
+    """Deterministic chaos: the kvstore.send site closes the connection
+    twice; the RPC layer reconnects and replays without the caller ever
+    noticing."""
+    port = _free_port()
+    t = _start_server(port)
+    kv = _make_client(monkeypatch, port)
+    try:
+        kv.init("w", mx.nd.ones((2,)))
+        fault.inject("kvstore.send", action="close", count=2)
+        out = mx.nd.zeros((2,))
+        kv.pull("w", out=out)                  # absorbed both drops
+        np.testing.assert_allclose(out.asnumpy(), 1.0)
+        assert fault.site_calls("kvstore.send") >= 3
+    finally:
+        fault.clear()
+        kv.stop_server()
+        t.join(timeout=10)
+
+
+def test_terminal_error_after_retry_deadline(_fast_retries, monkeypatch):
+    """Past the deadline the failure is LOUD: MXNetError naming the knob
+    and the last transport error, not a hang or a silent None."""
+    monkeypatch.setenv("MX_KVSTORE_RETRY_DEADLINE", "0.6")
+    port = _free_port()
+    t = _start_server(port)
+    kv = _make_client(monkeypatch, port)
+    kv.init("w", mx.nd.ones((2,)))
+    _stop_server(port, t)                      # gone for good
+    out = mx.nd.zeros((2,))
+    t0 = time.monotonic()
+    with pytest.raises(MXNetError) as ei:
+        kv.pull("w", out=out)
+    assert time.monotonic() - t0 < 10
+    assert "MX_KVSTORE_RETRY_DEADLINE" in str(ei.value)
+
+
+def test_heartbeat_thread_keeps_worker_live(monkeypatch, tmp_path):
+    """With heartbeats on, a client that does NO data RPCs for longer
+    than the stale window still counts as live (its rank stays fresh in
+    the server's last-seen table)."""
+    monkeypatch.setenv("MX_KVSTORE_RETRY_DEADLINE", "10")
+    monkeypatch.setenv("MX_KVSTORE_HEARTBEAT", "0.1")
+    monkeypatch.delenv("MX_PS_ROOTS", raising=False)
+    port = _free_port()
+    # in-process server STATE so the test can inspect last-seen directly
+    srv = KVStoreServer(num_workers=1)
+    stop = threading.Event()
+
+    def serve():
+        import socketserver
+
+        class H(socketserver.BaseRequestHandler):
+            def handle(self):
+                while not stop.is_set():
+                    try:
+                        msg = recv_msg(self.request, timeout=1.0,
+                                       idle_block=False)
+                    except TimeoutError:
+                        continue
+                    except (ConnectionError, OSError):
+                        return
+                    ok, payload = srv.handle_request(msg)
+                    send_msg(self.request, (ok, payload))
+
+        class S(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        with S(("127.0.0.1", port), H) as s:
+            threading.Thread(target=s.serve_forever, daemon=True).start()
+            stop.wait()
+            s.shutdown()
+
+    threading.Thread(target=serve, daemon=True).start()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port), timeout=0.2).close()
+            break
+        except OSError:
+            time.sleep(0.05)
+    kv = _make_client(monkeypatch, port)
+    try:
+        time.sleep(0.45)                       # > stale window, no data RPCs
+        assert "r0" in srv._last_seen
+        assert time.monotonic() - srv._last_seen["r0"] < 0.4
+    finally:
+        kv.close()
+        stop.set()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint: crash-safe save + resume
+# ---------------------------------------------------------------------------
+
+def test_save_sharded_survives_kill_mid_save(tmp_path):
+    """Satellite: a kill between write and commit never corrupts the
+    last restorable checkpoint; the orphan temp dir is swept later."""
+    from mxnet_tpu.checkpoint import save_sharded, restore_sharded
+    p = str(tmp_path / "ck")
+    save_sharded(p, {"w": jnp.ones((4,))})
+    fault.inject("checkpoint.commit", action="crash")
+    with pytest.raises(SystemExit):
+        save_sharded(p, {"w": jnp.zeros((4,))})
+    fault.clear()
+    out = restore_sharded(p, template={"w": jnp.ones((4,))})
+    np.testing.assert_allclose(np.asarray(out["w"]), 1.0)   # intact
+    orphans = [e for e in os.listdir(tmp_path) if ".saving-" in e]
+    assert orphans                              # the victim's debris...
+    save_sharded(p, {"w": jnp.full((4,), 7.0)})
+    out = restore_sharded(p, template={"w": jnp.ones((4,))})
+    np.testing.assert_allclose(np.asarray(out["w"]), 7.0)
+    assert not [e for e in os.listdir(tmp_path) if ".saving-" in e]
+
+
+def test_save_sharded_heals_kill_inside_commit_window(tmp_path):
+    """A kill between the two commit renames leaves the previous
+    checkpoint at '<name>.replaced'; the next restore (or save) promotes
+    it back instead of cold-starting."""
+    from mxnet_tpu.checkpoint import save_sharded, restore_sharded
+    p = str(tmp_path / "ck")
+    save_sharded(p, {"w": jnp.ones((4,))})
+    os.rename(p, p + ".replaced")              # mid-commit crash state
+    out = restore_sharded(p, template={"w": jnp.ones((4,))})
+    np.testing.assert_allclose(np.asarray(out["w"]), 1.0)
+    assert os.path.exists(p) and not os.path.exists(p + ".replaced")
+
+
+def test_resume_or_init_continues_after_injected_crash(tmp_path):
+    """Acceptance: a training loop resumed via resume_or_init continues
+    from the last checkpointed step after an injected crash."""
+    from mxnet_tpu.checkpoint import resume_or_init
+    steps_run = []
+
+    def run(total):
+        state, start, mgr = resume_or_init(
+            str(tmp_path / "run"), lambda: {"w": jnp.zeros((3,))})
+        try:
+            for step in range(start, total):
+                fault.fire("train.step")       # chaos kill point
+                state = {"w": state["w"] + 1.0}
+                mgr.save(step, state)
+                steps_run.append(step)
+        finally:
+            mgr.close()
+        return state
+
+    fault.inject("train.step", action="crash", after=3)
+    with pytest.raises(SystemExit):
+        run(6)                                 # dies entering step 3
+    fault.clear()
+    state = run(6)                             # restart: resumes at 3
+    assert steps_run == [0, 1, 2, 3, 4, 5]     # no step repeated or lost
+    np.testing.assert_allclose(np.asarray(state["w"]), 6.0)
+
+
+def _mlp():
+    from mxnet_tpu import symbol as sym
+    data = sym.Variable("data")
+    h = sym.FullyConnected(data, sym.Variable("fc1_weight"),
+                           sym.Variable("fc1_bias"), num_hidden=16)
+    h = sym.Activation(h, act_type="relu")
+    out = sym.FullyConnected(h, sym.Variable("fc2_weight"),
+                             sym.Variable("fc2_bias"), num_hidden=3)
+    return sym.SoftmaxOutput(out, sym.Variable("softmax_label"),
+                             normalization="batch", name="softmax")
+
+
+def test_module_fit_auto_resumes_after_crash(tmp_path):
+    """Acceptance: Module.fit(checkpoint_dir=...) checkpoints every
+    epoch and a restarted fit resumes from latest_step()+1 with the
+    restored params."""
+    from mxnet_tpu import io as mio
+    from mxnet_tpu.module import Module
+    rng = np.random.RandomState(0)
+    X = rng.randn(96, 8).astype(np.float32)
+    Y = X[:, :3].argmax(axis=1).astype(np.float32)
+    d = str(tmp_path / "fit")
+
+    fault.inject("module.fit.epoch", action="crash", after=2)
+    mod = Module(_mlp(), context=mx.cpu())
+    with pytest.raises(SystemExit):
+        mod.fit(mio.NDArrayIter(X, Y, batch_size=24), optimizer="sgd",
+                optimizer_params={"learning_rate": 1.0}, num_epoch=5,
+                checkpoint_dir=d)              # dies in epoch 2, saved 0-1
+    fault.clear()
+
+    epochs = []
+    mod2 = Module(_mlp(), context=mx.cpu())
+    mod2.fit(mio.NDArrayIter(X, Y, batch_size=24), optimizer="sgd",
+             optimizer_params={"learning_rate": 1.0}, num_epoch=5,
+             checkpoint_dir=d,
+             batch_end_callback=lambda p: epochs.append(p.epoch))
+    assert sorted(set(epochs)) == [2, 3, 4]    # resumed, not restarted
+    # the resumed params came from the checkpoint, and the final fit
+    # leaves a usable model
+    acc = mod2.score(mio.NDArrayIter(X, Y, batch_size=24), "acc")
+    assert acc[0][1] > 1.0 / 3.0 - 0.05, acc   # better than chance
+
+
+def test_module_fit_resume_matches_uninterrupted_momentum_run(tmp_path):
+    """Optimizer slot state (momentum) rides in the checkpoint sidecar:
+    a crash+resume trajectory must match an uninterrupted run, not a
+    cold-optimizer restart."""
+    from mxnet_tpu import io as mio
+    from mxnet_tpu.module import Module
+    rng = np.random.RandomState(3)
+    X = rng.randn(48, 8).astype(np.float32)
+    Y = X[:, :3].argmax(axis=1).astype(np.float32)
+    opt = {"learning_rate": 0.1, "momentum": 0.9}
+    d = str(tmp_path / "fit")
+
+    def fresh():
+        mx.random.seed(42)                      # identical init each time
+        return Module(_mlp(), context=mx.cpu())
+
+    def data():
+        return mio.NDArrayIter(X, Y, batch_size=24)   # deterministic order
+
+    ref = fresh()                               # uninterrupted 4 epochs
+    ref.fit(data(), optimizer="sgd", optimizer_params=opt, num_epoch=4)
+
+    fault.inject("module.fit.epoch", action="crash", after=2)
+    m = fresh()
+    with pytest.raises(SystemExit):             # dies in epoch 2
+        m.fit(data(), optimizer="sgd", optimizer_params=opt, num_epoch=4,
+              checkpoint_dir=d)
+    fault.clear()
+    m2 = fresh()
+    m2.fit(data(), optimizer="sgd", optimizer_params=opt, num_epoch=4,
+           checkpoint_dir=d)                    # resumes epochs 2-3
+
+    ref_arg, _ = ref.get_params()
+    got_arg, _ = m2.get_params()
+    for k in ref_arg:
+        np.testing.assert_allclose(got_arg[k].asnumpy(),
+                                   ref_arg[k].asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_module_fit_resume_restores_exact_params(tmp_path):
+    """The resumed run restores the checkpointed weights bit-for-bit
+    before continuing (auto_resume=False still starts cold)."""
+    from mxnet_tpu import io as mio
+    from mxnet_tpu.module import Module
+    rng = np.random.RandomState(1)
+    X = rng.randn(48, 8).astype(np.float32)
+    Y = X[:, :3].argmax(axis=1).astype(np.float32)
+    d = str(tmp_path / "fit")
+    mod = Module(_mlp(), context=mx.cpu())
+    mod.fit(mio.NDArrayIter(X, Y, batch_size=24), optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5}, num_epoch=2,
+            checkpoint_dir=d)
+    arg, _ = mod.get_params()
+
+    # resumed module: begin beyond num_epoch → pure restore, no training
+    mod2 = Module(_mlp(), context=mx.cpu())
+    mod2.fit(mio.NDArrayIter(X, Y, batch_size=24), optimizer="sgd",
+             optimizer_params={"learning_rate": 0.5}, num_epoch=2,
+             checkpoint_dir=d)
+    arg2, _ = mod2.get_params()
+    for k in arg:
+        np.testing.assert_array_equal(arg[k].asnumpy(), arg2[k].asnumpy())

@@ -62,6 +62,9 @@ def launch_local(args, command):
         # DMLC_ROLE=server processes); with -s N keys shard across the N
         # servers by hash (kvstore_dist.h key->server assignment role)
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        snap_dir = getattr(args, "ps_snapshot_dir", None)
+        if snap_dir:
+            os.makedirs(snap_dir, exist_ok=True)
         for s in range(args.num_servers):
             port = _free_port()
             ps_roots.append("127.0.0.1:%d" % port)
@@ -72,12 +75,24 @@ def launch_local(args, command):
                         "MX_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
                         "PYTHONPATH": repo + os.pathsep +
                         env.get("PYTHONPATH", "")})
+            if snap_dir:
+                # durable PS: a restarted server (same snapshot path)
+                # resumes with no data loss — the client side's
+                # reconnect-and-replay then rides straight through
+                env["MX_PS_SNAPSHOT"] = os.path.join(
+                    snap_dir, "server_%d.pkl" % s)
+            if getattr(args, "fault", None):
+                env["MX_FAULT_INJECT"] = args.fault
             server_procs.append(subprocess.Popen(
                 [sys.executable, "-m", "mxnet_tpu.kvstore.server"],
                 env=env))
     procs = []
     for rank in range(args.num_workers):
         env = _env_for(rank, coordinator, args.num_workers)
+        if getattr(args, "fault", None):
+            # arm the chaos spec in every worker (mxnet_tpu.fault reads
+            # MX_FAULT_INJECT at import)
+            env["MX_FAULT_INJECT"] = args.fault
         if ps_roots:
             env["MX_PS_ROOT"] = ps_roots[0]
             env["MX_PS_ROOTS"] = ",".join(ps_roots)
@@ -146,6 +161,15 @@ def main():
     p.add_argument("--launcher", default="local",
                    choices=["local", "ssh", "manual"])
     p.add_argument("-H", "--hostfile", default=None)
+    p.add_argument("--fault", default=None, metavar="SPEC",
+                   help="arm fault injection in every spawned process "
+                        "(MX_FAULT_INJECT spec, e.g. "
+                        "'kvstore.send:close:after=3'); chaos testing "
+                        "only")
+    p.add_argument("--ps-snapshot-dir", default=None, metavar="DIR",
+                   help="persist each parameter server's store under "
+                        "DIR (atomic pickles) so a restarted server "
+                        "loses no data")
     p.add_argument("command", nargs=argparse.REMAINDER)
     args = p.parse_args()
     command = args.command
