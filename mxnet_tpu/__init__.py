@@ -62,6 +62,7 @@ from . import parallel
 from . import callback
 from . import checkpoint
 from . import fault
+from . import health
 from . import model
 from . import monitor
 from . import module

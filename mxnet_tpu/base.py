@@ -78,6 +78,10 @@ ENV_CATALOG: Dict[str, Any] = {
     "MX_KVSTORE_HEARTBEAT": ("5", "dist_async client: seconds between background PINGs to each server (0 disables); keeps a compute-bound worker from being evicted as stale."),
     "MX_KVSTORE_STALE_TIMEOUT": ("30", "kvstore server: a worker silent this many seconds is evicted from barrier accounting so a wedged peer cannot hold BARRIER forever."),
     "MX_FAULT_INJECT": ("", "Fault-injection spec 'site:action[:k=v,...];...' armed at import (tools/launch.py --fault); see mxnet_tpu/fault.py."),
+    "MX_NAN_POLICY": ("", "fit-loop gradient guard (mxnet_tpu/health.py): 'warn' logs non-finite gradients, 'skip_batch' additionally drops the poisoned update so params stay finite, 'raise' fails the rank fast for the supervisor to restart; empty disables."),
+    "MX_STEP_TIMEOUT": ("", "Seconds a training step may stall before the watchdog thread dumps every thread's stack to stderr and exits the process with code 86, so tools/launch.py --restart on-failure restarts the rank from its last checkpoint; empty disables."),
+    "MX_HEARTBEAT_FILE": ("", "Per-rank liveness file the fit loop atomically rewrites every batch; tools/launch.py --hang-timeout sets it per worker and reads the mtime to tell a slow rank (fresh file) from a wedged one (stale file, killed + restarted)."),
+    "MX_RECORDIO_TOLERATE_CORRUPT": ("0", "1 = a corrupt/truncated .rec record (e.g. a tail torn by a mid-write crash) is skipped-and-counted (reader.corrupt_skipped) and reads end there, instead of raising OSError with the uri and byte offset."),
     "MX_FLASH_BLOCK_Q": ("256", "Pallas flash-attention query-block rows (VMEM tiling knob; sweepable on hardware)."),
     "MX_FLASH_BLOCK_K": ("256", "Pallas flash-attention key-block rows."),
     "MX_NO_CAPTURE_FALLBACK": ("0", "bench.py: never replay a TPU capture (the capture loop's own children set this)."),

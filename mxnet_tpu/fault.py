@@ -30,6 +30,11 @@ Instrumented sites (grep for ``fault.fire``):
   ``server.handle``       server-side, before dispatching a request
   ``checkpoint.commit``   between checkpoint write and atomic rename
   ``module.fit.epoch``    end of each Module.fit epoch (pre-checkpoint)
+  ``worker.step``         start of each fit-loop batch — what
+                          ``launch.py --restart on-failure --fault
+                          'worker.step:crash:after=N'`` supervisor chaos
+                          runs kill into (``delay`` specs here model a
+                          hang for the MX_STEP_TIMEOUT watchdog)
 """
 from __future__ import annotations
 

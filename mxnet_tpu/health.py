@@ -1,0 +1,346 @@
+"""In-process training health guards: NaN policy, step watchdog, heartbeat.
+
+ISSUE 1 built the *recovery* primitives (retrying kvstore client,
+crash-safe checkpoints, ``fit(checkpoint_dir=..., auto_resume)``); this
+module supplies the *detection* half that makes them fire in practice.
+The reference treated step- and process-level health as the scheduler's
+problem (restart the container, resubmit the job); the TensorFlow
+supervisor/monitored-session model (PAPERS.md arXiv:1605.08695) folds it
+into the training stack instead, and that is the shape rebuilt here —
+three small guards the fit loop installs and ``tools/launch.py``'s
+process supervisor observes from outside:
+
+* :class:`GradientGuard` — ``MX_NAN_POLICY`` (``warn`` | ``skip_batch``
+  | ``raise``; empty disables).  Scans the step's gradients for NaN/Inf
+  after backward, before update — ``skip_batch`` drops the poisoned
+  update so the parameters stay finite, ``raise`` fails the rank fast
+  (the supervisor then restarts it from the last checkpoint).  Same
+  observable surface as :class:`~mxnet_tpu.monitor.Monitor` stat hooks
+  (the bound gradient arrays), but cheap enough to run every batch.
+
+* :class:`Watchdog` — ``MX_STEP_TIMEOUT``.  A daemon thread that, when
+  the fit loop stops petting it for longer than the timeout, dumps every
+  thread's stack to stderr and exits the process nonzero
+  (:data:`WATCHDOG_EXIT_CODE`), converting a silent wedge — a deadlocked
+  collective, a hung host callback — into a crash the supervisor can
+  see and restart.  All timing goes through :mod:`mxnet_tpu.fault`'s
+  module clock, so chaos tests drive expiry on a virtual clock with no
+  real sleeps.
+
+* :class:`Heartbeat` — ``MX_HEARTBEAT_FILE``.  Atomically rewrites a
+  per-rank liveness file every batch; the supervisor reads its mtime to
+  distinguish *slow* (file fresh, leave it alone) from *wedged* (file
+  stale beyond ``--hang-timeout``, kill and restart) without any wire
+  protocol between them.
+
+:class:`StepGuard` bundles all three behind the four calls the fit loop
+makes (``batch_start`` / ``allow_update`` / ``batch_end`` / ``close``);
+``StepGuard.from_env()`` arms only what the environment asks for, so an
+unconfigured process pays one no-op attribute check per batch.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+import traceback
+from typing import Callable, Iterable, List, Optional, Tuple
+
+from . import fault as _fault
+from .base import MXNetError, get_env
+
+__all__ = ["WATCHDOG_EXIT_CODE", "NAN_POLICIES", "nonfinite_grads",
+           "dump_all_stacks", "GradientGuard", "Watchdog", "Heartbeat",
+           "StepGuard"]
+
+# Distinct from generic failure (1) and the injected-crash server exit
+# (17) so the supervisor's logs say WHY a rank died; 86 stays clear of
+# the shell's 126/127/128+n conventions.
+WATCHDOG_EXIT_CODE = 86
+
+NAN_POLICIES = ("", "warn", "skip_batch", "raise")
+
+
+def nonfinite_grads(named_grads: Iterable[Tuple[str, object]]) -> List[str]:
+    """Names of gradients containing NaN/Inf.  Accepts (name, NDArray)
+    pairs (None gradients skipped — fixed params).
+
+    The happy path costs ONE host sync: the per-array all-finite
+    reductions stay on device and collapse through a single fused
+    ``jnp.all``; per-name blame (one sync per array) is computed only
+    on the rare poisoned batch."""
+    import jax.numpy as jnp
+    named = [(n, getattr(g, "_jax", g)) for n, g in named_grads
+             if g is not None]
+    if not named:
+        return []
+    finite = [jnp.isfinite(a).all() for _n, a in named]
+    if bool(jnp.all(jnp.stack(finite))):
+        return []
+    return [n for (n, _a), f in zip(named, finite) if not bool(f)]
+
+
+def dump_all_stacks(file=None) -> None:
+    """Write every live thread's stack to ``file`` (default stderr).
+
+    Pure-Python (sys._current_frames + traceback) rather than
+    faulthandler so the output can go to any text stream — tests capture
+    it in a StringIO, the watchdog sends it to stderr where the
+    supervisor's log collector finds it."""
+    file = file if file is not None else sys.stderr
+    frames = sys._current_frames()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    for ident, frame in frames.items():
+        print("--- thread %s (%s) ---" % (ident, names.get(ident, "?")),
+              file=file)
+        for line in traceback.format_stack(frame):
+            file.write(line)
+    file.flush()
+
+
+class GradientGuard:
+    """Apply the ``MX_NAN_POLICY`` to one step's gradients.
+
+    ``allow_update(named_grads)`` returns False when the update must be
+    skipped; ``raise`` policy raises :class:`MXNetError` naming the
+    offending arrays instead."""
+
+    def __init__(self, policy: str = "", logger=None):
+        if policy not in NAN_POLICIES:
+            raise ValueError(
+                "MX_NAN_POLICY must be one of %s, got %r"
+                % ("|".join(p for p in NAN_POLICIES if p), policy))
+        self.policy = policy
+        self.logger = logger or logging
+        self.nan_events = 0          # batches with any non-finite grad
+        self.skipped_batches = 0     # updates dropped under skip_batch
+
+    def allow_update(self, named_grads) -> bool:
+        if not self.policy:
+            return True
+        bad = nonfinite_grads(named_grads)
+        if not bad:
+            return True
+        self.nan_events += 1
+        shown = ", ".join(bad[:4]) + ("..." if len(bad) > 4 else "")
+        if self.policy == "raise":
+            raise MXNetError(
+                "non-finite gradient(s) in %s (MX_NAN_POLICY=raise)"
+                % shown)
+        if self.policy == "skip_batch":
+            self.skipped_batches += 1
+            self.logger.warning(
+                "health: non-finite gradient(s) in %s - skipping this "
+                "batch's update (MX_NAN_POLICY=skip_batch, %d skipped "
+                "so far)", shown, self.skipped_batches)
+            return False
+        self.logger.warning(
+            "health: non-finite gradient(s) in %s (MX_NAN_POLICY=warn: "
+            "update applied anyway)", shown)
+        return True
+
+
+class Watchdog:
+    """Hung-step watchdog: no ``pet()`` for > ``timeout`` seconds ⇒ dump
+    all thread stacks and exit nonzero so the supervisor restarts the
+    rank.
+
+    Timing reads :func:`mxnet_tpu.fault.now` — under
+    ``fault.use_virtual_time()`` tests drive ``expired()``/``check()``
+    directly with zero real sleeps.  The background thread (``start()``)
+    is production-only plumbing: it polls ``check()`` every ``poll``
+    real seconds, so a hang is detected within ``timeout + poll`` —
+    ``poll`` defaults to ``timeout / 2`` (bounded to [0.05, 1.0] s),
+    keeping detection inside 2x the configured timeout."""
+
+    def __init__(self, timeout: float,
+                 on_timeout: Optional[Callable[[], None]] = None,
+                 poll: Optional[float] = None, logger=None):
+        self.timeout = float(timeout)
+        if self.timeout <= 0:
+            raise ValueError("Watchdog timeout must be > 0")
+        self.poll = float(poll) if poll is not None else \
+            min(1.0, max(0.05, self.timeout / 2.0))
+        self.on_timeout = on_timeout
+        self.logger = logger or logging
+        self._last: Optional[float] = None   # None = not yet armed
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.fired = False
+
+    def pet(self) -> None:
+        """Mark progress: the current step window restarts now."""
+        self._last = _fault.now()
+
+    def suspend(self) -> None:
+        """Disarm until the next pet() (long known-slow phases: eval,
+        checkpoint restore)."""
+        self._last = None
+
+    def expired(self) -> bool:
+        last = self._last
+        return last is not None and (_fault.now() - last) > self.timeout
+
+    def check(self) -> bool:
+        """One poll tick: fire on expiry.  Returns True when fired."""
+        if self.fired or not self.expired():
+            return False
+        self.fired = True
+        self._fire()
+        return True
+
+    def _fire(self) -> None:
+        sys.stderr.write(
+            "watchdog: no training-step progress for > %.3gs "
+            "(MX_STEP_TIMEOUT) - dumping thread stacks and exiting %d\n"
+            % (self.timeout, WATCHDOG_EXIT_CODE))
+        dump_all_stacks(sys.stderr)
+        if self.on_timeout is not None:
+            self.on_timeout()
+            return
+        os._exit(WATCHDOG_EXIT_CODE)
+
+    # -- background thread (production path) --------------------------------
+    def start(self) -> "Watchdog":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="mx-step-watchdog", daemon=True)
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll):
+            if self.check():
+                return
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+
+class Heartbeat:
+    """Per-rank liveness file: ``beat()`` atomically rewrites it with
+    ``<unix-time> <epoch> <batch>``; the supervisor reads the mtime."""
+
+    def __init__(self, path: str):
+        self.path = path
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+
+    def beat(self, epoch: int = 0, nbatch: int = 0) -> None:
+        self._write("%d %d" % (epoch, nbatch))
+
+    def done(self) -> None:
+        """Final beat: training finished, the process may legitimately
+        go silent now (export, final eval).  The supervisor sees the
+        'done' token and stops hang enforcement for this rank."""
+        self._write("done")
+
+    def _write(self, tail: str) -> None:
+        import time as _time
+        tmp = "%s.tmp.%d" % (self.path, os.getpid())
+        try:
+            with open(tmp, "w") as f:
+                f.write("%f %s\n" % (_time.time(), tail))
+            os.replace(tmp, self.path)
+        except OSError:
+            pass    # liveness is advisory - never fail training over it
+
+    def remove(self) -> None:
+        try:
+            os.remove(self.path)
+        except OSError:
+            pass
+
+
+class StepGuard:
+    """The fit loop's composite guard: watchdog + heartbeat + NaN policy.
+
+    All three are optional; :meth:`from_env` arms whichever the
+    environment configures.  Usage (what ``BaseModule.fit`` does)::
+
+        guard = StepGuard.from_env(logger=self.logger)
+        try:
+            for epoch ...:
+                for nbatch, batch ...:
+                    guard.batch_start()
+                    forward_backward(batch)
+                    if guard.allow_update(named_grads()):
+                        update()
+                    guard.batch_end(epoch, nbatch)
+        finally:
+            guard.close()
+    """
+
+    def __init__(self, nan_policy: str = "",
+                 step_timeout: Optional[float] = None,
+                 heartbeat_path: Optional[str] = None,
+                 logger=None, on_timeout=None):
+        self.logger = logger or logging
+        self.grad_guard = GradientGuard(nan_policy, logger=self.logger) \
+            if nan_policy else None
+        self.watchdog = None
+        if step_timeout:
+            self.watchdog = Watchdog(step_timeout, logger=self.logger,
+                                     on_timeout=on_timeout).start()
+        self.heartbeat = Heartbeat(heartbeat_path) if heartbeat_path \
+            else None
+        self._steps = 0     # completed batches: arms the watchdog
+
+    @classmethod
+    def from_env(cls, logger=None, **overrides) -> "StepGuard":
+        timeout = get_env("MX_STEP_TIMEOUT", dtype=float)
+        kwargs = dict(
+            nan_policy=get_env("MX_NAN_POLICY", "") or "",
+            step_timeout=timeout if timeout and timeout > 0 else None,
+            heartbeat_path=get_env("MX_HEARTBEAT_FILE", "") or None,
+        )
+        kwargs.update(overrides)
+        return cls(logger=logger, **kwargs)
+
+    @property
+    def armed(self) -> bool:
+        return (self.grad_guard is not None or self.watchdog is not None
+                or self.heartbeat is not None)
+
+    def batch_start(self) -> None:
+        # the watchdog arms only once a batch has COMPLETED: the first
+        # batch includes whole-graph jit compilation (and a restart's
+        # re-compilation), which a steady-state MX_STEP_TIMEOUT must
+        # not count as a hang — the same grace launch.py's heartbeat
+        # liveness grants slow startup
+        if self.watchdog is not None and self._steps > 0:
+            self.watchdog.pet()
+
+    def allow_update(self, named_grads) -> bool:
+        if self.grad_guard is None:
+            return True
+        return self.grad_guard.allow_update(named_grads)
+
+    def batch_end(self, epoch: int = 0, nbatch: int = 0) -> None:
+        self._steps += 1
+        if self.watchdog is not None:
+            self.watchdog.pet()
+        if self.heartbeat is not None:
+            self.heartbeat.beat(epoch, nbatch)
+
+    def epoch_end(self, epoch: int = 0) -> None:
+        """Between epochs (checkpoint save, eval) steps legitimately
+        stall - keep the heartbeat fresh and the watchdog disarmed."""
+        if self.watchdog is not None:
+            self.watchdog.suspend()
+        if self.heartbeat is not None:
+            self.heartbeat.beat(epoch, -1)
+
+    @property
+    def skipped_batches(self) -> int:
+        return self.grad_guard.skipped_batches if self.grad_guard else 0
+
+    def close(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        if self.heartbeat is not None:
+            self.heartbeat.done()   # post-fit silence is not a wedge

@@ -244,7 +244,16 @@ class ResizeIter(DataIter):
 
 class PrefetchingIter(DataIter):
     """Background-thread prefetch over one or more iterators (reference:
-    io.PrefetchingIter — hides iterator latency behind compute)."""
+    io.PrefetchingIter — hides iterator latency behind compute).
+
+    Lifecycle: the prefetch threads live in a ThreadPoolExecutor that
+    must be shut down — ``close()`` (idempotent; also called by
+    ``__del__`` and ``with``-statement exit) drains the in-flight
+    batches and releases the threads, so a training job that churns
+    through many iterators doesn't leak a pool per iterator.  A
+    prefetch worker that raises is surfaced by the NEXT ``next()`` call
+    as an :class:`MXNetError` naming which inner iterator failed, with
+    the original exception chained (``raise ... from``)."""
 
     def __init__(self, iters, rename_data=None, rename_label=None):
         if not isinstance(iters, (list, tuple)):
@@ -253,15 +262,22 @@ class PrefetchingIter(DataIter):
         self.iters = iters
         self._pool = ThreadPoolExecutor(max_workers=len(iters))
         self._futures = None
+        self._closed = False
         self._submit()
 
     def _submit(self):
-        def _one(it):
+        def _one(it, i):
             try:
                 return it.next()
             except StopIteration:
                 return None
-        self._futures = [self._pool.submit(_one, it) for it in self.iters]
+            except Exception as e:
+                raise MXNetError(
+                    "PrefetchingIter: inner iterator %d (%s) raised "
+                    "%s: %s" % (i, type(it).__name__, type(e).__name__,
+                                e)) from e
+        self._futures = [self._pool.submit(_one, it, i)
+                         for i, it in enumerate(self.iters)]
 
     @property
     def provide_data(self):
@@ -272,13 +288,20 @@ class PrefetchingIter(DataIter):
         return sum([i.provide_label for i in self.iters], [])
 
     def reset(self):
+        if self._closed:
+            raise MXNetError("PrefetchingIter is closed")
         for f in self._futures:
-            f.result()
+            try:
+                f.result()
+            except MXNetError:
+                pass        # reset clears a poisoned prefetch slot
         for it in self.iters:
             it.reset()
         self._submit()
 
     def next(self):
+        if self._closed:
+            raise MXNetError("PrefetchingIter is closed")
         batches = [f.result() for f in self._futures]
         if any(b is None for b in batches):
             raise StopIteration
@@ -289,6 +312,33 @@ class PrefetchingIter(DataIter):
             data=sum([b.data for b in batches], []),
             label=sum([(b.label or []) for b in batches], []),
             pad=max(b.pad for b in batches))
+
+    def close(self):
+        """Shut down the prefetch threads.  Safe to call repeatedly;
+        further next()/reset() calls raise.  Never blocks: pending
+        fetches are cancelled and an in-flight one releases its thread
+        when it returns — close() (and __del__, possibly running inside
+        GC on the training thread) must not hang on a wedged inner
+        iterator."""
+        if self._closed:
+            return
+        self._closed = True
+        for f in self._futures or []:
+            f.cancel()
+        self._pool.shutdown(wait=False)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass    # interpreter shutdown: executor internals may be gone
 
 
 class CSVIter(DataIter):

@@ -159,7 +159,11 @@ class FeedForward:
 
         ``checkpoint_dir``/``checkpoint_period``/``auto_resume`` pass
         through to :meth:`Module.fit`'s fault-tolerance hook: periodic
-        crash-safe checkpointing with restart-from-latest resume."""
+        crash-safe checkpointing with restart-from-latest resume.  The
+        delegated loop also installs :class:`mxnet_tpu.health.StepGuard`
+        from the environment, so ``MX_NAN_POLICY`` / ``MX_STEP_TIMEOUT``
+        / ``MX_HEARTBEAT_FILE`` guard classic FeedForward scripts the
+        same as Module ones."""
         data = self._as_iter(X, y, is_train=True)
         if self.epoch_size is not None:
             # reference: epoch_size bounds batches/epoch (the epoch

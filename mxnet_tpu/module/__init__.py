@@ -25,6 +25,7 @@ import numpy as _np
 import jax
 import jax.numpy as jnp
 
+from .. import fault as _fault
 from ..base import MXNetError
 from ..device import Context, cpu, current_context
 from .. import initializer as init_mod
@@ -200,6 +201,18 @@ class BaseModule:
         self.forward(data_batch, is_train=True)
         self.backward()
 
+    def _named_update_grads(self):
+        """(name, grad NDArray) pairs the next update() will apply —
+        what health.GradientGuard scans for NaN/Inf.  Module exposes its
+        executor's grad_dict; BucketingModule delegates to the bucket
+        currently bound."""
+        exec_ = getattr(self, "_exec", None)
+        if exec_ is None:
+            cur = getattr(self, "_curr_module", None)
+            return cur._named_update_grads() if cur is not None else []
+        return [(n, g) for n, g in exec_.grad_dict.items()
+                if g is not None]
+
     def score(self, eval_data, eval_metric, num_batch=None, reset=True,
               epoch=0, batch_end_callback=None):
         """Reference: BaseModule.score."""
@@ -258,6 +271,17 @@ class BaseModule:
         ``auto_resume=True`` (default) a restarted job picks up from
         ``latest_step() + 1`` instead of epoch 0, so a crash costs at
         most ``checkpoint_period`` epochs of work.
+
+        Health guards (:mod:`mxnet_tpu.health`, env-armed): the loop
+        installs ``StepGuard.from_env()`` — ``MX_NAN_POLICY`` scans each
+        step's gradients before update (``skip_batch`` drops poisoned
+        updates so the params stay finite), ``MX_STEP_TIMEOUT`` arms a
+        hung-step watchdog that dumps thread stacks and exits nonzero
+        for the launch.py supervisor to restart, and
+        ``MX_HEARTBEAT_FILE`` keeps a per-rank liveness file fresh every
+        batch.  The per-batch ``worker.step`` fault site is what
+        ``launch.py --fault 'worker.step:crash:after=N'`` chaos specs
+        kill into.
         """
         assert num_epoch is not None, "please specify number of epochs"
         initializer = initializer or init_mod.Uniform(0.01)
@@ -303,25 +327,68 @@ class BaseModule:
         if not isinstance(validation_metric, metric_mod.EvalMetric):
             validation_metric = metric_mod.create(validation_metric)
 
+        from ..health import StepGuard
+        guard = StepGuard.from_env(logger=self.logger)
+        try:
+            self._fit_epochs(
+                train_data, eval_data, eval_metric, validation_metric,
+                begin_epoch, num_epoch, monitor=monitor, guard=guard,
+                ckpt_mgr=ckpt_mgr, checkpoint_dir=checkpoint_dir,
+                checkpoint_period=checkpoint_period,
+                batch_end_callback=batch_end_callback,
+                epoch_end_callback=epoch_end_callback,
+                eval_end_callback=eval_end_callback,
+                eval_batch_end_callback=eval_batch_end_callback)
+        finally:
+            if guard.skipped_batches:
+                self.logger.warning(
+                    "fit: skipped %d poisoned batch update(s) "
+                    "(MX_NAN_POLICY=skip_batch)", guard.skipped_batches)
+            guard.close()
+
+    def _fit_epochs(self, train_data, eval_data, eval_metric,
+                    validation_metric, begin_epoch, num_epoch, *,
+                    monitor, guard, ckpt_mgr, checkpoint_dir,
+                    checkpoint_period, batch_end_callback,
+                    epoch_end_callback, eval_end_callback,
+                    eval_batch_end_callback):
         for epoch in range(begin_epoch, num_epoch):
             eval_metric.reset()
             train_data.reset()
             for nbatch, data_batch in enumerate(train_data):
+                guard.batch_start()
+                # chaos site: launch.py --fault 'worker.step:crash:
+                # after=N' (or a delay spec the watchdog converts into a
+                # restart) kills the rank on an exact batch ordinal; the
+                # watchdog is armed first so an injected hang here is
+                # detected like any mid-step wedge
+                _fault.fire("worker.step")
                 if monitor is not None:
                     monitor.tic()
                 self.forward_backward(data_batch)
-                self.update()
+                # the grad scan is built only when a NaN policy is armed
+                # — an unconfigured run pays one attribute check here
+                if guard.grad_guard is None or \
+                        guard.allow_update(self._named_update_grads()):
+                    self.update()
+                elif getattr(self, "_grad_req", None) == "add":
+                    # skipped batch under accumulating gradients: purge
+                    # the poisoned sums, or the NaN would infect every
+                    # later backward's += and freeze training silently
+                    for _n, g in self._named_update_grads():
+                        g._set_jax(jnp.zeros_like(g._jax))
                 self.update_metric(eval_metric, data_batch.label)
                 if monitor is not None:
                     monitor.toc_print()
+                guard.batch_end(epoch, nbatch)
                 if batch_end_callback is not None:
                     for cb in _as_list(batch_end_callback):
                         cb(BatchEndParam(epoch, nbatch, eval_metric,
                                          locals()))
+            guard.epoch_end(epoch)
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             # chaos hook: tests kill the loop here to exercise resume
-            from .. import fault as _fault
             _fault.fire("module.fit.epoch")
             if ckpt_mgr is not None and (
                     (epoch + 1) % max(1, checkpoint_period) == 0

@@ -20,6 +20,7 @@ import numbers
 import os
 import struct
 import threading
+import warnings
 from collections import namedtuple
 
 import numpy as _np
@@ -82,6 +83,8 @@ class MXRecordIO:
         self._lib = None      # pinned per instance so close() survives
         self._pyfile = None   # python fallback
         self._read_lock = threading.Lock()
+        self.corrupt_skipped = 0   # records dropped under tolerate mode
+        self._corrupt_eof = False  # tolerated damage: reads report EOF
         self.open()
 
     # -- lifecycle ----------------------------------------------------------
@@ -98,6 +101,7 @@ class MXRecordIO:
                 raise OSError("cannot open %r" % self.uri)
         else:
             self._pyfile = open(self.uri, "wb" if self.flag == "w" else "rb")
+        self._corrupt_eof = False     # reset()/reopen clears the latch
         self.is_open = True
 
     def close(self):
@@ -160,6 +164,11 @@ class MXRecordIO:
     def read(self):
         """Next record payload as bytes, or None at EOF."""
         assert self.flag == "r"
+        if self._corrupt_eof:
+            # a tolerated corruption ended this pass: stay EOF (and keep
+            # the count stable) instead of re-detecting the same damage
+            # on every subsequent call — reset() clears the latch
+            return None
         if self._handle is not None:
             data = ctypes.c_char_p()
             size = ctypes.c_uint64()
@@ -168,9 +177,38 @@ class MXRecordIO:
             if rc == 1:
                 return None
             if rc != 0:
-                raise OSError("corrupt recordio file %r" % self.uri)
+                return self._corrupt_record(
+                    self._lib.MXRecordIOReaderTell(self._handle),
+                    "corrupt record")
             return ctypes.string_at(data, size.value)
         return self._py_read()
+
+    def _corrupt_record(self, offset: int, why: str):
+        """Corruption policy, shared by both reader backends.
+
+        The classic damage is a tail record cut short by a mid-write
+        crash; default is a loud OSError naming the uri and byte offset
+        so the operator knows exactly what to truncate or re-pack.
+        With ``MX_RECORDIO_TOLERATE_CORRUPT=1`` the damaged record is
+        skipped-and-counted (``self.corrupt_skipped``) and the read
+        reports EOF — resuming a job over the damaged file keeps every
+        intact record before the tear."""
+        from .base import get_env
+        if get_env("MX_RECORDIO_TOLERATE_CORRUPT", dtype=bool):
+            self.corrupt_skipped += 1
+            warnings.warn(
+                "recordio: skipping %s in %r at byte offset %d "
+                "(MX_RECORDIO_TOLERATE_CORRUPT=1; %d skipped so far)"
+                % (why, self.uri, offset, self.corrupt_skipped))
+            self._corrupt_eof = True         # damaged tail: stop here
+            if self._pyfile is not None:
+                self._pyfile.seek(0, 2)
+            return None
+        raise OSError(
+            "%s in recordio file %r at byte offset %d (set "
+            "MX_RECORDIO_TOLERATE_CORRUPT=1 to skip damaged records, "
+            "e.g. a tail torn by a mid-write crash)"
+            % (why, self.uri, offset))
 
     def tell(self) -> int:
         if self._handle is not None:
@@ -213,21 +251,32 @@ class MXRecordIO:
 
     def _py_read(self):
         f = self._pyfile
+        start = f.tell()             # record start: reported on damage
         out = []
         in_multi = False
         while True:
             head = f.read(4)
             if not head and not in_multi:
-                return None
-            if len(head) != 4 or struct.unpack("<I", head)[0] != _MAGIC:
-                raise OSError("corrupt recordio file %r" % self.uri)
-            lrec = struct.unpack("<I", f.read(4))[0]
+                return None          # clean EOF on a record boundary
+            if len(head) != 4:
+                return self._corrupt_record(
+                    start, "truncated record header (mid-write tear)")
+            if struct.unpack("<I", head)[0] != _MAGIC:
+                return self._corrupt_record(
+                    start, "corrupt record header (bad magic)")
+            lenb = f.read(4)
+            if len(lenb) != 4:
+                return self._corrupt_record(
+                    start, "truncated record length field")
+            lrec = struct.unpack("<I", lenb)[0]
             cflag, clen = lrec >> 29, lrec & ((1 << 29) - 1)
             if in_multi:
                 out.append(struct.pack("<I", _MAGIC))
             data = f.read(clen)
             if len(data) != clen:
-                raise OSError("truncated recordio file %r" % self.uri)
+                return self._corrupt_record(
+                    start, "truncated record payload (%d of %d bytes)"
+                    % (len(data), clen))
             f.read((4 - (clen & 3)) & 3)
             out.append(data)
             if cflag in (0, 3):
@@ -265,6 +314,10 @@ class MXIndexedRecordIO(MXRecordIO):
 
     def seek(self, idx):
         assert self.flag == "r"
+        # the corrupt-EOF latch is a sequential-pass concept; a seek
+        # repositions the stream, so one tolerated bad record must not
+        # swallow every other (intact) record of a random-access pass
+        self._corrupt_eof = False
         pos = self.idx[idx]
         if self._handle is not None:
             self._lib.MXRecordIOReaderSeek(self._handle, pos)

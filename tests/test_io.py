@@ -577,3 +577,155 @@ def test_image_record_uint8_iter(tmp_path):
         ImageRecordUInt8Iter(path_imgrec=prefix + ".rec",
                              data_shape=(3, 28, 28), batch_size=4,
                              mean_r=123.0)
+
+
+# -- recordio corruption policy (ISSUE 2 satellite) ---------------------------
+
+@pytest.fixture
+def _py_recordio(monkeypatch):
+    """Pin the pure-python reader: corruption-policy tests must not
+    depend on how the native parser classifies a torn tail."""
+    monkeypatch.setattr(recordio, "_LIB", None)
+    monkeypatch.setattr(recordio, "_LIB_TRIED", True)
+
+
+def _write_rec(path, payloads):
+    w = recordio.MXRecordIO(path, "w")
+    for p in payloads:
+        w.write(p)
+    w.close()
+
+
+def test_recordio_truncated_tail_names_uri_and_offset(_py_recordio,
+                                                      tmp_path):
+    """A tail torn by a mid-write crash raises OSError naming the file
+    and the damaged record's byte offset; intact records still read."""
+    path = str(tmp_path / "torn.rec")
+    _write_rec(path, [b"alpha", b"beta", b"gamma-payload"])
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(size - 6)               # tear into the last payload
+    r = recordio.MXRecordIO(path, "r")
+    assert r.read() == b"alpha"
+    assert r.read() == b"beta"
+    tail_offset = r.tell()
+    with pytest.raises(OSError) as ei:
+        r.read()
+    msg = str(ei.value)
+    assert path in msg and "byte offset %d" % tail_offset in msg
+    assert "truncated" in msg
+    r.close()
+
+
+def test_recordio_corrupt_header_detected(_py_recordio, tmp_path):
+    path = str(tmp_path / "bad.rec")
+    _write_rec(path, [b"first", b"second"])
+    with open(path, "r+b") as f:
+        # last record = magic(4) + len(4) + b"second"(6) + pad(2)
+        f.seek(-16, os.SEEK_END)
+        f.write(b"\xde\xad\xbe\xef")       # stomp the record's magic
+    r = recordio.MXRecordIO(path, "r")
+    assert r.read() == b"first"
+    with pytest.raises(OSError) as ei:
+        r.read()
+    assert "byte offset" in str(ei.value)
+    r.close()
+
+
+def test_recordio_tolerate_corrupt_skips_and_counts(_py_recordio, tmp_path,
+                                                    monkeypatch):
+    """MX_RECORDIO_TOLERATE_CORRUPT=1: the damaged tail reads as EOF,
+    the skip is counted, and every intact record before it survives —
+    the resume-over-a-damaged-file posture."""
+    monkeypatch.setenv("MX_RECORDIO_TOLERATE_CORRUPT", "1")
+    path = str(tmp_path / "tolerant.rec")
+    _write_rec(path, [b"keep-1", b"keep-2", b"doomed-payload"])
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) - 5)
+    r = recordio.MXRecordIO(path, "r")
+    with pytest.warns(UserWarning, match="skipping"):
+        got = []
+        while True:
+            x = r.read()
+            if x is None:
+                break
+            got.append(x)
+    assert got == [b"keep-1", b"keep-2"]
+    assert r.corrupt_skipped == 1
+    assert r.read() is None                # stays EOF, count stays 1
+    assert r.corrupt_skipped == 1
+    r.reset()                              # new pass: latch cleared,
+    assert r.read() == b"keep-1"           # damage re-detected once
+    assert r.read() == b"keep-2"
+    with pytest.warns(UserWarning, match="skipping"):
+        assert r.read() is None
+    assert r.corrupt_skipped == 2
+    r.close()
+
+
+def test_indexed_recordio_tolerate_survives_one_bad_record(
+        _py_recordio, tmp_path, monkeypatch):
+    """Random access: one tolerated bad record must not latch the
+    reader into EOF for every other (intact) key — seek clears it."""
+    monkeypatch.setenv("MX_RECORDIO_TOLERATE_CORRUPT", "1")
+    rec, idx = str(tmp_path / "i.rec"), str(tmp_path / "i.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(3):
+        w.write_idx(i, b"payload-%d" % i)
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, rec, "r")
+    with open(rec, "r+b") as f:
+        f.seek(r.idx[1])
+        f.write(b"\xde\xad\xbe\xef")       # stomp record 1's magic
+    assert r.read_idx(0) == b"payload-0"
+    with pytest.warns(UserWarning, match="skipping"):
+        assert r.read_idx(1) is None       # the bad record: skipped
+    assert r.corrupt_skipped == 1
+    assert r.read_idx(2) == b"payload-2"   # intact keys still readable
+    assert r.read_idx(0) == b"payload-0"
+    r.close()
+
+
+# -- PrefetchingIter lifecycle (ISSUE 2 satellite) ----------------------------
+
+def _tiny_iter(n=8, batch=4):
+    return mio.NDArrayIter(np.zeros((n, 2), np.float32),
+                           np.zeros(n, np.float32), batch_size=batch)
+
+
+def test_prefetching_iter_close_is_idempotent_and_final():
+    p = mio.PrefetchingIter(_tiny_iter())
+    assert p.next() is not None
+    p.close()
+    p.close()                              # idempotent
+    assert p._pool._shutdown               # threads released, not leaked
+    with pytest.raises(mx.MXNetError):
+        p.next()
+    with pytest.raises(mx.MXNetError):
+        p.reset()
+
+
+def test_prefetching_iter_context_manager():
+    with mio.PrefetchingIter(_tiny_iter()) as p:
+        n = sum(1 for _ in p)
+    assert n == 2
+    assert p._pool._shutdown
+
+
+def test_prefetching_iter_names_failing_inner_iterator():
+    class Boom(mio.DataIter):
+        def __init__(self):
+            super().__init__(batch_size=4)
+
+        def next(self):
+            raise ValueError("kaput")
+
+    p = mio.PrefetchingIter([_tiny_iter(), Boom()])
+    try:
+        with pytest.raises(mx.MXNetError) as ei:
+            p.next()
+        assert "inner iterator 1" in str(ei.value)
+        assert "Boom" in str(ei.value)
+        assert isinstance(ei.value.__cause__, ValueError)  # chained
+    finally:
+        p.close()

@@ -1,0 +1,637 @@
+"""Supervised elastic launch (ISSUE 2): process supervisor + health guards.
+
+Chaos-marked, tier-1 resident.  The ladder, bottom-up:
+
+  * health.Watchdog expiry math on the fault module's VIRTUAL clock
+    (zero real sleeps — the acceptance's detection-latency bound)
+  * health.dump_all_stacks / Heartbeat / GradientGuard / StepGuard units
+  * MX_NAN_POLICY wired through Module.fit: skip_batch keeps params
+    finite over a poisoned batch, raise fails fast, default propagates
+  * launch.Supervisor (imported from tools/launch.py): restart with the
+    original env, RetryPolicy backoff schedule under virtual time,
+    budget exhaustion → whole-job teardown, restart=never back-compat,
+    heartbeat-staleness kill+restart, graceful server STOP + exit-code
+    folding
+  * end-to-end through the CLI: `launch.py -n 2 --restart on-failure`
+    with an armed `worker.step:crash:after=N` spec finishes exit 0 and
+    the resumed ranks' params match an uninterrupted run; an injected
+    hang (delay spec) is converted into a restart by the
+    MX_STEP_TIMEOUT watchdog (exit 86)
+
+The subprocess scripts that don't need the framework (markers, hangs,
+fake PS) stay framework-free so the supervisor unit tests run in
+milliseconds; only the two acceptance tests pay real jax startup.
+"""
+import importlib.util
+import io as _stringio
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import fault, health
+from mxnet_tpu.base import MXNetError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fault.clear()
+    yield
+    fault.clear()
+
+
+def _load_launch():
+    spec = importlib.util.spec_from_file_location(
+        "mx_launch_under_test", os.path.join(REPO, "tools", "launch.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+launch = _load_launch()
+
+
+def _no_jitter_backoff(base=0.5):
+    return fault.RetryPolicy(deadline=float("inf"), base=base,
+                             max_delay=8.0, jitter=0.0)
+
+
+# ---------------------------------------------------------------------------
+# Watchdog (virtual clock — no real sleeps)
+# ---------------------------------------------------------------------------
+
+def test_watchdog_expiry_on_virtual_clock():
+    """Expiry math runs on fault.now(): petted at t, expired strictly
+    after t+timeout; detection poll defaults to <= timeout so the
+    in-process detection latency stays within 2x MX_STEP_TIMEOUT."""
+    fired = []
+    with fault.use_virtual_time() as clk:
+        wd = health.Watchdog(2.0, on_timeout=lambda: fired.append(True))
+        assert not wd.expired()            # never petted: disarmed
+        wd.pet()
+        clk.advance(1.9)
+        assert not wd.check() and not fired
+        wd.pet()                           # progress resets the window
+        clk.advance(1.9)
+        assert not wd.expired()
+        clk.advance(0.2)                   # 2.1s since last pet
+        assert wd.expired()
+    assert wd.poll <= wd.timeout           # poll tick bounds detection
+    assert wd.timeout + wd.poll <= 2 * wd.timeout
+
+
+def test_watchdog_fires_once_and_dumps_stacks(capsys):
+    fired = []
+    with fault.use_virtual_time() as clk:
+        wd = health.Watchdog(1.0, on_timeout=lambda: fired.append(True))
+        wd.pet()
+        clk.advance(1.5)
+        assert wd.check() is True
+        assert wd.check() is False         # latched: fires exactly once
+    assert fired == [True]
+    err = capsys.readouterr().err
+    assert "MX_STEP_TIMEOUT" in err
+    assert "MainThread" in err             # all-threads stack dump
+
+
+def test_watchdog_suspend_disarms_between_epochs():
+    with fault.use_virtual_time() as clk:
+        wd = health.Watchdog(1.0, on_timeout=lambda: None)
+        wd.pet()
+        wd.suspend()                       # eval/checkpoint phase
+        clk.advance(100.0)
+        assert not wd.expired()
+
+
+def test_watchdog_rejects_nonpositive_timeout():
+    with pytest.raises(ValueError):
+        health.Watchdog(0.0)
+
+
+def test_dump_all_stacks_names_live_threads():
+    ready = threading.Event()
+    release = threading.Event()
+
+    def parked():
+        ready.set()
+        release.wait(timeout=10)
+
+    t = threading.Thread(target=parked, name="parked-thread")
+    t.start()
+    ready.wait(timeout=10)
+    buf = _stringio.StringIO()
+    try:
+        health.dump_all_stacks(buf)
+    finally:
+        release.set()
+        t.join()
+    out = buf.getvalue()
+    assert "parked-thread" in out and "MainThread" in out
+    assert "release.wait" in out           # the parked frame is visible
+
+
+# ---------------------------------------------------------------------------
+# GradientGuard / Heartbeat / StepGuard
+# ---------------------------------------------------------------------------
+
+def _grads(**named):
+    return [(k, None if v is None else mx.nd.array(np.asarray(v)))
+            for k, v in named.items()]
+
+
+def test_nonfinite_grads_finds_nan_and_inf():
+    bad = health.nonfinite_grads(_grads(
+        a=[1.0, 2.0], b=[np.nan, 1.0], c=[np.inf], fixed=None))
+    assert bad == ["b", "c"]
+
+
+def test_gradient_guard_policies():
+    ok = _grads(w=[1.0])
+    poisoned = _grads(w=[np.nan])
+    g = health.GradientGuard("warn")
+    assert g.allow_update(poisoned) is True        # warn: apply anyway
+    assert g.nan_events == 1
+    g = health.GradientGuard("skip_batch")
+    assert g.allow_update(ok) is True
+    assert g.allow_update(poisoned) is False
+    assert (g.nan_events, g.skipped_batches) == (1, 1)
+    g = health.GradientGuard("raise")
+    with pytest.raises(MXNetError) as ei:
+        g.allow_update(poisoned)
+    assert "MX_NAN_POLICY" in str(ei.value)
+    assert health.GradientGuard("").allow_update(poisoned) is True
+    with pytest.raises(ValueError):
+        health.GradientGuard("bogus")
+
+
+def test_heartbeat_beats_atomically(tmp_path):
+    hb = health.Heartbeat(str(tmp_path / "sub" / "rank_0"))
+    hb.beat(epoch=3, nbatch=7)
+    with open(hb.path) as f:
+        ts, epoch, nbatch = f.read().split()
+    assert abs(float(ts) - time.time()) < 60
+    assert (epoch, nbatch) == ("3", "7")
+    hb.beat(epoch=3, nbatch=8)                     # rewrite, not append
+    with open(hb.path) as f:
+        assert len(f.readlines()) == 1
+    hb.remove()
+    assert not os.path.exists(hb.path)
+
+
+def test_step_guard_from_env(monkeypatch, tmp_path):
+    monkeypatch.setenv("MX_NAN_POLICY", "skip_batch")
+    monkeypatch.setenv("MX_HEARTBEAT_FILE", str(tmp_path / "hb"))
+    monkeypatch.delenv("MX_STEP_TIMEOUT", raising=False)
+    guard = health.StepGuard.from_env()
+    try:
+        assert guard.armed
+        assert guard.grad_guard.policy == "skip_batch"
+        assert guard.watchdog is None
+        guard.batch_end(0, 0)
+        assert os.path.exists(str(tmp_path / "hb"))
+    finally:
+        guard.close()
+    for var in ("MX_NAN_POLICY", "MX_HEARTBEAT_FILE"):
+        monkeypatch.delenv(var)
+    unarmed = health.StepGuard.from_env()
+    assert not unarmed.armed
+    unarmed.close()
+
+
+# ---------------------------------------------------------------------------
+# MX_NAN_POLICY through Module.fit
+# ---------------------------------------------------------------------------
+
+def _poisoned_data():
+    rng = np.random.RandomState(0)
+    X = rng.randn(48, 8).astype(np.float32)
+    X[24:30] = np.nan                    # batch 1 (of batch_size 24)
+    Y = np.zeros(48, np.float32)
+    return X, Y
+
+
+def _mlp():
+    from mxnet_tpu import symbol as sym
+    data = sym.Variable("data")
+    h = sym.FullyConnected(data, sym.Variable("fc1_weight"),
+                           sym.Variable("fc1_bias"), num_hidden=16)
+    h = sym.Activation(h, act_type="relu")
+    out = sym.FullyConnected(h, sym.Variable("fc2_weight"),
+                             sym.Variable("fc2_bias"), num_hidden=3)
+    return sym.SoftmaxOutput(out, sym.Variable("softmax_label"),
+                             normalization="batch", name="softmax")
+
+
+def _fit_poisoned(monkeypatch, policy):
+    from mxnet_tpu import io as mio
+    from mxnet_tpu.module import Module
+    if policy is None:
+        monkeypatch.delenv("MX_NAN_POLICY", raising=False)
+    else:
+        monkeypatch.setenv("MX_NAN_POLICY", policy)
+    X, Y = _poisoned_data()
+    mod = Module(_mlp(), context=mx.cpu())
+    mod.fit(mio.NDArrayIter(X, Y, batch_size=24), optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1}, num_epoch=2)
+    arg, _ = mod.get_params()
+    return {k: v.asnumpy() for k, v in arg.items()}
+
+
+def test_nan_policy_skip_batch_keeps_params_finite(monkeypatch):
+    """Acceptance: one NaN-poisoned batch per epoch; skip_batch drops
+    exactly those updates and the parameters stay finite, while the
+    unguarded default lets the NaNs take the weights."""
+    params = _fit_poisoned(monkeypatch, "skip_batch")
+    for k, v in params.items():
+        assert np.isfinite(v).all(), k
+
+    unguarded = _fit_poisoned(monkeypatch, None)
+    assert any(not np.isfinite(v).all() for v in unguarded.values())
+
+
+def test_nan_policy_skip_batch_clears_add_accumulators(monkeypatch,
+                                                       caplog):
+    """grad_req='add' accumulates into the executor's grad buffers; a
+    skipped poisoned batch must purge its NaN sums or every later
+    backward's += would stay non-finite and freeze training silently.
+    Exactly one skip per epoch proves the clean batches recovered."""
+    import logging as _logging
+    from mxnet_tpu import io as mio
+    from mxnet_tpu.module import Module
+    monkeypatch.setenv("MX_NAN_POLICY", "skip_batch")
+    X, Y = _poisoned_data()                # batch 1 of 2 is poisoned
+    it = mio.NDArrayIter(X, Y, batch_size=24)
+    mod = Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=True, grad_req="add")
+    with caplog.at_level(_logging.WARNING):
+        mod.fit(it, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1}, num_epoch=3)
+    arg, _ = mod.get_params()
+    for k, v in arg.items():
+        assert np.isfinite(v.asnumpy()).all(), k
+    # 3 epochs x 1 poisoned batch — a dirty accumulator would have
+    # dragged every subsequent batch into the skip count (5, not 3)
+    assert "skipped 3 poisoned batch update(s)" in caplog.text
+
+
+def test_nan_policy_raise_fails_the_rank_fast(monkeypatch):
+    with pytest.raises(MXNetError) as ei:
+        _fit_poisoned(monkeypatch, "raise")
+    assert "non-finite gradient" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# Supervisor units (framework-free subprocess scripts: milliseconds each)
+# ---------------------------------------------------------------------------
+
+_MARKER_SCRIPT = textwrap.dedent("""
+    import os, sys
+    m = os.environ["MX_TEST_MARKER"]
+    if os.path.exists(m):
+        print("SECOND_RUN_OK", flush=True)
+        sys.exit(0)
+    open(m, "w").close()
+    sys.exit(9)
+""")
+
+
+def _marker_env(path):
+    env = dict(os.environ)
+    env["MX_TEST_MARKER"] = str(path)
+    return env
+
+
+def test_supervisor_restarts_with_original_env_and_backoff(tmp_path):
+    """First run crashes (exit 9), the restart reuses the frozen env and
+    succeeds; the RetryPolicy backoff window elapsed on the VIRTUAL
+    clock (deadline-scheduled — zero real sleeping, and the supervise
+    loop stayed live throughout the window)."""
+    sup = launch.Supervisor(restart="on-failure", max_restarts=3,
+                            backoff=_no_jitter_backoff(base=2.0))
+    sp = sup.add("rank 0", [sys.executable, "-c", _MARKER_SCRIPT],
+                 _marker_env(tmp_path / "marker"))
+    t0 = time.monotonic()
+    with fault.use_virtual_time() as clk:
+        rc = sup.run()
+    assert rc == 0
+    assert sp.restarts == 1 and sp.rc == 0
+    assert sum(clk.sleeps) >= 2.0          # full backoff window honored
+    assert time.monotonic() - t0 < 10      # ...without real sleeping it
+
+
+def test_supervisor_budget_exhaustion_tears_down_whole_job(tmp_path):
+    """A rank burning its budget escalates: the healthy long-running
+    rank is killed too and the job exits with the failing rank's code."""
+    sup = launch.Supervisor(restart="on-failure", max_restarts=1,
+                            backoff=_no_jitter_backoff(base=0.01))
+    bad = sup.add("rank 0", [sys.executable, "-c",
+                             "import sys; sys.exit(5)"], dict(os.environ))
+    slow = sup.add("rank 1", [sys.executable, "-c",
+                              "import time; time.sleep(60)"],
+                   dict(os.environ))
+    t0 = time.monotonic()
+    with fault.use_virtual_time():
+        rc = sup.run()
+    assert rc == 5
+    assert bad.restarts == 1               # budget spent, then teardown
+    assert not slow.alive()                # healthy rank reaped
+    assert time.monotonic() - t0 < 30      # nowhere near the sleep(60)
+
+
+def test_supervisor_restart_never_preserves_old_contract(tmp_path):
+    """Default policy: no restarts, wait every worker, fold nonzero."""
+    sup = launch.Supervisor(restart="never")
+    bad = sup.add("rank 0", [sys.executable, "-c",
+                             "import sys; sys.exit(2)"], dict(os.environ))
+    ok = sup.add("rank 1", [sys.executable, "-c",
+                            "print('fine')"], dict(os.environ))
+    rc = sup.run()
+    assert rc == 2
+    assert bad.restarts == 0 and ok.rc == 0
+
+
+def test_supervisor_hang_timeout_kills_and_restarts(tmp_path):
+    """Heartbeat-file liveness: enforcement starts at the process's
+    FIRST beat (a slow startup is never killed); the wedged first run
+    beats once then stalls, is killed when the file goes stale past
+    --hang-timeout, and the restart completes."""
+    script = textwrap.dedent("""
+        import os, sys, time
+        m = os.environ["MX_TEST_MARKER"]
+        if os.path.exists(m):
+            sys.exit(0)
+        open(m, "w").close()
+        open(os.environ["MX_HEARTBEAT_FILE"], "w").close()  # one beat
+        time.sleep(60)                     # ...then wedged
+    """)
+    hb = tmp_path / "hb_rank0"
+    sup = launch.Supervisor(restart="on-failure", max_restarts=2,
+                            hang_timeout=0.3, poll=0.05,
+                            backoff=_no_jitter_backoff(base=0.01))
+    env = _marker_env(tmp_path / "marker")
+    env["MX_HEARTBEAT_FILE"] = str(hb)
+    sp = sup.add("rank 0", [sys.executable, "-c", script], env,
+                 heartbeat=str(hb))
+    t0 = time.monotonic()
+    with fault.use_virtual_time():         # backoff virtual; mtime real
+        rc = sup.run()
+    assert rc == 0
+    assert sp.restarts == 1
+    assert time.monotonic() - t0 < 30
+
+
+def test_heartbeat_done_sentinel_disarms_hang_enforcement(tmp_path):
+    """StepGuard.close() writes a final 'done' beat; the supervisor
+    sees it and stops hang enforcement — a rank doing >hang-timeout of
+    post-fit work (export, final eval) must not be killed healthy."""
+    hb = tmp_path / "hb"
+    guard = health.StepGuard(heartbeat_path=str(hb))
+    guard.batch_end(0, 0)
+    guard.close()
+    assert open(str(hb)).read().strip().endswith("done")
+
+    sup = launch.Supervisor(restart="on-failure", max_restarts=1,
+                            hang_timeout=0.1, startup_grace=0.1)
+    sp = sup.add("rank 0", [sys.executable, "-c",
+                            "import time; time.sleep(30)"],
+                 dict(os.environ), heartbeat=str(hb))
+    sp.spawned_wall = time.time() - 100    # far past every window
+    sp.proc = subprocess.Popen(sp.argv, env=sp.env)
+    try:
+        os.utime(str(hb), (time.time() - 100, time.time() - 100))
+        sup._check_hang(sp)                # stale mtime, but 'done'
+        assert sp.proc.poll() is None      # ...so it was NOT killed
+    finally:
+        sp.proc.kill()
+        sp.proc.wait()
+
+
+def test_step_guard_first_batch_compile_grace():
+    """The watchdog arms only after the FIRST completed batch — batch
+    0's jit compile (arbitrarily long) must not read as a hang, exactly
+    like the supervisor's startup grace for the heartbeat file."""
+    with fault.use_virtual_time() as clk:
+        g = health.StepGuard(step_timeout=1.0, on_timeout=lambda: None)
+        try:
+            g.batch_start()                # batch 0: compiling
+            clk.advance(100.0)
+            assert not g.watchdog.expired()
+            g.batch_end(0, 0)              # first batch landed: armed
+            g.batch_start()
+            clk.advance(1.5)
+            assert g.watchdog.expired()
+        finally:
+            g.close()
+
+
+def test_supervisor_startup_grace_bounds_wedged_spawn(tmp_path):
+    """A (re)spawn that wedges BEFORE its first beat (no heartbeat file
+    at all) is still detected — bounded by startup_grace, not never."""
+    script = textwrap.dedent("""
+        import os, sys, time
+        m = os.environ["MX_TEST_MARKER"]
+        if os.path.exists(m):
+            sys.exit(0)
+        open(m, "w").close()
+        time.sleep(60)                     # wedged in startup: no beat
+    """)
+    sup = launch.Supervisor(restart="on-failure", max_restarts=2,
+                            hang_timeout=0.2, startup_grace=0.5,
+                            poll=0.05,
+                            backoff=_no_jitter_backoff(base=0.01))
+    sp = sup.add("rank 0", [sys.executable, "-c", script],
+                 _marker_env(tmp_path / "marker"),
+                 heartbeat=str(tmp_path / "hb"))
+    t0 = time.monotonic()
+    with fault.use_virtual_time():
+        rc = sup.run()
+    assert rc == 0
+    assert sp.restarts == 1
+    assert time.monotonic() - t0 < 30
+
+
+_FAKE_PS = textwrap.dedent("""
+    import os, pickle, socket, struct, sys
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", int(os.environ["FAKE_PS_PORT"])))
+    srv.listen(4)
+    while True:
+        c, _ = srv.accept()
+        head = b""
+        while len(head) < 8:
+            chunk = c.recv(8 - len(head))
+            if not chunk:
+                break
+            head += chunk
+        if len(head) < 8:
+            c.close()
+            continue
+        (n,) = struct.unpack("<Q", head)
+        body = b""
+        while len(body) < n:
+            body += c.recv(n - len(body))
+        msg = pickle.loads(body)
+        payload = pickle.dumps((True, "stopping"))
+        c.sendall(struct.pack("<Q", len(payload)) + payload)
+        c.close()
+        if msg[0] == "STOP":
+            sys.exit(0)
+""")
+
+
+def test_supervisor_stops_servers_gracefully_and_folds_exit_codes():
+    """Satellite: after the workers finish, servers get the
+    wire-protocol STOP (not SIGTERM) and exit 0 — folded, not ignored."""
+    port = launch._free_port()
+    env = dict(os.environ)
+    env["FAKE_PS_PORT"] = str(port)
+    sup = launch.Supervisor(restart="never")
+    server = sup.add("server 0", [sys.executable, "-c", _FAKE_PS], env,
+                     role="server", addr="127.0.0.1:%d" % port)
+    sup.add("rank 0", [sys.executable, "-c", "import time; time.sleep(0.5)"],
+            dict(os.environ))
+    rc = sup.run()
+    assert rc == 0
+    assert server.rc == 0 and not server.we_killed   # STOP, not SIGTERM
+
+
+def test_supervisor_forgiven_server_crash_does_not_fail_job():
+    """A server crash the restart policy accepted (respawn pending in
+    its backoff window) must not resurface as the job's exit code when
+    the workers finish first — success/failure can't be a race."""
+    huge = fault.RetryPolicy(deadline=float("inf"), base=1e9,
+                             max_delay=1e9, jitter=0.0)
+    sup = launch.Supervisor(restart="on-failure", max_restarts=2,
+                            backoff=huge)  # window outlasts the workers
+    server = sup.add("server 0", [sys.executable, "-c",
+                                  "import sys; sys.exit(17)"],
+                     dict(os.environ), role="server", addr=None)
+    sup.add("rank 0", [sys.executable, "-c", "import time; time.sleep(0.4)"],
+            dict(os.environ))
+    rc = sup.run()
+    assert rc == 0
+    assert server.rc == 0                  # forgiven, not folded
+
+
+def test_supervisor_folds_server_crash_into_job_rc():
+    """A server that dies nonzero mid-job fails the job under
+    restart=never (the old launcher silently ignored server deaths)."""
+    sup = launch.Supervisor(restart="never")
+    sup.add("server 0", [sys.executable, "-c", "import sys; sys.exit(17)"],
+            dict(os.environ), role="server", addr=None)
+    sup.add("rank 0", [sys.executable, "-c", "import time; time.sleep(0.4)"],
+            dict(os.environ))
+    rc = sup.run()
+    assert rc == 17
+
+
+def test_launch_ssh_rejects_supervision_flags():
+    """--hang-timeout reads a local heartbeat file, and --restart on an
+    ssh client's exit could duplicate a still-live remote rank — both
+    are local-launcher features; accepting and silently dropping them
+    would fake protection."""
+    class A:
+        num_servers, num_workers, hostfile = 0, 1, None
+        restart, max_restarts, hang_timeout = "never", 3, 5.0
+    with pytest.raises(SystemExit, match="hang-timeout"):
+        launch.launch_ssh(A(), ["true"])
+    A.hang_timeout = None
+    A.restart = "on-failure"
+    with pytest.raises(SystemExit, match="restart"):
+        launch.launch_ssh(A(), ["true"])
+
+
+def test_restart_flag_parsing():
+    class A:
+        restart, max_restarts, hang_timeout = "2", 3, None
+    sup = launch._make_supervisor(A())
+    assert sup.restart == "on-failure" and sup.max_restarts == 2
+    A.restart = "on-failure"
+    assert launch._make_supervisor(A()).max_restarts == 3
+    A.restart = "sometimes"
+    with pytest.raises(SystemExit):
+        launch._make_supervisor(A())
+
+
+# ---------------------------------------------------------------------------
+# End-to-end through the CLI (the acceptance demos; real jax startup)
+# ---------------------------------------------------------------------------
+
+def _clean_env(**extra):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)      # conftest's 8-dev count: workers pick own
+    env.pop("MX_FAULT_INJECT", None)
+    env.update(extra)
+    return env
+
+
+def _launch(argv, env, timeout=300):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py")] + argv,
+        capture_output=True, text=True, timeout=timeout, env=env)
+
+
+def test_launch_crash_restart_resumes_to_matching_params(tmp_path):
+    """Acceptance: `launch.py -n 2 --restart on-failure` with an armed
+    `worker.step:crash:after=5` spec — every rank dies mid-epoch-1, is
+    restarted with its original env, auto-resumes from its epoch-0
+    checkpoint (momentum sidecar included) and finishes exit 0 with
+    final params IDENTICAL to an uninterrupted run."""
+    fit = os.path.join(REPO, "tools", "chaos_fit.py")
+    ref = _launch(["-n", "1", "--launcher", "local", "--",
+                   sys.executable, fit,
+                   "--ckpt-dir", str(tmp_path / "ref"),
+                   "--out", str(tmp_path / "ref")], _clean_env())
+    assert ref.returncode == 0, (ref.stdout, ref.stderr)
+
+    r = _launch(["-n", "2", "--launcher", "local",
+                 "--restart", "on-failure", "--max-restarts", "2",
+                 "--fault", "worker.step:crash:after=5", "--",
+                 sys.executable, fit,
+                 "--ckpt-dir", str(tmp_path / "chaos"),
+                 "--out", str(tmp_path / "chaos")], _clean_env())
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "restart 1/" in r.stderr, r.stderr       # the crash really hit
+    assert r.stdout.count("CHAOS_FIT_DONE") == 2
+
+    want = np.load(str(tmp_path / "ref.rank0.npz"))
+    for rank in (0, 1):
+        got = np.load(str(tmp_path / ("chaos.rank%d.npz" % rank)))
+        assert set(got.files) == set(want.files)
+        for k in want.files:
+            np.testing.assert_allclose(got[k], want[k], rtol=1e-5,
+                                       atol=1e-6,
+                                       err_msg="rank %d %s" % (rank, k))
+
+
+def test_launch_watchdog_converts_hang_into_restart(tmp_path):
+    """Acceptance: an injected hang (`worker.step:delay:delay=60`) is
+    detected by the MX_STEP_TIMEOUT watchdog (stack dump + exit 86) and
+    the supervisor restarts the rank, which resumes and completes."""
+    fit = os.path.join(REPO, "tools", "chaos_fit.py")
+    r = _launch(["-n", "1", "--launcher", "local",
+                 "--restart", "on-failure", "--max-restarts", "2",
+                 "--fault", "worker.step:delay:delay=60,after=5", "--",
+                 sys.executable, fit,
+                 "--ckpt-dir", str(tmp_path / "hang"),
+                 "--out", str(tmp_path / "hang")],
+                _clean_env(MX_STEP_TIMEOUT="1.0"))
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "watchdog" in r.stderr                   # in-process detection
+    assert "exit 86" in r.stderr                    # supervisor names it
+    assert "MX_STEP_TIMEOUT watchdog" in r.stderr
+    assert "CHAOS_FIT_DONE" in r.stdout
+    got = np.load(str(tmp_path / "hang.rank0.npz"))
+    assert all(np.isfinite(got[k]).all() for k in got.files)

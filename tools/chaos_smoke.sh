@@ -1,0 +1,63 @@
+#!/usr/bin/env bash
+# chaos_smoke.sh — exercise the supervised-elastic-launch resilience path
+# end-to-end through the CLI, outside the unit suite (CI smoke).
+#
+# Runs tools/chaos_fit.py under `launch.py -n 2 --restart on-failure` with
+# an armed `worker.step:crash:after=5` spec: each rank is killed
+# mid-epoch-1, restarted by the supervisor with its original env, and
+# auto-resumed from its epoch-0 checkpoint.  Asserts exit 0, both ranks
+# finishing, and the resumed ranks' final params matching an
+# uninterrupted single-rank reference run.
+set -euo pipefail
+
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+WORK="$(mktemp -d /tmp/mx-chaos-smoke.XXXXXX)"
+trap 'rm -rf "$WORK"' EXIT
+
+export JAX_PLATFORMS=cpu MX_FORCE_CPU=1
+unset XLA_FLAGS || true
+PY="${PYTHON:-python3}"
+
+echo "== chaos_smoke: uninterrupted reference run (-n 1)"
+"$PY" "$REPO/tools/launch.py" -n 1 --launcher local -- \
+    "$PY" "$REPO/tools/chaos_fit.py" \
+    --ckpt-dir "$WORK/ref" --out "$WORK/ref" > "$WORK/ref.log" 2>&1
+
+echo "== chaos_smoke: -n 2 --restart on-failure --fault worker.step:crash:after=5"
+rc=0
+"$PY" "$REPO/tools/launch.py" -n 2 --launcher local \
+    --restart on-failure --max-restarts 2 \
+    --fault 'worker.step:crash:after=5' -- \
+    "$PY" "$REPO/tools/chaos_fit.py" \
+    --ckpt-dir "$WORK/chaos" --out "$WORK/chaos" 2>&1 \
+    | tee "$WORK/chaos.log" || rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "chaos_smoke: FAIL - launch.py exited $rc" >&2
+    exit 1
+fi
+grep -q 'restart 1/' "$WORK/chaos.log" || {
+    echo "chaos_smoke: FAIL - no restart happened (fault spec not armed?)" >&2
+    exit 1
+}
+DONE=$(grep -c 'CHAOS_FIT_DONE' "$WORK/chaos.log" || true)
+if [ "$DONE" -ne 2 ]; then
+    echo "chaos_smoke: FAIL - expected 2 completed ranks, saw $DONE" >&2
+    exit 1
+fi
+
+echo "== chaos_smoke: comparing resumed params to the uninterrupted run"
+"$PY" - "$WORK" <<'EOF'
+import sys
+import numpy as np
+work = sys.argv[1]
+ref = np.load("%s/ref.rank0.npz" % work)
+for rank in (0, 1):
+    got = np.load("%s/chaos.rank%d.npz" % (work, rank))
+    assert set(got.files) == set(ref.files), (got.files, ref.files)
+    for k in ref.files:
+        np.testing.assert_allclose(got[k], ref[k], rtol=1e-5, atol=1e-6,
+                                   err_msg="rank %d param %s" % (rank, k))
+print("chaos_smoke: resumed params match the uninterrupted run")
+EOF
+
+echo "chaos_smoke: PASS"

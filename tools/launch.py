@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""launch.py — start a multi-process / multi-host training job.
+"""launch.py — start (and supervise) a multi-process / multi-host training job.
 
 Reference: ``tools/launch.py`` + ``3rdparty/ps-lite/tracker``
 (dmlc_tracker.local/ssh — spawn workers+servers with DMLC_* envs).
@@ -20,15 +20,45 @@ Modes:
   -n N --launcher ssh -H hostfile : one process per hostfile line via ssh
   --launcher manual      : print the per-rank environment + command
 
+Supervision (the restart-and-resume layer over ISSUE 1's recovery
+primitives): with ``--restart on-failure`` (or ``--restart N``) the
+launcher keeps watching every spawned rank and parameter server.  A
+process that exits nonzero is restarted with its ORIGINAL environment —
+same rank, same MX_COORDINATOR (rank 0 re-binds its own coordinator
+port, so a dead rank 0 regenerates the coordinator for the job), same
+MX_PS_SNAPSHOT path — so ``fit(checkpoint_dir=..., auto_resume)`` and
+the durable PS pick up from the last step instead of from scratch.
+Restart delays follow ``mxnet_tpu.fault.RetryPolicy`` exponential
+backoff; a rank that exceeds ``--max-restarts`` escalates to whole-job
+teardown (every surviving process is killed, the job exits nonzero).
+``--hang-timeout S`` additionally arms heartbeat-file liveness: each
+rank gets MX_HEARTBEAT_FILE, the fit loop touches it every batch, and a
+rank whose file goes stale for S seconds is killed and restarted —
+distinguishing *wedged* from merely *slow* (a slow rank keeps beating).
+In-process, ``MX_STEP_TIMEOUT`` (mxnet_tpu.health watchdog) converts a
+hung step into exit code 86 the supervisor sees like any other crash.
+
 Example:
-  python tools/launch.py -n 2 --launcher local -- python train.py --kv dist
+  python tools/launch.py -n 2 --restart on-failure \\
+      --fault 'worker.step:crash:after=5' -- python train.py --kv dist
 """
 import argparse
 import os
+import pickle
 import shlex
+import shutil
 import socket
+import struct
 import subprocess
 import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# keep in sync with mxnet_tpu.health.WATCHDOG_EXIT_CODE (launch.py stays
+# import-light: mxnet_tpu loads lazily, only when a restart is needed)
+WATCHDOG_EXIT_CODE = 86
 
 
 def _free_port() -> int:
@@ -39,77 +69,447 @@ def _free_port() -> int:
     return port
 
 
-def _env_for(rank: int, coordinator: str, n: int):
-    env = dict(os.environ)
-    env.update({
+def _compat_env(rank: int, coordinator: str, n: int):
+    """The launcher contract: MX_* plus the reference-era DMLC_* names,
+    for scripts that read either.  launch_manual prints exactly this."""
+    return {
         "MX_COORDINATOR": coordinator,
         "MX_NUM_PROCESSES": str(n),
         "MX_PROCESS_ID": str(rank),
-        # reference-era names, for scripts that read DMLC_*:
         "DMLC_NUM_WORKER": str(n),
         "DMLC_WORKER_ID": str(rank),
         "DMLC_ROLE": "worker",
-    })
+    }
+
+
+def _env_for(rank: int, coordinator: str, n: int):
+    env = dict(os.environ)
+    env.update(_compat_env(rank, coordinator, n))
     return env
 
 
+# ---------------------------------------------------------------------------
+# Supervisor
+# ---------------------------------------------------------------------------
+
+class SupervisedProc:
+    """One supervised process: argv + frozen env + restart accounting."""
+
+    def __init__(self, name, argv, env, role="worker", addr=None,
+                 heartbeat=None):
+        self.name = name
+        self.argv = list(argv)
+        self.env = dict(env)          # frozen: restarts reuse it verbatim
+        self.role = role              # "worker" | "server"
+        self.addr = addr              # host:port (servers, for STOP)
+        self.heartbeat = heartbeat    # liveness file path or None
+        self.proc = None
+        self.restarts = 0
+        self.restart_at = None        # backoff deadline for the respawn
+        self.spawned_wall = None      # wall clock of the last spawn
+        self.rc = None                # final status once permanently done
+        self.we_killed = False        # we tore it down: rc not a failure
+
+    @property
+    def done(self):
+        return self.rc is not None
+
+    def alive(self):
+        return self.proc is not None and self.proc.poll() is None
+
+
+class Supervisor:
+    """Restart-and-resume process supervisor (tentpole of ISSUE 2).
+
+    Policy ``never`` reproduces the old launcher: spawn once, wait for
+    every worker, fold return codes.  Policy ``on-failure`` restarts a
+    crashed process with its original env after a
+    ``mxnet_tpu.fault.RetryPolicy`` backoff delay (so restart storms
+    decorrelate), up to ``max_restarts`` per process; past the budget
+    the whole job is torn down nonzero.  Heartbeat-file staleness
+    (``hang_timeout``) counts as a crash: the wedged process is killed
+    first, then the restart path runs.
+
+    Backoff is DEADLINE-scheduled, not slept inline: a rank awaiting its
+    restart window never blocks reaping, hang detection, or restarts of
+    the other processes (a correlated failure restarts every rank after
+    ONE backoff, not a serialized sum of them).  All backoff timing goes
+    through ``mxnet_tpu.fault``'s module clock — under
+    ``fault.use_virtual_time()`` chaos tests drive the full schedule
+    with zero real sleeping.
+    """
+
+    def __init__(self, restart="never", max_restarts=3, backoff=None,
+                 hang_timeout=None, startup_grace=None, poll=0.05,
+                 log=None):
+        if restart not in ("never", "on-failure"):
+            raise ValueError("restart must be 'never' or 'on-failure'")
+        self.restart = restart
+        self.max_restarts = int(max_restarts)
+        self._backoff = backoff       # lazy: RetryPolicy needs mxnet_tpu
+        self.hang_timeout = hang_timeout
+        # before the FIRST beat (no heartbeat file yet) a process gets a
+        # generous startup window — jax import + first-batch compile are
+        # legitimately slow — but not forever: a (re)spawn that wedges
+        # during startup must still be detected or the job hangs for
+        # good.  Default: 20x the hang timeout, at least 120s.
+        self.startup_grace = startup_grace if startup_grace is not None \
+            else (max(120.0, 20.0 * hang_timeout) if hang_timeout
+                  else None)
+        self.poll = poll
+        self.log = log or (lambda msg: print("launch.py: %s" % msg,
+                                             file=sys.stderr, flush=True))
+        self.procs = []
+        self.job_rc = 0
+        self._fault = None            # mxnet_tpu.fault, loaded lazily
+
+    # -- registration -------------------------------------------------------
+    def add(self, name, argv, env, role="worker", addr=None,
+            heartbeat=None):
+        sp = SupervisedProc(name, argv, env, role=role, addr=addr,
+                            heartbeat=heartbeat)
+        self.procs.append(sp)
+        return sp
+
+    # -- plumbing -----------------------------------------------------------
+    def _fault_mod(self):
+        """mxnet_tpu.fault, imported on first use only — a job that
+        never crashes never pays the framework import in the launcher."""
+        if self._fault is None:
+            if REPO not in sys.path:
+                sys.path.insert(0, REPO)
+            from mxnet_tpu import fault
+            self._fault = fault
+        return self._fault
+
+    def _now(self):
+        return self._fault.now() if self._fault is not None \
+            else time.monotonic()
+
+    def _sleep_poll(self):
+        # once the fault clock is loaded (first failure), poll ticks go
+        # through it too, so virtual-time tests advance restart deadlines
+        if self._fault is not None:
+            self._fault.sleep(self.poll)
+        else:
+            time.sleep(self.poll)
+
+    def _backoff_delay(self, attempt):
+        fault = self._fault_mod()
+        if self._backoff is None:
+            # deadline is irrelevant (only .delay() is used); jitter
+            # decorrelates simultaneous rank restarts after a correlated
+            # failure (e.g. the coordinator died under all of them)
+            self._backoff = fault.RetryPolicy(
+                deadline=float("inf"), base=1.0, max_delay=30.0,
+                jitter=0.1)
+        return self._backoff.delay(attempt)
+
+    def _spawn(self, sp):
+        if sp.heartbeat:
+            # drop the previous incarnation's beats: liveness
+            # enforcement (re)starts at this process's FIRST beat, so
+            # neither a stale leftover file nor a slow startup (jax
+            # import, first-batch compile) can get a healthy process
+            # killed before its first batch
+            try:
+                os.remove(sp.heartbeat)
+            except OSError:
+                pass
+        sp.spawned_wall = time.time()
+        sp.proc = subprocess.Popen(sp.argv, env=sp.env)
+
+    def _kill(self, sp):
+        if not sp.alive():
+            return
+        sp.we_killed = True
+        sp.proc.terminate()
+        try:
+            sp.proc.wait(timeout=5.0)
+        except subprocess.TimeoutExpired:
+            sp.proc.kill()
+            sp.proc.wait()
+
+    def _fold(self, rc):
+        if rc:
+            self.job_rc = self.job_rc or (rc if rc > 0 else 1)
+
+    # -- failure handling ---------------------------------------------------
+    def _describe(self, rc):
+        if rc == WATCHDOG_EXIT_CODE:
+            return ("exit %d (MX_STEP_TIMEOUT watchdog: hung step)"
+                    % rc)
+        if rc < 0:
+            return "signal %d" % -rc
+        return "exit %d" % rc
+
+    def _on_failure(self, sp, rc):
+        """Crashed (or was hang-killed).  Returns True to keep running,
+        False when the budget is exhausted → caller tears the job down."""
+        if self.restart != "on-failure":
+            sp.rc = rc
+            self._fold(rc)
+            return True                       # old posture: wait the rest
+        if sp.restarts >= self.max_restarts:
+            self.log("%s failed (%s) and exhausted its restart budget "
+                     "(%d) - tearing the job down"
+                     % (sp.name, self._describe(rc), self.max_restarts))
+            sp.rc = rc
+            self._fold(rc)
+            return False
+        delay = self._backoff_delay(sp.restarts)
+        sp.restarts += 1
+        sp.restart_at = self._now() + delay    # deadline, not a sleep:
+        extra = ""                             # supervision stays live
+        if sp.role == "worker" and sp.env.get("MX_PROCESS_ID") == "0":
+            extra = " (rank 0: regenerating the coordinator on %s)" \
+                % sp.env.get("MX_COORDINATOR", "?")
+        self.log("%s failed (%s) - restart %d/%d in %.3gs with original "
+                 "env%s" % (sp.name, self._describe(rc), sp.restarts,
+                            self.max_restarts, delay, extra))
+        return True
+
+    def _check_hang(self, sp):
+        """Heartbeat-file liveness: slow ranks keep the file fresh;
+        a file stale past hang_timeout means wedged → kill (the exit
+        then routes through the normal failure/restart path)."""
+        if not (sp.heartbeat and self.hang_timeout) or not sp.alive():
+            return
+        try:
+            age = time.time() - os.stat(sp.heartbeat).st_mtime
+            limit, phase = self.hang_timeout, "--hang-timeout"
+            try:
+                with open(sp.heartbeat) as f:
+                    if f.read().strip().endswith("done"):
+                        return     # fit finished its beats: post-fit
+                                   # work may be legitimately silent
+            except OSError:
+                pass
+        except OSError:
+            # no beat yet: startup.  Slow is allowed (import + compile);
+            # wedged-before-the-first-batch is bounded by the grace
+            if self.startup_grace is None or sp.spawned_wall is None:
+                return
+            age = time.time() - sp.spawned_wall
+            limit, phase = self.startup_grace, "startup grace"
+        if age > limit:
+            self.log("%s heartbeat stale for %.3gs (> %s %.3g) - "
+                     "killing the wedged process"
+                     % (sp.name, age, phase, limit))
+            sp.proc.kill()
+            sp.proc.wait()
+
+    def _teardown(self):
+        for sp in self.procs:
+            self._kill(sp)
+            if sp.rc is None:
+                sp.rc = 0 if sp.proc is None else (sp.proc.poll() or 0)
+
+    # -- run ----------------------------------------------------------------
+    def run(self):
+        """Spawn everything, supervise until every worker is done, then
+        stop the servers gracefully.  Returns the job return code."""
+        for sp in self.procs:
+            self._spawn(sp)
+        workers = [sp for sp in self.procs if sp.role == "worker"]
+        try:
+            while True:
+                for sp in self.procs:
+                    if sp.done or sp.proc is None:
+                        continue
+                    if sp.restart_at is not None:
+                        if self._now() >= sp.restart_at:
+                            sp.restart_at = None
+                            self._spawn(sp)
+                        continue           # awaiting its backoff window
+                    self._check_hang(sp)
+                    rc = sp.proc.poll()
+                    if rc is None:
+                        continue
+                    if rc == 0:
+                        # a server exiting 0 early means a worker sent
+                        # STOP (its own shutdown path) — that's done too
+                        sp.rc = 0
+                        continue
+                    if not self._on_failure(sp, rc):
+                        self._teardown()
+                        return self.job_rc
+                if all(w.done for w in workers):
+                    break
+                self._sleep_poll()
+        except BaseException:
+            # ^C or any supervisor bug (e.g. a respawn Popen failing):
+            # never exit leaving ranks/servers running unsupervised
+            self._teardown()
+            raise
+        self.stop_servers()
+        return self.job_rc
+
+    # -- graceful server shutdown ------------------------------------------
+    def stop_servers(self, timeout=10.0):
+        """Workers are done: drain each surviving parameter server with
+        the wire-protocol STOP (ISSUE 1's graceful drain — in-flight
+        requests finish, the snapshot lands) instead of SIGTERM, and
+        fold server exit codes into the job's return code.  SIGTERM is
+        the fallback for a server that won't take the hint; a kill WE
+        sent is not treated as a server failure."""
+        for sp in self.procs:
+            if sp.role != "server" or sp.done:
+                continue
+            if sp.restart_at is not None and not sp.alive():
+                # its crash was already forgiven by the restart policy
+                # and the workers finished before the backoff window —
+                # nothing left to restart, and folding the stale rc
+                # would make the job's exit code a race
+                sp.rc = 0
+                continue
+            stop_sent = False
+            if sp.alive() and sp.addr:
+                try:
+                    _send_stop(sp.addr)
+                    stop_sent = True
+                except OSError as e:
+                    self.log("%s: graceful STOP failed (%s); falling "
+                             "back to terminate" % (sp.name, e))
+            if not stop_sent:
+                # no drain was requested — waiting for one is pointless
+                self._kill(sp)
+            try:
+                sp.proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                self._kill(sp)
+            rc = sp.proc.poll()
+            sp.rc = rc if rc is not None else 0
+            if not sp.we_killed:
+                self._fold(sp.rc)
+
+
+def _send_stop(addr, timeout=5.0):
+    """Send the kvstore wire-protocol STOP (length-prefixed pickle; see
+    mxnet_tpu/kvstore/server.py) and await the ack.  Inlined rather than
+    imported so the launcher never has to load the framework."""
+    host, _, port = addr.rpartition(":")
+    with socket.create_connection((host or "127.0.0.1", int(port)),
+                                  timeout=timeout) as s:
+        s.settimeout(timeout)
+        payload = pickle.dumps(("STOP", None), protocol=4)
+        s.sendall(struct.pack("<Q", len(payload)) + payload)
+        head = b""
+        while len(head) < 8:                  # ack: (True, "stopping")
+            chunk = s.recv(8 - len(head))
+            if not chunk:
+                return
+            head += chunk
+        (n,) = struct.unpack("<Q", head)
+        body = b""
+        while len(body) < n:
+            chunk = s.recv(min(1 << 16, n - len(body)))
+            if not chunk:
+                return
+            body += chunk
+
+
+def _make_supervisor(args):
+    restart = getattr(args, "restart", "never")
+    max_restarts = getattr(args, "max_restarts", 3)
+    if restart not in ("never", "on-failure"):
+        try:
+            max_restarts = int(restart)
+        except ValueError:
+            raise SystemExit("--restart must be never, on-failure, or an "
+                             "integer budget (got %r)" % restart)
+        if max_restarts < 0:
+            raise SystemExit("--restart N needs N >= 0")
+        restart = "on-failure"
+    return Supervisor(restart=restart, max_restarts=max_restarts,
+                      hang_timeout=getattr(args, "hang_timeout", None))
+
+
+# ---------------------------------------------------------------------------
+# Launch modes
+# ---------------------------------------------------------------------------
+
 def launch_local(args, command):
     coordinator = "127.0.0.1:%d" % _free_port()
-    server_procs = []
+    sup = _make_supervisor(args)
+    hb_dir = None
+    if sup.hang_timeout:
+        hb_dir = tempfile.mkdtemp(prefix="mx-heartbeat-")
     ps_roots = []
     if getattr(args, "num_servers", 0) > 0:
         # dist_async parameter server(s) (reference: tracker starting
         # DMLC_ROLE=server processes); with -s N keys shard across the N
         # servers by hash (kvstore_dist.h key->server assignment role)
-        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         snap_dir = getattr(args, "ps_snapshot_dir", None)
         if snap_dir:
             os.makedirs(snap_dir, exist_ok=True)
         for s in range(args.num_servers):
             port = _free_port()
-            ps_roots.append("127.0.0.1:%d" % port)
+            addr = "127.0.0.1:%d" % port
+            ps_roots.append(addr)
             env = dict(os.environ)
             env.update({"DMLC_ROLE": "server",
                         "DMLC_NUM_WORKER": str(args.num_workers),
                         "MX_PS_PORT": str(port),
                         "MX_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
-                        "PYTHONPATH": repo + os.pathsep +
+                        "PYTHONPATH": REPO + os.pathsep +
                         env.get("PYTHONPATH", "")})
             if snap_dir:
-                # durable PS: a restarted server (same snapshot path)
-                # resumes with no data loss — the client side's
-                # reconnect-and-replay then rides straight through
+                # durable PS: a restarted server (same snapshot path,
+                # same port via the frozen env) resumes with no data
+                # loss — the client side's reconnect-and-replay then
+                # rides straight through
                 env["MX_PS_SNAPSHOT"] = os.path.join(
                     snap_dir, "server_%d.pkl" % s)
             if getattr(args, "fault", None):
                 env["MX_FAULT_INJECT"] = args.fault
-            server_procs.append(subprocess.Popen(
-                [sys.executable, "-m", "mxnet_tpu.kvstore.server"],
-                env=env))
-    procs = []
+            sup.add("server %d" % s,
+                    [sys.executable, "-m", "mxnet_tpu.kvstore.server"],
+                    env, role="server", addr=addr)
     for rank in range(args.num_workers):
         env = _env_for(rank, coordinator, args.num_workers)
         if getattr(args, "fault", None):
             # arm the chaos spec in every worker (mxnet_tpu.fault reads
-            # MX_FAULT_INJECT at import)
+            # MX_FAULT_INJECT at import) — a restarted rank re-arms the
+            # same spec, keeping chaos runs deterministic per process
             env["MX_FAULT_INJECT"] = args.fault
+        heartbeat = None
+        if hb_dir:
+            heartbeat = os.path.join(hb_dir, "rank_%d" % rank)
+            env["MX_HEARTBEAT_FILE"] = heartbeat
         if ps_roots:
             env["MX_PS_ROOT"] = ps_roots[0]
             env["MX_PS_ROOTS"] = ",".join(ps_roots)
             env["DMLC_PS_ROOT_URI"] = ps_roots[0].split(":")[0]
             env["DMLC_PS_ROOT_PORT"] = ps_roots[0].split(":")[1]
             env["DMLC_NUM_SERVER"] = str(len(ps_roots))
-        procs.append(subprocess.Popen(command, env=env))
-    rc = 0
-    for p in procs:
-        rc = p.wait() or rc
-    for p in server_procs:       # workers done: stop the PS
-        p.terminate()
-        p.wait()
-    return rc
+        sup.add("rank %d" % rank, command, env, role="worker",
+                heartbeat=heartbeat)
+    try:
+        return sup.run()
+    finally:
+        if hb_dir:
+            shutil.rmtree(hb_dir, ignore_errors=True)
 
 
 def launch_ssh(args, command):
+    if getattr(args, "hang_timeout", None):
+        raise SystemExit(
+            "launch.py: --hang-timeout reads a LOCAL heartbeat file and "
+            "cannot observe remote ranks; it is only supported with "
+            "--launcher local (use MX_STEP_TIMEOUT for in-process "
+            "hang detection on remote ranks)")
+    if getattr(args, "restart", "never") != "never":
+        # an ssh CLIENT exiting nonzero does not mean the REMOTE rank
+        # died (a transport blip orphans it alive); respawning would
+        # start a duplicate rank k against the same PS/checkpoints, and
+        # teardown could only kill the local clients.  Restart
+        # supervision therefore stays a local-launcher feature.
+        raise SystemExit(
+            "launch.py: --restart is only supported with --launcher "
+            "local (an ssh client's exit cannot be distinguished from "
+            "the remote rank's death; restarting on it risks duplicate "
+            "ranks)")
     if getattr(args, "num_servers", 0) > 0:
         raise SystemExit(
             "launch.py: -s/--num-servers is only implemented for the "
@@ -125,7 +525,8 @@ def launch_ssh(args, command):
         raise SystemExit("hostfile has %d hosts < -n %d"
                          % (len(hosts), args.num_workers))
     coordinator = "%s:%d" % (hosts[0], 43117)
-    procs = []
+    sup = _make_supervisor(args)   # restart=never (guarded above): the
+                                   # supervisor just waits + folds rcs
     for rank in range(args.num_workers):
         env = _env_for(rank, coordinator, args.num_workers)
         exports = " ".join("%s=%s" % (k, shlex.quote(v))
@@ -134,21 +535,20 @@ def launch_ssh(args, command):
         remote = "cd %s && env %s %s" % (
             shlex.quote(os.getcwd()), exports,
             " ".join(shlex.quote(c) for c in command))
-        procs.append(subprocess.Popen(["ssh", "-o",
-                                       "StrictHostKeyChecking=no",
-                                       hosts[rank], remote]))
-    rc = 0
-    for p in procs:
-        rc = p.wait() or rc
-    return rc
+        sup.add("rank %d (%s)" % (rank, hosts[rank]),
+                ["ssh", "-o", "StrictHostKeyChecking=no", hosts[rank],
+                 remote],
+                dict(os.environ), role="worker")
+    return sup.run()
 
 
 def launch_manual(args, command):
     coordinator = "<host0>:43117"
     for rank in range(args.num_workers):
-        env = {"MX_COORDINATOR": coordinator,
-               "MX_NUM_PROCESSES": args.num_workers,
-               "MX_PROCESS_ID": rank}
+        # exactly the contract _env_for gives spawned workers — MX_*
+        # plus the DMLC_* compat names, so a manually-started process
+        # behaves identically to a launched one
+        env = _compat_env(rank, coordinator, args.num_workers)
         exports = " ".join("%s=%s" % kv for kv in env.items())
         print("rank %d:  env %s %s" % (rank, exports, " ".join(command)))
     return 0
@@ -161,9 +561,31 @@ def main():
     p.add_argument("--launcher", default="local",
                    choices=["local", "ssh", "manual"])
     p.add_argument("-H", "--hostfile", default=None)
+    p.add_argument("--restart", default="never", metavar="POLICY",
+                   help="never (default) | on-failure | N (shorthand for "
+                        "on-failure with --max-restarts N).  on-failure "
+                        "restarts a crashed rank/server with its original "
+                        "env (RetryPolicy backoff) so checkpoint "
+                        "auto-resume and MX_PS_SNAPSHOT pick up from the "
+                        "last step; past the budget the whole job is "
+                        "torn down nonzero.  Local launcher only")
+    p.add_argument("--max-restarts", type=int, default=3, metavar="N",
+                   help="per-process restart budget under --restart "
+                        "on-failure (default 3)")
+    p.add_argument("--hang-timeout", type=float, default=None,
+                   metavar="SECS",
+                   help="supervisor-side wedge detection: each rank gets "
+                        "MX_HEARTBEAT_FILE (touched every batch by the "
+                        "fit loop); a rank whose file goes stale this "
+                        "many seconds is killed and handled like a "
+                        "crash.  Set it well above your slowest "
+                        "batch+eval gap — slow is fine, wedged is not.  "
+                        "Before a rank's first beat a startup grace of "
+                        "max(120s, 20x this) applies (import + compile)")
     p.add_argument("--fault", default=None, metavar="SPEC",
                    help="arm fault injection in every spawned process "
                         "(MX_FAULT_INJECT spec, e.g. "
+                        "'worker.step:crash:after=5' or "
                         "'kvstore.send:close:after=3'); chaos testing "
                         "only")
     p.add_argument("--ps-snapshot-dir", default=None, metavar="DIR",
