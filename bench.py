@@ -229,6 +229,86 @@ def run_bert_bench():
     }))
 
 
+def run_eager_bench():
+    """--eager: Gluon eager-Trainer step throughput, images/sec/chip.
+
+    The steady-state path ISSUE 3 optimized: per-op forward/backward, ONE
+    fused optimizer dispatch per step (multi-tensor pytree apply), device-
+    side metric accumulation.  Reported next to the TrainStep numbers so
+    BENCH rounds can watch the eager-vs-whole-step-jit gap shrink; the
+    dispatch counts per step ride along as diagnostics.
+    """
+    import jax
+    if os.environ.get("MX_BENCH_PLATFORM") == "cpu":
+        from mxnet_tpu.base import pin_cpu
+        pin_cpu()
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.engine import engine
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    on_cpu = jax.default_backend() == "cpu"
+    batch = 4 if on_cpu else 64
+    warmup = 1 if on_cpu else 3
+    iters = 2 if on_cpu else 10
+
+    mx.random.seed(0)
+    np.random.seed(0)
+    net = vision.resnet18_v1(classes=1000)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    params = list(net.collect_params().values())
+    trainer = gluon.Trainer(params, "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+    x = nd.array(np.random.randn(batch, 3, 224, 224).astype(np.float32))
+    y = nd.array(np.random.randint(0, 1000, batch).astype(np.float32))
+
+    def step():
+        with autograd.record():
+            out = net(x)
+            loss = loss_fn(out, y)
+        loss.backward()
+        trainer.step(batch_size=batch)
+        metric.update([y], [out])
+        return loss
+
+    def sync():
+        # the loss alone doesn't depend on the step's optimizer update or
+        # the metric accumulate — block on those too, or the last step's
+        # device work leaks out of the timed window
+        jax.block_until_ready(loss._jax)
+        jax.block_until_ready(params[0].data()._jax)
+        if metric._dev_sum is not None:
+            jax.block_until_ready(metric._dev_sum)
+
+    for _ in range(warmup):
+        loss = step()
+    sync()
+    c0 = engine.dispatch_count
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step()
+    sync()
+    dt = time.perf_counter() - t0
+    dispatches = (engine.dispatch_count - c0) / iters
+
+    img_per_sec = batch * iters / dt
+    print(json.dumps({
+        "metric": "resnet18_eager_trainer_images_per_sec_per_chip",
+        "value": round(img_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 4),
+        "baseline_nominal": True,
+        "device": jax.default_backend(),
+        "batch": batch,
+        "dispatches_per_step": round(dispatches, 1),
+        "n_params": len(params),
+    }))
+
+
 def run_score_bench():
     """--score: model-zoo INFERENCE throughput vs batch size (reference:
     example/image-classification/benchmark_score.py).  Hybridized forward
@@ -443,11 +523,14 @@ def main():
             run_bert_bench()
         elif mode_env == "score":
             run_score_bench()
+        elif mode_env == "eager":
+            run_eager_bench()
         else:
             run_bench()
         return
     mode = "bert" if "--bert" in sys.argv else \
-        ("score" if "--score" in sys.argv else "resnet")
+        ("score" if "--score" in sys.argv else
+         ("eager" if "--eager" in sys.argv else "resnet"))
     if "--scan" in sys.argv:
         # diagnostic: run the measured iterations inside ONE jit (lax scan
         # over the step) — the delta vs the default per-step dispatch loop

@@ -69,6 +69,8 @@ ENV_CATALOG: Dict[str, Any] = {
     "MX_PS_PORT": ("9600", "Port a kvstore server process binds (DMLC_ROLE=server)."),
     "MX_PS_SNAPSHOT": ("", "Path where a kvstore server persists its store (atomic pickle) after mutations and on STOP; a server restarted with the same path resumes with no data loss."),
     "MX_PS_SNAPSHOT_EVERY": ("1", "Snapshot the server store every N mutating requests (1 = every PUSH/INIT; larger trades durability for throughput)."),
+    "MX_KVSTORE_BUCKET_KB": ("4096", "Fusion-bucket capacity in KB for coalesced gradient exchange: a batched push/pull packs small dense keys into flat per-dtype buckets of about this size, so a ResNet-scale step does a few bucket collectives/RPCs instead of ~160 per-key ones; 0 disables bucketing.  The key->bucket layout is a pure function of the ordered (key, shape, dtype) set, so workers and the PS agree with no coordination; the dist_async retry layer replays whole buckets."),
+    "MX_OPTIMIZER_AGGREGATE": ("", "Fused multi-tensor optimizer apply: empty keeps each optimizer's default aggregate_num (SGD/NAG/Adam/AdamW fuse up to 64 params per dispatch by default), 0 opts out back to the per-param update loop, any other N caps how many (weight, grad, state) triples fuse into one jitted pytree dispatch."),
     "MX_KVSTORE_RETRY_DEADLINE": ("60", "dist_async client: total seconds to keep retrying a failed RPC (reconnect + replay) before raising a terminal MXNetError."),
     "MX_KVSTORE_RETRY_BASE": ("0.05", "dist_async client: first backoff delay in seconds; doubles per attempt."),
     "MX_KVSTORE_RETRY_MAX": ("2.0", "dist_async client: backoff delay cap in seconds."),

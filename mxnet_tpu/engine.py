@@ -35,9 +35,19 @@ class Engine:
         # live NDArray chunks, registered at creation/write; WaitForAll
         # blocks on each — the reference's "wait for all vars" semantics
         self._live = weakref.WeakSet()
+        # device-program launches since process start (or the caller's last
+        # snapshot): eager op invokes, fused tree updates, kvstore
+        # collectives, metric accumulates, whole-graph jit steps.  The
+        # dispatch-budget harness (tools/dispatch_count.py) reads deltas of
+        # this to pin the O(#buckets)-dispatches-per-step contract.
+        self.dispatch_count = 0
 
     def track(self, chunk) -> None:
         self._live.add(chunk)
+
+    def count_dispatch(self, n: int = 1) -> None:
+        """Note `n` device-program dispatches (hot path: one int add)."""
+        self.dispatch_count += n
 
     # -- engine type -------------------------------------------------------
     @property

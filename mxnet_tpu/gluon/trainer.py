@@ -16,6 +16,7 @@ from typing import Dict, List, Optional
 
 from ..base import MXNetError
 from .. import optimizer as opt
+from .. import profiler as _profiler
 from ..kvstore import create as kv_create
 from .parameter import Parameter, ParameterDict
 
@@ -43,6 +44,14 @@ class Trainer:
             self._param2idx[param.name] = i
             self._params.append(param)
             param._trainer = self
+        # dense/sparse split, computed ONCE (grad_stype is fixed at
+        # Parameter construction): the step hot loop must not re-derive
+        # per-param storage types, and sparse grads take the per-param
+        # row_sparse path while dense ones ride the fused/bucketed one
+        self._sparse_indices = [i for i, p in enumerate(self._params)
+                                if p._grad_stype == "row_sparse"]
+        self._dense_indices = [i for i, p in enumerate(self._params)
+                               if p._grad_stype != "row_sparse"]
         self._compression_params = compression_params
         self._contexts = self._check_contexts()
         optimizer_params = optimizer_params or {}
@@ -185,6 +194,8 @@ class Trainer:
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
+        idxs: List[int] = []
+        grad_lists = []
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
                 continue
@@ -194,12 +205,22 @@ class Trainer:
                 # single grad, single worker: nothing to reduce — but a
                 # multi-process store must still see the push (allreduce)
                 continue
-            self._kvstore.push(i, grads)
+            idxs.append(i)
+            grad_lists.append(grads)
+        if not idxs:
+            return
+        # ONE batched push/pull for the whole key set: the store coalesces
+        # small dense keys into fusion buckets (MX_KVSTORE_BUCKET_KB) so a
+        # ResNet-scale model does a few bucket exchanges per step instead
+        # of ~160 per-key ones
+        with _profiler.annotate("trainer.allreduce"):
+            self._kvstore.push(idxs, grad_lists)
             if self._update_on_kvstore:
                 # server-side optimizer ran on push: fetch updated weights
-                self._kvstore.pull(i, param.list_data())
+                self._kvstore.pull(
+                    idxs, [self._params[i].list_data() for i in idxs])
             else:
-                self._kvstore.pull(i, grads)
+                self._kvstore.pull(idxs, grad_lists)
 
     def update(self, batch_size, ignore_stale_grad=False):
         """Separate update step (reference: Trainer.update)."""
@@ -227,17 +248,30 @@ class Trainer:
     def _update(self, ignore_stale_grad=False):
         if self._update_on_kvstore:
             return
-        for i, param in enumerate(self._params):
-            if param.grad_req == "null":
-                continue
-            sparse = param._grad_stype == "row_sparse"
-            for upd, arr, grad in zip(self._updaters, param.list_data(),
-                                      param.list_grad()):
-                if sparse:
-                    # nnz discovery is a host sync (reference cast_storage);
-                    # the update itself is a jitted gather/scatter
-                    grad = grad.tostype("row_sparse")
-                upd(i, grad, arr)
+        with _profiler.annotate("trainer.update"):
+            for d, upd in enumerate(self._updaters):
+                # dense params: ONE batched updater call per device — the
+                # aggregate-enabled optimizer applies the whole group as a
+                # single fused pytree dispatch
+                idxs, gs, ws = [], [], []
+                for i in self._dense_indices:
+                    param = self._params[i]
+                    if param.grad_req == "null":
+                        continue
+                    idxs.append(i)
+                    ws.append(param.list_data()[d])
+                    gs.append(param.list_grad()[d])
+                if idxs:
+                    upd(idxs, gs, ws)
+                for i in self._sparse_indices:
+                    param = self._params[i]
+                    if param.grad_req == "null":
+                        continue
+                    # nnz discovery is a host sync (reference
+                    # cast_storage); the update itself is a jitted
+                    # gather/scatter — kept out of the fused dense group
+                    grad = param.list_grad()[d].tostype("row_sparse")
+                    upd(i, grad, param.list_data()[d])
 
     # -- states ------------------------------------------------------------
     def save_states(self, fname):

@@ -1,0 +1,125 @@
+"""Fusion buckets: coalesced gradient exchange (ISSUE 3 tentpole b).
+
+Reference role: MXNet's kvstore groups small dense tensors so the wire /
+collective layer sees a few large messages instead of one op per key (the
+collective-coalescing direction of arXiv:1802.06949; NCCL-era MXNet did the
+same via flattened buffer fusion).  Here a deterministic planner assigns
+small dense keys to flat per-dtype buckets of ``MX_KVSTORE_BUCKET_KB``
+(default 4 MB); a ResNet-scale push/pull then costs a few bucket exchanges
+rather than ~160 per-key RPCs or collectives.
+
+Determinism contract: the layout is a pure function of the ordered
+``(key, shape, dtype)`` descriptors and the bucket byte cap, so every
+worker — and, for the parameter-server store, every client of the same
+server — derives the same key→bucket mapping with no coordination.  The
+bucket's wire key embeds a CRC of its member descriptors: if any member's
+shape/dtype (or the member set) changes, the name changes with it, and a
+stale server entry can never be misread as the new layout.  Stores cache
+plans per signature (KVStore._bucket_plans), which is the persisted form
+of the layout within a process.
+
+Sparse values are never bucketed: a row_sparse gradient's payload is
+(data, indices) keyed on nnz — it has no stable flat extent to place at a
+fixed bucket offset.  Values larger than the cap stay solo (they already
+amortize their dispatch; the PS big-array path additionally shards them).
+"""
+from __future__ import annotations
+
+import zlib
+from typing import List, Sequence, Tuple
+
+from ..base import get_env
+
+__all__ = ["Bucket", "bucket_bytes", "plan_buckets"]
+
+
+def bucket_bytes() -> int:
+    """Configured bucket capacity in bytes; 0 disables bucketing."""
+    kb = get_env("MX_KVSTORE_BUCKET_KB", 4096, int)
+    return max(0, int(kb)) * 1024
+
+
+class Bucket:
+    """One fusion bucket: an ordered slice layout over member keys."""
+
+    __slots__ = ("name", "positions", "keys", "offsets", "sizes", "shapes",
+                 "dtype", "total")
+
+    def __init__(self, index: int, positions: Sequence[int],
+                 keys: Sequence, sizes: Sequence[int],
+                 shapes: Sequence[Tuple[int, ...]], dtype: str):
+        self.positions = list(positions)     # indices into the caller's keys
+        self.keys = list(keys)
+        self.sizes = list(sizes)
+        self.shapes = [tuple(s) for s in shapes]
+        self.dtype = dtype
+        self.offsets = []
+        off = 0
+        for n in self.sizes:
+            self.offsets.append(off)
+            off += n
+        self.total = off
+        desc = ";".join("%s:%s:%s" % (k, "x".join(map(str, s)), dtype)
+                        for k, s in zip(self.keys, self.shapes))
+        # index + member CRC: stable across steps/workers, distinct across
+        # layout changes
+        self.name = "__fusedb%d_%08x" % (index, zlib.crc32(desc.encode()))
+
+    def slices(self):
+        """(position, offset, size, shape) per member, in layout order."""
+        return zip(self.positions, self.offsets, self.sizes, self.shapes)
+
+    def __repr__(self):
+        return "Bucket(%s, n=%d, total=%d, %s)" % (
+            self.name, len(self.keys), self.total, self.dtype)
+
+
+def plan_buckets(keys: Sequence, shapes: Sequence[Tuple[int, ...]],
+                 dtypes: Sequence[str], itemsizes: Sequence[int],
+                 stypes: Sequence[str], max_bytes: int):
+    """Greedy first-fit in key order, one dtype per bucket.
+
+    Returns ``(buckets, solo_positions)``: positions not covered by any
+    bucket (sparse, over-cap, lone-member dtypes) take the per-key path.
+    Deterministic in its inputs — see the module docstring contract.
+    """
+    solo: List[int] = []
+    open_by_dtype = {}    # dtype -> (positions, nbytes)
+    closed: List[List[int]] = []
+
+    def close(dtype):
+        poss, _ = open_by_dtype.pop(dtype)
+        if len(poss) > 1:
+            closed.append(poss)
+        else:
+            solo.extend(poss)
+
+    for pos, (shape, dtype, isz, stype) in enumerate(
+            zip(shapes, dtypes, itemsizes, stypes)):
+        size = 1
+        for d in shape:
+            size *= int(d)
+        nbytes = size * int(isz)
+        if stype != "default" or max_bytes <= 0 or nbytes > max_bytes:
+            solo.append(pos)
+            continue
+        poss, used = open_by_dtype.get(dtype, ([], 0))
+        if poss and used + nbytes > max_bytes:
+            close(dtype)
+            poss, used = [], 0
+        poss.append(pos)
+        open_by_dtype[dtype] = (poss, used + nbytes)
+    for dtype in list(open_by_dtype):
+        close(dtype)
+
+    buckets = []
+    for bi, poss in enumerate(sorted(closed, key=lambda p: p[0])):
+        sizes = []
+        for p in poss:
+            n = 1
+            for d in shapes[p]:
+                n *= int(d)
+            sizes.append(n)
+        buckets.append(Bucket(bi, poss, [keys[p] for p in poss], sizes,
+                              [shapes[p] for p in poss], str(dtypes[poss[0]])))
+    return buckets, sorted(solo)

@@ -63,6 +63,9 @@ class KVStore:
         self._store: Dict[str, NDArray] = {}
         self._updater = None
         self._optimizer = None
+        # persisted key→bucket layouts, keyed by the ordered (key, shape,
+        # dtype, stype) signature of a batched push/pull (see bucketing.py)
+        self._bucket_cache: Dict = {}
 
     # -- identity ----------------------------------------------------------
     @property
@@ -89,16 +92,46 @@ class KVStore:
 
     def push(self, key, value, priority=0):
         keys, values = self._normalize(key, value)
-        for k, v in zip(keys, values):
-            merged = self._reduce(v if isinstance(v, (list, tuple)) else [v],
-                                  key=k)
+        vlists = [v if isinstance(v, (list, tuple)) else [v] for v in values]
+        merged = self._reduce_many(keys, vlists)
+        stored_list = []
+        for k in keys:
             stored = self._store.get(k)
             if stored is None:
                 raise MXNetError("key %s has not been initialized" % k)
-            if self._updater is not None:
-                self._updater(k, merged, stored)
-            else:
-                stored._set_jax(merged.as_in_context(stored.context)._jax)
+            stored_list.append(stored)
+        if self._updater is not None:
+            # ONE batched updater call: with an aggregate-enabled optimizer
+            # the server-side update is a fused pytree dispatch, not a
+            # per-key loop
+            self._updater(list(keys), merged, stored_list)
+        else:
+            for stored, m in zip(stored_list, merged):
+                stored._set_jax(m.as_in_context(stored.context)._jax)
+
+    def _reduce_many(self, keys, vlists) -> List[NDArray]:
+        """Merge each key's device copies (and, in subclasses, exchange
+        across workers — where fusion buckets coalesce the wire ops)."""
+        return [self._reduce(v, key=k) for k, v in zip(keys, vlists)]
+
+    def _bucket_plans(self, keys, arrays):
+        """Cached stable key→bucket layout for a batched exchange.
+
+        `arrays` supplies shapes/dtypes (NDArray or numpy).  Returns
+        (buckets, solo_positions); callers gate on bucketing being
+        applicable (multi-key, no attached optimizer)."""
+        from .bucketing import bucket_bytes, plan_buckets
+        sig = tuple((k, tuple(a.shape), str(a.dtype),
+                     getattr(a, "stype", "default"))
+                    for k, a in zip(keys, arrays))
+        cached = self._bucket_cache.get(sig)
+        if cached is None:
+            cached = plan_buckets(
+                keys, [s[1] for s in sig], [s[2] for s in sig],
+                [_np.dtype(a.dtype).itemsize for a in arrays],
+                [s[3] for s in sig], bucket_bytes())
+            self._bucket_cache[sig] = cached
+        return cached
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs = self._normalize(key, out)
@@ -237,6 +270,8 @@ class KVStore:
         vals = [(x if v.context == target else
                  jax.device_put(x, target.jax_device))
                 for (x, _), v in zip(comp, values)]
+        from ..engine import engine as _engine
+        _engine.count_dispatch()
         out = _sum_arrays(vals)
         if orig_dtype is not None:
             out = out.astype(orig_dtype)
@@ -352,20 +387,55 @@ class KVStoreICI(KVStoreLocal):
         stacked = jax.make_array_from_single_device_arrays(
             (self._size,) + tuple(x.shape),
             NamedSharding(mesh, P("dp")), [shard])
+        from ..engine import engine as _engine
+        _engine.count_dispatch()
         return fn(stacked)
+
+    def _cross_reduce_one(self, merged: NDArray) -> NDArray:
+        """Cross-process allreduce of ONE locally merged value."""
+        payload, orig_dtype = self._maybe_compress(merged._jax)
+        out = self._cross_process_sum(payload)
+        if orig_dtype is not None:
+            out = out.astype(orig_dtype)
+        # out is replicated over the global mesh; its local shard IS the
+        # full value — re-home it on the store's device
+        out = jax.device_put(out.addressable_data(0),
+                             merged.context.jax_device)
+        return NDArray(out, ctx=merged.context)
 
     def _reduce(self, values: List[NDArray], key=None) -> NDArray:
         merged = super()._reduce(values, key=key)
         if self._size > 1:
-            payload, orig_dtype = self._maybe_compress(merged._jax)
-            out = self._cross_process_sum(payload)
-            if orig_dtype is not None:
-                out = out.astype(orig_dtype)
-            # out is replicated over the global mesh; its local shard IS the
-            # full value — re-home it on the store's device
-            out = jax.device_put(out.addressable_data(0),
-                                 merged.context.jax_device)
-            merged = NDArray(out, ctx=merged.context)
+            merged = self._cross_reduce_one(merged)
+        return merged
+
+    def _reduce_many(self, keys, vlists) -> List[NDArray]:
+        """Batched exchange: local per-key reduce (+ optional 2-bit
+        quantize), then the cross-process allreduce coalesced into fusion
+        buckets — O(#buckets) collectives per step instead of O(#keys)."""
+        merged = [KVStore._reduce(self, v, key=k)
+                  for k, v in zip(keys, vlists)]
+        if self._size <= 1:
+            return merged
+        buckets = []
+        solo = range(len(keys))
+        if len(keys) > 1 and self._optimizer is None:
+            eligible = all(isinstance(m, NDArray) for m in merged)
+            if eligible:
+                buckets, solo = self._bucket_plans(keys, merged)
+        for b in buckets:
+            flat = jnp.concatenate(
+                [merged[p]._jax.reshape(-1) for p in b.positions])
+            from ..engine import engine as _engine
+            _engine.count_dispatch()   # the concat launch
+            out = self._cross_reduce_one(NDArray(flat,
+                                                 ctx=merged[b.positions[0]]
+                                                 .context))
+            for p, off, size, shape in b.slices():
+                piece = out._jax[off:off + size].reshape(shape)
+                merged[p] = NDArray(piece, ctx=merged[p].context)
+        for p in solo:
+            merged[p] = self._cross_reduce_one(merged[p])
         return merged
 
     def _barrier(self):
@@ -460,6 +530,7 @@ class KVStoreDistAsync(KVStore):
         self._lock = threading.Lock()
         self._seq = 0
         self._seq_lock = threading.Lock()
+        self._bucket_inited: set = set()
         self._hb_stop = threading.Event()
         self._hb_thread = None
         self._start_heartbeat()
@@ -719,20 +790,72 @@ class KVStoreDistAsync(KVStore):
             self._send_np("INIT", k, vv.asnumpy())
             self._store[k] = vv.copy()       # local mirror for shape/dtype
 
+    def _buckets_active(self, keys):
+        """Bucketing is a pure-gradient-exchange optimization: with a
+        server-side optimizer installed the server must see each key
+        individually (per-key lr/wd/state), so buckets are off."""
+        return len(keys) > 1 and self._optimizer is None and \
+            self._updater is None
+
     def push(self, key, value, priority=0):
         keys, values = self._normalize(key, value)
-        for k, v in zip(keys, values):
-            merged = self._reduce(v if isinstance(v, (list, tuple)) else [v],
-                                  key=k)
-            self._send_np("PUSH", k, merged.asnumpy())
+        vlists = [v if isinstance(v, (list, tuple)) else [v] for v in values]
+        merged = [self._reduce(v, key=k) for k, v in zip(keys, vlists)]
+        buckets = []
+        solo = range(len(keys))
+        if self._buckets_active(keys):
+            # plan from the NDArrays, not densified numpy: the signature
+            # must keep stype so the paired pull (planned from same-stype
+            # targets) derives the identical layout
+            buckets, solo = self._bucket_plans(keys, merged)
+        for b in buckets:
+            # concatenate ON DEVICE, then ONE host transfer per bucket —
+            # a per-key asnumpy loop would reintroduce O(#keys) syncs
+            flat = _np.asarray(jnp.concatenate(
+                [merged[p]._jax.reshape(-1) for p in b.positions]))
+            if b.name not in self._bucket_inited:
+                # zero-init so the server's accumulator contract (pull =
+                # init + sum of pushes) returns exactly the pushed sums
+                self._send_np("INIT", b.name, _np.zeros_like(flat))
+                self._bucket_inited.add(b.name)
+            # one wire op per bucket; the SEQ-tagged retry layer now
+            # replays buckets, not keys
+            self._send_np("PUSH", b.name, flat)
+        for p in solo:
+            self._send_np("PUSH", keys[p], merged[p].asnumpy())
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs = self._normalize(key, out)
-        for k, o in zip(keys, outs):
-            targets = o if isinstance(o, (list, tuple)) else [o]
-            arr = self._pull_np(k, targets[0].shape,
-                                int(targets[0].size))
-            for t in targets:
+        target_lists = [o if isinstance(o, (list, tuple)) else [o]
+                        for o in outs]
+        firsts = [ts[0] for ts in target_lists]
+        buckets = []
+        solo = range(len(keys))
+        if self._buckets_active(keys):
+            # same signature as the paired push (grads pull into same-stype,
+            # same-shaped buffers), so the derived layout agrees — even for
+            # a worker that never pushed itself (bucket names are a pure
+            # function of the signature)
+            buckets, solo = self._bucket_plans(keys, firsts)
+        solo = list(solo)
+        for b in buckets:
+            try:
+                flat = self._pull_np(b.name, (b.total,), b.total)
+            except MXNetError:
+                # bucket absent server-side (nothing pushed this layout
+                # yet — e.g. pulling broadcast weights): per-key fallback
+                # for exactly this bucket's members, never silent staleness
+                solo.extend(b.positions)
+                continue
+            flat = _np.asarray(flat).ravel()
+            for p, off, size, shape in b.slices():
+                piece = flat[off:off + size].reshape(shape)
+                for t in target_lists[p]:
+                    t._set_jax(nd.array(piece).astype(t.dtype)._jax)
+        for p in sorted(solo):
+            arr = self._pull_np(keys[p], firsts[p].shape,
+                                int(firsts[p].size))
+            for t in target_lists[p]:
                 t._set_jax(nd.array(arr).astype(t.dtype)._jax)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):

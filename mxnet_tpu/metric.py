@@ -5,15 +5,24 @@ F1, MCC, Perplexity, MAE, MSE, RMSE, CrossEntropy, NegativeLogLikelihood,
 PearsonCorrelation, Loss, CompositeEvalMetric, CustomMetric, np(), create()).
 Gluon 2.x re-exports this surface as gluon.metric.
 
-Accumulation happens on host in NumPy (metrics are tiny); predictions are
-fetched with asnumpy() — an explicit sync point, same as the reference.
+Device-side accumulation (ISSUE 3 tentpole c): the hot fit-loop metrics
+(Accuracy, MSE/MAE/RMSE, Loss, CrossEntropy, Perplexity) keep their running
+sum/count as DEVICE scalars, updated inside one jitted accumulate per batch
+— update() never calls asnumpy(), so host dispatch runs ahead of the device
+instead of syncing every batch.  The host transfer is deferred to get(),
+which drains the device accumulators into the classic
+``sum_metric``/``num_inst`` fields (reference semantics preserved; the
+host-numpy path still serves numpy/list inputs and the long-tail metrics).
 """
 from __future__ import annotations
 
+import functools
 import math
 from typing import List, Optional, Sequence, Union
 
 import numpy as _np
+import jax
+import jax.numpy as jnp
 
 __all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
            "F1", "MCC", "Perplexity", "MAE", "MSE", "RMSE", "CrossEntropy",
@@ -33,6 +42,16 @@ def _as_numpy(x):
     if hasattr(x, "asnumpy"):
         return x.asnumpy()
     return _np.asarray(x)
+
+
+def _device_val(x):
+    """The jax.Array behind a device-resident dense input, else None (the
+    caller then takes the host-numpy path)."""
+    if isinstance(x, jax.Array):
+        return x
+    if getattr(x, "stype", None) == "default" and hasattr(x, "_jax"):
+        return x._jax
+    return None
 
 
 def check_label_shapes(labels, preds, shape: bool = False):
@@ -84,8 +103,46 @@ class EvalMetric:
     def reset(self):
         self.num_inst = 0
         self.sum_metric = 0.0
+        self._dev_sum = None
+        self._dev_inst = None
+
+    # -- device-side accumulation -----------------------------------------
+    def _accumulate(self, kernel, *arrays):
+        """Fold one batch into the device accumulators: ONE jitted
+        dispatch, no host sync (kernel(sum, count, *arrays) -> (sum',
+        count'))."""
+        # home everything on the first array's device (group2ctx heads may
+        # produce outputs on another device than the labels/accumulators)
+        dev = next(iter(arrays[0].devices()))
+        arrays = tuple(a if a.devices() == {dev} else jax.device_put(a, dev)
+                       for a in arrays)
+        ds = getattr(self, "_dev_sum", None)
+        if ds is None:
+            ds = jax.device_put(jnp.zeros((), jnp.float32), dev)
+            di = jax.device_put(jnp.zeros((), jnp.int32), dev)
+        else:
+            di = self._dev_inst
+            if ds.devices() != {dev}:
+                ds = jax.device_put(ds, dev)
+                di = jax.device_put(di, dev)
+        from .engine import engine as _engine
+        from . import profiler as _profiler
+        with _profiler.annotate("metric.accumulate"):
+            _engine.count_dispatch()
+            self._dev_sum, self._dev_inst = kernel(ds, di, *arrays)
+
+    def _drain_device(self):
+        """Host sync point: move the device accumulators into the classic
+        sum_metric/num_inst fields (called by get())."""
+        ds = getattr(self, "_dev_sum", None)
+        if ds is not None:
+            self.sum_metric += float(_np.asarray(ds))
+            self.num_inst += int(_np.asarray(self._dev_inst))
+            self._dev_sum = None
+            self._dev_inst = None
 
     def get(self):
+        self._drain_device()
         if self.num_inst == 0:
             return (self.name, float("nan"))
         return (self.name, self.sum_metric / self.num_inst)
@@ -132,6 +189,18 @@ class CompositeEvalMetric(EvalMetric):
         return (names, values)
 
 
+@functools.lru_cache(maxsize=None)
+def _acc_kernel(axis):
+    @jax.jit
+    def k(s, n, pred, label):
+        if pred.ndim > label.ndim:
+            pred = jnp.argmax(pred, axis=axis)
+        p = pred.reshape(-1).astype(jnp.int32)
+        l = label.reshape(-1).astype(jnp.int32)
+        return s + (p == l).sum().astype(jnp.float32), n + l.size
+    return k
+
+
 @register
 class Accuracy(EvalMetric):
     def __init__(self, axis=1, name="accuracy", output_names=None,
@@ -144,6 +213,16 @@ class Accuracy(EvalMetric):
         preds = preds if isinstance(preds, (list, tuple)) else [preds]
         check_label_shapes(labels, preds)
         for label, pred in zip(labels, preds):
+            pj, lj = _device_val(pred), _device_val(label)
+            if pj is not None and lj is not None:
+                n_pred = pj.size // (pj.shape[self.axis]
+                                     if pj.ndim > lj.ndim else 1)
+                if n_pred != lj.size:
+                    raise ValueError(
+                        "Shape of labels %d does not match shape of "
+                        "predictions %d" % (lj.size, n_pred))
+                self._accumulate(_acc_kernel(self.axis), pj, lj)
+                continue
             pred = _as_numpy(pred)
             label = _as_numpy(label)
             if pred.ndim > label.ndim:
@@ -275,6 +354,23 @@ class MCC(EvalMetric):
         return (self.name, ((tp * tn) - (fp * fn)) / denom if denom else 0.0)
 
 
+@functools.lru_cache(maxsize=None)
+def _ppl_kernel(ignore_label):
+    @jax.jit
+    def k(s, n, pred, label):
+        p = pred.reshape(-1, pred.shape[-1]).astype(jnp.float32)
+        l = label.reshape(-1).astype(jnp.int32)
+        probs = jnp.take_along_axis(p, l[:, None], axis=-1)[:, 0]
+        count = l.shape[0]
+        if ignore_label is not None:
+            ign = (l == int(ignore_label))
+            probs = jnp.where(ign, 1.0, probs)
+            count = count - ign.sum()
+        loss = -jnp.sum(jnp.log(jnp.maximum(1e-10, probs)))
+        return s + loss.astype(jnp.float32), n + count
+    return k
+
+
 @register
 class Perplexity(EvalMetric):
     """exp(mean NLL) (reference: metric.Perplexity; ignore_label skips
@@ -293,6 +389,10 @@ class Perplexity(EvalMetric):
         loss = 0.0
         num = 0
         for label, pred in zip(labels, preds):
+            pj, lj = _device_val(pred), _device_val(label)
+            if pj is not None and lj is not None:
+                self._accumulate(_ppl_kernel(self.ignore_label), pj, lj)
+                continue
             pred = _as_numpy(pred).astype(_np.float64)
             label = _as_numpy(label).astype(_np.int64).reshape(-1)
             pred = pred.reshape(-1, pred.shape[-1])
@@ -307,47 +407,63 @@ class Perplexity(EvalMetric):
         self.num_inst += num
 
     def get(self):
+        self._drain_device()
         if self.num_inst == 0:
             return (self.name, float("nan"))
         return (self.name, math.exp(self.sum_metric / self.num_inst))
 
 
+@functools.lru_cache(maxsize=None)
+def _regression_kernel(squared):
+    @jax.jit
+    def k(s, n, label, pred):
+        if label.ndim == 1:
+            label = label.reshape(-1, 1)
+        if pred.ndim == 1:
+            pred = pred.reshape(-1, 1)
+        diff = label.astype(jnp.float32) - pred.astype(jnp.float32)
+        err = (diff * diff).mean() if squared else jnp.abs(diff).mean()
+        return s + err, n + 1
+    return k
+
+
+class _RegressionMetric(EvalMetric):
+    """Shared MAE/MSE accumulation (device path + host fallback)."""
+
+    _squared = False
+
+    def update(self, labels, preds):
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        for label, pred in zip(labels, preds):
+            lj, pj = _device_val(label), _device_val(pred)
+            if lj is not None and pj is not None:
+                self._accumulate(_regression_kernel(self._squared), lj, pj)
+                continue
+            label = _as_numpy(label)
+            pred = _as_numpy(pred)
+            if len(label.shape) == 1:
+                label = label.reshape(label.shape[0], 1)
+            if len(pred.shape) == 1:
+                pred = pred.reshape(pred.shape[0], 1)
+            err = ((label - pred) ** 2) if self._squared \
+                else _np.abs(label - pred)
+            self.sum_metric += float(err.mean())
+            self.num_inst += 1
+
+
 @register
-class MAE(EvalMetric):
+class MAE(_RegressionMetric):
     def __init__(self, name="mae", output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
 
-    def update(self, labels, preds):
-        labels = labels if isinstance(labels, (list, tuple)) else [labels]
-        preds = preds if isinstance(preds, (list, tuple)) else [preds]
-        for label, pred in zip(labels, preds):
-            label = _as_numpy(label)
-            pred = _as_numpy(pred)
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                pred = pred.reshape(pred.shape[0], 1)
-            self.sum_metric += float(_np.abs(label - pred).mean())
-            self.num_inst += 1
-
 
 @register
-class MSE(EvalMetric):
+class MSE(_RegressionMetric):
+    _squared = True
+
     def __init__(self, name="mse", output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
-
-    def update(self, labels, preds):
-        labels = labels if isinstance(labels, (list, tuple)) else [labels]
-        preds = preds if isinstance(preds, (list, tuple)) else [preds]
-        for label, pred in zip(labels, preds):
-            label = _as_numpy(label)
-            pred = _as_numpy(pred)
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if len(pred.shape) == 1:
-                pred = pred.reshape(pred.shape[0], 1)
-            self.sum_metric += float(((label - pred) ** 2).mean())
-            self.num_inst += 1
 
 
 @register
@@ -356,9 +472,22 @@ class RMSE(MSE):
         super().__init__(name, output_names, label_names)
 
     def get(self):
+        self._drain_device()
         if self.num_inst == 0:
             return (self.name, float("nan"))
         return (self.name, math.sqrt(self.sum_metric / self.num_inst))
+
+
+@functools.lru_cache(maxsize=None)
+def _ce_kernel(eps):
+    @jax.jit
+    def k(s, n, label, pred):
+        l = label.reshape(-1).astype(jnp.int32)
+        prob = jnp.take_along_axis(pred.astype(jnp.float32), l[:, None],
+                                   axis=-1)[:, 0]
+        return (s + (-jnp.log(prob + eps)).sum().astype(jnp.float32),
+                n + l.shape[0])
+    return k
 
 
 @register
@@ -372,6 +501,11 @@ class CrossEntropy(EvalMetric):
         labels = labels if isinstance(labels, (list, tuple)) else [labels]
         preds = preds if isinstance(preds, (list, tuple)) else [preds]
         for label, pred in zip(labels, preds):
+            lj, pj = _device_val(label), _device_val(pred)
+            if lj is not None and pj is not None and pj.ndim == 2:
+                assert lj.size == pj.shape[0]
+                self._accumulate(_ce_kernel(self.eps), lj, pj)
+                continue
             label = _as_numpy(label).ravel()
             pred = _as_numpy(pred)
             assert label.shape[0] == pred.shape[0]
@@ -402,6 +536,11 @@ class PearsonCorrelation(EvalMetric):
             self.num_inst += 1
 
 
+@jax.jit
+def _loss_kernel(s, n, pred):
+    return s + pred.sum().astype(jnp.float32), n + pred.size
+
+
 @register
 class Loss(EvalMetric):
     """Mean of a loss output stream (reference: metric.Loss)."""
@@ -412,6 +551,10 @@ class Loss(EvalMetric):
     def update(self, _, preds):
         preds = preds if isinstance(preds, (list, tuple)) else [preds]
         for pred in preds:
+            pj = _device_val(pred)
+            if pj is not None:
+                self._accumulate(_loss_kernel, pj)
+                continue
             loss = float(_as_numpy(pred).sum())
             self.sum_metric += loss
             self.num_inst += int(_np.prod(_as_numpy(pred).shape))

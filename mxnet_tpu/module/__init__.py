@@ -826,6 +826,8 @@ class Module(BaseModule):
             rng = next_key()
         else:
             rng = jax.random.PRNGKey(0)
+        from ..engine import engine as _engine
+        _engine.count_dispatch()   # the whole fwd(+bwd) is ONE executable
         label_vals = [None if l is None else l._jax for l in labels]
         if is_train:
             diff = {}
@@ -954,14 +956,23 @@ class Module(BaseModule):
         self._exec.backward(out_grads)
 
     def update(self):
-        """Reference: Module.update — updater over (grad, weight) pairs."""
+        """Reference: Module.update — updater over (grad, weight) pairs,
+        batched into ONE call so an aggregate-enabled optimizer applies
+        the whole parameter set as a single fused pytree dispatch."""
         assert self.binded and self.params_initialized and \
             self.optimizer_initialized
+        idxs, grads, weights = [], [], []
         for i, name in enumerate(self._param_names):
             grad = self._exec.grad_dict.get(name)
             if grad is None:
                 continue
-            self._updater(i, grad, self._exec.arg_dict[name])
+            idxs.append(i)
+            grads.append(grad)
+            weights.append(self._exec.arg_dict[name])
+        if idxs:
+            from .. import profiler as _profiler
+            with _profiler.annotate("module.update"):
+                self._updater(idxs, grads, weights)
 
     def get_outputs(self):
         assert self.binded

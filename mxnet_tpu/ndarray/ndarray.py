@@ -772,6 +772,7 @@ def _invoke_impl(op_name: str, *inputs, out=None, **params):
     - otherwise calls the per-(op, params) jit-cached executable.
     """
     op = get_op(op_name)
+    engine.count_dispatch()
     # MXNet op calls accept ctx= (output placement) and name= (symbol compat)
     ctx_kw = params.pop("ctx", None)
     params.pop("name", None)

@@ -16,6 +16,9 @@ rescale_grad — matching dmlc-param defaults.
 """
 from __future__ import annotations
 
+import functools
+
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -704,6 +707,178 @@ def _adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-7, wd=0.0,
     new_h = history + g * g
     upd = g / jnp.sqrt(new_h + epsilon) + wd * weight
     return (weight - lr * upd).astype(weight.dtype), new_h
+
+
+# ---------------------------------------------------------------------------
+# Tree kernels: fused pytree optimizer apply (ISSUE 3 tentpole a).
+#
+# The registry ops above are the reference's per-tensor kernels — one
+# dispatch per parameter.  The tree kernels below take the WHOLE parameter
+# group as pytrees (lists of arrays) and apply the update as ONE jitted XLA
+# program: the role of the reference's multi_sgd_update / multi_adamw fleets,
+# but without the flat varargs calling convention — lr folds in as a traced
+# per-leaf vector (so an LR scheduler never retriggers a compile), wd / clip
+# / momentum are static, and the weight/state buffers are donated so XLA
+# updates them in place (donation is skipped on the cpu backend, which
+# cannot reuse buffers and would warn).
+#
+# Each leaf's math reuses the per-tensor kernel bodies above, so fused and
+# per-param trajectories agree to fp32 tolerance (the equivalence suite in
+# tests/test_fused_update.py pins this).  Multi-precision leaves follow
+# Optimizer.update_multi_precision's generic master-copy semantics: grad is
+# cast to fp32, the fp32 body runs on weight32, and the low-precision weight
+# is a cast of the new master.
+# ---------------------------------------------------------------------------
+
+
+def _tree_sgd(weights, grads, weights32, lrs, *, wds=(), rescale_grad=1.0,
+              clip_gradient=-1.0, mp=False):
+    new_w, new_w32 = [], []
+    for i, (w, g) in enumerate(zip(weights, grads)):
+        if mp:
+            w32 = weights32[i]
+            nw32 = _sgd_update(w32, g.astype(jnp.float32), lr=lrs[i],
+                               wd=wds[i], rescale_grad=rescale_grad,
+                               clip_gradient=clip_gradient)
+            new_w.append(nw32.astype(w.dtype))
+            new_w32.append(nw32)
+        else:
+            new_w.append(_sgd_update(w, g, lr=lrs[i], wd=wds[i],
+                                     rescale_grad=rescale_grad,
+                                     clip_gradient=clip_gradient))
+    return tuple(new_w), None, tuple(new_w32) if mp else None
+
+
+def _tree_sgd_mom(weights, grads, moms, weights32, lrs, *, momentum=0.0,
+                  wds=(), rescale_grad=1.0, clip_gradient=-1.0, mp=False):
+    new_w, new_m, new_w32 = [], [], []
+    for i, (w, g, m) in enumerate(zip(weights, grads, moms)):
+        if mp:
+            w32 = weights32[i]
+            nw32, nm = _sgd_mom_update(w32, g.astype(jnp.float32), m,
+                                       lr=lrs[i], momentum=momentum,
+                                       wd=wds[i], rescale_grad=rescale_grad,
+                                       clip_gradient=clip_gradient)
+            new_w.append(nw32.astype(w.dtype))
+            new_m.append(nm)
+            new_w32.append(nw32)
+        else:
+            nw, nm = _sgd_mom_update(w, g, m, lr=lrs[i], momentum=momentum,
+                                     wd=wds[i], rescale_grad=rescale_grad,
+                                     clip_gradient=clip_gradient)
+            new_w.append(nw)
+            new_m.append(nm)
+    return tuple(new_w), (tuple(new_m),), tuple(new_w32) if mp else None
+
+
+def _tree_nag_mom(weights, grads, moms, weights32, lrs, *, momentum=0.0,
+                  wds=(), rescale_grad=1.0, clip_gradient=-1.0, mp=False):
+    new_w, new_m, new_w32 = [], [], []
+    for i, (w, g, m) in enumerate(zip(weights, grads, moms)):
+        if mp:
+            w32 = weights32[i]
+            nw32, nm = _nag_mom_update(w32, g.astype(jnp.float32), m,
+                                       lr=lrs[i], momentum=momentum,
+                                       wd=wds[i], rescale_grad=rescale_grad,
+                                       clip_gradient=clip_gradient)
+            new_w.append(nw32.astype(w.dtype))
+            new_m.append(nm)
+            new_w32.append(nw32)
+        else:
+            nw, nm = _nag_mom_update(w, g, m, lr=lrs[i], momentum=momentum,
+                                     wd=wds[i], rescale_grad=rescale_grad,
+                                     clip_gradient=clip_gradient)
+            new_w.append(nw)
+            new_m.append(nm)
+    return tuple(new_w), (tuple(new_m),), tuple(new_w32) if mp else None
+
+
+def _tree_adam(weights, grads, means, variances, weights32, lrs, *,
+               beta1=0.9, beta2=0.999, epsilon=1e-8, wds=(),
+               rescale_grad=1.0, clip_gradient=-1.0, mp=False):
+    # lrs arrive bias-corrected per leaf (the class folds sqrt(1-b2^t)/
+    # (1-b1^t) in on host, exactly like the per-param path)
+    new_w, new_m, new_v, new_w32 = [], [], [], []
+    for i, (w, g, m, v) in enumerate(zip(weights, grads, means, variances)):
+        tgt = weights32[i] if mp else w
+        gg = g.astype(jnp.float32) if mp else g
+        nw, nm, nv = _adam_update(tgt, gg, m, v, lr=lrs[i], beta1=beta1,
+                                  beta2=beta2, epsilon=epsilon, wd=wds[i],
+                                  rescale_grad=rescale_grad,
+                                  clip_gradient=clip_gradient)
+        new_w.append(nw.astype(w.dtype) if mp else nw)
+        new_m.append(nm)
+        new_v.append(nv)
+        if mp:
+            new_w32.append(nw)
+    return (tuple(new_w), (tuple(new_m), tuple(new_v)),
+            tuple(new_w32) if mp else None)
+
+
+def _tree_adamw(weights, grads, means, variances, weights32, lrs, decays, *,
+                beta1=0.9, beta2=0.999, epsilon=1e-8, wds=(),
+                rescale_grad=1.0, clip_gradient=-1.0, mp=False):
+    # lrs = bias-corrected step lr; decays = raw_lr * wd per leaf (the
+    # class's decoupled `weight -= lr * wd * weight`, fused in)
+    new_w, new_m, new_v, new_w32 = [], [], [], []
+    for i, (w, g, m, v) in enumerate(zip(weights, grads, means, variances)):
+        tgt = weights32[i] if mp else w
+        gg = g.astype(jnp.float32) if mp else g
+        nw, nm, nv = _adamw_update(tgt, gg, m, v, lr=lrs[i], beta1=beta1,
+                                   beta2=beta2, epsilon=epsilon, wd=0.0,
+                                   eta=1.0, rescale_grad=rescale_grad,
+                                   clip_gradient=clip_gradient)
+        if wds[i]:
+            nw = nw - decays[i] * nw
+        new_w.append(nw.astype(w.dtype) if mp else nw)
+        new_m.append(nm)
+        new_v.append(nv)
+        if mp:
+            new_w32.append(nw)
+    return (tuple(new_w), (tuple(new_m), tuple(new_v)),
+            tuple(new_w32) if mp else None)
+
+
+# kind -> (body, donatable positional argnums: weight/state buffers only —
+# grads and the lr vector must survive the call)
+_TREE_BODIES = {
+    "sgd": (_tree_sgd, (0, 2)),
+    "sgd_mom": (_tree_sgd_mom, (0, 2, 3)),
+    "nag_mom": (_tree_nag_mom, (0, 2, 3)),
+    "adam": (_tree_adam, (0, 2, 3, 4)),
+    "adamw": (_tree_adamw, (0, 2, 3, 4)),
+}
+
+
+@functools.lru_cache(maxsize=512)
+def _tree_jit(kind, statics, donate):
+    body, donatable = _TREE_BODIES[kind]
+    fn = functools.partial(body, **dict(statics))
+    return jax.jit(fn, donate_argnums=donatable if donate else ())
+
+
+def tree_apply(kind, arrays, lrs, decays=None, **static_params):
+    """Apply one fused pytree update: ONE device dispatch for the whole
+    (weight, grad, state) group.
+
+    ``arrays`` is the kind's positional pytree lists (weights, grads,
+    states..., weights32-or-None); ``lrs`` (and for adamw ``decays``) are
+    per-leaf host floats, shipped as a traced fp32 vector so per-step lr
+    changes never recompile.  Everything in ``static_params`` (wds tuple,
+    momentum, betas, clip, rescale_grad, mp) is static — stable across
+    steps.  Returns (new_weights, new_states_tuple_or_None,
+    new_weights32_or_None) as tuples of jax arrays.
+    """
+    import numpy as _onp
+    from ..engine import engine as _engine
+    donate = jax.default_backend() != "cpu"
+    fn = _tree_jit(kind, tuple(sorted(static_params.items())), donate)
+    args = [tuple(a) if isinstance(a, list) else a for a in arrays]
+    args.append(jnp.asarray(_onp.asarray(lrs, _onp.float32)))
+    if kind == "adamw":
+        args.append(jnp.asarray(_onp.asarray(decays, _onp.float32)))
+    _engine.count_dispatch()
+    return fn(*args)
 
 
 def _lamb_fleet_body(w, g, m, v, w32, lr, wd, beta1, beta2, epsilon, t,

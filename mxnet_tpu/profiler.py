@@ -33,7 +33,7 @@ from typing import Dict, List, Optional
 __all__ = [
     "set_config", "set_state", "state", "start", "stop", "pause", "resume",
     "dump", "dumps", "dump_profile", "Domain", "Task", "Frame", "Event",
-    "Counter", "Marker", "scope",
+    "Counter", "Marker", "scope", "annotate",
 ]
 
 # module-level fast flags read by the dispatch hot loop -----------------------
@@ -309,6 +309,29 @@ class scope:
     def __exit__(self, *exc):
         self._span.__exit__(*exc)
         return self._named.__exit__(*exc)
+
+
+class _NullSpan:
+    """Free when the profiler is stopped (annotate's fast path)."""
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+def annotate(name: str):
+    """Phase range for the steady-state training step (allreduce / update /
+    metric): a full scope() — host span + jax.named_scope so the fused
+    blocks show as single ranges in a device trace — when the profiler is
+    running, and a shared no-op context otherwise, so the fit hot loop
+    pays one global read per phase."""
+    return scope(name) if RUNNING else _NULL_SPAN
 
 
 # -- output -------------------------------------------------------------------

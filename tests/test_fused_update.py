@@ -1,0 +1,455 @@
+"""ISSUE 3: single-dispatch training step.
+
+Pins the three tentpole layers:
+  * fused multi-tensor optimizer apply == per-param loop to fp32 tolerance
+    (SGD / SGD-momentum / NAG / Adam / AdamW, incl. multi-precision bf16
+    weights + fp32 master, and lr_mult / wd_mult overrides);
+  * dispatch-count regression: Trainer.step and metric.update issue O(1)
+    device dispatches, not O(#params) (tools/dispatch_count.py harness);
+  * bucketed gradient exchange: deterministic key→bucket layout, dist_async
+    roundtrip over real sockets, retry-layer composition;
+  * device-side metric accumulation parity with the host-numpy path.
+"""
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu import optimizer as opt
+from mxnet_tpu.engine import engine
+from mxnet_tpu.gluon import nn
+
+SHAPES = [(4, 3), (7,), (2, 3, 2), (5, 5), (1,)]
+
+
+def _run_updater(name, kwargs, aggregate, steps=4, mp=False, mults=False):
+    """Drive an Updater over SHAPES params; returns final fp32 weights."""
+    np.random.seed(0)
+    dtype = "bfloat16" if mp else "float32"
+    o = opt.create(name, multi_precision=mp,
+                   param_idx2name={i: "p%d_weight" % i
+                                   for i in range(len(SHAPES))}, **kwargs)
+    if not aggregate:
+        o.aggregate_num = 0
+    assert (o.aggregate_num > 0) == aggregate
+    if mults:
+        o.set_lr_mult({"p1_weight": 0.5, "p3_weight": 2.0})
+        o.set_wd_mult({"p2_weight": 3.0})
+    upd = opt.get_updater(o)
+    ws = [nd.array(np.random.randn(*s).astype(np.float32)).astype(dtype)
+          for s in SHAPES]
+    for step in range(steps):
+        gs = [nd.array((np.random.randn(*s) * (step + 1)).astype(np.float32)
+                       ).astype(dtype) for s in SHAPES]
+        upd(list(range(len(SHAPES))), gs, ws)
+    return [w.asnumpy().astype(np.float32) for w in ws]
+
+
+@pytest.mark.parametrize("mults", [False, True])
+@pytest.mark.parametrize("mp", [False, True])
+@pytest.mark.parametrize("name,kwargs", [
+    ("sgd", {"learning_rate": 0.1}),
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 0.01}),
+    ("sgd", {"learning_rate": 0.5, "momentum": 0.9, "clip_gradient": 0.2}),
+    ("nag", {"learning_rate": 0.1, "momentum": 0.9, "wd": 0.01}),
+    ("adam", {"learning_rate": 0.01, "wd": 0.01}),
+    ("adamw", {"learning_rate": 0.01, "wd": 0.05}),
+])
+def test_fused_matches_per_param_loop(name, kwargs, mp, mults):
+    fused = _run_updater(name, kwargs, True, mp=mp, mults=mults)
+    loop = _run_updater(name, kwargs, False, mp=mp, mults=mults)
+    for f, l in zip(fused, loop):
+        np.testing.assert_allclose(f, l, rtol=2e-5, atol=1e-6)
+
+
+def test_fused_respects_lr_scheduler():
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+
+    def run(aggregate):
+        np.random.seed(1)
+        o = opt.SGD(momentum=0.9,
+                    lr_scheduler=FactorScheduler(step=2, factor=0.5,
+                                                 base_lr=0.2))
+        if not aggregate:
+            o.aggregate_num = 0
+        upd = opt.get_updater(o)
+        ws = [nd.array(np.random.randn(*s).astype(np.float32))
+              for s in SHAPES]
+        for _ in range(5):
+            gs = [nd.array(np.random.randn(*s).astype(np.float32))
+                  for s in SHAPES]
+            upd(list(range(len(SHAPES))), gs, ws)
+        return [w.asnumpy() for w in ws]
+
+    for f, l in zip(run(True), run(False)):
+        np.testing.assert_allclose(f, l, rtol=2e-5, atol=1e-6)
+
+
+def test_aggregate_env_opt_out(monkeypatch):
+    monkeypatch.setenv("MX_OPTIMIZER_AGGREGATE", "0")
+    assert opt.create("sgd").aggregate_num == 0
+    assert opt.create("adam").aggregate_num == 0
+    monkeypatch.setenv("MX_OPTIMIZER_AGGREGATE", "8")
+    assert opt.create("sgd").aggregate_num == 8
+    monkeypatch.delenv("MX_OPTIMIZER_AGGREGATE")
+    assert opt.create("sgd").aggregate_num > 0     # fused by default
+    assert opt.create("adamw").aggregate_num > 0
+    # explicit constructor arg wins over the default
+    assert opt.create("sgd", aggregate_num=3).aggregate_num == 3
+
+
+def test_aggregate_num_chunks_dispatches():
+    o = opt.SGD(learning_rate=0.1, momentum=0.9, aggregate_num=2)
+    upd = opt.get_updater(o)
+    ws = [nd.ones((3, 3)) for _ in range(5)]
+    gs = [nd.ones((3, 3)) for _ in range(5)]
+    upd(list(range(5)), gs, ws)          # warmup (state creation)
+    c0 = engine.dispatch_count
+    upd(list(range(5)), gs, ws)
+    assert engine.dispatch_count - c0 == 3   # ceil(5 / 2)
+
+
+def test_fused_updater_state_roundtrip():
+    """Pickled updater states from the fused path load back and keep the
+    trajectory identical (momentum buffers survive)."""
+    o = opt.SGD(learning_rate=0.1, momentum=0.9)
+    upd = opt.get_updater(o)
+    ws = [nd.ones((3, 3)) for _ in range(3)]
+    gs = [nd.ones((3, 3)) * 0.5 for _ in range(3)]
+    upd(list(range(3)), gs, ws)
+    blob = upd.get_states()
+    upd2 = opt.get_updater(opt.SGD(learning_rate=0.1, momentum=0.9))
+    upd2.set_states(blob)
+    assert set(upd2.states) == {0, 1, 2}
+    ws2 = [w.copy() for w in ws]
+    upd(list(range(3)), gs, ws)
+    upd2(list(range(3)), gs, ws2)
+    for a, b in zip(ws, ws2):
+        np.testing.assert_allclose(a.asnumpy(), b.asnumpy(), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# dispatch budget: O(1) per step, not O(#params)
+# ---------------------------------------------------------------------------
+
+def test_trainer_step_dispatch_budget():
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import dispatch_count
+    report = dispatch_count.run(steps=3)
+    assert report["ok"], report
+    assert report["params"] >= 10
+    assert report["trainer_step_dispatches"] <= report["step_budget"]
+    assert report["trainer_step_dispatches"] < report["params"]
+    assert report["metric_update_dispatches"] <= report["metric_budget"]
+
+
+def test_trainer_fused_step_matches_loop_trajectory():
+    """End-to-end Gluon: training with the fused step reproduces the
+    per-param-loop trajectory."""
+
+    def train(aggregate):
+        mx.random.seed(0)
+        np.random.seed(0)
+        net = nn.Sequential()
+        net.add(nn.Dense(8, in_units=6, activation="relu"),
+                nn.Dense(3, in_units=8))
+        net.initialize(mx.init.Xavier())
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1, "momentum": 0.9})
+        if not aggregate:
+            trainer.optimizer.aggregate_num = 0
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        x = nd.array(np.random.randn(12, 6).astype(np.float32))
+        y = nd.array(np.random.randint(0, 3, 12).astype(np.float32))
+        for _ in range(4):
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            trainer.step(batch_size=12)
+        return [p.data().asnumpy() for p in net.collect_params().values()]
+
+    for a, b in zip(train(True), train(False)):
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# bucketed gradient exchange
+# ---------------------------------------------------------------------------
+
+def test_bucket_plan_layout():
+    from mxnet_tpu.kvstore.bucketing import plan_buckets
+    keys = [0, 1, 2, 3, 4, 5]
+    shapes = [(8, 8), (16,), (100, 100), (8, 8), (4,), (2,)]
+    dtypes = ["float32"] * 5 + ["int32"]
+    stypes = ["default"] * 6
+    buckets, solo = plan_buckets(keys, shapes, dtypes, [4] * 6, stypes,
+                                 max_bytes=1024)
+    # (100,100) fp32 = 40 KB > cap -> solo; the lone int32 key -> solo
+    assert solo == [2, 5]
+    assert len(buckets) == 1
+    b = buckets[0]
+    assert b.positions == [0, 1, 3, 4]
+    assert b.total == 64 + 16 + 64 + 4
+    assert b.offsets == [0, 64, 80, 144]
+    # deterministic, content-addressed name
+    again, _ = plan_buckets(keys, shapes, dtypes, [4] * 6, stypes, 1024)
+    assert again[0].name == b.name
+    # layout change changes the name (stale-server safety)
+    changed, _ = plan_buckets(keys, [(9, 8)] + shapes[1:], dtypes, [4] * 6,
+                              stypes, 1024)
+    assert changed[0].name != b.name
+
+
+def test_bucket_plan_excludes_sparse_and_respects_cap():
+    from mxnet_tpu.kvstore.bucketing import plan_buckets
+    keys = list(range(4))
+    shapes = [(8,), (8,), (8,), (8,)]
+    buckets, solo = plan_buckets(keys, shapes, ["float32"] * 4, [4] * 4,
+                                 ["default", "row_sparse", "default",
+                                  "default"], max_bytes=1024)
+    assert 1 in solo                     # sparse never bucketed
+    assert buckets[0].positions == [0, 2, 3]
+    # cap forces multiple buckets: 2 x 32B per 64B bucket
+    buckets, solo = plan_buckets(keys, shapes, ["float32"] * 4, [4] * 4,
+                                 ["default"] * 4, max_bytes=64)
+    assert len(buckets) == 2
+    assert [b.positions for b in buckets] == [[0, 1], [2, 3]]
+    # 0 disables
+    buckets, solo = plan_buckets(keys, shapes, ["float32"] * 4, [4] * 4,
+                                 ["default"] * 4, max_bytes=0)
+    assert not buckets and solo == [0, 1, 2, 3]
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _start_server(port):
+    from mxnet_tpu.kvstore.server import serve_forever
+    t = threading.Thread(target=serve_forever,
+                         kwargs=dict(port=port, num_workers=1), daemon=True)
+    t.start()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port), timeout=0.2).close()
+            return t
+        except OSError:
+            time.sleep(0.05)
+    raise RuntimeError("server did not come up on %d" % port)
+
+
+@pytest.fixture
+def _dist_async_client(monkeypatch):
+    from mxnet_tpu.kvstore.kvstore import KVStoreDistAsync
+    monkeypatch.setenv("MX_KVSTORE_HEARTBEAT", "0")
+    monkeypatch.setenv("MX_KVSTORE_BUCKET_KB", "1")   # force small buckets
+    monkeypatch.delenv("MX_PS_ROOTS", raising=False)
+    port = _free_port()
+    _start_server(port)
+    monkeypatch.setenv("MX_PS_ROOT", "127.0.0.1:%d" % port)
+    kv = KVStoreDistAsync()
+    yield kv
+    kv.stop_server()
+
+
+def test_dist_async_bucketed_roundtrip(_dist_async_client):
+    kv = _dist_async_client
+    keys = list(range(5))
+    shapes = [(8, 8), (16,), (8, 8), (64, 64), (4,)]   # 16 KB one stays solo
+    for k, s in zip(keys, shapes):
+        kv.init(k, nd.zeros(s))
+    grads = [nd.array(np.random.RandomState(k).randn(*s).astype(np.float32))
+             for k, s in zip(keys, shapes)]
+    kv.push(keys, grads)
+    assert kv._bucket_inited                   # fusion buckets went out
+    outs = [nd.zeros(s) for s in shapes]
+    kv.pull(keys, outs)
+    for g, o in zip(grads, outs):
+        np.testing.assert_allclose(o.asnumpy(), g.asnumpy(), rtol=1e-6)
+    # the server accumulates bucket payloads exactly like per-key pushes
+    kv.push(keys, grads)
+    kv.pull(keys, outs)
+    for g, o in zip(grads, outs):
+        np.testing.assert_allclose(o.asnumpy(), 2 * g.asnumpy(), rtol=1e-6)
+
+
+def test_dist_async_bucket_pull_from_other_worker(_dist_async_client,
+                                                  monkeypatch):
+    """A worker that never pushed derives the same deterministic layout
+    and reads the bucket another client wrote — no silent per-key
+    staleness (code-review regression)."""
+    from mxnet_tpu.kvstore.kvstore import KVStoreDistAsync
+    kv = _dist_async_client
+    keys = [0, 1, 2]
+    shapes = [(8, 8), (16,), (8, 8)]
+    for k, s in zip(keys, shapes):
+        kv.init(k, nd.zeros(s))
+    grads = [nd.array(np.random.RandomState(k).randn(*s).astype(np.float32))
+             for k, s in zip(keys, shapes)]
+    kv.push(keys, grads)
+    other = KVStoreDistAsync()          # fresh client, empty _bucket_inited
+    try:
+        for k, s in zip(keys, shapes):
+            other.init(k, nd.zeros(s))  # mirrors only; bucket already live
+        outs = [nd.zeros(s) for s in shapes]
+        other.pull(keys, outs)
+        for g, o in zip(grads, outs):
+            np.testing.assert_allclose(o.asnumpy(), g.asnumpy(), rtol=1e-6)
+    finally:
+        other.close()
+
+
+def test_dist_async_bucket_pull_falls_back_before_any_push(
+        _dist_async_client):
+    """Batched pull BEFORE any bucket push: the bucket is absent server-
+    side, so the pull must fall back to per-key reads (broadcast-weights
+    pattern), not fail and not return garbage."""
+    kv = _dist_async_client
+    keys = [0, 1]
+    vals = [nd.array(np.full((4,), 7.0, np.float32)),
+            nd.array(np.full((6,), 9.0, np.float32))]
+    for k, v in zip(keys, vals):
+        kv.init(k, v)
+    outs = [nd.zeros((4,)), nd.zeros((6,))]
+    kv.pull(keys, outs)
+    np.testing.assert_allclose(outs[0].asnumpy(), 7.0)
+    np.testing.assert_allclose(outs[1].asnumpy(), 9.0)
+
+
+def test_dist_async_bucketing_off_with_server_optimizer(_dist_async_client):
+    """With a server-side optimizer the server must see each key
+    individually: buckets stay off and per-key semantics hold."""
+    kv = _dist_async_client
+    kv.init("w", nd.ones((4,)))
+    kv.init("v", nd.ones((3,)))
+    kv.set_optimizer(opt.SGD(learning_rate=0.5))
+    kv.push(["w", "v"], [nd.ones((4,)), nd.ones((3,))])
+    assert not kv._bucket_inited
+    out_w, out_v = nd.zeros((4,)), nd.zeros((3,))
+    kv.pull(["w", "v"], [out_w, out_v])
+    np.testing.assert_allclose(out_w.asnumpy(), 0.5)
+    np.testing.assert_allclose(out_v.asnumpy(), 0.5)
+
+
+def test_ici_store_batched_push_pull_single_process():
+    """The Trainer's batched push/pull path through the collective store:
+    local device-copy reduce still works keyed per param."""
+    from mxnet_tpu import kvstore
+    kv = kvstore.create("ici")
+    keys = [0, 1]
+    kv.init(keys, [nd.zeros((3,)), nd.zeros((2, 2))])
+    g0 = [nd.array(np.full(3, r + 1.0, np.float32), ctx=mx.cpu(r))
+          for r in range(2)]
+    g1 = [nd.array(np.full((2, 2), 10.0 * (r + 1), np.float32),
+                   ctx=mx.cpu(r)) for r in range(2)]
+    kv.push(keys, [g0, g1])
+    o0, o1 = nd.zeros((3,)), nd.zeros((2, 2))
+    kv.pull(keys, [o0, o1])
+    np.testing.assert_allclose(o0.asnumpy(), 3.0)    # 1 + 2
+    np.testing.assert_allclose(o1.asnumpy(), 30.0)   # 10 + 20
+
+
+# ---------------------------------------------------------------------------
+# device-side metric accumulation
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("cls,kwargs", [
+    ("Accuracy", {}),
+    ("MSE", {}),
+    ("MAE", {}),
+    ("RMSE", {}),
+    ("CrossEntropy", {}),
+    ("Perplexity", {"ignore_label": 2}),
+])
+def test_device_metric_matches_host(cls, kwargs):
+    from mxnet_tpu import metric as M
+    rng = np.random.RandomState(0)
+    lab = rng.randint(0, 5, (8,)).astype(np.float32)
+    if cls in ("MSE", "MAE", "RMSE"):
+        pred = rng.rand(8).astype(np.float32)
+    else:
+        pred = rng.rand(8, 5).astype(np.float32)
+        pred /= pred.sum(axis=1, keepdims=True)
+    dev = getattr(M, cls)(**kwargs)
+    host = getattr(M, cls)(**kwargs)
+    for _ in range(3):
+        dev.update([nd.array(lab)], [nd.array(pred)])
+        host.update([lab], [pred])
+    # update() stayed device-side: accumulators live, no host sync yet
+    assert dev._dev_sum is not None
+    assert np.allclose(dev.get()[1], host.get()[1], rtol=1e-5, atol=1e-7)
+    # drained after get()
+    assert dev._dev_sum is None
+
+
+def test_device_metric_single_dispatch_per_update():
+    from mxnet_tpu import metric as M
+    m = M.Accuracy()
+    lab, pred = nd.array(np.zeros(8)), nd.array(np.random.rand(8, 4))
+    m.update([lab], [pred])          # warm
+    c0 = engine.dispatch_count
+    m.update([lab], [pred])
+    assert engine.dispatch_count - c0 == 1
+
+
+def test_device_metric_mixed_paths_and_reset():
+    from mxnet_tpu import metric as M
+    rng = np.random.RandomState(3)
+    lab = rng.randint(0, 4, (6,)).astype(np.float32)
+    pred = rng.rand(6, 4).astype(np.float32)
+    m = M.Accuracy()
+    m.update([nd.array(lab)], [nd.array(pred)])   # device
+    m.update([lab], [pred])                       # host numpy
+    h = M.Accuracy()
+    h.update([lab], [pred])
+    h.update([lab], [pred])
+    assert np.allclose(m.get()[1], h.get()[1])
+    m.reset()
+    assert m.num_inst == 0 and m._dev_sum is None
+    name, val = m.get()
+    assert np.isnan(val)
+
+
+def test_loss_metric_device_path():
+    from mxnet_tpu import metric as M
+    x = np.random.RandomState(1).rand(4, 3).astype(np.float32)
+    m, h = M.Loss(), M.Loss()
+    m.update(None, [nd.array(x)])
+    h.update(None, [x])
+    assert m._dev_sum is not None
+    assert np.allclose(m.get()[1], h.get()[1], rtol=1e-6)
+
+
+def test_module_fit_epoch_metric_still_correct():
+    """Module fit path end-to-end with the device-accumulated Accuracy."""
+    import mxnet_tpu.symbol as sym
+    from mxnet_tpu.io import NDArrayIter
+    from mxnet_tpu.module import Module
+
+    np.random.seed(0)
+    x = np.random.randn(64, 10).astype(np.float32)
+    w = np.random.randn(10, 3).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.float32)
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=3, name="fc")
+    out = sym.SoftmaxOutput(net, sym.Variable("softmax_label"),
+                            name="softmax")
+    mod = Module(out, context=mx.cpu())
+    it = NDArrayIter(x, y, batch_size=16)
+    mod.fit(it, num_epoch=6, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5})
+    metric = mx.metric.Accuracy()
+    score = mod.score(it, metric)
+    assert dict(score)["accuracy"] > 0.8

@@ -1,0 +1,101 @@
+"""Dispatch-count smoke: the ISSUE 3 acceptance harness.
+
+Runs a short eager Gluon-Trainer fit on CPU, counts device-program
+dispatches per training-step phase via ``engine.dispatch_count``, prints a
+JSON report and exits nonzero if the step exceeds its budget.
+
+The contract being locked: ``Trainer.step`` (allreduce + optimizer apply)
+and the metric update together issue **O(#buckets)** dispatches per step —
+a handful, independent of the parameter count — instead of the pre-fusion
+O(#params).  Forward/backward stay eager per-op here on purpose (that is
+the workload the Gluon path serves); the whole-graph-jitted paths
+(Module fast path, parallel.TrainStep) are already single-dispatch.
+
+Usage: python tools/dispatch_count.py [--steps N] [--params N]
+Wired as a fast non-slow test in tests/test_fused_update.py.
+"""
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("MX_FORCE_CPU", "1")
+
+# a step phase may legitimately cost a few fixed dispatches (fused update
+# chunk, bucket exchange, metric accumulate) — but never O(#params)
+STEP_BUDGET = 4
+METRIC_BUDGET = 2
+
+
+def run(steps=3, hidden_layers=6, hidden=16):
+    """Measured eager fit; returns the report dict (no printing)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.engine import engine
+    from mxnet_tpu.gluon import nn
+
+    mx.random.seed(0)
+    np.random.seed(0)
+    net = nn.Sequential()
+    in_units = 8
+    for _ in range(hidden_layers):
+        net.add(nn.Dense(hidden, in_units=in_units, activation="relu"))
+        in_units = hidden
+    net.add(nn.Dense(4, in_units=in_units))
+    net.initialize(mx.init.Xavier())
+    params = list(net.collect_params().values())
+    trainer = gluon.Trainer(params, "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+    x = nd.array(np.random.randn(16, 8).astype(np.float32))
+    y = nd.array(np.random.randint(0, 4, 16).astype(np.float32))
+
+    def one_step():
+        with autograd.record():
+            out = net(x)
+            loss = loss_fn(out, y)
+        loss.backward()
+        c0 = engine.dispatch_count
+        trainer.step(batch_size=16)
+        step_d = engine.dispatch_count - c0
+        c1 = engine.dispatch_count
+        metric.update([y], [out])
+        metric_d = engine.dispatch_count - c1
+        return step_d, metric_d
+
+    one_step()                      # warmup: state creation dispatches
+    per_step = [one_step() for _ in range(steps)]
+    step_d = max(d for d, _ in per_step)
+    metric_d = max(d for _, d in per_step)
+    n_params = len(params)
+    return {
+        "metric": "eager_step_dispatches",
+        "params": n_params,
+        "steps": steps,
+        "trainer_step_dispatches": step_d,
+        "metric_update_dispatches": metric_d,
+        "step_budget": STEP_BUDGET,
+        "metric_budget": METRIC_BUDGET,
+        "ok": bool(step_d <= STEP_BUDGET and metric_d <= METRIC_BUDGET
+                   and step_d < n_params),
+    }
+
+
+def main():
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--layers", type=int, default=6)
+    args = ap.parse_args()
+    report = run(steps=args.steps, hidden_layers=args.layers)
+    print(json.dumps(report, indent=2))
+    sys.exit(0 if report["ok"] else 1)
+
+
+if __name__ == "__main__":
+    main()
