@@ -50,6 +50,7 @@ ENV_CATALOG: Dict[str, Any] = {
     "MXNET_GPU_MEM_POOL_TYPE": ("Round", "No-op: PJRT owns HBM pooling."),
     "MXNET_KVSTORE_BIGARRAY_BOUND": ("1000000", "Gradient bucket size threshold for kvstore collectives."),
     "MXNET_ENFORCE_DETERMINISM": ("0", "Force deterministic kernels."),
+    "MXNET_PROFILER_SYNC": ("0", "1 = the profiler blocks until each annotated range's device work completes before stamping its duration (accurate per-range timings at the cost of breaking dispatch overlap)."),
     "MXNET_SAFE_ACCUMULATION": ("1", "Accumulate reductions in fp32 even for fp16/bf16 inputs."),
     "MXNET_DEFAULT_DTYPE": ("float32", "Default dtype for array creation."),
     # rebuild-specific flags (SURVEY §5.6: env vars are the de-facto flag
@@ -71,7 +72,7 @@ ENV_CATALOG: Dict[str, Any] = {
     "MX_PS_SNAPSHOT_EVERY": ("1", "Snapshot the server store every N mutating requests (1 = every PUSH/INIT; larger trades durability for throughput)."),
     "MX_KVSTORE_BUCKET_KB": ("4096", "Fusion-bucket capacity in KB for coalesced gradient exchange: a batched push/pull packs small dense keys into flat per-dtype buckets of about this size, so a ResNet-scale step does a few bucket collectives/RPCs instead of ~160 per-key ones; 0 disables bucketing.  The key->bucket layout is a pure function of the ordered (key, shape, dtype) set, so workers and the PS agree with no coordination; the dist_async retry layer replays whole buckets."),
     "MX_OPTIMIZER_AGGREGATE": ("", "Fused multi-tensor optimizer apply: empty keeps each optimizer's default aggregate_num (SGD/NAG/Adam/AdamW fuse up to 64 params per dispatch by default), 0 opts out back to the per-param update loop, any other N caps how many (weight, grad, state) triples fuse into one jitted pytree dispatch."),
-    "MX_KVSTORE_RETRY_DEADLINE": ("60", "dist_async client: total seconds to keep retrying a failed RPC (reconnect + replay) before raising a terminal MXNetError."),
+    "MX_KVSTORE_RETRY_DEADLINE": ("60", "dist_async client: total seconds to keep retrying a failed RPC (reconnect + replay) before raising a terminal MXNetError; also bounds the initial connect wait per server at startup (the launcher starts servers concurrently, so workers retry until each binds)."),
     "MX_KVSTORE_RETRY_BASE": ("0.05", "dist_async client: first backoff delay in seconds; doubles per attempt."),
     "MX_KVSTORE_RETRY_MAX": ("2.0", "dist_async client: backoff delay cap in seconds."),
     "MX_KVSTORE_RETRY_JITTER": ("0.2", "dist_async client: uniform jitter fraction added to each backoff delay (decorrelates worker retry storms)."),
@@ -157,9 +158,11 @@ class environment:
 # ---------------------------------------------------------------------------
 
 def cpu_pinned_by_user() -> bool:
-    """True if the operator explicitly pinned CPU (MX_FORCE_CPU=1 or
-    JAX_PLATFORMS=cpu) — callers must honor it and skip accelerator probes."""
-    if os.environ.get("MX_FORCE_CPU") == "1":
+    """True if the operator explicitly pinned CPU (MX_FORCE_CPU truthy or
+    JAX_PLATFORMS=cpu) — callers must honor it and skip accelerator probes.
+    Same bool parsing as device.py's resolution ('1'/'true'/'yes'/'on'),
+    so the pin and the probe can never disagree."""
+    if get_env("MX_FORCE_CPU", dtype=bool):
         return True
     return os.environ.get("JAX_PLATFORMS", "").strip() == "cpu"
 

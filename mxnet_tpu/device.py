@@ -28,8 +28,8 @@ def _accel_devices() -> List[jax.Device]:
     under jax.distributed, rank r's cpu(0)/tpu(0) must resolve to one of
     r's own (addressable) devices, never another process's — hence
     jax.local_devices, not jax.devices."""
-    import os
-    if os.environ.get("MX_FORCE_CPU"):
+    from .base import get_env
+    if get_env("MX_FORCE_CPU", dtype=bool):
         # test harness: pretend no accelerator so tpu(i) maps onto the fake
         # 8-device host mesh (SURVEY.md §4.5)
         return []

@@ -60,7 +60,7 @@ class Engine:
         # os.environ in sync) and direct monkeypatch.setenv writes, without
         # get_env's lock + override-dict + dtype machinery per dispatch
         import os
-        val = os.environ.get("MXNET_ENGINE_TYPE")
+        val = os.environ.get("MXNET_ENGINE_TYPE")  # mxlint: disable=env-var-registry
         if val != self._kind_raw:
             self._kind_raw = val
             self._naive = val in ("NaiveEngine", "naive")

@@ -38,7 +38,6 @@ Instrumented sites (grep for ``fault.fire``):
 """
 from __future__ import annotations
 
-import os
 import random as _random
 import threading
 import time as _time
@@ -48,7 +47,7 @@ from .base import get_env
 
 __all__ = ["FaultError", "RetryPolicy", "FaultInjector", "inject", "fire",
            "clear", "site_calls", "arm_from_env", "use_virtual_time",
-           "VirtualClock", "now", "sleep"]
+           "VirtualClock", "now", "sleep", "is_virtual", "Deadline"]
 
 
 class FaultError(ConnectionError):
@@ -89,8 +88,10 @@ class VirtualClock:
 
 
 class _RealClock:
-    now = staticmethod(_time.monotonic)
-    sleep = staticmethod(_time.sleep)
+    # the one legitimate raw-clock site: this IS the injectable clock's
+    # real backend
+    now = staticmethod(_time.monotonic)    # mxlint: disable=wall-clock-in-fault-path
+    sleep = staticmethod(_time.sleep)      # mxlint: disable=wall-clock-in-fault-path
 
 
 _clock: Any = _RealClock()
@@ -103,6 +104,47 @@ def now() -> float:
 
 def sleep(seconds: float) -> None:
     _clock.sleep(seconds)
+
+
+def is_virtual() -> bool:
+    """True while a use_virtual_time() context governs the module clock.
+    Waits that cannot ride sleep() directly (condition variables, socket
+    timeouts) branch on this to charge their tick to the virtual clock
+    instead of blocking real time."""
+    return isinstance(_clock, VirtualClock)
+
+
+class Deadline:
+    """A wait budget that survives clock-regime switches.
+
+    ``now()``-anchored absolute deadlines break when a use_virtual_time()
+    context starts or ends around a parked thread: a virtual anchor
+    compared against real monotonic mis-fires by tens of thousands of
+    seconds (either direction).  Deadline instead consumes elapsed time
+    per same-regime segment — the interval spanning a switch is simply
+    not charged — so long-lived waits (barrier parks, connect retries,
+    drain loops) keep an honest budget on whichever clock is current.
+    """
+
+    __slots__ = ("_remaining", "_anchor", "_virtual")
+
+    def __init__(self, seconds: float):
+        self._remaining = float(seconds)
+        self._anchor = now()
+        self._virtual = is_virtual()
+
+    def remaining(self) -> float:
+        cur_virtual = is_virtual()
+        cur = now()
+        if cur_virtual == self._virtual:
+            self._remaining -= max(0.0, cur - self._anchor)
+        else:
+            self._virtual = cur_virtual
+        self._anchor = cur
+        return self._remaining
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0
 
 
 class use_virtual_time:
@@ -356,5 +398,5 @@ def arm_from_env(spec: Optional[str] = None) -> List[_Rule]:
 
 # arm automatically in any process launched with the env spec set
 # (tools/launch.py --fault path); a bad spec should fail loudly at import
-if os.environ.get("MX_FAULT_INJECT"):
+if get_env("MX_FAULT_INJECT", ""):
     arm_from_env()

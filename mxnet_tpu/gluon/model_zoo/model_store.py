@@ -25,13 +25,15 @@ import hashlib
 import os
 import re
 
+from ...base import get_env
+
 __all__ = ["get_model_file", "load_pretrained", "purge"]
 
 _SHA1_NAME = re.compile(r"-([0-9a-f]{8})\.params$")
 
 
 def _root(root=None):
-    return root or os.environ.get("MX_PRETRAINED_DIR") or \
+    return root or get_env("MX_PRETRAINED_DIR", default="") or \
         os.path.join(os.path.expanduser("~"), ".mxnet", "models")
 
 

@@ -27,6 +27,7 @@ XLA collectives.
 from __future__ import annotations
 
 import pickle
+import time as _real_time
 from typing import Callable, Dict, List, Optional
 
 import numpy as _np
@@ -447,7 +448,8 @@ class KVStoreICI(KVStoreLocal):
 def _ps_addr():
     """Parameter-server address from the launcher env, or None."""
     import os
-    addr = os.environ.get("MX_PS_ROOT") or \
+    from ..base import get_env
+    addr = get_env("MX_PS_ROOT") or \
         os.environ.get("DMLC_PS_ROOT_URI")
     if not addr:
         return None
@@ -460,8 +462,8 @@ def _ps_addrs():
     """ALL server addresses (MX_PS_ROOTS, comma-separated) — keys shard
     across them by hash (reference: kvstore_dist.h key->server
     assignment + MXNET_KVSTORE_BIGARRAY_BOUND sharding role)."""
-    import os
-    roots = os.environ.get("MX_PS_ROOTS")
+    from ..base import get_env
+    roots = get_env("MX_PS_ROOTS")
     if roots:
         return [a.strip() for a in roots.split(",") if a.strip()]
     one = _ps_addr()
@@ -504,29 +506,40 @@ class KVStoreDistAsync(KVStore):
                 "with tools/launch.py -n <workers> -s <servers> "
                 "(MX_PS_ROOTS/MX_PS_ROOT unset)")
         self._addrs = list(addrs)
-        self._rank = int(os.environ.get("MX_PROCESS_ID",
-                                        os.environ.get("DMLC_WORKER_ID", 0)))
-        self._size = int(os.environ.get("MX_NUM_PROCESSES",
-                                        os.environ.get("DMLC_NUM_WORKER",
-                                                       1)))
+        from ..base import get_env
+        self._rank = int(get_env("MX_PROCESS_ID") or
+                         os.environ.get("DMLC_WORKER_ID", 0))
+        self._size = int(get_env("MX_NUM_PROCESSES") or
+                         os.environ.get("DMLC_NUM_WORKER", 1))
         # liveness is per RANK server-side; the uuid distinguishes a
         # restarted worker's replay cache from its predecessor's
         self._client_id = "r%d:%s" % (self._rank, uuid.uuid4().hex[:12])
         import socket
-        import time as _time
         self._socks = []
+        # connect-retry budget rides the injectable clock (fault.now/
+        # fault.sleep) and the documented retry knob, so chaos tests
+        # fast-forward it under use_virtual_time() instead of burning a
+        # real minute per dead server
+        connect_deadline = get_env("MX_KVSTORE_RETRY_DEADLINE", dtype=float)
         for addr in self._addrs:
             host, port = addr.rsplit(":", 1)
-            deadline = _time.time() + 60
+            deadline = _fault.Deadline(connect_deadline or 60.0)
             while True:  # the launcher starts servers concurrently:
                 try:     # retry until each binds (ps-lite scheduler role)
                     self._socks.append(socket.create_connection(
                         (host, int(port)), timeout=120))
                     break
                 except (ConnectionRefusedError, OSError):
-                    if _time.time() > deadline:
+                    if deadline.expired():
                         raise
-                    _time.sleep(0.2)
+                    if _fault.is_virtual():
+                        # the server binds in REAL time: a pure virtual
+                        # tick would burn the whole budget in microseconds
+                        # before it ever gets a chance — yield briefly,
+                        # then charge the tick so a truly dead server
+                        # still fails fast in virtual seconds
+                        _real_time.sleep(0.005)  # mxlint: disable=wall-clock-in-fault-path
+                    _fault.sleep(0.2)
         self._lock = threading.Lock()
         self._seq = 0
         self._seq_lock = threading.Lock()
@@ -644,9 +657,8 @@ class KVStoreDistAsync(KVStore):
     # servers instead of hashing whole to one) -----------------------------
     @property
     def _bigarray_bound(self):
-        import os
-        return int(os.environ.get("MXNET_KVSTORE_BIGARRAY_BOUND",
-                                  1_000_000))
+        from ..base import get_env
+        return get_env("MXNET_KVSTORE_BIGARRAY_BOUND", 1_000_000, int)
 
     def _shard_plan(self, size):
         """[(server, start, stop)] flat slices, or None for whole-key

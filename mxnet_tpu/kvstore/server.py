@@ -54,6 +54,9 @@ from typing import Dict, Optional
 
 import numpy as _np
 
+from .. import fault as _fault
+from ..base import get_env
+
 __all__ = ["KVStoreServer", "serve_forever", "send_msg", "recv_msg"]
 
 
@@ -63,12 +66,11 @@ def send_msg(sock: socket.socket, obj) -> None:
 
 
 def _env_timeout(name: str, default: str = "") -> Optional[float]:
-    """Positive float from the env, else the ENV_CATALOG default (the
-    single documented source of truth), else `default`; None = no bound."""
-    raw = os.environ.get(name)
-    if raw is None:
-        from ..base import ENV_CATALOG
-        raw = ENV_CATALOG.get(name, (default, ""))[0] or default
+    """Positive float via base.get_env (catalog defaults apply), else
+    `default`; None = no bound."""
+    raw = get_env(name)
+    if raw is None or raw == "":
+        raw = default
     try:
         val = float(raw)
     except (TypeError, ValueError):
@@ -149,8 +151,17 @@ class KVStoreServer:
         self._barrier_count = 0
         self._barrier_gen = 0
         self._barrier_cv = threading.Condition()
+        # under use_virtual_time(), exactly ONE parked waiter advances the
+        # shared virtual clock — N waiters each charging their tick would
+        # run every deadline on that clock N times too fast
+        self._vclock_pumper: Optional[int] = None
         # liveness: rank -> last activity (monotonic seconds)
         self._last_seen: Dict[str, float] = {}
+        # which clock regime each stamp was taken under: virtual-clock
+        # stamps are meaningless against real monotonic (and vice
+        # versa), so a server outliving a use_virtual_time() block
+        # must never compare across the switch
+        self._seen_regime: Dict[str, bool] = {}
         # ranks parked inside the current barrier generation: alive by
         # definition, excluded from stale eviction
         self._barrier_waiting: Dict[str, int] = {}
@@ -160,11 +171,11 @@ class KVStoreServer:
         self._replay: Dict[str, list] = {}
         self._replay_lock = threading.Lock()
         self._snapshot_path = snapshot_path if snapshot_path is not None \
-            else (os.environ.get("MX_PS_SNAPSHOT") or None)
+            else (get_env("MX_PS_SNAPSHOT") or None)
         try:
             self._snapshot_every = int(
                 snapshot_every if snapshot_every is not None else
-                os.environ.get("MX_PS_SNAPSHOT_EVERY", "1") or 1)
+                get_env("MX_PS_SNAPSHOT_EVERY") or 1)
         except ValueError:
             self._snapshot_every = 1
         self._mutations = 0
@@ -180,7 +191,9 @@ class KVStoreServer:
     # -- liveness -----------------------------------------------------------
     def touch(self, client_id) -> None:
         if client_id is not None:
-            self._last_seen[_rank_of(client_id)] = _time.monotonic()
+            rank = _rank_of(client_id)
+            self._last_seen[rank] = _fault.now()
+            self._seen_regime[rank] = _fault.is_virtual()
 
     def _effective_workers(self) -> int:
         """Barrier quorum = configured workers minus evicted-stale ranks.
@@ -191,10 +204,18 @@ class KVStoreServer:
         stale = _env_timeout("MX_KVSTORE_STALE_TIMEOUT")
         if stale is None:
             return self._num_workers
-        horizon = _time.monotonic() - stale
+        regime = _fault.is_virtual()
+        horizon = _fault.now() - stale
+        evicted = 0
         # list(): touch() inserts from other handler threads concurrently
-        evicted = sum(1 for r, t in list(self._last_seen.items())
-                      if t < horizon and r not in self._barrier_waiting)
+        for r, t in list(self._last_seen.items()):
+            if self._seen_regime.get(r, regime) != regime:
+                # stamped under the other clock: re-stamp as fresh now —
+                # never evict on an apples-to-oranges comparison
+                self._last_seen[r] = _fault.now()
+                self._seen_regime[r] = regime
+            elif t < horizon and r not in self._barrier_waiting:
+                evicted += 1
         return max(1, self._num_workers - evicted)
 
     # -- durability ---------------------------------------------------------
@@ -406,9 +427,12 @@ class KVStoreServer:
             try:
                 if self._try_release_barrier():
                     return True, None
-                deadline = _time.monotonic() + timeout
+                # Deadline (not now()+timeout): a use_virtual_time()
+                # context starting/ending around this park must not make
+                # the budget compare across clock regimes
+                deadline = _fault.Deadline(timeout)
                 while self._barrier_gen == gen:
-                    remaining = deadline - _time.monotonic()
+                    remaining = deadline.remaining()
                     if remaining <= 0:
                         self._barrier_count = max(0,
                                                   self._barrier_count - 1)
@@ -416,11 +440,31 @@ class KVStoreServer:
                                        "waiting for %d workers (%d arrived)"
                                        % (timeout, self._num_workers,
                                           self._barrier_count + 1))
-                    self._barrier_cv.wait(timeout=min(poll, remaining))
+                    tick = min(poll, remaining)
+                    if _fault.is_virtual():
+                        # a real cv.wait cannot advance a virtual clock:
+                        # yield briefly for arriving workers, then charge
+                        # the whole tick so the deadline math progresses
+                        # and a chaos test's barrier timeout fires in
+                        # milliseconds of real time.  Only the elected
+                        # pumper charges (cv lock is held here): every
+                        # waiter charging would advance deadlines N×.
+                        me = threading.get_ident()
+                        if self._vclock_pumper is None:
+                            self._vclock_pumper = me
+                        self._barrier_cv.wait(timeout=0.001)
+                        if self._vclock_pumper == me:
+                            _fault.sleep(tick)
+                    else:
+                        self._barrier_cv.wait(timeout=tick)
                     if self._barrier_gen == gen:
                         if self._try_release_barrier():
                             break
             finally:
+                if self._vclock_pumper == threading.get_ident():
+                    # hand the clock-pumping duty to whichever waiter
+                    # iterates next
+                    self._vclock_pumper = None
                 if rank is not None:
                     n = self._barrier_waiting.get(rank, 0) - 1
                     if n <= 0:
@@ -464,9 +508,7 @@ def serve_forever(port=None, num_workers=None, ready_file=None,
     their replies, THEN the process exits — so a worker's final RPC never
     races the shutdown.
     """
-    from .. import fault as _fault
-    port = int(port if port is not None else
-               os.environ.get("MX_PS_PORT", 9600))
+    port = int(port if port is not None else get_env("MX_PS_PORT"))
     num_workers = int(num_workers if num_workers is not None else
                       os.environ.get("DMLC_NUM_WORKER", 1))
     server_state = KVStoreServer(num_workers, snapshot_path=snapshot_path)
@@ -526,12 +568,18 @@ def serve_forever(port=None, num_workers=None, ready_file=None,
         t.start()
         stop_event.wait()
         srv.shutdown()                      # stop accepting
-        drain_deadline = _time.monotonic() + 5.0
-        while _time.monotonic() < drain_deadline:
+        drain_deadline = _fault.Deadline(5.0)
+        while not drain_deadline.expired():
             with inflight_lock:
                 if inflight_count[0] == 0:
                     break
-            _time.sleep(0.02)
+            if _fault.is_virtual():
+                # in-flight handlers run in REAL threads: a pure virtual
+                # tick would burn the whole drain budget in microseconds
+                # without giving them a chance to finish (same treatment
+                # as the barrier wait above)
+                _time.sleep(0.001)  # mxlint: disable=wall-clock-in-fault-path
+            _fault.sleep(0.02)
         server_state.snapshot()
         # sever surviving client connections so peers observe the stop
         # immediately (a subprocess server gets this for free at exit;

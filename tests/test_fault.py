@@ -63,6 +63,23 @@ def test_retry_policy_backoff_schedule_virtual():
     assert clk.sleeps == [0.5, 1.0, 2.0, 4.0]
 
 
+def test_deadline_survives_clock_regime_switch():
+    # a budget anchored inside use_virtual_time() must not mis-fire when
+    # the context exits (virtual ~0 vs real monotonic ~1e5), and vice versa
+    with fault.use_virtual_time() as clk:
+        dl = fault.Deadline(100.0)
+        clk.advance(30.0)
+        assert 69.0 < dl.remaining() <= 70.0
+    # regime switched: the spanning interval is not charged
+    assert 69.0 < dl.remaining() <= 70.0 and not dl.expired()
+
+    dl2 = fault.Deadline(100.0)         # anchored on the real clock
+    with fault.use_virtual_time() as clk:
+        assert not dl2.expired()        # switch interval uncharged
+        clk.advance(150.0)
+        assert dl2.expired()            # virtual seconds count once inside
+
+
 def test_retry_policy_jitter_is_bounded_and_seeded():
     import random
     p = fault.RetryPolicy(deadline=1, base=1.0, max_delay=8.0, jitter=0.5,

@@ -1,0 +1,532 @@
+"""mxlint (ISSUE 4): the TPU-invariant static analyzer.
+
+Three layers, bottom-up:
+
+  * fixture snippets per rule — positive hit (right rule id, right
+    line), suppressed hit (`# mxlint: disable=`), baselined hit, clean
+    code — all through ``lint_source`` with no filesystem;
+  * the CLI contract (`python -m tools.mxlint`): exit 0 clean / 1 new
+    violations / 2 usage error, ``--format json``, ``--write-baseline``
+    round-trip, plus ``tools/gen_env_docs.py --check`` consistency;
+  * the tier-1 gate: the SHIPPED tree lints clean against the checked-in
+    baseline, and intentionally reintroducing the historical violations
+    (an ``asnumpy()`` in ``Trainer._update``, a raw ``time.time()`` in
+    the kvstore connect-retry loop) trips the right rule id — the
+    acceptance criteria of the issue, verbatim.
+
+Pure stdlib + pytest: no jax import, so this file costs milliseconds.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.mxlint import (lint_source, lint_paths, load_baseline,   # noqa: E402
+                          write_baseline, collect_env_reads, RULES)
+from tools.mxlint.core import apply_baseline                        # noqa: E402
+
+BASELINE = os.path.join(REPO, "tools", "mxlint", "baseline.json")
+
+
+def rules_of(diags):
+    return [d.rule for d in diags]
+
+
+def src(text):
+    return textwrap.dedent(text).lstrip("\n")
+
+
+# ---------------------------------------------------------------------------
+# host-sync-in-hot-path
+# ---------------------------------------------------------------------------
+
+HOT_PATH = "mxnet_tpu/gluon/trainer.py"
+
+def test_host_sync_positive_direct_and_via_helper():
+    code = src("""
+    class Trainer:
+        def step(self, batch_size):
+            self._update()
+
+        def _update(self):
+            for p in self.params:
+                self._drain(p)
+
+        def _drain(self, p):
+            return float(p.grad.asnumpy()[0])
+    """)
+    diags = lint_source(code, HOT_PATH)
+    assert rules_of(diags) == ["host-sync-in-hot-path"]
+    assert diags[0].line == 10
+    # message names the reachable root, not just the containing helper
+    assert "Trainer" in diags[0].message and "_drain" in diags[0].message
+
+
+def test_host_sync_suppressed():
+    code = src("""
+    class Trainer:
+        def _update(self):
+            return self.g.asnumpy()  # mxlint: disable=host-sync-in-hot-path
+    """)
+    assert lint_source(code, HOT_PATH) == []
+
+
+def test_host_sync_clean_and_out_of_hot_path():
+    clean = src("""
+    class Trainer:
+        def _update(self):
+            self.w = self.w - self.lr * self.g
+
+    def offline_report(arrs):
+        return [a.asnumpy() for a in arrs]
+    """)
+    assert lint_source(clean, HOT_PATH) == []
+    # same sync outside any hot-path file: no rule applies
+    sync = "def f(a):\n    return a.asnumpy()\n"
+    assert lint_source(sync, "mxnet_tpu/visualization.py") == []
+
+
+def test_host_sync_metric_update_root():
+    code = src("""
+    class Accuracy:
+        def update(self, labels, preds):
+            import numpy as np
+            self.sum_metric += float(np.asarray(preds).sum())
+    """)
+    diags = lint_source(code, "mxnet_tpu/metric.py")
+    assert rules_of(diags) == ["host-sync-in-hot-path"]
+
+
+# ---------------------------------------------------------------------------
+# jit-purity
+# ---------------------------------------------------------------------------
+
+def test_jit_purity_decorated():
+    code = src("""
+    import time
+    import jax
+
+    @jax.jit
+    def kernel(x):
+        print("tracing")
+        t = time.time()
+        if x > 0:
+            return x
+        return -x
+    """)
+    diags = lint_source(code, "mxnet_tpu/ops/extra.py")
+    kinds = rules_of(diags)
+    assert kinds == ["jit-purity"] * 3
+    msgs = " | ".join(d.message for d in diags)
+    assert "print()" in msgs and "wall-clock" in msgs and \
+        "data-dependent" in msgs
+
+
+def test_jit_purity_static_args_and_shape_branches_ok():
+    code = src("""
+    import jax
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=("mode",))
+    def kernel(x, mode, axis=0):
+        if mode == "fast":      # static_argnames: fine
+            return x
+        if axis:                # defaulted param: static by contract
+            return x.sum(axis)
+        if x.ndim > 2:          # shape attr: static under trace
+            return x.reshape(-1)
+        if x is None:           # sentinel: fine
+            return x
+        return x
+    """)
+    assert lint_source(code, "mxnet_tpu/ops/extra.py") == []
+
+
+def test_jit_purity_registered_op_and_env_read():
+    code = src("""
+    import os
+    from .registry import register
+
+    @register("myop")
+    def _k(x):
+        if os.environ.get("MX_DEBUG_FLAG"):
+            return x
+        return x + 1
+
+    @register("dynop", no_jit=True)
+    def _d(x):
+        print(x)   # eager op: prints are legal
+        return x
+    """)
+    diags = lint_source(code, "mxnet_tpu/ops/extra.py",
+                        catalog={"MX_DEBUG_FLAG"})
+    # the same read trips BOTH rules: ad-hoc env read (env-var-registry)
+    # and trace-time env read (jit-purity)
+    assert sorted(set(rules_of(diags))) == ["env-var-registry", "jit-purity"]
+    jp = [d for d in diags if d.rule == "jit-purity"]
+    assert "os.environ" in jp[0].message
+
+
+def test_jit_purity_by_name_jit_call():
+    code = src("""
+    import jax
+    import random
+
+    def make(fn):
+        def step(x):
+            return x * random.random()
+        return jax.jit(step)
+    """)
+    diags = lint_source(code, "mxnet_tpu/parallel/foo.py")
+    assert rules_of(diags) == ["jit-purity"]
+    assert "RNG" in diags[0].message
+
+
+# ---------------------------------------------------------------------------
+# wall-clock-in-fault-path
+# ---------------------------------------------------------------------------
+
+def test_wall_clock_positive_alias_and_from_import():
+    code = src("""
+    import time as _time
+    from time import monotonic
+
+    def retry_loop():
+        deadline = _time.time() + 60
+        while monotonic() < deadline:
+            _time.sleep(0.2)
+    """)
+    diags = lint_source(code, "mxnet_tpu/kvstore/kvstore.py")
+    assert rules_of(diags) == ["wall-clock-in-fault-path"] * 3
+    assert "fault.now()" in diags[0].message
+    assert "fault.sleep()" in diags[-1].message
+
+
+def test_wall_clock_suppressed_and_clean_and_scoped():
+    sup = src("""
+    import time as _time
+
+    class _RealClock:
+        now = staticmethod(_time.monotonic)  # mxlint: disable=wall-clock-in-fault-path
+    """)
+    assert lint_source(sup, "mxnet_tpu/fault.py") == []
+    clean = src("""
+    from .. import fault as _fault
+
+    def retry_loop():
+        deadline = _fault.now() + 60
+        _fault.sleep(0.2)
+    """)
+    assert lint_source(clean, "mxnet_tpu/kvstore/kvstore.py") == []
+    # time.time is legal outside the fault-path files
+    other = "import time\ndef f():\n    return time.time()\n"
+    assert lint_source(other, "mxnet_tpu/callback.py") == []
+
+
+# ---------------------------------------------------------------------------
+# env-var-registry
+# ---------------------------------------------------------------------------
+
+def test_env_registry_adhoc_read_flagged():
+    code = src("""
+    import os
+
+    def f():
+        a = os.environ.get("MX_SOME_FLAG")
+        b = os.getenv("MX_OTHER")
+        c = os.environ["MX_THIRD"]
+        return a, b, c
+    """)
+    diags = lint_source(code, "mxnet_tpu/foo.py",
+                        catalog={"MX_SOME_FLAG", "MX_OTHER", "MX_THIRD"})
+    assert rules_of(diags) == ["env-var-registry"] * 3
+    assert all("get_env" in d.message for d in diags)
+
+
+def test_env_registry_submodule_import_does_not_blind():
+    # `import os.path` binds the name `os`; the alias map must not remap
+    # it to "os.path" or every os.environ detector goes blind
+    code = src("""
+    import os.path
+
+    def f():
+        return os.environ.get("MX_SOME_FLAG")
+    """)
+    diags = lint_source(code, "mxnet_tpu/foo.py", catalog={"MX_SOME_FLAG"})
+    assert rules_of(diags) == ["env-var-registry"]
+
+
+def test_env_registry_unregistered_and_clean_and_writes_ok():
+    code = src("""
+    from .base import get_env
+
+    def f():
+        return get_env("MX_NOT_IN_CATALOG")
+    """)
+    diags = lint_source(code, "mxnet_tpu/foo.py", catalog={"MX_KNOWN"})
+    assert rules_of(diags) == ["env-var-registry"]
+    assert "ENV_CATALOG" in diags[0].message
+    clean = src("""
+    import os
+    from .base import get_env
+
+    def f():
+        os.environ["MX_FORCE_CPU"] = "1"   # writes are fine
+        return get_env("MX_KNOWN"), os.environ.get("PATH")
+    """)
+    assert lint_source(clean, "mxnet_tpu/foo.py", catalog={"MX_KNOWN",
+                                                           "MX_FORCE_CPU"}) \
+        == []
+    # base.py itself is the accessor: exempt
+    accessor = 'import os\nv = os.environ.get("MX_FORCE_CPU")\n'
+    assert lint_source(accessor, "mxnet_tpu/base.py") == []
+
+
+# ---------------------------------------------------------------------------
+# donation-after-use
+# ---------------------------------------------------------------------------
+
+def test_donation_after_use_positive():
+    code = src("""
+    import jax
+
+    def f(g, a, b):
+        fn = jax.jit(g, donate_argnums=(0,))
+        out = fn(a, b)
+        return a + out
+    """)
+    diags = lint_source(code, "mxnet_tpu/parallel/foo.py")
+    assert rules_of(diags) == ["donation-after-use"]
+    assert "'a'" in diags[0].message
+
+
+def test_donation_after_use_rebind_and_nondonated_ok():
+    code = src("""
+    import jax
+
+    def f(g, a, b):
+        fn = jax.jit(g, donate_argnums=(0,))
+        a = fn(a, b)      # rebound: old buffer unreachable
+        return a + b      # b was not donated
+    """)
+    assert lint_source(code, "mxnet_tpu/parallel/foo.py") == []
+
+
+def test_donation_after_use_self_attr_and_conditional_donate():
+    code = src("""
+    import jax
+
+    class Step:
+        def __init__(self, fn, donate):
+            self._step = jax.jit(fn, donate_argnums=(0, 1) if donate else ())
+
+        def run(self, params, opt, batch):
+            new_p, new_o = self._step(params, opt, batch)
+            self.stale = params.copy()
+            return new_p, new_o
+    """)
+    diags = lint_source(code, "mxnet_tpu/parallel/foo.py")
+    assert rules_of(diags) == ["donation-after-use"]
+    assert "'params'" in diags[0].message
+
+
+# ---------------------------------------------------------------------------
+# baseline mechanics
+# ---------------------------------------------------------------------------
+
+def test_baseline_roundtrip(tmp_path):
+    code = src("""
+    class Trainer:
+        def _update(self):
+            return self.g.asnumpy()
+    """)
+    diags = lint_source(code, HOT_PATH)
+    assert len(diags) == 1
+    bl = tmp_path / "baseline.json"
+    write_baseline(str(bl), diags)
+    new, old, stale = apply_baseline(lint_source(code, HOT_PATH),
+                                     load_baseline(str(bl)))
+    assert new == [] and len(old) == 1 and stale == []
+    # a SECOND violation with a different line text is NOT absorbed
+    code2 = code + "\n    def update(self):\n        return self.w.asnumpy()\n"
+    new2, old2, _ = apply_baseline(lint_source(code2, HOT_PATH),
+                                   load_baseline(str(bl)))
+    assert len(new2) == 1 and len(old2) == 1
+    # fixing the violation leaves the entry stale (reported, not fatal)
+    fixed = "class Trainer:\n    def _update(self):\n        return 0\n"
+    new3, old3, stale3 = apply_baseline(lint_source(fixed, HOT_PATH),
+                                        load_baseline(str(bl)))
+    assert new3 == [] and old3 == [] and len(stale3) == 1
+
+
+def test_parse_error_is_a_diagnostic():
+    diags = lint_source("def broken(:\n", "mxnet_tpu/foo.py")
+    assert rules_of(diags) == ["mxlint-parse"]
+
+
+# ---------------------------------------------------------------------------
+# CLI contract
+# ---------------------------------------------------------------------------
+
+def _fake_repo(tmp_path, bad=True):
+    pkg = tmp_path / "mxnet_tpu"
+    (pkg / "kvstore").mkdir(parents=True)
+    (pkg / "base.py").write_text("ENV_CATALOG = {'MX_KNOWN': ('', 'd')}\n")
+    body = "import time as _time\n\ndef retry():\n    return _time.time()\n" \
+        if bad else "def retry():\n    return 0\n"
+    (pkg / "kvstore" / "mod.py").write_text(body)
+    return pkg
+
+
+def _run_cli(args, cwd=REPO):
+    return subprocess.run([sys.executable, "-m", "tools.mxlint"] + args,
+                          cwd=cwd, capture_output=True, text=True)
+
+
+def test_cli_exit_codes_and_json(tmp_path):
+    pkg = _fake_repo(tmp_path, bad=True)
+    r = _run_cli([str(pkg), "--no-baseline", "--format", "json"])
+    assert r.returncode == 1, r.stderr
+    payload = json.loads(r.stdout)
+    assert [v["rule"] for v in payload["violations"]] == \
+        ["wall-clock-in-fault-path"]
+    assert payload["violations"][0]["path"] == "mxnet_tpu/kvstore/mod.py"
+
+    clean = _fake_repo(tmp_path / "c", bad=False)
+    r = _run_cli([str(clean), "--no-baseline"])
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    assert _run_cli(["/nonexistent/path"]).returncode == 2
+    assert _run_cli([str(pkg), "--select", "no-such-rule"]).returncode == 2
+    assert _run_cli(["--list-rules"]).returncode == 0
+
+    # a typo'd --baseline is a usage error (2), NOT "new violations" (1)
+    r = _run_cli([str(pkg), "--baseline", str(tmp_path / "no_such.json")])
+    assert r.returncode == 2, r.stdout + r.stderr
+    assert "cannot read baseline" in r.stderr
+    garbled = tmp_path / "garbled.json"
+    garbled.write_text("{not json")
+    r = _run_cli([str(pkg), "--baseline", str(garbled)])
+    assert r.returncode == 2, r.stdout + r.stderr
+
+
+def test_cli_write_baseline_roundtrip(tmp_path):
+    pkg = _fake_repo(tmp_path, bad=True)
+    bl = tmp_path / "bl.json"
+    r = _run_cli([str(pkg), "--baseline", str(bl), "--write-baseline"])
+    assert r.returncode == 0, r.stderr
+    r = _run_cli([str(pkg), "--baseline", str(bl)])
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_write_baseline_narrowed_scan_preserves_entries(tmp_path):
+    # re-baselining one FILE must not erase grandfathered entries for the
+    # rest of the tree; re-baselining with --select must refuse outright
+    pkg = _fake_repo(tmp_path, bad=True)
+    clean_file = pkg / "kvstore" / "other.py"
+    clean_file.write_text("def ok():\n    return 0\n")
+    bl = tmp_path / "bl.json"
+    r = _run_cli([str(pkg), "--baseline", str(bl), "--write-baseline"])
+    assert r.returncode == 0, r.stderr
+    full = json.loads(bl.read_text())["entries"]
+    assert len(full) == 1     # mod.py's wall-clock hit
+
+    r = _run_cli([str(clean_file), "--baseline", str(bl),
+                  "--write-baseline"])
+    assert r.returncode == 0, r.stderr
+    assert "preserved" in r.stdout
+    assert json.loads(bl.read_text())["entries"] == full
+
+    r = _run_cli([str(pkg), "--baseline", str(bl), "--write-baseline",
+                  "--select", "jit-purity"])
+    assert r.returncode == 2, r.stdout + r.stderr
+    assert json.loads(bl.read_text())["entries"] == full
+
+
+# ---------------------------------------------------------------------------
+# env scanner + gen_env_docs --check
+# ---------------------------------------------------------------------------
+
+def test_collect_env_reads(tmp_path):
+    f = tmp_path / "m.py"
+    f.write_text(src("""
+    import os
+    from .base import get_env
+
+    a = os.environ.get("MX_ALPHA")
+    b = get_env("MXNET_BETA")
+    c = os.environ["MX_GAMMA"]
+    d = os.environ.get("HOME")        # not MX_*: ignored
+    """))
+    found = collect_env_reads([str(tmp_path)])
+    assert set(found) == {"MX_ALPHA", "MXNET_BETA", "MX_GAMMA"}
+
+
+@pytest.mark.slow
+def test_gen_env_docs_check_passes_on_shipped_tree():
+    r = subprocess.run([sys.executable,
+                        os.path.join(REPO, "tools", "gen_env_docs.py"),
+                        "--check"], capture_output=True, text=True,
+                       cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate: shipped tree is clean; reinjected violations trip
+# ---------------------------------------------------------------------------
+
+def _lint_tree():
+    diags = lint_paths([os.path.join(REPO, "mxnet_tpu")], root=REPO)
+    return apply_baseline(diags, load_baseline(BASELINE))
+
+
+def test_shipped_tree_lints_clean():
+    new, old, stale = _lint_tree()
+    assert new == [], "\n".join(map(repr, new))
+    assert stale == [], ("baseline entries no longer match the tree — "
+                         "run `python -m tools.mxlint --write-baseline "
+                         "mxnet_tpu/`: %s" % (stale,))
+
+
+def test_reinjected_asnumpy_in_trainer_update_trips():
+    p = os.path.join(REPO, "mxnet_tpu", "gluon", "trainer.py")
+    with open(p) as f:
+        code = f.read()
+    anchor = 'with _profiler.annotate("trainer.update"):'
+    assert anchor in code, "Trainer._update moved; update this test"
+    bad = code.replace(
+        anchor,
+        anchor + "\n            _dbg = [g.asnumpy() for g in gs]")
+    diags = lint_source(bad, "mxnet_tpu/gluon/trainer.py")
+    assert "host-sync-in-hot-path" in rules_of(diags)
+    # and it is NOT absorbed by the shipped baseline
+    new, _, _ = apply_baseline(diags, load_baseline(BASELINE))
+    assert "host-sync-in-hot-path" in rules_of(new)
+
+
+def test_reinjected_wall_clock_in_kvstore_retry_trips():
+    p = os.path.join(REPO, "mxnet_tpu", "kvstore", "kvstore.py")
+    with open(p) as f:
+        code = f.read()
+    anchor = "if deadline.expired():"
+    assert anchor in code, "connect-retry loop moved; update this test"
+    bad = code.replace(
+        anchor,
+        "import time\n                    "
+        "if time.time() > _connect_t0 + 60:", 1)
+    diags = lint_source(bad, "mxnet_tpu/kvstore/kvstore.py")
+    assert "wall-clock-in-fault-path" in rules_of(diags)
+    new, _, _ = apply_baseline(diags, load_baseline(BASELINE))
+    assert "wall-clock-in-fault-path" in rules_of(new)
+
+
+def test_rule_set_is_complete():
+    assert {"host-sync-in-hot-path", "jit-purity",
+            "wall-clock-in-fault-path", "env-var-registry",
+            "donation-after-use"} <= set(RULES)

@@ -60,4 +60,7 @@ for rank in (0, 1):
 print("chaos_smoke: resumed params match the uninterrupted run")
 EOF
 
+echo "== chaos_smoke: static-analysis lane (tools/lint.sh)"
+bash "$REPO/tools/lint.sh"
+
 echo "chaos_smoke: PASS"

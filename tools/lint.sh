@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# lint.sh — the static-analysis lane as one CLI smoke (chaos_smoke.sh's
+# sibling; the builder loop runs the same checks inside tier-1 via
+# tests/test_mxlint.py).
+#
+#   1. mxlint over mxnet_tpu/ — the TPU-invariant rule set (host syncs in
+#      the hot path, jit purity, wall clocks in fault paths, the MX_* env
+#      registry, donation-after-use) with the checked-in baseline.
+#   2. gen_env_docs --check — docs/ENV_VARS.md must match base.ENV_CATALOG
+#      and every MX_* read in mxnet_tpu/ + tools/ must be cataloged.
+#
+# Exit nonzero on any new violation.  To suppress a justified hit, append
+# `# mxlint: disable=<rule-id>` to the line; to re-baseline after review,
+# run `python -m tools.mxlint --write-baseline mxnet_tpu/`.
+set -euo pipefail
+
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$REPO"
+PY="${PYTHON:-python3}"
+
+echo "== lint: mxlint (tools/mxlint, baseline $(
+    "$PY" -c 'import json;print(len(json.load(open("tools/mxlint/baseline.json"))["entries"]))' 2>/dev/null || echo 0) entries)"
+"$PY" -m tools.mxlint mxnet_tpu/
+
+echo "== lint: env-var doc consistency (tools/gen_env_docs.py --check)"
+"$PY" tools/gen_env_docs.py --check
+
+echo "lint: PASS"

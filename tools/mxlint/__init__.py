@@ -1,0 +1,45 @@
+"""mxlint — a TPU-invariant static analyzer for this repo.
+
+The last three PRs bought hard-won performance/correctness invariants
+(O(1) dispatches per training step, no per-batch host sync, virtual-clock
+fault paths, a documented ``MX_*`` env surface); mxlint is the machine
+check that keeps them true.  Pure stdlib ``ast`` — no third-party deps,
+no imports of the code under analysis — so it runs anywhere the repo
+checks out, including inside the tier-1 pytest lane
+(``tests/test_mxlint.py``).
+
+Usage::
+
+    python -m tools.mxlint mxnet_tpu/              # lint, exit 1 on hits
+    python -m tools.mxlint --format json mxnet_tpu/
+    python -m tools.mxlint --write-baseline mxnet_tpu/
+    python -m tools.mxlint --list-rules
+
+Suppression: append ``# mxlint: disable=<rule-id>[,<rule-id>...]`` to the
+flagged line (or ``disable=all``).  Grandfathered violations live in
+``tools/mxlint/baseline.json`` (see ``--write-baseline``); the tier-1
+test fails on any NEW violation.
+
+Rules (see ``tools/mxlint/rules.py`` and docs/ARCHITECTURE.md
+"Enforced invariants"):
+
+  host-sync-in-hot-path    device->host syncs reachable from Trainer.step /
+                           Module.update / metric update (ISSUE 3)
+  jit-purity               side effects inside jitted / registered kernels
+  wall-clock-in-fault-path raw time.* in fault.py / health.py / kvstore/*
+                           that must use the injectable clock (ISSUE 1)
+  env-var-registry         ad-hoc MX_* env reads bypassing base.get_env or
+                           missing from base.ENV_CATALOG / docs/ENV_VARS.md
+  donation-after-use       buffers donated to a donate_argnums jit and
+                           referenced afterwards
+"""
+from .core import (Diagnostic, FileContext, Rule, RULES, register_rule,
+                   lint_source, lint_paths, load_baseline, write_baseline,
+                   collect_env_reads, load_catalog_names)
+from . import rules as _rules  # noqa: F401  (registers the rule set)
+
+__all__ = ["Diagnostic", "FileContext", "Rule", "RULES", "register_rule",
+           "lint_source", "lint_paths", "load_baseline", "write_baseline",
+           "collect_env_reads", "load_catalog_names"]
+
+__version__ = "1.0"

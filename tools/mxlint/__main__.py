@@ -1,0 +1,150 @@
+"""``python -m tools.mxlint`` — CLI front end.
+
+Exit-code contract (what tools/lint.sh and the tier-1 test key on):
+  0  clean (every diagnostic suppressed or baselined)
+  1  new violations
+  2  usage / internal error
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .core import (RULES, apply_baseline, lint_paths, load_baseline,
+                   repo_root_of, write_baseline)
+from . import rules as _rules  # noqa: F401  (registers the rule set)
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.mxlint",
+        description="TPU-invariant static analyzer for this repo "
+                    "(stdlib-ast; see tools/mxlint/__init__.py)")
+    ap.add_argument("paths", nargs="*", default=["mxnet_tpu"],
+                    help="files/trees to lint (default: mxnet_tpu)")
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help="grandfathered-violations file (default: "
+                    "tools/mxlint/baseline.json when it exists)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report grandfathered violations too")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from the current findings "
+                    "and exit 0")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--select", default=None, metavar="RULES",
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, rule in sorted(RULES.items()):
+            print("%-26s %s" % (rid, rule.description))
+        return 0
+
+    paths = [p for p in args.paths]
+    for p in paths:
+        if not os.path.exists(p):
+            print("mxlint: no such path: %s" % p, file=sys.stderr)
+            return 2
+    select = None
+    if args.select:
+        select = {r.strip() for r in args.select.split(",") if r.strip()}
+        unknown = select - set(RULES)
+        if unknown:
+            print("mxlint: unknown rule(s): %s" % ", ".join(sorted(unknown)),
+                  file=sys.stderr)
+            return 2
+
+    root = repo_root_of(paths[0]) or os.getcwd()
+    try:
+        diags = lint_paths(paths, root=root, select=select)
+    except Exception as e:  # internal error must not look like "clean"
+        print("mxlint: internal error: %s: %s" % (type(e).__name__, e),
+              file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or (
+        DEFAULT_BASELINE if os.path.isfile(DEFAULT_BASELINE) else None)
+
+    if args.write_baseline:
+        if select is not None:
+            # a rule-narrowed scan sees only a slice of the findings;
+            # writing it out would silently drop every other rule's
+            # grandfathered entries
+            print("mxlint: --write-baseline cannot be combined with "
+                  "--select (it would erase the unselected rules' "
+                  "entries)", file=sys.stderr)
+            return 2
+        out = args.baseline or DEFAULT_BASELINE
+        # merge: entries for files OUTSIDE the scanned paths are not in
+        # `diags` only because they were not looked at — preserve them
+        kept = []
+        if os.path.isfile(out):
+            rel_scanned = [os.path.relpath(os.path.abspath(p),
+                                           root).replace(os.sep, "/")
+                           for p in paths]
+            prefixes = [r + "/" if os.path.isdir(p) else r
+                        for p, r in zip(paths, rel_scanned)]
+
+            def scanned(entry_path):
+                return any(entry_path == pre.rstrip("/") or
+                           entry_path.startswith(pre) for pre in prefixes)
+
+            try:
+                for key, count in load_baseline(out).items():
+                    if not scanned(key[0]):
+                        kept.append((key, count))
+            except (OSError, ValueError, KeyError) as e:
+                print("mxlint: cannot read existing baseline %s: %s"
+                      % (out, e), file=sys.stderr)
+                return 2
+        write_baseline(out, diags, extra_counts=dict(kept))
+        n = len(diags) + sum(c for _, c in kept)
+        print("mxlint: wrote %d grandfathered entr%s to %s%s"
+              % (n, "y" if n == 1 else "ies", out,
+                 " (%d preserved from unscanned paths)" % len(kept)
+                 if kept else ""))
+        return 0
+
+    baseline = {}
+    if baseline_path and not args.no_baseline:
+        try:
+            baseline = load_baseline(baseline_path)
+        except (OSError, ValueError, KeyError) as e:
+            # a typo'd --baseline must read as a usage error (2), never as
+            # "new violations" (1) — scripts key on the exit code
+            print("mxlint: cannot read baseline %s: %s"
+                  % (baseline_path, e), file=sys.stderr)
+            return 2
+    new, old, stale = apply_baseline(diags, baseline)
+
+    if args.format == "json":
+        print(json.dumps({
+            "violations": [d.to_json() for d in new],
+            "baselined": [d.to_json() for d in old],
+            "stale_baseline": ["%s:%s:%s" % k for k in stale],
+        }, indent=1))
+    else:
+        for d in new:
+            print("%s:%d:%d: %s: %s" % (d.path, d.line, d.col, d.rule,
+                                        d.message))
+        if stale:
+            print("mxlint: note: %d stale baseline entr%s (fixed or "
+                  "reworded) — run --write-baseline to shrink the file"
+                  % (len(stale), "y" if len(stale) == 1 else "ies"),
+                  file=sys.stderr)
+        summary = "mxlint: %d new violation%s" % (
+            len(new), "" if len(new) == 1 else "s")
+        if old:
+            summary += ", %d baselined" % len(old)
+        print(summary, file=sys.stderr)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,478 @@
+"""mxlint framework: diagnostics, rule registry, suppressions, baseline.
+
+Everything here is file-local static analysis over stdlib ``ast`` — rules
+never import the code under analysis, so a broken tree still lints.  The
+deliberately simple analyses (per-file call graph, alias maps, literal
+env names) trade soundness for zero-dependency robustness; the baseline
+file absorbs the approximation errors that fixing would not pay for.
+"""
+from __future__ import annotations
+
+import ast
+import fnmatch
+import json
+import os
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+__all__ = ["Diagnostic", "FileContext", "Rule", "RULES", "register_rule",
+           "lint_source", "lint_paths", "load_baseline", "write_baseline",
+           "collect_env_reads", "load_catalog_names", "repo_root_of"]
+
+
+# ---------------------------------------------------------------------------
+# Diagnostics
+# ---------------------------------------------------------------------------
+
+class Diagnostic:
+    """One finding: rule id + location + message + the source line.
+
+    The baseline fingerprint is (path, rule, stripped source line) — line
+    NUMBERS drift with every edit, line TEXT only changes when the
+    violation itself is touched, which is exactly when a grandfathered
+    entry should come back up for review.
+    """
+
+    __slots__ = ("rule", "path", "line", "col", "message", "snippet")
+
+    def __init__(self, rule: str, path: str, line: int, col: int,
+                 message: str, snippet: str = ""):
+        self.rule = rule
+        self.path = path.replace(os.sep, "/")
+        self.line = line
+        self.col = col
+        self.message = message
+        self.snippet = snippet.strip()
+
+    def fingerprint(self) -> Tuple[str, str, str]:
+        return (self.path, self.rule, self.snippet)
+
+    def to_json(self) -> Dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "snippet": self.snippet}
+
+    def __repr__(self):
+        return "%s:%d:%d: %s: %s" % (self.path, self.line, self.col,
+                                     self.rule, self.message)
+
+
+# ---------------------------------------------------------------------------
+# Suppressions:  # mxlint: disable=rule-a,rule-b   (or disable=all)
+# ---------------------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(r"#\s*mxlint:\s*disable=([A-Za-z0-9_,\- ]+)")
+_SUPPRESS_FILE_RE = re.compile(r"#\s*mxlint:\s*disable-file=([A-Za-z0-9_,\- ]+)")
+
+
+def _parse_suppressions(lines: Sequence[str]):
+    per_line: Dict[int, Set[str]] = {}
+    per_file: Set[str] = set()
+    for i, text in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(text)
+        if m:
+            per_line[i] = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        m = _SUPPRESS_FILE_RE.search(text)
+        if m:
+            per_file |= {r.strip() for r in m.group(1).split(",") if r.strip()}
+    return per_line, per_file
+
+
+# ---------------------------------------------------------------------------
+# Per-file context handed to every rule
+# ---------------------------------------------------------------------------
+
+class FileContext:
+    """Parsed file + shared lazy analyses (alias maps, function index,
+    call graph) so each rule doesn't re-derive them."""
+
+    def __init__(self, path: str, source: str,
+                 catalog: Optional[Set[str]] = None):
+        self.path = path.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # known env vars (from base.ENV_CATALOG); None = unknown, skip the
+        # registry-membership half of env-var-registry
+        self.catalog = catalog
+        self._functions = None
+        self._call_graph = None
+        self._import_aliases = None
+
+    # -- source helpers -----------------------------------------------------
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def diag(self, rule: str, node: ast.AST, message: str) -> Diagnostic:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Diagnostic(rule, self.path, line, col, message,
+                          self.line_text(line))
+
+    # -- import alias map ---------------------------------------------------
+    @property
+    def import_aliases(self) -> Dict[str, str]:
+        """local name -> dotted origin, e.g. {'_time': 'time',
+        'np': 'numpy', 'monotonic': 'time.monotonic'}."""
+        if self._import_aliases is None:
+            aliases: Dict[str, str] = {}
+            for node in ast.walk(self.tree):
+                if isinstance(node, ast.Import):
+                    for a in node.names:
+                        if a.asname:
+                            aliases[a.asname] = a.name
+                        else:
+                            # `import os.path` binds the NAME `os` to the
+                            # module `os` — mapping it to the full dotted
+                            # path would blind every os.environ/time.*
+                            # detector in files that import submodules
+                            head = a.name.split(".")[0]
+                            aliases[head] = head
+                elif isinstance(node, ast.ImportFrom) and node.module:
+                    for a in node.names:
+                        aliases[a.asname or a.name] = \
+                            "%s.%s" % (node.module, a.name)
+            self._import_aliases = aliases
+        return self._import_aliases
+
+    def resolves_to(self, node: ast.AST, dotted: str) -> bool:
+        """True if `node` (the func of a Call) names `dotted` (e.g.
+        'time.monotonic' or 'os.environ.get') through any import alias."""
+        chain = _attr_chain(node)
+        if chain is None:
+            return False
+        head, rest = chain[0], chain[1:]
+        origin = self.import_aliases.get(head, head)
+        full = ".".join([origin] + rest)
+        return full == dotted
+
+    # -- function index / call graph ---------------------------------------
+    @property
+    def functions(self) -> Dict[str, ast.AST]:
+        """qualname ('Class.method' or 'func') -> FunctionDef.  Nested
+        defs belong to their enclosing function (their bodies are scanned
+        as part of it)."""
+        if self._functions is None:
+            idx: Dict[str, ast.AST] = {}
+            for node in self.tree.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    idx[node.name] = node
+                elif isinstance(node, ast.ClassDef):
+                    for sub in node.body:
+                        if isinstance(sub, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef)):
+                            idx["%s.%s" % (node.name, sub.name)] = sub
+            self._functions = idx
+        return self._functions
+
+    @property
+    def call_graph(self) -> Dict[str, Set[str]]:
+        """qualname -> set of callee qualnames (same-file resolution:
+        ``self.m()``/``cls.m()``/``super().m()`` -> a method m in this
+        file, bare ``f()`` -> a module-level f)."""
+        if self._call_graph is None:
+            methods_by_name: Dict[str, List[str]] = {}
+            for qual in self.functions:
+                if "." in qual:
+                    methods_by_name.setdefault(
+                        qual.split(".", 1)[1], []).append(qual)
+            graph: Dict[str, Set[str]] = {}
+            for qual, fn in self.functions.items():
+                callees: Set[str] = set()
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    f = node.func
+                    if isinstance(f, ast.Name) and f.id in self.functions:
+                        callees.add(f.id)
+                    elif isinstance(f, ast.Attribute):
+                        recv = f.value
+                        is_selfish = (
+                            isinstance(recv, ast.Name)
+                            and recv.id in ("self", "cls")) or (
+                            isinstance(recv, ast.Call)
+                            and isinstance(recv.func, ast.Name)
+                            and recv.func.id == "super")
+                        if is_selfish:
+                            own_class = qual.split(".", 1)[0] \
+                                if "." in qual else None
+                            own = "%s.%s" % (own_class, f.attr)
+                            if own in self.functions:
+                                callees.add(own)
+                            else:
+                                # over-approximate: any class in this file
+                                # with a method of that name
+                                callees.update(
+                                    methods_by_name.get(f.attr, ()))
+                graph[qual] = callees
+            self._call_graph = graph
+        return self._call_graph
+
+    def reachable_from(self, roots: Iterable[str]) -> Set[str]:
+        seen: Set[str] = set()
+        stack = [r for r in roots if r in self.functions]
+        while stack:
+            q = stack.pop()
+            if q in seen:
+                continue
+            seen.add(q)
+            stack.extend(self.call_graph.get(q, ()))
+        return seen
+
+
+def _attr_chain(node: ast.AST) -> Optional[List[str]]:
+    """['os', 'environ', 'get'] for os.environ.get; None if not a plain
+    Name/Attribute chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Rule registry
+# ---------------------------------------------------------------------------
+
+class Rule:
+    """Base class: subclasses set `id`/`description`/`invariant_from` and
+    implement check(ctx) -> iterator of Diagnostics."""
+
+    id: str = ""
+    description: str = ""
+    # which PR introduced the invariant this rule enforces (docs table)
+    invariant_from: str = ""
+    # fnmatch patterns (posix, repo-relative) this rule applies to;
+    # empty = every linted file
+    path_patterns: Tuple[str, ...] = ()
+
+    def applies_to(self, path: str) -> bool:
+        if not self.path_patterns:
+            return True
+        return any(fnmatch.fnmatch(path, pat) for pat in self.path_patterns)
+
+    def check(self, ctx: FileContext) -> Iterator[Diagnostic]:
+        raise NotImplementedError
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def register_rule(cls):
+    assert cls.id and cls.id not in RULES, cls
+    RULES[cls.id] = cls()
+    return cls
+
+
+# ---------------------------------------------------------------------------
+# Baseline: grandfathered violations, matched by fingerprint multiset
+# ---------------------------------------------------------------------------
+
+def load_baseline(path: str) -> Dict[Tuple[str, str, str], int]:
+    with open(path) as f:
+        data = json.load(f)
+    counts: Dict[Tuple[str, str, str], int] = {}
+    for e in data.get("entries", []):
+        key = (e["path"], e["rule"], e["snippet"])
+        counts[key] = counts.get(key, 0) + int(e.get("count", 1))
+    return counts
+
+
+def write_baseline(path: str, diags: Sequence[Diagnostic],
+                   extra_counts: Optional[Dict[Tuple[str, str, str],
+                                               int]] = None) -> None:
+    """Write `diags` as the baseline; `extra_counts` carries entries to
+    preserve verbatim (e.g. for files a narrowed scan never visited)."""
+    counts: Dict[Tuple[str, str, str], int] = dict(extra_counts or {})
+    for d in diags:
+        counts[d.fingerprint()] = counts.get(d.fingerprint(), 0) + 1
+    entries = [{"path": p, "rule": r, "snippet": s, "count": c}
+               for (p, r, s), c in sorted(counts.items())]
+    with open(path, "w") as f:
+        json.dump({"version": 1, "entries": entries}, f, indent=1,
+                  sort_keys=True)
+        f.write("\n")
+
+
+def apply_baseline(diags: Sequence[Diagnostic],
+                   baseline: Dict[Tuple[str, str, str], int]):
+    """Split diagnostics into (new, grandfathered); also return baseline
+    entries that matched nothing (stale — candidates for re-baseline)."""
+    budget = dict(baseline)
+    new: List[Diagnostic] = []
+    old: List[Diagnostic] = []
+    for d in diags:
+        key = d.fingerprint()
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+            old.append(d)
+        else:
+            new.append(d)
+    stale = [k for k, c in budget.items() if c > 0]
+    return new, old, stale
+
+
+# ---------------------------------------------------------------------------
+# Catalog extraction (env-var-registry): parse base.py's ENV_CATALOG keys
+# without importing it
+# ---------------------------------------------------------------------------
+
+def load_catalog_names(root: str) -> Optional[Set[str]]:
+    base_py = os.path.join(root, "mxnet_tpu", "base.py")
+    if not os.path.isfile(base_py):
+        return None
+    with open(base_py) as f:
+        tree = ast.parse(f.read(), filename=base_py)
+    for node in ast.walk(tree):
+        target = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            target = node.targets[0].id
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name):
+            target = node.target.id
+        if target == "ENV_CATALOG" and \
+                isinstance(getattr(node, "value", None), ast.Dict):
+            names = set()
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    names.add(k.value)
+            return names
+    return None
+
+
+def repo_root_of(path: str) -> Optional[str]:
+    """Nearest ancestor of `path` containing mxnet_tpu/base.py."""
+    p = os.path.abspath(path)
+    if os.path.isfile(p):
+        p = os.path.dirname(p)
+    while True:
+        if os.path.isfile(os.path.join(p, "mxnet_tpu", "base.py")):
+            return p
+        parent = os.path.dirname(p)
+        if parent == p:
+            return None
+        p = parent
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+
+def lint_source(source: str, path: str,
+                catalog: Optional[Set[str]] = None,
+                select: Optional[Set[str]] = None) -> List[Diagnostic]:
+    """Lint one source string as repo-relative `path`.  Returns ALL
+    diagnostics after suppression comments (baseline is the caller's
+    job).  Syntax errors surface as a single mxlint-parse diagnostic —
+    a file that doesn't parse can't be certified."""
+    try:
+        ctx = FileContext(path, source, catalog=catalog)
+    except SyntaxError as e:
+        return [Diagnostic("mxlint-parse", path, e.lineno or 1, 0,
+                           "file does not parse: %s" % e.msg)]
+    per_line, per_file = _parse_suppressions(ctx.lines)
+    out: List[Diagnostic] = []
+    for rule in RULES.values():
+        if select is not None and rule.id not in select:
+            continue
+        if not rule.applies_to(ctx.path):
+            continue
+        for d in rule.check(ctx):
+            if d.rule in per_file or "all" in per_file:
+                continue
+            sup = per_line.get(d.line, ())
+            if d.rule in sup or "all" in sup:
+                continue
+            out.append(d)
+    # dedupe: nested Attribute chains can hit one detector twice per line
+    seen = set()
+    uniq = []
+    for d in out:
+        key = (d.rule, d.path, d.line, d.message)
+        if key not in seen:
+            seen.add(key)
+            uniq.append(d)
+    uniq.sort(key=lambda d: (d.path, d.line, d.col, d.rule))
+    return uniq
+
+
+_SKIP_DIRS = {"__pycache__", ".git", "node_modules", ".venv", "fixtures"}
+
+
+def iter_py_files(paths: Sequence[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        else:
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in _SKIP_DIRS)
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+
+
+def lint_paths(paths: Sequence[str], root: Optional[str] = None,
+               select: Optional[Set[str]] = None) -> List[Diagnostic]:
+    """Lint files/trees.  Paths in diagnostics are repo-relative (to the
+    detected root containing mxnet_tpu/base.py) so baselines and path
+    patterns are machine-independent."""
+    if root is None:
+        root = repo_root_of(paths[0] if paths else ".") or os.getcwd()
+    catalog = load_catalog_names(root)
+    diags: List[Diagnostic] = []
+    for fp in iter_py_files(paths):
+        rel = os.path.relpath(os.path.abspath(fp), root).replace(os.sep, "/")
+        with open(fp, encoding="utf-8") as f:
+            src = f.read()
+        diags.extend(lint_source(src, rel, catalog=catalog, select=select))
+    diags.sort(key=lambda d: (d.path, d.line, d.col, d.rule))
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# Env-read scanner (shared with tools/gen_env_docs.py --check)
+# ---------------------------------------------------------------------------
+
+_ENV_NAME_RE = re.compile(r"^MX(?:NET)?_[A-Z0-9_]+$")
+
+
+def collect_env_reads(paths: Sequence[str]) -> Dict[str, List[str]]:
+    """name -> ['path:line', ...] for every literal MX_*/MXNET_* env read
+    (os.environ.get/[]/os.getenv/base.get_env) in the trees."""
+    found: Dict[str, List[str]] = {}
+
+    def note(name, rel, lineno):
+        if _ENV_NAME_RE.match(name):
+            found.setdefault(name, []).append("%s:%d" % (rel, lineno))
+
+    for fp in iter_py_files(paths):
+        rel = fp.replace(os.sep, "/")
+        try:
+            with open(fp, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=fp)
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                chain = _attr_chain(node.func)
+                tail = chain[-1] if chain else None
+                if tail in ("get_env", "getenv") or \
+                        (chain and chain[-2:] == ["environ", "get"]):
+                    if node.args and isinstance(node.args[0], ast.Constant) \
+                            and isinstance(node.args[0].value, str):
+                        note(node.args[0].value, rel, node.lineno)
+            elif isinstance(node, ast.Subscript):
+                chain = _attr_chain(node.value)
+                if chain and chain[-1] == "environ":
+                    sl = node.slice
+                    if isinstance(sl, ast.Constant) and \
+                            isinstance(sl.value, str):
+                        note(sl.value, rel, node.lineno)
+    return found

@@ -1,0 +1,545 @@
+"""The repo-specific rule set.
+
+Each rule enforces an invariant a prior PR bought (see the
+"Enforced invariants" table in docs/ARCHITECTURE.md).  All analysis is
+file-local: call graphs do not cross imports, so a sync hidden behind an
+imported helper needs a root entry for that helper's own file.  That is a
+deliberate trade — file-local analysis is fast, dependency-free and has
+no false positives from dynamic dispatch — and the hot-path root table
+below covers both sides of every cross-file hot edge (Trainer._update ->
+Updater.__call__, Module.update_metric -> metric.update, ...).
+"""
+from __future__ import annotations
+
+import ast
+import fnmatch
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .core import (Diagnostic, FileContext, Rule, register_rule,
+                   _attr_chain)
+
+# ---------------------------------------------------------------------------
+# host-sync-in-hot-path
+# ---------------------------------------------------------------------------
+
+# (file pattern, [qualname patterns]) — the training-step hot path as rooted
+# per file.  Cross-file hot edges are covered by rooting the callee's own
+# entry points (file-local analysis never follows imports).
+HOT_PATH_ROOTS: List[Tuple[str, List[str]]] = [
+    ("mxnet_tpu/gluon/trainer.py",
+     ["Trainer.step", "Trainer.update", "Trainer._update",
+      "Trainer.allreduce_grads", "Trainer._allreduce_grads"]),
+    ("mxnet_tpu/module/*.py", ["*.update", "*.update_metric"]),
+    ("mxnet_tpu/model.py", ["*.update", "*.update_metric"]),
+    ("mxnet_tpu/metric.py", ["*.update", "*.update_dict"]),
+    ("mxnet_tpu/monitor.py", ["Monitor.tic", "Monitor.toc"]),
+    ("mxnet_tpu/optimizer/*.py",
+     ["Updater.__call__", "*.fused_update", "*._fused_apply", "*.update",
+      "*.update_multi_precision"]),
+]
+
+_SYNC_ATTRS = {"asnumpy", "asscalar", "item", "wait_to_read", "tolist"}
+_NUMPY_PULLS = ("numpy.asarray", "numpy.array", "numpy.frombuffer")
+
+
+def _is_numpy_pull(ctx: FileContext, func: ast.AST) -> bool:
+    return any(ctx.resolves_to(func, d) for d in _NUMPY_PULLS)
+
+
+@register_rule
+class HostSyncInHotPath(Rule):
+    id = "host-sync-in-hot-path"
+    description = ("device->host syncs (.asnumpy()/.item()/np.asarray/"
+                   "waitall) inside functions reachable from the training "
+                   "step; each one stalls the XLA pipeline and breaks the "
+                   "O(1)-dispatches-per-step budget")
+    invariant_from = "ISSUE 3 (single-dispatch training step)"
+    path_patterns = tuple(pat for pat, _ in HOT_PATH_ROOTS)
+
+    def check(self, ctx: FileContext) -> Iterator[Diagnostic]:
+        roots: List[str] = []
+        for pat, quals in HOT_PATH_ROOTS:
+            if not fnmatch.fnmatch(ctx.path, pat):
+                continue
+            for qual in ctx.functions:
+                if any(fnmatch.fnmatch(qual, qp) for qp in quals):
+                    roots.append(qual)
+        if not roots:
+            return
+        # BFS with provenance so the message names the reaching root
+        via: Dict[str, str] = {}
+        stack = [(r, r) for r in roots]
+        while stack:
+            qual, root = stack.pop()
+            if qual in via:
+                continue
+            via[qual] = root
+            for callee in ctx.call_graph.get(qual, ()):
+                stack.append((callee, root))
+        for qual, root in sorted(via.items()):
+            fn = ctx.functions[qual]
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                what = None
+                if isinstance(f, ast.Attribute) and f.attr in _SYNC_ATTRS:
+                    what = ".%s()" % f.attr
+                elif isinstance(f, ast.Attribute) and f.attr == "waitall":
+                    what = "waitall()"
+                elif isinstance(f, ast.Name) and f.id == "waitall":
+                    what = "waitall()"
+                elif _is_numpy_pull(ctx, f):
+                    what = "np.%s()" % f.attr if isinstance(f, ast.Attribute)\
+                        else "np.asarray()"
+                if what:
+                    yield ctx.diag(
+                        self.id, node,
+                        "%s in %s (hot path via %s) forces a device->host "
+                        "sync every batch; accumulate device-side and drain "
+                        "once outside the step" % (what, qual, root))
+
+
+# ---------------------------------------------------------------------------
+# jit-purity
+# ---------------------------------------------------------------------------
+
+_WALL_CLOCK = ("time.time", "time.monotonic", "time.perf_counter",
+               "time.process_time", "time.sleep")
+
+
+def _donate_positions(call: ast.Call) -> Optional[Set[int]]:
+    """Literal donate_argnums positions of a jax.jit call; None if absent
+    or not statically known.  An `X if flag else ()` conditional takes the
+    union — the use-after bug only bites when donation is on."""
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        vals = [kw.value]
+        if isinstance(kw.value, ast.IfExp):
+            vals = [kw.value.body, kw.value.orelse]
+        out: Set[int] = set()
+        for v in vals:
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                out.add(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                for el in v.elts:
+                    if isinstance(el, ast.Constant) and \
+                            isinstance(el.value, int):
+                        out.add(el.value)
+        return out or None
+    return None
+
+
+def _static_param_names(fn: ast.AST,
+                        jit_call: Optional[ast.Call]) -> Set[str]:
+    """Parameters a tracer never flows through: static_argnums/argnames at
+    the jit site, plus any parameter with a default (registry op `params`
+    are static by contract)."""
+    static: Set[str] = set()
+    args = fn.args
+    pos = [a.arg for a in getattr(args, "posonlyargs", [])] + \
+          [a.arg for a in args.args]
+    if jit_call is not None:
+        for kw in jit_call.keywords:
+            if kw.arg == "static_argnames":
+                v = kw.value
+                elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+                for el in elts:
+                    if isinstance(el, ast.Constant) and \
+                            isinstance(el.value, str):
+                        static.add(el.value)
+            elif kw.arg == "static_argnums":
+                v = kw.value
+                elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+                for el in elts:
+                    if isinstance(el, ast.Constant) and \
+                            isinstance(el.value, int) and \
+                            el.value < len(pos):
+                        static.add(pos[el.value])
+    ndefaults = len(args.defaults)
+    if ndefaults:
+        static.update(a for a in pos[-ndefaults:])
+    static.update(a.arg for a in args.kwonlyargs)
+    if args.vararg:
+        pass  # *arrays stay traced
+    if args.kwarg:
+        static.add(args.kwarg.arg)  # **params: static attrs by contract
+    return static
+
+
+@register_rule
+class JitPurity(Rule):
+    id = "jit-purity"
+    description = ("side effects (print/open/wall-clock/env reads/python "
+                   "RNG/global writes/host syncs) and data-dependent "
+                   "python branches inside functions that jax traces — "
+                   "they run once at trace time (or crash), not per step")
+    invariant_from = "seed (pure-traceable op registry contract)"
+
+    def _jit_functions(self, ctx: FileContext):
+        """(fn node, jit call-or-None) for every function this file jits
+        or registers as an op kernel."""
+        # every def in the file, by name (incl. nested), for by-name marks
+        defs_by_name: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs_by_name.setdefault(node.name, []).append(node)
+        marked: Dict[ast.AST, Optional[ast.Call]] = {}
+        in_ops = fnmatch.fnmatch(ctx.path, "mxnet_tpu/ops/*.py")
+
+        def is_jax_jit(node):
+            return ctx.resolves_to(node, "jax.jit") or \
+                ctx.resolves_to(node, "jax.experimental.pjit.pjit")
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    jit_call = None
+                    hit = False
+                    if is_jax_jit(dec):
+                        hit = True
+                    elif isinstance(dec, ast.Call):
+                        if is_jax_jit(dec.func):
+                            hit, jit_call = True, dec
+                        elif ctx.resolves_to(dec.func, "functools.partial") \
+                                and dec.args and is_jax_jit(dec.args[0]):
+                            hit, jit_call = True, dec
+                        elif in_ops and ctx.resolves_to(
+                                dec.func, "mxnet_tpu.ops.registry.register")\
+                                or in_ops and isinstance(dec.func, ast.Name)\
+                                and dec.func.id == "register":
+                            # no_jit exempts only when truthy (or not a
+                            # literal — then be conservative and exempt)
+                            if not any(kw.arg == "no_jit" and
+                                       (not isinstance(kw.value,
+                                                       ast.Constant) or
+                                        kw.value.value)
+                                       for kw in dec.keywords):
+                                hit = True
+                    if hit:
+                        marked[node] = jit_call
+            elif isinstance(node, ast.Call):
+                fn_arg = None
+                jit_call = None
+                if is_jax_jit(node.func) and node.args:
+                    fn_arg, jit_call = node.args[0], node
+                elif in_ops and isinstance(node.func, ast.Name) and \
+                        node.func.id == "register" and len(node.args) >= 2:
+                    if not any(kw.arg == "no_jit" and
+                               isinstance(kw.value, ast.Constant) and
+                               kw.value.value for kw in node.keywords):
+                        fn_arg = node.args[1]
+                if isinstance(fn_arg, ast.Name):
+                    for d in defs_by_name.get(fn_arg.id, ()):
+                        marked.setdefault(d, jit_call)
+        return marked
+
+    def check(self, ctx: FileContext) -> Iterator[Diagnostic]:
+        for fn, jit_call in sorted(self._jit_functions(ctx).items(),
+                                   key=lambda kv: kv[0].lineno):
+            static = _static_param_names(fn, jit_call)
+            params = {a.arg for a in fn.args.args} | \
+                {a.arg for a in getattr(fn.args, "posonlyargs", [])}
+            traced = params - static
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Global):
+                    yield ctx.diag(self.id, node,
+                                   "`global` write inside jitted %r runs at "
+                                   "trace time, not per call" % fn.name)
+                elif isinstance(node, ast.Call):
+                    f = node.func
+                    if isinstance(f, ast.Name) and f.id in ("print", "open",
+                                                            "input"):
+                        yield ctx.diag(
+                            self.id, node,
+                            "%s() inside jitted %r is a trace-time side "
+                            "effect (use jax.debug.print / hoist the I/O)"
+                            % (f.id, fn.name))
+                    elif any(ctx.resolves_to(f, d) for d in _WALL_CLOCK):
+                        yield ctx.diag(
+                            self.id, node,
+                            "wall-clock read inside jitted %r is baked in "
+                            "at trace time" % fn.name)
+                    elif ctx.resolves_to(f, "os.getenv") or \
+                            (isinstance(f, ast.Attribute) and
+                             f.attr in ("get_env", "getenv")) or \
+                            (isinstance(f, ast.Name) and
+                             f.id in ("get_env", "getenv")):
+                        yield ctx.diag(
+                            self.id, node,
+                            "env read inside jitted %r is baked in at trace "
+                            "time; pass it as a static argument" % fn.name)
+                    elif isinstance(f, ast.Attribute) and \
+                            f.attr in ("asnumpy", "item", "asscalar"):
+                        yield ctx.diag(
+                            self.id, node,
+                            ".%s() inside jitted %r forces concretization "
+                            "under trace" % (f.attr, fn.name))
+                    else:
+                        chain = _attr_chain(f)
+                        if chain:
+                            origin = ctx.import_aliases.get(chain[0],
+                                                            chain[0])
+                            full = ".".join([origin] + chain[1:])
+                            if full.startswith("random.") or \
+                                    full.startswith("numpy.random."):
+                                yield ctx.diag(
+                                    self.id, node,
+                                    "python/numpy RNG inside jitted %r is "
+                                    "trace-frozen; thread a jax PRNG key "
+                                    "instead" % fn.name)
+                elif isinstance(node, ast.Attribute) and \
+                        _attr_chain(node) is not None:
+                    chain = _attr_chain(node)
+                    origin = ctx.import_aliases.get(chain[0], chain[0])
+                    if ".".join([origin] + chain[1:]).startswith(
+                            "os.environ"):
+                        yield ctx.diag(
+                            self.id, node,
+                            "os.environ access inside jitted %r is baked in "
+                            "at trace time" % fn.name)
+                elif isinstance(node, (ast.If, ast.While)):
+                    d = self._data_dep_branch(ctx, node, traced, fn)
+                    if d:
+                        yield d
+
+    def _data_dep_branch(self, ctx, node, traced: Set[str], fn):
+        """`if x > 0:` on a traced array argument — TracerBoolConversionError
+        at runtime (or silently trace-frozen).  Shape/dtype attribute
+        reads (`x.ndim`, `x.shape[0]`) are static and exempt, as are
+        `is None` / isinstance checks."""
+        # A traced name only counts when its VALUE flows into the branch
+        # decision directly: bare (`if x:`), compared (`if x > 0:`), or
+        # indexed (`if x[0]:`).  Excluded subtrees are static or at worst
+        # loud at trace time on their own:
+        #   - Attribute chains (`x.ndim`, `x.shape[0]`, `x.dtype`)
+        #   - Call arguments (`isinstance(x, ...)`, `len(x)`, helper
+        #     predicates over shape/dtype)
+        #   - `is` / `is not` comparisons (None sentinels)
+        real: List[str] = []
+
+        def scan(sub):
+            if isinstance(sub, (ast.Attribute, ast.Call)):
+                return
+            if isinstance(sub, ast.Compare) and \
+                    all(isinstance(op, (ast.Is, ast.IsNot))
+                        for op in sub.ops):
+                return
+            if isinstance(sub, ast.Name) and sub.id in traced and \
+                    isinstance(sub.ctx, ast.Load):
+                real.append(sub.id)
+            for child in ast.iter_child_nodes(sub):
+                scan(child)
+
+        scan(node.test)
+        if real:
+            return ctx.diag(
+                self.id, node,
+                "branch on traced argument%s %s inside jitted %r is "
+                "data-dependent python control flow; use lax.cond/jnp.where "
+                "or mark the argument static" %
+                ("s" if len(real) > 1 else "", ", ".join(sorted(set(real))),
+                 fn.name))
+        return None
+
+
+# ---------------------------------------------------------------------------
+# wall-clock-in-fault-path
+# ---------------------------------------------------------------------------
+
+@register_rule
+class WallClockInFaultPath(Rule):
+    id = "wall-clock-in-fault-path"
+    description = ("raw time.time()/monotonic()/sleep() in retry/timeout/"
+                   "liveness code that must use mxnet_tpu.fault's "
+                   "injectable clock, so chaos tests can fast-forward it")
+    invariant_from = "ISSUE 1 (virtual-clock fault tolerance)"
+    path_patterns = ("mxnet_tpu/fault.py", "mxnet_tpu/health.py",
+                     "mxnet_tpu/kvstore/*.py")
+
+    def check(self, ctx: FileContext) -> Iterator[Diagnostic]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.Attribute, ast.Name)):
+                continue
+            if not isinstance(getattr(node, "ctx", None), ast.Load):
+                continue
+            # a bare module alias ("time") resolves to "time", never to
+            # "time.time", so plain imports don't flag
+            for dotted in _WALL_CLOCK:
+                if ctx.resolves_to(node, dotted):
+                    yield ctx.diag(
+                        self.id, node,
+                        "%s in fault-path code: use mxnet_tpu.fault."
+                        "%s() so chaos tests can drive it with a "
+                        "virtual clock" %
+                        (dotted, "sleep" if dotted.endswith("sleep")
+                         else "now"))
+                    break
+
+
+# ---------------------------------------------------------------------------
+# env-var-registry
+# ---------------------------------------------------------------------------
+
+@register_rule
+class EnvVarRegistry(Rule):
+    id = "env-var-registry"
+    description = ("every MX_*/MXNET_* env read must go through "
+                   "mxnet_tpu.base.get_env and be declared in "
+                   "base.ENV_CATALOG (docs/ENV_VARS.md regenerates from "
+                   "it); ad-hoc os.environ reads dodge overrides, typed "
+                   "defaults and the doc")
+    invariant_from = "ISSUE 1-3 (documented MX_* env surface)"
+    # NB fnmatch '*' crosses '/': this one pattern covers every depth
+    path_patterns = ("mxnet_tpu/*.py",)
+
+    _EXEMPT = ("mxnet_tpu/base.py",)  # the accessor itself
+
+    def _is_mx(self, name: str) -> bool:
+        return name.startswith("MX_") or name.startswith("MXNET_")
+
+    def check(self, ctx: FileContext) -> Iterator[Diagnostic]:
+        if ctx.path in self._EXEMPT:
+            return
+        for node in ast.walk(ctx.tree):
+            name = None
+            adhoc = False
+            if isinstance(node, ast.Call):
+                f = node.func
+                chain = _attr_chain(f)
+                if chain:
+                    origin = ctx.import_aliases.get(chain[0], chain[0])
+                    full = ".".join([origin] + chain[1:])
+                    lit = (node.args and
+                           isinstance(node.args[0], ast.Constant) and
+                           isinstance(node.args[0].value, str) and
+                           node.args[0].value)
+                    if full in ("os.environ.get", "os.getenv"):
+                        name, adhoc = lit, True
+                    elif full.endswith("get_env") or full == "util.getenv" \
+                            or (isinstance(f, ast.Name) and
+                                f.id in ("get_env", "getenv")):
+                        name = lit
+            elif isinstance(node, ast.Subscript) and \
+                    isinstance(node.ctx, ast.Load):
+                chain = _attr_chain(node.value)
+                if chain:
+                    origin = ctx.import_aliases.get(chain[0], chain[0])
+                    if ".".join([origin] + chain[1:]) == "os.environ":
+                        sl = node.slice
+                        if isinstance(sl, ast.Constant) and \
+                                isinstance(sl.value, str):
+                            name, adhoc = sl.value, True
+            if not name or not self._is_mx(name):
+                continue
+            if adhoc:
+                yield ctx.diag(
+                    self.id, node,
+                    "ad-hoc env read of %s: route it through "
+                    "mxnet_tpu.base.get_env (typed, override-aware, "
+                    "catalog-documented)" % name)
+            if ctx.catalog is not None and name not in ctx.catalog:
+                yield ctx.diag(
+                    self.id, node,
+                    "%s is not declared in base.ENV_CATALOG — add it (with "
+                    "default + doc line) and regenerate docs/ENV_VARS.md "
+                    "via tools/gen_env_docs.py" % name)
+
+
+# ---------------------------------------------------------------------------
+# donation-after-use
+# ---------------------------------------------------------------------------
+
+@register_rule
+class DonationAfterUse(Rule):
+    id = "donation-after-use"
+    description = ("an argument passed at a donate_argnums position is "
+                   "invalidated by XLA buffer donation; reading it after "
+                   "the call returns garbage or errors on hardware (CPU "
+                   "silently skips donation, hiding the bug)")
+    invariant_from = "ISSUE 3 (donated fused-optimizer buffers)"
+
+    def check(self, ctx: FileContext) -> Iterator[Diagnostic]:
+        # 1. name -> donated positions, for `f = jax.jit(g, donate_argnums=...)`
+        #    bindings (local names and self.X attributes, file-wide)
+        bound: Dict[str, Set[int]] = {}
+        self_bound: Dict[str, Set[int]] = {}
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Assign) or \
+                    not isinstance(node.value, ast.Call):
+                continue
+            call = node.value
+            if not (ctx.resolves_to(call.func, "jax.jit")):
+                continue
+            donated = _donate_positions(call)
+            if not donated:
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    bound[tgt.id] = donated
+                elif isinstance(tgt, ast.Attribute) and \
+                        isinstance(tgt.value, ast.Name) and \
+                        tgt.value.id == "self":
+                    self_bound[tgt.attr] = donated
+        # 2. scan every function for calls through those bindings (or a
+        #    direct jax.jit(...)(...) call) and reads-after of donated args
+        for qual, fn in sorted(ctx.functions.items()):
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                donated = None
+                f = node.func
+                if isinstance(f, ast.Name) and f.id in bound:
+                    donated = bound[f.id]
+                elif isinstance(f, ast.Attribute) and \
+                        isinstance(f.value, ast.Name) and \
+                        f.value.id == "self" and f.attr in self_bound:
+                    donated = self_bound[f.attr]
+                elif isinstance(f, ast.Call) and \
+                        ctx.resolves_to(f.func, "jax.jit"):
+                    donated = _donate_positions(f)
+                if not donated:
+                    continue
+                donated_names = {a.id for i, a in enumerate(node.args)
+                                 if i in donated and isinstance(a, ast.Name)}
+                if not donated_names:
+                    continue
+                yield from self._reads_after(ctx, fn, node, donated_names,
+                                             qual)
+
+    def _reads_after(self, ctx, fn, call, names: Set[str], qual: str):
+        call_line = getattr(call, "end_lineno", call.lineno)
+        names = set(names)
+        # `a = fn(a, b)` rebinds on the call's own line: the assignment
+        # targets of the statement containing the call kill the taint
+        for stmt in ast.walk(fn):
+            if isinstance(stmt, ast.Assign) and \
+                    any(n is call for n in ast.walk(stmt.value)):
+                for tgt in stmt.targets:
+                    for n in ast.walk(tgt):
+                        if isinstance(n, ast.Name):
+                            names.discard(n.id)
+        if not names:
+            return
+        events = []   # (lineno, name, is_store)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and node.id in names and \
+                    node.lineno > call_line:
+                events.append((node.lineno, node.id,
+                               isinstance(node.ctx, ast.Store), node))
+        events.sort(key=lambda e: e[0])
+        dead = set(names)
+        for lineno, name, is_store, node in events:
+            if name not in dead:
+                continue
+            if is_store:
+                dead.discard(name)   # rebound: old buffer unreachable
+            else:
+                yield ctx.diag(
+                    self.id, node,
+                    "%r is read after being passed at a donated position "
+                    "of a donate_argnums-jitted call in %s; its buffer "
+                    "belongs to XLA now — rebind the result or drop "
+                    "donation" % (name, qual))
+                dead.discard(name)   # one report per buffer per call
