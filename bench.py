@@ -309,6 +309,71 @@ def run_eager_bench():
     }))
 
 
+def run_exchange_bench():
+    """--exchange: bucketed gradient-exchange micro-bench (ISSUE 5).
+
+    Times one batched push+pull of a ResNet-ish key set (many small dense
+    tensors + a few large ones) through the collective store per wire
+    mode — fp32, bf16 cast, int8 per-block quantized, 2-bit — and reports
+    ms/step plus the measured wire bytes (engine.wire_bytes deltas).  On
+    one process the collective is local, so this isolates the quantize/
+    bucketing overhead the compression pays for its bandwidth win; on a
+    real pod the same harness times the ICI/DCN exchange itself.
+    """
+    import jax
+    if os.environ.get("MX_BENCH_PLATFORM") == "cpu":
+        from mxnet_tpu.base import pin_cpu
+        pin_cpu()
+    import numpy as np
+    from mxnet_tpu import kvstore, nd
+    from mxnet_tpu.engine import engine
+
+    on_cpu = jax.default_backend() == "cpu"
+    iters = 3 if on_cpu else 20
+    rng = np.random.RandomState(0)
+    # conv-net-like: many small params, a few big FC/embedding-scale ones
+    sizes = [256] * 40 + [16 * 1024] * 12 + [256 * 1024] * 4 + [2 << 20]
+    grads = [nd.array(rng.randn(n).astype(np.float32)) for n in sizes]
+    keys = list(range(len(sizes)))
+    total_mb = sum(sizes) * 4 / (1 << 20)
+
+    per_mode = {}
+    for mode in ("fp32", "bf16", "int8", "2bit"):
+        kv = kvstore.create("ici")
+        if mode != "fp32":
+            kv.set_gradient_compression({"type": mode})
+        for k, g in zip(keys, grads):
+            kv.init(k, nd.zeros((g.size,)))
+        vlists = [[g] for g in grads]
+        kv.push(keys, vlists)                       # warm (compile)
+        kv.pull(keys, vlists)
+        grads[0].wait_to_read()
+        w0 = engine.wire_bytes
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            kv.push(keys, vlists)
+            kv.pull(keys, vlists)
+        grads[0].wait_to_read()
+        dt = time.perf_counter() - t0
+        wire_mb = (engine.wire_bytes - w0) / iters / (1 << 20)
+        per_mode[mode] = {"ms_per_step": round(dt / iters * 1e3, 2),
+                          "wire_mb_per_step": round(wire_mb, 3)}
+    fp32_mb = per_mode["fp32"]["wire_mb_per_step"]
+    for mode, rec in per_mode.items():
+        rec["wire_reduction_vs_fp32"] = round(
+            fp32_mb / max(1e-9, rec["wire_mb_per_step"]), 2)
+    print(json.dumps({
+        "metric": "gradient_exchange_wire_reduction_int8",
+        "value": per_mode["int8"]["wire_reduction_vs_fp32"],
+        "unit": "x_fewer_bytes",
+        "device": jax.default_backend(),
+        "keys": len(sizes),
+        "payload_mb": round(total_mb, 1),
+        "iters": iters,
+        "per_mode": per_mode,
+    }))
+
+
 def run_score_bench():
     """--score: model-zoo INFERENCE throughput vs batch size (reference:
     example/image-classification/benchmark_score.py).  Hybridized forward
@@ -516,6 +581,9 @@ def _captured_tpu_result(mode="resnet"):
 def main():
     if "--real-data" in sys.argv:
         run_real_data_bench()
+        return
+    if "--exchange" in sys.argv:
+        run_exchange_bench()
         return
     if os.environ.get("MX_BENCH_CHILD"):
         mode_env = os.environ.get("MX_BENCH_MODE")

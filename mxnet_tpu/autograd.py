@@ -360,10 +360,58 @@ def _run_backward(heads, head_grads, retain_graph, write_leaves=True,
             head_nodes.append(h._ag_node)
 
     order = _topo_from(head_nodes)
+
+    # Incremental leaf finalization (ISSUE 5 overlap scheduling): a leaf's
+    # gradient is FINAL the moment every tape node that consumes it has
+    # been processed.  Writing it (and firing the grad buffer's overlap
+    # hook) right then — instead of after the whole walk — lets a consumer
+    # (Trainer fusion-bucket exchange) launch its collective while the
+    # rest of backward is still running.  Backward visits heads first, so
+    # late-layer leaves finalize earliest — which is exactly the order the
+    # reverse-packed buckets close in.
+    leaf_edges: Dict[int, int] = {}
+    finalized: set = set()
+    if write_leaves:
+        for node in order:
+            for p in node.parents:
+                if isinstance(p, VariableNode):
+                    k = id(p.array)
+                    leaf_edges[k] = leaf_edges.get(k, 0) + 1
+
+    def _write_leaf(arr, val):
+        req = arr._grad_req
+        if req == "null" or arr._grad is None:
+            return
+        if req == "add":
+            arr._grad._set_jax(arr._grad._jax + val.astype(arr._grad.dtype))
+        else:
+            arr._grad._set_jax(val.astype(arr._grad.dtype))
+            hook = getattr(arr._grad, "_grad_hook", None)
+            if hook is not None:
+                # 'write' only: an accumulating grad ('add') is not final
+                # until the caller says so — overlap consumers drain it at
+                # step time instead
+                hook()
+
+    def _note_consumed(node):
+        for p in node.parents:
+            if not isinstance(p, VariableNode):
+                continue
+            k = id(p.array)
+            leaf_edges[k] -= 1
+            if leaf_edges[k] == 0 and k not in finalized:
+                finalized.add(k)
+                if k in leaf_vals:
+                    _write_leaf(leaf_refs[k], leaf_vals[k])
+
     # order: producers-before-consumers removed by reversal → walk heads first
     for node in reversed(order):
         slot = cts.get(id(node))
         if slot is None:
+            # unreached node (pruned branch): its leaf inputs still count
+            # this visit, or they would never finalize
+            if write_leaves:
+                _note_consumed(node)
             continue
         # Cotangents must match each output's dtype; a consumer may have
         # promoted (e.g. the AMP fp32-list casts a bf16 activation up before
@@ -443,17 +491,17 @@ def _run_backward(heads, head_grads, retain_graph, write_leaves=True,
                 # (index operands of gather/clip/mod): nothing flows
                 continue
             add_ct(parent, g)
+        if write_leaves:
+            # this node's contributions are in: any leaf it was the last
+            # consumer of is now final — write it and fire its hook
+            _note_consumed(node)
 
     if write_leaves:
+        # sweep leaves the walk could not finalize (heads that ARE leaves,
+        # zero-consumer edge cases)
         for key, val in leaf_vals.items():
-            arr = leaf_refs[key]
-            req = arr._grad_req
-            if req == "null" or arr._grad is None:
-                continue
-            if req == "add":
-                arr._grad._set_jax(arr._grad._jax + val.astype(arr._grad.dtype))
-            else:
-                arr._grad._set_jax(val.astype(arr._grad.dtype))
+            if key not in finalized:
+                _write_leaf(leaf_refs[key], val)
         return None
     return dict(leaf_vals)
 

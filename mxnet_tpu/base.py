@@ -71,6 +71,9 @@ ENV_CATALOG: Dict[str, Any] = {
     "MX_PS_SNAPSHOT": ("", "Path where a kvstore server persists its store (atomic pickle) after mutations and on STOP; a server restarted with the same path resumes with no data loss."),
     "MX_PS_SNAPSHOT_EVERY": ("1", "Snapshot the server store every N mutating requests (1 = every PUSH/INIT; larger trades durability for throughput)."),
     "MX_KVSTORE_BUCKET_KB": ("4096", "Fusion-bucket capacity in KB for coalesced gradient exchange: a batched push/pull packs small dense keys into flat per-dtype buckets of about this size, so a ResNet-scale step does a few bucket collectives/RPCs instead of ~160 per-key ones; 0 disables bucketing.  The key->bucket layout is a pure function of the ordered (key, shape, dtype) set, so workers and the PS agree with no coordination; the dist_async retry layer replays whole buckets."),
+    "MX_GRAD_COMPRESS": ("", "Default gradient-wire compression for Trainers constructed without explicit compression_params: 'int8' (per-block symmetric int8 + error feedback, ~3.9x fewer exchange bytes), '2bit' (reference +-threshold/0 levels + error feedback), or 'bf16' (pure cast, half the bytes).  Empty ships full-width floats.  Launch scripts flip it fleet-wide; per-Trainer compression_params always wins."),
+    "MX_GRAD_COMPRESS_BLOCK": ("256", "Elements per int8 scale block for 'int8' gradient compression: each block of this many gradient elements shares one f32 scale (max|block|/127), so the wire payload is n + 4n/block bytes per n-element gradient.  Smaller blocks track outliers tighter at more scale overhead."),
+    "MX_EXCHANGE_OVERLAP": ("0", "1 = overlap-scheduled gradient exchange: the Trainer arms per-gradient readiness hooks and each fusion bucket's collective launches the moment backward finalizes the bucket's last member (reverse-parameter-order buckets, so late layers go out first), with results committed at the pre-update drain barrier.  Exchange results are identical to the serialized path (a grad rewritten after launch relaunches its unit at drain); 0 keeps the exchange serialized after backward."),
     "MX_OPTIMIZER_AGGREGATE": ("", "Fused multi-tensor optimizer apply: empty keeps each optimizer's default aggregate_num (SGD/NAG/Adam/AdamW fuse up to 64 params per dispatch by default), 0 opts out back to the per-param update loop, any other N caps how many (weight, grad, state) triples fuse into one jitted pytree dispatch."),
     "MX_KVSTORE_RETRY_DEADLINE": ("60", "dist_async client: total seconds to keep retrying a failed RPC (reconnect + replay) before raising a terminal MXNetError; also bounds the initial connect wait per server at startup (the launcher starts servers concurrently, so workers retry until each binds)."),
     "MX_KVSTORE_RETRY_BASE": ("0.05", "dist_async client: first backoff delay in seconds; doubles per attempt."),

@@ -41,6 +41,12 @@ class Engine:
         # dispatch-budget harness (tools/dispatch_count.py) reads deltas of
         # this to pin the O(#buckets)-dispatches-per-step contract.
         self.dispatch_count = 0
+        # gradient-exchange payload bytes since process start: what each
+        # pushed gradient occupies in its wire representation (compressed
+        # codes+scales, bf16 cast, or full width).  tools/bandwidth.py and
+        # bench.py --exchange read deltas of this to report measured
+        # bytes-per-step, compressed vs fp32 (ISSUE 5 acceptance).
+        self.wire_bytes = 0
 
     def track(self, chunk) -> None:
         self._live.add(chunk)
@@ -48,6 +54,10 @@ class Engine:
     def count_dispatch(self, n: int = 1) -> None:
         """Note `n` device-program dispatches (hot path: one int add)."""
         self.dispatch_count += n
+
+    def count_wire_bytes(self, n: int) -> None:
+        """Note `n` gradient-exchange wire bytes (hot path: one int add)."""
+        self.wire_bytes += int(n)
 
     # -- engine type -------------------------------------------------------
     @property

@@ -12,9 +12,10 @@ step over a Mesh (mxnet_tpu.parallel.TrainStep) but keeps this class's API.
 """
 from __future__ import annotations
 
+import functools
 from typing import Dict, List, Optional
 
-from ..base import MXNetError
+from ..base import MXNetError, get_env
 from .. import optimizer as opt
 from .. import profiler as _profiler
 from ..kvstore import create as kv_create
@@ -52,6 +53,13 @@ class Trainer:
                                 if p._grad_stype == "row_sparse"]
         self._dense_indices = [i for i, p in enumerate(self._params)
                                if p._grad_stype != "row_sparse"]
+        if compression_params is None:
+            # ops knob: MX_GRAD_COMPRESS=int8|2bit|bf16 compresses the
+            # gradient wire of any Trainer launched without explicit
+            # compression_params (launch scripts flip it fleet-wide)
+            default_compress = get_env("MX_GRAD_COMPRESS")
+            if default_compress:
+                compression_params = {"type": default_compress}
         self._compression_params = compression_params
         self._contexts = self._check_contexts()
         optimizer_params = optimizer_params or {}
@@ -98,6 +106,9 @@ class Trainer:
         self._update_on_kvstore = None
         self._params_to_init = [p for p in self._params]
         self._kv_broadcast_done: set = set()
+        self._overlap = False
+        self._exchange_session = None
+        self._armed_set = None
 
     def _init_kvstore(self):
         config = self._kvstore_params
@@ -129,6 +140,14 @@ class Trainer:
             if update_on_kvstore:
                 kv.set_optimizer(self._optimizer)
             self._update_on_kvstore = update_on_kvstore
+            # overlap scheduling (ISSUE 5): exchange each fusion bucket as
+            # soon as backward finalizes its last gradient, instead of
+            # serializing the whole exchange behind backward.  Needs the
+            # local-updater layout (the server-optimizer path must see the
+            # full key set at once) and a store whose exchange dispatch is
+            # async (begin_exchange returns None on the PS transport).
+            self._overlap = not update_on_kvstore and \
+                get_env("MX_EXCHANGE_OVERLAP", dtype=bool)
         else:
             self._kvstore = None
             self._update_on_kvstore = False
@@ -191,9 +210,10 @@ class Trainer:
             "is not supported. Try setting `update_on_kvstore` to False."
         self._allreduce_grads()
 
-    def _allreduce_grads(self):
-        if self._kvstore is None:
-            return
+    def _exchange_set(self):
+        """(idxs, grad_lists) of params whose gradients need the exchange
+        this step — the key set both the batched push/pull and the
+        overlap session operate on."""
         idxs: List[int] = []
         grad_lists = []
         for i, param in enumerate(self._params):
@@ -207,7 +227,86 @@ class Trainer:
                 continue
             idxs.append(i)
             grad_lists.append(grads)
+        return idxs, grad_lists
+
+    def _arm_exchange(self):
+        """Open the NEXT step's overlap session and point each grad
+        buffer's readiness hook at it: during the following backward,
+        every finalized gradient notifies the session and a fusion
+        bucket's exchange launches the moment its last member lands
+        (reverse-parameter-order buckets, so late layers — produced first
+        — go out first).  Results commit at drain (_allreduce_grads), so
+        gradients read between backward and step() are untouched."""
+        self._exchange_session = None
+        self._armed_set = None
+        if not self._overlap or self._kvstore is None:
+            return
+        idxs, grad_lists = self._exchange_set()
         if not idxs:
+            return
+        sess = self._kvstore.begin_exchange(idxs, grad_lists)
+        if sess is None:        # transport cannot overlap (dist_async)
+            self._overlap = False
+            return
+        self._exchange_session = sess
+        self._armed_set = (idxs, grad_lists)
+        for p, i in enumerate(idxs):
+            for d, g in enumerate(grad_lists[p]):
+                g._grad_hook = functools.partial(self._on_grad_ready, i, d)
+
+    def _armed_set_current(self):
+        """The armed session still covers exactly this step's exchange
+        set: same param indices AND the same grad buffer objects (a
+        grad_req flip or a force-reinit between steps changes either)."""
+        if self._armed_set is None:
+            return False
+        idxs, grad_lists = self._exchange_set()
+        a_idxs, a_lists = self._armed_set
+        return idxs == a_idxs and \
+            len(grad_lists) == len(a_lists) and \
+            all(len(l) == len(al) and all(g is ag for g, ag in zip(l, al))
+                for l, al in zip(grad_lists, a_lists))
+
+    def _on_grad_ready(self, i, d):
+        sess = self._exchange_session
+        if sess is not None:
+            sess.notify_key(i, d)
+
+    def _allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        sess = self._exchange_session
+        if sess is not None and not self._armed_set_current():
+            # the exchange set changed under the armed session (a param
+            # frozen/unfrozen or re-initialized between steps): its plan
+            # no longer covers this step — roll back any launched EF
+            # state and fall through to a fresh session/serialized path
+            sess.abort()
+            sess = None
+            self._exchange_session = None
+        if sess is None and self._overlap:
+            # overlap enabled but no session was armed before this
+            # backward (first step, or recovering from a fallback): run
+            # THIS exchange through the session machinery too — drain
+            # launches every pending unit — so the bucket layout (and the
+            # error-feedback residual wire keys, which embed the bucket
+            # CRC) is identical to the overlapped steps'
+            idxs, grad_lists = self._exchange_set()
+            if idxs:
+                sess = self._kvstore.begin_exchange(idxs, grad_lists)
+                if sess is None:    # transport cannot overlap (dist_async)
+                    self._overlap = False
+        if sess is not None:
+            # overlap path: bucket exchanges already launched during
+            # backward — launch stragglers and commit the results
+            self._exchange_session = None
+            with _profiler.annotate("trainer.allreduce"):
+                sess.drain()
+            self._arm_exchange()
+            return
+        idxs, grad_lists = self._exchange_set()
+        if not idxs:
+            self._arm_exchange()
             return
         # ONE batched push/pull for the whole key set: the store coalesces
         # small dense keys into fusion buckets (MX_KVSTORE_BUCKET_KB) so a
@@ -221,6 +320,7 @@ class Trainer:
                     idxs, [self._params[i].list_data() for i in idxs])
             else:
                 self._kvstore.pull(idxs, grad_lists)
+        self._arm_exchange()
 
     def update(self, batch_size, ignore_stale_grad=False):
         """Separate update step (reference: Trainer.update)."""

@@ -26,11 +26,11 @@ amortize their dispatch; the PS big-array path additionally shards them).
 from __future__ import annotations
 
 import zlib
-from typing import List, Sequence, Tuple
+from typing import Dict, List, Sequence, Set, Tuple
 
 from ..base import get_env
 
-__all__ = ["Bucket", "bucket_bytes", "plan_buckets"]
+__all__ = ["Bucket", "bucket_bytes", "plan_buckets", "ReadinessPlanner"]
 
 
 def bucket_bytes() -> int:
@@ -76,12 +76,20 @@ class Bucket:
 
 def plan_buckets(keys: Sequence, shapes: Sequence[Tuple[int, ...]],
                  dtypes: Sequence[str], itemsizes: Sequence[int],
-                 stypes: Sequence[str], max_bytes: int):
+                 stypes: Sequence[str], max_bytes: int,
+                 reverse: bool = False):
     """Greedy first-fit in key order, one dtype per bucket.
 
     Returns ``(buckets, solo_positions)``: positions not covered by any
     bucket (sparse, over-cap, lone-member dtypes) take the per-key path.
     Deterministic in its inputs — see the module docstring contract.
+
+    ``reverse=True`` packs in REVERSE parameter order: backward produces
+    late-layer gradients first, so reverse packing aligns bucket
+    boundaries with production order — the first buckets to fill are
+    exactly the first whose members all exist, letting the overlap
+    scheduler (:class:`ReadinessPlanner`) launch their exchange while
+    early layers are still differentiating.
     """
     solo: List[int] = []
     open_by_dtype = {}    # dtype -> (positions, nbytes)
@@ -94,8 +102,11 @@ def plan_buckets(keys: Sequence, shapes: Sequence[Tuple[int, ...]],
         else:
             solo.extend(poss)
 
-    for pos, (shape, dtype, isz, stype) in enumerate(
-            zip(shapes, dtypes, itemsizes, stypes)):
+    indices = range(len(shapes) - 1, -1, -1) if reverse \
+        else range(len(shapes))
+    for pos in indices:
+        shape, dtype, isz, stype = (shapes[pos], dtypes[pos],
+                                    itemsizes[pos], stypes[pos])
         size = 1
         for d in shape:
             size *= int(d)
@@ -113,7 +124,8 @@ def plan_buckets(keys: Sequence, shapes: Sequence[Tuple[int, ...]],
         close(dtype)
 
     buckets = []
-    for bi, poss in enumerate(sorted(closed, key=lambda p: p[0])):
+    order_key = (lambda p: -p[0]) if reverse else (lambda p: p[0])
+    for bi, poss in enumerate(sorted(closed, key=order_key)):
         sizes = []
         for p in poss:
             n = 1
@@ -123,3 +135,71 @@ def plan_buckets(keys: Sequence, shapes: Sequence[Tuple[int, ...]],
         buckets.append(Bucket(bi, poss, [keys[p] for p in poss], sizes,
                               [shapes[p] for p in poss], str(dtypes[poss[0]])))
     return buckets, sorted(solo)
+
+
+class ReadinessPlanner:
+    """Overlap scheduling (ISSUE 5): close exchange *units* — fusion
+    buckets or solo keys — the moment their last member gradient lands.
+
+    The exchange layer plans units up front (reverse-parameter-order
+    buckets, so the first gradients backward produces complete the first
+    units), then feeds per-position readiness events in as autograd
+    finalizes leaf gradients.  ``note`` returns the unit indices that
+    just closed — the caller launches those exchanges immediately,
+    overlapping the collective with the rest of backward.  Positions with
+    several device copies close only once every copy has landed.
+
+    A second event for an already-complete position (double backward,
+    ``grad_req='add'`` re-entry) sets :attr:`stale`: the caller must
+    relaunch every unit at drain time, because launched exchanges read
+    values that have since changed.
+    """
+
+    def __init__(self, buckets: Sequence[Bucket], solo: Sequence[int],
+                 copies: int = 1):
+        self._units: List = [("bucket", b) for b in buckets] + \
+            [("solo", int(p)) for p in solo]
+        self._unit_of_pos: Dict[int, int] = {}
+        self._remaining: List[int] = []
+        for u, (kind, obj) in enumerate(self._units):
+            members = obj.positions if kind == "bucket" else [obj]
+            self._remaining.append(len(members))
+            for p in members:
+                self._unit_of_pos[int(p)] = u
+        self._copies = max(1, int(copies))
+        self._seen: Dict[int, Set[int]] = {}
+        self._closed: List[bool] = [False] * len(self._units)
+        self.stale = False
+
+    def __len__(self):
+        return len(self._units)
+
+    def unit(self, u: int):
+        """(kind, obj) — ('bucket', Bucket) or ('solo', position)."""
+        return self._units[u]
+
+    def note(self, pos: int, copy: int = 0) -> List[int]:
+        """Record that `pos`'s gradient copy `copy` is final; returns the
+        unit indices this event closed (usually [] or [u])."""
+        u = self._unit_of_pos.get(int(pos))
+        if u is None:
+            return []
+        seen = self._seen.setdefault(int(pos), set())
+        if self._closed[u] or copy in seen:
+            self.stale = True
+            return []
+        seen.add(copy)
+        if len(seen) < self._copies:
+            return []
+        self._remaining[u] -= 1
+        if self._remaining[u] == 0:
+            self._closed[u] = True
+            return [u]
+        return []
+
+    def pending(self) -> List[int]:
+        """Units not yet closed (drain launches these)."""
+        return [u for u, c in enumerate(self._closed) if not c]
+
+    def all_units(self) -> List[int]:
+        return list(range(len(self._units)))

@@ -1,109 +1,193 @@
-"""2-bit gradient compression with residual accumulation (error feedback).
+"""Gradient compression for the exchange wire (2-bit + int8, error
+feedback).
 
 Reference: ``src/kvstore/gradient_compression.cc`` (`GradientCompression`,
 `Quantize2BitImpl`, `Dequantize2BitImpl`) and
-``src/kvstore/gradient_compression-inl.h``.
+``src/kvstore/gradient_compression-inl.h``; the int8 mode follows EQuARX
+(arXiv:2506.17615) — per-block symmetric int8 with scale-merged
+dequant-sum-requant inside the collective.
 
-Contract (the reference's exact algorithm):
-  * per worker and per key a float *residual* accumulates what compression
-    dropped: ``residual += grad``;
-  * each element is quantized to one of three levels —
-    ``+threshold`` when ``residual >= threshold``, ``-threshold`` when
-    ``residual <= -threshold``, else 0 — and the emitted level is
-    subtracted back from the residual (error feedback keeps |residual| <
-    threshold + |grad_step|, so no gradient mass is ever lost, only
-    delayed);
+Contract (the reference's exact algorithm, both modes):
+  * per worker and per wire key a float *residual* accumulates what
+    compression dropped: ``residual += grad``;
+  * the emitted payload is subtracted back from the residual (error
+    feedback: compression error is carried into the next step, so no
+    gradient mass is ever lost, only delayed);
   * the receiver sums workers' *dequantized* values.
 
-TPU-native realization: quantize/error-feedback is one jitted elementwise
-kernel (XLA fuses the compare/select/subtract).  On the collective path
-the "wire" is the allreduce itself, which sums the dequantized ±t/0
-levels directly — a 2-bit payload would have to be decoded before psum
-anyway, so nothing is gained by shipping codes between chips.  The packed
-2-bit wire format (16 codes per 32-bit word) is still implemented and
-tested for format parity with reference byte streams: ``pack_2bit`` /
-``unpack_2bit``.
+The kernels live in :mod:`mxnet_tpu.ops.quantization` — jitted,
+donation-aware (the residual buffer is donated into each quantize step).
+This module owns the per-key residual STATE (device-resident, f32); the
+host-side ``QGRAD`` wire codec the dist_async TCP path ships lives in
+:mod:`.wire_codec` (numpy-only, so the server never imports the device
+kernel stack) and is re-exported here.
+
+The packed 2-bit wire format (16 codes per 32-bit word) is implemented
+both device-side (ops.quantization.pack_2bit_words) and host-side
+(wire_codec.pack_2bit / unpack_2bit, kept for format parity with
+reference byte streams); the roundtrip test pins them bit-compatible.
 """
 from __future__ import annotations
 
-from typing import Dict, Tuple
+from typing import Dict
 
 import numpy as _np
-import jax
 import jax.numpy as jnp
 
+from ..ops import quantization as _qops
+from .wire_codec import (is_wire_payload, encode_wire, decode_wire,  # noqa: F401
+                         pack_2bit, unpack_2bit)
+
 __all__ = ["GradientCompression", "quantize_2bit", "pack_2bit",
-           "unpack_2bit"]
-
-
-@jax.jit
-def _quantize_2bit_jit(grad, residual, threshold):
-    acc = residual + grad
-    q = jnp.where(acc >= threshold, threshold, 0.0) + \
-        jnp.where(acc <= -threshold, -threshold, 0.0)
-    q = q.astype(grad.dtype)
-    return q, (acc - q).astype(grad.dtype)
+           "unpack_2bit", "encode_wire", "decode_wire", "is_wire_payload"]
 
 
 def quantize_2bit(grad, residual, threshold: float):
     """One error-feedback quantization step; returns (dequantized levels,
-    new residual).  Levels are in {-threshold, 0, +threshold}."""
-    return _quantize_2bit_jit(grad, residual,
-                              jnp.asarray(threshold, grad.dtype))
+    new residual).  Levels are in {-threshold, 0, +threshold}.  NB the
+    residual buffer is donated into the jitted kernel — pass a fresh array
+    or one you will not read again."""
+    return _qops.quantize_2bit_ef(grad, residual, threshold)
 
 
-def pack_2bit(levels: _np.ndarray, threshold: float) -> _np.ndarray:
-    """Pack ±t/0 levels into the 2-bit wire format: 16 codes per uint32
-    word, code i of a word at bits [2i, 2i+1], 00=zero 01=-t 10=+t
-    (reference Quantize2BitImpl packs 16 values per float32 word; the
-    in-word bit order is pinned by the roundtrip test)."""
-    flat = _np.asarray(levels, _np.float32).ravel()
-    codes = _np.where(flat > 0, 2, _np.where(flat < 0, 1, 0)).astype(
-        _np.uint32)
-    pad = (-len(codes)) % 16
-    if pad:
-        codes = _np.concatenate([codes, _np.zeros(pad, _np.uint32)])
-    words = codes.reshape(-1, 16)
-    out = _np.zeros(words.shape[0], _np.uint32)
-    for i in range(16):
-        out |= words[:, i] << (2 * i)
-    return out
-
-
-def unpack_2bit(words: _np.ndarray, n: int, threshold: float,
-                dtype=_np.float32) -> _np.ndarray:
-    """Inverse of pack_2bit: first `n` codes back to ±threshold/0."""
-    words = _np.asarray(words, _np.uint32)
-    codes = _np.zeros((len(words), 16), _np.uint32)
-    for i in range(16):
-        codes[:, i] = (words >> (2 * i)) & 0x3
-    codes = codes.ravel()[:n]
-    out = _np.zeros(n, dtype)
-    out[codes == 2] = threshold
-    out[codes == 1] = -threshold
-    return out
+def wire_nbytes(mode: str, n: int, block: int = None) -> int:
+    """Bytes the payload of an n-element gradient occupies on the wire."""
+    if mode == "int8":
+        return _qops.int8_wire_bytes(n, block or _qops.grad_compress_block())
+    if mode == "2bit":
+        return _qops.two_bit_wire_bytes(n)
+    if mode == "bf16":
+        return 2 * n
+    return 4 * n
 
 
 class GradientCompression:
-    """Per-store compression state: residual per key (reference keeps one
-    residual buffer per key per worker)."""
+    """Per-store compression state: one device-resident residual per wire
+    key (reference keeps one residual buffer per key per worker).  Wire
+    keys are whatever the exchange layer compresses — a parameter key on
+    the per-key path, a fusion-bucket name on the bucketed path (bucket
+    names embed a member CRC, so a layout change rolls the residual
+    instead of misapplying it)."""
 
-    def __init__(self, threshold: float = 0.5):
+    def __init__(self, type: str = "2bit", threshold: float = 0.5,
+                 block: int = None):
+        if type not in ("2bit", "int8"):
+            raise ValueError("unsupported gradient compression type %r "
+                             "(GradientCompression handles '2bit'/'int8')"
+                             % (type,))
         if threshold <= 0:
             raise ValueError("2bit compression threshold must be > 0, got "
                              "%r" % threshold)
-        self.type = "2bit"
+        self.type = type
         self.threshold = float(threshold)
+        self.block = int(block) if block else _qops.grad_compress_block()
         self._residuals: Dict = {}
+        # wire keys whose PRE-quantize residual must stay restorable (the
+        # overlap session's relaunch path): quantization for a pinned key
+        # runs donation-FREE so the checkpointed buffer remains valid on
+        # backends where donation really invalidates it (TPU)
+        self._pinned: Dict = {}
 
-    def quantize(self, key, x) -> Tuple:
-        """Quantize jax array `x` for `key`, updating the residual."""
-        res = self._residuals.get(key)
-        if res is None or res.shape != x.shape:
-            res = jnp.zeros_like(x)
-        q, new_res = quantize_2bit(x, res, self.threshold)
+    # -- residual store -----------------------------------------------------
+    def _residual(self, key, shape, dtype=None):
+        res = self._residuals.pop(key, None)
+        if res is None or res.shape != tuple(shape):
+            res = jnp.zeros(shape, dtype or jnp.float32)
+        return res
+
+    def _donate(self, key) -> bool:
+        return key not in self._pinned
+
+    # -- overlap-session checkpointing (relaunch rollback) -------------------
+    def checkpoint(self, keys) -> None:
+        """Pin the CURRENT residuals of `keys`: until :meth:`commit`,
+        quantize steps for these keys keep the checkpointed buffer alive
+        (no donation) so :meth:`rollback` can restore the exact
+        pre-launch error-feedback state.  Idempotent per key — a second
+        checkpoint before commit keeps the ORIGINAL snapshot (the
+        relaunch path re-quantizes from the restored state)."""
+        for k in keys:
+            if k not in self._pinned:
+                self._pinned[k] = self._residuals.get(k)
+
+    def rollback(self, keys) -> None:
+        """Restore the checkpointed residuals of `keys` (the launched
+        exchange's payload was discarded, so its error-feedback step
+        must un-happen before re-quantizing)."""
+        for k in keys:
+            if k not in self._pinned:
+                continue
+            snap = self._pinned[k]
+            if snap is None:
+                self._residuals.pop(k, None)
+            else:
+                self._residuals[k] = snap
+
+    def commit(self, keys) -> None:
+        """Drop the checkpoints of `keys` (results committed; donation
+        resumes next step)."""
+        for k in keys:
+            self._pinned.pop(k, None)
+
+    # -- device-side API (collective path) ----------------------------------
+    def quantize(self, key, x):
+        """Error-feedback compress→decompress of `x` for wire key `key`:
+        what a single worker's exchange observes of the compression.  One
+        jitted dispatch; updates the residual."""
+        if self.type == "int8":
+            flat = x.reshape(-1)
+            res = self._residual(key, flat.shape)
+            deq, new_res = _qops.roundtrip_int8_blocks(
+                flat, res, self.block, donate=self._donate(key))
+            self._residuals[key] = new_res
+            return deq.reshape(x.shape)
+        res = self._residual(key, x.shape, x.dtype)
+        q, new_res = _qops.quantize_2bit_ef(x, res, self.threshold,
+                                            donate=self._donate(key))
         self._residuals[key] = new_res
         return q
 
+    def compress_device(self, key, flat):
+        """Compress a FLAT payload to its compact device representation,
+        updating the residual.  int8 → (q, scales); 2bit → (words,) of
+        the packed format."""
+        if self.type == "int8":
+            res = self._residual(key, flat.shape)
+            q, scales, new_res = _qops.quantize_int8_blocks(
+                flat, res, self.block, donate=self._donate(key))
+            self._residuals[key] = new_res
+            return q, scales
+        res = self._residual(key, flat.shape, flat.dtype)
+        levels, new_res = _qops.quantize_2bit_ef(flat, res, self.threshold,
+                                                 donate=self._donate(key))
+        self._residuals[key] = new_res
+        return (_qops.pack_2bit_words(levels),)
+
+    def decompress_device(self, payload, n):
+        """Inverse of :meth:`compress_device` (device, jitted)."""
+        if self.type == "int8":
+            q, scales = payload
+            return _qops.dequantize_int8_blocks(q, scales, n)
+        return _qops.unpack_2bit_words(payload[0], self.threshold, n)
+
+    # -- host-side wire (dist_async path) -----------------------------------
+    def encode(self, key, x):
+        """Compress `x` and encode it for the TCP wire (ONE host transfer
+        of the compact payload instead of the full-width float array)."""
+        flat = x.reshape(-1)
+        payload = self.compress_device(key, flat)
+        if self.type == "int8":
+            q, scales = payload
+            return encode_wire("int8", x.shape, x.dtype,
+                               (_np.asarray(q), _np.asarray(scales)))
+        return encode_wire("2bit", x.shape, x.dtype,
+                           (_np.asarray(payload[0]), self.threshold))
+
+    def wire_nbytes(self, n: int) -> int:
+        return wire_nbytes(self.type, n, self.block)
+
     def get_params(self):
-        return {"type": self.type, "threshold": self.threshold}
+        p = {"type": self.type, "threshold": self.threshold}
+        if self.type == "int8":
+            p["block"] = self.block
+        return p

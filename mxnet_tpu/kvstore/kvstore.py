@@ -113,25 +113,107 @@ class KVStore:
     def _reduce_many(self, keys, vlists) -> List[NDArray]:
         """Merge each key's device copies (and, in subclasses, exchange
         across workers — where fusion buckets coalesce the wire ops)."""
-        return [self._reduce(v, key=k) for k, v in zip(keys, vlists)]
+        out = []
+        for k, v in zip(keys, vlists):
+            m = self._reduce(v, key=k)
+            if isinstance(m, NDArray):
+                self._note_wire_value(m)
+            out.append(m)
+        return out
 
-    def _bucket_plans(self, keys, arrays):
+    # -- wire accounting (tools/bandwidth.py, bench.py --exchange) ---------
+    def _wire_nbytes(self, n_elems: int, itemsize: int,
+                     floating: bool = True) -> int:
+        """Bytes an n-element gradient payload occupies in its exchange
+        representation (compressed wire format, bf16 cast, or full
+        width).  On the collective path this is the payload entering the
+        allreduce; on the PS path the bytes actually sent."""
+        gc = getattr(self, "_gc", None)
+        if gc is not None and floating:
+            return gc.wire_nbytes(int(n_elems))
+        if getattr(self, "_compress_bf16", False) and floating and \
+                itemsize == 4:
+            return 2 * int(n_elems)
+        return int(n_elems) * int(itemsize)
+
+    def _note_wire_value(self, m) -> None:
+        if not isinstance(m, NDArray):
+            return      # sparse payloads are nnz-keyed; not accounted here
+        floating = jnp.issubdtype(m._jax.dtype, jnp.floating)
+        from ..engine import engine as _engine
+        _engine.count_wire_bytes(
+            self._wire_nbytes(m.size, m._jax.dtype.itemsize, floating))
+
+    # -- overlap-scheduled exchange (ISSUE 5) ------------------------------
+    def begin_exchange(self, keys, vlists):
+        """Open an overlap-scheduled batched exchange: the caller feeds
+        per-key readiness events (gradients finalizing during backward)
+        and each fusion bucket's exchange launches the moment its last
+        member lands; ``drain()`` launches stragglers and commits every
+        result (store slot + pull targets).  Returns None on stores that
+        cannot overlap (host-blocking RPC transports)."""
+        keys = [_key(k) for k in keys]
+        vlists = [v if isinstance(v, (list, tuple)) else [v]
+                  for v in vlists]
+        return _ExchangeSession(self, keys, vlists)
+
+    def _exchange_unit(self, kind, obj, keys, vlists):
+        """Launch one exchange unit (async dispatch; no host sync).  Base
+        stores have no cross-worker wire: a unit is the per-key local
+        merge."""
+        if kind == "solo":
+            m = self._reduce(vlists[obj], key=keys[obj])
+            self._note_wire_value(m)
+            return m
+        out = []
+        for p in obj.positions:
+            m = self._reduce(vlists[p], key=keys[p])
+            self._note_wire_value(m)
+            out.append(m)
+        return out
+
+    def _commit_unit(self, kind, obj, result, keys, vlists):
+        """Write a launched unit's result into the store slot and every
+        pull target — the push+pull contract, deferred to drain time so
+        gradients observed between backward and step() keep their
+        un-exchanged values."""
+        if kind == "solo":
+            self._commit_key(keys[obj], result, vlists[obj])
+            return
+        for p, m in zip(obj.positions, result):
+            self._commit_key(keys[p], m, vlists[p])
+
+    def _commit_key(self, k, merged, targets):
+        stored = self._store.get(k)
+        if stored is None:
+            raise MXNetError("key %s has not been initialized" % k)
+        stored._set_jax(merged.as_in_context(stored.context)._jax)
+        for t in targets:
+            stored.copyto(t)
+
+    def _bucket_plans(self, keys, arrays, reverse=False):
         """Cached stable key→bucket layout for a batched exchange.
 
         `arrays` supplies shapes/dtypes (NDArray or numpy).  Returns
         (buckets, solo_positions); callers gate on bucketing being
-        applicable (multi-key, no attached optimizer)."""
+        applicable (multi-key, no attached optimizer).  The cache key
+        includes the bucket capacity and packing order: changing
+        ``MX_KVSTORE_BUCKET_KB`` mid-process (tests, tuning sweeps) must
+        re-plan, not serve a stale layout — and ``MX_KVSTORE_BUCKET_KB=0``
+        cleanly disables bucketing (everything solo, per-key path)."""
         from .bucketing import bucket_bytes, plan_buckets
+        cap = bucket_bytes()
         sig = tuple((k, tuple(a.shape), str(a.dtype),
                      getattr(a, "stype", "default"))
                     for k, a in zip(keys, arrays))
-        cached = self._bucket_cache.get(sig)
+        cache_key = (sig, cap, bool(reverse))
+        cached = self._bucket_cache.get(cache_key)
         if cached is None:
             cached = plan_buckets(
                 keys, [s[1] for s in sig], [s[2] for s in sig],
                 [_np.dtype(a.dtype).itemsize for a in arrays],
-                [s[3] for s in sig], bucket_bytes())
-            self._bucket_cache[sig] = cached
+                [s[3] for s in sig], cap, reverse=reverse)
+            self._bucket_cache[cache_key] = cached
         return cached
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
@@ -199,28 +281,32 @@ class KVStore:
         ``{'type': '2bit', 'threshold': t}`` — the reference's exact
         scheme: per-key residual error feedback, each pushed gradient
         quantized to {-t, 0, +t} per worker before the reduce
-        (gradient_compression.py).  ``{'type': 'bf16'}`` — TPU-extra:
-        cast payloads to bfloat16 before the allreduce (half the ICI/DCN
-        bytes).  Anything else warns loudly instead of silently
-        succeeding."""
-        import warnings
+        (gradient_compression.py).  ``{'type': 'int8', 'block': b}`` —
+        per-block symmetric int8 with error feedback; the collective
+        payload carries int8 codes + one f32 scale per `b` elements
+        (MX_GRAD_COMPRESS_BLOCK default) and is merged scale-aware
+        (dequant-sum-requant) inside the allreduce.  ``{'type': 'bf16'}``
+        — TPU-extra: cast payloads to bfloat16 before the allreduce
+        (half the ICI/DCN bytes).  An unknown type raises ValueError
+        (matching upstream MXNet) instead of silently not compressing."""
         params = dict(compression_params or {})
         ctype = params.get("type")
         self._gc = None
         self._compress_bf16 = False
-        if ctype == "2bit":
+        if ctype in ("2bit", "int8"):
             from .gradient_compression import GradientCompression
             self._gc = GradientCompression(
-                threshold=float(params.get("threshold", 0.5)))
+                type=ctype,
+                threshold=float(params.get("threshold", 0.5)),
+                block=params.get("block"))
             return
         if ctype == "bf16":
             self._compress_bf16 = True
             return
         if ctype is not None:
-            warnings.warn(
-                "gradient compression %r is not supported (use '2bit' or "
-                "'bf16'); gradients will NOT be compressed." % (ctype,),
-                stacklevel=2)
+            raise ValueError(
+                "Unsupported gradient compression type %r (supported: "
+                "'2bit', 'int8', 'bf16')" % (ctype,))
 
     def _maybe_compress(self, x):
         """bf16 cast applied to gradient payloads before the reduce."""
@@ -252,12 +338,16 @@ class KVStore:
 
     def _reduce(self, values: List[NDArray], key=None) -> NDArray:
         merged = self._reduce_local(values)
-        # 2-bit error-feedback quantization of the per-process merged
-        # gradient (reference: worker quantizes AFTER its local multi-GPU
-        # reduce, before the wire — kvstore_dist.h PushImpl)
+        # error-feedback quantization of the per-process merged gradient
+        # (reference: worker quantizes AFTER its local multi-GPU reduce,
+        # before the wire — kvstore_dist.h PushImpl).  2bit emits ±t/0
+        # levels; int8 is a per-block quantize→dequantize roundtrip (one
+        # jitted dispatch) — what a single worker observes of the wire.
         gc = getattr(self, "_gc", None)
         if gc is not None and key is not None and \
                 jnp.issubdtype(merged._jax.dtype, jnp.floating):
+            from ..engine import engine as _engine
+            _engine.count_dispatch()
             merged = NDArray(gc.quantize(key, merged._jax),
                              ctx=merged.context)
         return merged
@@ -277,6 +367,145 @@ class KVStore:
         if orig_dtype is not None:
             out = out.astype(orig_dtype)
         return NDArray(out, ctx=target)
+
+
+class _ExchangeSession:
+    """One overlap-scheduled batched gradient exchange (ISSUE 5).
+
+    Created by :meth:`KVStore.begin_exchange` BEFORE backward runs; the
+    trainer's grad-ready hooks call :meth:`notify_key` as autograd
+    finalizes each leaf gradient, and the moment a fusion bucket's last
+    member (across every device copy) lands, that bucket's exchange
+    launches — an async XLA dispatch that overlaps with the rest of
+    backward.  Buckets are planned in REVERSE parameter order
+    (bucketing.plan_buckets(reverse=True)): backward produces late-layer
+    gradients first, so the first buckets close (and their collectives
+    fly) while early layers are still differentiating.
+
+    Results are committed (store slot + pull targets) only at
+    :meth:`drain` — called by Trainer._allreduce_grads before the
+    optimizer applies — so code inspecting gradients between backward and
+    step() still sees the un-exchanged values.  A notify for an
+    already-launched unit (double backward, grad_req='add') marks the
+    session stale and drain relaunches everything from the arrays'
+    current values — overlap degrades to the serialized exchange, never
+    to wrong gradients.
+    """
+
+    def __init__(self, store: "KVStore", keys, vlists):
+        from .bucketing import ReadinessPlanner
+        self._store = store
+        self._keys = keys
+        self._vlists = vlists
+        buckets: List = []
+        solo = range(len(keys))
+        if len(keys) > 1 and store._optimizer is None and \
+                all(isinstance(v[0], NDArray) for v in vlists):
+            buckets, solo = store._bucket_plans(
+                keys, [v[0] for v in vlists], reverse=True)
+        copies = max(len(v) for v in vlists) if vlists else 1
+        self._planner = ReadinessPlanner(buckets, list(solo), copies=copies)
+        self._pos_of_key = {k: i for i, k in enumerate(keys)}
+        self._results: Dict[int, object] = {}
+        self._snaps: Dict[int, List] = {}
+        self._launched: set = set()
+
+    def notify_key(self, key, copy: int = 0) -> None:
+        """Gradient for `key` (device copy `copy`) is final; launch any
+        unit this closes."""
+        pos = self._pos_of_key.get(_key(key))
+        if pos is None:
+            return
+        for u in self._planner.note(pos, copy):
+            self._launch(u)
+
+    def _unit_inputs(self, u: int) -> List:
+        """Snapshot of a unit's input buffers (the jax array OBJECTS, not
+        bare ids — holding the refs rules out id reuse after gc).
+        NDArray writes replace the underlying array object (`_set_jax`),
+        so an identity mismatch at drain time means some input was
+        rewritten after the unit launched (e.g. manual grad scaling
+        between backward and step()) and the launched exchange read a
+        stale value."""
+        kind, obj = self._planner.unit(u)
+        poss = obj.positions if kind == "bucket" else [obj]
+        return [v._jax for p in poss for v in self._vlists[p]]
+
+    def _wire_keys(self, u: int) -> List:
+        """Wire keys a unit's exchange may quantize under: the bucket's
+        CRC name (int8 bucket path) plus/or its member keys (per-key
+        quantize paths)."""
+        kind, obj = self._planner.unit(u)
+        if kind == "solo":
+            return [self._keys[obj]]
+        return [obj.name] + [self._keys[p] for p in obj.positions]
+
+    def _launch(self, u: int) -> None:
+        kind, obj = self._planner.unit(u)
+        gc = getattr(self._store, "_gc", None)
+        if gc is not None:
+            # error feedback makes a launch stateful: checkpoint the
+            # residuals it will consume so a RElaunch (stale session /
+            # rewritten input) first un-does the discarded payload's EF
+            # step instead of double-stepping the residual
+            wk = self._wire_keys(u)
+            if u in self._launched:
+                gc.rollback(wk)
+            else:
+                gc.checkpoint(wk)
+        self._launched.add(u)
+        self._snaps[u] = self._unit_inputs(u)
+        self._results[u] = self._store._exchange_unit(
+            kind, obj, self._keys, self._vlists)
+
+    def _inputs_unchanged(self, u: int) -> bool:
+        snap, cur = self._snaps[u], self._unit_inputs(u)
+        return len(snap) == len(cur) and \
+            all(a is b for a, b in zip(snap, cur))
+
+    def abort(self) -> None:
+        """Discard the session without committing anything: roll back the
+        error-feedback residuals every launched unit consumed and drop
+        the checkpoints.  Used when the exchange key set changed under an
+        armed session (e.g. a param unfrozen between steps) — the caller
+        falls back to a fresh serialized exchange."""
+        gc = getattr(self._store, "_gc", None)
+        if gc is not None:
+            for u in self._launched:
+                wk = self._wire_keys(u)
+                gc.rollback(wk)
+                gc.commit(wk)
+        self._launched.clear()
+        self._results.clear()
+        self._snaps.clear()
+
+    def drain(self) -> None:
+        """Launch every remaining unit, then commit all results."""
+        if self._planner.stale:
+            # values changed under launched exchanges: redo everything
+            self._results.clear()
+            for u in self._planner.all_units():
+                self._launch(u)
+        else:
+            for u in self._planner.pending():
+                self._launch(u)
+            for u in sorted(self._results):
+                # input rewritten since launch: the exchange read a stale
+                # value — relaunch from the current buffers (overlap
+                # degrades to serialized, never to wrong gradients)
+                if not self._inputs_unchanged(u):
+                    self._launch(u)
+        for u in sorted(self._results):
+            kind, obj = self._planner.unit(u)
+            self._store._commit_unit(kind, obj, self._results[u],
+                                     self._keys, self._vlists)
+        gc = getattr(self._store, "_gc", None)
+        if gc is not None:
+            for u in self._launched:
+                gc.commit(self._wire_keys(u))
+        self._launched.clear()
+        self._results.clear()
+        self._snaps.clear()
 
 
 class KVStoreLocal(KVStore):
@@ -395,6 +624,8 @@ class KVStoreICI(KVStoreLocal):
     def _cross_reduce_one(self, merged: NDArray) -> NDArray:
         """Cross-process allreduce of ONE locally merged value."""
         payload, orig_dtype = self._maybe_compress(merged._jax)
+        from ..engine import engine as _engine
+        _engine.count_wire_bytes(payload.size * payload.dtype.itemsize)
         out = self._cross_process_sum(payload)
         if orig_dtype is not None:
             out = out.astype(orig_dtype)
@@ -404,20 +635,122 @@ class KVStoreICI(KVStoreLocal):
                              merged.context.jax_device)
         return NDArray(out, ctx=merged.context)
 
+    def _wire_nbytes(self, n_elems: int, itemsize: int,
+                     floating: bool = True) -> int:
+        gc = getattr(self, "_gc", None)
+        if gc is not None and gc.type == "2bit" and floating:
+            # the collective ships 2bit LEVELS full-width (±t/0 must sum
+            # exactly inside the allreduce) — only the PS TCP wire ships
+            # the packed n/4-byte format, so report honest bytes here
+            return int(n_elems) * int(itemsize)
+        return super()._wire_nbytes(n_elems, itemsize, floating)
+
+    # -- quantized collective (ISSUE 5: EQuARX-style int8 allreduce) -------
+    def _int8_active(self, x=None) -> bool:
+        gc = getattr(self, "_gc", None)
+        return gc is not None and gc.type == "int8" and \
+            (x is None or jnp.issubdtype(x.dtype, jnp.floating))
+
+    def _cross_sum_quantized(self, q, scales):
+        """AllReduce of the COMPACT payload: every process contributes its
+        (int8 codes, per-block scales); inside the jitted collective each
+        worker's shard is dequantized at its own scales, summed, and the
+        sum requantized at a fresh merged scale — so both directions of
+        the exchange stay int8-narrow on the wire (EQuARX's
+        dequant-sum-requant).  Returns the replicated (q_sum, scales_sum)
+        local shards."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..ops import quantization as _qops
+        mesh = self._ensure_mesh()
+        key = ("q8sum", q.shape, scales.shape)
+        fn = self._xsum_cache.get(key)
+        if fn is None:
+            fn = jax.jit(_qops._dequant_sum_requant_kernel,
+                         in_shardings=(NamedSharding(mesh, P("dp")),
+                                       NamedSharding(mesh, P("dp"))),
+                         out_shardings=(NamedSharding(mesh, P()),
+                                        NamedSharding(mesh, P())))
+            self._xsum_cache[key] = fn
+        def _stack(x):
+            shard = jax.device_put(x[None], self._home_dev)
+            return jax.make_array_from_single_device_arrays(
+                (self._size,) + tuple(x.shape),
+                NamedSharding(mesh, P("dp")), [shard])
+        from ..engine import engine as _engine
+        _engine.count_dispatch()
+        qo, so = fn(_stack(q), _stack(scales))
+        return qo.addressable_data(0), so.addressable_data(0)
+
+    def _exchange_flat(self, wire_key, flat: NDArray) -> NDArray:
+        """Full int8-compressed exchange of one FLAT float payload:
+        quantize (error feedback, residual keyed by `wire_key`) →
+        compact allreduce → dequantize once."""
+        from ..engine import engine as _engine
+        gc = self._gc
+        x = flat._jax
+        _engine.count_wire_bytes(gc.wire_nbytes(x.size))
+        if self._size <= 1:
+            _engine.count_dispatch()
+            out = gc.quantize(wire_key, x)     # fused roundtrip
+        else:
+            _engine.count_dispatch()
+            q, scales = gc.compress_device(wire_key, x)
+            qo, so = self._cross_sum_quantized(q, scales)
+            _engine.count_dispatch()
+            out = gc.decompress_device((qo, so), x.size).astype(x.dtype)
+        out = jax.device_put(out, flat.context.jax_device)
+        return NDArray(out, ctx=flat.context)
+
     def _reduce(self, values: List[NDArray], key=None) -> NDArray:
+        if key is not None and isinstance(values[0], NDArray) and \
+                self._int8_active(values[0]._jax):
+            merged = self._reduce_local(values)
+            flat = NDArray(merged._jax.reshape(-1), ctx=merged.context)
+            out = self._exchange_flat(key, flat)
+            return NDArray(out._jax.reshape(merged.shape),
+                           ctx=merged.context)
         merged = super()._reduce(values, key=key)
         if self._size > 1:
             merged = self._cross_reduce_one(merged)
         return merged
 
+    def _exchange_bucket(self, b, members: List[NDArray]) -> NDArray:
+        """One fusion bucket's exchange: concat the locally merged member
+        payloads, cross the wire (int8-quantized under the bucket's name,
+        plain collective, or local passthrough), return the flat result.
+        Shared by the serialized batched exchange (:meth:`_reduce_many`)
+        and the overlap session (:meth:`_exchange_unit`)."""
+        flat = jnp.concatenate([m._jax.reshape(-1) for m in members])
+        from ..engine import engine as _engine
+        _engine.count_dispatch()   # the concat launch
+        ctx = members[0].context
+        if self._int8_active(flat):
+            return self._exchange_flat(b.name, NDArray(flat, ctx=ctx))
+        if self._size > 1:
+            return self._cross_reduce_one(NDArray(flat, ctx=ctx))
+        out = NDArray(flat, ctx=ctx)   # local / non-float: no wire to cross
+        self._note_wire_value(out)
+        return out
+
     def _reduce_many(self, keys, vlists) -> List[NDArray]:
-        """Batched exchange: local per-key reduce (+ optional 2-bit
-        quantize), then the cross-process allreduce coalesced into fusion
-        buckets — O(#buckets) collectives per step instead of O(#keys)."""
-        merged = [KVStore._reduce(self, v, key=k)
-                  for k, v in zip(keys, vlists)]
-        if self._size <= 1:
-            return merged
+        """Batched exchange: local per-key reduce (+ optional error-
+        feedback quantize), then the cross-process allreduce coalesced
+        into fusion buckets — O(#buckets) collectives per step instead of
+        O(#keys).  With int8 compression each bucket's payload is
+        quantized per-bucket (residual keyed by the bucket name) and
+        allreduced compact."""
+        int8 = self._int8_active()
+        if int8:
+            # local merge only: quantization happens per exchange payload
+            # (bucket or solo), not per key
+            merged = [self._reduce_local(v) for v in vlists]
+        else:
+            merged = [KVStore._reduce(self, v, key=k)
+                      for k, v in zip(keys, vlists)]
+            if self._size <= 1:
+                for m in merged:
+                    self._note_wire_value(m)
+                return merged
         buckets = []
         solo = range(len(keys))
         if len(keys) > 1 and self._optimizer is None:
@@ -425,19 +758,40 @@ class KVStoreICI(KVStoreLocal):
             if eligible:
                 buckets, solo = self._bucket_plans(keys, merged)
         for b in buckets:
-            flat = jnp.concatenate(
-                [merged[p]._jax.reshape(-1) for p in b.positions])
-            from ..engine import engine as _engine
-            _engine.count_dispatch()   # the concat launch
-            out = self._cross_reduce_one(NDArray(flat,
-                                                 ctx=merged[b.positions[0]]
-                                                 .context))
+            out = self._exchange_bucket(b, [merged[p] for p in b.positions])
             for p, off, size, shape in b.slices():
                 piece = out._jax[off:off + size].reshape(shape)
                 merged[p] = NDArray(piece, ctx=merged[p].context)
         for p in solo:
-            merged[p] = self._cross_reduce_one(merged[p])
+            if int8 and isinstance(merged[p], NDArray) and \
+                    jnp.issubdtype(merged[p]._jax.dtype, jnp.floating):
+                # _reduce's int8 path: flatten → _exchange_flat → reshape
+                merged[p] = self._reduce([merged[p]], key=keys[p])
+            elif self._size > 1 and isinstance(merged[p], NDArray):
+                merged[p] = self._cross_reduce_one(merged[p])
+            else:
+                self._note_wire_value(merged[p])
         return merged
+
+    def _exchange_unit(self, kind, obj, keys, vlists):
+        """Overlap-session unit launch: the bucket path concatenates,
+        exchanges (quantized when int8 compression is on), and returns
+        the split pieces; solo keys ride the per-key exchange."""
+        if kind == "solo":
+            m = self._reduce(vlists[obj], key=keys[obj])
+            if self._size <= 1 and not (isinstance(m, NDArray) and
+                                        self._int8_active(m._jax)):
+                self._note_wire_value(m)
+            return m
+        merged = [self._reduce_local(vlists[p]) if self._int8_active()
+                  else KVStore._reduce(self, vlists[p], key=keys[p])
+                  for p in obj.positions]
+        out = self._exchange_bucket(obj, merged)
+        pieces = []
+        for (_p, off, size, shape), m in zip(obj.slices(), merged):
+            pieces.append(NDArray(out._jax[off:off + size].reshape(shape),
+                                  ctx=m.context))
+        return pieces
 
     def _barrier(self):
         if self._size > 1:
@@ -809,10 +1163,50 @@ class KVStoreDistAsync(KVStore):
         return len(keys) > 1 and self._optimizer is None and \
             self._updater is None
 
+    def begin_exchange(self, keys, vlists):
+        """No overlap on the PS store: its RPCs are host-blocking socket
+        roundtrips — launching them mid-backward would serialize backward
+        behind the wire instead of hiding it.  The Trainer falls back to
+        the batched push/pull."""
+        return None
+
+    def _wire_gc(self):
+        """The compact-wire compressor, when one is installed (2bit/int8;
+        bf16 is a collective-path cast with no numpy dtype, so the PS
+        wire ships it full-width)."""
+        return getattr(self, "_gc", None)
+
+    def _push_payload(self, wire_key, nd_value):
+        """One PUSH: compressed wire tuple (payload + scales + dtype tag,
+        dequantized server-side) or the full-width numpy array.
+
+        Keys over the big-array bound are NOT compressed: INIT slices
+        them across every server (``key::partN`` pieces), so a compact
+        whole-key PUSH would target a server that only holds parts and
+        fail 'not initialized' — they take the sharded full-width path
+        instead (the bound already marks them as bandwidth-amortized)."""
+        from ..engine import engine as _engine
+        gc = self._wire_gc()
+        if gc is not None and isinstance(nd_value, NDArray) and \
+                jnp.issubdtype(nd_value._jax.dtype, jnp.floating) and \
+                self._shard_plan(int(nd_value.size)) is None:
+            wire = gc.encode(wire_key, nd_value._jax)
+            _engine.count_wire_bytes(gc.wire_nbytes(nd_value.size))
+            self._rpc("PUSH", wire_key, wire)
+            return
+        arr = nd_value.asnumpy()
+        _engine.count_wire_bytes(arr.nbytes)
+        self._send_np("PUSH", wire_key, arr)
+
     def push(self, key, value, priority=0):
         keys, values = self._normalize(key, value)
         vlists = [v if isinstance(v, (list, tuple)) else [v] for v in values]
-        merged = [self._reduce(v, key=k) for k, v in zip(keys, vlists)]
+        # local device merge only — wire compression (error feedback,
+        # residual per wire key) happens at _push_payload, so the payload
+        # is quantized exactly once
+        merged = [self._reduce_local(v) if self._wire_gc() is not None
+                  else self._reduce(v, key=k)
+                  for k, v in zip(keys, vlists)]
         buckets = []
         solo = range(len(keys))
         if self._buckets_active(keys):
@@ -823,18 +1217,20 @@ class KVStoreDistAsync(KVStore):
         for b in buckets:
             # concatenate ON DEVICE, then ONE host transfer per bucket —
             # a per-key asnumpy loop would reintroduce O(#keys) syncs
-            flat = _np.asarray(jnp.concatenate(
-                [merged[p]._jax.reshape(-1) for p in b.positions]))
+            flat = jnp.concatenate(
+                [merged[p]._jax.reshape(-1) for p in b.positions])
             if b.name not in self._bucket_inited:
                 # zero-init so the server's accumulator contract (pull =
                 # init + sum of pushes) returns exactly the pushed sums
-                self._send_np("INIT", b.name, _np.zeros_like(flat))
+                self._send_np("INIT", b.name,
+                              _np.zeros((b.total,),
+                                        _np.dtype(str(flat.dtype))))
                 self._bucket_inited.add(b.name)
             # one wire op per bucket; the SEQ-tagged retry layer now
             # replays buckets, not keys
-            self._send_np("PUSH", b.name, flat)
+            self._push_payload(b.name, NDArray(flat))
         for p in solo:
-            self._send_np("PUSH", keys[p], merged[p].asnumpy())
+            self._push_payload(keys[p], merged[p])
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs = self._normalize(key, out)

@@ -361,10 +361,22 @@ class KVStoreServer:
             return True, None
         if cmd == "PUSH":
             _, key, grad = msg
+            # numpy-only codec: the PUSH hot path must not pull in the
+            # device kernel stack (jax/ops) gradient_compression carries
+            from .wire_codec import decode_wire, is_wire_payload
+            if is_wire_payload(grad):
+                # compact wire format (payload + scales + dtype tag):
+                # dequantize BEFORE the updater/accumulator sees it — the
+                # optimizer contract is full-width gradients (the worker
+                # already paid the quantization error via error feedback)
+                grad = decode_wire(grad)
             with self._lock_of(key):
                 stored = self._store.get(key)
                 if stored is None:
                     return False, "key %r not initialized" % (key,)
+                if grad.shape != stored.shape and \
+                        grad.size == stored.size:
+                    grad = grad.reshape(stored.shape)
                 if self._updater is not None:
                     # async contract: apply THIS worker's gradient now
                     self._updater(key, grad, stored)

@@ -1,0 +1,104 @@
+"""Host-side gradient wire codec (numpy only — no jax, no ops registry).
+
+The dist_async TCP path ships compressed gradients as compact picklable
+``QGRAD`` tuples; the parameter server decodes them BEFORE its
+updater/accumulator sees the value (the optimizer contract is full-width
+gradients).  This module is deliberately free of jax imports so the
+server's PUSH hot path never drags in the device kernel stack — the
+jitted kernels live in :mod:`mxnet_tpu.ops.quantization`, and
+:mod:`.gradient_compression` (which owns residual state) re-exports
+these helpers for compatibility.
+
+Formats (see docs/ARCHITECTURE.md "Gradient wire format"):
+  int8:  ``(QGRAD, 'int8', shape, dtype, n, q_bytes, scales_f32)``
+  2bit:  ``(QGRAD, '2bit', shape, dtype, n, words_u32, threshold)``
+
+The packed 2-bit layout (16 codes per uint32 word, code i at bits
+[2i, 2i+1], 00=zero 01=-t 10=+t) is bit-compatible with the device pack
+(`ops.quantization.pack_2bit_words`); the parity test pins it.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = ["is_wire_payload", "encode_wire", "decode_wire",
+           "pack_2bit", "unpack_2bit"]
+
+_WIRE_TAG = "QGRAD"
+
+
+def is_wire_payload(obj) -> bool:
+    return isinstance(obj, tuple) and len(obj) >= 2 and obj[0] == _WIRE_TAG
+
+
+def encode_wire(mode: str, shape, dtype, payload) -> tuple:
+    """Build the compact picklable wire tuple for one pushed value.
+
+    int8:  ``(QGRAD, 'int8', shape, dtype, n, q_bytes, scales_f32)``
+    2bit:  ``(QGRAD, '2bit', shape, dtype, n, words_u32, threshold)``
+    """
+    mode = str(mode)
+    shape = tuple(int(s) for s in shape)
+    n = 1
+    for s in shape:
+        n *= s
+    if mode == "int8":
+        q, scales = payload
+        return (_WIRE_TAG, "int8", shape, str(dtype), n,
+                _np.asarray(q, _np.int8).tobytes(),
+                _np.asarray(scales, _np.float32))
+    if mode == "2bit":
+        words, threshold = payload
+        return (_WIRE_TAG, "2bit", shape, str(dtype), n,
+                _np.asarray(words, _np.uint32), float(threshold))
+    raise ValueError("unknown gradient wire mode %r" % (mode,))
+
+
+def decode_wire(obj) -> _np.ndarray:
+    """Dequantize a wire tuple back to a full-width numpy array (server
+    side, before the updater / accumulator sees it)."""
+    if not is_wire_payload(obj):
+        raise ValueError("not a QGRAD wire payload: %r" % (type(obj),))
+    _, mode, shape, dtype, n = obj[:5]
+    if mode == "int8":
+        q = _np.frombuffer(obj[5], dtype=_np.int8).astype(_np.float32)
+        scales = _np.asarray(obj[6], _np.float32)
+        block = q.size // max(1, scales.size)
+        flat = (q.reshape(-1, block) * scales[:, None]).reshape(-1)[:n]
+    elif mode == "2bit":
+        flat = unpack_2bit(obj[5], n, obj[6])
+    else:
+        raise ValueError("unknown gradient wire mode %r" % (mode,))
+    return flat.astype(_np.dtype(dtype)).reshape(shape)
+
+
+def pack_2bit(levels: _np.ndarray, threshold: float) -> _np.ndarray:
+    """Pack ±t/0 levels into the 2-bit wire format: 16 codes per uint32
+    word, code i of a word at bits [2i, 2i+1], 00=zero 01=-t 10=+t
+    (reference Quantize2BitImpl packs 16 values per float32 word; the
+    in-word bit order is pinned by the roundtrip test)."""
+    flat = _np.asarray(levels, _np.float32).ravel()
+    codes = _np.where(flat > 0, 2, _np.where(flat < 0, 1, 0)).astype(
+        _np.uint32)
+    pad = (-len(codes)) % 16
+    if pad:
+        codes = _np.concatenate([codes, _np.zeros(pad, _np.uint32)])
+    words = codes.reshape(-1, 16)
+    out = _np.zeros(words.shape[0], _np.uint32)
+    for i in range(16):
+        out |= words[:, i] << (2 * i)
+    return out
+
+
+def unpack_2bit(words: _np.ndarray, n: int, threshold: float,
+                dtype=_np.float32) -> _np.ndarray:
+    """Inverse of pack_2bit: first `n` codes back to ±threshold/0."""
+    words = _np.asarray(words, _np.uint32)
+    codes = _np.zeros((len(words), 16), _np.uint32)
+    for i in range(16):
+        codes[:, i] = (words >> (2 * i)) & 0x3
+    codes = codes.ravel()[:n]
+    out = _np.zeros(n, dtype)
+    out[codes == 2] = threshold
+    out[codes == 1] = -threshold
+    return out

@@ -942,6 +942,10 @@ class Module(BaseModule):
                     tgt._set_jax(tgt._jax + g.astype(tgt.dtype))
                 else:
                     tgt._set_jax(g.astype(tgt.dtype))
+                    # overlap scheduling (ISSUE 5): each gradient write is
+                    # a readiness event for the bucketed exchange
+                    if tgt._grad_hook is not None:
+                        tgt._grad_hook()
             self._fast_grads = None
             return
         if out_grads is None:

@@ -81,7 +81,8 @@ def _put(value, ctx: Context) -> jax.Array:
 
 class NDArray:
     __slots__ = ("_chunk", "_index", "_vshape", "_cached", "_cached_version",
-                 "_grad", "_grad_req", "_ag_node", "__weakref__")
+                 "_grad", "_grad_req", "_ag_node", "_grad_hook",
+                 "__weakref__")
 
     # higher than numpy's so ndarray.__op__(numpy) defers to us
     __array_priority__ = 1000.0
@@ -100,6 +101,10 @@ class NDArray:
         self._grad: Optional[NDArray] = None
         self._grad_req: str = "null"
         self._ag_node = None          # autograd tape node that produced this
+        # overlap scheduling (ISSUE 5): set on a GRAD buffer, called the
+        # moment backward finalizes its value — lets the Trainer launch a
+        # fusion bucket's exchange mid-backward
+        self._grad_hook = None
 
     # ------------------------------------------------------------------
     # raw value access

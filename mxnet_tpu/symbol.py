@@ -1020,7 +1020,13 @@ class Executor:
         for name, arr in self.arg_dict.items():
             g = arr.grad
             if g is not None and name in self.grad_dict:
-                self.grad_dict[name]._set_jax(g._jax)
+                tgt = self.grad_dict[name]
+                tgt._set_jax(g._jax)
+                # overlap scheduling (ISSUE 5): this argument's gradient
+                # is final — let a registered consumer (bucketed exchange)
+                # launch without waiting for the remaining copies
+                if tgt._grad_hook is not None:
+                    tgt._grad_hook()
 
     @property
     def grad_arrays(self) -> List[NDArray]:

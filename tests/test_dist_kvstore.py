@@ -162,7 +162,7 @@ def test_trainer_step_matches_serial_reference(tmp_path):
 
 def test_gradient_compression_bf16(tmp_path):
     """set_gradient_compression({'type': 'bf16'}) casts the allreduce
-    payload to bfloat16; anything else warns (never a silent no-op)."""
+    payload to bfloat16; an unknown type raises (never a silent no-op)."""
     script = tmp_path / "w.py"
     script.write_text(textwrap.dedent(_PRELUDE) + textwrap.dedent("""
         import warnings
@@ -178,15 +178,19 @@ def test_gradient_compression_bf16(tmp_path):
         # bf16 has ~3 decimal digits: sum 3*v to bf16 precision
         np.testing.assert_allclose(out.asnumpy(), 3 * v, rtol=2e-2)
         assert out.dtype == np.float32          # decompressed on arrival
-        # '2bit' is now a real scheme (no warning); junk still warns
+        # '2bit'/'int8' are real schemes (no warning); junk RAISES
+        # (upstream MXNet contract — never a silent no-op)
         with warnings.catch_warnings(record=True) as w:
             warnings.simplefilter("always")
             kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+            kv.set_gradient_compression({"type": "int8"})
         assert not w, [str(x.message) for x in w]
-        with warnings.catch_warnings(record=True) as w:
-            warnings.simplefilter("always")
+        try:
             kv.set_gradient_compression({"type": "1bit"})
-        assert any("not supported" in str(x.message) for x in w), w
+        except ValueError as e:
+            assert "1bit" in str(e)
+        else:
+            raise AssertionError("unsupported type must raise ValueError")
         print("COMPRESS_OK rank", rank, flush=True)
     """))
     out = _launch(script)

@@ -227,6 +227,73 @@ def test_bucket_plan_excludes_sparse_and_respects_cap():
     assert not buckets and solo == [0, 1, 2, 3]
 
 
+def test_bucket_kb_zero_disables_bucketing_at_store(monkeypatch):
+    """ISSUE 5 satellite: MX_KVSTORE_BUCKET_KB=0 cleanly disables
+    bucketing (everything takes the per-key path — no degenerate 0-byte
+    buckets), the exchange stays correct, and flipping the knob
+    mid-process re-plans instead of serving a stale cached layout."""
+    from mxnet_tpu import kvstore
+    kv = kvstore.create("ici")
+    keys = [0, 1, 2]
+    arrays = [nd.array(np.arange(4, dtype=np.float32) + k) for k in keys]
+    kv.init(keys, [nd.zeros((4,)) for _ in keys])
+
+    monkeypatch.setenv("MX_KVSTORE_BUCKET_KB", "0")
+    buckets, solo = kv._bucket_plans(keys, arrays)
+    assert buckets == [] and list(solo) == keys
+    kv.push(keys, [[a] for a in arrays])
+    outs = [nd.zeros((4,)) for _ in keys]
+    kv.pull(keys, outs)
+    for k, o in zip(keys, outs):
+        np.testing.assert_allclose(o.asnumpy(),
+                                   np.arange(4, dtype=np.float32) + k)
+
+    # same store, knob back on: the plan cache keys on the capacity, so
+    # the bucketed layout comes back without a new store
+    monkeypatch.setenv("MX_KVSTORE_BUCKET_KB", "4096")
+    buckets, solo = kv._bucket_plans(keys, arrays)
+    assert len(buckets) == 1 and buckets[0].positions == keys
+    assert list(solo) == []
+    kv.push(keys, [[a] for a in arrays])
+    kv.pull(keys, outs)
+    for k, o in zip(keys, outs):
+        np.testing.assert_allclose(o.asnumpy(),
+                                   np.arange(4, dtype=np.float32) + k)
+
+
+def test_bucket_kb_zero_trainer_step(monkeypatch):
+    """A 2-device Trainer step with bucketing disabled still trains
+    (per-key exchange path) and matches the bucketed result."""
+    def run():
+        mx.random.seed(0)
+        ctxs = [mx.cpu(0), mx.cpu(1)]
+        net = nn.Dense(2, in_units=4)
+        net.initialize(mx.init.Xavier(), ctx=ctxs)
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1}, kvstore="device")
+        rng = np.random.RandomState(0)
+        X = rng.randn(8, 4).astype(np.float32)
+        Y = rng.randn(8, 2).astype(np.float32)
+        loss_fn = gluon.loss.L2Loss()
+        for _ in range(2):
+            with autograd.record():
+                for ctx, sl in zip(ctxs, (slice(0, 4), slice(4, None))):
+                    loss_fn(net(nd.array(X[sl], ctx=ctx)),
+                            nd.array(Y[sl], ctx=ctx)).backward()
+            tr.step(batch_size=8)
+        return {k: v.data(ctxs[0]).asnumpy()
+                for k, v in net.collect_params().items()}
+
+    monkeypatch.setenv("MX_KVSTORE_BUCKET_KB", "0")
+    unbucketed = run()
+    monkeypatch.setenv("MX_KVSTORE_BUCKET_KB", "4096")
+    bucketed = run()
+    assert set(unbucketed) == set(bucketed)
+    for k in unbucketed:
+        np.testing.assert_allclose(unbucketed[k], bucketed[k],
+                                   rtol=1e-6, atol=1e-6)
+
+
 def _free_port():
     s = socket.socket()
     s.bind(("", 0))

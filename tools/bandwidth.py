@@ -6,8 +6,15 @@ store type.  Here the interesting axes are the collective stores (one
 jitted reduce; ICI on real hardware, host RAM on the fake mesh) and the
 dist_async TCP parameter server.
 
+ISSUE 5 adds *wire accounting*: every exchange notes the bytes its payload
+occupies in its wire representation (compressed int8/2-bit codes+scales,
+bf16 cast, or full width) on ``engine.wire_bytes``; this harness reports
+the measured bytes-per-step and — with ``--compare-compress`` — the
+reduction factor vs an uncompressed fp32 baseline run in the same process
+(the ISSUE 5 acceptance gate: int8 must move >= 3.5x fewer bytes).
+
 Run:  python tools/bandwidth.py [--store local|device|ici] [--mb 64]
-      [--iters 10] [--compress 2bit|bf16]
+      [--iters 10] [--compress 2bit|int8|bf16] [--compare-compress]
 (dist_async needs `tools/launch.py -n W -s 1 -- python tools/bandwidth.py
  --store dist_async`.)
 """
@@ -21,45 +28,70 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
+def _measure(store, compress, mb, iters, key="x"):
+    """One timed pushpull loop; returns (GiB/s, wire bytes per step)."""
+    import numpy as np
+    from mxnet_tpu import nd, kvstore
+    from mxnet_tpu.engine import engine
+
+    kv = kvstore.create(store)
+    if compress:
+        params = {"type": compress}
+        if compress == "2bit":
+            params["threshold"] = 0.5
+        kv.set_gradient_compression(params)
+    n = int(mb * (1 << 20) / 4)
+    payload = nd.array(np.random.RandomState(0).rand(n).astype(np.float32))
+    out = nd.zeros((n,))
+    kv.init(key, nd.zeros((n,)))
+    kv.pushpull(key, payload, out=out)          # warm (compile/connect)
+    out.wait_to_read()
+    w0 = engine.wire_bytes
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        kv.pushpull(key, payload, out=out)
+    out.wait_to_read()
+    dt = time.perf_counter() - t0
+    wire_per_step = (engine.wire_bytes - w0) / iters
+    moved = 2 * mb * iters / 1024.0              # push + pull, GiB
+    return kv, round(moved / dt, 3), int(wire_per_step)
+
+
 def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--store", default="local")
     p.add_argument("--mb", type=float, default=64.0)
     p.add_argument("--iters", type=int, default=10)
     p.add_argument("--compress", default=None)
+    p.add_argument("--compare-compress", action="store_true",
+                   help="also run an uncompressed fp32 baseline and "
+                   "report the measured wire-bytes reduction factor")
     p.add_argument("--cpu", action="store_true",
                    help="pin the CPU backend (no TPU probe)")
     args = p.parse_args()
     if args.cpu:
         os.environ.setdefault("MX_FORCE_CPU", "1")
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    import numpy as np
-    import mxnet_tpu as mx
-    from mxnet_tpu import nd, kvstore
+    import mxnet_tpu as mx   # noqa: F401  (backend init)
 
-    kv = kvstore.create(args.store)
-    if args.compress:
-        kv.set_gradient_compression({"type": args.compress,
-                                     "threshold": 0.5})
-    n = int(args.mb * (1 << 20) / 4)
-    payload = nd.array(np.random.RandomState(0).rand(n).astype(np.float32))
-    out = nd.zeros((n,))
-    kv.init("x", nd.zeros((n,)))
-    kv.pushpull("x", payload, out=out)          # warm (compile/connect)
-    out.wait_to_read()
-    t0 = time.perf_counter()
-    for _ in range(args.iters):
-        kv.pushpull("x", payload, out=out)
-    out.wait_to_read()
-    dt = time.perf_counter() - t0
-    moved = 2 * args.mb * args.iters / 1024.0    # push + pull, GiB
-    print(json.dumps({
+    kv, gbps, wire = _measure(args.store, args.compress, args.mb, args.iters)
+    report = {
         "metric": "kvstore_pushpull_bandwidth_gb_per_sec",
-        "value": round(moved / dt, 3), "unit": "GiB/s",
+        "value": gbps, "unit": "GiB/s",
         "store": kv.type, "mb_per_tensor": args.mb, "iters": args.iters,
         "compression": args.compress,
+        "wire_bytes_per_step": wire,
         "num_workers": kv.num_workers,
-    }))
+    }
+    if args.compare_compress:
+        # fresh store + key: independent residual state, same payload
+        _, base_gbps, base_wire = _measure(args.store, None, args.mb,
+                                           args.iters, key="x_fp32")
+        report["fp32_wire_bytes_per_step"] = base_wire
+        report["fp32_gb_per_sec"] = base_gbps
+        report["wire_reduction_vs_fp32"] = round(
+            base_wire / max(1, wire), 3)
+    print(json.dumps(report))
 
 
 if __name__ == "__main__":

@@ -60,6 +60,54 @@ for rank in (0, 1):
 print("chaos_smoke: resumed params match the uninterrupted run")
 EOF
 
+echo "== chaos_smoke: 3-step int8-compressed overlap-scheduled fit"
+XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+MX_GRAD_COMPRESS=int8 MX_EXCHANGE_OVERLAP=1 \
+"$PY" - "$REPO" <<'EOF'
+import sys
+sys.path.insert(0, sys.argv[1])
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.engine import engine
+
+# 2-device DP fit through the int8-quantized, overlap-scheduled exchange:
+# grad hooks fire during backward, bucket collectives launch early, drain
+# commits before the fused update — 3 steps must train (loss drops) and
+# the wire must carry compressed bytes.
+mx.random.seed(0)
+ctxs = [mx.cpu(0), mx.cpu(1)]
+net = gluon.nn.Dense(4, in_units=8)
+net.initialize(mx.init.Xavier(), ctx=ctxs)
+trainer = gluon.Trainer(net.collect_params(), "sgd",
+                        {"learning_rate": 0.1}, kvstore="device")
+loss_fn = gluon.loss.L2Loss()
+rng = np.random.RandomState(0)
+X = rng.randn(16, 8).astype(np.float32)
+W = rng.randn(8, 4).astype(np.float32)
+Y = X.dot(W)
+losses = []
+w0 = engine.wire_bytes
+for step in range(3):
+    half = len(X) // 2
+    tot = 0.0
+    with autograd.record():
+        for ctx, sl in zip(ctxs, (slice(0, half), slice(half, None))):
+            loss = loss_fn(net(nd.array(X[sl], ctx=ctx)),
+                           nd.array(Y[sl], ctx=ctx))
+            loss.backward()
+            tot += float(loss.mean().asnumpy())
+    trainer.step(batch_size=len(X))
+    losses.append(tot)
+wire = engine.wire_bytes - w0
+assert all(np.isfinite(l) for l in losses), losses
+assert losses[-1] < losses[0], losses
+assert trainer._kvstore is not None and trainer._kvstore._gc.type == "int8"
+assert 0 < wire, wire
+print("compressed_fit_smoke: PASS losses=%s wire_bytes=%d"
+      % (["%.4f" % l for l in losses], wire))
+EOF
+
 echo "== chaos_smoke: static-analysis lane (tools/lint.sh)"
 bash "$REPO/tools/lint.sh"
 

@@ -28,6 +28,56 @@ os.environ.setdefault("MX_FORCE_CPU", "1")
 # chunk, bucket exchange, metric accumulate) — but never O(#params)
 STEP_BUDGET = 4
 METRIC_BUDGET = 2
+# one overlap-scheduled, int8-compressed bucket exchange: concat + fused
+# quantize-allreduce-dequantize per bucket — never a per-key quantize
+EXCHANGE_BUDGET = 4
+
+
+def run_exchange(n_keys=40):
+    """ISSUE 5 acceptance: a batched exchange with int8 compression AND
+    overlap scheduling dispatches O(#buckets), not O(#keys) — compression
+    must ride inside the fused bucket dispatch (per-bucket residual), and
+    the overlap session's unit launches are the same dispatches the
+    serialized path would make, just earlier."""
+    import numpy as np
+    from mxnet_tpu import kvstore, nd
+    from mxnet_tpu.engine import engine
+
+    kv = kvstore.create("ici")   # single-process: collective is a no-op,
+    kv.set_gradient_compression({"type": "int8"})   # quantize path isn't
+    keys = list(range(n_keys))
+    grads = [nd.array(np.random.RandomState(k).randn(64).astype("f4"))
+             for k in keys]
+    for k, g in zip(keys, grads):
+        kv.init(k, nd.zeros_like(g))
+
+    # serialized batched push/pull (what Trainer does without overlap)
+    kv.push(keys, [[g] for g in grads])
+    c0 = engine.dispatch_count
+    kv.push(keys, [[g] for g in grads])
+    kv.pull(keys, [[g] for g in grads])
+    batched_d = engine.dispatch_count - c0
+
+    # overlap session: notify every key, drain (what backward's hooks do)
+    sess = kv.begin_exchange(keys, [[g] for g in grads])
+    for k in keys:
+        sess.notify_key(k)
+    sess.drain()
+    sess = kv.begin_exchange(keys, [[g] for g in grads])
+    c1 = engine.dispatch_count
+    for k in keys:
+        sess.notify_key(k)
+    sess.drain()
+    overlap_d = engine.dispatch_count - c1
+    return {
+        "keys": n_keys,
+        "batched_exchange_dispatches": batched_d,
+        "overlap_exchange_dispatches": overlap_d,
+        "exchange_budget": EXCHANGE_BUDGET,
+        "ok": bool(batched_d <= EXCHANGE_BUDGET
+                   and overlap_d <= EXCHANGE_BUDGET
+                   and batched_d < n_keys and overlap_d < n_keys),
+    }
 
 
 def run(steps=3, hidden_layers=6, hidden=16):
@@ -91,8 +141,20 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=3)
     ap.add_argument("--layers", type=int, default=6)
+    ap.add_argument("--compress", default=None,
+                    help="run the trainer fit under MX_GRAD_COMPRESS")
+    ap.add_argument("--overlap", action="store_true",
+                    help="run the trainer fit under MX_EXCHANGE_OVERLAP=1")
     args = ap.parse_args()
+    if args.compress:
+        os.environ["MX_GRAD_COMPRESS"] = args.compress
+    if args.overlap:
+        os.environ["MX_EXCHANGE_OVERLAP"] = "1"
     report = run(steps=args.steps, hidden_layers=args.layers)
+    report["compress"] = args.compress
+    report["overlap"] = bool(args.overlap)
+    report["exchange"] = run_exchange()
+    report["ok"] = bool(report["ok"] and report["exchange"]["ok"])
     print(json.dumps(report, indent=2))
     sys.exit(0 if report["ok"] else 1)
 
