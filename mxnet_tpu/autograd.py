@@ -390,7 +390,12 @@ def _run_backward(heads, head_grads, retain_graph, write_leaves=True,
             if hook is not None:
                 # 'write' only: an accumulating grad ('add') is not final
                 # until the caller says so — overlap consumers drain it at
-                # step time instead
+                # step time instead.  The hook runs on WHATEVER thread is
+                # executing this backward (incl. XLA host-callback
+                # threads), so hook targets may only touch state guarded
+                # for cross-thread access — mxlint's concurrency pass
+                # models every `._grad_hook = ...` target as a thread
+                # root and enforces exactly that
                 hook()
 
     def _note_consumed(node):

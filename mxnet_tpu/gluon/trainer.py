@@ -13,6 +13,7 @@ step over a Mesh (mxnet_tpu.parallel.TrainStep) but keeps this class's API.
 from __future__ import annotations
 
 import functools
+import threading
 from typing import Dict, List, Optional
 
 from ..base import MXNetError, get_env
@@ -71,7 +72,25 @@ class Trainer:
         self._kvstore = None
         self._update_on_kvstore = None
         self._params_to_init: List[Parameter] = []
+        # _grad_hook callbacks fire from whatever thread runs backward
+        # (incl. XLA host-callback threads); every handoff of the armed
+        # overlap session goes through this lock so a hook never sees a
+        # half-swapped session (ISSUE 5 overlap scheduling)
+        self._hook_lock = threading.Lock()
         self._reset_kvstore()
+
+    # pickling: the optimizer's param_dict reaches this Trainer through
+    # Parameter._trainer, and save_states() pickles the optimizer — a
+    # raw Lock cannot ride along, so drop it and re-create on load (a
+    # fresh lock is correct: no hooks can be armed in a new process)
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_hook_lock"] = None
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._hook_lock = threading.Lock()
 
     # -- setup -------------------------------------------------------------
     def _check_contexts(self):
@@ -237,7 +256,8 @@ class Trainer:
         (reverse-parameter-order buckets, so late layers — produced first
         — go out first).  Results commit at drain (_allreduce_grads), so
         gradients read between backward and step() are untouched."""
-        self._exchange_session = None
+        with self._hook_lock:
+            self._exchange_session = None
         self._armed_set = None
         if not self._overlap or self._kvstore is None:
             return
@@ -248,7 +268,8 @@ class Trainer:
         if sess is None:        # transport cannot overlap (dist_async)
             self._overlap = False
             return
-        self._exchange_session = sess
+        with self._hook_lock:
+            self._exchange_session = sess
         self._armed_set = (idxs, grad_lists)
         for p, i in enumerate(idxs):
             for d, g in enumerate(grad_lists[p]):
@@ -268,14 +289,19 @@ class Trainer:
                 for l, al in zip(grad_lists, a_lists))
 
     def _on_grad_ready(self, i, d):
-        sess = self._exchange_session
+        with self._hook_lock:
+            sess = self._exchange_session
         if sess is not None:
+            # notify OUTSIDE the lock: the session may launch a bucket
+            # collective here, and the arm/drain paths must not wait on
+            # that dispatch just to swap the session pointer
             sess.notify_key(i, d)
 
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
-        sess = self._exchange_session
+        with self._hook_lock:
+            sess = self._exchange_session
         if sess is not None and not self._armed_set_current():
             # the exchange set changed under the armed session (a param
             # frozen/unfrozen or re-initialized between steps): its plan
@@ -283,7 +309,8 @@ class Trainer:
             # state and fall through to a fresh session/serialized path
             sess.abort()
             sess = None
-            self._exchange_session = None
+            with self._hook_lock:
+                self._exchange_session = None
         if sess is None and self._overlap:
             # overlap enabled but no session was armed before this
             # backward (first step, or recovering from a fallback): run
@@ -299,7 +326,8 @@ class Trainer:
         if sess is not None:
             # overlap path: bucket exchanges already launched during
             # backward — launch stragglers and commit the results
-            self._exchange_session = None
+            with self._hook_lock:
+                self._exchange_session = None
             with _profiler.annotate("trainer.allreduce"):
                 sess.drain()
             self._arm_exchange()

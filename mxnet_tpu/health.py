@@ -164,6 +164,11 @@ class Watchdog:
             min(1.0, max(0.05, self.timeout / 2.0))
         self.on_timeout = on_timeout
         self.logger = logger or logging
+        # _last is petted by the fit loop (main thread) and read by the
+        # watchdog thread every poll tick; the lock makes arm/disarm
+        # atomic with the expiry read (a torn suspend()+pet() pair must
+        # never be observed as armed-with-stale-stamp)
+        self._lock = threading.Lock()
         self._last: Optional[float] = None   # None = not yet armed
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -171,15 +176,18 @@ class Watchdog:
 
     def pet(self) -> None:
         """Mark progress: the current step window restarts now."""
-        self._last = _fault.now()
+        with self._lock:
+            self._last = _fault.now()
 
     def suspend(self) -> None:
         """Disarm until the next pet() (long known-slow phases: eval,
         checkpoint restore)."""
-        self._last = None
+        with self._lock:
+            self._last = None
 
     def expired(self) -> bool:
-        last = self._last
+        with self._lock:
+            last = self._last
         return last is not None and (_fault.now() - last) > self.timeout
 
     def check(self) -> bool:

@@ -293,17 +293,23 @@ class ImageDetIter(DataIter):
         if self._shuffle:
             self._rng.shuffle(self._order)
         self._cursor = 0
-        self._epoch += 1
+        with self._lock:
+            # the pool workers read _epoch for their per-record seeds;
+            # next()'s map() is synchronous so no fetch is in flight
+            # here, but the lock makes the publication explicit instead
+            # of relying on that calling discipline
+            self._epoch += 1
 
     def _load_one(self, key):
         from .. import recordio as rio
+        with self._lock:
+            epoch = self._epoch
+            payload = self._record.read_idx(int(key))
         # deterministic per (seed, record, epoch) no matter which worker
         # thread picks the record up
         _TL.rng = _np.random.RandomState(
-            (self._seed * 1000003 + int(key) * 9176 + self._epoch)
+            (self._seed * 1000003 + int(key) * 9176 + epoch)
             % (2 ** 31))
-        with self._lock:
-            payload = self._record.read_idx(int(key))
         header, img_bytes = rio.unpack(payload)
         img = imdecode(img_bytes)
         label = self._parse_label(_np.asarray(header.label))

@@ -48,6 +48,7 @@ import pickle
 import socket
 import socketserver
 import struct
+import sys
 import threading
 import time as _time
 from typing import Dict, Optional
@@ -155,7 +156,12 @@ class KVStoreServer:
         # shared virtual clock — N waiters each charging their tick would
         # run every deadline on that clock N times too fast
         self._vclock_pumper: Optional[int] = None
-        # liveness: rank -> last activity (monotonic seconds)
+        # liveness: rank -> last activity (monotonic seconds).  Written
+        # by every handler thread (touch) and read/re-stamped under the
+        # barrier wait; _seen_lock makes the pair atomic.  Lock order:
+        # _barrier_cv is taken FIRST when both are held (the barrier
+        # path touches liveness, never the reverse).
+        self._seen_lock = threading.Lock()
         self._last_seen: Dict[str, float] = {}
         # which clock regime each stamp was taken under: virtual-clock
         # stamps are meaningless against real monotonic (and vice
@@ -192,8 +198,9 @@ class KVStoreServer:
     def touch(self, client_id) -> None:
         if client_id is not None:
             rank = _rank_of(client_id)
-            self._last_seen[rank] = _fault.now()
-            self._seen_regime[rank] = _fault.is_virtual()
+            with self._seen_lock:
+                self._last_seen[rank] = _fault.now()
+                self._seen_regime[rank] = _fault.is_virtual()
 
     def _effective_workers(self) -> int:
         """Barrier quorum = configured workers minus evicted-stale ranks.
@@ -207,15 +214,15 @@ class KVStoreServer:
         regime = _fault.is_virtual()
         horizon = _fault.now() - stale
         evicted = 0
-        # list(): touch() inserts from other handler threads concurrently
-        for r, t in list(self._last_seen.items()):
-            if self._seen_regime.get(r, regime) != regime:
-                # stamped under the other clock: re-stamp as fresh now —
-                # never evict on an apples-to-oranges comparison
-                self._last_seen[r] = _fault.now()
-                self._seen_regime[r] = regime
-            elif t < horizon and r not in self._barrier_waiting:
-                evicted += 1
+        with self._seen_lock:   # atomic vs touch() in handler threads
+            for r, t in list(self._last_seen.items()):
+                if self._seen_regime.get(r, regime) != regime:
+                    # stamped under the other clock: re-stamp as fresh
+                    # now — never evict on an apples-to-oranges compare
+                    self._last_seen[r] = _fault.now()
+                    self._seen_regime[r] = regime
+                elif t < horizon and r not in self._barrier_waiting:
+                    evicted += 1
         return max(1, self._num_workers - evicted)
 
     # -- durability ---------------------------------------------------------
@@ -247,24 +254,40 @@ class KVStoreServer:
         with self._snapshot_lock:
             with self._global_lock:
                 locks = list(self._locks.values())
-            for lk in locks:       # quiesce in-flight per-key mutations
-                lk.acquire()
+            # quiesce in-flight per-key mutations — BOUNDED: a handler
+            # wedged mid-PUSH must cost us this snapshot, not wedge the
+            # snapshotting thread forever (the next mutation retries);
+            # real-time bound on purpose, the holders are real threads
+            acquired = []
+            for lk in locks:
+                if lk.acquire(timeout=30.0):
+                    acquired.append(lk)
+                    continue
+                for got in acquired:
+                    got.release()
+                print("kvstore server: snapshot skipped - a per-key "
+                      "lock stayed held for 30s", file=sys.stderr)
+                return
             try:
                 with self._replay_lock:
                     replay = {cid: (ent[0], ent[2])
                               for cid, ent in self._replay.items()
                               if ent[1].is_set()}
-                with self._global_lock:   # fence vs concurrent INIT insert
+                with self._global_lock:
+                    # one fence for everything SET_OPT/INIT mutate under
+                    # it: the store dict and the installed optimizer
                     items = list(self._store.items())
+                    opt_blob = self._opt_blob
+                    updater = self._updater
                 blob = {"store": {k: _np.array(v, copy=True)
                                   for k, v in items},
-                        "opt_blob": self._opt_blob,
-                        "opt_states": (self._updater.inner.get_states(False)
-                                       if self._updater is not None
+                        "opt_blob": opt_blob,
+                        "opt_states": (updater.inner.get_states(False)
+                                       if updater is not None
                                        else None),
                         "replay": replay}
             finally:
-                for lk in locks:
+                for lk in acquired:
                     lk.release()
             tmp = "%s.tmp.%d" % (path, os.getpid())
             with open(tmp, "wb") as f:
@@ -370,6 +393,13 @@ class KVStoreServer:
                 # optimizer contract is full-width gradients (the worker
                 # already paid the quantization error via error feedback)
                 grad = decode_wire(grad)
+            with self._global_lock:
+                # snapshot the updater OUTSIDE the per-key lock (same
+                # order as INIT: per-key -> global never reverses) — a
+                # concurrent SET_OPT installs under _global_lock, and an
+                # updater is never uninstalled, so the local ref stays
+                # valid for the whole apply
+                updater = self._updater
             with self._lock_of(key):
                 stored = self._store.get(key)
                 if stored is None:
@@ -377,9 +407,9 @@ class KVStoreServer:
                 if grad.shape != stored.shape and \
                         grad.size == stored.size:
                     grad = grad.reshape(stored.shape)
-                if self._updater is not None:
+                if updater is not None:
                     # async contract: apply THIS worker's gradient now
-                    self._updater(key, grad, stored)
+                    updater(key, grad, stored)
                 else:
                     # no optimizer: the server is an ACCUMULATOR — pull
                     # returns init + sum of every push (the dist num_
@@ -396,13 +426,16 @@ class KVStoreServer:
                 return True, _np.array(stored, copy=True)
         if cmd == "SET_OPT":
             _, blob = msg
-            if self._updater is not None:
-                # every worker ships the optimizer (startup skew): keep the
-                # FIRST installation so accumulated momentum/Adam state is
-                # never wiped mid-training (reference gates the controller
-                # message on rank 0 for the same reason)
-                return True, "already installed"
-            self._install_optimizer(blob)
+            with self._global_lock:
+                # check-and-install is ATOMIC: two workers shipping the
+                # optimizer concurrently (startup skew) must not both
+                # pass the None check and double-install — the loser
+                # would wipe accumulated momentum/Adam state.  Keep the
+                # FIRST installation (reference gates the controller
+                # message on rank 0 for the same reason).
+                if self._updater is not None:
+                    return True, "already installed"
+                self._install_optimizer(blob)
             return True, None
         if cmd == "PING":
             # heartbeat: payload is the sender's client_id (also reached
@@ -578,7 +611,10 @@ def serve_forever(port=None, num_workers=None, ready_file=None,
                 f.write("%d" % srv.server_address[1])
         t = threading.Thread(target=srv.serve_forever, daemon=True)
         t.start()
-        stop_event.wait()
+        # unbounded BY DESIGN: idling until a worker sends STOP is the
+        # server's whole lifetime — there is nothing to time out against
+        # (launch.py's supervisor owns killing an abandoned server)
+        stop_event.wait()   # mxlint: disable=blocking-wait-unbounded
         srv.shutdown()                      # stop accepting
         drain_deadline = _fault.Deadline(5.0)
         while not drain_deadline.expired():

@@ -27,11 +27,14 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from tools.mxlint import (lint_source, lint_paths, load_baseline,   # noqa: E402
+from tools.mxlint import (lint_source, lint_sources, lint_paths,    # noqa: E402
+                          load_baseline, load_baseline_whys,
                           write_baseline, collect_env_reads, RULES)
 from tools.mxlint.core import apply_baseline                        # noqa: E402
 
 BASELINE = os.path.join(REPO, "tools", "mxlint", "baseline.json")
+RUNTIME_PATHS = [os.path.join(REPO, "mxnet_tpu"),
+                 os.path.join(REPO, "tools", "launch.py")]
 
 
 def rules_of(diags):
@@ -337,6 +340,465 @@ def test_donation_after_use_self_attr_and_conditional_donate():
 
 
 # ---------------------------------------------------------------------------
+# concurrency rules (ISSUE 6): whole-program pass fixtures
+# ---------------------------------------------------------------------------
+
+CONC = "mxnet_tpu/foo.py"
+
+SHARED_HIT = src("""
+import threading
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    def bump(self):
+        self._n += 1
+
+    def _run(self):
+        while True:
+            x = self._n
+""")
+
+
+def test_unguarded_shared_write_hit():
+    diags = lint_source(SHARED_HIT, CONC)
+    assert rules_of(diags) == ["unguarded-shared-write"]
+    d = diags[0]
+    assert d.line == 11 and "Pump._n" in d.message
+    # both thread roots named, and the peer read site carried separately
+    assert "thread:Pump._run" in d.threads and "main" in d.threads
+    assert d.peer == "mxnet_tpu/foo.py:15"
+
+
+def test_unguarded_shared_write_suppressed_baselined_clean(tmp_path):
+    sup = SHARED_HIT.replace(
+        "self._n += 1",
+        "self._n += 1  # mxlint: disable=unguarded-shared-write")
+    assert lint_source(sup, CONC) == []
+    bl = tmp_path / "bl.json"
+    write_baseline(str(bl), lint_source(SHARED_HIT, CONC))
+    new, old, stale = apply_baseline(lint_source(SHARED_HIT, CONC),
+                                     load_baseline(str(bl)))
+    assert new == [] and len(old) == 1 and stale == []
+    clean = SHARED_HIT.replace(
+        "        self._n += 1",
+        "        with self._lock:\n            self._n += 1").replace(
+        "            x = self._n",
+        "            with self._lock:\n                x = self._n")
+    assert lint_source(clean, CONC) == []
+
+
+def test_unguarded_shared_write_init_is_prepublication():
+    # writes in __init__ (and private helpers only it calls) happen
+    # before the thread starts: never a conflict
+    code = src("""
+    import threading
+
+    class Pump:
+        def __init__(self):
+            self._setup()
+            threading.Thread(target=self._run, daemon=True).start()
+
+        def _setup(self):
+            self._n = 0
+
+        def _run(self):
+            return self._n
+    """)
+    assert lint_source(code, CONC) == []
+
+
+def test_unguarded_shared_write_handler_multi_instance():
+    # one socketserver handler root is MANY threads: a shared object it
+    # writes without a lock conflicts with itself
+    code = src("""
+    import socketserver
+
+    class Store:
+        def note(self, k):
+            self._seen[k] = 1
+
+    store = Store()
+
+    class Handler(socketserver.BaseRequestHandler):
+        def handle(self):
+            store.note(self.request)
+    """)
+    diags = lint_source(code, CONC)
+    assert rules_of(diags) == ["unguarded-shared-write"]
+    assert "handler:Handler" in diags[0].threads
+
+
+def test_inconsistent_guard_quad(tmp_path):
+    code = src("""
+    import threading
+
+    class Pump:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+            threading.Thread(target=self._run, daemon=True).start()
+
+        def bump(self):
+            with self._lock:
+                self._n += 1
+
+        def _run(self):
+            return self._n
+    """)
+    diags = lint_source(code, CONC)
+    assert rules_of(diags) == ["inconsistent-guard"]
+    # anchored on the UNGUARDED side, naming the guarded peer's lock
+    assert diags[0].line == 14
+    assert "Pump._lock" in diags[0].message
+    sup = code.replace("return self._n",
+                       "return self._n  # mxlint: disable=inconsistent-guard")
+    assert lint_source(sup, CONC) == []
+    bl = tmp_path / "bl.json"
+    write_baseline(str(bl), diags)
+    new, old, _ = apply_baseline(lint_source(code, CONC),
+                                 load_baseline(str(bl)))
+    assert new == [] and len(old) == 1
+    clean = code.replace("return self._n",
+                         "with self._lock:\n            return self._n")
+    assert lint_source(clean, CONC) == []
+
+
+def test_guard_propagates_through_private_callee():
+    # a helper called ONLY with the lock held inherits the guard — the
+    # _try_release_barrier pattern must not false-positive
+    code = src("""
+    import threading
+
+    class Pump:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+            threading.Thread(target=self._run, daemon=True).start()
+
+        def bump(self):
+            with self._lock:
+                self._bump_locked()
+
+        def _bump_locked(self):
+            self._n += 1
+
+        def _run(self):
+            with self._lock:
+                return self._n
+    """)
+    assert lint_source(code, CONC) == []
+
+
+def test_lock_order_cycle_quad(tmp_path):
+    code = src("""
+    import threading
+
+    class AB:
+        def __init__(self):
+            self._a_lock = threading.Lock()
+            self._b_lock = threading.Lock()
+            threading.Thread(target=self._w, daemon=True).start()
+
+        def fwd(self):
+            with self._a_lock:
+                with self._b_lock:
+                    pass
+
+        def _w(self):
+            with self._b_lock:
+                with self._a_lock:
+                    pass
+    """)
+    diags = lint_source(code, CONC)
+    assert rules_of(diags) == ["lock-order-cycle"]
+    assert "AB._a_lock" in diags[0].message and \
+        "AB._b_lock" in diags[0].message
+    anchor = diags[0].line
+    lines = code.splitlines()
+    lines[anchor - 1] += "  # mxlint: disable=lock-order-cycle"
+    assert lint_source("\n".join(lines) + "\n", CONC) == []
+    bl = tmp_path / "bl.json"
+    write_baseline(str(bl), diags)
+    new, old, _ = apply_baseline(lint_source(code, CONC),
+                                 load_baseline(str(bl)))
+    assert new == [] and len(old) == 1
+    clean = code.replace(
+        "with self._b_lock:\n            with self._a_lock:",
+        "with self._a_lock:\n            with self._b_lock:")
+    assert lint_source(clean, CONC) == []
+
+
+def test_blocking_wait_unbounded_quad(tmp_path):
+    code = src("""
+    import threading
+
+    class W:
+        def __init__(self):
+            self._ev = threading.Event()
+            self._lk = threading.Lock()
+
+        def park(self):
+            self._ev.wait()
+
+        def grab(self):
+            self._lk.acquire()
+
+        def park_ok(self):
+            self._ev.wait(1.0)
+            self._lk.acquire(timeout=2.0)
+    """)
+    path = "mxnet_tpu/kvstore/foo.py"
+    diags = lint_source(code, path)
+    assert rules_of(diags) == ["blocking-wait-unbounded"] * 2
+    assert "Event.wait" in diags[0].message
+    assert "acquire" in diags[1].message
+    # out of the fault/kvstore/health/launch scope: not checked
+    assert lint_source(code, "mxnet_tpu/callback.py") == []
+    sup = code.replace(
+        "self._ev.wait()",
+        "self._ev.wait()  # mxlint: disable=blocking-wait-unbounded"
+    ).replace(
+        "self._lk.acquire()",
+        "self._lk.acquire()  # mxlint: disable=blocking-wait-unbounded")
+    assert lint_source(sup, path) == []
+    bl = tmp_path / "bl.json"
+    write_baseline(str(bl), diags)
+    new, old, _ = apply_baseline(lint_source(code, path),
+                                 load_baseline(str(bl)))
+    assert new == [] and len(old) == 2
+
+
+def test_thread_leak_quad(tmp_path):
+    hit = src("""
+    import threading
+
+    def work():
+        pass
+
+    def spawn():
+        t = threading.Thread(target=work)
+        t.start()
+    """)
+    diags = lint_source(hit, CONC)
+    assert rules_of(diags) == ["thread-leak"]
+    sup = hit.replace(
+        "t = threading.Thread(target=work)",
+        "t = threading.Thread(target=work)  # mxlint: disable=thread-leak")
+    assert lint_source(sup, CONC) == []
+    bl = tmp_path / "bl.json"
+    write_baseline(str(bl), diags)
+    new, old, _ = apply_baseline(lint_source(hit, CONC),
+                                 load_baseline(str(bl)))
+    assert new == [] and len(old) == 1
+    # clean: daemon=True, an (even bounded) join, or a stop-event loop
+    assert lint_source(hit.replace("target=work", "target=work, daemon=True"),
+                       CONC) == []
+    joined = hit + "\n    t.join(timeout=5)\n"
+    assert lint_source(joined, CONC) == []
+    stop_ev = src("""
+    import threading
+
+    _stop = threading.Event()
+
+    def work():
+        while not _stop.wait(0.5):
+            pass
+
+    def spawn():
+        threading.Thread(target=work).start()
+    """)
+    assert lint_source(stop_ev, CONC) == []
+
+
+def test_grad_hook_callback_is_thread_root():
+    # `X._grad_hook = partial(self._cb, ...)` marks _cb as an overlap
+    # callback root (fires mid-backward) — unguarded state it shares
+    # with the step path is flagged
+    code = src("""
+    import functools
+
+    class Trainer:
+        def arm(self, grads):
+            self._sess = object()
+            for i, g in enumerate(grads):
+                g._grad_hook = functools.partial(self._on_ready, i)
+
+        def _on_ready(self, i):
+            s = self._sess
+            return s
+    """)
+    diags = lint_source(code, "mxnet_tpu/gluon/trainer.py")
+    assert "unguarded-shared-write" in rules_of(diags)
+    assert any("hook:Trainer._on_ready" in d.threads for d in diags)
+
+
+def test_pool_submit_target_is_thread_root():
+    code = src("""
+    from concurrent.futures import ThreadPoolExecutor
+
+    class Loader:
+        def __init__(self):
+            self._pool = ThreadPoolExecutor(4)
+            self._epoch = 0
+
+        def reset(self):
+            self._epoch += 1
+
+        def fetch(self, keys):
+            return list(self._pool.map(self._load, keys))
+
+        def _load(self, k):
+            return (k, self._epoch)
+    """)
+    diags = lint_source(code, CONC)
+    assert rules_of(diags) == ["unguarded-shared-write"]
+    assert any("pool:Loader._load" in d.threads for d in diags)
+
+
+def test_lock_order_same_named_locals_do_not_collide():
+    # same-named function-local locks in two files are DIFFERENT locks:
+    # their tokens must not merge into one graph node and fabricate a
+    # cross-file cycle
+    a = src("""
+    import threading
+    my_lock = threading.Lock()
+    my_sem = threading.Semaphore()
+
+    def f():
+        with my_lock:
+            with my_sem:
+                pass
+    """)
+    b = src("""
+    import threading
+    my_lock = threading.Lock()
+    my_sem = threading.Semaphore()
+
+    def g():
+        with my_sem:
+            with my_lock:
+                pass
+    """)
+    assert lint_sources({"mxnet_tpu/x.py": a, "mxnet_tpu/y.py": b}) == []
+
+
+def test_blocking_wait_per_method_timeout_semantics():
+    # a positional arg is not always a timeout: wait_for's first arg is
+    # the predicate, and acquire(blocking=True) is explicitly unbounded
+    code = src("""
+    import threading
+
+    class W:
+        def __init__(self):
+            self._cv = threading.Condition()
+            self._lk = threading.Lock()
+
+        def bad(self):
+            with self._cv:
+                self._cv.wait_for(lambda: True)
+            self._lk.acquire(blocking=True)
+
+        def ok(self):
+            with self._cv:
+                self._cv.wait_for(lambda: True, 5.0)
+            self._lk.acquire(False)
+            self._lk.acquire(True, 5.0)
+            self._lk.acquire(timeout=1.0)
+    """)
+    diags = lint_source(code, "mxnet_tpu/kvstore/foo.py")
+    assert rules_of(diags) == ["blocking-wait-unbounded"] * 2
+    assert [d.line for d in diags] == [10, 11]
+
+
+def test_thread_leak_join_matching_is_file_scoped():
+    # an unrelated `t.join()` in ANOTHER file must not silence a leak
+    # bound to a bare local name; a class-qualified binding still
+    # matches project-wide
+    leak = src("""
+    import threading
+
+    def work():
+        pass
+
+    def spawn():
+        t = threading.Thread(target=work)
+        t.start()
+    """)
+    other = src("""
+    class Other:
+        def stop(self):
+            t = self.worker
+            t.join()
+    """)
+    out = lint_sources({"mxnet_tpu/m.py": leak, "mxnet_tpu/n.py": other})
+    assert rules_of(out) == ["thread-leak"]
+
+
+# ---------------------------------------------------------------------------
+# cross-file anchoring (the two-site satellite): write site anchors the
+# diagnostic, the peer read in ANOTHER file rides in message/peer only —
+# so suppression and the baseline fingerprint stay stable under peer drift
+# ---------------------------------------------------------------------------
+
+XFILE_A = src("""
+class Base:
+    def set(self, v):
+        self._n = v
+""")
+
+XFILE_B = src("""
+import threading
+from .a import Base
+
+class Worker(Base):
+    def __init__(self):
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        return self._n
+""")
+
+
+def test_cross_file_conflict_anchors_on_write_site():
+    diags = lint_sources({"mxnet_tpu/a.py": XFILE_A,
+                          "mxnet_tpu/b.py": XFILE_B})
+    assert rules_of(diags) == ["unguarded-shared-write"]
+    d = diags[0]
+    assert d.path == "mxnet_tpu/a.py" and d.line == 3
+    assert d.peer == "mxnet_tpu/b.py:9"
+    assert "mxnet_tpu/b.py:9" in d.message
+
+
+def test_cross_file_fingerprint_survives_peer_drift(tmp_path):
+    diags = lint_sources({"mxnet_tpu/a.py": XFILE_A,
+                          "mxnet_tpu/b.py": XFILE_B})
+    # shift the PEER file by 5 lines: fingerprint (and thus a baseline
+    # entry / suppression) must not change, only the peer pointer
+    shifted = lint_sources({"mxnet_tpu/a.py": XFILE_A,
+                            "mxnet_tpu/b.py": "\n" * 5 + XFILE_B})
+    assert diags[0].fingerprint() == shifted[0].fingerprint()
+    assert diags[0].fingerprint_id() == shifted[0].fingerprint_id()
+    assert shifted[0].peer == "mxnet_tpu/b.py:14"
+    bl = tmp_path / "bl.json"
+    write_baseline(str(bl), diags)
+    new, old, stale = apply_baseline(shifted, load_baseline(str(bl)))
+    assert new == [] and len(old) == 1 and stale == []
+
+
+def test_cross_file_suppression_on_write_site():
+    sup_a = XFILE_A.replace(
+        "self._n = v",
+        "self._n = v  # mxlint: disable=unguarded-shared-write")
+    assert lint_sources({"mxnet_tpu/a.py": sup_a,
+                         "mxnet_tpu/b.py": XFILE_B}) == []
+
+
+# ---------------------------------------------------------------------------
 # baseline mechanics
 # ---------------------------------------------------------------------------
 
@@ -449,6 +911,74 @@ def test_cli_write_baseline_narrowed_scan_preserves_entries(tmp_path):
     assert json.loads(bl.read_text())["entries"] == full
 
 
+def test_cli_jobs_parallel_matches_serial(tmp_path):
+    # --jobs N must produce byte-identical findings to the serial scan
+    pkg = _fake_repo(tmp_path, bad=True)
+    (pkg / "kvstore" / "waits.py").write_text(src("""
+    import threading
+
+    class W:
+        def __init__(self):
+            self._ev = threading.Event()
+
+        def park(self):
+            self._ev.wait()
+    """))
+    serial = _run_cli([str(pkg), "--no-baseline", "--format", "json"])
+    par = _run_cli([str(pkg), "--no-baseline", "--format", "json",
+                    "--jobs", "4"])
+    assert serial.returncode == par.returncode == 1
+    assert json.loads(serial.stdout)["violations"] == \
+        json.loads(par.stdout)["violations"]
+
+
+def test_cli_json_schema_stable(tmp_path):
+    pkg = _fake_repo(tmp_path, bad=True)
+    r = _run_cli([str(pkg), "--no-baseline", "--format", "json"])
+    payload = json.loads(r.stdout)
+    assert payload["schema"] == 2
+    assert set(payload) >= {"schema", "violations", "baselined",
+                            "stale_baseline", "lock_graph"}
+    v = payload["violations"][0]
+    # the machine contract: rule id, drift-stable fingerprint,
+    # file:line, thread roots involved
+    assert set(v) >= {"rule", "path", "line", "col", "message",
+                      "snippet", "fingerprint", "threads"}
+    assert isinstance(v["fingerprint"], str) and len(v["fingerprint"]) == 16
+    assert payload["lock_graph"]["acyclic"] in (True, False)
+
+
+def test_cli_select_accepts_concurrency_rules(tmp_path):
+    pkg = _fake_repo(tmp_path, bad=True)
+    # selecting ONLY a concurrency rule: the wall-clock hit disappears
+    r = _run_cli([str(pkg), "--no-baseline",
+                  "--select", "unguarded-shared-write,lock-order-cycle"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = _run_cli(["--list-rules"])
+    for rid in ("unguarded-shared-write", "inconsistent-guard",
+                "lock-order-cycle", "blocking-wait-unbounded",
+                "thread-leak"):
+        assert rid in r.stdout
+
+
+def test_write_baseline_preserves_why(tmp_path):
+    # the baseline-justification policy: regenerating the baseline must
+    # keep each surviving entry's reviewer-written `why`
+    pkg = _fake_repo(tmp_path, bad=True)
+    bl = tmp_path / "bl.json"
+    r = _run_cli([str(pkg), "--baseline", str(bl), "--write-baseline"])
+    assert r.returncode == 0, r.stderr
+    data = json.loads(bl.read_text())
+    assert len(data["entries"]) == 1
+    data["entries"][0]["why"] = "virtual-clock exempt: test fixture"
+    bl.write_text(json.dumps(data))
+    r = _run_cli([str(pkg), "--baseline", str(bl), "--write-baseline"])
+    assert r.returncode == 0, r.stderr
+    entries = json.loads(bl.read_text())["entries"]
+    assert entries[0]["why"] == "virtual-clock exempt: test fixture"
+    assert load_baseline_whys(str(bl))
+
+
 # ---------------------------------------------------------------------------
 # env scanner + gen_env_docs --check
 # ---------------------------------------------------------------------------
@@ -481,17 +1011,59 @@ def test_gen_env_docs_check_passes_on_shipped_tree():
 # the tier-1 gate: shipped tree is clean; reinjected violations trip
 # ---------------------------------------------------------------------------
 
+_TREE_SCAN = []     # memo: the full-tree scan feeds three gate tests
+
+
+def _scan_tree():
+    if not _TREE_SCAN:
+        _TREE_SCAN.append(lint_paths(RUNTIME_PATHS, root=REPO,
+                                     return_project=True))
+    return _TREE_SCAN[0]
+
+
 def _lint_tree():
-    diags = lint_paths([os.path.join(REPO, "mxnet_tpu")], root=REPO)
+    diags, _project = _scan_tree()
     return apply_baseline(diags, load_baseline(BASELINE))
 
 
 def test_shipped_tree_lints_clean():
+    # the whole threaded runtime (mxnet_tpu + the supervisor), ALL rules
+    # including the concurrency pass
     new, old, stale = _lint_tree()
     assert new == [], "\n".join(map(repr, new))
     assert stale == [], ("baseline entries no longer match the tree — "
-                         "run `python -m tools.mxlint --write-baseline "
-                         "mxnet_tpu/`: %s" % (stale,))
+                         "run `python -m tools.mxlint --write-baseline`"
+                         ": %s" % (stale,))
+
+
+def test_shipped_lock_graph_is_acyclic():
+    # the acceptance criterion verbatim: the runtime's static
+    # lock-acquisition graph must stay acyclic, and must actually SEE
+    # the lock hierarchy the docs promise
+    _diags, project = _scan_tree()
+    cycles = project.lock_cycles()
+    assert cycles == [], cycles
+    edges = set(project.lock_graph())
+    assert ("KVStoreServer._barrier_cv",
+            "KVStoreServer._seen_lock") in edges
+    assert ("KVStoreServer._snapshot_lock",
+            "KVStoreServer._global_lock") in edges
+    assert ("KVStoreDistAsync._lock",
+            "KVStoreDistAsync._seq_lock") in edges
+
+
+def test_shipped_thread_roots_discovered():
+    # the pass must actually SEE the runtime's thread landscape: the
+    # kvstore heartbeat, the socketserver handler, the watchdog, and
+    # the overlap grad-hook callback
+    _diags, project = _scan_tree()
+    roots = {r.display for r in project.roots}
+    assert any("handler:Handler" in r for r in roots), roots
+    assert any("Watchdog._run" in r for r in roots), roots
+    assert any("_start_heartbeat" in r and r.startswith("thread:")
+               for r in roots), roots
+    assert any(r.startswith("hook:") and "_on_grad_ready" in r
+               for r in roots), roots
 
 
 def test_reinjected_asnumpy_in_trainer_update_trips():
@@ -526,7 +1098,74 @@ def test_reinjected_wall_clock_in_kvstore_retry_trips():
     assert "wall-clock-in-fault-path" in rules_of(new)
 
 
+def test_reinjected_unguarded_write_in_server_trips():
+    # acceptance criterion: re-introduce the known-fixed race (the
+    # liveness-table write losing its lock) into a test copy of
+    # kvstore/server.py and the lint must fail
+    p = os.path.join(REPO, "mxnet_tpu", "kvstore", "server.py")
+    with open(p) as f:
+        code = f.read()
+    anchor = ("            with self._seen_lock:\n"
+              "                self._last_seen[rank] = _fault.now()\n"
+              "                self._seen_regime[rank] = "
+              "_fault.is_virtual()")
+    assert anchor in code, "touch() moved; update this test"
+    bad = code.replace(anchor,
+                       "            self._last_seen[rank] = _fault.now()\n"
+                       "            self._seen_regime[rank] = "
+                       "_fault.is_virtual()")
+    diags = lint_source(bad, "mxnet_tpu/kvstore/server.py")
+    assert "unguarded-shared-write" in rules_of(diags)
+    new, _, _ = apply_baseline(diags, load_baseline(BASELINE))
+    assert "unguarded-shared-write" in rules_of(new)
+
+
+def test_reinjected_unguarded_write_in_server_fails_cli(tmp_path):
+    # same reinjection through the CLI exit-code contract, on a copied
+    # tree (the shipped tree itself must stay clean)
+    pkg = tmp_path / "mxnet_tpu"
+    (pkg / "kvstore").mkdir(parents=True)
+    (pkg / "base.py").write_text("ENV_CATALOG = {}\n")
+    p = os.path.join(REPO, "mxnet_tpu", "kvstore", "server.py")
+    with open(p) as f:
+        code = f.read()
+    bad = code.replace("            with self._seen_lock:\n"
+                       "                self._last_seen[rank]",
+                       "            if True:\n"
+                       "                self._last_seen[rank]")
+    assert bad != code
+    (pkg / "kvstore" / "server.py").write_text(bad)
+    r = _run_cli([str(pkg), "--select", "unguarded-shared-write"])
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "unguarded-shared-write" in r.stdout
+
+
+def test_reinjected_hook_race_in_trainer_trips():
+    # the overlap-session handoff (ISSUE 5) is lock-protected; dropping
+    # the guard on the hook-side read must trip the concurrency pass
+    p = os.path.join(REPO, "mxnet_tpu", "gluon", "trainer.py")
+    with open(p) as f:
+        code = f.read()
+    anchor = ("    def _on_grad_ready(self, i, d):\n"
+              "        with self._hook_lock:\n"
+              "            sess = self._exchange_session")
+    assert anchor in code, "Trainer._on_grad_ready moved; update this test"
+    bad = code.replace(anchor,
+                       "    def _on_grad_ready(self, i, d):\n"
+                       "        if True:\n"
+                       "            sess = self._exchange_session")
+    diags = lint_source(bad, "mxnet_tpu/gluon/trainer.py")
+    assert "inconsistent-guard" in rules_of(diags) or \
+        "unguarded-shared-write" in rules_of(diags)
+    new, _, _ = apply_baseline(diags, load_baseline(BASELINE))
+    assert new != []
+
+
 def test_rule_set_is_complete():
     assert {"host-sync-in-hot-path", "jit-purity",
             "wall-clock-in-fault-path", "env-var-registry",
-            "donation-after-use"} <= set(RULES)
+            "donation-after-use",
+            # ISSUE 6: the whole-program concurrency pass
+            "unguarded-shared-write", "inconsistent-guard",
+            "lock-order-cycle", "blocking-wait-unbounded",
+            "thread-leak"} <= set(RULES)

@@ -228,7 +228,14 @@ class Supervisor:
             sp.proc.wait(timeout=5.0)
         except subprocess.TimeoutExpired:
             sp.proc.kill()
-            sp.proc.wait()
+            try:
+                # bounded even after SIGKILL: an unkillable (D-state)
+                # child must not wedge the whole supervisor loop — the
+                # zombie is reaped by a later poll() instead
+                sp.proc.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                self.log("%s ignored SIGKILL (uninterruptible?); "
+                         "leaving it to a later poll" % sp.name)
 
     def _fold(self, rc):
         if rc:
@@ -297,7 +304,13 @@ class Supervisor:
                      "killing the wedged process"
                      % (sp.name, age, phase, limit))
             sp.proc.kill()
-            sp.proc.wait()
+            try:
+                # bounded: a D-state child must not stall hang checks
+                # for every OTHER rank; poll() reaps it later
+                sp.proc.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                self.log("%s ignored SIGKILL (uninterruptible?); "
+                         "leaving it to a later poll" % sp.name)
 
     def _teardown(self):
         for sp in self.procs:

@@ -3,15 +3,21 @@
 # sibling; the builder loop runs the same checks inside tier-1 via
 # tests/test_mxlint.py).
 #
-#   1. mxlint over mxnet_tpu/ — the TPU-invariant rule set (host syncs in
-#      the hot path, jit purity, wall clocks in fault paths, the MX_* env
-#      registry, donation-after-use) with the checked-in baseline.
+#   1. mxlint over mxnet_tpu/ + tools/launch.py — the per-file
+#      TPU-invariant rules (host syncs in the hot path, jit purity, wall
+#      clocks in fault paths, the MX_* env registry, donation-after-use)
+#      PLUS the whole-program concurrency rules (unguarded-shared-write,
+#      inconsistent-guard, lock-order-cycle, blocking-wait-unbounded,
+#      thread-leak) with the checked-in baseline; also asserts the
+#      runtime's static lock-acquisition graph stays acyclic.
 #   2. gen_env_docs --check — docs/ENV_VARS.md must match base.ENV_CATALOG
 #      and every MX_* read in mxnet_tpu/ + tools/ must be cataloged.
 #
 # Exit nonzero on any new violation.  To suppress a justified hit, append
-# `# mxlint: disable=<rule-id>` to the line; to re-baseline after review,
-# run `python -m tools.mxlint --write-baseline mxnet_tpu/`.
+# `# mxlint: disable=<rule-id>` to the line (for a two-site concurrency
+# finding: on the WRITE site, where it anchors); to re-baseline after
+# review, run `python -m tools.mxlint --write-baseline` (every
+# concurrency entry needs a `why` justification — docs/TESTING.md §5).
 set -euo pipefail
 
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
@@ -20,7 +26,28 @@ PY="${PYTHON:-python3}"
 
 echo "== lint: mxlint (tools/mxlint, baseline $(
     "$PY" -c 'import json;print(len(json.load(open("tools/mxlint/baseline.json"))["entries"]))' 2>/dev/null || echo 0) entries)"
-"$PY" -m tools.mxlint mxnet_tpu/
+# ONE json run carries both the violation exit contract and the lock
+# graph; the checker re-prints violations textually and fails on a
+# cyclic graph
+rc=0
+out="$("$PY" -m tools.mxlint --format json --jobs 4)" || rc=$?
+if [ "$rc" -ge 2 ] || [ -z "$out" ]; then
+    echo "lint: mxlint internal/usage error (exit $rc)" >&2
+    exit 2
+fi
+MXLINT_JSON="$out" "$PY" - "$rc" <<'PYEOF'
+import json, os, sys
+rc = int(sys.argv[1])
+payload = json.loads(os.environ["MXLINT_JSON"])
+for v in payload["violations"]:
+    print("%(path)s:%(line)d: %(rule)s: %(message)s" % v)
+g = payload["lock_graph"]
+print("lock-acquisition graph (%s):" %
+      ("acyclic" if g["acyclic"] else "CYCLIC"))
+for e in g["edges"]:
+    print("   " + e)
+sys.exit(rc or (0 if g["acyclic"] else 1))
+PYEOF
 
 echo "== lint: env-var doc consistency (tools/gen_env_docs.py --check)"
 "$PY" tools/gen_env_docs.py --check

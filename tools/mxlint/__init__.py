@@ -10,17 +10,19 @@ checks out, including inside the tier-1 pytest lane
 
 Usage::
 
-    python -m tools.mxlint mxnet_tpu/              # lint, exit 1 on hits
-    python -m tools.mxlint --format json mxnet_tpu/
-    python -m tools.mxlint --write-baseline mxnet_tpu/
+    python -m tools.mxlint                # mxnet_tpu/ + tools/launch.py
+    python -m tools.mxlint --jobs 4       # parallel file parse
+    python -m tools.mxlint --format json  # stable schema + lock graph
+    python -m tools.mxlint --write-baseline
     python -m tools.mxlint --list-rules
 
 Suppression: append ``# mxlint: disable=<rule-id>[,<rule-id>...]`` to the
 flagged line (or ``disable=all``).  Grandfathered violations live in
-``tools/mxlint/baseline.json`` (see ``--write-baseline``); the tier-1
-test fails on any NEW violation.
+``tools/mxlint/baseline.json`` (see ``--write-baseline``; concurrency
+entries need a ``why`` justification); the tier-1 test fails on any NEW
+violation.
 
-Rules (see ``tools/mxlint/rules.py`` and docs/ARCHITECTURE.md
+Per-file rules (``tools/mxlint/rules.py``; docs/ARCHITECTURE.md
 "Enforced invariants"):
 
   host-sync-in-hot-path    device->host syncs reachable from Trainer.step /
@@ -32,14 +34,32 @@ Rules (see ``tools/mxlint/rules.py`` and docs/ARCHITECTURE.md
                            missing from base.ENV_CATALOG / docs/ENV_VARS.md
   donation-after-use       buffers donated to a donate_argnums jit and
                            referenced afterwards
+
+Whole-program concurrency rules (``tools/mxlint/project.py``; ISSUE 6 —
+thread roots = Thread targets, socketserver handlers, executor
+submit/map targets, ``_grad_hook`` overlap callbacks):
+
+  unguarded-shared-write   attribute written lock-free while another
+                           thread root reads/writes it (anchored on the
+                           write site; peer may be in another file)
+  inconsistent-guard       racing accesses hold disjoint lock sets
+  lock-order-cycle         the static lock-acquisition graph has a cycle
+  blocking-wait-unbounded  timeout-less Event.wait/Condition.wait/
+                           Lock.acquire/proc.wait in fault / kvstore /
+                           health / launch paths
+  thread-leak              non-daemon thread without join or stop event
 """
 from .core import (Diagnostic, FileContext, Rule, RULES, register_rule,
-                   lint_source, lint_paths, load_baseline, write_baseline,
-                   collect_env_reads, load_catalog_names)
+                   lint_source, lint_sources, lint_paths, load_baseline,
+                   load_baseline_whys, write_baseline, collect_env_reads,
+                   load_catalog_names)
 from . import rules as _rules  # noqa: F401  (registers the rule set)
+from . import project as _project_rules  # noqa: F401  (concurrency rules)
+from .project import ProjectIndex, summarize_source
 
 __all__ = ["Diagnostic", "FileContext", "Rule", "RULES", "register_rule",
-           "lint_source", "lint_paths", "load_baseline", "write_baseline",
-           "collect_env_reads", "load_catalog_names"]
+           "lint_source", "lint_sources", "lint_paths", "load_baseline",
+           "load_baseline_whys", "write_baseline", "collect_env_reads",
+           "load_catalog_names", "ProjectIndex", "summarize_source"]
 
-__version__ = "1.0"
+__version__ = "2.0"

@@ -13,11 +13,21 @@ import os
 import sys
 
 from .core import (RULES, apply_baseline, lint_paths, load_baseline,
-                   repo_root_of, write_baseline)
+                   load_baseline_whys, repo_root_of, write_baseline)
 from . import rules as _rules  # noqa: F401  (registers the rule set)
+from . import project as _project  # noqa: F401  (concurrency rules)
 
 DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "baseline.json")
+
+
+def _default_paths():
+    """mxnet_tpu plus the supervisor — the launcher is part of the
+    threaded runtime the concurrency rules certify."""
+    out = ["mxnet_tpu"]
+    if os.path.isfile(os.path.join("tools", "launch.py")):
+        out.append(os.path.join("tools", "launch.py"))
+    return out
 
 
 def main(argv=None) -> int:
@@ -25,8 +35,12 @@ def main(argv=None) -> int:
         prog="python -m tools.mxlint",
         description="TPU-invariant static analyzer for this repo "
                     "(stdlib-ast; see tools/mxlint/__init__.py)")
-    ap.add_argument("paths", nargs="*", default=["mxnet_tpu"],
-                    help="files/trees to lint (default: mxnet_tpu)")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/trees to lint (default: mxnet_tpu plus "
+                    "tools/launch.py — the whole threaded runtime)")
+    ap.add_argument("--jobs", type=int, default=1, metavar="N",
+                    help="parse/lint files in N worker processes (the "
+                    "whole-program pass itself stays in-process)")
     ap.add_argument("--baseline", default=None, metavar="FILE",
                     help="grandfathered-violations file (default: "
                     "tools/mxlint/baseline.json when it exists)")
@@ -46,7 +60,7 @@ def main(argv=None) -> int:
             print("%-26s %s" % (rid, rule.description))
         return 0
 
-    paths = [p for p in args.paths]
+    paths = list(args.paths) if args.paths else _default_paths()
     for p in paths:
         if not os.path.exists(p):
             print("mxlint: no such path: %s" % p, file=sys.stderr)
@@ -61,8 +75,14 @@ def main(argv=None) -> int:
             return 2
 
     root = repo_root_of(paths[0]) or os.getcwd()
+    # only the json output needs the ProjectIndex back (for the lock
+    # graph); a --select run narrowed to file rules then skips the
+    # whole-program indexing entirely
+    want_graph = args.format == "json"
     try:
-        diags = lint_paths(paths, root=root, select=select)
+        result = lint_paths(paths, root=root, select=select,
+                            jobs=args.jobs, return_project=want_graph)
+        diags, project = result if want_graph else (result, None)
     except Exception as e:  # internal error must not look like "clean"
         print("mxlint: internal error: %s: %s" % (type(e).__name__, e),
               file=sys.stderr)
@@ -82,8 +102,10 @@ def main(argv=None) -> int:
             return 2
         out = args.baseline or DEFAULT_BASELINE
         # merge: entries for files OUTSIDE the scanned paths are not in
-        # `diags` only because they were not looked at — preserve them
+        # `diags` only because they were not looked at — preserve them,
+        # and re-attach every surviving entry's `why` justification
         kept = []
+        whys = {}
         if os.path.isfile(out):
             rel_scanned = [os.path.relpath(os.path.abspath(p),
                                            root).replace(os.sep, "/")
@@ -96,6 +118,7 @@ def main(argv=None) -> int:
                            entry_path.startswith(pre) for pre in prefixes)
 
             try:
+                whys = load_baseline_whys(out)
                 for key, count in load_baseline(out).items():
                     if not scanned(key[0]):
                         kept.append((key, count))
@@ -103,7 +126,7 @@ def main(argv=None) -> int:
                 print("mxlint: cannot read existing baseline %s: %s"
                       % (out, e), file=sys.stderr)
                 return 2
-        write_baseline(out, diags, extra_counts=dict(kept))
+        write_baseline(out, diags, extra_counts=dict(kept), whys=whys)
         n = len(diags) + sum(c for _, c in kept)
         print("mxlint: wrote %d grandfathered entr%s to %s%s"
               % (n, "y" if n == 1 else "ies", out,
@@ -124,10 +147,21 @@ def main(argv=None) -> int:
     new, old, stale = apply_baseline(diags, baseline)
 
     if args.format == "json":
+        # stable machine schema (satellite of ISSUE 6): every finding
+        # carries rule id, file:line, a drift-stable fingerprint and the
+        # thread roots involved; the static lock graph rides along so CI
+        # can assert it stays acyclic
+        cycles = project.lock_cycles()
         print(json.dumps({
+            "schema": 2,
             "violations": [d.to_json() for d in new],
             "baselined": [d.to_json() for d in old],
             "stale_baseline": ["%s:%s:%s" % k for k in stale],
+            "lock_graph": {
+                "edges": sorted("%s -> %s" % k
+                                for k in project.lock_graph()),
+                "acyclic": not cycles,
+            },
         }, indent=1))
     else:
         for d in new:

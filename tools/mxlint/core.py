@@ -10,14 +10,16 @@ from __future__ import annotations
 
 import ast
 import fnmatch
+import hashlib
 import json
 import os
 import re
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
 
 __all__ = ["Diagnostic", "FileContext", "Rule", "RULES", "register_rule",
-           "lint_source", "lint_paths", "load_baseline", "write_baseline",
-           "collect_env_reads", "load_catalog_names", "repo_root_of"]
+           "lint_source", "lint_sources", "lint_paths", "load_baseline",
+           "write_baseline", "collect_env_reads", "load_catalog_names",
+           "repo_root_of"]
 
 
 # ---------------------------------------------------------------------------
@@ -31,26 +33,48 @@ class Diagnostic:
     NUMBERS drift with every edit, line TEXT only changes when the
     violation itself is touched, which is exactly when a grandfathered
     entry should come back up for review.
+
+    A concurrency finding can span TWO sites (a write and a conflicting
+    read in another function or file).  It is always ANCHORED on the
+    write site — fingerprint, suppression comment and baseline entry key
+    on that one line — and names the peer in ``peer``/``message``, so
+    line drift at the peer never invalidates the fingerprint.  ``threads``
+    carries the thread roots involved (for the JSON schema).
     """
 
-    __slots__ = ("rule", "path", "line", "col", "message", "snippet")
+    __slots__ = ("rule", "path", "line", "col", "message", "snippet",
+                 "threads", "peer")
 
     def __init__(self, rule: str, path: str, line: int, col: int,
-                 message: str, snippet: str = ""):
+                 message: str, snippet: str = "",
+                 threads: Tuple[str, ...] = (), peer: Optional[str] = None):
         self.rule = rule
         self.path = path.replace(os.sep, "/")
         self.line = line
         self.col = col
         self.message = message
         self.snippet = snippet.strip()
+        self.threads = tuple(threads)
+        self.peer = peer
 
     def fingerprint(self) -> Tuple[str, str, str]:
         return (self.path, self.rule, self.snippet)
 
+    def fingerprint_id(self) -> str:
+        """Stable machine id of the fingerprint (survives line drift:
+        hashes path+rule+source text, never line numbers or the peer)."""
+        blob = "\x00".join(self.fingerprint()).encode("utf-8")
+        return hashlib.sha1(blob).hexdigest()[:16]
+
     def to_json(self) -> Dict:
-        return {"rule": self.rule, "path": self.path, "line": self.line,
-                "col": self.col, "message": self.message,
-                "snippet": self.snippet}
+        out = {"rule": self.rule, "path": self.path, "line": self.line,
+               "col": self.col, "message": self.message,
+               "snippet": self.snippet,
+               "fingerprint": self.fingerprint_id(),
+               "threads": list(self.threads)}
+        if self.peer:
+            out["peer"] = self.peer
+        return out
 
     def __repr__(self):
         return "%s:%d:%d: %s: %s" % (self.path, self.line, self.col,
@@ -241,10 +265,16 @@ def _attr_chain(node: ast.AST) -> Optional[List[str]]:
 
 class Rule:
     """Base class: subclasses set `id`/`description`/`invariant_from` and
-    implement check(ctx) -> iterator of Diagnostics."""
+    implement check(ctx) -> iterator of Diagnostics.
+
+    ``scope`` is ``"file"`` (checked per file against a FileContext) or
+    ``"project"`` (checked once against the whole-program ProjectIndex —
+    see tools/mxlint/project.py; such rules implement
+    ``check_project(project)`` instead)."""
 
     id: str = ""
     description: str = ""
+    scope: str = "file"
     # which PR introduced the invariant this rule enforces (docs table)
     invariant_from: str = ""
     # fnmatch patterns (posix, repo-relative) this rule applies to;
@@ -283,16 +313,35 @@ def load_baseline(path: str) -> Dict[Tuple[str, str, str], int]:
     return counts
 
 
+def load_baseline_whys(path: str) -> Dict[Tuple[str, str, str], str]:
+    """The reviewer-written justification (`why`) of each baseline
+    entry, keyed like load_baseline().  Baselining policy (docs/TESTING
+    §5): every concurrency-rule entry MUST carry one."""
+    with open(path) as f:
+        data = json.load(f)
+    return {(e["path"], e["rule"], e["snippet"]): e["why"]
+            for e in data.get("entries", []) if e.get("why")}
+
+
 def write_baseline(path: str, diags: Sequence[Diagnostic],
                    extra_counts: Optional[Dict[Tuple[str, str, str],
-                                               int]] = None) -> None:
+                                               int]] = None,
+                   whys: Optional[Dict[Tuple[str, str, str],
+                                       str]] = None) -> None:
     """Write `diags` as the baseline; `extra_counts` carries entries to
-    preserve verbatim (e.g. for files a narrowed scan never visited)."""
+    preserve verbatim (e.g. for files a narrowed scan never visited) and
+    `whys` reattaches per-entry justifications so a regeneration never
+    drops the review trail."""
     counts: Dict[Tuple[str, str, str], int] = dict(extra_counts or {})
     for d in diags:
         counts[d.fingerprint()] = counts.get(d.fingerprint(), 0) + 1
-    entries = [{"path": p, "rule": r, "snippet": s, "count": c}
-               for (p, r, s), c in sorted(counts.items())]
+    whys = whys or {}
+    entries = []
+    for (p, r, s), c in sorted(counts.items()):
+        e = {"path": p, "rule": r, "snippet": s, "count": c}
+        if (p, r, s) in whys:
+            e["why"] = whys[(p, r, s)]
+        entries.append(e)
     with open(path, "w") as f:
         json.dump({"version": 1, "entries": entries}, f, indent=1,
                   sort_keys=True)
@@ -364,42 +413,122 @@ def repo_root_of(path: str) -> Optional[str]:
 # Entry points
 # ---------------------------------------------------------------------------
 
-def lint_source(source: str, path: str,
-                catalog: Optional[Set[str]] = None,
-                select: Optional[Set[str]] = None) -> List[Diagnostic]:
-    """Lint one source string as repo-relative `path`.  Returns ALL
-    diagnostics after suppression comments (baseline is the caller's
-    job).  Syntax errors surface as a single mxlint-parse diagnostic —
-    a file that doesn't parse can't be certified."""
+def _suppressed(d: Diagnostic, per_line, per_file) -> bool:
+    if d.rule in per_file or "all" in per_file:
+        return True
+    sup = per_line.get(d.line, ())
+    return d.rule in sup or "all" in sup
+
+
+def _project_wanted(select: Optional[Set[str]]) -> bool:
+    if select is None:
+        return True
+    return any(r.scope == "project" and r.id in select
+               for r in RULES.values())
+
+
+def _lint_one_file(path: str, source: str,
+                   catalog: Optional[Set[str]],
+                   select: Optional[Set[str]],
+                   want_summary: bool = True):
+    """File-scope pass over one source: returns (diags, summary,
+    per_line_supp, per_file_supp).  `summary` is the picklable
+    project-pass extraction (None when the file does not parse, or when
+    a --select narrowed the run to file rules only) — this is the unit
+    of work ``--jobs N`` farms out to worker processes."""
     try:
         ctx = FileContext(path, source, catalog=catalog)
     except SyntaxError as e:
-        return [Diagnostic("mxlint-parse", path, e.lineno or 1, 0,
-                           "file does not parse: %s" % e.msg)]
+        return ([Diagnostic("mxlint-parse", path, e.lineno or 1, 0,
+                            "file does not parse: %s" % e.msg)],
+                None, {}, set())
     per_line, per_file = _parse_suppressions(ctx.lines)
     out: List[Diagnostic] = []
     for rule in RULES.values():
+        if rule.scope != "file":
+            continue
         if select is not None and rule.id not in select:
             continue
         if not rule.applies_to(ctx.path):
             continue
         for d in rule.check(ctx):
-            if d.rule in per_file or "all" in per_file:
-                continue
-            sup = per_line.get(d.line, ())
-            if d.rule in sup or "all" in sup:
-                continue
-            out.append(d)
+            if not _suppressed(d, per_line, per_file):
+                out.append(d)
+    summary = None
+    if want_summary:
+        from . import project as _project
+        summary = _project.summarize(ctx.tree, ctx.path, ctx.lines)
+    return out, summary, per_line, per_file
+
+
+def _dedupe_sort(diags: Sequence[Diagnostic]) -> List[Diagnostic]:
     # dedupe: nested Attribute chains can hit one detector twice per line
     seen = set()
     uniq = []
-    for d in out:
+    for d in diags:
         key = (d.rule, d.path, d.line, d.message)
         if key not in seen:
             seen.add(key)
             uniq.append(d)
     uniq.sort(key=lambda d: (d.path, d.line, d.col, d.rule))
     return uniq
+
+
+def lint_sources(sources: Dict[str, str],
+                 catalog: Optional[Set[str]] = None,
+                 select: Optional[Set[str]] = None,
+                 return_project: bool = False):
+    """Lint a {repo-relative path: source} mapping: per-file rules on
+    each file, then the whole-program concurrency pass over all of them
+    together.  Returns the diagnostics (and the ProjectIndex when
+    ``return_project``)."""
+    from . import project as _project
+    want_project = return_project or _project_wanted(select)
+    diags: List[Diagnostic] = []
+    summaries = {}
+    supp = {}
+    for path, source in sources.items():
+        path = path.replace(os.sep, "/")
+        file_diags, summary, per_line, per_file = _lint_one_file(
+            path, source, catalog, select, want_summary=want_project)
+        diags.extend(file_diags)
+        if summary is not None:
+            summaries[path] = summary
+        supp[path] = (per_line, per_file)
+    index = None
+    if want_project:
+        index = _project.ProjectIndex(summaries)
+        diags.extend(_project_pass(index, supp, select))
+    out = _dedupe_sort(diags)
+    if return_project:
+        return out, index
+    return out
+
+
+def _project_pass(index, supp, select) -> List[Diagnostic]:
+    out: List[Diagnostic] = []
+    for rule in RULES.values():
+        if rule.scope != "project":
+            continue
+        if select is not None and rule.id not in select:
+            continue
+        for d in rule.check_project(index):
+            per_line, per_file = supp.get(d.path, ({}, set()))
+            if not _suppressed(d, per_line, per_file):
+                out.append(d)
+    return out
+
+
+def lint_source(source: str, path: str,
+                catalog: Optional[Set[str]] = None,
+                select: Optional[Set[str]] = None) -> List[Diagnostic]:
+    """Lint one source string as repo-relative `path`.  Returns ALL
+    diagnostics after suppression comments (baseline is the caller's
+    job).  Syntax errors surface as a single mxlint-parse diagnostic —
+    a file that doesn't parse can't be certified.  Project-scope rules
+    run over the single-file 'program' (thread roots inside this file
+    are still discovered)."""
+    return lint_sources({path: source}, catalog=catalog, select=select)
 
 
 _SKIP_DIRS = {"__pycache__", ".git", "node_modules", ".venv", "fixtures"}
@@ -418,21 +547,67 @@ def iter_py_files(paths: Sequence[str]) -> Iterator[str]:
                         yield os.path.join(dirpath, fn)
 
 
+def _parallel_worker(item):
+    """Module-level so ProcessPoolExecutor can pickle it.  One file in,
+    (rel, diags, summary, per_line_supp, per_file_supp) out."""
+    fp, rel, catalog, select, want_summary = item
+    with open(fp, encoding="utf-8") as f:
+        src = f.read()
+    diags, summary, per_line, per_file = _lint_one_file(
+        rel, src, catalog, select, want_summary=want_summary)
+    return rel, diags, summary, per_line, per_file
+
+
 def lint_paths(paths: Sequence[str], root: Optional[str] = None,
-               select: Optional[Set[str]] = None) -> List[Diagnostic]:
-    """Lint files/trees.  Paths in diagnostics are repo-relative (to the
-    detected root containing mxnet_tpu/base.py) so baselines and path
-    patterns are machine-independent."""
+               select: Optional[Set[str]] = None, jobs: int = 1,
+               return_project: bool = False):
+    """Lint files/trees: per-file rules on each file (parsed in ``jobs``
+    worker processes when > 1), then ONE whole-program concurrency pass
+    over everything scanned.  Paths in diagnostics are repo-relative (to
+    the detected root containing mxnet_tpu/base.py) so baselines and
+    path patterns are machine-independent.
+
+    Note the project pass only sees the files given: linting a single
+    file still discovers the thread roots *inside* it, but conflicts
+    against unscanned files are invisible — the shipped gate therefore
+    always scans the full runtime tree."""
     if root is None:
         root = repo_root_of(paths[0] if paths else ".") or os.getcwd()
     catalog = load_catalog_names(root)
-    diags: List[Diagnostic] = []
+    from . import project as _project
+    want_project = return_project or _project_wanted(select)
+    items = []
     for fp in iter_py_files(paths):
         rel = os.path.relpath(os.path.abspath(fp), root).replace(os.sep, "/")
-        with open(fp, encoding="utf-8") as f:
-            src = f.read()
-        diags.extend(lint_source(src, rel, catalog=catalog, select=select))
-    diags.sort(key=lambda d: (d.path, d.line, d.col, d.rule))
+        items.append((fp, rel, catalog, select, want_project))
+    results = None
+    if jobs and jobs > 1 and len(items) > 1:
+        try:
+            import concurrent.futures as _cf
+            with _cf.ProcessPoolExecutor(max_workers=jobs) as pool:
+                results = list(pool.map(_parallel_worker, items,
+                                        chunksize=8))
+        except Exception:
+            # sandboxes without process spawning fall back silently —
+            # results are identical either way, only slower
+            results = None
+    if results is None:
+        results = [_parallel_worker(it) for it in items]
+    diags: List[Diagnostic] = []
+    summaries = {}
+    supp = {}
+    for rel, file_diags, summary, per_line, per_file in results:
+        diags.extend(file_diags)
+        if summary is not None:
+            summaries[rel] = summary
+        supp[rel] = (per_line, per_file)
+    index = None
+    if want_project:
+        index = _project.ProjectIndex(summaries)
+        diags.extend(_project_pass(index, supp, select))
+    diags = _dedupe_sort(diags)
+    if return_project:
+        return diags, index
     return diags
 
 

@@ -1,0 +1,1465 @@
+"""mxlint whole-program concurrency analysis (ISSUE 6).
+
+PRs 1/2/5 made the runtime genuinely multi-threaded (kvstore heartbeat,
+socketserver handlers, health watchdog, mid-backward ``_grad_hook``
+callbacks); the per-file rules in ``rules.py`` cannot see the lock
+discipline those features depend on.  This module adds the project-wide
+pass they need:
+
+* :func:`summarize` distills one parsed file into a picklable
+  :class:`FileSummary` — functions with their ``self.X`` attribute
+  accesses (and the locks lexically held at each), lock-acquisition
+  events, blocking-wait sites, thread-spawn sites, call edges, plus an
+  alias-aware import map — cheap enough to farm out to ``--jobs N``
+  worker processes.
+* :class:`ProjectIndex` stitches the summaries together: resolves call
+  edges across files (import aliases, ``x = Class()`` locals, typed
+  ``self._mod = module`` attributes), discovers thread entry points
+  (``threading.Thread(target=...)``, socketserver handler classes,
+  executor ``submit``/``map`` targets, ``._grad_hook`` assignments),
+  computes which functions each thread root reaches, infers the locks
+  guaranteed held at every function entry (intersection over call
+  sites, a shrinking-set fixpoint), and builds the static
+  lock-acquisition graph.
+* Five registered project-scope rules consume the index:
+  ``unguarded-shared-write``, ``inconsistent-guard``,
+  ``lock-order-cycle``, ``blocking-wait-unbounded``, ``thread-leak``.
+
+Soundness posture (same trade as the file rules): no imports of the
+code under analysis, best-effort alias/type tracking, and deliberate
+happens-before modelling — writes inside ``__init__`` (or helpers only
+reachable from it) are pre-publication and never conflict; per-key lock
+factories (``with self._lock_of(k):``) collapse to one guard token; a
+socketserver handler's *own* attributes are per-connection and not
+shared.  What the analysis cannot prove is suppressed inline or
+baselined with a ``why`` — never silently ignored.
+"""
+from __future__ import annotations
+
+import ast
+import fnmatch
+import re
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .core import Diagnostic, Rule, register_rule, _attr_chain
+
+__all__ = ["FileSummary", "ProjectIndex", "summarize", "summarize_source"]
+
+
+# ---------------------------------------------------------------------------
+# type tokens
+# ---------------------------------------------------------------------------
+
+# attr/local types we track.  SYNC types are excluded from shared-state
+# conflicts (the primitives are internally thread-safe and only ever
+# rebound pre-publication).
+_SYNC_TYPES = {"Lock", "RLock", "Condition", "Event", "Semaphore",
+               "Barrier"}
+_EXEMPT_TYPES = _SYNC_TYPES | {"Thread", "Executor", "ThreadLocal"}
+
+_CTOR_TYPES = {
+    "threading.Lock": "Lock", "threading.RLock": "RLock",
+    "threading.Condition": "Condition", "threading.Event": "Event",
+    "threading.Semaphore": "Semaphore",
+    "threading.BoundedSemaphore": "Semaphore",
+    "threading.Barrier": "Barrier", "threading.Thread": "Thread",
+    "threading.local": "ThreadLocal",
+    "subprocess.Popen": "Popen",
+    "concurrent.futures.ThreadPoolExecutor": "Executor",
+    "concurrent.futures.ProcessPoolExecutor": "Executor",
+    "concurrent.futures.thread.ThreadPoolExecutor": "Executor",
+}
+
+_GUARD_NAME_RE = re.compile(r"(lock|mutex|cv|cond\b|condition|sem)", re.I)
+_EVENTISH_RE = re.compile(r"(event|stop|done|ready)", re.I)
+_LOCKISH_RE = re.compile(r"(lock|mutex|sem)", re.I)
+_CONDISH_RE = re.compile(r"(cv|cond)", re.I)
+_PROCISH = ("proc", "process", "popen")
+
+# container-method calls that mutate the receiver
+_MUTATORS = {"append", "add", "extend", "insert", "remove", "discard",
+             "pop", "popitem", "clear", "update", "setdefault", "sort",
+             "reverse"}
+
+_HANDLER_BASES = ("BaseRequestHandler", "StreamRequestHandler",
+                  "DatagramRequestHandler")
+
+
+# ---------------------------------------------------------------------------
+# picklable summary records (plain __slots__ classes, protocol-2 safe)
+# ---------------------------------------------------------------------------
+
+class Access:
+    """One ``self.X`` access: r(ead) / w(rite), with the guard tokens
+    lexically held."""
+    __slots__ = ("attr", "kind", "line", "col", "snippet", "guards")
+
+    def __init__(self, attr, kind, line, col, snippet, guards):
+        self.attr, self.kind = attr, kind
+        self.line, self.col, self.snippet = line, col, snippet
+        self.guards = frozenset(guards)
+
+
+class CallSite:
+    __slots__ = ("ref", "guards", "line")
+
+    def __init__(self, ref, guards, line):
+        self.ref, self.guards, self.line = ref, frozenset(guards), line
+
+
+class Acq:
+    """A ``with <lock>:`` entry: the new token + tokens already held."""
+    __slots__ = ("token", "held", "line", "snippet")
+
+    def __init__(self, token, held, line, snippet):
+        self.token, self.held = token, tuple(held)
+        self.line, self.snippet = line, snippet
+
+
+class WaitSite:
+    """A blocking call (wait/acquire/join) with its receiver kind."""
+    __slots__ = ("kind", "recv", "has_timeout", "line", "col", "snippet")
+
+    def __init__(self, kind, recv, has_timeout, line, col, snippet):
+        self.kind, self.recv, self.has_timeout = kind, recv, has_timeout
+        self.line, self.col, self.snippet = line, col, snippet
+
+
+class Spawn:
+    """A thread/pool-worker spawn site."""
+    __slots__ = ("kind", "target", "daemon", "binding", "line", "col",
+                 "snippet")
+
+    def __init__(self, kind, target, daemon, binding, line, col, snippet):
+        self.kind = kind            # 'thread' | 'pool'
+        self.target = target        # ref (see _Summarizer._ref) or None
+        self.daemon = daemon        # True | False | None (absent/dynamic)
+        self.binding = binding      # token for join matching, or None
+        self.line, self.col, self.snippet = line, col, snippet
+
+
+class FuncInfo:
+    __slots__ = ("qual", "owner", "accesses", "calls", "acqs", "waits",
+                 "spawns", "joins", "daemon_set")
+
+    def __init__(self, qual, owner):
+        self.qual = qual
+        self.owner = owner          # owning class name or None
+        self.accesses: List[Access] = []
+        self.calls: List[CallSite] = []
+        self.acqs: List[Acq] = []
+        self.waits: List[WaitSite] = []
+        self.spawns: List[Spawn] = []
+        self.joins: Set[str] = set()
+        self.daemon_set: Set[str] = set()
+
+
+class ClassInfo:
+    __slots__ = ("name", "qual", "bases", "methods", "attr_types",
+                 "is_handler")
+
+    def __init__(self, name, qual, bases):
+        self.name, self.qual, self.bases = name, qual, bases
+        self.methods: Dict[str, str] = {}     # method name -> func qual
+        self.attr_types: Dict[str, object] = {}
+        self.is_handler = any(
+            str(b).rsplit(".", 1)[-1] in _HANDLER_BASES for b in bases)
+
+
+class FileSummary:
+    __slots__ = ("path", "module", "funcs", "classes", "aliases",
+                 "hook_targets")
+
+    def __init__(self, path, module):
+        self.path, self.module = path, module
+        self.funcs: Dict[str, FuncInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        self.aliases: Dict[str, str] = {}
+        # ``X._grad_hook = <callable>`` assignment targets: overlap-
+        # exchange callbacks that fire mid-backward (ISSUE 5)
+        self.hook_targets: List[Tuple[object, int]] = []
+
+
+# ---------------------------------------------------------------------------
+# alias map (path-aware: resolves relative imports against the file path)
+# ---------------------------------------------------------------------------
+
+def _module_of(path: str) -> str:
+    mod = path[:-3] if path.endswith(".py") else path
+    mod = mod.replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+def _build_aliases(tree: ast.AST, path: str) -> Dict[str, str]:
+    pkg = _module_of(path)
+    if not path.endswith("/__init__.py"):
+        pkg = pkg.rsplit(".", 1)[0] if "." in pkg else ""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    out[a.asname] = a.name
+                else:
+                    head = a.name.split(".")[0]
+                    out[head] = head
+        elif isinstance(node, ast.ImportFrom):
+            base = node.module or ""
+            if node.level:
+                parts = pkg.split(".") if pkg else []
+                keep = parts[: max(0, len(parts) - (node.level - 1))]
+                base = ".".join(keep + ([node.module] if node.module
+                                        else []))
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                out[a.asname or a.name] = \
+                    ("%s.%s" % (base, a.name)) if base else a.name
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the summarizer
+# ---------------------------------------------------------------------------
+
+class _Scope:
+    """One function (or module) name scope; closure lookups walk up."""
+    __slots__ = ("qual", "types", "defs", "parent")
+
+    def __init__(self, qual, parent):
+        self.qual = qual
+        self.types: Dict[str, object] = {}
+        self.defs: Dict[str, str] = {}    # local def name -> func qual
+        self.parent = parent
+
+    def lookup(self, name):
+        s = self
+        while s is not None:
+            if name in s.types:
+                return s.types[name], s.qual
+            s = s.parent
+        return None, None
+
+    def lookup_def(self, name):
+        s = self
+        while s is not None:
+            if name in s.defs:
+                return s.defs[name]
+            s = s.parent
+        return None
+
+
+class _Summarizer:
+    def __init__(self, path: str, tree: ast.AST, lines: Sequence[str]):
+        self.path = path
+        self.tree = tree
+        self.lines = lines
+        self.summary = FileSummary(path, _module_of(path))
+        self.summary.aliases = _build_aliases(tree, path)
+        self.class_stack: List[ClassInfo] = []
+        self.func_stack: List[FuncInfo] = []
+        # module scope is named by its dotted module so module-level
+        # lock tokens (`_clock_lock` in fault.py vs `_lock` in two other
+        # files) never collide across files in the project lock graph
+        self.scope: _Scope = _Scope(self.summary.module, None)
+        self.guards: List[str] = []
+        self.qual_stack: List[str] = []
+        self._container_writes: Set[int] = set()  # Attribute node ids
+        self._collect_class_types()
+        # a synthetic FuncInfo for module-level statements
+        self._module_fn = FuncInfo("<module>", None)
+        self.summary.funcs["<module>"] = self._module_fn
+        self.func_stack.append(self._module_fn)
+        for stmt in tree.body:
+            self._visit(stmt)
+        self.func_stack.pop()
+
+    # -- helpers ------------------------------------------------------------
+    def _line(self, lineno):
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def _dotted(self, node) -> Optional[str]:
+        chain = _attr_chain(node)
+        if not chain:
+            return None
+        head = chain[0]
+        origin = self.summary.aliases.get(head, head)
+        return ".".join([origin] + chain[1:])
+
+    def _ctor_type(self, call: ast.Call):
+        """Type token produced by a constructor-style call, or None."""
+        dotted = self._dotted(call.func)
+        if dotted in _CTOR_TYPES:
+            return _CTOR_TYPES[dotted]
+        tail = dotted.rsplit(".", 1)[-1] if dotted else None
+        if tail in ("ThreadPoolExecutor", "ProcessPoolExecutor"):
+            return "Executor"
+        if isinstance(call.func, ast.Name) and \
+                call.func.id in self.summary.classes:
+            return ("class", call.func.id)
+        if dotted:
+            # `x = mod.Class(...)` for a class defined in this file
+            parts = dotted.rsplit(".", 1)
+            if len(parts) == 2 and parts[1] in self.summary.classes:
+                return ("class", parts[1])
+        # list()/sorted()/tuple() over a lock collection stays lockish
+        if isinstance(call.func, ast.Name) and \
+                call.func.id in ("list", "sorted", "tuple") and call.args:
+            if self._expr_type(call.args[0]) in ("LockList", "LockDict"):
+                return "LockList"
+        return None
+
+    def _owner(self) -> Optional[str]:
+        return self.class_stack[-1].name if self.class_stack else None
+
+    def _attr_type(self, attr: str):
+        cls = self.class_stack[-1] if self.class_stack else None
+        if cls is not None and attr in cls.attr_types:
+            return cls.attr_types[attr]
+        return None
+
+    def _expr_type(self, node):
+        """Best-effort type token of an expression."""
+        if isinstance(node, ast.Name):
+            t, _scope = self.scope.lookup(node.id)
+            return t
+        if isinstance(node, ast.Attribute):
+            if isinstance(node.value, ast.Name) and node.value.id == "self":
+                return self._attr_type(node.attr)
+            # module attr through a module-typed self attribute
+            return None
+        if isinstance(node, ast.Call):
+            t = self._ctor_type(node)
+            if t is not None:
+                return t
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in ("values", "keys"):
+                if self._expr_type(f.value) == "LockDict":
+                    return "LockList"
+            return None
+        return None
+
+    # -- guard tokens -------------------------------------------------------
+    def _guard_token(self, expr) -> Optional[str]:
+        """Token for a with-item that acquires a lock, else None."""
+        if isinstance(expr, ast.Call):
+            # per-key lock factory: `with self._lock_of(key):`
+            f = expr.func
+            if isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name) and f.value.id == "self" \
+                    and _GUARD_NAME_RE.search(f.attr):
+                owner = self._owner_for_self()
+                if owner:
+                    return "%s.%s()" % (owner, f.attr)
+            return None
+        chain = _attr_chain(expr)
+        if not chain:
+            return None
+        if chain[0] == "self" and len(chain) == 2:
+            attr = chain[1]
+            t = self._attr_type_for_self(attr)
+            if t in _SYNC_TYPES or _GUARD_NAME_RE.search(attr):
+                owner = self._owner_for_self()
+                if owner:
+                    return "%s.%s" % (owner, attr)
+            return None
+        if len(chain) == 1:
+            name = chain[0]
+            t, scope_qual = self.scope.lookup(name)
+            if t in _SYNC_TYPES or (t is None and
+                                    _GUARD_NAME_RE.search(name)):
+                # local/closure lock: qualify with module + defining
+                # scope so same-named locals in two files (or an
+                # untyped parameter named `lock`) never collapse into
+                # one graph node and fabricate cross-file cycles
+                if scope_qual is None:
+                    scope_qual = self.scope.qual
+                if scope_qual == self.summary.module:
+                    return "%s.%s" % (scope_qual, name)
+                return "%s.%s.%s" % (self.summary.module, scope_qual,
+                                     name)
+        return None
+
+    def _owner_for_self(self) -> Optional[str]:
+        """Nearest enclosing class — `self` in a nested def is a closure
+        over the method's self (same instance)."""
+        return self.class_stack[-1].name if self.class_stack else None
+
+    def _attr_type_for_self(self, attr):
+        cls = self.class_stack[-1] if self.class_stack else None
+        if cls is not None:
+            return cls.attr_types.get(attr)
+        return None
+
+    # -- pass A: class attr types ------------------------------------------
+    def _collect_class_types(self):
+        def scan_class(cnode: ast.ClassDef, qual: str):
+            bases = [self._dotted(b) or "" for b in cnode.bases]
+            info = ClassInfo(cnode.name, qual, bases)
+            self.summary.classes[cnode.name] = info
+            for sub in ast.walk(cnode):
+                if isinstance(sub, ast.Assign):
+                    val_t = None
+                    if isinstance(sub.value, ast.Call):
+                        val_t = self._ctor_type_early(sub.value)
+                    elif isinstance(sub.value, ast.Name) and \
+                            sub.value.id in self.summary.aliases:
+                        dotted = self.summary.aliases[sub.value.id]
+                        # `self._srv_mod = _srv` (module alias): lets
+                        # `self._srv_mod.send_msg(...)` resolve cross-file
+                        val_t = ("module", dotted)
+                    for tgt in sub.targets:
+                        if isinstance(tgt, ast.Attribute) and \
+                                isinstance(tgt.value, ast.Name) and \
+                                tgt.value.id == "self" and val_t:
+                            info.attr_types.setdefault(tgt.attr, val_t)
+                elif isinstance(sub, ast.AnnAssign) and \
+                        isinstance(sub.target, ast.Attribute) and \
+                        isinstance(sub.target.value, ast.Name) and \
+                        sub.target.value.id == "self" and \
+                        isinstance(sub.value, ast.Call):
+                    val_t = self._ctor_type_early(sub.value)
+                    if val_t:
+                        info.attr_types.setdefault(sub.target.attr, val_t)
+                elif isinstance(sub, ast.Call):
+                    # `self._locks.setdefault(k, threading.Lock())` marks
+                    # _locks as a lock collection
+                    f = sub.func
+                    if isinstance(f, ast.Attribute) and \
+                            f.attr == "setdefault" and \
+                            isinstance(f.value, ast.Attribute) and \
+                            isinstance(f.value.value, ast.Name) and \
+                            f.value.value.id == "self" and \
+                            len(sub.args) == 2 and \
+                            isinstance(sub.args[1], ast.Call) and \
+                            self._ctor_type_early(sub.args[1]) in \
+                            _SYNC_TYPES:
+                        info.attr_types.setdefault(f.value.attr, "LockDict")
+
+        def walk(node, quals):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    scan_class(child, ".".join(quals + [child.name]))
+                    walk(child, quals + [child.name])
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    walk(child, quals + [child.name])
+                else:
+                    walk(child, quals)
+        walk(self.tree, [])
+        # module-level lock names
+        for stmt in self.tree.body:
+            if isinstance(stmt, ast.Assign) and \
+                    isinstance(stmt.value, ast.Call):
+                t = self._ctor_type_early(stmt.value)
+                if t:
+                    for tgt in stmt.targets:
+                        if isinstance(tgt, ast.Name):
+                            self.scope.types[tgt.id] = t
+
+    def _ctor_type_early(self, call: ast.Call):
+        dotted = self._dotted(call.func)
+        if dotted in _CTOR_TYPES:
+            return _CTOR_TYPES[dotted]
+        tail = dotted.rsplit(".", 1)[-1] if dotted else None
+        if tail in ("ThreadPoolExecutor", "ProcessPoolExecutor"):
+            return "Executor"
+        return None
+
+    # -- refs ---------------------------------------------------------------
+    def _ref(self, node) -> Optional[tuple]:
+        """Portable reference to a callable for cross-file resolution."""
+        if isinstance(node, ast.Name):
+            qual = self.scope.lookup_def(node.id)
+            if qual is not None:
+                return ("local", qual)
+            dotted = self.summary.aliases.get(node.id)
+            if dotted:
+                return ("dotted", dotted)
+            return None
+        if isinstance(node, ast.Attribute):
+            recv = node.value
+            if isinstance(recv, ast.Name) and recv.id in ("self", "cls"):
+                owner = self._owner_for_self()
+                if owner:
+                    return ("method", owner, node.attr)
+                return None
+            if isinstance(recv, ast.Call) and \
+                    isinstance(recv.func, ast.Name) and \
+                    recv.func.id == "super":
+                owner = self._owner_for_self()
+                if owner:
+                    return ("method", owner, node.attr)
+                return None
+            t = self._expr_type(recv)
+            if isinstance(t, tuple) and t[0] == "class":
+                return ("method", t[1], node.attr)
+            # self.<module-typed attr>.func  /  alias.func
+            if isinstance(recv, ast.Attribute) and \
+                    isinstance(recv.value, ast.Name) and \
+                    recv.value.id == "self":
+                at = self._attr_type_for_self(recv.attr)
+                if isinstance(at, tuple) and at[0] == "module":
+                    return ("dotted", "%s.%s" % (at[1], node.attr))
+            dotted = self._dotted(node)
+            if dotted and dotted != ".".join(_attr_chain(node) or []):
+                # head resolved through an import alias: cross-module
+                return ("dotted", dotted)
+            return None
+        if isinstance(node, ast.Call):
+            # functools.partial(f, ...) -> f
+            dotted = self._dotted(node.func)
+            if dotted in ("functools.partial", "partial") and node.args:
+                return self._ref(node.args[0])
+            return None
+        return None
+
+    # -- main walk ----------------------------------------------------------
+    def _record_access(self, attr, kind, node):
+        fn = self.func_stack[-1]
+        fn.accesses.append(Access(
+            attr, kind, node.lineno, node.col_offset,
+            self._line(node.lineno), self.guards))
+
+    def _visit(self, node):
+        meth = getattr(self, "_visit_%s" % type(node).__name__, None)
+        if meth is not None:
+            meth(node)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._visit(child)
+
+    def _visit_ClassDef(self, node: ast.ClassDef):
+        info = self.summary.classes.get(node.name)
+        self.qual_stack.append(node.name)
+        if info is not None:
+            self.class_stack.append(info)
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    info.methods[sub.name] = \
+                        ".".join(self.qual_stack + [sub.name])
+        for sub in node.body:
+            self._visit(sub)
+        if info is not None:
+            self.class_stack.pop()
+        self.qual_stack.pop()
+
+    def _visit_FunctionDef(self, node):
+        qual = ".".join(self.qual_stack + [node.name])
+        owner = self._owner()
+        fn = FuncInfo(qual, owner)
+        self.summary.funcs[qual] = fn
+        self.scope.defs[node.name] = qual
+        for dec in node.decorator_list:
+            self._visit(dec)
+        self.qual_stack.append(node.name)
+        self.func_stack.append(fn)
+        self.scope = _Scope(qual, self.scope)
+        saved_guards, self.guards = self.guards, []
+        for stmt in node.body:
+            self._visit(stmt)
+        self.guards = saved_guards
+        self.scope = self.scope.parent
+        self.func_stack.pop()
+        self.qual_stack.pop()
+
+    _visit_AsyncFunctionDef = _visit_FunctionDef
+
+    def _visit_Lambda(self, node: ast.Lambda):
+        # lambdas passed to e.g. fault.fire(on_close=...) run at the call
+        # site; keep the lexical guard context
+        self._visit(node.body)
+
+    def _visit_With(self, node: ast.With):
+        pushed = []
+        for item in node.items:
+            self._visit(item.context_expr)
+            tok = self._guard_token(item.context_expr)
+            if tok is not None:
+                fn = self.func_stack[-1]
+                fn.acqs.append(Acq(tok, self.guards, node.lineno,
+                                   self._line(node.lineno)))
+                self.guards.append(tok)
+                pushed.append(tok)
+        for stmt in node.body:
+            self._visit(stmt)
+        for tok in pushed:
+            self.guards.pop()
+
+    _visit_AsyncWith = _visit_With
+
+    def _visit_Assign(self, node: ast.Assign):
+        # hook targets / daemon flags / type bindings, then accesses
+        val_type = self._expr_type(node.value)
+        if val_type is None and isinstance(node.value, ast.Name) and \
+                node.value.id in self.summary.aliases:
+            # bare module alias: makes `x = mod; x.f()` resolvable
+            val_type = ("module", self.summary.aliases[node.value.id])
+        # value FIRST: a `t = threading.Thread(...)` records its spawn
+        # during the value visit, and the target handler then attaches
+        # the binding name to that spawn for join matching
+        self._visit(node.value)
+        for tgt in node.targets:
+            self._assign_target(tgt, node, val_type)
+
+    def _assign_target(self, tgt, node, val_type):
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._assign_target(el, node, None)
+            return
+        if isinstance(tgt, ast.Name):
+            if val_type is not None:
+                self.scope.types[tgt.id] = val_type
+            if isinstance(node.value, ast.Call) and \
+                    self._dotted(node.value.func) == "threading.Thread":
+                self._bind_last_spawn(tgt.id)
+            return
+        if isinstance(tgt, ast.Attribute):
+            if tgt.attr == "_grad_hook":
+                ref = self._ref(node.value)
+                if ref is not None:
+                    self.summary.hook_targets.append((ref, node.lineno))
+                self._maybe_self_access(tgt, "w")
+                return
+            if tgt.attr == "daemon" and \
+                    isinstance(node.value, ast.Constant) and \
+                    node.value.value is True:
+                self.func_stack[-1].daemon_set.add(
+                    self._binding_token(tgt.value))
+            if isinstance(tgt.value, ast.Name) and tgt.value.id == "self":
+                if val_type is not None and self.class_stack:
+                    self.class_stack[-1].attr_types.setdefault(
+                        tgt.attr, val_type)
+                self._record_self_attr(tgt, "w")
+                if isinstance(node.value, ast.Call) and \
+                        self._dotted(node.value.func) == "threading.Thread":
+                    owner = self._owner_for_self()
+                    self._bind_last_spawn(
+                        "%s.%s" % (owner, tgt.attr) if owner else tgt.attr)
+            else:
+                self._visit(tgt.value)
+            return
+        if isinstance(tgt, ast.Subscript):
+            base = tgt.value
+            if isinstance(base, ast.Attribute) and \
+                    isinstance(base.value, ast.Name) and \
+                    base.value.id == "self":
+                self._record_self_attr(base, "w")
+            else:
+                self._visit(base)
+            self._visit(tgt.slice)
+            return
+        self._visit(tgt)
+
+    def _bind_last_spawn(self, token):
+        fn = self.func_stack[-1]
+        if fn.spawns:
+            fn.spawns[-1].binding = token
+
+    def _binding_token(self, recv) -> str:
+        if isinstance(recv, ast.Attribute) and \
+                isinstance(recv.value, ast.Name) and recv.value.id == "self":
+            owner = self._owner_for_self()
+            return "%s.%s" % (owner, recv.attr) if owner else recv.attr
+        if isinstance(recv, ast.Name):
+            return recv.id
+        chain = _attr_chain(recv)
+        if chain:
+            return ".".join(chain)
+        return "?"
+
+    def _visit_AugAssign(self, node: ast.AugAssign):
+        tgt = node.target
+        if isinstance(tgt, ast.Attribute) and \
+                isinstance(tgt.value, ast.Name) and tgt.value.id == "self":
+            self._record_self_attr(tgt, "w")
+            self._record_self_attr(tgt, "r")
+        elif isinstance(tgt, ast.Subscript) and \
+                isinstance(tgt.value, ast.Attribute) and \
+                isinstance(tgt.value.value, ast.Name) and \
+                tgt.value.value.id == "self":
+            self._record_self_attr(tgt.value, "w")
+            self._visit(tgt.slice)
+        else:
+            self._visit(tgt)
+        self._visit(node.value)
+
+    def _visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is None:
+            return
+        fake = ast.Assign(targets=[node.target], value=node.value)
+        ast.copy_location(fake, node)
+        self._visit_Assign(fake)
+
+    def _visit_For(self, node: ast.For):
+        it_t = self._expr_type(node.iter)
+        if it_t in ("LockList", "LockDict") and \
+                isinstance(node.target, ast.Name):
+            self.scope.types[node.target.id] = "Lock"
+        self._visit(node.iter)
+        for stmt in node.body + node.orelse:
+            self._visit(stmt)
+
+    def _maybe_self_access(self, attr_node: ast.Attribute, kind):
+        if isinstance(attr_node.value, ast.Name) and \
+                attr_node.value.id == "self":
+            self._record_self_attr(attr_node, kind)
+        else:
+            self._visit(attr_node.value)
+
+    def _record_self_attr(self, attr_node: ast.Attribute, kind):
+        if self._owner_for_self() is None:
+            return
+        t = self._attr_type_for_self(attr_node.attr)
+        if t in _EXEMPT_TYPES or t == "LockDict" or \
+                (isinstance(t, tuple) and t[0] == "module"):
+            return
+        self._record_access(attr_node.attr, kind, attr_node)
+
+    def _visit_Attribute(self, node: ast.Attribute):
+        if isinstance(node.value, ast.Name) and node.value.id == "self":
+            kind = "w" if isinstance(node.ctx, (ast.Store, ast.Del)) \
+                else "r"
+            self._record_self_attr(node, kind)
+            return
+        self._visit(node.value)
+
+    def _visit_Call(self, node: ast.Call):
+        f = node.func
+        dotted = self._dotted(f)
+        # thread spawn
+        if dotted == "threading.Thread":
+            target = None
+            daemon = None
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    target = self._ref(kw.value)
+                elif kw.arg == "daemon":
+                    daemon = kw.value.value \
+                        if isinstance(kw.value, ast.Constant) else None
+            self.func_stack[-1].spawns.append(Spawn(
+                "thread", target, daemon, None, node.lineno,
+                node.col_offset, self._line(node.lineno)))
+        elif isinstance(f, ast.Attribute) and f.attr in ("submit", "map") \
+                and self._expr_type(f.value) == "Executor" and node.args:
+            target = self._ref(node.args[0])
+            self.func_stack[-1].spawns.append(Spawn(
+                "pool", target, True, None, node.lineno,
+                node.col_offset, self._line(node.lineno)))
+        # blocking waits
+        if isinstance(f, ast.Attribute) and \
+                f.attr in ("wait", "wait_for", "acquire", "join"):
+            self._classify_wait(node, f)
+        # container mutators on self attrs
+        if isinstance(f, ast.Attribute) and f.attr in _MUTATORS and \
+                isinstance(f.value, ast.Attribute) and \
+                isinstance(f.value.value, ast.Name) and \
+                f.value.value.id == "self":
+            self._record_self_attr(f.value, "w")
+        # join bookkeeping for thread-leak
+        if isinstance(f, ast.Attribute) and f.attr == "join":
+            self.func_stack[-1].joins.add(self._binding_token(f.value))
+        # call edge
+        ref = self._ref(f)
+        if ref is not None:
+            self.func_stack[-1].calls.append(
+                CallSite(ref, self.guards, node.lineno))
+        # recurse
+        self._visit(f)
+        for a in node.args:
+            self._visit(a)
+        for kw in node.keywords:
+            self._visit(kw.value)
+
+    def _classify_wait(self, node: ast.Call, f: ast.Attribute):
+        has_timeout = self._wait_is_bounded(node, f.attr)
+        recv = f.value
+        t = self._expr_type(recv)
+        name = recv.attr if isinstance(recv, ast.Attribute) else (
+            recv.id if isinstance(recv, ast.Name) else None)
+        kind = None
+        if f.attr in ("wait", "wait_for"):
+            if t == "Event" or t == "Condition":
+                kind = "%s.%s" % (t, f.attr)
+            elif t == "Popen" or (name and name.lower() in _PROCISH):
+                kind = "Popen.wait"
+            elif t is None and name and _CONDISH_RE.search(name):
+                kind = "Condition.wait"
+            elif t is None and name and _EVENTISH_RE.search(name):
+                kind = "Event.wait"
+        elif f.attr == "acquire":
+            if t in ("Lock", "RLock", "Semaphore") or \
+                    (t is None and name and _LOCKISH_RE.search(name)):
+                kind = "%s.acquire" % (t or "Lock")
+        elif f.attr == "join":
+            if t == "Thread" or (t is None and name and
+                                 "thread" in name.lower()):
+                kind = "Thread.join"
+        if kind is None:
+            return
+        recv_tok = self._binding_token(recv) if isinstance(
+            recv, (ast.Name, ast.Attribute)) else "?"
+        self.func_stack[-1].waits.append(WaitSite(
+            kind, recv_tok, has_timeout, node.lineno, node.col_offset,
+            self._line(node.lineno)))
+
+    @staticmethod
+    def _wait_is_bounded(node: ast.Call, meth: str) -> bool:
+        """Per-method timeout semantics — a positional arg is NOT
+        always a timeout: ``wait_for(pred)`` still parks forever and
+        ``acquire(True)`` is explicitly unbounded."""
+        kw = {k.arg: k.value for k in node.keywords}
+        if "timeout" in kw:
+            return True
+        if meth == "wait_for":
+            # signature (predicate, timeout=None): only a SECOND
+            # positional bounds the wait
+            return len(node.args) >= 2
+        if meth == "acquire":
+            # (blocking=True, timeout=-1): bounded iff a timeout is
+            # given or the acquire is non-blocking
+            if len(node.args) >= 2:
+                return True
+            blocking = kw.get("blocking") or (node.args[0]
+                                              if node.args else None)
+            return isinstance(blocking, ast.Constant) and \
+                blocking.value is False
+        # wait()/join()/proc.wait(): the first positional is the timeout
+        return bool(node.args)
+
+    def _visit_Subscript(self, node: ast.Subscript):
+        base = node.value
+        if isinstance(node.ctx, (ast.Store, ast.Del)) and \
+                isinstance(base, ast.Attribute) and \
+                isinstance(base.value, ast.Name) and \
+                base.value.id == "self":
+            self._record_self_attr(base, "w")
+        else:
+            self._visit(base)
+        self._visit(node.slice)
+
+
+def summarize(tree: ast.AST, path: str,
+              lines: Sequence[str]) -> FileSummary:
+    return _Summarizer(path, tree, lines).summary
+
+
+def summarize_source(source: str, path: str) -> Optional[FileSummary]:
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError:
+        return None
+    return summarize(tree, path, source.splitlines())
+
+
+# ---------------------------------------------------------------------------
+# the project index
+# ---------------------------------------------------------------------------
+
+class Root:
+    __slots__ = ("kind", "display", "entries", "multi")
+
+    def __init__(self, kind, display, entries, multi):
+        self.kind = kind          # 'thread' | 'handler' | 'pool' | 'hook'
+        self.display = display    # e.g. 'thread:KVStore._start_heartbeat.run'
+        self.entries = tuple(entries)
+        self.multi = multi        # may run in >1 thread concurrently
+
+
+class ProjectIndex:
+    """Cross-file resolution + reachability + guard inference over a set
+    of :class:`FileSummary` objects (key: repo-relative path)."""
+
+    def __init__(self, summaries: Dict[str, FileSummary]):
+        self.summaries = summaries
+        self.funcs: Dict[str, Tuple[str, FuncInfo]] = {}
+        self.class_reg: Dict[str, List[Tuple[str, ClassInfo]]] = {}
+        self.path_of_module: Dict[str, str] = {}
+        for path, s in summaries.items():
+            self.path_of_module[s.module] = path
+            for qual, fn in s.funcs.items():
+                self.funcs[self._fid(path, qual)] = (path, fn)
+            for cname, cinfo in s.classes.items():
+                self.class_reg.setdefault(cname, []).append((path, cinfo))
+        self.edges: Dict[str, List[Tuple[str, frozenset]]] = {}
+        self._resolve_edges()
+        self.family = self._class_families()
+        self.roots: List[Root] = []
+        self._discover_roots()
+        self.reach: List[Set[str]] = [self._closure(r.entries)
+                                      for r in self.roots]
+        spawn_reach_all: Set[str] = set()
+        for s in self.reach:
+            spawn_reach_all |= s
+        all_fids = set(self.funcs)
+        self.main_entries = all_fids - spawn_reach_all
+        self.main_reach = self._closure(self.main_entries)
+        self.init_only = self._compute_init_only(all_fids)
+        # guard checking wants the locks GUARANTEED held at entry
+        # (intersection over call sites); the deadlock graph wants every
+        # lock POSSIBLY held (union) — an edge on any one path is real
+        self.entry_guards = self._infer_entry_guards()
+        self.entry_guards_any = self._infer_entry_guards_union()
+
+    def _compute_init_only(self, all_fids) -> Set[str]:
+        """Pre-publication functions: ``__init__`` plus every PRIVATE
+        helper whose callers are ALL init-only (construction
+        happens-before thread start, so their writes can never race).
+        Public methods are never init-only — the analysis cannot see
+        their external callers — and neither are thread entry points,
+        even when spawned from __init__."""
+        callers: Dict[str, Set[str]] = {}
+        for caller, outs in self.edges.items():
+            for callee, _g in outs:
+                callers.setdefault(callee, set()).add(caller)
+        root_entries = {e for r in self.roots for e in r.entries}
+        init_only = {f for f in all_fids
+                     if f.rsplit(".", 1)[-1] == "__init__"} - root_entries
+
+        def private(fid):
+            name = fid.rsplit(".", 1)[-1]
+            return name.startswith("_") and not name.startswith("__")
+
+        changed = True
+        while changed:
+            changed = False
+            for f in all_fids:
+                if f in init_only or f in root_entries or not private(f):
+                    continue
+                cs = callers.get(f)
+                if cs and cs <= init_only:
+                    init_only.add(f)
+                    changed = True
+        return init_only
+
+    # -- plumbing -----------------------------------------------------------
+    @staticmethod
+    def _fid(path, qual):
+        return "%s::%s" % (path, qual)
+
+    def _resolve_ref(self, path: str, ref) -> Optional[str]:
+        if ref is None:
+            return None
+        kind = ref[0]
+        if kind == "local":
+            fid = self._fid(path, ref[1])
+            return fid if fid in self.funcs else None
+        if kind == "method":
+            cname, meth = ref[1], ref[2]
+            cands = self.class_reg.get(cname, ())
+            same = [(p, c) for p, c in cands if p == path]
+            for p, c in (same or list(cands)[:1]):
+                qual = c.methods.get(meth)
+                if qual:
+                    fid = self._fid(p, qual)
+                    if fid in self.funcs:
+                        return fid
+            return None
+        if kind == "dotted":
+            dotted = ref[1]
+            # longest module prefix match, remainder = func or Class.meth
+            parts = dotted.split(".")
+            for i in range(len(parts) - 1, 0, -1):
+                mod = ".".join(parts[:i])
+                p = self.path_of_module.get(mod)
+                if p is None:
+                    continue
+                rest = parts[i:]
+                fid = self._fid(p, ".".join(rest))
+                if fid in self.funcs:
+                    return fid
+                return None
+            return None
+        return None
+
+    def _resolve_edges(self):
+        for fid, (path, fn) in self.funcs.items():
+            out = []
+            for cs in fn.calls:
+                callee = self._resolve_ref(path, cs.ref)
+                if callee is not None and callee != fid:
+                    out.append((callee, cs.guards))
+            self.edges[fid] = out
+
+    def _class_families(self) -> Dict[Tuple[str, str], Tuple[str, str]]:
+        """Union-find over subclass relations: a subclass shares its
+        base's attribute namespace, so a write in the base file and a
+        read in the subclass file are the SAME shared state — this is
+        what lets one diagnostic span two files."""
+        parent: Dict[Tuple[str, str], Tuple[str, str]] = {}
+
+        def find(k):
+            while parent.get(k, k) != k:
+                parent[k] = parent.get(parent[k], parent[k])
+                k = parent[k]
+            return k
+
+        def union(a, b):
+            ra, rb = find(a), find(b)
+            if ra != rb:
+                parent[max(ra, rb)] = min(ra, rb)
+
+        for path, s in self.summaries.items():
+            for cname, cinfo in s.classes.items():
+                key = (path, cname)
+                parent.setdefault(key, key)
+                for base in cinfo.bases:
+                    tail = str(base).rsplit(".", 1)[-1]
+                    cands = self.class_reg.get(tail, ())
+                    same = [(p, c) for p, c in cands if p == path]
+                    pick = same or (list(cands) if len(cands) == 1 else [])
+                    for p, c in pick[:1]:
+                        parent.setdefault((p, c.name), (p, c.name))
+                        union(key, (p, c.name))
+        return {k: find(k) for k in parent}
+
+    def _closure(self, entries) -> Set[str]:
+        seen: Set[str] = set()
+        stack = [e for e in entries if e in self.funcs]
+        while stack:
+            f = stack.pop()
+            if f in seen:
+                continue
+            seen.add(f)
+            for callee, _g in self.edges.get(f, ()):
+                if callee not in seen:
+                    stack.append(callee)
+        return seen
+
+    def _discover_roots(self):
+        seen_entries = set()
+
+        def add(kind, display, entries, multi):
+            entries = tuple(e for e in entries if e in self.funcs)
+            if not entries:
+                return
+            key = (kind, entries)
+            if key in seen_entries:
+                return
+            seen_entries.add(key)
+            self.roots.append(Root(kind, display, entries, multi))
+
+        for path, s in self.summaries.items():
+            for qual, fn in s.funcs.items():
+                for sp in fn.spawns:
+                    fid = self._resolve_ref(path, sp.target)
+                    if fid is None:
+                        continue
+                    disp = "%s:%s" % (sp.kind, fid.split("::", 1)[1])
+                    add(sp.kind, disp, [fid], sp.kind == "pool")
+            for cname, cinfo in s.classes.items():
+                if cinfo.is_handler:
+                    entries = [self._fid(path, q)
+                               for q in cinfo.methods.values()]
+                    add("handler", "handler:%s" % cname, entries, True)
+            for ref, _line in s.hook_targets:
+                fid = self._resolve_ref(path, ref)
+                if fid is not None:
+                    add("hook", "hook:%s" % fid.split("::", 1)[1],
+                        [fid], False)
+
+    def _infer_entry_guards(self) -> Dict[str, frozenset]:
+        # a function's entry-held set is the INTERSECTION over its call
+        # sites.  Forced to empty: thread entry points, and any function
+        # the analysis cannot see every caller of — public API, or no
+        # static caller at all.  A PRIVATE function whose callers are
+        # all visible keeps whatever they guarantee (this is how
+        # `_try_release_barrier`-style called-with-lock-held helpers
+        # avoid false positives).
+        callers: Set[str] = set()
+        for _caller, outs in self.edges.items():
+            for callee, _g in outs:
+                callers.add(callee)
+
+        def public(fid):
+            name = fid.rsplit(".", 1)[-1]
+            return not name.startswith("_") or name.startswith("__")
+
+        forced = {e for r in self.roots for e in r.entries} | {
+            f for f in self.main_entries
+            if public(f) or f not in callers}
+        entry: Dict[str, Optional[frozenset]] = {f: None for f in self.funcs}
+        for e in forced:
+            entry[e] = frozenset()
+        changed = True
+        while changed:
+            changed = False
+            for caller, outs in self.edges.items():
+                held = entry.get(caller)
+                if held is None or caller in self.init_only:
+                    continue
+                for callee, g in outs:
+                    if callee in forced:
+                        continue
+                    eff = held | g
+                    cur = entry.get(callee)
+                    new = eff if cur is None else (cur & eff)
+                    if new != cur:
+                        entry[callee] = new
+                        changed = True
+        return {f: (g if g is not None else frozenset())
+                for f, g in entry.items()}
+
+    def _infer_entry_guards_union(self) -> Dict[str, frozenset]:
+        entry: Dict[str, frozenset] = {f: frozenset() for f in self.funcs}
+        changed = True
+        while changed:
+            changed = False
+            for caller, outs in self.edges.items():
+                if caller in self.init_only:
+                    continue
+                held = entry[caller]
+                for callee, g in outs:
+                    new = entry[callee] | held | g
+                    if new != entry[callee]:
+                        entry[callee] = new
+                        changed = True
+        return entry
+
+    # -- public queries ------------------------------------------------------
+    def roots_of(self, fid: str) -> List[Tuple[str, bool]]:
+        """(display, multi) of every root that reaches `fid` — plus the
+        implicit main thread when main-reachable."""
+        out = [(r.display, r.multi)
+               for r, reach in zip(self.roots, self.reach) if fid in reach]
+        if fid in self.main_reach:
+            out.append(("main", False))
+        return out
+
+    def effective_guards(self, fid: str, site_guards) -> frozenset:
+        return frozenset(site_guards) | self.entry_guards.get(
+            fid, frozenset())
+
+    def lock_graph(self):
+        """edges: {(held, acquired): [site, ...]} from every non-init
+        acquisition; a cycle here is a potential deadlock."""
+        edges: Dict[Tuple[str, str], List[str]] = {}
+        for fid, (path, fn) in self.funcs.items():
+            if fid in self.init_only:
+                continue
+            entry = self.entry_guards_any.get(fid, frozenset())
+            for acq in fn.acqs:
+                held = set(acq.held) | entry
+                for h in held:
+                    if h == acq.token:
+                        continue
+                    edges.setdefault((h, acq.token), []).append(
+                        "%s:%d" % (path, acq.line))
+        return edges
+
+    def lock_cycles(self):
+        """List of cycles, each a list of (held, acquired, site)."""
+        edges = self.lock_graph()
+        adj: Dict[str, List[str]] = {}
+        for (a, b), _sites in edges.items():
+            adj.setdefault(a, []).append(b)
+        cycles = []
+        seen_cycles = set()
+        state: Dict[str, int] = {}   # 0 unvisited, 1 in-stack, 2 done
+
+        def dfs(n, stack):
+            state[n] = 1
+            stack.append(n)
+            for m in sorted(adj.get(n, ())):
+                if state.get(m, 0) == 0:
+                    dfs(m, stack)
+                elif state.get(m) == 1:
+                    i = stack.index(m)
+                    cyc = stack[i:] + [m]
+                    norm = tuple(sorted(set(cyc)))
+                    if norm not in seen_cycles:
+                        seen_cycles.add(norm)
+                        steps = []
+                        for a, b in zip(cyc, cyc[1:]):
+                            site = edges.get((a, b), ["?"])[0]
+                            steps.append((a, b, site))
+                        cycles.append(steps)
+            stack.pop()
+            state[n] = 2
+
+        for n in sorted(adj):
+            if state.get(n, 0) == 0:
+                dfs(n, [])
+        return cycles
+
+    # -- shared-state conflict scan -----------------------------------------
+    def shared_conflicts(self):
+        """Yield (attr_key, anchor_site, peer_site, kind).  ``kind`` is
+        'unguarded' (a write holds nothing — anchored on that write) or
+        'inconsistent' (some guard exists but the racing pair shares no
+        lock — anchored on the less-guarded side).  A site is
+        (path, fid, Access, roots, guards); each anchor line is reported
+        at most once per attribute, so the two rules never double-report
+        one underlying race."""
+        # group accesses per (class FAMILY, attr): subclasses share the
+        # base's attribute namespace, so the write and the conflicting
+        # read may live in different files
+        handler_fams = set()
+        for (path, cname), fam in self.family.items():
+            cinfo = self.summaries[path].classes.get(cname)
+            if cinfo is not None and cinfo.is_handler:
+                handler_fams.add(fam)
+        grouped: Dict[Tuple[str, str, str], List] = {}
+        for fid, (path, fn) in self.funcs.items():
+            if fn.owner is None or fid in self.init_only:
+                continue
+            key0 = (path, fn.owner)
+            fam = self.family.get(key0, key0)
+            if fam in handler_fams or \
+                    self.summaries[path].classes.get(fn.owner) is None:
+                # a handler's own attrs are per-connection, not shared
+                continue
+            roots = self.roots_of(fid)
+            if not roots:
+                continue
+            for acc in fn.accesses:
+                key = (fam[0], fam[1], acc.attr)
+                guards = self.effective_guards(fid, acc.guards)
+                grouped.setdefault(key, []).append(
+                    (path, fid, acc, roots, guards))
+        for key, sites in sorted(grouped.items()):
+            writes = [s for s in sites if s[2].kind == "w"]
+            if not writes:
+                continue
+            anchored: Set[Tuple[str, int]] = set()
+            for w in writes:
+                for a in sites:
+                    if not _roots_conflict(w[3], a[3]):
+                        continue
+                    if w[4] & a[4]:
+                        continue
+                    if not w[4]:
+                        anchor, other, kind = w, a, "unguarded"
+                    elif not a[4]:
+                        anchor, other, kind = a, w, "inconsistent"
+                    else:
+                        anchor, other, kind = w, a, "inconsistent"
+                    mark = (anchor[0], anchor[2].line)
+                    if mark in anchored:
+                        continue
+                    anchored.add(mark)
+                    yield key, anchor, other, kind
+                    break   # one peer per write site is enough
+
+
+def _roots_conflict(r1, r2):
+    """Can the two sites execute concurrently?  Yes when they are
+    reachable from two distinct thread roots, or from one root that
+    runs in several threads at once (socketserver handlers, pools)."""
+    union = {n for n, _m in list(r1) + list(r2)}
+    if len(union) > 1:
+        return True
+    return any(m for _n, m in list(r1) + list(r2))
+
+
+# ---------------------------------------------------------------------------
+# the project-scope rules
+# ---------------------------------------------------------------------------
+
+class ProjectRule(Rule):
+    scope = "project"
+
+    def check(self, ctx):          # file-scope entry point unused
+        return iter(())
+
+    def check_project(self, project: ProjectIndex) -> Iterator[Diagnostic]:
+        raise NotImplementedError
+
+    def _emit(self, rule_id, path, line, col, message, snippet,
+              threads=(), peer=None):
+        if self.path_patterns and not any(
+                fnmatch.fnmatch(path, p) for p in self.path_patterns):
+            return None
+        return Diagnostic(rule_id, path, line, col, message, snippet,
+                          threads=tuple(threads), peer=peer)
+
+
+def _thread_names(*rootlists):
+    names = set()
+    for rl in rootlists:
+        for n, _m in rl:
+            names.add(n)
+    return sorted(names)
+
+
+@register_rule
+class UnguardedSharedWrite(ProjectRule):
+    id = "unguarded-shared-write"
+    description = ("an object attribute written with NO lock held while "
+                   "another thread root reads or writes it; interleaved "
+                   "steps corrupt training state silently.  Anchored on "
+                   "the write site; the conflicting peer site is named "
+                   "in the message (it may be in another file)")
+    invariant_from = "ISSUE 6 (whole-program lock discipline)"
+
+    def check_project(self, project):
+        for key, anchor, other, kind in project.shared_conflicts():
+            if kind != "unguarded":
+                continue
+            path, cls, attr = key
+            threads = _thread_names(anchor[3], other[3])
+            peer = "%s:%d" % (other[0], other[2].line)
+            if other is anchor:
+                what = ("this write site itself runs concurrently in "
+                        "several threads of root(s) %s"
+                        % ", ".join(threads))
+            else:
+                what = ("it is also %s at %s from thread root(s) %s"
+                        % ("written" if other[2].kind == "w" else "read",
+                           peer, ", ".join(threads)))
+            d = self._emit(
+                self.id, anchor[0], anchor[2].line, anchor[2].col,
+                "%s.%s is written here with no lock held and %s; guard "
+                "both sides with a common lock" % (cls, attr, what),
+                anchor[2].snippet, threads=threads, peer=peer)
+            if d:
+                yield d
+
+
+@register_rule
+class InconsistentGuard(ProjectRule):
+    id = "inconsistent-guard"
+    description = ("a shared attribute is guarded at some sites but a "
+                   "conflicting access holds a DISJOINT lock set — the "
+                   "guard only works if every racing site shares a lock")
+    invariant_from = "ISSUE 6 (whole-program lock discipline)"
+
+    def check_project(self, project):
+        for key, anchor, other, kind in project.shared_conflicts():
+            if kind != "inconsistent":
+                continue
+            path, cls, attr = key
+            threads = _thread_names(anchor[3], other[3])
+            peer = "%s:%d" % (other[0], other[2].line)
+            d = self._emit(
+                self.id, anchor[0], anchor[2].line, anchor[2].col,
+                "%s.%s accessed here under {%s} but a conflicting %s at "
+                "%s holds {%s}; no common lock protects this pair "
+                "(thread roots %s)"
+                % (cls, attr, ", ".join(sorted(anchor[4])) or "no lock",
+                   "write" if other[2].kind == "w" else "access", peer,
+                   ", ".join(sorted(other[4])) or "no lock",
+                   ", ".join(threads)),
+                anchor[2].snippet, threads=threads, peer=peer)
+            if d:
+                yield d
+
+
+@register_rule
+class LockOrderCycle(ProjectRule):
+    id = "lock-order-cycle"
+    description = ("the static lock-acquisition graph has a cycle: two "
+                   "thread roots taking the same locks in opposite "
+                   "order deadlock under contention")
+    invariant_from = "ISSUE 6 (lock hierarchy)"
+
+    def check_project(self, project):
+        for cyc in project.lock_cycles():
+            a, b, site = cyc[0]
+            path, _, line = site.rpartition(":")
+            chain = " -> ".join([s[0] for s in cyc] + [cyc[0][0]])
+            sites = "; ".join("%s->%s at %s" % s for s in cyc)
+            try:
+                lineno = int(line)
+            except ValueError:
+                path, lineno = site, 1
+            snippet = ""
+            s = project.summaries.get(path)
+            if s is not None:
+                for f in s.funcs.values():
+                    for acq in f.acqs:
+                        if acq.line == lineno:
+                            snippet = acq.snippet
+            d = self._emit(
+                self.id, path or site, lineno, 0,
+                "lock-acquisition cycle %s (%s): threads taking these "
+                "locks in opposite order deadlock; pick one hierarchy "
+                "and reorder" % (chain, sites), snippet)
+            if d:
+                yield d
+
+
+@register_rule
+class BlockingWaitUnbounded(ProjectRule):
+    id = "blocking-wait-unbounded"
+    description = ("Event.wait()/Condition.wait()/Lock.acquire()/"
+                   "join()/proc.wait() without a timeout in fault/"
+                   "kvstore/health/supervisor code: a wedged peer parks "
+                   "this thread forever — pass a timeout or budget the "
+                   "wait with fault.Deadline")
+    invariant_from = "ISSUE 6 (bounded waits in recovery paths)"
+    path_patterns = ("mxnet_tpu/fault.py", "mxnet_tpu/health.py",
+                     "mxnet_tpu/kvstore/*.py", "tools/launch.py")
+
+    def check_project(self, project):
+        for fid, (path, fn) in sorted(project.funcs.items()):
+            for ws in fn.waits:
+                if ws.has_timeout:
+                    continue
+                d = self._emit(
+                    self.id, path, ws.line, ws.col,
+                    "%s() on %r without a timeout blocks this thread "
+                    "forever if the peer is wedged; pass a timeout (or "
+                    "drive the budget through fault.Deadline)"
+                    % (ws.kind, ws.recv), ws.snippet)
+                if d:
+                    yield d
+
+
+@register_rule
+class ThreadLeak(ProjectRule):
+    id = "thread-leak"
+    description = ("a non-daemon Thread is started without a matching "
+                   "join()/stop-event: it outlives its owner and blocks "
+                   "interpreter shutdown")
+    invariant_from = "ISSUE 6 (thread lifecycle hygiene)"
+
+    def check_project(self, project):
+        for fid, (path, fn) in sorted(project.funcs.items()):
+            for sp in fn.spawns:
+                if sp.kind != "thread" or sp.daemon is True:
+                    continue
+                binding = sp.binding
+                if binding is not None and self._handled(
+                        project, path, binding):
+                    continue
+                if self._target_has_stop_event(project, path, sp):
+                    continue
+                d = self._emit(
+                    self.id, path, sp.line, sp.col,
+                    "non-daemon Thread started here has no join() or "
+                    "stop event anywhere in this project; it outlives "
+                    "its owner — set daemon=True, join it on close(), "
+                    "or loop it on a stop Event", sp.snippet)
+                if d:
+                    yield d
+
+    @staticmethod
+    def _handled(project, path, binding):
+        # a bare local name ('t') only matches joins in the SPAWNING
+        # file — an unrelated `t.join()` elsewhere must not silence the
+        # leak; class-qualified bindings ('KVStore._hb_thread') are
+        # unambiguous and match project-wide (close() may live in a
+        # subclass file)
+        for fid, (p, fn) in project.funcs.items():
+            if "." not in binding and p != path:
+                continue
+            if binding in fn.joins or binding in fn.daemon_set:
+                return True
+        return False
+
+    @staticmethod
+    def _target_has_stop_event(project, path, sp):
+        fid = project._resolve_ref(path, sp.target)
+        if fid is None:
+            return False
+        for f in project._closure([fid]):
+            _p, fn = project.funcs[f]
+            for ws in fn.waits:
+                if ws.kind.startswith("Event."):
+                    return True
+        return False
