@@ -47,6 +47,14 @@ class Engine:
         # bench.py --exchange read deltas of this to report measured
         # bytes-per-step, compressed vs fp32 (ISSUE 5 acceptance).
         self.wire_bytes = 0
+        # whole-step-compiled accounting (ISSUE 7): a lax.scan window of N
+        # training steps is ONE device-program launch — dispatch_count
+        # grows by the window's launches (1, +1 for its host->device batch
+        # transfer), never by N.  compiled_steps tracks the optimizer
+        # steps those windows covered so tools/dispatch_count.py can
+        # report dispatches-per-step < 1 in scan mode.
+        self.compiled_step_windows = 0
+        self.compiled_steps = 0
 
     def track(self, chunk) -> None:
         self._live.add(chunk)
@@ -54,6 +62,14 @@ class Engine:
     def count_dispatch(self, n: int = 1) -> None:
         """Note `n` device-program dispatches (hot path: one int add)."""
         self.dispatch_count += n
+
+    def count_step_window(self, steps: int, dispatches: int = 1) -> None:
+        """Note one compiled N-step window: `steps` optimizer steps
+        executed under `dispatches` device launches (the window dispatch,
+        plus any host->device input transfer the caller counts)."""
+        self.dispatch_count += int(dispatches)
+        self.compiled_step_windows += 1
+        self.compiled_steps += int(steps)
 
     def count_wire_bytes(self, n: int) -> None:
         """Note `n` gradient-exchange wire bytes (hot path: one int add)."""

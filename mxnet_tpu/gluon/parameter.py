@@ -288,7 +288,10 @@ class Parameter:
             return
         for arr in self._data.values():
             if isinstance(data, NDArray):
-                arr._set_jax(data.as_in_context(arr.context)._jax.astype(arr.dtype))
+                # copyto, not a raw _set_jax of data's array: on the same
+                # device+dtype that would alias data's buffer, and a
+                # donated alias (compiled-step lane) dies with the donor
+                data.copyto(arr)
             else:
                 arr[:] = data
 

@@ -205,6 +205,20 @@ class Trainer:
     def set_learning_rate(self, lr):
         self._optimizer.set_learning_rate(lr)
 
+    # -- whole-step compiled lane (ISSUE 7) --------------------------------
+    def make_compiled_step(self, net, loss_fn, metric=None):
+        """A :class:`mxnet_tpu.step.CompiledStep` over this trainer:
+        forward + loss + backward + this trainer's gradient exchange
+        (incl. int8/2bit compression) + the fused optimizer apply (+ the
+        metric's device accumulate) as ONE donated jit per step — the
+        MX_STEP_COMPILE lane.  The returned object reads/writes this
+        trainer's parameters, updater state and error-feedback residuals
+        every dispatch, so eager ``step()`` calls, ``save_states`` and
+        checkpoints interoperate; transports the trace cannot express
+        (dist_async) fall back to the eager pipeline automatically."""
+        from ..step import CompiledStep
+        return CompiledStep(net, loss_fn, self, metric=metric)
+
     # -- the step ----------------------------------------------------------
     def step(self, batch_size, ignore_stale_grad=False):
         """allreduce + update (reference: Trainer.step)."""

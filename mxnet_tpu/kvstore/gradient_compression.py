@@ -98,6 +98,23 @@ class GradientCompression:
     def _donate(self, key) -> bool:
         return key not in self._pinned
 
+    # -- compiled-step residual threading (ISSUE 7) --------------------------
+    def peek_residual(self, key, shape, dtype=None):
+        """Current residual for `key` as a concrete array (zeros when
+        absent or shape-rolled) WITHOUT popping it — the whole-step
+        compiled lane reads every wire key's residual as a donated jit
+        input and writes the new state back via :meth:`put_residual`
+        after the dispatch."""
+        res = self._residuals.get(key)
+        if res is None or res.shape != tuple(shape):
+            return jnp.zeros(tuple(shape), dtype or jnp.float32)
+        return res
+
+    def put_residual(self, key, value) -> None:
+        """Install the post-step residual for `key` (the compiled step's
+        write-back half of :meth:`peek_residual`)."""
+        self._residuals[key] = value
+
     # -- overlap-session checkpointing (relaunch rollback) -------------------
     def checkpoint(self, keys) -> None:
         """Pin the CURRENT residuals of `keys`: until :meth:`commit`,

@@ -144,6 +144,77 @@ class KVStore:
         _engine.count_wire_bytes(
             self._wire_nbytes(m.size, m._jax.dtype.itemsize, floating))
 
+    # -- whole-step-compiled exchange (ISSUE 7) ----------------------------
+    def build_exchange_body(self, keys, arrays):
+        """Pure-traceable single-worker exchange body for the compiled
+        train step (mxnet_tpu.step.CompiledStep): what ONE worker's
+        batched push+pull observes of this store's wire, expressed as a
+        jax-pure function so the whole gradient exchange inlines into
+        the step's single XLA program.
+
+        ``arrays`` are per-key templates (NDArray; shape/dtype only).
+        Returns a :class:`TraceableExchange` — or None when the
+        transport cannot be traced (host-blocking RPCs on the PS store,
+        cross-process collectives that need the SPMD mesh lane) and the
+        caller must fall back to the eager pipeline.
+
+        The base store's semantics (local/device): per-key error-feedback
+        quantization when gradient compression is installed (exactly
+        :meth:`_reduce`'s wire model), bf16 cast-roundtrip under the
+        bf16 mode, identity otherwise.
+        """
+        if self._updater is not None or self._optimizer is not None:
+            return None     # server-side optimizer: push is not a pure exchange
+        keys = [_key(k) for k in keys]
+        gc = getattr(self, "_gc", None)
+        bf16 = getattr(self, "_compress_bf16", False)
+        plan = []           # per position: (mode, wire_key or None)
+        specs = []          # (wire_key, residual shape, residual dtype)
+        wire_bytes = 0
+        for k, a in zip(keys, arrays):
+            floating = jnp.issubdtype(jnp.dtype(str(a.dtype)), jnp.floating)
+            if gc is not None and floating:
+                plan.append((gc.type, k))
+                if gc.type == "int8":
+                    specs.append((k, (int(a.size),), jnp.float32))
+                else:
+                    specs.append((k, tuple(a.shape),
+                                  jnp.dtype(str(a.dtype))))
+                wire_bytes += gc.wire_nbytes(int(a.size))
+                continue
+            if bf16 and floating and _np.dtype(str(a.dtype)).itemsize == 4:
+                plan.append(("bf16", None))
+                wire_bytes += 2 * int(a.size)
+            else:
+                plan.append(("none", None))
+                wire_bytes += int(a.size) * _np.dtype(str(a.dtype)).itemsize
+        block = gc.block if gc is not None and gc.type == "int8" else 0
+        threshold = gc.threshold if gc is not None else 0.0
+
+        def body(grads, residuals):
+            from ..ops import quantization as _qops
+            res_it = iter(residuals)
+            new_grads, new_res = [], []
+            for (mode, _wk), g in zip(plan, grads):
+                if mode == "int8":
+                    deq, nr = _qops._roundtrip_int8_kernel(
+                        g.reshape(-1), next(res_it), block)
+                    new_grads.append(deq.reshape(g.shape).astype(g.dtype))
+                    new_res.append(nr)
+                elif mode == "2bit":
+                    q, nr = _qops._quantize_2bit_kernel(
+                        g, next(res_it), jnp.asarray(threshold, g.dtype))
+                    new_grads.append(q)
+                    new_res.append(nr)
+                elif mode == "bf16":
+                    new_grads.append(
+                        g.astype(jnp.bfloat16).astype(g.dtype))
+                else:
+                    new_grads.append(g)
+            return new_grads, new_res
+
+        return TraceableExchange(specs, body, wire_bytes)
+
     # -- overlap-scheduled exchange (ISSUE 5) ------------------------------
     def begin_exchange(self, keys, vlists):
         """Open an overlap-scheduled batched exchange: the caller feeds
@@ -367,6 +438,30 @@ class KVStore:
         if orig_dtype is not None:
             out = out.astype(orig_dtype)
         return NDArray(out, ctx=target)
+
+
+class TraceableExchange:
+    """One store's gradient exchange as a pure function (ISSUE 7).
+
+    ``residual_specs`` names the error-feedback residual state the body
+    threads through — ``[(wire_key, shape, dtype)]`` in the exact order
+    the body consumes/produces them; the compiled step reads each via
+    ``GradientCompression.peek_residual`` (donated jit input) and writes
+    the returned state back with ``put_residual`` after the dispatch, so
+    eager and compiled steps share one residual store (checkpoint /
+    mode-switch continuity).  ``wire_bytes`` is the static per-step wire
+    accounting (``engine.count_wire_bytes``) the eager path would have
+    recorded for the same exchange.
+    """
+
+    def __init__(self, residual_specs, body, wire_bytes: int = 0):
+        self.residual_specs = list(residual_specs)
+        self._body = body
+        self.wire_bytes = int(wire_bytes)
+
+    def __call__(self, grads, residuals):
+        """(new_grads, new_residuals) — pure, safe under an outer jit."""
+        return self._body(grads, residuals)
 
 
 class _ExchangeSession:
@@ -793,6 +888,71 @@ class KVStoreICI(KVStoreLocal):
                                   ctx=m.context))
         return pieces
 
+    def build_exchange_body(self, keys, arrays):
+        """ICI's traceable body mirrors :meth:`_reduce_many`'s
+        single-process semantics: int8 compression quantizes per FUSION
+        BUCKET (concat → error-feedback roundtrip keyed by the bucket's
+        CRC name → split), solo/2bit/bf16 keys ride the per-key base
+        body.  Multi-process exchange needs the SPMD mesh lane
+        (parallel.TrainStep) — the compiled Gluon step falls back to the
+        eager pipeline there."""
+        if self._size > 1:
+            return None
+        gc = getattr(self, "_gc", None)
+        if gc is None or gc.type != "int8" or \
+                self._updater is not None or self._optimizer is not None:
+            return super().build_exchange_body(keys, arrays)
+        keys = [_key(k) for k in keys]
+        buckets: List = []
+        solo = range(len(keys))
+        if len(keys) > 1:
+            eligible = all(isinstance(a, NDArray) for a in arrays)
+            if eligible:
+                buckets, solo = self._bucket_plans(keys, arrays)
+        solo = list(solo)
+        block = gc.block
+        specs = []
+        wire_bytes = 0
+        solo_modes = []
+        for b in buckets:
+            specs.append((b.name, (int(b.total),), jnp.float32))
+            wire_bytes += gc.wire_nbytes(int(b.total))
+        for p in solo:
+            a = arrays[p]
+            floating = jnp.issubdtype(jnp.dtype(str(a.dtype)), jnp.floating)
+            if floating:
+                specs.append((keys[p], (int(a.size),), jnp.float32))
+                wire_bytes += gc.wire_nbytes(int(a.size))
+                solo_modes.append("int8")
+            else:
+                wire_bytes += int(a.size) * _np.dtype(str(a.dtype)).itemsize
+                solo_modes.append("none")
+
+        def body(grads, residuals):
+            from ..ops import quantization as _qops
+            res_it = iter(residuals)
+            new_grads = list(grads)
+            new_res = []
+            for b in buckets:
+                flat = jnp.concatenate(
+                    [grads[p].reshape(-1) for p in b.positions])
+                deq, nr = _qops._roundtrip_int8_kernel(flat, next(res_it),
+                                                       block)
+                new_res.append(nr)
+                for p, off, size, shape in b.slices():
+                    new_grads[p] = deq[off:off + size].reshape(shape).astype(
+                        grads[p].dtype)
+            for p, mode in zip(solo, solo_modes):
+                if mode == "int8":
+                    g = grads[p]
+                    deq, nr = _qops._roundtrip_int8_kernel(
+                        g.reshape(-1), next(res_it), block)
+                    new_grads[p] = deq.reshape(g.shape).astype(g.dtype)
+                    new_res.append(nr)
+            return new_grads, new_res
+
+        return TraceableExchange(specs, body, wire_bytes)
+
     def _barrier(self):
         if self._size > 1:
             from jax.experimental import multihost_utils
@@ -1170,6 +1330,14 @@ class KVStoreDistAsync(KVStore):
         the batched push/pull."""
         return None
 
+    def build_exchange_body(self, keys, arrays):
+        """Untraceable: the exchange crosses a TCP socket mid-step (the
+        server applies pushes the moment they arrive), so there is no
+        pure function of the local gradients to inline — the compiled
+        step lane (MX_STEP_COMPILE) falls back to the eager pipeline on
+        this transport."""
+        return None
+
     def _wire_gc(self):
         """The compact-wire compressor, when one is installed (2bit/int8;
         bf16 is a collective-path cast with no numpy dtype, so the PS
@@ -1259,12 +1427,17 @@ class KVStoreDistAsync(KVStore):
             for p, off, size, shape in b.slices():
                 piece = flat[off:off + size].reshape(shape)
                 for t in target_lists[p]:
-                    t._set_jax(nd.array(piece).astype(t.dtype)._jax)
+                    # home the pulled value on the TARGET's device — a
+                    # default-ctx array labeled with t's context would
+                    # feed mixed-device operands into later jits
+                    t._set_jax(nd.array(piece, ctx=t.context)
+                               .astype(t.dtype)._jax)
         for p in sorted(solo):
             arr = self._pull_np(keys[p], firsts[p].shape,
                                 int(firsts[p].size))
             for t in target_lists[p]:
-                t._set_jax(nd.array(arr).astype(t.dtype)._jax)
+                t._set_jax(nd.array(arr, ctx=t.context)
+                           .astype(t.dtype)._jax)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull CURRENT server rows (the base implementation reads the

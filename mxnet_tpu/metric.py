@@ -134,6 +134,17 @@ class EvalMetric:
             _engine.count_dispatch()
             self._dev_sum, self._dev_inst = kernel(ds, di, *arrays)
 
+    def _trace_kernel(self):
+        """(kernel, argspec) for folding this metric's accumulate into a
+        whole-step compiled program (ISSUE 7; mxnet_tpu.step) — the same
+        jitted kernel :meth:`_accumulate` dispatches, inlined into the
+        step's single XLA program with the device accumulators carried as
+        donated state.  argspec names the operand order after (sum,
+        count): 'pred_label', 'label_pred' or 'loss'.  None = this metric
+        has no pure device kernel; callers accumulate eagerly from the
+        step's returned outputs instead."""
+        return None
+
     def _drain_device(self):
         """Host sync point: move the device accumulators into the classic
         sum_metric/num_inst fields (called by get())."""
@@ -210,6 +221,9 @@ class Accuracy(EvalMetric):
                  label_names=None):
         super().__init__(name, output_names, label_names, axis=axis)
         self.axis = axis
+
+    def _trace_kernel(self):
+        return _acc_kernel(self.axis), "pred_label"
 
     def update(self, labels, preds):
         labels = labels if isinstance(labels, (list, tuple)) else [labels]
@@ -386,6 +400,9 @@ class Perplexity(EvalMetric):
         self.ignore_label = ignore_label
         self.axis = axis
 
+    def _trace_kernel(self):
+        return _ppl_kernel(self.ignore_label), "pred_label"
+
     def update(self, labels, preds):
         labels = labels if isinstance(labels, (list, tuple)) else [labels]
         preds = preds if isinstance(preds, (list, tuple)) else [preds]
@@ -434,6 +451,9 @@ class _RegressionMetric(EvalMetric):
     """Shared MAE/MSE accumulation (device path + host fallback)."""
 
     _squared = False
+
+    def _trace_kernel(self):
+        return _regression_kernel(self._squared), "label_pred"
 
     def update(self, labels, preds):
         labels = labels if isinstance(labels, (list, tuple)) else [labels]
@@ -500,6 +520,9 @@ class CrossEntropy(EvalMetric):
         super().__init__(name, output_names, label_names, eps=eps)
         self.eps = eps
 
+    def _trace_kernel(self):
+        return _ce_kernel(self.eps), "label_pred"
+
     def update(self, labels, preds):
         labels = labels if isinstance(labels, (list, tuple)) else [labels]
         preds = preds if isinstance(preds, (list, tuple)) else [preds]
@@ -550,6 +573,9 @@ class Loss(EvalMetric):
 
     def __init__(self, name="loss", output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
+
+    def _trace_kernel(self):
+        return _loss_kernel, "loss"
 
     def update(self, _, preds):
         preds = preds if isinstance(preds, (list, tuple)) else [preds]

@@ -201,6 +201,13 @@ class BaseModule:
         self.forward(data_batch, is_train=True)
         self.backward()
 
+    def _compiled_fit_batch(self, data_batch, eval_metric):
+        """Whole-step-compiled fit iteration (MX_STEP_COMPILE=1): run
+        forward+backward+update+metric as one dispatch and return True,
+        or return False to run the classic eager body.  Base modules
+        (FeedForward) have no compiled lane."""
+        return False
+
     def _named_update_grads(self):
         """(name, grad NDArray) pairs the next update() will apply —
         what health.GradientGuard scans for NaN/Inf.  Module exposes its
@@ -352,6 +359,13 @@ class BaseModule:
                     checkpoint_period, batch_end_callback,
                     epoch_end_callback, eval_end_callback,
                     eval_batch_end_callback):
+        from ..step import step_compile_enabled
+        # whole-step compiled lane (ISSUE 7): fwd+bwd+fused update+
+        # metric accumulate in ONE donated jit per batch.  The eager body
+        # remains the debug path — per-node monitors and the NaN grad
+        # guard need materialized per-step gradients, so they keep it.
+        use_compiled = step_compile_enabled() and monitor is None and \
+            guard.grad_guard is None
         for epoch in range(begin_epoch, num_epoch):
             eval_metric.reset()
             train_data.reset()
@@ -365,19 +379,23 @@ class BaseModule:
                 _fault.fire("worker.step")
                 if monitor is not None:
                     monitor.tic()
-                self.forward_backward(data_batch)
-                # the grad scan is built only when a NaN policy is armed
-                # — an unconfigured run pays one attribute check here
-                if guard.grad_guard is None or \
-                        guard.allow_update(self._named_update_grads()):
-                    self.update()
-                elif getattr(self, "_grad_req", None) == "add":
-                    # skipped batch under accumulating gradients: purge
-                    # the poisoned sums, or the NaN would infect every
-                    # later backward's += and freeze training silently
-                    for _n, g in self._named_update_grads():
-                        g._set_jax(jnp.zeros_like(g._jax))
-                self.update_metric(eval_metric, data_batch.label)
+                if not (use_compiled and
+                        self._compiled_fit_batch(data_batch, eval_metric)):
+                    self.forward_backward(data_batch)
+                    # the grad scan is built only when a NaN policy is
+                    # armed — an unconfigured run pays one attribute
+                    # check here
+                    if guard.grad_guard is None or \
+                            guard.allow_update(self._named_update_grads()):
+                        self.update()
+                    elif getattr(self, "_grad_req", None) == "add":
+                        # skipped batch under accumulating gradients:
+                        # purge the poisoned sums, or the NaN would infect
+                        # every later backward's += and freeze training
+                        # silently
+                        for _n, g in self._named_update_grads():
+                            g._set_jax(jnp.zeros_like(g._jax))
+                    self.update_metric(eval_metric, data_batch.label)
                 if monitor is not None:
                     monitor.toc_print()
                 guard.batch_end(epoch, nbatch)
@@ -522,6 +540,14 @@ class Module(BaseModule):
         self._jit_step = {}
         self._fast_grads = None
         self._jit_ok = None
+        # MX_STEP_COMPILE lane: fwd+bwd+fused update+metric as ONE jit;
+        # _compiled_owned tracks the arrays the lane's own dispatches
+        # produced — only those may be donated (foreign arrays can be
+        # aliased by shared modules / set_params sources and must be
+        # copied before donation)
+        self._compiled_fit = {}
+        self._compiled_owned: set = set()
+        self._compiled_owned_refs: list = []
 
     # -- properties ---------------------------------------------------------
     @property
@@ -863,11 +889,9 @@ class Module(BaseModule):
             self._exec.arg_dict[name] = arr
         return True
 
-    def forward(self, data_batch, is_train=None):
-        """Reference: Module.forward."""
-        assert self.binded and self.params_initialized
-        if is_train is None:
-            is_train = self.for_training
+    def _collect_feeds(self, data_batch):
+        """Name-matched feeds for one batch (sets self._labels) — shared
+        by forward() and the whole-step compiled fit path."""
         def in_batch_order(arrays, descs, wanted):
             """Reference DataParallelExecutorGroup matches batch arrays to
             module slots by NAME (DataDesc), not position — NDArrayIter
@@ -898,6 +922,14 @@ class Module(BaseModule):
                 if name in self._exec.arg_dict:  # labels a non-loss head uses
                     feeds[name] = arr
                 self._labels.append(arr)
+        return feeds
+
+    def forward(self, data_batch, is_train=None):
+        """Reference: Module.forward."""
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        feeds = self._collect_feeds(data_batch)
         if self._try_fast_forward(feeds, is_train):
             return
         self._fast_grads = None
@@ -958,6 +990,233 @@ class Module(BaseModule):
                         "requires the same)" % node.name)
                 out_grads.append(g)
         self._exec.backward(out_grads)
+
+    # -- whole-step compiled fit (ISSUE 7: MX_STEP_COMPILE) ------------------
+    def _compiled_fit_batch(self, data_batch, eval_metric):
+        """One fit-loop iteration — forward, loss-head gradients, vjp,
+        fused optimizer apply and (when the metric has a device kernel)
+        the metric accumulate — as ONE jitted dispatch.  Returns False
+        when this configuration cannot compile (the caller runs the
+        classic eager body): per-node monitors, group2ctx, non-loss
+        heads, grad_req='add', or an optimizer without a pure tree
+        kernel."""
+        from ..symbol import whole_graph_jit_enabled
+        from ..step import metric_trace_kernel
+        from ..ops.optimizer import tree_body
+        if self._jit_ok is False or self._exec._group2ctx \
+                or not whole_graph_jit_enabled() \
+                or self._grad_req != "write" or self.inputs_need_grad:
+            return False
+        opt = self._updater.optimizer
+        spec = opt._compiled_spec()
+        if spec is None:
+            return False
+        feeds = self._collect_feeds(data_batch)
+        labels = self._resolve_head_labels()
+        if any(r is None or l is None
+               for r, l in zip(self._head_rules, labels)):
+            return False
+
+        trainable = [n for n in self._param_names
+                     if n in self._exec.grad_dict]
+        name2idx = {n: i for i, n in enumerate(self._param_names)}
+        mp_flags = []
+        for n in trainable:
+            i = name2idx[n]
+            w = self._exec.arg_dict[n]
+            if i not in self._updater.states:
+                self._updater.states[i] = \
+                    opt.create_state_multi_precision(i, w)
+                self._updater.states_synced[i] = True
+            mp_flags.append(bool(opt._is_mp_state(
+                w, self._updater.states[i])))
+        diff_names = sorted(self._exec.grad_dict)
+        other = {}
+        diff = {}
+        for name, arr in self._exec.arg_dict.items():
+            v = feeds[name]._jax if name in feeds else arr._jax
+            (diff if name in self._exec.grad_dict else other)[name] = v
+        for name, arr in self._exec.aux_dict.items():
+            other[name] = arr._jax
+        from ..step import metric_cache_key
+        metric_info = metric_trace_kernel(eval_metric)
+        # wd/clip are baked into the trace as statics: they belong in the
+        # cache key so a mid-run mutation retraces instead of silently
+        # reusing the stale values (the eager path reads them per step)
+        wds = tuple(opt._get_wds([name2idx[n] for n in trainable]))
+        clip = -1.0 if opt.clip_gradient is None else \
+            float(opt.clip_gradient)
+        key = ("fit",
+               tuple((n, tuple(v.shape), str(v.dtype))
+                     for n, v in sorted(diff.items())),
+               tuple((n, tuple(v.shape), str(v.dtype))
+                     for n, v in sorted(other.items())),
+               tuple((tuple(l._jax.shape), str(l.dtype)) for l in labels),
+               spec["kind"], tuple(sorted(spec["static"].items())),
+               tuple(mp_flags), float(opt.rescale_grad), wds, clip,
+               metric_cache_key(eval_metric, metric_info))
+        step = self._compiled_fit.get(key)
+        if step is None:
+            step = self._build_compiled_fit(spec, trainable, mp_flags,
+                                            metric_info, tree_body,
+                                            wds, clip)
+            if step is None:
+                return False
+            self._compiled_fit[key] = step
+        # host-side optimizer bookkeeping: num_update advance + per-param
+        # effective lr/decay as traced scalars (schedulers never recompile)
+        idxs = [name2idx[n] for n in trainable]
+        ctx = self._context
+        opt._set_current_context((ctx.canonical_type, ctx.device_id))
+        opt._update_count(idxs)
+        raw = opt._get_lrs(idxs)
+        wds = opt._get_wds(idxs)
+        decay_vec = None
+        if spec.get("decay_fn") is not None:
+            decay_vec = jnp.asarray(_np.asarray(
+                [spec["decay_fn"](i, lr, wd)
+                 for i, lr, wd in zip(idxs, raw, wds)], _np.float32))
+        if spec.get("lr_fn") is not None:
+            raw = [spec["lr_fn"](i, lr) for i, lr in zip(idxs, raw)]
+        lr_vec = jnp.asarray(_np.asarray(raw, _np.float32))
+        if self._exec._rng_needed():
+            from ..ops.random import next_key
+            rng = next_key()
+        else:
+            rng = jax.random.PRNGKey(0)
+        states, w32s = [], []
+        for pos, n in enumerate(trainable):
+            inner, w32 = spec["unpack"](self._updater.states[name2idx[n]],
+                                        mp_flags[pos])
+            states.append(tuple(s._jax for s in inner))
+            w32s.append(w32._jax if w32 is not None else None)
+        mstate = None
+        if metric_info is not None:
+            ds = getattr(eval_metric, "_dev_sum", None)
+            mstate = (ds, eval_metric._dev_inst) if ds is not None else \
+                (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32))
+        from ..engine import engine as _engine
+        label_vals = [l._jax for l in labels]
+
+        def donatable(a):
+            if a is None or id(a) in self._compiled_owned:
+                return a
+            return jnp.array(a, copy=True)   # foreign: may be aliased
+
+        diff = {n: donatable(v) for n, v in diff.items()}
+        states = tuple(tuple(donatable(s) for s in inner)
+                       for inner in states)
+        w32s = tuple(donatable(w) for w in w32s)
+        (new_diff, new_states, new_w32, aux_new, outs,
+         new_mstate) = step(diff, other, states, w32s,
+                            label_vals, rng, lr_vec, decay_vec, mstate)
+        self._compiled_owned_refs = [
+            a for a in jax.tree_util.tree_leaves(
+                (new_diff, new_states, new_w32))
+            if a is not None]
+        self._compiled_owned = {id(a) for a in self._compiled_owned_refs}
+        _engine.count_step_window(1)
+        for name, val in new_diff.items():
+            arr = self._exec.arg_dict[name]
+            arr._set_jax(val.astype(arr.dtype))
+        for pos, n in enumerate(trainable):
+            inner, w32 = spec["unpack"](self._updater.states[name2idx[n]],
+                                        mp_flags[pos])
+            for s_nd, val in zip(inner, new_states[pos]):
+                s_nd._set_jax(val.astype(s_nd.dtype))
+            if w32 is not None and new_w32[pos] is not None:
+                w32._set_jax(new_w32[pos])
+        for name, val in aux_new.items():
+            tgt = self._exec.aux_dict.get(name)
+            if tgt is not None:
+                tgt._set_jax(val.astype(tgt.dtype))
+        self._outputs = [nd.from_jax(o, ctx=ctx) for o in outs]
+        self._fast_grads = None
+        if new_mstate is not None:
+            eval_metric._dev_sum, eval_metric._dev_inst = new_mstate
+        else:
+            self.update_metric(eval_metric, data_batch.label)
+        return True
+
+    def _build_compiled_fit(self, spec, trainable, mp_flags,
+                            metric_info, tree_body, wds, clip):
+        from ..symbol import build_pure_fn, NotJittableGraph
+        try:
+            pure = build_pure_fn(self._exec_symbol, is_train=True)
+        except NotJittableGraph:
+            self._jit_ok = False
+            return None
+        head_nodes = [n for n, _ in self._symbol._heads]
+        cores = []
+        for node, rule in zip(head_nodes, self._head_rules):
+            cores.append((_RULE_CORES[node.op],
+                          {k: v for k, v in rule[1].items()}))
+        body = tree_body(spec["kind"])
+        statics = dict(spec["static"])
+        n_state = spec["n_state"]
+        groups: Dict[bool, List[int]] = {}
+        for pos, mp in enumerate(mp_flags):
+            groups.setdefault(mp, []).append(pos)
+        mp_groups = sorted(groups.items())
+        opt = self._updater.optimizer
+        rescale = float(opt.rescale_grad)
+        order = metric_info[1] if metric_info is not None else None
+        kernel = metric_info[0] if metric_info is not None else None
+
+        def _traced_fit_step(diff_vals, other_vals, states, w32s,
+                             label_vals, rng, lr_vec, decay_vec, mstate):
+            def f(dv):
+                heads, aux_new = pure({**dv, **other_vals}, rng)
+                return tuple(heads), aux_new
+
+            heads, vjp_fn, aux_new = jax.vjp(f, diff_vals, has_aux=True)
+            outs, cots = [], []
+            for z, (core, attrs), lab in zip(heads, cores, label_vals):
+                out, g = core(z, lab, attrs)
+                outs.append(out)
+                cots.append(g)
+            (d_diff,) = vjp_fn(tuple(cots))
+            new_diff = dict(diff_vals)
+            new_states = list(states)
+            new_w32 = list(w32s)
+            for mp, poss in mp_groups:
+                names = [trainable[p] for p in poss]
+                ws = tuple(diff_vals[n] for n in names)
+                gs = tuple(d_diff[n].astype(diff_vals[n].dtype)
+                           for n in names)
+                cols = [tuple(states[p][j] for p in poss)
+                        for j in range(n_state)]
+                args = [ws, gs] + cols
+                args.append(tuple(w32s[p] for p in poss) if mp else None)
+                args.append(lr_vec[jnp.asarray(poss, jnp.int32)])
+                if decay_vec is not None:
+                    args.append(decay_vec[jnp.asarray(poss, jnp.int32)])
+                out_w, out_states, out_w32 = body(
+                    *args, wds=tuple(wds[p] for p in poss),
+                    rescale_grad=rescale, clip_gradient=clip, mp=mp,
+                    **statics)
+                for j, (p, n) in enumerate(zip(poss, names)):
+                    new_diff[n] = out_w[j]
+                    if out_states is not None:
+                        new_states[p] = tuple(col[j] for col in out_states)
+                    if mp and out_w32 is not None:
+                        new_w32[p] = out_w32[j]
+            if mstate is not None and kernel is not None:
+                msum, minst = mstate
+                if order == "loss":
+                    new_mstate = tuple(kernel(msum, minst, outs[0]))
+                elif order == "label_pred":
+                    new_mstate = tuple(kernel(msum, minst, label_vals[0],
+                                              outs[0]))
+                else:
+                    new_mstate = tuple(kernel(msum, minst, outs[0],
+                                              label_vals[0]))
+            else:
+                new_mstate = mstate
+            return (new_diff, tuple(new_states), tuple(new_w32), aux_new,
+                    tuple(outs), new_mstate)
+
+        return jax.jit(_traced_fit_step, donate_argnums=(0, 2, 3))
 
     def update(self):
         """Reference: Module.update — updater over (grad, weight) pairs,

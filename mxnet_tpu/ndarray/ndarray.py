@@ -306,7 +306,16 @@ class NDArray:
             return NDArray(_put(self._jax, other), ctx=other)
         if not isinstance(other, NDArray):
             raise TypeError("copyto expects NDArray or Context")
-        other._set_jax(_put(self._jax, other.context).astype(other.dtype))
+        if other.context == self.context:
+            # same device: device_put is a no-op and a same-dtype astype
+            # returns an Array SHARING this buffer — copyto must produce
+            # an independent value (the whole-step compiled lane donates
+            # parameter buffers; an alias would be deleted with them)
+            val = self._jax.astype(other.dtype) \
+                if other.dtype != self.dtype else jnp.copy(self._jax)
+        else:
+            val = _put(self._jax, other.context).astype(other.dtype)
+        other._set_jax(val)
         return other
 
     def as_in_context(self, ctx: Context) -> "NDArray":

@@ -850,6 +850,19 @@ _TREE_BODIES = {
 }
 
 
+def tree_body(kind):
+    """The PURE (un-jitted) tree-kernel body for `kind`, or None.
+
+    The whole-step compiled lane (mxnet_tpu.step) inlines these bodies
+    into its single-program trace so the fused eager apply and the
+    compiled step share one implementation of every optimizer's math —
+    signature ``body(weights, grads, *state_cols, weights32, lrs[,
+    decays], **static) -> (new_w, new_state_cols_or_None, new_w32_or_
+    None)`` exactly as :func:`tree_apply` dispatches it."""
+    hit = _TREE_BODIES.get(kind)
+    return hit[0] if hit else None
+
+
 @functools.lru_cache(maxsize=512)
 def _tree_jit(kind, statics, donate):
     body, donatable = _TREE_BODIES[kind]

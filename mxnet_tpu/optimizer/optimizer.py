@@ -142,6 +142,26 @@ class Optimizer:
         per-param update loop."""
         return False
 
+    # -- whole-step compiled lane (ISSUE 7) --------------------------------
+    def _compiled_spec(self):
+        """Functional description of this optimizer's update for the
+        whole-step compiled lane (mxnet_tpu.step.CompiledStep / the
+        Module compiled fit step): a dict with
+
+          ``kind``      — ops.optimizer tree-body name,
+          ``static``    — trace-static kwargs (momentum, betas, ...),
+          ``unpack``    — ``(state, mp) -> (inner_state_tuple, w32)``,
+                          the same layout split _fused_apply uses,
+          ``n_state``   — number of inner state columns,
+          ``lr_fn``     — optional ``(index, lr) -> effective lr`` (host,
+                          per step; bias correction folds in here so the
+                          compiled trace sees lr as a traced scalar),
+          ``decay_fn``  — optional ``(index, lr, wd) -> decoupled decay``.
+
+        Returns None when the optimizer has no pure tree kernel — the
+        compiled lane then falls back to the eager pipeline."""
+        return None
+
     def _is_mp_state(self, weight, state):
         """Same predicate update_multi_precision routes on: a (inner,
         fp32-master) state pair for a low-precision weight."""
@@ -336,6 +356,17 @@ class SGD(Optimizer):
         return self._fused_apply("sgd_mom" if has_mom else "sgd", indices,
                                  weights, grads, states, unpack, **extra)
 
+    def _compiled_spec(self):
+        has_mom = self.momentum != 0.0
+
+        def unpack(state, mp):
+            inner = state[0] if mp else state
+            return ((inner,) if has_mom else ()), (state[1] if mp else None)
+
+        return {"kind": "sgd_mom" if has_mom else "sgd",
+                "static": {"momentum": self.momentum} if has_mom else {},
+                "unpack": unpack, "n_state": 1 if has_mom else 0}
+
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr = self._get_lr(index)
@@ -397,6 +428,17 @@ class NAG(Optimizer):
         return self._fused_apply("nag_mom" if has_mom else "sgd", indices,
                                  weights, grads, states, unpack, **extra)
 
+    def _compiled_spec(self):
+        has_mom = self.momentum != 0.0
+
+        def unpack(state, mp):
+            inner = state[0] if mp else state
+            return ((inner,) if has_mom else ()), (state[1] if mp else None)
+
+        return {"kind": "nag_mom" if has_mom else "sgd",
+                "static": {"momentum": self.momentum} if has_mom else {},
+                "unpack": unpack, "n_state": 1 if has_mom else 0}
+
 
 @register
 class Adam(Optimizer):
@@ -431,6 +473,21 @@ class Adam(Optimizer):
         return self._fused_apply("adam", indices, weights, grads, states,
                                  unpack, lr_fn=lr_fn, beta1=self.beta1,
                                  beta2=self.beta2, epsilon=self.epsilon)
+
+    def _compiled_spec(self):
+        def unpack(state, mp):
+            mean, var = state[0] if mp else state
+            return (mean, var), (state[1] if mp else None)
+
+        def lr_fn(index, lr):
+            t = self._index_update_count[index]
+            return lr * math.sqrt(1.0 - self.beta2 ** t) / \
+                (1.0 - self.beta1 ** t)
+
+        return {"kind": "adam",
+                "static": {"beta1": self.beta1, "beta2": self.beta2,
+                           "epsilon": self.epsilon},
+                "unpack": unpack, "n_state": 2, "lr_fn": lr_fn}
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -496,6 +553,24 @@ class AdamW(Optimizer):
                                  unpack, lr_fn=lr_fn, decay_fn=decay_fn,
                                  beta1=self.beta1, beta2=self.beta2,
                                  epsilon=self.epsilon)
+
+    def _compiled_spec(self):
+        def unpack(state, mp):
+            mean, var = state[0] if mp else state
+            return (mean, var), (state[1] if mp else None)
+
+        def lr_fn(index, lr):
+            if not self.correct_bias:
+                return lr
+            t = self._index_update_count[index]
+            return lr * math.sqrt(1.0 - self.beta2 ** t) / \
+                (1.0 - self.beta1 ** t)
+
+        return {"kind": "adamw",
+                "static": {"beta1": self.beta1, "beta2": self.beta2,
+                           "epsilon": self.epsilon},
+                "unpack": unpack, "n_state": 2, "lr_fn": lr_fn,
+                "decay_fn": lambda index, lr, wd: lr * wd}
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -821,6 +896,16 @@ class Updater:
             index = [index]
             grad = [grad]
             weight = [weight]
+        # per-device update counts (reference: Updater.__call__ →
+        # _set_current_context): the Trainer runs one Updater per device
+        # over the SAME optimizer object, so without switching the count
+        # table each device copy would advance num_update — Adam-family
+        # bias correction then sees t jump by #devices per step AND
+        # differ across copies, silently desynchronizing the replicas
+        ctx = getattr(weight[0], "context", None)
+        if ctx is not None:
+            self.optimizer._set_current_context(
+                (ctx.canonical_type, ctx.device_id))
         for i, w in zip(index, weight):
             if i not in self.states:
                 self.states[i] = \

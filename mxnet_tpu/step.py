@@ -1,0 +1,726 @@
+"""Whole-program compiled training step (ISSUE 7 tentpole).
+
+Reference: MXNet's defining trick is ``hybridize()`` — run eager, then
+cache the whole graph as one CachedOp (src/imperative/cached_op.cc).  The
+Julia→TPU full-program compilation work (arxiv 1810.09868) and TF1-style
+graph execution (arxiv 1605.08695) make the same argument for the
+*training loop*: compile the whole step, not kernels.  PR 3 made the
+eager Gluon step O(1) dispatches; this module collapses those remaining
+~dozen programs — loss forward, backward, the bucketed (int8/2bit
+error-feedback quantized) gradient exchange, the fused multi-tensor
+optimizer apply and device-side metric accumulation — into **one donated
+``jax.jit``** per step, with a ``lax.scan`` multi-step window
+(``MX_STEP_SCAN=N``) that keeps N prefetched batches on device per host
+round-trip and folds gradient accumulation into the scanned body.
+
+Semantics mirror hybridize: the first call traces, a shape/dtype change
+retraces (the cache key is the input/param avals), ``invalidate()`` is
+the ``_clear_cached_op`` equivalent, and parameter values are *read
+fresh and written back every dispatch* — external mutation (checkpoint
+restore, manual ``set_data``) between steps is picked up automatically
+because the NDArray chunks, not device-side captures, remain the source
+of truth.  lr/wd (and Adam-family bias correction) arrive as traced
+scalars computed on host per step, so LR schedulers never retrigger
+compilation.
+
+Eager remains the debug path: configurations the trace cannot express —
+the PS/dist_async transport (its exchange crosses a socket mid-step),
+multi-process collectives (the SPMD mesh lane ``parallel.TrainStep``
+owns those), optimizers without a pure tree kernel, ``grad_req='add'``,
+sparse gradients — fall back to the eager pipeline with a one-time
+warning, and :meth:`CompiledStep.step` keeps working either way.
+
+State continuity: optimizer slot state lives in the Trainer's Updater
+``states`` (donated in, written back out each dispatch), and
+error-feedback residuals live in the kvstore's GradientCompression store
+— so ``Trainer.save_states``/checkpoint sidecars round-trip the donated
+state, and switching compiled↔eager mid-training continues the exact
+trajectory.
+"""
+from __future__ import annotations
+
+import warnings
+from typing import Dict, List, Optional
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .base import MXNetError, get_env
+from .device import cpu
+from .ndarray.ndarray import NDArray
+from . import autograd
+from .ops import random as _ops_random
+from .ops.optimizer import tree_body
+from .gluon.block import _flatten_nds
+from .gluon.parameter import (DeferredInitializationError,
+                              _ParamOverrideScope)
+
+__all__ = ["CompiledStep", "scan_window", "step_compile_enabled",
+           "metric_trace_kernel"]
+
+
+def step_compile_enabled() -> bool:
+    """MX_STEP_COMPILE=1 — the whole-step-compiled lane is on."""
+    return bool(get_env("MX_STEP_COMPILE", dtype=bool))
+
+
+def scan_window() -> int:
+    """MX_STEP_SCAN window size (N batches per dispatch); 0/1 = per-step."""
+    try:
+        n = int(get_env("MX_STEP_SCAN", 0, int) or 0)
+    except (TypeError, ValueError):
+        n = 0
+    return max(n, 0)
+
+
+def metric_trace_kernel(metric):
+    """(kernel, argspec) folding `metric` into a whole-step jit, or None
+    (caller accumulates eagerly from the returned outputs instead).
+    argspec names the kernel's operand order: 'pred_label', 'label_pred'
+    or 'loss' (see EvalMetric._trace_kernel)."""
+    if metric is None:
+        return None
+    get = getattr(metric, "_trace_kernel", None)
+    return get() if get is not None else None
+
+
+def metric_cache_key(metric, metric_info):
+    """Trace-identity of a folded metric: class + argspec + the
+    kernel-affecting config (axis/eps/ignore_label/...), so two
+    same-class metrics with different hyperparameters never share a
+    cached executable."""
+    if metric_info is None:
+        return None
+    cfg = tuple(sorted((k, repr(v)) for k, v in
+                       getattr(metric, "_kwargs", {}).items()))
+    return (type(metric).__name__, metric_info[1], cfg)
+
+
+def _as_jax(x):
+    return x._jax if isinstance(x, NDArray) else jnp.asarray(x)
+
+
+def _as_nd(x, ctx):
+    return x if isinstance(x, NDArray) else NDArray(jnp.asarray(x), ctx=ctx)
+
+
+class CompiledStep:
+    """One Gluon training step as a single donated XLA program.
+
+    Built over a live ``gluon.Trainer`` — its parameters, optimizer,
+    kvstore (exchange + compression) and updater state are the state the
+    compiled program donates and writes back, so eager and compiled
+    steps are interchangeable mid-run.
+
+    ``step(data, label)`` is the hybridize-style drop-in for the eager
+    record/backward/Trainer.step/metric sequence; ``run_window(data,
+    label, accum=k)`` executes a stacked window of micro-batches under
+    one ``lax.scan`` dispatch with gradient accumulation folded in.
+    """
+
+    def __init__(self, net, loss_fn, trainer, metric=None):
+        self._net = net
+        self._loss_fn = loss_fn
+        self._trainer = trainer
+        self._metric = metric
+        self._cache: Dict = {}
+        self._fallback_reason: Optional[str] = None
+        self._warned = False
+        # donation safety: ONLY buffers this step produced itself (last
+        # dispatch's outputs) are donated as-is — a foreign array may be
+        # aliased elsewhere (kvstore init/broadcast slots share the
+        # initial param buffers; set_data/as_in_context alias on same
+        # device+dtype), and donating it would delete every alias's
+        # view.  Foreign inputs are copied once before donation; the
+        # refs list pins the owned arrays so ids cannot be reused.
+        self._owned: set = set()
+        self._owned_refs: List = []
+        # plan cache: the trace-static view of the trainer (exchange
+        # body, bucket specs, mp grouping, slot-state layout) is rebuilt
+        # only when its cheap signature changes — not O(n_params) of
+        # Python per dispatch on the host hot path
+        self._plan_cached = None
+        self._plan_sig = None
+
+    # -- cache control (hybridize semantics) -------------------------------
+    @property
+    def compiled(self) -> bool:
+        return self._fallback_reason is None
+
+    @property
+    def fallback_reason(self) -> Optional[str]:
+        return self._fallback_reason
+
+    def invalidate(self) -> None:
+        """Drop every cached executable (the `_clear_cached_op` of this
+        lane) — the next call retraces from the current configuration."""
+        self._cache.clear()
+        self._plan_cached = None
+        self._plan_sig = None
+
+    def _fall(self, reason: str):
+        self._fallback_reason = reason
+        if not self._warned:
+            self._warned = True
+            warnings.warn("CompiledStep: falling back to the eager "
+                          "pipeline (%s)" % reason, stacklevel=3)
+        return None
+
+    # -- plan: the trace-static view of the trainer ------------------------
+    def _plan_signature(self):
+        """What can change the plan between steps: kvstore identity and
+        compression config, bucket capacity, grad_req flips, context
+        set.  Cheap attribute reads only — checked every dispatch."""
+        tr = self._trainer
+        kv = tr._kvstore
+        gc = getattr(kv, "_gc", None) if kv is not None else None
+        from .kvstore.bucketing import bucket_bytes
+        opt = tr._optimizer
+        return (id(kv), tr._update_on_kvstore, id(opt),
+                tuple(p._grad_req for p in tr._params),
+                tuple(id(c) for c in (tr._contexts or ())),
+                None if gc is None
+                else (gc.type, gc.block, gc.threshold),
+                getattr(kv, "_compress_bf16", False) if kv else False,
+                bucket_bytes(),
+                # trace-static optimizer hyperparams (the supported
+                # kinds'): a mid-run mutation must rebuild spec statics
+                opt.clip_gradient, getattr(opt, "momentum", None),
+                getattr(opt, "beta1", None), getattr(opt, "beta2", None),
+                getattr(opt, "epsilon", None),
+                getattr(opt, "correct_bias", None))
+
+    def _plan(self):
+        if self._fallback_reason is not None:
+            return None
+        tr = self._trainer
+        if not tr._kv_initialized:
+            tr._init_kvstore()
+        if tr._params_to_init:
+            tr._init_params()
+        sig = self._plan_signature()
+        if self._plan_cached is not None and sig == self._plan_sig:
+            return self._plan_cached
+        if tr._update_on_kvstore:
+            return self._fall("server-side optimizer (update_on_kvstore)")
+        opt = tr._optimizer
+        spec = opt._compiled_spec()
+        if spec is None:
+            return self._fall("optimizer %s has no pure tree kernel"
+                              % type(opt).__name__)
+        kv = tr._kvstore
+        if kv is not None and kv.num_workers > 1:
+            return self._fall("multi-process exchange needs the SPMD mesh "
+                              "lane (parallel.TrainStep)")
+        trainable_idx, frozen_params = [], []
+        for i, p in enumerate(tr._params):
+            if p._data is None:
+                raise DeferredInitializationError(
+                    "Parameter %s is not initialized yet" % p.name)
+            if p.grad_req == "add":
+                return self._fall("grad_req='add' (use run_window(accum=k) "
+                                  "for compiled gradient accumulation)")
+            if p.grad_req == "null":
+                frozen_params.append(p)
+            elif p._grad_stype == "row_sparse":
+                return self._fall("row_sparse gradients take the per-key "
+                                  "gather/scatter path")
+            else:
+                trainable_idx.append(i)
+        ctxs = tr._contexts
+        trainable = [tr._params[i] for i in trainable_idx]
+        exchange = None
+        if kv is not None and len(ctxs) > 1:
+            # the eager exchange set: every trainable param crosses the
+            # store when there is more than one device copy to merge
+            exchange = kv.build_exchange_body(
+                trainable_idx, [p.data(ctxs[0]) for p in trainable])
+            if exchange is None:
+                return self._fall("kvstore %r exchange is not traceable "
+                                  "(host-blocking transport)" % kv.type)
+        # optimizer slot state, created through the SAME updater store the
+        # eager path uses (and every save_states/checkpoint reads)
+        mp_flags = []
+        for d, upd in enumerate(tr._updaters):
+            for pos, i in enumerate(trainable_idx):
+                w = trainable[pos].data(ctxs[d])
+                if i not in upd.states:
+                    upd.states[i] = \
+                        upd.optimizer.create_state_multi_precision(i, w)
+                    upd.states_synced[i] = True
+                if d == 0:
+                    mp_flags.append(bool(opt._is_mp_state(w, upd.states[i])))
+        groups: Dict[bool, List[int]] = {}
+        for pos, mp in enumerate(mp_flags):
+            groups.setdefault(mp, []).append(pos)
+        plan = {
+            "spec": spec,
+            "trainable_idx": trainable_idx,
+            "trainable": trainable,
+            "frozen": frozen_params,
+            "ctxs": ctxs,
+            "exchange": exchange,
+            "mp_flags": tuple(mp_flags),
+            "mp_groups": sorted(groups.items()),
+            "clip": -1.0 if opt.clip_gradient is None
+                    else float(opt.clip_gradient),
+        }
+        self._plan_cached = plan
+        self._plan_sig = sig
+        return plan
+
+    # -- trace builders ----------------------------------------------------
+    def _make_forward(self, plan):
+        net, loss_fn = self._net, self._loss_fn
+        trainable, frozen = plan["trainable"], plan["frozen"]
+
+        def run_forward(t_vals, f_vals, rng, x_vals, y_val):
+            overrides: Dict[int, NDArray] = {}
+            fr_nds = []
+            for p, v in zip(trainable, t_vals):
+                overrides[id(p)] = NDArray(v, ctx=cpu())
+            for p, v in zip(frozen, f_vals):
+                nd_ = NDArray(v, ctx=cpu())
+                overrides[id(p)] = nd_
+                fr_nds.append(nd_)
+            x_nds = [NDArray(v, ctx=cpu()) for v in x_vals]
+            y_nd = NDArray(y_val, ctx=cpu())
+            with _ParamOverrideScope(overrides), \
+                    _ops_random.trace_key_scope(rng), \
+                    autograd._Scope(False, True):
+                out = net(*x_nds)
+                loss = loss_fn(out, y_nd)
+            out_leaves: List[NDArray] = []
+            _flatten_nds(out, out_leaves)
+            loss_leaves: List[NDArray] = []
+            _flatten_nds(loss, loss_leaves)
+            # aux state (BatchNorm running stats) mutated during forward:
+            # the frozen params' fresh values ride the scan carry
+            new_f = tuple(nd_._jax for nd_ in fr_nds)
+            return ([l._jax for l in loss_leaves],
+                    [o._jax for o in out_leaves], new_f)
+
+        def forward_backward(t_vals, f_vals, rng, x_vals, y_val):
+            def loss_of(tv):
+                losses, outs, new_f = run_forward(tv, f_vals, rng,
+                                                  x_vals, y_val)
+                # backward() seeds a ones cotangent on the loss: the
+                # gradient of the elementwise SUM is exactly that
+                total = losses[0].sum()
+                for extra in losses[1:]:
+                    total = total + extra.sum()
+                out0 = outs[0] if outs else losses[0]
+                return total, (losses[0], out0, new_f)
+
+            (_tot, aux), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(tuple(t_vals))
+            loss0, out0, new_f = aux
+            return loss0, out0, grads, new_f
+
+        return forward_backward
+
+    def _build_fn(self, plan, n_steps, accum, rescale, wds, decays_on,
+                  metric_info, return_outs):
+        spec = plan["spec"]
+        body = tree_body(spec["kind"])
+        statics = dict(spec["static"])
+        n_state = spec["n_state"]
+        mp_groups = plan["mp_groups"]
+        exchange = plan["exchange"]
+        clip = plan["clip"]
+        forward_backward = self._make_forward(plan)
+
+        def _traced_step_window(t_vals, f_vals, opt_states, w32s,
+                                residuals, mstate, lr_rows, decay_rows,
+                                rng, xs, ys):
+            # NB every helper below is NESTED in this jitted function on
+            # purpose: mxlint's jit-purity rule walks the jitted def's
+            # own AST, so the whole step body is machine-checked for
+            # host syncs / wall-clock / env reads (ISSUE 7 satellite).
+            def apply_optimizer(t_vals, grads, opt_states, w32s, lr_row,
+                                decay_row):
+                new_t = list(t_vals)
+                new_states = list(opt_states)
+                new_w32 = list(w32s)
+                for mp, poss in mp_groups:
+                    ws = tuple(t_vals[p] for p in poss)
+                    gs = tuple(grads[p] for p in poss)
+                    cols = [tuple(opt_states[p][j] for p in poss)
+                            for j in range(n_state)]
+                    args = [ws, gs] + cols
+                    args.append(tuple(w32s[p] for p in poss)
+                                if mp else None)
+                    args.append(lr_row[jnp.asarray(poss, jnp.int32)])
+                    if decays_on:
+                        args.append(decay_row[jnp.asarray(poss,
+                                                          jnp.int32)])
+                    out_w, out_states, out_w32 = body(
+                        *args, wds=tuple(wds[p] for p in poss),
+                        rescale_grad=rescale, clip_gradient=clip, mp=mp,
+                        **statics)
+                    for j, p in enumerate(poss):
+                        new_t[p] = out_w[j]
+                        if out_states is not None:
+                            new_states[p] = tuple(col[j]
+                                                  for col in out_states)
+                        if mp and out_w32 is not None:
+                            new_w32[p] = out_w32[j]
+                return tuple(new_t), tuple(new_states), tuple(new_w32)
+
+            def accumulate_metric(mstate, loss0, out0, y_mb):
+                if metric_info is None or mstate is None:
+                    return mstate
+                kernel, order = metric_info
+                msum, minst = mstate
+                if order == "loss":
+                    return tuple(kernel(msum, minst, loss0))
+                if order == "label_pred":
+                    return tuple(kernel(msum, minst, y_mb, out0))
+                return tuple(kernel(msum, minst, out0, y_mb))
+
+            def one_step(carry, inp):
+                t_vals, f_vals, opt_states, w32s, residuals, mstate = carry
+                lr_row, decay_row, rngs, x_row, y_row = inp
+
+                def micro(mcarry, minp):
+                    f_v, g_acc, mst = mcarry
+                    key, x_mb, y_mb = minp
+                    loss0, out0, grads, new_f = forward_backward(
+                        t_vals, f_v, key, x_mb, y_mb)
+                    mst = accumulate_metric(mst, loss0, out0, y_mb)
+                    g_acc = tuple(a + g for a, g in zip(g_acc, grads))
+                    return (new_f, g_acc, mst), (loss0, out0)
+
+                init = (f_vals,
+                        tuple(jnp.zeros(v.shape, v.dtype)
+                              for v in t_vals),
+                        mstate)
+                if accum == 1:
+                    mcarry, (loss0, out0) = micro(
+                        init, (rngs[0], tuple(x[0] for x in x_row),
+                               y_row[0]))
+                    losses = loss0[None]
+                    outs = out0[None]
+                else:
+                    mcarry, (losses, outs) = lax.scan(
+                        micro, init, (rngs, x_row, y_row))
+                f_vals, g_sum, mstate = mcarry
+                if exchange is not None:
+                    new_g, new_res = exchange(list(g_sum),
+                                              list(residuals))
+                    g_sum = tuple(new_g)
+                    residuals = tuple(new_res)
+                t_vals, opt_states, w32s = apply_optimizer(
+                    t_vals, g_sum, opt_states, w32s, lr_row, decay_row)
+                out_row = (losses, outs) if return_outs else losses
+                return (t_vals, f_vals, opt_states, w32s, residuals,
+                        mstate), out_row
+
+            # window xs leaves arrive (n_steps*accum, B, ...); the
+            # single-step path passes the bare (B, ...) micro-batch.
+            # Either way the (window, micro-batch) grid is laid out
+            # inside the trace (a reshape — free in XLA).
+            if n_steps * accum == 1:
+                x_grid = tuple(x[None, None] for x in xs)
+                y_grid = ys[None, None]
+            else:
+                x_grid = tuple(x.reshape((n_steps, accum) + x.shape[1:])
+                               for x in xs)
+                y_grid = ys.reshape((n_steps, accum) + ys.shape[1:])
+            keys = jax.random.split(rng, n_steps * accum).reshape(
+                (n_steps, accum) + rng.shape)
+            carry = (t_vals, f_vals, opt_states, w32s, residuals, mstate)
+            if n_steps == 1:
+                # unrolled single step: a length-1 lax.scan would wrap
+                # the whole model in a while-loop body, which XLA (CPU
+                # especially) optimizes far more conservatively
+                carry, row = one_step(
+                    carry, (lr_rows[0],
+                            None if decay_rows is None else decay_rows[0],
+                            keys[0], tuple(x[0] for x in x_grid),
+                            y_grid[0]))
+                stacked = jax.tree_util.tree_map(lambda a: a[None], row)
+            else:
+                carry, stacked = lax.scan(
+                    one_step, carry,
+                    (lr_rows, decay_rows, keys, x_grid, y_grid))
+            if return_outs:
+                losses, outs = stacked
+                outs = outs.reshape((n_steps * accum,) + outs.shape[2:])
+            else:
+                losses, outs = stacked, None
+            losses = losses.reshape((n_steps * accum,) + losses.shape[2:])
+            return carry + (losses, outs)
+
+        return jax.jit(_traced_step_window,
+                       donate_argnums=(0, 1, 2, 3, 4, 5))
+
+    # -- host-side per-window bookkeeping ----------------------------------
+    def _lr_rows(self, plan, n_steps, batch_size):
+        tr = self._trainer
+        opt = tr._optimizer
+        spec = plan["spec"]
+        idxs = plan["trainable_idx"]
+        rescale = tr._scale / batch_size
+        opt.rescale_grad = rescale
+        # advance EVERY device copy's update-count table (Updater.__call__
+        # keys per-device tables) so an eager<->compiled switch continues
+        # one num_update trajectory on all replicas; lr comes off the
+        # primary table
+        ctx0 = plan["ctxs"][0]
+        for c in plan["ctxs"][1:]:
+            opt._set_current_context((c.canonical_type, c.device_id))
+            for _ in range(n_steps):
+                opt._update_count(idxs)
+        opt._set_current_context((ctx0.canonical_type, ctx0.device_id))
+        lr_rows, decay_rows = [], []
+        wds = None
+        for _ in range(n_steps):
+            opt._update_count(idxs)
+            raw = opt._get_lrs(idxs)
+            if wds is None:
+                wds = tuple(opt._get_wds(idxs))
+            if spec.get("decay_fn") is not None:
+                decay_rows.append([spec["decay_fn"](i, lr, wd)
+                                   for i, lr, wd in zip(idxs, raw, wds)])
+            if spec.get("lr_fn") is not None:
+                raw = [spec["lr_fn"](i, lr) for i, lr in zip(idxs, raw)]
+            lr_rows.append(raw)
+        # packing HOST floats (scheduler lr / bias-correction values) into
+        # the traced lr matrix — no device buffer is read here
+        lrs = jnp.asarray(_np.asarray(lr_rows, _np.float32))  # mxlint: disable=host-sync-in-hot-path
+        decays = None
+        if decay_rows:
+            decays = jnp.asarray(_np.asarray(decay_rows, _np.float32))  # mxlint: disable=host-sync-in-hot-path
+        return rescale, wds, lrs, decays
+
+    def _gather_state(self, plan):
+        tr = self._trainer
+        spec = plan["spec"]
+        ctx0 = plan["ctxs"][0]
+        t_vals = tuple(p.data(ctx0)._jax for p in plan["trainable"])
+        f_vals = tuple(p.data(ctx0)._jax for p in plan["frozen"])
+        upd = tr._updaters[0]
+        opt_states, w32s = [], []
+        for pos, i in enumerate(plan["trainable_idx"]):
+            inner, w32 = spec["unpack"](upd.states[i],
+                                        plan["mp_flags"][pos])
+            opt_states.append(tuple(s._jax for s in inner))
+            w32s.append(w32._jax if w32 is not None else None)
+        residuals = ()
+        if plan["exchange"] is not None:
+            gc = getattr(tr._kvstore, "_gc", None)
+            if plan["exchange"].residual_specs:
+                residuals = tuple(
+                    gc.peek_residual(wk, shape, dtype)
+                    for wk, shape, dtype in
+                    plan["exchange"].residual_specs)
+        mstate = None
+        if self._metric is not None and \
+                metric_trace_kernel(self._metric) is not None:
+            ds = getattr(self._metric, "_dev_sum", None)
+            if ds is None:
+                mstate = (jnp.zeros((), jnp.float32),
+                          jnp.zeros((), jnp.int32))
+            else:
+                mstate = (ds, self._metric._dev_inst)
+        return t_vals, f_vals, tuple(opt_states), tuple(w32s), \
+            residuals, mstate
+
+    def _write_back(self, plan, new_t, new_f, new_states, new_w32,
+                    new_res, new_mstate):
+        tr = self._trainer
+        spec = plan["spec"]
+        ctxs = plan["ctxs"]
+
+        def place(val, ctx, d):
+            return val if d == 0 else jax.device_put(val, ctx.jax_device)
+
+        for d, ctx in enumerate(ctxs):
+            for pos, p in enumerate(plan["trainable"]):
+                p._data[ctx]._set_jax(place(new_t[pos], ctx, d))
+            for pos, p in enumerate(plan["frozen"]):
+                p._data[ctx]._set_jax(place(new_f[pos], ctx, d))
+            upd = tr._updaters[d]
+            for pos, i in enumerate(plan["trainable_idx"]):
+                inner, w32 = spec["unpack"](upd.states[i],
+                                            plan["mp_flags"][pos])
+                for s_nd, val in zip(inner, new_states[pos]):
+                    s_nd._set_jax(place(val, ctx, d).astype(s_nd.dtype))
+                if w32 is not None and new_w32[pos] is not None:
+                    w32._set_jax(place(new_w32[pos], ctx, d))
+        if plan["exchange"] is not None and new_res:
+            gc = tr._kvstore._gc
+            for (wk, _shape, _dtype), val in zip(
+                    plan["exchange"].residual_specs, new_res):
+                gc.put_residual(wk, val)
+        if new_mstate is not None:
+            self._metric._dev_sum, self._metric._dev_inst = new_mstate
+
+    # -- dispatch ----------------------------------------------------------
+    def _run(self, plan, n_steps, accum, xs, ys, batch_size, transfers):
+        """One window dispatch: xs/ys leaves shaped (n_steps*accum, B,
+        ...).  Returns (losses, outs_or_None) as jax arrays."""
+        from .engine import engine as _engine
+        from . import profiler as _profiler
+        rescale, wds, lr_rows, decay_rows = self._lr_rows(
+            plan, n_steps, batch_size)
+        metric_info = metric_trace_kernel(self._metric)
+        return_outs = self._metric is not None and metric_info is None
+        key = (n_steps, accum, rescale, wds, plan["clip"],
+               plan["spec"]["kind"],
+               tuple(sorted(plan["spec"]["static"].items())),
+               plan["mp_flags"],
+               tuple((tuple(x.shape), str(x.dtype)) for x in xs),
+               (tuple(ys.shape), str(ys.dtype)),
+               tuple((p.shape, str(p.dtype)) for p in plan["trainable"]),
+               tuple((p.shape, str(p.dtype)) for p in plan["frozen"]),
+               tuple((wk, tuple(s), str(jnp.dtype(dt))) for wk, s, dt in
+                     (plan["exchange"].residual_specs
+                      if plan["exchange"] is not None else ())),
+               metric_cache_key(self._metric, metric_info),
+               return_outs)
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = self._build_fn(plan, n_steps, accum, rescale, wds,
+                                decay_rows is not None, metric_info,
+                                return_outs)
+            self._cache[key] = fn
+        state = self._gather_state(plan)
+
+        def donatable(a):
+            if a is None or id(a) in self._owned:
+                return a
+            return jnp.array(a, copy=True)   # foreign: may be aliased
+
+        state = tuple(jax.tree_util.tree_map(donatable, s) for s in state)
+        rng = _ops_random.next_key()
+        with _profiler.annotate("compiled_step"):
+            out = fn(*state, lr_rows, decay_rows, rng, xs, ys)
+        (new_t, new_f, new_states, new_w32, new_res, new_mstate,
+         losses, outs) = out
+        self._write_back(plan, new_t, new_f, new_states, new_w32,
+                         new_res, new_mstate)
+        self._owned_refs = [
+            a for a in jax.tree_util.tree_leaves(
+                (new_t, new_f, new_states, new_w32, new_res, new_mstate))
+            if a is not None]
+        self._owned = {id(a) for a in self._owned_refs}
+        _engine.count_step_window(n_steps * accum,
+                                  dispatches=1 + transfers)
+        if plan["exchange"] is not None:
+            _engine.count_wire_bytes(
+                plan["exchange"].wire_bytes * n_steps)
+        return losses, outs
+
+    def step(self, data, label, batch_size=None):
+        """One training step (forward + backward + exchange + update +
+        metric) in ONE dispatch; returns the loss (eager shape)."""
+        datas = data if isinstance(data, (list, tuple)) else (data,)
+        B = int(_as_jax(datas[0]).shape[0])
+        batch_size = batch_size or B
+        try:
+            plan = self._plan()
+        except DeferredInitializationError:
+            plan = None   # first call finishes deferred init eagerly
+        if plan is None:
+            return self._eager_step(datas, label, batch_size)
+        ctx0 = plan["ctxs"][0]
+        xs = tuple(_as_jax(d) for d in datas)
+        y = _as_jax(label)
+        losses, outs = self._run(plan, 1, 1, xs, y, batch_size,
+                                 transfers=0)
+        if outs is not None:
+            self._metric.update([_as_nd(y, ctx0)],
+                                [NDArray(outs[0], ctx=ctx0)])
+        return NDArray(losses.reshape(losses.shape[1:]), ctx=ctx0)
+
+    def run_window(self, data, label, batch_size=None, accum=1):
+        """N-step scan window: `data` leaves are (n_micro, B, ...) with
+        ``n_micro = n_steps * accum`` — every `accum` consecutive
+        micro-batches accumulate into one optimizer step.  The whole
+        window is ONE device dispatch (plus the batch transfer); returns
+        the per-micro-batch losses, shape (n_micro, ...)."""
+        datas = data if isinstance(data, (list, tuple)) else (data,)
+        accum = max(1, int(accum))
+        xs = tuple(_as_jax(d) for d in datas)
+        y = _as_jax(label)
+        n_micro = int(xs[0].shape[0])
+        if n_micro % accum:
+            raise MXNetError("run_window: %d micro-batches do not divide "
+                             "into accum=%d groups" % (n_micro, accum))
+        n_steps = n_micro // accum
+        B = int(xs[0].shape[1])
+        batch_size = batch_size or B * accum
+        try:
+            plan = self._plan()
+        except DeferredInitializationError:
+            plan = None
+        if plan is None:
+            if accum > 1:
+                raise MXNetError(
+                    "run_window(accum=%d) has no eager fallback (%s); use "
+                    "grad_req='add' accumulation on the eager path"
+                    % (accum, self._fallback_reason))
+            losses = [self._eager_step(
+                tuple(NDArray(x[t], ctx=self._trainer._contexts[0])
+                      for x in xs),
+                NDArray(y[t], ctx=self._trainer._contexts[0]),
+                batch_size).mean()._jax
+                for t in range(n_micro)]
+            return NDArray(jnp.stack(losses),
+                           ctx=self._trainer._contexts[0])
+        ctx0 = plan["ctxs"][0]
+        losses, outs = self._run(plan, n_steps, accum, xs, y, batch_size,
+                                 transfers=1)
+        if outs is not None:
+            flat = outs.reshape((-1,) + outs.shape[2:])
+            self._metric.update(
+                [NDArray(y.reshape((-1,) + y.shape[2:]), ctx=ctx0)],
+                [NDArray(flat, ctx=ctx0)])
+        return NDArray(losses, ctx=ctx0)
+
+    # -- the debug path ----------------------------------------------------
+    def _eager_step(self, datas, label, batch_size):
+        ctxs = self._trainer._contexts
+        if len(ctxs) > 1:
+            # classic DP eager loop: the batch splits across the device
+            # copies, each runs its own forward/backward, the Trainer's
+            # exchange merges — same math the compiled lane traces
+            B = int(_as_jax(datas[0]).shape[0])
+            per = B // len(ctxs)
+            losses, out0, y0 = [], None, None
+            with autograd.record():
+                for d, ctx in enumerate(ctxs):
+                    sl = slice(d * per, (d + 1) * per if
+                               d < len(ctxs) - 1 else B)
+                    x_nds = [NDArray(jax.device_put(_as_jax(x)[sl],
+                                                    ctx.jax_device),
+                                     ctx=ctx) for x in datas]
+                    y_nd = NDArray(jax.device_put(_as_jax(label)[sl],
+                                                  ctx.jax_device), ctx=ctx)
+                    out = self._net(*x_nds)
+                    loss = self._loss_fn(out, y_nd)
+                    loss.backward()
+                    losses.append(loss)
+                    if d == 0:
+                        out0, y0 = out, y_nd
+            self._trainer.step(batch_size)
+            if self._metric is not None:
+                o = out0[0] if isinstance(out0, (list, tuple)) else out0
+                self._metric.update([y0], [o])
+            return losses[0]
+        ctx = ctxs[0]
+        x_nds = [_as_nd(d, ctx) for d in datas]
+        y_nd = _as_nd(label, ctx)
+        with autograd.record():
+            out = self._net(*x_nds)
+            loss = self._loss_fn(out, y_nd)
+        loss.backward()
+        self._trainer.step(batch_size)
+        if self._metric is not None:
+            out0 = out[0] if isinstance(out, (list, tuple)) else out
+            self._metric.update([y_nd], [out0])
+        return loss

@@ -1,0 +1,471 @@
+"""ISSUE 7: whole-program compiled train step (one donated jit per step).
+
+Pins the tentpole contracts:
+  * compiled == eager parity — identical loss trajectories and final
+    params through the ICI store for {plain, int8, 2bit, bf16, overlap
+    armed, adam} exchange/optimizer modes (the compiled trace inlines
+    the SAME bucket layout, error-feedback kernels and fused tree-apply
+    bodies the eager pipeline dispatches separately);
+  * the lax.scan multi-step window (MX_STEP_SCAN role): N steps in ONE
+    dispatch match N per-step dispatches bit-for-bit, and gradient
+    accumulation folded into the scanned body (accum=k) matches the
+    equivalent concatenated-batch steps;
+  * hybridize-style cache semantics — shape change retraces (both entries
+    stay live), invalidate() clears, external param mutation between
+    steps is picked up (NDArray chunks stay the source of truth);
+  * donation safety — params/optimizer state/EF residuals are donated
+    into every dispatch, yet NDArray handles held across steps read the
+    CURRENT values and save_states round-trips;
+  * eager<->compiled mode switches mid-run continue one trajectory
+    (optimizer slot state AND int8 error-feedback residuals are shared
+    stores, not device-side captures);
+  * PS/dist_async transport falls back to the eager pipeline (its
+    exchange crosses a socket mid-step) — and still trains;
+  * the dispatch budget: 1-2 dispatches per N-step window
+    (tools/dispatch_count.py --compiled);
+  * Module.fit under MX_STEP_COMPILE=1 — one dispatch per batch, exact
+    param parity with the eager fit, metric folded into the jit.
+"""
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.engine import engine
+from mxnet_tpu.gluon import nn
+
+CTXS = [mx.cpu(0), mx.cpu(1)]
+RNG = np.random.RandomState(7)
+X = RNG.randn(16, 8).astype(np.float32)
+Y = RNG.randn(16, 4).astype(np.float32)
+
+
+def _build(compress=None, opt="sgd", optp=None, kvstore="ici", ctxs=CTXS,
+           seed=0):
+    mx.random.seed(seed)
+    net = nn.Sequential()
+    net.add(nn.Dense(16, in_units=8, activation="relu"))
+    net.add(nn.Dense(4, in_units=16))
+    net.initialize(mx.init.Xavier(), ctx=ctxs)
+    tr = gluon.Trainer(net.collect_params(), opt,
+                       dict(optp or {"learning_rate": 0.05,
+                                     "momentum": 0.9}),
+                       kvstore=kvstore, compression_params=compress)
+    return net, tr
+
+
+LOSS = gluon.loss.L2Loss()
+
+
+def _eager_steps(net, tr, steps, data=None, labels=None, ctxs=CTXS):
+    """Classic DP eager loop: split batch across device copies, per-copy
+    backward, Trainer exchange+update."""
+    data = X if data is None else data
+    labels = Y if labels is None else labels
+    losses = []
+    n = len(data)
+    per = n // len(ctxs)
+    for _ in range(steps):
+        tot = 0.0
+        with autograd.record():
+            for d, ctx in enumerate(ctxs):
+                sl = slice(d * per, (d + 1) * per if d < len(ctxs) - 1
+                           else n)
+                loss = LOSS(net(nd.array(data[sl], ctx=ctx)),
+                            nd.array(labels[sl], ctx=ctx))
+                loss.backward()
+                tot += float(loss.sum().asnumpy())
+        tr.step(batch_size=n)
+        losses.append(tot / n)
+    return losses
+
+
+def _compiled_steps(step, steps, data=None, labels=None):
+    data = X if data is None else data
+    labels = Y if labels is None else labels
+    out = []
+    for _ in range(steps):
+        loss = step.step(nd.array(data, ctx=CTXS[0]),
+                         nd.array(labels, ctx=CTXS[0]),
+                         batch_size=len(data))
+        out.append(float(loss.mean().asnumpy()))
+    return out
+
+
+def _params(net):
+    return {k: v.data(CTXS[0]).asnumpy()
+            for k, v in net.collect_params().items()}
+
+
+# ---------------------------------------------------------------------------
+# compiled == eager parity (the tentpole acceptance)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("compress", [
+    None,
+    {"type": "int8"},
+    {"type": "2bit", "threshold": 0.05},
+    {"type": "bf16"},
+])
+def test_compiled_matches_eager_all_exchange_modes(compress):
+    """6-step 2-device fit through the ICI store: the compiled lane's
+    loss trajectory and final params match the eager pipeline for every
+    wire mode — the traced exchange body IS the eager exchange."""
+    net_e, tr_e = _build(compress)
+    e_losses = _eager_steps(net_e, tr_e, 6)
+    net_c, tr_c = _build(compress)
+    step = tr_c.make_compiled_step(net_c, LOSS)
+    c_losses = _compiled_steps(step, 6)
+    assert step.compiled, step.fallback_reason
+    assert c_losses[-1] < c_losses[0]           # it trains
+    np.testing.assert_allclose(c_losses, e_losses, rtol=1e-3, atol=1e-5)
+    pe, pc = _params(net_e), _params(net_c)
+    # bf16 is the one mode where the wire math differs by construction:
+    # eager casts each device copy before summing, the compiled trace
+    # casts the full-batch sum — one bf16 rounding apart per step
+    rtol = 2e-2 if (compress or {}).get("type") == "bf16" else 1e-4
+    for k in pe:
+        np.testing.assert_allclose(pc[k], pe[k], rtol=rtol, atol=1e-4,
+                                   err_msg=k)
+
+
+def test_compiled_matches_eager_with_overlap_armed(monkeypatch):
+    """MX_EXCHANGE_OVERLAP=1 on the eager side is a pure scheduling
+    change, so the compiled lane (which has nothing to overlap — the
+    whole step is one program) must still match it exactly."""
+    monkeypatch.setenv("MX_EXCHANGE_OVERLAP", "1")
+    net_e, tr_e = _build({"type": "int8"})
+    e_losses = _eager_steps(net_e, tr_e, 5)
+    net_c, tr_c = _build({"type": "int8"})
+    step = tr_c.make_compiled_step(net_c, LOSS)
+    c_losses = _compiled_steps(step, 5)
+    np.testing.assert_allclose(c_losses, e_losses, rtol=1e-3, atol=1e-5)
+    pe, pc = _params(net_e), _params(net_c)
+    for k in pe:
+        np.testing.assert_allclose(pc[k], pe[k], rtol=1e-4, atol=1e-5)
+
+
+def test_compiled_adam_matches_eager():
+    """Adam's bias correction rides the traced lr vector (host-folded per
+    step); num_update bookkeeping advances once per step per replica."""
+    optp = {"learning_rate": 0.01}
+    net_e, tr_e = _build({"type": "int8"}, opt="adam", optp=optp)
+    e_losses = _eager_steps(net_e, tr_e, 6)
+    net_c, tr_c = _build({"type": "int8"}, opt="adam", optp=optp)
+    step = tr_c.make_compiled_step(net_c, LOSS)
+    c_losses = _compiled_steps(step, 6)
+    np.testing.assert_allclose(c_losses, e_losses, rtol=1e-3, atol=1e-5)
+    pe, pc = _params(net_e), _params(net_c)
+    for k in pe:
+        np.testing.assert_allclose(pc[k], pe[k], rtol=1e-4, atol=1e-5)
+
+
+def test_single_device_compiled_matches_eager_exactly():
+    """One context, no kvstore: the compiled step is the pure fused
+    pipeline and matches eager bit-for-bit."""
+    net_e, tr_e = _build(ctxs=[mx.cpu(0)])
+    e_losses = []
+    for _ in range(5):
+        with autograd.record():
+            loss = LOSS(net_e(nd.array(X)), nd.array(Y))
+        loss.backward()
+        tr_e.step(batch_size=16)
+        e_losses.append(float(loss.mean().asnumpy()))
+    net_c, tr_c = _build(ctxs=[mx.cpu(0)])
+    step = tr_c.make_compiled_step(net_c, LOSS)
+    c_losses = _compiled_steps(step, 5)
+    np.testing.assert_allclose(c_losses, e_losses, rtol=0, atol=0)
+    pe, pc = _params(net_e), _params(net_c)
+    for k in pe:
+        np.testing.assert_array_equal(pc[k], pe[k])
+
+
+# ---------------------------------------------------------------------------
+# lax.scan windows + gradient accumulation
+# ---------------------------------------------------------------------------
+
+def test_scan_window_matches_per_step_exactly():
+    """N=4 steps under ONE lax.scan dispatch == 4 per-step dispatches:
+    same traced body, so params agree bit-for-bit."""
+    rng = np.random.RandomState(3)
+    Xw = rng.randn(4, 16, 8).astype(np.float32)
+    Yw = rng.randn(4, 16, 4).astype(np.float32)
+    net_a, tr_a = _build({"type": "int8"})
+    step_a = tr_a.make_compiled_step(net_a, LOSS)
+    per_step = [float(step_a.step(nd.array(Xw[t], ctx=CTXS[0]),
+                                  nd.array(Yw[t], ctx=CTXS[0]),
+                                  batch_size=16).mean().asnumpy())
+                for t in range(4)]
+    net_b, tr_b = _build({"type": "int8"})
+    step_b = tr_b.make_compiled_step(net_b, LOSS)
+    losses = step_b.run_window(nd.array(Xw, ctx=CTXS[0]),
+                               nd.array(Yw, ctx=CTXS[0]), batch_size=16)
+    scanned = list(np.asarray(losses._jax).reshape(4, -1).mean(axis=1))
+    np.testing.assert_allclose(scanned, per_step, rtol=1e-6, atol=1e-7)
+    pa, pb = _params(net_a), _params(net_b)
+    for k in pa:
+        np.testing.assert_allclose(pb[k], pa[k], rtol=1e-6, atol=1e-7)
+
+
+def test_scan_grad_accumulation_matches_concat_batches():
+    """accum=2 inside the scanned body: each optimizer step consumes two
+    micro-batches whose summed gradient equals the concatenated batch's
+    gradient — so a window of 4 micro-batches with accum=2 matches 2
+    full-batch steps on the concatenations."""
+    rng = np.random.RandomState(5)
+    micro = rng.randn(4, 8, 8).astype(np.float32)
+    lab = rng.randn(4, 8, 4).astype(np.float32)
+    net_a, tr_a = _build()
+    step_a = tr_a.make_compiled_step(net_a, LOSS)
+    for t in (0, 1):
+        step_a.step(nd.array(np.concatenate(micro[2 * t:2 * t + 2]),
+                             ctx=CTXS[0]),
+                    nd.array(np.concatenate(lab[2 * t:2 * t + 2]),
+                             ctx=CTXS[0]),
+                    batch_size=16)
+    net_b, tr_b = _build()
+    step_b = tr_b.make_compiled_step(net_b, LOSS)
+    step_b.run_window(nd.array(micro, ctx=CTXS[0]),
+                      nd.array(lab, ctx=CTXS[0]),
+                      batch_size=16, accum=2)
+    pa, pb = _params(net_a), _params(net_b)
+    for k in pa:
+        np.testing.assert_allclose(pb[k], pa[k], rtol=1e-5, atol=1e-6,
+                                   err_msg=k)
+
+
+def test_window_dispatch_budget():
+    """The ISSUE 7 dispatch contract, via the same harness the CLI smoke
+    runs: 1-2 dispatches per N-step window, one per single step, and the
+    engine attributes N optimizer steps to the one window."""
+    import tools.dispatch_count as dc
+    report = dc.run_compiled(n_steps=4)
+    assert report["ok"], report
+
+
+# ---------------------------------------------------------------------------
+# cache semantics (hybridize parity)
+# ---------------------------------------------------------------------------
+
+def test_retrace_on_shape_change_and_invalidate():
+    net, tr = _build(ctxs=[mx.cpu(0)])
+    step = tr.make_compiled_step(net, LOSS)
+    step.step(nd.array(X), nd.array(Y))
+    assert len(step._cache) == 1
+    # new batch shape: retrace, both executables stay cached
+    step.step(nd.array(X[:8]), nd.array(Y[:8]))
+    assert len(step._cache) == 2
+    # same shapes again: cache hit, no growth
+    step.step(nd.array(X), nd.array(Y))
+    step.step(nd.array(X[:8]), nd.array(Y[:8]))
+    assert len(step._cache) == 2
+    step.invalidate()
+    assert len(step._cache) == 0
+    step.step(nd.array(X), nd.array(Y))
+    assert len(step._cache) == 1
+
+
+def test_external_param_mutation_is_picked_up():
+    """set_data between compiled steps must take effect (the NDArray
+    chunks, not device captures, are the source of truth) — the
+    _clear_cached_op-style invalidation contract."""
+    net_c, tr_c = _build(ctxs=[mx.cpu(0)])
+    step = tr_c.make_compiled_step(net_c, LOSS)
+    step.step(nd.array(X), nd.array(Y))
+    for p in net_c.collect_params().values():
+        p.set_data(nd.zeros(p.shape))
+    loss = step.step(nd.array(X), nd.array(Y))
+    # from zero weights the first layer's output is 0 -> loss == mean of
+    # 0.5*|y|^2 per example; params moved off zero afterwards
+    expect = 0.5 * (Y ** 2).sum(axis=1).mean() / Y.shape[1]
+    assert abs(float(loss.mean().asnumpy()) - expect) < 1e-4
+    w = net_c.collect_params()[list(net_c.collect_params())[-1]]
+    assert float(np.abs(w.data().asnumpy()).sum()) > 0
+
+
+# ---------------------------------------------------------------------------
+# donation safety + state round-trips
+# ---------------------------------------------------------------------------
+
+def test_donation_safe_handles_and_save_states(tmp_path):
+    """Params, optimizer slot state and EF residuals are donated into
+    every dispatch; NDArray handles held across steps must still read
+    the CURRENT value (chunk swap, never a dead buffer), and
+    save_states/load_states round-trips the donated momentum."""
+    net, tr = _build({"type": "int8"})
+    params = list(net.collect_params().values())
+    held_w = params[0].data(CTXS[0])
+    step = tr.make_compiled_step(net, LOSS)
+    _compiled_steps(step, 3)
+    # the held handle tracks the post-step value of the SAME parameter
+    np.testing.assert_array_equal(held_w.asnumpy(),
+                                  params[0].data(CTXS[0]).asnumpy())
+    assert np.all(np.isfinite(held_w.asnumpy()))
+    # momentum state was created in the shared updater store and is live
+    st = tr._updaters[0].states
+    assert st and all(s is not None for s in st.values())
+    f = str(tmp_path / "trainer.states")
+    tr.save_states(f)
+    # a fresh identical trainer resumes from the saved slot state and
+    # matches continued training exactly
+    net2, tr2 = _build({"type": "int8"})
+    for p2, p in zip(net2.collect_params().values(), params):
+        p2.set_data(p.data(CTXS[0]))
+    step2 = tr2.make_compiled_step(net2, LOSS)
+    step2.step(nd.array(X, ctx=CTXS[0]), nd.array(Y, ctx=CTXS[0]))  # init kv
+    tr2.load_states(f)
+    # residuals continue from the live store on tr; COPY the arrays over
+    # (tr keeps training below and donates its own residuals) so the
+    # comparison isolates the optimizer-state round-trip
+    import jax.numpy as jnp
+    gc1 = tr._kvstore._gc
+    tr2._kvstore._gc._residuals = {k: jnp.array(v, copy=True)
+                                   for k, v in gc1._residuals.items()}
+    for p2, p in zip(net2.collect_params().values(), params):
+        p2.set_data(p.data(CTXS[0]))
+    a = _compiled_steps(step, 2)
+    b = _compiled_steps(step2, 2)
+    np.testing.assert_allclose(b, a, rtol=1e-4, atol=1e-6)
+
+
+def test_mode_switch_continues_trajectory():
+    """compiled -> eager mid-run continues ONE trajectory: slot state and
+    int8 error-feedback residuals live in shared stores, so 3 compiled +
+    3 eager steps equal 6 eager steps."""
+    net_e, tr_e = _build({"type": "int8"})
+    e_losses = _eager_steps(net_e, tr_e, 6)
+    net_m, tr_m = _build({"type": "int8"})
+    step = tr_m.make_compiled_step(net_m, LOSS)
+    m_losses = _compiled_steps(step, 3)
+    m_losses += _eager_steps(net_m, tr_m, 3)
+    np.testing.assert_allclose(m_losses, e_losses, rtol=1e-3, atol=1e-5)
+    pe, pm = _params(net_e), _params(net_m)
+    for k in pe:
+        np.testing.assert_allclose(pm[k], pe[k], rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# metric folding
+# ---------------------------------------------------------------------------
+
+def test_metric_folds_into_step_dispatch():
+    """A device-kernel metric accumulates INSIDE the step's one dispatch
+    and get() drains the same value the eager update would produce."""
+    net_c, tr_c = _build(ctxs=[mx.cpu(0)])
+    metric = mx.metric.MSE()
+    step = tr_c.make_compiled_step(net_c, LOSS, metric=metric)
+    step.step(nd.array(X), nd.array(Y))        # warm: trace+compile
+    c0 = engine.dispatch_count
+    step.step(nd.array(X), nd.array(Y))
+    assert engine.dispatch_count - c0 == 1     # metric cost no extra dispatch
+    name, val = metric.get()
+    # eager reference on the SAME outputs
+    net_e, tr_e = _build(ctxs=[mx.cpu(0)])
+    ref = mx.metric.MSE()
+    for _ in range(2):
+        with autograd.record():
+            out = net_e(nd.array(X))
+            loss = LOSS(out, nd.array(Y))
+        loss.backward()
+        tr_e.step(batch_size=16)
+        ref.update([nd.array(Y)], [out])
+    _, ref_val = ref.get()
+    np.testing.assert_allclose(val, ref_val, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# PS-transport fallback
+# ---------------------------------------------------------------------------
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_ps_transport_falls_back_to_eager(monkeypatch):
+    """dist_async's exchange crosses a socket mid-step — untraceable.
+    The compiled step must fall back to the eager pipeline (with the
+    documented warning) and still train through the real server."""
+    from mxnet_tpu.kvstore.server import serve_forever
+    monkeypatch.setenv("MX_KVSTORE_HEARTBEAT", "0")
+    monkeypatch.delenv("MX_PS_ROOTS", raising=False)
+    port = _free_port()
+    t = threading.Thread(target=serve_forever,
+                         kwargs=dict(port=port, num_workers=1), daemon=True)
+    t.start()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port),
+                                     timeout=0.2).close()
+            break
+        except OSError:
+            time.sleep(0.05)
+    monkeypatch.setenv("MX_PS_ROOT", "127.0.0.1:%d" % port)
+    net, tr = _build(kvstore="dist_async")
+    step = tr.make_compiled_step(net, LOSS)
+    with pytest.warns(UserWarning, match="falling back to the eager"):
+        losses = _compiled_steps(step, 4)
+    assert not step.compiled
+    assert "dist_async" in step.fallback_reason
+    assert losses[-1] < losses[0]
+    assert all(np.isfinite(l) for l in losses)
+    tr._kvstore.stop_server()
+
+
+def test_unsupported_optimizer_falls_back():
+    net, tr = _build(opt="rmsprop", optp={"learning_rate": 0.01})
+    step = tr.make_compiled_step(net, LOSS)
+    with pytest.warns(UserWarning, match="no pure tree kernel"):
+        losses = _compiled_steps(step, 3)
+    assert not step.compiled
+    assert losses[-1] < losses[0]
+
+
+# ---------------------------------------------------------------------------
+# Module.fit wiring (MX_STEP_COMPILE=1)
+# ---------------------------------------------------------------------------
+
+def _mlp_symbol():
+    from mxnet_tpu import symbol as sym
+    data = sym.Variable("data")
+    h = sym.FullyConnected(data, sym.Variable("fc1_weight"),
+                           sym.Variable("fc1_bias"), num_hidden=16)
+    h = sym.Activation(h, act_type="relu")
+    out = sym.FullyConnected(h, sym.Variable("fc2_weight"),
+                             sym.Variable("fc2_bias"), num_hidden=3)
+    return sym.SoftmaxOutput(out, sym.Variable("softmax_label"),
+                             normalization="batch", name="softmax")
+
+
+def _module_fit(compile_flag, monkeypatch):
+    from mxnet_tpu import io as mio
+    from mxnet_tpu.module import Module
+    monkeypatch.setenv("MX_STEP_COMPILE", compile_flag)
+    rng = np.random.RandomState(0)
+    Xm = rng.randn(96, 8).astype(np.float32)
+    Ym = Xm[:, :3].argmax(axis=1).astype(np.float32)
+    mx.random.seed(42)
+    mod = Module(_mlp_symbol(), context=mx.cpu())
+    mod.fit(mio.NDArrayIter(Xm, Ym, batch_size=24), optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            num_epoch=2)
+    arg, _aux = mod.get_params()
+    return {k: v.asnumpy() for k, v in arg.items()}
+
+
+def test_module_fit_compiled_matches_eager(monkeypatch):
+    eager = _module_fit("0", monkeypatch)
+    w0 = engine.compiled_step_windows
+    compiled = _module_fit("1", monkeypatch)
+    assert engine.compiled_step_windows - w0 == 8    # 4 batches x 2 epochs
+    for k in eager:
+        np.testing.assert_allclose(compiled[k], eager[k], rtol=1e-5,
+                                   atol=1e-6, err_msg=k)

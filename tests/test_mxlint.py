@@ -1082,6 +1082,54 @@ def test_reinjected_asnumpy_in_trainer_update_trips():
     assert "host-sync-in-hot-path" in rules_of(new)
 
 
+def test_reinjected_asnumpy_in_compiled_step_body_trips():
+    """ISSUE 7: the whole-step compiled trace is a jit-purity target — a
+    float(asnumpy()) reintroduced INSIDE the traced step body must trip
+    the linter (a host sync under trace either crashes on tracers or
+    bakes a constant in; either way the single-program contract dies)."""
+    p = os.path.join(REPO, "mxnet_tpu", "step.py")
+    with open(p) as f:
+        code = f.read()
+    anchor = ("            carry = (t_vals, f_vals, opt_states, w32s, "
+              "residuals, mstate)")
+    assert anchor in code, "_traced_step_window moved; update this test"
+    bad = code.replace(
+        anchor,
+        anchor + "\n            _dbg = float(t_vals[0].asnumpy())", 1)
+    diags = lint_source(bad, "mxnet_tpu/step.py")
+    assert "jit-purity" in rules_of(diags)
+    new, _, _ = apply_baseline(diags, load_baseline(BASELINE))
+    assert "jit-purity" in rules_of(new)
+
+
+def test_reinjected_asnumpy_in_compiled_step_host_path_trips():
+    """The compiled lane's HOST side (CompiledStep._run and friends) is a
+    hot-path root: a per-dispatch sync there stalls the one-program
+    pipeline exactly like a per-op sync used to."""
+    p = os.path.join(REPO, "mxnet_tpu", "step.py")
+    with open(p) as f:
+        code = f.read()
+    anchor = "        state = self._gather_state(plan)"
+    assert anchor in code, "CompiledStep._run moved; update this test"
+    bad = code.replace(
+        anchor, anchor + "\n        _dbg = state[0][0].asnumpy()", 1)
+    diags = lint_source(bad, "mxnet_tpu/step.py")
+    assert "host-sync-in-hot-path" in rules_of(diags)
+    new, _, _ = apply_baseline(diags, load_baseline(BASELINE))
+    assert "host-sync-in-hot-path" in rules_of(new)
+
+
+def test_compiled_step_is_hot_path_root():
+    """The rule table names the compiled-step entry points (regression
+    guard: removing the root entry would silently drop the coverage the
+    two reinjection tests above rely on)."""
+    from tools.mxlint.rules import HOT_PATH_ROOTS
+    roots = dict(HOT_PATH_ROOTS)
+    assert "mxnet_tpu/step.py" in roots
+    assert any("CompiledStep.step" in q for q in roots["mxnet_tpu/step.py"])
+    assert any("CompiledStep._run" in q for q in roots["mxnet_tpu/step.py"])
+
+
 def test_reinjected_wall_clock_in_kvstore_retry_trips():
     p = os.path.join(REPO, "mxnet_tpu", "kvstore", "kvstore.py")
     with open(p) as f:

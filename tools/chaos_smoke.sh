@@ -108,6 +108,94 @@ print("compressed_fit_smoke: PASS losses=%s wire_bytes=%d"
       % (["%.4f" % l for l in losses], wire))
 EOF
 
+echo "== chaos_smoke: compiled-mode fit (MX_STEP_COMPILE=1) + crash->restart->resume"
+# reference run under the whole-step-compiled lane; its params must ALSO
+# match the eager reference (compiled == eager parity through the CLI)
+MX_STEP_COMPILE=1 "$PY" "$REPO/tools/launch.py" -n 1 --launcher local -- \
+    "$PY" "$REPO/tools/chaos_fit.py" \
+    --ckpt-dir "$WORK/cref" --out "$WORK/cref" > "$WORK/cref.log" 2>&1
+rc=0
+MX_STEP_COMPILE=1 "$PY" "$REPO/tools/launch.py" -n 2 --launcher local \
+    --restart on-failure --max-restarts 2 \
+    --fault 'worker.step:crash:after=5' -- \
+    "$PY" "$REPO/tools/chaos_fit.py" \
+    --ckpt-dir "$WORK/cchaos" --out "$WORK/cchaos" 2>&1 \
+    | tee "$WORK/cchaos.log" || rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "chaos_smoke: FAIL - compiled-mode launch.py exited $rc" >&2
+    exit 1
+fi
+grep -q 'restart 1/' "$WORK/cchaos.log" || {
+    echo "chaos_smoke: FAIL - no compiled-mode restart happened" >&2
+    exit 1
+}
+"$PY" - "$WORK" <<'EOF'
+import sys
+import numpy as np
+work = sys.argv[1]
+eager = np.load("%s/ref.rank0.npz" % work)
+cref = np.load("%s/cref.rank0.npz" % work)
+# compiled fit == eager fit (same trajectory, one dispatch per batch)
+for k in eager.files:
+    np.testing.assert_allclose(cref[k], eager[k], rtol=1e-5, atol=1e-6,
+                               err_msg="compiled-vs-eager %s" % k)
+# crash->restart->resume round-trips the DONATED optimizer state: the
+# resumed compiled ranks land on the uninterrupted compiled run's params
+for rank in (0, 1):
+    got = np.load("%s/cchaos.rank%d.npz" % (work, rank))
+    for k in cref.files:
+        np.testing.assert_allclose(got[k], cref[k], rtol=1e-5, atol=1e-6,
+                                   err_msg="rank %d param %s" % (rank, k))
+print("chaos_smoke: compiled-mode fit matches eager; resume round-trips "
+      "donated optimizer state")
+EOF
+
+echo "== chaos_smoke: 3-step compiled int8 fit (CompiledStep, EF residuals donated)"
+XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+"$PY" - "$REPO" <<'EOF'
+import sys
+sys.path.insert(0, sys.argv[1])
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.engine import engine
+
+# single-program steps through the int8-compressed ICI exchange body on
+# a 2-device store: loss drops, the EF residual store fills, EVERY step
+# is one dispatch and the 4-step scan window costs 2 dispatches total
+mx.random.seed(0)
+ctxs = [mx.cpu(0), mx.cpu(1)]
+net = gluon.nn.Dense(4, in_units=8)
+net.initialize(mx.init.Xavier(), ctx=ctxs)
+trainer = gluon.Trainer(net.collect_params(), "sgd",
+                        {"learning_rate": 0.1}, kvstore="ici",
+                        compression_params={"type": "int8"})
+step = trainer.make_compiled_step(net, gluon.loss.L2Loss())
+rng = np.random.RandomState(0)
+X = rng.randn(16, 8).astype(np.float32)
+Y = X.dot(rng.randn(8, 4)).astype(np.float32)
+x_nd = nd.array(X, ctx=ctxs[0])
+y_nd = nd.array(Y, ctx=ctxs[0])
+losses = []
+for _ in range(3):
+    losses.append(float(step.step(x_nd, y_nd).mean().asnumpy()))
+c0 = engine.dispatch_count
+step.step(x_nd, y_nd)
+per_step = engine.dispatch_count - c0
+assert step.compiled, step.fallback_reason
+assert losses[-1] < losses[0], losses
+assert per_step <= 2, per_step
+assert trainer._kvstore._gc._residuals, "EF residual store never filled"
+Xw, Yw = np.stack([X] * 4), np.stack([Y] * 4)
+step.run_window(Xw, Yw)           # warm: the trace itself runs eager ops
+w0, s0 = engine.dispatch_count, engine.compiled_steps
+step.run_window(Xw, Yw)
+assert engine.dispatch_count - w0 <= 2, engine.dispatch_count - w0
+assert engine.compiled_steps - s0 == 4
+print("compiled_step_smoke: PASS losses=%s dispatches/step=%d"
+      % (["%.4f" % l for l in losses], per_step))
+EOF
+
 echo "== chaos_smoke: static-analysis lane (tools/lint.sh)"
 bash "$REPO/tools/lint.sh"
 

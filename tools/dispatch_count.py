@@ -31,6 +31,10 @@ METRIC_BUDGET = 2
 # one overlap-scheduled, int8-compressed bucket exchange: concat + fused
 # quantize-allreduce-dequantize per bucket — never a per-key quantize
 EXCHANGE_BUDGET = 4
+# ISSUE 7: a compiled N-step scan window is data transfer + ONE window
+# launch, regardless of N — and a single compiled step is one launch
+COMPILED_WINDOW_BUDGET = 2
+COMPILED_STEP_BUDGET = 2
 
 
 def run_exchange(n_keys=40):
@@ -77,6 +81,61 @@ def run_exchange(n_keys=40):
         "ok": bool(batched_d <= EXCHANGE_BUDGET
                    and overlap_d <= EXCHANGE_BUDGET
                    and batched_d < n_keys and overlap_d < n_keys),
+    }
+
+
+def run_compiled(n_steps=4, hidden_layers=6, hidden=16):
+    """ISSUE 7 acceptance: the whole-step-compiled lane dispatches 1-2
+    device programs per N-step scan window (the batch transfer + the
+    window launch) — NOT N — and a single compiled step is one launch.
+    engine.compiled_steps must attribute all N optimizer steps to that
+    one window, so dispatches-per-step is 2/N in steady state."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd
+    from mxnet_tpu.engine import engine
+    from mxnet_tpu.gluon import nn
+
+    mx.random.seed(0)
+    np.random.seed(0)
+    net = nn.Sequential()
+    in_units = 8
+    for _ in range(hidden_layers):
+        net.add(nn.Dense(hidden, in_units=in_units, activation="relu"))
+        in_units = hidden
+    net.add(nn.Dense(4, in_units=in_units))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9})
+    loss_fn = gluon.loss.L2Loss()
+    metric = mx.metric.MSE()
+    step = trainer.make_compiled_step(net, loss_fn, metric=metric)
+    rng = np.random.RandomState(0)
+    Xw = rng.randn(n_steps, 16, 8).astype(np.float32)
+    Yw = rng.randn(n_steps, 16, 4).astype(np.float32)
+    step.run_window(Xw, Yw)                   # warm (trace + compile)
+    c0, s0 = engine.dispatch_count, engine.compiled_steps
+    step.run_window(Xw, Yw)
+    window_d = engine.dispatch_count - c0
+    window_steps = engine.compiled_steps - s0
+    x1 = nd.array(Xw[0])
+    y1 = nd.array(Yw[0])
+    step.step(x1, y1)                          # warm the 1-step entry
+    c1 = engine.dispatch_count
+    step.step(x1, y1)
+    single_d = engine.dispatch_count - c1
+    return {
+        "compiled": bool(step.compiled),
+        "scan_steps": n_steps,
+        "window_dispatches": window_d,
+        "window_steps_accounted": window_steps,
+        "single_step_dispatches": single_d,
+        "window_budget": COMPILED_WINDOW_BUDGET,
+        "step_budget": COMPILED_STEP_BUDGET,
+        "ok": bool(step.compiled
+                   and window_d <= COMPILED_WINDOW_BUDGET
+                   and window_steps == n_steps
+                   and single_d <= COMPILED_STEP_BUDGET),
     }
 
 
@@ -145,6 +204,12 @@ def main():
                     help="run the trainer fit under MX_GRAD_COMPRESS")
     ap.add_argument("--overlap", action="store_true",
                     help="run the trainer fit under MX_EXCHANGE_OVERLAP=1")
+    ap.add_argument("--compiled", action="store_true",
+                    help="also pin the ISSUE 7 compiled-step budget: 1-2 "
+                         "dispatches per N-step scan window")
+    ap.add_argument("--scan", type=int, default=0,
+                    help="scan window size for --compiled "
+                         "(default: MX_STEP_SCAN, else 4)")
     args = ap.parse_args()
     if args.compress:
         os.environ["MX_GRAD_COMPRESS"] = args.compress
@@ -155,6 +220,11 @@ def main():
     report["overlap"] = bool(args.overlap)
     report["exchange"] = run_exchange()
     report["ok"] = bool(report["ok"] and report["exchange"]["ok"])
+    if args.compiled:
+        from mxnet_tpu.step import scan_window
+        n_steps = args.scan or scan_window() or 4
+        report["compiled"] = run_compiled(n_steps=max(1, n_steps))
+        report["ok"] = bool(report["ok"] and report["compiled"]["ok"])
     print(json.dumps(report, indent=2))
     sys.exit(0 if report["ok"] else 1)
 

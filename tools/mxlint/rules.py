@@ -29,6 +29,16 @@ HOT_PATH_ROOTS: List[Tuple[str, List[str]]] = [
     ("mxnet_tpu/gluon/trainer.py",
      ["Trainer.step", "Trainer.update", "Trainer._update",
       "Trainer.allreduce_grads", "Trainer._allreduce_grads"]),
+    # the whole-step compiled lane (ISSUE 7): every host-side function on
+    # the per-dispatch path is a hot root — one sync here stalls the
+    # single-program pipeline exactly like a per-op sync used to.  The
+    # traced bodies (_traced_step_window / _traced_fit_step and their
+    # closures) are additionally jit-purity targets via their
+    # jax.jit(...) sites.
+    ("mxnet_tpu/step.py",
+     ["CompiledStep.step", "CompiledStep.run_window", "CompiledStep._run",
+      "CompiledStep._plan", "CompiledStep._lr_rows",
+      "CompiledStep._gather_state", "CompiledStep._write_back"]),
     ("mxnet_tpu/module/*.py", ["*.update", "*.update_metric"]),
     ("mxnet_tpu/model.py", ["*.update", "*.update_metric"]),
     ("mxnet_tpu/metric.py", ["*.update", "*.update_dict"]),
