@@ -274,7 +274,12 @@ def _topo_from(heads: Sequence[Tuple[OpNode, int]]) -> List[OpNode]:
 def backward(heads, head_grads=None, retain_graph: bool = False,
              train_mode: bool = True) -> None:
     """Compute gradients of heads w.r.t. attached variables (writes .grad)."""
-    _run_backward(heads, head_grads, retain_graph, write_leaves=True)
+    from . import telemetry as _telemetry
+    # step-phase span (ISSUE 8): eager Gluon loops get their backward
+    # attributed; dispatch-time only (the tape replay enqueues async
+    # XLA work, nothing here syncs it)
+    with _telemetry.phase("backward"):
+        _run_backward(heads, head_grads, retain_graph, write_leaves=True)
 
 
 def grad(heads, variables, head_grads=None, retain_graph=None,

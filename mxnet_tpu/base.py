@@ -93,6 +93,10 @@ ENV_CATALOG: Dict[str, Any] = {
     "MX_FLASH_BLOCK_Q": ("256", "Pallas flash-attention query-block rows (VMEM tiling knob; sweepable on hardware)."),
     "MX_FLASH_BLOCK_K": ("256", "Pallas flash-attention key-block rows."),
     "MX_NO_CAPTURE_FALLBACK": ("0", "bench.py: never replay a TPU capture (the capture loop's own children set this)."),
+    "MX_TELEMETRY": ("1", "Runtime telemetry (mxnet_tpu/telemetry.py): 1 (default) records per-phase step histograms (data_wait/forward/backward/exchange/optimizer_apply/metric_update/metric_drain/retrace/compiled_step) into the process-wide instrument registry and appends one flight-recorder step record per training step (phase durations, dispatch/wire deltas, retry + NaN-guard hits, throughput); 0 disables both (spans become shared no-ops).  Engine counters (dispatch_count, wire_bytes, compiled_steps) live in the registry regardless - this flag gates only the span/record layer."),
+    "MX_TELEMETRY_TRACE": ("", "Directory for per-process distributed trace files: when set, every span (step phases, kvstore client RPCs, server handling incl. retry/replay events, causally linked by wire-propagated trace/span IDs) is buffered and flushed to <dir>/trace-<role>-r<rank>-p<pid>.trace.json at process exit; tools/telemetry_dump.py merges the per-worker files into one chrome-trace timeline.  Empty disables span buffering (tests force it via telemetry.start_tracing())."),
+    "MX_TELEMETRY_RING": ("256", "Flight-recorder capacity: the telemetry ring keeps the last N structured step records, dumped to MX_CRASH_DIR on watchdog/NaN/fit failure and summarized (step, throughput, last-exchange bytes) in the heartbeat file's JSON payload for the supervisor's fleet status table."),
+    "MX_CRASH_DIR": ("", "Crash-dump directory: on a watchdog trip, an MX_NAN_POLICY=raise gradient guard, a fit-loop exception, or a supervisor-observed rank failure, the flight-recorder ring + a counters snapshot are written to <dir>/crash-rank<r>-pid<p>-<n>.json (the supervisor adds supervisor-<proc>-<n>.json with what it saw: exit code, restarts, last heartbeat payload).  Empty disables crash dumps."),
 }
 
 

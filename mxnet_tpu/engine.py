@@ -30,50 +30,95 @@ class Engine:
 
     def __init__(self):
         import weakref
+        from . import telemetry as _telemetry
         self._kind_raw = object()   # sentinel: never equals a str
         self._naive = False
         # live NDArray chunks, registered at creation/write; WaitForAll
         # blocks on each — the reference's "wait for all vars" semantics
         self._live = weakref.WeakSet()
-        # device-program launches since process start (or the caller's last
-        # snapshot): eager op invokes, fused tree updates, kvstore
-        # collectives, metric accumulates, whole-graph jit steps.  The
-        # dispatch-budget harness (tools/dispatch_count.py) reads deltas of
-        # this to pin the O(#buckets)-dispatches-per-step contract.
-        self.dispatch_count = 0
-        # gradient-exchange payload bytes since process start: what each
-        # pushed gradient occupies in its wire representation (compressed
-        # codes+scales, bf16 cast, or full width).  tools/bandwidth.py and
-        # bench.py --exchange read deltas of this to report measured
-        # bytes-per-step, compressed vs fp32 (ISSUE 5 acceptance).
-        self.wire_bytes = 0
-        # whole-step-compiled accounting (ISSUE 7): a lax.scan window of N
-        # training steps is ONE device-program launch — dispatch_count
-        # grows by the window's launches (1, +1 for its host->device batch
-        # transfer), never by N.  compiled_steps tracks the optimizer
-        # steps those windows covered so tools/dispatch_count.py can
-        # report dispatches-per-step < 1 in scan mode.
-        self.compiled_step_windows = 0
-        self.compiled_steps = 0
+        # ISSUE 8: the step accounting lives in the telemetry registry
+        # (one source of truth for exposition, flight recorder and crash
+        # dumps); the dispatch_count / wire_bytes / compiled_* properties
+        # below alias it so tools/dispatch_count.py, tools/bandwidth.py
+        # and every existing delta-reading harness keep working.
+        #
+        # - engine.dispatch_count: device-program launches since process
+        #   start — eager op invokes, fused tree updates, kvstore
+        #   collectives, metric accumulates, whole-graph jit steps.  The
+        #   dispatch-budget harness pins O(#buckets)-dispatches-per-step
+        #   on deltas of this.
+        # - engine.wire_bytes: gradient-exchange payload bytes in their
+        #   wire representation (compressed codes+scales, bf16 cast, or
+        #   full width) — ISSUE 5 acceptance reads deltas.
+        # - compiled windows/steps (ISSUE 7): a lax.scan window of N
+        #   steps is ONE launch; compiled_steps attributes the N.
+        self._c_dispatch = _telemetry.registry.counter(
+            "engine.dispatch_count",
+            doc="device-program dispatches since process start")
+        self._c_wire = _telemetry.registry.counter(
+            "engine.wire_bytes",
+            doc="gradient-exchange wire bytes (compressed representation)")
+        self._c_windows = _telemetry.registry.counter(
+            "engine.compiled_step_windows",
+            doc="whole-step-compiled window launches")
+        self._c_steps = _telemetry.registry.counter(
+            "engine.compiled_steps",
+            doc="optimizer steps covered by compiled windows")
 
     def track(self, chunk) -> None:
         self._live.add(chunk)
 
+    # -- telemetry-registry-backed counters (ISSUE 8) ----------------------
+    # kept as read/write properties: harnesses read them as plain ints and
+    # tests reset them with `engine.wire_bytes = 0`
+    @property
+    def dispatch_count(self) -> int:
+        return self._c_dispatch.value
+
+    @dispatch_count.setter
+    def dispatch_count(self, v: int) -> None:
+        self._c_dispatch.set(int(v))
+
+    @property
+    def wire_bytes(self) -> int:
+        return self._c_wire.value
+
+    @wire_bytes.setter
+    def wire_bytes(self, v: int) -> None:
+        self._c_wire.set(int(v))
+
+    @property
+    def compiled_step_windows(self) -> int:
+        return self._c_windows.value
+
+    @compiled_step_windows.setter
+    def compiled_step_windows(self, v: int) -> None:
+        self._c_windows.set(int(v))
+
+    @property
+    def compiled_steps(self) -> int:
+        return self._c_steps.value
+
+    @compiled_steps.setter
+    def compiled_steps(self, v: int) -> None:
+        self._c_steps.set(int(v))
+
     def count_dispatch(self, n: int = 1) -> None:
-        """Note `n` device-program dispatches (hot path: one int add)."""
-        self.dispatch_count += n
+        """Note `n` device-program dispatches (hot path: one counter add)."""
+        self._c_dispatch.inc(n)
 
     def count_step_window(self, steps: int, dispatches: int = 1) -> None:
         """Note one compiled N-step window: `steps` optimizer steps
         executed under `dispatches` device launches (the window dispatch,
         plus any host->device input transfer the caller counts)."""
-        self.dispatch_count += int(dispatches)
-        self.compiled_step_windows += 1
-        self.compiled_steps += int(steps)
+        self._c_dispatch.inc(int(dispatches))
+        self._c_windows.inc(1)
+        self._c_steps.inc(int(steps))
 
     def count_wire_bytes(self, n: int) -> None:
-        """Note `n` gradient-exchange wire bytes (hot path: one int add)."""
-        self.wire_bytes += int(n)
+        """Note `n` gradient-exchange wire bytes (hot path: one counter
+        add)."""
+        self._c_wire.inc(int(n))
 
     # -- engine type -------------------------------------------------------
     @property

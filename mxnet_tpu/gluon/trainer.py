@@ -18,7 +18,7 @@ from typing import Dict, List, Optional
 
 from ..base import MXNetError, get_env
 from .. import optimizer as opt
-from .. import profiler as _profiler
+from .. import telemetry as _telemetry
 from ..kvstore import create as kv_create
 from .parameter import Parameter, ParameterDict
 
@@ -230,6 +230,10 @@ class Trainer:
             self._init_params()
         self._allreduce_grads()
         self._update(ignore_stale_grad)
+        # flight recorder (ISSUE 8): one structured step record per
+        # optimizer step — phase durations accumulated above + dispatch/
+        # wire deltas; dispatch-time only, no host sync
+        _telemetry.note_step(batch_size=batch_size)
 
     def allreduce_grads(self):
         """Separate allreduce for gradient manipulation between reduce and
@@ -342,7 +346,7 @@ class Trainer:
             # backward — launch stragglers and commit the results
             with self._hook_lock:
                 self._exchange_session = None
-            with _profiler.annotate("trainer.allreduce"):
+            with _telemetry.phase("exchange"):
                 sess.drain()
             self._arm_exchange()
             return
@@ -354,7 +358,7 @@ class Trainer:
         # small dense keys into fusion buckets (MX_KVSTORE_BUCKET_KB) so a
         # ResNet-scale model does a few bucket exchanges per step instead
         # of ~160 per-key ones
-        with _profiler.annotate("trainer.allreduce"):
+        with _telemetry.phase("exchange"):
             self._kvstore.push(idxs, grad_lists)
             if self._update_on_kvstore:
                 # server-side optimizer ran on push: fetch updated weights
@@ -390,7 +394,7 @@ class Trainer:
     def _update(self, ignore_stale_grad=False):
         if self._update_on_kvstore:
             return
-        with _profiler.annotate("trainer.update"):
+        with _telemetry.phase("optimizer_apply"):
             for d, upd in enumerate(self._updaters):
                 # dense params: ONE batched updater call per device — the
                 # aggregate-enabled optimizer applies the whole group as a

@@ -48,6 +48,7 @@ import traceback
 from typing import Callable, Iterable, List, Optional, Tuple
 
 from . import fault as _fault
+from . import telemetry as _telemetry
 from .base import MXNetError, get_env
 
 __all__ = ["WATCHDOG_EXIT_CODE", "NAN_POLICIES", "nonfinite_grads",
@@ -123,8 +124,17 @@ class GradientGuard:
         if not bad:
             return True
         self.nan_events += 1
+        # registry counter (ISSUE 8): NaN-guard hits ride every flight-
+        # recorder step record and the crash-dump counters snapshot
+        _telemetry.registry.counter(
+            "health.nan_events",
+            doc="batches with non-finite gradients (MX_NAN_POLICY)").inc()
         shown = ", ".join(bad[:4]) + ("..." if len(bad) > 4 else "")
         if self.policy == "raise":
+            # the raise kills this rank: leave the flight recorder's
+            # last step records in MX_CRASH_DIR on the way out
+            _telemetry.dump_crash(
+                "nan_policy_raise: non-finite gradient(s) in %s" % shown)
             raise MXNetError(
                 "non-finite gradient(s) in %s (MX_NAN_POLICY=raise)"
                 % shown)
@@ -203,6 +213,13 @@ class Watchdog:
             "watchdog: no training-step progress for > %.3gs "
             "(MX_STEP_TIMEOUT) - dumping thread stacks and exiting %d\n"
             % (self.timeout, WATCHDOG_EXIT_CODE))
+        # flight-recorder crash dump FIRST (ISSUE 8): the ring's last
+        # step records say what the rank was doing when it wedged —
+        # written before the stack dump so even a hung stderr cannot
+        # lose it
+        _telemetry.dump_crash(
+            "watchdog: no step progress for > %.3gs (MX_STEP_TIMEOUT)"
+            % self.timeout)
         dump_all_stacks(sys.stderr)
         if self.on_timeout is not None:
             self.on_timeout()
@@ -239,7 +256,13 @@ class Heartbeat:
         os.makedirs(parent, exist_ok=True)
 
     def beat(self, epoch: int = 0, nbatch: int = 0) -> None:
-        self._write("%d %d" % (epoch, nbatch))
+        # telemetry payload (ISSUE 8): the latest flight-recorder step
+        # record rides line 2 as compact JSON (step, throughput, last-
+        # exchange bytes) — what the supervisor's fleet status table
+        # renders without any wire protocol.  Line 1 keeps the classic
+        # `<unix-time> <epoch> <batch>` format.
+        self._write("%d %d" % (epoch, nbatch),
+                    payload=_telemetry.heartbeat_payload())
 
     def done(self) -> None:
         """Final beat: training finished, the process may legitimately
@@ -247,7 +270,8 @@ class Heartbeat:
         'done' token and stops hang enforcement for this rank."""
         self._write("done")
 
-    def _write(self, tail: str) -> None:
+    def _write(self, tail: str, payload=None) -> None:
+        import json as _json
         import time as _time
         tmp = "%s.tmp.%d" % (self.path, os.getpid())
         try:
@@ -255,8 +279,11 @@ class Heartbeat:
                 # wall-clock ON PURPOSE: the beat's payload is a human-
                 # readable timestamp; liveness uses the file's mtime
                 f.write("%f %s\n" % (_time.time(), tail))  # mxlint: disable=wall-clock-in-fault-path
+                if payload:
+                    f.write(_json.dumps(payload,
+                                        separators=(",", ":")) + "\n")
             os.replace(tmp, self.path)
-        except OSError:
+        except (OSError, TypeError, ValueError):
             pass    # liveness is advisory - never fail training over it
 
     def remove(self) -> None:
@@ -332,6 +359,10 @@ class StepGuard:
 
     def batch_end(self, epoch: int = 0, nbatch: int = 0) -> None:
         self._steps += 1
+        # one flight-recorder step record per completed batch (ISSUE 8)
+        # — BEFORE the heartbeat so the beat's JSON payload carries THIS
+        # step, not the previous one
+        _telemetry.note_step(epoch=epoch, batch=nbatch)
         if self.watchdog is not None:
             self.watchdog.pet()
         if self.heartbeat is not None:

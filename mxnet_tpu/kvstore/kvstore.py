@@ -1210,39 +1210,48 @@ class KVStoreDistAsync(KVStore):
         # (the concurrency is the point of big-array sharding).  PULL is
         # idempotent, so a failed round simply re-issues every part with
         # fresh seqs under the retry policy.
+        from .. import telemetry as _telemetry
         policy = self._retry_policy()
         timeout = self._recv_timeout("PULL")
-        for _attempt in policy:
-            try:
-                with self._lock:
+        with _telemetry.rpc_span("kv.client.PULL_SHARDED") as span:
+            tctx = span.wire_context()
+            for _attempt in policy:
+                try:
+                    with self._lock:
+                        for i, _s, _e in plan:
+                            sock = self._ensure_sock(i)
+                            self._fault.fire(
+                                "kvstore.send",
+                                on_close=lambda i=i: self._kill_sock(i))
+                            inner = ("PULL", self._part_key(k, i))
+                            env = ("SEQ", self._client_id,
+                                   self._next_seq(), inner)
+                            self._srv_mod.send_msg(
+                                sock, env if tctx is None
+                                else env + (tctx,))
+                        parts = []
+                        bad = None
+                        for i, _s, _e in plan:
+                            # drain EVERY pending reply even after a
+                            # failure: an unread response left buffered
+                            # would be misread as the next RPC's answer
+                            # (desync)
+                            ok, payload = self._srv_mod.recv_msg(
+                                self._socks[i], timeout=timeout)
+                            if not ok and bad is None:
+                                bad = (i, payload)
+                            parts.append(payload)
+                        if bad is not None:
+                            raise MXNetError(
+                                "dist_async server %d: %s" % bad)
+                    return _onp.concatenate(
+                        [_onp.asarray(p).ravel()
+                         for p in parts]).reshape(shape)
+                except (ConnectionError, OSError, TimeoutError) as e:
                     for i, _s, _e in plan:
-                        sock = self._ensure_sock(i)
-                        self._fault.fire(
-                            "kvstore.send",
-                            on_close=lambda i=i: self._kill_sock(i))
-                        self._srv_mod.send_msg(
-                            sock, ("SEQ", self._client_id,
-                                   self._next_seq(),
-                                   ("PULL", self._part_key(k, i))))
-                    parts = []
-                    bad = None
-                    for i, _s, _e in plan:
-                        # drain EVERY pending reply even after a failure:
-                        # an unread response left buffered would be
-                        # misread as the next RPC's answer (desync)
-                        ok, payload = self._srv_mod.recv_msg(
-                            self._socks[i], timeout=timeout)
-                        if not ok and bad is None:
-                            bad = (i, payload)
-                        parts.append(payload)
-                    if bad is not None:
-                        raise MXNetError("dist_async server %d: %s" % bad)
-                return _onp.concatenate(
-                    [_onp.asarray(p).ravel() for p in parts]).reshape(shape)
-            except (ConnectionError, OSError, TimeoutError) as e:
-                for i, _s, _e in plan:
-                    self._kill_sock(i)
-                policy.note(e)
+                        self._kill_sock(i)
+                    policy.note(e)
+                    self._note_retry(span, -1, -1, e)
         raise MXNetError(
             "dist_async sharded pull of %r failed for %.3gs "
             "(MX_KVSTORE_RETRY_DEADLINE); last error: %s"
@@ -1253,41 +1262,62 @@ class KVStoreDistAsync(KVStore):
         connection, reconnect and REPLAY the same (client_id, seq)
         envelope — the server's replay cache makes the retry idempotent
         (a PUSH applied before the reply was lost is answered from cache,
-        never re-applied).  Gives up loudly after the retry deadline."""
+        never re-applied).  Gives up loudly after the retry deadline.
+
+        Distributed tracing (ISSUE 8): the RPC runs under a client span
+        whose (trace_id, span_id) ride the SEQ envelope, so the server's
+        handler span becomes this span's child — one causally linked
+        trace across the socket; each retry is an instant child event."""
+        from .. import telemetry as _telemetry
         seq = self._next_seq()
-        wrapped = ("SEQ", self._client_id, seq, msg)
         timeout = self._recv_timeout(msg[0])
         policy = self._retry_policy()
         if msg[0] == "STOP":
             # shutdown is best-effort: don't spend the full recovery
             # deadline on a server that is already gone
             policy.deadline = min(policy.deadline, 5.0)
-        for _attempt in policy:
-            with self._lock:
-                try:
-                    sock = self._ensure_sock(idx)
-                    self._fault.fire(
-                        "kvstore.send",
-                        on_close=lambda: self._kill_sock(idx))
-                    self._srv_mod.send_msg(sock, wrapped)
-                    self._fault.fire(
-                        "kvstore.recv",
-                        on_close=lambda: self._kill_sock(idx))
-                    ok, payload = self._srv_mod.recv_msg(sock,
-                                                         timeout=timeout)
-                except (ConnectionError, OSError, TimeoutError) as e:
-                    self._kill_sock(idx)
-                    policy.note(e)
-                    continue
-            if not ok:
-                raise MXNetError("dist_async server %d: %s"
-                                 % (idx, payload))
-            return payload
+        with _telemetry.rpc_span("kv.client.%s" % msg[0]) as span:
+            tctx = span.wire_context()
+            wrapped = ("SEQ", self._client_id, seq, msg) if tctx is None \
+                else ("SEQ", self._client_id, seq, msg, tctx)
+            for _attempt in policy:
+                with self._lock:
+                    try:
+                        sock = self._ensure_sock(idx)
+                        self._fault.fire(
+                            "kvstore.send",
+                            on_close=lambda: self._kill_sock(idx))
+                        self._srv_mod.send_msg(sock, wrapped)
+                        self._fault.fire(
+                            "kvstore.recv",
+                            on_close=lambda: self._kill_sock(idx))
+                        ok, payload = self._srv_mod.recv_msg(
+                            sock, timeout=timeout)
+                    except (ConnectionError, OSError, TimeoutError) as e:
+                        self._kill_sock(idx)
+                        policy.note(e)
+                        self._note_retry(span, idx, seq, e)
+                        continue
+                if not ok:
+                    raise MXNetError("dist_async server %d: %s"
+                                     % (idx, payload))
+                return payload
         raise MXNetError(
             "dist_async server %d (%s) unreachable: %r retried for %.3gs "
             "(MX_KVSTORE_RETRY_DEADLINE exceeded); last error: %s"
             % (idx, self._addrs[idx], msg[0], policy.deadline,
                policy.last_error))
+
+    @staticmethod
+    def _note_retry(span, idx, seq, err) -> None:
+        """Account one reconnect-and-replay: registry counter (rides the
+        flight-recorder step records) + an instant child event on the
+        RPC span (rides the merged chrome trace)."""
+        from .. import telemetry as _telemetry
+        _telemetry.registry.counter(
+            "kvstore.client_retries",
+            doc="dist_async RPC reconnect-and-replay attempts").inc()
+        span.event("retry", server=idx, seq=seq, error=str(err))
 
     def _rpc(self, *msg):
         """Route by key for data commands; controller commands go wider

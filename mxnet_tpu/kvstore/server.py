@@ -324,50 +324,75 @@ class KVStoreServer:
         snapshot for a mutating command fires AFTER its cache entry
         resolves, so a persisted store state always travels with the
         cache entry that marks its push as applied (a crash between the
-        two can therefore never lead to a double-apply on restart)."""
+        two can therefore never lead to a double-apply on restart).
+
+        Distributed tracing (ISSUE 8): a SEQ envelope may carry a fifth
+        element ``(trace_id, span_id)`` stamped by the client's RPC
+        span; the handling here runs under a child span with those IDs,
+        so the merged chrome trace (tools/telemetry_dump.py) shows one
+        causal chain per RPC — replay-cache hits become instant
+        ``replay`` child events.  Envelopes without the element (older
+        clients, tools) are handled identically."""
         if isinstance(msg, tuple) and msg and msg[0] == "SEQ":
-            _, cid, seq, inner = msg
+            from .. import telemetry as _telemetry
+            cid, seq, inner = msg[1], msg[2], msg[3]
+            tctx = msg[4] if len(msg) > 4 else None
             self.touch(cid)
             cmd = inner[0] if inner else None
-            if cmd in ("PULL", "PING"):
-                return self.handle(inner, client_id=cid)
-            with self._replay_lock:
-                ent = self._replay.get(cid)
-                if ent is not None and seq == ent[0]:
-                    dup = ent
-                elif ent is not None and seq < ent[0]:
-                    return False, ("stale request seq %s (server already "
-                                   "at %s)" % (seq, ent[0]))
-                else:
-                    dup = None
-                    ent = [seq, threading.Event(), None]
-                    self._replay[cid] = ent
-            if dup is not None:
-                # the original execution may still be in flight on the
-                # dead connection's thread: wait for its result rather
-                # than re-executing (PUSH must apply exactly once)
-                timeout = (_env_timeout("MX_KVSTORE_BARRIER_TIMEOUT")
-                           or 120) + 30
-                if not dup[1].wait(timeout=timeout):
-                    return False, "replayed request %s still in flight" % seq
-                return dup[2]
-            try:
-                resp = self.handle(inner, client_id=cid)
-            except BaseException as e:
-                # the entry MUST resolve even on a handler fault — a
-                # forever-pending seq would starve every future replay of
-                # it (the client would burn its whole retry deadline)
-                ent[2] = (False, "server error handling %r: %s"
-                          % (inner[0], e))
-                ent[1].set()
-                raise
-            ent[2] = resp
-            ent[1].set()
-            if cmd in self._MUTATING:
-                self._note_mutation()
-            return resp
+            with _telemetry.rpc_span(
+                    "kv.server.%s" % cmd,
+                    trace_id=tctx[0] if tctx else None,
+                    parent_id=tctx[1] if tctx else None) as span:
+                return self._handle_seq(cid, seq, inner, cmd, span)
         resp = self.handle(msg, client_id=client_id)
         if msg and msg[0] in self._MUTATING:
+            self._note_mutation()
+        return resp
+
+    def _handle_seq(self, cid, seq, inner, cmd, span):
+        """SEQ-enveloped dispatch under the caller's server span."""
+        if cmd in ("PULL", "PING"):
+            return self.handle(inner, client_id=cid)
+        with self._replay_lock:
+            ent = self._replay.get(cid)
+            if ent is not None and seq == ent[0]:
+                dup = ent
+            elif ent is not None and seq < ent[0]:
+                span.event("stale", seq=seq, server_at=ent[0])
+                return False, ("stale request seq %s (server already "
+                               "at %s)" % (seq, ent[0]))
+            else:
+                dup = None
+                ent = [seq, threading.Event(), None]
+                self._replay[cid] = ent
+        if dup is not None:
+            # the original execution may still be in flight on the
+            # dead connection's thread: wait for its result rather
+            # than re-executing (PUSH must apply exactly once)
+            from .. import telemetry as _telemetry
+            span.event("replay", seq=seq)
+            _telemetry.registry.counter(
+                "kvstore.server_replays",
+                doc="SEQ requests answered from the exactly-once "
+                    "replay cache").inc()
+            timeout = (_env_timeout("MX_KVSTORE_BARRIER_TIMEOUT")
+                       or 120) + 30
+            if not dup[1].wait(timeout=timeout):
+                return False, "replayed request %s still in flight" % seq
+            return dup[2]
+        try:
+            resp = self.handle(inner, client_id=cid)
+        except BaseException as e:
+            # the entry MUST resolve even on a handler fault — a
+            # forever-pending seq would starve every future replay of
+            # it (the client would burn its whole retry deadline)
+            ent[2] = (False, "server error handling %r: %s"
+                      % (inner[0], e))
+            ent[1].set()
+            raise
+        ent[2] = resp
+        ent[1].set()
+        if cmd in self._MUTATING:
             self._note_mutation()
         return resp
 

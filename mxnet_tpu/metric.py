@@ -129,8 +129,8 @@ class EvalMetric:
                 ds = jax.device_put(ds, dev)
                 di = jax.device_put(di, dev)
         from .engine import engine as _engine
-        from . import profiler as _profiler
-        with _profiler.annotate("metric.accumulate"):
+        from . import telemetry as _telemetry
+        with _telemetry.phase("metric_update"):
             _engine.count_dispatch()
             self._dev_sum, self._dev_inst = kernel(ds, di, *arrays)
 
@@ -147,13 +147,18 @@ class EvalMetric:
 
     def _drain_device(self):
         """Host sync point: move the device accumulators into the classic
-        sum_metric/num_inst fields (called by get())."""
+        sum_metric/num_inst fields (called by get()).  This is the ONE
+        deliberate metric sync per drain — the telemetry phase span makes
+        its cost visible (epoch-end drains are cheap; one inside the step
+        loop would light up the per-phase breakdown)."""
         ds = getattr(self, "_dev_sum", None)
         if ds is not None:
-            self.sum_metric += float(_np.asarray(ds))
-            self.num_inst += int(_np.asarray(self._dev_inst))
-            self._dev_sum = None
-            self._dev_inst = None
+            from . import telemetry as _telemetry
+            with _telemetry.phase("metric_drain"):
+                self.sum_metric += float(_np.asarray(ds))
+                self.num_inst += int(_np.asarray(self._dev_inst))
+                self._dev_sum = None
+                self._dev_inst = None
 
     def get(self):
         self._drain_device()

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import fault as _fault
+from .. import telemetry as _telemetry
 from ..base import MXNetError
 from ..device import Context, cpu, current_context
 from .. import initializer as init_mod
@@ -198,8 +199,12 @@ class BaseModule:
 
     # shared loops ----------------------------------------------------------
     def forward_backward(self, data_batch):
-        self.forward(data_batch, is_train=True)
-        self.backward()
+        # step-phase spans (ISSUE 8): dispatch-time only — forward/
+        # backward enqueue async XLA work, the span never syncs it
+        with _telemetry.phase("forward"):
+            self.forward(data_batch, is_train=True)
+        with _telemetry.phase("backward"):
+            self.backward()
 
     def _compiled_fit_batch(self, data_batch, eval_metric):
         """Whole-step-compiled fit iteration (MX_STEP_COMPILE=1): run
@@ -346,6 +351,13 @@ class BaseModule:
                 epoch_end_callback=epoch_end_callback,
                 eval_end_callback=eval_end_callback,
                 eval_batch_end_callback=eval_batch_end_callback)
+        except BaseException as e:
+            # flight recorder (ISSUE 8): a fit loop dying for ANY reason
+            # — injected crash (SystemExit), NaN raise, OOM, data error —
+            # leaves its last MX_TELEMETRY_RING step records in
+            # MX_CRASH_DIR before the exception propagates
+            _telemetry.dump_crash("fit: %r" % (e,))
+            raise
         finally:
             if guard.skipped_batches:
                 self.logger.warning(
@@ -369,7 +381,18 @@ class BaseModule:
         for epoch in range(begin_epoch, num_epoch):
             eval_metric.reset()
             train_data.reset()
-            for nbatch, data_batch in enumerate(train_data):
+            batches = iter(train_data)
+            nbatch = -1
+            while True:
+                # data_wait phase (ISSUE 8): time spent blocked on the
+                # input pipeline — the one step phase that is HOST wait
+                # by definition, so an input-bound run shows up as a fat
+                # data_wait bar instead of vanishing into "forward"
+                with _telemetry.phase("data_wait"):
+                    data_batch = next(batches, None)
+                if data_batch is None:
+                    break
+                nbatch += 1
                 guard.batch_start()
                 # chaos site: launch.py --fault 'worker.step:crash:
                 # after=N' (or a delay spec the watchdog converts into a
@@ -1107,9 +1130,12 @@ class Module(BaseModule):
         states = tuple(tuple(donatable(s) for s in inner)
                        for inner in states)
         w32s = tuple(donatable(w) for w in w32s)
-        (new_diff, new_states, new_w32, aux_new, outs,
-         new_mstate) = step(diff, other, states, w32s,
-                            label_vals, rng, lr_vec, decay_vec, mstate)
+        # ISSUE 8: the one-dispatch fit batch shows up in profiler
+        # dumps() and the per-phase breakdown like any eager phase would
+        with _telemetry.phase("compiled_step"):
+            (new_diff, new_states, new_w32, aux_new, outs,
+             new_mstate) = step(diff, other, states, w32s,
+                                label_vals, rng, lr_vec, decay_vec, mstate)
         self._compiled_owned_refs = [
             a for a in jax.tree_util.tree_leaves(
                 (new_diff, new_states, new_w32))
@@ -1233,8 +1259,7 @@ class Module(BaseModule):
             grads.append(grad)
             weights.append(self._exec.arg_dict[name])
         if idxs:
-            from .. import profiler as _profiler
-            with _profiler.annotate("module.update"):
+            with _telemetry.phase("optimizer_apply"):
                 self._updater(idxs, grads, weights)
 
     def get_outputs(self):

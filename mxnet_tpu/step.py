@@ -564,7 +564,7 @@ class CompiledStep:
         """One window dispatch: xs/ys leaves shaped (n_steps*accum, B,
         ...).  Returns (losses, outs_or_None) as jax arrays."""
         from .engine import engine as _engine
-        from . import profiler as _profiler
+        from . import telemetry as _telemetry
         rescale, wds, lr_rows, decay_rows = self._lr_rows(
             plan, n_steps, batch_size)
         metric_info = metric_trace_kernel(self._metric)
@@ -584,10 +584,15 @@ class CompiledStep:
                return_outs)
         fn = self._cache.get(key)
         if fn is None:
-            fn = self._build_fn(plan, n_steps, accum, rescale, wds,
-                                decay_rows is not None, metric_info,
-                                return_outs)
-            self._cache[key] = fn
+            # profiler blind spot fix (ISSUE 8): a retrace is the
+            # expensive rare event that used to hide inside the first
+            # dispatch — it gets its own phase span so hybridize-style
+            # recompiles are visible in dumps() and the flight recorder
+            with _telemetry.phase("retrace"):
+                fn = self._build_fn(plan, n_steps, accum, rescale, wds,
+                                    decay_rows is not None, metric_info,
+                                    return_outs)
+                self._cache[key] = fn
         state = self._gather_state(plan)
 
         def donatable(a):
@@ -597,7 +602,12 @@ class CompiledStep:
 
         state = tuple(jax.tree_util.tree_map(donatable, s) for s in state)
         rng = _ops_random.next_key()
-        with _profiler.annotate("compiled_step"):
+        # distinct span names so scan windows and single compiled steps
+        # aggregate separately in profiler.dumps() (the eager-only
+        # blind spot this satellite closes)
+        span_name = "compiled_step" if n_steps * accum == 1 \
+            else "compiled_window"
+        with _telemetry.phase(span_name):
             out = fn(*state, lr_rows, decay_rows, rng, xs, ys)
         (new_t, new_f, new_states, new_w32, new_res, new_mstate,
          losses, outs) = out
@@ -613,6 +623,8 @@ class CompiledStep:
         if plan["exchange"] is not None:
             _engine.count_wire_bytes(
                 plan["exchange"].wire_bytes * n_steps)
+        _telemetry.note_step(steps=n_steps * accum, batch_size=batch_size,
+                             extra={"compiled": True})
         return losses, outs
 
     def step(self, data, label, batch_size=None):

@@ -1070,7 +1070,7 @@ def test_reinjected_asnumpy_in_trainer_update_trips():
     p = os.path.join(REPO, "mxnet_tpu", "gluon", "trainer.py")
     with open(p) as f:
         code = f.read()
-    anchor = 'with _profiler.annotate("trainer.update"):'
+    anchor = 'with _telemetry.phase("optimizer_apply"):'
     assert anchor in code, "Trainer._update moved; update this test"
     bad = code.replace(
         anchor,

@@ -177,13 +177,20 @@ def test_gradient_guard_policies():
 def test_heartbeat_beats_atomically(tmp_path):
     hb = health.Heartbeat(str(tmp_path / "sub" / "rank_0"))
     hb.beat(epoch=3, nbatch=7)
+    # line 1 keeps the classic `<unix-time> <epoch> <batch>` beat; line
+    # 2, when telemetry has recorded a step in this process, is the
+    # flight recorder's latest record as JSON (ISSUE 8)
     with open(hb.path) as f:
-        ts, epoch, nbatch = f.read().split()
+        lines = f.read().splitlines()
+    ts, epoch, nbatch = lines[0].split()
     assert abs(float(ts) - time.time()) < 60
     assert (epoch, nbatch) == ("3", "7")
+    if len(lines) > 1:
+        import json
+        assert "step" in json.loads(lines[1])
     hb.beat(epoch=3, nbatch=8)                     # rewrite, not append
     with open(hb.path) as f:
-        assert len(f.readlines()) == 1
+        assert len(f.read().splitlines()) <= 2
     hb.remove()
     assert not os.path.exists(hb.path)
 

@@ -25,8 +25,9 @@ echo "== chaos_smoke: uninterrupted reference run (-n 1)"
 
 echo "== chaos_smoke: -n 2 --restart on-failure --fault worker.step:crash:after=5"
 rc=0
+MX_CRASH_DIR="$WORK/crash" \
 "$PY" "$REPO/tools/launch.py" -n 2 --launcher local \
-    --restart on-failure --max-restarts 2 \
+    --restart on-failure --max-restarts 2 --status-interval 2 \
     --fault 'worker.step:crash:after=5' -- \
     "$PY" "$REPO/tools/chaos_fit.py" \
     --ckpt-dir "$WORK/chaos" --out "$WORK/chaos" 2>&1 \
@@ -44,6 +45,34 @@ if [ "$DONE" -ne 2 ]; then
     echo "chaos_smoke: FAIL - expected 2 completed ranks, saw $DONE" >&2
     exit 1
 fi
+
+echo "== chaos_smoke: flight-recorder crash dumps + supervisor status table (ISSUE 8)"
+# the kill-mid-fit above must leave BOTH sides of the observability
+# story in MX_CRASH_DIR: each crashed rank's in-process flight-recorder
+# dump (>= 1 structured step record) and the supervisor's own record of
+# what it saw; the supervisor log must render the fleet status table
+grep -q 'fleet status:' "$WORK/chaos.log" || {
+    echo "chaos_smoke: FAIL - supervisor never printed a fleet status table" >&2
+    exit 1
+}
+"$PY" - "$WORK/crash" <<'EOF'
+import glob, json, sys
+d = sys.argv[1]
+worker = sorted(glob.glob("%s/crash-rank*.json" % d))
+sup = sorted(glob.glob("%s/supervisor-*.json" % d))
+assert worker, "no worker flight-recorder crash dumps in %s" % d
+assert sup, "no supervisor crash records in %s" % d
+blob = json.load(open(worker[0]))
+assert len(blob.get("records") or []) >= 1, \
+    "crash dump %s has no step records: %s" % (worker[0], blob.keys())
+rec = blob["records"][-1]
+for field in ("step", "phases", "dispatches", "wire_bytes"):
+    assert field in rec, (field, rec)
+sblob = json.load(open(sup[0]))
+assert sblob["rc"] != 0 and "heartbeat" in sblob, sblob
+print("chaos_smoke: %d worker crash dump(s) with step records + %d "
+      "supervisor record(s)" % (len(worker), len(sup)))
+EOF
 
 echo "== chaos_smoke: comparing resumed params to the uninterrupted run"
 "$PY" - "$WORK" <<'EOF'

@@ -43,6 +43,7 @@ Example:
       --fault 'worker.step:crash:after=5' -- python train.py --kv dist
 """
 import argparse
+import json
 import os
 import pickle
 import shlex
@@ -141,13 +142,21 @@ class Supervisor:
 
     def __init__(self, restart="never", max_restarts=3, backoff=None,
                  hang_timeout=None, startup_grace=None, poll=0.05,
-                 log=None):
+                 log=None, status_interval=None):
         if restart not in ("never", "on-failure"):
             raise ValueError("restart must be 'never' or 'on-failure'")
         self.restart = restart
         self.max_restarts = int(max_restarts)
         self._backoff = backoff       # lazy: RetryPolicy needs mxnet_tpu
         self.hang_timeout = hang_timeout
+        # fleet status table (ISSUE 8): every status_interval wall
+        # seconds — and on every failure — print one line per process
+        # from the heartbeat files' telemetry JSON payload (step,
+        # throughput, last-exchange bytes); 0 = failures only, None
+        # (default) = no tables at all
+        self.status_interval = status_interval
+        self._last_status = time.time()
+        self._crash_seq = 0
         # before the FIRST beat (no heartbeat file yet) a process gets a
         # generous startup window — jax import + first-batch compile are
         # legitimately slow — but not forever: a (re)spawn that wedges
@@ -241,6 +250,101 @@ class Supervisor:
         if rc:
             self.job_rc = self.job_rc or (rc if rc > 0 else 1)
 
+    # -- fleet status (ISSUE 8) --------------------------------------------
+    @staticmethod
+    def _read_beat(sp):
+        """(age_seconds_or_None, head_line, telemetry_payload_dict) from
+        a rank's heartbeat file.  Line 1 is the classic
+        ``<unix-time> <epoch> <batch>`` / ``... done`` beat; line 2, when
+        present, is the flight recorder's latest step record as compact
+        JSON (mxnet_tpu.telemetry.heartbeat_payload)."""
+        if not sp.heartbeat:
+            return None, "", {}
+        try:
+            age = time.time() - os.stat(sp.heartbeat).st_mtime
+            with open(sp.heartbeat) as f:
+                lines = f.read().splitlines()
+        except OSError:
+            return None, "", {}
+        head = lines[0] if lines else ""
+        payload = {}
+        if len(lines) > 1 and lines[1].startswith("{"):
+            try:
+                payload = json.loads(lines[1])
+            except ValueError:
+                payload = {}
+        return age, head, payload
+
+    @staticmethod
+    def _state_of(sp):
+        if sp.done:
+            return "done(rc=%s)" % sp.rc
+        if sp.restart_at is not None:
+            return "restarting"
+        return "running" if sp.alive() else "spawning"
+
+    def status_table(self):
+        """Live fleet status as a rendered text table — one row per
+        supervised process, populated from the heartbeat telemetry
+        payloads.  What a human tailing the supervisor log (and
+        chaos_smoke.sh) reads to see where the fleet is."""
+        cols = ("proc", "state", "restarts", "step", "epoch",
+                "steps/s", "img/s", "wire KB", "beat age")
+        rows = [cols]
+        for sp in self.procs:
+            age, _head, p = self._read_beat(sp)
+            rows.append((
+                sp.name, self._state_of(sp), str(sp.restarts),
+                str(p.get("step", "-")), str(p.get("epoch", "-")),
+                "%.3g" % p["steps_per_sec"] if "steps_per_sec" in p
+                else "-",
+                "%.4g" % p["throughput"] if "throughput" in p else "-",
+                "%.1f" % (p["wire_bytes"] / 1024.0)
+                if "wire_bytes" in p else "-",
+                "%.1fs" % age if age is not None else "-"))
+        widths = [max(len(r[i]) for r in rows) for i in range(len(cols))]
+        lines = ["  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+                 for r in rows]
+        sep = "-" * len(lines[0])
+        return "\n".join(["fleet status:", sep] + lines + [sep])
+
+    def _maybe_status(self):
+        if not self.status_interval:
+            return
+        now = time.time()
+        if now - self._last_status >= self.status_interval:
+            self._last_status = now
+            self.log("\n" + self.status_table())
+
+    def _crash_dump(self, sp, rc, kind):
+        """Supervisor-side crash record into MX_CRASH_DIR: what the
+        supervisor observed of a failed process (exit code, restart
+        budget, last heartbeat payload).  The worker's own in-process
+        dump (flight-recorder ring) lands next to it; together they say
+        what the rank was doing and how it died."""
+        d = os.environ.get("MX_CRASH_DIR")
+        if not d:
+            return None
+        try:
+            os.makedirs(d, exist_ok=True)
+            self._crash_seq += 1
+            age, head, payload = self._read_beat(sp)
+            safe = "".join(c if c.isalnum() else "_" for c in sp.name)
+            path = os.path.join(d, "supervisor-%s-%d.json"
+                                % (safe, self._crash_seq))
+            blob = {"reason": kind, "proc": sp.name, "role": sp.role,
+                    "rc": rc, "restarts": sp.restarts,
+                    "wall_time": time.time(),
+                    "heartbeat_age": age, "heartbeat_head": head,
+                    "heartbeat": payload}
+            tmp = "%s.tmp.%d" % (path, os.getpid())
+            with open(tmp, "w") as f:
+                json.dump(blob, f, indent=1)
+            os.replace(tmp, path)
+            return path
+        except OSError:
+            return None
+
     # -- failure handling ---------------------------------------------------
     def _describe(self, rc):
         if rc == WATCHDOG_EXIT_CODE:
@@ -253,6 +357,11 @@ class Supervisor:
     def _on_failure(self, sp, rc):
         """Crashed (or was hang-killed).  Returns True to keep running,
         False when the budget is exhausted → caller tears the job down."""
+        self._crash_dump(sp, rc, self._describe(rc))
+        if self.status_interval is not None:
+            # a failure is always worth a fleet snapshot, whatever the
+            # interval cadence says
+            self.log("\n" + self.status_table())
         if self.restart != "on-failure":
             sp.rc = rc
             self._fold(rc)
@@ -349,6 +458,7 @@ class Supervisor:
                         return self.job_rc
                 if all(w.done for w in workers):
                     break
+                self._maybe_status()
                 self._sleep_poll()
         except BaseException:
             # ^C or any supervisor bug (e.g. a respawn Popen failing):
@@ -435,7 +545,9 @@ def _make_supervisor(args):
             raise SystemExit("--restart N needs N >= 0")
         restart = "on-failure"
     return Supervisor(restart=restart, max_restarts=max_restarts,
-                      hang_timeout=getattr(args, "hang_timeout", None))
+                      hang_timeout=getattr(args, "hang_timeout", None),
+                      status_interval=getattr(args, "status_interval",
+                                              None))
 
 
 # ---------------------------------------------------------------------------
@@ -446,7 +558,9 @@ def launch_local(args, command):
     coordinator = "127.0.0.1:%d" % _free_port()
     sup = _make_supervisor(args)
     hb_dir = None
-    if sup.hang_timeout:
+    if sup.hang_timeout or sup.status_interval:
+        # status tables read the same per-rank heartbeat files hang
+        # detection uses — either feature provisions them
         hb_dir = tempfile.mkdtemp(prefix="mx-heartbeat-")
     ps_roots = []
     if getattr(args, "num_servers", 0) > 0:
@@ -595,6 +709,14 @@ def main():
                         "batch+eval gap — slow is fine, wedged is not.  "
                         "Before a rank's first beat a startup grace of "
                         "max(120s, 20x this) applies (import + compile)")
+    p.add_argument("--status-interval", type=float, default=None,
+                   metavar="SECS",
+                   help="print a live fleet status table every SECS "
+                        "seconds (and on every failure): per-rank step, "
+                        "throughput and last-exchange bytes read from "
+                        "the heartbeat files' telemetry JSON payload "
+                        "(implies per-rank heartbeat files, like "
+                        "--hang-timeout).  Unset = no tables")
     p.add_argument("--fault", default=None, metavar="SPEC",
                    help="arm fault injection in every spawned process "
                         "(MX_FAULT_INJECT spec, e.g. "

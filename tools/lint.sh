@@ -3,9 +3,11 @@
 # sibling; the builder loop runs the same checks inside tier-1 via
 # tests/test_mxlint.py).
 #
-#   1. mxlint over mxnet_tpu/ + tools/launch.py — the per-file
-#      TPU-invariant rules (host syncs in the hot path, jit purity, wall
-#      clocks in fault paths, the MX_* env registry, donation-after-use)
+#   1. mxlint over mxnet_tpu/ (incl. telemetry.py — span helpers are
+#      hot-path roots) + tools/launch.py + tools/telemetry_dump.py —
+#      the per-file TPU-invariant rules (host syncs in the hot path, jit
+#      purity, wall clocks in fault paths, the MX_* env registry,
+#      donation-after-use)
 #      PLUS the whole-program concurrency rules (unguarded-shared-write,
 #      inconsistent-guard, lock-order-cycle, blocking-wait-unbounded,
 #      thread-leak) with the checked-in baseline; also asserts the

@@ -22,11 +22,14 @@ DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 
 def _default_paths():
-    """mxnet_tpu plus the supervisor — the launcher is part of the
-    threaded runtime the concurrency rules certify."""
+    """mxnet_tpu plus the supervisor and the trace-merge tool — the
+    launcher is part of the threaded runtime the concurrency rules
+    certify, and telemetry_dump.py processes operator-facing trace
+    files (ISSUE 8)."""
     out = ["mxnet_tpu"]
-    if os.path.isfile(os.path.join("tools", "launch.py")):
-        out.append(os.path.join("tools", "launch.py"))
+    for extra in ("launch.py", "telemetry_dump.py"):
+        if os.path.isfile(os.path.join("tools", extra)):
+            out.append(os.path.join("tools", extra))
     return out
 
 

@@ -46,6 +46,16 @@ HOT_PATH_ROOTS: List[Tuple[str, List[str]]] = [
     ("mxnet_tpu/optimizer/*.py",
      ["Updater.__call__", "*.fused_update", "*._fused_apply", "*.update",
       "*.update_multi_precision"]),
+    # telemetry span/record helpers (ISSUE 8) run inside every step
+    # phase — Trainer.step, the fit loops, CompiledStep dispatches all
+    # cross into this file per batch, so a host sync here stalls the
+    # pipeline exactly like one in the trainer would.  Spans are
+    # dispatch-time by contract; this root machine-checks it (the
+    # tests/test_telemetry.py reinjection test trips this entry).
+    ("mxnet_tpu/telemetry.py",
+     ["phase", "note_step", "heartbeat_payload", "rpc_span",
+      "Span.*", "_PhaseSpan.*", "FlightRecorder.record",
+      "Counter.*", "Gauge.*", "Histogram.*"]),
 ]
 
 _SYNC_ATTRS = {"asnumpy", "asscalar", "item", "wait_to_read", "tolist"}
@@ -64,7 +74,7 @@ class HostSyncInHotPath(Rule):
                    "step; each one stalls the XLA pipeline and breaks the "
                    "O(1)-dispatches-per-step budget")
     invariant_from = "ISSUE 3 (single-dispatch training step)"
-    path_patterns = tuple(pat for pat, _ in HOT_PATH_ROOTS)
+    path_patterns = tuple(sorted({pat for pat, _ in HOT_PATH_ROOTS}))
 
     def check(self, ctx: FileContext) -> Iterator[Diagnostic]:
         roots: List[str] = []

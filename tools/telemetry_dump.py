@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""telemetry_dump.py — merge per-worker telemetry traces into ONE
+chrome-trace file.
+
+Each process in a distributed job buffers its spans (client RPCs,
+server handling, step phases — see mxnet_tpu/telemetry.py) and flushes
+them to ``MX_TELEMETRY_TRACE/trace-<role>-r<rank>-p<pid>.trace.json``
+at exit.  This tool stitches those per-process files into a single
+timeline viewable in chrome://tracing / Perfetto: every source file
+becomes one named process row (``process_name`` metadata), span
+timestamps are already wall-epoch microseconds so rows align, and the
+``trace_id``/``span_id``/``parent_id`` args let the viewer (and the
+tests) follow one RPC from a worker's push through the server's handler
+and back — retries and replay-cache hits ride along as instant events.
+
+Usage:
+  python tools/telemetry_dump.py --out merged.json trace1.json trace2.json
+  python tools/telemetry_dump.py --out merged.json --dir $MX_TELEMETRY_TRACE
+
+Prints a JSON summary (files, events, distinct trace ids) to stdout.
+"""
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def load_trace(path):
+    """One per-process trace file -> (label, events list)."""
+    with open(path) as f:
+        payload = json.load(f)
+    if isinstance(payload, list):          # bare event list tolerated
+        payload = {"traceEvents": payload}
+    meta = payload.get("metadata") or {}
+    label = "%s r%s (pid %s)" % (meta.get("role", "proc"),
+                                 meta.get("rank", "?"),
+                                 meta.get("pid", "?"))
+    return label, list(payload.get("traceEvents") or [])
+
+
+def merge(paths):
+    """Merge trace files into one chrome-trace payload + summary."""
+    events = []
+    trace_ids = set()
+    per_file = {}
+    for i, path in enumerate(sorted(paths)):
+        label, evs = load_trace(path)
+        # one synthetic pid per source file: two processes on one host
+        # can share an OS pid across time, and the viewer needs stable
+        # distinct rows anyway
+        pid = i + 1
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "args": {"name": label}})
+        for ev in evs:
+            ev = dict(ev)
+            ev["pid"] = pid
+            events.append(ev)
+            tid = (ev.get("args") or {}).get("trace_id")
+            if tid:
+                trace_ids.add(tid)
+        per_file[os.path.basename(path)] = len(evs)
+    payload = {"traceEvents": events, "displayTimeUnit": "ms"}
+    summary = {"files": per_file, "events": len(events),
+               "distinct_trace_ids": len(trace_ids)}
+    return payload, summary
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("inputs", nargs="*", help="per-process trace files")
+    ap.add_argument("--dir", default=None,
+                    help="merge every *.trace.json under this directory "
+                         "(what MX_TELEMETRY_TRACE processes flush into)")
+    ap.add_argument("--out", required=True, help="merged chrome-trace path")
+    args = ap.parse_args(argv)
+    paths = list(args.inputs)
+    if args.dir:
+        paths.extend(glob.glob(os.path.join(args.dir, "*.trace.json")))
+    if not paths:
+        print("telemetry_dump: no input traces", file=sys.stderr)
+        return 1
+    payload, summary = merge(paths)
+    tmp = "%s.tmp.%d" % (args.out, os.getpid())
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, args.out)
+    summary["out"] = args.out
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
