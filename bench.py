@@ -614,6 +614,167 @@ def run_score_bench():
     }))
 
 
+def run_serve_bench(rate=None, duration=None, senders=12):
+    """--serve: open-loop load against a REAL local serving replica
+    (ISSUE 9 acceptance lane).
+
+    A synthetic Poisson arrival process (configurable rate/duration;
+    open-loop: the schedule never slows down for the server, so queueing
+    shows up as latency, not as a lower offered rate) drives PREDICT
+    RPCs over a real socket through the SEQ envelope into the
+    micro-batcher.  Reports p50/p99 end-to-end latency (measured from
+    the SCHEDULED arrival, so sender lateness counts — the
+    coordinated-omission-safe convention), achieved throughput, the
+    batch-occupancy histogram, the rejection rate, and the serve-time
+    retrace count after warmup (must be 0: every dispatch must hit the
+    AOT bucket table).
+    """
+    import socket as _socket
+    import threading
+    import numpy as np
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serve import (Overloaded, ServeClient, ServeServer,
+                                 Servable, serve_forever)
+    from mxnet_tpu.serve.demo import DEMO_IN, demo_block, demo_example
+
+    rate = float(rate or os.environ.get("MX_BENCH_SERVE_RATE", 250.0))
+    duration = float(duration or
+                     os.environ.get("MX_BENCH_SERVE_DURATION", 2.0))
+
+    s = _socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    state = ServeServer()
+    state.host.deploy(Servable(demo_block(), name="demo-mlp", version=1),
+                      example=demo_example())
+    stop_ev = threading.Event()
+    threading.Thread(target=serve_forever,
+                     kwargs=dict(port=port, state=state,
+                                 stop_event=stop_ev),
+                     daemon=True).start()
+    addr = "127.0.0.1:%d" % port
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        try:
+            _socket.create_connection(("127.0.0.1", port),
+                                      timeout=0.2).close()
+            break
+        except OSError:
+            time.sleep(0.05)
+
+    # a couple of warm round-trips (client connect, codec, first batch)
+    warm_cli = ServeClient([addr], timeout=30)
+    xw = np.zeros((1, DEMO_IN), np.float32)
+    for _ in range(3):
+        warm_cli.predict([xw])
+    warm_cli.close()
+    sv = state.host.active()
+    retraces_before = sv.retraces
+    reg = telemetry.registry
+    rej0 = reg.value("serve.rejected")
+    batches0 = reg.value("serve.batches")
+    occ_inst = reg.find("serve.batch_occupancy")
+    occ0 = occ_inst.snapshot() if occ_inst is not None else None
+
+    # open-loop schedule: Poisson arrivals, single-row requests
+    rng = np.random.RandomState(7)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate,
+                                         int(rate * duration) + 1))
+    arrivals = arrivals[arrivals < duration]
+    payloads = [rng.randn(1, DEMO_IN).astype(np.float32)
+                for _ in range(len(arrivals))]
+    latencies, rejected, errors = [], [0], [0]
+    lat_lock = threading.Lock()
+    next_i = [0]
+    idx_lock = threading.Lock()
+    t0 = time.perf_counter()
+
+    def sender():
+        cli = ServeClient([addr], timeout=30)
+        while True:
+            with idx_lock:
+                i = next_i[0]
+                if i >= len(arrivals):
+                    break
+                next_i[0] += 1
+            due = t0 + arrivals[i]
+            delay = due - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                cli.predict([payloads[i]])
+                lat = time.perf_counter() - due
+                with lat_lock:
+                    latencies.append(lat)
+            except Overloaded:
+                with lat_lock:
+                    rejected[0] += 1
+            except Exception:
+                with lat_lock:
+                    errors[0] += 1
+        cli.close()
+
+    threads = [threading.Thread(target=sender, daemon=True)
+               for _ in range(senders)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration + 60)
+    wall = time.perf_counter() - t0
+
+    # report the LOAD's occupancy only — the warm-up round-trips above
+    # also dispatched (single-row) batches; deltas keep them out, like
+    # rejected_counter / retraces_after_warmup below
+    occupancy = {}
+    inst = reg.find("serve.batch_occupancy")
+    if inst is not None:
+        snap = inst.snapshot()
+        count = snap["count"] - (occ0["count"] if occ0 else 0)
+        total = snap["sum"] - (occ0["sum"] if occ0 else 0.0)
+        occupancy = {
+            "count": count,
+            "avg_rows": round(total / count, 2) if count else 0.0,
+            "max_rows": snap["max"],
+            "buckets": {le: c - (occ0["buckets"].get(le, 0)
+                                 if occ0 else 0)
+                        for le, c in snap["buckets"].items()}}
+    lat_ms = sorted(l * 1e3 for l in latencies)
+
+    def pct(p):
+        if not lat_ms:
+            return 0.0
+        return round(lat_ms[min(len(lat_ms) - 1,
+                                int(p / 100.0 * len(lat_ms)))], 3)
+
+    n_ok = len(latencies)
+    report = {
+        "metric": "serve_demo_requests_per_sec",
+        "value": round(n_ok / wall, 2),
+        "unit": "requests/sec",
+        "device": "cpu" if os.environ.get("MX_FORCE_CPU") else "default",
+        "offered_rate": rate,
+        "duration_s": duration,
+        "requests": len(arrivals),
+        "completed": n_ok,
+        "rejected": rejected[0],
+        "errors": errors[0],
+        "rejection_rate": round(rejected[0] / max(1, len(arrivals)), 4),
+        "latency_ms": {"p50": pct(50), "p90": pct(90), "p99": pct(99),
+                       "max": round(lat_ms[-1], 3) if lat_ms else 0.0},
+        "batch_occupancy": occupancy,
+        "batches": reg.value("serve.batches") - batches0,
+        "retraces_after_warmup": sv.retraces - retraces_before,
+        "zero_serve_time_retraces": sv.retraces == retraces_before,
+        "rejected_counter": reg.value("serve.rejected") - rej0,
+        "phases": {k: v for k, v in telemetry.phase_snapshot().items()
+                   if k in ("queue_wait", "pad", "serve_dispatch",
+                            "scatter")},
+    }
+    stop_ev.set()
+    print(json.dumps(report))
+
+
 def run_real_data_bench():
     """--real-data: prove the input pipeline (.rec → JPEG decode → augment →
     NCHW batch) sustains the compute rate (SURVEY hard part 7: ~3k img/s
@@ -774,6 +935,13 @@ def main():
         return
     if "--exchange" in sys.argv:
         run_exchange_bench()
+        return
+    if "--serve" in sys.argv:
+        # CPU-friendly like --exchange: the serving engine's value on a
+        # bench box is the batching/latency behavior, not model FLOPs
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        os.environ.setdefault("MX_FORCE_CPU", "1")
+        run_serve_bench()
         return
     if os.environ.get("MX_BENCH_CHILD"):
         mode_env = os.environ.get("MX_BENCH_MODE")

@@ -97,6 +97,13 @@ ENV_CATALOG: Dict[str, Any] = {
     "MX_TELEMETRY_TRACE": ("", "Directory for per-process distributed trace files: when set, every span (step phases, kvstore client RPCs, server handling incl. retry/replay events, causally linked by wire-propagated trace/span IDs) is buffered and flushed to <dir>/trace-<role>-r<rank>-p<pid>.trace.json at process exit; tools/telemetry_dump.py merges the per-worker files into one chrome-trace timeline.  Empty disables span buffering (tests force it via telemetry.start_tracing())."),
     "MX_TELEMETRY_RING": ("256", "Flight-recorder capacity: the telemetry ring keeps the last N structured step records, dumped to MX_CRASH_DIR on watchdog/NaN/fit failure and summarized (step, throughput, last-exchange bytes) in the heartbeat file's JSON payload for the supervisor's fleet status table."),
     "MX_CRASH_DIR": ("", "Crash-dump directory: on a watchdog trip, an MX_NAN_POLICY=raise gradient guard, a fit-loop exception, or a supervisor-observed rank failure, the flight-recorder ring + a counters snapshot are written to <dir>/crash-rank<r>-pid<p>-<n>.json (the supervisor adds supervisor-<proc>-<n>.json with what it saw: exit code, restarts, last heartbeat payload).  Empty disables crash dumps."),
+    "MX_SERVE_BUCKETS": ("1,2,4,8,16", "Serving engine (mxnet_tpu/serve): comma-separated batch-size buckets the AOT compiler pre-traces per servable version.  Every batch the micro-batcher dispatches is padded up to the smallest bucket that fits, so serve-time never pays a trace; requests larger than the top bucket are rejected at admission."),
+    "MX_SERVE_MAX_BATCH": ("16", "Serving engine: the micro-batcher coalesces queued requests into one dispatch of at most this many rows (clamped to the top MX_SERVE_BUCKETS bucket).  Larger batches amortize dispatch overhead at higher per-request latency."),
+    "MX_SERVE_MAX_DELAY_US": ("2000", "Serving engine: microseconds the micro-batcher holds an under-full batch open for more arrivals before dispatching what it has.  0 dispatches immediately (no coalescing).  The wait rides the mxnet_tpu.fault injectable clock, so virtual-time tests drive the coalescing window deterministically."),
+    "MX_SERVE_QUEUE_CAP": ("256", "Serving engine: admission-queue bound in ROWS (requests' batch rows, not request count).  A submit that would exceed it is rejected immediately with an explicit overload error (counted in serve.rejected) instead of queueing into unbounded latency - load shedding is the backpressure contract."),
+    "MX_SERVE_PORT": ("9700", "Port a serving replica binds (python -m mxnet_tpu.serve); with --port-base under the launcher each rank serves on port-base + MX_PROCESS_ID."),
+    "MX_SERVE_ROOTS": ("", "Comma-separated serving replica addresses host:port the ServeClient connects to; the client sticks to one replica and fails over to the next on a connection error or timeout (SEQ retry makes the replay safe)."),
+    "MX_SERVE_TIMEOUT": ("30", "Seconds a serving client waits for one PREDICT reply (queue wait + dispatch included) before treating the replica as dead and failing over; also the server-side bound on a request waiting out its batch future."),
 }
 
 

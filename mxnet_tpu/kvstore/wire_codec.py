@@ -1,4 +1,4 @@
-"""Host-side gradient wire codec (numpy only — no jax, no ops registry).
+"""Host-side wire codecs (numpy only — no jax, no ops registry).
 
 The dist_async TCP path ships compressed gradients as compact picklable
 ``QGRAD`` tuples; the parameter server decodes them BEFORE its
@@ -16,15 +16,50 @@ Formats (see docs/ARCHITECTURE.md "Gradient wire format"):
 The packed 2-bit layout (16 codes per uint32 word, code i at bits
 [2i, 2i+1], 00=zero 01=-t 10=+t) is bit-compatible with the device pack
 (`ops.quantization.pack_2bit_words`); the parity test pins it.
+
+The serving engine (ISSUE 9) rides the same numpy-only contract: its
+PREDICT request/response tensors cross the socket as compact ``NPX``
+tuples (:func:`encode_array`/:func:`decode_array`), so neither the
+serving client nor a health-probing tool ever needs the device stack to
+talk the wire, and a device array can never leak into a pickle.
 """
 from __future__ import annotations
 
 import numpy as _np
 
 __all__ = ["is_wire_payload", "encode_wire", "decode_wire",
-           "pack_2bit", "unpack_2bit"]
+           "pack_2bit", "unpack_2bit",
+           "is_array_payload", "encode_array", "decode_array"]
 
 _WIRE_TAG = "QGRAD"
+_ARR_TAG = "NPX"
+
+
+def is_array_payload(obj) -> bool:
+    return isinstance(obj, tuple) and len(obj) == 4 and obj[0] == _ARR_TAG
+
+
+def encode_array(arr) -> tuple:
+    """One tensor as a compact picklable tuple:
+    ``(NPX, shape, dtype_str, row_major_bytes)``.
+
+    Accepts anything numpy can view (ndarray, NDArray via __array__,
+    jax array via __array__) but always emits plain host bytes — the
+    wire stays device-free by construction.
+    """
+    a = _np.asarray(arr)
+    shape = tuple(int(s) for s in a.shape)   # BEFORE ascontiguousarray
+    a = _np.ascontiguousarray(a)             # (it promotes 0-d to 1-d)
+    return (_ARR_TAG, shape, str(a.dtype), a.tobytes())
+
+
+def decode_array(obj) -> _np.ndarray:
+    """Inverse of :func:`encode_array`; returns a writable ndarray."""
+    if not is_array_payload(obj):
+        raise ValueError("not an NPX array payload: %r" % (type(obj),))
+    _, shape, dtype, raw = obj
+    return _np.frombuffer(raw, dtype=_np.dtype(dtype)).reshape(
+        shape).copy()
 
 
 def is_wire_payload(obj) -> bool:

@@ -1,0 +1,58 @@
+"""Inference serving engine (ISSUE 9 tentpole).
+
+The ROADMAP's north star is "heavy traffic from millions of users", and
+until this package every code path in the repo terminated in ``fit()``.
+``mxnet_tpu.serve`` is the subsystem that turns the training stack into
+the product: it hosts trained models behind an RPC front and keeps the
+accelerator busy with batched, AOT-compiled forward programs.
+
+Architecture (TensorFlow-Serving's shape — arxiv 1605.08695 — rebuilt
+over this repo's own substrates):
+
+* **Servable** (:mod:`.servable`) — one immutable model *version*:
+  parameters + an AOT **bucketed program table**.  Loading reuses the
+  existing import lanes (a live Gluon block, a ``save_parameters`` file,
+  or a foreign ``symbol.json`` + ``.params`` pair via
+  ``SymbolBlock.imports``); compilation reuses the ``CompiledStep``
+  trace machinery forward-only (param-override trace of the block under
+  ``autograd.predict_mode``), pre-traced at every configured batch-size
+  bucket (``MX_SERVE_BUCKETS``) so **no request ever pays a trace at
+  serve time**.
+
+* **ModelHost** (:mod:`.servable`) — versioned hot-swap: load v(N+1),
+  warm every bucket, atomically flip the active pointer, drain v(N)'s
+  in-flight dispatches.  A request only ever sees a fully-warmed
+  version.
+
+* **Batcher** (:mod:`.batcher`) — the dynamic micro-batcher: bounded
+  admission queue → coalesce up to ``MX_SERVE_MAX_BATCH`` rows or
+  ``MX_SERVE_MAX_DELAY_US`` → pad to bucket → ONE dispatch → scatter
+  responses to the waiting handler threads.  Overload is an explicit
+  rejection at admission (``MX_SERVE_QUEUE_CAP``), never unbounded
+  latency.  The dispatch loop is an mxlint hot-path root: no host sync
+  may land between dequeue and dispatch.
+
+* **RPC front** (:mod:`.server` / :mod:`.client`) — PREDICT / HEALTH /
+  SWAP / STOP verbs over the kvstore SEQ-retry wire envelope
+  (length-prefixed pickles, numpy-only tensors via
+  ``kvstore.wire_codec.encode_array``), with the exactly-once replay
+  cache and wire-propagated trace context, so one request is one causal
+  trace client → batcher → dispatch.  The client fails over across
+  ``MX_SERVE_ROOTS`` replicas.
+
+* **Multi-replica serving** — ``python -m mxnet_tpu.serve`` runs one
+  replica; under ``tools/launch.py --restart on-failure`` each rank
+  serves on ``--port-base + rank``, beats its ``MX_HEARTBEAT_FILE``
+  from the batcher loop (health-gated restarts), and the chaos smoke
+  (tools/chaos_smoke.sh) kills one of two replicas mid-load proving
+  traffic drains to the survivor with zero lost requests.
+"""
+from __future__ import annotations
+
+from .servable import BucketTable, ModelHost, Servable
+from .batcher import Batcher, Overloaded
+from .server import ServeServer, serve_forever
+from .client import ServeClient
+
+__all__ = ["BucketTable", "Servable", "ModelHost", "Batcher",
+           "Overloaded", "ServeServer", "serve_forever", "ServeClient"]

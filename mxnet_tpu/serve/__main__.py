@@ -1,0 +1,129 @@
+"""``python -m mxnet_tpu.serve`` — run one serving replica.
+
+The process face of the serving engine: load a servable (an exported /
+foreign ``<prefix>-symbol.json`` + ``.params`` checkpoint, or the
+built-in deterministic demo model), AOT-warm every batch bucket, then
+serve PREDICT/HEALTH/SWAP on a TCP port until a STOP arrives.
+
+Multi-replica serving rides ``tools/launch.py``: with ``--port-base P``
+each supervised rank binds ``P + MX_PROCESS_ID``, and when the launcher
+provisions ``MX_HEARTBEAT_FILE`` the batcher loop beats it (throttled)
+so ``--hang-timeout`` health-gates restarts — a wedged replica is
+killed and respawned with its original env, a crashed one (e.g. the
+``serve.request`` chaos fault) restarts and warms back up while clients
+fail over to the survivors.
+
+Examples::
+
+  python -m mxnet_tpu.serve --demo --port 9700
+  python tools/launch.py -n 2 --restart on-failure -- \\
+      python -m mxnet_tpu.serve --demo --port-base 9700
+  python -m mxnet_tpu.serve --model /ckpt/resnet --epoch 3 \\
+      --inputs data --example-shape 3,224,224
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+import time
+
+import numpy as _np
+
+
+def _build_servable(args):
+    from .servable import BucketTable, Servable
+    buckets = BucketTable([int(b) for b in args.buckets.split(",")]) \
+        if args.buckets else None
+    if args.demo:
+        from .demo import demo_block, demo_example
+        sv = Servable(demo_block(), name="demo-mlp", version=1,
+                      buckets=buckets)
+        return sv, demo_example()
+    if not args.model:
+        raise SystemExit("serve: need --model PREFIX or --demo")
+    sv = Servable.from_checkpoint(args.model, epoch=args.epoch,
+                                  input_names=args.inputs.split(","),
+                                  version=1, buckets=buckets)
+    if not args.example_shape:
+        raise SystemExit("serve: --model needs --example-shape (comma "
+                         "dims per input, ';' between inputs)")
+    example = []
+    for part in args.example_shape.split(";"):
+        trail = tuple(int(d) for d in part.split(",") if d.strip())
+        example.append(_np.zeros((1,) + trail, _np.dtype(args.dtype)))
+    return sv, example
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m mxnet_tpu.serve", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--model", default=None, metavar="PREFIX",
+                    help="checkpoint prefix (PREFIX-symbol.json + "
+                         "PREFIX-%%04d.params, the export/foreign lane)")
+    ap.add_argument("--epoch", type=int, default=0)
+    ap.add_argument("--inputs", default="data",
+                    help="comma-separated model input names")
+    ap.add_argument("--example-shape", default=None, metavar="DIMS",
+                    help="per-row input dims, e.g. '3,224,224' "
+                         "(';'-separated for multi-input models)")
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--demo", action="store_true",
+                    help="serve the built-in deterministic demo MLP "
+                         "(smokes/benches; tools/serve_load.py verifies "
+                         "its outputs)")
+    ap.add_argument("--port", type=int, default=None)
+    ap.add_argument("--port-base", type=int, default=None,
+                    help="bind port-base + MX_PROCESS_ID (multi-replica "
+                         "serving under tools/launch.py)")
+    ap.add_argument("--buckets", default=None,
+                    help="override MX_SERVE_BUCKETS for this replica")
+    ap.add_argument("--ready-file", default=None,
+                    help="write the bound port here once accepting")
+    args = ap.parse_args(argv)
+
+    from ..base import get_env
+    from ..health import Heartbeat
+    from .server import ServeServer, serve_forever
+
+    port = args.port
+    if port is None and args.port_base is not None:
+        rank = int(get_env("MX_PROCESS_ID") or
+                   os.environ.get("DMLC_WORKER_ID") or 0)
+        port = args.port_base + rank
+    if port is None:
+        port = int(get_env("MX_SERVE_PORT"))
+
+    # heartbeat-file liveness (launch.py --hang-timeout): beat from the
+    # batcher loop, throttled — an IDLE replica is healthy, so the beat
+    # must not depend on traffic
+    tick = None
+    hb_path = get_env("MX_HEARTBEAT_FILE", "")
+    if hb_path:
+        hb = Heartbeat(hb_path)
+        last = [0.0]
+
+        def tick():
+            now = time.monotonic()
+            if now - last[0] >= 1.0:
+                last[0] = now
+                hb.beat(0, 0)
+
+        hb.beat(0, 0)
+
+    sv, example = _build_servable(args)
+    state = ServeServer(on_tick=tick)
+    state.host.deploy(sv, example=example)
+    print("serve: %s v%d warm on %d bucket(s) %r, port %d"
+          % (sv.name, sv.version, len(sv.buckets.sizes),
+             list(sv.buckets.sizes), port), file=sys.stderr, flush=True)
+
+    serve_forever(port=port, state=state, ready_file=args.ready_file)
+    print("serve: stopped", file=sys.stderr, flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

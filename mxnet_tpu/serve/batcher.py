@@ -1,0 +1,367 @@
+"""Dynamic micro-batcher: admission queue → pad-to-bucket → one dispatch
+→ scatter.
+
+The serving latency/throughput trade lives here (TF-Serving's batching
+layer, arxiv 1605.08695 §3.3): requests admit into a BOUNDED queue, the
+batcher thread coalesces up to ``MX_SERVE_MAX_BATCH`` rows — holding an
+under-full batch open at most ``MX_SERVE_MAX_DELAY_US`` for more
+arrivals — pads the coalesced rows up to the smallest AOT bucket, and
+issues ONE device dispatch.  Responses scatter back to the waiting RPC
+handler threads through per-request futures; the device→host read
+happens on the *handler* thread (the batcher never syncs — it is
+already collecting the next batch while XLA runs this one).
+
+Backpressure is explicit: a submit that would push the queue past
+``MX_SERVE_QUEUE_CAP`` rows raises :class:`Overloaded` immediately
+(counted in ``serve.rejected``) instead of absorbing load into
+unbounded latency.
+
+Concurrency/lint contract: ``Batcher._loop`` / ``_collect`` /
+``_dispatch`` are mxlint hot-path roots — no host sync may land between
+dequeue and dispatch (tools/mxlint rules.py HOT_PATH_ROOTS; the
+reinjection test in tests/test_mxlint.py proves a blocking host read
+there trips the rule).  The coalescing window rides the
+``mxnet_tpu.fault`` injectable clock, so virtual-time tests drive it
+deterministically — under ``use_virtual_time()`` the batcher charges
+its wait ticks to the virtual clock the way the kvstore barrier park
+does.
+
+Telemetry: per-request ``queue_wait``, per-batch ``pad`` and
+``serve_dispatch`` phases land in ``step_phase_seconds``; the
+``serve_dispatch`` span carries one instant event per member request
+with the request's wire-propagated (trace_id, span_id), so the merged
+chrome trace shows client → batcher → dispatch as one causal chain.
+``scatter`` is stamped by the future's resolver on the handler thread.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as _np
+
+from ..base import MXNetError, get_env
+from .. import fault as _fault
+from .. import telemetry as _telemetry
+
+__all__ = ["Overloaded", "Batcher"]
+
+
+class Overloaded(MXNetError):
+    """Admission rejected: the bounded queue is full (load shedding)."""
+
+
+class _Batch:
+    """One dispatched micro-batch's device outputs, converted to host
+    numpy AT MOST ONCE (first resolver pays the sync; the rest slice)."""
+
+    __slots__ = ("_outs", "_np", "_lk", "version")
+
+    def __init__(self, outs, version: int):
+        self._outs = outs
+        self._np: Optional[List[_np.ndarray]] = None
+        self._lk = threading.Lock()
+        self.version = version
+
+    def host(self) -> List[_np.ndarray]:
+        with self._lk:
+            if self._np is None:
+                self._np = [_np.asarray(o) for o in self._outs]
+                self._outs = None
+            return self._np
+
+
+class _Pending:
+    """One admitted request: inputs + a future the handler thread waits
+    on.  Fulfilled by the batcher thread with (batch, row span)."""
+
+    __slots__ = ("inputs", "rows", "sig", "trace_ctx", "enq_t",
+                 "_event", "_lk", "_batch", "_span", "_err")
+
+    def __init__(self, inputs: List[_np.ndarray], rows: int, sig: Tuple,
+                 trace_ctx: Optional[Tuple[str, str]] = None):
+        self.inputs = inputs
+        self.rows = rows
+        self.sig = sig
+        self.trace_ctx = trace_ctx
+        self.enq_t = time.perf_counter()
+        self._event = threading.Event()
+        self._lk = threading.Lock()
+        self._batch: Optional[Tuple[_Batch, int, int]] = None
+        self._err: Optional[BaseException] = None
+
+    def _fulfill(self, batch: _Batch, start: int, stop: int) -> None:
+        with self._lk:
+            self._batch = (batch, start, stop)
+        self._event.set()
+
+    def _fail(self, err: BaseException) -> None:
+        with self._lk:
+            self._err = err
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None
+               ) -> Tuple[int, List[_np.ndarray]]:
+        """Block (bounded) for the dispatch, then scatter this request's
+        rows out of the batch outputs: returns (version, [out_leaf...]).
+        The device→host sync happens HERE, on the caller's thread."""
+        if timeout is None:
+            timeout = get_env("MX_SERVE_TIMEOUT", 30.0, float) or 30.0
+        if not self._event.wait(timeout=timeout):
+            raise MXNetError("serve: request timed out after %.3gs in "
+                             "the batcher" % timeout)
+        with self._lk:
+            err, ent = self._err, self._batch
+        if err is not None:
+            raise err
+        batch, start, stop = ent
+        with _telemetry.phase("scatter"):
+            outs = [leaf[start:stop] for leaf in batch.host()]
+        return batch.version, outs
+
+
+class Batcher:
+    """The dispatch loop: one daemon thread per serving process."""
+
+    def __init__(self, host, max_batch: Optional[int] = None,
+                 max_delay_us: Optional[float] = None,
+                 queue_cap: Optional[int] = None,
+                 on_tick=None, autostart: bool = True):
+        self._host = host
+        self._max_batch = int(max_batch if max_batch is not None else
+                              get_env("MX_SERVE_MAX_BATCH", 16, int))
+        delay_us = max_delay_us if max_delay_us is not None else \
+            get_env("MX_SERVE_MAX_DELAY_US", 2000.0, float)
+        self._max_delay = max(0.0, float(delay_us) / 1e6)
+        self._cap = int(queue_cap if queue_cap is not None else
+                        get_env("MX_SERVE_QUEUE_CAP", 256, int))
+        self._on_tick = on_tick
+        self._q: deque = deque()
+        self._qrows = 0
+        self._cv = threading.Condition()
+        self._stop = threading.Event()
+        reg = _telemetry.registry
+        self._c_requests = reg.counter(
+            "serve.requests", doc="admitted predict requests")
+        self._c_rejected = reg.counter(
+            "serve.rejected", doc="requests shed at admission "
+            "(queue cap) or refused (too large / bad signature)")
+        self._c_rows = reg.counter(
+            "serve.rows", doc="admitted request rows (examples)")
+        self._c_pad_rows = reg.counter(
+            "serve.padding_rows", doc="pad rows dispatched (bucket "
+            "minus occupancy — the padding waste)")
+        self._g_depth = reg.gauge(
+            "serve.queue_rows", doc="rows currently queued")
+        self._h_occupancy = reg.histogram(
+            "serve.batch_occupancy", doc="real rows per dispatched "
+            "micro-batch",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="mx-serve-batcher")
+        if autostart:
+            self._thread.start()
+
+    # -- admission ----------------------------------------------------------
+    def queue_rows(self) -> int:
+        with self._cv:
+            return self._qrows
+
+    def submit(self, arrays: Sequence,
+               trace_ctx: Optional[Tuple[str, str]] = None) -> _Pending:
+        """Admit one request (per-input row-batched arrays).  Raises
+        :class:`Overloaded` when the bounded queue is full, MXNetError
+        when the request cannot ever be served (too many rows for the
+        bucket table, signature mismatch with the warmed servable)."""
+        from .servable import Servable
+        inputs = [_np.ascontiguousarray(a) for a in arrays]
+        if not inputs or any(i.ndim < 1 for i in inputs):
+            self._c_rejected.inc()
+            raise MXNetError("serve: a request needs >=1 row-batched "
+                             "input array")
+        rows = int(inputs[0].shape[0])
+        if any(int(i.shape[0]) != rows for i in inputs):
+            self._c_rejected.inc()
+            raise MXNetError("serve: input leading (batch) dims disagree")
+        sv = self._host.active()
+        if sv.buckets.bucket_for(rows) is None:
+            self._c_rejected.inc()
+            raise MXNetError(
+                "serve: request of %d rows exceeds the top bucket %d "
+                "(MX_SERVE_BUCKETS)" % (rows, sv.buckets.max_size))
+        sig = Servable.signature_of(inputs)
+        want = sv.warmed_signature
+        if want is not None and sig != want:
+            self._c_rejected.inc()
+            raise MXNetError(
+                "serve: input signature %r does not match the deployed "
+                "model's %r" % (sig, want))
+        p = _Pending(inputs, rows, sig, trace_ctx=trace_ctx)
+        with self._cv:
+            if self._qrows + rows > self._cap:
+                self._c_rejected.inc()
+                raise Overloaded(
+                    "serve: admission queue full (%d/%d rows; "
+                    "MX_SERVE_QUEUE_CAP) - retry later or add replicas"
+                    % (self._qrows, self._cap))
+            self._q.append(p)
+            self._qrows += rows
+            self._g_depth.set(self._qrows)
+            self._cv.notify_all()
+        self._c_requests.inc()
+        self._c_rows.inc(rows)
+        return p
+
+    # -- the dispatch loop (mxlint hot-path root) ---------------------------
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            batch = self._collect()
+            if batch:
+                self._dispatch(batch)
+            if self._on_tick is not None:
+                self._on_tick()
+        # drain on stop: refuse whatever is still queued so no handler
+        # thread is left waiting on a future nobody will fulfill
+        with self._cv:
+            leftover = list(self._q)
+            self._q.clear()
+            self._qrows = 0
+            self._g_depth.set(0)
+        for p in leftover:
+            p._fail(MXNetError("serve: batcher stopped"))
+
+    def _effective_max(self) -> int:
+        try:
+            top = self._host.active().buckets.max_size
+        except MXNetError:
+            return self._max_batch
+        return max(1, min(self._max_batch, top))
+
+    def _collect(self) -> List[_Pending]:
+        """Pop the next coalesced batch: same-signature requests from the
+        queue head, up to the effective max rows, holding the window
+        open ``max_delay`` for stragglers.  Returns [] on an idle tick
+        (so the loop can heartbeat)."""
+        eff = self._effective_max()
+        with self._cv:
+            if not self._q:
+                self._cv.wait(timeout=0.05)
+                if not self._q:
+                    return []
+            head = self._q[0]
+            if self._max_delay > 0 and head.rows < eff:
+                # hold the batch open for more arrivals — on the
+                # injectable clock, so a virtual-time test drives the
+                # window without real sleeping (the batcher is the
+                # elected pumper for its own deadline, like the kvstore
+                # barrier park)
+                deadline = _fault.Deadline(self._max_delay)
+                while not self._stop.is_set():
+                    if self._qrows >= eff:
+                        break
+                    remaining = deadline.remaining()
+                    if remaining <= 0:
+                        break
+                    tick = min(0.002, remaining)
+                    if _fault.is_virtual():
+                        self._cv.wait(timeout=0.001)
+                        _fault.sleep(tick)
+                    else:
+                        self._cv.wait(timeout=tick)
+            take: List[_Pending] = []
+            taken = 0
+            while self._q:
+                p = self._q[0]
+                if p.sig != head.sig and take:
+                    break              # next batch gets the new shape
+                if take and taken + p.rows > eff:
+                    break
+                self._q.popleft()
+                take.append(p)
+                taken += p.rows
+                if taken >= eff:
+                    break
+            self._qrows -= taken
+            self._g_depth.set(self._qrows)
+            return take
+
+    def _dispatch(self, take: List[_Pending]) -> None:
+        """Pad the coalesced rows to the smallest bucket and launch ONE
+        program; fulfill the members' futures with (batch, row span).
+        No device→host read happens here — scatter syncs on the handler
+        threads while this loop collects the next batch."""
+        rows = sum(p.rows for p in take)
+        sv = None
+        while sv is None:
+            sv = self._host.active()
+            if not sv.begin():         # raced a hot-swap drain: re-read
+                sv = None
+        try:
+            # admission validated against the servable that was active
+            # THEN; a hot-swap may have changed the signature or bucket
+            # table since.  Re-check here so a straggler can never
+            # force a serve-time retrace (or a shape crash) through the
+            # new version — it gets an explicit retryable error instead
+            want = sv.warmed_signature
+            if want is not None and take[0].sig != want:
+                raise MXNetError(
+                    "serve: model hot-swapped to an incompatible input "
+                    "signature (%r -> %r) while this request was "
+                    "queued; resubmit" % (take[0].sig, want))
+            bucket = sv.buckets.bucket_for(rows)
+            if bucket is None:
+                raise MXNetError("serve: %d rows exceed the deployed "
+                                 "bucket table" % rows)
+            now_t = time.perf_counter()
+            for p in take:
+                _telemetry.observe_phase("queue_wait", now_t - p.enq_t)
+            with _telemetry.phase("pad"):
+                pad_rows = bucket - rows
+                padded = []
+                for i, (trail, dt) in enumerate(take[0].sig):
+                    parts = [p.inputs[i] for p in take]
+                    if pad_rows:
+                        parts.append(_np.zeros((pad_rows,) + trail,
+                                               dtype=dt))
+                    padded.append(parts[0] if len(parts) == 1
+                                  else _np.concatenate(parts, axis=0))
+            with _telemetry.phase("serve_dispatch") as span:
+                # link each member request's wire-propagated span into
+                # the batch: req_trace/req_span (Span.event reserves the
+                # bare trace_id/span_id names for the batch span's own)
+                for p in take:
+                    if p.trace_ctx is not None:
+                        span.event("request", req_trace=p.trace_ctx[0],
+                                   req_span=p.trace_ctx[1],
+                                   rows=p.rows)
+                outs = sv.dispatch(bucket, padded)
+            self._h_occupancy.observe(rows)
+            self._c_pad_rows.inc(pad_rows)
+            batch = _Batch(outs, sv.version)
+            offset = 0
+            for p in take:
+                p._fulfill(batch, offset, offset + p.rows)
+                offset += p.rows
+        except BaseException as e:
+            for p in take:
+                p._fail(e)
+        finally:
+            sv.release()
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "Batcher":
+        if not self._thread.is_alive():
+            self._thread.start()
+        return self
+
+    def close(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
+        if self._thread.is_alive():
+            self._thread.join(timeout=timeout)

@@ -1,0 +1,210 @@
+"""Serving client: SEQ-tagged RPCs with cross-replica failover.
+
+The dist_async client's resilience posture (reconnect + idempotent
+replay under a ``RetryPolicy``) extended with *replica failover*: the
+client sticks to one replica of ``MX_SERVE_ROOTS`` and, when a
+connection drops or times out, rotates to the next and replays the same
+request there (fresh replica, fresh replay cache — a PREDICT recomputes
+harmlessly; the seq still protects the same-replica lost-reply case).
+This is what makes "kill a replica mid-load" lose ZERO in-flight
+requests: every request either gets its reply from the replica that
+took it, or is replayed on a survivor.
+
+Overload (``(False, "overloaded: ...")``) is NOT a failover trigger by
+default — the replica is healthy and shedding load; the caller gets
+:class:`~mxnet_tpu.serve.batcher.Overloaded` to back off or report.
+Pass ``spill=True`` to try the other replicas first (queue-spill
+routing) and raise only when every replica sheds.
+"""
+from __future__ import annotations
+
+import socket
+import threading
+import uuid
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as _np
+
+from ..base import MXNetError, get_env
+from .. import fault as _fault
+from .. import telemetry as _telemetry
+from ..kvstore.server import send_msg, recv_msg
+from ..kvstore.wire_codec import decode_array, encode_array
+from .batcher import Overloaded
+
+__all__ = ["ServeClient"]
+
+
+def _roots(addrs) -> List[str]:
+    if addrs is None:
+        raw = get_env("MX_SERVE_ROOTS") or ""
+        addrs = [a.strip() for a in str(raw).split(",") if a.strip()]
+    if isinstance(addrs, str):
+        addrs = [addrs]
+    if not addrs:
+        raise MXNetError("ServeClient needs replica addresses "
+                         "(MX_SERVE_ROOTS or addrs=[...])")
+    return list(addrs)
+
+
+class ServeClient:
+    """Client to one serving fleet; thread-safe (one RPC at a time)."""
+
+    def __init__(self, addrs=None, timeout: Optional[float] = None):
+        self._addrs = _roots(addrs)
+        self._socks: List[Optional[socket.socket]] = \
+            [None] * len(self._addrs)
+        self._idx = 0                       # sticky current replica
+        self._client_id = "serve:%s" % uuid.uuid4().hex[:12]
+        self._timeout = float(timeout if timeout is not None else
+                              get_env("MX_SERVE_TIMEOUT", 30.0, float)
+                              or 30.0)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._c_failover = _telemetry.registry.counter(
+            "serve.client_failovers",
+            doc="requests replayed on another replica after a "
+                "connection failure/timeout")
+
+    @property
+    def replicas(self) -> List[str]:
+        return list(self._addrs)
+
+    # -- plumbing -----------------------------------------------------------
+    def _next_seq(self) -> int:
+        self._seq += 1                      # caller holds self._lock
+        return self._seq
+
+    def _kill_sock(self, idx: int) -> None:
+        s = self._socks[idx]
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._socks[idx] = None
+
+    def _ensure_sock(self, idx: int) -> socket.socket:
+        s = self._socks[idx]
+        if s is not None:
+            return s
+        host, port = self._addrs[idx].rsplit(":", 1)
+        s = socket.create_connection((host, int(port)), timeout=5)
+        s.settimeout(self._timeout)
+        self._socks[idx] = s
+        return s
+
+    def _rpc(self, *msg, idx: Optional[int] = None,
+             failover: bool = True):
+        """One SEQ-enveloped RPC.  ``idx=None`` uses the sticky replica
+        and rotates on connection failures; an explicit ``idx`` pins one
+        replica (health probes) and never fails over."""
+        pinned = idx is not None
+        policy = _fault.RetryPolicy.from_env()
+        if msg[0] == "STOP":
+            # shutdown is best-effort: a replica that is already gone
+            # must not cost the caller a retry deadline per replica
+            policy.deadline = min(policy.deadline, 1.0)
+        with self._lock:
+            # ONE seq for every attempt: a same-replica retry must
+            # replay the same (client_id, seq) so the server's
+            # exactly-once cache answers it instead of re-executing
+            seq = self._next_seq()
+        with _telemetry.rpc_span("serve.client.%s" % msg[0]) as span:
+            tctx = span.wire_context()
+            for _attempt in policy:
+                with self._lock:
+                    at = idx if pinned else self._idx
+                    env = ("SEQ", self._client_id, seq, msg)
+                    try:
+                        sock = self._ensure_sock(at)
+                        _fault.fire(
+                            "serve.client.send",
+                            on_close=lambda at=at: self._kill_sock(at))
+                        send_msg(sock, env if tctx is None
+                                 else env + (tctx,))
+                        _fault.fire(
+                            "serve.client.recv",
+                            on_close=lambda at=at: self._kill_sock(at))
+                        ok, payload = recv_msg(sock,
+                                               timeout=self._timeout)
+                    except (ConnectionError, OSError, TimeoutError) as e:
+                        self._kill_sock(at)
+                        policy.note(e)
+                        if pinned or not failover:
+                            span.event("retry", replica=at, seq=seq,
+                                       error=str(e))
+                            continue
+                        self._idx = (at + 1) % len(self._addrs)
+                        self._c_failover.inc()
+                        span.event("failover", dead=at,
+                                   to=self._idx, seq=seq, error=str(e))
+                        continue
+                return ok, payload
+        raise MXNetError(
+            "serve: %r unreachable on every replica %r for %.3gs "
+            "(MX_KVSTORE_RETRY_DEADLINE); last error: %s"
+            % (msg[0], self._addrs, policy.deadline, policy.last_error))
+
+    # -- verbs --------------------------------------------------------------
+    def predict(self, arrays: Sequence, spill: bool = False
+                ) -> Tuple[int, List[_np.ndarray]]:
+        """One inference request: per-input row-batched arrays in,
+        ``(servable_version, [output leaf, ...])`` out.  Raises
+        :class:`Overloaded` when the fleet sheds it, MXNetError on a
+        terminal failure."""
+        payload = [encode_array(a) for a in arrays]
+        tried = 0
+        while True:
+            ok, resp = self._rpc("PREDICT", payload)
+            if ok:
+                version, outs = resp
+                return int(version), [decode_array(t) for t in outs]
+            if isinstance(resp, str) and resp.startswith("overloaded"):
+                tried += 1
+                if spill and tried < len(self._addrs):
+                    with self._lock:      # shed here; try the next one
+                        self._idx = (self._idx + 1) % len(self._addrs)
+                    continue
+                raise Overloaded(resp)
+            raise MXNetError("serve: %s" % resp)
+
+    def health(self, idx: Optional[int] = None) -> dict:
+        """One replica's health dict (``idx`` pins; default = sticky)."""
+        ok, resp = self._rpc("HEALTH", idx=idx)
+        if not ok:
+            raise MXNetError("serve: %s" % resp)
+        return resp
+
+    def swap(self, prefix: str, epoch: int = 0,
+             input_names: Sequence[str] = ("data",)) -> List[int]:
+        """Hot-swap EVERY replica to the checkpoint at ``prefix``;
+        returns the per-replica new version numbers."""
+        versions = []
+        for i in range(len(self._addrs)):
+            ok, resp = self._rpc("SWAP", prefix, int(epoch),
+                                 tuple(input_names), idx=i)
+            if not ok:
+                raise MXNetError("serve: replica %d %s" % (i, resp))
+            versions.append(int(resp))
+        return versions
+
+    def stop(self) -> None:
+        """Graceful STOP to every replica (best-effort)."""
+        for i in range(len(self._addrs)):
+            try:
+                self._rpc("STOP", idx=i)
+            except MXNetError:
+                pass
+
+    def close(self) -> None:
+        with self._lock:
+            for i in range(len(self._socks)):
+                self._kill_sock(i)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -1,0 +1,300 @@
+"""Servables: versioned models behind AOT-compiled bucketed programs.
+
+Reference: TF-Serving's loader/servable/version-manager split (arxiv
+1605.08695 §3) — a *servable* is one immutable version of one model; the
+*host* owns the version lifecycle (load → warm → flip → drain).  The
+compilation lane reuses the repo's whole-step trace machinery
+(``CompiledStep._make_forward``'s param-override trace) forward-only:
+the block runs once under ``autograd.predict_mode`` per (bucket, input
+signature) to build a jitted program, and every configured batch bucket
+is pre-traced at deploy time (:meth:`Servable.warm`) so serve time is
+pure cached-executable dispatch — the ``serve.retraces`` counter pins
+"zero retraces after warmup" in bench and the dispatch-budget harness.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as _np
+import jax
+
+from ..base import MXNetError, get_env
+from .. import fault as _fault
+from .. import telemetry as _telemetry
+
+__all__ = ["BucketTable", "Servable", "ModelHost"]
+
+
+class BucketTable:
+    """The configured batch-size buckets, sorted ascending.
+
+    ``bucket_for(n)`` returns the smallest bucket >= n (pad-to-bucket
+    target), or None when n exceeds the top bucket — the admission path
+    rejects those instead of compiling an unplanned shape at serve time.
+    """
+
+    def __init__(self, sizes: Sequence[int]):
+        uniq = sorted({int(s) for s in sizes})
+        if not uniq or uniq[0] < 1:
+            raise MXNetError("BucketTable needs positive bucket sizes, "
+                             "got %r" % (sizes,))
+        self.sizes: Tuple[int, ...] = tuple(uniq)
+
+    @classmethod
+    def from_env(cls) -> "BucketTable":
+        raw = get_env("MX_SERVE_BUCKETS") or "1,2,4,8,16"
+        return cls([int(p) for p in str(raw).split(",") if p.strip()])
+
+    @property
+    def max_size(self) -> int:
+        return self.sizes[-1]
+
+    def bucket_for(self, n: int) -> Optional[int]:
+        for s in self.sizes:
+            if s >= n:
+                return s
+        return None
+
+    def __iter__(self):
+        return iter(self.sizes)
+
+    def __repr__(self):
+        return "BucketTable%r" % (self.sizes,)
+
+
+def _counter(name, doc):
+    return _telemetry.registry.counter(name, doc=doc)
+
+
+class Servable:
+    """One immutable model version: parameters + AOT program table.
+
+    ``block`` is any Gluon/Symbol block whose forward maps row-batched
+    inputs to row-batched outputs (leading axis = batch on every input
+    and output leaf) — the padding contract depends on row independence
+    of the *slots*, i.e. padding rows changes nothing about real rows.
+
+    Programs are keyed ``(bucket, input signature)`` where the signature
+    is the per-input (trailing shape, dtype) tuple; :meth:`warm`
+    pre-builds and pre-runs every bucket for one signature so the jit
+    cache, the XLA executable AND the first-dispatch autotuning are all
+    paid before the version goes live.
+    """
+
+    def __init__(self, block, name: str = "model", version: int = 1,
+                 buckets: Optional[BucketTable] = None):
+        from ..gluon.block import functionalize
+        self.block = block
+        self.name = str(name)
+        self.version = int(version)
+        self.buckets = buckets or BucketTable.from_env()
+        self._pure, self._param_values = functionalize(block)
+        self._lock = threading.Lock()
+        self._programs: Dict[Tuple, object] = {}
+        self._warm_sig: Optional[Tuple] = None
+        self.retraces = 0            # program builds (trace+compile)
+        self.bucket_hits = 0         # dispatches served from the table
+        self._c_retrace = _counter(
+            "serve.retraces", "serve-side program builds (should be 0 "
+            "after warmup; warm() pays them at deploy)")
+        self._c_hits = _counter(
+            "serve.bucket_hits", "dispatches answered by a pre-built "
+            "bucket program")
+        self._c_batches = _counter(
+            "serve.batches", "micro-batch dispatches")
+        # in-flight dispatch tracking for the host's drain
+        self._inflight = 0
+        self._inflight_cv = threading.Condition()
+        self._closed = False
+
+    # -- loaders ------------------------------------------------------------
+    @staticmethod
+    def from_block(block, params_file: Optional[str] = None, ctx=None,
+                   **kwargs) -> "Servable":
+        """Host a live Gluon block, optionally restoring a
+        ``save_parameters`` checkpoint first."""
+        if params_file:
+            block.load_parameters(params_file, ctx=ctx)
+        return Servable(block, **kwargs)
+
+    @staticmethod
+    def from_checkpoint(prefix: str, epoch: int = 0,
+                        input_names: Sequence[str] = ("data",),
+                        **kwargs) -> "Servable":
+        """Host an exported/foreign ``<prefix>-symbol.json`` +
+        ``<prefix>-%04d.params`` artifact through the existing
+        ``SymbolBlock.imports`` lane (the deploy format every MXNet-era
+        tool emits)."""
+        from ..gluon.block import SymbolBlock
+        sym_file = "%s-symbol.json" % prefix
+        params_file = "%s-%04d.params" % (prefix, int(epoch))
+        if not os.path.exists(params_file):
+            params_file = None
+        block = SymbolBlock.imports(sym_file, list(input_names),
+                                    params_file)
+        kwargs.setdefault("name", os.path.basename(prefix))
+        return Servable(block, **kwargs)
+
+    # -- program table ------------------------------------------------------
+    @staticmethod
+    def signature_of(arrays: Sequence) -> Tuple:
+        """Per-input (trailing shape, dtype) — the part of the aval the
+        bucket does not normalize.  Inputs must be ndarray-like (shape/
+        dtype attributes): the admission path hands the batcher numpy
+        arrays by contract, and shape reads never sync a device."""
+        return tuple((tuple(int(s) for s in a.shape[1:]), str(a.dtype))
+                     for a in arrays)
+
+    def _build(self, key):
+        """One jit program per (bucket, signature) key.  Kept explicit —
+        rather than one jax.jit whose aval cache we cannot see — so
+        retrace/hit accounting is exact and 'no serve-time retraces' is
+        a checkable number, not a hope."""
+        pure = self._pure
+
+        def run_infer(param_values, xs):
+            outs = pure(param_values, *xs, training=False)
+            leaves = jax.tree_util.tree_leaves(outs)
+            return tuple(leaves)
+
+        return jax.jit(run_infer)
+
+    def program(self, bucket: int, sig: Tuple):
+        key = (int(bucket), sig)
+        with self._lock:
+            prog = self._programs.get(key)
+            if prog is not None:
+                self.bucket_hits += 1
+        if prog is not None:
+            self._c_hits.inc()
+            return prog
+        with _telemetry.phase("retrace"):
+            prog = self._build(key)
+        with self._lock:
+            # two racing builders: first one in wins, identical programs
+            prog = self._programs.setdefault(key, prog)
+            self.retraces += 1
+        self._c_retrace.inc()
+        return prog
+
+    def warm(self, example: Sequence, outputs_expected: bool = True):
+        """Pre-trace + pre-run EVERY bucket for `example`'s signature
+        (`example` = per-input arrays; leading batch dim arbitrary).
+        Returns self so ``deploy(Servable(...).warm(x))`` chains."""
+        example = [_np.asarray(a) for a in example]
+        sig = self.signature_of(example)
+        for bucket in self.buckets:
+            zeros = [_np.zeros((bucket,) + trail, dtype=dt)
+                     for trail, dt in sig]
+            outs = self.dispatch(bucket, zeros, warming=True)
+            if outputs_expected:
+                for o in outs:
+                    jax.block_until_ready(o)
+        with self._lock:
+            self._warm_sig = sig
+        return self
+
+    @property
+    def warmed_signature(self) -> Optional[Tuple]:
+        with self._lock:
+            return self._warm_sig
+
+    # -- dispatch -----------------------------------------------------------
+    def dispatch(self, bucket: int, padded_inputs: Sequence,
+                 warming: bool = False) -> Tuple:
+        """Run the bucket program over already-padded inputs; returns the
+        output leaves as jax arrays (async — callers sync when they
+        scatter).  One device-program launch, counted."""
+        from ..engine import engine as _engine
+        sig = self.signature_of(padded_inputs)
+        prog = self.program(bucket, sig)
+        outs = prog(self._param_values, tuple(padded_inputs))
+        _engine.count_dispatch(1)
+        if not warming:
+            self._c_batches.inc()
+        return outs
+
+    # -- lifecycle ----------------------------------------------------------
+    def begin(self) -> bool:
+        """Claim one in-flight dispatch slot; False once retired."""
+        with self._inflight_cv:
+            if self._closed:
+                return False
+            self._inflight += 1
+            return True
+
+    def release(self) -> None:
+        with self._inflight_cv:
+            self._inflight = max(0, self._inflight - 1)
+            self._inflight_cv.notify_all()
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Wait (bounded) until no dispatch is in flight, then retire:
+        new begin() calls fail, the program table is dropped.  Returns
+        False if in-flight work outlived the budget (retire anyway —
+        outstanding jax arrays stay valid; only NEW dispatches die)."""
+        deadline = _fault.Deadline(timeout)
+        ok = True
+        with self._inflight_cv:
+            while self._inflight > 0:
+                remaining = deadline.remaining()
+                if remaining <= 0:
+                    ok = False
+                    break
+                self._inflight_cv.wait(timeout=min(0.05, remaining))
+            self._closed = True
+        with self._lock:
+            self._programs.clear()
+        return ok
+
+
+class ModelHost:
+    """Versioned servable lifecycle: load v(N+1) → warm → atomic flip →
+    drain v(N).
+
+    ``active()`` is what the batcher dereferences per batch — one lock
+    acquisition, never blocked by a deploy in progress (warming happens
+    entirely BEFORE the flip, draining entirely after), so hot-swap
+    under load serves every request from exactly one complete version.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._active: Optional[Servable] = None
+        self._history: List[Tuple[int, str]] = []
+
+    def active(self) -> Servable:
+        with self._lock:
+            sv = self._active
+        if sv is None:
+            raise MXNetError("ModelHost: no servable deployed")
+        return sv
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._active.version if self._active is not None else 0
+
+    def deploy(self, servable: Servable, example: Optional[Sequence] = None,
+               drain_timeout: float = 30.0) -> Servable:
+        """Warm `servable` (when an example is given and it is not
+        already warm), flip it live, drain the predecessor."""
+        if example is not None and servable.warmed_signature is None:
+            servable.warm(example)
+        with self._lock:
+            if self._active is not None and \
+                    servable.version <= self._active.version:
+                raise MXNetError(
+                    "ModelHost: version %d is not newer than the active "
+                    "%d" % (servable.version, self._active.version))
+            old, self._active = self._active, servable
+            self._history.append((servable.version, servable.name))
+        if old is not None:
+            old.drain(timeout=drain_timeout)
+        return servable
+
+    def history(self) -> List[Tuple[int, str]]:
+        with self._lock:
+            return list(self._history)

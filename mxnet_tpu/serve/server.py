@@ -1,0 +1,333 @@
+"""Serving RPC front: PREDICT / HEALTH / SWAP / STOP over the kvstore
+wire.
+
+Transport and envelope are the kvstore server's, verbatim: length-
+prefixed pickles (``kvstore.server.send_msg/recv_msg``), requests
+optionally wrapped ``("SEQ", client_id, seq, inner[, (trace_id,
+span_id)])`` with an exactly-once replay cache — a client that
+reconnects after a dropped reply replays the same seq and is answered
+from the cache instead of re-executing (a replayed PREDICT must not
+burn a second dispatch; a replayed SWAP must not double-bump the
+version).  Tensors cross as numpy-only ``NPX`` tuples
+(``kvstore.wire_codec.encode_array``), so the wire never carries a
+device array and health tools never import the kernel stack.
+
+Verbs::
+
+  PREDICT  (PREDICT, [npx, ...])          -> (True, (version, [npx, ...]))
+  HEALTH   (HEALTH,)                      -> (True, {status, version, ...})
+  SWAP     (SWAP, prefix, epoch, inputs)  -> (True, new_version)
+  STOP     (STOP,)                        -> (True, "stopping")
+
+Overload is a NORMAL reply — ``(False, "overloaded: ...")`` — so the
+client can distinguish load shedding (report/back off; the replica is
+healthy) from a dead replica (fail over).
+
+Tracing: the handler opens ``serve.server.<CMD>`` as a child of the
+client's wire-propagated span, and hands its own (trace_id, span_id) to
+the batcher with the request, so the batch's ``serve_dispatch`` span
+events close the client → server → batcher → dispatch chain.
+
+Chaos: every request passes the ``serve.request`` fault site —
+``tools/launch.py --fault 'serve.request:crash:after=N'`` kills the
+replica mid-load exactly like the worker-fit chaos lane, which is how
+tools/chaos_smoke.sh proves failover + supervisor restart.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import socketserver
+import threading
+from typing import Dict, Optional, Sequence
+
+from ..base import MXNetError, get_env
+from .. import fault as _fault
+from .. import telemetry as _telemetry
+from ..kvstore.server import send_msg, recv_msg
+from ..kvstore.wire_codec import decode_array, encode_array
+from .batcher import Batcher, Overloaded
+from .servable import ModelHost, Servable
+
+__all__ = ["ServeServer", "serve_forever"]
+
+
+class ServeServer:
+    """Verb handlers + replay cache over one (ModelHost, Batcher) pair."""
+
+    # replies worth exactly-once semantics; HEALTH re-executes harmlessly
+    _CACHED = ("PREDICT", "SWAP")
+
+    # replay-cache client bound: serving clients are ephemeral (every
+    # ServeClient is a fresh uuid), unlike the kvstore's fixed worker
+    # population — without eviction each dead client's last PREDICT
+    # response would be retained forever
+    _REPLAY_CAP = 512
+
+    def __init__(self, host: Optional[ModelHost] = None,
+                 batcher: Optional[Batcher] = None, **batcher_kw):
+        self.host = host or ModelHost()
+        self.batcher = batcher or Batcher(self.host, **batcher_kw)
+        # client_id -> [seq, done Event, resp]  (same shape as the
+        # kvstore server's cache; one in-flight entry per client)
+        self._replay: Dict[str, list] = {}
+        self._replay_lock = threading.Lock()
+
+    # -- envelope (kvstore SEQ contract) ------------------------------------
+    def handle_request(self, msg):
+        if isinstance(msg, tuple) and msg and msg[0] == "SEQ":
+            cid, seq, inner = msg[1], msg[2], msg[3]
+            tctx = msg[4] if len(msg) > 4 else None
+            cmd = inner[0] if inner else None
+            with _telemetry.rpc_span(
+                    "serve.server.%s" % cmd,
+                    trace_id=tctx[0] if tctx else None,
+                    parent_id=tctx[1] if tctx else None) as span:
+                return self._handle_seq(cid, seq, inner, cmd, span)
+        return self.handle(msg)
+
+    def _handle_seq(self, cid, seq, inner, cmd, span):
+        if cmd not in self._CACHED:
+            return self.handle(inner, span=span)
+        with self._replay_lock:
+            ent = self._replay.get(cid)
+            if ent is not None and seq == ent[0]:
+                dup = ent
+            elif ent is not None and seq < ent[0]:
+                span.event("stale", seq=seq, server_at=ent[0])
+                return False, ("stale request seq %s (server already at "
+                               "%s)" % (seq, ent[0]))
+            else:
+                dup = None
+                ent = [seq, threading.Event(), None]
+                self._replay[cid] = ent
+                if len(self._replay) > self._REPLAY_CAP:
+                    self._evict_replay_locked()
+        if dup is not None:
+            span.event("replay", seq=seq)
+            _telemetry.registry.counter(
+                "serve.server_replays",
+                doc="PREDICT/SWAP requests answered from the "
+                    "exactly-once replay cache").inc()
+            timeout = (get_env("MX_SERVE_TIMEOUT", 30.0, float) or 30.0) + 5
+            if not dup[1].wait(timeout=timeout):
+                return False, "replayed request %s still in flight" % seq
+            return dup[2]
+        try:
+            resp = self.handle(inner, span=span)
+        except BaseException as e:
+            ent[2] = (False, "serve error handling %r: %s" % (cmd, e))
+            ent[1].set()
+            raise
+        ent[2] = resp
+        ent[1].set()
+        return resp
+
+    def _evict_replay_locked(self) -> None:
+        """Caller holds _replay_lock.  Drop oldest-inserted RESOLVED
+        entries until back under the cap; in-flight entries (Event not
+        set) are never evicted — their replay semantics are live."""
+        for cid in list(self._replay):
+            if len(self._replay) <= self._REPLAY_CAP:
+                break
+            ent = self._replay[cid]
+            if ent[1].is_set():
+                del self._replay[cid]
+
+    # -- verbs --------------------------------------------------------------
+    def handle(self, msg, span=None):
+        cmd = msg[0]
+        if cmd == "PREDICT":
+            return self._predict(msg[1], span)
+        if cmd == "HEALTH":
+            return True, self.health()
+        if cmd == "SWAP":
+            _, prefix, epoch, input_names = msg
+            try:
+                version = self.swap(prefix, epoch, input_names)
+            except Exception as e:      # incl. a broken model's trace
+                # error: the old version stays live, the caller gets
+                # the reason instead of a severed connection
+                return False, "swap failed: %s" % e
+            return True, version
+        if cmd == "STOP":
+            return True, "stopping"
+        return False, "unknown serve command %r" % (cmd,)
+
+    def _predict(self, payload: Sequence, span):
+        try:
+            arrays = [decode_array(t) for t in payload]
+        except ValueError as e:
+            return False, "bad PREDICT payload: %s" % e
+        tctx = span.wire_context() if span is not None else None
+        try:
+            pending = self.batcher.submit(arrays, trace_ctx=tctx)
+        except Overloaded as e:
+            return False, "overloaded: %s" % e
+        except MXNetError as e:
+            return False, str(e)
+        # server-side wait stays INSIDE the client's recv window (which
+        # started earlier and includes network time), so a backlogged
+        # replica sheds with an explicit reply instead of the client
+        # timing out first and mistaking it for a dead replica
+        timeout = max(1.0,
+                      (get_env("MX_SERVE_TIMEOUT", 30.0, float) or 30.0)
+                      - 2.0)
+        try:
+            version, outs = pending.result(timeout=timeout)
+        except Exception as e:
+            # ANY dispatch failure (XLA runtime error, OOM, a broken
+            # foreign model's forward) must come back as a normal
+            # (False, reason) reply — a severed connection would make
+            # the client replay the poison request on every replica
+            return False, "predict failed: %s: %s" % (type(e).__name__, e)
+        return True, (version, [encode_array(o) for o in outs])
+
+    def health(self) -> Dict:
+        reg = _telemetry.registry
+        try:
+            sv = self.host.active()
+            status: Dict = {"status": "serving", "version": sv.version,
+                            "model": sv.name,
+                            "buckets": list(sv.buckets.sizes),
+                            "retraces": sv.retraces,
+                            "bucket_hits": sv.bucket_hits}
+        except MXNetError:
+            status = {"status": "empty", "version": 0}
+        status.update({
+            "queue_rows": self.batcher.queue_rows(),
+            "requests": reg.value("serve.requests"),
+            "rejected": reg.value("serve.rejected"),
+            "batches": reg.value("serve.batches"),
+            "pid": os.getpid(),
+        })
+        return status
+
+    def swap(self, prefix: str, epoch: int,
+             input_names: Sequence[str]) -> int:
+        """Load ``prefix`` as version N+1, warm it with the active
+        version's signature, flip, drain — the wire face of
+        ``ModelHost.deploy``."""
+        new_version = self.host.version + 1
+        sv = Servable.from_checkpoint(prefix, epoch=epoch,
+                                     input_names=input_names,
+                                     version=new_version)
+        example = None
+        try:
+            want = self.host.active().warmed_signature
+            if want is not None:
+                import numpy as _np
+                example = [_np.zeros((1,) + trail, dtype=dt)
+                           for trail, dt in want]
+        except MXNetError:
+            pass
+        self.host.deploy(sv, example=example)
+        return new_version
+
+    def close(self) -> None:
+        self.batcher.close()
+
+
+def serve_forever(port: Optional[int] = None,
+                  state: Optional[ServeServer] = None,
+                  ready_file: Optional[str] = None,
+                  stop_event: Optional[threading.Event] = None,
+                  abort_event: Optional[threading.Event] = None) -> None:
+    """Run one serving replica's accept loop (modeled on
+    ``kvstore.server.serve_forever``: threaded handlers, graceful STOP
+    drain, surviving connections severed on the way out).
+
+    ``abort_event`` is the chaos hook for in-process tests: setting it
+    severs the listener and every live connection IMMEDIATELY — no
+    drain, no replies — which is what a killed replica looks like to
+    its clients (the subprocess lane uses the ``serve.request`` crash
+    fault instead).
+    """
+    port = int(port if port is not None else get_env("MX_SERVE_PORT"))
+    server_state = state or ServeServer()
+    stop_event = stop_event or threading.Event()
+    abort_event = abort_event or threading.Event()
+    inflight_count = [0]
+    inflight_lock = threading.Lock()
+    conns = set()
+    conns_lock = threading.Lock()
+
+    class Handler(socketserver.BaseRequestHandler):
+        def handle(self):
+            with conns_lock:
+                conns.add(self.request)
+            try:
+                self._serve()
+            finally:
+                with conns_lock:
+                    conns.discard(self.request)
+
+        def _serve(self):
+            while not abort_event.is_set():
+                try:
+                    msg = recv_msg(self.request, idle_block=True)
+                except (ConnectionError, OSError, TimeoutError):
+                    return
+                with inflight_lock:
+                    inflight_count[0] += 1
+                try:
+                    _fault.fire("serve.request")
+                    ok, payload = server_state.handle_request(msg)
+                except SystemExit:      # injected crash: die mid-request
+                    os._exit(17)
+                except _fault.FaultError as e:
+                    ok, payload = False, str(e)
+                finally:
+                    with inflight_lock:
+                        inflight_count[0] -= 1
+                try:
+                    send_msg(self.request, (ok, payload))
+                except (ConnectionError, OSError):
+                    return
+                inner = msg[3] if isinstance(msg, tuple) and msg and \
+                    msg[0] == "SEQ" else msg
+                if inner and inner[0] == "STOP":
+                    stop_event.set()
+                    return
+
+    class Server(socketserver.ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+    def _sever():
+        with conns_lock:
+            leftover = list(conns)
+        for c in leftover:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    with Server(("0.0.0.0", port), Handler) as srv:
+        if ready_file:
+            with open(ready_file, "w") as f:
+                f.write("%d" % srv.server_address[1])
+        t = threading.Thread(target=srv.serve_forever, daemon=True,
+                             name="mx-serve-accept")
+        t.start()
+        # idle until STOP (a replica's lifetime) or the chaos abort —
+        # the supervisor owns killing an abandoned replica
+        while not stop_event.is_set() and not abort_event.is_set():
+            stop_event.wait(timeout=0.1)
+        srv.shutdown()                      # stop accepting
+        if abort_event.is_set():
+            _sever()                        # simulated crash: no drain
+            server_state.close()
+            return
+        drain_deadline = _fault.Deadline(5.0)
+        while not drain_deadline.expired():
+            with inflight_lock:
+                if inflight_count[0] == 0:
+                    break
+            _fault.sleep(0.02)
+        server_state.close()
+        _sever()

@@ -24,7 +24,9 @@ scale — arxiv 1605.08695, PAPERS.md):
   step (taxonomy: ``data_wait`` / ``forward`` / ``backward`` /
   ``exchange`` / ``optimizer_apply`` / ``metric_update`` /
   ``metric_drain`` / ``retrace`` / ``compiled_step`` /
-  ``compiled_window``).  A span measures *dispatch* latency — it never
+  ``compiled_window``, plus the serving engine's request phases
+  ``queue_wait`` / ``pad`` / ``serve_dispatch`` / ``scatter`` —
+  ISSUE 9).  A span measures *dispatch* latency — it never
   syncs the device (the host-sync mxlint rule roots this file's
   helpers) — and feeds three sinks: the per-phase histogram
   (``step_phase_seconds{phase=...}``), the existing profiler
@@ -76,7 +78,7 @@ from .base import get_env
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "registry",
     "enabled", "tracing_enabled", "start_tracing", "stop_tracing",
-    "Span", "phase", "rpc_span", "current_trace",
+    "Span", "phase", "rpc_span", "current_trace", "observe_phase",
     "FlightRecorder", "flight_recorder", "note_step",
     "heartbeat_payload", "phase_snapshot",
     "dump_trace", "trace_events", "clear_trace", "dump_crash",
@@ -549,6 +551,20 @@ def phase(name: str):
     if not (_profiler.RUNNING or enabled() or tracing_enabled()):
         return _NULL_SPAN
     return _PhaseSpan("phase." + name, cat="phase")
+
+
+def observe_phase(name: str, seconds: float) -> None:
+    """Record one already-measured phase duration into the per-phase
+    histogram (``step_phase_seconds{phase=name}``).
+
+    The span form (:func:`phase`) needs the phase to be a lexical block
+    on ONE thread; a duration that straddles threads — the serving
+    batcher's ``queue_wait`` starts at admission on an RPC handler
+    thread and ends at dequeue on the batcher thread — is measured by
+    the consumer and observed here instead.  No-op when telemetry is
+    off."""
+    if enabled():
+        _phase_hist(name).observe(float(seconds))
 
 
 def rpc_span(name: str, trace_id: Optional[str] = None,

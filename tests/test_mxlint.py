@@ -1130,6 +1130,54 @@ def test_compiled_step_is_hot_path_root():
     assert any("CompiledStep._run" in q for q in roots["mxnet_tpu/step.py"])
 
 
+def test_reinjected_host_sync_in_serve_batcher_trips():
+    """ISSUE 9: the serving batcher's dispatch loop is a hot-path root —
+    a blocking ``float(...asnumpy())`` reintroduced between dequeue and
+    dispatch (debug peeking at the batch output) serializes the whole
+    fleet's latency and must trip the rule."""
+    p = os.path.join(REPO, "mxnet_tpu", "serve", "batcher.py")
+    with open(p) as f:
+        code = f.read()
+    anchor = "                outs = sv.dispatch(bucket, padded)"
+    assert anchor in code, "Batcher._dispatch moved; update this test"
+    bad = code.replace(
+        anchor,
+        anchor + "\n                _dbg = float(outs[0].asnumpy()[0])", 1)
+    diags = lint_source(bad, "mxnet_tpu/serve/batcher.py")
+    assert "host-sync-in-hot-path" in rules_of(diags)
+    new, _, _ = apply_baseline(diags, load_baseline(BASELINE))
+    assert "host-sync-in-hot-path" in rules_of(new)
+
+
+def test_serve_batcher_is_hot_path_root():
+    """Regression guard for the root-table entries the reinjection test
+    above relies on (batcher loop + the servable dispatch side of the
+    cross-file hot edge)."""
+    from tools.mxlint.rules import HOT_PATH_ROOTS
+    roots = dict(HOT_PATH_ROOTS)
+    assert "mxnet_tpu/serve/batcher.py" in roots
+    assert any("Batcher._dispatch" in q
+               for q in roots["mxnet_tpu/serve/batcher.py"])
+    assert any("Batcher._collect" in q
+               for q in roots["mxnet_tpu/serve/batcher.py"])
+    assert "mxnet_tpu/serve/servable.py" in roots
+    assert any("Servable.dispatch" in q
+               for q in roots["mxnet_tpu/serve/servable.py"])
+
+
+def test_serve_batcher_thread_is_a_discovered_root():
+    """The concurrency pass must see the batcher's dispatch loop as a
+    thread root (its shared state is then race-checked) — and the
+    serving socket handler as a multi-instance root, like the kvstore
+    server's.  Reuses the memoized full-tree scan."""
+    _diags, proj = _scan_tree()
+    displays = {r.display for r in proj.roots}
+    assert "thread:Batcher._loop" in displays
+    assert any("mxnet_tpu/serve/server.py" in e
+               for r in proj.roots for e in r.entries
+               if r.kind == "handler")
+
+
 def test_reinjected_wall_clock_in_kvstore_retry_trips():
     p = os.path.join(REPO, "mxnet_tpu", "kvstore", "kvstore.py")
     with open(p) as f:

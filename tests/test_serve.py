@@ -1,0 +1,577 @@
+"""Serving engine tests (ISSUE 9): bucket table + padding purity,
+batcher coalescing on the injectable clock, explicit overload shedding,
+the SEQ-wire PREDICT round trip with trace propagation and exactly-once
+replay, hot-swap-under-load version integrity, and the foreign
+symbol.json servable lane.
+"""
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import fault, nd, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.kvstore.wire_codec import (decode_array, encode_array,
+                                          is_array_payload)
+from mxnet_tpu.serve import (Batcher, BucketTable, ModelHost, Overloaded,
+                             Servable, ServeClient, ServeServer,
+                             serve_forever)
+from mxnet_tpu.serve.demo import (DEMO_IN, demo_block, demo_example,
+                                  demo_expected)
+from mxnet_tpu.telemetry import registry
+
+
+def _mk_host(buckets=(1, 2, 4, 8), version=1, scale=None):
+    net = demo_block()
+    if scale is not None:
+        for p in net.collect_params().values():
+            p.set_data(p.data() * scale)
+    sv = Servable(net, name="demo", version=version,
+                  buckets=BucketTable(buckets))
+    host = ModelHost()
+    host.deploy(sv, example=demo_example())
+    return host, sv, net
+
+
+@pytest.fixture(scope="module")
+def shared_host():
+    """One warmed (1,2,4,8)-bucket demo host for the read-only batcher
+    tests — each test builds its own Batcher (cheap) but shares the
+    warm cost (4 trace+compiles) across the module."""
+    return _mk_host()
+
+
+# ---------------------------------------------------------------------------
+# wire codec
+# ---------------------------------------------------------------------------
+
+def test_npx_codec_roundtrip():
+    for arr in (np.arange(12, dtype=np.float32).reshape(3, 4),
+                np.zeros((2, 0, 5), np.int32),
+                np.asarray(3.5, np.float64)):
+        enc = encode_array(arr)
+        assert is_array_payload(enc)
+        out = decode_array(enc)
+        assert out.dtype == arr.dtype and out.shape == arr.shape
+        np.testing.assert_array_equal(out, arr)
+        out += 1                      # decode must hand back writable
+
+
+def test_npx_codec_accepts_ndarray_and_rejects_junk():
+    enc = encode_array(nd.array(np.ones((2, 3), np.float32)))
+    np.testing.assert_array_equal(decode_array(enc), np.ones((2, 3)))
+    with pytest.raises(ValueError):
+        decode_array(("NOPE", (1,), "float32", b""))
+
+
+# ---------------------------------------------------------------------------
+# bucket table
+# ---------------------------------------------------------------------------
+
+def test_bucket_table_selection():
+    bt = BucketTable([8, 1, 4, 4, 2])
+    assert bt.sizes == (1, 2, 4, 8)
+    assert bt.bucket_for(1) == 1
+    assert bt.bucket_for(3) == 4
+    assert bt.bucket_for(8) == 8
+    assert bt.bucket_for(9) is None
+    assert bt.max_size == 8
+
+
+def test_bucket_table_from_env(monkeypatch):
+    monkeypatch.setenv("MX_SERVE_BUCKETS", "2, 8,32")
+    bt = BucketTable.from_env()
+    assert bt.sizes == (2, 8, 32)
+    with pytest.raises(MXNetError):
+        BucketTable([0, 4])
+
+
+# ---------------------------------------------------------------------------
+# padding correctness
+# ---------------------------------------------------------------------------
+
+def test_padded_rows_bit_equal_to_unpadded(shared_host):
+    """The pad rows must be invisible: the same 5 real rows through the
+    bucket-8 program give BIT-EQUAL outputs whether the other 3 slots
+    hold zero padding or unrelated real rows."""
+    _host, sv, _net = shared_host
+    rng = np.random.RandomState(0)
+    real = rng.randn(5, DEMO_IN).astype(np.float32)
+    other = rng.randn(3, DEMO_IN).astype(np.float32)
+    padded = np.concatenate([real, np.zeros((3, DEMO_IN), np.float32)])
+    full = np.concatenate([real, other])
+    out_pad = np.asarray(sv.dispatch(8, [padded])[0])
+    out_full = np.asarray(sv.dispatch(8, [full])[0])
+    np.testing.assert_array_equal(out_pad[:5], out_full[:5])
+
+
+def test_batcher_padded_result_matches_eager(shared_host):
+    """End to end through admission → pad → dispatch → scatter, the
+    response equals the eager forward of the unpadded request."""
+    host, _sv, net = shared_host
+    b = Batcher(host, max_batch=8, max_delay_us=0, queue_cap=64)
+    try:
+        x = np.random.RandomState(1).randn(3, DEMO_IN).astype(np.float32)
+        version, outs = b.submit([x]).result(timeout=30)
+        assert version == 1
+        assert outs[0].shape == (3, 8)
+        np.testing.assert_allclose(outs[0], demo_expected(x, net=net),
+                                   rtol=1e-5, atol=1e-6)
+    finally:
+        b.close()
+
+
+def test_zero_retraces_after_warm(shared_host):
+    host, sv, _net = shared_host
+    b = Batcher(host, max_batch=8, max_delay_us=0, queue_cap=64)
+    try:
+        r0 = sv.retraces
+        h0 = sv.bucket_hits
+        rng = np.random.RandomState(2)
+        for rows in (1, 2, 3, 5, 8, 7, 4):
+            b.submit([rng.randn(rows, DEMO_IN).astype(np.float32)]
+                     ).result(timeout=30)
+        assert sv.retraces == r0, "serve-time retrace happened"
+        assert sv.bucket_hits - h0 == 7
+    finally:
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# batcher coalescing (virtual clock) + overload
+# ---------------------------------------------------------------------------
+
+def test_batcher_coalesces_burst_into_one_dispatch(shared_host):
+    """A queued burst coalesces into ceil(rows/max_batch) dispatches —
+    deterministic because the batcher starts after the burst lands."""
+    host, _sv, _net = shared_host
+    b = Batcher(host, max_batch=4, max_delay_us=0, queue_cap=64,
+                autostart=False)
+    rng = np.random.RandomState(3)
+    pendings = [b.submit([rng.randn(1, DEMO_IN).astype(np.float32)])
+                for _ in range(8)]
+    b0 = registry.value("serve.batches")
+    b.start()
+    for p in pendings:
+        p.result(timeout=30)
+    b.close()
+    assert registry.value("serve.batches") - b0 == 2
+
+
+@pytest.mark.chaos
+def test_batcher_window_rides_virtual_clock(shared_host):
+    """The max-delay coalescing window runs on the injectable clock: a
+    lone request dispatches only after the batcher itself pumps the
+    VIRTUAL deadline past MX_SERVE_MAX_DELAY_US (no real half-second
+    sleep anywhere), and a burst that fills max_batch dispatches without
+    waiting out the window."""
+    host, _sv, _net = shared_host
+    with fault.use_virtual_time() as clk:
+        b = Batcher(host, max_batch=4, max_delay_us=500_000,
+                    queue_cap=64)
+        try:
+            t0 = clk.now()
+            x = np.zeros((1, DEMO_IN), np.float32)
+            version, _outs = b.submit([x]).result(timeout=30)
+            assert version == 1
+            assert clk.now() - t0 >= 0.5, \
+                "window expired without charging the virtual clock"
+            # a full burst must NOT wait the window out: 4 rows fill
+            # max_batch and dispatch immediately
+            b0 = registry.value("serve.batches")
+            t1 = clk.now()
+            pendings = [b.submit([x]) for _ in range(4)]
+            for p in pendings:
+                p.result(timeout=30)
+            assert registry.value("serve.batches") - b0 == 1
+            occ = registry.find("serve.batch_occupancy").snapshot()
+            assert occ["max"] >= 4
+            assert clk.now() - t1 < 0.5, \
+                "full batch still waited out the delay window"
+        finally:
+            b.close()
+
+
+def test_overload_rejection_is_explicit(shared_host):
+    host, _sv, _net = shared_host
+    b = Batcher(host, max_batch=8, max_delay_us=0, queue_cap=4,
+                autostart=False)
+    rej0 = registry.value("serve.rejected")
+    b.submit([np.zeros((2, DEMO_IN), np.float32)])
+    b.submit([np.zeros((2, DEMO_IN), np.float32)])
+    with pytest.raises(Overloaded):
+        b.submit([np.zeros((1, DEMO_IN), np.float32)])
+    assert registry.value("serve.rejected") - rej0 == 1
+    b.close()           # fails the queued pendings loudly, leaks none
+
+
+def test_admission_rejects_unservable_requests():
+    host, _sv, _net = _mk_host(buckets=(1, 2, 4))
+    b = Batcher(host, max_batch=4, max_delay_us=0, queue_cap=64,
+                autostart=False)
+    with pytest.raises(MXNetError, match="top bucket"):
+        b.submit([np.zeros((5, DEMO_IN), np.float32)])
+    with pytest.raises(MXNetError, match="signature"):
+        b.submit([np.zeros((1, DEMO_IN + 1), np.float32)])
+    with pytest.raises(MXNetError, match="disagree"):
+        b.submit([np.zeros((1, DEMO_IN), np.float32),
+                  np.zeros((2, DEMO_IN), np.float32)])
+    b.close()
+
+
+# ---------------------------------------------------------------------------
+# hot swap
+# ---------------------------------------------------------------------------
+
+def test_hot_swap_mid_load_serves_only_complete_versions():
+    """Requests racing a deploy must each be answered by exactly ONE
+    fully-warmed version — the response values must match the tagged
+    version's reference outputs bit-for-bit(ish), never a mix."""
+    host, _sv1, net1 = _mk_host()
+    net2 = demo_block()
+    for p in net2.collect_params().values():
+        p.set_data(p.data() * 2.0)
+    sv2 = Servable(net2, name="demo", version=2,
+                   buckets=BucketTable((1, 2, 4, 8)))
+    b = Batcher(host, max_batch=4, max_delay_us=100, queue_cap=256)
+    stop = threading.Event()
+    results, errors = [], []
+    lock = threading.Lock()
+    rng = np.random.RandomState(4)
+    xs = [rng.randn(2, DEMO_IN).astype(np.float32) for _ in range(8)]
+
+    def load():
+        i = 0
+        while not stop.is_set():
+            x = xs[i % len(xs)]
+            try:
+                version, outs = b.submit([x]).result(timeout=30)
+                with lock:
+                    results.append((x, version, outs[0]))
+            except MXNetError as e:        # pragma: no cover - fails test
+                with lock:
+                    errors.append(e)
+                return
+            i += 1
+
+    threads = [threading.Thread(target=load, daemon=True)
+               for _ in range(3)]
+    for t in threads:
+        t.start()
+    time.sleep(0.15)
+    host.deploy(sv2, example=demo_example())   # warm → flip → drain
+    time.sleep(0.15)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    b.close()
+    assert not errors, errors
+    versions = {v for _x, v, _o in results}
+    assert versions == {1, 2}, \
+        "load did not straddle the swap: %r" % versions
+    exp1 = {id(x): demo_expected(x, net=net1) for x in xs}
+    exp2 = {id(x): demo_expected(x, net=net2) for x in xs}
+    for x, version, out in results:
+        want = exp1[id(x)] if version == 1 else exp2[id(x)]
+        np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6,
+                                   err_msg="v%d response mixed versions"
+                                           % version)
+    assert host.version == 2
+
+
+def test_hot_swap_to_new_signature_fails_stragglers_explicitly():
+    """A request admitted under v1's signature, then overtaken by a
+    deploy whose signature differs, must get an explicit retryable
+    error — never a serve-time retrace through the new version."""
+    host, sv1, _net = _mk_host(buckets=(1, 2, 4))
+    b = Batcher(host, max_batch=4, max_delay_us=0, queue_cap=16,
+                autostart=False)
+    p = b.submit([np.zeros((2, DEMO_IN), np.float32)])   # valid for v1
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(4, in_units=DEMO_IN + 4))          # new signature
+    net2.initialize(mx.init.Xavier())
+    sv2 = Servable(net2, name="demo", version=2,
+                   buckets=BucketTable((1, 2, 4)))
+    host.deploy(sv2, example=[np.zeros((1, DEMO_IN + 4), np.float32)])
+    r2 = sv2.retraces
+    b.start()
+    with pytest.raises(MXNetError, match="hot-swapped"):
+        p.result(timeout=30)
+    assert sv2.retraces == r2, "straggler forced a retrace through v2"
+    b.close()
+
+
+def test_dispatch_failure_is_a_reply_not_a_severed_connection():
+    """Any dispatch-time exception (XLA error, broken model) must come
+    back as a normal (False, reason) PREDICT reply — a severed
+    connection would make the client replay the poison request on
+    every replica."""
+    from mxnet_tpu.serve.server import ServeServer
+    host, sv, _net = _mk_host(buckets=(1, 2))
+    state = ServeServer(host=host, max_delay_us=0, queue_cap=16)
+    try:
+        boom = RuntimeError("XLA exploded")
+
+        def bad_dispatch(*a, **k):
+            raise boom
+
+        sv.dispatch = bad_dispatch
+        ok, reason = state.handle(
+            ("PREDICT", [encode_array(np.zeros((1, DEMO_IN),
+                                               np.float32))]))
+        assert ok is False
+        assert "predict failed" in reason and "XLA exploded" in reason
+    finally:
+        state.close()
+
+
+def test_replay_cache_is_bounded():
+    from mxnet_tpu.serve.server import ServeServer
+    host, _sv, _net = _mk_host(buckets=(1,))
+    state = ServeServer(host=host, max_delay_us=0, queue_cap=16)
+    try:
+        state._REPLAY_CAP = 8
+        done = threading.Event()
+        done.set()
+        with state._replay_lock:
+            for i in range(20):
+                state._replay["c%d" % i] = [1, done, (True, None)]
+                if len(state._replay) > state._REPLAY_CAP:
+                    state._evict_replay_locked()
+            assert len(state._replay) <= state._REPLAY_CAP
+            # in-flight entries survive eviction
+            pending = threading.Event()
+            state._replay["inflight"] = [2, pending, None]
+            for i in range(20, 40):
+                state._replay["c%d" % i] = [1, done, (True, None)]
+                if len(state._replay) > state._REPLAY_CAP:
+                    state._evict_replay_locked()
+            assert "inflight" in state._replay
+    finally:
+        state.close()
+
+
+def test_model_host_rejects_stale_versions():
+    host, _sv, _net = _mk_host(version=3)
+    with pytest.raises(MXNetError, match="not newer"):
+        host.deploy(Servable(demo_block(), version=3,
+                             buckets=BucketTable((1, 2))),
+                    example=demo_example())
+
+
+# ---------------------------------------------------------------------------
+# wire round trip
+# ---------------------------------------------------------------------------
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _start_replica(port, buckets=(1, 4), abort_event=None):
+    state = ServeServer()
+    state.host.deploy(
+        Servable(demo_block(), version=1, buckets=BucketTable(buckets)),
+        example=demo_example())
+    stop_ev = threading.Event()
+    t = threading.Thread(
+        target=serve_forever,
+        kwargs=dict(port=port, state=state, stop_event=stop_ev,
+                    abort_event=abort_event),
+        daemon=True)
+    t.start()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port),
+                                     timeout=0.2).close()
+            return state, stop_ev, t
+        except OSError:
+            time.sleep(0.05)
+    raise RuntimeError("serve replica did not come up on %d" % port)
+
+
+@pytest.fixture
+def replica(monkeypatch):
+    monkeypatch.setenv("MX_KVSTORE_RETRY_DEADLINE", "20")
+    monkeypatch.setenv("MX_KVSTORE_RETRY_BASE", "0.05")
+    monkeypatch.setenv("MX_KVSTORE_RETRY_MAX", "0.25")
+    port = _free_port()
+    state, stop_ev, t = _start_replica(port)
+    yield port, state
+    stop_ev.set()
+    t.join(timeout=10)
+    fault.clear()
+
+
+def _spans(name):
+    return [e for e in telemetry.trace_events()
+            if e["name"] == name and e["ph"] == "X"]
+
+
+def test_predict_round_trip_with_trace_propagation(replica):
+    """PREDICT over a real socket: correct values back, and the client
+    span, server span and the batch's per-request event share one
+    causal chain (wire-propagated trace context)."""
+    port, _state = replica
+    telemetry.start_tracing()
+    try:
+        telemetry.clear_trace()
+        cli = ServeClient(["127.0.0.1:%d" % port], timeout=15)
+        net = demo_block()
+        x = np.random.RandomState(5).randn(3, DEMO_IN).astype(np.float32)
+        version, outs = cli.predict([x])
+        assert version == 1
+        np.testing.assert_allclose(outs[0], demo_expected(x, net=net),
+                                   rtol=1e-5, atol=1e-6)
+        cli.close()
+        # one causal chain: client span -> server child span -> batch
+        # "request" event carrying the server span's ids
+        cli_sp = _spans("serve.client.PREDICT")
+        assert cli_sp
+        cli_by_trace = {e["args"]["trace_id"]: e for e in cli_sp}
+        srv_sp = [e for e in _spans("serve.server.PREDICT")
+                  if e["args"]["trace_id"] in cli_by_trace]
+        assert srv_sp, "no server span shares a client trace id"
+        srv0 = srv_sp[0]
+        cli0 = cli_by_trace[srv0["args"]["trace_id"]]
+        assert srv0["args"]["parent_id"] == cli0["args"]["span_id"]
+        reqev = [e for e in telemetry.trace_events()
+                 if e["name"] == "request" and e["ph"] == "i" and
+                 e["args"].get("req_trace") == srv0["args"]["trace_id"]]
+        assert reqev, "batch span carries no event for this request"
+        assert reqev[0]["args"]["req_span"] == srv0["args"]["span_id"]
+    finally:
+        telemetry.stop_tracing()
+
+
+@pytest.mark.chaos
+def test_lost_reply_is_replayed_exactly_once(replica):
+    """A reply dropped after the server dispatched the PREDICT: the
+    client replays the SAME seq on reconnect and the server answers
+    from the exactly-once cache (no second dispatch burned)."""
+    port, state = replica
+    cli = ServeClient(["127.0.0.1:%d" % port], timeout=15)
+    x = np.ones((1, DEMO_IN), np.float32)
+    cli.predict([x])                       # connection warm
+    b0 = registry.value("serve.batches")
+    r0 = registry.value("serve.server_replays")
+    fault.inject("serve.client.recv", action="close", after=0, count=1)
+    version, outs = cli.predict([x])
+    assert version == 1
+    assert registry.value("serve.server_replays") == r0 + 1
+    assert registry.value("serve.batches") == b0 + 1, \
+        "the replayed PREDICT burned a second dispatch"
+    cli.close()
+
+
+def test_health_and_overload_over_the_wire(replica):
+    port, state = replica
+    cli = ServeClient(["127.0.0.1:%d" % port], timeout=15)
+    h = cli.health()
+    assert h["status"] == "serving" and h["version"] == 1
+    assert h["buckets"] == [1, 4]
+    # oversize request: a normal (False, reason) reply, not a hang
+    with pytest.raises(MXNetError, match="top bucket"):
+        cli.predict([np.zeros((5, DEMO_IN), np.float32)])
+    cli.close()
+
+
+def test_swap_over_the_wire(replica, tmp_path):
+    port, state = replica
+    cli = ServeClient(["127.0.0.1:%d" % port], timeout=15)
+    net2 = demo_block()
+    for p in net2.collect_params().values():
+        p.set_data(p.data() * 0.5)
+    net2(nd.zeros((1, DEMO_IN)))
+    prefix = str(tmp_path / "v2")
+    net2.export(prefix, epoch=0)
+    assert cli.swap(prefix, epoch=0, input_names=("data",)) == [2]
+    x = np.random.RandomState(6).randn(2, DEMO_IN).astype(np.float32)
+    version, outs = cli.predict([x])
+    assert version == 2
+    np.testing.assert_allclose(outs[0], demo_expected(x, net=net2),
+                               rtol=1e-4, atol=1e-5)
+    assert state.host.version == 2
+    cli.close()
+
+
+@pytest.mark.chaos
+def test_failover_loses_no_requests(monkeypatch):
+    """Kill one of two replicas mid-stream: every request still gets a
+    correct answer (sticky client + SEQ retry + rotation)."""
+    monkeypatch.setenv("MX_KVSTORE_RETRY_DEADLINE", "20")
+    monkeypatch.setenv("MX_KVSTORE_RETRY_BASE", "0.05")
+    monkeypatch.setenv("MX_KVSTORE_RETRY_MAX", "0.25")
+    p1, p2 = _free_port(), _free_port()
+    ab1 = threading.Event()
+    _s1, ev1, t1 = _start_replica(p1, buckets=(2,), abort_event=ab1)
+    _s2, ev2, t2 = _start_replica(p2, buckets=(2,))
+    try:
+        cli = ServeClient(["127.0.0.1:%d" % p1, "127.0.0.1:%d" % p2],
+                          timeout=15)
+        net = demo_block()
+        f0 = registry.value("serve.client_failovers")
+        rng = np.random.RandomState(7)
+        for i in range(8):
+            if i == 3:
+                ab1.set()              # sever replica 1 mid-load
+            x = rng.randn(2, DEMO_IN).astype(np.float32)
+            version, outs = cli.predict([x])
+            np.testing.assert_allclose(outs[0],
+                                       demo_expected(x, net=net),
+                                       rtol=1e-5, atol=1e-6)
+        assert registry.value("serve.client_failovers") > f0
+        cli.stop()
+        cli.close()
+    finally:
+        ab1.set()
+        ev2.set()
+        t1.join(timeout=10)
+        t2.join(timeout=10)
+        fault.clear()
+
+
+# ---------------------------------------------------------------------------
+# foreign symbol.json servable
+# ---------------------------------------------------------------------------
+
+def test_foreign_symbol_json_servable_matches_eager(tmp_path):
+    """A servable hosted from an exported symbol.json + params pair (the
+    deploy artifact every MXNet-era tool emits) answers exactly like the
+    live block's eager forward."""
+    net = nn.HybridSequential()
+    net.add(nn.Dense(12, activation="relu"), nn.Dense(5))
+    net.initialize(mx.init.Xavier())
+    x = np.random.RandomState(8).randn(3, 7).astype(np.float32)
+    y_eager = np.asarray(net(nd.array(x))._jax)
+    prefix = str(tmp_path / "foreign")
+    net.export(prefix, epoch=2)
+    sv = Servable.from_checkpoint(prefix, epoch=2, input_names=("data",),
+                                  version=1, buckets=BucketTable((4,)))
+    host = ModelHost()
+    host.deploy(sv, example=[np.zeros((1, 7), np.float32)])
+    b = Batcher(host, max_batch=4, max_delay_us=0, queue_cap=16)
+    try:
+        version, outs = b.submit([x]).result(timeout=30)
+        np.testing.assert_allclose(outs[0], y_eager,
+                                   rtol=1e-5, atol=1e-6)
+        assert sv.retraces == len(sv.buckets.sizes)   # warm only
+    finally:
+        b.close()
+
+
+def test_serve_env_knobs_are_cataloged():
+    from mxnet_tpu.base import ENV_CATALOG
+    for name in ("MX_SERVE_BUCKETS", "MX_SERVE_MAX_BATCH",
+                 "MX_SERVE_MAX_DELAY_US", "MX_SERVE_QUEUE_CAP",
+                 "MX_SERVE_PORT", "MX_SERVE_ROOTS", "MX_SERVE_TIMEOUT"):
+        assert name in ENV_CATALOG, name

@@ -225,6 +225,72 @@ print("compiled_step_smoke: PASS losses=%s dispatches/step=%d"
       % (["%.4f" % l for l in losses], per_step))
 EOF
 
+echo "== chaos_smoke: two-replica serving - kill one mid-load (ISSUE 9)"
+# two supervised serving replicas (health-gated via --hang-timeout +
+# heartbeat beats from the batcher loop); the serve.request fault kills
+# replica 0 mid-request ~45, the sticky client fails over to replica 1,
+# the supervisor restarts replica 0, and the driver asserts: every one
+# of its 100 requests got a CORRECT answer (zero lost in-flight), >=1
+# failover happened, and both replicas serve again at the end.
+SERVE_BASE=$("$PY" - <<'EOF'
+import socket
+while True:
+    s1 = socket.socket(); s1.bind(("", 0)); p = s1.getsockname()[1]
+    s2 = socket.socket()
+    try:
+        s2.bind(("", p + 1))
+    except OSError:
+        s1.close(); s2.close(); continue
+    s1.close(); s2.close(); print(p); break
+EOF
+)
+rc=0
+# 100 requests with a crash every ~45 handled → at most 2 crashes
+# fleet-wide, comfortably inside a 3-per-replica restart budget (the
+# failed-over survivor can crash too — rolling chaos is the point)
+PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}" \
+"$PY" "$REPO/tools/launch.py" -n 2 --launcher local \
+    --restart on-failure --max-restarts 3 --hang-timeout 30 \
+    --fault 'serve.request:crash:after=45' -- \
+    "$PY" -m mxnet_tpu.serve --demo --port-base "$SERVE_BASE" \
+    > "$WORK/serve.log" 2>&1 &
+LAUNCH_PID=$!
+"$PY" "$REPO/tools/serve_load.py" \
+    --addrs "127.0.0.1:$SERVE_BASE,127.0.0.1:$((SERVE_BASE+1))" \
+    --requests 100 --chaos --stop 2>&1 \
+    | tee "$WORK/serve_load.log" || rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "chaos_smoke: FAIL - serve load driver exited $rc" >&2
+    kill "$LAUNCH_PID" 2>/dev/null || true
+    cat "$WORK/serve.log" >&2 || true
+    exit 1
+fi
+wait "$LAUNCH_PID" || rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "chaos_smoke: FAIL - serve launch.py exited $rc" >&2
+    cat "$WORK/serve.log" >&2 || true
+    exit 1
+fi
+grep -q 'restart 1/' "$WORK/serve.log" || {
+    echo "chaos_smoke: FAIL - no serving replica was restarted" >&2
+    exit 1
+}
+grep -q 'SERVE_LOAD_OK' "$WORK/serve_load.log" || {
+    echo "chaos_smoke: FAIL - serve load driver never reported OK" >&2
+    exit 1
+}
+echo "chaos_smoke: serving chaos PASS (failover + restart, zero lost)"
+
+echo "== chaos_smoke: serve dispatch budget (1 dispatch per batch)"
+"$PY" "$REPO/tools/dispatch_count.py" --serve > "$WORK/serve_budget.json"
+"$PY" - "$WORK/serve_budget.json" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1]))
+assert r["serve"]["ok"], r["serve"]
+print("serve budget: %(dispatches)d dispatches / %(batches)d batches, "
+      "%(retraces)d retraces" % r["serve"])
+EOF
+
 echo "== chaos_smoke: static-analysis lane (tools/lint.sh)"
 bash "$REPO/tools/lint.sh"
 

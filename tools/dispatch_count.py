@@ -35,6 +35,10 @@ EXCHANGE_BUDGET = 4
 # launch, regardless of N — and a single compiled step is one launch
 COMPILED_WINDOW_BUDGET = 2
 COMPILED_STEP_BUDGET = 2
+# ISSUE 9: the serving micro-batcher launches exactly ONE device program
+# per dispatched batch (pad on host, jit launch, async scatter) — and
+# after warmup every launch must hit the AOT bucket table (0 retraces)
+SERVE_BATCH_BUDGET = 1
 
 
 def run_exchange(n_keys=40):
@@ -139,6 +143,58 @@ def run_compiled(n_steps=4, hidden_layers=6, hidden=16):
     }
 
 
+def run_serve(n_requests=24, rows_per_request=2, max_batch=8):
+    """ISSUE 9 acceptance: a coalesced serving batch costs ONE device
+    dispatch regardless of how many requests ride it, every dispatch
+    hits the pre-warmed AOT bucket table (bucket_hits == batches), and
+    serve time pays ZERO retraces.  The batcher starts AFTER the burst
+    is queued so the coalescing plan — ceil(rows/max_batch) batches —
+    is deterministic, not a race against submission speed."""
+    import numpy as np
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.engine import engine
+    from mxnet_tpu.serve import Batcher, BucketTable, ModelHost, Servable
+    from mxnet_tpu.serve.demo import DEMO_IN, demo_block, demo_example
+
+    host = ModelHost()
+    sv = Servable(demo_block(), version=1,
+                  buckets=BucketTable([1, 2, 4, max_batch]))
+    host.deploy(sv, example=demo_example())
+    batcher = Batcher(host, max_batch=max_batch, max_delay_us=0,
+                      queue_cap=n_requests * rows_per_request,
+                      autostart=False)
+    rng = np.random.RandomState(0)
+    retraces0, hits0 = sv.retraces, sv.bucket_hits
+    batches0 = telemetry.registry.value("serve.batches")
+    c0 = engine.dispatch_count
+    pendings = [batcher.submit(
+        [rng.randn(rows_per_request, DEMO_IN).astype(np.float32)])
+        for _ in range(n_requests)]
+    batcher.start()
+    for p in pendings:
+        p.result(timeout=60)
+    batcher.close()
+    dispatches = engine.dispatch_count - c0
+    batches = telemetry.registry.value("serve.batches") - batches0
+    total_rows = n_requests * rows_per_request
+    want_batches = -(-total_rows // max_batch)     # ceil
+    return {
+        "requests": n_requests,
+        "rows": total_rows,
+        "batches": batches,
+        "expected_batches": want_batches,
+        "dispatches": dispatches,
+        "dispatches_per_batch": round(dispatches / max(1, batches), 2),
+        "bucket_hits": sv.bucket_hits - hits0,
+        "retraces": sv.retraces - retraces0,
+        "batch_budget": SERVE_BATCH_BUDGET,
+        "ok": bool(batches == want_batches
+                   and dispatches == batches * SERVE_BATCH_BUDGET
+                   and sv.bucket_hits - hits0 == batches
+                   and sv.retraces == retraces0),
+    }
+
+
 def run(steps=3, hidden_layers=6, hidden=16):
     """Measured eager fit; returns the report dict (no printing)."""
     import numpy as np
@@ -207,6 +263,10 @@ def main():
     ap.add_argument("--compiled", action="store_true",
                     help="also pin the ISSUE 7 compiled-step budget: 1-2 "
                          "dispatches per N-step scan window")
+    ap.add_argument("--serve", action="store_true",
+                    help="also pin the ISSUE 9 serving budget: 1 device "
+                         "dispatch per coalesced micro-batch, all "
+                         "bucket-table hits, 0 serve-time retraces")
     ap.add_argument("--scan", type=int, default=0,
                     help="scan window size for --compiled "
                          "(default: MX_STEP_SCAN, else 4)")
@@ -225,6 +285,9 @@ def main():
         n_steps = args.scan or scan_window() or 4
         report["compiled"] = run_compiled(n_steps=max(1, n_steps))
         report["ok"] = bool(report["ok"] and report["compiled"]["ok"])
+    if args.serve:
+        report["serve"] = run_serve()
+        report["ok"] = bool(report["ok"] and report["serve"]["ok"])
     print(json.dumps(report, indent=2))
     sys.exit(0 if report["ok"] else 1)
 

@@ -56,6 +56,20 @@ HOT_PATH_ROOTS: List[Tuple[str, List[str]]] = [
      ["phase", "note_step", "heartbeat_payload", "rpc_span",
       "Span.*", "_PhaseSpan.*", "FlightRecorder.record",
       "Counter.*", "Gauge.*", "Histogram.*"]),
+    # the serving batcher's dispatch loop (ISSUE 9): a host sync between
+    # dequeue and dispatch serializes the whole fleet's latency — the
+    # scatter-side device→host read belongs on the handler threads
+    # (_Pending.result/_Batch.host), never in the loop.  The
+    # tests/test_mxlint.py reinjection test proves a blocking host read
+    # reintroduced into the loop trips this entry.
+    ("mxnet_tpu/serve/batcher.py",
+     ["Batcher._loop", "Batcher._collect", "Batcher._dispatch",
+      "Batcher.submit"]),
+    # the servable dispatch path is the other side of the batcher's hot
+    # edge (file-local analysis never follows imports)
+    ("mxnet_tpu/serve/servable.py",
+     ["Servable.dispatch", "Servable.program", "Servable.signature_of",
+      "ModelHost.active"]),
 ]
 
 _SYNC_ATTRS = {"asnumpy", "asscalar", "item", "wait_to_read", "tolist"}

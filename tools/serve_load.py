@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""serve_load.py — drive a serving fleet and verify every answer.
+
+The client side of the serving chaos lane (tools/chaos_smoke.sh) and a
+handy manual load CLI.  Sends N PREDICT requests at the fleet through
+one sticky :class:`mxnet_tpu.serve.ServeClient` (failover exercises the
+SEQ retry + replica rotation), checks every response against a LOCAL
+eager forward of the deterministic demo model — correctness, not just
+arrival — and reports a JSON summary.
+
+``--chaos`` additionally asserts the kill-one-replica story end to end:
+
+  * every request got a (correct) response — zero lost in-flight
+    requests across the crash;
+  * at least one client failover happened (the fault actually fired);
+  * after the load, EVERY replica answers a pinned HEALTH probe — i.e.
+    the supervisor restarted the crashed one and it is serving again.
+
+``--stop`` sends the wire STOP to every replica at the end so the
+supervised job (launch.py) drains and exits 0.
+"""
+import argparse
+import json
+import os
+import socket
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("MX_FORCE_CPU", "1")
+
+
+def wait_up(addrs, timeout=90.0):
+    deadline = time.monotonic() + timeout
+    pending = list(addrs)
+    while pending and time.monotonic() < deadline:
+        addr = pending[0]
+        host, port = addr.rsplit(":", 1)
+        try:
+            socket.create_connection((host, int(port)),
+                                     timeout=0.5).close()
+            pending.pop(0)
+        except OSError:
+            time.sleep(0.2)
+    if pending:
+        raise SystemExit("serve_load: replicas never came up: %s"
+                         % pending)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--addrs", required=True,
+                    help="comma-separated replica addresses host:port")
+    ap.add_argument("--requests", type=int, default=120)
+    ap.add_argument("--rows", type=int, default=2,
+                    help="rows per request")
+    ap.add_argument("--chaos", action="store_true",
+                    help="assert failover happened and every replica "
+                         "serves again afterwards")
+    ap.add_argument("--stop", action="store_true",
+                    help="send STOP to every replica at the end")
+    ap.add_argument("--timeout", type=float, default=20.0)
+    args = ap.parse_args()
+
+    import numpy as np
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serve import ServeClient
+    from mxnet_tpu.serve.demo import demo_block, demo_expected
+
+    addrs = [a.strip() for a in args.addrs.split(",") if a.strip()]
+    wait_up(addrs)
+    net = demo_block()                      # local truth for verification
+    cli = ServeClient(addrs, timeout=args.timeout)
+    rng = np.random.RandomState(0)
+    ok, t0 = 0, time.perf_counter()
+    for i in range(args.requests):
+        x = rng.randn(args.rows, 16).astype(np.float32)
+        version, outs = cli.predict([x])
+        np.testing.assert_allclose(
+            outs[0], demo_expected(x, net=net), rtol=1e-4, atol=1e-5,
+            err_msg="request %d (servable v%d) answered WRONG values"
+                    % (i, version))
+        ok += 1
+    wall = time.perf_counter() - t0
+    failovers = telemetry.registry.value("serve.client_failovers")
+
+    restarted = []
+    if args.chaos:
+        assert ok == args.requests, \
+            "lost requests: %d/%d answered" % (ok, args.requests)
+        assert failovers >= 1, \
+            "no failover happened - did the chaos fault fire?"
+        # the supervisor must have brought the dead replica back: every
+        # replica answers a PINNED health probe (the restarted one needs
+        # its warmup window, covered by the client's retry deadline)
+        for i in range(len(addrs)):
+            h = cli.health(idx=i)
+            assert h.get("status") == "serving", (i, h)
+            restarted.append(h.get("pid"))
+    if args.stop:
+        cli.stop()
+    cli.close()
+    print(json.dumps({
+        "requests": args.requests,
+        "answered": ok,
+        "failovers": failovers,
+        "requests_per_sec": round(ok / wall, 2),
+        "replica_pids": restarted,
+    }))
+    print("SERVE_LOAD_OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
