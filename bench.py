@@ -62,6 +62,23 @@ def _device_peak_tflops():
     return 197.0
 
 
+def _census_report(max_programs=40):
+    """Program-census block every bench lane embeds (ISSUE 10): the
+    roll-up the regression sentinel gates on (total compile seconds,
+    peak temp bytes, retrace count) plus the per-program table, largest
+    compile first."""
+    from mxnet_tpu import programs
+    table = programs.program_table()
+    ranked = sorted(table.values(),
+                    key=lambda t: -t["compile_seconds"]["total"])
+    dropped = max(0, len(ranked) - max_programs)
+    out = {"summary": programs.program_summary(),
+           "programs": {t["name"]: t for t in ranked[:max_programs]}}
+    if dropped:
+        out["programs_truncated"] = dropped
+    return out
+
+
 def _timed_steps(step, scan, warmup, iters, dev_batch, host_batch):
     """Measure `iters` steps; per-step dispatch loop by default, ONE
     k-step jit (TrainStep.run_steps) with --scan.  In scan mode the first
@@ -287,13 +304,16 @@ def run_eager_bench():
     for _ in range(warmup):
         loss = step()
     sync()
-    c0 = engine.dispatch_count
+    # ISSUE 10: ONE consistent counter read (snapshot), not racy
+    # property-by-property reads mid-step
+    snap0 = engine.snapshot()
     t0 = time.perf_counter()
     for _ in range(iters):
         loss = step()
     sync()
     dt = time.perf_counter() - t0
-    dispatches = (engine.dispatch_count - c0) / iters
+    dispatches = (engine.snapshot()["dispatches"]
+                  - snap0["dispatches"]) / iters
     img_per_sec = batch * iters / dt
 
     # ISSUE 8: telemetry snapshot + measured overhead.  The loop above
@@ -364,6 +384,9 @@ def run_eager_bench():
         "dispatch_bound": _dispatch_bound_compare(),
         # ISSUE 8: per-phase step breakdown + measured span overhead
         "telemetry": telemetry_report,
+        # ISSUE 10: per-program compile-cost/memory table + the roll-up
+        # tools/bench_compare.py appends to BENCH_HISTORY.jsonl and gates
+        "census": _census_report(),
     }))
 
 
@@ -538,14 +561,14 @@ def run_exchange_bench():
         kv.push(keys, vlists)                       # warm (compile)
         kv.pull(keys, vlists)
         grads[0].wait_to_read()
-        w0 = engine.wire_bytes
+        w0 = engine.snapshot()["wire_bytes"]
         t0 = time.perf_counter()
         for _ in range(iters):
             kv.push(keys, vlists)
             kv.pull(keys, vlists)
         grads[0].wait_to_read()
         dt = time.perf_counter() - t0
-        wire_mb = (engine.wire_bytes - w0) / iters / (1 << 20)
+        wire_mb = (engine.snapshot()["wire_bytes"] - w0) / iters / (1 << 20)
         per_mode[mode] = {"ms_per_step": round(dt / iters * 1e3, 2),
                           "wire_mb_per_step": round(wire_mb, 3)}
     fp32_mb = per_mode["fp32"]["wire_mb_per_step"]
@@ -770,6 +793,10 @@ def run_serve_bench(rate=None, duration=None, senders=12):
         "phases": {k: v for k, v in telemetry.phase_snapshot().items()
                    if k in ("queue_wait", "pad", "serve_dispatch",
                             "scatter")},
+        # ISSUE 10: the serve lane's program census — every bucket
+        # program with compile time and (where the backend provides it)
+        # memory/cost metadata
+        "census": _census_report(),
     }
     stop_ev.set()
     print(json.dumps(report))

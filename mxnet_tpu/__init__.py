@@ -27,6 +27,7 @@ from .device import (Context, Device, cpu, gpu, tpu, cpu_pinned, num_gpus,
                      tpu_memory_info, gpu_memory_info)
 from . import runtime
 from . import engine
+from . import programs
 from . import ops
 from . import ndarray
 from . import ndarray as nd

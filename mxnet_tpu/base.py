@@ -104,6 +104,9 @@ ENV_CATALOG: Dict[str, Any] = {
     "MX_SERVE_PORT": ("9700", "Port a serving replica binds (python -m mxnet_tpu.serve); with --port-base under the launcher each rank serves on port-base + MX_PROCESS_ID."),
     "MX_SERVE_ROOTS": ("", "Comma-separated serving replica addresses host:port the ServeClient connects to; the client sticks to one replica and fails over to the next on a connection error or timeout (SEQ retry makes the replay safe)."),
     "MX_SERVE_TIMEOUT": ("30", "Seconds a serving client waits for one PREDICT reply (queue wait + dispatch included) before treating the replica as dead and failing over; also the server-side bound on a request waiting out its batch future."),
+    "MX_PROGRAM_CENSUS": ("1", "XLA program census (mxnet_tpu/programs.py): 1 (default) routes every jit-creation site through the process-wide program registry - per-program compile-time histograms (program_compile_seconds{program}), XLA memory_analysis/cost_analysis metadata (program_temp_bytes/program_flops, where the backend provides them), retrace counts with a structured retrace-explainer diff (which arg's shape/dtype/tree structure changed), and the jax.live_arrays() device-buffer census bucketed by owner (params/optimizer_state/ef_residuals/serve/other) riding flight-recorder records and crash dumps.  0 makes register_program a plain jax.jit and disables the census."),
+    "MX_LEAK_WARN_BYTES": ("67108864", "Buffer-census leak detector threshold: when total live device bytes grow monotonically across consecutive census checks by more than this many bytes, the census_leak_bytes gauge latches the streak, census.leak_trips increments and a warning names the growing owner buckets.  Any shrink resets the streak; 0 disables the trip (gauges still publish)."),
+    "MX_BENCH_HISTORY": ("", "Path of the bench-trajectory history file tools/bench_compare.py appends each bench.py run to and gates regressions against (>10% throughput or >15% peak-temp-bytes vs the rolling best per metric); empty uses BENCH_HISTORY.jsonl next to bench.py."),
 }
 
 

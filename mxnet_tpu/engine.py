@@ -64,6 +64,13 @@ class Engine:
         self._c_steps = _telemetry.registry.counter(
             "engine.compiled_steps",
             doc="optimizer steps covered by compiled windows")
+        # ISSUE 10: one mutation lock over the counter group so
+        # snapshot() returns a CONSISTENT view — count_step_window bumps
+        # three counters; a reader between the bumps used to see windows
+        # advanced but steps not.  Order: _snap_lock -> counter leaf
+        # lock, always (acyclic).
+        import threading
+        self._snap_lock = threading.Lock()
 
     def track(self, chunk) -> None:
         self._live.add(chunk)
@@ -77,7 +84,8 @@ class Engine:
 
     @dispatch_count.setter
     def dispatch_count(self, v: int) -> None:
-        self._c_dispatch.set(int(v))
+        with self._snap_lock:           # resets respect snapshot() too
+            self._c_dispatch.set(int(v))
 
     @property
     def wire_bytes(self) -> int:
@@ -85,7 +93,8 @@ class Engine:
 
     @wire_bytes.setter
     def wire_bytes(self, v: int) -> None:
-        self._c_wire.set(int(v))
+        with self._snap_lock:
+            self._c_wire.set(int(v))
 
     @property
     def compiled_step_windows(self) -> int:
@@ -93,7 +102,8 @@ class Engine:
 
     @compiled_step_windows.setter
     def compiled_step_windows(self, v: int) -> None:
-        self._c_windows.set(int(v))
+        with self._snap_lock:
+            self._c_windows.set(int(v))
 
     @property
     def compiled_steps(self) -> int:
@@ -101,24 +111,45 @@ class Engine:
 
     @compiled_steps.setter
     def compiled_steps(self, v: int) -> None:
-        self._c_steps.set(int(v))
+        with self._snap_lock:
+            self._c_steps.set(int(v))
 
     def count_dispatch(self, n: int = 1) -> None:
         """Note `n` device-program dispatches (hot path: one counter add)."""
-        self._c_dispatch.inc(n)
+        with self._snap_lock:
+            self._c_dispatch.inc(n)
 
     def count_step_window(self, steps: int, dispatches: int = 1) -> None:
         """Note one compiled N-step window: `steps` optimizer steps
         executed under `dispatches` device launches (the window dispatch,
         plus any host->device input transfer the caller counts)."""
-        self._c_dispatch.inc(int(dispatches))
-        self._c_windows.inc(1)
-        self._c_steps.inc(int(steps))
+        with self._snap_lock:
+            self._c_dispatch.inc(int(dispatches))
+            self._c_windows.inc(1)
+            self._c_steps.inc(int(steps))
 
     def count_wire_bytes(self, n: int) -> None:
         """Note `n` gradient-exchange wire bytes (hot path: one counter
         add)."""
-        self._c_wire.inc(int(n))
+        with self._snap_lock:
+            self._c_wire.inc(int(n))
+
+    def snapshot(self) -> dict:
+        """ONE consistent view of the step-accounting counter group
+        (ISSUE 10 satellite): dispatches, wire bytes, compiled windows/
+        steps taken under the same mutation lock every count_* helper
+        holds — bench/tools read this instead of several properties
+        racily mid-step — plus the program-registry size."""
+        with self._snap_lock:
+            snap = {
+                "dispatches": self._c_dispatch.value,
+                "wire_bytes": self._c_wire.value,
+                "compiled_step_windows": self._c_windows.value,
+                "compiled_steps": self._c_steps.value,
+            }
+        from . import programs as _programs
+        snap["programs"] = _programs.program_count()
+        return snap
 
     # -- engine type -------------------------------------------------------
     @property

@@ -345,10 +345,20 @@ def _rebuild(template, leaves: List[Any], pos: List[int]):
 
 # Single process-wide backward executor: applies a vjp Partial to cotangents.
 # The Partial's static structure is fixed per forward-trace, so this jit hits
-# its cache every step (one XLA executable per cached graph).
-@jax.jit
-def _apply_vjp(vjp_fn, cotangents):
+# its cache every step (one XLA executable per cached graph).  Light-mode
+# census (ISSUE 10): keeps jax.jit's C++ dispatch on the per-backward hot
+# path while the program registry counts its (re)traces.
+def _apply_vjp_body(vjp_fn, cotangents):
     return vjp_fn(cotangents)
+
+
+def _make_apply_vjp():
+    from ..programs import register_program
+    return register_program("hybrid.apply_vjp", _apply_vjp_body,
+                            mode="light")
+
+
+_apply_vjp = _make_apply_vjp()
 
 
 class _CacheEntry:
@@ -518,8 +528,9 @@ class HybridBlock(Block):
                     mutated_vals.append(nd._jax)
             return tuple(o._jax for o in out_leaves), tuple(mutated_vals)
 
+        from ..programs import register_program
+        pname = "hybrid.%s" % type(self).__name__
         if recording:
-            @jax.jit
             def fwd_train(t_vals, f_vals, rng, in_vals):
                 def f(tv, iv):
                     return run(tv, f_vals, rng, iv)
@@ -527,9 +538,11 @@ class HybridBlock(Block):
                                                 has_aux=True)
                 return outs, vjp_fn, mutated
 
-            entry.fwd_train = fwd_train
+            entry.fwd_train = register_program(pname + ".train",
+                                               fwd_train, mode="light")
         else:
-            entry.fwd_infer = jax.jit(run)
+            entry.fwd_infer = register_program(pname + ".infer", run,
+                                               mode="light")
         return entry
 
     # -- export (symbol.json + params artifact) -----------------------------

@@ -72,6 +72,19 @@ def _shape_complete(shape) -> bool:
         d is not None and int(d) > 0 for d in shape)
 
 
+def _param_census_arrays(p):
+    """One parameter's live device buffers (data + grad, every ctx copy)
+    for the buffer census."""
+    out = []
+    for store in (p._data, p._grad):
+        if store:
+            for nd in store.values():
+                a = getattr(nd, "_jax", None)
+                if a is not None:
+                    out.append(a)
+    return out
+
+
 class Parameter:
     """A weight/bias/state of a Block (reference: gluon.Parameter).
 
@@ -107,6 +120,10 @@ class Parameter:
         self._grad: Optional["OrderedDict[Context, NDArray]"] = None
         self._deferred_init = None    # (init, ctx_list, default_init)
         self._structural_name = None  # set by Block registration walk
+        # buffer-census attribution (ISSUE 10): every live param/grad
+        # device buffer is claimed by the "params" owner bucket
+        from .. import programs as _programs
+        _programs.track_buffers("params", self, _param_census_arrays)
 
     # -- identity ----------------------------------------------------------
     @property

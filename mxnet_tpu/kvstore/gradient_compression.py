@@ -82,6 +82,13 @@ class GradientCompression:
         self.threshold = float(threshold)
         self.block = int(block) if block else _qops.grad_compress_block()
         self._residuals: Dict = {}
+        # buffer-census attribution (ISSUE 10): device-resident error-
+        # feedback residuals land in "ef_residuals"
+        from .. import programs as _programs
+        _programs.track_buffers(
+            "ef_residuals", self,
+            lambda gc: [a for a in list(gc._residuals.values())
+                        + list(gc._pinned.values()) if a is not None])
         # wire keys whose PRE-quantize residual must stay restorable (the
         # overlap session's relaunch path): quantization for a pinned key
         # runs donation-FREE so the checkpointed buffer remains valid on

@@ -49,12 +49,22 @@ def _key(k):
     return k if isinstance(k, int) else str(k)
 
 
-@jax.jit
-def _sum_arrays(arrays):
+def _sum_arrays_body(arrays):
     out = arrays[0]
     for a in arrays[1:]:
         out = out + a
     return out
+
+
+def _make_sum_arrays():
+    # light mode: this runs per KEY per push on the eager exchange —
+    # jax.jit's C++ dispatch stays; a trivial add-reduction's
+    # memory_analysis is not worth a per-dispatch Python signature walk
+    from ..programs import register_program
+    return register_program("kvstore.sum", _sum_arrays_body, mode="light")
+
+
+_sum_arrays = _make_sum_arrays()
 
 
 class KVStore:
@@ -704,9 +714,16 @@ class KVStoreICI(KVStoreLocal):
         key = (x.shape, str(x.dtype))
         fn = self._xsum_cache.get(key)
         if fn is None:
-            fn = jax.jit(lambda y: jnp.sum(y, axis=0),
-                         in_shardings=NamedSharding(mesh, P("dp")),
-                         out_shardings=NamedSharding(mesh, P()))
+            # light census: explicit shardings make AOT lowering here
+            # depend on the global mesh layout; plain jit dispatch
+            # keeps the collective path untouched while the registry
+            # still counts its (re)traces and compile time
+            from ..programs import register_program
+            fn = register_program(
+                "kvstore.cross_sum", lambda y: jnp.sum(y, axis=0),
+                mode="light",
+                in_shardings=NamedSharding(mesh, P("dp")),
+                out_shardings=NamedSharding(mesh, P()))
             self._xsum_cache[key] = fn
         shard = jax.device_put(x[None], self._home_dev)
         stacked = jax.make_array_from_single_device_arrays(
@@ -760,11 +777,14 @@ class KVStoreICI(KVStoreLocal):
         key = ("q8sum", q.shape, scales.shape)
         fn = self._xsum_cache.get(key)
         if fn is None:
-            fn = jax.jit(_qops._dequant_sum_requant_kernel,
-                         in_shardings=(NamedSharding(mesh, P("dp")),
-                                       NamedSharding(mesh, P("dp"))),
-                         out_shardings=(NamedSharding(mesh, P()),
-                                        NamedSharding(mesh, P())))
+            from ..programs import register_program
+            fn = register_program(
+                "kvstore.q8_cross_sum",
+                _qops._dequant_sum_requant_kernel, mode="light",
+                in_shardings=(NamedSharding(mesh, P("dp")),
+                              NamedSharding(mesh, P("dp"))),
+                out_shardings=(NamedSharding(mesh, P()),
+                               NamedSharding(mesh, P())))
             self._xsum_cache[key] = fn
         def _stack(x):
             shard = jax.device_put(x[None], self._home_dev)

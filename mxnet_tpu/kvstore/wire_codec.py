@@ -29,7 +29,8 @@ import numpy as _np
 
 __all__ = ["is_wire_payload", "encode_wire", "decode_wire",
            "pack_2bit", "unpack_2bit",
-           "is_array_payload", "encode_array", "decode_array"]
+           "is_array_payload", "encode_array", "decode_array",
+           "is_text_payload", "encode_text", "decode_text"]
 
 _WIRE_TAG = "QGRAD"
 _ARR_TAG = "NPX"
@@ -60,6 +61,27 @@ def decode_array(obj) -> _np.ndarray:
     _, shape, dtype, raw = obj
     return _np.frombuffer(raw, dtype=_np.dtype(dtype)).reshape(
         shape).copy()
+
+
+_TXT_TAG = "TXT"
+
+
+def is_text_payload(obj) -> bool:
+    return isinstance(obj, tuple) and len(obj) == 2 and obj[0] == _TXT_TAG
+
+
+def encode_text(text: str) -> tuple:
+    """A (possibly large) text blob as a compact picklable tuple —
+    ``(TXT, utf8_bytes)``.  The serving METRICS verb ships its
+    Prometheus snapshot this way so the exposition crosses the wire as
+    one bytes payload, not a python str pickle."""
+    return (_TXT_TAG, str(text).encode("utf-8"))
+
+
+def decode_text(obj) -> str:
+    if not is_text_payload(obj):
+        raise ValueError("not a TXT payload: %r" % (type(obj),))
+    return obj[1].decode("utf-8")
 
 
 def is_wire_payload(obj) -> bool:

@@ -208,9 +208,19 @@ class CompositeEvalMetric(EvalMetric):
         return (names, values)
 
 
+def _census(name):
+    """Light-mode program-census wrapper for the device metric kernels
+    (ISSUE 10): jax.jit dispatch stays on the hot accumulate path, the
+    registry sees each kernel's (re)trace count and compile time."""
+    def deco(fn):
+        from .programs import register_program
+        return register_program(name, fn, mode="light")
+    return deco
+
+
 @functools.lru_cache(maxsize=None)
 def _acc_kernel(axis):
-    @jax.jit
+    @_census("metric.accuracy")
     def k(s, n, pred, label):
         if pred.ndim > label.ndim:
             pred = jnp.argmax(pred, axis=axis)
@@ -378,7 +388,7 @@ class MCC(EvalMetric):
 
 @functools.lru_cache(maxsize=None)
 def _ppl_kernel(ignore_label):
-    @jax.jit
+    @_census("metric.perplexity")
     def k(s, n, pred, label):
         p = pred.reshape(-1, pred.shape[-1]).astype(jnp.float32)
         l = label.reshape(-1).astype(jnp.int32)
@@ -440,7 +450,7 @@ class Perplexity(EvalMetric):
 
 @functools.lru_cache(maxsize=None)
 def _regression_kernel(squared):
-    @jax.jit
+    @_census("metric.mse" if squared else "metric.mae")
     def k(s, n, label, pred):
         if label.ndim == 1:
             label = label.reshape(-1, 1)
@@ -508,7 +518,7 @@ class RMSE(MSE):
 
 @functools.lru_cache(maxsize=None)
 def _ce_kernel(eps):
-    @jax.jit
+    @_census("metric.cross_entropy")
     def k(s, n, label, pred):
         l = label.reshape(-1).astype(jnp.int32)
         prob = jnp.take_along_axis(pred.astype(jnp.float32), l[:, None],
@@ -567,7 +577,7 @@ class PearsonCorrelation(EvalMetric):
             self.num_inst += 1
 
 
-@jax.jit
+@_census("metric.loss")
 def _loss_kernel(s, n, pred):
     return s + pred.sum().astype(jnp.float32), n + pred.size
 

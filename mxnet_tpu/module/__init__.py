@@ -460,6 +460,25 @@ class BaseModule:
                                      name, val)
 
 
+def _module_census_arrays(mod):
+    """A bound Module's parameter/aux/grad device buffers for the
+    buffer census ("params" owner; data/label slots stay unclaimed)."""
+    ex = getattr(mod, "_exec", None)
+    if ex is None:
+        return []
+    out = []
+    for name in getattr(mod, "_param_names", ()) or ():
+        for store in (ex.arg_dict, ex.grad_dict):
+            a = getattr(store.get(name), "_jax", None)
+            if a is not None:
+                out.append(a)
+    for name in getattr(mod, "_aux_names", ()) or ():
+        a = getattr(ex.aux_dict.get(name), "_jax", None)
+        if a is not None:
+            out.append(a)
+    return out
+
+
 def _as_list(x):
     return x if isinstance(x, (list, tuple)) else [x]
 
@@ -674,6 +693,11 @@ class Module(BaseModule):
             self._context, args, grads,
             grad_req if for_training else "null", aux,
             group2ctx=self._group2ctx)
+        # buffer-census attribution (ISSUE 10): a Module's weights live
+        # in its executor's arg/aux/grad dicts, not gluon Parameters —
+        # claim them for the "params" owner bucket
+        from .. import programs as _programs
+        _programs.track_buffers("params", self, _module_census_arrays)
         if shared_exec is not None:
             for aname in self._aux_names:
                 if aname in shared_exec.aux_dict:
@@ -866,7 +890,10 @@ class Module(BaseModule):
                         else:
                             outs.append(core(z, lab, attrs)[0])
                     return tuple(outs)
-            step = jax.jit(step)
+            from ..programs import register_program
+            step = register_program(
+                "module.step_train" if is_train else "module.step_infer",
+                step)
             self._jit_step[key] = step
             self._jit_ok = True
 
@@ -1242,7 +1269,9 @@ class Module(BaseModule):
             return (new_diff, tuple(new_states), tuple(new_w32), aux_new,
                     tuple(outs), new_mstate)
 
-        return jax.jit(_traced_fit_step, donate_argnums=(0, 2, 3))
+        from ..programs import register_program
+        return register_program("module.fit_step", _traced_fit_step,
+                                donate_argnums=(0, 2, 3))
 
     def update(self):
         """Reference: Module.update — updater over (grad, weight) pairs,

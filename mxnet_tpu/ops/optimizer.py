@@ -867,7 +867,9 @@ def tree_body(kind):
 def _tree_jit(kind, statics, donate):
     body, donatable = _TREE_BODIES[kind]
     fn = functools.partial(body, **dict(statics))
-    return jax.jit(fn, donate_argnums=donatable if donate else ())
+    from ..programs import register_program
+    return register_program("optimizer.fused_%s" % kind, fn,
+                            donate_argnums=donatable if donate else ())
 
 
 def tree_apply(kind, arrays, lrs, decays=None, **static_params):

@@ -435,7 +435,10 @@ def _jitted(name, fn, donate=()):
     key = (name, donate)
     hit = _jit_cache.get(key)
     if hit is None:
-        hit = jax.jit(fn, donate_argnums=donate)
+        from ..programs import register_program
+        parts = name if isinstance(name, tuple) else (name,)
+        pname = "quant." + "_".join(str(p) for p in parts)
+        hit = register_program(pname, fn, donate_argnums=donate)
         _jit_cache[key] = hit
     return hit
 

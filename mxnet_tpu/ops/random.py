@@ -20,7 +20,15 @@ from .registry import register
 
 _state = threading.local()
 _DEFAULT_SEED = 0
-_fold_in_jit = jax.jit(jax.random.fold_in)
+
+
+def _make_fold_in():
+    from ..programs import register_program
+    return register_program("random.fold_in", jax.random.fold_in,
+                            mode="light")
+
+
+_fold_in_jit = _make_fold_in()
 
 
 def _root():

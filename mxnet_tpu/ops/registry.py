@@ -191,7 +191,13 @@ def _freeze(v: Any):
 def _jitted(name: str, frozen_params) -> Callable:
     op = _REGISTRY[name]
     params = dict(frozen_params)
-    return jax.jit(functools.partial(op.fn, **params))
+    # light-mode census (ISSUE 10): jax.jit keeps its C++ dispatch on
+    # this hottest of paths; the registry still sees every op program's
+    # (re)trace count and bracketed compile time as `op.<name>`
+    from ..programs import register_program
+    return register_program("op." + op.name,
+                            functools.partial(op.fn, **params),
+                            mode="light")
 
 
 def cached_jit(name: str, params: Dict[str, Any]) -> Callable:

@@ -872,6 +872,18 @@ class Test(Optimizer):
         state[:] = weight
 
 
+def _updater_census_arrays(u):
+    """One updater's live slot-state device buffers for the census."""
+    import jax as _jax
+    out = []
+    for st in u.states.values():
+        for leaf in _jax.tree_util.tree_leaves(st):
+            a = getattr(leaf, "_jax", leaf)
+            if hasattr(a, "nbytes"):
+                out.append(a)
+    return out
+
+
 class Updater:
     """Apply an optimizer to (index, grad, weight) triples — the kvstore
     server-side hook (reference: get_updater / class Updater).
@@ -886,6 +898,11 @@ class Updater:
         self.optimizer = optimizer
         self.states: Dict[Any, Any] = {}
         self.states_synced: Dict[Any, bool] = {}
+        # buffer-census attribution (ISSUE 10): slot state (momenta,
+        # adam moments, fp32 masters) lands in "optimizer_state"
+        from .. import programs as _programs
+        _programs.track_buffers("optimizer_state", self,
+                                _updater_census_arrays)
 
     @property
     def aggregate_updates(self):

@@ -187,8 +187,10 @@ class TrainStep:
 
         self._step_fn = step
         self._donate = donate
-        self._step = jax.jit(
-            step, donate_argnums=(0, 1) if donate else ())
+        from ..programs import register_program
+        self._step = register_program(
+            "mesh.train_step", step, mode="light",
+            donate_argnums=(0, 1) if donate else ())
         self._multi = {}
 
     def shard_batch(self, *arrays):
@@ -222,8 +224,10 @@ class TrainStep:
                 return lax.fori_loop(
                     0, k, body,
                     (params, opt_state, jnp.zeros((), jnp.float32)))
-            self._multi[k] = jax.jit(
-                multi, donate_argnums=(0, 1) if self._donate else ())
+            from ..programs import register_program
+            self._multi[k] = register_program(
+                "mesh.train_window", multi, mode="light",
+                donate_argnums=(0, 1) if self._donate else ())
         self.params, self.opt_state, loss = self._multi[k](
             self.params, self.opt_state, *batch)
         return loss

@@ -1,0 +1,709 @@
+"""XLA program census: per-program compile-cost/memory accounting, a
+retrace explainer, and a device-buffer census (ISSUE 10 tentpole).
+
+The runtime could already trace *time* (telemetry spans) and *wire
+bytes*, but its ~10 scattered ``jax.jit`` sites compiled blind: nothing
+recorded compile latency, XLA cost, or device-memory footprint, and the
+ROADMAP's FSDP acceptance ("per-chip memory dropping ~linearly with the
+fsdp axis") was unmeasurable.  The Julia→TPU whole-program work (arxiv
+1810.09868) and TF's cost-model surfaces (arxiv 1605.08695) both treat
+compile cost and program footprint as first-class pipeline outputs —
+this module is that layer:
+
+* **Program registry** — every jit-creation site routes through
+  :func:`register_program`, which returns a :class:`Program` wrapper.
+  ``mode='aot'`` owns its executable cache explicitly
+  (``jit(fn).lower(args).compile()``), so compile wall-time is bracketed
+  exactly and the compiled object's ``memory_analysis()`` (argument /
+  output / temp / generated-code bytes) and ``cost_analysis()`` (flops,
+  bytes accessed) are captured where the backend provides them —
+  explicitly ``None`` where it does not.  ``mode='light'`` keeps
+  ``jax.jit``'s C++ dispatch for ultra-hot sites (eager per-op kernels,
+  hybridize cache) and detects (re)traces with a zero-cost trace probe;
+  compile time is the bracketed dispatch that traced.  Per-program
+  numbers feed the telemetry registry —
+  ``program_compile_seconds{program}``, ``program_temp_bytes{program}``,
+  ``program_flops{program}``, ``program_retraces{program}`` — and ride
+  the Prometheus/JSON exposition.
+
+* **Retrace explainer** — each program record keeps the last trace
+  signature (input avals + tree structure); on a retrace the structured
+  diff (which arg's shape/dtype/weak-type changed, or that the tree
+  structure itself did) is logged and recorded, so the serving
+  zero-retrace gate and CompiledStep invalidations are diagnosable
+  instead of just countable.
+
+* **Device-buffer census** — :func:`buffer_census` buckets
+  ``jax.live_arrays()`` by owner (params / optimizer_state /
+  ef_residuals / serve / other; owners self-register via
+  :func:`track_buffers`), and :class:`LeakDetector` turns step-over-step
+  monotonic growth beyond ``MX_LEAK_WARN_BYTES`` into a gauge + warning,
+  wired into the flight recorder (periodic step observer) and crash
+  dumps (telemetry crash sections).
+
+Hot-path contract (mxlint-rooted): :meth:`Program.__call__`,
+:func:`signature_of` and :meth:`ProgramRecord.note_compile` are
+dispatch-time bookkeeping only — they read shapes/avals and never sync a
+device; the census walk itself reads ``nbytes`` off live array handles
+(host metadata, no transfer) and runs only periodically / at crash time.
+"""
+from __future__ import annotations
+
+import functools
+import logging
+import threading
+import time
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+
+from .base import get_env
+from . import telemetry as _telemetry
+
+__all__ = [
+    "register_program", "Program", "ProgramRecord", "census_enabled",
+    "program_table", "program_summary", "find_record", "reset_records",
+    "signature_of", "diff_signatures",
+    "track_buffers", "buffer_census", "leak_detector", "LeakDetector",
+    "CENSUS_OWNERS",
+]
+
+logger = logging.getLogger("mxnet_tpu.programs")
+
+
+def census_enabled() -> bool:
+    """MX_PROGRAM_CENSUS (default on): program registry + buffer census."""
+    return bool(get_env("MX_PROGRAM_CENSUS", dtype=bool))
+
+
+# ---------------------------------------------------------------------------
+# Trace signatures + the retrace explainer
+# ---------------------------------------------------------------------------
+
+def _leaf_sig(x):
+    """One leaf's trace identity.  jax arrays/tracers contribute their
+    aval (shape, dtype, weak_type) plus their sharding — exactly
+    jax.jit's cache key; an AOT executable strictly rejects inputs on a
+    different device, so the device must key the cache too.
+    ndarray-likes contribute a (shape, dtype) tuple; python scalars
+    their VALUE (conservative: correct under static_argnums, and no
+    routed site passes scalars as traced operands)."""
+    aval = getattr(x, "aval", None)
+    if aval is not None:
+        return ("aval", aval, getattr(x, "sharding", None))
+    shape = getattr(x, "shape", None)
+    if shape is not None and hasattr(x, "dtype"):
+        return ("arr", tuple(int(s) for s in shape), str(x.dtype))
+    if isinstance(x, (bool, int, float, complex, str, bytes)):
+        return ("py", type(x).__name__, x)
+    return ("obj", type(x).__name__)
+
+
+def signature_of(args: tuple, kwargs: Optional[dict] = None) -> Tuple:
+    """(treedef, per-leaf identity) of a call — the program cache key.
+    Reads shapes/avals only; never touches device data."""
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs or {}))
+    return (treedef, tuple(_leaf_sig(x) for x in leaves))
+
+
+class _SigLeaf:
+    """Opaque wrapper so a stored leaf signature (which may itself be a
+    tuple) survives tree_unflatten as ONE leaf when the explainer
+    rebuilds arg paths."""
+
+    __slots__ = ("sig",)
+
+    def __init__(self, sig):
+        self.sig = sig
+
+
+def _leaf_desc(sig) -> Dict[str, Any]:
+    """Human/JSON form of one leaf signature."""
+    if isinstance(sig, tuple) and sig and sig[0] == "aval":
+        _, aval, sharding = sig
+        out = {"shape": tuple(int(s) for s in aval.shape),
+               "dtype": str(aval.dtype),
+               "weak_type": bool(getattr(aval, "weak_type", False))}
+        if sharding is not None:
+            out["device"] = str(sharding)
+        return out
+    if isinstance(sig, tuple) and sig and sig[0] == "arr":
+        return {"shape": sig[1], "dtype": sig[2], "weak_type": False}
+    if isinstance(sig, tuple) and sig and sig[0] == "py":
+        return {"py": sig[1], "value": sig[2]}
+    return {"opaque": str(sig)}
+
+
+def _paths_for(treedef, sigs) -> List[str]:
+    tree = jax.tree_util.tree_unflatten(treedef,
+                                        [_SigLeaf(s) for s in sigs])
+    flat, _ = jax.tree_util.tree_flatten_with_path(
+        tree, is_leaf=lambda x: isinstance(x, _SigLeaf))
+    return [jax.tree_util.keystr(path) for path, _ in flat]
+
+
+def diff_signatures(old: Tuple, new: Tuple) -> Optional[Dict[str, Any]]:
+    """Structured explanation of why `new` could not reuse `old`'s
+    executable: either the argument tree structure changed, or specific
+    leaves changed shape/dtype/weak-type.  None when identical."""
+    if old == new:
+        return None
+    old_td, old_sigs = old
+    new_td, new_sigs = new
+    if old_td != new_td:
+        return {"kind": "tree_structure",
+                "before": str(old_td), "after": str(new_td)}
+    paths = _paths_for(new_td, new_sigs)
+    changed = []
+    for path, a, b in zip(paths, old_sigs, new_sigs):
+        if a == b:
+            continue
+        da, db = _leaf_desc(a), _leaf_desc(b)
+        if da.get("dtype") != db.get("dtype") and \
+                da.get("shape") == db.get("shape"):
+            change = "dtype"
+        elif da.get("shape") != db.get("shape") and \
+                da.get("dtype") == db.get("dtype"):
+            change = "shape"
+        elif da.get("shape") == db.get("shape") and \
+                da.get("dtype") == db.get("dtype") and \
+                da.get("device") != db.get("device"):
+            change = "device"
+        else:
+            change = "leaf"
+        changed.append({"arg": path, "change": change,
+                        "before": da, "after": db})
+    return {"kind": "leaves", "changed": changed}
+
+
+def _format_diff(diff: Dict[str, Any]) -> str:
+    if diff["kind"] == "tree_structure":
+        return "argument tree structure changed: %s -> %s" % (
+            diff["before"], diff["after"])
+    parts = []
+    for c in diff["changed"][:8]:
+        parts.append("%s %s: %s -> %s" % (
+            c["arg"], c["change"], c["before"], c["after"]))
+    more = len(diff["changed"]) - 8
+    if more > 0:
+        parts.append("(+%d more)" % more)
+    return "; ".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# Program records
+# ---------------------------------------------------------------------------
+
+def _memory_dict(compiled) -> Optional[Dict[str, Any]]:
+    """CompiledMemoryStats → plain dict, or None where the backend does
+    not provide memory_analysis."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+    try:
+        return {
+            "argument_bytes": int(ma.argument_size_in_bytes),
+            "output_bytes": int(ma.output_size_in_bytes),
+            "temp_bytes": int(ma.temp_size_in_bytes),
+            "generated_code_bytes": int(ma.generated_code_size_in_bytes),
+            "alias_bytes": int(ma.alias_size_in_bytes),
+        }
+    except AttributeError:
+        return None
+
+
+def _cost_dict(compiled) -> Optional[Dict[str, Any]]:
+    """cost_analysis() → {flops, bytes_accessed}, or None."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    out: Dict[str, Any] = {}
+    if "flops" in ca:
+        out["flops"] = float(ca["flops"])
+    if "bytes accessed" in ca:
+        out["bytes_accessed"] = float(ca["bytes accessed"])
+    return out or None
+
+
+class ProgramRecord:
+    """Aggregated accounting for one named program (all its wrapper
+    instances and executables)."""
+
+    def __init__(self, name: str, mode: str):
+        self.name = name
+        self.mode = mode
+        self._lock = threading.Lock()
+        self.compiles = 0                 # executables built
+        self.retraces = 0                 # compiles whose signature
+        #                                   differed from the last seen
+        self.compile_seconds_total = 0.0
+        self.compile_seconds_max = 0.0
+        self.last_compile_seconds: Optional[float] = None
+        self.memory: Optional[Dict[str, Any]] = None    # latest compile's
+        self.cost: Optional[Dict[str, Any]] = None
+        self.temp_bytes_peak: Optional[int] = None
+        self.last_sig: Optional[Tuple] = None
+        self.last_retrace: Optional[Dict[str, Any]] = None
+        labels = {"program": name}
+        reg = _telemetry.registry
+        self._h_compile = reg.histogram(
+            "program_compile_seconds",
+            doc="wall-clock trace+lower+compile time per XLA program",
+            labels=labels)
+        self._c_retrace = reg.counter(
+            "program_retraces",
+            doc="program rebuilds whose input signature changed vs the "
+                "previous trace (see the retrace explainer log)",
+            labels=labels)
+        self._g_temp = reg.gauge(
+            "program_temp_bytes",
+            doc="XLA memory_analysis temp allocation of the latest "
+                "executable", labels=labels)
+        self._g_flops = reg.gauge(
+            "program_flops",
+            doc="XLA cost_analysis flops of the latest executable",
+            labels=labels)
+
+    def note_compile(self, seconds: float, sig: Tuple,
+                     compiled=None) -> None:
+        """Record one executable build: timing, optional AOT metadata,
+        and the retrace explainer's signature diff."""
+        mem = _memory_dict(compiled) if compiled is not None else None
+        cost = _cost_dict(compiled) if compiled is not None else None
+        diff = None
+        with self._lock:
+            self.compiles += 1
+            self.compile_seconds_total += seconds
+            if seconds > self.compile_seconds_max:
+                self.compile_seconds_max = seconds
+            self.last_compile_seconds = seconds
+            if self.last_sig is not None:
+                diff = diff_signatures(self.last_sig, sig)
+                if diff is not None:
+                    self.retraces += 1
+                    self.last_retrace = {"diff": diff,
+                                         "compile_seconds": seconds}
+            self.last_sig = sig
+            if mem is not None:
+                self.memory = mem
+                tb = mem["temp_bytes"]
+                if self.temp_bytes_peak is None or tb > self.temp_bytes_peak:
+                    self.temp_bytes_peak = tb
+            if cost is not None:
+                self.cost = cost
+        self._h_compile.observe(seconds)
+        if mem is not None:
+            self._g_temp.set(mem["temp_bytes"])
+        if cost is not None and "flops" in cost:
+            self._g_flops.set(cost["flops"])
+        if diff is not None:
+            self._c_retrace.inc()
+            logger.info("program %r retraced (compile %.3fs): %s",
+                        self.name, seconds, _format_diff(diff))
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "name": self.name,
+                "mode": self.mode,
+                "compiles": self.compiles,
+                "retraces": self.retraces,
+                "compile_seconds": {
+                    "total": round(self.compile_seconds_total, 6),
+                    "max": round(self.compile_seconds_max, 6),
+                    "last": None if self.last_compile_seconds is None
+                    else round(self.last_compile_seconds, 6),
+                },
+                "memory": dict(self.memory) if self.memory else None,
+                "cost": dict(self.cost) if self.cost else None,
+                "temp_bytes_peak": self.temp_bytes_peak,
+                "last_retrace": self.last_retrace,
+            }
+
+
+_records_lock = threading.Lock()
+_records: Dict[str, ProgramRecord] = {}
+
+
+def _record(name: str, mode: str) -> ProgramRecord:
+    with _records_lock:
+        rec = _records.get(name)
+        if rec is None:
+            rec = ProgramRecord(name, mode)
+            _records[name] = rec
+    return rec
+
+
+def find_record(name: str) -> Optional[ProgramRecord]:
+    with _records_lock:
+        return _records.get(name)
+
+
+def program_table() -> Dict[str, Dict[str, Any]]:
+    """{program name: record snapshot} — what bench.py embeds and crash
+    dumps carry."""
+    with _records_lock:
+        recs = list(_records.values())
+    return {rec.name: rec.snapshot() for rec in recs}
+
+
+def program_summary() -> Dict[str, Any]:
+    """Roll-up across every registered program: total compile seconds,
+    total retraces, peak temp bytes — the numbers the bench sentinel
+    gates on."""
+    table = program_table()
+    total_s = sum(t["compile_seconds"]["total"] for t in table.values())
+    peak_temp = [t["temp_bytes_peak"] for t in table.values()
+                 if t["temp_bytes_peak"] is not None]
+    return {
+        "programs": len(table),
+        "compiles": sum(t["compiles"] for t in table.values()),
+        "retraces": sum(t["retraces"] for t in table.values()),
+        "compile_seconds_total": round(total_s, 6),
+        "peak_temp_bytes": max(peak_temp) if peak_temp else None,
+    }
+
+
+def program_count() -> int:
+    with _records_lock:
+        return len(_records)
+
+
+def reset_records() -> None:
+    """Drop every record (tests).  Telemetry instruments persist —
+    readers should use fresh names or deltas."""
+    with _records_lock:
+        _records.clear()
+
+
+# ---------------------------------------------------------------------------
+# The wrapper
+# ---------------------------------------------------------------------------
+
+class Program:
+    """Census-wrapped jitted callable.
+
+    ``aot=True``: per-signature executable cache via
+    ``jit.lower(...).compile()`` — exact compile bracketing + XLA
+    memory/cost metadata.  Falls back permanently to plain jit dispatch
+    if the site cannot lower ahead-of-time (exotic shardings etc.).
+
+    ``aot=False`` (light): ``jax.jit`` keeps its C++ dispatch; a trace
+    probe inside the traced fn bumps a counter, so a dispatch that
+    traced is detected after the fact and its wall time recorded as the
+    compile cost (memory/cost stay explicitly None).
+    """
+
+    def __init__(self, name: str, mode: str, fn: Callable,
+                 jit_kw: Dict[str, Any], aot: bool):
+        self._name = name
+        self._mode = mode
+        self._record: Optional[ProgramRecord] = None
+        self._seq = 0
+        self._noted = 0     # compiles already recorded (under _cache_lock)
+
+        def _trace_probe(*a, **k):
+            # runs at TRACE time only (host side); the attribute write
+            # is the point — it marks "this dispatch compiled"
+            self._seq += 1
+            return fn(*a, **k)
+
+        functools.update_wrapper(_trace_probe, fn, updated=())
+        self._jit = jax.jit(_trace_probe, **jit_kw)
+        self._aot = aot
+        self._cache: Dict[Tuple, Any] = {}
+        self._cache_lock = threading.Lock()
+
+    @property
+    def record(self) -> ProgramRecord:
+        """Get-or-create LAZILY at first compile: a registered-but-never-
+        dispatched wrapper (e.g. a module-level kernel the workload never
+        runs) must not pollute the table with a zero-compile row."""
+        if self._record is None:
+            self._record = _record(self._name, self._mode)
+        return self._record
+
+    @property
+    def executables(self) -> int:
+        with self._cache_lock:
+            return len(self._cache)
+
+    def _compile(self, sig, args, kwargs):
+        t0 = time.perf_counter()
+        try:
+            compiled = self._jit.lower(*args, **kwargs).compile()
+        except Exception as e:
+            # this site cannot AOT-lower (e.g. layout/sharding the
+            # lowering path rejects): census degrades to light mode.
+            # The failed lower may still have TRACED (bumping the probe)
+            # — consume those bumps so the light path only counts its
+            # own subsequent trace, not phantom compiles.
+            with self._cache_lock:
+                self._noted = self._seq
+            self._aot = False
+            logger.info("programs: AOT census unavailable for %r (%s: "
+                        "%s); using plain jit dispatch",
+                        self._name, type(e).__name__, e)
+            return None
+        dt = time.perf_counter() - t0
+        with self._cache_lock:
+            kept = self._cache.setdefault(sig, compiled)
+            self._noted = self._seq     # AOT owns these probe bumps
+        if kept is compiled:
+            # two racing cold-callers both compile; the one whose
+            # executable the cache kept records the build — compiles
+            # stays exact
+            self.record.note_compile(dt, sig, compiled=kept)
+        return kept
+
+    def __call__(self, *args, **kwargs):
+        if self._aot:
+            sig = signature_of(args, kwargs)
+            compiled = self._cache.get(sig)
+            if compiled is None:
+                compiled = self._compile(sig, args, kwargs)
+            if compiled is not None:
+                return compiled(*args, **kwargs)
+        seq = self._seq
+        t0 = time.perf_counter()
+        out = self._jit(*args, **kwargs)
+        if self._seq != seq:
+            dt = time.perf_counter() - t0
+            # claim the trace under the lock: two threads dispatching
+            # concurrently both observe the bump, but only the first
+            # records it — no double-counted compiles / phantom retraces
+            with self._cache_lock:
+                claimed = self._seq - self._noted
+                self._noted = self._seq
+            for _ in range(claimed):
+                self.record.note_compile(dt, signature_of(args, kwargs))
+        return out
+
+
+def register_program(name: str, fn: Callable, mode: str = "aot",
+                     **jit_kw) -> Callable:
+    """Route one jit-creation site through the program census.
+
+    Drop-in for ``jax.jit(fn, **jit_kw)``; returns a callable.  ``name``
+    is the program's stable registry identity (wrappers sharing a name
+    aggregate into one record — e.g. every hybridize cache entry of one
+    block class).  ``mode='aot'`` for programs built once and dispatched
+    per step/batch; ``mode='light'`` for per-op hot paths.  With
+    ``MX_PROGRAM_CENSUS=0`` this is exactly ``jax.jit``.
+    """
+    if not census_enabled():
+        return jax.jit(fn, **jit_kw)
+    return Program(name, mode, fn, jit_kw, aot=(mode == "aot"))
+
+
+# ---------------------------------------------------------------------------
+# Device-buffer census
+# ---------------------------------------------------------------------------
+
+# claim priority, most specific first: a Servable's version arrays are
+# the same buffers its source block's Parameters hold — the serving
+# owner wins so a deployed version's footprint is visible as such
+CENSUS_OWNERS = ("serve", "ef_residuals", "optimizer_state", "params")
+
+_owners_lock = threading.Lock()
+# obj -> (kind, extractor(obj) -> iterable of arrays/NDArrays)
+_owners: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def track_buffers(kind: str, obj, extract: Callable) -> None:
+    """Register `obj` as a buffer owner for the census.  `extract(obj)`
+    yields its current device arrays (jax arrays or NDArray-likes) when
+    the census runs; held weakly, so owners never leak through the
+    census itself."""
+    try:
+        with _owners_lock:
+            _owners[obj] = (str(kind), extract)
+    except TypeError:
+        pass            # not weakref-able: stay uncounted ("other")
+
+
+def _owned_ids() -> Dict[str, set]:
+    with _owners_lock:
+        items = list(_owners.items())
+    by_kind: Dict[str, set] = {k: set() for k in CENSUS_OWNERS}
+    for obj, (kind, extract) in items:
+        ids = by_kind.setdefault(kind, set())
+        try:
+            arrays = extract(obj)
+        except Exception:
+            continue
+        for a in arrays or ():
+            a = getattr(a, "_jax", a)
+            if a is not None:
+                ids.add(id(a))
+    return by_kind
+
+
+def buffer_census() -> Dict[str, Any]:
+    """Bucket every live device array by owner.
+
+    Walks ``jax.live_arrays()`` host-side (array handles + nbytes
+    metadata — no device sync, no transfer) and attributes each to the
+    first owner bucket claiming its id; unclaimed arrays land in
+    ``other`` (activations in flight, test droppings, leaks)."""
+    by_kind = _owned_ids()
+    order = [k for k in CENSUS_OWNERS if k in by_kind] + \
+        [k for k in by_kind if k not in CENSUS_OWNERS]
+    out: Dict[str, Any] = {k: {"count": 0, "bytes": 0}
+                           for k in order + ["other"]}
+    total = 0
+    n = 0
+    try:
+        live = jax.live_arrays()
+    except Exception:
+        live = []
+    for a in live:
+        try:
+            if getattr(a, "is_deleted", lambda: False)():
+                continue
+            nbytes = int(a.nbytes)
+        except Exception:
+            continue
+        aid = id(a)
+        for kind in order:
+            if aid in by_kind[kind]:
+                slot = out[kind]
+                break
+        else:
+            slot = out["other"]
+        slot["count"] += 1
+        slot["bytes"] += nbytes
+        total += nbytes
+        n += 1
+    out["total_bytes"] = total
+    out["n_arrays"] = n
+    return out
+
+
+class LeakDetector:
+    """Step-over-step live-byte growth detector.
+
+    Each :meth:`check` snapshots the census, publishes per-owner
+    ``census_live_bytes{owner}`` gauges, and accumulates consecutive
+    total growth; when the streak exceeds ``MX_LEAK_WARN_BYTES`` the
+    ``census_leak_bytes`` gauge latches the streak size,
+    ``census.leak_trips`` increments and a warning names the growing
+    buckets.  Any shrink resets the streak (steady-state training
+    reuses buffers; a true leak only ever grows)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._prev_total: Optional[int] = None
+        self._prev_census: Optional[Dict[str, Any]] = None
+        self._growth = 0
+        self._tripped = False
+        reg = _telemetry.registry
+        self._g_leak = reg.gauge(
+            "census_leak_bytes",
+            doc="consecutive step-over-step live-byte growth "
+                "(0 until it exceeds MX_LEAK_WARN_BYTES)")
+        self._c_trips = reg.counter(
+            "census.leak_trips",
+            doc="times the buffer-census leak detector tripped")
+
+    def reset(self) -> None:
+        with self._lock:
+            self._prev_total = None
+            self._prev_census = None
+            self._growth = 0
+            self._tripped = False
+        self._g_leak.set(0)
+
+    def check(self) -> Dict[str, Any]:
+        census = buffer_census()
+        reg = _telemetry.registry
+        for kind, slot in census.items():
+            if isinstance(slot, dict):
+                reg.gauge("census_live_bytes",
+                          doc="live device bytes by owner bucket",
+                          labels={"owner": kind}).set(slot["bytes"])
+        try:
+            warn_bytes = int(get_env("MX_LEAK_WARN_BYTES", 64 << 20, int)
+                             or 0)
+        except (TypeError, ValueError):
+            warn_bytes = 64 << 20
+        total = census["total_bytes"]
+        growers = []
+        with self._lock:
+            if self._prev_total is not None:
+                delta = total - self._prev_total
+                if delta > 0:
+                    self._growth += delta
+                    prev = self._prev_census or {}
+                    for kind, slot in census.items():
+                        if not isinstance(slot, dict):
+                            continue
+                        before = (prev.get(kind) or {}).get("bytes", 0)
+                        if slot["bytes"] > before:
+                            growers.append(
+                                (kind, slot["bytes"] - before))
+                elif delta < 0:
+                    # only a SHRINK resets the streak — a flat plateau
+                    # between growth steps (allocator reuse) must not
+                    # hide a monotonically growing leak
+                    self._growth = 0
+                    self._tripped = False
+            self._prev_total = total
+            self._prev_census = census
+            growth = self._growth
+            tripped = warn_bytes > 0 and growth >= warn_bytes
+            first_trip = tripped and not self._tripped
+            self._tripped = tripped
+        self._g_leak.set(growth if tripped else 0)
+        if first_trip:
+            self._c_trips.inc()
+            logger.warning(
+                "buffer-census leak suspect: live bytes grew %d over "
+                "consecutive checks (MX_LEAK_WARN_BYTES=%d); growing "
+                "buckets this check: %s; census: %s",
+                growth, warn_bytes,
+                ", ".join("%s+%d" % g for g in growers) or "other",
+                {k: v for k, v in census.items() if isinstance(v, dict)})
+        return {"census": census, "growth_bytes": growth,
+                "tripped": tripped}
+
+
+leak_detector = LeakDetector()
+
+# Flight-recorder wiring: every Nth step record carries the census
+# totals + leak streak (cheap enough to ride along; a live_arrays walk
+# per step would not be).
+_CENSUS_EVERY = 16
+_census_tick = [0]
+_census_tick_lock = threading.Lock()
+
+
+def _step_census_observer() -> Optional[Dict[str, Any]]:
+    if not census_enabled():
+        return None
+    with _census_tick_lock:
+        _census_tick[0] += 1
+        due = _census_tick[0] % _CENSUS_EVERY == 1
+    if not due:
+        return None
+    chk = leak_detector.check()
+    return {"live_bytes": chk["census"]["total_bytes"],
+            "leak_bytes": chk["growth_bytes"]}
+
+
+def _crash_census() -> Dict[str, Any]:
+    return buffer_census()
+
+
+_telemetry.register_step_observer(_step_census_observer)
+_telemetry.register_crash_section("buffer_census", _crash_census)
+_telemetry.register_crash_section("programs", program_table)

@@ -29,7 +29,7 @@ from ..base import MXNetError, get_env
 from .. import fault as _fault
 from .. import telemetry as _telemetry
 from ..kvstore.server import send_msg, recv_msg
-from ..kvstore.wire_codec import decode_array, encode_array
+from ..kvstore.wire_codec import decode_array, decode_text, encode_array
 from .batcher import Overloaded
 
 __all__ = ["ServeClient"]
@@ -175,6 +175,16 @@ class ServeClient:
         if not ok:
             raise MXNetError("serve: %s" % resp)
         return resp
+
+    def metrics(self, idx: Optional[int] = None,
+                fmt: str = "prometheus") -> str:
+        """One replica's live telemetry snapshot — the Prometheus text
+        exposition (or ``fmt='json'`` registry snapshot) over the serve
+        wire, so a running fleet is scrapeable without a sidecar."""
+        ok, resp = self._rpc("METRICS", fmt, idx=idx)
+        if not ok:
+            raise MXNetError("serve: %s" % resp)
+        return decode_text(resp)
 
     def swap(self, prefix: str, epoch: int = 0,
              input_names: Sequence[str] = ("data",)) -> List[int]:

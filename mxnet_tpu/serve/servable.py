@@ -91,6 +91,12 @@ class Servable:
         self.version = int(version)
         self.buckets = buckets or BucketTable.from_env()
         self._pure, self._param_values = functionalize(block)
+        # buffer-census attribution (ISSUE 10): this version's parameter
+        # arrays show up under the "serve" owner bucket
+        from .. import programs as _programs
+        _programs.track_buffers(
+            "serve", self,
+            lambda sv: list(sv._param_values.values()))
         self._lock = threading.Lock()
         self._programs: Dict[Tuple, object] = {}
         self._warm_sig: Optional[Tuple] = None
@@ -151,7 +157,10 @@ class Servable:
         """One jit program per (bucket, signature) key.  Kept explicit —
         rather than one jax.jit whose aval cache we cannot see — so
         retrace/hit accounting is exact and 'no serve-time retraces' is
-        a checkable number, not a hope."""
+        a checkable number, not a hope.  Routed through the program
+        census (ISSUE 10) as ``serve.<model>.b<bucket>`` so every bucket
+        program's compile time and memory footprint are registry
+        outputs."""
         pure = self._pure
 
         def run_infer(param_values, xs):
@@ -159,7 +168,9 @@ class Servable:
             leaves = jax.tree_util.tree_leaves(outs)
             return tuple(leaves)
 
-        return jax.jit(run_infer)
+        from .. import programs as _programs
+        return _programs.register_program(
+            "serve.%s.b%d" % (self.name, int(key[0])), run_infer)
 
     def program(self, bucket: int, sig: Tuple):
         key = (int(bucket), sig)

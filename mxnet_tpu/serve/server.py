@@ -16,6 +16,9 @@ Verbs::
 
   PREDICT  (PREDICT, [npx, ...])          -> (True, (version, [npx, ...]))
   HEALTH   (HEALTH,)                      -> (True, {status, version, ...})
+  METRICS  (METRICS[, fmt])               -> (True, (TXT, utf8-bytes)):
+           the live Prometheus text exposition (fmt='json': the JSON
+           registry snapshot) — a replica is scrapeable with no sidecar
   SWAP     (SWAP, prefix, epoch, inputs)  -> (True, new_version)
   STOP     (STOP,)                        -> (True, "stopping")
 
@@ -45,7 +48,7 @@ from ..base import MXNetError, get_env
 from .. import fault as _fault
 from .. import telemetry as _telemetry
 from ..kvstore.server import send_msg, recv_msg
-from ..kvstore.wire_codec import decode_array, encode_array
+from ..kvstore.wire_codec import decode_array, encode_array, encode_text
 from .batcher import Batcher, Overloaded
 from .servable import ModelHost, Servable
 
@@ -141,6 +144,16 @@ class ServeServer:
             return self._predict(msg[1], span)
         if cmd == "HEALTH":
             return True, self.health()
+        if cmd == "METRICS":
+            # live Prometheus scrape over the serve wire (ISSUE 10
+            # satellite): no sidecar needed — the reply is the whole
+            # instrument registry (serve.* counters, program census,
+            # phase histograms) as one TXT payload
+            fmt = msg[1] if len(msg) > 1 else "prometheus"
+            reg = _telemetry.registry
+            text = reg.to_json(indent=1) if fmt == "json" \
+                else reg.to_prometheus()
+            return True, encode_text(text)
         if cmd == "SWAP":
             _, prefix, epoch, input_names = msg
             try:

@@ -454,8 +454,14 @@ class CompiledStep:
             losses = losses.reshape((n_steps * accum,) + losses.shape[2:])
             return carry + (losses, outs)
 
-        return jax.jit(_traced_step_window,
-                       donate_argnums=(0, 1, 2, 3, 4, 5))
+        # AOT census (ISSUE 10): the whole-step program's compile time,
+        # memory_analysis footprint and retrace diffs are first-class
+        # registry outputs — a CompiledStep invalidation shows up as a
+        # `step.*` retrace with the offending arg named
+        from .programs import register_program
+        pname = "step.step" if n_steps * accum == 1 else "step.window"
+        return register_program(pname, _traced_step_window,
+                                donate_argnums=(0, 1, 2, 3, 4, 5))
 
     # -- host-side per-window bookkeeping ----------------------------------
     def _lr_rows(self, plan, n_steps, batch_size):

@@ -985,7 +985,8 @@ class Executor:
             def run(values, key):
                 heads, _aux = pure(values, key)
                 return tuple(heads)
-            self._pure_jit = jax.jit(run)
+            from .programs import register_program
+            self._pure_jit = register_program("symbol.infer", run)
         jvals = {}
         for k, v in vals.items():
             jvals[k] = v._jax if isinstance(v, NDArray) else jnp.asarray(v)

@@ -82,6 +82,7 @@ __all__ = [
     "FlightRecorder", "flight_recorder", "note_step",
     "heartbeat_payload", "phase_snapshot",
     "dump_trace", "trace_events", "clear_trace", "dump_crash",
+    "register_step_observer", "register_crash_section",
 ]
 
 
@@ -699,6 +700,25 @@ class FlightRecorder:
 
 flight_recorder = FlightRecorder()
 
+# Step observers / crash sections (ISSUE 10): jax-aware layers (the
+# program census lives in mxnet_tpu/programs.py) hook in from outside so
+# this module stays importable by the numpy-only kvstore server.  An
+# observer returns a dict to merge into the step record (or None); a
+# crash section returns a JSON-able payload keyed under its name.
+_step_observers: List = []
+_crash_sections: List[Tuple[str, Any]] = []
+
+
+def register_step_observer(fn) -> None:
+    """`fn() -> Optional[dict]` called per note_step (telemetry on);
+    non-None results merge into that step's flight-recorder record."""
+    _step_observers.append(fn)
+
+
+def register_crash_section(name: str, fn) -> None:
+    """`fn() -> payload` embedded as `name` in every crash dump."""
+    _crash_sections.append((str(name), fn))
+
 
 def note_step(steps: int = 1, epoch: Optional[int] = None,
               batch: Optional[int] = None,
@@ -714,6 +734,14 @@ def note_step(steps: int = 1, epoch: Optional[int] = None,
         _tls.phases = {}
     if not enabled():
         return None
+    for fn in list(_step_observers):
+        try:
+            obs = fn()
+        except Exception:
+            obs = None      # observers must never fail a training step
+        if obs:
+            extra = dict(extra or {})
+            extra.update(obs)
     return flight_recorder.record(phases=phases, steps=steps, epoch=epoch,
                                   batch=batch, batch_size=batch_size,
                                   extra=extra)
@@ -774,6 +802,11 @@ def dump_crash(reason: str, directory: Optional[str] = None,
             "records": flight_recorder.records(),
             "counters": registry.snapshot(),
         }
+        for name, fn in list(_crash_sections):
+            try:
+                payload[name] = fn()
+            except Exception:
+                payload[name] = None    # a dying process still dumps
         if extra:
             payload["extra"] = extra
         tmp = "%s.tmp.%d" % (path, os.getpid())

@@ -46,13 +46,13 @@ def _measure(store, compress, mb, iters, key="x"):
     kv.init(key, nd.zeros((n,)))
     kv.pushpull(key, payload, out=out)          # warm (compile/connect)
     out.wait_to_read()
-    w0 = engine.wire_bytes
+    w0 = engine.snapshot()["wire_bytes"]        # one consistent read
     t0 = time.perf_counter()
     for _ in range(iters):
         kv.pushpull(key, payload, out=out)
     out.wait_to_read()
     dt = time.perf_counter() - t0
-    wire_per_step = (engine.wire_bytes - w0) / iters
+    wire_per_step = (engine.snapshot()["wire_bytes"] - w0) / iters
     moved = 2 * mb * iters / 1024.0              # push + pull, GiB
     return kv, round(moved / dt, 3), int(wire_per_step)
 

@@ -1,0 +1,263 @@
+#!/usr/bin/env python
+"""bench_compare.py — the bench-trajectory regression sentinel (ISSUE 10).
+
+Reads one ``bench.py`` JSON report (file argument, or ``-``/stdin for a
+pipe: ``python bench.py --eager | python tools/bench_compare.py -``),
+appends a compact record — throughput, total compile seconds, peak temp
+bytes, retrace count, device — to the rolling history file
+(``MX_BENCH_HISTORY``, default ``BENCH_HISTORY.jsonl`` next to bench.py)
+and exits non-zero when the run regresses vs the rolling best *for the
+same metric on the same device class*:
+
+  * throughput  more than ``--throughput-tol`` (default 10%) below the
+    best recorded value, or
+  * memory      peak temp bytes more than ``--memory-tol`` (default 15%)
+    above the best (smallest) recorded footprint.
+
+The first run of a metric seeds the history and always passes.  Records
+whose report carries no census block (e.g. a replayed TPU capture) gate
+on throughput only.
+
+``--inject-slowdown F`` divides the measured throughput by F before
+gating and skips the history append — the synthetic-regression hook the
+acceptance test drives (a 2x injected slowdown must exit non-zero while
+the real run passes).
+
+``--check-schema`` validates every history line parses and carries the
+required fields (tools/lint.sh runs this), exit 0 on an empty/missing
+history.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+REQUIRED_FIELDS = ("ts", "metric", "value", "unit")
+NUMERIC_FIELDS = ("ts", "value")
+
+
+def _base_mod():
+    """mxnet_tpu.base loaded standalone (it only needs os/threading):
+    importing the package would drag jax into a CLI that reads one env
+    var — the sentinel must stay instant in CI loops."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "mx_base_bench_compare", os.path.join(REPO, "mxnet_tpu",
+                                              "base.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def history_path() -> str:
+    p = _base_mod().get_env("MX_BENCH_HISTORY", "") or ""
+    return p or os.path.join(REPO, "BENCH_HISTORY.jsonl")
+
+
+def load_history(path):
+    """[(lineno, record)] of parseable lines; ValueError lines reported
+    by check_schema, skipped (with a warning) by the gate."""
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append((i, json.loads(line)))
+            except ValueError:
+                out.append((i, None))
+    return out
+
+
+def check_schema(path) -> int:
+    bad = []
+    for lineno, rec in load_history(path):
+        if rec is None:
+            bad.append((lineno, "unparseable JSON"))
+            continue
+        if not isinstance(rec, dict):
+            bad.append((lineno, "not an object"))
+            continue
+        for field in REQUIRED_FIELDS:
+            if field not in rec:
+                bad.append((lineno, "missing field %r" % field))
+        for field in NUMERIC_FIELDS:
+            if field in rec and not isinstance(rec[field], (int, float)):
+                bad.append((lineno, "field %r not numeric" % field))
+    if bad:
+        for lineno, why in bad:
+            print("bench_compare: %s:%d: %s" % (path, lineno, why),
+                  file=sys.stderr)
+        return 1
+    print("bench_compare: schema OK (%d records in %s)"
+          % (len(load_history(path)), path))
+    return 0
+
+
+def extract_record(report: dict) -> dict:
+    """Compact history record from one bench.py report."""
+    import platform
+    rec = {
+        "ts": time.time(),
+        "metric": str(report.get("metric", "unknown")),
+        "value": float(report.get("value", 0.0)),
+        "unit": str(report.get("unit", "")),
+        "device": str(report.get("device", "")),
+        # absolute throughput is machine-relative: records gate only
+        # against the rolling best measured on the SAME host, so a
+        # committed history never fails a slower developer box
+        "host": platform.node(),
+    }
+    census = report.get("census") or {}
+    summary = census.get("summary") or {}
+    if summary:
+        rec["compile_seconds_total"] = summary.get("compile_seconds_total")
+        rec["peak_temp_bytes"] = summary.get("peak_temp_bytes")
+        rec["retraces"] = summary.get("retraces")
+        rec["programs"] = summary.get("programs")
+    return rec
+
+
+def gate(rec, history, throughput_tol, memory_tol):
+    """(ok, findings): compare `rec` against the rolling best of the
+    same (metric, device) records."""
+    peers = [r for _, r in history
+             if isinstance(r, dict)
+             and r.get("metric") == rec["metric"]
+             and r.get("device", "") == rec["device"]
+             and r.get("host", "") == rec.get("host", "")
+             and isinstance(r.get("value"), (int, float))]
+    findings = []
+    if not peers:
+        findings.append(
+            "first record for %r on %r@%s: seeding history"
+            % (rec["metric"], rec["device"] or "default",
+               rec.get("host", "?")))
+        return True, findings
+    best_value = max(r["value"] for r in peers)
+    ok = True
+    if best_value > 0:
+        floor = best_value * (1.0 - throughput_tol)
+        if rec["value"] < floor:
+            ok = False
+            findings.append(
+                "THROUGHPUT REGRESSION: %.4g %s < %.4g (best %.4g "
+                "- %d%% tolerance)" % (
+                    rec["value"], rec["unit"], floor, best_value,
+                    round(throughput_tol * 100)))
+        else:
+            findings.append(
+                "throughput %.4g %s within %d%% of best %.4g"
+                % (rec["value"], rec["unit"],
+                   round(throughput_tol * 100), best_value))
+    mem = rec.get("peak_temp_bytes")
+    mem_peers = [r["peak_temp_bytes"] for r in peers
+                 if isinstance(r.get("peak_temp_bytes"), (int, float))
+                 and r["peak_temp_bytes"] > 0]
+    if isinstance(mem, (int, float)) and mem > 0 and mem_peers:
+        best_mem = min(mem_peers)
+        ceil = best_mem * (1.0 + memory_tol)
+        if mem > ceil:
+            ok = False
+            findings.append(
+                "MEMORY REGRESSION: peak temp bytes %d > %d (best %d "
+                "+ %d%% tolerance)" % (mem, int(ceil), int(best_mem),
+                                       round(memory_tol * 100)))
+        else:
+            findings.append(
+                "peak temp bytes %d within %d%% of best %d"
+                % (mem, round(memory_tol * 100), int(best_mem)))
+    return ok, findings
+
+
+def append_record(path, rec) -> None:
+    d = os.path.dirname(os.path.abspath(path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "a") as f:
+        f.write(json.dumps(rec, sort_keys=True) + "\n")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("report", nargs="?", default="-",
+                    help="bench.py JSON report file, or '-' for stdin")
+    ap.add_argument("--history", default=None,
+                    help="history file (default MX_BENCH_HISTORY or "
+                         "BENCH_HISTORY.jsonl next to bench.py)")
+    ap.add_argument("--throughput-tol", type=float, default=0.10)
+    ap.add_argument("--memory-tol", type=float, default=0.15)
+    ap.add_argument("--no-append", action="store_true",
+                    help="gate only; do not record this run")
+    ap.add_argument("--inject-slowdown", type=float, default=None,
+                    help="divide throughput by F before gating "
+                         "(synthetic-regression self-test; implies "
+                         "--no-append)")
+    ap.add_argument("--check-schema", action="store_true",
+                    help="validate the history file and exit")
+    args = ap.parse_args(argv)
+
+    path = args.history or history_path()
+    if args.check_schema:
+        return check_schema(path)
+
+    if args.report == "-":
+        raw = sys.stdin.read()
+    else:
+        with open(args.report) as f:
+            raw = f.read()
+    # bench.py children may print diagnostics; the report is the last
+    # JSON object line
+    report = None
+    for line in raw.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                report = json.loads(line)
+            except ValueError:
+                continue
+    if report is None:
+        print("bench_compare: no JSON report found in input",
+              file=sys.stderr)
+        return 2
+
+    rec = extract_record(report)
+    injected = None
+    if args.inject_slowdown:
+        injected = float(args.inject_slowdown)
+        rec["value"] = rec["value"] / injected
+        rec["injected_slowdown"] = injected
+
+    history = load_history(path)
+    bad = sum(1 for _, r in history if r is None)
+    if bad:
+        print("bench_compare: warning: %d unparseable history line(s) "
+              "skipped (run --check-schema)" % bad, file=sys.stderr)
+    ok, findings = gate(rec, history, args.throughput_tol,
+                        args.memory_tol)
+    # EVERY real run lands in the trajectory, regressions included
+    # (marked ok=false) — a week of failing runs must be visible in the
+    # history, and the gate compares against the rolling BEST, so a
+    # failing record can never lower the bar
+    rec["ok"] = ok
+    if not args.no_append and injected is None:
+        append_record(path, rec)
+    print(json.dumps({
+        "ok": ok,
+        "record": rec,
+        "history": path,
+        "history_records": sum(1 for _, r in history if r is not None),
+        "findings": findings,
+    }, sort_keys=True))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

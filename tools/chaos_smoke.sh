@@ -68,6 +68,20 @@ assert len(blob.get("records") or []) >= 1, \
 rec = blob["records"][-1]
 for field in ("step", "phases", "dispatches", "wire_bytes"):
     assert field in rec, (field, rec)
+# ISSUE 10: crash dumps carry the device-buffer census and the program
+# registry — a dead rank's memory story and compiled-program set are
+# part of the flight recording
+census = blob.get("buffer_census")
+assert census and census.get("total_bytes", 0) > 0, \
+    "crash dump %s has no buffer census: %r" % (worker[0], census)
+assert census.get("params", {}).get("count", 0) >= 1, \
+    "census attributed no parameter buffers: %r" % (census,)
+progs = blob.get("programs")
+assert progs and len(progs) >= 1, \
+    "crash dump %s has no registered programs" % worker[0]
+assert any(t.get("compile_seconds", {}).get("total", 0) > 0
+           for t in progs.values()), \
+    "no program carries compile time: %r" % (list(progs),)
 sblob = json.load(open(sup[0]))
 assert sblob["rc"] != 0 and "heartbeat" in sblob, sblob
 print("chaos_smoke: %d worker crash dump(s) with step records + %d "
@@ -217,10 +231,11 @@ assert per_step <= 2, per_step
 assert trainer._kvstore._gc._residuals, "EF residual store never filled"
 Xw, Yw = np.stack([X] * 4), np.stack([Y] * 4)
 step.run_window(Xw, Yw)           # warm: the trace itself runs eager ops
-w0, s0 = engine.dispatch_count, engine.compiled_steps
+snap0 = engine.snapshot()         # ONE consistent counter-group read
 step.run_window(Xw, Yw)
-assert engine.dispatch_count - w0 <= 2, engine.dispatch_count - w0
-assert engine.compiled_steps - s0 == 4
+snap1 = engine.snapshot()
+assert snap1["dispatches"] - snap0["dispatches"] <= 2, snap1
+assert snap1["compiled_steps"] - snap0["compiled_steps"] == 4, snap1
 print("compiled_step_smoke: PASS losses=%s dispatches/step=%d"
       % (["%.4f" % l for l in losses], per_step))
 EOF

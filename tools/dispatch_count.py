@@ -61,10 +61,10 @@ def run_exchange(n_keys=40):
 
     # serialized batched push/pull (what Trainer does without overlap)
     kv.push(keys, [[g] for g in grads])
-    c0 = engine.dispatch_count
+    c0 = engine.snapshot()["dispatches"]
     kv.push(keys, [[g] for g in grads])
     kv.pull(keys, [[g] for g in grads])
-    batched_d = engine.dispatch_count - c0
+    batched_d = engine.snapshot()["dispatches"] - c0
 
     # overlap session: notify every key, drain (what backward's hooks do)
     sess = kv.begin_exchange(keys, [[g] for g in grads])
@@ -72,11 +72,11 @@ def run_exchange(n_keys=40):
         sess.notify_key(k)
     sess.drain()
     sess = kv.begin_exchange(keys, [[g] for g in grads])
-    c1 = engine.dispatch_count
+    c1 = engine.snapshot()["dispatches"]
     for k in keys:
         sess.notify_key(k)
     sess.drain()
-    overlap_d = engine.dispatch_count - c1
+    overlap_d = engine.snapshot()["dispatches"] - c1
     return {
         "keys": n_keys,
         "batched_exchange_dispatches": batched_d,
@@ -118,16 +118,20 @@ def run_compiled(n_steps=4, hidden_layers=6, hidden=16):
     Xw = rng.randn(n_steps, 16, 8).astype(np.float32)
     Yw = rng.randn(n_steps, 16, 4).astype(np.float32)
     step.run_window(Xw, Yw)                   # warm (trace + compile)
-    c0, s0 = engine.dispatch_count, engine.compiled_steps
+    # ISSUE 10: dispatch_count and compiled_steps must be ONE consistent
+    # read — count_step_window bumps both, and reading them as two
+    # properties could split a mid-flight bump
+    snap0 = engine.snapshot()
     step.run_window(Xw, Yw)
-    window_d = engine.dispatch_count - c0
-    window_steps = engine.compiled_steps - s0
+    snap1 = engine.snapshot()
+    window_d = snap1["dispatches"] - snap0["dispatches"]
+    window_steps = snap1["compiled_steps"] - snap0["compiled_steps"]
     x1 = nd.array(Xw[0])
     y1 = nd.array(Yw[0])
     step.step(x1, y1)                          # warm the 1-step entry
-    c1 = engine.dispatch_count
+    c1 = engine.snapshot()["dispatches"]
     step.step(x1, y1)
-    single_d = engine.dispatch_count - c1
+    single_d = engine.snapshot()["dispatches"] - c1
     return {
         "compiled": bool(step.compiled),
         "scan_steps": n_steps,
@@ -166,7 +170,7 @@ def run_serve(n_requests=24, rows_per_request=2, max_batch=8):
     rng = np.random.RandomState(0)
     retraces0, hits0 = sv.retraces, sv.bucket_hits
     batches0 = telemetry.registry.value("serve.batches")
-    c0 = engine.dispatch_count
+    c0 = engine.snapshot()["dispatches"]
     pendings = [batcher.submit(
         [rng.randn(rows_per_request, DEMO_IN).astype(np.float32)])
         for _ in range(n_requests)]
@@ -174,7 +178,7 @@ def run_serve(n_requests=24, rows_per_request=2, max_batch=8):
     for p in pendings:
         p.result(timeout=60)
     batcher.close()
-    dispatches = engine.dispatch_count - c0
+    dispatches = engine.snapshot()["dispatches"] - c0
     batches = telemetry.registry.value("serve.batches") - batches0
     total_rows = n_requests * rows_per_request
     want_batches = -(-total_rows // max_batch)     # ceil
@@ -225,12 +229,12 @@ def run(steps=3, hidden_layers=6, hidden=16):
             out = net(x)
             loss = loss_fn(out, y)
         loss.backward()
-        c0 = engine.dispatch_count
+        c0 = engine.snapshot()["dispatches"]
         trainer.step(batch_size=16)
-        step_d = engine.dispatch_count - c0
-        c1 = engine.dispatch_count
+        step_d = engine.snapshot()["dispatches"] - c0
+        c1 = engine.snapshot()["dispatches"]
         metric.update([y], [out])
-        metric_d = engine.dispatch_count - c1
+        metric_d = engine.snapshot()["dispatches"] - c1
         return step_d, metric_d
 
     one_step()                      # warmup: state creation dispatches

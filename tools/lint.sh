@@ -54,4 +54,7 @@ PYEOF
 echo "== lint: env-var doc consistency (tools/gen_env_docs.py --check)"
 "$PY" tools/gen_env_docs.py --check
 
+echo "== lint: bench-history schema (tools/bench_compare.py --check-schema)"
+"$PY" tools/bench_compare.py --check-schema
+
 echo "lint: PASS"

@@ -27,7 +27,7 @@ def _default_paths():
     certify, and telemetry_dump.py processes operator-facing trace
     files (ISSUE 8)."""
     out = ["mxnet_tpu"]
-    for extra in ("launch.py", "telemetry_dump.py"):
+    for extra in ("launch.py", "telemetry_dump.py", "bench_compare.py"):
         if os.path.isfile(os.path.join("tools", extra)):
             out.append(os.path.join("tools", extra))
     return out

@@ -70,6 +70,15 @@ HOT_PATH_ROOTS: List[Tuple[str, List[str]]] = [
     ("mxnet_tpu/serve/servable.py",
      ["Servable.dispatch", "Servable.program", "Servable.signature_of",
       "ModelHost.active"]),
+    # the program census (ISSUE 10) wraps EVERY jit dispatch: its call
+    # path and record helpers are dispatch-time bookkeeping by contract
+    # (shape/aval reads only — never a device sync), and the buffer
+    # census walks live-array HANDLES (nbytes metadata, no transfer).
+    # The tests/test_mxlint.py reinjection test trips this entry.
+    ("mxnet_tpu/programs.py",
+     ["Program.__call__", "Program._compile", "ProgramRecord.note_compile",
+      "signature_of", "diff_signatures", "buffer_census",
+      "LeakDetector.check"]),
 ]
 
 _SYNC_ATTRS = {"asnumpy", "asscalar", "item", "wait_to_read", "tolist"}
@@ -78,6 +87,22 @@ _NUMPY_PULLS = ("numpy.asarray", "numpy.array", "numpy.frombuffer")
 
 def _is_numpy_pull(ctx: FileContext, func: ast.AST) -> bool:
     return any(ctx.resolves_to(func, d) for d in _NUMPY_PULLS)
+
+
+def _program_fn_arg(ctx: FileContext, call: ast.AST):
+    """The traced-fn argument of a program-census jit site (ISSUE 10):
+    ``register_program(name, fn, **jit_kw)`` is the repo's drop-in for
+    ``jax.jit(fn, **jit_kw)`` — its second positional arg is the traced
+    body, and the same jit kwargs (static_argnums, donate_argnums) apply.
+    Returns the fn node, or None when `call` is not such a site."""
+    if not isinstance(call, ast.Call) or len(call.args) < 2:
+        return None
+    f = call.func
+    if ctx.resolves_to(f, "mxnet_tpu.programs.register_program") or \
+            (isinstance(f, ast.Name) and f.id == "register_program") or \
+            (isinstance(f, ast.Attribute) and f.attr == "register_program"):
+        return call.args[1]
+    return None
 
 
 @register_rule
@@ -258,6 +283,10 @@ class JitPurity(Rule):
                 jit_call = None
                 if is_jax_jit(node.func) and node.args:
                     fn_arg, jit_call = node.args[0], node
+                elif _program_fn_arg(ctx, node) is not None:
+                    # register_program(name, fn, **jit_kw): fn is traced
+                    # exactly like jax.jit(fn, **jit_kw)'s arg (ISSUE 10)
+                    fn_arg, jit_call = _program_fn_arg(ctx, node), node
                 elif in_ops and isinstance(node.func, ast.Name) and \
                         node.func.id == "register" and len(node.args) >= 2:
                     if not any(kw.arg == "no_jit" and
@@ -504,7 +533,8 @@ class DonationAfterUse(Rule):
                     not isinstance(node.value, ast.Call):
                 continue
             call = node.value
-            if not (ctx.resolves_to(call.func, "jax.jit")):
+            if not (ctx.resolves_to(call.func, "jax.jit") or
+                    _program_fn_arg(ctx, call) is not None):
                 continue
             donated = _donate_positions(call)
             if not donated:
@@ -531,7 +561,8 @@ class DonationAfterUse(Rule):
                         f.value.id == "self" and f.attr in self_bound:
                     donated = self_bound[f.attr]
                 elif isinstance(f, ast.Call) and \
-                        ctx.resolves_to(f.func, "jax.jit"):
+                        (ctx.resolves_to(f.func, "jax.jit") or
+                         _program_fn_arg(ctx, f) is not None):
                     donated = _donate_positions(f)
                 if not donated:
                     continue

@@ -17,7 +17,9 @@ arrival — and reports a JSON summary.
     the supervisor restarted the crashed one and it is serving again.
 
 ``--stop`` sends the wire STOP to every replica at the end so the
-supervised job (launch.py) drains and exits 0.
+supervised job (launch.py) drains and exits 0.  ``--metrics`` prints
+every replica's live Prometheus snapshot via the METRICS verb after the
+load (``--requests 0 --metrics`` is a pure scrape).
 """
 import argparse
 import json
@@ -62,6 +64,10 @@ def main():
                          "serves again afterwards")
     ap.add_argument("--stop", action="store_true",
                     help="send STOP to every replica at the end")
+    ap.add_argument("--metrics", action="store_true",
+                    help="after the load, print every replica's live "
+                         "Prometheus snapshot via the METRICS verb "
+                         "(use --requests 0 for a pure scrape)")
     ap.add_argument("--timeout", type=float, default=20.0)
     args = ap.parse_args()
 
@@ -100,6 +106,10 @@ def main():
             h = cli.health(idx=i)
             assert h.get("status") == "serving", (i, h)
             restarted.append(h.get("pid"))
+    if args.metrics:
+        for i, addr in enumerate(addrs):
+            print("# ==== metrics: replica %d (%s) ====" % (i, addr))
+            print(cli.metrics(idx=i))
     if args.stop:
         cli.stop()
     cli.close()
