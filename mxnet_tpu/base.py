@@ -104,6 +104,8 @@ ENV_CATALOG: Dict[str, Any] = {
     "MX_SERVE_PORT": ("9700", "Port a serving replica binds (python -m mxnet_tpu.serve); with --port-base under the launcher each rank serves on port-base + MX_PROCESS_ID."),
     "MX_SERVE_ROOTS": ("", "Comma-separated serving replica addresses host:port the ServeClient connects to; the client sticks to one replica and fails over to the next on a connection error or timeout (SEQ retry makes the replay safe)."),
     "MX_SERVE_TIMEOUT": ("30", "Seconds a serving client waits for one PREDICT reply (queue wait + dispatch included) before treating the replica as dead and failing over; also the server-side bound on a request waiting out its batch future."),
+    "MX_TPU_PROBE_TIMEOUT": ("120", "Seconds the subprocess accelerator probe (base.probe_accelerator, the default budget when no explicit timeout is passed; tests/conftest.py's MX_TEST_CTX=tpu lane reads it the same way) waits for jax backend init before declaring the TPU tunnel wedged.  A timeout is definitive (hangs don't flake); the test suite shrinks it to prove the skip path without burning the full production budget.  Callers that pass an explicit timeout (tools/tpu_capture.py polling) are unaffected."),
+    "MX_SERVE_REPLAY_CAP": ("512", "Serving replica: bound on the exactly-once replay cache (one entry per client id).  Entries are kept in LRU order - every new seq or replay hit from a client moves it to the recent end - and over-cap inserts evict the least-recently-touched RESOLVED entries (in-flight entries are never dropped); each eviction is counted in serve.replay_evicted.  Values < 1 clamp to 1 (the exactly-once contract needs at least the in-flight entry; 0 never means 'unbounded').  Serving clients are ephemeral uuids, so without this bound every dead client's last PREDICT response would be retained forever."),
     "MX_PROGRAM_CENSUS": ("1", "XLA program census (mxnet_tpu/programs.py): 1 (default) routes every jit-creation site through the process-wide program registry - per-program compile-time histograms (program_compile_seconds{program}), XLA memory_analysis/cost_analysis metadata (program_temp_bytes/program_flops, where the backend provides them), retrace counts with a structured retrace-explainer diff (which arg's shape/dtype/tree structure changed), and the jax.live_arrays() device-buffer census bucketed by owner (params/optimizer_state/ef_residuals/serve/other) riding flight-recorder records and crash dumps.  0 makes register_program a plain jax.jit and disables the census."),
     "MX_LEAK_WARN_BYTES": ("67108864", "Buffer-census leak detector threshold: when total live device bytes grow monotonically across consecutive census checks by more than this many bytes, the census_leak_bytes gauge latches the streak, census.leak_trips increments and a warning names the growing owner buckets.  Any shrink resets the streak; 0 disables the trip (gauges still publish)."),
     "MX_BENCH_HISTORY": ("", "Path of the bench-trajectory history file tools/bench_compare.py appends each bench.py run to and gates regressions against (>10% throughput or >15% peak-temp-bytes vs the rolling best per metric); empty uses BENCH_HISTORY.jsonl next to bench.py."),
@@ -189,19 +191,32 @@ def cpu_pinned_by_user() -> bool:
 _probe_result: Optional[bool] = None
 
 
-def probe_accelerator(timeout_s: float = 120.0) -> bool:
+def probe_timeout() -> float:
+    """MX_TPU_PROBE_TIMEOUT: subprocess budget for one accelerator
+    probe.  Env-tunable so the test lane can prove the skip path
+    without burning the full production budget on a wedged tunnel."""
+    try:
+        return float(get_env("MX_TPU_PROBE_TIMEOUT", 120.0, float))
+    except (TypeError, ValueError):
+        return 120.0
+
+
+def probe_accelerator(timeout_s: Optional[float] = None) -> bool:
     """True iff jax's default backend is a healthy accelerator.
 
-    Probed in a SUBPROCESS with a hard timeout: in-process backend init on a
-    wedged tunnel blocks forever with no way to recover.  A probe timeout is
-    treated as definitively wedged (hangs don't flake) — no retry.  The
-    result is memoized for the process lifetime (the probe costs a full jax
-    startup, and the wedged/healthy state doesn't change underneath one
-    process by the same hangs-don't-flake reasoning)."""
+    Probed in a SUBPROCESS with a hard timeout (default: the cataloged
+    MX_TPU_PROBE_TIMEOUT budget via :func:`probe_timeout`): in-process
+    backend init on a wedged tunnel blocks forever with no way to
+    recover.  A probe timeout is treated as definitively wedged (hangs
+    don't flake) — no retry.  The result is memoized for the process
+    lifetime (the probe costs a full jax startup, and the
+    wedged/healthy state doesn't change underneath one process by the
+    same hangs-don't-flake reasoning)."""
     global _probe_result
     if _probe_result is not None:
         return _probe_result
-    _probe_result = probe_accelerator_once(timeout_s)
+    _probe_result = probe_accelerator_once(
+        probe_timeout() if timeout_s is None else timeout_s)
     return _probe_result
 
 
@@ -234,9 +249,12 @@ def pin_cpu() -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
-def ensure_live_backend(timeout_s: float = 120.0) -> str:
+def ensure_live_backend(timeout_s: Optional[float] = None) -> str:
     """Honor an explicit user CPU pin; otherwise probe the accelerator and
-    pin cpu if it is wedged.  Returns "cpu" or "accelerator"."""
+    pin cpu if it is wedged.  Returns "cpu" or "accelerator".  The probe
+    budget defaults to MX_TPU_PROBE_TIMEOUT (forwarded as None so
+    probe_accelerator resolves it), like every no-explicit-timeout
+    probe path."""
     if cpu_pinned_by_user():
         pin_cpu()
         return "cpu"

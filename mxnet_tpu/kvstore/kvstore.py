@@ -1572,3 +1572,65 @@ def create(name: str = "local") -> KVStore:
         raise MXNetError("unknown KVStore type %r (have %s)"
                          % (name, sorted(_STORES)))
     return _STORES[key]()
+
+
+# ---------------------------------------------------------------------------
+# Program contracts (ISSUE 11): the gradient-exchange bodies' declared
+# donation/HBM invariants.  The exchange bodies normally inline into
+# the compiled step's single program; contracting them STANDALONE keeps
+# the proof per-transport — the int8/2bit error-feedback residuals are
+# the donated state, and the verifier shows each survives as an output
+# alias under every compression mode before any TPU sees the job.
+# Builders run only inside `python -m tools.mxlint --contracts`.
+# ---------------------------------------------------------------------------
+
+def _exchange_contract_cases():
+    from ..programs import ContractCase, register_program
+    from ..device import cpu
+    cases = []
+    shapes = [(96, 4), (256,)]
+    for mode in ("int8", "2bit", "none"):
+        kv = KVStoreLocal()
+        if mode != "none":
+            kv.set_gradient_compression({"type": mode})
+        templates = [NDArray(jnp.zeros(s, jnp.float32), ctx=cpu())
+                     for s in shapes]
+        body = kv.build_exchange_body(list(range(len(shapes))), templates)
+        pname = "kvstore.exchange_%s" % mode
+        prog = register_program(pname, body, donate_argnums=(1,))
+        grads = [jax.ShapeDtypeStruct(s, jnp.float32) for s in shapes]
+        residuals = [jax.ShapeDtypeStruct(tuple(s), jnp.dtype(dt))
+                     for _wk, s, dt in body.residual_specs]
+        cases.append(ContractCase(pname, (grads, residuals),
+                                  label=mode, target=prog))
+    return cases
+
+
+def _sum_contract_cases():
+    from ..programs import ContractCase
+    arrs = tuple(jax.ShapeDtypeStruct((128, 8), jnp.float32)
+                 for _ in range(4))
+    return [ContractCase("kvstore.sum", (arrs,), label="sum4",
+                         target=_sum_arrays)]
+
+
+def _declare_kvstore_contracts():
+    from ..programs import declare_contract
+    declare_contract(
+        "kvstore.exchange", _exchange_contract_cases,
+        donate_argnums=(1,),
+        temp_budget_bytes=1 << 20,
+        description="single-worker traceable exchange bodies (int8 / "
+                    "2bit / uncompressed): error-feedback residuals "
+                    "donate in-place; gradients rebind to the returned "
+                    "merged values")
+    declare_contract(
+        "kvstore.sum", _sum_contract_cases,
+        donate_argnums=(),
+        temp_budget_bytes=1 << 20,
+        description="per-key eager reduction body (light census mode): "
+                    "no donations — the summands are live parameter "
+                    "gradients owned by their devices")
+
+
+_declare_kvstore_contracts()

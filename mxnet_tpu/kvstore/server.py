@@ -137,6 +137,28 @@ def _rank_of(client_id) -> str:
     return cid.split(":", 1)[0]
 
 
+# The parameter-server wire surface, DECLARED (ISSUE 11): mxlint's
+# wire-verb-exhaustive rule pairs every client-emitted verb with an
+# entry here, checks a handler comparison exists in this file, that
+# 'replayable' entries sit in the exactly-once replay set (_MUTATING)
+# and 'idempotent' ones do not, and that a named codec has an
+# encode_<name>/decode_<name> pair in kvstore/wire_codec.py.  Adding a
+# client verb (the elastic-membership JOIN/LEAVE of ROADMAP item 2)
+# without completing this row fails lint — half-wired protocols cannot
+# ship.
+WIRE_VERBS = {
+    # mutating commands replay from the SEQ cache after a lost reply
+    "INIT": {"semantics": "replayable", "codec": None},
+    "PUSH": {"semantics": "replayable", "codec": "wire"},
+    "SET_OPT": {"semantics": "replayable", "codec": None},
+    # re-executing these on a retried envelope is harmless by design
+    "PULL": {"semantics": "idempotent", "codec": None},
+    "BARRIER": {"semantics": "idempotent", "codec": None},
+    "PING": {"semantics": "idempotent", "codec": None},
+    "STOP": {"semantics": "idempotent", "codec": None},
+}
+
+
 class KVStoreServer:
     """The server-side store + optimizer (reference: KVStoreDistServer)."""
 

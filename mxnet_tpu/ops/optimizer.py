@@ -968,3 +968,77 @@ def _multi_mp_lamb_update(*arrays, learning_rates=None, wds=None, beta1=0.9,
             rescale_grad)
         outs.extend([new_w32.astype(w.dtype), new_m, new_v, new_w32])
     return tuple(outs)
+
+
+# ---------------------------------------------------------------------------
+# Program contracts (ISSUE 11): the fused tree kernels' declared
+# donation/HBM invariants.  Declaration is a dict insert; the builders
+# below only run inside the device-free verifier
+# (`python -m tools.mxlint --contracts`), which lowers each kernel with
+# abstract inputs and proves every donated buffer actually aliases an
+# output — the eager path only turns donation ON off-CPU
+# (tree_apply's `donate = jax.default_backend() != "cpu"`), so a
+# dropped donation would otherwise surface as doubled HBM on the first
+# TPU run and nowhere else.
+# ---------------------------------------------------------------------------
+
+# per kind: (static params beyond wds/rescale/clip/mp, extra traced args)
+_CONTRACT_STATICS = {
+    "sgd": {},
+    "sgd_mom": {"momentum": 0.9},
+    "nag_mom": {"momentum": 0.9},
+    "adam": {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8},
+    "adamw": {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8},
+}
+
+# state columns between grads and weights32 in each body's signature
+_CONTRACT_N_STATE = {"sgd": 0, "sgd_mom": 1, "nag_mom": 1,
+                     "adam": 2, "adamw": 2}
+
+
+def _fused_contract_cases(kind, mp):
+    """ContractCases for one fused kind: 3 leaves of 64 elements — small
+    enough to lower instantly, structured enough that every donated
+    buffer class (weights, each state column, weights32) is present."""
+    from ..programs import ContractCase
+    n, leaf = 3, (64,)
+    wdtype = jnp.bfloat16 if mp else jnp.float32
+
+    def col(dt=jnp.float32):
+        return tuple(jax.ShapeDtypeStruct(leaf, dt) for _ in range(n))
+
+    statics = dict(_CONTRACT_STATICS[kind])
+    statics.update(wds=(0.0,) * n, rescale_grad=1.0 / 32,
+                   clip_gradient=-1.0, mp=mp)
+    fn = _tree_jit(kind, tuple(sorted(statics.items())), True)
+    args = [col(wdtype), col(wdtype)]
+    args += [col() for _ in range(_CONTRACT_N_STATE[kind])]
+    args.append(col() if mp else None)                    # weights32
+    args.append(jax.ShapeDtypeStruct((n,), jnp.float32))  # lrs
+    if kind == "adamw":
+        args.append(jax.ShapeDtypeStruct((n,), jnp.float32))
+    return [ContractCase("optimizer.fused_%s" % kind, tuple(args),
+                         label="%s%s" % (kind, "_mp" if mp else ""),
+                         target=fn)]
+
+
+def _declare_fused_contracts():
+    from ..programs import declare_contract
+    for kind, (_body, donatable) in sorted(_TREE_BODIES.items()):
+        def build(kind=kind):
+            cases = _fused_contract_cases(kind, mp=False)
+            if kind in ("adam", "adamw"):
+                # the multi-precision layout donates weights32 too —
+                # prove that alias on at least one Adam-family kind
+                cases += _fused_contract_cases(kind, mp=True)
+            return cases
+        declare_contract(
+            "optimizer.fused_%s" % kind, build,
+            donate_argnums=donatable,
+            temp_budget_bytes=1 << 20,
+            description="fused multi-tensor %s apply: weight/state "
+                        "buffers donate in-place; grads and the lr "
+                        "vector survive the call" % kind)
+
+
+_declare_fused_contracts()

@@ -563,3 +563,52 @@ def unpack_2bit_words(words, threshold, n):
     fn = _jitted(("u2", int(n)),
                  functools.partial(_unpack_2bit_kernel, n=int(n)))
     return fn(words, jnp.asarray(threshold, jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# Program contracts (ISSUE 11): the gradient-wire kernels' declared
+# donation/HBM invariants.  The error-feedback residual is the donated
+# state here — it is rewritten every step, and a dropped donation would
+# keep BOTH generations of every bucket's residual live on TPU.  The
+# builders run only inside `python -m tools.mxlint --contracts`.
+# ---------------------------------------------------------------------------
+
+_CONTRACT_N = 4096          # one mid-sized flat bucket payload
+
+
+def _quant_contract_cases():
+    from ..programs import ContractCase
+    block = GRAD_BLOCK_DEFAULT
+    f32 = jnp.float32
+    flat = jax.ShapeDtypeStruct((_CONTRACT_N,), f32)
+    res = jax.ShapeDtypeStruct((_CONTRACT_N,), f32)
+    thr = jax.ShapeDtypeStruct((), f32)
+    q8 = _jitted(("q8", block),
+                 functools.partial(_quantize_int8_kernel, block=block),
+                 donate=(1,))
+    rt8 = _jitted(("rt8", block),
+                  functools.partial(_roundtrip_int8_kernel, block=block),
+                  donate=(1,))
+    q2 = _jitted(("q2",), _quantize_2bit_kernel, donate=(1,))
+    return [
+        ContractCase("quant.q8_%d" % block, (flat, res), label="q8",
+                     target=q8),
+        ContractCase("quant.rt8_%d" % block, (flat, res), label="rt8",
+                     target=rt8),
+        ContractCase("quant.q2", (flat, res, thr), label="q2",
+                     target=q2),
+    ]
+
+
+def _declare_quant_contracts():
+    from ..programs import declare_contract
+    declare_contract(
+        "quant.gradient_wire", _quant_contract_cases,
+        donate_argnums=(1,),
+        temp_budget_bytes=1 << 20,
+        description="int8/2bit error-feedback kernels: the residual "
+                    "donates in-place (same shape+dtype out); codes/"
+                    "scales/threshold survive the call")
+
+
+_declare_quant_contracts()

@@ -41,6 +41,15 @@ this module is that layer:
   wired into the flight recorder (periodic step observer) and crash
   dumps (telemetry crash sections).
 
+* **Program contracts** (ISSUE 11) — the registry's declarative face:
+  :func:`declare_contract` lets each jit site state its abstract input
+  signatures (``jax.ShapeDtypeStruct`` trees), expected donation set,
+  temp-HBM budget and optionally a trace-closure spec.  Builders are
+  lazy (declaring costs a dict insert); ``python -m tools.mxlint
+  --contracts`` (tools/mxlint/contracts.py) lowers every declared case
+  device-free and proves donation aliasing, the HBM budget and closure
+  — see docs/TESTING.md §5.
+
 Hot-path contract (mxlint-rooted): :meth:`Program.__call__`,
 :func:`signature_of` and :meth:`ProgramRecord.note_compile` are
 dispatch-time bookkeeping only — they read shapes/avals and never sync a
@@ -67,6 +76,9 @@ __all__ = [
     "signature_of", "diff_signatures",
     "track_buffers", "buffer_census", "leak_detector", "LeakDetector",
     "CENSUS_OWNERS",
+    "CONTRACT_SCHEMA", "ContractCase", "ContractClosure",
+    "ProgramContract", "declare_contract", "contracts",
+    "contract_manifest", "reset_contracts",
 ]
 
 logger = logging.getLogger("mxnet_tpu.programs")
@@ -169,7 +181,20 @@ def diff_signatures(old: Tuple, new: Tuple) -> Optional[Dict[str, Any]]:
         elif da.get("shape") == db.get("shape") and \
                 da.get("dtype") == db.get("dtype") and \
                 da.get("device") != db.get("device"):
+            # same logical value, different placement: a LAYOUT change.
+            # When the device set is unchanged but the partitioning is
+            # (same mesh, new PartitionSpec — the FSDP resharding path),
+            # call it what it is: a sharding change, not a device move.
             change = "device"
+            try:
+                sa = a[2] if isinstance(a, tuple) and a[0] == "aval" else None
+                sb = b[2] if isinstance(b, tuple) and b[0] == "aval" else None
+                if sa is not None and sb is not None and \
+                        getattr(sa, "device_set", None) == \
+                        getattr(sb, "device_set", None):
+                    change = "sharding"
+            except Exception:
+                pass
         else:
             change = "leaf"
         changed.append({"arg": path, "change": change,
@@ -419,9 +444,23 @@ class Program:
 
         functools.update_wrapper(_trace_probe, fn, updated=())
         self._jit = jax.jit(_trace_probe, **jit_kw)
+        self._jit_kw = dict(jit_kw)
         self._aot = aot
         self._cache: Dict[Tuple, Any] = {}
         self._cache_lock = threading.Lock()
+
+    @property
+    def jit_kw(self) -> Dict[str, Any]:
+        """The jit kwargs this site registered with (donate_argnums,
+        static_argnums, shardings) — what the contract verifier proves
+        against."""
+        return dict(self._jit_kw)
+
+    def lower(self, *args, **kwargs):
+        """AOT-lower the wrapped jit without dispatching — the contract
+        verifier's device-free entry point (works with
+        jax.ShapeDtypeStruct trees; no buffers are materialized)."""
+        return self._jit.lower(*args, **kwargs)
 
     @property
     def record(self) -> ProgramRecord:
@@ -503,6 +542,161 @@ def register_program(name: str, fn: Callable, mode: str = "aot",
     if not census_enabled():
         return jax.jit(fn, **jit_kw)
     return Program(name, mode, fn, jit_kw, aot=(mode == "aot"))
+
+
+# ---------------------------------------------------------------------------
+# Program contracts (ISSUE 11): the registry's declarative face
+# ---------------------------------------------------------------------------
+
+# bumped when the manifest JSON layout changes; tools/bench_compare.py
+# --check-schema validates checked-in manifests against this version
+CONTRACT_SCHEMA = 1
+
+
+class ContractCase:
+    """One concrete, device-free lowering of a contracted program.
+
+    ``args``/``kwargs`` are abstract input trees (``jax.ShapeDtypeStruct``
+    leaves — no buffers); ``target`` is the site's own registered wrapper
+    (anything with ``.lower``, i.e. a :class:`Program` or a ``jax.jit``
+    object) so the verifier proves the EXACT jit spec the runtime ships.
+    Alternatively ``fn``+``jit_kw`` hand the verifier a raw traceable
+    body to jit itself (the kvstore exchange bodies, which normally
+    inline into the step program, are contracted standalone this way).
+    """
+
+    __slots__ = ("program", "label", "target", "fn", "jit_kw", "args",
+                 "kwargs")
+
+    def __init__(self, program: str, args: tuple, kwargs=None,
+                 label: Optional[str] = None, target=None,
+                 fn: Optional[Callable] = None, jit_kw=None):
+        if (target is None) == (fn is None):
+            raise ValueError("ContractCase needs exactly one of "
+                             "target= (a lowerable) or fn= (a raw body)")
+        self.program = str(program)
+        self.label = str(label if label is not None else program)
+        self.target = target
+        self.fn = fn
+        self.jit_kw = dict(jit_kw or {})
+        self.args = tuple(args)
+        self.kwargs = dict(kwargs or {})
+
+    def lower(self):
+        if self.target is not None:
+            return self.target.lower(*self.args, **self.kwargs)
+        return jax.jit(self.fn, **self.jit_kw).lower(*self.args,
+                                                     **self.kwargs)
+
+
+class ContractClosure:
+    """Static zero-retrace proof spec: ``points`` enumerates the
+    workload's reachable dispatch points (every admissible serve batch
+    size, every configured scan window, ...) and ``resolve(point)``
+    returns the abstract argument tree that point would dispatch with —
+    or None when the runtime provably rejects the point before the jit
+    (serve admission refusing an over-bucket batch).  The verifier
+    asserts every resolved signature is one of the declared cases'
+    signatures; a miss is an unproven shape, rendered through the
+    retrace explainer's diff."""
+
+    __slots__ = ("points", "resolve")
+
+    def __init__(self, points, resolve: Callable):
+        self.points = list(points)
+        self.resolve = resolve
+
+
+class ProgramContract:
+    """Declared invariants of one program family.
+
+    ``build()`` is LAZY — declaring a contract at import time costs a
+    dict insert; only the verifier (``python -m tools.mxlint
+    --contracts``) ever builds the cases.  ``donate_argnums`` is the
+    EXPECTED donation set: the verifier proves each donated leaf
+    actually appears in the lowered executable's input→output aliasing
+    (a dropped donation doubles HBM on TPU while CPU runs clean).
+    ``temp_budget_bytes`` caps the compiled ``memory_analysis`` temp
+    allocation — the static HBM-creep gate."""
+
+    __slots__ = ("name", "build", "donate_argnums", "temp_budget_bytes",
+                 "closure", "description", "origin")
+
+    def __init__(self, name: str, build: Callable,
+                 donate_argnums: Tuple[int, ...] = (),
+                 temp_budget_bytes: Optional[int] = None,
+                 closure: Optional[ContractClosure] = None,
+                 description: str = "",
+                 origin: Optional[Tuple[str, int]] = None):
+        self.name = str(name)
+        self.build = build
+        self.donate_argnums = tuple(sorted(int(i) for i in donate_argnums))
+        self.temp_budget_bytes = None if temp_budget_bytes is None \
+            else int(temp_budget_bytes)
+        self.closure = closure
+        self.description = str(description)
+        # (file, line) of the declaring site — contract findings anchor
+        # there, like any other mxlint diagnostic
+        self.origin = origin
+
+    def manifest_entry(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "donate_argnums": list(self.donate_argnums),
+            "temp_budget_bytes": self.temp_budget_bytes,
+            "closure_points": (
+                None if self.closure is None
+                else [str(p) for p in self.closure.points]
+                if isinstance(self.closure, ContractClosure)
+                # lazy closure: built (with its cases) only when the
+                # verifier runs — the static manifest records that one
+                # exists without paying the build
+                else "deferred"),
+            "description": self.description,
+        }
+
+
+_contracts_lock = threading.Lock()
+_contracts: Dict[str, ProgramContract] = {}
+
+
+def declare_contract(name: str, build: Callable, *,
+                     donate_argnums: Tuple[int, ...] = (),
+                     temp_budget_bytes: Optional[int] = None,
+                     closure: Optional[ContractClosure] = None,
+                     description: str = "") -> ProgramContract:
+    """Declare the contract for one program family.  ``build`` returns
+    the :class:`ContractCase` list when the verifier runs; everything
+    else is metadata recorded now.  Redeclaring a name replaces the
+    entry (module reloads in tests)."""
+    import sys as _sys
+    frame = _sys._getframe(1)
+    origin = (frame.f_code.co_filename, frame.f_lineno)
+    c = ProgramContract(name, build, donate_argnums=donate_argnums,
+                        temp_budget_bytes=temp_budget_bytes,
+                        closure=closure, description=description,
+                        origin=origin)
+    with _contracts_lock:
+        _contracts[c.name] = c
+    return c
+
+
+def contracts() -> List[ProgramContract]:
+    with _contracts_lock:
+        return [_contracts[k] for k in sorted(_contracts)]
+
+
+def contract_manifest() -> Dict[str, Any]:
+    """The declared (not built) manifest — what ships in
+    tools/mxlint/contracts.json and what bench_compare --check-schema
+    validates."""
+    return {"schema": CONTRACT_SCHEMA,
+            "contracts": [c.manifest_entry() for c in contracts()]}
+
+
+def reset_contracts() -> None:
+    with _contracts_lock:
+        _contracts.clear()
 
 
 # ---------------------------------------------------------------------------

@@ -309,3 +309,64 @@ class ModelHost:
     def history(self) -> List[Tuple[int, str]]:
         with self._lock:
             return list(self._history)
+
+
+# ---------------------------------------------------------------------------
+# Program contracts (ISSUE 11): the serve bucket table's declared
+# trace-closure proof.  One contract covers every `serve.demo.b<N>`
+# bucket program of the canonical demo servable under the CONFIGURED
+# bucket table (MX_SERVE_BUCKETS): the verifier lowers each bucket
+# program device-free and proves the admission path is CLOSED — every
+# admissible batch size pads to a bucket whose signature is in the
+# compiled set, and over-bucket sizes are rejected before the jit — so
+# "zero serve-time retraces" is a static theorem, not a bench
+# observation.  Builders run only inside the contracts verifier.
+# ---------------------------------------------------------------------------
+
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=1)
+def _demo_contract_built():
+    from ..programs import ContractCase, ContractClosure
+    from .demo import demo_block, DEMO_IN
+    table = BucketTable.from_env()
+    sv = Servable(demo_block(), name="demo", version=1, buckets=table)
+    params_abs = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                  for k, v in sv._param_values.items()}
+    sig = (((DEMO_IN,), "float32"),)
+
+    def args_for(bucket):
+        return (params_abs,
+                (jax.ShapeDtypeStruct((bucket, DEMO_IN), _np.float32),))
+
+    cases = [ContractCase("serve.demo.b%d" % b, args_for(b),
+                          label="b%d" % b, target=sv.program(b, sig))
+             for b in table]
+
+    def resolve(rows):
+        # mirror the runtime admission/padding path exactly: the
+        # batcher pads a rows-row batch up to bucket_for(rows), and
+        # over-bucket batches are refused at admission (never reach a
+        # jit) — resolving to None
+        bucket = table.bucket_for(int(rows))
+        return None if bucket is None else args_for(bucket)
+
+    closure = ContractClosure(range(1, table.max_size + 3), resolve)
+    return cases, closure
+
+
+def _declare_serve_contracts():
+    from ..programs import declare_contract
+    declare_contract(
+        "serve.demo", lambda: _demo_contract_built()[0],
+        donate_argnums=(),
+        temp_budget_bytes=1 << 20,
+        closure=lambda: _demo_contract_built()[1],
+        description="demo servable's AOT bucket table: no donations "
+                    "(params are shared across dispatches), trace "
+                    "signatures closed over the MX_SERVE_BUCKETS "
+                    "admission set")
+
+
+_declare_serve_contracts()

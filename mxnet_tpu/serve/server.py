@@ -54,6 +54,23 @@ from .servable import ModelHost, Servable
 
 __all__ = ["ServeServer", "serve_forever"]
 
+# The serving wire surface, DECLARED (ISSUE 11): mxlint's
+# wire-verb-exhaustive rule pairs every ServeClient-emitted verb with
+# an entry here, checks this file handles it, that 'replayable' verbs
+# sit in the exactly-once replay set (_CACHED) and 'idempotent' ones do
+# not, and that named codecs have encode_*/decode_* pairs in
+# kvstore/wire_codec.py.  The serve-router ROUTE verb (ROADMAP item 3)
+# lands by completing a row here — never half-wired.
+WIRE_VERBS = {
+    # one PREDICT = one dispatch, even replayed; one SWAP = one flip
+    "PREDICT": {"semantics": "replayable", "codec": "array"},
+    "SWAP": {"semantics": "replayable", "codec": None},
+    # probes and shutdown re-execute harmlessly on a retried envelope
+    "HEALTH": {"semantics": "idempotent", "codec": None},
+    "METRICS": {"semantics": "idempotent", "codec": "text"},
+    "STOP": {"semantics": "idempotent", "codec": None},
+}
+
 
 class ServeServer:
     """Verb handlers + replay cache over one (ModelHost, Batcher) pair."""
@@ -61,20 +78,35 @@ class ServeServer:
     # replies worth exactly-once semantics; HEALTH re-executes harmlessly
     _CACHED = ("PREDICT", "SWAP")
 
-    # replay-cache client bound: serving clients are ephemeral (every
-    # ServeClient is a fresh uuid), unlike the kvstore's fixed worker
-    # population — without eviction each dead client's last PREDICT
-    # response would be retained forever
-    _REPLAY_CAP = 512
-
     def __init__(self, host: Optional[ModelHost] = None,
                  batcher: Optional[Batcher] = None, **batcher_kw):
         self.host = host or ModelHost()
         self.batcher = batcher or Batcher(self.host, **batcher_kw)
         # client_id -> [seq, done Event, resp]  (same shape as the
-        # kvstore server's cache; one in-flight entry per client)
+        # kvstore server's cache; one in-flight entry per client).
+        # Serving clients are ephemeral (every ServeClient is a fresh
+        # uuid), unlike the kvstore's fixed worker population — without
+        # eviction each dead client's last PREDICT response (a full
+        # output tensor) would be retained forever.  Bounded per-client
+        # LRU: dict insertion order IS recency order because every
+        # touch (new seq or replay hit) moves the entry to the end;
+        # over-cap inserts evict the least-recently-touched RESOLVED
+        # entries, counted in serve.replay_evicted.
+        try:
+            raw_cap = get_env("MX_SERVE_REPLAY_CAP", 512, int)
+            # values < 1 clamp to 1 (never silently back to the
+            # default): the exactly-once contract requires at least the
+            # in-flight entry, so 0 cannot mean "disabled"
+            self._replay_cap = max(1, int(512 if raw_cap is None
+                                          else raw_cap))
+        except (TypeError, ValueError):
+            self._replay_cap = 512
         self._replay: Dict[str, list] = {}
         self._replay_lock = threading.Lock()
+        self._c_evicted = _telemetry.registry.counter(
+            "serve.replay_evicted",
+            doc="replay-cache entries dropped by the per-client LRU "
+                "bound (MX_SERVE_REPLAY_CAP)")
 
     # -- envelope (kvstore SEQ contract) ------------------------------------
     def handle_request(self, msg):
@@ -96,6 +128,10 @@ class ServeServer:
             ent = self._replay.get(cid)
             if ent is not None and seq == ent[0]:
                 dup = ent
+                # LRU touch: a replaying client is alive — move it to
+                # the recent end so churn from new clients cannot evict
+                # its in-flight exactly-once entry
+                self._replay[cid] = self._replay.pop(cid)
             elif ent is not None and seq < ent[0]:
                 span.event("stale", seq=seq, server_at=ent[0])
                 return False, ("stale request seq %s (server already at "
@@ -103,8 +139,9 @@ class ServeServer:
             else:
                 dup = None
                 ent = [seq, threading.Event(), None]
+                self._replay.pop(cid, None)   # re-insert at recent end
                 self._replay[cid] = ent
-                if len(self._replay) > self._REPLAY_CAP:
+                if len(self._replay) > self._replay_cap:
                     self._evict_replay_locked()
         if dup is not None:
             span.event("replay", seq=seq)
@@ -127,15 +164,20 @@ class ServeServer:
         return resp
 
     def _evict_replay_locked(self) -> None:
-        """Caller holds _replay_lock.  Drop oldest-inserted RESOLVED
-        entries until back under the cap; in-flight entries (Event not
-        set) are never evicted — their replay semantics are live."""
+        """Caller holds _replay_lock.  Drop least-recently-touched
+        RESOLVED entries until back under the cap; in-flight entries
+        (Event not set) are never evicted — their replay semantics are
+        live.  Each eviction bumps serve.replay_evicted."""
+        evicted = 0
         for cid in list(self._replay):
-            if len(self._replay) <= self._REPLAY_CAP:
+            if len(self._replay) <= self._replay_cap:
                 break
             ent = self._replay[cid]
             if ent[1].is_set():
                 del self._replay[cid]
+                evicted += 1
+        if evicted:
+            self._c_evicted.inc(evicted)
 
     # -- verbs --------------------------------------------------------------
     def handle(self, msg, span=None):

@@ -742,3 +742,114 @@ class CompiledStep:
             out0 = out[0] if isinstance(out, (list, tuple)) else out
             self._metric.update([y_nd], [out0])
         return loss
+
+
+# ---------------------------------------------------------------------------
+# Program contracts (ISSUE 11): the whole-step programs' declared
+# donation/HBM invariants and window closure.  The builder assembles a
+# small canonical model + Trainer (momentum SGD, so real slot state is
+# in the donated tree) and hands the verifier the EXACT traced bodies
+# `step.step` / `step.window` the runtime registers, with abstract
+# (ShapeDtypeStruct) state/batch trees — `python -m tools.mxlint
+# --contracts` lowers them device-free and proves all six donated
+# state groups alias outputs, the temp footprint fits the declared
+# budget, and the window set is trace-closed.
+# ---------------------------------------------------------------------------
+
+_CONTRACT_WINDOWS = (1, 4)      # the single step + one scan window
+_CONTRACT_BATCH = 8
+_CONTRACT_IN = 16
+
+
+def _contract_step() -> "CompiledStep":
+    import mxnet_tpu as mx
+    from .gluon import nn, Trainer
+    from .gluon.loss import SoftmaxCrossEntropyLoss
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, in_units=_CONTRACT_IN, activation="relu"))
+    net.add(nn.Dense(8, in_units=32))
+    net.initialize(mx.init.Xavier())
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.1, "momentum": 0.9})
+    return CompiledStep(net, SoftmaxCrossEntropyLoss(), trainer)
+
+
+def _abstract(tree):
+    return jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tree)
+
+
+def _step_abstract_args(cs, plan, n_steps):
+    """The abstract argument tree a window of `n_steps` dispatches with
+    — shared by the cases and the closure's resolve, so the closure
+    proof checks the SAME signature construction the cases compiled."""
+    state = _abstract(cs._gather_state(plan))
+    n_params = len(plan["trainable_idx"])
+    lr_rows = jax.ShapeDtypeStruct((n_steps, n_params), jnp.float32)
+    key = _ops_random.next_key()
+    rng = jax.ShapeDtypeStruct(key.shape, key.dtype)
+    if n_steps == 1:
+        xs = (jax.ShapeDtypeStruct((_CONTRACT_BATCH, _CONTRACT_IN),
+                                   jnp.float32),)
+        ys = jax.ShapeDtypeStruct((_CONTRACT_BATCH,), jnp.float32)
+    else:
+        xs = (jax.ShapeDtypeStruct((n_steps, _CONTRACT_BATCH,
+                                    _CONTRACT_IN), jnp.float32),)
+        ys = jax.ShapeDtypeStruct((n_steps, _CONTRACT_BATCH), jnp.float32)
+    return state + (lr_rows, None, rng, xs, ys)
+
+
+def _step_contract_case(cs, plan, n_steps):
+    from .programs import ContractCase
+    rescale, wds, _lr_rows, _decays = cs._lr_rows(plan, n_steps,
+                                                  _CONTRACT_BATCH)
+    fn = cs._build_fn(plan, n_steps, 1, rescale, wds, decays_on=False,
+                      metric_info=None, return_outs=False)
+    pname = "step.step" if n_steps == 1 else "step.window"
+    return ContractCase(pname, _step_abstract_args(cs, plan, n_steps),
+                        label="w%d" % n_steps, target=fn)
+
+
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=4)
+def _step_contract_built(configured_window: int):
+    """Keyed by the CONFIGURED scan window so a long-lived process that
+    changes MX_STEP_SCAN between verifies never reuses a closure built
+    for the old window set."""
+    from .programs import ContractClosure
+    cs = _contract_step()
+    plan = cs._plan()
+    assert plan is not None, cs.fallback_reason
+    cases = [_step_contract_case(cs, plan, n) for n in _CONTRACT_WINDOWS]
+
+    # window-set closure: the windows the step lane can actually
+    # dispatch are the single step plus the CONFIGURED scan window
+    # (MX_STEP_SCAN at verify time) — each must land on a declared
+    # case's signature, so an operator config outside the contracted
+    # window set fails the static proof instead of retracing at runtime
+    points = sorted({1, configured_window} | set(_CONTRACT_WINDOWS))
+    closure = ContractClosure(
+        points, lambda n: _step_abstract_args(cs, plan, int(n)))
+    return cases, closure
+
+
+def _declare_step_contracts():
+    from .programs import declare_contract
+
+    declare_contract(
+        "step.train",
+        lambda: _step_contract_built(scan_window() or 1)[0],
+        donate_argnums=(0, 1, 2, 3, 4, 5),
+        temp_budget_bytes=8 << 20,
+        closure=lambda: _step_contract_built(scan_window() or 1)[1],
+        description="whole-step compiled train programs: params, frozen "
+                    "aux, optimizer slots, fp32 masters, EF residuals "
+                    "and metric state all donate and write back; the "
+                    "batch, lr matrix and rng key survive; trace "
+                    "signatures closed over the configured window set")
+
+
+_declare_step_contracts()

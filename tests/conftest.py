@@ -32,10 +32,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 _tpu_reachable = False
 if TPU_LANE:
-    # probe in a SUBPROCESS (a wedged tunnel hangs in-process jax init)
-    from mxnet_tpu.base import probe_accelerator
+    # probe in a SUBPROCESS (a wedged tunnel hangs in-process jax init);
+    # budget env-tunable (MX_TPU_PROBE_TIMEOUT, default 120s) so the
+    # skip-cleanliness test can prove the path without burning two
+    # minutes of tier-1 wall time on a wedged tunnel
+    from mxnet_tpu.base import probe_accelerator, probe_timeout
 
-    _tpu_reachable = probe_accelerator(120)
+    _tpu_reachable = probe_accelerator(probe_timeout())
 else:
     # The axon TPU plugin's sitecustomize force-overrides the platform list
     # with jax.config.update("jax_platforms", "axon,cpu"), IGNORING the

@@ -1264,4 +1264,348 @@ def test_rule_set_is_complete():
             # ISSUE 6: the whole-program concurrency pass
             "unguarded-shared-write", "inconsistent-guard",
             "lock-order-cycle", "blocking-wait-unbounded",
-            "thread-leak"} <= set(RULES)
+            "thread-leak",
+            # ISSUE 11: the program-contract PR's AST rules
+            "retrace-hazard", "wire-verb-exhaustive"} <= set(RULES)
+
+
+# ---------------------------------------------------------------------------
+# retrace-hazard (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+STEP_PATH = "mxnet_tpu/step.py"
+
+
+def test_retrace_hazard_shape_branch_in_jitted_body():
+    code = src("""
+    import jax
+
+    def body(x, k):
+        if x.shape[0] > 4:
+            return x * k
+        return x
+
+    f = jax.jit(body)
+    """)
+    diags = lint_source(code, STEP_PATH, select={"retrace-hazard"})
+    assert rules_of(diags) == ["retrace-hazard"]
+    assert "x.shape" in diags[0].message and "body" in diags[0].message
+
+
+def test_retrace_hazard_scalar_literal_at_hot_call_site():
+    code = src("""
+    import jax
+
+    def body(x, k):
+        return x * k
+
+    _F = jax.jit(body)
+
+    class CompiledStep:
+        def _run(self, x):
+            return _F(x, 3.0)
+    """)
+    diags = lint_source(code, STEP_PATH, select={"retrace-hazard"})
+    assert rules_of(diags) == ["retrace-hazard"]
+    assert "3.0" in diags[0].message and "VALUE" in diags[0].message
+
+
+def test_retrace_hazard_negative_and_keyword_scalars():
+    # -1.0 parses as UnaryOp(USub, Constant) and k=3.0 arrives via
+    # node.keywords — both are value-keyed retrace amplifiers; a
+    # static_argnames-covered keyword is exempt
+    code = src("""
+    import jax
+
+    def body(x, c, k=None, mode=None):
+        return x * c + k
+
+    _F = jax.jit(body, static_argnames=("mode",))
+
+    class CompiledStep:
+        def _run(self, x):
+            return _F(x, -1.0, k=3.0, mode=2)
+    """)
+    diags = lint_source(code, STEP_PATH, select={"retrace-hazard"})
+    assert rules_of(diags) == ["retrace-hazard"] * 2
+    msgs = "\n".join(d.message for d in diags)
+    assert "-1.0" in msgs and "3.0" in msgs and "2" not in msgs.split()
+
+
+def test_retrace_hazard_register_program_site_and_static_exempt():
+    # static_argnums covers both halves: the branch argument and the
+    # scalar position are trace-static, so neither is a hazard
+    code = src("""
+    import jax
+    from mxnet_tpu.programs import register_program
+
+    def body(x, n):
+        if x.shape[0] > n:
+            return x
+        return x + n
+
+    _F = register_program("p", body, static_argnums=(1,))
+
+    class CompiledStep:
+        def _run(self, x):
+            return _F(x, 3)
+    """)
+    diags = lint_source(code, STEP_PATH, select={"retrace-hazard"})
+    # the shape branch still flags (x is traced); the scalar does not
+    assert rules_of(diags) == ["retrace-hazard"]
+    assert "x.shape" in diags[0].message
+
+    clean = src("""
+    import jax
+    from mxnet_tpu.programs import register_program
+
+    def body(x, n):
+        if x.shape[0] > n:
+            return x
+        return x + n
+
+    _F = register_program("p", body, static_argnums=(0, 1))
+    """)
+    assert lint_source(clean, STEP_PATH,
+                       select={"retrace-hazard"}) == []
+
+
+def test_retrace_hazard_suppressed_and_ops_exempt():
+    code = src("""
+    import jax
+
+    def body(x):
+        if x.shape[0] > 4:  # mxlint: disable=retrace-hazard
+            return x
+        return x
+
+    f = jax.jit(body)
+    """)
+    assert lint_source(code, STEP_PATH, select={"retrace-hazard"}) == []
+    # per-op eager kernels specialize by rank/shape by design — the
+    # rule's path scope exempts mxnet_tpu/ops entirely
+    unsuppressed = code.replace("  # mxlint: disable=retrace-hazard", "")
+    assert lint_source(unsuppressed, "mxnet_tpu/ops/matrix.py",
+                       select={"retrace-hazard"}) == []
+
+
+def test_reinjected_shape_branch_in_step_body_trips():
+    """ISSUE 11 reinjection: a per-shape python branch reintroduced into
+    the traced step body must trip retrace-hazard (and not be absorbed
+    by the shipped baseline)."""
+    p = os.path.join(REPO, "mxnet_tpu", "step.py")
+    with open(p) as f:
+        code = f.read()
+    anchor = ("            carry = (t_vals, f_vals, opt_states, w32s, "
+              "residuals, mstate)")
+    assert anchor in code, "_traced_step_window moved; update this test"
+    bad = code.replace(
+        anchor,
+        "            if xs[0].shape[0] > 4:\n"
+        "                pass\n" + anchor, 1)
+    diags = lint_source(bad, "mxnet_tpu/step.py")
+    assert "retrace-hazard" in rules_of(diags)
+    new, _, _ = apply_baseline(diags, load_baseline(BASELINE))
+    assert "retrace-hazard" in rules_of(new)
+
+
+# ---------------------------------------------------------------------------
+# wire-verb-exhaustive (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+WIRE_SERVER = "mxnet_tpu/serve/xserver.py"
+WIRE_CLIENT = "mxnet_tpu/serve/xclient.py"
+
+CLEAN_SERVER = src("""
+WIRE_VERBS = {
+    "ROUTE": {"semantics": "replayable", "codec": "blob"},
+    "DRAIN": {"semantics": "idempotent", "codec": None},
+}
+_CACHED = ("ROUTE",)
+
+def encode_blob(x):
+    return x
+
+def decode_blob(x):
+    return x
+
+def handle(msg):
+    cmd = msg[0]
+    if cmd == "ROUTE":
+        return True, "ok"
+    if cmd == "DRAIN":
+        return True, "ok"
+    return False, "unknown"
+""")
+
+CLEAN_CLIENT = src("""
+class C:
+    def route(self, x):
+        return self._rpc("ROUTE", x)
+
+    def drain(self):
+        return self._rpc("DRAIN")
+""")
+
+
+def test_wire_verbs_clean_pair():
+    diags = lint_sources({WIRE_SERVER: CLEAN_SERVER,
+                          WIRE_CLIENT: CLEAN_CLIENT},
+                         select={"wire-verb-exhaustive"})
+    assert diags == []
+
+
+def test_wire_verb_undeclared_emission():
+    client = CLEAN_CLIENT + src("""
+    class D:
+        def leave(self):
+            return self._rpc("LEAVE", 0)
+    """)
+    diags = lint_sources({WIRE_SERVER: CLEAN_SERVER, WIRE_CLIENT: client},
+                         select={"wire-verb-exhaustive"})
+    assert rules_of(diags) == ["wire-verb-exhaustive"]
+    assert "'LEAVE'" in diags[0].message and diags[0].path == WIRE_CLIENT
+
+
+def test_wire_verb_unhandled_bad_semantics_replay_and_codec():
+    server = src("""
+    WIRE_VERBS = {
+        "JOIN": {"semantics": "replayable", "codec": None},
+        "ROUTE": {"semantics": "maybe", "codec": "blob"},
+    }
+    _CACHED = ("PREDICT",)
+
+    def handle(msg):
+        cmd = msg[0]
+        if cmd == "ROUTE":
+            return True, "ok"
+    """)
+    diags = lint_sources({WIRE_SERVER: server},
+                         select={"wire-verb-exhaustive"})
+    msgs = "\n".join(d.message for d in diags)
+    assert "no handler comparison" in msgs          # JOIN unhandled
+    assert "missing from this file's replay-cache" in msgs
+    assert "semantics 'maybe'" in msgs              # ROUTE semantics
+    assert "encode_blob" in msgs                    # codec pair absent
+
+
+def test_wire_verb_handled_but_undeclared_and_idempotent_in_cache():
+    server = src("""
+    WIRE_VERBS = {
+        "ROUTE": {"semantics": "idempotent", "codec": None},
+    }
+    _CACHED = ("ROUTE",)
+
+    def handle(msg):
+        cmd = msg[0]
+        if cmd == "ROUTE":
+            return True, "ok"
+        if cmd == "EVICT":
+            return True, "ok"
+    """)
+    diags = lint_sources({WIRE_SERVER: server},
+                         select={"wire-verb-exhaustive"})
+    msgs = "\n".join(d.message for d in diags)
+    assert "does not declare it" in msgs            # EVICT handled only
+    assert "declared idempotent but sits" in msgs   # ROUTE in _CACHED
+
+
+def test_wire_verb_cross_protocol_declaration_does_not_mask():
+    """A verb declared only by ANOTHER protocol's manifest (kvstore's
+    STOP) must not satisfy a serve-client emission: declaration is
+    scoped to the client's own package directory when it has a
+    manifest."""
+    kv_server = src("""
+    WIRE_VERBS = {
+        "STOP": {"semantics": "idempotent", "codec": None},
+    }
+
+    def handle(msg):
+        cmd = msg[0]
+        if cmd == "STOP":
+            return True, "ok"
+    """)
+    # serve server manifest exists but does NOT declare STOP
+    serve_server = CLEAN_SERVER
+    serve_client = CLEAN_CLIENT + src("""
+    class S:
+        def stop(self):
+            return self._rpc("STOP")
+    """)
+    diags = lint_sources({"mxnet_tpu/kvstore/xserver.py": kv_server,
+                          WIRE_SERVER: serve_server,
+                          WIRE_CLIENT: serve_client},
+                         select={"wire-verb-exhaustive"})
+    assert any("'STOP'" in d.message and d.path == WIRE_CLIENT
+               for d in diags), "\n".join(map(repr, diags))
+    assert any("this protocol's server module" in d.message
+               for d in diags)
+    # a manifest-less directory still falls back to any manifest
+    tool_client = src("""
+    def shutdown(sock):
+        send_msg(sock, ("STOP", "rank0"))
+    """)
+    diags = lint_sources({"mxnet_tpu/kvstore/xserver.py": kv_server,
+                          "tools/xlaunch.py": tool_client},
+                         select={"wire-verb-exhaustive"})
+    assert diags == [], "\n".join(map(repr, diags))
+
+
+def test_wire_verb_suppressed_on_manifest_line():
+    server = CLEAN_SERVER.replace(
+        "WIRE_VERBS = {",
+        "WIRE_VERBS = {  # mxlint: disable=wire-verb-exhaustive")
+    server = server.replace(
+        '    "DRAIN": {"semantics": "idempotent", "codec": None},\n', "")
+    # DRAIN handled-but-undeclared anchors on the handler line; the
+    # manifest-line suppression covers manifest-side findings only
+    diags = lint_sources({WIRE_SERVER: server, WIRE_CLIENT: CLEAN_CLIENT},
+                         select={"wire-verb-exhaustive"})
+    assert {d.rule for d in diags} <= {"wire-verb-exhaustive"}
+    assert all("DRAIN" in d.message for d in diags), \
+        "\n".join(d.message for d in diags)
+
+
+def test_reinjected_unpaired_route_verb_trips():
+    """ISSUE 11 reinjection (acceptance criterion): a ROUTE verb added
+    to the serve client without completing the server's WIRE_VERBS row
+    ships half-wired and must fail lint."""
+    p = os.path.join(REPO, "mxnet_tpu", "serve", "client.py")
+    with open(p) as f:
+        code = f.read()
+    anchor = "    def stop(self) -> None:"
+    assert anchor in code, "ServeClient moved; update this test"
+    bad = code.replace(
+        anchor,
+        "    def route(self, payload):\n"
+        "        return self._rpc(\"ROUTE\", payload)\n\n" + anchor, 1)
+    sources = {"mxnet_tpu/serve/client.py": bad}
+    for rel in ("mxnet_tpu/serve/server.py",
+                "mxnet_tpu/kvstore/server.py",
+                "mxnet_tpu/kvstore/wire_codec.py"):
+        with open(os.path.join(REPO, rel)) as f:
+            sources[rel] = f.read()
+    diags = lint_sources(sources, select={"wire-verb-exhaustive"})
+    assert any("'ROUTE'" in d.message for d in diags), \
+        "\n".join(map(repr, diags))
+    new, _, _ = apply_baseline(diags, load_baseline(BASELINE))
+    assert any("'ROUTE'" in d.message for d in new)
+
+
+def test_shipped_wire_surface_is_declared():
+    """The shipped protocol surface: both server manifests parse, every
+    client verb is declared, and the replay sets agree with semantics
+    (the tree-level gate is test_shipped_tree_lints_clean; this pins
+    the extraction actually SEEING the manifests)."""
+    _diags, project = _scan_tree()
+    manifests = {p: s.wire.manifest for p, s in project.summaries.items()
+                 if getattr(s, "wire", None) is not None
+                 and s.wire.manifest is not None}
+    assert "mxnet_tpu/serve/server.py" in manifests
+    assert "mxnet_tpu/kvstore/server.py" in manifests
+    serve = manifests["mxnet_tpu/serve/server.py"]
+    assert set(serve) == {"PREDICT", "HEALTH", "METRICS", "SWAP", "STOP"}
+    assert serve["PREDICT"]["semantics"] == "replayable"
+    kv = manifests["mxnet_tpu/kvstore/server.py"]
+    assert {"INIT", "PUSH", "PULL", "SET_OPT", "BARRIER", "PING",
+            "STOP"} == set(kv)

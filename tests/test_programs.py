@@ -217,6 +217,55 @@ def test_explainer_names_the_changed_arg_in_light_mode():
     assert chg["change"] == "shape"
 
 
+def test_explainer_sharding_change_same_shape_dtype():
+    """ISSUE 11 satellite: a resharded argument — same shape, same
+    dtype, different PartitionSpec — must diff as a 'sharding' change,
+    not a generic leaf change.  This is the first explainer path FSDP
+    (ROADMAP item 1) will exercise: flipping a parameter from
+    replicated to fsdp-sharded retraces every program it feeds."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    mesh = Mesh(np.array(jax.devices("cpu")[:1]), ("fsdp",))
+    x = jnp.ones((8, 4), jnp.float32)
+    repl = jax.device_put(x, NamedSharding(mesh, P()))
+    shard = jax.device_put(x, NamedSharding(mesh, P("fsdp")))
+    old = programs.signature_of((repl,))
+    new = programs.signature_of((shard,))
+    diff = programs.diff_signatures(old, new)
+    assert diff is not None and diff["kind"] == "leaves"
+    (chg,) = diff["changed"]
+    assert chg["change"] == "sharding"
+    assert chg["before"]["shape"] == chg["after"]["shape"] == (8, 4)
+    assert chg["before"]["dtype"] == chg["after"]["dtype"] == "float32"
+    assert chg["before"]["device"] != chg["after"]["device"]
+    # identical shardings stay cache hits (no spurious diff)
+    assert programs.diff_signatures(
+        old, programs.signature_of(
+            (jax.device_put(x, NamedSharding(mesh, P())),))) is None
+
+
+def test_explainer_sharding_change_through_dispatch():
+    """End-to-end: dispatching an AOT program with a resharded
+    (shape/dtype-identical) argument builds a second executable — the
+    AOT cache keys on sharding, since an AOT executable rejects inputs
+    laid out differently — and the record's explainer diff names the
+    arg and the sharding change.  (Light mode defers to jax.jit's own
+    cache, which may normalize single-device shardings; the AOT lane is
+    the one serving/step programs use, so it is the one FSDP will
+    retrace through.)"""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    name = _name("reshard")
+    prog = programs.register_program(name, lambda x: x.sum())
+    mesh = Mesh(np.array(jax.devices("cpu")[:1]), ("fsdp",))
+    x = jnp.ones((8, 4), jnp.float32)
+    prog(jax.device_put(x, NamedSharding(mesh, P())))
+    prog(jax.device_put(x, NamedSharding(mesh, P("fsdp"))))
+    rec = programs.find_record(name)
+    assert rec.compiles == 2 and rec.retraces == 1
+    (chg,) = rec.last_retrace["diff"]["changed"]
+    assert chg["change"] == "sharding"
+    assert "[0]" in chg["arg"]
+
+
 def test_program_retrace_counter_in_telemetry():
     name = _name("metric")
     prog = programs.register_program(name, lambda x: x + 1)

@@ -330,28 +330,52 @@ def test_dispatch_failure_is_a_reply_not_a_severed_connection():
         state.close()
 
 
-def test_replay_cache_is_bounded():
+class _StubSpan:
+    def event(self, *a, **k):
+        pass
+
+
+def test_replay_cache_is_bounded_lru(monkeypatch):
+    """ISSUE 11 satellite: the replay cache is a bounded per-client LRU
+    (MX_SERVE_REPLAY_CAP) — over-cap inserts evict the least-recently-
+    touched RESOLVED entries (counted in serve.replay_evicted), a
+    replay hit refreshes its client's recency, and in-flight entries
+    are never evicted."""
+    from mxnet_tpu import telemetry
     from mxnet_tpu.serve.server import ServeServer
+    monkeypatch.setenv("MX_SERVE_REPLAY_CAP", "4")
     host, _sv, _net = _mk_host(buckets=(1,))
     state = ServeServer(host=host, max_delay_us=0, queue_cap=16)
     try:
-        state._REPLAY_CAP = 8
-        done = threading.Event()
-        done.set()
+        assert state._replay_cap == 4
+        monkeypatch.setattr(state, "handle",
+                            lambda inner, span=None: (True, "ok"))
+        span = _StubSpan()
+        ev0 = telemetry.registry.value("serve.replay_evicted") or 0
+        for i in range(4):
+            state._handle_seq("c%d" % i, 1, ("PREDICT",), "PREDICT",
+                              span)
+        # touch c0 via a replay hit: it becomes most-recent
+        assert state._handle_seq("c0", 1, ("PREDICT",), "PREDICT",
+                                 span) == (True, "ok")
+        # two new clients evict the LRU victims — c1 then c2, NOT the
+        # just-replayed c0
+        state._handle_seq("c4", 1, ("PREDICT",), "PREDICT", span)
+        state._handle_seq("c5", 1, ("PREDICT",), "PREDICT", span)
+        assert len(state._replay) <= 4
+        assert "c0" in state._replay
+        assert "c1" not in state._replay and "c2" not in state._replay
+        assert (telemetry.registry.value("serve.replay_evicted")
+                - ev0) == 2
+        # in-flight entries survive eviction pressure
+        pending = threading.Event()
         with state._replay_lock:
-            for i in range(20):
-                state._replay["c%d" % i] = [1, done, (True, None)]
-                if len(state._replay) > state._REPLAY_CAP:
-                    state._evict_replay_locked()
-            assert len(state._replay) <= state._REPLAY_CAP
-            # in-flight entries survive eviction
-            pending = threading.Event()
+            state._replay.pop("c0")
             state._replay["inflight"] = [2, pending, None]
-            for i in range(20, 40):
-                state._replay["c%d" % i] = [1, done, (True, None)]
-                if len(state._replay) > state._REPLAY_CAP:
-                    state._evict_replay_locked()
-            assert "inflight" in state._replay
+        for i in range(6, 16):
+            state._handle_seq("c%d" % i, 1, ("PREDICT",), "PREDICT",
+                              span)
+        assert "inflight" in state._replay
     finally:
         state.close()
 

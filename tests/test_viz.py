@@ -78,6 +78,9 @@ def test_tpu_lane_skips_cleanly_when_unreachable(tmp_path):
     env.pop("MX_FORCE_CPU", None)
     env.pop("JAX_PLATFORMS", None)
     env.pop("XLA_FLAGS", None)
+    # a wedged tunnel burns the FULL probe budget before skipping; 10s
+    # proves the same skip path without 2 minutes of tier-1 wall time
+    env["MX_TPU_PROBE_TIMEOUT"] = "10"
     r = subprocess.run(
         [sys.executable, "-m", "pytest", "tests/test_viz.py::"
          "test_print_summary_counts_params", "-q", "--no-header"],

@@ -39,6 +39,22 @@ sys.path.insert(0, REPO)
 REQUIRED_FIELDS = ("ts", "metric", "value", "unit")
 NUMERIC_FIELDS = ("ts", "value")
 
+# program-contract manifest (ISSUE 11): tools/mxlint/contracts.json,
+# written by `python -m tools.mxlint --contracts --write-manifest`.
+# Version must track mxnet_tpu.programs.CONTRACT_SCHEMA (this tool
+# stays jax-free, so the value is pinned here; tests/test_contracts.py
+# asserts the two constants agree).
+CONTRACT_SCHEMA = 1
+CONTRACT_MANIFEST = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "mxlint",
+    "contracts.json")
+CONTRACT_FIELDS = ("name", "donate_argnums", "temp_budget_bytes")
+# each program row carries a `cases` list (one entry per lowering —
+# e.g. fused_adam's plain AND mp cases); every case needs these
+CONTRACT_PROGRAM_FIELDS = ("program", "cases")
+CONTRACT_CASE_FIELDS = ("program", "label", "donated_expected",
+                        "aliased", "temp_bytes", "budget")
+
 
 def _base_mod():
     """mxnet_tpu.base loaded standalone (it only needs os/threading):
@@ -96,8 +112,82 @@ def check_schema(path) -> int:
             print("bench_compare: %s:%d: %s" % (path, lineno, why),
                   file=sys.stderr)
         return 1
+    rc = check_contract_manifest(CONTRACT_MANIFEST)
+    if rc:
+        return rc
     print("bench_compare: schema OK (%d records in %s)"
           % (len(load_history(path)), path))
+    return 0
+
+
+def check_contract_manifest(path) -> int:
+    """Validate the checked-in program-contract manifest (absent is OK —
+    the contracts lane may not have been run on this checkout)."""
+    if not os.path.exists(path):
+        return 0
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except ValueError as e:
+        print("bench_compare: %s: unparseable contract manifest: %s"
+              % (path, e), file=sys.stderr)
+        return 1
+    if not isinstance(doc, dict):
+        print("bench_compare: %s: contract manifest is not an object"
+              % path, file=sys.stderr)
+        return 1
+    bad = []
+    progs = doc.get("programs")
+    if progs is not None and not isinstance(progs, dict):
+        bad.append("'programs' is not an object")
+        doc = dict(doc, programs={})
+    if doc.get("schema") != CONTRACT_SCHEMA:
+        bad.append("contract schema %r != expected %d (regenerate with "
+                   "python -m tools.mxlint --contracts --write-manifest, "
+                   "or bump CONTRACT_SCHEMA in both places)"
+                   % (doc.get("schema"), CONTRACT_SCHEMA))
+    declared = doc.get("contracts", [])
+    if not isinstance(declared, list):
+        bad.append("'contracts' is not a list")
+        declared = []
+    for entry in declared:
+        if not isinstance(entry, dict):
+            # type corruption must be a finding, not a TypeError
+            bad.append("contract entry %r is not an object" % (entry,))
+            continue
+        for field in CONTRACT_FIELDS:
+            if field not in entry:
+                bad.append("contract entry %r missing field %r"
+                           % (entry.get("name", "?"), field))
+    for pname, row in (doc.get("programs") or {}).items():
+        if not isinstance(row, dict):
+            # type corruption must be a finding, not a TypeError
+            bad.append("program row %r is not an object" % pname)
+            continue
+        for field in CONTRACT_PROGRAM_FIELDS:
+            if field not in row:
+                bad.append("program row %r missing field %r"
+                           % (pname, field))
+        cases = row.get("cases") or []
+        if not isinstance(cases, list):
+            bad.append("program %r 'cases' is not a list" % pname)
+            cases = []
+        for case in cases:
+            if not isinstance(case, dict):
+                bad.append("program %r has a non-object case" % pname)
+                continue
+            for field in CONTRACT_CASE_FIELDS:
+                if field not in case:
+                    bad.append("program %r case %r missing field %r"
+                               % (pname, case.get("label", "?"), field))
+    if bad:
+        for why in bad:
+            print("bench_compare: %s: %s" % (path, why), file=sys.stderr)
+        return 1
+    print("bench_compare: contract manifest OK (%d contracts, %d "
+          "programs, schema %d)"
+          % (len(doc.get("contracts", [])),
+             len(doc.get("programs") or {}), CONTRACT_SCHEMA))
     return 0
 
 

@@ -57,4 +57,12 @@ echo "== lint: env-var doc consistency (tools/gen_env_docs.py --check)"
 echo "== lint: bench-history schema (tools/bench_compare.py --check-schema)"
 "$PY" tools/bench_compare.py --check-schema
 
+echo "== lint: program contracts (python -m tools.mxlint --contracts)"
+# device-free donation/HBM/trace-closure proofs (ISSUE 11): lowers every
+# contracted jit program under JAX_PLATFORMS=cpu and prints the
+# per-program budget table.  Wall-time budget: the lane must stay a
+# CI-speed check (<60s CPU; measured ~4s), so a hung lowering fails
+# loudly instead of stalling the pipeline.
+timeout -k 10 60 env JAX_PLATFORMS=cpu "$PY" -m tools.mxlint --contracts
+
 echo "lint: PASS"

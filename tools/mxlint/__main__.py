@@ -56,7 +56,31 @@ def main(argv=None) -> int:
     ap.add_argument("--select", default=None, metavar="RULES",
                     help="comma-separated rule ids to run (default: all)")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--contracts", action="store_true",
+                    help="run the program-contract lane instead of the "
+                    "AST rules: lower every contracted jit program "
+                    "device-free (JAX_PLATFORMS=cpu) and prove donation "
+                    "aliasing, temp-HBM budgets and trace closure "
+                    "(ISSUE 11; see tools/mxlint/contracts.py)")
+    ap.add_argument("--write-manifest", nargs="?", const="DEFAULT",
+                    default=None, metavar="FILE",
+                    help="with --contracts: write the contract manifest "
+                    "JSON (default tools/mxlint/contracts.json)")
     args = ap.parse_args(argv)
+
+    if args.contracts:
+        # the contract lane imports the runtime (jax + mxnet_tpu) —
+        # deliberately isolated from the pure-stdlib AST lanes above
+        from . import contracts as _contracts
+        out = args.write_manifest
+        if out == "DEFAULT":
+            out = _contracts.DEFAULT_MANIFEST
+        names = None
+        if args.select:
+            names = [r.strip() for r in args.select.split(",")
+                     if r.strip()]
+        return _contracts.run_cli(fmt=args.format, write_manifest=out,
+                                  contract_names=names)
 
     if args.list_rules:
         for rid, rule in sorted(RULES.items()):

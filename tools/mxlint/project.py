@@ -166,9 +166,31 @@ class ClassInfo:
             str(b).rsplit(".", 1)[-1] in _HANDLER_BASES for b in bases)
 
 
+class WireInfo:
+    """Per-file wire-protocol facts (ISSUE 11 wire-verb-exhaustive):
+    client-emitted verbs, server handler comparisons, the literal
+    ``WIRE_VERBS`` manifest, replay-cache verb tuples (``_CACHED`` /
+    ``_MUTATING``) and ``encode_*``/``decode_*`` codec basenames."""
+
+    __slots__ = ("emits", "handles", "manifest", "manifest_line",
+                 "replay_verbs", "codecs")
+
+    def __init__(self):
+        # [(verb, line, snippet)] — calls through _rpc/_send_np, and
+        # ("VERB", ...) tuple literals handed to send_msg
+        self.emits: List[Tuple[str, int, str]] = []
+        self.handles: Dict[str, int] = {}     # verb -> first compare line
+        # verb -> {"semantics": ..., "codec": ...} from a literal
+        # module/class-level WIRE_VERBS dict; None when absent
+        self.manifest: Optional[Dict[str, Dict[str, object]]] = None
+        self.manifest_line = 0
+        self.replay_verbs: Set[str] = set()
+        self.codecs: Set[Tuple[str, str]] = set()   # ("encode"|"decode", name)
+
+
 class FileSummary:
     __slots__ = ("path", "module", "funcs", "classes", "aliases",
-                 "hook_targets")
+                 "hook_targets", "wire")
 
     def __init__(self, path, module):
         self.path, self.module = path, module
@@ -178,6 +200,7 @@ class FileSummary:
         # ``X._grad_hook = <callable>`` assignment targets: overlap-
         # exchange callbacks that fire mid-backward (ISSUE 5)
         self.hook_targets: List[Tuple[object, int]] = []
+        self.wire = WireInfo()
 
 
 # ---------------------------------------------------------------------------
@@ -843,9 +866,92 @@ class _Summarizer:
         self._visit(node.slice)
 
 
+_VERB_RE = re.compile(r"^[A-Z][A-Z_]{2,}$")
+# the SEQ envelope wraps verbs, it is not one; PONG is a reply payload
+_NON_VERBS = {"SEQ", "PONG"}
+
+
+def _verb_const(node) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str) and \
+            _VERB_RE.match(node.value) and node.value not in _NON_VERBS:
+        return node.value
+    return None
+
+
+def _wire_summary(tree: ast.AST, lines: Sequence[str]) -> WireInfo:
+    """Extract the file's wire-protocol facts (see WireInfo)."""
+    w = WireInfo()
+
+    def snippet(n):
+        ln = getattr(n, "lineno", 1)
+        return lines[ln - 1].strip() if 1 <= ln <= len(lines) else ""
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            f = node.func
+            tail = f.attr if isinstance(f, ast.Attribute) else \
+                (f.id if isinstance(f, ast.Name) else None)
+            if tail in ("_rpc", "_send_np") and node.args:
+                verb = _verb_const(node.args[0])
+                if verb:
+                    w.emits.append((verb, node.lineno, snippet(node)))
+            elif tail == "send_msg":
+                for a in node.args:
+                    if isinstance(a, ast.Tuple) and a.elts:
+                        verb = _verb_const(a.elts[0])
+                        if verb:
+                            w.emits.append((verb, node.lineno,
+                                            snippet(node)))
+        elif isinstance(node, ast.Compare):
+            left_ok = isinstance(node.left, (ast.Name, ast.Subscript))
+            if not left_ok:
+                continue
+            for op, comp in zip(node.ops, node.comparators):
+                if isinstance(op, ast.Eq):
+                    verb = _verb_const(comp)
+                    if verb:
+                        w.handles.setdefault(verb, node.lineno)
+                elif isinstance(op, ast.In) and \
+                        isinstance(comp, (ast.Tuple, ast.List, ast.Set)):
+                    for el in comp.elts:
+                        verb = _verb_const(el)
+                        if verb:
+                            w.handles.setdefault(verb, node.lineno)
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            tname = node.targets[0].id
+            if tname == "WIRE_VERBS" and isinstance(node.value, ast.Dict):
+                manifest: Dict[str, Dict[str, object]] = {}
+                for k, v in zip(node.value.keys, node.value.values):
+                    verb = _verb_const(k)
+                    if not verb or not isinstance(v, ast.Dict):
+                        continue
+                    entry: Dict[str, object] = {}
+                    for ek, ev in zip(v.keys, v.values):
+                        if isinstance(ek, ast.Constant) and \
+                                isinstance(ev, ast.Constant):
+                            entry[str(ek.value)] = ev.value
+                    manifest[verb] = entry
+                w.manifest = manifest
+                w.manifest_line = node.lineno
+            elif tname in ("_CACHED", "_MUTATING") and \
+                    isinstance(node.value, (ast.Tuple, ast.List)):
+                for el in node.value.elts:
+                    verb = _verb_const(el)
+                    if verb:
+                        w.replay_verbs.add(verb)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for kind in ("encode", "decode"):
+                if node.name.startswith(kind + "_"):
+                    w.codecs.add((kind, node.name[len(kind) + 1:]))
+    return w
+
+
 def summarize(tree: ast.AST, path: str,
               lines: Sequence[str]) -> FileSummary:
-    return _Summarizer(path, tree, lines).summary
+    summary = _Summarizer(path, tree, lines).summary
+    summary.wire = _wire_summary(tree, lines)
+    return summary
 
 
 def summarize_source(source: str, path: str) -> Optional[FileSummary]:
@@ -1463,3 +1569,149 @@ class ThreadLeak(ProjectRule):
                 if ws.kind.startswith("Event."):
                     return True
         return False
+
+
+@register_rule
+class WireVerbExhaustive(ProjectRule):
+    id = "wire-verb-exhaustive"
+    description = ("every client-emitted wire verb (kvstore CMDs, serve "
+                   "PREDICT/HEALTH/METRICS/SWAP/STOP, the coming "
+                   "JOIN/LEAVE/ROUTE) must be fully wired: declared in a "
+                   "server-side WIRE_VERBS manifest with an explicit "
+                   "replayable-or-idempotent semantics, handled by a "
+                   "comparison in the declaring file, consistent with "
+                   "that file's exactly-once replay set, and — when it "
+                   "ships tensors — backed by an encode_*/decode_* "
+                   "codec pair somewhere in the scanned tree")
+    invariant_from = "ISSUE 11 (wire-protocol exhaustiveness)"
+
+    _SEMANTICS = ("replayable", "idempotent")
+
+    def check_project(self, project: ProjectIndex) -> Iterator[Diagnostic]:
+        manifests = []       # (path, WireInfo)
+        codecs: Set[Tuple[str, str]] = set()
+        for path, s in sorted(project.summaries.items()):
+            wire = getattr(s, "wire", None)
+            if wire is None:
+                continue
+            codecs |= wire.codecs
+            if wire.manifest is not None:
+                manifests.append((path, wire))
+        declared: Dict[str, List[str]] = {}
+        for path, wire in manifests:
+            for verb in wire.manifest:
+                declared.setdefault(verb, []).append(path)
+
+        def declares_for(client_path: str, verb: str) -> bool:
+            """Protocol scoping: a client's verbs must be declared by a
+            manifest in the SAME package directory when one exists
+            there (serve/client.py binds to serve/server.py's manifest
+            — kvstore's STOP must not mask a serve STOP dropped from
+            the serve manifest).  Files in manifest-less directories
+            (tools/launch.py driving the PS) fall back to any
+            manifest."""
+            holders = declared.get(verb)
+            if not holders:
+                return False
+            client_dir = client_path.rsplit("/", 1)[0]
+            local = [p for p, _w in manifests
+                     if p.rsplit("/", 1)[0] == client_dir]
+            if not local:
+                return True
+            return any(h.rsplit("/", 1)[0] == client_dir
+                       for h in holders)
+
+        # 1. manifest-side checks: semantics, handler, replay set, codec
+        for path, wire in manifests:
+            line = wire.manifest_line
+            for verb, entry in sorted(wire.manifest.items()):
+                sem = entry.get("semantics")
+                if sem not in self._SEMANTICS:
+                    d = self._emit(
+                        self.id, path, line, 0,
+                        "WIRE_VERBS entry %r declares semantics %r — "
+                        "every verb must state 'replayable' (exactly-"
+                        "once via the SEQ replay cache) or 'idempotent' "
+                        "(safe to re-execute on retry)" % (verb, sem),
+                        "WIRE_VERBS[%r]" % verb)
+                    if d:
+                        yield d
+                if verb not in wire.handles:
+                    d = self._emit(
+                        self.id, path, line, 0,
+                        "WIRE_VERBS declares %r but this file has no "
+                        "handler comparison for it — the verb is "
+                        "half-wired (a client can emit what no server "
+                        "dispatches)" % verb,
+                        "WIRE_VERBS[%r]" % verb)
+                    if d:
+                        yield d
+                if wire.replay_verbs:
+                    if sem == "replayable" and \
+                            verb not in wire.replay_verbs:
+                        d = self._emit(
+                            self.id, path, line, 0,
+                            "%r is declared replayable but is missing "
+                            "from this file's replay-cache verb tuple "
+                            "(_CACHED/_MUTATING) — a retried request "
+                            "would re-execute instead of replaying"
+                            % verb, "WIRE_VERBS[%r]" % verb)
+                        if d:
+                            yield d
+                    elif sem == "idempotent" and \
+                            verb in wire.replay_verbs:
+                        d = self._emit(
+                            self.id, path, line, 0,
+                            "%r is declared idempotent but sits in this "
+                            "file's replay-cache verb tuple — pick one: "
+                            "exactly-once (declare replayable) or "
+                            "re-executable (drop it from the cache set)"
+                            % verb, "WIRE_VERBS[%r]" % verb)
+                        if d:
+                            yield d
+                codec = entry.get("codec")
+                if codec is not None:
+                    for kind in ("encode", "decode"):
+                        if (kind, str(codec)) not in codecs:
+                            d = self._emit(
+                                self.id, path, line, 0,
+                                "verb %r names wire codec %r but no "
+                                "%s_%s() exists in the scanned tree — "
+                                "the payload cannot cross the wire"
+                                % (verb, codec, kind, codec),
+                                "WIRE_VERBS[%r]" % verb)
+                            if d:
+                                yield d
+            # 2. reverse exhaustiveness: a handled verb missing from the
+            # manifest means its contract (semantics, codec) is undeclared
+            for verb, hline in sorted(wire.handles.items()):
+                if verb not in wire.manifest:
+                    d = self._emit(
+                        self.id, path, hline, 0,
+                        "this file handles wire verb %r but its "
+                        "WIRE_VERBS manifest does not declare it — add "
+                        "the entry (semantics + codec) so the protocol "
+                        "surface stays exhaustive" % verb,
+                        "handles %r" % verb)
+                    if d:
+                        yield d
+
+        # 3. client side: every emitted verb must be declared somewhere
+        for path, s in sorted(project.summaries.items()):
+            wire = getattr(s, "wire", None)
+            if wire is None:
+                continue
+            for verb, line, snip in wire.emits:
+                if not declares_for(path, verb):
+                    d = self._emit(
+                        self.id, path, line, 0,
+                        "client-emitted wire verb %r has no WIRE_VERBS "
+                        "declaration in %s — the verb would ship "
+                        "half-wired (no declared semantics, no "
+                        "guaranteed handler)"
+                        % (verb,
+                           "this protocol's server module"
+                           if verb in declared
+                           else "any scanned server module"), snip)
+                    if d:
+                        yield d

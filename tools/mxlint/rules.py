@@ -227,6 +227,71 @@ def _static_param_names(fn: ast.AST,
     return static
 
 
+def _is_jax_jit(ctx: FileContext, node: ast.AST) -> bool:
+    return ctx.resolves_to(node, "jax.jit") or \
+        ctx.resolves_to(node, "jax.experimental.pjit.pjit")
+
+
+def _collect_jit_functions(ctx: FileContext):
+    """(fn node -> jit call-or-None) for every function this file jits
+    or registers as an op kernel — shared by jit-purity and
+    retrace-hazard."""
+    # every def in the file, by name (incl. nested), for by-name marks
+    defs_by_name: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+    marked: Dict[ast.AST, Optional[ast.Call]] = {}
+    in_ops = fnmatch.fnmatch(ctx.path, "mxnet_tpu/ops/*.py")
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                jit_call = None
+                hit = False
+                if _is_jax_jit(ctx, dec):
+                    hit = True
+                elif isinstance(dec, ast.Call):
+                    if _is_jax_jit(ctx, dec.func):
+                        hit, jit_call = True, dec
+                    elif ctx.resolves_to(dec.func, "functools.partial") \
+                            and dec.args and _is_jax_jit(ctx, dec.args[0]):
+                        hit, jit_call = True, dec
+                    elif in_ops and ctx.resolves_to(
+                            dec.func, "mxnet_tpu.ops.registry.register")\
+                            or in_ops and isinstance(dec.func, ast.Name)\
+                            and dec.func.id == "register":
+                        # no_jit exempts only when truthy (or not a
+                        # literal — then be conservative and exempt)
+                        if not any(kw.arg == "no_jit" and
+                                   (not isinstance(kw.value,
+                                                   ast.Constant) or
+                                    kw.value.value)
+                                   for kw in dec.keywords):
+                            hit = True
+                if hit:
+                    marked[node] = jit_call
+        elif isinstance(node, ast.Call):
+            fn_arg = None
+            jit_call = None
+            if _is_jax_jit(ctx, node.func) and node.args:
+                fn_arg, jit_call = node.args[0], node
+            elif _program_fn_arg(ctx, node) is not None:
+                # register_program(name, fn, **jit_kw): fn is traced
+                # exactly like jax.jit(fn, **jit_kw)'s arg (ISSUE 10)
+                fn_arg, jit_call = _program_fn_arg(ctx, node), node
+            elif in_ops and isinstance(node.func, ast.Name) and \
+                    node.func.id == "register" and len(node.args) >= 2:
+                if not any(kw.arg == "no_jit" and
+                           isinstance(kw.value, ast.Constant) and
+                           kw.value.value for kw in node.keywords):
+                    fn_arg = node.args[1]
+            if isinstance(fn_arg, ast.Name):
+                for d in defs_by_name.get(fn_arg.id, ()):
+                    marked.setdefault(d, jit_call)
+    return marked
+
+
 @register_rule
 class JitPurity(Rule):
     id = "jit-purity"
@@ -237,66 +302,7 @@ class JitPurity(Rule):
     invariant_from = "seed (pure-traceable op registry contract)"
 
     def _jit_functions(self, ctx: FileContext):
-        """(fn node, jit call-or-None) for every function this file jits
-        or registers as an op kernel."""
-        # every def in the file, by name (incl. nested), for by-name marks
-        defs_by_name: Dict[str, List[ast.AST]] = {}
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                defs_by_name.setdefault(node.name, []).append(node)
-        marked: Dict[ast.AST, Optional[ast.Call]] = {}
-        in_ops = fnmatch.fnmatch(ctx.path, "mxnet_tpu/ops/*.py")
-
-        def is_jax_jit(node):
-            return ctx.resolves_to(node, "jax.jit") or \
-                ctx.resolves_to(node, "jax.experimental.pjit.pjit")
-
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                for dec in node.decorator_list:
-                    jit_call = None
-                    hit = False
-                    if is_jax_jit(dec):
-                        hit = True
-                    elif isinstance(dec, ast.Call):
-                        if is_jax_jit(dec.func):
-                            hit, jit_call = True, dec
-                        elif ctx.resolves_to(dec.func, "functools.partial") \
-                                and dec.args and is_jax_jit(dec.args[0]):
-                            hit, jit_call = True, dec
-                        elif in_ops and ctx.resolves_to(
-                                dec.func, "mxnet_tpu.ops.registry.register")\
-                                or in_ops and isinstance(dec.func, ast.Name)\
-                                and dec.func.id == "register":
-                            # no_jit exempts only when truthy (or not a
-                            # literal — then be conservative and exempt)
-                            if not any(kw.arg == "no_jit" and
-                                       (not isinstance(kw.value,
-                                                       ast.Constant) or
-                                        kw.value.value)
-                                       for kw in dec.keywords):
-                                hit = True
-                    if hit:
-                        marked[node] = jit_call
-            elif isinstance(node, ast.Call):
-                fn_arg = None
-                jit_call = None
-                if is_jax_jit(node.func) and node.args:
-                    fn_arg, jit_call = node.args[0], node
-                elif _program_fn_arg(ctx, node) is not None:
-                    # register_program(name, fn, **jit_kw): fn is traced
-                    # exactly like jax.jit(fn, **jit_kw)'s arg (ISSUE 10)
-                    fn_arg, jit_call = _program_fn_arg(ctx, node), node
-                elif in_ops and isinstance(node.func, ast.Name) and \
-                        node.func.id == "register" and len(node.args) >= 2:
-                    if not any(kw.arg == "no_jit" and
-                               isinstance(kw.value, ast.Constant) and
-                               kw.value.value for kw in node.keywords):
-                        fn_arg = node.args[1]
-                if isinstance(fn_arg, ast.Name):
-                    for d in defs_by_name.get(fn_arg.id, ()):
-                        marked.setdefault(d, jit_call)
-        return marked
+        return _collect_jit_functions(ctx)
 
     def check(self, ctx: FileContext) -> Iterator[Diagnostic]:
         for fn, jit_call in sorted(self._jit_functions(ctx).items(),
@@ -523,6 +529,20 @@ class DonationAfterUse(Rule):
                    "silently skips donation, hiding the bug)")
     invariant_from = "ISSUE 3 (donated fused-optimizer buffers)"
 
+    # The INVERSE failure mode — a donation XLA silently DROPS because
+    # no output matches the donated leaf's shape+dtype, leaving both
+    # generations of the buffer live on TPU — is not statically visible
+    # in source and is covered by the contract lane instead:
+    # `python -m tools.mxlint --contracts` lowers every contracted
+    # program and emits `contract-donation-dropped` when a declared
+    # donation fails to appear in the executable's input→output
+    # aliasing (with jax's "donated buffers were not usable" warning
+    # attached).  A donated-but-value-unused arg (jax prunes it; e.g.
+    # the bf16 weights of a multi-precision Adam apply, whose new
+    # values derive from the fp32 masters) is a no-op donation — the
+    # verifier NOTES it in the budget table (`pruned` column) without
+    # flagging.  See docs/TESTING.md §5 and ISSUE 11.
+
     def check(self, ctx: FileContext) -> Iterator[Diagnostic]:
         # 1. name -> donated positions, for `f = jax.jit(g, donate_argnums=...)`
         #    bindings (local names and self.X attributes, file-wide)
@@ -608,3 +628,192 @@ class DonationAfterUse(Rule):
                     "belongs to XLA now — rebind the result or drop "
                     "donation" % (name, qual))
                 dead.discard(name)   # one report per buffer per call
+
+
+# ---------------------------------------------------------------------------
+# retrace-hazard
+# ---------------------------------------------------------------------------
+
+def _literal_static_spec(call: ast.Call) -> Tuple[Set[int], Set[str]]:
+    """(static positions, static names) literally declared at a jit
+    call site — the single source both halves of the retrace analysis
+    (bindings and direct calls) read, so a parsing fix lands once."""
+    nums: Set[int] = set()
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg not in ("static_argnums", "static_argnames"):
+            continue
+        v = kw.value
+        elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+        for el in elts:
+            if not isinstance(el, ast.Constant):
+                continue
+            if kw.arg == "static_argnums" and isinstance(el.value, int):
+                nums.add(el.value)
+            elif kw.arg == "static_argnames" and \
+                    isinstance(el.value, str):
+                names.add(el.value)
+    return nums, names
+
+
+def _jit_call_bindings(ctx: FileContext):
+    """Names (locals and ``self.X`` attrs) bound to jax.jit /
+    register_program results, with the literal static spec of each
+    binding's jit call — the call-site half of the retrace analysis."""
+    bound: Dict[str, Tuple[Set[int], Set[str]]] = {}
+    self_bound: Dict[str, Tuple[Set[int], Set[str]]] = {}
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Assign) or \
+                not isinstance(node.value, ast.Call):
+            continue
+        call = node.value
+        if not (_is_jax_jit(ctx, call.func) or
+                _program_fn_arg(ctx, call) is not None):
+            continue
+        st = _literal_static_spec(call)
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                bound[tgt.id] = st
+            elif isinstance(tgt, ast.Attribute) and \
+                    isinstance(tgt.value, ast.Name) and \
+                    tgt.value.id == "self":
+                self_bound[tgt.attr] = st
+    return bound, self_bound
+
+
+def _scalar_literal(node: ast.AST):
+    """The python numeric value of a literal operand, through unary
+    sign (``-1.0`` parses as UnaryOp(USub, Constant)); None otherwise.
+    bools are excluded (two values cannot amplify retraces)."""
+    sign = 1
+    if isinstance(node, ast.UnaryOp) and \
+            isinstance(node.op, (ast.USub, ast.UAdd)):
+        sign = -1 if isinstance(node.op, ast.USub) else 1
+        node = node.operand
+    if isinstance(node, ast.Constant) and \
+            isinstance(node.value, (int, float)) and \
+            not isinstance(node.value, bool):
+        return sign * node.value
+    return None
+
+
+@register_rule
+class RetraceHazard(Rule):
+    id = "retrace-hazard"
+    description = ("per-call-site retrace amplifiers on the hot-path "
+                   "surfaces whose zero-retrace behavior is contracted "
+                   "(step, serve, batcher, programs): python branches on "
+                   "a traced argument's .shape/.ndim inside a jitted "
+                   "body (each distinct shape compiles a separate "
+                   "executable — close the shape set or hoist the "
+                   "branch), and python scalar literals passed as traced "
+                   "operands at jit call sites in hot-path roots (the "
+                   "program cache keys scalars by VALUE, so every "
+                   "distinct scalar is a fresh compile).  Per-op eager "
+                   "kernels (mxnet_tpu/ops) are exempt: rank/shape "
+                   "specialization is their light-census contract")
+    invariant_from = "ISSUE 11 (program contracts: static zero-retrace)"
+
+    # scoped to the files whose dispatch behavior the contracts lane
+    # proves — the same surface the host-sync rule roots
+    path_patterns = tuple(sorted({pat for pat, _ in HOT_PATH_ROOTS}))
+
+    _SHAPE_ATTRS = ("shape", "ndim", "size")
+
+    def check(self, ctx: FileContext) -> Iterator[Diagnostic]:
+        yield from self._shape_branches(ctx)
+        yield from self._scalar_call_sites(ctx)
+
+    # -- (a) shape-specializing branches inside traced bodies ---------------
+    def _shape_branches(self, ctx: FileContext) -> Iterator[Diagnostic]:
+        for fn, jit_call in sorted(_collect_jit_functions(ctx).items(),
+                                   key=lambda kv: kv[0].lineno):
+            static = _static_param_names(fn, jit_call)
+            params = {a.arg for a in fn.args.args} | \
+                {a.arg for a in getattr(fn.args, "posonlyargs", [])}
+            traced = params - static
+            for node in ast.walk(fn):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                names = self._shape_reads(node.test, traced)
+                if names:
+                    yield ctx.diag(
+                        self.id, node,
+                        "branch on %s inside jitted %r specializes the "
+                        "executable per input shape — every new shape "
+                        "is a silent recompile; bucket the shapes "
+                        "(declare a contract closure), mark the "
+                        "argument static, or hoist the branch" %
+                        (", ".join(sorted(names)), fn.name))
+
+    def _shape_reads(self, test: ast.AST, traced: Set[str]) -> Set[str]:
+        """'x.shape...' chains rooted at a traced parameter inside a
+        branch test — through subscripts too (``xs[0].shape[0]``: the
+        tuple-of-batches layout every window body uses)."""
+        out: Set[str] = set()
+        for node in ast.walk(test):
+            if not isinstance(node, ast.Attribute) or \
+                    node.attr not in self._SHAPE_ATTRS:
+                continue
+            base = node.value
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            if isinstance(base, ast.Name) and base.id in traced:
+                out.add("%s.%s" % (base.id, node.attr))
+        return out
+
+    # -- (b) python scalars as traced operands in hot-path roots ------------
+    def _scalar_call_sites(self, ctx: FileContext) -> Iterator[Diagnostic]:
+        roots: List[str] = []
+        for pat, quals in HOT_PATH_ROOTS:
+            if not fnmatch.fnmatch(ctx.path, pat):
+                continue
+            for qual in ctx.functions:
+                if any(fnmatch.fnmatch(qual, qp) for qp in quals):
+                    roots.append(qual)
+        if not roots:
+            return
+        bound, self_bound = _jit_call_bindings(ctx)
+        hot = ctx.reachable_from(roots)
+        for qual in sorted(hot):
+            fn = ctx.functions[qual]
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                st = None
+                f = node.func
+                if isinstance(f, ast.Name) and f.id in bound:
+                    st = bound[f.id]
+                elif isinstance(f, ast.Attribute) and \
+                        isinstance(f.value, ast.Name) and \
+                        f.value.id == "self" and f.attr in self_bound:
+                    st = self_bound[f.attr]
+                elif isinstance(f, ast.Call) and \
+                        (_is_jax_jit(ctx, f.func) or
+                         _program_fn_arg(ctx, f) is not None):
+                    st = _literal_static_spec(f)
+                if st is None:
+                    continue
+                static_nums, static_names = st
+                hits = []
+                for pos, arg in enumerate(node.args):
+                    if pos in static_nums:
+                        continue
+                    val = _scalar_literal(arg)
+                    if val is not None:
+                        hits.append((val, arg))
+                for kw in node.keywords:
+                    if kw.arg is None or kw.arg in static_names:
+                        continue
+                    val = _scalar_literal(kw.value)
+                    if val is not None:
+                        hits.append((val, kw.value))
+                for val, anchor in hits:
+                    yield ctx.diag(
+                        self.id, anchor,
+                        "python scalar %r passed as a traced operand "
+                        "of a jitted call in %s (hot path): the "
+                        "program cache keys scalars by VALUE — each "
+                        "distinct value retraces; pass a jnp array "
+                        "or mark the position static"
+                        % (val, qual))
