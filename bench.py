@@ -798,6 +798,132 @@ def run_serve_bench(rate=None, duration=None, senders=12):
         # memory/cost metadata
         "census": _census_report(),
     }
+
+    # fleet-collector overhead probe (ISSUE 12 acceptance): INTERLEAVED
+    # paired closed-loop lanes against the SAME warm replica — each
+    # cycle runs a collector-off lane then a collector-on lane, and the
+    # gate compares the MEDIANS (interleaving cancels box drift, the
+    # median kills one-off scheduler spikes).  Expected <=2%, gate <=5%
+    # on BOTH throughput and p99.  The collector runs in a SUBPROCESS,
+    # matching the production topology (the supervisor hosts it): what
+    # lands on the replica is exactly the per-scrape METRICS handling
+    # (registry snapshot + one socket round-trip), not the collector's
+    # own merge loop stealing the GIL.
+    from mxnet_tpu.base import get_env as _get_env
+
+    def _probe_load(nreq, rate_):
+        cli = ServeClient([addr], timeout=30)
+        lat = []
+        sched = np.cumsum(rng.exponential(1.0 / rate_, nreq))
+        t0p = time.perf_counter()
+        for i in range(nreq):
+            due = t0p + sched[i]
+            d = due - time.perf_counter()
+            if d > 0:
+                time.sleep(d)
+            try:
+                cli.predict([payloads[i % len(payloads)]])
+                lat.append(time.perf_counter() - due)
+            except Exception:
+                pass        # shed/failed probes just shrink the sample
+        cli.close()
+        wallp = time.perf_counter() - t0p
+        lat.sort()
+        p99_ = lat[min(len(lat) - 1, int(0.99 * len(lat)))] * 1e3 \
+            if lat else 0.0
+        # plain floats: the latencies are contaminated with np.float64
+        # via the np.cumsum schedule, and a np.bool_ gate comparison
+        # would fail json.dumps
+        return float(len(lat) / wallp), float(p99_)
+
+    probe_rate = max(50.0, rate / 2.0)
+    fleet_interval = _get_env("MX_FLEET_INTERVAL", 2.0, float) or 2.0
+    # span >= 3 scrape rounds per lane so the paired delta actually
+    # contains scrapes (bounded so the probe stays a bench, not a soak)
+    probe_n = int(os.environ.get(
+        "MX_BENCH_FLEET_PROBE",
+        max(200, int(probe_rate * min(3.0 * fleet_interval, 8.0)))))
+
+    # (a) deterministic per-scrape cost: time the METRICS round-trip
+    # the collector performs; the replica-side duty cycle it implies
+    # (scrape_ms / interval_ms, an upper bound — it charges the whole
+    # round-trip as stolen replica CPU) is the gated number, because
+    # sub-5% paired deltas sit below a shared box's noise floor.
+    from mxnet_tpu import fleet as _fleet
+    scrape_ms = []
+    for _ in range(5):
+        t0s = time.perf_counter()
+        _fleet.fetch_metrics(addr, fmt="json")
+        scrape_ms.append((time.perf_counter() - t0s) * 1e3)
+    scrape_ms = sorted(scrape_ms)[len(scrape_ms) // 2]
+    modeled_pct = 100.0 * (scrape_ms / 1e3) / fleet_interval
+
+    # (b) interleaved paired lanes at the CONFIGURED MX_FLEET_INTERVAL;
+    # the collector subprocess is spawned fresh per on-lane so the
+    # adjacent off-lane is genuinely collector-free
+    probe_src = (
+        "import sys, time\n"
+        "sys.path.insert(0, %r)\n"
+        "from mxnet_tpu import fleet\n"
+        "c = fleet.FleetCollector([fleet.FleetMember('serve', 0, "
+        "addr=%r)], interval=%r)\n"
+        "c.scrape_once()\n"
+        "print('SCRAPING', flush=True)\n"
+        "c.start()\n"
+        "time.sleep(600)\n" % (os.path.dirname(os.path.abspath(__file__)),
+                               addr, fleet_interval))
+    cycles = int(os.environ.get("MX_BENCH_FLEET_CYCLES", 3))
+    off_tps, off_p99s, on_tps, on_p99s = [], [], [], []
+    for _cycle in range(cycles):
+        tp_, p99_ = _probe_load(probe_n, probe_rate)
+        off_tps.append(tp_)
+        off_p99s.append(p99_)
+        proc = subprocess.Popen([sys.executable, "-c", probe_src],
+                                stdout=subprocess.PIPE, text=True)
+        try:
+            line = proc.stdout.readline()   # first scrape completed
+            if "SCRAPING" not in line:
+                raise RuntimeError(
+                    "fleet probe collector failed to start")
+            # settle past the subprocess's interpreter+import CPU burst:
+            # production collectors start ONCE — the steady state under
+            # measurement is scraping, not python startup sharing the
+            # box with the replica for the lane's first second
+            time.sleep(0.75)
+            tp_, p99_ = _probe_load(probe_n, probe_rate)
+            on_tps.append(tp_)
+            on_p99s.append(p99_)
+        finally:
+            proc.kill()
+            proc.wait()
+
+    def _median(vs):
+        return sorted(vs)[len(vs) // 2] if vs else 0.0
+
+    off_tp, off_p99 = _median(off_tps), _median(off_p99s)
+    on_tp, on_p99 = _median(on_tps), _median(on_p99s)
+    tp_overhead = 100.0 * (off_tp - on_tp) / off_tp if off_tp else 0.0
+    p99_overhead = 100.0 * (on_p99 - off_p99) / off_p99 if off_p99 \
+        else 0.0
+    report["fleet_collector"] = {
+        "scrape_interval_s": fleet_interval,
+        "collector": "subprocess (supervisor topology)",
+        "cycles": cycles,
+        "scrape_roundtrip_ms": round(scrape_ms, 3),
+        "modeled_overhead_pct": round(modeled_pct, 3),
+        "throughput_off_rps": round(off_tp, 2),
+        "throughput_on_rps": round(on_tp, 2),
+        "p99_off_ms": round(off_p99, 3),
+        "p99_on_ms": round(on_p99, 3),
+        "throughput_overhead_pct": round(tp_overhead, 2),
+        "p99_overhead_pct": round(p99_overhead, 2),
+        "gate_pct": 5.0,
+        # the ISSUE acceptance gate, measured: median-of-interleaved
+        # throughput AND p99 deltas <=5% (negative deltas = noise
+        # favoring the collector-on lanes); the modeled duty cycle
+        # rides along as the deterministic cross-check
+        "within_gate": tp_overhead <= 5.0 and p99_overhead <= 5.0,
+    }
     stop_ev.set()
     print(json.dumps(report))
 

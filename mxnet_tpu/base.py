@@ -109,6 +109,18 @@ ENV_CATALOG: Dict[str, Any] = {
     "MX_PROGRAM_CENSUS": ("1", "XLA program census (mxnet_tpu/programs.py): 1 (default) routes every jit-creation site through the process-wide program registry - per-program compile-time histograms (program_compile_seconds{program}), XLA memory_analysis/cost_analysis metadata (program_temp_bytes/program_flops, where the backend provides them), retrace counts with a structured retrace-explainer diff (which arg's shape/dtype/tree structure changed), and the jax.live_arrays() device-buffer census bucketed by owner (params/optimizer_state/ef_residuals/serve/other) riding flight-recorder records and crash dumps.  0 makes register_program a plain jax.jit and disables the census."),
     "MX_LEAK_WARN_BYTES": ("67108864", "Buffer-census leak detector threshold: when total live device bytes grow monotonically across consecutive census checks by more than this many bytes, the census_leak_bytes gauge latches the streak, census.leak_trips increments and a warning names the growing owner buckets.  Any shrink resets the streak; 0 disables the trip (gauges still publish)."),
     "MX_BENCH_HISTORY": ("", "Path of the bench-trajectory history file tools/bench_compare.py appends each bench.py run to and gates regressions against (>10% throughput or >15% peak-temp-bytes vs the rolling best per metric); empty uses BENCH_HISTORY.jsonl next to bench.py."),
+    "MX_FLEET_INTERVAL": ("2.0", "Fleet collector (mxnet_tpu/fleet.py): seconds between scrape rounds over every registered member (serve replicas + PS servers via the METRICS wire verb, training workers via their heartbeat files' JSON payload).  A member that fails its scrape is marked absent on that same round.  0 disables the embedded supervisor collector."),
+    "MX_FLEET_RING": ("120", "Fleet collector: bounded time-series ring of merged fleet snapshots (one entry per scrape round, keyed (role, rank, instrument) inside).  The straggler/SLO detectors and tools/fleet_top.py read the ring; the newest entry rides supervisor crash dumps as the `fleet` section."),
+    "MX_FLEET_WINDOW": ("5", "Fleet detectors: sliding-window length in scrape rounds for straggler step-time medians and SLO burn (rolling p50/p99, rejection-rate) computation.  Short windows react faster; long windows smooth transients."),
+    "MX_FLEET_STRAGGLER_FACTOR": ("2.0", "Straggler detector: a worker whose windowed step duration exceeds this multiple of the fleet (lower-)median is flagged — fleet.stragglers gauge, a flight-recorder event and a structured warning naming the rank and its dominant phase (e.g. data_wait)."),
+    "MX_FLEET_STALE": ("", "Fleet collector: seconds a heartbeat-scraped member's beat may age before the member is marked absent.  Empty = auto: max(2x MX_FLEET_INTERVAL, 30s) - beats are per BATCH, so the floor stays above slow-rank step times (a 6s-step straggler must be NAMED, not flap absent).  Wire-scraped members (serve/PS) are instead marked absent on scrape failure."),
+    "MX_FLEET_SLO_P50_MS": ("", "Serving SLO target: fleet-merged rolling p50 of the MX_FLEET_SLO_PHASES histograms in milliseconds.  fleet.slo_burn{slo=p50_latency} publishes observed/target; burn > 1 latches a breach event.  Empty disables this tracker."),
+    "MX_FLEET_SLO_P99_MS": ("", "Serving SLO target: fleet-merged rolling p99 latency in milliseconds (same burn/latch semantics as MX_FLEET_SLO_P50_MS).  Empty disables."),
+    "MX_FLEET_SLO_REJECT_RATE": ("", "Serving SLO target: windowed fleet rejection-rate bound (rejected / (requests+rejected), from merged serve.* counter deltas).  Burn = observed/target into fleet.slo_burn{slo=rejection_rate}; > 1 latches.  Empty disables."),
+    "MX_FLEET_SLO_QUEUE": ("", "Serving SLO target: mean fleet queue depth bound (rows, from merged serve.queue_rows gauges).  Burn = observed/target into fleet.slo_burn{slo=queue_depth}; > 1 latches.  Empty disables."),
+    "MX_FLEET_SLO_PHASES": ("queue_wait,serve_dispatch", "Comma-separated step_phase_seconds phases whose fleet-merged histograms define the serving latency distribution the SLO p50/p99 trackers read (bucket-wise exact merge; identical boundaries required)."),
+    "MX_FLEET_PORT": ("", "Port the fleet collector's wire server binds (FLEET verb -> merged snapshot as a JSN payload, METRICS -> whole-fleet federation exposition; same length-prefixed envelope as the kvstore/serve wire).  This is the API surface the coming serve router/autoscaler consume.  Empty = no wire server."),
+    "MX_FLEET_HTTP_PORT": ("", "Port of the collector's Prometheus federation HTTP endpoint: GET /metrics returns every member's instruments re-labeled {role,rank,model} plus the fleet rollups — a single scrape covers the whole fleet; GET /fleet.json returns the merged snapshot.  Empty = no HTTP endpoint."),
 }
 
 

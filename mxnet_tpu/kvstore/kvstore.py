@@ -1359,6 +1359,21 @@ class KVStoreDistAsync(KVStore):
             return out
         return self._rpc_on(0, *msg)        # BARRIER
 
+    def metrics(self, fmt: str = "json"):
+        """Per-server telemetry scrape over the METRICS wire verb
+        (ISSUE 12): returns one decoded exposition per server —
+        ``fmt='json'`` a registry-snapshot dict, ``'prometheus'`` the
+        text exposition.  Read-only and idempotent; this is the same
+        surface the fleet collector (mxnet_tpu/fleet.py) scrapes."""
+        import json as _json
+        from .wire_codec import decode_text
+        out = []
+        for i in range(len(self._socks)):
+            payload = self._rpc_on(i, "METRICS", fmt)
+            text = decode_text(payload)
+            out.append(_json.loads(text) if fmt == "json" else text)
+        return out
+
     def init(self, key, value):
         keys, values = self._normalize(key, value)
         for k, v in zip(keys, values):

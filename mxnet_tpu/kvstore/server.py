@@ -155,6 +155,11 @@ WIRE_VERBS = {
     "PULL": {"semantics": "idempotent", "codec": None},
     "BARRIER": {"semantics": "idempotent", "codec": None},
     "PING": {"semantics": "idempotent", "codec": None},
+    # read-only telemetry scrape (ISSUE 12): the fleet collector reads
+    # a PS's live instrument registry over the same wire the workers
+    # use — no sidecar, no extra port.  telemetry.py imports no jax, so
+    # the numpy-only server process can afford it on every scrape.
+    "METRICS": {"semantics": "idempotent", "codec": "text"},
     "STOP": {"semantics": "idempotent", "codec": None},
 }
 
@@ -372,8 +377,11 @@ class KVStoreServer:
         return resp
 
     def _handle_seq(self, cid, seq, inner, cmd, span):
-        """SEQ-enveloped dispatch under the caller's server span."""
-        if cmd in ("PULL", "PING"):
+        """SEQ-enveloped dispatch under the caller's server span.
+        METRICS joins the PULL/PING cache bypass: it is read-only by
+        contract, and caching a whole registry exposition per scrape
+        would bloat the replay cache for nothing."""
+        if cmd in ("PULL", "PING", "METRICS"):
             return self.handle(inner, client_id=cid)
         with self._replay_lock:
             ent = self._replay.get(cid)
@@ -490,6 +498,18 @@ class KVStoreServer:
             if len(msg) > 1:
                 self.touch(msg[1])
             return True, "PONG"
+        if cmd == "METRICS":
+            # live telemetry scrape (ISSUE 12): the reply is this server
+            # process's whole instrument registry — Prometheus text by
+            # default, fmt='json' for the fleet collector's merge path.
+            # Read-only/idempotent; bypasses the replay cache.
+            from .. import telemetry as _telemetry
+            from .wire_codec import encode_text
+            fmt = msg[1] if len(msg) > 1 else "prometheus"
+            reg = _telemetry.registry
+            text = reg.to_json(indent=1) if fmt == "json" \
+                else reg.to_prometheus()
+            return True, encode_text(text)
         if cmd == "BARRIER":
             return self._handle_barrier(client_id)
         if cmd == "STOP":

@@ -30,7 +30,8 @@ import numpy as _np
 __all__ = ["is_wire_payload", "encode_wire", "decode_wire",
            "pack_2bit", "unpack_2bit",
            "is_array_payload", "encode_array", "decode_array",
-           "is_text_payload", "encode_text", "decode_text"]
+           "is_text_payload", "encode_text", "decode_text",
+           "is_json_payload", "encode_json", "decode_json"]
 
 _WIRE_TAG = "QGRAD"
 _ARR_TAG = "NPX"
@@ -82,6 +83,30 @@ def decode_text(obj) -> str:
     if not is_text_payload(obj):
         raise ValueError("not a TXT payload: %r" % (type(obj),))
     return obj[1].decode("utf-8")
+
+
+_JSN_TAG = "JSN"
+
+
+def is_json_payload(obj) -> bool:
+    return isinstance(obj, tuple) and len(obj) == 2 and obj[0] == _JSN_TAG
+
+
+def encode_json(obj) -> tuple:
+    """A JSON-able structure as a compact picklable tuple —
+    ``(JSN, utf8_bytes)``.  The fleet FLEET verb ships its merged
+    snapshot this way: the payload is a typed document (not free text),
+    crosses the wire as one bytes blob, and the receiving side gets a
+    plain dict with no pickle-of-arbitrary-objects surface."""
+    import json as _json
+    return (_JSN_TAG, _json.dumps(obj, default=str).encode("utf-8"))
+
+
+def decode_json(obj):
+    if not is_json_payload(obj):
+        raise ValueError("not a JSN payload: %r" % (type(obj),))
+    import json as _json
+    return _json.loads(obj[1].decode("utf-8"))
 
 
 def is_wire_payload(obj) -> bool:

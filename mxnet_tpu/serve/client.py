@@ -105,6 +105,11 @@ class ServeClient:
             # shutdown is best-effort: a replica that is already gone
             # must not cost the caller a retry deadline per replica
             policy.deadline = min(policy.deadline, 1.0)
+        elif pinned and msg[0] in ("HEALTH", "METRICS"):
+            # pinned probes ARE liveness checks (fleet scrapes, health
+            # sweeps): a dead replica should read as dead in seconds,
+            # not burn the full recovery deadline per member
+            policy.deadline = min(policy.deadline, 5.0)
         with self._lock:
             # ONE seq for every attempt: a same-replica retry must
             # replay the same (client_id, seq) so the server's

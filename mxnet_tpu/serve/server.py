@@ -193,6 +193,17 @@ class ServeServer:
             # phase histograms) as one TXT payload
             fmt = msg[1] if len(msg) > 1 else "prometheus"
             reg = _telemetry.registry
+            # a scrape self-describes the replica (ISSUE 12): the active
+            # servable rides the exposition as a model-labeled version
+            # gauge, which is where the fleet collector/federation get
+            # their `model` label from (no extra HEALTH round-trip)
+            try:
+                sv = self.host.active()
+                reg.gauge("serve.active_version",
+                          doc="live servable version per hosted model",
+                          labels={"model": sv.name}).set(sv.version)
+            except MXNetError:
+                pass        # empty host: nothing deployed yet
             text = reg.to_json(indent=1) if fmt == "json" \
                 else reg.to_prometheus()
             return True, encode_text(text)

@@ -80,7 +80,8 @@ __all__ = [
     "enabled", "tracing_enabled", "start_tracing", "stop_tracing",
     "Span", "phase", "rpc_span", "current_trace", "observe_phase",
     "FlightRecorder", "flight_recorder", "note_step",
-    "heartbeat_payload", "phase_snapshot",
+    "heartbeat_payload", "HEARTBEAT_SCHEMA", "parse_heartbeat",
+    "phase_snapshot",
     "dump_trace", "trace_events", "clear_trace", "dump_crash",
     "register_step_observer", "register_crash_section",
 ]
@@ -194,8 +195,18 @@ def _prom_name(name: str) -> str:
     return out if not out[:1].isdigit() else "_" + out
 
 
+def _escape_label_value(v) -> str:
+    """Prometheus text-exposition label-value escaping (format 0.0.4):
+    backslash, double-quote and newline must be escaped IN THIS ORDER
+    (backslash first, or the escapes themselves get re-escaped) — a
+    model name or checkpoint path containing any of them otherwise
+    emits an unparseable scrape line."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _prom_labels(labels: Dict[str, str], extra: str = "") -> str:
-    parts = ['%s="%s"' % (_prom_name(k), str(v).replace('"', '\\"'))
+    parts = ['%s="%s"' % (_prom_name(k), _escape_label_value(v))
              for k, v in sorted(labels.items())]
     if extra:
         parts.append(extra)
@@ -258,11 +269,19 @@ class Registry:
 
     # -- exposition ---------------------------------------------------------
     def snapshot(self) -> Dict[str, Any]:
-        """JSON-ready dict keyed ``name{label=value,...}``."""
+        """JSON-ready dict keyed ``name{label=value,...}``.  Each entry
+        additionally carries ``name`` and (when labeled) ``labels`` so
+        consumers that merge snapshots across processes — the fleet
+        collector (mxnet_tpu/fleet.py) — never have to parse the
+        display key back apart."""
         out: Dict[str, Any] = {}
         for inst in self.instruments():   # copies the list; no lock held
             key = inst.name + _prom_labels(inst.labels).replace('"', "")
-            out[key] = inst.snapshot()
+            entry = inst.snapshot()
+            entry["name"] = inst.name
+            if inst.labels:
+                entry["labels"] = dict(inst.labels)
+            out[key] = entry
         return out
 
     def to_json(self, indent=None) -> str:
@@ -279,6 +298,8 @@ class Registry:
             pname = "mx_" + _prom_name(name)
             doc = next((i.doc for i in insts if i.doc), "")
             if doc:
+                # HELP text escapes backslash + newline (same format)
+                doc = doc.replace("\\", "\\\\").replace("\n", "\\n")
                 lines.append("# HELP %s %s" % (pname, doc))
             lines.append("# TYPE %s %s" % (pname, insts[0].kind))
             for inst in insts:
@@ -749,18 +770,69 @@ def note_step(steps: int = 1, epoch: Optional[int] = None,
 
 _HEARTBEAT_FIELDS = ("step", "epoch", "batch", "steps_per_sec",
                      "throughput", "wire_bytes", "dispatches", "retries",
-                     "nan_events")
+                     "nan_events", "phases")
+
+# version stamp of the heartbeat JSON payload: parse_heartbeat (and
+# the supervisor's import-light copy) IGNORES payloads stamped with a
+# newer schema than this process understands — a mixed-version fleet's
+# old reader must drop a future beat's payload rather than mis-render
+# fields whose semantics changed (the head line still proves liveness)
+HEARTBEAT_SCHEMA = 1
 
 
 def heartbeat_payload() -> Optional[Dict[str, Any]]:
     """Compact dict of the latest step record for the heartbeat file's
-    JSON line (step, throughput, last-exchange bytes) — what the
-    supervisor's fleet status table renders.  None when no step has
-    been recorded (the heartbeat then stays the classic one-liner)."""
+    JSON line (step, throughput, last-exchange bytes, per-phase
+    seconds) — what the supervisor's fleet status table renders and the
+    fleet collector's degraded heartbeat-fallback scrape reads.  None
+    when no step has been recorded (the heartbeat then stays the
+    classic one-liner).
+
+    ``schema`` versions the payload; ``ts`` is the record's
+    injectable-clock stamp (mxnet_tpu.fault.now), which lets a
+    virtual-clock supervisor compute beat ages on the SAME clock the
+    beat was stamped with instead of racing wall time against st_mtime.
+    """
     rec = flight_recorder.last()
     if rec is None:
         return None
-    return {k: rec[k] for k in _HEARTBEAT_FIELDS if k in rec}
+    out = {k: rec[k] for k in _HEARTBEAT_FIELDS if k in rec}
+    out["schema"] = HEARTBEAT_SCHEMA
+    out["ts"] = rec.get("ts")
+    return out
+
+
+def parse_heartbeat(lines) -> Tuple[str, Dict[str, Any], int]:
+    """Parse a heartbeat file's lines -> ``(head, payload, malformed)``.
+
+    Line 1 is the classic ``<unix-time> <epoch> <batch>`` beat; line 2,
+    when present, is :func:`heartbeat_payload` JSON.  A second line that
+    fails to parse OR parses to a non-object (a torn write can leave
+    valid-JSON garbage like a bare number) is tolerated-and-counted:
+    ``payload`` comes back empty, ``malformed`` is 1, and the head line
+    still proves liveness.  Consumed by the fleet collector's
+    heartbeat-fallback scrape; ``tools/launch.py``'s
+    ``Supervisor._read_beat`` keeps an import-light inline copy of this
+    exact logic (the launcher must not import the framework on its
+    happy path) — keep the two in sync."""
+    head = lines[0] if lines else ""
+    payload: Dict[str, Any] = {}
+    malformed = 0
+    if len(lines) > 1 and lines[1].strip():
+        try:
+            payload = json.loads(lines[1])
+            if not isinstance(payload, dict):
+                raise ValueError("heartbeat payload is not an object")
+        except ValueError:
+            payload = {}
+            malformed = 1
+    try:
+        if payload.get("schema", HEARTBEAT_SCHEMA) > HEARTBEAT_SCHEMA:
+            payload = {}    # future schema: ignore, don't mis-render
+    except TypeError:
+        payload = {}
+        malformed = 1
+    return head, payload, malformed
 
 
 # ---------------------------------------------------------------------------
@@ -818,19 +890,23 @@ def dump_crash(reason: str, directory: Optional[str] = None,
         return None
 
 
-def dump_trace(path: Optional[str] = None, reset: bool = False
-               ) -> Optional[str]:
+def dump_trace(path: Optional[str] = None, reset: bool = False,
+               role: Optional[str] = None) -> Optional[str]:
     """Write this process's buffered spans as a chrome-trace JSON.
 
     Default path: ``MX_TELEMETRY_TRACE`` directory,
     ``trace-<role>-r<rank>-p<pid>.trace.json`` — what
-    ``tools/telemetry_dump.py`` merges across workers/servers."""
+    ``tools/telemetry_dump.py`` merges across workers/servers.
+    ``role`` overrides the DMLC_ROLE-derived label (the fleet
+    collector flushes its scrape spans as role ``fleet`` so they merge
+    into the chrome trace as their own row)."""
+    if role is None:
+        role = os.environ.get("DMLC_ROLE", "worker")
     if path is None:
         d = get_env("MX_TELEMETRY_TRACE", "")
         if not d:
             return None
         os.makedirs(d, exist_ok=True)
-        role = os.environ.get("DMLC_ROLE", "worker")
         path = os.path.join(d, "trace-%s-r%s-p%d.trace.json"
                             % (role, _rank(), os.getpid()))
     with _trace_lock:
@@ -840,8 +916,7 @@ def dump_trace(path: Optional[str] = None, reset: bool = False
     payload = {
         "traceEvents": events,
         "displayTimeUnit": "ms",
-        "metadata": {"pid": os.getpid(), "rank": _rank(),
-                     "role": os.environ.get("DMLC_ROLE", "worker")},
+        "metadata": {"pid": os.getpid(), "rank": _rank(), "role": role},
     }
     tmp = "%s.tmp.%d" % (path, os.getpid())
     with open(tmp, "w") as f:

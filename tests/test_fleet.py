@@ -1,0 +1,861 @@
+"""Fleet telemetry plane (ISSUE 12): merge algebra exactness (counter
+restart rebasing, gauge rollups, bucket-wise histogram merge + quantile
+reproduction, mismatched-boundary rejection), wire scraping of a real
+kvstore server and a real serve replica (merged p99 == per-replica p99
+within one bucket boundary), absent-member marking within one scrape,
+straggler naming within two windows, SLO burn + latched breach on a
+rejection spike, the FLEET verb + federation faces, fleet_top rendering,
+the supervisor embed, and the mxlint hot-path reinjection."""
+import importlib.util
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from mxnet_tpu import fault, fleet, telemetry  # noqa: E402
+from mxnet_tpu.base import ENV_CATALOG, MXNetError  # noqa: E402
+from mxnet_tpu.fleet import (FleetCollector, FleetMember,  # noqa: E402
+                             FleetMergeError, SLOTracker,
+                             StragglerDetector, merge_bucket_maps,
+                             merge_snapshots, quantile_from_buckets)
+from mxnet_tpu.telemetry import Registry  # noqa: E402
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        "mx_%s_fleet_test" % name,
+        os.path.join(REPO, "tools", "%s.py" % name))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _beat(path, payload, head="0 0"):
+    with open(path, "w") as f:
+        f.write("%f %s\n" % (time.time(), head))
+        if payload is not None:
+            f.write(payload if isinstance(payload, str)
+                    else json.dumps(payload))
+            f.write("\n")
+
+
+# ---------------------------------------------------------------------------
+# merge algebra
+# ---------------------------------------------------------------------------
+
+def test_counters_sum_and_gauges_roll_up():
+    r1, r2 = Registry(), Registry()
+    r1.counter("serve.requests").inc(5)
+    r2.counter("serve.requests").inc(7)
+    r1.gauge("serve.queue_rows").set(4)
+    r2.gauge("serve.queue_rows").set(10)
+    m = merge_snapshots({"serve:0": r1.snapshot(),
+                         "serve:1": r2.snapshot()})
+    c = m["counters"]["serve.requests"]
+    assert c["total"] == 12
+    assert c["per_member"] == {"serve:0": 5, "serve:1": 7}
+    g = m["gauges"]["serve.queue_rows"]
+    assert g["min"] == 4 and g["max"] == 10 and g["mean"] == 7.0
+
+
+def test_histogram_merge_is_exact_vs_union():
+    """merged(p50/p99) == quantiles recomputed from the union of
+    observations on identical bucket boundaries."""
+    buckets = (0.001, 0.01, 0.1, 1.0)
+    obs_a = [0.0005, 0.005, 0.05, 0.05]
+    obs_b = [0.005, 0.5, 0.5, 0.5, 0.05]
+    ra, rb, runion = Registry(), Registry(), Registry()
+    ha = ra.histogram("lat", buckets=buckets)
+    hb = rb.histogram("lat", buckets=buckets)
+    hu = runion.histogram("lat", buckets=buckets)
+    for v in obs_a:
+        ha.observe(v)
+        hu.observe(v)
+    for v in obs_b:
+        hb.observe(v)
+        hu.observe(v)
+    merged = merge_snapshots({"a": ra.snapshot(), "b": rb.snapshot()})
+    mh = merged["histograms"]["lat"]
+    union = hu.snapshot()
+    assert mh["buckets"] == union["buckets"]
+    assert mh["count"] == len(obs_a) + len(obs_b)
+    for q in (0.5, 0.9, 0.99):
+        assert quantile_from_buckets(mh["buckets"], q) == \
+            quantile_from_buckets(union["buckets"], q)
+
+
+def test_mismatched_boundaries_rejected():
+    ra, rb = Registry(), Registry()
+    ra.histogram("lat", buckets=(0.01, 0.1)).observe(0.05)
+    rb.histogram("lat", buckets=(0.02, 0.2)).observe(0.05)
+    with pytest.raises(FleetMergeError) as ei:
+        merge_snapshots({"a": ra.snapshot(), "b": rb.snapshot()})
+    assert "lat" in str(ei.value) and "boundaries" in str(ei.value)
+
+
+def test_quantile_upper_bound_convention():
+    assert quantile_from_buckets({}, 0.99) == 0.0
+    b = {"0.01": 1, "0.1": 3, "1": 4, "+Inf": 4}
+    assert quantile_from_buckets(b, 0.25) == 0.01
+    assert quantile_from_buckets(b, 0.5) == 0.1
+    assert quantile_from_buckets(b, 1.0) == 1.0
+    # mass above the top bound reports the largest FINITE boundary
+    # (Prometheus histogram_quantile convention) — an inf here would
+    # serialize as the non-RFC 'Infinity' token on the JSON faces
+    b_inf = {"0.01": 0, "+Inf": 2}
+    assert quantile_from_buckets(b_inf, 0.99) == 0.01
+    assert json.loads(json.dumps(quantile_from_buckets(b_inf, 0.99)))
+
+
+def test_merge_bucket_maps_sums_and_checks():
+    a = {"0.1": 1, "+Inf": 2}
+    b = {"0.1": 3, "+Inf": 4}
+    assert merge_bucket_maps([a, b]) == {"0.1": 4, "+Inf": 6}
+    assert merge_bucket_maps([a, {}]) == a      # empties drop out
+    with pytest.raises(FleetMergeError):
+        merge_bucket_maps([a, {"0.2": 1, "+Inf": 1}], name="x")
+
+
+def test_counter_restart_rebased_not_double_counted(tmp_path):
+    """A member restart resets its process counters; the fleet total
+    must neither jump backwards nor double-count the pre-restart work."""
+    hb = str(tmp_path / "rank_0")
+    c = FleetCollector([FleetMember("worker", 0, heartbeat=hb)],
+                       interval=0.01, stale_after=60)
+    _beat(hb, {"schema": 1, "step": 100, "steps_per_sec": 10.0})
+    m1 = c.scrape_once()
+    assert m1["counters"]["worker.steps"]["total"] == 100
+    _beat(hb, {"schema": 1, "step": 130, "steps_per_sec": 10.0})
+    m2 = c.scrape_once()
+    assert m2["counters"]["worker.steps"]["total"] == 130
+    # restart: the rank's step counter resets and climbs to 20
+    _beat(hb, {"schema": 1, "step": 20, "steps_per_sec": 10.0})
+    m3 = c.scrape_once()
+    assert m3["counters"]["worker.steps"]["total"] == 150   # 130 + 20
+    _beat(hb, {"schema": 1, "step": 25, "steps_per_sec": 10.0})
+    m4 = c.scrape_once()
+    assert m4["counters"]["worker.steps"]["total"] == 155
+    totals = [m["counters"]["worker.steps"]["total"]
+              for m in (m1, m2, m3, m4)]
+    assert totals == sorted(totals)             # monotone
+
+
+# ---------------------------------------------------------------------------
+# detectors
+# ---------------------------------------------------------------------------
+
+def test_straggler_named_within_two_windows():
+    det = StragglerDetector(factor=2.0, window=5)
+    fast = {"step_seconds": 0.1,
+            "phases": {"forward": 0.06, "data_wait": 0.02}}
+    slow = {"step_seconds": 0.3,
+            "phases": {"forward": 0.08, "data_wait": 0.2}}
+    found = []
+    for _round in range(2):
+        found = det.update({"worker:0": fast, "worker:1": slow})
+    assert len(found) == 1
+    f = found[0]
+    assert f["member"] == "worker:1"
+    assert f["ratio"] >= 3.0 - 1e-6
+    assert f["dominant_phase"] == "data_wait"
+    assert f["dominant_share"] > 0.5
+
+
+def test_no_straggler_when_uniform():
+    det = StragglerDetector(factor=2.0, window=3)
+    s = {"step_seconds": 0.1, "phases": {"forward": 0.1}}
+    for _ in range(3):
+        assert det.update({"worker:0": dict(s), "worker:1": dict(s)}) \
+            == []
+
+
+def test_slo_latch_on_rejection_spike():
+    tr = SLOTracker(window=4, targets={"rejection_rate": 0.05})
+    out = tr.update({}, rejected_delta=0, offered_delta=100,
+                    queue_depth=0)
+    assert out["burn"]["rejection_rate"] == 0.0
+    assert out["breached"] == {}
+    out = tr.update({}, rejected_delta=40, offered_delta=100,
+                    queue_depth=0)
+    assert out["burn"]["rejection_rate"] > 1.0
+    assert "rejection_rate" in out["breached"]
+    # latched: a healthy round later, the breach stays raised
+    out = tr.update({}, rejected_delta=0, offered_delta=100,
+                    queue_depth=0)
+    assert "rejection_rate" in out["breached"]
+    # latched: healthy rounds (even past the window) keep it raised
+    for _ in range(5):
+        out = tr.update({}, rejected_delta=0, offered_delta=100,
+                        queue_depth=0)
+    assert out["burn"]["rejection_rate"] == 0.0
+    assert "rejection_rate" in out["breached"]
+    # only an explicit operator reset un-latches — and with the spike
+    # aged out of the window it stays quiet
+    tr.reset()
+    out = tr.update({}, rejected_delta=0, offered_delta=100,
+                    queue_depth=0)
+    assert out["breached"] == {}
+
+
+def test_slo_latency_burn_from_bucket_deltas():
+    tr = SLOTracker(window=4, targets={"p99_latency": 50.0})
+    fast = {"0.01": 10, "0.1": 10, "+Inf": 10}       # all <= 10ms
+    out = tr.update(fast, 0, 10, 0)
+    assert out["p99_ms"] == 10.0 and out["breached"] == {}
+    slow = {"0.01": 0, "0.1": 20, "+Inf": 20}        # all <= 100ms
+    out = tr.update(slow, 0, 20, 0)
+    assert out["p99_ms"] == 100.0
+    assert out["burn"]["p99_latency"] == 2.0
+    assert "p99_latency" in out["breached"]
+
+
+def test_slo_latency_window_ages_out_when_idle():
+    """Idle rounds roll the window too: a spike must not keep burn hot
+    forever on a fleet serving zero traffic (review finding)."""
+    tr = SLOTracker(window=3, targets={"p99_latency": 50.0})
+    spike = {"0.01": 0, "0.1": 10, "+Inf": 10}       # p99 = 100ms
+    out = tr.update(spike, 0, 10, 0)
+    assert out["burn"]["p99_latency"] == 2.0
+    for _ in range(3):                               # 3 idle rounds
+        out = tr.update({}, 0, 0, 0)
+    assert out["p99_ms"] == 0.0
+    assert out["burn"]["p99_latency"] == 0.0
+    # the breach stays LATCHED by design; only the live burn decays
+    assert "p99_latency" in out["breached"]
+
+
+def test_straggler_history_survives_one_missed_round():
+    det = StragglerDetector(factor=2.0, window=5)
+    fast = {"step_seconds": 0.1, "phases": {"forward": 0.1}}
+    slow = {"step_seconds": 0.3, "phases": {"data_wait": 0.3}}
+    for _ in range(3):
+        det.update({"worker:0": fast, "worker:1": slow})
+    # worker:1 misses ONE round (transient scrape failure): its window
+    # must survive, and it is named again the moment it reports
+    det.update({"worker:0": fast})
+    found = det.update({"worker:0": fast, "worker:1": slow})
+    assert [f["member"] for f in found] == ["worker:1"]
+    # a full window of misses DOES retire the history
+    for _ in range(6):
+        det.update({"worker:0": fast})
+    assert det.update({"worker:0": fast}) == []
+
+
+def test_straggler_ages_out_present_but_durationless_worker():
+    """A worker that stays PRESENT but stops reporting a usable step
+    duration (e.g. its payload is dropped by the schema gate) must age
+    out of detection like an absent one — not stay flagged forever on
+    a frozen pre-silence mean (review finding)."""
+    det = StragglerDetector(factor=2.0, window=3)
+    fast = {"step_seconds": 0.1, "phases": {"forward": 0.1}}
+    slow = {"step_seconds": 0.3, "phases": {"data_wait": 0.3}}
+    for _ in range(3):
+        det.update({"worker:0": fast, "worker:1": slow})
+    mute = {"step_seconds": None, "phases": {}}
+    out = []
+    for _ in range(5):      # present every round, never a duration
+        out = det.update({"worker:0": fast, "worker:1": mute})
+    assert out == []        # frozen history retired, flag dropped
+
+
+def test_first_scrape_lifetime_totals_do_not_latch_slo(tmp_path):
+    """Attaching a collector to an already-running fleet must not
+    compute burn over lifetime history (review finding)."""
+    r = Registry()
+    r.counter("serve.requests").inc(100)
+    r.counter("serve.rejected").inc(1000)    # ancient startup burst
+    snap = r.snapshot()
+    c = FleetCollector([FleetMember("serve", 0, addr="127.0.0.1:1")],
+                       interval=0.05,
+                       slo_targets={"rejection_rate": 0.05})
+    st = c._state["serve:0"]
+    c._rebase_counters(st, snap)
+    merged = c._fold(c.members(), {"serve:0": (snap, "wire", None, 0)})
+    assert merged["slo"]["burn"].get("rejection_rate", 0.0) == 0.0
+    assert merged["slo"]["breached"] == {}
+
+
+def test_model_of_prefers_highest_version():
+    r = Registry()
+    r.gauge("serve.active_version", labels={"model": "mlp-a"}).set(1)
+    r.gauge("serve.active_version", labels={"model": "mlp-b"}).set(2)
+    assert FleetCollector._model_of(r.snapshot()) == "mlp-b"
+
+
+def test_breached_gauge_clears_after_reset(tmp_path):
+    hb = str(tmp_path / "rank_0")
+    _beat(hb, {"schema": 1, "step": 1, "steps_per_sec": 5.0})
+    c = FleetCollector([FleetMember("worker", 0, heartbeat=hb)],
+                       interval=0.05, stale_after=60,
+                       slo_targets={"queue_depth": 1.0})
+    c.scrape_once()
+    # force a queue breach: feed the tracker directly, then publish
+    c.slo.update({}, 0, 0, queue_depth=5.0)
+    m = c.scrape_once()
+    gauge = telemetry.registry.find("fleet.slo_breached",
+                                    {"slo": "queue_depth"})
+    # the latch itself is sticky across healthy rounds...
+    assert gauge is not None
+    if "queue_depth" in m["slo"]["breached"]:
+        assert gauge.value == 1
+    c.slo.reset()
+    c.scrape_once()
+    # ...but an operator reset clears the EXPORTED gauge too
+    assert gauge.value == 0
+
+
+def test_hung_member_does_not_stall_the_round(tmp_path):
+    """Members scrape concurrently: one peer that accepts and never
+    replies costs ITS slot the scrape_timeout, not the whole round
+    (review finding — the absent-within-one-scrape promise is per
+    member)."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(2)
+    addr = "127.0.0.1:%d" % srv.getsockname()[1]
+    hb = str(tmp_path / "rank_0")
+    _beat(hb, {"schema": 1, "step": 3, "steps_per_sec": 5.0})
+    c = FleetCollector([FleetMember("serve", 0, addr=addr),
+                        FleetMember("worker", 0, heartbeat=hb)],
+                       interval=0.05, stale_after=60,
+                       scrape_timeout=0.5)
+    t0 = time.monotonic()
+    m = c.scrape_once()
+    assert time.monotonic() - t0 < 3.0
+    assert m["members"]["worker:0"]["present"]
+    assert not m["members"]["serve:0"]["present"]
+    srv.close()
+
+
+def test_collector_restartable_after_stop(tmp_path):
+    hb = str(tmp_path / "rank_0")
+    _beat(hb, {"schema": 1, "step": 1, "steps_per_sec": 5.0})
+    c = FleetCollector([FleetMember("worker", 0, heartbeat=hb)],
+                       interval=0.05, stale_after=60)
+    c.start()
+    c.stop()
+    n0 = c.snapshot()["scrape"] if c.snapshot() else 0
+    c.start()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        s = c.snapshot()
+        if s and s["scrape"] > n0:
+            break
+        time.sleep(0.02)
+    c.stop()
+    assert c.snapshot()["scrape"] > n0      # the restarted thread scrapes
+
+
+# ---------------------------------------------------------------------------
+# collector over real wires
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def kv_server():
+    from mxnet_tpu.kvstore import server as kvs
+    port = _free_port()
+    t = threading.Thread(target=kvs.serve_forever,
+                         kwargs=dict(port=port, num_workers=1),
+                         daemon=True)
+    t.start()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port),
+                                     timeout=0.2).close()
+            break
+        except OSError:
+            time.sleep(0.02)
+    yield "127.0.0.1:%d" % port
+    try:
+        from tools.launch import _send_stop
+        _send_stop("127.0.0.1:%d" % port)
+    except Exception:
+        pass
+
+
+def test_kvstore_metrics_verb_scrape(kv_server):
+    snap = fleet.fetch_metrics(kv_server, fmt="json")
+    assert any(k.startswith("engine.") for k in snap)
+    text = fleet.fetch_metrics(kv_server, fmt="prometheus")
+    assert "# TYPE" in text
+    c = FleetCollector([FleetMember("server", 0, addr=kv_server)],
+                       interval=0.05)
+    merged = c.scrape_once()
+    meta = merged["members"]["server:0"]
+    assert meta["present"] and meta["source"] == "wire"
+
+
+def test_absent_marked_within_one_scrape(tmp_path):
+    dead_addr = "127.0.0.1:%d" % _free_port()        # nothing listening
+    hb = str(tmp_path / "rank_0")
+    _beat(hb, {"schema": 1, "step": 3, "steps_per_sec": 5.0})
+    c = FleetCollector([FleetMember("serve", 0, addr=dead_addr),
+                        FleetMember("worker", 0, heartbeat=hb)],
+                       interval=0.05, stale_after=0.2,
+                       scrape_timeout=0.5)
+    m = c.scrape_once()
+    assert not m["members"]["serve:0"]["present"]
+    assert m["members"]["serve:0"]["absent_scrapes"] == 1
+    assert m["members"]["worker:0"]["present"]
+    # worker goes silent: stale past the bound -> absent next scrape
+    time.sleep(0.3)
+    m = c.scrape_once()
+    assert not m["members"]["worker:0"]["present"]
+    assert telemetry.registry.value("fleet.members_absent") == 2
+
+
+def test_malformed_heartbeat_line_tolerated_and_counted(tmp_path):
+    hb = str(tmp_path / "rank_0")
+    # both malformed classes: broken JSON, and VALID JSON that is not
+    # an object (a torn write can leave a bare number — review finding:
+    # this must count as malformed, not kill the scraper thread)
+    for bad in ("{not json", "42", "null"):
+        _beat(hb, bad, head="1 2")
+        c = FleetCollector([FleetMember("worker", 0, heartbeat=hb)],
+                           interval=0.05, stale_after=60)
+        n0 = telemetry.registry.value("fleet.malformed_beats")
+        m = c.scrape_once()
+        # the beat still proves liveness; the bad payload is counted
+        assert m["members"]["worker:0"]["present"], bad
+        assert m["malformed_beats"] == 1, bad
+        assert telemetry.registry.value("fleet.malformed_beats") == n0 + 1
+
+
+def test_parse_heartbeat_shared_helper():
+    head, payload, bad = telemetry.parse_heartbeat(
+        ["123.4 1 2", '{"schema": 1, "step": 7}'])
+    assert head == "123.4 1 2" and payload["step"] == 7 and bad == 0
+    for line2 in ("{broken", "7", "null", "[1]"):
+        _h, payload, bad = telemetry.parse_heartbeat(["t 0 0", line2])
+        assert payload == {} and bad == 1, line2
+    assert telemetry.parse_heartbeat([]) == ("", {}, 0)
+    # a beat stamped by a NEWER framework is ignored, not mis-rendered
+    _h, payload, bad = telemetry.parse_heartbeat(
+        ["t 0 0", '{"schema": %d, "step": 9}'
+         % (telemetry.HEARTBEAT_SCHEMA + 1)])
+    assert payload == {} and bad == 0
+
+
+def test_survivor_rollups_keep_advancing_past_a_death(tmp_path):
+    hb0, hb1 = str(tmp_path / "rank_0"), str(tmp_path / "rank_1")
+    _beat(hb0, {"schema": 1, "step": 10, "steps_per_sec": 5.0})
+    _beat(hb1, {"schema": 1, "step": 10, "steps_per_sec": 5.0})
+    c = FleetCollector([FleetMember("worker", 0, heartbeat=hb0),
+                        FleetMember("worker", 1, heartbeat=hb1)],
+                       interval=0.05, stale_after=0.25)
+    m1 = c.scrape_once()
+    assert m1["counters"]["worker.steps"]["total"] == 20
+    os.remove(hb1)                                  # rank 1 dies
+    _beat(hb0, {"schema": 1, "step": 15, "steps_per_sec": 5.0})
+    m2 = c.scrape_once()
+    assert not m2["members"]["worker:1"]["present"]
+    # the dead rank's counted work is retained, the survivor advances
+    assert m2["counters"]["worker.steps"]["total"] == 25
+
+
+# ---------------------------------------------------------------------------
+# serve-replica scrape: merged p99 within one bucket boundary
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def serve_replica():
+    from mxnet_tpu.serve import (BucketTable, ServeClient, ServeServer,
+                                 Servable, serve_forever)
+    from mxnet_tpu.serve.demo import DEMO_IN, demo_block, demo_example
+    port = _free_port()
+    state = ServeServer()
+    # two buckets, not the default five: the scrape contract under test
+    # is bucket-count-independent and each bucket costs a trace+compile
+    state.host.deploy(Servable(demo_block(), name="demo-mlp", version=1,
+                               buckets=BucketTable((1, 2))),
+                      example=demo_example())
+    stop_ev = threading.Event()
+    t = threading.Thread(target=serve_forever,
+                         kwargs=dict(port=port, state=state,
+                                     stop_event=stop_ev), daemon=True)
+    t.start()
+    addr = "127.0.0.1:%d" % port
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port),
+                                     timeout=0.2).close()
+            break
+        except OSError:
+            time.sleep(0.05)
+    cli = ServeClient([addr], timeout=30)
+    x = np.zeros((1, DEMO_IN), np.float32)
+    for _ in range(4):
+        cli.predict([x])
+    cli.close()
+    yield addr
+    stop_ev.set()
+
+
+def test_fleet_p99_matches_replica_p99(serve_replica):
+    c = FleetCollector([FleetMember("serve", 0, addr=serve_replica)],
+                       interval=0.05)
+    merged = c.scrape_once()
+    key = "step_phase_seconds{phase=serve_dispatch}"
+    mh = merged["histograms"].get(key)
+    assert mh is not None and mh["count"] >= 1
+    per_replica = fleet.fetch_metrics(serve_replica, fmt="json")[key]
+    expect = quantile_from_buckets(per_replica["buckets"], 0.99)
+    # single member: exact; the convention makes multi-member merges
+    # land within one bucket boundary by construction
+    assert mh["p99"] == expect
+    # the member self-describes its model via the version gauge
+    assert merged["members"]["serve:0"]["model"] == "demo-mlp"
+
+
+def test_fleet_verb_and_federation(serve_replica):
+    c = FleetCollector([FleetMember("serve", 0, addr=serve_replica)],
+                       interval=0.05)
+    c.scrape_once()
+    srv = fleet.serve_fleet(c, 0)
+    try:
+        addr = "127.0.0.1:%d" % srv.server_address[1]
+        snap = fleet.fetch_fleet(addr)
+        assert snap["schema"] == fleet.SCHEMA
+        assert snap["members"]["serve:0"]["present"]
+        fed = fleet.fetch_metrics(addr, fmt="prometheus")
+        assert 'role="serve"' in fed and 'rank="0"' in fed
+        assert 'model="demo-mlp"' in fed
+        assert "mx_fleet_members" in fed        # local rollups ride too
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_federation_http_endpoint(kv_server):
+    import urllib.request
+    c = FleetCollector([FleetMember("server", 0, addr=kv_server)],
+                       interval=0.05)
+    c.scrape_once()
+    srv = fleet._serve_federation(c, 0)
+    try:
+        hp = srv.server_address[1]
+        txt = urllib.request.urlopen(
+            "http://127.0.0.1:%d/metrics" % hp, timeout=5).read().decode()
+        assert 'role="server"' in txt and "mx_fleet_members" in txt
+        snap = json.loads(urllib.request.urlopen(
+            "http://127.0.0.1:%d/fleet.json" % hp, timeout=5).read())
+        assert snap["schema"] == fleet.SCHEMA
+        assert snap["members"]["server:0"]["present"]
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_fleet_top_renders_once(serve_replica, tmp_path, capsys):
+    c = FleetCollector([FleetMember("serve", 0, addr=serve_replica)],
+                       interval=0.05)
+    c.scrape_once()
+    srv = fleet.serve_fleet(c, 0)
+    try:
+        addr = "127.0.0.1:%d" % srv.server_address[1]
+        ft = _load_tool("fleet_top")
+        rc = ft.main(["--fleet", addr, "--once"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "serve:0" in out and "slo:" in out
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_fleet_top_table_flags_stragglers_and_absent():
+    ft = _load_tool("fleet_top")
+    snap = {
+        "schema": 1, "scrape": 7,
+        "members": {
+            "worker:0": {"role": "worker", "present": True,
+                         "source": "heartbeat", "model": None},
+            "worker:1": {"role": "worker", "present": False,
+                         "absent_scrapes": 3, "source": "heartbeat",
+                         "model": None},
+        },
+        "counters": {"worker.steps": {"per_member": {"worker:0": 12}}},
+        "gauges": {"worker.steps_per_sec":
+                   {"per_member": {"worker:0": 4.0}}},
+        "histograms": {},
+        "stragglers": [{"member": "worker:0", "ratio": 3.1,
+                        "dominant_phase": "data_wait"}],
+        "slo": {"p50_ms": 1, "p99_ms": 2, "rejection_rate": 0.0,
+                "queue_depth": 0, "burn": {"p99_latency": 1.5},
+                "breached": {"p99_latency": {}}},
+    }
+    out = ft.render(snap)
+    assert "STRAGGLER(3.1x data_wait)" in out
+    assert "ABSENT(3)" in out
+    assert "BREACH" in out
+
+
+# ---------------------------------------------------------------------------
+# supervisor embed
+# ---------------------------------------------------------------------------
+
+def _load_launch():
+    spec = importlib.util.spec_from_file_location(
+        "mx_launch_fleet_test", os.path.join(REPO, "tools", "launch.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_supervisor_embeds_collector_and_flags(tmp_path, monkeypatch):
+    monkeypatch.setenv("MX_FLEET_STALE", "60")
+    launch = _load_launch()
+    sup = launch.Supervisor(status_interval=1)
+    hb0, hb1 = str(tmp_path / "rank_0"), str(tmp_path / "rank_1")
+    _beat(hb0, {"schema": 1, "step": 10, "steps_per_sec": 10.0,
+                "phases": {"forward": 0.09}})
+    _beat(hb1, {"schema": 1, "step": 4, "steps_per_sec": 2.0,
+                "phases": {"forward": 0.1, "data_wait": 0.39}})
+    sup.add("rank 0", ["true"], {"MX_PROCESS_ID": "0"}, heartbeat=hb0)
+    sup.add("rank 1", ["true"], {"MX_PROCESS_ID": "1"}, heartbeat=hb1)
+    sup._start_collector()
+    try:
+        assert sup.fleet is not None
+        for _ in range(2):
+            sup.fleet.scrape_once()
+        table = sup.status_table()
+        assert "flags" in table
+        assert "STRAGGLER" in table and "data_wait" in table
+        # crash dumps carry the fleet section
+        monkeypatch.setenv("MX_CRASH_DIR", str(tmp_path / "crash"))
+        path = sup._crash_dump(sup.procs[1], 1, "exit 1")
+        blob = json.load(open(path))
+        assert blob["fleet"]["schema"] == fleet.SCHEMA
+        assert "worker:1" in blob["fleet"]["members"]
+    finally:
+        sup._stop_collector()
+
+
+def test_supervisor_read_beat_counts_malformed(tmp_path):
+    launch = _load_launch()
+    hb = str(tmp_path / "hb")
+    _beat(hb, "{broken", head="2 5")
+    sp = launch.SupervisedProc("rank 0", ["true"], {}, heartbeat=hb)
+    n0 = launch.Supervisor.malformed_beats
+    age, head, payload = launch.Supervisor._read_beat(sp)
+    assert age is not None and payload == {}
+    assert head.split()[1:] == ["2", "5"]           # beat NOT dropped
+    assert launch.Supervisor.malformed_beats == n0 + 1
+
+
+def test_supervisor_read_beat_virtual_clock_age(tmp_path):
+    launch = _load_launch()
+    hb = str(tmp_path / "hb")
+    sp = launch.SupervisedProc("rank 0", ["true"], {}, heartbeat=hb)
+    with fault.use_virtual_time() as clk:
+        _beat(hb, {"schema": 1, "step": 1, "ts": fault.now()})
+        clk.advance(42.0)
+        age, _head, payload = launch.Supervisor._read_beat(sp)
+    assert payload.get("schema") == telemetry.HEARTBEAT_SCHEMA
+    # the age came off the injectable clock, not wall-vs-mtime
+    assert abs(age - 42.0) < 1e-6
+
+
+def test_heartbeat_payload_has_schema_ts_and_phases(tmp_path):
+    telemetry.flight_recorder.clear()
+    with telemetry.phase("forward"):
+        pass
+    telemetry.note_step(epoch=0, batch=1)
+    p = telemetry.heartbeat_payload()
+    try:
+        assert p["schema"] == telemetry.HEARTBEAT_SCHEMA
+        assert isinstance(p["ts"], (int, float))
+        assert "forward" in p.get("phases", {})
+    finally:
+        telemetry.flight_recorder.clear()
+
+
+# ---------------------------------------------------------------------------
+# env catalog + mxlint wiring
+# ---------------------------------------------------------------------------
+
+def test_fleet_env_knobs_cataloged():
+    for name in ("MX_FLEET_INTERVAL", "MX_FLEET_RING", "MX_FLEET_WINDOW",
+                 "MX_FLEET_STRAGGLER_FACTOR", "MX_FLEET_STALE",
+                 "MX_FLEET_SLO_P50_MS", "MX_FLEET_SLO_P99_MS",
+                 "MX_FLEET_SLO_REJECT_RATE", "MX_FLEET_SLO_QUEUE",
+                 "MX_FLEET_SLO_PHASES", "MX_FLEET_PORT",
+                 "MX_FLEET_HTTP_PORT"):
+        assert name in ENV_CATALOG, name
+
+
+def test_fleet_is_hot_path_root():
+    from tools.mxlint.rules import HOT_PATH_ROOTS
+    roots = dict(HOT_PATH_ROOTS)
+    assert "mxnet_tpu/fleet.py" in roots
+    quals = roots["mxnet_tpu/fleet.py"]
+    assert "FleetCollector.scrape_once" in quals
+    assert "merge_snapshots" in quals
+
+
+def test_reinjected_sync_in_merge_loop_trips_hot_path_rule():
+    from tools.mxlint import lint_source
+    p = os.path.join(REPO, "mxnet_tpu", "fleet.py")
+    with open(p) as f:
+        code = f.read()
+    anchor = "        merged = self._fold(members, snap_results)"
+    assert anchor in code, "scrape_once moved; update this test"
+    bad = code.replace(
+        anchor, "        _dbg = snap_results and "
+                "list(snap_results.values())[0][0].asnumpy()\n" + anchor,
+        1)
+    diags = lint_source(bad, "mxnet_tpu/fleet.py")
+    assert "host-sync-in-hot-path" in {d.rule for d in diags}, \
+        {d.rule for d in diags}
+
+
+def test_shipped_fleet_lints_clean():
+    from tools.mxlint import lint_paths
+    # wire_codec rides along: the wire-verb rule is project-scope and
+    # resolves the json/text codec pairs from the scanned set
+    diags = lint_paths(
+        [os.path.join(REPO, "mxnet_tpu", "fleet.py"),
+         os.path.join(REPO, "tools", "fleet_top.py"),
+         os.path.join(REPO, "mxnet_tpu", "kvstore", "wire_codec.py")],
+        root=REPO)
+    assert [d for d in diags] == [], diags
+
+
+def test_wire_verbs_declared():
+    from mxnet_tpu.fleet import WIRE_VERBS as FLEET_VERBS
+    from mxnet_tpu.kvstore.server import WIRE_VERBS as KV_VERBS
+    assert FLEET_VERBS["FLEET"]["semantics"] == "idempotent"
+    assert FLEET_VERBS["METRICS"]["codec"] == "text"
+    assert KV_VERBS["METRICS"]["semantics"] == "idempotent"
+
+
+# ---------------------------------------------------------------------------
+# telemetry_dump graceful-partial behavior + fleet row
+# ---------------------------------------------------------------------------
+
+def test_telemetry_dump_partial_dir_exits_zero(tmp_path, capsys):
+    td = _load_tool("telemetry_dump")
+    d = str(tmp_path / "traces")
+    os.makedirs(d)
+    out = str(tmp_path / "merged.json")
+    rc = td.main(["--out", out, "--dir", d,
+                  "--expect-roles", "worker,server,fleet"])
+    captured = capsys.readouterr()
+    assert rc == 0 and os.path.exists(out)
+    summary = json.loads(captured.out)
+    assert summary["absent_roles"] == ["fleet", "server", "worker"]
+    assert "no input traces" in captured.err
+
+
+def test_telemetry_dump_skips_unreadable_and_merges_fleet_row(
+        tmp_path, capsys):
+    td = _load_tool("telemetry_dump")
+    good = str(tmp_path / "trace-worker-r0-p1.trace.json")
+    json.dump({"traceEvents": [{"name": "phase.forward", "ph": "X",
+                                "ts": 1.0, "dur": 2.0, "pid": 1,
+                                "tid": 1, "args": {"trace_id": "t1"}}],
+               "metadata": {"role": "worker", "rank": "0", "pid": 1}},
+              open(good, "w"))
+    fleet_tr = str(tmp_path / "trace-fleet-r0-p2.trace.json")
+    json.dump({"traceEvents": [{"name": "fleet.scrape.METRICS",
+                                "ph": "X", "ts": 2.0, "dur": 1.0,
+                                "pid": 2, "tid": 2, "args": {}}],
+               "metadata": {"role": "fleet", "rank": "0", "pid": 2}},
+              open(fleet_tr, "w"))
+    bad = str(tmp_path / "trace-server-r0-p3.trace.json")
+    with open(bad, "w") as f:
+        f.write("{corrupt")
+    out = str(tmp_path / "merged.json")
+    rc = td.main(["--out", out, good, fleet_tr, bad,
+                  "--expect-roles", "worker,server,fleet"])
+    captured = capsys.readouterr()
+    assert rc == 0
+    summary = json.loads(captured.out)
+    assert set(summary["roles"]) == {"worker", "fleet"}
+    assert list(summary["skipped"]) == [os.path.basename(bad)]
+    assert summary["absent_roles"] == ["server"]
+    merged = json.load(open(out))
+    names = {e.get("args", {}).get("name") for e in merged["traceEvents"]
+             if e.get("ph") == "M"}
+    assert any(n and n.startswith("fleet ") for n in names)
+
+
+def test_collector_flushes_fleet_trace_row(tmp_path, monkeypatch):
+    monkeypatch.setenv("MX_TELEMETRY_TRACE", str(tmp_path))
+    telemetry.clear_trace()
+    c = FleetCollector([], interval=0.05)
+    telemetry.start_tracing()
+    try:
+        with telemetry.rpc_span("fleet.scrape.METRICS"):
+            pass
+    finally:
+        telemetry.stop_tracing()
+    c.stop()
+    files = [f for f in os.listdir(str(tmp_path))
+             if f.startswith("trace-fleet-")]
+    assert files, os.listdir(str(tmp_path))
+    blob = json.load(open(str(tmp_path / files[0])))
+    assert blob["metadata"]["role"] == "fleet"
+    telemetry.clear_trace()
+
+
+# ---------------------------------------------------------------------------
+# prometheus escaping round-trip (ISSUE 12 satellite)
+# ---------------------------------------------------------------------------
+
+def _parse_prom_labels(raw):
+    """Minimal exposition-format label parser (the round-trip half)."""
+    out = {}
+    i = 0
+    while i < len(raw):
+        eq = raw.index("=", i)
+        key = raw[i:eq]
+        assert raw[eq + 1] == '"'
+        j = eq + 2
+        val = []
+        while raw[j] != '"':
+            if raw[j] == "\\":
+                nxt = raw[j + 1]
+                val.append({"\\": "\\", '"': '"', "n": "\n"}[nxt])
+                j += 2
+            else:
+                val.append(raw[j])
+                j += 1
+        out[key] = "".join(val)
+        i = j + 1
+        if i < len(raw) and raw[i] == ",":
+            i += 1
+    return out
+
+
+def test_prometheus_label_escaping_roundtrip():
+    nasty = 'mo"del\\path\nwith newline'
+    r = Registry()
+    r.counter("serve.requests", labels={"model": nasty}).inc(3)
+    text = r.to_prometheus()
+    line = next(ln for ln in text.splitlines()
+                if ln.startswith("mx_serve_requests{"))
+    raw = line[line.index("{") + 1:line.rindex("}")]
+    assert _parse_prom_labels(raw)["model"] == nasty
+    # exactly one sample line — the raw newline did not split it
+    samples = [ln for ln in text.splitlines()
+               if ln.startswith("mx_serve_requests{")]
+    assert len(samples) == 1 and samples[0].endswith(" 3")

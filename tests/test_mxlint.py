@@ -1608,4 +1608,10 @@ def test_shipped_wire_surface_is_declared():
     assert serve["PREDICT"]["semantics"] == "replayable"
     kv = manifests["mxnet_tpu/kvstore/server.py"]
     assert {"INIT", "PUSH", "PULL", "SET_OPT", "BARRIER", "PING",
-            "STOP"} == set(kv)
+            "METRICS", "STOP"} == set(kv)
+    assert kv["METRICS"]["semantics"] == "idempotent"
+    # the fleet plane's surface (ISSUE 12)
+    assert "mxnet_tpu/fleet.py" in manifests
+    fl = manifests["mxnet_tpu/fleet.py"]
+    assert set(fl) == {"FLEET", "METRICS"}
+    assert fl["FLEET"]["codec"] == "json"

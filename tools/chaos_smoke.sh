@@ -306,6 +306,120 @@ print("serve budget: %(dispatches)d dispatches / %(batches)d batches, "
       "%(retraces)d retraces" % r["serve"])
 EOF
 
+echo "== chaos_smoke: fleet telemetry plane - kill a replica + a worker mid-load (ISSUE 12)"
+"$PY" - "$REPO" "$WORK" <<'EOF'
+import json, os, socket, subprocess, sys, threading, time
+sys.path.insert(0, sys.argv[1])
+WORK = sys.argv[2]
+import numpy as np
+from mxnet_tpu import fleet, telemetry
+from mxnet_tpu.serve import ServeClient, ServeServer, Servable, serve_forever
+from mxnet_tpu.serve.demo import DEMO_IN, demo_block, demo_example
+
+def free_port():
+    s = socket.socket(); s.bind(("", 0)); p = s.getsockname()[1]; s.close()
+    return p
+
+# two in-process serve replicas (separate ports; the abort_event on
+# replica 0 is the in-process stand-in for a kill)
+replicas = []
+for i in range(2):
+    port = free_port()
+    state = ServeServer()
+    state.host.deploy(Servable(demo_block(), name="demo-mlp", version=1),
+                      example=demo_example())
+    stop_ev, abort_ev = threading.Event(), threading.Event()
+    threading.Thread(target=serve_forever,
+                     kwargs=dict(port=port, state=state, stop_event=stop_ev,
+                                 abort_event=abort_ev), daemon=True).start()
+    replicas.append(("127.0.0.1:%d" % port, stop_ev, abort_ev))
+for addr, _s, _a in replicas:
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        try:
+            socket.create_connection(tuple([addr.split(":")[0],
+                                            int(addr.split(":")[1])]),
+                                     timeout=0.2).close()
+            break
+        except OSError:
+            time.sleep(0.05)
+
+# two fake training workers beating heartbeat files; rank 1 is 3x slow
+hb_dir = os.path.join(WORK, "fleet-hb"); os.makedirs(hb_dir, exist_ok=True)
+def beat(rank, step, sps, data_wait):
+    path = os.path.join(hb_dir, "rank_%d" % rank)
+    payload = {"schema": 1, "step": step, "steps_per_sec": sps,
+               "phases": {"forward": 0.05, "data_wait": data_wait}}
+    with open(path, "w") as f:
+        f.write("%f 0 %d\n%s\n" % (time.time(), step, json.dumps(payload)))
+beat(0, 10, 10.0, 0.01); beat(1, 4, 3.3, 0.22)
+
+members = [fleet.FleetMember("serve", i, addr=a)
+           for i, (a, _s, _ab) in enumerate(replicas)]
+members += [fleet.FleetMember("worker", r,
+                              heartbeat=os.path.join(hb_dir, "rank_%d" % r))
+            for r in (0, 1)]
+coll = fleet.FleetCollector(members, interval=0.2, stale_after=0.5,
+                            scrape_timeout=1.0,
+                            slo_targets={"rejection_rate": 0.01})
+
+# drive some load so the serve histograms have mass
+cli = ServeClient([replicas[0][0]], timeout=10)
+x = np.zeros((1, DEMO_IN), np.float32)
+for _ in range(10): cli.predict([x])
+cli.close()
+m = coll.scrape_once()
+assert all(mm["present"] for mm in m["members"].values()), m["members"]
+
+# straggler: the 3x-slow rank is named within 2 windows
+for _ in range(2):
+    m = coll.scrape_once()
+names = [f["member"] for f in m["stragglers"]]
+assert names == ["worker:1"], m["stragglers"]
+assert m["stragglers"][0]["dominant_phase"] == "data_wait"
+
+# fleet_top --once renders off the FLEET wire verb (straggler visible)
+srv = fleet.serve_fleet(coll, 0)
+addr = "127.0.0.1:%d" % srv.server_address[1]
+out = subprocess.run(
+    [sys.executable, os.path.join(sys.argv[1], "tools", "fleet_top.py"),
+     "--fleet", addr, "--once"],
+    capture_output=True, text=True, timeout=60)
+assert out.returncode == 0, out.stderr
+assert "serve:1" in out.stdout and "STRAGGLER" in out.stdout, out.stdout
+srv.shutdown(); srv.server_close()
+
+# forced rejection spike trips the SLO burn + latch
+telemetry.registry.counter("serve.rejected").inc(50)
+m = coll.scrape_once()
+assert m["slo"]["burn"]["rejection_rate"] > 1.0, m["slo"]
+assert "rejection_rate" in m["slo"]["breached"], m["slo"]
+
+# kill replica 0 and silence worker 1: both absent within one interval
+before = m["counters"]["serve.requests"]["total"]
+replicas[0][2].set()          # sever the replica's listener + conns
+time.sleep(0.6)               # > stale_after: worker 1's beat goes stale
+beat(0, 20, 10.0, 0.01)       # survivor keeps beating
+m = coll.scrape_once()
+assert not m["members"]["serve:0"]["present"], m["members"]["serve:0"]
+assert not m["members"]["worker:1"]["present"], m["members"]["worker:1"]
+assert m["members"]["serve:1"]["present"] and m["members"]["worker:0"]["present"]
+
+# survivors' crash-free rollups keep advancing
+cli = ServeClient([replicas[1][0]], timeout=10)
+for _ in range(5): cli.predict([x])
+cli.close()
+m = coll.scrape_once()
+assert m["counters"]["serve.requests"]["total"] > before, \
+    (m["counters"]["serve.requests"], before)
+# the latched breach survives the healthy rounds
+assert "rejection_rate" in m["slo"]["breached"], m["slo"]
+for _a, stop_ev, ab in replicas:
+    stop_ev.set()
+print("fleet_smoke: PASS (absent within one scrape, straggler named, "
+      "SLO latched, survivors advancing, fleet_top renders)")
+EOF
+
 echo "== chaos_smoke: static-analysis lane (tools/lint.sh)"
 bash "$REPO/tools/lint.sh"
 

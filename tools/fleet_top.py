@@ -1,0 +1,210 @@
+#!/usr/bin/env python
+"""fleet_top.py — live terminal dashboard over the fleet telemetry
+plane (ISSUE 12).
+
+Replaces ad-hoc reading of N heartbeat files: one table, one row per
+fleet member, with per-member throughput, phase breakdown, queue /
+occupancy, and the straggler / SLO flags the collector's detectors
+raise.  Three source modes:
+
+  --fleet HOST:PORT       read a running collector's FLEET wire verb
+                          (the supervisor embeds one; MX_FLEET_PORT)
+  --serve a:p,b:p [...]   build a local collector over serve replicas
+  --kv a:p,b:p            ... and/or parameter servers (METRICS verb)
+  --heartbeat-dir DIR     ... and/or training workers' heartbeat files
+                          (rank_* files, the launch.py layout)
+
+Examples::
+
+  python tools/fleet_top.py --fleet 127.0.0.1:9800 --once
+  python tools/fleet_top.py --serve 127.0.0.1:9700,127.0.0.1:9701 \\
+      --heartbeat-dir /tmp/mx-heartbeat-XXXX --interval 2
+
+``--once`` renders a single snapshot and exits 0 (CI smoke);
+``--json`` dumps the merged snapshot instead of the table.
+"""
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _fmt(v, spec="%.3g"):
+    if v is None:
+        return "-"
+    try:
+        return spec % v
+    except (TypeError, ValueError):
+        return str(v)
+
+
+def _member_row(mid, meta, snap):
+    """One table row from the merged snapshot's member entry."""
+    counters = snap.get("counters") or {}
+    gauges = snap.get("gauges") or {}
+
+    def cval(name):
+        return (counters.get(name) or {}).get("per_member", {}).get(mid)
+
+    def gval(name):
+        return (gauges.get(name) or {}).get("per_member", {}).get(mid)
+
+    role = meta.get("role", "?")
+    state = "up" if meta.get("present") else \
+        "ABSENT(%d)" % meta.get("absent_scrapes", 0)
+    if role == "serve":
+        work = _fmt(cval("serve.requests"), "%d")
+        rate = "-"
+        queue = _fmt(gval("serve.queue_rows"), "%g")
+    else:
+        work = _fmt(cval("worker.steps"), "%d")
+        rate = _fmt(gval("worker.steps_per_sec"))
+        queue = "-"
+    # dominant phase: largest per-phase gauge for this member
+    dom = "-"
+    best = 0.0
+    for key, slot in gauges.items():
+        if not key.startswith("worker.phase_seconds{"):
+            continue
+        v = slot.get("per_member", {}).get(mid)
+        if v is not None and v > best:
+            best = v
+            dom = key.split("phase=", 1)[1].rstrip("}")
+    flags = []
+    for f in snap.get("stragglers") or []:
+        if f.get("member") == mid:
+            flags.append("STRAGGLER(%.3gx %s)"
+                         % (f.get("ratio", 0),
+                            f.get("dominant_phase") or "?"))
+    return (mid, state, meta.get("source") or "-",
+            meta.get("model") or "-", work, rate, queue, dom,
+            " ".join(flags) or "-")
+
+
+def render(snap):
+    """The fleet table + SLO footer as one printable string."""
+    cols = ("member", "state", "source", "model", "work", "rate",
+            "queue", "top phase", "flags")
+    rows = [cols]
+    for mid in sorted(snap.get("members") or {}):
+        rows.append(_member_row(mid, snap["members"][mid], snap))
+    widths = [max(len(str(r[i])) for r in rows) for i in range(len(cols))]
+    lines = ["  ".join(str(c).ljust(w)
+                       for c, w in zip(r, widths)).rstrip()
+             for r in rows]
+    sep = "-" * max(len(ln) for ln in lines)
+    out = ["fleet @ scrape %s (%s member(s))"
+           % (snap.get("scrape", "?"), len(snap.get("members") or {})),
+           sep] + lines + [sep]
+    slo = snap.get("slo") or {}
+    out.append("slo: p50=%.4gms p99=%.4gms reject=%.3g%% queue=%.3g"
+               % (slo.get("p50_ms", 0), slo.get("p99_ms", 0),
+                  100 * slo.get("rejection_rate", 0),
+                  slo.get("queue_depth", 0)))
+    for name, b in (slo.get("burn") or {}).items():
+        mark = " BREACH" if name in (slo.get("breached") or {}) else ""
+        out.append("slo burn %s: %.3gx%s" % (name, b, mark))
+    stragglers = snap.get("stragglers") or []
+    if stragglers:
+        out.append("stragglers: " + ", ".join(
+            "%s (%.3gx, %s)" % (f["member"], f.get("ratio", 0),
+                                f.get("dominant_phase") or "?")
+            for f in stragglers))
+    return "\n".join(out)
+
+
+def _build_collector(args):
+    from mxnet_tpu import fleet
+    members = []
+    for i, addr in enumerate(a for a in (args.serve or "").split(",")
+                             if a.strip()):
+        members.append(fleet.FleetMember("serve", i, addr=addr.strip()))
+    for i, addr in enumerate(a for a in (args.kv or "").split(",")
+                             if a.strip()):
+        members.append(fleet.FleetMember("server", i, addr=addr.strip()))
+    if args.heartbeat_dir:
+        for path in sorted(glob.glob(
+                os.path.join(args.heartbeat_dir, "rank_*"))):
+            if path.endswith(".tmp") or ".tmp." in path:
+                continue
+            rank = os.path.basename(path).split("_", 1)[1]
+            members.append(fleet.FleetMember("worker", rank,
+                                             heartbeat=path))
+    if not members:
+        raise SystemExit("fleet_top: no members (need --fleet, --serve, "
+                         "--kv, or --heartbeat-dir)")
+    return fleet.FleetCollector(members,
+                                interval=args.interval,
+                                stale_after=args.stale_after)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python tools/fleet_top.py", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--fleet", default=None, metavar="HOST:PORT",
+                    help="read a running collector's FLEET wire verb")
+    ap.add_argument("--serve", default=None, metavar="ADDRS",
+                    help="comma-separated serve replica addresses to "
+                         "scrape directly (builds a local collector)")
+    ap.add_argument("--kv", default=None, metavar="ADDRS",
+                    help="comma-separated parameter-server addresses")
+    ap.add_argument("--heartbeat-dir", default=None, metavar="DIR",
+                    help="directory of rank_* heartbeat files (the "
+                         "launch.py layout) for training workers")
+    ap.add_argument("--interval", type=float, default=None,
+                    help="refresh/scrape seconds (default "
+                         "MX_FLEET_INTERVAL)")
+    ap.add_argument("--stale-after", type=float, default=None,
+                    help="heartbeat staleness bound (default "
+                         "MX_FLEET_STALE / auto)")
+    ap.add_argument("--once", action="store_true",
+                    help="render one snapshot and exit")
+    ap.add_argument("--json", action="store_true",
+                    help="print the merged snapshot as JSON instead of "
+                         "the table")
+    args = ap.parse_args(argv)
+
+    from mxnet_tpu import fleet
+    collector = None
+    if args.fleet:
+        def snap_fn():
+            return fleet.fetch_fleet(args.fleet)
+    else:
+        collector = _build_collector(args)
+
+        def snap_fn():
+            return collector.scrape_once()
+
+    interval = args.interval
+    if interval is None:
+        from mxnet_tpu.base import get_env
+        interval = get_env("MX_FLEET_INTERVAL", 2.0, float) or 2.0
+    try:
+        while True:
+            snap = snap_fn()
+            if args.json:
+                print(json.dumps(snap, indent=1, default=str))
+            else:
+                if not args.once and sys.stdout.isatty():
+                    print("\033[2J\033[H", end="")
+                print(render(snap))
+            if args.once:
+                return 0
+            sys.stdout.flush()
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        if collector is not None:
+            collector.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

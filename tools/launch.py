@@ -104,6 +104,7 @@ class SupervisedProc:
         self.role = role              # "worker" | "server"
         self.addr = addr              # host:port (servers, for STOP)
         self.heartbeat = heartbeat    # liveness file path or None
+        self.fleet_key = None         # this proc's fleet-member id
         self.proc = None
         self.restarts = 0
         self.restart_at = None        # backoff deadline for the respawn
@@ -171,6 +172,7 @@ class Supervisor:
         self.procs = []
         self.job_rc = 0
         self._fault = None            # mxnet_tpu.fault, loaded lazily
+        self.fleet = None             # embedded FleetCollector (ISSUE 12)
 
     # -- registration -------------------------------------------------------
     def add(self, name, argv, env, role="worker", addr=None,
@@ -251,13 +253,26 @@ class Supervisor:
             self.job_rc = self.job_rc or (rc if rc > 0 else 1)
 
     # -- fleet status (ISSUE 8) --------------------------------------------
+
+    # malformed heartbeat JSON lines seen by _read_beat, tolerated and
+    # COUNTED (ISSUE 12 satellite): a half-written payload line must
+    # not drop the whole beat — the head line still proves liveness.
+    # Class-level because _read_beat is a staticmethod.
+    malformed_beats = 0
+
     @staticmethod
     def _read_beat(sp):
         """(age_seconds_or_None, head_line, telemetry_payload_dict) from
         a rank's heartbeat file.  Line 1 is the classic
         ``<unix-time> <epoch> <batch>`` / ``... done`` beat; line 2, when
         present, is the flight recorder's latest step record as compact
-        JSON (mxnet_tpu.telemetry.heartbeat_payload)."""
+        JSON (mxnet_tpu.telemetry.heartbeat_payload, ``schema``-tagged).
+
+        Age normally compares wall time against the file mtime; when
+        this process runs under mxnet_tpu.fault's VIRTUAL clock (chaos
+        tests) that compare races — the payload's ``ts`` field was
+        stamped by fault.now() in the beating process, so the age is
+        computed on that same injectable clock instead."""
         if not sp.heartbeat:
             return None, "", {}
         try:
@@ -266,13 +281,34 @@ class Supervisor:
                 lines = f.read().splitlines()
         except OSError:
             return None, "", {}
+        # import-light inline copy of mxnet_tpu.telemetry.parse_heartbeat
+        # (the launcher must not import the framework on its happy
+        # path) — keep the two in sync
         head = lines[0] if lines else ""
         payload = {}
-        if len(lines) > 1 and lines[1].startswith("{"):
+        if len(lines) > 1 and lines[1].strip():
             try:
                 payload = json.loads(lines[1])
+                if not isinstance(payload, dict):
+                    raise ValueError("payload is not a JSON object")
             except ValueError:
                 payload = {}
+                Supervisor.malformed_beats += 1
+        try:
+            # schema gate: a beat stamped by a NEWER framework version
+            # is ignored, not mis-rendered (1 = the schema this copy
+            # understands; mxnet_tpu.telemetry.HEARTBEAT_SCHEMA)
+            if payload.get("schema", 1) > 1:
+                payload = {}
+        except TypeError:
+            payload = {}
+            Supervisor.malformed_beats += 1
+        # only consulted when the framework is already loaded — the
+        # launcher stays import-light on the happy path
+        _f = sys.modules.get("mxnet_tpu.fault")
+        if _f is not None and _f.is_virtual() and \
+                isinstance(payload.get("ts"), (int, float)):
+            age = max(0.0, _f.now() - float(payload["ts"]))
         return age, head, payload
 
     @staticmethod
@@ -283,16 +319,82 @@ class Supervisor:
             return "restarting"
         return "running" if sp.alive() else "spawning"
 
+    # -- embedded fleet collector (ISSUE 12) --------------------------------
+    def _start_collector(self):
+        """Embed a fleet collector so every supervised job gets the
+        fleet plane for free: workers scrape via their heartbeat files,
+        parameter servers over the METRICS wire verb.  The collector
+        thread runs the scrape/merge/detect loop; the status table and
+        crash dumps read its merged snapshot.  Lazy-imports the
+        framework (same posture as _fault_mod); any failure degrades to
+        the old heartbeat-only table, never to a dead supervisor."""
+        if self.fleet is not None:
+            return
+        candidates = [sp for sp in self.procs
+                      if sp.heartbeat or (sp.role == "server" and
+                                          sp.addr)]
+        if not candidates:
+            return
+        try:
+            if REPO not in sys.path:
+                sys.path.insert(0, REPO)
+            from mxnet_tpu import fleet as _fleet
+            from mxnet_tpu.base import get_env as _get_env
+            interval = _get_env("MX_FLEET_INTERVAL", 2.0, float)
+            if not interval or interval <= 0:
+                return      # MX_FLEET_INTERVAL=0 opts the embed out
+            members = []
+            nsrv = 0
+            for sp in candidates:
+                if sp.heartbeat:
+                    rank = sp.env.get("MX_PROCESS_ID", len(members))
+                    m = _fleet.FleetMember("worker", rank,
+                                           heartbeat=sp.heartbeat)
+                else:
+                    m = _fleet.FleetMember("server", nsrv, addr=sp.addr)
+                    nsrv += 1
+                sp.fleet_key = m.key
+                members.append(m)
+            self.fleet = _fleet.FleetCollector(members).start()
+        except Exception as e:
+            self.log("fleet collector unavailable (%s); falling back "
+                     "to heartbeat-only status" % e)
+            self.fleet = None
+
+    def _stop_collector(self):
+        if self.fleet is not None:
+            try:
+                self.fleet.stop()
+            except Exception:
+                pass
+
     def status_table(self):
         """Live fleet status as a rendered text table — one row per
-        supervised process, populated from the heartbeat telemetry
-        payloads.  What a human tailing the supervisor log (and
-        chaos_smoke.sh) reads to see where the fleet is."""
+        supervised process.  Row data comes from the heartbeat
+        telemetry payloads; presence, straggler and SLO flags come from
+        the embedded collector's merged fleet snapshot when it runs
+        (ISSUE 12 — the table IS the fleet snapshot's view of the job).
+        What a human tailing the supervisor log (and chaos_smoke.sh)
+        reads to see where the fleet is."""
+        snap = self.fleet.snapshot() if self.fleet is not None else None
+        fleet_members = (snap or {}).get("members") or {}
+        stragglers = {f.get("member"): f
+                      for f in (snap or {}).get("stragglers") or []}
         cols = ("proc", "state", "restarts", "step", "epoch",
-                "steps/s", "img/s", "wire KB", "beat age")
+                "steps/s", "img/s", "wire KB", "beat age", "flags")
         rows = [cols]
         for sp in self.procs:
             age, _head, p = self._read_beat(sp)
+            flags = []
+            meta = fleet_members.get(sp.fleet_key)
+            if meta is not None and not meta.get("present") and \
+                    not sp.done:
+                flags.append("ABSENT")
+            f = stragglers.get(sp.fleet_key)
+            if f:
+                flags.append("STRAGGLER(%.3gx %s)"
+                             % (f.get("ratio", 0),
+                                f.get("dominant_phase") or "?"))
             rows.append((
                 sp.name, self._state_of(sp), str(sp.restarts),
                 str(p.get("step", "-")), str(p.get("epoch", "-")),
@@ -301,12 +403,18 @@ class Supervisor:
                 "%.4g" % p["throughput"] if "throughput" in p else "-",
                 "%.1f" % (p["wire_bytes"] / 1024.0)
                 if "wire_bytes" in p else "-",
-                "%.1fs" % age if age is not None else "-"))
+                "%.1fs" % age if age is not None else "-",
+                " ".join(flags) or "-"))
         widths = [max(len(r[i]) for r in rows) for i in range(len(cols))]
         lines = ["  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
                  for r in rows]
         sep = "-" * len(lines[0])
-        return "\n".join(["fleet status:", sep] + lines + [sep])
+        out = ["fleet status:", sep] + lines + [sep]
+        slo = (snap or {}).get("slo") or {}
+        breached = sorted((slo.get("breached") or {}))
+        if breached:
+            out.append("SLO BREACH (latched): %s" % ", ".join(breached))
+        return "\n".join(out)
 
     def _maybe_status(self):
         if not self.status_interval:
@@ -337,6 +445,14 @@ class Supervisor:
                     "wall_time": time.time(),
                     "heartbeat_age": age, "heartbeat_head": head,
                     "heartbeat": payload}
+            if self.fleet is not None:
+                # the last merged fleet snapshot (ISSUE 12): the
+                # post-mortem shows what the REST of the job was doing
+                # when this rank died, not just the dead rank's story
+                try:
+                    blob["fleet"] = self.fleet.snapshot()
+                except Exception:
+                    blob["fleet"] = None
             tmp = "%s.tmp.%d" % (path, os.getpid())
             with open(tmp, "w") as f:
                 json.dump(blob, f, indent=1)
@@ -434,6 +550,10 @@ class Supervisor:
         for sp in self.procs:
             self._spawn(sp)
         workers = [sp for sp in self.procs if sp.role == "worker"]
+        if self.status_interval is not None or self.hang_timeout:
+            # the fleet plane rides the same provisioning as the status
+            # table / hang detection (heartbeat files, server addrs)
+            self._start_collector()
         try:
             while True:
                 for sp in self.procs:
@@ -465,6 +585,8 @@ class Supervisor:
             # never exit leaving ranks/servers running unsupervised
             self._teardown()
             raise
+        finally:
+            self._stop_collector()
         self.stop_servers()
         return self.job_rc
 

@@ -22,12 +22,14 @@ DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 
 def _default_paths():
-    """mxnet_tpu plus the supervisor and the trace-merge tool — the
-    launcher is part of the threaded runtime the concurrency rules
-    certify, and telemetry_dump.py processes operator-facing trace
-    files (ISSUE 8)."""
+    """mxnet_tpu plus the supervisor and the operator-facing tools —
+    the launcher is part of the threaded runtime the concurrency rules
+    certify, telemetry_dump.py processes trace files (ISSUE 8), and
+    fleet_top.py emits the FLEET wire verb the exhaustiveness rule
+    pins (ISSUE 12)."""
     out = ["mxnet_tpu"]
-    for extra in ("launch.py", "telemetry_dump.py", "bench_compare.py"):
+    for extra in ("launch.py", "telemetry_dump.py", "bench_compare.py",
+                  "fleet_top.py"):
         if os.path.isfile(os.path.join("tools", extra)):
             out.append(os.path.join("tools", extra))
     return out

@@ -79,6 +79,19 @@ HOT_PATH_ROOTS: List[Tuple[str, List[str]]] = [
      ["Program.__call__", "Program._compile", "ProgramRecord.note_compile",
       "signature_of", "diff_signatures", "buffer_census",
       "LeakDetector.check"]),
+    # the fleet collector's scrape/merge loop (ISSUE 12) runs forever
+    # NEXT TO the training/serving processes it observes — a host sync
+    # (or any device pull) reintroduced here would periodically stall
+    # the very fleet it measures.  The merge algebra is dict arithmetic
+    # by contract (no numpy, no jax); this root machine-checks it (the
+    # tests/test_fleet.py reinjection test trips this entry).
+    ("mxnet_tpu/fleet.py",
+     ["FleetCollector.scrape_once", "FleetCollector._scrape_member",
+      "FleetCollector._scrape_heartbeat", "FleetCollector._fold",
+      "FleetCollector._publish", "FleetCollector._rebase_counters",
+      "FleetCollector._hist_delta", "merge_snapshots",
+      "merge_bucket_maps", "quantile_from_buckets",
+      "StragglerDetector.update", "SLOTracker.update"]),
 ]
 
 _SYNC_ATTRS = {"asnumpy", "asscalar", "item", "wait_to_read", "tolist"}

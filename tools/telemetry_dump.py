@@ -5,7 +5,10 @@ chrome-trace file.
 Each process in a distributed job buffers its spans (client RPCs,
 server handling, step phases — see mxnet_tpu/telemetry.py) and flushes
 them to ``MX_TELEMETRY_TRACE/trace-<role>-r<rank>-p<pid>.trace.json``
-at exit.  This tool stitches those per-process files into a single
+at exit.  The fleet collector (mxnet_tpu/fleet.py) flushes its scrape
+spans the same way under role ``fleet``, so a merged job trace shows
+the scraping cadence as its own row next to the workers/servers it
+observed.  This tool stitches those per-process files into a single
 timeline viewable in chrome://tracing / Perfetto: every source file
 becomes one named process row (``process_name`` metadata), span
 timestamps are already wall-epoch microseconds so rows align, and the
@@ -13,11 +16,20 @@ timestamps are already wall-epoch microseconds so rows align, and the
 tests) follow one RPC from a worker's push through the server's handler
 and back — retries and replay-cache hits ride along as instant events.
 
+Partial jobs are NORMAL (a killed rank's file may never flush): a
+missing or unreadable input is warned about and skipped, a directory
+with zero trace files still produces an (empty) merged file, and
+``--expect-roles`` lists which roles were expected — absent ones are
+named in a warning.  All of that exits 0; only a genuinely unwritable
+--out fails the merge.
+
 Usage:
   python tools/telemetry_dump.py --out merged.json trace1.json trace2.json
-  python tools/telemetry_dump.py --out merged.json --dir $MX_TELEMETRY_TRACE
+  python tools/telemetry_dump.py --out merged.json --dir $MX_TELEMETRY_TRACE \\
+      --expect-roles worker,server,fleet
 
-Prints a JSON summary (files, events, distinct trace ids) to stdout.
+Prints a JSON summary (files, events, distinct trace ids, roles,
+skipped inputs, absent roles) to stdout.
 """
 import argparse
 import glob
@@ -27,29 +39,51 @@ import sys
 
 
 def load_trace(path):
-    """One per-process trace file -> (label, events list)."""
+    """One per-process trace file -> (label, role, events list)."""
     with open(path) as f:
         payload = json.load(f)
     if isinstance(payload, list):          # bare event list tolerated
         payload = {"traceEvents": payload}
     meta = payload.get("metadata") or {}
-    label = "%s r%s (pid %s)" % (meta.get("role", "proc"),
-                                 meta.get("rank", "?"),
+    role = meta.get("role") or _role_from_name(path) or "proc"
+    label = "%s r%s (pid %s)" % (role, meta.get("rank", "?"),
                                  meta.get("pid", "?"))
-    return label, list(payload.get("traceEvents") or [])
+    return label, role, list(payload.get("traceEvents") or [])
+
+
+def _role_from_name(path):
+    """``trace-<role>-r<rank>-p<pid>.trace.json`` -> role (or None)."""
+    base = os.path.basename(path)
+    if base.startswith("trace-"):
+        rest = base[len("trace-"):]
+        head = rest.split("-r", 1)[0]
+        return head or None
+    return None
 
 
 def merge(paths):
-    """Merge trace files into one chrome-trace payload + summary."""
+    """Merge trace files into one chrome-trace payload + summary.
+    Missing/unreadable/corrupt inputs are skipped with a warning (a
+    crashed rank legitimately never flushed its trace)."""
     events = []
     trace_ids = set()
     per_file = {}
-    for i, path in enumerate(sorted(paths)):
-        label, evs = load_trace(path)
+    roles = set()
+    skipped = {}
+    pid = 0
+    for path in sorted(paths):
+        try:
+            label, role, evs = load_trace(path)
+        except (OSError, ValueError) as e:
+            skipped[os.path.basename(path)] = str(e)
+            print("telemetry_dump: skipping %s (%s)" % (path, e),
+                  file=sys.stderr)
+            continue
         # one synthetic pid per source file: two processes on one host
         # can share an OS pid across time, and the viewer needs stable
         # distinct rows anyway
-        pid = i + 1
+        pid += 1
+        roles.add(role)
         events.append({"name": "process_name", "ph": "M", "pid": pid,
                        "args": {"name": label}})
         for ev in evs:
@@ -62,7 +96,8 @@ def merge(paths):
         per_file[os.path.basename(path)] = len(evs)
     payload = {"traceEvents": events, "displayTimeUnit": "ms"}
     summary = {"files": per_file, "events": len(events),
-               "distinct_trace_ids": len(trace_ids)}
+               "distinct_trace_ids": len(trace_ids),
+               "roles": sorted(roles), "skipped": skipped}
     return payload, summary
 
 
@@ -73,14 +108,26 @@ def main(argv=None):
                     help="merge every *.trace.json under this directory "
                          "(what MX_TELEMETRY_TRACE processes flush into)")
     ap.add_argument("--out", required=True, help="merged chrome-trace path")
+    ap.add_argument("--expect-roles", default=None, metavar="ROLES",
+                    help="comma-separated roles that SHOULD appear "
+                         "(e.g. worker,server,fleet); absent ones are "
+                         "listed in a warning — still exit 0 (a killed "
+                         "rank's trace legitimately never flushed)")
     args = ap.parse_args(argv)
     paths = list(args.inputs)
     if args.dir:
         paths.extend(glob.glob(os.path.join(args.dir, "*.trace.json")))
     if not paths:
-        print("telemetry_dump: no input traces", file=sys.stderr)
-        return 1
+        print("telemetry_dump: warning - no input traces (merging an "
+              "empty timeline)", file=sys.stderr)
     payload, summary = merge(paths)
+    expected = [r.strip() for r in (args.expect_roles or "").split(",")
+                if r.strip()]
+    absent = sorted(set(expected) - set(summary["roles"]))
+    summary["absent_roles"] = absent
+    if absent:
+        print("telemetry_dump: warning - expected role(s) with no "
+              "trace file: %s" % ", ".join(absent), file=sys.stderr)
     tmp = "%s.tmp.%d" % (args.out, os.getpid())
     with open(tmp, "w") as f:
         json.dump(payload, f)
