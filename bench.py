@@ -34,6 +34,12 @@ BASELINE_IMG_PER_SEC = 1000.0  # nominal MXNet-CUDA 1-GPU reference
 PROBE_TIMEOUT_S = 150          # first TPU compile can take ~20-40s; be generous
 CHILD_TIMEOUT_S = 1200
 
+# ISSUE 13 retrace chase: strict retraces the --eager lane may report.
+# Measured 2 after the imperative-pass fix + specializing-site census
+# split (was 79); the budget only ever goes DOWN — bench_compare exits
+# non-zero on an over-budget report.
+EAGER_RETRACE_BUDGET = 4
+
 # Per-chip bf16 peak TFLOP/s by device kind (public cloud.google.com/tpu
 # numbers); the MFU gate must use the actual device, not a flat constant.
 # ORDERED: specific kinds first — v5p reports device_kind "TPU v5", while
@@ -66,13 +72,16 @@ def _census_report(max_programs=40):
     """Program-census block every bench lane embeds (ISSUE 10): the
     roll-up the regression sentinel gates on (total compile seconds,
     peak temp bytes, retrace count) plus the per-program table, largest
-    compile first."""
-    from mxnet_tpu import programs
+    compile first.  ISSUE 13: the persistent compile-cache roll-up
+    (hits/misses/bytes per layer) rides along — the warm-restart
+    acceptance reads it."""
+    from mxnet_tpu import compile_cache, programs
     table = programs.program_table()
     ranked = sorted(table.values(),
                     key=lambda t: -t["compile_seconds"]["total"])
     dropped = max(0, len(ranked) - max_programs)
     out = {"summary": programs.program_summary(),
+           "compile_cache": compile_cache.stats(),
            "programs": {t["name"]: t for t in ranked[:max_programs]}}
     if dropped:
         out["programs_truncated"] = dropped
@@ -280,16 +289,18 @@ def run_eager_bench():
                             {"learning_rate": 0.1, "momentum": 0.9})
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
     metric = mx.metric.Accuracy()
-    x = nd.array(np.random.randn(batch, 3, 224, 224).astype(np.float32))
-    y = nd.array(np.random.randint(0, 1000, batch).astype(np.float32))
+    x_np = np.random.randn(batch, 3, 224, 224).astype(np.float32)
+    y_np = np.random.randint(0, 1000, batch).astype(np.float32)
+    x = nd.array(x_np)
+    y = nd.array(y_np)
 
-    def step():
+    def step(xb, yb):
         with autograd.record():
-            out = net(x)
-            loss = loss_fn(out, y)
+            out = net(xb)
+            loss = loss_fn(out, yb)
         loss.backward()
         trainer.step(batch_size=batch)
-        metric.update([y], [out])
+        metric.update([yb], [out])
         return loss
 
     def sync():
@@ -302,16 +313,63 @@ def run_eager_bench():
             jax.block_until_ready(metric._dev_sum)
 
     for _ in range(warmup):
-        loss = step()
+        loss = step(x, y)
     sync()
+
+    # ISSUE 13: the timed loop consumes a REAL input stream — fresh
+    # host batches crossing to the device each step — so data_wait is a
+    # measured phase, not structurally zero.  With MX_PREFETCH (default
+    # on) the DevicePrefetcher device_puts one batch ahead off its own
+    # thread; with it off the transfer runs synchronously in the loop,
+    # observed under the same phase for an honest on/off comparison.
+    from mxnet_tpu import telemetry as _tel
+    from mxnet_tpu.io.prefetch import DevicePrefetcher, prefetch_enabled
+
+    def batch_stream():
+        for _ in range(iters):
+            yield (x_np, y_np)
+
+    use_prefetch = prefetch_enabled()
+
+    def _dw_total():
+        inst = _tel.registry.find("step_phase_seconds",
+                                  {"phase": "data_wait"})
+        return inst.snapshot()["sum"] if inst is not None else 0.0
+
     # ISSUE 10: ONE consistent counter read (snapshot), not racy
     # property-by-property reads mid-step
     snap0 = engine.snapshot()
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        loss = step()
-    sync()
-    dt = time.perf_counter() - t0
+    dw0 = _dw_total()
+    if use_prefetch:
+        with DevicePrefetcher(batch_stream()) as pf:
+            t0 = time.perf_counter()
+            for xb, yb in pf:
+                loss = step(nd.NDArray(xb), nd.NDArray(yb))
+            sync()
+            dt = time.perf_counter() - t0
+    else:
+        t0 = time.perf_counter()
+        for xb_np, yb_np in batch_stream():
+            t_dw = time.perf_counter()
+            xb = nd.NDArray(jax.device_put(xb_np))
+            yb = nd.NDArray(jax.device_put(yb_np))
+            # block on BOTH transfers: an in-flight label copy would
+            # escape data_wait and overlap the step, flattering the
+            # synchronous baseline
+            jax.block_until_ready(xb._jax)
+            jax.block_until_ready(yb._jax)
+            _tel.observe_phase("data_wait", time.perf_counter() - t_dw)
+            loss = step(xb, yb)
+        sync()
+        dt = time.perf_counter() - t0
+    data_wait_s = _dw_total() - dw0
+    prefetch_report = {
+        "enabled": use_prefetch,
+        "data_wait_total_ms": round(data_wait_s * 1e3, 3),
+        "data_wait_share_pct": round(100.0 * data_wait_s / dt, 3),
+        "gate_pct": 5.0,
+        "within_gate": bool(100.0 * data_wait_s / dt < 5.0),
+    }
     dispatches = (engine.snapshot()["dispatches"]
                   - snap0["dispatches"]) / iters
     img_per_sec = batch * iters / dt
@@ -365,6 +423,13 @@ def run_eager_bench():
                                   (scan_n,) + tuple(y.shape)).copy())
     scan_ips = timed(lambda: cstep.run_window(xw, yw), batch * scan_n)
 
+    # ISSUE 13 retrace budget: the eager lane's STRICT retrace count
+    # (specializing sites count their expected shape specializations
+    # separately) can only go down.  Over-budget poisons the report —
+    # tools/bench_compare.py exits non-zero on it.
+    census = _census_report()
+    retraces = census["summary"]["retraces"]
+
     print(json.dumps({
         "metric": "resnet18_eager_trainer_images_per_sec_per_chip",
         "value": round(img_per_sec, 2),
@@ -382,11 +447,16 @@ def run_eager_bench():
         "speedup_compiled_vs_eager": round(compiled_ips / img_per_sec, 2),
         "speedup_scan_vs_eager": round(scan_ips / img_per_sec, 2),
         "dispatch_bound": _dispatch_bound_compare(),
+        # ISSUE 13: async input pipeline — data_wait share of the timed
+        # eager loop (acceptance < 5% with prefetch on)
+        "prefetch": prefetch_report,
+        "retrace_budget": EAGER_RETRACE_BUDGET,
+        "retraces_over_budget": bool(retraces > EAGER_RETRACE_BUDGET),
         # ISSUE 8: per-phase step breakdown + measured span overhead
         "telemetry": telemetry_report,
         # ISSUE 10: per-program compile-cost/memory table + the roll-up
         # tools/bench_compare.py appends to BENCH_HISTORY.jsonl and gates
-        "census": _census_report(),
+        "census": census,
     }))
 
 
@@ -928,6 +998,144 @@ def run_serve_bench(rate=None, duration=None, senders=12):
     print(json.dumps(report))
 
 
+def run_warm_spawn_bench():
+    """--warm-spawn: serve replica ready-to-traffic time, cold vs warm
+    (ISSUE 13 acceptance lane).
+
+    Spawns the compile-heavy conv demo replica (resnet18 @ 64x64 — the
+    compile-bound regime a TPU replica lives in) twice against one
+    persistent compile-cache directory: the COLD spawn pays every
+    bucket program's trace+XLA compile and populates the store; the
+    WARM spawn deserializes the same executables.  Ready-to-traffic is
+    measured spawn → first successful PREDICT over a real socket, so
+    interpreter+jax import, model build, bucket warm-up and server
+    bind all count.  The replica's compile-cache counters and census
+    are scraped over the METRICS verb — the warm spawn must report
+    cache hits == its bucket count and warm compile seconds ~0.
+    """
+    import shutil
+    import socket as _socket
+    import tempfile
+    import numpy as np
+    from mxnet_tpu import fleet
+    from mxnet_tpu.serve import ServeClient
+    from mxnet_tpu.serve.demo import DEMO_CONV_SHAPE
+
+    cache_dir = tempfile.mkdtemp(prefix="mx_warm_spawn_cache_")
+    buckets = os.environ.get("MX_BENCH_WARM_BUCKETS", "1,2,4,8,16,32,64")
+    spawn_timeout = float(os.environ.get("MX_BENCH_WARM_TIMEOUT", 300))
+
+    def _free_port():
+        s = _socket.socket()
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def spawn_and_measure(tag):
+        port = _free_port()
+        env = dict(os.environ,
+                   JAX_PLATFORMS="cpu", MX_FORCE_CPU="1",
+                   MX_COMPILE_CACHE=cache_dir,
+                   MX_SERVE_BUCKETS=buckets,
+                   PYTHONPATH=os.path.dirname(os.path.abspath(__file__))
+                   + os.pathsep + os.environ.get("PYTHONPATH", ""))
+        t0 = time.perf_counter()
+        # stderr goes to a FILE, not a pipe: a chatty cold compile
+        # could fill a pipe buffer and deadlock the replica before it
+        # ever binds — the file is read back only on failure
+        err_path = os.path.join(cache_dir, "replica-%s.stderr" % tag)
+        err_f = open(err_path, "wb")
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "mxnet_tpu.serve", "--demo-conv",
+                 "--port", str(port)],
+                env=env, stdout=subprocess.DEVNULL, stderr=err_f)
+        finally:
+            err_f.close()
+        addr = "127.0.0.1:%d" % port
+        x = np.zeros((1,) + DEMO_CONV_SHAPE, np.float32)
+        ready_s = None
+        deadline = time.monotonic() + spawn_timeout
+        while time.monotonic() < deadline and proc.poll() is None:
+            try:
+                cli = ServeClient([addr], timeout=10)
+                cli.predict([x])
+                ready_s = time.perf_counter() - t0
+                cli.close()
+                break
+            except Exception:
+                time.sleep(0.05)
+        if ready_s is None:
+            proc.kill()
+            proc.wait()
+            try:
+                with open(err_path, "rb") as f:
+                    err = f.read()
+            except OSError:
+                err = b""
+            raise RuntimeError("warm-spawn %s replica never became "
+                               "ready: %s" % (tag,
+                                              err.decode(errors="replace")
+                                              [-2000:]))
+        # the replica's own receipts, over the wire it serves on
+        snap = fleet.fetch_metrics(addr, fmt="json")
+
+        def _val(name):
+            total = 0
+            for entry in snap.values():
+                if isinstance(entry, dict) and entry.get("name") == name:
+                    total += int(entry.get("value", 0))
+            return total
+
+        compile_s = 0.0
+        for entry in snap.values():
+            if isinstance(entry, dict) and \
+                    entry.get("name") == "program_compile_seconds" and \
+                    entry.get("type") == "histogram":
+                compile_s += float(entry.get("sum", 0.0))
+        stats = {
+            "ready_to_traffic_s": round(ready_s, 3),
+            "cache_hits": _val("compile_cache.hits"),
+            "cache_misses": _val("compile_cache.misses"),
+            "cache_writes": _val("compile_cache.writes"),
+            "compile_seconds_total": round(compile_s, 3),
+        }
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+        return stats
+
+    try:
+        cold = spawn_and_measure("cold")
+        warm = spawn_and_measure("warm")
+    finally:
+        if not os.environ.get("MX_BENCH_WARM_KEEP"):
+            shutil.rmtree(cache_dir, ignore_errors=True)
+    n_buckets = len([b for b in buckets.split(",") if b.strip()])
+    speedup = cold["ready_to_traffic_s"] / max(1e-9,
+                                               warm["ready_to_traffic_s"])
+    print(json.dumps({
+        "metric": "serve_warm_spawn_speedup",
+        "value": round(speedup, 2),
+        "unit": "x_faster_ready_to_traffic",
+        "device": "cpu",
+        "buckets": buckets,
+        "cold": cold,
+        "warm": warm,
+        "warm_spawn_seconds": warm["ready_to_traffic_s"],
+        "cold_spawn_seconds": cold["ready_to_traffic_s"],
+        "gate": 5.0,
+        "within_gate": bool(speedup >= 5.0),
+        "warm_hits_cover_buckets": bool(warm["cache_hits"] >= n_buckets),
+        "warm_compile_under_1s": bool(
+            warm["compile_seconds_total"] < 1.0),
+    }))
+
+
 def run_real_data_bench():
     """--real-data: prove the input pipeline (.rec → JPEG decode → augment →
     NCHW batch) sustains the compute rate (SURVEY hard part 7: ~3k img/s
@@ -1095,6 +1303,13 @@ def main():
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         os.environ.setdefault("MX_FORCE_CPU", "1")
         run_serve_bench()
+        return
+    if "--warm-spawn" in sys.argv:
+        # CPU-friendly: the lane measures spawn→first-PREDICT time of
+        # subprocess replicas, which pin themselves to cpu
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        os.environ.setdefault("MX_FORCE_CPU", "1")
+        run_warm_spawn_bench()
         return
     if os.environ.get("MX_BENCH_CHILD"):
         mode_env = os.environ.get("MX_BENCH_MODE")

@@ -119,6 +119,10 @@ ENV_CATALOG: Dict[str, Any] = {
     "MX_FLEET_SLO_REJECT_RATE": ("", "Serving SLO target: windowed fleet rejection-rate bound (rejected / (requests+rejected), from merged serve.* counter deltas).  Burn = observed/target into fleet.slo_burn{slo=rejection_rate}; > 1 latches.  Empty disables."),
     "MX_FLEET_SLO_QUEUE": ("", "Serving SLO target: mean fleet queue depth bound (rows, from merged serve.queue_rows gauges).  Burn = observed/target into fleet.slo_burn{slo=queue_depth}; > 1 latches.  Empty disables."),
     "MX_FLEET_SLO_PHASES": ("queue_wait,serve_dispatch", "Comma-separated step_phase_seconds phases whose fleet-merged histograms define the serving latency distribution the SLO p50/p99 trackers read (bucket-wise exact merge; identical boundaries required)."),
+    "MX_COMPILE_CACHE": ("", "Persistent compiled-program cache directory (mxnet_tpu/compile_cache.py): every AOT jit site routed through the program registry serializes its XLA executable here, keyed by (program name, trace signature, function fingerprint, jit spec, backend/topology/jax-version/library-fingerprint envelope), so a warm restart — supervisor respawn, chaos restart, serve replica spawn — DESERIALIZES (~ms) instead of re-tracing and re-compiling (seconds).  jax's own persistent compilation cache is additionally armed under <dir>/xla for the light-mode sites an executable store cannot key (the hybridize train lane's vjp closures).  Any miss, version skew or corrupt entry is counted (compile_cache.misses{reason}) and falls back to a normal compile — the cache can never fail a program.  Writes are temp+rename atomic; concurrent writers are last-write-wins.  Empty disables both layers."),
+    "MX_COMPILE_CACHE_SALT": ("", "Extra compile-cache key component: operators set it to partition one shared cache directory (e.g. per experiment branch) without deleting entries; changing it is a guaranteed full-miss restart."),
+    "MX_PREFETCH": ("1", "Async device input pipeline (mxnet_tpu/io/prefetch.py DevicePrefetcher) in the harnesses that support it (bench.py --eager): a background thread device_puts one batch AHEAD of the training loop (double-buffered), so the host->device transfer of batch N+1 overlaps the compute of batch N and the loop's data_wait phase share collapses to the queue handoff.  Bit-parity with the synchronous path (device_put moves bytes, never rounds).  0 keeps the transfer synchronous in the loop (still measured under data_wait)."),
+    "MX_PREFETCH_DEPTH": ("2", "DevicePrefetcher queue bound in batches: how many device-resident batches may sit ahead of the consumer (2 = classic double buffering).  The producer blocks (stop-aware bounded polls) at the bound, so prefetch can never balloon memory by more than this many batches."),
     "MX_FLEET_PORT": ("", "Port the fleet collector's wire server binds (FLEET verb -> merged snapshot as a JSN payload, METRICS -> whole-fleet federation exposition; same length-prefixed envelope as the kvstore/serve wire).  This is the API surface the coming serve router/autoscaler consume.  Empty = no wire server."),
     "MX_FLEET_HTTP_PORT": ("", "Port of the collector's Prometheus federation HTTP endpoint: GET /metrics returns every member's instruments re-labeled {role,rank,model} plus the fleet rollups — a single scrape covers the whole fleet; GET /fleet.json returns the merged snapshot.  Empty = no HTTP endpoint."),
 }
@@ -148,12 +152,16 @@ def get_env(name: str, default: Any = None, dtype: Callable = str) -> Any:
 def set_env(name: str, value: Optional[str]) -> None:
     """Set (or with None, unset) a process-local env override.  NB this
     keeps os.environ in sync, which hot-path caches (engine.is_naive's
-    value-compare) rely on."""
+    value-compare) rely on.  Unsetting REMOVES the override entirely —
+    a lingering ``None`` entry would shadow every later direct
+    ``os.environ`` write (e.g. pytest ``monkeypatch.setenv``) behind
+    the catalog default forever."""
     with _env_lock:
-        _env_overrides[name] = None if value is None else str(value)
         if value is None:
+            _env_overrides.pop(name, None)
             os.environ.pop(name, None)
         else:
+            _env_overrides[name] = str(value)
             os.environ[name] = str(value)
 
 

@@ -629,7 +629,11 @@ class FleetCollector:
         Synthesized into a minimal registry-shaped snapshot so one
         merge path serves both sources."""
         st = os.stat(member.heartbeat)
-        with open(member.heartbeat) as f:
+        # reading the liveness file IS this scrape path's job (the
+        # degraded fallback for members with no wire face); it runs on
+        # the collector's own thread at MX_FLEET_INTERVAL, never on a
+        # dispatch path
+        with open(member.heartbeat) as f:  # mxlint: disable=host-sync-in-hot-path
             lines = f.read().splitlines()
         _head, payload, malformed = _telemetry.parse_heartbeat(lines)
         age = time.time() - st.st_mtime

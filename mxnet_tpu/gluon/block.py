@@ -355,10 +355,38 @@ def _apply_vjp_body(vjp_fn, cotangents):
 def _make_apply_vjp():
     from ..programs import register_program
     return register_program("hybrid.apply_vjp", _apply_vjp_body,
-                            mode="light")
+                            mode="light", specializing=True)
 
 
 _apply_vjp = _make_apply_vjp()
+
+
+# Hybrid imperative-pass scope (ISSUE 13 retrace chase): while a
+# hybridized ANCESTOR runs its imperative fallback pass (deferred
+# params — the reference's _build_cache infer pass), nested hybridized
+# children must run imperatively too.  Without this, the first resnet18
+# step built 30 per-child programs plus 31 per-child backward (vjp)
+# programs — ~2.7s of trace+compile and 60+ census "retraces" — all
+# dead weight the moment the SECOND step traces the whole net as one
+# program (children inline into an enclosing trace via the override
+# scope; this scope closes the same hole for the imperative pass).
+_imperative_pass = threading.local()
+
+
+def _in_imperative_pass() -> bool:
+    return getattr(_imperative_pass, "depth", 0) > 0
+
+
+class _ImperativePassScope:
+    __slots__ = ()
+
+    def __enter__(self):
+        _imperative_pass.depth = getattr(_imperative_pass, "depth", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        _imperative_pass.depth -= 1
+        return False
 
 
 class _CacheEntry:
@@ -415,13 +443,18 @@ class HybridBlock(Block):
         # blocks must not run their jitted cache or tracers leak into the
         # symbol recorder
         if not self._active or _overrides() is not None \
-                or _ndmod._sym_tracer is not None:
+                or _ndmod._sym_tracer is not None \
+                or _in_imperative_pass():
             return super()._call_impl(*args, **kwargs)
         params = list(self.collect_params().items())
         # deferred params: first call runs imperatively (finishes deferred
-        # init with real shapes — the reference's _build_cache infer pass)
+        # init with real shapes — the reference's _build_cache infer pass).
+        # The scope keeps hybridized CHILDREN imperative too: their
+        # soon-obsolete per-child programs must not be built for a pass
+        # the whole-net trace replaces on the next call.
         if any(p._data is None for _, p in params):
-            return super()._call_impl(*args, **kwargs)
+            with _ImperativePassScope():
+                return super()._call_impl(*args, **kwargs)
         return self._call_cached(params, args, kwargs)
 
     def _call_cached(self, params, args, kwargs):
@@ -539,10 +572,12 @@ class HybridBlock(Block):
                 return outs, vjp_fn, mutated
 
             entry.fwd_train = register_program(pname + ".train",
-                                               fwd_train, mode="light")
+                                               fwd_train, mode="light",
+                                               specializing=True)
         else:
             entry.fwd_infer = register_program(pname + ".infer", run,
-                                               mode="light")
+                                               mode="light",
+                                               specializing=True)
         return entry
 
     # -- export (symbol.json + params artifact) -----------------------------

@@ -29,7 +29,9 @@ from ..device import cpu
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "CSVIter", "ImageRecordIter", "ImageRecordUInt8Iter", "LibSVMIter",
-           "MNISTIter"]
+           "MNISTIter", "DevicePrefetcher"]
+
+from .prefetch import DevicePrefetcher
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):

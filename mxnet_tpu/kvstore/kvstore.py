@@ -61,7 +61,8 @@ def _make_sum_arrays():
     # jax.jit's C++ dispatch stays; a trivial add-reduction's
     # memory_analysis is not worth a per-dispatch Python signature walk
     from ..programs import register_program
-    return register_program("kvstore.sum", _sum_arrays_body, mode="light")
+    return register_program("kvstore.sum", _sum_arrays_body,
+                            mode="light", specializing=True)
 
 
 _sum_arrays = _make_sum_arrays()

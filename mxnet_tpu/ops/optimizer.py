@@ -869,6 +869,7 @@ def _tree_jit(kind, statics, donate):
     fn = functools.partial(body, **dict(statics))
     from ..programs import register_program
     return register_program("optimizer.fused_%s" % kind, fn,
+                            specializing=True,
                             donate_argnums=donatable if donate else ())
 
 

@@ -197,7 +197,7 @@ def _jitted(name: str, frozen_params) -> Callable:
     from ..programs import register_program
     return register_program("op." + op.name,
                             functools.partial(op.fn, **params),
-                            mode="light")
+                            mode="light", specializing=True)
 
 
 def cached_jit(name: str, params: Dict[str, Any]) -> Callable:

@@ -261,15 +261,33 @@ def _cost_dict(compiled) -> Optional[Dict[str, Any]]:
 
 class ProgramRecord:
     """Aggregated accounting for one named program (all its wrapper
-    instances and executables)."""
+    instances and executables).
 
-    def __init__(self, name: str, mode: str):
+    ``specializing`` records (ISSUE 13): some sites aggregate MANY
+    expected shape/rank specializations under one name — per-op eager
+    kernels, the hybridize cache, the fused optimizer's per-model tree
+    kernels.  For those, a FRESH signature is an expected
+    specialization (counted separately), and ``retraces`` counts only
+    a rebuild of an ALREADY-SEEN signature — genuine cache thrash.
+    Strict records (the default: step/serve/mesh programs) keep the
+    original semantics: any signature change is a retrace."""
+
+    def __init__(self, name: str, mode: str, specializing: bool = False):
         self.name = name
         self.mode = mode
+        self.specializing = bool(specializing)
         self._lock = threading.Lock()
         self.compiles = 0                 # executables built
         self.retraces = 0                 # compiles whose signature
         #                                   differed from the last seen
+        #                                   (specializing: re-compiles
+        #                                   of a KNOWN signature)
+        self.specializations = 0          # fresh-signature compiles of
+        #                                   a specializing program
+        self.cache_hits = 0               # executables deserialized from
+        #                                   the persistent compile cache
+        self.deserialize_seconds_total = 0.0
+        self._seen_sigs: set = set()
         self.compile_seconds_total = 0.0
         self.compile_seconds_max = 0.0
         self.last_compile_seconds: Optional[float] = None
@@ -298,6 +316,25 @@ class ProgramRecord:
             doc="XLA cost_analysis flops of the latest executable",
             labels=labels)
 
+    def _absorb_metadata_locked(self, mem, cost) -> None:
+        """Fold one executable's memory/cost analysis into the record
+        (caller holds self._lock) — shared by compiled and
+        cache-deserialized builds so their census columns can never
+        diverge."""
+        if mem is not None:
+            self.memory = mem
+            tb = mem["temp_bytes"]
+            if self.temp_bytes_peak is None or tb > self.temp_bytes_peak:
+                self.temp_bytes_peak = tb
+        if cost is not None:
+            self.cost = cost
+
+    def _publish_metadata_gauges(self, mem, cost) -> None:
+        if mem is not None:
+            self._g_temp.set(mem["temp_bytes"])
+        if cost is not None and "flops" in cost:
+            self._g_flops.set(cost["flops"])
+
     def note_compile(self, seconds: float, sig: Tuple,
                      compiled=None) -> None:
         """Record one executable build: timing, optional AOT metadata,
@@ -305,6 +342,7 @@ class ProgramRecord:
         mem = _memory_dict(compiled) if compiled is not None else None
         cost = _cost_dict(compiled) if compiled is not None else None
         diff = None
+        is_retrace = False
         with self._lock:
             self.compiles += 1
             self.compile_seconds_total += seconds
@@ -314,34 +352,59 @@ class ProgramRecord:
             if self.last_sig is not None:
                 diff = diff_signatures(self.last_sig, sig)
                 if diff is not None:
-                    self.retraces += 1
-                    self.last_retrace = {"diff": diff,
-                                         "compile_seconds": seconds}
+                    if self.specializing and sig not in self._seen_sigs:
+                        # fresh shape at a specializing site: expected
+                        # (per-op rank/shape specialization is the
+                        # light-census contract), counted separately
+                        self.specializations += 1
+                    else:
+                        is_retrace = True
+                        self.retraces += 1
+                        self.last_retrace = {"diff": diff,
+                                             "compile_seconds": seconds}
+            self._seen_sigs.add(sig)
             self.last_sig = sig
-            if mem is not None:
-                self.memory = mem
-                tb = mem["temp_bytes"]
-                if self.temp_bytes_peak is None or tb > self.temp_bytes_peak:
-                    self.temp_bytes_peak = tb
-            if cost is not None:
-                self.cost = cost
+            self._absorb_metadata_locked(mem, cost)
         self._h_compile.observe(seconds)
-        if mem is not None:
-            self._g_temp.set(mem["temp_bytes"])
-        if cost is not None and "flops" in cost:
-            self._g_flops.set(cost["flops"])
-        if diff is not None:
+        self._publish_metadata_gauges(mem, cost)
+        if is_retrace:
             self._c_retrace.inc()
             logger.info("program %r retraced (compile %.3fs): %s",
                         self.name, seconds, _format_diff(diff))
+        elif diff is not None:
+            logger.debug("program %r specialized (compile %.3fs): %s",
+                         self.name, seconds, _format_diff(diff))
+
+    def note_cache_hit(self, seconds: float, sig: Tuple,
+                       compiled=None) -> None:
+        """Record one executable DESERIALIZED from the persistent
+        compile cache: no compile happened, no retrace is charged —
+        ``compile_seconds_total`` stays the cost actually paid (the
+        warm-restart acceptance number), deserialize time accumulates
+        separately.  The signature still lands in the seen-set so a
+        later genuine rebuild of it is attributed correctly."""
+        mem = _memory_dict(compiled) if compiled is not None else None
+        cost = _cost_dict(compiled) if compiled is not None else None
+        with self._lock:
+            self.cache_hits += 1
+            self.deserialize_seconds_total += seconds
+            self._seen_sigs.add(sig)
+            self.last_sig = sig
+            self._absorb_metadata_locked(mem, cost)
+        self._publish_metadata_gauges(mem, cost)
 
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
             return {
                 "name": self.name,
                 "mode": self.mode,
+                "specializing": self.specializing,
                 "compiles": self.compiles,
                 "retraces": self.retraces,
+                "specializations": self.specializations,
+                "cache_hits": self.cache_hits,
+                "deserialize_seconds": round(
+                    self.deserialize_seconds_total, 6),
                 "compile_seconds": {
                     "total": round(self.compile_seconds_total, 6),
                     "max": round(self.compile_seconds_max, 6),
@@ -359,11 +422,12 @@ _records_lock = threading.Lock()
 _records: Dict[str, ProgramRecord] = {}
 
 
-def _record(name: str, mode: str) -> ProgramRecord:
+def _record(name: str, mode: str,
+            specializing: bool = False) -> ProgramRecord:
     with _records_lock:
         rec = _records.get(name)
         if rec is None:
-            rec = ProgramRecord(name, mode)
+            rec = ProgramRecord(name, mode, specializing=specializing)
             _records[name] = rec
     return rec
 
@@ -393,6 +457,11 @@ def program_summary() -> Dict[str, Any]:
         "programs": len(table),
         "compiles": sum(t["compiles"] for t in table.values()),
         "retraces": sum(t["retraces"] for t in table.values()),
+        "specializations": sum(t["specializations"]
+                               for t in table.values()),
+        "cache_hits": sum(t["cache_hits"] for t in table.values()),
+        "deserialize_seconds_total": round(
+            sum(t["deserialize_seconds"] for t in table.values()), 6),
         "compile_seconds_total": round(total_s, 6),
         "peak_temp_bytes": max(peak_temp) if peak_temp else None,
     }
@@ -429,9 +498,12 @@ class Program:
     """
 
     def __init__(self, name: str, mode: str, fn: Callable,
-                 jit_kw: Dict[str, Any], aot: bool):
+                 jit_kw: Dict[str, Any], aot: bool,
+                 specializing: bool = False):
         self._name = name
         self._mode = mode
+        self._specializing = bool(specializing)
+        self._fn = fn            # compile-cache function fingerprint
         self._record: Optional[ProgramRecord] = None
         self._seq = 0
         self._noted = 0     # compiles already recorded (under _cache_lock)
@@ -448,6 +520,11 @@ class Program:
         self._aot = aot
         self._cache: Dict[Tuple, Any] = {}
         self._cache_lock = threading.Lock()
+        # signatures whose executable came off the persistent compile
+        # cache (under _cache_lock) — per-INSTANCE, so warm()-style
+        # callers can tell a deserialized build from a cold compile
+        # without racing on process-global counters
+        self._from_cache_sigs: set = set()
 
     @property
     def jit_kw(self) -> Dict[str, Any]:
@@ -468,7 +545,8 @@ class Program:
         dispatched wrapper (e.g. a module-level kernel the workload never
         runs) must not pollute the table with a zero-compile row."""
         if self._record is None:
-            self._record = _record(self._name, self._mode)
+            self._record = _record(self._name, self._mode,
+                                   specializing=self._specializing)
         return self._record
 
     @property
@@ -477,6 +555,27 @@ class Program:
             return len(self._cache)
 
     def _compile(self, sig, args, kwargs):
+        # persistent compile cache (ISSUE 13): a warm restart
+        # deserializes the executable this process's predecessor built —
+        # no trace, no lower, no XLA compile.  Any miss (absent entry,
+        # version/topology skew, corrupt payload) falls through to the
+        # normal compile below, which then publishes the entry.
+        from . import compile_cache as _cc
+        ckey = None
+        if _cc.enabled():
+            ckey = _cc.cache_key(self._name, sig, fn=self._fn,
+                                 jit_kw=self._jit_kw)
+            t0 = time.perf_counter()
+            cached = _cc.load(self._name, ckey)
+            if cached is not None:
+                dt = time.perf_counter() - t0
+                with self._cache_lock:
+                    kept = self._cache.setdefault(sig, cached)
+                    self._from_cache_sigs.add(sig)
+                    self._noted = self._seq
+                if kept is cached:
+                    self.record.note_cache_hit(dt, sig, compiled=kept)
+                return kept
         t0 = time.perf_counter()
         try:
             compiled = self._jit.lower(*args, **kwargs).compile()
@@ -502,7 +601,30 @@ class Program:
             # executable the cache kept records the build — compiles
             # stays exact
             self.record.note_compile(dt, sig, compiled=kept)
+            if ckey is not None:
+                _cc.store(self._name, ckey, kept)
         return kept
+
+    def ensure_compiled(self, *args, **kwargs):
+        """Build (or warm-load from the persistent compile cache) the
+        executable for this argument signature WITHOUT dispatching it.
+
+        Returns a truthy provenance string when an AOT executable is
+        ready — ``"hit"`` (deserialized from the persistent cache, this
+        instance, this signature), ``"compiled"`` (built cold) or
+        ``"ready"`` (already in the in-memory table) — and False in
+        light mode or after an AOT fallback, where the caller must
+        dispatch normally."""
+        if not self._aot:
+            return False
+        sig = signature_of(args, kwargs)
+        with self._cache_lock:
+            if sig in self._cache:
+                return "hit" if sig in self._from_cache_sigs else "ready"
+        if self._compile(sig, args, kwargs) is None:
+            return False
+        with self._cache_lock:
+            return "hit" if sig in self._from_cache_sigs else "compiled"
 
     def __call__(self, *args, **kwargs):
         if self._aot:
@@ -529,19 +651,28 @@ class Program:
 
 
 def register_program(name: str, fn: Callable, mode: str = "aot",
-                     **jit_kw) -> Callable:
+                     specializing: bool = False, **jit_kw) -> Callable:
     """Route one jit-creation site through the program census.
 
     Drop-in for ``jax.jit(fn, **jit_kw)``; returns a callable.  ``name``
     is the program's stable registry identity (wrappers sharing a name
     aggregate into one record — e.g. every hybridize cache entry of one
     block class).  ``mode='aot'`` for programs built once and dispatched
-    per step/batch; ``mode='light'`` for per-op hot paths.  With
+    per step/batch; ``mode='light'`` for per-op hot paths.
+    ``specializing=True`` marks a site whose record expects many
+    shape/rank specializations under one name (per-op kernels, the
+    hybridize cache, fused optimizer tree kernels): fresh signatures
+    count as ``specializations``, and ``retraces`` counts only genuine
+    rebuilds of an already-seen signature.  With
     ``MX_PROGRAM_CENSUS=0`` this is exactly ``jax.jit``.
     """
+    from . import compile_cache as _cc
+    if _cc.enabled():
+        _cc.activate()          # idempotent; arms the XLA-level layer
     if not census_enabled():
         return jax.jit(fn, **jit_kw)
-    return Program(name, mode, fn, jit_kw, aot=(mode == "aot"))
+    return Program(name, mode, fn, jit_kw, aot=(mode == "aot"),
+                   specializing=specializing)
 
 
 # ---------------------------------------------------------------------------

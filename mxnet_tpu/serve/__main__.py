@@ -36,6 +36,11 @@ def _build_servable(args):
     from .servable import BucketTable, Servable
     buckets = BucketTable([int(b) for b in args.buckets.split(",")]) \
         if args.buckets else None
+    if args.demo_conv:
+        from .demo import demo_conv_block, demo_conv_example
+        sv = Servable(demo_conv_block(), name="demo-conv", version=1,
+                      buckets=buckets)
+        return sv, demo_conv_example()
     if args.demo:
         from .demo import demo_block, demo_example
         sv = Servable(demo_block(), name="demo-mlp", version=1,
@@ -74,6 +79,10 @@ def main(argv=None) -> int:
                     help="serve the built-in deterministic demo MLP "
                          "(smokes/benches; tools/serve_load.py verifies "
                          "its outputs)")
+    ap.add_argument("--demo-conv", action="store_true",
+                    help="serve the compile-heavy deterministic conv "
+                         "demo (resnet18 @ 64x64) — the warm-spawn "
+                         "bench lane's compile-bound replica")
     ap.add_argument("--port", type=int, default=None)
     ap.add_argument("--port-base", type=int, default=None,
                     help="bind port-base + MX_PROCESS_ID (multi-replica "
@@ -115,10 +124,22 @@ def main(argv=None) -> int:
 
     sv, example = _build_servable(args)
     state = ServeServer(on_tick=tick)
+    t_warm0 = time.perf_counter()
     state.host.deploy(sv, example=example)
-    print("serve: %s v%d warm on %d bucket(s) %r, port %d"
+    warm_s = time.perf_counter() - t_warm0
+    # warm-start visibility (ISSUE 13): with MX_COMPILE_CACHE set, a
+    # respawned replica deserializes its whole bucket table instead of
+    # compiling it — the banner (and the METRICS verb the fleet/bench
+    # scrape) carries the receipts
+    from ..compile_cache import stats as _cc_stats
+    cs = _cc_stats()
+    print("serve: %s v%d warm on %d bucket(s) %r in %.2fs "
+          "(compile-cache%s hits=%d misses=%d), port %d"
           % (sv.name, sv.version, len(sv.buckets.sizes),
-             list(sv.buckets.sizes), port), file=sys.stderr, flush=True)
+             list(sv.buckets.sizes), warm_s,
+             "" if cs["enabled"] else " off",
+             cs["hits"], cs["misses"], port),
+          file=sys.stderr, flush=True)
 
     serve_forever(port=port, state=state, ready_file=args.ready_file)
     print("serve: stopped", file=sys.stderr, flush=True)

@@ -1064,6 +1064,8 @@ def test_shipped_thread_roots_discovered():
                for r in roots), roots
     assert any(r.startswith("hook:") and "_on_grad_ready" in r
                for r in roots), roots
+    # ISSUE 13: the async input pipeline's producer thread
+    assert "thread:DevicePrefetcher._run" in roots, roots
 
 
 def test_reinjected_asnumpy_in_trainer_update_trips():

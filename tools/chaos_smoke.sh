@@ -420,6 +420,105 @@ print("fleet_smoke: PASS (absent within one scrape, straggler named, "
       "SLO latched, survivors advancing, fleet_top renders)")
 EOF
 
+echo "== chaos_smoke: warm respawn — persistent compile cache (ISSUE 13)"
+# kill-and-respawn with MX_COMPILE_CACHE (via launch.py --compile-cache):
+# the respawned worker must deserialize its step programs — the DONE
+# receipt line carries cache_hits and the compile wall-time actually
+# paid — and a respawned serve replica must warm its whole bucket table
+# from hits while serving correct answers.
+CACHE="$WORK/ccache"
+rc=0
+MX_STEP_COMPILE=1 "$PY" "$REPO/tools/launch.py" -n 1 --launcher local \
+    --restart on-failure --max-restarts 2 --compile-cache "$CACHE" \
+    --fault 'worker.step:crash:after=5' -- \
+    "$PY" "$REPO/tools/chaos_fit.py" \
+    --ckpt-dir "$WORK/warm-ckpt" --out "$WORK/warm" 2>&1 \
+    | tee "$WORK/warm.log" || rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "chaos_smoke: FAIL - warm-respawn launch.py exited $rc" >&2
+    exit 1
+fi
+grep -q 'restart 1/' "$WORK/warm.log" || {
+    echo "chaos_smoke: FAIL - warm-respawn: no restart happened" >&2
+    exit 1
+}
+"$PY" - "$WORK/warm.log" <<'EOF'
+import re, sys
+log = open(sys.argv[1]).read()
+done = re.findall(r"CHAOS_FIT_DONE rank \S+ cache_hits=(\d+) "
+                  r"cache_misses=(\d+) compile_seconds=([\d.]+)", log)
+assert done, "no warm-respawn DONE receipt in log"
+hits, _misses, comp = done[-1]
+# the crashed first incarnation populated the store; the incarnation
+# that FINISHED (the respawn) must have warm-started from it
+assert int(hits) >= 1, "respawned worker reported no cache hits: %s" % (done,)
+assert float(comp) < 1.0, \
+    "respawned worker compile_seconds=%s >= 1s" % comp
+print("warm respawn worker: PASS (hits=%s, compile %ss < 1s)" % (hits, comp))
+EOF
+
+# serve replica warm respawn: same cache flag, crash mid-load; the
+# respawn banner itself carries the receipts, and every answer the
+# driver got must still be CORRECT
+WARM_BASE=$("$PY" - <<'EOF'
+import socket
+while True:
+    s1 = socket.socket(); s1.bind(("", 0)); p = s1.getsockname()[1]
+    s2 = socket.socket()
+    try:
+        s2.bind(("", p + 1))
+    except OSError:
+        s1.close(); s2.close(); continue
+    s1.close(); s2.close(); print(p); break
+EOF
+)
+rc=0
+PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}" \
+"$PY" "$REPO/tools/launch.py" -n 2 --launcher local \
+    --restart on-failure --max-restarts 3 --hang-timeout 30 \
+    --compile-cache "$CACHE" \
+    --fault 'serve.request:crash:after=45' -- \
+    "$PY" -m mxnet_tpu.serve --demo --port-base "$WARM_BASE" \
+    > "$WORK/warm_serve.log" 2>&1 &
+WARM_LAUNCH_PID=$!
+"$PY" "$REPO/tools/serve_load.py" \
+    --addrs "127.0.0.1:$WARM_BASE,127.0.0.1:$((WARM_BASE+1))" \
+    --requests 100 --chaos --stop 2>&1 \
+    | tee "$WORK/warm_serve_load.log" || rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "chaos_smoke: FAIL - warm serve load driver exited $rc" >&2
+    kill "$WARM_LAUNCH_PID" 2>/dev/null || true
+    cat "$WORK/warm_serve.log" >&2 || true
+    exit 1
+fi
+wait "$WARM_LAUNCH_PID" || rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "chaos_smoke: FAIL - warm serve launch.py exited $rc" >&2
+    cat "$WORK/warm_serve.log" >&2 || true
+    exit 1
+fi
+grep -q 'SERVE_LOAD_OK' "$WORK/warm_serve_load.log" || {
+    echo "chaos_smoke: FAIL - warm serve load never reported OK" >&2
+    exit 1
+}
+"$PY" - "$WORK/warm_serve.log" <<'EOF'
+import re, sys
+log = open(sys.argv[1]).read()
+banners = re.findall(r"warm on (\d+) bucket\(s\).* in ([\d.]+)s "
+                     r"\(compile-cache hits=(\d+) misses=(\d+)\)", log)
+assert len(banners) >= 3, \
+    "expected 2 cold + >=1 respawn banner, got %r" % (banners,)
+buckets = int(banners[0][0])
+warm = [b for b in banners if int(b[2]) >= buckets]
+assert warm, "no respawned replica warmed from cache hits: %r" % (banners,)
+assert any(float(b[1]) < 1.0 for b in warm), \
+    "no warm respawn deployed in <1s: %r" % (warm,)
+print("warm respawn serve: PASS (%d respawn banner(s) with hits>=%d, "
+      "fastest warm deploy %.2fs)"
+      % (len(warm), buckets, min(float(b[1]) for b in warm)))
+EOF
+echo "chaos_smoke: warm respawn PASS (worker + serve replica came back warm)"
+
 echo "== chaos_smoke: static-analysis lane (tools/lint.sh)"
 bash "$REPO/tools/lint.sh"
 

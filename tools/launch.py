@@ -684,6 +684,15 @@ def launch_local(args, command):
         # status tables read the same per-rank heartbeat files hang
         # detection uses — either feature provisions them
         hb_dir = tempfile.mkdtemp(prefix="mx-heartbeat-")
+    # warm respawn (ISSUE 13): one resolved cache dir frozen into EVERY
+    # rank's env — workers and PS servers alike, and every RESTART of
+    # them (the supervisor respawns with the original env) — so a
+    # chaos-killed process deserializes its executables instead of
+    # re-paying the cold-start compile bill
+    compile_cache_dir = getattr(args, "compile_cache", None)
+    if compile_cache_dir:
+        compile_cache_dir = os.path.abspath(compile_cache_dir)
+        os.makedirs(compile_cache_dir, exist_ok=True)
     ps_roots = []
     if getattr(args, "num_servers", 0) > 0:
         # dist_async parameter server(s) (reference: tracker starting
@@ -703,6 +712,8 @@ def launch_local(args, command):
                         "MX_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
                         "PYTHONPATH": REPO + os.pathsep +
                         env.get("PYTHONPATH", "")})
+            if compile_cache_dir:
+                env["MX_COMPILE_CACHE"] = compile_cache_dir
             if snap_dir:
                 # durable PS: a restarted server (same snapshot path,
                 # same port via the frozen env) resumes with no data
@@ -717,6 +728,8 @@ def launch_local(args, command):
                     env, role="server", addr=addr)
     for rank in range(args.num_workers):
         env = _env_for(rank, coordinator, args.num_workers)
+        if compile_cache_dir:
+            env["MX_COMPILE_CACHE"] = compile_cache_dir
         if getattr(args, "fault", None):
             # arm the chaos spec in every worker (mxnet_tpu.fault reads
             # MX_FAULT_INJECT at import) — a restarted rank re-arms the
@@ -845,6 +858,12 @@ def main():
                         "'worker.step:crash:after=5' or "
                         "'kvstore.send:close:after=3'); chaos testing "
                         "only")
+    p.add_argument("--compile-cache", default=None, metavar="DIR",
+                   help="persistent compiled-program cache directory "
+                        "(sets MX_COMPILE_CACHE in every rank): a "
+                        "respawned/restarted rank deserializes its XLA "
+                        "executables from here instead of recompiling "
+                        "them — warm restart compiles ~0 programs")
     p.add_argument("--ps-snapshot-dir", default=None, metavar="DIR",
                    help="persist each parameter server's store under "
                         "DIR (atomic pickles) so a restarted server "
