@@ -430,6 +430,12 @@ def run_eager_bench():
     census = _census_report()
     retraces = census["summary"]["retraces"]
 
+    # ISSUE 14: --mesh lane — the SpecLayout-sharded step's per-chip
+    # params+optimizer bytes (buffer census) and throughput; gated by
+    # tools/bench_compare.py as the mesh-class-keyed
+    # params_bytes_per_chip series
+    sharded = _sharded_lane()
+
     print(json.dumps({
         "metric": "resnet18_eager_trainer_images_per_sec_per_chip",
         "value": round(img_per_sec, 2),
@@ -457,7 +463,93 @@ def run_eager_bench():
         # ISSUE 10: per-program compile-cost/memory table + the roll-up
         # tools/bench_compare.py appends to BENCH_HISTORY.jsonl and gates
         "census": census,
+        # ISSUE 14: sharded-training lane (None unless --mesh/MX_MESH_AXES)
+        "sharded": sharded,
     }))
+
+
+def _sharded_lane(layers=4, hidden=256, batch=32, steps=3):
+    """The --mesh lane (ISSUE 14): a SpecLayout-sharded CompiledStep on
+    the MX_BENCH_MESH mesh vs its replicated twin — reporting the
+    buffer-census per-chip params+optimizer bytes (the series
+    tools/bench_compare.py gates, keyed by mesh class) and the sharded
+    step's throughput.  Returns None when no mesh is configured (the
+    default eager lane is unchanged)."""
+    import gc as _gc
+    mesh_text = os.environ.get("MX_BENCH_MESH") or ""
+    if not mesh_text:
+        return None
+    import jax
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd, programs
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import SpecLayout, make_mesh
+    from mxnet_tpu.parallel.speclayout import parse_mesh_axes
+
+    axes, sizes = parse_mesh_axes(mesh_text)
+    mesh = make_mesh(axes=axes, shape=sizes, devices=jax.devices())
+    layout = SpecLayout.infer(mesh)
+    mesh_class = ",".join("%s=%d" % (a, s)
+                          for a, s in dict(mesh.shape).items())
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(batch, 64).astype(np.float32)
+    Y = rng.randn(batch, 8).astype(np.float32)
+    loss_fn = gluon.loss.L2Loss()
+
+    def _census_delta(lay):
+        """(per-chip params+optimizer bytes, images/sec) of one fresh
+        trainer under `lay`, as a buffer-census delta around its
+        lifetime — the same attribution buffer_census() reports."""
+        _gc.collect()
+        before = programs.buffer_census()
+        mx.random.seed(0)
+        net = nn.Sequential()
+        in_units = 64
+        for _ in range(layers):
+            net.add(nn.Dense(hidden, in_units=in_units,
+                             activation="relu"))
+            in_units = hidden
+        net.add(nn.Dense(8, in_units=in_units))
+        net.initialize(mx.init.Xavier())
+        tr = gluon.Trainer(list(net.collect_params().values()), "sgd",
+                           {"learning_rate": 0.05, "momentum": 0.9})
+        step = tr.make_compiled_step(net, loss_fn, layout=lay)
+        step.step(nd.array(X), nd.array(Y), batch_size=batch)   # trace
+        step.step(nd.array(X), nd.array(Y), batch_size=batch)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = step.step(nd.array(X), nd.array(Y), batch_size=batch)
+        jax.block_until_ready(loss._jax)
+        ips = batch * steps / (time.perf_counter() - t0)
+        _gc.collect()
+        after = programs.buffer_census()
+
+        def delta(owner):
+            return max(0, after[owner]["bytes_per_chip"]
+                       - before[owner]["bytes_per_chip"])
+        return delta("params"), delta("optimizer_state"), ips
+
+    repl_params, repl_opt, repl_ips = _census_delta(None)
+    sh_params, sh_opt, sh_ips = _census_delta(layout)
+    fsdp = layout.fsdp
+    measured = (repl_params + repl_opt) / max(1, sh_params + sh_opt)
+    return {
+        "mesh": mesh_text,
+        "mesh_class": mesh_class,
+        "fsdp": fsdp,
+        "params_bytes_per_chip": sh_params,
+        "optimizer_bytes_per_chip": sh_opt,
+        "replicated_params_bytes": repl_params,
+        "replicated_optimizer_bytes": repl_opt,
+        # per-chip state must drop ~linearly with the fsdp axis
+        "ideal_ratio": fsdp,
+        "measured_ratio": round(measured, 3),
+        "within_15pct_of_ideal": bool(measured >= 0.85 * fsdp),
+        "images_per_sec": round(sh_ips, 2),
+        "replicated_images_per_sec": round(repl_ips, 2),
+    }
 
 
 def _telemetry_overhead(layers=8, hidden=64, batch=16, pairs=12):
@@ -1330,6 +1422,22 @@ def main():
         # over the step) — the delta vs the default per-step dispatch loop
         # is the per-step host/tunnel overhead
         os.environ["MX_BENCH_SCAN"] = "1"
+    if "--mesh" in sys.argv:
+        # ISSUE 14: --mesh data,fsdp[=N][,tp=N] arms the sharded lane in
+        # the eager child (env so the probe/fallback respawn keeps it);
+        # a CPU box fakes the mesh devices, set BEFORE any jax init
+        at = sys.argv.index("--mesh")
+        if at + 1 >= len(sys.argv):
+            sys.stderr.write("bench.py: --mesh expects an axes argument "
+                             "(e.g. --mesh data,fsdp=2)\n")
+            sys.exit(2)
+        mesh_arg = sys.argv[at + 1]
+        os.environ["MX_BENCH_MESH"] = mesh_arg
+        if "xla_force_host_platform_device_count" not in \
+                os.environ.get("XLA_FLAGS", ""):
+            os.environ["XLA_FLAGS"] = \
+                (os.environ.get("XLA_FLAGS", "") +
+                 " --xla_force_host_platform_device_count=8").strip()
     if mode != "resnet":
         # same probe/fallback machinery, mode-specific child
         os.environ["MX_BENCH_MODE"] = mode

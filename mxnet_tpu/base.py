@@ -75,6 +75,8 @@ ENV_CATALOG: Dict[str, Any] = {
     "MX_GRAD_COMPRESS_BLOCK": ("256", "Elements per int8 scale block for 'int8' gradient compression: each block of this many gradient elements shares one f32 scale (max|block|/127), so the wire payload is n + 4n/block bytes per n-element gradient.  Smaller blocks track outliers tighter at more scale overhead."),
     "MX_STEP_COMPILE": ("0", "1 = whole-program compiled train step: loss forward, backward, the bucketed (int8/2bit error-feedback quantized) gradient exchange, the fused multi-tensor optimizer apply and device-side metric accumulation trace into ONE donated jax.jit per step (mxnet_tpu/step.py CompiledStep; Module.fit picks it up automatically).  First call traces, a shape/dtype change retraces, lr/wd arrive as traced scalars so schedulers never recompile.  Eager remains the debug path; the PS/dist_async transport, unsupported optimizers, grad_req='add' and NaN-policy-armed runs fall back to the eager pipeline automatically."),
     "MX_STEP_SCAN": ("0", "N>1 = scan-window size for the compiled step lane's window consumers (mxnet_tpu.step.scan_window(): bench.py --eager, tools/dispatch_count.py --compiled, and any harness driving CompiledStep.run_window): N prefetched batches stay on device per host round-trip, the step body runs under one lax.scan, and the window costs 1-2 dispatches total (batch transfer + window launch) instead of N; gradient accumulation folds into the scanned body via run_window(accum=k).  Module.fit dispatches per batch regardless (its iterator/callback contract is per-batch).  0/1 = one dispatch per step."),
+    "MX_MESH_AXES": ("", "Named mesh axes for the SpecLayout sharded training lane (mxnet_tpu/parallel/speclayout.py), as comma-separated name[=size] tokens, e.g. 'data,fsdp=2' or 'data,fsdp=2,tp=2'.  When set, CompiledStep/Trainer.make_compiled_step build the step as ONE donated SPMD jit over this mesh: the batch splits over data*fsdp, parameters + optimizer state live sheet-sharded (fsdp) / tensor-split (tp) so per-chip state bytes drop ~linearly with the fsdp axis, gradients reduce-scatter onto the parameter shards (int8-quantized per bucket under gradient compression, error-feedback residuals sharded per chip) and XLA all-gathers updated parameters just in time.  An unsized data axis infers -1 (all remaining devices); unsized model axes default to 2.  Empty keeps the replicated step.  Sharding NEVER changes results - only placement and communication."),
+    "MX_FSDP": ("", "Size of the fsdp (ZeRO sheet-sharding) mesh axis for the SpecLayout lane.  Overrides the fsdp entry of MX_MESH_AXES; setting MX_FSDP=N alone implies MX_MESH_AXES='data,fsdp=N'.  Per-chip params+optimizer_state bytes in buffer_census() drop ~1/N (acceptance: within 15% of ideal at N=2 and N=4 in dryrun_multichip).  Empty/1 = no fsdp sharding."),
     "MX_EXCHANGE_OVERLAP": ("0", "1 = overlap-scheduled gradient exchange: the Trainer arms per-gradient readiness hooks and each fusion bucket's collective launches the moment backward finalizes the bucket's last member (reverse-parameter-order buckets, so late layers go out first), with results committed at the pre-update drain barrier.  Exchange results are identical to the serialized path (a grad rewritten after launch relaunches its unit at drain); 0 keeps the exchange serialized after backward."),
     "MX_OPTIMIZER_AGGREGATE": ("", "Fused multi-tensor optimizer apply: empty keeps each optimizer's default aggregate_num (SGD/NAG/Adam/AdamW fuse up to 64 params per dispatch by default), 0 opts out back to the per-param update loop, any other N caps how many (weight, grad, state) triples fuse into one jitted pytree dispatch."),
     "MX_KVSTORE_RETRY_DEADLINE": ("60", "dist_async client: total seconds to keep retrying a failed RPC (reconnect + replay) before raising a terminal MXNetError; also bounds the initial connect wait per server at startup (the launcher starts servers concurrently, so workers retry until each binds)."),

@@ -17,6 +17,7 @@ none either); checkpoint frequency bounds lost work.
 """
 from __future__ import annotations
 
+import json
 import os
 import shutil
 from typing import Any, Callable, Optional, Tuple
@@ -24,7 +25,135 @@ from typing import Any, Callable, Optional, Tuple
 import jax
 
 __all__ = ["save_sharded", "restore_sharded", "CheckpointManager",
-           "resume_or_init"]
+           "resume_or_init", "saved_specs", "shardings_from_saved"]
+
+# per-leaf PartitionSpec sidecar (ISSUE 14): a sharded job's checkpoint
+# records WHERE each leaf lived so a restore onto a NEW mesh re-shards
+# by axis NAME — a dp×fsdp save resumes sharded on any mesh carrying an
+# fsdp axis, and degrades to replicated on a plain-dp mesh, with no
+# caller-side layout bookkeeping.  The sidecar is advisory metadata: a
+# missing/stale one falls back to the restore template's own shardings.
+SPEC_SCHEMA = 1
+_SPEC_SIDECAR = ".speclayout.json"
+
+
+def _spec_to_json(sharding) -> Optional[list]:
+    """A NamedSharding's PartitionSpec as JSON entries (None | axis |
+    [axes]); None for anything without a named spec."""
+    spec = getattr(sharding, "spec", None)
+    if spec is None:
+        return None
+    out = []
+    for entry in tuple(spec):
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, (tuple, list)):
+            out.append([str(a) for a in entry])
+        else:
+            out.append(str(entry))
+    return out
+
+
+def _sidecar_doc(state) -> dict:
+    leaves = jax.tree_util.tree_leaves(state)
+    mesh_axes = {}
+    specs = []
+    for leaf in leaves:
+        sh = getattr(leaf, "sharding", None)
+        mesh = getattr(sh, "mesh", None)
+        if mesh is not None and not mesh_axes:
+            mesh_axes = {str(k): int(v) for k, v in dict(mesh.shape).items()}
+        specs.append(_spec_to_json(sh))
+    return {"schema": SPEC_SCHEMA, "mesh_axes": mesh_axes,
+            "leaf_specs": specs}
+
+
+def _sidecar_path(path: str) -> str:
+    return os.path.abspath(path) + _SPEC_SIDECAR
+
+
+def _write_sidecar(target: str, state) -> None:
+    """Atomic (temp+rename) sidecar write; lead process only."""
+    doc = _sidecar_doc(state)
+    tmp = target + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, target)
+
+
+def saved_specs(path: str) -> Optional[dict]:
+    """The sidecar document saved next to checkpoint `path`, or None
+    (absent / unreadable / wrong schema — every failure degrades to
+    template-sharding restore, never an error)."""
+    try:
+        with open(_sidecar_path(path)) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return _validate_sidecar(doc)
+
+
+def _spec_onto_mesh(entries, shape, mesh):
+    """Rebuild one leaf's PartitionSpec onto a NEW mesh: axes are matched
+    by NAME, and an axis the new mesh lacks (or that no longer divides
+    the dimension) drops out — the elastic-restore contract."""
+    from jax.sharding import PartitionSpec as P
+    sizes = {str(k): int(v) for k, v in dict(mesh.shape).items()}
+    out = []
+    for dim, entry in zip(tuple(shape), tuple(entries or ())):
+        if entry is None:
+            out.append(None)
+            continue
+        axes = entry if isinstance(entry, list) else [entry]
+        kept, whole = [], 1
+        for a in axes:
+            sz = sizes.get(str(a), 1)
+            if sz > 1 and int(dim) % (whole * sz) == 0:
+                kept.append(str(a))
+                whole *= sz
+        out.append(tuple(kept) if len(kept) > 1
+                   else (kept[0] if kept else None))
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
+
+
+def _validate_sidecar(doc) -> Optional[dict]:
+    """Schema gate shared by every sidecar reader: None on anything but
+    a well-formed schema-1 document (degrade, never error)."""
+    if not isinstance(doc, dict) or doc.get("schema") != SPEC_SCHEMA:
+        return None
+    if not isinstance(doc.get("leaf_specs"), list):
+        return None
+    return doc
+
+
+def _shardings_from_doc(doc, template, mesh):
+    """Per-leaf NamedShardings for `template` on `mesh` from a sidecar
+    document — the one spec-rebuild loop every restore path shares.
+    Leaves beyond the saved spec list (template grew) replicate."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    specs = doc["leaf_specs"]
+    out = []
+    for i, leaf in enumerate(leaves):
+        entries = specs[i] if i < len(specs) else None
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        spec = _spec_onto_mesh(entries, shape, mesh) \
+            if entries else P()
+        out.append(NamedSharding(mesh, spec))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def shardings_from_saved(path: str, template, mesh):
+    """Per-leaf NamedShardings for restoring checkpoint `path` onto
+    `mesh`, from the saved sidecar; None when no sidecar exists (caller
+    falls back to the template's own shardings)."""
+    doc = saved_specs(path)
+    if doc is None or mesh is None:
+        return None
+    return _shardings_from_doc(doc, template, mesh)
 
 _TMP_MARK = ".saving-"      # in-progress save dir: <name>.saving-tmp
                             # (deterministic — every host of a collective
@@ -89,6 +218,13 @@ def save_sharded(path: str, state: Any, force: bool = True) -> None:
         os.rename(tmp, path)                # path momentarily absent: a
         if had_old:                         # kill here is healed by
             shutil.rmtree(old, ignore_errors=True)   # _recover_commit
+        # per-leaf sharding sidecar (ISSUE 14): written AFTER the main
+        # commit — a crash in between leaves a valid checkpoint whose
+        # restore degrades to template shardings, never a torn one
+        try:
+            _write_sidecar(_sidecar_path(path), state)
+        except OSError:
+            pass        # advisory metadata only
     _sync(nprocs, "mx_ckpt_committed")      # rename visible everywhere
 
 
@@ -111,19 +247,28 @@ def _sync(nprocs: int, tag: str) -> None:
 
 
 def restore_sharded(path: str, template: Optional[Any] = None,
-                    shardings: Optional[Any] = None) -> Any:
+                    shardings: Optional[Any] = None,
+                    mesh: Optional[Any] = None) -> Any:
     """Restore a pytree saved by save_sharded.
 
     template: a pytree of arrays or jax.ShapeDtypeStruct giving the target
     structure; pair it with ``shardings`` (a matching pytree of
     NamedSharding) to re-lay-out onto a NEW mesh — elastic restore onto a
     different topology than the one that saved.
+
+    ``mesh`` (without explicit ``shardings``) re-shards by NAME from the
+    saved per-leaf spec sidecar: a dp×fsdp checkpoint restores sharded
+    onto any mesh with an fsdp axis and replicated onto a plain-dp mesh
+    (and vice versa — a replicated save restores replicated even onto a
+    sharded-capable mesh unless the caller passes explicit shardings).
     """
     ckptr = _checkpointer()
     path = os.path.abspath(path)
     _recover_commit(path)       # heal a crash mid-commit before reading
     if template is None:
         return ckptr.restore(path)
+    if shardings is None and mesh is not None:
+        shardings = shardings_from_saved(path, template, mesh)
     return ckptr.restore(path, _restore_target(template, shardings))
 
 
@@ -156,19 +301,44 @@ class CheckpointManager:
         import orbax.checkpoint as ocp
         self._mgr.save(step, args=ocp.args.StandardSave(state))
         self._mgr.wait_until_finished()
+        # sharding sidecar (ISSUE 14): one latest-wins document per
+        # manager directory — resume_or_init(mesh=...) re-shards by name
+        if jax.process_index() == 0:
+            try:
+                _write_sidecar(os.path.join(self._dir, "speclayout.json"),
+                               state)
+            except OSError:
+                pass
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
+    def _saved_shardings(self, template, mesh):
+        # path-based helper expects the sidecar SUFFIX convention; read
+        # the manager-dir document directly, then share the one
+        # validation + spec-rebuild implementation
+        try:
+            with open(os.path.join(self._dir, "speclayout.json")) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return None
+        doc = _validate_sidecar(doc)
+        if doc is None or mesh is None:
+            return None
+        return _shardings_from_doc(doc, template, mesh)
+
     def restore(self, step: Optional[int] = None,
                 template: Optional[Any] = None,
-                shardings: Optional[Any] = None) -> Any:
+                shardings: Optional[Any] = None,
+                mesh: Optional[Any] = None) -> Any:
         import orbax.checkpoint as ocp
         step = self.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError("no checkpoints in %s" % self._dir)
         if template is None:
             return self._mgr.restore(step)
+        if shardings is None and mesh is not None:
+            shardings = self._saved_shardings(template, mesh)
         return self._mgr.restore(
             step, args=ocp.args.StandardRestore(
                 _restore_target(template, shardings)))
@@ -182,6 +352,7 @@ class CheckpointManager:
 
 def resume_or_init(directory: str, init_fn: Callable[[], Any], *,
                    shardings: Optional[Any] = None,
+                   mesh: Optional[Any] = None,
                    max_to_keep: int = 3,
                    manager: Optional[CheckpointManager] = None,
                    ) -> Tuple[Any, int, CheckpointManager]:
@@ -192,7 +363,9 @@ def resume_or_init(directory: str, init_fn: Callable[[], Any], *,
     jax.Arrays); it always runs — its result is either returned as-is
     (cold start) or used as the restore template so arrays land with the
     new job's shapes/dtypes (pass ``shardings`` to re-lay-out onto a new
-    mesh).  Returns ``(state, start_step, manager)`` where
+    mesh, or just ``mesh`` to re-shard by NAME from the saved per-leaf
+    spec sidecar — a sharded job restores sharded, ISSUE 14).  Returns
+    ``(state, start_step, manager)`` where
     ``start_step`` is 0 on a cold start and ``latest_step() + 1`` after
     a resume — drivers loop ``for step in range(start_step, total)`` and
     ``manager.save(step, state)`` periodically, and a crashed-and-
@@ -203,5 +376,6 @@ def resume_or_init(directory: str, init_fn: Callable[[], Any], *,
     step = mgr.latest_step()
     if step is None:
         return state, 0, mgr
-    restored = mgr.restore(step, template=state, shardings=shardings)
+    restored = mgr.restore(step, template=state, shardings=shardings,
+                           mesh=mesh)
     return restored, step + 1, mgr

@@ -118,6 +118,21 @@ class Block:
         for cname, child in self._children.items():
             yield from child._iter_params(prefix + cname + ".")
 
+    def sharding_spec(self, layout):
+        """Per-parameter PartitionSpec overrides for sharded training
+        (the SpecLayout hook, ISSUE 14).  Called by
+        :meth:`mxnet_tpu.parallel.SpecLayout.resolve` on every block in
+        the tree; return ``{param-attr-name-or-Parameter:
+        jax.sharding.PartitionSpec}`` to pin a layout for this block's
+        OWN parameters (``self._reg_params`` names, e.g. ``"weight"``),
+        or an empty mapping to accept the layout's defaults (embeddings
+        and linears split on ``tp``, everything else sheet-sharded on
+        ``fsdp``).  A ``PartitionSpec()`` value forces replication;
+        entries naming axes the mesh lacks (or that do not divide the
+        dimension) degrade to replication rather than erroring, so one
+        declaration serves every mesh class."""
+        return {}
+
     def initialize(self, init=None, ctx=None, verbose: bool = False,
                    force_reinit: bool = False) -> None:
         self.collect_params().initialize(init, ctx, verbose, force_reinit)

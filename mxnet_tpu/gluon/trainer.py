@@ -206,7 +206,7 @@ class Trainer:
         self._optimizer.set_learning_rate(lr)
 
     # -- whole-step compiled lane (ISSUE 7) --------------------------------
-    def make_compiled_step(self, net, loss_fn, metric=None):
+    def make_compiled_step(self, net, loss_fn, metric=None, layout=None):
         """A :class:`mxnet_tpu.step.CompiledStep` over this trainer:
         forward + loss + backward + this trainer's gradient exchange
         (incl. int8/2bit compression) + the fused optimizer apply (+ the
@@ -215,9 +215,20 @@ class Trainer:
         trainer's parameters, updater state and error-feedback residuals
         every dispatch, so eager ``step()`` calls, ``save_states`` and
         checkpoints interoperate; transports the trace cannot express
-        (dist_async) fall back to the eager pipeline automatically."""
+        (dist_async) fall back to the eager pipeline automatically.
+
+        ``layout`` (a :class:`mxnet_tpu.parallel.SpecLayout`, or the
+        MX_MESH_AXES/MX_FSDP env knobs when omitted) turns the step into
+        the SHARDED one-donated-jit: parameters + optimizer state live
+        FSDP/ZeRO-sheet- and TP-split across the layout's mesh, the
+        batch splits over data×fsdp, gradients reduce-scatter onto the
+        parameter shards (int8-quantized per bucket when this trainer
+        carries compression_params) and XLA all-gathers updated
+        parameters just in time — per-chip state bytes drop ~linearly
+        with the fsdp axis (ISSUE 14)."""
         from ..step import CompiledStep
-        return CompiledStep(net, loss_fn, self, metric=metric)
+        return CompiledStep(net, loss_fn, self, metric=metric,
+                            layout=layout)
 
     # -- the step ----------------------------------------------------------
     def step(self, batch_size, ignore_stale_grad=False):

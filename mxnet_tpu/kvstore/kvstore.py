@@ -155,8 +155,8 @@ class KVStore:
         _engine.count_wire_bytes(
             self._wire_nbytes(m.size, m._jax.dtype.itemsize, floating))
 
-    # -- whole-step-compiled exchange (ISSUE 7) ----------------------------
-    def build_exchange_body(self, keys, arrays):
+    # -- whole-step-compiled exchange (ISSUE 7; sharded variant ISSUE 14) --
+    def build_exchange_body(self, keys, arrays, layout=None):
         """Pure-traceable single-worker exchange body for the compiled
         train step (mxnet_tpu.step.CompiledStep): what ONE worker's
         batched push+pull observes of this store's wire, expressed as a
@@ -173,24 +173,47 @@ class KVStore:
         quantization when gradient compression is installed (exactly
         :meth:`_reduce`'s wire model), bf16 cast-roundtrip under the
         bf16 mode, identity otherwise.
+
+        ``layout`` (a :class:`~mxnet_tpu.parallel.SpecLayout`) selects
+        the reduce-scatter/all-gather variant: each quantized payload is
+        sharding-constrained onto the layout's fsdp shards before the
+        error-feedback kernel runs, so under GSPMD the gradient sum
+        reaches each chip as a reduce-scatter, quantization happens
+        shard-local, and the residual state stays sharded per chip
+        (``residual_shardings`` tells the step how to place/donate it).
+        Sharding never changes the math — the replicated body and the
+        sharded body compute identical values.
         """
         if self._updater is not None or self._optimizer is not None:
             return None     # server-side optimizer: push is not a pure exchange
         keys = [_key(k) for k in keys]
         gc = getattr(self, "_gc", None)
         bf16 = getattr(self, "_compress_bf16", False)
-        plan = []           # per position: (mode, wire_key or None)
+        plan = []           # per position: (mode, payload sharding or None)
         specs = []          # (wire_key, residual shape, residual dtype)
+        shardings = []      # residual placement, aligned with specs
         wire_bytes = 0
         for k, a in zip(keys, arrays):
             floating = jnp.issubdtype(jnp.dtype(str(a.dtype)), jnp.floating)
             if gc is not None and floating:
-                plan.append((gc.type, k))
                 if gc.type == "int8":
+                    # the fsdp rs-grain int8 path lives on the ICI
+                    # store's bucketed body; the base per-key body keeps
+                    # the replicated kernel (residual replicated)
+                    sh = None if layout is None else layout.replicated()
+                    plan.append(("int8", sh))
                     specs.append((k, (int(a.size),), jnp.float32))
                 else:
+                    # 2bit is elementwise: the residual simply lives on
+                    # the gradient's sheet shards; no mid-body
+                    # constraints needed (the step already constrains
+                    # the gradients themselves)
+                    sh = None if layout is None else \
+                        layout.sharding(layout.sheet_spec(tuple(a.shape)))
+                    plan.append(("2bit", sh))
                     specs.append((k, tuple(a.shape),
                                   jnp.dtype(str(a.dtype))))
+                shardings.append(sh)
                 wire_bytes += gc.wire_nbytes(int(a.size))
                 continue
             if bf16 and floating and _np.dtype(str(a.dtype)).itemsize == 4:
@@ -206,7 +229,7 @@ class KVStore:
             from ..ops import quantization as _qops
             res_it = iter(residuals)
             new_grads, new_res = [], []
-            for (mode, _wk), g in zip(plan, grads):
+            for (mode, _sh), g in zip(plan, grads):
                 if mode == "int8":
                     deq, nr = _qops._roundtrip_int8_kernel(
                         g.reshape(-1), next(res_it), block)
@@ -224,7 +247,8 @@ class KVStore:
                     new_grads.append(g)
             return new_grads, new_res
 
-        return TraceableExchange(specs, body, wire_bytes)
+        return TraceableExchange(specs, body, wire_bytes,
+                                 residual_shardings=shardings)
 
     # -- overlap-scheduled exchange (ISSUE 5) ------------------------------
     def begin_exchange(self, keys, vlists):
@@ -465,10 +489,17 @@ class TraceableExchange:
     recorded for the same exchange.
     """
 
-    def __init__(self, residual_specs, body, wire_bytes: int = 0):
+    def __init__(self, residual_specs, body, wire_bytes: int = 0,
+                 residual_shardings=None):
         self.residual_specs = list(residual_specs)
         self._body = body
         self.wire_bytes = int(wire_bytes)
+        # sharded lane (ISSUE 14): each residual's NamedSharding, aligned
+        # with residual_specs (None entries replicate) — the EF state
+        # stays sharded per chip across dispatches
+        self.residual_shardings = list(
+            residual_shardings if residual_shardings is not None
+            else [None] * len(self.residual_specs))
 
     def __call__(self, grads, residuals):
         """(new_grads, new_residuals) — pure, safe under an outer jit."""
@@ -909,20 +940,31 @@ class KVStoreICI(KVStoreLocal):
                                   ctx=m.context))
         return pieces
 
-    def build_exchange_body(self, keys, arrays):
+    def build_exchange_body(self, keys, arrays, layout=None):
         """ICI's traceable body mirrors :meth:`_reduce_many`'s
         single-process semantics: int8 compression quantizes per FUSION
         BUCKET (concat → error-feedback roundtrip keyed by the bucket's
         CRC name → split), solo/2bit/bf16 keys ride the per-key base
         body.  Multi-process exchange needs the SPMD mesh lane
         (parallel.TrainStep) — the compiled Gluon step falls back to the
-        eager pipeline there."""
+        eager pipeline there.
+
+        With ``layout`` (ISSUE 14) this is the **reduce-scatter /
+        all-gather** variant next to the existing allreduce: each flat
+        bucket payload is sharding-constrained over the layout's fsdp
+        axis before the error-feedback roundtrip, so GSPMD delivers the
+        gradient sum to each chip as a reduce-scatter of the int8
+        (codes, scales) grain, quantization and the residual update run
+        shard-local, and the dequantized pieces all-gather back into
+        each consumer's layout only where the optimizer apply needs
+        them.  Residuals stay sharded per chip (``residual_shardings``).
+        """
         if self._size > 1:
             return None
         gc = getattr(self, "_gc", None)
         if gc is None or gc.type != "int8" or \
                 self._updater is not None or self._optimizer is not None:
-            return super().build_exchange_body(keys, arrays)
+            return super().build_exchange_body(keys, arrays, layout=layout)
         keys = [_key(k) for k in keys]
         buckets: List = []
         solo = range(len(keys))
@@ -932,47 +974,97 @@ class KVStoreICI(KVStoreLocal):
                 buckets, solo = self._bucket_plans(keys, arrays)
         solo = list(solo)
         block = gc.block
+        from ..ops.quantization import rs_block_bytes
+        # the reduce-scatter grain (fsdp>1): every flat payload pads to
+        # whole blocks per shard so shard-local quantization IS logical
+        # blockwise quantization; residuals live at the PADDED length,
+        # fsdp-sharded (a lane switch rolls them — the shape mismatch
+        # hands back fresh zeros, same as a bucket-layout change)
+        fsdp = 0 if layout is None else int(layout.fsdp)
+        use_rs = fsdp > 1
+
+        def _payload(n):
+            """(residual length, residual sharding) of one n-elem flat
+            int8 payload under the active layout."""
+            if not use_rs:
+                return int(n), (None if layout is None
+                                else layout.replicated())
+            npad = rs_block_bytes(int(n), block, fsdp)
+            from jax.sharding import PartitionSpec as _P
+            return npad, layout.sharding(_P(layout.fsdp_axis))
+
         specs = []
+        shardings = []
         wire_bytes = 0
         solo_modes = []
+        bucket_pads = []
         for b in buckets:
-            specs.append((b.name, (int(b.total),), jnp.float32))
+            npad, sh = _payload(b.total)
+            specs.append((b.name, (npad,), jnp.float32))
+            shardings.append(sh)
+            bucket_pads.append(npad)
             wire_bytes += gc.wire_nbytes(int(b.total))
+        solo_pads = []
         for p in solo:
             a = arrays[p]
             floating = jnp.issubdtype(jnp.dtype(str(a.dtype)), jnp.floating)
             if floating:
-                specs.append((keys[p], (int(a.size),), jnp.float32))
+                npad, sh = _payload(a.size)
+                specs.append((keys[p], (npad,), jnp.float32))
+                shardings.append(sh)
+                solo_pads.append(npad)
                 wire_bytes += gc.wire_nbytes(int(a.size))
                 solo_modes.append("int8")
             else:
                 wire_bytes += int(a.size) * _np.dtype(str(a.dtype)).itemsize
                 solo_modes.append("none")
+                solo_pads.append(0)
+
+        def _quantize_flat(flat, res, npad):
+            from jax import lax as _lax
+            from ..ops import quantization as _qops
+            if not use_rs:
+                return _qops._roundtrip_int8_kernel(flat, res, block)
+            n = flat.shape[0]
+            if npad > n:
+                flat = jnp.concatenate(
+                    [flat, jnp.zeros((npad - n,), flat.dtype)])
+            # XLA:CPU SPMD miscompiles a `concatenate` whose consumer is
+            # sharded: the operands get partitioned over the OTHER mesh
+            # axes and the pieces psum'd, so values arrive multiplied by
+            # the data-axis size.  Pinning the concat result replicated
+            # before the manual shard_map kernel sidesteps it — the
+            # kernel itself then reshards to the fsdp grain (the
+            # reduce-scatter) from a known-good replicated value.
+            flat = _lax.with_sharding_constraint(flat, layout.replicated())
+            deq, nr = _qops.rs_roundtrip_int8(flat, res, block,
+                                              layout.mesh,
+                                              layout.fsdp_axis)
+            return deq[:n], nr
 
         def body(grads, residuals):
-            from ..ops import quantization as _qops
             res_it = iter(residuals)
             new_grads = list(grads)
             new_res = []
-            for b in buckets:
+            for b, npad in zip(buckets, bucket_pads):
                 flat = jnp.concatenate(
                     [grads[p].reshape(-1) for p in b.positions])
-                deq, nr = _qops._roundtrip_int8_kernel(flat, next(res_it),
-                                                       block)
+                deq, nr = _quantize_flat(flat, next(res_it), npad)
                 new_res.append(nr)
                 for p, off, size, shape in b.slices():
                     new_grads[p] = deq[off:off + size].reshape(shape).astype(
                         grads[p].dtype)
-            for p, mode in zip(solo, solo_modes):
+            for p, mode, npad in zip(solo, solo_modes, solo_pads):
                 if mode == "int8":
-                    g = grads[p]
-                    deq, nr = _qops._roundtrip_int8_kernel(
-                        g.reshape(-1), next(res_it), block)
-                    new_grads[p] = deq.reshape(g.shape).astype(g.dtype)
+                    g = grads[p].reshape(-1)
+                    deq, nr = _quantize_flat(g, next(res_it), npad)
+                    new_grads[p] = deq.reshape(
+                        grads[p].shape).astype(grads[p].dtype)
                     new_res.append(nr)
             return new_grads, new_res
 
-        return TraceableExchange(specs, body, wire_bytes)
+        return TraceableExchange(specs, body, wire_bytes,
+                                 residual_shardings=shardings)
 
     def _barrier(self):
         if self._size > 1:
@@ -1396,7 +1488,7 @@ class KVStoreDistAsync(KVStore):
         the batched push/pull."""
         return None
 
-    def build_exchange_body(self, keys, arrays):
+    def build_exchange_body(self, keys, arrays, layout=None):
         """Untraceable: the exchange crosses a TCP socket mid-step (the
         server applies pushes the moment they arrive), so there is no
         pure function of the local gradients to inline — the compiled

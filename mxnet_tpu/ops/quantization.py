@@ -490,6 +490,42 @@ def roundtrip_int8_blocks(flat, residual, block=None, donate=True):
     return fn(flat, residual)
 
 
+def rs_block_bytes(n: int, block: int, fsdp: int) -> int:
+    """Padded flat length of the reduce-scatter int8 grain: whole blocks
+    per fsdp shard, so shard-local blockwise quantization IS logical
+    blockwise quantization."""
+    grain = block * max(1, int(fsdp))
+    return -(-int(n) // grain) * grain
+
+
+def rs_roundtrip_int8(flat_padded, residual, block, mesh, fsdp_axis):
+    """Shard-local error-feedback int8 roundtrip over the fsdp axis
+    (ISSUE 14): the payload arrives fsdp-sharded (the reduce-scatter
+    grain), every chip quantizes ITS whole blocks against its own
+    residual shard, and the dequantized payload stays fsdp-sharded for
+    the ZeRO optimizer apply (XLA all-gathers later uses on demand).
+
+    Implemented with ``shard_map`` — manual partitioning — rather than
+    ``with_sharding_constraint`` on purpose: the auto-partitioner
+    miscompiles the blockwise max/scale reductions of this kernel when
+    their output sharding is constrained (observed on XLA:CPU, jax
+    0.4.37: per-block scales come back multiplied by the size of the
+    OTHER mesh axes — a psum where a max belongs).  Inside shard_map
+    the blockwise math is local per chip, so there is nothing for the
+    partitioner to get wrong; ``flat_padded`` must be block-aligned per
+    shard (:func:`rs_block_bytes`).
+    """
+    try:
+        from jax import shard_map as _shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _shard_map
+    from jax.sharding import PartitionSpec as _P
+    spec = _P(fsdp_axis)
+    local = functools.partial(_roundtrip_int8_kernel, block=block)
+    return _shard_map(local, mesh=mesh, in_specs=(spec, spec),
+                      out_specs=(spec, spec))(flat_padded, residual)
+
+
 def _dequant_sum_requant_kernel(q, scales):
     """Scale-merged reduction of W workers' int8 payloads: dequantize each
     at its own per-block scale, sum, requantize the sum at a fresh merged

@@ -106,47 +106,22 @@ def batch_sharded(mesh: Mesh, axis: str = "dp") -> NamedSharding:
 def shard_params_tp(param_values: Dict[str, jax.Array], mesh: Mesh,
                     tp_axis: str = "tp",
                     rules: Optional[Dict[str, Any]] = None):
-    """Megatron-style TP placement for Dense weights.
-
-    rules: {param-name-substring: PartitionSpec} — explicit layout control
-    (the Megatron-style annotation surface); any param not matching a rule
-    replicates.  Without rules: alternate column-parallel ((tp, None) on
-    the (out, in) weight) and row-parallel ((None, tp)) for consecutive
-    '.weight' 2-D params; biases and everything else replicate.
+    """Deprecated thin alias: Megatron-style TP placement for Dense
+    weights, now owned by :mod:`mxnet_tpu.parallel.speclayout` (the one
+    source of truth for parameter shardings — ISSUE 14).  Same
+    semantics as ever: explicit ``rules`` ({name-substring:
+    PartitionSpec}; unmatched params replicate), else column/row
+    alternation for consecutive 2-D '.weight' params.  New code should
+    build a :class:`~mxnet_tpu.parallel.speclayout.SpecLayout` and call
+    :func:`~mxnet_tpu.parallel.speclayout.shard_params` (which adds the
+    fsdp/ZeRO sheet-sharding this TP-only surface never had).
 
     NOTE: sharding choices here NEVER change results — XLA inserts the
     collectives that preserve the math; a suboptimal layout only costs
-    communication.  The default alternation is the right layout for MLP
-    stacks (one psum per Dense pair); for other architectures pass rules.
+    communication.
     """
-    tp = mesh.shape.get(tp_axis, 1)
-    out = {}
-    col = True
-    for name, v in param_values.items():
-        if rules is not None:
-            spec = P()   # explicit mode: unmatched params replicate
-            for frag, s in rules.items():
-                if frag in name:
-                    spec = s
-                    break
-        elif tp > 1 and name.endswith("weight") and v.ndim == 2:
-            spec = P(tp_axis, None) if col else P(None, tp_axis)
-            col = not col
-        else:
-            # biases and everything else replicate (always a valid
-            # placement; XLA re-shards at use sites as needed)
-            spec = P()
-        sharding = NamedSharding(mesh, spec)
-        if jax.process_count() > 1:
-            # multi-host: device_put would need a cross-host transfer; every
-            # process holds the SAME full value (same-seed init / broadcast),
-            # so assemble the global array from local slices
-            host_v = _np.asarray(v)
-            out[name] = jax.make_array_from_callback(
-                host_v.shape, sharding, lambda idx, hv=host_v: hv[idx])
-        else:
-            out[name] = jax.device_put(v, sharding)
-    return out
+    from .speclayout import shard_params_tp as _impl
+    return _impl(param_values, mesh, tp_axis=tp_axis, rules=rules)
 
 
 class TrainStep:

@@ -1,0 +1,473 @@
+"""SpecLayout: canonical PartitionSpecs over named data/fsdp/tp mesh axes.
+
+The sharding layer ROADMAP item 1 asks for (promoting the SNIPPETS.md
+SpecLayout / PartitionSpec-helper patterns into the real thing): one
+object that owns the mapping from *parameter identity* to *placement*
+so every consumer — the sharded :class:`~mxnet_tpu.step.CompiledStep`,
+the kvstore exchange bodies, ``checkpoint.save_sharded`` and the buffer
+census — derives the same layout from the same three named axes:
+
+``data``
+    pure data parallelism: batches split, parameters replicated.
+``fsdp``
+    ZeRO/FSDP: batches split AND parameters + optimizer state
+    sheet-sharded — per-chip state bytes drop ~linearly with the axis
+    size; XLA all-gathers parameters just in time for each use and
+    reduce-scatters gradients back onto the shards.
+``tp``
+    Megatron tensor parallelism: weight matrices split within a layer
+    (embeddings and linears), activations cross chips inside the layer.
+
+Resolution order for one parameter's PartitionSpec (first hit wins):
+
+1. explicit ``rules`` ({name-substring: PartitionSpec}, the operator's
+   escape hatch — matching the old ``shard_params_tp(rules=...)``);
+2. the owning Block's :meth:`~mxnet_tpu.gluon.block.Block.sharding_spec`
+   hook (architecture-specific layouts declared next to the layer);
+3. kind defaults: embedding weights shard the vocab axis over
+   ``fsdp×tp``, linear (Dense) weights split ``(out, in)`` over
+   ``(tp, fsdp)``;
+4. everything else sheet-shards its largest divisible axis over
+   ``fsdp``; scalars and indivisible shapes replicate.
+
+Axes absent from the mesh (or of size 1) drop out of every spec, so the
+same model code runs unchanged on ``data``-only, ``data×fsdp`` and
+``data×fsdp×tp`` meshes — and sharding choices NEVER change results
+(XLA inserts the collectives that preserve the math; a different layout
+only moves communication).
+
+``shard_params_tp`` — the pre-SpecLayout TP-only entry point from
+``parallel/mesh.py`` — is folded in here (its column/row alternation is
+:func:`tp_alternation_specs`); ``mesh.shard_params_tp`` remains as a
+thin deprecated alias so existing callers keep working while this
+module stays the one source of truth for parameter shardings.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as _np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["SpecLayout", "tp_alternation_specs", "shard_params",
+           "shard_params_tp", "place_value", "layout_from_env",
+           "mesh_from_env", "parse_mesh_axes"]
+
+# block-class-name -> {param attr name: kind}; the kind defaults of
+# resolution step 3.  Extended here rather than monkey-patched so the
+# mapping is greppable next to the resolution order it feeds.
+_BLOCK_PARAM_KINDS = {
+    "Dense": {"weight": "linear"},
+    "Embedding": {"weight": "embedding"},
+}
+
+
+def _dim_divisible(dim: int, size: int) -> bool:
+    return size > 1 and dim % size == 0
+
+
+class SpecLayout:
+    """Canonical PartitionSpecs for parameters/state/batches on `mesh`.
+
+    ``rules`` maps parameter-name substrings to explicit PartitionSpecs
+    (checked first, in insertion order).  Axis names default to
+    ``data``/``fsdp``/``tp``; any subset may be present on the mesh —
+    :meth:`infer` accepts the legacy ``dp`` spelling for the data axis.
+    """
+
+    __slots__ = ("mesh", "data_axis", "fsdp_axis", "tp_axis", "rules",
+                 "_sig")
+
+    def __init__(self, mesh: Mesh, data_axis: str = "data",
+                 fsdp_axis: str = "fsdp", tp_axis: str = "tp",
+                 rules: Optional[Dict[str, Any]] = None):
+        self.mesh = mesh
+        self.data_axis = data_axis
+        self.fsdp_axis = fsdp_axis
+        self.tp_axis = tp_axis
+        self.rules = dict(rules or {})
+        # immutable once built: the signature (consulted on every step
+        # dispatch) is computed once, not O(n_devices) per step
+        self._sig = (tuple(mesh.axis_names),
+                     tuple(int(s) for s in mesh.shape.values()),
+                     tuple(d.id for d in mesh.devices.flat),
+                     data_axis, fsdp_axis, tp_axis,
+                     tuple((k, repr(v))
+                           for k, v in sorted(self.rules.items())))
+
+    @classmethod
+    def infer(cls, mesh: Mesh, rules: Optional[Dict[str, Any]] = None
+              ) -> "SpecLayout":
+        """Layout over `mesh` with the data axis name detected: the
+        first axis named ``data``/``dp``/``batch``, else the first axis
+        that is neither ``fsdp`` nor ``tp``."""
+        names = list(mesh.axis_names)
+        data = next((n for n in names if n in ("data", "dp", "batch")),
+                    None)
+        if data is None:
+            data = next((n for n in names if n not in ("fsdp", "tp")),
+                        "data")
+        return cls(mesh, data_axis=data, rules=rules)
+
+    # -- axis helpers ------------------------------------------------------
+    def axis_size(self, axis: str) -> int:
+        return int(dict(self.mesh.shape).get(axis, 1))
+
+    def _present(self, axis: str) -> bool:
+        return self.axis_size(axis) > 1
+
+    @property
+    def fsdp(self) -> int:
+        return self.axis_size(self.fsdp_axis)
+
+    @property
+    def tp(self) -> int:
+        return self.axis_size(self.tp_axis)
+
+    def signature(self) -> Tuple:
+        """Trace-identity of this layout: mesh topology + axis naming +
+        rules — what a compiled-step cache key folds in so a mesh or
+        rule change retraces instead of reusing a stale executable."""
+        return self._sig
+
+    # -- specs -------------------------------------------------------------
+    def batch_spec(self) -> P:
+        """Batch axis 0 splits over every data-parallel axis present:
+        under FSDP each fsdp rank consumes its own micro-shard (ZeRO is
+        data parallelism), so the batch spec is ``(data, fsdp)``."""
+        axes = [a for a in (self.data_axis, self.fsdp_axis)
+                if self._present(a)]
+        if not axes:
+            return P()
+        return P(tuple(axes) if len(axes) > 1 else axes[0])
+
+    def batch_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, self.batch_spec())
+
+    def batch_spec_for(self, shape, batch_dim: int = 0) -> P:
+        """The batch spec applied to dimension `batch_dim` of `shape`
+        (stacked scan-window leaves carry (n_micro, B, ...) — the batch
+        is axis 1 there), degraded to replication when the dimension
+        does not divide the data×fsdp extent."""
+        if not shape or batch_dim >= len(shape):
+            return P()
+        axes = [a for a in (self.data_axis, self.fsdp_axis)
+                if self._present(a)]
+        if not axes:
+            return P()
+        entries = [None] * len(shape)
+        entries[batch_dim] = tuple(axes) if len(axes) > 1 else axes[0]
+        return self._fit(tuple(entries), shape)
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def sharding(self, spec: P) -> NamedSharding:
+        return NamedSharding(self.mesh, spec)
+
+    def _fit(self, spec_entries, shape) -> P:
+        """Drop spec axes the shape cannot honor (missing from the mesh,
+        size 1, or not dividing the dimension) — an ill-fitting axis
+        replicates that dimension rather than erroring, so one layout
+        serves every mesh class."""
+        out = []
+        for dim, entry in zip(shape, spec_entries):
+            if entry is None:
+                out.append(None)
+                continue
+            axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+            kept, whole = [], 1
+            for a in axes:
+                sz = self.axis_size(a)
+                if sz > 1 and int(dim) % (whole * sz) == 0:
+                    kept.append(a)
+                    whole *= sz
+            out.append(tuple(kept) if len(kept) > 1
+                       else (kept[0] if kept else None))
+        while out and out[-1] is None:
+            out.pop()
+        return P(*out)
+
+    def embedding_spec(self, shape) -> P:
+        """Embedding tables shard the vocab axis over fsdp×tp (the
+        SNIPPETS pattern): both model axes carve the one huge dimension,
+        lookups gather only the owning shard's rows."""
+        if len(shape) < 1:
+            return P()
+        return self._fit(((self.fsdp_axis, self.tp_axis),)
+                         + (None,) * (len(shape) - 1), shape)
+
+    def linear_spec(self, shape) -> P:
+        """Dense ``(out, in)`` weights: column-parallel over ``tp`` on
+        the output dim (Megatron), ``fsdp``-sharded on the input dim —
+        each chip owns an (out/tp, in/fsdp) tile."""
+        if len(shape) != 2:
+            return self.sheet_spec(shape)
+        return self._fit((self.tp_axis, self.fsdp_axis), shape)
+
+    def sheet_spec(self, shape) -> P:
+        """The everything-else default: sheet-shard the largest
+        fsdp-divisible dimension over ``fsdp``; replicate when nothing
+        divides (biases, scalars, odd shapes)."""
+        fsdp = self.fsdp
+        if fsdp <= 1 or not shape:
+            return P()
+        best = None
+        for i, d in enumerate(shape):
+            if _dim_divisible(int(d), fsdp):
+                if best is None or int(d) > int(shape[best]):
+                    best = i
+        if best is None:
+            return P()
+        entries = [None] * len(shape)
+        entries[best] = self.fsdp_axis
+        return self._fit(tuple(entries), shape)
+
+    def param_spec(self, name: str, shape, dtype=None,
+                   kind: Optional[str] = None,
+                   hook_spec: Optional[P] = None) -> P:
+        """One parameter's PartitionSpec under the documented resolution
+        order: rules > Block hook > kind default > fsdp sheet."""
+        for frag, spec in self.rules.items():
+            if frag in name:
+                return self._fit(tuple(spec) + (None,) *
+                                 (len(shape) - len(tuple(spec))), shape)
+        if hook_spec is not None:
+            return self._fit(tuple(hook_spec) + (None,) *
+                             (len(shape) - len(tuple(hook_spec))), shape)
+        if kind == "embedding":
+            return self.embedding_spec(shape)
+        if kind == "linear":
+            return self.linear_spec(shape)
+        return self.sheet_spec(shape)
+
+    def compute_spec(self, spec: P) -> P:
+        """The spec a parameter COMPUTES under: its storage spec with the
+        fsdp axis removed.  FSDP stores sheet-sharded but consumes whole
+        (tp splits stay — they are the intra-layer compute layout); the
+        sharded step constrains each parameter to this spec at its use
+        site, which is the explicit just-in-time all-gather, and
+        constrains gradients back to the storage spec (the
+        reduce-scatter).  Keeping the gather explicit also sidesteps an
+        XLA:SPMD partitioner unsoundness: differentiating a stacked
+        matmul whose weight carries BOTH tp and fsdp while the batch is
+        fsdp-sharded miscompiles the weight gradient (observed on
+        XLA:CPU, jax 0.4.37) unless the operand is resharded before the
+        dot."""
+        out = []
+        for entry in tuple(spec):
+            if entry is None:
+                out.append(None)
+                continue
+            axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+            kept = [a for a in axes if a != self.fsdp_axis]
+            out.append(tuple(kept) if len(kept) > 1
+                       else (kept[0] if kept else None))
+        while out and out[-1] is None:
+            out.pop()
+        return P(*out)
+
+    def state_spec(self, param_spec: P, shape) -> P:
+        """Optimizer slot state lives ZeRO-style on its parameter's
+        shards (same-shape moments inherit the spec verbatim); shapes
+        that differ from the parameter fall back to the sheet default."""
+        entries = tuple(param_spec)
+        if len(entries) <= len(shape):
+            return self._fit(entries + (None,) * (len(shape) -
+                                                  len(entries)), shape)
+        return self.sheet_spec(shape)
+
+    # -- block resolution --------------------------------------------------
+    def resolve(self, block=None, params: Optional[Dict[str, Any]] = None
+                ) -> Dict[str, P]:
+        """{structural name: PartitionSpec} for every parameter.
+
+        With a ``block``, walks the tree collecting each sub-block's
+        :meth:`sharding_spec` hook result and the kind defaults
+        (:data:`_BLOCK_PARAM_KINDS`); with a bare ``params`` mapping
+        (name -> array-like), only rules + shape defaults apply.
+        """
+        hook_specs: Dict[int, P] = {}
+        kinds: Dict[int, str] = {}
+        named = {}
+        if block is not None:
+            self._walk(block, hook_specs, kinds)
+            for name, p in block.collect_params().items():
+                named[name] = p
+        elif params is not None:
+            named = dict(params)
+        out: Dict[str, P] = {}
+        for name, p in named.items():
+            shape = tuple(getattr(p, "shape", ()) or ())
+            dtype = getattr(p, "dtype", None)
+            out[name] = self.param_spec(
+                name, shape, dtype, kind=kinds.get(id(p)),
+                hook_spec=hook_specs.get(id(p)))
+        return out
+
+    def _walk(self, block, hook_specs: Dict[int, P],
+              kinds: Dict[int, str]) -> None:
+        by_kind = _BLOCK_PARAM_KINDS.get(type(block).__name__)
+        if by_kind:
+            for attr, kind in by_kind.items():
+                p = block._reg_params.get(attr)
+                if p is not None:
+                    kinds[id(p)] = kind
+        hook = getattr(block, "sharding_spec", None)
+        if callable(hook):
+            declared = hook(self) or {}
+            for key, spec in declared.items():
+                p = key if not isinstance(key, str) \
+                    else block._reg_params.get(key)
+                if p is not None and spec is not None:
+                    hook_specs[id(p)] = spec
+        for child in block._children.values():
+            self._walk(child, hook_specs, kinds)
+
+
+# ---------------------------------------------------------------------------
+# placement (the device_put half of the old shard_params_tp, now shared)
+# ---------------------------------------------------------------------------
+
+
+def place_value(value, sharding: NamedSharding):
+    """Place one (host or device) value onto `sharding`.  Multi-host:
+    every process holds the SAME full value (same-seed init/broadcast),
+    so the global array assembles from local slices instead of paying a
+    cross-host device_put."""
+    if getattr(value, "sharding", None) == sharding:
+        return value
+    if jax.process_count() > 1:
+        host_v = _np.asarray(value)
+        return jax.make_array_from_callback(
+            host_v.shape, sharding, lambda idx, hv=host_v: hv[idx])
+    return jax.device_put(value, sharding)
+
+
+def shard_params(param_values: Dict[str, jax.Array],
+                 layout: SpecLayout,
+                 specs: Optional[Dict[str, P]] = None
+                 ) -> Dict[str, jax.Array]:
+    """Place a name->array mapping onto the layout's resolved specs."""
+    specs = specs or layout.resolve(params=param_values)
+    return {name: place_value(v, layout.sharding(specs.get(name, P())))
+            for name, v in param_values.items()}
+
+
+# ---------------------------------------------------------------------------
+# the folded-in TP-only entry point (parallel/mesh.py keeps a thin alias)
+# ---------------------------------------------------------------------------
+
+
+def tp_alternation_specs(param_values: Dict[str, Any], mesh: Mesh,
+                         tp_axis: str = "tp",
+                         rules: Optional[Dict[str, Any]] = None
+                         ) -> Dict[str, P]:
+    """The legacy ``shard_params_tp`` layout as pure specs: explicit
+    rules (unmatched params replicate), else alternate column-parallel
+    ``(tp, None)`` / row-parallel ``(None, tp)`` for consecutive 2-D
+    '.weight' params; biases and everything else replicate."""
+    tp = int(dict(mesh.shape).get(tp_axis, 1))
+    specs: Dict[str, P] = {}
+    col = True
+    for name, v in param_values.items():
+        if rules is not None:
+            spec = P()
+            for frag, s in rules.items():
+                if frag in name:
+                    spec = s
+                    break
+        elif tp > 1 and name.endswith("weight") and \
+                getattr(v, "ndim", len(getattr(v, "shape", ()))) == 2:
+            spec = P(tp_axis, None) if col else P(None, tp_axis)
+            col = not col
+        else:
+            spec = P()
+        specs[name] = spec
+    return specs
+
+
+def shard_params_tp(param_values: Dict[str, jax.Array], mesh: Mesh,
+                    tp_axis: str = "tp",
+                    rules: Optional[Dict[str, Any]] = None):
+    """Deprecated TP-only placement (the pre-SpecLayout entry point).
+
+    Kept as a thin alias over :func:`tp_alternation_specs` +
+    :func:`place_value` with the exact legacy semantics; new code should
+    build a :class:`SpecLayout` and use :func:`shard_params` (one source
+    of truth for parameter shardings, fsdp included).
+    """
+    specs = tp_alternation_specs(param_values, mesh, tp_axis, rules)
+    return {name: place_value(v, NamedSharding(mesh, specs[name]))
+            for name, v in param_values.items()}
+
+
+# ---------------------------------------------------------------------------
+# env-driven construction (MX_MESH_AXES / MX_FSDP)
+# ---------------------------------------------------------------------------
+
+
+def parse_mesh_axes(text: str, fsdp_override: Optional[int] = None
+                    ) -> Tuple[Tuple[str, ...], Tuple[int, ...]]:
+    """Parse ``MX_MESH_AXES`` — comma-separated ``name[=size]`` tokens,
+    e.g. ``data,fsdp=2,tp=2``.  Unsized axes default to -1 (inferred)
+    for the data axis and 2 for model axes; ``fsdp_override`` (the
+    MX_FSDP knob) wins for the fsdp axis."""
+    axes, sizes = [], []
+    for tok in (text or "").split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        if "=" in tok:
+            name, _, sz = tok.partition("=")
+            name = name.strip()
+            size = int(sz)
+        else:
+            name = tok
+            size = -1 if name in ("data", "dp", "batch") else 2
+        if name == "fsdp" and fsdp_override is not None:
+            size = int(fsdp_override)
+        if size != -1 and size < 1:
+            # MX_FSDP=0 is the conventional 'off' spelling: a zero (or
+            # negative) axis degrades to size 1 — the axis drops out of
+            # every spec — instead of a ZeroDivisionError in make_mesh
+            size = 1
+        axes.append(name)
+        sizes.append(size)
+    if not axes:
+        raise ValueError("MX_MESH_AXES is empty")
+    return tuple(axes), tuple(sizes)
+
+
+def mesh_from_env(devices=None) -> Optional[Mesh]:
+    """Mesh described by MX_MESH_AXES/MX_FSDP, or None when unset.
+
+    ``MX_FSDP=N`` alone (without MX_MESH_AXES) means ``data,fsdp=N``.
+    """
+    from ..base import get_env
+    axes_text = get_env("MX_MESH_AXES")
+    fsdp = get_env("MX_FSDP")
+    fsdp_n = None
+    if fsdp:
+        try:
+            fsdp_n = int(fsdp)
+        except ValueError:
+            fsdp_n = None
+    if not axes_text:
+        if not fsdp_n or fsdp_n <= 1:
+            return None
+        axes_text = "data,fsdp"
+    from .mesh import make_mesh
+    axes, sizes = parse_mesh_axes(axes_text, fsdp_n)
+    return make_mesh(axes=axes, shape=sizes, devices=devices)
+
+
+def layout_from_env(devices=None, rules=None) -> Optional[SpecLayout]:
+    """SpecLayout from the env knobs, or None when they are unset (the
+    replicated default).  The hook the compiled-step lane consults when
+    no explicit layout is passed."""
+    mesh = mesh_from_env(devices)
+    if mesh is None:
+        return None
+    return SpecLayout.infer(mesh, rules=rules)

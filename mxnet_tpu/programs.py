@@ -873,19 +873,50 @@ def _owned_ids() -> Dict[str, set]:
     return by_kind
 
 
+def _per_chip_nbytes(a, nbytes: int) -> int:
+    """Bytes ONE chip holds of array `a` (ISSUE 14): the shard extent
+    under the array's sharding — full bytes when replicated or
+    single-device, ``nbytes / prod(sharded axes)`` when sheet/tensor
+    sharded.  This is the number that must drop ~linearly with the fsdp
+    axis for params + optimizer state."""
+    try:
+        sh = getattr(a, "sharding", None)
+        if sh is None:
+            return nbytes
+        shape = tuple(a.shape)
+        shard_shape = sh.shard_shape(shape)
+        full = 1
+        part = 1
+        for d in shape:
+            full *= int(d)
+        for d in shard_shape:
+            part *= int(d)
+        if full <= 0:
+            return nbytes
+        return (nbytes * part) // full
+    except Exception:
+        return nbytes
+
+
 def buffer_census() -> Dict[str, Any]:
     """Bucket every live device array by owner.
 
     Walks ``jax.live_arrays()`` host-side (array handles + nbytes
     metadata — no device sync, no transfer) and attributes each to the
     first owner bucket claiming its id; unclaimed arrays land in
-    ``other`` (activations in flight, test droppings, leaks)."""
+    ``other`` (activations in flight, test droppings, leaks).  Each
+    bucket reports global ``bytes`` and sharding-aware
+    ``bytes_per_chip`` (the per-device footprint: a mesh-sharded param's
+    shard extent, the full value when replicated) — the acceptance
+    series for the FSDP lane."""
     by_kind = _owned_ids()
     order = [k for k in CENSUS_OWNERS if k in by_kind] + \
         [k for k in by_kind if k not in CENSUS_OWNERS]
-    out: Dict[str, Any] = {k: {"count": 0, "bytes": 0}
+    out: Dict[str, Any] = {k: {"count": 0, "bytes": 0,
+                               "bytes_per_chip": 0}
                            for k in order + ["other"]}
     total = 0
+    total_chip = 0
     n = 0
     try:
         live = jax.live_arrays()
@@ -898,6 +929,7 @@ def buffer_census() -> Dict[str, Any]:
             nbytes = int(a.nbytes)
         except Exception:
             continue
+        chip_bytes = _per_chip_nbytes(a, nbytes)
         aid = id(a)
         for kind in order:
             if aid in by_kind[kind]:
@@ -907,9 +939,12 @@ def buffer_census() -> Dict[str, Any]:
             slot = out["other"]
         slot["count"] += 1
         slot["bytes"] += nbytes
+        slot["bytes_per_chip"] += chip_bytes
         total += nbytes
+        total_chip += chip_bytes
         n += 1
     out["total_bytes"] = total
+    out["total_bytes_per_chip"] = total_chip
     out["n_arrays"] = n
     return out
 

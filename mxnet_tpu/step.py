@@ -106,6 +106,16 @@ def _as_nd(x, ctx):
     return x if isinstance(x, NDArray) else NDArray(jnp.asarray(x), ctx=ctx)
 
 
+def _placed(a, sharding):
+    """Place `a` onto `sharding` iff it is not already there (sharded
+    lane steady state: an equality check, no transfer)."""
+    if a is None or sharding is None:
+        return a
+    if getattr(a, "sharding", None) == sharding:
+        return a
+    return jax.device_put(a, sharding)
+
+
 class CompiledStep:
     """One Gluon training step as a single donated XLA program.
 
@@ -120,11 +130,23 @@ class CompiledStep:
     one ``lax.scan`` dispatch with gradient accumulation folded in.
     """
 
-    def __init__(self, net, loss_fn, trainer, metric=None):
+    def __init__(self, net, loss_fn, trainer, metric=None, layout=None):
         self._net = net
         self._loss_fn = loss_fn
         self._trainer = trainer
         self._metric = metric
+        # sharded lane (ISSUE 14): a parallel.SpecLayout turns this step
+        # into an SPMD program over the layout's mesh — parameters and
+        # optimizer state live sheet-sharded (fsdp) / tensor-split (tp),
+        # the batch splits over data×fsdp, gradients reduce-scatter onto
+        # the parameter shards and XLA all-gathers updated params just
+        # in time inside the SAME one donated jit.  None (+ unset env
+        # knobs) keeps the replicated behavior bit-identical.
+        if layout is None:
+            from .parallel.speclayout import layout_from_env
+            layout = layout_from_env()
+        self._layout = layout
+        self._shard_kv = None   # lazily built exchange store (sharded lane)
         self._cache: Dict = {}
         self._fallback_reason: Optional[str] = None
         self._warned = False
@@ -174,11 +196,18 @@ class CompiledStep:
         compression config, bucket capacity, grad_req flips, context
         set.  Cheap attribute reads only — checked every dispatch."""
         tr = self._trainer
-        kv = tr._kvstore
+        # sharded lane: materialize the lazily-created exchange store
+        # BEFORE keying on it, or the signature flips between step 1
+        # (id(None)) and step 2 (id(store)) and forces a full plan
+        # rebuild on the second dispatch
+        kv = tr._kvstore if self._layout is None else \
+            (tr._kvstore or self._ensure_shard_kv())
         gc = getattr(kv, "_gc", None) if kv is not None else None
         from .kvstore.bucketing import bucket_bytes
         opt = tr._optimizer
         return (id(kv), tr._update_on_kvstore, id(opt),
+                None if self._layout is None
+                else self._layout.signature(),
                 tuple(p._grad_req for p in tr._params),
                 tuple(id(c) for c in (tr._contexts or ())),
                 None if gc is None
@@ -231,6 +260,49 @@ class CompiledStep:
                 trainable_idx.append(i)
         ctxs = tr._contexts
         trainable = [tr._params[i] for i in trainable_idx]
+        layout = self._layout
+        shardings = frozen_shardings = compute_shardings = None
+        if layout is not None:
+            if len(ctxs) > 1:
+                return self._fall(
+                    "SpecLayout sharded lane is SPMD over the mesh — "
+                    "use ONE Trainer context (the mesh owns the devices)")
+            # per-parameter placement: rules > Block.sharding_spec hook >
+            # kind defaults > fsdp sheet (speclayout resolution order)
+            specs = layout.resolve(self._net)
+            by_id = {}
+            for name, p in self._net.collect_params().items():
+                by_id[id(p)] = specs.get(name)
+
+            def _spec_of(p):
+                sp = by_id.get(id(p))
+                if sp is None:
+                    sp = layout.param_spec(p.name, tuple(p.shape), p.dtype)
+                return sp
+
+            shardings = tuple(layout.sharding(_spec_of(p))
+                              for p in trainable)
+            compute_shardings = tuple(
+                layout.sharding(layout.compute_spec(_spec_of(p)))
+                for p in trainable)
+            # frozen/aux state (BatchNorm stats) mutates inside forward
+            # on every chip's shard of the batch: replicate it
+            frozen_shardings = tuple(layout.replicated()
+                                     for _ in frozen_params)
+            # adopt: the parameter (and dormant grad) buffers live
+            # SHARDED from here — the NDArray chunks stay the source of
+            # truth, but their jax value is the global mesh array, so
+            # per-chip HBM drops with the fsdp axis and steady-state
+            # gathers are no-ops
+            from .parallel.speclayout import place_value as _place
+            for p, s in list(zip(trainable, shardings)) + \
+                    list(zip(frozen_params, frozen_shardings)):
+                nd_ = p._data[ctxs[0]]
+                nd_._set_jax(_place(nd_._jax, s))
+                if p._grad:
+                    g_nd = p._grad.get(ctxs[0])
+                    if g_nd is not None:
+                        g_nd._set_jax(_place(g_nd._jax, s))
         exchange = None
         if kv is not None and len(ctxs) > 1:
             # the eager exchange set: every trainable param crosses the
@@ -240,6 +312,20 @@ class CompiledStep:
             if exchange is None:
                 return self._fall("kvstore %r exchange is not traceable "
                                   "(host-blocking transport)" % kv.type)
+        elif layout is not None:
+            # sharded quantized wire: the trainer's compression config
+            # rides a process-local exchange store (single-context
+            # trainers never build one of their own) whose body becomes
+            # the reduce-scatter/all-gather variant
+            kvx = self._ensure_shard_kv()
+            if kvx is not None:
+                exchange = kvx.build_exchange_body(
+                    trainable_idx, [p.data(ctxs[0]) for p in trainable],
+                    layout=layout)
+                if exchange is None:
+                    return self._fall(
+                        "kvstore %r exchange is not traceable under the "
+                        "sharded lane" % kvx.type)
         # optimizer slot state, created through the SAME updater store the
         # eager path uses (and every save_states/checkpoint reads)
         mp_flags = []
@@ -255,6 +341,38 @@ class CompiledStep:
         groups: Dict[bool, List[int]] = {}
         for pos, mp in enumerate(mp_flags):
             groups.setdefault(mp, []).append(pos)
+        state_shardings = w32_shardings = residual_shardings = None
+        if layout is not None:
+            # ZeRO: optimizer state lives on its parameter's shards from
+            # init — re-place the (just-created) slot NDArrays so the
+            # sharded layout IS the stored state, not a per-dispatch copy
+            from .parallel.speclayout import place_value as _place
+            upd0 = tr._updaters[0]
+            state_shardings, w32_shardings = [], []
+            for pos, i in enumerate(trainable_idx):
+                p = trainable[pos]
+                pspec = _spec_of(p)
+                inner, w32 = spec["unpack"](upd0.states[i], mp_flags[pos])
+                cols = []
+                for s_nd in inner:
+                    ssh = layout.sharding(
+                        layout.state_spec(pspec, tuple(s_nd.shape)))
+                    s_nd._set_jax(_place(s_nd._jax, ssh))
+                    cols.append(ssh)
+                state_shardings.append(tuple(cols))
+                if w32 is not None:
+                    wsh = layout.sharding(
+                        layout.state_spec(pspec, tuple(w32.shape)))
+                    w32._set_jax(_place(w32._jax, wsh))
+                    w32_shardings.append(wsh)
+                else:
+                    w32_shardings.append(None)
+            state_shardings = tuple(state_shardings)
+            w32_shardings = tuple(w32_shardings)
+            residual_shardings = tuple(
+                sh if sh is not None else layout.replicated()
+                for sh in (exchange.residual_shardings
+                           if exchange is not None else ()))
         plan = {
             "spec": spec,
             "trainable_idx": trainable_idx,
@@ -266,10 +384,41 @@ class CompiledStep:
             "mp_groups": sorted(groups.items()),
             "clip": -1.0 if opt.clip_gradient is None
                     else float(opt.clip_gradient),
+            # sharded lane (ISSUE 14): every donated state group's
+            # placement, resolved once per plan
+            "layout": layout,
+            "shardings": shardings,
+            "compute_shardings": compute_shardings,
+            "frozen_shardings": frozen_shardings,
+            "state_shardings": state_shardings,
+            "w32_shardings": w32_shardings,
+            "residual_shardings": residual_shardings,
+            "replicated": None if layout is None else layout.replicated(),
+            "gc": getattr(kv, "_gc", None) if layout is None
+                  else getattr(self._shard_kv or kv, "_gc", None),
         }
         self._plan_cached = plan
         self._plan_sig = sig
         return plan
+
+    def _ensure_shard_kv(self):
+        """The sharded lane's exchange store: the trainer's own kvstore
+        when it has one, else a lazily created process-local 'ici' store
+        carrying the trainer's compression config (a single-context
+        Trainer never builds a store of its own) — the error-feedback
+        residual state lives there exactly like the replicated lane's
+        store-resident residuals, so checkpoints and census attribution
+        see one consistent owner.  None when no compression is
+        configured (plain FSDP: constraint-only exchange)."""
+        tr = self._trainer
+        if tr._kvstore is not None:
+            return tr._kvstore
+        if self._shard_kv is None and tr._compression_params:
+            from .kvstore import create as _kv_create
+            kv = _kv_create("ici")
+            kv.set_gradient_compression(tr._compression_params)
+            self._shard_kv = kv
+        return self._shard_kv
 
     # -- trace builders ----------------------------------------------------
     def _make_forward(self, plan):
@@ -330,6 +479,8 @@ class CompiledStep:
         mp_groups = plan["mp_groups"]
         exchange = plan["exchange"]
         clip = plan["clip"]
+        shardings = plan.get("shardings")
+        compute_shardings = plan.get("compute_shardings")
         forward_backward = self._make_forward(plan)
 
         def _traced_step_window(t_vals, f_vals, opt_states, w32s,
@@ -383,12 +534,21 @@ class CompiledStep:
             def one_step(carry, inp):
                 t_vals, f_vals, opt_states, w32s, residuals, mstate = carry
                 lr_row, decay_row, rngs, x_row, y_row = inp
+                # FSDP just-in-time all-gather (ISSUE 14): parameters are
+                # STORED sheet-sharded over fsdp but COMPUTE whole (tp
+                # splits stay); constraining to the compute spec here
+                # makes XLA emit the gather right before the forward —
+                # and re-emit it inside every scan iteration, so a
+                # window never holds gathered copies across steps
+                t_use = t_vals if compute_shardings is None else tuple(
+                    lax.with_sharding_constraint(v, s)
+                    for v, s in zip(t_vals, compute_shardings))
 
                 def micro(mcarry, minp):
                     f_v, g_acc, mst = mcarry
                     key, x_mb, y_mb = minp
                     loss0, out0, grads, new_f = forward_backward(
-                        t_vals, f_v, key, x_mb, y_mb)
+                        t_use, f_v, key, x_mb, y_mb)
                     mst = accumulate_metric(mst, loss0, out0, y_mb)
                     g_acc = tuple(a + g for a, g in zip(g_acc, grads))
                     return (new_f, g_acc, mst), (loss0, out0)
@@ -407,6 +567,15 @@ class CompiledStep:
                     mcarry, (losses, outs) = lax.scan(
                         micro, init, (rngs, x_row, y_row))
                 f_vals, g_sum, mstate = mcarry
+                if shardings is not None:
+                    # the reduce-scatter point (ISSUE 14): the gradient
+                    # sum over the data×fsdp-sharded batch lands directly
+                    # on each parameter's shards — GSPMD fuses the cross-
+                    # chip sum and the scatter into one collective, and
+                    # the updated params all-gather just in time at the
+                    # next forward's use sites
+                    g_sum = tuple(lax.with_sharding_constraint(g, s)
+                                  for g, s in zip(g_sum, shardings))
                 if exchange is not None:
                     new_g, new_res = exchange(list(g_sum),
                                               list(residuals))
@@ -517,7 +686,7 @@ class CompiledStep:
             w32s.append(w32._jax if w32 is not None else None)
         residuals = ()
         if plan["exchange"] is not None:
-            gc = getattr(tr._kvstore, "_gc", None)
+            gc = plan["gc"]
             if plan["exchange"].residual_specs:
                 residuals = tuple(
                     gc.peek_residual(wk, shape, dtype)
@@ -532,8 +701,30 @@ class CompiledStep:
                           jnp.zeros((), jnp.int32))
             else:
                 mstate = (ds, self._metric._dev_inst)
-        return t_vals, f_vals, tuple(opt_states), tuple(w32s), \
-            residuals, mstate
+        opt_states = tuple(opt_states)
+        w32s = tuple(w32s)
+        if plan.get("layout") is not None:
+            # defensive re-placement: steady-state buffers are already
+            # mesh-resident (adopted at plan time, written back sharded),
+            # so these are == checks; only external mutation (set_data,
+            # checkpoint restore) between steps pays a device_put here
+            t_vals = tuple(_placed(v, s)
+                           for v, s in zip(t_vals, plan["shardings"]))
+            f_vals = tuple(_placed(v, s)
+                           for v, s in zip(f_vals,
+                                           plan["frozen_shardings"]))
+            opt_states = tuple(
+                tuple(_placed(c, cs) for c, cs in zip(cols, css))
+                for cols, css in zip(opt_states, plan["state_shardings"]))
+            w32s = tuple(_placed(w, s)
+                         for w, s in zip(w32s, plan["w32_shardings"]))
+            residuals = tuple(
+                _placed(r, s)
+                for r, s in zip(residuals, plan["residual_shardings"]))
+            if mstate is not None:
+                mstate = tuple(_placed(m, plan["replicated"])
+                               for m in mstate)
+        return t_vals, f_vals, opt_states, w32s, residuals, mstate
 
     def _write_back(self, plan, new_t, new_f, new_states, new_w32,
                     new_res, new_mstate):
@@ -558,7 +749,7 @@ class CompiledStep:
                 if w32 is not None and new_w32[pos] is not None:
                     w32._set_jax(place(new_w32[pos], ctx, d))
         if plan["exchange"] is not None and new_res:
-            gc = tr._kvstore._gc
+            gc = plan["gc"]
             for (wk, _shape, _dtype), val in zip(
                     plan["exchange"].residual_specs, new_res):
                 gc.put_residual(wk, val)
@@ -575,7 +766,9 @@ class CompiledStep:
             plan, n_steps, batch_size)
         metric_info = metric_trace_kernel(self._metric)
         return_outs = self._metric is not None and metric_info is None
+        layout = plan.get("layout")
         key = (n_steps, accum, rescale, wds, plan["clip"],
+               None if layout is None else layout.signature(),
                plan["spec"]["kind"],
                tuple(sorted(plan["spec"]["static"].items())),
                plan["mp_flags"],
@@ -608,6 +801,20 @@ class CompiledStep:
 
         state = tuple(jax.tree_util.tree_map(donatable, s) for s in state)
         rng = _ops_random.next_key()
+        if layout is not None:
+            # the batch crosses to the mesh sharded over data×fsdp (axis
+            # 0 of each micro-batch; axis 1 of stacked window leaves) —
+            # the ONE transfer the dispatch budget charges.  rng is a
+            # committed single-device jit output: replicate it onto the
+            # mesh or the dispatch mixes incompatible device sets.
+            bdim = 0 if n_steps * accum == 1 else 1
+            xs = tuple(jax.device_put(
+                x, layout.sharding(layout.batch_spec_for(x.shape, bdim)))
+                for x in xs)
+            ys = jax.device_put(
+                ys, layout.sharding(layout.batch_spec_for(ys.shape, bdim)))
+            rng = jax.device_put(rng, plan["replicated"])
+            transfers = max(transfers, 1)
         # distinct span names so scan windows and single compiled steps
         # aggregate separately in profiler.dumps() (the eager-only
         # blind spot this satellite closes)
@@ -853,3 +1060,130 @@ def _declare_step_contracts():
 
 
 _declare_step_contracts()
+
+
+# ---------------------------------------------------------------------------
+# Sharded-step contracts (ISSUE 14): the SpecLayout lane's donation/HBM
+# proofs over every supported mesh class.  Each class builds the SAME
+# canonical model as the replicated contract, lays it out through a
+# SpecLayout over a fake mesh (the verifier forces 8 CPU devices, like
+# tests/conftest), and lowers the EXACT `step.step` body the runtime
+# would dispatch — with the abstract argument tree carrying the REAL
+# NamedShardings, so the aliasing proof covers the sharded donation
+# (params, slots, masters all sheet-sharded) and the trace-closure
+# proves the {dp, dp×fsdp, dp×fsdp×tp} points land on declared
+# signatures instead of retracing at runtime.
+# ---------------------------------------------------------------------------
+
+# mesh classes the sharded lane contracts: label -> (axes, shape)
+_SHARD_MESH_CLASSES = (
+    ("dp", ("data",), (8,)),
+    ("dp_fsdp", ("data", "fsdp"), (4, 2)),
+    ("dp_fsdp_tp", ("data", "fsdp", "tp"), (2, 2, 2)),
+)
+
+
+def _abstract_sharded(tree):
+    """Like :func:`_abstract` but KEEPING each leaf's sharding — the
+    sharded cases must lower with the placements the runtime uses, or
+    the donation/temp proofs describe a program that never ships."""
+    return jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                       sharding=getattr(a, "sharding",
+                                                        None)),
+        tree)
+
+
+def _contract_sharded_step(axes, shape) -> "CompiledStep":
+    import mxnet_tpu as mx
+    from .gluon import Trainer
+    from .parallel.mesh import make_mesh
+    from .parallel.speclayout import SpecLayout
+    need = 1
+    for s in shape:
+        need *= int(s)
+    devs = jax.devices()
+    if len(devs) < need:
+        raise RuntimeError(
+            "sharded step contract needs %d devices (have %d); run under "
+            "the contracts CLI or tests/conftest, which force an 8-device "
+            "CPU mesh" % (need, len(devs)))
+    mesh = make_mesh(axes=axes, shape=shape, devices=devs[:need])
+    cs = _contract_step()
+    cs._layout = SpecLayout.infer(mesh)
+    return cs
+
+
+def _sharded_case(label, axes, shape):
+    from .programs import ContractCase
+    cs = _contract_sharded_step(axes, shape)
+    plan = cs._plan()
+    assert plan is not None, cs.fallback_reason
+    rescale, wds, _lr, _dec = cs._lr_rows(plan, 1, _CONTRACT_BATCH)
+    fn = cs._build_fn(plan, 1, 1, rescale, wds, decays_on=False,
+                      metric_info=None, return_outs=False)
+    args = _sharded_abstract_args(cs, plan)
+    return ContractCase("step.step", args, label=label, target=fn)
+
+
+def _sharded_abstract_args(cs, plan):
+    layout = plan["layout"]
+    state = _abstract_sharded(cs._gather_state(plan))
+    n_params = len(plan["trainable_idx"])
+    lr_rows = jax.ShapeDtypeStruct((1, n_params), jnp.float32)
+    key = _ops_random.next_key()
+    rng = jax.ShapeDtypeStruct(key.shape, key.dtype,
+                               sharding=plan["replicated"])
+    xs_shape = (_CONTRACT_BATCH, _CONTRACT_IN)
+    ys_shape = (_CONTRACT_BATCH,)
+    xs = (jax.ShapeDtypeStruct(
+        xs_shape, jnp.float32,
+        sharding=layout.sharding(layout.batch_spec_for(xs_shape, 0))),)
+    ys = jax.ShapeDtypeStruct(
+        ys_shape, jnp.float32,
+        sharding=layout.sharding(layout.batch_spec_for(ys_shape, 0)))
+    return state + (lr_rows, None, rng, xs, ys)
+
+
+@_functools.lru_cache(maxsize=1)
+def _sharded_contract_built():
+    from .programs import ContractClosure
+    cases = {}
+    for label, axes, shape in _SHARD_MESH_CLASSES:
+        cases[label] = _sharded_case(label, axes, shape)
+
+    def resolve(label):
+        # re-derive the dispatch signature from the runtime's own state
+        # construction for that mesh class — a drift between what the
+        # lane dispatches and what the cases compiled is a closure miss
+        for lbl, axes, shape in _SHARD_MESH_CLASSES:
+            if lbl == label:
+                cs = _contract_sharded_step(axes, shape)
+                plan = cs._plan()
+                return _sharded_abstract_args(cs, plan)
+        return None
+
+    closure = ContractClosure([lbl for lbl, _a, _s in
+                               _SHARD_MESH_CLASSES], resolve)
+    return list(cases.values()), closure
+
+
+def _declare_sharded_step_contracts():
+    from .programs import declare_contract
+    declare_contract(
+        "step.train_sharded",
+        lambda: _sharded_contract_built()[0],
+        donate_argnums=(0, 1, 2, 3, 4, 5),
+        # per-mesh-class ceiling: the sharded step's temp footprint must
+        # not exceed the replicated budget — reduce-scatter/all-gather
+        # staging is transient and bounded by the gathered param bytes
+        temp_budget_bytes=8 << 20,
+        closure=lambda: _sharded_contract_built()[1],
+        description="SpecLayout sharded step programs: the same six "
+                    "donated state groups as step.train, sheet-/tensor-"
+                    "sharded over the mesh; donation aliasing must "
+                    "survive sharding, and the {dp, dp×fsdp, "
+                    "dp×fsdp×tp} mesh classes are trace-closed")
+
+
+_declare_sharded_step_contracts()

@@ -16,6 +16,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 from PIL import Image
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -124,7 +125,13 @@ def _make_image_tree(dirpath, classes=3, per_class=4, edge=48):
                 os.path.join(d, "img%d.jpg" % i), quality=85)
 
 
+@pytest.mark.slow
 def test_prepare_data_layout_and_gates_run(tmp_path):
+    # slow lane: ~3 minutes of subprocess training gates — over 20% of
+    # the tier-1 870s wall budget for ONE meta-test, and it currently
+    # sits in the environmental-failure set on CPU boxes.  The data-drop
+    # activation contract still runs under the slow selection
+    # (`pytest -m slow tests/test_prepare_data.py`).
     # 1. scatter a synthetic "downloads" directory
     src = tmp_path / "downloads"
     _make_mnist(str(src / "somewhere" / "deep"))

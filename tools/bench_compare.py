@@ -226,6 +226,17 @@ def extract_record(report: dict) -> dict:
     if prefetch:
         rec["data_wait_share_pct"] = prefetch.get("data_wait_share_pct")
         rec["prefetch_enabled"] = bool(prefetch.get("enabled"))
+    # ISSUE 14: sharded-lane per-chip state bytes, keyed by mesh class
+    # (gating compares only within one mesh topology — a dp,fsdp=2 run
+    # must never become the bar a dp,fsdp=4 run is held to)
+    sharded = report.get("sharded") or {}
+    if sharded:
+        rec["params_bytes_per_chip"] = sharded.get("params_bytes_per_chip")
+        rec["optimizer_bytes_per_chip"] = \
+            sharded.get("optimizer_bytes_per_chip")
+        rec["mesh_class"] = sharded.get("mesh_class")
+        rec["sharded_within_ideal"] = bool(
+            sharded.get("within_15pct_of_ideal"))
     return rec
 
 
@@ -327,6 +338,48 @@ def gate(rec, history, throughput_tol, memory_tol):
                     "%.3f" % (comp, round(throughput_tol * 100),
                               "warm" if warm_class else "cold",
                               best_comp))
+    # ISSUE 14: per-chip sharded state bytes — mesh-class-keyed (like
+    # the warmth classes): gate against the best (smallest) per-chip
+    # footprint recorded for the SAME mesh topology, and fail outright
+    # when the lane reports the fsdp drop fell outside 15% of ideal
+    pbc = rec.get("params_bytes_per_chip")
+    if isinstance(pbc, (int, float)) and pbc > 0:
+        if rec.get("sharded_within_ideal") is False:
+            ok = False
+            findings.append(
+                "SHARDED-STATE REGRESSION: per-chip params+optimizer "
+                "bytes fell outside 15%% of the ideal 1/fsdp drop "
+                "(mesh class %s)" % rec.get("mesh_class"))
+        opt_b = rec.get("optimizer_bytes_per_chip") or 0
+        total = pbc + (opt_b if isinstance(opt_b, (int, float)) else 0)
+        pbc_peers = [
+            (r["params_bytes_per_chip"] +
+             (r.get("optimizer_bytes_per_chip") or 0))
+            for r in peers
+            if r.get("mesh_class") == rec.get("mesh_class")
+            and isinstance(r.get("params_bytes_per_chip"), (int, float))
+            and r["params_bytes_per_chip"] > 0]
+        if not pbc_peers:
+            findings.append(
+                "first sharded record for mesh class %r: seeding "
+                "params_bytes_per_chip trajectory" % rec.get("mesh_class"))
+        else:
+            best_pbc = min(pbc_peers)
+            ceil_p = best_pbc * (1.0 + memory_tol)
+            if total > ceil_p:
+                ok = False
+                findings.append(
+                    "SHARDED-STATE REGRESSION: per-chip params+optimizer "
+                    "bytes %d > %d (best %d + %d%% tolerance, mesh class "
+                    "%s)" % (total, int(ceil_p), int(best_pbc),
+                             round(memory_tol * 100),
+                             rec.get("mesh_class")))
+            else:
+                findings.append(
+                    "per-chip sharded state %d within %d%% of best %d "
+                    "(mesh class %s)"
+                    % (total, round(memory_tol * 100), int(best_pbc),
+                       rec.get("mesh_class")))
     # warm-spawn trajectory: the ready-to-traffic seconds themselves
     # (the speedup ratio already gates as this metric's value)
     wsp = rec.get("warm_spawn_seconds")

@@ -88,17 +88,31 @@ def run_exchange(n_keys=40):
     }
 
 
-def run_compiled(n_steps=4, hidden_layers=6, hidden=16):
+def run_compiled(n_steps=4, hidden_layers=6, hidden=16, mesh=None):
     """ISSUE 7 acceptance: the whole-step-compiled lane dispatches 1-2
     device programs per N-step scan window (the batch transfer + the
     window launch) — NOT N — and a single compiled step is one launch.
     engine.compiled_steps must attribute all N optimizer steps to that
-    one window, so dispatches-per-step is 2/N in steady state."""
+    one window, so dispatches-per-step is 2/N in steady state.
+
+    ``mesh`` (ISSUE 14, e.g. ``"data,fsdp"`` or ``"data,fsdp=2,tp=2"``)
+    runs the SAME budget through the SpecLayout-sharded step: the
+    sharded one-donated-jit must fit the identical ≤2 dispatches/step
+    envelope — proving FSDP adds no hidden host-side gathers."""
     import numpy as np
     import mxnet_tpu as mx
     from mxnet_tpu import gluon, nd
     from mxnet_tpu.engine import engine
     from mxnet_tpu.gluon import nn
+
+    layout = None
+    if mesh:
+        import jax
+        from mxnet_tpu.parallel import SpecLayout, make_mesh
+        from mxnet_tpu.parallel.speclayout import parse_mesh_axes
+        axes, sizes = parse_mesh_axes(mesh)
+        layout = SpecLayout.infer(
+            make_mesh(axes=axes, shape=sizes, devices=jax.devices()))
 
     mx.random.seed(0)
     np.random.seed(0)
@@ -113,7 +127,8 @@ def run_compiled(n_steps=4, hidden_layers=6, hidden=16):
                             {"learning_rate": 0.05, "momentum": 0.9})
     loss_fn = gluon.loss.L2Loss()
     metric = mx.metric.MSE()
-    step = trainer.make_compiled_step(net, loss_fn, metric=metric)
+    step = trainer.make_compiled_step(net, loss_fn, metric=metric,
+                                      layout=layout)
     rng = np.random.RandomState(0)
     Xw = rng.randn(n_steps, 16, 8).astype(np.float32)
     Yw = rng.randn(n_steps, 16, 4).astype(np.float32)
@@ -134,6 +149,7 @@ def run_compiled(n_steps=4, hidden_layers=6, hidden=16):
     single_d = engine.snapshot()["dispatches"] - c1
     return {
         "compiled": bool(step.compiled),
+        "mesh": mesh or None,
         "scan_steps": n_steps,
         "window_dispatches": window_d,
         "window_steps_accounted": window_steps,
@@ -274,7 +290,18 @@ def main():
     ap.add_argument("--scan", type=int, default=0,
                     help="scan window size for --compiled "
                          "(default: MX_STEP_SCAN, else 4)")
+    ap.add_argument("--mesh", default=None,
+                    help="with --compiled: ALSO run the SpecLayout-"
+                         "sharded step (ISSUE 14) over this mesh "
+                         "(e.g. 'data,fsdp' or 'data,fsdp=2,tp=2') and "
+                         "pin the same <=2 dispatches/step budget")
     args = ap.parse_args()
+    if args.mesh and "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        # a CPU box needs a fake multi-device mesh; set BEFORE jax init
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                                   " --xla_force_host_platform_device"
+                                   "_count=8").strip()
     if args.compress:
         os.environ["MX_GRAD_COMPRESS"] = args.compress
     if args.overlap:
@@ -289,6 +316,11 @@ def main():
         n_steps = args.scan or scan_window() or 4
         report["compiled"] = run_compiled(n_steps=max(1, n_steps))
         report["ok"] = bool(report["ok"] and report["compiled"]["ok"])
+        if args.mesh:
+            report["compiled_sharded"] = run_compiled(
+                n_steps=max(1, n_steps), mesh=args.mesh)
+            report["ok"] = bool(report["ok"] and
+                                report["compiled_sharded"]["ok"])
     if args.serve:
         report["serve"] = run_serve()
         report["ok"] = bool(report["ok"] and report["serve"]["ok"])

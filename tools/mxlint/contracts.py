@@ -68,13 +68,28 @@ DECLARING_MODULES = (
 )
 
 _ALIAS_RE = re.compile(r"tf\.aliasing_output")
+# sharded lowerings (inputs carrying NamedShardings, ISSUE 14) mark
+# donations as `jax.buffer_donor = true` instead: the in/out aliasing
+# decision is deferred to XLA (shardings may legally differ), so the
+# donor attribute is the strongest device-free witness that the
+# declared donation reached the executable — jax's not-usable warning
+# still fires at compile when a donor cannot be consumed
+_DONOR_RE = re.compile(r"jax\.buffer_donor\s*=\s*true")
 _DROP_WARNING = "donated buffers were not usable"
 
 
 def _ensure_device_free():
     """The proofs must not depend on (or grab) an accelerator: force the
-    CPU backend unless the operator explicitly chose a platform."""
+    CPU backend unless the operator explicitly chose a platform.  The
+    sharded step contracts (ISSUE 14) lower over {dp, dp×fsdp,
+    dp×fsdp×tp} meshes, so the CPU backend is faked out to 8 devices —
+    the same flag tests/conftest.py sets — when the operator has not
+    already pinned a device count."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = \
+            (flags + " --xla_force_host_platform_device_count=8").strip()
 
 
 def load_contracts(extra_modules: Tuple[str, ...] = ()):
@@ -173,7 +188,8 @@ def _verify_case(contract, case, root: str):
 
     drop_msgs = [str(w.message) for w in rec
                  if _DROP_WARNING in str(w.message)]
-    res.aliased = len(_ALIAS_RE.findall(txt))
+    res.aliased = len(_ALIAS_RE.findall(txt)) + \
+        len(_DONOR_RE.findall(txt))
     missing = max(0, res.donated_expected - res.aliased)
     if drop_msgs:
         # jax could not alias a LIVE donated buffer (shape/dtype matched
