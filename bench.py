@@ -799,6 +799,15 @@ def run_score_bench():
     }))
 
 
+def _pctile(sorted_vals, p):
+    """p-th percentile of an ascending list (truncation-indexed — the
+    convention both serve lanes share); 0.0 on empty."""
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[min(len(sorted_vals) - 1,
+                           int(p / 100.0 * len(sorted_vals)))]
+
+
 def run_serve_bench(rate=None, duration=None, senders=12):
     """--serve: open-loop load against a REAL local serving replica
     (ISSUE 9 acceptance lane).
@@ -927,10 +936,7 @@ def run_serve_bench(rate=None, duration=None, senders=12):
     lat_ms = sorted(l * 1e3 for l in latencies)
 
     def pct(p):
-        if not lat_ms:
-            return 0.0
-        return round(lat_ms[min(len(lat_ms) - 1,
-                                int(p / 100.0 * len(lat_ms)))], 3)
+        return round(_pctile(lat_ms, p), 3)
 
     n_ok = len(latencies)
     report = {
@@ -1087,6 +1093,136 @@ def run_serve_bench(rate=None, duration=None, senders=12):
         "within_gate": tp_overhead <= 5.0 and p99_overhead <= 5.0,
     }
     stop_ev.set()
+    print(json.dumps(report))
+
+
+def run_decode_bench(n_gens=None, rate=None):
+    """--serve --decode: the autoregressive decode lane (ISSUE 15
+    acceptance).
+
+    A mixed-length Poisson workload (70% short generations, 30% long
+    ones — the regime where request-level batching starves) drives the
+    continuous-batching decode engine in-process, then the IDENTICAL
+    workload replays against ``mode="request"`` (admit a batch, run it
+    to completion — the classic strawman).  The offered rate
+    deliberately exceeds single-replica capacity so a backlog forms:
+    throughput then measures the ENGINE's batching discipline, not the
+    arrival process (an underloaded engine drains any schedule at the
+    offered rate and the comparison degenerates to 1x).  Reports
+    tokens/sec,
+    per-token p50/p99 (first token = submit→harvest including queue +
+    prefill; then inter-token gaps), slot occupancy, the
+    continuous-vs-request speedup (acceptance >= 2x), zero serve-time
+    retraces and FLAT KV-pool bytes across the whole run (the pool is
+    donated through every step — any growth is a leak).
+    """
+    import numpy as np
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serve.decode import (DecodeBatcher, DecodeConfig,
+                                        DecodeServable)
+
+    n_gens = int(n_gens or os.environ.get("MX_BENCH_DECODE_GENS", 200))
+    rate = float(rate or os.environ.get("MX_BENCH_DECODE_RATE", 2500.0))
+    short_new, long_new, long_frac = 2, 48, 0.3
+
+    # the demo LM is sized so a decode step is DISPATCH-overhead-bound
+    # (per-step cost ~flat in the active count) — the regime a real TPU
+    # decode step lives in (weight-load-bandwidth-bound, equally
+    # batch-size-invariant), where tokens-per-step translates directly
+    # to throughput.  A compute-bound toy would under-credit continuous
+    # batching for an artifact of the CPU bench box.
+    cfg = DecodeConfig(dim=8, heads=1, layers=6, slots=8, max_tokens=48,
+                       prompt_buckets=(4, 8))
+    rng = np.random.RandomState(11)
+    prompts = [list(map(int, rng.randint(2, cfg.vocab,
+                                         size=rng.randint(2, 8))))
+               for _ in range(n_gens)]
+    max_news = [long_new if rng.rand() < long_frac else short_new
+                for _ in range(n_gens)]
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, n_gens))
+    reg = telemetry.registry
+
+    def pct(sorted_secs, p):
+        return round(_pctile(sorted_secs, p) * 1e3, 3)
+
+    def run_lane(mode):
+        sv = DecodeServable(config=cfg)
+        eng = DecodeBatcher(sv, queue_cap=n_gens + 64, mode=mode)
+        # untimed pre-burst: each lane measures its STEADY state, not
+        # the process's first-touch costs (XLA autotune, allocator
+        # warm, CPU boost ramp) — without this the lane that happens to
+        # run first eats them and the comparison drifts run to run
+        pre = [eng.submit([3, 4], max_new=12) for _ in range(24)]
+        for g in pre:
+            g.result(timeout=120)
+        kv0 = sv.kv_state_bytes()
+        retr0 = sv.retraces
+        steps0 = reg.value("serve.decode.steps")
+        gens = []
+        t0 = time.perf_counter()
+        # open-loop Poisson arrivals: the schedule never slows down for
+        # the engine, so queueing shows up as latency
+        for i in range(n_gens):
+            due = t0 + arrivals[i]
+            d = due - time.perf_counter()
+            if d > 0:
+                time.sleep(d)
+            gens.append(eng.submit(prompts[i], max_new=max_news[i]))
+        outs = [g.result(timeout=300) for g in gens]
+        wall = time.perf_counter() - t0
+        tokens = sum(len(o) for o in outs)
+        steps = reg.value("serve.decode.steps") - steps0
+        decode_tokens = tokens - n_gens     # first tokens = prefill
+        token_lats = sorted(t for g in gens for t in g.token_times[1:])
+        first_lats = sorted(g.token_times[0] for g in gens
+                            if g.token_times)
+        kv_flat = sv.kv_state_bytes() == kv0
+        lane = {
+            "mode": mode,
+            "generations": n_gens,
+            "tokens": tokens,
+            "wall_s": round(wall, 3),
+            "tokens_per_sec": round(tokens / wall, 2),
+            "decode_steps": steps,
+            "mean_occupancy": round(decode_tokens / steps, 2)
+            if steps else 0.0,
+            "token_latency_ms": {"p50": pct(token_lats, 50),
+                                 "p99": pct(token_lats, 99)},
+            "first_token_ms": {"p50": pct(first_lats, 50),
+                               "p99": pct(first_lats, 99)},
+            "retraces_after_warmup": sv.retraces - retr0,
+            "kv_pool_bytes": sv.kv_state_bytes(),
+            "kv_pool_flat": kv_flat,
+        }
+        eng.close()
+        return lane
+
+    cont = run_lane("continuous")
+    req = run_lane("request")
+    speedup = cont["tokens_per_sec"] / max(1e-9, req["tokens_per_sec"])
+    report = {
+        "metric": "serve_decode_tokens_per_sec",
+        "value": cont["tokens_per_sec"],
+        "unit": "tokens/sec",
+        "device": "cpu" if os.environ.get("MX_FORCE_CPU") else "default",
+        "decode": {
+            "offered_rate": rate,
+            "slots": cfg.slots,
+            "mix": {"short_tokens": short_new, "long_tokens": long_new,
+                    "long_fraction": long_frac},
+            "continuous": cont,
+            "request_level": req,
+            "continuous_speedup": round(speedup, 2),
+            "speedup_ok": bool(speedup >= 2.0),
+            "kv_pool_flat": bool(cont["kv_pool_flat"]),
+            "zero_serve_time_retraces": bool(
+                cont["retraces_after_warmup"] == 0
+                and req["retraces_after_warmup"] == 0),
+        },
+        "phases": {k: v for k, v in telemetry.phase_snapshot().items()
+                   if k in ("prefill", "decode_step", "kv_evict")},
+        "census": _census_report(),
+    }
     print(json.dumps(report))
 
 
@@ -1394,6 +1530,10 @@ def main():
         # bench box is the batching/latency behavior, not model FLOPs
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         os.environ.setdefault("MX_FORCE_CPU", "1")
+        if "--decode" in sys.argv:
+            # ISSUE 15: continuous-vs-request-level decode comparison
+            run_decode_bench()
+            return
         run_serve_bench()
         return
     if "--warm-spawn" in sys.argv:

@@ -108,6 +108,10 @@ ENV_CATALOG: Dict[str, Any] = {
     "MX_SERVE_TIMEOUT": ("30", "Seconds a serving client waits for one PREDICT reply (queue wait + dispatch included) before treating the replica as dead and failing over; also the server-side bound on a request waiting out its batch future."),
     "MX_TPU_PROBE_TIMEOUT": ("120", "Seconds the subprocess accelerator probe (base.probe_accelerator, the default budget when no explicit timeout is passed; tests/conftest.py's MX_TEST_CTX=tpu lane reads it the same way) waits for jax backend init before declaring the TPU tunnel wedged.  A timeout is definitive (hangs don't flake); the test suite shrinks it to prove the skip path without burning the full production budget.  Callers that pass an explicit timeout (tools/tpu_capture.py polling) are unaffected."),
     "MX_SERVE_REPLAY_CAP": ("512", "Serving replica: bound on the exactly-once replay cache (one entry per client id).  Entries are kept in LRU order - every new seq or replay hit from a client moves it to the recent end - and over-cap inserts evict the least-recently-touched RESOLVED entries (in-flight entries are never dropped); each eviction is counted in serve.replay_evicted.  Values < 1 clamp to 1 (the exactly-once contract needs at least the in-flight entry; 0 never means 'unbounded').  Serving clients are ephemeral uuids, so without this bound every dead client's last PREDICT response would be retained forever."),
+    "MX_SERVE_DECODE_SLOTS": ("8", "Decode engine (mxnet_tpu/serve/decode.py): number of concurrent generation slots in the device-resident KV-cache pool.  The pool is allocated once at deploy (owner 'kv_cache' in the buffer census) and donated through every decode step, so HBM stays flat; decode programs are AOT-bucketed by active-slot count (powers of two up to this), and the continuous-batching pump packs all active sequences into the smallest covering bucket each step - one device dispatch per decode step regardless of the active count."),
+    "MX_SERVE_DECODE_MAX_TOKENS": ("32", "Decode engine: cap on generated tokens per GENERATE request (a request's max_tokens clamps to this).  Together with the top prompt bucket it sizes each slot's KV page capacity."),
+    "MX_SERVE_DECODE_PAGE": ("16", "Decode engine: KV page size in token positions.  Each slot's cache extent (top prompt bucket + max tokens + the pipeline-overrun margin) rounds up to whole pages; retiring a sequence 'evicts' its pages by bookkeeping alone (lengths reset on slot reuse, stale entries masked) - the pool itself is never reallocated."),
+    "MX_SERVE_DECODE_PROMPT_BUCKETS": ("4,8,16", "Decode engine: comma-separated prompt-length buckets the prefill program table pre-compiles.  A GENERATE prompt pads up to the smallest covering bucket (one prefill dispatch per admitted sequence); prompts longer than the top bucket are rejected at admission, so serve time never pays a trace."),
     "MX_PROGRAM_CENSUS": ("1", "XLA program census (mxnet_tpu/programs.py): 1 (default) routes every jit-creation site through the process-wide program registry - per-program compile-time histograms (program_compile_seconds{program}), XLA memory_analysis/cost_analysis metadata (program_temp_bytes/program_flops, where the backend provides them), retrace counts with a structured retrace-explainer diff (which arg's shape/dtype/tree structure changed), and the jax.live_arrays() device-buffer census bucketed by owner (params/optimizer_state/ef_residuals/serve/other) riding flight-recorder records and crash dumps.  0 makes register_program a plain jax.jit and disables the census."),
     "MX_LEAK_WARN_BYTES": ("67108864", "Buffer-census leak detector threshold: when total live device bytes grow monotonically across consecutive census checks by more than this many bytes, the census_leak_bytes gauge latches the streak, census.leak_trips increments and a warning names the growing owner buckets.  Any shrink resets the streak; 0 disables the trip (gauges still publish)."),
     "MX_BENCH_HISTORY": ("", "Path of the bench-trajectory history file tools/bench_compare.py appends each bench.py run to and gates regressions against (>10% throughput or >15% peak-temp-bytes vs the rolling best per metric); empty uses BENCH_HISTORY.jsonl next to bench.py."),

@@ -25,7 +25,7 @@ from jax import lax
 
 from .registry import register
 
-__all__ = ["attention_core", "flash_attention"]
+__all__ = ["attention_core", "flash_attention", "cached_attention"]
 
 # kernel block sizes: 256x256 keeps the fp32 accumulators + two operand
 # tiles comfortably inside v5e VMEM; overridable via env so a healthy
@@ -508,6 +508,42 @@ def attention_core(q, k, v, scale=None, causal=False, mask=None):
         logits = jnp.where(mask.astype(bool), logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+# ---------------------------------------------------------------------------
+# Cached (decode-time) attention: one query token per sequence attending
+# over a fixed-capacity KV page buffer under a valid-length mask — the
+# autoregressive serving hot path (mxnet_tpu/serve/decode.py).  The page
+# buffer is the full pre-allocated slot extent, so the program shape
+# never depends on how far a generation has progressed: zero retraces
+# across a sequence's whole lifetime, and the pool arrays can be donated
+# through every decode step (HBM stays flat).
+# ---------------------------------------------------------------------------
+
+
+def cached_attention(q, k_pages, v_pages, cur_len, scale=None):
+    """Single-position attention over per-sequence KV cache pages.
+
+    ``q``: (B, H, D) — the current token's query per sequence;
+    ``k_pages``/``v_pages``: (B, P, H, D) — each sequence's KV page
+    buffer at its FULL capacity P (positions >= ``cur_len`` hold stale
+    or zero entries); ``cur_len``: (B,) int — how many leading positions
+    are valid (includes the current token's just-written entry).
+    Returns (B, H, D).
+
+    Masked positions get a finite -1e30 (never -inf): ``cur_len`` >= 1
+    by contract, so every row has at least one live key and the softmax
+    stays NaN-free even for scratch/padded lanes.
+    """
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    P = k_pages.shape[1]
+    logits = jnp.einsum("bhd,bphd->bhp", q, k_pages,
+                        preferred_element_type=jnp.float32) * scale
+    valid = jnp.arange(P)[None, None, :] < cur_len[:, None, None]
+    logits = jnp.where(valid, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhp,bphd->bhd", probs, v_pages)
 
 
 # ---------------------------------------------------------------------------

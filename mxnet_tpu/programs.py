@@ -836,8 +836,12 @@ def reset_contracts() -> None:
 
 # claim priority, most specific first: a Servable's version arrays are
 # the same buffers its source block's Parameters hold — the serving
-# owner wins so a deployed version's footprint is visible as such
-CENSUS_OWNERS = ("serve", "ef_residuals", "optimizer_state", "params")
+# owner wins so a deployed version's footprint is visible as such.
+# kv_cache (ISSUE 15) holds the decode engine's device-resident KV
+# pool + per-slot token/length state, donated across decode steps —
+# the bucket whose bytes must stay FLAT across generations.
+CENSUS_OWNERS = ("serve", "kv_cache", "ef_residuals", "optimizer_state",
+                 "params")
 
 _owners_lock = threading.Lock()
 # obj -> (kind, extractor(obj) -> iterable of arrays/NDArrays)

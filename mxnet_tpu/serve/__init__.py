@@ -46,6 +46,14 @@ over this repo's own substrates):
   from the batcher loop (health-gated restarts), and the chaos smoke
   (tools/chaos_smoke.sh) kills one of two replicas mid-load proving
   traffic drains to the survivor with zero lost requests.
+
+* **Autoregressive decode** (:mod:`.decode`, ISSUE 15) — the
+  sequence-generation workload behind the GENERATE verb: prefill and
+  decode as separately bucketed AOT programs, a device-resident
+  donated KV-cache pool (owner-tagged ``kv_cache`` in the buffer
+  census, flat HBM across generations), and CONTINUOUS batching — the
+  decode pump admits and retires sequences per decode step, not per
+  request, so long generations never block short ones.
 """
 from __future__ import annotations
 
@@ -53,6 +61,8 @@ from .servable import BucketTable, ModelHost, Servable
 from .batcher import Batcher, Overloaded
 from .server import ServeServer, serve_forever
 from .client import ServeClient
+from .decode import DecodeBatcher, DecodeConfig, DecodeServable
 
 __all__ = ["BucketTable", "Servable", "ModelHost", "Batcher",
-           "Overloaded", "ServeServer", "serve_forever", "ServeClient"]
+           "Overloaded", "ServeServer", "serve_forever", "ServeClient",
+           "DecodeBatcher", "DecodeConfig", "DecodeServable"]

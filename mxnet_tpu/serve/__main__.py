@@ -16,6 +16,7 @@ fail over to the survivors.
 Examples::
 
   python -m mxnet_tpu.serve --demo --port 9700
+  python -m mxnet_tpu.serve --decode --port 9700     # GENERATE lane
   python tools/launch.py -n 2 --restart on-failure -- \\
       python -m mxnet_tpu.serve --demo --port-base 9700
   python -m mxnet_tpu.serve --model /ckpt/resnet --epoch 3 \\
@@ -83,6 +84,11 @@ def main(argv=None) -> int:
                     help="serve the compile-heavy deterministic conv "
                          "demo (resnet18 @ 64x64) — the warm-spawn "
                          "bench lane's compile-bound replica")
+    ap.add_argument("--decode", action="store_true",
+                    help="also host the deterministic demo LM behind "
+                         "the GENERATE verb (continuous-batching "
+                         "decode engine; can serve alone or alongside "
+                         "--demo)")
     ap.add_argument("--port", type=int, default=None)
     ap.add_argument("--port-base", type=int, default=None,
                     help="bind port-base + MX_PROCESS_ID (multi-replica "
@@ -122,10 +128,22 @@ def main(argv=None) -> int:
 
         hb.beat(0, 0)
 
-    sv, example = _build_servable(args)
-    state = ServeServer(on_tick=tick)
+    decode_engine = None
     t_warm0 = time.perf_counter()
-    state.host.deploy(sv, example=example)
+    if args.decode:
+        # the GENERATE lane: demo LM + continuous-batching decode pump
+        # (ISSUE 15); warm() pre-builds every prefill/decode bucket so
+        # serve time pays zero traces
+        from .decode import DecodeBatcher, DecodeServable
+        decode_engine = DecodeBatcher(DecodeServable(), on_tick=tick)
+    state = ServeServer(on_tick=tick, decode=decode_engine)
+    sv = None
+    if args.demo or args.demo_conv or args.model:
+        sv, example = _build_servable(args)
+        state.host.deploy(sv, example=example)
+    elif not args.decode:
+        raise SystemExit("serve: need --model PREFIX, --demo or "
+                         "--decode")
     warm_s = time.perf_counter() - t_warm0
     # warm-start visibility (ISSUE 13): with MX_COMPILE_CACHE set, a
     # respawned replica deserializes its whole bucket table instead of
@@ -133,13 +151,24 @@ def main(argv=None) -> int:
     # scrape) carries the receipts
     from ..compile_cache import stats as _cc_stats
     cs = _cc_stats()
-    print("serve: %s v%d warm on %d bucket(s) %r in %.2fs "
-          "(compile-cache%s hits=%d misses=%d), port %d"
-          % (sv.name, sv.version, len(sv.buckets.sizes),
-             list(sv.buckets.sizes), warm_s,
-             "" if cs["enabled"] else " off",
-             cs["hits"], cs["misses"], port),
-          file=sys.stderr, flush=True)
+    if sv is not None:
+        print("serve: %s v%d warm on %d bucket(s) %r in %.2fs "
+              "(compile-cache%s hits=%d misses=%d), port %d"
+              % (sv.name, sv.version, len(sv.buckets.sizes),
+                 list(sv.buckets.sizes), warm_s,
+                 "" if cs["enabled"] else " off",
+                 cs["hits"], cs["misses"], port),
+              file=sys.stderr, flush=True)
+    if decode_engine is not None:
+        dsv = decode_engine.servable
+        print("serve: decode %s v%d warm on %d prompt + %d slot "
+              "bucket(s) in %.2fs (slots=%d, max_tokens=%d, "
+              "page=%d), port %d"
+              % (dsv.name, dsv.version, len(dsv.config.prompt_buckets),
+                 len(dsv.config.slot_buckets), warm_s,
+                 dsv.config.slots, dsv.config.max_tokens,
+                 dsv.config.page, port),
+              file=sys.stderr, flush=True)
 
     serve_forever(port=port, state=state, ready_file=args.ready_file)
     print("serve: stopped", file=sys.stderr, flush=True)

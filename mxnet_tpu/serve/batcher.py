@@ -46,11 +46,24 @@ from ..base import MXNetError, get_env
 from .. import fault as _fault
 from .. import telemetry as _telemetry
 
-__all__ = ["Overloaded", "Batcher"]
+__all__ = ["Overloaded", "Batcher", "result_timeout"]
 
 
 class Overloaded(MXNetError):
-    """Admission rejected: the bounded queue is full (load shedding)."""
+    """Admission rejected: the bounded queue is full (load shedding).
+
+    Shared by both admission paths — this micro-batcher's PREDICT queue
+    and the decode engine's generation queue (:mod:`.decode`, which
+    batches per decode STEP instead of per request)."""
+
+
+def result_timeout(timeout: Optional[float]) -> float:
+    """Resolve a caller's request-wait bound: explicit value, else
+    ``MX_SERVE_TIMEOUT`` — one rule for PREDICT futures and GENERATE
+    pendings, so the client/server timeout budget stays consistent."""
+    if timeout is not None:
+        return float(timeout)
+    return get_env("MX_SERVE_TIMEOUT", 30.0, float) or 30.0
 
 
 class _Batch:
@@ -110,8 +123,7 @@ class _Pending:
         """Block (bounded) for the dispatch, then scatter this request's
         rows out of the batch outputs: returns (version, [out_leaf...]).
         The device→host sync happens HERE, on the caller's thread."""
-        if timeout is None:
-            timeout = get_env("MX_SERVE_TIMEOUT", 30.0, float) or 30.0
+        timeout = result_timeout(timeout)
         if not self._event.wait(timeout=timeout):
             raise MXNetError("serve: request timed out after %.3gs in "
                              "the batcher" % timeout)

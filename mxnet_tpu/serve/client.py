@@ -95,10 +95,13 @@ class ServeClient:
         return s
 
     def _rpc(self, *msg, idx: Optional[int] = None,
-             failover: bool = True):
+             failover: bool = True, on_stream=None):
         """One SEQ-enveloped RPC.  ``idx=None`` uses the sticky replica
         and rotates on connection failures; an explicit ``idx`` pins one
-        replica (health probes) and never fails over."""
+        replica (health probes) and never fails over.  ``on_stream``
+        receives each ("STREAM", offset, tokens) frame a streaming
+        GENERATE emits ahead of its terminal reply (frames are
+        at-least-once across a failover — the offset dedupes)."""
         pinned = idx is not None
         policy = _fault.RetryPolicy.from_env()
         if msg[0] == "STOP":
@@ -131,8 +134,15 @@ class ServeClient:
                         _fault.fire(
                             "serve.client.recv",
                             on_close=lambda at=at: self._kill_sock(at))
-                        ok, payload = recv_msg(sock,
-                                               timeout=self._timeout)
+                        while True:
+                            resp = recv_msg(sock, timeout=self._timeout)
+                            if isinstance(resp, tuple) and resp and \
+                                    resp[0] == "STREAM":
+                                if on_stream is not None:
+                                    on_stream(resp[1], resp[2])
+                                continue      # chunk; terminal follows
+                            ok, payload = resp
+                            break
                     except (ConnectionError, OSError, TimeoutError) as e:
                         self._kill_sock(at)
                         policy.note(e)
@@ -169,6 +179,53 @@ class ServeClient:
                 tried += 1
                 if spill and tried < len(self._addrs):
                     with self._lock:      # shed here; try the next one
+                        self._idx = (self._idx + 1) % len(self._addrs)
+                    continue
+                raise Overloaded(resp)
+            raise MXNetError("serve: %s" % resp)
+
+    def generate(self, prompt: Sequence[int],
+                 max_tokens: Optional[int] = None,
+                 eos: Optional[int] = None, on_token=None,
+                 spill: bool = False) -> Tuple[int, List[int]]:
+        """One autoregressive generation: prompt token ids in,
+        ``(servable_version, [generated token, ...])`` out, through the
+        fleet's continuous-batching decode engine (ISSUE 15).
+
+        ``on_token(tokens)`` arms STREAMING: the server emits token
+        chunks as they are harvested and the callback receives each NEW
+        token list exactly once in order (chunks re-sent after a
+        failover are deduped by offset — the replayed generation is
+        deterministic, so offsets line up).  The returned terminal list
+        is always the complete sequence.  Raises :class:`Overloaded`
+        when the fleet sheds it, MXNetError on a terminal failure."""
+        opts = {"stream": on_token is not None}
+        if max_tokens is not None:
+            opts["max_tokens"] = int(max_tokens)
+        if eos is not None:
+            opts["eos"] = int(eos)
+        seen = [0]
+
+        def _dedupe(offset, tokens):
+            fresh = tokens[max(0, seen[0] - offset):]
+            if offset > seen[0]:       # gap (failover skew): drop, the
+                return                 # terminal reply has everything
+            if fresh:
+                seen[0] = offset + len(tokens)
+                on_token([int(t) for t in fresh])
+
+        tried = 0
+        while True:
+            ok, resp = self._rpc(
+                "GENERATE", [int(t) for t in prompt], opts,
+                on_stream=_dedupe if on_token is not None else None)
+            if ok:
+                version, tokens = resp
+                return int(version), [int(t) for t in tokens]
+            if isinstance(resp, str) and resp.startswith("overloaded"):
+                tried += 1
+                if spill and tried < len(self._addrs):
+                    with self._lock:
                         self._idx = (self._idx + 1) % len(self._addrs)
                     continue
                 raise Overloaded(resp)

@@ -1,0 +1,1045 @@
+"""Autoregressive decode serving: device-resident KV cache + continuous
+batching (ISSUE 15 tentpole).
+
+Until this module every served request was one fixed-shape forward; the
+sequence-generation traffic that dominates real serving (one prompt in,
+many tokens out) would have held its whole micro-batch hostage for the
+longest generation.  This is the decode engine that opens it, built on
+the same disciplines the rest of ``serve/`` runs on:
+
+* **Split prefill / decode, both AOT-bucketed.**  Prefill (process the
+  whole prompt, fill the KV pages, emit the first token) compiles one
+  program per PROMPT-LENGTH bucket (``MX_SERVE_DECODE_PROMPT_BUCKETS``);
+  decode (one token for every active sequence) compiles one program per
+  ACTIVE-SLOT-COUNT bucket (powers of two up to
+  ``MX_SERVE_DECODE_SLOTS``).  Both register through
+  ``programs.register_program`` so the compile cache, census and
+  zero-retrace accounting carry over unchanged — after
+  :meth:`DecodeServable.warm` serve time is pure cached-executable
+  dispatch.
+
+* **Device-resident KV pool, donated every step.**  K/V pages for every
+  slot live in two fixed arrays ``(layers, slots+1, max_len, heads,
+  head_dim)`` (+1 = the scratch slot padded decode lanes park on),
+  owner-tagged ``kv_cache`` in ``programs.buffer_census()`` and donated
+  through every prefill/decode dispatch — the pool is allocated once
+  and HBM stays flat across any number of generations.  Retiring a
+  sequence "evicts" its pages by bookkeeping alone: the slot's length
+  resets on reuse and stale entries beyond it are masked, never read.
+
+* **Continuous batching.**  The decode pump packs ALL active sequences
+  into the smallest covering slot bucket each step (ONE device dispatch
+  regardless of the active count), and at step boundaries retires
+  finished sequences and admits queued prefills into the freed slots —
+  a long generation never blocks a short one.  Sampled tokens stay
+  device-resident between steps (the program writes the next input
+  token into a donated pool-shaped array), so the pump never syncs the
+  host; a separate harvester thread reads each step's emitted tokens
+  asynchronously, stamps per-token latency and flags EOS/limit
+  completions for the next boundary.  ``mode="request"`` is the
+  request-level strawman (admit a batch, run it to completion) the
+  bench lane compares against.
+
+Slot state machine (one slot)::
+
+    FREE --admit/prefill--> ACTIVE --harvest flags done--> FINISHED
+      ^                                                       |
+      +------------- retire at step boundary (kv_evict) ------+
+
+Concurrency/lint contract: ``DecodeBatcher._tick`` / ``_admit`` /
+``_retire`` / ``_step`` / ``_dispatch_prefill`` and the
+``DecodeServable`` dispatch path are mxlint hot-path roots — no host
+sync may land between state dequeue and device dispatch (the
+tests/test_mxlint.py reinjection test proves a blocking host read there
+trips the rule).  The device→host token read lives ONLY in the
+harvester thread (``_harvest_once``).  Result/stream wait budgets ride
+``mxnet_tpu.fault.Deadline`` (virtual-time aware, like the
+micro-batcher's coalescing window); the pump's idle wait is a plain
+short condition poll.
+
+Telemetry: ``prefill`` / ``decode_step`` / ``kv_evict`` phases land in
+``step_phase_seconds``; ``serve.decode.token_seconds`` histograms
+per-token latency (first token = submit→harvest incl. queue + prefill,
+then inter-token gaps); counters ``serve.decode.requests`` / ``tokens``
+/ ``steps`` / ``prefills`` / ``sequences`` / ``rejected`` and the
+``serve.decode.occupancy`` active-slots histogram drive the bench lane
+and the fleet plane.
+"""
+from __future__ import annotations
+
+import functools
+import queue as _queue
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError, get_env
+from .. import fault as _fault
+from .. import telemetry as _telemetry
+from ..ops.attention import attention_core, cached_attention
+from .batcher import Overloaded, result_timeout as _result_timeout
+
+__all__ = ["DecodeConfig", "DecodeServable", "DecodeBatcher",
+           "demo_lm_params", "reference_generate"]
+
+# extra pool positions past prompt+generation capacity: the pump may
+# run a few steps ahead of the harvester (bounded by the harvest queue)
+# before a finished sequence is retired, and those overrun writes must
+# still land inside the slot's pages
+_OVERRUN_MARGIN = 8
+
+
+class DecodeConfig:
+    """Decode-engine geometry: model dims + pool/bucket layout.
+
+    Slot buckets are the powers of two up to ``slots`` (plus ``slots``
+    itself) — every active-set size packs into the smallest covering
+    bucket, so the decode program table is closed over 1..slots.
+    ``max_len`` is the per-slot page capacity: top prompt bucket +
+    ``max_tokens`` + the pipeline overrun margin, rounded up to whole
+    ``page``-sized pages.
+    """
+
+    def __init__(self, vocab: int = 48, dim: int = 32, heads: int = 4,
+                 layers: int = 2, slots: Optional[int] = None,
+                 max_tokens: Optional[int] = None,
+                 page: Optional[int] = None,
+                 prompt_buckets: Optional[Sequence[int]] = None,
+                 eos_id: Optional[int] = None, seed: int = 7):
+        self.vocab = int(vocab)
+        self.dim = int(dim)
+        self.heads = int(heads)
+        if self.dim % self.heads:
+            raise MXNetError("decode: dim %d must divide by heads %d"
+                             % (self.dim, self.heads))
+        self.head_dim = self.dim // self.heads
+        self.layers = int(layers)
+        self.slots = int(slots if slots is not None else
+                         get_env("MX_SERVE_DECODE_SLOTS", 8, int))
+        if self.slots < 1:
+            raise MXNetError("decode: need >= 1 slot")
+        self.max_tokens = int(max_tokens if max_tokens is not None else
+                              get_env("MX_SERVE_DECODE_MAX_TOKENS", 32,
+                                      int))
+        self.page = int(page if page is not None else
+                        get_env("MX_SERVE_DECODE_PAGE", 16, int))
+        if prompt_buckets is None:
+            raw = get_env("MX_SERVE_DECODE_PROMPT_BUCKETS") or "4,8,16"
+            prompt_buckets = [int(p) for p in str(raw).split(",")
+                              if p.strip()]
+        self.prompt_buckets: Tuple[int, ...] = \
+            tuple(sorted({int(b) for b in prompt_buckets}))
+        if not self.prompt_buckets or self.prompt_buckets[0] < 1:
+            raise MXNetError("decode: prompt buckets must be positive, "
+                             "got %r" % (prompt_buckets,))
+        sizes = set()
+        b = 1
+        while b < self.slots:
+            sizes.add(b)
+            b *= 2
+        sizes.add(self.slots)
+        self.slot_buckets: Tuple[int, ...] = tuple(sorted(sizes))
+        self.eos_id = None if eos_id is None else int(eos_id)
+        need = self.prompt_buckets[-1] + self.max_tokens + _OVERRUN_MARGIN
+        self.pages = -(-need // self.page)
+        self.max_len = self.pages * self.page
+        self.seed = int(seed)
+
+    def prompt_bucket_for(self, n: int) -> Optional[int]:
+        for b in self.prompt_buckets:
+            if b >= n:
+                return b
+        return None
+
+    def slot_bucket_for(self, n: int) -> int:
+        for b in self.slot_buckets:
+            if b >= n:
+                return b
+        return self.slot_buckets[-1]
+
+    def __repr__(self):
+        return ("DecodeConfig(vocab=%d, dim=%d, heads=%d, layers=%d, "
+                "slots=%d, max_tokens=%d, page=%d, max_len=%d)"
+                % (self.vocab, self.dim, self.heads, self.layers,
+                   self.slots, self.max_tokens, self.page, self.max_len))
+
+
+def demo_lm_params(config: Optional[DecodeConfig] = None
+                   ) -> Dict[str, jnp.ndarray]:
+    """Seeded deterministic demo LM parameters (the decode analogue of
+    ``serve.demo.demo_block``): both sides of a chaos run build these
+    independently, so generated-token *correctness* is assertable
+    across processes.  The unembedding is scaled up so greedy-argmax
+    margins are decisive — bucket packing must not flip a token on a
+    float whisker."""
+    cfg = config or DecodeConfig()
+    rs = _np.random.RandomState(cfg.seed)
+    d = cfg.dim
+
+    def mat(rows, cols, scale):
+        return jnp.asarray(rs.randn(rows, cols).astype(_np.float32)
+                           * scale)
+
+    params: Dict[str, jnp.ndarray] = {
+        "emb": mat(cfg.vocab, d, 1.0),
+        "unemb": mat(d, cfg.vocab, 4.0 / (d ** 0.5)),
+    }
+    for l in range(cfg.layers):
+        for name in ("wq", "wk", "wv", "wo"):
+            params["l%d.%s" % (l, name)] = mat(d, d, 1.0 / (d ** 0.5))
+        params["l%d.w1" % l] = mat(d, 2 * d, 1.0 / (d ** 0.5))
+        params["l%d.w2" % l] = mat(2 * d, d, 1.0 / ((2 * d) ** 0.5))
+    return params
+
+
+# ---------------------------------------------------------------------------
+# traced program bodies (pure; jit-purity applies via register_program)
+# ---------------------------------------------------------------------------
+
+
+def _block_mlp(params, l, x):
+    h = jnp.maximum(x @ params["l%d.w1" % l], 0.0)
+    return x + h @ params["l%d.w2" % l]
+
+
+def _decode_body(cfg: DecodeConfig, params, k_pool, v_pool, tokens,
+                 lengths, slot_ids):
+    """One decode step over the packed active set.
+
+    ``k_pool``/``v_pool``: (L, S+1, P, H, Dh) donated; ``tokens`` /
+    ``lengths``: (S+1,) int32 donated (tokens = each slot's NEXT input
+    token, device-resident so the pump never reads the host between
+    steps); ``slot_ids``: (b,) int32, padded lanes carry the scratch
+    index S.  Returns the four state arrays (aliased in place via
+    donation) plus the (b,) sampled tokens for the harvester.
+    """
+    tok = tokens[slot_ids]                              # (b,)
+    lens = lengths[slot_ids]                            # (b,)
+    x = params["emb"][tok]                              # (b, D)
+    b = x.shape[0]
+    pos = lens                     # this token's KV write position
+    for l in range(cfg.layers):
+        k_new = (x @ params["l%d.wk" % l]).reshape(
+            b, cfg.heads, cfg.head_dim)
+        v_new = (x @ params["l%d.wv" % l]).reshape(
+            b, cfg.heads, cfg.head_dim)
+        k_pool = k_pool.at[l, slot_ids, pos].set(k_new)
+        v_pool = v_pool.at[l, slot_ids, pos].set(v_new)
+        q = (x @ params["l%d.wq" % l]).reshape(b, cfg.heads,
+                                               cfg.head_dim)
+        att = cached_attention(q, k_pool[l, slot_ids],
+                               v_pool[l, slot_ids], lens + 1)
+        x = x + att.reshape(b, cfg.dim) @ params["l%d.wo" % l]
+        x = _block_mlp(params, l, x)
+    logits = x @ params["unemb"]                        # (b, V)
+    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    tokens = tokens.at[slot_ids].set(nxt)
+    lengths = lengths.at[slot_ids].set(lens + 1)
+    # park the scratch slot: padded lanes read/write it every step, so
+    # its bookkeeping must reset or its fake length would creep past
+    # the pool extent
+    tokens = tokens.at[cfg.slots].set(0)
+    lengths = lengths.at[cfg.slots].set(0)
+    return k_pool, v_pool, tokens, lengths, nxt
+
+
+def _prefill_body(cfg: DecodeConfig, params, k_pool, v_pool, tokens,
+                  lengths, slot_id, prompt, n):
+    """Process one padded prompt into slot ``slot_id``: causal attention
+    over the prompt (keys masked to the true length ``n``), KV pages
+    written for every position, first generated token sampled from the
+    last REAL position.  Rows past ``n`` compute garbage that is never
+    attended (decode masks by length) and is overwritten as the
+    generation advances."""
+    Lp = prompt.shape[0]
+    x = params["emb"][prompt]                           # (Lp, D)
+    valid = jnp.arange(Lp) < n
+    for l in range(cfg.layers):
+        k = (x @ params["l%d.wk" % l]).reshape(Lp, cfg.heads,
+                                               cfg.head_dim)
+        v = (x @ params["l%d.wv" % l]).reshape(Lp, cfg.heads,
+                                               cfg.head_dim)
+        k_pool = lax.dynamic_update_slice(
+            k_pool, k[None, None], (l, slot_id, 0, 0, 0))
+        v_pool = lax.dynamic_update_slice(
+            v_pool, v[None, None], (l, slot_id, 0, 0, 0))
+        q = (x @ params["l%d.wq" % l]).reshape(Lp, cfg.heads,
+                                               cfg.head_dim)
+        q4 = q.transpose(1, 0, 2)[None]                 # (1, H, Lp, Dh)
+        k4 = k.transpose(1, 0, 2)[None]
+        v4 = v.transpose(1, 0, 2)[None]
+        att = attention_core(q4, k4, v4, causal=True,
+                             mask=valid[None, None, None, :])
+        x = x + att[0].transpose(1, 0, 2).reshape(Lp, cfg.dim) \
+            @ params["l%d.wo" % l]
+        x = _block_mlp(params, l, x)
+    x_last = jnp.take(x, jnp.maximum(n - 1, 0), axis=0)
+    logits = x_last @ params["unemb"]
+    t0 = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    tokens = tokens.at[slot_id].set(t0)
+    lengths = lengths.at[slot_id].set(n)
+    return k_pool, v_pool, tokens, lengths, t0
+
+
+# geometry-keyed jit cache for the reference oracle: a load driver
+# replays MANY reference decodes against one model — per-token eager
+# dispatch would dominate its wall time.  Plain jax.jit, deliberately
+# NOT register_program: the oracle is a verification tool, not a
+# serving path, and must not pollute the serve census.
+_reference_jits: Dict[Tuple, Tuple] = {}
+_reference_jits_lock = threading.Lock()
+
+
+def _reference_step_fns(cfg: DecodeConfig):
+    key = (cfg.vocab, cfg.dim, cfg.heads, cfg.layers, cfg.slots,
+           cfg.max_len)
+    with _reference_jits_lock:
+        fns = _reference_jits.get(key)
+        if fns is None:
+            fns = (jax.jit(functools.partial(_prefill_body, cfg)),
+                   jax.jit(functools.partial(_decode_body, cfg)))
+            _reference_jits[key] = fns
+        return fns
+
+
+def reference_generate(prompt: Sequence[int], max_new: int,
+                       params: Optional[Dict] = None,
+                       config: Optional[DecodeConfig] = None,
+                       eos_id: Optional[int] = None) -> List[int]:
+    """Local greedy-decode oracle: drives the SAME prefill/decode
+    bodies through a private single-slot state (no pool sharing), so a
+    load driver can recompute what a correct replica must answer — the
+    decode analogue of ``demo.demo_expected``."""
+    cfg = config or DecodeConfig()
+    params = params if params is not None else demo_lm_params(cfg)
+    lp = cfg.prompt_bucket_for(len(prompt))
+    if lp is None:
+        raise MXNetError("reference_generate: prompt of %d tokens "
+                         "exceeds the top prompt bucket %d"
+                         % (len(prompt), cfg.prompt_buckets[-1]))
+    prefill_fn, decode_fn = _reference_step_fns(cfg)
+    shape = (cfg.layers, cfg.slots + 1, cfg.max_len, cfg.heads,
+             cfg.head_dim)
+    k = jnp.zeros(shape, jnp.float32)
+    v = jnp.zeros(shape, jnp.float32)
+    tok = jnp.zeros((cfg.slots + 1,), jnp.int32)
+    ln = jnp.zeros((cfg.slots + 1,), jnp.int32)
+    padded = _np.zeros(lp, _np.int32)
+    padded[:len(prompt)] = list(prompt)
+    k, v, tok, ln, t0 = prefill_fn(params, k, v, tok, ln,
+                                   _np.int32(0), jnp.asarray(padded),
+                                   _np.int32(len(prompt)))
+    out = [int(t0)]
+    ids = jnp.zeros((1,), jnp.int32)
+    while len(out) < max_new:
+        if eos_id is not None and out[-1] == eos_id:
+            break
+        k, v, tok, ln, nxt = decode_fn(params, k, v, tok, ln, ids)
+        out.append(int(nxt[0]))
+    return out[:max_new]
+
+
+class _CensusHandle:
+    """Weakref-able holder so one servable can own two census buckets
+    (its KV pool under ``kv_cache``, its parameters under ``serve``)."""
+
+    __slots__ = ("fn", "__weakref__")
+
+    def __init__(self, fn):
+        self.fn = fn
+
+
+def _counter(name, doc):
+    return _telemetry.registry.counter(name, doc=doc)
+
+
+class DecodeServable:
+    """One immutable decode-model version: params + device-resident KV
+    pool + the two bucketed AOT program tables (prefill by prompt
+    bucket, decode by slot bucket).
+
+    The KV state (pool pages, per-slot next-token and length arrays) is
+    DONATED through every dispatch: ``_state`` always holds the only
+    live copy, rebound from the program outputs, so pool bytes in
+    ``buffer_census()['kv_cache']`` are constant for the servable's
+    lifetime.  Only the pump thread may dispatch (single-writer state).
+    """
+
+    def __init__(self, params: Optional[Dict] = None,
+                 config: Optional[DecodeConfig] = None,
+                 name: str = "demo-lm", version: int = 1):
+        self.config = config or DecodeConfig()
+        self.params = params if params is not None \
+            else demo_lm_params(self.config)
+        self.name = str(name)
+        self.version = int(version)
+        cfg = self.config
+        shape = (cfg.layers, cfg.slots + 1, cfg.max_len, cfg.heads,
+                 cfg.head_dim)
+        self._state: Dict[str, jnp.ndarray] = {
+            "k": jnp.zeros(shape, jnp.float32),
+            "v": jnp.zeros(shape, jnp.float32),
+            "tok": jnp.zeros((cfg.slots + 1,), jnp.int32),
+            "len": jnp.zeros((cfg.slots + 1,), jnp.int32),
+        }
+        from .. import programs as _programs
+        self._kv_handle = _CensusHandle(
+            lambda: list(self._state.values()))
+        self._params_handle = _CensusHandle(
+            lambda: list(self.params.values()))
+        _programs.track_buffers("kv_cache", self._kv_handle,
+                                lambda h: h.fn())
+        _programs.track_buffers("serve", self._params_handle,
+                                lambda h: h.fn())
+        self._lock = threading.Lock()
+        self._step_programs: Dict[int, object] = {}
+        self._prefill_programs: Dict[int, object] = {}
+        self.retraces = 0            # program builds (warm pays them)
+        self.hits = 0                # dispatches answered by the table
+        self.warmed = False
+        self._c_retrace = _counter(
+            "serve.retraces", "serve-side program builds (should be 0 "
+            "after warmup; warm() pays them at deploy)")
+        self._c_hits = _counter(
+            "serve.bucket_hits", "dispatches answered by a pre-built "
+            "bucket program")
+
+    # -- program tables -----------------------------------------------------
+    def step_program(self, bucket: int):
+        """The decode program for one slot bucket (builds on miss,
+        counted as a retrace — warm() pre-builds every bucket)."""
+        bucket = int(bucket)
+        with self._lock:
+            prog = self._step_programs.get(bucket)
+            if prog is not None:
+                self.hits += 1
+        if prog is not None:
+            self._c_hits.inc()
+            return prog
+        cfg = self.config
+
+        def run_decode(params, k_pool, v_pool, tokens, lengths,
+                       slot_ids):
+            return _decode_body(cfg, params, k_pool, v_pool, tokens,
+                                lengths, slot_ids)
+
+        from .. import programs as _programs
+        with _telemetry.phase("retrace"):
+            prog = _programs.register_program(
+                "serve.decode.step.s%d" % bucket, run_decode,
+                donate_argnums=(1, 2, 3, 4))
+        with self._lock:
+            prog = self._step_programs.setdefault(bucket, prog)
+            self.retraces += 1
+        self._c_retrace.inc()
+        return prog
+
+    def prefill_program(self, prompt_bucket: int):
+        prompt_bucket = int(prompt_bucket)
+        with self._lock:
+            prog = self._prefill_programs.get(prompt_bucket)
+            if prog is not None:
+                self.hits += 1
+        if prog is not None:
+            self._c_hits.inc()
+            return prog
+        cfg = self.config
+
+        def run_prefill(params, k_pool, v_pool, tokens, lengths,
+                        slot_id, prompt, n):
+            return _prefill_body(cfg, params, k_pool, v_pool, tokens,
+                                 lengths, slot_id, prompt, n)
+
+        from .. import programs as _programs
+        with _telemetry.phase("retrace"):
+            prog = _programs.register_program(
+                "serve.decode.prefill.p%d" % prompt_bucket, run_prefill,
+                donate_argnums=(1, 2, 3, 4))
+        with self._lock:
+            prog = self._prefill_programs.setdefault(prompt_bucket,
+                                                     prog)
+            self.retraces += 1
+        self._c_retrace.inc()
+        return prog
+
+    # -- dispatch (pump thread only; mxlint hot-path roots) -----------------
+    def dispatch_step(self, slot_ids: _np.ndarray):
+        """ONE device program over the packed active set; rebinds the
+        donated state and returns the (b,) emitted-token device array
+        (async — the harvester syncs it)."""
+        from ..engine import engine as _engine
+        prog = self.step_program(len(slot_ids))
+        st = self._state
+        k, v, tok, ln, out = prog(self.params, st["k"], st["v"],
+                                  st["tok"], st["len"], slot_ids)
+        self._state = {"k": k, "v": v, "tok": tok, "len": ln}
+        _engine.count_dispatch(1)
+        return out
+
+    def dispatch_prefill(self, slot: int, prompt: _np.ndarray, n: int):
+        """ONE device program filling ``slot``'s KV pages from a padded
+        prompt; returns the first generated token as a () device
+        array."""
+        from ..engine import engine as _engine
+        prog = self.prefill_program(prompt.shape[0])
+        st = self._state
+        k, v, tok, ln, t0 = prog(self.params, st["k"], st["v"],
+                                 st["tok"], st["len"],
+                                 _np.int32(slot), prompt, _np.int32(n))
+        self._state = {"k": k, "v": v, "tok": tok, "len": ln}
+        _engine.count_dispatch(1)
+        return t0
+
+    def warm(self) -> "DecodeServable":
+        """Pre-build + pre-run EVERY prefill and decode bucket (against
+        the scratch slot), then reset the generation bookkeeping —
+        after this, serve time never pays a trace."""
+        cfg = self.config
+        for lp in cfg.prompt_buckets:
+            self.dispatch_prefill(cfg.slots,
+                                  _np.zeros(lp, _np.int32), lp)
+        for b in cfg.slot_buckets:
+            self.dispatch_step(_np.full(b, cfg.slots, _np.int32))
+        jax.block_until_ready(self._state["k"])
+        # scratch-slot bookkeeping back to empty; the pool's warmed
+        # garbage is masked by zero lengths and overwritten on reuse
+        self._state["tok"] = jnp.zeros_like(self._state["tok"])
+        self._state["len"] = jnp.zeros_like(self._state["len"])
+        self.warmed = True
+        return self
+
+    def kv_state_bytes(self) -> int:
+        """Current KV-state footprint (pool pages + token/length
+        arrays) — the number that must stay FLAT across generations."""
+        return sum(int(a.nbytes) for a in self._state.values())
+
+
+class _PendingGen:
+    """One admitted generation request: prompt in, tokens accumulating
+    out.  The pump owns its slot; the HARVESTER appends tokens, stamps
+    per-token latency and flags completion; handler threads block in
+    :meth:`result` / stream via :meth:`wait_new`."""
+
+    __slots__ = ("prompt", "max_new", "eos_id", "trace_ctx", "submit_t",
+                 "slot", "token_times", "_cv", "_tokens", "_done",
+                 "_err", "_last_t")
+
+    def __init__(self, prompt: List[int], max_new: int,
+                 eos_id: Optional[int],
+                 trace_ctx: Optional[Tuple[str, str]] = None):
+        self.prompt = prompt
+        self.max_new = int(max_new)
+        self.eos_id = eos_id
+        self.trace_ctx = trace_ctx
+        self.submit_t = time.perf_counter()
+        self.slot: Optional[int] = None
+        self.token_times: List[float] = []   # per-token latency (s)
+        self._cv = threading.Condition()
+        self._tokens: List[int] = []
+        self._done = False
+        self._err: Optional[BaseException] = None
+        self._last_t: Optional[float] = None
+
+    # -- harvester side -----------------------------------------------------
+    def _append(self, tok: int, now: float) -> Tuple[bool, bool]:
+        """Record one harvested token; returns (appended, finished).
+        Tokens arriving after completion (pipeline overrun) are
+        dropped."""
+        with self._cv:
+            if self._done:
+                return False, True
+            base = self._last_t if self._last_t is not None \
+                else self.submit_t
+            self.token_times.append(now - base)
+            self._last_t = now
+            self._tokens.append(int(tok))
+            finished = len(self._tokens) >= self.max_new or (
+                self.eos_id is not None and int(tok) == self.eos_id)
+            if finished:
+                self._done = True
+            self._cv.notify_all()
+            return True, finished
+
+    def _fail(self, err: BaseException) -> None:
+        with self._cv:
+            if not self._done:
+                self._err = err
+                self._done = True
+            self._cv.notify_all()
+
+    # -- consumer side ------------------------------------------------------
+    def done(self) -> bool:
+        with self._cv:
+            return self._done
+
+    def tokens_so_far(self) -> List[int]:
+        with self._cv:
+            return list(self._tokens)
+
+    def wait_new(self, have: int, timeout: float
+                 ) -> Tuple[List[int], bool]:
+        """Block until more than ``have`` tokens exist (or the
+        generation completes / the wait times out); returns (the tokens
+        past ``have``, done)."""
+        deadline = _fault.Deadline(timeout)
+        with self._cv:
+            while len(self._tokens) <= have and not self._done:
+                remaining = deadline.remaining()
+                if remaining <= 0:
+                    break
+                self._cv.wait(timeout=min(0.05, remaining))
+            return list(self._tokens[have:]), self._done
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block (bounded) for the whole generation; raises on engine
+        failure or timeout."""
+        timeout = _result_timeout(timeout)
+        deadline = _fault.Deadline(timeout)
+        with self._cv:
+            while not self._done:
+                remaining = deadline.remaining()
+                if remaining <= 0:
+                    raise MXNetError(
+                        "serve: generation timed out after %.3gs "
+                        "(%d/%d tokens)" % (timeout, len(self._tokens),
+                                            self.max_new))
+                self._cv.wait(timeout=min(0.1, remaining))
+            if self._err is not None:
+                raise self._err
+            return list(self._tokens)
+
+
+class DecodeBatcher:
+    """The continuous-batching decode engine: admission queue + slot
+    allocator + decode pump (pure dispatch) + token harvester (the only
+    device→host reader)."""
+
+    def __init__(self, servable: DecodeServable,
+                 queue_cap: Optional[int] = None,
+                 mode: str = "continuous", on_tick=None,
+                 autostart: bool = True):
+        if mode not in ("continuous", "request"):
+            raise MXNetError("DecodeBatcher mode must be 'continuous' "
+                             "or 'request', got %r" % (mode,))
+        self._sv = servable
+        if not servable.warmed:
+            servable.warm()
+        self._cap = int(queue_cap if queue_cap is not None else
+                        get_env("MX_SERVE_QUEUE_CAP", 256, int))
+        self._mode = mode
+        self._on_tick = on_tick
+        self._q: deque = deque()
+        self._cv = threading.Condition()
+        self._slot_lk = threading.Lock()
+        self._slots: List[Optional[_PendingGen]] = \
+            [None] * servable.config.slots
+        # bounded pump->harvester handoff: one step boundary emits at
+        # most `slots` prefill items + 1 step item, so this bound can
+        # never wedge a synchronous (autostart=False) driver, while in
+        # threaded mode it caps how far the pump runs ahead of the
+        # host-side token reads
+        self._harvest_q: _queue.Queue = _queue.Queue(
+            maxsize=servable.config.slots + 4)
+        self._stop = threading.Event()
+        reg = _telemetry.registry
+        self._c_requests = reg.counter(
+            "serve.decode.requests", doc="admitted generation requests")
+        self._c_rejected = reg.counter(
+            "serve.decode.rejected", doc="generation requests shed at "
+            "admission (queue cap) or refused (prompt too long)")
+        self._c_tokens = reg.counter(
+            "serve.decode.tokens", doc="generated tokens harvested")
+        self._c_steps = reg.counter(
+            "serve.decode.steps", doc="decode-step device dispatches "
+            "(exactly 1 per step regardless of the active count)")
+        self._c_prefills = reg.counter(
+            "serve.decode.prefills", doc="prefill device dispatches "
+            "(one per admitted sequence)")
+        self._c_seqs = reg.counter(
+            "serve.decode.sequences", doc="generations retired complete")
+        self._g_queue = reg.gauge(
+            "serve.decode.queue", doc="generation requests queued")
+        self._g_active = reg.gauge(
+            "serve.decode.active_slots", doc="sequences in decode slots")
+        self._h_occ = reg.histogram(
+            "serve.decode.occupancy", doc="active sequences per decode "
+            "step", buckets=(1, 2, 4, 8, 16, 32, 64))
+        self._h_token = reg.histogram(
+            "serve.decode.token_seconds", doc="per-token latency: first "
+            "token = submit->harvest (queue + prefill included), then "
+            "inter-token gaps",
+            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                     0.1, 0.25, 0.5, 1.0, 2.5))
+        self._pump = threading.Thread(
+            target=self._loop, daemon=True, name="mx-serve-decode-pump")
+        self._harvester = threading.Thread(
+            target=self._harvest_loop, daemon=True,
+            name="mx-serve-decode-harvest")
+        if autostart:
+            self._pump.start()
+            self._harvester.start()
+
+    @property
+    def servable(self) -> DecodeServable:
+        return self._sv
+
+    @property
+    def version(self) -> int:
+        return self._sv.version
+
+    @property
+    def mode(self) -> str:
+        return self._mode
+
+    # -- admission ----------------------------------------------------------
+    def queue_depth(self) -> int:
+        with self._cv:
+            return len(self._q)
+
+    def active_count(self) -> int:
+        with self._slot_lk:
+            return sum(1 for g in self._slots if g is not None)
+
+    def submit(self, prompt: Sequence[int],
+               max_new: Optional[int] = None,
+               eos_id: Optional[int] = None,
+               trace_ctx: Optional[Tuple[str, str]] = None
+               ) -> _PendingGen:
+        """Admit one generation request.  ``eos_id`` overrides the
+        config's stop token for this request (stop tokens are
+        per-request in real serving).  Raises :class:`Overloaded` when
+        the bounded queue is full, MXNetError when the request can
+        never be served (empty/over-bucket prompt, bad token ids)."""
+        cfg = self._sv.config
+        try:
+            prompt = [int(t) for t in prompt]
+        except (TypeError, ValueError):
+            self._c_rejected.inc()
+            raise MXNetError("serve: GENERATE prompt must be a sequence "
+                             "of token ids")
+        if not prompt:
+            self._c_rejected.inc()
+            raise MXNetError("serve: GENERATE needs >= 1 prompt token")
+        if any(t < 0 or t >= cfg.vocab for t in prompt):
+            self._c_rejected.inc()
+            raise MXNetError("serve: prompt token out of vocab range "
+                             "[0, %d)" % cfg.vocab)
+        if cfg.prompt_bucket_for(len(prompt)) is None:
+            self._c_rejected.inc()
+            raise MXNetError(
+                "serve: prompt of %d tokens exceeds the top prompt "
+                "bucket %d (MX_SERVE_DECODE_PROMPT_BUCKETS)"
+                % (len(prompt), cfg.prompt_buckets[-1]))
+        limit = cfg.max_tokens if max_new is None \
+            else max(1, min(int(max_new), cfg.max_tokens))
+        stop = cfg.eos_id if eos_id is None else int(eos_id)
+        gen = _PendingGen(prompt, limit, stop, trace_ctx=trace_ctx)
+        with self._cv:
+            if len(self._q) >= self._cap:
+                self._c_rejected.inc()
+                raise Overloaded(
+                    "serve: decode admission queue full (%d/%d; "
+                    "MX_SERVE_QUEUE_CAP) - retry later or add replicas"
+                    % (len(self._q), self._cap))
+            self._q.append(gen)
+            self._g_queue.set(len(self._q))
+            self._cv.notify_all()
+        self._c_requests.inc()
+        return gen
+
+    # -- the decode pump (mxlint hot-path roots) ----------------------------
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            idle = self._tick()
+            if self._on_tick is not None:
+                self._on_tick()
+            if idle:
+                with self._cv:
+                    if not self._q:
+                        self._cv.wait(timeout=0.01)
+        # stop: refuse whatever is still queued so no handler thread is
+        # left waiting on a generation nobody will advance
+        with self._cv:
+            leftover = list(self._q)
+            self._q.clear()
+            self._g_queue.set(0)
+        with self._slot_lk:
+            leftover += [g for g in self._slots if g is not None]
+            self._slots = [None] * len(self._slots)
+        for g in leftover:
+            g._fail(MXNetError("serve: decode engine stopped"))
+
+    def _tick(self) -> bool:
+        """One step boundary: retire finished sequences, admit queued
+        prefills into the freed slots, then ONE decode dispatch over
+        the packed active set.  Returns True when there was nothing to
+        do (idle)."""
+        self._retire()
+        self._admit()
+        active = self._active()
+        if not active:
+            return True
+        try:
+            self._step(active)
+        except BaseException as e:            # XLA failure: fail the set
+            for _slot, g in active:
+                g._fail(e)
+        return False
+
+    def _retire(self) -> None:
+        """Step boundary, phase ``kv_evict``: free the slots of
+        completed sequences.  Eviction is bookkeeping — the pool pages
+        stay allocated (flat HBM); the next prefill into the slot
+        resets its length and overwrites from position 0, and stale
+        entries beyond the new length are masked, never read."""
+        with self._slot_lk:
+            done = [(i, g) for i, g in enumerate(self._slots)
+                    if g is not None and g.done()]
+        if not done:
+            return
+        with _telemetry.phase("kv_evict"):
+            with self._slot_lk:
+                for i, _g in done:
+                    self._slots[i] = None
+        self._c_seqs.inc(len(done))
+        self._g_active.set(self.active_count())
+
+    def _admit(self) -> None:
+        """The slot allocator: fill free slots from the queue at the
+        step boundary, one prefill dispatch each.  Request-level mode
+        (the bench strawman) admits only when the whole previous batch
+        has retired — exactly the behavior continuous batching
+        exists to beat."""
+        with self._slot_lk:
+            free = [i for i, g in enumerate(self._slots) if g is None]
+            occupied = len(self._slots) - len(free)
+        if self._mode == "request" and occupied:
+            return
+        while free:
+            with self._cv:
+                if not self._q:
+                    break
+                gen = self._q.popleft()
+                self._g_queue.set(len(self._q))
+            slot = free.pop(0)
+            gen.slot = slot
+            with self._slot_lk:
+                self._slots[slot] = gen
+            try:
+                self._dispatch_prefill(gen, slot)
+            except BaseException as e:
+                with self._slot_lk:
+                    self._slots[slot] = None
+                gen._fail(e)
+
+    def _active(self) -> List[Tuple[int, _PendingGen]]:
+        with self._slot_lk:
+            return [(i, g) for i, g in enumerate(self._slots)
+                    if g is not None and not g.done()]
+
+    def _dispatch_prefill(self, gen: _PendingGen, slot: int) -> None:
+        cfg = self._sv.config
+        lp = cfg.prompt_bucket_for(len(gen.prompt))
+        padded = _np.zeros(lp, _np.int32)
+        padded[:len(gen.prompt)] = gen.prompt
+        with _telemetry.phase("prefill") as span:
+            if gen.trace_ctx is not None:
+                span.event("request", req_trace=gen.trace_ctx[0],
+                           req_span=gen.trace_ctx[1], slot=slot)
+            t0 = self._sv.dispatch_prefill(slot, padded,
+                                           len(gen.prompt))
+        self._c_prefills.inc()
+        self._g_active.set(self.active_count())
+        self._hq_put(([gen], t0))
+
+    def _step(self, active: List[Tuple[int, _PendingGen]]) -> None:
+        """ONE decode dispatch: pack the active slots into the smallest
+        covering bucket (padded lanes park on the scratch slot) — no
+        host sync anywhere on this path; the emitted-token array goes
+        to the harvester."""
+        cfg = self._sv.config
+        bucket = cfg.slot_bucket_for(len(active))
+        ids = _np.full(bucket, cfg.slots, _np.int32)
+        ids[:len(active)] = [slot for slot, _g in active]
+        with _telemetry.phase("decode_step") as span:
+            for _slot, g in active:
+                if g.trace_ctx is not None:
+                    span.event("request", req_trace=g.trace_ctx[0],
+                               req_span=g.trace_ctx[1])
+            out = self._sv.dispatch_step(ids)
+        self._c_steps.inc()
+        self._h_occ.observe(len(active))
+        self._hq_put(([g for _slot, g in active], out))
+
+    def _hq_put(self, item) -> None:
+        """Bounded handoff to the harvester: the pump may run at most
+        the queue depth ahead of the host-side token reads (that bound
+        is what sizes the pool's overrun margin)."""
+        while not self._stop.is_set():
+            try:
+                self._harvest_q.put(item, timeout=0.05)
+                return
+            except _queue.Full:
+                continue
+
+    # -- the harvester (the ONLY device->host reader) -----------------------
+    def _harvest_loop(self) -> None:
+        while not (self._stop.is_set() and self._harvest_q.empty()):
+            self._harvest_once(block=True)
+
+    def _harvest_once(self, block: bool = False) -> bool:
+        """Read one dispatch's emitted tokens (the device sync lives
+        HERE, overlapping the pump's next dispatch), append them to
+        their generations, stamp per-token latency, flag EOS/limit
+        completions for the next boundary's retire."""
+        try:
+            if block:
+                gens, out = self._harvest_q.get(timeout=0.05)
+            else:
+                gens, out = self._harvest_q.get_nowait()
+        except _queue.Empty:
+            return False
+        toks = _np.asarray(out).reshape(-1)
+        now = time.perf_counter()
+        appended = 0
+        for g, t in zip(gens, toks[:len(gens)]):
+            did, _finished = g._append(int(t), now)
+            if did:
+                appended += 1
+                self._h_token.observe(g.token_times[-1])
+        if appended:
+            self._c_tokens.inc(appended)
+        return True
+
+    # -- synchronous driving (tests, the dispatch-count budget) -------------
+    def step_sync(self) -> bool:
+        """One boundary + dispatch + synchronous harvest — the
+        deterministic test face (requires ``autostart=False``: no
+        pipeline lag, token counts exact).  Returns False once idle
+        with an empty queue."""
+        idle = self._tick()
+        while self._harvest_once(block=False):
+            pass
+        with self._cv:
+            empty = not self._q
+        return not (idle and empty)
+
+    def drain_sync(self, max_ticks: int = 10000) -> None:
+        """step_sync until idle (tests)."""
+        for _ in range(max_ticks):
+            if not self.step_sync():
+                return
+        raise MXNetError("decode: drain_sync did not converge in %d "
+                         "ticks" % max_ticks)
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "DecodeBatcher":
+        if not self._pump.is_alive():
+            self._pump.start()
+        if not self._harvester.is_alive():
+            self._harvester.start()
+        return self
+
+    def close(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
+        if self._pump.is_alive():
+            self._pump.join(timeout=timeout)
+        if self._harvester.is_alive():
+            self._harvester.join(timeout=timeout)
+
+
+# ---------------------------------------------------------------------------
+# Program contracts (ISSUE 11): the decode engine's declared proofs.
+# ``serve.decode`` covers every slot-bucket decode program:
+#   * donation — all four KV-state leaves (k/v pools, token and length
+#     arrays) alias input->output in the lowered executable, the static
+#     form of "HBM stays flat across decode steps";
+#   * trace closure — every active-set size 1..slots resolves to a
+#     compiled slot bucket (zero serve-time retraces as a theorem).
+# ``serve.prefill`` does the same over the prompt-length bucket set,
+# with over-bucket prompts provably rejected at admission (resolve ->
+# None).  Builders run only inside the contracts verifier.
+# ---------------------------------------------------------------------------
+
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=1)
+def _decode_contract_built():
+    from ..programs import ContractCase, ContractClosure
+    cfg = DecodeConfig()
+    sv = DecodeServable(config=cfg)
+    params_abs = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                  for k, v in sv.params.items()}
+    pool_abs = jax.ShapeDtypeStruct(
+        (cfg.layers, cfg.slots + 1, cfg.max_len, cfg.heads,
+         cfg.head_dim), jnp.float32)
+    tok_abs = jax.ShapeDtypeStruct((cfg.slots + 1,), jnp.int32)
+    scalar_abs = jax.ShapeDtypeStruct((), jnp.int32)
+
+    def step_args(bucket):
+        return (params_abs, pool_abs, pool_abs, tok_abs, tok_abs,
+                jax.ShapeDtypeStruct((bucket,), jnp.int32))
+
+    def prefill_args(lp):
+        return (params_abs, pool_abs, pool_abs, tok_abs, tok_abs,
+                scalar_abs, jax.ShapeDtypeStruct((lp,), jnp.int32),
+                scalar_abs)
+
+    step_cases = [ContractCase("serve.decode.step.s%d" % b,
+                               step_args(b), label="s%d" % b,
+                               target=sv.step_program(b))
+                  for b in cfg.slot_buckets]
+    prefill_cases = [ContractCase("serve.decode.prefill.p%d" % lp,
+                                  prefill_args(lp), label="p%d" % lp,
+                                  target=sv.prefill_program(lp))
+                     for lp in cfg.prompt_buckets]
+
+    def resolve_step(n):
+        # every active-set size packs to its covering slot bucket
+        return step_args(cfg.slot_bucket_for(int(n)))
+
+    def resolve_prefill(n):
+        # prompts pad to their bucket; over-bucket prompts are refused
+        # at admission (never reach a jit)
+        lp = cfg.prompt_bucket_for(int(n))
+        return None if lp is None else prefill_args(lp)
+
+    step_closure = ContractClosure(range(1, cfg.slots + 1),
+                                   resolve_step)
+    prefill_closure = ContractClosure(
+        range(1, cfg.prompt_buckets[-1] + 3), resolve_prefill)
+    return step_cases, step_closure, prefill_cases, prefill_closure
+
+
+def _declare_decode_contracts():
+    from ..programs import declare_contract
+    declare_contract(
+        "serve.decode", lambda: _decode_contract_built()[0],
+        donate_argnums=(1, 2, 3, 4),
+        temp_budget_bytes=8 << 20,
+        closure=lambda: _decode_contract_built()[1],
+        description="decode-step slot-bucket table: KV pool pages + "
+                    "per-slot token/length arrays donate in place "
+                    "(flat HBM across steps); trace signatures closed "
+                    "over every active-set size 1..slots")
+    declare_contract(
+        "serve.prefill", lambda: _decode_contract_built()[2],
+        donate_argnums=(1, 2, 3, 4),
+        temp_budget_bytes=8 << 20,
+        closure=lambda: _decode_contract_built()[3],
+        description="prefill prompt-bucket table: same donated KV "
+                    "state; trace signatures closed over the "
+                    "MX_SERVE_DECODE_PROMPT_BUCKETS admission set "
+                    "(over-bucket prompts provably rejected)")
+
+
+_declare_decode_contracts()

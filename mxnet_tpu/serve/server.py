@@ -1,5 +1,5 @@
-"""Serving RPC front: PREDICT / HEALTH / SWAP / STOP over the kvstore
-wire.
+"""Serving RPC front: PREDICT / GENERATE / HEALTH / SWAP / STOP over
+the kvstore wire.
 
 Transport and envelope are the kvstore server's, verbatim: length-
 prefixed pickles (``kvstore.server.send_msg/recv_msg``), requests
@@ -15,6 +15,17 @@ device array and health tools never import the kernel stack.
 Verbs::
 
   PREDICT  (PREDICT, [npx, ...])          -> (True, (version, [npx, ...]))
+  GENERATE (GENERATE, [tok, ...], opts)   -> (True, (version, [tok, ...]))
+           autoregressive decode through the continuous-batching engine
+           (ISSUE 15); opts = {"max_tokens": N, "stream": bool,
+           "eos": tok} (eos = per-request stop token).  With
+           stream=True the terminal reply is preceded by zero or more
+           ("STREAM", offset, [tok, ...]) frames as tokens are
+           harvested — chunks are at-least-once (a failover replays
+           from offset 0; the offset lets the client dedupe), the
+           terminal (version, tokens) reply is exactly-once via the
+           replay cache like PREDICT: a replayed COMPLETED sequence is
+           answered from the cache, never re-generated.
   HEALTH   (HEALTH,)                      -> (True, {status, version, ...})
   METRICS  (METRICS[, fmt])               -> (True, (TXT, utf8-bytes)):
            the live Prometheus text exposition (fmt='json': the JSON
@@ -49,7 +60,7 @@ from .. import fault as _fault
 from .. import telemetry as _telemetry
 from ..kvstore.server import send_msg, recv_msg
 from ..kvstore.wire_codec import decode_array, encode_array, encode_text
-from .batcher import Batcher, Overloaded
+from .batcher import Batcher, Overloaded, result_timeout
 from .servable import ModelHost, Servable
 
 __all__ = ["ServeServer", "serve_forever"]
@@ -65,6 +76,15 @@ WIRE_VERBS = {
     # one PREDICT = one dispatch, even replayed; one SWAP = one flip
     "PREDICT": {"semantics": "replayable", "codec": "array"},
     "SWAP": {"semantics": "replayable", "codec": None},
+    # one GENERATE = one generated sequence: a replayed COMPLETED
+    # sequence answers from the cache (tokens are plain int lists — no
+    # tensor codec)
+    "GENERATE": {"semantics": "replayable", "codec": None},
+    # STREAM is the server->client token-chunk frame of a streaming
+    # GENERATE, not a request verb: a client SENDING it is answered
+    # with an explicit error (see handle()), and chunks re-emitted
+    # after a failover dedupe by offset — re-delivery is harmless
+    "STREAM": {"semantics": "idempotent", "codec": None},
     # probes and shutdown re-execute harmlessly on a retried envelope
     "HEALTH": {"semantics": "idempotent", "codec": None},
     "METRICS": {"semantics": "idempotent", "codec": "text"},
@@ -73,15 +93,20 @@ WIRE_VERBS = {
 
 
 class ServeServer:
-    """Verb handlers + replay cache over one (ModelHost, Batcher) pair."""
+    """Verb handlers + replay cache over one (ModelHost, Batcher) pair,
+    plus an optional continuous-batching decode engine (``decode=``, a
+    :class:`~mxnet_tpu.serve.decode.DecodeBatcher`) behind the GENERATE
+    verb."""
 
     # replies worth exactly-once semantics; HEALTH re-executes harmlessly
-    _CACHED = ("PREDICT", "SWAP")
+    _CACHED = ("PREDICT", "SWAP", "GENERATE")
 
     def __init__(self, host: Optional[ModelHost] = None,
-                 batcher: Optional[Batcher] = None, **batcher_kw):
+                 batcher: Optional[Batcher] = None, decode=None,
+                 **batcher_kw):
         self.host = host or ModelHost()
         self.batcher = batcher or Batcher(self.host, **batcher_kw)
+        self.decode = decode
         # client_id -> [seq, done Event, resp]  (same shape as the
         # kvstore server's cache; one in-flight entry per client).
         # Serving clients are ephemeral (every ServeClient is a fresh
@@ -109,7 +134,11 @@ class ServeServer:
                 "bound (MX_SERVE_REPLAY_CAP)")
 
     # -- envelope (kvstore SEQ contract) ------------------------------------
-    def handle_request(self, msg):
+    def handle_request(self, msg, stream_fn=None):
+        """``stream_fn(offset, tokens)`` — provided by the socket
+        handler — emits one ("STREAM", offset, tokens) frame ahead of
+        the terminal reply; only a FRESH streaming GENERATE uses it
+        (replays answer terminally from the cache)."""
         if isinstance(msg, tuple) and msg and msg[0] == "SEQ":
             cid, seq, inner = msg[1], msg[2], msg[3]
             tctx = msg[4] if len(msg) > 4 else None
@@ -118,10 +147,11 @@ class ServeServer:
                     "serve.server.%s" % cmd,
                     trace_id=tctx[0] if tctx else None,
                     parent_id=tctx[1] if tctx else None) as span:
-                return self._handle_seq(cid, seq, inner, cmd, span)
-        return self.handle(msg)
+                return self._handle_seq(cid, seq, inner, cmd, span,
+                                        stream_fn=stream_fn)
+        return self.handle(msg, stream_fn=stream_fn)
 
-    def _handle_seq(self, cid, seq, inner, cmd, span):
+    def _handle_seq(self, cid, seq, inner, cmd, span, stream_fn=None):
         if cmd not in self._CACHED:
             return self.handle(inner, span=span)
         with self._replay_lock:
@@ -154,7 +184,7 @@ class ServeServer:
                 return False, "replayed request %s still in flight" % seq
             return dup[2]
         try:
-            resp = self.handle(inner, span=span)
+            resp = self.handle(inner, span=span, stream_fn=stream_fn)
         except BaseException as e:
             ent[2] = (False, "serve error handling %r: %s" % (cmd, e))
             ent[1].set()
@@ -180,10 +210,18 @@ class ServeServer:
             self._c_evicted.inc(evicted)
 
     # -- verbs --------------------------------------------------------------
-    def handle(self, msg, span=None):
+    def handle(self, msg, span=None, stream_fn=None):
         cmd = msg[0]
         if cmd == "PREDICT":
             return self._predict(msg[1], span)
+        if cmd == "GENERATE":
+            opts = msg[2] if len(msg) > 2 else {}
+            return self._generate(msg[1], opts or {}, span, stream_fn)
+        if cmd == "STREAM":
+            # server->client frame only; a client emitting it as a
+            # request is a protocol error, answered explicitly
+            return False, ("STREAM is a server-to-client token frame, "
+                           "not a request verb")
         if cmd == "HEALTH":
             return True, self.health()
         if cmd == "METRICS":
@@ -236,9 +274,7 @@ class ServeServer:
         # started earlier and includes network time), so a backlogged
         # replica sheds with an explicit reply instead of the client
         # timing out first and mistaking it for a dead replica
-        timeout = max(1.0,
-                      (get_env("MX_SERVE_TIMEOUT", 30.0, float) or 30.0)
-                      - 2.0)
+        timeout = max(1.0, result_timeout(None) - 2.0)
         try:
             version, outs = pending.result(timeout=timeout)
         except Exception as e:
@@ -248,6 +284,50 @@ class ServeServer:
             # the client replay the poison request on every replica
             return False, "predict failed: %s: %s" % (type(e).__name__, e)
         return True, (version, [encode_array(o) for o in outs])
+
+    def _generate(self, prompt, opts, span, stream_fn):
+        """GENERATE: submit into the continuous-batching decode engine,
+        optionally stream token chunks, answer the complete sequence.
+        Like PREDICT, every failure is a normal (False, reason) reply —
+        a severed connection would make the client replay a poison
+        request on every replica."""
+        if self.decode is None:
+            return False, ("no decode engine deployed (start the "
+                           "replica with --decode)")
+        try:
+            prompt = [int(t) for t in prompt]
+        except (TypeError, ValueError):
+            return False, "bad GENERATE payload: prompt must be token ids"
+        tctx = span.wire_context() if span is not None else None
+        max_new = opts.get("max_tokens")
+        try:
+            pending = self.decode.submit(prompt, max_new=max_new,
+                                         eos_id=opts.get("eos"),
+                                         trace_ctx=tctx)
+        except Overloaded as e:
+            return False, "overloaded: %s" % e
+        except MXNetError as e:
+            return False, str(e)
+        # like PREDICT: stay inside the client's recv window so a slow
+        # generation sheds with an explicit reply, not a dead socket
+        timeout = max(1.0, result_timeout(None) - 2.0)
+        deadline = _fault.Deadline(timeout)
+        try:
+            if opts.get("stream") and stream_fn is not None:
+                sent = 0
+                while not deadline.expired():
+                    chunk, done = pending.wait_new(sent, timeout=0.25)
+                    if chunk:
+                        stream_fn(sent, [int(t) for t in chunk])
+                        sent += len(chunk)
+                    if done:
+                        break
+            tokens = pending.result(timeout=max(0.001,
+                                                deadline.remaining()))
+        except Exception as e:
+            return False, "generate failed: %s: %s" % (type(e).__name__,
+                                                       e)
+        return True, (self.decode.version, [int(t) for t in tokens])
 
     def health(self) -> Dict:
         reg = _telemetry.registry
@@ -260,6 +340,21 @@ class ServeServer:
                             "bucket_hits": sv.bucket_hits}
         except MXNetError:
             status = {"status": "empty", "version": 0}
+        if self.decode is not None:
+            # a decode-only replica is serving even with an empty host
+            dsv = self.decode.servable
+            status["status"] = "serving"
+            status["decode"] = {
+                "model": dsv.name, "version": dsv.version,
+                "slots": dsv.config.slots,
+                "active": self.decode.active_count(),
+                "queued": self.decode.queue_depth(),
+                "slot_buckets": list(dsv.config.slot_buckets),
+                "prompt_buckets": list(dsv.config.prompt_buckets),
+                "retraces": dsv.retraces,
+                "tokens": reg.value("serve.decode.tokens"),
+                "sequences": reg.value("serve.decode.sequences"),
+            }
         status.update({
             "queue_rows": self.batcher.queue_rows(),
             "requests": reg.value("serve.requests"),
@@ -292,6 +387,8 @@ class ServeServer:
 
     def close(self) -> None:
         self.batcher.close()
+        if self.decode is not None:
+            self.decode.close()
 
 
 def serve_forever(port: Optional[int] = None,
@@ -336,9 +433,17 @@ def serve_forever(port: Optional[int] = None,
                     return
                 with inflight_lock:
                     inflight_count[0] += 1
+                sock = self.request
+
+                def stream_fn(offset, tokens):
+                    # token chunks of a streaming GENERATE ride ahead
+                    # of the terminal reply on the same connection
+                    send_msg(sock, ("STREAM", offset, tokens))
+
                 try:
                     _fault.fire("serve.request")
-                    ok, payload = server_state.handle_request(msg)
+                    ok, payload = server_state.handle_request(
+                        msg, stream_fn=stream_fn)
                 except SystemExit:      # injected crash: die mid-request
                     os._exit(17)
                 except _fault.FaultError as e:
@@ -384,11 +489,17 @@ def serve_forever(port: Optional[int] = None,
         # the supervisor owns killing an abandoned replica
         while not stop_event.is_set() and not abort_event.is_set():
             stop_event.wait(timeout=0.1)
-        srv.shutdown()                      # stop accepting
         if abort_event.is_set():
-            _sever()                        # simulated crash: no drain
+            # simulated crash: live connections die FIRST (no drain, no
+            # replies — socketserver's shutdown() can block up to its
+            # 0.5s poll interval, and a "killed" replica must not keep
+            # answering in-flight requests through that window), then
+            # the listener stops
+            _sever()
+            srv.shutdown()
             server_state.close()
             return
+        srv.shutdown()                      # stop accepting
         drain_deadline = _fault.Deadline(5.0)
         while not drain_deadline.expired():
             with inflight_lock:

@@ -26,7 +26,10 @@ scale — arxiv 1605.08695, PAPERS.md):
   ``metric_drain`` / ``retrace`` / ``compiled_step`` /
   ``compiled_window``, plus the serving engine's request phases
   ``queue_wait`` / ``pad`` / ``serve_dispatch`` / ``scatter`` —
-  ISSUE 9).  A span measures *dispatch* latency — it never
+  ISSUE 9 — and the decode engine's ``prefill`` / ``decode_step`` /
+  ``kv_evict`` — ISSUE 15, with per-token latency in the
+  ``serve.decode.token_seconds`` histogram).  A span measures
+  *dispatch* latency — it never
   syncs the device (the host-sync mxlint rule roots this file's
   helpers) — and feeds three sinks: the per-phase histogram
   (``step_phase_seconds{phase=...}``), the existing profiler

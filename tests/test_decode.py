@@ -1,0 +1,530 @@
+"""Decode-serving tests (ISSUE 15): cached attention semantics, the
+prefill/decode program split vs a full-recompute reference, slot-bucket
+packing invariance, continuous batching (long generations never block
+short ones; scheduling never changes tokens), donated KV-pool flatness
++ census attribution, dispatch/retrace budgets, the GENERATE wire verb
+(round trip, streaming, exactly-once replay, mid-generation failover),
+and the engine's telemetry/env/contract surface.
+"""
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu import programs, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.engine import engine
+from mxnet_tpu.ops.attention import cached_attention
+from mxnet_tpu.serve import (Overloaded, ServeClient, ServeServer,
+                             serve_forever)
+from mxnet_tpu.serve.decode import (DecodeBatcher, DecodeConfig,
+                                    DecodeServable, demo_lm_params,
+                                    reference_generate)
+from mxnet_tpu.telemetry import registry
+
+# one small shared geometry: 5 programs to warm (2 prefill + 3 slot
+# buckets), reused by every sync-engine test below
+CFG = dict(dim=16, heads=2, layers=2, slots=4, max_tokens=12,
+           prompt_buckets=(4, 8))
+
+
+@pytest.fixture(scope="module")
+def shared_sv():
+    """One warmed servable; tests build their own (cheap) sync engines
+    on it sequentially — KV state is donated-chained, slot bookkeeping
+    is per-engine, and a fresh prefill resets any slot it reuses."""
+    cfg = DecodeConfig(**CFG)
+    return DecodeServable(config=cfg), cfg
+
+
+def _sync_engine(sv, **kw):
+    return DecodeBatcher(sv, autostart=False, **kw)
+
+
+# ---------------------------------------------------------------------------
+# kernel + model semantics
+# ---------------------------------------------------------------------------
+
+
+def test_cached_attention_matches_reference():
+    rng = np.random.RandomState(0)
+    B, P, H, D = 3, 16, 2, 8
+    q = jnp.asarray(rng.randn(B, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, P, H, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, P, H, D).astype(np.float32))
+    lens = jnp.asarray([1, 7, 16], jnp.int32)
+    out = np.asarray(cached_attention(q, k, v, lens))
+    scale = 1.0 / np.sqrt(D)
+    for b in range(B):
+        n = int(lens[b])
+        for h in range(H):
+            logits = np.asarray(k)[b, :n, h] @ np.asarray(q)[b, h] * scale
+            p = np.exp(logits - logits.max())
+            p /= p.sum()
+            want = p @ np.asarray(v)[b, :n, h]
+            np.testing.assert_allclose(out[b, h], want, rtol=1e-5,
+                                       atol=1e-5)
+
+
+def test_cached_attention_ignores_stale_pages():
+    """Entries at positions >= cur_len must not influence the output —
+    the whole eviction story (retire = bookkeeping, stale KV masked)
+    rests on this."""
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(1, 2, 8).astype(np.float32))
+    k = jnp.asarray(rng.randn(1, 8, 2, 8).astype(np.float32))
+    v = jnp.asarray(rng.randn(1, 8, 2, 8).astype(np.float32))
+    lens = jnp.asarray([3], jnp.int32)
+    base = np.asarray(cached_attention(q, k, v, lens))
+    k2 = k.at[0, 3:].set(99.0)          # poison the stale region
+    v2 = v.at[0, 3:].set(-99.0)
+    out = np.asarray(cached_attention(q, k2, v2, lens))
+    np.testing.assert_array_equal(base, out)
+
+
+def test_config_geometry():
+    cfg = DecodeConfig(slots=8, max_tokens=32, page=16,
+                       prompt_buckets=(4, 8, 16))
+    assert cfg.slot_buckets == (1, 2, 4, 8)
+    assert cfg.slot_bucket_for(3) == 4
+    assert cfg.prompt_bucket_for(5) == 8
+    assert cfg.prompt_bucket_for(17) is None
+    assert cfg.max_len % cfg.page == 0
+    assert cfg.max_len >= cfg.prompt_buckets[-1] + cfg.max_tokens
+    with pytest.raises(MXNetError):
+        DecodeConfig(dim=30, heads=4)
+
+
+def test_decode_matches_full_recompute_reference(shared_sv):
+    sv, cfg = shared_sv
+    eng = _sync_engine(sv)
+    prompts = [[2, 3, 5], [7, 7], [11, 4, 9, 1, 6]]
+    gens = [eng.submit(p, max_new=8) for p in prompts]
+    eng.drain_sync()
+    for p, g in zip(prompts, gens):
+        ref = reference_generate(p, 8, params=sv.params, config=cfg)
+        assert g.tokens_so_far() == ref, (p, g.tokens_so_far(), ref)
+        assert g.done()
+
+
+def test_bucket_packing_invariance(shared_sv):
+    """A sequence's tokens must not depend on which slot bucket it was
+    packed into — the 4-packed decode must equal the 1-alone decode
+    (and the cross-process reference the chaos driver uses)."""
+    sv, cfg = shared_sv
+    ref = reference_generate([9, 2, 13], 10, params=sv.params,
+                             config=cfg)
+    eng = _sync_engine(sv)
+    g_alone = eng.submit([9, 2, 13], max_new=10)
+    eng.drain_sync()
+    assert g_alone.tokens_so_far() == ref
+    eng2 = _sync_engine(sv)
+    packed = [eng2.submit([9, 2, 13], max_new=10)] + \
+        [eng2.submit([int(i) + 3, 8], max_new=10) for i in range(3)]
+    eng2.drain_sync()
+    assert packed[0].tokens_so_far() == ref
+
+
+def test_scheduling_never_changes_tokens(shared_sv):
+    """Continuous vs request-level batching is a THROUGHPUT knob, not a
+    semantics knob: identical workloads produce identical sequences."""
+    sv, cfg = shared_sv
+    prompts = [[3, 1, 4], [1, 5], [9, 2, 6, 5], [3, 5, 8], [9, 7],
+               [9, 3, 2]]
+    news = [2, 9, 4, 2, 7, 3]
+
+    def run(mode):
+        eng = _sync_engine(sv, mode=mode)
+        gens = [eng.submit(p, max_new=n) for p, n in zip(prompts, news)]
+        eng.drain_sync()
+        return [g.tokens_so_far() for g in gens]
+
+    assert run("continuous") == run("request")
+
+
+# ---------------------------------------------------------------------------
+# continuous batching + slots
+# ---------------------------------------------------------------------------
+
+
+def test_long_generation_never_blocks_short(shared_sv):
+    sv, cfg = shared_sv
+    eng = _sync_engine(sv)
+    long_g = eng.submit([2], max_new=12)
+    shorts = [eng.submit([3], max_new=2) for _ in range(3)]
+    for _ in range(5):
+        eng.step_sync()
+    assert all(g.done() for g in shorts)
+    assert not long_g.done()
+    # freed slots admit NEW work while the long one still runs
+    late = eng.submit([4], max_new=2)
+    for _ in range(4):
+        eng.step_sync()
+    assert late.done() and not long_g.done()
+    eng.drain_sync()
+    assert long_g.done() and len(long_g.tokens_so_far()) == 12
+
+
+def test_request_mode_holds_admissions(shared_sv):
+    sv, cfg = shared_sv
+    eng = _sync_engine(sv, mode="request")
+    wave1 = [eng.submit([5], max_new=6) for _ in range(cfg.slots)]
+    late = eng.submit([6], max_new=2)
+    eng.step_sync()                     # admits wave 1 only
+    assert eng.active_count() == cfg.slots
+    for _ in range(3):
+        eng.step_sync()
+    # wave 1 not all done -> the strawman refuses to admit `late`
+    assert not late.done() and eng.queue_depth() == 1
+    eng.drain_sync()
+    assert late.done() and all(g.done() for g in wave1)
+
+
+def test_slot_reuse_after_retire_is_clean(shared_sv):
+    """A retired slot's stale KV must never leak into the next tenant:
+    prefill resets the slot's length and overwrites from position 0."""
+    sv, cfg = shared_sv
+    eng = _sync_engine(sv)
+    first = [eng.submit([7, 3], max_new=6) for _ in range(cfg.slots)]
+    eng.drain_sync()
+    second = eng.submit([2, 8, 4], max_new=8)      # reuses a dirty slot
+    eng.drain_sync()
+    ref = reference_generate([2, 8, 4], 8, params=sv.params, config=cfg)
+    assert second.tokens_so_far() == ref
+    assert all(g.done() for g in first)
+
+
+def test_admission_refusals(shared_sv):
+    sv, cfg = shared_sv
+    eng = _sync_engine(sv)
+    with pytest.raises(MXNetError):
+        eng.submit([])                              # empty prompt
+    with pytest.raises(MXNetError):
+        eng.submit([1] * (cfg.prompt_buckets[-1] + 1))   # over-bucket
+    with pytest.raises(MXNetError):
+        eng.submit([cfg.vocab + 5])                 # out of vocab
+    with pytest.raises(MXNetError):
+        eng.submit(["nope"])                        # not token ids
+    r0 = registry.value("serve.decode.rejected")
+    assert r0 >= 4
+
+
+def test_queue_cap_sheds_overload(shared_sv):
+    sv, cfg = shared_sv
+    eng = _sync_engine(sv, queue_cap=2)
+    eng.submit([1], max_new=2)
+    eng.submit([1], max_new=2)
+    with pytest.raises(Overloaded):
+        eng.submit([1], max_new=2)
+    eng.drain_sync()
+
+
+def test_max_tokens_clamps_to_config(shared_sv):
+    sv, cfg = shared_sv
+    eng = _sync_engine(sv)
+    g = eng.submit([5, 5], max_new=cfg.max_tokens + 50)
+    eng.drain_sync()
+    assert len(g.tokens_so_far()) == cfg.max_tokens
+
+
+def test_eos_stops_generation(shared_sv):
+    """Per-request stop tokens (submit(eos_id=...), the wire's
+    opts["eos"]): generation ends ON the eos token, reference oracle
+    agrees."""
+    sv, cfg = shared_sv
+    ref = reference_generate([3, 9], 8, params=sv.params, config=cfg)
+    eos = ref[2]                       # third emitted token
+    eng = _sync_engine(sv)
+    g = eng.submit([3, 9], max_new=8, eos_id=eos)
+    plain = eng.submit([3, 9], max_new=8)      # no stop token: full run
+    eng.drain_sync()
+    assert g.tokens_so_far() == ref[:3]        # stops ON the eos token
+    assert plain.tokens_so_far() == ref
+    assert reference_generate([3, 9], 8, params=sv.params, config=cfg,
+                              eos_id=eos) == ref[:3]
+
+
+# ---------------------------------------------------------------------------
+# budgets: dispatches, retraces, KV-pool flatness, donation
+# ---------------------------------------------------------------------------
+
+
+def test_dispatch_budget_exact(shared_sv):
+    """1 dispatch per decode step regardless of the active count, 1 per
+    prefill, every dispatch accounted, zero retraces after warm."""
+    sv, cfg = shared_sv
+    eng = _sync_engine(sv)
+    retr0 = sv.retraces
+    pre0 = registry.value("serve.decode.prefills")
+    st0 = registry.value("serve.decode.steps")
+    c0 = engine.snapshot()["dispatches"]
+    gens = [eng.submit([2, 4, 6], max_new=5) for _ in range(4)]
+    eng.drain_sync()
+    dispatches = engine.snapshot()["dispatches"] - c0
+    prefills = registry.value("serve.decode.prefills") - pre0
+    steps = registry.value("serve.decode.steps") - st0
+    assert prefills == 4
+    assert steps == 4                   # token 1 comes from the prefill
+    assert dispatches == prefills + steps
+    assert sv.retraces == retr0
+    assert all(len(g.tokens_so_far()) == 5 for g in gens)
+
+
+def test_kv_pool_flat_and_census_owner(shared_sv):
+    sv, cfg = shared_sv
+    eng = _sync_engine(sv)
+    census = programs.buffer_census()
+    assert "kv_cache" in census
+    assert census["kv_cache"]["bytes"] >= sv.kv_state_bytes()
+    b0 = sv.kv_state_bytes()
+    for _ in range(3):
+        gens = [eng.submit([3, 3], max_new=7) for _ in range(6)]
+        eng.drain_sync()
+        assert all(g.done() for g in gens)
+    assert sv.kv_state_bytes() == b0
+    after = programs.buffer_census()["kv_cache"]["bytes"]
+    assert after == census["kv_cache"]["bytes"]
+
+
+def test_state_donated_and_rebound(shared_sv):
+    """Every dispatch rebinds ``_state`` to the program outputs; the
+    consumed buffers are donated (deleted), so the pool never holds two
+    copies — the device-side face of 'HBM stays flat'."""
+    sv, cfg = shared_sv
+    eng = _sync_engine(sv)
+    eng.submit([4, 2], max_new=4)
+    old = dict(sv._state)
+    eng.drain_sync()
+    assert sv._state["k"] is not old["k"]
+    assert old["k"].is_deleted()        # donated into the dispatch
+    assert old["len"].is_deleted()
+
+
+def test_decode_contracts_declared():
+    names = {c.name for c in programs.contracts()}
+    assert "serve.decode" in names and "serve.prefill" in names
+    by_name = {c.name: c for c in programs.contracts()}
+    assert by_name["serve.decode"].donate_argnums == (1, 2, 3, 4)
+    assert by_name["serve.prefill"].donate_argnums == (1, 2, 3, 4)
+
+
+# ---------------------------------------------------------------------------
+# telemetry
+# ---------------------------------------------------------------------------
+
+
+def test_phases_and_token_histogram(shared_sv):
+    sv, cfg = shared_sv
+    snap0 = telemetry.phase_snapshot()
+    tok_h = registry.find("serve.decode.token_seconds")
+    t0 = tok_h.snapshot()["count"] if tok_h is not None else 0
+    eng = _sync_engine(sv)
+    gens = [eng.submit([6, 1], max_new=4) for _ in range(5)]
+    eng.drain_sync()
+    eng.step_sync()                     # boundary after harvest: retire
+    snap = telemetry.phase_snapshot()
+
+    def count(name):
+        now = snap.get(name, {}).get("count", 0)
+        return now - snap0.get(name, {}).get("count", 0)
+
+    assert count("prefill") >= 5
+    assert count("decode_step") >= 3
+    assert count("kv_evict") >= 1
+    tok_h = registry.find("serve.decode.token_seconds")
+    assert tok_h is not None
+    assert tok_h.snapshot()["count"] - t0 == sum(
+        len(g.tokens_so_far()) for g in gens)
+
+
+def test_streaming_wait_new(shared_sv):
+    sv, cfg = shared_sv
+    eng = _sync_engine(sv)
+    g = eng.submit([8, 8], max_new=6)
+    chunk, done = g.wait_new(0, timeout=0.01)      # nothing yet
+    assert chunk == [] and not done
+    eng.drain_sync()
+    chunk, done = g.wait_new(0, timeout=1.0)
+    assert done and chunk == g.tokens_so_far() and len(chunk) == 6
+    tail, done = g.wait_new(4, timeout=1.0)
+    assert done and tail == g.tokens_so_far()[4:]
+
+
+# ---------------------------------------------------------------------------
+# the GENERATE wire verb
+# ---------------------------------------------------------------------------
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _start_decode_replica(port, cfg=None, params=None, abort_event=None,
+                          on_tick=None):
+    sv = DecodeServable(params=params,
+                        config=cfg or DecodeConfig(**CFG))
+    state = ServeServer(decode=DecodeBatcher(sv, on_tick=on_tick))
+    stop_ev = threading.Event()
+    t = threading.Thread(
+        target=serve_forever,
+        kwargs=dict(port=port, state=state, stop_event=stop_ev,
+                    abort_event=abort_event),
+        daemon=True)
+    t.start()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port),
+                                     timeout=0.2).close()
+            return state, sv, stop_ev
+        except OSError:
+            time.sleep(0.05)
+    raise RuntimeError("decode replica did not come up on %d" % port)
+
+
+@pytest.fixture(scope="module")
+def wire_replica():
+    port = _free_port()
+    state, sv, stop_ev = _start_decode_replica(port)
+    yield "127.0.0.1:%d" % port, state, sv
+    stop_ev.set()
+    state.close()
+
+
+def test_wire_generate_round_trip(wire_replica):
+    addr, state, sv = wire_replica
+    with ServeClient([addr], timeout=30) as cli:
+        ref = reference_generate([3, 1, 4], 9, params=sv.params,
+                                 config=sv.config)
+        version, toks = cli.generate([3, 1, 4], max_tokens=9)
+        assert version == sv.version and toks == ref
+        # refusals come back as normal errors, not severed connections
+        with pytest.raises(MXNetError):
+            cli.generate([1] * 99)
+
+
+def test_wire_generate_streaming(wire_replica):
+    addr, state, sv = wire_replica
+    got = []
+    with ServeClient([addr], timeout=30) as cli:
+        _v, toks = cli.generate([2, 9, 5], max_tokens=8,
+                                on_token=got.extend)
+    assert toks == got
+    assert toks == reference_generate([2, 9, 5], 8, params=sv.params,
+                                      config=sv.config)
+
+
+def test_generate_replay_exactly_once(wire_replica):
+    """A replayed COMPLETED generation answers from the exactly-once
+    cache: identical reply, no second prefill, replay counted."""
+    addr, state, sv = wire_replica
+    pre0 = registry.value("serve.decode.prefills")
+    rep0 = registry.value("serve.server_replays")
+    msg = ("SEQ", "decode-replay-test", 7,
+           ("GENERATE", [4, 4, 4], {"max_tokens": 5}))
+    r1 = state.handle_request(msg)
+    assert r1[0] is True
+    pre1 = registry.value("serve.decode.prefills")
+    r2 = state.handle_request(msg)
+    assert r2 == r1
+    assert registry.value("serve.decode.prefills") == pre1
+    assert pre1 - pre0 == 1
+    assert registry.value("serve.server_replays") - rep0 == 1
+
+
+def test_health_reports_decode(wire_replica):
+    addr, state, sv = wire_replica
+    with ServeClient([addr], timeout=30) as cli:
+        h = cli.health()
+    assert h["status"] == "serving"
+    assert h["decode"]["slots"] == sv.config.slots
+    assert h["decode"]["model"] == sv.name
+    assert h["decode"]["retraces"] == sv.retraces
+
+
+def test_failover_mid_generation(wire_replica):
+    """Kill a replica while a generation is IN FLIGHT: the client
+    fails over, the survivor (the module's wire replica) re-prefills,
+    and the caller still gets the exact deterministic sequence — no
+    lost or corrupted generations."""
+    addr2, _state2, sv2 = wire_replica
+    p1 = _free_port()
+    ab1 = threading.Event()
+    # throttle replica 1's pump (~25ms/step) so the generation
+    # comfortably outlives the abort's ~100ms detection latency — the
+    # kill must land MID-generation, not between request and reply
+    state1, sv1, _st1 = _start_decode_replica(
+        p1, params=sv2.params, abort_event=ab1,
+        on_tick=lambda: time.sleep(0.025))
+    addrs = ["127.0.0.1:%d" % p1, addr2]
+    ref = reference_generate([6, 2, 8], 12, params=sv2.params,
+                             config=sv2.config)
+    fo0 = registry.value("serve.client_failovers")
+    result = {}
+
+    def call():
+        with ServeClient(addrs, timeout=30) as cli:
+            result["out"] = cli.generate([6, 2, 8], max_tokens=12)
+
+    t = threading.Thread(target=call, daemon=True)
+    t.start()
+    # sever replica 1 the moment the generation is live on it
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if state1.decode.active_count() > 0:
+            break
+        time.sleep(0.001)
+    ab1.set()
+    t.join(timeout=60)
+    assert "out" in result, "generation lost in failover"
+    _version, toks = result["out"]
+    assert toks == ref
+    assert registry.value("serve.client_failovers") > fo0
+    state1.close()
+
+
+# ---------------------------------------------------------------------------
+# env + threaded smoke
+# ---------------------------------------------------------------------------
+
+
+def test_decode_env_catalog():
+    from mxnet_tpu.base import ENV_CATALOG
+    for name in ("MX_SERVE_DECODE_SLOTS", "MX_SERVE_DECODE_MAX_TOKENS",
+                 "MX_SERVE_DECODE_PAGE",
+                 "MX_SERVE_DECODE_PROMPT_BUCKETS"):
+        assert name in ENV_CATALOG, name
+        default, doc = ENV_CATALOG[name]
+        assert default and doc
+
+
+def test_threaded_engine_smoke(shared_sv):
+    """The real (pump + harvester) threads: a burst of mixed-length
+    generations all complete correctly and the engine closes clean."""
+    sv, cfg = shared_sv
+    eng = DecodeBatcher(sv)
+    try:
+        prompts = [[5, 6, 7], [2, 2], [9, 1, 3, 8]]
+        refs = [reference_generate(p, n, params=sv.params, config=cfg)
+                for p, n in zip(prompts, (8, 2, 5))]
+        gens = [eng.submit(p, max_new=n)
+                for p, n in zip(prompts, (8, 2, 5))] * 1
+        gens += [eng.submit(prompts[0], max_new=8) for _ in range(5)]
+        outs = [g.result(timeout=60) for g in gens]
+        assert outs[0] == refs[0] and outs[1] == refs[1] \
+            and outs[2] == refs[2]
+        assert all(o == refs[0] for o in outs[3:])
+    finally:
+        eng.close()
+    # close() is idempotent and the threads are gone
+    eng.close()
+    assert not eng._pump.is_alive() and not eng._harvester.is_alive()

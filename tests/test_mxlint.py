@@ -1180,6 +1180,54 @@ def test_serve_batcher_thread_is_a_discovered_root():
                if r.kind == "handler")
 
 
+def test_reinjected_host_sync_in_decode_pump_trips():
+    """ISSUE 15: the decode pump is a hot-path root — a blocking host
+    read reintroduced between decode dispatches (debug peeking at the
+    step's emitted tokens) stalls EVERY active generation's token
+    cadence; the device→host read belongs only to the harvester
+    thread."""
+    p = os.path.join(REPO, "mxnet_tpu", "serve", "decode.py")
+    with open(p) as f:
+        code = f.read()
+    anchor = "            out = self._sv.dispatch_step(ids)"
+    assert anchor in code, "DecodeBatcher._step moved; update this test"
+    bad = code.replace(
+        anchor,
+        anchor + "\n            _dbg = float(out.asnumpy()[0])", 1)
+    diags = lint_source(bad, "mxnet_tpu/serve/decode.py")
+    assert "host-sync-in-hot-path" in rules_of(diags)
+    new, _, _ = apply_baseline(diags, load_baseline(BASELINE))
+    assert "host-sync-in-hot-path" in rules_of(new)
+
+
+def test_decode_pump_is_hot_path_root():
+    """Root-table regression guard for the decode engine (ISSUE 15):
+    the pump loop, the slot allocator and the servable dispatch path
+    must stay rooted so the reinjection test above keeps meaning
+    something."""
+    from tools.mxlint.rules import HOT_PATH_ROOTS
+    roots = dict(HOT_PATH_ROOTS)
+    assert "mxnet_tpu/serve/decode.py" in roots
+    entries = roots["mxnet_tpu/serve/decode.py"]
+    for qual in ("DecodeBatcher._tick", "DecodeBatcher._admit",
+                 "DecodeBatcher._step",
+                 "DecodeServable.dispatch_step"):
+        assert any(qual in q for q in entries), (qual, entries)
+    # the harvester is deliberately NOT rooted: it is the one place the
+    # device→host token read is allowed to live
+    assert not any("_harvest" in q for q in entries), entries
+
+
+def test_decode_pump_threads_are_discovered_roots():
+    """The concurrency pass must see BOTH decode threads — the dispatch
+    pump and the token harvester — as thread roots so their shared
+    state is race-checked.  Reuses the memoized full-tree scan."""
+    _diags, proj = _scan_tree()
+    displays = {r.display for r in proj.roots}
+    assert "thread:DecodeBatcher._loop" in displays
+    assert "thread:DecodeBatcher._harvest_loop" in displays
+
+
 def test_reinjected_wall_clock_in_kvstore_retry_trips():
     p = os.path.join(REPO, "mxnet_tpu", "kvstore", "kvstore.py")
     with open(p) as f:
@@ -1606,8 +1654,14 @@ def test_shipped_wire_surface_is_declared():
     assert "mxnet_tpu/serve/server.py" in manifests
     assert "mxnet_tpu/kvstore/server.py" in manifests
     serve = manifests["mxnet_tpu/serve/server.py"]
-    assert set(serve) == {"PREDICT", "HEALTH", "METRICS", "SWAP", "STOP"}
+    assert set(serve) == {"PREDICT", "GENERATE", "STREAM", "HEALTH",
+                          "METRICS", "SWAP", "STOP"}
     assert serve["PREDICT"]["semantics"] == "replayable"
+    # ISSUE 15: a replayed COMPLETED generation answers from the cache;
+    # STREAM is the server->client chunk frame (handled with an explicit
+    # error if a client ever emits it as a request)
+    assert serve["GENERATE"]["semantics"] == "replayable"
+    assert serve["STREAM"]["semantics"] == "idempotent"
     kv = manifests["mxnet_tpu/kvstore/server.py"]
     assert {"INIT", "PUSH", "PULL", "SET_OPT", "BARRIER", "PING",
             "METRICS", "STOP"} == set(kv)

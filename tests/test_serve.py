@@ -349,7 +349,8 @@ def test_replay_cache_is_bounded_lru(monkeypatch):
     try:
         assert state._replay_cap == 4
         monkeypatch.setattr(state, "handle",
-                            lambda inner, span=None: (True, "ok"))
+                            lambda inner, span=None, stream_fn=None:
+                            (True, "ok"))
         span = _StubSpan()
         ev0 = telemetry.registry.value("serve.replay_evicted") or 0
         for i in range(4):

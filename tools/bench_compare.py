@@ -226,6 +226,16 @@ def extract_record(report: dict) -> dict:
     if prefetch:
         rec["data_wait_share_pct"] = prefetch.get("data_wait_share_pct")
         rec["prefetch_enabled"] = bool(prefetch.get("enabled"))
+    # ISSUE 15: decode-lane gated series — the continuous-vs-request
+    # speedup is an ABSOLUTE acceptance (>= 2x), and flat-KV/zero-
+    # retrace are invariants, not trajectories
+    dec = report.get("decode") or {}
+    if dec:
+        rec["decode_speedup"] = dec.get("continuous_speedup")
+        rec["decode_speedup_ok"] = bool(dec.get("speedup_ok"))
+        rec["decode_kv_pool_flat"] = bool(dec.get("kv_pool_flat"))
+        rec["decode_zero_retraces"] = bool(
+            dec.get("zero_serve_time_retraces"))
     # ISSUE 14: sharded-lane per-chip state bytes, keyed by mesh class
     # (gating compares only within one mesh topology — a dp,fsdp=2 run
     # must never become the bar a dp,fsdp=4 run is held to)
@@ -304,6 +314,27 @@ def gate(rec, history, throughput_tol, memory_tol):
             findings.append(
                 "peak temp bytes %d within %d%% of best %d"
                 % (mem, round(memory_tol * 100), int(best_mem)))
+    # ISSUE 15 gated series: the decode lane's acceptance invariants
+    if "decode_speedup" in rec:
+        if not rec.get("decode_speedup_ok"):
+            ok = False
+            findings.append(
+                "DECODE-BATCHING REGRESSION: continuous-vs-request "
+                "speedup %s < the 2x acceptance floor"
+                % rec.get("decode_speedup"))
+        else:
+            findings.append("decode continuous speedup %sx >= 2x"
+                            % rec.get("decode_speedup"))
+        if not rec.get("decode_kv_pool_flat"):
+            ok = False
+            findings.append(
+                "DECODE KV-POOL LEAK: pool bytes grew across the "
+                "bench run (donation broke — HBM would creep on TPU)")
+        if not rec.get("decode_zero_retraces"):
+            ok = False
+            findings.append(
+                "DECODE RETRACE REGRESSION: serve-time retraces "
+                "after warmup (the bucket tables must be closed)")
     # ISSUE 13 gated series: the retrace budget only ever goes down
     if rec.get("retraces_over_budget"):
         ok = False

@@ -296,14 +296,77 @@ grep -q 'SERVE_LOAD_OK' "$WORK/serve_load.log" || {
 }
 echo "chaos_smoke: serving chaos PASS (failover + restart, zero lost)"
 
-echo "== chaos_smoke: serve dispatch budget (1 dispatch per batch)"
-"$PY" "$REPO/tools/dispatch_count.py" --serve > "$WORK/serve_budget.json"
+echo "== chaos_smoke: decode serving - kill a replica mid-generation (ISSUE 15)"
+# two supervised DECODE replicas (GENERATE verb, continuous batching,
+# device-resident KV pool); the serve.request fault kills a replica
+# mid-load, in-flight generations fail over and RE-PREFILL on the
+# survivor, completed sequences replay from the exactly-once cache.
+# The driver verifies every sequence against a local reference decode
+# of the same seeded demo LM — deterministic greedy decode means a
+# re-prefilled generation must reproduce its tokens EXACTLY, so
+# correctness (not just arrival) survives the crash.
+DECODE_BASE=$("$PY" - <<'EOF'
+import socket
+while True:
+    s1 = socket.socket(); s1.bind(("", 0)); p = s1.getsockname()[1]
+    s2 = socket.socket()
+    try:
+        s2.bind(("", p + 1))
+    except OSError:
+        s1.close(); s2.close(); continue
+    s1.close(); s2.close(); print(p); break
+EOF
+)
+rc=0
+# 80 generations with a crash every ~50 handled requests: the first
+# crash lands mid-load, and end-of-load per-replica counters stay well
+# below the NEXT trip point so the driver's closing health probes and
+# STOPs cannot themselves crash a replica into the assertion window
+PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}" \
+"$PY" "$REPO/tools/launch.py" -n 2 --launcher local \
+    --restart on-failure --max-restarts 3 --hang-timeout 60 \
+    --fault 'serve.request:crash:after=50' -- \
+    "$PY" -m mxnet_tpu.serve --decode --port-base "$DECODE_BASE" \
+    > "$WORK/decode.log" 2>&1 &
+DECODE_LAUNCH_PID=$!
+"$PY" "$REPO/tools/serve_load.py" \
+    --addrs "127.0.0.1:$DECODE_BASE,127.0.0.1:$((DECODE_BASE+1))" \
+    --decode --requests 80 --chaos --stop 2>&1 \
+    | tee "$WORK/decode_load.log" || rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "chaos_smoke: FAIL - decode load driver exited $rc" >&2
+    kill "$DECODE_LAUNCH_PID" 2>/dev/null || true
+    cat "$WORK/decode.log" >&2 || true
+    exit 1
+fi
+wait "$DECODE_LAUNCH_PID" || rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "chaos_smoke: FAIL - decode launch.py exited $rc" >&2
+    cat "$WORK/decode.log" >&2 || true
+    exit 1
+fi
+grep -q 'restart 1/' "$WORK/decode.log" || {
+    echo "chaos_smoke: FAIL - no decode replica was restarted" >&2
+    exit 1
+}
+grep -q 'SERVE_LOAD_OK' "$WORK/decode_load.log" || {
+    echo "chaos_smoke: FAIL - decode load driver never reported OK" >&2
+    exit 1
+}
+echo "chaos_smoke: decode chaos PASS (failover + re-prefill, sequences exact)"
+
+echo "== chaos_smoke: serve dispatch budgets (1/batch, 1/decode step)"
+"$PY" "$REPO/tools/dispatch_count.py" --serve --decode > "$WORK/serve_budget.json"
 "$PY" - "$WORK/serve_budget.json" <<'EOF'
 import json, sys
 r = json.load(open(sys.argv[1]))
 assert r["serve"]["ok"], r["serve"]
+assert r["decode"]["ok"], r["decode"]
 print("serve budget: %(dispatches)d dispatches / %(batches)d batches, "
       "%(retraces)d retraces" % r["serve"])
+print("decode budget: %(dispatches)d dispatches = %(prefill_dispatches)d "
+      "prefills + %(decode_steps)d steps, %(retraces)d retraces"
+      % r["decode"])
 EOF
 
 echo "== chaos_smoke: fleet telemetry plane - kill a replica + a worker mid-load (ISSUE 12)"

@@ -39,6 +39,11 @@ COMPILED_STEP_BUDGET = 2
 # per dispatched batch (pad on host, jit launch, async scatter) — and
 # after warmup every launch must hit the AOT bucket table (0 retraces)
 SERVE_BATCH_BUDGET = 1
+# ISSUE 15: one decode step = ONE device dispatch regardless of how many
+# sequences are active (the pump packs them into a slot bucket), one
+# prefill = one dispatch per admitted sequence, and after warmup every
+# launch hits a pre-built bucket program (0 serve-time retraces)
+DECODE_STEP_BUDGET = 1
 
 
 def run_exchange(n_keys=40):
@@ -215,6 +220,59 @@ def run_serve(n_requests=24, rows_per_request=2, max_batch=8):
     }
 
 
+def run_decode(n_gens=6, prompt_len=3, max_new=5, slots=8):
+    """ISSUE 15 acceptance: the continuous-batching decode engine's
+    dispatch budget, driven SYNCHRONOUSLY (autostart=False: no pipeline
+    lag, so the plan is exact arithmetic, not a race).  All ``n_gens``
+    same-length generations admit at the first boundary (one prefill
+    dispatch each), then run in lockstep: ``max_new - 1`` decode steps
+    of exactly ONE dispatch each regardless of the active count.  Every
+    dispatch must be accounted (dispatches == prefills + steps), and
+    serve time pays ZERO retraces after the deploy-time warm."""
+    import numpy as np
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.engine import engine
+    from mxnet_tpu.serve.decode import (DecodeBatcher, DecodeConfig,
+                                        DecodeServable)
+
+    assert n_gens <= slots, "budget plan needs one admission boundary"
+    cfg = DecodeConfig(slots=slots, max_tokens=max(8, max_new),
+                       prompt_buckets=(4, 8))
+    sv = DecodeServable(config=cfg)
+    eng = DecodeBatcher(sv, autostart=False)     # warm() paid here
+    reg = telemetry.registry
+    retraces0 = sv.retraces
+    pre0 = reg.value("serve.decode.prefills")
+    steps0 = reg.value("serve.decode.steps")
+    c0 = engine.snapshot()["dispatches"]
+    gens = [eng.submit(list(range(1, prompt_len + 1)), max_new=max_new)
+            for _ in range(n_gens)]
+    eng.drain_sync()
+    dispatches = engine.snapshot()["dispatches"] - c0
+    prefills = reg.value("serve.decode.prefills") - pre0
+    steps = reg.value("serve.decode.steps") - steps0
+    want_steps = max_new - 1        # token 1 comes out of the prefill
+    done = all(len(g.tokens_so_far()) == max_new and g.done()
+               for g in gens)
+    return {
+        "generations": n_gens,
+        "tokens": sum(len(g.tokens_so_far()) for g in gens),
+        "prefill_dispatches": prefills,
+        "decode_steps": steps,
+        "expected_steps": want_steps,
+        "dispatches": dispatches,
+        "dispatches_per_step": DECODE_STEP_BUDGET,
+        "retraces": sv.retraces - retraces0,
+        "step_budget": DECODE_STEP_BUDGET,
+        "ok": bool(done
+                   and prefills == n_gens
+                   and steps == want_steps
+                   and dispatches == prefills
+                   + steps * DECODE_STEP_BUDGET
+                   and sv.retraces == retraces0),
+    }
+
+
 def run(steps=3, hidden_layers=6, hidden=16):
     """Measured eager fit; returns the report dict (no printing)."""
     import numpy as np
@@ -287,6 +345,11 @@ def main():
                     help="also pin the ISSUE 9 serving budget: 1 device "
                          "dispatch per coalesced micro-batch, all "
                          "bucket-table hits, 0 serve-time retraces")
+    ap.add_argument("--decode", action="store_true",
+                    help="with --serve: also pin the ISSUE 15 decode "
+                         "budget: exactly 1 dispatch per decode step "
+                         "regardless of active-sequence count, 1 per "
+                         "prefill, 0 serve-time retraces after warmup")
     ap.add_argument("--scan", type=int, default=0,
                     help="scan window size for --compiled "
                          "(default: MX_STEP_SCAN, else 4)")
@@ -324,6 +387,9 @@ def main():
     if args.serve:
         report["serve"] = run_serve()
         report["ok"] = bool(report["ok"] and report["serve"]["ok"])
+    if args.decode:
+        report["decode"] = run_decode()
+        report["ok"] = bool(report["ok"] and report["decode"]["ok"])
     print(json.dumps(report, indent=2))
     sys.exit(0 if report["ok"] else 1)
 

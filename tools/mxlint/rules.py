@@ -70,6 +70,21 @@ HOT_PATH_ROOTS: List[Tuple[str, List[str]]] = [
     ("mxnet_tpu/serve/servable.py",
      ["Servable.dispatch", "Servable.program", "Servable.signature_of",
       "ModelHost.active"]),
+    # the decode pump + slot allocator (ISSUE 15): ONE host sync
+    # between decode dispatches serializes every active generation's
+    # token cadence — sampled tokens stay device-resident between
+    # steps, and the device→host read belongs ONLY to the harvester
+    # thread (_harvest_once, deliberately NOT rooted).  The
+    # tests/test_mxlint.py reinjection test proves a blocking host
+    # read between state dequeue and dispatch trips this entry.
+    ("mxnet_tpu/serve/decode.py",
+     ["DecodeBatcher._loop", "DecodeBatcher._tick",
+      "DecodeBatcher._retire", "DecodeBatcher._admit",
+      "DecodeBatcher._active", "DecodeBatcher._step",
+      "DecodeBatcher._dispatch_prefill", "DecodeBatcher._hq_put",
+      "DecodeBatcher.submit", "DecodeServable.dispatch_step",
+      "DecodeServable.dispatch_prefill", "DecodeServable.step_program",
+      "DecodeServable.prefill_program"]),
     # the program census (ISSUE 10) wraps EVERY jit dispatch: its call
     # path and record helpers are dispatch-time bookkeeping by contract
     # (shape/aval reads only — never a device sync), and the buffer

@@ -20,6 +20,14 @@ arrival — and reports a JSON summary.
 supervised job (launch.py) drains and exits 0.  ``--metrics`` prints
 every replica's live Prometheus snapshot via the METRICS verb after the
 load (``--requests 0 --metrics`` is a pure scrape).
+
+``--decode`` switches the load to GENERATE requests against the
+continuous-batching decode engine (ISSUE 15): every generated token
+sequence is checked against a LOCAL greedy decode of the same
+deterministic demo LM (``serve.decode.reference_generate``), so a
+failover that re-prefills on the survivor must reproduce the sequence
+EXACTLY — completed sequences are never lost, replayed at most once,
+and never silently wrong.
 """
 import argparse
 import json
@@ -59,6 +67,13 @@ def main():
     ap.add_argument("--requests", type=int, default=120)
     ap.add_argument("--rows", type=int, default=2,
                     help="rows per request")
+    ap.add_argument("--decode", action="store_true",
+                    help="drive GENERATE (autoregressive decode) "
+                         "instead of PREDICT; every token sequence is "
+                         "verified against a local reference decode")
+    ap.add_argument("--max-tokens", type=int, default=12,
+                    help="--decode: generated tokens per request "
+                         "(short/long mix alternates 2 and this)")
     ap.add_argument("--chaos", action="store_true",
                     help="assert failover happened and every replica "
                          "serves again afterwards")
@@ -78,18 +93,47 @@ def main():
 
     addrs = [a.strip() for a in args.addrs.split(",") if a.strip()]
     wait_up(addrs)
-    net = demo_block()                      # local truth for verification
     cli = ServeClient(addrs, timeout=args.timeout)
     rng = np.random.RandomState(0)
     ok, t0 = 0, time.perf_counter()
-    for i in range(args.requests):
-        x = rng.randn(args.rows, 16).astype(np.float32)
-        version, outs = cli.predict([x])
-        np.testing.assert_allclose(
-            outs[0], demo_expected(x, net=net), rtol=1e-4, atol=1e-5,
-            err_msg="request %d (servable v%d) answered WRONG values"
-                    % (i, version))
-        ok += 1
+    if args.decode:
+        # local truth: the reference greedy decode of the same seeded
+        # demo LM — a replica (or a failover re-prefill on the
+        # survivor) must answer these tokens EXACTLY
+        from mxnet_tpu.serve.decode import (DecodeConfig,
+                                            demo_lm_params,
+                                            reference_generate)
+        cfg = DecodeConfig()
+        params = demo_lm_params(cfg)
+        # mirror the server's silent clamp (submit caps max_new at
+        # MX_SERVE_DECODE_MAX_TOKENS) or the local oracle would expect
+        # more tokens than a CORRECT replica may return
+        long_new = min(args.max_tokens, cfg.max_tokens)
+        expect_cache = {}
+        for i in range(args.requests):
+            prompt = [int(t) for t in
+                      rng.randint(2, cfg.vocab, size=2 + (i % 3))]
+            max_new = 2 if i % 2 else long_new
+            key = (tuple(prompt), max_new)
+            if key not in expect_cache:
+                expect_cache[key] = reference_generate(
+                    prompt, max_new, params=params, config=cfg)
+            version, toks = cli.generate(prompt, max_tokens=max_new)
+            assert toks == expect_cache[key], \
+                ("request %d (decode v%d) answered WRONG tokens: "
+                 "%r != %r" % (i, version, toks, expect_cache[key]))
+            ok += 1
+    else:
+        net = demo_block()                  # local truth for verification
+        for i in range(args.requests):
+            x = rng.randn(args.rows, 16).astype(np.float32)
+            version, outs = cli.predict([x])
+            np.testing.assert_allclose(
+                outs[0], demo_expected(x, net=net), rtol=1e-4,
+                atol=1e-5,
+                err_msg="request %d (servable v%d) answered WRONG "
+                        "values" % (i, version))
+            ok += 1
     wall = time.perf_counter() - t0
     failovers = telemetry.registry.value("serve.client_failovers")
 
@@ -100,8 +144,13 @@ def main():
         assert failovers >= 1, \
             "no failover happened - did the chaos fault fire?"
         # the supervisor must have brought the dead replica back: every
-        # replica answers a PINNED health probe (the restarted one needs
-        # its warmup window, covered by the client's retry deadline)
+        # replica answers a PINNED health probe.  A replica killed near
+        # the END of the load may still be re-warming its program
+        # tables (the decode demo compiles ~7 bucket programs before it
+        # binds), which outlives the pinned probe's 5s fail-fast clamp
+        # — so first wait for every port to accept again (a respawned
+        # replica binds only once warm), THEN probe.
+        wait_up(addrs, timeout=120.0)
         for i in range(len(addrs)):
             h = cli.health(idx=i)
             assert h.get("status") == "serving", (i, h)
@@ -115,6 +164,7 @@ def main():
     cli.close()
     print(json.dumps({
         "requests": args.requests,
+        "mode": "decode" if args.decode else "predict",
         "answered": ok,
         "failovers": failovers,
         "requests_per_sec": round(ok / wall, 2),
