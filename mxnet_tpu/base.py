@@ -129,6 +129,11 @@ ENV_CATALOG: Dict[str, Any] = {
     "MX_COMPILE_CACHE_SALT": ("", "Extra compile-cache key component: operators set it to partition one shared cache directory (e.g. per experiment branch) without deleting entries; changing it is a guaranteed full-miss restart."),
     "MX_PREFETCH": ("1", "Async device input pipeline (mxnet_tpu/io/prefetch.py DevicePrefetcher) in the harnesses that support it (bench.py --eager): a background thread device_puts one batch AHEAD of the training loop (double-buffered), so the host->device transfer of batch N+1 overlaps the compute of batch N and the loop's data_wait phase share collapses to the queue handoff.  Bit-parity with the synchronous path (device_put moves bytes, never rounds).  0 keeps the transfer synchronous in the loop (still measured under data_wait)."),
     "MX_PREFETCH_DEPTH": ("2", "DevicePrefetcher queue bound in batches: how many device-resident batches may sit ahead of the consumer (2 = classic double buffering).  The producer blocks (stop-aware bounded polls) at the bound, so prefetch can never balloon memory by more than this many batches."),
+    "MX_ELASTIC": ("0", "Elastic membership (mxnet_tpu/kvstore): 1 = a dist_async worker announces itself with the JOIN wire verb at store init (idempotent for ranks the server already seeded) and the Module.fit loop installs a SIGTERM drain handler — on preemption notice the rank finishes its epoch, checkpoints, sends LEAVE and exits 0, so the barrier quorum shrinks instead of timing out.  tools/launch.py --elastic sets it for every worker.  0 keeps the fixed-membership behavior."),
+    "MX_ELASTIC_EPOCH": ("0", "The membership epoch a worker incarnation plans its fusion buckets under (the bucket-name CRC salt).  Set by tools/launch.py --elastic on every (re)spawned worker after a resize, so all workers of one incarnation derive identical salted bucket names with no coordination; 0 keeps the historical unsalted names."),
+    "MX_ELASTIC_EVICT_AFTER": ("", "kvstore server: a MEMBER rank silent this many seconds is evicted from the live membership table itself (an involuntary LEAVE with a membership-epoch bump) instead of only being discounted from the current barrier - shrink-and-continue for workers that died without preemption notice.  Empty/0 disables permanent eviction (transient stale discounting via MX_KVSTORE_STALE_TIMEOUT still applies)."),
+    "MX_EXCHANGE_HIERARCHICAL": ("0", "1 = two-tier gradient exchange on the dist_async store (gradient/accumulate mode): tier 1 merges device copies locally (ICI), tier 2 ships int8 both ways across the slice boundary - the existing compressed PUSH plus the PULLQ quantized return leg - with each fusion bucket's pull launched as-ready on its own connection (a straggling server shard delays only its own buckets).  Cross-slice wire bytes drop ~4x vs the flat fp32 pull; the pull leg's quantization error is stateless (no error feedback), so this is an opt-in for the accumulate exchange, never the default."),
+    "MX_EXCHANGE_PARALLEL": ("4", "Concurrent as-ready bucket pulls (dedicated connections) per worker under MX_EXCHANGE_HIERARCHICAL."),
     "MX_FLEET_PORT": ("", "Port the fleet collector's wire server binds (FLEET verb -> merged snapshot as a JSN payload, METRICS -> whole-fleet federation exposition; same length-prefixed envelope as the kvstore/serve wire).  This is the API surface the coming serve router/autoscaler consume.  Empty = no wire server."),
     "MX_FLEET_HTTP_PORT": ("", "Port of the collector's Prometheus federation HTTP endpoint: GET /metrics returns every member's instruments re-labeled {role,rank,model} plus the fleet rollups — a single scrape covers the whole fleet; GET /fleet.json returns the merged snapshot.  Empty = no HTTP endpoint."),
 }

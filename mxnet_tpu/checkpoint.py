@@ -10,10 +10,17 @@ whatever mesh the new job brings up (elastic re-sharding).
 
 Failure posture (§5.3, documented contract): fail fast and restart from
 the last checkpoint.  XLA collectives are SPMD — a lost host wedges the
-step, so the job relies on (a) the launcher/scheduler restarting all
-processes, and (b) ``CheckpointManager.latest_step()`` resume.  There is
-deliberately NO in-band elastic shrink (the reference's dist_async had
-none either); checkpoint frequency bounds lost work.
+step, so WITHIN one jitted world the job relies on (a) the
+launcher/scheduler restarting all processes, and (b)
+``CheckpointManager.latest_step()`` resume.  There is deliberately no
+in-band shrink DURING a step; elastic membership (ISSUE 16) instead
+resizes BETWEEN epochs, through this module — the supervisor quiesces
+every rank at an epoch boundary, the checkpoint (params + optimizer
+sidecar + per-leaf spec sidecar) is the hand-off artifact, and the
+resized world restores it onto its new mesh via
+``resume_or_init(mesh=...)``'s re-shard-by-axis-NAME path.  The
+sidecar's ``world_size`` records how many processes wrote the
+checkpoint, so a resumed job can tell a resize from a plain restart.
 """
 from __future__ import annotations
 
@@ -25,7 +32,8 @@ from typing import Any, Callable, Optional, Tuple
 import jax
 
 __all__ = ["save_sharded", "restore_sharded", "CheckpointManager",
-           "resume_or_init", "saved_specs", "shardings_from_saved"]
+           "resume_or_init", "saved_specs", "saved_world_size",
+           "shardings_from_saved"]
 
 # per-leaf PartitionSpec sidecar (ISSUE 14): a sharded job's checkpoint
 # records WHERE each leaf lived so a restore onto a NEW mesh re-shards
@@ -64,8 +72,12 @@ def _sidecar_doc(state) -> dict:
         if mesh is not None and not mesh_axes:
             mesh_axes = {str(k): int(v) for k, v in dict(mesh.shape).items()}
         specs.append(_spec_to_json(sh))
+    try:
+        world = int(jax.process_count())
+    except Exception:
+        world = 1
     return {"schema": SPEC_SCHEMA, "mesh_axes": mesh_axes,
-            "leaf_specs": specs}
+            "leaf_specs": specs, "world_size": world}
 
 
 def _sidecar_path(path: str) -> str:
@@ -92,6 +104,21 @@ def saved_specs(path: str) -> Optional[dict]:
     except (OSError, ValueError):
         return None
     return _validate_sidecar(doc)
+
+
+def saved_world_size(path: str) -> Optional[int]:
+    """How many processes wrote checkpoint `path` (the sidecar's
+    ``world_size``), or None when no/old sidecar exists.  An elastic
+    resume compares it against the CURRENT world to tell a resize
+    (re-shard, replan exchange layout) from a plain same-size restart."""
+    doc = saved_specs(path)
+    if doc is None:
+        return None
+    try:
+        w = int(doc.get("world_size", 0))
+    except (TypeError, ValueError):
+        return None
+    return w if w > 0 else None
 
 
 def _spec_onto_mesh(entries, shape, mesh):

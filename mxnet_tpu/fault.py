@@ -28,6 +28,8 @@ Instrumented sites (grep for ``fault.fire``):
   ``kvstore.send``        before each client RPC send
   ``kvstore.recv``        before each client RPC receive
   ``server.handle``       server-side, before dispatching a request
+  ``kvstore.membership``  server-side, before applying a JOIN/LEAVE
+                          membership mutation (elastic resize chaos)
   ``checkpoint.commit``   between checkpoint write and atomic rename
   ``module.fit.epoch``    end of each Module.fit epoch (pre-checkpoint)
   ``worker.step``         start of each fit-loop batch — what

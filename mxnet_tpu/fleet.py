@@ -251,60 +251,77 @@ class StragglerDetector:
         self.min_members = max(2, int(min_members))
         self._hist: Dict[str, deque] = {}
         self._missed: Dict[str, int] = {}
+        # guards _hist/_missed: the collector thread updates them every
+        # scrape round while an elastic retire() (main/supervisor
+        # thread) may drop a departed member mid-round
+        self._guard = threading.Lock()
 
     def update(self, worker_stats: Dict[str, Dict[str, Any]]
                ) -> List[Dict[str, Any]]:
         """One scrape round of ``{member_id: {"step_seconds", "phases"}}``
         -> the current straggler findings (possibly empty)."""
         reported = set()
-        for mid, st in worker_stats.items():
-            dur = st.get("step_seconds")
-            if dur is None or dur <= 0:
-                continue
-            dq = self._hist.setdefault(mid, deque(maxlen=self.window))
-            dq.append((float(dur), dict(st.get("phases") or {})))
-            self._missed[mid] = 0
-            reported.add(mid)
-        # a member that stopped reporting a USABLE step duration —
-        # absent, or present with an empty/unreadable payload — falls
-        # out of the comparison, but only after a full window of
-        # misses: one transient scrape failure must not reset a slow
-        # rank's accumulated history (it would oscillate out of
-        # detection exactly when it matters), while a permanently
-        # silent one must not stay flagged on a frozen mean forever
-        for mid in list(self._hist):
-            if mid not in reported:
-                self._missed[mid] = self._missed.get(mid, 0) + 1
-                if self._missed[mid] > self.window:
-                    self._hist.pop(mid)
-                    self._missed.pop(mid, None)
-        means = {mid: sum(d for d, _p in dq) / len(dq)
-                 for mid, dq in self._hist.items() if dq}
-        if len(means) < self.min_members:
-            return []
-        med = _lower_median(list(means.values()))
-        if med <= 0:
-            return []
-        out = []
-        for mid, mean_dur in sorted(means.items()):
-            if mean_dur <= self.factor * med:
-                continue
-            phases: Dict[str, float] = {}
-            for _d, p in self._hist[mid]:
-                for k, v in p.items():
-                    phases[k] = phases.get(k, 0.0) + float(v)
-            total = sum(phases.values())
-            dom, share = None, 0.0
-            if phases:
-                dom = max(phases, key=lambda k: phases[k])
-                share = phases[dom] / total if total > 0 else 0.0
-            out.append({"member": mid,
-                        "step_seconds": round(mean_dur, 6),
-                        "fleet_median_seconds": round(med, 6),
-                        "ratio": round(mean_dur / med, 3),
-                        "dominant_phase": dom,
-                        "dominant_share": round(share, 4)})
-        return out
+        with self._guard:
+            for mid, st in worker_stats.items():
+                dur = st.get("step_seconds")
+                if dur is None or dur <= 0:
+                    continue
+                dq = self._hist.setdefault(mid,
+                                           deque(maxlen=self.window))
+                dq.append((float(dur), dict(st.get("phases") or {})))
+                self._missed[mid] = 0
+                reported.add(mid)
+            # a member that stopped reporting a USABLE step duration —
+            # absent, or present with an empty/unreadable payload — falls
+            # out of the comparison, but only after a full window of
+            # misses: one transient scrape failure must not reset a slow
+            # rank's accumulated history (it would oscillate out of
+            # detection exactly when it matters), while a permanently
+            # silent one must not stay flagged on a frozen mean forever
+            for mid in list(self._hist):
+                if mid not in reported:
+                    self._missed[mid] = self._missed.get(mid, 0) + 1
+                    if self._missed[mid] > self.window:
+                        self._hist.pop(mid)
+                        self._missed.pop(mid, None)
+            means = {mid: sum(d for d, _p in dq) / len(dq)
+                     for mid, dq in self._hist.items() if dq}
+            if len(means) < self.min_members:
+                return []
+            med = _lower_median(list(means.values()))
+            if med <= 0:
+                return []
+            out = []
+            for mid, mean_dur in sorted(means.items()):
+                if mean_dur <= self.factor * med:
+                    continue
+                phases: Dict[str, float] = {}
+                for _d, p in self._hist[mid]:
+                    for k, v in p.items():
+                        phases[k] = phases.get(k, 0.0) + float(v)
+                total = sum(phases.values())
+                dom, share = None, 0.0
+                if phases:
+                    dom = max(phases, key=lambda k: phases[k])
+                    share = phases[dom] / total if total > 0 else 0.0
+                out.append({"member": mid,
+                            "step_seconds": round(mean_dur, 6),
+                            "fleet_median_seconds": round(med, 6),
+                            "ratio": round(mean_dur / med, 3),
+                            "dominant_phase": dom,
+                            "dominant_share": round(share, 4)})
+            return out
+
+    def retire(self, mid: str) -> None:
+        """Drop a member from straggler tracking IMMEDIATELY (elastic
+        membership, ISSUE 16): a worker that sent LEAVE — or was evicted
+        from the kvstore membership table — is gone by protocol, not
+        merely silent, so it must not sit in the window as a frozen mean
+        (a false straggler flag on every voluntary shrink) or burn the
+        full miss-window aging out."""
+        with self._guard:
+            self._hist.pop(mid, None)
+            self._missed.pop(mid, None)
 
 
 class SLOTracker:
@@ -607,6 +624,21 @@ class FleetCollector:
         with self._lock:
             self._members.pop(key, None)
             self._state.pop(key, None)
+
+    def retire(self, key: str) -> None:
+        """Elastic departure (ISSUE 16): a member that sent LEAVE (or
+        was evicted by the kvstore membership table, or shrunk away by
+        the supervisor) is retired from presence AND detector state in
+        one step — unlike :meth:`remove_member` alone, this also clears
+        its straggler window and any outstanding flag, so a voluntary
+        shrink never false-alarms as a straggler/ABSENT member aging
+        out over the miss-window.  All under _lock — the scrape thread
+        mutates the same detector state mid-round."""
+        with self._lock:
+            self._members.pop(key, None)
+            self._state.pop(key, None)
+            self.stragglers.retire(key)
+            self._flagged.discard(key)
 
     def members(self) -> List[FleetMember]:
         with self._lock:
@@ -934,8 +966,10 @@ class FleetCollector:
                       labels={"slo": slo}).set(1 if slo in breached
                                                else 0)
         current = {f["member"] for f in findings}
+        with self._lock:      # vs retire() clearing a flag mid-round
+            flagged = set(self._flagged)
         for f in findings:
-            if f["member"] in self._flagged:
+            if f["member"] in flagged:
                 continue
             dom = ""
             if f.get("dominant_phase"):
@@ -952,7 +986,8 @@ class FleetCollector:
                                     **{k: f[k] for k in
                                        ("member", "ratio",
                                         "dominant_phase")}})
-        self._flagged = current
+        with self._lock:
+            self._flagged = current
 
     # -- faces --------------------------------------------------------------
     def snapshot(self) -> Optional[Dict[str, Any]]:

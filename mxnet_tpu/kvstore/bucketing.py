@@ -12,8 +12,10 @@ Determinism contract: the layout is a pure function of the ordered
 ``(key, shape, dtype)`` descriptors and the bucket byte cap, so every
 worker — and, for the parameter-server store, every client of the same
 server — derives the same key→bucket mapping with no coordination.  The
-bucket's wire key embeds a CRC of its member descriptors: if any member's
-shape/dtype (or the member set) changes, the name changes with it, and a
+bucket's wire key embeds a CRC of its member descriptors (plus an
+optional ``salt`` — elastic jobs pass the membership epoch, so a resize
+rolls every name): if any member's shape/dtype (or the member set, or
+the salt) changes, the name changes with it, and a
 stale server entry can never be misread as the new layout.  Stores cache
 plans per signature (KVStore._bucket_plans), which is the persisted form
 of the layout within a process.
@@ -47,7 +49,8 @@ class Bucket:
 
     def __init__(self, index: int, positions: Sequence[int],
                  keys: Sequence, sizes: Sequence[int],
-                 shapes: Sequence[Tuple[int, ...]], dtype: str):
+                 shapes: Sequence[Tuple[int, ...]], dtype: str,
+                 salt=None):
         self.positions = list(positions)     # indices into the caller's keys
         self.keys = list(keys)
         self.sizes = list(sizes)
@@ -61,6 +64,14 @@ class Bucket:
         self.total = off
         desc = ";".join("%s:%s:%s" % (k, "x".join(map(str, s)), dtype)
                         for k, s in zip(self.keys, self.shapes))
+        if salt:
+            # elastic membership (ISSUE 16): the membership epoch rides
+            # the CRC, so replanning after a resize is coordination-free
+            # AND collision-free — every epoch's layout gets fresh wire
+            # names and a pre-resize server accumulator can never be
+            # misread as the new world's bucket.  salt=0/None keeps the
+            # historical names (fixed-membership jobs are unchanged).
+            desc += "|salt:%s" % (salt,)
         # index + member CRC: stable across steps/workers, distinct across
         # layout changes
         self.name = "__fusedb%d_%08x" % (index, zlib.crc32(desc.encode()))
@@ -77,7 +88,7 @@ class Bucket:
 def plan_buckets(keys: Sequence, shapes: Sequence[Tuple[int, ...]],
                  dtypes: Sequence[str], itemsizes: Sequence[int],
                  stypes: Sequence[str], max_bytes: int,
-                 reverse: bool = False):
+                 reverse: bool = False, salt=None):
     """Greedy first-fit in key order, one dtype per bucket.
 
     Returns ``(buckets, solo_positions)``: positions not covered by any
@@ -133,7 +144,8 @@ def plan_buckets(keys: Sequence, shapes: Sequence[Tuple[int, ...]],
                 n *= int(d)
             sizes.append(n)
         buckets.append(Bucket(bi, poss, [keys[p] for p in poss], sizes,
-                              [shapes[p] for p in poss], str(dtypes[poss[0]])))
+                              [shapes[p] for p in poss],
+                              str(dtypes[poss[0]]), salt=salt))
     return buckets, sorted(solo)
 
 

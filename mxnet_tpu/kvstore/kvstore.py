@@ -309,16 +309,21 @@ class KVStore:
         cleanly disables bucketing (everything solo, per-key path)."""
         from .bucketing import bucket_bytes, plan_buckets
         cap = bucket_bytes()
+        # elastic membership (ISSUE 16): stores carrying a bucket salt
+        # (the membership epoch of their incarnation) roll every bucket
+        # CRC on resize — replanning stays coordination-free AND a stale
+        # pre-resize server accumulator can never alias a new bucket
+        salt = getattr(self, "_bucket_salt", None) or None
         sig = tuple((k, tuple(a.shape), str(a.dtype),
                      getattr(a, "stype", "default"))
                     for k, a in zip(keys, arrays))
-        cache_key = (sig, cap, bool(reverse))
+        cache_key = (sig, cap, bool(reverse), salt)
         cached = self._bucket_cache.get(cache_key)
         if cached is None:
             cached = plan_buckets(
                 keys, [s[1] for s in sig], [s[2] for s in sig],
                 [_np.dtype(a.dtype).itemsize for a in arrays],
-                [s[3] for s in sig], cap, reverse=reverse)
+                [s[3] for s in sig], cap, reverse=reverse, salt=salt)
             self._bucket_cache[cache_key] = cached
         return cached
 
@@ -1171,9 +1176,25 @@ class KVStoreDistAsync(KVStore):
         self._seq = 0
         self._seq_lock = threading.Lock()
         self._bucket_inited: set = set()
+        # elastic membership (ISSUE 16): under MX_ELASTIC the worker
+        # announces itself with JOIN at init (a no-op for ranks the
+        # server already seeded) and the launcher hands every worker of
+        # one incarnation the SAME membership epoch via MX_ELASTIC_EPOCH
+        # — the bucket salt must be agreed BEFORE the first plan, not
+        # observed racily while a join storm is still in flight.
+        self._elastic = bool(get_env("MX_ELASTIC", 0, int))
+        self._membership_epoch = get_env("MX_ELASTIC_EPOCH", 0, int) or 0
+        self._bucket_salt = self._membership_epoch or None
+        # hierarchical exchange (ISSUE 16): the cross-slice return leg
+        # pulls int8 (PULLQ) instead of fp32 — opt-in, gradient/
+        # accumulate mode only (a server-side optimizer needs exact
+        # full-width weights back)
+        self._hier = bool(get_env("MX_EXCHANGE_HIERARCHICAL", 0, int))
         self._hb_stop = threading.Event()
         self._hb_thread = None
         self._start_heartbeat()
+        if self._elastic:
+            self.join()
 
     # -- resilience plumbing ------------------------------------------------
     def _retry_policy(self):
@@ -1313,11 +1334,129 @@ class KVStoreDistAsync(KVStore):
         for i, s, e in plan:
             self._rpc_on(i, cmd, self._part_key(k, i), flat[s:e])
 
+    @staticmethod
+    def _count_pull_bytes(n) -> None:
+        """Pull-leg wire accounting — a counter of its own so the push-
+        leg ``engine.wire_bytes`` the existing benches pin is untouched;
+        tools/bandwidth.py --hierarchical reads both to compare the flat
+        and two-tier exchanges end to end."""
+        from .. import telemetry as _telemetry
+        _telemetry.registry.counter(
+            "kvstore.pull_wire_bytes",
+            doc="bytes received on the pull leg of the dist_async "
+                "exchange (PULLQ compact tuples or full-width "
+                "arrays)").inc(int(n))
+
+    def _pull_hier(self, k):
+        """Hierarchical cross-slice return leg (ISSUE 16): PULLQ ships
+        the merged value per-block int8 — ~4x fewer wire bytes than the
+        fp32 PULL.  The pull leg's quantization error is bounded by the
+        per-block absmax scale and is NOT error-fed-back (the server
+        encode is stateless), which is why this tier is opt-in
+        (MX_EXCHANGE_HIERARCHICAL) for the gradient/accumulate exchange
+        rather than the default pull."""
+        from . import wire_codec as _wc
+        gc = self._wire_gc()
+        block = gc.block if gc is not None and \
+            getattr(gc, "type", None) == "int8" else 256
+        payload = self._rpc("PULLQ", k, int(block))
+        if _wc.is_wire_payload(payload):
+            scales = _np.asarray(payload[6])
+            self._count_pull_bytes(len(payload[5]) + scales.nbytes)
+            return _wc.decode_wire(payload)
+        arr = _np.asarray(payload)          # non-float key: full width
+        self._count_pull_bytes(arr.nbytes)
+        return arr
+
+    # -- as-ready hierarchical bucket exchange (ISSUE 16) -------------------
+    def _hier_pool_get(self):
+        """Lazy bounded thread pool for the as-ready bucket pulls; pool
+        threads keep their own sockets (a dedicated connection per
+        (thread, server), heartbeat-style) so concurrent bucket RPCs
+        never contend on the main _lock-serialized connections."""
+        if getattr(self, "_hier_pool", None) is None:
+            import concurrent.futures as _fut
+            import threading as _threading
+            from ..base import get_env
+            n = max(1, get_env("MX_EXCHANGE_PARALLEL", 4, int) or 4)
+            self._hier_pool = _fut.ThreadPoolExecutor(
+                max_workers=n, thread_name_prefix="mx-kv-exchange")
+            self._hier_tls = _threading.local()
+        return self._hier_pool
+
+    def _rpc_dedicated(self, idx, msg):
+        """One SEQ-enveloped RPC on this pool thread's OWN connection to
+        server ``idx``, retried under the same RetryPolicy as the main
+        path.  The envelope's client id carries a per-thread suffix —
+        the rank prefix (liveness) is preserved, but each thread gets
+        its own replay slot, so concurrent in-flight sequence numbers
+        can never clobber one another's exactly-once entry."""
+        import socket as _socket
+        import threading as _threading
+        tls = self._hier_tls
+        if not hasattr(tls, "socks"):
+            tls.socks = {}
+        cid = "%s#x%d" % (self._client_id, _threading.get_ident())
+        seq = self._next_seq()
+        wrapped = ("SEQ", cid, seq, msg)
+        timeout = self._recv_timeout(msg[0])
+        policy = self._retry_policy()
+        for _attempt in policy:
+            sock = tls.socks.get(idx)
+            try:
+                if sock is None:
+                    host, port = self._addrs[idx].rsplit(":", 1)
+                    sock = _socket.create_connection(
+                        (host, int(port)), timeout=5)
+                    sock.settimeout(120)
+                    tls.socks[idx] = sock
+                self._srv_mod.send_msg(sock, wrapped)
+                ok, payload = self._srv_mod.recv_msg(sock, timeout=timeout)
+            except (ConnectionError, OSError, TimeoutError) as e:
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                tls.socks[idx] = None
+                policy.note(e)
+                continue
+            if not ok:
+                raise MXNetError("dist_async server %d: %s"
+                                 % (idx, payload))
+            return payload
+        raise MXNetError(
+            "dist_async server %d (%s) unreachable: %r retried for %.3gs "
+            "(MX_KVSTORE_RETRY_DEADLINE exceeded); last error: %s"
+            % (idx, self._addrs[idx], msg[0], policy.deadline,
+               policy.last_error))
+
+    def _hier_bucket_pull(self, name):
+        """One bucket's cross-slice return leg on a pool thread: PULLQ
+        (int8, ~4x fewer wire bytes), decoded host-side."""
+        from . import wire_codec as _wc
+        gc = self._wire_gc()
+        block = gc.block if gc is not None and \
+            getattr(gc, "type", None) == "int8" else 256
+        payload = self._rpc_dedicated(self._server_of(name),
+                                      ("PULLQ", name, int(block)))
+        if _wc.is_wire_payload(payload):
+            scales = _np.asarray(payload[6])
+            self._count_pull_bytes(len(payload[5]) + scales.nbytes)
+            return _wc.decode_wire(payload)
+        arr = _np.asarray(payload)
+        self._count_pull_bytes(arr.nbytes)
+        return arr
+
     def _pull_np(self, k, shape, size):
         import numpy as _onp
         plan = self._shard_plan(size)
         if plan is None:
-            return self._rpc("PULL", k)
+            if self._hier:
+                return self._pull_hier(k)
+            arr = self._rpc("PULL", k)
+            self._count_pull_bytes(_np.asarray(arr).nbytes)
+            return arr
         # pipeline: issue every part request on its own socket FIRST,
         # then collect replies — wall-clock ~max(parts), not sum(parts)
         # (the concurrency is the point of big-array sharding).  PULL is
@@ -1436,21 +1575,59 @@ class KVStoreDistAsync(KVStore):
         """Route by key for data commands; controller commands go wider
         (SET_OPT to every server, BARRIER to server 0)."""
         cmd = msg[0]
-        if cmd in ("INIT", "PUSH", "PULL"):
+        if cmd in ("INIT", "PUSH", "PULL", "PULLQ"):
             return self._rpc_on(self._server_of(msg[1]), *msg)
-        if cmd in ("SET_OPT", "STOP"):
+        if cmd in ("SET_OPT", "STOP", "JOIN", "LEAVE"):
             # controller fan-out: every server installs the optimizer /
-            # shuts down (a STOP reaching only server 0 would leak the
-            # rest as live processes on manual multi-host deployments)
+            # shuts down / applies the membership change (the barrier
+            # quorum lives on server 0, but each shard server sizes its
+            # own liveness table too; a STOP or LEAVE reaching only
+            # server 0 would leak the rest)
             out = None
             for i in range(len(self._socks)):
                 try:
                     out = self._rpc_on(i, *msg)
                 except MXNetError:
-                    if cmd != "STOP":   # STOP is best-effort per server
+                    if cmd not in ("STOP", "LEAVE"):
+                        # STOP/LEAVE are best-effort per server: on the
+                        # way OUT, a server that is already gone is fine
                         raise
             return out
-        return self._rpc_on(0, *msg)        # BARRIER
+        return self._rpc_on(0, *msg)        # BARRIER, MEMBERS
+
+    # -- elastic membership (ISSUE 16) --------------------------------------
+    def join(self):
+        """Announce this worker's rank to every server's live membership
+        table.  Idempotent: a rank the server already counts is a no-op
+        (no epoch bump), so fixed-size jobs can send it unconditionally.
+        Returns ``(epoch, members)`` as the last server reported."""
+        payload = self._rpc("JOIN", self._client_id)
+        epoch, members = payload
+        self._membership_epoch = max(self._membership_epoch, int(epoch))
+        return int(epoch), list(members)
+
+    def leave(self):
+        """Voluntarily retire this worker's rank from the quorum (the
+        preemption-drain path: the supervisor's SIGTERM gives notice, the
+        fit loop checkpoints at the epoch boundary, then leaves).  Best-
+        effort per server — on the way out a dead server is fine."""
+        payload = self._rpc("LEAVE", self._client_id)
+        if payload is not None:
+            self._membership_epoch = max(self._membership_epoch,
+                                         int(payload[0]))
+        return payload
+
+    def members(self):
+        """``(epoch, [ranks])`` of server 0's live membership table (the
+        barrier quorum lives there, same as BARRIER routing)."""
+        epoch, members = self._rpc("MEMBERS")
+        return int(epoch), list(members)
+
+    @property
+    def membership_epoch(self) -> int:
+        """The membership epoch this store incarnation is salted under
+        (MX_ELASTIC_EPOCH at init, raised by observed JOIN replies)."""
+        return self._membership_epoch
 
     def metrics(self, fmt: str = "json"):
         """Per-server telemetry scrape over the METRICS wire verb
@@ -1558,6 +1735,18 @@ class KVStoreDistAsync(KVStore):
         for p in solo:
             self._push_payload(keys[p], merged[p])
 
+    @staticmethod
+    def _commit_bucket(b, flat, target_lists):
+        """Scatter one pulled bucket to its member targets, homing each
+        piece on the TARGET's device — a default-ctx array labeled with
+        t's context would feed mixed-device operands into later jits."""
+        flat = _np.asarray(flat).ravel()
+        for p, off, size, shape in b.slices():
+            piece = flat[off:off + size].reshape(shape)
+            for t in target_lists[p]:
+                t._set_jax(nd.array(piece, ctx=t.context)
+                           .astype(t.dtype)._jax)
+
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs = self._normalize(key, out)
         target_lists = [o if isinstance(o, (list, tuple)) else [o]
@@ -1572,24 +1761,38 @@ class KVStoreDistAsync(KVStore):
             # function of the signature)
             buckets, solo = self._bucket_plans(keys, firsts)
         solo = list(solo)
-        for b in buckets:
-            try:
-                flat = self._pull_np(b.name, (b.total,), b.total)
-            except MXNetError:
-                # bucket absent server-side (nothing pushed this layout
-                # yet — e.g. pulling broadcast weights): per-key fallback
-                # for exactly this bucket's members, never silent staleness
-                solo.extend(b.positions)
-                continue
-            flat = _np.asarray(flat).ravel()
-            for p, off, size, shape in b.slices():
-                piece = flat[off:off + size].reshape(shape)
-                for t in target_lists[p]:
-                    # home the pulled value on the TARGET's device — a
-                    # default-ctx array labeled with t's context would
-                    # feed mixed-device operands into later jits
-                    t._set_jax(nd.array(piece, ctx=t.context)
-                               .astype(t.dtype)._jax)
+        if self._hier and len(buckets) > 1:
+            # as-ready cross-slice tier (ISSUE 16): every bucket's PULLQ
+            # flies concurrently on its own connection and COMMITS the
+            # moment its reply lands — a straggling server shard (or
+            # slice behind it) delays only its own buckets, never the
+            # whole pull.  Commits happen on THIS thread (the
+            # as_completed loop), so target mutation stays single-
+            # threaded.
+            import concurrent.futures as _fut
+            ex = self._hier_pool_get()
+            futs = {ex.submit(self._hier_bucket_pull, b.name): b
+                    for b in buckets}
+            for f in _fut.as_completed(futs):
+                b = futs[f]
+                try:
+                    flat = f.result()
+                except MXNetError:
+                    solo.extend(b.positions)
+                    continue
+                self._commit_bucket(b, flat, target_lists)
+        else:
+            for b in buckets:
+                try:
+                    flat = self._pull_np(b.name, (b.total,), b.total)
+                except MXNetError:
+                    # bucket absent server-side (nothing pushed this
+                    # layout yet — e.g. pulling broadcast weights):
+                    # per-key fallback for exactly this bucket's
+                    # members, never silent staleness
+                    solo.extend(b.positions)
+                    continue
+                self._commit_bucket(b, flat, target_lists)
         for p in sorted(solo):
             arr = self._pull_np(keys[p], firsts[p].shape,
                                 int(firsts[p].size))
@@ -1637,11 +1840,17 @@ class KVStoreDistAsync(KVStore):
         self.close()
 
     def close(self):
-        """Stop the heartbeat thread and drop every connection."""
+        """Stop the heartbeat thread and drop every connection.  (A
+        voluntary departure calls :meth:`leave` FIRST — close alone
+        keeps the rank in the quorum, which is what a worker that will
+        be respawned under the same rank wants.)"""
         self._hb_stop.set()
         if self._hb_thread is not None:
             self._hb_thread.join(timeout=2)
             self._hb_thread = None
+        if getattr(self, "_hier_pool", None) is not None:
+            self._hier_pool.shutdown(wait=False)
+            self._hier_pool = None
         with self._lock:
             for i in range(len(self._socks)):
                 self._kill_sock(i)

@@ -16,10 +16,25 @@ reference, not part of its contract).
 
 Wire protocol: request = (cmd, key, payload...); response = (ok, payload).
 Commands: INIT (store if absent), PUSH (updater(key, grad, store) when an
-optimizer is installed, else accumulate-sum), PULL, SET_OPT (pickled
+optimizer is installed, else accumulate-sum), PULL, PULLQ (quantized
+pull — the hierarchical exchange's cross-slice tier), SET_OPT (pickled
 optimizer, the reference's set_optimizer controller message), BARRIER
 (explicit only — pushes NEVER barrier), PING (heartbeat; refreshes the
-sender's liveness), STOP.
+sender's liveness), JOIN/LEAVE/MEMBERS (elastic membership, below), STOP.
+
+Elastic membership (ISSUE 16): the barrier quorum is no longer the
+constructor's ``num_workers`` but a live membership TABLE seeded from it.
+JOIN adds the sender's rank, LEAVE removes it, and every mutation bumps a
+monotonic *membership epoch* — workers salt their fusion-bucket layout
+with the epoch they observed, so a resize rolls every bucket name and a
+stale accumulator from the pre-resize world can never be misread.
+Barrier arithmetic, liveness eviction and ``_effective_workers`` all read
+the live table; a barrier that opened under one epoch re-checks the
+current epoch before releasing (a JOIN/LEAVE racing a barrier can
+neither deadlock the waiters nor double-release).  With
+``MX_ELASTIC_EVICT_AFTER`` set, a member silent that long is evicted
+from the table outright (an involuntary LEAVE) instead of merely being
+discounted from one barrier.
 
 Fault tolerance (the ps-lite resender/heartbeat role, rebuilt here):
 
@@ -143,9 +158,8 @@ def _rank_of(client_id) -> str:
 # 'replayable' entries sit in the exactly-once replay set (_MUTATING)
 # and 'idempotent' ones do not, and that a named codec has an
 # encode_<name>/decode_<name> pair in kvstore/wire_codec.py.  Adding a
-# client verb (the elastic-membership JOIN/LEAVE of ROADMAP item 2)
-# without completing this row fails lint — half-wired protocols cannot
-# ship.
+# client verb without completing this row fails lint — half-wired
+# protocols cannot ship.
 WIRE_VERBS = {
     # mutating commands replay from the SEQ cache after a lost reply
     "INIT": {"semantics": "replayable", "codec": None},
@@ -153,8 +167,17 @@ WIRE_VERBS = {
     "SET_OPT": {"semantics": "replayable", "codec": None},
     # re-executing these on a retried envelope is harmless by design
     "PULL": {"semantics": "idempotent", "codec": None},
+    # quantized pull (ISSUE 16): the hierarchical exchange's cross-slice
+    # return leg — same read-only contract as PULL, ~4x fewer wire bytes
+    "PULLQ": {"semantics": "idempotent", "codec": "wire"},
     "BARRIER": {"semantics": "idempotent", "codec": None},
     "PING": {"semantics": "idempotent", "codec": None},
+    # elastic membership (ISSUE 16): JOIN of a present rank and LEAVE of
+    # an absent rank are designed no-ops (no epoch bump), so a retried
+    # envelope re-executes harmlessly — idempotent by construction
+    "JOIN": {"semantics": "idempotent", "codec": None},
+    "LEAVE": {"semantics": "idempotent", "codec": None},
+    "MEMBERS": {"semantics": "idempotent", "codec": None},
     # read-only telemetry scrape (ISSUE 12): the fleet collector reads
     # a PS's live instrument registry over the same wire the workers
     # use — no sidecar, no extra port.  telemetry.py imports no jax, so
@@ -176,6 +199,19 @@ class KVStoreServer:
         self._updater = None
         self._opt_blob = None
         self._num_workers = num_workers
+        # elastic membership (ISSUE 16): the LIVE quorum table, seeded
+        # from the constructor's num_workers in the rank naming
+        # _rank_of() produces.  Guarded by _barrier_cv (every mutation
+        # notifies the cv — a quorum change is exactly what a parked
+        # barrier waiter needs to re-check); _membership_epoch bumps
+        # monotonically on every table change.
+        self._members = set("r%d" % i for i in range(max(1, num_workers)))
+        self._membership_epoch = 0
+        # the epoch the in-progress barrier generation opened under —
+        # _try_release_barrier re-checks it so a membership change racing
+        # a barrier rebases the arrival count instead of deadlocking
+        # waiters or double-releasing (satellite of ISSUE 16)
+        self._barrier_open_epoch = 0
         self._barrier_count = 0
         self._barrier_gen = 0
         self._barrier_cv = threading.Condition()
@@ -230,14 +266,16 @@ class KVStoreServer:
                 self._seen_regime[rank] = _fault.is_virtual()
 
     def _effective_workers(self) -> int:
-        """Barrier quorum = configured workers minus evicted-stale ranks.
-        Ranks never heard from are NOT stale (they may still be starting),
-        and ranks parked INSIDE the barrier are alive by definition — a
-        waiting worker's own silence (e.g. heartbeats disabled) must
-        never evict it out of the barrier it is holding."""
+        """Barrier quorum = live membership table minus transiently-stale
+        member ranks.  Caller holds _barrier_cv (the table is guarded by
+        it).  Member ranks never heard from are NOT stale (they may still
+        be starting), and ranks parked INSIDE the barrier are alive by
+        definition — a waiting worker's own silence (e.g. heartbeats
+        disabled) must never evict it out of the barrier it is holding."""
+        base = max(1, len(self._members))
         stale = _env_timeout("MX_KVSTORE_STALE_TIMEOUT")
         if stale is None:
-            return self._num_workers
+            return base
         regime = _fault.is_virtual()
         horizon = _fault.now() - stale
         evicted = 0
@@ -248,9 +286,61 @@ class KVStoreServer:
                     # now — never evict on an apples-to-oranges compare
                     self._last_seen[r] = _fault.now()
                     self._seen_regime[r] = regime
-                elif t < horizon and r not in self._barrier_waiting:
+                elif t < horizon and r in self._members \
+                        and r not in self._barrier_waiting:
                     evicted += 1
-        return max(1, self._num_workers - evicted)
+        return max(1, base - evicted)
+
+    def _evict_departed(self) -> None:
+        """Permanent liveness eviction (ISSUE 16): with
+        ``MX_ELASTIC_EVICT_AFTER`` armed, a member rank silent that long
+        is removed from the membership TABLE itself — an involuntary
+        LEAVE on behalf of a worker that died without preemption notice
+        — so every later barrier sizes against the shrunken world
+        instead of re-discounting the ghost each time.  Caller holds
+        _barrier_cv.  Unset/0 keeps today's transient-only discounting."""
+        evict_after = _env_timeout("MX_ELASTIC_EVICT_AFTER")
+        if evict_after is None:
+            return
+        regime = _fault.is_virtual()
+        horizon = _fault.now() - evict_after
+        gone = []
+        with self._seen_lock:
+            for r in list(self._members):
+                t = self._last_seen.get(r)
+                if t is None or r in self._barrier_waiting:
+                    continue        # never heard from, or provably alive
+                if self._seen_regime.get(r, regime) != regime:
+                    continue        # cross-clock stamp: not comparable
+                if t < horizon:
+                    gone.append(r)
+                    self._last_seen.pop(r, None)
+                    self._seen_regime.pop(r, None)
+        if gone:
+            for r in gone:
+                self._members.discard(r)
+            self._membership_epoch += 1
+            self._note_membership_change("evict", gone)
+
+    def _note_membership_change(self, what: str, ranks) -> None:
+        """Telemetry + log for one membership-table mutation (safe to
+        call with _barrier_cv held — counter/gauge updates only)."""
+        from .. import telemetry as _telemetry
+        _telemetry.registry.counter(
+            "kvstore.membership_%ss" % what,
+            doc="elastic membership %s events applied to the live "
+                "table" % what).inc(len(ranks) if not
+                                    isinstance(ranks, str) else 1)
+        _telemetry.registry.gauge(
+            "kvstore.membership_epoch",
+            doc="monotonic membership epoch — bumps on every JOIN/"
+                "LEAVE/evict").set(self._membership_epoch)
+        _telemetry.registry.gauge(
+            "kvstore.members",
+            doc="live membership table size").set(len(self._members))
+        print("kvstore server: membership %s %s -> epoch %d, %d member(s)"
+              % (what, list(ranks), self._membership_epoch,
+                 len(self._members)), file=sys.stderr)
 
     # -- durability ---------------------------------------------------------
     def _load_snapshot(self) -> None:
@@ -270,6 +360,12 @@ class KVStoreServer:
             done = threading.Event()
             done.set()
             self._replay[cid] = [seq, done, resp]
+        # a restarted server resumes the RESIZED world, not the seeded
+        # one — membership survives with the store it sized
+        if blob.get("members"):
+            self._members = set(blob["members"])
+            self._membership_epoch = int(blob.get("membership_epoch", 0))
+            self._barrier_open_epoch = self._membership_epoch
 
     def snapshot(self) -> None:
         """Atomically persist store + optimizer (write sibling, rename).
@@ -278,6 +374,9 @@ class KVStoreServer:
         path = self._snapshot_path
         if not path:
             return
+        with self._barrier_cv:      # taken ALONE (before any data lock)
+            members = sorted(self._members)
+            membership_epoch = self._membership_epoch
         with self._snapshot_lock:
             with self._global_lock:
                 locks = list(self._locks.values())
@@ -312,6 +411,8 @@ class KVStoreServer:
                         "opt_states": (updater.inner.get_states(False)
                                        if updater is not None
                                        else None),
+                        "members": members,
+                        "membership_epoch": membership_epoch,
                         "replay": replay}
             finally:
                 for lk in acquired:
@@ -380,8 +481,10 @@ class KVStoreServer:
         """SEQ-enveloped dispatch under the caller's server span.
         METRICS joins the PULL/PING cache bypass: it is read-only by
         contract, and caching a whole registry exposition per scrape
-        would bloat the replay cache for nothing."""
-        if cmd in ("PULL", "PING", "METRICS"):
+        would bloat the replay cache for nothing.  PULLQ and MEMBERS
+        bypass for the same read-only reason — and PULLQ replies are
+        parameter-sized, exactly what the cache must stay free of."""
+        if cmd in ("PULL", "PULLQ", "PING", "METRICS", "MEMBERS"):
             return self.handle(inner, client_id=cid)
         with self._replay_lock:
             ent = self._replay.get(cid)
@@ -479,6 +582,27 @@ class KVStoreServer:
                 if stored is None:
                     return False, "key %r not initialized" % (key,)
                 return True, _np.array(stored, copy=True)
+        if cmd == "PULLQ":
+            # hierarchical exchange, cross-slice return leg (ISSUE 16):
+            # the merged value goes back per-block int8-quantized — ~4x
+            # fewer wire bytes than the fp32 PULL.  Stateless encode (no
+            # residual on the server), so this is the opt-in tier of
+            # MX_EXCHANGE_HIERARCHICAL, never the default pull.  Non-
+            # float keys fall back to the full-width PULL reply.
+            from .wire_codec import encode_wire, quantize_int8_np
+            key = msg[1]
+            block = 256
+            if len(msg) > 2 and msg[2]:
+                block = int(msg[2])
+            with self._lock_of(key):
+                stored = self._store.get(key)
+                if stored is None:
+                    return False, "key %r not initialized" % (key,)
+                if stored.dtype.kind != "f":
+                    return True, _np.array(stored, copy=True)
+                q, scales = quantize_int8_np(stored.reshape(-1), block)
+                return True, encode_wire("int8", stored.shape,
+                                         stored.dtype, (q, scales))
         if cmd == "SET_OPT":
             _, blob = msg
             with self._global_lock:
@@ -510,6 +634,60 @@ class KVStoreServer:
             text = reg.to_json(indent=1) if fmt == "json" \
                 else reg.to_prometheus()
             return True, encode_text(text)
+        if cmd == "JOIN":
+            # elastic membership (ISSUE 16): admit the sender's rank to
+            # the live quorum.  A JOIN of a rank already present is a
+            # no-op (no epoch bump) — that is what makes the verb
+            # idempotent under SEQ retry, and what lets every worker of
+            # a FIXED job send JOIN at init unconditionally.
+            _fault.fire("kvstore.membership")
+            who = msg[1] if len(msg) > 1 and msg[1] is not None \
+                else client_id
+            rank = _rank_of(who) if who is not None else None
+            changed = False
+            with self._barrier_cv:
+                if rank is not None and rank not in self._members:
+                    self._members.add(rank)
+                    self._membership_epoch += 1
+                    changed = True
+                    self._note_membership_change("join", [rank])
+                    self._barrier_cv.notify_all()
+                epoch = self._membership_epoch
+                members = sorted(self._members)
+            self.touch(who)
+            if changed:
+                self.snapshot()
+            return True, (epoch, members)
+        if cmd == "LEAVE":
+            # voluntary departure (preemption drain, supervisor shrink):
+            # drop the rank from the quorum NOW so no barrier ever waits
+            # on it, and clear its liveness stamp so it cannot read as a
+            # stale ghost.  LEAVE of an absent rank is a no-op.
+            _fault.fire("kvstore.membership")
+            who = msg[1] if len(msg) > 1 and msg[1] is not None \
+                else client_id
+            rank = _rank_of(who) if who is not None else None
+            changed = False
+            with self._barrier_cv:
+                if rank is not None and rank in self._members:
+                    self._members.discard(rank)
+                    self._membership_epoch += 1
+                    changed = True
+                    with self._seen_lock:   # cv -> seen: documented order
+                        self._last_seen.pop(rank, None)
+                        self._seen_regime.pop(rank, None)
+                    self._note_membership_change("leave", [rank])
+                    # the quorum shrank: parked waiters may now release
+                    self._barrier_cv.notify_all()
+                epoch = self._membership_epoch
+                members = sorted(self._members)
+            if changed:
+                self.snapshot()
+            return True, (epoch, members)
+        if cmd == "MEMBERS":
+            with self._barrier_cv:
+                return True, (self._membership_epoch,
+                              sorted(self._members))
         if cmd == "BARRIER":
             return self._handle_barrier(client_id)
         if cmd == "STOP":
@@ -532,6 +710,12 @@ class KVStoreServer:
         rank = _rank_of(client_id) if client_id is not None else None
         with self._barrier_cv:
             gen = self._barrier_gen
+            if self._barrier_count == 0:
+                # first arrival OPENS this barrier generation: stamp the
+                # membership epoch it sized against — release re-checks
+                # the stamp (ISSUE 16 satellite) so a racing JOIN/LEAVE
+                # rebases the count instead of deadlocking/double-firing
+                self._barrier_open_epoch = self._membership_epoch
             self._barrier_count += 1
             if rank is not None:
                 self._barrier_waiting[rank] = \
@@ -550,7 +734,8 @@ class KVStoreServer:
                                                   self._barrier_count - 1)
                         return False, ("barrier timed out after %.3gs "
                                        "waiting for %d workers (%d arrived)"
-                                       % (timeout, self._num_workers,
+                                       % (timeout,
+                                          self._effective_workers(),
                                           self._barrier_count + 1))
                     tick = min(poll, remaining)
                     if _fault.is_virtual():
@@ -587,7 +772,23 @@ class KVStoreServer:
         return True, None
 
     def _try_release_barrier(self) -> bool:
-        """Caller holds _barrier_cv.  Release if every live worker is in."""
+        """Caller holds _barrier_cv.  Release if every live worker is in.
+
+        Membership re-check (ISSUE 16 satellite): if the membership
+        epoch moved since this barrier generation opened, the arrival
+        count is REBASED to the parked waiters that are still members —
+        a departed rank's ghost arrival can no longer inflate the count
+        into a double-release, and a JOIN that grew the quorum mid-wait
+        is sized against honestly instead of deadlocking the waiters on
+        an arithmetic carried over from the old world.  (Anonymous
+        arrivals — client_id=None, rank untracked — are only countable
+        pre-rebase; elastic callers always identify themselves.)"""
+        self._evict_departed()
+        if self._membership_epoch != self._barrier_open_epoch:
+            self._barrier_count = sum(
+                n for r, n in self._barrier_waiting.items()
+                if r in self._members)
+            self._barrier_open_epoch = self._membership_epoch
         if self._barrier_count >= self._effective_workers():
             self._barrier_count = 0
             self._barrier_gen += 1

@@ -28,7 +28,7 @@ from __future__ import annotations
 import numpy as _np
 
 __all__ = ["is_wire_payload", "encode_wire", "decode_wire",
-           "pack_2bit", "unpack_2bit",
+           "pack_2bit", "unpack_2bit", "quantize_int8_np",
            "is_array_payload", "encode_array", "decode_array",
            "is_text_payload", "encode_text", "decode_text",
            "is_json_payload", "encode_json", "decode_json"]
@@ -152,6 +152,28 @@ def decode_wire(obj) -> _np.ndarray:
     else:
         raise ValueError("unknown gradient wire mode %r" % (mode,))
     return flat.astype(_np.dtype(dtype)).reshape(shape)
+
+
+def quantize_int8_np(flat, block: int = 256):
+    """Per-block symmetric int8 quantization of a flat float array — the
+    numpy mirror of ``ops.quantization.quantize_int8_blocks``, minus
+    error feedback (the server-side PULLQ encode is stateless: the pull
+    leg's quantization error is NOT fed back anywhere, which is why the
+    quantized pull is an opt-in hierarchical-exchange tier, not the
+    default PULL).  Returns ``(q_int8, scales_f32)`` with the tail block
+    zero-padded; :func:`decode_wire` trims the pad via the element count
+    carried in the tuple."""
+    flat = _np.asarray(flat, _np.float32).ravel()
+    block = max(1, int(block))
+    pad = (-flat.size) % block
+    if pad:
+        flat = _np.concatenate([flat, _np.zeros(pad, _np.float32)])
+    blocks = flat.reshape(-1, block)
+    scales = (_np.abs(blocks).max(axis=1) / 127.0).astype(_np.float32)
+    safe = _np.where(scales > 0, scales, 1.0).astype(_np.float32)
+    q = _np.clip(_np.rint(blocks / safe[:, None]),
+                 -127, 127).astype(_np.int8)
+    return q.reshape(-1), scales
 
 
 def pack_2bit(levels: _np.ndarray, threshold: float) -> _np.ndarray:

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 
 from .. import fault as _fault
 from .. import telemetry as _telemetry
-from ..base import MXNetError
+from ..base import MXNetError, get_env
 from ..device import Context, cpu, current_context
 from .. import initializer as init_mod
 from .. import metric as metric_mod
@@ -341,6 +341,29 @@ class BaseModule:
 
         from ..health import StepGuard
         guard = StepGuard.from_env(logger=self.logger)
+        # elastic drain (ISSUE 16): under MX_ELASTIC the supervisor's
+        # resize path SIGTERMs every worker — the handler only sets a
+        # flag, and the epoch loop quiesces at its next epoch BOUNDARY
+        # (checkpoint + optimizer sidecar saved, then exit 0) so the
+        # respawned world resumes the exact trajectory.  The rank does
+        # NOT send LEAVE here: a drained rank usually comes straight
+        # back under the same rank id (restart or resize survivor), and
+        # membership departure is the supervisor's call — it LEAVEs
+        # only the ranks the new world size actually removed.
+        drain_flag = None
+        drain_armed = False
+        prev_sigterm = None
+        if get_env("MX_ELASTIC", 0, int):
+            import signal as _signal
+            import threading as _threading
+            if _threading.current_thread() is _threading.main_thread():
+                drain_flag = _threading.Event()
+
+                def _on_sigterm(signum, frame):
+                    drain_flag.set()
+                prev_sigterm = _signal.signal(_signal.SIGTERM,
+                                              _on_sigterm)
+                drain_armed = True
         try:
             self._fit_epochs(
                 train_data, eval_data, eval_metric, validation_metric,
@@ -350,15 +373,22 @@ class BaseModule:
                 batch_end_callback=batch_end_callback,
                 epoch_end_callback=epoch_end_callback,
                 eval_end_callback=eval_end_callback,
-                eval_batch_end_callback=eval_batch_end_callback)
+                eval_batch_end_callback=eval_batch_end_callback,
+                drain_flag=drain_flag)
         except BaseException as e:
             # flight recorder (ISSUE 8): a fit loop dying for ANY reason
-            # — injected crash (SystemExit), NaN raise, OOM, data error —
-            # leaves its last MX_TELEMETRY_RING step records in
-            # MX_CRASH_DIR before the exception propagates
-            _telemetry.dump_crash("fit: %r" % (e,))
+            # — injected crash (nonzero SystemExit), NaN raise, OOM,
+            # data error — leaves its last MX_TELEMETRY_RING step
+            # records in MX_CRASH_DIR before the exception propagates.
+            # SystemExit(0) is the elastic drain's clean quiesce, not a
+            # death — no crash record for it.
+            if not (isinstance(e, SystemExit) and not e.code):
+                _telemetry.dump_crash("fit: %r" % (e,))
             raise
         finally:
+            if drain_armed:
+                import signal as _signal
+                _signal.signal(_signal.SIGTERM, prev_sigterm)
             if guard.skipped_batches:
                 self.logger.warning(
                     "fit: skipped %d poisoned batch update(s) "
@@ -370,7 +400,7 @@ class BaseModule:
                     monitor, guard, ckpt_mgr, checkpoint_dir,
                     checkpoint_period, batch_end_callback,
                     epoch_end_callback, eval_end_callback,
-                    eval_batch_end_callback):
+                    eval_batch_end_callback, drain_flag=None):
         from ..step import step_compile_enabled
         # whole-step compiled lane (ISSUE 7): fwd+bwd+fused update+
         # metric accumulate in ONE donated jit per batch.  The eager body
@@ -431,8 +461,13 @@ class BaseModule:
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             # chaos hook: tests kill the loop here to exercise resume
             _fault.fire("module.fit.epoch")
+            # elastic drain: a SIGTERM seen mid-epoch quiesces HERE —
+            # the epoch boundary — and forces a checkpoint regardless
+            # of checkpoint_period, so the resized world loses nothing
+            draining = drain_flag is not None and drain_flag.is_set()
             if ckpt_mgr is not None and (
-                    (epoch + 1) % max(1, checkpoint_period) == 0
+                    draining
+                    or (epoch + 1) % max(1, checkpoint_period) == 0
                     or epoch == num_epoch - 1):
                 arg, aux = self.get_params()
                 ckpt_mgr.save(epoch,
@@ -442,6 +477,12 @@ class BaseModule:
                     _write_opt_states(checkpoint_dir, epoch,
                                       self._updater.get_states(False),
                                       keep=ckpt_mgr.all_steps())
+            if draining:
+                self.logger.info(
+                    "fit: elastic drain - checkpointed epoch %d, "
+                    "exiting 0 for the supervisor to resize/respawn",
+                    epoch)
+                raise SystemExit(0)
             if epoch_end_callback is not None:
                 arg, aux = self.get_params()
                 for cb in _as_list(epoch_end_callback):

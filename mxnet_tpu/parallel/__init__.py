@@ -9,7 +9,7 @@ DCN across slices.
 from .mesh import (make_mesh, replicated, batch_sharded, shard_params_tp,
                    TrainStep, init_process_group)
 from .speclayout import (SpecLayout, shard_params, tp_alternation_specs,
-                         layout_from_env, mesh_from_env)
+                         layout_from_env, mesh_from_env, mesh_for_world)
 from .ring import (ring_attention, ulysses_attention,
                    context_parallel_attention)
 from .pipeline import pipeline_apply, pipeline_parallel
@@ -17,7 +17,7 @@ from .moe import moe_apply, moe_parallel, top1_dispatch
 
 __all__ = ["make_mesh", "replicated", "batch_sharded", "shard_params_tp",
            "SpecLayout", "shard_params", "tp_alternation_specs",
-           "layout_from_env", "mesh_from_env",
+           "layout_from_env", "mesh_from_env", "mesh_for_world",
            "TrainStep", "init_process_group", "ring_attention",
            "ulysses_attention", "context_parallel_attention",
            "pipeline_apply", "pipeline_parallel", "moe_apply",

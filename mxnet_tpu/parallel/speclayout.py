@@ -52,7 +52,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["SpecLayout", "tp_alternation_specs", "shard_params",
            "shard_params_tp", "place_value", "layout_from_env",
-           "mesh_from_env", "parse_mesh_axes"]
+           "mesh_from_env", "mesh_for_world", "parse_mesh_axes"]
 
 # block-class-name -> {param attr name: kind}; the kind defaults of
 # resolution step 3.  Extended here rather than monkey-patched so the
@@ -460,6 +460,57 @@ def mesh_from_env(devices=None) -> Optional[Mesh]:
         axes_text = "data,fsdp"
     from .mesh import make_mesh
     axes, sizes = parse_mesh_axes(axes_text, fsdp_n)
+    return make_mesh(axes=axes, shape=sizes, devices=devices)
+
+
+def mesh_for_world(world: int, devices=None) -> Mesh:
+    """Mesh for an elastic incarnation with ``world`` data-parallel
+    participants (ISSUE 16 resize glue): the env-described axes
+    (MX_MESH_AXES/MX_FSDP, default plain ``data``) with the data axis
+    forced to ``world``.  Model axes keep their configured sizes while
+    the mesh still fits the visible devices; an axis that no longer
+    fits degrades to 1 — it drops out of every spec — rather than
+    failing the resize.  Pairs with
+    ``checkpoint.resume_or_init(mesh=mesh_for_world(n))``: the saved
+    per-leaf spec sidecar re-shards the old world's state onto this
+    mesh by axis NAME, whatever size the old world was."""
+    world = int(world)
+    if world < 1:
+        raise ValueError("mesh_for_world needs world >= 1, got %d"
+                         % world)
+    if devices is None:
+        devices = jax.devices()
+    from ..base import get_env
+    fsdp = get_env("MX_FSDP")
+    try:
+        fsdp_n = int(fsdp) if fsdp else None
+    except ValueError:
+        fsdp_n = None
+    axes_text = get_env("MX_MESH_AXES")
+    if not axes_text:
+        axes_text = "data,fsdp" if fsdp_n and fsdp_n > 1 else "data"
+    axes, sizes = parse_mesh_axes(axes_text, fsdp_n)
+    sizes = list(sizes)
+    di = next((i for i, a in enumerate(axes)
+               if a in ("data", "dp", "batch")), 0)
+    sizes[di] = world
+
+    def _prod(xs):
+        p = 1
+        for x in xs:
+            p *= max(1, int(x))
+        return p
+    # degrade model axes innermost-first until the mesh fits
+    for i in range(len(sizes) - 1, -1, -1):
+        if _prod(sizes) <= len(devices):
+            break
+        if i != di:
+            sizes[i] = 1
+    if _prod(sizes) > len(devices):
+        raise ValueError(
+            "mesh_for_world: world %d needs %d devices, only %d visible"
+            % (world, _prod(sizes), len(devices)))
+    from .mesh import make_mesh
     return make_mesh(axes=axes, shape=sizes, devices=devices)
 
 

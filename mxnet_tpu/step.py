@@ -1075,9 +1075,17 @@ _declare_step_contracts()
 # signatures instead of retracing at runtime.
 # ---------------------------------------------------------------------------
 
-# mesh classes the sharded lane contracts: label -> (axes, shape)
+# mesh classes the sharded lane contracts: label -> (axes, shape).
+# The dp2/dp3/dp4 rows are elastic-resize coverage (ISSUE 16): every
+# data-parallel world size a mid-job resize can land on (within the
+# 8-device contract mesh) gets its own declared signature, so a job
+# that shrinks 4->3 or grows 2->4 dispatches onto a contracted program
+# instead of retracing where the closure proof promised none.
 _SHARD_MESH_CLASSES = (
     ("dp", ("data",), (8,)),
+    ("dp2", ("data",), (2,)),
+    ("dp3", ("data",), (3,)),
+    ("dp4", ("data",), (4,)),
     ("dp_fsdp", ("data", "fsdp"), (4, 2)),
     ("dp_fsdp_tp", ("data", "fsdp", "tp"), (2, 2, 2)),
 )
@@ -1182,8 +1190,10 @@ def _declare_sharded_step_contracts():
         description="SpecLayout sharded step programs: the same six "
                     "donated state groups as step.train, sheet-/tensor-"
                     "sharded over the mesh; donation aliasing must "
-                    "survive sharding, and the {dp, dp×fsdp, "
-                    "dp×fsdp×tp} mesh classes are trace-closed")
+                    "survive sharding, and the {dp, dp2, dp3, dp4, "
+                    "dp×fsdp, dp×fsdp×tp} mesh classes — including "
+                    "every data-parallel size an elastic resize can "
+                    "reach — are trace-closed")
 
 
 _declare_sharded_step_contracts()

@@ -1,0 +1,558 @@
+"""Elastic membership (ISSUE 16): resize a running job without losing it.
+
+Chaos-marked.  The ladder, bottom-up:
+
+  * KVStoreServer membership table: JOIN/LEAVE/MEMBERS epoch arithmetic,
+    idempotency under SEQ retry, snapshot durability across a server
+    restart
+  * satellite 2 regression: a barrier parked against the OLD world is
+    released (rebased, not double-fired) when a concurrent LEAVE or an
+    MX_ELASTIC_EVICT_AFTER liveness eviction moves the membership epoch
+    mid-wait — the eviction variant runs on the virtual clock, zero
+    real waiting
+  * PULLQ: the promoted cross-slice return leg ships the int8 wire
+    tuple — decodes within quantization tolerance at a fraction of the
+    fp32 bytes
+  * launch.Supervisor elastic units (framework-free scripts,
+    milliseconds each): budget-exhausted worker -> shrink-and-continue
+    instead of whole-job teardown, LEAVE-on-behalf reaches a live
+    parameter server, resize-file grow/shrink respawns the worker set
+    under a bumped generation, a stale resize target is never re-applied
+  * end-to-end through the CLI (slow): `launch.py --elastic
+    --resize-file` grows 2->4 and shrinks 4->3 mid-fit and the final
+    params match an uninterrupted run; a rank SIGKILLed past its restart
+    budget shrinks the job instead of failing it
+"""
+import importlib.util
+import os
+import signal
+import socket
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx                                      # noqa: F401
+from mxnet_tpu import fault
+from mxnet_tpu.kvstore.server import (KVStoreServer, recv_msg, send_msg,
+                                      serve_forever)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fault.clear()
+    yield
+    fault.clear()
+
+
+def _load_launch():
+    spec = importlib.util.spec_from_file_location(
+        "mx_launch_elastic_under_test",
+        os.path.join(REPO, "tools", "launch.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+launch = _load_launch()
+
+
+def _no_jitter_backoff(base=0.01):
+    return fault.RetryPolicy(deadline=float("inf"), base=base,
+                             max_delay=8.0, jitter=0.0)
+
+
+# ---------------------------------------------------------------------------
+# membership table: epochs, idempotency, durability
+# ---------------------------------------------------------------------------
+
+def test_join_leave_members_epoch_arithmetic():
+    srv = KVStoreServer(num_workers=2)        # seeds {r0, r1} at epoch 0
+    ok, (epoch, members) = srv.handle(("MEMBERS", None))
+    assert ok and epoch == 0 and members == ["r0", "r1"]
+
+    ok, (epoch, members) = srv.handle(("JOIN", "r2:boot"))
+    assert ok and epoch == 1 and members == ["r0", "r1", "r2"]
+
+    ok, (epoch, members) = srv.handle(("LEAVE", "r1:drain"))
+    assert ok and epoch == 2 and members == ["r0", "r2"]
+
+
+def test_join_and_leave_are_idempotent():
+    """JOIN of a present rank and LEAVE of an absent rank are no-ops
+    with NO epoch bump — that is the SEQ-retry safety contract, and it
+    lets every worker of a fixed-size job JOIN at init unconditionally."""
+    srv = KVStoreServer(num_workers=2)
+    ok, (e1, m1) = srv.handle(("JOIN", "r0:again"))     # already a member
+    assert ok and e1 == 0 and m1 == ["r0", "r1"]
+    ok, (e2, m2) = srv.handle(("LEAVE", "r7:ghost"))    # never a member
+    assert ok and e2 == 0 and m2 == ["r0", "r1"]
+    # real mutations still move the clock
+    srv.handle(("LEAVE", "r1:x"))
+    ok, (e3, _) = srv.handle(("LEAVE", "r1:x"))         # replayed LEAVE
+    assert ok and e3 == 1                               # bumped exactly once
+
+
+def test_membership_survives_snapshot_restart(tmp_path):
+    """The table and its epoch ride the snapshot: a restarted server
+    sizes barriers against the RESIZED world, not the constructor's."""
+    snap = str(tmp_path / "s.pkl")
+    srv = KVStoreServer(num_workers=2, snapshot_path=snap)
+    srv.handle(("JOIN", "r2:boot"))
+    srv.handle(("LEAVE", "r0:drain"))
+    srv2 = KVStoreServer(num_workers=2, snapshot_path=snap)   # restart
+    ok, (epoch, members) = srv2.handle(("MEMBERS", None))
+    assert ok and members == ["r1", "r2"]
+    assert epoch == 2                         # monotonic across restart
+
+
+# ---------------------------------------------------------------------------
+# satellite 2: barrier release re-checks the membership epoch
+# ---------------------------------------------------------------------------
+
+def _park_barrier(srv, cid, out):
+    def run():
+        out.append(srv.handle_request(("SEQ", cid, 1, ("BARRIER", None))))
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
+
+
+def test_barrier_parked_against_old_world_releases_on_leave(monkeypatch):
+    """r0 parks in a 2-member barrier; r1's LEAVE lands mid-wait.  The
+    release path must rebase the count against the CURRENT epoch and
+    free r0 — not strand it against arithmetic from the old world."""
+    monkeypatch.setenv("MX_KVSTORE_BARRIER_TIMEOUT", "20")
+    monkeypatch.delenv("MX_KVSTORE_STALE_TIMEOUT", raising=False)
+    srv = KVStoreServer(num_workers=2)
+    results = []
+    t = _park_barrier(srv, "r0:live", results)
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:        # wait until r0 is parked
+        with srv._barrier_cv:
+            if srv._barrier_waiting.get("r0"):
+                break
+        time.sleep(0.01)
+    t0 = time.monotonic()
+    ok, (epoch, members) = srv.handle(("LEAVE", "r1:drain"))
+    assert ok and members == ["r0"]
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert results and results[0][0] is True  # released, not timed out
+    assert time.monotonic() - t0 < 5.0        # nowhere near the 20s budget
+    # clean single fire: the next barrier in the 1-member world is
+    # immediate (no leftover count from a double release)
+    with srv._barrier_cv:
+        assert srv._barrier_count == 0
+    ok2, _ = srv.handle_request(("SEQ", "r0:live", 2, ("BARRIER", None)))
+    assert ok2
+
+
+def test_departed_ghost_arrival_cannot_double_release(monkeypatch):
+    """The rebase discounts a DEPARTED rank's parked arrival: after r1
+    arrives and then LEAVEs (preemption notice racing its own barrier),
+    the count must rebase to the surviving members' arrivals only."""
+    monkeypatch.setenv("MX_KVSTORE_BARRIER_TIMEOUT", "0.5")
+    srv = KVStoreServer(num_workers=3)        # r0, r1, r2
+    results = []
+    threads = [_park_barrier(srv, "r0:a", results),
+               _park_barrier(srv, "r1:b", results)]
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        with srv._barrier_cv:
+            if (srv._barrier_waiting.get("r0")
+                    and srv._barrier_waiting.get("r1")):
+                break
+        time.sleep(0.01)
+    # r1 departs while parked: quorum shrinks 3 -> 2, but r1's own
+    # arrival no longer counts — one live waiter (r0) of two members,
+    # so the barrier must NOT fire for r0 until r2 shows up or timeout
+    srv.handle(("LEAVE", "r1:gone"))
+    for t in threads:
+        t.join(timeout=10)
+    assert len(results) == 2
+    # r0 timed out (honest wait for r2 in the rebased 2-member world);
+    # r1 was freed by the generation it observed — either outcome for
+    # r1 is fine as long as r0 did not get a phantom release
+    r0_result = [r for r in results if r[0] is False]
+    assert r0_result, results
+    assert "timed out" in str(r0_result[0][1])
+
+
+def test_evict_after_shrinks_membership_on_virtual_clock(monkeypatch):
+    """MX_ELASTIC_EVICT_AFTER turns a long-silent member into an
+    involuntary LEAVE: the TABLE shrinks (epoch bump), the parked
+    survivor's barrier releases, and the ghost is gone from MEMBERS —
+    all on the virtual clock, zero real waiting."""
+    monkeypatch.setenv("MX_ELASTIC_EVICT_AFTER", "30")
+    monkeypatch.setenv("MX_KVSTORE_BARRIER_TIMEOUT", "300")
+    monkeypatch.delenv("MX_KVSTORE_STALE_TIMEOUT", raising=False)
+    with fault.use_virtual_time() as clk:
+        srv = KVStoreServer(num_workers=2)
+        srv.touch("r1:wedged")                # seen once...
+        clk.advance(31.0)                     # ...then silent too long
+        t0 = time.monotonic()
+        ok, _ = srv.handle_request(("SEQ", "r0:live", 1, ("BARRIER",
+                                                          None)))
+        assert ok
+        assert time.monotonic() - t0 < 10.0   # virtual, not the 300s
+        ok, (epoch, members) = srv.handle(("MEMBERS", None))
+        assert ok and members == ["r0"]       # permanent: table, not
+        assert epoch == 1                     # per-barrier discounting
+
+
+# ---------------------------------------------------------------------------
+# PULLQ: quantized cross-slice return leg
+# ---------------------------------------------------------------------------
+
+def test_pullq_decodes_within_tolerance_at_a_fraction_of_the_bytes():
+    from mxnet_tpu.kvstore import wire_codec as wc
+    srv = KVStoreServer(num_workers=1)
+    rng = np.random.RandomState(3)
+    value = rng.uniform(-1, 1, size=4096).astype(np.float32)
+    srv.handle(("INIT", "w", value))
+
+    ok, full = srv.handle(("PULL", "w"))
+    assert ok
+    ok, wire = srv.handle(("PULLQ", "w"))
+    assert ok and wc.is_wire_payload(wire)
+    decoded = wc.decode_wire(wire)
+    np.testing.assert_allclose(decoded, full, atol=0.02)   # int8 error
+
+    q_bytes = sum(np.asarray(p).nbytes for p in wire
+                  if isinstance(p, np.ndarray))
+    assert q_bytes < full.nbytes / 3.0        # the wire win is real
+
+
+def test_pullq_is_idempotent_and_bypasses_the_replay_cache():
+    """PULLQ rides the PULL bypass: replaying the same seq answers
+    fresh (no cache bloat, no stale-seq refusal for a read)."""
+    srv = KVStoreServer(num_workers=1)
+    srv.handle(("INIT", "w", np.ones(8, np.float32)))
+    ok1, w1 = srv.handle_request(("SEQ", "r0:x", 5, ("PULLQ", "w")))
+    ok2, w2 = srv.handle_request(("SEQ", "r0:x", 5, ("PULLQ", "w")))
+    assert ok1 and ok2
+    from mxnet_tpu.kvstore import wire_codec as wc
+    np.testing.assert_allclose(wc.decode_wire(w1), wc.decode_wire(w2))
+
+
+# ---------------------------------------------------------------------------
+# Supervisor elastic units (framework-free subprocess scripts)
+# ---------------------------------------------------------------------------
+
+def _worker_env(rank, **extra):
+    env = dict(os.environ)
+    env["MX_PROCESS_ID"] = str(rank)
+    env.update(extra)
+    return env
+
+
+def test_supervisor_elastic_shrinks_instead_of_tearing_down():
+    """A worker burning its restart budget under --elastic retires from
+    the job; the survivors run to completion and the job exits 0 (the
+    non-elastic contract — teardown with the failing rank's code — is
+    pinned by test_supervisor_budget_exhaustion_tears_down_whole_job)."""
+    sup = launch.Supervisor(restart="on-failure", max_restarts=0,
+                            backoff=_no_jitter_backoff(), elastic=True)
+    bad = sup.add("rank 0", [sys.executable, "-c", "import sys; sys.exit(5)"],
+                  _worker_env(0))
+    ok = sup.add("rank 1",
+                 [sys.executable, "-c",
+                  "import time; time.sleep(0.3); print('SURVIVOR_OK')"],
+                 _worker_env(1))
+    rc = sup.run()
+    assert rc == 0                            # shrink-and-continue
+    assert bad.rc == 5 and bad.done           # retired, rc not folded
+    assert ok.rc == 0
+
+
+def test_supervisor_elastic_sigkill_past_budget_shrinks():
+    """Satellite 3's involuntary-loss flavor: a rank killed by the OOM
+    reaper (real SIGKILL, rc -9) past its budget shrinks the job too."""
+    kill_me = "import os, signal; os.kill(os.getpid(), signal.SIGKILL)"
+    sup = launch.Supervisor(restart="on-failure", max_restarts=1,
+                            backoff=_no_jitter_backoff(), elastic=True)
+    bad = sup.add("rank 0", [sys.executable, "-c", kill_me],
+                  _worker_env(0))
+    sup.add("rank 1", [sys.executable, "-c", "import time; time.sleep(0.3)"],
+            _worker_env(1))
+    rc = sup.run()
+    assert rc == 0
+    assert bad.restarts == 1                  # budget honestly spent first
+    assert bad.rc == -signal.SIGKILL
+
+
+def test_supervisor_elastic_without_survivors_still_tears_down():
+    """Shrink-and-continue needs someone to continue: when the LAST
+    worker exhausts its budget the job fails loudly, elastic or not."""
+    sup = launch.Supervisor(restart="on-failure", max_restarts=0,
+                            backoff=_no_jitter_backoff(), elastic=True)
+    sup.add("rank 0", [sys.executable, "-c", "import sys; sys.exit(5)"],
+            _worker_env(0))
+    assert sup.run() == 5
+
+
+def _start_ps(num_workers):
+    port = launch._free_port()
+    t = threading.Thread(target=serve_forever,
+                         kwargs=dict(port=port, num_workers=num_workers),
+                         daemon=True)
+    t.start()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port),
+                                     timeout=0.2).close()
+            return port, t
+        except OSError:
+            time.sleep(0.05)
+    raise RuntimeError("server did not come up on %d" % port)
+
+
+def _ps_rpc(port, msg):
+    raw = socket.create_connection(("127.0.0.1", port), timeout=5)
+    try:
+        send_msg(raw, msg)
+        return recv_msg(raw, timeout=5)
+    finally:
+        raw.close()
+
+
+def test_supervisor_sends_leave_on_behalf_of_the_dead_rank():
+    """The shrink must reach the parameter server: the retired rank is
+    LEAVEd out of the membership table so no barrier ever waits on it."""
+    port, thread = _start_ps(num_workers=2)
+    try:
+        sup = launch.Supervisor(restart="on-failure", max_restarts=0,
+                                backoff=_no_jitter_backoff(), elastic=True)
+        sup.ps_addrs = ["127.0.0.1:%d" % port]
+        sup.add("rank 1", [sys.executable, "-c", "import sys; sys.exit(3)"],
+                _worker_env(1))
+        sup.add("rank 0",
+                [sys.executable, "-c", "import time; time.sleep(0.4)"],
+                _worker_env(0))
+        assert sup.run() == 0
+        ok, (epoch, members) = _ps_rpc(port, ("MEMBERS", None))
+        assert ok and members == ["r0"]       # r1 LEAVEd on its behalf
+        assert epoch == 1
+    finally:
+        _ps_rpc(port, ("STOP", None))
+        thread.join(timeout=10)
+
+
+_RESIZE_WORKER = textwrap.dedent("""
+    import os, time
+    open(os.environ["MX_DONE_DIR"] + "/done.%s.gen%s" % (
+        os.environ["MX_PROCESS_ID"],
+        os.environ.get("MX_ELASTIC_EPOCH", "?")), "w").close()
+    time.sleep(float(os.environ.get("MX_LINGER", "0")))
+""")
+
+
+def _resize_factory(tmp_path, linger="0"):
+    def make_worker(rank, n, generation):
+        env = _worker_env(rank, MX_DONE_DIR=str(tmp_path),
+                          MX_LINGER=linger,
+                          MX_ELASTIC="1",
+                          MX_ELASTIC_EPOCH=str(generation))
+        return ("rank %d" % rank, [sys.executable, "-c", _RESIZE_WORKER],
+                env, None)
+    return make_worker
+
+
+def test_supervisor_resize_file_grows_the_worker_set(tmp_path):
+    """Pre-staged resize target 3 with 1 running worker: the tick
+    drains the old world and respawns ranks 0..2 under generation 1."""
+    resize = tmp_path / "resize"
+    resize.write_text("3")
+    factory = _resize_factory(tmp_path)
+    sup = launch.Supervisor(restart="never", elastic=True,
+                            resize_file=str(resize), drain_timeout=5.0)
+    sup.worker_factory = factory
+    sup._resize_applied = 1
+    name, argv, env, hb = factory(0, 1, 0)    # generation-0 world
+    env["MX_LINGER"] = "30"                   # still running at the tick
+    sup.add(name, argv, env, heartbeat=hb)
+    t0 = time.monotonic()
+    rc = sup.run()
+    assert rc == 0
+    assert time.monotonic() - t0 < 25         # drained, never slept 30
+    assert sup.generation == 1
+    for rank in range(3):
+        assert (tmp_path / ("done.%d.gen1" % rank)).exists()
+
+
+def test_supervisor_resize_file_shrinks_the_worker_set(tmp_path):
+    port, thread = _start_ps(num_workers=2)
+    try:
+        resize = tmp_path / "resize"
+        resize.write_text("1")
+        factory = _resize_factory(tmp_path)
+        sup = launch.Supervisor(restart="never", elastic=True,
+                                resize_file=str(resize), drain_timeout=5.0)
+        sup.worker_factory = factory
+        sup.ps_addrs = ["127.0.0.1:%d" % port]
+        sup._resize_applied = 2
+        for rank in range(2):
+            name, argv, env, hb = factory(rank, 2, 0)
+            env["MX_LINGER"] = "30"
+            sup.add(name, argv, env, heartbeat=hb)
+        rc = sup.run()
+        assert rc == 0
+        assert (tmp_path / "done.0.gen1").exists()
+        assert not (tmp_path / "done.1.gen1").exists()   # rank 1 removed
+        ok, (_, members) = _ps_rpc(port, ("MEMBERS", None))
+        assert ok and members == ["r0"]       # LEAVEd out of the quorum
+    finally:
+        _ps_rpc(port, ("STOP", None))
+        thread.join(timeout=10)
+
+
+def test_stale_resize_target_is_never_reapplied(tmp_path):
+    """After an involuntary shrink the resize file still holds the OLD
+    target; _check_resize must not let it 'heal' the world back up."""
+    resize = tmp_path / "resize"
+    resize.write_text("2")
+
+    def boom(rank, n, generation):            # factory must not fire
+        raise AssertionError("stale target re-applied")
+
+    sup = launch.Supervisor(restart="never", elastic=True,
+                            resize_file=str(resize))
+    sup.worker_factory = boom
+    sup._resize_applied = 2                   # target 2 already honoured
+    sup._check_resize()                       # no-op, no AssertionError
+    resize.write_text("0")                    # nonsense targets ignored
+    sup._check_resize()
+    resize.write_text("banana")
+    sup._check_resize()
+    assert sup.generation == 0
+
+
+# ---------------------------------------------------------------------------
+# end-to-end through the CLI (slow: real jax startup per worker)
+# ---------------------------------------------------------------------------
+
+def _clean_env(**extra):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)      # conftest's 8-dev count: workers pick own
+    env.pop("MX_FAULT_INJECT", None)
+    env.update(extra)
+    return env
+
+
+def _launch_argv(argv):
+    return [sys.executable, os.path.join(REPO, "tools", "launch.py")] + argv
+
+
+def _fit_argv(tmp_path, tag, epochs):
+    fit = os.path.join(REPO, "tools", "chaos_fit.py")
+    return [sys.executable, fit, "--epochs", str(epochs),
+            "--ckpt-dir", str(tmp_path / tag), "--out", str(tmp_path / tag)]
+
+
+def _reference_params(tmp_path, epochs):
+    ref = subprocess.run(
+        _launch_argv(["-n", "1", "--launcher", "local", "--"]
+                     + _fit_argv(tmp_path, "ref", epochs)),
+        capture_output=True, text=True, timeout=300, env=_clean_env())
+    assert ref.returncode == 0, (ref.stdout, ref.stderr)
+    return np.load(str(tmp_path / "ref.rank0.npz"))
+
+
+def _assert_params_match(want, path, label):
+    got = np.load(str(path))
+    assert set(got.files) == set(want.files)
+    for k in want.files:
+        np.testing.assert_allclose(got[k], want[k], rtol=1e-5, atol=1e-6,
+                                   err_msg="%s %s" % (label, k))
+
+
+def _run_elastic_resize(tmp_path, tag, n0, n_new, epochs):
+    """launch.py --elastic -n n0, flip the resize file to n_new once the
+    generation-0 workers are up, wait for the job to finish exit 0."""
+    resize = tmp_path / (tag + ".resize")
+    proc = subprocess.Popen(
+        _launch_argv(["-n", str(n0), "--launcher", "local",
+                      "--elastic", "--resize-file", str(resize),
+                      "--drain-timeout", "60", "--"]
+                     + _fit_argv(tmp_path, tag, epochs)),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=_clean_env())
+    try:
+        # resize once the generation-0 world exists (first rank's
+        # checkpoint dir appears); landing pre-, mid- or post-fit are
+        # all legal interleavings the drain must absorb
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if (tmp_path / tag / "rank0").exists():
+                break
+            if proc.poll() is not None:
+                break
+            time.sleep(0.2)
+        resize.write_text(str(n_new))
+        out, err = proc.communicate(timeout=420)
+    except Exception:
+        proc.kill()
+        raise
+    assert proc.returncode == 0, (out, err)
+    assert "elastic resize" in err, err
+    return out, err
+
+
+@pytest.mark.slow
+def test_launch_elastic_grow_matches_uninterrupted(tmp_path):
+    """Acceptance: grow 2->4 mid-fit.  Old ranks drain at an epoch
+    boundary and auto-resume; new ranks join under generation 1; every
+    final parameter set matches an uninterrupted single-rank run."""
+    want = _reference_params(tmp_path, epochs=4)
+    out, _err = _run_elastic_resize(tmp_path, "grow", 2, 4, epochs=4)
+    assert out.count("CHAOS_FIT_DONE") >= 4
+    for rank in range(4):
+        _assert_params_match(want, tmp_path / ("grow.rank%d.npz" % rank),
+                             "grow rank %d" % rank)
+
+
+@pytest.mark.slow
+def test_launch_elastic_shrink_matches_uninterrupted(tmp_path):
+    """Acceptance: shrink 4->3 mid-fit with loss-trajectory parity (the
+    params ARE the trajectory: same seeded data + deterministic resume
+    means matching final params within fp tolerance)."""
+    want = _reference_params(tmp_path, epochs=4)
+    out, _err = _run_elastic_resize(tmp_path, "shrink", 4, 3, epochs=4)
+    assert out.count("CHAOS_FIT_DONE") >= 3
+    for rank in range(3):
+        _assert_params_match(want, tmp_path / ("shrink.rank%d.npz" % rank),
+                             "shrink rank %d" % rank)
+
+
+@pytest.mark.slow
+def test_launch_elastic_budget_exhausted_shrinks_and_continues(tmp_path):
+    """A rank crashing past --max-restarts under --elastic retires; the
+    survivor finishes exit 0 with correct params (vs the non-elastic
+    contract where the whole job would fold to the crash's rc)."""
+    want = _reference_params(tmp_path, epochs=2)
+    crash_rank1 = textwrap.dedent("""
+        import os, signal, sys
+        if os.environ.get("MX_PROCESS_ID") == "1":
+            os.kill(os.getpid(), signal.SIGKILL)
+        os.execv(sys.executable, sys.argv[1:])   # argv[1] is the python exe
+    """)
+    r = subprocess.run(
+        _launch_argv(["-n", "2", "--launcher", "local", "--elastic",
+                      "--restart", "on-failure", "--max-restarts", "1",
+                      "--", sys.executable, "-c", crash_rank1]
+                     + _fit_argv(tmp_path, "loss", epochs=2)),
+        capture_output=True, text=True, timeout=300, env=_clean_env())
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "elastic shrink" in r.stderr, r.stderr
+    assert "CHAOS_FIT_DONE rank 0" in r.stdout
+    _assert_params_match(want, tmp_path / "loss.rank0.npz", "survivor")

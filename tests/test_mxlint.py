@@ -1663,9 +1663,16 @@ def test_shipped_wire_surface_is_declared():
     assert serve["GENERATE"]["semantics"] == "replayable"
     assert serve["STREAM"]["semantics"] == "idempotent"
     kv = manifests["mxnet_tpu/kvstore/server.py"]
-    assert {"INIT", "PUSH", "PULL", "SET_OPT", "BARRIER", "PING",
-            "METRICS", "STOP"} == set(kv)
+    # ISSUE 16: PULLQ (quantized pull — a read, idempotent like PULL)
+    # and the elastic membership verbs JOIN/LEAVE/MEMBERS (no-op
+    # mutations never bump the epoch, so replays are safe = idempotent)
+    assert {"INIT", "PUSH", "PULL", "PULLQ", "SET_OPT", "BARRIER",
+            "PING", "METRICS", "JOIN", "LEAVE", "MEMBERS",
+            "STOP"} == set(kv)
     assert kv["METRICS"]["semantics"] == "idempotent"
+    assert kv["PULLQ"]["semantics"] == "idempotent"
+    assert kv["JOIN"]["semantics"] == "idempotent"
+    assert kv["LEAVE"]["semantics"] == "idempotent"
     # the fleet plane's surface (ISSUE 12)
     assert "mxnet_tpu/fleet.py" in manifests
     fl = manifests["mxnet_tpu/fleet.py"]

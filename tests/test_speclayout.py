@@ -465,10 +465,13 @@ def test_sharded_step_contract_declared():
          if c.name == "step.train_sharded"][0]
     assert c.donate_argnums == (0, 1, 2, 3, 4, 5)
     cases = c.build()
+    # dp2/dp3/dp4 are elastic-resize coverage (ISSUE 16): every
+    # data-parallel world a mid-job resize can land on is contracted
     assert sorted(case.label for case in cases) == \
-        ["dp", "dp_fsdp", "dp_fsdp_tp"]
+        ["dp", "dp2", "dp3", "dp4", "dp_fsdp", "dp_fsdp_tp"]
     closure = c.closure()
-    assert list(closure.points) == ["dp", "dp_fsdp", "dp_fsdp_tp"]
+    assert list(closure.points) == \
+        ["dp", "dp2", "dp3", "dp4", "dp_fsdp", "dp_fsdp_tp"]
 
 
 def test_parse_mesh_axes_and_layout_from_env(monkeypatch):

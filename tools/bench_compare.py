@@ -236,6 +236,14 @@ def extract_record(report: dict) -> dict:
         rec["decode_kv_pool_flat"] = bool(dec.get("kv_pool_flat"))
         rec["decode_zero_retraces"] = bool(
             dec.get("zero_serve_time_retraces"))
+    # ISSUE 16: hierarchical-exchange gated series — the two-tier
+    # cross-slice byte reduction is an ABSOLUTE acceptance (the
+    # promoted int8 return leg must move fewer bytes than the flat
+    # exchange), not a trajectory
+    if report.get("metric") == "kvstore_hierarchical_cross_slice_bytes":
+        rec["hier_cross_slice_reduction"] = report.get(
+            "cross_slice_reduction")
+        rec["hier_fewer_bytes_ok"] = bool(report.get("ok"))
     # ISSUE 14: sharded-lane per-chip state bytes, keyed by mesh class
     # (gating compares only within one mesh topology — a dp,fsdp=2 run
     # must never become the bar a dp,fsdp=4 run is held to)
@@ -265,6 +273,16 @@ def gate(rec, history, throughput_tol, memory_tol):
             "first record for %r on %r@%s: seeding history"
             % (rec["metric"], rec["device"] or "default",
                rec.get("host", "?")))
+        # absolute acceptances gate even a seeding record — a first
+        # run that violates its invariant must fail, not set the bar
+        if "hier_fewer_bytes_ok" in rec and \
+                not rec["hier_fewer_bytes_ok"]:
+            findings.append(
+                "HIERARCHICAL-EXCHANGE REGRESSION: two-tier exchange "
+                "moved no fewer cross-slice wire bytes than the flat "
+                "int8 exchange (reduction %s <= 1x)"
+                % rec.get("hier_cross_slice_reduction"))
+            return False, findings
         return True, findings
     # Throughput gates within the record's own lane CLASS: same input-
     # pipeline mode (a prefetch-off run pays data_wait the prefetched
@@ -335,6 +353,20 @@ def gate(rec, history, throughput_tol, memory_tol):
             findings.append(
                 "DECODE RETRACE REGRESSION: serve-time retraces "
                 "after warmup (the bucket tables must be closed)")
+    # ISSUE 16 gated series: the hierarchical exchange's acceptance —
+    # two-tier must beat flat dist_async on cross-slice wire bytes
+    if "hier_fewer_bytes_ok" in rec:
+        if not rec["hier_fewer_bytes_ok"]:
+            ok = False
+            findings.append(
+                "HIERARCHICAL-EXCHANGE REGRESSION: two-tier exchange "
+                "moved no fewer cross-slice wire bytes than the flat "
+                "int8 exchange (reduction %s <= 1x)"
+                % rec.get("hier_cross_slice_reduction"))
+        else:
+            findings.append(
+                "hierarchical exchange cross-slice reduction %sx > 1x"
+                % rec.get("hier_cross_slice_reduction"))
     # ISSUE 13 gated series: the retrace budget only ever goes down
     if rec.get("retraces_over_budget"):
         ok = False

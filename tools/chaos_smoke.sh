@@ -649,6 +649,18 @@ print("sharded_dryrun: PASS (int8 dp*fsdp loss %.4f -> %.4f == "
       "state %.2fx smaller)" % (got[0], got[-1], max(disp[1:]), ratio))
 EOF
 
+echo "== chaos_smoke: elastic membership - resize mid-fit + budget shrink (ISSUE 16)"
+# The canonical elastic-resize chaos tests live in tests/test_elastic.py:
+# grow 2->4 and shrink 4->3 mid-fit through `launch.py --elastic
+# --resize-file` with final-param parity against an uninterrupted run,
+# plus a rank SIGKILLed past --max-restarts retiring (shrink-and-continue,
+# exit 0) instead of failing the job.  Run the WHOLE file here — the
+# slow-marked CLI acceptance tests included (tier-1 only runs the fast
+# in-process ones).
+"$PY" -m pytest "$REPO/tests/test_elastic.py" -q \
+    -p no:cacheprovider -p no:randomly
+echo "chaos_smoke: elastic PASS (grow 2->4, shrink 4->3, SIGKILL shrink-and-continue)"
+
 echo "== chaos_smoke: static-analysis lane (tools/lint.sh)"
 bash "$REPO/tools/lint.sh"
 

@@ -38,6 +38,22 @@ distinguishing *wedged* from merely *slow* (a slow rank keeps beating).
 In-process, ``MX_STEP_TIMEOUT`` (mxnet_tpu.health watchdog) converts a
 hung step into exit code 86 the supervisor sees like any other crash.
 
+Elastic membership (ISSUE 16): ``--elastic`` spawns every worker with
+MX_ELASTIC=1, so each rank JOINs the parameter-server membership table
+at store init, and changes two supervisor behaviours.  Involuntary: a
+worker that exhausts its restart budget is given up — the supervisor
+sends LEAVE on its behalf to every server (barriers re-quorum on the
+survivors), retires it from the fleet plane, and the job CONTINUES on
+the remaining ranks instead of tearing down (teardown only when the
+last worker dies).  Voluntary: ``--resize-file PATH`` polls PATH for a
+target worker count; when it differs from the live world the supervisor
+drains every rank at its next epoch boundary (SIGTERM → the elastic fit
+handler checkpoints and exits 0), LEAVEs removed ranks out of the
+membership, and respawns ranks ``0..N_new-1`` with the new world size
+and a bumped MX_ELASTIC_EPOCH — the epoch salts the fusion-bucket CRC
+names, so the resized job replans its exchange layout with zero
+coordination and can never misread a pre-resize server accumulator.
+
 Example:
   python tools/launch.py -n 2 --restart on-failure \\
       --fault 'worker.step:crash:after=5' -- python train.py --kv dist
@@ -143,11 +159,24 @@ class Supervisor:
 
     def __init__(self, restart="never", max_restarts=3, backoff=None,
                  hang_timeout=None, startup_grace=None, poll=0.05,
-                 log=None, status_interval=None):
+                 log=None, status_interval=None, elastic=False,
+                 resize_file=None, drain_timeout=60.0):
         if restart not in ("never", "on-failure"):
             raise ValueError("restart must be 'never' or 'on-failure'")
         self.restart = restart
         self.max_restarts = int(max_restarts)
+        # elastic membership (ISSUE 16): shrink-and-continue past the
+        # restart budget, plus resize-file-driven voluntary resize
+        self.elastic = bool(elastic)
+        self.resize_file = resize_file
+        self.drain_timeout = float(drain_timeout)
+        self.ps_addrs = []            # server addrs for LEAVE-on-behalf
+        self.worker_factory = None    # (rank, n, generation) -> spec
+        self.generation = 0           # membership generation: bumped per
+                                      # resize, rides MX_ELASTIC_EPOCH
+        self._resize_applied = None   # last target honoured (an
+                                      # involuntary shrink must not be
+                                      # "healed" by a stale resize file)
         self._backoff = backoff       # lazy: RetryPolicy needs mxnet_tpu
         self.hang_timeout = hang_timeout
         # fleet status table (ISSUE 8): every status_interval wall
@@ -483,6 +512,44 @@ class Supervisor:
             self._fold(rc)
             return True                       # old posture: wait the rest
         if sp.restarts >= self.max_restarts:
+            if self.elastic and sp.role == "worker":
+                survivors = [w for w in self.procs
+                             if w is not sp and w.role == "worker"
+                             and not w.done]
+                if survivors:
+                    # shrink-and-continue (ISSUE 16): an elastic job
+                    # gives the rank up instead of tearing everyone
+                    # down.  LEAVE on its behalf evicts it from the PS
+                    # membership (barriers re-quorum on the survivors
+                    # at the current membership epoch) and the fleet
+                    # plane retires it immediately — a departed member
+                    # is gone by protocol, not merely silent, so it
+                    # must never linger as ABSENT/STRAGGLER.
+                    self.log("%s failed (%s) past its restart budget "
+                             "(%d) - elastic shrink: continuing with "
+                             "%d worker(s)"
+                             % (sp.name, self._describe(rc),
+                                self.max_restarts, len(survivors)))
+                    sp.rc = rc        # done; NOT folded — the job's
+                                      # exit code belongs to survivors
+                    try:
+                        rank = int(sp.env.get("MX_PROCESS_ID", -1))
+                    except (TypeError, ValueError):
+                        rank = -1
+                    if rank >= 0:
+                        for addr in self.ps_addrs:
+                            try:
+                                _send_leave(addr, rank)
+                            except OSError as e:
+                                self.log("LEAVE r%d -> %s failed (%s); "
+                                         "liveness eviction will catch "
+                                         "up" % (rank, addr, e))
+                    if self.fleet is not None and sp.fleet_key:
+                        try:
+                            self.fleet.retire(sp.fleet_key)
+                        except Exception:
+                            pass
+                    return True
             self.log("%s failed (%s) and exhausted its restart budget "
                      "(%d) - tearing the job down"
                      % (sp.name, self._describe(rc), self.max_restarts))
@@ -537,6 +604,96 @@ class Supervisor:
                 self.log("%s ignored SIGKILL (uninterruptible?); "
                          "leaving it to a later poll" % sp.name)
 
+    # -- elastic resize (ISSUE 16) ------------------------------------------
+    def _check_resize(self):
+        """Poll the resize file for a target worker count; a target that
+        differs from the last one honoured triggers a live resize."""
+        if not (self.elastic and self.resize_file and self.worker_factory):
+            return
+        try:
+            with open(self.resize_file) as f:
+                txt = f.read().strip()
+        except OSError:
+            return
+        if not txt:
+            return
+        try:
+            n_new = int(txt)
+        except ValueError:
+            self.log("resize file %r holds %r (not an integer); ignored"
+                     % (self.resize_file, txt))
+            return
+        if n_new <= 0 or n_new == self._resize_applied:
+            return
+        self._resize_applied = n_new
+        self._do_resize(n_new)
+
+    def _do_resize(self, n_new):
+        """Voluntary elastic resize: quiesce every worker at its next
+        epoch boundary (SIGTERM → the elastic fit drain handler saves a
+        checkpoint and exits 0), LEAVE the removed ranks out of the PS
+        membership, then respawn ranks 0..n_new-1 under the new world
+        size with a bumped membership generation.  MX_ELASTIC_EPOCH
+        carries the generation into every worker, where it salts the
+        fusion-bucket CRC names — the resized world's exchange layout
+        is replanned deterministically and can never collide with a
+        pre-resize server accumulator."""
+        old = [sp for sp in self.procs
+               if sp.role == "worker" and not sp.done]
+        self.generation += 1
+        self.log("elastic resize: %d -> %d worker(s) (generation %d); "
+                 "draining at the epoch boundary"
+                 % (len(old), n_new, self.generation))
+        for sp in old:
+            if sp.alive():
+                sp.proc.terminate()   # drain: checkpoint, then exit 0
+        deadline = time.time() + self.drain_timeout
+        for sp in old:
+            if sp.proc is not None:
+                try:
+                    sp.proc.wait(timeout=max(0.1,
+                                             deadline - time.time()))
+                except subprocess.TimeoutExpired:
+                    self.log("%s did not drain within %.3gs - killing "
+                             "it (auto-resume picks up from its last "
+                             "checkpoint)" % (sp.name, self.drain_timeout))
+                    sp.we_killed = True
+                    sp.proc.kill()
+                    try:
+                        sp.proc.wait(timeout=5.0)
+                    except subprocess.TimeoutExpired:
+                        pass
+            sp.rc = 0                 # drained by request, not a failure
+        # ranks above the new world size leave the membership NOW;
+        # continuing/new ranks re-register themselves (JOIN is
+        # idempotent) when they come up under the new generation
+        for sp in old:
+            try:
+                rank = int(sp.env.get("MX_PROCESS_ID", -1))
+            except (TypeError, ValueError):
+                rank = -1
+            if rank >= n_new:
+                for addr in self.ps_addrs:
+                    try:
+                        _send_leave(addr, rank)
+                    except OSError as e:
+                        self.log("LEAVE r%d -> %s failed (%s); liveness "
+                                 "eviction will catch up" % (rank, addr, e))
+        self.procs = [sp for sp in self.procs if sp.role != "worker"]
+        for rank in range(n_new):
+            name, argv, env, heartbeat = self.worker_factory(
+                rank, n_new, self.generation)
+            sp = self.add(name, argv, env, role="worker",
+                          heartbeat=heartbeat)
+            self._spawn(sp)
+        if self.fleet is not None:
+            # the collector's member set is frozen at start(): rebuild
+            # it over the new world (removed ranks drop out of
+            # presence/straggler tracking with it)
+            self._stop_collector()
+            self.fleet = None
+            self._start_collector()
+
     def _teardown(self):
         for sp in self.procs:
             self._kill(sp)
@@ -549,14 +706,17 @@ class Supervisor:
         stop the servers gracefully.  Returns the job return code."""
         for sp in self.procs:
             self._spawn(sp)
-        workers = [sp for sp in self.procs if sp.role == "worker"]
         if self.status_interval is not None or self.hang_timeout:
             # the fleet plane rides the same provisioning as the status
             # table / hang detection (heartbeat files, server addrs)
             self._start_collector()
         try:
             while True:
-                for sp in self.procs:
+                # elastic: the resize file can swap the whole worker set
+                # out from under this loop, so the membership is read
+                # fresh each tick rather than captured once up front
+                self._check_resize()
+                for sp in list(self.procs):
                     if sp.done or sp.proc is None:
                         continue
                     if sp.restart_at is not None:
@@ -576,6 +736,8 @@ class Supervisor:
                     if not self._on_failure(sp, rc):
                         self._teardown()
                         return self.job_rc
+                workers = [sp for sp in self.procs
+                           if sp.role == "worker"]
                 if all(w.done for w in workers):
                     break
                 self._maybe_status()
@@ -654,6 +816,35 @@ def _send_stop(addr, timeout=5.0):
             body += chunk
 
 
+def _send_leave(addr, rank, timeout=5.0):
+    """Send the kvstore wire-protocol LEAVE for rank ``rank`` (elastic
+    membership, ISSUE 16) — the supervisor departs a dead or removed
+    worker on its behalf so barriers re-quorum on the survivors
+    immediately instead of waiting out liveness eviction.  Same inlined
+    length-prefixed-pickle framing as _send_stop: the launcher never
+    loads the framework for it.  LEAVE is idempotent server-side, so
+    racing the worker's own voluntary leave() is harmless."""
+    host, _, port = addr.rpartition(":")
+    with socket.create_connection((host or "127.0.0.1", int(port)),
+                                  timeout=timeout) as s:
+        s.settimeout(timeout)
+        payload = pickle.dumps(("LEAVE", "r%d" % int(rank)), protocol=4)
+        s.sendall(struct.pack("<Q", len(payload)) + payload)
+        head = b""
+        while len(head) < 8:                  # ack: (True, (epoch, ...))
+            chunk = s.recv(8 - len(head))
+            if not chunk:
+                return
+            head += chunk
+        (n,) = struct.unpack("<Q", head)
+        body = b""
+        while len(body) < n:
+            chunk = s.recv(min(1 << 16, n - len(body)))
+            if not chunk:
+                return
+            body += chunk
+
+
 def _make_supervisor(args):
     restart = getattr(args, "restart", "never")
     max_restarts = getattr(args, "max_restarts", 3)
@@ -669,7 +860,11 @@ def _make_supervisor(args):
     return Supervisor(restart=restart, max_restarts=max_restarts,
                       hang_timeout=getattr(args, "hang_timeout", None),
                       status_interval=getattr(args, "status_interval",
-                                              None))
+                                              None),
+                      elastic=getattr(args, "elastic", False),
+                      resize_file=getattr(args, "resize_file", None),
+                      drain_timeout=getattr(args, "drain_timeout", None)
+                      or 60.0)
 
 
 # ---------------------------------------------------------------------------
@@ -726,8 +921,13 @@ def launch_local(args, command):
             sup.add("server %d" % s,
                     [sys.executable, "-m", "mxnet_tpu.kvstore.server"],
                     env, role="server", addr=addr)
-    for rank in range(args.num_workers):
-        env = _env_for(rank, coordinator, args.num_workers)
+    elastic = bool(getattr(args, "elastic", False))
+
+    def make_worker(rank, n, generation):
+        """(name, argv, env, heartbeat) for one worker — used for the
+        initial spawn AND stored as the supervisor's worker_factory so
+        an elastic resize can respawn the world at any size."""
+        env = _env_for(rank, coordinator, n)
         if compile_cache_dir:
             env["MX_COMPILE_CACHE"] = compile_cache_dir
         if getattr(args, "fault", None):
@@ -745,8 +945,25 @@ def launch_local(args, command):
             env["DMLC_PS_ROOT_URI"] = ps_roots[0].split(":")[0]
             env["DMLC_PS_ROOT_PORT"] = ps_roots[0].split(":")[1]
             env["DMLC_NUM_SERVER"] = str(len(ps_roots))
-        sup.add("rank %d" % rank, command, env, role="worker",
-                heartbeat=heartbeat)
+        if elastic:
+            # MX_ELASTIC: the dist store JOINs the membership at init
+            # and fit arms the SIGTERM epoch-boundary drain.
+            # MX_ELASTIC_EPOCH: supervisor-assigned membership
+            # generation — salts the fusion-bucket names so each
+            # incarnation's exchange layout is distinct and agreed
+            # (every worker of a generation gets the SAME value; a
+            # racily-observed server epoch could disagree mid-join)
+            env["MX_ELASTIC"] = "1"
+            env["MX_ELASTIC_EPOCH"] = str(int(generation))
+        return "rank %d" % rank, list(command), env, heartbeat
+
+    for rank in range(args.num_workers):
+        name, argv, env, heartbeat = make_worker(rank, args.num_workers, 0)
+        sup.add(name, argv, env, role="worker", heartbeat=heartbeat)
+    sup.ps_addrs = list(ps_roots)
+    if elastic:
+        sup.worker_factory = make_worker
+        sup._resize_applied = args.num_workers
     try:
         return sup.run()
     finally:
@@ -772,6 +989,13 @@ def launch_ssh(args, command):
             "local (an ssh client's exit cannot be distinguished from "
             "the remote rank's death; restarting on it risks duplicate "
             "ranks)")
+    if getattr(args, "elastic", False) or getattr(args, "resize_file",
+                                                  None):
+        # same reasoning as --restart: elastic respawn/drain needs
+        # authoritative process lifecycle, which ssh clients cannot give
+        raise SystemExit(
+            "launch.py: --elastic/--resize-file are only supported "
+            "with --launcher local")
     if getattr(args, "num_servers", 0) > 0:
         raise SystemExit(
             "launch.py: -s/--num-servers is only implemented for the "
@@ -852,6 +1076,29 @@ def main():
                         "the heartbeat files' telemetry JSON payload "
                         "(implies per-rank heartbeat files, like "
                         "--hang-timeout).  Unset = no tables")
+    p.add_argument("--elastic", action="store_true",
+                   help="elastic membership (preemption tolerance): "
+                        "workers JOIN the parameter-server membership "
+                        "at startup (MX_ELASTIC=1); a rank that "
+                        "exhausts its restart budget is LEAVEd out and "
+                        "the job continues on the survivors "
+                        "(shrink-and-continue) instead of tearing "
+                        "down.  Local launcher only")
+    p.add_argument("--resize-file", default=None, metavar="PATH",
+                   help="poll PATH for a target worker count (an "
+                        "integer); when it changes the supervisor "
+                        "drains every rank at its next epoch boundary "
+                        "(SIGTERM -> checkpoint -> exit 0), LEAVEs "
+                        "removed ranks from the PS membership, and "
+                        "respawns the new world with a bumped "
+                        "MX_ELASTIC_EPOCH (bucket-layout salt).  "
+                        "Requires --elastic")
+    p.add_argument("--drain-timeout", type=float, default=None,
+                   metavar="SECS",
+                   help="how long a resize waits for workers to reach "
+                        "their epoch-boundary drain before killing "
+                        "them (default 60; auto-resume then picks up "
+                        "from the last checkpoint)")
     p.add_argument("--fault", default=None, metavar="SPEC",
                    help="arm fault injection in every spawned process "
                         "(MX_FAULT_INJECT spec, e.g. "
@@ -875,6 +1122,8 @@ def main():
         command = command[1:]
     if not command:
         raise SystemExit("no command given")
+    if args.resize_file and not args.elastic:
+        raise SystemExit("--resize-file requires --elastic")
     if args.launcher == "local":
         sys.exit(launch_local(args, command))
     elif args.launcher == "ssh":
