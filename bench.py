@@ -808,9 +808,13 @@ def _pctile(sorted_vals, p):
                            int(p / 100.0 * len(sorted_vals)))]
 
 
-def run_serve_bench(rate=None, duration=None, senders=12):
+def run_serve_bench(rate=None, duration=None, senders=12,
+                    routed=False):
     """--serve: open-loop load against a REAL local serving replica
-    (ISSUE 9 acceptance lane).
+    (ISSUE 9 acceptance lane).  ``routed=True`` (``--serve --routed``,
+    ISSUE 17) appends a paired direct-vs-through-the-router probe
+    against the SAME warm replica — interleaved closed-loop lanes,
+    medians gated at p50/p99 within 10%.
 
     A synthetic Poisson arrival process (configurable rate/duration;
     open-loop: the schedule never slows down for the server, so queueing
@@ -979,8 +983,8 @@ def run_serve_bench(rate=None, duration=None, senders=12):
     # own merge loop stealing the GIL.
     from mxnet_tpu.base import get_env as _get_env
 
-    def _probe_load(nreq, rate_):
-        cli = ServeClient([addr], timeout=30)
+    def _probe_load(nreq, rate_, target=addr):
+        cli = ServeClient([target], timeout=30)
         lat = []
         sched = np.cumsum(rng.exponential(1.0 / rate_, nreq))
         t0p = time.perf_counter()
@@ -997,12 +1001,14 @@ def run_serve_bench(rate=None, duration=None, senders=12):
         cli.close()
         wallp = time.perf_counter() - t0p
         lat.sort()
+        p50_ = lat[min(len(lat) - 1, int(0.50 * len(lat)))] * 1e3 \
+            if lat else 0.0
         p99_ = lat[min(len(lat) - 1, int(0.99 * len(lat)))] * 1e3 \
             if lat else 0.0
         # plain floats: the latencies are contaminated with np.float64
         # via the np.cumsum schedule, and a np.bool_ gate comparison
         # would fail json.dumps
-        return float(len(lat) / wallp), float(p99_)
+        return float(len(lat) / wallp), float(p50_), float(p99_)
 
     probe_rate = max(50.0, rate / 2.0)
     fleet_interval = _get_env("MX_FLEET_INTERVAL", 2.0, float) or 2.0
@@ -1043,7 +1049,7 @@ def run_serve_bench(rate=None, duration=None, senders=12):
     cycles = int(os.environ.get("MX_BENCH_FLEET_CYCLES", 3))
     off_tps, off_p99s, on_tps, on_p99s = [], [], [], []
     for _cycle in range(cycles):
-        tp_, p99_ = _probe_load(probe_n, probe_rate)
+        tp_, _p50, p99_ = _probe_load(probe_n, probe_rate)
         off_tps.append(tp_)
         off_p99s.append(p99_)
         proc = subprocess.Popen([sys.executable, "-c", probe_src],
@@ -1058,7 +1064,7 @@ def run_serve_bench(rate=None, duration=None, senders=12):
             # measurement is scraping, not python startup sharing the
             # box with the replica for the lane's first second
             time.sleep(0.75)
-            tp_, p99_ = _probe_load(probe_n, probe_rate)
+            tp_, _p50, p99_ = _probe_load(probe_n, probe_rate)
             on_tps.append(tp_)
             on_p99s.append(p99_)
         finally:
@@ -1092,6 +1098,92 @@ def run_serve_bench(rate=None, duration=None, senders=12):
         # rides along as the deterministic cross-check
         "within_gate": tp_overhead <= 5.0 and p99_overhead <= 5.0,
     }
+
+    if routed:
+        # ISSUE 17 acceptance: the session router's forwarding tax.
+        # A SUBPROCESS router fronts the SAME warm replica — the
+        # production topology (the supervisor runs the router as its
+        # own process), and the same reasoning as the collector probe
+        # above: in-process it would fight the replica's batcher for
+        # the GIL and charge scheduler contention to forwarding.
+        # Interleaved paired closed-loop lanes (direct, then through
+        # the router) per cycle cancel box drift, medians kill
+        # scheduler spikes.  Gate: routed p50 AND p99 within 10% of
+        # direct, with an ABSOLUTE ms floor — on a fast box a 10%
+        # relative delta of a small p50 is two-loopback-hop noise, so
+        # the gate also passes when the ADDED latency is under the
+        # floor flat.
+        rs = _socket.socket()
+        rs.bind(("", 0))
+        rport_ = rs.getsockname()[1]
+        rs.close()
+        renv = dict(os.environ, JAX_PLATFORMS="cpu", MX_FORCE_CPU="1")
+        renv["PYTHONPATH"] = (
+            os.path.dirname(os.path.abspath(__file__))
+            + os.pathsep + renv.get("PYTHONPATH", ""))
+        rproc = subprocess.Popen(
+            [sys.executable, "-m", "mxnet_tpu.serve.router",
+             "--port", str(rport_), "--replicas", addr],
+            env=renv, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        raddr = "127.0.0.1:%d" % rport_
+        rdeadline = time.monotonic() + 30
+        while time.monotonic() < rdeadline:
+            try:
+                _socket.create_connection(("127.0.0.1", rport_),
+                                          timeout=0.2).close()
+                break
+            except OSError:
+                time.sleep(0.05)
+        warm_r = ServeClient([raddr], timeout=30)
+        for _ in range(3):
+            warm_r.predict([xw])
+        warm_r.close()
+        # probe BELOW the queueing knee: at the fleet probe's rate the
+        # single closed-loop sender sits near 50% utilization, where
+        # open-loop lateness cascades amplify ANY per-request delta
+        # into the tail — that measures the queue, not the router.  At
+        # ~5x the service time between arrivals the latency IS the
+        # path: replica service + (routed) two loopback hops.
+        routed_rate = min(probe_rate, 50.0)
+        routed_n = max(120, int(3.0 * routed_rate))
+        d_tps, d_p50s, d_p99s = [], [], []
+        r_tps, r_p50s, r_p99s = [], [], []
+        for _cycle in range(cycles):
+            tp_, p50_, p99_ = _probe_load(routed_n, routed_rate)
+            d_tps.append(tp_)
+            d_p50s.append(p50_)
+            d_p99s.append(p99_)
+            tp_, p50_, p99_ = _probe_load(routed_n, routed_rate,
+                                          target=raddr)
+            r_tps.append(tp_)
+            r_p50s.append(p50_)
+            r_p99s.append(p99_)
+        rproc.kill()
+        rproc.wait()
+        d_p50, d_p99 = _median(d_p50s), _median(d_p99s)
+        r_p50, r_p99 = _median(r_p50s), _median(r_p99s)
+        p50_pct = 100.0 * (r_p50 - d_p50) / d_p50 if d_p50 else 0.0
+        p99_pct = 100.0 * (r_p99 - d_p99) / d_p99 if d_p99 else 0.0
+        gate_pct, floor_ms = 10.0, 1.0
+        report["routed"] = {
+            "cycles": cycles,
+            "probe_rate": routed_rate,
+            "probe_requests": routed_n,
+            "throughput_direct_rps": round(_median(d_tps), 2),
+            "throughput_routed_rps": round(_median(r_tps), 2),
+            "p50_direct_ms": round(d_p50, 3),
+            "p50_routed_ms": round(r_p50, 3),
+            "p99_direct_ms": round(d_p99, 3),
+            "p99_routed_ms": round(r_p99, 3),
+            "p50_overhead_pct": round(p50_pct, 2),
+            "p99_overhead_pct": round(p99_pct, 2),
+            "gate_pct": gate_pct,
+            "floor_ms": floor_ms,
+            "within_gate": bool(
+                (p50_pct <= gate_pct or r_p50 - d_p50 <= floor_ms)
+                and (p99_pct <= gate_pct or r_p99 - d_p99 <= floor_ms)),
+        }
     stop_ev.set()
     print(json.dumps(report))
 
@@ -1534,7 +1626,7 @@ def main():
             # ISSUE 15: continuous-vs-request-level decode comparison
             run_decode_bench()
             return
-        run_serve_bench()
+        run_serve_bench(routed="--routed" in sys.argv)
         return
     if "--warm-spawn" in sys.argv:
         # CPU-friendly: the lane measures spawn→first-PREDICT time of

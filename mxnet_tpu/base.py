@@ -136,6 +136,18 @@ ENV_CATALOG: Dict[str, Any] = {
     "MX_EXCHANGE_PARALLEL": ("4", "Concurrent as-ready bucket pulls (dedicated connections) per worker under MX_EXCHANGE_HIERARCHICAL."),
     "MX_FLEET_PORT": ("", "Port the fleet collector's wire server binds (FLEET verb -> merged snapshot as a JSN payload, METRICS -> whole-fleet federation exposition; same length-prefixed envelope as the kvstore/serve wire).  This is the API surface the coming serve router/autoscaler consume.  Empty = no wire server."),
     "MX_FLEET_HTTP_PORT": ("", "Port of the collector's Prometheus federation HTTP endpoint: GET /metrics returns every member's instruments re-labeled {role,rank,model} plus the fleet rollups — a single scrape covers the whole fleet; GET /fleet.json returns the merged snapshot.  Empty = no HTTP endpoint."),
+    "MX_SERVE_DRAIN_TIMEOUT": ("30", "Serving replica drain-not-kill retirement (ISSUE 17): default bounded deadline in seconds a DRAIN verb without an explicit timeout arms.  Admission closes immediately (fresh PREDICT/GENERATE answered '(False, draining: ...)' so routers/clients re-route), in-flight requests and generations finish, then the serve loop exits cleanly; past the deadline the stragglers' connections are severed with NO reply so their clients fail over and re-prefill on a survivor.  A re-asserted DRAIN keeps the FIRST deadline (a retry cannot extend retirement)."),
+    "MX_ROUTER_PORT": ("9800", "Port the serving front-tier router binds (python -m mxnet_tpu.serve.router) when --port is not given.  Clients point MX_SERVE_ROOTS at this one address and the router forwards their SEQ envelopes verbatim across the replica set."),
+    "MX_ROUTER_REPLICAS": ("", "Comma-separated static replica addresses host:port the router seeds its membership with (the dynamic complement is MX_ROUTER_REPLICAS_FILE).  New members join 'up' optimistically; the first failed forward demotes them to 'dead' and a connect-probe per refresh tick revives them."),
+    "MX_ROUTER_REPLICAS_FILE": ("", "Path of the authoritative replica-list file (one host:port per line, '#' comments) the router re-reads every refresh tick.  tools/launch.py --route rewrites it atomically as the autoscaler spawns and retires replicas: an addr that appears joins 'up', one that disappears goes 'draining' (nothing new routed there) until dead, then is forgotten."),
+    "MX_ROUTER_REFRESH": ("1.0", "Seconds between router refresh ticks: replicas-file re-read, dead-replica connect probes, and the FLEET snapshot pull that feeds least-loaded routing.  Also the router's heartbeat cadence under the launcher's --hang-timeout."),
+    "MX_ROUTER_FLEET": ("", "Fleet collector wire address host:port the router pulls merged load signals from (fleet.replica_signals projection: queue depth, decode admission queue, decode slot occupancy, KV headroom).  Empty = no signals; routing degrades to round-robin over 'up' replicas (a fresh replica with no scrape history scores 0 = idle, which is correct)."),
+    "MX_ROUTER_PIN_CAP": ("4096", "Bound on the router's session-pin LRU (client_id -> replica).  Serving clients are ephemeral uuids, so pins must age out; evicting a pin costs decode locality on that session's NEXT request (it re-routes least-loaded and re-pins), never correctness.  Values < 1 clamp to 1."),
+    "MX_ROUTER_DRAIN_TIMEOUT": ("30", "Default bounded deadline in seconds for draining the ROUTER itself (DRAIN verb to the router): new sessions are refused 'draining: ...' while pinned sessions keep flowing; the router exits once the wire is idle, and past the deadline straggler connections are severed so their clients replay elsewhere."),
+    "MX_AUTOSCALE_UP_BURN": ("1.0", "Autoscaler (tools/launch.py --autoscale MIN:MAX): scale UP when any fleet SLO burn (fleet.slo_burn, observed/target from the merged snapshot) meets/exceeds this for MX_AUTOSCALE_HOLD consecutive supervisor ticks.  Spawns one warm replica per decision (compile-cache restarts make this seconds, not minutes) and registers it with the collector + the router's replicas file."),
+    "MX_AUTOSCALE_DOWN_BURN": ("0.5", "Autoscaler: scale DOWN (retire-and-drain ONE replica) when every tracked SLO burn stays at/below this for MX_AUTOSCALE_HOLD consecutive ticks.  The gap between UP_BURN and DOWN_BURN is the hysteresis band that keeps the fleet from flapping; retirement is always drain-not-kill (DRAIN verb, bounded deadline, supervisor treats the clean exit as expected)."),
+    "MX_AUTOSCALE_HOLD": ("3", "Autoscaler: consecutive supervisor autoscale ticks a burn signal must hold before acting (both directions).  Raising it trades reaction time for stability; 1 reacts on a single tick."),
+    "MX_AUTOSCALE_COOLDOWN": ("10", "Autoscaler: base seconds of the post-action cooldown.  Each action arms fault.RetryPolicy-style backoff (base * 2^consecutive-same-direction-actions, jittered, capped at 8x) before the next action may fire, so a spike absorbs with a burst of spawns but repeated flip-flops back off exponentially."),
 }
 
 

@@ -37,6 +37,17 @@ Instrumented sites (grep for ``fault.fire``):
                           'worker.step:crash:after=N'`` supervisor chaos
                           runs kill into (``delay`` specs here model a
                           hang for the MX_STEP_TIMEOUT watchdog)
+  ``serve.request``       serving replica, before handling each wire
+                          request (``crash`` = kill a replica mid-load)
+  ``serve.client.send``   serve client, before each RPC send
+  ``serve.client.recv``   serve client, before each RPC receive
+  ``router.request``      serve router (ISSUE 17), before handling each
+                          inbound client envelope (``crash`` = kill the
+                          router mid-load)
+  ``router.forward``      serve router, before forwarding an envelope
+                          to the chosen replica — error/close here
+                          looks like a dead replica and must trigger a
+                          router-side failover, never a double dispatch
 """
 from __future__ import annotations
 

@@ -71,10 +71,13 @@ __all__ = [
     "SCHEMA", "FleetMergeError", "FleetMember", "FleetCollector",
     "StragglerDetector", "SLOTracker",
     "merge_bucket_maps", "quantile_from_buckets", "merge_snapshots",
-    "serve_fleet", "fetch_fleet", "fetch_metrics",
+    "serve_fleet", "fetch_fleet", "fetch_metrics", "replica_signals",
 ]
 
-SCHEMA = 1
+# FLEET payload schema.  2 (ISSUE 17): member rows carry their scrape
+# ``addr``, making the snapshot directly router-consumable — the router
+# maps per-member gauges back to the replica address it forwards to.
+SCHEMA = 2
 
 # The fleet wire surface, DECLARED (ISSUE 11 contract): mxlint's
 # wire-verb-exhaustive rule pairs every emitted verb with an entry
@@ -495,6 +498,56 @@ def fetch_fleet(addr: str, timeout: float = 5.0) -> Dict[str, Any]:
     return decode_json(payload)
 
 
+def replica_signals(snapshot: Optional[Dict[str, Any]],
+                    role: str = "serve") -> Dict[str, Dict[str, Any]]:
+    """The router-consumable signal surface (ISSUE 17): one merged
+    FLEET snapshot -> ``{replica addr: load signals}`` for every
+    ``role`` member that carries a scrape address.
+
+    Pure function of the schema-2 payload, so the router, the
+    autoscaler, and tests all read the SAME projection: per-replica
+    queue depth (``serve.queue_rows`` + decode admission queue), decode
+    slot occupancy, KV-pool admission headroom, and cumulative
+    rejections (the caller differences these for burn).  Members whose
+    row predates schema 2 (no ``addr``) are skipped — a router must
+    never route to an address it cannot name."""
+    out: Dict[str, Dict[str, Any]] = {}
+    if not isinstance(snapshot, dict):
+        return out
+    gauges = snapshot.get("gauges") or {}
+    counters = snapshot.get("counters") or {}
+
+    def _per_member(table, name):
+        slot = table.get(name) or {}
+        return slot.get("per_member") or {}
+
+    queue = _per_member(gauges, "serve.queue_rows")
+    dqueue = _per_member(gauges, "serve.decode.queue")
+    active = _per_member(gauges, "serve.decode.active_slots")
+    occupancy = _per_member(gauges, "serve.decode.slot_occupancy")
+    headroom = _per_member(gauges, "serve.decode.kv_headroom_bytes")
+    rejected = _per_member(counters, "serve.rejected")
+    d_rejected = _per_member(counters, "serve.decode.rejected")
+    for key, meta in (snapshot.get("members") or {}).items():
+        if not isinstance(meta, dict) or meta.get("role") != role:
+            continue
+        addr = meta.get("addr")
+        if not addr:
+            continue
+        out[str(addr)] = {
+            "member": key,
+            "present": bool(meta.get("present")),
+            "queue_rows": queue.get(key, 0) or 0,
+            "decode_queue": dqueue.get(key, 0) or 0,
+            "active_slots": active.get(key, 0) or 0,
+            "slot_occupancy": occupancy.get(key, 0.0) or 0.0,
+            "kv_headroom_bytes": headroom.get(key, 0) or 0,
+            "rejected": (rejected.get(key, 0) or 0)
+            + (d_rejected.get(key, 0) or 0),
+        }
+    return out
+
+
 # ---------------------------------------------------------------------------
 # the collector
 # ---------------------------------------------------------------------------
@@ -797,6 +850,9 @@ class FleetCollector:
                     "role": m.role, "rank": m.rank,
                     "present": st.present,
                     "absent_scrapes": st.absent_scrapes,
+                    # schema 2: the scrape address rides the row so a
+                    # router can map per-member signals -> replica addr
+                    "addr": m.addr,
                     "source": st.source, "model": st.model,
                     "age": round(st.age, 3) if st.age is not None
                     else None,

@@ -47,6 +47,18 @@ over this repo's own substrates):
   (tools/chaos_smoke.sh) kills one of two replicas mid-load proving
   traffic drains to the survivor with zero lost requests.
 
+* **Fleet front-tier** (:mod:`.router`, ISSUE 17) — the decode-aware
+  session router: one address fronting a DYNAMIC replica set, speaking
+  the same SEQ wire surface and forwarding client envelopes verbatim
+  (so the replicas' exactly-once replay caches keep working end-to-end
+  with zero router-side replay state).  Sessions pin to a replica
+  (moving a decode session costs a re-prefill), routing reads the
+  fleet collector's merged load signals, and replica retirement is a
+  first-class DRAIN — stop admitting, finish in-flight, sever only the
+  stragglers past a bounded deadline.  ``tools/launch.py --route``
+  supervises router + replicas and ``--autoscale MIN:MAX`` resizes the
+  fleet against SLO burn with hysteresis.
+
 * **Autoregressive decode** (:mod:`.decode`, ISSUE 15) — the
   sequence-generation workload behind the GENERATE verb: prefill and
   decode as separately bucketed AOT programs, a device-resident
@@ -65,4 +77,17 @@ from .decode import DecodeBatcher, DecodeConfig, DecodeServable
 
 __all__ = ["BucketTable", "Servable", "ModelHost", "Batcher",
            "Overloaded", "ServeServer", "serve_forever", "ServeClient",
-           "DecodeBatcher", "DecodeConfig", "DecodeServable"]
+           "DecodeBatcher", "DecodeConfig", "DecodeServable",
+           "ServeRouter", "serve_router_forever"]
+
+
+def __getattr__(name):
+    # lazy (PEP 562): ``python -m mxnet_tpu.serve.router`` must not
+    # find the router module pre-imported by its own package (runpy's
+    # double-execution warning), so the package face resolves these on
+    # first touch instead of at import
+    if name in ("ServeRouter", "serve_router_forever"):
+        from . import router
+        return getattr(router, name)
+    raise AttributeError("module %r has no attribute %r"
+                         % (__name__, name))

@@ -14,7 +14,16 @@ Overload (``(False, "overloaded: ...")``) is NOT a failover trigger by
 default — the replica is healthy and shedding load; the caller gets
 :class:`~mxnet_tpu.serve.batcher.Overloaded` to back off or report.
 Pass ``spill=True`` to try the other replicas first (queue-spill
-routing) and raise only when every replica sheds.
+routing) and raise only when every replica sheds.  A DRAINING replica
+(``(False, "draining: ...")``, ISSUE 17 retirement) always rotates —
+retirement is routine, not load to report — and raises only when every
+replica is retiring.
+
+Retry attempts back off on the jittered exponential
+:class:`~mxnet_tpu.fault.RetryPolicy` schedule through the injectable
+clock (ISSUE 17 satellite): a fleet-wide blip produces spread-out
+replays instead of a synchronized retry storm, and every slept delay
+lands on the ``serve.client_backoff_seconds`` histogram.
 """
 from __future__ import annotations
 
@@ -65,6 +74,11 @@ class ServeClient:
             "serve.client_failovers",
             doc="requests replayed on another replica after a "
                 "connection failure/timeout")
+        self._h_backoff = _telemetry.registry.histogram(
+            "serve.client_backoff_seconds",
+            doc="jittered exponential backoff slept between serve RPC "
+                "retry/failover attempts (injectable clock)",
+            buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0))
 
     @property
     def replicas(self) -> List[str]:
@@ -120,7 +134,21 @@ class ServeClient:
             seq = self._next_seq()
         with _telemetry.rpc_span("serve.client.%s" % msg[0]) as span:
             tctx = span.wire_context()
-            for _attempt in policy:
+            start = _fault.now()
+            attempt = 0
+            while True:
+                if attempt:
+                    # the RetryPolicy schedule walked explicitly (same
+                    # math as its iterator) so every slept backoff is
+                    # OBSERVED: jittered delays de-synchronize a
+                    # fleet-wide blip's replays, and the histogram
+                    # makes the spread auditable
+                    d = policy.delay(attempt - 1)
+                    if _fault.now() + d - start > policy.deadline:
+                        break   # next attempt would blow the deadline
+                    self._h_backoff.observe(d)
+                    _fault.sleep(d)
+                attempt += 1
                 with self._lock:
                     at = idx if pinned else self._idx
                     env = ("SEQ", self._client_id, seq, msg)
@@ -175,13 +203,19 @@ class ServeClient:
             if ok:
                 version, outs = resp
                 return int(version), [decode_array(t) for t in outs]
-            if isinstance(resp, str) and resp.startswith("overloaded"):
+            if isinstance(resp, str) and resp.startswith(("overloaded",
+                                                          "draining")):
                 tried += 1
-                if spill and tried < len(self._addrs):
+                # a DRAINING replica is retiring (ISSUE 17): always
+                # move on — retirement is routine, not load to report;
+                # overload spills only when the caller opted in
+                if ((spill or resp.startswith("draining"))
+                        and tried < len(self._addrs)):
                     with self._lock:      # shed here; try the next one
                         self._idx = (self._idx + 1) % len(self._addrs)
                     continue
-                raise Overloaded(resp)
+                if resp.startswith("overloaded"):
+                    raise Overloaded(resp)
             raise MXNetError("serve: %s" % resp)
 
     def generate(self, prompt: Sequence[int],
@@ -222,13 +256,19 @@ class ServeClient:
             if ok:
                 version, tokens = resp
                 return int(version), [int(t) for t in tokens]
-            if isinstance(resp, str) and resp.startswith("overloaded"):
+            if isinstance(resp, str) and resp.startswith(("overloaded",
+                                                          "draining")):
                 tried += 1
-                if spill and tried < len(self._addrs):
+                # draining => the session must move: re-prefill on the
+                # next replica (deterministic decode reproduces the
+                # sequence exactly); overload spills only on opt-in
+                if ((spill or resp.startswith("draining"))
+                        and tried < len(self._addrs)):
                     with self._lock:
                         self._idx = (self._idx + 1) % len(self._addrs)
                     continue
-                raise Overloaded(resp)
+                if resp.startswith("overloaded"):
+                    raise Overloaded(resp)
             raise MXNetError("serve: %s" % resp)
 
     def health(self, idx: Optional[int] = None) -> dict:
@@ -260,6 +300,20 @@ class ServeClient:
                 raise MXNetError("serve: replica %d %s" % (i, resp))
             versions.append(int(resp))
         return versions
+
+    def drain(self, timeout: Optional[float] = None,
+              idx: Optional[int] = None) -> dict:
+        """Begin drain-not-kill retirement on one replica (``idx``
+        pins; default = sticky): admission closes, in-flight work
+        finishes against the bounded deadline, then the replica's serve
+        loop exits cleanly (ISSUE 17).  Returns the replica's drain
+        status dict."""
+        ok, resp = self._rpc(
+            "DRAIN", None if timeout is None else float(timeout),
+            idx=idx)
+        if not ok:
+            raise MXNetError("serve: %s" % resp)
+        return resp
 
     def stop(self) -> None:
         """Graceful STOP to every replica (best-effort)."""

@@ -519,6 +519,12 @@ class DecodeServable:
         arrays) — the number that must stay FLAT across generations."""
         return sum(int(a.nbytes) for a in self._state.values())
 
+    def kv_slot_bytes(self) -> int:
+        """One slot's share of the KV pool (the scratch lane counts as
+        a slot here — the pool is ``slots + 1`` lanes wide), i.e. the
+        bytes a free slot represents as ADMISSION headroom."""
+        return self.kv_state_bytes() // (self.config.slots + 1)
+
 
 class _PendingGen:
     """One admitted generation request: prompt in, tokens accumulating
@@ -667,6 +673,17 @@ class DecodeBatcher:
             "serve.decode.queue", doc="generation requests queued")
         self._g_active = reg.gauge(
             "serve.decode.active_slots", doc="sequences in decode slots")
+        # first-class capacity signals (ISSUE 17): the router and
+        # autoscaler read these per-replica off the merged FLEET
+        # snapshot — no more deriving load from occupancy histograms
+        self._g_occupancy = reg.gauge(
+            "serve.decode.slot_occupancy",
+            doc="fraction of decode slots holding an active sequence "
+                "(0..1; router load signal)")
+        self._g_headroom = reg.gauge(
+            "serve.decode.kv_headroom_bytes",
+            doc="KV-pool bytes behind currently-FREE decode slots "
+                "(admission headroom; router/autoscaler signal)")
         self._h_occ = reg.histogram(
             "serve.decode.occupancy", doc="active sequences per decode "
             "step", buckets=(1, 2, 4, 8, 16, 32, 64))
@@ -676,6 +693,7 @@ class DecodeBatcher:
             "inter-token gaps",
             buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
                      0.1, 0.25, 0.5, 1.0, 2.5))
+        self._set_capacity_gauges(0)
         self._pump = threading.Thread(
             target=self._loop, daemon=True, name="mx-serve-decode-pump")
         self._harvester = threading.Thread(
@@ -705,6 +723,14 @@ class DecodeBatcher:
     def active_count(self) -> int:
         with self._slot_lk:
             return sum(1 for g in self._slots if g is not None)
+
+    def _set_capacity_gauges(self, active: int) -> None:
+        """Publish the per-replica capacity signals for ``active``
+        occupied slots (called wherever occupancy changes)."""
+        slots = self._sv.config.slots
+        self._g_occupancy.set(active / float(slots) if slots else 0.0)
+        self._g_headroom.set(
+            max(0, slots - active) * self._sv.kv_slot_bytes())
 
     def submit(self, prompt: Sequence[int],
                max_new: Optional[int] = None,
@@ -808,7 +834,9 @@ class DecodeBatcher:
                 for i, _g in done:
                     self._slots[i] = None
         self._c_seqs.inc(len(done))
-        self._g_active.set(self.active_count())
+        active = self.active_count()
+        self._g_active.set(active)
+        self._set_capacity_gauges(active)
 
     def _admit(self) -> None:
         """The slot allocator: fill free slots from the queue at the
@@ -855,7 +883,9 @@ class DecodeBatcher:
             t0 = self._sv.dispatch_prefill(slot, padded,
                                            len(gen.prompt))
         self._c_prefills.inc()
-        self._g_active.set(self.active_count())
+        active = self.active_count()
+        self._g_active.set(active)
+        self._set_capacity_gauges(active)
         self._hq_put(([gen], t0))
 
     def _step(self, active: List[Tuple[int, _PendingGen]]) -> None:

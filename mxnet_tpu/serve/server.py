@@ -31,11 +31,23 @@ Verbs::
            the live Prometheus text exposition (fmt='json': the JSON
            registry snapshot) — a replica is scrapeable with no sidecar
   SWAP     (SWAP, prefix, epoch, inputs)  -> (True, new_version)
+  DRAIN    (DRAIN[, timeout])             -> (True, {status, ...}):
+           first-class retirement (ISSUE 17) — stop ADMITTING new work
+           (fresh PREDICT/GENERATE get ``(False, "draining: ...")``),
+           let in-flight requests and generations finish, then exit the
+           serve loop cleanly.  Past the bounded drain deadline
+           (``timeout`` or MX_SERVE_DRAIN_TIMEOUT) the stragglers'
+           connections are severed with NO reply, so their clients fail
+           over and re-prefill on a survivor — exactly the
+           mid-generation-kill story, but only for the stragglers.
   STOP     (STOP,)                        -> (True, "stopping")
 
 Overload is a NORMAL reply — ``(False, "overloaded: ...")`` — so the
 client can distinguish load shedding (report/back off; the replica is
-healthy) from a dead replica (fail over).
+healthy) from a dead replica (fail over).  A DRAINING replica refuses
+new work the same way (``(False, "draining: ...")``): the
+router/client route the request to another replica instead of burning
+a retry deadline here.
 
 Tracing: the handler opens ``serve.server.<CMD>`` as a child of the
 client's wire-propagated span, and hands its own (trace_id, span_id) to
@@ -70,8 +82,10 @@ __all__ = ["ServeServer", "serve_forever"]
 # an entry here, checks this file handles it, that 'replayable' verbs
 # sit in the exactly-once replay set (_CACHED) and 'idempotent' ones do
 # not, and that named codecs have encode_*/decode_* pairs in
-# kvstore/wire_codec.py.  The serve-router ROUTE verb (ROADMAP item 3)
-# lands by completing a row here — never half-wired.
+# kvstore/wire_codec.py.  The serve router (ISSUE 17) speaks this SAME
+# surface — it forwards client envelopes verbatim, so its manifest in
+# router.py mirrors these rows and the replay semantics hold
+# end-to-end through it.
 WIRE_VERBS = {
     # one PREDICT = one dispatch, even replayed; one SWAP = one flip
     "PREDICT": {"semantics": "replayable", "codec": "array"},
@@ -89,6 +103,9 @@ WIRE_VERBS = {
     "HEALTH": {"semantics": "idempotent", "codec": None},
     "METRICS": {"semantics": "idempotent", "codec": "text"},
     "STOP": {"semantics": "idempotent", "codec": None},
+    # drain-not-kill retirement (ISSUE 17): re-asserting an already-
+    # draining replica is a no-op, so a retried DRAIN is harmless
+    "DRAIN": {"semantics": "idempotent", "codec": None},
 }
 
 
@@ -132,6 +149,12 @@ class ServeServer:
             "serve.replay_evicted",
             doc="replay-cache entries dropped by the per-client LRU "
                 "bound (MX_SERVE_REPLAY_CAP)")
+        # drain-not-kill retirement (ISSUE 17): once set, admission is
+        # closed (fresh PREDICT/GENERATE refused with "draining: ...")
+        # while in-flight work finishes against the bounded deadline
+        self._draining = threading.Event()
+        self._drain_lock = threading.Lock()
+        self._drain_deadline: Optional[_fault.Deadline] = None
 
     # -- envelope (kvstore SEQ contract) ------------------------------------
     def handle_request(self, msg, stream_fn=None):
@@ -254,11 +277,66 @@ class ServeServer:
                 # the reason instead of a severed connection
                 return False, "swap failed: %s" % e
             return True, version
+        if cmd == "DRAIN":
+            timeout = msg[1] if len(msg) > 1 else None
+            return True, self.drain(timeout)
         if cmd == "STOP":
             return True, "stopping"
         return False, "unknown serve command %r" % (cmd,)
 
+    # -- drain lifecycle (ISSUE 17) -----------------------------------------
+    def drain(self, timeout=None) -> Dict:
+        """Begin retirement: close admission, arm the bounded drain
+        deadline (idempotent — a re-asserted DRAIN keeps the FIRST
+        deadline so a retry cannot extend the retirement window), and
+        report what is still in flight.  ``serve_forever`` watches
+        :meth:`drain_idle` / :meth:`drain_expired` and exits the serve
+        loop when the replica is empty or the deadline passes."""
+        t = float(timeout if timeout is not None else
+                  get_env("MX_SERVE_DRAIN_TIMEOUT", 30.0, float) or 30.0)
+        with self._drain_lock:
+            if self._drain_deadline is None:
+                self._drain_deadline = _fault.Deadline(t)
+            self._draining.set()
+            remaining = self._drain_deadline.remaining()
+        _telemetry.registry.counter(
+            "serve.drains",
+            doc="DRAIN retirements accepted by this replica").inc()
+        status = {"status": "draining",
+                  "deadline_seconds": remaining,
+                  "queue_rows": self.batcher.queue_rows()}
+        if self.decode is not None:
+            status["active"] = self.decode.active_count()
+            status["queued"] = self.decode.queue_depth()
+        return status
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def drain_idle(self) -> bool:
+        """True when nothing is left in flight inside the engines (the
+        wire-level in-flight count is ``serve_forever``'s half)."""
+        if self.batcher.queue_rows() > 0:
+            return False
+        if self.decode is not None and (
+                self.decode.active_count() > 0
+                or self.decode.queue_depth() > 0):
+            return False
+        return True
+
+    def drain_expired(self) -> bool:
+        with self._drain_lock:
+            dl = self._drain_deadline
+        return dl is not None and dl.expired()
+
     def _predict(self, payload: Sequence, span):
+        if self._draining.is_set():
+            # admission is closed: a NORMAL reply (not a severed
+            # socket) so the router/client re-routes instead of
+            # replaying a poison request against a retiring replica
+            return False, ("draining: replica is retiring, not "
+                           "admitting new work")
         try:
             arrays = [decode_array(t) for t in payload]
         except ValueError as e:
@@ -291,6 +369,12 @@ class ServeServer:
         Like PREDICT, every failure is a normal (False, reason) reply —
         a severed connection would make the client replay a poison
         request on every replica."""
+        if self._draining.is_set():
+            # new generations (even from a session pinned here) are new
+            # WORK: refuse so the router re-pins the session elsewhere;
+            # generations already inside the pump keep running
+            return False, ("draining: replica is retiring, not "
+                           "admitting new sessions")
         if self.decode is None:
             return False, ("no decode engine deployed (start the "
                            "replica with --decode)")
@@ -355,6 +439,10 @@ class ServeServer:
                 "tokens": reg.value("serve.decode.tokens"),
                 "sequences": reg.value("serve.decode.sequences"),
             }
+        if self._draining.is_set():
+            # a draining replica still ANSWERS (in-flight work, probes)
+            # but must advertise that it admits nothing new
+            status["status"] = "draining"
         status.update({
             "queue_rows": self.batcher.queue_rows(),
             "requests": reg.value("serve.requests"),
@@ -485,10 +573,29 @@ def serve_forever(port: Optional[int] = None,
         t = threading.Thread(target=srv.serve_forever, daemon=True,
                              name="mx-serve-accept")
         t.start()
-        # idle until STOP (a replica's lifetime) or the chaos abort —
-        # the supervisor owns killing an abandoned replica
+        # idle until STOP (a replica's lifetime), a completed/expired
+        # DRAIN retirement (ISSUE 17), or the chaos abort — the
+        # supervisor owns killing an abandoned replica
+        drain_overrun = False
         while not stop_event.is_set() and not abort_event.is_set():
             stop_event.wait(timeout=0.1)
+            if server_state.draining:
+                with inflight_lock:
+                    wire_busy = inflight_count[0]
+                if wire_busy == 0 and server_state.drain_idle():
+                    break                   # drained clean: exit 0
+                if server_state.drain_expired():
+                    # bounded deadline passed with stragglers still in
+                    # flight: sever them WITHOUT replies so their
+                    # clients fail over and re-prefill on a survivor —
+                    # the mid-generation-kill story, stragglers only
+                    drain_overrun = True
+                    break
+        if drain_overrun:
+            _sever()
+            srv.shutdown()
+            server_state.close()
+            return
         if abort_event.is_set():
             # simulated crash: live connections die FIRST (no drain, no
             # replies — socketserver's shutdown() can block up to its

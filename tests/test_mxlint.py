@@ -1654,14 +1654,27 @@ def test_shipped_wire_surface_is_declared():
     assert "mxnet_tpu/serve/server.py" in manifests
     assert "mxnet_tpu/kvstore/server.py" in manifests
     serve = manifests["mxnet_tpu/serve/server.py"]
+    # ISSUE 17: DRAIN retires a replica (re-asserting keeps the FIRST
+    # deadline, so a retried DRAIN is a no-op = idempotent)
     assert set(serve) == {"PREDICT", "GENERATE", "STREAM", "HEALTH",
-                          "METRICS", "SWAP", "STOP"}
+                          "METRICS", "SWAP", "STOP", "DRAIN"}
     assert serve["PREDICT"]["semantics"] == "replayable"
     # ISSUE 15: a replayed COMPLETED generation answers from the cache;
     # STREAM is the server->client chunk frame (handled with an explicit
     # error if a client ever emits it as a request)
     assert serve["GENERATE"]["semantics"] == "replayable"
     assert serve["STREAM"]["semantics"] == "idempotent"
+    assert serve["DRAIN"]["semantics"] == "idempotent"
+    # ISSUE 17: the router speaks the same surface plus its own DRAIN;
+    # forwarded verbs keep the replica's replay semantics (the envelope
+    # crosses unmodified, so exactly-once stays with the replica cache)
+    assert "mxnet_tpu/serve/router.py" in manifests
+    rt = manifests["mxnet_tpu/serve/router.py"]
+    assert set(rt) == {"PREDICT", "GENERATE", "STREAM", "HEALTH",
+                       "METRICS", "SWAP", "STOP", "DRAIN"}
+    assert rt["PREDICT"]["semantics"] == "replayable"
+    assert rt["GENERATE"]["semantics"] == "replayable"
+    assert rt["DRAIN"]["semantics"] == "idempotent"
     kv = manifests["mxnet_tpu/kvstore/server.py"]
     # ISSUE 16: PULLQ (quantized pull — a read, idempotent like PULL)
     # and the elastic membership verbs JOIN/LEAVE/MEMBERS (no-op

@@ -1,0 +1,688 @@
+"""Serving fleet tier tests (ISSUE 17): the session router's pinning /
+membership / drain state machine as pure units, the routed wire path
+(verbatim forwarding, exactly-once replay through the extra hop,
+failover and spill), drain-not-kill semantics against the decode
+oracle (bit-identical completion, deadline-overrun re-prefill
+failover, killed-replica pinned-session failover), and the SLO-burn
+autoscaler's hysteresis/cooldown schedule on the virtual clock.
+
+The in-process tests drive real sockets but fabricate membership and
+load signals directly on the ServeRouter object (no collector, no
+refresh races); the one slow CLI lane goes through ``launch.py
+--route`` end to end.
+"""
+import importlib.util
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import fault
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.serve import (BucketTable, Servable, ServeClient,
+                             ServeServer, serve_forever)
+from mxnet_tpu.serve.demo import DEMO_IN, demo_block, demo_example, \
+    demo_expected
+from mxnet_tpu.serve.router import ServeRouter, serve_router_forever
+from mxnet_tpu.telemetry import registry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fault.clear()
+    yield
+    fault.clear()
+
+
+# ---------------------------------------------------------------------------
+# routing state machine (no sockets)
+# ---------------------------------------------------------------------------
+
+
+def test_route_least_loaded_then_pins():
+    rt = ServeRouter(replicas=["a:1", "b:2"])
+    rt._signals = {"a:1": {"queue_rows": 9.0},
+                   "b:2": {"queue_rows": 1.0, "active_slots": 1.0}}
+    assert rt.route("cid") == "b:2"          # least loaded wins
+    rt._signals = {"a:1": {"queue_rows": 0.0},
+                   "b:2": {"queue_rows": 99.0}}
+    # the pin outlives the load signal flipping: sessions stick
+    assert rt.route("cid") == "b:2"
+    # a different session sees the new signals
+    assert rt.route("other") == "a:1"
+
+
+def test_pin_cap_lru_evicts_oldest(monkeypatch):
+    monkeypatch.setenv("MX_ROUTER_PIN_CAP", "2")
+    rt = ServeRouter(replicas=["a:1"])
+    rt.route("s1")
+    rt.route("s2")
+    rt.route("s1")                           # LRU touch: s1 is recent
+    rt.route("s3")                           # over cap: s2 evicted
+    assert set(rt._pins) == {"s1", "s3"}
+
+
+def test_membership_reconcile_lifecycle():
+    rt = ServeRouter(replicas=["a:1", "b:2"])
+    rt.route("cid")                          # pin somewhere
+    pinned = rt._pins["cid"]
+    other = "a:1" if pinned == "b:2" else "b:2"
+    # the pinned replica leaves the authoritative list: it drains (the
+    # autoscaler DRAINs the process; the router just stops admitting)
+    rt.set_replicas([other])
+    assert rt._replicas[pinned] == "draining"
+    assert "cid" not in rt._pins             # moved off the leaver
+    assert rt.route("cid") == other
+    # dead members that left are forgotten entirely
+    rt.mark_dead(pinned)
+    rt.set_replicas([other])
+    assert pinned not in rt._replicas
+    # a returning addr rejoins up (optimistically)
+    rt.set_replicas([other, pinned])
+    assert rt._replicas[pinned] == "up"
+
+
+def test_mark_dead_unpins_sessions():
+    rt = ServeRouter(replicas=["a:1", "b:2"])
+    rt._signals = {"b:2": {"queue_rows": 50.0}}
+    assert rt.route("cid") == "a:1"
+    u0 = registry.value("router.sessions_unpinned")
+    rt.mark_dead("a:1")
+    assert "cid" not in rt._pins
+    assert registry.value("router.sessions_unpinned") == u0 + 1
+    # the session fails over to the survivor despite its load
+    assert rt.route("cid") == "b:2"
+    # no live replica at all: route must say so, not hang
+    rt.mark_dead("b:2")
+    assert rt.route("cid") is None
+
+
+def test_router_drain_admits_only_pinned_first_deadline_wins():
+    with fault.use_virtual_time() as clk:
+        rt = ServeRouter(replicas=["a:1"])
+        rt.route("old")                      # pinned before retirement
+        assert rt.admits("old") and rt.admits("new") and rt.admits(None)
+        st = rt.drain(5.0)
+        assert st["status"] == "draining" and rt.draining
+        assert rt.admits("old")              # pinned sessions keep flowing
+        assert not rt.admits("new") and not rt.admits(None)
+        clk.advance(4.0)
+        assert not rt.drain_expired()
+        rt.drain(100.0)                      # a retried DRAIN must not
+        clk.advance(2.0)                     # extend the first deadline
+        assert rt.drain_expired()
+
+
+# ---------------------------------------------------------------------------
+# routed wire path (real sockets, fabricated membership)
+# ---------------------------------------------------------------------------
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_port(port, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port),
+                                     timeout=0.2).close()
+            return
+        except OSError:
+            time.sleep(0.05)
+    raise RuntimeError("nothing came up on %d" % port)
+
+
+def _start_replica(port, buckets=(1, 4), abort_event=None):
+    state = ServeServer()
+    state.host.deploy(
+        Servable(demo_block(), version=1, buckets=BucketTable(buckets)),
+        example=demo_example())
+    stop_ev = threading.Event()
+    t = threading.Thread(
+        target=serve_forever,
+        kwargs=dict(port=port, state=state, stop_event=stop_ev,
+                    abort_event=abort_event),
+        daemon=True)
+    t.start()
+    _wait_port(port)
+    return state, stop_ev, t
+
+
+def _start_router(port, replicas):
+    rt = ServeRouter(replicas=replicas, refresh=0.1)
+    stop_ev = threading.Event()
+    t = threading.Thread(
+        target=serve_router_forever,
+        kwargs=dict(port=port, router=rt, stop_event=stop_ev),
+        daemon=True)
+    t.start()
+    _wait_port(port)
+    return rt, stop_ev, t
+
+
+@pytest.fixture
+def fast_retry(monkeypatch):
+    monkeypatch.setenv("MX_KVSTORE_RETRY_DEADLINE", "20")
+    monkeypatch.setenv("MX_KVSTORE_RETRY_BASE", "0.05")
+    monkeypatch.setenv("MX_KVSTORE_RETRY_MAX", "0.25")
+    yield
+
+
+def test_routed_predict_round_trip(fast_retry):
+    p1, rp = _free_port(), _free_port()
+    _state, ev1, t1 = _start_replica(p1)
+    rt, rev, trt = _start_router(rp, ["127.0.0.1:%d" % p1])
+    try:
+        cli = ServeClient(["127.0.0.1:%d" % rp], timeout=15)
+        net = demo_block()
+        x = np.random.RandomState(2).randn(3, DEMO_IN).astype(np.float32)
+        version, outs = cli.predict([x])
+        assert version == 1
+        np.testing.assert_allclose(outs[0], demo_expected(x, net=net),
+                                   rtol=1e-5, atol=1e-6)
+        # HEALTH is answered by the ROUTER itself (fleet-tier state)
+        h = cli.health()
+        assert h["role"] == "router" and h["status"] == "routing"
+        assert h["replicas"] == {"127.0.0.1:%d" % p1: "up"}
+        cli.close()
+    finally:
+        rev.set()
+        ev1.set()
+        trt.join(timeout=10)
+        t1.join(timeout=10)
+
+
+@pytest.mark.chaos
+def test_replay_through_router_is_exactly_once(fast_retry):
+    """A reply lost between router and client: the client replays the
+    same seq through the router, the router forwards it VERBATIM, and
+    the REPLICA's exactly-once cache answers — no second dispatch."""
+    p1, rp = _free_port(), _free_port()
+    _state, ev1, t1 = _start_replica(p1)
+    _rt, rev, trt = _start_router(rp, ["127.0.0.1:%d" % p1])
+    try:
+        cli = ServeClient(["127.0.0.1:%d" % rp], timeout=15)
+        x = np.ones((1, DEMO_IN), np.float32)
+        cli.predict([x])                     # connection warm
+        b0 = registry.value("serve.batches")
+        r0 = registry.value("serve.server_replays")
+        fault.inject("serve.client.recv", action="close", after=0,
+                     count=1)
+        version, _outs = cli.predict([x])
+        assert version == 1
+        assert registry.value("serve.server_replays") == r0 + 1
+        assert registry.value("serve.batches") == b0 + 1, \
+            "the replay through the router burned a second dispatch"
+        cli.close()
+    finally:
+        rev.set()
+        ev1.set()
+        trt.join(timeout=10)
+        t1.join(timeout=10)
+
+
+@pytest.mark.chaos
+def test_killed_replica_fails_over_pinned_sessions(fast_retry):
+    """SIGKILL analog: sever the pinned replica mid-conversation.  The
+    router absorbs the failover (dead mark, unpin, replay on the
+    survivor) — the client never sees an error."""
+    p1, p2, rp = _free_port(), _free_port(), _free_port()
+    ab1 = threading.Event()
+    _s1, _ev1, t1 = _start_replica(p1, buckets=(2,), abort_event=ab1)
+    _s2, ev2, t2 = _start_replica(p2, buckets=(2,))
+    a1, a2 = "127.0.0.1:%d" % p1, "127.0.0.1:%d" % p2
+    rt, rev, trt = _start_router(rp, [a1])   # pin lands on replica 1
+    try:
+        cli = ServeClient(["127.0.0.1:%d" % rp], timeout=15)
+        net = demo_block()
+        rng = np.random.RandomState(4)
+        x = rng.randn(2, DEMO_IN).astype(np.float32)
+        cli.predict([x])
+        assert list(rt._pins.values()) == [a1]
+        rt.set_replicas([a1, a2])            # survivor joins
+        f0 = registry.value("router.failovers")
+        cf0 = registry.value("serve.client_failovers")
+        ab1.set()                            # kill the pinned replica
+        for _ in range(3):
+            x = rng.randn(2, DEMO_IN).astype(np.float32)
+            version, outs = cli.predict([x])
+            np.testing.assert_allclose(outs[0],
+                                       demo_expected(x, net=net),
+                                       rtol=1e-5, atol=1e-6)
+        assert registry.value("router.failovers") > f0
+        assert rt._replicas[a1] == "dead"
+        assert list(rt._pins.values()) == [a2]
+        # the failover happened ROUTER-side: the client saw nothing
+        assert registry.value("serve.client_failovers") == cf0
+        cli.close()
+    finally:
+        ab1.set()
+        ev2.set()
+        rev.set()
+        trt.join(timeout=10)
+        t1.join(timeout=10)
+        t2.join(timeout=10)
+
+
+@pytest.mark.chaos
+def test_draining_refusal_spills_and_repins(fast_retry):
+    """A replica that starts draining refuses with a NORMAL reply; the
+    router believes it before the membership file catches up, spills
+    the request to the next-best replica, and re-pins the session."""
+    p1, p2, rp = _free_port(), _free_port(), _free_port()
+    s1, ev1, t1 = _start_replica(p1, buckets=(2,))
+    _s2, ev2, t2 = _start_replica(p2, buckets=(2,))
+    a1, a2 = "127.0.0.1:%d" % p1, "127.0.0.1:%d" % p2
+    rt, rev, trt = _start_router(rp, [a1])
+    try:
+        cli = ServeClient(["127.0.0.1:%d" % rp], timeout=15)
+        net = demo_block()
+        x = np.random.RandomState(5).randn(2, DEMO_IN).astype(np.float32)
+        cli.predict([x])
+        assert list(rt._pins.values()) == [a1]
+        rt.set_replicas([a1, a2])
+        sp0 = registry.value("router.spills")
+        s1.drain(timeout=30.0)               # replica 1 starts retiring
+        version, outs = cli.predict([x])
+        np.testing.assert_allclose(outs[0], demo_expected(x, net=net),
+                                   rtol=1e-5, atol=1e-6)
+        assert registry.value("router.spills") == sp0 + 1
+        assert rt._replicas[a1] == "draining"
+        assert list(rt._pins.values()) == [a2]
+        cli.close()
+    finally:
+        rev.set()
+        ev1.set()
+        ev2.set()
+        trt.join(timeout=10)
+        t1.join(timeout=10)
+        t2.join(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# drain-not-kill vs the decode oracle
+# ---------------------------------------------------------------------------
+
+
+DCFG = dict(dim=16, heads=2, layers=2, slots=4, max_tokens=12,
+            prompt_buckets=(4, 8))
+
+
+@pytest.fixture(scope="module")
+def decode_ref():
+    from mxnet_tpu.serve.decode import DecodeConfig, DecodeServable
+    cfg = DecodeConfig(**DCFG)
+    sv = DecodeServable(config=cfg)
+    return sv.params, cfg
+
+
+def _start_decode_replica(port, params, cfg, abort_event=None,
+                          on_tick=None):
+    from mxnet_tpu.serve.decode import DecodeBatcher, DecodeServable
+    sv = DecodeServable(params=params, config=cfg)
+    state = ServeServer(decode=DecodeBatcher(sv, on_tick=on_tick))
+    stop_ev = threading.Event()
+    t = threading.Thread(
+        target=serve_forever,
+        kwargs=dict(port=port, state=state, stop_event=stop_ev,
+                    abort_event=abort_event),
+        daemon=True)
+    t.start()
+    _wait_port(port)
+    return state, stop_ev, t
+
+
+@pytest.mark.chaos
+def test_drain_completes_inflight_bit_identical(fast_retry, decode_ref):
+    """Mid-generation retirement must DRAIN: the in-flight sequence
+    finishes bit-identical to the uninterrupted oracle, new work is
+    refused with a normal reply, and the serve loop exits cleanly once
+    the replica is empty."""
+    from mxnet_tpu.serve.decode import reference_generate
+    params, cfg = decode_ref
+    port = _free_port()
+    # ~20ms/step pump so the DRAIN lands MID-generation, not after it
+    state, _stop, t = _start_decode_replica(
+        port, params, cfg, on_tick=lambda: time.sleep(0.02))
+    addr = "127.0.0.1:%d" % port
+    ref = reference_generate([6, 2, 8], 12, params=params, config=cfg)
+    result = {}
+
+    def call():
+        with ServeClient([addr], timeout=30) as cli:
+            result["out"] = cli.generate([6, 2, 8], max_tokens=12)
+
+    gen = threading.Thread(target=call, daemon=True)
+    gen.start()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if state.decode.active_count() > 0:
+            break
+        time.sleep(0.001)
+    assert state.decode.active_count() > 0, "generation never started"
+    with ServeClient([addr], timeout=15) as dc:
+        st = dc.drain(timeout=30.0)
+        assert st["status"] == "draining"
+        # admission is CLOSED while the in-flight generation finishes
+        with pytest.raises(MXNetError, match="draining"):
+            dc.generate([1, 2], max_tokens=2)
+    gen.join(timeout=60)
+    assert "out" in result, "drain lost the in-flight generation"
+    _version, toks = result["out"]
+    assert toks == ref, "drained generation diverged from the oracle"
+    # drained clean: the serve loop exits by itself, no STOP needed
+    t.join(timeout=30)
+    assert not t.is_alive(), "serve loop kept running after drain"
+    state.close()
+
+
+@pytest.mark.chaos
+def test_drain_deadline_overrun_fails_over_stragglers(fast_retry,
+                                                      decode_ref):
+    """A drain deadline too short for the in-flight generation: the
+    straggler's connection is severed with NO reply, the ROUTER marks
+    the replica dead and replays the envelope on the survivor, which
+    re-prefills — the caller still gets the exact sequence and never
+    sees the failover."""
+    from mxnet_tpu.serve.decode import reference_generate
+    params, cfg = decode_ref
+    p1, p2, rp = _free_port(), _free_port(), _free_port()
+    # replica 1 is slow (~50ms/step) so the overrun is guaranteed;
+    # replica 2 (same params) is the survivor
+    state1, _st1, t1 = _start_decode_replica(
+        p1, params, cfg, on_tick=lambda: time.sleep(0.05))
+    state2, st2, t2 = _start_decode_replica(p2, params, cfg)
+    a1, a2 = "127.0.0.1:%d" % p1, "127.0.0.1:%d" % p2
+    rt, rev, trt = _start_router(rp, [a1])   # session pins on replica 1
+    ref = reference_generate([6, 2, 8], 12, params=params, config=cfg)
+    f0 = registry.value("router.failovers")
+    cf0 = registry.value("serve.client_failovers")
+    result = {}
+
+    def call():
+        with ServeClient(["127.0.0.1:%d" % rp], timeout=60) as cli:
+            result["out"] = cli.generate([6, 2, 8], max_tokens=12)
+
+    gen = threading.Thread(target=call, daemon=True)
+    gen.start()
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if state1.decode.active_count() > 0:
+                break
+            time.sleep(0.001)
+        assert state1.decode.active_count() > 0
+        rt.set_replicas([a1, a2])            # survivor joins
+        # a deadline far shorter than the generation: overrun is the
+        # point — the straggler must be severed and fail over
+        with ServeClient([a1], timeout=15) as dc:
+            dc.drain(timeout=0.05)
+        gen.join(timeout=60)
+        assert "out" in result, "generation lost in the overrun"
+        _version, toks = result["out"]
+        assert toks == ref
+        assert registry.value("router.failovers") > f0
+        # the failover was absorbed router-side
+        assert registry.value("serve.client_failovers") == cf0
+    finally:
+        rev.set()
+        st2.set()
+        trt.join(timeout=10)
+        t1.join(timeout=15)
+        t2.join(timeout=10)
+        state1.close()
+        state2.close()
+
+
+# ---------------------------------------------------------------------------
+# autoscaler hysteresis on the virtual clock
+# ---------------------------------------------------------------------------
+
+
+def _load_launch():
+    spec = importlib.util.spec_from_file_location(
+        "mx_launch_router_test", os.path.join(REPO, "tools", "launch.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+launch = _load_launch()
+
+
+class _FakeSLO:
+    def __init__(self):
+        self.resets = 0
+
+    def reset(self):
+        self.resets += 1
+
+
+class _FakeFleet:
+    """snapshot()-shaped stand-in: tests fabricate scrape rounds."""
+
+    def __init__(self):
+        self.snap = None
+        self.added = []
+        self.retired = []
+        self.slo = _FakeSLO()
+
+    def snapshot(self):
+        return self.snap
+
+    def add_member(self, m):
+        self.added.append(m)
+
+    def retire(self, key):
+        self.retired.append(key)
+
+
+def _mk_autoscaled(tmp_path, monkeypatch, replicas=1, mn=1, mx=3,
+                   hold=2, cooldown=10.0):
+    monkeypatch.setenv("MX_AUTOSCALE_HOLD", str(hold))
+    monkeypatch.setenv("MX_AUTOSCALE_COOLDOWN", str(cooldown))
+    logs = []
+    sup = launch.Supervisor(restart="never",
+                            log=lambda m: logs.append(m))
+    sup._fault = fault                  # _now() rides the virtual clock
+    sup.autoscale = (mn, mx)
+    sup.replicas_file = str(tmp_path / "replicas")
+    spawned = []
+    monkeypatch.setattr(launch.Supervisor, "_spawn",
+                        lambda self, sp: spawned.append(sp.name))
+
+    def factory(idx):
+        addr = "127.0.0.1:%d" % (9700 + idx)
+        return "serve-%d" % idx, ["true"], {}, addr, None
+
+    sup.serve_factory = factory
+    fl = _FakeFleet()
+    sup.fleet = fl
+    for i in range(replicas):
+        sup.add("serve-%d" % i, ["true"], {},
+                role="serve", addr="127.0.0.1:%d" % (9700 + i))
+    sup._as_next_index = replicas
+    sup._write_replicas_file()
+    return sup, fl, spawned, logs
+
+
+def _round(sup, fl, burn):
+    fl.snap = {"scrape": getattr(fl, "_round", 0) + 1,
+               "slo": {"burn": {"serve_p99_ms": burn}}}
+    fl._round = fl.snap["scrape"]
+    sup._check_autoscale()
+
+
+def _replicas_file(sup):
+    with open(sup.replicas_file) as f:
+        return [ln.strip() for ln in f if ln.strip()]
+
+
+def test_autoscaler_spawns_after_hold_then_cools_down(tmp_path,
+                                                      monkeypatch):
+    with fault.use_virtual_time() as clk:
+        sup, fl, spawned, logs = _mk_autoscaled(tmp_path, monkeypatch)
+        _round(sup, fl, 2.0)
+        assert spawned == []                 # one breach round: held
+        _round(sup, fl, 2.0)
+        assert spawned == ["serve-1"]        # held MX_AUTOSCALE_HOLD
+        assert _replicas_file(sup) == ["127.0.0.1:9700",
+                                       "127.0.0.1:9701"]
+        assert [m.key for m in fl.added]     # registered with the plane
+        # burn stays breached, but the cooldown gates the next action
+        _round(sup, fl, 2.0)
+        _round(sup, fl, 2.0)
+        assert spawned == ["serve-1"]
+        clk.advance(100.0)                   # cooldown over
+        _round(sup, fl, 2.0)
+        assert spawned == ["serve-1", "serve-2"]
+        # MAX replicas: breach forever, never exceed the bound
+        clk.advance(100.0)
+        for _ in range(5):
+            _round(sup, fl, 2.0)
+        assert len(sup._serve_procs()) == 3
+        assert any("spawning serve-1" in m for m in logs)
+
+
+def test_autoscaler_retires_drain_not_kill(tmp_path, monkeypatch):
+    with fault.use_virtual_time() as clk:
+        sup, fl, _spawned, logs = _mk_autoscaled(tmp_path, monkeypatch,
+                                                 replicas=2)
+        drained = []
+        monkeypatch.setattr(launch, "_send_drain",
+                            lambda addr, **kw: drained.append(addr))
+        _round(sup, fl, 0.0)
+        _round(sup, fl, 0.0)
+        sp1 = sup.procs[-1]
+        assert sp1.draining                  # newest replica retires
+        # admission closed at the ROUTER first: the file shrank BEFORE
+        # (well, with) the DRAIN courtesy to the replica itself
+        assert _replicas_file(sup) == ["127.0.0.1:9700"]
+        assert drained == ["127.0.0.1:9701"]
+        assert fl.slo.resets == 1            # stale latches un-latched
+        assert any("drain-not-kill" in m for m in logs)
+        # MIN floor: burn stays low forever, the last replica survives
+        clk.advance(100.0)
+        for _ in range(5):
+            _round(sup, fl, 0.0)
+        assert len(sup._serve_procs()) == 1
+
+
+def test_autoscaler_hysteresis_band_holds_steady(tmp_path, monkeypatch):
+    with fault.use_virtual_time():
+        sup, fl, spawned, _logs = _mk_autoscaled(tmp_path, monkeypatch)
+        drained = []
+        monkeypatch.setattr(launch, "_send_drain",
+                            lambda addr, **kw: drained.append(addr))
+        # a band round (between DOWN_BURN and UP_BURN) resets BOTH
+        # holds: breach-band-breach never accumulates to an action
+        for burn in (2.0, 0.75, 2.0, 0.75, 0.0, 0.75, 0.0):
+            _round(sup, fl, burn)
+        assert spawned == [] and drained == []
+
+
+def test_autoscaler_drain_failure_falls_back_to_kill(tmp_path,
+                                                     monkeypatch):
+    with fault.use_virtual_time():
+        sup, fl, _spawned, logs = _mk_autoscaled(tmp_path, monkeypatch,
+                                                 replicas=2)
+
+        def boom(addr, **kw):
+            raise OSError("connection refused")
+
+        monkeypatch.setattr(launch, "_send_drain", boom)
+        killed = []
+        monkeypatch.setattr(launch.Supervisor, "_kill",
+                            lambda self, sp: killed.append(sp.name))
+        _round(sup, fl, 0.0)
+        _round(sup, fl, 0.0)
+        assert killed == ["serve-1"]
+        assert any("DRAIN failed" in m for m in logs)
+
+
+# ---------------------------------------------------------------------------
+# catalog + CLI lane
+# ---------------------------------------------------------------------------
+
+
+def test_router_env_knobs_are_cataloged():
+    from mxnet_tpu.base import ENV_CATALOG
+    for name in ("MX_ROUTER_PORT", "MX_ROUTER_REPLICAS",
+                 "MX_ROUTER_REPLICAS_FILE", "MX_ROUTER_REFRESH",
+                 "MX_ROUTER_FLEET", "MX_ROUTER_PIN_CAP",
+                 "MX_ROUTER_DRAIN_TIMEOUT", "MX_AUTOSCALE_UP_BURN",
+                 "MX_AUTOSCALE_DOWN_BURN", "MX_AUTOSCALE_HOLD",
+                 "MX_AUTOSCALE_COOLDOWN"):
+        assert name in ENV_CATALOG, name
+        default, doc = ENV_CATALOG[name]
+        assert doc
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_cli_router_drain_not_kill_mid_load(tmp_path):
+    """The slow CLI lane: `launch.py --route` fronting two demo
+    replicas, 40 verified predicts through the router while one replica
+    is DRAINed mid-load (clean exit, no restart), then STOP through the
+    router folds the whole fleet to exit 0."""
+    while True:
+        base = _free_port()
+        try:
+            s = socket.socket()
+            s.bind(("", base + 1))
+            s.close()
+            break
+        except OSError:
+            continue
+    rport = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("MX_FAULT_INJECT", None)
+    env.update(JAX_PLATFORMS="cpu", MX_FORCE_CPU="1",
+               PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""))
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--launcher", "local", "--restart", "on-failure",
+         "--hang-timeout", "60",
+         "--serve-port-base", str(base), "--route", str(rport), "--",
+         sys.executable, "-m", "mxnet_tpu.serve", "--demo",
+         "--port-base", str(base)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    try:
+        for port in (base, base + 1, rport):
+            _wait_port(port, timeout=180.0)
+        net = demo_block()
+        rng = np.random.RandomState(6)
+        cli = ServeClient(["127.0.0.1:%d" % rport], timeout=30)
+        for i in range(40):
+            if i == 15:
+                with ServeClient(["127.0.0.1:%d" % base],
+                                 timeout=15) as dc:
+                    st = dc.drain(timeout=20.0)
+                    assert st["status"] == "draining"
+            x = rng.randn(2, DEMO_IN).astype(np.float32)
+            _version, outs = cli.predict([x])
+            np.testing.assert_allclose(outs[0],
+                                       demo_expected(x, net=net),
+                                       rtol=1e-5, atol=1e-6)
+        cli.stop()
+        cli.close()
+        out, _ = proc.communicate(timeout=120)
+    except Exception:
+        proc.kill()
+        raise
+    assert proc.returncode == 0, out

@@ -236,6 +236,15 @@ def extract_record(report: dict) -> dict:
         rec["decode_kv_pool_flat"] = bool(dec.get("kv_pool_flat"))
         rec["decode_zero_retraces"] = bool(
             dec.get("zero_serve_time_retraces"))
+    # ISSUE 17: routed-lane gated series — the session router's
+    # forwarding tax is an ABSOLUTE acceptance (routed p50 AND p99
+    # within 10% of direct-to-replica, or the ADDED latency under the
+    # probe's flat ms floor), not a trajectory
+    routed = report.get("routed") or {}
+    if routed:
+        rec["routed_p50_overhead_pct"] = routed.get("p50_overhead_pct")
+        rec["routed_p99_overhead_pct"] = routed.get("p99_overhead_pct")
+        rec["routed_within_gate"] = bool(routed.get("within_gate"))
     # ISSUE 16: hierarchical-exchange gated series — the two-tier
     # cross-slice byte reduction is an ABSOLUTE acceptance (the
     # promoted int8 return leg must move fewer bytes than the flat
@@ -282,6 +291,15 @@ def gate(rec, history, throughput_tol, memory_tol):
                 "moved no fewer cross-slice wire bytes than the flat "
                 "int8 exchange (reduction %s <= 1x)"
                 % rec.get("hier_cross_slice_reduction"))
+            return False, findings
+        if "routed_within_gate" in rec and \
+                not rec["routed_within_gate"]:
+            findings.append(
+                "ROUTED-OVERHEAD REGRESSION: p50 %s%% / p99 %s%% "
+                "through the session router exceed the 10%% gate over "
+                "direct-to-replica (and the added ms floor)"
+                % (rec.get("routed_p50_overhead_pct"),
+                   rec.get("routed_p99_overhead_pct")))
             return False, findings
         return True, findings
     # Throughput gates within the record's own lane CLASS: same input-
@@ -353,6 +371,21 @@ def gate(rec, history, throughput_tol, memory_tol):
             findings.append(
                 "DECODE RETRACE REGRESSION: serve-time retraces "
                 "after warmup (the bucket tables must be closed)")
+    # ISSUE 17 gated series: the session router's forwarding tax
+    if "routed_within_gate" in rec:
+        if not rec["routed_within_gate"]:
+            ok = False
+            findings.append(
+                "ROUTED-OVERHEAD REGRESSION: p50 %s%% / p99 %s%% "
+                "through the session router exceed the 10%% gate over "
+                "direct-to-replica (and the added ms floor)"
+                % (rec.get("routed_p50_overhead_pct"),
+                   rec.get("routed_p99_overhead_pct")))
+        else:
+            findings.append(
+                "routed overhead p50 %s%% / p99 %s%% within the gate"
+                % (rec.get("routed_p50_overhead_pct"),
+                   rec.get("routed_p99_overhead_pct")))
     # ISSUE 16 gated series: the hierarchical exchange's acceptance —
     # two-tier must beat flat dist_async on cross-slice wire bytes
     if "hier_fewer_bytes_ok" in rec:

@@ -355,18 +355,275 @@ grep -q 'SERVE_LOAD_OK' "$WORK/decode_load.log" || {
 }
 echo "chaos_smoke: decode chaos PASS (failover + re-prefill, sequences exact)"
 
-echo "== chaos_smoke: serve dispatch budgets (1/batch, 1/decode step)"
-"$PY" "$REPO/tools/dispatch_count.py" --serve --decode > "$WORK/serve_budget.json"
+echo "== chaos_smoke: session router - kill a replica UNDER the router (ISSUE 17)"
+# the fleet front-tier: one router address fronting two supervised
+# decode replicas.  The serve.request fault kills a replica mid-load;
+# the ROUTER absorbs the failover (re-pins the dead replica's sessions,
+# re-prefills stragglers on the survivor) while the client keeps
+# talking to the one address it knows.  Every GENERATE answer is
+# verified against the local reference decode THROUGH the router —
+# exactly-once end to end: a retry through the router must replay from
+# the replica's cache, never burn a second prefill with different
+# tokens.
+ROUTER_BASE=$("$PY" - <<'EOF'
+import socket
+while True:
+    s1 = socket.socket(); s1.bind(("", 0)); p = s1.getsockname()[1]
+    s2 = socket.socket()
+    try:
+        s2.bind(("", p + 1))
+    except OSError:
+        s1.close(); s2.close(); continue
+    s1.close(); s2.close(); print(p); break
+EOF
+)
+ROUTER_PORT=$("$PY" - <<'EOF'
+import socket
+s = socket.socket(); s.bind(("", 0)); print(s.getsockname()[1]); s.close()
+EOF
+)
+rc=0
+PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}" \
+"$PY" "$REPO/tools/launch.py" -n 2 --launcher local \
+    --restart on-failure --max-restarts 3 --hang-timeout 60 \
+    --serve-port-base "$ROUTER_BASE" --route "$ROUTER_PORT" \
+    --fault 'serve.request:crash:after=50' -- \
+    "$PY" -m mxnet_tpu.serve --decode --port-base "$ROUTER_BASE" \
+    > "$WORK/router.log" 2>&1 &
+ROUTER_LAUNCH_PID=$!
+# the router binds instantly but decode replicas bind only once warm —
+# wait for the REPLICA ports too, or the first routed request spends
+# its whole retry deadline probing a fleet that isn't up yet
+"$PY" - "$ROUTER_BASE" <<'EOF'
+import socket, sys, time
+base = int(sys.argv[1])
+for port in (base, base + 1):
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port),
+                                     timeout=0.5).close()
+            break
+        except OSError:
+            time.sleep(0.2)
+    else:
+        raise SystemExit("replica on %d never came up" % port)
+EOF
+"$PY" "$REPO/tools/serve_load.py" \
+    --addrs "127.0.0.1:$ROUTER_PORT" --routed \
+    --decode --requests 100 --chaos --stop 2>&1 \
+    | tee "$WORK/router_load.log" || rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "chaos_smoke: FAIL - routed load driver exited $rc" >&2
+    kill "$ROUTER_LAUNCH_PID" 2>/dev/null || true
+    cat "$WORK/router.log" >&2 || true
+    exit 1
+fi
+wait "$ROUTER_LAUNCH_PID" || rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "chaos_smoke: FAIL - routed launch.py exited $rc" >&2
+    cat "$WORK/router.log" >&2 || true
+    exit 1
+fi
+grep -q 'restart 1/' "$WORK/router.log" || {
+    echo "chaos_smoke: FAIL - no replica was restarted under the router" >&2
+    exit 1
+}
+grep -q 'SERVE_LOAD_OK' "$WORK/router_load.log" || {
+    echo "chaos_smoke: FAIL - routed load driver never reported OK" >&2
+    exit 1
+}
+echo "chaos_smoke: router chaos PASS (replica killed, router absorbed it, 100/100 exact)"
+
+echo "== chaos_smoke: session router - kill the ROUTER itself mid-load (ISSUE 17)"
+# router-targeted fault burst: the router.request crash site kills the
+# front tier mid-request.  The supervisor restarts it; the client fails
+# over (reconnect + SEQ replay through the fresh router), the replicas'
+# replay caches dedupe anything already dispatched — 100/100 verified
+# answers with zero double-dispatches.
+RB2=$("$PY" - <<'EOF'
+import socket
+while True:
+    s1 = socket.socket(); s1.bind(("", 0)); p = s1.getsockname()[1]
+    s2 = socket.socket()
+    try:
+        s2.bind(("", p + 1))
+    except OSError:
+        s1.close(); s2.close(); continue
+    s1.close(); s2.close(); print(p); break
+EOF
+)
+RP2=$("$PY" - <<'EOF'
+import socket
+s = socket.socket(); s.bind(("", 0)); print(s.getsockname()[1]); s.close()
+EOF
+)
+rc=0
+PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}" \
+"$PY" "$REPO/tools/launch.py" -n 2 --launcher local \
+    --restart on-failure --max-restarts 3 --hang-timeout 60 \
+    --serve-port-base "$RB2" --route "$RP2" \
+    --fault 'router.request:crash:after=60' -- \
+    "$PY" -m mxnet_tpu.serve --demo --port-base "$RB2" \
+    > "$WORK/router2.log" 2>&1 &
+ROUTER2_LAUNCH_PID=$!
+"$PY" - "$RB2" <<'EOF'
+import socket, sys, time
+base = int(sys.argv[1])
+for port in (base, base + 1):
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port),
+                                     timeout=0.5).close()
+            break
+        except OSError:
+            time.sleep(0.2)
+    else:
+        raise SystemExit("replica on %d never came up" % port)
+EOF
+"$PY" "$REPO/tools/serve_load.py" \
+    --addrs "127.0.0.1:$RP2" --routed \
+    --requests 100 --chaos --stop 2>&1 \
+    | tee "$WORK/router2_load.log" || rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "chaos_smoke: FAIL - router-kill load driver exited $rc" >&2
+    kill "$ROUTER2_LAUNCH_PID" 2>/dev/null || true
+    cat "$WORK/router2.log" >&2 || true
+    exit 1
+fi
+wait "$ROUTER2_LAUNCH_PID" || rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "chaos_smoke: FAIL - router-kill launch.py exited $rc" >&2
+    cat "$WORK/router2.log" >&2 || true
+    exit 1
+fi
+grep -q 'restart 1/' "$WORK/router2.log" || {
+    echo "chaos_smoke: FAIL - the router was never restarted" >&2
+    exit 1
+}
+grep -q 'SERVE_LOAD_OK' "$WORK/router2_load.log" || {
+    echo "chaos_smoke: FAIL - router-kill load never reported OK" >&2
+    exit 1
+}
+echo "chaos_smoke: router-kill chaos PASS (front tier restarted, 100/100 exact)"
+
+echo "== chaos_smoke: autoscaler - 4x Poisson spike absorbed, drains back (ISSUE 17)"
+# SLO-burn autoscaler: 1-3 replicas behind the router, a 1ms p99 target
+# any sustained traffic breaches.  The Poisson spike must burn the SLO
+# -> spawn(s) observed while EVERY answer stays verified-correct; once
+# the spike ends the rolling window ages out, burn drops under the
+# scale-down band, and the newest replica retires DRAIN-not-kill.
+AS_BASE=$("$PY" - <<'EOF'
+import socket
+while True:
+    s1 = socket.socket(); s1.bind(("", 0)); p = s1.getsockname()[1]
+    ss = []
+    try:
+        for off in (1, 2):
+            s = socket.socket(); s.bind(("", p + off)); ss.append(s)
+    except OSError:
+        s1.close(); [s.close() for s in ss]; continue
+    s1.close(); [s.close() for s in ss]; print(p); break
+EOF
+)
+AS_PORT=$("$PY" - <<'EOF'
+import socket
+s = socket.socket(); s.bind(("", 0)); print(s.getsockname()[1]); s.close()
+EOF
+)
+rc=0
+PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}" \
+MX_FLEET_INTERVAL=0.5 MX_FLEET_SLO_P99_MS=1 \
+MX_AUTOSCALE_HOLD=2 MX_AUTOSCALE_COOLDOWN=1 \
+"$PY" "$REPO/tools/launch.py" -n 1 --launcher local \
+    --restart on-failure --hang-timeout 60 \
+    --serve-port-base "$AS_BASE" --route "$AS_PORT" --autoscale 1:3 -- \
+    "$PY" -m mxnet_tpu.serve --demo --port-base "$AS_BASE" \
+    > "$WORK/autoscale.log" 2>&1 &
+AS_LAUNCH_PID=$!
+"$PY" - "$AS_BASE" <<'EOF'
+import socket, sys, time
+port = int(sys.argv[1])
+deadline = time.monotonic() + 120
+while time.monotonic() < deadline:
+    try:
+        socket.create_connection(("127.0.0.1", port), timeout=0.5).close()
+        break
+    except OSError:
+        time.sleep(0.2)
+else:
+    raise SystemExit("replica on %d never came up" % port)
+EOF
+# the 4x spike: open-loop Poisson arrivals at 40/s vs the 10/s baseline
+# trickle, all through the router, every answer verified
+"$PY" "$REPO/tools/serve_load.py" \
+    --addrs "127.0.0.1:$AS_PORT" --routed \
+    --requests 30 --poisson 10 > "$WORK/as_baseline.log" 2>&1 || rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "chaos_smoke: FAIL - autoscaler baseline load exited $rc" >&2
+    kill "$AS_LAUNCH_PID" 2>/dev/null || true
+    cat "$WORK/autoscale.log" >&2 || true
+    exit 1
+fi
+"$PY" "$REPO/tools/serve_load.py" \
+    --addrs "127.0.0.1:$AS_PORT" --routed \
+    --requests 240 --poisson 40 2>&1 \
+    | tee "$WORK/as_spike.log" || rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "chaos_smoke: FAIL - autoscaler spike load exited $rc" >&2
+    kill "$AS_LAUNCH_PID" 2>/dev/null || true
+    cat "$WORK/autoscale.log" >&2 || true
+    exit 1
+fi
+# spike over: wait for the scale-down (window ages out -> burn ~0 ->
+# hold -> drain-not-kill retire), then stop the fleet
+for _i in $(seq 1 120); do
+    grep -q 'drain-not-kill' "$WORK/autoscale.log" && break
+    sleep 0.5
+done
+"$PY" "$REPO/tools/serve_load.py" \
+    --addrs "127.0.0.1:$AS_PORT" --routed \
+    --requests 0 --stop > "$WORK/as_stop.log" 2>&1 || true
+wait "$AS_LAUNCH_PID" || rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "chaos_smoke: FAIL - autoscaler launch.py exited $rc" >&2
+    cat "$WORK/autoscale.log" >&2 || true
+    exit 1
+fi
+grep -q 'autoscale: .* spawning' "$WORK/autoscale.log" || {
+    echo "chaos_smoke: FAIL - the spike never spawned a replica" >&2
+    cat "$WORK/autoscale.log" >&2 || true
+    exit 1
+}
+grep -q 'drain-not-kill' "$WORK/autoscale.log" || {
+    echo "chaos_smoke: FAIL - the fleet never drained back down" >&2
+    cat "$WORK/autoscale.log" >&2 || true
+    exit 1
+}
+grep -q 'SERVE_LOAD_OK' "$WORK/as_spike.log" || {
+    echo "chaos_smoke: FAIL - spike load never reported OK" >&2
+    exit 1
+}
+echo "chaos_smoke: autoscaler PASS (spike spawned, drained back, all answers exact)"
+
+echo "== chaos_smoke: serve dispatch budgets (1/batch, 1/decode step, +0 routed)"
+"$PY" "$REPO/tools/dispatch_count.py" --serve --decode --routed \
+    > "$WORK/serve_budget.json"
 "$PY" - "$WORK/serve_budget.json" <<'EOF'
 import json, sys
 r = json.load(open(sys.argv[1]))
 assert r["serve"]["ok"], r["serve"]
 assert r["decode"]["ok"], r["decode"]
+assert r["routed"]["ok"], r["routed"]
 print("serve budget: %(dispatches)d dispatches / %(batches)d batches, "
       "%(retraces)d retraces" % r["serve"])
 print("decode budget: %(dispatches)d dispatches = %(prefill_dispatches)d "
       "prefills + %(decode_steps)d steps, %(retraces)d retraces"
       % r["decode"])
+print("routed budget: %(routed_dispatches)d dispatches routed == "
+      "%(direct_dispatches)d direct (+%(extra_dispatches)d), "
+      "%(routed_retraces)d retraces" % r["routed"])
 EOF
 
 echo "== chaos_smoke: fleet telemetry plane - kill a replica + a worker mid-load (ISSUE 12)"

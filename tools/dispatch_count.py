@@ -273,6 +273,98 @@ def run_decode(n_gens=6, prompt_len=3, max_new=5, slots=8):
     }
 
 
+def run_routed(n_requests=24, rows_per_request=2, max_batch=8):
+    """ISSUE 17 acceptance: the session router is a PURE host-side
+    forwarder — the same PREDICT burst driven through it costs exactly
+    the device dispatches the direct-to-replica burst costs (zero
+    extra), and zero retraces either way (every launch still hits the
+    replica's pre-warmed AOT bucket table; the router never touches a
+    tensor).  One in-process replica + one in-process router share this
+    process's dispatch counter, so the comparison is exact arithmetic:
+    sequential unit-row requests with max_delay_us=0 coalesce 1:1, so
+    both lanes must count exactly ``n_requests`` dispatches."""
+    import socket
+    import threading
+    import time
+    import numpy as np
+    from mxnet_tpu.engine import engine
+    from mxnet_tpu.serve import (BucketTable, Servable, ServeClient,
+                                 ServeRouter, serve_router_forever)
+    from mxnet_tpu.serve.server import ServeServer, serve_forever
+    from mxnet_tpu.serve.demo import DEMO_IN, demo_block, demo_example
+
+    def _free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def _wait_up(port, timeout=30.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                socket.create_connection(("127.0.0.1", port),
+                                         timeout=0.2).close()
+                return
+            except OSError:
+                time.sleep(0.05)
+        raise RuntimeError("port %d never came up" % port)
+
+    rport, xport = _free_port(), _free_port()
+    sv = Servable(demo_block(), version=1,
+                  buckets=BucketTable([1, rows_per_request, max_batch]))
+    state = ServeServer(max_delay_us=0, queue_cap=256)
+    state.host.deploy(sv, example=demo_example())
+    stop_replica = threading.Event()
+    threading.Thread(target=serve_forever,
+                     kwargs=dict(port=rport, state=state,
+                                 stop_event=stop_replica),
+                     daemon=True).start()
+    _wait_up(rport)
+    rt = ServeRouter(replicas=["127.0.0.1:%d" % rport], refresh=30.0)
+    stop_router = threading.Event()
+    threading.Thread(target=serve_router_forever,
+                     kwargs=dict(port=xport, router=rt,
+                                 stop_event=stop_router),
+                     daemon=True).start()
+    _wait_up(xport)
+
+    rng = np.random.RandomState(0)
+
+    def burst(port):
+        cli = ServeClient(["127.0.0.1:%d" % port], timeout=30.0)
+        try:
+            c0 = engine.snapshot()["dispatches"]
+            r0 = sv.retraces
+            for _ in range(n_requests):
+                x = rng.randn(rows_per_request,
+                              DEMO_IN).astype(np.float32)
+                cli.predict([x])
+            return (engine.snapshot()["dispatches"] - c0,
+                    sv.retraces - r0)
+        finally:
+            cli.close()
+
+    try:
+        direct_d, direct_r = burst(rport)
+        routed_d, routed_r = burst(xport)
+    finally:
+        stop_router.set()
+        stop_replica.set()
+    return {
+        "requests": n_requests,
+        "direct_dispatches": direct_d,
+        "routed_dispatches": routed_d,
+        "extra_dispatches": routed_d - direct_d,
+        "direct_retraces": direct_r,
+        "routed_retraces": routed_r,
+        "ok": bool(direct_d == n_requests
+                   and routed_d == direct_d
+                   and direct_r == 0 and routed_r == 0),
+    }
+
+
 def run(steps=3, hidden_layers=6, hidden=16):
     """Measured eager fit; returns the report dict (no printing)."""
     import numpy as np
@@ -350,6 +442,11 @@ def main():
                          "budget: exactly 1 dispatch per decode step "
                          "regardless of active-sequence count, 1 per "
                          "prefill, 0 serve-time retraces after warmup")
+    ap.add_argument("--routed", action="store_true",
+                    help="with --serve: also pin the ISSUE 17 router "
+                         "budget: the same burst through the session "
+                         "router costs ZERO extra device dispatches "
+                         "and zero retraces vs direct-to-replica")
     ap.add_argument("--scan", type=int, default=0,
                     help="scan window size for --compiled "
                          "(default: MX_STEP_SCAN, else 4)")
@@ -390,6 +487,9 @@ def main():
     if args.decode:
         report["decode"] = run_decode()
         report["ok"] = bool(report["ok"] and report["decode"]["ok"])
+    if args.routed:
+        report["routed"] = run_routed()
+        report["ok"] = bool(report["ok"] and report["routed"]["ok"])
     print(json.dumps(report, indent=2))
     sys.exit(0 if report["ok"] else 1)
 

@@ -62,10 +62,37 @@ def _member_row(mid, meta, snap):
         work = _fmt(cval("serve.requests"), "%d")
         rate = "-"
         queue = _fmt(gval("serve.queue_rows"), "%g")
+        # decode-aware replicas (ISSUE 17): slot occupancy + KV-pool
+        # headroom ride the flags column so one table answers "can this
+        # replica take another session?"
+        occ = gval("serve.decode.slot_occupancy")
+        if occ is not None:
+            flags_extra = ["slots=%.0f%%" % (100.0 * occ)]
+            head = gval("serve.decode.kv_headroom_bytes")
+            if head is not None:
+                flags_extra.append("kv_free=%s" % _fmt(head, "%.3g"))
+        else:
+            flags_extra = []
+    elif role == "router":
+        # the fleet front-tier (ISSUE 17): forwarded requests, pinned
+        # sessions as "queue", failovers/spills as flags
+        work = _fmt(cval("router.requests"), "%d")
+        rate = "-"
+        queue = _fmt(gval("router.sessions"), "%g")
+        flags_extra = []
+        up = gval("router.replicas_up")
+        if up is not None:
+            flags_extra.append("up=%s" % _fmt(up, "%g"))
+        for cname, label in (("router.failovers", "failover"),
+                             ("router.spills", "spill")):
+            v = cval(cname)
+            if v:
+                flags_extra.append("%s=%d" % (label, v))
     else:
         work = _fmt(cval("worker.steps"), "%d")
         rate = _fmt(gval("worker.steps_per_sec"))
         queue = "-"
+        flags_extra = []
     # dominant phase: largest per-phase gauge for this member
     dom = "-"
     best = 0.0
@@ -76,7 +103,7 @@ def _member_row(mid, meta, snap):
         if v is not None and v > best:
             best = v
             dom = key.split("phase=", 1)[1].rstrip("}")
-    flags = []
+    flags = list(flags_extra)
     for f in snap.get("stragglers") or []:
         if f.get("member") == mid:
             flags.append("STRAGGLER(%.3gx %s)"
@@ -128,6 +155,9 @@ def _build_collector(args):
     for i, addr in enumerate(a for a in (args.kv or "").split(",")
                              if a.strip()):
         members.append(fleet.FleetMember("server", i, addr=addr.strip()))
+    for i, addr in enumerate(a for a in (args.router or "").split(",")
+                             if a.strip()):
+        members.append(fleet.FleetMember("router", i, addr=addr.strip()))
     if args.heartbeat_dir:
         for path in sorted(glob.glob(
                 os.path.join(args.heartbeat_dir, "rank_*"))):
@@ -155,6 +185,9 @@ def main(argv=None) -> int:
                          "scrape directly (builds a local collector)")
     ap.add_argument("--kv", default=None, metavar="ADDRS",
                     help="comma-separated parameter-server addresses")
+    ap.add_argument("--router", default=None, metavar="ADDRS",
+                    help="comma-separated session-router addresses "
+                         "(the serve tier's front, ISSUE 17)")
     ap.add_argument("--heartbeat-dir", default=None, metavar="DIR",
                     help="directory of rank_* heartbeat files (the "
                          "launch.py layout) for training workers")

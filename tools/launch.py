@@ -38,6 +38,28 @@ distinguishing *wedged* from merely *slow* (a slow rank keeps beating).
 In-process, ``MX_STEP_TIMEOUT`` (mxnet_tpu.health watchdog) converts a
 hung step into exit code 86 the supervisor sees like any other crash.
 
+Serving fleet tier (ISSUE 17): ``--serve-port-base B`` tells the
+supervisor its command is a serving replica bound at ``B + rank``, so
+each process is registered with the embedded fleet collector as a
+wire-scraped ``serve`` member (queue depth, decode occupancy, KV
+headroom — the router's routing signals).  ``--route PORT``
+additionally fronts the replicas with the session router
+(``python -m mxnet_tpu.serve.router``) reading an authoritative
+replicas file this supervisor rewrites, and ``--autoscale MIN:MAX``
+arms the SLO-burn autoscaler: when any fleet SLO burn (from the merged
+snapshot; targets via MX_FLEET_SLO_*) holds >= MX_AUTOSCALE_UP_BURN
+for MX_AUTOSCALE_HOLD scrape rounds, a warm replica is spawned into
+the spike (compile-cache makes that seconds); when every burn holds <=
+MX_AUTOSCALE_DOWN_BURN the newest replica is retired DRAIN-not-kill —
+dropped from the replicas file first (the router stops admitting),
+then the wire DRAIN verb lets its in-flight generations finish against
+a bounded deadline; the clean exit 0 is expected, not a failure.
+Post-action cooldowns back off exponentially (MX_AUTOSCALE_COOLDOWN)
+so the fleet never flaps.  A crashed replica is an involuntary retire:
+the router fails its pinned sessions over immediately, the supervisor
+restarts it (or, past the restart budget, shrinks the serve tier and
+continues, like --elastic does for workers).
+
 Elastic membership (ISSUE 16): ``--elastic`` spawns every worker with
 MX_ELASTIC=1, so each rank JOINs the parameter-server membership table
 at store init, and changes two supervisor behaviours.  Involuntary: a
@@ -117,8 +139,9 @@ class SupervisedProc:
         self.name = name
         self.argv = list(argv)
         self.env = dict(env)          # frozen: restarts reuse it verbatim
-        self.role = role              # "worker" | "server"
+        self.role = role              # "worker"|"server"|"serve"|"router"
         self.addr = addr              # host:port (servers, for STOP)
+        self.draining = False         # serve tier: retirement in flight
         self.heartbeat = heartbeat    # liveness file path or None
         self.fleet_key = None         # this proc's fleet-member id
         self.proc = None
@@ -202,6 +225,20 @@ class Supervisor:
         self.job_rc = 0
         self._fault = None            # mxnet_tpu.fault, loaded lazily
         self.fleet = None             # embedded FleetCollector (ISSUE 12)
+        # serving fleet tier (ISSUE 17): --route/--autoscale wiring
+        self.replicas_file = None     # router's authoritative addr list
+        self.fleet_port = None        # FLEET wire port (router signals)
+        self.autoscale = None         # (min, max) replica bounds or None
+        self.serve_factory = None     # index -> (name, argv, env, addr,
+                                      #           heartbeat)
+        self._as_next_index = 0       # next spawned replica's rank
+        self._as_up_hold = 0          # consecutive rounds burn >= up
+        self._as_down_hold = 0        # consecutive rounds burn <= down
+        self._as_last_round = None    # last scrape round evaluated
+        self._as_last_dir = None      # last action direction
+        self._as_streak = 0           # consecutive same-direction acts
+        self._as_cooldown_until = 0.0
+        self._as_policy = None        # RetryPolicy-shaped cooldown
 
     # -- registration -------------------------------------------------------
     def add(self, name, argv, env, role="worker", addr=None,
@@ -360,7 +397,8 @@ class Supervisor:
         if self.fleet is not None:
             return
         candidates = [sp for sp in self.procs
-                      if sp.heartbeat or (sp.role == "server" and
+                      if sp.heartbeat or (sp.role in ("server", "serve",
+                                                      "router") and
                                           sp.addr)]
         if not candidates:
             return
@@ -375,7 +413,16 @@ class Supervisor:
             members = []
             nsrv = 0
             for sp in candidates:
-                if sp.heartbeat:
+                if sp.role in ("serve", "router") and sp.addr:
+                    # serve tier (ISSUE 17): wire-scraped with the
+                    # member row carrying its addr, so the merged
+                    # snapshot is directly router/autoscaler-consumable
+                    # (fleet.replica_signals)
+                    rank = sp.env.get("MX_PROCESS_ID",
+                                      "0" if sp.role == "router"
+                                      else len(members))
+                    m = _fleet.FleetMember(sp.role, rank, addr=sp.addr)
+                elif sp.heartbeat:
                     rank = sp.env.get("MX_PROCESS_ID", len(members))
                     m = _fleet.FleetMember("worker", rank,
                                            heartbeat=sp.heartbeat)
@@ -384,7 +431,8 @@ class Supervisor:
                     nsrv += 1
                 sp.fleet_key = m.key
                 members.append(m)
-            self.fleet = _fleet.FleetCollector(members).start()
+            self.fleet = _fleet.FleetCollector(members).start(
+                port=self.fleet_port)
         except Exception as e:
             self.log("fleet collector unavailable (%s); falling back "
                      "to heartbeat-only status" % e)
@@ -550,6 +598,31 @@ class Supervisor:
                         except Exception:
                             pass
                     return True
+            if sp.role == "serve":
+                survivors = [w for w in self.procs
+                             if w is not sp and w.role == "serve"
+                             and not w.done]
+                if survivors:
+                    # involuntary retire (ISSUE 17): the serve tier
+                    # shrinks and continues — the router already failed
+                    # this replica's pinned sessions over on the first
+                    # dead forward; here the supervisor just stops
+                    # paying for restarts and retires it from the
+                    # signal plane + the replicas file
+                    self.log("%s failed (%s) past its restart budget "
+                             "(%d) - involuntary retire: serving "
+                             "continues on %d replica(s)"
+                             % (sp.name, self._describe(rc),
+                                self.max_restarts, len(survivors)))
+                    sp.rc = rc        # done; NOT folded — the tier's
+                                      # exit code belongs to survivors
+                    self._write_replicas_file()
+                    if self.fleet is not None and sp.fleet_key:
+                        try:
+                            self.fleet.retire(sp.fleet_key)
+                        except Exception:
+                            pass
+                    return True
             self.log("%s failed (%s) and exhausted its restart budget "
                      "(%d) - tearing the job down"
                      % (sp.name, self._describe(rc), self.max_restarts))
@@ -694,6 +767,149 @@ class Supervisor:
             self.fleet = None
             self._start_collector()
 
+    # -- serving autoscaler (ISSUE 17) --------------------------------------
+    def _serve_procs(self, live_only=True):
+        return [sp for sp in self.procs
+                if sp.role == "serve" and not sp.done
+                and not (live_only and sp.draining)]
+
+    def _write_replicas_file(self):
+        """Atomically rewrite the router's authoritative replica list:
+        live, non-draining replicas only.  Dropping an addr here is the
+        FIRST retirement step — the router stops admitting new sessions
+        to it before the replica itself is asked to DRAIN."""
+        if not self.replicas_file:
+            return
+        addrs = [sp.addr for sp in self._serve_procs() if sp.addr]
+        tmp = "%s.tmp.%d" % (self.replicas_file, os.getpid())
+        with open(tmp, "w") as f:
+            f.write("".join(a + "\n" for a in addrs))
+        os.replace(tmp, self.replicas_file)
+
+    def _as_env(self, name, default):
+        from mxnet_tpu.base import get_env as _get_env
+        try:
+            v = _get_env(name, default, float)
+            return float(default if v is None else v)
+        except (TypeError, ValueError):
+            return float(default)
+
+    def _check_autoscale(self):
+        """One autoscale evaluation per fleet scrape round: SLO burn
+        (observed/target, from the merged snapshot) must HOLD past the
+        hysteresis band for MX_AUTOSCALE_HOLD consecutive rounds before
+        an action fires, and every action arms an exponentially
+        backed-off cooldown — a spike absorbs with a burst of spawns,
+        but up/down flapping gets slower each flip."""
+        if not (self.autoscale and self.serve_factory
+                and self.fleet is not None):
+            return
+        snap = None
+        try:
+            snap = self.fleet.snapshot()
+        except Exception:
+            return
+        if not snap:
+            return
+        round_id = snap.get("scrape")
+        if round_id is None or round_id == self._as_last_round:
+            return                      # same round: nothing new to read
+        self._as_last_round = round_id
+        burn = ((snap.get("slo") or {}).get("burn") or {})
+        vals = [float(v) for v in burn.values()
+                if isinstance(v, (int, float))]
+        worst = max(vals, default=0.0)
+        up_t = self._as_env("MX_AUTOSCALE_UP_BURN", 1.0)
+        down_t = self._as_env("MX_AUTOSCALE_DOWN_BURN", 0.5)
+        hold = max(1, int(self._as_env("MX_AUTOSCALE_HOLD", 3)))
+        if worst >= up_t:
+            self._as_up_hold += 1
+            self._as_down_hold = 0
+        elif worst <= down_t:
+            self._as_down_hold += 1
+            self._as_up_hold = 0
+        else:
+            # inside the hysteresis band: hold steady both ways
+            self._as_up_hold = self._as_down_hold = 0
+        if self._now() < self._as_cooldown_until:
+            return
+        mn, mx = self.autoscale
+        n_live = len(self._serve_procs())
+        if self._as_up_hold >= hold and n_live < mx:
+            self._scale_up(worst, up_t, n_live)
+        elif self._as_down_hold >= hold and n_live > mn:
+            self._scale_down(worst, down_t, n_live)
+
+    def _as_arm_cooldown(self, direction):
+        fault = self._fault_mod()
+        if self._as_last_dir == direction:
+            self._as_streak += 1
+        else:
+            self._as_streak = 0
+            self._as_last_dir = direction
+        base = max(0.1, self._as_env("MX_AUTOSCALE_COOLDOWN", 10.0))
+        if self._as_policy is None or self._as_policy.base != base:
+            self._as_policy = fault.RetryPolicy(
+                deadline=float("inf"), base=base, max_delay=8.0 * base,
+                jitter=0.1)
+        self._as_cooldown_until = self._now() + \
+            self._as_policy.delay(min(self._as_streak, 3))
+        self._as_up_hold = self._as_down_hold = 0
+
+    def _scale_up(self, worst, up_t, n_live):
+        idx = self._as_next_index
+        self._as_next_index += 1
+        name, argv, env, addr, heartbeat = self.serve_factory(idx)
+        sp = self.add(name, argv, env, role="serve", addr=addr,
+                      heartbeat=heartbeat)
+        self._spawn(sp)
+        self._write_replicas_file()
+        self.log("autoscale: burn %.3g >= %.3g held - spawning %s at "
+                 "%s (%d -> %d replicas)"
+                 % (worst, up_t, name, addr, n_live, n_live + 1))
+        if self.fleet is not None:
+            try:
+                from mxnet_tpu import fleet as _fleet
+                m = _fleet.FleetMember("serve", idx, addr=addr)
+                sp.fleet_key = m.key
+                self.fleet.add_member(m)
+            except Exception:
+                pass
+        self._as_arm_cooldown("up")
+
+    def _scale_down(self, worst, down_t, n_live):
+        victims = self._serve_procs()
+        if not victims:
+            return
+        sp = victims[-1]                # newest replica retires first
+        sp.draining = True
+        self._write_replicas_file()     # router admission closes FIRST
+        self.log("autoscale: burn %.3g <= %.3g held - retiring %s "
+                 "drain-not-kill (%d -> %d replicas)"
+                 % (worst, down_t, sp.name, n_live, n_live - 1))
+        try:
+            _send_drain(sp.addr)
+        except OSError as e:
+            # already dead or wedged: the DRAIN courtesy failed, fall
+            # back to the supervisor's kill (clients failover-replay)
+            self.log("%s: DRAIN failed (%s); killing it" % (sp.name, e))
+            self._kill(sp)
+        if self.fleet is not None:
+            if sp.fleet_key:
+                try:
+                    self.fleet.retire(sp.fleet_key)
+                except Exception:
+                    pass
+            try:
+                # the spike this retirement answers is over: un-latch
+                # the breach records so the NEXT breach is a fresh
+                # signal, not a stale latch blocking/false-arming scale
+                # decisions
+                self.fleet.slo.reset()
+            except Exception:
+                pass
+        self._as_arm_cooldown("down")
+
     def _teardown(self):
         for sp in self.procs:
             self._kill(sp)
@@ -706,9 +922,11 @@ class Supervisor:
         stop the servers gracefully.  Returns the job return code."""
         for sp in self.procs:
             self._spawn(sp)
-        if self.status_interval is not None or self.hang_timeout:
+        if self.status_interval is not None or self.hang_timeout \
+                or self.replicas_file or self.autoscale:
             # the fleet plane rides the same provisioning as the status
-            # table / hang detection (heartbeat files, server addrs)
+            # table / hang detection (heartbeat files, server addrs);
+            # the serve router/autoscaler REQUIRE it (load signals)
             self._start_collector()
         try:
             while True:
@@ -716,6 +934,7 @@ class Supervisor:
                 # out from under this loop, so the membership is read
                 # fresh each tick rather than captured once up front
                 self._check_resize()
+                self._check_autoscale()
                 for sp in list(self.procs):
                     if sp.done or sp.proc is None:
                         continue
@@ -736,8 +955,12 @@ class Supervisor:
                     if not self._on_failure(sp, rc):
                         self._teardown()
                         return self.job_rc
+                # serve replicas and the router count as workers for
+                # job lifetime: the job ends when every non-server
+                # process is done (serve: a STOP through the client or
+                # router stops the whole tier)
                 workers = [sp for sp in self.procs
-                           if sp.role == "worker"]
+                           if sp.role != "server"]
                 if all(w.done for w in workers):
                     break
                 self._maybe_status()
@@ -832,6 +1055,36 @@ def _send_leave(addr, rank, timeout=5.0):
         s.sendall(struct.pack("<Q", len(payload)) + payload)
         head = b""
         while len(head) < 8:                  # ack: (True, (epoch, ...))
+            chunk = s.recv(8 - len(head))
+            if not chunk:
+                return
+            head += chunk
+        (n,) = struct.unpack("<Q", head)
+        body = b""
+        while len(body) < n:
+            chunk = s.recv(min(1 << 16, n - len(body)))
+            if not chunk:
+                return
+            body += chunk
+
+
+def _send_drain(addr, drain_timeout=None, timeout=5.0):
+    """Send the serve wire-protocol DRAIN (drain-not-kill retirement,
+    ISSUE 17) and await the status ack.  Same inlined length-prefixed-
+    pickle framing as _send_stop — the launcher never loads the
+    framework for it.  ``drain_timeout=None`` lets the replica's own
+    MX_SERVE_DRAIN_TIMEOUT bound the retirement; DRAIN is idempotent
+    (a retry keeps the replica's FIRST deadline)."""
+    host, _, port = addr.rpartition(":")
+    with socket.create_connection((host or "127.0.0.1", int(port)),
+                                  timeout=timeout) as s:
+        s.settimeout(timeout)
+        msg = ("DRAIN",) if drain_timeout is None \
+            else ("DRAIN", float(drain_timeout))
+        payload = pickle.dumps(msg, protocol=4)
+        s.sendall(struct.pack("<Q", len(payload)) + payload)
+        head = b""
+        while len(head) < 8:              # ack: (True, {status dict})
             chunk = s.recv(8 - len(head))
             if not chunk:
                 return
@@ -957,9 +1210,73 @@ def launch_local(args, command):
             env["MX_ELASTIC_EPOCH"] = str(int(generation))
         return "rank %d" % rank, list(command), env, heartbeat
 
+    # serving fleet tier (ISSUE 17): replicas get wire addrs on the
+    # fleet plane; --route adds the session router; --autoscale arms
+    # the SLO-burn resize loop
+    serve_base = getattr(args, "serve_port_base", None)
+    route_port = getattr(args, "route", None)
+    autoscale = getattr(args, "autoscale", None)
+    if (route_port is not None or autoscale) and serve_base is None:
+        raise SystemExit("launch.py: --route/--autoscale need "
+                         "--serve-port-base B (the replicas' "
+                         "--port-base, so the supervisor knows their "
+                         "addrs)")
+
+    def make_replica(index):
+        """serve_factory face of make_worker: (name, argv, env, addr,
+        heartbeat) for replica ``index`` at serve-port-base + index —
+        used for the initial spawn AND every autoscaler scale-up."""
+        name, argv, env, heartbeat = make_worker(index,
+                                                 args.num_workers, 0)
+        return (name, argv, env,
+                "127.0.0.1:%d" % (serve_base + index), heartbeat)
+
+    rt_dir = None
     for rank in range(args.num_workers):
-        name, argv, env, heartbeat = make_worker(rank, args.num_workers, 0)
-        sup.add(name, argv, env, role="worker", heartbeat=heartbeat)
+        if serve_base is not None:
+            name, argv, env, addr, heartbeat = make_replica(rank)
+            sup.add(name, argv, env, role="serve", addr=addr,
+                    heartbeat=heartbeat)
+        else:
+            name, argv, env, heartbeat = make_worker(
+                rank, args.num_workers, 0)
+            sup.add(name, argv, env, role="worker", heartbeat=heartbeat)
+    if route_port is not None:
+        rt_dir = tempfile.mkdtemp(prefix="mx-router-")
+        sup.replicas_file = os.path.join(rt_dir, "replicas.txt")
+        sup.fleet_port = _free_port()
+        sup._write_replicas_file()
+        env = dict(os.environ)
+        env.update({"MX_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
+                    "PYTHONPATH": REPO + os.pathsep +
+                    env.get("PYTHONPATH", "")})
+        if getattr(args, "fault", None):
+            # the router has its own chaos sites (router.request /
+            # router.forward) — arm the same spec everywhere
+            env["MX_FAULT_INJECT"] = args.fault
+        heartbeat = None
+        if hb_dir:
+            heartbeat = os.path.join(hb_dir, "router")
+            env["MX_HEARTBEAT_FILE"] = heartbeat
+        sup.add("router",
+                [sys.executable, "-m", "mxnet_tpu.serve.router",
+                 "--port", str(route_port),
+                 "--replicas-file", sup.replicas_file,
+                 "--fleet", "127.0.0.1:%d" % sup.fleet_port],
+                env, role="router",
+                addr="127.0.0.1:%d" % route_port, heartbeat=heartbeat)
+    if autoscale:
+        try:
+            mn, mx = (int(x) for x in str(autoscale).split(":", 1))
+        except ValueError:
+            raise SystemExit("launch.py: --autoscale wants MIN:MAX "
+                             "(got %r)" % autoscale)
+        if not (1 <= mn <= mx):
+            raise SystemExit("launch.py: --autoscale needs "
+                             "1 <= MIN <= MAX")
+        sup.autoscale = (mn, mx)
+        sup.serve_factory = make_replica
+        sup._as_next_index = args.num_workers
     sup.ps_addrs = list(ps_roots)
     if elastic:
         sup.worker_factory = make_worker
@@ -969,6 +1286,8 @@ def launch_local(args, command):
     finally:
         if hb_dir:
             shutil.rmtree(hb_dir, ignore_errors=True)
+        if rt_dir:
+            shutil.rmtree(rt_dir, ignore_errors=True)
 
 
 def launch_ssh(args, command):
@@ -1001,6 +1320,15 @@ def launch_ssh(args, command):
             "launch.py: -s/--num-servers is only implemented for the "
             "local launcher; start `python -m mxnet_tpu.kvstore.server` "
             "on a host manually and export MX_PS_ROOT=host:port")
+    if getattr(args, "route", None) is not None or \
+            getattr(args, "serve_port_base", None) is not None or \
+            getattr(args, "autoscale", None):
+        # the serve tier needs authoritative local process lifecycle
+        # (replicas file, DRAIN-then-reap, fleet wire scrapes) — same
+        # reasoning as --restart/--elastic
+        raise SystemExit(
+            "launch.py: --route/--serve-port-base/--autoscale are only "
+            "supported with --launcher local")
     hosts = []
     with open(args.hostfile) as f:
         for line in f:
@@ -1099,6 +1427,34 @@ def main():
                         "their epoch-boundary drain before killing "
                         "them (default 60; auto-resume then picks up "
                         "from the last checkpoint)")
+    p.add_argument("--serve-port-base", type=int, default=None,
+                   metavar="PORT",
+                   help="the command is a serving replica bound at "
+                        "PORT + rank (its own --port-base): each "
+                        "replica is registered on the fleet plane as a "
+                        "wire-scraped 'serve' member whose merged "
+                        "signals (queue depth, decode occupancy, KV "
+                        "headroom) feed the router and autoscaler.  "
+                        "Local launcher only")
+    p.add_argument("--route", type=int, default=None, metavar="PORT",
+                   help="front the replicas with the session router "
+                        "(python -m mxnet_tpu.serve.router) on PORT: "
+                        "clients speak to ONE addr, sessions pin to "
+                        "replicas, retirement is drain-not-kill.  The "
+                        "supervisor owns the router's replicas file "
+                        "and an embedded fleet collector wire port "
+                        "for its load signals.  Needs "
+                        "--serve-port-base")
+    p.add_argument("--autoscale", default=None, metavar="MIN:MAX",
+                   help="SLO-burn autoscaler over the serve tier: "
+                        "spawn a warm replica when any fleet SLO burn "
+                        "(MX_FLEET_SLO_* targets) holds >= "
+                        "MX_AUTOSCALE_UP_BURN, retire-and-DRAIN the "
+                        "newest when every burn holds <= "
+                        "MX_AUTOSCALE_DOWN_BURN; hysteresis hold + "
+                        "exponentially backed-off cooldowns stop "
+                        "flapping.  Needs --serve-port-base (and "
+                        "usually --route)")
     p.add_argument("--fault", default=None, metavar="SPEC",
                    help="arm fault injection in every spawned process "
                         "(MX_FAULT_INJECT spec, e.g. "

@@ -21,6 +21,18 @@ supervised job (launch.py) drains and exits 0.  ``--metrics`` prints
 every replica's live Prometheus snapshot via the METRICS verb after the
 load (``--requests 0 --metrics`` is a pure scrape).
 
+``--routed`` declares ``--addrs`` to be the session ROUTER's one
+address (ISSUE 17) instead of the replica list: the load and the
+verification are unchanged (the router forwards envelopes verbatim, so
+answers must still match the local oracle bit-for-bit), but the
+``--chaos`` assertions move to the fleet tier — zero lost requests,
+at least one failover SOMEWHERE (client-side when the router itself is
+killed, router-side when a replica dies under it), and afterwards the
+router reports every replica ``up`` again.  ``--poisson RATE`` opens
+the closed loop into Poisson arrivals at RATE req/s (exponential
+inter-arrival gaps) — the autoscaler chaos lane drives a baseline and
+a 4x spike with it.
+
 ``--decode`` switches the load to GENERATE requests against the
 continuous-batching decode engine (ISSUE 15): every generated token
 sequence is checked against a LOCAL greedy decode of the same
@@ -74,6 +86,16 @@ def main():
     ap.add_argument("--max-tokens", type=int, default=12,
                     help="--decode: generated tokens per request "
                          "(short/long mix alternates 2 and this)")
+    ap.add_argument("--routed", action="store_true",
+                    help="--addrs is the session router's address: "
+                         "chaos assertions move to the fleet tier "
+                         "(router health + per-replica 'up' states "
+                         "instead of pinned per-replica probes)")
+    ap.add_argument("--poisson", type=float, default=None,
+                    metavar="RATE",
+                    help="Poisson arrivals at RATE requests/s "
+                         "(exponential inter-arrival gaps) instead of "
+                         "closed-loop back-to-back")
     ap.add_argument("--chaos", action="store_true",
                     help="assert failover happened and every replica "
                          "serves again afterwards")
@@ -95,6 +117,13 @@ def main():
     wait_up(addrs)
     cli = ServeClient(addrs, timeout=args.timeout)
     rng = np.random.RandomState(0)
+
+    def pace():
+        # open-loop Poisson arrivals: exponential inter-arrival gaps at
+        # --poisson req/s (closed-loop back-to-back when unset)
+        if args.poisson:
+            time.sleep(float(rng.exponential(1.0 / args.poisson)))
+
     ok, t0 = 0, time.perf_counter()
     if args.decode:
         # local truth: the reference greedy decode of the same seeded
@@ -118,6 +147,7 @@ def main():
             if key not in expect_cache:
                 expect_cache[key] = reference_generate(
                     prompt, max_new, params=params, config=cfg)
+            pace()
             version, toks = cli.generate(prompt, max_tokens=max_new)
             assert toks == expect_cache[key], \
                 ("request %d (decode v%d) answered WRONG tokens: "
@@ -127,6 +157,7 @@ def main():
         net = demo_block()                  # local truth for verification
         for i in range(args.requests):
             x = rng.randn(args.rows, 16).astype(np.float32)
+            pace()
             version, outs = cli.predict([x])
             np.testing.assert_allclose(
                 outs[0], demo_expected(x, net=net), rtol=1e-4,
@@ -138,7 +169,34 @@ def main():
     failovers = telemetry.registry.value("serve.client_failovers")
 
     restarted = []
-    if args.chaos:
+    if args.chaos and args.routed:
+        assert ok == args.requests, \
+            "lost requests: %d/%d answered" % (ok, args.requests)
+        # through a router the failover can land on EITHER side of it:
+        # a replica killed under the router is absorbed ROUTER-side
+        # (the client never sees it), a killed router is a CLIENT-side
+        # failover (reconnect + SEQ replay).  Require at least one
+        # somewhere, then wait for the router to report every replica
+        # it fronts 'up' again (the supervisor restarted the victim and
+        # a refresh-tick probe revived it).
+        wait_up(addrs, timeout=120.0)
+        h = cli.health()
+        assert h.get("status") in ("routing", "draining"), h
+        total = failovers + int(h.get("failovers", 0))
+        assert total >= 1, \
+            "no failover happened anywhere - did the chaos fault fire?"
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            h = cli.health()
+            states = h.get("replicas", {})
+            if states and all(s == "up" for s in states.values()):
+                break
+            time.sleep(0.5)
+        else:
+            raise SystemExit("serve_load: router never saw the fleet "
+                             "whole again: %r" % (h,))
+        restarted.append(h.get("pid"))
+    elif args.chaos:
         assert ok == args.requests, \
             "lost requests: %d/%d answered" % (ok, args.requests)
         assert failovers >= 1, \
@@ -165,6 +223,7 @@ def main():
     print(json.dumps({
         "requests": args.requests,
         "mode": "decode" if args.decode else "predict",
+        "routed": bool(args.routed),
         "answered": ok,
         "failovers": failovers,
         "requests_per_sec": round(ok / wall, 2),
