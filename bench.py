@@ -1207,11 +1207,28 @@ def run_decode_bench(n_gens=None, rate=None):
     continuous-vs-request speedup (acceptance >= 2x), zero serve-time
     retraces and FLAT KV-pool bytes across the whole run (the pool is
     donated through every step — any growth is a leak).
+
+    ISSUE 18 adds three PAGED lanes on top:
+
+    * ``paged`` — the identical mixed workload through the paged
+      engine (same geometry, auto ``kv_pages`` == the flat pool's
+      HBM): tokens must match the flat continuous lane ELEMENT-WISE,
+      the page heap must stay flat, and warm retraces must stay zero;
+    * ``shared_prefix`` — N sessions over K long shared prompts:
+      flat re-prefills every repeat, the paged engine answers it with
+      a CoW fork + ONE replay chunk, so repeat first-token p50 must
+      drop >= 5x (same tokens out of both engines);
+    * ``admission`` — the census-pinned equal-HBM capacity story:
+      at byte-identical KV pools the paged heap runs the mixed-length
+      admission >= 4x as wide as flat slots allow.
     """
     import numpy as np
     from mxnet_tpu import telemetry
     from mxnet_tpu.serve.decode import (DecodeBatcher, DecodeConfig,
-                                        DecodeServable)
+                                        DecodeServable,
+                                        PagedDecodeBatcher,
+                                        PagedDecodeServable,
+                                        reference_generate)
 
     n_gens = int(n_gens or os.environ.get("MX_BENCH_DECODE_GENS", 200))
     rate = float(rate or os.environ.get("MX_BENCH_DECODE_RATE", 2500.0))
@@ -1237,9 +1254,14 @@ def run_decode_bench(n_gens=None, rate=None):
     def pct(sorted_secs, p):
         return round(_pctile(sorted_secs, p) * 1e3, 3)
 
-    def run_lane(mode):
-        sv = DecodeServable(config=cfg)
-        eng = DecodeBatcher(sv, queue_cap=n_gens + 64, mode=mode)
+    def run_lane(mode, paged=False):
+        if paged:
+            sv = PagedDecodeServable(config=cfg)
+            eng = PagedDecodeBatcher(sv, queue_cap=n_gens + 64,
+                                     mode=mode)
+        else:
+            sv = DecodeServable(config=cfg)
+            eng = DecodeBatcher(sv, queue_cap=n_gens + 64, mode=mode)
         # untimed pre-burst: each lane measures its STEADY state, not
         # the process's first-touch costs (XLA autotune, allocator
         # warm, CPU boost ramp) — without this the lane that happens to
@@ -1270,7 +1292,7 @@ def run_decode_bench(n_gens=None, rate=None):
                             if g.token_times)
         kv_flat = sv.kv_state_bytes() == kv0
         lane = {
-            "mode": mode,
+            "mode": "paged" if paged else mode,
             "generations": n_gens,
             "tokens": tokens,
             "wall_s": round(wall, 3),
@@ -1286,11 +1308,121 @@ def run_decode_bench(n_gens=None, rate=None):
             "kv_pool_bytes": sv.kv_state_bytes(),
             "kv_pool_flat": kv_flat,
         }
+        if paged:
+            lane["page_stats"] = eng.page_stats()
         eng.close()
-        return lane
+        return lane, outs
 
-    cont = run_lane("continuous")
-    req = run_lane("request")
+    def run_shared_prefix_bench():
+        # the prefix-reuse headline: K long shared prompts, repeats
+        # must come back with ONE replay chunk under the paged engine
+        # while flat pays the whole monolithic prefill again.  Model
+        # sized so the prompt prefill is COMPUTE-bound (a chunk is
+        # ~1/16th of it) — a dispatch-bound toy would hide the win.
+        sp_len = int(os.environ.get("MX_BENCH_SHARED_LEN", 1024))
+        sp_k = int(os.environ.get("MX_BENCH_SHARED_PROMPTS", 4))
+        sp_reqs = int(os.environ.get("MX_BENCH_SHARED_REQS", 24))
+        sp_new = 8
+        base = dict(dim=32, heads=2, layers=6, slots=4, max_tokens=16,
+                    prompt_buckets=(8, sp_len))
+        srng = np.random.RandomState(18)
+        probe = DecodeConfig(**base)
+        bases = [[int(t) for t in srng.randint(2, probe.vocab,
+                                               size=sp_len)]
+                 for _ in range(sp_k)]
+        warm_p = [int(t) for t in srng.randint(2, probe.vocab,
+                                               size=sp_len)]
+
+        def lane(paged):
+            if paged:
+                scfg = DecodeConfig(kv_page_len=64, prefill_chunk=64,
+                                    kv_pages=128, **base)
+                sv = PagedDecodeServable(config=scfg)
+                eng = PagedDecodeBatcher(sv)
+            else:
+                sv = DecodeServable(config=DecodeConfig(**base))
+                eng = DecodeBatcher(sv)
+            # untimed warm generation off a DISTINCT full-length
+            # prompt: compile + first-touch costs never land in the
+            # first measured cold request
+            eng.submit(warm_p, max_new=2).result(timeout=600)
+            firsts = {"cold": [], "shared": []}
+            outs, seen = [], set()
+            for i in range(sp_reqs):
+                k = i % sp_k
+                bucket = "shared" if k in seen else "cold"
+                seen.add(k)
+                g = eng.submit(bases[k], max_new=sp_new)
+                outs.append(g.result(timeout=600))
+                firsts[bucket].append(g.token_times[0])
+            stats = eng.page_stats()
+            eng.close()
+            ms = {b: {"p50": pct(sorted(v), 50),
+                      "p99": pct(sorted(v), 99), "n": len(v)}
+                  for b, v in firsts.items() if v}
+            return ms, outs, stats
+
+        flat_ms, flat_outs, _ = lane(paged=False)
+        paged_ms, paged_outs, pstats = lane(paged=True)
+        sp_speed = (flat_ms["shared"]["p50"]
+                    / max(1e-9, paged_ms["shared"]["p50"]))
+        return {
+            "prompt_len": sp_len,
+            "prompts": sp_k,
+            "requests": sp_reqs,
+            "flat_first_token_ms": flat_ms,
+            "paged_first_token_ms": paged_ms,
+            "shared_page_hits": pstats["shared_hits"],
+            "parity": bool(paged_outs == flat_outs),
+            "first_token_speedup": round(sp_speed, 2),
+            "speedup_ok": bool(sp_speed >= 5.0
+                               and paged_outs == flat_outs),
+        }
+
+    def run_admission_bench():
+        # census-pinned equal-HBM capacity: flat slots=2 admits 2,
+        # the byte-identical page heap runs the mixed-length set 6x
+        # as wide (short sessions hold 1 page, not a flat extent)
+        abase = dict(dim=8, heads=1, layers=1, max_tokens=16,
+                     prompt_buckets=(4, 64))
+        flat_cfg = DecodeConfig(slots=2, **abase)
+        paged_cfg = DecodeConfig(slots=12, kv_page_len=16, kv_pages=18,
+                                 **abase)
+        sv = PagedDecodeServable(config=paged_cfg)
+        flat_pool = (flat_cfg.layers * 2 * (flat_cfg.slots + 1)
+                     * flat_cfg.max_len * flat_cfg.dim * 4)
+        paged_pool = sv.page_bytes() * paged_cfg.kv_pages
+        eng = PagedDecodeBatcher(sv, autostart=False)
+        long_p = [int(t) for t in np.arange(64) % 7 + 1]
+        work = [(long_p, 16)] + [([1 + i % 5, 2, 3, 4], 2)
+                                 for i in range(11)]
+        gens = [eng.submit(p, max_new=n) for p, n in work]
+        eng.step_sync()                  # admission is one boundary
+        concurrent = eng.active_count()
+        eng.drain_sync()
+        correct = all(
+            g.tokens_so_far() == reference_generate(
+                p, n, params=sv.params, config=paged_cfg)
+            for g, (p, n) in zip(gens, work))
+        eng.close()
+        ratio = concurrent / float(flat_cfg.slots)
+        return {
+            "flat_slots": flat_cfg.slots,
+            "paged_sessions": concurrent,
+            "capacity_ratio": round(ratio, 2),
+            "kv_pool_bytes_flat": flat_pool,
+            "kv_pool_bytes_paged": paged_pool,
+            "equal_hbm": bool(flat_pool == paged_pool),
+            "tokens_correct": bool(correct),
+            "ok": bool(ratio >= 4.0 and flat_pool == paged_pool
+                       and correct),
+        }
+
+    cont, cont_outs = run_lane("continuous")
+    req, _ = run_lane("request")
+    paged_lane, paged_outs = run_lane("continuous", paged=True)
+    shared = run_shared_prefix_bench()
+    admission = run_admission_bench()
     speedup = cont["tokens_per_sec"] / max(1e-9, req["tokens_per_sec"])
     report = {
         "metric": "serve_decode_tokens_per_sec",
@@ -1310,6 +1442,15 @@ def run_decode_bench(n_gens=None, rate=None):
             "zero_serve_time_retraces": bool(
                 cont["retraces_after_warmup"] == 0
                 and req["retraces_after_warmup"] == 0),
+            "paged": {
+                "lane": paged_lane,
+                "parity_with_flat": bool(paged_outs == cont_outs),
+                "kv_pool_flat": bool(paged_lane["kv_pool_flat"]),
+                "zero_retraces": bool(
+                    paged_lane["retraces_after_warmup"] == 0),
+            },
+            "shared_prefix": shared,
+            "admission": admission,
         },
         "phases": {k: v for k, v in telemetry.phase_snapshot().items()
                    if k in ("prefill", "decode_step", "kv_evict")},

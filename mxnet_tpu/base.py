@@ -112,6 +112,10 @@ ENV_CATALOG: Dict[str, Any] = {
     "MX_SERVE_DECODE_MAX_TOKENS": ("32", "Decode engine: cap on generated tokens per GENERATE request (a request's max_tokens clamps to this).  Together with the top prompt bucket it sizes each slot's KV page capacity."),
     "MX_SERVE_DECODE_PAGE": ("16", "Decode engine: KV page size in token positions.  Each slot's cache extent (top prompt bucket + max tokens + the pipeline-overrun margin) rounds up to whole pages; retiring a sequence 'evicts' its pages by bookkeeping alone (lengths reset on slot reuse, stale entries masked) - the pool itself is never reallocated."),
     "MX_SERVE_DECODE_PROMPT_BUCKETS": ("4,8,16", "Decode engine: comma-separated prompt-length buckets the prefill program table pre-compiles.  A GENERATE prompt pads up to the smallest covering bucket (one prefill dispatch per admitted sequence); prompts longer than the top bucket are rejected at admission, so serve time never pays a trace."),
+    "MX_SERVE_KV_PAGES": ("0", "Paged decode engine (ISSUE 18): number of physical pages in the shared KV page heap (layers, kv_pages, kv_page_len, heads, head_dim), owner 'kv_pages' in the buffer census.  0 (default) auto-sizes to (slots+1) * pages-per-slot - the same HBM the flat pool would take - but because sessions only hold the pages their actual length needs, the same heap admits several times more mixed-length sessions.  > 0 on 'python -m mxnet_tpu.serve --decode' also SELECTS the paged engine (the flat pool stays the default).  Page 0 is reserved scratch."),
+    "MX_SERVE_KV_PAGE_LEN": ("0", "Paged decode engine: token positions per physical KV page.  0 (default) inherits MX_SERVE_DECODE_PAGE.  Smaller pages pack mixed-length sessions tighter and share longer prefixes (only FULL pages are hash-shared); larger pages cut block-table and gather overhead."),
+    "MX_SERVE_PREFIX_SHARE": ("1", "Paged decode engine: 1 (default) hash-shares read-only full prompt pages across sessions - a rolling content hash over token ids is chained at page boundaries, equal hashes adopt the donor's pages via refcounts, and a session diverging inside a shared page forks it copy-on-write - so N sessions over one system prompt prefill only their suffixes.  0 disables sharing (every admission prefills all its pages)."),
+    "MX_SERVE_PREFILL_CHUNK": ("0", "Paged decode engine: prefill chunk length in token positions (rounded up to whole pages; 0 = one page).  Long prompts prefill as a train of page-aligned chunks that INTERLEAVE with decode steps inside the pump's one-dispatch-per-tick cadence, so a 10k-token admission never stalls in-flight generations for more than one chunk-step."),
     "MX_PROGRAM_CENSUS": ("1", "XLA program census (mxnet_tpu/programs.py): 1 (default) routes every jit-creation site through the process-wide program registry - per-program compile-time histograms (program_compile_seconds{program}), XLA memory_analysis/cost_analysis metadata (program_temp_bytes/program_flops, where the backend provides them), retrace counts with a structured retrace-explainer diff (which arg's shape/dtype/tree structure changed), and the jax.live_arrays() device-buffer census bucketed by owner (params/optimizer_state/ef_residuals/serve/other) riding flight-recorder records and crash dumps.  0 makes register_program a plain jax.jit and disables the census."),
     "MX_LEAK_WARN_BYTES": ("67108864", "Buffer-census leak detector threshold: when total live device bytes grow monotonically across consecutive census checks by more than this many bytes, the census_leak_bytes gauge latches the streak, census.leak_trips increments and a warning names the growing owner buckets.  Any shrink resets the streak; 0 disables the trip (gauges still publish)."),
     "MX_BENCH_HISTORY": ("", "Path of the bench-trajectory history file tools/bench_compare.py appends each bench.py run to and gates regressions against (>10% throughput or >15% peak-temp-bytes vs the rolling best per metric); empty uses BENCH_HISTORY.jsonl next to bench.py."),

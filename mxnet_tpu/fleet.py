@@ -526,6 +526,14 @@ def replica_signals(snapshot: Optional[Dict[str, Any]],
     active = _per_member(gauges, "serve.decode.active_slots")
     occupancy = _per_member(gauges, "serve.decode.slot_occupancy")
     headroom = _per_member(gauges, "serve.decode.kv_headroom_bytes")
+    # paged replicas (ISSUE 18) additionally publish page-level
+    # headroom + sharing savings; flat replicas simply lack the gauges
+    # (keys default to 0/absent — same schema 2, router math unchanged:
+    # kv_headroom_bytes already means "admission headroom in bytes" on
+    # both engines)
+    free_pages = _per_member(gauges, "serve.decode.kv_free_pages")
+    shared_saved = _per_member(gauges,
+                               "serve.decode.kv_shared_saved_bytes")
     rejected = _per_member(counters, "serve.rejected")
     d_rejected = _per_member(counters, "serve.decode.rejected")
     for key, meta in (snapshot.get("members") or {}).items():
@@ -542,6 +550,8 @@ def replica_signals(snapshot: Optional[Dict[str, Any]],
             "active_slots": active.get(key, 0) or 0,
             "slot_occupancy": occupancy.get(key, 0.0) or 0.0,
             "kv_headroom_bytes": headroom.get(key, 0) or 0,
+            "kv_free_pages": free_pages.get(key),
+            "kv_shared_saved_bytes": shared_saved.get(key, 0) or 0,
             "rejected": (rejected.get(key, 0) or 0)
             + (d_rejected.get(key, 0) or 0),
         }

@@ -25,7 +25,8 @@ from jax import lax
 
 from .registry import register
 
-__all__ = ["attention_core", "flash_attention", "cached_attention"]
+__all__ = ["attention_core", "flash_attention", "cached_attention",
+           "paged_attention"]
 
 # kernel block sizes: 256x256 keeps the fp32 accumulators + two operand
 # tiles comfortably inside v5e VMEM; overridable via env so a healthy
@@ -544,6 +545,32 @@ def cached_attention(q, k_pages, v_pages, cur_len, scale=None):
     logits = jnp.where(valid, logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhp,bphd->bhd", probs, v_pages)
+
+
+def paged_attention(q, k_heap, v_heap, block_tables, cur_len,
+                    scale=None):
+    """Single-position attention over a PAGED KV heap (ISSUE 18).
+
+    The paged decode engine keeps one shared page heap instead of
+    per-slot extents; each sequence's logical key positions map to
+    physical pages through its block table.  This gathers every lane's
+    pages into the (B, extent, H, D) view :func:`cached_attention`
+    expects and delegates — the masking/softmax discipline (finite
+    -1e30, ``cur_len`` >= 1) is identical, so flat-vs-paged greedy
+    decode parity holds at the token level.
+
+    ``q``: (B, H, D); ``k_heap``/``v_heap``: (n_pages, page_len, H, D)
+    — ONE layer's slice of the shared heap; ``block_tables``:
+    (B, pages_per_slot) int32 physical page ids (scratch lanes carry
+    all-zero rows: page 0 is reserved, masked by ``cur_len``);
+    ``cur_len``: (B,) int valid leading positions.  Returns (B, H, D).
+    """
+    B = q.shape[0]
+    page_len = k_heap.shape[1]
+    extent = block_tables.shape[1] * page_len
+    k = k_heap[block_tables].reshape((B, extent) + k_heap.shape[2:])
+    v = v_heap[block_tables].reshape((B, extent) + v_heap.shape[2:])
+    return cached_attention(q, k, v, cur_len, scale=scale)
 
 
 # ---------------------------------------------------------------------------

@@ -840,8 +840,12 @@ def reset_contracts() -> None:
 # kv_cache (ISSUE 15) holds the decode engine's device-resident KV
 # pool + per-slot token/length state, donated across decode steps —
 # the bucket whose bytes must stay FLAT across generations.
-CENSUS_OWNERS = ("serve", "kv_cache", "ef_residuals", "optimizer_state",
-                 "params")
+# kv_pages (ISSUE 18) is the PAGED decode engine's shared page heap +
+# block-table state — same flatness contract as kv_cache, but the
+# bucket is sized in pages, not slots, so admission headroom reads off
+# it directly.
+CENSUS_OWNERS = ("serve", "kv_cache", "kv_pages", "ef_residuals",
+                 "optimizer_state", "params")
 
 _owners_lock = threading.Lock()
 # obj -> (kind, extractor(obj) -> iterable of arrays/NDArrays)

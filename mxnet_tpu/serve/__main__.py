@@ -133,9 +133,17 @@ def main(argv=None) -> int:
     if args.decode:
         # the GENERATE lane: demo LM + continuous-batching decode pump
         # (ISSUE 15); warm() pre-builds every prefill/decode bucket so
-        # serve time pays zero traces
-        from .decode import DecodeBatcher, DecodeServable
-        decode_engine = DecodeBatcher(DecodeServable(), on_tick=tick)
+        # serve time pays zero traces.  MX_SERVE_KV_PAGES > 0 selects
+        # the PAGED engine (ISSUE 18): shared page heap + block tables,
+        # hash-shared prefixes, chunked prefill — same wire surface.
+        if int(get_env("MX_SERVE_KV_PAGES", 0, int) or 0) > 0:
+            from .decode import PagedDecodeBatcher, PagedDecodeServable
+            decode_engine = PagedDecodeBatcher(PagedDecodeServable(),
+                                               on_tick=tick)
+        else:
+            from .decode import DecodeBatcher, DecodeServable
+            decode_engine = DecodeBatcher(DecodeServable(),
+                                          on_tick=tick)
     state = ServeServer(on_tick=tick, decode=decode_engine)
     sv = None
     if args.demo or args.demo_conv or args.model:
@@ -161,14 +169,26 @@ def main(argv=None) -> int:
               file=sys.stderr, flush=True)
     if decode_engine is not None:
         dsv = decode_engine.servable
-        print("serve: decode %s v%d warm on %d prompt + %d slot "
-              "bucket(s) in %.2fs (slots=%d, max_tokens=%d, "
-              "page=%d), port %d"
-              % (dsv.name, dsv.version, len(dsv.config.prompt_buckets),
-                 len(dsv.config.slot_buckets), warm_s,
-                 dsv.config.slots, dsv.config.max_tokens,
-                 dsv.config.page, port),
-              file=sys.stderr, flush=True)
+        ps = decode_engine.page_stats()
+        if ps is not None:
+            print("serve: decode %s v%d warm (paged: %d pages x %d "
+                  "tok, chunk=%d, share=%s) in %.2fs (slots=%d, "
+                  "max_tokens=%d), port %d"
+                  % (dsv.name, dsv.version, ps["kv_pages"],
+                     ps["kv_page_len"], ps["prefill_chunk"],
+                     "on" if ps["prefix_share"] else "off", warm_s,
+                     dsv.config.slots, dsv.config.max_tokens, port),
+                  file=sys.stderr, flush=True)
+        else:
+            print("serve: decode %s v%d warm on %d prompt + %d slot "
+                  "bucket(s) in %.2fs (slots=%d, max_tokens=%d, "
+                  "page=%d), port %d"
+                  % (dsv.name, dsv.version,
+                     len(dsv.config.prompt_buckets),
+                     len(dsv.config.slot_buckets), warm_s,
+                     dsv.config.slots, dsv.config.max_tokens,
+                     dsv.config.page, port),
+                  file=sys.stderr, flush=True)
 
     serve_forever(port=port, state=state, ready_file=args.ready_file)
     print("serve: stopped", file=sys.stderr, flush=True)

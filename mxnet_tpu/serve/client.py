@@ -278,6 +278,15 @@ class ServeClient:
             raise MXNetError("serve: %s" % resp)
         return resp
 
+    def decode_stats(self, idx: Optional[int] = None) -> Optional[dict]:
+        """The replica's decode-engine section of HEALTH, or None when
+        it hosts no decode engine.  On a paged replica (ISSUE 18,
+        ``MX_SERVE_KV_PAGES`` > 0) this carries the page-level
+        admission headroom — ``engine='paged'``, ``kv_free_pages``,
+        ``shared_saved_bytes`` — that a load driver reads to assert
+        sharing actually happened."""
+        return self.health(idx=idx).get("decode")
+
     def metrics(self, idx: Optional[int] = None,
                 fmt: str = "prometheus") -> str:
         """One replica's live telemetry snapshot — the Prometheus text

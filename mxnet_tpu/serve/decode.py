@@ -64,6 +64,24 @@ then inter-token gaps); counters ``serve.decode.requests`` / ``tokens``
 / ``steps`` / ``prefills`` / ``sequences`` / ``rejected`` and the
 ``serve.decode.occupancy`` active-slots histogram drive the bench lane
 and the fleet plane.
+
+**The paged engine (ISSUE 18).**  :class:`PagedDecodeServable` /
+:class:`PagedDecodeBatcher` rebuild the KV store as a shared PAGE HEAP
+``(L, kv_pages, kv_page_len, H, Dh)`` (owner ``kv_pages`` in the
+census, donated every dispatch) addressed through per-session block
+tables, so admission is bounded by FREE PAGES, not slots — a mix of
+2-token and 10k-token sessions packs tightly into the same bytes the
+flat pool spends on worst-case extents.  Full read-only prompt pages
+are hash-shared across sessions (rolling content hash chained at page
+boundaries, refcounted adoption, copy-on-write at divergence:
+``mxnet_tpu/serve/paging.py``), and prompts prefill as page-aligned
+CHUNK trains that interleave with decode steps inside the pump's
+1-dispatch-per-tick cadence.  Greedy decode stays token-identical to
+the flat engine and :func:`reference_generate` — sharing and chunking
+change WHEN work happens, never what it computes.  Select with
+``MX_SERVE_KV_PAGES`` > 0 (``python -m mxnet_tpu.serve --decode``);
+knobs: ``MX_SERVE_KV_PAGE_LEN``, ``MX_SERVE_PREFIX_SHARE``,
+``MX_SERVE_PREFILL_CHUNK``.
 """
 from __future__ import annotations
 
@@ -82,10 +100,13 @@ from jax import lax
 from ..base import MXNetError, get_env
 from .. import fault as _fault
 from .. import telemetry as _telemetry
-from ..ops.attention import attention_core, cached_attention
+from ..ops.attention import (attention_core, cached_attention,
+                             paged_attention)
 from .batcher import Overloaded, result_timeout as _result_timeout
+from .paging import PageAllocator, page_hashes
 
 __all__ = ["DecodeConfig", "DecodeServable", "DecodeBatcher",
+           "PagedDecodeServable", "PagedDecodeBatcher",
            "demo_lm_params", "reference_generate"]
 
 # extra pool positions past prompt+generation capacity: the pump may
@@ -111,7 +132,11 @@ class DecodeConfig:
                  max_tokens: Optional[int] = None,
                  page: Optional[int] = None,
                  prompt_buckets: Optional[Sequence[int]] = None,
-                 eos_id: Optional[int] = None, seed: int = 7):
+                 eos_id: Optional[int] = None, seed: int = 7,
+                 kv_pages: Optional[int] = None,
+                 kv_page_len: Optional[int] = None,
+                 prefix_share: Optional[bool] = None,
+                 prefill_chunk: Optional[int] = None):
         self.vocab = int(vocab)
         self.dim = int(dim)
         self.heads = int(heads)
@@ -150,6 +175,36 @@ class DecodeConfig:
         self.pages = -(-need // self.page)
         self.max_len = self.pages * self.page
         self.seed = int(seed)
+        # -- paged pool geometry (ISSUE 18) ---------------------------------
+        # the paged engine swaps per-slot flat extents for one shared
+        # page heap; a session holds only the pages its actual
+        # prompt+generation extent needs, so admission is bounded by
+        # free pages, not slots
+        self.kv_page_len = int(
+            kv_page_len if kv_page_len is not None else
+            get_env("MX_SERVE_KV_PAGE_LEN", 0, int) or self.page)
+        if self.kv_page_len < 1:
+            raise MXNetError("decode: MX_SERVE_KV_PAGE_LEN must be "
+                             ">= 1, got %d" % self.kv_page_len)
+        self.pages_per_slot = -(-need // self.kv_page_len)
+        self.slot_extent = self.pages_per_slot * self.kv_page_len
+        n_pages = int(kv_pages if kv_pages is not None else
+                      get_env("MX_SERVE_KV_PAGES", 0, int))
+        if n_pages <= 0:
+            # auto: the same HBM the flat pool's (slots+1) extents take
+            n_pages = (self.slots + 1) * self.pages_per_slot
+        # floor: the scratch page plus one worst-case session
+        self.kv_pages = max(n_pages, self.pages_per_slot + 1)
+        share = (prefix_share if prefix_share is not None else
+                 get_env("MX_SERVE_PREFIX_SHARE", 1, int))
+        self.prefix_share = bool(int(share))
+        chunk = int(prefill_chunk if prefill_chunk is not None else
+                    get_env("MX_SERVE_PREFILL_CHUNK", 0, int))
+        if chunk <= 0:
+            chunk = self.kv_page_len
+        # chunks are page-aligned by construction: round up
+        self.prefill_chunk = \
+            -(-chunk // self.kv_page_len) * self.kv_page_len
 
     def prompt_bucket_for(self, n: int) -> Optional[int]:
         for b in self.prompt_buckets:
@@ -287,6 +342,124 @@ def _prefill_body(cfg: DecodeConfig, params, k_pool, v_pool, tokens,
     return k_pool, v_pool, tokens, lengths, t0
 
 
+def _paged_decode_body(cfg: DecodeConfig, params, k_heap, v_heap,
+                       tokens, lengths, slot_ids, block_tbls):
+    """One decode step over the packed active set, PAGED pool (ISSUE
+    18).
+
+    ``k_heap``/``v_heap``: (L, kv_pages, kv_page_len, H, Dh) donated —
+    the ONE shared heap; ``block_tbls``: (b, pages_per_slot) int32
+    physical page ids per lane (padded lanes carry all-zero rows: page
+    0 is the reserved scratch page).  The new token's KV entry scatters
+    to ``block_tbls[lane][pos // page_len]`` at offset ``pos %
+    page_len``; attention gathers each lane's pages back into its
+    logical extent via :func:`paged_attention`.  Decode never writes a
+    SHARED page: generation positions live past the prompt, in pages
+    the session allocated privately.
+    """
+    pl = cfg.kv_page_len
+    tok = tokens[slot_ids]                              # (b,)
+    lens = lengths[slot_ids]                            # (b,)
+    x = params["emb"][tok]                              # (b, D)
+    b = x.shape[0]
+    pos = lens                     # this token's logical write position
+    page_idx = jnp.clip(pos // pl, 0, cfg.pages_per_slot - 1)
+    phys = jnp.take_along_axis(block_tbls, page_idx[:, None],
+                               axis=1)[:, 0]            # (b,)
+    off = pos % pl
+    for l in range(cfg.layers):
+        k_new = (x @ params["l%d.wk" % l]).reshape(
+            b, cfg.heads, cfg.head_dim)
+        v_new = (x @ params["l%d.wv" % l]).reshape(
+            b, cfg.heads, cfg.head_dim)
+        k_heap = k_heap.at[l, phys, off].set(k_new)
+        v_heap = v_heap.at[l, phys, off].set(v_new)
+        q = (x @ params["l%d.wq" % l]).reshape(b, cfg.heads,
+                                               cfg.head_dim)
+        att = paged_attention(q, k_heap[l], v_heap[l], block_tbls,
+                              lens + 1)
+        x = x + att.reshape(b, cfg.dim) @ params["l%d.wo" % l]
+        x = _block_mlp(params, l, x)
+    logits = x @ params["unemb"]                        # (b, V)
+    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    tokens = tokens.at[slot_ids].set(nxt)
+    lengths = lengths.at[slot_ids].set(lens + 1)
+    # park the scratch slot (same discipline as the flat body)
+    tokens = tokens.at[cfg.slots].set(0)
+    lengths = lengths.at[cfg.slots].set(0)
+    return k_heap, v_heap, tokens, lengths, nxt
+
+
+def _prefill_chunk_body(cfg: DecodeConfig, params, k_heap, v_heap,
+                        tokens, lengths, slot_id, block_tbl, chunk,
+                        start, nvalid, emit, cow_src, cow_dst):
+    """One page-aligned prefill CHUNK into the paged heap (ISSUE 18).
+
+    ``chunk``: (prefill_chunk,) token ids for absolute positions
+    ``start .. start+Lc-1`` (rows past ``nvalid`` are padding — their
+    KV writes land in the session's own reserved pages or the scratch
+    page and are masked/overwritten, never attended); ``block_tbl``:
+    (pages_per_slot,) this session's physical pages.  Row ``r``
+    attends causally over absolute keys ``0 .. start+r``, gathered
+    through the block table — earlier chunks' (or a DONOR's shared)
+    pages included, so chunking is bit-compatible with one monolithic
+    prefill.
+
+    Copy-on-write folds in here: the program FIRST copies page
+    ``cow_src`` -> ``cow_dst`` (both scalars; ``src == dst == 0`` is
+    the self-copy no-op for chunks with no divergence), so a full
+    prompt-coverage prefix hit needs only this ONE replay-chunk
+    dispatch to fork the donor's last page and emit the first token —
+    one trace signature regardless, keeping the chunk program table
+    closed.
+
+    ``emit`` > 0 (the final chunk) samples the first generated token
+    from row ``nvalid - 1`` and arms the slot's next-input token;
+    earlier chunks leave it untouched.  ``lengths[slot]`` advances to
+    ``start + nvalid`` either way.
+    """
+    pl = cfg.kv_page_len
+    Lc = chunk.shape[0]
+    k_heap = k_heap.at[:, cow_dst].set(k_heap[:, cow_src])
+    v_heap = v_heap.at[:, cow_dst].set(v_heap[:, cow_src])
+    x = params["emb"][chunk]                            # (Lc, D)
+    p = start + jnp.arange(Lc)                          # absolute pos
+    page_idx = jnp.clip(p // pl, 0, cfg.pages_per_slot - 1)
+    phys = block_tbl[page_idx]                          # (Lc,)
+    off = p % pl
+    ext = cfg.pages_per_slot * pl
+    # causal-prefix mask: row r sees absolute keys 0..start+r (>= 1
+    # live key per row, so the finite -1e30 masking stays NaN-free)
+    mask = jnp.arange(ext)[None, :] <= p[:, None]       # (Lc, ext)
+    for l in range(cfg.layers):
+        k = (x @ params["l%d.wk" % l]).reshape(Lc, cfg.heads,
+                                               cfg.head_dim)
+        v = (x @ params["l%d.wv" % l]).reshape(Lc, cfg.heads,
+                                               cfg.head_dim)
+        k_heap = k_heap.at[l, phys, off].set(k)
+        v_heap = v_heap.at[l, phys, off].set(v)
+        q = (x @ params["l%d.wq" % l]).reshape(Lc, cfg.heads,
+                                               cfg.head_dim)
+        k_all = k_heap[l, block_tbl].reshape(ext, cfg.heads,
+                                             cfg.head_dim)
+        v_all = v_heap[l, block_tbl].reshape(ext, cfg.heads,
+                                             cfg.head_dim)
+        q4 = q.transpose(1, 0, 2)[None]                 # (1, H, Lc, Dh)
+        k4 = k_all.transpose(1, 0, 2)[None]
+        v4 = v_all.transpose(1, 0, 2)[None]
+        att = attention_core(q4, k4, v4, mask=mask[None, None])
+        x = x + att[0].transpose(1, 0, 2).reshape(Lc, cfg.dim) \
+            @ params["l%d.wo" % l]
+        x = _block_mlp(params, l, x)
+    x_last = jnp.take(x, jnp.maximum(nvalid - 1, 0), axis=0)
+    logits = x_last @ params["unemb"]
+    t0 = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    tokens = tokens.at[slot_id].set(
+        jnp.where(emit > 0, t0, tokens[slot_id]))
+    lengths = lengths.at[slot_id].set(start + nvalid)
+    return k_heap, v_heap, tokens, lengths, t0
+
+
 # geometry-keyed jit cache for the reference oracle: a load driver
 # replays MANY reference decodes against one model — per-token eager
 # dispatch would dominate its wall time.  Plain jax.jit, deliberately
@@ -371,6 +544,22 @@ class DecodeServable:
     lifetime.  Only the pump thread may dispatch (single-writer state).
     """
 
+    #: engine discriminator on the health surface; the paged subclass
+    #: overrides both (its heap is censused under ``kv_pages``)
+    engine = "flat"
+    census_owner = "kv_cache"
+
+    def _alloc_state(self) -> Dict[str, jnp.ndarray]:
+        cfg = self.config
+        shape = (cfg.layers, cfg.slots + 1, cfg.max_len, cfg.heads,
+                 cfg.head_dim)
+        return {
+            "k": jnp.zeros(shape, jnp.float32),
+            "v": jnp.zeros(shape, jnp.float32),
+            "tok": jnp.zeros((cfg.slots + 1,), jnp.int32),
+            "len": jnp.zeros((cfg.slots + 1,), jnp.int32),
+        }
+
     def __init__(self, params: Optional[Dict] = None,
                  config: Optional[DecodeConfig] = None,
                  name: str = "demo-lm", version: int = 1):
@@ -379,21 +568,13 @@ class DecodeServable:
             else demo_lm_params(self.config)
         self.name = str(name)
         self.version = int(version)
-        cfg = self.config
-        shape = (cfg.layers, cfg.slots + 1, cfg.max_len, cfg.heads,
-                 cfg.head_dim)
-        self._state: Dict[str, jnp.ndarray] = {
-            "k": jnp.zeros(shape, jnp.float32),
-            "v": jnp.zeros(shape, jnp.float32),
-            "tok": jnp.zeros((cfg.slots + 1,), jnp.int32),
-            "len": jnp.zeros((cfg.slots + 1,), jnp.int32),
-        }
+        self._state: Dict[str, jnp.ndarray] = self._alloc_state()
         from .. import programs as _programs
         self._kv_handle = _CensusHandle(
             lambda: list(self._state.values()))
         self._params_handle = _CensusHandle(
             lambda: list(self.params.values()))
-        _programs.track_buffers("kv_cache", self._kv_handle,
+        _programs.track_buffers(self.census_owner, self._kv_handle,
                                 lambda h: h.fn())
         _programs.track_buffers("serve", self._params_handle,
                                 lambda h: h.fn())
@@ -524,6 +705,179 @@ class DecodeServable:
         a slot here — the pool is ``slots + 1`` lanes wide), i.e. the
         bytes a free slot represents as ADMISSION headroom."""
         return self.kv_state_bytes() // (self.config.slots + 1)
+
+
+class PagedDecodeServable(DecodeServable):
+    """The PAGED decode servable (ISSUE 18): same model, but the KV
+    store is one shared page heap ``(L, kv_pages, kv_page_len, H,
+    Dh)`` — owner-tagged ``kv_pages`` in the census, donated through
+    every dispatch — addressed per session through host-side block
+    tables.  Two program tables replace the flat pair:
+
+    * ``serve.decode.paged.step.s{b}`` per slot bucket — the decode
+      step with per-lane block tables (scatter the new KV entry to its
+      physical page, gather the lane's pages for attention);
+    * ``serve.decode.paged.prefill.c{Lc}`` — ONE chunk program (the
+      chunk length is the compile unit, not the prompt bucket): any
+      admitted prompt prefills as a train of page-aligned chunks, and
+      the CoW page fork rides the same signature, so the trace set is
+      closed with a single prefill program regardless of prompt
+      length.
+
+    The monolithic flat prefill has no paged analogue —
+    :meth:`dispatch_prefill` raises; the pump schedules
+    :meth:`dispatch_chunk` trains instead.
+    """
+
+    engine = "paged"
+    census_owner = "kv_pages"
+
+    def _alloc_state(self) -> Dict[str, jnp.ndarray]:
+        cfg = self.config
+        heap = (cfg.layers, cfg.kv_pages, cfg.kv_page_len, cfg.heads,
+                cfg.head_dim)
+        return {
+            "k": jnp.zeros(heap, jnp.float32),
+            "v": jnp.zeros(heap, jnp.float32),
+            "tok": jnp.zeros((cfg.slots + 1,), jnp.int32),
+            "len": jnp.zeros((cfg.slots + 1,), jnp.int32),
+        }
+
+    # -- program tables -----------------------------------------------------
+    def step_program(self, bucket: int):
+        bucket = int(bucket)
+        with self._lock:
+            prog = self._step_programs.get(bucket)
+            if prog is not None:
+                self.hits += 1
+        if prog is not None:
+            self._c_hits.inc()
+            return prog
+        cfg = self.config
+
+        def run_decode(params, k_heap, v_heap, tokens, lengths,
+                       slot_ids, block_tbls):
+            return _paged_decode_body(cfg, params, k_heap, v_heap,
+                                      tokens, lengths, slot_ids,
+                                      block_tbls)
+
+        from .. import programs as _programs
+        with _telemetry.phase("retrace"):
+            prog = _programs.register_program(
+                "serve.decode.paged.step.s%d" % bucket, run_decode,
+                donate_argnums=(1, 2, 3, 4))
+        with self._lock:
+            prog = self._step_programs.setdefault(bucket, prog)
+            self.retraces += 1
+        self._c_retrace.inc()
+        return prog
+
+    def chunk_program(self):
+        """THE prefill program: one signature (chunk length
+        ``prefill_chunk``) covers every admitted prompt as a chunk
+        train."""
+        lc = self.config.prefill_chunk
+        with self._lock:
+            prog = self._prefill_programs.get(lc)
+            if prog is not None:
+                self.hits += 1
+        if prog is not None:
+            self._c_hits.inc()
+            return prog
+        cfg = self.config
+
+        def run_chunk(params, k_heap, v_heap, tokens, lengths, slot_id,
+                      block_tbl, chunk, start, nvalid, emit, cow_src,
+                      cow_dst):
+            return _prefill_chunk_body(cfg, params, k_heap, v_heap,
+                                       tokens, lengths, slot_id,
+                                       block_tbl, chunk, start, nvalid,
+                                       emit, cow_src, cow_dst)
+
+        from .. import programs as _programs
+        with _telemetry.phase("retrace"):
+            prog = _programs.register_program(
+                "serve.decode.paged.prefill.c%d" % lc, run_chunk,
+                donate_argnums=(1, 2, 3, 4))
+        with self._lock:
+            prog = self._prefill_programs.setdefault(lc, prog)
+            self.retraces += 1
+        self._c_retrace.inc()
+        return prog
+
+    def prefill_program(self, prompt_bucket: int):
+        raise MXNetError("paged decode servable has no monolithic "
+                         "prefill program; prompts prefill as chunk "
+                         "trains (chunk_program)")
+
+    # -- dispatch (pump thread only; mxlint hot-path roots) -----------------
+    def dispatch_step(self, slot_ids: _np.ndarray,
+                      block_tbls: _np.ndarray):
+        """ONE device program over the packed active set + its block
+        tables; rebinds the donated heap state."""
+        from ..engine import engine as _engine
+        prog = self.step_program(len(slot_ids))
+        st = self._state
+        k, v, tok, ln, out = prog(self.params, st["k"], st["v"],
+                                  st["tok"], st["len"], slot_ids,
+                                  block_tbls)
+        self._state = {"k": k, "v": v, "tok": tok, "len": ln}
+        _engine.count_dispatch(1)
+        return out
+
+    def dispatch_prefill(self, slot: int, prompt: _np.ndarray, n: int):
+        raise MXNetError("paged decode servable has no monolithic "
+                         "prefill dispatch; use dispatch_chunk")
+
+    def dispatch_chunk(self, slot: int, block_tbl: _np.ndarray,
+                       chunk: _np.ndarray, start: int, nvalid: int,
+                       emit: bool, cow_src: int = 0, cow_dst: int = 0):
+        """ONE device program writing one page-aligned prefill chunk
+        (plus the optional CoW page fork) through ``slot``'s block
+        table; returns the chunk's sampled token as a () device array
+        (meaningful only when ``emit``)."""
+        from ..engine import engine as _engine
+        prog = self.chunk_program()
+        st = self._state
+        k, v, tok, ln, t0 = prog(
+            self.params, st["k"], st["v"], st["tok"], st["len"],
+            _np.int32(slot), block_tbl, chunk, _np.int32(start),
+            _np.int32(nvalid), _np.int32(1 if emit else 0),
+            _np.int32(cow_src), _np.int32(cow_dst))
+        self._state = {"k": k, "v": v, "tok": tok, "len": ln}
+        _engine.count_dispatch(1)
+        return t0
+
+    def warm(self) -> "PagedDecodeServable":
+        """Pre-build + pre-run the chunk program and every decode slot
+        bucket against the scratch page/slot, then reset the
+        bookkeeping — zero serve-time retraces, as the flat engine."""
+        cfg = self.config
+        tbl = _np.zeros(cfg.pages_per_slot, _np.int32)
+        self.dispatch_chunk(cfg.slots, tbl,
+                            _np.zeros(cfg.prefill_chunk, _np.int32),
+                            0, cfg.prefill_chunk, False)
+        for b in cfg.slot_buckets:
+            self.dispatch_step(
+                _np.full(b, cfg.slots, _np.int32),
+                _np.zeros((b, cfg.pages_per_slot), _np.int32))
+        jax.block_until_ready(self._state["k"])
+        self._state["tok"] = jnp.zeros_like(self._state["tok"])
+        self._state["len"] = jnp.zeros_like(self._state["len"])
+        self.warmed = True
+        return self
+
+    def page_bytes(self) -> int:
+        """One physical page's K+V bytes across all layers — the unit
+        the allocator's headroom gauges convert to bytes with."""
+        cfg = self.config
+        return (2 * cfg.layers * cfg.kv_page_len * cfg.heads *
+                cfg.head_dim * 4)
+
+    def kv_slot_bytes(self) -> int:
+        """A worst-case session's heap share (its full block-table
+        extent) — what one admission can cost at most."""
+        return self.page_bytes() * self.config.pages_per_slot
 
 
 class _PendingGen:
@@ -724,6 +1078,11 @@ class DecodeBatcher:
         with self._slot_lk:
             return sum(1 for g in self._slots if g is not None)
 
+    def page_stats(self) -> Optional[Dict]:
+        """Paged-engine capacity detail for the health surface; the
+        flat engine has none."""
+        return None
+
     def _set_capacity_gauges(self, active: int) -> None:
         """Publish the per-replica capacity signals for ``active``
         occupied slots (called wherever occupancy changes)."""
@@ -818,21 +1177,53 @@ class DecodeBatcher:
                 g._fail(e)
         return False
 
+    # -- locked slot/queue helpers ------------------------------------------
+    # the ONLY direct touches of ``_slots`` / ``_q`` outside __init__ /
+    # _loop / submit: the paged subclass schedules through these, so
+    # the lock discipline lives (and is lint-attributed) in one class
+    def _finished_slots(self) -> List[Tuple[int, _PendingGen]]:
+        with self._slot_lk:
+            return [(i, g) for i, g in enumerate(self._slots)
+                    if g is not None and g.done()]
+
+    def _free_slot_ids(self) -> List[int]:
+        with self._slot_lk:
+            return [i for i, g in enumerate(self._slots) if g is None]
+
+    def _clear_slots(self, ids: Sequence[int]) -> None:
+        with self._slot_lk:
+            for i in ids:
+                self._slots[i] = None
+
+    def _bind_slot(self, slot: int, gen: _PendingGen) -> None:
+        with self._slot_lk:
+            self._slots[slot] = gen
+
+    def _peek_queued(self) -> Optional[_PendingGen]:
+        """Head of the admission queue without taking it (the pump is
+        the only consumer, so a later pop returns the same request)."""
+        with self._cv:
+            return self._q[0] if self._q else None
+
+    def _pop_queued(self) -> Optional[_PendingGen]:
+        with self._cv:
+            if not self._q:
+                return None
+            gen = self._q.popleft()
+            self._g_queue.set(len(self._q))
+            return gen
+
     def _retire(self) -> None:
         """Step boundary, phase ``kv_evict``: free the slots of
         completed sequences.  Eviction is bookkeeping — the pool pages
         stay allocated (flat HBM); the next prefill into the slot
         resets its length and overwrites from position 0, and stale
         entries beyond the new length are masked, never read."""
-        with self._slot_lk:
-            done = [(i, g) for i, g in enumerate(self._slots)
-                    if g is not None and g.done()]
+        done = self._finished_slots()
         if not done:
             return
         with _telemetry.phase("kv_evict"):
-            with self._slot_lk:
-                for i, _g in done:
-                    self._slots[i] = None
+            self._clear_slots([i for i, _g in done])
         self._c_seqs.inc(len(done))
         active = self.active_count()
         self._g_active.set(active)
@@ -844,26 +1235,21 @@ class DecodeBatcher:
         (the bench strawman) admits only when the whole previous batch
         has retired — exactly the behavior continuous batching
         exists to beat."""
-        with self._slot_lk:
-            free = [i for i, g in enumerate(self._slots) if g is None]
-            occupied = len(self._slots) - len(free)
+        free = self._free_slot_ids()
+        occupied = self._sv.config.slots - len(free)
         if self._mode == "request" and occupied:
             return
         while free:
-            with self._cv:
-                if not self._q:
-                    break
-                gen = self._q.popleft()
-                self._g_queue.set(len(self._q))
+            gen = self._pop_queued()
+            if gen is None:
+                break
             slot = free.pop(0)
             gen.slot = slot
-            with self._slot_lk:
-                self._slots[slot] = gen
+            self._bind_slot(slot, gen)
             try:
                 self._dispatch_prefill(gen, slot)
             except BaseException as e:
-                with self._slot_lk:
-                    self._slots[slot] = None
+                self._clear_slots([slot])
                 gen._fail(e)
 
     def _active(self) -> List[Tuple[int, _PendingGen]]:
@@ -986,6 +1372,353 @@ class DecodeBatcher:
             self._harvester.join(timeout=timeout)
 
 
+class _PagedSeq:
+    """Host bookkeeping for one admitted PAGED session: its block
+    table, the page references it holds, the remaining prefill-chunk
+    train, and the full-page hashes to publish once the train has
+    dispatched.  Pump-thread-only."""
+
+    __slots__ = ("gen", "table", "held", "chunks", "publish")
+
+    def __init__(self, gen, table, held, chunks, publish):
+        self.gen = gen
+        self.table = table          # np.int32 (pages_per_slot,)
+        self.held = held            # page ids to release at retire
+        self.chunks = chunks        # deque of pending chunk dispatches
+        self.publish = publish      # [(chain_hash, page)] after train
+
+
+class PagedDecodeBatcher(DecodeBatcher):
+    """The paged continuous-batching engine (ISSUE 18): the flat
+    pump's loop with three changes —
+
+    * **Admission is bounded by pages, not slots.**  ``_admit`` plans
+      each head-of-queue request against the
+      :class:`~mxnet_tpu.serve.paging.PageAllocator`: hash-share full
+      prompt pages from earlier sessions, allocate private pages for
+      the rest of the worst-case extent, and queue the prefill-chunk
+      train.  No pages -> the request WAITS (head-of-line; no
+      half-allocation); free slots beyond page capacity are just
+      cheap int32 rows, so configs can run slots >> the flat pool's
+      count at the same heap bytes.
+
+    * **Chunked prefill interleaves with decode.**  Each tick
+      dispatches exactly ONE program: a pending prefill chunk and the
+      decode step over the DECODING active set alternate
+      (``_chunk_turn``), so a 10k-token admission never stalls
+      in-flight generations for more than one chunk-step, and the
+      1-dispatch-per-tick budget ``tools/dispatch_count.py`` pins
+      holds with chunks counted as steps.
+
+    * **Prefix reuse is plumbed, not special-cased.**  A full-coverage
+      hash hit admits with a single CoW replay chunk (fork the donor's
+      last page, recompute its final position, emit the first token);
+      a partial hit prefills only the suffix chunks.  Decode never
+      writes shared pages (generation positions land in private
+      pages), and publication happens strictly after the owning
+      chunks' dispatches, so sharing is invisible to correctness —
+      paged greedy decode is token-identical to the flat engine and
+      the oracle.
+
+    Continuous-only: the request-level strawman stays on the flat
+    engine.
+    """
+
+    def __init__(self, servable: PagedDecodeServable,
+                 queue_cap: Optional[int] = None,
+                 mode: str = "continuous", on_tick=None,
+                 autostart: bool = True):
+        if not isinstance(servable, PagedDecodeServable):
+            raise MXNetError("PagedDecodeBatcher needs a "
+                             "PagedDecodeServable")
+        if mode != "continuous":
+            raise MXNetError("the paged engine is continuous-only; "
+                             "mode=%r belongs to the flat engine's "
+                             "bench strawman" % (mode,))
+        # pre-super wiring: the base __init__ publishes capacity gauges
+        # through our override, which needs the allocator + extra
+        # instruments in place
+        self._sv = servable
+        self._alloc = PageAllocator(servable.config.kv_pages)
+        self._seqs: Dict[int, _PagedSeq] = {}
+        self._chunk_turn = False
+        self._chunk_rr = -1      # last slot whose chunk was served
+        reg = _telemetry.registry
+        self._c_chunks = reg.counter(
+            "serve.decode.prefill_chunks",
+            doc="prefill-chunk device dispatches (a prompt admits as a "
+                "train of page-aligned chunks interleaved with decode "
+                "steps)")
+        self._c_shared = reg.counter(
+            "serve.decode.shared_page_hits",
+            doc="prompt pages adopted from the prefix hash table "
+                "instead of prefilled (each one is a skipped chunk's "
+                "worth of work and a page of HBM not allocated)")
+        self._c_cow = reg.counter(
+            "serve.decode.cow_forks",
+            doc="copy-on-write page forks (full prompt-coverage prefix "
+                "hits replaying only their final position)")
+        self._g_free_pages = reg.gauge(
+            "serve.decode.kv_free_pages",
+            doc="KV heap pages currently allocatable (free + evictable "
+                "cached prefix pages); the paged admission headroom "
+                "the fleet plane reports")
+        self._g_shared_saved = reg.gauge(
+            "serve.decode.kv_shared_saved_bytes",
+            doc="KV heap bytes prefix sharing is saving right now "
+                "(extra references on hashed pages x page bytes)")
+        super().__init__(servable, queue_cap=queue_cap, mode=mode,
+                         on_tick=on_tick, autostart=autostart)
+
+    # -- capacity surface ---------------------------------------------------
+    def _set_capacity_gauges(self, active: int) -> None:
+        slots = self._sv.config.slots
+        self._g_occupancy.set(active / float(slots) if slots else 0.0)
+        pb = self._sv.page_bytes()
+        free = self._alloc.free_pages()
+        self._g_headroom.set(free * pb)
+        self._g_free_pages.set(free)
+        self._g_shared_saved.set(self._alloc.shared_extra_refs() * pb)
+
+    def page_stats(self) -> Dict:
+        cfg = self._sv.config
+        pb = self._sv.page_bytes()
+        st = self._alloc.stats()
+        return {
+            "engine": "paged",
+            "kv_pages": cfg.kv_pages,
+            "kv_page_len": cfg.kv_page_len,
+            "prefill_chunk": cfg.prefill_chunk,
+            "prefix_share": cfg.prefix_share,
+            "kv_free_pages": st["free"],
+            "kv_cached_pages": st["cached"],
+            "shared_hits": st["shared_hits"],
+            "shared_saved_bytes":
+                self._alloc.shared_extra_refs() * pb,
+        }
+
+    # -- the paged pump (mxlint hot-path roots) -----------------------------
+    def _tick(self) -> bool:
+        """One boundary, ONE dispatch: retire, admit (bookkeeping
+        only), then EITHER the next pending prefill chunk OR the
+        decode step — alternating while both kinds of work exist."""
+        self._retire()
+        self._admit()
+        chunk_slot = self._next_chunk_slot()
+        active = self._active()
+        if chunk_slot is not None and (self._chunk_turn or not active):
+            self._chunk_turn = False
+            self._dispatch_chunk_for(chunk_slot)
+            return False
+        self._chunk_turn = True
+        if not active:
+            return chunk_slot is None
+        try:
+            self._step(active)
+        except BaseException as e:            # XLA failure: fail the set
+            for _slot, g in active:
+                g._fail(e)
+        return False
+
+    def _retire(self) -> None:
+        """Step boundary, phase ``kv_evict``: release finished
+        sessions' page references.  A released page whose content is
+        published under a prefix hash parks in the allocator's LRU
+        cache — still adoptable — instead of freeing; the heap itself
+        never reallocates (flat HBM)."""
+        done = self._finished_slots()
+        if not done:
+            return
+        with _telemetry.phase("kv_evict"):
+            self._clear_slots([i for i, _g in done])
+            for i, _g in done:
+                seq = self._seqs.pop(i, None)
+                if seq is not None:
+                    for p in seq.held:
+                        self._alloc.release(p)
+        self._c_seqs.inc(len(done))
+        active = self.active_count()
+        self._g_active.set(active)
+        self._set_capacity_gauges(active)
+
+    def _admit(self) -> None:
+        """Admission bounded by PAGES: plan the head-of-queue request
+        (prefix lookup + private-page allocation + chunk train) and
+        take a slot only when its worst-case extent fits.  Pure
+        bookkeeping — the chunks dispatch on later ticks."""
+        while True:
+            free = self._free_slot_ids()
+            if not free:
+                return
+            gen = self._peek_queued()
+            if gen is None:
+                return
+            plan = self._plan(gen)
+            if plan is None:
+                return            # head-of-line waits for free pages
+            self._pop_queued()    # == gen: the pump is the only consumer
+            slot = free[0]
+            gen.slot = slot
+            table, held, chunks, publish = plan
+            self._bind_slot(slot, gen)
+            self._seqs[slot] = _PagedSeq(gen, table, held, chunks,
+                                         publish)
+            active = self.active_count()
+            self._g_active.set(active)
+            self._set_capacity_gauges(active)
+
+    def _plan(self, gen: _PendingGen):
+        """Map one request onto the heap: shared prefix pages adopted
+        by hash, private pages allocated for the rest of the
+        worst-case extent, prefill chunks laid out page-aligned.
+        Returns (table, held, chunks, publish) or None when the pages
+        don't fit (nothing is retained on failure)."""
+        cfg = self._sv.config
+        pl = cfg.kv_page_len
+        prompt = gen.prompt
+        n = len(prompt)
+        need_pages = min(
+            cfg.pages_per_slot,
+            -(-(n + gen.max_new + _OVERRUN_MARGIN) // pl))
+        hashes = page_hashes(prompt, pl) if cfg.prefix_share else []
+        shared: List[int] = []
+        for h in hashes:
+            p = self._alloc.lookup(h)
+            if p is None:
+                break
+            shared.append(p)
+        cow_src = None
+        if shared and len(shared) * pl == n:
+            # full coverage: fork the donor's last page (CoW) and
+            # replay only the final position to emit the first token
+            cow_src = shared.pop()
+        priv = self._alloc.alloc(need_pages - len(shared))
+        if priv is None:
+            for p in shared:
+                self._alloc.release(p)
+            if cow_src is not None:
+                self._alloc.release(cow_src)
+            return None
+        if shared or cow_src is not None:
+            self._c_shared.inc(len(shared) +
+                               (1 if cow_src is not None else 0))
+        table = _np.zeros(cfg.pages_per_slot, _np.int32)
+        table[:len(shared)] = shared
+        table[len(shared):need_pages] = priv
+        held = shared + priv
+        if cow_src is not None:
+            held.append(cow_src)   # keep the donor page live until
+            #                        retire: its fork copy must not
+            #                        race a reuse of the page
+        chunks: deque = deque()
+        publish: List[Tuple[int, int]] = []
+        Lc = cfg.prefill_chunk
+        if cow_src is not None:
+            self._c_cow.inc()
+            buf = _np.zeros(Lc, _np.int32)
+            buf[0] = prompt[n - 1]
+            chunks.append((buf, n - 1, 1, True, int(cow_src),
+                           int(priv[0])))
+        else:
+            start0 = len(shared) * pl
+            for s in range(start0, n, Lc):
+                e = min(n, s + Lc)
+                buf = _np.zeros(Lc, _np.int32)
+                buf[:e - s] = prompt[s:e]
+                chunks.append((buf, s, e - s, e == n, 0, 0))
+            if cfg.prefix_share:
+                for i in range(len(shared), n // pl):
+                    publish.append((hashes[i], int(table[i])))
+        return table, held, chunks, publish
+
+    def _active(self) -> List[Tuple[int, _PendingGen]]:
+        """The DECODING active set: sessions whose prefill-chunk train
+        has fully dispatched (prefilling sessions are not packed into
+        decode steps)."""
+        return [(i, g) for i, g in super()._active()
+                if not (i in self._seqs and self._seqs[i].chunks)]
+
+    def _next_chunk_slot(self) -> Optional[int]:
+        # _seqs entries are popped exactly when their slot clears
+        # (_retire / _drop_seq, both on the pump), so a live entry
+        # implies a live slot.  ROUND-ROBIN over chunk-pending
+        # sessions: a 10k-token train must not starve a later
+        # admission's one-chunk prefill of its first token.
+        pending = sorted(i for i in self._seqs if self._seqs[i].chunks)
+        if not pending:
+            return None
+        for i in pending:
+            if i > self._chunk_rr:
+                return i
+        return pending[0]
+
+    def _dispatch_chunk_for(self, slot: int) -> None:
+        """ONE prefill-chunk dispatch.  The train's last chunk emits
+        the first token (handed to the harvester like the flat
+        prefill's) and triggers hash publication — strictly after the
+        pages' writes are in the dispatch stream."""
+        seq = self._seqs[slot]
+        gen = seq.gen
+        self._chunk_rr = slot
+        chunk, start, nvalid, emit, cow_src, cow_dst = \
+            seq.chunks.popleft()
+        try:
+            with _telemetry.phase("prefill") as span:
+                if gen.trace_ctx is not None:
+                    span.event("request", req_trace=gen.trace_ctx[0],
+                               req_span=gen.trace_ctx[1], slot=slot)
+                t0 = self._sv.dispatch_chunk(slot, seq.table, chunk,
+                                             start, nvalid, emit,
+                                             cow_src, cow_dst)
+        except BaseException as e:
+            self._drop_seq(slot)
+            gen._fail(e)
+            return
+        self._c_chunks.inc()
+        if not seq.chunks:
+            # train complete = the flat engine's "prefill" unit
+            self._c_prefills.inc()
+            for h, page in seq.publish:
+                self._alloc.publish(h, page)
+            seq.publish = []
+            active = self.active_count()
+            self._g_active.set(active)
+            self._set_capacity_gauges(active)
+            self._hq_put(([gen], t0))
+
+    def _drop_seq(self, slot: int) -> None:
+        self._clear_slots([slot])
+        seq = self._seqs.pop(slot, None)
+        if seq is not None:
+            for p in seq.held:
+                self._alloc.release(p)
+
+    def _dispatch_prefill(self, gen: _PendingGen, slot: int) -> None:
+        raise MXNetError("paged engine prefills via chunk trains, "
+                         "never the monolithic prefill")
+
+    def _step(self, active: List[Tuple[int, _PendingGen]]) -> None:
+        """ONE decode dispatch over the packed DECODING set, each lane
+        carrying its block-table row (padded lanes: all-zero rows ->
+        the scratch page)."""
+        cfg = self._sv.config
+        bucket = cfg.slot_bucket_for(len(active))
+        ids = _np.full(bucket, cfg.slots, _np.int32)
+        ids[:len(active)] = [slot for slot, _g in active]
+        tbls = _np.zeros((bucket, cfg.pages_per_slot), _np.int32)
+        for lane, (slot, _g) in enumerate(active):
+            tbls[lane] = self._seqs[slot].table
+        with _telemetry.phase("decode_step") as span:
+            for _slot, g in active:
+                if g.trace_ctx is not None:
+                    span.event("request", req_trace=g.trace_ctx[0],
+                               req_span=g.trace_ctx[1])
+            out = self._sv.dispatch_step(ids, tbls)
+        self._c_steps.inc()
+        self._h_occ.observe(len(active))
+        self._hq_put(([g for _slot, g in active], out))
+
+
 # ---------------------------------------------------------------------------
 # Program contracts (ISSUE 11): the decode engine's declared proofs.
 # ``serve.decode`` covers every slot-bucket decode program:
@@ -1050,6 +1783,72 @@ def _decode_contract_built():
     return step_cases, step_closure, prefill_cases, prefill_closure
 
 
+@_functools.lru_cache(maxsize=1)
+def _paged_contract_built():
+    """The paged engine's contract cases/closures (ISSUE 18):
+
+    * ``serve.paged.decode`` — every slot-bucket step program with its
+      block-table argument; heap donation proven; closed over active
+      set sizes 1..slots.
+    * ``serve.paged.prefill`` — THE chunk program: one signature
+      (chunk length) serves every admitted prompt length as a chunk
+      train, CoW folds into the same signature, so the closure maps
+      ANY prompt length 1..top-bucket to the single compiled case —
+      zero serve-time retraces as a theorem with a one-program prefill
+      table.
+    """
+    from ..programs import ContractCase, ContractClosure
+    cfg = DecodeConfig()
+    sv = PagedDecodeServable(config=cfg)
+    params_abs = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                  for k, v in sv.params.items()}
+    heap_abs = jax.ShapeDtypeStruct(
+        (cfg.layers, cfg.kv_pages, cfg.kv_page_len, cfg.heads,
+         cfg.head_dim), jnp.float32)
+    tok_abs = jax.ShapeDtypeStruct((cfg.slots + 1,), jnp.int32)
+    scalar_abs = jax.ShapeDtypeStruct((), jnp.int32)
+    tbl_abs = jax.ShapeDtypeStruct((cfg.pages_per_slot,), jnp.int32)
+
+    def step_args(bucket):
+        return (params_abs, heap_abs, heap_abs, tok_abs, tok_abs,
+                jax.ShapeDtypeStruct((bucket,), jnp.int32),
+                jax.ShapeDtypeStruct((bucket, cfg.pages_per_slot),
+                                     jnp.int32))
+
+    chunk_args = (params_abs, heap_abs, heap_abs, tok_abs, tok_abs,
+                  scalar_abs, tbl_abs,
+                  jax.ShapeDtypeStruct((cfg.prefill_chunk,),
+                                       jnp.int32),
+                  scalar_abs, scalar_abs, scalar_abs, scalar_abs,
+                  scalar_abs)
+
+    step_cases = [ContractCase("serve.decode.paged.step.s%d" % b,
+                               step_args(b), label="s%d" % b,
+                               target=sv.step_program(b))
+                  for b in cfg.slot_buckets]
+    chunk_cases = [ContractCase(
+        "serve.decode.paged.prefill.c%d" % cfg.prefill_chunk,
+        chunk_args, label="c%d" % cfg.prefill_chunk,
+        target=sv.chunk_program())]
+
+    def resolve_step(n):
+        return step_args(cfg.slot_bucket_for(int(n)))
+
+    def resolve_chunk(n):
+        # ANY admitted prompt length prefills as a train of the ONE
+        # chunk signature; over-bucket prompts are refused at
+        # admission (never reach a jit)
+        if cfg.prompt_bucket_for(int(n)) is None:
+            return None
+        return chunk_args
+
+    step_closure = ContractClosure(range(1, cfg.slots + 1),
+                                   resolve_step)
+    chunk_closure = ContractClosure(
+        range(1, cfg.prompt_buckets[-1] + 3), resolve_chunk)
+    return step_cases, step_closure, chunk_cases, chunk_closure
+
+
 def _declare_decode_contracts():
     from ..programs import declare_contract
     declare_contract(
@@ -1070,6 +1869,28 @@ def _declare_decode_contracts():
                     "state; trace signatures closed over the "
                     "MX_SERVE_DECODE_PROMPT_BUCKETS admission set "
                     "(over-bucket prompts provably rejected)")
+    declare_contract(
+        "serve.paged.decode", lambda: _paged_contract_built()[0],
+        donate_argnums=(1, 2, 3, 4),
+        temp_budget_bytes=8 << 20,
+        closure=lambda: _paged_contract_built()[1],
+        description="paged decode-step slot-bucket table (ISSUE 18): "
+                    "the shared KV page heap + token/length arrays "
+                    "donate in place (flat HBM across steps, one heap "
+                    "for every session); trace signatures closed over "
+                    "every active-set size 1..slots with per-lane "
+                    "block tables")
+    declare_contract(
+        "serve.paged.prefill", lambda: _paged_contract_built()[2],
+        donate_argnums=(1, 2, 3, 4),
+        temp_budget_bytes=8 << 20,
+        closure=lambda: _paged_contract_built()[3],
+        description="paged prefill-chunk program (ISSUE 18): ONE "
+                    "signature — chunk length — serves every admitted "
+                    "prompt as a page-aligned chunk train, with the "
+                    "copy-on-write page fork folded into the same "
+                    "signature; heap donation proven, closure maps "
+                    "any prompt length to the single compiled case")
 
 
 _declare_decode_contracts()

@@ -430,6 +430,7 @@ class ServeServer:
             status["status"] = "serving"
             status["decode"] = {
                 "model": dsv.name, "version": dsv.version,
+                "engine": getattr(dsv, "engine", "flat"),
                 "slots": dsv.config.slots,
                 "active": self.decode.active_count(),
                 "queued": self.decode.queue_depth(),
@@ -439,6 +440,11 @@ class ServeServer:
                 "tokens": reg.value("serve.decode.tokens"),
                 "sequences": reg.value("serve.decode.sequences"),
             }
+            # paged engine (ISSUE 18): page-level admission headroom +
+            # prefix-sharing savings ride the same health dict
+            page_stats = self.decode.page_stats()
+            if page_stats is not None:
+                status["decode"].update(page_stats)
         if self._draining.is_set():
             # a draining replica still ANSWERS (in-flight work, probes)
             # but must advertise that it admits nothing new

@@ -1228,6 +1228,64 @@ def test_decode_pump_threads_are_discovered_roots():
     assert "thread:DecodeBatcher._harvest_loop" in displays
 
 
+def test_reinjected_host_sync_in_page_allocator_trips():
+    """ISSUE 18: the page allocator runs inside the pump's admission
+    path every tick — a device sync smuggled into ``alloc()`` (debug
+    peeking at the heap while handing out pages) stalls admission AND
+    decode, since the pump alternates both on one thread."""
+    p = os.path.join(REPO, "mxnet_tpu", "serve", "paging.py")
+    with open(p) as f:
+        code = f.read()
+    anchor = "                self._refs[page] = 1"
+    assert anchor in code, "PageAllocator.alloc moved; update this test"
+    bad = code.replace(
+        anchor,
+        anchor + "\n                _dbg = float(heap.asnumpy()[page])",
+        1)
+    diags = lint_source(bad, "mxnet_tpu/serve/paging.py")
+    assert "host-sync-in-hot-path" in rules_of(diags)
+    new, _, _ = apply_baseline(diags, load_baseline(BASELINE))
+    assert "host-sync-in-hot-path" in rules_of(new)
+
+
+def test_reinjected_host_sync_in_chunk_scheduler_trips():
+    """The chunked-prefill scheduler is a hot-path root: a blocking
+    read of the chunk's emitted token inside the pump (instead of the
+    harvester) re-serializes every interleaved generation."""
+    p = os.path.join(REPO, "mxnet_tpu", "serve", "decode.py")
+    with open(p) as f:
+        code = f.read()
+    anchor = "        self._c_chunks.inc()"
+    assert anchor in code, \
+        "PagedDecodeBatcher._dispatch_chunk_for moved; update this test"
+    bad = code.replace(
+        anchor, anchor + "\n        _dbg = float(t0.asnumpy())", 1)
+    diags = lint_source(bad, "mxnet_tpu/serve/decode.py")
+    assert "host-sync-in-hot-path" in rules_of(diags)
+    new, _, _ = apply_baseline(diags, load_baseline(BASELINE))
+    assert "host-sync-in-hot-path" in rules_of(new)
+
+
+def test_paged_engine_is_hot_path_root():
+    """Root-table regression guard for the paged engine (ISSUE 18):
+    the chunk scheduler, the page planner, the allocator and the
+    prefix-hash helpers must stay rooted so the reinjection tests
+    above keep meaning something."""
+    from tools.mxlint.rules import HOT_PATH_ROOTS
+    roots = dict(HOT_PATH_ROOTS)
+    entries = roots["mxnet_tpu/serve/decode.py"]
+    for qual in ("PagedDecodeBatcher._tick", "PagedDecodeBatcher._plan",
+                 "PagedDecodeBatcher._dispatch_chunk_for",
+                 "PagedDecodeServable.dispatch_chunk",
+                 "PagedDecodeServable.dispatch_step"):
+        assert any(qual in q for q in entries), (qual, entries)
+    assert "mxnet_tpu/serve/paging.py" in roots
+    palloc = roots["mxnet_tpu/serve/paging.py"]
+    for qual in ("PageAllocator.alloc", "PageAllocator.release",
+                 "chain_hash", "page_hashes"):
+        assert any(qual in q for q in palloc), (qual, palloc)
+
+
 def test_reinjected_wall_clock_in_kvstore_retry_trips():
     p = os.path.join(REPO, "mxnet_tpu", "kvstore", "kvstore.py")
     with open(p) as f:

@@ -1,0 +1,437 @@
+"""Paged decode-serving tests (ISSUE 18): the page allocator + prefix
+hash table, paged-vs-flat-vs-oracle token bit-identity (including CoW
+divergence and chunked admissions), admission capacity >= 4x the flat
+pool at EQUAL KV HBM (census-pinned), heap donation/flatness under the
+``kv_pages`` census owner, chunked-prefill scheduling (a 10k-token
+admission never stalls generations), page-exhaustion queueing, the
+paged program contracts, env catalog, and the threaded engine smoke.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import programs, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.engine import engine
+from mxnet_tpu.serve.decode import (DecodeBatcher, DecodeConfig,
+                                    DecodeServable, PagedDecodeBatcher,
+                                    PagedDecodeServable,
+                                    reference_generate)
+from mxnet_tpu.serve.paging import (HASH_SEED, SCRATCH_PAGE,
+                                    PageAllocator, chain_hash,
+                                    page_hashes)
+from mxnet_tpu.telemetry import registry
+
+# the flat suite's geometry + the paged knobs: pages_per_slot = 7,
+# kv_pages = 35, 4 programs to warm (3 slot buckets + 1 chunk)
+PCFG = dict(dim=16, heads=2, layers=2, slots=4, max_tokens=12,
+            prompt_buckets=(4, 8), kv_page_len=4, prefill_chunk=4)
+
+
+@pytest.fixture(scope="module")
+def paged_sv():
+    """One warmed paged servable; tests build their own (cheap) sync
+    engines on it sequentially — each engine brings a fresh allocator
+    and the chunk trains overwrite whatever the last tenant left."""
+    cfg = DecodeConfig(**PCFG)
+    return PagedDecodeServable(config=cfg), cfg
+
+
+def _sync_engine(sv, **kw):
+    return PagedDecodeBatcher(sv, autostart=False, **kw)
+
+
+def _ref(sv, cfg, prompt, n):
+    return reference_generate(prompt, n, params=sv.params, config=cfg)
+
+
+# ---------------------------------------------------------------------------
+# host-side bookkeeping: prefix hashes + the page allocator
+# ---------------------------------------------------------------------------
+
+
+def test_page_hashes_cover_whole_prefix():
+    # hashes[i] covers prompt[:(i+1)*page_len]: equality at page i
+    # implies the ENTIRE prefix matches, so chains diverge forever
+    # after the first differing page
+    a = page_hashes([1, 2, 3, 4, 5, 6, 7, 8], 4)
+    b = page_hashes([1, 2, 3, 4, 9, 6, 7, 8], 4)
+    assert len(a) == len(b) == 2
+    assert a[0] == b[0] and a[1] != b[1]
+    # same last page after different first pages must NOT collide
+    c = page_hashes([9, 2, 3, 4, 5, 6, 7, 8], 4)
+    assert c[1] != a[1]
+    # a trailing partial page is never hashed (not shareable)
+    assert len(page_hashes([1, 2, 3, 4, 5], 4)) == 1
+    assert len(page_hashes([1, 2, 3], 4)) == 0
+    assert chain_hash(HASH_SEED, [1, 2, 3, 4]) == a[0]
+
+
+def test_allocator_lifecycle():
+    al = PageAllocator(6)            # pages 1..5 usable, 0 scratch
+    assert al.free_pages() == 5
+    held = al.alloc(3)
+    assert len(held) == 3 and SCRATCH_PAGE not in held
+    assert al.free_pages() == 2
+    assert al.alloc(3) is None       # over capacity: NOTHING taken
+    assert al.free_pages() == 2
+    # publish one page, share it, then release the original holder:
+    # the extra ref keeps it live, ref 0 parks it in the LRU cache
+    assert al.publish(77, held[0])
+    assert not al.publish(77, held[1])      # first writer wins
+    assert al.lookup(77) == held[0]
+    assert al.shared_extra_refs() == 1
+    al.release(held[0])
+    assert al.shared_extra_refs() == 0
+    al.release(held[0])              # ref 0 -> cached, still adoptable
+    assert al.free_pages() == 3 and al.stats()["cached"] == 1
+    assert al.lookup(77) == held[0]  # adopted straight from the cache
+    al.release(held[0])
+    # exhaust the free list: the cached page is evicted (hash gone)
+    rest = al.alloc(3)
+    assert rest is not None and al.evictions == 1
+    assert al.lookup(77) is None
+    # double release is a bookkeeping bug, not a silent no-op
+    al2 = PageAllocator(4)
+    (p,) = al2.alloc(1)
+    al2.release(p)
+    with pytest.raises(MXNetError):
+        al2.release(p)
+    with pytest.raises(MXNetError):
+        PageAllocator(1)
+
+
+# ---------------------------------------------------------------------------
+# token bit-identity: paged == flat == oracle
+# ---------------------------------------------------------------------------
+
+
+def test_paged_matches_flat_and_oracle(paged_sv):
+    """The tentpole's correctness bar: greedy decode through the page
+    heap (chunked prefill included) is TOKEN-IDENTICAL to the flat
+    engine and the full-recompute oracle."""
+    sv, cfg = paged_sv
+    flat = DecodeBatcher(DecodeServable(config=DecodeConfig(
+        **{k: v for k, v in PCFG.items()
+           if k not in ("kv_page_len", "prefill_chunk")})),
+        autostart=False)
+    prompts = [[3, 1, 4, 1], [5, 9, 2, 6, 5, 3], [2, 7, 1, 8, 2, 8, 1, 8],
+               [1, 2], [9, 9, 9, 9, 9, 1, 1]]
+    for max_new in (1, 4, 8):
+        eng = _sync_engine(sv)
+        gens = [eng.submit(p, max_new=max_new) for p in prompts]
+        fgens = [flat.submit(p, max_new=max_new) for p in prompts]
+        eng.drain_sync()
+        flat.drain_sync()
+        for p, g, f in zip(prompts, gens, fgens):
+            ref = _ref(sv, cfg, p, max_new)
+            assert g.tokens_so_far() == ref, (p, max_new)
+            assert f.tokens_so_far() == ref, (p, max_new)
+
+
+def test_chunked_admission_identical(paged_sv):
+    """An 8-token prompt admits as TWO 4-token chunks; chunk grouping
+    must be bitwise-invisible (each prefill row attends independently
+    over the same pages)."""
+    sv, cfg = paged_sv
+    eng = _sync_engine(sv)
+    c0 = registry.value("serve.decode.prefill_chunks")
+    p = [7, 3, 2, 9, 4, 4, 1, 6]
+    g = eng.submit(p, max_new=6)
+    eng.drain_sync()
+    assert g.tokens_so_far() == _ref(sv, cfg, p, 6)
+    assert registry.value("serve.decode.prefill_chunks") - c0 == 2
+
+
+def test_cow_and_partial_share_match_oracle(paged_sv):
+    """Prefix reuse must be invisible to tokens: a full-coverage hit
+    forks CoW and replays ONE position; a partial hit prefills only
+    the divergent suffix — both still exactly match the oracle."""
+    sv, cfg = paged_sv
+    eng = _sync_engine(sv)
+    donor = [2, 7, 1, 8, 2, 8, 1, 8]           # 2 full pages, published
+    g0 = eng.submit(donor, max_new=4)
+    eng.drain_sync()
+    assert g0.tokens_so_far() == _ref(sv, cfg, donor, 4)
+    c0 = registry.value("serve.decode.prefill_chunks")
+    cow0 = registry.value("serve.decode.cow_forks")
+    sh0 = registry.value("serve.decode.shared_page_hits")
+    # full coverage -> CoW: ONE replay chunk instead of two
+    g1 = eng.submit(donor, max_new=6)
+    eng.drain_sync()
+    assert g1.tokens_so_far() == _ref(sv, cfg, donor, 6)
+    assert registry.value("serve.decode.prefill_chunks") - c0 == 1
+    assert registry.value("serve.decode.cow_forks") - cow0 == 1
+    # shared first page + divergent suffix -> one suffix chunk
+    c1 = registry.value("serve.decode.prefill_chunks")
+    fork = donor[:4] + [5, 5, 3, 1]
+    g2 = eng.submit(fork, max_new=6)
+    eng.drain_sync()
+    assert g2.tokens_so_far() == _ref(sv, cfg, fork, 6)
+    assert registry.value("serve.decode.prefill_chunks") - c1 == 1
+    assert registry.value("serve.decode.shared_page_hits") - sh0 >= 2
+    # and the donor pages were never corrupted by either adopter
+    g3 = eng.submit(donor, max_new=6)
+    eng.drain_sync()
+    assert g3.tokens_so_far() == g1.tokens_so_far()
+
+
+def test_shared_pages_survive_donor_retire(paged_sv):
+    """Published pages park in the allocator's LRU at ref 0 — a LATER
+    session still adopts them (the cross-request prefix cache), and
+    correctness holds after the reuse."""
+    sv, cfg = paged_sv
+    eng = _sync_engine(sv)
+    donor = [6, 1, 6, 1, 3, 8, 3, 8]
+    eng.submit(donor, max_new=2)
+    eng.drain_sync()                 # donor done + retired
+    st = eng.page_stats()
+    assert st["kv_cached_pages"] >= 2
+    c0 = registry.value("serve.decode.prefill_chunks")
+    g = eng.submit(donor, max_new=5)
+    eng.drain_sync()
+    assert g.tokens_so_far() == _ref(sv, cfg, donor, 5)
+    assert registry.value("serve.decode.prefill_chunks") - c0 == 1
+
+
+# ---------------------------------------------------------------------------
+# the tentpole's capacity claim: >= 4x concurrency at EQUAL KV HBM
+# ---------------------------------------------------------------------------
+
+
+def test_admission_capacity_4x_at_equal_hbm():
+    """Flat pool, slots=2: 2 concurrent sessions, period.  The paged
+    heap with the SAME pool bytes (census-pinned) runs the mixed
+    workload 6x as wide, because short sessions hold 1 page instead of
+    a worst-case flat extent."""
+    base = dict(dim=8, heads=1, layers=1, max_tokens=16,
+                prompt_buckets=(4, 64))
+    flat_sv = DecodeServable(config=DecodeConfig(slots=2, **base))
+    paged_cfg = DecodeConfig(slots=12, kv_page_len=16, kv_pages=18,
+                             **base)
+    paged_sv = PagedDecodeServable(config=paged_cfg)
+    # EQUAL KV HBM: flat (slots+1) x max_len extents == 18 pages x 16
+    flat_pool = 2 * 1 * 3 * flat_sv.config.max_len * 8 * 4
+    paged_pool = paged_sv.page_bytes() * paged_cfg.kv_pages
+    assert flat_pool == paged_pool == 18432
+    census = programs.buffer_census()
+    assert census["kv_cache"]["bytes"] >= flat_pool
+    assert census["kv_pages"]["bytes"] >= paged_pool
+    eng = PagedDecodeBatcher(paged_sv, autostart=False)
+    long_p = list(np.arange(64) % 7 + 1)
+    gens = [eng.submit(long_p, max_new=16)]
+    gens += [eng.submit([1 + i % 5, 2, 3, 4], max_new=2)
+             for i in range(11)]
+    eng.step_sync()                  # admission is one boundary
+    got = eng.active_count()
+    assert got == 12 >= 4 * flat_sv.config.slots
+    eng.drain_sync()
+    for g, p, n in zip(gens, [long_p] + [[1 + i % 5, 2, 3, 4]
+                                         for i in range(11)],
+                       [16] + [2] * 11):
+        assert g.tokens_so_far() == reference_generate(
+            p, n, params=paged_sv.params, config=paged_cfg)
+
+
+def test_page_exhaustion_queues_then_admits():
+    """When the heap is full the head-of-line request WAITS (bounded by
+    pages, not slots) and admits — correctly — once a retire frees
+    pages.  Nothing is half-allocated meanwhile."""
+    cfg = DecodeConfig(dim=8, heads=1, layers=1, slots=12,
+                       max_tokens=16, prompt_buckets=(4, 64),
+                       kv_page_len=16, kv_pages=18)
+    sv = PagedDecodeServable(config=cfg)
+    eng = PagedDecodeBatcher(sv, autostart=False)
+    long_p = list(np.arange(64) % 7 + 1)
+    eng.submit(long_p, max_new=16)               # 6 pages
+    shorts = [eng.submit([2, 2, 2, 2], max_new=2)
+              for _ in range(11)]                # 11 x 1 page = 17 total
+    eng.step_sync()
+    assert eng.active_count() == 12              # heap full
+    extra = eng.submit([3, 3, 3, 3], max_new=2)
+    eng.step_sync()
+    assert not extra.done() and eng.queue_depth() == 1
+    assert eng.page_stats()["kv_free_pages"] == 0
+    eng.drain_sync()                             # retire frees pages
+    assert extra.done()
+    assert extra.tokens_so_far() == reference_generate(
+        [3, 3, 3, 3], 2, params=sv.params, config=cfg)
+    assert all(g.done() for g in shorts)
+
+
+# ---------------------------------------------------------------------------
+# chunked prefill scheduling: long admissions never stall the pump
+# ---------------------------------------------------------------------------
+
+
+def test_10k_prefill_interleaves_with_decode():
+    """A 10240-token admission is a 20-chunk train; chunk dispatches
+    ROUND-ROBIN with other sessions' chunks and ALTERNATE with decode
+    steps, so short generations admitted alongside finish while the
+    long prefill is still in flight — and the long result is invariant
+    to the chunk size (the chunked-prefill correctness proof that
+    avoids a 10k-position monolithic oracle)."""
+    base = dict(dim=8, heads=1, layers=1, slots=4, max_tokens=8,
+                prompt_buckets=(32, 10240))
+    rs = np.random.RandomState(3)
+    long_p = list(rs.randint(1, 40, size=10240))
+    short_p = list(rs.randint(1, 40, size=32))
+
+    def run(chunk):
+        cfg = DecodeConfig(kv_page_len=64, prefill_chunk=chunk, **base)
+        eng = PagedDecodeBatcher(PagedDecodeServable(config=cfg),
+                                 autostart=False)
+        lg = eng.submit(long_p, max_new=4)
+        sg = [eng.submit(short_p, max_new=2) for _ in range(2)]
+        ticks_until_shorts = None
+        for t in range(1, 9):
+            eng.step_sync()
+            if ticks_until_shorts is None and all(g.done() for g in sg):
+                ticks_until_shorts = t
+        # shorts done within 8 ticks; the 20-chunk train is NOT
+        assert ticks_until_shorts is not None
+        assert not lg.done()
+        eng.drain_sync(max_ticks=200)
+        short_ref = reference_generate(short_p, 2,
+                                       params=eng._sv.params,
+                                       config=cfg)
+        for g in sg:
+            assert g.tokens_so_far() == short_ref
+        return lg.tokens_so_far(), short_ref
+
+    out_512 = run(512)
+    out_1024 = run(1024)
+    assert out_512 == out_1024       # chunk size never changes tokens
+
+
+# ---------------------------------------------------------------------------
+# budgets: dispatches, retraces, heap flatness, donation, contracts
+# ---------------------------------------------------------------------------
+
+
+def test_paged_dispatch_budget_and_zero_retraces(paged_sv):
+    """Every device dispatch is either one prefill chunk or one decode
+    step — nothing else — and the warmed program table answers all of
+    them (zero serve-time retraces)."""
+    sv, cfg = paged_sv
+    eng = _sync_engine(sv)
+    retr0 = sv.retraces
+    c0 = engine.snapshot()["dispatches"]
+    ch0 = registry.value("serve.decode.prefill_chunks")
+    st0 = registry.value("serve.decode.steps")
+    pre0 = registry.value("serve.decode.prefills")
+    gens = [eng.submit([2, 4, 6], max_new=5) for _ in range(4)]
+    eng.drain_sync()
+    dispatches = engine.snapshot()["dispatches"] - c0
+    chunks = registry.value("serve.decode.prefill_chunks") - ch0
+    steps = registry.value("serve.decode.steps") - st0
+    assert chunks == 4               # one-page prompts: 1 chunk each
+    assert registry.value("serve.decode.prefills") - pre0 == 4
+    assert dispatches == chunks + steps
+    assert sv.retraces == retr0
+    assert all(len(g.tokens_so_far()) == 5 for g in gens)
+
+
+def test_heap_flat_census_owner_and_donation(paged_sv):
+    """The page heap is allocated ONCE: 200 generations later the
+    ``kv_pages`` census bytes are unchanged, and every dispatch donated
+    the previous heap buffers (no double-residency)."""
+    sv, cfg = paged_sv
+    eng = _sync_engine(sv)
+    census0 = programs.buffer_census()
+    assert "kv_pages" in census0
+    assert census0["kv_pages"]["bytes"] >= sv.kv_state_bytes()
+    b0 = sv.kv_state_bytes()
+    old = dict(sv._state)
+    done = 0
+    while done < 200:
+        gens = [eng.submit([3, 1 + done % 5], max_new=3)
+                for _ in range(4)]
+        eng.drain_sync()
+        done += len(gens)
+    assert sv.kv_state_bytes() == b0
+    after = programs.buffer_census()["kv_pages"]["bytes"]
+    assert after == census0["kv_pages"]["bytes"]
+    assert sv._state["k"] is not old["k"]
+    assert old["k"].is_deleted()     # donated into the first dispatch
+    assert old["len"].is_deleted()
+
+
+def test_dispatch_count_paged_budget():
+    """The CLI harness (tools/dispatch_count.py --serve --decode) pins
+    the same arithmetic: chunks are counted as steps, at most one
+    dispatch per pump tick, zero retraces."""
+    import tools.dispatch_count as dc
+    report = dc.run_paged_decode(n_gens=4, prompt_len=8, max_new=4,
+                                 slots=4)
+    assert report["ok"], report
+    assert report["max_dispatches_per_tick"] <= 1
+    assert report["prefill_chunk_dispatches"] == 8
+    assert report["dispatches"] == (report["prefill_chunk_dispatches"]
+                                    + report["decode_steps"])
+
+
+def test_paged_contracts_declared():
+    names = {c.name for c in programs.contracts()}
+    assert "serve.paged.decode" in names
+    assert "serve.paged.prefill" in names
+    by_name = {c.name: c for c in programs.contracts()}
+    assert by_name["serve.paged.decode"].donate_argnums == (1, 2, 3, 4)
+    assert by_name["serve.paged.prefill"].donate_argnums == (1, 2, 3, 4)
+
+
+def test_paged_env_catalog():
+    from mxnet_tpu.base import ENV_CATALOG
+    for name in ("MX_SERVE_KV_PAGES", "MX_SERVE_KV_PAGE_LEN",
+                 "MX_SERVE_PREFIX_SHARE", "MX_SERVE_PREFILL_CHUNK"):
+        assert name in ENV_CATALOG, name
+        default, doc = ENV_CATALOG[name]
+        assert default is not None and doc
+
+
+def test_paged_engine_surface(paged_sv):
+    """The health/fleet projection: engine discriminator, page stats,
+    and the headroom gauges the router and fleet_top consume."""
+    sv, cfg = paged_sv
+    eng = _sync_engine(sv)
+    assert sv.engine == "paged" and sv.census_owner == "kv_pages"
+    st = eng.page_stats()
+    assert st["engine"] == "paged"
+    assert st["kv_pages"] == cfg.kv_pages
+    assert st["prefill_chunk"] == cfg.prefill_chunk
+    eng.submit([5, 5], max_new=2)
+    eng.drain_sync()
+    assert registry.find("serve.decode.kv_free_pages") is not None
+    assert registry.value("serve.decode.kv_free_pages") \
+        == eng.page_stats()["kv_free_pages"]
+    # the flat engine must NOT grow page stats
+    assert super(PagedDecodeBatcher, eng).page_stats() is None
+    with pytest.raises(MXNetError):
+        PagedDecodeBatcher(sv, mode="request", autostart=False)
+    with pytest.raises(MXNetError):
+        sv.prefill_program(8)
+    with pytest.raises(MXNetError):
+        sv.dispatch_prefill(0, np.zeros(4, np.int32), 2)
+
+
+def test_threaded_paged_smoke(paged_sv):
+    """The real (pump + harvester) threads over the paged engine: a
+    burst of mixed + shared-prefix generations all complete correctly
+    and the engine closes clean."""
+    sv, cfg = paged_sv
+    eng = PagedDecodeBatcher(sv)
+    try:
+        prompts = [[5, 6, 7], [2, 2], [9, 1, 3, 8], [9, 1, 3, 8]]
+        news = (8, 2, 5, 5)
+        refs = [_ref(sv, cfg, p, n) for p, n in zip(prompts, news)]
+        gens = [eng.submit(p, max_new=n)
+                for p, n in zip(prompts, news)]
+        gens += [eng.submit(prompts[0], max_new=8) for _ in range(5)]
+        outs = [g.result(timeout=60) for g in gens]
+        assert outs[:4] == refs
+        assert all(o == refs[0] for o in outs[4:])
+    finally:
+        eng.close()
+    eng.close()
+    assert not eng._pump.is_alive() and not eng._harvester.is_alive()

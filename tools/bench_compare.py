@@ -236,6 +236,26 @@ def extract_record(report: dict) -> dict:
         rec["decode_kv_pool_flat"] = bool(dec.get("kv_pool_flat"))
         rec["decode_zero_retraces"] = bool(
             dec.get("zero_serve_time_retraces"))
+        # ISSUE 18: paged-KV gated series — parity/flat-heap/zero-
+        # retrace are invariants, the shared-prefix first-token drop
+        # (>= 5x) and equal-HBM admission width (>= 4x) are ABSOLUTE
+        # acceptances, not trajectories
+        paged = dec.get("paged") or {}
+        if paged:
+            rec["decode_paged_parity_ok"] = bool(
+                paged.get("parity_with_flat"))
+            rec["decode_paged_kv_flat"] = bool(paged.get("kv_pool_flat"))
+            rec["decode_paged_zero_retraces"] = bool(
+                paged.get("zero_retraces"))
+        sp = dec.get("shared_prefix") or {}
+        if sp:
+            rec["decode_shared_prefix_speedup"] = \
+                sp.get("first_token_speedup")
+            rec["decode_shared_prefix_ok"] = bool(sp.get("speedup_ok"))
+        adm = dec.get("admission") or {}
+        if adm:
+            rec["decode_admission_ratio"] = adm.get("capacity_ratio")
+            rec["decode_admission_ok"] = bool(adm.get("ok"))
     # ISSUE 17: routed-lane gated series — the session router's
     # forwarding tax is an ABSOLUTE acceptance (routed p50 AND p99
     # within 10% of direct-to-replica, or the ADDED latency under the
@@ -371,6 +391,46 @@ def gate(rec, history, throughput_tol, memory_tol):
             findings.append(
                 "DECODE RETRACE REGRESSION: serve-time retraces "
                 "after warmup (the bucket tables must be closed)")
+    # ISSUE 18 gated series: the paged-KV engine's acceptance invariants
+    if "decode_paged_parity_ok" in rec:
+        if not rec.get("decode_paged_parity_ok"):
+            ok = False
+            findings.append(
+                "PAGED DECODE PARITY BROKEN: paged tokens diverged "
+                "from the flat continuous lane on the same workload")
+        if not rec.get("decode_paged_kv_flat"):
+            ok = False
+            findings.append(
+                "PAGED KV-HEAP LEAK: page-heap bytes grew across the "
+                "bench run (heap donation broke — HBM would creep)")
+        if not rec.get("decode_paged_zero_retraces"):
+            ok = False
+            findings.append(
+                "PAGED RETRACE REGRESSION: serve-time retraces after "
+                "warmup (the chunk/step program tables must be closed)")
+    if "decode_shared_prefix_speedup" in rec:
+        if not rec.get("decode_shared_prefix_ok"):
+            ok = False
+            findings.append(
+                "SHARED-PREFIX REGRESSION: repeat first-token speedup "
+                "%s < the 5x acceptance floor (or tokens diverged)"
+                % rec.get("decode_shared_prefix_speedup"))
+        else:
+            findings.append(
+                "shared-prefix first-token speedup %sx >= 5x"
+                % rec.get("decode_shared_prefix_speedup"))
+    if "decode_admission_ratio" in rec:
+        if not rec.get("decode_admission_ok"):
+            ok = False
+            findings.append(
+                "PAGED ADMISSION REGRESSION: equal-HBM concurrent "
+                "sessions %sx < the 4x acceptance floor (or pools "
+                "were not byte-identical)"
+                % rec.get("decode_admission_ratio"))
+        else:
+            findings.append(
+                "paged admission %sx wider than flat at equal KV HBM"
+                % rec.get("decode_admission_ratio"))
     # ISSUE 17 gated series: the session router's forwarding tax
     if "routed_within_gate" in rec:
         if not rec["routed_within_gate"]:

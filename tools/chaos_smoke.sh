@@ -296,15 +296,18 @@ grep -q 'SERVE_LOAD_OK' "$WORK/serve_load.log" || {
 }
 echo "chaos_smoke: serving chaos PASS (failover + restart, zero lost)"
 
-echo "== chaos_smoke: decode serving - kill a replica mid-generation (ISSUE 15)"
-# two supervised DECODE replicas (GENERATE verb, continuous batching,
-# device-resident KV pool); the serve.request fault kills a replica
-# mid-load, in-flight generations fail over and RE-PREFILL on the
-# survivor, completed sequences replay from the exactly-once cache.
-# The driver verifies every sequence against a local reference decode
-# of the same seeded demo LM — deterministic greedy decode means a
-# re-prefilled generation must reproduce its tokens EXACTLY, so
-# correctness (not just arrival) survives the crash.
+echo "== chaos_smoke: decode serving - kill a replica mid-generation (ISSUE 15/18)"
+# two supervised PAGED decode replicas (GENERATE verb, continuous
+# batching, shared page heap + hash-shared prefixes + chunked
+# prefill); the serve.request fault kills a replica mid-load under the
+# shared-prefix workload, in-flight generations fail over and
+# RE-PREFILL on the survivor — as chunk trains, against the survivor's
+# OWN hash table — and completed sequences replay from the
+# exactly-once cache.  The driver verifies every sequence against a
+# local reference decode of the same seeded demo LM — deterministic
+# greedy decode means a re-prefilled generation must reproduce its
+# tokens EXACTLY, so correctness (not just arrival) survives the crash
+# whether the survivor answered from a CoW fork or a cold chunk train.
 DECODE_BASE=$("$PY" - <<'EOF'
 import socket
 while True:
@@ -322,6 +325,8 @@ rc=0
 # crash lands mid-load, and end-of-load per-replica counters stay well
 # below the NEXT trip point so the driver's closing health probes and
 # STOPs cannot themselves crash a replica into the assertion window
+MX_SERVE_KV_PAGES=64 MX_SERVE_KV_PAGE_LEN=16 \
+MX_SERVE_PREFIX_SHARE=1 MX_SERVE_PREFILL_CHUNK=16 \
 PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}" \
 "$PY" "$REPO/tools/launch.py" -n 2 --launcher local \
     --restart on-failure --max-restarts 3 --hang-timeout 60 \
@@ -331,7 +336,7 @@ PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}" \
 DECODE_LAUNCH_PID=$!
 "$PY" "$REPO/tools/serve_load.py" \
     --addrs "127.0.0.1:$DECODE_BASE,127.0.0.1:$((DECODE_BASE+1))" \
-    --decode --requests 80 --chaos --stop 2>&1 \
+    --decode --requests 80 --shared-prefix 3 --chaos --stop 2>&1 \
     | tee "$WORK/decode_load.log" || rc=$?
 if [ "$rc" -ne 0 ]; then
     echo "chaos_smoke: FAIL - decode load driver exited $rc" >&2
@@ -353,7 +358,12 @@ grep -q 'SERVE_LOAD_OK' "$WORK/decode_load.log" || {
     echo "chaos_smoke: FAIL - decode load driver never reported OK" >&2
     exit 1
 }
-echo "chaos_smoke: decode chaos PASS (failover + re-prefill, sequences exact)"
+grep -q 'paged: 64 pages' "$WORK/decode.log" || {
+    echo "chaos_smoke: FAIL - decode replicas did not come up PAGED" >&2
+    exit 1
+}
+echo "chaos_smoke: decode chaos PASS (paged failover + chunked" \
+     "re-prefill under shared prefixes, sequences exact)"
 
 echo "== chaos_smoke: session router - kill a replica UNDER the router (ISSUE 17)"
 # the fleet front-tier: one router address fronting two supervised

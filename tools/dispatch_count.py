@@ -44,6 +44,11 @@ SERVE_BATCH_BUDGET = 1
 # prefill = one dispatch per admitted sequence, and after warmup every
 # launch hits a pre-built bucket program (0 serve-time retraces)
 DECODE_STEP_BUDGET = 1
+# ISSUE 18: the paged engine keeps the same envelope with chunked
+# prefill — every pump tick issues AT MOST one device program (a
+# prefill chunk OR a decode step, never both), every dispatch is
+# accounted as exactly one of the two, and retraces stay zero
+PAGED_TICK_BUDGET = 1
 
 
 def run_exchange(n_keys=40):
@@ -273,6 +278,71 @@ def run_decode(n_gens=6, prompt_len=3, max_new=5, slots=8):
     }
 
 
+def run_paged_decode(n_gens=6, prompt_len=8, max_new=5, slots=8):
+    """ISSUE 18 acceptance: the paged engine's dispatch arithmetic,
+    driven tick by tick.  Each admitted prompt prefills as a train of
+    page-aligned chunks (``prompt_len / prefill_chunk`` dispatches; the
+    last chunk emits token 1), chunks interleave with decode steps at
+    AT MOST one device program per pump tick, every dispatch is
+    accounted as a chunk or a step, and serve time pays ZERO retraces
+    after the deploy-time warm."""
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.engine import engine
+    from mxnet_tpu.serve.decode import (DecodeConfig, PagedDecodeBatcher,
+                                        PagedDecodeServable)
+
+    assert n_gens <= slots, "budget plan needs one admission boundary"
+    chunk = 4
+    cfg = DecodeConfig(slots=slots, max_tokens=max(8, max_new),
+                       prompt_buckets=(4, 8), kv_page_len=4,
+                       prefill_chunk=chunk)
+    sv = PagedDecodeServable(config=cfg)
+    eng = PagedDecodeBatcher(sv, autostart=False)    # warm() paid here
+    reg = telemetry.registry
+    retraces0 = sv.retraces
+    pre0 = reg.value("serve.decode.prefills")
+    ch0 = reg.value("serve.decode.prefill_chunks")
+    steps0 = reg.value("serve.decode.steps")
+    c0 = engine.snapshot()["dispatches"]
+    # distinct first pages -> no prefix sharing; the chunk plan is
+    # exact arithmetic, not a cache race
+    gens = [eng.submit([(i + j) % 7 + 1 for j in range(prompt_len)],
+                       max_new=max_new) for i in range(n_gens)]
+    max_per_tick = 0
+    busy, ticks = True, 0
+    while busy and ticks < 10000:
+        t0 = engine.snapshot()["dispatches"]
+        busy = eng.step_sync()
+        max_per_tick = max(max_per_tick,
+                           engine.snapshot()["dispatches"] - t0)
+        ticks += 1
+    dispatches = engine.snapshot()["dispatches"] - c0
+    prefills = reg.value("serve.decode.prefills") - pre0
+    chunks = reg.value("serve.decode.prefill_chunks") - ch0
+    steps = reg.value("serve.decode.steps") - steps0
+    want_chunks = n_gens * (-(-prompt_len // chunk))
+    done = all(len(g.tokens_so_far()) == max_new and g.done()
+               for g in gens)
+    return {
+        "generations": n_gens,
+        "tokens": sum(len(g.tokens_so_far()) for g in gens),
+        "prefill_chunk_dispatches": chunks,
+        "expected_chunks": want_chunks,
+        "prefill_trains": prefills,
+        "decode_steps": steps,
+        "dispatches": dispatches,
+        "max_dispatches_per_tick": max_per_tick,
+        "tick_budget": PAGED_TICK_BUDGET,
+        "retraces": sv.retraces - retraces0,
+        "ok": bool(done
+                   and chunks == want_chunks
+                   and prefills == n_gens
+                   and dispatches == chunks + steps
+                   and max_per_tick <= PAGED_TICK_BUDGET
+                   and sv.retraces == retraces0),
+    }
+
+
 def run_routed(n_requests=24, rows_per_request=2, max_batch=8):
     """ISSUE 17 acceptance: the session router is a PURE host-side
     forwarder — the same PREDICT burst driven through it costs exactly
@@ -439,9 +509,12 @@ def main():
                          "bucket-table hits, 0 serve-time retraces")
     ap.add_argument("--decode", action="store_true",
                     help="with --serve: also pin the ISSUE 15 decode "
-                         "budget: exactly 1 dispatch per decode step "
+                         "budget (exactly 1 dispatch per decode step "
                          "regardless of active-sequence count, 1 per "
-                         "prefill, 0 serve-time retraces after warmup")
+                         "prefill, 0 serve-time retraces after warmup) "
+                         "AND the ISSUE 18 paged budget (chunked "
+                         "prefill = at most 1 dispatch per pump tick, "
+                         "chunks counted as steps, 0 retraces)")
     ap.add_argument("--routed", action="store_true",
                     help="with --serve: also pin the ISSUE 17 router "
                          "budget: the same burst through the session "
@@ -487,6 +560,9 @@ def main():
     if args.decode:
         report["decode"] = run_decode()
         report["ok"] = bool(report["ok"] and report["decode"]["ok"])
+        report["paged_decode"] = run_paged_decode()
+        report["ok"] = bool(report["ok"]
+                            and report["paged_decode"]["ok"])
     if args.routed:
         report["routed"] = run_routed()
         report["ok"] = bool(report["ok"] and report["routed"]["ok"])

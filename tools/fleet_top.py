@@ -71,6 +71,14 @@ def _member_row(mid, meta, snap):
             head = gval("serve.decode.kv_headroom_bytes")
             if head is not None:
                 flags_extra.append("kv_free=%s" % _fmt(head, "%.3g"))
+            # paged replicas (ISSUE 18): page-level headroom + what
+            # prefix sharing is saving right now
+            pages = gval("serve.decode.kv_free_pages")
+            if pages is not None:
+                flags_extra.append("pages=%s" % _fmt(pages, "%g"))
+            saved = gval("serve.decode.kv_shared_saved_bytes")
+            if saved:
+                flags_extra.append("shared=%s" % _fmt(saved, "%.3g"))
         else:
             flags_extra = []
     elif role == "router":

@@ -84,7 +84,32 @@ HOT_PATH_ROOTS: List[Tuple[str, List[str]]] = [
       "DecodeBatcher._dispatch_prefill", "DecodeBatcher._hq_put",
       "DecodeBatcher.submit", "DecodeServable.dispatch_step",
       "DecodeServable.dispatch_prefill", "DecodeServable.step_program",
-      "DecodeServable.prefill_program"]),
+      "DecodeServable.prefill_program",
+      # the paged engine (ISSUE 18): admission planning (hash lookups,
+      # page allocation, chunk layout) and the chunk scheduler run
+      # between dequeue and dispatch every tick — pure host
+      # bookkeeping by contract, same no-sync rule
+      "PagedDecodeBatcher._tick", "PagedDecodeBatcher._retire",
+      "PagedDecodeBatcher._admit", "PagedDecodeBatcher._plan",
+      "PagedDecodeBatcher._active",
+      "PagedDecodeBatcher._next_chunk_slot",
+      "PagedDecodeBatcher._dispatch_chunk_for",
+      "PagedDecodeBatcher._step",
+      "PagedDecodeServable.dispatch_step",
+      "PagedDecodeServable.dispatch_chunk",
+      "PagedDecodeServable.step_program",
+      "PagedDecodeServable.chunk_program"]),
+    # the paged KV allocator + prefix hash table (ISSUE 18) sit inside
+    # the pump's admission path — every method is per-tick bookkeeping
+    # (free lists, refcounts, rolling hashes over host ints) and must
+    # never touch the device or block.  The tests/test_mxlint.py
+    # reinjection test proves a host sync smuggled into alloc() trips
+    # this entry.
+    ("mxnet_tpu/serve/paging.py",
+     ["PageAllocator.alloc", "PageAllocator.lookup",
+      "PageAllocator.publish", "PageAllocator.release",
+      "PageAllocator.free_pages", "PageAllocator.shared_extra_refs",
+      "chain_hash", "page_hashes"]),
     # the program census (ISSUE 10) wraps EVERY jit dispatch: its call
     # path and record helpers are dispatch-time bookkeeping by contract
     # (shape/aval reads only — never a device sync), and the buffer

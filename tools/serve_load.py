@@ -40,6 +40,16 @@ deterministic demo LM (``serve.decode.reference_generate``), so a
 failover that re-prefills on the survivor must reproduce the sequence
 EXACTLY — completed sequences are never lost, replayed at most once,
 and never silently wrong.
+
+``--shared-prefix K`` (with ``--decode``, ISSUE 18) reshapes the load
+into the paged engine's headline workload: the N sessions cycle over K
+distinct full-bucket prompts, so a prefix-sharing replica answers every
+repeat from its hash table (CoW fork + one replay chunk) instead of
+re-prefilling.  Each request STREAMS (on_token) and the time to the
+FIRST token is recorded per lane — ``cold`` (first sight of a prompt)
+vs ``shared`` (repeats) — reported as p50/p99 ms.  Token verification
+against the local oracle is unchanged: sharing must be invisible to
+correctness, whichever engine is behind the socket.
 """
 import argparse
 import json
@@ -86,6 +96,11 @@ def main():
     ap.add_argument("--max-tokens", type=int, default=12,
                     help="--decode: generated tokens per request "
                          "(short/long mix alternates 2 and this)")
+    ap.add_argument("--shared-prefix", type=int, default=0, metavar="K",
+                    help="--decode: cycle the sessions over K distinct "
+                         "full-bucket prompts (the prefix-reuse "
+                         "workload, ISSUE 18) and report first-token "
+                         "p50/p99 ms per lane (cold vs shared)")
     ap.add_argument("--routed", action="store_true",
                     help="--addrs is the session router's address: "
                          "chaos assertions move to the fleet tier "
@@ -125,7 +140,49 @@ def main():
             time.sleep(float(rng.exponential(1.0 / args.poisson)))
 
     ok, t0 = 0, time.perf_counter()
-    if args.decode:
+    first_token_ms = None
+    if args.decode and args.shared_prefix:
+        # the prefix-reuse workload: N sessions over K full-bucket
+        # prompts, first-token latency split cold (first sight) vs
+        # shared (repeats a paged replica answers from its hash table)
+        from mxnet_tpu.serve.decode import (DecodeConfig,
+                                            demo_lm_params,
+                                            reference_generate)
+        cfg = DecodeConfig()
+        params = demo_lm_params(cfg)
+        plen = cfg.prompt_buckets[-1]
+        max_new = min(args.max_tokens, cfg.max_tokens)
+        bases = [[int(t) for t in rng.randint(2, cfg.vocab, size=plen)]
+                 for _ in range(max(1, args.shared_prefix))]
+        expect = [reference_generate(p, max_new, params=params,
+                                     config=cfg) for p in bases]
+        lanes = {"cold": [], "shared": []}
+        seen = set()
+        for i in range(args.requests):
+            k = i % len(bases)
+            lane = "shared" if k in seen else "cold"
+            seen.add(k)
+            stamp = {}
+
+            def first_token(_chunk, _stamp=stamp):
+                _stamp.setdefault("t", time.perf_counter())
+
+            pace()
+            t_req = time.perf_counter()
+            version, toks = cli.generate(bases[k], max_tokens=max_new,
+                                         on_token=first_token)
+            assert toks == expect[k], \
+                ("request %d (decode v%d, prompt %d) answered WRONG "
+                 "tokens: %r != %r" % (i, version, k, toks, expect[k]))
+            lanes[lane].append(
+                (stamp.get("t", time.perf_counter()) - t_req) * 1000.0)
+            ok += 1
+        first_token_ms = {
+            lane: {"p50": round(float(np.percentile(v, 50)), 3),
+                   "p99": round(float(np.percentile(v, 99)), 3),
+                   "n": len(v)}
+            for lane, v in lanes.items() if v}
+    elif args.decode:
         # local truth: the reference greedy decode of the same seeded
         # demo LM — a replica (or a failover re-prefill on the
         # survivor) must answer these tokens EXACTLY
@@ -220,7 +277,7 @@ def main():
     if args.stop:
         cli.stop()
     cli.close()
-    print(json.dumps({
+    report = {
         "requests": args.requests,
         "mode": "decode" if args.decode else "predict",
         "routed": bool(args.routed),
@@ -228,7 +285,11 @@ def main():
         "failovers": failovers,
         "requests_per_sec": round(ok / wall, 2),
         "replica_pids": restarted,
-    }))
+    }
+    if first_token_ms is not None:
+        report["shared_prefix_prompts"] = args.shared_prefix
+        report["first_token_ms"] = first_token_ms
+    print(json.dumps(report))
     print("SERVE_LOAD_OK")
     return 0
 
