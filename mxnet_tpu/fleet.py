@@ -66,6 +66,7 @@ from .base import MXNetError, get_env
 from .kvstore.server import send_msg, recv_msg
 from .kvstore.wire_codec import decode_json, decode_text, encode_json, \
     encode_text
+from .kvstore.wire_verbs import declare_verbs
 
 __all__ = [
     "SCHEMA", "FleetMergeError", "FleetMember", "FleetCollector",
@@ -84,14 +85,16 @@ SCHEMA = 2
 # here, checks this file handles it, and that named codecs have
 # encode_*/decode_* pairs in kvstore/wire_codec.py.  Read-only by
 # construction — the collector never mutates a member.
-WIRE_VERBS = {
+WIRE_VERBS = declare_verbs("fleet", {
     # merged fleet snapshot as one typed JSN payload: THE api the
     # coming serve router/autoscaler (ROADMAP item 3) call
-    "FLEET": {"semantics": "idempotent", "codec": "json"},
+    "FLEET": {"semantics": "idempotent", "replay": "bypass",
+              "codec": "json", "mutates": ()},
     # whole-fleet federation exposition (or the collector's own
     # registry as json) — same contract as the serve/kvstore scrape
-    "METRICS": {"semantics": "idempotent", "codec": "text"},
-}
+    "METRICS": {"semantics": "idempotent", "replay": "bypass",
+                "codec": "text", "mutates": ()},
+}, role="collector", handler="serve_fleet.Handler.handle")
 
 
 class FleetMergeError(MXNetError):

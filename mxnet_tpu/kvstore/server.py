@@ -72,6 +72,8 @@ import numpy as _np
 
 from .. import fault as _fault
 from ..base import get_env
+from .wire_codec import WireCodecError
+from .wire_verbs import declare_verbs
 
 __all__ = ["KVStoreServer", "serve_forever", "send_msg", "recv_msg"]
 
@@ -159,32 +161,52 @@ def _rank_of(client_id) -> str:
 # and 'idempotent' ones do not, and that a named codec has an
 # encode_<name>/decode_<name> pair in kvstore/wire_codec.py.  Adding a
 # client verb without completing this row fails lint — half-wired
-# protocols cannot ship.
-WIRE_VERBS = {
+# protocols cannot ship.  The replay/mutates fields are the altitude-4
+# protocol contract (ISSUE 19): tools/mxlint/protocol.py diffs them
+# against the handler bodies and model-checks the declared semantics
+# under bounded fault schedules.
+WIRE_VERBS = declare_verbs("kvstore", {
     # mutating commands replay from the SEQ cache after a lost reply
-    "INIT": {"semantics": "replayable", "codec": None},
-    "PUSH": {"semantics": "replayable", "codec": "wire"},
-    "SET_OPT": {"semantics": "replayable", "codec": None},
+    "INIT": {"semantics": "replayable", "replay": "cached",
+             "codec": None, "mutates": ("kv",)},
+    "PUSH": {"semantics": "replayable", "replay": "cached",
+             "codec": "wire", "mutates": ("kv", "optimizer")},
+    "SET_OPT": {"semantics": "replayable", "replay": "cached",
+                "codec": None, "mutates": ("optimizer",)},
     # re-executing these on a retried envelope is harmless by design
-    "PULL": {"semantics": "idempotent", "codec": None},
+    "PULL": {"semantics": "idempotent", "replay": "bypass",
+             "codec": None, "mutates": ()},
     # quantized pull (ISSUE 16): the hierarchical exchange's cross-slice
     # return leg — same read-only contract as PULL, ~4x fewer wire bytes
-    "PULLQ": {"semantics": "idempotent", "codec": "wire"},
-    "BARRIER": {"semantics": "idempotent", "codec": None},
-    "PING": {"semantics": "idempotent", "codec": None},
+    "PULLQ": {"semantics": "idempotent", "replay": "bypass",
+              "codec": "wire", "mutates": ()},
+    # barrier release may also evict provably-departed members (an
+    # involuntary LEAVE), hence membership+epoch in its effect set
+    "BARRIER": {"semantics": "idempotent", "replay": "cached",
+                "codec": None,
+                "mutates": ("barrier", "membership", "epoch")},
+    "PING": {"semantics": "idempotent", "replay": "bypass",
+             "codec": None, "mutates": ()},
     # elastic membership (ISSUE 16): JOIN of a present rank and LEAVE of
     # an absent rank are designed no-ops (no epoch bump), so a retried
     # envelope re-executes harmlessly — idempotent by construction
-    "JOIN": {"semantics": "idempotent", "codec": None},
-    "LEAVE": {"semantics": "idempotent", "codec": None},
-    "MEMBERS": {"semantics": "idempotent", "codec": None},
+    "JOIN": {"semantics": "idempotent", "replay": "cached",
+             "codec": None, "mutates": ("membership", "epoch")},
+    "LEAVE": {"semantics": "idempotent", "replay": "cached",
+              "codec": None, "mutates": ("membership", "epoch")},
+    "MEMBERS": {"semantics": "idempotent", "replay": "bypass",
+                "codec": None, "mutates": ()},
     # read-only telemetry scrape (ISSUE 12): the fleet collector reads
     # a PS's live instrument registry over the same wire the workers
     # use — no sidecar, no extra port.  telemetry.py imports no jax, so
     # the numpy-only server process can afford it on every scrape.
-    "METRICS": {"semantics": "idempotent", "codec": "text"},
-    "STOP": {"semantics": "idempotent", "codec": None},
-}
+    "METRICS": {"semantics": "idempotent", "replay": "bypass",
+                "codec": "text", "mutates": ()},
+    # rides the cache (the bypass tuple is read-only verbs), burns no
+    # state: serve_forever owns the actual drain+exit
+    "STOP": {"semantics": "idempotent", "replay": "cached",
+             "codec": None, "mutates": ()},
+}, role="server", durable=True, handler="KVStoreServer.handle")
 
 
 class KVStoreServer:
@@ -854,7 +876,11 @@ def serve_forever(port=None, num_workers=None, ready_file=None,
                     ok, payload = server_state.handle_request(msg)
                 except SystemExit:          # injected crash: die mid-request
                     os._exit(17)
-                except _fault.FaultError as e:
+                except (_fault.FaultError, WireCodecError) as e:
+                    # a malformed wire frame is the CLIENT's fault: the
+                    # decoder raised before any state was touched, so
+                    # answer with a typed refusal on the same connection
+                    # instead of severing it with a traceback
                     ok, payload = False, str(e)
                 finally:
                     with inflight_lock:

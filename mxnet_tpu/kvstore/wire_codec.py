@@ -27,7 +27,8 @@ from __future__ import annotations
 
 import numpy as _np
 
-__all__ = ["is_wire_payload", "encode_wire", "decode_wire",
+__all__ = ["WireCodecError",
+           "is_wire_payload", "encode_wire", "decode_wire",
            "pack_2bit", "unpack_2bit", "quantize_int8_np",
            "is_array_payload", "encode_array", "decode_array",
            "is_text_payload", "encode_text", "decode_text",
@@ -35,6 +36,47 @@ __all__ = ["is_wire_payload", "encode_wire", "decode_wire",
 
 _WIRE_TAG = "QGRAD"
 _ARR_TAG = "NPX"
+
+
+class WireCodecError(ValueError):
+    """A wire payload failed structural validation while decoding.
+
+    Every ``decode_*`` in this module raises this — and only this — on
+    a malformed payload (wrong tag, truncated bytes, inconsistent
+    shape/dtype/length, undecodable utf-8/json): the decode either
+    returns a fully-built value or raises cleanly BEFORE any caller
+    state is touched, so a corrupt frame can never partially apply.
+    Subclasses ``ValueError`` so pre-existing ``except ValueError``
+    call sites keep working."""
+
+
+def _codec_fail(what, detail):
+    raise WireCodecError("%s: %s" % (what, detail))
+
+
+def _expect_bytes(what, raw):
+    if not isinstance(raw, (bytes, bytearray)):
+        _codec_fail(what, "payload bytes field is %s, not bytes"
+                    % type(raw).__name__)
+    return bytes(raw)
+
+
+def _expect_shape(what, shape):
+    if not (isinstance(shape, tuple) and
+            all(isinstance(s, int) and s >= 0 for s in shape)):
+        _codec_fail(what, "shape field %r is not a tuple of "
+                    "non-negative ints" % (shape,))
+    n = 1
+    for s in shape:
+        n *= s
+    return n
+
+
+def _expect_dtype(what, dtype):
+    try:
+        return _np.dtype(dtype)
+    except (TypeError, ValueError) as e:
+        _codec_fail(what, "bad dtype %r (%s)" % (dtype, e))
 
 
 def is_array_payload(obj) -> bool:
@@ -56,12 +98,22 @@ def encode_array(arr) -> tuple:
 
 
 def decode_array(obj) -> _np.ndarray:
-    """Inverse of :func:`encode_array`; returns a writable ndarray."""
+    """Inverse of :func:`encode_array`; returns a writable ndarray.
+
+    Raises :class:`WireCodecError` on any malformed payload (wrong
+    tag, truncated/overlong bytes, bad shape or dtype) — never a bare
+    numpy exception, never a partially-built array."""
     if not is_array_payload(obj):
-        raise ValueError("not an NPX array payload: %r" % (type(obj),))
+        raise WireCodecError("not an NPX array payload: %r"
+                             % (type(obj),))
     _, shape, dtype, raw = obj
-    return _np.frombuffer(raw, dtype=_np.dtype(dtype)).reshape(
-        shape).copy()
+    n = _expect_shape("NPX", shape)
+    dt = _expect_dtype("NPX", dtype)
+    raw = _expect_bytes("NPX", raw)
+    if len(raw) != n * dt.itemsize:
+        _codec_fail("NPX", "payload is %d bytes but shape %r of %s "
+                    "needs %d" % (len(raw), shape, dt, n * dt.itemsize))
+    return _np.frombuffer(raw, dtype=dt).reshape(shape).copy()
 
 
 _TXT_TAG = "TXT"
@@ -80,9 +132,16 @@ def encode_text(text: str) -> tuple:
 
 
 def decode_text(obj) -> str:
+    """Raises :class:`WireCodecError` on a non-TXT tuple or bytes that
+    are not valid utf-8 (a bit-flipped frame must fail typed, not leak
+    a UnicodeDecodeError into the handler)."""
     if not is_text_payload(obj):
-        raise ValueError("not a TXT payload: %r" % (type(obj),))
-    return obj[1].decode("utf-8")
+        raise WireCodecError("not a TXT payload: %r" % (type(obj),))
+    raw = _expect_bytes("TXT", obj[1])
+    try:
+        return raw.decode("utf-8")
+    except UnicodeDecodeError as e:
+        _codec_fail("TXT", "payload is not valid utf-8 (%s)" % (e,))
 
 
 _JSN_TAG = "JSN"
@@ -103,10 +162,16 @@ def encode_json(obj) -> tuple:
 
 
 def decode_json(obj):
+    """Raises :class:`WireCodecError` on a non-JSN tuple, non-utf-8
+    bytes, or bytes that do not parse as one JSON document."""
     if not is_json_payload(obj):
-        raise ValueError("not a JSN payload: %r" % (type(obj),))
+        raise WireCodecError("not a JSN payload: %r" % (type(obj),))
     import json as _json
-    return _json.loads(obj[1].decode("utf-8"))
+    raw = _expect_bytes("JSN", obj[1])
+    try:
+        return _json.loads(raw.decode("utf-8"))
+    except (UnicodeDecodeError, ValueError) as e:
+        _codec_fail("JSN", "payload does not parse as JSON (%s)" % (e,))
 
 
 def is_wire_payload(obj) -> bool:
@@ -138,20 +203,56 @@ def encode_wire(mode: str, shape, dtype, payload) -> tuple:
 
 def decode_wire(obj) -> _np.ndarray:
     """Dequantize a wire tuple back to a full-width numpy array (server
-    side, before the updater / accumulator sees it)."""
+    side, before the updater / accumulator sees it).
+
+    Raises :class:`WireCodecError` on any malformed tuple — wrong tag,
+    short tuple, shape/count mismatch, truncated quantized bytes,
+    block/scale inconsistency — so a corrupt PUSH frame fails BEFORE
+    the optimizer or accumulator sees a garbage gradient."""
     if not is_wire_payload(obj):
-        raise ValueError("not a QGRAD wire payload: %r" % (type(obj),))
+        raise WireCodecError("not a QGRAD wire payload: %r"
+                             % (type(obj),))
+    if len(obj) != 7:
+        _codec_fail("QGRAD", "tuple has %d fields, expected 7"
+                    % len(obj))
     _, mode, shape, dtype, n = obj[:5]
+    n_shape = _expect_shape("QGRAD", shape)
+    dt = _expect_dtype("QGRAD", dtype)
+    if not isinstance(n, int) or n != n_shape:
+        _codec_fail("QGRAD", "element count %r does not match shape %r "
+                    "(%d elements)" % (n, shape, n_shape))
     if mode == "int8":
-        q = _np.frombuffer(obj[5], dtype=_np.int8).astype(_np.float32)
-        scales = _np.asarray(obj[6], _np.float32)
-        block = q.size // max(1, scales.size)
+        raw = _expect_bytes("QGRAD int8", obj[5])
+        try:
+            scales = _np.asarray(obj[6], _np.float32)
+        except (TypeError, ValueError) as e:
+            _codec_fail("QGRAD int8", "bad scales field (%s)" % (e,))
+        if scales.ndim != 1 or scales.size == 0:
+            _codec_fail("QGRAD int8", "scales must be a non-empty 1-d "
+                        "float array, got shape %r"
+                        % (getattr(scales, "shape", None),))
+        q = _np.frombuffer(raw, dtype=_np.int8).astype(_np.float32)
+        if q.size < n or q.size % scales.size != 0:
+            _codec_fail("QGRAD int8", "%d quantized bytes cannot cover "
+                        "%d elements in %d equal blocks"
+                        % (q.size, n, scales.size))
+        block = q.size // scales.size
         flat = (q.reshape(-1, block) * scales[:, None]).reshape(-1)[:n]
     elif mode == "2bit":
-        flat = unpack_2bit(obj[5], n, obj[6])
+        try:
+            words = _np.asarray(obj[5], _np.uint32)
+            threshold = float(obj[6])
+        except (TypeError, ValueError) as e:
+            _codec_fail("QGRAD 2bit", "bad words/threshold field (%s)"
+                        % (e,))
+        if words.ndim != 1 or words.size * 16 < n:
+            _codec_fail("QGRAD 2bit", "%r uint32 words carry %d codes, "
+                        "need %d" % (getattr(words, "shape", None),
+                                     words.size * 16, n))
+        flat = unpack_2bit(words, n, threshold)
     else:
-        raise ValueError("unknown gradient wire mode %r" % (mode,))
-    return flat.astype(_np.dtype(dtype)).reshape(shape)
+        _codec_fail("QGRAD", "unknown gradient wire mode %r" % (mode,))
+    return flat.astype(dt).reshape(shape)
 
 
 def quantize_int8_np(flat, block: int = 256):

@@ -72,6 +72,7 @@ from .. import fault as _fault
 from .. import fleet as _fleet
 from .. import telemetry as _telemetry
 from ..kvstore.server import send_msg, recv_msg
+from ..kvstore.wire_verbs import declare_verbs
 from ..kvstore.wire_codec import encode_text
 
 __all__ = ["ServeRouter", "serve_router_forever", "main"]
@@ -84,27 +85,36 @@ __all__ = ["ServeRouter", "serve_router_forever", "main"]
 # replica never burned a dispatch for... or worse, re-dispatch what the
 # replica already cached).  mxlint's wire-verb-exhaustive rule checks
 # every row is handled below.
-WIRE_VERBS = {
+WIRE_VERBS = declare_verbs("router", {
     # forwarded verbatim to the pinned/least-loaded replica; replay
     # exactly-once lives in the REPLICA's cache, keyed on the client's
     # own (client_id, seq) because the envelope crosses unmodified
-    "PREDICT": {"semantics": "replayable", "codec": "array"},
-    "GENERATE": {"semantics": "replayable", "codec": None},
+    "PREDICT": {"semantics": "replayable", "replay": "forward",
+                "codec": "array", "mutates": ()},
+    "GENERATE": {"semantics": "replayable", "replay": "forward",
+                 "codec": None, "mutates": (), "stream": "STREAM"},
     # fan-out: one client SWAP flips every live replica
-    "SWAP": {"semantics": "replayable", "codec": None},
+    "SWAP": {"semantics": "replayable", "replay": "forward",
+             "codec": None, "mutates": ()},
     # server->client token frame of a streaming GENERATE, passed
-    # through unmodified (offset-deduped by the client on re-delivery)
-    "STREAM": {"semantics": "idempotent", "codec": None},
+    # through unmodified (offset-deduped by the client on re-delivery);
+    # a client SENDING it is answered locally with an explicit error
+    "STREAM": {"semantics": "idempotent", "replay": "local",
+               "codec": None, "mutates": ()},
     # answered by the ROUTER itself (fleet-tier state, not replica
     # state) — probing the tier must work with zero live replicas
-    "HEALTH": {"semantics": "idempotent", "codec": None},
-    "METRICS": {"semantics": "idempotent", "codec": "text"},
+    "HEALTH": {"semantics": "idempotent", "replay": "local",
+               "codec": None, "mutates": ()},
+    "METRICS": {"semantics": "idempotent", "replay": "local",
+                "codec": "text", "mutates": ()},
     # retire the ROUTER: new sessions refused, pinned sessions finish
-    "DRAIN": {"semantics": "idempotent", "codec": None},
+    "DRAIN": {"semantics": "idempotent", "replay": "local",
+              "codec": None, "mutates": ("lifecycle",)},
     # stop the fleet: forwarded best-effort to every replica, then the
     # router itself exits
-    "STOP": {"semantics": "idempotent", "codec": None},
-}
+    "STOP": {"semantics": "idempotent", "replay": "forward",
+             "codec": None, "mutates": ()},
+}, role="router", handler="serve_router_forever.Handler._dispatch")
 
 def _split_addrs(raw) -> List[str]:
     if raw is None:

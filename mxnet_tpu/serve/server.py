@@ -71,7 +71,9 @@ from ..base import MXNetError, get_env
 from .. import fault as _fault
 from .. import telemetry as _telemetry
 from ..kvstore.server import send_msg, recv_msg
-from ..kvstore.wire_codec import decode_array, encode_array, encode_text
+from ..kvstore.wire_codec import (WireCodecError, decode_array,
+                                  encode_array, encode_text)
+from ..kvstore.wire_verbs import declare_verbs
 from .batcher import Batcher, Overloaded, result_timeout
 from .servable import ModelHost, Servable
 
@@ -86,27 +88,37 @@ __all__ = ["ServeServer", "serve_forever"]
 # surface — it forwards client envelopes verbatim, so its manifest in
 # router.py mirrors these rows and the replay semantics hold
 # end-to-end through it.
-WIRE_VERBS = {
+WIRE_VERBS = declare_verbs("serve", {
     # one PREDICT = one dispatch, even replayed; one SWAP = one flip
-    "PREDICT": {"semantics": "replayable", "codec": "array"},
-    "SWAP": {"semantics": "replayable", "codec": None},
+    "PREDICT": {"semantics": "replayable", "replay": "cached",
+                "codec": "array", "mutates": ("engine",)},
+    "SWAP": {"semantics": "replayable", "replay": "cached",
+             "codec": None, "mutates": ("model",)},
     # one GENERATE = one generated sequence: a replayed COMPLETED
     # sequence answers from the cache (tokens are plain int lists — no
-    # tensor codec)
-    "GENERATE": {"semantics": "replayable", "codec": None},
+    # tensor codec); fresh streaming runs emit STREAM frames ahead of
+    # the terminal reply
+    "GENERATE": {"semantics": "replayable", "replay": "cached",
+                 "codec": None, "mutates": ("engine",),
+                 "stream": "STREAM"},
     # STREAM is the server->client token-chunk frame of a streaming
     # GENERATE, not a request verb: a client SENDING it is answered
     # with an explicit error (see handle()), and chunks re-emitted
     # after a failover dedupe by offset — re-delivery is harmless
-    "STREAM": {"semantics": "idempotent", "codec": None},
+    "STREAM": {"semantics": "idempotent", "replay": "bypass",
+               "codec": None, "mutates": ()},
     # probes and shutdown re-execute harmlessly on a retried envelope
-    "HEALTH": {"semantics": "idempotent", "codec": None},
-    "METRICS": {"semantics": "idempotent", "codec": "text"},
-    "STOP": {"semantics": "idempotent", "codec": None},
+    "HEALTH": {"semantics": "idempotent", "replay": "bypass",
+               "codec": None, "mutates": ()},
+    "METRICS": {"semantics": "idempotent", "replay": "bypass",
+                "codec": "text", "mutates": ()},
+    "STOP": {"semantics": "idempotent", "replay": "bypass",
+             "codec": None, "mutates": ()},
     # drain-not-kill retirement (ISSUE 17): re-asserting an already-
     # draining replica is a no-op, so a retried DRAIN is harmless
-    "DRAIN": {"semantics": "idempotent", "codec": None},
-}
+    "DRAIN": {"semantics": "idempotent", "replay": "bypass",
+              "codec": None, "mutates": ("lifecycle",)},
+}, role="server", durable=False, handler="ServeServer.handle")
 
 
 class ServeServer:
@@ -540,7 +552,10 @@ def serve_forever(port: Optional[int] = None,
                         msg, stream_fn=stream_fn)
                 except SystemExit:      # injected crash: die mid-request
                     os._exit(17)
-                except _fault.FaultError as e:
+                except (_fault.FaultError, WireCodecError) as e:
+                    # malformed wire frame: decoders raise before any
+                    # state is touched, so reply a typed refusal on the
+                    # same connection instead of severing it
                     ok, payload = False, str(e)
                 finally:
                     with inflight_lock:

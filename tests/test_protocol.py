@@ -1,0 +1,566 @@
+"""Wire-protocol verifier (ISSUE 19): ``python -m tools.mxlint
+--protocol`` — per-verb effect summaries + exhaustive bounded
+fault-schedule model checking of the exactly-once layer.
+
+Layers, bottom-up:
+
+  * extraction units — synthetic machines through ``check_sources``
+    prove the effect-category tables, invalidating-guard analysis and
+    SEQ facts on code small enough to eyeball;
+  * codec robustness (satellite) — deterministic fuzz of the
+    NPX/TXT/JSN/QGRAD codecs: truncated / bit-flipped / wrong-verb
+    payloads raise :class:`WireCodecError`, never hang, and a corrupt
+    PUSH never partially applies server state;
+  * the shipped tree certifies — zero findings, the deterministic
+    schedule count pinned at 737 (drift = reviewed machine change),
+    byte-identical across runs;
+  * the four reinjection quads — each classic protocol fault tripped
+    by its designated rule and cleared by a targeted line suppression;
+  * the CLI contract — exit 0/1/2, ``--format json`` with stable
+    fingerprints, and ``tools/gen_wire_docs.py --check`` in sync.
+
+Pure stdlib + numpy + pytest: no jax import, milliseconds per test.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.mxlint import protocol                            # noqa: E402
+from tools.mxlint import lint_source                         # noqa: E402
+
+# the deterministic fault-schedule count over the shipped machines —
+# pinned here AND in tools/lint.sh: a drift means a machine/verb/SEQ
+# shape change that must be reviewed, then repinned in both places
+PINNED_SCHEDULES = 737
+
+MACHINE_PATHS = ("mxnet_tpu/kvstore/server.py",
+                 "mxnet_tpu/serve/server.py",
+                 "mxnet_tpu/serve/router.py",
+                 "mxnet_tpu/fleet.py")
+
+
+def shipped_sources():
+    out = {}
+    for fp in protocol.iter_py_files([os.path.join(REPO, "mxnet_tpu")]):
+        rel = os.path.relpath(fp, REPO).replace(os.sep, "/")
+        with open(fp, encoding="utf-8") as f:
+            out[rel] = f.read()
+    return out
+
+
+def rules_of(diags):
+    return sorted({d.rule for d in diags})
+
+
+def src(text):
+    return textwrap.dedent(text).lstrip("\n")
+
+
+# ---------------------------------------------------------------------------
+# extraction units: synthetic machines
+# ---------------------------------------------------------------------------
+
+MINI = """
+from mxnet_tpu.kvstore.wire_verbs import declare_verbs
+
+WIRE_VERBS = declare_verbs("mini", {
+    "SET": {"semantics": "replayable", "replay": "cached",
+            "codec": None, "mutates": ("kv",)},
+    "GET": {"semantics": "idempotent", "replay": "bypass",
+            "codec": None, "mutates": ()},
+}, role="server")
+
+
+class Mini:
+    _CACHED = ("SET",)
+
+    def _handle_seq(self, env):
+        _, cid, seq, inner = env
+        if inner[0] not in self._CACHED:
+            return self.handle(inner)
+        ent = self._replay.get(cid)
+        if ent is not None and seq == ent[0]:
+            return ent[2]
+        if ent is not None and seq < ent[0]:
+            return False, "stale"
+        ent = [seq, _Evt(), None]
+        self._replay[cid] = ent
+        resp = self.handle(inner)
+        ent[2] = resp
+        ent[1].set()
+        return resp
+
+    def handle(self, msg):
+        if msg[0] == "SET":
+            key, value = msg[1], msg[2]
+            self._store[key] = value
+            return True, None
+        if msg[0] == "GET":
+            return True, self._store.get(msg[1])
+"""
+
+
+def check_mini(body=MINI):
+    return protocol.check_sources({"mxnet_tpu/mini.py": body})
+
+
+def test_extraction_mini_machine_clean():
+    diags, stats = check_mini()
+    assert diags == [] or rules_of(diags) == [], rules_of(diags)
+    assert len(stats["machines"]) == 1
+    m = stats["machines"][0]
+    assert m["protocol"] == "mini" and m["verbs"] == 2
+    assert stats["schedules"] > 0
+
+
+def test_extraction_guarded_vs_unguarded_effects():
+    # the KV write is an unguarded set; wrap it in an invalidating
+    # `not in` guard and the extractor must mark it guarded (the
+    # retry/no-op path skips it)
+    guarded = MINI.replace(
+        "            self._store[key] = value\n",
+        "            if key not in self._store:\n"
+        "                self._store[key] = value\n")
+    for body in (MINI, guarded):
+        diags, _ = check_mini(body)
+        assert not [d for d in diags if d.rule != "protocol-model"], \
+            rules_of(diags)
+
+
+def test_extraction_missing_dispatch_branch_is_lane_error():
+    body = MINI.replace('if msg[0] == "GET":', 'if msg[0] == "GETX":')
+    diags, _ = check_mini(body)
+    assert "protocol-error" in rules_of(diags)
+    msgs = " ".join(d.message for d in diags)
+    assert "GET" in msgs and "no dispatch branch" in msgs
+
+
+def test_replay_class_mutating_verb_outside_cache():
+    # q1 in miniature: SET declared cached but dropped from _CACHED —
+    # a retried SET re-executes instead of replaying
+    body = MINI.replace('_CACHED = ("SET",)', '_CACHED = ()')
+    diags, _ = check_mini(body)
+    assert "protocol-replay-class" in rules_of(diags)
+
+
+def test_model_checker_catches_unguarded_reexecution():
+    # SET declared *idempotent* + bypass with an ACCUMULATING handler
+    # (+=, not =): the duplicate schedule applies it twice and the
+    # model checker must object — note a plain assignment in the same
+    # position is genuinely idempotent and stays clean (previous test)
+    body = MINI.replace(
+        '"SET": {"semantics": "replayable", "replay": "cached",',
+        '"SET": {"semantics": "idempotent", "replay": "bypass",')
+    body = body.replace('_CACHED = ("SET",)', '_CACHED = ()')
+    body = body.replace("            self._store[key] = value\n",
+                        "            self._store[key] += value\n")
+    diags, _ = check_mini(body)
+    assert "protocol-model" in rules_of(diags), \
+        "model stayed silent on a re-executing accumulate"
+
+
+# ---------------------------------------------------------------------------
+# codec robustness (satellite): typed errors, no partial application
+# ---------------------------------------------------------------------------
+
+from mxnet_tpu.kvstore.wire_codec import (            # noqa: E402
+    WireCodecError, encode_array, decode_array, encode_text,
+    decode_text, encode_json, decode_json, encode_wire, decode_wire,
+    quantize_int8_np, pack_2bit)
+
+
+def _payload_zoo():
+    a = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    q, s = quantize_int8_np(a.ravel(), block=8)
+    words = pack_2bit(np.sign(a.ravel() - 11.0), 0.25)
+    return [
+        (encode_array(a), decode_array),
+        (encode_text("héllo wire"), decode_text),
+        (encode_json({"k": [1, 2, {"n": None}]}), decode_json),
+        (encode_wire("int8", a.shape, a.dtype, (q, s)), decode_wire),
+        (encode_wire("2bit", a.shape, a.dtype, (words, 0.25)),
+         decode_wire),
+    ]
+
+
+def test_codec_roundtrips_still_hold():
+    a = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    np.testing.assert_array_equal(decode_array(encode_array(a)), a)
+    assert decode_text(encode_text("x")) == "x"
+    assert decode_json(encode_json({"a": 1})) == {"a": 1}
+    q, s = quantize_int8_np(a.ravel(), block=8)
+    out = decode_wire(encode_wire("int8", a.shape, a.dtype, (q, s)))
+    assert out.shape == a.shape and out.dtype == a.dtype
+
+
+def test_codec_fuzz_truncate_bitflip_wrongverb():
+    """Property-style fuzz, deterministically seeded: every corrupted
+    payload either decodes to a value or raises WireCodecError —
+    nothing else escapes, nothing hangs."""
+    rng = np.random.RandomState(20190807)
+    decoders = (decode_array, decode_text, decode_json, decode_wire)
+    for payload, its_decoder in _payload_zoo():
+        # wrong verb: every OTHER decoder must refuse with the typed
+        # error (tag mismatch), not garbage or an arbitrary exception
+        for dec in decoders:
+            if dec is its_decoder:
+                continue
+            with pytest.raises(WireCodecError):
+                dec(payload)
+        for trial in range(60):
+            corrupt = list(payload)
+            what = rng.randint(3)
+            idx = rng.randint(1, len(corrupt))
+            field = corrupt[idx]
+            if what == 0 and isinstance(field, bytes) and field:
+                cut = rng.randint(len(field))
+                corrupt[idx] = field[:cut]              # truncate
+            elif what == 1 and isinstance(field, bytes) and field:
+                pos = rng.randint(len(field))
+                flipped = bytearray(field)
+                flipped[pos] ^= 1 << rng.randint(8)     # bit flip
+                corrupt[idx] = bytes(flipped)
+            else:
+                junk = [None, "junk", -1, b"\x00", (), 3.5]
+                corrupt[idx] = junk[rng.randint(len(junk))]
+            try:
+                its_decoder(tuple(corrupt))
+            except WireCodecError:
+                pass        # the contract: clean typed failure
+            # a decode that still succeeds is fine (the corruption may
+            # have hit a semantically-dead byte, e.g. a flipped bit
+            # inside a float payload) — what must never happen is any
+            # OTHER exception type, which pytest would surface here
+
+
+def test_codec_error_is_valueerror_subclass():
+    # pre-existing `except ValueError` call sites keep working
+    assert issubclass(WireCodecError, ValueError)
+    with pytest.raises(ValueError):
+        decode_array(("NPX", (2,), "float32", b"\x00"))
+
+
+def test_corrupt_push_never_partially_applies():
+    from mxnet_tpu.kvstore.server import KVStoreServer
+    srv = KVStoreServer(num_workers=1)
+    init = np.zeros(8, np.float32)
+    assert srv.handle(("INIT", "w", init)) == (True, None)
+    # truncated QGRAD frame: decode raises BEFORE any store/optimizer
+    # state is touched — the stored value must be bit-identical after
+    q, s = quantize_int8_np(np.ones(8, np.float32), block=8)
+    frame = encode_wire("int8", (8,), "float32", (q, s))
+    bad = frame[:5] + (frame[5][:3], frame[6])
+    with pytest.raises(WireCodecError):
+        srv.handle(("PUSH", "w", bad))
+    ok, out = srv.handle(("PULL", "w"))
+    assert ok and (out == init).all()
+    # and a well-formed retry of the same logical push still lands
+    assert srv.handle(("PUSH", "w", frame)) == (True, None)
+
+
+# ---------------------------------------------------------------------------
+# the shipped tree certifies
+# ---------------------------------------------------------------------------
+
+def test_shipped_tree_zero_findings_and_pinned_schedules():
+    diags, stats = protocol.check_sources(shipped_sources())
+    assert diags == [], [(d.rule, "%s:%d" % (d.path, d.line), d.message)
+                         for d in diags]
+    assert len(stats["machines"]) == 4
+    assert {m["protocol"] for m in stats["machines"]} == \
+        {"kvstore", "serve", "router", "fleet"}
+    assert stats["verbs"] == 30
+    assert stats["schedules"] == PINNED_SCHEDULES
+
+
+def test_every_manifest_verb_is_covered():
+    sources = shipped_sources()
+    covered = set()
+    for path in MACHINE_PATHS:
+        m = protocol._extract_machine(path, sources[path])
+        assert m is not None, path
+        for verb in m.manifest:
+            assert verb in m.verbs, (path, verb)
+            covered.add((m.protocol, verb))
+    assert len(covered) == 30
+
+
+def test_model_checker_is_deterministic():
+    sources = shipped_sources()
+    runs = [protocol.check_sources(sources) for _ in range(2)]
+    assert runs[0][1] == runs[1][1]
+    assert [(d.rule, d.path, d.line, d.message) for d in runs[0][0]] == \
+        [(d.rule, d.path, d.line, d.message) for d in runs[1][0]]
+
+
+# ---------------------------------------------------------------------------
+# the reinjection quads: trip, then clear under targeted suppression
+# ---------------------------------------------------------------------------
+
+QUADS = [
+    # (path, old, new, rule that must fire)
+    ("mxnet_tpu/serve/server.py",
+     '_CACHED = ("PREDICT", "SWAP", "GENERATE")',
+     '_CACHED = ("PREDICT", "SWAP")',
+     "protocol-replay-class"),
+    ("mxnet_tpu/kvstore/server.py",
+     "            self.touch(who)\n"
+     "            if changed:\n",
+     "            self.touch(who)\n"
+     "            self._membership_epoch += 1\n"
+     "            if changed:\n",
+     "protocol-idempotent-epoch"),
+    ("mxnet_tpu/kvstore/server.py",
+     "        ent[2] = resp\n"
+     "        ent[1].set()\n"
+     "        if cmd in self._MUTATING:\n"
+     "            self._note_mutation()\n"
+     "        return resp",
+     "        if cmd in self._MUTATING:\n"
+     "            self._note_mutation()\n"
+     "        ent[2] = resp\n"
+     "        ent[1].set()\n"
+     "        return resp",
+     "protocol-reply-order"),
+    ("mxnet_tpu/serve/router.py",
+     "                send_msg(up, env)\n"
+     "                while True:",
+     '                send_msg(up, ("SEQ", cid, attempt, env))\n'
+     "                while True:",
+     "protocol-router-verbatim"),
+]
+
+
+@pytest.mark.parametrize("path,old,new,rule",
+                         QUADS, ids=[q[3] for q in QUADS])
+def test_reinjection_quad_trips_and_suppresses(path, old, new, rule):
+    sources = shipped_sources()
+    assert old in sources[path], "quad anchor drifted: %s" % rule
+    sources[path] = sources[path].replace(old, new)
+    diags, _ = protocol.check_sources(sources)
+    fired = rules_of(diags)
+    assert rule in fired, (rule, fired)
+    # the static finding corroborated by the model checker replaying
+    # the fault schedule that exploits it (except the pure-contract
+    # replay-class case on a machine whose model sees the same hole)
+    assert all(d.path in MACHINE_PATHS for d in diags)
+    # targeted suppression at each finding's line clears the lane —
+    # the documented fix-or-suppress-with-why escape hatch (two rules
+    # anchored on one line ride one comma-joined disable comment)
+    by_line = {}
+    for d in diags:
+        by_line.setdefault((d.path, d.line), set()).add(d.rule)
+    for (path2, line), rset in by_line.items():
+        lines = sources[path2].split("\n")
+        lines[line - 1] += "  # mxlint: disable=%s" % ",".join(
+            sorted(rset))
+        sources[path2] = "\n".join(lines)
+    diags2, _ = protocol.check_sources(sources)
+    assert diags2 == [], [(d.rule, d.line) for d in diags2]
+
+
+# ---------------------------------------------------------------------------
+# stream-dedupe: the one rule anchored client-side
+# ---------------------------------------------------------------------------
+
+STREAM_CLIENT = """
+def request(verb, payload, on_stream=None):
+    pass
+"""
+
+STREAM_MACHINE = """
+from mxnet_tpu.kvstore.wire_verbs import declare_verbs
+
+WIRE_VERBS = declare_verbs("minis", {
+    "GENERATE": {"semantics": "replayable", "replay": "cached",
+                 "codec": None, "mutates": ("engine",), "stream": True},
+}, role="server")
+
+
+class S:
+    _CACHED = ("GENERATE",)
+
+    def _handle_seq(self, env):
+        _, cid, seq, inner = env
+        if inner[0] not in self._CACHED:
+            return self.handle(inner)
+        ent = self._replay.get(cid)
+        if ent is not None and seq == ent[0]:
+            return ent[2]
+        if ent is not None and seq < ent[0]:
+            return False, "stale"
+        ent = [seq, _Evt(), None]
+        self._replay[cid] = ent
+        resp = self.handle(inner)
+        ent[2] = resp
+        ent[1].set()
+        return resp
+
+    def handle(self, msg):
+        if msg[0] == "GENERATE":
+            self.batcher.submit(msg[1])
+            return True, None
+"""
+
+
+def test_stream_dedupe_offset_blind_callback_fires():
+    blind = STREAM_CLIENT + src("""
+    def run():
+        request("GENERATE", "req",
+                on_stream=lambda off, tok: print(tok))
+    """)
+    diags, _ = protocol.check_sources({
+        "mxnet_tpu/minis.py": STREAM_MACHINE,
+        "mxnet_tpu/minic.py": blind})
+    assert "protocol-stream-dedupe" in rules_of(diags)
+    d = [x for x in diags if x.rule == "protocol-stream-dedupe"][0]
+    assert d.path == "mxnet_tpu/minic.py"
+
+
+def test_stream_dedupe_offset_consulting_callback_clean():
+    dedup = STREAM_CLIENT + src("""
+    def run(state):
+        def on_frame(off, tok):
+            if off <= state["seen"]:
+                return
+            state["seen"] = off
+            state["out"].append(tok)
+        request("GENERATE", "req", on_stream=on_frame)
+    """)
+    diags, _ = protocol.check_sources({
+        "mxnet_tpu/minis.py": STREAM_MACHINE,
+        "mxnet_tpu/minic.py": dedup})
+    assert "protocol-stream-dedupe" not in rules_of(diags)
+
+
+def test_shipped_stream_client_dedupes():
+    # the real serve client's on_stream plumbing consults the frame
+    # offset — the rule stays quiet over the whole shipped tree (the
+    # zero-findings test above covers it; this pins the client file
+    # specifically so a refactor that drops the dedupe can't hide)
+    sources = shipped_sources()
+    assert "mxnet_tpu/serve/client.py" in sources
+    diags, _ = protocol.check_sources(sources)
+    assert "protocol-stream-dedupe" not in rules_of(diags)
+
+
+# ---------------------------------------------------------------------------
+# wire-manifest-schema (file rule riding the normal pass)
+# ---------------------------------------------------------------------------
+
+def test_wire_manifest_schema_bare_dict_fires():
+    code = src("""
+    WIRE_VERBS = {
+        "PING": {"semantics": "idempotent", "codec": None},
+    }
+    """)
+    diags = lint_source(code, path="mxnet_tpu/fleet.py",
+                        select={"wire-manifest-schema"})
+    assert [d.rule for d in diags] == ["wire-manifest-schema"]
+
+
+def test_wire_manifest_schema_declared_clean_and_scoped():
+    code = src("""
+    from mxnet_tpu.kvstore.wire_verbs import declare_verbs
+    WIRE_VERBS = declare_verbs("fleet", {
+        "PING": {"semantics": "idempotent", "codec": None},
+    }, role="collector")
+    """)
+    assert lint_source(code, path="mxnet_tpu/fleet.py",
+                       select={"wire-manifest-schema"}) == []
+    # out of the four machine files, a bare dict is none of this
+    # rule's business (tests build toy manifests all the time)
+    bare = 'WIRE_VERBS = {"X": {"semantics": "idempotent"}}\n'
+    assert lint_source(bare, path="mxnet_tpu/other.py",
+                       select={"wire-manifest-schema"}) == []
+
+
+# ---------------------------------------------------------------------------
+# CLI contract + docs gate
+# ---------------------------------------------------------------------------
+
+def run_cli(*argv):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.mxlint"] + list(argv),
+        cwd=REPO, capture_output=True, text=True)
+
+
+def test_cli_protocol_clean_tree_exit_zero():
+    p = run_cli("--protocol")
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "%d fault schedule(s) checked" % PINNED_SCHEDULES in p.stdout
+    assert "0 violation(s)" in p.stdout
+
+
+def test_cli_protocol_json_schema_and_fingerprints(tmp_path):
+    p = run_cli("--protocol", "--format", "json")
+    assert p.returncode == 0, p.stdout + p.stderr
+    payload = json.loads(p.stdout)
+    assert payload["protocol_schema"] == 1
+    assert payload["schedules"] == PINNED_SCHEDULES
+    assert payload["verbs"] == 30 and len(payload["machines"]) == 4
+    assert payload["violations"] == []
+    # findings DO carry fingerprints: run against a mutated copy
+    mut = tmp_path / "mxnet_tpu"
+    import shutil
+    shutil.copytree(os.path.join(REPO, "mxnet_tpu"), mut,
+                    ignore=shutil.ignore_patterns("__pycache__"))
+    sp = mut / "serve" / "server.py"
+    sp.write_text(sp.read_text().replace(
+        '_CACHED = ("PREDICT", "SWAP", "GENERATE")',
+        '_CACHED = ("PREDICT", "SWAP")'))
+    p = subprocess.run(
+        [sys.executable, "-m", "tools.mxlint", "--protocol",
+         "--format", "json", str(mut)],
+        cwd=REPO, capture_output=True, text=True)
+    assert p.returncode == 1, p.stdout + p.stderr
+    payload = json.loads(p.stdout)
+    assert payload["violations"], "mutated tree must yield findings"
+    for v in payload["violations"]:
+        assert v["rule"].startswith("protocol-")
+        assert len(v["fingerprint"]) == 16
+
+
+def test_cli_protocol_select_and_usage_errors():
+    p = run_cli("--protocol", "--select", "protocol-model")
+    assert p.returncode == 0, p.stdout + p.stderr
+    p = run_cli("--protocol", "--select", "no-such-rule")
+    assert p.returncode == 2
+    p = run_cli("--protocol", "does/not/exist")
+    assert p.returncode == 2
+
+
+def test_protocol_rules_listed():
+    p = run_cli("--list-rules")
+    assert p.returncode == 0
+    for rule in ("protocol-replay-class", "protocol-idempotent-epoch",
+                 "protocol-reply-order", "protocol-stream-dedupe",
+                 "protocol-router-verbatim", "protocol-effects-drift",
+                 "protocol-model", "protocol-error",
+                 "wire-manifest-schema"):
+        assert rule in p.stdout, rule
+
+
+def test_gen_wire_docs_in_sync():
+    p = subprocess.run(
+        [sys.executable, os.path.join("tools", "gen_wire_docs.py"),
+         "--check"], cwd=REPO, capture_output=True, text=True)
+    assert p.returncode == 0, p.stdout + p.stderr
+
+
+def test_wire_doc_mentions_every_verb():
+    doc = open(os.path.join(REPO, "docs", "WIRE_PROTOCOL.md")).read()
+    sources = shipped_sources()
+    for path in MACHINE_PATHS:
+        m = protocol._extract_machine(path, sources[path])
+        for verb in m.manifest:
+            assert "`%s`" % verb in doc, (path, verb)
+    assert str(PINNED_SCHEDULES) in doc

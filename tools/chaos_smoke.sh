@@ -928,6 +928,38 @@ echo "== chaos_smoke: elastic membership - resize mid-fit + budget shrink (ISSUE
     -p no:cacheprovider -p no:randomly
 echo "chaos_smoke: elastic PASS (grow 2->4, shrink 4->3, SIGKILL shrink-and-continue)"
 
+echo "== chaos_smoke: wire-protocol verifier has teeth (ISSUE 19)"
+# The --protocol lane must (a) pass on the shipped tree — lint.sh below
+# runs the real CLI with the pinned schedule count — and (b) actually
+# trip when a protocol fault is injected.  Reinject the classic one
+# in-memory (drop GENERATE from the serve replay cache: a retried
+# generation would re-decode instead of replaying) and assert the lane
+# catches it; the full quad lives in tests/test_protocol.py.
+"$PY" - <<'EOF'
+import os
+from tools.mxlint import protocol
+
+repo = os.getcwd()
+sources = {}
+for fp in protocol.iter_py_files([os.path.join(repo, "mxnet_tpu")]):
+    rel = os.path.relpath(fp, repo).replace(os.sep, "/")
+    sources[rel] = open(fp, encoding="utf-8").read()
+diags, stats = protocol.check_sources(sources)
+assert not diags, "shipped tree must be clean: %r" % [
+    (d.rule, d.path, d.line) for d in diags]
+
+mut = sources["mxnet_tpu/serve/server.py"].replace(
+    '_CACHED = ("PREDICT", "SWAP", "GENERATE")',
+    '_CACHED = ("PREDICT", "SWAP")')
+assert mut != sources["mxnet_tpu/serve/server.py"], "anchor drifted"
+sources["mxnet_tpu/serve/server.py"] = mut
+diags, _ = protocol.check_sources(sources)
+rules = sorted({d.rule for d in diags})
+assert "protocol-replay-class" in rules, rules
+print("chaos_smoke: protocol verifier PASS (clean tree certifies; "
+      "injected replay-set hole trips %s)" % rules)
+EOF
+
 echo "== chaos_smoke: static-analysis lane (tools/lint.sh)"
 bash "$REPO/tools/lint.sh"
 

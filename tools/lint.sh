@@ -54,6 +54,26 @@ PYEOF
 echo "== lint: env-var doc consistency (tools/gen_env_docs.py --check)"
 "$PY" tools/gen_env_docs.py --check
 
+echo "== lint: wire-protocol verifier (python -m tools.mxlint --protocol)"
+# altitude 4 (ISSUE 19): per-verb effect summaries + exhaustive bounded
+# fault-schedule model checking of the exactly-once layer.  Never
+# baselined — a finding here is fix-now or suppress-at-line-with-why.
+# The schedule count is pinned: the checker is deterministic (virtual
+# clock, no sockets, sorted enumeration), so a drift in the count means
+# a machine/verb/SEQ-shape change that must be reviewed (and the doc
+# regenerated).  Wall budget <60s like the contracts lane (measured ~4s).
+proto_out="$(timeout -k 10 60 "$PY" -m tools.mxlint --protocol)"
+echo "$proto_out"
+echo "$proto_out" | grep -q "737 fault schedule(s) checked" || {
+    echo "lint: protocol fault-schedule count drifted from the pinned 737" \
+         "— review the machine change, then repin here and in" \
+         "tests/test_protocol.py" >&2
+    exit 1
+}
+
+echo "== lint: wire-protocol doc consistency (tools/gen_wire_docs.py --check)"
+"$PY" tools/gen_wire_docs.py --check
+
 echo "== lint: bench-history schema (tools/bench_compare.py --check-schema)"
 "$PY" tools/bench_compare.py --check-schema
 

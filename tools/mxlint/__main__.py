@@ -68,7 +68,33 @@ def main(argv=None) -> int:
                     default=None, metavar="FILE",
                     help="with --contracts: write the contract manifest "
                     "JSON (default tools/mxlint/contracts.json)")
+    ap.add_argument("--protocol", action="store_true",
+                    help="run the wire-protocol verifier instead of the "
+                    "AST rules: extract per-verb effect summaries from "
+                    "every declare_verbs() machine and model-check the "
+                    "exactly-once layer under exhaustive bounded fault "
+                    "schedules (ISSUE 19; see tools/mxlint/protocol.py). "
+                    "No baseline: findings are fix-or-suppress-with-why")
     args = ap.parse_args(argv)
+
+    if args.protocol:
+        # pure-stdlib like the AST lanes, but its own pipeline: verb
+        # machines + deterministic model checker, never baselined
+        from . import protocol as _protocol
+        sel = None
+        if args.select:
+            sel = {r.strip() for r in args.select.split(",") if r.strip()}
+            unknown = sel - set(RULES)
+            if unknown:
+                print("mxlint: unknown rule(s): %s"
+                      % ", ".join(sorted(unknown)), file=sys.stderr)
+                return 2
+        ppaths = list(args.paths) if args.paths else _default_paths()
+        for p in ppaths:
+            if not os.path.exists(p):
+                print("mxlint: no such path: %s" % p, file=sys.stderr)
+                return 2
+        return _protocol.run_cli(ppaths, fmt=args.format, select=sel)
 
     if args.contracts:
         # the contract lane imports the runtime (jax + mxnet_tpu) —

@@ -267,10 +267,14 @@ class Rule:
     """Base class: subclasses set `id`/`description`/`invariant_from` and
     implement check(ctx) -> iterator of Diagnostics.
 
-    ``scope`` is ``"file"`` (checked per file against a FileContext) or
+    ``scope`` is ``"file"`` (checked per file against a FileContext),
     ``"project"`` (checked once against the whole-program ProjectIndex —
     see tools/mxlint/project.py; such rules implement
-    ``check_project(project)`` instead)."""
+    ``check_project(project)`` instead), or ``"protocol"`` (run only by
+    the ``--protocol`` wire-protocol verifier in
+    tools/mxlint/protocol.py; registered here so --list-rules/--select
+    see the ids, skipped by both the file and project passes, and —
+    unlike the other scopes — never baselined)."""
 
     id: str = ""
     description: str = ""

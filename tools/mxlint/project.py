@@ -173,7 +173,7 @@ class WireInfo:
     ``_MUTATING``) and ``encode_*``/``decode_*`` codec basenames."""
 
     __slots__ = ("emits", "handles", "manifest", "manifest_line",
-                 "replay_verbs", "codecs")
+                 "replay_verbs", "codecs", "meta")
 
     def __init__(self):
         # [(verb, line, snippet)] — calls through _rpc/_send_np, and
@@ -181,11 +181,15 @@ class WireInfo:
         self.emits: List[Tuple[str, int, str]] = []
         self.handles: Dict[str, int] = {}     # verb -> first compare line
         # verb -> {"semantics": ..., "codec": ...} from a literal
-        # module/class-level WIRE_VERBS dict; None when absent
+        # module/class-level WIRE_VERBS dict — either a bare dict or the
+        # dict argument of a declare_verbs(...) call; None when absent
         self.manifest: Optional[Dict[str, Dict[str, object]]] = None
         self.manifest_line = 0
         self.replay_verbs: Set[str] = set()
         self.codecs: Set[Tuple[str, str]] = set()   # ("encode"|"decode", name)
+        # declare_verbs(...) call-level facts: protocol name + keyword
+        # options (role, durable, handler); empty for bare-dict manifests
+        self.meta: Dict[str, object] = {}
 
 
 class FileSummary:
@@ -920,17 +924,40 @@ def _wire_summary(tree: ast.AST, lines: Sequence[str]) -> WireInfo:
         elif isinstance(node, ast.Assign) and len(node.targets) == 1 and \
                 isinstance(node.targets[0], ast.Name):
             tname = node.targets[0].id
-            if tname == "WIRE_VERBS" and isinstance(node.value, ast.Dict):
+            val = node.value
+            if tname == "WIRE_VERBS" and isinstance(val, ast.Call):
+                # declare_verbs("name", {...literal...}, role=..., ...)
+                # (ISSUE 19): unwrap to the literal dict argument and
+                # keep the call-level options as manifest metadata
+                cf = val.func
+                ctail = cf.attr if isinstance(cf, ast.Attribute) else \
+                    (cf.id if isinstance(cf, ast.Name) else None)
+                if ctail == "declare_verbs":
+                    for pos, a in enumerate(val.args):
+                        if pos == 0 and isinstance(a, ast.Constant):
+                            w.meta["protocol"] = a.value
+                        elif isinstance(a, ast.Dict):
+                            val = a
+                    for kw in node.value.keywords:
+                        if kw.arg and isinstance(kw.value, ast.Constant):
+                            w.meta[kw.arg] = kw.value.value
+            if tname == "WIRE_VERBS" and isinstance(val, ast.Dict):
                 manifest: Dict[str, Dict[str, object]] = {}
-                for k, v in zip(node.value.keys, node.value.values):
+                for k, v in zip(val.keys, val.values):
                     verb = _verb_const(k)
                     if not verb or not isinstance(v, ast.Dict):
                         continue
                     entry: Dict[str, object] = {}
                     for ek, ev in zip(v.keys, v.values):
-                        if isinstance(ek, ast.Constant) and \
-                                isinstance(ev, ast.Constant):
+                        if not isinstance(ek, ast.Constant):
+                            continue
+                        if isinstance(ev, ast.Constant):
                             entry[str(ek.value)] = ev.value
+                        elif isinstance(ev, (ast.Tuple, ast.List)) and \
+                                all(isinstance(el, ast.Constant)
+                                    for el in ev.elts):
+                            entry[str(ek.value)] = tuple(
+                                el.value for el in ev.elts)
                     manifest[verb] = entry
                 w.manifest = manifest
                 w.manifest_line = node.lineno

@@ -1,0 +1,1569 @@
+"""mxlint altitude 4 — the wire-protocol verifier (``--protocol``).
+
+Two halves, both pure stdlib-``ast`` static analysis (no sockets, no
+imports of the code under check, virtual clock only):
+
+1. **Per-verb effect extraction.**  Every ``WIRE_VERBS`` manifest built
+   through :func:`mxnet_tpu.kvstore.wire_verbs.declare_verbs` names a
+   protocol machine; for each declared verb the extractor walks the
+   handler branch (depth-bounded method inlining) and summarizes which
+   state categories it mutates, whether each mutation sits behind an
+   *invalidating guard* (the test that made it run becomes false once
+   it ran — the shape that makes a handler idempotent), where the SEQ
+   replay layer resolves/persists, and whether a router re-mints the
+   client's ``(cid, seq)`` identity.
+
+2. **Fault-schedule model checking.**  The summaries plus the declared
+   contracts compile into tiny per-verb state machines; a deterministic
+   enumerator drives every bounded schedule of drop / duplicate /
+   reply-loss / stale-reorder / crash-restart-from-snapshot / router
+   failover and asserts the declared property on each terminal state
+   (``replayable``: applied exactly once per request; ``idempotent``:
+   N deliveries ≡ 1; stateless: no visible delta).  The schedule count
+   is deterministic and pinned by the test suite.
+
+Findings from this lane are NEVER baselinable — a broken exactly-once
+invariant is not technical debt.  Per-line ``# mxlint: disable=...``
+suppressions are honored (for documented-by-design exceptions only).
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .core import (Diagnostic, Rule, register_rule, _parse_suppressions,
+                   _suppressed, repo_root_of, iter_py_files)
+from .project import _wire_summary
+
+__all__ = ["check_sources", "check_paths", "run_cli", "PROTOCOL_RULES"]
+
+RULE_REPLAY = "protocol-replay-class"
+RULE_EPOCH = "protocol-idempotent-epoch"
+RULE_ORDER = "protocol-reply-order"
+RULE_STREAM = "protocol-stream-dedupe"
+RULE_VERBATIM = "protocol-router-verbatim"
+RULE_EFFECTS = "protocol-effects-drift"
+RULE_MODEL = "protocol-model"
+RULE_ERROR = "protocol-error"
+
+
+class _ProtocolRule(Rule):
+    """Registry stub: protocol-lane rules run inside check_sources(),
+    not the per-file/project passes — scope='protocol' is skipped by
+    both.  Registering them keeps --list-rules/--select truthful."""
+    scope = "protocol"
+    invariant_from = "PR 19"
+
+    def check(self, ctx):                       # pragma: no cover
+        return iter(())
+
+
+@register_rule
+class _ReplayClassRule(_ProtocolRule):
+    id = RULE_REPLAY
+    description = ("declared replay class must match the SEQ layer: a "
+                   "mutating replayable verb outside the replay cache "
+                   "re-executes on reconnect replay")
+
+
+@register_rule
+class _IdempotentEpochRule(_ProtocolRule):
+    id = RULE_EPOCH
+    description = ("a declared-idempotent verb must not bump the "
+                   "membership epoch on its no-op path (PR-16 "
+                   "contract: retried JOIN/LEAVE are epoch-silent)")
+
+
+@register_rule
+class _ReplyOrderRule(_ProtocolRule):
+    id = RULE_ORDER
+    description = ("the SEQ layer must resolve a mutating verb's cache "
+                   "entry BEFORE persisting: a snapshot carrying the "
+                   "effect but not the resolved entry double-applies "
+                   "on crash-replay")
+
+
+@register_rule
+class _StreamDedupeRule(_ProtocolRule):
+    id = RULE_STREAM
+    description = ("a stream verb's client on_stream callback must "
+                   "dedupe by frame offset — replayed connections "
+                   "resend frames")
+
+
+@register_rule
+class _RouterVerbatimRule(_ProtocolRule):
+    id = RULE_VERBATIM
+    description = ("a router must forward the client envelope verbatim, "
+                   "never mint its own (cid, seq): fresh identities "
+                   "defeat every replica's replay cache")
+
+
+@register_rule
+class _EffectsDriftRule(_ProtocolRule):
+    id = RULE_EFFECTS
+    description = ("the manifest's declared mutates set must match the "
+                   "handler's extracted effect summary")
+
+
+@register_rule
+class _ModelRule(_ProtocolRule):
+    id = RULE_MODEL
+    description = ("exhaustive bounded fault schedules must uphold the "
+                   "declared per-verb property (exactly-once / "
+                   "idempotent / stateless)")
+
+
+@register_rule
+class _ProtocolErrorRule(_ProtocolRule):
+    id = RULE_ERROR
+    description = ("protocol lane infrastructure error: unparseable "
+                   "machine, undeclared handler branch, missing SEQ "
+                   "layer — the machine cannot be certified")
+
+
+PROTOCOL_RULES = (RULE_REPLAY, RULE_EPOCH, RULE_ORDER, RULE_STREAM,
+                  RULE_VERBATIM, RULE_EFFECTS, RULE_MODEL, RULE_ERROR)
+
+
+# ---------------------------------------------------------------------------
+# State-category tables: attribute name -> protocol state category.
+# Categories in _BENIGN never carry protocol meaning (caches, telemetry,
+# liveness stamps, lock plumbing) — mutating them is always allowed.
+# ---------------------------------------------------------------------------
+
+ATTR_EXACT = {
+    "_store": "kv",
+    "_opt_blob": "optimizer",
+    "_updater": "optimizer",
+    "_members": "membership",
+    "_membership_epoch": "epoch",
+    "_replay": "replaycache",
+    "_pins": "routing",
+    "_replicas": "routing",
+    "_signals": "routing",
+    "_rr": "routing",
+    "_draining": "lifecycle",
+    "_drain_deadline": "lifecycle",
+    "host": "model",
+    "batcher": "engine",
+    "decode": "engine",
+    "_locks": "locking",
+    "_lock": "locking",
+    "_last_seen": "liveness",
+    "_seen_regime": "liveness",
+    "_vclock_pumper": "liveness",
+    "_mutations": "durability",
+}
+
+ATTR_PREFIX = (
+    ("_barrier", "barrier"),
+    ("_snapshot", "durability"),
+    ("_replay", "replaycache"),
+    ("_c_", "telemetry"),
+    ("_g_", "telemetry"),
+    ("_seen", "liveness"),
+)
+
+_BENIGN = frozenset(("replaycache", "routing", "locking", "liveness",
+                     "durability", "telemetry"))
+
+# mutator method names, by the kind of state transition they make
+MUT_SET = frozenset(("add", "set", "update", "setdefault"))
+MUT_DEL = frozenset(("discard", "remove", "clear", "pop", "popitem"))
+MUT_AUG = frozenset(("append", "appendleft", "inc", "insert", "extend",
+                     "submit", "deploy", "put", "observe"))
+
+# handler-function search order when locating a verb's dispatch branch
+_BRANCH_PRIORITY = ("_dispatch", "handle", "handle_local", "_serve")
+
+_INLINE_DEPTH = 4
+# methods recorded as persistence points, never inlined (their bodies
+# write files, not protocol state)
+_PERSIST_METHODS = frozenset(("snapshot", "_note_mutation"))
+
+
+def _attr_category(name: str) -> str:
+    if name in ATTR_EXACT:
+        return ATTR_EXACT[name]
+    for pre, cat in ATTR_PREFIX:
+        if name.startswith(pre):
+            return cat
+    return "other:" + name
+
+
+def _chain(node) -> Optional[List[str]]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return None
+
+
+class _Effect:
+    __slots__ = ("category", "kind", "guarded", "line", "via")
+
+    def __init__(self, category, kind, guarded, line, via=""):
+        self.category = category
+        self.kind = kind            # "set" | "del" | "aug"
+        self.guarded = guarded
+        self.line = line
+        self.via = via              # inlined callee, for messages
+
+    def key(self):
+        return (self.category, self.kind, self.guarded, self.line)
+
+
+class _VerbFacts:
+    __slots__ = ("verb", "line", "func", "effects", "persists",
+                 "calls_forward", "calls_fanout")
+
+    def __init__(self, verb, line, func):
+        self.verb = verb
+        self.line = line            # dispatch-compare line
+        self.func = func            # qualname of the dispatch function
+        self.effects: List[_Effect] = []
+        self.persists: List[Tuple[int, bool]] = []   # (line, guarded)
+        self.calls_forward = False
+        self.calls_fanout = False
+
+
+class _SeqFacts:
+    __slots__ = ("present", "line", "bypass", "cached", "resolve_line",
+                 "persist_line", "persist_verbs", "has_stale")
+
+    def __init__(self):
+        self.present = False
+        self.line = 0
+        self.bypass: Set[str] = set()
+        self.cached: Optional[Set[str]] = None
+        self.resolve_line = 0
+        self.persist_line = 0
+        self.persist_verbs: Set[str] = set()
+        self.has_stale = False
+
+
+class _Machine:
+    """One protocol machine: a file whose WIRE_VERBS went through
+    declare_verbs()."""
+
+    __slots__ = ("path", "lines", "tree", "protocol", "role", "durable",
+                 "manifest", "manifest_line", "verbs", "seq",
+                 "minted_sites", "errors")
+
+    def __init__(self, path, lines, tree, wire):
+        self.path = path
+        self.lines = lines
+        self.tree = tree
+        self.protocol = wire.meta.get("protocol")
+        self.role = wire.meta.get("role", "server")
+        self.durable = bool(wire.meta.get("durable"))
+        self.manifest = wire.manifest or {}
+        self.manifest_line = wire.manifest_line
+        self.verbs: Dict[str, _VerbFacts] = {}
+        self.seq = _SeqFacts()
+        self.minted_sites: List[int] = []
+        self.errors: List[Tuple[int, str]] = []
+
+    def line_text(self, lineno):
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+
+class _FileCtx:
+    """Class/method index of one machine file, for branch lookup and
+    depth-bounded inlining."""
+
+    def __init__(self, tree):
+        self.classes: Dict[str, ast.ClassDef] = {}
+        # (class, method) -> FunctionDef;  method -> [class, ...]
+        self.methods: Dict[Tuple[str, str], ast.FunctionDef] = {}
+        self.method_classes: Dict[str, List[str]] = {}
+        self.functions: List[Tuple[str, Optional[str], ast.FunctionDef]] = []
+        stack: List[Tuple[ast.AST, Optional[str]]] = [(tree, None)]
+        while stack:
+            node, cls = stack.pop()
+            for sub in ast.iter_child_nodes(node):
+                if isinstance(sub, ast.ClassDef):
+                    self.classes[sub.name] = sub
+                    stack.append((sub, sub.name))
+                elif isinstance(sub, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    if cls is not None:
+                        self.methods[(cls, sub.name)] = sub
+                        self.method_classes.setdefault(
+                            sub.name, []).append(cls)
+                    self.functions.append((sub.name, cls, sub))
+                    stack.append((sub, cls))
+        self.functions.sort(key=lambda t: t[2].lineno)
+
+    def resolve_name_method(self, meth: str):
+        """``rt.forward(...)`` — a Name receiver resolves iff exactly
+        one class in the file defines the method."""
+        owners = self.method_classes.get(meth, [])
+        if len(set(owners)) == 1:
+            cls = owners[0]
+            return cls, self.methods[(cls, meth)]
+        return None, None
+
+
+# ---------------------------------------------------------------------------
+# local-alias / taint pre-pass (per function)
+# ---------------------------------------------------------------------------
+
+def _state_cats_in(expr, aliases, tainted) -> Set[str]:
+    """Every state category an expression touches (self attrs through
+    the category tables, plus category-aliased locals)."""
+    cats: Set[str] = set()
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute):
+            ch = _chain(node)
+            if ch and ch[0] == "self" and len(ch) >= 2:
+                cats.add(_attr_category(ch[1]))
+        elif isinstance(node, ast.Name):
+            if node.id in aliases:
+                cats.add(aliases[node.id])
+    return cats
+
+
+def _is_tainted_test(expr, aliases, tainted) -> bool:
+    return any(isinstance(n, ast.Name) and n.id in tainted
+               for n in ast.walk(expr))
+
+
+def _scan_locals(fn: ast.FunctionDef):
+    """aliases: local name -> state category it references (``stored =
+    self._store.get(k)``); tainted: locals whose value is derived from
+    state (directly, or assigned/mutated under a state-dependent test
+    or loop) — a bare ``if changed:`` over such a name is an
+    invalidating guard."""
+    aliases: Dict[str, str] = {}
+    tainted: Set[str] = set()
+
+    def first_cat(expr):
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Attribute):
+                ch = _chain(node)
+                if ch and ch[0] == "self" and len(ch) >= 2:
+                    cat = _attr_category(ch[1])
+                    if cat not in _BENIGN:
+                        return cat
+        return None
+
+    def scan(stmts, ctx):
+        for st in stmts:
+            if isinstance(st, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = (st.targets if isinstance(st, ast.Assign)
+                           else [st.target])
+                value = getattr(st, "value", None)
+                for t in targets:
+                    if isinstance(t, ast.Name) and value is not None:
+                        cat = first_cat(value)
+                        if cat:
+                            aliases.setdefault(t.id, cat)
+                            tainted.add(t.id)
+                        elif ctx:
+                            tainted.add(t.id)
+            if isinstance(st, ast.Expr) and isinstance(st.value, ast.Call):
+                f = st.value.func
+                if isinstance(f, ast.Attribute) and \
+                        isinstance(f.value, ast.Name) and ctx:
+                    tainted.add(f.value.id)
+            sub_ctx = ctx
+            if isinstance(st, ast.For):
+                sub_ctx = ctx or bool(
+                    _state_cats_in(st.iter, aliases, tainted))
+            elif isinstance(st, (ast.If, ast.While)):
+                sub_ctx = ctx or bool(
+                    _state_cats_in(st.test, aliases, tainted)) or \
+                    _is_tainted_test(st.test, aliases, tainted)
+            for field in ("body", "orelse", "finalbody"):
+                inner = getattr(st, field, None)
+                if inner:
+                    scan(inner, sub_ctx)
+            for h in getattr(st, "handlers", ()) or ():
+                scan(h.body, sub_ctx)
+            if isinstance(st, ast.With):
+                pass    # body already covered above
+    scan(fn.body, False)
+    return aliases, tainted
+
+
+# ---------------------------------------------------------------------------
+# guard polarity: does running the guarded body make the guard false?
+# ---------------------------------------------------------------------------
+
+def _guards_of(test, aliases, tainted):
+    """[(cats, polarity)] — polarity 'absent' (test says the state is
+    missing), 'present', or 'taint' (bare state-derived flag)."""
+    out = []
+    if isinstance(test, ast.BoolOp):
+        for v in test.values:
+            out.extend(_guards_of(v, aliases, tainted))
+        return out
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        inner = _guards_of(test.operand, aliases, tainted)
+        flip = {"absent": "present", "present": "absent",
+                "taint": "taint"}
+        return [(c, flip[p]) for c, p in inner]
+    cats = _state_cats_in(test, aliases, tainted)
+    if isinstance(test, ast.Compare) and len(test.ops) == 1:
+        op = test.ops[0]
+        comp = test.comparators[0]
+        none_cmp = isinstance(comp, ast.Constant) and comp.value is None
+        if isinstance(op, ast.NotIn):
+            pol = "absent"
+        elif isinstance(op, ast.In):
+            pol = "present"
+        elif isinstance(op, ast.Is):
+            pol = "absent" if none_cmp else "present"
+        elif isinstance(op, ast.IsNot):
+            pol = "present" if none_cmp else "absent"
+        elif isinstance(op, ast.Eq):
+            pol = "absent" if none_cmp else "present"
+        elif isinstance(op, ast.NotEq):
+            pol = "absent"
+        else:
+            pol = "present"
+        if cats:
+            out.append((cats, pol))
+        elif _is_tainted_test(test, aliases, tainted):
+            out.append((set(), "taint"))
+        return out
+    if cats:
+        out.append((cats, "present"))
+    elif _is_tainted_test(test, aliases, tainted):
+        out.append((set(), "taint"))
+    return out
+
+
+def _quick_muts(stmts, aliases) -> Set[Tuple[str, str]]:
+    """(category, kind) pairs mutated anywhere under `stmts`, without
+    inlining — enough to decide guard invalidation."""
+    muts: Set[Tuple[str, str]] = set()
+
+    def note_target(t, kind):
+        if isinstance(t, ast.Attribute):
+            ch = _chain(t)
+            if ch and ch[0] == "self" and len(ch) >= 2:
+                muts.add((_attr_category(ch[1]), kind))
+        elif isinstance(t, ast.Subscript):
+            note_target(t.value, kind)
+        elif isinstance(t, ast.Name) and t.id in aliases:
+            muts.add((aliases[t.id], kind))
+
+    for st in stmts:
+        for node in ast.walk(st):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    note_target(t, "set")
+            elif isinstance(node, ast.AugAssign):
+                note_target(node.target, "aug")
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    note_target(t, "del")
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute):
+                meth = node.func.attr
+                kind = ("set" if meth in MUT_SET else
+                        "del" if meth in MUT_DEL else
+                        "aug" if meth in MUT_AUG else None)
+                if kind:
+                    ch = _chain(node.func)
+                    if ch and ch[0] == "self" and len(ch) >= 3:
+                        muts.add((_attr_category(ch[1]), kind))
+                    elif ch and len(ch) == 2 and ch[0] in aliases:
+                        muts.add((aliases[ch[0]], kind))
+    return muts
+
+
+def _guard_invalidates(guards, muts) -> bool:
+    """An 'invalidating' guard is one the body's own mutation turns
+    false: absent-polarity + a set of the tested category (JOIN adds
+    the missing member), or present-polarity + a del of it (LEAVE
+    discards the present member).  Bare tainted flags count — they
+    exist only to gate re-application."""
+    for cats, pol in guards:
+        if pol == "taint":
+            return True
+        if pol == "absent" and any(c in cats and k == "set"
+                                   for c, k in muts):
+            return True
+        if pol == "present" and any(c in cats and k == "del"
+                                    for c, k in muts):
+            return True
+    return False
+
+
+def _ends_in_exit(stmts) -> bool:
+    return bool(stmts) and isinstance(stmts[-1], (ast.Return, ast.Raise,
+                                                  ast.Continue))
+
+
+# ---------------------------------------------------------------------------
+# effect walker: one verb branch -> [_Effect], with method inlining
+# ---------------------------------------------------------------------------
+
+class _Walker:
+    def __init__(self, fctx: _FileCtx, facts: _VerbFacts):
+        self.fctx = fctx
+        self.facts = facts
+        self.stack: List[Tuple[str, str]] = []   # (class, method) cycle guard
+
+    def walk_stmts(self, stmts, cls, aliases, tainted, guarded,
+                   scoped_cats, depth, line_override=None):
+        scoped = set(scoped_cats)
+        for st in stmts:
+            self._walk_stmt(st, cls, aliases, tainted, guarded, scoped,
+                            depth, line_override)
+            # sibling terminator: `if <present state test>: return` makes
+            # every LATER same-category "set" effectively run-once
+            if isinstance(st, ast.If) and _ends_in_exit(st.body) \
+                    and not st.orelse:
+                for cats, pol in _guards_of(st.test, aliases, tainted):
+                    if pol == "present":
+                        scoped |= cats
+
+    def _effect(self, cat, kind, guarded, line, via=""):
+        self.facts.effects.append(_Effect(cat, kind, guarded, line, via))
+
+    def _note_calls(self, expr, cls, aliases, guarded, scoped, depth,
+                    line_override):
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            line = line_override or node.lineno
+            if isinstance(f, ast.Attribute):
+                ch = _chain(f)
+                if ch is None:
+                    continue
+                meth = ch[-1]
+                if ch[0] == "self" and len(ch) == 2:
+                    # self.meth(...) — persistence point or inline
+                    if meth in _PERSIST_METHODS:
+                        self.facts.persists.append((line, guarded))
+                        continue
+                    if meth in ("forward",):
+                        self.facts.calls_forward = True
+                    if meth in ("fan_out",):
+                        self.facts.calls_fanout = True
+                    self._inline(cls, meth, node, guarded, scoped,
+                                 depth, line)
+                elif ch[0] == "self" and len(ch) >= 3:
+                    cat = _attr_category(ch[1])
+                    kind = ("set" if meth in MUT_SET else
+                            "del" if meth in MUT_DEL else
+                            "aug" if meth in MUT_AUG else None)
+                    if kind:
+                        self._effect(cat, kind,
+                                     guarded or cat in scoped, line)
+                elif len(ch) == 2:
+                    recv, = ch[:1]
+                    if recv in aliases and (meth in MUT_SET or
+                                            meth in MUT_DEL or
+                                            meth in MUT_AUG):
+                        kind = ("set" if meth in MUT_SET else
+                                "del" if meth in MUT_DEL else "aug")
+                        cat = aliases[recv]
+                        self._effect(cat, kind,
+                                     guarded or cat in scoped, line)
+                    elif meth in _PERSIST_METHODS:
+                        continue
+                    else:
+                        owner, fn = self.fctx.resolve_name_method(meth)
+                        if fn is not None:
+                            if meth == "forward":
+                                self.facts.calls_forward = True
+                            if meth == "fan_out":
+                                self.facts.calls_fanout = True
+                            self._inline(owner, meth, node, guarded,
+                                         scoped, depth, line,
+                                         fn_known=fn)
+            elif isinstance(f, ast.Name):
+                if f.id in aliases:
+                    # calling a state-derived callable (the installed
+                    # updater) applies it: an in-place aug of both its
+                    # source category and any state-aliased args
+                    cat = aliases[f.id]
+                    self._effect(cat, "aug", guarded or cat in scoped,
+                                 line, via=f.id)
+                    for a in node.args:
+                        if isinstance(a, ast.Name) and a.id in aliases:
+                            acat = aliases[a.id]
+                            self._effect(acat, "aug",
+                                         guarded or acat in scoped,
+                                         line, via=f.id)
+
+    def _inline(self, cls, meth, call, guarded, scoped, depth, line,
+                fn_known=None):
+        if depth <= 0 or cls is None:
+            return
+        fn = fn_known or self.fctx.methods.get((cls, meth))
+        if fn is None or (cls, meth) in self.stack:
+            return
+        self.stack.append((cls, meth))
+        try:
+            aliases, tainted = _scan_locals(fn)
+            self.walk_stmts(fn.body, cls, aliases, tainted, guarded,
+                            scoped, depth - 1, line_override=line)
+        finally:
+            self.stack.pop()
+
+    def _note_target(self, t, kind, cls, aliases, guarded, scoped, line):
+        if isinstance(t, ast.Attribute):
+            ch = _chain(t)
+            if ch and ch[0] == "self" and len(ch) >= 2:
+                cat = _attr_category(ch[1])
+                self._effect(cat, kind, guarded or cat in scoped, line)
+        elif isinstance(t, ast.Subscript):
+            self._note_target(t.value, kind, cls, aliases, guarded,
+                              scoped, line)
+        elif isinstance(t, ast.Name) and kind != "set" and \
+                t.id in aliases:
+            cat = aliases[t.id]
+            self._effect(cat, kind, guarded or cat in scoped, line)
+
+    def _walk_stmt(self, st, cls, aliases, tainted, guarded, scoped,
+                   depth, line_override):
+        line = line_override or getattr(st, "lineno", 0)
+        if isinstance(st, ast.Assign):
+            for t in st.targets:
+                self._note_target(t, "set", cls, aliases, guarded,
+                                  scoped, line)
+            self._note_calls(st.value, cls, aliases, guarded, scoped,
+                             depth, line_override)
+        elif isinstance(st, ast.AugAssign):
+            self._note_target(st.target, "aug", cls, aliases, guarded,
+                              scoped, line)
+            self._note_calls(st.value, cls, aliases, guarded, scoped,
+                             depth, line_override)
+        elif isinstance(st, ast.AnnAssign) and st.value is not None:
+            self._note_target(st.target, "set", cls, aliases, guarded,
+                              scoped, line)
+            self._note_calls(st.value, cls, aliases, guarded, scoped,
+                             depth, line_override)
+        elif isinstance(st, ast.Delete):
+            for t in st.targets:
+                self._note_target(t, "del", cls, aliases, guarded,
+                                  scoped, line)
+        elif isinstance(st, (ast.Expr, ast.Return)):
+            if getattr(st, "value", None) is not None:
+                self._note_calls(st.value, cls, aliases, guarded,
+                                 scoped, depth, line_override)
+        elif isinstance(st, ast.If):
+            self._note_calls(st.test, cls, aliases, guarded, scoped,
+                             depth, line_override)
+            guards = _guards_of(st.test, aliases, tainted)
+            body_muts = _quick_muts(st.body, aliases)
+            g_body = guarded or _guard_invalidates(guards, body_muts)
+            self.walk_stmts(st.body, cls, aliases, tainted, g_body,
+                            scoped, depth, line_override)
+            if st.orelse:
+                flip = {"absent": "present", "present": "absent",
+                        "taint": "taint"}
+                inv = [(c, flip[p]) for c, p in guards]
+                or_muts = _quick_muts(st.orelse, aliases)
+                g_or = guarded or _guard_invalidates(inv, or_muts)
+                self.walk_stmts(st.orelse, cls, aliases, tainted, g_or,
+                                scoped, depth, line_override)
+        elif isinstance(st, (ast.While, ast.For)):
+            if isinstance(st, ast.While):
+                self._note_calls(st.test, cls, aliases, guarded, scoped,
+                                 depth, line_override)
+            else:
+                self._note_calls(st.iter, cls, aliases, guarded, scoped,
+                                 depth, line_override)
+            self.walk_stmts(st.body, cls, aliases, tainted, guarded,
+                            scoped, depth, line_override)
+            if st.orelse:
+                self.walk_stmts(st.orelse, cls, aliases, tainted,
+                                guarded, scoped, depth, line_override)
+        elif isinstance(st, ast.With):
+            for item in st.items:
+                self._note_calls(item.context_expr, cls, aliases,
+                                 guarded, scoped, depth, line_override)
+            self.walk_stmts(st.body, cls, aliases, tainted, guarded,
+                            scoped, depth, line_override)
+        elif isinstance(st, ast.Try):
+            for block in (st.body, st.orelse, st.finalbody):
+                if block:
+                    self.walk_stmts(block, cls, aliases, tainted,
+                                    guarded, scoped, depth,
+                                    line_override)
+            for h in st.handlers:
+                self.walk_stmts(h.body, cls, aliases, tainted, guarded,
+                                scoped, depth, line_override)
+        elif isinstance(st, ast.Raise):
+            if st.exc is not None:
+                self._note_calls(st.exc, cls, aliases, guarded, scoped,
+                                 depth, line_override)
+
+
+# ---------------------------------------------------------------------------
+# branch finder + SEQ-layer facts + minted-envelope scan
+# ---------------------------------------------------------------------------
+
+def _verbs_of_test(test, manifest) -> Set[str]:
+    """Verbs this If-test dispatches on: `cmd == "VERB"` or
+    `cmd in ("A", "B")` (constants only — attribute tuples like
+    self._MUTATING are replay metadata, not dispatch)."""
+    out: Set[str] = set()
+    if not (isinstance(test, ast.Compare) and len(test.ops) == 1):
+        return out
+    op = test.ops[0]
+    comp = test.comparators[0]
+    if isinstance(op, ast.Eq):
+        for side in (test.left, comp):
+            if isinstance(side, ast.Constant) and \
+                    isinstance(side.value, str) and side.value in manifest:
+                out.add(side.value)
+    elif isinstance(op, ast.In) and isinstance(comp, (ast.Tuple, ast.List)):
+        for e in comp.elts:
+            if isinstance(e, ast.Constant) and \
+                    isinstance(e.value, str) and e.value in manifest:
+                out.add(e.value)
+    return out
+
+
+def _find_branches(fctx: _FileCtx, manifest):
+    """verb -> (rank, line, body, class, fn) — best dispatch branch per
+    verb across every function in the file (priority order, then file
+    order)."""
+    best: Dict[str, Tuple[int, int, list, Optional[str],
+                          ast.FunctionDef]] = {}
+    for name, cls, fn in fctx.functions:
+        rank = (_BRANCH_PRIORITY.index(name)
+                if name in _BRANCH_PRIORITY else len(_BRANCH_PRIORITY))
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.If):
+                continue
+            for verb in _verbs_of_test(node.test, manifest):
+                cand = (rank, node.lineno, node.body, cls, fn)
+                if verb not in best or cand[:2] < best[verb][:2]:
+                    best[verb] = cand
+    return best
+
+
+def _const_tuple_attr(fctx: _FileCtx, cls: Optional[str], attr: str):
+    """Resolve a class-level `ATTR = ("A", "B")` tuple of constants."""
+    cands = [cls] if cls else []
+    cands += [c for c in fctx.classes if c not in cands]
+    for cname in cands:
+        cdef = fctx.classes.get(cname)
+        if cdef is None:
+            continue
+        for st in cdef.body:
+            if isinstance(st, ast.Assign) and len(st.targets) == 1 and \
+                    isinstance(st.targets[0], ast.Name) and \
+                    st.targets[0].id == attr and \
+                    isinstance(st.value, (ast.Tuple, ast.List)):
+                vals = [e.value for e in st.value.elts
+                        if isinstance(e, ast.Constant)]
+                return set(vals)
+    return None
+
+
+def _seq_facts(fctx: _FileCtx, manifest) -> _SeqFacts:
+    sf = _SeqFacts()
+    for name, cls, fn in fctx.functions:
+        if name != "_handle_seq":
+            continue
+        sf.present = True
+        sf.line = fn.lineno
+        resolve_lines: List[int] = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.If) and \
+                    isinstance(node.test, ast.Compare) and \
+                    len(node.test.ops) == 1:
+                op = node.test.ops[0]
+                comp = node.test.comparators[0]
+                returns_handle = any(
+                    isinstance(s, ast.Return) and
+                    isinstance(s.value, ast.Call) and
+                    isinstance(s.value.func, ast.Attribute) and
+                    s.value.func.attr == "handle"
+                    for s in node.body)
+                if isinstance(op, ast.In) and \
+                        isinstance(comp, (ast.Tuple, ast.List)) and \
+                        returns_handle:
+                    sf.bypass |= {e.value for e in comp.elts
+                                  if isinstance(e, ast.Constant)}
+                elif isinstance(op, ast.NotIn) and \
+                        isinstance(comp, ast.Attribute) and \
+                        returns_handle:
+                    tup = _const_tuple_attr(fctx, cls, comp.attr)
+                    if tup is not None:
+                        sf.cached = set(tup) & set(manifest)
+                        sf.bypass |= set(manifest) - sf.cached
+            elif isinstance(node, ast.Compare) and len(node.ops) == 1 \
+                    and isinstance(node.ops[0], (ast.Lt, ast.LtE)):
+                names = {n.id for n in ast.walk(node)
+                         if isinstance(n, ast.Name)}
+                if "seq" in names:
+                    sf.has_stale = True
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute):
+                if node.func.attr == "set" and \
+                        isinstance(node.func.value, ast.Subscript):
+                    resolve_lines.append(node.lineno)
+                elif node.func.attr in _PERSIST_METHODS:
+                    sf.persist_line = node.lineno
+        if resolve_lines:
+            sf.resolve_line = max(resolve_lines)
+        if sf.persist_line:
+            # the persist is gated on `cmd in self._MUTATING` (or
+            # similar): resolve which verbs actually persist here
+            for node in ast.walk(fn):
+                if isinstance(node, ast.If) and \
+                        isinstance(node.test, ast.Compare) and \
+                        len(node.test.ops) == 1 and \
+                        isinstance(node.test.ops[0], ast.In) and \
+                        isinstance(node.test.comparators[0],
+                                   ast.Attribute) and \
+                        any(isinstance(c, ast.Call) and
+                            isinstance(c.func, ast.Attribute) and
+                            c.func.attr in _PERSIST_METHODS
+                            for s in node.body
+                            for c in ast.walk(s)):
+                    tup = _const_tuple_attr(
+                        fctx, cls, node.test.comparators[0].attr)
+                    if tup is not None:
+                        sf.persist_verbs = set(tup) & set(manifest)
+            if not sf.persist_verbs and sf.cached is not None:
+                sf.persist_verbs = set(sf.cached)
+        if sf.cached is None:
+            sf.cached = set(manifest) - sf.bypass
+        break
+    return sf
+
+
+def _minted_seq_sites(tree) -> List[int]:
+    """Lines where a router builds a fresh ("SEQ", ...) tuple literal
+    and hands it to send_msg — minting its own request identity."""
+    sites: List[int] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        ch = _chain(node.func)
+        if not ch or ch[-1] != "send_msg":
+            continue
+        for a in node.args:
+            if isinstance(a, ast.Tuple) and a.elts and \
+                    isinstance(a.elts[0], ast.Constant) and \
+                    a.elts[0].value == "SEQ":
+                sites.append(node.lineno)
+    return sites
+
+
+def _extract_machine(path, source) -> Optional[_Machine]:
+    """Parse one file; a _Machine when it carries a declare_verbs()
+    manifest, None otherwise.  Raises SyntaxError upward (the caller
+    turns it into a protocol-error diagnostic)."""
+    lines = source.splitlines()
+    tree = ast.parse(source, filename=path)
+    wire = _wire_summary(tree, lines)
+    if not wire.manifest or "protocol" not in wire.meta:
+        return None
+    m = _Machine(path, lines, tree, wire)
+    fctx = _FileCtx(tree)
+    branches = _find_branches(fctx, m.manifest)
+    for verb in sorted(m.manifest):
+        if verb not in branches:
+            m.errors.append(
+                (m.manifest_line,
+                 "verb %s declared in the %s manifest has no dispatch "
+                 "branch in this file" % (verb, m.protocol)))
+            continue
+        rank, line, body, cls, fn = branches[verb]
+        vf = _VerbFacts(verb, line, fn.name)
+        aliases, tainted = _scan_locals(fn)
+        w = _Walker(fctx, vf)
+        w.walk_stmts(body, cls, aliases, tainted, False, set(),
+                     _INLINE_DEPTH)
+        # dedupe (an inlined helper shared by two paths reports once)
+        seen: Set[tuple] = set()
+        vf.effects = [e for e in vf.effects
+                      if not (e.key() in seen or seen.add(e.key()))]
+        m.verbs[verb] = vf
+    m.seq = _seq_facts(fctx, m.manifest)
+    if m.role == "router":
+        m.minted_sites = _minted_seq_sites(tree)
+    return m
+
+
+# ---------------------------------------------------------------------------
+# client-side stream emits (for protocol-stream-dedupe)
+# ---------------------------------------------------------------------------
+
+class _StreamEmit:
+    __slots__ = ("path", "line", "verb", "capable", "snippet")
+
+    def __init__(self, path, line, verb, capable, snippet):
+        self.path, self.line, self.verb = path, line, verb
+        self.capable, self.snippet = capable, snippet
+
+
+def _first_param(fn) -> Optional[str]:
+    args = [a.arg for a in fn.args.args if a.arg not in ("self", "cls")]
+    return args[0] if args else None
+
+
+def _offset_dedupe_capable(fn) -> bool:
+    """The callback dedupes iff its frame-offset (first) parameter
+    participates in the arithmetic that selects fresh tokens — a
+    compare against the high-water mark or an offset subtraction."""
+    p = _first_param(fn)
+    if p is None:
+        return False
+    for node in ast.walk(fn if isinstance(fn, ast.Lambda) else
+                         ast.Module(body=fn.body, type_ignores=[])):
+        if isinstance(node, ast.Compare):
+            if any(isinstance(n, ast.Name) and n.id == p
+                   for n in ast.walk(node)):
+                return True
+        elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+            if any(isinstance(n, ast.Name) and n.id == p
+                   for n in ast.walk(node)):
+                return True
+    return False
+
+
+def _resolve_stream_callable(value, tree):
+    """on_stream=<value> -> the FunctionDef/Lambda it names (through an
+    IfExp's truthy arm), or None when unresolvable."""
+    if isinstance(value, ast.IfExp):
+        value = value.body
+    if isinstance(value, ast.Lambda):
+        return value
+    name = None
+    if isinstance(value, ast.Name):
+        name = value.id
+    elif isinstance(value, ast.Attribute):
+        name = value.attr
+    if name is None:
+        return None
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == name:
+            return node
+    return None
+
+
+def _scan_stream_emits(path, tree, lines, stream_verbs) -> List[_StreamEmit]:
+    out: List[_StreamEmit] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        a0 = node.args[0]
+        if not (isinstance(a0, ast.Constant) and a0.value in stream_verbs):
+            continue
+        for kw in node.keywords:
+            if kw.arg != "on_stream":
+                continue
+            if isinstance(kw.value, ast.Constant) and \
+                    kw.value.value is None:
+                continue
+            fn = _resolve_stream_callable(kw.value, tree)
+            capable = fn is not None and _offset_dedupe_capable(fn)
+            snippet = (lines[node.lineno - 1]
+                       if 1 <= node.lineno <= len(lines) else "")
+            out.append(_StreamEmit(path, node.lineno, a0.value,
+                                   capable, snippet))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# static rules over extracted machines
+# ---------------------------------------------------------------------------
+
+def _diag(rule, m: _Machine, line, message) -> Diagnostic:
+    return Diagnostic(rule, m.path, line or m.manifest_line or 1, 0,
+                      message, m.line_text(line or m.manifest_line))
+
+
+def _nonbenign_cats(vf: _VerbFacts) -> Set[str]:
+    return {e.category for e in vf.effects if e.category not in _BENIGN}
+
+
+def _static_checks(m: _Machine) -> Iterator[Diagnostic]:
+    for line, msg in m.errors:
+        yield _diag(RULE_ERROR, m, line, msg)
+    for verb in sorted(m.manifest):
+        row = m.manifest[verb]
+        vf = m.verbs.get(verb)
+        declared_replay = row.get("replay")
+        semantics = row.get("semantics")
+        declared_mutates = tuple(row.get("mutates") or ())
+        vline = vf.line if vf else m.manifest_line
+
+        # -- protocol-replay-class ------------------------------------------
+        if m.role in ("server", "collector"):
+            if m.seq.present:
+                extracted = ("bypass" if verb in m.seq.bypass
+                             else "cached")
+                if declared_replay == "cached" and extracted == "bypass":
+                    yield _diag(
+                        RULE_REPLAY, m, m.seq.line,
+                        "%s.%s is declared replay=cached but the SEQ "
+                        "layer bypasses the replay cache for it — a "
+                        "reconnect replay re-executes the request"
+                        % (m.protocol, verb))
+                elif declared_replay == "bypass" and \
+                        extracted == "cached":
+                    yield _diag(
+                        RULE_REPLAY, m, m.seq.line,
+                        "%s.%s is declared replay=bypass but the SEQ "
+                        "layer caches it — the manifest misdescribes "
+                        "the machine" % (m.protocol, verb))
+            elif declared_replay == "cached":
+                yield _diag(
+                    RULE_REPLAY, m, vline,
+                    "%s.%s is declared replay=cached but this machine "
+                    "has no _handle_seq replay layer at all"
+                    % (m.protocol, verb))
+            if semantics == "replayable" and declared_mutates and \
+                    declared_replay != "cached":
+                yield _diag(
+                    RULE_REPLAY, m, vline,
+                    "%s.%s mutates %s and is replayable but sits "
+                    "outside the replay cache (replay=%s): retried "
+                    "mutations double-apply"
+                    % (m.protocol, verb, ",".join(declared_mutates),
+                       declared_replay))
+        elif m.role == "router" and vf is not None:
+            routed = vf.calls_forward or vf.calls_fanout
+            if declared_replay == "forward" and not routed:
+                yield _diag(
+                    RULE_REPLAY, m, vline,
+                    "%s.%s is declared replay=forward but its dispatch "
+                    "branch never forwards/fans-out the envelope"
+                    % (m.protocol, verb))
+            if declared_replay == "local" and routed:
+                yield _diag(
+                    RULE_REPLAY, m, vline,
+                    "%s.%s is declared replay=local but its branch "
+                    "forwards upstream" % (m.protocol, verb))
+
+        # -- protocol-idempotent-epoch --------------------------------------
+        if vf is not None and semantics == "idempotent":
+            for e in vf.effects:
+                if e.category == "epoch" and e.kind == "aug" and \
+                        not e.guarded:
+                    yield _diag(
+                        RULE_EPOCH, m, e.line,
+                        "%s.%s is declared idempotent but bumps the "
+                        "membership epoch unconditionally — its no-op "
+                        "path must leave the epoch alone (PR-16 "
+                        "membership contract)" % (m.protocol, verb))
+
+        # -- protocol-effects-drift -----------------------------------------
+        if vf is not None:
+            extracted_cats = _nonbenign_cats(vf)
+            for cat in sorted(extracted_cats):
+                if cat not in declared_mutates:
+                    where = min(e.line for e in vf.effects
+                                if e.category == cat)
+                    yield _diag(
+                        RULE_EFFECTS, m, where,
+                        "%s.%s handler mutates state category %r not "
+                        "declared in its manifest mutates tuple"
+                        % (m.protocol, verb, cat))
+            for cat in declared_mutates:
+                if cat not in extracted_cats:
+                    yield _diag(
+                        RULE_EFFECTS, m, vline,
+                        "%s.%s declares mutates=%r but the handler "
+                        "branch never touches that category"
+                        % (m.protocol, verb, cat))
+
+    # -- protocol-reply-order ----------------------------------------------
+    sf = m.seq
+    if sf.present and sf.persist_line and sf.resolve_line and \
+            sf.persist_line < sf.resolve_line:
+        risky = sorted(
+            v for v in (sf.persist_verbs or set(m.manifest))
+            if v in m.verbs and any(
+                e.kind == "aug" and not e.guarded and
+                e.category not in _BENIGN
+                for e in m.verbs[v].effects))
+        if risky:
+            yield _diag(
+                RULE_ORDER, m, sf.persist_line,
+                "%s SEQ layer persists (line %d) BEFORE resolving the "
+                "replay entry (line %d): a crash between the two "
+                "snapshots the applied effect without its cache entry, "
+                "so reconnect replay double-applies %s"
+                % (m.protocol, sf.persist_line, sf.resolve_line,
+                   ",".join(risky)))
+
+    # -- protocol-router-verbatim -------------------------------------------
+    if m.role == "router":
+        for line in sorted(m.minted_sites):
+            yield _diag(
+                RULE_VERBATIM, m, line,
+                "%s router builds its own (\"SEQ\", ...) envelope "
+                "instead of forwarding the client's verbatim — a "
+                "minted (cid, seq) defeats every replica's replay "
+                "cache" % m.protocol)
+
+
+# ---------------------------------------------------------------------------
+# model checker: exhaustive bounded fault schedules on a virtual clock
+# ---------------------------------------------------------------------------
+#
+# The simulated server holds per-(request, category) application counts;
+# one handler execution applies each category's delta once (an unguarded
+# aug adds 1 per execution, anything guarded or set-like lands at 1 no
+# matter how often it re-runs).  The declared property is asserted on
+# every terminal state:
+#   replayable / idempotent : every category count <= 1, == 1 after a
+#                             delivered success (crash schedules allow
+#                             the documented bounded-loss 0)
+#   stateless (mutates=())  : no non-benign category ever counts > 0
+# Everything iterates over sorted/static structures — the schedule count
+# is a pure function of the shipped tree and is pinned by the tests.
+
+_CLIENT_PREFIX = ("drop", "replydrop", "dup")
+_CLIENT_FINAL = ("ok", "dupok")
+
+
+class _VerbDelta:
+    """Per-execution state delta of one verb, in model terms."""
+
+    __slots__ = ("aug_cats", "set_cats")
+
+    def __init__(self, vf: Optional[_VerbFacts]):
+        self.aug_cats: Set[str] = set()
+        self.set_cats: Set[str] = set()
+        for e in (vf.effects if vf else ()):
+            if e.category in _BENIGN or e.category.startswith("other:"):
+                continue
+            if e.kind == "aug" and not e.guarded:
+                self.aug_cats.add(e.category)
+            else:
+                self.set_cats.add(e.category)
+        self.aug_cats -= set()
+        self.set_cats -= self.aug_cats
+
+    @property
+    def cats(self):
+        return self.aug_cats | self.set_cats
+
+
+class _ServerSim:
+    """One simulated server: replay cache (latest seq per client, like
+    the real single-entry-per-cid caches) + per-(seq, cat) counts +
+    optional snapshot durability."""
+
+    def __init__(self, cached: bool, durable: bool, has_stale: bool):
+        self.cached = cached
+        self.durable = durable
+        self.has_stale = has_stale
+        self.counts: Dict[Tuple[int, str], int] = {}
+        self.entry: Optional[List] = None       # [seq, resolved]
+        self.snap = ({}, None)                  # (counts, resolved entry)
+        self.execs = 0
+
+    def _apply(self, seq: int, delta: _VerbDelta):
+        self.execs += 1
+        for c in sorted(delta.aug_cats):
+            self.counts[(seq, c)] = self.counts.get((seq, c), 0) + 1
+        for c in sorted(delta.set_cats):
+            self.counts[(seq, c)] = 1
+
+    def persist(self):
+        ent = None
+        if self.entry is not None and self.entry[1]:
+            ent = list(self.entry)
+        self.snap = (dict(self.counts), ent)
+
+    def crash_restore(self):
+        counts, ent = self.snap
+        self.counts = dict(counts)
+        self.entry = list(ent) if ent is not None else None
+
+    def deliver(self, seq: int, delta: _VerbDelta,
+                steps: Sequence[str], crash_after: int = -1) -> bool:
+        """One request delivery; returns True when it replied (from
+        cache or fresh execution).  ``crash_after`` crashes (and
+        restores from snapshot) after that many micro-steps."""
+        if self.cached:
+            if self.entry is not None and self.entry[0] == seq:
+                if self.entry[1]:
+                    return True                 # replayed from cache
+            elif self.entry is not None and seq < self.entry[0] \
+                    and self.has_stale:
+                return True                     # stale-rejected (error reply)
+            else:
+                self.entry = [seq, False]
+        done = 0
+        for step in steps:
+            if crash_after >= 0 and done >= crash_after:
+                self.crash_restore()
+                return False
+            if step == "apply":
+                self._apply(seq, delta)
+            elif step == "resolve":
+                if self.cached and self.entry is not None and \
+                        self.entry[0] == seq:
+                    self.entry[1] = True
+            elif step == "persist":
+                if self.durable:
+                    self.persist()
+            done += 1
+        if crash_after >= 0 and done >= crash_after:
+            self.crash_restore()
+            return False
+        return True
+
+
+def _micro_steps(m: _Machine, verb: str) -> List[str]:
+    """Ordered micro-steps of one fresh execution: the branch's apply
+    and any in-branch persist (by line), then the SEQ layer's resolve /
+    persist in their extracted order."""
+    vf = m.verbs.get(verb)
+    branch_events: List[Tuple[int, str]] = []
+    if vf is not None and vf.effects:
+        branch_events.append(
+            (min(e.line for e in vf.effects), "apply"))
+    for line, _guarded in (vf.persists if vf else ()):
+        branch_events.append((line, "persist"))
+    seq_events: List[Tuple[int, str]] = []
+    if m.seq.present and m.seq.resolve_line:
+        seq_events.append((m.seq.resolve_line, "resolve"))
+    if m.seq.present and m.seq.persist_line and \
+            verb in (m.seq.persist_verbs or set()):
+        seq_events.append((m.seq.persist_line, "persist"))
+    steps = [ev for _l, ev in sorted(branch_events)] + \
+            [ev for _l, ev in sorted(seq_events)]
+    if "resolve" not in steps:
+        steps.append("resolve")
+    return steps
+
+
+def _check_counts(m, verb, row, sim: _ServerSim, schedule,
+                  delivered: bool, crashed: bool):
+    """Assert the declared property on one terminal state; yields
+    violation messages."""
+    semantics = row.get("semantics")
+    stateless = not tuple(row.get("mutates") or ())
+    for (seq, cat), n in sorted(sim.counts.items()):
+        if n > 1:
+            yield ("%s.%s (%s): request seq=%d applied %dx to %r "
+                   "under schedule %s — %s requires exactly-once"
+                   % (m.protocol, verb, semantics, seq, n, cat,
+                      "/".join(schedule), semantics))
+        elif stateless and n > 0:
+            yield ("%s.%s declares no mutations but schedule %s left "
+                   "%r mutated" % (m.protocol, verb,
+                                   "/".join(schedule), cat))
+    if delivered and not crashed and not stateless:
+        delta = _VerbDelta(m.verbs.get(verb))
+        for cat in sorted(delta.cats):
+            if sim.counts.get((1, cat), 0) != 1:
+                yield ("%s.%s: delivered success under schedule %s "
+                       "left %r un-applied (lost effect)"
+                       % (m.protocol, verb, "/".join(schedule), cat))
+
+
+def _client_schedules():
+    """All bounded single-client retry schedules: up to two failed
+    attempts, then a final delivered one."""
+    prefixes = [()]
+    for a in _CLIENT_PREFIX:
+        prefixes.append((a,))
+        for b in _CLIENT_PREFIX:
+            prefixes.append((a, b))
+    for pre in prefixes:
+        for fin in _CLIENT_FINAL:
+            yield pre + (fin,)
+
+
+def _run_single_client(m, verb, row, cached) -> Iterator[Tuple]:
+    """(schedule, sim, delivered, crashed) per terminal state."""
+    delta = _VerbDelta(m.verbs.get(verb))
+    steps = _micro_steps(m, verb)
+    for sched in _client_schedules():
+        sim = _ServerSim(cached, m.durable, m.seq.has_stale)
+        delivered = False
+        for act in sched:
+            if act == "drop":
+                continue
+            if act in ("replydrop", "ok"):
+                replied = sim.deliver(1, delta, steps)
+                delivered = replied and act == "ok"
+            elif act in ("dup", "dupok"):
+                sim.deliver(1, delta, steps)
+                replied = sim.deliver(1, delta, steps)
+                delivered = replied and act == "dupok"
+        yield (sched, sim, delivered, False)
+
+
+def _run_crash(m, verb, row, cached) -> Iterator[Tuple]:
+    """Crash-restart schedules (durable machines only): attempt 1
+    crashes after each micro-step boundary, the server restores from
+    its last snapshot, and the client replays the same seq."""
+    delta = _VerbDelta(m.verbs.get(verb))
+    steps = _micro_steps(m, verb)
+    for point in range(len(steps) + 1):
+        sim = _ServerSim(cached, True, m.seq.has_stale)
+        sim.deliver(1, delta, steps, crash_after=point)
+        sim.deliver(1, delta, steps)
+        label = ("crash@%d" % point, "retry")
+        yield (label, sim, True, True)
+
+
+def _run_stale(m, verb, row) -> Iterator[Tuple]:
+    """An old connection's duplicate of an ALREADY superseded request
+    arrives after a newer one executed: it must be rejected as stale,
+    never re-executed (the cache only remembers the newest seq)."""
+    delta = _VerbDelta(m.verbs.get(verb))
+    steps = _micro_steps(m, verb)
+    for variant in ("dup-after-newer", "dup-after-newer-replydrop"):
+        sim = _ServerSim(True, m.durable, m.seq.has_stale)
+        sim.deliver(1, delta, steps)            # request 1 executes
+        sim.deliver(2, delta, steps)            # request 2 supersedes it
+        sim.deliver(1, delta, steps)            # late duplicate of 1
+        yield ((variant,), sim, True, False)
+
+
+def _run_router(m, verb, row, fanout: bool) -> Iterator[Tuple]:
+    """Forward/fan-out schedules over two replicas, each with its own
+    replay cache.  verbatim => every hop carries the client's (cid,
+    seq); minted => the router stamps a fresh seq per send, so no
+    replica can ever dedupe."""
+    minted = bool(m.minted_sites)
+    delta = _VerbDelta(m.verbs.get(verb))
+    # remote execution delta: the forwarded verb's effect lands on the
+    # replica; model it as one opaque unguarded application per fresh seq
+    remote = _VerbDelta(None)
+    remote.aug_cats = {"remote"}
+
+    def fresh_seq(counter):
+        counter[0] += 1
+        return counter[0] + 100
+
+    if fanout:
+        plans = [("once",), ("once", "client-retry")]
+    else:
+        plans = [("A:ok",), ("A:dup",),
+                 ("A:connfail-pre", "B:ok"), ("A:connfail-pre", "B:dup"),
+                 ("A:connfail-post", "B:ok"),
+                 ("A:connfail-post", "B:dup")]
+    for plan in plans:
+        reps = {"A": _ServerSim(True, False, True),
+                "B": _ServerSim(True, False, True)}
+        counter = [0]
+        if fanout:
+            for hop in plan:
+                for name in sorted(reps):
+                    seq = fresh_seq(counter) if minted else 1
+                    reps[name].deliver(seq, remote, ["apply", "resolve"])
+        else:
+            for hop in plan:
+                name, outcome = hop.split(":")
+                seq = fresh_seq(counter) if minted else 1
+                if outcome == "connfail-pre":
+                    continue
+                reps[name].deliver(seq, remote, ["apply", "resolve"])
+                if outcome == "dup":
+                    seq2 = fresh_seq(counter) if minted else 1
+                    reps[name].deliver(seq2, remote,
+                                       ["apply", "resolve"])
+        for name in sorted(reps):
+            if reps[name].execs > 1:
+                yield (plan, name, reps[name].execs)
+
+
+def _model_check(m: _Machine) -> Tuple[List[Diagnostic], int]:
+    diags: List[Diagnostic] = []
+    schedules = 0
+    for verb in sorted(m.manifest):
+        if verb not in m.verbs:
+            continue                    # protocol-error already raised
+        row = m.manifest[verb]
+        vline = m.verbs[verb].line
+        msgs: List[str] = []
+        if m.role in ("server", "collector"):
+            cached = m.seq.present and verb not in m.seq.bypass
+            for sched, sim, delivered, crashed in \
+                    _run_single_client(m, verb, row, cached):
+                schedules += 1
+                msgs.extend(_check_counts(m, verb, row, sim, sched,
+                                          delivered, crashed))
+            if cached and m.seq.present:
+                for sched, sim, delivered, crashed in \
+                        _run_stale(m, verb, row):
+                    schedules += 1
+                    msgs.extend(_check_counts(m, verb, row, sim, sched,
+                                              delivered, crashed))
+            if cached and m.durable:
+                for sched, sim, delivered, crashed in \
+                        _run_crash(m, verb, row, cached):
+                    schedules += 1
+                    msgs.extend(_check_counts(m, verb, row, sim, sched,
+                                              delivered, crashed))
+        elif m.role == "router":
+            vf = m.verbs[verb]
+            if vf.calls_forward or vf.calls_fanout:
+                plans = 2 if vf.calls_fanout and not vf.calls_forward \
+                    else 6
+                schedules += plans
+                for plan, rep, execs in _run_router(
+                        m, verb, row, fanout=vf.calls_fanout and
+                        not vf.calls_forward):
+                    msgs.append(
+                        "%s.%s: replica %s executed one client request "
+                        "%dx under schedule %s — the router must "
+                        "forward (cid, seq) verbatim so replica replay "
+                        "caches dedupe"
+                        % (m.protocol, verb, rep, execs,
+                           "/".join(plan)))
+            else:
+                for sched, sim, delivered, crashed in \
+                        _run_single_client(m, verb, row, False):
+                    schedules += 1
+                    msgs.extend(_check_counts(m, verb, row, sim, sched,
+                                              delivered, crashed))
+        # one diagnostic per distinct violation message, anchored on the
+        # verb's dispatch line (distinct snippet => distinct fingerprint)
+        for msg in sorted(set(msgs)):
+            diags.append(_diag(RULE_MODEL, m, vline, msg))
+    return diags, schedules
+
+
+# ---------------------------------------------------------------------------
+# lane driver
+# ---------------------------------------------------------------------------
+
+def check_sources(sources: Dict[str, str],
+                  select: Optional[Set[str]] = None):
+    """Run the protocol lane over a {repo-relative path: source} map.
+
+    Returns ``(diags, stats)``: suppression-filtered diagnostics (this
+    lane has NO baseline — findings are fix-or-suppress-with-why) and
+    a stats dict with machine/verb/schedule counts.  Files without a
+    declare_verbs() manifest only contribute client-side emit facts.
+    """
+    machines: List[_Machine] = []
+    diags: List[Diagnostic] = []
+    supp: Dict[str, Tuple[dict, set]] = {}
+    parsed: Dict[str, Tuple[ast.AST, List[str]]] = {}
+    for path in sorted(sources):
+        src = sources[path]
+        path = path.replace(os.sep, "/")
+        lines = src.splitlines()
+        supp[path] = _parse_suppressions(lines)
+        try:
+            m = _extract_machine(path, src)
+        except SyntaxError as e:
+            diags.append(Diagnostic(
+                RULE_ERROR, path, e.lineno or 1, 0,
+                "file does not parse: %s" % e.msg))
+            continue
+        parsed[path] = (ast.parse(src, filename=path)
+                        if m is None else m.tree, lines)
+        if m is not None:
+            machines.append(m)
+    schedules = 0
+    for m in machines:
+        diags.extend(_static_checks(m))
+        model_diags, n = _model_check(m)
+        diags.extend(model_diags)
+        schedules += n
+    # stream verbs come from the manifests; their emit sites can live in
+    # ANY scanned file (the serve client) — check each site once
+    stream_verbs: Set[str] = set()
+    for m in machines:
+        for verb, row in m.manifest.items():
+            if row.get("stream"):
+                stream_verbs.add(verb)
+    if stream_verbs:
+        seen_sites: Set[Tuple[str, int]] = set()
+        for path in sorted(parsed):
+            tree, lines = parsed[path]
+            for em in _scan_stream_emits(path, tree, lines,
+                                         stream_verbs):
+                if (em.path, em.line) in seen_sites:
+                    continue
+                seen_sites.add((em.path, em.line))
+                if not em.capable:
+                    diags.append(Diagnostic(
+                        RULE_STREAM, em.path, em.line, 0,
+                        "%s is a stream verb but this on_stream "
+                        "callback never consults its frame offset — "
+                        "replayed connections resend STREAM frames and "
+                        "the client would apply tokens twice" % em.verb,
+                        em.snippet))
+    if select is not None:
+        diags = [d for d in diags if d.rule in select]
+    out = []
+    for d in diags:
+        per_line, per_file = supp.get(d.path, ({}, set()))
+        if not _suppressed(d, per_line, per_file):
+            out.append(d)
+    out.sort(key=lambda d: (d.path, d.line, d.rule, d.message))
+    stats = {
+        "machines": [
+            {"protocol": m.protocol, "path": m.path, "role": m.role,
+             "durable": m.durable, "verbs": len(m.manifest)}
+            for m in machines],
+        "verbs": sum(len(m.manifest) for m in machines),
+        "schedules": schedules,
+    }
+    return out, stats
+
+
+def check_paths(paths: Sequence[str], root: Optional[str] = None,
+                select: Optional[Set[str]] = None):
+    if root is None:
+        root = repo_root_of(paths[0] if paths else ".") or os.getcwd()
+    sources: Dict[str, str] = {}
+    for fp in iter_py_files(paths):
+        rel = os.path.relpath(os.path.abspath(fp),
+                              root).replace(os.sep, "/")
+        with open(fp, encoding="utf-8") as f:
+            sources[rel] = f.read()
+    return check_sources(sources, select=select)
+
+
+def run_cli(paths: Sequence[str], fmt: str = "text",
+            select: Optional[Set[str]] = None, out=None) -> int:
+    """--protocol entry point.  Exit 0 clean, 1 findings, 2 lane
+    errors (unparseable machine / undeclared branch).  No baseline:
+    every finding is fix-now or suppress-with-why."""
+    import sys
+    out = out or sys.stdout
+    diags, stats = check_paths(list(paths), select=select)
+    errors = [d for d in diags if d.rule == RULE_ERROR]
+    if fmt == "json":
+        json.dump({
+            "protocol_schema": 1,
+            "machines": stats["machines"],
+            "verbs": stats["verbs"],
+            "schedules": stats["schedules"],
+            "violations": [d.to_json() for d in diags],
+        }, out, indent=1, sort_keys=True)
+        out.write("\n")
+    else:
+        for d in diags:
+            out.write("%s\n" % d)
+        for mrow in stats["machines"]:
+            out.write("protocol: %-8s %-28s role=%-9s durable=%-5s "
+                      "%2d verbs\n"
+                      % (mrow["protocol"], mrow["path"], mrow["role"],
+                         mrow["durable"], mrow["verbs"]))
+        out.write("protocol: %d machine(s), %d verb(s), %d fault "
+                  "schedule(s) checked, %d violation(s)\n"
+                  % (len(stats["machines"]), stats["verbs"],
+                     stats["schedules"], len(diags)))
+    if errors:
+        return 2
+    return 1 if diags else 0

@@ -901,3 +901,52 @@ class RetraceHazard(Rule):
                         "distinct value retraces; pass a jnp array "
                         "or mark the position static"
                         % (val, qual))
+
+
+# ---------------------------------------------------------------------------
+# wire-manifest-schema (PR 19 satellite): the four shipped protocol
+# machines must declare their WIRE_VERBS through the shared
+# declare_verbs() schema helper — a bare dict has no vocabulary
+# validation and is invisible to the --protocol verifier.
+# ---------------------------------------------------------------------------
+
+@register_rule
+class WireManifestSchema(Rule):
+    id = "wire-manifest-schema"
+    description = ("shipped WIRE_VERBS manifests must go through "
+                   "kvstore.wire_verbs.declare_verbs (schema-validated, "
+                   "protocol-verifier visible), not a bare dict")
+    invariant_from = "PR 19"
+    path_patterns = ("mxnet_tpu/kvstore/server.py",
+                     "mxnet_tpu/serve/server.py",
+                     "mxnet_tpu/serve/router.py",
+                     "mxnet_tpu/fleet.py")
+
+    def check(self, ctx: FileContext) -> Iterator[Diagnostic]:
+        for node in ast.walk(ctx.tree):
+            target = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                target = node.targets[0].id
+            elif isinstance(node, ast.AnnAssign) and \
+                    isinstance(node.target, ast.Name):
+                target = node.target.id
+            if target != "WIRE_VERBS":
+                continue
+            val = getattr(node, "value", None)
+            is_declared = (isinstance(val, ast.Call) and
+                           _attr_chain(val.func) is not None and
+                           _attr_chain(val.func)[-1] == "declare_verbs")
+            if not is_declared:
+                yield ctx.diag(
+                    self.id, node,
+                    "WIRE_VERBS here must be built by declare_verbs() "
+                    "from mxnet_tpu/kvstore/wire_verbs.py — a bare "
+                    "dict skips schema validation and hides this "
+                    "machine from `python -m tools.mxlint --protocol`")
+
+
+# registered last so --list-rules / --select see the --protocol lane's
+# rule ids (scope='protocol': skipped by the file and project passes,
+# executed only inside tools/mxlint/protocol.py's check_sources)
+from . import protocol as _protocol  # noqa: E402,F401
