@@ -1221,6 +1221,16 @@ def run_decode_bench(n_gens=None, rate=None):
     * ``admission`` — the census-pinned equal-HBM capacity story:
       at byte-identical KV pools the paged heap runs the mixed-length
       admission >= 4x as wide as flat slots allow.
+
+    ISSUE 20 adds the SPECULATIVE lane: the draft-friendly demo LM
+    (a deep target whose step is KV-gather-bound over a long paged
+    extent, plus its 1-layer draft prefix) decodes the identical
+    closed-loop workload through the plain paged engine and through
+    the speculative engine (k draft dispatches + ONE k+1-position
+    verify dispatch per window).  Request-level tokens/sec must come
+    out >= 2x, tokens must match the plain paged lane ELEMENT-WISE
+    (speculative greedy output is bit-identical by construction), the
+    page heap must stay flat, and warm retraces must stay zero.
     """
     import numpy as np
     from mxnet_tpu import telemetry
@@ -1418,11 +1428,109 @@ def run_decode_bench(n_gens=None, rate=None):
                        and correct),
         }
 
+    def run_speculative_bench():
+        # the speculative headline (ISSUE 20): the target is sized so
+        # a decode step is KV-GATHER-bound — a deep model over a long
+        # paged extent, the regime a real memory-bandwidth-bound TPU
+        # decode step lives in — so the 1-layer draft costs ~1/24th of
+        # a target step and the k+1-position verify costs ~one step
+        # (the per-lane page gather is shared across window positions):
+        # k committed tokens for ~2 target-steps' worth of HBM traffic.
+        from mxnet_tpu.serve.decode import (DraftDecodeServable,
+                                            SpeculativeDecodeBatcher,
+                                            demo_spec_pair)
+        sk = int(os.environ.get("MX_BENCH_SPEC_K", 8))
+        s_gens = int(os.environ.get("MX_BENCH_SPEC_GENS", 8))
+        s_new = int(os.environ.get("MX_BENCH_SPEC_NEW", 72))
+        scfg = DecodeConfig(dim=64, heads=4, layers=24, slots=4,
+                            max_tokens=1024, prompt_buckets=(8, 16),
+                            kv_page_len=64, kv_pages=96,
+                            prefill_chunk=16, spec_k=sk)
+        tparams, dcfg, dparams = demo_spec_pair(scfg, draft_layers=1)
+        srng = np.random.RandomState(20)
+        sprompts = [[int(t) for t in srng.randint(2, scfg.vocab,
+                                                  size=12)]
+                    for _ in range(s_gens)]
+
+        def lane(spec):
+            sv = PagedDecodeServable(params=tparams, config=scfg)
+            if spec:
+                draft = DraftDecodeServable(params=dparams, config=dcfg,
+                                            name="demo-lm-draft")
+                eng = SpeculativeDecodeBatcher(sv, draft,
+                                               queue_cap=s_gens + 8)
+            else:
+                eng = PagedDecodeBatcher(sv, queue_cap=s_gens + 8)
+            for g in [eng.submit([3, 4, 5], max_new=8)
+                      for _ in range(4)]:
+                g.result(timeout=600)
+            kv0 = sv.kv_state_bytes()
+            retr0 = sv.retraces + (eng.draft.retraces if spec else 0)
+            w0 = reg.value("serve.decode.spec_windows")
+            d0 = reg.value("serve.decode.draft_steps")
+            # closed-loop request-level throughput, min wall of two
+            # measured passes: greedy decode is deterministic so both
+            # passes emit identical tokens — the min isolates engine
+            # cost from bench-box scheduling noise
+            best, outs = None, None
+            for _ in range(2):
+                t0 = time.perf_counter()
+                gens = [eng.submit(p, max_new=s_new) for p in sprompts]
+                pass_outs = [g.result(timeout=600) for g in gens]
+                wall = time.perf_counter() - t0
+                if best is None or wall < best:
+                    best, outs = wall, pass_outs
+            tokens = sum(len(o) for o in outs)
+            lane_rec = {
+                "tokens": tokens,
+                "wall_s": round(best, 3),
+                "tokens_per_sec": round(tokens / best, 2),
+                "kv_pool_flat": bool(sv.kv_state_bytes() == kv0),
+                "retraces_after_warmup":
+                    sv.retraces + (eng.draft.retraces if spec else 0)
+                    - retr0,
+            }
+            if spec:
+                windows = reg.value("serve.decode.spec_windows") - w0
+                lane_rec["spec_windows"] = windows
+                lane_rec["draft_steps"] = \
+                    reg.value("serve.decode.draft_steps") - d0
+                st = eng.page_stats()
+                lane_rec["engine"] = st["engine"]
+                lane_rec["draft_model"] = st["draft_model"]
+            eng.close()
+            return lane_rec, outs
+
+        base_rec, base_outs = lane(spec=False)
+        spec_rec, spec_outs = lane(spec=True)
+        ratio = (spec_rec["tokens_per_sec"]
+                 / max(1e-9, base_rec["tokens_per_sec"]))
+        parity = bool(spec_outs == base_outs)
+        return {
+            "spec_k": sk,
+            "target_layers": scfg.layers,
+            "draft_layers": dcfg.layers,
+            "kv_extent_tokens": scfg.max_tokens,
+            "generations": s_gens,
+            "max_new": s_new,
+            "paged_baseline": base_rec,
+            "speculative": spec_rec,
+            "request_speedup": round(ratio, 2),
+            "parity": parity,
+            "kv_pool_flat": bool(base_rec["kv_pool_flat"]
+                                 and spec_rec["kv_pool_flat"]),
+            "zero_retraces": bool(
+                base_rec["retraces_after_warmup"] == 0
+                and spec_rec["retraces_after_warmup"] == 0),
+            "speedup_ok": bool(ratio >= 2.0 and parity),
+        }
+
     cont, cont_outs = run_lane("continuous")
     req, _ = run_lane("request")
     paged_lane, paged_outs = run_lane("continuous", paged=True)
     shared = run_shared_prefix_bench()
     admission = run_admission_bench()
+    speculative = run_speculative_bench()
     speedup = cont["tokens_per_sec"] / max(1e-9, req["tokens_per_sec"])
     report = {
         "metric": "serve_decode_tokens_per_sec",
@@ -1451,6 +1559,7 @@ def run_decode_bench(n_gens=None, rate=None):
             },
             "shared_prefix": shared,
             "admission": admission,
+            "speculative": speculative,
         },
         "phases": {k: v for k, v in telemetry.phase_snapshot().items()
                    if k in ("prefill", "decode_step", "kv_evict")},

@@ -116,6 +116,9 @@ ENV_CATALOG: Dict[str, Any] = {
     "MX_SERVE_KV_PAGE_LEN": ("0", "Paged decode engine: token positions per physical KV page.  0 (default) inherits MX_SERVE_DECODE_PAGE.  Smaller pages pack mixed-length sessions tighter and share longer prefixes (only FULL pages are hash-shared); larger pages cut block-table and gather overhead."),
     "MX_SERVE_PREFIX_SHARE": ("1", "Paged decode engine: 1 (default) hash-shares read-only full prompt pages across sessions - a rolling content hash over token ids is chained at page boundaries, equal hashes adopt the donor's pages via refcounts, and a session diverging inside a shared page forks it copy-on-write - so N sessions over one system prompt prefill only their suffixes.  0 disables sharing (every admission prefills all its pages)."),
     "MX_SERVE_PREFILL_CHUNK": ("0", "Paged decode engine: prefill chunk length in token positions (rounded up to whole pages; 0 = one page).  Long prompts prefill as a train of page-aligned chunks that INTERLEAVE with decode steps inside the pump's one-dispatch-per-tick cadence, so a 10k-token admission never stalls in-flight generations for more than one chunk-step."),
+    "MX_SERVE_SPEC_K": ("4", "Speculative decoding (ISSUE 20): tokens the draft model proposes per speculative window.  Each window costs spec_k draft dispatches (on the draft's own tiny KV pool) + ONE multi-position verify dispatch on the paged target, which accepts the longest agreeing prefix and emits the target's own argmax after it - so 1..spec_k tokens commit per verify with output BIT-IDENTICAL to non-speculative greedy decode regardless of draft quality.  Clamped to [1, 8] (the page-overrun margin the verify scatter needs)."),
+    "MX_SERVE_DRAFT": ("0", "Speculative decoding: number of layers in the built-in draft model for 'python -m mxnet_tpu.serve --decode'.  > 0 co-hosts a shallow draft (the target demo LM's first N layers, shared embeddings - see demo_spec_pair) next to the paged target and selects the speculative engine; requires MX_SERVE_KV_PAGES > 0.  0 (default) disables speculation."),
+    "MX_SERVE_HBM_BUDGET": ("0", "Census-driven multi-model bin-packing (ISSUE 20): HBM byte budget one serving replica may spend across every co-hosted model (deployed servables + decode engines' target/draft).  ModelHost.deploy measures each candidate AFTER its warm - live param/state bytes plus the peak memory_analysis temp bytes of its registered programs - and refuses admission with a typed in-band '(False, \"budget: ...\")' wire reply when hosted + new would bust the budget.  0 (default) disables the packer (admission is unbounded)."),
     "MX_PROGRAM_CENSUS": ("1", "XLA program census (mxnet_tpu/programs.py): 1 (default) routes every jit-creation site through the process-wide program registry - per-program compile-time histograms (program_compile_seconds{program}), XLA memory_analysis/cost_analysis metadata (program_temp_bytes/program_flops, where the backend provides them), retrace counts with a structured retrace-explainer diff (which arg's shape/dtype/tree structure changed), and the jax.live_arrays() device-buffer census bucketed by owner (params/optimizer_state/ef_residuals/serve/other) riding flight-recorder records and crash dumps.  0 makes register_program a plain jax.jit and disables the census."),
     "MX_LEAK_WARN_BYTES": ("67108864", "Buffer-census leak detector threshold: when total live device bytes grow monotonically across consecutive census checks by more than this many bytes, the census_leak_bytes gauge latches the streak, census.leak_trips increments and a warning names the growing owner buckets.  Any shrink resets the streak; 0 disables the trip (gauges still publish)."),
     "MX_BENCH_HISTORY": ("", "Path of the bench-trajectory history file tools/bench_compare.py appends each bench.py run to and gates regressions against (>10% throughput or >15% peak-temp-bytes vs the rolling best per metric); empty uses BENCH_HISTORY.jsonl next to bench.py."),

@@ -78,7 +78,10 @@ __all__ = [
 # FLEET payload schema.  2 (ISSUE 17): member rows carry their scrape
 # ``addr``, making the snapshot directly router-consumable — the router
 # maps per-member gauges back to the replica address it forwards to.
-SCHEMA = 2
+# 3 (ISSUE 20): a ``models`` section rolls model-labeled serve.*
+# counters up per co-hosted model (multi-model replicas), so per-model
+# traffic is first-class in the merged snapshot.
+SCHEMA = 3
 
 # The fleet wire surface, DECLARED (ISSUE 11 contract): mxlint's
 # wire-verb-exhaustive rule pairs every emitted verb with an entry
@@ -879,6 +882,21 @@ class FleetCollector:
             base_merge = merge_snapshots(mergeable,
                                          include_counters=False)
             base_merge["counters"] = counter_totals
+            # per-model rollup (ISSUE 20, schema 3): every model-labeled
+            # serve.* counter folds into a {model: {name: total}} map —
+            # the multi-model replica's per-model traffic, fleet-wide
+            model_rollup: Dict[str, Dict[str, Any]] = {}
+            for key, slot in counter_totals.items():
+                if "{" not in key or not key.startswith("serve."):
+                    continue
+                name, rest = key.split("{", 1)
+                mdl = None
+                for part in rest.rstrip("}").split(","):
+                    if part.startswith("model="):
+                        mdl = part[len("model="):]
+                if mdl is not None:
+                    model_rollup.setdefault(mdl, {})[name] = \
+                        slot["total"]
             straggler_findings = self.stragglers.update(worker_stats)
             rejected_d, offered_d = self._rate_deltas(counter_totals)
             queue_depth = self._queue_depth(base_merge["gauges"])
@@ -894,6 +912,7 @@ class FleetCollector:
                 "counters": base_merge["counters"],
                 "gauges": base_merge["gauges"],
                 "histograms": base_merge["histograms"],
+                "models": model_rollup,
                 "stragglers": straggler_findings,
                 "slo": slo,
                 "malformed_beats": malformed_total,

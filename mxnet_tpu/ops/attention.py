@@ -26,7 +26,8 @@ from jax import lax
 from .registry import register
 
 __all__ = ["attention_core", "flash_attention", "cached_attention",
-           "paged_attention"]
+           "cached_attention_multi", "paged_attention",
+           "paged_attention_multi"]
 
 # kernel block sizes: 256x256 keeps the fp32 accumulators + two operand
 # tiles comfortably inside v5e VMEM; overridable via env so a healthy
@@ -545,6 +546,57 @@ def cached_attention(q, k_pages, v_pages, cur_len, scale=None):
     logits = jnp.where(valid, logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhp,bphd->bhd", probs, v_pages)
+
+
+def cached_attention_multi(q, k_pages, v_pages, pos, scale=None):
+    """Multi-position attention over per-sequence KV cache pages.
+
+    The speculative-verify generalization of :func:`cached_attention`:
+    T query rows per sequence, each attending over the prefix ending at
+    its OWN absolute position — the causal mask a chunk of in-flight
+    draft tokens needs when the target model scores all of them in one
+    dispatch.
+
+    ``q``: (B, T, H, D) — T query tokens per sequence; ``k_pages``/
+    ``v_pages``: (B, P, H, D) full-capacity page buffers (rows >= a
+    query's position hold stale entries); ``pos``: (B, T) int — each
+    query row's absolute position (its own KV entry is already written,
+    so row t attends keys [0, pos[b, t]]).  Returns (B, T, H, D).
+    Masking keeps the finite -1e30 discipline of the single-position
+    path so scratch/padded lanes stay NaN-free.
+    """
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    P = k_pages.shape[1]
+    logits = jnp.einsum("bthd,bphd->bthp", q, k_pages,
+                        preferred_element_type=jnp.float32) * scale
+    valid = jnp.arange(P)[None, None, :] <= pos[:, :, None]
+    logits = jnp.where(valid[:, :, None, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bthp,bphd->bthd", probs, v_pages)
+
+
+def paged_attention_multi(q, k_heap, v_heap, block_tables, pos,
+                          scale=None):
+    """Multi-position attention over a PAGED KV heap — the speculative
+    verify dispatch's core (ISSUE 20).
+
+    Gathers each lane's physical pages into the (B, extent, H, D) view
+    :func:`cached_attention_multi` expects and delegates, exactly as
+    :func:`paged_attention` does for the single-position step, so the
+    verify program shares the flat path's masking/softmax semantics and
+    greedy accept/reject stays bit-exact against plain decode.
+
+    ``q``: (B, T, H, D); ``k_heap``/``v_heap``: (n_pages, page_len, H,
+    D) one layer's heap slice; ``block_tables``: (B, pages_per_slot)
+    int32; ``pos``: (B, T) absolute positions.  Returns (B, T, H, D).
+    """
+    B = q.shape[0]
+    page_len = k_heap.shape[1]
+    extent = block_tables.shape[1] * page_len
+    k = k_heap[block_tables].reshape((B, extent) + k_heap.shape[2:])
+    v = v_heap[block_tables].reshape((B, extent) + v_heap.shape[2:])
+    return cached_attention_multi(q, k, v, pos, scale=scale)
 
 
 def paged_attention(q, k_heap, v_heap, block_tables, cur_len,

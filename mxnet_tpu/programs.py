@@ -72,7 +72,8 @@ from . import telemetry as _telemetry
 
 __all__ = [
     "register_program", "Program", "ProgramRecord", "census_enabled",
-    "program_table", "program_summary", "find_record", "reset_records",
+    "program_table", "program_summary", "program_memory_bytes",
+    "find_record", "reset_records",
     "signature_of", "diff_signatures",
     "track_buffers", "buffer_census", "leak_detector", "LeakDetector",
     "CENSUS_OWNERS",
@@ -465,6 +466,34 @@ def program_summary() -> Dict[str, Any]:
         "compile_seconds_total": round(total_s, 6),
         "peak_temp_bytes": max(peak_temp) if peak_temp else None,
     }
+
+
+def program_memory_bytes(prefix: str) -> Dict[str, int]:
+    """Aggregate ``memory_analysis`` bytes over every registered
+    program whose name starts with ``prefix`` (ISSUE 20): the HBM
+    bin-packer's per-model program-side footprint.  ``temp_bytes_peak``
+    is the max transient allocation any one of the model's programs
+    needs live at dispatch (programs run one at a time per replica);
+    argument/output bytes are informational — the live arrays they
+    alias are already counted by :func:`buffer_census`."""
+    table = program_table()
+    out = {"programs": 0, "temp_bytes_peak": 0,
+           "argument_bytes_max": 0, "output_bytes_max": 0}
+    for name, t in table.items():
+        if not name.startswith(prefix):
+            continue
+        out["programs"] += 1
+        tb = t.get("temp_bytes_peak")
+        if tb:
+            out["temp_bytes_peak"] = max(out["temp_bytes_peak"],
+                                         int(tb))
+        mem = t.get("memory") or {}
+        for src, dst in (("argument_bytes", "argument_bytes_max"),
+                         ("output_bytes", "output_bytes_max")):
+            b = mem.get(src)
+            if b:
+                out[dst] = max(out[dst], int(b))
+    return out
 
 
 def program_count() -> int:

@@ -33,42 +33,54 @@ import time
 import numpy as _np
 
 
-def _build_servable(args):
+def _build_servables(args):
+    """Every --demo/--demo-conv/--model spec as (servable, example) —
+    multi-model co-hosting (ISSUE 20): the FIRST spec is the default
+    model, the rest are admitted through ``ServeServer.add_model``
+    under the MX_SERVE_HBM_BUDGET packer and addressed by the wire
+    envelope's model field."""
     from .servable import BucketTable, Servable
     buckets = BucketTable([int(b) for b in args.buckets.split(",")]) \
         if args.buckets else None
-    if args.demo_conv:
-        from .demo import demo_conv_block, demo_conv_example
-        sv = Servable(demo_conv_block(), name="demo-conv", version=1,
-                      buckets=buckets)
-        return sv, demo_conv_example()
+    specs = []
     if args.demo:
         from .demo import demo_block, demo_example
-        sv = Servable(demo_block(), name="demo-mlp", version=1,
-                      buckets=buckets)
-        return sv, demo_example()
-    if not args.model:
-        raise SystemExit("serve: need --model PREFIX or --demo")
-    sv = Servable.from_checkpoint(args.model, epoch=args.epoch,
-                                  input_names=args.inputs.split(","),
-                                  version=1, buckets=buckets)
-    if not args.example_shape:
-        raise SystemExit("serve: --model needs --example-shape (comma "
-                         "dims per input, ';' between inputs)")
-    example = []
-    for part in args.example_shape.split(";"):
-        trail = tuple(int(d) for d in part.split(",") if d.strip())
-        example.append(_np.zeros((1,) + trail, _np.dtype(args.dtype)))
-    return sv, example
+        specs.append((Servable(demo_block(), name="demo-mlp",
+                               version=1, buckets=buckets),
+                      demo_example()))
+    if args.demo_conv:
+        from .demo import demo_conv_block, demo_conv_example
+        specs.append((Servable(demo_conv_block(), name="demo-conv",
+                               version=1, buckets=buckets),
+                      demo_conv_example()))
+    for prefix in (args.model or ()):
+        sv = Servable.from_checkpoint(prefix, epoch=args.epoch,
+                                      input_names=args.inputs.split(","),
+                                      version=1, buckets=buckets)
+        if not args.example_shape:
+            raise SystemExit("serve: --model needs --example-shape "
+                             "(comma dims per input, ';' between "
+                             "inputs)")
+        example = []
+        for part in args.example_shape.split(";"):
+            trail = tuple(int(d) for d in part.split(",") if d.strip())
+            example.append(_np.zeros((1,) + trail,
+                                     _np.dtype(args.dtype)))
+        specs.append((sv, example))
+    return specs
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m mxnet_tpu.serve", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
-    ap.add_argument("--model", default=None, metavar="PREFIX",
+    ap.add_argument("--model", action="append", default=None,
+                    metavar="PREFIX",
                     help="checkpoint prefix (PREFIX-symbol.json + "
-                         "PREFIX-%%04d.params, the export/foreign lane)")
+                         "PREFIX-%%04d.params, the export/foreign "
+                         "lane); repeatable — extra models co-host on "
+                         "this replica under MX_SERVE_HBM_BUDGET and "
+                         "route by the wire envelope's model field")
     ap.add_argument("--epoch", type=int, default=0)
     ap.add_argument("--inputs", default="data",
                     help="comma-separated model input names")
@@ -136,7 +148,29 @@ def main(argv=None) -> int:
         # serve time pays zero traces.  MX_SERVE_KV_PAGES > 0 selects
         # the PAGED engine (ISSUE 18): shared page heap + block tables,
         # hash-shared prefixes, chunked prefill — same wire surface.
-        if int(get_env("MX_SERVE_KV_PAGES", 0, int) or 0) > 0:
+        paged = int(get_env("MX_SERVE_KV_PAGES", 0, int) or 0) > 0
+        draft_layers = int(get_env("MX_SERVE_DRAFT", 0, int) or 0)
+        if draft_layers > 0:
+            # speculative decoding (ISSUE 20): a shallow draft proposes
+            # MX_SERVE_SPEC_K tokens per window, the paged target
+            # verifies them in ONE multi-position dispatch; co-hosted
+            # draft+target share the page heap budget
+            if not paged:
+                raise SystemExit("serve: MX_SERVE_DRAFT needs the "
+                                 "paged engine (set MX_SERVE_KV_PAGES)")
+            from .decode import (DecodeConfig, DraftDecodeServable,
+                                 PagedDecodeServable,
+                                 SpeculativeDecodeBatcher,
+                                 demo_spec_pair)
+            cfg = DecodeConfig()
+            tparams, dcfg, dparams = demo_spec_pair(
+                cfg, draft_layers=draft_layers)
+            decode_engine = SpeculativeDecodeBatcher(
+                PagedDecodeServable(params=tparams, config=cfg),
+                DraftDecodeServable(params=dparams, config=dcfg,
+                                    name="demo-lm-draft"),
+                on_tick=tick)
+        elif paged:
             from .decode import PagedDecodeBatcher, PagedDecodeServable
             decode_engine = PagedDecodeBatcher(PagedDecodeServable(),
                                                on_tick=tick)
@@ -146,9 +180,12 @@ def main(argv=None) -> int:
                                           on_tick=tick)
     state = ServeServer(on_tick=tick, decode=decode_engine)
     sv = None
-    if args.demo or args.demo_conv or args.model:
-        sv, example = _build_servable(args)
+    specs = _build_servables(args)
+    if specs:
+        sv, example = specs[0]
         state.host.deploy(sv, example=example)
+        for extra_sv, extra_ex in specs[1:]:
+            state.add_model(extra_sv, example=extra_ex, on_tick=tick)
     elif not args.decode:
         raise SystemExit("serve: need --model PREFIX, --demo or "
                          "--decode")
@@ -167,17 +204,29 @@ def main(argv=None) -> int:
                  "" if cs["enabled"] else " off",
                  cs["hits"], cs["misses"], port),
               file=sys.stderr, flush=True)
+        if len(specs) > 1:
+            rep = state.host.packing_report()
+            print("serve: co-hosting %d models %r (used=%d budget=%s)"
+                  % (len(rep["models"]), sorted(rep["models"]),
+                     rep["used_bytes"],
+                     rep["hbm_budget_bytes"] or "off"),
+                  file=sys.stderr, flush=True)
     if decode_engine is not None:
         dsv = decode_engine.servable
         ps = decode_engine.page_stats()
         if ps is not None:
+            spec = ""
+            if ps.get("engine") == "speculative":
+                spec = ", speculative: k=%d draft=%s" \
+                    % (ps["spec_k"], ps["draft_model"])
             print("serve: decode %s v%d warm (paged: %d pages x %d "
-                  "tok, chunk=%d, share=%s) in %.2fs (slots=%d, "
+                  "tok, chunk=%d, share=%s%s) in %.2fs (slots=%d, "
                   "max_tokens=%d), port %d"
                   % (dsv.name, dsv.version, ps["kv_pages"],
                      ps["kv_page_len"], ps["prefill_chunk"],
-                     "on" if ps["prefix_share"] else "off", warm_s,
-                     dsv.config.slots, dsv.config.max_tokens, port),
+                     "on" if ps["prefix_share"] else "off", spec,
+                     warm_s, dsv.config.slots, dsv.config.max_tokens,
+                     port),
                   file=sys.stderr, flush=True)
         else:
             print("serve: decode %s v%d warm on %d prompt + %d slot "

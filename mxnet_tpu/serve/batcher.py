@@ -143,8 +143,10 @@ class Batcher:
     def __init__(self, host, max_batch: Optional[int] = None,
                  max_delay_us: Optional[float] = None,
                  queue_cap: Optional[int] = None,
-                 on_tick=None, autostart: bool = True):
+                 on_tick=None, autostart: bool = True,
+                 model: Optional[str] = None):
         self._host = host
+        self._model = model
         self._max_batch = int(max_batch if max_batch is not None else
                               get_env("MX_SERVE_MAX_BATCH", 16, int))
         delay_us = max_delay_us if max_delay_us is not None else \
@@ -200,7 +202,7 @@ class Batcher:
         if any(int(i.shape[0]) != rows for i in inputs):
             self._c_rejected.inc()
             raise MXNetError("serve: input leading (batch) dims disagree")
-        sv = self._host.active()
+        sv = self._host.active(self._model)
         if sv.buckets.bucket_for(rows) is None:
             self._c_rejected.inc()
             raise MXNetError(
@@ -227,6 +229,14 @@ class Batcher:
             self._cv.notify_all()
         self._c_requests.inc()
         self._c_rows.inc(rows)
+        # per-model labeled twins (ISSUE 20): the unlabeled aggregates
+        # stay; fleet.py rolls the labeled series up per hosted model
+        reg = _telemetry.registry
+        lbl = {"model": sv.name}
+        reg.counter("serve.requests", doc="admitted predict requests",
+                    labels=lbl).inc()
+        reg.counter("serve.rows", doc="admitted request rows "
+                    "(examples)", labels=lbl).inc(rows)
         return p
 
     # -- the dispatch loop (mxlint hot-path root) ---------------------------
@@ -249,7 +259,7 @@ class Batcher:
 
     def _effective_max(self) -> int:
         try:
-            top = self._host.active().buckets.max_size
+            top = self._host.active(self._model).buckets.max_size
         except MXNetError:
             return self._max_batch
         return max(1, min(self._max_batch, top))
@@ -310,7 +320,7 @@ class Batcher:
         rows = sum(p.rows for p in take)
         sv = None
         while sv is None:
-            sv = self._host.active()
+            sv = self._host.active(self._model)
             if not sv.begin():         # raced a hot-swap drain: re-read
                 sv = None
         try:
@@ -354,6 +364,10 @@ class Batcher:
                 outs = sv.dispatch(bucket, padded)
             self._h_occupancy.observe(rows)
             self._c_pad_rows.inc(pad_rows)
+            _telemetry.registry.histogram(
+                "serve.batch_occupancy", doc="real rows per dispatched "
+                "micro-batch", labels={"model": sv.name},
+                buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256)).observe(rows)
             batch = _Batch(outs, sv.version)
             offset = 0
             for p in take:

@@ -190,16 +190,20 @@ class ServeClient:
             % (msg[0], self._addrs, policy.deadline, policy.last_error))
 
     # -- verbs --------------------------------------------------------------
-    def predict(self, arrays: Sequence, spill: bool = False
+    def predict(self, arrays: Sequence, spill: bool = False,
+                model: Optional[str] = None
                 ) -> Tuple[int, List[_np.ndarray]]:
         """One inference request: per-input row-batched arrays in,
-        ``(servable_version, [output leaf, ...])`` out.  Raises
+        ``(servable_version, [output leaf, ...])`` out.  ``model``
+        names which co-hosted model answers on a multi-model replica
+        (ISSUE 20); None keeps the replica's default.  Raises
         :class:`Overloaded` when the fleet sheds it, MXNetError on a
         terminal failure."""
         payload = [encode_array(a) for a in arrays]
         tried = 0
         while True:
-            ok, resp = self._rpc("PREDICT", payload)
+            ok, resp = self._rpc("PREDICT", payload) if model is None \
+                else self._rpc("PREDICT", payload, str(model))
             if ok:
                 version, outs = resp
                 return int(version), [decode_array(t) for t in outs]
@@ -221,7 +225,8 @@ class ServeClient:
     def generate(self, prompt: Sequence[int],
                  max_tokens: Optional[int] = None,
                  eos: Optional[int] = None, on_token=None,
-                 spill: bool = False) -> Tuple[int, List[int]]:
+                 spill: bool = False,
+                 model: Optional[str] = None) -> Tuple[int, List[int]]:
         """One autoregressive generation: prompt token ids in,
         ``(servable_version, [generated token, ...])`` out, through the
         fleet's continuous-batching decode engine (ISSUE 15).
@@ -238,6 +243,8 @@ class ServeClient:
             opts["max_tokens"] = int(max_tokens)
         if eos is not None:
             opts["eos"] = int(eos)
+        if model is not None:
+            opts["model"] = str(model)
         seen = [0]
 
         def _dedupe(offset, tokens):

@@ -101,13 +101,14 @@ from ..base import MXNetError, get_env
 from .. import fault as _fault
 from .. import telemetry as _telemetry
 from ..ops.attention import (attention_core, cached_attention,
-                             paged_attention)
+                             paged_attention, paged_attention_multi)
 from .batcher import Overloaded, result_timeout as _result_timeout
 from .paging import PageAllocator, page_hashes
 
 __all__ = ["DecodeConfig", "DecodeServable", "DecodeBatcher",
            "PagedDecodeServable", "PagedDecodeBatcher",
-           "demo_lm_params", "reference_generate"]
+           "DraftDecodeServable", "SpeculativeDecodeBatcher",
+           "demo_lm_params", "demo_spec_pair", "reference_generate"]
 
 # extra pool positions past prompt+generation capacity: the pump may
 # run a few steps ahead of the harvester (bounded by the harvest queue)
@@ -136,7 +137,8 @@ class DecodeConfig:
                  kv_pages: Optional[int] = None,
                  kv_page_len: Optional[int] = None,
                  prefix_share: Optional[bool] = None,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 spec_k: Optional[int] = None):
         self.vocab = int(vocab)
         self.dim = int(dim)
         self.heads = int(heads)
@@ -205,6 +207,13 @@ class DecodeConfig:
         # chunks are page-aligned by construction: round up
         self.prefill_chunk = \
             -(-chunk // self.kv_page_len) * self.kv_page_len
+        # -- speculative window width (ISSUE 20) ----------------------------
+        # the verify program writes positions len..len+k into the slot's
+        # pages before acceptance truncates back, so k may never exceed
+        # the overrun margin the pool geometry already reserves
+        k = int(spec_k if spec_k is not None else
+                get_env("MX_SERVE_SPEC_K", 4, int))
+        self.spec_k = max(1, min(k, _OVERRUN_MARGIN))
 
     def prompt_bucket_for(self, n: int) -> Optional[int]:
         for b in self.prompt_buckets:
@@ -251,6 +260,48 @@ def demo_lm_params(config: Optional[DecodeConfig] = None
         params["l%d.w1" % l] = mat(d, 2 * d, 1.0 / (d ** 0.5))
         params["l%d.w2" % l] = mat(2 * d, d, 1.0 / ((2 * d) ** 0.5))
     return params
+
+
+def demo_spec_pair(config: DecodeConfig, draft_layers: int = 1,
+                   residual_eps: float = 1e-4):
+    """A draft-friendly (target, draft) parameter pair for speculative
+    decoding (ISSUE 20).
+
+    The target is ``config.layers`` deep, but every layer past
+    ``draft_layers`` has its residual write-back matrices (``wo`` /
+    ``w2``) scaled by ``residual_eps`` — those layers still run at full
+    cost, yet perturb the residual stream by ~eps, so the target's
+    greedy argmax (decisive margins: the demo unembedding is scaled x4)
+    almost always equals what the first ``draft_layers`` layers alone
+    predict.  The draft is exactly that shallow prefix, sharing the
+    embedding/unembedding tables, so acceptance runs near 100% while
+    the draft costs ``draft_layers / layers`` of a target step — the
+    regime speculative decoding pays off in.
+
+    Returns ``(target_params, draft_config, draft_params)``; the draft
+    config shares every pool/bucket dimension with ``config`` (slot ids
+    and lengths line up 1:1) but is only ``draft_layers`` deep.
+    """
+    cfg = config
+    draft_layers = max(1, min(int(draft_layers), cfg.layers))
+    target = demo_lm_params(cfg)
+    for l in range(draft_layers, cfg.layers):
+        target["l%d.wo" % l] = target["l%d.wo" % l] * residual_eps
+        target["l%d.w2" % l] = target["l%d.w2" % l] * residual_eps
+    draft_cfg = DecodeConfig(
+        vocab=cfg.vocab, dim=cfg.dim, heads=cfg.heads,
+        layers=draft_layers, slots=cfg.slots,
+        max_tokens=cfg.max_tokens, page=cfg.page,
+        prompt_buckets=cfg.prompt_buckets, eos_id=cfg.eos_id,
+        seed=cfg.seed, kv_pages=cfg.kv_pages,
+        kv_page_len=cfg.kv_page_len, prefix_share=cfg.prefix_share,
+        prefill_chunk=cfg.prefill_chunk, spec_k=cfg.spec_k)
+    draft = {"emb": target["emb"], "unemb": target["unemb"]}
+    for l in range(draft_layers):
+        for name in ("wq", "wk", "wv", "wo", "w1", "w2"):
+            key = "l%d.%s" % (l, name)
+            draft[key] = target[key]
+    return target, draft_cfg, draft
 
 
 # ---------------------------------------------------------------------------
@@ -460,6 +511,125 @@ def _prefill_chunk_body(cfg: DecodeConfig, params, k_heap, v_heap,
     return k_heap, v_heap, tokens, lengths, t0
 
 
+def _draft_step_body(cfg: DecodeConfig, params, k_pool, v_pool, tokens,
+                     lengths, props, slot_ids, col):
+    """One DRAFT autoregressive step (ISSUE 20): the flat decode body
+    on the draft's own tiny KV pool, with the sampled token ALSO
+    written into column ``col`` of the device-resident proposals
+    buffer ``props`` (slots+1, spec_k) — the verify dispatch reads the
+    whole window from there, so the k draft steps + verify chain never
+    syncs the host.  ``col`` is a traced scalar: one program per slot
+    bucket covers every window position."""
+    k_pool, v_pool, tokens, lengths, nxt = _decode_body(
+        cfg, params, k_pool, v_pool, tokens, lengths, slot_ids)
+    props = props.at[slot_ids, col].set(nxt)
+    # park the scratch row (padded lanes write it every step)
+    props = props.at[cfg.slots].set(0)
+    return k_pool, v_pool, tokens, lengths, props
+
+
+def _draft_prefill_body(cfg: DecodeConfig, params, k_pool, v_pool,
+                        tokens, lengths, tgt_tokens, slot_id, prompt,
+                        n):
+    """Prefill the DRAFT's KV pool for one admitted session (ISSUE
+    20): the flat prefill body, except the slot's next-input token is
+    adopted from the TARGET's token array (read-only input) rather
+    than the draft's own first-token prediction — the window invariant
+    is that draft and target agree on (next token, length) at every
+    window boundary, and the first committed token is the target's.
+    Passing ``tgt_tokens`` as a program input also makes XLA order
+    this dispatch after the target's emitting prefill chunk."""
+    k_pool, v_pool, tokens, lengths, _t0 = _prefill_body(
+        cfg, params, k_pool, v_pool, tokens, lengths, slot_id, prompt,
+        n)
+    tokens = tokens.at[slot_id].set(tgt_tokens[slot_id])
+    return k_pool, v_pool, tokens, lengths
+
+
+def _verify_body(cfg: DecodeConfig, params, k_heap, v_heap, t_tok,
+                 t_len, d_tok, d_len, props, slot_ids, block_tbls):
+    """Verify one speculative window in ONE dispatch (ISSUE 20).
+
+    Window invariant on entry (per active lane, slot ``s``, length
+    ``L``, next token ``t``): the draft ran k steps from (t, L), so
+    ``props[s]`` holds its proposals d_1..d_k and the draft KV covers
+    positions L..L+k-1 (inputs t, d_1..d_{k-1}).  This program runs
+    the TARGET over the k+1 inputs ``[t, d_1..d_k]`` at positions
+    ``L..L+k`` through the paged heap — the chunked-prefill scatter/
+    gather pattern with a per-position causal mask — and argmaxes
+    every position: ``a_j`` is the target's greedy token after input
+    j.  Acceptance is the standard longest-prefix rule, CAPPED at
+    k-1 so the committed state never depends on position L+k (whose
+    input d_k may be wrong):
+
+        m  = max prefix with d_j == a_{j-1}        (0..k)
+        m' = min(m, k-1)
+        emit a_0..a_{m'}  (1..k tokens; all provably equal what
+                           non-speculative greedy decode emits)
+        next token = a_{m'},  new length = L + m' + 1
+
+    On full acceptance (m == k) this emits k tokens and the next
+    token a_{k-1} == d_k is exactly the draft's current state — both
+    models stay in lockstep with no host round-trip; on a rejection
+    the program itself rewrites the DRAFT's (token, length) arrays
+    (donated in) to the corrected values, so the draft's stale KV
+    past the new length is masked garbage, overwritten by its next
+    window's steps.  Target KV entries past L+m' are likewise stale
+    and land inside the slot's pages (k <= _OVERRUN_MARGIN).
+
+    Returns (k_heap, v_heap, t_tok, t_len, d_tok, d_len, emitted,
+    n_em): ``emitted`` (b, k) holds a_0..a_{k-1}, of which the first
+    ``n_em[lane]`` are real — the harvester appends exactly those.
+    """
+    pl = cfg.kv_page_len
+    K = props.shape[1]
+    E = K + 1
+    lens = t_len[slot_ids]                              # (b,) = L
+    cur = t_tok[slot_ids]                               # (b,)
+    d = props[slot_ids]                                 # (b, K)
+    inp = jnp.concatenate([cur[:, None], d], axis=1)    # (b, E)
+    x = params["emb"][inp]                              # (b, E, D)
+    b = x.shape[0]
+    pos = lens[:, None] + jnp.arange(E)[None, :]        # (b, E)
+    page_idx = jnp.clip(pos // pl, 0, cfg.pages_per_slot - 1)
+    phys = jnp.take_along_axis(block_tbls, page_idx, axis=1)  # (b, E)
+    off = pos % pl
+    for l in range(cfg.layers):
+        k_new = (x @ params["l%d.wk" % l]).reshape(
+            b, E, cfg.heads, cfg.head_dim)
+        v_new = (x @ params["l%d.wv" % l]).reshape(
+            b, E, cfg.heads, cfg.head_dim)
+        k_heap = k_heap.at[l, phys, off].set(k_new)
+        v_heap = v_heap.at[l, phys, off].set(v_new)
+        q = (x @ params["l%d.wq" % l]).reshape(b, E, cfg.heads,
+                                               cfg.head_dim)
+        att = paged_attention_multi(q, k_heap[l], v_heap[l],
+                                    block_tbls, pos)
+        x = x + att.reshape(b, E, cfg.dim) @ params["l%d.wo" % l]
+        x = _block_mlp(params, l, x)
+    logits = x @ params["unemb"]                        # (b, E, V)
+    a = jnp.argmax(logits, axis=-1).astype(jnp.int32)   # (b, E)
+    # accept d_{i+1} while it equals a_i, longest prefix, capped k-1
+    match = (d == a[:, :K]).astype(jnp.int32)           # (b, K)
+    m = jnp.sum(jnp.cumprod(match, axis=1), axis=1)     # (b,) 0..K
+    m_cap = jnp.minimum(m, K - 1)
+    n_em = (m_cap + 1).astype(jnp.int32)                # (b,) 1..K
+    emitted = a[:, :K]                                  # (b, K)
+    new_tok = jnp.take_along_axis(a, m_cap[:, None], axis=1)[:, 0]
+    new_len = lens + n_em
+    t_tok = t_tok.at[slot_ids].set(new_tok)
+    t_len = t_len.at[slot_ids].set(new_len)
+    d_tok = d_tok.at[slot_ids].set(new_tok)
+    d_len = d_len.at[slot_ids].set(new_len)
+    # park the scratch slot on BOTH state pairs (padded lanes)
+    t_tok = t_tok.at[cfg.slots].set(0)
+    t_len = t_len.at[cfg.slots].set(0)
+    d_tok = d_tok.at[cfg.slots].set(0)
+    d_len = d_len.at[cfg.slots].set(0)
+    return (k_heap, v_heap, t_tok, t_len, d_tok, d_len, emitted,
+            n_em)
+
+
 # geometry-keyed jit cache for the reference oracle: a load driver
 # replays MANY reference decodes against one model — per-token eager
 # dispatch would dominate its wall time.  Plain jax.jit, deliberately
@@ -581,6 +751,7 @@ class DecodeServable:
         self._lock = threading.Lock()
         self._step_programs: Dict[int, object] = {}
         self._prefill_programs: Dict[int, object] = {}
+        self._verify_programs: Dict[int, object] = {}
         self.retraces = 0            # program builds (warm pays them)
         self.hits = 0                # dispatches answered by the table
         self.warmed = False
@@ -590,6 +761,29 @@ class DecodeServable:
         self._c_hits = _counter(
             "serve.bucket_hits", "dispatches answered by a pre-built "
             "bucket program")
+
+    # -- HBM census (ISSUE 20 bin-packing) ----------------------------------
+    def program_prefix(self) -> str:
+        """Program-registry name prefix this servable's programs live
+        under (the budget packer reads their memory_analysis here)."""
+        return "serve.decode."
+
+    def live_bytes(self) -> int:
+        """Resident bytes: params + the whole KV state (pool or page
+        heap) — exactly the arrays the buffer census owner-tags."""
+        n = sum(int(getattr(a, "nbytes", 0))
+                for a in self.params.values())
+        n += sum(int(getattr(a, "nbytes", 0))
+                 for a in self._state.values())
+        return n
+
+    def footprint_bytes(self) -> int:
+        """Measured HBM footprint for the ModelHost budget packer:
+        live bytes plus the peak transient bytes of any registered
+        decode program (populated by :meth:`warm`)."""
+        from .. import programs as _programs
+        mem = _programs.program_memory_bytes(self.program_prefix())
+        return self.live_bytes() + int(mem["temp_bytes_peak"])
 
     # -- program tables -----------------------------------------------------
     def step_program(self, bucket: int):
@@ -848,6 +1042,61 @@ class PagedDecodeServable(DecodeServable):
         _engine.count_dispatch(1)
         return t0
 
+    def verify_program(self, bucket: int):
+        """The speculative VERIFY program for one slot bucket (ISSUE
+        20): all k+1 window positions of every lane in one dispatch —
+        multi-position paged attention, per-position argmax, the
+        accept-longest-prefix rule and the draft-state correction all
+        traced into a single program."""
+        bucket = int(bucket)
+        with self._lock:
+            prog = self._verify_programs.get(bucket)
+            if prog is not None:
+                self.hits += 1
+        if prog is not None:
+            self._c_hits.inc()
+            return prog
+        cfg = self.config
+
+        def run_verify(params, k_heap, v_heap, t_tok, t_len, d_tok,
+                       d_len, props, slot_ids, block_tbls):
+            return _verify_body(cfg, params, k_heap, v_heap, t_tok,
+                                t_len, d_tok, d_len, props, slot_ids,
+                                block_tbls)
+
+        from .. import programs as _programs
+        with _telemetry.phase("retrace"):
+            prog = _programs.register_program(
+                "serve.decode.verify.k%d.s%d" % (cfg.spec_k, bucket),
+                run_verify, donate_argnums=(1, 2, 3, 4, 5, 6))
+        with self._lock:
+            prog = self._verify_programs.setdefault(bucket, prog)
+            self.retraces += 1
+        self._c_retrace.inc()
+        return prog
+
+    def dispatch_verify(self, draft: "DraftDecodeServable",
+                        slot_ids: _np.ndarray,
+                        block_tbls: _np.ndarray):
+        """ONE verify dispatch over the packed window set: donates the
+        target heap state AND the draft's token/length arrays (both
+        rebound), reads the draft's device-resident proposals buffer —
+        no host sync anywhere; the (emitted, n_em) pair goes to the
+        harvester."""
+        from ..engine import engine as _engine
+        prog = self.verify_program(len(slot_ids))
+        st = self._state
+        dst = draft._state
+        k, v, tt, tl, dt, dl, emitted, n_em = prog(
+            self.params, st["k"], st["v"], st["tok"], st["len"],
+            dst["tok"], dst["len"], dst["props"], slot_ids,
+            block_tbls)
+        self._state = {"k": k, "v": v, "tok": tt, "len": tl}
+        draft._state = {"k": dst["k"], "v": dst["v"], "tok": dt,
+                        "len": dl, "props": dst["props"]}
+        _engine.count_dispatch(1)
+        return emitted, n_em
+
     def warm(self) -> "PagedDecodeServable":
         """Pre-build + pre-run the chunk program and every decode slot
         bucket against the scratch page/slot, then reset the
@@ -878,6 +1127,140 @@ class PagedDecodeServable(DecodeServable):
         """A worst-case session's heap share (its full block-table
         extent) — what one admission can cost at most."""
         return self.page_bytes() * self.config.pages_per_slot
+
+
+class DraftDecodeServable(DecodeServable):
+    """The DRAFT servable for speculative decoding (ISSUE 20): a small
+    flat-pool decode model whose steps write their sampled tokens into
+    a device-resident PROPOSALS buffer ``(slots+1, spec_k)`` instead
+    of feeding the harvester — the target's verify program reads the
+    whole window from it, so draft + verify form a pure device-side
+    chain.  Geometry (slots, buckets, pool length) must match the
+    target's so slot ids and lengths line up 1:1; only depth/width
+    differ.  Co-hosted under the ModelHost HBM budget like any other
+    servable (its pool is censused ``kv_cache``, its params
+    ``serve``)."""
+
+    engine = "draft"
+
+    def program_prefix(self) -> str:
+        return "serve.decode.draft."
+
+    def _alloc_state(self) -> Dict[str, jnp.ndarray]:
+        st = super()._alloc_state()
+        cfg = self.config
+        st["props"] = jnp.zeros((cfg.slots + 1, cfg.spec_k),
+                                jnp.int32)
+        return st
+
+    # -- program tables -----------------------------------------------------
+    def step_program(self, bucket: int):
+        bucket = int(bucket)
+        with self._lock:
+            prog = self._step_programs.get(bucket)
+            if prog is not None:
+                self.hits += 1
+        if prog is not None:
+            self._c_hits.inc()
+            return prog
+        cfg = self.config
+
+        def run_draft(params, k_pool, v_pool, tokens, lengths, props,
+                      slot_ids, col):
+            return _draft_step_body(cfg, params, k_pool, v_pool,
+                                    tokens, lengths, props, slot_ids,
+                                    col)
+
+        from .. import programs as _programs
+        with _telemetry.phase("retrace"):
+            prog = _programs.register_program(
+                "serve.decode.draft.s%d" % bucket, run_draft,
+                donate_argnums=(1, 2, 3, 4, 5))
+        with self._lock:
+            prog = self._step_programs.setdefault(bucket, prog)
+            self.retraces += 1
+        self._c_retrace.inc()
+        return prog
+
+    def prefill_program(self, prompt_bucket: int):
+        prompt_bucket = int(prompt_bucket)
+        with self._lock:
+            prog = self._prefill_programs.get(prompt_bucket)
+            if prog is not None:
+                self.hits += 1
+        if prog is not None:
+            self._c_hits.inc()
+            return prog
+        cfg = self.config
+
+        def run_prefill(params, k_pool, v_pool, tokens, lengths,
+                        tgt_tokens, slot_id, prompt, n):
+            return _draft_prefill_body(cfg, params, k_pool, v_pool,
+                                       tokens, lengths, tgt_tokens,
+                                       slot_id, prompt, n)
+
+        from .. import programs as _programs
+        with _telemetry.phase("retrace"):
+            prog = _programs.register_program(
+                "serve.decode.draft.prefill.p%d" % prompt_bucket,
+                run_prefill, donate_argnums=(1, 2, 3, 4))
+        with self._lock:
+            prog = self._prefill_programs.setdefault(prompt_bucket,
+                                                     prog)
+            self.retraces += 1
+        self._c_retrace.inc()
+        return prog
+
+    # -- dispatch (pump thread only; mxlint hot-path roots) -----------------
+    def dispatch_step(self, slot_ids: _np.ndarray, col: int):
+        """ONE draft step over the packed window set, writing window
+        column ``col`` of the proposals buffer."""
+        from ..engine import engine as _engine
+        prog = self.step_program(len(slot_ids))
+        st = self._state
+        k, v, tok, ln, props = prog(self.params, st["k"], st["v"],
+                                    st["tok"], st["len"], st["props"],
+                                    slot_ids, _np.int32(col))
+        self._state = {"k": k, "v": v, "tok": tok, "len": ln,
+                       "props": props}
+        _engine.count_dispatch(1)
+        return props
+
+    def dispatch_prefill(self, slot: int, prompt: _np.ndarray, n: int,
+                         tgt_tokens=None):
+        """ONE draft-prefill dispatch; ``tgt_tokens`` is the TARGET's
+        token array (read-only), whose ``slot`` entry arms the draft's
+        next-input token."""
+        from ..engine import engine as _engine
+        prog = self.prefill_program(prompt.shape[0])
+        st = self._state
+        if tgt_tokens is None:
+            tgt_tokens = jnp.zeros_like(st["tok"])
+        k, v, tok, ln = prog(self.params, st["k"], st["v"], st["tok"],
+                             st["len"], tgt_tokens, _np.int32(slot),
+                             prompt, _np.int32(n))
+        self._state = {"k": k, "v": v, "tok": tok, "len": ln,
+                       "props": st["props"]}
+        _engine.count_dispatch(1)
+        return None
+
+    def warm(self) -> "DraftDecodeServable":
+        """Pre-build + pre-run every draft prefill and step bucket
+        against the scratch slot, then reset the bookkeeping."""
+        cfg = self.config
+        zeros_tok = jnp.zeros((cfg.slots + 1,), jnp.int32)
+        for lp in cfg.prompt_buckets:
+            self.dispatch_prefill(cfg.slots,
+                                  _np.zeros(lp, _np.int32), lp,
+                                  tgt_tokens=zeros_tok)
+        for b in cfg.slot_buckets:
+            self.dispatch_step(_np.full(b, cfg.slots, _np.int32), 0)
+        jax.block_until_ready(self._state["k"])
+        self._state["tok"] = jnp.zeros_like(self._state["tok"])
+        self._state["len"] = jnp.zeros_like(self._state["len"])
+        self._state["props"] = jnp.zeros_like(self._state["props"])
+        self.warmed = True
+        return self
 
 
 class _PendingGen:
@@ -1023,6 +1406,19 @@ class DecodeBatcher:
             "(one per admitted sequence)")
         self._c_seqs = reg.counter(
             "serve.decode.sequences", doc="generations retired complete")
+        # per-model labeled twins (ISSUE 20): the unlabeled aggregates
+        # stay (bench/dispatch_count read them); the labeled series is
+        # what fleet.py rolls up per co-hosted model
+        _lbl = {"model": servable.name}
+        self._c_requests_m = reg.counter(
+            "serve.decode.requests", doc="admitted generation requests",
+            labels=_lbl)
+        self._c_tokens_m = reg.counter(
+            "serve.decode.tokens", doc="generated tokens harvested",
+            labels=_lbl)
+        self._c_seqs_m = reg.counter(
+            "serve.decode.sequences", doc="generations retired complete",
+            labels=_lbl)
         self._g_queue = reg.gauge(
             "serve.decode.queue", doc="generation requests queued")
         self._g_active = reg.gauge(
@@ -1136,6 +1532,7 @@ class DecodeBatcher:
             self._g_queue.set(len(self._q))
             self._cv.notify_all()
         self._c_requests.inc()
+        self._c_requests_m.inc()
         return gen
 
     # -- the decode pump (mxlint hot-path roots) ----------------------------
@@ -1225,6 +1622,7 @@ class DecodeBatcher:
         with _telemetry.phase("kv_evict"):
             self._clear_slots([i for i, _g in done])
         self._c_seqs.inc(len(done))
+        self._c_seqs_m.inc(len(done))
         active = self.active_count()
         self._g_active.set(active)
         self._set_capacity_gauges(active)
@@ -1321,16 +1719,34 @@ class DecodeBatcher:
                 gens, out = self._harvest_q.get_nowait()
         except _queue.Empty:
             return False
-        toks = _np.asarray(out).reshape(-1)
         now = time.perf_counter()
         appended = 0
-        for g, t in zip(gens, toks[:len(gens)]):
-            did, _finished = g._append(int(t), now)
-            if did:
-                appended += 1
-                self._h_token.observe(g.token_times[-1])
+        if isinstance(out, tuple):
+            # speculative verify result: (emitted (b, k), n_em (b,)) —
+            # lane ``i`` contributed its first n_em[i] tokens this
+            # window (ISSUE 20).  _append drops post-done tokens, so a
+            # mid-window EOS/limit truncates here automatically.
+            emitted, n_em = out
+            em = _np.asarray(emitted)
+            ne = _np.asarray(n_em).reshape(-1)
+            for lane, g in enumerate(gens):
+                for t in em[lane, :int(ne[lane])]:
+                    did, finished = g._append(int(t), now)
+                    if did:
+                        appended += 1
+                        self._h_token.observe(g.token_times[-1])
+                    if finished:
+                        break
+        else:
+            toks = _np.asarray(out).reshape(-1)
+            for g, t in zip(gens, toks[:len(gens)]):
+                did, _finished = g._append(int(t), now)
+                if did:
+                    appended += 1
+                    self._h_token.observe(g.token_times[-1])
         if appended:
             self._c_tokens.inc(appended)
+            self._c_tokens_m.inc(appended)
         return True
 
     # -- synchronous driving (tests, the dispatch-count budget) -------------
@@ -1378,7 +1794,7 @@ class _PagedSeq:
     train, and the full-page hashes to publish once the train has
     dispatched.  Pump-thread-only."""
 
-    __slots__ = ("gen", "table", "held", "chunks", "publish")
+    __slots__ = ("gen", "table", "held", "chunks", "publish", "t0")
 
     def __init__(self, gen, table, held, chunks, publish):
         self.gen = gen
@@ -1386,6 +1802,9 @@ class _PagedSeq:
         self.held = held            # page ids to release at retire
         self.chunks = chunks        # deque of pending chunk dispatches
         self.publish = publish      # [(chain_hash, page)] after train
+        self.t0 = None              # emit chunk's first token (spec
+        #                             engine: harvested only after the
+        #                             draft-prefill sentinel)
 
 
 class PagedDecodeBatcher(DecodeBatcher):
@@ -1537,6 +1956,7 @@ class PagedDecodeBatcher(DecodeBatcher):
                     for p in seq.held:
                         self._alloc.release(p)
         self._c_seqs.inc(len(done))
+        self._c_seqs_m.inc(len(done))
         active = self.active_count()
         self._g_active.set(active)
         self._set_capacity_gauges(active)
@@ -1719,6 +2139,222 @@ class PagedDecodeBatcher(DecodeBatcher):
         self._hq_put(([g for _slot, g in active], out))
 
 
+class SpeculativeDecodeBatcher(PagedDecodeBatcher):
+    """The SPECULATIVE paged engine (ISSUE 20): the paged pump, but
+    decode advances in WINDOWS of ``spec_k`` tokens —
+
+    * **k draft ticks + 1 verify tick per window.**  The window's
+      active set freezes at the first draft tick; each draft tick is
+      one dispatch of the co-hosted draft servable writing its
+      proposal into the device-resident proposals buffer; the verify
+      tick is ONE target dispatch over all k+1 window positions of
+      every lane (multi-position paged attention), which accepts the
+      longest agreeing prefix, corrects the next token from the
+      target's own argmax, and rewrites the draft's (token, length)
+      state in-program — the whole window is a device-side chain with
+      zero host syncs, and the 1-dispatch-per-tick budget holds.
+
+    * **Output is bit-identical to plain paged greedy decode.**  Every
+      emitted token is the target's own argmax under the committed
+      prefix (the draft only chooses how many of them one dispatch
+      yields), so correctness never depends on draft quality — a
+      worthless draft just degrades throughput to ~1 token per 2
+      dispatches, a draft-friendly model approaches k tokens per k+1
+      (cheap) dispatches.
+
+    * **Admission grows a draft-prefill sentinel.**  A session's
+      prefill-chunk train ends with one extra dispatch that prefills
+      the DRAFT's KV pool and adopts the target's emitted first token
+      (read-only input -> XLA orders it after the emit chunk); the
+      first token is harvested only once the sentinel has dispatched,
+      so a session never enters a window with a cold draft.
+    """
+
+    def __init__(self, servable: PagedDecodeServable,
+                 draft: DraftDecodeServable,
+                 queue_cap: Optional[int] = None,
+                 mode: str = "continuous", on_tick=None,
+                 autostart: bool = True):
+        if not isinstance(draft, DraftDecodeServable):
+            raise MXNetError("SpeculativeDecodeBatcher needs a "
+                             "DraftDecodeServable draft")
+        tcfg = servable.config
+        dcfg = draft.config
+        if (tcfg.slots != dcfg.slots or tcfg.vocab != dcfg.vocab
+                or tcfg.prompt_buckets != dcfg.prompt_buckets
+                or tcfg.max_tokens != dcfg.max_tokens
+                or tcfg.spec_k != dcfg.spec_k):
+            raise MXNetError(
+                "speculative decode: draft/target geometry mismatch "
+                "(slots, vocab, prompt buckets, max_tokens and spec_k "
+                "must agree; got target=%r draft=%r)" % (tcfg, dcfg))
+        self._draft = draft
+        self._win_active: Optional[List[Tuple[int, _PendingGen]]] = \
+            None
+        self._win_step = 0
+        reg = _telemetry.registry
+        self._c_draft_steps = reg.counter(
+            "serve.decode.draft_steps",
+            doc="draft-model decode dispatches (spec_k per speculative "
+                "window)")
+        self._c_draft_prefills = reg.counter(
+            "serve.decode.draft_prefills",
+            doc="draft KV prefill dispatches (the sentinel ending each "
+                "admission's chunk train)")
+        self._c_windows = reg.counter(
+            "serve.decode.spec_windows",
+            doc="speculative verify dispatches (each commits 1..spec_k "
+                "tokens for every window lane)")
+        # warm everything BEFORE the pump threads exist: target buckets
+        # + chunk program (base warm), draft buckets + prefills, and
+        # the verify bucket table (scratch lanes only — the programs
+        # park the scratch slot themselves)
+        if not servable.warmed:
+            servable.warm()
+        if not draft.warmed:
+            draft.warm()
+        for b in tcfg.slot_buckets:
+            servable.dispatch_verify(
+                draft, _np.full(b, tcfg.slots, _np.int32),
+                _np.zeros((b, tcfg.pages_per_slot), _np.int32))
+        jax.block_until_ready(servable._state["k"])
+        super().__init__(servable, queue_cap=queue_cap, mode=mode,
+                         on_tick=on_tick, autostart=autostart)
+
+    @property
+    def draft(self) -> DraftDecodeServable:
+        return self._draft
+
+    def page_stats(self) -> Dict:
+        st = super().page_stats()
+        st["engine"] = "speculative"
+        st["spec_k"] = self._sv.config.spec_k
+        st["draft_model"] = self._draft.name
+        st["draft_layers"] = self._draft.config.layers
+        return st
+
+    # -- the speculative pump (mxlint hot-path roots) -----------------------
+    def _tick(self) -> bool:
+        """One boundary, ONE dispatch.  Mid-window ticks only advance
+        the window (the active set is frozen; retire/admit/chunks wait
+        for the boundary); boundary ticks run the paged engine's
+        retire/admit/chunk alternation and open the next window."""
+        if self._win_active is not None:
+            self._window_tick()
+            return False
+        self._retire()
+        self._admit()
+        chunk_slot = self._next_chunk_slot()
+        active = self._active()
+        if chunk_slot is not None and (self._chunk_turn or not active):
+            self._chunk_turn = False
+            self._dispatch_chunk_for(chunk_slot)
+            return False
+        self._chunk_turn = True
+        if not active:
+            return chunk_slot is None
+        self._win_active = active
+        self._win_step = 0
+        self._window_tick()
+        return False
+
+    def _window_tick(self) -> None:
+        """One dispatch of the current window: draft step ``_win_step``
+        while < spec_k, else the verify dispatch that closes the
+        window and hands (emitted, n_em) to the harvester."""
+        active = self._win_active
+        cfg = self._sv.config
+        bucket = cfg.slot_bucket_for(len(active))
+        ids = _np.full(bucket, cfg.slots, _np.int32)
+        ids[:len(active)] = [slot for slot, _g in active]
+        try:
+            if self._win_step < cfg.spec_k:
+                with _telemetry.phase("draft_step"):
+                    self._draft.dispatch_step(ids, self._win_step)
+                self._c_draft_steps.inc()
+                self._win_step += 1
+                return
+            tbls = _np.zeros((bucket, cfg.pages_per_slot), _np.int32)
+            for lane, (slot, _g) in enumerate(active):
+                tbls[lane] = self._seqs[slot].table
+            with _telemetry.phase("decode_step") as span:
+                for _slot, g in active:
+                    if g.trace_ctx is not None:
+                        span.event("request", req_trace=g.trace_ctx[0],
+                                   req_span=g.trace_ctx[1])
+                out = self._sv.dispatch_verify(self._draft, ids, tbls)
+        except BaseException as e:            # XLA failure: fail the set
+            self._win_active = None
+            self._win_step = 0
+            for _slot, g in active:
+                g._fail(e)
+            return
+        self._c_steps.inc()
+        self._c_windows.inc()
+        self._h_occ.observe(len(active))
+        self._win_active = None
+        self._win_step = 0
+        self._hq_put(([g for _slot, g in active], out))
+
+    # -- admission: chunk train + draft-prefill sentinel --------------------
+    def _plan(self, gen: _PendingGen):
+        plan = super()._plan(gen)
+        if plan is None:
+            return None
+        table, held, chunks, publish = plan
+        # sentinel: chunk=None marks the draft prefill ending the train
+        chunks.append((None, 0, len(gen.prompt), False, 0, 0))
+        return table, held, chunks, publish
+
+    def _dispatch_chunk_for(self, slot: int) -> None:
+        """ONE train dispatch: a target prefill chunk, or the
+        draft-prefill sentinel that completes the train.  The emit
+        chunk's first token parks on the session (``seq.t0``) and is
+        harvested only when the sentinel has dispatched — the window
+        invariant needs the draft warm before the first decode."""
+        seq = self._seqs[slot]
+        gen = seq.gen
+        self._chunk_rr = slot
+        chunk, start, nvalid, emit, cow_src, cow_dst = \
+            seq.chunks.popleft()
+        try:
+            with _telemetry.phase("prefill") as span:
+                if gen.trace_ctx is not None:
+                    span.event("request", req_trace=gen.trace_ctx[0],
+                               req_span=gen.trace_ctx[1], slot=slot)
+                if chunk is None:
+                    lp = self._draft.config.prompt_bucket_for(
+                        len(gen.prompt))
+                    padded = _np.zeros(lp, _np.int32)
+                    padded[:len(gen.prompt)] = gen.prompt
+                    self._draft.dispatch_prefill(
+                        slot, padded, len(gen.prompt),
+                        tgt_tokens=self._sv._state["tok"])
+                    self._c_draft_prefills.inc()
+                else:
+                    t0 = self._sv.dispatch_chunk(slot, seq.table,
+                                                 chunk, start, nvalid,
+                                                 emit, cow_src,
+                                                 cow_dst)
+                    self._c_chunks.inc()
+                    if emit:
+                        seq.t0 = t0
+        except BaseException as e:
+            self._drop_seq(slot)
+            gen._fail(e)
+            return
+        if not seq.chunks:
+            # train complete = the flat engine's "prefill" unit
+            self._c_prefills.inc()
+            for h, page in seq.publish:
+                self._alloc.publish(h, page)
+            seq.publish = []
+            active = self.active_count()
+            self._g_active.set(active)
+            self._set_capacity_gauges(active)
+            self._hq_put(([gen], seq.t0))
+
+
 # ---------------------------------------------------------------------------
 # Program contracts (ISSUE 11): the decode engine's declared proofs.
 # ``serve.decode`` covers every slot-bucket decode program:
@@ -1849,6 +2485,101 @@ def _paged_contract_built():
     return step_cases, step_closure, chunk_cases, chunk_closure
 
 
+@_functools.lru_cache(maxsize=1)
+def _spec_contract_built():
+    """The speculative engine's contract cases/closures (ISSUE 20):
+
+    * ``serve.spec.draft`` — the draft-step slot-bucket table: the
+      draft's KV pool, token/length arrays AND the proposals buffer
+      all donate in place; the window column is a traced scalar, so
+      ONE program per bucket is closed over every k — the closure maps
+      any (active-set size, window column) to its compiled case.
+    * ``serve.spec.draft.prefill`` — the draft-prefill sentinel per
+      prompt bucket (target token array read-only; draft state
+      donated).
+    * ``serve.spec.verify`` — the verify slot-bucket table: BOTH KV
+      states' mutable leaves (target heap + token/length, draft
+      token/length) donate in place, proposals read-only; closed over
+      every active-set size 1..slots.
+    """
+    from ..programs import ContractCase, ContractClosure
+    cfg = DecodeConfig()
+    tparams, dcfg, dparams = demo_spec_pair(cfg)
+    sv = PagedDecodeServable(params=tparams, config=cfg)
+    draft = DraftDecodeServable(params=dparams, config=dcfg)
+    tparams_abs = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                   for k, v in sv.params.items()}
+    dparams_abs = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                   for k, v in draft.params.items()}
+    heap_abs = jax.ShapeDtypeStruct(
+        (cfg.layers, cfg.kv_pages, cfg.kv_page_len, cfg.heads,
+         cfg.head_dim), jnp.float32)
+    dpool_abs = jax.ShapeDtypeStruct(
+        (dcfg.layers, dcfg.slots + 1, dcfg.max_len, dcfg.heads,
+         dcfg.head_dim), jnp.float32)
+    tok_abs = jax.ShapeDtypeStruct((cfg.slots + 1,), jnp.int32)
+    props_abs = jax.ShapeDtypeStruct((cfg.slots + 1, cfg.spec_k),
+                                     jnp.int32)
+    scalar_abs = jax.ShapeDtypeStruct((), jnp.int32)
+
+    def draft_args(bucket):
+        return (dparams_abs, dpool_abs, dpool_abs, tok_abs, tok_abs,
+                props_abs, jax.ShapeDtypeStruct((bucket,), jnp.int32),
+                scalar_abs)
+
+    def draft_prefill_args(lp):
+        return (dparams_abs, dpool_abs, dpool_abs, tok_abs, tok_abs,
+                tok_abs, scalar_abs,
+                jax.ShapeDtypeStruct((lp,), jnp.int32), scalar_abs)
+
+    def verify_args(bucket):
+        return (tparams_abs, heap_abs, heap_abs, tok_abs, tok_abs,
+                tok_abs, tok_abs, props_abs,
+                jax.ShapeDtypeStruct((bucket,), jnp.int32),
+                jax.ShapeDtypeStruct((bucket, cfg.pages_per_slot),
+                                     jnp.int32))
+
+    draft_cases = [ContractCase("serve.decode.draft.s%d" % b,
+                                draft_args(b), label="s%d" % b,
+                                target=draft.step_program(b))
+                   for b in dcfg.slot_buckets]
+    dp_cases = [ContractCase("serve.decode.draft.prefill.p%d" % lp,
+                             draft_prefill_args(lp), label="p%d" % lp,
+                             target=draft.prefill_program(lp))
+                for lp in dcfg.prompt_buckets]
+    verify_cases = [ContractCase(
+        "serve.decode.verify.k%d.s%d" % (cfg.spec_k, b),
+        verify_args(b), label="k%d.s%d" % (cfg.spec_k, b),
+        target=sv.verify_program(b))
+        for b in cfg.slot_buckets]
+
+    def resolve_draft(point):
+        # (active-set size, window column): any size packs to its
+        # covering bucket; every column 0..spec_k-1 rides the SAME
+        # program (the column is traced data, not a signature)
+        n, col = point
+        if col < 0 or col >= cfg.spec_k:
+            return None
+        return draft_args(cfg.slot_bucket_for(int(n)))
+
+    def resolve_dp(n):
+        lp = cfg.prompt_bucket_for(int(n))
+        return None if lp is None else draft_prefill_args(lp)
+
+    def resolve_verify(n):
+        return verify_args(cfg.slot_bucket_for(int(n)))
+
+    draft_points = [(n, col) for n in range(1, cfg.slots + 1)
+                    for col in range(cfg.spec_k)]
+    draft_closure = ContractClosure(draft_points, resolve_draft)
+    dp_closure = ContractClosure(
+        range(1, cfg.prompt_buckets[-1] + 3), resolve_dp)
+    verify_closure = ContractClosure(range(1, cfg.slots + 1),
+                                     resolve_verify)
+    return (draft_cases, draft_closure, dp_cases, dp_closure,
+            verify_cases, verify_closure)
+
+
 def _declare_decode_contracts():
     from ..programs import declare_contract
     declare_contract(
@@ -1891,6 +2622,40 @@ def _declare_decode_contracts():
                     "copy-on-write page fork folded into the same "
                     "signature; heap donation proven, closure maps "
                     "any prompt length to the single compiled case")
+    declare_contract(
+        "serve.spec.draft", lambda: _spec_contract_built()[0],
+        donate_argnums=(1, 2, 3, 4, 5),
+        temp_budget_bytes=8 << 20,
+        closure=lambda: _spec_contract_built()[1],
+        description="speculative DRAFT step table (ISSUE 20): the "
+                    "draft's KV pool, token/length arrays and the "
+                    "device-resident proposals buffer donate in "
+                    "place; the window column is traced data, so the "
+                    "table is closed over every (active-set size, "
+                    "window column 0..spec_k-1) pair with one program "
+                    "per slot bucket")
+    declare_contract(
+        "serve.spec.draft.prefill", lambda: _spec_contract_built()[2],
+        donate_argnums=(1, 2, 3, 4),
+        temp_budget_bytes=8 << 20,
+        closure=lambda: _spec_contract_built()[3],
+        description="speculative draft-prefill sentinel (ISSUE 20): "
+                    "draft KV state donated, the TARGET's token array "
+                    "read-only (the adopted first token also orders "
+                    "the sentinel after the emit chunk); closed over "
+                    "the prompt-bucket admission set")
+    declare_contract(
+        "serve.spec.verify", lambda: _spec_contract_built()[4],
+        donate_argnums=(1, 2, 3, 4, 5, 6),
+        temp_budget_bytes=8 << 20,
+        closure=lambda: _spec_contract_built()[5],
+        description="speculative VERIFY table (ISSUE 20): one "
+                    "dispatch covers all spec_k+1 window positions — "
+                    "target heap + token/length AND the draft's "
+                    "token/length donate in place (both models' "
+                    "states stay flat and in lockstep), proposals "
+                    "read-only; closed over every active-set size "
+                    "1..slots")
 
 
 _declare_decode_contracts()

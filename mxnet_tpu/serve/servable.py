@@ -24,7 +24,14 @@ from ..base import MXNetError, get_env
 from .. import fault as _fault
 from .. import telemetry as _telemetry
 
-__all__ = ["BucketTable", "Servable", "ModelHost"]
+__all__ = ["BucketTable", "Servable", "ModelHost", "BudgetExceeded"]
+
+
+class BudgetExceeded(MXNetError):
+    """Raised when admitting a servable would bust the host's HBM
+    budget (``MX_SERVE_HBM_BUDGET``) — the wire layer maps this to the
+    typed in-band ``(False, "budget: ...")`` refusal, so a client can
+    tell "this replica is full" from a crash."""
 
 
 class BucketTable:
@@ -110,6 +117,12 @@ class Servable:
             "bucket program")
         self._c_batches = _counter(
             "serve.batches", "micro-batch dispatches")
+        # per-model twins (ISSUE 20): the aggregate series above stay
+        # for every existing gate; the labeled ones give the fleet
+        # plane a per-model breakdown on a multi-model replica
+        self._c_batches_m = _telemetry.registry.counter(
+            "serve.batches", doc="micro-batch dispatches",
+            labels={"model": self.name})
         # in-flight dispatch tracking for the host's drain
         self._inflight = 0
         self._inflight_cv = threading.Condition()
@@ -252,7 +265,31 @@ class Servable:
         _engine.count_dispatch(1)
         if not warming:
             self._c_batches.inc()
+            self._c_batches_m.inc()
         return outs
+
+    # -- footprint (the HBM bin-packer's measurement; ISSUE 20) -------------
+    def program_prefix(self) -> str:
+        """The program-registry name prefix this servable's programs
+        register under (``memory_analysis`` bytes aggregate by it)."""
+        return "serve.%s." % self.name
+
+    def live_bytes(self) -> int:
+        """Bytes of device arrays this servable holds LIVE (the same
+        arrays its ``buffer_census()`` owner tags claim)."""
+        return sum(int(getattr(a, "nbytes", 0))
+                   for a in self._param_values.values())
+
+    def footprint_bytes(self) -> int:
+        """Measured HBM footprint for budget admission: live bytes
+        (params + any device state) plus the peak transient bytes any
+        of its registered programs needs at dispatch.  Meaningful after
+        :meth:`warm` — warming is what populates ``memory_analysis``
+        in the program registry, which is why the packer admits AFTER
+        the warm."""
+        from .. import programs as _programs
+        mem = _programs.program_memory_bytes(self.program_prefix())
+        return self.live_bytes() + int(mem["temp_bytes_peak"])
 
     # -- lifecycle ----------------------------------------------------------
     def begin(self) -> bool:
@@ -289,46 +326,174 @@ class Servable:
 
 
 class ModelHost:
-    """Versioned servable lifecycle: load v(N+1) → warm → atomic flip →
-    drain v(N).
+    """Versioned servable lifecycle, MULTI-MODEL (ISSUE 20): one host
+    co-hosts N named models, each with its own version chain (load
+    v(N+1) → warm → atomic flip → drain v(N)), under one HBM budget.
 
-    ``active()`` is what the batcher dereferences per batch — one lock
-    acquisition, never blocked by a deploy in progress (warming happens
-    entirely BEFORE the flip, draining entirely after), so hot-swap
-    under load serves every request from exactly one complete version.
+    ``active(model)`` is what a batcher dereferences per batch — one
+    lock acquisition, never blocked by a deploy in progress (warming
+    happens entirely BEFORE the flip, draining entirely after), so
+    hot-swap under load serves every request from exactly one complete
+    version per model.  ``active()`` with no argument keeps the
+    single-model API: the DEFAULT model (first deployed).
+
+    **Census-driven bin-packing.**  With ``MX_SERVE_HBM_BUDGET`` > 0
+    (bytes), :meth:`deploy` measures the candidate's footprint AFTER
+    its warm — live param/state bytes (the arrays its
+    ``buffer_census()`` owner tags claim) plus the peak
+    ``memory_analysis`` temp bytes of its registered programs — and
+    refuses admission with :class:`BudgetExceeded` when hosted + new
+    would bust the budget (a same-name redeploy gets its
+    predecessor's bytes back first).  The refusal is typed so the wire
+    layer can answer in-band instead of dying.
     """
 
-    def __init__(self):
+    def __init__(self, hbm_budget: Optional[int] = None):
         self._lock = threading.Lock()
-        self._active: Optional[Servable] = None
+        self._servables: Dict[str, Servable] = {}
+        self._default: Optional[str] = None
         self._history: List[Tuple[int, str]] = []
+        self.hbm_budget = int(
+            hbm_budget if hbm_budget is not None else
+            get_env("MX_SERVE_HBM_BUDGET", 0, int))
+        #: per-model engines (micro-batchers), managed by the serving
+        #: layer; lives on the host so the wire layer's model routing
+        #: stays a read off the one object that owns model lifecycle
+        self.engines: Dict[str, object] = {}
 
-    def active(self) -> Servable:
+    def models(self) -> List[str]:
         with self._lock:
-            sv = self._active
+            return sorted(self._servables)
+
+    def active(self, model: Optional[str] = None) -> Servable:
+        with self._lock:
+            name = model if model is not None else self._default
+            sv = self._servables.get(name) if name is not None else None
         if sv is None:
-            raise MXNetError("ModelHost: no servable deployed")
+            if model is None:
+                raise MXNetError("ModelHost: no servable deployed")
+            raise MXNetError(
+                "ModelHost: unknown model %r (hosted: %s)"
+                % (model, ", ".join(sorted(self._servables)) or "none"))
         return sv
 
     @property
-    def version(self) -> int:
+    def default_model(self) -> Optional[str]:
         with self._lock:
-            return self._active.version if self._active is not None else 0
+            return self._default
+
+    @property
+    def version(self) -> int:
+        """The DEFAULT model's live version (single-model API)."""
+        with self._lock:
+            name = self._default
+            sv = self._servables.get(name) if name is not None else None
+            return sv.version if sv is not None else 0
+
+    def version_of(self, model: str) -> int:
+        with self._lock:
+            sv = self._servables.get(model)
+            return sv.version if sv is not None else 0
+
+    def _engine_servables(self) -> Dict[str, object]:
+        """Decode-engine servables co-hosted on this replica (target +
+        draft of a speculative pair ride ``engines``), excluding names
+        already counted as deployed servables — these share the HBM
+        budget with the predict-lane models."""
+        with self._lock:
+            hosted = set(self._servables)
+            engines = list(self.engines.values())
+        out: Dict[str, object] = {}
+        for eng in engines:
+            for sv in (getattr(eng, "servable", None),
+                       getattr(eng, "draft", None)):
+                if sv is None or not hasattr(sv, "footprint_bytes"):
+                    continue
+                if sv.name in hosted or sv.name in out:
+                    continue
+                out[sv.name] = sv
+        return out
+
+    def used_bytes(self) -> int:
+        """Measured footprint of every hosted servable (recomputed —
+        the census reads live handles, so this tracks reality, not an
+        admission-time estimate), plus any co-hosted decode engines'
+        models (a speculative draft/target pair shares the budget)."""
+        with self._lock:
+            svs = list(self._servables.values())
+        svs.extend(self._engine_servables().values())
+        return sum(sv.footprint_bytes() for sv in svs)
+
+    def packing_report(self) -> Dict[str, object]:
+        """The bin-packer's health/FLEET surface: per-model measured
+        footprints against the budget."""
+        with self._lock:
+            svs = dict(self._servables)
+            default = self._default
+        per_model = {name: {"version": sv.version,
+                            "footprint_bytes": sv.footprint_bytes()}
+                     for name, sv in svs.items()}
+        for name, sv in self._engine_servables().items():
+            per_model[name] = {"version": sv.version,
+                               "footprint_bytes": sv.footprint_bytes(),
+                               "engine": getattr(sv, "engine", "decode")}
+        used = sum(m["footprint_bytes"] for m in per_model.values())
+        return {
+            "hbm_budget_bytes": self.hbm_budget,
+            "used_bytes": used,
+            "free_bytes": (self.hbm_budget - used
+                           if self.hbm_budget > 0 else None),
+            "default_model": default,
+            "models": per_model,
+        }
 
     def deploy(self, servable: Servable, example: Optional[Sequence] = None,
                drain_timeout: float = 30.0) -> Servable:
         """Warm `servable` (when an example is given and it is not
-        already warm), flip it live, drain the predecessor."""
+        already warm), admit it against the HBM budget, flip it live
+        under its name, drain the same-name predecessor.  Raises
+        :class:`BudgetExceeded` (servable NOT retained) on a budget
+        bust."""
         if example is not None and servable.warmed_signature is None:
             servable.warm(example)
+        name = servable.name
         with self._lock:
-            if self._active is not None and \
-                    servable.version <= self._active.version:
+            prev = self._servables.get(name)
+            if prev is not None and servable.version <= prev.version:
                 raise MXNetError(
                     "ModelHost: version %d is not newer than the active "
-                    "%d" % (servable.version, self._active.version))
-            old, self._active = self._active, servable
-            self._history.append((servable.version, servable.name))
+                    "%d" % (servable.version, prev.version))
+        if self.hbm_budget > 0:
+            # admission AFTER warm: the footprint is measured, not
+            # estimated — warm populated memory_analysis and the params
+            # /state are resident
+            new_bytes = servable.footprint_bytes()
+            with self._lock:
+                others = [sv for n, sv in self._servables.items()
+                          if n != name]
+            others.extend(sv for n, sv in
+                          self._engine_servables().items() if n != name)
+            used = sum(sv.footprint_bytes() for sv in others)
+            if used + new_bytes > self.hbm_budget:
+                raise BudgetExceeded(
+                    "ModelHost: admitting %r v%d (%d bytes) would use "
+                    "%d of %d budget bytes (MX_SERVE_HBM_BUDGET; %d "
+                    "hosted: %s)"
+                    % (name, servable.version, new_bytes,
+                       used + new_bytes, self.hbm_budget, len(others),
+                       ", ".join(sorted(sv.name for sv in others))
+                       or "none"))
+        with self._lock:
+            prev = self._servables.get(name)
+            if prev is not None and servable.version <= prev.version:
+                raise MXNetError(
+                    "ModelHost: version %d is not newer than the active "
+                    "%d" % (servable.version, prev.version))
+            old = prev
+            self._servables[name] = servable
+            if self._default is None:
+                self._default = name
+            self._history.append((servable.version, name))
         if old is not None:
             old.drain(timeout=drain_timeout)
         return servable

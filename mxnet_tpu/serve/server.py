@@ -75,7 +75,7 @@ from ..kvstore.wire_codec import (WireCodecError, decode_array,
                                   encode_array, encode_text)
 from ..kvstore.wire_verbs import declare_verbs
 from .batcher import Batcher, Overloaded, result_timeout
-from .servable import ModelHost, Servable
+from .servable import BudgetExceeded, ModelHost, Servable
 
 __all__ = ["ServeServer", "serve_forever"]
 
@@ -136,6 +136,12 @@ class ServeServer:
         self.host = host or ModelHost()
         self.batcher = batcher or Batcher(self.host, **batcher_kw)
         self.decode = decode
+        # co-hosted decode engines join the host's engine map so the
+        # budget packer counts their models (a speculative pair's
+        # draft + target) and FLEET/HEALTH can enumerate them; never
+        # mutated from a verb branch
+        if decode is not None:
+            self.host.engines.setdefault(decode.servable.name, decode)
         # client_id -> [seq, done Event, resp]  (same shape as the
         # kvstore server's cache; one in-flight entry per client).
         # Serving clients are ephemeral (every ServeClient is a fresh
@@ -167,6 +173,23 @@ class ServeServer:
         self._draining = threading.Event()
         self._drain_lock = threading.Lock()
         self._drain_deadline: Optional[_fault.Deadline] = None
+
+    # -- multi-model lifecycle (ISSUE 20; startup/admin path, NOT a
+    # verb branch — engines are never created inside handle()) --------------
+    def add_model(self, servable: Servable, example=None,
+                  **batcher_kw) -> Servable:
+        """Deploy one more named model onto this replica: warm + budget
+        admission through ``ModelHost.deploy`` (raises
+        :class:`BudgetExceeded` on a bust, nothing retained), then give
+        the non-default model its own micro-batcher in
+        ``host.engines`` so PREDICTs carrying its name coalesce
+        independently of the default lane."""
+        sv = self.host.deploy(servable, example=example)
+        if sv.name != self.host.default_model and \
+                sv.name not in self.host.engines:
+            self.host.engines[sv.name] = Batcher(
+                self.host, model=sv.name, **batcher_kw)
+        return sv
 
     # -- envelope (kvstore SEQ contract) ------------------------------------
     def handle_request(self, msg, stream_fn=None):
@@ -248,7 +271,10 @@ class ServeServer:
     def handle(self, msg, span=None, stream_fn=None):
         cmd = msg[0]
         if cmd == "PREDICT":
-            return self._predict(msg[1], span)
+            # optional third element: the target model's name on a
+            # multi-model replica (absent/None -> the default model)
+            return self._predict(msg[1], span,
+                                 model=msg[2] if len(msg) > 2 else None)
         if cmd == "GENERATE":
             opts = msg[2] if len(msg) > 2 else {}
             return self._generate(msg[1], opts or {}, span, stream_fn)
@@ -270,13 +296,14 @@ class ServeServer:
             # servable rides the exposition as a model-labeled version
             # gauge, which is where the fleet collector/federation get
             # their `model` label from (no extra HEALTH round-trip)
-            try:
-                sv = self.host.active()
+            for name in self.host.models():
+                try:
+                    sv = self.host.active(name)
+                except MXNetError:
+                    continue    # raced an empty host / retired model
                 reg.gauge("serve.active_version",
                           doc="live servable version per hosted model",
                           labels={"model": sv.name}).set(sv.version)
-            except MXNetError:
-                pass        # empty host: nothing deployed yet
             text = reg.to_json(indent=1) if fmt == "json" \
                 else reg.to_prometheus()
             return True, encode_text(text)
@@ -284,6 +311,11 @@ class ServeServer:
             _, prefix, epoch, input_names = msg
             try:
                 version = self.swap(prefix, epoch, input_names)
+            except BudgetExceeded as e:
+                # typed in-band refusal (ISSUE 20): the packer said no —
+                # the replica is healthy, the model just does not fit
+                # under MX_SERVE_HBM_BUDGET; nothing was retained
+                return False, "budget: %s" % e
             except Exception as e:      # incl. a broken model's trace
                 # error: the old version stays live, the caller gets
                 # the reason instead of a severed connection
@@ -342,7 +374,7 @@ class ServeServer:
             dl = self._drain_deadline
         return dl is not None and dl.expired()
 
-    def _predict(self, payload: Sequence, span):
+    def _predict(self, payload: Sequence, span, model=None):
         if self._draining.is_set():
             # admission is closed: a NORMAL reply (not a severed
             # socket) so the router/client re-routes instead of
@@ -354,8 +386,21 @@ class ServeServer:
         except ValueError as e:
             return False, "bad PREDICT payload: %s" % e
         tctx = span.wire_context() if span is not None else None
+        # model routing (ISSUE 20): a named non-default model rides its
+        # own micro-batcher (host.engines, created at deploy, read-only
+        # here); no/None/default name keeps the single-model fast path
+        eng = self.batcher
+        if model is not None and model != self.host.default_model:
+            eng = self.host.engines.get(model)
+            if not isinstance(eng, Batcher):
+                return False, ("unknown model %r (hosted: %s)"
+                               % (model,
+                                  ", ".join(self.host.models()) or
+                                  "none"))
         try:
-            pending = self.batcher.submit(arrays, trace_ctx=tctx)
+            pending = eng.submit(arrays, trace_ctx=tctx) \
+                if eng is not self.batcher \
+                else self.batcher.submit(arrays, trace_ctx=tctx)
         except Overloaded as e:
             return False, "overloaded: %s" % e
         except MXNetError as e:
@@ -396,10 +441,26 @@ class ServeServer:
             return False, "bad GENERATE payload: prompt must be token ids"
         tctx = span.wire_context() if span is not None else None
         max_new = opts.get("max_tokens")
+        # model routing (ISSUE 20): the envelope may name which hosted
+        # LM to decode with; the default engine answers unnamed (and
+        # its own name), other names resolve through host.engines
+        model = opts.get("model")
+        eng = self.decode
+        if model is not None and model != self.decode.servable.name:
+            cand = self.host.engines.get(model)
+            if cand is None or isinstance(cand, Batcher) or \
+                    not hasattr(cand, "submit"):
+                return False, ("unknown model %r (decode engines: %s)"
+                               % (model, self.decode.servable.name))
+            eng = cand
         try:
-            pending = self.decode.submit(prompt, max_new=max_new,
-                                         eos_id=opts.get("eos"),
-                                         trace_ctx=tctx)
+            pending = eng.submit(prompt, max_new=max_new,
+                                 eos_id=opts.get("eos"),
+                                 trace_ctx=tctx) \
+                if eng is not self.decode \
+                else self.decode.submit(prompt, max_new=max_new,
+                                        eos_id=opts.get("eos"),
+                                        trace_ctx=tctx)
         except Overloaded as e:
             return False, "overloaded: %s" % e
         except MXNetError as e:
@@ -423,7 +484,7 @@ class ServeServer:
         except Exception as e:
             return False, "generate failed: %s: %s" % (type(e).__name__,
                                                        e)
-        return True, (self.decode.version, [int(t) for t in tokens])
+        return True, (eng.version, [int(t) for t in tokens])
 
     def health(self) -> Dict:
         reg = _telemetry.registry
@@ -436,6 +497,13 @@ class ServeServer:
                             "bucket_hits": sv.bucket_hits}
         except MXNetError:
             status = {"status": "empty", "version": 0}
+        # multi-model packing (ISSUE 20): per-model versions/footprints
+        # against the HBM budget, so the fleet can see what this
+        # replica co-hosts and how much headroom it has left
+        models = self.host.models()
+        if len(models) > 1 or self.host.hbm_budget > 0 or \
+                self.host.engines:
+            status["packing"] = self.host.packing_report()
         if self.decode is not None:
             # a decode-only replica is serving even with an empty host
             dsv = self.decode.servable
@@ -476,9 +544,16 @@ class ServeServer:
         version's signature, flip, drain — the wire face of
         ``ModelHost.deploy``."""
         new_version = self.host.version + 1
+        kw = {}
+        cur_name = self.host.default_model
+        if cur_name is not None:
+            # a SWAP replaces the DEFAULT model's version chain — same
+            # name, next version — not a new co-hosted model (add_model
+            # is the multi-model admission path)
+            kw["name"] = cur_name
         sv = Servable.from_checkpoint(prefix, epoch=epoch,
                                      input_names=input_names,
-                                     version=new_version)
+                                     version=new_version, **kw)
         example = None
         try:
             want = self.host.active().warmed_signature

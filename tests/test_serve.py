@@ -384,9 +384,16 @@ def test_replay_cache_is_bounded_lru(monkeypatch):
 def test_model_host_rejects_stale_versions():
     host, _sv, _net = _mk_host(version=3)
     with pytest.raises(MXNetError, match="not newer"):
-        host.deploy(Servable(demo_block(), version=3,
+        host.deploy(Servable(demo_block(), name="demo", version=3,
                              buckets=BucketTable((1, 2))),
                     example=demo_example())
+    # a DIFFERENT name is a new co-hosted model, not a stale redeploy
+    # (ISSUE 20 multi-model host): its own version chain starts fresh
+    host.deploy(Servable(demo_block(), name="demo-b", version=1,
+                         buckets=BucketTable((1, 2))),
+                example=demo_example())
+    assert host.version_of("demo-b") == 1
+    assert host.default_model == "demo"
 
 
 # ---------------------------------------------------------------------------

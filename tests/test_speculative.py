@@ -1,0 +1,245 @@
+"""Speculative decoding + multi-model packing tests (ISSUE 20):
+draft/verify window bit-identity against the greedy oracle across
+prompts x spec_k, forced draft disagreement (full and partial window
+rejection) via a monkeypatched draft step — output must stay bit-exact
+while the window arithmetic degrades exactly as the acceptance rule
+says — the census-driven ModelHost packer refusing a budget-busting
+admission with a typed in-band error, two co-hosted models answering
+isolated predictions with per-model telemetry labels, and the exact
+speculative dispatch plan (tools/dispatch_count.py --speculative).
+"""
+import numpy as np
+import pytest
+
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.kvstore.wire_codec import decode_array, encode_array
+from mxnet_tpu.serve import (BucketTable, ModelHost, Servable,
+                             ServeServer)
+from mxnet_tpu.serve.decode import (DecodeConfig, DraftDecodeServable,
+                                    PagedDecodeBatcher,
+                                    PagedDecodeServable,
+                                    SpeculativeDecodeBatcher,
+                                    demo_spec_pair, reference_generate)
+from mxnet_tpu.serve.demo import (DEMO_IN, demo_block, demo_example,
+                                  demo_expected)
+from mxnet_tpu.serve.servable import BudgetExceeded
+from mxnet_tpu.telemetry import registry
+
+# tiny paged geometry shared by every engine in this file: 3 slot
+# buckets + 1 chunk program on the target, 2 draft prefill buckets,
+# 3 verify buckets — cheap enough to warm per test
+SCFG = dict(dim=16, heads=2, layers=2, slots=4, max_tokens=24,
+            prompt_buckets=(4, 8), kv_page_len=4, prefill_chunk=4,
+            kv_pages=30)
+
+PROMPTS = ([3, 1, 4], [2, 7, 1, 8, 2, 8], [5, 5], [9, 3, 9, 8, 1])
+NEWS = (6, 11, 13, 8)
+
+
+def _pair(spec_k, draft_layers=1):
+    cfg = DecodeConfig(spec_k=spec_k, **SCFG)
+    tparams, dcfg, dparams = demo_spec_pair(cfg,
+                                            draft_layers=draft_layers)
+    sv = PagedDecodeServable(params=tparams, config=cfg)
+    draft = DraftDecodeServable(params=dparams, config=dcfg,
+                                name="demo-lm-draft")
+    return sv, draft, cfg
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: speculative == greedy oracle == plain paged engine
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("k", (1, 4))
+def test_speculative_bit_identity_across_prompts(k):
+    """Every emitted token is the target's own argmax: across window
+    sizes the speculative engine's output must equal the greedy oracle
+    token for token, with zero warm retraces on either model.  At k=4
+    the acceptance statement is also checked verbatim: the identical
+    workload through the PLAIN paged engine emits identical tokens
+    (the draft only changes the dispatch count)."""
+    sv, draft, cfg = _pair(k)
+    eng = SpeculativeDecodeBatcher(sv, draft, autostart=False)
+    try:
+        r0 = sv.retraces + draft.retraces
+        gens = [eng.submit(list(p), max_new=n)
+                for p, n in zip(PROMPTS, NEWS)]
+        eng.drain_sync()
+        refs = [reference_generate(list(p), n, params=sv.params,
+                                   config=cfg)
+                for p, n in zip(PROMPTS, NEWS)]
+        spec_outs = [g.tokens_so_far() for g in gens]
+        assert spec_outs == refs
+        assert sv.retraces + draft.retraces == r0
+    finally:
+        eng.close()
+    if k != 4:
+        return
+    plain_sv = PagedDecodeServable(params=sv.params, config=cfg)
+    plain = PagedDecodeBatcher(plain_sv, autostart=False)
+    try:
+        gens = [plain.submit(list(p), max_new=n)
+                for p, n in zip(PROMPTS, NEWS)]
+        plain.drain_sync()
+        assert [g.tokens_so_far() for g in gens] == spec_outs
+    finally:
+        plain.close()
+
+
+# ---------------------------------------------------------------------------
+# forced accept/reject: a corrupted draft degrades throughput, never
+# correctness
+# ---------------------------------------------------------------------------
+
+
+def test_forced_draft_disagreement(monkeypatch):
+    """Corrupt every proposal column >= ``corrupt_from`` AFTER the
+    draft step ran (draft_layers == layers, so uncorrupted columns
+    agree with the target exactly): each window then commits exactly
+    ``min(corrupt_from, k-1) + 1`` tokens, the window count follows,
+    and the output still equals the greedy oracle bit for bit.  One
+    engine serves every corruption point — the cell flips between
+    workloads (full rejection, 1-token and 2-token partial accepts)."""
+    k = 4
+    orig = DraftDecodeServable.dispatch_step
+    cell = {"corrupt_from": k}          # no corruption while warming
+
+    def corrupted(self, slot_ids, col):
+        props = orig(self, slot_ids, col)
+        if col >= cell["corrupt_from"]:
+            st = dict(self._state)
+            st["props"] = st["props"].at[:, col].set(
+                (st["props"][:, col] + 1) % self.config.vocab)
+            self._state = st
+            props = st["props"]
+        return props
+
+    monkeypatch.setattr(DraftDecodeServable, "dispatch_step",
+                        corrupted)
+    sv, draft, cfg = _pair(k, draft_layers=SCFG["layers"])
+    eng = SpeculativeDecodeBatcher(sv, draft, autostart=False)
+    try:
+        for corrupt_from in (0, 1, 2):
+            cell["corrupt_from"] = corrupt_from
+            n_em = min(corrupt_from, k - 1) + 1
+            for prompt, max_new in zip(PROMPTS[:2], (9, 12)):
+                w0 = registry.value("serve.decode.spec_windows")
+                g = eng.submit(list(prompt), max_new=max_new)
+                eng.drain_sync()
+                ref = reference_generate(list(prompt), max_new,
+                                         params=sv.params, config=cfg)
+                assert g.tokens_so_far() == ref
+                windows = registry.value(
+                    "serve.decode.spec_windows") - w0
+                assert windows == -(-(len(ref) - 1) // n_em), \
+                    "acceptance rule: %d tokens per window" % n_em
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# packer refusal + two-model isolation (the ModelHost side)
+# ---------------------------------------------------------------------------
+
+
+def _demo_sv(name, version=1, scale=None):
+    net = demo_block()
+    if scale is not None:
+        for p in net.collect_params().values():
+            p.set_data(p.data() * scale)
+    return Servable(net, name=name, version=version,
+                    buckets=BucketTable((1, 2))), net
+
+
+def test_packer_refuses_budget_busting_third_model():
+    """Two models fit the HBM budget; the third's censused footprint
+    (params + warm temp peak) busts it — deploy must raise the TYPED
+    BudgetExceeded (an MXNetError: in-band refusal on the wire, never
+    a crashed replica) and leave the two admitted models untouched."""
+    probe = ModelHost()
+    sv0, _ = _demo_sv("probe")
+    probe.deploy(sv0, example=demo_example())
+    foot = probe.used_bytes()
+    assert foot > 0
+
+    host = ModelHost(hbm_budget=int(2.5 * foot))
+    for name in ("m-a", "m-b"):
+        sv, _ = _demo_sv(name)
+        host.deploy(sv, example=demo_example())
+    third, _ = _demo_sv("m-c")
+    with pytest.raises(BudgetExceeded) as ei:
+        host.deploy(third, example=demo_example())
+    assert isinstance(ei.value, MXNetError)
+    msg = str(ei.value)
+    assert "MX_SERVE_HBM_BUDGET" in msg and "m-c" in msg
+    # the refusal names the incumbents and changed nothing
+    assert list(host.models()) == ["m-a", "m-b"]
+    assert host.version_of("m-a") == 1 and host.version_of("m-b") == 1
+    report = host.packing_report()
+    assert report["hbm_budget_bytes"] == int(2.5 * foot)
+    assert report["used_bytes"] <= report["hbm_budget_bytes"]
+    assert set(report["models"]) == {"m-a", "m-b"}
+
+
+def test_two_model_isolation_and_per_model_metrics():
+    """One replica, two co-hosted models with different weights: a
+    routed PREDICT answers from the named model's own engine (outputs
+    match that model's net, versions don't bleed), an unknown name is
+    refused in-band, and the serve counters carry per-model labels."""
+    host = ModelHost()
+    sv1, net1 = _demo_sv("demo")
+    host.deploy(sv1, example=demo_example())
+    state = ServeServer(host=host, max_delay_us=0, queue_cap=16)
+    try:
+        sv2, net2 = _demo_sv("demo-b", version=7, scale=3.0)
+        state.add_model(sv2, example=demo_example(), max_delay_us=0)
+        x = np.random.RandomState(5).rand(1, DEMO_IN).astype(np.float32)
+        c1 = registry.value("serve.requests",
+                            labels={"model": "demo"})
+        c2 = registry.value("serve.requests",
+                            labels={"model": "demo-b"})
+        ok, (ver, outs) = state.handle(("PREDICT", [encode_array(x)]))
+        assert ok and ver == 1
+        np.testing.assert_allclose(decode_array(outs[0]),
+                                   demo_expected(x, net=net1),
+                                   rtol=1e-4, atol=1e-5)
+        ok, (ver, outs) = state.handle(
+            ("PREDICT", [encode_array(x)], "demo-b"))
+        assert ok and ver == 7
+        np.testing.assert_allclose(decode_array(outs[0]),
+                                   demo_expected(x, net=net2),
+                                   rtol=1e-4, atol=1e-5)
+        # isolation: each model's labeled request counter moved by
+        # exactly its own traffic
+        assert registry.value("serve.requests",
+                              labels={"model": "demo"}) == c1 + 1
+        assert registry.value("serve.requests",
+                              labels={"model": "demo-b"}) == c2 + 1
+        ok, reason = state.handle(
+            ("PREDICT", [encode_array(x)], "nope"))
+        assert ok is False and "unknown model" in reason
+        assert "demo" in reason and "demo-b" in reason
+        # the packing report rides HEALTH once the host is multi-model
+        assert state.health()["packing"]["models"]
+    finally:
+        state.close()
+
+
+# ---------------------------------------------------------------------------
+# dispatch plan
+# ---------------------------------------------------------------------------
+
+
+def test_speculative_dispatch_plan_pinned():
+    """tools/dispatch_count.py --speculative: the sequential lane is
+    closed-form exact (chunks + draft prefill + k draft + 1 verify per
+    window), the concurrent lane satisfies the accounting identity
+    under the <=1-dispatch-per-tick budget, zero retraces."""
+    from tools.dispatch_count import run_speculative
+    res = run_speculative(n_gens=2, prompt_len=8, max_new=9, slots=4,
+                          spec_k=4)
+    assert res["ok"], res
+    assert res["sequential_dispatches"] == res["expected_sequential"]
+    assert res["max_dispatches_per_tick"] <= res["tick_budget"]
+    assert res["retraces"] == 0

@@ -256,6 +256,18 @@ def extract_record(report: dict) -> dict:
         if adm:
             rec["decode_admission_ratio"] = adm.get("capacity_ratio")
             rec["decode_admission_ok"] = bool(adm.get("ok"))
+        # ISSUE 20: speculative-decode gated series — the request-level
+        # speedup over the plain paged engine is an ABSOLUTE acceptance
+        # (>= 2x on the draft-friendly demo LM), and token parity /
+        # flat-heap / zero-retrace are invariants, not trajectories
+        spec = dec.get("speculative") or {}
+        if spec:
+            rec["decode_spec_speedup"] = spec.get("request_speedup")
+            rec["decode_spec_ok"] = bool(spec.get("speedup_ok"))
+            rec["decode_spec_parity_ok"] = bool(spec.get("parity"))
+            rec["decode_spec_kv_flat"] = bool(spec.get("kv_pool_flat"))
+            rec["decode_spec_zero_retraces"] = bool(
+                spec.get("zero_retraces"))
     # ISSUE 17: routed-lane gated series — the session router's
     # forwarding tax is an ABSOLUTE acceptance (routed p50 AND p99
     # within 10% of direct-to-replica, or the ADDED latency under the
@@ -320,6 +332,17 @@ def gate(rec, history, throughput_tol, memory_tol):
                 "direct-to-replica (and the added ms floor)"
                 % (rec.get("routed_p50_overhead_pct"),
                    rec.get("routed_p99_overhead_pct")))
+            return False, findings
+        if "decode_spec_speedup" in rec and (
+                not rec.get("decode_spec_ok")
+                or not rec.get("decode_spec_parity_ok")
+                or not rec.get("decode_spec_kv_flat")
+                or not rec.get("decode_spec_zero_retraces")):
+            findings.append(
+                "SPECULATIVE REGRESSION: request-level speedup %s "
+                "below the 2x acceptance floor, or token parity / "
+                "flat-heap / zero-retrace invariants broken"
+                % rec.get("decode_spec_speedup"))
             return False, findings
         return True, findings
     # Throughput gates within the record's own lane CLASS: same input-
@@ -431,6 +454,35 @@ def gate(rec, history, throughput_tol, memory_tol):
             findings.append(
                 "paged admission %sx wider than flat at equal KV HBM"
                 % rec.get("decode_admission_ratio"))
+    # ISSUE 20 gated series: speculative decode's acceptance invariants
+    if "decode_spec_speedup" in rec:
+        if not rec.get("decode_spec_parity_ok"):
+            ok = False
+            findings.append(
+                "SPECULATIVE PARITY BROKEN: speculative tokens "
+                "diverged from the plain paged greedy lane (accept/"
+                "verify must be bit-exact regardless of draft quality)")
+        if not rec.get("decode_spec_ok"):
+            ok = False
+            findings.append(
+                "SPECULATIVE REGRESSION: request-level speedup %s < "
+                "the 2x acceptance floor on the draft-friendly demo LM"
+                % rec.get("decode_spec_speedup"))
+        else:
+            findings.append(
+                "speculative request-level speedup %sx >= 2x"
+                % rec.get("decode_spec_speedup"))
+        if not rec.get("decode_spec_kv_flat"):
+            ok = False
+            findings.append(
+                "SPECULATIVE KV LEAK: target heap or draft pool bytes "
+                "grew across the bench run (window donation broke)")
+        if not rec.get("decode_spec_zero_retraces"):
+            ok = False
+            findings.append(
+                "SPECULATIVE RETRACE REGRESSION: serve-time retraces "
+                "after warmup (draft/verify bucket tables must be "
+                "closed over k and the slot buckets)")
     # ISSUE 17 gated series: the session router's forwarding tax
     if "routed_within_gate" in rec:
         if not rec["routed_within_gate"]:

@@ -365,6 +365,71 @@ grep -q 'paged: 64 pages' "$WORK/decode.log" || {
 echo "chaos_smoke: decode chaos PASS (paged failover + chunked" \
      "re-prefill under shared prefixes, sequences exact)"
 
+echo "== chaos_smoke: speculative decode - kill a replica mid-window (ISSUE 20)"
+# two supervised SPECULATIVE replicas (MX_SERVE_DRAFT spawns the
+# draft/verify pair co-hosted on the paged heap); the serve.request
+# fault kills one mid-load under the shared-prefix workload, so
+# in-flight generations die between a draft tick and its verify and
+# must fail over — the survivor re-prefills BOTH models (chunk train +
+# draft-prefill sentinel) and resumes windowed decode.  The driver's
+# oracle is the spec pair's TARGET (serve_load honors MX_SERVE_DRAFT),
+# and speculative output is bit-identical to target greedy decode, so
+# every recovered sequence must still match token for token.
+SPEC_BASE=$("$PY" - <<'EOF'
+import socket
+while True:
+    s1 = socket.socket(); s1.bind(("", 0)); p = s1.getsockname()[1]
+    s2 = socket.socket()
+    try:
+        s2.bind(("", p + 1))
+    except OSError:
+        s1.close(); s2.close(); continue
+    s1.close(); s2.close(); print(p); break
+EOF
+)
+rc=0
+MX_SERVE_DRAFT=1 MX_SERVE_SPEC_K=4 \
+MX_SERVE_KV_PAGES=64 MX_SERVE_KV_PAGE_LEN=16 \
+MX_SERVE_PREFIX_SHARE=1 MX_SERVE_PREFILL_CHUNK=16 \
+PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}" \
+"$PY" "$REPO/tools/launch.py" -n 2 --launcher local \
+    --restart on-failure --max-restarts 3 --hang-timeout 60 \
+    --fault 'serve.request:crash:after=50' -- \
+    "$PY" -m mxnet_tpu.serve --decode --port-base "$SPEC_BASE" \
+    > "$WORK/spec_decode.log" 2>&1 &
+SPEC_LAUNCH_PID=$!
+MX_SERVE_DRAFT=1 MX_SERVE_SPEC_K=4 \
+"$PY" "$REPO/tools/serve_load.py" \
+    --addrs "127.0.0.1:$SPEC_BASE,127.0.0.1:$((SPEC_BASE+1))" \
+    --decode --requests 80 --shared-prefix 3 --chaos --stop 2>&1 \
+    | tee "$WORK/spec_load.log" || rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "chaos_smoke: FAIL - speculative load driver exited $rc" >&2
+    kill "$SPEC_LAUNCH_PID" 2>/dev/null || true
+    cat "$WORK/spec_decode.log" >&2 || true
+    exit 1
+fi
+wait "$SPEC_LAUNCH_PID" || rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "chaos_smoke: FAIL - speculative launch.py exited $rc" >&2
+    cat "$WORK/spec_decode.log" >&2 || true
+    exit 1
+fi
+grep -q 'restart 1/' "$WORK/spec_decode.log" || {
+    echo "chaos_smoke: FAIL - no speculative replica was restarted" >&2
+    exit 1
+}
+grep -q 'SERVE_LOAD_OK' "$WORK/spec_load.log" || {
+    echo "chaos_smoke: FAIL - speculative load driver never reported OK" >&2
+    exit 1
+}
+grep -q 'speculative: k=4 draft=demo-lm-draft' "$WORK/spec_decode.log" || {
+    echo "chaos_smoke: FAIL - replicas did not come up SPECULATIVE" >&2
+    exit 1
+}
+echo "chaos_smoke: speculative chaos PASS (draft+target failover" \
+     "re-prefill, windowed sequences bit-exact)"
+
 echo "== chaos_smoke: session router - kill a replica UNDER the router (ISSUE 17)"
 # the fleet front-tier: one router address fronting two supervised
 # decode replicas.  The serve.request fault kills a replica mid-load;
@@ -617,15 +682,16 @@ grep -q 'SERVE_LOAD_OK' "$WORK/as_spike.log" || {
 }
 echo "chaos_smoke: autoscaler PASS (spike spawned, drained back, all answers exact)"
 
-echo "== chaos_smoke: serve dispatch budgets (1/batch, 1/decode step, +0 routed)"
+echo "== chaos_smoke: serve dispatch budgets (1/batch, 1/decode step, +0 routed, spec window k+1)"
 "$PY" "$REPO/tools/dispatch_count.py" --serve --decode --routed \
-    > "$WORK/serve_budget.json"
+    --speculative > "$WORK/serve_budget.json"
 "$PY" - "$WORK/serve_budget.json" <<'EOF'
 import json, sys
 r = json.load(open(sys.argv[1]))
 assert r["serve"]["ok"], r["serve"]
 assert r["decode"]["ok"], r["decode"]
 assert r["routed"]["ok"], r["routed"]
+assert r["speculative"]["ok"], r["speculative"]
 print("serve budget: %(dispatches)d dispatches / %(batches)d batches, "
       "%(retraces)d retraces" % r["serve"])
 print("decode budget: %(dispatches)d dispatches = %(prefill_dispatches)d "
@@ -634,6 +700,9 @@ print("decode budget: %(dispatches)d dispatches = %(prefill_dispatches)d "
 print("routed budget: %(routed_dispatches)d dispatches routed == "
       "%(direct_dispatches)d direct (+%(extra_dispatches)d), "
       "%(routed_retraces)d retraces" % r["routed"])
+print("speculative budget: %(sequential_dispatches)d dispatches == "
+      "%(expected_sequential)d planned (k=%(spec_k)d windows exact), "
+      "%(retraces)d retraces" % r["speculative"])
 EOF
 
 echo "== chaos_smoke: fleet telemetry plane - kill a replica + a worker mid-load (ISSUE 12)"

@@ -49,6 +49,12 @@ DECODE_STEP_BUDGET = 1
 # prefill chunk OR a decode step, never both), every dispatch is
 # accounted as exactly one of the two, and retraces stay zero
 PAGED_TICK_BUDGET = 1
+# ISSUE 20: the speculative engine's plan is exact — each admission is
+# its chunk train + ONE draft prefill (the sentinel ending the train),
+# then every window is spec_k draft dispatches + ONE verify dispatch
+# (committing 1..spec_k tokens), still at most one program per pump
+# tick, and retraces stay zero on BOTH models
+SPEC_TICK_BUDGET = 1
 
 
 def run_exchange(n_keys=40):
@@ -343,6 +349,115 @@ def run_paged_decode(n_gens=6, prompt_len=8, max_new=5, slots=8):
     }
 
 
+def run_speculative(n_gens=4, prompt_len=8, max_new=9, slots=8,
+                    spec_k=4):
+    """ISSUE 20 acceptance: the speculative engine's dispatch
+    arithmetic, driven tick by tick.
+
+    Sequential lane (one generation at a time, a FULL-acceptance
+    draft == target): the plan is closed-form — per generation,
+    ``ceil(prompt/chunk)`` chunk dispatches + 1 draft prefill (the
+    train's sentinel) + ``ceil((max_new-1)/k)`` windows of exactly
+    ``k`` draft dispatches + 1 verify dispatch.  Concurrent lane (all
+    generations at once): the exact count depends on admission overlap,
+    so the pinned invariants are the accounting identity (dispatches ==
+    chunks + draft prefills + draft steps + verifies), the <=1
+    program-per-tick budget, and ZERO retraces on both models."""
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.engine import engine
+    from mxnet_tpu.serve.decode import (DecodeConfig,
+                                        DraftDecodeServable,
+                                        PagedDecodeServable,
+                                        SpeculativeDecodeBatcher,
+                                        demo_spec_pair)
+
+    assert n_gens <= slots, "budget plan needs one admission boundary"
+    chunk = 4
+    cfg = DecodeConfig(slots=slots, max_tokens=prompt_len + max_new + 1,
+                       prompt_buckets=(4, 8), kv_page_len=4,
+                       prefill_chunk=chunk, spec_k=spec_k)
+    k = cfg.spec_k
+    # draft_layers == layers: the draft IS the target, so every window
+    # fully accepts and the sequential plan is exact arithmetic
+    tparams, dcfg, dparams = demo_spec_pair(cfg,
+                                            draft_layers=cfg.layers)
+    sv = PagedDecodeServable(params=tparams, config=cfg)
+    draft = DraftDecodeServable(params=dparams, config=dcfg,
+                                name="demo-lm-draft")
+    eng = SpeculativeDecodeBatcher(sv, draft, autostart=False)
+    reg = telemetry.registry
+
+    def counters():
+        return {
+            "chunks": reg.value("serve.decode.prefill_chunks"),
+            "dp": reg.value("serve.decode.draft_prefills"),
+            "ds": reg.value("serve.decode.draft_steps"),
+            "verify": reg.value("serve.decode.spec_windows"),
+        }
+
+    def drive():
+        max_per_tick, busy, ticks = 0, True, 0
+        while busy and ticks < 20000:
+            t0 = engine.snapshot()["dispatches"]
+            busy = eng.step_sync()
+            max_per_tick = max(max_per_tick,
+                               engine.snapshot()["dispatches"] - t0)
+            ticks += 1
+        return max_per_tick
+
+    retraces0 = sv.retraces + draft.retraces
+    # -- sequential lane: closed-form plan ----------------------------------
+    c0, k0 = engine.snapshot()["dispatches"], counters()
+    seq_gens = []
+    for i in range(n_gens):
+        g = eng.submit([(i + j) % 7 + 1 for j in range(prompt_len)],
+                       max_new=max_new)
+        drive()
+        seq_gens.append(g)
+    seq_d = engine.snapshot()["dispatches"] - c0
+    k1 = counters()
+    seq = {key: k1[key] - k0[key] for key in k1}
+    chunks_per = -(-prompt_len // chunk)
+    windows_per = -(-(max_new - 1) // k)
+    want_seq = n_gens * (chunks_per + 1 + windows_per * (k + 1))
+    seq_ok = (all(len(g.tokens_so_far()) == max_new and g.done()
+                  for g in seq_gens)
+              and seq["chunks"] == n_gens * chunks_per
+              and seq["dp"] == n_gens
+              and seq["ds"] == n_gens * windows_per * k
+              and seq["verify"] == n_gens * windows_per
+              and seq_d == want_seq)
+    # -- concurrent lane: accounting identity + tick budget -----------------
+    c0, k0 = engine.snapshot()["dispatches"], counters()
+    gens = [eng.submit([(i + j) % 7 + 1 for j in range(prompt_len)],
+                       max_new=max_new) for i in range(n_gens)]
+    max_per_tick = drive()
+    conc_d = engine.snapshot()["dispatches"] - c0
+    k1 = counters()
+    conc = {key: k1[key] - k0[key] for key in k1}
+    accounted = (conc["chunks"] + conc["dp"] + conc["ds"]
+                 + conc["verify"])
+    conc_ok = (all(len(g.tokens_so_far()) == max_new and g.done()
+                   for g in gens)
+               and conc_d == accounted
+               and max_per_tick <= SPEC_TICK_BUDGET)
+    retraces = (sv.retraces + draft.retraces) - retraces0
+    return {
+        "generations": n_gens,
+        "spec_k": k,
+        "sequential_dispatches": seq_d,
+        "expected_sequential": want_seq,
+        "sequential_plan": seq,
+        "concurrent_dispatches": conc_d,
+        "concurrent_accounted": accounted,
+        "concurrent_plan": conc,
+        "max_dispatches_per_tick": max_per_tick,
+        "tick_budget": SPEC_TICK_BUDGET,
+        "retraces": retraces,
+        "ok": bool(seq_ok and conc_ok and retraces == 0),
+    }
+
+
 def run_routed(n_requests=24, rows_per_request=2, max_batch=8):
     """ISSUE 17 acceptance: the session router is a PURE host-side
     forwarder — the same PREDICT burst driven through it costs exactly
@@ -515,6 +630,13 @@ def main():
                          "AND the ISSUE 18 paged budget (chunked "
                          "prefill = at most 1 dispatch per pump tick, "
                          "chunks counted as steps, 0 retraces)")
+    ap.add_argument("--speculative", action="store_true",
+                    help="with --serve --decode: also pin the ISSUE 20 "
+                         "speculative budget (per window: exactly "
+                         "spec_k draft dispatches + 1 verify dispatch "
+                         "committing 1..k tokens; chunk trains end in "
+                         "one draft-prefill sentinel; <=1 program per "
+                         "pump tick; 0 retraces on either model)")
     ap.add_argument("--routed", action="store_true",
                     help="with --serve: also pin the ISSUE 17 router "
                          "budget: the same burst through the session "
@@ -563,6 +685,10 @@ def main():
         report["paged_decode"] = run_paged_decode()
         report["ok"] = bool(report["ok"]
                             and report["paged_decode"]["ok"])
+    if args.speculative:
+        report["speculative"] = run_speculative()
+        report["ok"] = bool(report["ok"]
+                            and report["speculative"]["ok"])
     if args.routed:
         report["routed"] = run_routed()
         report["ok"] = bool(report["ok"] and report["routed"]["ok"])

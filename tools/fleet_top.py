@@ -137,6 +137,21 @@ def render(snap):
     out = ["fleet @ scrape %s (%s member(s))"
            % (snap.get("scrape", "?"), len(snap.get("members") or {})),
            sep] + lines + [sep]
+    # per-model traffic rows (ISSUE 20, schema 3): one line per
+    # co-hosted model from the merged model-labeled counter rollup
+    for mdl in sorted(snap.get("models") or {}):
+        row = snap["models"][mdl]
+        parts = []
+        for cname, label in (("serve.requests", "req"),
+                             ("serve.rows", "rows"),
+                             ("serve.batches", "batches"),
+                             ("serve.decode.requests", "gen"),
+                             ("serve.decode.tokens", "tok"),
+                             ("serve.decode.sequences", "seqs")):
+            v = row.get(cname)
+            if v:
+                parts.append("%s=%s" % (label, _fmt(v, "%d")))
+        out.append("model %-16s %s" % (mdl, " ".join(parts) or "-"))
     slo = snap.get("slo") or {}
     out.append("slo: p50=%.4gms p99=%.4gms reject=%.3g%% queue=%.3g"
                % (slo.get("p50_ms", 0), slo.get("p99_ms", 0),

@@ -41,6 +41,12 @@ failover that re-prefills on the survivor must reproduce the sequence
 EXACTLY — completed sequences are never lost, replayed at most once,
 and never silently wrong.
 
+With ``MX_SERVE_DRAFT`` set (the replicas run the SPECULATIVE engine,
+ISSUE 20) the local oracle mirrors the replica's model construction —
+the spec pair's TARGET params — because speculative decoding is
+bit-identical to the target's own greedy decode; the verification
+itself is byte-for-byte the same.
+
 ``--shared-prefix K`` (with ``--decode``, ISSUE 18) reshapes the load
 into the paged engine's headline workload: the N sessions cycle over K
 distinct full-bucket prompts, so a prefix-sharing replica answers every
@@ -80,6 +86,26 @@ def wait_up(addrs, timeout=90.0):
     if pending:
         raise SystemExit("serve_load: replicas never came up: %s"
                          % pending)
+
+
+def decode_oracle():
+    """(cfg, params) for the LOCAL reference decode — mirrors the
+    replica's own model construction.  Under MX_SERVE_DRAFT the
+    replica's GENERATE lane is the speculative pair's TARGET
+    (``demo_spec_pair`` damps the deep layers so a shallow draft stays
+    plausible), and speculative decoding is bit-identical to that
+    target's greedy decode, so the oracle must be built the same way."""
+    from mxnet_tpu.base import get_env
+    from mxnet_tpu.serve.decode import (DecodeConfig, demo_lm_params,
+                                        demo_spec_pair)
+    cfg = DecodeConfig()
+    draft_layers = int(get_env("MX_SERVE_DRAFT", 0, int) or 0)
+    if draft_layers > 0:
+        params, _dcfg, _dparams = demo_spec_pair(
+            cfg, draft_layers=draft_layers)
+    else:
+        params = demo_lm_params(cfg)
+    return cfg, params
 
 
 def main():
@@ -145,11 +171,8 @@ def main():
         # the prefix-reuse workload: N sessions over K full-bucket
         # prompts, first-token latency split cold (first sight) vs
         # shared (repeats a paged replica answers from its hash table)
-        from mxnet_tpu.serve.decode import (DecodeConfig,
-                                            demo_lm_params,
-                                            reference_generate)
-        cfg = DecodeConfig()
-        params = demo_lm_params(cfg)
+        from mxnet_tpu.serve.decode import reference_generate
+        cfg, params = decode_oracle()
         plen = cfg.prompt_buckets[-1]
         max_new = min(args.max_tokens, cfg.max_tokens)
         bases = [[int(t) for t in rng.randint(2, cfg.vocab, size=plen)]
@@ -186,11 +209,8 @@ def main():
         # local truth: the reference greedy decode of the same seeded
         # demo LM — a replica (or a failover re-prefill on the
         # survivor) must answer these tokens EXACTLY
-        from mxnet_tpu.serve.decode import (DecodeConfig,
-                                            demo_lm_params,
-                                            reference_generate)
-        cfg = DecodeConfig()
-        params = demo_lm_params(cfg)
+        from mxnet_tpu.serve.decode import reference_generate
+        cfg, params = decode_oracle()
         # mirror the server's silent clamp (submit caps max_new at
         # MX_SERVE_DECODE_MAX_TOKENS) or the local oracle would expect
         # more tokens than a CORRECT replica may return
